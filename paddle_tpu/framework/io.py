@@ -1,0 +1,62 @@
+"""Checkpoint save/load.
+
+Mirrors `python/paddle/framework/io.py:565,781` (`paddle.save`/`paddle.load`
+— pickled state dicts with protocol-4 for >4GB tensors; the reference's C++
+twins are `save_combine_op`/`load_combine_op`). Arrays are stored as numpy;
+loading returns jax arrays. Nested dicts/lists and optimizer state round-trip.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _to_numpy(obj: Any):
+    if isinstance(obj, jax.Array):
+        return np.asarray(obj)
+    if hasattr(obj, "value") and hasattr(obj, "stop_gradient"):  # Parameter
+        return np.asarray(obj.value)
+    if isinstance(obj, dict):
+        return {k: _to_numpy(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        if hasattr(obj, "_fields"):  # NamedTuple
+            return t(*(_to_numpy(v) for v in obj))
+        return t(_to_numpy(v) for v in obj)
+    return obj
+
+
+def _to_jax(obj: Any):
+    if isinstance(obj, np.ndarray):
+        return jnp.asarray(obj)
+    if isinstance(obj, dict):
+        return {k: _to_jax(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        if hasattr(obj, "_fields"):
+            return t(*(_to_jax(v) for v in obj))
+        return t(_to_jax(v) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4):
+    """paddle.save equivalent."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    if hasattr(obj, "state_dict") and callable(obj.state_dict):
+        obj = obj.state_dict()
+    with open(path, "wb") as f:
+        pickle.dump(_to_numpy(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False):
+    """paddle.load equivalent."""
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return obj if return_numpy else _to_jax(obj)

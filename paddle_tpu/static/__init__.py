@@ -1,0 +1,35 @@
+"""`paddle.static` equivalent — the compiled-execution namespace.
+
+The reference's static graph (ProgramDesc + C++ Executor,
+`framework/executor.cc`) is subsumed by jax tracing + XLA compilation: a
+"Program" is a traced, shape-specialized computation. This namespace keeps
+the user-facing pieces that still mean something on TPU: `InputSpec`,
+inference save/load, and a thin `Executor` shim for script parity.
+"""
+from .input_spec import InputSpec  # noqa: F401
+
+
+def load_inference_model(path_prefix, executor=None):
+    from ..jit import load as _jit_load
+    return _jit_load(path_prefix)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    raise NotImplementedError(
+        "Use paddle_tpu.jit.save(layer, path, input_spec=...) — the static "
+        "program pipeline is a jax trace in this framework.")
+
+
+class Executor:
+    """Shim for scripts that instantiate `paddle.static.Executor`. Running
+    arbitrary Programs is not supported (no ProgramDesc IR); jitted
+    callables replace it."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None):
+        raise NotImplementedError(
+            "Executor.run(Program) has no TPU equivalent: compile a step "
+            "function with paddle_tpu.jit.to_static / jax.jit instead.")

@@ -1,0 +1,2 @@
+"""`paddle.vision` equivalent."""
+from . import models  # noqa: F401

@@ -1,0 +1,37 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh
+(`--xla_force_host_platform_device_count=8`), the same trick the reference
+uses to test distributed logic without a cluster (SURVEY.md §4 "Port
+lesson"). The env must be set before jax initializes a backend; do NOT
+import jax above these lines in any test module imported earlier.
+
+Note: under the axon TPU tunnel, JAX_PLATFORMS must be overridden
+in-process (the sitecustomize hook reads ambient env at startup); setting it
+here before first backend use routes everything to CPU.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng_seed():
+    import paddle_tpu
+    paddle_tpu.seed(0)
+    return 0
+
+
+@pytest.fixture
+def mesh8():
+    """A 2x2x2 (data, pipe, model) test mesh on virtual CPU devices."""
+    from paddle_tpu.distributed import build_mesh
+    return build_mesh(dp=2, pp=2, mp=2)

@@ -1,0 +1,63 @@
+"""OpTest-style harness: numeric-vs-analytic gradient checks.
+
+Mirrors the reference's `unittests/op_test.py:270` strategy: run the op
+forward, compare `jax.grad` against central finite differences
+(`get_numeric_gradient`, op_test.py:110), with per-op tolerance knobs.
+Also cross-checks eager vs jitted execution (the reference cross-checks
+static vs dygraph, op_test.py:637).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def numeric_grad(fn, args, idx=0, eps=1e-3):
+    """Central finite differences w.r.t. args[idx] (fp64 on CPU)."""
+    args = [np.asarray(a, dtype=np.float64) if np.issubdtype(
+        np.asarray(a).dtype, np.floating) else np.asarray(a) for a in args]
+    x = args[idx]
+    grad = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = grad.reshape(-1)
+
+    def f(v):
+        a = list(args)
+        a[idx] = v.reshape(x.shape)
+        out = fn(*a)
+        return float(np.sum(np.asarray(out, dtype=np.float64)))
+
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        fp = f(flat)
+        flat[i] = orig - eps
+        fm = f(flat)
+        flat[i] = orig
+        gflat[i] = (fp - fm) / (2 * eps)
+    return grad
+
+
+def check_grad(fn, args, idx=0, rtol=1e-2, atol=1e-3, eps=1e-3):
+    """Assert jax.grad(sum(fn)) matches finite differences."""
+    def scalar_fn(*a):
+        return jnp.sum(fn(*a))
+
+    analytic = jax.grad(scalar_fn, argnums=idx)(
+        *[jnp.asarray(a) for a in args])
+    numeric = numeric_grad(fn, args, idx=idx, eps=eps)
+    np.testing.assert_allclose(np.asarray(analytic), numeric, rtol=rtol,
+                               atol=atol,
+                               err_msg=f"grad mismatch for arg {idx}")
+
+
+def check_eager_vs_jit(fn, args, rtol=1e-6, atol=1e-6):
+    """The reference's dygraph-vs-static cross-check (op_test.py:1101)."""
+    eager = fn(*args)
+    jitted = jax.jit(fn)(*args)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=rtol, atol=atol),
+        eager, jitted)
+    return eager

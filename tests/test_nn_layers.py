@@ -1,0 +1,210 @@
+"""nn.Layer system + layer tests (reference analogue:
+test_imperative_basic.py, test_layers.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn.layer import (
+    buffer_state,
+    functional_call,
+    load_state,
+    trainable_state,
+)
+
+
+class TestLayerSystem:
+    def test_parameter_registration(self):
+        lin = nn.Linear(3, 4)
+        names = [n for n, _ in lin.named_parameters()]
+        assert names == ["weight", "bias"]
+        assert lin.weight.shape == (3, 4)
+
+    def test_nested_layers(self):
+        net = nn.Sequential(nn.Linear(2, 4), nn.ReLU(), nn.Linear(4, 1))
+        names = [n for n, _ in net.named_parameters()]
+        assert "0.weight" in names and "2.bias" in names
+        assert len(list(net.sublayers())) == 3
+
+    def test_state_dict_roundtrip(self, tmp_path):
+        net = nn.Linear(2, 2)
+        sd = net.state_dict()
+        net2 = nn.Linear(2, 2)
+        missing, unexpected = net2.set_state_dict(sd)
+        assert not missing and not unexpected
+        np.testing.assert_array_equal(np.asarray(net2.weight.value),
+                                      np.asarray(net.weight.value))
+        paddle.save(net.state_dict(), str(tmp_path / "m.pdparams"))
+        loaded = paddle.load(str(tmp_path / "m.pdparams"))
+        np.testing.assert_array_equal(np.asarray(loaded["weight"]),
+                                      np.asarray(net.weight.value))
+
+    def test_hooks(self):
+        lin = nn.Linear(2, 2)
+        calls = []
+        h = lin.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(out.shape))
+        lin(jnp.ones((1, 2)))
+        assert calls == [(1, 2)]
+        h.remove()
+        lin(jnp.ones((1, 2)))
+        assert len(calls) == 1
+
+    def test_train_eval_mode(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        x = jnp.ones((4, 2))
+        np.testing.assert_array_equal(np.asarray(net(x)),
+                                      np.asarray(net(x)))
+
+    def test_functional_call_pure(self):
+        lin = nn.Linear(2, 2)
+        orig = np.asarray(lin.weight.value)
+        params = {"weight": jnp.zeros((2, 2)), "bias": jnp.zeros((2,))}
+        out, _ = functional_call(lin, params, jnp.ones((1, 2)))
+        assert float(jnp.abs(out).sum()) == 0.0
+        np.testing.assert_array_equal(np.asarray(lin.weight.value), orig)
+
+
+class TestLayers:
+    def test_conv2d_shape(self):
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        out = conv(jnp.ones((2, 3, 16, 16)))
+        assert out.shape == (2, 8, 8, 8)
+
+    def test_conv2d_matches_numpy(self, rng_seed):
+        conv = nn.Conv2D(1, 1, 3, padding=0, bias_attr=False)
+        conv.weight.set_value(np.ones((1, 1, 3, 3), np.float32))
+        x = jnp.ones((1, 1, 5, 5))
+        out = conv(x)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.full((1, 1, 3, 3), 9.0))
+
+    def test_conv_transpose(self):
+        deconv = nn.Conv2DTranspose(4, 2, 3, stride=2, padding=1)
+        out = deconv(jnp.ones((1, 4, 8, 8)))
+        assert out.shape == (1, 2, 15, 15)
+
+    def test_batchnorm_running_stats(self):
+        bn = nn.BatchNorm2D(3)
+        x = jax.random.normal(jax.random.key(0), (8, 3, 4, 4)) * 2 + 5
+        bn.train()
+        out = bn(x)
+        assert abs(float(jnp.mean(out))) < 1e-4
+        assert float(jnp.abs(bn._mean.value).sum()) > 0
+        bn.eval()
+        out_eval = bn(x)
+        assert out_eval.shape == x.shape
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = jax.random.normal(jax.random.key(0), (2, 4, 8)) * 3 + 1
+        out = ln(x)
+        np.testing.assert_allclose(np.asarray(jnp.mean(out, -1)), 0.0,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(jnp.std(out, -1)), 1.0,
+                                   atol=1e-2)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        out = emb(jnp.asarray([[1, 0, 3]]))
+        assert out.shape == (1, 3, 4)
+        np.testing.assert_array_equal(np.asarray(out[0, 1]), np.zeros(4))
+
+    def test_pools(self):
+        x = jnp.arange(16.0).reshape(1, 1, 4, 4)
+        mp = nn.MaxPool2D(2, 2)(x)
+        ap = nn.AvgPool2D(2, 2)(x)
+        assert mp.shape == (1, 1, 2, 2)
+        np.testing.assert_allclose(np.asarray(mp[0, 0]), [[5, 7], [13, 15]])
+        np.testing.assert_allclose(np.asarray(ap[0, 0]),
+                                   [[2.5, 4.5], [10.5, 12.5]])
+        gap = nn.AdaptiveAvgPool2D(1)(x)
+        assert float(gap[0, 0, 0, 0]) == 7.5
+
+    def test_dropout_train_vs_eval(self):
+        drop = nn.Dropout(0.5)
+        x = jnp.ones((100, 100))
+        drop.train()
+        out = drop(x)
+        frac_zero = float(jnp.mean(out == 0))
+        assert 0.3 < frac_zero < 0.7
+        drop.eval()
+        np.testing.assert_array_equal(np.asarray(drop(x)), np.asarray(x))
+
+    def test_rnn_lstm_gru(self):
+        for cls in [nn.SimpleRNN, nn.LSTM, nn.GRU]:
+            rnn = cls(4, 8, num_layers=2)
+            out, state = rnn(jnp.ones((2, 5, 4)))
+            assert out.shape == (2, 5, 8)
+        birnn = nn.LSTM(4, 8, direction="bidirect")
+        out, _ = birnn(jnp.ones((2, 5, 4)))
+        assert out.shape == (2, 5, 16)
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        out = enc(jnp.ones((2, 6, 16)))
+        assert out.shape == (2, 6, 16)
+
+    def test_multihead_attention_causal_mask(self):
+        mha = nn.MultiHeadAttention(8, 2, dropout=0.0)
+        x = jax.random.normal(jax.random.key(0), (1, 4, 8))
+        mask = jnp.tril(jnp.ones((4, 4), dtype=bool))
+        out = mha(x, attn_mask=mask)
+        assert out.shape == (1, 4, 8)
+
+
+class TestLosses:
+    def test_cross_entropy_matches_manual(self, rng_seed):
+        logits = jax.random.normal(jax.random.key(1), (4, 5))
+        label = jnp.asarray([0, 2, 1, 4])
+        loss = nn.functional.cross_entropy(logits, label)
+        manual = -jnp.mean(jnp.take_along_axis(
+            jax.nn.log_softmax(logits), label[:, None], 1))
+        np.testing.assert_allclose(float(loss), float(manual), rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = jnp.ones((3, 4))
+        label = jnp.asarray([0, -100, 2])
+        loss = nn.functional.cross_entropy(logits, label,
+                                           ignore_index=-100)
+        assert np.isfinite(float(loss))
+
+    def test_mse_l1(self):
+        a = jnp.asarray([1.0, 2.0])
+        b = jnp.asarray([2.0, 4.0])
+        assert float(nn.functional.mse_loss(a, b)) == 2.5
+        assert float(nn.functional.l1_loss(a, b)) == 1.5
+
+    def test_bce_with_logits(self, rng_seed):
+        logit = jax.random.normal(jax.random.key(2), (8,))
+        label = (jax.random.uniform(jax.random.key(3), (8,)) > 0.5) * 1.0
+        loss = nn.functional.binary_cross_entropy_with_logits(logit, label)
+        manual = -jnp.mean(label * jax.nn.log_sigmoid(logit) +
+                           (1 - label) * jax.nn.log_sigmoid(-logit))
+        np.testing.assert_allclose(float(loss), float(manual), rtol=1e-5)
+
+
+class TestPyLayer:
+    def test_custom_vjp(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x ** 3
+
+            @staticmethod
+            def backward(ctx, dy):
+                x, = ctx.saved_tensor
+                return 3 * x ** 2 * dy
+
+        x = jnp.asarray(2.0)
+        assert float(Cube.apply(x)) == 8.0
+        g = jax.grad(lambda v: Cube.apply(v))(x)
+        assert float(g) == 12.0

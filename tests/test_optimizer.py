@@ -1,0 +1,207 @@
+"""Optimizer + LR scheduler + training loop tests (reference analogue:
+test_adam_op.py, test_momentum_op.py, test_lr_scheduler.py,
+test_imperative_optimizer.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.nn.layer import (
+    buffer_state,
+    functional_call,
+    load_state,
+    trainable_state,
+)
+
+
+def quad_problem():
+    """min ||Wx - y||^2 over a fixed batch."""
+    paddle.seed(0)
+    net = nn.Linear(4, 4, bias_attr=False)
+    X = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+    W_true = np.random.RandomState(1).randn(4, 4).astype(np.float32)
+    Y = X @ W_true
+    return net, jnp.asarray(X), jnp.asarray(Y)
+
+
+def run_steps(net, opt, X, Y, n=80):
+    opt._ensure_state()
+    params = trainable_state(net)
+    state = opt._accumulators
+
+    @jax.jit
+    def step(params, state):
+        def loss_fn(p):
+            out, _ = functional_call(net, p, X)
+            return jnp.mean((out - Y) ** 2)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_p, new_s = opt.apply(params, grads, state)
+        return loss, new_p, new_s
+
+    loss = None
+    for _ in range(n):
+        loss, params, state = step(params, state)
+    load_state(net, params)
+    return float(loss)
+
+
+OPTIMIZERS = [
+    (lambda p: optimizer.SGD(0.1, parameters=p), 80),
+    (lambda p: optimizer.Momentum(0.05, momentum=0.9, parameters=p), 80),
+    (lambda p: optimizer.Adam(0.1, parameters=p), 80),
+    (lambda p: optimizer.AdamW(0.1, parameters=p, weight_decay=0.001), 80),
+    (lambda p: optimizer.Adamax(0.1, parameters=p), 80),
+    (lambda p: optimizer.Adagrad(0.3, parameters=p), 80),
+    (lambda p: optimizer.Adadelta(3.0, parameters=p), 500),  # slow starter
+    (lambda p: optimizer.RMSProp(0.05, parameters=p), 80),
+    (lambda p: optimizer.Lamb(0.5, parameters=p), 300),
+    (lambda p: optimizer.LarsMomentum(2.0, parameters=p), 300),
+]
+
+
+@pytest.mark.parametrize("make_opt,steps", OPTIMIZERS)
+def test_optimizer_converges(make_opt, steps):
+    net, X, Y = quad_problem()
+    initial = float(jnp.mean(
+        (functional_call(net, trainable_state(net), X)[0] - Y) ** 2))
+    final = run_steps(net, make_opt(net), X, Y, n=steps)
+    assert final < initial * 0.2, f"{final} vs {initial}"
+
+
+def test_adam_matches_manual():
+    """Single Adam step against a hand-computed update (reference:
+    test_adam_op.py numeric check)."""
+    net = nn.Linear(1, 1, bias_attr=False)
+    net.weight.set_value(np.asarray([[1.0]], np.float32))
+    opt = optimizer.Adam(learning_rate=0.1, beta1=0.9, beta2=0.999,
+                         epsilon=1e-8, parameters=net)
+    opt._ensure_state()
+    g = {"weight": jnp.asarray([[0.5]])}
+    params = trainable_state(net)
+    new_p, _ = opt.apply(params, g, opt._accumulators)
+    m = 0.1 * 0.5
+    v = 0.001 * 0.25
+    mhat = m / (1 - 0.9)
+    vhat = v / (1 - 0.999)
+    expect = 1.0 - 0.1 * mhat / (np.sqrt(vhat) + 1e-8)
+    np.testing.assert_allclose(float(new_p["weight"][0, 0]), expect,
+                               rtol=1e-5)
+
+
+def test_grad_clip_global_norm():
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    grads = {"a": jnp.asarray([3.0, 4.0])}  # norm 5
+    clipped = clip(grads)
+    np.testing.assert_allclose(np.asarray(clipped["a"]), [0.6, 0.8],
+                               rtol=1e-5)
+    grads = {"a": jnp.asarray([0.3, 0.4])}  # under the limit: untouched
+    clipped = clip(grads)
+    np.testing.assert_allclose(np.asarray(clipped["a"]), [0.3, 0.4],
+                               rtol=1e-6)
+
+
+def test_clip_by_value_and_norm():
+    v = nn.ClipGradByValue(0.5)({"g": jnp.asarray([-2.0, 0.2, 3.0])})
+    np.testing.assert_allclose(np.asarray(v["g"]), [-0.5, 0.2, 0.5])
+    n = nn.ClipGradByNorm(1.0)({"g": jnp.asarray([3.0, 4.0])})
+    np.testing.assert_allclose(np.asarray(n["g"]), [0.6, 0.8], rtol=1e-5)
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        sched = optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(sched.get_lr())
+            sched.step()
+        np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025],
+                                   rtol=1e-6)
+
+    def test_piecewise(self):
+        sched = optimizer.lr.PiecewiseDecay([2, 4], [1.0, 0.5, 0.1])
+        vals = [float(sched.lr_fn(s)) for s in [0, 1, 2, 3, 4, 5]]
+        np.testing.assert_allclose(vals, [1, 1, 0.5, 0.5, 0.1, 0.1])
+
+    def test_warmup_then_decay(self):
+        base = optimizer.lr.CosineAnnealingDecay(0.1, T_max=100)
+        sched = optimizer.lr.LinearWarmup(base, warmup_steps=10,
+                                          start_lr=0.0, end_lr=0.1)
+        assert float(sched.lr_fn(0)) == 0.0
+        np.testing.assert_allclose(float(sched.lr_fn(5)), 0.05, rtol=1e-5)
+        assert float(sched.lr_fn(10)) <= 0.1 + 1e-6
+
+    def test_noam(self):
+        sched = optimizer.lr.NoamDecay(d_model=512, warmup_steps=100)
+        peak_region = float(sched.lr_fn(100))
+        assert float(sched.lr_fn(10)) < peak_region
+        assert float(sched.lr_fn(10000)) < peak_region
+
+    def test_scheduler_in_optimizer(self):
+        net, X, Y = quad_problem()
+        sched = optimizer.lr.StepDecay(0.1, step_size=1000, gamma=0.5)
+        opt = optimizer.Adam(sched, parameters=net)
+        final = run_steps(net, opt, X, Y, n=60)
+        assert final < 1.0
+
+    def test_one_cycle(self):
+        sched = optimizer.lr.OneCycleLR(max_learning_rate=1.0,
+                                        total_steps=100)
+        lr_start = float(sched.lr_fn(0))
+        lr_peak = float(sched.lr_fn(30))
+        lr_end = float(sched.lr_fn(99))
+        assert lr_start < lr_peak and lr_end < lr_peak
+
+
+class TestAMP:
+    def test_autocast_bf16(self):
+        x = jnp.ones((4, 4), jnp.float32)
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            y = paddle.matmul(x, x)
+        assert y.dtype == jnp.bfloat16
+        y = paddle.matmul(x, x)
+        assert y.dtype == jnp.float32
+
+    def test_grad_scaler_dynamic(self):
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0,
+                                       incr_every_n_steps=1)
+        st = scaler.init_state()
+        grads = {"w": jnp.asarray([1.0, 2.0]) * 4.0}
+        unscaled, found_inf = scaler.unscale_and_check(grads, st)
+        assert not bool(found_inf)
+        np.testing.assert_allclose(np.asarray(unscaled["w"]), [1, 2])
+        st2 = scaler.update_state(st, found_inf)
+        assert float(st2.scale) == 8.0  # grew
+        bad = {"w": jnp.asarray([jnp.inf])}
+        _, found = scaler.unscale_and_check(bad, st2)
+        assert bool(found)
+        st3 = scaler.update_state(st2, found)
+        assert float(st3.scale) == 4.0  # shrank
+
+    def test_scaled_training_skips_on_inf(self):
+        net, X, Y = quad_problem()
+        opt = optimizer.SGD(0.1, parameters=net)
+        opt._ensure_state()
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+        params = trainable_state(net)
+        bad_grads = {"weight": jnp.full((4, 4), jnp.nan)}
+        new_p, _, _ = scaler.apply_step(opt, params, bad_grads,
+                                        opt._accumulators,
+                                        scaler.init_state())
+        np.testing.assert_array_equal(np.asarray(new_p["weight"]),
+                                      np.asarray(params["weight"]))
+
+
+class TestRecompute:
+    def test_recompute_matches(self):
+        from paddle_tpu.distributed.fleet import recompute
+
+        def f(x):
+            return jnp.sum(jnp.tanh(x) ** 2)
+
+        x = jnp.linspace(-1, 1, 8)
+        g1 = jax.grad(f)(x)
+        g2 = jax.grad(lambda v: recompute(f, v))(x)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-6)
