@@ -1,0 +1,108 @@
+"""Headline benchmark — GPT-345M causal-LM pretraining throughput.
+
+Runs the one compiled hybrid train step (models/gpt.py build_train_step) on
+whatever devices are visible (the driver gives one real TPU chip) and
+prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+vs_baseline is MFU / 0.35 — the north-star target from BASELINE.json
+("BERT-base pretraining >=35% MFU"); the reference publishes no absolute
+numbers (BASELINE.md), so the MFU ratio is the comparable metric.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+PEAK_FLOPS = {
+    # bf16 peak per chip
+    "TPU v4": 275e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v5e": 197e12,
+    "TPU v5 lite": 197e12,
+    "TPU v6e": 918e12,
+    "TPU v6 lite": 918e12,
+}
+
+
+def peak_flops(dev) -> float:
+    kind = getattr(dev, "device_kind", "cpu")
+    # longest prefix first: 'TPU v5 lite' must not match 'TPU v5'
+    for k in sorted(PEAK_FLOPS, key=len, reverse=True):
+        if kind.lower().startswith(k.lower()):
+            return PEAK_FLOPS[k]
+    if "tpu" in kind.lower():
+        return 197e12
+    return 2e12  # nominal CPU figure so local runs produce a number
+
+
+def model_flops_per_token(cfg, seq_len: int) -> float:
+    """6*P matmul flops/token (fwd+bwd) + attention term 12*L*d*s."""
+    d, L, V = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
+    ffn = cfg.ffn_hidden
+    p_block = L * (4 * d * d + 2 * d * ffn)        # qkv+out + 2 mlp mats
+    p_emb = V * d                                   # tied head matmul
+    return 6.0 * (p_block + p_emb) + 12.0 * L * d * seq_len
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.distributed import build_mesh
+    from paddle_tpu.models import gpt_345m, GPTForPretraining, \
+        build_train_step
+
+    n_dev = len(jax.devices())
+    on_tpu = jax.default_backend() == "tpu"
+    seq = 1024
+    if on_tpu:
+        cfg = gpt_345m()
+        batch = 8 * n_dev
+        steps, warmup = 20, 3
+    else:  # local smoke: tiny config so the bench is runnable anywhere
+        from paddle_tpu.models import gpt_tiny
+        cfg = gpt_tiny()
+        seq = 128
+        batch = 4 * n_dev
+        steps, warmup = 5, 1
+
+    mesh = build_mesh(dp=n_dev)
+    model = GPTForPretraining(cfg)
+    opt = pt.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                             grad_clip=pt.nn.ClipGradByGlobalNorm(1.0))
+    step, state = build_train_step(model, opt, mesh, num_microbatches=1,
+                                   remat=True)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)),
+                      jnp.int32)
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)),
+                         jnp.int32)
+    for _ in range(warmup):
+        state, loss = step(state, (ids, labels))
+    float(loss)  # host transfer — hard sync (block_until_ready is not
+    #              sufficient through the remoted-device tunnel)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = step(state, (ids, labels))
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    tokens_per_sec_chip = tokens_per_sec / n_dev
+    flops = model_flops_per_token(cfg, seq) * tokens_per_sec_chip
+    mfu = flops / peak_flops(jax.devices()[0])
+    print(json.dumps({
+        "metric": "gpt345m_pretrain_tokens_per_sec_per_chip"
+                  if on_tpu else "gpt_tiny_cpu_tokens_per_sec",
+        "value": round(tokens_per_sec_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.35, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

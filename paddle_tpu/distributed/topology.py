@@ -116,6 +116,10 @@ def get_mesh() -> Mesh:
     return _GLOBAL_MESH
 
 
+def get_mesh_or_none() -> Optional[Mesh]:
+    return _GLOBAL_MESH
+
+
 def set_mesh(mesh: Mesh):
     global _GLOBAL_MESH
     _GLOBAL_MESH = mesh

@@ -75,8 +75,12 @@ class Model:
     def train_batch(self, inputs, labels=None):
         net = self.network
         net.train()
-        self._optimizer._ensure_state()
         params = trainable_state(net)
+        # optimizer state must be keyed by the same structured names as the
+        # functional params pytree (p.name keys from a bare parameters list
+        # don't match — caught by /verify driving Model.fit)
+        if self._optimizer._accumulators is None:
+            self._optimizer._accumulators = self._optimizer.init_state(params)
         buffers = buffer_state(net)
         batch = list(inputs if isinstance(inputs, (list, tuple))
                      else [inputs])

@@ -99,12 +99,26 @@ def to_static(function=None, input_spec=None, build_strategy=None,
 
 
 def _specs_to_abstract(input_spec):
+    """InputSpec dims of None/-1 become jax.export symbolic dims so the
+    exported StableHLO stays shape-polymorphic (the reference's ProgramDesc
+    keeps -1 dims the same way)."""
+    from jax import export as jax_export
     out = []
-    for s in input_spec:
+    scope = jax_export.SymbolicScope()  # one scope for all args
+    for i, s in enumerate(input_spec):
         if isinstance(s, InputSpec):
-            out.append(jax.ShapeDtypeStruct(
-                tuple(d if d is not None and d != -1 else 1
-                      for d in s.shape), s.dtype))
+            if any(d is None or d == -1 for d in s.shape):
+                # dynamic axis-0 dims share one 'batch' symbol (inputs and
+                # labels almost always co-vary there); other dynamic dims
+                # get per-(arg,axis) symbols in the shared scope
+                dims = ",".join(
+                    ("batch" if j == 0 else f"dyn{i}_{j}")
+                    if d is None or d == -1 else str(d)
+                    for j, d in enumerate(s.shape))
+                shape = jax_export.symbolic_shape(f"({dims})", scope=scope)
+            else:
+                shape = tuple(s.shape)
+            out.append(jax.ShapeDtypeStruct(shape, s.dtype))
         else:
             out.append(jax.ShapeDtypeStruct(jnp.shape(s),
                                             jnp.asarray(s).dtype))

@@ -33,7 +33,8 @@ class Parameter:
     `trainable` flag.
     """
 
-    __slots__ = ("value", "name", "stop_gradient", "_is_buffer", "optimize_attr")
+    __slots__ = ("value", "name", "stop_gradient", "_is_buffer",
+                 "optimize_attr", "sharding_spec")
 
     def __init__(self, value, name: str = "", stop_gradient: bool = False,
                  is_buffer: bool = False):
@@ -42,6 +43,9 @@ class Parameter:
         self.stop_gradient = stop_gradient
         self._is_buffer = is_buffer
         self.optimize_attr = {"learning_rate": 1.0}
+        # PartitionSpec for hybrid-parallel training (set by mp/pp layers;
+        # consumed by the distributed train-step to build NamedShardings).
+        self.sharding_spec = None
 
     @property
     def trainable(self) -> bool:
