@@ -28,6 +28,11 @@ from .tensor_parallel import (  # noqa: F401
     shard_parameters,
 )
 from .sharding_optimizer import DygraphShardingOptimizer  # noqa: F401
+from .sequence_parallel import (  # noqa: F401
+    make_sp_attention,
+    ring_attention,
+    ulysses_attention,
+)
 from .stacked_pipeline import (  # noqa: F401
     gpipe,
     pipelined_apply,

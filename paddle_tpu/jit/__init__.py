@@ -101,20 +101,30 @@ def to_static(function=None, input_spec=None, build_strategy=None,
 def _specs_to_abstract(input_spec):
     """InputSpec dims of None/-1 become jax.export symbolic dims so the
     exported StableHLO stays shape-polymorphic (the reference's ProgramDesc
-    keeps -1 dims the same way)."""
+    keeps -1 dims the same way).
+
+    Symbol naming: dynamic axis-0 dims share one 'batch' symbol (inputs and
+    labels almost always co-vary there); other dynamic dims get
+    per-(arg,axis) symbols. For args whose leading dims are independent,
+    pass a string as the dim — e.g. InputSpec(["n", 4]) — to name the
+    symbol explicitly (equal names ⇒ tied, distinct ⇒ free)."""
     from jax import export as jax_export
     out = []
     scope = jax_export.SymbolicScope()  # one scope for all args
+
+    def dim_sym(i, j, d):
+        if isinstance(d, str):
+            return d
+        if d is None or d == -1:
+            return "batch" if j == 0 else f"dyn{i}_{j}"
+        return str(d)
+
     for i, s in enumerate(input_spec):
         if isinstance(s, InputSpec):
-            if any(d is None or d == -1 for d in s.shape):
-                # dynamic axis-0 dims share one 'batch' symbol (inputs and
-                # labels almost always co-vary there); other dynamic dims
-                # get per-(arg,axis) symbols in the shared scope
-                dims = ",".join(
-                    ("batch" if j == 0 else f"dyn{i}_{j}")
-                    if d is None or d == -1 else str(d)
-                    for j, d in enumerate(s.shape))
+            if any(isinstance(d, str) or d is None or d == -1
+                   for d in s.shape):
+                dims = ",".join(dim_sym(i, j, d)
+                                for j, d in enumerate(s.shape))
                 shape = jax_export.symbolic_shape(f"({dims})", scope=scope)
             else:
                 shape = tuple(s.shape)

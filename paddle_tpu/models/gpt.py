@@ -15,6 +15,7 @@ same architecture is built TPU-first:
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -248,8 +249,10 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
     (`fleet_base.py:1288` → StrategyCompiler → program rewriting).
 
     Returns (step_fn, state) where state = (outer, stacked_blocks,
-    opt_state) and step_fn(state, batch) -> (state, loss).
-    batch = (input_ids, labels) int32 [B, S].
+    opt_state) and step_fn(state, batch) -> (state, loss);
+    batch = (input_ids, labels) int32 [B, S]. When cfg.dropout > 0 the
+    signature is step_fn(state, batch, rng_key) — pass a fresh key per
+    step.
     """
     cfg = model.config
     axis = dict(zip(mesh.axis_names, mesh.devices.shape))
@@ -326,10 +329,22 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
 
     opt_state0 = optimizer.init_state(flatname_params)
 
-    def step(state, batch):
+    def step(state, batch, rng=None):
         outer_p, stacked_p, opt_state = state
-        loss, grads = jax.value_and_grad(loss_fn)((outer_p, stacked_p),
-                                                  batch)
+        if rng is None:
+            loss, grads = jax.value_and_grad(loss_fn)((outer_p, stacked_p),
+                                                      batch)
+        else:
+            # scope the traced key so Dropout draws fresh masks per step
+            # (an unscoped next_key() inside jit would bake one constant
+            # mask into the compiled program)
+            from ..framework.random import rng_guard
+
+            def lf(params, batch):
+                with rng_guard(rng):
+                    return loss_fn(params, batch)
+            loss, grads = jax.value_and_grad(lf)((outer_p, stacked_p),
+                                                 batch)
         g_outer, g_stacked = grads
         flat_p = dict(outer_p)
         flat_p.update({f"blocks.{n}": v for n, v in stacked_p.items()})
@@ -374,11 +389,18 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
                      is_leaf=lambda s: isinstance(s, P)))
     batch_sharding = (ns(P("data", None)), ns(P("data", None)))
 
-    step_jit = jax.jit(
-        step,
-        in_shardings=(state_shardings, batch_sharding),
-        out_shardings=(state_shardings, None),
-        donate_argnums=(0,) if donate else ())
+    if cfg.dropout > 0.0:
+        step_jit = jax.jit(
+            step,
+            in_shardings=(state_shardings, batch_sharding, None),
+            out_shardings=(state_shardings, None),
+            donate_argnums=(0,) if donate else ())
+    else:
+        step_jit = jax.jit(
+            functools.partial(step, rng=None),
+            in_shardings=(state_shardings, batch_sharding),
+            out_shardings=(state_shardings, None),
+            donate_argnums=(0,) if donate else ())
 
     # place initial state
     state0 = jax.device_put(

@@ -1,0 +1,42 @@
+"""Flash-attention Pallas kernel vs XLA attention (interpret mode on CPU)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.ops.flash_attention import flash_attention
+from paddle_tpu.nn.functional.attention import _xla_attention
+
+
+def _qkv(b=2, s=256, h=4, d=64, seed=0):
+    rs = np.random.RandomState(seed)
+    return [jnp.asarray(rs.randn(b, s, h, d) * 0.5, jnp.float32)
+            for _ in range(3)]
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_xla(causal):
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, causal=causal)
+    ref = _xla_attention(q, k, v, None, 0.0, causal, False, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=2e-5)
+
+
+def test_grads_match_xla():
+    q, k, v = _qkv(s=128)
+    g1 = jax.grad(lambda a, b, c: jnp.sum(
+        flash_attention(a, b, c, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda a, b, c: jnp.sum(
+        _xla_attention(a, b, c, None, 0.0, True, False, None) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=5e-5)
+
+
+def test_rejects_unaligned_seq():
+    q, k, v = _qkv(s=100)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v)
