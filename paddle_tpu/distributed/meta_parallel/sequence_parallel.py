@@ -127,8 +127,13 @@ def make_sp_attention(mesh, mode: str = "ring", causal: bool = False,
     spec = P(None, axis_name, None, None)
 
     inner = partial(fn, axis_name=axis_name, causal=causal)
+    # manualize ONLY the sequence axis — data/model axes stay under GSPMD
+    # (omitting axis_names would manualize every axis and silently
+    # replicate the batch across 'data')
     wrapped = jax.shard_map(
         lambda q, k, v: inner(q, k, v),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
-    return wrapped
+        axis_names={axis_name}, check_vma=False)
+    # partial-manual shard_map (axis_names ⊂ mesh axes) only resolves
+    # inside a jit trace; eager calls misread the unmentioned axes
+    return jax.jit(wrapped)

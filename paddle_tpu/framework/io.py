@@ -44,19 +44,46 @@ def _to_jax(obj: Any):
     return obj
 
 
-def save(obj: Any, path: str, protocol: int = 4):
-    """paddle.save equivalent."""
+_ENC_MAGIC = b"PTPUENC1"
+
+
+def _derive_key(password: bytes) -> bytes:
+    import hashlib
+    return hashlib.sha256(password).digest()[:16]
+
+
+def save(obj: Any, path: str, protocol: int = 4, password: bytes = None):
+    """paddle.save equivalent. `password` enables AES-128-CTR encrypted
+    save via the native cipher (reference: encrypted save,
+    `framework/io/crypto/aes_cipher.cc` + pybind `crypto.cc`)."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
     if hasattr(obj, "state_dict") and callable(obj.state_dict):
         obj = obj.state_dict()
+    payload = pickle.dumps(_to_numpy(obj), protocol=protocol)
+    if password is not None:
+        from ..core.native import aes_ctr_xcrypt
+        iv = os.urandom(16)
+        payload = _ENC_MAGIC + iv + aes_ctr_xcrypt(
+            _derive_key(password), iv, payload)
     with open(path, "wb") as f:
-        pickle.dump(_to_numpy(obj), f, protocol=protocol)
+        f.write(payload)
 
 
-def load(path: str, return_numpy: bool = False):
-    """paddle.load equivalent."""
+def load(path: str, return_numpy: bool = False, password: bytes = None):
+    """paddle.load equivalent (see `save` for `password`)."""
     with open(path, "rb") as f:
-        obj = pickle.load(f)
+        head = f.read(len(_ENC_MAGIC))
+        if head == _ENC_MAGIC:
+            if password is None:
+                raise ValueError(f"{path} is encrypted; pass password=")
+            from ..core.native import aes_ctr_xcrypt
+            iv = f.read(16)
+            payload = aes_ctr_xcrypt(_derive_key(password), iv, f.read())
+            obj = pickle.loads(payload)
+        else:
+            # unencrypted: stream (no whole-file bytes + arrays in memory)
+            f.seek(0)
+            obj = pickle.load(f)
     return obj if return_numpy else _to_jax(obj)

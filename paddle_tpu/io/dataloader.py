@@ -11,10 +11,7 @@ not on Python), and device prefetch double-buffers batches onto the TPU with
 """
 from __future__ import annotations
 
-import collections
-import queue
 import threading
-from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional
 
 import jax
@@ -90,28 +87,82 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in indices])
             return
-        # threaded fetch: overlap batch assembly with device compute
-        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
-            pending = collections.deque()
-            depth = self.num_workers * self.prefetch_factor
+        # worker threads + native blocking queue: the reference's
+        # DataLoader worker model (`dataloader_iter.py:317` workers feeding
+        # `lod_tensor_blocking_queue`); synchronization lives in C++
+        # (csrc BlockingQueue), falling back to queue.Queue without it
+        from ..core.native import make_queue
+        depth = max(2, self.num_workers * self.prefetch_factor)
+        out_q = make_queue(depth)
+        work = list(self.batch_sampler)
+        state = {"claim": 0, "served": 0, "stop": False}
+        cond = threading.Condition()
+        errors = []
 
-            def fetch(indices):
-                return self.collate_fn([self.dataset[i] for i in indices])
-
-            it = iter(self.batch_sampler)
-            try:
-                for _ in range(depth):
-                    pending.append(pool.submit(fetch, next(it)))
-            except StopIteration:
-                it = None
-            while pending:
-                out = pending.popleft().result()
-                if it is not None:
+        def worker():
+            while True:
+                with cond:
+                    # claim the next batch index, but stay inside the
+                    # prefetch window so in-flight batches stay bounded at
+                    # `depth` even when one worker is slow (backpressure
+                    # the bounded queue alone can't give once the consumer
+                    # buffers out-of-order arrivals)
+                    while (not state["stop"]
+                           and state["claim"] >= state["served"] + depth):
+                        cond.wait(timeout=0.1)
+                    if state["stop"] or state["claim"] >= len(work):
+                        return
+                    i = state["claim"]
+                    state["claim"] = i + 1
+                try:
+                    batch = self.collate_fn(
+                        [self.dataset[j] for j in work[i]])
+                except Exception as e:  # surface to consumer
+                    errors.append(e)
+                    out_q.close()
+                    return
+                while True:
                     try:
-                        pending.append(pool.submit(fetch, next(it)))
-                    except StopIteration:
-                        it = None
-                yield out
+                        if out_q.push((i, batch), timeout_ms=100):
+                            break
+                    except RuntimeError:
+                        return  # closed (consumer bailed)
+                    if state["stop"]:
+                        return
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        try:
+            reorder = {}
+            nxt = 0
+            while nxt < len(work):
+                if nxt in reorder:
+                    yield reorder.pop(nxt)
+                    nxt += 1
+                    with cond:
+                        state["served"] = nxt
+                        cond.notify_all()
+                    continue
+                got = out_q.pop(timeout_ms=100)
+                if got is out_q.closed_sentinel:
+                    break
+                if got is None:
+                    if errors:
+                        break
+                    continue
+                seq, batch = got
+                reorder[seq] = batch
+            if errors:
+                raise errors[0]
+        finally:
+            with cond:
+                state["stop"] = True
+                cond.notify_all()
+            out_q.close()
+            for t in threads:
+                t.join(timeout=5)
 
     def __iter__(self):
         if not self.use_buffer_reader:

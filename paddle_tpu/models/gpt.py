@@ -183,10 +183,14 @@ class GPTForPretraining(Layer):
         return self.gpt.config
 
     def logits(self, hidden):
-        # tied head: [b,s,d] @ [V,d]^T — vocab dim sharded over 'model'
-        w = self.gpt.embeddings.word_embeddings.weight
-        logits = jnp.einsum("bsd,vd->bsv", hidden.astype(jnp.float32),
-                            jnp.asarray(w).astype(jnp.float32))
+        # tied head: [b,s,d] @ [V,d]^T — vocab dim sharded over 'model'.
+        # bf16 operands on the MXU, fp32 accumulation (fp32 operands would
+        # run the biggest matmul in the model at 1/4 MXU rate)
+        cdt = self.config.dtype
+        w = jnp.asarray(self.gpt.embeddings.word_embeddings.weight)
+        logits = jnp.einsum("bsd,vd->bsv", hidden.astype(cdt),
+                            w.astype(cdt),
+                            preferred_element_type=jnp.float32)
         return _constrain(logits, "data", None, "model")
 
     def forward(self, input_ids, labels=None, loss_mask=None,

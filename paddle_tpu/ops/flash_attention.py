@@ -25,7 +25,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 BLOCK = 128
-LANE = 128           # broadcast width for row statistics (lse)
+# Row statistics (lse/delta) ride an 8-lane broadcast: TPU block layouts
+# need the last two dims (sublane, lane) to divide (8, 128) or equal the
+# array dims — a trailing dim of 8 equals itself, keeping the stat arrays
+# at 8x logical size instead of 128x.
+LANE = 8
 NEG_INF = -1e30
 
 
@@ -101,8 +105,8 @@ def _fwd(q3, k3, v3, causal, scale):
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
                    jax.ShapeDtypeStruct((bh, s, LANE), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((BLOCK, d), jnp.float32),
-                        pltpu.VMEM((BLOCK, LANE), jnp.float32),
-                        pltpu.VMEM((BLOCK, LANE), jnp.float32)],
+                        pltpu.VMEM((BLOCK, 128), jnp.float32),
+                        pltpu.VMEM((BLOCK, 128), jnp.float32)],
         interpret=_interpret(),
         **_params(),
     )(q3, k3, v3)

@@ -1,0 +1,170 @@
+"""Native runtime (csrc/ptpu_runtime.cc via ctypes) tests."""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import native
+
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native lib unavailable")
+
+
+class TestArena:
+    def test_alloc_free_reuse(self):
+        a = native.Arena(chunk_size=1 << 20)
+        b1 = a.buffer(1000)
+        assert b1.shape == (1000,)
+        b1[:] = 7
+        assert a.in_use >= 1000
+        a.release(b1)
+        assert a.in_use == 0
+        # best-fit reuse: second alloc of same size returns pooled memory
+        b2 = a.buffer(1000)
+        assert a.reserved == 1 << 20  # no growth
+        a.release(b2)
+
+    def test_grows_beyond_chunk(self):
+        a = native.Arena(chunk_size=4096)
+        big = a.buffer(1 << 20)
+        assert a.reserved >= 1 << 20
+        a.release(big)
+
+    def test_coalescing(self):
+        a = native.Arena(chunk_size=1 << 20)
+        bufs = [a.buffer(100_000) for _ in range(5)]
+        for b in bufs:
+            a.release(b)
+        # all coalesced back: a full-chunk alloc must not grow the arena
+        big = a.buffer(900_000)
+        assert a.reserved == 1 << 20
+        a.release(big)
+
+
+class TestQueue:
+    def test_fifo_and_capacity(self):
+        q = native.NativeQueue(2)
+        assert q.push("a") and q.push("b")
+        assert not q.push("c", timeout_ms=50)  # full → timeout
+        assert q.pop() == "a"
+        assert q.push("c")
+        assert q.pop() == "b" and q.pop() == "c"
+
+    def test_threaded_producer_consumer(self):
+        q = native.NativeQueue(4)
+        n = 200
+
+        def produce():
+            for i in range(n):
+                q.push(i)
+            q.close()
+
+        t = threading.Thread(target=produce)
+        t.start()
+        got = []
+        while True:
+            v = q.pop()
+            if v is q.closed_sentinel:
+                break
+            got.append(v)
+        t.join()
+        assert got == list(range(n))
+
+    def test_close_wakes_popper(self):
+        q = native.NativeQueue(1)
+        res = {}
+
+        def popper():
+            res["v"] = q.pop()
+
+        t = threading.Thread(target=popper)
+        t.start()
+        time.sleep(0.05)
+        q.close()
+        t.join(timeout=2)
+        assert not t.is_alive()
+        assert res["v"] is q.closed_sentinel
+
+
+class TestProfiler:
+    def test_record_and_dump(self, tmp_path):
+        import paddle_tpu.profiler as prof
+        prof.reset()
+        prof.start_profiler()
+        with prof.RecordEvent("step"):
+            with prof.RecordEvent("forward"):
+                time.sleep(0.001)
+        assert prof.event_count() == 2
+        out = str(tmp_path / "trace.json")
+        prof.stop_profiler(profile_path=out)
+        import json
+        with open(out) as f:
+            trace = json.load(f)
+        names = {e["name"] for e in trace["traceEvents"]}
+        assert names == {"step", "forward"}
+        assert all(e["dur"] >= 0 for e in trace["traceEvents"])
+        prof.reset()
+
+
+class TestStats:
+    def test_counter(self):
+        l = native.lib()
+        l.ptpu_stat_reset(b"test_counter")
+        l.ptpu_stat_add(b"test_counter", 5)
+        l.ptpu_stat_add(b"test_counter", 7)
+        assert l.ptpu_stat_get(b"test_counter") == 12
+        l.ptpu_stat_reset(b"test_counter")
+
+
+class TestCrypto:
+    def test_roundtrip(self):
+        key, iv = b"0123456789abcdef", b"fedcba9876543210"
+        msg = os.urandom(1000) + b"tail"
+        enc = native.aes_ctr_xcrypt(key, iv, msg)
+        assert enc != msg
+        dec = native.aes_ctr_xcrypt(key, iv, enc)
+        assert dec == msg
+
+    def test_aes128_known_answer(self):
+        # FIPS-197 appendix B: AES-128 single block
+        import ctypes
+        key = bytes.fromhex("2b7e151628aed2a6abf7158809cf4f3c")
+        # CTR with iv=X encrypts the counter; xor with zeros reveals E(X)
+        iv = bytes.fromhex("3243f6a8885a308d313198a2e0370734")
+        out = native.aes_ctr_xcrypt(key, iv, b"\x00" * 16)
+        assert out.hex() == "3925841d02dc09fbdc118597196a0b32"
+
+    def test_encrypted_save_load(self, tmp_path):
+        import paddle_tpu as pt
+        import jax.numpy as jnp
+        obj = {"w": jnp.arange(10, dtype=jnp.float32)}
+        p = str(tmp_path / "enc.pdparams")
+        pt.save(obj, p, password=b"secret")
+        with pytest.raises(ValueError):
+            pt.load(p)
+        back = pt.load(p, password=b"secret")
+        np.testing.assert_array_equal(np.asarray(back["w"]),
+                                      np.arange(10, dtype=np.float32))
+
+
+class TestDataLoaderWorkers:
+    def test_multiworker_order_and_content(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class Sq(Dataset):
+            def __len__(self):
+                return 64
+
+            def __getitem__(self, i):
+                return np.asarray([i * i], dtype=np.int64)
+
+        dl = DataLoader(Sq(), batch_size=8, num_workers=3, shuffle=False,
+                        use_buffer_reader=False)
+        batches = list(dl)
+        assert len(batches) == 8
+        flat = np.concatenate([np.asarray(b).reshape(-1) for b in batches])
+        np.testing.assert_array_equal(flat,
+                                      np.arange(64, dtype=np.int64) ** 2)
