@@ -35,4 +35,6 @@ from .topology import (  # noqa: F401
     set_mesh,
 )
 from .parallel import DataParallel  # noqa: F401
+from .spawn import spawn  # noqa: F401
 from . import fleet  # noqa: F401
+from . import meta_parallel  # noqa: F401

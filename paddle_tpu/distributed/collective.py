@@ -37,7 +37,13 @@ class Group:
     def __init__(self, axis_name: str, ranks=None):
         self.axis_name = axis_name
         self.ranks = ranks
-        self.nranks = len(ranks) if ranks else get_world_size()
+
+    @property
+    def nranks(self):
+        # lazy: get_world_size() touches jax.process_count(), which
+        # initializes a backend — must NOT happen at import time (a
+        # module-level Group would dial the TPU tunnel on every import)
+        return len(self.ranks) if self.ranks else get_world_size()
 
     def __repr__(self):
         return f"Group(axis={self.axis_name!r})"

@@ -1,0 +1,118 @@
+"""`paddle.inference` equivalent — deployment API.
+
+Reference: `AnalysisPredictor`/`AnalysisConfig`
+(`inference/api/analysis_predictor.cc:381`, `paddle_inference_api.h`) —
+a C++ engine that loads a ProgramDesc, runs IR optimization passes, and
+executes with zero-copy tensors. TPU-native: the saved artifact is
+shape-polymorphic StableHLO (`paddle_tpu.jit.save`); "optimization passes"
+are XLA's; `Predictor.run` feeds/fetches jax arrays (zero-copy on device).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class Config:
+    """Reference: AnalysisConfig. Model path + toggles (most reference
+    knobs — TensorRT, MKLDNN, IR passes — have no TPU meaning and are
+    accepted as no-ops for script parity)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self._path = prog_file
+        self._device = None
+        self._memory_pool_mb = 0
+
+    def set_model(self, prog_file, params_file=None):
+        if prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self._path = prog_file
+
+    def model_dir(self):
+        return self._path
+
+    # accepted-for-parity toggles
+    def enable_use_gpu(self, memory_pool_mb=100, device_id=0):
+        self._memory_pool_mb = memory_pool_mb
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_memory_optim(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def enable_mkldnn(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class Tensor:
+    """Zero-copy-ish handle (reference: ZeroCopyTensor)."""
+
+    def __init__(self, name: str, owner: "Predictor", is_input: bool):
+        self.name = name
+        self._owner = owner
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._owner._feeds[self.name] = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._owner._outputs[self.name])
+
+    def shape(self):
+        src = self._owner._feeds if self._is_input else self._owner._outputs
+        return list(np.asarray(src[self.name]).shape)
+
+
+class Predictor:
+    """Reference: AnalysisPredictor (`analysis_predictor.cc:381` Run,
+    `:889` ZeroCopyRun). Wraps the jit-saved StableHLO artifact."""
+
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+        if config.model_dir() is None:
+            raise ValueError("Config has no model path")
+        self._layer = jit_load(config.model_dir())
+        self._feeds = {}
+        self._outputs = {}
+
+    def get_input_names(self) -> List[str]:
+        return self._layer.input_names() or ["x"]
+
+    def get_output_names(self) -> List[str]:
+        return list(self._outputs.keys()) or ["out"]
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return Tensor(name, self, True)
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return Tensor(name, self, False)
+
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+        """Positional-run (new API) or handle-based (copy_from_cpu then
+        run())."""
+        if inputs is None:
+            names = self.get_input_names()
+            inputs = [self._feeds[n] for n in names]
+        outs = self._layer(*[np.asarray(a) for a in inputs])
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        self._outputs = {f"out{i}" if i else "out": o
+                         for i, o in enumerate(outs)}
+        return [np.asarray(o) for o in outs]
+
+
+def create_predictor(config: Config) -> Predictor:
+    """Reference: CreatePaddlePredictor (`analysis_predictor.cc:1183`)."""
+    return Predictor(config)

@@ -142,6 +142,8 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
     `<path>.pdmodel` (serialized StableHLO of the eval forward) — same split
     as the reference's params file + ProgramDesc model file.
     """
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec to trace the model")
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -149,10 +151,10 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
     layer.eval()
     params = {n: p.value for n, p in layer.named_parameters()}
     buffers = buffer_state(layer)
-    _save_state({"params": params, "buffers": buffers}, path + ".pdiparams")
-
-    if input_spec is None:
-        raise ValueError("jit.save requires input_spec to trace the model")
+    _save_state({"params": params, "buffers": buffers,
+                 "input_names": [getattr(s, "name", None) or f"x{i}"
+                                 for i, s in enumerate(input_spec)]},
+                path + ".pdiparams")
     abstract = _specs_to_abstract(input_spec)
 
     def fwd(params, buffers, *args):
@@ -176,13 +178,17 @@ class TranslatedLayer:
     """Loaded inference artifact (reference: TranslatedLayer running the
     captured program via a run_program op — here: deserialized StableHLO)."""
 
-    def __init__(self, exported, params, buffers):
+    def __init__(self, exported, params, buffers, input_names=None):
         self._exported = exported
         self._params = params
         self._buffers = buffers
+        self._input_names = list(input_names or [])
 
     def __call__(self, *args):
         return self._exported.call(self._params, self._buffers, *args)
+
+    def input_names(self):
+        return list(self._input_names)
 
     def eval(self):
         return self
@@ -194,4 +200,5 @@ def load(path: str):
     state = _load_state(path + ".pdiparams")
     with open(path + ".pdmodel", "rb") as f:
         exported = jax_export.deserialize(f.read())
-    return TranslatedLayer(exported, state["params"], state["buffers"])
+    return TranslatedLayer(exported, state["params"], state["buffers"],
+                           state.get("input_names"))

@@ -7,6 +7,7 @@ the user-facing pieces that still mean something on TPU: `InputSpec`,
 inference save/load, and a thin `Executor` shim for script parity.
 """
 from .input_spec import InputSpec  # noqa: F401
+from . import nn  # noqa: F401
 
 
 def load_inference_model(path_prefix, executor=None):

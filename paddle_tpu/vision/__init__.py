@@ -1,2 +1,4 @@
 """`paddle.vision` equivalent."""
+from . import datasets  # noqa: F401
 from . import models  # noqa: F401
+from . import transforms  # noqa: F401

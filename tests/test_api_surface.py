@@ -1,0 +1,187 @@
+"""API-surface tests: inference predictor, vision zoo/transforms/datasets,
+text datasets, distribution, static.nn control flow, utils."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+
+
+class TestInference:
+    def test_predictor_roundtrip(self, tmp_path):
+        from paddle_tpu.inference import Config, create_predictor
+        from paddle_tpu.static import InputSpec
+        pt.seed(0)
+        net = pt.nn.Linear(8, 3)
+        path = str(tmp_path / "model")
+        pt.jit.save(net, path, input_spec=[InputSpec([None, 8], "float32",
+                                                     name="x")])
+        cfg = Config(path + ".pdmodel")
+        pred = create_predictor(cfg)
+        x = np.random.RandomState(0).randn(4, 8).astype("float32")
+        (out,) = pred.run([x])
+        np.testing.assert_allclose(out, np.asarray(net(jnp.asarray(x))),
+                                   rtol=1e-5)
+        # handle API
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        h.copy_from_cpu(x[:2])
+        pred.run()
+        out2 = pred.get_output_handle("out").copy_to_cpu()
+        np.testing.assert_allclose(
+            out2, np.asarray(net(jnp.asarray(x[:2]))), rtol=1e-5)
+
+
+class TestVision:
+    def test_transforms_pipeline(self):
+        from paddle_tpu.vision import transforms as T
+        tr = T.Compose([T.Resize(36), T.CenterCrop(32),
+                        T.RandomHorizontalFlip(0.0), T.ToTensor(),
+                        T.Normalize(mean=[0.5] * 3, std=[0.5] * 3)])
+        img = np.random.RandomState(0).randint(0, 256, (48, 64, 3),
+                                               dtype=np.uint8)
+        out = tr(img)
+        assert out.shape == (3, 32, 32)
+        assert out.dtype == np.float32
+        assert -1.0 <= out.min() and out.max() <= 1.0
+
+    def test_datasets(self):
+        from paddle_tpu.vision.datasets import MNIST, Cifar10
+        ds = MNIST(mode="test")
+        img, label = ds[0]
+        assert img.shape == (28, 28) and 0 <= int(label) < 10
+        c = Cifar10(mode="test")
+        img, label = c[0]
+        assert img.shape == (32, 32, 3)
+
+    def test_dataset_folder(self, tmp_path):
+        from paddle_tpu.vision.datasets import DatasetFolder
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(3):
+                np.save(str(d / f"{i}.npy"),
+                        np.zeros((4, 4, 3), np.float32))
+        ds = DatasetFolder(str(tmp_path))
+        assert len(ds) == 6
+        img, target = ds[0]
+        assert img.shape == (4, 4, 3) and target == 0
+        assert ds.classes == ["cat", "dog"]
+
+    def test_model_zoo_forward(self):
+        from paddle_tpu.vision.models import mobilenet_v2
+        m = mobilenet_v2(num_classes=7)
+        m.eval()
+        out = m(jnp.ones((1, 3, 32, 32)))
+        assert out.shape == (1, 7)
+
+
+class TestText:
+    def test_imdb(self):
+        from paddle_tpu.text import Imdb
+        ds = Imdb(mode="train")
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and int(label) in (0, 1)
+
+    def test_imikolov_window(self):
+        from paddle_tpu.text import Imikolov
+        ds = Imikolov(window_size=5)
+        rec = ds[0]
+        assert len(rec) == 5
+
+    def test_uci_housing(self):
+        from paddle_tpu.text import UCIHousing
+        ds = UCIHousing(mode="train")
+        x, y = ds[0]
+        assert x.shape == (13,) and y.shape == (1,)
+
+
+class TestDistribution:
+    def test_normal(self):
+        from paddle_tpu.distribution import Normal
+        pt.seed(0)
+        d = Normal(0.0, 1.0)
+        s = d.sample((10000,))
+        assert abs(float(jnp.mean(s))) < 0.05
+        lp = d.log_prob(jnp.asarray(0.0))
+        np.testing.assert_allclose(float(lp), -0.9189385, rtol=1e-5)
+        kl = d.kl_divergence(Normal(0.0, 1.0))
+        np.testing.assert_allclose(float(kl), 0.0, atol=1e-6)
+
+    def test_categorical(self):
+        from paddle_tpu.distribution import Categorical
+        pt.seed(0)
+        d = Categorical(jnp.log(jnp.asarray([0.7, 0.2, 0.1])))
+        s = d.sample((5000,))
+        frac = float(jnp.mean((s == 0).astype(jnp.float32)))
+        assert 0.65 < frac < 0.75
+        np.testing.assert_allclose(float(d.entropy()), 0.8018186, rtol=1e-4)
+
+    def test_uniform_bernoulli(self):
+        from paddle_tpu.distribution import Bernoulli, Uniform
+        pt.seed(1)
+        u = Uniform(2.0, 4.0)
+        s = u.sample((1000,))
+        assert float(s.min()) >= 2.0 and float(s.max()) < 4.0
+        b = Bernoulli(probs=0.3)
+        assert abs(float(b.sample((8000,)).mean()) - 0.3) < 0.03
+
+
+class TestStaticNN:
+    def test_cond_eager_and_traced(self):
+        from paddle_tpu.static.nn import cond
+        assert float(cond(True, lambda: jnp.asarray(1.0),
+                          lambda: jnp.asarray(2.0))) == 1.0
+
+        @jax.jit
+        def f(x):
+            return cond(x > 0, lambda: x * 2, lambda: x - 1)
+
+        assert float(f(jnp.asarray(3.0))) == 6.0
+        assert float(f(jnp.asarray(-3.0))) == -4.0
+
+    def test_while_loop(self):
+        from paddle_tpu.static.nn import while_loop
+        # eager
+        out = while_loop(lambda i, s: i < 5,
+                         lambda i, s: [i + 1, s + i], [0, 0])
+        assert out == [5, 10]
+
+        # traced
+        @jax.jit
+        def f(n):
+            return while_loop(lambda i, s: i < n,
+                              lambda i, s: [i + 1, s + i],
+                              [jnp.asarray(0), jnp.asarray(0)])[1]
+
+        assert int(f(jnp.asarray(5))) == 10
+
+    def test_switch_case(self):
+        from paddle_tpu.static.nn import switch_case
+        fns = {0: lambda: jnp.asarray(10.0), 1: lambda: jnp.asarray(20.0)}
+        assert float(switch_case(1, fns)) == 20.0
+
+        @jax.jit
+        def f(i):
+            return switch_case(i, [lambda: jnp.asarray(10.0),
+                                   lambda: jnp.asarray(20.0)])
+
+        assert float(f(jnp.asarray(0))) == 10.0
+
+
+class TestUtils:
+    def test_run_check(self, capsys):
+        assert pt.utils.run_check()
+
+    def test_deprecated_warns(self):
+        import warnings
+
+        @pt.utils.deprecated(update_to="new_fn", since="2.0")
+        def old_fn():
+            return 42
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert old_fn() == 42
+            assert any(issubclass(x.category, DeprecationWarning)
+                       for x in w)
