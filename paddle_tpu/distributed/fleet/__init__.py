@@ -13,3 +13,5 @@ from .fleet_base import (  # noqa: F401
 from ..topology import HybridCommunicateGroup  # noqa: F401
 from .recompute import recompute  # noqa: F401
 from ..random import get_rng_state_tracker  # noqa: F401
+from . import elastic  # noqa: F401
+from . import utils  # noqa: F401

@@ -77,28 +77,30 @@ class Fleet:
         annotation; PP gets the schedule-carrying wrapper."""
         assert self._is_initialized, "call fleet.init first"
         hcg = self._hcg
-        if hcg.get_pipe_parallel_world_size() > 1:
-            from ..pipeline_parallel import PipelineParallel
-            from ..pp_layers import PipelineLayer
-            if isinstance(model, PipelineLayer):
-                return PipelineParallel(model, hcg, self._strategy)
-        if hcg.get_data_parallel_world_size() > 1 or \
-                hcg.get_model_parallel_world_size() > 1:
+        from ..meta_parallel import (PipelineLayer, PipelineParallel,
+                                     ShardingParallel, TensorParallel)
+        if hcg.get_pipe_parallel_world_size() > 1 and \
+                isinstance(model, PipelineLayer):
+            return PipelineParallel(model, hcg, self._strategy)
+        if hcg.get_model_parallel_world_size() > 1:
+            return TensorParallel(model, hcg, self._strategy)
+        if hcg.get_sharding_parallel_world_size() > 1:
+            return ShardingParallel(model, hcg, self._strategy)
+        if hcg.get_data_parallel_world_size() > 1:
             from ..parallel import DataParallel
             return DataParallel(model)
         return model
 
     def distributed_optimizer(self, optimizer, strategy=None):
-        """Reference returns the same optimizer decorated with the strategy;
-        sharding/DP math lives in the sharded step (see
-        distributed/sharding.py)."""
+        """Reference returns the same optimizer decorated with the
+        strategy; ZeRO state placement comes from the sharding wrapper."""
         if strategy is not None:
             self._strategy = strategy
         optimizer._fleet_strategy = self._strategy
         hcg = self._hcg
         if hcg is not None and hcg.get_sharding_parallel_world_size() > 1:
-            from ..sharding import ShardingOptimizer
-            return ShardingOptimizer(optimizer, hcg)
+            from ..meta_parallel import DygraphShardingOptimizer
+            return DygraphShardingOptimizer(hcg=hcg, inner_opt=optimizer)
         return optimizer
 
     # hooks for API parity

@@ -130,33 +130,47 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
             callbacks=None):
+        from .callbacks import EarlyStopping, config_callbacks
         loader = train_data if isinstance(train_data, DataLoader) else \
             DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
                        drop_last=drop_last, num_workers=num_workers)
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                log_freq=log_freq, verbose=verbose,
+                                save_freq=save_freq, save_dir=save_dir,
+                                metrics=[m.name() for m in self._metrics])
+        cbks.on_train_begin()
+        history = []
         for epoch in range(epochs):
             for m in self._metrics:
                 m.reset()
-            t0 = time.time()
+            cbks.on_epoch_begin(epoch)
             losses = []
             for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
                 batch = list(batch)
                 loss, metrics = self.train_batch(batch[:-1], batch[-1])
                 losses.append(loss)
-                if verbose and step % log_freq == 0:
-                    msg = f"Epoch {epoch + 1}/{epochs} step {step} " \
-                          f"loss={loss:.4f}"
-                    for k, v in metrics.items():
-                        msg += f" {k}={v:.4f}" if isinstance(v, float) else \
-                            f" {k}={v}"
-                    print(msg, flush=True)
-            if verbose:
-                print(f"Epoch {epoch + 1} done in {time.time() - t0:.1f}s "
-                      f"avg_loss={np.mean(losses):.4f}", flush=True)
+                logs = {"loss": loss, **metrics}
+                cbks.on_train_batch_end(step, logs)
+            epoch_logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+            cbks.on_epoch_end(epoch, epoch_logs)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size,
-                              verbose=verbose)
-            if save_dir is not None and (epoch + 1) % save_freq == 0:
-                self.save(f"{save_dir}/epoch_{epoch}")
+                res = self.evaluate(eval_data, batch_size=batch_size,
+                                    verbose=verbose)
+                cbks.on_eval_end(res)
+                # eval keys prefixed (reference hapi: eval_loss/eval_*) so
+                # the train loss in history is never clobbered
+                for k, v in res.items():
+                    if isinstance(v, (list, tuple)) and len(v) == 1:
+                        v = v[0]
+                    epoch_logs[f"eval_{k}"] = v
+            history.append(epoch_logs)
+            if any(getattr(c, "stopped", False)
+                   for c in cbks.callbacks
+                   if isinstance(c, EarlyStopping)):
+                break
+        cbks.on_train_end()
+        return history
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None):

@@ -1,0 +1,149 @@
+"""hapi callbacks + incubate optimizer tests."""
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.io import TensorDataset
+
+
+def _toy_model(lr=0.05):
+    pt.seed(0)
+    net = pt.nn.Linear(8, 1)
+    model = pt.Model(net)
+    model.prepare(pt.optimizer.Adam(learning_rate=lr,
+                                    parameters=net.parameters()),
+                  pt.nn.MSELoss())
+    return model
+
+
+def _toy_data(n=64):
+    rs = np.random.RandomState(0)
+    X = rs.randn(n, 8).astype("float32")
+    y = (X @ rs.randn(8, 1)).astype("float32")
+    return TensorDataset([X, y])
+
+
+class TestCallbacks:
+    def test_fit_returns_history_and_fires_callbacks(self):
+        from paddle_tpu.hapi.callbacks import Callback
+        events = []
+
+        class Probe(Callback):
+            def on_train_begin(self, logs=None):
+                events.append("train_begin")
+
+            def on_epoch_end(self, epoch, logs=None):
+                events.append(("epoch_end", epoch, "loss" in (logs or {})))
+
+            def on_train_end(self, logs=None):
+                events.append("train_end")
+
+        model = _toy_model()
+        hist = model.fit(_toy_data(), epochs=2, batch_size=16, verbose=0,
+                         callbacks=[Probe()])
+        assert len(hist) == 2 and "loss" in hist[0]
+        assert events[0] == "train_begin" and events[-1] == "train_end"
+        assert ("epoch_end", 1, True) in events
+
+    def test_model_checkpoint(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import ModelCheckpoint
+        model = _toy_model()
+        model.fit(_toy_data(), epochs=2, batch_size=16, verbose=0,
+                  callbacks=[ModelCheckpoint(save_freq=1,
+                                             save_dir=str(tmp_path))])
+        assert os.path.exists(str(tmp_path / "0.pdparams"))
+        assert os.path.exists(str(tmp_path / "final.pdparams"))
+
+    def test_early_stopping(self):
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+        model = _toy_model(lr=0.0)  # frozen → eval loss never improves
+        es = EarlyStopping(monitor="loss", patience=0, mode="min")
+        hist = model.fit(_toy_data(), eval_data=_toy_data(), epochs=6,
+                         batch_size=16, verbose=0, callbacks=[es])
+        assert len(hist) < 6  # stopped early
+
+    def test_visualdl_jsonl(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import VisualDL
+        model = _toy_model()
+        model.fit(_toy_data(), epochs=1, batch_size=16, verbose=0,
+                  callbacks=[VisualDL(log_dir=str(tmp_path))])
+        import json
+        lines = open(str(tmp_path / "scalars.jsonl")).read().splitlines()
+        assert len(lines) == 4  # 64/16 batches
+        assert "loss" in json.loads(lines[0])
+
+
+class TestIncubateOptimizers:
+    def _grads(self, lin, x):
+        import jax
+        from paddle_tpu.nn.layer import functional_call, trainable_state
+
+        def loss(p):
+            out, _ = functional_call(lin, p, x)
+            return jnp.sum(out ** 2)
+
+        struct = jax.grad(loss)(trainable_state(lin))
+        name_of = {n: p.name or f"param_{i}"
+                   for i, (n, p) in enumerate(lin.named_parameters())}
+        return {name_of[n]: g for n, g in struct.items()}
+
+    def test_lookahead(self):
+        pt.seed(0)
+        lin = pt.nn.Linear(4, 4)
+        # small lr: big steps make quadratic-loss SGD oscillate and the
+        # slow weights legitimately stand still
+        inner = pt.optimizer.SGD(learning_rate=0.01,
+                                 parameters=lin.parameters())
+        opt = pt.incubate.LookAhead(inner, alpha=0.5, k=2)
+        x = jnp.ones((2, 4))
+        w0 = np.asarray(lin.weight)
+        for _ in range(4):
+            opt.step(self._grads(lin, x))
+        assert not np.allclose(w0, np.asarray(lin.weight))
+
+    def test_ema_apply_restore(self):
+        pt.seed(0)
+        lin = pt.nn.Linear(4, 2)
+        ema = pt.incubate.ExponentialMovingAverage(decay=0.5, layer=lin)
+        orig = np.asarray(lin.weight)
+        lin.weight.set_value(orig + 1.0)
+        ema.update()
+        with ema.apply():
+            applied = np.asarray(lin.weight)
+        restored = np.asarray(lin.weight)
+        np.testing.assert_allclose(restored, orig + 1.0)
+        # ema = 0.5*orig + 0.5*(orig+1) = orig + 0.5
+        np.testing.assert_allclose(applied, orig + 0.5, rtol=1e-6)
+
+    def test_gradient_merge(self):
+        pt.seed(0)
+        lin = pt.nn.Linear(4, 4)
+        inner = pt.optimizer.SGD(learning_rate=0.1,
+                                 parameters=lin.parameters())
+        opt = pt.incubate.GradientMergeOptimizer(inner, k_steps=3)
+        x = jnp.ones((2, 4))
+        w0 = np.asarray(lin.weight)
+        g = self._grads(lin, x)
+        opt.step(g)
+        opt.step(g)
+        np.testing.assert_allclose(w0, np.asarray(lin.weight))  # not yet
+        opt.step(g)
+        assert not np.allclose(w0, np.asarray(lin.weight))  # applied
+
+    def test_model_average(self):
+        pt.seed(0)
+        lin = pt.nn.Linear(4, 2)
+        inner = pt.optimizer.SGD(learning_rate=0.1,
+                                 parameters=lin.parameters())
+        opt = pt.incubate.ModelAverage(0.15, inner_optimizer=inner)
+        x = jnp.ones((2, 4))
+        for _ in range(3):
+            opt.step(self._grads(lin, x))
+        cur = np.asarray(lin.weight)
+        with opt.apply():
+            avg = np.asarray(lin.weight)
+        assert not np.allclose(cur, avg)
+        np.testing.assert_allclose(cur, np.asarray(lin.weight))
