@@ -2,15 +2,24 @@
 
 Runs the one compiled hybrid train step (models/gpt.py build_train_step) on
 whatever devices are visible (the driver gives one real TPU chip) and
-prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+prints ONE JSON line on stdout: {"metric", "value", "unit", "vs_baseline"}.
 
 vs_baseline is MFU / 0.35 — the north-star target from BASELINE.json
 ("BERT-base pretraining >=35% MFU"); the reference publishes no absolute
 numbers (BASELINE.md), so the MFU ratio is the comparable metric.
+
+Robustness contract (VERDICT round 1 item 1): backend init under the axon
+TPU tunnel can HANG or error. We therefore probe the backend in a
+subprocess with a hard timeout, and fall back to a CPU run with
+"degraded": true — a JSON line is ALWAYS emitted, even on unexpected
+errors (then with "error" set).
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -27,9 +36,10 @@ PEAK_FLOPS = {
     "TPU v6 lite": 918e12,
 }
 
+PROBE_TIMEOUT_S = int(os.environ.get("PTPU_BENCH_PROBE_TIMEOUT", "420"))
 
-def peak_flops(dev) -> float:
-    kind = getattr(dev, "device_kind", "cpu")
+
+def peak_flops(kind: str) -> float:
     # longest prefix first: 'TPU v5 lite' must not match 'TPU v5'
     for k in sorted(PEAK_FLOPS, key=len, reverse=True):
         if kind.lower().startswith(k.lower()):
@@ -48,7 +58,63 @@ def model_flops_per_token(cfg, seq_len: int) -> float:
     return 6.0 * (p_block + p_emb) + 12.0 * L * d * seq_len
 
 
-def main():
+def probe_backend(timeout: float = PROBE_TIMEOUT_S) -> bool:
+    """Probe the default jax backend in a SUBPROCESS (init may hang).
+
+    Returns True iff the ambient backend initializes within the timeout.
+    """
+    code = "import jax; jax.devices(); print('PROBE_OK')"
+    for attempt in range(2):
+        p = subprocess.Popen([sys.executable, "-c", code],
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True)
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            # SIGTERM + grace first: SIGKILL mid-TPU-handshake can wedge
+            # the axon tunnel for every later process
+            p.terminate()
+            try:
+                p.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.communicate()
+            print(f"bench: backend probe timed out ({timeout}s), "
+                  f"attempt {attempt + 1}", file=sys.stderr)
+            continue
+        if p.returncode == 0 and "PROBE_OK" in out:
+            return True
+        print(f"bench: backend probe rc={p.returncode} "
+              f"tail={err[-500:]!r}", file=sys.stderr)
+    return False
+
+
+def rerun_on_cpu(timeout: float = 900) -> dict:
+    """Re-exec this bench in a fresh subprocess pinned to CPU.
+
+    An in-process platform flip is a no-op once the jax backend cache is
+    populated, so the degraded fallback must be a new process.
+    """
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PTPU_BENCH_FORCED_CPU"] = "1"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(
+        flags + ["--xla_force_host_platform_device_count=1"])
+    r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                       env=env, capture_output=True, text=True,
+                       timeout=timeout)
+    for line in reversed(r.stdout.splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    raise RuntimeError(f"cpu rerun produced no JSON (rc={r.returncode}, "
+                       f"stderr tail {r.stderr[-300:]!r})")
+
+
+def run_bench(degraded: bool):
     import jax
     import jax.numpy as jnp
     import paddle_tpu as pt
@@ -63,7 +129,7 @@ def main():
         cfg = gpt_345m()
         batch = 8 * n_dev
         steps, warmup = 20, 3
-    else:  # local smoke: tiny config so the bench is runnable anywhere
+    else:  # local smoke / degraded: tiny config runnable anywhere
         from paddle_tpu.models import gpt_tiny
         cfg = gpt_tiny()
         seq = 128
@@ -94,14 +160,48 @@ def main():
     tokens_per_sec = batch * seq * steps / dt
     tokens_per_sec_chip = tokens_per_sec / n_dev
     flops = model_flops_per_token(cfg, seq) * tokens_per_sec_chip
-    mfu = flops / peak_flops(jax.devices()[0])
-    print(json.dumps({
+    mfu = flops / peak_flops(jax.devices()[0].device_kind)
+    out = {
         "metric": "gpt345m_pretrain_tokens_per_sec_per_chip"
                   if on_tpu else "gpt_tiny_cpu_tokens_per_sec",
         "value": round(tokens_per_sec_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.35, 4),
-    }))
+    }
+    if degraded:
+        out["degraded"] = True
+    return out
+
+
+def main():
+    out = None
+    try:
+        forced = os.environ.get("PTPU_BENCH_FORCED_CPU") == "1"
+        if forced:
+            # env JAX_PLATFORMS=cpu alone is NOT honored under the axon
+            # sitecustomize hook — the in-process config update is what
+            # actually routes to CPU (same recipe as tests/conftest.py)
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        if forced or probe_backend():
+            out = run_bench(degraded=forced)
+        else:
+            # ambient backend hangs or errors — degraded CPU subprocess
+            print("bench: backend unavailable; degraded CPU run",
+                  file=sys.stderr)
+            out = rerun_on_cpu()
+    except Exception as e:
+        print(f"bench: run failed ({type(e).__name__}: {e}); "
+              "retrying on CPU", file=sys.stderr)
+        try:
+            if forced:  # already the CPU child — don't recurse
+                raise
+            out = rerun_on_cpu()
+        except Exception as e2:
+            out = {"metric": "bench_error", "value": 0.0, "unit": "none",
+                   "vs_baseline": 0.0, "degraded": True,
+                   "error": f"{type(e2).__name__}: {e2}"[:300]}
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -72,6 +72,10 @@ class BestFitArena {
 
   void *Alloc(size_t n) {
     std::lock_guard<std::mutex> g(mu_);
+    // zero-size requests round up to one alignment unit: n==0 would erase
+    // a free block yet re-add the whole block at the same base, leaving
+    // the address simultaneously free and allocated
+    if (n == 0) n = 1;
     n = RoundUp(n);
     auto it = free_by_size_.lower_bound(n);
     if (it == free_by_size_.end()) {
@@ -319,6 +323,29 @@ class Profiler {
     events_.push_back(std::move(e));
   }
 
+  static std::string JsonEscape(const std::string &s) {
+    std::string out;
+    out.reserve(s.size() + 8);
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"': out += "\\\""; break;
+        case '\\': out += "\\\\"; break;
+        case '\n': out += "\\n"; break;
+        case '\r': out += "\\r"; break;
+        case '\t': out += "\\t"; break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+            out += buf;
+          } else {
+            out += static_cast<char>(c);
+          }
+      }
+    }
+    return out;
+  }
+
   int Dump(const char *path) {
     std::lock_guard<std::mutex> g(mu_);
     FILE *f = std::fopen(path, "w");
@@ -332,7 +359,7 @@ class Profiler {
       std::fprintf(f,
                    "%s{\"name\":\"%s\",\"ph\":\"X\",\"pid\":0,\"tid\":%llu,"
                    "\"ts\":%lld,\"dur\":%lld}",
-                   i ? "," : "", e.name.c_str(),
+                   i ? "," : "", JsonEscape(e.name).c_str(),
                    (unsigned long long)e.tid, (long long)e.ts_us,
                    (long long)e.dur_us);
     }

@@ -119,6 +119,7 @@ class ElasticManager:
         if not self.enable:
             return
         while not self.stopped:
+            self.register()  # refresh heartbeat — emulates etcd lease keepalive
             n = len(self.nodes())
             if n >= self.np:
                 return
@@ -129,6 +130,11 @@ class ElasticManager:
         if not self.enable:
             return ElasticStatus.HOLD if procs_alive() \
                 else ElasticStatus.COMPLETED
+        # re-put the node key with a fresh timestamp on every poll so a
+        # healthy job running past _TTL never loses its own membership
+        # entry (reference refreshes via the etcd lease keepalive thread,
+        # fleet/elastic.py:125-164)
+        self.register()
         if not procs_alive():
             return ElasticStatus.COMPLETED
         if len(self.nodes()) != self.np:

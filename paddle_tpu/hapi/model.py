@@ -42,7 +42,25 @@ class Model:
         if metrics is not None:
             self._metrics = metrics if isinstance(metrics, list) else \
                 [metrics]
+        if optimizer is not None:
+            self._rekey_optimizer()
         self._build_steps()
+
+    def _rekey_optimizer(self):
+        """Rekey the optimizer's param map to the network's structured
+        names (dot paths from named_parameters).
+
+        One canonical key scheme end to end: train_batch seeds optimizer
+        state by structured pytree names, so _ensure_state/state_dict/
+        set_state_dict must use the same keys or a save+load round trip
+        silently restores zero optimizer slots (ADVICE round 1)."""
+        from collections import OrderedDict
+        opt = self._optimizer
+        if opt._accumulators is not None or not getattr(opt, "_params", None):
+            return  # state already materialized under the old keys
+        by_id = {id(p): n for n, p in self.network.named_parameters()}
+        opt._params = OrderedDict(
+            (by_id.get(id(p), key), p) for key, p in opt._params.items())
 
     def _build_steps(self):
         net, loss_layer, opt = self.network, self._loss, self._optimizer

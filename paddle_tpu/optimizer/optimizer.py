@@ -196,11 +196,22 @@ class Optimizer:
         if "step" in state:
             self._accumulators["step"] = jnp.asarray(state["step"],
                                                      jnp.int32)
+        matched = 0
         for pname, slots in self._accumulators["slots"].items():
             for sname in list(slots.keys()):
                 key = f"{pname}/{sname}"
                 if key in state:
                     slots[sname] = jnp.asarray(state[key])
+                    matched += 1
+        n_slot_entries = sum(1 for k in state
+                             if k not in ("step", "LR_Scheduler"))
+        if n_slot_entries and not matched:
+            import warnings
+            warnings.warn(
+                "optimizer set_state_dict matched no slot keys — the "
+                "checkpoint was saved under a different param key scheme; "
+                "accumulators (e.g. Adam moments) remain reinitialized",
+                stacklevel=2)
 
     # --- subclass hooks ---
 

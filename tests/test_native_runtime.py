@@ -149,6 +149,21 @@ class TestCrypto:
         np.testing.assert_array_equal(np.asarray(back["w"]),
                                       np.arange(10, dtype=np.float32))
 
+    def test_encrypted_wrong_password_and_tamper_detected(self, tmp_path):
+        """Encrypt-then-MAC: wrong password / bit flips never reach
+        pickle (ADVICE round 1 — v1 fed garbage plaintext to pickle)."""
+        import paddle_tpu as pt
+        import jax.numpy as jnp
+        p = str(tmp_path / "enc2.pdparams")
+        pt.save({"w": jnp.ones((4,))}, p, password=b"secret")
+        with pytest.raises(ValueError, match="HMAC"):
+            pt.load(p, password=b"wrong")
+        raw = bytearray(open(p, "rb").read())
+        raw[40] ^= 0x01  # flip one ciphertext bit
+        open(p, "wb").write(bytes(raw))
+        with pytest.raises(ValueError, match="HMAC"):
+            pt.load(p, password=b"secret")
+
 
 class TestDataLoaderWorkers:
     def test_multiworker_order_and_content(self):
