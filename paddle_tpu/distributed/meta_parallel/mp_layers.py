@@ -74,7 +74,7 @@ class VocabParallelEmbedding(Layer):
 
     def forward(self, x):
         out = F.embedding(x, self.weight)
-        return _constrain(out, "data", None, None)
+        return _constrain(out, ("data", "sharding"), None, None)
 
     def extra_repr(self):
         return f"{self.num_embeddings}, {self.embedding_dim} [vocab-sharded]"
@@ -112,8 +112,8 @@ class ColumnParallelLinear(Layer):
             x.astype(self._compute_dtype)
         out = F.linear(x, w, b)
         if self.gather_output:
-            return _constrain(out, "data", None, None)
-        return _constrain(out, "data", None, "model")
+            return _constrain(out, ("data", "sharding"), None, None)
+        return _constrain(out, ("data", "sharding"), None, "model")
 
     def extra_repr(self):
         return (f"in={self.in_features}, out={self.out_features} "
@@ -152,12 +152,12 @@ class RowParallelLinear(Layer):
 
     def forward(self, x):
         if self.input_is_parallel:
-            x = _constrain(x, "data", None, "model")
+            x = _constrain(x, ("data", "sharding"), None, "model")
         w, b = _cast(self._compute_dtype, self.weight, self.bias)
         x = x if self._compute_dtype is None else \
             x.astype(self._compute_dtype)
         out = F.linear(x, w, None)
-        out = _constrain(out, "data", None, None)
+        out = _constrain(out, ("data", "sharding"), None, None)
         if b is not None:
             out = out + b
         return out
@@ -183,7 +183,7 @@ class ParallelCrossEntropy(Layer):
         self.ignore_index = ignore_index
 
     def forward(self, input, label):
-        logits = _constrain(input, "data", None, "model")
+        logits = _constrain(input, ("data", "sharding"), None, "model")
         logits = logits.astype(jnp.float32)
         m = jnp.max(logits, axis=-1, keepdims=True)
         lse = m[..., 0] + jnp.log(

@@ -139,7 +139,7 @@ class BertModel(Layer):
             # [b, s] padding mask -> [b, 1, 1, s] broadcastable boolean
             attention_mask = attention_mask[:, None, None, :].astype(bool)
         x = self.embeddings(input_ids, token_type_ids, position_ids)
-        x = _constrain(x, "data", None, None)
+        x = _constrain(x, ("data", "sharding"), None, None)
         for blk in self.encoder:
             x = blk(x, attn_mask=attention_mask)
         return x, self.pooler(x)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import warnings
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -31,7 +32,7 @@ from ..distributed.meta_parallel.mp_layers import (
     ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
     VocabParallelEmbedding, _constrain)
 from ..distributed.meta_parallel.stacked_pipeline import (
-    pipelined_apply, stack_stage_params)
+    one_f_one_b, pipelined_apply, stack_stage_params)
 
 
 @dataclasses.dataclass
@@ -147,7 +148,7 @@ class GPTModel(Layer):
 
     def forward(self, input_ids, position_ids=None):
         x = self.embeddings(input_ids, position_ids)
-        x = _constrain(x, "data", None, None)
+        x = _constrain(x, ("data", "sharding"), None, None)
         for blk in self.layers:
             x = blk(x)
         return self.ln_f(x)
@@ -191,7 +192,7 @@ class GPTForPretraining(Layer):
         logits = jnp.einsum("bsd,vd->bsv", hidden.astype(cdt),
                             w.astype(cdt),
                             preferred_element_type=jnp.float32)
-        return _constrain(logits, "data", None, "model")
+        return _constrain(logits, ("data", "sharding"), None, "model")
 
     def forward(self, input_ids, labels=None, loss_mask=None,
                 position_ids=None):
@@ -243,7 +244,7 @@ def _outer_specs(model: GPTForPretraining):
 
 def build_train_step(model: GPTForPretraining, optimizer, mesh,
                      num_microbatches: int = 1, remat: bool = True,
-                     donate: bool = True):
+                     donate: bool = True, pipeline_schedule: str = "gpipe"):
     """Build the one compiled hybrid-parallel training step.
 
     Parallelism comes entirely from the mesh axes: 'data' (DP — batch dim),
@@ -263,6 +264,11 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
     pp = axis.get("pipe", 1)
     assert cfg.num_layers % pp == 0, "num_layers must divide pipe axis"
     layers_per_stage = cfg.num_layers // pp
+    if pp > 1 and num_microbatches < pp:
+        warnings.warn(
+            f"num_microbatches={num_microbatches} < pipeline stages "
+            f"{pp}: the schedule needs at least one microbatch per stage; "
+            f"using {pp}", stacklevel=2)
 
     outer, block_list = _split_params(model)
     stacked = stack_stage_params(block_list)  # leaves [L, ...]
@@ -272,29 +278,32 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
         out, _ = functional_call(template, bparams, x)
         return out
 
+    def stage_blocks(stage_p, h):
+        """One pipeline stage = scan over its L/pp blocks (shared by the
+        gpipe and 1f1b schedules)."""
+        def body(carry, bp):
+            fn = jax.checkpoint(block_apply) if remat else block_apply
+            return fn(bp, carry), None
+        out, _ = jax.lax.scan(body, h, stage_p)
+        return out
+
+    def to_staged(stacked_p):
+        """Leaves [L, ...] -> [pp, L/pp, ...]."""
+        return jax.tree.map(
+            lambda a: a.reshape((pp, layers_per_stage) + a.shape[1:]),
+            stacked_p)
+
+    def embed_fwd(input_ids):
+        x = model.gpt.embeddings(input_ids)
+        return _constrain(x, ("data", "sharding"), None, None)
+
     def trunk(stacked_p, x):
         """Apply all L blocks: scan over layers (and pipeline over stages
         when pp > 1)."""
         if pp == 1:
-            def body(h, bp):
-                fn = jax.checkpoint(block_apply) if remat else block_apply
-                return fn(bp, h), None
-            h, _ = jax.lax.scan(body, x, stacked_p)
-            return h
-
-        # reshape leaves [L, ...] -> [pp, L/pp, ...]; stage = inner scan
-        staged = jax.tree.map(
-            lambda a: a.reshape((pp, layers_per_stage) + a.shape[1:]),
-            stacked_p)
-
-        def stage_fn(stage_p, h):
-            def body(carry, bp):
-                fn = jax.checkpoint(block_apply) if remat else block_apply
-                return fn(bp, carry), None
-            out, _ = jax.lax.scan(body, h, stage_p)
-            return out
-
-        return pipelined_apply(stage_fn, staged, x, num_stages=pp,
+            return stage_blocks(stacked_p, x)
+        return pipelined_apply(stage_blocks, to_staged(stacked_p), x,
+                               num_stages=pp,
                                num_microbatches=max(num_microbatches, pp),
                                remat=False)
 
@@ -304,8 +313,7 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
         # embeddings + ln_f + head run via functional_call on the model with
         # outer params; trunk handled functionally
         def fwd():
-            x = model.gpt.embeddings(input_ids)
-            x = _constrain(x, "data", None, None)
+            x = embed_fwd(input_ids)
             x = trunk(stacked_p, x)
             x = model.gpt.ln_f(x)
             logits = model.logits(x)
@@ -333,9 +341,63 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
 
     opt_state0 = optimizer.init_state(flatname_params)
 
+    def value_and_grad_1f1b(params, batch):
+        """Loss + grads via the 1F1B schedule (SectionWorker mode 1,
+        `section_worker.cc:144-156`): embedding vjp outside the schedule,
+        per-microbatch head (ln_f + tied logits + CE) inside it so
+        backward starts S-1 ticks after forward."""
+        outer_p, stacked_p = params
+        input_ids, labels = batch
+        B = input_ids.shape[0]
+        M = max(num_microbatches, pp)
+        assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
+
+        def embed_fn(op):
+            out, _ = functional_call_outer(
+                model, op, lambda: embed_fwd(input_ids))
+            return out
+
+        x, embed_vjp = jax.vjp(embed_fn, outer_p)
+        mb = x.reshape((M, B // M) + tuple(x.shape[1:]))
+        labels_mb = labels.reshape((M, B // M) + tuple(labels.shape[1:]))
+
+        def head_grad(op, y, lab):
+            def h(op_, y_):
+                def fwd():
+                    z = model.gpt.ln_f(y_)
+                    logits = model.logits(z)
+                    return model.criterion(logits, lab)
+                out, _ = functional_call_outer(model, op_, fwd)
+                return out
+            loss_v, vjp_fn = jax.vjp(h, op, y)
+            # global loss = mean over microbatches → seed cotangent 1/M
+            dop, dy = vjp_fn(jnp.asarray(1.0 / M, loss_v.dtype))
+            return loss_v, dy, dop
+
+        loss_sum, dx_stream, g_staged, g_outer_head = one_f_one_b(
+            stage_blocks, to_staged(stacked_p), mb, head_grad, outer_p,
+            labels_mb, num_stages=pp)
+        dx = dx_stream.reshape((B,) + tuple(x.shape[1:]))
+        (g_outer_embed,) = embed_vjp(dx)
+        g_outer = jax.tree.map(jnp.add, g_outer_head, g_outer_embed)
+        g_stacked = jax.tree.map(
+            lambda a: a.reshape((pp * layers_per_stage,) + a.shape[2:]),
+            g_staged)
+        return loss_sum / M, (g_outer, g_stacked)
+
+    use_1f1b = pipeline_schedule == "1f1b" and pp > 1
+    if pipeline_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline_schedule {pipeline_schedule!r}")
+    if use_1f1b and cfg.dropout > 0.0:
+        raise NotImplementedError(
+            "1f1b schedule does not thread dropout rng yet — "
+            "use pipeline_schedule='gpipe' or dropout=0")
+
     def step(state, batch, rng=None):
         outer_p, stacked_p, opt_state = state
-        if rng is None:
+        if use_1f1b:
+            loss, grads = value_and_grad_1f1b((outer_p, stacked_p), batch)
+        elif rng is None:
             loss, grads = jax.value_and_grad(loss_fn)((outer_p, stacked_p),
                                                       batch)
         else:
@@ -354,6 +416,16 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
         flat_p.update({f"blocks.{n}": v for n, v in stacked_p.items()})
         flat_g = dict(g_outer)
         flat_g.update({f"blocks.{n}": v for n, v in g_stacked.items()})
+        if shard_axis > 1:
+            # ZeRO-2: pin gradients to the optimizer-state layout so XLA
+            # reduce-scatters them over 'sharding' (instead of all-reduce)
+            # and runs the update sharded; fresh params all-gather on the
+            # way out. Reference bar: grad sharding in static
+            # ShardingOptimizer (`sharding_optimizer.py:87-1385`).
+            flat_g = {n: (jax.lax.with_sharding_constraint(
+                              v, ns(opt_spec(n, v)))
+                          if jnp.ndim(v) else v)
+                      for n, v in flat_g.items()}
         new_flat, new_opt = optimizer.apply(flat_p, flat_g, opt_state)
         new_outer = {n: new_flat[n] for n in outer_p}
         new_stacked = {n: new_flat[f"blocks.{n}"] for n in stacked_p}
@@ -391,7 +463,12 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
         {n: ns(s) for n, s in stacked_specs.items()},
         jax.tree.map(lambda s: ns(s), opt_state_specs,
                      is_leaf=lambda s: isinstance(s, P)))
-    batch_sharding = (ns(P("data", None)), ns(P("data", None)))
+    # ZeRO semantics: the 'sharding' axis IS data parallelism with sharded
+    # states — the batch splits over data×sharding jointly (reference:
+    # sharding_degree multiplies dp for the data split,
+    # sharding_optimizer.py:968 _build_groups)
+    batch_sharding = (ns(P(("data", "sharding"), None)),
+                      ns(P(("data", "sharding"), None)))
 
     if cfg.dropout > 0.0:
         step_jit = jax.jit(
