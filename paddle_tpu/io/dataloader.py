@@ -48,12 +48,19 @@ class DataLoader:
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
-                 use_shared_memory=True, timeout=0, worker_init_fn=None):
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 worker_mode: str = "process"):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 1)
         self.use_buffer_reader = use_buffer_reader
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        if worker_mode not in ("process", "thread"):
+            raise ValueError("worker_mode must be 'process' or 'thread'")
+        self.worker_mode = worker_mode
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if not self._iterable_mode:
             if batch_sampler is not None:
@@ -86,6 +93,21 @@ class DataLoader:
         if self.num_workers <= 0:
             for indices in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in indices])
+            return
+        if self.worker_mode == "process":
+            # forked worker processes + shared-memory batches + watchdog —
+            # the reference's default worker model (`dataloader_iter.py:317`
+            # + `worker.py:251` + mmap_allocator shared mem). Python-heavy
+            # decode pipelines scale past the GIL here.
+            from .worker import MultiprocessBatchIterator
+            it = MultiprocessBatchIterator(
+                self.dataset, self.collate_fn, list(self.batch_sampler),
+                num_workers=self.num_workers,
+                prefetch=self.prefetch_factor,
+                use_shm=self.use_shared_memory,
+                worker_init_fn=self.worker_init_fn,
+                timeout_s=self.timeout if self.timeout else 120.0)
+            yield from it
             return
         # worker threads + native blocking queue: the reference's
         # DataLoader worker model (`dataloader_iter.py:317` workers feeding

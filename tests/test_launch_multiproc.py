@@ -1,0 +1,65 @@
+"""Subprocess-per-rank distributed tests (VERDICT round 1 item 4).
+
+Ports the reference's universal distributed-test trick (`TestDistBase`,
+`test_dist_base.py:743`): spawn real trainer processes on localhost via
+the launcher with a simulated device per process, run a tiny DP model,
+assert loss equivalence with single-process training. This makes
+`distributed/launch.py` + `env.py` (jax.distributed bootstrap) genuinely
+tested instead of dead code.
+
+These tests spawn subprocesses that each import jax (~10-20 s apiece).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RUNNER = os.path.join(REPO, "tests", "dist_runner_dp.py")
+
+
+def _launch(nproc, out_path, timeout=300):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # children force CPU in-process; scrub the parent test env overrides
+    env.pop("XLA_FLAGS", None)
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", str(nproc),
+           "--simulate_cpu_devices", "1",
+           RUNNER, out_path]
+    r = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                       text=True, timeout=timeout)
+    assert r.returncode == 0, \
+        f"launcher rc={r.returncode}\nstdout:{r.stdout[-2000:]}\n" \
+        f"stderr:{r.stderr[-2000:]}"
+    with open(out_path) as f:
+        return json.load(f)
+
+
+class TestLaunchMultiproc:
+    def test_dp2_loss_matches_single_process(self, tmp_path):
+        single = _launch(1, str(tmp_path / "single.json"))
+        dp2 = _launch(2, str(tmp_path / "dp2.json"))
+        assert len(single) == 3 and len(dp2) == 3
+        np.testing.assert_allclose(dp2, single, rtol=2e-4,
+                                   err_msg="2-proc DP diverged from "
+                                           "single-process")
+
+    def test_failed_child_tears_down_job(self, tmp_path):
+        bad = tmp_path / "bad_runner.py"
+        bad.write_text(
+            "import os, sys, time\n"
+            "if os.environ['PADDLE_TRAINER_ID'] == '1':\n"
+            "    sys.exit(3)\n"
+            "time.sleep(60)\n")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", str(bad)],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=60)
+        # rank 1 exits 3 → launcher kills rank 0 and reports failure fast
+        assert r.returncode == 3, (r.returncode, r.stderr[-500:])
