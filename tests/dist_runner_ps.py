@@ -1,0 +1,93 @@
+"""Per-rank runner for the parameter-server loss-equivalence test.
+
+The TPU-native DownpourWorker loop (`device_worker.h:244`): per step,
+pull embedding rows from the sharded host table, run the compiled dense
+step data-parallel over the global mesh, push row grads back to the
+owners, barrier. Rank 0 writes the loss trajectory to argv[1].
+"""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu.distributed import env as denv  # noqa: E402
+
+denv.init_parallel_env()
+
+import jax.numpy as jnp  # noqa: E402
+from jax.experimental import multihost_utils  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+from paddle_tpu.distributed.ps import (init_table_service,  # noqa: E402
+                                       shutdown_table_service)
+
+VOCAB, DIM, B, STEPS = 64, 8, 16, 4
+LR_DENSE, LR_EMB = 0.1, 0.1
+
+
+def main():
+    out_path = sys.argv[1]
+    world = denv.get_world_size()
+    rank = denv.get_rank()
+    svc = init_table_service()
+    table = svc.register("emb", VOCAB, DIM, lr=LR_EMB, seed=7)
+
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+    repl = NamedSharding(mesh, P())
+    row_sh = NamedSharding(mesh, P("data"))
+
+    # deterministic global batch per step
+    rs = np.random.RandomState(0)
+    all_ids = rs.randint(0, VOCAB, (STEPS, B)).astype(np.int64)
+    all_y = rs.randn(STEPS, B).astype(np.float32)
+    w0 = np.random.RandomState(1).randn(DIM).astype(np.float32) * 0.1
+
+    per = B // world
+    lo = rank * per
+
+    def to_global(a):
+        if world == 1:
+            return jnp.asarray(a)
+        return multihost_utils.host_local_array_to_global_array(
+            a, mesh, P("data"))
+
+    def step_fn(w, rows, y):
+        def loss_fn(w, rows):
+            pred = rows @ w
+            return jnp.mean((pred - y) ** 2)
+        loss, (dw, drows) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1))(w, rows)
+        return loss, w - LR_DENSE * dw, drows
+
+    step = jax.jit(step_fn, in_shardings=(repl, row_sh, row_sh),
+                   out_shardings=(repl, repl, row_sh))
+
+    w = jnp.asarray(w0)
+    losses = []
+    for t in range(STEPS):
+        local_ids = all_ids[t, lo:lo + per]
+        rows_local = table.pull(local_ids)                    # host RPC
+        rows_g = to_global(rows_local)
+        y_g = to_global(all_y[t, lo:lo + per])
+        loss, w, drows = step(w, rows_g, y_g)
+        drows_local = (np.asarray(drows) if world == 1 else
+                       multihost_utils.global_array_to_host_local_array(
+                           drows, mesh, P("data")))
+        table.push(local_ids, drows_local, sync=True)         # host RPC
+        if world > 1:
+            multihost_utils.sync_global_devices(f"ps_step_{t}")
+        losses.append(float(loss))
+    if rank == 0:
+        with open(out_path, "w") as f:
+            json.dump(losses, f)
+    print(f"PS_RUNNER_OK rank={rank} losses={losses}", flush=True)
+    shutdown_table_service()
+
+
+if __name__ == "__main__":
+    main()
