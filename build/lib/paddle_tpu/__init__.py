@@ -1,0 +1,69 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Capability surface of PaddlePaddle (~v2.1, see SURVEY.md), designed
+TPU-first: jax/XLA is the compute path (everything lowers to HLO and runs on
+the MXU), `jax.sharding.Mesh` + named axes replace NCCL ring-ids, functional
+transforms replace the imperative autograd engine, and Pallas kernels replace
+hand-written CUDA where fusion matters.
+
+Top-level namespace mirrors `python/paddle/__init__.py` of the reference.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from . import core  # noqa: F401
+from .core import (  # noqa: F401
+    CPUPlace,
+    TPUPlace,
+    get_default_dtype,
+    get_device,
+    get_flags,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    set_default_dtype,
+    set_device,
+    set_flags,
+)
+from .core.dtypes import (  # noqa: F401
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+)
+from .framework import get_rng_state, seed, set_rng_state  # noqa: F401
+from .tensor import *  # noqa: F401,F403
+from . import tensor  # noqa: F401
+
+# Subpackages imported lazily to keep `import paddle_tpu` light are still
+# eagerly wired for API parity (paddle exposes paddle.nn etc. on import).
+from . import autograd  # noqa: F401  (isort: skip)
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import distributed  # noqa: F401
+from . import vision  # noqa: F401
+from . import jit  # noqa: F401
+from . import static  # noqa: F401
+from . import models  # noqa: F401
+from . import profiler  # noqa: F401
+from . import inference  # noqa: F401
+from . import distribution  # noqa: F401
+from . import text  # noqa: F401
+from . import onnx  # noqa: F401
+from . import utils  # noqa: F401
+from . import incubate  # noqa: F401
+from .autograd import grad, no_grad, value_and_grad  # noqa: F401
+from .framework.io import load, save  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from .nn.layer import Layer, Parameter  # noqa: F401

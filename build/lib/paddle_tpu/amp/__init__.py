@@ -1,0 +1,23 @@
+"""Automatic mixed precision.
+
+Mirrors `python/paddle/amp/` (reference: dygraph `amp_guard`
+(`fluid/dygraph/amp/auto_cast.py:95`) + `GradScaler` (`loss_scaler.py:27`)
+backed by `check_finite_and_unscale` / `update_loss_scaling` CUDA ops; static
+white/black op lists in `contrib/mixed_precision/fp16_lists.py:40`).
+
+TPU-native design: bf16 is the native MXU dtype, so the default `auto_cast`
+dtype is bfloat16 and **no loss scaling is needed** (bf16 has fp32's
+exponent). fp16 + dynamic loss scaling is still provided for parity; the
+finite-check/scale-update runs inside the compiled step via `lax.cond` — the
+two CUDA kernels of the reference become a fused part of the step graph.
+"""
+from .auto_cast import (  # noqa: F401
+    amp_state,
+    auto_cast,
+    amp_guard,
+    decorate,
+    maybe_autocast,
+    white_op,
+    black_op,
+)
+from .grad_scaler import GradScaler, ScalerState  # noqa: F401

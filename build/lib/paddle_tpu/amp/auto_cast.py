@@ -1,0 +1,104 @@
+"""auto_cast policy.
+
+Reference op lists (`fp16_lists.py:40`): white = matmul/conv (MXU ops run in
+low precision), black = reductions/softmax/norm accumulations stay fp32.
+Here the policy is consulted by the compute-heavy functional ops
+(`F.linear`, `F.conv*`, `tensor.matmul`, attention) via `maybe_autocast`;
+norm layers already compute statistics in fp32 unconditionally.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..core.dtypes import convert_dtype
+
+_state = threading.local()
+
+# mirrors fp16_lists.py: ops that should run in low precision
+WHITE_LIST = {"matmul", "conv", "linear", "attention", "einsum", "bmm"}
+# ops that must stay fp32
+BLACK_LIST = {"softmax_with_cross_entropy", "cross_entropy", "layer_norm",
+              "batch_norm", "log", "exp", "mean", "sum"}
+
+
+def amp_state():
+    if not hasattr(_state, "enabled"):
+        _state.enabled = False
+        _state.dtype = jnp.bfloat16
+        _state.level = "O1"
+        _state.custom_white = set()
+        _state.custom_black = set()
+    return _state
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """Reference: `paddle.amp.auto_cast` / `amp_guard` (auto_cast.py:95)."""
+    st = amp_state()
+    saved = (st.enabled, st.dtype, st.level, st.custom_white,
+             st.custom_black)
+    st.enabled = enable
+    st.dtype = convert_dtype(dtype)
+    st.level = level
+    st.custom_white = set(custom_white_list or ())
+    st.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (st.enabled, st.dtype, st.level, st.custom_white,
+         st.custom_black) = saved
+
+
+amp_guard = auto_cast
+
+
+def white_op(op_name: str) -> bool:
+    st = amp_state()
+    if not st.enabled:
+        return False
+    if op_name in st.custom_black:
+        return False
+    if st.level == "O2":
+        return op_name not in BLACK_LIST
+    return op_name in WHITE_LIST or op_name in st.custom_white
+
+
+def black_op(op_name: str) -> bool:
+    st = amp_state()
+    return op_name in BLACK_LIST or op_name in st.custom_black
+
+
+def maybe_autocast(*tensors, op="matmul"):
+    """Cast float inputs to the AMP dtype when the op is white-listed."""
+    st = amp_state()
+    if not st.enabled or not white_op(op):
+        return tensors if len(tensors) > 1 else tensors[0]
+    out = tuple(
+        t.astype(st.dtype)
+        if hasattr(t, "dtype") and jnp.issubdtype(t.dtype, jnp.floating)
+        and t.dtype != st.dtype else t
+        for t in tensors)
+    return out if len(out) > 1 else out[0]
+
+
+def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """Reference: `paddle.amp.decorate` — pure-fp16/bf16 mode: casts model
+    params to the AMP dtype; optimizer should use multi_precision masters."""
+    dt = convert_dtype(dtype)
+    result = []
+    model_list = models if isinstance(models, (list, tuple)) else [models]
+    for m in model_list:
+        if m is not None:
+            m.to(dtype=dt)
+    result = models
+    if optimizers is not None:
+        for opt in (optimizers if isinstance(optimizers, (list, tuple))
+                    else [optimizers]):
+            opt._multi_precision = True
+        return result, optimizers
+    return result

@@ -1,0 +1,126 @@
+"""Dynamic loss scaling.
+
+Reference: `GradScaler` (`fluid/dygraph/amp/loss_scaler.py:27`) +
+`check_finite_and_unscale_op` and `update_loss_scaling_op` CUDA kernels
+(`operators/amp/`). Here both live inside the compiled step: the finite scan
+is a fused reduction, the scale update a `lax.cond` — zero extra kernel
+launches.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ScalerState(NamedTuple):
+    scale: jax.Array          # current loss scale (f32 scalar)
+    growth_tracker: jax.Array  # consecutive finite steps (i32)
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._init_scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._state = self.init_state()
+
+    def init_state(self) -> ScalerState:
+        return ScalerState(scale=jnp.float32(self._init_scale),
+                           growth_tracker=jnp.int32(0))
+
+    # --- functional API (use inside jit) ---
+
+    def scale_loss(self, loss, state: ScalerState):
+        if not self._enable:
+            return loss
+        return loss * state.scale.astype(loss.dtype)
+
+    def unscale_and_check(self, grads, state: ScalerState):
+        """Returns (unscaled_grads, found_inf). Reference:
+        check_finite_and_unscale_op."""
+        if not self._enable:
+            return grads, jnp.bool_(False)
+        inv = (1.0 / state.scale)
+        unscaled = jax.tree.map(
+            lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads)
+        finite = jnp.bool_(True)
+        for g in jax.tree.leaves(unscaled):
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(
+                g.astype(jnp.float32))))
+        return unscaled, jnp.logical_not(finite)
+
+    def update_state(self, state: ScalerState, found_inf) -> ScalerState:
+        """Reference: update_loss_scaling_op."""
+        if not self._enable or not self._dynamic:
+            return state
+        def on_inf(s):
+            return ScalerState(
+                scale=jnp.maximum(s.scale * self._decr_ratio, 1.0),
+                growth_tracker=jnp.int32(0))
+
+        def on_finite(s):
+            tracker = s.growth_tracker + 1
+            grow = tracker >= self._incr_every
+            return ScalerState(
+                scale=jnp.where(grow, s.scale * self._incr_ratio, s.scale),
+                growth_tracker=jnp.where(grow, 0, tracker))
+
+        return jax.lax.cond(found_inf, on_inf, on_finite, state)
+
+    def apply_step(self, optimizer, params, grads, opt_state,
+                   scaler_state: ScalerState):
+        """Full scaled step: unscale, check, conditionally update params.
+        On overflow the params/opt_state pass through unchanged (the
+        reference skips `optimizer.step()` the same way)."""
+        grads, found_inf = self.unscale_and_check(grads, scaler_state)
+
+        def do_step(_):
+            return optimizer.apply(params, grads, opt_state)
+
+        def skip(_):
+            return params, opt_state
+
+        new_params, new_opt_state = jax.lax.cond(found_inf, skip, do_step,
+                                                 None)
+        return new_params, new_opt_state, self.update_state(scaler_state,
+                                                            found_inf)
+
+    # --- stateful eager API (paddle parity) ---
+
+    def scale(self, loss):
+        return self.scale_loss(loss, self._state)
+
+    def step(self, optimizer, grads):
+        grads, found_inf = self.unscale_and_check(grads, self._state)
+        if not bool(found_inf):
+            optimizer.step(grads)
+        self._state = self.update_state(self._state, found_inf)
+
+    def minimize(self, optimizer, scaled_loss_grads):
+        self.step(optimizer, scaled_loss_grads)
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return float(self._state.scale)
+
+    def state_dict(self):
+        return {"scale": float(self._state.scale),
+                "incr_count": int(self._state.growth_tracker)}
+
+    def load_state_dict(self, state):
+        self._state = ScalerState(
+            scale=jnp.float32(state["scale"]),
+            growth_tracker=jnp.int32(state.get("incr_count", 0)))

@@ -1,0 +1,181 @@
+"""Autograd — functional differentiation.
+
+TPU-native replacement for the reference's imperative autograd engine
+(`imperative/basic_engine.cc:305` reverse topological walk,
+`partial_grad_engine.cc` for `paddle.grad`, `PyLayer` custom ops). On TPU the
+whole step is traced and differentiated by `jax.grad`; there is no tape, no
+per-op GradOpMaker, no dependency counting — XLA sees the full graph and
+schedules it.
+
+- `value_and_grad` / `grad`: differentiate pure functions (including
+  `nn.functional_call` closures over a Layer).
+- `PyLayer`: custom forward/backward via `jax.custom_vjp` (reference:
+  `python/paddle/autograd/py_layer.py:192` + C++ `py_layer_fwd.h`).
+- `no_grad`: parity context — inside, arrays are wrapped with
+  `stop_gradient` on exit from the scope's functions (primarily an eager-mode
+  annotation; under traced training use `jax.lax.stop_gradient`).
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Any, Callable, Optional, Sequence, Union
+
+import jax
+from jax import lax
+
+
+def value_and_grad(func: Callable, argnums: Union[int, Sequence[int]] = 0,
+                   has_aux: bool = False, holomorphic: bool = False):
+    return jax.value_and_grad(func, argnums=argnums, has_aux=has_aux,
+                              holomorphic=holomorphic)
+
+
+def grad(outputs=None, inputs=None, *, func: Optional[Callable] = None,
+         argnums: Union[int, Sequence[int]] = 0, has_aux: bool = False,
+         **kwargs):
+    """Dual-form `grad`:
+
+    - Functional (TPU-idiomatic): `grad(func)(x)` or
+      `grad(func=..., argnums=...)` — thin wrapper over `jax.grad`.
+    - `paddle.grad(outputs, inputs)` imperative form is NOT supported on an
+      already-computed eager result (there is no tape); the error points the
+      user at the functional form.
+    """
+    if callable(outputs) and inputs is None and func is None:
+        return jax.grad(outputs, argnums=argnums, has_aux=has_aux)
+    if func is not None:
+        return jax.grad(func, argnums=argnums, has_aux=has_aux)
+    raise RuntimeError(
+        "paddle_tpu.grad(outputs, inputs) on eager tensors is unsupported: "
+        "autograd is functional on TPU. Write the computation as a function "
+        "and use paddle_tpu.grad(fn)(inputs) / value_and_grad(fn).")
+
+
+stop_gradient = lax.stop_gradient
+
+
+@contextlib.contextmanager
+def no_grad():
+    """Parity with `paddle.no_grad`. In the functional world gradients only
+    flow through explicitly-differentiated functions, so this is a no-op
+    scope; kept so reference training scripts port unchanged."""
+    yield
+
+
+def no_grad_(func=None):
+    if func is None:
+        return no_grad()
+
+    @functools.wraps(func)
+    def wrapper(*a, **k):
+        return func(*a, **k)
+    return wrapper
+
+
+class PyLayerContext:
+    """Reference: `paddle/autograd/py_layer.py:21` — save tensors between
+    forward and backward."""
+
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class _PyLayerMeta(type):
+    def __init__(cls, name, bases, ns):
+        super().__init__(name, bases, ns)
+        if bases and ("forward" in ns or "backward" in ns):
+            cls._build()
+
+
+class PyLayer(metaclass=_PyLayerMeta):
+    """Custom autograd op (reference: PyLayer / C++ py_layer op).
+
+    class Cube(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x ** 3
+        @staticmethod
+        def backward(ctx, dy):
+            x, = ctx.saved_tensor
+            return 3 * x ** 2 * dy
+
+    Cube.apply(x) works in eager and under jit/grad — it lowers to
+    `jax.custom_vjp`.
+    """
+
+    @classmethod
+    def _build(cls):
+        fwd_static = cls.__dict__.get("forward") or cls.forward
+        bwd_static = cls.__dict__.get("backward") or cls.backward
+        fwd = fwd_static.__func__ if isinstance(fwd_static, staticmethod) \
+            else fwd_static
+        bwd = bwd_static.__func__ if isinstance(bwd_static, staticmethod) \
+            else bwd_static
+
+        @jax.custom_vjp
+        def op(*args):
+            return fwd(PyLayerContext(), *args)
+
+        def op_fwd(*args):
+            ctx = PyLayerContext()
+            out = fwd(ctx, *args)
+            # residuals must be jax types: persist only the saved tensors
+            return out, tuple(ctx._saved)
+
+        def op_bwd(saved, g):
+            ctx = PyLayerContext()
+            ctx._saved = tuple(saved)
+            grads = bwd(ctx, *(g if isinstance(g, tuple) else (g,)))
+            if not isinstance(grads, tuple):
+                grads = (grads,)
+            return grads
+
+        cls._op = op
+        cls._op_fwd = op_fwd
+        cls._op_bwd = op_bwd
+        op.defvjp(op_fwd, op_bwd)
+
+    @classmethod
+    def apply(cls, *args):
+        return cls._op(*args)
+
+    @staticmethod
+    def forward(ctx, *args):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+
+def jacobian(func, xs, create_graph=False):
+    return jax.jacrev(func)(xs)
+
+
+def hessian(func, xs, create_graph=False):
+    return jax.hessian(func)(xs)
+
+
+def vjp(func, xs, v=None):
+    out, pullback = jax.vjp(func, xs)
+    if v is None:
+        import jax.numpy as jnp
+        v = jnp.ones_like(out)
+    return out, pullback(v)[0]
+
+
+def jvp(func, xs, v):
+    return jax.jvp(func, (xs,), (v,))

@@ -1,0 +1,21 @@
+"""Core runtime: dtypes, flags, error enforcement, device places.
+
+TPU-native replacement for the reference's `paddle/fluid/platform/` layer —
+what survives of it once XLA owns streams, allocators and kernels.
+"""
+from . import dtypes, enforce, flags  # noqa: F401
+from .device import (  # noqa: F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    Place,
+    TPUPlace,
+    device_count,
+    get_device,
+    get_place,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    is_compiled_with_xpu,
+    set_device,
+)
+from .dtypes import get_default_dtype, set_default_dtype  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401

@@ -1,0 +1,63 @@
+"""NaN/Inf sanitizer (reference: `FLAGS_check_nan_inf`,
+`framework/details/nan_inf_utils_detail.{cc,cu}` — scans every op output
+when the flag is set).
+
+On TPU there is no per-op boundary to hook once XLA fuses the program, so
+the equivalent check works at the pytree boundary: `check_numerics`
+asserts a tree is finite (eager), and `nan_inf_guard` wraps a step
+function so its outputs are verified each call when
+`FLAGS_check_nan_inf` is on — inside jit via `jax.debug` callbacks.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .flags import flag
+
+
+class NaNInfError(FloatingPointError):
+    pass
+
+
+def _leaf_bad(x) -> bool:
+    if not isinstance(x, (jax.Array,)) or not jnp.issubdtype(
+            jnp.asarray(x).dtype, jnp.inexact):
+        return False
+    return bool(jnp.any(~jnp.isfinite(jnp.asarray(x))))
+
+
+def check_numerics(tree: Any, message: str = "") -> Any:
+    """Eagerly assert every inexact leaf in `tree` is finite; returns the
+    tree so it can be used inline. Raises NaNInfError with the offending
+    paths (reference prints op name + tensor stats)."""
+    bad = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if _leaf_bad(leaf):
+            arr = jnp.asarray(leaf)
+            n_nan = int(jnp.sum(jnp.isnan(arr)))
+            n_inf = int(jnp.sum(jnp.isinf(arr)))
+            bad.append(f"{jax.tree_util.keystr(path)}: "
+                       f"{n_nan} NaN, {n_inf} Inf of {arr.size}")
+    if bad:
+        raise NaNInfError(f"{message or 'check_numerics'} found "
+                          f"non-finite values:\n  " + "\n  ".join(bad))
+    return tree
+
+
+def nan_inf_guard(fn):
+    """Wrap a (possibly jitted) step function: when FLAGS_check_nan_inf
+    is set, verify all inexact outputs after each call. The check runs on
+    host after device execution — zero cost when the flag is off."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        if flag("check_nan_inf"):
+            check_numerics(out, getattr(fn, "__name__", "step"))
+        return out
+
+    return wrapped

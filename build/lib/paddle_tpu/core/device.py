@@ -1,0 +1,115 @@
+"""Device / place abstraction.
+
+TPU-native equivalent of the reference's `paddle/fluid/platform/place.h`
+(`Place` variant over CPUPlace/CUDAPlace/XPUPlace/NPUPlace) and
+`device_context.h`. On TPU, streams/contexts/allocators are owned by XLA, so a
+Place reduces to a handle onto a `jax.Device`; `DeviceContextPool` disappears.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+
+from . import enforce
+
+
+class Place:
+    """Base place. Compares by device kind + index like the reference Place."""
+
+    kind: str = "undefined"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __eq__(self, other):
+        return (isinstance(other, Place) and self.kind == other.kind
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.kind, self.device_id))
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.device_id})"
+
+    def jax_device(self) -> jax.Device:
+        devs = [d for d in jax.devices() if _kind_of(d) == self.kind]
+        enforce.enforce(
+            self.device_id < len(devs),
+            f"No {self.kind} device with index {self.device_id}; "
+            f"visible: {jax.devices()}",
+            enforce.UnavailableError)
+        return devs[self.device_id]
+
+
+class CPUPlace(Place):
+    kind = "cpu"
+
+
+class TPUPlace(Place):
+    """Reference analogue: CUDAPlace (place.h). The accelerator place."""
+    kind = "tpu"
+
+
+class CUDAPinnedPlace(Place):
+    # On TPU there is no pinned staging pool exposed to users; kept for API
+    # parity, maps to host memory.
+    kind = "cpu"
+
+
+def _kind_of(dev: jax.Device) -> str:
+    p = dev.platform.lower()
+    if p in ("tpu", "axon"):
+        return "tpu"
+    if p in ("gpu", "cuda", "rocm"):
+        return "gpu"
+    return "cpu"
+
+
+_current_device: Optional[str] = None
+
+
+@functools.lru_cache(maxsize=None)
+def _devices_of_kind(kind: str):
+    return tuple(d for d in jax.devices() if _kind_of(d) == kind)
+
+
+def is_compiled_with_tpu() -> bool:
+    return len(_devices_of_kind("tpu")) > 0
+
+
+def is_compiled_with_cuda() -> bool:  # API parity
+    return False
+
+
+def is_compiled_with_xpu() -> bool:  # API parity
+    return False
+
+
+def set_device(device: str) -> Place:
+    """paddle.set_device equivalent: 'tpu', 'tpu:1', 'cpu'."""
+    global _current_device
+    name, _, idx = device.partition(":")
+    idx = int(idx) if idx else 0
+    name = {"gpu": "tpu"}.get(name, name)  # accept 'gpu' for drop-in scripts
+    place = TPUPlace(idx) if name == "tpu" else CPUPlace(idx)
+    place.jax_device()  # validate
+    _current_device = f"{place.kind}:{idx}"
+    jax.config.update("jax_default_device", place.jax_device())
+    return place
+
+
+def get_device() -> str:
+    if _current_device is not None:
+        return _current_device
+    return "tpu:0" if is_compiled_with_tpu() else "cpu:0"
+
+
+def get_place() -> Place:
+    name, _, idx = get_device().partition(":")
+    return (TPUPlace if name == "tpu" else CPUPlace)(int(idx or 0))
+
+
+def device_count(kind: str = "tpu") -> int:
+    return len(_devices_of_kind(kind))

@@ -1,0 +1,102 @@
+"""Dtype system.
+
+TPU-native equivalent of the reference's dtype plumbing
+(`paddle/fluid/framework/data_type.h`, `platform/float16.h`,
+`platform/bfloat16.h`): on TPU the software-emulated fp16/bf16 types are
+unnecessary — XLA has native bf16 on the MXU — so dtypes are plain numpy/jax
+dtypes with paddle-style string names.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (paddle name -> jax dtype).
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_ALIASES = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "fp16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float64": float64,
+    "fp64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64}
+_INTEGER = {uint8, int8, int16, int32, int64}
+
+_default_dtype = float32
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype spec (string / np / jnp dtype) to a jnp dtype.
+
+    Mirrors `convert_dtype` in the reference's
+    `python/paddle/fluid/data_feeder.py`.
+    """
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _ALIASES:
+            raise TypeError(f"Unsupported dtype string: {dtype!r}")
+        return _ALIASES[dtype]
+    return jnp.dtype(dtype).type
+
+
+def dtype_name(dtype) -> str:
+    return jnp.dtype(dtype).name
+
+
+def is_floating_point(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(convert_dtype(dtype)), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(convert_dtype(dtype)), jnp.integer)
+
+
+def set_default_dtype(dtype):
+    """paddle.set_default_dtype equivalent."""
+    global _default_dtype
+    dtype = convert_dtype(dtype)
+    if dtype not in (float16, bfloat16, float32, float64):
+        raise TypeError("set_default_dtype only accepts floating dtypes")
+    _default_dtype = dtype
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def promote_types(a, b):
+    return jnp.promote_types(convert_dtype(a), convert_dtype(b))
+
+
+def finfo(dtype):
+    return jnp.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    return np.iinfo(jnp.dtype(convert_dtype(dtype)))

@@ -1,0 +1,111 @@
+"""Global flag/config registry.
+
+TPU-native equivalent of the reference's gflags system
+(`paddle/fluid/platform/flags.cc:33-603` DEFINE_* +
+`global_value_getter_setter.cc` + `paddle.set_flags`). Flags are defined in
+Python, overridable from the environment as ``FLAGS_<name>`` exactly like the
+reference, and read/written via `get_flags`/`set_flags`.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_lock = threading.Lock()
+_registry: Dict[str, "_Flag"] = {}
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "type", "help", "on_change")
+
+    def __init__(self, name, default, type_, help_, on_change=None):
+        self.name = name
+        self.default = default
+        self.type = type_
+        self.help = help_
+        self.on_change = on_change
+        self.value = default
+
+
+def _coerce(type_, raw):
+    if type_ is bool and isinstance(raw, str):
+        return raw.lower() in ("1", "true", "yes", "on")
+    return type_(raw)
+
+
+def define_flag(name: str, default: Any, help: str = "",
+                type: Optional[type] = None,
+                on_change: Optional[Callable[[Any], None]] = None):
+    """DEFINE_bool/int32/double/string analogue; env FLAGS_<name> overrides."""
+    type_ = type or (bool if isinstance(default, bool) else builtins_type(default))
+    with _lock:
+        if name in _registry:
+            return _registry[name].value
+        flag = _Flag(name, default, type_, help, on_change)
+        env = os.environ.get(f"FLAGS_{name}")
+        if env is not None:
+            flag.value = _coerce(type_, env)
+        _registry[name] = flag
+        return flag.value
+
+
+def builtins_type(v):
+    return type(v) if v is not None else str
+
+
+def _strip(name: str) -> str:
+    return name[6:] if name.startswith("FLAGS_") else name
+
+
+def get_flags(names):
+    """paddle.get_flags equivalent. Accepts one name or a list of names."""
+    single = isinstance(names, str)
+    out = {}
+    for n in [names] if single else names:
+        key = _strip(n)
+        if key not in _registry:
+            raise KeyError(f"Flag {n!r} is not defined")
+        out[f"FLAGS_{key}"] = _registry[key].value
+    return next(iter(out.values())) if single else out
+
+
+def set_flags(flags: Dict[str, Any]):
+    """paddle.set_flags equivalent."""
+    for n, v in flags.items():
+        key = _strip(n)
+        with _lock:
+            if key not in _registry:
+                raise KeyError(f"Flag {n!r} is not defined")
+            f = _registry[key]
+            f.value = _coerce(f.type, v)
+            cb = f.on_change
+        if cb is not None:
+            cb(f.value)
+
+
+def flag(name: str):
+    """Fast read of a single flag value."""
+    return _registry[name].value
+
+
+# --- Core flags (subset of platform/flags.cc relevant on TPU) ---
+define_flag("check_nan_inf", False,
+            "Scan op outputs for NaN/Inf (reference: flags.cc:44)")
+define_flag("benchmark", False, "Sync + time each op")
+define_flag("paddle_num_threads", 1, "Host compute threads")
+define_flag("use_bf16_matmul", True,
+            "Prefer bf16 matmul accumulation on MXU where AMP is active")
+define_flag("allocator_strategy", "xla",
+            "Memory allocator strategy; on TPU XLA owns HBM (reference: "
+            "auto_growth/naive_best_fit)")
+define_flag("fraction_of_gpu_memory_to_use", 1.0,
+            "Kept for API parity; XLA preallocation governs TPU HBM")
+define_flag("init_allocated_mem", False, "Kept for API parity")
+define_flag("enable_pallas_kernels", True,
+            "Use Pallas kernels (flash attention etc.) where available")
+define_flag("check_kernel_launch", False,
+            "Kept for API parity (reference: flags.cc:590)")
+define_flag("max_inplace_grad_add", 0, "Kept for API parity")
+define_flag("cudnn_deterministic", False,
+            "Deterministic mode: also sets XLA deterministic ops")

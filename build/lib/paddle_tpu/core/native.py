@@ -1,0 +1,267 @@
+"""ctypes bridge to the native runtime (csrc/ptpu_runtime.cc).
+
+The reference binds C++ via pybind11 (`fluid/pybind/pybind.cc:459`);
+pybind11 isn't in this image, so the native core exposes a flat C ABI and
+this module is the binding layer. The library is compiled on first import
+if the prebuilt `paddle_tpu/_native.so` is missing (the reference's
+analogue: `utils/cpp_extension` JIT builds).
+
+Everything degrades gracefully: if no C++ toolchain exists, `available()`
+is False and pure-Python fallbacks take over (profiler no-ops, queue →
+`queue.Queue`, arena → numpy allocation).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import queue as _pyqueue
+import subprocess
+import threading
+from typing import Optional
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+_LOCK = threading.Lock()
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SO_PATH = os.path.join(_PKG_DIR, "_native.so")
+_SRC = os.path.join(os.path.dirname(_PKG_DIR), "csrc", "ptpu_runtime.cc")
+
+
+def _build() -> bool:
+    if not os.path.exists(_SRC):
+        return False
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+           "-fvisibility=hidden", "-o", _SO_PATH, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        if not os.path.exists(_SO_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            return None
+        # signatures
+        lib.ptpu_last_error.restype = ctypes.c_char_p
+        lib.ptpu_version.restype = ctypes.c_char_p
+        lib.ptpu_arena_create.restype = ctypes.c_void_p
+        lib.ptpu_arena_create.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+        lib.ptpu_arena_destroy.argtypes = [ctypes.c_void_p]
+        lib.ptpu_arena_alloc.restype = ctypes.c_void_p
+        lib.ptpu_arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.ptpu_arena_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        for f in ("ptpu_arena_in_use", "ptpu_arena_peak",
+                  "ptpu_arena_reserved"):
+            getattr(lib, f).restype = ctypes.c_uint64
+            getattr(lib, f).argtypes = [ctypes.c_void_p]
+        lib.ptpu_queue_create.restype = ctypes.c_void_p
+        lib.ptpu_queue_create.argtypes = [ctypes.c_uint64]
+        lib.ptpu_queue_destroy.argtypes = [ctypes.c_void_p]
+        lib.ptpu_queue_push.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                        ctypes.c_int]
+        lib.ptpu_queue_pop.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_int64),
+                                       ctypes.c_int]
+        lib.ptpu_queue_close.argtypes = [ctypes.c_void_p]
+        lib.ptpu_queue_size.restype = ctypes.c_uint64
+        lib.ptpu_queue_size.argtypes = [ctypes.c_void_p]
+        lib.ptpu_profiler_now_us.restype = ctypes.c_int64
+        lib.ptpu_profiler_record.argtypes = [ctypes.c_char_p,
+                                             ctypes.c_int64, ctypes.c_int64]
+        lib.ptpu_profiler_dump.argtypes = [ctypes.c_char_p]
+        lib.ptpu_profiler_count.restype = ctypes.c_uint64
+        lib.ptpu_stat_add.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.ptpu_stat_get.restype = ctypes.c_int64
+        lib.ptpu_stat_get.argtypes = [ctypes.c_char_p]
+        lib.ptpu_stat_reset.argtypes = [ctypes.c_char_p]
+        lib.ptpu_aes_ctr_xcrypt.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_uint64]
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def lib() -> ctypes.CDLL:
+    l = _load()
+    if l is None:
+        raise RuntimeError("native runtime unavailable (no _native.so and "
+                           "no g++ to build it)")
+    return l
+
+
+class Arena:
+    """Best-fit host staging arena (reference:
+    auto_growth_best_fit_allocator.cc). `buffer(nbytes)` returns a numpy
+    uint8 view of arena memory; `release(buf)` returns it to the pool."""
+
+    def __init__(self, chunk_size: int = 64 << 20, alignment: int = 64):
+        import numpy as np
+        self._np = np
+        self._l = lib()
+        self._h = self._l.ptpu_arena_create(chunk_size, alignment)
+        self._live = {}
+
+    def buffer(self, nbytes: int):
+        p = self._l.ptpu_arena_alloc(self._h, nbytes)
+        if not p:
+            raise MemoryError(self._l.ptpu_last_error().decode())
+        buf = (ctypes.c_uint8 * nbytes).from_address(p)
+        arr = self._np.frombuffer(buf, dtype=self._np.uint8)
+        # keyed by base address (== arr.ctypes.data for the returned view)
+        self._live[int(p)] = buf
+        return arr
+
+    def release(self, arr) -> None:
+        p = int(arr.ctypes.data)
+        if p not in self._live:
+            raise ValueError("not an arena buffer (release the object "
+                             "returned by buffer(), not a slice)")
+        del self._live[p]
+        self._l.ptpu_arena_free(self._h, ctypes.c_void_p(p))
+
+    @property
+    def in_use(self) -> int:
+        return int(self._l.ptpu_arena_in_use(self._h))
+
+    @property
+    def peak(self) -> int:
+        return int(self._l.ptpu_arena_peak(self._h))
+
+    @property
+    def reserved(self) -> int:
+        return int(self._l.ptpu_arena_reserved(self._h))
+
+    def __del__(self):
+        try:
+            self._l.ptpu_arena_destroy(self._h)
+        except Exception:
+            pass
+
+
+class NativeQueue:
+    """Bounded blocking queue whose synchronization lives in C++
+    (reference: `lod_tensor_blocking_queue.h` feeding `read_op`). Objects
+    are kept in a Python-side registry keyed by monotonically increasing
+    tokens; C++ carries only the tokens, so arbitrary batches (numpy trees)
+    flow through without serialization."""
+
+    _CLOSED = object()
+
+    def __init__(self, capacity: int):
+        self._l = lib()
+        self._h = self._l.ptpu_queue_create(capacity)
+        self._objs = {}
+        self._next = 0
+        self._mu = threading.Lock()
+
+    def push(self, obj, timeout_ms: int = -1) -> bool:
+        with self._mu:
+            tok = self._next
+            self._next += 1
+            self._objs[tok] = obj
+        rc = self._l.ptpu_queue_push(self._h, tok, timeout_ms)
+        if rc != 0:
+            with self._mu:
+                self._objs.pop(tok, None)
+            if rc == -1:
+                raise RuntimeError("queue closed")
+            return False
+        return True
+
+    def pop(self, timeout_ms: int = -1):
+        out = ctypes.c_int64()
+        rc = self._l.ptpu_queue_pop(self._h, ctypes.byref(out), timeout_ms)
+        if rc == -1:
+            return self._CLOSED
+        if rc == -2:
+            return None
+        with self._mu:
+            return self._objs.pop(out.value)
+
+    @property
+    def closed_sentinel(self):
+        return self._CLOSED
+
+    def close(self):
+        self._l.ptpu_queue_close(self._h)
+
+    def __len__(self):
+        return int(self._l.ptpu_queue_size(self._h))
+
+    def __del__(self):
+        try:
+            self._l.ptpu_queue_destroy(self._h)
+        except Exception:
+            pass
+
+
+class PyQueueFallback:
+    """Pure-Python stand-in with the NativeQueue interface."""
+
+    _CLOSED = object()
+
+    def __init__(self, capacity: int):
+        self._q = _pyqueue.Queue(maxsize=capacity)
+        self._closed = False
+
+    def push(self, obj, timeout_ms: int = -1) -> bool:
+        if self._closed:
+            raise RuntimeError("queue closed")
+        try:
+            self._q.put(obj, timeout=None if timeout_ms < 0
+                        else timeout_ms / 1000)
+            return True
+        except _pyqueue.Full:
+            return False
+
+    def pop(self, timeout_ms: int = -1):
+        while True:
+            try:
+                return self._q.get(
+                    timeout=0.05 if timeout_ms < 0 else timeout_ms / 1000)
+            except _pyqueue.Empty:
+                if self._closed:
+                    return self._CLOSED
+                if timeout_ms >= 0:
+                    return None
+
+    @property
+    def closed_sentinel(self):
+        return self._CLOSED
+
+    def close(self):
+        self._closed = True
+
+    def __len__(self):
+        return self._q.qsize()
+
+
+def make_queue(capacity: int):
+    return NativeQueue(capacity) if available() else \
+        PyQueueFallback(capacity)
+
+
+def aes_ctr_xcrypt(key: bytes, iv: bytes, data: bytes) -> bytes:
+    """AES-128-CTR (encrypt == decrypt). Pure-python fallback is
+    intentionally absent — encrypted save requires the native lib, like the
+    reference requires cryptopp (`framework/io/crypto/aes_cipher.cc`)."""
+    if len(key) != 16 or len(iv) != 16:
+        raise ValueError("key and iv must be 16 bytes (AES-128-CTR)")
+    out = ctypes.create_string_buffer(len(data))
+    lib().ptpu_aes_ctr_xcrypt(key, iv, data, out, len(data))
+    return out.raw

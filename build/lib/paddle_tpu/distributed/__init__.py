@@ -1,0 +1,40 @@
+"""`paddle.distributed` equivalent namespace.
+
+The reference's four comm stacks (NCCL/BKCL/HCCL/Gloo + brpc PS) collapse
+into XLA collectives over a `jax.sharding.Mesh` (ICI/DCN) plus the jax
+coordination service for bootstrap. See SURVEY.md §5 "Distributed
+communication backend".
+"""
+from .collective import (  # noqa: F401
+    Group,
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    all_to_all_single,
+    alltoall,
+    barrier,
+    broadcast,
+    get_group,
+    new_group,
+    p2p_push,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    wait,
+)
+from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env  # noqa: F401
+from .topology import (  # noqa: F401
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    build_mesh,
+    get_hybrid_communicate_group,
+    get_mesh,
+    named_sharding,
+    set_mesh,
+)
+from .parallel import DataParallel  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from . import fleet  # noqa: F401
+from . import meta_parallel  # noqa: F401

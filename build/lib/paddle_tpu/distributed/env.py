@@ -1,0 +1,79 @@
+"""Distributed environment.
+
+Mirrors `python/paddle/distributed/parallel.py` (`init_parallel_env`,
+`ParallelEnv`) and the launcher env contract
+(`fleet/launch_utils.py:453-525`: PADDLE_TRAINER_ID /
+PADDLE_TRAINER_ENDPOINTS / PADDLE_TRAINERS_NUM).
+
+TPU-native: `jax.distributed.initialize` (coordination service) replaces the
+reference's raw-TCP ncclUniqueId bootstrap
+(`platform/gen_comm_id_helper.cc:286-321`); after init, `jax.devices()` spans
+all hosts and GSPMD handles cross-host collectives over ICI/DCN.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env():
+    """Reference: parallel.py:58. Reads the launcher env and brings up the
+    jax coordination service for multi-host; single-host is a no-op."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_MASTER") or \
+        os.environ.get("MASTER_ADDR")
+    nranks = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if coord and nranks > 1:
+        port = os.environ.get("MASTER_PORT", "8476")
+        jax.distributed.initialize(
+            coordinator_address=f"{coord}:{port}",
+            num_processes=nranks, process_id=rank)
+    _initialized = True
+    return ParallelEnv()
+
+
+def get_rank() -> int:
+    if jax.process_count() > 1:
+        return jax.process_index()
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+def get_world_size() -> int:
+    if jax.process_count() > 1:
+        return jax.process_count()
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+
+class ParallelEnv:
+    """Reference: `fluid/dygraph/parallel.py` ParallelEnv."""
+
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def device_id(self) -> int:
+        return int(os.environ.get("FLAGS_selected_tpus", "0").split(",")[0])
+
+    @property
+    def current_endpoint(self) -> str:
+        eps = self.trainer_endpoints
+        return eps[self.rank] if self.rank < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    local_rank = rank
+    nranks = world_size

@@ -1,0 +1,17 @@
+"""`paddle.distributed.fleet` equivalent."""
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .fleet_base import (  # noqa: F401
+    Fleet,
+    distributed_model,
+    distributed_optimizer,
+    get_hybrid_communicate_group,
+    init,
+    is_first_worker,
+    worker_index,
+    worker_num,
+)
+from ..topology import HybridCommunicateGroup  # noqa: F401
+from .recompute import recompute  # noqa: F401
+from ..random import get_rng_state_tracker  # noqa: F401
+from . import elastic  # noqa: F401
+from . import utils  # noqa: F401

@@ -1,0 +1,76 @@
+"""DistributedStrategy.
+
+Mirrors `fleet/base/distributed_strategy.py` backed by
+`framework/distributed_strategy.proto:158-210` — the single config object
+for every distributed feature (amp, recompute, gradient_merge, lamb/lars,
+pipeline, sharding, tensor_parallel, hybrid dp/mp/pp/sharding degrees).
+Plain attributes here (no proto — nothing crosses a C++ boundary anymore);
+field names are kept identical so reference scripts port unchanged.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # reference proto defaults (distributed_strategy.proto:158-210)
+        self.amp = False
+        self.amp_configs: Dict[str, Any] = {
+            "init_loss_scaling": 32768.0,
+            "incr_every_n_steps": 1000,
+            "decr_every_n_nan_or_inf": 2,
+            "incr_ratio": 2.0,
+            "decr_ratio": 0.8,
+            "use_dynamic_loss_scaling": True,
+            "use_pure_fp16": False,
+            "use_bf16": True,  # TPU default
+            "custom_white_list": [],
+            "custom_black_list": [],
+        }
+        self.recompute = False
+        self.recompute_configs: Dict[str, Any] = {"checkpoints": []}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.lamb = False
+        self.lamb_configs = {"lamb_weight_decay": 0.01,
+                             "exclude_from_weight_decay": []}
+        self.lars = False
+        self.lars_configs = {"lars_coeff": 0.001,
+                             "lars_weight_decay": 0.0005,
+                             "epsilon": 0.0,
+                             "exclude_from_weight_decay": []}
+        self.dgc = False
+        self.localsgd = False
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1,
+                                 "schedule_mode": "1F1B"}
+        self.sharding = False
+        self.sharding_configs = {"sharding_degree": 1, "stage": 1,
+                                 "offload": False,
+                                 "segment_broadcast_MB": 32.0}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sp_degree": 1,  # beyond-reference: sequence/context parallel
+        }
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True  # no-op on TPU (XLA fuses)
+        self.nccl_comm_num = 1           # parity only
+        self.a_sync = False
+        self.a_sync_configs = {"k_steps": -1}
+        self.heter_ccl_mode = False
+
+    def to_dict(self):
+        return {k: v for k, v in self.__dict__.items()}
+
+    def __repr__(self):
+        lines = ["DistributedStrategy("]
+        for k, v in self.__dict__.items():
+            lines.append(f"  {k}={v!r},")
+        return "\n".join(lines) + "\n)"

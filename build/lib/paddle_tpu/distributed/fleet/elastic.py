@@ -1,0 +1,146 @@
+"""Elastic training manager (reference: `fleet/elastic.py:90` —
+`ElasticManager` registers nodes in etcd3, watches membership, and
+relaunches `paddle.distributed.launch` on scale events; fault-tolerance
+level via PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL).
+
+TPU-native reality: slice membership is fixed by the TPU runtime — scale
+events mean re-acquiring a slice and restarting from auto-checkpoint
+(incubate/checkpoint.py), which jax.distributed detects as coordinator
+loss. This manager keeps the reference's state machine (register/watch/
+exit codes) over a pluggable KV store: etcd3 when importable, else a
+local-file store (single-host tests and the common TPU case where the
+platform's own scheduler handles replacement).
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+from typing import Callable, List, Optional
+
+ELASTIC_EXIT_CODE = 101
+
+
+class _FileKV:
+    """Local-file fallback store with the tiny subset of etcd3 used."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def put(self, key: str, value: bytes, lease=None):
+        p = os.path.join(self.root, key.replace("/", "__"))
+        with open(p, "wb") as f:
+            f.write(value)
+
+    def get_prefix(self, prefix: str):
+        out = []
+        pfx = prefix.replace("/", "__")
+        for fn in os.listdir(self.root):
+            if fn.startswith(pfx):
+                with open(os.path.join(self.root, fn), "rb") as f:
+                    out.append((f.read(), type("M", (), {
+                        "key": fn.replace("__", "/").encode()})()))
+        return out
+
+    def delete(self, key: str):
+        p = os.path.join(self.root, key.replace("/", "__"))
+        if os.path.exists(p):
+            os.remove(p)
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Reference: elastic.py:90."""
+
+    def __init__(self, args=None, etcd_client=None):
+        server = os.environ.get("PADDLE_ELASTIC_SERVER")
+        self.job_id = os.environ.get("PADDLE_ELASTIC_JOB_ID", "default")
+        self.np = int(os.environ.get("PADDLE_ELASTIC_NP", "1"))
+        self.host = os.environ.get("POD_IP", "127.0.0.1")
+        self.fault_tolerance_level = int(
+            os.environ.get("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "0"))
+        flag = os.environ.get("PADDLE_ELASTIC_ENABLE", "").lower()
+        self.enable = bool(server) or flag in ("1", "true", "yes", "on")
+        if etcd_client is not None:
+            self.etcd = etcd_client
+        elif server:
+            try:
+                import etcd3
+                h, p = server.split(":")
+                self.etcd = etcd3.client(host=h, port=int(p))
+            except ImportError:
+                self.etcd = _FileKV(f"/tmp/paddle_tpu_elastic/{self.job_id}")
+        else:
+            self.etcd = _FileKV(f"/tmp/paddle_tpu_elastic/{self.job_id}")
+        self.prefix = f"/paddle/{self.job_id}"
+        self.stopped = False
+        self._watches: List[Callable] = []
+
+    # --- membership -------------------------------------------------
+    # node key includes the PID so several workers per host stay distinct;
+    # entries carry a heartbeat time and go stale after _TTL seconds
+    # (the file store has no leases — etcd3 expiry is emulated by
+    # filtering on read)
+    _TTL = 60.0
+
+    def _node_key(self):
+        return f"{self.prefix}/nodes/{self.host}-{os.getpid()}"
+
+    def register(self):
+        if not self.enable:
+            return
+        self.etcd.put(self._node_key(), json.dumps(
+            {"host": self.host, "time": time.time()}).encode())
+
+    def nodes(self) -> List[str]:
+        out = []
+        now = time.time()
+        for val, meta in self.etcd.get_prefix(f"{self.prefix}/nodes"):
+            rec = json.loads(val.decode())
+            if now - rec.get("time", now) <= self._TTL:
+                out.append(rec["host"])
+        return sorted(out)
+
+    def exit(self, completed=False):
+        self.stopped = True
+        self.etcd.delete(self._node_key())
+
+    # --- health → status machine (reference: elastic.py watch loop) --
+    def wait(self):
+        if not self.enable:
+            return
+        while not self.stopped:
+            self.register()  # refresh heartbeat — emulates etcd lease keepalive
+            n = len(self.nodes())
+            if n >= self.np:
+                return
+            time.sleep(1)
+
+    def watch(self, procs_alive: Callable[[], bool]) -> str:
+        """Poll children + membership; returns an ElasticStatus."""
+        if not self.enable:
+            return ElasticStatus.HOLD if procs_alive() \
+                else ElasticStatus.COMPLETED
+        # re-put the node key with a fresh timestamp on every poll so a
+        # healthy job running past _TTL never loses its own membership
+        # entry (reference refreshes via the etcd lease keepalive thread,
+        # fleet/elastic.py:125-164)
+        self.register()
+        if not procs_alive():
+            return ElasticStatus.COMPLETED
+        if len(self.nodes()) != self.np:
+            return ElasticStatus.RESTART
+        return ElasticStatus.HOLD
+
+    def signal_handler(self, sigint, frame):
+        self.exit()
+        self.stopped = True

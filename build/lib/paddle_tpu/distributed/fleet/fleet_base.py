@@ -1,0 +1,140 @@
+"""Fleet facade.
+
+Mirrors `fleet/base/fleet_base.py:139-1413` (`fleet.init`,
+`distributed_model`, `distributed_optimizer`, worker introspection). The
+reference's role-maker/env parsing + per-mode model wrapping survives; the
+meta-optimizer StrategyCompiler (program rewriting) is replaced by
+composable step-function transforms — AMP/recompute/gradient-merge are
+orthogonal wrappers, parallelism is mesh sharding.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ...nn.layer import Layer
+from ..env import get_rank, get_world_size, init_parallel_env
+from ..topology import (
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    get_hybrid_communicate_group as _get_hcg,
+)
+from .distributed_strategy import DistributedStrategy
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        """Reference: fleet_base.py:139."""
+        self._strategy = strategy or DistributedStrategy()
+        init_parallel_env()
+        hc = self._strategy.hybrid_configs
+        dims = [hc.get("dp_degree", 1), hc.get("pp_degree", 1),
+                hc.get("sharding_degree", 1), hc.get("mp_degree", 1)]
+        names = ["data", "pipe", "sharding", "model"]
+        if hc.get("sp_degree", 1) > 1:
+            dims.append(hc["sp_degree"])
+            names.append("sequence")
+        topo = CommunicateTopology(names, dims)
+        self._hcg = HybridCommunicateGroup(topo)
+        self._is_initialized = True
+        return self
+
+    # --- introspection (reference parity) ---
+
+    def worker_index(self):
+        return get_rank()
+
+    def worker_num(self):
+        return get_world_size()
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def worker_endpoints(self, to_string=False):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        return ",".join(eps) if to_string else eps
+
+    def barrier_worker(self):
+        from ..collective import barrier
+        barrier()
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def strategy(self):
+        return self._strategy
+
+    # --- model / optimizer wrapping (reference: fleet_base.py:836,783) ---
+
+    def distributed_model(self, model: Layer):
+        """Wrap by parallel mode. Under GSPMD most wrapping is sharding
+        annotation; PP gets the schedule-carrying wrapper."""
+        assert self._is_initialized, "call fleet.init first"
+        hcg = self._hcg
+        from ..meta_parallel import (PipelineLayer, PipelineParallel,
+                                     ShardingParallel, TensorParallel)
+        if hcg.get_pipe_parallel_world_size() > 1 and \
+                isinstance(model, PipelineLayer):
+            return PipelineParallel(model, hcg, self._strategy)
+        if hcg.get_model_parallel_world_size() > 1:
+            return TensorParallel(model, hcg, self._strategy)
+        if hcg.get_sharding_parallel_world_size() > 1:
+            return ShardingParallel(model, hcg, self._strategy)
+        if hcg.get_data_parallel_world_size() > 1:
+            from ..parallel import DataParallel
+            return DataParallel(model)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """Reference returns the same optimizer decorated with the
+        strategy; ZeRO state placement comes from the sharding wrapper."""
+        if strategy is not None:
+            self._strategy = strategy
+        optimizer._fleet_strategy = self._strategy
+        hcg = self._hcg
+        if hcg is not None and hcg.get_sharding_parallel_world_size() > 1:
+            from ..meta_parallel import DygraphShardingOptimizer
+            return DygraphShardingOptimizer(hcg=hcg, inner_opt=optimizer)
+        return optimizer
+
+    # hooks for API parity
+    def save_persistables(self, executor=None, dirname=None,
+                          main_program=None):
+        raise NotImplementedError("use paddle_tpu.save(layer.state_dict())")
+
+
+fleet = Fleet()
+
+
+def init(role_maker=None, is_collective=True, strategy=None):
+    return fleet.init(role_maker, is_collective, strategy)
+
+
+def distributed_model(model):
+    return fleet.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet.distributed_optimizer(optimizer, strategy)
+
+
+def worker_index():
+    return fleet.worker_index()
+
+
+def worker_num():
+    return fleet.worker_num()
+
+
+def is_first_worker():
+    return fleet.is_first_worker()
+
+
+def get_hybrid_communicate_group():
+    return _get_hcg()

@@ -1,0 +1,47 @@
+"""Recompute (activation checkpointing).
+
+Reference: `fleet/utils/recompute.py:63` — a PyLayer that saves inputs + RNG
+and replays forward during backward; static twin `RecomputeOptimizer`
+(`fluid/optimizer.py:5288`) via `append_backward(checkpoints=...)`.
+
+TPU-native: `jax.checkpoint` (remat) IS this feature, implemented in the
+compiler — it rematerializes the wrapped computation in the backward pass,
+trading FLOPs for HBM exactly like the reference, but with XLA-chosen
+scheduling. RNG replay is automatic (keys are values, not global state).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
+              **kwargs):
+    """Reference signature: recompute(function, *args). Applies remat to the
+    call. With a Layer, wraps its functional forward."""
+    from ...nn.layer import Layer
+
+    if isinstance(function, Layer):
+        layer = function
+
+        @jax.checkpoint
+        def fwd(params, *inner):
+            from ...nn.layer import functional_call
+            out, _ = functional_call(layer, params, *inner)
+            return out
+
+        params = {n: p.value for n, p in layer.named_parameters()}
+        return fwd(params, *args)
+    return jax.checkpoint(function)(*args, **kwargs)
+
+
+def recompute_wrapper(fn):
+    """Decorator form for step-function composition."""
+    return jax.checkpoint(fn)
+
+
+# policy helpers for selective remat (beyond-reference: save matmul outputs)
+def checkpoint_dots(fn):
+    return jax.checkpoint(
+        fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)

@@ -1,0 +1,9 @@
+"""fleet.utils (reference: python/paddle/distributed/fleet/utils/)."""
+from ..recompute import recompute  # noqa: F401
+from .fs import FS, LocalFS, HDFSClient  # noqa: F401
+from .hybrid_parallel_util import (  # noqa: F401
+    broadcast_dp_parameters,
+    broadcast_input_data,
+    broadcast_mp_parameters,
+    fused_allreduce_gradients,
+)

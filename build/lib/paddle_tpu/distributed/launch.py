@@ -1,0 +1,125 @@
+"""`python -m paddle_tpu.distributed.launch` — multi-process launcher.
+
+Mirrors `python/paddle/distributed/fleet/launch.py:396` +
+`launch_utils.py:453-525`: spawn one process per device/host slot, inject
+the trainer env contract (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ENDPOINTS / MASTER_ADDR), watch children, tear all down
+when one dies (reference: `watch_local_trainers`/`terminate_local_procs`).
+
+On TPU pods each host usually runs ONE process that owns its local chips
+(jax.distributed model) — so the default is nproc_per_node=1 with the
+coordination service address passed through; `--nproc_per_node N` exists
+for CPU-simulation tests (each child gets JAX_PLATFORMS=cpu + a forced
+device count).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import List
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--master", default=None,
+                   help="coordinator host:port (default: localhost:auto)")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--simulate_cpu_devices", type=int, default=0,
+                   help="per-proc XLA virtual CPU devices (tests)")
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def start_local_trainers(args) -> List[subprocess.Popen]:
+    nproc = args.nproc_per_node
+    world = args.nnodes * nproc
+    master = args.master or f"127.0.0.1:{_free_port()}"
+    host, port = master.rsplit(":", 1)
+    procs = []
+    endpoints = ",".join(f"{host}:{int(port) + 1 + r}"
+                         for r in range(world))
+    for local in range(nproc):
+        rank = args.node_rank * nproc + local
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_MASTER": host,
+            "MASTER_ADDR": host,
+            "MASTER_PORT": port,
+            "FLAGS_selected_tpus": str(local),
+        })
+        if args.simulate_cpu_devices:
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "") +
+                f" --xla_force_host_platform_device_count="
+                f"{args.simulate_cpu_devices}")
+        log = None
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            log = open(os.path.join(args.log_dir,
+                                    f"workerlog.{rank}"), "w")
+        procs.append(subprocess.Popen(
+            [sys.executable, args.training_script,
+             *args.training_script_args],
+            env=env, stdout=log, stderr=log))
+    return procs
+
+
+def watch_local_trainers(procs: List[subprocess.Popen]) -> int:
+    """Reference: launch_utils.py watch_local_trainers — if any child
+    exits nonzero, kill the rest."""
+    try:
+        while True:
+            codes = [p.poll() for p in procs]
+            if all(c is not None for c in codes):
+                # signal deaths are negative exit codes — any nonzero
+                # (either sign) is a failure
+                return next((c for c in codes if c != 0), 0)
+            bad = [c for c in codes if c not in (None, 0)]
+            if bad:
+                terminate_local_procs(procs)
+                return bad[0]
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        terminate_local_procs(procs)
+        return 1
+
+
+def terminate_local_procs(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    deadline = time.time() + 10
+    for p in procs:
+        try:
+            p.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def launch():
+    args = parse_args()
+    procs = start_local_trainers(args)
+    sys.exit(watch_local_trainers(procs))
+
+
+if __name__ == "__main__":
+    launch()

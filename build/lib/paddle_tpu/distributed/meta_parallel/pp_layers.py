@@ -1,0 +1,178 @@
+"""Pipeline layer description + partitioning.
+
+Mirrors `fleet/meta_parallel/parallel_layers/pp_layers.py` (`LayerDesc`,
+`SharedLayerDesc`, `SegmentLayers` uniform/param-count partition,
+`PipelineLayer:23-257`). The reference instantiates only the local stage's
+layers on each rank; under SPMD every process traces the full program, so
+`PipelineLayer` here builds all stages and exposes per-stage sub-forward
+functions that `PipelineParallel` maps onto the 'pipe' mesh axis.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ...nn.layer import Layer
+
+
+class LayerDesc:
+    """Deferred layer constructor (reference: pp_layers.py:23)."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("The input(layer_func) should be a derived "
+                            "class of Layer.")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Tied layers across stages (e.g. embedding/output head sharing).
+    Reference: pp_layers.py SharedLayerDesc."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Partition N layers into `num_parts` stages (reference:
+    pp_layers.py SegmentLayers — 'uniform' and 'layer:<class>' methods)."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self._layers_desc = layers_desc
+        self.method = method
+        self.num_parts = num_parts
+        self.num_items = len(layers_desc)
+        assert self.num_items >= self.num_parts, (
+            "layer number should be greater than number of segments")
+
+    def do_segment(self) -> List[int]:
+        if self.method == "uniform":
+            return self.uniform(self.num_items, self.num_parts)
+        if self.method.startswith("layer:"):
+            # split so each stage has an equal count of the named layer type
+            name = self.method.split(":")[1]
+            weights = [1 if n == name else 0 for n in
+                       (d.layer_func.__name__ if isinstance(d, LayerDesc)
+                        else type(d).__name__ for d in self._layers_desc)]
+            total = sum(weights)
+            assert total % self.num_parts == 0, (
+                f"number of {name} layers ({total}) not divisible by "
+                f"{self.num_parts} stages")
+            per = total // self.num_parts
+            result = [0]
+            seen = 0
+            for i, w in enumerate(weights):
+                seen += w
+                if len(result) < self.num_parts and seen > per * len(result):
+                    result.append(i)
+            result.append(self.num_items)
+            return result
+        raise ValueError(f"unknown segment method {self.method}")
+
+    @staticmethod
+    def uniform(num_items, num_parts) -> List[int]:
+        result = [0] * (num_parts + 1)
+        part_size = np.floor(num_items / num_parts)
+        extra = num_items % num_parts
+        for i in range(1, num_parts + 1):
+            result[i] = int(result[i - 1] + part_size +
+                            (1 if i <= extra else 0))
+        assert result[num_parts] == num_items
+        return result
+
+
+class PipelineLayer(Layer):
+    """Reference: pp_layers.py:123 `PipelineLayer`.
+
+    Holds the full layer list (SPMD traces everything everywhere) plus the
+    stage segmentation. `stage_forward(stage_id)` returns a callable running
+    that stage's slice — consumed by `PipelineParallel`'s shard_map schedule
+    and by `paddle_tpu.distributed.pipeline.pipeline_step`.
+    """
+
+    def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method="uniform",
+                 recompute_interval=0, **kwargs):
+        super().__init__()
+        self._layers_desc = list(layers)
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        if num_stages is None and topology is not None:
+            num_stages = topology.get_dim("pipe")
+        self._num_stages = int(num_stages or 1)
+        seg = SegmentLayers(self._layers_desc, self._num_stages, seg_method)
+        self.segment_parts = seg.do_segment()
+
+        self.run_function: List = []
+        self.shared_layers = {}
+        for i, d in enumerate(self._layers_desc):
+            if isinstance(d, SharedLayerDesc):
+                first_use = d.layer_name not in self.shared_layers
+                if first_use:
+                    self.shared_layers[d.layer_name] = d.build_layer()
+                layer = self.shared_layers[d.layer_name]
+                fwd = d.forward_func
+                if fwd is not None:
+                    lay = layer
+                    self.run_function.append(
+                        lambda x, lay=lay, fwd=fwd: fwd(lay, x))
+                else:
+                    self.run_function.append(layer)
+                if first_use:
+                    # register the tied layer ONCE — a second registration
+                    # would alias its params under two names, splitting the
+                    # tied gradient (each name sees only its own cotangent)
+                    self.add_sublayer(f"shared_{d.layer_name}", layer)
+            elif isinstance(d, LayerDesc):
+                layer = d.build_layer()
+                self.run_function.append(layer)
+                self.add_sublayer(str(i), layer)
+            elif isinstance(d, Layer):
+                self.run_function.append(d)
+                self.add_sublayer(str(i), d)
+            elif callable(d):
+                self.run_function.append(d)
+            else:
+                raise TypeError(f"unsupported layer desc {d!r}")
+
+    @property
+    def num_stages(self):
+        return self._num_stages
+
+    def get_stage_layers(self, stage_id: int):
+        lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
+        return self.run_function[lo:hi]
+
+    def stage_forward(self, stage_id: int) -> Callable:
+        fns = self.get_stage_layers(stage_id)
+
+        def run(x):
+            for fn in fns:
+                x = fn(x)
+            return x
+        return run
+
+    def forward(self, x):
+        # full (non-pipelined) forward — used single-device and for parity
+        # tests against the pipelined schedule
+        for fn in self.run_function:
+            x = fn(x)
+        return x
+
+    def loss(self, output, label):
+        if self._loss_fn is None:
+            raise RuntimeError("PipelineLayer built without loss_fn")
+        return self._loss_fn(output, label)

@@ -1,0 +1,139 @@
+"""Sequence/context parallelism — ring attention + Ulysses (all-to-all).
+
+BEYOND-REFERENCE capability (SURVEY.md §5 "Long-context / sequence
+parallelism: Absent ... The TPU build must therefore add SP/CP"). The only
+reference hook is the `alltoall` collective
+(`operators/collective/alltoall_op.cc`), which is the Ulysses building
+block.
+
+Two schemes over the 'sequence' mesh axis, both used inside
+`jax.shard_map`:
+
+* **ring_attention** — q/k/v sharded on the sequence dim; K/V blocks
+  rotate around the ring via `lax.ppermute` over ICI while each chip
+  accumulates its queries' attention in flash style (running max /
+  normalizer — the S×S score matrix never materializes globally).
+  Communication overlaps compute; memory per chip is O(S/sp · S/sp).
+* **ulysses_attention** — `lax.all_to_all` reshards [B, S/sp, H, D] →
+  [B, S, H/sp, D], runs dense per-head attention locally, then reshards
+  back. Cheaper collectives for moderate S; requires heads % sp == 0.
+
+Both are reverse-differentiable (scan + ppermute/all_to_all transpose
+rules) so they drop straight into training.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def ring_attention(q, k, v, axis_name: str = "sequence",
+                   causal: bool = False, scale: Optional[float] = None):
+    """Blockwise ring attention on per-chip shards.
+
+    q, k, v: [b, s_local, h, d] — the local sequence shard (call inside
+    shard_map with in_specs sharding dim 1 over `axis_name`).
+    Returns [b, s_local, h, d].
+    """
+    sp = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, s, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    # [b, h, s, d] compute layout
+    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale
+    kh0 = jnp.swapaxes(k, 1, 2)
+    vh0 = jnp.swapaxes(v, 1, 2)
+
+    q_pos = idx * s + jnp.arange(s)                      # global q positions
+
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+    def step(carry, i):
+        o, m, l, kh, vh = carry
+        src = (idx - i) % sp                              # block kh holds
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qh,
+                            kh.astype(jnp.float32))
+        if causal:
+            k_pos = src * s + jnp.arange(s)
+            mask = q_pos[:, None] >= k_pos[None, :]       # [sq, sk]
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        m_blk = jnp.max(scores, axis=-1)                  # [b,h,sq]
+        m_new = jnp.maximum(m, m_blk)
+        # guard fully-masked rows (all -inf): keep m finite
+        m_new = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(scores - m_new[..., None])            # masked → exp(-inf)=0
+        corr = jnp.exp(m - m_new)                         # rescale old acc
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vh.astype(jnp.float32))
+        kh_n = lax.ppermute(kh, axis_name, perm)
+        vh_n = lax.ppermute(vh, axis_name, perm)
+        return (o_new, m_new, l_new, kh_n, vh_n), None
+
+    o0 = jnp.zeros((b, h, s, d), jnp.float32)
+    m0 = jnp.full((b, h, s), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, s), jnp.float32)
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, kh0, vh0),
+                                  jnp.arange(sp))
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sequence",
+                      causal: bool = False, scale: Optional[float] = None,
+                      attn_fn=None):
+    """DeepSpeed-Ulysses resharding attention on per-chip shards.
+
+    q, k, v: [b, s_local, h, d]; requires h % sp == 0.
+    """
+    sp = lax.psum(1, axis_name)   # axis size — static at trace time
+    h = q.shape[2]
+    if h % sp != 0:
+        raise ValueError(
+            f"ulysses_attention needs heads ({h}) divisible by the "
+            f"'{axis_name}' axis size ({sp}); use ring attention instead")
+
+    def to_seq(x):   # [b, s/sp, h, d] -> [b, s, h/sp, d]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def to_heads(x):  # [b, s, h/sp, d] -> [b, s/sp, h, d]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qs, ks, vs = to_seq(q), to_seq(k), to_seq(v)
+    if attn_fn is None:
+        from ...nn.functional.attention import _xla_attention
+        out = _xla_attention(qs, ks, vs, None, 0.0, causal, False, scale)
+    else:
+        out = attn_fn(qs, ks, vs)
+    return to_heads(out)
+
+
+def make_sp_attention(mesh, mode: str = "ring", causal: bool = False,
+                      axis_name: str = "sequence"):
+    """Wrap ring/ulysses attention as a global-view function on sequence-
+    sharded [b, s, h, d] arrays via shard_map (other mesh axes stay auto)."""
+    if mode not in ("ring", "ulysses"):
+        raise ValueError(f"mode must be 'ring' or 'ulysses', got {mode!r}")
+    fn = ring_attention if mode == "ring" else ulysses_attention
+    from jax.sharding import PartitionSpec as P
+    spec = P(None, axis_name, None, None)
+
+    inner = partial(fn, axis_name=axis_name, causal=causal)
+    # manualize ONLY the sequence axis — data/model axes stay under GSPMD
+    # (omitting axis_names would manualize every axis and silently
+    # replicate the batch across 'data')
+    wrapped = jax.shard_map(
+        lambda q, k, v: inner(q, k, v),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names={axis_name}, check_vma=False)
+    # partial-manual shard_map (axis_names ⊂ mesh axes) only resolves
+    # inside a jit trace; eager calls misread the unmentioned axes
+    return jax.jit(wrapped)

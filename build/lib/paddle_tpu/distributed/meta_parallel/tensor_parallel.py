@@ -1,0 +1,60 @@
+"""TensorParallel / ShardingParallel model wrappers.
+
+Reference: `fleet/meta_parallel/tensor_parallel.py:25` (broadcasts params +
+inputs across the mp group so every rank starts identical) and
+`meta_parallel/sharding_parallel.py`. Under a single-controller SPMD mesh
+there is nothing to broadcast — parameters are logically global and GSPMD
+places the shards — so the wrappers' job collapses to (a) API parity and
+(b) laying out parameter shardings on the mesh (`shard_parameters`).
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...nn.layer import Layer
+from ..topology import get_mesh_or_none
+
+
+def shard_parameters(layer: Layer, mesh=None):
+    """Place every Parameter on the mesh per its `sharding_spec` (set by the
+    mp_layers; None → replicated). The GSPMD analogue of the reference's
+    param broadcast at wrapper init (tensor_parallel.py:36)."""
+    mesh = mesh or get_mesh_or_none()
+    if mesh is None:
+        return layer
+    for _, p in layer.named_parameters():
+        spec = p.sharding_spec or P()
+        p.value = jax.device_put(p.value, NamedSharding(mesh, spec))
+    for _, b in layer.named_buffers():
+        b.value = jax.device_put(b.value, NamedSharding(mesh, P()))
+    return layer
+
+
+class _MetaParallelBase(Layer):
+    def __init__(self, layers: Layer, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self.add_sublayer("wrapped", layers)
+        shard_parameters(layers)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+
+class TensorParallel(_MetaParallelBase):
+    """Reference: meta_parallel/tensor_parallel.py:25."""
+
+
+class ShardingParallel(_MetaParallelBase):
+    """Reference: meta_parallel/sharding_parallel.py (ZeRO stage-1 wrapper;
+    the optimizer-state sharding itself lives in
+    sharding_optimizer.DygraphShardingOptimizer)."""

@@ -1,0 +1,60 @@
+"""Data-parallel model wrapper.
+
+Mirrors `paddle.DataParallel` (`fluid/dygraph/parallel.py:382`) + the C++
+`Reducer` bucketed-allreduce engine (`imperative/reducer.cc:309-798`).
+
+TPU-native: under pjit/GSPMD, data parallelism is a sharding of the batch
+axis — gradients are reduced by XLA inside the compiled step, fully
+overlapped, so the entire Reducer (bucketing, hooks, comm streams,
+rebuild-order) is unnecessary. This wrapper therefore only (a) annotates the
+intended batch sharding, (b) provides the reference API surface
+(`scale_loss`, `no_sync`, state passthrough).
+"""
+from __future__ import annotations
+
+import contextlib
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..nn.layer import Layer
+from .topology import get_mesh
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        """Reference scales loss by 1/nranks before allreduce; with psum-mean
+        semantics in the compiled step this is identity."""
+        return loss
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Reference: suspend Reducer allreduce for gradient accumulation.
+        Functional grads are not auto-reduced, so this is a parity no-op."""
+        yield
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    def batch_sharding(self) -> NamedSharding:
+        """Sharding for input batches: split dim 0 over the 'data' axis."""
+        return NamedSharding(get_mesh(), PartitionSpec("data"))
+
+
+def shard_batch(batch):
+    """Place a host batch onto the mesh sharded along 'data'."""
+    import jax
+    sharding = NamedSharding(get_mesh(), PartitionSpec("data"))
+    return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)

@@ -1,0 +1,27 @@
+"""Parameter server — host-resident sharded embedding tables.
+
+TPU-native core of the reference's "the one PS" stack (N22):
+  * brpc RPC service (`distributed/service/brpc_ps_server.cc`,
+    `brpc_ps_client.cc`) → a lightweight authenticated TCP message
+    service per trainer process (`multiprocessing.connection`), riding
+    the same host network (DCN) the reference's brpc does;
+  * sparse tables (`distributed/table/common_sparse_table.cc`) →
+    `ShardedEmbeddingTable`: rows sharded round-robin over processes,
+    host-resident numpy storage, server-side optimizer update on push;
+  * async `Communicator` grad sends (`service/communicator.cc`) →
+    `push(..., sync=False)` fire-and-forget worker thread;
+  * `TheOnePSRuntime._init_server/_init_worker` (`the_one_ps.py:434`) →
+    `init_table_service()` from the launcher env contract.
+
+Design note: the dense model trains on-device via the normal compiled
+step; the PS embedding lives OUTSIDE jit — pull rows → jitted dense step
+→ push row grads, exactly the reference's DownpourWorker dataflow
+(`device_worker.h:244`). This is the right split on TPU too: giant
+embedding tables don't fit HBM, and the sparse gather/scatter is
+host-memory-bound, not MXU work.
+"""
+from .table import (ShardedEmbeddingTable, TableService,
+                    init_table_service, shutdown_table_service)
+
+__all__ = ["ShardedEmbeddingTable", "TableService", "init_table_service",
+           "shutdown_table_service"]

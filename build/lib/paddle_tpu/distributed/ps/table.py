@@ -1,0 +1,260 @@
+"""Sharded embedding table + TCP table service.
+
+See package docstring for the reference mapping. Wire protocol: pickled
+(op, table, payload) tuples over `multiprocessing.connection` (length-
+prefixed, HMAC-authenticated by authkey) — the brpc `sendrecv.proto`
+equivalent at test scale.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from multiprocessing.connection import Client, Listener
+from typing import Dict, Optional
+
+import numpy as np
+
+_AUTHKEY_BASE = b"ptpu-ps-"
+_PORT_OFFSET = 200  # launcher endpoints use MASTER_PORT+1+rank; stay clear
+
+
+def _authkey() -> bytes:
+    return _AUTHKEY_BASE + os.environ.get("MASTER_PORT", "0").encode()
+
+
+class _Shard:
+    """This process's rows of one table (owner(id) = id % world,
+    local row = id // world — the reference's round-robin
+    `ps_dispatcher.py` placement)."""
+
+    def __init__(self, name: str, vocab: int, dim: int, rank: int,
+                 world: int, lr: float, seed: int):
+        self.name, self.vocab, self.dim = name, vocab, dim
+        self.rank, self.world, self.lr = rank, world, lr
+        # deterministic per-row init independent of world size: generate
+        # the full table from one seed, keep owned rows (test-scale; a
+        # production shard would stream its rows)
+        full = np.random.RandomState(seed).normal(
+            0.0, 0.02, (vocab, dim)).astype(np.float32)
+        self.data = np.ascontiguousarray(full[rank::world])
+        self._lock = threading.Lock()
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        with self._lock:
+            return self.data[ids // self.world]
+
+    def push(self, ids: np.ndarray, grads: np.ndarray):
+        """Server-side SGD (reference: optimizer runs in the table,
+        `common_sparse_table.cc`); duplicate ids accumulate first."""
+        with self._lock:
+            # scatter-add duplicates, then one update per unique row
+            uniq, inv = np.unique(ids // self.world, return_inverse=True)
+            acc = np.zeros((len(uniq), self.dim), np.float32)
+            np.add.at(acc, inv, grads)
+            self.data[uniq] -= self.lr * acc
+
+
+class TableService:
+    """Per-process PS node: hosts local shards, serves peers, and
+    provides the client-side pull/push over all shards."""
+
+    def __init__(self, rank: int, world: int, port_base: int):
+        self.rank, self.world = rank, world
+        self._ports = [port_base + _PORT_OFFSET + r for r in range(world)]
+        self._shards: Dict[str, _Shard] = {}
+        self._conns: Dict[int, object] = {}
+        self._conn_lock = threading.Lock()
+        self._stop = False
+        self._async_q: "queue.Queue" = queue.Queue()
+        self._listener = None
+        self._threads = []
+        if world > 1:
+            self._listener = Listener(("127.0.0.1", self._ports[rank]),
+                                      authkey=_authkey())
+            t = threading.Thread(target=self._accept_loop, daemon=True)
+            t.start()
+            self._threads.append(t)
+        ta = threading.Thread(target=self._async_push_loop, daemon=True)
+        ta.start()
+        self._threads.append(ta)
+
+    # ---- server side ----------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn):
+        try:
+            while not self._stop:
+                try:
+                    op, table, payload = conn.recv()
+                except (EOFError, OSError):
+                    return
+                shard = self._shards[table]
+                if op == "pull":
+                    conn.send(shard.pull(payload))
+                elif op == "push":
+                    ids, grads = payload
+                    shard.push(ids, grads)
+                    conn.send(b"ok")
+                elif op == "barrier_probe":
+                    conn.send(b"ok")
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # ---- client side ----------------------------------------------------
+
+    def _conn(self, peer: int, timeout_s: float = 60.0):
+        with self._conn_lock:
+            c = self._conns.get(peer)
+            if c is None:
+                # peers come up at their own pace (jax init can take
+                # seconds) — retry with backoff like the reference's brpc
+                # channel connect (`brpc_ps_client.cc` connect retries)
+                import time
+                deadline = time.time() + timeout_s
+                delay = 0.05
+                while True:
+                    try:
+                        c = Client(("127.0.0.1", self._ports[peer]),
+                                   authkey=_authkey())
+                        break
+                    except (ConnectionRefusedError, OSError):
+                        if time.time() > deadline:
+                            raise
+                        time.sleep(delay)
+                        delay = min(delay * 2, 1.0)
+                self._conns[peer] = c
+            return c
+
+    def _rpc(self, peer: int, op: str, table: str, payload):
+        c = self._conn(peer)
+        c.send((op, table, payload))
+        return c.recv()
+
+    def register(self, name: str, vocab: int, dim: int, lr: float = 0.1,
+                 seed: int = 0) -> "ShardedEmbeddingTable":
+        self._shards[name] = _Shard(name, vocab, dim, self.rank,
+                                    self.world, lr, seed)
+        return ShardedEmbeddingTable(self, name, vocab, dim)
+
+    def pull(self, table: str, ids: np.ndarray) -> np.ndarray:
+        """Gather rows for arbitrary global ids (reference:
+        `brpc_ps_client` PullSparse)."""
+        flat = np.asarray(ids).reshape(-1)
+        dim = self._shards[table].dim
+        out = np.empty((flat.size, dim), np.float32)
+        for peer in range(self.world):
+            m = (flat % self.world) == peer
+            if not m.any():
+                continue
+            sub = flat[m]
+            rows = (self._shards[table].pull(sub) if peer == self.rank
+                    else self._rpc(peer, "pull", table, sub))
+            out[m] = rows
+        return out.reshape(tuple(np.shape(ids)) + (dim,))
+
+    def push(self, table: str, ids: np.ndarray, grads: np.ndarray,
+             sync: bool = True):
+        """Scatter row-grads to owners. sync=False queues the send on the
+        communicator thread (reference: async `Communicator` batching,
+        `service/communicator.cc`)."""
+        flat = np.asarray(ids).reshape(-1)
+        g = np.asarray(grads, np.float32).reshape(flat.size, -1)
+        if not sync:
+            self._async_q.put((table, flat, g))
+            return
+        self._push_now(table, flat, g)
+
+    def _push_now(self, table, flat, g):
+        for peer in range(self.world):
+            m = (flat % self.world) == peer
+            if not m.any():
+                continue
+            if peer == self.rank:
+                self._shards[table].push(flat[m], g[m])
+            else:
+                self._rpc(peer, "push", table, (flat[m], g[m]))
+
+    def _async_push_loop(self):
+        while True:
+            item = self._async_q.get()
+            if item is None:
+                return
+            self._push_now(*item)
+            self._async_q.task_done()
+
+    def flush(self):
+        """Drain queued async pushes (reference: Communicator barrier)."""
+        self._async_q.join()
+
+    def shutdown(self):
+        self._stop = True
+        self._async_q.put(None)
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._conn_lock:
+            for c in self._conns.values():
+                try:
+                    c.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+
+
+class ShardedEmbeddingTable:
+    """User handle: pull rows before the compiled dense step, push row
+    grads after it (DownpourWorker dataflow, `device_worker.h:244`)."""
+
+    def __init__(self, service: TableService, name: str, vocab: int,
+                 dim: int):
+        self._svc = service
+        self.name, self.vocab, self.dim = name, vocab, dim
+
+    def pull(self, ids) -> np.ndarray:
+        return self._svc.pull(self.name, np.asarray(ids))
+
+    def push(self, ids, grads, sync: bool = True):
+        self._svc.push(self.name, np.asarray(ids), np.asarray(grads),
+                       sync=sync)
+
+    def flush(self):
+        self._svc.flush()
+
+
+_SERVICE: Optional[TableService] = None
+
+
+def init_table_service() -> TableService:
+    """Build the per-process PS node from the launcher env contract
+    (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / MASTER_PORT — the same
+    vars `the_one_ps.py:434 _init_server` reads)."""
+    global _SERVICE
+    if _SERVICE is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        port = int(os.environ.get("MASTER_PORT", "8476"))
+        _SERVICE = TableService(rank, world, port)
+    return _SERVICE
+
+
+def shutdown_table_service():
+    global _SERVICE
+    if _SERVICE is not None:
+        _SERVICE.shutdown()
+        _SERVICE = None

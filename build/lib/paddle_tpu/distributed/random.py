@@ -1,0 +1,7 @@
+"""Model-parallel RNG tracker re-export (reference:
+`fleet/meta_parallel/parallel_layers/random.py`)."""
+from ..framework.random import (  # noqa: F401
+    RNGStatesTracker,
+    get_rng_state_tracker,
+    model_parallel_random_seed,
+)

@@ -1,0 +1,47 @@
+"""`paddle.distributed.spawn` equivalent (reference:
+python/paddle/distributed/spawn.py — fork/spawn one proc per device with
+the trainer env contract)."""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+
+from .launch import _free_port
+
+
+def _worker(func, rank, nprocs, master_port, env_extra, args):
+    os.environ.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nprocs),
+        "PADDLE_MASTER": "127.0.0.1",
+        "MASTER_ADDR": "127.0.0.1",
+        "MASTER_PORT": str(master_port),
+        **(env_extra or {}),
+    })
+    func(rank, *args)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Launch `func(rank, *args)` in `nprocs` spawned processes.
+    nprocs=-1 (reference default, spawn.py:333) = one per local device."""
+    if nprocs in (-1, 0, None):
+        import jax
+        nprocs = max(1, jax.local_device_count())
+    ctx = mp.get_context("spawn")
+    port = _free_port()
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, rank, nprocs, port,
+                              options.get("env"), tuple(args)),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if not join:
+        return procs
+    for p in procs:
+        p.join()
+    bad = [p.exitcode for p in procs if p.exitcode]
+    if bad:
+        raise RuntimeError(f"spawned process failed with exit code {bad[0]}")
+    return procs

@@ -1,0 +1,137 @@
+"""`paddle.distribution` equivalent (reference:
+python/paddle/distribution.py — Distribution base, Uniform, Normal,
+Categorical; v2.1 surface). Sampling draws from the framework's global
+PRNG stream (`paddle_tpu.seed`); log_prob/entropy are pure jnp."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .framework.random import next_key
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        return jnp.exp(self.log_prob(value))
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """Reference: distribution.py Uniform(low, high)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = jnp.asarray(low, jnp.float32)
+        self.high = jnp.asarray(high, jnp.float32)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.low.shape,
+                                                    self.high.shape)
+        u = jax.random.uniform(next_key(), shape)
+        return self.low + u * (self.high - self.low)
+
+    def log_prob(self, value):
+        value = jnp.asarray(value, jnp.float32)
+        inside = (value >= self.low) & (value < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return jnp.where(inside, lp, -jnp.inf)
+
+    def entropy(self):
+        return jnp.log(self.high - self.low)
+
+
+class Normal(Distribution):
+    """Reference: distribution.py Normal(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        return self.loc + self.scale * jax.random.normal(next_key(), shape)
+
+    def log_prob(self, value):
+        value = jnp.asarray(value, jnp.float32)
+        var = self.scale ** 2
+        return (-((value - self.loc) ** 2) / (2 * var)
+                - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+
+    def kl_divergence(self, other: "Normal"):
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+
+class Categorical(Distribution):
+    """Reference: distribution.py Categorical(logits)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = jnp.asarray(logits, jnp.float32)
+
+    @property
+    def _log_pmf(self):
+        return jax.nn.log_softmax(self.logits, axis=-1)
+
+    def sample(self, shape=(), seed=0):
+        return jax.random.categorical(next_key(), self.logits,
+                                      shape=tuple(shape) +
+                                      self.logits.shape[:-1])
+
+    def log_prob(self, value):
+        value = jnp.asarray(value, jnp.int32)
+        return jnp.take_along_axis(self._log_pmf, value[..., None],
+                                   axis=-1)[..., 0]
+
+    def probabilities(self):
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    def entropy(self):
+        p = self.probabilities()
+        return -jnp.sum(p * self._log_pmf, axis=-1)
+
+    def kl_divergence(self, other: "Categorical"):
+        p = self.probabilities()
+        return jnp.sum(p * (self._log_pmf - other._log_pmf), axis=-1)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self.probs_ = jnp.asarray(probs, jnp.float32)
+        else:
+            self.probs_ = jax.nn.sigmoid(jnp.asarray(logits, jnp.float32))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.probs_.shape
+        return (jax.random.uniform(next_key(), shape) <
+                self.probs_).astype(jnp.float32)
+
+    def log_prob(self, value):
+        v = jnp.asarray(value, jnp.float32)
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+    def entropy(self):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    return p.kl_divergence(q)

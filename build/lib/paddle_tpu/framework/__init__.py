@@ -1,0 +1,11 @@
+"""Framework-level services: RNG state, parameter/pytree utilities, io."""
+from .random import (  # noqa: F401
+    RNGStatesTracker,
+    get_rng_state,
+    get_rng_state_tracker,
+    model_parallel_random_seed,
+    next_key,
+    rng_guard,
+    seed,
+    set_rng_state,
+)

@@ -1,0 +1,256 @@
+"""High-level `paddle.Model` API.
+
+Mirrors `python/paddle/hapi/model.py:878` (prepare/fit/evaluate/predict,
+callbacks). The dygraph/static adapter pair of the reference collapses into
+one path: a jitted train step over the layer's functional form — compiled
+once, reused every batch.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.random import next_key, rng_guard
+from ..io import DataLoader
+from ..metric import Metric
+from ..nn.layer import (
+    Layer,
+    buffer_state,
+    functional_call,
+    load_state,
+    trainable_state,
+)
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+        self._eval_step = None
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, list) else \
+                [metrics]
+        if optimizer is not None:
+            self._rekey_optimizer()
+        self._build_steps()
+
+    def _rekey_optimizer(self):
+        """Rekey the optimizer's param map to the network's structured
+        names (dot paths from named_parameters).
+
+        One canonical key scheme end to end: train_batch seeds optimizer
+        state by structured pytree names, so _ensure_state/state_dict/
+        set_state_dict must use the same keys or a save+load round trip
+        silently restores zero optimizer slots (ADVICE round 1)."""
+        from collections import OrderedDict
+        opt = self._optimizer
+        if opt._accumulators is not None or not getattr(opt, "_params", None):
+            return  # state already materialized under the old keys
+        by_id = {id(p): n for n, p in self.network.named_parameters()}
+        opt._params = OrderedDict(
+            (by_id.get(id(p), key), p) for key, p in opt._params.items())
+
+    def _build_steps(self):
+        net, loss_layer, opt = self.network, self._loss, self._optimizer
+
+        def train_step(params, buffers, opt_state, key, *batch):
+            *inputs, label = batch
+
+            def loss_fn(p):
+                with rng_guard(key):
+                    out, new_buf = functional_call(net, p, *inputs,
+                                                   buffers=buffers)
+                    loss = loss_layer(out, label)
+                return loss, (out, new_buf)
+
+            (loss, (out, new_buf)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opt_state = opt.apply(params, grads, opt_state)
+            return loss, out, new_params, new_buf, new_opt_state
+
+        def eval_step(params, buffers, *batch):
+            *inputs, label = batch
+            out, _ = functional_call(net, params, *inputs, buffers=buffers)
+            loss = loss_layer(out, label) if loss_layer is not None else \
+                jnp.zeros(())
+            return loss, out
+
+        self._train_step = jax.jit(train_step, donate_argnums=(0, 2))
+        self._eval_step = jax.jit(eval_step)
+
+    def train_batch(self, inputs, labels=None):
+        net = self.network
+        net.train()
+        params = trainable_state(net)
+        # optimizer state must be keyed by the same structured names as the
+        # functional params pytree (p.name keys from a bare parameters list
+        # don't match — caught by /verify driving Model.fit)
+        if self._optimizer._accumulators is None:
+            self._optimizer._accumulators = self._optimizer.init_state(params)
+        buffers = buffer_state(net)
+        batch = list(inputs if isinstance(inputs, (list, tuple))
+                     else [inputs])
+        if labels is not None:
+            batch.append(labels if not isinstance(labels, (list, tuple))
+                         else labels[0])
+        loss, out, new_params, new_buf, new_opt_state = self._train_step(
+            params, buffers, self._optimizer._accumulators, next_key(),
+            *batch)
+        load_state(net, new_params, new_buf)
+        self._optimizer._accumulators = new_opt_state
+        metrics = self._update_metrics(out, batch[-1])
+        return float(loss), metrics
+
+    def eval_batch(self, inputs, labels=None):
+        net = self.network
+        net.eval()
+        params = {n: p.value for n, p in net.named_parameters()}
+        buffers = buffer_state(net)
+        batch = list(inputs if isinstance(inputs, (list, tuple))
+                     else [inputs])
+        if labels is not None:
+            batch.append(labels if not isinstance(labels, (list, tuple))
+                         else labels[0])
+        loss, out = self._eval_step(params, buffers, *batch)
+        metrics = self._update_metrics(out, batch[-1])
+        return float(loss), metrics
+
+    def predict_batch(self, inputs):
+        net = self.network
+        net.eval()
+        params = {n: p.value for n, p in net.named_parameters()}
+        buffers = buffer_state(net)
+        out, _ = functional_call(net, params,
+                                 *(inputs if isinstance(inputs, (list, tuple))
+                                   else [inputs]), buffers=buffers)
+        return out
+
+    def _update_metrics(self, out, label):
+        res = {}
+        for m in self._metrics:
+            m.update(*_as_tuple(m.compute(out, label)))
+            res[m.name()] = m.accumulate()
+        return res
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None):
+        from .callbacks import EarlyStopping, config_callbacks
+        loader = train_data if isinstance(train_data, DataLoader) else \
+            DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                       drop_last=drop_last, num_workers=num_workers)
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                log_freq=log_freq, verbose=verbose,
+                                save_freq=save_freq, save_dir=save_dir,
+                                metrics=[m.name() for m in self._metrics])
+        cbks.on_train_begin()
+        history = []
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            cbks.on_epoch_begin(epoch)
+            losses = []
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                batch = list(batch)
+                loss, metrics = self.train_batch(batch[:-1], batch[-1])
+                losses.append(loss)
+                logs = {"loss": loss, **metrics}
+                cbks.on_train_batch_end(step, logs)
+            epoch_logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+            cbks.on_epoch_end(epoch, epoch_logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                res = self.evaluate(eval_data, batch_size=batch_size,
+                                    verbose=verbose)
+                cbks.on_eval_end(res)
+                # eval keys prefixed (reference hapi: eval_loss/eval_*) so
+                # the train loss in history is never clobbered
+                for k, v in res.items():
+                    if isinstance(v, (list, tuple)) and len(v) == 1:
+                        v = v[0]
+                    epoch_logs[f"eval_{k}"] = v
+            history.append(epoch_logs)
+            if any(getattr(c, "stopped", False)
+                   for c in cbks.callbacks
+                   if isinstance(c, EarlyStopping)):
+                break
+        cbks.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else \
+            DataLoader(eval_data, batch_size=batch_size,
+                       num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        metrics = {}
+        for batch in loader:
+            batch = list(batch)
+            loss, metrics = self.eval_batch(batch[:-1], batch[-1])
+            losses.append(loss)
+        result = {"loss": [float(np.mean(losses))] if losses else [0.0]}
+        result.update(metrics)
+        if verbose:
+            print(f"Eval: {result}", flush=True)
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None):
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size,
+                       num_workers=num_workers)
+        outputs = []
+        for batch in loader:
+            batch = list(batch) if isinstance(batch, (list, tuple)) else \
+                [batch]
+            outputs.append(self.predict_batch(batch))
+        if stack_outputs:
+            return [jnp.concatenate(outputs, axis=0)]
+        return outputs
+
+    def save(self, path, training=True):
+        from ..framework.io import save as _save
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as _load
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        import os
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        total = 0
+        lines = []
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape))
+            total += n
+            lines.append(f"{name:60s} {str(p.shape):24s} {n}")
+        report = "\n".join(lines) + f"\nTotal params: {total:,}"
+        print(report)
+        return {"total_params": total}
+
+
+def _as_tuple(x):
+    return x if isinstance(x, tuple) else (x,)

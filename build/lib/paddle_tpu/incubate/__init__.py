@@ -1,0 +1,9 @@
+"""`paddle.incubate` equivalent."""
+from . import optimizer  # noqa: F401
+from .optimizer import (  # noqa: F401
+    ExponentialMovingAverage,
+    GradientMergeOptimizer,
+    LookAhead,
+    ModelAverage,
+)
+from . import checkpoint  # noqa: F401

@@ -1,0 +1,107 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Reference: `fluid/contrib/sparsity/` (+ `python/paddle/fluid/contrib/
+sparsity/asp.py` ASPHelper): prune weights to the 2:4 pattern, keep the
+masks, and re-apply them after each optimizer step so training stays
+sparse. On TPU the n:m pattern has no sparse-MXU path (that's an Ampere
+tensor-core feature); the capability is kept for model-compression parity
+— masks are plain multiplies XLA fuses into the surrounding ops.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def create_mask(w, n: int = 2, m: int = 4):
+    """Keep the n largest-|w| entries in every group of m along the last
+    dim (reference: sparsity/utils.py get_mask_2d_best / 1d)."""
+    arr = np.asarray(w)
+    if arr.ndim < 1 or arr.shape[-1] % m != 0:
+        return np.ones_like(arr, dtype=arr.dtype)
+    flat = arr.reshape(-1, m)
+    order = np.argsort(-np.abs(flat), axis=1)
+    mask = np.zeros_like(flat)
+    rows = np.arange(flat.shape[0])[:, None]
+    mask[rows, order[:, :n]] = 1.0
+    return mask.reshape(arr.shape).astype(arr.dtype)
+
+
+def check_mask_1d(mat, n: int = 2, m: int = 4) -> bool:
+    arr = np.asarray(mat)
+    if arr.shape[-1] % m != 0:
+        return False
+    groups = (arr.reshape(-1, m) != 0).sum(axis=1)
+    return bool((groups <= n).all())
+
+
+def calculate_density(mat) -> float:
+    arr = np.asarray(mat)
+    return float((arr != 0).sum() / arr.size)
+
+
+class ASPHelper:
+    """Reference: sparsity/asp.py ASPHelper — tracks per-param masks."""
+
+    _masks: Dict[int, jnp.ndarray] = {}
+
+    @classmethod
+    def prune_model(cls, layer, n: int = 2, m: int = 4,
+                    mask_algo: str = "mask_1d", with_mask: bool = True):
+        """Prune every supported weight (2-D+ matmul/conv weights) of the
+        layer in place; record masks for re-application."""
+        pruned = 0
+        for name, p in layer.named_parameters():
+            if not p.trainable or len(p.shape) < 2:
+                continue
+            if p.shape[-1] % m != 0:
+                continue
+            mask = create_mask(p.value, n, m)
+            p.value = p.value * jnp.asarray(mask)
+            if with_mask:
+                cls._masks[id(p)] = jnp.asarray(mask)
+            pruned += 1
+        return pruned
+
+    @classmethod
+    def reapply_masks(cls, optimizer) -> None:
+        for _, p in optimizer._params.items():
+            mask = cls._masks.get(id(p))
+            if mask is not None:
+                p.value = p.value * mask
+
+    @classmethod
+    def reset(cls):
+        cls._masks.clear()
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True):
+    """Reference: paddle.static.sparsity.prune_model (2.1 surface)."""
+    return ASPHelper.prune_model(model, n, m, mask_algo, with_mask)
+
+
+def decorate(optimizer):
+    """Reference: sparsity.decorate — wrap the optimizer so masks are
+    re-applied after each step (keeps pruned entries at zero)."""
+
+    class _ASPOptimizer:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, item):
+            return getattr(self._inner, item)
+
+        def step(self, grads=None):
+            out = self._inner.step(grads)
+            ASPHelper.reapply_masks(self._inner)
+            return out
+
+        def minimize(self, *args, **kw):
+            out = self._inner.minimize(*args, **kw)
+            ASPHelper.reapply_masks(self._inner)
+            return out
+
+    return _ASPOptimizer(optimizer)

@@ -1,0 +1,83 @@
+"""Auto-checkpoint (reference:
+`fluid/incubate/checkpoint/auto_checkpoint.py:71` — epoch-granular
+checkpoint/resume keyed by a run id, stored through the FS abstraction;
+enabled by env `PADDLE_RUNNING_ENV=PADDLE_EDL_AUTO_CHECKPOINT`).
+
+`train_epoch_range(max_epochs)` yields the epoch numbers left to run: on
+restart with the same run id it resumes after the last completed epoch.
+Model/optimizer state is attached via `acp._save_handlers` (register a
+layer/optimizer with `add_handler`) and snapshotted per epoch.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator, Optional
+
+from ..distributed.fleet.utils.fs import FS, LocalFS
+
+
+class _AcpState:
+    def __init__(self):
+        self.fs: FS = LocalFS()
+        self.root = os.environ.get("PADDLE_EDL_FS_CACHE",
+                                   "/tmp/paddle_tpu_auto_checkpoint")
+        self.run_id = os.environ.get("PADDLE_JOB_ID", "default_run")
+        self.handlers = []  # (name, obj with state_dict/set_state_dict)
+
+
+_acp = _AcpState()
+
+
+def _enabled() -> bool:
+    return os.environ.get("PADDLE_RUNNING_ENV") == \
+        "PADDLE_EDL_AUTO_CHECKPOINT"
+
+
+def add_handler(name: str, obj):
+    """Register a Layer/Optimizer to snapshot each epoch."""
+    _acp.handlers.append((name, obj))
+
+
+def _ckpt_dir() -> str:
+    return os.path.join(_acp.root, _acp.run_id)
+
+
+def _meta_path() -> str:
+    return os.path.join(_ckpt_dir(), "meta.json")
+
+
+def _save_epoch(epoch: int):
+    from ..framework.io import save
+    d = _ckpt_dir()
+    _acp.fs.mkdirs(d)
+    for name, obj in _acp.handlers:
+        save(obj.state_dict(), os.path.join(d, f"{name}.pdparams"))
+    with open(_meta_path(), "w") as f:
+        json.dump({"epoch": epoch}, f)
+
+
+def _restore() -> int:
+    from ..framework.io import load
+    if not os.path.exists(_meta_path()):
+        return -1
+    with open(_meta_path()) as f:
+        epoch = json.load(f)["epoch"]
+    d = _ckpt_dir()
+    for name, obj in _acp.handlers:
+        p = os.path.join(d, f"{name}.pdparams")
+        if os.path.exists(p):
+            obj.set_state_dict(load(p))
+    return epoch
+
+
+def train_epoch_range(max_epoch_num: int,
+                      save_checkpoint_inter: int = 1) -> Iterator[int]:
+    """Reference: auto_checkpoint.py `acp.train_epoch_range`."""
+    start = 0
+    if _enabled():
+        start = _restore() + 1
+    for epoch in range(start, max_epoch_num):
+        yield epoch
+        if _enabled() and (epoch + 1) % save_checkpoint_inter == 0:
+            _save_epoch(epoch)

@@ -1,0 +1,205 @@
+"""Incubate optimizers.
+
+Reference: `python/paddle/incubate/optimizer/lookahead.py:26`,
+`modelaverage.py:27`, plus the static-graph program-rewriting optimizers
+`ExponentialMovingAverage` (`fluid/optimizer.py:3882`) and
+`GradientMergeOptimizer` (`fluid/optimizer.py:6141`). All are wrappers
+over an inner optimizer operating on the params pytree — no program
+rewriting exists; the transform is plain function composition.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..optimizer.optimizer import Optimizer
+
+
+class _Wrapper:
+    def __init__(self, inner: Optimizer):
+        self._inner = inner
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
+class _AppliedGuard:
+    """Returned by apply(): usable as a context manager or ignored (then
+    call restore() manually). Shared by ModelAverage and EMA."""
+
+    def __init__(self, owner, need_restore: bool):
+        self._owner = owner
+        self._need_restore = need_restore
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if self._need_restore:
+            self._owner.restore()
+        return False
+
+
+def _swap_in(params: Dict, values: Dict) -> Dict:
+    """Write `values` into Parameter slots, returning the backup."""
+    backup = {n: p.value for n, p in params.items()}
+    for n, p in params.items():
+        if n in values:
+            p.value = values[n]
+    return backup
+
+
+def _swap_back(params: Dict, backup: Optional[Dict]):
+    if backup is not None:
+        for n, p in params.items():
+            p.value = backup[n]
+
+
+class LookAhead(_Wrapper):
+    """Reference: lookahead.py:26 — slow/fast weights: every k steps,
+    slow += alpha * (fast - slow); fast ← slow."""
+
+    def __init__(self, inner_optimizer: Optimizer, alpha=0.5, k=5,
+                 name=None):
+        super().__init__(inner_optimizer)
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._slow: Optional[Dict] = None
+        self._k_count = 0
+
+    def step(self, grads=None):
+        inner = self._inner
+        if self._slow is None:
+            self._slow = {n: p.value for n, p in inner._params.items()}
+        inner.step(grads)
+        self._k_count += 1
+        if self._k_count % self.k == 0:
+            for n, p in inner._params.items():
+                slow = self._slow[n] + self.alpha * (p.value - self._slow[n])
+                self._slow[n] = slow
+                p.value = slow
+
+    def minimize(self, loss_fn, *args):
+        from ..nn.layer import functional_call, trainable_state
+        inner = self._inner
+        assert inner._layer is not None
+
+        def wrapped(params):
+            out, _ = functional_call(inner._layer, params, *args)
+            return out if jnp.ndim(out) == 0 else jnp.sum(out)
+
+        loss, grads = jax.value_and_grad(wrapped)(
+            trainable_state(inner._layer))
+        self.step(grads)
+        return loss
+
+
+class ModelAverage(_Wrapper):
+    """Reference: modelaverage.py:27 — running average of params applied
+    at eval time via `apply()`/`restore()`."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 inner_optimizer: Optional[Optimizer] = None, name=None):
+        if inner_optimizer is None:
+            from ..optimizer.optimizer import SGD
+            inner_optimizer = SGD(parameters=parameters)
+        super().__init__(inner_optimizer)
+        self._sum: Optional[Dict] = None
+        self._count = 0
+        self._total_steps = 0
+        self._guard = None
+        self.average_window_rate = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+
+    def _window_limit(self) -> int:
+        """Reference semantics (fluid/optimizer.py ModelAverage): the
+        window holds ~rate * total_updates steps, clamped to
+        [min_average_window, max_average_window]."""
+        want = int(self.average_window_rate * max(1, self._total_steps))
+        return max(self.min_average_window,
+                   min(self.max_average_window, want)) or 1
+
+    def step(self, grads=None):
+        self._inner.step(grads)
+        self._total_steps += 1
+        ps = self._inner._params
+        if self._sum is None or self._count >= self._window_limit():
+            self._sum = {n: jnp.zeros_like(p.value) for n, p in ps.items()}
+            self._count = 0
+        for n, p in ps.items():
+            self._sum[n] = self._sum[n] + p.value
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        ps = self._inner._params
+        avg = {} if not self._count else \
+            {n: self._sum[n] / self._count for n in ps}
+        self._backup = _swap_in(ps, avg)
+        return _AppliedGuard(self, need_restore)
+
+    def restore(self, executor=None):
+        _swap_back(self._inner._params, getattr(self, "_backup", None))
+        self._backup = None
+
+
+class ExponentialMovingAverage:
+    """Reference: fluid/optimizer.py:3882 — EMA of params with
+    apply/restore guards."""
+
+    def __init__(self, decay=0.999, thres_steps=None, parameters=None,
+                 layer=None, name=None):
+        from ..nn.layer import Layer
+        self.decay = float(decay)
+        if isinstance(parameters, Layer) or layer is not None:
+            lay = layer if layer is not None else parameters
+            self._params = {n: p for n, p in lay.named_parameters()
+                            if p.trainable}
+        else:
+            self._params = {p.name or f"p{i}": p
+                            for i, p in enumerate(parameters or [])}
+        self._ema = {n: p.value for n, p in self._params.items()}
+        self._backup = None
+
+    def update(self):
+        d = self.decay
+        for n, p in self._params.items():
+            self._ema[n] = d * self._ema[n] + (1.0 - d) * p.value
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = _swap_in(self._params, self._ema)
+        return _AppliedGuard(self, need_restore)
+
+    def restore(self, executor=None):
+        _swap_back(self._params, self._backup)
+        self._backup = None
+
+
+class GradientMergeOptimizer(_Wrapper):
+    """Reference: fluid/optimizer.py:6141 (and the
+    GradientMergeOptimizer meta-optimizer) — accumulate grads for k_steps
+    micro-steps, then apply once."""
+
+    def __init__(self, inner_optimizer: Optimizer, k_steps=1, avg=True):
+        super().__init__(inner_optimizer)
+        self.k_steps = int(k_steps)
+        self.avg = avg
+        self._acc: Optional[Dict] = None
+        self._n = 0
+
+    def step(self, grads):
+        if self._acc is None:
+            self._acc = {k: jnp.zeros_like(v) for k, v in grads.items()}
+        for k, v in grads.items():
+            self._acc[k] = self._acc[k] + v
+        self._n += 1
+        if self._n >= self.k_steps:
+            g = self._acc
+            if self.avg:
+                g = {k: v / self._n for k, v in g.items()}
+            self._inner.step(g)
+            self._acc = None
+            self._n = 0

@@ -112,49 +112,6 @@ class Predictor:
                          for i, o in enumerate(outs)}
         return [np.asarray(o) for o in outs]
 
-    def warmup(self, *example_inputs, block: bool = True):
-        """Ahead-of-time compile for the given input shapes/dtypes.
-
-        The reference predictor pays its optimization cost in
-        `OptimizeInferenceProgram` at load; XLA pays at first run per
-        shape. `warmup` moves that cost to deployment init: run once on
-        zeros with the serving shapes so the compiled executable is
-        cached before traffic arrives.
-        """
-        zeros = [np.zeros(np.asarray(a).shape,
-                          np.asarray(a).dtype) if not hasattr(a, "shape")
-                 else np.zeros(tuple(a.shape), getattr(a, "dtype",
-                                                       np.float32))
-                 for a in example_inputs]
-        outs = self._layer(*zeros)
-        if block:
-            jax.block_until_ready(outs)
-        return self
-
-    def clone(self) -> "Predictor":
-        """Share the loaded model (and XLA compile cache) — reference
-        `AnalysisPredictor::Clone` for multi-thread serving."""
-        p = object.__new__(Predictor)
-        p._layer = self._layer
-        p._feeds = {}
-        p._outputs = {}
-        return p
-
-
-class PredictorPool:
-    """Reference: `paddle_infer::services::PredictorPool` — N cloned
-    predictors over one loaded model for concurrent serving threads."""
-
-    def __init__(self, config: Config, size: int = 1):
-        first = Predictor(config)
-        self._preds = [first] + [first.clone() for _ in range(size - 1)]
-
-    def retrieve(self, idx: int) -> Predictor:
-        return self._preds[idx]
-
-    def __len__(self):
-        return len(self._preds)
-
 
 def create_predictor(config: Config) -> Predictor:
     """Reference: CreatePaddlePredictor (`analysis_predictor.cc:1183`)."""

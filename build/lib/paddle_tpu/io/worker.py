@@ -1,0 +1,256 @@
+"""Multiprocess DataLoader workers — process pool + shared-memory batch
+transport + liveness watchdog.
+
+Mirrors the reference's worker stack:
+  * worker processes spawned per loader
+    (`fluid/dataloader/dataloader_iter.py:317`);
+  * `_worker_loop` pulling index batches and pushing results
+    (`fluid/dataloader/worker.py:251`);
+  * cross-process tensors via shared memory
+    (`memory/allocation/mmap_allocator.cc`);
+  * SIGCHLD watchdog killing the job when a worker dies
+    (`dataloader_iter.py` `_set_SIGCHLD_handler`).
+
+TPU-native differences: results are numpy batches (device transfer happens
+in the parent's double-buffer stage, `dataloader.py __iter__`), the
+watchdog is a poll on `Process.is_alive()` instead of a process-global
+SIGCHLD handler (no global signal state from library code), and a killed
+worker is *respawned* with its in-flight batches re-dispatched rather than
+aborting the epoch.
+
+Workers are forked, so the dataset needn't be picklable (the reference
+relies on the same fork semantics on Linux). Children must not touch jax:
+decode/collate is numpy-land; anything device-side stays in the parent.
+"""
+from __future__ import annotations
+
+import collections
+import multiprocessing as mp
+import os
+import queue as pyqueue
+import threading
+import traceback
+from multiprocessing import shared_memory
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+_SHM_MIN_BYTES = 1 << 14  # arrays below this ship pickled (shm setup cost)
+
+
+class _ShmRef:
+    """Descriptor for an array parked in a shared-memory segment."""
+    __slots__ = ("name", "shape", "dtype")
+
+    def __init__(self, name, shape, dtype):
+        self.name, self.shape, self.dtype = name, shape, dtype
+
+    def __reduce__(self):
+        return (_ShmRef, (self.name, self.shape, self.dtype))
+
+
+def _pack(obj, use_shm: bool):
+    if isinstance(obj, np.ndarray) and use_shm \
+            and obj.nbytes >= _SHM_MIN_BYTES:
+        seg = shared_memory.SharedMemory(create=True, size=obj.nbytes)
+        np.ndarray(obj.shape, obj.dtype, buffer=seg.buf)[...] = obj
+        ref = _ShmRef(seg.name, obj.shape, str(obj.dtype))
+        seg.close()  # parent unlinks after reading
+        return ref
+    if isinstance(obj, tuple):
+        return tuple(_pack(o, use_shm) for o in obj)
+    if isinstance(obj, list):
+        return [_pack(o, use_shm) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _pack(v, use_shm) for k, v in obj.items()}
+    return obj
+
+
+def _unpack(obj):
+    if isinstance(obj, _ShmRef):
+        seg = shared_memory.SharedMemory(name=obj.name)
+        try:
+            arr = np.ndarray(obj.shape, np.dtype(obj.dtype),
+                             buffer=seg.buf).copy()
+        finally:
+            seg.close()
+            try:
+                seg.unlink()
+            except FileNotFoundError:
+                pass
+        return arr
+    if isinstance(obj, tuple):
+        return tuple(_unpack(o) for o in obj)
+    if isinstance(obj, list):
+        return [_unpack(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _unpack(v) for k, v in obj.items()}
+    return obj
+
+
+def _worker_loop(dataset, collate_fn, index_queue, result_queue,
+                 use_shm: bool, worker_init_fn, worker_id: int):
+    """Child body (reference `worker.py:251 _worker_loop`)."""
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(worker_id)
+        while True:
+            item = index_queue.get()
+            if item is None:
+                return
+            bidx, indices = item
+            try:
+                batch = collate_fn([dataset[i] for i in indices])
+                result_queue.put((bidx, worker_id,
+                                  _pack(batch, use_shm), None))
+            except Exception:
+                result_queue.put((bidx, worker_id, None,
+                                  traceback.format_exc()))
+    except KeyboardInterrupt:
+        pass
+
+
+class WorkerDied(RuntimeError):
+    pass
+
+
+class MultiprocessBatchIterator:
+    """Ordered batch stream over forked worker processes.
+
+    Dispatches up to `prefetch` batches per worker, reassembles results in
+    batch order, respawns dead workers (re-dispatching their in-flight
+    batches) up to `max_respawns` times.
+    """
+
+    def __init__(self, dataset, collate_fn, index_batches: Sequence,
+                 num_workers: int, prefetch: int = 2, use_shm: bool = True,
+                 worker_init_fn: Optional[Callable] = None,
+                 max_respawns: int = 3, poll_s: float = 0.2,
+                 timeout_s: float = 120.0):
+        self._dataset = dataset
+        self._collate = collate_fn
+        self._work = list(index_batches)
+        self._n = num_workers
+        self._prefetch = max(prefetch, 1)
+        self._use_shm = use_shm
+        self._init_fn = worker_init_fn
+        self._max_respawns = max_respawns
+        self._poll_s = poll_s
+        self._timeout_s = timeout_s
+        self._ctx = mp.get_context("fork")
+
+    # -- worker lifecycle ------------------------------------------------
+
+    def _spawn(self, wid: int):
+        iq = self._ctx.Queue()
+        p = self._ctx.Process(
+            target=_worker_loop,
+            args=(self._dataset, self._collate, iq, self._result_q,
+                  self._use_shm, self._init_fn, wid),
+            daemon=True)
+        p.start()
+        self._procs[wid] = p
+        self._index_qs[wid] = iq
+        self._inflight[wid] = set()
+
+    def _dispatch_specific(self, wid: int, bidx: int):
+        self._index_qs[wid].put((bidx, self._work[bidx]))
+        self._inflight[wid].add(bidx)
+
+    def _fill(self, wid: int):
+        """Top worker `wid` up to its prefetch window from pending work."""
+        while len(self._inflight[wid]) < self._prefetch:
+            if self._pending:
+                b = self._pending.popleft()
+            elif self._next_dispatch < len(self._work):
+                b = self._next_dispatch
+                self._next_dispatch += 1
+            else:
+                return
+            self._dispatch_specific(wid, b)
+
+    def _watchdog(self):
+        """Detect dead workers; respawn + re-dispatch their in-flight
+        batches (reference aborts via SIGCHLD; we recover)."""
+        for wid, p in list(self._procs.items()):
+            if p.is_alive():
+                continue
+            lost = self._inflight.pop(wid, set())
+            if self._respawns >= self._max_respawns:
+                raise WorkerDied(
+                    f"DataLoader worker {wid} died (exit "
+                    f"{p.exitcode}) and respawn budget exhausted")
+            self._respawns += 1
+            for b in sorted(lost, reverse=True):
+                self._pending.appendleft(b)
+            self._spawn(wid)
+            self._fill(wid)
+
+    # -- iteration -------------------------------------------------------
+
+    def __iter__(self):
+        self._result_q = self._ctx.Queue()
+        self._procs = {}
+        self._index_qs = {}
+        self._inflight = {}
+        self._pending = collections.deque()
+        self._next_dispatch = 0
+        self._respawns = 0
+        reorder = {}
+        nxt = 0
+        try:
+            for wid in range(self._n):
+                self._spawn(wid)
+                self._fill(wid)
+            waited = 0.0
+            while nxt < len(self._work):
+                if nxt in reorder:
+                    yield reorder.pop(nxt)
+                    nxt += 1
+                    continue
+                try:
+                    bidx, wid, payload, err = self._result_q.get(
+                        timeout=self._poll_s)
+                except pyqueue.Empty:
+                    waited += self._poll_s
+                    if waited > self._timeout_s:
+                        raise TimeoutError(
+                            f"DataLoader: no batch for {waited:.0f}s "
+                            f"(waiting for batch {nxt})")
+                    self._watchdog()
+                    continue
+                waited = 0.0
+                if err is not None:
+                    raise RuntimeError(
+                        f"DataLoader worker {wid} failed:\n{err}")
+                self._inflight.get(wid, set()).discard(bidx)
+                if wid in self._procs and self._procs[wid].is_alive():
+                    self._fill(wid)
+                if bidx >= nxt and bidx not in reorder:
+                    reorder[bidx] = _unpack(payload)
+                else:
+                    _unpack(payload)  # duplicate after respawn: free shm
+        finally:
+            self._shutdown()
+
+    def _shutdown(self):
+        for wid, q in self._index_qs.items():
+            try:
+                q.put(None)
+            except Exception:
+                pass
+        for p in self._procs.values():
+            p.join(timeout=1.0)
+        for p in self._procs.values():
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=1.0)
+        # drain leftover results so their shm segments get unlinked
+        try:
+            while True:
+                _, _, payload, _ = self._result_q.get_nowait()
+                if payload is not None:
+                    _unpack(payload)
+        except Exception:
+            pass
+        self._result_q.close()

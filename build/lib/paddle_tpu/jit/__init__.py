@@ -1,0 +1,204 @@
+"""`paddle.jit` equivalent: to_static, save, load.
+
+Mirrors the reference's dy2static stack (`dygraph_to_static/
+program_translator.py:232` StaticFunction/ProgramCache, `jit.save`). The
+TPU design is radically simpler: a "static graph" IS a jax trace, so
+`to_static` = shape-specialized `jax.jit` over the layer's functional form —
+no AST rewriting. Python control flow on traced values fails loudly at trace
+time (same contract as the reference's unsupported-syntax errors); use
+`lax.cond`/`lax.scan` in model code.
+
+`jit.save` exports (a) params + buffers via `paddle_tpu.save` and (b) the
+compiled computation as StableHLO via `jax.export` for inference deployment
+(reference: `save_inference_model` ProgramDesc + params).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.io import load as _load_state
+from ..framework.io import save as _save_state
+from ..nn.layer import Layer, buffer_state, functional_call, trainable_state
+from ..static.input_spec import InputSpec
+
+
+class StaticFunction:
+    """Reference: program_translator.py StaticFunction — per-input-signature
+    compiled cache (`ProgramCache` ≈ jax.jit's trace cache)."""
+
+    def __init__(self, function: Callable, input_spec=None, layer=None):
+        self._function = function  # the ORIGINAL bound forward
+        self._input_spec = input_spec
+        self._layer = layer
+        if layer is not None:
+            from ..nn.layer import _slots
+
+            def fn(params, buffers, *args, **kwargs):
+                # swap params in and call the captured original forward —
+                # NOT layer(...), whose forward attr is shadowed by this
+                # StaticFunction (would recurse).
+                slots = _slots(layer)
+                saved = {k: s.value for k, s in slots.items()}
+                try:
+                    for k, v in {**params, **buffers}.items():
+                        if k in slots:
+                            slots[k].value = v
+                    out = function(*args, **kwargs)
+                    new_buffers = {n: b.value
+                                   for n, b in layer.named_buffers()}
+                    return out, new_buffers
+                finally:
+                    for k, s in slots.items():
+                        s.value = saved[k]
+            self._jitted = jax.jit(fn)
+        else:
+            self._jitted = jax.jit(function)
+
+    def __call__(self, *args, **kwargs):
+        if self._layer is not None:
+            params = {n: p.value for n, p in
+                      self._layer.named_parameters()}
+            buffers = buffer_state(self._layer)
+            out, new_buffers = self._jitted(params, buffers, *args, **kwargs)
+            from ..nn.layer import load_state
+            load_state(self._layer, {}, new_buffers)
+            return out
+        return self._jitted(*args, **kwargs)
+
+    @property
+    def code(self):
+        import inspect
+        return inspect.getsource(self._function)
+
+    def concrete_program(self, *args):
+        return jax.make_jaxpr(self._function)(*args)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None):
+    """`@paddle.jit.to_static` equivalent."""
+    def decorate(fn_or_layer):
+        if isinstance(fn_or_layer, Layer):
+            sf = StaticFunction(fn_or_layer.forward, input_spec,
+                                layer=fn_or_layer)
+            # Layer.__call__ dispatches through self.forward (instance
+            # lookup), so shadowing forward routes calls into the jit cache;
+            # shadowing __call__ would be ignored (type-level lookup).
+            fn_or_layer.forward = sf
+            fn_or_layer._static_function = sf
+            return fn_or_layer
+        return StaticFunction(fn_or_layer, input_spec)
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def _specs_to_abstract(input_spec):
+    """InputSpec dims of None/-1 become jax.export symbolic dims so the
+    exported StableHLO stays shape-polymorphic (the reference's ProgramDesc
+    keeps -1 dims the same way).
+
+    Symbol naming: dynamic axis-0 dims share one 'batch' symbol (inputs and
+    labels almost always co-vary there); other dynamic dims get
+    per-(arg,axis) symbols. For args whose leading dims are independent,
+    pass a string as the dim — e.g. InputSpec(["n", 4]) — to name the
+    symbol explicitly (equal names ⇒ tied, distinct ⇒ free)."""
+    from jax import export as jax_export
+    out = []
+    scope = jax_export.SymbolicScope()  # one scope for all args
+
+    def dim_sym(i, j, d):
+        if isinstance(d, str):
+            return d
+        if d is None or d == -1:
+            return "batch" if j == 0 else f"dyn{i}_{j}"
+        return str(d)
+
+    for i, s in enumerate(input_spec):
+        if isinstance(s, InputSpec):
+            if any(isinstance(d, str) or d is None or d == -1
+                   for d in s.shape):
+                dims = ",".join(dim_sym(i, j, d)
+                                for j, d in enumerate(s.shape))
+                shape = jax_export.symbolic_shape(f"({dims})", scope=scope)
+            else:
+                shape = tuple(s.shape)
+            out.append(jax.ShapeDtypeStruct(shape, s.dtype))
+        else:
+            out.append(jax.ShapeDtypeStruct(jnp.shape(s),
+                                            jnp.asarray(s).dtype))
+    return out
+
+
+def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
+    """`paddle.jit.save` equivalent.
+
+    Produces: `<path>.pdiparams` (params+buffers pickle) and
+    `<path>.pdmodel` (serialized StableHLO of the eval forward) — same split
+    as the reference's params file + ProgramDesc model file.
+    """
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec to trace the model")
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    was_training = layer.training
+    layer.eval()
+    params = {n: p.value for n, p in layer.named_parameters()}
+    buffers = buffer_state(layer)
+    _save_state({"params": params, "buffers": buffers,
+                 "input_names": [getattr(s, "name", None) or f"x{i}"
+                                 for i, s in enumerate(input_spec)]},
+                path + ".pdiparams")
+    abstract = _specs_to_abstract(input_spec)
+
+    def fwd(params, buffers, *args):
+        out, _ = functional_call(layer, params, *args, buffers=buffers)
+        return out
+
+    from jax import export as jax_export
+    exported = jax_export.export(jax.jit(fwd))(
+        jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                     params),
+        jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                     buffers),
+        *abstract)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    if was_training:
+        layer.train()
+
+
+class TranslatedLayer:
+    """Loaded inference artifact (reference: TranslatedLayer running the
+    captured program via a run_program op — here: deserialized StableHLO)."""
+
+    def __init__(self, exported, params, buffers, input_names=None):
+        self._exported = exported
+        self._params = params
+        self._buffers = buffers
+        self._input_names = list(input_names or [])
+
+    def __call__(self, *args):
+        return self._exported.call(self._params, self._buffers, *args)
+
+    def input_names(self):
+        return list(self._input_names)
+
+    def eval(self):
+        return self
+
+
+def load(path: str):
+    """`paddle.jit.load` equivalent."""
+    from jax import export as jax_export
+    state = _load_state(path + ".pdiparams")
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    return TranslatedLayer(exported, state["params"], state["buffers"],
+                           state.get("input_names"))

@@ -1,0 +1,142 @@
+"""Metrics.
+
+Mirrors `python/paddle/metric/metrics.py` (Metric base, Accuracy, Precision,
+Recall, Auc; reference C++ twins `accuracy_op`, `auc_op`).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self._name
+
+    def compute(self, pred, label):
+        return pred, label
+
+
+class Accuracy(Metric):
+    """Reference: metrics.py Accuracy (top-k)."""
+
+    def __init__(self, topk=(1,), name="acc"):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def compute(self, pred, label):
+        maxk = max(self.topk)
+        idx = np.argsort(-np.asarray(pred), axis=-1)[..., :maxk]
+        label = np.asarray(label)
+        if label.ndim == idx.ndim:
+            label = label.squeeze(-1) if label.shape[-1] == 1 else \
+                label.argmax(-1)
+        correct = (idx == label[..., None])
+        return correct
+
+    def update(self, correct):
+        correct = np.asarray(correct)
+        accs = []
+        for i, k in enumerate(self.topk):
+            c = correct[..., :k].any(axis=-1).astype(np.float64)
+            self.total[i] += c.sum()
+            self.count[i] += c.size
+            accs.append(c.mean())
+        return accs[0] if len(accs) == 1 else accs
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+
+class Precision(Metric):
+    def __init__(self, name="precision"):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = (np.asarray(preds) > 0.5).astype(int).reshape(-1)
+        labels = np.asarray(labels).astype(int).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+
+class Recall(Metric):
+    def __init__(self, name="recall"):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = (np.asarray(preds) > 0.5).astype(int).reshape(-1)
+        labels = np.asarray(labels).astype(int).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+
+class Auc(Metric):
+    """Reference: auc_op — threshold-bucketed ROC AUC."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        self._name = name
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        buckets = np.clip((preds * self.num_thresholds).astype(int), 0,
+                          self.num_thresholds)
+        np.add.at(self._stat_pos, buckets[labels == 1], 1)
+        np.add.at(self._stat_neg, buckets[labels == 0], 1)
+
+    def accumulate(self):
+        tot_pos = self._stat_pos[::-1].cumsum()
+        tot_neg = self._stat_neg[::-1].cumsum()
+        tp, fp = 0.0, 0.0
+        auc = 0.0
+        prev_tp, prev_fp = 0.0, 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            tp += self._stat_pos[i]
+            fp += self._stat_neg[i]
+            auc += (fp - prev_fp) * (tp + prev_tp) / 2.0
+            prev_tp, prev_fp = tp, fp
+        if tp == 0 or fp == 0:
+            return 0.0
+        return auc / (tp * fp)

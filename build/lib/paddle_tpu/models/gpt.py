@@ -1,0 +1,500 @@
+"""GPT — decoder-only LM, the hybrid-parallel flagship (BASELINE config 3).
+
+Reference model: PaddleNLP GPT (`examples/language_model/gpt`), built on the
+reference's meta-parallel layers (`mp_layers.py`, `pp_layers.py`). Here the
+same architecture is built TPU-first:
+
+  * uniform pre-LN decoder blocks → stackable: one traced block, `lax.scan`
+    over the layer dim (fast compile) or the GSPMD pipeline engine
+    (`stacked_pipeline.gpipe`) when a 'pipe' mesh axis exists;
+  * TP via the GSPMD mp_layers (weights carry PartitionSpecs; XLA inserts
+    the ICI collectives);
+  * tied embedding/output head; vocab-parallel softmax CE;
+  * everything bf16-friendly: matmuls hit the MXU, softmax/CE in fp32.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import warnings
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import (Layer, functional_call, load_state, trainable_state)
+from ..nn.layer_common import Dropout, Embedding, LayerList
+from ..nn.layer_conv_norm import LayerNorm
+from ..distributed.meta_parallel.mp_layers import (
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding, _constrain)
+from ..distributed.meta_parallel.stacked_pipeline import (
+    one_f_one_b, pipelined_apply, stack_stage_params)
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    ffn_hidden: Optional[int] = None          # default 4*hidden
+    max_position_embeddings: int = 1024
+    dropout: float = 0.0                       # pretraining bench default
+    dtype: Any = jnp.bfloat16                  # activation/weight dtype
+    initializer_range: float = 0.02
+
+    def __post_init__(self):
+        if self.ffn_hidden is None:
+            self.ffn_hidden = 4 * self.hidden_size
+
+
+def gpt_tiny(**kw) -> GPTConfig:
+    return GPTConfig(vocab_size=512, hidden_size=64, num_layers=4,
+                     num_heads=4, max_position_embeddings=128, **kw)
+
+
+def gpt_345m(**kw) -> GPTConfig:
+    return GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                     num_heads=16, **kw)
+
+
+def gpt_1p3b(**kw) -> GPTConfig:
+    return GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
+                     num_heads=32, **kw)
+
+
+class GPTDecoderLayer(Layer):
+    """Pre-LN decoder block. TP layout: fused QKV column-parallel, attention
+    output row-parallel; MLP column→row (Megatron pattern, reference
+    mp_layers usage in PaddleNLP GPTDecoderLayer)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        d = cfg.hidden_size
+        self.num_heads = cfg.num_heads
+        self.head_dim = d // cfg.num_heads
+        init = I.Normal(0.0, cfg.initializer_range)
+        dt = cfg.dtype
+        self.ln1 = LayerNorm(d)
+        self.qkv = ColumnParallelLinear(d, 3 * d, weight_attr=init,
+                                        gather_output=False,
+                                        compute_dtype=dt)
+        self.out_proj = RowParallelLinear(d, d, weight_attr=init,
+                                          input_is_parallel=True,
+                                          compute_dtype=dt)
+        self.ln2 = LayerNorm(d)
+        self.fc1 = ColumnParallelLinear(d, cfg.ffn_hidden, weight_attr=init,
+                                        gather_output=False,
+                                        compute_dtype=dt)
+        self.fc2 = RowParallelLinear(cfg.ffn_hidden, d, weight_attr=init,
+                                     input_is_parallel=True,
+                                     compute_dtype=dt)
+        self.dropout = Dropout(cfg.dropout)
+        self._dtype_ = dt
+
+    def forward(self, x):
+        b, s, d = x.shape
+        h, hd = self.num_heads, self.head_dim
+        dt = x.dtype
+        res = x
+        qkv = self.qkv(self.ln1(x))   # LN in fp32, matmul in compute dtype
+        qkv = jnp.reshape(qkv, (b, s, 3, h, hd))
+        # heads sharded over 'model' (column shards = contiguous head groups)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                              training=self.training)
+        attn = jnp.reshape(attn, (b, s, d))
+        x = res + self.dropout(self.out_proj(attn)).astype(dt)
+        res = x
+        y = self.fc2(F.gelu(self.fc1(self.ln2(x)), approximate=True))
+        return res + self.dropout(y).astype(dt)
+
+
+class GPTEmbeddings(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.word_embeddings = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size, weight_attr=init)
+        # position table is small — plain replicated Embedding
+        self.position_embeddings = Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size, weight_attr=init)
+        self.dropout = Dropout(cfg.dropout)
+        self._dtype_ = cfg.dtype
+
+    def forward(self, input_ids, position_ids=None):
+        if position_ids is None:
+            position_ids = jnp.arange(input_ids.shape[-1], dtype=jnp.int32)
+            position_ids = jnp.broadcast_to(position_ids, input_ids.shape)
+        x = (F.embedding(input_ids, self.word_embeddings.weight) +
+             F.embedding(position_ids, self.position_embeddings.weight))
+        return self.dropout(x.astype(self._dtype_))
+
+
+class GPTModel(Layer):
+    """Decoder-only trunk; returns final hidden states [b, s, d]."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.config = cfg
+        self.embeddings = GPTEmbeddings(cfg)
+        self.layers = LayerList([GPTDecoderLayer(cfg)
+                                 for _ in range(cfg.num_layers)])
+        self.ln_f = LayerNorm(cfg.hidden_size)
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.embeddings(input_ids, position_ids)
+        x = _constrain(x, ("data", "sharding"), None, None)
+        for blk in self.layers:
+            x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTPretrainingCriterion(Layer):
+    """Vocab-parallel softmax CE over tied-logits (reference:
+    GPTPretrainingCriterion + `c_softmax_with_cross_entropy`)."""
+
+    def __init__(self):
+        super().__init__()
+        self.ce = ParallelCrossEntropy(ignore_index=-1)
+
+    def forward(self, logits, labels, loss_mask=None):
+        loss = self.ce(logits, labels)[..., 0]
+        if loss_mask is not None:
+            m = loss_mask.astype(jnp.float32)
+            return jnp.sum(loss * m) / jnp.maximum(jnp.sum(m), 1.0)
+        return jnp.mean(loss)
+
+
+class GPTForPretraining(Layer):
+    def __init__(self, cfg_or_model):
+        super().__init__()
+        if isinstance(cfg_or_model, GPTModel):
+            self.gpt = cfg_or_model
+        else:
+            self.gpt = GPTModel(cfg_or_model)
+        self.criterion = GPTPretrainingCriterion()
+
+    @property
+    def config(self):
+        return self.gpt.config
+
+    def logits(self, hidden):
+        # tied head: [b,s,d] @ [V,d]^T — vocab dim sharded over 'model'.
+        # bf16 operands on the MXU, fp32 accumulation (fp32 operands would
+        # run the biggest matmul in the model at 1/4 MXU rate)
+        cdt = self.config.dtype
+        w = jnp.asarray(self.gpt.embeddings.word_embeddings.weight)
+        logits = jnp.einsum("bsd,vd->bsv", hidden.astype(cdt),
+                            w.astype(cdt),
+                            preferred_element_type=jnp.float32)
+        return _constrain(logits, ("data", "sharding"), None, "model")
+
+    def forward(self, input_ids, labels=None, loss_mask=None,
+                position_ids=None):
+        hidden = self.gpt(input_ids, position_ids)
+        logits = self.logits(hidden)
+        if labels is None:
+            return logits
+        return self.criterion(logits, labels, loss_mask)
+
+
+# --------------------------------------------------------------------------
+# Distributed train-step builder (bench.py / __graft_entry__ entrypoint)
+# --------------------------------------------------------------------------
+
+def _split_params(model: GPTForPretraining):
+    """Partition trainable state into stacked block params + outer params.
+
+    Returns (outer: {name: arr}, blocks: [per-block {relname: arr}],
+    relnames keyed to one template block).
+    """
+    all_params = trainable_state(model)
+    nl = model.config.num_layers
+    blocks = [dict() for _ in range(nl)]
+    outer = {}
+    for name, v in all_params.items():
+        if ".layers." in name:
+            head, rest = name.split(".layers.", 1)
+            idx, rel = rest.split(".", 1)
+            blocks[int(idx)][rel] = v
+        else:
+            outer[name] = v
+    return outer, blocks
+
+
+def _block_specs(model: GPTForPretraining):
+    tmpl = model.gpt.layers[0]
+    return {n: (p.sharding_spec or P())
+            for n, p in tmpl.named_parameters() if p.trainable}
+
+
+def _outer_specs(model: GPTForPretraining):
+    out = {}
+    for name, p in model.named_parameters():
+        if ".layers." in name or not p.trainable:
+            continue
+        out[name] = p.sharding_spec or P()
+    return out
+
+
+def build_train_step(model: GPTForPretraining, optimizer, mesh,
+                     num_microbatches: int = 1, remat: bool = True,
+                     donate: bool = True, pipeline_schedule: str = "gpipe"):
+    """Build the one compiled hybrid-parallel training step.
+
+    Parallelism comes entirely from the mesh axes: 'data' (DP — batch dim),
+    'model' (TP — weight PartitionSpecs), 'pipe' (PP — stacked blocks via
+    the CollectivePermute schedule), 'sharding' (ZeRO — optimizer-state
+    specs). This replaces the reference's whole meta-optimizer chain
+    (`fleet_base.py:1288` → StrategyCompiler → program rewriting).
+
+    Returns (step_fn, state) where state = (outer, stacked_blocks,
+    opt_state) and step_fn(state, batch) -> (state, loss);
+    batch = (input_ids, labels) int32 [B, S]. When cfg.dropout > 0 the
+    signature is step_fn(state, batch, rng_key) — pass a fresh key per
+    step.
+    """
+    cfg = model.config
+    axis = dict(zip(mesh.axis_names, mesh.devices.shape))
+    pp = axis.get("pipe", 1)
+    assert cfg.num_layers % pp == 0, "num_layers must divide pipe axis"
+    layers_per_stage = cfg.num_layers // pp
+    if pp > 1 and num_microbatches < pp:
+        warnings.warn(
+            f"num_microbatches={num_microbatches} < pipeline stages "
+            f"{pp}: the schedule needs at least one microbatch per stage; "
+            f"using {pp}", stacklevel=2)
+
+    outer, block_list = _split_params(model)
+    stacked = stack_stage_params(block_list)  # leaves [L, ...]
+    template = model.gpt.layers[0]
+
+    def block_apply(bparams, x):
+        out, _ = functional_call(template, bparams, x)
+        return out
+
+    def stage_blocks(stage_p, h):
+        """One pipeline stage = scan over its L/pp blocks (shared by the
+        gpipe and 1f1b schedules)."""
+        def body(carry, bp):
+            fn = jax.checkpoint(block_apply) if remat else block_apply
+            return fn(bp, carry), None
+        out, _ = jax.lax.scan(body, h, stage_p)
+        return out
+
+    def to_staged(stacked_p):
+        """Leaves [L, ...] -> [pp, L/pp, ...]."""
+        return jax.tree.map(
+            lambda a: a.reshape((pp, layers_per_stage) + a.shape[1:]),
+            stacked_p)
+
+    def embed_fwd(input_ids):
+        x = model.gpt.embeddings(input_ids)
+        return _constrain(x, ("data", "sharding"), None, None)
+
+    def trunk(stacked_p, x):
+        """Apply all L blocks: scan over layers (and pipeline over stages
+        when pp > 1)."""
+        if pp == 1:
+            return stage_blocks(stacked_p, x)
+        return pipelined_apply(stage_blocks, to_staged(stacked_p), x,
+                               num_stages=pp,
+                               num_microbatches=max(num_microbatches, pp),
+                               remat=False)
+
+    def loss_fn(params, batch):
+        outer_p, stacked_p = params
+        input_ids, labels = batch
+        # embeddings + ln_f + head run via functional_call on the model with
+        # outer params; trunk handled functionally
+        def fwd():
+            x = embed_fwd(input_ids)
+            x = trunk(stacked_p, x)
+            x = model.gpt.ln_f(x)
+            logits = model.logits(x)
+            return model.criterion(logits, labels)
+        out, _ = functional_call_outer(model, outer_p, fwd)
+        return out
+
+    def functional_call_outer(mdl, outer_p, thunk):
+        from ..nn.layer import _slots
+        slots = _slots(mdl)
+        saved = {n: s.value for n, s in slots.items()}
+        try:
+            for n, v in outer_p.items():
+                if n in slots:
+                    slots[n].value = v
+            return thunk(), None
+        finally:
+            for n, s in slots.items():
+                s.value = saved[n]
+
+    # optimizer state over combined pytree
+    params0 = (outer, stacked)
+    flatname_params = dict(outer)
+    flatname_params.update({f"blocks.{n}": v for n, v in stacked.items()})
+
+    opt_state0 = optimizer.init_state(flatname_params)
+
+    def value_and_grad_1f1b(params, batch):
+        """Loss + grads via the 1F1B schedule (SectionWorker mode 1,
+        `section_worker.cc:144-156`): embedding vjp outside the schedule,
+        per-microbatch head (ln_f + tied logits + CE) inside it so
+        backward starts S-1 ticks after forward."""
+        outer_p, stacked_p = params
+        input_ids, labels = batch
+        B = input_ids.shape[0]
+        M = max(num_microbatches, pp)
+        assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
+
+        def embed_fn(op):
+            out, _ = functional_call_outer(
+                model, op, lambda: embed_fwd(input_ids))
+            return out
+
+        x, embed_vjp = jax.vjp(embed_fn, outer_p)
+        mb = x.reshape((M, B // M) + tuple(x.shape[1:]))
+        labels_mb = labels.reshape((M, B // M) + tuple(labels.shape[1:]))
+
+        def head_grad(op, y, lab):
+            def h(op_, y_):
+                def fwd():
+                    z = model.gpt.ln_f(y_)
+                    logits = model.logits(z)
+                    return model.criterion(logits, lab)
+                out, _ = functional_call_outer(model, op_, fwd)
+                return out
+            loss_v, vjp_fn = jax.vjp(h, op, y)
+            # global loss = mean over microbatches → seed cotangent 1/M
+            dop, dy = vjp_fn(jnp.asarray(1.0 / M, loss_v.dtype))
+            return loss_v, dy, dop
+
+        loss_sum, dx_stream, g_staged, g_outer_head = one_f_one_b(
+            stage_blocks, to_staged(stacked_p), mb, head_grad, outer_p,
+            labels_mb, num_stages=pp)
+        dx = dx_stream.reshape((B,) + tuple(x.shape[1:]))
+        (g_outer_embed,) = embed_vjp(dx)
+        g_outer = jax.tree.map(jnp.add, g_outer_head, g_outer_embed)
+        g_stacked = jax.tree.map(
+            lambda a: a.reshape((pp * layers_per_stage,) + a.shape[2:]),
+            g_staged)
+        return loss_sum / M, (g_outer, g_stacked)
+
+    use_1f1b = pipeline_schedule == "1f1b" and pp > 1
+    if pipeline_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline_schedule {pipeline_schedule!r}")
+    if use_1f1b and cfg.dropout > 0.0:
+        raise NotImplementedError(
+            "1f1b schedule does not thread dropout rng yet — "
+            "use pipeline_schedule='gpipe' or dropout=0")
+
+    def step(state, batch, rng=None):
+        outer_p, stacked_p, opt_state = state
+        if use_1f1b:
+            loss, grads = value_and_grad_1f1b((outer_p, stacked_p), batch)
+        elif rng is None:
+            loss, grads = jax.value_and_grad(loss_fn)((outer_p, stacked_p),
+                                                      batch)
+        else:
+            # scope the traced key so Dropout draws fresh masks per step
+            # (an unscoped next_key() inside jit would bake one constant
+            # mask into the compiled program)
+            from ..framework.random import rng_guard
+
+            def lf(params, batch):
+                with rng_guard(rng):
+                    return loss_fn(params, batch)
+            loss, grads = jax.value_and_grad(lf)((outer_p, stacked_p),
+                                                 batch)
+        g_outer, g_stacked = grads
+        flat_p = dict(outer_p)
+        flat_p.update({f"blocks.{n}": v for n, v in stacked_p.items()})
+        flat_g = dict(g_outer)
+        flat_g.update({f"blocks.{n}": v for n, v in g_stacked.items()})
+        if shard_axis > 1:
+            # ZeRO-2: pin gradients to the optimizer-state layout so XLA
+            # reduce-scatters them over 'sharding' (instead of all-reduce)
+            # and runs the update sharded; fresh params all-gather on the
+            # way out. Reference bar: grad sharding in static
+            # ShardingOptimizer (`sharding_optimizer.py:87-1385`).
+            flat_g = {n: (jax.lax.with_sharding_constraint(
+                              v, ns(opt_spec(n, v)))
+                          if jnp.ndim(v) else v)
+                      for n, v in flat_g.items()}
+        new_flat, new_opt = optimizer.apply(flat_p, flat_g, opt_state)
+        new_outer = {n: new_flat[n] for n in outer_p}
+        new_stacked = {n: new_flat[f"blocks.{n}"] for n in stacked_p}
+        return (new_outer, new_stacked, new_opt), loss
+
+    # ---- shardings ----
+    bspecs = _block_specs(model)
+    stacked_specs = {n: P("pipe", *s) if pp > 1 else P(None, *s)
+                     for n, s in bspecs.items()}
+    outer_specs = _outer_specs(model)
+    shard_axis = axis.get("sharding", 1)
+
+    def ns(spec):
+        return NamedSharding(mesh, spec)
+
+    from ..distributed.meta_parallel.sharding_optimizer import shard_spec_for
+
+    def opt_spec(pname, v):
+        if jnp.ndim(v) == 0:
+            return P()
+        base = (stacked_specs.get(pname[7:]) if pname.startswith("blocks.")
+                else outer_specs.get(pname)) or P()
+        if shard_axis > 1:
+            return shard_spec_for(v.shape, shard_axis, "sharding", base)
+        return base
+
+    opt_state_specs = {
+        "step": P(),
+        "slots": {pname: {sname: opt_spec(pname, v)
+                          for sname, v in slots.items()}
+                  for pname, slots in opt_state0["slots"].items()}}
+
+    state_shardings = (
+        {n: ns(s) for n, s in outer_specs.items()},
+        {n: ns(s) for n, s in stacked_specs.items()},
+        jax.tree.map(lambda s: ns(s), opt_state_specs,
+                     is_leaf=lambda s: isinstance(s, P)))
+    # ZeRO semantics: the 'sharding' axis IS data parallelism with sharded
+    # states — the batch splits over data×sharding jointly (reference:
+    # sharding_degree multiplies dp for the data split,
+    # sharding_optimizer.py:968 _build_groups)
+    batch_sharding = (ns(P(("data", "sharding"), None)),
+                      ns(P(("data", "sharding"), None)))
+
+    if cfg.dropout > 0.0:
+        step_jit = jax.jit(
+            step,
+            in_shardings=(state_shardings, batch_sharding, None),
+            out_shardings=(state_shardings, None),
+            donate_argnums=(0,) if donate else ())
+    else:
+        step_jit = jax.jit(
+            functools.partial(step, rng=None),
+            in_shardings=(state_shardings, batch_sharding),
+            out_shardings=(state_shardings, None),
+            donate_argnums=(0,) if donate else ())
+
+    # place initial state
+    state0 = jax.device_put(
+        (outer, stacked, opt_state0), state_shardings)
+    return step_jit, state0
+
+
+def sync_params_to_model(model: GPTForPretraining, state):
+    """Write (outer, stacked) back into the Layer tree (for save/eval)."""
+    outer_p, stacked_p, _ = state
+    nl = model.config.num_layers
+    flat = dict(outer_p)
+    for rel, v in stacked_p.items():
+        for i in range(nl):
+            flat[f"gpt.layers.{i}.{rel}"] = v[i]
+    load_state(model, flat)

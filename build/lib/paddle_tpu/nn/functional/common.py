@@ -1,0 +1,175 @@
+"""Common NN functional ops: linear, dropout, embedding, one_hot, interpolate.
+
+Mirrors `python/paddle/nn/functional/common.py` + `input.py` (reference
+kernels: `operators/matmul_v2_op`, `dropout_op`, `lookup_table_v2_op`,
+`one_hot_v2_op`, `interpolate_v2`).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.random import next_key
+
+
+def linear(x, weight, bias=None):
+    """y = x @ W + b. Weight layout [in, out] as in the reference
+    (`matmul` with the stored layout; no transpose → clean MXU mapping)."""
+    from ...amp.auto_cast import maybe_autocast
+    w = weight.value if hasattr(weight, "value") else weight
+    x, w = maybe_autocast(x, w, op="linear")
+    y = jnp.matmul(x, w)
+    if bias is not None:
+        b = bias.value if hasattr(bias, "value") else bias
+        y = y + b.astype(y.dtype)
+    return y
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train"):
+    """Reference: dropout_op. `upscale_in_train` (default) scales by 1/(1-p)
+    at train time; `downscale_in_infer` scales by (1-p) at eval."""
+    if p == 0.0:
+        return x
+    if not training:
+        return x if mode == "upscale_in_train" else x * (1.0 - p)
+    if p == 1.0:
+        return jnp.zeros_like(x)
+    shape = x.shape
+    if axis is not None:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        shape = tuple(s if i in axes else 1 for i, s in enumerate(x.shape))
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    return jnp.where(keep, x, 0.0).astype(x.dtype)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
+    axis = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    axis = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, x.shape)
+    a = (1.0 / ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** 0.5)
+    b = -a * alpha_p * p
+    return (a * jnp.where(keep, x, alpha_p) + b).astype(x.dtype)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False):
+    """Reference: lookup_table_v2_op. Gather along vocab dim; `sparse` is
+    accepted for parity (XLA gather handles both)."""
+    w = weight.value if hasattr(weight, "value") else weight
+    out = jnp.take(w, x, axis=0)
+    if padding_idx is not None:
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    return out
+
+
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    k = label.shape[-1]
+    if prior_dist is None:
+        return (1.0 - epsilon) * label + epsilon / k
+    return (1.0 - epsilon) * label + epsilon * prior_dist
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
+    """Reference: interpolate_v2 (bilinear/nearest/bicubic...)."""
+    is_nchw = data_format in ("NCHW", "NCDHW", "NCL")
+    spatial = x.shape[2:] if is_nchw else x.shape[1:-1]
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * len(spatial)
+        size = [int(s * f) for s, f in zip(spatial, scale_factor)]
+    size = [int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+    method = {"nearest": "nearest", "bilinear": "bilinear",
+              "bicubic": "bicubic", "trilinear": "trilinear",
+              "linear": "linear", "area": "linear"}[mode]
+    if is_nchw:
+        target = x.shape[:2] + tuple(size)
+    else:
+        target = (x.shape[0],) + tuple(size) + (x.shape[-1],)
+    return jax.image.resize(x, target, method=method).astype(x.dtype)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, data_format="NCHW"):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """Reference: unfold_op (im2col). NCHW input -> [N, C*kh*kw, L]."""
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    n, c, h, w = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            patch = x[:, :, i * dh:i * dh + oh * sh:sh,
+                      j * dw:j * dw + ow * sw:sw]
+            patches.append(patch)
+    out = jnp.stack(patches, axis=2)  # [N, C, kh*kw, oh, ow]
+    return jnp.reshape(out, (n, c * kh * kw, oh * ow))
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v, v)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    from ...tensor.manipulation import pad as _tensor_pad
+    return _tensor_pad(x, pad, mode=mode, value=value,
+                       data_format=data_format)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def bilinear(x1, x2, weight, bias=None):
+    w = weight.value if hasattr(weight, "value") else weight
+    out = jnp.einsum("bi,oij,bj->bo", x1, w, x2)
+    if bias is not None:
+        b = bias.value if hasattr(bias, "value") else bias
+        out = out + b
+    return out
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = jnp.reshape(x, (n, c // (r * r), r, r, h, w))
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return jnp.reshape(x, (n, c // (r * r), h * r, w * r))
+    n, h, w, c = x.shape
+    x = jnp.reshape(x, (n, h, w, r, r, c // (r * r)))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return jnp.reshape(x, (n, h * r, w * r, c // (r * r)))

@@ -1,0 +1,137 @@
+"""Convolution ops.
+
+Mirrors `python/paddle/nn/functional/conv.py` (reference kernels:
+`operators/conv_op.*` → cuDNN). Lowers to `lax.conv_general_dilated`, which
+XLA tiles onto the MXU directly — no im2col, no algorithm search. Weights are
+stored in the reference's OIHW layout for state-dict parity; XLA's layout
+assignment transposes to the TPU-preferred layout at compile time.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...core import enforce
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        enforce.enforce_eq(len(v), n, "conv parameter rank mismatch")
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        return [tuple(p) for p in padding]
+    if len(padding) == n:
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:  # paddle flat [before0, after0, ...]
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n,
+          data_format, transpose=False, output_padding=0):
+    from ...amp.auto_cast import maybe_autocast
+    w = weight.value if hasattr(weight, "value") else weight
+    x, w = maybe_autocast(x, w, op="conv")
+    stride = _tuple(stride, n)
+    dilation = _tuple(dilation, n)
+    pad = _padding(padding, n)
+    channel_last = data_format in ("NHWC", "NDHWC", "NLC")
+    spatial = "DHW"[3 - n:] if n <= 3 else None
+    if channel_last:
+        lhs_spec = "N" + spatial + "C"
+    else:
+        lhs_spec = "NC" + spatial
+    out_spec = lhs_spec
+    if not transpose:
+        rhs_spec = "OI" + spatial  # paddle weight layout [out_c, in_c/g, *k]
+        y = jax.lax.conv_general_dilated(
+            x, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, feature_group_count=groups,
+            dimension_numbers=(lhs_spec, rhs_spec, out_spec))
+    else:
+        # conv_transpose = gradient-of-conv: flip kernel spatially, treat the
+        # stored [in_c, out_c/g, *k] layout as (I, O, *k), fractionally
+        # stride the input (lhs_dilation), and use the k-1-p padding rule.
+        out_pad = _tuple(output_padding, n)
+        in_c = w.shape[0]
+        out_cg = w.shape[1]
+        w_t = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups > 1:
+            # regroup to I=in_c/g, O=out_c (group-major) for XLA's grouped
+            # convolution contract
+            w_t = jnp.reshape(w_t, (groups, in_c // groups, out_cg)
+                              + w_t.shape[2:])
+            w_t = jnp.swapaxes(w_t, 0, 1)
+            w_t = jnp.reshape(w_t, (in_c // groups, groups * out_cg)
+                              + w_t.shape[3:])
+        rhs_spec = "IO" + spatial
+        if isinstance(pad, str):
+            pads = pad
+        else:
+            k = [(w.shape[2 + i] - 1) * dilation[i] + 1 for i in range(n)]
+            pads = [(k[i] - 1 - pad[i][0],
+                     k[i] - 1 - pad[i][1] + out_pad[i]) for i in range(n)]
+        y = jax.lax.conv_general_dilated(
+            x, w_t,
+            window_strides=(1,) * n,
+            padding=pads, lhs_dilation=stride, rhs_dilation=dilation,
+            feature_group_count=groups,
+            dimension_numbers=(lhs_spec, rhs_spec, out_spec))
+    if bias is not None:
+        b = bias.value if hasattr(bias, "value") else bias
+        b = b.astype(y.dtype)
+        if channel_last:
+            y = y + b
+        else:
+            y = y + jnp.reshape(b, (1, -1) + (1,) * n)
+    return y
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCL"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 data_format, transpose=True, output_padding=output_padding)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, transpose=True, output_padding=output_padding)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, transpose=True, output_padding=output_padding)

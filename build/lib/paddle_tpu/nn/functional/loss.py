@@ -1,0 +1,241 @@
+"""Loss functions.
+
+Mirrors `python/paddle/nn/functional/loss.py` (reference kernels:
+`operators/softmax_with_cross_entropy_op.*`, `cross_entropy_op`,
+`bce_loss_op`, `smooth_l1_loss_op`, `kldiv_loss_op`, `margin_rank_loss` …).
+`cross_entropy` fuses log-softmax + NLL exactly like the reference's fused
+`softmax_with_cross_entropy` CUDA kernel — here the fusion is XLA's.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0):
+    """Reference: `softmax_with_cross_entropy` (fused)."""
+    if use_softmax:
+        logp = jax.nn.log_softmax(input, axis=axis)
+    else:
+        logp = jnp.log(jnp.clip(input, 1e-15, 1.0))
+    if soft_label or (label.ndim == input.ndim and label.shape == input.shape):
+        if label_smoothing > 0.0:
+            k = input.shape[axis]
+            label = (1.0 - label_smoothing) * label + label_smoothing / k
+        loss = -jnp.sum(label * logp, axis=axis)
+        valid = None
+    else:
+        label = label.astype(jnp.int32)
+        if label.ndim == input.ndim:  # trailing 1 dim
+            label = jnp.squeeze(label, axis=axis)
+        k = input.shape[axis]
+        safe_label = jnp.clip(label, 0, k - 1)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe_label, axis), axis=axis)
+        nll = -jnp.squeeze(picked, axis=axis)
+        if label_smoothing > 0.0:
+            smooth = -jnp.mean(logp, axis=axis)
+            nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
+        valid = (label != ignore_index)
+        loss = jnp.where(valid, nll, 0.0)
+        if weight is not None:
+            w = jnp.take(weight, safe_label)
+            loss = loss * w
+    if reduction == "mean":
+        if valid is not None:
+            denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+            if weight is not None:
+                denom = jnp.maximum(jnp.sum(
+                    jnp.where(valid, jnp.take(weight, jnp.clip(
+                        label, 0, input.shape[axis] - 1)), 0.0)), 1e-12)
+            return jnp.sum(loss) / denom
+        return jnp.mean(loss)
+    return _reduce(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    loss = jnp.expand_dims(loss, axis)
+    if return_softmax:
+        return loss, jax.nn.softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    """`input` is LOG-probabilities (paddle contract: pair with
+    log_softmax) — no further log is applied."""
+    label = label.astype(jnp.int32)
+    k = input.shape[-1]
+    safe_label = jnp.clip(label, 0, k - 1)
+    picked = jnp.take_along_axis(input, jnp.expand_dims(safe_label, -1),
+                                 axis=-1)
+    loss = -jnp.squeeze(picked, axis=-1)
+    valid = (label != ignore_index)
+    loss = jnp.where(valid, loss, 0.0)
+    if weight is not None:
+        loss = loss * jnp.take(weight, safe_label)
+    if reduction == "mean":
+        denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+        if weight is not None:
+            denom = jnp.maximum(jnp.sum(jnp.where(
+                valid, jnp.take(weight, safe_label), 0.0)), 1e-12)
+        return jnp.sum(loss) / denom
+    return _reduce(loss, reduction)
+
+
+def mse_loss(input, label, reduction="mean"):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+def l1_loss(input, label, reduction="mean"):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    diff = jnp.abs(input - label)
+    loss = jnp.where(diff < delta, 0.5 * diff * diff / delta,
+                     diff - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.clip(input, eps, 1.0)) +
+             (1.0 - label) * jnp.log(jnp.clip(1.0 - input, eps, 1.0)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None):
+    max_val = jnp.clip(-logit, 0, None)
+    if pos_weight is not None:
+        log_weight = (pos_weight - 1.0) * label + 1.0
+        loss = (1.0 - label) * logit + log_weight * (
+            jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        loss = (1.0 - label) * logit + max_val + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean"):
+    loss = label * (jnp.log(jnp.clip(label, 1e-12, None)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    loss = jnp.clip(-label * (input - other) + margin, 0, None)
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    loss = jnp.where(label == 1.0, input,
+                     jnp.clip(margin - input, 0, None))
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean"):
+    cos = jnp.sum(input1 * input2, axis=-1) / (
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1))
+    loss = jnp.where(label == 1, 1.0 - cos,
+                     jnp.clip(cos - margin, 0, None))
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_loss(anchor, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, reduction="mean"):
+    d_pos = jnp.linalg.norm(anchor - positive + epsilon, ord=p, axis=-1)
+    d_neg = jnp.linalg.norm(anchor - negative + epsilon, ord=p, axis=-1)
+    loss = jnp.clip(d_pos - d_neg + margin, 0, None)
+    return _reduce(loss, reduction)
+
+
+def log_loss(input, label, epsilon=1e-4):
+    return -label * jnp.log(input + epsilon) - \
+        (1.0 - label) * jnp.log(1.0 - input + epsilon)
+
+
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = binary_cross_entropy_with_logits(logit, label, reduction="none")
+    p_t = p * label + (1.0 - p) * (1.0 - label)
+    loss = ce * jnp.power(1.0 - p_t, gamma)
+    alpha_t = alpha * label + (1.0 - alpha) * (1.0 - label)
+    loss = alpha_t * loss
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean"):
+    """Reference: warpctc_op. Uses a dense alpha-recursion over lax.scan."""
+    # log_probs: [T, B, C]; labels: [B, S]
+    T, B, C = log_probs.shape
+    S = labels.shape[1]
+    # extended label seq: blank, l1, blank, l2, ... blank (len 2S+1)
+    ext = jnp.full((B, 2 * S + 1), blank, dtype=labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    ext_len = 2 * label_lengths + 1
+
+    neg_inf = -1e30
+
+    def get_prob(t_probs, idx):
+        return jnp.take_along_axis(t_probs, idx, axis=-1)
+
+    alpha0 = jnp.full((B, 2 * S + 1), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(log_probs[0, :, blank])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(S > 0, get_prob(log_probs[0], ext[:, 1:2])[:, 0], neg_inf))
+
+    same_as_prev2 = jnp.concatenate(
+        [jnp.ones((B, 2), dtype=bool),
+         ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    def step(alpha, t_probs):
+        shift1 = jnp.concatenate(
+            [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+        shift2 = jnp.concatenate(
+            [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+        shift2 = jnp.where(same_as_prev2, neg_inf, shift2)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, shift1), shift2)
+        new_alpha = merged + get_prob(t_probs, ext)
+        return new_alpha, new_alpha
+
+    _, alphas = jax.lax.scan(step, alpha0, log_probs[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, 2S+1]
+    batch_idx = jnp.arange(B)
+    final = alphas[input_lengths - 1, batch_idx]  # [B, 2S+1]
+    last = jnp.take_along_axis(final, (ext_len - 1)[:, None], axis=1)[:, 0]
+    second_last = jnp.take_along_axis(
+        final, jnp.clip(ext_len - 2, 0, None)[:, None], axis=1)[:, 0]
+    loss = -jnp.logaddexp(last, second_last)
+    if reduction == "mean":
+        return jnp.mean(loss / jnp.maximum(label_lengths, 1))
+    return _reduce(loss, reduction)

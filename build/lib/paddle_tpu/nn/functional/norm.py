@@ -1,0 +1,144 @@
+"""Normalization ops.
+
+Mirrors `python/paddle/nn/functional/norm.py` (reference kernels:
+`operators/batch_norm_op.*` → cuDNN, `layer_norm_op.*` hand-written CUDA with
+welford reductions, `instance_norm_op`, `group_norm_op`). On TPU these are
+plain jnp reductions — XLA fuses mean/var/normalize/affine into one or two
+passes, matching the hand-fused CUDA kernels.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _val(p):
+    return p.value if hasattr(p, "value") else p
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW"):
+    """Returns (out, new_mean, new_var) in training mode — the functional
+    form; the BatchNorm layer handles buffer threading."""
+    rm, rv = _val(running_mean), _val(running_var)
+    channel_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    bshape = tuple(x.shape[i] if i == channel_axis else 1
+                   for i in range(x.ndim))
+    compute_dtype = jnp.promote_types(x.dtype, jnp.float32)
+    xc = x.astype(compute_dtype)
+    if training:
+        mean = jnp.mean(xc, axis=axes)
+        var = jnp.var(xc, axis=axes)
+        n = float(np.prod([x.shape[i] for i in axes]))
+        unbiased = var * (n / max(n - 1.0, 1.0))
+        new_mean = momentum * rm + (1.0 - momentum) * mean
+        new_var = momentum * rv + (1.0 - momentum) * unbiased
+    else:
+        mean, var = rm.astype(compute_dtype), rv.astype(compute_dtype)
+        new_mean, new_var = rm, rv
+    inv = jnp.reshape((var + epsilon) ** -0.5, bshape)
+    out = (xc - jnp.reshape(mean, bshape)) * inv
+    if weight is not None:
+        out = out * jnp.reshape(_val(weight).astype(compute_dtype), bshape)
+    if bias is not None:
+        out = out + jnp.reshape(_val(bias).astype(compute_dtype), bshape)
+    return out.astype(x.dtype), new_mean.astype(rm.dtype), \
+        new_var.astype(rv.dtype)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    """Reference: layer_norm_op. Stats in fp32 even under bf16 AMP (matches
+    the reference's float accumulators)."""
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n = len(tuple(normalized_shape))
+    axes = tuple(range(x.ndim - n, x.ndim))
+    compute_dtype = jnp.promote_types(x.dtype, jnp.float32)
+    xc = x.astype(compute_dtype)
+    mean = jnp.mean(xc, axis=axes, keepdims=True)
+    var = jnp.var(xc, axis=axes, keepdims=True)
+    out = (xc - mean) * jnp.reciprocal(jnp.sqrt(var + epsilon))
+    if weight is not None:
+        out = out * _val(weight).astype(compute_dtype)
+    if bias is not None:
+        out = out + _val(bias).astype(compute_dtype)
+    return out.astype(x.dtype)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, epsilon=1e-5, data_format="NCHW"):
+    channel_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(2, x.ndim)) if channel_axis == 1 else \
+        tuple(i for i in range(1, x.ndim - 1))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) / jnp.sqrt(var + epsilon)
+    bshape = tuple(x.shape[i] if i == channel_axis else 1
+                   for i in range(x.ndim))
+    if weight is not None:
+        out = out * jnp.reshape(_val(weight), bshape)
+    if bias is not None:
+        out = out + jnp.reshape(_val(bias), bshape)
+    return out
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW"):
+    if data_format == "NCHW":
+        n, c = x.shape[:2]
+        spatial = x.shape[2:]
+        g = num_groups
+        xg = jnp.reshape(x, (n, g, c // g) + spatial)
+        axes = tuple(range(2, xg.ndim))
+        mean = jnp.mean(xg, axis=axes, keepdims=True)
+        var = jnp.var(xg, axis=axes, keepdims=True)
+        out = jnp.reshape((xg - mean) / jnp.sqrt(var + epsilon), x.shape)
+        bshape = (1, c) + (1,) * len(spatial)
+    else:
+        n, c = x.shape[0], x.shape[-1]
+        spatial = x.shape[1:-1]
+        g = num_groups
+        xg = jnp.reshape(x, (n,) + spatial + (g, c // g))
+        axes = tuple(range(1, xg.ndim - 2)) + (xg.ndim - 1,)
+        mean = jnp.mean(xg, axis=axes, keepdims=True)
+        var = jnp.var(xg, axis=axes, keepdims=True)
+        out = jnp.reshape((xg - mean) / jnp.sqrt(var + epsilon), x.shape)
+        bshape = (1,) * (x.ndim - 1) + (c,)
+    if weight is not None:
+        out = out * jnp.reshape(_val(weight), bshape)
+    if bias is not None:
+        out = out + jnp.reshape(_val(bias), bshape)
+    return out
+
+
+def rms_norm(x, weight=None, epsilon=1e-6):
+    """Beyond-reference: RMSNorm for modern LLM blocks."""
+    compute_dtype = jnp.promote_types(x.dtype, jnp.float32)
+    xc = x.astype(compute_dtype)
+    ms = jnp.mean(jnp.square(xc), axis=-1, keepdims=True)
+    out = xc * jnp.reciprocal(jnp.sqrt(ms + epsilon))
+    if weight is not None:
+        out = out * _val(weight).astype(compute_dtype)
+    return out.astype(x.dtype)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    norm = jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True)
+    return x / jnp.maximum(norm, epsilon)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW"):
+    import jax
+    channel_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    sq = jnp.square(x)
+    half = size // 2
+    window = [1] * x.ndim
+    window[channel_axis] = size
+    pads = [(0, 0)] * x.ndim
+    pads[channel_axis] = (half, size - half - 1)
+    summed = jax.lax.reduce_window(sq, 0.0, jax.lax.add, tuple(window),
+                                   (1,) * x.ndim, pads)
+    return x / jnp.power(k + alpha * summed, beta)

@@ -1,0 +1,144 @@
+"""Pooling ops.
+
+Mirrors `python/paddle/nn/functional/pooling.py` (reference:
+`operators/pool_op.*` + `math/pooling.{cc,cu}`). Lowers to
+`lax.reduce_window`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .conv import _padding, _tuple
+
+
+def _pool(x, kernel, stride, padding, n, data_format, reducer, init,
+          ceil_mode=False):
+    channel_last = data_format in ("NHWC", "NDHWC", "NLC")
+    kernel = _tuple(kernel, n)
+    stride = _tuple(stride if stride is not None else kernel, n)
+    pad = _padding(padding, n)
+    if channel_last:
+        dims = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        pad_all = [(0, 0)] + (pad if not isinstance(pad, str) else pad) + [(0, 0)] \
+            if not isinstance(pad, str) else pad
+    else:
+        dims = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pad_all = [(0, 0), (0, 0)] + pad if not isinstance(pad, str) else pad
+    if ceil_mode and not isinstance(pad_all, str):
+        # extend the high side so the last partial window is included
+        spatial_off = 1 if channel_last else 2
+        pad_all = list(pad_all)
+        for i in range(n):
+            d = spatial_off + i
+            size = x.shape[d] + pad_all[d][0] + pad_all[d][1]
+            rem = (size - kernel[i]) % stride[i]
+            if rem != 0:
+                pad_all[d] = (pad_all[d][0], pad_all[d][1] + stride[i] - rem)
+    return jax.lax.reduce_window(x, init, reducer, dims, strides, pad_all)
+
+
+def _max_init(x):
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        return -jnp.inf
+    return jnp.iinfo(x.dtype).min
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW"):
+    return _pool(x, kernel_size, stride, padding, 2, data_format,
+                 jax.lax.max, _max_init(x), ceil_mode)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False):
+    return _pool(x, kernel_size, stride, padding, 1, "NCL", jax.lax.max,
+                 _max_init(x), ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW"):
+    return _pool(x, kernel_size, stride, padding, 3, data_format,
+                 jax.lax.max, _max_init(x), ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, data_format="NCHW"):
+    summed = _pool(x, kernel_size, stride, padding, 2, data_format,
+                   jax.lax.add, 0.0, ceil_mode)
+    if exclusive:
+        ones = jnp.ones_like(x)
+        counts = _pool(ones, kernel_size, stride, padding, 2, data_format,
+                       jax.lax.add, 0.0, ceil_mode)
+        return summed / counts
+    k = _tuple(kernel_size, 2)
+    return summed / float(np.prod(k))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True):
+    summed = _pool(x, kernel_size, stride, padding, 1, "NCL",
+                   jax.lax.add, 0.0, ceil_mode)
+    if exclusive:
+        counts = _pool(jnp.ones_like(x), kernel_size, stride, padding, 1,
+                       "NCL", jax.lax.add, 0.0, ceil_mode)
+        return summed / counts
+    return summed / float(_tuple(kernel_size, 1)[0])
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, data_format="NCDHW"):
+    summed = _pool(x, kernel_size, stride, padding, 3, data_format,
+                   jax.lax.add, 0.0, ceil_mode)
+    if exclusive:
+        counts = _pool(jnp.ones_like(x), kernel_size, stride, padding, 3,
+                       data_format, jax.lax.add, 0.0, ceil_mode)
+        return summed / counts
+    return summed / float(np.prod(_tuple(kernel_size, 3)))
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    """Reference: pool2d with adaptive=True."""
+    oh, ow = _tuple(output_size, 2)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x_ = jnp.reshape(x, (n, c, oh, h // oh, ow, w // ow)) \
+            if h % oh == 0 and w % ow == 0 else None
+        if x_ is not None:
+            return jnp.mean(x_, axis=(3, 5))
+        target = (n, c, oh, ow)
+    else:
+        n, h, w, c = x.shape
+        if h % oh == 0 and w % ow == 0:
+            x_ = jnp.reshape(x, (n, oh, h // oh, ow, w // ow, c))
+            return jnp.mean(x_, axis=(2, 4))
+        target = (n, oh, ow, c)
+    return jax.image.resize(x, target, method="linear").astype(x.dtype)
+
+
+def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
+    oh, ow = _tuple(output_size, 2)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        assert h % oh == 0 and w % ow == 0, \
+            "adaptive_max_pool2d requires divisible sizes on TPU"
+        return jnp.max(jnp.reshape(x, (n, c, oh, h // oh, ow, w // ow)),
+                       axis=(3, 5))
+    n, h, w, c = x.shape
+    return jnp.max(jnp.reshape(x, (n, oh, h // oh, ow, w // ow, c)),
+                   axis=(2, 4))
+
+
+def adaptive_avg_pool1d(x, output_size):
+    n, c, l = x.shape
+    o = output_size if isinstance(output_size, int) else output_size[0]
+    assert l % o == 0
+    return jnp.mean(jnp.reshape(x, (n, c, o, l // o)), axis=3)
+
+
+def global_avg_pool2d(x, data_format="NCHW"):
+    axes = (2, 3) if data_format == "NCHW" else (1, 2)
+    return jnp.mean(x, axis=axes, keepdims=True)

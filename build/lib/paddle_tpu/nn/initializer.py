@@ -1,0 +1,172 @@
+"""Weight initializers.
+
+Mirrors `python/paddle/fluid/initializer.py` (ConstantInitializer,
+UniformInitializer, NormalInitializer, TruncatedNormal, Xavier, MSRA) and the
+2.x `paddle.nn.initializer` namespace. An initializer is a callable
+`(shape, dtype) -> jax.Array` drawing from the global RNG
+(`paddle_tpu.framework.random`).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtypes import convert_dtype, get_default_dtype
+from ..framework.random import next_key
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]  # Linear layout [in, out]
+    # conv kernels use the reference's OIHW layout: [out_c, in_c, *spatial]
+    receptive = int(np.prod(shape[2:]))
+    fan_in = shape[1] * receptive
+    fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        return jnp.full(tuple(shape), self.value, dtype=dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        return jax.random.uniform(next_key(), tuple(shape), dtype=dtype,
+                                  minval=self.low, maxval=self.high)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        return jax.random.normal(next_key(), tuple(shape),
+                                 dtype=dtype) * self.std + self.mean
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        return jax.random.truncated_normal(
+            next_key(), -2.0, 2.0, tuple(shape), dtype=dtype
+        ) * self.std + self.mean
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(next_key(), tuple(shape), dtype=dtype,
+                                  minval=-limit, maxval=limit)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return jax.random.normal(next_key(), tuple(shape), dtype=dtype) * std
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(next_key(), tuple(shape), dtype=dtype,
+                                  minval=-limit, maxval=limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        std = gain / math.sqrt(fi)
+        return jax.random.normal(next_key(), tuple(shape), dtype=dtype) * std
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        arr = jnp.asarray(self.value, dtype=dtype)
+        assert tuple(arr.shape) == tuple(shape), \
+            f"Assign shape {arr.shape} != {tuple(shape)}"
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        init = jax.nn.initializers.orthogonal(scale=self.gain)
+        return init(next_key(), tuple(shape), dtype)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        init = jax.nn.initializers.delta_orthogonal()
+        return init(next_key(), tuple(shape), dtype)
+
+
+# paddle-2.x style aliases
+constant_ = Constant
+normal_ = Normal
+uniform_ = Uniform

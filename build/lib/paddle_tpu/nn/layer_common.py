@@ -1,0 +1,355 @@
+"""Common layers: Linear, Embedding, Dropout, containers, activations.
+
+Mirrors `python/paddle/nn/layer/common.py` + `container.py` +
+`activation.py` layer classes of the reference.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+import jax.numpy as jnp
+
+from . import functional as F
+from . import initializer as I
+from .layer import Layer, Parameter
+
+
+class Linear(Layer):
+    """Reference: `paddle.nn.Linear` — weight stored [in, out] so forward is
+    a single MXU matmul without transpose."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.weight = self.create_parameter(
+            (in_features, out_features),
+            default_initializer=weight_attr if isinstance(weight_attr, I.Initializer) else None)
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                (out_features,), is_bias=True,
+                default_initializer=bias_attr if isinstance(bias_attr, I.Initializer) else None)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+    def extra_repr(self):
+        return f"in={self.in_features}, out={self.out_features}"
+
+
+class Embedding(Layer):
+    """Reference: `paddle.nn.Embedding` (lookup_table_v2)."""
+
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
+                 sparse=False, weight_attr=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.padding_idx = padding_idx
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim),
+            default_initializer=weight_attr if isinstance(weight_attr, I.Initializer) else I.Normal(0.0, 1.0))
+        if padding_idx is not None:
+            self.weight.value = self.weight.value.at[padding_idx].set(0.0)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, padding_idx=self.padding_idx)
+
+    def extra_repr(self):
+        return f"{self.num_embeddings}, {self.embedding_dim}"
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, axis=None, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.axis = axis
+        self.mode = mode
+
+    def forward(self, x):
+        return F.dropout(x, p=self.p, axis=self.axis, training=self.training,
+                         mode=self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}"
+
+
+class Dropout2D(Layer):
+    def __init__(self, p=0.5, data_format="NCHW", name=None):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.dropout2d(x, p=self.p, training=self.training,
+                           data_format=self.data_format)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.alpha_dropout(x, p=self.p, training=self.training)
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self.start_axis = start_axis
+        self.stop_axis = stop_axis
+
+    def forward(self, x):
+        from ..tensor.manipulation import flatten
+        return flatten(x, self.start_axis, self.stop_axis)
+
+
+class Identity(Layer):
+    def forward(self, x):
+        return x
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 align_corners=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.size, self.scale_factor = size, scale_factor
+        self.mode, self.align_corners = mode, align_corners
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.interpolate(x, self.size, self.scale_factor, self.mode,
+                             self.align_corners, self.data_format)
+
+
+class Pad2D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCHW"):
+        super().__init__()
+        self.padding, self.mode = padding, mode
+        self.value, self.data_format = value, data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, self.mode, self.value, self.data_format)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format="NCHW"):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self.upscale_factor, self.data_format)
+
+
+class Bilinear(Layer):
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (out_features, in1_features, in2_features))
+        self.bias = None if bias_attr is False else \
+            self.create_parameter((out_features,), is_bias=True)
+
+    def forward(self, x1, x2):
+        return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+# --- containers (reference: python/paddle/nn/layer/container.py) ---
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], OrderedDict):
+            items = layers[0].items()
+        elif layers and isinstance(layers[0], (list, tuple)) and \
+                not isinstance(layers[0], Layer) and \
+                all(isinstance(t, tuple) for t in layers):
+            items = layers
+        else:
+            items = ((str(i), l) for i, l in enumerate(layers))
+        for name, layer in items:
+            self.add_sublayer(str(name), layer)
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers:
+            for i, l in enumerate(sublayers):
+                self.add_sublayer(str(i), l)
+
+    def append(self, sublayer):
+        self.add_sublayer(str(len(self._sub_layers)), sublayer)
+        return self
+
+    def extend(self, sublayers):
+        for l in sublayers:
+            self.append(l)
+        return self
+
+    def insert(self, index, sublayer):
+        layers = list(self._sub_layers.values())
+        layers.insert(index, sublayer)
+        self._sub_layers.clear()
+        for i, l in enumerate(layers):
+            self._sub_layers[str(i)] = l
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return list(self._sub_layers.values())[idx]
+        return self._sub_layers[str(idx if idx >= 0 else
+                                    len(self._sub_layers) + idx)]
+
+    def __setitem__(self, idx, layer):
+        self._sub_layers[str(idx)] = layer
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def append(self, parameter):
+        self.add_parameter(str(len(self._parameters)), parameter)
+        return self
+
+    def __getitem__(self, idx):
+        return self._parameters[str(idx)]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+
+class LayerDict(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers:
+            for name, l in (sublayers.items()
+                            if isinstance(sublayers, dict) else sublayers):
+                self.add_sublayer(name, l)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, layer):
+        self.add_sublayer(key, layer)
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+
+# --- activation layers ---
+
+def _act_layer(fn_name, *arg_names, **defaults):
+    fn = getattr(F, fn_name)
+
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kwargs = dict(defaults)
+            for n, v in zip(arg_names, args):
+                self._kwargs[n] = v
+            self._kwargs.update({k: v for k, v in kwargs.items()
+                                 if k in arg_names or k in defaults})
+
+        def forward(self, x):
+            return fn(x, **self._kwargs)
+
+    _Act.__name__ = fn_name.title().replace("_", "")
+    return _Act
+
+
+ReLU = _act_layer("relu")
+ReLU6 = _act_layer("relu6")
+LeakyReLU = _act_layer("leaky_relu", "negative_slope",
+                       negative_slope=0.01)
+ELU = _act_layer("elu", "alpha", alpha=1.0)
+SELU = _act_layer("selu")
+CELU = _act_layer("celu", "alpha", alpha=1.0)
+GELU = _act_layer("gelu", "approximate", approximate=False)
+Silu = _act_layer("silu")
+Swish = _act_layer("swish")
+Mish = _act_layer("mish")
+Sigmoid = _act_layer("sigmoid")
+Hardsigmoid = _act_layer("hardsigmoid")
+Hardswish = _act_layer("hardswish")
+Hardtanh = _act_layer("hardtanh", "min", "max", min=-1.0, max=1.0)
+Hardshrink = _act_layer("hardshrink", "threshold", threshold=0.5)
+Softshrink = _act_layer("softshrink", "threshold", threshold=0.5)
+Tanhshrink = _act_layer("tanhshrink")
+Tanh = _act_layer("tanh")
+Softplus = _act_layer("softplus", "beta", "threshold", beta=1.0,
+                      threshold=20.0)
+Softsign = _act_layer("softsign")
+LogSigmoid = _act_layer("log_sigmoid")
+Softmax = _act_layer("softmax", "axis", axis=-1)
+LogSoftmax = _act_layer("log_softmax", "axis", axis=-1)
+ThresholdedReLU = _act_layer("thresholded_relu", "threshold", threshold=1.0)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (num_parameters,), default_initializer=I.Constant(init))
+        self.data_format = data_format
+
+    def forward(self, x):
+        w = self.weight.value
+        if w.shape[0] > 1:
+            shape = [1] * x.ndim
+            ch = 1 if self.data_format.startswith("NC") else x.ndim - 1
+            shape[ch] = w.shape[0]
+            w = jnp.reshape(w, shape)
+        return jnp.where(x > 0, x, w * x)
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self.groups, self.axis = groups, axis
+
+    def forward(self, x):
+        return F.maxout(x, self.groups, self.axis)

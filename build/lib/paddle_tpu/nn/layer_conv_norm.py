@@ -1,0 +1,436 @@
+"""Conv / Norm / Pooling layer classes.
+
+Mirrors `python/paddle/nn/layer/conv.py`, `norm.py`, `pooling.py`.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import functional as F
+from . import initializer as I
+from .layer import Layer
+
+
+def _ntuple(v, n):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
+
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, n, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 transpose=False, output_padding=0):
+        super().__init__()
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _ntuple(kernel_size, n)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self.data_format = data_format
+        self._n = n
+        self._transpose = transpose
+        self.output_padding = output_padding
+        if transpose:
+            wshape = (in_channels, out_channels // groups) + self.kernel_size
+            fan_in = out_channels // groups * int(np.prod(self.kernel_size))
+        else:
+            wshape = (out_channels, in_channels // groups) + self.kernel_size
+            fan_in = in_channels // groups * int(np.prod(self.kernel_size))
+        init = weight_attr if isinstance(weight_attr, I.Initializer) else \
+            I.KaimingUniform(fan_in=fan_in)
+        self.weight = self.create_parameter(wshape, default_initializer=init)
+        if bias_attr is False:
+            self.bias = None
+        else:
+            binit = bias_attr if isinstance(bias_attr, I.Initializer) else \
+                I.Uniform(-1.0 / np.sqrt(fan_in), 1.0 / np.sqrt(fan_in))
+            self.bias = self.create_parameter(
+                (out_channels,), is_bias=True, default_initializer=binit)
+
+    def forward(self, x):
+        fn = {1: (F.conv1d, F.conv1d_transpose),
+              2: (F.conv2d, F.conv2d_transpose),
+              3: (F.conv3d, F.conv3d_transpose)}[self._n][self._transpose]
+        if self._transpose:
+            return fn(x, self.weight, self.bias, self.stride, self.padding,
+                      self.output_padding, self.dilation, self.groups,
+                      self.data_format)
+        return fn(x, self.weight, self.bias, self.stride, self.padding,
+                  self.dilation, self.groups, self.data_format)
+
+    def extra_repr(self):
+        return (f"{self.in_channels}, {self.out_channels}, "
+                f"kernel_size={self.kernel_size}, stride={self.stride}")
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride,
+                         padding, dilation, groups, padding_mode, weight_attr,
+                         bias_attr, data_format)
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, padding_mode, weight_attr,
+                         bias_attr, data_format)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, padding_mode, weight_attr,
+                         bias_attr, data_format)
+
+
+class Conv1DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride,
+                         padding, dilation, groups, "zeros", weight_attr,
+                         bias_attr, data_format, transpose=True,
+                         output_padding=output_padding)
+
+
+class Conv2DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, "zeros", weight_attr,
+                         bias_attr, data_format, transpose=True,
+                         output_padding=output_padding)
+
+
+class Conv3DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, "zeros", weight_attr,
+                         bias_attr, data_format, transpose=True,
+                         output_padding=output_padding)
+
+
+class _BatchNormBase(Layer):
+    """Reference: `paddle.nn.BatchNorm2D` (batch_norm_op + cuDNN). Running
+    stats live in buffers; the functional bridge threads their updates
+    through jit (see `functional_call`)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.use_global_stats = use_global_stats
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(
+                (num_features,), default_initializer=I.Constant(1.0))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter((num_features,), is_bias=True)
+        self.register_buffer("_mean", jnp.zeros((num_features,)))
+        self.register_buffer("_variance", jnp.ones((num_features,)))
+
+    def forward(self, x):
+        training = self.training and not self.use_global_stats
+        out, new_mean, new_var = F.batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=training, momentum=self.momentum, epsilon=self.epsilon,
+            data_format=self.data_format)
+        if training:
+            self._mean.value = new_mean
+            self._variance.value = new_var
+        return out
+
+    def extra_repr(self):
+        return f"num_features={self.num_features}"
+
+
+class BatchNorm1D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, "NCL" if data_format == "NCL" else
+                         data_format, use_global_stats, name)
+
+    def forward(self, x):
+        if x.ndim == 2:
+            x3 = x[:, :, None]
+            out = super().forward(x3)
+            return out[:, :, 0]
+        return super().forward(x)
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, data_format, use_global_stats, name)
+
+
+BatchNorm = _BatchNormBase  # 1.x alias
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Reference: sync_batch_norm_op (NCCL allreduce of stats). On TPU the
+    cross-replica mean/var ride a psum over the data axis when run inside
+    shard_map; under plain GSPMD data parallelism, per-replica stats match
+    the reference's default (non-sync) DP behaviour."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 axis_name="data", name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, data_format, None, name)
+        self.axis_name = axis_name
+
+    def forward(self, x):
+        import jax
+        if not self.training:
+            return super().forward(x)
+        channel_axis = 1 if self.data_format.startswith("NC") else x.ndim - 1
+        axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+        mean = jnp.mean(x, axis=axes)
+        meansq = jnp.mean(jnp.square(x), axis=axes)
+        try:
+            mean = jax.lax.pmean(mean, self.axis_name)
+            meansq = jax.lax.pmean(meansq, self.axis_name)
+        except NameError:
+            pass  # not inside a mapped axis: degenerate to local BN
+        var = meansq - jnp.square(mean)
+        bshape = tuple(x.shape[i] if i == channel_axis else 1
+                       for i in range(x.ndim))
+        out = (x - jnp.reshape(mean, bshape)) * jnp.reshape(
+            (var + self.epsilon) ** -0.5, bshape)
+        if self.weight is not None:
+            out = out * jnp.reshape(self.weight.value, bshape)
+        if self.bias is not None:
+            out = out + jnp.reshape(self.bias.value, bshape)
+        self._mean.value = self.momentum * self._mean.value + \
+            (1 - self.momentum) * mean
+        self._variance.value = self.momentum * self._variance.value + \
+            (1 - self.momentum) * var
+        return out
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        """Reference: SyncBatchNorm.convert_sync_batchnorm."""
+        if isinstance(layer, _BatchNormBase) and not isinstance(
+                layer, SyncBatchNorm):
+            new = cls(layer.num_features, layer.momentum, layer.epsilon,
+                      data_format=layer.data_format)
+            new.set_state_dict(layer.state_dict())
+            return new
+        for name, sub in list(layer._sub_layers.items()):
+            layer.add_sublayer(name, cls.convert_sync_batchnorm(sub))
+        return layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.epsilon = epsilon
+        self.weight = None if weight_attr is False else self.create_parameter(
+            self.normalized_shape, default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            self.normalized_shape, is_bias=True)
+
+    def forward(self, x):
+        return F.layer_norm(x, self.normalized_shape, self.weight, self.bias,
+                            self.epsilon)
+
+    def extra_repr(self):
+        return f"normalized_shape={self.normalized_shape}"
+
+
+class RMSNorm(Layer):
+    """Beyond-reference (modern LLM blocks)."""
+
+    def __init__(self, hidden_size, epsilon=1e-6):
+        super().__init__()
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            (hidden_size,), default_initializer=I.Constant(1.0))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self.epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.num_groups = num_groups
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.weight = None if weight_attr is False else self.create_parameter(
+            (num_channels,), default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (num_channels,), is_bias=True)
+
+    def forward(self, x):
+        return F.group_norm(x, self.num_groups, self.weight, self.bias,
+                            self.epsilon, self.data_format)
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.weight = None if weight_attr is False else self.create_parameter(
+            (num_features,), default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (num_features,), is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.weight, bias=self.bias,
+                               epsilon=self.epsilon,
+                               data_format=self.data_format)
+
+
+InstanceNorm1D = InstanceNorm2D
+InstanceNorm3D = InstanceNorm2D
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.local_response_norm(x, self.size, self.alpha, self.beta,
+                                     self.k, self.data_format)
+
+
+# --- pooling layers ---
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, ceil_mode)
+        self.data_format = data_format
+
+    def forward(self, x):
+        k, s, p, c = self.args
+        return F.max_pool2d(x, k, s, p, c, data_format=self.data_format)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, ceil_mode, exclusive)
+        self.data_format = data_format
+
+    def forward(self, x):
+        k, s, p, c, e = self.args
+        return F.avg_pool2d(x, k, s, p, c, e, data_format=self.data_format)
+
+
+class MaxPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, ceil_mode)
+
+    def forward(self, x):
+        k, s, p, c = self.args
+        return F.max_pool1d(x, k, s, p, c)
+
+
+class AvgPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, ceil_mode, exclusive)
+
+    def forward(self, x):
+        k, s, p, c, e = self.args
+        return F.avg_pool1d(x, k, s, p, c, e)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NCDHW", name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, ceil_mode)
+        self.data_format = data_format
+
+    def forward(self, x):
+        k, s, p, c = self.args
+        return F.max_pool3d(x, k, s, p, c, data_format=self.data_format)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, data_format="NCDHW", name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, ceil_mode, exclusive)
+        self.data_format = data_format
+
+    def forward(self, x):
+        k, s, p, c, e = self.args
+        return F.avg_pool3d(x, k, s, p, c, e, data_format=self.data_format)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size, data_format="NCHW", name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size, self.data_format)
+
+
+class AdaptiveMaxPool2D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self.output_size)
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self.output_size)

@@ -1,0 +1,290 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU.
+
+Mirrors `python/paddle/nn/layer/rnn.py` (reference: `operators/rnn_op` →
+cuDNN fused LSTM/GRU). TPU-native design: the time loop is a `lax.scan` so
+the whole recurrence compiles to a single fused XLA while-loop; weights for
+all gates are packed into one matmul per step (the same trick cuDNN uses).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import initializer as I
+from .layer import Layer
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_size, hidden_size, dtype=None):
+        dtype = dtype or self._dtype
+        return jnp.zeros((batch_size, hidden_size), dtype=dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / hidden_size ** 0.5
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter((input_size, hidden_size),
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter((hidden_size, hidden_size),
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter((hidden_size,), is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter((hidden_size,), is_bias=True,
+                                             default_initializer=init)
+        self.activation = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None else self.get_initial_states(
+            inputs.shape[0], self.hidden_size, inputs.dtype)
+        z = inputs @ self.weight_ih.value + self.bias_ih.value + \
+            h @ self.weight_hh.value + self.bias_hh.value
+        h = self.activation(z)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    """Gates packed [i, f, g, o] along the output dim — one matmul/step."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / hidden_size ** 0.5
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            (input_size, 4 * hidden_size), default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            (hidden_size, 4 * hidden_size), default_initializer=init)
+        self.bias_ih = self.create_parameter((4 * hidden_size,), is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter((4 * hidden_size,), is_bias=True,
+                                             default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs.shape[0], self.hidden_size,
+                                        inputs.dtype)
+            c = h
+        else:
+            h, c = states
+        z = inputs @ self.weight_ih.value + self.bias_ih.value + \
+            h @ self.weight_hh.value + self.bias_hh.value
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        return h, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / hidden_size ** 0.5
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            (input_size, 3 * hidden_size), default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            (hidden_size, 3 * hidden_size), default_initializer=init)
+        self.bias_ih = self.create_parameter((3 * hidden_size,), is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter((3 * hidden_size,), is_bias=True,
+                                             default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None else self.get_initial_states(
+            inputs.shape[0], self.hidden_size, inputs.dtype)
+        zi = inputs @ self.weight_ih.value + self.bias_ih.value
+        zh = h @ self.weight_hh.value + self.bias_hh.value
+        ri, ui, ci = jnp.split(zi, 3, axis=-1)
+        rh, uh, ch = jnp.split(zh, 3, axis=-1)
+        r = jax.nn.sigmoid(ri + rh)
+        u = jax.nn.sigmoid(ui + uh)
+        c = jnp.tanh(ci + r * ch)
+        h = u * h + (1.0 - u) * c
+        return h, h
+
+
+class RNN(Layer):
+    """Runs a cell over time with `lax.scan` (reference: rnn.py RNN class,
+    which python-loops in dygraph and builds a while_op in static mode)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if not self.time_major:
+            inputs = jnp.swapaxes(inputs, 0, 1)  # [T, B, F]
+        if self.is_reverse:
+            inputs = jnp.flip(inputs, axis=0)
+        T, batch = inputs.shape[0], inputs.shape[1]
+        if initial_states is None:
+            if isinstance(self.cell, LSTMCell):
+                z = jnp.zeros((batch, self.cell.hidden_size), inputs.dtype)
+                initial_states = (z, z)
+            else:
+                initial_states = jnp.zeros(
+                    (batch, self.cell.hidden_size), inputs.dtype)
+
+        if sequence_length is None:
+            def step(state, x_t):
+                out, new_state = self.cell(x_t, state)
+                return new_state, out
+            final_state, outputs = jax.lax.scan(step, initial_states, inputs)
+        else:
+            # variable length: freeze state and zero outputs past each
+            # sequence's end (reference: rnn.py mask-based update)
+            seq_len = jnp.asarray(sequence_length)
+            steps = jnp.arange(T)
+            if self.is_reverse:
+                # step t in reversed order touches original index T-1-t:
+                # valid iff original index >= T - len (suffix alignment)
+                valid = (T - 1 - steps[:, None]) >= (T - seq_len[None, :])
+            else:
+                valid = steps[:, None] < seq_len[None, :]
+
+            def step(state, inp):
+                x_t, keep = inp  # keep: [B] bool
+                out, new_state = self.cell(x_t, state)
+                keepc = keep[:, None]
+                new_state = jax.tree.map(
+                    lambda n, o: jnp.where(keepc, n, o), new_state, state)
+                out = jnp.where(keepc, out, jnp.zeros_like(out))
+                return new_state, out
+
+            final_state, outputs = jax.lax.scan(
+                step, initial_states, (inputs, valid))
+        if self.is_reverse:
+            outputs = jnp.flip(outputs, axis=0)
+        if not self.time_major:
+            outputs = jnp.swapaxes(outputs, 0, 1)
+        return outputs, final_state
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        states = initial_states if initial_states is not None else \
+            (None, None)
+        out_fw, st_fw = self.rnn_fw(inputs, states[0],
+                                    sequence_length=sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, states[1],
+                                    sequence_length=sequence_length)
+        return jnp.concatenate([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        from .layer_common import LayerList
+        self.mode = mode
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        cell_cls = {"LSTM": LSTMCell, "GRU": GRUCell,
+                    "RNN_TANH": SimpleRNNCell}[mode]
+        num_dir = 2 if self.bidirect else 1
+        self.rnns = LayerList()
+        for layer_i in range(num_layers):
+            in_size = input_size if layer_i == 0 else hidden_size * num_dir
+            if self.bidirect:
+                self.rnns.append(BiRNN(cell_cls(in_size, hidden_size),
+                                       cell_cls(in_size, hidden_size),
+                                       time_major=time_major))
+            else:
+                self.rnns.append(RNN(cell_cls(in_size, hidden_size),
+                                     time_major=time_major))
+
+    def _layer_initial_states(self, initial_states, layer_i):
+        """Slice paddle's stacked [num_layers*num_dir, B, H] states for one
+        layer (pair for bidirect, (h, c) tuple for LSTM)."""
+        if initial_states is None:
+            return None
+        num_dir = 2 if self.bidirect else 1
+        lo = layer_i * num_dir
+
+        def pick(s, i):
+            return s[lo + i]
+
+        if self.mode == "LSTM":
+            h0, c0 = initial_states
+            if self.bidirect:
+                return ((pick(h0, 0), pick(c0, 0)),
+                        (pick(h0, 1), pick(c0, 1)))
+            return (pick(h0, 0), pick(c0, 0))
+        h0 = initial_states
+        if self.bidirect:
+            return (pick(h0, 0), pick(h0, 1))
+        return pick(h0, 0)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .functional.common import dropout as F_dropout
+        out = inputs
+        final_states = []
+        for i, rnn in enumerate(self.rnns):
+            st0 = self._layer_initial_states(initial_states, i)
+            out, st = rnn(out, st0, sequence_length=sequence_length)
+            final_states.append(st)
+            if self.dropout > 0.0 and i < self.num_layers - 1:
+                out = F_dropout(out, p=self.dropout, training=self.training)
+        # stack final states along layer*dir axis like paddle
+        if self.mode == "LSTM":
+            if self.bidirect:
+                hs = [s[0] for pair in final_states for s in pair]
+                cs = [s[1] for pair in final_states for s in pair]
+            else:
+                hs = [s[0] for s in final_states]
+                cs = [s[1] for s in final_states]
+            return out, (jnp.stack(hs), jnp.stack(cs))
+        if self.bidirect:
+            hs = [s for pair in final_states for s in pair]
+        else:
+            hs = final_states
+        return out, jnp.stack(hs)
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__("RNN_TANH", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)

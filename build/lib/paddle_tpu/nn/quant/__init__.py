@@ -1,0 +1,10 @@
+"""Quantization-aware-training layers (reference:
+python/paddle/nn/quant/quant_layers.py + the slim QAT passes
+`fluid/contrib/slim/quantization/`)."""
+from .quant_layers import (  # noqa: F401
+    FakeQuantAbsMax,
+    FakeQuantMovingAverageAbsMax,
+    QuantizedConv2D,
+    QuantizedLinear,
+    fake_quant,
+)

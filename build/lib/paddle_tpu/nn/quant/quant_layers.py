@@ -1,0 +1,100 @@
+"""QAT fake-quantization layers.
+
+Reference: `python/paddle/nn/quant/quant_layers.py`
+(QuantizedLinear/QuantizedConv2D wrapping a float layer with
+fake_quantize ops) and the imperative QAT pass
+(`fluid/contrib/slim/quantization/imperative/qat.py`). The fake-quant op
+is a straight-through estimator: round in the forward, identity gradient
+— expressed here with jax's stop_gradient trick, which XLA folds into
+the surrounding computation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..layer import Layer
+from .. import functional as F
+
+
+def fake_quant(x, scale, bits: int = 8):
+    """Symmetric uniform fake quantization with straight-through grads."""
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = jnp.maximum(jnp.asarray(scale, jnp.float32), 1e-8)
+    q = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax) * scale / qmax
+    # straight-through: forward q, backward identity
+    return x + jax.lax.stop_gradient(q - x)
+
+
+class FakeQuantAbsMax(Layer):
+    """Per-tensor abs-max scale, recomputed every call (weight quant)."""
+
+    def __init__(self, quant_bits: int = 8, name=None):
+        super().__init__()
+        self.quant_bits = quant_bits
+
+    def forward(self, x):
+        scale = jnp.max(jnp.abs(x))
+        return fake_quant(x, scale, self.quant_bits)
+
+
+class FakeQuantMovingAverageAbsMax(Layer):
+    """EMA of the abs-max (activation quant; reference:
+    moving_average_abs_max)."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9,
+                 name=None):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.moving_rate = moving_rate
+        self.register_buffer("scale", jnp.ones((), jnp.float32))
+
+    def forward(self, x):
+        cur = jnp.max(jnp.abs(x)).astype(jnp.float32)
+        r = self.moving_rate
+        if self.training:
+            new_scale = r * self.scale.value + (1 - r) * cur
+            self.scale.value = new_scale
+        else:
+            new_scale = self.scale.value
+        return fake_quant(x, new_scale, self.quant_bits)
+
+
+class QuantizedLinear(Layer):
+    """Reference: quant_layers.py QuantizedLinear — wraps a float Linear
+    with weight+activation fake quant."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, **kwargs):
+        super().__init__()
+        self.inner = layer
+        self.weight_quant = FakeQuantAbsMax(weight_bits)
+        self.act_quant = FakeQuantMovingAverageAbsMax(activation_bits,
+                                                      moving_rate)
+
+    def forward(self, x):
+        x = self.act_quant(x)
+        w = self.weight_quant(jnp.asarray(self.inner.weight))
+        b = self.inner.bias
+        return F.linear(x, w, None if b is None else jnp.asarray(b))
+
+
+class QuantizedConv2D(Layer):
+    """Reference: quant_layers.py QuantizedConv2D."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, **kwargs):
+        super().__init__()
+        self.inner = layer
+        self.weight_quant = FakeQuantAbsMax(weight_bits)
+        self.act_quant = FakeQuantMovingAverageAbsMax(activation_bits,
+                                                      moving_rate)
+
+    def forward(self, x):
+        x = self.act_quant(x)
+        inner = self.inner
+        w = self.weight_quant(jnp.asarray(inner.weight))
+        return F.conv2d(
+            x, w, None if inner.bias is None else jnp.asarray(inner.bias),
+            stride=inner.stride, padding=inner.padding,
+            dilation=inner.dilation, groups=inner.groups)

@@ -1,0 +1,32 @@
+"""`paddle.onnx` equivalent (reference: python/paddle/onnx/export.py —
+a thin wrapper over the external paddle2onnx package).
+
+ONNX is a CUDA/CPU deployment interchange; the TPU deployment artifact is
+shape-polymorphic StableHLO (`paddle_tpu.jit.save`), which XLA consumes
+directly. There is no ONNX converter in this environment, so `export`
+saves the StableHLO artifact and returns its path explicitly marked as
+`.pdmodel` (NOT a `.onnx` file) — callers that need a real ONNX graph
+must run external tooling on another stack.
+"""
+from __future__ import annotations
+
+import warnings
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Reference: onnx/export.py `paddle.onnx.export`. Saves the
+    StableHLO inference artifact (`<path>.pdmodel` + `.pdiparams`) and
+    returns the `.pdmodel` path. A warning makes explicit that the file
+    is StableHLO, not ONNX protobuf."""
+    from ..jit import save as jit_save
+    if input_spec is None:
+        raise ValueError("paddle_tpu.onnx.export requires input_spec")
+    if path.endswith(".onnx"):
+        path = path[:-len(".onnx")]
+    warnings.warn(
+        "paddle_tpu.onnx.export writes a StableHLO .pdmodel artifact "
+        "(loadable with paddle_tpu.jit.load / paddle_tpu.inference), not "
+        "an ONNX protobuf; convert externally if ONNX is required.",
+        UserWarning, stacklevel=2)
+    jit_save(layer, path, input_spec=input_spec)
+    return path + ".pdmodel"

@@ -1,0 +1,7 @@
+"""Pallas TPU kernels — the hand-tuned hot-op layer.
+
+Equivalent role to the reference's `operators/fused/` CUDA kernels and the
+x86 JIT assembler (`operators/jit/gen/`): everything XLA fuses poorly by
+itself lives here. Kernels are drop-in replacements for the XLA compositions
+behind `FLAGS_enable_pallas_kernels`.
+"""

@@ -1,0 +1,275 @@
+"""Flash attention — Pallas TPU kernel (training-capable, custom VJP).
+
+Replaces the reference's inference-only fused attention CUDA kernels
+(`operators/fused/multihead_matmul_op.cu`,
+`operators/math/bert_encoder_functor.cu`) with a fused kernel that works in
+both directions: the S×S score matrix lives only tile-by-tile in VMEM, so
+long sequences never materialize O(S²) in HBM.
+
+Layout contract: [batch, seq, heads, head_dim] (paddle 2.x attention
+layout); internally [b·h, s, d]. All three kernels (fwd, dq, dk/dv) walk a
+3-D grid (bh, out_tile, reduce_tile) with only 128-row tiles in VMEM and
+fp32 scratch accumulators — VMEM use is O(BLOCK·d) regardless of S, so the
+same kernel serves 1K and 64K tokens (and each ring-attention shard,
+sequence_parallel.py). Row statistics (logsumexp) ride a 128-lane broadcast
+because TPU block layouts need a 128-divisible last dim.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK = 128
+# Row statistics (lse/delta) ride an 8-lane broadcast: TPU block layouts
+# need the last two dims (sublane, lane) to divide (8, 128) or equal the
+# array dims — a trailing dim of 8 equals itself, keeping the stat arrays
+# at 8x logical size instead of 128x.
+LANE = 8
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _params():
+    if _interpret():
+        return {}
+    return dict(compiler_params=pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary")))
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, causal, scale, nk):
+    iq, jk = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(jk == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(jnp.logical_or(not causal, jk <= iq))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale          # [BQ, d]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = iq * BLOCK + jax.lax.broadcasted_iota(
+                jnp.int32, (BLOCK, BLOCK), 0)
+            k_pos = jk * BLOCK + jax.lax.broadcasted_iota(
+                jnp.int32, (BLOCK, BLOCK), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jax.lax.broadcast_in_dim(m_new[:, 0], m_ref.shape, (0,))
+
+    @pl.when(jk == nk - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_ref[:, 0:1], 1e-30)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse = m_ref[:, 0:1] + jnp.log(l_safe)
+        lse_ref[0] = jax.lax.broadcast_in_dim(lse[:, 0],
+                                              lse_ref.shape[1:], (0,))
+
+
+def _fwd(q3, k3, v3, causal, scale):
+    bh, s, d = q3.shape
+    n = s // BLOCK
+    qt = pl.BlockSpec((1, BLOCK, d), lambda b, i, j: (b, i, 0),
+                      memory_space=pltpu.VMEM)
+    kt = pl.BlockSpec((1, BLOCK, d), lambda b, i, j: (b, j, 0),
+                      memory_space=pltpu.VMEM)
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, causal=causal, scale=scale, nk=n),
+        grid=(bh, n, n),
+        in_specs=[qt, kt, kt],
+        out_specs=[qt,
+                   pl.BlockSpec((1, BLOCK, LANE), lambda b, i, j: (b, i, 0),
+                                memory_space=pltpu.VMEM)],
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
+                   jax.ShapeDtypeStruct((bh, s, LANE), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((BLOCK, d), jnp.float32),
+                        pltpu.VMEM((BLOCK, 128), jnp.float32),
+                        pltpu.VMEM((BLOCK, 128), jnp.float32)],
+        interpret=_interpret(),
+        **_params(),
+    )(q3, k3, v3)
+    return o, lse
+
+
+# --------------------------------------------------------------- backward
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_ref, *, causal, scale, nk):
+    iq, jk = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(jk == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(jnp.logical_or(not causal, jk <= iq))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0:1]
+        delta = delta_ref[0][:, 0:1]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = iq * BLOCK + jax.lax.broadcasted_iota(
+                jnp.int32, (BLOCK, BLOCK), 0)
+            k_pos = jk * BLOCK + jax.lax.broadcasted_iota(
+                jnp.int32, (BLOCK, BLOCK), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(jk == nk - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, causal, scale, nq):
+    jk, i = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(jnp.logical_or(not causal, i >= jk))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0:1]
+        delta = delta_ref[0][:, 0:1]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = i * BLOCK + jax.lax.broadcasted_iota(
+                jnp.int32, (BLOCK, BLOCK), 0)
+            k_pos = jk * BLOCK + jax.lax.broadcasted_iota(
+                jnp.int32, (BLOCK, BLOCK), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                              # [BQ, BK]
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(causal, scale, res, g):
+    q3, k3, v3, o3, lse = res
+    bh, s, d = q3.shape
+    n = s // BLOCK
+    do3 = g
+    # softmax delta rowsum(dO·O), precomputed once (not per k-tile) and
+    # broadcast over the 128-lane stat layout like lse
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1)
+    delta3 = jnp.broadcast_to(delta[..., None], (bh, s, LANE))
+
+    def tile_i(b, i, j):
+        return (b, i, 0)
+
+    def tile_j(b, i, j):
+        return (b, j, 0)
+
+    ti = pl.BlockSpec((1, BLOCK, d), tile_i, memory_space=pltpu.VMEM)
+    tj = pl.BlockSpec((1, BLOCK, d), tile_j, memory_space=pltpu.VMEM)
+    lse_i = pl.BlockSpec((1, BLOCK, LANE), tile_i, memory_space=pltpu.VMEM)
+    lse_j = pl.BlockSpec((1, BLOCK, LANE), tile_j, memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, scale=scale, nk=n),
+        grid=(bh, n, n),
+        in_specs=[ti, tj, tj, ti, lse_i, lse_i],
+        out_specs=[ti],
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), q3.dtype)],
+        scratch_shapes=[pltpu.VMEM((BLOCK, d), jnp.float32)],
+        interpret=_interpret(),
+        **_params(),
+    )(q3, k3, v3, do3, lse, delta3)[0]
+
+    # grid dims: (bh, k_tile, q_tile) — q is the reduce (innermost) dim
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, scale=scale, nq=n),
+        grid=(bh, n, n),
+        in_specs=[tj, ti, ti, tj, lse_j, lse_j],
+        out_specs=[ti, ti],
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), k3.dtype),
+                   jax.ShapeDtypeStruct((bh, s, d), v3.dtype)],
+        scratch_shapes=[pltpu.VMEM((BLOCK, d), jnp.float32),
+                        pltpu.VMEM((BLOCK, d), jnp.float32)],
+        interpret=_interpret(),
+        **_params(),
+    )(q3, k3, v3, do3, lse, delta3)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------- public op
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash3(q3, k3, v3, causal, scale):
+    o, _ = _fwd(q3, k3, v3, causal, scale)
+    return o
+
+
+def _flash3_fwd(q3, k3, v3, causal, scale):
+    o, lse = _fwd(q3, k3, v3, causal, scale)
+    return o, (q3, k3, v3, o, lse)
+
+
+_flash3.defvjp(_flash3_fwd, _bwd)
+
+
+def flash_attention(query, key, value, causal: bool = False,
+                    scale=None):
+    """[b, s, h, d] fused attention. Requires s % 128 == 0."""
+    b, s, h, d = query.shape
+    if s % BLOCK != 0:
+        raise ValueError(f"flash_attention needs seq % {BLOCK} == 0, "
+                         f"got {s}")
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    def to3(x):
+        return jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
+
+    o3 = _flash3(to3(query), to3(key), to3(value), causal, scale)
+    return jnp.swapaxes(o3.reshape(b, h, s, d), 1, 2)

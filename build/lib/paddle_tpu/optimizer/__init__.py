@@ -1,0 +1,15 @@
+"""`paddle.optimizer` equivalent namespace."""
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    SGD,
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    LarsMomentum,
+    Momentum,
+    Optimizer,
+    RMSProp,
+)

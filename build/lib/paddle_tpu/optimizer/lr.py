@@ -1,0 +1,349 @@
+"""Learning-rate schedulers.
+
+Mirrors `python/paddle/optimizer/lr.py:37-1393` (LRScheduler base + 14
+schedulers). Dual API:
+
+- Stateful paddle parity: `sched.step()`, `sched.get_lr()`, `sched()`.
+- Traceable: `sched.lr_fn(step)` — pure function of the (possibly traced)
+  global step, used inside compiled training steps so LR decay happens
+  on-device with no host round-trip.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+
+class LRScheduler:
+    """Reference: lr.py:37."""
+
+    def __init__(self, learning_rate=0.1, last_epoch=-1, verbose=False):
+        self.base_lr = float(learning_rate)
+        self.last_epoch = last_epoch
+        self.verbose = verbose
+        self.last_lr = self.base_lr
+        self.step()
+
+    def __call__(self):
+        return self.last_lr
+
+    def step(self, epoch: Optional[int] = None):
+        if epoch is None:
+            self.last_epoch += 1
+        else:
+            self.last_epoch = epoch
+        self.last_lr = self.get_lr()
+
+    def get_lr(self) -> float:
+        return float(self.lr_fn(self.last_epoch))
+
+    # traceable form; subclasses implement in jnp so `step` may be a tracer
+    def lr_fn(self, step):
+        raise NotImplementedError
+
+    def state_dict(self):
+        return {"last_epoch": self.last_epoch, "last_lr": self.last_lr}
+
+    def set_state_dict(self, state):
+        self.last_epoch = state.get("last_epoch", self.last_epoch)
+        self.last_lr = state.get("last_lr", self.last_lr)
+
+    set_dict = set_state_dict
+    state_keys = state_dict
+
+
+class NoamDecay(LRScheduler):
+    """Reference: lr.py NoamDecay — d_model^-0.5 * min(t^-0.5, t*w^-1.5)."""
+
+    def __init__(self, d_model, warmup_steps, learning_rate=1.0,
+                 last_epoch=-1, verbose=False):
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_fn(self, step):
+        t = jnp.maximum(jnp.asarray(step, jnp.float32), 1.0)
+        return self.base_lr * self.d_model ** -0.5 * jnp.minimum(
+            t ** -0.5, t * self.warmup_steps ** -1.5)
+
+
+class PiecewiseDecay(LRScheduler):
+    def __init__(self, boundaries: List[int], values: List[float],
+                 last_epoch=-1, verbose=False):
+        self.boundaries = list(boundaries)
+        self.values = list(values)
+        super().__init__(values[0], last_epoch, verbose)
+
+    def lr_fn(self, step):
+        step = jnp.asarray(step)
+        idx = jnp.searchsorted(jnp.asarray(self.boundaries), step,
+                               side="right")
+        return jnp.take(jnp.asarray(self.values, jnp.float32), idx)
+
+
+class NaturalExpDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_fn(self, step):
+        return self.base_lr * jnp.exp(-self.gamma *
+                                      jnp.asarray(step, jnp.float32))
+
+
+class InverseTimeDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_fn(self, step):
+        return self.base_lr / (1.0 + self.gamma *
+                               jnp.asarray(step, jnp.float32))
+
+
+class PolynomialDecay(LRScheduler):
+    def __init__(self, learning_rate, decay_steps, end_lr=0.0001, power=1.0,
+                 cycle=False, last_epoch=-1, verbose=False):
+        self.decay_steps = decay_steps
+        self.end_lr = end_lr
+        self.power = power
+        self.cycle = cycle
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_fn(self, step):
+        t = jnp.asarray(step, jnp.float32)
+        if self.cycle:
+            div = jnp.ceil(jnp.maximum(t, 1.0) / self.decay_steps)
+            decay_steps = self.decay_steps * jnp.maximum(div, 1.0)
+        else:
+            decay_steps = self.decay_steps
+            t = jnp.minimum(t, decay_steps)
+        frac = (1.0 - t / decay_steps) ** self.power
+        return (self.base_lr - self.end_lr) * frac + self.end_lr
+
+
+class LinearWarmup(LRScheduler):
+    def __init__(self, learning_rate, warmup_steps, start_lr, end_lr,
+                 last_epoch=-1, verbose=False):
+        self.lr_after = learning_rate  # float or LRScheduler
+        self.warmup_steps = warmup_steps
+        self.start_lr = start_lr
+        self.end_lr = end_lr
+        base = learning_rate.base_lr if isinstance(learning_rate,
+                                                   LRScheduler) else \
+            float(learning_rate)
+        super().__init__(base, last_epoch, verbose)
+
+    def lr_fn(self, step):
+        t = jnp.asarray(step, jnp.float32)
+        warm = self.start_lr + (self.end_lr - self.start_lr) * \
+            jnp.minimum(t, self.warmup_steps) / max(self.warmup_steps, 1)
+        if isinstance(self.lr_after, LRScheduler):
+            after = self.lr_after.lr_fn(
+                jnp.maximum(t - self.warmup_steps, 0.0))
+        else:
+            after = jnp.asarray(self.lr_after, jnp.float32)
+        return jnp.where(t < self.warmup_steps, warm, after)
+
+    def step(self, epoch=None):
+        if isinstance(self.lr_after, LRScheduler) and \
+                self.last_epoch >= self.warmup_steps:
+            self.lr_after.step(epoch)
+        super().step(epoch)
+
+
+class ExponentialDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_fn(self, step):
+        return self.base_lr * self.gamma ** jnp.asarray(step, jnp.float32)
+
+
+class MultiStepDecay(LRScheduler):
+    def __init__(self, learning_rate, milestones, gamma=0.1, last_epoch=-1,
+                 verbose=False):
+        self.milestones = list(milestones)
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_fn(self, step):
+        n = jnp.sum(jnp.asarray(self.milestones) <=
+                    jnp.asarray(step)).astype(jnp.float32)
+        return self.base_lr * self.gamma ** n
+
+
+class StepDecay(LRScheduler):
+    def __init__(self, learning_rate, step_size, gamma=0.1, last_epoch=-1,
+                 verbose=False):
+        self.step_size = step_size
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_fn(self, step):
+        n = jnp.floor_divide(jnp.asarray(step), self.step_size)
+        return self.base_lr * self.gamma ** n.astype(jnp.float32)
+
+
+class LambdaDecay(LRScheduler):
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
+                 verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_fn(self, step):
+        return self.base_lr * self.lr_lambda(step)
+
+
+class ReduceOnPlateau(LRScheduler):
+    """Metric-driven, inherently host-side (reference: lr.py
+    ReduceOnPlateau). No traceable form — call `step(metric)` per epoch."""
+
+    def __init__(self, learning_rate, mode="min", factor=0.1, patience=10,
+                 threshold=1e-4, threshold_mode="rel", cooldown=0, min_lr=0,
+                 epsilon=1e-8, verbose=False):
+        self.mode = mode
+        self.factor = factor
+        self.patience = patience
+        self.threshold = threshold
+        self.threshold_mode = threshold_mode
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.epsilon = epsilon
+        self.best = None
+        self.cooldown_counter = 0
+        self.num_bad_epochs = 0
+        self.base_lr = float(learning_rate)
+        self.last_lr = self.base_lr
+        self.last_epoch = 0
+        self.verbose = verbose
+
+    def lr_fn(self, step):
+        return jnp.asarray(self.last_lr, jnp.float32)
+
+    def _better(self, a, best):
+        if self.mode == "min":
+            thr = best * (1 - self.threshold) if \
+                self.threshold_mode == "rel" else best - self.threshold
+            return a < thr
+        thr = best * (1 + self.threshold) if \
+            self.threshold_mode == "rel" else best + self.threshold
+        return a > thr
+
+    def step(self, metrics=None, epoch=None):
+        if metrics is None:
+            return
+        current = float(metrics)
+        self.last_epoch += 1
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.num_bad_epochs = 0
+        if self.best is None or self._better(current, self.best):
+            self.best = current
+            self.num_bad_epochs = 0
+        else:
+            self.num_bad_epochs += 1
+        if self.num_bad_epochs > self.patience:
+            new_lr = max(self.last_lr * self.factor, self.min_lr)
+            if self.last_lr - new_lr > self.epsilon:
+                self.last_lr = new_lr
+            self.cooldown_counter = self.cooldown
+            self.num_bad_epochs = 0
+
+
+class CosineAnnealingDecay(LRScheduler):
+    def __init__(self, learning_rate, T_max, eta_min=0, last_epoch=-1,
+                 verbose=False):
+        self.T_max = T_max
+        self.eta_min = eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_fn(self, step):
+        t = jnp.asarray(step, jnp.float32)
+        return self.eta_min + (self.base_lr - self.eta_min) * \
+            (1 + jnp.cos(math.pi * t / self.T_max)) / 2
+
+
+class MultiplicativeDecay(LRScheduler):
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
+                 verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        if self.last_epoch > 0:
+            return self.last_lr * self.lr_lambda(self.last_epoch)
+        return self.base_lr
+
+    def lr_fn(self, step):  # approximation: product form isn't traceable
+        return jnp.asarray(self.last_lr, jnp.float32)
+
+
+class OneCycleLR(LRScheduler):
+    def __init__(self, max_learning_rate, total_steps, divide_factor=25.0,
+                 end_learning_rate=0.0001, phase_pct=0.3,
+                 anneal_strategy="cos", three_phase=False, last_epoch=-1,
+                 verbose=False):
+        self.max_lr = max_learning_rate
+        self.total_steps = total_steps
+        self.initial_lr = max_learning_rate / divide_factor
+        self.end_lr = end_learning_rate
+        self.phase_pct = phase_pct
+        self.anneal = anneal_strategy
+        super().__init__(self.initial_lr, last_epoch, verbose)
+
+    def _interp(self, frac, start, end):
+        if self.anneal == "cos":
+            return end + (start - end) * (1 + jnp.cos(math.pi * frac)) / 2
+        return start + (end - start) * frac
+
+    def lr_fn(self, step):
+        t = jnp.asarray(step, jnp.float32)
+        up_steps = self.phase_pct * self.total_steps
+        down_steps = self.total_steps - up_steps
+        up = self._interp(jnp.clip(t / jnp.maximum(up_steps, 1), 0, 1),
+                          self.initial_lr, self.max_lr)
+        down = self._interp(
+            jnp.clip((t - up_steps) / jnp.maximum(down_steps, 1), 0, 1),
+            self.max_lr, self.end_lr)
+        return jnp.where(t < up_steps, up, down)
+
+
+class CyclicLR(LRScheduler):
+    def __init__(self, base_learning_rate, max_learning_rate, step_size_up,
+                 step_size_down=None, mode="triangular", exp_gamma=1.0,
+                 scale_fn=None, scale_mode="cycle", last_epoch=-1,
+                 verbose=False):
+        self.max_lr = max_learning_rate
+        self.step_size_up = step_size_up
+        self.step_size_down = step_size_down or step_size_up
+        self.mode = mode
+        self.exp_gamma = exp_gamma
+        super().__init__(base_learning_rate, last_epoch, verbose)
+
+    def lr_fn(self, step):
+        t = jnp.asarray(step, jnp.float32)
+        total = self.step_size_up + self.step_size_down
+        cycle = jnp.floor(1 + t / total)
+        x = t - (cycle - 1) * total
+        frac = jnp.where(x <= self.step_size_up,
+                         x / self.step_size_up,
+                         1 - (x - self.step_size_up) / self.step_size_down)
+        amp = self.max_lr - self.base_lr
+        if self.mode == "triangular2":
+            amp = amp / (2.0 ** (cycle - 1))
+        elif self.mode == "exp_range":
+            amp = amp * self.exp_gamma ** t
+        return self.base_lr + amp * jnp.maximum(frac, 0.0)
+
+
+# 1.x-style functional aliases used by older scripts
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    return NoamDecay(d_model, warmup_steps, learning_rate)
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    return CosineAnnealingDecay(learning_rate, step_each_epoch * epochs)

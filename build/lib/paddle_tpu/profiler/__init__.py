@@ -1,0 +1,101 @@
+"""`paddle.profiler` equivalent.
+
+Host-side scoped events live in the native runtime
+(csrc/ptpu_runtime.cc Profiler ≈ `platform/profiler.h:127` RecordEvent);
+device-side timing comes from `jax.profiler` (XLA's tracer replaces the
+reference's CUPTI `DeviceTracer`, `platform/device_tracer.h:43`). Both
+export chrome://tracing-compatible traces (`tools/timeline.py` parity).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional
+
+from ..core import native
+
+
+class RecordEvent:
+    """Scoped host event (reference: platform/profiler.h:127).
+
+    Usable as context manager or decorator; no-op when profiling is off or
+    the native lib is unavailable.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t0 = None
+
+    def __enter__(self):
+        if native.available():
+            self._t0 = native.lib().ptpu_profiler_now_us()
+        return self
+
+    def __exit__(self, *exc):
+        if self._t0 is not None and native.available():
+            l = native.lib()
+            l.ptpu_profiler_record(self.name.encode(), self._t0,
+                                   l.ptpu_profiler_now_us())
+        return False
+
+    begin = __enter__
+
+    def end(self):
+        self.__exit__()
+
+
+def start_profiler(tracer_option: str = "Default"):
+    """Reference: fluid/profiler.py start_profiler."""
+    if native.available():
+        native.lib().ptpu_profiler_enable()
+
+
+def stop_profiler(sorted_key: Optional[str] = None,
+                  profile_path: str = "/tmp/profile"):
+    """Dump host events as a chrome trace (reference writes profiler.proto;
+    chrome trace is the rendered form both end up in)."""
+    if native.available():
+        l = native.lib()
+        l.ptpu_profiler_disable()
+        l.ptpu_profiler_dump(str(profile_path).encode())
+
+
+@contextlib.contextmanager
+def profiler(tracer_option: str = "Default",
+             profile_path: str = "/tmp/profile"):
+    """Reference: fluid/profiler.py profiler context manager."""
+    start_profiler(tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(profile_path=profile_path)
+
+
+def event_count() -> int:
+    return int(native.lib().ptpu_profiler_count()) if native.available() \
+        else 0
+
+
+def reset():
+    if native.available():
+        native.lib().ptpu_profiler_clear()
+
+
+# Device-side (XLA) tracing — jax.profiler passthrough
+def start_trace(log_dir: str):
+    import jax
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace():
+    import jax
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    start_trace(log_dir)
+    try:
+        yield
+    finally:
+        stop_trace()

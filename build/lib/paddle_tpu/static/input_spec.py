@@ -1,0 +1,22 @@
+"""InputSpec — shape/dtype signature for tracing.
+
+Mirrors `python/paddle/static/input.py` InputSpec.
+"""
+from __future__ import annotations
+
+from ..core.dtypes import convert_dtype
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name)

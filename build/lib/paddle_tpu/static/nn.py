@@ -1,0 +1,108 @@
+"""`paddle.static.nn` control-flow builders.
+
+Reference: `fluid/layers/control_flow.py` (cond:2295, while_loop:1115,
+case:2474, switch_case:2588) — Python builders that emit
+`conditional_block_op`/`while_op` subgraphs interpreted by the C++
+executor (`operators/controlflow/`).
+
+TPU-native: these ARE `lax.cond`/`lax.while_loop`/`lax.switch` — XLA
+compiles real control flow on device; no block-interpreter exists. With
+concrete (non-traced) predicates they run the Python branch directly, so
+the same code works eagerly, matching dygraph behavior.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.enforce import enforce
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, name=None):
+    """Reference: control_flow.py:2295."""
+    if not _is_traced(pred):
+        return true_fn() if bool(pred) else false_fn()
+    return lax.cond(pred, lambda _: true_fn(), lambda _: false_fn(),
+                    operand=None)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars,
+               is_test=False, name=None):
+    """Reference: control_flow.py:1115. loop_vars is a list/tuple pytree."""
+    loop_vars = tuple(loop_vars)
+
+    concrete = not any(_is_traced(v) for v in jax.tree.leaves(loop_vars))
+    if concrete:
+        first = cond_fn(*loop_vars)
+        if not _is_traced(first):
+            vars_ = loop_vars
+            while bool(cond_fn(*vars_)):
+                out = body_fn(*vars_)
+                vars_ = tuple(out) if isinstance(out, (list, tuple)) \
+                    else (out,)
+            return list(vars_)
+    def body(vs):
+        out = body_fn(*vs)
+        return tuple(out) if isinstance(out, (list, tuple)) else (out,)
+
+    out = lax.while_loop(lambda vs: cond_fn(*vs), body, loop_vars)
+    return list(out)
+
+
+def case(pred_fn_pairs: Sequence[Tuple], default: Callable = None,
+         name=None):
+    """Reference: control_flow.py:2474 — first true predicate wins."""
+    enforce(len(pred_fn_pairs) > 0, "case needs at least one pair")
+    preds = [p for p, _ in pred_fn_pairs]
+    fns = [f for _, f in pred_fn_pairs]
+    if default is None:
+        default = fns[-1]
+    if not any(_is_traced(p) for p in preds):
+        for p, f in pred_fn_pairs:
+            if bool(p):
+                return f()
+        return default()
+    # traced: index of first true predicate, else len(preds) → default
+    stacked = jnp.stack([jnp.asarray(p, bool) for p in preds])
+    idx = jnp.argmax(stacked)
+    any_true = jnp.any(stacked)
+    branch = jnp.where(any_true, idx, len(fns))
+    return lax.switch(branch, [*(lambda f=f: f() for f in fns),
+                               lambda: default()])
+
+
+def switch_case(branch_index, branch_fns, default: Callable = None,
+                name=None):
+    """Reference: control_flow.py:2588."""
+    # normalize to an index → fn mapping; (int, fn) pairs keep their
+    # declared index (reference semantics), bare fns get list position
+    if isinstance(branch_fns, dict):
+        mapping = dict(branch_fns)
+    else:
+        mapping = {}
+        for pos, f in enumerate(branch_fns):
+            if isinstance(f, (tuple, list)):
+                mapping[int(f[0])] = f[1]
+            else:
+                mapping[pos] = f
+    keys = sorted(mapping)
+    fns = [mapping[k] for k in keys]
+    if default is None:
+        default = fns[-1]
+    if not _is_traced(branch_index):
+        i = int(branch_index)
+        return mapping[i]() if i in mapping else default()
+    # traced: map the runtime index onto the sorted-key table
+    keys_arr = jnp.asarray(keys)
+    pos = jnp.argmax(keys_arr == branch_index)
+    matched = jnp.any(keys_arr == branch_index)
+    branch = jnp.where(matched, pos, len(fns))
+    return lax.switch(branch, [*(lambda f=f: f() for f in fns),
+                               lambda: default()])

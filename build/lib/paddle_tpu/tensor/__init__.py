@@ -1,0 +1,18 @@
+"""Functional tensor API — the `paddle.tensor` equivalent namespace.
+
+Everything re-exported here is also available at the top level
+(`paddle_tpu.add`, …), matching how `python/paddle/__init__.py` flattens
+`paddle.tensor.*` in the reference.
+"""
+from jax.numpy import einsum  # noqa: F401
+
+from .attribute import *  # noqa: F401,F403
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from . import stat  # noqa: F401
+from .stat import std, var, median, quantile, nanmedian, nanquantile  # noqa: F401

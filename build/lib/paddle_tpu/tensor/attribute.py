@@ -1,0 +1,34 @@
+"""Tensor attribute helpers.
+
+Mirrors `python/paddle/tensor/attribute.py`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def shape(x):
+    return list(jnp.shape(x))
+
+
+def rank(x):
+    return jnp.ndim(x)
+
+
+def is_complex(x):
+    return jnp.iscomplexobj(x)
+
+
+def is_integer(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def numel(x):
+    return int(np.prod(jnp.shape(x))) if not isinstance(x, jax.core.Tracer) \
+        else x.size

@@ -1,0 +1,160 @@
+"""Tensor creation ops.
+
+Mirrors `python/paddle/tensor/creation.py` in the reference. Tensors are
+`jax.Array`s — there is no wrapper type; XLA owns layout and memory (the
+reference's `Tensor`/`LoDTensor` buffer management, `framework/tensor.h:1-321`,
+is subsumed by jax/XLA).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtypes
+from ..core.dtypes import convert_dtype, get_default_dtype
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor equivalent.
+
+    `stop_gradient` has no effect on a raw array (autograd is functional —
+    differentiation is w.r.t. explicit arguments); it is accepted for API
+    compatibility. `place` selects the jax device.
+    """
+    dtype = convert_dtype(dtype)
+    if isinstance(data, jax.Array) and dtype is None and place is None:
+        return data
+    if dtype is None and isinstance(data, (bool, int, float, list, tuple)):
+        probe = np.asarray(data)
+        if probe.dtype == np.float64:
+            dtype = get_default_dtype()
+    arr = jnp.asarray(data, dtype=dtype)
+    if place is not None:
+        arr = jax.device_put(arr, place.jax_device())
+    return arr
+
+
+def full(shape, fill_value, dtype=None):
+    if dtype is None:
+        dtype = get_default_dtype() if isinstance(fill_value, float) else None
+    return jnp.full(_shape(shape), fill_value, dtype=convert_dtype(dtype))
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=convert_dtype(dtype))
+
+
+def zeros(shape, dtype=None):
+    return jnp.zeros(_shape(shape), dtype=convert_dtype(dtype) or get_default_dtype())
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=convert_dtype(dtype))
+
+
+def ones(shape, dtype=None):
+    return jnp.ones(_shape(shape), dtype=convert_dtype(dtype) or get_default_dtype())
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=convert_dtype(dtype))
+
+
+def empty(shape, dtype=None):
+    # XLA has no uninitialized alloc; zeros compiles to a fusion-friendly fill.
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    dtype = convert_dtype(dtype)
+    if dtype is None:
+        if any(isinstance(v, float) for v in (start, end, step)):
+            dtype = get_default_dtype()
+        else:
+            dtype = dtypes.int64
+    return jnp.arange(start, end, step, dtype=dtype)
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, int(num),
+                        dtype=convert_dtype(dtype) or get_default_dtype())
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return jnp.logspace(start, stop, int(num), base=base,
+                        dtype=convert_dtype(dtype) or get_default_dtype())
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return jnp.eye(num_rows, num_columns,
+                   dtype=convert_dtype(dtype) or get_default_dtype())
+
+
+def diag(x, offset=0, padding_value=0):
+    x = jnp.asarray(x)
+    if x.ndim == 1 and padding_value != 0:
+        n = x.shape[0] + abs(offset)
+        base = jnp.full((n, n), padding_value, dtype=x.dtype)
+        return base + jnp.diag(x - jnp.zeros((), x.dtype) + 0, k=offset) - \
+            jnp.diag(jnp.full((x.shape[0],), padding_value, x.dtype), k=offset)
+    return jnp.diag(x, k=offset)
+
+
+def diagflat(x, offset=0):
+    return jnp.diagflat(jnp.asarray(x), k=offset)
+
+
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def meshgrid(*args):
+    return list(jnp.meshgrid(*args, indexing="ij"))
+
+
+def assign(x, output=None):
+    # Functional world: assign is identity / copy.
+    return jnp.asarray(x)
+
+
+def clone(x):
+    return jnp.copy(x)
+
+
+def numel(x):
+    return jnp.asarray(x).size
+
+
+def tril_indices(row, col, offset=0):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return jnp.stack([r, c])
+
+
+def triu_indices(row, col=None, offset=0):
+    r, c = jnp.triu_indices(row, k=offset, m=col or row)
+    return jnp.stack([r, c])
+
+
+def complex(real, imag):
+    return jax.lax.complex(real, imag)
+
+
+def polar(abs_, angle):
+    return jax.lax.complex(abs_ * jnp.cos(angle), abs_ * jnp.sin(angle))
+
+
+def _shape(shape):
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)

@@ -1,0 +1,145 @@
+"""Linear algebra ops.
+
+Mirrors `python/paddle/tensor/linalg.py` (reference kernels: `math/blas.h` →
+cuBLAS/MKL, `matrix_inverse`, `cholesky_op`, `svd_op` …). On TPU these lower
+to XLA linalg HLOs; decompositions run on the host-side XLA linalg library
+when not MXU-friendly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .math import matmul, mm, bmm, dot, mv, t  # noqa: F401  (re-export parity)
+
+
+def norm(x, p="fro", axis=None, keepdim=False):
+    if p == "fro":
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        return jnp.linalg.norm(x, ord=None, axis=_ax(axis), keepdims=keepdim)
+    if p == "nuc":
+        return jnp.linalg.norm(x, ord="nuc", axis=_ax(axis), keepdims=keepdim)
+    if axis is None:
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    return jnp.linalg.norm(x, ord=p, axis=_ax(axis), keepdims=keepdim)
+
+
+def _ax(axis):
+    if isinstance(axis, list):
+        return tuple(axis)
+    return axis
+
+
+def dist(x, y, p=2.0):
+    return norm(x - y, p=float(p) if p != "fro" else p)
+
+
+def cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, tol=tol)
+
+
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def det(x):
+    return jnp.linalg.det(x)
+
+
+def slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logabs])
+
+
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+def cholesky_solve(x, y, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def eig(x):
+    return jnp.linalg.eig(x)
+
+
+def eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def lu(x):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_, piv
+
+
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+def lstsq(x, y, rcond=None):
+    return jnp.linalg.lstsq(x, y, rcond=rcond)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+def histogram(input, bins=100, min=0, max=0):
+    rng = None if (min == 0 and max == 0) else (min, max)
+    hist, _ = jnp.histogram(jnp.reshape(input, (-1,)), bins=bins, range=rng)
+    return hist
+
+
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(jnp.reshape(x, (-1,)), weights=weights,
+                        minlength=minlength)
+
+
+def multi_dot(tensors):
+    return jnp.linalg.multi_dot(tensors)
+
+
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)

@@ -1,0 +1,313 @@
+"""Shape / layout manipulation ops.
+
+Mirrors `python/paddle/tensor/manipulation.py` (reference kernels:
+`reshape_op`, `transpose_op`, `concat_op`, `split_op`, `gather*`, `scatter*`,
+`slice_op`, `tile_op`, `expand_v2_op` …). All are XLA-native; gather/scatter
+lower to HLO gather/scatter which TPU executes efficiently for static shapes.
+Ops whose output shape is data-dependent in the reference (masked_select,
+nonzero, unique) are provided in eager form and, where possible, with a
+static-shape variant usable under jit.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtypes import convert_dtype
+
+
+def reshape(x, shape):
+    return jnp.reshape(x, tuple(int(s) for s in shape))
+
+
+def transpose(x, perm):
+    return jnp.transpose(x, axes=tuple(perm))
+
+
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a for a in axis if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axis) if axis else x
+
+
+def unsqueeze(x, axis):
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.expand_dims(x, axis=tuple(axis))
+
+
+def concat(x, axis=0):
+    return jnp.concatenate(list(x), axis=int(axis))
+
+
+def stack(x, axis=0):
+    return jnp.stack(list(x), axis=axis)
+
+
+def unstack(x, axis=0, num=None):
+    n = num if num is not None else x.shape[axis]
+    return [jnp.squeeze(s, axis=axis)
+            for s in jnp.split(x, n, axis=axis)]
+
+
+def split(x, num_or_sections, axis=0):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = total - known
+    offsets = np.cumsum(sections)[:-1].tolist()
+    return jnp.split(x, offsets, axis=axis)
+
+
+def chunk(x, chunks, axis=0):
+    return jnp.array_split(x, chunks, axis=axis)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    ndim = jnp.ndim(x)
+    start = start_axis % ndim
+    stop = stop_axis % ndim
+    shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return jnp.reshape(x, shape)
+
+
+def slice(x, axes, starts, ends):
+    """Reference: slice_op. Static start/end only (XLA requirement)."""
+    idx = [builtins.slice(None)] * jnp.ndim(x)
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = builtins.slice(int(st), int(en))
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [builtins.slice(None)] * jnp.ndim(x)
+    for ax, st, en, sr in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(int(st), int(en), int(sr))
+    return x[tuple(idx)]
+
+
+def crop(x, shape, offsets=None):
+    offsets = offsets or [0] * jnp.ndim(x)
+    return jax.lax.dynamic_slice(x, [int(o) for o in offsets],
+                                 [int(s) for s in shape])
+
+
+def gather(x, index, axis=0):
+    """Reference: gather_op — select rows of `x` along `axis` by `index`."""
+    return jnp.take(x, jnp.reshape(index, (-1,)), axis=axis)
+
+
+def gather_nd(x, index):
+    index = jnp.asarray(index)
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def scatter(x, index, updates, overwrite=True):
+    """Reference: scatter_op. overwrite=False accumulates (scatter_add)."""
+    index = jnp.reshape(index, (-1,))
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    index = jnp.asarray(index)
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def scatter_nd(index, updates, shape):
+    zeros = jnp.zeros(tuple(shape), dtype=jnp.asarray(updates).dtype)
+    return scatter_nd_add(zeros, index, updates)
+
+
+def put_along_axis(arr, indices, values, axis):
+    return jnp.put_along_axis(arr, indices, values, axis=axis, inplace=False)
+
+
+def take_along_axis(arr, indices, axis):
+    return jnp.take_along_axis(arr, indices, axis=axis)
+
+
+def index_select(x, index, axis=0):
+    return jnp.take(x, jnp.reshape(index, (-1,)), axis=axis)
+
+
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def tile(x, repeat_times):
+    return jnp.tile(x, tuple(repeat_times))
+
+
+def expand(x, shape):
+    shape = tuple(int(s) for s in shape)
+    # paddle allows -1 meaning "keep this dim"
+    x_shape = (1,) * (len(shape) - jnp.ndim(x)) + tuple(x.shape)
+    shape = tuple(xs if s == -1 else s for s, xs in zip(shape, x_shape))
+    return jnp.broadcast_to(jnp.reshape(x, x_shape), shape)
+
+
+def expand_as(x, y):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+def broadcast_tensors(inputs):
+    return list(jnp.broadcast_arrays(*inputs))
+
+
+def flip(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, axis=tuple(axis))
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def cast(x, dtype):
+    return jnp.asarray(x).astype(convert_dtype(dtype))
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def unbind(input, axis=0):
+    return unstack(input, axis=axis)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None):
+    """Eager-only (data-dependent output shape; reference: unique_op)."""
+    res = jnp.unique(np.asarray(x), return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    return res
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None):
+    arr = np.asarray(x)
+    if axis is None:
+        arr = arr.reshape(-1)
+        keep = np.concatenate([[True], arr[1:] != arr[:-1]])
+    else:
+        moved = np.moveaxis(arr, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        keep = np.concatenate([[True], np.any(flat[1:] != flat[:-1], axis=1)])
+    out = [jnp.asarray(np.compress(keep, arr, axis=axis or 0))]
+    if return_inverse:
+        out.append(jnp.asarray(np.cumsum(keep) - 1))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, keep.size))
+        out.append(jnp.asarray(counts))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def masked_select(x, mask):
+    """Eager-only: output shape is data-dependent."""
+    return jnp.asarray(np.asarray(x)[np.asarray(mask)])
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, dtype=jnp.asarray(x).dtype), x)
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return jnp.where(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    """Eager-only (data-dependent shape; reference: where_index_op)."""
+    res = np.nonzero(np.asarray(x))
+    if as_tuple:
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(np.stack(res, axis=1))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    """Reference: pad_op / pad3d_op. `pad` is paddle's flat low/high list
+    covering the trailing dims (or all dims when len==2*ndim)."""
+    ndim = jnp.ndim(x)
+    pad = list(pad)
+    if len(pad) == 2 * ndim:
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(ndim)]
+    else:
+        # paddle semantics: pad applies to spatial dims per data_format
+        n_spatial = len(pad) // 2
+        pairs = [(0, 0)] * ndim
+        if data_format.startswith("NC"):
+            spatial_dims = builtins.range(2, 2 + n_spatial)
+        else:
+            spatial_dims = builtins.range(1, 1 + n_spatial)
+        # paddle pads last spatial dim first in the flat list
+        for i, d in enumerate(spatial_dims):
+            pairs[d] = (pad[2 * i], pad[2 * i + 1])
+    if mode == "constant":
+        return jnp.pad(x, pairs, mode="constant", constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, pairs, mode=jmode)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Reference: shard_index_op (used by sharded embedding)."""
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    hi = lo + shard_size
+    in_shard = (input >= lo) & (input < hi)
+    return jnp.where(in_shard, input - lo, ignore_value)
+
+
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def view(x, shape):
+    return reshape(x, shape)
+
+
+def view_as(x, other):
+    return jnp.reshape(x, other.shape)
+
+
+def tensordot(x, y, axes=2):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def tolist(x):
+    return np.asarray(x).tolist()

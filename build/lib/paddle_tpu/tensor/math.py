@@ -1,0 +1,483 @@
+"""Elementwise / reduction / scan math ops.
+
+Mirrors `python/paddle/tensor/math.py` in the reference (which dispatches to
+`operators/elementwise/*`, `operators/reduce_ops/*`,
+`operators/activation_op.*` CUDA kernels). On TPU every function lowers to an
+XLA HLO op; fusion into surrounding matmuls happens in the compiler, which is
+what the reference's `fusion_group`/NVRTC pass (`framework/ir/fusion_group/`)
+did by hand.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtypes import convert_dtype
+
+# --- binary elementwise (broadcast rules == numpy == paddle) ---
+
+def add(x, y):
+    return jnp.add(x, y)
+
+
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+def mod(x, y):
+    return jnp.mod(x, y)
+
+
+remainder = mod
+
+
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+# --- unary elementwise ---
+
+def abs(x):
+    return jnp.abs(x)
+
+
+def neg(x):
+    return jnp.negative(x)
+
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def expm1(x):
+    return jnp.expm1(x)
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def log2(x):
+    return jnp.log2(x)
+
+
+def log10(x):
+    return jnp.log10(x)
+
+
+def log1p(x):
+    return jnp.log1p(x)
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+def rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+def square(x):
+    return jnp.square(x)
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def ceil(x):
+    return jnp.ceil(x)
+
+
+def floor(x):
+    return jnp.floor(x)
+
+
+def round(x):
+    return jnp.round(x)
+
+
+def trunc(x):
+    return jnp.trunc(x)
+
+
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+def sin(x):
+    return jnp.sin(x)
+
+
+def cos(x):
+    return jnp.cos(x)
+
+
+def tan(x):
+    return jnp.tan(x)
+
+
+def asin(x):
+    return jnp.arcsin(x)
+
+
+def acos(x):
+    return jnp.arccos(x)
+
+
+def atan(x):
+    return jnp.arctan(x)
+
+
+def sinh(x):
+    return jnp.sinh(x)
+
+
+def cosh(x):
+    return jnp.cosh(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x) - jnp.log1p(-x)
+
+
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+def isnan(x):
+    return jnp.isnan(x)
+
+
+def isinf(x):
+    return jnp.isinf(x)
+
+
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def angle(x):
+    return jnp.angle(x)
+
+
+def conj(x):
+    return jnp.conj(x)
+
+
+def real(x):
+    return jnp.real(x)
+
+
+def imag(x):
+    return jnp.imag(x)
+
+
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+# --- scale / linear combination ops (reference: scale_op, addmm_op) ---
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def increment(x, value=1.0):
+    return x + value
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+def multiplex(inputs, index):
+    stacked = jnp.stack(inputs, axis=0)  # [n, batch, ...]
+    idx = jnp.reshape(index, (-1,))
+    return jnp.take_along_axis(
+        stacked, idx[None, :].reshape((1, -1) + (1,) * (stacked.ndim - 2)),
+        axis=0)[0]
+
+
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+# --- reductions (reference: operators/reduce_ops/) ---
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.sum(x, axis=_axis(axis), dtype=convert_dtype(dtype),
+                   keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=_axis(axis), dtype=convert_dtype(dtype),
+                    keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.nansum(x, axis=_axis(axis), dtype=convert_dtype(dtype),
+                      keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+# --- scans (reference: cumsum_op etc.) ---
+
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=convert_dtype(dtype))
+
+
+def cumprod(x, dim=None, dtype=None):
+    return jnp.cumprod(x, axis=dim, dtype=convert_dtype(dtype))
+
+
+def cummax(x, axis=None):
+    if axis is None:
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    vals = jax.lax.associative_scan(jnp.maximum, x, axis=axis)
+    return vals
+
+
+def cummin(x, axis=None):
+    if axis is None:
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    return jax.lax.associative_scan(jnp.minimum, x, axis=axis)
+
+
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1):
+    if dx is None and x is None:
+        dx = 1.0
+    return jnp.trapezoid(y, x=x, dx=dx if dx is not None else 1.0, axis=axis)
+
+
+# --- matmul family (the MXU path) ---
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    """Reference: matmul_v2 op (`operators/matmul_v2_op.*` → cuBLAS).
+
+    Lowers to a single dot_general; XLA tiles it onto the MXU. Keep operands
+    bf16 under AMP for full MXU throughput.
+    """
+    from ..amp.auto_cast import maybe_autocast
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if jnp.ndim(x) > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if jnp.ndim(y) > 1 else y
+    if jnp.issubdtype(jnp.result_type(x), jnp.floating):
+        x, y = maybe_autocast(x, y, op="matmul")
+    return jnp.matmul(x, y)
+
+
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+def bmm(x, y):
+    return jax.lax.batch_matmul(x, y)
+
+
+def dot(x, y):
+    if jnp.ndim(x) == 2:
+        return jnp.sum(x * y, axis=-1, keepdims=True)
+    return jnp.dot(x, y)
+
+
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+def t(x):
+    if jnp.ndim(x) < 2:
+        return x
+    return jnp.swapaxes(x, -1, -2)
+
+
+# --- misc ---
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def softplus(x, beta=1.0, threshold=20.0):
+    return jnp.where(beta * x > threshold, x,
+                     jnp.log1p(jnp.exp(beta * x)) / beta)
+
+
+def rsqrt_(x):  # inplace aliases are plain ops in a functional world
+    return rsqrt(x)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

@@ -1,0 +1,94 @@
+"""Random sampling ops.
+
+Mirrors `python/paddle/tensor/random.py` (reference:
+`operators/gaussian_random_op`, `uniform_random_op`, `randint_op`,
+`randperm_op`, `bernoulli_op`, `multinomial_op`). Keys come from the global
+stateful seed (`paddle_tpu.seed`) in eager mode or a scoped traced key under
+`rng_guard` — see `paddle_tpu/framework/random.py`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..core.dtypes import convert_dtype, get_default_dtype
+from ..framework.random import next_key
+
+
+def _shape(shape):
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0):
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    key = jax.random.key(seed) if seed else next_key()
+    return jax.random.uniform(key, _shape(shape), dtype=dtype,
+                              minval=min, maxval=max)
+
+
+def rand(shape, dtype=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    shape = _shape(shape if shape is not None else [1])
+    sample = jax.random.normal(next_key(), shape, dtype=get_default_dtype())
+    return sample * std + mean
+
+
+def randn(shape, dtype=None):
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return jax.random.normal(next_key(), _shape(shape), dtype=dtype)
+
+
+def standard_normal(shape, dtype=None):
+    return randn(shape, dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None):
+    if high is None:
+        low, high = 0, low
+    dtype = convert_dtype(dtype) or dtypes.int64
+    return jax.random.randint(next_key(), _shape(shape), low, high,
+                              dtype=dtype)
+
+
+def randint_like(x, low=0, high=None):
+    return randint(low, high, shape=x.shape, dtype=x.dtype)
+
+
+def randperm(n, dtype=None):
+    dtype = convert_dtype(dtype) or dtypes.int64
+    return jax.random.permutation(next_key(), n).astype(dtype)
+
+
+def bernoulli(x):
+    return jax.random.bernoulli(next_key(), p=x).astype(x.dtype)
+
+
+def poisson(x):
+    return jax.random.poisson(next_key(), lam=x).astype(x.dtype)
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    logits = jnp.log(jnp.clip(x, 1e-30, None))
+    if replacement:
+        return jax.random.categorical(
+            next_key(), logits, axis=-1,
+            shape=(num_samples,) + x.shape[:-1]).T if x.ndim > 1 else \
+            jax.random.categorical(next_key(), logits, shape=(num_samples,))
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(next_key(), x.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx
+
+
+def exponential_(x, lam=1.0):
+    return jax.random.exponential(next_key(), x.shape, dtype=x.dtype) / lam
+
+
+def normal_like(x, mean=0.0, std=1.0):
+    return jax.random.normal(next_key(), x.shape, dtype=x.dtype) * std + mean

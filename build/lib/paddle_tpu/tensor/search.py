@@ -1,0 +1,78 @@
+"""Search / sort ops.
+
+Mirrors `python/paddle/tensor/search.py` (reference: `arg_max_op`,
+`top_k_v2_op` → cub radix selects; on TPU `lax.top_k` / XLA sort).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    from ..core.dtypes import convert_dtype
+    res = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return res.astype(convert_dtype(dtype))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    from ..core.dtypes import convert_dtype
+    res = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return res.astype(convert_dtype(dtype))
+
+
+def argsort(x, axis=-1, descending=False, stable=True):
+    idx = jnp.argsort(x, axis=axis, stable=stable,
+                      descending=descending)
+    return idx
+
+
+def sort(x, axis=-1, descending=False):
+    out = jnp.sort(x, axis=axis, descending=descending)
+    return out
+
+
+def topk(x, k, axis=None, largest=True, sorted=True):
+    """Reference: top_k_v2_op. Lowers to lax.top_k on the last axis."""
+    if axis is None:
+        axis = -1
+    moved = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(moved, k)
+    else:
+        vals, idx = jax.lax.top_k(-moved, k)
+        vals = -vals
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    s = jnp.sort(x, axis=axis)
+    i = jnp.argsort(x, axis=axis)
+    vals = jnp.take(s, k - 1, axis=axis)
+    idxs = jnp.take(i, k - 1, axis=axis)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idxs = jnp.expand_dims(idxs, axis)
+    return vals, idxs
+
+
+def mode(x, axis=-1, keepdim=False):
+    from jax.scipy import stats
+    vals = stats.mode(x, axis=axis, keepdims=keepdim)
+    return vals.mode, vals.count
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    res = jnp.searchsorted(sorted_sequence, values, side=side)
+    return res.astype(jnp.int32) if out_int32 else res.astype(jnp.int64)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def index_put(x, indices, value, accumulate=False):
+    if accumulate:
+        return x.at[tuple(indices)].add(value)
+    return x.at[tuple(indices)].set(value)

@@ -1,0 +1,115 @@
+"""Sequence ops on dense padded tensors + segment ids.
+
+The reference's ~49 LoD-driven sequence ops (`operators/sequence_ops/` —
+sequence_pool, sequence_mask, sequence_expand, sequence_pad...) operate on
+ragged LoDTensors. The TPU design replaces LoD with dense padding +
+lengths/segment ids (SURVEY.md Appendix A: "the TPU build replaces LoD
+with dense padding + segment ids") — static shapes the MXU and XLA need.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sequence_mask(lengths, maxlen: Optional[int] = None,
+                  dtype="bool"):
+    """Reference: sequence_mask op — [b] lengths → [b, maxlen] mask."""
+    lengths = jnp.asarray(lengths)
+    if maxlen is None:
+        maxlen = int(jnp.max(lengths))
+    row = jnp.arange(maxlen)
+    mask = row[None, :] < lengths[..., None]
+    from ..core.dtypes import convert_dtype
+    return mask.astype(convert_dtype(dtype))
+
+
+def sequence_pad(sequences: Sequence, pad_value=0.0,
+                 maxlen: Optional[int] = None):
+    """Reference: sequence_pad op — list of [len_i, ...] arrays →
+    ([b, maxlen, ...], lengths)."""
+    seqs = [np.asarray(s) for s in sequences]
+    lens = np.asarray([len(s) for s in seqs], np.int64)
+    maxlen = maxlen or int(lens.max())
+    trailing = seqs[0].shape[1:]
+    out = np.full((len(seqs), maxlen) + trailing, pad_value,
+                  dtype=seqs[0].dtype)
+    for i, s in enumerate(seqs):
+        out[i, :len(s)] = s[:maxlen]
+    return jnp.asarray(out), jnp.asarray(lens)
+
+
+def sequence_unpad(x, length):
+    """Reference: sequence_unpad op — back to a list of arrays (host)."""
+    x = np.asarray(x)
+    length = np.asarray(length)
+    return [x[i, :int(l)] for i, l in enumerate(length)]
+
+
+def sequence_pool(x, pool_type: str = "sum", lengths=None):
+    """Reference: sequence_pool op. x: [b, s, ...]; masked by lengths."""
+    pool_type = pool_type.lower()
+    if lengths is not None:
+        mask = sequence_mask(lengths, x.shape[1], dtype="float32")
+        mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    else:
+        mask = jnp.ones(x.shape[:2] + (1,) * (x.ndim - 2), jnp.float32)
+    xm = x * mask
+    if pool_type == "sum":
+        return jnp.sum(xm, axis=1)
+    if pool_type == "average" or pool_type == "mean":
+        denom = jnp.maximum(jnp.sum(mask, axis=1), 1.0)
+        return jnp.sum(xm, axis=1) / denom
+    if pool_type == "sqrt":
+        denom = jnp.sqrt(jnp.maximum(jnp.sum(mask, axis=1), 1.0))
+        return jnp.sum(xm, axis=1) / denom
+    if pool_type == "max":
+        neg = jnp.where(mask > 0, 0.0, -jnp.inf)
+        return jnp.max(x + neg, axis=1)
+    if pool_type == "first":
+        return x[:, 0]
+    if pool_type == "last":
+        if lengths is None:
+            return x[:, -1]
+        idx = jnp.maximum(jnp.asarray(lengths) - 1, 0)
+        return jnp.take_along_axis(
+            x, idx.reshape(-1, *([1] * (x.ndim - 1))), axis=1)[:, 0]
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+def sequence_expand(x, ref_lengths):
+    """Reference: sequence_expand — repeat row i ref_lengths[i] times."""
+    return jnp.repeat(jnp.asarray(x), jnp.asarray(ref_lengths), axis=0)
+
+
+# --- segment ops (reference: operators/segment_pool_op + tf-style) ----
+
+def segment_sum(data, segment_ids, num_segments: Optional[int] = None):
+    n = num_segments or int(jnp.max(segment_ids)) + 1
+    return jax.ops.segment_sum(data, segment_ids, n) \
+        if hasattr(jax.ops, "segment_sum") else \
+        jnp.zeros((n,) + data.shape[1:], data.dtype).at[segment_ids].add(data)
+
+
+def segment_mean(data, segment_ids, num_segments: Optional[int] = None):
+    n = num_segments or int(jnp.max(segment_ids)) + 1
+    s = segment_sum(data, segment_ids, n)
+    cnt = segment_sum(jnp.ones((data.shape[0],), jnp.float32),
+                      segment_ids, n)
+    return s / jnp.maximum(cnt, 1.0).reshape(
+        (-1,) + (1,) * (data.ndim - 1))
+
+
+def segment_max(data, segment_ids, num_segments: Optional[int] = None):
+    n = num_segments or int(jnp.max(segment_ids)) + 1
+    init = jnp.full((n,) + data.shape[1:], -jnp.inf, data.dtype)
+    return init.at[segment_ids].max(data)
+
+
+def segment_min(data, segment_ids, num_segments: Optional[int] = None):
+    n = num_segments or int(jnp.max(segment_ids)) + 1
+    init = jnp.full((n,) + data.shape[1:], jnp.inf, data.dtype)
+    return init.at[segment_ids].min(data)

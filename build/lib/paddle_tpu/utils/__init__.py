@@ -1,0 +1,68 @@
+"""`paddle.utils` equivalent (reference: python/paddle/utils/ —
+download.py, install_check.py, deprecated.py, op_version.py)."""
+from __future__ import annotations
+
+import functools
+import os
+import warnings
+
+
+def run_check():
+    """Reference: utils/install_check.py `paddle.utils.run_check` — a
+    sanity forward/backward on the available device(s)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..nn.layer_common import Linear
+    from ..nn.layer import functional_call, trainable_state
+
+    lin = Linear(4, 2)
+    x = jnp.ones((2, 4))
+
+    def loss(p):
+        out, _ = functional_call(lin, p, x)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(trainable_state(lin))
+    assert all(np.isfinite(np.asarray(v)).all() for v in g.values())
+    n = len(jax.devices())
+    print(f"paddle_tpu is installed successfully! "
+          f"{n} {jax.default_backend()} device(s) available.")
+    return True
+
+
+def deprecated(update_to="", since="", reason=""):
+    """Reference: utils/deprecated.py decorator."""
+
+    def decorator(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            hint = f"; use {update_to} instead" if update_to else ""
+            warnings.warn(
+                f"{fn.__name__} is deprecated since {since or 'n/a'}"
+                f"{hint}. {reason}", DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return decorator
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """Reference: utils/download.py — zero-egress environment: only a
+    pre-populated cache hit can succeed."""
+    cache = os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                         "weights", os.path.basename(url))
+    if os.path.exists(cache):
+        return cache
+    raise RuntimeError(
+        f"no network egress and {cache} not pre-populated; place the "
+        "weights file there manually")
+
+
+def try_import(module_name: str):
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            f"{module_name} is required but not installed (and this "
+            "environment installs nothing)") from e

@@ -1,0 +1,172 @@
+"""Custom-op extension ABI — JIT-compile user C++ kernels into XLA FFI
+custom calls.
+
+TPU-native replacement for the reference's custom-op stack:
+  * `PD_BUILD_OP` macro + `paddle::Tensor` header-only ABI
+    (`paddle/fluid/extension/include/ext_op_meta_info.h:502`,
+    `ext_tensor.h:50`);
+  * runtime registration `load_op_meta_info_and_register_op`
+    (`pybind.cc:1903`);
+  * Python-side JIT build `utils/cpp_extension/` (setuptools + nvcc).
+
+Here the public kernel ABI is XLA's own FFI (`xla/ffi/api/ffi.h`, shipped
+in jaxlib's include dir): the user writes a handler with
+`XLA_FFI_DEFINE_HANDLER_SYMBOL`, `load()` compiles it with g++, dlopens
+the result, and registers every requested symbol as a jax FFI target.
+Handlers registered this way run on the host CPU; device-side custom
+kernels on TPU are Pallas kernels (see `paddle_tpu/ops`), which need no
+compilation step — this module is the escape hatch for native host code
+(data munging, custom CPU ops, post-processing), the same role the
+reference's CPU custom ops play.
+
+Example
+-------
+    mod = load(name="my_ops", sources=["my_ops.cc"],
+               functions={"Square": out_like_first_arg})
+    y = mod.Square(x)             # → jax.ffi.ffi_call under the hood
+
+where `my_ops.cc` contains::
+
+    #include "xla/ffi/api/ffi.h"
+    namespace ffi = xla::ffi;
+    static ffi::Error SquareImpl(ffi::AnyBuffer x,
+                                 ffi::Result<ffi::AnyBuffer> out) { ... }
+    XLA_FFI_DEFINE_HANDLER_SYMBOL(Square, SquareImpl,
+        ffi::Ffi::Bind().Arg<ffi::AnyBuffer>().Ret<ffi::AnyBuffer>());
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sys
+import tempfile
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+
+
+def include_paths() -> List[str]:
+    """XLA FFI headers shipped with jaxlib (reference parity:
+    `cpp_extension.include_paths()`)."""
+    import jax.ffi
+    return [jax.ffi.include_dir()]
+
+
+def out_like_first_arg(*args):
+    """Common shape-inference helper: one output, same shape/dtype as the
+    first argument (the reference's default InferShape for unary ops)."""
+    return jax.ShapeDtypeStruct(args[0].shape, args[0].dtype)
+
+
+class ExtensionModule:
+    """Callable-per-op namespace returned by `load` (mirrors the module
+    object `utils.cpp_extension.load` returns in the reference)."""
+
+    def __init__(self, name: str, lib_path: str,
+                 functions: Dict[str, Callable]):
+        self.__name__ = name
+        self._lib_path = lib_path
+        self._functions = dict(functions)
+
+    def __repr__(self):
+        return (f"<paddle_tpu extension {self.__name__} "
+                f"ops={sorted(self._functions)} lib={self._lib_path}>")
+
+
+def _compile(name: str, sources: Sequence[str], build_directory: str,
+             extra_cflags: Sequence[str], extra_ldflags: Sequence[str],
+             extra_include_paths: Sequence[str], verbose: bool) -> str:
+    os.makedirs(build_directory, exist_ok=True)
+    tag = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            tag.update(f.read())
+    tag.update(" ".join(list(extra_cflags) + list(extra_ldflags)).encode())
+    lib = os.path.join(build_directory,
+                       f"{name}_{tag.hexdigest()[:12]}.so")
+    if os.path.exists(lib):
+        return lib
+    # note: no -fvisibility=hidden — the XLA_FFI_DEFINE_HANDLER_SYMBOL
+    # extern "C" functions must stay visible for dlsym
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared"]
+    for inc in list(include_paths()) + list(extra_include_paths):
+        cmd += ["-I", inc]
+    cmd += list(extra_cflags) + list(sources) + ["-o", lib]
+    cmd += list(extra_ldflags)
+    if verbose:
+        print("cpp_extension:", " ".join(cmd), file=sys.stderr)
+    r = subprocess.run(cmd, capture_output=True, text=True)
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"cpp_extension build of {name} failed:\n{r.stderr[-4000:]}")
+    return lib
+
+
+def load(name: str,
+         sources: Union[str, Sequence[str]],
+         functions: Dict[str, Optional[Callable]] = None,
+         extra_cflags: Sequence[str] = (),
+         extra_ldflags: Sequence[str] = (),
+         extra_include_paths: Sequence[str] = (),
+         build_directory: Optional[str] = None,
+         platform: str = "cpu",
+         verbose: bool = False) -> ExtensionModule:
+    """Compile + register user C++ XLA-FFI handlers; return a module of
+    jittable callables.
+
+    Args:
+      name: extension name (build artifact prefix).
+      sources: .cc file path(s). Each exported op must be declared with
+        `XLA_FFI_DEFINE_HANDLER_SYMBOL(<Symbol>, ...)` and listed in
+        `functions`.
+      functions: {symbol_name: out_spec_fn}. `out_spec_fn(*args)` returns
+        the output `jax.ShapeDtypeStruct` (or list/tuple thereof) — the
+        Python twin of the reference's `SetInferShapeFn`/`SetInferDtypeFn`
+        in `PD_BUILD_OP`. None means same shape/dtype as first arg.
+      platform: FFI platform to register for ("cpu" — host-side; TPU
+        device kernels should be Pallas instead).
+
+    The returned module has one attribute per function; each is a normal
+    traceable jax function usable under jit/grad (wrap with
+    `jax.custom_vjp` for gradients, as the reference wraps grad kernels).
+    """
+    import jax.ffi
+    if isinstance(sources, str):
+        sources = [sources]
+    if not functions:
+        raise ValueError("functions={} is required: map each "
+                         "XLA_FFI_DEFINE_HANDLER_SYMBOL name to an output "
+                         "spec fn (or None for out-like-first-arg)")
+    build_directory = build_directory or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_extensions")
+    lib = _compile(name, sources, build_directory, extra_cflags,
+                   extra_ldflags, extra_include_paths, verbose)
+    cdll = ctypes.CDLL(lib)
+
+    made = {}
+    for sym, out_spec in functions.items():
+        try:
+            addr = ctypes.cast(getattr(cdll, sym), ctypes.c_void_p).value
+        except AttributeError:
+            raise RuntimeError(
+                f"symbol {sym!r} not exported by {lib} — declare it with "
+                "XLA_FFI_DEFINE_HANDLER_SYMBOL and make sure it isn't "
+                "hidden (the macro marks it visible)") from None
+        target = f"{name}.{sym}"
+        jax.ffi.register_ffi_target(
+            target, jax.ffi.pycapsule(addr), platform=platform)
+        spec_fn = out_spec or out_like_first_arg
+
+        def make_call(target=target, spec_fn=spec_fn):
+            def call(*args, **attrs):
+                out = spec_fn(*args)
+                return jax.ffi.ffi_call(target, out)(*args, **attrs)
+            return call
+
+        made[sym] = make_call()
+    mod = ExtensionModule(name, lib, made)
+    for sym, fn in made.items():
+        setattr(mod, sym, fn)
+    return mod

@@ -1,0 +1,174 @@
+"""`paddle.vision.datasets` equivalent (reference:
+python/paddle/vision/datasets/{mnist,cifar,folder}.py).
+
+The reference downloads from dataset.bj.bcebos.com; this environment has
+zero egress, so each dataset loads from a local file when present
+(`image_path=`/`data_file=` like the reference) and otherwise generates a
+deterministic synthetic sample set with the real shapes/dtypes/label
+spaces — enough for the test strategy (SURVEY.md §4: tests assert
+training mechanics, not dataset content).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class _SyntheticImageDataset(Dataset):
+    def __init__(self, n, shape, num_classes, transform=None, seed=0):
+        rs = np.random.RandomState(seed)
+        self.images = rs.randint(0, 256, (n,) + shape).astype(np.uint8)
+        self.labels = rs.randint(0, num_classes, (n,)).astype(np.int64)
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, i):
+        img = self.images[i]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[i]
+
+
+class MNIST(_SyntheticImageDataset):
+    """Reference: vision/datasets/mnist.py. Reads idx-format files when
+    given; synthesizes 28x28 grayscale otherwise."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                images = np.frombuffer(f.read(), np.uint8).reshape(
+                    n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                f.read(8)
+                labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+            self.images, self.labels = images, labels
+            self.transform = transform
+            return
+        n = 2048 if mode == "train" else 512
+        super().__init__(n, (28, 28), 10, transform,
+                         seed=0 if mode == "train" else 1)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(_SyntheticImageDataset):
+    """Reference: vision/datasets/cifar.py."""
+
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        if data_file and os.path.exists(data_file):
+            import tarfile
+            with tarfile.open(data_file) as tf:
+                batches = [m for m in tf.getmembers()
+                           if m.isfile() and self._member_match(m.name,
+                                                                mode)]
+                imgs, labs = [], []
+                for m in batches:
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    imgs.append(np.asarray(d[b"data"]))
+                    labs.extend(d.get(b"labels", d.get(b"fine_labels")))
+            self.images = np.concatenate(imgs).reshape(
+                -1, 3, 32, 32).transpose(0, 2, 3, 1).astype(np.uint8)
+            self.labels = np.asarray(labs, np.int64)
+            self.transform = transform
+            return
+        n = 2048 if mode == "train" else 512
+        super().__init__(n, (32, 32, 3), self.NUM_CLASSES, transform,
+                         seed=2 if mode == "train" else 3)
+
+
+    @staticmethod
+    def _member_match(name, mode):
+        # cifar-10 archives: data_batch_1..5 / test_batch
+        base = os.path.basename(name)
+        return ("data_batch" in base) if mode == "train" \
+            else ("test_batch" in base)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+
+    @staticmethod
+    def _member_match(name, mode):
+        # cifar-100 archives: members named 'train' / 'test'
+        base = os.path.basename(name)
+        return base == ("train" if mode == "train" else "test")
+
+
+class DatasetFolder(Dataset):
+    """Reference: vision/datasets/folder.py — directory-per-class layout."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        extensions = extensions or (".npy",)
+        self.classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(self.classes)}
+        self.samples = []
+        for c in self.classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                if fn.lower().endswith(tuple(extensions)):
+                    self.samples.append((os.path.join(cdir, fn),
+                                         self.class_to_idx[c]))
+        self.loader = loader or (lambda p: np.load(p))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        path, target = self.samples[i]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+
+ImageFolder = DatasetFolder
+
+
+class Flowers(_SyntheticImageDataset):
+    """Reference: vision/datasets/flowers.py (synthetic fallback only)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        n = 1024 if mode == "train" else 256
+        super().__init__(n, (64, 64, 3), 102, transform,
+                         seed=4 if mode == "train" else 5)
+
+
+class VOC2012(_SyntheticImageDataset):
+    """Reference: vision/datasets/voc2012.py (synthetic fallback only)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        n = 256 if mode == "train" else 64
+        super().__init__(n, (64, 64, 3), 21, transform,
+                         seed=6 if mode == "train" else 7)
+
+    def __getitem__(self, i):
+        img = self.images[i]
+        if self.transform is not None:
+            img = self.transform(img)
+        # segmentation label map
+        rs = np.random.RandomState(int(self.labels[i]) + 100)
+        seg = rs.randint(0, 21, img.shape[:2] if img.ndim == 3 and
+                         img.shape[2] == 3 else (64, 64)).astype(np.int64)
+        return img, seg

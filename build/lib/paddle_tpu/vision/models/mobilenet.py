@@ -1,0 +1,149 @@
+"""MobileNetV1/V2 (reference: python/paddle/vision/models/
+mobilenetv1.py, mobilenetv2.py). Depthwise convs lower to XLA
+feature-group convolutions — the TPU path for the reference's
+`depthwise_conv.cu`."""
+from __future__ import annotations
+
+from ...nn.layer import Layer
+from ...nn.layer_common import Dropout, Linear
+from ...nn.layer_conv_norm import AdaptiveAvgPool2D, BatchNorm2D, Conv2D
+from ...nn import functional as F
+
+
+class ConvBNLayer(Layer):
+    def __init__(self, in_c, out_c, kernel, stride=1, padding=0, groups=1,
+                 act="relu"):
+        super().__init__()
+        self.conv = Conv2D(in_c, out_c, kernel, stride=stride,
+                           padding=padding, groups=groups, bias_attr=False)
+        self.bn = BatchNorm2D(out_c)
+        self.act = act
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        if self.act == "relu":
+            x = F.relu(x)
+        elif self.act == "relu6":
+            x = F.relu6(x)
+        return x
+
+
+class DepthwiseSeparable(Layer):
+    def __init__(self, in_c, out_c1, out_c2, stride, scale):
+        super().__init__()
+        self.dw = ConvBNLayer(int(in_c * scale), int(out_c1 * scale), 3,
+                              stride=stride, padding=1,
+                              groups=int(in_c * scale))
+        self.pw = ConvBNLayer(int(out_c1 * scale), int(out_c2 * scale), 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(Layer):
+    """Reference: mobilenetv1.py MobileNetV1."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = ConvBNLayer(3, int(32 * scale), 3, stride=2, padding=1)
+        cfg = [  # in, c1, c2, stride
+            (32, 32, 64, 1), (64, 64, 128, 2), (128, 128, 128, 1),
+            (128, 128, 256, 2), (256, 256, 256, 1), (256, 256, 512, 2),
+            (512, 512, 512, 1), (512, 512, 512, 1), (512, 512, 512, 1),
+            (512, 512, 512, 1), (512, 512, 512, 1), (512, 512, 1024, 2),
+            (1024, 1024, 1024, 1)]
+        self.blocks = []
+        for i, (ic, c1, c2, s) in enumerate(cfg):
+            blk = DepthwiseSeparable(ic, c1, c2, s, scale)
+            self.add_sublayer(f"block{i}", blk)
+            self.blocks.append(blk)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.conv1(x)
+        for blk in self.blocks:
+            x = blk(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.reshape((x.shape[0], -1)))
+        return x
+
+
+class InvertedResidual(Layer):
+    def __init__(self, in_c, out_c, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(in_c * expand_ratio))
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNLayer(in_c, hidden, 1, act="relu6"))
+        layers.append(ConvBNLayer(hidden, hidden, 3, stride=stride,
+                                  padding=1, groups=hidden, act="relu6"))
+        layers.append(ConvBNLayer(hidden, out_c, 1, act=None))
+        self.layers = layers
+        for i, l in enumerate(layers):
+            self.add_sublayer(str(i), l)
+
+    def forward(self, x):
+        out = x
+        for l in self.layers:
+            out = l(out)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    """Reference: mobilenetv2.py MobileNetV2."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [  # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        in_c = int(32 * scale)
+        self.conv1 = ConvBNLayer(3, in_c, 3, stride=2, padding=1,
+                                 act="relu6")
+        self.blocks = []
+        bi = 0
+        for t, c, n, s in cfg:
+            out_c = int(c * scale)
+            for i in range(n):
+                blk = InvertedResidual(in_c, out_c, s if i == 0 else 1, t)
+                self.add_sublayer(f"ir{bi}", blk)
+                self.blocks.append(blk)
+                in_c = out_c
+                bi += 1
+        self.last_c = int(1280 * max(1.0, scale))
+        self.conv_last = ConvBNLayer(in_c, self.last_c, 1, act="relu6")
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = Dropout(0.2)
+            self.fc = Linear(self.last_c, num_classes)
+
+    def forward(self, x):
+        x = self.conv1(x)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.conv_last(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.reshape((x.shape[0], -1))))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV2(scale=scale, **kwargs)

@@ -1,0 +1,99 @@
+"""VGG (reference: python/paddle/vision/models/vgg.py)."""
+from __future__ import annotations
+
+from ...nn.layer import Layer
+from ...nn.layer_common import Dropout, Linear
+from ...nn.layer_conv_norm import BatchNorm2D, Conv2D
+from ...nn import functional as F
+
+
+_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+          512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+          "M", 512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512,
+          512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class _Features(Layer):
+    def __init__(self, cfg, batch_norm):
+        super().__init__()
+        self._ops = []
+        in_c = 3
+        idx = 0
+        for v in cfg:
+            if v == "M":
+                self._ops.append(("pool", None))
+                continue
+            conv = Conv2D(in_c, v, 3, padding=1)
+            self.add_sublayer(str(idx), conv)
+            idx += 1
+            if batch_norm:
+                bn = BatchNorm2D(v)
+                self.add_sublayer(str(idx), bn)
+                idx += 1
+                self._ops.append(("convbn", (conv, bn)))
+            else:
+                self._ops.append(("conv", conv))
+            in_c = v
+
+    def forward(self, x):
+        for kind, op in self._ops:
+            if kind == "pool":
+                x = F.max_pool2d(x, kernel_size=2, stride=2)
+            elif kind == "convbn":
+                conv, bn = op
+                x = F.relu(bn(conv(x)))
+            else:
+                x = F.relu(op(x))
+        return x
+
+
+class VGG(Layer):
+    """Reference: vision/models/vgg.py VGG."""
+
+    def __init__(self, features, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.features = features
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if num_classes > 0:
+            self.classifier0 = Linear(512 * 7 * 7, 4096)
+            self.classifier1 = Linear(4096, 4096)
+            self.classifier2 = Linear(4096, num_classes)
+            self.dropout = Dropout(0.5)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = F.adaptive_avg_pool2d(x, (7, 7))
+        if self.num_classes > 0:
+            b = x.shape[0]
+            x = x.reshape((b, -1))
+            x = self.dropout(F.relu(self.classifier0(x)))
+            x = self.dropout(F.relu(self.classifier1(x)))
+            x = self.classifier2(x)
+        return x
+
+
+def _vgg(cfg, batch_norm=False, **kwargs):
+    return VGG(_Features(_CFGS[cfg], batch_norm), **kwargs)
+
+
+def vgg11(pretrained=False, batch_norm=False, **kwargs):
+    return _vgg("A", batch_norm, **kwargs)
+
+
+def vgg13(pretrained=False, batch_norm=False, **kwargs):
+    return _vgg("B", batch_norm, **kwargs)
+
+
+def vgg16(pretrained=False, batch_norm=False, **kwargs):
+    return _vgg("D", batch_norm, **kwargs)
+
+
+def vgg19(pretrained=False, batch_norm=False, **kwargs):
+    return _vgg("E", batch_norm, **kwargs)

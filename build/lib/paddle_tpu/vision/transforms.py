@@ -1,0 +1,168 @@
+"""`paddle.vision.transforms` equivalent (reference:
+python/paddle/vision/transforms/transforms.py). Numpy-based — transforms
+run in DataLoader workers on host, keeping the device step pure compute.
+Images are HWC uint8/float arrays (PIL not required)."""
+from __future__ import annotations
+
+import numbers
+import random
+from typing import List, Sequence
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(np.asarray(img))
+
+
+class ToTensor(BaseTransform):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (reference: to_tensor)."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        if img.ndim == 2:
+            img = img[:, :, None]
+        out = img.astype(np.float32)
+        if img.dtype == np.uint8:
+            out = out / 255.0
+        if self.data_format == "CHW":
+            out = np.transpose(out, (2, 0, 1))
+        return out
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW",
+                 to_rgb=False):
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = img.astype(np.float32)
+        if self.data_format == "CHW":
+            return (img - self.mean[:, None, None]) / self.std[:, None, None]
+        return (img - self.mean) / self.std
+
+
+def _resize_np(img, size):
+    """Nearest-neighbor resize (no PIL/cv2 dependency)."""
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        if h < w:
+            nh, nw = size, int(w * size / h)
+        else:
+            nh, nw = int(h * size / w), size
+    else:
+        nh, nw = size
+    ri = (np.arange(nh) * h / nh).astype(np.int64).clip(0, h - 1)
+    ci = (np.arange(nw) * w / nw).astype(np.int64).clip(0, w - 1)
+    return img[ri][:, ci]
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="nearest"):
+        self.size = size
+
+    def _apply_image(self, img):
+        return _resize_np(img, self.size)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        h, w = img.shape[:2]
+        th, tw = self.size
+        i = max(0, (h - th) // 2)
+        j = max(0, (w - tw) // 2)
+        return img[i:i + th, j:j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        if self.padding:
+            p = self.padding
+            pad = ((p, p), (p, p)) + ((0, 0),) * (img.ndim - 2)
+            img = np.pad(img, pad, mode="constant")
+        h, w = img.shape[:2]
+        th, tw = self.size
+        i = random.randint(0, max(0, h - th))
+        j = random.randint(0, max(0, w - tw))
+        return img[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return img[:, ::-1].copy()
+        return img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return img[::-1].copy()
+        return img
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def _apply_image(self, img):
+        if img.ndim == 2:
+            img = img[:, :, None]
+        return np.transpose(img, self.order)
+
+
+# functional aliases (paddle.vision.transforms.functional subset)
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format="CHW"):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="nearest"):
+    return _resize_np(np.asarray(img), size)
+
+
+def center_crop(img, size):
+    return CenterCrop(size)(img)
+
+
+def hflip(img):
+    return np.asarray(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return np.asarray(img)[::-1].copy()

@@ -1,0 +1,37 @@
+"""Build script — compiles the native host runtime into the wheel.
+
+Reference: `python/setup.py.in:262-267` ships `core_avx.so` inside the
+paddle package; here `csrc/ptpu_runtime.cc` builds to
+`paddle_tpu/_native.so` (arena allocator, blocking queue, profiler,
+AES-CTR — loaded via ctypes, `paddle_tpu/core/native.py`).
+"""
+import os
+import subprocess
+import sys
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def build_native():
+    src = os.path.join(ROOT, "csrc", "ptpu_runtime.cc")
+    out = os.path.join(ROOT, "paddle_tpu", "_native.so")
+    if os.path.exists(out) and \
+            os.path.getmtime(out) >= os.path.getmtime(src):
+        return
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-Wextra",
+           "-fvisibility=hidden", "-pthread", "-shared", "-o", out, src]
+    print("building native runtime:", " ".join(cmd), file=sys.stderr)
+    subprocess.run(cmd, check=True)
+
+
+class BuildPyWithNative(build_py):
+    def run(self):
+        build_native()
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildPyWithNative})

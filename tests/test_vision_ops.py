@@ -1,0 +1,212 @@
+"""Detection op tests (VERDICT round 1 item 9).
+
+Gradcheck where differentiable (the OpTest bar, `op_test.py:110`), numpy
+reference comparisons for the discrete ops, and a small YOLO-ish conv
+model running forward+backward end to end (BASELINE config 4 smoke).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.vision import ops as V
+from op_test import check_grad, check_eager_vs_jit
+
+
+class TestBoxIoU:
+    def test_matches_numpy(self):
+        rs = np.random.RandomState(0)
+        # sort along the point axis → [x1, y1, x2, y2] directly
+        a = np.sort(rs.rand(5, 2, 2), axis=1).reshape(5, 4) * 10
+        b = np.sort(rs.rand(7, 2, 2), axis=1).reshape(7, 4) * 10
+        got = np.asarray(V.box_iou(jnp.asarray(a), jnp.asarray(b)))
+        for i in range(5):
+            for j in range(7):
+                xx1 = max(a[i, 0], b[j, 0]); yy1 = max(a[i, 1], b[j, 1])
+                xx2 = min(a[i, 2], b[j, 2]); yy2 = min(a[i, 3], b[j, 3])
+                inter = max(0, xx2 - xx1) * max(0, yy2 - yy1)
+                areas = ((a[i, 2] - a[i, 0]) * (a[i, 3] - a[i, 1]) +
+                         (b[j, 2] - b[j, 0]) * (b[j, 3] - b[j, 1]))
+                ref = inter / (areas - inter + 1e-10)
+                np.testing.assert_allclose(got[i, j], ref, atol=1e-5)
+
+    def test_identity(self):
+        a = jnp.asarray([[0., 0., 2., 2.]])
+        np.testing.assert_allclose(np.asarray(V.box_iou(a, a)), [[1.0]],
+                                   rtol=1e-6)
+
+
+class TestYoloBox:
+    def _head(self, N=2, A=2, C=3, H=4, W=4):
+        rs = np.random.RandomState(1)
+        x = rs.randn(N, A * (5 + C), H, W).astype(np.float32) * 0.5
+        img = np.asarray([[128, 128]] * N, np.int32)
+        return x, img
+
+    def test_shapes_and_ranges(self):
+        x, img = self._head()
+        boxes, scores = V.yolo_box(jnp.asarray(x), jnp.asarray(img),
+                                   anchors=[10, 13, 16, 30], class_num=3,
+                                   downsample_ratio=32)
+        assert boxes.shape == (2, 2 * 4 * 4, 4)
+        assert scores.shape == (2, 2 * 4 * 4, 3)
+        b = np.asarray(boxes)
+        assert (b >= 0).all() and (b <= 127).all()  # clipped to image
+        assert (np.asarray(scores) >= 0).all()
+
+    def test_jit_parity_and_grad(self):
+        x, img = self._head(N=1, A=1, C=2, H=2, W=2)
+        imgj = jnp.asarray(img)
+
+        def f(v):
+            b, s = V.yolo_box(v, imgj, anchors=[16, 30], class_num=2,
+                              conf_thresh=0.0, downsample_ratio=32)
+            return jnp.sum(b) * 1e-3 + jnp.sum(s)
+
+        check_eager_vs_jit(f, [jnp.asarray(x)])
+        check_grad(lambda v: f(jnp.asarray(v, jnp.float32)), [x],
+                   rtol=2e-2, atol=2e-3)
+
+
+class TestPriorBox:
+    def test_ssd_priors(self):
+        boxes, var = V.prior_box((2, 2), (32, 32), min_sizes=[8.0],
+                                 max_sizes=[16.0], aspect_ratios=[2.0],
+                                 flip=True, clip=True)
+        # P = 1 (ar=1) + 2 (ar=2, 1/2) + 1 (max size) = 4
+        assert boxes.shape == (2, 2, 4, 4)
+        b = np.asarray(boxes)
+        assert (b >= 0).all() and (b <= 1).all()
+        assert var.shape == boxes.shape
+        # center of cell (0,0) prior: offset 0.5 * step 16 / img 32
+        cx = (b[0, 0, 0, 0] + b[0, 0, 0, 2]) / 2
+        np.testing.assert_allclose(cx, 0.25, atol=1e-6)
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        rs = np.random.RandomState(2)
+        priors = np.sort(rs.rand(6, 2, 2), axis=1).reshape(6, 4) \
+            .astype(np.float32)
+        var = np.full((6, 4), 0.1, np.float32)
+        targets = np.sort(rs.rand(3, 2, 2), axis=1).reshape(3, 4) \
+            .astype(np.float32)
+        enc = V.box_coder(jnp.asarray(priors), jnp.asarray(var),
+                          jnp.asarray(targets), "encode_center_size")
+        dec = V.box_coder(jnp.asarray(priors), jnp.asarray(var),
+                          enc, "decode_center_size")
+        np.testing.assert_allclose(
+            np.asarray(dec),
+            np.broadcast_to(targets[:, None, :], (3, 6, 4)), atol=1e-5)
+
+    def test_encode_gradcheck(self):
+        rs = np.random.RandomState(3)
+        priors = (np.sort(rs.rand(4, 2, 2), axis=1).reshape(4, 4)
+                  .astype(np.float32) + 0.1)
+        targets = (np.sort(rs.rand(2, 2, 2), axis=1).reshape(2, 4)
+                   .astype(np.float32) + 0.1)
+        pj = jnp.asarray(priors)
+        check_grad(
+            lambda t: V.box_coder(pj, None, jnp.asarray(t, jnp.float32)),
+            [targets], rtol=2e-2, atol=2e-3)
+
+
+class TestRoiAlign:
+    def test_constant_map(self):
+        x = jnp.full((1, 3, 8, 8), 5.0)
+        rois = jnp.asarray([[1.0, 1.0, 5.0, 5.0]])
+        out = V.roi_align(x, rois, output_size=(2, 2))
+        assert out.shape == (1, 3, 2, 2)
+        np.testing.assert_allclose(np.asarray(out), 5.0, rtol=1e-6)
+
+    def test_linear_ramp_center(self):
+        # f(x,y) = x → averaging bilinear samples reproduces bin centers
+        W = 8
+        ramp = jnp.broadcast_to(jnp.arange(W, dtype=jnp.float32),
+                                (1, 1, W, W))
+        rois = jnp.asarray([[2.0, 2.0, 6.0, 6.0]])
+        out = V.roi_align(ramp, rois, output_size=(2, 2), aligned=False)
+        got = np.asarray(out)[0, 0]
+        np.testing.assert_allclose(got[0], [3.0, 5.0], atol=1e-5)
+
+    def test_gradcheck(self):
+        rs = np.random.RandomState(4)
+        x = rs.randn(1, 2, 6, 6).astype(np.float32)
+        rois = jnp.asarray([[1.0, 1.0, 4.5, 4.5],
+                            [0.5, 2.0, 3.0, 5.0]])
+        check_grad(
+            lambda v: V.roi_align(jnp.asarray(v, jnp.float32), rois,
+                                  output_size=(2, 2)),
+            [x], rtol=2e-2, atol=2e-3)
+
+
+class TestNMS:
+    def test_suppression(self):
+        boxes = jnp.asarray([[0, 0, 10, 10], [1, 1, 11, 11],
+                             [20, 20, 30, 30]], jnp.float32)
+        scores = jnp.asarray([0.9, 0.8, 0.7])
+        keep = np.asarray(V.nms(boxes, scores, iou_threshold=0.5))
+        np.testing.assert_array_equal(keep, [True, False, True])
+
+    def test_multiclass_nms_padded_output(self):
+        boxes = jnp.asarray([[0, 0, 10, 10], [1, 1, 11, 11],
+                             [20, 20, 30, 30], [50, 50, 60, 60]],
+                            jnp.float32)
+        scores = jnp.asarray([[0.9, 0.85, 0.1, 0.0],
+                              [0.0, 0.1, 0.8, 0.75]], jnp.float32)
+        out, n = V.multiclass_nms(boxes, scores, score_threshold=0.2,
+                                  nms_threshold=0.5, keep_top_k=6)
+        assert out.shape == (6, 6)
+        n = int(n)
+        assert n == 3  # (c0, box0), (c1, box2), (c1, box3); box1 suppressed
+        got = np.asarray(out)
+        assert set(got[:n, 0].astype(int)) == {0, 1}
+        assert (got[n:, 0] == -1).all()  # padding rows flagged
+
+    def test_multiclass_nms_jits(self):
+        boxes = jnp.asarray(np.random.RandomState(5).rand(16, 4) * 50,
+                            jnp.float32)
+        boxes = jnp.concatenate([boxes[:, :2],
+                                 boxes[:, :2] + 5 + boxes[:, 2:]], 1)
+        scores = jnp.asarray(np.random.RandomState(6).rand(3, 16),
+                             jnp.float32)
+        f = jax.jit(lambda b, s: V.multiclass_nms(b, s))
+        out, n = f(boxes, scores)
+        assert out.shape[1] == 6 and int(n) >= 1
+
+
+class TestYoloModelSmoke:
+    def test_tiny_yolo_forward_backward(self):
+        """Small conv backbone + YOLO head trains a step (config 4
+        smoke: detection model fwd+bwd on static shapes)."""
+        pt.seed(0)
+        A, C = 2, 3
+        net = pt.nn.Sequential(
+            pt.nn.Conv2D(3, 8, 3, stride=2, padding=1), pt.nn.ReLU(),
+            pt.nn.Conv2D(8, 16, 3, stride=2, padding=1), pt.nn.ReLU(),
+            pt.nn.Conv2D(16, A * (5 + C), 1),
+        )
+        from paddle_tpu.nn.layer import functional_call, trainable_state
+        img = jnp.asarray(np.random.RandomState(7).rand(2, 3, 32, 32),
+                          jnp.float32)
+        img_size = jnp.asarray([[32, 32]] * 2, jnp.int32)
+        tgt_scores = jnp.zeros((2, A * 8 * 8, C), jnp.float32)
+
+        def loss_fn(params):
+            feat, _ = functional_call(net, params, img)
+            _, scores = V.yolo_box(feat, img_size, anchors=[8, 8, 16, 16],
+                                   class_num=C, conf_thresh=0.0,
+                                   downsample_ratio=4)
+            return jnp.mean((scores - tgt_scores) ** 2)
+
+        params = trainable_state(net)
+        l0, g = jax.value_and_grad(loss_fn)(params)
+        assert np.isfinite(float(l0))
+        gnorm = sum(float(jnp.sum(jnp.abs(v))) for v in
+                    jax.tree.leaves(g))
+        assert gnorm > 0
+        # one SGD step reduces the loss
+        params2 = jax.tree.map(lambda p, gg: p - 0.5 * gg, params, g)
+        l1 = loss_fn(params2)
+        assert float(l1) < float(l0)
