@@ -1,0 +1,157 @@
+"""Decoding + hierarchical softmax.
+
+Reference:
+  * `operators/hierarchical_sigmoid_op.cc` + `math/matrix_bit_code.h`
+    (complete-binary-tree hsigmoid) → `hsigmoid_loss`;
+  * `operators/math/beam_search.{cc,cu}` + Python
+    `layers/rnn.py BeamSearchDecoder` → `beam_search` (functional,
+    static max_len, `lax.scan` over steps — the XLA shape contract).
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical sigmoid
+# ---------------------------------------------------------------------------
+
+def _complete_tree_codes(num_classes: int):
+    """Path node ids + branch bits for a complete binary tree (reference
+    `matrix_bit_code.h SimpleCode`: code(c) = c + num_classes; walk the
+    implicit heap). Returns (paths [C, D], bits [C, D], mask [C, D])."""
+    depth = max(1, int(math.ceil(math.log2(max(num_classes, 2)))))
+    paths = np.zeros((num_classes, depth), np.int32)
+    bits = np.zeros((num_classes, depth), np.float32)
+    mask = np.zeros((num_classes, depth), np.float32)
+    for c in range(num_classes):
+        code = c + num_classes
+        seq = []
+        while code > 1:
+            seq.append((code // 2 - 1, float(code & 1)))  # (node, bit)
+            code //= 2
+        seq.reverse()  # root → leaf
+        for d, (node, bit) in enumerate(seq):
+            paths[c, d] = node
+            bits[c, d] = bit
+            mask[c, d] = 1.0
+    return jnp.asarray(paths), jnp.asarray(bits), jnp.asarray(mask)
+
+
+def hsigmoid_loss(x, labels, num_classes: int, weight, bias=None):
+    """Hierarchical sigmoid loss (`hierarchical_sigmoid_op.cc`).
+
+    x: [B, D]; labels: [B] int; weight: [num_classes-1, D] internal-node
+    vectors; bias: [num_classes-1]. Returns per-example loss [B].
+    Cost O(B * log C * D) vs softmax's O(B * C * D).
+    """
+    paths, bits, mask = _complete_tree_codes(num_classes)
+    p = paths[labels]            # [B, depth]
+    b = bits[labels]             # [B, depth]
+    m = mask[labels]             # [B, depth]
+    w = weight[p]                # [B, depth, D]
+    logits = jnp.einsum("bd,bkd->bk", x, w)
+    if bias is not None:
+        logits = logits + bias[p]
+    # BCE with target = bit, masked beyond path length
+    loss = m * (jnp.logaddexp(0.0, logits) - b * logits)
+    return jnp.sum(loss, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Beam search
+# ---------------------------------------------------------------------------
+
+def beam_search(step_fn: Callable, init_state: Any, batch_size: int,
+                beam_size: int, bos_id: int, eos_id: int, max_len: int,
+                length_penalty: float = 0.0):
+    """Functional beam search (`math/beam_search.cc` semantics, XLA
+    shapes: everything [B, K, ...], `lax.scan` over max_len steps).
+
+    step_fn(tokens [B, K] int32, state) -> (log_probs [B, K, V], state);
+    state leaves carry leading dims [B, K]. Finished beams (emitted
+    eos) are frozen: they propose only eos at zero incremental score.
+
+    Returns (sequences [B, K, max_len] int32, scores [B, K]) sorted
+    best-first along K. Scores are sum of token log-probs, length-
+    normalized by ((5+len)/6)**length_penalty when length_penalty > 0
+    (GNMT rule, reference BeamSearchDecoder).
+    """
+    B, K = batch_size, beam_size
+    neg_inf = jnp.asarray(-1e9, jnp.float32)
+
+    tokens0 = jnp.full((B, K), bos_id, jnp.int32)
+    # only beam 0 is live at t=0 (all beams start identical)
+    scores0 = jnp.tile(jnp.asarray([0.0] + [-1e9] * (K - 1),
+                                   jnp.float32)[None], (B, 1))
+    finished0 = jnp.zeros((B, K), bool)
+    lengths0 = jnp.zeros((B, K), jnp.int32)
+    seqs0 = jnp.full((B, K, max_len), eos_id, jnp.int32)
+
+    def tick(carry, t):
+        tokens, scores, finished, lengths, seqs, state = carry
+        log_probs, new_state = step_fn(tokens, state)
+        V = log_probs.shape[-1]
+        # finished beams: force eos continuation at no cost
+        eos_only = jnp.full((V,), -1e9, jnp.float32).at[eos_id].set(0.0)
+        log_probs = jnp.where(finished[..., None], eos_only[None, None],
+                              log_probs)
+        cand = scores[..., None] + log_probs          # [B, K, V]
+        flat = cand.reshape(B, K * V)
+        top_scores, top_idx = jax.lax.top_k(flat, K)  # [B, K]
+        beam_idx = top_idx // V                       # source beam
+        tok = (top_idx % V).astype(jnp.int32)
+
+        def sel(x):
+            return jnp.take_along_axis(
+                x, beam_idx.reshape((B, K) + (1,) * (x.ndim - 2)), axis=1)
+
+        state = jax.tree.map(sel, new_state)
+        finished = jnp.take_along_axis(finished, beam_idx, axis=1)
+        lengths = jnp.take_along_axis(lengths, beam_idx, axis=1)
+        seqs = jnp.take_along_axis(seqs, beam_idx[..., None], axis=1)
+        seqs = seqs.at[:, :, t].set(tok)
+        lengths = jnp.where(finished, lengths, lengths + 1)
+        finished = finished | (tok == eos_id)
+        return (tok, top_scores, finished, lengths, seqs, state), None
+
+    carry0 = (tokens0, scores0, finished0, lengths0, seqs0, init_state)
+    (tokens, scores, finished, lengths, seqs, _), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(max_len))
+
+    if length_penalty > 0.0:
+        lp = ((5.0 + lengths.astype(jnp.float32)) / 6.0) ** length_penalty
+        norm = scores / lp
+    else:
+        norm = scores
+    order = jnp.argsort(-norm, axis=1)
+    seqs = jnp.take_along_axis(seqs, order[..., None], axis=1)
+    norm = jnp.take_along_axis(norm, order, axis=1)
+    return seqs, norm
+
+
+def greedy_search(step_fn: Callable, init_state: Any, batch_size: int,
+                  bos_id: int, eos_id: int, max_len: int):
+    """Greedy decode = beam_size 1 without the bookkeeping."""
+    tokens0 = jnp.full((batch_size,), bos_id, jnp.int32)
+    seqs0 = jnp.full((batch_size, max_len), eos_id, jnp.int32)
+    fin0 = jnp.zeros((batch_size,), bool)
+
+    def tick(carry, t):
+        tokens, finished, seqs, state = carry
+        log_probs, state = step_fn(tokens[:, None], state)
+        tok = jnp.argmax(log_probs[:, 0], axis=-1).astype(jnp.int32)
+        tok = jnp.where(finished, eos_id, tok)
+        seqs = seqs.at[:, t].set(tok)
+        finished = finished | (tok == eos_id)
+        return (tok, finished, seqs, state), None
+
+    (_, _, seqs, _), _ = jax.lax.scan(tick, (tokens0, fin0, seqs0,
+                                             init_state),
+                                      jnp.arange(max_len))
+    return seqs

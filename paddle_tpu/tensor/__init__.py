@@ -14,5 +14,11 @@ from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
+from . import sequence  # noqa: F401
+from .sequence import (  # noqa: F401
+    segment_max, segment_mean, segment_min, segment_sum, sequence_concat,
+    sequence_conv, sequence_enumerate, sequence_expand, sequence_mask,
+    sequence_pad, sequence_pool, sequence_reverse, sequence_slice,
+    sequence_softmax, sequence_unpad)
 from . import stat  # noqa: F401
 from .stat import std, var, median, quantile, nanmedian, nanquantile  # noqa: F401

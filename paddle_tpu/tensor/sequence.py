@@ -85,6 +85,102 @@ def sequence_expand(x, ref_lengths):
     return jnp.repeat(jnp.asarray(x), jnp.asarray(ref_lengths), axis=0)
 
 
+def sequence_softmax(x, lengths=None):
+    """Reference: sequence_softmax op — softmax over the time dim with
+    padding masked out (padded positions get probability 0)."""
+    if lengths is None:
+        return jax.nn.softmax(x, axis=1)
+    mask = sequence_mask(lengths, x.shape[1], dtype="bool")
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    neg = jnp.where(mask, 0.0, -jnp.inf)
+    return jax.nn.softmax(x + neg, axis=1) * mask.astype(x.dtype)
+
+
+def sequence_reverse(x, lengths=None):
+    """Reference: sequence_reverse op — reverse each sequence's valid
+    prefix; padding stays in place."""
+    T = x.shape[1]
+    if lengths is None:
+        return jnp.flip(x, axis=1)
+    lengths = jnp.asarray(lengths)
+    pos = jnp.arange(T)
+    # index of source element for output position t: len-1-t inside the
+    # valid prefix, identity in the padding tail
+    src = jnp.where(pos[None, :] < lengths[:, None],
+                    lengths[:, None] - 1 - pos[None, :], pos[None, :])
+    return jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+
+
+def sequence_concat(xs, lengths_list):
+    """Reference: sequence_concat op — concatenate per-batch sequences
+    along time (valid parts back to back; result re-padded)."""
+    xs = [jnp.asarray(a) for a in xs]
+    lens = [jnp.asarray(l) for l in lengths_list]
+    total = sum(a.shape[1] for a in xs)
+    B = xs[0].shape[0]
+    out = jnp.zeros((B, total) + tuple(xs[0].shape[2:]), xs[0].dtype)
+    out_len = sum(lens)
+    offset = jnp.zeros((B,), lens[0].dtype)
+    pos = jnp.arange(total)
+    for a, l in zip(xs, lens):
+        # scatter a's valid prefix at [offset, offset+l)
+        t = jnp.arange(a.shape[1])
+        dst = offset[:, None] + t[None, :]
+        valid = t[None, :] < l[:, None]
+        dst = jnp.where(valid, dst, total)  # out-of-range drops
+        one_hot = (pos[None, None, :] == dst[:, :, None]).astype(a.dtype)
+        out = out + jnp.einsum("bt...,bts->bs...", a * valid.reshape(
+            valid.shape + (1,) * (a.ndim - 2)).astype(a.dtype), one_hot)
+        offset = offset + l
+    return out, out_len
+
+
+def sequence_slice(x, offset, length):
+    """Reference: sequence_slice op — per-batch [offset, offset+length)
+    windows (static max length; gather-based)."""
+    offset = jnp.asarray(offset)
+    L = int(length) if np.ndim(length) == 0 else int(np.max(length))
+    idx = offset[:, None] + jnp.arange(L)[None, :]
+    idx = jnp.clip(idx, 0, x.shape[1] - 1)
+    return jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+
+
+def sequence_conv(x, filter_w, context_length: int,
+                  context_start: Optional[int] = None, lengths=None):
+    """Reference: sequence_conv op (`sequence_conv_op.cc`) — the
+    im2col-over-time + GEMM pattern: each position sees
+    [t+start, t+start+context_length) rows, flattened, times filter
+    [context_length*d_in, d_out]. Padding positions contribute zeros."""
+    if context_start is None:
+        context_start = -((context_length - 1) // 2)
+    B, T, D = x.shape
+    if lengths is not None:
+        m = sequence_mask(lengths, T, dtype=x.dtype)
+        x = x * m[..., None]
+    cols = []
+    for k in range(context_length):
+        shift = context_start + k
+        rolled = jnp.roll(x, -shift, axis=1)
+        t = jnp.arange(T)
+        valid = (t + shift >= 0) & (t + shift < T)
+        cols.append(rolled * valid[None, :, None].astype(x.dtype))
+    im2col = jnp.concatenate(cols, axis=-1)       # [B, T, ctx*D]
+    return im2col @ filter_w                      # MXU GEMM
+
+
+def sequence_enumerate(ids, win_size: int, pad_value: int = 0):
+    """Reference: sequence_enumerate op — sliding windows of ids:
+    [B, T] → [B, T, win_size] (tail padded)."""
+    B, T = ids.shape
+    t = jnp.arange(T)[:, None] + jnp.arange(win_size)[None, :]
+    valid = t < T
+    t = jnp.clip(t, 0, T - 1)
+    win = ids[:, t]                                # [B, T, W]
+    return jnp.where(valid[None], win, pad_value)
+
+
 # --- segment ops (reference: operators/segment_pool_op + tf-style) ----
 
 def segment_sum(data, segment_ids, num_segments: Optional[int] = None):

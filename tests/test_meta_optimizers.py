@@ -1,0 +1,134 @@
+"""Gradient-compression meta-optimizer tests (VERDICT missing item 8).
+
+Reference semantics checked: DGC sparsity + error feedback
+(`dgc_optimizer.py`), LocalSGD divergence/sync cycle
+(`localsgd_optimizer.py`), fp16 grad compression
+(`fp16_allreduce_optimizer.py`).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed.fleet.meta_optimizers import (
+    DGCMomentumOptimizer, FP16AllReduceOptimizer, LocalSGDOptimizer,
+    fp16_allreduce)
+
+
+def _quadratic(dim=64, seed=0):
+    rs = np.random.RandomState(seed)
+    target = jnp.asarray(rs.randn(dim), jnp.float32)
+
+    def loss_fn(params):
+        return 0.5 * jnp.sum((params["w"] - target) ** 2)
+
+    return loss_fn, {"w": jnp.zeros((dim,), jnp.float32)}, target
+
+
+class TestDGC:
+    def test_sent_grads_are_sparse(self):
+        loss_fn, params, _ = _quadratic()
+        dgc = DGCMomentumOptimizer(pt.optimizer.SGD(learning_rate=0.1),
+                                   sparsity=0.9, rampup_begin_step=0)
+        state = dgc.init_state(params)
+        grads = jax.grad(loss_fn)(params)
+        sent, state = dgc.compress(grads, state)
+        frac_zero = float(jnp.mean(sent["w"] == 0))
+        assert frac_zero >= 0.85, frac_zero  # ~90% suppressed
+
+    def test_error_feedback_preserves_mass(self):
+        """Unsent gradient mass stays in the residual v — nothing is
+        dropped (the core DGC invariant)."""
+        loss_fn, params, _ = _quadratic()
+        dgc = DGCMomentumOptimizer(pt.optimizer.SGD(learning_rate=0.1),
+                                   momentum=0.0, sparsity=0.9)
+        state = dgc.init_state(params)
+        g = jax.grad(loss_fn)(params)
+        sent, state = dgc.compress(g, state)
+        # u = g (no momentum), v_new + sent == g
+        np.testing.assert_allclose(
+            np.asarray(sent["w"] + state["dgc"]["v"]["w"]),
+            np.asarray(g["w"]), rtol=1e-6)
+
+    def test_rampup_sends_dense_then_sparsifies(self):
+        loss_fn, params, _ = _quadratic()
+        dgc = DGCMomentumOptimizer(pt.optimizer.SGD(learning_rate=0.1),
+                                   sparsity=0.9, rampup_begin_step=2)
+        state = dgc.init_state(params)
+        g = jax.grad(loss_fn)(params)
+        sent1, state = dgc.compress(g, state)          # step 1: dense
+        assert float(jnp.mean(sent1["w"] == 0)) < 0.1
+        sent2, state = dgc.compress(g, state)          # step 2: dense
+        sent3, state = dgc.compress(g, state)          # step 3: sparse
+        assert float(jnp.mean(sent3["w"] == 0)) >= 0.85
+
+    def test_converges_on_quadratic(self):
+        loss_fn, params, target = _quadratic(dim=32)
+        dgc = DGCMomentumOptimizer(pt.optimizer.SGD(learning_rate=0.3),
+                                   momentum=0.5, sparsity=0.75)
+        state = dgc.init_state(params)
+        step = jax.jit(lambda p, s: dgc.step_fn(p, jax.grad(loss_fn)(p),
+                                                s))
+        for _ in range(200):
+            params, state = step(params, state)
+        final = float(loss_fn(params))
+        assert final < 1e-2 * 32, final  # near optimum despite 75% drop
+
+
+class TestLocalSGD:
+    def test_diverge_then_sync(self):
+        inner = pt.optimizer.SGD(learning_rate=0.1)
+        lsgd = LocalSGDOptimizer(inner, k_steps=3)
+        params = {"w": jnp.zeros((4,), jnp.float32)}
+        W = 2
+        sp = lsgd.stack_params(params, W)
+        state = lsgd.init_state(sp)
+        # per-worker different grads → replicas diverge between syncs
+        g = {"w": jnp.stack([jnp.ones(4), -jnp.ones(4)])}
+        sp, state = lsgd.apply(sp, g, state)           # step 1
+        assert not np.allclose(np.asarray(sp["w"][0]),
+                               np.asarray(sp["w"][1]))
+        sp, state = lsgd.apply(sp, g, state)           # step 2
+        sp, state = lsgd.apply(sp, g, state)           # step 3 → sync
+        np.testing.assert_allclose(np.asarray(sp["w"][0]),
+                                   np.asarray(sp["w"][1]), rtol=1e-6)
+        # average of +0.1 and -0.1 walks = 0
+        np.testing.assert_allclose(np.asarray(sp["w"][0]), 0.0,
+                                   atol=1e-6)
+
+    def test_converges_with_shared_objective(self):
+        loss_fn, params, target = _quadratic(dim=16, seed=1)
+        lsgd = LocalSGDOptimizer(pt.optimizer.SGD(learning_rate=0.2),
+                                 k_steps=4)
+        sp = lsgd.stack_params(params, 2)
+        state = lsgd.init_state(sp)
+        grad_fn = jax.vmap(jax.grad(loss_fn))
+        step = jax.jit(lambda p, s: lsgd.apply(p, grad_fn(p), s))
+        for _ in range(60):
+            sp, state = step(sp, state)
+        assert float(loss_fn({"w": sp["w"][0]})) < 1e-3
+
+
+class TestFP16AllReduce:
+    def test_cast_roundtrip_dtype_and_error(self):
+        g = {"w": jnp.asarray(np.random.RandomState(0).randn(256),
+                              jnp.float32)}
+        out = fp16_allreduce(g)
+        assert out["w"].dtype == jnp.float32  # restored
+        err = float(jnp.max(jnp.abs(out["w"] - g["w"])))
+        assert 0 < err < 2e-3  # fp16 quantization happened, bounded
+
+    def test_int_grads_pass_through(self):
+        g = {"i": jnp.arange(4)}
+        out = fp16_allreduce(g)
+        assert out["i"].dtype == g["i"].dtype
+
+    def test_wrapper_trains(self):
+        loss_fn, params, _ = _quadratic(dim=8, seed=2)
+        opt = FP16AllReduceOptimizer(pt.optimizer.SGD(learning_rate=0.5))
+        state = opt.init_state(params)
+        for _ in range(50):
+            g = jax.grad(loss_fn)(params)
+            params, state = opt.apply(params, g, state)
+        assert float(loss_fn(params)) < 1e-3

@@ -1,0 +1,214 @@
+"""Sequence-op tranche + hsigmoid + beam search tests (VERDICT missing
+item 7 remainder). Brute-force references throughout — the OpTest bar."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.tensor import sequence as S
+from paddle_tpu.nn.decode import (beam_search, greedy_search,
+                                  hsigmoid_loss, _complete_tree_codes)
+from op_test import check_grad
+
+
+class TestSequenceOps:
+    def test_sequence_softmax_masks_padding(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 5),
+                        jnp.float32)
+        out = S.sequence_softmax(x, lengths=[3, 5])
+        o = np.asarray(out)
+        np.testing.assert_allclose(o[0, 3:], 0.0, atol=1e-7)
+        np.testing.assert_allclose(o.sum(axis=1), 1.0, rtol=1e-5)
+        ref = np.exp(np.asarray(x[0, :3]))
+        ref /= ref.sum()
+        np.testing.assert_allclose(o[0, :3], ref, rtol=1e-5)
+
+    def test_sequence_reverse(self):
+        x = jnp.asarray([[1, 2, 3, 0, 0], [1, 2, 3, 4, 5]], jnp.float32)
+        out = np.asarray(S.sequence_reverse(x, lengths=[3, 5]))
+        np.testing.assert_array_equal(out[0], [3, 2, 1, 0, 0])
+        np.testing.assert_array_equal(out[1], [5, 4, 3, 2, 1])
+
+    def test_sequence_concat(self):
+        a = jnp.asarray([[1, 2, 0]], jnp.float32)
+        b = jnp.asarray([[7, 8, 9, 0]], jnp.float32)
+        out, lens = S.sequence_concat([a, b], [[2], [3]])
+        np.testing.assert_array_equal(np.asarray(out)[0],
+                                      [1, 2, 7, 8, 9, 0, 0])
+        assert int(lens[0]) == 5
+
+    def test_sequence_slice(self):
+        x = jnp.asarray([[10, 11, 12, 13, 14], [20, 21, 22, 23, 24]],
+                        jnp.float32)
+        out = np.asarray(S.sequence_slice(x, offset=[1, 2], length=2))
+        np.testing.assert_array_equal(out, [[11, 12], [22, 23]])
+
+    def test_sequence_conv_matches_manual(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(1, 4, 3).astype(np.float32)
+        w = rs.randn(9, 5).astype(np.float32)  # ctx 3 * d 3 → 5
+        out = np.asarray(S.sequence_conv(jnp.asarray(x), jnp.asarray(w),
+                                         context_length=3))
+        pad = np.concatenate([np.zeros((1, 1, 3), np.float32), x,
+                              np.zeros((1, 1, 3), np.float32)], axis=1)
+        for t in range(4):
+            window = pad[0, t:t + 3].reshape(-1)
+            np.testing.assert_allclose(out[0, t], window @ w, rtol=1e-5)
+
+    def test_sequence_conv_gradcheck(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(2, 3, 2).astype(np.float32)
+        w = jnp.asarray(rs.randn(4, 3).astype(np.float32))
+        check_grad(
+            lambda v: S.sequence_conv(jnp.asarray(v, jnp.float32), w,
+                                      context_length=2),
+            [x], rtol=2e-2, atol=2e-3)
+
+    def test_sequence_enumerate(self):
+        ids = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+        out = np.asarray(S.sequence_enumerate(ids, win_size=2,
+                                              pad_value=0))
+        np.testing.assert_array_equal(
+            out[0], [[1, 2], [2, 3], [3, 4], [4, 0]])
+
+
+class TestHSigmoid:
+    def test_tree_codes_cover_all_classes_uniquely(self):
+        for C in (2, 5, 8, 13):
+            paths, bits, mask = _complete_tree_codes(C)
+            keys = set()
+            for c in range(C):
+                d = int(np.asarray(mask[c]).sum())
+                key = tuple(np.asarray(paths[c][:d])) + \
+                    tuple(np.asarray(bits[c][:d]))
+                keys.add(key)
+            assert len(keys) == C  # unique leaf per class
+
+    def test_loss_decreases_training_to_target(self):
+        C, D, B = 10, 6, 8
+        rs = np.random.RandomState(3)
+        x = jnp.asarray(rs.randn(B, D), jnp.float32)
+        labels = jnp.asarray(rs.randint(0, C, (B,)), jnp.int32)
+        w = jnp.asarray(rs.randn(C - 1, D) * 0.1, jnp.float32)
+        b = jnp.zeros((C - 1,), jnp.float32)
+
+        def loss(w, b):
+            return jnp.mean(hsigmoid_loss(x, labels, C, w, b))
+
+        l0 = float(loss(w, b))
+        step = jax.jit(lambda w, b: jax.grad(loss, argnums=(0, 1))(w, b))
+        for _ in range(150):
+            gw, gb = step(w, b)
+            w, b = w - 0.5 * gw, b - 0.5 * gb
+        assert float(loss(w, b)) < l0 * 0.3
+
+    def test_gradcheck(self):
+        C, D, B = 6, 4, 3
+        rs = np.random.RandomState(4)
+        x = rs.randn(B, D).astype(np.float32)
+        labels = jnp.asarray([0, 3, 5], jnp.int32)
+        w = jnp.asarray(rs.randn(C - 1, D).astype(np.float32))
+        check_grad(
+            lambda v: hsigmoid_loss(jnp.asarray(v, jnp.float32), labels,
+                                    C, w),
+            [x], rtol=2e-2, atol=2e-3)
+
+
+def _table_lm(V=5, T=3, seed=5):
+    """Toy LM: fixed per-token transition log-probs (state-free)."""
+    rs = np.random.RandomState(seed)
+    logits = rs.randn(V, V).astype(np.float32) * 2.0
+    table = jnp.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+
+    def step_fn(tokens, state):
+        return table[tokens], state
+
+    return table, step_fn
+
+
+class TestBeamSearch:
+    def test_beam_finds_brute_force_optimum(self):
+        V, T = 5, 3
+        table, step_fn = _table_lm(V, T)
+        tbl = np.asarray(table)
+        bos, eos = 0, V - 1  # eos never optimal here by construction
+        tbl[:, eos] = -100.0
+        table2 = jnp.asarray(tbl)
+
+        def step2(tokens, state):
+            return table2[tokens], state
+
+        seqs, scores = beam_search(step2, init_state={}, batch_size=1,
+                                   beam_size=V * V, bos_id=bos,
+                                   eos_id=eos, max_len=T)
+        # brute force over all V^T sequences
+        best, best_s = None, -1e18
+        import itertools
+        for cand in itertools.product(range(V), repeat=T):
+            s, prev = 0.0, bos
+            for tok in cand:
+                s += tbl[prev, tok]
+                prev = tok
+            if s > best_s:
+                best, best_s = cand, s
+        np.testing.assert_array_equal(np.asarray(seqs)[0, 0], best)
+        np.testing.assert_allclose(float(scores[0, 0]), best_s,
+                                   rtol=1e-4)
+
+    def test_finished_beams_freeze(self):
+        """A beam that emits eos stops accumulating score."""
+        V = 4
+        bos, eos = 0, 1
+        # token 1 (eos) is overwhelmingly likely from bos
+        tbl = np.full((V, V), -10.0, np.float32)
+        tbl[:, eos] = -0.01
+        table = jnp.asarray(tbl)
+
+        def step_fn(tokens, state):
+            return table[tokens], state
+
+        seqs, scores = beam_search(step_fn, init_state={}, batch_size=1,
+                                   beam_size=2, bos_id=bos, eos_id=eos,
+                                   max_len=5)
+        top = np.asarray(seqs)[0, 0]
+        assert top[0] == eos and (top == eos).all()
+        np.testing.assert_allclose(float(scores[0, 0]), -0.01, atol=1e-4)
+
+    def test_greedy_matches_beam1(self):
+        V, T = 6, 4
+        table, step_fn = _table_lm(V, T, seed=6)
+        seqs_b, _ = beam_search(step_fn, init_state={}, batch_size=2,
+                                beam_size=1, bos_id=0, eos_id=V - 1,
+                                max_len=T)
+        g = greedy_search(step_fn, init_state={}, batch_size=2, bos_id=0,
+                          eos_id=V - 1, max_len=T)
+        got_b = np.asarray(seqs_b)[:, 0]
+        got_g = np.asarray(g)
+        # identical until (and including) first eos
+        for row_b, row_g in zip(got_b, got_g):
+            for tb, tg in zip(row_b, row_g):
+                assert tb == tg
+                if tb == V - 1:
+                    break
+
+    def test_state_is_gathered_by_beam(self):
+        """Stateful LM: state must follow its beam through reorderings."""
+        V = 4
+        bos, eos = 0, 3
+
+        def step_fn(tokens, counts):
+            # favor repeating the current token; forbid eos early
+            logits = jnp.full(tokens.shape + (V,), -5.0)
+            logits = jnp.take_along_axis(
+                logits, tokens[..., None], axis=-1
+            ) * 0 - 5.0  # placeholder
+            one_hot = jax.nn.one_hot(tokens, V) * 4.0
+            logits = -5.0 + one_hot
+            logits = logits.at[..., eos].set(-50.0)
+            return jax.nn.log_softmax(logits), counts + 1
+
+        counts0 = jnp.zeros((1, 3), jnp.int32)
+        seqs, _ = beam_search(step_fn, counts0, batch_size=1, beam_size=3,
+                              bos_id=bos, eos_id=eos, max_len=4)
+        assert seqs.shape == (1, 3, 4)
