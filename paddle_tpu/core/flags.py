@@ -104,6 +104,10 @@ define_flag("fraction_of_gpu_memory_to_use", 1.0,
 define_flag("init_allocated_mem", False, "Kept for API parity")
 define_flag("enable_pallas_kernels", True,
             "Use Pallas kernels (flash attention etc.) where available")
+define_flag("pallas_attention_min_seq", 4096,
+            "Min self-attention seq len routed to the Pallas flash kernel "
+            "(below it XLA's fused dense attention wins; measured on v5e: "
+            "xla fwd+bwd 11.7ms vs flash 16.7ms at [8,1024,16,64])")
 define_flag("check_kernel_launch", False,
             "Kept for API parity (reference: flags.cc:590)")
 define_flag("max_inplace_grad_add", 0, "Kept for API parity")

@@ -51,12 +51,20 @@ def pipeline_spec(spec_tree):
         spec_tree, is_leaf=lambda s: s is None or isinstance(s, tuple))
 
 
+def _mb_key(base, i, s):
+    """Per-(microbatch, stage) dropout key. Both schedules derive keys
+    through this so gpipe and 1F1B draw IDENTICAL masks for the same
+    (microbatch, stage) — loss parity between schedules is exact."""
+    return jax.random.fold_in(jax.random.fold_in(base, i), s)
+
+
 def gpipe(block_fn: Callable[[Any, Any], Any],
           stacked_params,
           microbatches,
           *,
           num_stages: int,
-          remat: bool = False):
+          remat: bool = False,
+          rng_key=None):
     """Run the F-then-B pipeline forward.
 
     block_fn(stage_params, x) -> y : one stage's computation (same code for
@@ -65,12 +73,17 @@ def gpipe(block_fn: Callable[[Any, Any], Any],
     the 'pipe' axis).
 
     microbatches: [M, mb, ...] input activation stream.
+    With rng_key set, block_fn is called as block_fn(stage_params, x, key)
+    with a distinct key per (microbatch, stage) — dropout masks decorrelate
+    across ticks and stages (a plain closure draw would bake ONE mask into
+    the scanned tick).
     Returns [M, mb, ...] outputs of the last stage, microbatch order
     preserved.
     """
     S = num_stages
     M = microbatches.shape[0]
     fn = jax.checkpoint(block_fn) if remat else block_fn
+    sidx = jnp.arange(S)
 
     state = jnp.zeros((S,) + tuple(microbatches.shape[1:]),
                       microbatches.dtype)
@@ -80,18 +93,24 @@ def gpipe(block_fn: Callable[[Any, Any], Any],
         jnp.zeros((0,) + tuple(microbatches.shape[1:]), microbatches.dtype)
     stream = jnp.concatenate([microbatches, pad], axis=0)
 
-    def tick(state, x_t):
+    def tick(state, xs):
+        x_t, t = xs
         shifted = jnp.roll(state, 1, axis=0)          # CollectivePermute
         shifted = shifted.at[0].set(x_t)               # inject at stage 0
-        y = jax.vmap(fn)(stacked_params, shifted)      # each device: 1 stage
+        if rng_key is None:
+            y = jax.vmap(fn)(stacked_params, shifted)  # each device: 1 stage
+        else:
+            # microbatch index at stage s on tick t is i = t - s
+            keys = jax.vmap(lambda s: _mb_key(rng_key, t - s, s))(sidx)
+            y = jax.vmap(fn)(stacked_params, shifted, keys)
         return y, y[S - 1]                             # emit last stage
 
-    _, outs = lax.scan(tick, state, stream)
+    _, outs = lax.scan(tick, state, (stream, jnp.arange(stream.shape[0])))
     return outs[S - 1:] if S > 1 else outs
 
 
 def one_f_one_b(block_fn, stacked_params, microbatches, head_grad_fn,
-                head_params, head_aux, *, num_stages: int):
+                head_params, head_aux, *, num_stages: int, rng_key=None):
     """1F1B pipeline schedule: one combined forward+backward tick per scan
     step.
 
@@ -151,8 +170,10 @@ def one_f_one_b(block_fn, stacked_params, microbatches, head_grad_fn,
              jnp.zeros((S - 1,) + tuple(a.shape[1:]), a.dtype)], 0),
         head_aux)
 
-    def stage_bwd(stage_p, x_saved, ct):
-        _, vjp_fn = jax.vjp(block_fn, stage_p, x_saved)
+    def stage_bwd(stage_p, x_saved, ct, *key):
+        def f(sp, xs):
+            return block_fn(sp, xs, *key)
+        _, vjp_fn = jax.vjp(f, stage_p, x_saved)
         dp, dx = vjp_fn(ct)
         return dp, dx
 
@@ -162,7 +183,12 @@ def one_f_one_b(block_fn, stacked_params, microbatches, head_grad_fn,
         # ---- forward ----
         f_in = jnp.roll(fwd, 1, axis=0).at[0].set(x_t)
         stash = stash.at[t % D].set(f_in)
-        y = jax.vmap(block_fn)(stacked_params, f_in)
+        if rng_key is None:
+            y = jax.vmap(block_fn)(stacked_params, f_in)
+        else:
+            # stage s runs microbatch i = t - s forward on tick t
+            keys_f = jax.vmap(lambda s: _mb_key(rng_key, t - s, s))(sidx)
+            y = jax.vmap(block_fn)(stacked_params, f_in, keys_f)
         # ---- head: loss + cotangent for the mb leaving the last stage ----
         valid_h = jnp.logical_and(t >= S - 1, t <= S + M - 2)
         loss_t, dy_t, dh_t = head_grad_fn(head_params, y[S - 1], aux_t)
@@ -177,7 +203,15 @@ def one_f_one_b(block_fn, stacked_params, microbatches, head_grad_fn,
         b_in = jnp.roll(bwd, -1, axis=0).at[S - 1].set(
             dy_t.astype(dtype))
         read = stash[(t - 2 * (S - 1 - sidx)) % D, sidx]
-        dps, dxs = jax.vmap(stage_bwd)(stacked_params, read, b_in)
+        if rng_key is None:
+            dps, dxs = jax.vmap(stage_bwd)(stacked_params, read, b_in)
+        else:
+            # recompute with the SAME key the forward of that microbatch
+            # used: stage s backs up microbatch i = t - 2(S-1) + s here
+            keys_b = jax.vmap(
+                lambda s: _mb_key(rng_key, t - 2 * (S - 1) + s, s))(sidx)
+            dps, dxs = jax.vmap(stage_bwd)(stacked_params, read, b_in,
+                                           keys_b)
         gs = jax.tree.map(lambda a, d: a + d.astype(a.dtype), gs, dps)
         return (y, dxs, stash, gs, gh, loss_acc), dxs[0]
 
@@ -196,7 +230,8 @@ def one_f_one_b(block_fn, stacked_params, microbatches, head_grad_fn,
 
 
 def pipelined_apply(block_fn, stacked_params, x, *, num_stages: int,
-                    num_microbatches: int, remat: bool = False):
+                    num_microbatches: int, remat: bool = False,
+                    rng_key=None):
     """Batch-level wrapper: split [B, ...] into M microbatches, pipeline,
     re-merge. Identity to `for each block: x = block(x)` (modulo fp
     reassociation) — tested against the sequential reference."""
@@ -205,5 +240,5 @@ def pipelined_apply(block_fn, stacked_params, x, *, num_stages: int,
     assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
     mb = x.reshape((M, B // M) + tuple(x.shape[1:]))
     out = gpipe(block_fn, stacked_params, mb, num_stages=num_stages,
-                remat=remat)
+                remat=remat, rng_key=rng_key)
     return out.reshape((B,) + tuple(out.shape[2:]))

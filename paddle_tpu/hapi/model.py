@@ -135,7 +135,9 @@ class Model:
         out, _ = functional_call(net, params,
                                  *(inputs if isinstance(inputs, (list, tuple))
                                    else [inputs]), buffers=buffers)
-        return out
+        # reference `Model.predict_batch` returns a LIST of outputs
+        # (hapi/model.py:1094) — never a bare array
+        return list(out) if isinstance(out, (list, tuple)) else [out]
 
     def _update_metrics(self, out, label):
         res = {}
@@ -219,9 +221,12 @@ class Model:
             batch = list(batch) if isinstance(batch, (list, tuple)) else \
                 [batch]
             outputs.append(self.predict_batch(batch))
+        # reference predict: list with one entry PER MODEL OUTPUT, each a
+        # list of per-batch arrays (stacked when stack_outputs=True)
+        per_out = list(zip(*outputs))
         if stack_outputs:
-            return [jnp.concatenate(outputs, axis=0)]
-        return outputs
+            return [jnp.concatenate(o, axis=0) for o in per_out]
+        return [list(o) for o in per_out]
 
     def save(self, path, training=True):
         from ..framework.io import save as _save

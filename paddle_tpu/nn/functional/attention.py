@@ -40,14 +40,16 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
 
 def _pallas_ok(q, k) -> bool:
-    """Dispatch heuristic, measured on v5e: XLA's fused attention wins below
-    ~4K tokens; the Pallas flash kernel wins above (6.7x at 8K) and is the
-    only option from ~16K where dense scores exceed HBM. Cross-attention
-    (k_len != q_len) stays on the XLA path."""
+    """Dispatch heuristic, measured on v5e: the Pallas flash kernel wins
+    from ~1K tokens in training (fwd+bwd; no S×S score tensor to save or
+    re-read), 6.7x at 8K, and is the only option from ~16K where dense
+    scores exceed HBM. Floor tunable via FLAGS_pallas_attention_min_seq.
+    Cross-attention (k_len != q_len) stays on the XLA path."""
     if jax.default_backend() not in ("tpu",):
         return False
     b, s, h, d = q.shape
-    return (k.shape == q.shape and s % 128 == 0 and s >= 4096
+    return (k.shape == q.shape and s % 128 == 0
+            and s >= int(flag("pallas_attention_min_seq"))
             and d <= 256)
 
 

@@ -34,7 +34,7 @@ class Parameter:
     """
 
     __slots__ = ("value", "name", "stop_gradient", "_is_buffer",
-                 "optimize_attr", "sharding_spec")
+                 "optimize_attr", "sharding_spec", "regularizer")
 
     def __init__(self, value, name: str = "", stop_gradient: bool = False,
                  is_buffer: bool = False):
@@ -46,6 +46,8 @@ class Parameter:
         # PartitionSpec for hybrid-parallel training (set by mp/pp layers;
         # consumed by the distributed train-step to build NamedShardings).
         self.sharding_spec = None
+        # per-param weight-decay override (reference: ParamAttr.regularizer)
+        self.regularizer = None
 
     @property
     def trainable(self) -> bool:

@@ -64,6 +64,15 @@ class Optimizer:
         self._accumulators: Optional[Dict[str, Any]] = None
         self._step_count = 0
 
+    @property
+    def _param_regularizers(self):
+        """Per-param regularizer overrides, read at apply time so
+        assignments AFTER optimizer construction are honored (reference
+        `append_regularization_ops` reads param.regularizer at minimize
+        time). Note: a jit-compiled step only re-reads these on retrace."""
+        return {n: p.regularizer for n, p in self._params.items()
+                if getattr(p, "regularizer", None) is not None}
+
     # --- learning rate ---
 
     def get_lr(self) -> float:
@@ -110,8 +119,12 @@ class Optimizer:
         lr = self._lr_value(step)
         if self._grad_clip is not None:
             grads = self._grad_clip(grads)
-        # L2 regularization (coupled, reference: regularizer appended to grad)
+        # regularization (coupled, reference: regularizer appended to grad;
+        # per-param Parameter.regularizer overrides the optimizer-global
+        # weight_decay — `fluid/regularizer.py append_regularization_ops`)
+        from ..regularizer import WeightDecayRegularizer
         wd = self._weight_decay
+        per_param = getattr(self, "_param_regularizers", None) or {}
         new_params, new_slots = {}, {}
         for name, p in params.items():
             g = grads.get(name)
@@ -123,7 +136,15 @@ class Optimizer:
             master = slots.get("master")
             p_eff = master if master is not None else p
             g = g.astype(p_eff.dtype)
-            if isinstance(wd, float) and wd != 0.0 and self._couple_wd:
+            reg = per_param.get(name)
+            if reg is not None:
+                g = g + reg.grad(p_eff).astype(p_eff.dtype)
+            elif isinstance(wd, WeightDecayRegularizer):
+                # regularizers are coupled-into-grad by definition
+                # (append_regularization_ops) even for AdamW, whose
+                # decoupling applies only to its float coefficient
+                g = g + wd.grad(p_eff).astype(p_eff.dtype)
+            elif isinstance(wd, float) and wd != 0.0 and self._couple_wd:
                 g = g + wd * p_eff
             new_p, slots = self._update(p_eff, g, slots, lr, step, name)
             if master is not None:
@@ -299,7 +320,10 @@ class AdamW(Adam):
     def _update(self, p, g, slots, lr, step, name):
         wd = self._weight_decay if isinstance(self._weight_decay, float) \
             else 0.0
-        if wd and (self._decay_fn is None or self._decay_fn(name)):
+        # a per-param regularizer (already folded into g by apply())
+        # overrides the optimizer-global decay — don't double-penalize
+        if wd and name not in self._param_regularizers and \
+                (self._decay_fn is None or self._decay_fn(name)):
             p = p * (1.0 - lr * wd)
         return super()._update(p, g, slots, lr, step, name)
 

@@ -1,0 +1,61 @@
+"""Weight-decay regularizers.
+
+Reference: `python/paddle/fluid/regularizer.py` — `L1DecayRegularizer` /
+`L2DecayRegularizer` append a scaled penalty gradient to each parameter's
+gradient before the optimizer update (`regularizer.py append_regularization_ops`).
+Per-parameter regularizers (set via `ParamAttr.regularizer` /
+`Parameter.regularizer`) override the optimizer-global one, exactly like the
+reference's precedence rule.
+
+TPU-native: a regularizer is a pure function `grad(p) -> penalty_grad` folded
+into the compiled update step — no extra ops or program rewriting.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class WeightDecayRegularizer:
+    """Base class (reference: `regularizer.py WeightDecayRegularizer`)."""
+
+    coeff: float = 0.0
+
+    def grad(self, p):
+        """Penalty gradient to add to the parameter's gradient."""
+        raise NotImplementedError
+
+    def __call__(self, p):
+        return self.grad(p)
+
+
+class L2Decay(WeightDecayRegularizer):
+    """loss += coeff/2 * ||p||^2  →  grad += coeff * p
+    (reference: `regularizer.py L2DecayRegularizer`)."""
+
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+    def grad(self, p):
+        return self.coeff * p
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self.coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """loss += coeff * ||p||_1  →  grad += coeff * sign(p)
+    (reference: `regularizer.py L1DecayRegularizer`)."""
+
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+    def grad(self, p):
+        return self.coeff * jnp.sign(p)
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self.coeff})"
+
+
+# reference aliases (fluid names)
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay

@@ -182,3 +182,26 @@ class TestIncubateOptimizers:
             avg = np.asarray(lin.weight)
         assert not np.allclose(cur, avg)
         np.testing.assert_allclose(cur, np.asarray(lin.weight))
+
+
+def test_predict_returns_per_output_lists():
+    """predict_batch returns a LIST of outputs; predict returns one entry
+    per model output (reference hapi/model.py:1094 predict_batch,
+    :1523 predict)."""
+    import numpy as np
+    import jax.numpy as jnp
+    net = pt.nn.Linear(8, 3)
+    m = pt.Model(net)
+    m.prepare(None, pt.nn.CrossEntropyLoss())
+    X = np.random.RandomState(0).randn(10, 8).astype("float32")
+    out = m.predict_batch([X])
+    assert isinstance(out, list) and len(out) == 1
+    assert tuple(out[0].shape) == (10, 3)
+    ds = pt.io.TensorDataset([X])
+    res = m.predict(ds, batch_size=4)
+    assert isinstance(res, list) and len(res) == 1
+    assert len(res[0]) == 3  # 3 batches of 4,4,2
+    stacked = m.predict(ds, batch_size=4, stack_outputs=True)
+    assert tuple(stacked[0].shape) == (10, 3)
+    np.testing.assert_allclose(np.asarray(stacked[0]),
+                               np.asarray(out[0]), rtol=1e-6)

@@ -245,17 +245,58 @@ class TestOneFOneB:
         # 1F1B growth M=4→32 far below GPipe growth (O(S) vs O(M) stash)
         assert (f32 - f4) < 0.5 * (g32 - g4), (f4, f32, g4, g32)
 
-    def test_1f1b_rejects_dropout(self):
+    def _run_dropout(self, schedule, steps=3):
+        """Train with dropout=0.1 under the given schedule; per-(microbatch,
+        stage) dropout keys derive identically in both schedules
+        (stacked_pipeline._mb_key) so losses must match exactly."""
         from paddle_tpu.models import (GPTConfig, GPTForPretraining,
                                        build_train_step)
         pt.seed(0)
-        cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=2,
-                        num_heads=2, max_position_embeddings=32,
+        cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                        num_heads=4, max_position_embeddings=64,
                         dropout=0.1, dtype=jnp.float32)
         model = GPTForPretraining(cfg)
-        with pytest.raises(NotImplementedError):
-            build_train_step(model, pt.optimizer.SGD(), build_mesh(pp=2),
-                             pipeline_schedule="1f1b")
+        opt = pt.optimizer.AdamW(learning_rate=1e-3)
+        step, state = build_train_step(model, opt, build_mesh(pp=2),
+                                       num_microbatches=4,
+                                       pipeline_schedule=schedule)
+        rs = np.random.RandomState(0)
+        ids = jnp.asarray(rs.randint(0, 128, (8, 16)), jnp.int32)
+        labels = jnp.asarray(rs.randint(0, 128, (8, 16)), jnp.int32)
+        losses = []
+        for i in range(steps):
+            state, loss = step(state, (ids, labels), jax.random.key(i))
+            losses.append(float(loss))
+        return losses
+
+    def test_1f1b_trains_with_dropout_matching_gpipe(self):
+        """VERDICT r2 item 4: 1F1B must run real configs with dropout
+        (reference `section_worker.cc:144-156`)."""
+        l_g = self._run_dropout("gpipe")
+        l_f = self._run_dropout("1f1b")
+        np.testing.assert_allclose(l_f, l_g, rtol=1e-4)
+
+    def test_dropout_masks_differ_across_steps(self):
+        """Two different step keys must give different losses (the mask is
+        not baked into the compiled program as a constant)."""
+        from paddle_tpu.models import (GPTConfig, GPTForPretraining,
+                                       build_train_step)
+        pt.seed(0)
+        cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                        num_heads=4, max_position_embeddings=64,
+                        dropout=0.5, dtype=jnp.float32)
+        model = GPTForPretraining(cfg)
+        opt = pt.optimizer.SGD(learning_rate=0.0)  # frozen params
+        step, state = build_train_step(model, opt, build_mesh(pp=2),
+                                       num_microbatches=2,
+                                       pipeline_schedule="1f1b",
+                                       donate=False)
+        rs = np.random.RandomState(0)
+        ids = jnp.asarray(rs.randint(0, 128, (4, 16)), jnp.int32)
+        labels = jnp.asarray(rs.randint(0, 128, (4, 16)), jnp.int32)
+        _, l1 = step(state, (ids, labels), jax.random.key(1))
+        _, l2 = step(state, (ids, labels), jax.random.key(2))
+        assert float(l1) != float(l2)
 
 
 class TestTrainStep:

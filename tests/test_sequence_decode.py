@@ -131,7 +131,7 @@ class TestBeamSearch:
     def test_beam_finds_brute_force_optimum(self):
         V, T = 5, 3
         table, step_fn = _table_lm(V, T)
-        tbl = np.asarray(table)
+        tbl = np.array(table)
         bos, eos = 0, V - 1  # eos never optimal here by construction
         tbl[:, eos] = -100.0
         table2 = jnp.asarray(tbl)
