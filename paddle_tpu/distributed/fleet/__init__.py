@@ -15,3 +15,5 @@ from .recompute import recompute  # noqa: F401
 from ..random import get_rng_state_tracker  # noqa: F401
 from . import elastic  # noqa: F401
 from . import utils  # noqa: F401
+from .dataset import (DatasetBase, InMemoryDataset,  # noqa: F401
+                      QueueDataset, train_from_dataset)

@@ -50,6 +50,29 @@ class _FileKV:
             os.remove(p)
 
 
+class _TCPKV:
+    """Multi-node KV over the PS TCP table service (rank 0 hosts the
+    store) — the etcd3-equivalent for launcher worlds where etcd isn't
+    deployed. Reference analogue: gloo HTTP-KV rendezvous
+    (`parallel.py:48,150`); fixes the r2 single-node _FileKV limitation."""
+
+    def __init__(self):
+        from ..ps.table import init_table_service
+        self._svc = init_table_service()
+
+    def put(self, key: str, value: bytes, lease=None):
+        self._svc.kv_put(key, value)
+
+    def get_prefix(self, prefix: str):
+        out = []
+        for k, v in self._svc.kv_prefix(prefix).items():
+            out.append((v, type("M", (), {"key": k.encode()})()))
+        return out
+
+    def delete(self, key: str):
+        self._svc.kv_del(key)
+
+
 class ElasticStatus:
     COMPLETED = "completed"
     ERROR = "error"
@@ -70,20 +93,35 @@ class ElasticManager:
             os.environ.get("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "0"))
         flag = os.environ.get("PADDLE_ELASTIC_ENABLE", "").lower()
         self.enable = bool(server) or flag in ("1", "true", "yes", "on")
+        self._etcd = None
         if etcd_client is not None:
-            self.etcd = etcd_client
+            self._etcd = etcd_client
         elif server:
             try:
                 import etcd3
                 h, p = server.split(":")
-                self.etcd = etcd3.client(host=h, port=int(p))
+                self._etcd = etcd3.client(host=h, port=int(p))
             except ImportError:
-                self.etcd = _FileKV(f"/tmp/paddle_tpu_elastic/{self.job_id}")
-        else:
-            self.etcd = _FileKV(f"/tmp/paddle_tpu_elastic/{self.job_id}")
+                self._etcd = _FileKV(
+                    f"/tmp/paddle_tpu_elastic/{self.job_id}")
         self.prefix = f"/paddle/{self.job_id}"
         self.stopped = False
         self._watches: List[Callable] = []
+
+    @property
+    def etcd(self):
+        """KV store, created LAZILY on first use: a disabled manager must
+        not bind ports or spin service threads as a construction side
+        effect. Launcher worlds without etcd get the PS-TCP KV (reaches
+        every node via the endpoint list); otherwise the local file
+        store."""
+        if self._etcd is None:
+            if int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1:
+                self._etcd = _TCPKV()
+            else:
+                self._etcd = _FileKV(
+                    f"/tmp/paddle_tpu_elastic/{self.job_id}")
+        return self._etcd
 
     # --- membership -------------------------------------------------
     # node key includes the PID so several workers per host stay distinct;

@@ -23,33 +23,70 @@ def _authkey() -> bytes:
     return _AUTHKEY_BASE + os.environ.get("MASTER_PORT", "0").encode()
 
 
+def _shard_bounds(vocab: int, world: int, rank: int):
+    """Block partition (reference: `ps_dispatcher.py` HashName/RoundRobin →
+    block here so each shard's rows are one contiguous id range and the
+    seeded init can position a counter-based stream in O(1))."""
+    block = -(-vocab // world)          # ceil
+    lo = min(rank * block, vocab)
+    hi = min(lo + block, vocab)
+    return lo, hi, block
+
+
+def _rows_normal(seed: int, lo: int, rows: int, dim: int,
+                 std: float) -> np.ndarray:
+    """Normal(0, std) values for global rows [lo, lo+rows) of the table.
+
+    Counter-based (Philox) stream: row g's values always come from stream
+    positions [g*dim, (g+1)*dim) — identical for every world size — and
+    generating a shard touches ONLY its own positions (per-rank cost
+    O(vocab/world), killing the r2 O(full-table) bring-up). Normals come
+    from Box–Muller over two fixed-consumption uniform draws per value
+    (ziggurat consumes data-dependently and would break row alignment).
+    """
+    out = np.empty((rows, dim), np.float32)
+    CHUNK = 1 << 13   # bounds Box–Muller temps to ~CHUNK*dim*8B each
+    for start in range(0, rows, CHUNK):
+        n = min(CHUNK, rows - start)
+        bg = np.random.Philox(key=seed)
+        # numpy's Philox is 4x64: one counter block = 4 uint64 draws.
+        # Value v consumes u64s [2v, 2v+1]; jump to the block containing
+        # this chunk's first u64 and discard the in-block remainder.
+        off_u64 = 2 * (lo + start) * dim
+        bg.advance(off_u64 // 4)
+        skip = off_u64 % 4
+        raw = bg.random_raw(skip + 2 * n * dim)[skip:]
+        u = (raw >> np.uint64(11)).astype(np.float64) * (2.0 ** -53)
+        u1 = np.maximum(u[0::2], 1e-12)
+        u2 = u[1::2]
+        z = np.sqrt(-2.0 * np.log(u1)) * np.cos(2.0 * np.pi * u2)
+        out[start:start + n] = (std * z).astype(np.float32).reshape(n, dim)
+    return out
+
+
 class _Shard:
-    """This process's rows of one table (owner(id) = id % world,
-    local row = id // world — the reference's round-robin
-    `ps_dispatcher.py` placement)."""
+    """This process's rows of one table: the contiguous id block
+    [lo, hi) (reference placement: `ps_dispatcher.py`)."""
 
     def __init__(self, name: str, vocab: int, dim: int, rank: int,
                  world: int, lr: float, seed: int):
         self.name, self.vocab, self.dim = name, vocab, dim
         self.rank, self.world, self.lr = rank, world, lr
-        # deterministic per-row init independent of world size: generate
-        # the full table from one seed, keep owned rows (test-scale; a
-        # production shard would stream its rows)
-        full = np.random.RandomState(seed).normal(
-            0.0, 0.02, (vocab, dim)).astype(np.float32)
-        self.data = np.ascontiguousarray(full[rank::world])
+        self.lo, self.hi, self.block = _shard_bounds(vocab, world, rank)
+        self.data = _rows_normal(seed, self.lo, self.hi - self.lo, dim,
+                                 0.02)
         self._lock = threading.Lock()
 
     def pull(self, ids: np.ndarray) -> np.ndarray:
         with self._lock:
-            return self.data[ids // self.world]
+            return self.data[ids - self.lo]
 
     def push(self, ids: np.ndarray, grads: np.ndarray):
         """Server-side SGD (reference: optimizer runs in the table,
         `common_sparse_table.cc`); duplicate ids accumulate first."""
         with self._lock:
             # scatter-add duplicates, then one update per unique row
-            uniq, inv = np.unique(ids // self.world, return_inverse=True)
+            uniq, inv = np.unique(ids - self.lo, return_inverse=True)
             acc = np.zeros((len(uniq), self.dim), np.float32)
             np.add.at(acc, inv, grads)
             self.data[uniq] -= self.lr * acc
@@ -62,15 +99,33 @@ class TableService:
     def __init__(self, rank: int, world: int, port_base: int):
         self.rank, self.world = rank, world
         self._ports = [port_base + _PORT_OFFSET + r for r in range(world)]
+        # multi-host: peer hosts come from the launcher endpoint list
+        # (PADDLE_TRAINER_ENDPOINTS "host:port,..."); single host (or no
+        # launcher) stays loopback. The listener binds all interfaces so
+        # remote peers can reach it.
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        hosts = [e.split(":")[0] for e in eps.split(",") if e]
+        self._hosts = hosts if len(hosts) == world else \
+            ["127.0.0.1"] * world
+        self._bind_host = "" if len(set(self._hosts)) > 1 else "127.0.0.1"
         self._shards: Dict[str, _Shard] = {}
         self._conns: Dict[int, object] = {}
         self._conn_lock = threading.Lock()
+        self._rpc_locks: Dict[int, threading.Lock] = {}
         self._stop = False
         self._async_q: "queue.Queue" = queue.Queue()
         self._listener = None
         self._threads = []
+        # generic KV (rank 0 is the store) — backs elastic membership and
+        # cross-rank barriers (reference: gloo HTTP-KV / etcd rendezvous)
+        self._kv: Dict[str, bytes] = {}
+        self._kv_lock = threading.Lock()
+        # global-shuffle receive buffer (reference: DatasetImpl
+        # GlobalShuffle exchanges records over brpc, `data_set.h:101`)
+        self._shuffle_buf: list = []
+        self._shuffle_lock = threading.Lock()
         if world > 1:
-            self._listener = Listener(("127.0.0.1", self._ports[rank]),
+            self._listener = Listener((self._bind_host, self._ports[rank]),
                                       authkey=_authkey())
             t = threading.Thread(target=self._accept_loop, daemon=True)
             t.start()
@@ -99,14 +154,32 @@ class TableService:
                     op, table, payload = conn.recv()
                 except (EOFError, OSError):
                     return
-                shard = self._shards[table]
                 if op == "pull":
-                    conn.send(shard.pull(payload))
+                    conn.send(self._shards[table].pull(payload))
                 elif op == "push":
                     ids, grads = payload
-                    shard.push(ids, grads)
+                    self._shards[table].push(ids, grads)
                     conn.send(b"ok")
                 elif op == "barrier_probe":
+                    conn.send(b"ok")
+                elif op == "kv_put":
+                    with self._kv_lock:
+                        self._kv[table] = payload
+                    conn.send(b"ok")
+                elif op == "kv_get":
+                    with self._kv_lock:
+                        conn.send(self._kv.get(table))
+                elif op == "kv_prefix":
+                    with self._kv_lock:
+                        conn.send({k: v for k, v in self._kv.items()
+                                   if k.startswith(table)})
+                elif op == "kv_del":
+                    with self._kv_lock:
+                        self._kv.pop(table, None)
+                    conn.send(b"ok")
+                elif op == "shuffle_recv":
+                    with self._shuffle_lock:
+                        self._shuffle_buf.extend(payload)
                     conn.send(b"ok")
         finally:
             try:
@@ -128,7 +201,7 @@ class TableService:
                 delay = 0.05
                 while True:
                     try:
-                        c = Client(("127.0.0.1", self._ports[peer]),
+                        c = Client((self._hosts[peer], self._ports[peer]),
                                    authkey=_authkey())
                         break
                     except (ConnectionRefusedError, OSError):
@@ -137,12 +210,17 @@ class TableService:
                         time.sleep(delay)
                         delay = min(delay * 2, 1.0)
                 self._conns[peer] = c
+                self._rpc_locks[peer] = threading.Lock()
             return c
 
     def _rpc(self, peer: int, op: str, table: str, payload):
         c = self._conn(peer)
-        c.send((op, table, payload))
-        return c.recv()
+        # one in-flight request per connection: the communicator thread's
+        # async pushes must not interleave send/recv with the caller's
+        # kv/barrier/pull RPCs (crossed replies otherwise)
+        with self._rpc_locks[peer]:
+            c.send((op, table, payload))
+            return c.recv()
 
     def register(self, name: str, vocab: int, dim: int, lr: float = 0.1,
                  seed: int = 0) -> "ShardedEmbeddingTable":
@@ -150,14 +228,19 @@ class TableService:
                                     self.world, lr, seed)
         return ShardedEmbeddingTable(self, name, vocab, dim)
 
+    def _owner(self, table: str, flat: np.ndarray) -> np.ndarray:
+        block = self._shards[table].block
+        return np.minimum(flat // block, self.world - 1)
+
     def pull(self, table: str, ids: np.ndarray) -> np.ndarray:
         """Gather rows for arbitrary global ids (reference:
         `brpc_ps_client` PullSparse)."""
         flat = np.asarray(ids).reshape(-1)
         dim = self._shards[table].dim
+        owner = self._owner(table, flat)
         out = np.empty((flat.size, dim), np.float32)
         for peer in range(self.world):
-            m = (flat % self.world) == peer
+            m = owner == peer
             if not m.any():
                 continue
             sub = flat[m]
@@ -179,8 +262,9 @@ class TableService:
         self._push_now(table, flat, g)
 
     def _push_now(self, table, flat, g):
+        owner = self._owner(table, flat)
         for peer in range(self.world):
-            m = (flat % self.world) == peer
+            m = owner == peer
             if not m.any():
                 continue
             if peer == self.rank:
@@ -189,16 +273,128 @@ class TableService:
                 self._rpc(peer, "push", table, (flat[m], g[m]))
 
     def _async_push_loop(self):
+        """Communicator thread: drains queued pushes and COALESCES
+        same-table grads into one RPC per peer per drain (reference:
+        async `Communicator` batching by send_queue,
+        `service/communicator.cc` — merge then send)."""
         while True:
             item = self._async_q.get()
             if item is None:
                 return
-            self._push_now(*item)
-            self._async_q.task_done()
+            batch = [item]
+            try:
+                while True:
+                    nxt = self._async_q.get_nowait()
+                    if nxt is None:
+                        self._drain(batch)
+                        for _ in batch:
+                            self._async_q.task_done()
+                        return
+                    batch.append(nxt)
+            except queue.Empty:
+                pass
+            self._drain(batch)
+            for _ in batch:
+                self._async_q.task_done()
+
+    def _drain(self, batch):
+        by_table: Dict[str, list] = {}
+        for table, flat, g in batch:
+            by_table.setdefault(table, []).append((flat, g))
+        for table, items in by_table.items():
+            flat = np.concatenate([f for f, _ in items])
+            g = np.concatenate([x for _, x in items])
+            self._push_now(table, flat, g)
 
     def flush(self):
         """Drain queued async pushes (reference: Communicator barrier)."""
         self._async_q.join()
+
+    # ---- KV store (rank 0 hosts; reference: gloo HTTP-KV / etcd) --------
+
+    def kv_put(self, key: str, value: bytes):
+        if self.rank == 0:
+            with self._kv_lock:
+                self._kv[key] = value
+        else:
+            self._rpc(0, "kv_put", key, value)
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        if self.rank == 0:
+            with self._kv_lock:
+                return self._kv.get(key)
+        return self._rpc(0, "kv_get", key, None)
+
+    def kv_prefix(self, prefix: str) -> Dict[str, bytes]:
+        if self.rank == 0:
+            with self._kv_lock:
+                return {k: v for k, v in self._kv.items()
+                        if k.startswith(prefix)}
+        return self._rpc(0, "kv_prefix", prefix, None)
+
+    def kv_del(self, key: str):
+        if self.rank == 0:
+            with self._kv_lock:
+                self._kv.pop(key, None)
+        else:
+            self._rpc(0, "kv_del", key, None)
+
+    def barrier(self, name: str, timeout_s: float = 120.0):
+        """KV-backed barrier (reference: `barrier_table.cc`). Each use of
+        a name gets a fresh sequence number (all ranks must call barriers
+        in the same order) so repeated barriers don't see stale keys."""
+        import time
+        if not hasattr(self, "_barrier_seq"):
+            self._barrier_seq = {}
+        seq = self._barrier_seq.get(name, 0)
+        self._barrier_seq[name] = seq + 1
+        full = f"__barrier__/{name}#{seq}/"
+        self.kv_put(f"{full}{self.rank}", b"1")
+        deadline = time.time() + timeout_s
+        while True:
+            n = len(self.kv_prefix(full))
+            if n >= self.world:
+                return
+            if time.time() > deadline:
+                raise TimeoutError(f"barrier {name!r}: {n}/{self.world}")
+            time.sleep(0.01)
+
+    # ---- global shuffle exchange (reference: DatasetImpl::GlobalShuffle,
+    # `data_set.h:101` — records repartition over the PS RPC channel) ----
+
+    def exchange_records(self, per_target: Dict[int, list],
+                         tag: str) -> list:
+        """Send each target rank its records; barrier; return everything
+        this rank received (plus its own share)."""
+        with self._shuffle_lock:
+            self._shuffle_buf.extend(per_target.get(self.rank, []))
+        for peer, recs in per_target.items():
+            if peer != self.rank and recs:
+                self._rpc(peer, "shuffle_recv", "", recs)
+        self.barrier(f"shuffle/{tag}")
+        with self._shuffle_lock:
+            out, self._shuffle_buf = self._shuffle_buf, []
+        # exit barrier: a fast peer must not start the NEXT exchange and
+        # deposit records before this rank's pop above
+        self.barrier(f"shuffle-exit/{tag}")
+        return out
+
+    def finalize(self, timeout_s: float = 60.0):
+        """Coordinated shutdown: non-zero ranks announce 'bye' (their
+        LAST rpc) before closing; rank 0 waits for every bye so no
+        peer's final poll hits a closed listener."""
+        import time
+        self.flush()
+        if self.world > 1:
+            if self.rank != 0:
+                self.kv_put(f"__bye__/{self.rank}", b"1")
+            else:
+                deadline = time.time() + timeout_s
+                while len(self.kv_prefix("__bye__/")) < self.world - 1:
+                    if time.time() > deadline:
+                        break
+                    time.sleep(0.01)
+        self.shutdown()
 
     def shutdown(self):
         self._stop = True
@@ -256,5 +452,5 @@ def init_table_service() -> TableService:
 def shutdown_table_service():
     global _SERVICE
     if _SERVICE is not None:
-        _SERVICE.shutdown()
+        _SERVICE.finalize()
         _SERVICE = None

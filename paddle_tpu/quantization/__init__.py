@@ -1,0 +1,161 @@
+"""Quantization workflows: QAT (quantize → train → export) and PTQ
+(calibrate → convert).
+
+Reference mapping:
+  * imperative QAT pass `ImperativeQuantAware`
+    (`fluid/contrib/slim/quantization/imperative/qat.py`) — swaps
+    Linear/Conv2D for fake-quant wrappers, trains, then
+    `save_quantized_model`;
+  * static QAT/PTQ program passes
+    (`fluid/contrib/slim/quantization/quantization_pass.py`,
+    `post_training_quantization.py`) — abs-max calibration over a data
+    reader, scales frozen into quantize/dequantize ops.
+
+TPU-native: the fake-quant straight-through ops (nn/quant/quant_layers.py)
+are ordinary traced jax ops, so the QAT model trains under the SAME
+compiled step as the float model and `jit.save` exports StableHLO in
+which every quantized matmul/conv is bracketed by quantize/dequantize
+arithmetic with baked scales — the int8-annotated artifact an inference
+runtime consumes. Scales ship alongside in `<path>.quant.json`.
+"""
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, Iterable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer import Layer
+from ..nn.layer_common import Linear
+from ..nn.layer_conv_norm import Conv2D
+from ..nn.quant import QuantizedConv2D, QuantizedLinear
+
+_DEFAULT_TYPES = (Linear, Conv2D)
+
+
+def _swap_layers(model: Layer, weight_bits: int, activation_bits: int,
+                 moving_rate: float, types) -> int:
+    """In-place depth-first replacement of quantizable sublayers
+    (reference: `ImperativeQuantAware.quantize` walking `named_sublayers`
+    and calling `_get_quantized_layer`)."""
+    n = 0
+    for name, child in list(model._sub_layers.items()):
+        if isinstance(child, (QuantizedLinear, QuantizedConv2D)):
+            continue
+        if isinstance(child, Linear) and Linear in types:
+            setattr(model, name, QuantizedLinear(
+                child, weight_bits, activation_bits, moving_rate))
+            n += 1
+        elif isinstance(child, Conv2D) and Conv2D in types:
+            setattr(model, name, QuantizedConv2D(
+                child, weight_bits, activation_bits, moving_rate))
+            n += 1
+        else:
+            n += _swap_layers(child, weight_bits, activation_bits,
+                              moving_rate, types)
+    return n
+
+
+def _quant_scales(model: Layer) -> Dict[str, float]:
+    """Collect frozen activation scales + current weight abs-max per
+    quantized layer (the `out_threshold`/scale attrs the reference writes
+    into the exported program)."""
+    scales: Dict[str, float] = {}
+    for name, sub in model.named_sublayers():
+        if isinstance(sub, (QuantizedLinear, QuantizedConv2D)):
+            scales[f"{name}.activation_scale"] = float(
+                np.asarray(sub.act_quant.scale.value))
+            scales[f"{name}.weight_scale"] = float(
+                np.max(np.abs(np.asarray(sub.inner.weight.value))))
+    return scales
+
+
+class QAT:
+    """Quantization-aware training driver (reference:
+    `ImperativeQuantAware`, qat.py)."""
+
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 moving_rate: float = 0.9, quantizable_layer_type=None):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.moving_rate = moving_rate
+        self.types = tuple(quantizable_layer_type or _DEFAULT_TYPES)
+
+    def quantize(self, model: Layer) -> Layer:
+        """Swap quantizable sublayers for fake-quant wrappers IN PLACE
+        (then train the returned model as usual)."""
+        n = _swap_layers(model, self.weight_bits, self.activation_bits,
+                         self.moving_rate, self.types)
+        if n == 0:
+            import warnings
+            warnings.warn("QAT.quantize: no quantizable layers found",
+                          stacklevel=2)
+        return model
+
+    def save_quantized_model(self, model: Layer, path: str,
+                             input_spec=None, **config):
+        """Export int8-annotated StableHLO via jit.save + a sidecar
+        `<path>.quant.json` with the frozen scales (reference:
+        `save_quantized_model` emitting the inference program with
+        quant/dequant ops and thresholds)."""
+        from ..jit import save as jit_save
+        model.eval()
+        jit_save(model, path, input_spec=input_spec, **config)
+        meta = {"weight_bits": self.weight_bits,
+                "activation_bits": self.activation_bits,
+                "scales": _quant_scales(model)}
+        with open(path + ".quant.json", "w") as f:
+            json.dump(meta, f, indent=1)
+        return meta
+
+
+class PostTrainingQuantization:
+    """PTQ: calibrate activation abs-max over a loader, then freeze
+    (reference: `post_training_quantization.py` — sample via abs_max,
+    then save with scales)."""
+
+    def __init__(self, model: Layer, weight_bits: int = 8,
+                 activation_bits: int = 8,
+                 quantizable_layer_type=None):
+        self.qat = QAT(weight_bits, activation_bits, moving_rate=0.0,
+                       quantizable_layer_type=quantizable_layer_type)
+        self.model = self.qat.quantize(model)
+
+    def quantize(self, data_loader: Iterable, batch_nums: Optional[int] = None,
+                 forward_fn: Optional[Callable] = None):
+        """Run calibration batches through the model in train()-mode
+        observers (moving_rate=0 → pure abs-max per batch, max-reduced
+        here), then switch to eval."""
+        observed: Dict[int, float] = {}
+        self.model.train()
+        for i, batch in enumerate(data_loader):
+            if batch_nums is not None and i >= batch_nums:
+                break
+            if forward_fn is not None:
+                forward_fn(self.model, batch)
+            elif isinstance(batch, (tuple, list)):
+                self.model(*[jnp.asarray(b) for b in batch])
+            else:
+                self.model(jnp.asarray(batch))
+            for name, sub in self.model.named_sublayers():
+                if isinstance(sub, (QuantizedLinear, QuantizedConv2D)):
+                    cur = float(np.asarray(sub.act_quant.scale.value))
+                    key = id(sub)
+                    observed[key] = max(observed.get(key, 0.0), cur)
+        # freeze: abs-max over all calibration batches
+        for name, sub in self.model.named_sublayers():
+            if isinstance(sub, (QuantizedLinear, QuantizedConv2D)):
+                sub.act_quant.scale.value = jnp.asarray(
+                    observed.get(id(sub), 1.0), jnp.float32)
+        self.model.eval()
+        return self.model
+
+    def save_quantized_model(self, path: str, input_spec=None, **config):
+        return self.qat.save_quantized_model(self.model, path,
+                                             input_spec=input_spec,
+                                             **config)
+
+
+# reference namespace aliases
+ImperativeQuantAware = QAT

@@ -51,3 +51,22 @@ class Executor:
         raise NotImplementedError(
             "Executor.run(Program) has no TPU equivalent: compile a step "
             "function with paddle_tpu.jit.to_static / jax.jit instead.")
+
+    def train_from_dataset(self, program=None, dataset=None, epochs=1,
+                           collate_fn=None, print_period=100, debug=False,
+                           **kw):
+        """Reference: `Executor.train_from_dataset` →
+        `Executor::RunFromDataset` + Trainer/DeviceWorker
+        (`executor.cc:152`, `trainer.h:57`). TPU-native contract:
+        `program` is a callable step (the compiled train step IS the
+        device worker); `dataset` a fleet InMemoryDataset/QueueDataset."""
+        from ..distributed.fleet.dataset import train_from_dataset as _tfd
+        if not callable(program):
+            raise TypeError(
+                "train_from_dataset needs a callable step_fn as `program` "
+                "(jitted train step) — ProgramDesc graphs do not exist "
+                "on the TPU backend")
+        return _tfd(program, dataset, epochs=epochs, collate_fn=collate_fn,
+                    print_period=print_period, debug=debug)
+
+    infer_from_dataset = train_from_dataset

@@ -1,0 +1,90 @@
+"""Cached dataset/model download (reference:
+`python/paddle/utils/download.py` — `get_path_from_url`, DATA_HOME cache,
+md5 validation, retries).
+
+Zero-egress environments: callers (vision/text datasets) catch the
+download failure and fall back to their synthetic generators, so tests
+never need the network; when the network exists the real files land in
+the same cache layout the reference uses.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import tarfile
+import time
+import zipfile
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PTPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+WEIGHTS_HOME = os.path.expanduser(
+    os.environ.get("PTPU_WEIGHTS_HOME", "~/.cache/paddle_tpu/hapi"))
+
+DOWNLOAD_RETRY_LIMIT = 3
+
+
+def _md5check(path: str, md5sum: str | None) -> bool:
+    if not md5sum:
+        return True
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest() == md5sum
+
+
+def _download(url: str, root_dir: str, md5sum: str | None = None,
+              timeout: float = 30.0) -> str:
+    os.makedirs(root_dir, exist_ok=True)
+    fname = os.path.join(root_dir, url.split("/")[-1].split("?")[0])
+    if os.path.exists(fname) and _md5check(fname, md5sum):
+        return fname
+    import urllib.request
+    last = None
+    for attempt in range(DOWNLOAD_RETRY_LIMIT):
+        try:
+            tmp = fname + ".tmp"
+            with urllib.request.urlopen(url, timeout=timeout) as r, \
+                    open(tmp, "wb") as f:
+                shutil.copyfileobj(r, f)
+            if not _md5check(tmp, md5sum):
+                os.remove(tmp)
+                raise IOError(f"md5 mismatch for {url}")
+            os.replace(tmp, fname)
+            return fname
+        except Exception as e:  # noqa: BLE001 — retry then surface
+            last = e
+            time.sleep(min(2 ** attempt, 5))
+    raise RuntimeError(f"download failed after {DOWNLOAD_RETRY_LIMIT} "
+                       f"tries: {url} ({last})")
+
+
+def _decompress(fname: str) -> str:
+    if tarfile.is_tarfile(fname):
+        dst = os.path.dirname(fname)
+        with tarfile.open(fname) as tf:
+            tf.extractall(dst, filter="data")
+        return dst
+    if zipfile.is_zipfile(fname):
+        dst = os.path.dirname(fname)
+        with zipfile.ZipFile(fname) as zf:
+            zf.extractall(dst)
+        return dst
+    return fname
+
+
+def get_path_from_url(url: str, root_dir: str = DATA_HOME,
+                      md5sum: str | None = None,
+                      check_exist: bool = True,
+                      decompress: bool = False) -> str:
+    """Download `url` into the cache (once) and return the local path
+    (reference: `download.py get_path_from_url`)."""
+    path = _download(url, root_dir, md5sum)
+    if decompress:
+        _decompress(path)
+    return path
+
+
+def get_weights_path_from_url(url: str, md5sum: str | None = None) -> str:
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

@@ -39,11 +39,34 @@ class _SyntheticImageDataset(Dataset):
 
 class MNIST(_SyntheticImageDataset):
     """Reference: vision/datasets/mnist.py. Reads idx-format files when
-    given; synthesizes 28x28 grayscale otherwise."""
+    given, downloads into the cache when the network allows
+    (`utils/download.py` get_path_from_url, same layout as the
+    reference's DATA_HOME), and synthesizes 28x28 grayscale otherwise."""
+
+    URL_BASE = "https://dataset.bj.bcebos.com/mnist/"
+    FILES = {"train": ("train-images-idx3-ubyte.gz",
+                       "train-labels-idx1-ubyte.gz"),
+             "test": ("t10k-images-idx3-ubyte.gz",
+                      "t10k-labels-idx1-ubyte.gz")}
 
     def __init__(self, image_path=None, label_path=None, mode="train",
                  transform=None, download=True, backend=None):
-        if image_path and os.path.exists(image_path):
+        if image_path is None and label_path is None and download \
+                and self.URL_BASE:
+            try:
+                from ..utils.download import get_path_from_url
+                img_f, lab_f = self.FILES["train" if mode == "train"
+                                          else "test"]
+                # assign only when BOTH fetches succeed — a partial
+                # download must fall back to synthetic, not crash on a
+                # None label_path
+                ip = get_path_from_url(self.URL_BASE + img_f)
+                lp = get_path_from_url(self.URL_BASE + lab_f)
+                image_path, label_path = ip, lp
+            except Exception:  # zero-egress: fall through to synthetic
+                pass
+        if image_path and label_path and os.path.exists(image_path) \
+                and os.path.exists(label_path):
             with gzip.open(image_path, "rb") as f:
                 magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
                 images = np.frombuffer(f.read(), np.uint8).reshape(
@@ -60,7 +83,10 @@ class MNIST(_SyntheticImageDataset):
 
 
 class FashionMNIST(MNIST):
-    pass
+    """Reference: vision/datasets/mnist.py FashionMNIST — same idx
+    format, its own archive URLs (inheriting MNIST's would silently
+    train on digit data)."""
+    URL_BASE = "https://dataset.bj.bcebos.com/fashion_mnist/"
 
 
 class Cifar10(_SyntheticImageDataset):
@@ -68,8 +94,16 @@ class Cifar10(_SyntheticImageDataset):
 
     NUM_CLASSES = 10
 
+    URL = ("https://dataset.bj.bcebos.com/cifar/cifar-10-python.tar.gz")
+
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=True, backend=None):
+        if data_file is None and download:
+            try:
+                from ..utils.download import get_path_from_url
+                data_file = get_path_from_url(self.URL)
+            except Exception:  # zero-egress: fall through to synthetic
+                pass
         if data_file and os.path.exists(data_file):
             import tarfile
             with tarfile.open(data_file) as tf:
@@ -101,6 +135,7 @@ class Cifar10(_SyntheticImageDataset):
 
 class Cifar100(Cifar10):
     NUM_CLASSES = 100
+    URL = ("https://dataset.bj.bcebos.com/cifar/cifar-100-python.tar.gz")
 
     @staticmethod
     def _member_match(name, mode):

@@ -1,0 +1,36 @@
+"""Per-rank runner for the fleet InMemoryDataset GlobalShuffle test.
+
+Each rank loads a disjoint contiguous id range, global-shuffles, and
+writes its resulting record ids to <out>.<rank>.json. The parent test
+asserts the union is preserved, partitions stay disjoint, and records
+actually moved across ranks (reference bar: DatasetImpl::GlobalShuffle,
+`data_set.h:101`).
+"""
+import json
+import os
+import sys
+
+from paddle_tpu.distributed.fleet.dataset import InMemoryDataset
+from paddle_tpu.distributed.ps import (init_table_service,
+                                       shutdown_table_service)
+
+N_PER_RANK = 500
+
+
+def main():
+    out_path = sys.argv[1]
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    ds = InMemoryDataset()
+    ds.init(batch_size=32)
+    base = rank * N_PER_RANK
+    ds.set_sample_list(list(range(base, base + N_PER_RANK)))
+    ds.global_shuffle()
+    size = ds.get_memory_data_size(fleet=True)
+    with open(f"{out_path}.{rank}.json", "w") as f:
+        json.dump({"records": sorted(ds._records), "global_size": size,
+                   "local_order_head": ds._records[:20]}, f)
+    shutdown_table_service()   # finalize(): coordinated listener close
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,201 @@
+"""Fleet dataset stack (VERDICT r2 item 6) + PS shard-init upgrades
+(item 7).
+
+Reference bars: `DatasetImpl::LoadIntoMemory`/`GlobalShuffle`
+(`framework/data_set.h:101`), `Executor::RunFromDataset`
+(`trainer.h:57`), per-row table init (`common_sparse_table.cc`).
+"""
+import json
+import os
+import subprocess
+import sys
+import tracemalloc
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RUNNER = os.path.join(REPO, "tests", "dist_runner_shuffle.py")
+
+
+class TestInMemoryDataset:
+    def test_load_into_memory_and_batch_iter(self, tmp_path):
+        from paddle_tpu.distributed.fleet import InMemoryDataset
+        p = tmp_path / "part-0"
+        p.write_text("\n".join(f"{i} {i * 2}" for i in range(10)))
+        ds = InMemoryDataset()
+        ds.init(batch_size=4)
+        ds.set_filelist([str(p)])
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 10
+        batches = list(ds.batch_iter())
+        assert [len(b) for b in batches] == [4, 4, 2]
+        np.testing.assert_allclose(batches[0][1], [1.0, 2.0])
+
+    def test_slot_parse_format(self, tmp_path):
+        from paddle_tpu.distributed.fleet import InMemoryDataset
+        p = tmp_path / "slots"
+        p.write_text("click:1 emb_id:3,5,7\n")
+        ds = InMemoryDataset()
+        ds.set_filelist([str(p)])
+        ds.load_into_memory()
+        rec = ds._records[0]
+        np.testing.assert_allclose(rec["click"], [1.0])
+        np.testing.assert_allclose(rec["emb_id"], [3, 5, 7])
+
+    def test_local_shuffle_preserves_multiset(self):
+        from paddle_tpu.distributed.fleet import InMemoryDataset
+        ds = InMemoryDataset()
+        ds.set_sample_list(list(range(100)))
+        ds.local_shuffle(seed=0)
+        assert sorted(ds._records) == list(range(100))
+        assert ds._records != list(range(100))
+
+    def test_global_shuffle_single_process_degrades_to_local(self):
+        from paddle_tpu.distributed.fleet import InMemoryDataset
+        ds = InMemoryDataset()
+        ds.set_sample_list(list(range(50)))
+        ds.global_shuffle()
+        assert sorted(ds._records) == list(range(50))
+
+    def test_queue_dataset_streams(self, tmp_path):
+        from paddle_tpu.distributed.fleet import QueueDataset
+        for i in range(2):
+            (tmp_path / f"f{i}").write_text("\n".join("1 2" for _ in range(3)))
+        ds = QueueDataset()
+        ds.init(batch_size=2)
+        ds.set_filelist([str(tmp_path / "f0"), str(tmp_path / "f1")])
+        assert [len(b) for b in ds.batch_iter()] == [2, 2, 2]
+
+
+class TestTrainFromDataset:
+    def test_epoch_driver_trains(self):
+        """train_from_dataset drives a real compiled step over dataset
+        batches (the RunFromDataset bar) and the loss goes down."""
+        import jax.numpy as jnp
+        import paddle_tpu as pt
+        from paddle_tpu.distributed.fleet import (InMemoryDataset,
+                                                  train_from_dataset)
+        pt.seed(0)
+        lin = pt.nn.Linear(4, 1)
+        # Layer-bound: grad keys line up with trainable_state names
+        opt = pt.optimizer.SGD(learning_rate=0.1, parameters=lin)
+        rs = np.random.RandomState(0)
+        X = rs.randn(64, 4).astype(np.float32)
+        w_true = np.asarray([1.0, -2.0, 0.5, 3.0], np.float32)
+        Y = X @ w_true
+
+        ds = InMemoryDataset()
+        ds.init(batch_size=16)
+        ds.set_sample_list([(X[i], Y[i]) for i in range(64)])
+
+        from paddle_tpu.nn.layer import functional_call, trainable_state
+        import jax
+
+        def loss_fn(params, xb, yb):
+            out, _ = functional_call(lin, params, xb)
+            return jnp.mean((out[:, 0] - yb) ** 2)
+
+        vg = jax.jit(jax.value_and_grad(loss_fn))
+
+        def step(batch):
+            xb = jnp.asarray(np.stack([b[0] for b in batch]))
+            yb = jnp.asarray(np.stack([b[1] for b in batch]))
+            params = trainable_state(lin)
+            loss, grads = vg(params, xb, yb)
+            opt.step(grads)
+            return loss
+
+        losses = train_from_dataset(step, ds, epochs=5)
+        assert losses[-1] < losses[0] * 0.5, losses
+
+    def test_static_executor_entry(self):
+        """static.Executor.train_from_dataset drives the same loop."""
+        import paddle_tpu as pt
+        from paddle_tpu.distributed.fleet import InMemoryDataset
+        ds = InMemoryDataset()
+        ds.init(batch_size=8)
+        ds.set_sample_list(list(range(32)))
+        exe = pt.static.Executor()
+        seen = []
+        out = exe.train_from_dataset(
+            program=lambda b: seen.append(len(b)) or 0.0, dataset=ds)
+        assert sum(seen) == 32
+        with pytest.raises(TypeError):
+            exe.train_from_dataset(program=None, dataset=ds)
+
+
+class TestShardSeededInit:
+    def test_rows_identical_across_world_sizes(self):
+        from paddle_tpu.distributed.ps.table import (_rows_normal,
+                                                     _shard_bounds)
+        vocab, dim = 1000, 8
+        full = _rows_normal(seed=5, lo=0, rows=vocab, dim=dim, std=0.02)
+        for world in (2, 3, 4):
+            for rank in range(world):
+                lo, hi, _ = _shard_bounds(vocab, world, rank)
+                part = _rows_normal(seed=5, lo=lo, rows=hi - lo, dim=dim,
+                                    std=0.02)
+                np.testing.assert_array_equal(part, full[lo:hi])
+
+    def test_distribution_sane(self):
+        from paddle_tpu.distributed.ps.table import _rows_normal
+        v = _rows_normal(seed=1, lo=0, rows=4000, dim=16, std=0.02)
+        assert abs(float(v.mean())) < 1e-3
+        assert abs(float(v.std()) - 0.02) < 2e-3
+
+    def test_million_row_table_memory_is_o_vocab_over_world(self):
+        """VERDICT r2 weak 5: a 1M-row table bring-up must not
+        materialize the full table per rank."""
+        from paddle_tpu.distributed.ps.table import _Shard
+        vocab, dim, world = 1_000_000, 16, 4
+        shard_bytes = (vocab // world) * dim * 4
+        tracemalloc.start()
+        sh = _Shard("e", vocab, dim, rank=1, world=world, lr=0.1, seed=0)
+        _, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        assert sh.data.nbytes == shard_bytes
+        # peak alloc stays well under the 64MB full table (shard=16MB;
+        # chunked Box-Muller temps add ~3x chunk size)
+        assert peak < 2.5 * shard_bytes, peak
+
+    def test_pull_push_block_partition(self):
+        from paddle_tpu.distributed.ps import table as T
+        svc = T.TableService(0, 1, port_base=9400)
+        t = svc.register("e", vocab=10, dim=4, lr=1.0, seed=2)
+        rows = t.pull(np.arange(10))
+        assert rows.shape == (10, 4)
+        g = np.ones((1, 4), np.float32)
+        before = rows[7].copy()
+        t.push(np.asarray([7]), g)
+        np.testing.assert_allclose(t.pull(np.asarray([7]))[0],
+                                   before - 1.0, rtol=1e-6)
+        svc.shutdown()
+
+
+class TestGlobalShuffle2Proc:
+    def test_global_shuffle_disjoint_and_complete(self, tmp_path):
+        out = str(tmp_path / "shuf")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.pop("XLA_FLAGS", None)
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--nproc_per_node", "2", "--simulate_cpu_devices", "1",
+               RUNNER, out]
+        r = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                           text=True, timeout=300)
+        assert r.returncode == 0, \
+            f"rc={r.returncode}\nstdout:{r.stdout[-2000:]}\n" \
+            f"stderr:{r.stderr[-2000:]}"
+        parts = []
+        for rank in range(2):
+            with open(f"{out}.{rank}.json") as f:
+                parts.append(json.load(f))
+        a, b = set(parts[0]["records"]), set(parts[1]["records"])
+        assert a.isdisjoint(b)
+        assert a | b == set(range(1000))
+        # records moved across ranks: each side holds some of the other's
+        # original block
+        assert any(r >= 500 for r in a) and any(r < 500 for r in b)
+        # global size visible from both ranks
+        assert parts[0]["global_size"] == parts[1]["global_size"] == 1000
