@@ -282,6 +282,55 @@ def bench_resnet() -> dict:
             "vs_baseline": round(mfu / 0.35, 4)}
 
 
+def bench_yolo() -> dict:
+    """BASELINE config 4: PP-YOLO-class (YOLOv3-DarkNet53) training
+    throughput, imgs/sec."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.vision.models import yolov3_darknet53, yolo_loss
+    from paddle_tpu.nn.layer import (buffer_state, functional_call,
+                                     trainable_state)
+
+    batch, size, steps, warmup = 8, 320, 8, 2
+    model = yolov3_darknet53(num_classes=80)
+    model.train()
+    opt = pt.optimizer.Momentum(learning_rate=0.01, momentum=0.9)
+    params = trainable_state(model)
+    buffers = buffer_state(model)
+    opt_state = opt.init_state(params)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(batch, 3, size, size), jnp.float32)
+    gt_box = jnp.asarray(rs.uniform(0.2, 0.8, (batch, 16, 4)), jnp.float32)
+    gt_cls = jnp.asarray(rs.randint(0, 80, (batch, 16)), jnp.int32)
+
+    def loss_fn(params, buffers, x):
+        with pt.amp.auto_cast(level="O1"):
+            outs, new_buf = functional_call(model, params, x,
+                                            buffers=buffers)
+        return yolo_loss(outs, gt_box, gt_cls, num_classes=80), new_buf
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state, x):
+        params, buffers, opt_state = state
+        (loss, new_buf), g = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, buffers, x)
+        new_p, new_s = opt.apply(params, g, opt_state)
+        return (new_p, new_buf, new_s), loss
+
+    _, dt = _timed_steps(lambda s: step(s, x),
+                         (params, buffers, opt_state), steps, warmup)
+    n_dev = len(jax.devices())
+    imgs = batch * steps / dt / n_dev
+    # YOLOv3-DarkNet53 fwd ~39 GFLOPs/img at 320^2; x3 for fwd+bwd
+    mfu = imgs * 3 * 39e9 / peak_flops(jax.devices()[0].device_kind)
+    return {"metric": "yolov3_darknet53_train_imgs_per_sec_per_chip",
+            "value": round(imgs, 1), "unit": "imgs/s/chip",
+            "vs_baseline": round(mfu / 0.35, 4)}
+
+
 def main():
     out = None
     forced = os.environ.get("PTPU_BENCH_FORCED_CPU") == "1"
@@ -298,7 +347,7 @@ def main():
             if on_tpu and os.environ.get("PTPU_BENCH_SECONDARY", "1") == "1":
                 # secondary configs first; their failures must never keep
                 # the headline line from printing
-                for fn in (bench_resnet, bench_bert):
+                for fn in (bench_resnet, bench_yolo, bench_bert):
                     try:
                         print(json.dumps(fn()), flush=True)
                     except Exception as e:  # noqa: BLE001

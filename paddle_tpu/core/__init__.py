@@ -15,6 +15,10 @@ from .device import (  # noqa: F401
     is_compiled_with_cuda,
     is_compiled_with_tpu,
     is_compiled_with_xpu,
+    max_memory_allocated,
+    memory_allocated,
+    memory_reserved,
+    memory_stats,
     set_device,
 )
 from .dtypes import get_default_dtype, set_default_dtype  # noqa: F401

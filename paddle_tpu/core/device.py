@@ -113,3 +113,52 @@ def get_place() -> Place:
 
 def device_count(kind: str = "tpu") -> int:
     return len(_devices_of_kind(kind))
+
+
+# --------------------------------------------------------------------------
+# Device memory stats (reference: memory/stats.h STAT_ADD +
+# `paddle.device.cuda.memory_allocated/max_memory_allocated`,
+# `platform/monitor.h:44`). On TPU, XLA owns HBM — the numbers come from
+# the PJRT device's memory_stats().
+# --------------------------------------------------------------------------
+
+def memory_stats(device=None) -> dict:
+    """Raw PJRT memory stats dict for a device ({} when the backend does
+    not report them, e.g. CPU). `device` may be None, a Place, a jax
+    Device, an int device index, or a "tpu:0"-style string."""
+    import jax
+    if device is None:
+        dev = jax.devices()[0]
+    elif isinstance(device, Place):
+        dev = device.jax_device()
+    elif isinstance(device, int):
+        dev = jax.devices()[device]
+    elif isinstance(device, str):
+        idx = int(device.split(":")[1]) if ":" in device else 0
+        dev = jax.devices()[idx]
+    elif isinstance(device, jax.Device):
+        dev = device
+    else:
+        raise TypeError(f"unsupported device spec {device!r}")
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    return dict(stats) if stats else {}
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently allocated on the device (reference:
+    `paddle.device.cuda.memory_allocated`)."""
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    """High-watermark of allocated bytes (reference:
+    `paddle.device.cuda.max_memory_allocated`)."""
+    s = memory_stats(device)
+    return int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))
+
+
+def memory_reserved(device=None) -> int:
+    """Bytes reserved by the allocator pool (== bytes_limit on TPU where
+    XLA preallocates; reference: `memory_reserved`)."""
+    s = memory_stats(device)
+    return int(s.get("bytes_limit", s.get("bytes_reserved", 0)))

@@ -22,6 +22,7 @@ host-memory-bound, not MXU work.
 """
 from .table import (ShardedEmbeddingTable, TableService,
                     init_table_service, shutdown_table_service)
+from .advanced import GeoTable, GraphTable, SSDTable  # noqa: F401
 
 __all__ = ["ShardedEmbeddingTable", "TableService", "init_table_service",
-           "shutdown_table_service"]
+           "shutdown_table_service", "GeoTable", "SSDTable", "GraphTable"]

@@ -1,0 +1,194 @@
+"""Advanced PS table modes: Geo-SGD, SSD-backed storage, graph table.
+
+Reference mapping:
+  * Geo-SGD — `paddle/fluid/distributed/table/sparse_geo_table.cc` +
+    geo mode in `service/communicator.cc` (trainers apply updates
+    LOCALLY and periodically push accumulated deltas to the global
+    table, pulling fresh rows on the way back);
+  * SSD-backed sparse table — `table/ssd_sparse_table.cc` (hot rows in
+    memory, cold rows on disk);
+  * graph table for GNN sampling — `table/common_graph_table.cc` +
+    `service/graph_brpc_server.cc` (neighbor storage + sampling RPC).
+
+TPU-native shape: these are host-side structures feeding the compiled
+dense step, exactly like the base `_Shard`; the wire protocol of
+`TableService` carries their RPCs.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .table import TableService, _rows_normal, _shard_bounds
+
+
+class GeoTable:
+    """Trainer-local view with Geo-SGD semantics (reference:
+    `sparse_geo_table.cc`): updates apply to a LOCAL replica immediately;
+    every `geo_step` pushes the accumulated delta to the global sharded
+    table and refreshes the touched rows from it.
+    """
+
+    def __init__(self, svc: TableService, name: str, vocab: int, dim: int,
+                 lr: float = 0.1, seed: int = 0, geo_step: int = 8):
+        self._svc = svc
+        self.name, self.vocab, self.dim = name, vocab, dim
+        self.lr = lr
+        self.geo_step = geo_step
+        # register the global table (idempotent per process)
+        svc.register(name, vocab, dim, lr=1.0, seed=seed)  # lr folded here
+        self._local = _rows_normal(seed, 0, vocab, dim, 0.02)
+        # sparse delta accumulator keyed by touched row — a dense
+        # zeros_like(local) would double the table's memory footprint
+        self._delta: Dict[int, np.ndarray] = {}
+        self._step = 0
+
+    def pull(self, ids) -> np.ndarray:
+        flat = np.asarray(ids).reshape(-1)
+        out = self._local[flat]
+        return out.reshape(tuple(np.shape(ids)) + (self.dim,))
+
+    def push(self, ids, grads):
+        """Local SGD apply + delta accumulation; geo push every
+        geo_step calls."""
+        flat = np.asarray(ids).reshape(-1)
+        g = np.asarray(grads, np.float32).reshape(flat.size, -1)
+        uniq, inv = np.unique(flat, return_inverse=True)
+        acc = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(acc, inv, g)
+        upd = self.lr * acc
+        self._local[uniq] -= upd
+        for row, u in zip(upd, uniq):
+            key = int(u)
+            d = self._delta.get(key)
+            self._delta[key] = -row if d is None else d - row
+        self._step += 1
+        if self._step % self.geo_step == 0:
+            self.geo_push()
+
+    def geo_push(self):
+        """Push accumulated deltas to the global table and refresh the
+        touched rows from it (reference: Communicator geo mode)."""
+        if not self._delta:
+            return
+        ids = np.fromiter(self._delta.keys(), np.int64)
+        delta = np.stack([self._delta[int(i)] for i in ids])
+        # global table applies -1.0 * delta (its lr is 1.0): send the
+        # NEGATED delta as the "gradient"
+        self._svc.push(self.name, ids, -delta, sync=True)
+        self._delta.clear()
+        self._local[ids] = self._svc.pull(self.name, ids)
+
+
+class SSDTable:
+    """Memory-capped shard: hot rows in RAM, full table on a disk memmap
+    (reference: `ssd_sparse_table.cc` — rocksdb-backed cold storage).
+
+    The memmap holds every row (written through on eviction); an LRU dict
+    caches at most `cache_rows` rows in memory.
+    """
+
+    def __init__(self, path: str, vocab: int, dim: int,
+                 cache_rows: int = 1024, lr: float = 0.1, seed: int = 0,
+                 rank: int = 0, world: int = 1):
+        self.vocab, self.dim, self.lr = vocab, dim, lr
+        self.lo, self.hi, _ = _shard_bounds(vocab, world, rank)
+        rows = self.hi - self.lo
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._mm = np.lib.format.open_memmap(
+            path, mode="w+", dtype=np.float32, shape=(rows, dim))
+        CHUNK = 1 << 13
+        for s in range(0, rows, CHUNK):
+            n = min(CHUNK, rows - s)
+            self._mm[s:s + n] = _rows_normal(seed, self.lo + s, n, dim,
+                                             0.02)
+        self._cache: "Dict[int, np.ndarray]" = {}
+        self._cap = cache_rows
+        self._lock = threading.Lock()
+
+    def _get(self, local_id: int) -> np.ndarray:
+        row = self._cache.pop(local_id, None)
+        if row is None:
+            row = np.array(self._mm[local_id])
+        self._cache[local_id] = row          # move to MRU end
+        while len(self._cache) > self._cap:
+            old_id, old_row = next(iter(self._cache.items()))
+            self._cache.pop(old_id)
+            self._mm[old_id] = old_row       # write-back on eviction
+        return row
+
+    def pull(self, ids) -> np.ndarray:
+        flat = np.asarray(ids).reshape(-1)
+        with self._lock:
+            out = np.stack([self._get(int(i) - self.lo) for i in flat])
+        return out.reshape(tuple(np.shape(ids)) + (self.dim,))
+
+    def push(self, ids, grads):
+        flat = np.asarray(ids).reshape(-1)
+        g = np.asarray(grads, np.float32).reshape(flat.size, -1)
+        with self._lock:
+            for i, gi in zip(flat, g):
+                li = int(i) - self.lo
+                self._get(li)
+                self._cache[li] = self._cache[li] - self.lr * gi
+
+    def flush(self):
+        with self._lock:
+            for li, row in self._cache.items():
+                self._mm[li] = row
+            self._mm.flush()
+
+    @property
+    def cached_rows(self) -> int:
+        return len(self._cache)
+
+
+class GraphTable:
+    """Adjacency store + neighbor sampling for GNN training (reference:
+    `common_graph_table.cc` random_sample_neighbors +
+    `graph_brpc_server.cc`). Edges partition by source-node owner; remote
+    sampling rides the TableService KV-free RPC path via per-rank
+    subtables registered under `graph:<name>`.
+    """
+
+    def __init__(self, name: str = "graph", seed: int = 0):
+        self.name = name
+        self._adj: Dict[int, np.ndarray] = {}
+        self._rs = np.random.RandomState(seed)
+
+    def add_edges(self, src: Sequence[int], dst: Sequence[int]):
+        src = np.asarray(src, np.int64)
+        dst = np.asarray(dst, np.int64)
+        order = np.argsort(src, kind="stable")
+        src, dst = src[order], dst[order]
+        uniq, starts = np.unique(src, return_index=True)
+        bounds = list(starts) + [len(src)]
+        for i, u in enumerate(uniq):
+            new = dst[bounds[i]:bounds[i + 1]]
+            old = self._adj.get(int(u))
+            self._adj[int(u)] = new if old is None else \
+                np.concatenate([old, new])
+
+    def sample_neighbors(self, nodes, sample_size: int,
+                         padding: int = -1) -> np.ndarray:
+        """[n] -> [n, sample_size] neighbor ids, `padding` where the
+        degree is short (dense output — XLA-ready, replacing the
+        reference's variable-length LoD result)."""
+        nodes = np.asarray(nodes, np.int64)
+        out = np.full((len(nodes), sample_size), padding, np.int64)
+        for r, u in enumerate(nodes):
+            nb = self._adj.get(int(u))
+            if nb is None or len(nb) == 0:
+                continue
+            if len(nb) <= sample_size:
+                out[r, :len(nb)] = nb
+            else:
+                out[r] = self._rs.choice(nb, sample_size, replace=False)
+        return out
+
+    def degree(self, nodes) -> np.ndarray:
+        return np.asarray([len(self._adj.get(int(u), ())) for u in
+                           np.asarray(nodes).reshape(-1)], np.int64)

@@ -280,22 +280,29 @@ class TableService:
         while True:
             item = self._async_q.get()
             if item is None:
+                self._async_q.task_done()
                 return
             batch = [item]
+            stop = False
             try:
                 while True:
                     nxt = self._async_q.get_nowait()
                     if nxt is None:
-                        self._drain(batch)
-                        for _ in batch:
-                            self._async_q.task_done()
-                        return
+                        stop = True
+                        self._async_q.task_done()
+                        break
                     batch.append(nxt)
             except queue.Empty:
                 pass
-            self._drain(batch)
-            for _ in batch:
-                self._async_q.task_done()
+            try:
+                self._drain(batch)
+            except Exception:   # peer gone mid-push: drop the batch —
+                pass            # task_done below keeps flush() unblocked
+            finally:
+                for _ in batch:
+                    self._async_q.task_done()
+            if stop:
+                return
 
     def _drain(self, batch):
         by_table: Dict[str, list] = {}

@@ -16,6 +16,7 @@ from .gpt import (  # noqa: F401
     gpt_tiny,
     gpt_345m,
     gpt_1p3b,
+    ernie_10b,
 )
 from .bert import (  # noqa: F401
     BertConfig,

@@ -67,6 +67,16 @@ def gpt_1p3b(**kw) -> GPTConfig:
                      num_heads=32, **kw)
 
 
+def ernie_10b(**kw) -> GPTConfig:
+    """ERNIE-3.0 10B-class decoder config (BASELINE config 5): train with
+    zero_stage=3 + sharding axis so per-chip param residency is
+    params/shard_axis (reference bar: static ShardingOptimizer ZeRO-2 +
+    offload, `sharding_optimizer.py:87-1385`)."""
+    kw.setdefault("max_position_embeddings", 2048)
+    return GPTConfig(vocab_size=50304, hidden_size=4096, num_layers=48,
+                     num_heads=64, **kw)
+
+
 class GPTDecoderLayer(Layer):
     """Pre-LN decoder block. TP layout: fused QKV column-parallel, attention
     output row-parallel; MLP column→row (Megatron pattern, reference

@@ -27,7 +27,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     keep) or additive float.
     """
     if flag("enable_pallas_kernels") and dropout_p == 0.0 \
-            and attn_mask is None and _pallas_ok(query, key):
+            and attn_mask is None and _pallas_ok(query, key, is_causal):
         try:
             from ...ops.flash_attention import flash_attention
         except ImportError:
@@ -39,18 +39,24 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                           training, scale)
 
 
-def _pallas_ok(q, k) -> bool:
-    """Dispatch heuristic, measured on v5e (512-seq tiles): the Pallas
-    flash kernel wins from 1K tokens in training (fwd+bwd 9.2ms vs XLA
-    12.1ms at [8,1024,16,64]; 1.7x at 2K), and is the only option from
-    ~8K where dense score temps exceed HBM. Floor tunable via
-    FLAGS_pallas_attention_min_seq. Cross-attention (k_len != q_len)
-    stays on the XLA path."""
+def _pallas_ok(q, k, causal: bool) -> bool:
+    """Dispatch heuristic, measured on v5e (512-seq tiles): causal flash
+    wins from 1K tokens in training (fwd+bwd 9.2ms vs XLA 12.1ms at
+    [8,1024,16,64]; 1.7x at 2K); NON-causal flash wins already at 512
+    (BERT-base b32: 35.5% vs 33.1% MFU — XLA's dense path carries the
+    full S x S fp32 score tensor either way, while the bubble the causal
+    kernel skips doesn't exist). Flash is the only option from ~8K where
+    dense score temps exceed HBM. Floor tunable via
+    FLAGS_pallas_attention_min_seq (causal; non-causal uses
+    min(floor, 512)). Cross-attention (k_len != q_len) stays on the XLA
+    path."""
     if jax.default_backend() not in ("tpu",):
         return False
     b, s, h, d = q.shape
-    return (k.shape == q.shape and s % 128 == 0
-            and s >= int(flag("pallas_attention_min_seq"))
+    floor = int(flag("pallas_attention_min_seq"))
+    if not causal:
+        floor = min(floor, 512)
+    return (k.shape == q.shape and s % 128 == 0 and s >= floor
             and d <= 256)
 
 

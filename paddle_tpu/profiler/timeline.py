@@ -1,0 +1,77 @@
+"""Multi-host timeline merger (reference: `tools/timeline.py` +
+`tools/CrossStackProfiler/` — merges per-node profiler dumps into one
+chrome://tracing view).
+
+Input: per-rank chrome-trace JSON files (what `stop_profiler(
+profile_path=...)` / the csrc Profiler emit). Output: one merged trace
+where each rank's events land in their own pid lane (`rank N`), with
+optional clock-skew alignment on a shared marker event.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+
+def _load(path: str) -> List[dict]:
+    with open(path) as f:
+        data = json.load(f)
+    return data["traceEvents"] if isinstance(data, dict) else data
+
+
+def merge_timelines(paths: Sequence[str], out_path: str,
+                    align_marker: Optional[str] = None) -> dict:
+    """Merge per-rank chrome traces into `out_path`.
+
+    paths: rank-ordered trace files. align_marker: event name present in
+    every trace (e.g. a barrier RecordEvent); when given, every rank's
+    timestamps shift so that marker starts at the same instant —
+    CrossStackProfiler's clock alignment (`CspReporter.py`).
+    Returns the merged trace dict.
+    """
+    merged: List[dict] = []
+    offsets: Dict[int, float] = {}
+    if align_marker:
+        starts = {}
+        for rank, p in enumerate(paths):
+            for ev in _load(p):
+                if ev.get("name") == align_marker and "ts" in ev:
+                    starts[rank] = min(starts.get(rank, float("inf")),
+                                       ev["ts"])
+        base = min(starts.values()) if starts else 0.0
+        offsets = {r: base - t for r, t in starts.items()}
+    for rank, p in enumerate(paths):
+        off = offsets.get(rank, 0.0)
+        merged.append({"name": "process_name", "ph": "M", "pid": rank,
+                       "args": {"name": f"rank {rank} "
+                                        f"({os.path.basename(p)})"}})
+        for ev in _load(p):
+            ev = dict(ev)
+            ev["pid"] = rank
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] + off
+            merged.append(ev)
+    out = {"traceEvents": merged, "displayTimeUnit": "ms"}
+    with open(out_path, "w") as f:
+        json.dump(out, f)
+    return out
+
+
+def main(argv: Optional[Sequence[str]] = None):
+    """CLI: python -m paddle_tpu.profiler.timeline out.json rank0.json
+    rank1.json ... [--align marker]."""
+    import argparse
+    ap = argparse.ArgumentParser(description="merge per-rank chrome "
+                                             "traces (tools/timeline.py)")
+    ap.add_argument("output")
+    ap.add_argument("inputs", nargs="+")
+    ap.add_argument("--align", default=None,
+                    help="event name used for cross-rank clock alignment")
+    a = ap.parse_args(argv)
+    merge_timelines(a.inputs, a.output, align_marker=a.align)
+    print(f"merged {len(a.inputs)} traces -> {a.output}")
+
+
+if __name__ == "__main__":
+    main()

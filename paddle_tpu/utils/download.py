@@ -82,7 +82,7 @@ def get_path_from_url(url: str, root_dir: str = DATA_HOME,
     (reference: `download.py get_path_from_url`)."""
     path = _download(url, root_dir, md5sum)
     if decompress:
-        _decompress(path)
+        return _decompress(path)
     return path
 
 

@@ -16,3 +16,9 @@ from .resnet import (  # noqa: F401
     resnet152,
     wide_resnet50_2,
 )
+from .yolo import (  # noqa: F401
+    DarkNet53,
+    YOLOv3,
+    yolo_loss,
+    yolov3_darknet53,
+)

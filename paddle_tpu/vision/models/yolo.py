@@ -1,0 +1,308 @@
+"""YOLOv3 / PP-YOLO-class detector (BASELINE config 4).
+
+Reference mapping (core repo):
+  * `yolov3_loss` op — `paddle/fluid/operators/detection/yolov3_loss_op.h`
+    (target assignment by best wh-IoU anchor, sigmoid-CE xy, MSE wh,
+    obj/noobj BCE with ignore_thresh, class BCE, box weight 2-w*h);
+  * `yolo_box` decode — `operators/detection/yolo_box_op.h` (wrapped in
+    `..ops.yolo_box`);
+  * SSD/YOLO python assembly — `fluid/layers/detection.py`.
+
+TPU-first shape discipline: every tensor is static — ground truth rides a
+fixed-capacity [B, MAX_BOXES, 4] pad (gt_class < 0 marks padding), target
+assignment is a vectorized scatter, and the whole train step jits into
+one XLA program (no per-image Python).
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...nn import functional as F
+from ...nn.layer import Layer
+from ...nn.layer_common import LayerList
+from ...nn.layer_conv_norm import BatchNorm2D, Conv2D
+
+ANCHORS = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45,
+           59, 119, 116, 90, 156, 198, 373, 326]
+ANCHOR_MASKS = [[6, 7, 8], [3, 4, 5], [0, 1, 2]]
+
+
+class ConvBNLayer(Layer):
+    def __init__(self, cin, cout, k=3, stride=1, act="leaky"):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, k, stride=stride, padding=k // 2,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(cout)
+        self.act = act
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return F.leaky_relu(x, 0.1) if self.act == "leaky" else x
+
+
+class BasicBlock(Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv1 = ConvBNLayer(ch, ch // 2, k=1)
+        self.conv2 = ConvBNLayer(ch // 2, ch, k=3)
+
+    def forward(self, x):
+        return x + self.conv2(self.conv1(x))
+
+
+class DarkNet53(Layer):
+    """YOLOv3 backbone; returns (C3, C4, C5). Stage depths 1/2/8/8/4."""
+
+    def __init__(self, depths=(1, 2, 8, 8, 4), base=32):
+        super().__init__()
+        self.stem = ConvBNLayer(3, base, k=3)
+        stages, downs = [], []
+        cin = base
+        for i, n in enumerate(depths):
+            cout = cin * 2
+            downs.append(ConvBNLayer(cin, cout, k=3, stride=2))
+            stages.append(LayerList([BasicBlock(cout) for _ in range(n)]))
+            cin = cout
+        self.downs = LayerList(downs)
+        self.stages = LayerList(stages)
+
+    def forward(self, x):
+        x = self.stem(x)
+        feats = []
+        for down, stage in zip(self.downs, self.stages):
+            x = down(x)
+            for blk in stage:
+                x = blk(x)
+            feats.append(x)
+        return feats[2], feats[3], feats[4]      # strides 8, 16, 32
+
+
+class YoloDetectionBlock(Layer):
+    """5-conv neck block (reference assembly in PaddleDetection's
+    YOLOv3 head; op-level pieces are core `detection.py`)."""
+
+    def __init__(self, cin, ch):
+        super().__init__()
+        self.conv0 = ConvBNLayer(cin, ch, k=1)
+        self.conv1 = ConvBNLayer(ch, ch * 2, k=3)
+        self.conv2 = ConvBNLayer(ch * 2, ch, k=1)
+        self.conv3 = ConvBNLayer(ch, ch * 2, k=3)
+        self.route = ConvBNLayer(ch * 2, ch, k=1)
+        self.tip = ConvBNLayer(ch, ch * 2, k=3)
+
+    def forward(self, x):
+        x = self.conv3(self.conv2(self.conv1(self.conv0(x))))
+        r = self.route(x)
+        return r, self.tip(r)
+
+
+class YOLOv3(Layer):
+    """Detector: DarkNet53 + FPN-style neck + 3-scale heads.
+
+    forward(img) -> list of raw head maps [B, na*(5+nc), H, W]
+    (train mode); `predict` decodes with `ops.yolo_box` + NMS.
+    """
+
+    def __init__(self, num_classes: int = 80,
+                 anchors: Sequence[int] = ANCHORS,
+                 anchor_masks=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.anchors = list(anchors)
+        self.anchor_masks = anchor_masks or ANCHOR_MASKS
+        self.backbone = DarkNet53()
+        cins = (1024, 768, 384)     # C5; ch(512)//2+C4; ch(256)//2+C3
+        chs = (512, 256, 128)
+        blocks, heads, routes = [], [], []
+        for i, (cin, ch) in enumerate(zip(cins, chs)):
+            blocks.append(YoloDetectionBlock(cin, ch))
+            na = len(self.anchor_masks[i])
+            heads.append(Conv2D(ch * 2, na * (5 + num_classes), 1))
+            if i < 2:
+                routes.append(ConvBNLayer(ch, ch // 2, k=1))
+        self.blocks = LayerList(blocks)
+        self.heads = LayerList(heads)
+        self.routes = LayerList(routes)
+
+    def forward(self, x):
+        c3, c4, c5 = self.backbone(x)
+        outs, feat = [], c5
+        for i, (blk, head) in enumerate(zip(self.blocks, self.heads)):
+            route, tip = blk(feat)
+            outs.append(head(tip))
+            if i < 2:
+                r = self.routes[i](route)
+                b, c, h, w = r.shape
+                r = jax.image.resize(r, (b, c, h * 2, w * 2), "nearest")
+                feat = jnp.concatenate([r, c4 if i == 0 else c3], axis=1)
+        return outs
+
+    def predict(self, img, img_size, conf_thresh=0.01, nms_topk=100,
+                score_threshold=0.01, nms_threshold=0.45):
+        """Decode + NMS (reference: `yolo_box` + `multiclass_nms`)."""
+        from ..ops import multiclass_nms, yolo_box
+        outs = self(img)
+        boxes_all, scores_all = [], []
+        for i, out in enumerate(outs):
+            stride = 32 // (2 ** i)
+            anchors = [self.anchors[2 * a + o]
+                       for a in self.anchor_masks[i] for o in (0, 1)]
+            boxes, scores = yolo_box(out, img_size, anchors,
+                                     self.num_classes, conf_thresh,
+                                     downsample_ratio=stride)
+            boxes_all.append(boxes)
+            scores_all.append(scores)
+        boxes = jnp.concatenate(boxes_all, axis=1)       # [N, M, 4]
+        scores = jnp.concatenate(scores_all, axis=1)     # [N, M, C]
+
+        def one(b, s):
+            return multiclass_nms(b, s.T,
+                                  score_threshold=score_threshold,
+                                  nms_threshold=nms_threshold,
+                                  keep_top_k=nms_topk)
+
+        return jax.vmap(one)(boxes, scores)
+
+
+# ------------------------------------------------------------------ loss
+
+def _wh_iou(wh1, wh2):
+    """IoU of boxes sharing a center: [n,2] x [m,2] -> [n,m]."""
+    inter = jnp.minimum(wh1[:, None, 0], wh2[None, :, 0]) * \
+        jnp.minimum(wh1[:, None, 1], wh2[None, :, 1])
+    a1 = wh1[:, 0] * wh1[:, 1]
+    a2 = wh2[:, 0] * wh2[:, 1]
+    return inter / (a1[:, None] + a2[None, :] - inter + 1e-10)
+
+
+def _bce(logit, target):
+    return jnp.maximum(logit, 0) - logit * target + \
+        jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+
+def yolo_loss(outputs: List[jax.Array], gt_box, gt_class,
+              anchors: Sequence[int] = ANCHORS,
+              anchor_masks=None, num_classes: int = 80,
+              ignore_thresh: float = 0.7,
+              downsample_ratios=(32, 16, 8)):
+    """YOLOv3 loss (reference: `yolov3_loss_op.h` CalcYolov3Loss).
+
+    gt_box: [B, MAX, 4] (cx, cy, w, h) normalized to [0,1];
+    gt_class: [B, MAX] int label, < 0 for padding slots.
+    Fully vectorized, static shapes: each gt picks its best wh-IoU anchor
+    over all 9; the owning scale scatters targets at the center cell.
+    """
+    anchor_masks = anchor_masks or ANCHOR_MASKS
+    aw = jnp.asarray(anchors[0::2], jnp.float32)
+    ah = jnp.asarray(anchors[1::2], jnp.float32)
+    B, MAX = gt_class.shape
+    valid = (gt_class >= 0)
+    input_size = outputs[0].shape[-1] * downsample_ratios[0]
+
+    # best anchor per gt over ALL anchors (wh IoU in pixels)
+    gwh = jnp.stack([gt_box[..., 2] * input_size,
+                     gt_box[..., 3] * input_size], -1)   # [B,MAX,2]
+    awh = jnp.stack([aw, ah], -1)                        # [9,2]
+    iou = _wh_iou(gwh.reshape(-1, 2), awh).reshape(B, MAX, -1)
+    best_anchor = jnp.argmax(iou, axis=-1)               # [B,MAX]
+
+    total = jnp.zeros((), jnp.float32)
+    for si, out in enumerate(outputs):
+        mask = jnp.asarray(anchor_masks[si])
+        na = len(anchor_masks[si])
+        _, C, H, W = out.shape
+        p = out.reshape(B, na, 5 + num_classes, H, W)
+        px, py = p[:, :, 0], p[:, :, 1]
+        pw, ph = p[:, :, 2], p[:, :, 3]
+        pobj = p[:, :, 4]
+        pcls = p[:, :, 5:]
+
+        # gt -> this scale's targets
+        on_scale = jnp.any(best_anchor[..., None] == mask[None, None],
+                           axis=-1) & valid                    # [B,MAX]
+        local_a = jnp.argmax(
+            (best_anchor[..., None] == mask[None, None]), axis=-1)
+        gi = jnp.clip((gt_box[..., 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gt_box[..., 1] * H).astype(jnp.int32), 0, H - 1)
+        bidx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, MAX))
+
+        sel_w = aw[mask][local_a]
+        sel_h = ah[mask][local_a]
+        tx = gt_box[..., 0] * W - gi
+        ty = gt_box[..., 1] * H - gj
+        tw = jnp.log(jnp.maximum(gwh[..., 0] / sel_w, 1e-9))
+        th = jnp.log(jnp.maximum(gwh[..., 1] / sel_h, 1e-9))
+        # reference box weight: 2 - w*h (small boxes weigh more)
+        bw = 2.0 - gt_box[..., 2] * gt_box[..., 3]
+
+        # invalid slots (padding / other-scale gts) scatter to an
+        # OUT-OF-BOUNDS cell dropped by XLA — writing 0.0 at their
+        # computed index would clobber a real target sharing that index
+        # (duplicate-index .set is last-write-wins)
+        gi_s = jnp.where(on_scale, gi, W)
+        gj_s = jnp.where(on_scale, gj, H)
+
+        def scat(val):
+            z = jnp.zeros((B, na, H, W), jnp.float32)
+            return z.at[bidx, local_a, gj_s, gi_s].set(val, mode="drop")
+
+        tobj = jnp.zeros((B, na, H, W), jnp.float32).at[
+            bidx, local_a, gj_s, gi_s].max(1.0, mode="drop")
+        wobj = scat(bw)
+        # xy: sigmoid BCE; wh: MSE — both weighted by bw at positives
+        l_xy = wobj * (_bce(px, scat(tx)) + _bce(py, scat(ty)))
+        l_wh = 0.5 * wobj * ((pw - scat(tw)) ** 2 + (ph - scat(th)) ** 2)
+
+        # noobj ignore mask: pred boxes with IoU > thresh vs any gt are
+        # not penalized (reference ignore_thresh)
+        cell_x = (jax.nn.sigmoid(px) + jnp.arange(W)[None, None, None]) / W
+        cell_y = (jax.nn.sigmoid(py) + jnp.arange(H)[None, None, :, None]) \
+            / H
+        pred_w = jnp.exp(jnp.clip(pw, -10, 10)) * aw[mask][None, :, None,
+                                                           None] / input_size
+        pred_h = jnp.exp(jnp.clip(ph, -10, 10)) * ah[mask][None, :, None,
+                                                           None] / input_size
+        pb = jnp.stack([cell_x, cell_y, pred_w, pred_h], -1)  # [B,na,H,W,4]
+
+        def box_iou_cwh(a, b):
+            ax1, ay1 = a[..., 0] - a[..., 2] / 2, a[..., 1] - a[..., 3] / 2
+            ax2, ay2 = a[..., 0] + a[..., 2] / 2, a[..., 1] + a[..., 3] / 2
+            bx1, by1 = b[..., 0] - b[..., 2] / 2, b[..., 1] - b[..., 3] / 2
+            bx2, by2 = b[..., 0] + b[..., 2] / 2, b[..., 1] + b[..., 3] / 2
+            iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1),
+                             0)
+            ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1),
+                             0)
+            inter = iw * ih
+            ua = (ax2 - ax1) * (ay2 - ay1) + (bx2 - bx1) * (by2 - by1) \
+                - inter
+            return inter / (ua + 1e-10)
+
+        ious = box_iou_cwh(pb[..., None, :],
+                           jnp.where(valid[:, None, None, None, :, None],
+                                     gt_box[:, None, None, None],
+                                     0.0))                 # [B,na,H,W,MAX]
+        best_iou = jnp.max(ious, axis=-1)
+        noobj_mask = (best_iou < ignore_thresh).astype(jnp.float32)
+
+        l_obj = tobj * _bce(pobj, tobj) + \
+            (1 - tobj) * noobj_mask * _bce(pobj, tobj)
+
+        tcls_idx = scat(gt_class.astype(jnp.float32)).astype(jnp.int32)
+        tcls = jax.nn.one_hot(tcls_idx, num_classes,
+                              dtype=jnp.float32, axis=2)
+        l_cls = tobj[:, :, None] * _bce(pcls, tcls)
+
+        total = total + (jnp.sum(l_xy) + jnp.sum(l_wh) + jnp.sum(l_obj) +
+                         jnp.sum(l_cls)) / B
+    return total
+
+
+def yolov3_darknet53(num_classes: int = 80, **kw) -> YOLOv3:
+    """PP-YOLO-class factory (BASELINE config 4 model)."""
+    return YOLOv3(num_classes=num_classes, **kw)

@@ -226,3 +226,16 @@ class TestUtils:
             assert old_fn() == 42
             assert any(issubclass(x.category, DeprecationWarning)
                        for x in w)
+
+
+def test_device_memory_stats_surface():
+    """Memory monitor surface (reference: platform/monitor.h STAT_ADD +
+    paddle.device.cuda.memory_allocated). CPU backend reports nothing —
+    the contract is ints, no crash; TPU reports real bytes."""
+    import paddle_tpu as pt
+    s = pt.core.memory_stats()
+    assert isinstance(s, dict)
+    for fn in (pt.core.memory_allocated, pt.core.max_memory_allocated,
+               pt.core.memory_reserved):
+        v = fn()
+        assert isinstance(v, int) and v >= 0

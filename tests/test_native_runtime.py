@@ -183,3 +183,32 @@ class TestDataLoaderWorkers:
         flat = np.concatenate([np.asarray(b).reshape(-1) for b in batches])
         np.testing.assert_array_equal(flat,
                                       np.arange(64, dtype=np.int64) ** 2)
+
+
+class TestTimelineMerger:
+    """tools/timeline.py + CrossStackProfiler equivalent."""
+
+    def _trace(self, path, rank, t0):
+        import json
+        evs = [{"name": "sync", "ph": "X", "ts": t0, "dur": 5, "pid": 0,
+                "tid": 1},
+               {"name": f"op{rank}", "ph": "X", "ts": t0 + 10, "dur": 3,
+                "pid": 0, "tid": 1}]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": evs}, f)
+
+    def test_merge_assigns_pid_lanes_and_aligns(self, tmp_path):
+        import json
+        from paddle_tpu.profiler.timeline import merge_timelines
+        p0, p1 = str(tmp_path / "r0.json"), str(tmp_path / "r1.json")
+        self._trace(p0, 0, t0=1000.0)
+        self._trace(p1, 1, t0=9000.0)   # skewed clock
+        out = str(tmp_path / "merged.json")
+        merged = merge_timelines([p0, p1], out, align_marker="sync")
+        evs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+        assert {e["pid"] for e in evs} == {0, 1}
+        sync_ts = [e["ts"] for e in evs if e["name"] == "sync"]
+        assert abs(sync_ts[0] - sync_ts[1]) < 1e-9  # clocks aligned
+        with open(out) as f:
+            assert len(json.load(f)["traceEvents"]) == len(
+                merged["traceEvents"])

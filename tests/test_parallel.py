@@ -404,3 +404,73 @@ class TestBert:
         seq, pooled = model(ids, attention_mask=mask)
         assert seq.shape == (2, 8, 64)
         assert pooled.shape == (2, 64)
+
+
+class TestZero3:
+    """ZeRO-3 (zero_stage=3): PARAMETERS rest sharded over 'sharding'
+    with gather-on-use (VERDICT r2 item 5). Reference bar: static
+    ShardingOptimizer is ZeRO-2+offload only
+    (`sharding_optimizer.py:87-1385`)."""
+
+    def _run(self, mesh_dims, zero_stage, steps=3):
+        from paddle_tpu.models import (GPTConfig, GPTForPretraining,
+                                       build_train_step)
+        pt.seed(0)
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=4,
+                        num_heads=4, max_position_embeddings=64,
+                        dtype=jnp.float32)
+        model = GPTForPretraining(cfg)
+        opt = pt.optimizer.AdamW(learning_rate=1e-3)
+        mesh = build_mesh(**mesh_dims)
+        step, state = build_train_step(model, opt, mesh,
+                                       zero_stage=zero_stage)
+        rs = np.random.RandomState(0)
+        ids = jnp.asarray(rs.randint(0, 128, (8, 16)), jnp.int32)
+        labels = jnp.asarray(rs.randint(0, 128, (8, 16)), jnp.int32)
+        losses = []
+        for _ in range(steps):
+            state, loss = step(state, (ids, labels))
+            losses.append(float(loss))
+        return losses, state
+
+    def test_zero3_param_bytes_per_chip_shrink(self):
+        """Live param bytes/chip at sharding=4 < half of sharding=1."""
+        _, s1 = self._run(dict(dp=4), zero_stage=3)
+        _, s4 = self._run(dict(sharding=4), zero_stage=3)
+
+        def chip_param_bytes(state):
+            total = 0
+            for tree in state[:2]:          # (outer, stacked)
+                for v in tree.values():
+                    total += v.addressable_shards[0].data.nbytes
+            return total
+
+        b1, b4 = chip_param_bytes(s1), chip_param_bytes(s4)
+        assert b4 < 0.5 * b1, (b4, b1)
+        # and the big block weights are truly sharded 4-way
+        qkv = s4[1]["qkv.weight"]
+        assert qkv.addressable_shards[0].data.size == qkv.size // 4
+
+    def test_zero3_loss_matches_dp(self):
+        l_dp, _ = self._run(dict(dp=4), zero_stage=2)
+        l_z3, _ = self._run(dict(sharding=4), zero_stage=3)
+        np.testing.assert_allclose(l_z3, l_dp, rtol=2e-4)
+
+    def test_zero3_composes_with_tp(self):
+        l_ref, _ = self._run(dict(dp=1, mp=2), zero_stage=2)
+        l_z3, s = self._run(dict(sharding=2, mp=2), zero_stage=3)
+        np.testing.assert_allclose(l_z3, l_ref, rtol=2e-4)
+        # TP dim and ZeRO dim shard DIFFERENT axes of the same weight
+        qkv = s[1]["qkv.weight"]
+        assert qkv.addressable_shards[0].data.size == qkv.size // 4
+
+
+def test_ernie_10b_config_shape():
+    """BASELINE config 5 model definition exists and is ~10B params."""
+    from paddle_tpu.models import ernie_10b
+    cfg = ernie_10b()
+    d, L, V, ffn = (cfg.hidden_size, cfg.num_layers, cfg.vocab_size,
+                    cfg.ffn_hidden)
+    params = L * (4 * d * d + 2 * d * ffn) + V * d + \
+        cfg.max_position_embeddings * d
+    assert 9e9 < params < 13e9, params

@@ -1,0 +1,120 @@
+"""YOLOv3/PP-YOLO-class detector (VERDICT r2 missing item 7; BASELINE
+config 4). Reference bars: `yolov3_loss_op.h`, `yolo_box_op.h`,
+`fluid/layers/detection.py`."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.vision.models import YOLOv3, yolo_loss, yolov3_darknet53
+
+
+def _tiny_yolo(nc=4):
+    # full architecture, tiny spatial size for CPU tests
+    return yolov3_darknet53(num_classes=nc)
+
+
+class TestYoloForward:
+    def test_head_shapes(self):
+        net = _tiny_yolo()
+        net.eval()
+        x = jnp.zeros((2, 3, 128, 128), jnp.float32)
+        outs = net(x)
+        assert len(outs) == 3
+        na, nc = 3, 4
+        assert outs[0].shape == (2, na * (5 + nc), 4, 4)      # stride 32
+        assert outs[1].shape == (2, na * (5 + nc), 8, 8)      # stride 16
+        assert outs[2].shape == (2, na * (5 + nc), 16, 16)    # stride 8
+
+    def test_predict_decodes_and_nms(self):
+        net = _tiny_yolo()
+        net.eval()
+        x = jnp.zeros((1, 3, 128, 128), jnp.float32)
+        img_size = jnp.asarray([[128, 128]], jnp.int32)
+        out = net.predict(x, img_size, score_threshold=0.0)
+        boxes = np.asarray(out[0]) if isinstance(out, (tuple, list)) \
+            else np.asarray(out)
+        assert boxes.ndim >= 2
+
+
+class TestYoloLoss:
+    def _gt(self, B=2, MAX=8, nc=4, seed=0):
+        rs = np.random.RandomState(seed)
+        box = rs.uniform(0.2, 0.8, (B, MAX, 4)).astype(np.float32)
+        box[..., 2:] = rs.uniform(0.05, 0.3, (B, MAX, 2))
+        cls = rs.randint(0, nc, (B, MAX)).astype(np.int32)
+        cls[:, MAX // 2:] = -1       # half the slots are padding
+        return jnp.asarray(box), jnp.asarray(cls)
+
+    def test_loss_finite_and_positive(self):
+        net = _tiny_yolo()
+        net.train()
+        x = jnp.zeros((2, 3, 128, 128), jnp.float32)
+        outs = net(x)
+        gt_box, gt_cls = self._gt()
+        loss = yolo_loss(outs, gt_box, gt_cls, num_classes=4)
+        assert np.isfinite(float(loss)) and float(loss) > 0
+
+    def test_padding_slots_do_not_contribute(self):
+        net = _tiny_yolo()
+        net.train()
+        x = jnp.zeros((2, 3, 128, 128), jnp.float32)
+        outs = net(x)
+        gt_box, gt_cls = self._gt()
+        l1 = float(yolo_loss(outs, gt_box, gt_cls, num_classes=4))
+        # mutate ONLY padded slots' boxes — loss must not change
+        gt_box2 = gt_box.at[:, 4:].set(0.5)
+        l2 = float(yolo_loss(outs, gt_box2, gt_cls, num_classes=4))
+        assert abs(l1 - l2) < 1e-4 * max(abs(l1), 1.0), (l1, l2)
+
+    def test_padding_at_origin_cell_does_not_clobber_real_target(self):
+        """Padding slots scatter at a computed index of cell (0,0); a
+        REAL gt in that cell must keep its targets (regression: the
+        old 0.0-write clobbered them, training the box toward 0)."""
+        net = _tiny_yolo()
+        net.train()
+        x = jnp.zeros((1, 3, 128, 128), jnp.float32)
+        outs = net(x)
+        real = jnp.asarray([[[0.05, 0.05, 0.6, 0.6]]], jnp.float32)
+        cls1 = jnp.asarray([[2]], jnp.int32)
+        l_solo = float(yolo_loss(outs, real, cls1, num_classes=4))
+        padded_box = jnp.concatenate(
+            [real, jnp.zeros((1, 3, 4), jnp.float32)], axis=1)
+        padded_cls = jnp.concatenate(
+            [cls1, jnp.full((1, 3), -1, jnp.int32)], axis=1)
+        l_pad = float(yolo_loss(outs, padded_box, padded_cls,
+                                num_classes=4))
+        assert abs(l_solo - l_pad) < 1e-3 * max(abs(l_solo), 1.0), \
+            (l_solo, l_pad)
+
+    def test_trains_toward_synthetic_targets(self):
+        """One fixed image + fixed boxes: a jitted Adam loop must cut the
+        loss substantially (the reference's convergence smoke bar)."""
+        from paddle_tpu.nn.layer import functional_call, trainable_state
+        pt.seed(0)
+        net = _tiny_yolo()
+        net.train()
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(1, 3, 128, 128), jnp.float32)
+        gt_box = jnp.asarray([[[0.5, 0.5, 0.25, 0.25],
+                               [0.25, 0.3, 0.1, 0.15]]], jnp.float32)
+        gt_cls = jnp.asarray([[1, 2]], jnp.int32)
+        params = trainable_state(net)
+        opt = pt.optimizer.Adam(learning_rate=1e-3)
+        opt_state = opt.init_state(params)
+
+        def loss_fn(p):
+            outs, _ = functional_call(net, p, x)
+            return yolo_loss(outs, gt_box, gt_cls, num_classes=4)
+
+        @jax.jit
+        def step(p, s):
+            l, g = jax.value_and_grad(loss_fn)(p)
+            p2, s2 = opt.apply(p, g, s)
+            return p2, s2, l
+
+        params, opt_state, l0 = step(params, opt_state)
+        for _ in range(12):
+            params, opt_state, loss = step(params, opt_state)
+        assert float(loss) < 0.6 * float(l0), (float(l0), float(loss))
