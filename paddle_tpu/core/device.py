@@ -134,8 +134,14 @@ def memory_stats(device=None) -> dict:
     elif isinstance(device, int):
         dev = jax.devices()[device]
     elif isinstance(device, str):
-        idx = int(device.split(":")[1]) if ":" in device else 0
-        dev = jax.devices()[idx]
+        # 'tpu:1' / 'cpu' — resolve by KIND via the Place machinery
+        # (indexing jax.devices() directly would hand back a TPU for a
+        # 'cpu:0' request on a TPU host)
+        name, _, idx = device.partition(":")
+        idx = int(idx) if idx else 0
+        name = {"gpu": "tpu"}.get(name, name)
+        place = TPUPlace(idx) if name == "tpu" else CPUPlace(idx)
+        dev = place.jax_device()
     elif isinstance(device, jax.Device):
         dev = device
     else:

@@ -23,6 +23,8 @@ host-memory-bound, not MXU work.
 from .table import (ShardedEmbeddingTable, TableService,
                     init_table_service, shutdown_table_service)
 from .advanced import GeoTable, GraphTable, SSDTable  # noqa: F401
+from .heter import HeterServer, HeterWorker  # noqa: F401
 
 __all__ = ["ShardedEmbeddingTable", "TableService", "init_table_service",
-           "shutdown_table_service", "GeoTable", "SSDTable", "GraphTable"]
+           "shutdown_table_service", "GeoTable", "SSDTable", "GraphTable",
+           "HeterServer", "HeterWorker"]

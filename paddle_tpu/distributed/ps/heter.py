@@ -1,0 +1,56 @@
+"""Heterogeneous split training (reference N29: `heter_client.cc`,
+`heter_server.cc`, `heterxpu_trainer.cc`, `hetercpu_worker.cc`).
+
+The reference splits one model between CPU parameter-server workers
+(sparse embedding lookup/update, data feeding) and accelerator services
+(the heavy dense layers), exchanging activations/grads over brpc.
+
+TPU-native mapping: the process that owns the TPU registers its jitted
+dense step as a heter function on the `TableService` wire protocol; CPU
+worker ranks pull embedding rows from the sharded host table, RPC the
+dense forward/backward to the device owner, and push the returned
+embedding-row grads back to the table. The accelerator never blocks on
+sparse work and the CPU never traces XLA.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from .table import ShardedEmbeddingTable, TableService
+
+
+class HeterWorker:
+    """CPU-side worker (reference: `hetercpu_worker.cc` DeviceWorker
+    loop): per batch — pull rows, heter_call the dense step, push row
+    grads."""
+
+    def __init__(self, svc: TableService, table: ShardedEmbeddingTable,
+                 device_rank: int, step_name: str = "dense_step"):
+        self._svc = svc
+        self._table = table
+        self._device_rank = device_rank
+        self._step_name = step_name
+
+    def train_batch(self, ids, labels, sync_push: bool = True):
+        """One DownpourWorker-style tick through the heter service.
+        Returns the loss reported by the device owner."""
+        rows = self._table.pull(ids)
+        loss, row_grads = self._svc.heter_call(
+            self._device_rank, self._step_name,
+            np.asarray(rows, np.float32), np.asarray(labels))
+        self._table.push(ids, row_grads, sync=sync_push)
+        return float(loss)
+
+
+class HeterServer:
+    """Accelerator-side service (reference: `heter_server.cc`): wraps a
+    jitted dense step `fn(rows, labels) -> (loss, row_grads)` and serves
+    it to CPU workers."""
+
+    def __init__(self, svc: TableService, fn: Callable,
+                 step_name: str = "dense_step"):
+        svc.register_heter_fn(step_name, fn)
+        self._svc = svc
+        self._name = step_name

@@ -124,6 +124,9 @@ class TableService:
         # GlobalShuffle exchanges records over brpc, `data_set.h:101`)
         self._shuffle_buf: list = []
         self._shuffle_lock = threading.Lock()
+        # heter split-training function registry (reference:
+        # `heter_server.cc` RegisterServiceHandler)
+        self._heter_fns: Dict[str, object] = {}
         if world > 1:
             self._listener = Listener((self._bind_host, self._ports[rank]),
                                       authkey=_authkey())
@@ -181,6 +184,20 @@ class TableService:
                     with self._shuffle_lock:
                         self._shuffle_buf.extend(payload)
                     conn.send(b"ok")
+                elif op == "heter_call":
+                    # heterogeneous split training (reference:
+                    # heter_client/server.cc): run a registered function
+                    # (e.g. the jitted dense step on the device owner)
+                    # on behalf of a CPU-side worker
+                    fn = self._heter_fns.get(table)
+                    if fn is None:
+                        conn.send(KeyError(f"heter fn {table!r} "
+                                           "not registered"))
+                    else:
+                        try:
+                            conn.send(("ok", fn(*payload)))
+                        except Exception as e:  # noqa: BLE001
+                            conn.send(("err", repr(e)))
         finally:
             try:
                 conn.close()
@@ -316,6 +333,30 @@ class TableService:
     def flush(self):
         """Drain queued async pushes (reference: Communicator barrier)."""
         self._async_q.join()
+
+    # ---- heterogeneous split training (reference: N29
+    # `heter_client.cc`/`heter_server.cc`, `heterxpu_trainer.cc`:
+    # CPU-side workers drive sparse/PS work and RPC the heavy dense
+    # compute to the accelerator owner) --------------------------------
+
+    def register_heter_fn(self, name: str, fn):
+        """Expose `fn(*numpy_args) -> pytree` to heter_call RPCs (run on
+        THIS process — typically the rank that owns the TPU)."""
+        self._heter_fns[name] = fn
+
+    def heter_call(self, peer: int, name: str, *args):
+        """Invoke a peer's registered heter function and return its
+        result (reference: HeterClient::SendAndRecvAsync)."""
+        if peer == self.rank:
+            return self._heter_fns[name](*args)
+        res = self._rpc(peer, "heter_call", name, args)
+        if isinstance(res, Exception):
+            raise res
+        status, payload = res
+        if status != "ok":
+            raise RuntimeError(f"heter_call {name!r} on rank {peer} "
+                               f"failed: {payload}")
+        return payload
 
     # ---- KV store (rank 0 hosts; reference: gloo HTTP-KV / etcd) --------
 

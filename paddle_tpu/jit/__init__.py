@@ -35,6 +35,11 @@ class StaticFunction:
         self._function = function  # the ORIGINAL bound forward
         self._input_spec = input_spec
         self._layer = layer
+        self._ast_converted = False
+        self._build(function)
+
+    def _build(self, function):
+        layer = self._layer
         if layer is not None:
             from ..nn.layer import _slots
 
@@ -59,16 +64,35 @@ class StaticFunction:
         else:
             self._jitted = jax.jit(function)
 
-    def __call__(self, *args, **kwargs):
+    def _ast_fallback(self):
+        """Trace hit Python control flow on a traced value: rewrite the
+        function's if/while into lax.cond/while_loop and re-jit
+        (reference: the dygraph_to_static AST transformer pass)."""
+        from .dy2static import convert_control_flow
+        self._function = convert_control_flow(self._function)
+        self._ast_converted = True
+        self._build(self._function)
+
+    def _invoke(self, *args, **kwargs):
         if self._layer is not None:
             params = {n: p.value for n, p in
                       self._layer.named_parameters()}
             buffers = buffer_state(self._layer)
-            out, new_buffers = self._jitted(params, buffers, *args, **kwargs)
+            out, new_buffers = self._jitted(params, buffers, *args,
+                                            **kwargs)
             from ..nn.layer import load_state
             load_state(self._layer, {}, new_buffers)
             return out
         return self._jitted(*args, **kwargs)
+
+    def __call__(self, *args, **kwargs):
+        try:
+            return self._invoke(*args, **kwargs)
+        except jax.errors.TracerBoolConversionError:
+            if self._ast_converted:
+                raise
+            self._ast_fallback()
+            return self._invoke(*args, **kwargs)
 
     @property
     def code(self):

@@ -1,0 +1,337 @@
+"""dy2static AST fallback: Python control flow on traced values.
+
+Reference: the dygraph_to_static AST transformer family
+(`fluid/dygraph/dygraph_to_static/ifelse_transformer.py`,
+`loop_transformer.py`, driven by `program_translator.py`): `if`/`while`
+statements whose conditions are tensors are rewritten into functional
+`cond`/`while_loop` ops with closure-converted branch functions.
+
+TPU-native twist: the rewritten calls dispatch at TRACE time — a concrete
+(python) condition keeps plain Python semantics, a traced condition lowers
+to `lax.cond` / `lax.while_loop`. Data-dependent Python control flow that
+the plain tracer rejects (jax TracerBoolConversionError) therefore works
+under `to_static`, matching the reference's contract.
+
+Supported subset (same shape the reference's transformers handle):
+  * `if <expr>: ... [else: ...]` — variables assigned in either branch
+    must be bound on both paths (reference requires the same);
+  * `while <expr>: ...` — loop-carried variables are those assigned in
+    the body; their types/shapes must be loop-invariant.
+`for` over tensors and `break`/`continue` inside rewritten loops are not
+converted (a clear error is raised at transform time).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Callable, Set
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class _Undef:
+    """Sentinel for names not bound at the rewrite site (a branch may
+    bind them for the first time)."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+_UNDEF = _Undef()
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _jaxable(x) -> bool:
+    """True if x can ride a lax.cond/while operand (pytree of arrays /
+    scalars). Objects like Layers, modules, or _UNDEF are closure-
+    captured instead."""
+    if x is _UNDEF:
+        return False
+    leaves = jax.tree.leaves(x)
+    return all(isinstance(v, (jax.Array, np.ndarray, int, float, bool,
+                              np.generic)) for v in leaves) and \
+        not isinstance(x, (str, bytes))
+
+
+def _pt_if(pred, true_fn, false_fn, operands):
+    """Runtime dispatch for a rewritten `if` (reference: convert_ifelse,
+    `dygraph_to_static/convert_operators.py`). Non-jax operands (self,
+    modules, still-unbound names) are closure-captured; only array-like
+    operands flow through lax.cond."""
+    if not _is_traced(pred):
+        return true_fn(*operands) if bool(pred) else false_fn(*operands)
+    dyn_idx = [i for i, o in enumerate(operands) if _jaxable(o)]
+
+    def mk(fn):
+        def wrapped(*dyn):
+            full = list(operands)
+            for i, v in zip(dyn_idx, dyn):
+                full[i] = v
+            return fn(*full)
+        return wrapped
+
+    return jax.lax.cond(jnp.asarray(pred).astype(bool).reshape(()),
+                        mk(true_fn), mk(false_fn),
+                        *(operands[i] for i in dyn_idx))
+
+
+def _pt_while(cond_fn, body_fn, carry, assigned):
+    """Runtime dispatch for a rewritten `while` (reference:
+    convert_while_loop). `assigned[i]` marks carry slots the body
+    assigns; non-jax slots may only be read (loop-invariant) on the
+    traced path."""
+    probe = cond_fn(*carry)
+    if not _is_traced(probe) and not any(_is_traced(c) for c in carry):
+        while bool(cond_fn(*carry)):
+            carry = body_fn(*carry)
+        return carry
+    dyn_idx = [i for i, o in enumerate(carry) if _jaxable(o)]
+    for i, o in enumerate(carry):
+        if i not in dyn_idx and assigned[i]:
+            raise TypeError(
+                "to_static while: loop variable assigned in the body has "
+                f"a non-array value {o!r} — traced while_loop carries "
+                "must be arrays/scalars")
+
+    def full(dyn):
+        out = list(carry)
+        for i, v in zip(dyn_idx, dyn):
+            out[i] = v
+        return out
+
+    res = jax.lax.while_loop(
+        lambda d: jnp.asarray(cond_fn(*full(d))).astype(bool).reshape(()),
+        lambda d: tuple(body_fn(*full(d))[i] for i in dyn_idx),
+        tuple(carry[i] for i in dyn_idx))
+    return tuple(full(res))
+
+
+class _Names(ast.NodeVisitor):
+    def __init__(self):
+        self.stored: Set[str] = set()
+        self.loaded: Set[str] = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Store):
+            self.stored.add(node.id)
+        else:
+            self.loaded.add(node.id)
+
+    def visit_FunctionDef(self, node):  # don't descend into nested defs
+        self.stored.add(node.name)
+
+
+def _names(nodes) -> "_Names":
+    v = _Names()
+    for n in nodes:
+        v.visit(n)
+    return v
+
+
+class _Unsupported(ast.NodeVisitor):
+    def visit_Break(self, node):
+        raise NotImplementedError(
+            "to_static AST fallback: break inside a converted while is "
+            "not supported — restructure with the loop condition")
+
+    visit_Continue = visit_Break
+
+    def visit_Return(self, node):
+        raise NotImplementedError(
+            "to_static AST fallback: return inside a converted branch/"
+            "loop is not supported — assign to a variable and return "
+            "after")
+
+    # don't descend: returns inside nested function defs (incl. the
+    # branch fns generated for inner ifs) and break/continue belonging
+    # to nested explicit loops are legal
+    def visit_FunctionDef(self, node):
+        pass
+
+    def visit_While(self, node):
+        pass
+
+    def visit_For(self, node):
+        pass
+
+
+class ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites If/While into _pt_if/_pt_while calls with closure-
+    converted branch functions (the reference's ifelse/loop
+    transformers)."""
+
+    def __init__(self):
+        self._n = 0
+
+    def _fresh(self, kind):
+        self._n += 1
+        return f"__pt_{kind}_{self._n}"
+
+    # -- helpers ----------------------------------------------------------
+
+    @staticmethod
+    def _fn_def(name, argnames, body, retnames):
+        args = ast.arguments(posonlyargs=[], kwonlyargs=[], kw_defaults=[],
+                             defaults=[],
+                             args=[ast.arg(arg=a) for a in argnames])
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=r, ctx=ast.Load()) for r in retnames],
+            ctx=ast.Load()))
+        return ast.FunctionDef(name=name, args=args,
+                               body=(body or [ast.Pass()]) + [ret],
+                               decorator_list=[])
+
+    @staticmethod
+    def _guarded_reads(ins, prefix):
+        """For each input name emit
+        `try: __tmp = name / except (NameError, UnboundLocalError):
+        __tmp = __pt_undef` — a branch may bind a name for the first
+        time, so reading it at the call site must not raise."""
+        stmts, tmps = [], []
+        for k, n in enumerate(ins):
+            tmp = f"{prefix}_{k}"
+            tmps.append(tmp)
+            stmts.append(ast.Try(
+                body=[ast.Assign(
+                    targets=[ast.Name(id=tmp, ctx=ast.Store())],
+                    value=ast.Name(id=n, ctx=ast.Load()))],
+                handlers=[ast.ExceptHandler(
+                    type=ast.Tuple(
+                        elts=[ast.Name(id="NameError", ctx=ast.Load()),
+                              ast.Name(id="UnboundLocalError",
+                                       ctx=ast.Load())],
+                        ctx=ast.Load()),
+                    name=None,
+                    body=[ast.Assign(
+                        targets=[ast.Name(id=tmp, ctx=ast.Store())],
+                        value=ast.Name(id="__pt_undef",
+                                       ctx=ast.Load()))])],
+                orelse=[], finalbody=[]))
+        return stmts, tmps
+
+    # -- If ---------------------------------------------------------------
+
+    def visit_If(self, node):
+        node = self.generic_visit(node)
+        for blk in (node.body, node.orelse):
+            _Unsupported().generic_visit(ast.Module(body=blk,
+                                                    type_ignores=[]))
+        nb, no = _names(node.body), _names(node.orelse)
+        # generated helpers (__pt_*) from already-converted inner
+        # control flow are branch-local — never carried in/out
+        gen = (lambda s: {n for n in s if not n.startswith("__pt_")})
+        outs = sorted(gen(nb.stored | no.stored))
+        tv = _names([node.test])
+        ins = sorted(gen(nb.loaded | no.loaded | tv.loaded | set(outs)) -
+                     {"True", "False", "None"})
+        tname, fname = self._fresh("true"), self._fresh("false")
+        t_def = self._fn_def(tname, ins, node.body, outs)
+        f_def = self._fn_def(fname, ins, node.orelse, outs)
+        reads, tmps = self._guarded_reads(ins, self._fresh("in"))
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=o, ctx=ast.Store()) for o in outs],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Name(id="__pt_if", ctx=ast.Load()),
+                args=[node.test,
+                      ast.Name(id=tname, ctx=ast.Load()),
+                      ast.Name(id=fname, ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Name(id=t, ctx=ast.Load())
+                                      for t in tmps], ctx=ast.Load())],
+                keywords=[]))
+        if not outs:
+            call = ast.Expr(value=call.value)
+        return [t_def, f_def] + reads + [call]
+
+    # -- While ------------------------------------------------------------
+
+    def visit_While(self, node):
+        node = self.generic_visit(node)
+        _Unsupported().generic_visit(ast.Module(body=node.body,
+                                                type_ignores=[]))
+        if node.orelse:
+            raise NotImplementedError(
+                "to_static AST fallback: while/else is not supported")
+        body_n = _names(node.body)
+        test_n = _names([node.test])
+        carry = sorted(body_n.stored | test_n.loaded |
+                       (body_n.loaded & body_n.stored))
+        carry = [c for c in carry if c not in ("True", "False", "None")
+                 and not c.startswith("__pt_")]
+        cname, bname = self._fresh("cond"), self._fresh("body")
+        c_def = self._fn_def(cname, carry, [], [])
+        c_def.body = [ast.Return(value=node.test)]
+        b_def = self._fn_def(bname, carry, node.body, carry)
+        reads, tmps = self._guarded_reads(carry, self._fresh("in"))
+        assigned = [ast.Constant(value=bool(c in body_n.stored))
+                    for c in carry]
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=c, ctx=ast.Store()) for c in carry],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Name(id="__pt_while", ctx=ast.Load()),
+                args=[ast.Name(id=cname, ctx=ast.Load()),
+                      ast.Name(id=bname, ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Name(id=t, ctx=ast.Load())
+                                      for t in tmps], ctx=ast.Load()),
+                      ast.Tuple(elts=assigned, ctx=ast.Load())],
+                keywords=[]))
+        return [c_def, b_def] + reads + [call]
+
+
+@functools.lru_cache(maxsize=256)
+def _convert(func: Callable) -> Callable:
+    """AST-convert `func`'s control flow; returns the rewritten function
+    (reference: `program_translator.py convert_to_static` cache)."""
+    try:
+        src = textwrap.dedent(inspect.getsource(func))
+    except (OSError, TypeError) as e:
+        raise NotImplementedError(
+            f"to_static AST fallback needs source for {func!r}") from e
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    # drop decorators (e.g. @to_static) — we're already inside the wrapper
+    fdef.decorator_list = []
+    new = ControlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(new)
+    code = compile(new, filename=f"<dy2static {func.__name__}>",
+                   mode="exec")
+    glb = dict(func.__globals__)
+    glb["__pt_if"] = _pt_if
+    glb["__pt_while"] = _pt_while
+    glb["__pt_undef"] = _UNDEF
+    if func.__closure__:
+        for name, cell in zip(func.__code__.co_freevars, func.__closure__):
+            glb.setdefault(name, cell.cell_contents)
+    loc: dict = {}
+    exec(code, glb, loc)
+    out = loc[fdef.name]
+    if func.__defaults__:
+        out.__defaults__ = func.__defaults__
+    return out
+
+
+def convert_control_flow(func: Callable) -> Callable:
+    """Public entry: return a twin of `func` whose Python `if`/`while`
+    dispatch to lax.cond/lax.while_loop when conditions are traced.
+    Bound methods stay bound."""
+    if inspect.ismethod(func):
+        import types
+        return types.MethodType(_convert(func.__func__), func.__self__)
+    return _convert(func)

@@ -117,6 +117,10 @@ class GPTDecoderLayer(Layer):
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         attn = F.scaled_dot_product_attention(q, k, v, is_causal=True,
                                               training=self.training)
+        # named so the "dots_attn" remat policy can SAVE it (skips the
+        # flash-kernel forward replay in the backward pass)
+        from jax.ad_checkpoint import checkpoint_name
+        attn = checkpoint_name(attn, "attn_out")
         attn = jnp.reshape(attn, (b, s, d))
         x = res + self.dropout(self.out_proj(attn)).astype(dt)
         res = x
@@ -298,6 +302,12 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
         # batch dims) — the VERDICT r2 lever: full per-block checkpoint
         # alone cost ~25% of achievable MFU
         ckpt_policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    elif remat_policy == "dots_attn":
+        # dots + the named attention output: +16MB/layer of residency
+        # buys skipping the flash-forward replay in the backward
+        ckpt_policy = jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names("attn_out"))
     else:
         raise ValueError(f"unknown remat_policy {remat_policy!r}")
 

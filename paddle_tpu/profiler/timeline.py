@@ -32,10 +32,11 @@ def merge_timelines(paths: Sequence[str], out_path: str,
     """
     merged: List[dict] = []
     offsets: Dict[int, float] = {}
+    loaded = {p: _load(p) for p in paths}   # parse each trace ONCE
     if align_marker:
         starts = {}
         for rank, p in enumerate(paths):
-            for ev in _load(p):
+            for ev in loaded[p]:
                 if ev.get("name") == align_marker and "ts" in ev:
                     starts[rank] = min(starts.get(rank, float("inf")),
                                        ev["ts"])
@@ -46,7 +47,7 @@ def merge_timelines(paths: Sequence[str], out_path: str,
         merged.append({"name": "process_name", "ph": "M", "pid": rank,
                        "args": {"name": f"rank {rank} "
                                         f"({os.path.basename(p)})"}})
-        for ev in _load(p):
+        for ev in loaded[p]:
             ev = dict(ev)
             ev["pid"] = rank
             if "ts" in ev:

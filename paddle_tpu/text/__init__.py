@@ -36,15 +36,58 @@ class _SyntheticSeqDataset(Dataset):
 
 
 class Imdb(_SyntheticSeqDataset):
-    """Reference: text/datasets/imdb.py — sentiment, binary labels."""
+    """Reference: text/datasets/imdb.py — sentiment, binary labels.
+    Parses the real aclImdb archive when present/downloadable (same
+    tokenize + frequency-cutoff vocab as the reference); synthetic
+    corpus offline."""
+
+    URL = "https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz"
 
     def __init__(self, data_file=None, mode="train", cutoff=150,
                  download=True):
+        if data_file is None and download:
+            try:
+                from ..utils.download import get_path_from_url
+                data_file = get_path_from_url(self.URL)
+            except Exception:
+                pass
+        if data_file and os.path.exists(data_file):
+            self._load_real(data_file, mode, cutoff)
+            return
         super().__init__(n=2000 if mode == "train" else 400,
                          vocab_size=5147, seq_range=(20, 200),
                          num_classes=2,
                          seed=10 if mode == "train" else 11)
         self.word_idx = {f"w{i}": i for i in range(self.vocab_size)}
+
+    def _load_real(self, path, mode, cutoff):
+        import collections
+        import re
+        pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+        tok = re.compile(r"[A-Za-z]+")
+        texts, labels = [], []
+        freq = collections.Counter()
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                g = pat.match(m.name)
+                if not g:
+                    continue
+                words = tok.findall(
+                    tf.extractfile(m).read().decode("latin1").lower())
+                # vocabulary spans BOTH splits (reference `imdb.py
+                # word_dict` builds one dict over train+test) so train
+                # and test agree on ids; docs come from the asked split
+                freq.update(words)
+                if g.group(1) == mode:
+                    texts.append(words)
+                    labels.append(0 if g.group(2) == "pos" else 1)
+        kept = [w for w, c in freq.most_common() if c >= cutoff]
+        self.word_idx = {w: i for i, w in enumerate(kept)}
+        self.word_idx["<unk>"] = unk = len(kept)
+        self.vocab_size = unk + 1
+        self.docs = [np.asarray([self.word_idx.get(w, unk) for w in t],
+                                np.int64) for t in texts]
+        self.labels = labels
 
 
 class Imikolov(Dataset):
@@ -69,9 +112,29 @@ class Imikolov(Dataset):
 
 
 class UCIHousing(Dataset):
-    """Reference: text/datasets/uci_housing.py — 13-feature regression."""
+    """Reference: text/datasets/uci_housing.py — 13-feature regression.
+    Parses the real housing.data (feature-normalized, 80/20 split like
+    the reference) when present/downloadable; synthetic offline."""
+
+    URL = "https://paddlemodels.bj.bcebos.com/uci_housing/housing.data"
 
     def __init__(self, data_file=None, mode="train", download=True):
+        if data_file is None and download:
+            try:
+                from ..utils.download import get_path_from_url
+                data_file = get_path_from_url(self.URL)
+            except Exception:
+                pass
+        if data_file and os.path.exists(data_file):
+            raw = np.loadtxt(data_file).astype(np.float32)
+            feats = raw[:, :13]
+            feats = (feats - feats.mean(0)) / np.maximum(feats.std(0),
+                                                         1e-6)
+            split = int(len(raw) * 0.8)
+            sl = slice(0, split) if mode == "train" else slice(split, None)
+            self.x = feats[sl]
+            self.y = raw[sl, 13:14]
+            return
         rs = np.random.RandomState(14)
         n = 404 if mode == "train" else 102
         self.x = rs.randn(n, 13).astype(np.float32)

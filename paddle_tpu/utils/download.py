@@ -60,17 +60,26 @@ def _download(url: str, root_dir: str, md5sum: str | None = None,
                        f"tries: {url} ({last})")
 
 
+def _top_dir(names, dst):
+    """Extracted location: the archive's single top-level entry when it
+    has one (the common dataset layout), else the extraction root."""
+    tops = {n.split("/")[0] for n in names if n and not n.startswith("/")}
+    if len(tops) == 1:
+        return os.path.join(dst, next(iter(tops)))
+    return dst
+
+
 def _decompress(fname: str) -> str:
     if tarfile.is_tarfile(fname):
         dst = os.path.dirname(fname)
         with tarfile.open(fname) as tf:
             tf.extractall(dst, filter="data")
-        return dst
+            return _top_dir(tf.getnames(), dst)
     if zipfile.is_zipfile(fname):
         dst = os.path.dirname(fname)
         with zipfile.ZipFile(fname) as zf:
             zf.extractall(dst)
-        return dst
+            return _top_dir(zf.namelist(), dst)
     return fname
 
 

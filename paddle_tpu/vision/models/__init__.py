@@ -22,3 +22,4 @@ from .yolo import (  # noqa: F401
     yolo_loss,
     yolov3_darknet53,
 )
+from .ocr import CRNN, crnn_ocr  # noqa: F401

@@ -1,0 +1,97 @@
+"""CRNN text recognizer (PP-OCR-class, BASELINE config 4 family).
+
+Reference mapping (core repo ops the model is assembled from):
+  * `warpctc_op` — CTC loss (`paddle_tpu.nn.functional.ctc_loss`'s
+    reference);
+  * conv/pool/BN op families (`operators/conv_op.cc`, `pool_op.cc`);
+  * cuDNN LSTM (`operators/rnn_op.h`) — here `nn.LSTM` over lax.scan.
+
+Architecture (CRNN, the recognition half of PP-OCRv2's det+rec pipeline):
+conv backbone downsampling height to 1 → per-column sequence features →
+bidirectional LSTM encoder → per-timestep class logits trained with CTC.
+TPU-first: fixed input height (32), static sequence length = W/4, dense
+batched everything — no dynamic shapes anywhere.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...nn import functional as F
+from ...nn.layer import Layer
+from ...nn.layer_common import Linear
+from ...nn.layer_conv_norm import BatchNorm2D, Conv2D, MaxPool2D
+from ...nn.layer_rnn import LSTM
+
+
+class _ConvBN(Layer):
+    def __init__(self, cin, cout, k=3, stride=1, padding=1):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, k, stride=stride, padding=padding,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(cout)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+class CRNN(Layer):
+    """forward(img [B, C, 32, W]) -> log-probs [T=W/4, B, num_classes]
+    (time-major, ready for `F.ctc_loss`)."""
+
+    def __init__(self, num_classes: int, in_channels: int = 3,
+                 hidden_size: int = 96):
+        super().__init__()
+        self.num_classes = num_classes
+        self.backbone = [
+            _ConvBN(in_channels, 32), MaxPool2D(2, 2),      # 32xW -> 16xW/2
+            _ConvBN(32, 64), MaxPool2D(2, 2),               # -> 8 x W/4
+            _ConvBN(64, 128),
+            _ConvBN(128, 128), MaxPool2D((8, 1), (8, 1)),   # -> 1 x W/4
+        ]
+        for i, m in enumerate(self.backbone):
+            setattr(self, f"b{i}", m)
+        self.encoder = LSTM(128, hidden_size, num_layers=2,
+                            direction="bidirect", time_major=True)
+        self.head = Linear(2 * hidden_size, num_classes)
+
+    def forward(self, x):
+        for i in range(len(self.backbone)):
+            x = getattr(self, f"b{i}")(x)
+        # [B, C, 1, T] -> [T, B, C]
+        feat = jnp.transpose(x[:, :, 0, :], (2, 0, 1))
+        enc, _ = self.encoder(feat)
+        logits = self.head(enc)                     # [T, B, num_classes]
+        return jax.nn.log_softmax(logits, axis=-1)
+
+    def loss(self, log_probs, labels, label_lengths, blank=None):
+        """CTC loss over the full static time axis (reference:
+        warpctc_op). blank defaults to num_classes - 1 (PP-OCR keeps
+        blank last)."""
+        T, B, _ = log_probs.shape
+        blank = self.num_classes - 1 if blank is None else blank
+        return F.ctc_loss(log_probs, labels,
+                          jnp.full((B,), T, jnp.int32),
+                          jnp.asarray(label_lengths, jnp.int32),
+                          blank=blank)
+
+    def decode_greedy(self, log_probs, blank=None):
+        """Best-path CTC decode: argmax per step, collapse repeats, drop
+        blanks. Returns [B, T] padded with -1 (dense, XLA-friendly)."""
+        blank = self.num_classes - 1 if blank is None else blank
+        ids = jnp.argmax(log_probs, axis=-1).T          # [B, T]
+        prev = jnp.concatenate(
+            [jnp.full((ids.shape[0], 1), -1, ids.dtype), ids[:, :-1]], 1)
+        keep = (ids != blank) & (ids != prev)
+        T = ids.shape[1]
+        # stable left-pack of kept ids
+        order = jnp.argsort(jnp.where(keep, 0, 1) * (T + 1) +
+                            jnp.arange(T)[None, :], axis=1)
+        packed = jnp.take_along_axis(jnp.where(keep, ids, -1), order,
+                                     axis=1)
+        return packed
+
+
+def crnn_ocr(num_classes: int = 6625, **kw) -> CRNN:
+    """PP-OCR-class recognizer factory (default vocab ≈ ppocr keys)."""
+    return CRNN(num_classes=num_classes, **kw)

@@ -1,0 +1,77 @@
+"""Per-rank runner for the heterogeneous split-training test.
+
+Rank 0 = accelerator owner: hosts the jitted dense step as a heter
+service AND trains its own batches. Rank 1 = CPU heter worker: pulls
+embedding rows, RPCs the dense step to rank 0, pushes row grads. The
+parent test asserts the 2-rank heter run's loss trajectory decreases and
+the embedding table stays consistent (reference: heterxpu_trainer.cc
+split dataflow).
+"""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu.distributed.ps import (init_table_service,  # noqa: E402
+                                       shutdown_table_service)
+from paddle_tpu.distributed.ps.heter import (HeterServer,  # noqa: E402
+                                             HeterWorker)
+
+VOCAB, DIM, B, STEPS = 32, 8, 8, 6
+LR = 0.2
+
+
+def make_dense_step():
+    import jax.numpy as jnp
+
+    w = np.random.RandomState(1).randn(DIM).astype(np.float32) * 0.1
+    state = {"w": jnp.asarray(w)}
+
+    @jax.jit
+    def fwd(w, rows, labels):
+        def loss_fn(w, rows):
+            pred = rows @ w
+            return jnp.mean((pred - labels) ** 2)
+        loss, (gw, grows) = jax.value_and_grad(
+            lambda w, r: loss_fn(w, r), argnums=(0, 1))(w, rows)
+        return loss, gw, grows
+
+    def step(rows, labels):
+        loss, gw, grows = fwd(state["w"], jnp.asarray(rows),
+                              jnp.asarray(labels))
+        state["w"] = state["w"] - LR * gw
+        return np.float32(loss), np.asarray(grows, np.float32)
+
+    return step
+
+
+def main():
+    out_path = sys.argv[1]
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    svc = init_table_service()
+    table = svc.register("emb", VOCAB, DIM, lr=LR, seed=7)
+    rs = np.random.RandomState(100 + rank)
+    ids = rs.randint(0, VOCAB, (STEPS, B)).astype(np.int64)
+    labels = rs.randn(STEPS, B).astype(np.float32)
+
+    if rank == 0:
+        HeterServer(svc, make_dense_step())
+        worker = HeterWorker(svc, table, device_rank=0)
+    else:
+        worker = HeterWorker(svc, table, device_rank=0)
+    svc.barrier("heter_up")
+
+    losses = [worker.train_batch(ids[t], labels[t]) for t in range(STEPS)]
+    svc.barrier("heter_done")
+    with open(f"{out_path}.{rank}.json", "w") as f:
+        json.dump(losses, f)
+    shutdown_table_service()
+
+
+if __name__ == "__main__":
+    main()

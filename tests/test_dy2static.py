@@ -1,0 +1,146 @@
+"""dy2static AST fallback (VERDICT r2 P21 gap): Python if/while on
+traced values under @to_static. Reference bars:
+`dygraph_to_static/ifelse_transformer.py`, `loop_transformer.py`,
+`program_translator.py`.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.jit import to_static
+from paddle_tpu.jit.dy2static import convert_control_flow
+
+
+class TestConvertIf:
+    def test_if_else_on_traced_scalar(self):
+        @to_static
+        def f(x):
+            if jnp.sum(x) > 0:
+                y = x * 2.0
+            else:
+                y = x - 1.0
+            return y
+
+        a = jnp.ones((3,))
+        np.testing.assert_allclose(np.asarray(f(a)), 2 * np.ones(3))
+        np.testing.assert_allclose(np.asarray(f(-a)), -2 * np.ones(3))
+
+    def test_if_without_else_keeps_prior_binding(self):
+        @to_static
+        def f(x):
+            y = x + 1.0
+            if x[0] > 10.0:
+                y = x * 100.0
+            return y
+
+        np.testing.assert_allclose(np.asarray(f(jnp.asarray([1.0]))),
+                                   [2.0])
+        np.testing.assert_allclose(np.asarray(f(jnp.asarray([11.0]))),
+                                   [1100.0])
+
+    def test_nested_if(self):
+        @to_static
+        def f(x):
+            if x[0] > 0:
+                if x[1] > 0:
+                    r = x.sum()
+                else:
+                    r = x[0]
+            else:
+                r = jnp.zeros(())
+            return r
+
+        assert float(f(jnp.asarray([1.0, 1.0]))) == 2.0
+        assert float(f(jnp.asarray([1.0, -1.0]))) == 1.0
+        assert float(f(jnp.asarray([-1.0, 5.0]))) == 0.0
+
+    def test_concrete_condition_stays_python(self):
+        calls = []
+
+        def g(x, flag):
+            if flag:             # concrete bool — no lax.cond
+                calls.append(1)
+                y = x + 1
+            else:
+                y = x - 1
+            return y
+
+        conv = convert_control_flow(g)
+        assert float(conv(jnp.zeros(()), True)) == 1.0
+        assert float(conv(jnp.zeros(()), False)) == -1.0
+        assert calls == [1]   # side effect ran exactly once (python path)
+
+
+class TestConvertWhile:
+    def test_while_on_traced_value(self):
+        @to_static
+        def f(x):
+            i = jnp.zeros((), jnp.int32)
+            while i < 5:
+                x = x * 2.0
+                i = i + 1
+            return x
+
+        assert float(f(jnp.ones(()))) == 32.0
+
+    def test_while_collatz_steps(self):
+        @to_static
+        def steps(n):
+            c = jnp.zeros((), jnp.int32)
+            while n != 1:
+                n = jnp.where(n % 2 == 0, n // 2, 3 * n + 1)
+                c = c + 1
+            return c
+
+        assert int(steps(jnp.asarray(6, jnp.int32))) == 8
+
+    def test_break_raises_clear_error(self):
+        def f(x):
+            while x[0] > 0:
+                break
+            return x
+
+        with pytest.raises(NotImplementedError, match="break"):
+            convert_control_flow(f)(jnp.ones((1,)))
+
+
+class TestLayerForward:
+    def test_layer_with_data_dependent_branch(self):
+        class Net(pt.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = pt.nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.lin(x)
+                if jnp.mean(h) > 0:
+                    out = h * 2.0
+                else:
+                    out = -h
+                return out
+
+        net = to_static(Net())
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 4), jnp.float32)
+        out = net(x)
+        assert out.shape == (2, 4)
+        # both paths reachable and consistent with eager recompute
+        h = x @ jnp.asarray(net.lin.weight) + jnp.asarray(net.lin.bias)
+        ref = h * 2.0 if float(jnp.mean(h)) > 0 else -h
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5)
+
+    def test_grad_flows_through_converted_branch(self):
+        def f(x):
+            if jnp.sum(x) > 0:
+                y = (x ** 2).sum()
+            else:
+                y = (x ** 3).sum()
+            return y
+
+        conv = convert_control_flow(f)
+        g = jax.grad(lambda x: conv(x))(jnp.asarray([2.0]))
+        np.testing.assert_allclose(np.asarray(g), [4.0])
+        g2 = jax.grad(lambda x: conv(x))(jnp.asarray([-2.0]))
+        np.testing.assert_allclose(np.asarray(g2), [12.0])

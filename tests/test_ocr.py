@@ -1,0 +1,63 @@
+"""CRNN/PP-OCR-class recognizer (BASELINE config 4 family).
+Reference bars: warpctc_op (CTC), rnn_op (LSTM), conv/pool families."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.vision.models import CRNN
+
+
+def _model(nc=12):
+    pt.seed(0)
+    return CRNN(num_classes=nc, in_channels=1, hidden_size=32)
+
+
+class TestCRNN:
+    def test_forward_shapes_time_major(self):
+        net = _model()
+        net.eval()
+        x = jnp.zeros((2, 1, 32, 64), jnp.float32)
+        lp = net(x)
+        assert lp.shape == (16, 2, 12)         # T = W/4
+        # log-probs: rows sum to 1 in prob space
+        np.testing.assert_allclose(
+            np.asarray(jnp.exp(lp).sum(-1)), np.ones((16, 2)), rtol=1e-4)
+
+    def test_ctc_loss_finite_and_trains(self):
+        from paddle_tpu.nn.layer import functional_call, trainable_state
+        net = _model()
+        net.train()
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(2, 1, 32, 64), jnp.float32)
+        labels = jnp.asarray(rs.randint(0, 11, (2, 5)), jnp.int32)
+        lens = jnp.asarray([5, 3], jnp.int32)
+        params = trainable_state(net)
+        opt = pt.optimizer.Adam(learning_rate=2e-3)
+        state = opt.init_state(params)
+
+        def loss_fn(p):
+            lp, _ = functional_call(net, p, x)
+            return net.loss(lp, labels, lens)
+
+        @jax.jit
+        def step(p, s):
+            l, g = jax.value_and_grad(loss_fn)(p)
+            p2, s2 = opt.apply(p, g, s)
+            return p2, s2, l
+
+        params, state, l0 = step(params, state)
+        for _ in range(15):
+            params, state, loss = step(params, state)
+        assert np.isfinite(float(l0))
+        assert float(loss) < 0.8 * float(l0), (float(l0), float(loss))
+
+    def test_greedy_decode_collapses_repeats_and_blanks(self):
+        net = _model(nc=5)   # blank = 4
+        T, B, C = 6, 1, 5
+        lp = jnp.full((T, B, C), -10.0)
+        # path: 1 1 blank 2 2 3  -> decoded [1, 2, 3]
+        path = [1, 1, 4, 2, 2, 3]
+        lp = lp.at[jnp.arange(T), 0, jnp.asarray(path)].set(0.0)
+        out = np.asarray(net.decode_greedy(lp))[0]
+        assert [v for v in out.tolist() if v >= 0] == [1, 2, 3]
