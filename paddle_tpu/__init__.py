@@ -65,7 +65,9 @@ from . import utils  # noqa: F401
 from . import incubate  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import quantization  # noqa: F401
+from . import ir  # noqa: F401
 from .autograd import grad, no_grad, value_and_grad  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 from .hapi.model import Model  # noqa: F401
+from .hapi.dynamic_flops import flops  # noqa: F401
 from .nn.layer import Layer, Parameter  # noqa: F401

@@ -1,0 +1,118 @@
+"""Mixture-of-Experts with expert parallelism (beyond-reference).
+
+The reference snapshot has NO MoE layers (SURVEY §2.3: expert parallel ✗;
+its only hook is the `alltoall` collective, `operators/collective/
+alltoall_op.cc`). This module adds the capability TPU-first, GShard
+style: expert weights carry a PartitionSpec over an expert axis and
+token dispatch/combine are einsums against a capacity-bounded dispatch
+mask — under GSPMD those einsums lower to exactly the all-to-all the
+reference would have hand-written.
+
+Gating follows GShard top-2: top-1 expert + probabilistic second expert,
+position-in-expert capacity enforcement via cumsum (tokens over capacity
+are dropped — dense shapes, no sorting, XLA-friendly).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer import Layer
+from .mp_layers import _constrain
+
+
+def top2_gating(logits, capacity: int):
+    """GShard top-2 gating. logits [g, s, e] fp32 →
+    (dispatch [g, s, e, c] bool-ish, combine [g, s, e, c] fp32, aux)."""
+    g, s, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    # top-1
+    idx1 = jnp.argmax(probs, axis=-1)                      # [g, s]
+    mask1 = jax.nn.one_hot(idx1, e, dtype=probs.dtype)
+    # top-2: mask out the winner, argmax again
+    probs2 = probs * (1.0 - mask1)
+    idx2 = jnp.argmax(probs2, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, e, dtype=probs.dtype)
+    # load-balancing auxiliary loss (GShard eq. 4 / Switch aux)
+    density = jnp.mean(mask1, axis=1)                      # [g, e]
+    density_proxy = jnp.mean(probs, axis=1)
+    aux = jnp.mean(density * density_proxy) * (e * e)
+    # capacity positions (top-1 tokens first, then top-2)
+    pos1 = jnp.cumsum(mask1, axis=1) * mask1               # 1-based
+    pos2 = (jnp.cumsum(mask2, axis=1) +
+            jnp.sum(mask1, axis=1, keepdims=True)) * mask2
+    keep1 = mask1 * (pos1 <= capacity)
+    keep2 = mask2 * (pos2 <= capacity)
+    w1 = jnp.sum(probs * keep1, axis=-1)                   # [g, s]
+    w2 = jnp.sum(probs * keep2, axis=-1)
+    denom = jnp.maximum(w1 + w2, 1e-9)
+    w1, w2 = w1 / denom, w2 / denom
+
+    def to_cap(keep, pos, w):
+        # [g, s, e] one-hot rows at capacity slot pos-1 → [g, s, e, c]
+        slot = jax.nn.one_hot((pos - 1.0) * keep, capacity,
+                              dtype=keep.dtype) * keep[..., None]
+        return slot * w[..., None, None]
+
+    combine = to_cap(keep1, pos1, w1) + to_cap(keep2, pos2, w2)
+    dispatch = (combine > 0.0).astype(logits.dtype)
+    return dispatch, combine, aux
+
+
+class MoEMLP(Layer):
+    """Expert-parallel FFN block: gate → dispatch → per-expert MLP →
+    combine. Expert weights are sharded over `expert_axis` (defaults to
+    the 'model' mesh axis — expert parallelism rides the TP axis the way
+    alltoall-based MoE rides NCCL groups)."""
+
+    def __init__(self, d_model: int, d_ff: int, num_experts: int,
+                 capacity_factor: float = 1.25,
+                 expert_axis: str = "model", compute_dtype=None):
+        super().__init__()
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        init = I.Normal(0.0, 0.02)
+        self.gate_weight = self.create_parameter(
+            (d_model, num_experts), default_initializer=init)
+        self.w1 = self.create_parameter((num_experts, d_model, d_ff),
+                                        default_initializer=init)
+        self.w2 = self.create_parameter((num_experts, d_ff, d_model),
+                                        default_initializer=init)
+        self.w1.sharding_spec = P(expert_axis, None, None)
+        self.w2.sharding_spec = P(expert_axis, None, None)
+        self._axis = expert_axis
+        self._cdt = compute_dtype
+        # aux loss rides a BUFFER so it survives functional_call/jit
+        # (a plain attribute would hold a leaked tracer); jitted steps
+        # read it from the returned new_buffers, eager from .value
+        self.register_buffer("aux_loss", jnp.zeros((), jnp.float32))
+
+    def forward(self, x):
+        b, s, d = x.shape
+        e = self.num_experts
+        cap = max(1, int(self.capacity_factor * s * 2 / e))
+        xf = x.astype(jnp.float32)
+        logits = xf @ jnp.asarray(self.gate_weight).astype(jnp.float32)
+        dispatch, combine, aux = top2_gating(logits, cap)
+        self.aux_loss.value = aux
+        dt = self._cdt or x.dtype
+        # dispatch: [b,s,d] x [b,s,e,c] -> [e,b,c,d] — under GSPMD with
+        # tokens sharded on 'data' and experts on the expert axis this
+        # IS the all-to-all (`alltoall_op.cc` equivalent)
+        xin = jnp.einsum("bsd,bsec->ebcd", x.astype(dt),
+                         dispatch.astype(dt))
+        xin = _constrain(xin, self._axis, None, None, None)
+        w1 = jnp.asarray(self.w1).astype(dt)
+        w2 = jnp.asarray(self.w2).astype(dt)
+        h = jnp.einsum("ebcd,edf->ebcf", xin, w1)
+        h = F.gelu(h, approximate=True)
+        out = jnp.einsum("ebcf,efd->ebcd", h, w2)
+        out = _constrain(out, self._axis, None, None, None)
+        y = jnp.einsum("ebcd,bsec->bsd", out.astype(jnp.float32),
+                       combine)
+        return y.astype(x.dtype)

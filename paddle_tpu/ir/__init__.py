@@ -1,0 +1,195 @@
+"""Op-level IR + pass framework.
+
+Reference mapping:
+  * ProgramDesc/BlockDesc/OpDesc (`framework/framework.proto:43-207`) —
+    the serialized op-level program;
+  * `framework/ir/` Pass framework + GraphPatternDetector
+    (`ir/graph_pattern_detector.cc`, 72+ passes).
+
+TPU-native: the op-level program IS the jaxpr — typed, SSA, already the
+form every jax transform manipulates. `Program` wraps a ClosedJaxpr with
+a Paddle-flavored surface: `ops()` lists OpDesc-like views,
+`find_pattern` is the GraphPatternDetector, passes are functions from
+eqn-list to eqn-list registered in a `PassRegistry`, and the result
+compiles straight back through XLA (`to_callable`). Serialization rides
+StableHLO (`jit.save`), the same artifact the inference engine loads —
+unlike the reference there is no second proto format to keep in sync.
+
+Most reference passes (fusion, memory reuse, layout) are subsumed by
+XLA; the infra here exists for the passes XLA can NOT see: framework-
+level rewrites like dropout removal for inference, collective
+annotation, quant/dequant insertion, or DCE after head-pruning.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence
+
+import jax
+
+
+class OpView:
+    """OpDesc-like read view of one jaxpr eqn (reference:
+    `framework.proto:43` OpDesc {type, inputs, outputs, attrs})."""
+
+    def __init__(self, eqn):
+        self._eqn = eqn
+
+    @property
+    def type(self) -> str:
+        return self._eqn.primitive.name
+
+    @property
+    def inputs(self) -> List[str]:
+        return [str(v) for v in self._eqn.invars]
+
+    @property
+    def outputs(self) -> List[str]:
+        return [str(v) for v in self._eqn.outvars]
+
+    @property
+    def attrs(self) -> dict:
+        return dict(self._eqn.params)
+
+    def __repr__(self):
+        return (f"OpView({self.type}: {', '.join(self.inputs)} -> "
+                f"{', '.join(self.outputs)})")
+
+
+class Program:
+    """A captured op-level program (reference: ProgramDesc)."""
+
+    def __init__(self, closed_jaxpr):
+        self.closed = closed_jaxpr
+
+    # -- capture ----------------------------------------------------------
+
+    @classmethod
+    def capture(cls, fn: Callable, *example_args, **example_kwargs):
+        """Trace `fn` into a Program (reference: Program construction via
+        `program_guard` + append_op; here one jax trace)."""
+        closed = jax.make_jaxpr(fn)(*example_args, **example_kwargs)
+        return cls(closed)
+
+    # -- inspection -------------------------------------------------------
+
+    def ops(self) -> List[OpView]:
+        return [OpView(e) for e in self.closed.jaxpr.eqns]
+
+    def op_types(self) -> List[str]:
+        return [o.type for o in self.ops()]
+
+    def find_pattern(self, pattern: Sequence[str]) -> List[List[OpView]]:
+        """GraphPatternDetector-lite: consecutive def-use chains whose
+        primitive names match `pattern` (each op's output feeds the
+        next)."""
+        eqns = self.closed.jaxpr.eqns
+        hits = []
+        for i, e in enumerate(eqns):
+            if e.primitive.name != pattern[0]:
+                continue
+            chain = [e]
+            for want in pattern[1:]:
+                nxt = None
+                outs = set(map(id, chain[-1].outvars))
+                for e2 in eqns[i + 1:]:
+                    if e2.primitive.name == want and \
+                            any(id(v) in outs for v in e2.invars):
+                        nxt = e2
+                        break
+                if nxt is None:
+                    break
+                chain.append(nxt)
+            if len(chain) == len(pattern):
+                hits.append([OpView(e) for e in chain])
+        return hits
+
+    # -- passes -----------------------------------------------------------
+
+    def apply_pass(self, name_or_fn) -> "Program":
+        """Run a registered pass (or a callable eqns->eqns) and return a
+        NEW Program (reference: `ir/pass.h` Pass::Apply)."""
+        fn = PassRegistry.get(name_or_fn) if isinstance(name_or_fn, str) \
+            else name_or_fn
+        jaxpr = self.closed.jaxpr
+        new_eqns = fn(list(jaxpr.eqns), jaxpr)
+        new_jaxpr = jaxpr.replace(eqns=new_eqns)
+        return Program(self.closed.replace(jaxpr=new_jaxpr))
+
+    # -- execution / export ----------------------------------------------
+
+    def to_callable(self) -> Callable:
+        closed = self.closed
+
+        def run(*args):
+            flat = jax.tree.leaves(args)
+            out = jax.core.eval_jaxpr(closed.jaxpr, closed.consts, *flat)
+            return out[0] if len(out) == 1 else tuple(out)
+        return run
+
+    def __call__(self, *args):
+        return self.to_callable()(*args)
+
+    def __repr__(self):
+        return f"Program({len(self.closed.jaxpr.eqns)} ops)"
+
+    def __str__(self):
+        return str(self.closed)
+
+
+class PassRegistry:
+    """Reference: `ir/pass.h` PassRegistry + REGISTER_PASS."""
+
+    _passes: Dict[str, Callable] = {}
+
+    @classmethod
+    def register(cls, name: str):
+        def deco(fn):
+            cls._passes[name] = fn
+            return fn
+        return deco
+
+    @classmethod
+    def get(cls, name: str) -> Callable:
+        if name not in cls._passes:
+            raise KeyError(f"unknown pass {name!r}; registered: "
+                           f"{sorted(cls._passes)}")
+        return cls._passes[name]
+
+    @classmethod
+    def list(cls) -> List[str]:
+        return sorted(cls._passes)
+
+
+# --------------------------------------------------------------------------
+# Built-in passes
+# --------------------------------------------------------------------------
+
+@PassRegistry.register("dead_code_elimination")
+def dead_code_elimination(eqns, jaxpr):
+    """Drop eqns none of whose outputs are used (reference:
+    `ir/memory_optimize_pass/eager_deletion_pass.cc` spirit; here a
+    classic backward liveness sweep)."""
+    from jax.extend.core import Literal
+    live = {id(v) for v in jaxpr.outvars}
+    kept = []
+    for e in reversed(eqns):
+        used = any(id(v) in live for v in e.outvars)
+        # keep possibly-effectful primitives conservatively
+        effectful = bool(getattr(e, "effects", ()))
+        if used or effectful:
+            kept.append(e)
+            for v in e.invars:
+                if not isinstance(v, Literal):
+                    live.add(id(v))
+    return list(reversed(kept))
+
+
+@PassRegistry.register("op_stats")
+def op_stats(eqns, jaxpr):
+    """Identity pass that prints an op histogram (reference:
+    `graph_viz_pass` class of diagnostics)."""
+    import collections
+    hist = collections.Counter(e.primitive.name for e in eqns)
+    for name, n in hist.most_common():
+        print(f"{name:24s} {n}")
+    return eqns

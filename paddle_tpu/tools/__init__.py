@@ -1,0 +1,2 @@
+"""Developer tooling (reference: `tools/` — op benchmark harness + CI
+regression gates, timeline utilities)."""

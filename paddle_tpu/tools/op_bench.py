@@ -1,0 +1,152 @@
+"""Op micro-benchmark harness + regression gate.
+
+Reference: `paddle/fluid/operators/benchmark/op_tester.cc` (single-op
+latency from config) and the CI gate `tools/test_op_benchmark.sh` +
+`tools/check_op_benchmark_result.py` (compare against a stored baseline,
+fail the build on regression).
+
+Timing follows the tunnel-safe protocol (bench.py): each timed region
+ends with a host transfer; per-call overhead is amortized over ITERS
+calls per measurement.
+
+CLI:
+  python -m paddle_tpu.tools.op_bench --out ops.json [--ops matmul,...]
+  python -m paddle_tpu.tools.op_bench --compare baseline.json \
+      [--tolerance 0.15]          # exit 1 when an op got slower
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+ITERS = 30
+
+
+def _standard_ops() -> Dict[str, Callable]:
+    """Benchmark set: one representative config per hot op family
+    (reference: configs under operators/benchmark)."""
+    import jax
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(0)
+
+    def matmul():
+        a = jnp.asarray(rs.randn(1024, 1024), jnp.bfloat16)
+        return (lambda: a @ a)
+
+    def conv2d():
+        from ..nn import functional as F
+        x = jnp.asarray(rs.randn(8, 64, 56, 56), jnp.float32)
+        w = jnp.asarray(rs.randn(64, 64, 3, 3), jnp.float32)
+        return (lambda: F.conv2d(x, w, padding=1))
+
+    def softmax():
+        x = jnp.asarray(rs.randn(64, 4096), jnp.float32)
+        return (lambda: jax.nn.softmax(x, axis=-1))
+
+    def layer_norm():
+        from ..nn import functional as F
+        x = jnp.asarray(rs.randn(64, 1024), jnp.float32)
+        g = jnp.ones((1024,), jnp.float32)
+        b = jnp.zeros((1024,), jnp.float32)
+        return (lambda: F.layer_norm(x, (1024,), g, b))
+
+    def attention():
+        from ..nn import functional as F
+        q = jnp.asarray(rs.randn(4, 512, 8, 64), jnp.bfloat16)
+        return (lambda: F.scaled_dot_product_attention(q, q, q,
+                                                       is_causal=True))
+
+    def embedding():
+        from ..nn import functional as F
+        w = jnp.asarray(rs.randn(30000, 256), jnp.float32)
+        ids = jnp.asarray(rs.randint(0, 30000, (64, 128)), jnp.int32)
+        return (lambda: F.embedding(ids, w))
+
+    def reduce_sum():
+        x = jnp.asarray(rs.randn(4096, 1024), jnp.float32)
+        return (lambda: jnp.sum(x, axis=-1))
+
+    return {"matmul": matmul, "conv2d": conv2d, "softmax": softmax,
+            "layer_norm": layer_norm, "attention": attention,
+            "embedding": embedding, "reduce_sum": reduce_sum}
+
+
+def bench_ops(ops: Optional[Sequence[str]] = None,
+              iters: int = ITERS) -> Dict[str, dict]:
+    import jax
+    import jax.numpy as jnp
+
+    reg = _standard_ops()
+    names = list(ops) if ops else sorted(reg)
+    out = {}
+    for name in names:
+        thunk = reg[name]()
+        f = jax.jit(thunk)
+        r = f()
+        float(jnp.ravel(r)[0])                  # warm + true sync
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = f()
+        float(jnp.ravel(r)[0])
+        ms = (time.perf_counter() - t0) / iters * 1e3
+        out[name] = {"ms": round(ms, 4)}
+    return out
+
+
+def check_regression(current: Dict[str, dict], baseline: Dict[str, dict],
+                     tolerance: float = 0.15):
+    """Reference: `check_op_benchmark_result.py` — list ops slower than
+    baseline*(1+tolerance). Returns (ok, failures)."""
+    failures = []
+    for name, rec in sorted(baseline.items()):
+        cur = current.get(name)
+        if cur is None:
+            failures.append(f"{name}: missing from current run")
+            continue
+        if cur["ms"] > rec["ms"] * (1.0 + tolerance):
+            failures.append(
+                f"{name}: {cur['ms']:.3f} ms vs baseline "
+                f"{rec['ms']:.3f} ms (+{cur['ms'] / rec['ms'] - 1:.0%})")
+    return (not failures, failures)
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(description="op micro-benchmarks "
+                                             "(op_tester.cc equivalent)")
+    ap.add_argument("--out", default=None, help="write results JSON")
+    ap.add_argument("--ops", default=None,
+                    help="comma-separated subset (default: all)")
+    ap.add_argument("--compare", default=None,
+                    help="baseline JSON; exit 1 on regression")
+    ap.add_argument("--tolerance", type=float, default=0.15)
+    ap.add_argument("--iters", type=int, default=ITERS)
+    a = ap.parse_args(argv)
+    ops = a.ops.split(",") if a.ops else None
+    res = bench_ops(ops, iters=a.iters)
+    for name, rec in sorted(res.items()):
+        print(f"{name:12s} {rec['ms']:9.4f} ms")
+    if a.out:
+        with open(a.out, "w") as f:
+            json.dump(res, f, indent=1)
+    if a.compare:
+        with open(a.compare) as f:
+            base = json.load(f)
+        ok, failures = check_regression(res, base, a.tolerance)
+        if not ok:
+            print("op benchmark REGRESSION:", file=sys.stderr)
+            for line in failures:
+                print(f"  {line}", file=sys.stderr)
+            return 1
+        print(f"no regressions vs {a.compare} "
+              f"(tolerance {a.tolerance:.0%})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,64 @@
+"""Op-level IR + pass framework (reference: framework.proto ProgramDesc,
+framework/ir Pass + GraphPatternDetector)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.ir import PassRegistry, Program
+
+
+def _fn(x):
+    y = jnp.sin(x) * 2.0
+    dead = jnp.cos(x) + 5.0          # unused
+    z = jnp.exp(y)
+    del dead
+    return z
+
+
+class TestProgram:
+    def test_capture_and_ops(self):
+        p = Program.capture(_fn, jnp.ones((4,)))
+        types = p.op_types()
+        assert "sin" in types and "exp" in types and "cos" in types
+        op = p.ops()[0]
+        assert op.type and op.outputs
+
+    def test_execution_matches_function(self):
+        p = Program.capture(_fn, jnp.ones((4,)))
+        x = jnp.asarray(np.random.RandomState(0).randn(4), jnp.float32)
+        np.testing.assert_allclose(np.asarray(p(x)), np.asarray(_fn(x)),
+                                   rtol=1e-6)
+
+    def test_dce_removes_dead_ops_and_preserves_semantics(self):
+        p = Program.capture(_fn, jnp.ones((4,)))
+        q = p.apply_pass("dead_code_elimination")
+        assert "cos" in p.op_types()
+        assert "cos" not in q.op_types()
+        assert len(q.ops()) < len(p.ops())
+        x = jnp.asarray([0.3, -0.2, 1.0, 2.0], jnp.float32)
+        np.testing.assert_allclose(np.asarray(q(x)), np.asarray(_fn(x)),
+                                   rtol=1e-6)
+
+    def test_find_pattern_def_use_chain(self):
+        p = Program.capture(_fn, jnp.ones((4,)))
+        hits = p.find_pattern(["sin", "mul"])    # y = sin(x) * 2.0
+        assert len(hits) == 1
+        assert hits[0][0].type == "sin" and hits[0][1].type == "mul"
+        # non-adjacent ops do NOT match as a chain
+        assert p.find_pattern(["cos", "exp"]) == []
+
+    def test_custom_pass_and_registry(self):
+        @PassRegistry.register("drop_all_sin")
+        def drop_sin(eqns, jaxpr):
+            return [e for e in eqns if e.primitive.name != "sin"]
+
+        assert "drop_all_sin" in PassRegistry.list()
+        with pytest.raises(KeyError):
+            PassRegistry.get("nope")
+        # jit-compilable after a pass
+        p = Program.capture(lambda x: jnp.cos(x) * 1.0, jnp.ones((2,)))
+        q = p.apply_pass("dead_code_elimination")
+        out = jax.jit(q.to_callable())(jnp.zeros((2,)))
+        np.testing.assert_allclose(np.asarray(out), np.ones(2), rtol=1e-6)
