@@ -531,4 +531,78 @@ PTPU_EXPORT int ptpu_aes_ctr_xcrypt(const uint8_t *key16, const uint8_t *iv16,
   return 0;
 }
 
+// ---------------------------------------------------------------------------
+// Numeric data-feed parser (reference: MultiSlotDataFeed /
+// InMemoryDataFeed parse hot loop, `framework/data_feed.cc` — the
+// reference keeps record parsing native because Python tokenization is
+// the bottleneck when LoadIntoMemory streams GBs of slot text).
+//
+// Parses whitespace-separated numeric lines (one record per line) from a
+// NUL-terminated buffer. Two-pass contract: ptpu_feed_count sizes the
+// output, ptpu_feed_parse fills caller-allocated arrays.
+// ---------------------------------------------------------------------------
+
+PTPU_EXPORT int ptpu_feed_count(const char *buf, int64_t len,
+                                int64_t *n_vals, int64_t *n_lines) {
+  if (!buf || !n_vals || !n_lines) return -1;
+  int64_t vals = 0, lines = 0;
+  bool in_tok = false, line_has = false;
+  for (int64_t i = 0; i < len; ++i) {
+    char c = buf[i];
+    if (c == '\n') {
+      if (in_tok) { ++vals; in_tok = false; }
+      if (line_has) ++lines;
+      line_has = false;
+    } else if (c == ' ' || c == '\t' || c == '\r' || c == ',') {
+      if (in_tok) { ++vals; in_tok = false; }
+    } else {
+      in_tok = true;
+      line_has = true;
+    }
+  }
+  if (in_tok) ++vals;
+  if (line_has) ++lines;
+  *n_vals = vals;
+  *n_lines = lines;
+  return 0;
+}
+
+PTPU_EXPORT int ptpu_feed_parse(const char *buf, int64_t len, float *vals,
+                                int64_t vals_cap, int64_t *line_starts,
+                                int64_t lines_cap, int64_t *n_vals_out) {
+  if (!buf || !vals || !line_starts || !n_vals_out) return -1;
+  const char *p = buf;
+  const char *end = buf + len;
+  int64_t nv = 0, nl = 0;
+  bool line_open = false;
+  while (p < end && *p) {
+    char c = *p;
+    if (c == '\n') {
+      line_open = false;
+      ++p;
+      continue;
+    }
+    if (c == ' ' || c == '\t' || c == '\r' || c == ',') {
+      ++p;
+      continue;
+    }
+    if (!line_open) {
+      if (nl >= lines_cap) return -2;
+      line_starts[nl++] = nv;
+      line_open = true;
+    }
+    char *tok_end = nullptr;
+    float v = std::strtof(p, &tok_end);
+    if (tok_end == p) return -3;  // non-numeric token
+    if (nv >= vals_cap) return -2;
+    vals[nv++] = v;
+    p = tok_end;
+  }
+  // callers MUST verify n_vals_out against ptpu_feed_count's tally: an
+  // early stop (embedded NUL, locale surprises) would otherwise leave
+  // the tail of the caller's buffer uninitialized
+  *n_vals_out = nv;
+  return static_cast<int>(nl);
+}
+
 PTPU_EXPORT const char *ptpu_version() { return "paddle_tpu-native 0.1"; }

@@ -88,6 +88,25 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.ptpu_aes_ctr_xcrypt.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_char_p, ctypes.c_uint64]
+        # newer symbols — a STALE prebuilt .so may predate them; the rest
+        # of the runtime must keep working and the feed path degrade
+        # (an AttributeError must never escape available()). dlopen
+        # caches by path, so a rebuild-and-reload here is unreliable —
+        # delete the stale .so and re-import to pick the new symbols up.
+        try:
+            lib.ptpu_feed_count.restype = ctypes.c_int
+            lib.ptpu_feed_count.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64)]
+            lib.ptpu_feed_parse.restype = ctypes.c_int
+            lib.ptpu_feed_parse.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64)]
+            lib._ptpu_has_feed = True
+        except AttributeError:
+            lib._ptpu_has_feed = False
         _LIB = lib
         return _LIB
 

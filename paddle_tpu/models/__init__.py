@@ -25,3 +25,7 @@ from .bert import (  # noqa: F401
     bert_base,
     bert_tiny,
 )
+from .transformer import (  # noqa: F401
+    TransformerModel,
+    sinusoid_position_encoding,
+)

@@ -1,0 +1,165 @@
+"""Transformer seq2seq model (WMT-class translation; reference: the
+transformer models driven throughout the reference test suite —
+`unittests/dist_transformer.py`, `dygraph_to_static` transformer — built
+from the op families `operators/fused/multihead_matmul_op.cu`,
+`softmax_with_cross_entropy`, `math/beam_search.cc`).
+
+TPU-first assembly over the nn.Transformer stack: learned embeddings +
+sinusoidal positions, label-smoothed CE (the WMT recipe), greedy and
+beam-search decode over the functional `nn.decode.beam_search` (static
+[B, K] shapes, lax.scan over steps).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer
+from ..nn.layer_common import Dropout, Embedding, Linear
+from ..nn.layer_transformer import Transformer
+
+
+def sinusoid_position_encoding(max_len: int, d_model: int) -> jnp.ndarray:
+    pos = np.arange(max_len)[:, None].astype(np.float64)
+    dim = np.arange(0, d_model, 2).astype(np.float64)
+    angle = pos / np.power(10000.0, dim / d_model)
+    enc = np.zeros((max_len, d_model), np.float32)
+    enc[:, 0::2] = np.sin(angle)
+    enc[:, 1::2] = np.cos(angle[:, : d_model // 2])  # odd d_model safe
+    return jnp.asarray(enc)
+
+
+class TransformerModel(Layer):
+    """Encoder-decoder translation model with shared target
+    embedding/generator weight (the WMT base-config convention)."""
+
+    def __init__(self, src_vocab_size: int, trg_vocab_size: int,
+                 max_length: int = 256, d_model: int = 512, n_head: int = 8,
+                 num_encoder_layers: int = 6, num_decoder_layers: int = 6,
+                 d_inner_hid: int = 2048, dropout: float = 0.1,
+                 bos_id: int = 0, eos_id: int = 1,
+                 pad_id: Optional[int] = None):
+        super().__init__()
+        self.d_model = d_model
+        self.bos_id, self.eos_id = bos_id, eos_id
+        self.pad_id = bos_id if pad_id is None else pad_id
+        init = I.Normal(0.0, d_model ** -0.5)
+        self.src_embedding = Embedding(src_vocab_size, d_model,
+                                       weight_attr=init)
+        self.trg_embedding = Embedding(trg_vocab_size, d_model,
+                                       weight_attr=init)
+        self.register_buffer("pos_enc",
+                             sinusoid_position_encoding(max_length,
+                                                        d_model))
+        self.transformer = Transformer(
+            d_model=d_model, nhead=n_head,
+            num_encoder_layers=num_encoder_layers,
+            num_decoder_layers=num_decoder_layers,
+            dim_feedforward=d_inner_hid, dropout=dropout,
+            normalize_before=True)
+        self.dropout = Dropout(dropout)
+        self.trg_vocab_size = trg_vocab_size
+
+    # -- embedding helpers -------------------------------------------------
+
+    def _embed(self, ids, table):
+        x = F.embedding(ids, table.weight) * math.sqrt(self.d_model)
+        x = x + jnp.asarray(self.pos_enc)[: ids.shape[1]][None]
+        return self.dropout(x)
+
+    def _src_mask(self, src):
+        # [B, 1, 1, S] boolean keep-mask broadcast over heads/queries
+        return (src != self.pad_id)[:, None, None, :]
+
+    # -- training ----------------------------------------------------------
+
+    def forward(self, src_word, trg_word):
+        """Teacher-forced logits [B, T, V]."""
+        src = self._embed(src_word, self.src_embedding)
+        tgt = self._embed(trg_word, self.trg_embedding)
+        t = trg_word.shape[1]
+        causal = Transformer.generate_square_subsequent_mask(t)
+        # memory_mask matches decode-time masking — cross-attention must
+        # not train on source pad positions it won't see at inference
+        out = self.transformer(src, tgt, src_mask=self._src_mask(src_word),
+                               tgt_mask=causal[None, None],
+                               memory_mask=self._src_mask(src_word))
+        # generator shares the target embedding (weight tying)
+        return out @ jnp.asarray(self.trg_embedding.weight).T
+
+    def loss(self, logits, labels, label_smooth_eps: float = 0.1):
+        """Label-smoothed CE ignoring pads (reference WMT recipe:
+        `softmax_with_cross_entropy(soft_label=True)` after
+        `label_smooth`)."""
+        v = self.trg_vocab_size
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        smooth = label_smooth_eps / (v - 1)
+        onehot = jax.nn.one_hot(labels, v, dtype=jnp.float32)
+        soft = onehot * (1.0 - label_smooth_eps - smooth) + smooth
+        per_tok = -jnp.sum(soft * logp, axis=-1)
+        mask = (labels != self.pad_id).astype(jnp.float32)
+        return jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    # -- inference ---------------------------------------------------------
+
+    def beam_search_decode(self, src_word, beam_size: int = 4,
+                           max_len: int = 32,
+                           length_penalty: float = 0.6):
+        """Returns (seqs [B, K, max_len], scores [B, K]) via the
+        functional beam search (`math/beam_search.cc` semantics).
+
+        The decode state is a FIXED [B, K, max_len+1] prefix buffer plus
+        a step counter (lax.scan carries need static shapes; beam_search
+        reorders the buffer along K when beams switch parents). Each step
+        re-runs the decoder over the padded prefix — the causal mask
+        keeps padded future slots out of position t's receptive field —
+        and reads the logits at the current position.
+        """
+        from ..nn.decode import beam_search
+        b = src_word.shape[0]
+        k = beam_size
+        was_training = self.training
+        self.eval()
+        try:
+            src = self._embed(src_word, self.src_embedding)
+            memory = self.transformer.encoder(
+                src, src_mask=self._src_mask(src_word))
+            mem = jnp.repeat(memory, k, axis=0)
+            msk = jnp.repeat(self._src_mask(src_word), k, axis=0)
+            T = max_len + 1
+            causal = Transformer.generate_square_subsequent_mask(T)
+
+            def step_fn(tokens, state):
+                buf = state["prefix"]                    # [B, K, T]
+                # step counter rides [B, K] so beam reordering can gather
+                # it like every other state leaf
+                t = state["t"]
+                tc = t[0, 0]
+                buf = jnp.where((jnp.arange(T) == tc)[None, None, :],
+                                tokens[..., None], buf)
+                flat = buf.reshape(b * k, T)
+                tgt = self._embed(flat, self.trg_embedding)
+                out = self.transformer.decoder(
+                    tgt, mem, tgt_mask=causal[None, None],
+                    memory_mask=msk)
+                w = jnp.asarray(self.trg_embedding.weight)
+                pos = jax.lax.dynamic_index_in_dim(out, tc, axis=1,
+                                                   keepdims=False)
+                logits = pos @ w.T                       # [B*K, V]
+                logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+                return (logp.reshape(b, k, -1),
+                        {"prefix": buf, "t": t + 1})
+
+            init_state = {"prefix": jnp.zeros((b, k, T), jnp.int32),
+                          "t": jnp.zeros((b, k), jnp.int32)}
+            return beam_search(step_fn, init_state, b, k, self.bos_id,
+                               self.eos_id, max_len, length_penalty)
+        finally:
+            if was_training:
+                self.train()
