@@ -88,7 +88,9 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         try:
             return self._invoke(*args, **kwargs)
-        except jax.errors.TracerBoolConversionError:
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.TracerIntegerConversionError):
+            # bool: `if/while` on a traced value; int: `range(traced_n)`
             if self._ast_converted:
                 raise
             self._ast_fallback()

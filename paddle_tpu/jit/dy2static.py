@@ -16,9 +16,12 @@ Supported subset (same shape the reference's transformers handle):
   * `if <expr>: ... [else: ...]` — variables assigned in either branch
     must be bound on both paths (reference requires the same);
   * `while <expr>: ...` — loop-carried variables are those assigned in
-    the body; their types/shapes must be loop-invariant.
-`for` over tensors and `break`/`continue` inside rewritten loops are not
-converted (a clear error is raised at transform time).
+    the body; their types/shapes must be loop-invariant;
+  * `for <name> in range(...)` — lowered to the while conversion
+    (start/stop/step snapshotted at entry; non-literal step keeps
+    Python semantics since the direction is unknowable statically).
+`for` over other iterables stays untouched Python; `break`/`continue`
+inside converted loops raise a clear error at transform time.
 """
 from __future__ import annotations
 
@@ -258,6 +261,62 @@ class ControlFlowTransformer(ast.NodeTransformer):
             call = ast.Expr(value=call.value)
         return [t_def, f_def] + reads + [call]
 
+    # -- For over range ----------------------------------------------------
+
+    def visit_For(self, node):
+        """`for i in range(...)` lowers to the while conversion (traced
+        bounds become lax.while_loop; reference: loop_transformer's
+        for-range handling). Other iterables stay untouched Python."""
+        node = self.generic_visit(node)
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and isinstance(node.target, ast.Name) and not node.orelse):
+            return node
+        a = it.args
+        start = a[0] if len(a) >= 2 else ast.Constant(value=0)
+        stop = a[1] if len(a) >= 2 else a[0]
+        step = a[2] if len(a) == 3 else ast.Constant(value=1)
+
+        def _const(n):
+            if isinstance(n, ast.Constant):
+                return n.value
+            if isinstance(n, ast.UnaryOp) and isinstance(n.op, ast.USub) \
+                    and isinstance(n.operand, ast.Constant):
+                return -n.operand.value   # -2 parses as USub(Constant(2))
+            return None
+
+        sv = _const(step)
+        if sv is None and len(a) == 3:
+            # non-literal step: loop direction unknowable at transform
+            # time — keep Python semantics
+            return node
+        desc = isinstance(sv, (int, float)) and sv < 0
+        tgt = node.target.id
+        # snapshot stop/step ONCE (python evaluates range() at loop
+        # entry; a body mutating a name the stop expression reads must
+        # not change the trip count). __pt_ temps stay out of the carry
+        # and closure-capture as loop invariants.
+        stop_t, step_t = self._fresh("stop"), self._fresh("step")
+        pre = [ast.Assign(targets=[ast.Name(id=stop_t, ctx=ast.Store())],
+                          value=stop),
+               ast.Assign(targets=[ast.Name(id=step_t, ctx=ast.Store())],
+                          value=step),
+               ast.Assign(targets=[ast.Name(id=tgt, ctx=ast.Store())],
+                          value=start)]
+        bump = ast.AugAssign(target=ast.Name(id=tgt, ctx=ast.Store()),
+                             op=ast.Add(),
+                             value=ast.Name(id=step_t, ctx=ast.Load()))
+        wnode = ast.While(
+            test=ast.Compare(left=ast.Name(id=tgt, ctx=ast.Load()),
+                             ops=[ast.Gt() if desc else ast.Lt()],
+                             comparators=[ast.Name(id=stop_t,
+                                                   ctx=ast.Load())]),
+            body=list(node.body) + [bump], orelse=[])
+        converted = self.visit_While(wnode)
+        return pre + (converted if isinstance(converted, list)
+                      else [converted])
+
     # -- While ------------------------------------------------------------
 
     def visit_While(self, node):
@@ -268,9 +327,12 @@ class ControlFlowTransformer(ast.NodeTransformer):
             raise NotImplementedError(
                 "to_static AST fallback: while/else is not supported")
         body_n = _names(node.body)
-        test_n = _names([node.test])
-        carry = sorted(body_n.stored | test_n.loaded |
-                       (body_n.loaded & body_n.stored))
+        # carry = names the body ASSIGNS, nothing more. Loop-invariant
+        # names the test/body merely read resolve through the enclosing
+        # scope (closure); hoisting read-only names like `len` into the
+        # carry would turn them into locals of the transformed function
+        # and shadow their global/builtin binding with _UNDEF.
+        carry = sorted(body_n.stored)
         carry = [c for c in carry if c not in ("True", "False", "None")
                  and not c.startswith("__pt_")]
         cname, bname = self._fresh("cond"), self._fresh("body")

@@ -126,7 +126,14 @@ def main(argv=None):
                     help="baseline JSON; exit 1 on regression")
     ap.add_argument("--tolerance", type=float, default=0.15)
     ap.add_argument("--iters", type=int, default=ITERS)
+    ap.add_argument("--device", default=None, choices=(None, "cpu", "tpu"),
+                    help="force a backend (cpu: in-process override — "
+                         "env JAX_PLATFORMS alone is not honored under "
+                         "the axon hook)")
     a = ap.parse_args(argv)
+    if a.device:
+        import jax
+        jax.config.update("jax_platforms", a.device)
     ops = a.ops.split(",") if a.ops else None
     res = bench_ops(ops, iters=a.iters)
     for name, rec in sorted(res.items()):

@@ -36,6 +36,7 @@ class TestOpBench:
         out = str(tmp_path / "ops.json")
         r = subprocess.run(
             [sys.executable, "-m", "paddle_tpu.tools.op_bench",
+             "--device", "cpu",     # never block on a busy/wedged tunnel
              "--ops", "reduce_sum", "--iters", "2", "--out", out],
             capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
         assert r.returncode == 0, r.stderr
@@ -45,6 +46,7 @@ class TestOpBench:
         # compare against itself: no regression, rc 0
         r2 = subprocess.run(
             [sys.executable, "-m", "paddle_tpu.tools.op_bench",
+             "--device", "cpu",
              "--ops", "reduce_sum", "--iters", "2", "--compare", out,
              "--tolerance", "5.0"],
             capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
