@@ -1,0 +1,61 @@
+"""Broad paddle-2.x user-script API smoke: commonly scripted surfaces
+must construct and run (regression net over the public namespace)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.mark.parametrize("name,fn", [
+    ("tensor_slicing", lambda: paddle.to_tensor(np.ones((3, 4)))[1:, ::2]),
+    ("arange_linspace", lambda: (paddle.arange(10),
+                                 paddle.linspace(0, 1, 5))),
+    ("where", lambda: paddle.where(paddle.to_tensor([True, False]),
+                                   paddle.to_tensor([1.0, 2.0]),
+                                   paddle.to_tensor([3.0, 4.0]))),
+    ("matmul", lambda: paddle.matmul(paddle.ones((2, 3)),
+                                     paddle.ones((3, 4)))),
+    ("topk_sort", lambda: (paddle.topk(paddle.to_tensor([3.0, 1.0, 2.0]), 2),
+                           paddle.sort(paddle.to_tensor([3.0, 1.0])))),
+    ("concat_split", lambda: paddle.split(
+        paddle.concat([paddle.ones((2, 2)), paddle.zeros((2, 2))]), 2)),
+    ("linalg_norm", lambda: paddle.linalg.norm(paddle.ones((3, 3)))),
+    ("conv2d", lambda: paddle.nn.Conv2D(3, 8, 3)(paddle.ones((1, 3, 8, 8)))),
+    ("lstm", lambda: paddle.nn.LSTM(4, 8)(paddle.ones((2, 5, 4)))),
+    ("mha", lambda: paddle.nn.MultiHeadAttention(16, 4)(
+        paddle.ones((2, 5, 16)))),
+    ("distribution", lambda: paddle.distribution.Normal(0.0, 1.0)
+        .sample([3])),
+    ("grad_scaler", lambda: paddle.amp.GradScaler()),
+    ("cosine_lr", lambda: paddle.optimizer.lr.CosineAnnealingDecay(0.1, 10)),
+    ("dataloader", lambda: next(iter(paddle.io.DataLoader(
+        paddle.io.TensorDataset([np.ones((8, 2), np.float32)]),
+        batch_size=4)))),
+    ("to_static_fn", lambda: paddle.jit.to_static(lambda x: x * 2)(
+        paddle.ones((2,)))),
+    ("transforms", lambda: paddle.vision.transforms.Compose(
+        [paddle.vision.transforms.Normalize([0.5], [0.5])])(
+        np.ones((1, 4, 4), np.float32))),
+    ("flops", lambda: paddle.flops(paddle.nn.Linear(4, 4), (1, 4))),
+    ("regularizer", lambda: paddle.regularizer.L2Decay(1e-4)),
+    ("flags", lambda: (paddle.set_flags({"FLAGS_check_nan_inf": False}),
+                       paddle.get_flags(["FLAGS_check_nan_inf"]))),
+    ("random_creation", lambda: (paddle.seed(42), paddle.randn([2, 2]),
+                                 paddle.uniform([2, 2]))),
+    ("one_hot", lambda: paddle.nn.functional.one_hot(
+        paddle.to_tensor([1, 2]), 4)),
+    ("cosine_similarity", lambda: paddle.nn.functional.cosine_similarity(
+        paddle.ones((2, 4)), paddle.ones((2, 4)))),
+])
+def test_api_smoke(name, fn):
+    fn()
+
+
+def test_double_grad_composes():
+    """Double grad (reference: PartialGradEngine create_graph) = grad
+    composition in the functional model."""
+    import jax.numpy as jnp
+    f = lambda x: (x ** 3).sum()
+    g1 = paddle.grad(f)
+    g2 = paddle.grad(lambda x: g1(x).sum())
+    np.testing.assert_allclose(np.asarray(g2(jnp.asarray([2.0]))), [12.0])
