@@ -1,0 +1,50 @@
+"""Book example 1 (reference: tests/book/test_recognize_digits.py):
+train LeNet on MNIST (synthetic offline fallback) with the hapi Model
+API, save, reload, predict.
+
+Run: python examples/recognize_digits.py [--epochs N]
+"""
+import argparse
+import os
+import tempfile
+
+import numpy as np
+
+
+def main(epochs=2, batch_size=64, limit=512):
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import LeNet
+    from paddle_tpu.vision.transforms import Compose, Normalize
+
+    transform = Compose([Normalize(mean=[127.5], std=[127.5])])
+    train = paddle.vision.datasets.MNIST(mode="train", transform=None)
+    # keep the example fast: cap the sample count
+    X = np.stack([np.asarray(train[i][0], np.float32)[None] / 127.5 - 1.0
+                  for i in range(min(limit, len(train)))])
+    Y = np.asarray([int(train[i][1]) for i in range(len(X))], np.int64)
+
+    model = paddle.Model(LeNet())
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.network.parameters())
+    model.prepare(opt, paddle.nn.CrossEntropyLoss(),
+                  paddle.metric.Accuracy())
+    ds = paddle.io.TensorDataset([X, Y])
+    model.fit(ds, epochs=epochs, batch_size=batch_size, verbose=0)
+    result = model.evaluate(ds, batch_size=128, verbose=0)
+
+    path = os.path.join(tempfile.mkdtemp(), "lenet")
+    model.save(path)
+    model2 = paddle.Model(LeNet())
+    model2.prepare(None, paddle.nn.CrossEntropyLoss())
+    model2.load(path)
+    pred = model2.predict_batch([X[:4]])[0]
+    print("eval:", {k: float(np.asarray(v).ravel()[0])
+                    for k, v in result.items()},
+          "pred shape:", tuple(np.asarray(pred).shape))
+    return result
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    main(epochs=ap.parse_args().epochs)

@@ -1,0 +1,56 @@
+"""Book example 3 (reference: tests/book word2vec): skip-gram-style
+embedding training over the Imikolov n-gram dataset (synthetic offline).
+
+Run: python examples/word2vec.py
+"""
+import numpy as np
+
+
+def main(steps=200):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.layer import functional_call, trainable_state
+
+    paddle.seed(0)
+    ds = paddle.text.Imikolov(window_size=5)
+    vocab = len(ds.word_idx)
+
+    class NGram(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = paddle.nn.Embedding(vocab, 32)
+            self.fc = paddle.nn.Linear(4 * 32, vocab)
+
+        def forward(self, ctx):
+            e = self.emb(ctx)                   # [B, 4, 32]
+            return self.fc(e.reshape(ctx.shape[0], -1))
+
+    net = NGram()
+    opt = paddle.optimizer.Adam(learning_rate=5e-3, parameters=net)
+    samples = np.stack([np.asarray(ds[i]) for i in range(512)])
+    ctx = jnp.asarray(samples[:, :4], jnp.int32)
+    tgt = jnp.asarray(samples[:, 4], jnp.int32)
+    ce = paddle.nn.CrossEntropyLoss()
+
+    def loss_fn(p):
+        out, _ = functional_call(net, p, ctx)
+        return ce(out, tgt)
+
+    @jax.jit
+    def value_grad(p):
+        return jax.value_and_grad(loss_fn)(p)
+
+    l0 = None
+    for i in range(steps):
+        loss, grads = value_grad(trainable_state(net))
+        opt.step(grads)
+        if l0 is None:
+            l0 = float(loss)
+    print(f"loss {l0:.3f} -> {float(loss):.3f}")
+    assert float(loss) < l0
+    return l0, float(loss)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""Book/e2e examples stay runnable (SURVEY §4 'tests/book' row)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples"))
+
+
+def test_recognize_digits_example():
+    import recognize_digits
+    result = recognize_digits.main(epochs=1, batch_size=64, limit=256)
+    assert "loss" in result
+
+
+def test_gpt_pretrain_example():
+    import gpt_pretrain
+    losses = gpt_pretrain.main(steps=6)
+    assert losses[-1] < losses[0]
+
+
+def test_word2vec_example():
+    import word2vec
+    l0, l1 = word2vec.main(steps=60)
+    assert l1 < l0
