@@ -17,9 +17,9 @@ def main(epochs=2, batch_size=64, limit=512):
     from paddle_tpu.vision.transforms import Compose, Normalize
 
     transform = Compose([Normalize(mean=[127.5], std=[127.5])])
-    train = paddle.vision.datasets.MNIST(mode="train", transform=None)
-    # keep the example fast: cap the sample count
-    X = np.stack([np.asarray(train[i][0], np.float32)[None] / 127.5 - 1.0
+    train = paddle.vision.datasets.MNIST(mode="train", transform=transform)
+    # keep the example fast: cap the sample count (transform emits CHW)
+    X = np.stack([np.asarray(train[i][0], np.float32)
                   for i in range(min(limit, len(train)))])
     Y = np.asarray([int(train[i][1]) for i in range(len(X))], np.int64)
 

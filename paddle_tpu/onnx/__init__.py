@@ -1,32 +1,84 @@
-"""`paddle.onnx` equivalent (reference: python/paddle/onnx/export.py —
-a thin wrapper over the external paddle2onnx package).
+"""`paddle.onnx` equivalent (reference: python/paddle/onnx/export.py — a
+thin wrapper over the external paddle2onnx package, which walks the
+ProgramDesc op graph).
 
-ONNX is a CUDA/CPU deployment interchange; the TPU deployment artifact is
-shape-polymorphic StableHLO (`paddle_tpu.jit.save`), which XLA consumes
-directly. There is no ONNX converter in this environment, so `export`
-saves the StableHLO artifact and returns its path explicitly marked as
-`.pdmodel` (NOT a `.onnx` file) — callers that need a real ONNX graph
-must run external tooling on another stack.
+TPU-native design: the exporter traces the layer's forward to a jaxpr —
+the same IR every transform here uses — and emits the ONNX ModelProto wire
+format directly (`converter.py` + `proto.py`; no onnx package needed).
+Parameters become initializers, so the `.onnx` file is self-contained and
+loadable by any ONNX runtime. `reference_runtime.py` is a numpy executor
+for the emitted op set, used to verify exports offline.
+
+Primitives with no ONNX mapping raise `UnsupportedPrimitive`; pass
+`fallback_stablehlo=True` to write the StableHLO `.pdmodel` artifact
+instead (the TPU deployment format, `paddle_tpu.jit.save`).
 """
 from __future__ import annotations
 
 import warnings
 
+from .converter import UnsupportedPrimitive, trace_to_onnx  # noqa: F401
+from . import proto, reference_runtime  # noqa: F401
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    """Reference: onnx/export.py `paddle.onnx.export`. Saves the
-    StableHLO inference artifact (`<path>.pdmodel` + `.pdiparams`) and
-    returns the `.pdmodel` path. A warning makes explicit that the file
-    is StableHLO, not ONNX protobuf."""
-    from ..jit import save as jit_save
+
+def export(layer, path, input_spec=None, opset_version=13,
+           fallback_stablehlo=False, **configs):
+    """Export `layer` to a real ONNX protobuf at `<path>.onnx`.
+
+    Reference: onnx/export.py `paddle.onnx.export`. Returns the written
+    path. `input_spec` is a list of `paddle_tpu.static.InputSpec` (or
+    arrays) describing example inputs; shapes are exported statically.
+    """
+    import jax.numpy as jnp
+    from ..nn.layer import buffer_state, functional_call, trainable_state
+    from ..static import InputSpec
+
     if input_spec is None:
         raise ValueError("paddle_tpu.onnx.export requires input_spec")
+    if opset_version < 13:
+        # the converter emits opset-13 op forms (ReduceSum axes input,
+        # Clip min/max inputs, Pad pads input, Slice starts/ends inputs)
+        raise ValueError(
+            f"opset_version must be >= 13 (got {opset_version})")
+    example = []
+    for spec in input_spec:
+        if isinstance(spec, InputSpec):
+            shape = [1 if d in (None, -1) else int(d) for d in spec.shape]
+            example.append(jnp.zeros(shape, spec.dtype or jnp.float32))
+        else:
+            example.append(jnp.asarray(spec))
+
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+    params = trainable_state(layer)
+    buffers = buffer_state(layer)
+
+    def fwd(*args):
+        out, _ = functional_call(layer, params, *args, buffers=buffers)
+        return out
+
     if path.endswith(".onnx"):
         path = path[:-len(".onnx")]
-    warnings.warn(
-        "paddle_tpu.onnx.export writes a StableHLO .pdmodel artifact "
-        "(loadable with paddle_tpu.jit.load / paddle_tpu.inference), not "
-        "an ONNX protobuf; convert externally if ONNX is required.",
-        UserWarning, stacklevel=2)
-    jit_save(layer, path, input_spec=input_spec)
-    return path + ".pdmodel"
+    try:
+        model_bytes = trace_to_onnx(
+            fwd, example,
+            input_names=[f"x{i}" for i in range(len(example))],
+            opset=opset_version)
+    except UnsupportedPrimitive as e:
+        if not fallback_stablehlo:
+            raise
+        warnings.warn(
+            f"ONNX conversion failed ({e}); writing StableHLO .pdmodel "
+            "artifact instead (loadable with paddle_tpu.jit.load).",
+            UserWarning, stacklevel=2)
+        from ..jit import save as jit_save
+        jit_save(layer, path, input_spec=input_spec)
+        return path + ".pdmodel"
+    finally:
+        if was_training and hasattr(layer, "train"):
+            layer.train()
+    out_path = path + ".onnx"
+    with open(out_path, "wb") as fh:
+        fh.write(model_bytes)
+    return out_path

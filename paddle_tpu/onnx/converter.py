@@ -1,0 +1,457 @@
+"""jaxpr → ONNX graph converter.
+
+Reference: python/paddle/onnx/export.py (delegates to the external
+paddle2onnx converter, which walks the ProgramDesc op graph). The TPU-native
+equivalent walks the *jaxpr* of the layer's forward — the same IR every
+other transform here uses — and emits one ONNX node (or a small cluster)
+per primitive. Parameters closed over the trace arrive as jaxpr consts and
+become ONNX initializers, so the exported file is self-contained.
+
+Static shapes only (ONNX dims are taken from traced avals). Higher-order
+primitives (pjit/custom_jvp/remat/closed_call) are inlined recursively.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.extend import core as jcore
+
+from . import proto
+
+
+class UnsupportedPrimitive(NotImplementedError):
+    pass
+
+
+class _Graph:
+    """Accumulates nodes/initializers and names jaxpr vars."""
+
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self.names: Dict[int, str] = {}   # id(var) -> name
+        self._counter = 0
+        self._init_cache: Dict[bytes, str] = {}
+
+    def fresh(self, hint: str = "t") -> str:
+        self._counter += 1
+        return f"{hint}_{self._counter}"
+
+    def name_of(self, var) -> str:
+        if isinstance(var, jcore.Literal):
+            arr = np.asarray(var.val)
+            return self.constant(arr)
+        key = id(var)
+        if key not in self.names:
+            self.names[key] = self.fresh("v")
+        return self.names[key]
+
+    def constant(self, arr: np.ndarray, hint: str = "const") -> str:
+        arr = np.asarray(arr)
+        if arr.dtype == np.dtype(jnp.bfloat16):
+            arr = arr.astype(np.float32)
+        cache_key = arr.tobytes() + str(arr.dtype).encode() \
+            + str(arr.shape).encode()
+        if cache_key in self._init_cache:
+            return self._init_cache[cache_key]
+        name = self.fresh(hint)
+        self.initializers.append(proto.tensor_proto(name, arr))
+        self._init_cache[cache_key] = name
+        return name
+
+    def add(self, op_type: str, inputs: List[str], n_out: int = 1,
+            outputs=None, **attrs) -> List[str]:
+        if outputs is None:
+            outputs = [self.fresh(op_type.lower()) for _ in range(n_out)]
+        self.nodes.append(proto.node_proto(op_type, inputs, outputs,
+                                           name=self.fresh("n"), **attrs))
+        return outputs
+
+    def set_name(self, var, name: str):
+        self.names[id(var)] = name
+
+
+_ELEMENTWISE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "max": "Max", "min": "Min", "neg": "Neg", "abs": "Abs",
+    "exp": "Exp", "log": "Log", "tanh": "Tanh", "logistic": "Sigmoid",
+    "sqrt": "Sqrt", "erf": "Erf", "pow": "Pow", "sign": "Sign",
+    "floor": "Floor", "ceil": "Ceil", "round": "Round",
+    "sin": "Sin", "cos": "Cos", "tan": "Tan",
+    "asin": "Asin", "acos": "Acos", "atan": "Atan",
+    "sinh": "Sinh", "cosh": "Cosh",
+    "asinh": "Asinh", "acosh": "Acosh", "atanh": "Atanh",
+    "and": "And", "or": "Or", "not": "Not", "xor": "Xor",
+    "stop_gradient": "Identity", "copy": "Identity",
+}
+
+_COMPARE = {"eq": "Equal", "lt": "Less", "le": "LessOrEqual",
+            "gt": "Greater", "ge": "GreaterOrEqual"}
+
+_REDUCE = {"reduce_sum": "ReduceSum", "reduce_max": "ReduceMax",
+           "reduce_min": "ReduceMin", "reduce_prod": "ReduceProd"}
+
+_LETTERS = "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ"
+
+
+def _einsum_equation(dn, lhs_rank, rhs_rank):
+    (lc, rc), (lb, rb) = dn
+    lhs = [""] * lhs_rank
+    rhs = [""] * rhs_rank
+    it = iter(_LETTERS)
+    for i, j in zip(lb, rb):
+        lhs[i] = rhs[j] = next(it)
+    for i, j in zip(lc, rc):
+        lhs[i] = rhs[j] = next(it)
+    out = [lhs[i] for i in lb]
+    for i in range(lhs_rank):
+        if not lhs[i]:
+            lhs[i] = next(it)
+            out.append(lhs[i])
+    for j in range(rhs_rank):
+        if not rhs[j]:
+            rhs[j] = next(it)
+            out.append(rhs[j])
+    return f"{''.join(lhs)},{''.join(rhs)}->{''.join(out)}"
+
+
+def _conv(g: _Graph, eqn, ins):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    if any(d != 1 for d in p.get("lhs_dilation") or ()):
+        raise UnsupportedPrimitive("conv with lhs_dilation (transpose conv)")
+    if p.get("batch_group_count", 1) != 1:
+        raise UnsupportedPrimitive("conv batch_group_count != 1")
+    n_sp = len(dn.lhs_spec) - 2
+    lhs_perm = (dn.lhs_spec[0], dn.lhs_spec[1]) + tuple(dn.lhs_spec[2:])
+    rhs_perm = (dn.rhs_spec[0], dn.rhs_spec[1]) + tuple(dn.rhs_spec[2:])
+    x, w = ins
+    if lhs_perm != tuple(range(n_sp + 2)):
+        x = g.add("Transpose", [x], perm=list(lhs_perm))[0]
+    if rhs_perm != tuple(range(n_sp + 2)):
+        w = g.add("Transpose", [w], perm=list(rhs_perm))[0]
+    pads = [int(b) for b, _ in p["padding"]] + [int(e) for _, e in
+                                               p["padding"]]
+    y = g.add("Conv", [x, w],
+              strides=[int(s) for s in p["window_strides"]],
+              pads=pads,
+              dilations=[int(d) for d in p.get("rhs_dilation")
+                         or (1,) * n_sp],
+              group=int(p.get("feature_group_count", 1)))[0]
+    out_spec = (dn.out_spec[0], dn.out_spec[1]) + tuple(dn.out_spec[2:])
+    if out_spec != tuple(range(n_sp + 2)):
+        inv = [0] * (n_sp + 2)
+        for i, s in enumerate(out_spec):
+            inv[s] = i
+        y = g.add("Transpose", [y], perm=inv)[0]
+    return [y]
+
+
+def _pool(g: _Graph, eqn, ins, kind: str):
+    p = eqn.params
+    wd = tuple(int(d) for d in p["window_dimensions"])
+    ws = tuple(int(s) for s in (p["window_strides"] or (1,) * len(wd)))
+    pad = tuple(p["padding"])
+    if any(d != 1 for d in p.get("base_dilation") or ()):
+        raise UnsupportedPrimitive("reduce_window base_dilation")
+    if any(d != 1 for d in p.get("window_dilation") or ()):
+        raise UnsupportedPrimitive("reduce_window window_dilation")
+    if wd[0] != 1 or wd[1] != 1 or ws[0] != 1 or ws[1] != 1 \
+            or pad[0] != (0, 0) or pad[1] != (0, 0):
+        raise UnsupportedPrimitive(
+            f"reduce_window over non-spatial dims: {wd}")
+    pads = [int(b) for b, _ in pad[2:]] + [int(e) for _, e in pad[2:]]
+    if kind == "max":
+        return g.add("MaxPool", ins, kernel_shape=list(wd[2:]),
+                     strides=list(ws[2:]), pads=pads)
+    # sum pool = AveragePool(count_include_pad) * prod(window)
+    y = g.add("AveragePool", ins, kernel_shape=list(wd[2:]),
+              strides=list(ws[2:]), pads=pads, count_include_pad=1)[0]
+    count = float(np.prod(wd))
+    scale = g.constant(np.asarray(count, np.result_type(
+        np.float32)), "winsize")
+    return g.add("Mul", [y, scale])
+
+
+def _gather(g: _Graph, eqn, ins):
+    """jnp.take(operand, idx, axis=k) pattern → ONNX Gather."""
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    operand, start = eqn.invars
+    op_shape = tuple(operand.aval.shape)
+    slice_sizes = tuple(int(s) for s in p["slice_sizes"])
+    if len(dn.start_index_map) != 1 or getattr(
+            dn, "operand_batching_dims", ()):
+        raise UnsupportedPrimitive("general gather")
+    axis = dn.start_index_map[0]
+    if dn.collapsed_slice_dims != (axis,) or slice_sizes[axis] != 1:
+        raise UnsupportedPrimitive("general gather (non-take pattern)")
+    for d in range(len(op_shape)):
+        if d != axis and slice_sizes[d] != op_shape[d]:
+            raise UnsupportedPrimitive("general gather (partial slice)")
+    idx_shape = tuple(start.aval.shape)
+    if idx_shape[-1] != 1:
+        raise UnsupportedPrimitive("gather with index vector > 1")
+    idx = g.add("Reshape", [ins[1], g.constant(
+        np.asarray(idx_shape[:-1], np.int64), "shape")])[0]
+    batch_rank = len(idx_shape) - 1
+    # ONNX Gather(axis=k) output = op[:k] + idx_shape + op[k+1:]; the jaxpr
+    # gather matches only when its offset dims sit at exactly those slots.
+    out_rank = len(op_shape) - 1 + batch_rank
+    expect_offset = tuple(range(axis)) \
+        + tuple(range(axis + batch_rank, out_rank))
+    if tuple(dn.offset_dims) != expect_offset:
+        raise UnsupportedPrimitive("gather offset dims not take-like")
+    return g.add("Gather", [ins[0], idx], axis=int(axis))
+
+
+def _convert_eqn(g: _Graph, eqn):
+    prim = eqn.primitive.name
+    ins = [g.name_of(v) for v in eqn.invars]
+
+    if prim in ("jit", "pjit", "closed_call", "custom_jvp_call",
+                "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
+                "checkpoint", "custom_jvp_call_jaxpr"):
+        inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr") \
+            or eqn.params.get("fun_jaxpr")
+        if inner is None:
+            raise UnsupportedPrimitive(f"{prim} without inner jaxpr")
+        if hasattr(inner, "jaxpr"):          # ClosedJaxpr
+            consts, inner = inner.consts, inner.jaxpr
+        else:
+            consts = ()
+        for cv, cval in zip(inner.constvars, consts):
+            g.set_name(cv, g.constant(np.asarray(cval), "const"))
+        for iv, outer in zip(inner.invars, eqn.invars):
+            g.set_name(iv, g.name_of(outer))
+        for ieq in inner.eqns:
+            _convert_eqn(g, ieq)
+        for ov, outer in zip(inner.outvars, eqn.outvars):
+            # alias: emit Identity so the outer name exists as node output
+            g.add("Identity", [g.name_of(ov)],
+                  outputs=[g.name_of(outer)])
+        return
+
+    def out(names):
+        for v, n in zip(eqn.outvars, names):
+            g.set_name(v, n)
+
+    if prim in _ELEMENTWISE:
+        out(g.add(_ELEMENTWISE[prim], ins))
+    elif prim in _COMPARE:
+        out(g.add(_COMPARE[prim], ins))
+    elif prim == "ne":
+        e = g.add("Equal", ins)[0]
+        out(g.add("Not", [e]))
+    elif prim == "rsqrt":
+        s = g.add("Sqrt", ins)[0]
+        out(g.add("Reciprocal", [s]))
+    elif prim == "log1p":
+        one = g.constant(np.asarray(1.0, eqn.invars[0].aval.dtype))
+        s = g.add("Add", [ins[0], one])[0]
+        out(g.add("Log", [s]))
+    elif prim == "expm1":
+        e = g.add("Exp", ins)[0]
+        one = g.constant(np.asarray(1.0, eqn.invars[0].aval.dtype))
+        out(g.add("Sub", [e, one]))
+    elif prim == "erfc":
+        e = g.add("Erf", ins)[0]
+        one = g.constant(np.asarray(1.0, eqn.invars[0].aval.dtype))
+        out(g.add("Sub", [one, e]))
+    elif prim == "square":
+        out(g.add("Mul", [ins[0], ins[0]]))
+    elif prim == "integer_pow":
+        y = eqn.params["y"]
+        exp = g.constant(np.asarray(float(y), eqn.invars[0].aval.dtype))
+        out(g.add("Pow", [ins[0], exp]))
+    elif prim == "rem":
+        out(g.add("Mod", ins, fmod=1))
+    elif prim == "clamp":
+        lo, x, hi = ins
+        out(g.add("Clip", [x, lo, hi]))
+    elif prim == "select_n":
+        if len(ins) != 3:
+            raise UnsupportedPrimitive("select_n with >2 cases")
+        out(g.add("Where", [ins[0], ins[2], ins[1]]))
+    elif prim == "convert_element_type":
+        dt = proto.NP_TO_ONNX.get(np.dtype(eqn.params["new_dtype"]))
+        if dt is None:   # bf16 → export as f32
+            dt = proto.FLOAT
+        out(g.add("Cast", ins, to=int(dt)))
+    elif prim == "dot_general":
+        dn = eqn.params["dimension_numbers"]
+        lhs_rank = len(eqn.invars[0].aval.shape)
+        rhs_rank = len(eqn.invars[1].aval.shape)
+        (lc, rc), (lb, rb) = dn
+        # MatMul only when rhs is a plain matrix/vector: for rhs rank >= 3
+        # with no batch dims, XLA's output layout (lhs free dims then rhs
+        # free dims) differs from numpy/ONNX MatMul broadcasting.
+        if not lb and rhs_rank <= 2 and len(lc) == 1 \
+                and lc[0] == lhs_rank - 1 \
+                and rc[0] == rhs_rank - 2 + (rhs_rank == 1):
+            out(g.add("MatMul", ins))
+        else:
+            out(g.add("Einsum", ins,
+                      equation=_einsum_equation(dn, lhs_rank, rhs_rank)))
+    elif prim == "conv_general_dilated":
+        out(_conv(g, eqn, ins))
+    elif prim == "reduce_window_max":
+        out(_pool(g, eqn, ins, "max"))
+    elif prim == "reduce_window_sum":
+        out(_pool(g, eqn, ins, "sum"))
+    elif prim in _REDUCE:
+        axes = [int(a) for a in eqn.params["axes"]]
+        if prim == "reduce_sum":
+            ax = g.constant(np.asarray(axes, np.int64), "axes")
+            out(g.add("ReduceSum", [ins[0], ax], keepdims=0))
+        else:
+            out(g.add(_REDUCE[prim], ins, axes=axes, keepdims=0))
+    elif prim in ("argmax", "argmin"):
+        axes = eqn.params["axes"]
+        if len(axes) != 1:
+            raise UnsupportedPrimitive(f"{prim} over multiple axes")
+        op = "ArgMax" if prim == "argmax" else "ArgMin"
+        y = g.add(op, ins, axis=int(axes[0]), keepdims=0)[0]
+        dt = proto.NP_TO_ONNX[np.dtype(eqn.params["index_dtype"])]
+        out(g.add("Cast", [y], to=int(dt)))
+    elif prim in ("reshape", "squeeze", "expand_dims"):
+        shape = g.constant(np.asarray(eqn.outvars[0].aval.shape, np.int64),
+                           "shape")
+        out(g.add("Reshape", [ins[0], shape]))
+    elif prim == "transpose":
+        out(g.add("Transpose", ins,
+                  perm=[int(p) for p in eqn.params["permutation"]]))
+    elif prim == "broadcast_in_dim":
+        in_shape = tuple(eqn.invars[0].aval.shape)
+        out_shape = tuple(eqn.outvars[0].aval.shape)
+        bdims = tuple(eqn.params["broadcast_dimensions"])
+        mid = [1] * len(out_shape)
+        for i, d in enumerate(bdims):
+            mid[d] = in_shape[i]
+        x = ins[0]
+        if tuple(mid) != in_shape:
+            x = g.add("Reshape", [x, g.constant(
+                np.asarray(mid, np.int64), "shape")])[0]
+        if tuple(mid) != out_shape:
+            x = g.add("Expand", [x, g.constant(
+                np.asarray(out_shape, np.int64), "shape")])[0]
+            out([x])
+        elif x == ins[0]:
+            out(g.add("Identity", [x]))
+        else:
+            out([x])
+    elif prim == "concatenate":
+        out(g.add("Concat", ins, axis=int(eqn.params["dimension"])))
+    elif prim == "slice":
+        p = eqn.params
+        rank = len(eqn.invars[0].aval.shape)
+        starts = g.constant(np.asarray(p["start_indices"], np.int64), "st")
+        ends = g.constant(np.asarray(p["limit_indices"], np.int64), "en")
+        axes = g.constant(np.asarray(range(rank), np.int64), "ax")
+        steps = g.constant(np.asarray(p["strides"] or [1] * rank,
+                                      np.int64), "sp")
+        out(g.add("Slice", [ins[0], starts, ends, axes, steps]))
+    elif prim == "rev":
+        # Reverse via Slice with negative steps
+        rank = len(eqn.invars[0].aval.shape)
+        dims = [int(d) for d in eqn.params["dimensions"]]
+        starts = g.constant(np.asarray([-1] * len(dims), np.int64), "st")
+        ends = g.constant(np.asarray([np.iinfo(np.int64).min + 1]
+                                     * len(dims), np.int64), "en")
+        axes = g.constant(np.asarray(dims, np.int64), "ax")
+        steps = g.constant(np.asarray([-1] * len(dims), np.int64), "sp")
+        out(g.add("Slice", [ins[0], starts, ends, axes, steps]))
+    elif prim == "pad":
+        p = eqn.params["padding_config"]
+        if any(i != 0 for _, _, i in p):
+            raise UnsupportedPrimitive("pad with interior padding")
+        if any(lo < 0 or hi < 0 for lo, hi, _ in p):
+            raise UnsupportedPrimitive("negative padding")
+        pads = [lo for lo, _, _ in p] + [hi for _, hi, _ in p]
+        out(g.add("Pad", [ins[0],
+                          g.constant(np.asarray(pads, np.int64), "pads"),
+                          ins[1]]))
+    elif prim == "iota":
+        dt = np.dtype(eqn.params["dtype"])
+        shape = tuple(eqn.params["shape"])
+        dim = int(eqn.params["dimension"])
+        arr = np.arange(shape[dim], dtype=dt if dt != np.dtype(
+            jnp.bfloat16) else np.float32)
+        # store only the 1-D arange; broadcast with graph ops so a
+        # (1,1,S,S) position/mask iota doesn't embed an S*S initializer
+        mid = [shape[dim] if i == dim else 1 for i in range(len(shape))]
+        x = g.constant(arr, "iota")
+        x = g.add("Reshape", [x, g.constant(
+            np.asarray(mid, np.int64), "shape")])[0]
+        if tuple(mid) != shape:
+            x = g.add("Expand", [x, g.constant(
+                np.asarray(shape, np.int64), "shape")])[0]
+        out([x])
+    elif prim == "gather":
+        out(_gather(g, eqn, ins))
+    elif prim == "cumsum":
+        ax = g.constant(np.asarray(eqn.params["axis"], np.int64), "axis")
+        if eqn.params.get("reverse"):
+            raise UnsupportedPrimitive("reverse cumsum")
+        out(g.add("CumSum", [ins[0], ax]))
+    elif prim == "dynamic_slice":
+        starts = []
+        for v in eqn.invars[1:]:
+            if not isinstance(v, jcore.Literal):
+                raise UnsupportedPrimitive("dynamic_slice (dynamic start)")
+            starts.append(int(v.val))
+        sizes = eqn.params["slice_sizes"]
+        rank = len(sizes)
+        st = g.constant(np.asarray(starts, np.int64), "st")
+        en = g.constant(np.asarray([s + z for s, z in zip(starts, sizes)],
+                                   np.int64), "en")
+        ax = g.constant(np.asarray(range(rank), np.int64), "ax")
+        out(g.add("Slice", [ins[0], st, en, ax]))
+    else:
+        raise UnsupportedPrimitive(
+            f"primitive '{prim}' has no ONNX mapping")
+
+
+def jaxpr_to_onnx_graph(closed_jaxpr, input_names=None,
+                        graph_name="paddle_tpu"):
+    """Convert a ClosedJaxpr (static shapes) to a serialized GraphProto."""
+    jaxpr = closed_jaxpr.jaxpr
+    g = _Graph()
+    for cv, cval in zip(jaxpr.constvars, closed_jaxpr.consts):
+        g.set_name(cv, g.constant(np.asarray(cval), "param"))
+    inputs = []
+    for i, iv in enumerate(jaxpr.invars):
+        name = (input_names[i] if input_names and i < len(input_names)
+                else f"input_{i}")
+        g.set_name(iv, name)
+        dt = np.dtype(iv.aval.dtype)
+        if dt == np.dtype(jnp.bfloat16):
+            dt = np.dtype(np.float32)
+        inputs.append(proto.value_info(name, dt, tuple(iv.aval.shape)))
+    for eqn in jaxpr.eqns:
+        _convert_eqn(g, eqn)
+    outputs = []
+    for i, ov in enumerate(jaxpr.outvars):
+        name = g.name_of(ov)
+        if isinstance(ov, (jcore.Literal,)) or name in (
+                g.name_of(iv) for iv in jaxpr.invars):
+            name2 = g.add("Identity", [name],
+                          outputs=[g.fresh("output")])[0]
+            name = name2
+        dt = np.dtype(ov.aval.dtype)
+        if dt == np.dtype(jnp.bfloat16):
+            dt = np.dtype(np.float32)
+        outputs.append(proto.value_info(name, dt, tuple(ov.aval.shape)))
+    return proto.graph_proto(graph_name, g.nodes, g.initializers,
+                             inputs, outputs)
+
+
+def trace_to_onnx(fn, example_args, input_names=None, opset=13):
+    """Trace `fn(*example_args)` and return serialized ONNX ModelProto."""
+    closed = jax.make_jaxpr(fn)(*example_args)
+    graph = jaxpr_to_onnx_graph(closed, input_names=input_names)
+    return proto.model_proto(graph, opset=opset)

@@ -1,0 +1,216 @@
+"""Minimal protobuf wire-format writer/reader for ONNX.
+
+The environment has no `onnx` package, so `paddle_tpu.onnx.export` emits
+the ONNX ModelProto wire format directly (reference consumer:
+python/paddle/onnx/export.py delegates to the external paddle2onnx
+package; here the emitter is self-contained). Field numbers follow
+onnx/onnx.proto (IR version 7 / opset 13 era); only the message subset
+the exporter needs is modeled.
+
+Wire format: each field is a varint key ``(field_number << 3) | wire_type``
+followed by a varint (type 0), 8 bytes (type 1), length-delimited bytes
+(type 2) or 4 bytes (type 5). Nested messages are length-delimited.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+# -------------------------------------------------------------- data types
+# onnx.TensorProto.DataType
+FLOAT, UINT8, INT8, UINT16, INT16, INT32, INT64, STRING, BOOL = range(1, 10)
+FLOAT16, DOUBLE, UINT32, UINT64 = 10, 11, 12, 13
+BFLOAT16 = 16
+
+NP_TO_ONNX = {
+    np.dtype(np.float32): FLOAT,
+    np.dtype(np.float64): DOUBLE,
+    np.dtype(np.float16): FLOAT16,
+    np.dtype(np.int8): INT8,
+    np.dtype(np.uint8): UINT8,
+    np.dtype(np.int16): INT16,
+    np.dtype(np.uint16): UINT16,
+    np.dtype(np.int32): INT32,
+    np.dtype(np.int64): INT64,
+    np.dtype(np.uint32): UINT32,
+    np.dtype(np.uint64): UINT64,
+    np.dtype(np.bool_): BOOL,
+}
+ONNX_TO_NP = {v: k for k, v in NP_TO_ONNX.items()}
+
+# AttributeProto.AttributeType
+AT_FLOAT, AT_INT, AT_STRING, AT_TENSOR, AT_GRAPH = 1, 2, 3, 4, 5
+AT_FLOATS, AT_INTS, AT_STRINGS = 6, 7, 8
+
+
+# ---------------------------------------------------------------- encoding
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64  # two's complement, 10-byte varint
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _key(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def w_varint(field: int, value: int) -> bytes:
+    return _key(field, 0) + _varint(int(value))
+
+
+def w_bytes(field: int, value: bytes) -> bytes:
+    return _key(field, 2) + _varint(len(value)) + value
+
+
+def w_string(field: int, value: str) -> bytes:
+    return w_bytes(field, value.encode("utf-8"))
+
+
+def w_float(field: int, value: float) -> bytes:
+    return _key(field, 5) + struct.pack("<f", float(value))
+
+
+def w_packed_varints(field: int, values) -> bytes:
+    payload = b"".join(_varint(int(v)) for v in values)
+    return w_bytes(field, payload)
+
+
+def w_packed_floats(field: int, values) -> bytes:
+    return w_bytes(field, struct.pack(f"<{len(values)}f", *values))
+
+
+# ------------------------------------------------------------ ONNX builders
+
+def tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    dt = NP_TO_ONNX[arr.dtype]
+    msg = w_packed_varints(1, arr.shape)        # dims
+    msg += w_varint(2, dt)                      # data_type
+    msg += w_string(8, name)                    # name
+    msg += w_bytes(9, arr.tobytes())            # raw_data
+    return msg
+
+
+def _attr(name: str, value) -> bytes:
+    msg = w_string(1, name)
+    if isinstance(value, float):
+        msg += w_float(2, value) + w_varint(20, AT_FLOAT)
+    elif isinstance(value, bool) or isinstance(value, int):
+        msg += w_varint(3, int(value)) + w_varint(20, AT_INT)
+    elif isinstance(value, str):
+        msg += w_bytes(4, value.encode()) + w_varint(20, AT_STRING)
+    elif isinstance(value, bytes):
+        msg += w_bytes(4, value) + w_varint(20, AT_STRING)
+    elif isinstance(value, np.ndarray):
+        msg += w_bytes(5, tensor_proto(name, value)) + w_varint(20, AT_TENSOR)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            msg += w_packed_floats(7, value) + w_varint(20, AT_FLOATS)
+        else:
+            msg += w_packed_varints(8, value) + w_varint(20, AT_INTS)
+    else:
+        raise TypeError(f"unsupported attribute type {type(value)}")
+    return msg
+
+
+def node_proto(op_type: str, inputs: List[str], outputs: List[str],
+               name: str = "", **attrs) -> bytes:
+    msg = b"".join(w_string(1, s) for s in inputs)
+    msg += b"".join(w_string(2, s) for s in outputs)
+    if name:
+        msg += w_string(3, name)
+    msg += w_string(4, op_type)
+    msg += b"".join(w_bytes(5, _attr(k, v)) for k, v in attrs.items())
+    return msg
+
+
+def value_info(name: str, dtype: np.dtype, shape: Tuple[int, ...]) -> bytes:
+    dims = b"".join(w_bytes(1, w_varint(1, d)) for d in shape)
+    shape_proto = dims
+    tensor_type = w_varint(1, NP_TO_ONNX[np.dtype(dtype)]) \
+        + w_bytes(2, shape_proto)
+    type_proto = w_bytes(1, tensor_type)
+    return w_string(1, name) + w_bytes(2, type_proto)
+
+
+def graph_proto(name: str, nodes: List[bytes], initializers: List[bytes],
+                inputs: List[bytes], outputs: List[bytes]) -> bytes:
+    msg = b"".join(w_bytes(1, n) for n in nodes)
+    msg += w_string(2, name)
+    msg += b"".join(w_bytes(5, t) for t in initializers)
+    msg += b"".join(w_bytes(11, v) for v in inputs)
+    msg += b"".join(w_bytes(12, v) for v in outputs)
+    return msg
+
+
+def model_proto(graph: bytes, opset: int = 13,
+                producer: str = "paddle_tpu") -> bytes:
+    msg = w_varint(1, 7)                        # ir_version 7 (opset 13 era)
+    msg += w_string(2, producer)
+    msg += w_string(3, "0.0")
+    msg += w_bytes(7, graph)
+    msg += w_bytes(8, w_string(1, "") + w_varint(2, opset))  # opset_import
+    return msg
+
+
+# ---------------------------------------------------------------- decoding
+# A reader for the same subset, used by the offline reference runtime to
+# load exported models back without the onnx package.
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def parse_message(buf: bytes) -> Dict[int, list]:
+    """Parse one message into {field_number: [raw values]} (wire order)."""
+    fields: Dict[int, list] = {}
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        fields.setdefault(field, []).append(val)
+    return fields
+
+
+def parse_packed_varints(buf: bytes) -> List[int]:
+    out, pos = [], 0
+    while pos < len(buf):
+        v, pos = _read_varint(buf, pos)
+        out.append(v)
+    return out
+
+
+def signed(v: int) -> int:
+    return v - (1 << 64) if v >= 1 << 63 else v

@@ -1,0 +1,299 @@
+"""Offline ONNX reference runtime (numpy).
+
+Loads models written by `paddle_tpu.onnx.export` — plain ONNX wire format —
+and executes them with numpy, covering exactly the op set the converter
+emits. Purpose: (a) numeric verification of exports in environments with no
+onnxruntime (this image), (b) a last-resort CPU executor for exported
+graphs. Not a general ONNX runtime.
+"""
+from __future__ import annotations
+
+import math
+import struct
+from typing import Dict, List
+
+import numpy as np
+
+from . import proto
+
+
+class Node:
+    def __init__(self, op_type, inputs, outputs, attrs):
+        self.op_type = op_type
+        self.inputs = inputs
+        self.outputs = outputs
+        self.attrs = attrs
+
+
+class OnnxModel:
+    def __init__(self, nodes, initializers, input_names, output_names):
+        self.nodes: List[Node] = nodes
+        self.initializers: Dict[str, np.ndarray] = initializers
+        self.input_names = input_names
+        self.output_names = output_names
+
+
+def _parse_attr(buf: bytes):
+    f = proto.parse_message(buf)
+    name = f[1][0].decode()
+    atype = f.get(20, [0])[0]
+    if atype == proto.AT_FLOAT:
+        return name, struct.unpack("<f", f[2][0])[0]
+    if atype == proto.AT_INT:
+        return name, proto.signed(f[3][0])
+    if atype == proto.AT_STRING:
+        return name, f[4][0].decode()
+    if atype == proto.AT_INTS:
+        vals = []
+        for raw in f.get(8, []):
+            if isinstance(raw, bytes):
+                vals.extend(proto.signed(v)
+                            for v in proto.parse_packed_varints(raw))
+            else:
+                vals.append(proto.signed(raw))
+        return name, vals
+    if atype == proto.AT_FLOATS:
+        vals = []
+        for raw in f.get(7, []):
+            vals.extend(struct.unpack(f"<{len(raw) // 4}f", raw))
+        return name, list(vals)
+    if atype == proto.AT_TENSOR:
+        return name, _parse_tensor(f[5][0])
+    raise ValueError(f"unsupported attribute type {atype}")
+
+
+def _parse_tensor(buf: bytes) -> np.ndarray:
+    f = proto.parse_message(buf)
+    dims = []
+    for raw in f.get(1, []):
+        if isinstance(raw, bytes):
+            dims.extend(proto.parse_packed_varints(raw))
+        else:
+            dims.append(raw)
+    dt = proto.ONNX_TO_NP[f[2][0]]
+    raw = f.get(9, [b""])[0]
+    arr = np.frombuffer(raw, dtype=dt).reshape(dims)
+    return arr.copy()
+
+
+def _tensor_name(buf: bytes) -> str:
+    return proto.parse_message(buf)[8][0].decode()
+
+
+def load(path_or_bytes) -> OnnxModel:
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        data = bytes(path_or_bytes)
+    else:
+        with open(path_or_bytes, "rb") as fh:
+            data = fh.read()
+    model = proto.parse_message(data)
+    graph = proto.parse_message(model[7][0])
+    nodes = []
+    for nb in graph.get(1, []):
+        nf = proto.parse_message(nb)
+        attrs = dict(_parse_attr(a) for a in nf.get(5, []))
+        nodes.append(Node(nf[4][0].decode(),
+                          [s.decode() for s in nf.get(1, [])],
+                          [s.decode() for s in nf.get(2, [])], attrs))
+    inits = {_tensor_name(t): _parse_tensor(t)
+             for t in graph.get(5, [])}
+    def names(field):
+        return [proto.parse_message(v)[1][0].decode()
+                for v in graph.get(field, [])]
+    return OnnxModel(nodes, inits, names(11), names(12))
+
+
+# ---------------------------------------------------------------- executor
+
+_erf = np.vectorize(math.erf)
+
+
+def _pool2d(x, kernel, strides, pads, mode, count_include_pad=False):
+    n, c, h, w = x.shape
+    kh, kw = kernel
+    sh, sw = strides
+    ph0, pw0, ph1, pw1 = pads if len(pads) == 4 else (0, 0, 0, 0)
+    fill = -np.inf if mode == "max" else 0.0
+    xp = np.full((n, c, h + ph0 + ph1, w + pw0 + pw1), fill, x.dtype)
+    xp[:, :, ph0:ph0 + h, pw0:pw0 + w] = x
+    oh = (xp.shape[2] - kh) // sh + 1
+    ow = (xp.shape[3] - kw) // sw + 1
+    out = np.empty((n, c, oh, ow), x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            win = xp[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
+            out[:, :, i, j] = win.max((2, 3)) if mode == "max" \
+                else win.mean((2, 3))
+    return out
+
+
+def _conv2d(x, w, strides, pads, dilations, group):
+    n, cin, h, wid = x.shape
+    cout, cing, kh, kw = w.shape
+    sh, sw = strides
+    dh, dw = dilations
+    ph0, pw0, ph1, pw1 = pads
+    xp = np.zeros((n, cin, h + ph0 + ph1, wid + pw0 + pw1), x.dtype)
+    xp[:, :, ph0:ph0 + h, pw0:pw0 + wid] = x
+    ekh, ekw = (kh - 1) * dh + 1, (kw - 1) * dw + 1
+    oh = (xp.shape[2] - ekh) // sh + 1
+    ow = (xp.shape[3] - ekw) // sw + 1
+    out = np.zeros((n, cout, oh, ow), np.result_type(x, w))
+    og = cout // group
+    for gi in range(group):
+        xg = xp[:, gi * cing:(gi + 1) * cing]
+        wg = w[gi * og:(gi + 1) * og]
+        # im2col over the group
+        cols = np.empty((n, cing, kh, kw, oh, ow), x.dtype)
+        for a in range(kh):
+            for b in range(kw):
+                cols[:, :, a, b] = xg[:, :, a * dh:a * dh + oh * sh:sh,
+                                      b * dw:b * dw + ow * sw:sw]
+        out[:, gi * og:(gi + 1) * og] = np.einsum(
+            "nkabhw,okab->nohw", cols, wg)
+    return out
+
+
+def run(model: OnnxModel, inputs: Dict[str, np.ndarray]) -> List[np.ndarray]:
+    env: Dict[str, np.ndarray] = dict(model.initializers)
+    env.update(inputs)
+
+    for node in model.nodes:
+        i = [env[x] for x in node.inputs]
+        a = node.attrs
+        t = node.op_type
+        if t == "Add":
+            o = [i[0] + i[1]]
+        elif t == "Sub":
+            o = [i[0] - i[1]]
+        elif t == "Mul":
+            o = [i[0] * i[1]]
+        elif t == "Div":
+            o = [i[0] / i[1]] if np.issubdtype(
+                np.result_type(i[0], i[1]), np.floating) \
+                else [i[0] // i[1]]
+        elif t == "MatMul":
+            o = [np.matmul(i[0], i[1])]
+        elif t == "Einsum":
+            o = [np.einsum(a["equation"], *i)]
+        elif t == "Conv":
+            o = [_conv2d(i[0], i[1], a.get("strides", [1, 1]),
+                         a.get("pads", [0, 0, 0, 0]),
+                         a.get("dilations", [1, 1]), a.get("group", 1))]
+        elif t == "MaxPool":
+            o = [_pool2d(i[0], a["kernel_shape"], a.get("strides", [1, 1]),
+                         a.get("pads", [0, 0, 0, 0]), "max")]
+        elif t == "AveragePool":
+            o = [_pool2d(i[0], a["kernel_shape"], a.get("strides", [1, 1]),
+                         a.get("pads", [0, 0, 0, 0]), "avg")]
+        elif t == "Max":
+            o = [np.maximum(i[0], i[1])]
+        elif t == "Min":
+            o = [np.minimum(i[0], i[1])]
+        elif t == "Neg":
+            o = [-i[0]]
+        elif t == "Abs":
+            o = [np.abs(i[0])]
+        elif t == "Exp":
+            o = [np.exp(i[0])]
+        elif t == "Log":
+            o = [np.log(i[0])]
+        elif t == "Tanh":
+            o = [np.tanh(i[0])]
+        elif t == "Sigmoid":
+            o = [1.0 / (1.0 + np.exp(-i[0]))]
+        elif t == "Sqrt":
+            o = [np.sqrt(i[0])]
+        elif t == "Reciprocal":
+            o = [1.0 / i[0]]
+        elif t == "Erf":
+            o = [_erf(i[0]).astype(i[0].dtype)]
+        elif t == "Pow":
+            o = [np.power(i[0], i[1]).astype(i[0].dtype)]
+        elif t == "Sign":
+            o = [np.sign(i[0])]
+        elif t in ("Floor", "Ceil"):
+            o = [getattr(np, t.lower())(i[0])]
+        elif t == "Round":
+            o = [np.round(i[0])]
+        elif t in ("Sin", "Cos", "Tan", "Sinh", "Cosh"):
+            o = [getattr(np, t.lower())(i[0])]
+        elif t in ("Asin", "Acos", "Atan", "Asinh", "Acosh", "Atanh"):
+            o = [getattr(np, "arc" + t.lower()[1:])(i[0])]
+        elif t == "And":
+            o = [np.logical_and(i[0], i[1])]
+        elif t == "Or":
+            o = [np.logical_or(i[0], i[1])]
+        elif t == "Xor":
+            o = [np.logical_xor(i[0], i[1])]
+        elif t == "Not":
+            o = [np.logical_not(i[0])]
+        elif t == "Mod":
+            o = [np.fmod(i[0], i[1]) if a.get("fmod") else
+                 np.mod(i[0], i[1])]
+        elif t == "Identity":
+            o = [i[0]]
+        elif t == "Clip":
+            o = [np.clip(i[0], i[1], i[2])]
+        elif t == "Where":
+            o = [np.where(i[0], i[1], i[2])]
+        elif t == "Cast":
+            o = [i[0].astype(proto.ONNX_TO_NP[a["to"]])]
+        elif t == "Equal":
+            o = [i[0] == i[1]]
+        elif t == "Less":
+            o = [i[0] < i[1]]
+        elif t == "LessOrEqual":
+            o = [i[0] <= i[1]]
+        elif t == "Greater":
+            o = [i[0] > i[1]]
+        elif t == "GreaterOrEqual":
+            o = [i[0] >= i[1]]
+        elif t == "ReduceSum":
+            axes = tuple(int(v) for v in i[1]) if len(i) > 1 else None
+            o = [np.sum(i[0], axis=axes, keepdims=bool(a.get(
+                "keepdims", 1)))]
+        elif t in ("ReduceMax", "ReduceMin", "ReduceProd", "ReduceMean"):
+            fn = {"ReduceMax": np.max, "ReduceMin": np.min,
+                  "ReduceProd": np.prod, "ReduceMean": np.mean}[t]
+            o = [fn(i[0], axis=tuple(a["axes"]),
+                    keepdims=bool(a.get("keepdims", 1)))]
+        elif t == "ArgMax":
+            o = [np.argmax(i[0], axis=a["axis"]).astype(np.int64)]
+        elif t == "ArgMin":
+            o = [np.argmin(i[0], axis=a["axis"]).astype(np.int64)]
+        elif t == "Reshape":
+            o = [i[0].reshape([int(v) for v in i[1]])]
+        elif t == "Transpose":
+            o = [np.transpose(i[0], a["perm"])]
+        elif t == "Expand":
+            o = [np.broadcast_to(i[0], [int(v) for v in i[1]]).copy()]
+        elif t == "Concat":
+            o = [np.concatenate(i, axis=a["axis"])]
+        elif t == "Slice":
+            starts, ends = i[1], i[2]
+            axes = i[3] if len(i) > 3 else np.arange(len(starts))
+            steps = i[4] if len(i) > 4 else np.ones(len(starts), np.int64)
+            sl = [slice(None)] * i[0].ndim
+            for s, e, ax, st in zip(starts, ends, axes, steps):
+                s, e, st = int(s), int(e), int(st)
+                lo = None if (st < 0 and s == -1) else s
+                hi = None if (st < 0 and e <= -(1 << 62)) else e
+                sl[int(ax)] = slice(lo, hi, st)
+            o = [i[0][tuple(sl)]]
+        elif t == "Gather":
+            o = [np.take(i[0], i[1].astype(np.int64), axis=a.get(
+                "axis", 0))]
+        elif t == "CumSum":
+            o = [np.cumsum(i[0], axis=int(i[1]))]
+        elif t == "Pad":
+            pads = [int(v) for v in i[1]]
+            half = len(pads) // 2
+            width = list(zip(pads[:half], pads[half:]))
+            cval = float(i[2]) if len(i) > 2 else 0.0
+            o = [np.pad(i[0], width, constant_values=cval)]
+        else:
+            raise NotImplementedError(f"reference runtime: op {t}")
+        for nm, val in zip(node.outputs, o):
+            env[nm] = val
+    return [env[nm] for nm in model.output_names]
