@@ -68,6 +68,69 @@ from . import quantization  # noqa: F401
 from . import ir  # noqa: F401
 from .autograd import grad, no_grad, value_and_grad  # noqa: F401
 from .framework.io import load, save  # noqa: F401
-from .hapi.model import Model  # noqa: F401
+from .hapi.model import Model, summary  # noqa: F401
 from .hapi.dynamic_flops import flops  # noqa: F401
+from .hapi import callbacks  # noqa: F401
 from .nn.layer import Layer, Parameter  # noqa: F401
+
+# ------------------------------------------------------------- 2.x parity
+# Names reference scripts use from the top level (python/paddle/__init__.py).
+import jax as _jax
+import numpy as _np
+
+#: the array type: `isinstance(x, paddle.Tensor)` works on any jax array
+Tensor = _jax.Array
+#: dtype objects are numpy dtypes end-to-end
+dtype = _np.dtype
+bool = bool_  # noqa: A001  (paddle.bool is the bool dtype, like the ref)
+
+from .batch import batch  # noqa: F401,E402
+from . import hub  # noqa: F401,E402
+from .core.device import (  # noqa: F401,E402
+    CUDAPinnedPlace,
+    CUDAPlace,
+    NPUPlace,
+    XPUPlace,
+    get_cudnn_version,
+    is_compiled_with_npu,
+    is_compiled_with_rocm,
+    is_compiled_with_xpu,
+)
+from .distributed.parallel import DataParallel  # noqa: F401,E402
+from .framework import (  # noqa: F401,E402
+    ParamAttr,
+    create_parameter,
+    disable_static,
+    enable_static,
+    get_cuda_rng_state,
+    in_dynamic_mode,
+    set_cuda_rng_state,
+    set_grad_enabled,
+)
+from .tensor.random import check_shape  # noqa: F401,E402
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Reference: `paddle.set_printoptions` (tensor/to_string.py). Arrays
+    print via numpy, so this forwards to `np.set_printoptions`."""
+    kwargs = {}
+    if precision is not None:
+        kwargs["precision"] = precision
+    if threshold is not None:
+        kwargs["threshold"] = threshold
+    if edgeitems is not None:
+        kwargs["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kwargs["linewidth"] = linewidth
+    if sci_mode is not None:
+        kwargs["suppress"] = not sci_mode
+    _np.set_printoptions(**kwargs)
+
+
+def monkey_patch_variable():  # reference: fluid Variable operator patching
+    """No-op: jax arrays already support operators natively."""
+
+
+def monkey_patch_math_varbase():  # reference: dygraph VarBase patching
+    """No-op: jax arrays already support operators natively."""

@@ -1,0 +1,26 @@
+"""`paddle.batch` — legacy reader decorator.
+
+Reference: `python/paddle/batch.py` (wraps a sample-generator into a
+mini-batch generator). Kept for 1.x-style scripts; the 2.x path is
+`paddle_tpu.io.DataLoader`.
+"""
+from __future__ import annotations
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Wrap `reader` (a no-arg callable returning a sample iterator) into a
+    callable returning a batched iterator."""
+    if batch_size <= 0:
+        raise ValueError(f"batch_size must be positive, got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

@@ -58,6 +58,21 @@ class CUDAPinnedPlace(Place):
     kind = "cpu"
 
 
+class CUDAPlace(Place):
+    """Drop-in accelerator place for reference scripts (`place.h`
+    CUDAPlace): maps to the TPU — scripts doing
+    `paddle.CUDAPlace(0) if use_gpu else CPUPlace()` run unchanged."""
+    kind = "tpu"
+
+
+class XPUPlace(Place):
+    kind = "tpu"  # accelerator place alias (reference: Kunlun XPU)
+
+
+class NPUPlace(Place):
+    kind = "tpu"  # accelerator place alias (reference: Ascend NPU)
+
+
 def _kind_of(dev: jax.Device) -> str:
     p = dev.platform.lower()
     if p in ("tpu", "axon"):
@@ -85,6 +100,18 @@ def is_compiled_with_cuda() -> bool:  # API parity
 
 def is_compiled_with_xpu() -> bool:  # API parity
     return False
+
+
+def is_compiled_with_npu() -> bool:  # API parity
+    return False
+
+
+def is_compiled_with_rocm() -> bool:  # API parity
+    return False
+
+
+def get_cudnn_version():  # API parity: no cuDNN on this stack
+    return None
 
 
 def set_device(device: str) -> Place:

@@ -38,3 +38,62 @@ from .parallel import DataParallel  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from . import fleet  # noqa: F401
 from . import meta_parallel  # noqa: F401
+from . import cloud_utils  # noqa: F401
+from . import utils  # noqa: F401
+from .entry_attr import CountFilterEntry, ProbabilityEntry  # noqa: F401
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401
+
+
+class BoxPSDataset(InMemoryDataset):
+    """Reference: fleet/dataset/dataset.py BoxPSDataset — the BoxPS
+    (GPU-accelerated PS) variant of InMemoryDataset. The TPU stack has one
+    memory hierarchy, so this is InMemoryDataset plus the BoxPS method
+    surface (begin/end_pass, wait preload)."""
+
+    def begin_pass(self):
+        pass
+
+    def end_pass(self, need_save_delta=False):
+        pass
+
+    def wait_preload_done(self):
+        pass
+
+    def preload_into_memory(self):
+        self.load_into_memory()
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Reference: `paddle.distributed.split` (collective.py:1282) — model-
+    parallel embedding / row-linear / column-linear over num_partitions.
+
+    TPU-native: delegates to the GSPMD mp layers
+    (`meta_parallel/mp_layers.py`) over the current mesh's model axis —
+    the mesh partitioner handles the sharding the reference does by hand.
+    Creates the parallel layer and applies it (parameters are created per
+    call, like the reference's functional form); prefer the layer classes
+    for repeated use.
+    """
+    from .meta_parallel.mp_layers import (ColumnParallelLinear,
+                                          RowParallelLinear,
+                                          VocabParallelEmbedding)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    if operation != "linear":
+        raise ValueError(f"unsupported operation {operation!r}: expected "
+                         "'linear' or 'embedding'")
+    if axis == 0:
+        layer = RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                  has_bias=bias_attr is not False,
+                                  input_is_parallel=False)
+    elif axis == 1:
+        layer = ColumnParallelLinear(size[0], size[1],
+                                     weight_attr=weight_attr,
+                                     has_bias=bias_attr is not False,
+                                     gather_output=gather_out)
+    else:
+        raise ValueError(f"axis must be 0 or 1, got {axis}")
+    return layer(x)

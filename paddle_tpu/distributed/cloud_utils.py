@@ -1,0 +1,32 @@
+"""Cluster bring-up helpers from cloud environment variables.
+
+Reference: `python/paddle/distributed/cloud_utils.py` (PaddleCloud env →
+cluster/pod objects for the launcher). TPU-native: the launcher contract
+is plain env vars (`distributed/launch.py`), so these helpers parse the
+same variables and return the endpoint layout.
+"""
+from __future__ import annotations
+
+import os
+
+
+def get_cluster_and_pod(args=None):
+    """Parse PADDLE_* env into (trainer_endpoints, current_endpoint,
+    rank, world_size) — the pieces the reference's cluster/pod carry."""
+    endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+    endpoints = [e for e in endpoints if e]
+    current = os.environ.get("PADDLE_CURRENT_ENDPOINT",
+                             endpoints[0] if endpoints else "")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                               str(max(len(endpoints), 1))))
+    return endpoints, current, rank, world
+
+
+def get_cloud_cluster(args_node_ips=None, args_node_ip=None,
+                      args_port=None, selected_devices=None):
+    return get_cluster_and_pod()
+
+
+def use_paddlecloud() -> bool:
+    return os.environ.get("PADDLE_RUNNING_ENV") == "PADDLE_CLOUD"

@@ -1,0 +1,42 @@
+"""Sparse-table entry admission policies.
+
+Reference: `python/paddle/distributed/entry_attr.py` — `ProbabilityEntry`
+(admit a new sparse feature with probability p) and `CountFilterEntry`
+(admit after min_count occurrences). Consumed by the PS sparse table
+(`paddle_tpu/distributed/ps/table.py`) when deciding whether an unseen
+feature id gets a row.
+"""
+from __future__ import annotations
+
+
+class EntryAttr:
+    def _to_attr(self) -> str:
+        raise NotImplementedError
+
+
+class ProbabilityEntry(EntryAttr):
+    def __init__(self, probability: float):
+        if not 0 < probability <= 1:
+            raise ValueError(
+                f"probability must be in (0, 1], got {probability}")
+        self._probability = float(probability)
+
+    def _to_attr(self) -> str:
+        return f"probability_entry:{self._probability}"
+
+    def should_admit(self, rng) -> bool:
+        return bool(rng.random() < self._probability)
+
+
+class CountFilterEntry(EntryAttr):
+    def __init__(self, count_filter: int):
+        if count_filter < 0:
+            raise ValueError(
+                f"count_filter must be >= 0, got {count_filter}")
+        self._count_filter = int(count_filter)
+
+    def _to_attr(self) -> str:
+        return f"count_filter_entry:{self._count_filter}"
+
+    def should_admit(self, seen_count: int) -> bool:
+        return seen_count >= self._count_filter

@@ -1,0 +1,40 @@
+"""`paddle.distributed.utils` parity surface.
+
+Reference: `python/paddle/distributed/utils.py` (free-port discovery,
+endpoint parsing, process watchdogs for the launcher). The launcher here
+(`distributed/launch.py`) carries the process machinery; these are the
+script-facing helpers.
+"""
+from __future__ import annotations
+
+import socket
+
+
+def find_free_ports(num: int):
+    """Reference: utils.py find_free_ports — grab `num` ephemeral ports."""
+    ports = set()
+    socks = []
+    try:
+        while len(ports) < num:
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.bind(("", 0))
+            socks.append(s)
+            ports.add(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return ports
+
+
+def get_host_name_ip():
+    try:
+        host = socket.gethostname()
+        return host, socket.gethostbyname(socket.getfqdn(host))
+    except OSError:
+        return None
+
+
+def add_arguments(argname, type, default, help, argparser, **kwargs):  # noqa: A002
+    """Reference: utils.py add_arguments — argparse sugar used by scripts."""
+    argparser.add_argument("--" + argname, default=default, type=type,
+                           help=help + f" Default: {default}.", **kwargs)

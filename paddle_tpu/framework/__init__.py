@@ -1,4 +1,6 @@
 """Framework-level services: RNG state, parameter/pytree utilities, io."""
+import contextlib as _contextlib
+
 from .random import (  # noqa: F401
     RNGStatesTracker,
     get_rng_state,
@@ -9,3 +11,57 @@ from .random import (  # noqa: F401
     seed,
     set_rng_state,
 )
+from .param_attr import ParamAttr  # noqa: F401
+
+# Reference scripts manage the device RNG stream separately
+# (paddle.get/set_cuda_rng_state); here there is ONE functional key stream.
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Reference: `paddle.create_parameter`
+    (python/paddle/fluid/layers/tensor.py create_parameter) — standalone
+    parameter creation outside a Layer."""
+    from ..nn.layer import Layer
+
+    class _Holder(Layer):
+        pass
+
+    holder = _Holder()
+    param = holder.create_parameter(shape, dtype=dtype, is_bias=is_bias,
+                                    attr=attr,
+                                    default_initializer=default_initializer)
+    if name:
+        param.name = name
+    return param
+
+
+@_contextlib.contextmanager
+def set_grad_enabled(mode: bool):
+    """Reference: `paddle.set_grad_enabled`. Gradients here flow only
+    through explicitly-differentiated functions (`jax.grad`), so this is a
+    parity scope like `no_grad`; kept so reference scripts port unchanged."""
+    yield
+
+
+# Static-graph mode toggle (reference: paddle.enable_static /
+# disable_static / in_dynamic_mode). The execution model here is always
+# eager+jit; the flag only records the caller's declared mode so scripts
+# and `paddle.static` shims can branch on it the way reference code does.
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def in_dynamic_mode() -> bool:
+    return not _static_mode

@@ -1,0 +1,61 @@
+"""ParamAttr — per-parameter creation attributes.
+
+Reference: `python/paddle/fluid/param_attr.py` (`ParamAttr`,
+`WeightNormParamAttr`). Carries name/initializer/learning-rate/
+regularizer/trainable hints that `Layer.create_parameter` folds into the
+created `Parameter`: the initializer runs at creation, `regularizer`
+lands on `Parameter.regularizer` (honored per-param by the optimizer,
+see `paddle_tpu/regularizer.py`), `learning_rate` on
+`Parameter.optimize_attr`, and `trainable=False` sets `stop_gradient`.
+"""
+from __future__ import annotations
+
+
+class ParamAttr:
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(arg):
+        """Reference: `ParamAttr._to_attr` (fluid/param_attr.py:184) —
+        normalize the zoo of accepted weight_attr/bias_attr forms: None and
+        False pass through (default-init / no-param), True means default
+        ParamAttr, str is a name, an Initializer seeds `initializer`, a
+        regularizer seeds `regularizer`, lists (multi-param layers) pass
+        through."""
+        from ..regularizer import WeightDecayRegularizer
+        if arg is None or isinstance(arg, ParamAttr) or arg is False:
+            return arg
+        if arg is True:
+            return ParamAttr()
+        if isinstance(arg, str):
+            return ParamAttr(name=arg)
+        if isinstance(arg, (list, tuple)):
+            return arg
+        if isinstance(arg, WeightDecayRegularizer):
+            return ParamAttr(regularizer=arg)
+        if callable(arg):  # an Initializer
+            return ParamAttr(initializer=arg)
+        raise TypeError(f"cannot make ParamAttr from {type(arg)}")
+
+    def apply_to(self, param):
+        """Fold these attributes onto a created Parameter."""
+        if self.name:
+            param.name = self.name
+        if self.regularizer is not None:
+            param.regularizer = self.regularizer
+        if not self.trainable:
+            param.stop_gradient = True
+        if self.learning_rate != 1.0:
+            attr = dict(param.optimize_attr or {})
+            attr["learning_rate"] = self.learning_rate
+            param.optimize_attr = attr
+        return param

@@ -1,0 +1,65 @@
+"""Model hub — load entrypoints from a `hubconf.py`.
+
+Reference: `python/paddle/hapi/hub.py` (list/help/load over a github repo
+or local dir containing `hubconf.py`). This environment has no egress, so
+`source='github'` raises with a clear message; `source='local'` is fully
+supported and is what the reference uses for pre-downloaded repos.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+MODULE_HUBCONF = "hubconf.py"
+
+
+def _import_hubconf(repo_dir):
+    path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {MODULE_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        sys.path.remove(repo_dir)
+    return module
+
+
+def _resolve_dir(repo_dir, source, force_reload):
+    if source not in ("local", "github", "gitee"):
+        raise ValueError(
+            f"unknown source {source!r}: expected local/github/gitee")
+    if source != "local":
+        raise RuntimeError(
+            "paddle_tpu.hub: remote sources need network egress, which this "
+            "environment does not have; clone the repo and use "
+            "source='local' with its path.")
+    return repo_dir
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """All callable entrypoints defined by the repo's hubconf.py."""
+    m = _import_hubconf(_resolve_dir(repo_dir, source, force_reload))
+    return [k for k, v in vars(m).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    """Docstring of one entrypoint."""
+    m = _import_hubconf(_resolve_dir(repo_dir, source, force_reload))
+    entry = getattr(m, model, None)
+    if entry is None or not callable(entry):
+        raise RuntimeError(f"cannot find callable {model} in hubconf")
+    return entry.__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """Instantiate one entrypoint with kwargs."""
+    m = _import_hubconf(_resolve_dir(repo_dir, source, force_reload))
+    entry = getattr(m, model, None)
+    if entry is None or not callable(entry):
+        raise RuntimeError(f"cannot find callable {model} in hubconf")
+    return entry(**kwargs)

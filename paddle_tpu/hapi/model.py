@@ -257,5 +257,23 @@ class Model:
         return {"total_params": total}
 
 
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Reference: `paddle.summary` (hapi/model_summary.py) — standalone
+    layer summary: per-parameter table + total/trainable counts."""
+    total = trainable = 0
+    lines = []
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape))
+        total += n
+        if getattr(p, "trainable", True):
+            trainable += n
+        lines.append(f"{name:60s} {str(p.shape):24s} {n}")
+    print("\n".join(lines))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    return {"total_params": total, "trainable_params": trainable}
+
+
 def _as_tuple(x):
     return x if isinstance(x, tuple) else (x,)

@@ -1,0 +1,4 @@
+"""`paddle.hub` namespace (reference: python/paddle/hub.py)."""
+from .hapi.hub import help, list, load  # noqa: F401,A004
+
+__all__ = ["list", "help", "load"]

@@ -18,3 +18,4 @@ from .sampler import (  # noqa: F401
     SequenceSampler,
     WeightedRandomSampler,
 )
+from .worker import WorkerInfo, get_worker_info  # noqa: F401

@@ -88,9 +88,32 @@ def _unpack(obj):
     return obj
 
 
+class WorkerInfo:
+    """Reference: `fluid/dataloader/worker.py WorkerInfo` — id/num_workers/
+    dataset visible to code running inside a DataLoader worker."""
+
+    def __init__(self, id, num_workers, dataset):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """Reference: `paddle.io.get_worker_info` (worker.py:72). Returns the
+    current worker's WorkerInfo inside a DataLoader worker process, else
+    None (main process)."""
+    return _worker_info
+
+
 def _worker_loop(dataset, collate_fn, index_queue, result_queue,
-                 use_shm: bool, worker_init_fn, worker_id: int):
+                 use_shm: bool, worker_init_fn, worker_id: int,
+                 num_workers: int = 0):
     """Child body (reference `worker.py:251 _worker_loop`)."""
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
     try:
         if worker_init_fn is not None:
             worker_init_fn(worker_id)
@@ -146,7 +169,7 @@ class MultiprocessBatchIterator:
         p = self._ctx.Process(
             target=_worker_loop,
             args=(self._dataset, self._collate, iq, self._result_q,
-                  self._use_shm, self._init_fn, wid),
+                  self._use_shm, self._init_fn, wid, self._n),
             daemon=True)
         p.start()
         self._procs[wid] = p

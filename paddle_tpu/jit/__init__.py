@@ -228,3 +228,93 @@ def load(path: str):
         exported = jax_export.deserialize(f.read())
     return TranslatedLayer(exported, state["params"], state["buffers"],
                            state.get("input_names"))
+
+
+from . import dy2static  # noqa: F401,E402
+
+
+def not_to_static(func=None):
+    """Reference: `paddle.jit.not_to_static` — mark a function to be left
+    eager by dy2static conversion."""
+    if func is None:
+        return not_to_static
+    func.__ptpu_not_to_static__ = True
+    return func
+
+
+_code_level = 0
+_verbosity = 0
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Reference: jit/set_code_level — log transformed code of dy2static.
+    Level > 0 prints the converted source when `to_static` transforms a
+    function (the AST pipeline here logs the final stage)."""
+    global _code_level
+    _code_level = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    global _verbosity
+    _verbosity = level
+
+
+class ProgramTranslator:
+    """Reference: `fluid/dygraph/dygraph_to_static/program_translator.py`
+    singleton controlling dy2static. The trace+AST pipeline here is
+    per-function; the singleton carries the global enable switch scripts
+    flip (`ProgramTranslator().enable(False)`)."""
+
+    _instance = None
+    enable_to_static = True
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    @classmethod
+    def get_instance(cls):
+        return cls()
+
+    def enable(self, enable_to_static: bool):
+        ProgramTranslator.enable_to_static = bool(enable_to_static)
+
+
+class TracedLayer:
+    """Reference: `fluid/dygraph/jit.py TracedLayer` (trace + static run).
+    `trace` jit-compiles the layer on example inputs; the traced object
+    runs the compiled path and `save_inference_model` exports StableHLO."""
+
+    def __init__(self, layer, static_fn, example_inputs):
+        self._layer = layer
+        self._fn = static_fn
+        self._example = example_inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        import jax as _jax
+        from ..nn.layer import buffer_state, functional_call, \
+            trainable_state
+        params = trainable_state(layer)
+        buffers = buffer_state(layer)
+
+        @_jax.jit
+        def fn(*args):
+            out, _ = functional_call(layer, params, *args, buffers=buffers)
+            return out
+
+        traced = TracedLayer(layer, fn, inputs)
+        return traced(*inputs), traced
+
+    def __call__(self, *args):
+        return self._fn(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        return save(self._layer, path, input_spec=list(self._example))
+
+
+# 1.x decorator aliases (reference: fluid/dygraph/jit.py declarative /
+# dygraph_to_static_func — both became `to_static`)
+declarative = to_static
+dygraph_to_static_func = to_static

@@ -5,6 +5,7 @@ Recall, Auc; reference C++ twins `accuracy_op`, `auc_op`).
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -140,3 +141,13 @@ class Auc(Metric):
         if tp == 0 or fp == 0:
             return 0.0
         return auc / (tp * fp)
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Functional top-k accuracy (reference: `paddle.metric.accuracy`,
+    metrics/accuracy_op). input: [N, C] scores; label: [N] or [N, 1]."""
+    input = jnp.asarray(input)
+    label = jnp.asarray(label).reshape(-1)
+    _, topk = jax.lax.top_k(input, k)
+    hit = jnp.any(topk == label[:, None], axis=1)
+    return jnp.mean(hit.astype(jnp.float32))

@@ -155,3 +155,98 @@ def greedy_search(step_fn: Callable, init_state: Any, batch_size: int,
                                              init_state),
                                       jnp.arange(max_len))
     return seqs
+
+
+def gather_tree(ids, parents):
+    """Reference: `paddle.nn.functional.gather_tree` (gather_tree_op.cc):
+    walk beam-search ancestry backward so each column holds a full
+    hypothesis. ids/parents: [max_time, batch, beam] int. Returns same
+    shape."""
+    ids = jnp.asarray(ids)
+    parents = jnp.asarray(parents)
+    T, B, K = ids.shape
+    beam0 = jnp.tile(jnp.arange(K, dtype=parents.dtype)[None], (B, 1))
+
+    def walk(beam_idx, t):
+        tok = jnp.take_along_axis(ids[t], beam_idx, axis=1)
+        prev = jnp.take_along_axis(parents[t], beam_idx, axis=1)
+        return prev, tok
+
+    _, toks = jax.lax.scan(walk, beam0, jnp.arange(T - 1, -1, -1))
+    return toks[::-1]
+
+
+class BeamSearchDecoder:
+    """Reference: `paddle.nn.BeamSearchDecoder` (layers/rnn.py).
+
+    TPU-native contract: wraps an RNNCell-like `cell` (callable
+    `(inputs [B*K, E], states) -> (outputs, new_states)`) plus an
+    `embedding_fn` (token ids -> embeddings) and optional `output_fn`
+    (cell outputs -> vocab logits). Decoding itself runs through the
+    functional `beam_search` engine (static shapes, lax.scan) via
+    `dynamic_decode`.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def step_fn(self):
+        def step(tokens, state):
+            B, K = tokens.shape
+            flat = tokens.reshape(B * K)
+            emb = self.embedding_fn(flat) if self.embedding_fn is not None \
+                else flat
+            flat_state = jax.tree.map(
+                lambda x: x.reshape((B * K,) + x.shape[2:]), state)
+            out, new_state = self.cell(emb, flat_state)
+            if self.output_fn is not None:
+                out = self.output_fn(out)
+            log_probs = jax.nn.log_softmax(out, axis=-1)
+            unflat = jax.tree.map(
+                lambda x: x.reshape((B, K) + x.shape[1:]), new_state)
+            return log_probs.reshape(B, K, -1), unflat
+
+        return step
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, batch_size=None,
+                   length_penalty=0.0, **kwargs):
+    """Reference: `paddle.nn.dynamic_decode` (layers/rnn.py dynamic_decode).
+    Runs `decoder` (a BeamSearchDecoder) to `max_step_num` steps and
+    returns (sequences [B, K, T], scores [B, K]) best-first.
+
+    Unlike the reference's while_loop with growing arrays, steps run under
+    `lax.scan` with a static `max_step_num` — the XLA shape contract.
+    `inits` are the cell's initial states with leading dim [B]; they are
+    always tiled to [B, K] here (the reference decoder tiles too). Pass
+    `states_tiled=True` via kwargs if yours already carry the beam dim —
+    shape sniffing cannot distinguish [B, K, ...] from [B, H] when
+    H == K, so tiling is never inferred.
+    """
+    if max_step_num is None:
+        raise ValueError("dynamic_decode requires max_step_num (static "
+                         "sequence bound under XLA)")
+    K = decoder.beam_size
+    states_tiled = kwargs.pop("states_tiled", False)
+    if batch_size is None:
+        leaves = jax.tree.leaves(inits)
+        if not leaves:
+            raise ValueError("pass batch_size when inits is empty")
+        batch_size = leaves[0].shape[0]
+
+    def tile(x):
+        x = jnp.asarray(x)
+        if states_tiled:
+            return x
+        return jnp.tile(x[:, None], (1, K) + (1,) * (x.ndim - 1))
+
+    state0 = jax.tree.map(tile, inits) if inits is not None else ()
+    return beam_search(decoder.step_fn(), state0, batch_size, K,
+                       decoder.start_token, decoder.end_token,
+                       int(max_step_num), length_penalty=length_penalty)

@@ -7,3 +7,5 @@ from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
 from .attention import scaled_dot_product_attention  # noqa: F401
 from ..decode import beam_search, greedy_search, hsigmoid_loss  # noqa: F401
+from ..decode import gather_tree  # noqa: F401
+from ...tensor.sequence import sequence_mask  # noqa: F401

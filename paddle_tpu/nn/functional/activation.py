@@ -144,3 +144,11 @@ def thresholded_relu(x, threshold=1.0):
 
 def log_sigmoid(x):
     return jax.nn.log_sigmoid(x)
+
+
+# In-place variants: plain ops in a functional world (reference exposes
+# them as mutation-fused kernels; semantics here are the returned array).
+relu_ = relu
+elu_ = elu
+softmax_ = softmax
+tanh_ = jnp.tanh

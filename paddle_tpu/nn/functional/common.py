@@ -173,3 +173,113 @@ def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
     x = jnp.reshape(x, (n, h, w, r, r, c // (r * r)))
     x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
     return jnp.reshape(x, (n, h * r, w * r, c // (r * r)))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    """Reference: `temporal_shift_op.cc` (TSM): fold channels shifted one
+    segment backward/forward in time; input [N*T, C, H, W]."""
+    if data_format != "NCHW":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    nt, c, h, w = x.shape
+    t = seg_num
+    n = nt // t
+    fold = int(c * shift_ratio)
+    xr = jnp.reshape(x, (n, t, c, h, w))
+    past = jnp.pad(xr[:, 1:, :fold], ((0, 0), (0, 1), (0, 0), (0, 0),
+                                      (0, 0)))            # shift left
+    future = jnp.pad(xr[:, :-1, fold:2 * fold],
+                     ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))  # shift right
+    out = jnp.concatenate([past, future, xr[:, :, 2 * fold:]], axis=2)
+    out = jnp.reshape(out, (nt, c, h, w))
+    if data_format != "NCHW":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    """Reference: `paddle.nn.functional.diag_embed` (diag_embed_op)."""
+    x = jnp.asarray(x)
+    last = x.shape[-1]
+    size = last + abs(offset)
+    idx = jnp.arange(last)
+    rows = idx + max(-offset, 0)
+    cols = idx + max(offset, 0)
+    out = jnp.zeros(x.shape[:-1] + (size, size), x.dtype)
+    out = out.at[..., rows, cols].set(x)
+    nd = out.ndim
+    d1 = dim1 % nd
+    d2 = dim2 % nd
+    if (d1, d2) != (nd - 2, nd - 1):
+        out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+    return out
+
+
+def affine_grid(theta, out_shape, align_corners=True):
+    """Reference: `affine_grid_op.cc`. theta [N, 2, 3]; out_shape
+    [N, C, H, W] -> grid [N, H, W, 2] of (x, y) source coords in [-1, 1]."""
+    n, _, h, w = [int(s) for s in out_shape]
+
+    def axis_coords(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    ys = axis_coords(h)
+    xs = axis_coords(w)
+    base = jnp.stack([
+        jnp.tile(xs[None, :], (h, 1)),
+        jnp.tile(ys[:, None], (1, w)),
+        jnp.ones((h, w)),
+    ], axis=-1)                                   # [H, W, 3]
+    # grid = base @ theta^T per batch
+    return jnp.einsum("hwk,nck->nhwc", base, jnp.asarray(theta))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """Reference: `grid_sampler_op.cc` (cuDNN SpatialTfSampler). x
+    [N, C, H, W]; grid [N, Hg, Wg, 2] of (x, y) in [-1, 1]."""
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError(
+            f"grid_sample padding_mode={padding_mode!r}: 'zeros' and "
+            "'border' are supported")
+    x = jnp.asarray(x)
+    grid = jnp.asarray(grid)
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+
+    def unnorm(g, size):
+        if align_corners:
+            return (g + 1.0) * 0.5 * (size - 1)
+        return ((g + 1.0) * size - 1.0) * 0.5
+
+    ix = unnorm(gx, w)
+    iy = unnorm(gy, h)
+
+    def sample(ix, iy):
+        """Gather x at integer coords with padding handling; returns
+        [N, C, Hg, Wg] plus validity mask for zeros-padding."""
+        valid = (ix >= 0) & (ix <= w - 1) & (iy >= 0) & (iy <= h - 1)
+        ixc = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+        iyc = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+        flat = iyc * w + ixc                       # [N, Hg, Wg]
+        xf = x.reshape(n, c, h * w)
+        got = jnp.take_along_axis(
+            xf, flat.reshape(n, 1, -1).astype(jnp.int32), axis=2)
+        got = got.reshape(n, c, *ix.shape[1:])
+        if padding_mode == "zeros":
+            got = got * valid[:, None].astype(got.dtype)
+        return got
+
+    if mode == "nearest":
+        return sample(jnp.round(ix), jnp.round(iy))
+    x0, y0 = jnp.floor(ix), jnp.floor(iy)
+    x1, y1 = x0 + 1, y0 + 1
+    wa = (x1 - ix) * (y1 - iy)
+    wb = (x1 - ix) * (iy - y0)
+    wc = (ix - x0) * (y1 - iy)
+    wd = (ix - x0) * (iy - y0)
+    out = (sample(x0, y0) * wa[:, None] + sample(x0, y1) * wb[:, None] +
+           sample(x1, y0) * wc[:, None] + sample(x1, y1) * wd[:, None])
+    return out.astype(x.dtype)

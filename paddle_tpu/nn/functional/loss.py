@@ -239,3 +239,33 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     if reduction == "mean":
         return jnp.mean(loss / jnp.maximum(label_lengths, 1))
     return _reduce(loss, reduction)
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """Reference: `python/paddle/fluid/layers/nn.py dice_loss` —
+    1 - 2|X∩Y| / (|X|+|Y|) over all but the batch dim; `input` is
+    probabilities [N, ..., C], `label` class ids [N, ..., 1]."""
+    label = jnp.squeeze(jnp.asarray(label), axis=-1)
+    one_hot = jax.nn.one_hot(label, input.shape[-1], dtype=input.dtype)
+    reduce_axes = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * one_hot, axis=reduce_axes)
+    union = jnp.sum(input, axis=reduce_axes) + jnp.sum(one_hot,
+                                                       axis=reduce_axes)
+    return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """Reference: `fluid/layers/loss.py npair_loss` (improved deep metric
+    learning): cross-entropy over anchor·positiveᵀ similarities with
+    same-label targets + L2 on the embeddings."""
+    anchor = jnp.asarray(anchor)
+    positive = jnp.asarray(positive)
+    labels = jnp.asarray(labels).reshape(-1)
+    same = (labels[:, None] == labels[None, :]).astype(anchor.dtype)
+    targets = same / jnp.sum(same, axis=1, keepdims=True)
+    sim = anchor @ positive.T
+    ce = jnp.mean(jnp.sum(
+        -targets * jax.nn.log_softmax(sim, axis=1), axis=1))
+    l2 = jnp.sum(anchor * anchor) / anchor.shape[0] \
+        + jnp.sum(positive * positive) / positive.shape[0]
+    return ce + l2_reg * l2 * 0.25

@@ -142,3 +142,38 @@ def adaptive_avg_pool1d(x, output_size):
 def global_avg_pool2d(x, data_format="NCHW"):
     axes = (2, 3) if data_format == "NCHW" else (1, 2)
     return jnp.mean(x, axis=axes, keepdims=True)
+
+
+def adaptive_max_pool1d(x, output_size):
+    n, c, l = x.shape
+    o = output_size if isinstance(output_size, int) else output_size[0]
+    assert l % o == 0, "adaptive_max_pool1d requires divisible sizes on TPU"
+    return jnp.max(jnp.reshape(x, (n, c, o, l // o)), axis=3)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    od, oh, ow = _tuple(output_size, 3)
+    if data_format == "NCDHW":
+        n, c, d, h, w = x.shape
+        assert d % od == 0 and h % oh == 0 and w % ow == 0
+        return jnp.mean(jnp.reshape(
+            x, (n, c, od, d // od, oh, h // oh, ow, w // ow)),
+            axis=(3, 5, 7))
+    n, d, h, w, c = x.shape
+    assert d % od == 0 and h % oh == 0 and w % ow == 0
+    return jnp.mean(jnp.reshape(
+        x, (n, od, d // od, oh, h // oh, ow, w // ow, c)), axis=(2, 4, 6))
+
+
+def adaptive_max_pool3d(x, output_size, data_format="NCDHW"):
+    od, oh, ow = _tuple(output_size, 3)
+    if data_format == "NCDHW":
+        n, c, d, h, w = x.shape
+        assert d % od == 0 and h % oh == 0 and w % ow == 0
+        return jnp.max(jnp.reshape(
+            x, (n, c, od, d // od, oh, h // oh, ow, w // ow)),
+            axis=(3, 5, 7))
+    n, d, h, w, c = x.shape
+    assert d % od == 0 and h % oh == 0 and w % ow == 0
+    return jnp.max(jnp.reshape(
+        x, (n, od, d // od, oh, h // oh, ow, w // ow, c)), axis=(2, 4, 6))

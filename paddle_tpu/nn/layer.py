@@ -149,14 +149,24 @@ class Layer:
     def create_parameter(self, shape, dtype=None, is_bias=False,
                          default_initializer=None, attr=None) -> Parameter:
         """Reference: `Layer.create_parameter` → `LayerHelper` param creation
-        (`fluid/layer_helper.py`)."""
+        (`fluid/layer_helper.py`). `attr` accepts a `ParamAttr` (or a
+        name/initializer it normalizes from) whose initializer overrides
+        `default_initializer` and whose regularizer/trainable/lr hints land
+        on the created Parameter."""
         from . import initializer as I
+        from ..framework.param_attr import ParamAttr
+        attr = ParamAttr._to_attr(attr)
         dtype = convert_dtype(dtype) or self._dtype
+        if isinstance(attr, ParamAttr) and attr.initializer is not None:
+            default_initializer = attr.initializer
         if default_initializer is None:
             default_initializer = I.Constant(0.0) if is_bias \
                 else I.XavierUniform()
         value = default_initializer(tuple(int(s) for s in shape), dtype)
-        return Parameter(value, name=_unique_name(self._full_name + ".w"))
+        param = Parameter(value, name=_unique_name(self._full_name + ".w"))
+        if isinstance(attr, ParamAttr):
+            attr.apply_to(param)
+        return param
 
     def register_buffer(self, name: str, tensor, persistable: bool = True):
         buf = Parameter(tensor, name=f"{self._full_name}.{name}",

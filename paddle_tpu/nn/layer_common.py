@@ -25,14 +25,12 @@ class Linear(Layer):
         self.in_features = in_features
         self.out_features = out_features
         self.weight = self.create_parameter(
-            (in_features, out_features),
-            default_initializer=weight_attr if isinstance(weight_attr, I.Initializer) else None)
+            (in_features, out_features), attr=weight_attr)
         if bias_attr is False:
             self.bias = None
         else:
             self.bias = self.create_parameter(
-                (out_features,), is_bias=True,
-                default_initializer=bias_attr if isinstance(bias_attr, I.Initializer) else None)
+                (out_features,), is_bias=True, attr=bias_attr)
 
     def forward(self, x):
         return F.linear(x, self.weight, self.bias)
@@ -51,8 +49,8 @@ class Embedding(Layer):
         self.embedding_dim = embedding_dim
         self.padding_idx = padding_idx
         self.weight = self.create_parameter(
-            (num_embeddings, embedding_dim),
-            default_initializer=weight_attr if isinstance(weight_attr, I.Initializer) else I.Normal(0.0, 1.0))
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=I.Normal(0.0, 1.0))
         if padding_idx is not None:
             self.weight.value = self.weight.value.at[padding_idx].set(0.0)
 
@@ -353,3 +351,98 @@ class Maxout(Layer):
 
     def forward(self, x):
         return F.maxout(x, self.groups, self.axis)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.dropout3d(x, p=self.p, training=self.training,
+                           data_format=self.data_format)
+
+
+class Pad1D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCL"):
+        super().__init__()
+        self.padding, self.mode = padding, mode
+        self.value, self.data_format = value, data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, self.mode, self.value,
+                     self.data_format)
+
+
+class Pad3D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW"):
+        super().__init__()
+        self.padding, self.mode = padding, mode
+        self.value, self.data_format = value, data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, self.mode, self.value,
+                     self.data_format)
+
+
+class Unfold(Layer):
+    """Reference: `paddle.nn.Unfold` (im2col, unfold_op)."""
+
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self.kernel_sizes = kernel_sizes
+        self.strides = strides
+        self.paddings = paddings
+        self.dilations = dilations
+
+    def forward(self, x):
+        return F.unfold(x, self.kernel_sizes, self.strides, self.paddings,
+                        self.dilations)
+
+
+class UpsamplingNearest2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.size, self.scale_factor = size, scale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.interpolate(x, self.size, self.scale_factor, "nearest",
+                             False, self.data_format)
+
+
+class UpsamplingBilinear2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.size, self.scale_factor = size, scale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.interpolate(x, self.size, self.scale_factor, "bilinear",
+                             True, self.data_format)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self.axis, self.eps = axis, eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, axis=self.axis, eps=self.eps)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        d = jnp.abs(x - y) + self.epsilon
+        return jnp.sum(d ** self.p, axis=-1,
+                       keepdims=self.keepdim) ** (1.0 / self.p)

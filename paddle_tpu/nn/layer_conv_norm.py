@@ -39,16 +39,16 @@ class _ConvNd(Layer):
         else:
             wshape = (out_channels, in_channels // groups) + self.kernel_size
             fan_in = in_channels // groups * int(np.prod(self.kernel_size))
-        init = weight_attr if isinstance(weight_attr, I.Initializer) else \
-            I.KaimingUniform(fan_in=fan_in)
-        self.weight = self.create_parameter(wshape, default_initializer=init)
+        self.weight = self.create_parameter(
+            wshape, attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in))
         if bias_attr is False:
             self.bias = None
         else:
-            binit = bias_attr if isinstance(bias_attr, I.Initializer) else \
-                I.Uniform(-1.0 / np.sqrt(fan_in), 1.0 / np.sqrt(fan_in))
             self.bias = self.create_parameter(
-                (out_channels,), is_bias=True, default_initializer=binit)
+                (out_channels,), is_bias=True, attr=bias_attr,
+                default_initializer=I.Uniform(-1.0 / np.sqrt(fan_in),
+                                              1.0 / np.sqrt(fan_in)))
 
     def forward(self, x):
         fn = {1: (F.conv1d, F.conv1d_transpose),
@@ -141,11 +141,13 @@ class _BatchNormBase(Layer):
             self.weight = None
         else:
             self.weight = self.create_parameter(
-                (num_features,), default_initializer=I.Constant(1.0))
+                (num_features,), attr=weight_attr,
+                default_initializer=I.Constant(1.0))
         if bias_attr is False:
             self.bias = None
         else:
-            self.bias = self.create_parameter((num_features,), is_bias=True)
+            self.bias = self.create_parameter((num_features,), is_bias=True,
+                                              attr=bias_attr)
         self.register_buffer("_mean", jnp.zeros((num_features,)))
         self.register_buffer("_variance", jnp.ones((num_features,)))
 
@@ -259,9 +261,10 @@ class LayerNorm(Layer):
         self.normalized_shape = tuple(normalized_shape)
         self.epsilon = epsilon
         self.weight = None if weight_attr is False else self.create_parameter(
-            self.normalized_shape, default_initializer=I.Constant(1.0))
+            self.normalized_shape, attr=weight_attr,
+            default_initializer=I.Constant(1.0))
         self.bias = None if bias_attr is False else self.create_parameter(
-            self.normalized_shape, is_bias=True)
+            self.normalized_shape, is_bias=True, attr=bias_attr)
 
     def forward(self, x):
         return F.layer_norm(x, self.normalized_shape, self.weight, self.bias,
@@ -293,9 +296,10 @@ class GroupNorm(Layer):
         self.epsilon = epsilon
         self.data_format = data_format
         self.weight = None if weight_attr is False else self.create_parameter(
-            (num_channels,), default_initializer=I.Constant(1.0))
+            (num_channels,), attr=weight_attr,
+            default_initializer=I.Constant(1.0))
         self.bias = None if bias_attr is False else self.create_parameter(
-            (num_channels,), is_bias=True)
+            (num_channels,), is_bias=True, attr=bias_attr)
 
     def forward(self, x):
         return F.group_norm(x, self.num_groups, self.weight, self.bias,
@@ -310,9 +314,10 @@ class InstanceNorm2D(Layer):
         self.epsilon = epsilon
         self.data_format = data_format
         self.weight = None if weight_attr is False else self.create_parameter(
-            (num_features,), default_initializer=I.Constant(1.0))
+            (num_features,), attr=weight_attr,
+            default_initializer=I.Constant(1.0))
         self.bias = None if bias_attr is False else self.create_parameter(
-            (num_features,), is_bias=True)
+            (num_features,), is_bias=True, attr=bias_attr)
 
     def forward(self, x):
         return F.instance_norm(x, weight=self.weight, bias=self.bias,
@@ -434,3 +439,67 @@ class AdaptiveAvgPool1D(Layer):
 
     def forward(self, x):
         return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self.output_size)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size, self.data_format)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self.output_size)
+
+
+class SpectralNorm(Layer):
+    """Reference: `paddle.nn.SpectralNorm` (spectral_norm_op.cc): power
+    iteration estimating sigma_max of the reshaped weight; u/v live in
+    buffers and refresh each forward in training."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        h = int(weight_shape[dim])
+        w = int(np.prod(weight_shape)) // h
+        from ..framework.random import next_key
+        import jax as _jax
+        self.register_buffer(
+            "weight_u", _jax.random.normal(next_key(), (h,), jnp.float32))
+        self.register_buffer(
+            "weight_v", _jax.random.normal(next_key(), (w,), jnp.float32))
+
+    def forward(self, weight):
+        w = weight.value if hasattr(weight, "value") else weight
+        mat = jnp.moveaxis(w, self.dim, 0).reshape(w.shape[self.dim], -1)
+        u, v = self.weight_u.value, self.weight_v.value
+        for _ in range(max(1, self.power_iters)):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + self.eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + self.eps)
+        if self.training:
+            self.weight_u.value = u
+            self.weight_v.value = v
+        sigma = u @ mat @ v
+        return w / sigma

@@ -123,3 +123,31 @@ class CTCLoss(Layer):
     def forward(self, log_probs, labels, input_lengths, label_lengths):
         return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
                           self.blank, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Reference: `paddle.nn.HSigmoidLoss` (hierarchical_sigmoid_op.cc):
+    complete-binary-tree hierarchical softmax over `num_classes` leaves;
+    internal-node vectors are the layer's weight."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError(
+                "custom trees need path/code tables; the complete-binary "
+                "tree of the reference's default mode is supported")
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (num_classes - 1,), is_bias=True, attr=bias_attr)
+
+    def forward(self, input, label):
+        from .decode import hsigmoid_loss
+        import jax.numpy as jnp
+        w = self.weight.value
+        b = self.bias.value if self.bias is not None else None
+        label = jnp.reshape(label, (-1,))
+        return jnp.mean(hsigmoid_loss(input, label, self.num_classes, w, b))

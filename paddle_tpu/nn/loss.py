@@ -1,0 +1,6 @@
+"""`paddle.nn.loss` submodule path parity (reference exposes loss layer
+classes both at `paddle.nn.X` and `paddle.nn.loss.X`)."""
+from .layer_loss import *  # noqa: F401,F403
+from .layer_loss import (  # noqa: F401
+    BCELoss, CrossEntropyLoss, CTCLoss, HSigmoidLoss, KLDivLoss, L1Loss,
+    MSELoss, NLLLoss, SmoothL1Loss)

@@ -1,0 +1,110 @@
+"""`paddle.nn.utils` — weight reparameterizations.
+
+Reference: python/paddle/nn/utils/weight_norm_hook.py (forward pre-hooks
+rewriting `weight` from `weight_g`/`weight_v`) and spectral_norm_hook.py.
+The same hook mechanism exists here (`Layer.register_forward_pre_hook`),
+so the implementation mirrors the reference's shape directly.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .layer import Parameter
+
+
+def _norm_except(v, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(v * v))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize `layer.<name>` as g * v / ||v|| (weight_norm_hook.py).
+    Returns the layer; `weight_g`/`weight_v` become the trainable params."""
+    w = getattr(layer, name)
+    v = w.value if hasattr(w, "value") else jnp.asarray(w)
+    g = _norm_except(v, dim)
+    layer.add_parameter(name + "_g", Parameter(g, name=name + "_g"))
+    layer.add_parameter(name + "_v", Parameter(v, name=name + "_v"))
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        gv = lyr._parameters[name + "_g"].value
+        vv = lyr._parameters[name + "_v"].value
+        object.__setattr__(lyr, name,
+                           gv * vv / (_norm_except(vv, dim) + 1e-12))
+        return inputs
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer.__dict__.setdefault("_weight_norm_hooks", {})[name] = handle
+    hook(layer, ())  # materialize once so eager access works pre-forward
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Undo `weight_norm`: bake the current normalized weight back."""
+    handles = layer.__dict__.get("_weight_norm_hooks", {})
+    if name not in handles:
+        raise ValueError(f"no weight_norm hook on parameter {name!r}")
+    handles.pop(name).remove()
+    g = layer._parameters.pop(name + "_g")
+    v = layer._parameters.pop(name + "_v")
+    dim_norm = _norm_except(v.value, _infer_dim(g.value))
+    w = g.value * v.value / (dim_norm + 1e-12)
+    layer.add_parameter(name, Parameter(w, name=name))
+    return layer
+
+
+def _infer_dim(g):
+    if g.ndim == 0:
+        return None
+    return int(np.argmax(np.asarray(g.shape) != 1)) \
+        if any(s != 1 for s in g.shape) else 0
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Reference: `paddle.nn.utils.spectral_norm` (spectral_norm_hook.py):
+    divide the weight by its spectral norm, estimated by power iteration
+    refreshed on every forward in training."""
+    import jax
+    from ..framework.random import next_key
+
+    w = getattr(layer, name)
+    v0 = w.value if hasattr(w, "value") else jnp.asarray(w)
+    if dim is None:
+        dim = 1 if type(layer).__name__.endswith("Transpose") else 0
+    h = v0.shape[dim]
+    ncols = int(np.prod(v0.shape)) // h
+    layer.register_buffer(name + "_u",
+                          jax.random.normal(next_key(), (h,), jnp.float32))
+    layer.register_buffer(name + "_v",
+                          jax.random.normal(next_key(), (ncols,),
+                                            jnp.float32))
+    orig = layer._parameters[name]
+    layer._parameters[name + "_orig"] = orig
+    del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        wv = lyr._parameters[name + "_orig"].value
+        mat = jnp.moveaxis(wv, dim, 0).reshape(h, -1)
+        u = lyr._buffers[name + "_u"].value
+        v = lyr._buffers[name + "_v"].value
+        for _ in range(max(1, n_power_iterations)):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        if lyr.training:
+            lyr._buffers[name + "_u"].value = u
+            lyr._buffers[name + "_v"].value = v
+        sigma = u @ mat @ v
+        object.__setattr__(lyr, name, wv / sigma)
+        return inputs
+
+    layer.register_forward_pre_hook(hook)
+    hook(layer, ())
+    return layer

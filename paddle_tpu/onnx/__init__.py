@@ -37,12 +37,22 @@ def export(layer, path, input_spec=None, opset_version=13,
         raise ValueError("paddle_tpu.onnx.export requires input_spec")
     if opset_version < 13:
         # the converter emits opset-13 op forms (ReduceSum axes input,
-        # Clip min/max inputs, Pad pads input, Slice starts/ends inputs)
-        raise ValueError(
-            f"opset_version must be >= 13 (got {opset_version})")
+        # Clip min/max inputs, Pad pads input, Slice starts/ends inputs);
+        # reference scripts pass the old default of 9 — clamp, don't break
+        warnings.warn(
+            f"opset_version={opset_version} not supported; emitting "
+            "opset 13 op forms instead", UserWarning, stacklevel=2)
+        opset_version = 13
     example = []
     for spec in input_spec:
         if isinstance(spec, InputSpec):
+            if any(d in (None, -1) for d in spec.shape):
+                # ONNX dims here are static (taken from traced avals)
+                warnings.warn(
+                    f"dynamic dims in {list(spec.shape)} are exported "
+                    "statically as 1; re-export per shape or use "
+                    "jit.save (StableHLO) for shape polymorphism",
+                    UserWarning, stacklevel=2)
             shape = [1 if d in (None, -1) else int(d) for d in spec.shape]
             example.append(jnp.zeros(shape, spec.dtype or jnp.float32))
         else:

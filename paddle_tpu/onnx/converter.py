@@ -85,9 +85,11 @@ _ELEMENTWISE = {
     "asin": "Asin", "acos": "Acos", "atan": "Atan",
     "sinh": "Sinh", "cosh": "Cosh",
     "asinh": "Asinh", "acosh": "Acosh", "atanh": "Atanh",
-    "and": "And", "or": "Or", "not": "Not", "xor": "Xor",
     "stop_gradient": "Identity", "copy": "Identity",
 }
+
+# ONNX And/Or/Not/Xor are boolean-only; jax's primitives are bitwise
+_LOGICAL = {"and": "And", "or": "Or", "not": "Not", "xor": "Xor"}
 
 _COMPARE = {"eq": "Equal", "lt": "Less", "le": "LessOrEqual",
             "gt": "Greater", "ge": "GreaterOrEqual"}
@@ -171,9 +173,10 @@ def _pool(g: _Graph, eqn, ins, kind: str):
     # sum pool = AveragePool(count_include_pad) * prod(window)
     y = g.add("AveragePool", ins, kernel_shape=list(wd[2:]),
               strides=list(ws[2:]), pads=pads, count_include_pad=1)[0]
-    count = float(np.prod(wd))
-    scale = g.constant(np.asarray(count, np.result_type(
-        np.float32)), "winsize")
+    out_dt = np.dtype(eqn.outvars[0].aval.dtype)
+    if out_dt == np.dtype(jnp.bfloat16):
+        out_dt = np.dtype(np.float32)
+    scale = g.constant(np.asarray(float(np.prod(wd)), out_dt), "winsize")
     return g.add("Mul", [y, scale])
 
 
@@ -242,6 +245,12 @@ def _convert_eqn(g: _Graph, eqn):
 
     if prim in _ELEMENTWISE:
         out(g.add(_ELEMENTWISE[prim], ins))
+    elif prim in _LOGICAL:
+        if np.dtype(eqn.invars[0].aval.dtype) != np.bool_:
+            raise UnsupportedPrimitive(
+                f"bitwise {prim} on non-bool inputs (ONNX opset 13 has "
+                "no integer bitwise ops)")
+        out(g.add(_LOGICAL[prim], ins))
     elif prim in _COMPARE:
         out(g.add(_COMPARE[prim], ins))
     elif prim == "ne":

@@ -169,9 +169,12 @@ def run(model: OnnxModel, inputs: Dict[str, np.ndarray]) -> List[np.ndarray]:
         elif t == "Mul":
             o = [i[0] * i[1]]
         elif t == "Div":
-            o = [i[0] / i[1]] if np.issubdtype(
-                np.result_type(i[0], i[1]), np.floating) \
-                else [i[0] // i[1]]
+            if np.issubdtype(np.result_type(i[0], i[1]), np.floating):
+                o = [i[0] / i[1]]
+            else:  # ONNX/XLA integer div truncates toward zero, not floor
+                o = [(np.sign(i[0]) * np.sign(i[1]) *
+                      (np.abs(i[0]) // np.abs(i[1]))).astype(
+                          np.result_type(i[0], i[1]))]
         elif t == "MatMul":
             o = [np.matmul(i[0], i[1])]
         elif t == "Einsum":

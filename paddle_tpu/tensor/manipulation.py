@@ -260,6 +260,8 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
     """Reference: pad_op / pad3d_op. `pad` is paddle's flat low/high list
     covering the trailing dims (or all dims when len==2*ndim)."""
     ndim = jnp.ndim(x)
+    if isinstance(pad, int):  # same pad on every spatial boundary
+        pad = [pad] * (2 * (ndim - 2))
     pad = list(pad)
     if len(pad) == 2 * ndim:
         pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(ndim)]
@@ -311,3 +313,27 @@ def tensordot(x, y, axes=2):
 
 def tolist(x):
     return np.asarray(x).tolist()
+
+
+# paddle.reverse is the flip alias (reverse_op == flip semantics)
+reverse = flip
+
+
+# In-place variants (`x.op_()`): plain ops in a functional world — they
+# return the new array; the reference's mutation contract is documented at
+# the Tensor wrapper level.
+
+def reshape_(x, shape):
+    return reshape(x, shape)
+
+
+def squeeze_(x, axis=None):
+    return squeeze(x, axis)
+
+
+def unsqueeze_(x, axis):
+    return unsqueeze(x, axis)
+
+
+def scatter_(x, index, updates, overwrite=True):
+    return scatter(x, index, updates, overwrite=overwrite)

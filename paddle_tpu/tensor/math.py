@@ -481,3 +481,32 @@ def rsqrt_(x):  # inplace aliases are plain ops in a functional world
 
 def broadcast_shape(x_shape, y_shape):
     return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def add_n(inputs):
+    """Reference: `paddle.add_n` (sum_op) — elementwise sum of a list."""
+    if not isinstance(inputs, (list, tuple)):
+        return jnp.asarray(inputs)
+    total = inputs[0]
+    for t in inputs[1:]:
+        total = total + t
+    return total
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    """Reference: `paddle.trace` (trace_op)."""
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    """Reference: `paddle.diagonal` (diagonal_op)."""
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def floor_mod(x, y):
+    """Reference: `paddle.floor_mod` — alias of mod (elementwise_mod)."""
+    return mod(x, y)
+
+
+def tanh_(x):  # inplace alias: plain op in a functional world
+    return jnp.tanh(x)

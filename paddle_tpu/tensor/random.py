@@ -92,3 +92,25 @@ def exponential_(x, lam=1.0):
 
 def normal_like(x, mean=0.0, std=1.0):
     return jax.random.normal(next_key(), x.shape, dtype=x.dtype) * std + mean
+
+
+def check_shape(shape):
+    """Validate a shape argument before creation ops (reference:
+    fluid/layers/utils.py check_shape, exported as `paddle.check_shape`)."""
+    if hasattr(shape, "dtype"):  # traced/array shape: dtype must be integral
+        import numpy as np
+        if np.dtype(shape.dtype).kind not in "iu":
+            raise TypeError("shape tensor must be int32/int64, got "
+                            f"{shape.dtype}")
+        return
+    for ele in shape:
+        if hasattr(ele, "dtype"):
+            continue
+        if not isinstance(ele, int):
+            raise TypeError(
+                "All elements in `shape` must be integers when it's a "
+                f"list or tuple, got {type(ele)}")
+        if ele < 0:
+            raise ValueError(
+                "All elements in `shape` must be positive when it's a "
+                f"list or tuple, got {ele}")

@@ -3,3 +3,11 @@ from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
+from .image import (  # noqa: F401
+    get_image_backend,
+    image_load,
+    set_image_backend,
+)
+from .models import LeNet  # noqa: F401  (reference re-exports it here)
+
+models_LeNet = LeNet

@@ -1,0 +1,36 @@
+"""Image backend selection + loading.
+
+Reference: `python/paddle/vision/image.py` (set_image_backend /
+get_image_backend / image_load over 'pil' and 'cv2'). cv2 is not in this
+image; 'pil' is the default and 'numpy' loads .npy arrays.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend: str):
+    if backend not in ("pil", "cv2", "numpy"):
+        raise ValueError(
+            f"expected 'pil', 'cv2' or 'numpy', got {backend!r}")
+    if backend == "cv2":
+        raise RuntimeError("cv2 is not available in this environment; "
+                           "use 'pil' or 'numpy'")
+    global _image_backend
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file. 'pil' returns a PIL.Image (reference contract);
+    'numpy' reads a .npy array."""
+    backend = backend or _image_backend
+    if backend == "numpy" or str(path).endswith(".npy"):
+        return np.load(path)
+    from PIL import Image
+    return Image.open(path)

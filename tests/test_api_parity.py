@@ -1,0 +1,149 @@
+"""Top-level API parity freeze.
+
+Mirrors the reference's API-signature freeze gate
+(tools/print_signatures.py, SURVEY §4 CI tooling): every public name the
+reference exports from `python/paddle/__init__.py` must exist on
+`paddle_tpu`. Parsed from the reference source via AST so the check tracks
+the actual surface, not a hand-copied list.
+"""
+import ast
+import os
+
+import pytest
+
+REF_INIT = "/root/reference/python/paddle/__init__.py"
+
+
+def _reference_names():
+    tree = ast.parse(open(REF_INIT).read())
+    names = set()
+    for node in tree.body:
+        if isinstance(node, ast.ImportFrom) and node.names:
+            for a in node.names:
+                if a.name != "*":
+                    names.add(a.asname or a.name)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", "") == "__all__":
+                    try:
+                        names |= set(ast.literal_eval(node.value))
+                    except ValueError:
+                        pass
+    return {n for n in names if not n.startswith("_")}
+
+
+@pytest.mark.skipif(not os.path.exists(REF_INIT),
+                    reason="reference tree not mounted")
+def test_top_level_names_all_present():
+    import paddle_tpu
+    names = _reference_names()
+    assert len(names) > 200  # sanity: the parse really found the surface
+    missing = sorted(n for n in names if not hasattr(paddle_tpu, n))
+    assert missing == [], f"top-level API gaps vs reference: {missing}"
+
+
+class TestParamAttr:
+    def test_initializer_and_trainable(self):
+        import numpy as np
+        import paddle_tpu as pt
+        attr = pt.ParamAttr(initializer=pt.nn.initializer.Constant(2.0),
+                            trainable=False)
+        lin = pt.nn.Linear(3, 2, weight_attr=attr)
+        assert np.allclose(np.asarray(lin.weight.value), 2.0)
+        assert lin.weight.stop_gradient
+        assert lin.weight.value.shape == (3, 2)
+
+    def test_regularizer_reaches_param(self):
+        import paddle_tpu as pt
+        reg = pt.regularizer.L2Decay(0.5)
+        conv = pt.nn.Conv2D(3, 4, 3, weight_attr=pt.ParamAttr(
+            regularizer=reg))
+        assert conv.weight.regularizer is reg
+
+    def test_name_and_str_attr(self):
+        import paddle_tpu as pt
+        lin = pt.nn.Linear(2, 2, weight_attr="my_weight")
+        assert lin.weight.name == "my_weight"
+
+    def test_create_parameter_top_level(self):
+        import paddle_tpu as pt
+        p = pt.create_parameter([4, 3], attr=pt.ParamAttr(name="w0"))
+        assert p.shape == (4, 3) and p.name == "w0"
+
+
+class TestMiscShims:
+    def test_tensor_isinstance(self):
+        import paddle_tpu as pt
+        assert isinstance(pt.to_tensor([1.0]), pt.Tensor)
+
+    def test_math_additions(self):
+        import numpy as np
+        import paddle_tpu as pt
+        assert float(pt.trace(pt.to_tensor(np.eye(4)))) == 4.0
+        assert pt.diagonal(pt.to_tensor(np.eye(3))).shape == (3,)
+        np.testing.assert_array_equal(
+            np.asarray(pt.add_n([pt.to_tensor([1.0]), pt.to_tensor([2.0]),
+                                 pt.to_tensor([3.0])])), [6.0])
+        np.testing.assert_array_equal(
+            np.asarray(pt.reverse(pt.to_tensor([1, 2, 3]), 0)), [3, 2, 1])
+        np.testing.assert_array_equal(
+            np.asarray(pt.floor_mod(pt.to_tensor([5]), pt.to_tensor([3]))),
+            [2])
+
+    def test_batch_reader(self):
+        import paddle_tpu as pt
+        out = list(pt.batch(lambda: iter(range(7)), 3)())
+        assert [len(b) for b in out] == [3, 3, 1]
+        out = list(pt.batch(lambda: iter(range(7)), 3, drop_last=True)())
+        assert [len(b) for b in out] == [3, 3]
+
+    def test_static_mode_flag(self):
+        import paddle_tpu as pt
+        assert pt.in_dynamic_mode()
+        pt.enable_static()
+        try:
+            assert not pt.in_dynamic_mode()
+        finally:
+            pt.disable_static()
+        assert pt.in_dynamic_mode()
+
+    def test_places(self):
+        import paddle_tpu as pt
+        # accelerator aliases construct; scripts branch on them freely
+        for cls in (pt.CUDAPlace, pt.XPUPlace, pt.NPUPlace):
+            assert cls(0).device_id == 0
+        assert pt.get_cudnn_version() is None
+        assert not pt.is_compiled_with_rocm()
+
+    def test_hub_local(self, tmp_path):
+        import paddle_tpu as pt
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny(n=2):\n"
+            "    'a tiny model'\n"
+            "    import paddle_tpu as pt\n"
+            "    return pt.nn.Linear(n, n)\n")
+        assert "tiny" in pt.hub.list(str(tmp_path), source="local")
+        assert "tiny model" in pt.hub.help(str(tmp_path), "tiny",
+                                           source="local")
+        layer = pt.hub.load(str(tmp_path), "tiny", source="local", n=3)
+        assert layer.weight.value.shape == (3, 3)
+
+    def test_check_shape(self):
+        import pytest as _pytest
+        import paddle_tpu as pt
+        pt.check_shape([2, 3])
+        with _pytest.raises(ValueError):
+            pt.check_shape([-2, 3])
+        with _pytest.raises(TypeError):
+            pt.check_shape([2.5])
+
+    def test_inplace_aliases(self):
+        import numpy as np
+        import paddle_tpu as pt
+        x = pt.to_tensor([[1.0, 2.0]])
+        assert pt.squeeze_(x, 0).shape == (2,)
+        assert pt.unsqueeze_(x, 0).shape == (1, 1, 2)
+        assert pt.reshape_(x, [2, 1]).shape == (2, 1)
+        np.testing.assert_allclose(np.asarray(pt.tanh_(x)),
+                                   np.tanh([[1.0, 2.0]]), rtol=1e-6)

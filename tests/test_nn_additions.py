@@ -1,0 +1,243 @@
+"""Tests for the round-3 nn surface additions: adaptive pools, grid
+sampling, temporal shift, spectral/weight norm, beam-search decoder API,
+hsigmoid layer, metric.accuracy, distributed entry attrs.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+
+
+class TestFunctionalAdditions:
+    def test_adaptive_pools(self):
+        x = pt.to_tensor(np.arange(2 * 3 * 8, dtype=np.float32)
+                         .reshape(2, 3, 8))
+        assert F.adaptive_max_pool1d(x, 4).shape == (2, 3, 4)
+        x3 = pt.to_tensor(np.random.RandomState(0).randn(
+            1, 2, 4, 4, 4).astype(np.float32))
+        assert F.adaptive_avg_pool3d(x3, 2).shape == (1, 2, 2, 2, 2)
+        assert F.adaptive_max_pool3d(x3, 2).shape == (1, 2, 2, 2, 2)
+        # avg pool == mean over blocks
+        np.testing.assert_allclose(
+            np.asarray(F.adaptive_avg_pool3d(x3, 1))[0, 0, 0, 0, 0],
+            np.asarray(x3)[0, 0].mean(), rtol=1e-6)
+
+    def test_diag_embed(self):
+        x = pt.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        out = np.asarray(F.diag_embed(x))
+        assert out.shape == (2, 3, 3)
+        np.testing.assert_array_equal(np.diagonal(out[1]), [3, 4, 5])
+        out2 = np.asarray(F.diag_embed(x, offset=1))
+        assert out2.shape == (2, 4, 4)
+        np.testing.assert_array_equal(np.diagonal(out2[0], offset=1),
+                                      [0, 1, 2])
+
+    def test_affine_grid_identity(self):
+        theta = np.tile(np.asarray([[1.0, 0, 0], [0, 1.0, 0]],
+                                   np.float32)[None], (1, 1, 1))
+        grid = np.asarray(F.affine_grid(theta, [1, 1, 4, 4]))
+        assert grid.shape == (1, 4, 4, 2)
+        np.testing.assert_allclose(grid[0, 0, 0], [-1, -1], atol=1e-6)
+        np.testing.assert_allclose(grid[0, -1, -1], [1, 1], atol=1e-6)
+
+    def test_grid_sample_identity(self):
+        x = np.random.RandomState(0).randn(1, 2, 5, 5).astype(np.float32)
+        theta = np.asarray([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32)
+        grid = F.affine_grid(theta, [1, 2, 5, 5])
+        out = np.asarray(F.grid_sample(pt.to_tensor(x), grid))
+        np.testing.assert_allclose(out, x, atol=1e-5)
+
+    def test_grid_sample_zeros_padding(self):
+        x = np.ones((1, 1, 4, 4), np.float32)
+        grid = np.full((1, 1, 1, 2), 5.0, np.float32)  # far outside
+        out = np.asarray(F.grid_sample(pt.to_tensor(x),
+                                       pt.to_tensor(grid)))
+        np.testing.assert_allclose(out, 0.0, atol=1e-6)
+
+    def test_temporal_shift(self):
+        nt, c, h, w = 4, 8, 2, 2
+        x = np.random.RandomState(0).randn(nt, c, h, w).astype(np.float32)
+        out = np.asarray(F.temporal_shift(pt.to_tensor(x), seg_num=2,
+                                          shift_ratio=0.25))
+        assert out.shape == x.shape
+        xr = x.reshape(2, 2, c, h, w)
+        # first fold shifted backward: out[t] = x[t+1]; last step zero
+        np.testing.assert_allclose(out.reshape(2, 2, c, h, w)[0, 0, :2],
+                                   xr[0, 1, :2], atol=1e-6)
+        np.testing.assert_allclose(out.reshape(2, 2, c, h, w)[0, 1, :2],
+                                   0.0, atol=1e-6)
+
+    def test_dice_npair_losses(self):
+        probs = pt.nn.functional.softmax(
+            pt.to_tensor(np.random.RandomState(0).randn(4, 3)
+                         .astype(np.float32)))
+        label = pt.to_tensor(np.asarray([[0], [1], [2], [1]], np.int64))
+        d = float(F.dice_loss(probs, label))
+        assert 0.0 < d < 1.0
+        anchor = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+        pos = anchor + 0.01 * np.random.RandomState(2).randn(4, 8) \
+            .astype(np.float32)
+        labels = np.asarray([0, 1, 2, 3])
+        loss = float(F.npair_loss(pt.to_tensor(anchor), pt.to_tensor(pos),
+                                  pt.to_tensor(labels)))
+        assert np.isfinite(loss)
+
+    def test_gather_tree(self):
+        ids = np.asarray([[[2, 2]], [[6, 1]], [[7, 8]]], np.int32)
+        parents = np.asarray([[[0, 0]], [[1, 0]], [[1, 0]]], np.int32)
+        out = np.asarray(F.gather_tree(ids, parents))
+        # walk: beam0 at t=2 has token 7, parent 1 -> t=1 token 1 parent 0
+        np.testing.assert_array_equal(out[:, 0, 0], [2, 1, 7])
+
+
+class TestLayerAdditions:
+    def test_pad_and_upsampling(self):
+        x = pt.to_tensor(np.ones((1, 2, 4), np.float32))
+        assert pt.nn.Pad1D([1, 1])(x).shape == (1, 2, 6)
+        x2 = pt.to_tensor(np.ones((1, 2, 4, 4), np.float32))
+        assert pt.nn.UpsamplingNearest2D(scale_factor=2)(x2).shape \
+            == (1, 2, 8, 8)
+        assert pt.nn.UpsamplingBilinear2D(size=(6, 6))(x2).shape \
+            == (1, 2, 6, 6)
+        x3 = pt.to_tensor(np.ones((1, 2, 3, 3, 3), np.float32))
+        assert pt.nn.Pad3D(1)(x3).shape == (1, 2, 5, 5, 5)
+
+    def test_similarity_layers(self):
+        a = pt.to_tensor(np.asarray([[1.0, 0.0]], np.float32))
+        b = pt.to_tensor(np.asarray([[0.0, 1.0]], np.float32))
+        assert abs(float(pt.nn.CosineSimilarity(axis=1)(a, a)[0]) - 1) \
+            < 1e-6
+        assert abs(float(pt.nn.CosineSimilarity(axis=1)(a, b)[0])) < 1e-6
+        d = float(pt.nn.PairwiseDistance()(a, b)[0])
+        assert abs(d - np.sqrt(2)) < 1e-3
+
+    def test_unfold_layer(self):
+        x = pt.to_tensor(np.random.RandomState(0).randn(1, 2, 4, 4)
+                         .astype(np.float32))
+        out = pt.nn.Unfold(kernel_sizes=2)(x)
+        assert out.shape == (1, 2 * 2 * 2, 9)
+
+    def test_hsigmoid_layer_trains(self):
+        import jax
+        layer = pt.nn.HSigmoidLoss(feature_size=8, num_classes=6)
+        from paddle_tpu.nn.layer import functional_call, trainable_state
+        x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        y = np.asarray([0, 2, 4, 5])
+        params = trainable_state(layer)
+
+        def loss_fn(p):
+            out, _ = functional_call(layer, p, x, y)
+            return out
+
+        l0 = float(loss_fn(params))
+        g = jax.grad(loss_fn)(params)
+        params2 = jax.tree.map(lambda p, gg: p - 0.5 * gg, params, g)
+        assert float(loss_fn(params2)) < l0
+
+    def test_spectral_norm_layer(self):
+        w = np.random.RandomState(0).randn(4, 3).astype(np.float32) * 5
+        sn = pt.nn.SpectralNorm(w.shape, dim=0, power_iters=20)
+        sn.train()
+        out = np.asarray(sn(pt.to_tensor(w)))
+        s = np.linalg.svd(out, compute_uv=False)
+        assert abs(s[0] - 1.0) < 1e-2  # spectral norm ~1 after division
+
+    def test_weight_norm_util(self):
+        lin = pt.nn.Linear(3, 2)
+        w0 = np.asarray(lin.weight.value).copy()
+        pt.nn.utils.weight_norm(lin, dim=0)
+        names = dict(lin.named_parameters())
+        assert "weight_g" in names and "weight_v" in names
+        out = lin(pt.to_tensor(np.ones((1, 3), np.float32)))
+        np.testing.assert_allclose(np.asarray(lin.weight), w0, atol=1e-5)
+        pt.nn.utils.remove_weight_norm(lin)
+        assert "weight" in dict(lin.named_parameters())
+        out2 = lin(pt.to_tensor(np.ones((1, 3), np.float32)))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out2),
+                                   atol=1e-5)
+
+    def test_spectral_norm_util(self):
+        conv = pt.nn.Conv2D(2, 4, 3)
+        pt.nn.utils.spectral_norm(conv)
+        x = pt.to_tensor(np.random.RandomState(0)
+                         .randn(1, 2, 8, 8).astype(np.float32))
+        assert conv(x).shape == (1, 4, 6, 6)
+        mat = np.asarray(conv.weight).reshape(4, -1)
+        s = np.linalg.svd(mat, compute_uv=False)
+        assert s[0] < 2.0  # roughly normalized after one power iteration
+
+
+class TestDecoderAPI:
+    def test_dynamic_decode_beam(self):
+        import jax.numpy as jnp
+        V, E, H = 10, 6, 6
+        emb = pt.nn.Embedding(V, E)
+
+        class Cell(pt.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = pt.nn.Linear(E + H, H)
+                self.out = pt.nn.Linear(H, V)
+
+            def forward(self, x, h):
+                h2 = jnp.tanh(self.fc(jnp.concatenate([x, h], axis=-1)))
+                return self.out(h2), h2
+
+        cell = Cell()
+        dec = pt.nn.BeamSearchDecoder(
+            cell=lambda x, st: cell(x, st),
+            start_token=1, end_token=2, beam_size=3,
+            embedding_fn=lambda ids: emb(ids))
+        h0 = np.zeros((2, H), np.float32)
+        seqs, scores = pt.nn.dynamic_decode(dec, inits=h0, max_step_num=5)
+        assert seqs.shape == (2, 3, 5)
+        assert scores.shape == (2, 3)
+        s = np.asarray(scores)
+        assert (np.diff(s, axis=1) <= 1e-5).all()  # sorted best-first
+
+
+class TestMiscAdditions:
+    def test_metric_accuracy_functional(self):
+        scores = np.asarray([[0.1, 0.9], [0.8, 0.2]], np.float32)
+        label = np.asarray([1, 1])
+        acc = float(pt.metric.accuracy(scores, label, k=1))
+        assert abs(acc - 0.5) < 1e-6
+        assert float(pt.metric.accuracy(scores, label, k=2)) == 1.0
+
+    def test_entry_attrs(self):
+        e = pt.distributed.ProbabilityEntry(0.5)
+        assert e._to_attr() == "probability_entry:0.5"
+        c = pt.distributed.CountFilterEntry(3)
+        assert c.should_admit(3) and not c.should_admit(2)
+        with pytest.raises(ValueError):
+            pt.distributed.ProbabilityEntry(0.0)
+
+    def test_get_worker_info_in_worker(self):
+        from paddle_tpu.io import DataLoader, get_worker_info
+
+        assert get_worker_info() is None  # main process
+
+        class DS(pt.io.Dataset):
+            def __getitem__(self, i):
+                info = get_worker_info()
+                return np.asarray([i, -1 if info is None else info.id,
+                                   -1 if info is None
+                                   else info.num_workers])
+
+            def __len__(self):
+                return 8
+
+        dl = DataLoader(DS(), batch_size=4, num_workers=2)
+        rows = np.concatenate([np.asarray(b) for b in dl])
+        assert set(rows[:, 1]) <= {0, 1}
+        assert (rows[:, 2] == 2).all()
+
+    def test_distributed_split_eager(self):
+        x = pt.to_tensor(np.random.RandomState(0)
+                         .randn(2, 6).astype(np.float32))
+        out = pt.distributed.split(x, (6, 4), operation="linear", axis=1)
+        assert out.shape == (2, 4)
+        ids = pt.to_tensor(np.asarray([[1, 2]], np.int64))
+        out = pt.distributed.split(ids, (8, 5), operation="embedding")
+        assert out.shape == (1, 2, 5)
