@@ -1,0 +1,58 @@
+"""Book example (reference: tests/book/test_fit_a_line.py): linear
+regression on the UCI housing dataset in CLASSIC STATIC-GRAPH style —
+`static.data` → `static.nn.fc` → `minimize` → `Executor.run` — running on
+the record/replay static engine (paddle_tpu/static/program.py).
+
+Run: python examples/fit_a_line_static.py [--epochs N]
+"""
+import argparse
+
+import numpy as np
+
+
+def main(epochs=20, batch_size=20):
+    import paddle_tpu as paddle
+
+    train_data = paddle.text.datasets.UCIHousing(mode="train")
+    X = np.stack([np.asarray(train_data[i][0], np.float32)
+                  for i in range(len(train_data))])
+    Y = np.stack([np.asarray(train_data[i][1], np.float32).reshape(1)
+                  for i in range(len(train_data))])
+
+    paddle.enable_static()
+    try:
+        main_prog = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main_prog, startup):
+            x = paddle.static.data("x", [None, 13], "float32")
+            y = paddle.static.data("y", [None, 1], "float32")
+            pred = paddle.static.nn.fc(x, 1)
+            loss = paddle.mean(
+                paddle.nn.functional.square_error_cost(pred, y))
+            paddle.optimizer.SGD(learning_rate=0.01).minimize(loss)
+
+        exe = paddle.static.Executor(paddle.CPUPlace())
+        exe.run(startup)
+        n = len(X)
+        final = None
+        for epoch in range(epochs):
+            perm = np.random.RandomState(epoch).permutation(n)
+            for s in range(0, n - batch_size + 1, batch_size):
+                idx = perm[s:s + batch_size]
+                (final,) = exe.run(main_prog,
+                                   feed={"x": X[idx], "y": Y[idx]},
+                                   fetch_list=[loss])
+        test_prog = main_prog.clone(for_test=True)
+        (test_loss,) = exe.run(test_prog, feed={"x": X, "y": Y},
+                               fetch_list=[loss])
+        print(f"train loss {float(final):.4f}  "
+              f"full-set loss {float(test_loss):.4f}")
+        return float(test_loss)
+    finally:
+        paddle.disable_static()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=20)
+    main(epochs=ap.parse_args().epochs)

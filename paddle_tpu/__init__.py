@@ -134,3 +134,10 @@ def monkey_patch_variable():  # reference: fluid Variable operator patching
 
 def monkey_patch_math_varbase():  # reference: dygraph VarBase patching
     """No-op: jax arrays already support operators natively."""
+
+
+# install static-mode dispatch last: wraps the curated op set so calls on
+# static.Variable record into the Program (see static/program.py)
+from .static.program import _install_dispatch as _isd  # noqa: E402
+_isd()
+del _isd

@@ -178,8 +178,18 @@ class Optimizer:
         self._step_count += 1
 
     def minimize(self, loss_fn: Callable, *args):
-        """Reference `minimize(loss)` reimagined functionally: takes a loss
-        *function* over the bound layer's params, computes grads, steps."""
+        """Reference `minimize(loss)`. Two forms:
+        - static mode: `minimize(loss_var)` with a `static.Variable` marks
+          the program for training — `Executor.run` then differentiates the
+          whole replay and applies this optimizer (executor.py);
+        - functional: takes a loss *function* over the bound layer's
+          params, computes grads, steps."""
+        from ..static.program import Variable as _StaticVar
+        if isinstance(loss_fn, _StaticVar):
+            loss_fn.program._train_spec = (loss_fn, self)
+            loss_fn.program._bump()
+            return [], [(p, p.name + "@GRAD")
+                        for p in loss_fn.program._params.values()]
         from ..nn.layer import functional_call, trainable_state
         assert self._layer is not None, "minimize needs a Layer-bound optimizer"
 

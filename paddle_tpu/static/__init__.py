@@ -1,13 +1,29 @@
-"""`paddle.static` equivalent — the compiled-execution namespace.
+"""`paddle.static` equivalent — the static-graph namespace.
 
 The reference's static graph (ProgramDesc + C++ Executor,
-`framework/executor.cc`) is subsumed by jax tracing + XLA compilation: a
-"Program" is a traced, shape-specialized computation. This namespace keeps
-the user-facing pieces that still mean something on TPU: `InputSpec`,
-inference save/load, and a thin `Executor` shim for script parity.
+`framework/executor.cc`) is re-designed TPU-first in `program.py` /
+`executor.py`: a Program records each layer/op call as a deferred closure
+and `Executor.run` replays the whole list as ONE jitted jax function —
+so classic fluid scripts (`static.data` → `static.nn.fc` →
+`optimizer.minimize(loss)` → `exe.run(feed, fetch_list)`) run end-to-end
+with XLA compiling the full graph, while the modern path stays
+`paddle_tpu.jit`.
 """
 from .input_spec import InputSpec  # noqa: F401
+from .program import (  # noqa: F401
+    Program,
+    Scope,
+    Variable,
+    data,
+    default_main_program,
+    default_startup_program,
+    global_scope,
+    program_guard,
+    scope_guard,
+)
+from .executor import Executor as _ReplayExecutor
 from . import nn  # noqa: F401
+from ..framework.param_attr import ParamAttr as _ParamAttr
 
 
 def load_inference_model(path_prefix, executor=None):
@@ -39,18 +55,9 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     return _jit_save(target, path_prefix, input_spec=specs)
 
 
-class Executor:
-    """Shim for scripts that instantiate `paddle.static.Executor`. Running
-    arbitrary Programs is not supported (no ProgramDesc IR); jitted
-    callables replace it."""
-
-    def __init__(self, place=None):
-        self.place = place
-
-    def run(self, program=None, feed=None, fetch_list=None):
-        raise NotImplementedError(
-            "Executor.run(Program) has no TPU equivalent: compile a step "
-            "function with paddle_tpu.jit.to_static / jax.jit instead.")
+class Executor(_ReplayExecutor):
+    """Static-graph executor (see `executor.py`) + the fleet dataset epoch
+    driver the reference exposes on the same class."""
 
     def train_from_dataset(self, program=None, dataset=None, epochs=1,
                            collate_fn=None, print_period=100, debug=False,
@@ -70,3 +77,291 @@ class Executor:
                     print_period=print_period, debug=debug)
 
     infer_from_dataset = train_from_dataset
+
+
+# ------------------------------------------------------- backward / grads
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Reference: `fluid/backward.py append_backward` — marks the program
+    so `Executor.run` computes parameter grads (fetchable as
+    '<param_name>@GRAD'). Returns [(param, grad_name)] like the reference's
+    (param, grad var) pairs."""
+    prog = loss.program
+    prog._grad_targets.append((loss, parameter_list))
+    prog._bump()
+    return [(p, n + "@GRAD") for n, p in prog._params.items()]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Reference: `paddle.static.gradients` — gradients of `targets`
+    w.r.t. `inputs` (data Variables differentiate through the feed;
+    Parameters through the param dict). Returns the fetchable
+    '<name>@GRAD' names, one per input."""
+    targets = list(targets) if isinstance(targets, (list, tuple)) \
+        else [targets]
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) \
+        else [inputs]
+    prog = targets[0].program
+    for t in targets:
+        prog._grad_targets.append((t, inputs))
+    prog._bump()
+    names = []
+    for i in inputs:
+        name = i.name if hasattr(i, "name") else str(i)
+        names.append(name + "@GRAD")
+    return names
+
+
+# ------------------------------------------------------------ param utils
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from ..framework import create_parameter as _cp
+    p = _cp(shape, dtype=dtype, name=name, attr=attr, is_bias=is_bias,
+            default_initializer=default_initializer)
+    default_main_program()._params[p.name] = p
+    return p
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """Reference: fluid/layers/tensor.py create_global_var."""
+    import jax.numpy as jnp
+    from ..nn.layer import Parameter
+    import numpy as np
+    p = Parameter(jnp.full(tuple(shape), value, dtype=np.dtype(dtype)),
+                  name=name or default_main_program()._unique("gvar"))
+    p.stop_gradient = True
+    default_main_program()._params[p.name] = p
+    return p
+
+
+class WeightNormParamAttr(_ParamAttr):
+    """Reference: fluid/param_attr.py WeightNormParamAttr. The dim is
+    carried for `nn.utils.weight_norm` consumers."""
+
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim
+
+
+# --------------------------------------------------------------- metrics
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Reference: fluid/layers/metric_op.py accuracy."""
+    from .program import Variable, record
+    from ..metric import accuracy as _acc
+    if isinstance(input, Variable) or isinstance(label, Variable):
+        return record(lambda i, l: _acc(i, l, k=k), (input, label), {},
+                      hint="accuracy")
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, **kwargs):
+    """Reference: fluid/layers/metric_op.py auc — batch AUC over positive-
+    class scores (stateful accumulation lives in `paddle.metric.Auc`)."""
+    from .program import Variable, record
+    import jax.numpy as jnp
+
+    def _auc(scores, y):
+        pos = scores[:, 1] if scores.ndim == 2 else scores
+        y = jnp.reshape(y, (-1,)).astype(jnp.float32)
+        order = jnp.argsort(pos)
+        ranks = jnp.empty_like(pos).at[order].set(
+            jnp.arange(1, pos.shape[0] + 1, dtype=pos.dtype))
+        n_pos = jnp.sum(y)
+        n_neg = y.shape[0] - n_pos
+        auc_v = (jnp.sum(ranks * y) - n_pos * (n_pos + 1) / 2) / \
+            jnp.maximum(n_pos * n_neg, 1.0)
+        return auc_v
+
+    if isinstance(input, Variable) or isinstance(label, Variable):
+        return record(_auc, (input, label), {}, hint="auc")
+    return _auc(input, label)
+
+
+# ------------------------------------------------------------- misc shims
+
+def cpu_places(device_count=None):
+    from ..core.device import CPUPlace
+    import os
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places (TPU chips on this stack)."""
+    import jax
+    from ..core.device import TPUPlace
+    if device_ids is None:
+        device_ids = range(len(jax.devices()))
+    return [TPUPlace(i) for i in device_ids]
+
+
+class _NullCtx:
+    def __init__(self, *a, **k):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class name_scope(_NullCtx):
+    """Reference: fluid.name_scope — name prefixing for graph viz; names
+    here come from the unique-name registry."""
+
+
+class device_guard(_NullCtx):
+    """Reference: fluid.device_guard — XLA owns placement on this stack."""
+
+
+class BuildStrategy:
+    """Reference: details/build_strategy.h. Graph-pass toggles — XLA's
+    pipeline subsumes them; attributes are accepted and recorded."""
+
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+
+class ExecutionStrategy:
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+
+class CompiledProgram:
+    """Reference: compiler.py CompiledProgram — the replay Executor jit-
+    compiles every program already; this wrapper keeps script parity."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        return self
+
+
+class ParallelExecutor:
+    """Reference: parallel_executor.py (deprecated in 2.x). Use
+    Executor + GSPMD sharding; kept for import parity."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "ParallelExecutor is the deprecated 1.x API; use "
+            "static.Executor (jit replay) or the GSPMD mesh path "
+            "(paddle_tpu.distributed).")
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, **kwargs):
+    """Reference: control_flow.py Print op — debug-print a var at run
+    time (jax.debug.print inside the compiled replay)."""
+    from .program import Variable, record
+    import jax
+
+    def run(v):
+        jax.debug.print((message or "") + " {}", v)
+        return v
+
+    if isinstance(input, Variable):
+        return record(run, (input,), {}, hint="print")
+    print(message or "", input)
+    return input
+
+
+from .nn import py_func  # noqa: F401,E402
+
+
+# ------------------------------------------------- program serialization
+
+def save(program, model_path, protocol=4):
+    """Reference: fluid/io.py save — program params + a loadable spec."""
+    from ..framework.io import save as _save
+    _save(program.state_dict(), model_path + ".pdparams")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    from ..framework.io import load as _load
+    program.set_state_dict(_load(model_path + ".pdparams"))
+
+
+def load_program_state(model_path, var_list=None):
+    from ..framework.io import load as _load
+    return _load(model_path + ".pdparams")
+
+
+def set_program_state(program, state):
+    program.set_state_dict(state)
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    """Reference: static/io.py normalize_program — freeze to an inference
+    artifact spec. Returns (program, feed names, fetch names)."""
+    feeds = [v.name for v in (feed_vars or program._data_vars)]
+    fetches = [v.name for v in (fetch_vars or [])]
+    return (program, feeds, fetches)
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    """Serialize the program structure (fetch closure over feeds) as
+    StableHLO bytes via jax.export — the TPU ProgramDesc."""
+    import jax
+    from jax import export as jax_export
+    import jax.numpy as jnp
+    prog = program or default_main_program()
+    from .executor import _replay
+    fetch = [v if isinstance(v, Variable) else prog._vars[v]
+             for v in fetch_vars]
+    params = {n: p.value for n, p in prog._params.items()}
+    buffers = {i: {n: b.value for n, b in
+                   __import__("paddle_tpu.static.executor",
+                              fromlist=["_buffers_of"])
+                   ._buffers_of(op.layer).items()}
+               for i, op in enumerate(prog.ops) if op.layer is not None}
+    feeds = {v.name: jnp.zeros(
+        tuple(1 if d is None else d for d in v.shape), v.dtype)
+        for v in (feed_vars or prog._data_vars)}
+    run = _replay(prog, sorted(feeds), fetch, train=False)
+
+    def fn(feed_vals):
+        return run(feed_vals, params, buffers, None, jax.random.key(0))[0]
+
+    exported = jax_export.export(jax.jit(fn))(feeds)
+    return exported.serialize()
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    import pickle
+    prog = program or default_main_program()
+    state = {n: __import__("numpy").asarray(v)
+             for n, v in prog.state_dict().items()}
+    return pickle.dumps(state, protocol=4)
+
+
+def deserialize_program(data):
+    from jax import export as jax_export
+    return jax_export.deserialize(data)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+    program.set_state_dict(pickle.loads(data))
+    return program
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+# amp namespace parity (paddle.static.amp in reference)
+from .. import amp  # noqa: F401,E402

@@ -1,0 +1,200 @@
+"""Static-graph Executor: replay a Program as one jitted jax function.
+
+Reference: `framework/executor.cc` Executor::Run (op-by-op interpreter
+over a Scope) + the backward/optimizer ops `append_backward`/`minimize`
+write into the ProgramDesc. TPU-native: the whole op list replays inside
+ONE `jax.jit` — XLA fuses across ops exactly like the rest of the
+framework — and training runs `jax.value_and_grad` over that replay with
+the optimizer's functional `apply`, instead of interpreting grad ops.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .program import (Program, Variable, default_main_program,
+                      default_startup_program)
+
+
+def _replay(program: Program, feed_names, fetch_vars, train: bool):
+    """Build `fn(feed_vals, params, buffers, opt_state) -> ...` replaying
+    the op list. Pure — jit-compiled by the caller."""
+    loss_var, optimizer = program._train_spec if train else (None, None)
+    grad_targets = list(program._grad_targets)
+
+    def forward(feed_vals: Dict[str, jax.Array],
+                params: Dict[str, jax.Array],
+                buffers: Dict[int, Dict[str, jax.Array]]):
+        env: Dict[str, jax.Array] = dict(feed_vals)
+        # (runs under the caller's rng_guard: RNG-consuming ops draw from
+        # the per-run step key threaded into `run`)
+        new_buffers: Dict[int, Dict[str, jax.Array]] = {}
+        for i, op in enumerate(program.ops):
+            call_with, treedef = op.arg_template
+            vals = [env[v.name] for v in op.inputs]
+            if op.layer is not None:
+                lp = {n: params[p.name] for n, p in
+                      op.layer.named_parameters()}
+                out, nb = call_with(vals, op.attrs, lp, buffers.get(i))
+                if nb:
+                    new_buffers[i] = nb
+            else:
+                out, _ = call_with(vals, op.attrs)
+            flat = jax.tree.flatten(out)[0]
+            for var, val in zip(op.outputs, flat):
+                env[var.name] = val
+        return env, new_buffers
+
+    def run(feed_vals, params, buffers, opt_state, step_key):
+        from ..framework.random import rng_guard
+        with rng_guard(step_key):
+            return _run_inner(feed_vals, params, buffers, opt_state)
+
+    def _resolve_fetches(env, grad_vals):
+        out = []
+        for v in fetch_vars:
+            if isinstance(v, str):
+                if v not in grad_vals:
+                    raise KeyError(
+                        f"fetch {v!r}: no gradient recorded under that "
+                        "name (append_backward/gradients register them)")
+                out.append(grad_vals[v])
+            else:
+                out.append(env[v.name])
+        return out
+
+    def _run_inner(feed_vals, params, buffers, opt_state):
+        if train:
+            def loss_fn(p):
+                env, nb = forward(feed_vals, p, buffers)
+                return env[loss_var.name], (env, nb)
+
+            (loss, (env, new_buffers)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opt_state = optimizer.apply(params, grads,
+                                                        opt_state)
+            grad_vals = {n + "@GRAD": g for n, g in grads.items()}
+            fetches = _resolve_fetches(env, grad_vals)
+            return fetches, new_params, new_buffers, new_opt_state
+        env, new_buffers = forward(feed_vals, params, buffers)
+        grad_vals = {}
+        for loss_v, wrt in grad_targets:
+            if wrt is None or all(
+                    not isinstance(w, Variable) or not w.is_data
+                    for w in (wrt or [])):
+                def loss_fn(p):
+                    e, _ = forward(feed_vals, p, buffers)
+                    return e[loss_v.name]
+                gs = jax.grad(loss_fn)(params)
+                for name, g in gs.items():
+                    grad_vals[name + "@GRAD"] = g
+            if wrt:
+                data_wrt = [w for w in wrt
+                            if isinstance(w, Variable) and w.is_data]
+                if data_wrt:
+                    def loss_wrt_feed(sub):
+                        fv = dict(feed_vals)
+                        fv.update(sub)
+                        e, _ = forward(fv, params, buffers)
+                        return e[loss_v.name]
+                    gs = jax.grad(loss_wrt_feed)(
+                        {w.name: feed_vals[w.name] for w in data_wrt})
+                    for name, g in gs.items():
+                        grad_vals[name + "@GRAD"] = g
+        fetches = _resolve_fetches(env, grad_vals)
+        return fetches, params, new_buffers, opt_state
+
+    return run
+
+
+class Executor:
+    """Reference: `paddle.static.Executor` (fluid/executor.py). `run`
+    compiles + executes the fed program; running the startup program
+    initializes nothing extra (parameters initialize at creation here)
+    but is kept for script parity."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, scope=None):
+        if program is None:
+            program = default_main_program()
+        if program is default_startup_program() or (
+                not program.ops and not fetch_list):
+            return []
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        train = program._train_spec is not None
+
+        fetch_resolved = []
+        for f in fetch_list:
+            if isinstance(f, Variable):
+                fetch_resolved.append(f)
+            elif isinstance(f, str) and f.endswith("@GRAD"):
+                fetch_resolved.append(f)   # resolved inside replay
+            elif isinstance(f, str):
+                fetch_resolved.append(program._vars[f])
+            else:
+                raise TypeError(f"bad fetch entry {f!r}")
+
+        feed_vals = {}
+        for v in program._data_vars:
+            if v.name not in feed:
+                raise ValueError(f"missing feed for data {v.name!r}")
+            arr = jnp.asarray(feed[v.name])
+            feed_vals[v.name] = arr
+        # tolerate extra feed keys (reference ignores them)
+
+        params = {n: p.value for n, p in program._params.items()}
+        buffers = {i: {n: b.value
+                       for n, b in _buffers_of(op.layer).items()}
+                   for i, op in enumerate(program.ops)
+                   if op.layer is not None}
+        opt_state = None
+        if train:
+            _, optimizer = program._train_spec
+            if getattr(optimizer, "_static_state", None) is None:
+                optimizer._static_state = optimizer.init_state(params)
+            opt_state = optimizer._static_state
+
+        key = (id(program), program._version,
+               tuple(str(f) for f in fetch_list),
+               tuple(sorted((k, v.shape, str(v.dtype))
+                            for k, v in feed_vals.items())))
+        if key not in self._cache:
+            fn = _replay(program, sorted(feed_vals), fetch_resolved, train)
+            self._cache[key] = jax.jit(fn)
+        from ..framework.random import next_key
+        step_key = next_key()   # eager: fresh randomness per run
+        fetches, new_params, new_buffers, new_opt_state = \
+            self._cache[key](feed_vals, params, buffers, opt_state,
+                             step_key)
+
+        # write back mutated state so later runs/eager access see updates
+        if train:
+            for n, p in program._params.items():
+                p.value = new_params[n]
+            program._train_spec[1]._static_state = new_opt_state
+        for i, bufs in (new_buffers or {}).items():
+            layer = program.ops[i].layer
+            for n, b in _buffers_of(layer).items():
+                if n in bufs:
+                    b.value = bufs[n]
+
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    # train_from_dataset / infer_from_dataset keep their existing homes in
+    # __init__.py (fleet dataset path); bound there.
+
+
+def _buffers_of(layer):
+    named = getattr(layer, "named_buffers", None)
+    return dict(named()) if named is not None else {}

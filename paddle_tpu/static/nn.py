@@ -106,3 +106,665 @@ def switch_case(branch_index, branch_fns, default: Callable = None,
     branch = jnp.where(matched, pos, len(fns))
     return lax.switch(branch, [*(lambda f=f: f() for f in fns),
                                lambda: default()])
+
+
+# ---------------------------------------------------------------------------
+# Layer-builder ops (reference: fluid/layers/nn.py — ProgramDesc builders
+# like `fc` at nn.py:87 that append ops + create params via LayerHelper).
+# TPU-native: each builder instantiates the corresponding nn.Layer and
+# records ONE deferred call on the Program (static/program.py record());
+# the replay jit-compiles the whole program, so XLA sees the same fused
+# graph the dygraph path produces.
+# ---------------------------------------------------------------------------
+
+from .program import Variable, record  # noqa: E402
+
+
+def _act(out, act):
+    if act is None:
+        return out
+    import paddle_tpu.nn.functional as F
+    fn = getattr(F, act, None)
+    if fn is None:
+        raise ValueError(f"unknown activation {act!r}")
+    fn = getattr(fn, "_wrapped_fn", fn)   # unwrap dispatch shims
+    if isinstance(out, Variable):          # record any activation, not
+        return record(fn, (out,), {}, hint=act)  # just the curated set
+    return fn(out)
+
+
+def _static_dim(shape, i, what):
+    d = shape[i]
+    if d is None:
+        raise ValueError(f"{what} needs a static dim {i}, got None in "
+                         f"{shape}")
+    return int(d)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Reference: fluid/layers/nn.py fc (nn.py:87)."""
+    import numpy as np
+    from ..nn.layer_common import Linear
+    in_dim = int(np.prod([_static_dim(x.shape, i, "fc")
+                          for i in range(num_flatten_dims, len(x.shape))]))
+    layer = Linear(in_dim, size, weight_attr=weight_attr,
+                   bias_attr=bias_attr)
+
+    def run(v):
+        import jax.numpy as jnp
+        flat = jnp.reshape(v, v.shape[:num_flatten_dims] + (-1,))
+        return flat
+
+    flat = record(run, (x,), {}, hint="fc_flatten")
+    out = record(None, (flat,), {}, layer=layer, hint=name or "fc")
+    return _act(out, activation)
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32", is_distributed=False,
+              name=None):
+    """Reference: fluid/input.py embedding (lookup_table_v2)."""
+    from ..nn.layer_common import Embedding
+    layer = Embedding(size[0], size[1], padding_idx=padding_idx,
+                      sparse=is_sparse, weight_attr=param_attr)
+    return record(None, (input,), {}, layer=layer, hint=name or "embedding")
+
+
+def sparse_embedding(input, size, padding_idx=None, param_attr=None,
+                     dtype="float32", **kwargs):
+    """Reference: fluid/contrib sparse_embedding (PS-backed lookup). Same
+    lookup math; the PS table path is `distributed/ps/table.py`."""
+    return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def _conv(cls, input, num_filters, filter_size, stride, padding, dilation,
+          groups, param_attr, bias_attr, act, data_format, name,
+          transpose_extra=None):
+    ch_axis = 1 if data_format.startswith("NC") else -1
+    in_ch = _static_dim(input.shape, ch_axis, cls.__name__)
+    kwargs = dict(stride=stride, padding=padding, dilation=dilation,
+                  groups=groups or 1, weight_attr=param_attr,
+                  bias_attr=bias_attr, data_format=data_format)
+    if transpose_extra:
+        kwargs.update(transpose_extra)
+    layer = cls(in_ch, num_filters, filter_size, **kwargs)
+    out = record(None, (input,), {}, layer=layer,
+                 hint=name or cls.__name__.lower())
+    return _act(out, act)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=None, param_attr=None, bias_attr=None,
+           act=None, data_format="NCHW", name=None, use_cudnn=True):
+    """Reference: fluid/layers/nn.py conv2d."""
+    from ..nn.layer_conv_norm import Conv2D
+    return _conv(Conv2D, input, num_filters, filter_size, stride, padding,
+                 dilation, groups, param_attr, bias_attr, act, data_format,
+                 name)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=None, param_attr=None, bias_attr=None,
+           act=None, data_format="NCDHW", name=None, use_cudnn=True):
+    from ..nn.layer_conv_norm import Conv3D
+    return _conv(Conv3D, input, num_filters, filter_size, stride, padding,
+                 dilation, groups, param_attr, bias_attr, act, data_format,
+                 name)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCHW", name=None, use_cudnn=True):
+    from ..nn.layer_conv_norm import Conv2DTranspose
+    if filter_size is None:
+        raise ValueError("conv2d_transpose requires filter_size (inferring "
+                         "from output_size is not supported)")
+    return _conv(Conv2DTranspose, input, num_filters, filter_size, stride,
+                 padding, dilation, groups, param_attr, bias_attr, act,
+                 data_format, name)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCDHW", name=None, use_cudnn=True):
+    from ..nn.layer_conv_norm import Conv3DTranspose
+    if filter_size is None:
+        raise ValueError("conv3d_transpose requires filter_size")
+    return _conv(Conv3DTranspose, input, num_filters, filter_size, stride,
+                 padding, dilation, groups, param_attr, bias_attr, act,
+                 data_format, name)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    """Reference: fluid/layers/nn.py batch_norm."""
+    from ..nn.layer_conv_norm import BatchNorm2D, BatchNorm1D, BatchNorm3D
+    ch_axis = 1 if data_layout.startswith("NC") else -1
+    ch = _static_dim(input.shape, ch_axis, "batch_norm")
+    cls = {2: BatchNorm1D, 3: BatchNorm1D, 4: BatchNorm2D,
+           5: BatchNorm3D}[len(input.shape)]
+    layer = cls(ch, momentum=momentum, epsilon=epsilon,
+                weight_attr=param_attr, bias_attr=bias_attr,
+                data_format=data_layout,
+                use_global_stats=use_global_stats or None)
+    if is_test:
+        layer.eval()
+    out = record(None, (input,), {}, layer=layer, hint=name or "batch_norm")
+    return _act(out, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from ..nn.layer_conv_norm import LayerNorm
+    shape = [_static_dim(input.shape, i, "layer_norm")
+             for i in range(begin_norm_axis, len(input.shape))]
+    layer = LayerNorm(shape, epsilon=epsilon,
+                      weight_attr=param_attr if scale else False,
+                      bias_attr=bias_attr if shift else False)
+    out = record(None, (input,), {}, layer=layer, hint=name or "layer_norm")
+    return _act(out, act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..nn.layer_conv_norm import InstanceNorm2D
+    ch = _static_dim(input.shape, 1, "instance_norm")
+    layer = InstanceNorm2D(ch, epsilon=epsilon, weight_attr=param_attr,
+                           bias_attr=bias_attr)
+    return record(None, (input,), {}, layer=layer,
+                  hint=name or "instance_norm")
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from ..nn.layer_conv_norm import GroupNorm
+    ch = _static_dim(input.shape, 1, "group_norm")
+    layer = GroupNorm(groups, ch, epsilon=epsilon, weight_attr=param_attr,
+                      bias_attr=bias_attr, data_format=data_layout)
+    out = record(None, (input,), {}, layer=layer, hint=name or "group_norm")
+    return _act(out, act)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              enable_scale_and_shift=False, name=None, **kwargs):
+    """Reference: fluid/layers/nn.py data_norm — normalize by accumulated
+    batch statistics (recsys CTR models). The accumulators (batch_size/
+    batch_sum/batch_square_sum) live as buffers like the reference's
+    persistable vars."""
+    from ..nn.layer import Layer
+
+    class _DataNorm(Layer):
+        def __init__(self, dim):
+            super().__init__()
+            import jax.numpy as jnp
+            self.register_buffer("batch_size", jnp.full((dim,), 1e4))
+            self.register_buffer("batch_sum", jnp.zeros((dim,)))
+            self.register_buffer("batch_square_sum", jnp.full((dim,), 1e4))
+            if enable_scale_and_shift:
+                self.scale_w = self.create_parameter((dim,),
+                                                     attr=param_attr)
+                self.bias = self.create_parameter((dim,), is_bias=True)
+            else:
+                self.scale_w = self.bias = None
+
+        def forward(self, x):
+            import jax.numpy as jnp
+            mean = self.batch_sum.value / self.batch_size.value
+            scale = (self.batch_size.value /
+                     self.batch_square_sum.value) ** 0.5
+            out = (x - mean) * scale
+            if self.scale_w is not None:
+                out = out * self.scale_w.value + self.bias.value
+            if self.training:
+                n = x.shape[0]
+                self.batch_size.value = self.batch_size.value + n
+                self.batch_sum.value = self.batch_sum.value \
+                    + jnp.sum(x, axis=0)
+                self.batch_square_sum.value = self.batch_square_sum.value \
+                    + jnp.sum(x * x, axis=0)
+            return out
+
+    dim = _static_dim(input.shape, -1, "data_norm")
+    out = record(None, (input,), {}, layer=_DataNorm(dim),
+                 hint=name or "data_norm")
+    return _act(out, act)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    from ..nn.layer_common import PReLU
+    if mode == "all":
+        num = 1
+    elif mode == "channel":
+        num = _static_dim(x.shape, 1, "prelu")
+    else:
+        import numpy as np
+        num = int(np.prod([_static_dim(x.shape, i, "prelu")
+                           for i in range(1, len(x.shape))]))
+    layer = PReLU(num_parameters=num, weight_attr=param_attr)
+    return record(None, (x,), {}, layer=layer, hint=name or "prelu")
+
+
+def bilinear_tensor_product(x, y, size, act=None, param_attr=None,
+                            bias_attr=None, name=None):
+    from ..nn.layer_common import Bilinear
+    layer = Bilinear(_static_dim(x.shape, -1, "bilinear"),
+                     _static_dim(y.shape, -1, "bilinear"), size,
+                     weight_attr=param_attr, bias_attr=bias_attr)
+    out = record(None, (x, y), {}, layer=layer, hint=name or "bilinear")
+    return _act(out, act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ..nn.layer_conv_norm import SpectralNorm
+    layer = SpectralNorm([_static_dim(weight.shape, i, "spectral_norm")
+                          for i in range(len(weight.shape))],
+                         dim=dim, power_iters=power_iters, eps=eps)
+    return record(None, (weight,), {}, layer=layer,
+                  hint=name or "spectral_norm")
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Reference: fluid/layers/nn.py nce (nce_op.cc) — noise-contrastive
+    estimation with `num_neg_samples` uniform negatives.
+
+    Negatives draw from the per-run step key the Executor threads through
+    the replay, so each run resamples (see program.py RNG note).
+    """
+    from ..nn.layer import Layer
+
+    dim = _static_dim(input.shape, -1, "nce")
+
+    class _NCE(Layer):
+        def __init__(self):
+            super().__init__()
+            self.weight = self.create_parameter((num_total_classes, dim),
+                                                attr=param_attr)
+            self.bias = None if bias_attr is False else \
+                self.create_parameter((num_total_classes,), is_bias=True,
+                                      attr=bias_attr)
+
+        def forward(self, x, y):
+            import jax as _jax
+            import jax.numpy as jnp
+            from ..framework.random import next_key
+            y = jnp.reshape(y, (-1,))
+            w = self.weight.value
+            b = self.bias.value if self.bias is not None else None
+            pos_logit = jnp.sum(x * w[y], axis=-1)
+            if b is not None:
+                pos_logit = pos_logit + b[y]
+            neg_ids = _jax.random.randint(
+                next_key(), (num_neg_samples,), 0, num_total_classes)
+            neg_logit = x @ w[neg_ids].T
+            if b is not None:
+                neg_logit = neg_logit + b[neg_ids]
+            # NCE with uniform noise: P_n = 1/C
+            log_pn = -jnp.log(float(num_total_classes))
+            k = float(num_neg_samples)
+            pos_loss = -_jax.nn.log_sigmoid(
+                pos_logit - jnp.log(k) - log_pn)
+            neg_loss = -jnp.sum(
+                _jax.nn.log_sigmoid(-(neg_logit - jnp.log(k) - log_pn)),
+                axis=-1)
+            return jnp.mean(pos_loss + neg_loss)
+
+    return record(None, (input, label), {}, layer=_NCE(), hint="nce")
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             name=None):
+    """Reference: fluid/layers/nn.py row_conv (row_conv_op.cc, lookahead
+    conv from DeepSpeech2): y[t] = sum_{i=0..k} w[i] ⊙ x[t+i]."""
+    from ..nn.layer import Layer
+
+    dim = _static_dim(input.shape, -1, "row_conv")
+    k = int(future_context_size)
+
+    class _RowConv(Layer):
+        def __init__(self):
+            super().__init__()
+            self.weight = self.create_parameter((k + 1, dim),
+                                                attr=param_attr)
+
+        def forward(self, x):
+            import jax.numpy as jnp
+            w = self.weight.value
+            pad = jnp.pad(x, ((0, 0), (0, k), (0, 0)))
+            out = sum(pad[:, i:i + x.shape[1]] * w[i] for i in range(k + 1))
+            return out
+
+    out = record(None, (input,), {}, layer=_RowConv(),
+                 hint=name or "row_conv")
+    return _act(out, act)
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None,
+                 transition=None, name=None):
+    """Reference: fluid/layers/nn.py crf_decoding (crf_decoding_op.cc):
+    Viterbi decode over linear-chain CRF emissions [B, T, N] with
+    transitions [(N+2), N] (rows 0/1 = start/stop like the reference).
+    Creates the transition parameter when not given one."""
+    from ..nn.layer import Layer
+
+    n_tags = _static_dim(input.shape, -1, "crf_decoding")
+
+    class _CRFDecode(Layer):
+        def __init__(self):
+            super().__init__()
+            self.transition = self.create_parameter((n_tags + 2, n_tags),
+                                                    attr=param_attr)
+
+        def forward(self, emissions):
+            import jax
+            import jax.numpy as jnp
+            trans = self.transition.value
+            start, stop, pair = trans[0], trans[1], trans[2:]
+
+            def viterbi_one(em):  # [T, N]
+                def tick(carry, e):
+                    score = carry  # [N]
+                    cand = score[:, None] + pair + e[None, :]
+                    best = jnp.max(cand, axis=0)
+                    back = jnp.argmax(cand, axis=0)
+                    return best, back
+
+                score0 = start + em[0]
+                final, backs = jax.lax.scan(tick, score0, em[1:])
+                final = final + stop
+                last = jnp.argmax(final)
+
+                def walk(tag, back):
+                    return back[tag], tag
+
+                first, path = jax.lax.scan(walk, last, backs[::-1])
+                return jnp.concatenate([jnp.asarray([first]),
+                                        path[::-1]]).astype(jnp.int64)
+
+            return jax.vmap(viterbi_one)(emissions)
+
+    return record(None, (input,), {}, layer=_CRFDecode(),
+                  hint=name or "crf_decoding")
+
+
+def deform_conv2d(input, offset, mask=None, num_filters=1, filter_size=3,
+                  stride=1, padding=0, dilation=1, groups=1,
+                  deformable_groups=1, im2col_step=1, param_attr=None,
+                  bias_attr=None, modulated=True, name=None):
+    """Reference: fluid/layers/nn.py deformable_conv (deformable_conv_op):
+    kernel taps sample the input at learned offsets via bilinear
+    interpolation (the grid_sample machinery), then contract as a conv."""
+    from ..nn.layer import Layer
+
+    in_ch = _static_dim(input.shape, 1, "deform_conv2d")
+    kh = kw = int(filter_size) if isinstance(filter_size, int) else None
+    if kh is None:
+        kh, kw = (int(s) for s in filter_size)
+    s = stride if isinstance(stride, (list, tuple)) else (stride, stride)
+    p = padding if isinstance(padding, (list, tuple)) else (padding,
+                                                            padding)
+    d = dilation if isinstance(dilation, (list, tuple)) else (dilation,
+                                                              dilation)
+
+    class _DeformConv(Layer):
+        def __init__(self):
+            super().__init__()
+            self.weight = self.create_parameter(
+                (num_filters, in_ch // groups, kh, kw), attr=param_attr)
+            self.bias = None if bias_attr is False else \
+                self.create_parameter((num_filters,), is_bias=True,
+                                      attr=bias_attr)
+
+        def forward(self, x, off, msk=None):
+            """Offset layout (torchvision/reference convention):
+            [N, dg*2*kh*kw, oh, ow], per deformable group a (kh, kw, 2)
+            block with (y, x) per tap; mask [N, dg*kh*kw, oh, ow]."""
+            import jax.numpy as jnp
+            n, c, h, w = x.shape
+            dg = deformable_groups
+            oh = (h + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+            ow = (w + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+            xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+            hp, wp = xp.shape[2], xp.shape[3]
+            # base sampling positions [oh/1, ow/1, kh/1, kw/1]
+            by = (jnp.arange(oh) * s[0])[:, None, None, None] + \
+                (jnp.arange(kh) * d[0])[None, None, :, None]
+            bx = (jnp.arange(ow) * s[1])[None, :, None, None] + \
+                (jnp.arange(kw) * d[1])[None, None, None, :]
+            off = off.reshape(n, dg, kh, kw, 2, oh, ow)
+            oy = jnp.moveaxis(off[..., 0, :, :], (2, 3), (4, 5))
+            ox = jnp.moveaxis(off[..., 1, :, :], (2, 3), (4, 5))
+            py = by[None, None] + oy        # [N, dg, oh, ow, kh, kw]
+            px = bx[None, None] + ox
+            m = None
+            if msk is not None and modulated:
+                m = jnp.moveaxis(msk.reshape(n, dg, kh, kw, oh, ow),
+                                 (2, 3), (4, 5))
+
+            def sample_group(xg, yy, xx, mg):
+                """Bilinear-sample one deformable group's channels."""
+                cg = xg.shape[1]
+                y0 = jnp.floor(yy)
+                x0 = jnp.floor(xx)
+
+                def gather(ya, xa):
+                    valid = (ya >= 0) & (ya <= hp - 1) & (xa >= 0) & \
+                        (xa <= wp - 1)
+                    yc = jnp.clip(ya, 0, hp - 1).astype(jnp.int32)
+                    xc = jnp.clip(xa, 0, wp - 1).astype(jnp.int32)
+                    flat = (yc * wp + xc).reshape(n, -1)
+                    got = jnp.take_along_axis(
+                        xg.reshape(n, cg, hp * wp), flat[:, None], axis=2)
+                    got = got.reshape((n, cg) + yy.shape[1:])
+                    return got * valid[:, None].astype(got.dtype)
+
+                wy = yy - y0
+                wx = xx - x0
+                patch = (gather(y0, x0) * ((1 - wy) * (1 - wx))[:, None]
+                         + gather(y0 + 1, x0) * (wy * (1 - wx))[:, None]
+                         + gather(y0, x0 + 1) * ((1 - wy) * wx)[:, None]
+                         + gather(y0 + 1, x0 + 1) * (wy * wx)[:, None])
+                if mg is not None:
+                    patch = patch * mg[:, None]
+                return patch
+
+            cg = c // dg
+            patches = jnp.concatenate([
+                sample_group(xp[:, g * cg:(g + 1) * cg], py[:, g],
+                             px[:, g], None if m is None else m[:, g])
+                for g in range(dg)], axis=1)   # [N, C, oh, ow, kh, kw]
+            if groups == 1:
+                out = jnp.einsum("nchwkl,ockl->nohw", patches,
+                                 self.weight.value)
+            else:
+                og = num_filters // groups
+                cpg = c // groups
+                out = jnp.concatenate([
+                    jnp.einsum("nchwkl,ockl->nohw",
+                               patches[:, g * cpg:(g + 1) * cpg],
+                               self.weight.value[g * og:(g + 1) * og])
+                    for g in range(groups)], axis=1)
+            if self.bias is not None:
+                out = out + self.bias.value[None, :, None, None]
+            return out
+
+    args = (input, offset) if mask is None else (input, offset, mask)
+    return record(None, args, {}, layer=_DeformConv(),
+                  hint=name or "deform_conv2d")
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, flip=True, clip=False, name=None,
+                   **kwargs):
+    """Reference: fluid/layers/detection.py multi_box_head (SSD): per
+    feature map, a 3x3 conv produces loc [N, P, 4] + conf [N, P, C], and
+    prior boxes come from `vision.ops.prior_box`."""
+    import numpy as np
+    from ..vision.ops import prior_box as _prior_box
+
+    if min_sizes is None:
+        # reference ratio schedule (detection.py:multi_box_head)
+        num = len(inputs)
+        step = int(np.floor((max_ratio - min_ratio) / (num - 2)))
+        min_sizes, max_sizes = [base_size * 0.1], [base_size * 0.2]
+        for r in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * r / 100.0)
+            max_sizes.append(base_size * (r + step) / 100.0)
+        min_sizes = min_sizes[:num]
+        max_sizes = max_sizes[:num]
+
+    locs, confs, boxes, vars_ = [], [], [], []
+    img_h = _static_dim(image.shape, 2, "multi_box_head")
+    img_w = _static_dim(image.shape, 3, "multi_box_head")
+    for i, feat in enumerate(inputs):
+        ar = aspect_ratios[i]
+        n_priors = len(ar) * (2 if flip else 1) + 1 + (
+            1 if max_sizes else 0)
+        h = _static_dim(feat.shape, 2, "multi_box_head")
+        w = _static_dim(feat.shape, 3, "multi_box_head")
+        loc = conv2d(feat, n_priors * 4, 3, padding=1,
+                     name=f"{name or 'mbox'}_loc{i}")
+        conf = conv2d(feat, n_priors * num_classes, 3, padding=1,
+                      name=f"{name or 'mbox'}_conf{i}")
+
+        def reshape_pred(v, last):
+            import jax.numpy as jnp
+            return jnp.reshape(jnp.transpose(v, (0, 2, 3, 1)),
+                               (v.shape[0], -1, last))
+
+        locs.append(record(lambda v: reshape_pred(v, 4), (loc,), {},
+                           hint="mbox_loc_r"))
+        confs.append(record(lambda v: reshape_pred(v, num_classes),
+                            (conf,), {}, hint="mbox_conf_r"))
+        pb, pv = _prior_box(
+            (h, w), (img_h, img_w), min_sizes=[min_sizes[i]],
+            max_sizes=[max_sizes[i]] if max_sizes else None,
+            aspect_ratios=list(ar), flip=flip, clip=clip)
+        boxes.append(np.asarray(pb).reshape(-1, 4))
+        vars_.append(np.asarray(pv).reshape(-1, 4))
+
+    import paddle_tpu as pt
+    mbox_locs = pt.concat(locs, axis=1)
+    mbox_confs = pt.concat(confs, axis=1)
+    box = np.concatenate(boxes, axis=0)
+    var = np.concatenate(vars_, axis=0)
+    return mbox_locs, mbox_confs, box, var
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Reference: fluid/layers/nn.py py_func — host-python op in the graph
+    via `jax.pure_callback` (the TPU-native escape hatch)."""
+    import jax
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    out_spec = out if isinstance(out, (list, tuple)) else [out]
+
+    def run(*vals):
+        # dynamic (None) out dims resolve to the first input's leading
+        # dim — the batch contract of the reference's py_func usage
+        lead = vals[0].shape[0]
+        shapes = [jax.ShapeDtypeStruct(
+            tuple(lead if d is None else d for d in o.shape), o.dtype)
+            for o in out_spec]
+        res = jax.pure_callback(
+            lambda *a: func(*a) if len(a) > 1 else func(a[0]),
+            shapes[0] if len(shapes) == 1 else shapes, *vals)
+        return res
+
+    return record(run, tuple(xs), {}, hint="py_func")
+
+
+# sequence_* builders delegate to the padded+lengths sequence library
+# (tensor/sequence.py — the LoD redesign); in static mode they record.
+
+def _seq(fn_name):
+    from ..tensor import sequence as S
+    fn = getattr(S, fn_name)
+
+    def builder(*args, **kwargs):
+        if any(isinstance(a, Variable) for a in args):
+            return record(fn, args, kwargs, hint=fn_name)
+        return fn(*args, **kwargs)
+
+    builder.__name__ = fn_name
+    builder.__doc__ = fn.__doc__
+    return builder
+
+
+sequence_concat = _seq("sequence_concat")
+sequence_conv = _seq("sequence_conv")
+sequence_enumerate = _seq("sequence_enumerate")
+sequence_expand = _seq("sequence_expand")
+sequence_pad = _seq("sequence_pad")
+sequence_pool = _seq("sequence_pool")
+sequence_reverse = _seq("sequence_reverse")
+sequence_slice = _seq("sequence_slice")
+sequence_softmax = _seq("sequence_softmax")
+sequence_unpad = _seq("sequence_unpad")
+
+
+def sequence_first_step(input, lengths=None):
+    from ..tensor import sequence as S
+    if isinstance(input, Variable):
+        return record(lambda x: S.sequence_pool(x, "first"), (input,), {},
+                      hint="seq_first")
+    return S.sequence_pool(input, "first", lengths)
+
+
+def sequence_last_step(input, lengths=None):
+    from ..tensor import sequence as S
+    if isinstance(input, Variable):
+        return record(lambda x: S.sequence_pool(x, "last"), (input,), {},
+                      hint="seq_last")
+    return S.sequence_pool(input, "last", lengths)
+
+
+def sequence_reshape(input, new_dim):
+    import jax.numpy as jnp
+
+    def run(x):
+        return jnp.reshape(x, (x.shape[0], -1, new_dim))
+
+    if isinstance(input, Variable):
+        return record(run, (input,), {}, hint="seq_reshape")
+    return run(input)
+
+
+def sequence_expand_as(x, y):
+    from ..tensor import sequence as S
+
+    def run(a, b):
+        import jax.numpy as jnp
+        reps = b.shape[1] // a.shape[1] if a.shape[1] else 1
+        return jnp.repeat(a, reps, axis=1)
+
+    if isinstance(x, Variable):
+        return record(run, (x, y), {}, hint="seq_expand_as")
+    return run(x, y)
+
+
+def sequence_scatter(input, index, updates):
+    def run(x, idx, upd):
+        return x.at[idx].add(upd)
+
+    if isinstance(input, Variable):
+        return record(run, (input, index, updates), {}, hint="seq_scatter")
+    return run(input, index, updates)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """static.nn.create_parameter (reference re-export)."""
+    from ..framework import create_parameter as _cp
+    return _cp(shape, dtype=dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)

@@ -1,0 +1,498 @@
+"""Static-graph mode: Program / Variable / Executor-replay core.
+
+Reference: the ProgramDesc object model (`fluid/framework.py` Program/
+Block/Variable, `framework/program_desc.cc`) executed by the C++ Executor
+(`framework/executor.cc`). The TPU-native redesign records each layer/op
+call as a deferred closure (`Operator`) on a `Program`; `Executor.run`
+replays the op list as ONE jax function — compiled by XLA exactly like
+the rest of the framework — with parameters and BN-style buffers threaded
+functionally so `minimize` can differentiate the whole program.
+
+What maps where:
+  ProgramDesc op list        → Program.ops (deferred closures)
+  Scope / persistables       → Parameter objects on each Operator
+  Executor::Run(fetch)       → jitted replay keyed by (ops, fetches, feeds)
+  append_backward + SGD ops  → jax.value_and_grad over the replay + the
+                               optimizer's functional `apply`
+  Program.clone(for_test)    → kwargs override (training=False) on ops
+
+Dispatch: Python operators on `Variable` and a curated set of top-level /
+functional ops are static-aware — called on a Variable they record instead
+of executing (see `_install_dispatch`). RNG-consuming ops (dropout, nce
+sampling) draw from a per-run step key the Executor threads through the
+replay (`rng_guard`), so masks/negatives vary across runs like the
+reference's seeded ops.
+"""
+from __future__ import annotations
+
+import functools
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+# --------------------------------------------------------------- variables
+
+class Variable:
+    """Symbolic handle for a value produced inside a Program."""
+
+    def __init__(self, program: "Program", name: str, shape, dtype,
+                 is_data: bool = False, lod_level: int = 0):
+        self.program = program
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self.is_data = is_data
+        self.lod_level = lod_level
+        self.stop_gradient = is_data
+        self.persistable = False
+
+    # ---- numpy-style niceties
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def astype(self, dtype):
+        import jax.numpy as _j
+        return record(lambda v: v.astype(dtype), (self,), {})
+
+    def __repr__(self):
+        return (f"static.Variable(name={self.name!r}, shape={self.shape}, "
+                f"dtype={self.dtype})")
+
+    # ---- operators record
+    def _binop(self, other, fn):
+        return record(fn, (self, other), {})
+
+    def __add__(self, o):
+        return self._binop(o, lambda a, b: a + b)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, lambda a, b: a - b)
+
+    def __rsub__(self, o):
+        return self._binop(o, lambda a, b: b - a)
+
+    def __mul__(self, o):
+        return self._binop(o, lambda a, b: a * b)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, lambda a, b: a / b)
+
+    def __rtruediv__(self, o):
+        return self._binop(o, lambda a, b: b / a)
+
+    def __pow__(self, o):
+        return self._binop(o, lambda a, b: a ** b)
+
+    def __matmul__(self, o):
+        return self._binop(o, lambda a, b: a @ b)
+
+    def __neg__(self):
+        return record(lambda a: -a, (self,), {})
+
+    def __getitem__(self, idx):
+        return record(lambda a: a[idx], (self,), {})
+
+    def __lt__(self, o):
+        return self._binop(o, lambda a, b: a < b)
+
+    def __le__(self, o):
+        return self._binop(o, lambda a, b: a <= b)
+
+    def __gt__(self, o):
+        return self._binop(o, lambda a, b: a > b)
+
+    def __ge__(self, o):
+        return self._binop(o, lambda a, b: a >= b)
+
+
+class Operator:
+    """One recorded call: `fn(params?, buffers?, *inputs, **attrs)`.
+
+    `fn` is a pure callable over arrays. Layer-backed ops carry `layer`
+    (its params/buffers are threaded through the replay); plain ops have
+    layer=None.
+    """
+
+    def __init__(self, fn: Callable, inputs: Sequence[Variable],
+                 outputs: Sequence[Variable], attrs: Dict[str, Any],
+                 layer=None, arg_template=None, type: str = "op"):
+        self.fn = fn
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+        self.attrs = dict(attrs)
+        self.layer = layer
+        self.arg_template = arg_template
+        self.type = type
+
+
+class _Block:
+    """Minimal Block shim: reference code reads program.global_block().vars
+    and .create_parameter."""
+
+    def __init__(self, program):
+        self.program = program
+
+    @property
+    def vars(self):
+        return self.program._vars
+
+    def var(self, name):
+        return self.program._vars[name]
+
+    @property
+    def ops(self):
+        return self.program.ops
+
+
+class Program:
+    """Reference: `fluid.framework.Program`. Records Operators; see module
+    docstring for the execution contract."""
+
+    _name_counter = itertools.count()
+
+    def __init__(self):
+        self.ops: List[Operator] = []
+        self._vars: Dict[str, Variable] = {}
+        self._data_vars: List[Variable] = []
+        self._params: Dict[str, Any] = {}     # name -> nn.layer.Parameter
+        self.random_seed = 0
+        self._train_spec = None               # (loss_var, optimizer)
+        self._grad_targets: List = []         # loss vars for append_backward
+        self._version = 0
+        self._block = _Block(self)
+
+    # ---- structure
+    def global_block(self):
+        return self._block
+
+    def block(self, i=0):
+        return self._block
+
+    @property
+    def num_blocks(self):
+        return 1
+
+    def list_vars(self):
+        return list(self._vars.values())
+
+    def all_parameters(self):
+        return list(self._params.values())
+
+    def current_block(self):
+        return self._block
+
+    def _unique(self, hint="tmp"):
+        return f"{hint}_{next(Program._name_counter)}"
+
+    def _add_var(self, shape, dtype, hint="tmp", is_data=False) -> Variable:
+        v = Variable(self, self._unique(hint), shape, dtype, is_data)
+        self._vars[v.name] = v
+        return v
+
+    def _bump(self):
+        self._version += 1
+
+    def clone(self, for_test: bool = False) -> "Program":
+        """Reference: Program.clone(for_test=True) strips backward ops and
+        flips is_test. Here ops are shared (closures are immutable); test
+        clones override `training`-style attrs and drop the train spec."""
+        p = Program.__new__(Program)
+        p.__dict__.update(self.__dict__)
+        p._block = _Block(p)
+        if for_test:
+            p.ops = []
+            for op in self.ops:
+                attrs = dict(op.attrs)
+                if "training" in attrs:
+                    attrs["training"] = False
+                if "is_test" in attrs:
+                    attrs["is_test"] = True
+                if op.layer is not None:
+                    attrs["__force_eval__"] = True
+                p.ops.append(Operator(op.fn, op.inputs, op.outputs, attrs,
+                                      layer=op.layer,
+                                      arg_template=op.arg_template,
+                                      type=op.type))
+            p._train_spec = None
+            p._version = self._version + 1_000_000  # distinct compile key
+        else:
+            p.ops = list(self.ops)
+        return p
+
+    def state_dict(self, mode="all"):
+        return {n: param.value for n, param in self._params.items()}
+
+    def set_state_dict(self, state):
+        for n, v in state.items():
+            if n in self._params:
+                self._params[n].value = jnp.asarray(v)
+
+
+# ------------------------------------------------------- default programs
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+class program_guard:
+    """Reference: `fluid.program_guard` — scope the default programs."""
+
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        global _main_program, _startup_program
+        self._old = (_main_program, _startup_program)
+        _main_program = self.main
+        if self.startup is not None:
+            _startup_program = self.startup
+        return self.main
+
+    def __exit__(self, *exc):
+        global _main_program, _startup_program
+        _main_program, _startup_program = self._old
+        return False
+
+
+# ----------------------------------------------------------------- scope
+
+class Scope:
+    """Reference: framework::Scope — name → persistable value. Proxies the
+    parameters of the default main program."""
+
+    def var(self, name):
+        return self.find_var(name)
+
+    def find_var(self, name):
+        p = default_main_program()._params.get(name)
+        if p is None:
+            return None
+
+        class _VarProxy:
+            def __init__(self, param):
+                self._param = param
+
+            def get_tensor(self):
+                return np.asarray(self._param.value)
+
+            def set(self, value, place=None):
+                self._param.value = jnp.asarray(value)
+
+        return _VarProxy(p)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        return self.scope
+
+    def __exit__(self, *exc):
+        return False
+
+
+# ------------------------------------------------------------- recording
+
+def _in_static_mode() -> bool:
+    from ..framework import in_dynamic_mode
+    return not in_dynamic_mode()
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Variable:
+    """Reference: `paddle.static.data` (fluid/data.py) — a feed slot."""
+    prog = default_main_program()
+    shape = [None if (d is None or int(d) < 0) else int(d) for d in shape]
+    v = Variable(prog, name, shape, dtype, is_data=True,
+                 lod_level=lod_level)
+    prog._vars[name] = v
+    prog._data_vars.append(v)
+    prog._bump()
+    return v
+
+
+def _placeholder(var: Variable):
+    shape = tuple(1 if d is None else d for d in var.shape)
+    return jax.ShapeDtypeStruct(shape, var.dtype)
+
+
+def record(fn: Callable, args: tuple, kwargs: dict, layer=None,
+           hint: str = "tmp", op_type: str = "op"):
+    """Record `fn(*args, **kwargs)` (Variables among args become runtime
+    inputs) into the producing program; returns output Variable(s).
+
+    For layer-backed ops pass `layer` (fn is ignored): the layer's params
+    join `program._params` (differentiated by minimize) and its buffers
+    (BN running stats) are threaded functionally through the replay.
+    """
+    import inspect
+
+    def _vars_in(x):
+        if isinstance(x, Variable):
+            return [x]
+        if isinstance(x, (list, tuple)):
+            return [e for e in x if isinstance(e, Variable)]
+        return []
+
+    var_args = [v for a in args for v in _vars_in(a)] + \
+               [v for kv in kwargs.values() for v in _vars_in(kv)]
+    if not var_args:
+        raise ValueError("record() needs at least one Variable input")
+    prog = var_args[0].program
+
+    kwargs = dict(kwargs)
+    if layer is None:
+        # surface `training`-style defaults so clone(for_test) can flip them
+        try:
+            sig = inspect.signature(fn)
+            if "training" in sig.parameters and "training" not in kwargs:
+                default = sig.parameters["training"].default
+                if default is not inspect.Parameter.empty:
+                    kwargs["training"] = default
+        except (TypeError, ValueError):
+            pass
+
+    def call_with(values, attrs, params=None, buffers=None):
+        """values: runtime arrays for the Variable slots, in var_args
+        order (Variables inside list/tuple args included). attrs: the
+        (possibly clone-overridden) kwargs dict."""
+        it = iter(values)
+
+        def fill(a):
+            if isinstance(a, Variable):
+                return next(it)
+            if isinstance(a, (list, tuple)) and any(
+                    isinstance(e, Variable) for e in a):
+                return type(a)(next(it) if isinstance(e, Variable) else e
+                               for e in a)
+            return a
+
+        call_args = [fill(a) for a in args]
+        call_kwargs = {k: fill(v) for k, v in attrs.items()}
+        if layer is not None:
+            from ..nn.layer import functional_call
+            was_training = layer.training
+            if attrs.get("__force_eval__"):
+                layer.eval()
+            try:
+                out, new_buf = functional_call(
+                    layer, params, *call_args, buffers=buffers,
+                    **{k: v for k, v in call_kwargs.items()
+                       if k != "__force_eval__"})
+            finally:
+                if was_training:
+                    layer.train()
+            return out, new_buf
+        return fn(*call_args, **call_kwargs), None
+
+    phs = [_placeholder(v) for v in var_args]
+    from ..framework.random import rng_guard
+    with rng_guard(jax.random.key(0)):   # abstract eval must not touch
+        if layer is not None:            # the process-global RNG state
+            params0 = {n: p.value for n, p in _layer_params(layer).items()}
+            buffers0 = {n: b.value
+                        for n, b in _layer_buffers(layer).items()}
+            out_aval = jax.eval_shape(
+                lambda vals: call_with(vals, kwargs, params0, buffers0)[0],
+                phs)
+        else:
+            out_aval = jax.eval_shape(
+                lambda vals: call_with(vals, kwargs)[0], phs)
+
+    flat_out, treedef = jax.tree.flatten(out_aval)
+    out_vars = [prog._add_var(a.shape, a.dtype, hint) for a in flat_out]
+    op = Operator(fn, var_args, out_vars, kwargs, layer=layer,
+                  arg_template=(call_with, treedef), type=op_type)
+    prog.ops.append(op)
+    prog._bump()
+    if layer is not None:
+        for n, p in _layer_params(layer).items():
+            prog._params[p.name] = p   # Parameter names are globally unique
+    outs = jax.tree.unflatten(treedef, out_vars)
+    return outs
+
+
+def _layer_params(layer):
+    return dict(layer.named_parameters())
+
+
+def _layer_buffers(layer):
+    named = getattr(layer, "named_buffers", None)
+    return dict(named()) if named is not None else {}
+
+
+# ------------------------------------------------------------- dispatch
+
+_DISPATCH_TOP = [
+    "mean", "sum", "max", "min", "reshape", "concat", "squeeze",
+    "unsqueeze", "transpose", "cast", "matmul", "add", "multiply",
+    "subtract", "divide", "sqrt", "square", "abs", "clip", "flatten",
+    "argmax", "argmin", "exp", "log", "stack", "tanh", "pow", "maximum",
+    "minimum",
+]
+_DISPATCH_F = [
+    "relu", "sigmoid", "tanh", "softmax", "cross_entropy",
+    "square_error_cost", "softmax_with_cross_entropy", "mse_loss",
+    "binary_cross_entropy", "dropout", "one_hot", "log_loss", "gelu",
+    "leaky_relu", "elu",
+]
+
+
+def _has_variable(x):
+    if isinstance(x, Variable):
+        return True
+    if isinstance(x, (list, tuple)):
+        return any(isinstance(e, Variable) for e in x)
+    return False
+
+
+def _make_dispatch(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if any(_has_variable(a) for a in args) or \
+                any(_has_variable(v) for v in kwargs.values()):
+            return record(fn, args, kwargs,
+                          hint=getattr(fn, "__name__", "op"))
+        return fn(*args, **kwargs)
+
+    wrapper._static_aware = True
+    wrapper._wrapped_fn = fn
+    return wrapper
+
+
+def _install_dispatch():
+    """Make the curated op set Variable-aware on the public namespaces."""
+    import paddle_tpu as pt
+    for mod, names in ((pt, _DISPATCH_TOP), (pt.tensor, _DISPATCH_TOP),
+                       (pt.nn.functional, _DISPATCH_F)):
+        for name in names:
+            fn = getattr(mod, name, None)
+            if fn is not None and callable(fn) \
+                    and not getattr(fn, "_static_aware", False):
+                setattr(mod, name, _make_dispatch(fn))

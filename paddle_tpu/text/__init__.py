@@ -202,3 +202,16 @@ class Movielens(Dataset):
 
     def __getitem__(self, i):
         return self.user[i], self.movie[i], self.rating[i]
+
+
+# submodule-path parity: reference exposes these under paddle.text.datasets
+import sys as _sys
+import types as _types
+
+datasets = _types.ModuleType(__name__ + ".datasets")
+for _n in ("Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"):
+    if _n in globals():
+        setattr(datasets, _n, globals()[_n])
+_sys.modules[datasets.__name__] = datasets
+del _sys, _types, _n

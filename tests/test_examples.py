@@ -22,3 +22,9 @@ def test_word2vec_example():
     import word2vec
     l0, l1 = word2vec.main(steps=60)
     assert l1 < l0
+
+
+def test_fit_a_line_static_example():
+    import fit_a_line_static
+    loss = fit_a_line_static.main(epochs=10)
+    assert loss < 60.0  # UCI housing MSE after a few epochs

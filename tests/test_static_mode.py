@@ -1,0 +1,414 @@
+"""Static-graph mode: Program record/replay, Executor, minimize,
+static.nn builders.
+
+Reference workflow being mirrored (SURVEY §3.1 static training step):
+build program with static.data + static.nn ops, optimizer.minimize(loss),
+Executor.run(feed, fetch_list) — here the replay is ONE jitted jax
+function (static/executor.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def _programs():
+    return paddle.static.Program(), paddle.static.Program()
+
+
+class TestStaticTraining:
+    def test_fc_regression_converges(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 13], "float32")
+            y = paddle.static.data("y", [None, 1], "float32")
+            h = paddle.static.nn.fc(x, 32, activation="relu")
+            pred = paddle.static.nn.fc(h, 1)
+            loss = paddle.mean(
+                paddle.nn.functional.square_error_cost(pred, y))
+            paddle.optimizer.SGD(learning_rate=0.01).minimize(loss)
+        exe = paddle.static.Executor(paddle.CPUPlace())
+        exe.run(startup)
+        rs = np.random.RandomState(0)
+        X = rs.randn(64, 13).astype("float32")
+        Y = (X @ rs.randn(13, 1)).astype("float32")
+        first = last = None
+        for _ in range(50):
+            (lv,) = exe.run(main, feed={"x": X, "y": Y},
+                            fetch_list=[loss])
+            first = lv if first is None else first
+            last = lv
+        assert float(last) < float(first) * 0.5
+
+    def test_conv_bn_classifier(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            img = paddle.static.data("img", [None, 1, 8, 8], "float32")
+            label = paddle.static.data("label", [None, 1], "int64")
+            c = paddle.static.nn.conv2d(img, 4, 3, padding=1, act="relu")
+            c = paddle.static.nn.batch_norm(c)
+            feat = paddle.flatten(c, 1)
+            logits = paddle.static.nn.fc(feat, 10)
+            loss = paddle.mean(paddle.nn.functional.cross_entropy(
+                logits, label))
+            acc = paddle.static.accuracy(
+                paddle.nn.functional.softmax(logits), label)
+            paddle.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        rs = np.random.RandomState(0)
+        X = rs.randn(32, 1, 8, 8).astype("float32")
+        Y = rs.randint(0, 10, (32, 1)).astype("int64")
+        l0 = a0 = None
+        for _ in range(30):
+            lv, av = exe.run(main, feed={"img": X, "label": Y},
+                             fetch_list=[loss, acc])
+            if l0 is None:
+                l0, a0 = lv, av
+        assert float(lv) < float(l0)
+        assert float(av) >= float(a0)
+
+    def test_bn_buffers_update(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 3, 4, 4], "float32")
+            out = paddle.static.nn.batch_norm(x)
+            loss = paddle.mean(out)
+            paddle.optimizer.SGD(learning_rate=0.0).minimize(loss)
+        exe = paddle.static.Executor()
+        bn_layer = main.ops[0].layer
+        mean_before = np.asarray(bn_layer._mean.value).copy()
+        X = np.random.RandomState(0).randn(8, 3, 4, 4).astype("float32") \
+            + 5.0
+        exe.run(main, feed={"x": X}, fetch_list=[loss])
+        mean_after = np.asarray(bn_layer._mean.value)
+        assert not np.allclose(mean_before, mean_after)
+        assert mean_after.mean() > 0.1  # moved toward the +5 data mean
+
+    def test_clone_for_test_freezes(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 6], "float32")
+            h = paddle.static.nn.fc(x, 6)
+            h = paddle.nn.functional.dropout(h, 0.5)
+            loss = paddle.mean(h * h)
+            paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        test_prog = main.clone(for_test=True)
+        exe = paddle.static.Executor()
+        X = np.ones((4, 6), np.float32)
+        a = exe.run(test_prog, feed={"x": X}, fetch_list=[loss])[0]
+        b = exe.run(test_prog, feed={"x": X}, fetch_list=[loss])[0]
+        np.testing.assert_allclose(a, b)  # eval: deterministic, no update
+
+    def test_append_backward_grad_fetch(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 4], "float32")
+            pred = paddle.static.nn.fc(x, 1, bias_attr=False)
+            loss = paddle.mean(pred * pred)
+            pairs = paddle.static.append_backward(loss)
+        assert pairs and pairs[0][1].endswith("@GRAD")
+        exe = paddle.static.Executor()
+        X = np.ones((8, 4), np.float32)
+        (g,) = exe.run(main, feed={"x": X}, fetch_list=[pairs[0][1]])
+        w = np.asarray(main.all_parameters()[0].value)
+        # d/dw mean((xw)^2) = 2/N * x^T (x w)
+        expect = 2.0 * X.T @ (X @ w) / X.shape[0]
+        np.testing.assert_allclose(g, expect, rtol=1e-4)
+
+
+class TestStaticNNOps:
+    def test_embedding_and_sequence(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            ids = paddle.static.data("ids", [None, 5], "int64")
+            emb = paddle.static.nn.embedding(ids, (20, 8))
+            pooled = paddle.static.nn.sequence_pool(emb, "max")
+            loss = paddle.mean(pooled)
+        exe = paddle.static.Executor()
+        out = exe.run(main,
+                      feed={"ids": np.zeros((3, 5), np.int64)},
+                      fetch_list=[emb, loss])
+        assert out[0].shape == (3, 5, 8)
+
+    def test_layer_norm_group_norm_prelu(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 4, 6, 6], "float32")
+            a = paddle.static.nn.group_norm(x, groups=2)
+            b = paddle.static.nn.prelu(a, mode="channel")
+            c = paddle.static.nn.layer_norm(b, begin_norm_axis=1)
+            loss = paddle.mean(c)
+        exe = paddle.static.Executor()
+        X = np.random.RandomState(0).randn(2, 4, 6, 6).astype("float32")
+        (out,) = exe.run(main, feed={"x": X}, fetch_list=[c])
+        assert out.shape == (2, 4, 6, 6)
+        assert abs(out.mean()) < 1e-4  # layer-normalized
+
+    def test_row_conv(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 7, 4], "float32")
+            y = paddle.static.nn.row_conv(x, future_context_size=2)
+        exe = paddle.static.Executor()
+        X = np.random.RandomState(0).randn(2, 7, 4).astype("float32")
+        (out,) = exe.run(main, feed={"x": X}, fetch_list=[y])
+        w = np.asarray(main.all_parameters()[0].value)
+        pad = np.pad(X, ((0, 0), (0, 2), (0, 0)))
+        expect = sum(pad[:, i:i + 7] * w[i] for i in range(3))
+        np.testing.assert_allclose(out, expect, rtol=1e-4)
+
+    def test_crf_decoding_matches_bruteforce(self, static_mode):
+        main, startup = _programs()
+        n_tags, T = 3, 4
+        with paddle.static.program_guard(main, startup):
+            em = paddle.static.data("em", [None, T, n_tags], "float32")
+            path = paddle.static.nn.crf_decoding(em)
+        exe = paddle.static.Executor()
+        rs = np.random.RandomState(0)
+        E = rs.randn(2, T, n_tags).astype("float32")
+        trans = np.asarray(main.all_parameters()[0].value)
+        (got,) = exe.run(main, feed={"em": E}, fetch_list=[path])
+
+        # brute force best path
+        import itertools
+        start, stop, pair = trans[0], trans[1], trans[2:]
+        for b in range(2):
+            best, best_s = None, -1e9
+            for cand in itertools.product(range(n_tags), repeat=T):
+                s = start[cand[0]] + E[b, 0, cand[0]]
+                for t in range(1, T):
+                    s += pair[cand[t - 1], cand[t]] + E[b, t, cand[t]]
+                s += stop[cand[-1]]
+                if s > best_s:
+                    best_s, best = s, cand
+            np.testing.assert_array_equal(got[b], best)
+
+    def test_nce_trains(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 8], "float32")
+            y = paddle.static.data("y", [None, 1], "int64")
+            loss = paddle.static.nn.nce(x, y, num_total_classes=50,
+                                        num_neg_samples=5)
+            paddle.optimizer.Adam(learning_rate=5e-2).minimize(loss)
+        exe = paddle.static.Executor()
+        rs = np.random.RandomState(0)
+        X = rs.randn(16, 8).astype("float32")
+        Y = rs.randint(0, 50, (16, 1)).astype("int64")
+        l0 = None
+        for _ in range(20):
+            (lv,) = exe.run(main, feed={"x": X, "y": Y},
+                            fetch_list=[loss])
+            l0 = lv if l0 is None else l0
+        assert float(lv) < float(l0)
+
+    def test_deform_conv2d_zero_offset_matches_conv(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 2, 6, 6], "float32")
+            off = paddle.static.data("off", [None, 18, 4, 4], "float32")
+            y = paddle.static.nn.deform_conv2d(
+                x, off, num_filters=3, filter_size=3, modulated=False)
+        exe = paddle.static.Executor()
+        rs = np.random.RandomState(0)
+        X = rs.randn(1, 2, 6, 6).astype("float32")
+        OFF = np.zeros((1, 18, 4, 4), np.float32)
+        (got,) = exe.run(main, feed={"x": X, "off": OFF}, fetch_list=[y])
+        # zero offsets == plain valid conv with same weight
+        w = np.asarray(main.all_parameters()[0].value)
+        b = np.asarray(main.all_parameters()[1].value)
+        import jax
+        expect = jax.lax.conv_general_dilated(
+            X, w, (1, 1), "VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        expect = np.asarray(expect) + b[None, :, None, None]
+        np.testing.assert_allclose(got, expect, rtol=1e-3, atol=1e-4)
+
+
+class TestStaticMisc:
+    def test_program_state_save_load(self, static_mode, tmp_path):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 4], "float32")
+            pred = paddle.static.nn.fc(x, 2)
+        exe = paddle.static.Executor()
+        X = np.ones((2, 4), np.float32)
+        (a,) = exe.run(main, feed={"x": X}, fetch_list=[pred])
+        path = str(tmp_path / "prog")
+        paddle.static.save(main, path)
+        for p in main.all_parameters():
+            p.value = p.value * 0.0
+        (z,) = exe.run(main, feed={"x": X}, fetch_list=[pred])
+        np.testing.assert_allclose(z, 0.0, atol=1e-6)
+        paddle.static.load(main, path)
+        (b,) = exe.run(main, feed={"x": X}, fetch_list=[pred])
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+    def test_serialize_roundtrip(self, static_mode, tmp_path):
+        from jax import export as jax_export
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [4, 3], "float32")
+            pred = paddle.static.nn.fc(x, 2)
+        blob = paddle.static.serialize_program([x], [pred], program=main)
+        pblob = paddle.static.serialize_persistables([x], [pred],
+                                                     program=main)
+        assert isinstance(blob, (bytes, bytearray)) and len(blob) > 100
+        exported = paddle.static.deserialize_program(blob)
+        X = np.random.RandomState(0).randn(4, 3).astype("float32")
+        got = exported.call({"x": X})
+        exe = paddle.static.Executor()
+        (want,) = exe.run(main, feed={"x": X}, fetch_list=[pred])
+        np.testing.assert_allclose(np.asarray(got[0]), want, rtol=1e-5)
+
+    def test_py_func(self, static_mode):
+        main, startup = _programs()
+
+        def double_np(a):
+            return (np.asarray(a) * 2).astype(np.float32)
+
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [2, 3], "float32")
+            y = paddle.static.py_func(double_np, x, out=x)
+            loss = paddle.mean(y)
+        exe = paddle.static.Executor()
+        X = np.ones((2, 3), np.float32)
+        (out,) = exe.run(main, feed={"x": X}, fetch_list=[y])
+        np.testing.assert_allclose(out, 2.0)
+
+    def test_places_and_guards(self, static_mode):
+        assert len(paddle.static.cpu_places(2)) == 2
+        with paddle.static.name_scope("block1"):
+            with paddle.static.device_guard("cpu"):
+                pass
+        s = paddle.static.BuildStrategy()
+        s.fuse_bn_act_ops = True
+        assert s.fuse_bn_act_ops
+
+    def test_auc_known_value(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            score = paddle.static.data("s", [None, 2], "float32")
+            label = paddle.static.data("l", [None, 1], "int64")
+            a = paddle.static.auc(score, label)
+        exe = paddle.static.Executor()
+        s = np.asarray([[0.9, 0.1], [0.6, 0.4], [0.3, 0.7], [0.1, 0.9]],
+                       np.float32)
+        y = np.asarray([[0], [0], [1], [1]], np.int64)
+        (got,) = exe.run(main, feed={"s": s, "l": y}, fetch_list=[a])
+        assert abs(float(got) - 1.0) < 1e-6  # perfectly separable
+
+    def test_global_scope_roundtrip(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 3], "float32")
+            pred = paddle.static.nn.fc(x, 2, bias_attr=False)
+        pname = main.all_parameters()[0].name
+        proxy = paddle.static.global_scope().find_var(pname)
+        assert proxy is None  # scope proxies the DEFAULT main program
+        with paddle.static.program_guard(main, startup):
+            proxy = paddle.static.global_scope().find_var(pname)
+            w = proxy.get_tensor()
+            proxy.set(np.zeros_like(w))
+        assert np.allclose(np.asarray(main.all_parameters()[0].value), 0)
+
+
+class TestReviewRegressions:
+    """Behaviors fixed after review: @GRAD fetch under minimize, list-arg
+    dispatch (concat), gradients w.r.t. data inputs, multi-group deform
+    offsets, dynamic-batch py_func, non-curated activations."""
+
+    def test_grad_fetch_with_minimize(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 4], "float32")
+            pred = paddle.static.nn.fc(x, 1, bias_attr=False)
+            loss = paddle.mean(pred * pred)
+            _, pairs = paddle.optimizer.SGD(
+                learning_rate=0.0).minimize(loss)
+        exe = paddle.static.Executor()
+        X = np.ones((8, 4), np.float32)
+        lv, g = exe.run(main, feed={"x": X},
+                        fetch_list=[loss, pairs[0][1]])
+        assert g.shape == (4, 1) and np.isfinite(g).all()
+
+    def test_concat_of_variables(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            a = paddle.static.data("a", [None, 2], "float32")
+            b = paddle.static.data("b", [None, 3], "float32")
+            c = paddle.concat([a, b], axis=1)
+            s = paddle.stack([a, a], axis=0)
+        exe = paddle.static.Executor()
+        out = exe.run(main, feed={"a": np.ones((2, 2), np.float32),
+                                  "b": np.zeros((2, 3), np.float32)},
+                      fetch_list=[c, s])
+        assert out[0].shape == (2, 5)
+        assert out[1].shape == (2, 2, 2)
+
+    def test_gradients_wrt_data_input(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 3], "float32")
+            loss = paddle.mean(x * x)
+            (gname,) = paddle.static.gradients(loss, x)
+        exe = paddle.static.Executor()
+        X = np.asarray([[1.0, 2.0, 3.0]], np.float32)
+        (g,) = exe.run(main, feed={"x": X}, fetch_list=[gname])
+        np.testing.assert_allclose(g, 2 * X / 3, rtol=1e-5)
+
+    def test_deform_conv_groups_use_own_offsets(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 2, 5, 5], "float32")
+            off = paddle.static.data("off", [None, 2 * 2 * 9, 3, 3],
+                                     "float32")
+            y = paddle.static.nn.deform_conv2d(
+                x, off, num_filters=2, filter_size=3, modulated=False,
+                deformable_groups=2)
+        exe = paddle.static.Executor()
+        rs = np.random.RandomState(0)
+        X = rs.randn(1, 2, 5, 5).astype("float32")
+        base = np.zeros((1, 36, 3, 3), np.float32)
+        shifted = base.copy()
+        shifted[:, 18:] = 100.0  # push group 1 far out of bounds
+        (a,) = exe.run(main, feed={"x": X, "off": base}, fetch_list=[y])
+        (b,) = exe.run(main, feed={"x": X, "off": shifted},
+                       fetch_list=[y])
+        assert not np.allclose(a, b)  # group-1 offsets must matter
+
+    def test_py_func_dynamic_batch(self, static_mode):
+        main, startup = _programs()
+
+        def triple(a):
+            return (np.asarray(a) * 3).astype(np.float32)
+
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 3], "float32")
+            y = paddle.static.py_func(triple, x, out=x)
+        exe = paddle.static.Executor()
+        for bs in (2, 5):
+            (out,) = exe.run(
+                main, feed={"x": np.ones((bs, 3), np.float32)},
+                fetch_list=[y])
+            assert out.shape == (bs, 3)
+            np.testing.assert_allclose(out, 3.0)
+
+    def test_uncurated_activation_records(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 4], "float32")
+            h = paddle.static.nn.fc(x, 4, activation="relu6")
+        exe = paddle.static.Executor()
+        (out,) = exe.run(main,
+                         feed={"x": np.full((2, 4), 99.0, np.float32)},
+                         fetch_list=[h])
+        assert out.max() <= 6.0 + 1e-6
