@@ -179,3 +179,15 @@ def vjp(func, xs, v=None):
 
 def jvp(func, xs, v):
     return jax.jvp(func, (xs,), (v,))
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """Reference: `paddle.autograd.backward` (imperative tape backward).
+    Autograd here is functional — there is no tape behind an eager array —
+    so this mirrors `paddle_tpu.grad`'s contract: write the computation as
+    a function and differentiate it."""
+    raise RuntimeError(
+        "paddle_tpu.autograd.backward(tensors) is unsupported: autograd "
+        "is functional on TPU (no tape). Write the computation as a "
+        "function and use paddle_tpu.grad(fn) / value_and_grad(fn); for "
+        "custom backward rules use PyLayer (jax.custom_vjp).")

@@ -325,7 +325,9 @@ def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
     feeds = {v.name: jnp.zeros(
         tuple(1 if d is None else d for d in v.shape), v.dtype)
         for v in (feed_vars or prog._data_vars)}
-    run = _replay(prog, sorted(feeds), fetch, train=False)
+    from .executor import needed_ops
+    op_indices, _ = needed_ops(prog, {v.name for v in fetch})
+    run = _replay(prog, op_indices, fetch, train=False)
 
     def fn(feed_vals):
         return run(feed_vals, params, buffers, None, jax.random.key(0))[0]

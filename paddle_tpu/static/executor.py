@@ -20,20 +20,40 @@ from .program import (Program, Variable, default_main_program,
                       default_startup_program)
 
 
-def _replay(program: Program, feed_names, fetch_vars, train: bool):
-    """Build `fn(feed_vals, params, buffers, opt_state) -> ...` replaying
-    the op list. Pure — jit-compiled by the caller."""
+def needed_ops(program: Program, root_names):
+    """Backward-slice the op list from the root var names: only ops whose
+    outputs (transitively) feed a root run — the reference Executor's
+    fetch-target pruning (`executor.cc` prune). Returns (op index list,
+    needed var-name set)."""
+    needed = set(root_names)
+    keep: List[int] = []
+    for i in range(len(program.ops) - 1, -1, -1):
+        op = program.ops[i]
+        if any(v.name in needed for v in op.outputs):
+            keep.append(i)
+            needed.update(v.name for v in op.inputs)
+    return keep[::-1], needed
+
+
+def _replay(program: Program, op_indices, fetch_vars, train: bool):
+    """Build `fn(feed_vals, params, buffers, opt_state, step_key) -> ...`
+    replaying the (pruned) op list. Pure — jit-compiled by the caller."""
     loss_var, optimizer = program._train_spec if train else (None, None)
     grad_targets = list(program._grad_targets)
+    ops = [(i, program.ops[i]) for i in op_indices]
 
     def forward(feed_vals: Dict[str, jax.Array],
                 params: Dict[str, jax.Array],
-                buffers: Dict[int, Dict[str, jax.Array]]):
+                buffers: Dict[int, Dict[str, jax.Array]],
+                override: Optional[Dict[str, jax.Array]] = None):
+        """Replay; `override` swaps the value bound to a var name right
+        after its producing op — the differentiation point for gradients
+        w.r.t. intermediate Variables."""
         env: Dict[str, jax.Array] = dict(feed_vals)
-        # (runs under the caller's rng_guard: RNG-consuming ops draw from
-        # the per-run step key threaded into `run`)
+        if override:
+            env.update({k: v for k, v in override.items() if k in env})
         new_buffers: Dict[int, Dict[str, jax.Array]] = {}
-        for i, op in enumerate(program.ops):
+        for i, op in ops:
             call_with, treedef = op.arg_template
             vals = [env[v.name] for v in op.inputs]
             if op.layer is not None:
@@ -47,7 +67,49 @@ def _replay(program: Program, feed_names, fetch_vars, train: bool):
             flat = jax.tree.flatten(out)[0]
             for var, val in zip(op.outputs, flat):
                 env[var.name] = val
+                if override and var.name in override:
+                    env[var.name] = override[var.name]
         return env, new_buffers
+
+    def compute_grad_targets(feed_vals, params, buffers):
+        """Resolve append_backward/gradients registrations into a
+        '<name>@GRAD' dict: w.r.t. params (wrt=None), data feeds, or
+        intermediate Variables (via the override mechanism)."""
+        grad_vals = {}
+        for loss_v, wrt in grad_targets:
+            if wrt is None:
+                def loss_fn(p):
+                    e, _ = forward(feed_vals, p, buffers)
+                    return e[loss_v.name]
+                for name, g in jax.grad(loss_fn)(params).items():
+                    grad_vals[name + "@GRAD"] = g
+                continue
+            data_wrt = [w for w in wrt
+                        if isinstance(w, Variable) and w.is_data]
+            mid_wrt = [w for w in wrt
+                       if isinstance(w, Variable) and not w.is_data]
+            if data_wrt:
+                def loss_wrt_feed(sub):
+                    fv = dict(feed_vals)
+                    fv.update(sub)
+                    e, _ = forward(fv, params, buffers)
+                    return e[loss_v.name]
+                gs = jax.grad(loss_wrt_feed)(
+                    {w.name: feed_vals[w.name] for w in data_wrt})
+                for name, g in gs.items():
+                    grad_vals[name + "@GRAD"] = g
+            if mid_wrt:
+                env0, _ = forward(feed_vals, params, buffers)
+
+                def loss_wrt_mid(sub):
+                    e, _ = forward(feed_vals, params, buffers,
+                                   override=sub)
+                    return e[loss_v.name]
+                gs = jax.grad(loss_wrt_mid)(
+                    {w.name: env0[w.name] for w in mid_wrt})
+                for name, g in gs.items():
+                    grad_vals[name + "@GRAD"] = g
+        return grad_vals
 
     def run(feed_vals, params, buffers, opt_state, step_key):
         from ..framework.random import rng_guard
@@ -78,33 +140,12 @@ def _replay(program: Program, feed_names, fetch_vars, train: bool):
             new_params, new_opt_state = optimizer.apply(params, grads,
                                                         opt_state)
             grad_vals = {n + "@GRAD": g for n, g in grads.items()}
+            grad_vals.update(compute_grad_targets(feed_vals, params,
+                                                  buffers))
             fetches = _resolve_fetches(env, grad_vals)
             return fetches, new_params, new_buffers, new_opt_state
         env, new_buffers = forward(feed_vals, params, buffers)
-        grad_vals = {}
-        for loss_v, wrt in grad_targets:
-            if wrt is None or all(
-                    not isinstance(w, Variable) or not w.is_data
-                    for w in (wrt or [])):
-                def loss_fn(p):
-                    e, _ = forward(feed_vals, p, buffers)
-                    return e[loss_v.name]
-                gs = jax.grad(loss_fn)(params)
-                for name, g in gs.items():
-                    grad_vals[name + "@GRAD"] = g
-            if wrt:
-                data_wrt = [w for w in wrt
-                            if isinstance(w, Variable) and w.is_data]
-                if data_wrt:
-                    def loss_wrt_feed(sub):
-                        fv = dict(feed_vals)
-                        fv.update(sub)
-                        e, _ = forward(fv, params, buffers)
-                        return e[loss_v.name]
-                    gs = jax.grad(loss_wrt_feed)(
-                        {w.name: feed_vals[w.name] for w in data_wrt})
-                    for name, g in gs.items():
-                        grad_vals[name + "@GRAD"] = g
+        grad_vals = compute_grad_targets(feed_vals, params, buffers)
         fetches = _resolve_fetches(env, grad_vals)
         return fetches, params, new_buffers, opt_state
 
@@ -143,13 +184,30 @@ class Executor:
             else:
                 raise TypeError(f"bad fetch entry {f!r}")
 
+        # prune to fetch targets (+ training loss + registered grad
+        # targets) like the reference Executor, so e.g. inference on a
+        # clone(for_test) of a training program doesn't demand label feeds
+        roots = {f.name for f in fetch_resolved
+                 if isinstance(f, Variable)}
+        if train:
+            roots.add(program._train_spec[0].name)
+        for loss_v, wrt in program._grad_targets:
+            roots.add(loss_v.name)
+            for w in (wrt or []):
+                if isinstance(w, Variable):
+                    roots.add(w.name)
+        op_indices, needed = needed_ops(program, roots)
+
         feed_vals = {}
         for v in program._data_vars:
+            if v.name not in needed and v.name not in roots:
+                continue
             if v.name not in feed:
                 raise ValueError(f"missing feed for data {v.name!r}")
-            arr = jnp.asarray(feed[v.name])
-            feed_vals[v.name] = arr
-        # tolerate extra feed keys (reference ignores them)
+            feed_vals[v.name] = jnp.asarray(feed[v.name])
+        for v in program._data_vars:   # fed-but-unneeded: pass through
+            if v.name in feed and v.name not in feed_vals:
+                feed_vals[v.name] = jnp.asarray(feed[v.name])
 
         params = {n: p.value for n, p in program._params.items()}
         buffers = {i: {n: b.value
@@ -168,7 +226,7 @@ class Executor:
                tuple(sorted((k, v.shape, str(v.dtype))
                             for k, v in feed_vals.items())))
         if key not in self._cache:
-            fn = _replay(program, sorted(feed_vals), fetch_resolved, train)
+            fn = _replay(program, op_indices, fetch_resolved, train)
             self._cache[key] = jax.jit(fn)
         from ..framework.random import next_key
         step_key = next_key()   # eager: fresh randomness per run

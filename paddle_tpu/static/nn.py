@@ -500,102 +500,32 @@ def deform_conv2d(input, offset, mask=None, num_filters=1, filter_size=3,
                   stride=1, padding=0, dilation=1, groups=1,
                   deformable_groups=1, im2col_step=1, param_attr=None,
                   bias_attr=None, modulated=True, name=None):
-    """Reference: fluid/layers/nn.py deformable_conv (deformable_conv_op):
-    kernel taps sample the input at learned offsets via bilinear
-    interpolation (the grid_sample machinery), then contract as a conv."""
+    """Reference: fluid/layers/nn.py deformable_conv (deformable_conv_op).
+    Thin static builder over `vision.ops.deform_conv2d` (the bilinear-
+    sampled tap implementation lives there)."""
     from ..nn.layer import Layer
+    from ..vision import ops as V
 
     in_ch = _static_dim(input.shape, 1, "deform_conv2d")
-    kh = kw = int(filter_size) if isinstance(filter_size, int) else None
-    if kh is None:
-        kh, kw = (int(s) for s in filter_size)
-    s = stride if isinstance(stride, (list, tuple)) else (stride, stride)
-    p = padding if isinstance(padding, (list, tuple)) else (padding,
-                                                            padding)
-    d = dilation if isinstance(dilation, (list, tuple)) else (dilation,
-                                                              dilation)
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
 
     class _DeformConv(Layer):
         def __init__(self):
             super().__init__()
             self.weight = self.create_parameter(
-                (num_filters, in_ch // groups, kh, kw), attr=param_attr)
+                (num_filters, in_ch // groups) + tuple(k),
+                attr=param_attr)
             self.bias = None if bias_attr is False else \
                 self.create_parameter((num_filters,), is_bias=True,
                                       attr=bias_attr)
 
         def forward(self, x, off, msk=None):
-            """Offset layout (torchvision/reference convention):
-            [N, dg*2*kh*kw, oh, ow], per deformable group a (kh, kw, 2)
-            block with (y, x) per tap; mask [N, dg*kh*kw, oh, ow]."""
-            import jax.numpy as jnp
-            n, c, h, w = x.shape
-            dg = deformable_groups
-            oh = (h + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
-            ow = (w + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
-            xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
-            hp, wp = xp.shape[2], xp.shape[3]
-            # base sampling positions [oh/1, ow/1, kh/1, kw/1]
-            by = (jnp.arange(oh) * s[0])[:, None, None, None] + \
-                (jnp.arange(kh) * d[0])[None, None, :, None]
-            bx = (jnp.arange(ow) * s[1])[None, :, None, None] + \
-                (jnp.arange(kw) * d[1])[None, None, None, :]
-            off = off.reshape(n, dg, kh, kw, 2, oh, ow)
-            oy = jnp.moveaxis(off[..., 0, :, :], (2, 3), (4, 5))
-            ox = jnp.moveaxis(off[..., 1, :, :], (2, 3), (4, 5))
-            py = by[None, None] + oy        # [N, dg, oh, ow, kh, kw]
-            px = bx[None, None] + ox
-            m = None
-            if msk is not None and modulated:
-                m = jnp.moveaxis(msk.reshape(n, dg, kh, kw, oh, ow),
-                                 (2, 3), (4, 5))
-
-            def sample_group(xg, yy, xx, mg):
-                """Bilinear-sample one deformable group's channels."""
-                cg = xg.shape[1]
-                y0 = jnp.floor(yy)
-                x0 = jnp.floor(xx)
-
-                def gather(ya, xa):
-                    valid = (ya >= 0) & (ya <= hp - 1) & (xa >= 0) & \
-                        (xa <= wp - 1)
-                    yc = jnp.clip(ya, 0, hp - 1).astype(jnp.int32)
-                    xc = jnp.clip(xa, 0, wp - 1).astype(jnp.int32)
-                    flat = (yc * wp + xc).reshape(n, -1)
-                    got = jnp.take_along_axis(
-                        xg.reshape(n, cg, hp * wp), flat[:, None], axis=2)
-                    got = got.reshape((n, cg) + yy.shape[1:])
-                    return got * valid[:, None].astype(got.dtype)
-
-                wy = yy - y0
-                wx = xx - x0
-                patch = (gather(y0, x0) * ((1 - wy) * (1 - wx))[:, None]
-                         + gather(y0 + 1, x0) * (wy * (1 - wx))[:, None]
-                         + gather(y0, x0 + 1) * ((1 - wy) * wx)[:, None]
-                         + gather(y0 + 1, x0 + 1) * (wy * wx)[:, None])
-                if mg is not None:
-                    patch = patch * mg[:, None]
-                return patch
-
-            cg = c // dg
-            patches = jnp.concatenate([
-                sample_group(xp[:, g * cg:(g + 1) * cg], py[:, g],
-                             px[:, g], None if m is None else m[:, g])
-                for g in range(dg)], axis=1)   # [N, C, oh, ow, kh, kw]
-            if groups == 1:
-                out = jnp.einsum("nchwkl,ockl->nohw", patches,
-                                 self.weight.value)
-            else:
-                og = num_filters // groups
-                cpg = c // groups
-                out = jnp.concatenate([
-                    jnp.einsum("nchwkl,ockl->nohw",
-                               patches[:, g * cpg:(g + 1) * cpg],
-                               self.weight.value[g * og:(g + 1) * og])
-                    for g in range(groups)], axis=1)
-            if self.bias is not None:
-                out = out + self.bias.value[None, :, None, None]
-            return out
+            return V.deform_conv2d(
+                x, off, self.weight, self.bias, stride=stride,
+                padding=padding, dilation=dilation,
+                deformable_groups=deformable_groups, groups=groups,
+                mask=msk if modulated else None)
 
     args = (input, offset) if mask is None else (input, offset, mask)
     return record(None, args, {}, layer=_DeformConv(),

@@ -208,6 +208,12 @@ class Program:
         clones override `training`-style attrs and drop the train spec."""
         p = Program.__new__(Program)
         p.__dict__.update(self.__dict__)
+        # own mutable containers: extending a clone must not corrupt the
+        # original (vars/params stay SHARED objects, the dicts are new)
+        p._vars = dict(self._vars)
+        p._data_vars = list(self._data_vars)
+        p._params = dict(self._params)
+        p._grad_targets = list(self._grad_targets)
         p._block = _Block(p)
         if for_test:
             p.ops = []

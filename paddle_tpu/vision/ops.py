@@ -330,3 +330,152 @@ def multiclass_nms(bboxes, scores, score_threshold: float = 0.01,
         jnp.where(valid[:, None], bboxes[flat_idx[sel]], 0.0),
     ], axis=1)
     return out, jnp.sum(valid.astype(jnp.int32))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None):
+    """Deformable convolution v1/v2 (reference: `paddle.vision.ops.
+    deform_conv2d`, deformable_conv_op.cu). Kernel taps sample the input
+    at learned offsets via bilinear interpolation, then contract like a
+    conv — all gather/interp math, which XLA fuses; no im2col kernel.
+
+    x [N, C, H, W]; offset [N, dg*2*kh*kw, oh, ow] with a (kh, kw, 2)
+    (y, x) block per deformable group; mask [N, dg*kh*kw, oh, ow]
+    (v2 modulation) or None (v1).
+    """
+    w = weight.value if hasattr(weight, "value") else jnp.asarray(weight)
+    num_filters, _, kh, kw = w.shape
+    s = stride if isinstance(stride, (list, tuple)) else (stride, stride)
+    p = padding if isinstance(padding, (list, tuple)) else (padding,
+                                                            padding)
+    d = dilation if isinstance(dilation, (list, tuple)) else (dilation,
+                                                              dilation)
+    n, c, h, wd = x.shape
+    dg = deformable_groups
+    oh = (h + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+    ow = (wd + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+    xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+    hp, wp = xp.shape[2], xp.shape[3]
+    by = (jnp.arange(oh) * s[0])[:, None, None, None] + \
+        (jnp.arange(kh) * d[0])[None, None, :, None]
+    bx = (jnp.arange(ow) * s[1])[None, :, None, None] + \
+        (jnp.arange(kw) * d[1])[None, None, None, :]
+    offset = offset.reshape(n, dg, kh, kw, 2, oh, ow)
+    oy = jnp.moveaxis(offset[..., 0, :, :], (2, 3), (4, 5))
+    ox = jnp.moveaxis(offset[..., 1, :, :], (2, 3), (4, 5))
+    py = by[None, None] + oy            # [N, dg, oh, ow, kh, kw]
+    px = bx[None, None] + ox
+    m = None
+    if mask is not None:
+        m = jnp.moveaxis(jnp.asarray(mask).reshape(n, dg, kh, kw, oh, ow),
+                         (2, 3), (4, 5))
+
+    def sample_group(xg, yy, xx, mg):
+        cg = xg.shape[1]
+        y0 = jnp.floor(yy)
+        x0 = jnp.floor(xx)
+
+        def gather(ya, xa):
+            valid = (ya >= 0) & (ya <= hp - 1) & (xa >= 0) & (xa <= wp - 1)
+            yc = jnp.clip(ya, 0, hp - 1).astype(jnp.int32)
+            xc = jnp.clip(xa, 0, wp - 1).astype(jnp.int32)
+            flat = (yc * wp + xc).reshape(n, -1)
+            got = jnp.take_along_axis(
+                xg.reshape(n, cg, hp * wp), flat[:, None], axis=2)
+            got = got.reshape((n, cg) + yy.shape[1:])
+            return got * valid[:, None].astype(got.dtype)
+
+        wy = yy - y0
+        wx = xx - x0
+        patch = (gather(y0, x0) * ((1 - wy) * (1 - wx))[:, None]
+                 + gather(y0 + 1, x0) * (wy * (1 - wx))[:, None]
+                 + gather(y0, x0 + 1) * ((1 - wy) * wx)[:, None]
+                 + gather(y0 + 1, x0 + 1) * (wy * wx)[:, None])
+        if mg is not None:
+            patch = patch * mg[:, None]
+        return patch
+
+    cg = c // dg
+    patches = jnp.concatenate([
+        sample_group(xp[:, g * cg:(g + 1) * cg], py[:, g], px[:, g],
+                     None if m is None else m[:, g])
+        for g in range(dg)], axis=1)       # [N, C, oh, ow, kh, kw]
+    if groups == 1:
+        out = jnp.einsum("nchwkl,ockl->nohw", patches, w)
+    else:
+        og = num_filters // groups
+        cpg = c // groups
+        out = jnp.concatenate([
+            jnp.einsum("nchwkl,ockl->nohw",
+                       patches[:, g * cpg:(g + 1) * cpg],
+                       w[g * og:(g + 1) * og])
+            for g in range(groups)], axis=1)
+    if bias is not None:
+        b = bias.value if hasattr(bias, "value") else jnp.asarray(bias)
+        out = out + b[None, :, None, None]
+    return out
+
+
+from ..nn.layer import Layer as _Layer  # noqa: E402
+
+
+class DeformConv2D(_Layer):
+    """Layer form (reference: `paddle.vision.ops.DeformConv2D`)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.deformable_groups = deformable_groups
+        self.groups = groups
+        k = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size, kernel_size)
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups) + tuple(k),
+            attr=weight_attr)
+        self.bias = None if bias_attr is False else \
+            self.create_parameter((out_channels,), is_bias=True,
+                                  attr=bias_attr)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, self.bias, stride=self.stride,
+            padding=self.padding, dilation=self.dilation,
+            deformable_groups=self.deformable_groups, groups=self.groups,
+            mask=mask)
+
+
+def read_file(path):
+    """Reference: `paddle.vision.ops.read_file` — raw file bytes as a
+    uint8 tensor."""
+    with open(path, "rb") as f:
+        data = f.read()
+    return jnp.frombuffer(data, dtype=jnp.uint8)
+
+
+def decode_jpeg(x, mode="unchanged"):
+    """Reference: `paddle.vision.ops.decode_jpeg` (nvjpeg). Decodes via
+    PIL on host; returns CHW uint8."""
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    data = bytes(np.asarray(x).astype(np.uint8).tobytes())
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return jnp.asarray(arr)
+
+
+from .models.yolo import yolo_loss  # noqa: F401,E402

@@ -166,3 +166,276 @@ def hflip(img):
 
 def vflip(img):
     return np.asarray(img)[::-1].copy()
+
+
+# ---- color / geometry functionals (reference: transforms/functional.py;
+# numpy implementations of the PIL/cv2 backends)
+
+def _as_float(img):
+    arr = np.asarray(img)
+    if arr.dtype == np.uint8:
+        return arr.astype(np.float32), True
+    return arr.astype(np.float32), False
+
+
+def _restore(arr, was_uint8):
+    if was_uint8:
+        return np.clip(arr, 0, 255).astype(np.uint8)
+    return arr
+
+
+def adjust_brightness(img, brightness_factor):
+    """out = img * factor (reference: functional.adjust_brightness)."""
+    arr, u8 = _as_float(img)
+    return _restore(arr * brightness_factor, u8)
+
+
+def adjust_contrast(img, contrast_factor):
+    """Blend with the image's grayscale mean."""
+    arr, u8 = _as_float(img)
+    gray_mean = to_grayscale(arr).mean()
+    return _restore(arr * contrast_factor
+                    + gray_mean * (1.0 - contrast_factor), u8)
+
+
+def adjust_saturation(img, saturation_factor):
+    """Blend with the per-pixel grayscale. Grayscale input (2-D or one
+    channel) has no saturation — returned unchanged."""
+    arr, u8 = _as_float(img)
+    if arr.ndim == 2 or arr.shape[-1] == 1:
+        return _restore(arr, u8)
+    gray = to_grayscale(arr)
+    return _restore(arr * saturation_factor
+                    + gray * (1.0 - saturation_factor), u8)
+
+
+def adjust_hue(img, hue_factor):
+    """Rotate hue in HSV space by hue_factor (in [-0.5, 0.5] turns)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError(f"hue_factor must be in [-0.5, 0.5], got "
+                         f"{hue_factor}")
+    arr, u8 = _as_float(img)
+    if arr.ndim == 2 or arr.shape[-1] == 1:   # gray: hue-invariant
+        return _restore(arr, u8)
+    scale = 255.0 if u8 else 1.0
+    x = arr / scale
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    maxc = x.max(-1)
+    minc = x.min(-1)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0.0)
+    dz = np.maximum(delta, 1e-12)
+    h = np.where(maxc == r, (g - b) / dz % 6,
+                 np.where(maxc == g, (b - r) / dz + 2, (r - g) / dz + 4))
+    h = (h / 6.0 + hue_factor) % 1.0
+    # hsv -> rgb
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    pq = v * (1.0 - s)
+    qq = v * (1.0 - s * f)
+    tq = v * (1.0 - s * (1.0 - f))
+    i = (i.astype(np.int32) % 6)[..., None]
+    out = np.select(
+        [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+        [np.stack([v, tq, pq], -1), np.stack([qq, v, pq], -1),
+         np.stack([pq, v, tq], -1), np.stack([pq, qq, v], -1),
+         np.stack([tq, pq, v], -1), np.stack([v, pq, qq], -1)])
+    return _restore(out * scale, u8)
+
+
+def to_grayscale(img, num_output_channels=1):
+    """ITU-R 601-2 luma (reference: functional.to_grayscale)."""
+    arr, u8 = _as_float(img)
+    if arr.ndim == 2:
+        gray = arr
+    else:
+        gray = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 \
+            + arr[..., 2] * 0.114
+    gray = gray[..., None]
+    if num_output_channels == 3:
+        gray = np.repeat(gray, 3, axis=-1)
+    return _restore(gray, u8)
+
+
+def crop(img, top, left, height, width):
+    return np.asarray(img)[top:top + height, left:left + width].copy()
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = np.asarray(img)
+    if isinstance(padding, int):
+        pl = pr = pt = pb = padding
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    width = [(pt, pb), (pl, pr)] + [(0, 0)] * (arr.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(arr, width, constant_values=fill)
+    return np.pad(arr, width, mode={"edge": "edge", "reflect": "reflect",
+                                    "symmetric": "symmetric"}[padding_mode])
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate counter-clockwise by `angle` degrees (nearest-neighbor
+    inverse mapping; no scipy/PIL dependency)."""
+    arr = np.asarray(img)
+    h, w = arr.shape[:2]
+    rad = np.deg2rad(angle)
+    cos_a, sin_a = np.cos(rad), np.sin(rad)
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    if expand:
+        nh = int(abs(h * cos_a) + abs(w * sin_a) + 0.5)
+        nw = int(abs(w * cos_a) + abs(h * sin_a) + 0.5)
+    else:
+        nh, nw = h, w
+    ncy, ncx = (nh - 1) / 2.0, (nw - 1) / 2.0
+    yy, xx = np.meshgrid(np.arange(nh), np.arange(nw), indexing="ij")
+    # inverse rotation dest -> source; sin signs flipped because image
+    # y grows downward (visual counter-clockwise, like PIL/rot90)
+    sy = (yy - ncy) * cos_a + (xx - ncx) * sin_a + cy
+    sx = -(yy - ncy) * sin_a + (xx - ncx) * cos_a + cx
+    syi = np.round(sy).astype(np.int64)
+    sxi = np.round(sx).astype(np.int64)
+    valid = (syi >= 0) & (syi < h) & (sxi >= 0) & (sxi < w)
+    out_shape = (nh, nw) + arr.shape[2:]
+    out = np.full(out_shape, fill, dtype=arr.dtype)
+    out[valid] = arr[syi[valid], sxi[valid]]
+    return out
+
+
+# ---- transform classes
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value):
+        if value < 0:
+            raise ValueError("brightness value must be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value):
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value):
+        if value < 0:
+            raise ValueError("saturation value must be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """Randomly ordered brightness/contrast/saturation/hue jitter
+    (reference: transforms.ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = list(self.transforms)
+        random.shuffle(order)
+        for t in order:
+            img = t(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding, self.fill = padding, fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = tuple(degrees)
+        self.expand, self.center, self.fill = expand, center, fill
+
+    def _apply_image(self, img):
+        angle = random.uniform(*self.degrees)
+        return rotate(img, angle, expand=self.expand, center=self.center,
+                      fill=self.fill)
+
+
+class RandomResizedCrop(BaseTransform):
+    """Random area/aspect crop resized to `size` (reference:
+    transforms.RandomResizedCrop, the Inception-style augmentation)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="nearest"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale, self.ratio = scale, ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        import math
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            log_r = (math.log(self.ratio[0]), math.log(self.ratio[1]))
+            ar = math.exp(random.uniform(*log_r))
+            cw = int(round(math.sqrt(target * ar)))
+            ch = int(round(math.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = random.randint(0, h - ch)
+                left = random.randint(0, w - cw)
+                patch = crop(img, top, left, ch, cw)
+                return _resize_np(patch, self.size)
+        # fallback: center crop of the constraining side
+        side = min(h, w)
+        patch = crop(img, (h - side) // 2, (w - side) // 2, side, side)
+        return _resize_np(patch, self.size)

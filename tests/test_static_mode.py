@@ -412,3 +412,59 @@ class TestReviewRegressions:
                          feed={"x": np.full((2, 4), 99.0, np.float32)},
                          fetch_list=[h])
         assert out.max() <= 6.0 + 1e-6
+
+    def test_clone_prunes_label_feed(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 4], "float32")
+            y = paddle.static.data("y", [None, 1], "float32")
+            pred = paddle.static.nn.fc(x, 1)
+            loss = paddle.mean(
+                paddle.nn.functional.square_error_cost(pred, y))
+            paddle.optimizer.SGD(learning_rate=0.01).minimize(loss)
+        test = main.clone(for_test=True)
+        exe = paddle.static.Executor()
+        # no 'y' feed: pruning to the fetch target must allow this
+        (p,) = exe.run(test, feed={"x": np.ones((3, 4), np.float32)},
+                       fetch_list=[pred])
+        assert p.shape == (3, 1)
+
+    def test_gradients_wrt_intermediate(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            a = paddle.static.data("a", [None, 3], "float32")
+            h = a * a
+            loss = paddle.mean(h)
+            (gname,) = paddle.static.gradients(loss, h)
+        exe = paddle.static.Executor()
+        A = np.asarray([[1.0, 2.0, 3.0]], np.float32)
+        (g,) = exe.run(main, feed={"a": A}, fetch_list=[gname])
+        np.testing.assert_allclose(g, np.full((1, 3), 1 / 3), rtol=1e-5)
+
+    def test_grad_targets_with_minimize(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 2], "float32")
+            loss = paddle.mean(x * x)
+            (gname,) = paddle.static.gradients(loss, x)
+            pred = paddle.static.nn.fc(x, 1, bias_attr=False)
+            loss2 = paddle.mean(pred * pred)
+            paddle.optimizer.SGD(learning_rate=0.0).minimize(loss2)
+        exe = paddle.static.Executor()
+        X = np.asarray([[1.0, 3.0]], np.float32)
+        lv, g = exe.run(main, feed={"x": X}, fetch_list=[loss2, gname])
+        np.testing.assert_allclose(g, X, rtol=1e-5)  # d/dx mean(x^2)=x/1
+
+    def test_clone_isolated_from_original(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 2], "float32")
+            out = paddle.mean(x)
+        clone = main.clone()
+        with paddle.static.program_guard(clone, startup):
+            paddle.static.data("z", [None, 2], "float32")
+        assert len(main._data_vars) == 1  # original untouched
+        exe = paddle.static.Executor()
+        (r,) = exe.run(main, feed={"x": np.ones((2, 2), np.float32)},
+                       fetch_list=[out])
+        assert abs(float(r) - 1.0) < 1e-6

@@ -1,0 +1,131 @@
+"""Vision additions: deform_conv2d op/layer, image io, color/geometry
+transforms (reference: paddle.vision.ops / transforms functional)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.vision.transforms as T
+from paddle_tpu.vision import ops as V
+
+
+class TestDeformConv:
+    def test_zero_offset_matches_plain_conv(self):
+        import jax
+        rs = np.random.RandomState(0)
+        x = rs.randn(1, 2, 6, 6).astype(np.float32)
+        w = rs.randn(3, 2, 3, 3).astype(np.float32)
+        off = np.zeros((1, 18, 4, 4), np.float32)
+        got = np.asarray(V.deform_conv2d(x, off, w))
+        want = np.asarray(jax.lax.conv_general_dilated(
+            x, w, (1, 1), "VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW")))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_mask_modulation_scales(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(1, 2, 5, 5).astype(np.float32)
+        w = rs.randn(2, 2, 3, 3).astype(np.float32)
+        off = np.zeros((1, 18, 3, 3), np.float32)
+        full = np.ones((1, 9, 3, 3), np.float32)
+        got_full = np.asarray(V.deform_conv2d(x, off, w, mask=full))
+        got_half = np.asarray(V.deform_conv2d(x, off, w, mask=full * 0.5))
+        np.testing.assert_allclose(got_half, got_full * 0.5, rtol=1e-4)
+
+    def test_layer_form(self):
+        layer = V.DeformConv2D(2, 4, 3, padding=1)
+        x = pt.to_tensor(np.random.RandomState(2)
+                         .randn(1, 2, 6, 6).astype(np.float32))
+        off = pt.to_tensor(np.zeros((1, 18, 6, 6), np.float32))
+        out = layer(x, off)
+        assert out.shape == (1, 4, 6, 6)
+        assert len(layer.parameters()) == 2
+
+
+class TestImageIO:
+    def test_read_and_decode_jpeg(self, tmp_path):
+        from PIL import Image
+        # smooth gradient: JPEG on noise is arbitrarily lossy
+        g = np.linspace(0, 255, 12, dtype=np.uint8)
+        arr = np.stack([np.tile(g, (10, 1))] * 3, axis=-1)
+        p = tmp_path / "img.jpg"
+        Image.fromarray(arr).save(p, quality=95)
+        raw = V.read_file(str(p))
+        assert raw.dtype == np.uint8 and raw.ndim == 1
+        img = V.decode_jpeg(raw)
+        assert img.shape == (3, 10, 12)
+        # lossy, but close
+        assert np.abs(np.asarray(img).astype(int).transpose(1, 2, 0)
+                      - arr.astype(int)).mean() < 16
+
+
+class TestTransforms:
+    def setup_method(self, m):
+        self.img = (np.random.RandomState(0).rand(16, 16, 3) * 255) \
+            .astype(np.uint8)
+
+    def test_identity_factors(self):
+        np.testing.assert_array_equal(T.adjust_brightness(self.img, 1.0),
+                                      self.img)
+        np.testing.assert_allclose(
+            np.asarray(T.adjust_contrast(self.img, 1.0), np.float32),
+            self.img, atol=1.0)
+        f = self.img.astype(np.float32) / 255
+        np.testing.assert_allclose(T.adjust_hue(f, 0.0), f, atol=0.02)
+
+    def test_brightness_scales(self):
+        out = T.adjust_brightness(self.img.astype(np.float32), 2.0)
+        np.testing.assert_allclose(out, self.img * 2.0, rtol=1e-5)
+
+    def test_grayscale(self):
+        g = T.to_grayscale(self.img)
+        assert g.shape == (16, 16, 1)
+        g3 = T.to_grayscale(self.img, 3)
+        assert g3.shape == (16, 16, 3)
+        np.testing.assert_array_equal(g3[..., 0], g3[..., 1])
+
+    def test_pad_crop_rotate(self):
+        assert T.pad(self.img, 2).shape == (20, 20, 3)
+        assert T.pad(self.img, (1, 2)).shape == (20, 18, 3)
+        assert T.crop(self.img, 2, 3, 5, 6).shape == (5, 6, 3)
+        np.testing.assert_array_equal(T.rotate(self.img, 90),
+                                      np.rot90(self.img))
+        np.testing.assert_array_equal(T.rotate(self.img, 180),
+                                      self.img[::-1, ::-1])
+        assert T.rotate(self.img, 45, expand=True).shape[0] > 16
+
+    def test_class_transforms_shapes(self):
+        assert T.ColorJitter(0.4, 0.4, 0.4, 0.2)(self.img).shape \
+            == self.img.shape
+        assert T.Grayscale()(self.img).shape == (16, 16, 1)
+        assert T.Pad(3)(self.img).shape == (22, 22, 3)
+        assert T.RandomRotation(25)(self.img).shape == self.img.shape
+        assert T.RandomResizedCrop(8)(self.img).shape == (8, 8, 3)
+
+    def test_hue_rotation_changes_channels(self):
+        f = self.img.astype(np.float32) / 255
+        out = T.adjust_hue(f, 0.25)
+        assert not np.allclose(out, f, atol=0.05)
+        # hue rotation preserves value (max channel)
+        np.testing.assert_allclose(out.max(-1), f.max(-1), atol=0.02)
+
+
+def test_autograd_backward_contract():
+    with pytest.raises(RuntimeError, match="functional"):
+        pt.autograd.backward([pt.to_tensor([1.0])])
+
+
+class TestReviewRegressions:
+    def test_deform_layer_isinstance(self):
+        layer = V.DeformConv2D(2, 3, 3)
+        assert isinstance(layer, V.DeformConv2D)
+
+    def test_negative_jitter_rejected(self):
+        for cls in (T.BrightnessTransform, T.ContrastTransform,
+                    T.SaturationTransform):
+            with pytest.raises(ValueError):
+                cls(-0.5)
+
+    def test_grayscale_saturation_passthrough(self):
+        gray = np.full((4, 4), 7, np.uint8)
+        np.testing.assert_array_equal(T.adjust_saturation(gray, 0.3), gray)
+        np.testing.assert_array_equal(T.adjust_hue(gray, 0.3), gray)
