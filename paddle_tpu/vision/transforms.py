@@ -281,7 +281,13 @@ def pad(img, padding, fill=0, padding_mode="constant"):
 def rotate(img, angle, interpolation="nearest", expand=False, center=None,
            fill=0):
     """Rotate counter-clockwise by `angle` degrees (nearest-neighbor
-    inverse mapping; no scipy/PIL dependency)."""
+    inverse mapping; no scipy/PIL dependency). `fill` may be a scalar or
+    a per-channel sequence."""
+    if interpolation not in (None, "nearest"):
+        import warnings
+        warnings.warn(f"rotate: interpolation={interpolation!r} not "
+                      "implemented; using nearest", UserWarning,
+                      stacklevel=2)
     arr = np.asarray(img)
     h, w = arr.shape[:2]
     rad = np.deg2rad(angle)
@@ -303,7 +309,8 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
     sxi = np.round(sx).astype(np.int64)
     valid = (syi >= 0) & (syi < h) & (sxi >= 0) & (sxi < w)
     out_shape = (nh, nw) + arr.shape[2:]
-    out = np.full(out_shape, fill, dtype=arr.dtype)
+    out = np.empty(out_shape, dtype=arr.dtype)
+    out[...] = fill          # broadcasts scalar or per-channel sequence
     out[valid] = arr[syi[valid], sxi[valid]]
     return out
 
@@ -402,11 +409,13 @@ class RandomRotation(BaseTransform):
         if isinstance(degrees, numbers.Number):
             degrees = (-abs(degrees), abs(degrees))
         self.degrees = tuple(degrees)
+        self.interpolation = interpolation
         self.expand, self.center, self.fill = expand, center, fill
 
     def _apply_image(self, img):
         angle = random.uniform(*self.degrees)
-        return rotate(img, angle, expand=self.expand, center=self.center,
+        return rotate(img, angle, interpolation=self.interpolation,
+                      expand=self.expand, center=self.center,
                       fill=self.fill)
 
 

@@ -28,3 +28,28 @@ def test_fit_a_line_static_example():
     import fit_a_line_static
     loss = fit_a_line_static.main(epochs=10)
     assert loss < 60.0  # UCI housing MSE after a few epochs
+
+
+def test_image_classification_example():
+    import image_classification
+    a0, a1 = image_classification.main(epochs=3, limit=256)
+    assert a1 > a0
+
+
+def test_understand_sentiment_example():
+    import understand_sentiment
+    l0, l1 = understand_sentiment.main(steps=30)
+    assert l1 < l0
+
+
+def test_machine_translation_example():
+    import machine_translation
+    l0, l1, seqs = machine_translation.main(steps=40)
+    assert l1 < l0
+    assert seqs.ndim == 3  # [B, K, T] beam output
+
+
+def test_recommender_system_example():
+    import recommender_system
+    l0, l1 = recommender_system.main(steps=60)
+    assert l1 < l0

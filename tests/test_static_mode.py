@@ -468,3 +468,39 @@ class TestReviewRegressions:
         (r,) = exe.run(main, feed={"x": np.ones((2, 2), np.float32)},
                        fetch_list=[out])
         assert abs(float(r) - 1.0) < 1e-6
+
+    def test_gradients_wrt_parameter(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 3], "float32")
+            pred = paddle.static.nn.fc(x, 1, bias_attr=False)
+            loss = paddle.mean(pred)
+            w = main.all_parameters()[0]
+            (gname,) = paddle.static.gradients(loss, [w])
+        exe = paddle.static.Executor()
+        X = np.ones((6, 3), np.float32)
+        (g,) = exe.run(main, feed={"x": X}, fetch_list=[gname])
+        np.testing.assert_allclose(g, np.full((3, 1), 1.0), rtol=1e-5)
+
+    def test_clone_for_test_strips_backward(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 4], "float32")
+            y = paddle.static.data("y", [None, 1], "float32")
+            pred = paddle.static.nn.fc(x, 1)
+            loss = paddle.mean(
+                paddle.nn.functional.square_error_cost(pred, y))
+            paddle.static.append_backward(loss)
+        test = main.clone(for_test=True)
+        assert test._grad_targets == []
+        exe = paddle.static.Executor()
+        # pruned: no y feed needed even though append_backward was called
+        (p,) = exe.run(test, feed={"x": np.ones((2, 4), np.float32)},
+                       fetch_list=[pred])
+        assert p.shape == (2, 1)
+
+    def test_rotation_sequence_fill(self, static_mode):
+        import paddle_tpu.vision.transforms as T
+        img = np.zeros((8, 8, 3), np.uint8)
+        out = T.rotate(img, 45, fill=(255, 0, 9))
+        assert (out[0, 0] == [255, 0, 9]).all()
