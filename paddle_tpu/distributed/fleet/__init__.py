@@ -17,3 +17,57 @@ from . import elastic  # noqa: F401
 from . import utils  # noqa: F401
 from .dataset import (DatasetBase, InMemoryDataset,  # noqa: F401
                       QueueDataset, train_from_dataset)
+from ..topology import CommunicateTopology  # noqa: F401
+from .data_generator import (  # noqa: F401
+    DataGenerator,
+    MultiSlotDataGenerator,
+    MultiSlotStringDataGenerator,
+)
+from .role_maker import (  # noqa: F401
+    PaddleCloudRoleMaker,
+    Role,
+    UserDefinedRoleMaker,
+)
+
+
+class UtilBase:
+    """Reference: fleet/base/util_factory.py UtilBase — cross-rank helper
+    ops (all_reduce/barrier over the CPU rendezvous) + filesystem hooks.
+    Here collectives ride `distributed.collective` (jax.distributed CPU
+    backend, the Gloo replacement) and fs is the fleet FS abstraction."""
+
+    def __init__(self):
+        from .utils.fs import LocalFS
+        self.fs_client = LocalFS()
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import jax.numpy as jnp
+        import numpy as np
+        from .. import collective
+        ops = {"sum": collective.ReduceOp.SUM,
+               "max": collective.ReduceOp.MAX,
+               "min": collective.ReduceOp.MIN}
+        if mode not in ops:
+            raise ValueError(f"all_reduce mode must be one of {set(ops)},"
+                             f" got {mode!r}")
+        out = collective.all_reduce(jnp.asarray(input), op=ops[mode])
+        return np.asarray(out)
+
+    def barrier(self, comm_world="worker"):
+        from .. import collective
+        collective.barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        """Returns a list with one entry per rank (eager collectives are
+        identity in a one-process world — see distributed/collective.py;
+        inside compiled steps use collective.all_gather directly)."""
+        import numpy as np
+        from .fleet_base import worker_num
+        return [np.asarray(input)] * max(worker_num(), 1)
+
+    def get_file_shard(self, files):
+        """Shard a file list over workers (reference: util_factory
+        get_file_shard)."""
+        from .fleet_base import worker_index, worker_num
+        n, i = worker_num(), worker_index()
+        return [f for j, f in enumerate(files) if j % n == i]

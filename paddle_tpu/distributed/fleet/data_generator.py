@@ -1,0 +1,78 @@
+"""Data generators for the fleet dataset pipeline.
+
+Reference: `python/paddle/distributed/fleet/data_generator/
+data_generator.py` — user subclasses override `generate_sample`; the
+base class renders samples into the slot line format the DataFeed parser
+consumes (`name:count id id ...` per slot). The native C++ parser here is
+`csrc` `ptpu_feed_*` (see `distributed/fleet/dataset.py`).
+"""
+from __future__ import annotations
+
+import sys
+
+
+class DataGenerator:
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        """Override: return a no-arg iterator yielding
+        [(slot_name, [values...]), ...] per sample."""
+        raise NotImplementedError(
+            "subclass DataGenerator and implement generate_sample")
+
+    def generate_batch(self, samples):
+        """Override for batch-level transforms (shuffle/pad): receives
+        the accumulated samples of one batch, returns a no-arg iterator
+        over (possibly rewritten) samples. Default: pass-through."""
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    def _gen_str(self, line):
+        raise NotImplementedError
+
+    def run_from_stdin(self):
+        for out in self._batched(
+                item for line in sys.stdin
+                for item in self.generate_sample(line)()):
+            sys.stdout.write(out)
+
+    def run_from_memory(self):
+        return list(self._batched(self.generate_sample(None)()))
+
+    def _batched(self, sample_iter):
+        """Group samples into batches of `batch_size_`, route each group
+        through generate_batch (the reference pipeline), format lines."""
+        buf = []
+        for item in sample_iter:
+            buf.append(item)
+            if len(buf) == self.batch_size_:
+                for s in self.generate_batch(buf)():
+                    yield self._gen_str(s)
+                buf = []
+        if buf:
+            for s in self.generate_batch(buf)():
+                yield self._gen_str(s)
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Reference: MultiSlotDataGenerator._gen_str — slot lines
+    `count v1 v2 ... count v1 ...` with a fixed slot order."""
+
+    def _gen_str(self, item):
+        parts = []
+        for _name, values in item:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """Reference: MultiSlotStringDataGenerator — values pass through as
+    strings (ids already tokenized upstream); same line format."""

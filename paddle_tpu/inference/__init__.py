@@ -174,3 +174,50 @@ class PredictorPool:
 def create_predictor(config: Config) -> Predictor:
     """Reference: CreatePaddlePredictor (`analysis_predictor.cc:1183`)."""
     return Predictor(config)
+
+
+class DataType:
+    """Reference: paddle_infer.DataType enum (inference/api/paddle_api.h)."""
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+
+
+class PlaceType:
+    """Reference: paddle_infer.PlaceType — kCPU/kGPU/kXPU; TPU is the
+    accelerator here."""
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    TPU = 3
+
+
+class PrecisionType:
+    """Reference: AnalysisConfig::Precision."""
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+_NUM_BYTES = {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+              DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+              DataType.BFLOAT16: 2}
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    """Reference: paddle_infer.get_num_bytes_of_data_type."""
+    if dtype not in _NUM_BYTES:
+        raise ValueError(f"unknown inference DataType {dtype!r}")
+    return _NUM_BYTES[dtype]
+
+
+def get_version() -> str:
+    """Reference: paddle_infer.get_version."""
+    from .. import __version__
+    import jax
+    return f"paddle_tpu {__version__} (jax {jax.__version__})"

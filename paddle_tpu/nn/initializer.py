@@ -170,3 +170,42 @@ class Dirac(Initializer):
 constant_ = Constant
 normal_ = Normal
 uniform_ = Uniform
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convs (reference:
+    fluid/initializer.py BilinearInitializer): weight[..., i, j] is the
+    bilinear interpolation hat function, so a stride-s Conv2DTranspose
+    initialized with it performs bilinear upsampling."""
+
+    def __call__(self, shape, dtype=None):
+        shape = tuple(shape)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D conv "
+                             f"kernel shape, got {shape}")
+        kh, kw = shape[2], shape[3]
+        f_h = math.ceil(kh / 2.0)
+        c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        f_w = math.ceil(kw / 2.0)
+        c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        og, ig = np.ogrid[:kh, :kw]
+        filt = (1 - abs(og / f_h - c_h)) * (1 - abs(ig / f_w - c_w))
+        w = np.zeros(shape, np.float32)
+        for i in range(shape[0]):
+            for j in range(shape[1]):
+                w[i, j] = filt
+        return jnp.asarray(w, dtype=convert_dtype(dtype)
+                           or get_default_dtype())
+
+
+_global_initializer = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Reference: `paddle.nn.initializer.set_global_initializer`
+    (fluid/initializer.py): override the default initializers used by
+    `Layer.create_parameter` when a layer specifies none. Pass None to
+    reset."""
+    global _global_initializer
+    _global_initializer = (weight_init, bias_init) \
+        if weight_init is not None else None

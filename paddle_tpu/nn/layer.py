@@ -160,8 +160,13 @@ class Layer:
         if isinstance(attr, ParamAttr) and attr.initializer is not None:
             default_initializer = attr.initializer
         if default_initializer is None:
-            default_initializer = I.Constant(0.0) if is_bias \
-                else I.XavierUniform()
+            glob = I._global_initializer   # set_global_initializer hook
+            if glob is not None and (glob[1] if is_bias else glob[0]) \
+                    is not None:
+                default_initializer = glob[1] if is_bias else glob[0]
+            else:
+                default_initializer = I.Constant(0.0) if is_bias \
+                    else I.XavierUniform()
         value = default_initializer(tuple(int(s) for s in shape), dtype)
         param = Parameter(value, name=_unique_name(self._full_name + ".w"))
         if isinstance(attr, ParamAttr):

@@ -79,14 +79,19 @@ def _replay(program: Program, op_indices, fetch_vars, train: bool):
         pass for that loss name (the train step already computed it)."""
         grad_vals = {}
         for loss_v, wrt in grad_targets:
-            wants_params = wrt is None or any(
-                not isinstance(w, Variable) for w in wrt)
-            if wants_params and loss_v.name != skip_param_loss:
+            param_wrt = None if wrt is None else {
+                w.name for w in wrt if not isinstance(w, Variable)}
+            if (wrt is None or param_wrt) \
+                    and loss_v.name != skip_param_loss:
                 def loss_fn(p):
                     e, _ = forward(feed_vals, p, buffers)
                     return e[loss_v.name]
                 for name, g in jax.grad(loss_fn)(params).items():
-                    grad_vals[name + "@GRAD"] = g
+                    # wrt=None (append_backward) registers every param;
+                    # explicit Parameter targets store only their own
+                    # grads so other losses' entries aren't clobbered
+                    if param_wrt is None or name in param_wrt:
+                        grad_vals[name + "@GRAD"] = g
             if wrt is None:
                 continue
             data_wrt = [w for w in wrt

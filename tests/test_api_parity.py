@@ -147,3 +147,66 @@ class TestMiscShims:
         assert pt.reshape_(x, [2, 1]).shape == (2, 1)
         np.testing.assert_allclose(np.asarray(pt.tanh_(x)),
                                    np.tanh([[1.0, 2.0]]), rtol=1e-6)
+
+
+class TestDeepNamespaceParity:
+    """Sub-namespace gap closures (round 3): fleet role makers / data
+    generators / UtilBase, Bilinear initializer + global initializer,
+    inference enums."""
+
+    def test_fleet_surface(self):
+        import paddle_tpu as pt
+        rm = pt.distributed.fleet.PaddleCloudRoleMaker()
+        assert rm.is_worker() and rm.is_first_worker()
+        u = pt.distributed.fleet.UserDefinedRoleMaker(
+            role=pt.distributed.fleet.Role.SERVER, current_id=1,
+            server_endpoints=["127.0.0.1:1", "127.0.0.1:2"])
+        assert u.is_server() and u.server_num() == 2
+
+    def test_data_generator_slot_format(self):
+        import paddle_tpu as pt
+
+        class Gen(pt.distributed.fleet.MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def it():
+                    yield [("ids", [4, 5]), ("label", [1])]
+                return it
+
+        assert Gen().run_from_memory() == ["2 4 5 1 1\n"]
+
+    def test_util_base_single_proc(self):
+        import numpy as np
+        import paddle_tpu as pt
+        util = pt.distributed.fleet.UtilBase()
+        util.barrier()
+        files = [f"f{i}" for i in range(5)]
+        assert util.get_file_shard(files) == files  # world size 1
+
+    def test_bilinear_initializer_partition(self):
+        import numpy as np
+        import paddle_tpu as pt
+        w = np.asarray(pt.nn.initializer.Bilinear()((2, 1, 4, 4)))
+        # hat filter sums to stride^2 per output channel
+        assert abs(w[0, 0].sum() - 4.0) < 1e-4
+        np.testing.assert_allclose(w[0, 0], w[1, 0])
+
+    def test_set_global_initializer(self):
+        import numpy as np
+        import paddle_tpu as pt
+        pt.nn.initializer.set_global_initializer(
+            pt.nn.initializer.Constant(2.5),
+            pt.nn.initializer.Constant(0.5))
+        try:
+            lin = pt.nn.Linear(3, 2)
+            assert np.allclose(np.asarray(lin.weight.value), 2.5)
+            assert np.allclose(np.asarray(lin.bias.value), 0.5)
+        finally:
+            pt.nn.initializer.set_global_initializer(None)
+
+    def test_inference_enums(self):
+        import paddle_tpu as pt
+        assert pt.inference.get_num_bytes_of_data_type(
+            pt.inference.DataType.FLOAT32) == 4
+        assert pt.inference.get_num_bytes_of_data_type(
+            pt.inference.DataType.BFLOAT16) == 2
+        assert "paddle_tpu" in pt.inference.get_version()
