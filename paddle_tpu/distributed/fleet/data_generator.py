@@ -3,7 +3,8 @@
 Reference: `python/paddle/distributed/fleet/data_generator/
 data_generator.py` — user subclasses override `generate_sample`; the
 base class renders samples into the slot line format the DataFeed parser
-consumes (`name:count id id ...` per slot). The native C++ parser here is
+consumes (`count v1 v2 ...` per slot, slots in declaration order —
+the plain-numeric layout the native C++ parser hot path reads). The native C++ parser here is
 `csrc` `ptpu_feed_*` (see `distributed/fleet/dataset.py`).
 """
 from __future__ import annotations

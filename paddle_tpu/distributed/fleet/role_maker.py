@@ -84,7 +84,12 @@ class PaddleCloudRoleMaker(RoleMakerBase):
         training_role = env.get("TRAINING_ROLE", "TRAINER")
         if training_role == "PSERVER":
             self._role = Role.SERVER
-            self._current_id = int(env.get("PADDLE_PSERVER_ID", "0"))
+            # reference contract: derive the server index from
+            # POD_IP:PADDLE_PORT against the pserver endpoint list
+            cur = (f"{env.get('POD_IP', '127.0.0.1')}:"
+                   f"{env.get('PADDLE_PORT', '')}")
+            self._current_id = self._server_endpoints.index(cur) \
+                if cur in self._server_endpoints else 0
         elif training_role == "HETER_TRAINER":
             self._role = Role.HETER_WORKER
             self._current_id = int(env.get("PADDLE_TRAINER_ID", "0"))

@@ -24,6 +24,6 @@ class DistributedInfer:
 
     def get_dist_infer_program(self):
         if self._main is None:
-            from ...static import default_main_program
+            from ....static import default_main_program
             return default_main_program()
         return self._main

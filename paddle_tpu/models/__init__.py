@@ -29,3 +29,4 @@ from .transformer import (  # noqa: F401
     TransformerModel,
     sinusoid_position_encoding,
 )
+from .ctr import DeepFM, WideDeep, build_ctr_train_step  # noqa: F401

@@ -1,0 +1,127 @@
+"""CTR model family: Wide&Deep and DeepFM.
+
+Reference workload class: the recsys/CTR models the reference's
+PS + fleet-dataset stack exists for (`data_set.h` LoadIntoMemory +
+DeviceWorker trainers; model shapes per the public wide_deep/deepfm
+configs in PaddleRec-style CTR benchmarks the fleet tests drive).
+
+TPU-first shape: sparse fields are fixed-count id slots [B, F] looked up
+in ONE embedding table gather (padded vocab, MXU-friendly dims), dense
+features ride alongside; everything fuses into a single jitted step.
+For the billion-row vocab regime the same forward runs against the PS
+sharded table (`distributed/ps/table.py`) with pulled rows.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer
+from ..nn.layer_common import Embedding, Linear
+
+
+class _MLP(Layer):
+    def __init__(self, dims: Sequence[int]):
+        super().__init__()
+        from ..nn.layer_common import LayerList
+        self.fcs = LayerList([Linear(dims[i], dims[i + 1])
+                              for i in range(len(dims) - 1)])
+
+    def forward(self, x):
+        for i, fc in enumerate(self.fcs):
+            x = fc(x)
+            if i < len(self.fcs) - 1:
+                x = F.relu(x)
+        return x
+
+
+class WideDeep(Layer):
+    """Wide & Deep (Cheng et al. 2016): a linear 'wide' path over the
+    sparse ids + an MLP 'deep' path over field embeddings."""
+
+    def __init__(self, sparse_vocab: int, num_fields: int,
+                 dense_dim: int = 13, embed_dim: int = 16,
+                 hidden: Sequence[int] = (128, 64)):
+        super().__init__()
+        self.embedding = Embedding(sparse_vocab, embed_dim,
+                                   weight_attr=I.Normal(0.0, 0.01))
+        self.wide = Embedding(sparse_vocab, 1,
+                              weight_attr=I.Normal(0.0, 0.01))
+        self.dense_wide = Linear(dense_dim, 1)
+        dims = [num_fields * embed_dim + dense_dim, *hidden, 1]
+        self.deep = _MLP(dims)
+
+    def forward(self, sparse_ids, dense):
+        emb = self.embedding(sparse_ids)            # [B, F, E]
+        deep_in = jnp.concatenate(
+            [emb.reshape(emb.shape[0], -1), dense], axis=-1)
+        deep_out = self.deep(deep_in)               # [B, 1]
+        wide_out = jnp.sum(self.wide(sparse_ids), axis=1) \
+            + self.dense_wide(dense)                # [B, 1]
+        return (deep_out + wide_out)[:, 0]          # logits [B]
+
+
+class DeepFM(Layer):
+    """DeepFM (Guo et al. 2017): first-order linear + pairwise FM
+    interactions + deep MLP, sharing one embedding table."""
+
+    def __init__(self, sparse_vocab: int, num_fields: int,
+                 dense_dim: int = 13, embed_dim: int = 16,
+                 hidden: Sequence[int] = (128, 64)):
+        super().__init__()
+        self.embedding = Embedding(sparse_vocab, embed_dim,
+                                   weight_attr=I.Normal(0.0, 0.01))
+        self.first_order = Embedding(sparse_vocab, 1,
+                                     weight_attr=I.Normal(0.0, 0.01))
+        self.dense_linear = Linear(dense_dim, 1)
+        dims = [num_fields * embed_dim + dense_dim, *hidden, 1]
+        self.deep = _MLP(dims)
+
+    def forward(self, sparse_ids, dense):
+        emb = self.embedding(sparse_ids)            # [B, F, E]
+        # FM second order: 0.5 * ((Σv)² − Σv²) summed over E
+        s = jnp.sum(emb, axis=1)
+        fm = 0.5 * jnp.sum(s * s - jnp.sum(emb * emb, axis=1), axis=-1,
+                           keepdims=True)
+        first = jnp.sum(self.first_order(sparse_ids), axis=1) \
+            + self.dense_linear(dense)
+        deep_in = jnp.concatenate(
+            [emb.reshape(emb.shape[0], -1), dense], axis=-1)
+        deep = self.deep(deep_in)
+        return (first + fm + deep)[:, 0]            # logits [B]
+
+
+def build_ctr_train_step(model: Layer, optimizer, donate: bool = True):
+    """One jitted CTR step: (state, (ids, dense, labels)) ->
+    (state, (loss, auc_proxy)). Loss = sigmoid BCE with logits."""
+    import functools
+
+    from ..nn.layer import functional_call, trainable_state
+
+    params = trainable_state(model)
+    opt_state = optimizer.init_state(params)
+
+    def loss_fn(p, ids, dense, labels):
+        logits, _ = functional_call(model, p, ids, dense)
+        labels = labels.astype(logits.dtype)
+        loss = jnp.mean(
+            jnp.maximum(logits, 0) - logits * labels
+            + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+        return loss, logits
+
+    deco = jax.jit if not donate else functools.partial(
+        jax.jit, donate_argnums=(0,))
+
+    @deco
+    def step(state, ids, dense, labels):
+        p, s = state
+        (loss, logits), g = jax.value_and_grad(loss_fn, has_aux=True)(
+            p, ids, dense, labels)
+        new_p, new_s = optimizer.apply(p, g, s)
+        return (new_p, new_s), (loss, logits)
+
+    return step, (params, opt_state)
