@@ -85,11 +85,24 @@ class PaddleCloudRoleMaker(RoleMakerBase):
         if training_role == "PSERVER":
             self._role = Role.SERVER
             # reference contract: derive the server index from
-            # POD_IP:PADDLE_PORT against the pserver endpoint list
-            cur = (f"{env.get('POD_IP', '127.0.0.1')}:"
-                   f"{env.get('PADDLE_PORT', '')}")
-            self._current_id = self._server_endpoints.index(cur) \
-                if cur in self._server_endpoints else 0
+            # POD_IP:PADDLE_PORT against the pserver endpoint list;
+            # PADDLE_PSERVER_ID (this repo's launcher contract) wins
+            # when set explicitly
+            if "PADDLE_PSERVER_ID" in env:
+                self._current_id = int(env["PADDLE_PSERVER_ID"])
+            else:
+                cur = (f"{env.get('POD_IP', '')}:"
+                       f"{env.get('PADDLE_PORT', '')}")
+                if cur in self._server_endpoints:
+                    self._current_id = self._server_endpoints.index(cur)
+                elif len(self._server_endpoints) <= 1:
+                    self._current_id = 0
+                else:
+                    raise ValueError(
+                        f"cannot locate this server ({cur!r}) in "
+                        f"PADDLE_PSERVERS_IP_PORT_LIST="
+                        f"{self._server_endpoints}; set POD_IP/"
+                        "PADDLE_PORT or PADDLE_PSERVER_ID")
         elif training_role == "HETER_TRAINER":
             self._role = Role.HETER_WORKER
             self._current_id = int(env.get("PADDLE_TRAINER_ID", "0"))

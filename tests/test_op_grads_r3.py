@@ -1,0 +1,102 @@
+"""Gradient checks for the round-3 functional additions, via the
+OpTest-style harness (numeric vs analytic + eager-vs-jit cross-check —
+SURVEY §4 'OpTest' row).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import ops as V
+
+from op_test import check_eager_vs_jit, check_grad
+
+
+class TestNewOpGrads:
+    def test_grid_sample_grads(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(1, 2, 5, 5).astype(np.float32)
+        grid = rs.uniform(-0.8, 0.8, (1, 3, 3, 2)).astype(np.float32)
+
+        def fn(x, grid):
+            return F.grid_sample(x, grid)
+
+        check_grad(fn, [x, grid], idx=0, rtol=2e-2, atol=2e-3)
+        check_grad(fn, [x, grid], idx=1, rtol=2e-2, atol=2e-3)
+        check_eager_vs_jit(fn, [x, grid])
+
+    def test_deform_conv_grads(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(1, 2, 5, 5).astype(np.float32)
+        w = rs.randn(2, 2, 3, 3).astype(np.float32) * 0.5
+        off = rs.uniform(-0.4, 0.4, (1, 18, 3, 3)).astype(np.float32)
+
+        def fn(x, off, w):
+            return V.deform_conv2d(x, off, w)
+
+        check_grad(fn, [x, off, w], idx=0, rtol=2e-2, atol=2e-3)
+        check_grad(fn, [x, off, w], idx=1, rtol=2e-2, atol=2e-3)
+        check_grad(fn, [x, off, w], idx=2, rtol=2e-2, atol=2e-3)
+        check_eager_vs_jit(fn, [x, off, w])
+
+    def test_temporal_shift_grads(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(4, 8, 3, 3).astype(np.float32)
+
+        def fn(x):
+            return F.temporal_shift(x, seg_num=2, shift_ratio=0.25)
+
+        check_grad(fn, [x], rtol=1e-2)
+        check_eager_vs_jit(fn, [x])
+
+    def test_diag_embed_grads(self):
+        rs = np.random.RandomState(3)
+        x = rs.randn(2, 4).astype(np.float32)
+
+        def fn(x):
+            return F.diag_embed(x, offset=1)
+
+        check_grad(fn, [x], rtol=1e-2)
+        check_eager_vs_jit(fn, [x])
+
+    def test_hsigmoid_grads(self):
+        rs = np.random.RandomState(4)
+        x = rs.randn(3, 6).astype(np.float32)
+        w = rs.randn(7, 6).astype(np.float32) * 0.3
+        labels = np.asarray([0, 3, 7])
+
+        def fn(x, w):
+            return F.hsigmoid_loss(x, labels, 8, w)
+
+        check_grad(fn, [x, w], idx=0, rtol=2e-2, atol=2e-3)
+        check_grad(fn, [x, w], idx=1, rtol=2e-2, atol=2e-3)
+
+    def test_dice_npair_grads(self):
+        rs = np.random.RandomState(5)
+        probs = np.abs(rs.randn(4, 3)).astype(np.float32) + 0.1
+        probs = probs / probs.sum(-1, keepdims=True)
+        label = np.asarray([[0], [1], [2], [1]])
+
+        def fn(p):
+            return F.dice_loss(p, label)
+
+        check_grad(fn, [probs], rtol=2e-2, atol=2e-3)
+
+        anchor = rs.randn(4, 6).astype(np.float32)
+        pos = rs.randn(4, 6).astype(np.float32)
+        lab = np.asarray([0, 1, 2, 3])
+
+        def fn2(a, p):
+            return F.npair_loss(a, p, lab)
+
+        check_grad(fn2, [anchor, pos], idx=0, rtol=2e-2, atol=2e-3)
+        check_grad(fn2, [anchor, pos], idx=1, rtol=2e-2, atol=2e-3)
+
+    def test_affine_grid_grads(self):
+        theta = np.asarray([[[1.0, 0.1, 0.0], [0.05, 0.9, 0.1]]],
+                           np.float32)
+
+        def fn(t):
+            return F.affine_grid(t, [1, 1, 4, 4])
+
+        check_grad(fn, [theta], rtol=1e-2)
+        check_eager_vs_jit(fn, [theta])

@@ -16,16 +16,17 @@ import time
 
 import numpy as np
 
-PEAK = {"TPU v5 lite": 197e12, "TPU v5e": 197e12}
+# single source of truth for chip peaks + the float(loss) sync protocol
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))
+from bench import PEAK_FLOPS, peak_flops  # noqa: E402
 
 
 def peak():
     import jax
-    kind = jax.devices()[0].device_kind
-    for k, v in PEAK.items():
-        if kind.lower().startswith(k.lower()):
-            return v
-    return 197e12
+    return peak_flops(jax.devices()[0].device_kind)
 
 
 def timed(step, state, args, steps, warmup):
@@ -121,6 +122,7 @@ def yolo(batch=8, size=320, level="O1", steps=8, warmup=2):
 
 
 def gpt(batch=8, seq=1024, chunks=8, steps=12, warmup=2):
+    """Per-chip tokens/s (batch is per-chip via dp mesh scaling)."""
     import jax
     import jax.numpy as jnp
     import paddle_tpu as pt
@@ -128,7 +130,7 @@ def gpt(batch=8, seq=1024, chunks=8, steps=12, warmup=2):
     from paddle_tpu.models import (GPTForPretraining, build_train_step,
                                    gpt_345m)
 
-    cfg = gpt_345m()
+    cfg = gpt_345m(max_position_embeddings=max(seq, 1024))
     mesh = build_mesh(dp=len(jax.devices()))
     model = GPTForPretraining(cfg)
     opt = pt.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
@@ -143,7 +145,7 @@ def gpt(batch=8, seq=1024, chunks=8, steps=12, warmup=2):
                          jnp.int32)
     dt = timed(lambda s, a: step(s, a), state, ((ids, labels),), steps,
                warmup)
-    toks = batch * seq * steps / dt
+    toks = batch * seq * steps / dt / len(jax.devices())  # per chip
     d, L, V, f = cfg.hidden_size, cfg.num_layers, cfg.vocab_size, \
         cfg.ffn_hidden
     fl = 6.0 * (L * (4 * d * d + 2 * d * f) + V * d) + 12.0 * L * d * seq
