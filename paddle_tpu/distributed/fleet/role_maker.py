@@ -91,7 +91,7 @@ class PaddleCloudRoleMaker(RoleMakerBase):
             if "PADDLE_PSERVER_ID" in env:
                 self._current_id = int(env["PADDLE_PSERVER_ID"])
             else:
-                cur = (f"{env.get('POD_IP', '')}:"
+                cur = (f"{env.get('POD_IP', '127.0.0.1')}:"
                        f"{env.get('PADDLE_PORT', '')}")
                 if cur in self._server_endpoints:
                     self._current_id = self._server_endpoints.index(cur)

@@ -22,4 +22,11 @@ from .yolo import (  # noqa: F401
     yolo_loss,
     yolov3_darknet53,
 )
-from .ocr import CRNN, crnn_ocr  # noqa: F401
+from .ocr import (  # noqa: F401
+    CRNN,
+    DBDetector,
+    crnn_ocr,
+    db_detector,
+    db_loss,
+    db_postprocess,
+)

@@ -61,3 +61,83 @@ class TestCRNN:
         lp = lp.at[jnp.arange(T), 0, jnp.asarray(path)].set(0.0)
         out = np.asarray(net.decode_greedy(lp))[0]
         assert [v for v in out.tolist() if v >= 0] == [1, 2, 3]
+
+
+class TestDBDetector:
+    """DB text detection (PP-OCR det half): forward shapes, loss
+    descends on a synthetic text-region task, postprocess finds the
+    box."""
+
+    def test_forward_maps(self):
+        import numpy as np
+        from paddle_tpu.vision.models import db_detector
+        m = db_detector(base=8)
+        m.eval()
+        x = np.random.RandomState(0).randn(1, 3, 64, 64).astype("float32")
+        import paddle_tpu as pt
+        out = m(pt.to_tensor(x))
+        assert out["maps"].shape == (1, 3, 16, 16)
+        arr = np.asarray(out["maps"])
+        assert (arr >= 0).all() and (arr <= 1).all()
+
+    def test_training_and_postprocess(self):
+        import jax
+        import numpy as np
+        import paddle_tpu as pt
+        from paddle_tpu.nn.layer import (buffer_state, functional_call,
+                                         trainable_state)
+        from paddle_tpu.vision.models import (db_detector, db_loss,
+                                              db_postprocess)
+
+        m = db_detector(base=8)
+        m.train()
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 3, 32, 32).astype("float32")
+        # ground truth: a text blob in the upper-left of the /4 map
+        gt = np.zeros((2, 1, 8, 8), np.float32)
+        gt[:, :, 1:4, 1:5] = 1.0
+        gt_thresh = np.full((2, 1, 8, 8), 0.3, np.float32)
+        # make the blob visible in the input
+        x[:, :, 4:16, 4:20] += 3.0
+
+        opt = pt.optimizer.Adam(learning_rate=5e-3)
+        params = trainable_state(m)
+        buffers = buffer_state(m)
+        opt_state = opt.init_state(params)
+
+        def loss_fn(p, b):
+            out, nb = functional_call(m, p, x, buffers=b)
+            return db_loss(out["maps"], gt, gt_thresh), nb
+
+        @jax.jit
+        def step(p, b, s):
+            (loss, nb), g = jax.value_and_grad(loss_fn, has_aux=True)(p, b)
+            p2, s2 = opt.apply(p, g, s)
+            return p2, nb, s2, loss
+
+        losses = []
+        for _ in range(40):
+            params, buffers, opt_state, loss = step(params, buffers,
+                                                    opt_state)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+        from paddle_tpu.nn.layer import load_state
+        load_state(m, params)
+        m.eval()
+        out = m(pt.to_tensor(x))
+        boxes = db_postprocess(np.asarray(out["maps"]), thresh=0.5)
+        assert len(boxes) == 2
+        assert len(boxes[0]) >= 1  # found the text region
+
+    def test_db_binarization_is_steep_sigmoid(self):
+        import numpy as np
+        from paddle_tpu.vision.models import db_detector
+        import paddle_tpu as pt
+        m = db_detector(base=8, k=50.0)
+        m.eval()
+        x = np.random.RandomState(1).randn(1, 3, 32, 32).astype("float32")
+        maps = np.asarray(m(pt.to_tensor(x))["maps"])
+        prob, thresh, binary = maps[0, 0], maps[0, 1], maps[0, 2]
+        expect = 1.0 / (1.0 + np.exp(-50.0 * (prob - thresh)))
+        np.testing.assert_allclose(binary, expect, atol=1e-4)

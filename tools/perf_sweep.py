@@ -21,7 +21,7 @@ import os as _os
 import sys as _sys
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
     _os.path.abspath(__file__))))
-from bench import PEAK_FLOPS, peak_flops  # noqa: E402
+from bench import peak_flops  # noqa: E402
 
 
 def peak():
@@ -122,7 +122,8 @@ def yolo(batch=8, size=320, level="O1", steps=8, warmup=2):
 
 
 def gpt(batch=8, seq=1024, chunks=8, steps=12, warmup=2):
-    """Per-chip tokens/s (batch is per-chip via dp mesh scaling)."""
+    """Per-chip tokens/s; `batch` is the GLOBAL batch, sharded
+    over the dp mesh (throughput divides by device count)."""
     import jax
     import jax.numpy as jnp
     import paddle_tpu as pt
