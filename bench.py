@@ -27,6 +27,7 @@ import os
 import subprocess
 import sys
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -274,53 +275,34 @@ def _bench_resnet_at(batch: int) -> float:
     return batch * steps / dt / len(jax.devices())
 
 
-def _best_of_ladder(name: str, batches, run_fn):
-    """Try each batch, keep the best imgs/s; failures fall through to the
-    next size. Returns (best_imgs_per_sec, winning_batch)."""
-    best, best_batch = 0.0, None
-    for batch in batches:
-        try:
-            imgs = run_fn(batch)
-        except Exception as e:  # noqa: BLE001
-            print(f"bench: {name} batch={batch} failed "
-                  f"({type(e).__name__}: {e})", file=sys.stderr)
-            continue
-        print(f"bench: {name} batch={batch}: {imgs:.0f} imgs/s",
-              file=sys.stderr)
-        if imgs > best:
-            best, best_batch = imgs, batch
-    if best_batch is None:
-        raise RuntimeError(f"all {name} batch sizes failed")
-    return best, best_batch
-
-
-def bench_resnet() -> dict:
+def bench_resnet(batch: int = 64) -> dict:
     """BASELINE config 1: ResNet-50 training throughput (imgs/sec),
-    bf16 compute via amp auto_cast O1. Conv MFU on the MXU rises with
-    batch, so measure a small ladder and report the best."""
+    bf16 compute via amp auto_cast O1, at ONE batch size. The ladder
+    over batch sizes lives in the parent (`_run_secondary_ladder`), one
+    subprocess per attempt, so a hung large-batch compile cannot take
+    the known-good attempt (or the headline) down with it."""
     import jax
 
-    best, best_batch = _best_of_ladder("resnet", (256, 64),
-                                       _bench_resnet_at)
+    imgs = _bench_resnet_at(batch)
     # ResNet-50 fwd ~4.1 GFLOPs/img at 224^2; x3 for fwd+bwd
-    mfu = best * 3 * 4.1e9 / peak_flops(jax.devices()[0].device_kind)
+    mfu = imgs * 3 * 4.1e9 / peak_flops(jax.devices()[0].device_kind)
     return {"metric": "resnet50_train_imgs_per_sec_per_chip",
-            "value": round(best, 1), "unit": "imgs/s/chip",
-            "batch": best_batch,
+            "value": round(imgs, 1), "unit": "imgs/s/chip",
+            "batch": batch,
             "vs_baseline": round(mfu / 0.35, 4)}
 
 
-def bench_yolo() -> dict:
+def bench_yolo(batch: int = 8) -> dict:
     """BASELINE config 4: PP-YOLO-class (YOLOv3-DarkNet53) training
-    throughput, imgs/sec — best of a small batch ladder like resnet."""
+    throughput at ONE batch size (ladder in the parent, like resnet)."""
     import jax
 
-    best, best_batch = _best_of_ladder("yolo", (24, 8), _bench_yolo_at)
+    imgs = _bench_yolo_at(batch)
     # YOLOv3-DarkNet53 fwd ~39 GFLOPs/img at 320^2; x3 for fwd+bwd
-    mfu = best * 3 * 39e9 / peak_flops(jax.devices()[0].device_kind)
+    mfu = imgs * 3 * 39e9 / peak_flops(jax.devices()[0].device_kind)
     return {"metric": "yolov3_darknet53_train_imgs_per_sec_per_chip",
-            "value": round(best, 1), "unit": "imgs/s/chip",
-            "batch": best_batch,
+            "value": round(imgs, 1), "unit": "imgs/s/chip",
+            "batch": batch,
             "vs_baseline": round(mfu / 0.35, 4)}
 
 
@@ -365,15 +347,15 @@ def _bench_yolo_at(batch: int) -> float:
     return batch * steps / dt / len(jax.devices())
 
 
-def _run_secondary_subprocess(name: str, timeout: float = 900) -> None:
-    """Run one secondary bench config in a SUBPROCESS with a hard
-    timeout, forwarding its JSON line. Isolation matters: an untested
-    ladder config can HANG in compile (not raise) through the axon
-    tunnel, and an in-process hang would break the 'headline line is
-    ALWAYS emitted' contract. SIGTERM + grace, never SIGKILL
-    mid-handshake (same protocol as probe_backend)."""
+def _run_secondary_attempt(spec: str, timeout: float) -> Optional[dict]:
+    """Run one secondary bench attempt ('name' or 'name:batch') in a
+    SUBPROCESS with a hard timeout; return its parsed JSON result or
+    None. Isolation matters: an untested ladder config can HANG in
+    compile (not raise) through the axon tunnel, and an in-process hang
+    would break the 'headline line is ALWAYS emitted' contract. SIGTERM
+    + grace, never SIGKILL mid-handshake (same as probe_backend)."""
     env = dict(os.environ)
-    env["PTPU_BENCH_ONLY"] = name
+    env["PTPU_BENCH_ONLY"] = spec
     p = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                          text=True, env=env)
@@ -386,43 +368,86 @@ def _run_secondary_subprocess(name: str, timeout: float = 900) -> None:
         except subprocess.TimeoutExpired:
             p.kill()
             p.communicate()
-        print(f"bench: {name} timed out ({timeout}s)", file=sys.stderr)
-        return
+        print(f"bench: {spec} timed out ({timeout}s)", file=sys.stderr)
+        return None
     if stderr:
         sys.stderr.write(stderr)
-    for line in stdout.splitlines():
+    if p.returncode != 0:
+        print(f"bench: {spec} exited rc={p.returncode}", file=sys.stderr)
+        return None
+    for line in stdout.splitlines()[::-1]:
         try:
-            json.loads(line)
+            return json.loads(line)
         except ValueError:
             continue
-        print(line, flush=True)
+    return None
+
+
+# (name, batch ladder, per-attempt timeout): the known-good batch comes
+# LAST so its own subprocess budget is untouched by a slow big-batch try
+_SECONDARY_LADDERS = (
+    ("resnet", (256, 64), 600),
+    ("yolo", (24, 8), 600),
+    ("bert", (None,), 600),
+)
+
+
+def _run_secondary_ladder(name: str, batches, timeout: float) -> None:
+    results = []
+    for b in batches:
+        spec = name if b is None else f"{name}:{b}"
+        res = _run_secondary_attempt(spec, timeout)
+        if res is not None:
+            results.append(res)
+    if results:
+        best = max(results, key=lambda r: r.get("value", 0.0))
+        print(json.dumps(best), flush=True)
+    else:
+        print(f"bench: all {name} attempts failed", file=sys.stderr)
+
+
+def _child_only(only: str) -> int:
+    """PTPU_BENCH_ONLY child: one attempt, one JSON line; errors exit
+    nonzero WITHOUT the CPU fallback (a secondary must never report a
+    TPU-named metric measured on CPU)."""
+    name, _, batch = only.partition(":")
+    fns = {"resnet": bench_resnet, "yolo": bench_yolo, "bert": bench_bert}
+    try:
+        if batch:
+            res = fns[name](batch=int(batch))
+        else:
+            res = fns[name]()
+        print(json.dumps(res), flush=True)
+        return 0
+    except Exception as e:  # noqa: BLE001
+        print(f"bench[{only}]: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
 
 
 def main():
     out = None
     forced = os.environ.get("PTPU_BENCH_FORCED_CPU") == "1"
     only = os.environ.get("PTPU_BENCH_ONLY")
+    if forced:
+        # env JAX_PLATFORMS=cpu alone is NOT honored under the axon
+        # sitecustomize hook — the in-process config update is what
+        # actually routes to CPU (same recipe as tests/conftest.py).
+        # Must run before the only-branch too, or a forced-CPU child
+        # would dial the (possibly wedged) tunnel.
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    if only:
+        sys.exit(_child_only(only))
     try:
-        if forced:
-            # env JAX_PLATFORMS=cpu alone is NOT honored under the axon
-            # sitecustomize hook — the in-process config update is what
-            # actually routes to CPU (same recipe as tests/conftest.py)
-            import jax
-            jax.config.update("jax_platforms", "cpu")
-        if only:
-            # child mode: one secondary config, one JSON line
-            fn = {"resnet": bench_resnet, "yolo": bench_yolo,
-                  "bert": bench_bert}[only]
-            print(json.dumps(fn()), flush=True)
-            return
         if forced or probe_backend():
             import jax
             on_tpu = jax.default_backend() == "tpu"
             if on_tpu and os.environ.get("PTPU_BENCH_SECONDARY", "1") == "1":
-                # secondary configs first (subprocess-isolated so even a
-                # hung compile cannot keep the headline from printing)
-                for name in ("resnet", "yolo", "bert"):
-                    _run_secondary_subprocess(name)
+                # secondary configs first (one subprocess per ladder
+                # attempt: even a hung compile cannot keep the headline
+                # or the known-good attempt from printing)
+                for name, batches, timeout in _SECONDARY_LADDERS:
+                    _run_secondary_ladder(name, batches, timeout)
             out = bench_gpt(on_tpu)
             if forced:
                 out["degraded"] = True

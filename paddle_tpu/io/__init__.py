@@ -19,3 +19,4 @@ from .sampler import (  # noqa: F401
     WeightedRandomSampler,
 )
 from .worker import WorkerInfo, get_worker_info  # noqa: F401
+from .device_buffer import DeviceBufferedReader, device_buffered  # noqa: F401

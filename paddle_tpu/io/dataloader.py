@@ -190,19 +190,7 @@ class DataLoader:
         if not self.use_buffer_reader:
             yield from self._batches()
             return
-        # device double-buffering (buffered_reader.cc equivalent)
-        import jax.numpy as jnp
-
-        def to_device(batch):
-            return jax.tree.map(
-                lambda a: jnp.asarray(a) if isinstance(a, np.ndarray) else a,
-                batch)
-
-        prev = None
-        for batch in self._batches():
-            cur = to_device(batch)
-            if prev is not None:
-                yield prev
-            prev = cur
-        if prev is not None:
-            yield prev
+        # device double-buffering (buffered_reader.cc equivalent) — one
+        # implementation, shared with the standalone reader
+        from .device_buffer import device_buffered
+        yield from device_buffered(self._batches(), buffer_size=2)

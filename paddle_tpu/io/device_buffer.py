@@ -1,0 +1,60 @@
+"""Device-prefetching reader.
+
+Reference: `operators/reader/buffered_reader.h` — BufferedReader stages
+the next batches onto the device on a dedicated stream so compute never
+waits on H2D copies. TPU-native: `jax.device_put` is asynchronous (the
+transfer is enqueued and overlaps with the running step), so prefetching
+means issuing the put for the NEXT `buffer_size` batches before the
+current one is consumed.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Iterable, Iterator, Optional
+
+import jax
+
+
+def _put(batch, device):
+    return jax.tree.map(
+        lambda x: jax.device_put(x, device) if hasattr(x, "shape") else x,
+        batch)
+
+
+class DeviceBufferedReader:
+    """Wrap any batch iterable; yields device-resident batches with
+    `buffer_size` transfers in flight (reference buffered_reader.h:36)."""
+
+    def __init__(self, loader: Iterable, buffer_size: int = 2,
+                 device=None):
+        if buffer_size < 1:
+            raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
+        self._loader = loader
+        self._size = buffer_size
+        self._device = device or jax.devices()[0]
+
+    def __iter__(self) -> Iterator:
+        buf: deque = deque()
+        it = iter(self._loader)
+        try:
+            for _ in range(self._size):
+                buf.append(_put(next(it), self._device))
+        except StopIteration:
+            pass
+        while buf:
+            out = buf.popleft()
+            try:
+                buf.append(_put(next(it), self._device))
+            except StopIteration:
+                pass
+            yield out
+
+    def __len__(self):
+        return len(self._loader)
+
+
+def device_buffered(loader: Iterable, buffer_size: int = 2,
+                    device=None) -> DeviceBufferedReader:
+    """Functional spelling: `for batch in device_buffered(loader): ...`"""
+    return DeviceBufferedReader(loader, buffer_size=buffer_size,
+                                device=device)

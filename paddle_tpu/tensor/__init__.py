@@ -22,3 +22,10 @@ from .sequence import (  # noqa: F401
     sequence_softmax, sequence_unpad)
 from . import stat  # noqa: F401
 from .stat import std, var, median, quantile, nanmedian, nanquantile  # noqa: F401
+from . import array  # noqa: F401
+from .array import (  # noqa: F401
+    array_length,
+    array_read,
+    array_write,
+    create_array,
+)

@@ -1,0 +1,62 @@
+"""TensorArray ops (reference: python/paddle/tensor/array.py over the
+LoDTensorArray runtime type + tensor_array_read_write_op).
+
+TPU-native: a TensorArray is a plain Python list of arrays in eager
+code; inside `lax.while_loop`/`scan` bodies the XLA-shaped pattern is a
+preallocated stacked buffer updated with `.at[i].set` — `array_write`
+transparently supports both (list for eager/int index, stacked jax array
+for traced index), so dy2static-converted loops keep working.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """Reference: array.py create_array — a new (empty) TensorArray."""
+    arr = list(initialized_list) if initialized_list else []
+    return arr
+
+
+def array_length(array):
+    """Reference: array.py array_length."""
+    if isinstance(array, (list, tuple)):
+        return len(array)
+    return array.shape[0]
+
+
+def array_read(array, i):
+    """Reference: array.py array_read. Works on a list (eager int i) or
+    a stacked array (traced i — XLA dynamic index)."""
+    if isinstance(array, (list, tuple)):
+        if isinstance(i, jax.core.Tracer):
+            return jnp.stack(array)[i]
+        return array[int(i)]
+    return array[i]
+
+
+def array_write(x, i, array=None):
+    """Reference: array.py array_write. Returns the updated array (the
+    reference mutates the LoDTensorArray; functional style returns)."""
+    if array is None:
+        array = []
+    if isinstance(array, tuple):
+        array = list(array)
+    if isinstance(array, list):
+        if isinstance(i, jax.core.Tracer):
+            raise TypeError(
+                "array_write with a traced index needs a stacked jax "
+                "array TensorArray (preallocate with jnp.zeros([n, ...]) "
+                "inside lax loops); python lists only take concrete "
+                "indices")
+        i = int(i)
+        if i == len(array):
+            array.append(x)
+        elif i < len(array):
+            array[i] = x
+        else:
+            raise IndexError(
+                f"array_write index {i} beyond array length {len(array)}")
+        return array
+    return array.at[i].set(x)

@@ -153,3 +153,41 @@ class TestMultiprocessDataLoader:
                         use_buffer_reader=False, worker_init_fn=init)
         wids = {int(np.asarray(b)[0, 0]) for b in dl}
         assert wids <= {0, 1} and wids, wids
+
+
+class TestDeviceBufferedReader:
+    """BufferedReader analogue (reference operators/reader/
+    buffered_reader.h): device-resident batches, order preserved,
+    partial tail kept."""
+
+    def test_order_and_device(self):
+        import jax
+        import numpy as np
+        from paddle_tpu.io import DeviceBufferedReader
+
+        batches = [np.full((2, 3), i, np.float32) for i in range(7)]
+        out = list(DeviceBufferedReader(batches, buffer_size=3))
+        assert len(out) == 7
+        for i, b in enumerate(out):
+            assert float(b[0, 0]) == i
+            assert isinstance(b, jax.Array)
+
+    def test_short_iterable_and_pytree(self):
+        import numpy as np
+        from paddle_tpu.io import device_buffered
+
+        batches = [{"x": np.ones((2,)), "y": np.zeros((1,))}]
+        out = list(device_buffered(batches, buffer_size=4))
+        assert len(out) == 1 and set(out[0]) == {"x", "y"}
+
+    def test_wraps_dataloader(self):
+        import numpy as np
+        import paddle_tpu as pt
+        from paddle_tpu.io import DataLoader, TensorDataset, \
+            device_buffered
+
+        ds = TensorDataset([np.arange(12, dtype=np.float32).reshape(6, 2)])
+        dl = DataLoader(ds, batch_size=2)
+        got = [np.asarray(b[0] if isinstance(b, (list, tuple)) else b)
+               for b in device_buffered(dl)]
+        assert sum(g.shape[0] for g in got) == 6

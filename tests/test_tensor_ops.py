@@ -189,3 +189,54 @@ class TestRandom:
         assert int(r.min()) >= 0 and int(r.max()) < 10
         p = paddle.randperm(10)
         assert sorted(np.asarray(p).tolist()) == list(range(10))
+
+
+class TestTensorArray:
+    """TensorArray ops (reference tensor/array.py): eager list mode and
+    the stacked-buffer mode for lax loops."""
+
+    def test_eager_list_mode(self):
+        import numpy as np
+        import paddle_tpu as pt
+        arr = pt.create_array("float32")
+        arr = pt.array_write(pt.to_tensor([1.0, 2.0]), 0, arr)
+        arr = pt.array_write(pt.to_tensor([3.0, 4.0]), 1, arr)
+        assert pt.array_length(arr) == 2
+        np.testing.assert_array_equal(np.asarray(pt.array_read(arr, 1)),
+                                      [3.0, 4.0])
+        arr = pt.array_write(pt.to_tensor([9.0, 9.0]), 0, arr)  # overwrite
+        np.testing.assert_array_equal(np.asarray(pt.array_read(arr, 0)),
+                                      [9.0, 9.0])
+
+    def test_stacked_mode_in_lax_loop(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import paddle_tpu as pt
+
+        def body(i, buf):
+            return i + 1, pt.array_write(jnp.full((2,), i, jnp.float32),
+                                         i, buf)
+
+        def run():
+            buf = jnp.zeros((4, 2))
+            i = 0
+            i, buf = jax.lax.while_loop(
+                lambda c: c[0] < 4, lambda c: body(*c), (i, buf))
+            return buf
+
+        out = np.asarray(jax.jit(run)())
+        np.testing.assert_array_equal(out[:, 0], [0, 1, 2, 3])
+
+    def test_traced_read_of_list(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import paddle_tpu as pt
+        arr = [jnp.asarray([1.0]), jnp.asarray([2.0]), jnp.asarray([3.0])]
+
+        @jax.jit
+        def pick(i):
+            return pt.array_read(arr, i)
+
+        np.testing.assert_array_equal(np.asarray(pick(2)), [3.0])
