@@ -128,12 +128,22 @@ def set_printoptions(precision=None, threshold=None, edgeitems=None,
     _np.set_printoptions(**kwargs)
 
 
-def monkey_patch_variable():  # reference: fluid Variable operator patching
-    """No-op: jax arrays already support operators natively."""
+from .framework.tensor_patch import monkey_patch_tensor  # noqa: E402
+
+
+def monkey_patch_variable():
+    """Reference: fluid Variable operator patching. Operators work
+    natively on jax arrays; this installs the METHOD spellings
+    (`t.numpy()`, `t.unsqueeze(0)`, ...) — see framework/tensor_patch."""
+    monkey_patch_tensor()
 
 
 def monkey_patch_math_varbase():  # reference: dygraph VarBase patching
-    """No-op: jax arrays already support operators natively."""
+    """Same patch as monkey_patch_variable (one tensor class here)."""
+    monkey_patch_tensor()
+
+
+monkey_patch_tensor()   # like the reference, patch at import
 
 
 # install static-mode dispatch last: wraps the curated op set so calls on

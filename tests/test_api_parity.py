@@ -210,3 +210,52 @@ class TestDeepNamespaceParity:
         assert pt.inference.get_num_bytes_of_data_type(
             pt.inference.DataType.BFLOAT16) == 2
         assert "paddle_tpu" in pt.inference.get_version()
+
+
+class TestTensorMethodSurface:
+    """monkey_patch_tensor: paddle Tensor method spellings on jax arrays
+    (reference: math_op_patch.py), eager and inside jit."""
+
+    def test_conversion_methods(self):
+        import numpy as np
+        import paddle_tpu as pt
+        t = pt.to_tensor([[1.0, 2.0]])
+        assert isinstance(t.numpy(), np.ndarray)
+        assert t.numel() == 2 and t.dim() == 2
+        np.testing.assert_array_equal(t.clone().numpy(), t.numpy())
+        assert t.detach().shape == t.shape
+
+    def test_math_methods_eager_and_jit(self):
+        import jax
+        import numpy as np
+        import paddle_tpu as pt
+        t = pt.to_tensor([[4.0, -9.0]])
+        np.testing.assert_allclose(t.abs().sqrt().numpy(), [[2.0, 3.0]])
+        np.testing.assert_allclose(t.add(1.0).numpy(), [[5.0, -8.0]])
+        out = jax.jit(lambda x: x.square().subtract(1.0))(t)
+        np.testing.assert_allclose(np.asarray(out), [[15.0, 80.0]])
+
+    def test_shape_methods(self):
+        import paddle_tpu as pt
+        t = pt.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert t.unsqueeze(0).shape == (1, 2, 2)
+        assert t.t().shape == (2, 2)
+        assert t.expand([3, 2, 2]).shape == (3, 2, 2) or \
+            t.unsqueeze(0).expand([3, 2, 2]).shape == (3, 2, 2)
+        parts = t.unbind(0)
+        assert len(parts) == 2 and parts[0].shape == (2,)
+
+    def test_stop_gradient_and_backward(self):
+        import pytest as _pytest
+        import paddle_tpu as pt
+        t = pt.to_tensor([1.0])
+        t.stop_gradient = True     # accepted and ignored
+        assert t.stop_gradient is True
+        with _pytest.raises(RuntimeError, match="functional"):
+            t.backward()
+
+    def test_gradients_flow_through_methods(self):
+        import numpy as np
+        import paddle_tpu as pt
+        g = pt.grad(lambda x: x.square().sum())(pt.to_tensor([3.0]))
+        np.testing.assert_allclose(np.asarray(g), [6.0])
