@@ -1,0 +1,62 @@
+"""Book example (reference: tests/book/test_label_semantic_roles.py):
+sequence tagging with a linear-chain CRF on top of word embeddings —
+`linear_chain_crf` trains the transitions, `crf_decoding` Viterbi-decodes,
+both over the static-graph engine.
+
+Run: python examples/label_semantic_roles.py [--steps N]
+"""
+import argparse
+
+import numpy as np
+
+
+def main(steps=60, batch_size=16, seq_len=6, vocab=50, n_tags=4):
+    import paddle_tpu as paddle
+
+    # synthetic SRL-ish data with a learnable rule: the tag cycles with
+    # the token id band
+    rs = np.random.RandomState(0)
+    words = rs.randint(0, vocab, (256, seq_len)).astype(np.int64)
+    tags = (words * n_tags // vocab).astype(np.int64)
+
+    paddle.enable_static()
+    try:
+        main_prog = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main_prog, startup):
+            w = paddle.static.data("w", [None, seq_len], "int64")
+            t = paddle.static.data("t", [None, seq_len], "int64")
+            emb = paddle.static.nn.embedding(w, (vocab, 16))
+            feat = paddle.static.nn.fc(emb, n_tags, num_flatten_dims=2)
+            nll = paddle.static.nn.linear_chain_crf(
+                feat, t, param_attr="crf_transition")
+            loss = paddle.mean(nll)
+            path = paddle.static.nn.crf_decoding(
+                feat, param_attr="crf_transition")
+            paddle.optimizer.Adam(learning_rate=5e-2).minimize(loss)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        first = last = None
+        for i in range(steps):
+            idx = np.random.RandomState(i).randint(0, len(words),
+                                                   batch_size)
+            (lv,) = exe.run(main_prog, feed={"w": words[idx],
+                                             "t": tags[idx]},
+                            fetch_list=[loss])
+            first = lv if first is None else first
+            last = lv
+        (decoded,) = exe.run(main_prog,
+                             feed={"w": words[:4], "t": tags[:4]},
+                             fetch_list=[path])
+        acc = float((decoded == tags[:4]).mean())
+        print(f"crf nll {float(first):.3f} -> {float(last):.3f}; "
+              f"decode acc {acc:.2f}")
+        return float(first), float(last), acc
+    finally:
+        paddle.disable_static()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    main(steps=ap.parse_args().steps)

@@ -8,6 +8,11 @@ but reference scripts also use the METHOD spellings (`t.numpy()`,
 `t.unsqueeze(0)`, `t.add(y)`, `t.stop_gradient = True`). This module
 adds the missing ones onto the jax Array class — never overriding
 anything jax already defines.
+
+Known hole: `x.flatten(start_axis, stop_axis)` keeps jax's native
+`flatten(order)` (overriding it could break jax internals); use
+`paddle_tpu.flatten(x, start, stop)` or the `x.flatten_(...)` alias for
+paddle flatten semantics.
 """
 from __future__ import annotations
 
@@ -155,13 +160,14 @@ def monkey_patch_tensor():
                 cls.backward = _backward
             except (TypeError, AttributeError):
                 pass
-    arr_cls = classes[0]
-    if not hasattr(arr_cls, "stop_gradient"):
-        try:
-            # eager arrays are constants: reads are True; writes are
-            # accepted and ignored so `x.stop_gradient = True` runs
-            arr_cls.stop_gradient = property(lambda self: True,
+    for cls in classes:   # Tracer is NOT a jax.Array subclass: both
+        if not hasattr(cls, "stop_gradient"):
+            try:
+                # eager arrays are constants: reads are True; writes are
+                # accepted and ignored so `x.stop_gradient = True` runs
+                # unchanged, eagerly AND inside traced code
+                cls.stop_gradient = property(lambda self: True,
                                              lambda self, v: None)
-        except (TypeError, AttributeError):
-            pass
+            except (TypeError, AttributeError):
+                pass
     _PATCHED = True

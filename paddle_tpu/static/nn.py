@@ -698,3 +698,80 @@ def create_parameter(shape, dtype="float32", name=None, attr=None,
     from ..framework import create_parameter as _cp
     return _cp(shape, dtype=dtype, name=name, attr=attr, is_bias=is_bias,
                default_initializer=default_initializer)
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None,
+                     transition=None, name=None):
+    """Reference: fluid/layers/nn.py linear_chain_crf
+    (linear_chain_crf_op.cc): negative log-likelihood of a linear-chain
+    CRF — emissions [B, T, N], labels [B, T] int — with the same
+    transition layout as `crf_decoding` (rows 0/1 start/stop, rest
+    pairwise). Weight sharing with crf_decoding: give both the same
+    param_attr NAME (one storage slot in the replay) or pass an explicit
+    `transition` Parameter.
+
+    `length` [B] masks padded timesteps (padding+lengths is this
+    framework's LoD mapping): positions t >= length contribute to
+    neither the gold score nor the partition.
+
+    TPU-native: the forward-algorithm partition is a `lax.scan` of
+    log-sum-exp steps (static T); the gold path score is a pure
+    gather-and-sum (no serial chain). Returns per-sequence NLL [B].
+    """
+    from ..nn.layer import Layer
+
+    n_tags = _static_dim(input.shape, -1, "linear_chain_crf")
+
+    class _CRFLoss(Layer):
+        def __init__(self):
+            super().__init__()
+            if transition is not None:
+                self.transition = transition
+            else:
+                self.transition = self.create_parameter(
+                    (n_tags + 2, n_tags), attr=param_attr)
+
+        def forward(self, emissions, labels, lengths=None):
+            import jax
+            import jax.numpy as jnp
+            trans = self.transition.value \
+                if hasattr(self.transition, "value") else self.transition
+            start, stop, pair = trans[0], trans[1], trans[2:]
+            T = emissions.shape[1]
+
+            def one(em, lab, n):  # em [T, N], lab [T], n scalar length
+                t_idx = jnp.arange(T)
+                valid = t_idx < n                      # [T]
+                last = jnp.maximum(n - 1, 0)
+                # gold score: gather-and-sum, no serial chain
+                gold = start[lab[0]] \
+                    + jnp.sum(jnp.where(valid, em[t_idx, lab], 0.0)) \
+                    + jnp.sum(jnp.where(valid[1:],
+                                        pair[lab[:-1], lab[1:]], 0.0)) \
+                    + stop[lab[last]]
+                # partition: masked forward algorithm; alpha freezes at
+                # t >= n so the final alpha is alpha_{n-1}
+                alpha0 = start + em[0]
+
+                def fwd(alpha, xs):
+                    e, keep = xs
+                    new = jax.nn.logsumexp(
+                        alpha[:, None] + pair + e[None, :], axis=0)
+                    return jnp.where(keep, new, alpha), None
+
+                alpha, _ = jax.lax.scan(fwd, alpha0,
+                                        (em[1:], valid[1:]))
+                logz = jax.nn.logsumexp(alpha + stop)
+                return logz - gold
+
+            if lengths is None:
+                lengths = jnp.full((emissions.shape[0],), T, jnp.int32)
+            return jax.vmap(one)(emissions, labels, lengths)
+
+    if isinstance(input, Variable):
+        args = (input, label) if length is None else (input, label,
+                                                      length)
+        return record(None, args, {}, layer=_CRFLoss(),
+                      hint=name or "linear_chain_crf")
+    layer = _CRFLoss()
+    return layer(input, label, length)

@@ -53,3 +53,9 @@ def test_recommender_system_example():
     import recommender_system
     l0, l1 = recommender_system.main(steps=60)
     assert l1 < l0
+
+
+def test_label_semantic_roles_example():
+    import label_semantic_roles
+    l0, l1, acc = label_semantic_roles.main(steps=50)
+    assert l1 < l0

@@ -504,3 +504,32 @@ class TestReviewRegressions:
         img = np.zeros((8, 8, 3), np.uint8)
         out = T.rotate(img, 45, fill=(255, 0, 9))
         assert (out[0, 0] == [255, 0, 9]).all()
+
+    def test_shared_param_name_shares_storage(self, static_mode):
+        """Two layers creating params with the SAME explicit name share
+        one storage slot in the replay (reference: scope name lookup) —
+        the mechanism crf loss/decoding weight sharing rides on."""
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            em = paddle.static.data("em", [None, 4, 3], "float32")
+            lab = paddle.static.data("lab", [None, 4], "int64")
+            nll = paddle.static.nn.linear_chain_crf(
+                em, lab, param_attr="trans")
+            loss = paddle.mean(nll)
+            path = paddle.static.nn.crf_decoding(em, param_attr="trans")
+            paddle.optimizer.Adam(learning_rate=0.1).minimize(loss)
+        assert sum(1 for p in main.all_parameters()
+                   if p.name == "trans") == 1
+        exe = paddle.static.Executor()
+        rs = np.random.RandomState(0)
+        E = rs.randn(4, 4, 3).astype("float32")
+        L = rs.randint(0, 3, (4, 4)).astype("int64")
+        before = np.asarray(main._params["trans"].value).copy()
+        for _ in range(5):
+            exe.run(main, feed={"em": E, "lab": L}, fetch_list=[loss])
+        after = np.asarray(main._params["trans"].value)
+        assert not np.allclose(before, after)  # trained
+        # decode consumes the TRAINED transitions (shared storage)
+        (p1,) = exe.run(main, feed={"em": E, "lab": L},
+                        fetch_list=[path])
+        assert p1.shape == (4, 4)
