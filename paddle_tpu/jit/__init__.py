@@ -161,6 +161,22 @@ def _specs_to_abstract(input_spec):
     return out
 
 
+def write_artifact(path: str, exported_bytes: bytes, params, buffers,
+                   input_names) -> str:
+    """Write the inference artifact pair — `<path>.pdmodel` (serialized
+    StableHLO) + `<path>.pdiparams` (state pickle) — the ONE format
+    `jit.load` / `inference.Predictor` consume (also used by the static-
+    graph `save_inference_model` export)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    _save_state({"params": params, "buffers": buffers,
+                 "input_names": list(input_names)}, path + ".pdiparams")
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported_bytes)
+    return path + ".pdmodel"
+
+
 def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
     """`paddle.jit.save` equivalent.
 
@@ -170,17 +186,10 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
     """
     if input_spec is None:
         raise ValueError("jit.save requires input_spec to trace the model")
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
     was_training = layer.training
     layer.eval()
     params = {n: p.value for n, p in layer.named_parameters()}
     buffers = buffer_state(layer)
-    _save_state({"params": params, "buffers": buffers,
-                 "input_names": [getattr(s, "name", None) or f"x{i}"
-                                 for i, s in enumerate(input_spec)]},
-                path + ".pdiparams")
     abstract = _specs_to_abstract(input_spec)
 
     def fwd(params, buffers, *args):
@@ -194,8 +203,9 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
         jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
                      buffers),
         *abstract)
-    with open(path + ".pdmodel", "wb") as f:
-        f.write(exported.serialize())
+    write_artifact(path, exported.serialize(), params, buffers,
+                   [getattr(s, "name", None) or f"x{i}"
+                    for i, s in enumerate(input_spec)])
     if was_training:
         layer.train()
 

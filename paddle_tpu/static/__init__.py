@@ -43,16 +43,68 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     """
     from ..jit import save as _jit_save
     from ..nn.layer import Layer
+    from .program import Variable as _Var
+
+    feed_list = list(feed_vars) if feed_vars is not None else []
+    fetch_list = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else ([fetch_vars] if fetch_vars is not None else [])
+    if feed_list and all(isinstance(v, _Var) for v in feed_list) and \
+            fetch_list and all(isinstance(v, _Var) for v in fetch_list):
+        # classic static-graph export: prune to the fetch targets and
+        # jax.export the replay in the jit.save artifact format, so
+        # jit.load / inference.Predictor consume it unchanged
+        return _save_static_inference(path_prefix, feed_list, fetch_list,
+                                      program)
     target = program
     if target is None and isinstance(fetch_vars, Layer):
         target = fetch_vars  # tolerate (prefix, feeds, layer) call shapes
     if target is None:
         raise ValueError(
-            "save_inference_model needs the model: pass program=<Layer> "
-            "(the ProgramDesc of the reference is a traced Layer here), "
+            "save_inference_model needs the model: pass static feed/fetch "
+            "Variables (classic static-graph export) or program=<Layer> "
             "with feed_vars as its InputSpec list.")
-    specs = list(feed_vars) if feed_vars is not None else None
+    specs = feed_list or None
     return _jit_save(target, path_prefix, input_spec=specs)
+
+
+def _save_static_inference(path_prefix, feed_vars, fetch_vars, program):
+    """Export a static Program slice as the jit.save artifact pair
+    (.pdmodel StableHLO + .pdiparams): params/buffers are baked into the
+    export as constants, so the state file carries only the input
+    names."""
+    import jax
+    from jax import export as jax_export
+
+    from ..jit import _specs_to_abstract
+    from .executor import _buffers_of, _replay, needed_ops
+    from .program import default_main_program
+
+    prog = program if program is not None else default_main_program()
+    test_prog = prog.clone(for_test=True)
+    fetch = [test_prog._vars.get(v.name, v) for v in fetch_vars]
+    op_indices, _ = needed_ops(test_prog, {v.name for v in fetch})
+    run = _replay(test_prog, op_indices, fetch, train=False)
+    params = {n: p.value for n, p in test_prog._params.items()}
+    buffers = {i: {n: b.value for n, b in _buffers_of(op.layer).items()}
+               for i, op in enumerate(test_prog.ops)
+               if op.layer is not None}
+    feed_names = [v.name for v in feed_vars]
+
+    def fwd(p, b, *args):
+        # jit-artifact signature: (params, buffers, *inputs); the static
+        # program's state is baked in, so p/b arrive empty
+        feed_vals = dict(zip(feed_names, args))
+        outs = run(feed_vals, params, buffers, None,
+                   jax.random.key(0))[0]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    specs = [InputSpec(list(v.shape), str(v.dtype), name=v.name)
+             for v in feed_vars]
+    abstract = _specs_to_abstract(specs)
+    exported = jax_export.export(jax.jit(fwd))({}, {}, *abstract)
+    from ..jit import write_artifact
+    return write_artifact(path_prefix, exported.serialize(), {}, {},
+                          feed_names)
 
 
 class Executor(_ReplayExecutor):

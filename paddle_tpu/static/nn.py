@@ -453,7 +453,11 @@ def crf_decoding(input, param_attr=None, label=None, length=None,
     """Reference: fluid/layers/nn.py crf_decoding (crf_decoding_op.cc):
     Viterbi decode over linear-chain CRF emissions [B, T, N] with
     transitions [(N+2), N] (rows 0/1 = start/stop like the reference).
-    Creates the transition parameter when not given one."""
+    Creates the transition parameter when not given one; share with
+    `linear_chain_crf` via the same param_attr name or an explicit
+    `transition`. `length` [B] masks padded timesteps (identity
+    Viterbi steps beyond the length; tags at padded positions replicate
+    the last valid tag)."""
     from ..nn.layer import Layer
 
     n_tags = _static_dim(input.shape, -1, "crf_decoding")
@@ -461,25 +465,35 @@ def crf_decoding(input, param_attr=None, label=None, length=None,
     class _CRFDecode(Layer):
         def __init__(self):
             super().__init__()
-            self.transition = self.create_parameter((n_tags + 2, n_tags),
-                                                    attr=param_attr)
+            if transition is not None:
+                self.transition = transition
+            else:
+                self.transition = self.create_parameter(
+                    (n_tags + 2, n_tags), attr=param_attr)
 
-        def forward(self, emissions):
+        def forward(self, emissions, lengths=None):
             import jax
             import jax.numpy as jnp
-            trans = self.transition.value
+            trans = self.transition.value \
+                if hasattr(self.transition, "value") else self.transition
             start, stop, pair = trans[0], trans[1], trans[2:]
+            T = emissions.shape[1]
 
-            def viterbi_one(em):  # [T, N]
-                def tick(carry, e):
+            def viterbi_one(em, n):  # [T, N], scalar length
+                valid = jnp.arange(1, T) < n
+
+                def tick(carry, xs):
+                    e, keep = xs
                     score = carry  # [N]
                     cand = score[:, None] + pair + e[None, :]
-                    best = jnp.max(cand, axis=0)
-                    back = jnp.argmax(cand, axis=0)
+                    best = jnp.where(keep, jnp.max(cand, axis=0), score)
+                    back = jnp.where(keep, jnp.argmax(cand, axis=0),
+                                     jnp.arange(n_tags))
                     return best, back
 
                 score0 = start + em[0]
-                final, backs = jax.lax.scan(tick, score0, em[1:])
+                final, backs = jax.lax.scan(tick, score0,
+                                            (em[1:], valid))
                 final = final + stop
                 last = jnp.argmax(final)
 
@@ -490,9 +504,12 @@ def crf_decoding(input, param_attr=None, label=None, length=None,
                 return jnp.concatenate([jnp.asarray([first]),
                                         path[::-1]]).astype(jnp.int64)
 
-            return jax.vmap(viterbi_one)(emissions)
+            if lengths is None:
+                lengths = jnp.full((emissions.shape[0],), T, jnp.int32)
+            return jax.vmap(viterbi_one)(emissions, lengths)
 
-    return record(None, (input,), {}, layer=_CRFDecode(),
+    args = (input,) if length is None else (input, length)
+    return record(None, args, {}, layer=_CRFDecode(),
                   hint=name or "crf_decoding")
 
 

@@ -533,3 +533,34 @@ class TestReviewRegressions:
         (p1,) = exe.run(main, feed={"em": E, "lab": L},
                         fetch_list=[path])
         assert p1.shape == (4, 4)
+
+    def test_save_inference_model_static_vars(self, static_mode,
+                                              tmp_path):
+        """Classic static export path: save_inference_model with static
+        feed/fetch Variables -> jit.load round trip (the reference's
+        main static-mode deployment flow)."""
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 4], "float32")
+            y = paddle.static.data("y", [None, 1], "float32")
+            h = paddle.static.nn.fc(x, 8, activation="relu")
+            pred = paddle.static.nn.fc(h, 1)
+            loss = paddle.mean(
+                paddle.nn.functional.square_error_cost(pred, y))
+            paddle.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        exe = paddle.static.Executor()
+        X = np.random.RandomState(0).randn(8, 4).astype("float32")
+        Y = X.sum(1, keepdims=True).astype("float32")
+        for _ in range(10):
+            exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        prefix = str(tmp_path / "static_model")
+        out = paddle.static.save_inference_model(prefix, [x], [pred],
+                                                 exe, program=main)
+        assert out.endswith(".pdmodel")
+        loaded = paddle.jit.load(prefix)
+        (want,) = exe.run(main.clone(for_test=True), feed={"x": X[:3]},
+                          fetch_list=[pred])
+        got = np.asarray(loaded(X[:3]))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        # batch polymorphism: a different batch size works
+        assert np.asarray(loaded(X[:5])).shape == (5, 1)
