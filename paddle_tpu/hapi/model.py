@@ -246,20 +246,73 @@ class Model:
         return self.network.parameters()
 
     def summary(self, input_size=None, dtype=None):
-        total = 0
-        lines = []
-        for name, p in self.network.named_parameters():
-            n = int(np.prod(p.shape))
-            total += n
-            lines.append(f"{name:60s} {str(p.shape):24s} {n}")
-        report = "\n".join(lines) + f"\nTotal params: {total:,}"
-        print(report)
-        return {"total_params": total}
+        return summary(self.network, input_size=input_size, dtypes=dtype)
 
 
 def summary(net, input_size=None, dtypes=None, input=None):
     """Reference: `paddle.summary` (hapi/model_summary.py) — standalone
-    layer summary: per-parameter table + total/trainable counts."""
+    layer summary. With `input_size` (or an example `input`), per-layer
+    OUTPUT shapes are captured via forward hooks under `jax.eval_shape`
+    (abstract — no FLOPs spent, works without any device); always ends
+    with the parameter totals table."""
+    shape_rows = []
+    if input_size is not None or input is not None:
+        import jax
+        import jax.numpy as jnp
+
+        from ..nn.layer import buffer_state, functional_call, \
+            trainable_state
+
+        if input is not None:
+            # a list/tuple of tensors = multiple forward args
+            ins = input if isinstance(input, (list, tuple)) else [input]
+            example = [jnp.asarray(i) for i in ins]
+        else:
+            sizes = list(input_size) if isinstance(input_size, list) \
+                else [input_size]
+            if sizes and all(isinstance(d, int) for d in sizes):
+                sizes = [tuple(sizes)]   # flat [1,3,8,8] = ONE shape
+            dts = list(dtypes) if isinstance(dtypes, (list, tuple)) \
+                else [dtypes] * len(sizes)
+            dts += [None] * (len(sizes) - len(dts))
+            example = [
+                jax.ShapeDtypeStruct(
+                    tuple(1 if d in (None, -1) else int(d) for d in s),
+                    jnp.dtype(dt or "float32"))
+                for s, dt in zip(sizes, dts)]
+
+        handles = []
+        sublayers = list(net.named_sublayers())
+        if not sublayers:          # bare leaf layer: show its own row
+            sublayers = [("", net)]
+        for lname, layer in sublayers:
+            def hook(lyr, inputs, outputs, _n=lname):
+                leaves = jax.tree.leaves(outputs)
+                shape_rows.append(
+                    (f"{type(lyr).__name__} ({_n})",
+                     [tuple(getattr(o, "shape", ())) for o in leaves],
+                     sum(int(np.prod(p.shape))
+                         for p in lyr._parameters.values())))
+                return outputs
+            handles.append(layer.register_forward_post_hook(hook))
+        params = trainable_state(net)
+        buffers = buffer_state(net)
+        try:
+            jax.eval_shape(
+                lambda args: functional_call(net, params, *args,
+                                             buffers=buffers)[0],
+                example)
+        finally:
+            for h in handles:
+                h.remove()
+        header = f"{'Layer (type)':38s}{'Output Shape':28s}{'Params':>10s}"
+        print(header)
+        print("-" * len(header))
+        for nm, shapes, n in shape_rows:
+            shown = shapes[0] if len(shapes) == 1 else shapes
+            print(f"{nm[:37]:38s}{str(shown):28s}{n:>10,d}")
+        print("-" * len(header))
+
     total = trainable = 0
     lines = []
     for name, p in net.named_parameters():
@@ -268,7 +321,8 @@ def summary(net, input_size=None, dtypes=None, input=None):
         if getattr(p, "trainable", True):
             trainable += n
         lines.append(f"{name:60s} {str(p.shape):24s} {n}")
-    print("\n".join(lines))
+    if not shape_rows:
+        print("\n".join(lines))
     print(f"Total params: {total:,}")
     print(f"Trainable params: {trainable:,}")
     print(f"Non-trainable params: {total - trainable:,}")

@@ -205,3 +205,23 @@ def test_predict_returns_per_output_lists():
     assert tuple(stacked[0].shape) == (10, 3)
     np.testing.assert_allclose(np.asarray(stacked[0]),
                                np.asarray(out[0]), rtol=1e-6)
+
+
+class TestSummaryShapes:
+    def test_summary_with_input_size(self, capsys):
+        import paddle_tpu as pt
+        net = pt.nn.Sequential(
+            pt.nn.Conv2D(1, 4, 3, padding=1), pt.nn.ReLU(),
+            pt.nn.Flatten(), pt.nn.Linear(4 * 8 * 8, 5))
+        out = pt.summary(net, input_size=(1, 1, 8, 8))
+        printed = capsys.readouterr().out
+        assert "(1, 4, 8, 8)" in printed       # conv output shape
+        assert "(1, 5)" in printed             # head output shape
+        assert out["total_params"] == 4 * 9 + 4 + (4 * 64 * 5 + 5)
+
+    def test_summary_without_shapes_still_totals(self, capsys):
+        import paddle_tpu as pt
+        lin = pt.nn.Linear(3, 2)
+        out = pt.summary(lin)
+        assert out["total_params"] == 8
+        assert "Total params: 8" in capsys.readouterr().out
