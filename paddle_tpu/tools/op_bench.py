@@ -71,9 +71,37 @@ def _standard_ops() -> Dict[str, Callable]:
         x = jnp.asarray(rs.randn(4096, 1024), jnp.float32)
         return (lambda: jnp.sum(x, axis=-1))
 
+    def deform_conv2d():
+        from ..vision import ops as V
+        x = jnp.asarray(rs.randn(4, 32, 28, 28), jnp.float32)
+        w = jnp.asarray(rs.randn(32, 32, 3, 3), jnp.float32)
+        off = jnp.asarray(rs.randn(4, 18, 26, 26) * 0.2, jnp.float32)
+        return (lambda: V.deform_conv2d(x, off, w))
+
+    def grid_sample():
+        from ..nn import functional as F
+        x = jnp.asarray(rs.randn(8, 32, 64, 64), jnp.float32)
+        g = jnp.asarray(rs.uniform(-1, 1, (8, 64, 64, 2)), jnp.float32)
+        return (lambda: F.grid_sample(x, g))
+
+    def beam_search():
+        # decode-path engine bench (pure functional; `lax.scan` beams)
+        from ..nn.decode import beam_search as bs
+        V = 512
+        proj = jnp.asarray(rs.randn(16, V) * 0.1, jnp.float32)
+
+        def step_fn(tokens, state):
+            h = jnp.take(proj, tokens % 16, axis=0)
+            return jax.nn.log_softmax(h, axis=-1), state
+
+        return (lambda: bs(step_fn, (), batch_size=8, beam_size=4,
+                           bos_id=1, eos_id=2, max_len=32)[0])
+
     return {"matmul": matmul, "conv2d": conv2d, "softmax": softmax,
             "layer_norm": layer_norm, "attention": attention,
-            "embedding": embedding, "reduce_sum": reduce_sum}
+            "embedding": embedding, "reduce_sum": reduce_sum,
+            "deform_conv2d": deform_conv2d, "grid_sample": grid_sample,
+            "beam_search": beam_search}
 
 
 def bench_ops(ops: Optional[Sequence[str]] = None,
