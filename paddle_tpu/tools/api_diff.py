@@ -1,0 +1,118 @@
+"""API-surface diff against the reference source tree.
+
+Mirrors the reference's signature-freeze gate
+(tools/print_signatures.py + check_api_compatible.py, SURVEY §4 CI
+tooling): AST-parse the reference's `__init__`/module files for their
+public names and report anything missing from the corresponding
+paddle_tpu namespace. `tests/test_api_parity.py` gates the top level in
+CI; this tool sweeps every sub-namespace for round-over-round audits.
+
+Usage:
+    python -m paddle_tpu.tools.api_diff [--ref /root/reference]
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+
+
+def ref_public_names(path: str, prefer_all: bool = True):
+    """Names a reference module exports: __all__ when present, else its
+    top-level explicit imports."""
+    try:
+        tree = ast.parse(open(path).read())
+    except (OSError, SyntaxError):
+        return None
+    all_names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", "") == "__all__":
+                    try:
+                        all_names |= set(ast.literal_eval(node.value))
+                    except ValueError:
+                        pass
+    if all_names and prefer_all:
+        return {n for n in all_names if not n.startswith("_")}
+    names = set(all_names)
+    for node in tree.body:
+        if isinstance(node, ast.ImportFrom) and node.names:
+            for a in node.names:
+                if a.name != "*":
+                    names.add(a.asname or a.name)
+    return {n for n in names if not n.startswith("_")}
+
+
+#: (display name, reference path relative to python/paddle/, attr path)
+NAMESPACES = [
+    ("paddle", "__init__.py", ""),
+    ("nn", "nn/__init__.py", "nn"),
+    ("nn.functional", "nn/functional/__init__.py", "nn.functional"),
+    ("nn.initializer", "nn/initializer/__init__.py", "nn.initializer"),
+    ("io", "io/__init__.py", "io"),
+    ("static", "static/__init__.py", "static"),
+    ("static.nn", "static/nn/__init__.py", "static.nn"),
+    ("distributed", "distributed/__init__.py", "distributed"),
+    ("distributed.fleet", "distributed/fleet/__init__.py",
+     "distributed.fleet"),
+    ("vision", "vision/__init__.py", "vision"),
+    ("vision.models", "vision/models/__init__.py", "vision.models"),
+    ("vision.ops", "vision/ops.py", "vision.ops"),
+    ("vision.transforms", "vision/transforms/__init__.py",
+     "vision.transforms"),
+    ("vision.datasets", "vision/datasets/__init__.py", "vision.datasets"),
+    ("text", "text/__init__.py", "text"),
+    ("metric", "metric/__init__.py", "metric"),
+    ("optimizer", "optimizer/__init__.py", "optimizer"),
+    ("optimizer.lr", "optimizer/lr.py", "optimizer.lr"),
+    ("amp", "amp/__init__.py", "amp"),
+    ("inference", "inference/__init__.py", "inference"),
+    ("jit", "fluid/dygraph/jit.py", "jit"),
+    ("utils", "utils/__init__.py", "utils"),
+    ("incubate", "incubate/__init__.py", "incubate"),
+    ("distribution", "distribution.py", "distribution"),
+]
+
+
+def run_diff(ref_root: str, out=sys.stdout) -> int:
+    import paddle_tpu
+
+    total_missing = 0
+    for display, rel, attr in NAMESPACES:
+        path = os.path.join(ref_root, "python", "paddle", rel)
+        names = ref_public_names(path)
+        if names is None:
+            print(f"{display}: SKIP (no {rel})", file=out)
+            continue
+        mod = paddle_tpu
+        for part in attr.split("."):
+            if part:
+                mod = getattr(mod, part, None)
+            if mod is None:
+                break
+        if mod is None:
+            print(f"{display}: namespace MISSING entirely "
+                  f"({len(names)} names)", file=out)
+            total_missing += len(names)
+            continue
+        missing = sorted(n for n in names if not hasattr(mod, n))
+        total_missing += len(missing)
+        status = "OK" if not missing else f"missing {missing}"
+        print(f"{display}: {len(names)} names, {status}", file=out)
+    print(f"TOTAL missing: {total_missing}", file=out)
+    return total_missing
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ref", default="/root/reference",
+                    help="reference source tree root")
+    args = ap.parse_args(argv)
+    missing = run_diff(args.ref)
+    return 1 if missing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
