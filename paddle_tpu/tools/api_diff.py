@@ -76,15 +76,19 @@ NAMESPACES = [
 ]
 
 
-def run_diff(ref_root: str, out=sys.stdout) -> int:
+def run_diff(ref_root: str, out=sys.stdout):
+    """Returns (total_missing, skipped): a CI gate must fail on EITHER —
+    a skipped namespace means the sweep silently stopped checking it."""
     import paddle_tpu
 
     total_missing = 0
+    skipped = 0
     for display, rel, attr in NAMESPACES:
         path = os.path.join(ref_root, "python", "paddle", rel)
         names = ref_public_names(path)
         if names is None:
-            print(f"{display}: SKIP (no {rel})", file=out)
+            print(f"{display}: SKIP (no/unparseable {rel})", file=out)
+            skipped += 1
             continue
         mod = paddle_tpu
         for part in attr.split("."):
@@ -101,8 +105,9 @@ def run_diff(ref_root: str, out=sys.stdout) -> int:
         total_missing += len(missing)
         status = "OK" if not missing else f"missing {missing}"
         print(f"{display}: {len(names)} names, {status}", file=out)
-    print(f"TOTAL missing: {total_missing}", file=out)
-    return total_missing
+    print(f"TOTAL missing: {total_missing} (skipped namespaces: "
+          f"{skipped})", file=out)
+    return total_missing, skipped
 
 
 def main(argv=None):
@@ -110,8 +115,8 @@ def main(argv=None):
     ap.add_argument("--ref", default="/root/reference",
                     help="reference source tree root")
     args = ap.parse_args(argv)
-    missing = run_diff(args.ref)
-    return 1 if missing else 0
+    missing, skipped = run_diff(args.ref)
+    return 1 if (missing or skipped) else 0
 
 
 if __name__ == "__main__":
