@@ -10,7 +10,7 @@ current one is consumed.
 from __future__ import annotations
 
 from collections import deque
-from typing import Iterable, Iterator, Optional
+from typing import Iterable, Iterator
 
 import jax
 

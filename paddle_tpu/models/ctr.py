@@ -13,7 +13,7 @@ sharded table (`distributed/ps/table.py`) with pulled rows.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp
