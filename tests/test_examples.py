@@ -59,3 +59,10 @@ def test_label_semantic_roles_example():
     import label_semantic_roles
     l0, l1, acc = label_semantic_roles.main(steps=50)
     assert l1 < l0
+
+
+def test_ocr_pipeline_example():
+    import ocr_pipeline
+    l0, l1, boxes = ocr_pipeline.main(steps=25)
+    assert l1 < l0
+    assert boxes, "detector found no box"
