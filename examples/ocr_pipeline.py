@@ -64,19 +64,17 @@ def main(steps=30):
     x0, y0, x1, y1 = boxes[0] if boxes else (0, 0, 15, 7)
     # map /4-scale box back to pixels, crop, resize to the rec input
     crop = img[:, :, y0 * 4:(y1 + 1) * 4, x0 * 4:(x1 + 1) * 4]
-    from paddle_tpu.vision.transforms import _resize_np
+    from paddle_tpu.vision.transforms import Resize
+    resize = Resize((32, 100))
     crop_hw = np.stack([
-        _resize_np(c.transpose(1, 2, 0), (32, 100)).transpose(2, 0, 1)
-        for c in crop])
+        resize(c.transpose(1, 2, 0)).transpose(2, 0, 1) for c in crop])
 
     rec = crnn_ocr(num_classes=37)
     rec.eval()
     out = rec(paddle.to_tensor(crop_hw.astype(np.float32)))
     logits = out[0] if isinstance(out, (list, tuple)) else out
-    pred_ids = np.asarray(logits).argmax(-1)[:, 0]   # [T] greedy path
-    # CTC collapse: drop repeats + blanks (blank = num_classes - 1)
-    text = [int(t) for i, t in enumerate(pred_ids)
-            if t != 36 and (i == 0 or t != pred_ids[i - 1])]
+    decoded = np.asarray(rec.decode_greedy(logits))[0]   # [T], -1 padded
+    text = [int(t) for t in decoded if t >= 0]
     print(f"det loss {losses[0]:.3f} -> {losses[-1]:.3f}; "
           f"box {boxes[:1]}; rec tokens {text[:8]}")
     return losses[0], losses[-1], boxes

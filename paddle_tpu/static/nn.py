@@ -25,8 +25,28 @@ def _is_traced(x) -> bool:
     return isinstance(x, jax.core.Tracer)
 
 
+def _is_static_var(x) -> bool:
+    from .program import Variable
+    return isinstance(x, Variable)
+
+
 def cond(pred, true_fn: Callable, false_fn: Callable, name=None):
-    """Reference: control_flow.py:2295."""
+    """Reference: control_flow.py:2295.
+
+    Three modes: concrete predicate -> run the branch eagerly; traced
+    predicate (inside jit/to_static) -> `lax.cond`; build-time static
+    Variable -> BOTH branches are recorded and the result is selected
+    (`jnp.where`) — XLA's select semantics, so branch bodies must be
+    side-effect-free beyond recording ops."""
+    if _is_static_var(pred):
+        from .program import record
+        tv, fv = true_fn(), false_fn()
+
+        def select(p, a, b):
+            import jax.numpy as jnp
+            return jnp.where(p, a, b)
+
+        return record(select, (pred, tv, fv), {}, hint="cond")
     if not _is_traced(pred):
         return true_fn() if bool(pred) else false_fn()
     return lax.cond(pred, lambda _: true_fn(), lambda _: false_fn(),
@@ -37,6 +57,12 @@ def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars,
                is_test=False, name=None):
     """Reference: control_flow.py:1115. loop_vars is a list/tuple pytree."""
     loop_vars = tuple(loop_vars)
+    if any(_is_static_var(v) for v in loop_vars):
+        raise NotImplementedError(
+            "static.nn.while_loop over build-time Variables needs "
+            "sub-program capture, which the record/replay engine does "
+            "not implement; run the loop inside @paddle.jit.to_static "
+            "(where it lowers to lax.while_loop) instead.")
 
     concrete = not any(_is_traced(v) for v in jax.tree.leaves(loop_vars))
     if concrete:
@@ -64,6 +90,14 @@ def case(pred_fn_pairs: Sequence[Tuple], default: Callable = None,
     fns = [f for _, f in pred_fn_pairs]
     if default is None:
         default = fns[-1]
+    if any(_is_static_var(p) for p in preds):
+        # build-time Variables: all branches recorded, nested select
+        from .program import record
+        out = default()
+        for p, f in reversed(pred_fn_pairs):
+            out = record(lambda c, a, b: jnp.where(c, a, b),
+                         (p, f(), out), {}, hint="case")
+        return out
     if not any(_is_traced(p) for p in preds):
         for p, f in pred_fn_pairs:
             if bool(p):
@@ -96,6 +130,15 @@ def switch_case(branch_index, branch_fns, default: Callable = None,
     fns = [mapping[k] for k in keys]
     if default is None:
         default = fns[-1]
+    if _is_static_var(branch_index):
+        from .program import record
+        out = default()
+        for k in reversed(keys):
+            out = record(
+                lambda idx, a, b, _k=k: jnp.where(idx == _k, a, b),
+                (branch_index, mapping[k](), out), {},
+                hint="switch_case")
+        return out
     if not _is_traced(branch_index):
         i = int(branch_index)
         return mapping[i]() if i in mapping else default()

@@ -114,6 +114,18 @@ class Variable:
     def __ge__(self, o):
         return self._binop(o, lambda a, b: a >= b)
 
+    def __bool__(self):
+        # reference: fluid Variable raises in conditionals — a symbolic
+        # value has no build-time truth; silently taking one branch
+        # would record the wrong program
+        raise TypeError(
+            f"static.Variable {self.name!r} cannot be used as a Python "
+            "bool during program construction. Use "
+            "paddle.static.nn.cond/case (both-branches-compute + select "
+            "over recorded Variables), paddle.where for elementwise "
+            "selection, or @paddle.jit.to_static (dy2static) for Python "
+            "if/while; loops over build-time Variables need to_static.")
+
 
 class Operator:
     """One recorded call: `fn(params?, buffers?, *inputs, **attrs)`.

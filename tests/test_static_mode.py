@@ -564,3 +564,55 @@ class TestReviewRegressions:
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
         # batch polymorphism: a different batch size works
         assert np.asarray(loaded(X[:5])).shape == (5, 1)
+
+    def test_variable_bool_raises(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 2], "float32")
+            cond_v = paddle.mean(x) > 0
+            with pytest.raises(TypeError, match="cond/case"):
+                if cond_v:       # the silent-wrong-branch trap
+                    pass
+
+    def test_cond_over_static_variable(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 2], "float32")
+            pred = paddle.mean(x) > 0
+            out = paddle.static.nn.cond(pred,
+                                        lambda: paddle.mean(x) * 2.0,
+                                        lambda: paddle.mean(x) - 10.0)
+        exe = paddle.static.Executor()
+        (a,) = exe.run(main, feed={"x": np.full((2, 2), 3.0, np.float32)},
+                       fetch_list=[out])
+        assert abs(float(a) - 6.0) < 1e-5        # true branch selected
+        (b,) = exe.run(main,
+                       feed={"x": np.full((2, 2), -1.0, np.float32)},
+                       fetch_list=[out])
+        assert abs(float(b) - (-11.0)) < 1e-5    # false branch selected
+
+    def test_case_over_static_variables(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None], "float32")
+            m = paddle.mean(x)
+            out = paddle.static.nn.case(
+                [(m > 10.0, lambda: m * 100.0),
+                 (m > 0.0, lambda: m * 2.0)],
+                default=lambda: m - 1.0)
+        exe = paddle.static.Executor()
+        run = lambda v: float(exe.run(
+            main, feed={"x": np.full((4,), v, np.float32)},
+            fetch_list=[out])[0])
+        assert abs(run(20.0) - 2000.0) < 1e-3
+        assert abs(run(3.0) - 6.0) < 1e-5
+        assert abs(run(-2.0) - (-3.0)) < 1e-5
+
+    def test_while_loop_static_var_raises(self, static_mode):
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None], "float32")
+            m = paddle.mean(x)
+            with pytest.raises(NotImplementedError, match="to_static"):
+                paddle.static.nn.while_loop(lambda v: v < 10,
+                                            lambda v: v + 1, [m])
