@@ -77,10 +77,10 @@ def is_integer(dtype) -> bool:
     return jnp.issubdtype(jnp.dtype(convert_dtype(dtype)), jnp.integer)
 
 
-def set_default_dtype(dtype):
-    """paddle.set_default_dtype equivalent."""
+def set_default_dtype(d):
+    """paddle.set_default_dtype equivalent (ref: framework/framework.py:25)."""
     global _default_dtype
-    dtype = convert_dtype(dtype)
+    dtype = convert_dtype(d)
     if dtype not in (float16, bfloat16, float32, float64):
         raise TypeError("set_default_dtype only accepts floating dtypes")
     _default_dtype = dtype

@@ -70,7 +70,8 @@ def _in_trace(x) -> bool:
     return isinstance(x, jax.core.Tracer)
 
 
-def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=True):
     """Reference: c_allreduce_{sum,max,min,prod}."""
     axis = _axis(group)
     if _in_trace(tensor):
@@ -90,17 +91,17 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     return tensor  # eager global view: already reduced/replicated
 
 
-def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True,
-               axis: int = 0):
+def all_gather(tensor_list, tensor=None, group=None, sync_op=True,
+               use_calc_stream=True, axis: int = 0):
     """Reference: c_allgather. Functional form returns the gathered array;
-    the paddle list-out form appends to `tensor_or_list`."""
-    if isinstance(tensor_or_list, list):
+    the paddle list-out form appends to `tensor_list`."""
+    if isinstance(tensor_list, list):
         t = tensor
         out = _all_gather_impl(t, group, axis)
         n = out.shape[axis] // t.shape[axis] if t.shape else 1
-        tensor_or_list.extend(jnp.split(out, n, axis=axis))
-        return tensor_or_list
-    return _all_gather_impl(tensor_or_list, group, axis)
+        tensor_list.extend(jnp.split(out, n, axis=axis))
+        return tensor_list
+    return _all_gather_impl(tensor_list, group, axis)
 
 
 def _all_gather_impl(tensor, group, axis):
@@ -125,7 +126,8 @@ def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, axis: int = 0):
     return tensor
 
 
-def broadcast(tensor, src=0, group=None, sync_op=True):
+def broadcast(tensor, src=0, group=None, sync_op=True,
+              use_calc_stream=True):
     """Reference: c_broadcast. Under SPMD every device computes the same
     program, so broadcast is realized by selecting src's shard."""
     ax = _axis(group)
@@ -139,13 +141,15 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     return tensor
 
 
-def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True,
+           use_calc_stream=True):
     """Reference: c_reduce_*. SPMD form: psum everywhere (result only
     meaningful on dst, same contract as NCCL reduce)."""
     return all_reduce(tensor, op=op, group=group)
 
 
-def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True,
+            use_calc_stream=True):
     ax = _axis(group)
     if tensor_list is not None and not _in_trace(tensor):
         return tensor_list[get_rank()]
@@ -160,7 +164,8 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     return tensor
 
 
-def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+def alltoall(in_tensor_list, out_tensor_list=None, group=None,
+             sync_op=True, use_calc_stream=True):
     """Reference: alltoall_op. Traced form over a mesh axis uses
     lax.all_to_all; this is the building block for Ulysses sequence
     parallelism (see distributed/sequence_parallel.py)."""
@@ -196,13 +201,13 @@ def all_to_all_single(tensor, group=None, split_axis=0, concat_axis=0):
     return tensor
 
 
-def send(tensor, dst=0, group=None, sync_op=True):
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=True):
     """Reference: send_v2. SPMD equivalent is a collective_permute — use
     `p2p_push` with an explicit perm inside shard_map."""
     return tensor
 
 
-def recv(tensor, src=0, group=None, sync_op=True):
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=True):
     return tensor
 
 

@@ -16,7 +16,14 @@ from .param_attr import ParamAttr  # noqa: F401
 # Reference scripts manage the device RNG stream separately
 # (paddle.get/set_cuda_rng_state); here there is ONE functional key stream.
 get_cuda_rng_state = get_rng_state
-set_cuda_rng_state = set_rng_state
+
+
+def set_cuda_rng_state(state_list):
+    """Reference: framework/random.py:80 (per-device state list); the
+    single functional key stream takes one state."""
+    if isinstance(state_list, (list, tuple)) and state_list:
+        state_list = state_list[0]
+    return set_rng_state(state_list)
 
 
 def create_parameter(shape, dtype="float32", name=None, attr=None,
@@ -58,7 +65,9 @@ def enable_static():
     _static_mode = True
 
 
-def disable_static():
+def disable_static(place=None):
+    """`place` selects the eager device in the reference; device
+    placement here is jax-managed, so it is accepted and unused."""
     global _static_mode
     _static_mode = False
 

@@ -28,9 +28,10 @@ def _global():
     return _state
 
 
-def seed(value: int):
-    """paddle.seed equivalent."""
-    _global().key = jax.random.key(int(value))
+def seed(seed):
+    """paddle.seed equivalent (ref: framework/random.py:22)."""
+    _global().key = jax.random.key(int(seed))
+    return _global().key
 
 
 def next_key():

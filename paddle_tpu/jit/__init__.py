@@ -296,10 +296,16 @@ class TracedLayer:
     `trace` jit-compiles the layer on example inputs; the traced object
     runs the compiled path and `save_inference_model` exports StableHLO."""
 
-    def __init__(self, layer, static_fn, example_inputs):
-        self._layer = layer
-        self._fn = static_fn
-        self._example = example_inputs
+    def __init__(self, program, parameters=None, feed_names=None,
+                 fetch_names=None):
+        # reference ctor contract: (program, parameters, feed/fetch
+        # names). Here `program` is the compiled callable (or the traced
+        # Layer — TracedLayer.trace passes both), `parameters` the
+        # source Layer, `feed_names` the example inputs.
+        self._layer = parameters
+        self._fn = program
+        self._example = feed_names
+        self._fetch = fetch_names
 
     @staticmethod
     def trace(layer, inputs):
@@ -314,7 +320,7 @@ class TracedLayer:
             out, _ = functional_call(layer, params, *args, buffers=buffers)
             return out
 
-        traced = TracedLayer(layer, fn, inputs)
+        traced = TracedLayer(fn, layer, inputs)
         return traced(*inputs), traced
 
     def __call__(self, *args):

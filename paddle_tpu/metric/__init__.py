@@ -143,7 +143,7 @@ class Auc(Metric):
         return auc / (tp * fp)
 
 
-def accuracy(input, label, k=1, correct=None, total=None):
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
     """Functional top-k accuracy (reference: `paddle.metric.accuracy`,
     metrics/accuracy_op). input: [N, C] scores; label: [N] or [N, 1]."""
     input = jnp.asarray(input)

@@ -43,13 +43,38 @@ def _complete_tree_codes(num_classes: int):
     return jnp.asarray(paths), jnp.asarray(bits), jnp.asarray(mask)
 
 
-def hsigmoid_loss(x, labels, num_classes: int, weight, bias=None):
+def hsigmoid_loss(input, label, num_classes: int, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
     """Hierarchical sigmoid loss (`hierarchical_sigmoid_op.cc`).
 
-    x: [B, D]; labels: [B] int; weight: [num_classes-1, D] internal-node
-    vectors; bias: [num_classes-1]. Returns per-example loss [B].
-    Cost O(B * log C * D) vs softmax's O(B * C * D).
+    input: [B, D]; label: [B] int; weight: [num_classes-1, D]
+    internal-node vectors; bias: [num_classes-1]. Returns per-example
+    loss [B]. Cost O(B * log C * D) vs softmax's O(B * C * D).
+    path_table/path_code override the complete-tree codes with a custom
+    tree (reference's custom-tree mode); is_sparse selects the sparse
+    weight-update kernel in the reference and is a no-op under jit.
     """
+    x, labels = input, label
+    if path_table is not None or path_code is not None:
+        if path_table is None or path_code is None:
+            raise ValueError("custom-tree hsigmoid_loss needs BOTH "
+                             "path_table and path_code (reference "
+                             "contract: per-sample [N, L] tables)")
+        # paddle contract: per-sample tables, path_table/path_code are
+        # [N, L] aligned with `label`'s batch; -1 pads short paths
+        paths = jnp.asarray(path_table)
+        codes = jnp.asarray(path_code)
+        valid = (paths >= 0)
+        p = jnp.where(valid, paths, 0)
+        w = weight.value if hasattr(weight, "value") else weight
+        wv = w[p]                      # [B, depth, D]
+        logits = jnp.einsum("bd,bkd->bk", x, wv)
+        if bias is not None:
+            bv = bias.value if hasattr(bias, "value") else bias
+            logits = logits + bv[p]
+        ll = jax.nn.log_sigmoid(jnp.where(codes > 0, logits, -logits))
+        return -jnp.sum(ll * valid.astype(ll.dtype), axis=-1)
     paths, bits, mask = _complete_tree_codes(num_classes)
     p = paths[labels]            # [B, depth]
     b = bits[labels]             # [B, depth]

@@ -11,28 +11,28 @@ import jax
 import jax.numpy as jnp
 
 
-def relu(x):
+def relu(x, name=None):
     return jax.nn.relu(x)
 
 
-def relu6(x):
+def relu6(x, name=None):
     return jax.nn.relu6(x)
 
 
-def leaky_relu(x, negative_slope=0.01):
+def leaky_relu(x, negative_slope=0.01, name=None):
     return jax.nn.leaky_relu(x, negative_slope)
 
 
-def prelu(x, weight):
+def prelu(x, weight, name=None):
     w = weight.value if hasattr(weight, "value") else weight
     return jnp.where(x > 0, x, w * x)
 
 
-def elu(x, alpha=1.0):
+def elu(x, alpha=1.0, name=None):
     return jax.nn.elu(x, alpha)
 
 
-def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
     return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
 
 
@@ -40,11 +40,11 @@ def celu(x, alpha=1.0):
     return jax.nn.celu(x, alpha)
 
 
-def gelu(x, approximate=False):
+def gelu(x, approximate=False, name=None):
     return jax.nn.gelu(x, approximate=approximate)
 
 
-def silu(x):
+def silu(x, name=None):
     return jax.nn.silu(x)
 
 
@@ -59,45 +59,45 @@ def sigmoid(x):
     return jax.nn.sigmoid(x)
 
 
-def hardsigmoid(x, slope=0.1666667, offset=0.5):
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
     return jnp.clip(slope * x + offset, 0.0, 1.0)
 
 
-def hardswish(x):
+def hardswish(x, name=None):
     return x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
 
 
-def hardtanh(x, min=-1.0, max=1.0):
+def hardtanh(x, min=-1.0, max=1.0, name=None):
     return jnp.clip(x, min, max)
 
 
-def hardshrink(x, threshold=0.5):
+def hardshrink(x, threshold=0.5, name=None):
     return jnp.where(jnp.abs(x) > threshold, x, 0.0)
 
 
-def softshrink(x, threshold=0.5):
+def softshrink(x, threshold=0.5, name=None):
     return jnp.where(x > threshold, x - threshold,
                      jnp.where(x < -threshold, x + threshold, 0.0))
 
 
-def tanhshrink(x):
+def tanhshrink(x, name=None):
     return x - jnp.tanh(x)
 
 
-def tanh(x):
+def tanh(x, name=None):
     return jnp.tanh(x)
 
 
-def softplus(x, beta=1.0, threshold=20.0):
+def softplus(x, beta=1.0, threshold=20.0, name=None):
     return jnp.where(beta * x > threshold, x,
                      jnp.log1p(jnp.exp(beta * jnp.minimum(x, threshold / beta))) / beta)
 
 
-def softsign(x):
+def softsign(x, name=None):
     return jax.nn.soft_sign(x)
 
 
-def maxout(x, groups, axis=1):
+def maxout(x, groups, axis=1, name=None):
     shape = list(x.shape)
     ch = shape[axis]
     shape[axis] = ch // groups
@@ -105,14 +105,14 @@ def maxout(x, groups, axis=1):
     return jnp.max(jnp.reshape(x, shape), axis=axis + 1)
 
 
-def softmax(x, axis=-1, dtype=None):
+def softmax(x, axis=-1, dtype=None, name=None):
     from ...core.dtypes import convert_dtype
     if dtype is not None:
         x = x.astype(convert_dtype(dtype))
     return jax.nn.softmax(x, axis=axis)
 
 
-def log_softmax(x, axis=-1, dtype=None):
+def log_softmax(x, axis=-1, dtype=None, name=None):
     from ...core.dtypes import convert_dtype
     if dtype is not None:
         x = x.astype(convert_dtype(dtype))
@@ -133,16 +133,16 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
     return y
 
 
-def glu(x, axis=-1):
+def glu(x, axis=-1, name=None):
     a, b = jnp.split(x, 2, axis=axis)
     return a * jax.nn.sigmoid(b)
 
 
-def thresholded_relu(x, threshold=1.0):
+def thresholded_relu(x, threshold=1.0, name=None):
     return jnp.where(x > threshold, x, 0.0)
 
 
-def log_sigmoid(x):
+def log_sigmoid(x, name=None):
     return jax.nn.log_sigmoid(x)
 
 
@@ -151,4 +151,7 @@ def log_sigmoid(x):
 relu_ = relu
 elu_ = elu
 softmax_ = softmax
-tanh_ = jnp.tanh
+
+
+def tanh_(x, name=None):
+    return jnp.tanh(x)

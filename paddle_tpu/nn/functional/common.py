@@ -12,7 +12,7 @@ import jax.numpy as jnp
 from ...framework.random import next_key
 
 
-def linear(x, weight, bias=None):
+def linear(x, weight, bias=None, name=None):
     """y = x @ W + b. Weight layout [in, out] as in the reference
     (`matmul` with the stored layout; no transpose → clean MXU mapping)."""
     from ...amp.auto_cast import maybe_autocast
@@ -25,7 +25,7 @@ def linear(x, weight, bias=None):
     return y
 
 
-def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train"):
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
     """Reference: dropout_op. `upscale_in_train` (default) scales by 1/(1-p)
     at train time; `downscale_in_infer` scales by (1-p) at eval."""
     if p == 0.0:
@@ -44,17 +44,17 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train"):
     return jnp.where(keep, x, 0.0).astype(x.dtype)
 
 
-def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
     axis = (0, 1) if data_format == "NCHW" else (0, 3)
     return dropout(x, p=p, axis=axis, training=training)
 
 
-def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
     axis = (0, 1) if data_format == "NCDHW" else (0, 4)
     return dropout(x, p=p, axis=axis, training=training)
 
 
-def alpha_dropout(x, p=0.5, training=True):
+def alpha_dropout(x, p=0.5, training=True, name=None):
     if not training or p == 0.0:
         return x
     alpha = 1.6732632423543772
@@ -66,7 +66,7 @@ def alpha_dropout(x, p=0.5, training=True):
     return (a * jnp.where(keep, x, alpha_p) + b).astype(x.dtype)
 
 
-def embedding(x, weight, padding_idx=None, sparse=False):
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     """Reference: lookup_table_v2_op. Gather along vocab dim; `sparse` is
     accepted for parity (XLA gather handles both)."""
     w = weight.value if hasattr(weight, "value") else weight
@@ -77,11 +77,11 @@ def embedding(x, weight, padding_idx=None, sparse=False):
     return out
 
 
-def one_hot(x, num_classes):
+def one_hot(x, num_classes, name=None):
     return jax.nn.one_hot(x, num_classes)
 
 
-def label_smooth(label, prior_dist=None, epsilon=0.1):
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
     k = label.shape[-1]
     if prior_dist is None:
         return (1.0 - epsilon) * label + epsilon / k
@@ -89,8 +89,12 @@ def label_smooth(label, prior_dist=None, epsilon=0.1):
 
 
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
-                align_corners=False, data_format="NCHW"):
-    """Reference: interpolate_v2 (bilinear/nearest/bicubic...)."""
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    """Reference: interpolate_v2 (bilinear/nearest/bicubic...).
+    `align_mode` selects the src-index formula when align_corners is
+    False; jax.image.resize implements mode 1 (pixel-center) semantics,
+    which is what the reference's default-path models use."""
     is_nchw = data_format in ("NCHW", "NCDHW", "NCL")
     spatial = x.shape[2:] if is_nchw else x.shape[1:-1]
     if size is None:
@@ -109,9 +113,10 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
 
 
 def upsample(x, size=None, scale_factor=None, mode="nearest",
-             align_corners=False, data_format="NCHW"):
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
     return interpolate(x, size, scale_factor, mode, align_corners,
-                       data_format)
+                       align_mode, data_format)
 
 
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
@@ -140,7 +145,7 @@ def _pair(v):
     return (v, v)
 
 
-def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
     from ...tensor.manipulation import pad as _tensor_pad
     return _tensor_pad(x, pad, mode=mode, value=value,
                        data_format=data_format)
@@ -153,7 +158,7 @@ def cosine_similarity(x1, x2, axis=1, eps=1e-8):
     return dot / jnp.maximum(n1 * n2, eps)
 
 
-def bilinear(x1, x2, weight, bias=None):
+def bilinear(x1, x2, weight, bias=None, name=None):
     w = weight.value if hasattr(weight, "value") else weight
     out = jnp.einsum("bi,oij,bj->bo", x1, w, x2)
     if bias is not None:
@@ -162,7 +167,7 @@ def bilinear(x1, x2, weight, bias=None):
     return out
 
 
-def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
     r = upscale_factor
     if data_format == "NCHW":
         n, c, h, w = x.shape
@@ -196,9 +201,9 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
     return out
 
 
-def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
     """Reference: `paddle.nn.functional.diag_embed` (diag_embed_op)."""
-    x = jnp.asarray(x)
+    x = jnp.asarray(input)
     last = x.shape[-1]
     size = last + abs(offset)
     idx = jnp.arange(last)
@@ -214,7 +219,7 @@ def diag_embed(x, offset=0, dim1=-2, dim2=-1):
     return out
 
 
-def affine_grid(theta, out_shape, align_corners=True):
+def affine_grid(theta, out_shape, align_corners=True, name=None):
     """Reference: `affine_grid_op.cc`. theta [N, 2, 3]; out_shape
     [N, C, H, W] -> grid [N, H, W, 2] of (x, y) source coords in [-1, 1]."""
     n, _, h, w = [int(s) for s in out_shape]
@@ -237,7 +242,7 @@ def affine_grid(theta, out_shape, align_corners=True):
 
 
 def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
-                align_corners=True):
+                align_corners=True, name=None):
     """Reference: `grid_sampler_op.cc` (cuDNN SpatialTfSampler). x
     [N, C, H, W]; grid [N, Hg, Wg, 2] of (x, y) in [-1, 1]."""
     if padding_mode not in ("zeros", "border"):

@@ -99,39 +99,75 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n,
 
 
 def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
-           data_format="NCL"):
+           data_format="NCL", name=None):
     return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
                  data_format)
 
 
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
-           data_format="NCHW"):
+           data_format="NCHW", name=None):
     return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
                  data_format)
 
 
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
-           data_format="NCDHW"):
+           data_format="NCDHW", name=None):
     return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
                  data_format)
 
 
+def _outpad_for_size(x, weight, stride, padding, dilation, output_size, n,
+                     data_format):
+    """Back out the output_padding that yields `output_size` (reference:
+    conv2d_transpose's output_size argument, conv_transpose_op.cc)."""
+    stride = _tuple(stride, n)
+    dilation = _tuple(dilation, n)
+    pad = _padding(padding, n)
+    if isinstance(pad, str):
+        raise ValueError("output_size with string padding is unsupported")
+    channel_last = data_format in ("NHWC", "NDHWC", "NLC")
+    ins = x.shape[1:1 + n] if channel_last else x.shape[2:2 + n]
+    size = _tuple(output_size, n)
+    out_pad = []
+    for i in range(n):
+        k = (weight.shape[2 + i] - 1) * dilation[i] + 1
+        base = (ins[i] - 1) * stride[i] - pad[i][0] - pad[i][1] + k
+        op = size[i] - base
+        if not 0 <= op < stride[i] + dilation[i]:
+            raise ValueError(f"output_size[{i}]={size[i]} unreachable "
+                             f"(base {base}, stride {stride[i]})")
+        out_pad.append(op)
+    return tuple(out_pad)
+
+
 def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
-                     output_padding=0, dilation=1, groups=1,
-                     data_format="NCL"):
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    if output_size is not None:
+        output_padding = _outpad_for_size(x, weight, stride, padding,
+                                          dilation, output_size, 1,
+                                          data_format)
     return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
                  data_format, transpose=True, output_padding=output_padding)
 
 
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1,
-                     data_format="NCHW"):
+                     output_size=None, data_format="NCHW", name=None):
+    if output_size is not None:
+        output_padding = _outpad_for_size(x, weight, stride, padding,
+                                          dilation, output_size, 2,
+                                          data_format)
     return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
                  data_format, transpose=True, output_padding=output_padding)
 
 
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
-                     output_padding=0, dilation=1, groups=1,
-                     data_format="NCDHW"):
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    if output_size is not None:
+        output_padding = _outpad_for_size(x, weight, stride, padding,
+                                          dilation, output_size, 3,
+                                          data_format)
     return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
                  data_format, transpose=True, output_padding=output_padding)

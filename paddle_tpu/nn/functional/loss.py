@@ -22,7 +22,7 @@ def _reduce(loss, reduction):
 
 def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction="mean", soft_label=False, axis=-1,
-                  use_softmax=True, label_smoothing=0.0):
+                  use_softmax=True, label_smoothing=0.0, name=None):
     """Reference: `softmax_with_cross_entropy` (fused)."""
     if use_softmax:
         logp = jax.nn.log_softmax(input, axis=axis)
@@ -64,8 +64,12 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False,
-                               ignore_index=-100, axis=-1,
-                               return_softmax=False):
+                               ignore_index=-100,
+                               numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    """`numeric_stable_mode` is the reference's kernel toggle
+    (softmax_with_cross_entropy_op.cu); the XLA lowering is always the
+    stable log-sum-exp form."""
     loss = cross_entropy(logits, label, soft_label=soft_label,
                          ignore_index=ignore_index, reduction="none",
                          axis=axis)
@@ -75,7 +79,7 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
     return loss
 
 
-def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
     """`input` is LOG-probabilities (paddle contract: pair with
     log_softmax) — no further log is applied."""
     label = label.astype(jnp.int32)
@@ -97,22 +101,22 @@ def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
     return _reduce(loss, reduction)
 
 
-def mse_loss(input, label, reduction="mean"):
+def mse_loss(input, label, reduction="mean", name=None):
     return _reduce(jnp.square(input - label), reduction)
 
 
-def l1_loss(input, label, reduction="mean"):
+def l1_loss(input, label, reduction="mean", name=None):
     return _reduce(jnp.abs(input - label), reduction)
 
 
-def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
     diff = jnp.abs(input - label)
     loss = jnp.where(diff < delta, 0.5 * diff * diff / delta,
                      diff - 0.5 * delta)
     return _reduce(loss, reduction)
 
 
-def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
     eps = 1e-12
     loss = -(label * jnp.log(jnp.clip(input, eps, 1.0)) +
              (1.0 - label) * jnp.log(jnp.clip(1.0 - input, eps, 1.0)))
@@ -122,7 +126,7 @@ def binary_cross_entropy(input, label, weight=None, reduction="mean"):
 
 
 def binary_cross_entropy_with_logits(logit, label, weight=None,
-                                     reduction="mean", pos_weight=None):
+                                     reduction="mean", pos_weight=None, name=None):
     max_val = jnp.clip(-logit, 0, None)
     if pos_weight is not None:
         log_weight = (pos_weight - 1.0) * label + 1.0
@@ -136,14 +140,14 @@ def binary_cross_entropy_with_logits(logit, label, weight=None,
     return _reduce(loss, reduction)
 
 
-def kl_div(input, label, reduction="mean"):
+def kl_div(input, label, reduction="mean", name=None):
     loss = label * (jnp.log(jnp.clip(label, 1e-12, None)) - input)
     if reduction == "batchmean":
         return jnp.sum(loss) / input.shape[0]
     return _reduce(loss, reduction)
 
 
-def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
     loss = jnp.clip(-label * (input - other) + margin, 0, None)
     return _reduce(loss, reduction)
 
@@ -181,7 +185,7 @@ def square_error_cost(input, label):
 
 
 def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
-                       reduction="sum"):
+                       reduction="sum", name=None):
     p = jax.nn.sigmoid(logit)
     ce = binary_cross_entropy_with_logits(logit, label, reduction="none")
     p_t = p * label + (1.0 - p) * (1.0 - label)
@@ -194,8 +198,10 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
 
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
-             reduction="mean"):
-    """Reference: warpctc_op. Uses a dense alpha-recursion over lax.scan."""
+             reduction="mean", norm_by_times=False):
+    """Reference: warpctc_op. Uses a dense alpha-recursion over lax.scan.
+    norm_by_times divides each sequence loss by its input length before
+    reduction (warpctc's norm_by_times flag)."""
     # log_probs: [T, B, C]; labels: [B, S]
     T, B, C = log_probs.shape
     S = labels.shape[1]
@@ -236,6 +242,8 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     second_last = jnp.take_along_axis(
         final, jnp.clip(ext_len - 2, 0, None)[:, None], axis=1)[:, 0]
     loss = -jnp.logaddexp(last, second_last)
+    if norm_by_times:
+        loss = loss / jnp.maximum(input_lengths, 1)
     if reduction == "mean":
         return jnp.mean(loss / jnp.maximum(label_lengths, 1))
     return _reduce(loss, reduction)

@@ -124,13 +124,13 @@ def rms_norm(x, weight=None, epsilon=1e-6):
     return out.astype(x.dtype)
 
 
-def normalize(x, p=2, axis=1, epsilon=1e-12):
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
     norm = jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True)
     return x / jnp.maximum(norm, epsilon)
 
 
 def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
-                        data_format="NCHW"):
+                        data_format="NCHW", name=None):
     import jax
     channel_axis = 1 if data_format.startswith("NC") else x.ndim - 1
     sq = jnp.square(x)

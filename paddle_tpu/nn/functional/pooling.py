@@ -48,27 +48,30 @@ def _max_init(x):
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
-               return_mask=False, data_format="NCHW"):
+               return_mask=False, data_format="NCHW", name=None):
     return _pool(x, kernel_size, stride, padding, 2, data_format,
                  jax.lax.max, _max_init(x), ceil_mode)
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
-               return_mask=False):
+               return_mask=False, name=None):
     return _pool(x, kernel_size, stride, padding, 1, "NCL", jax.lax.max,
                  _max_init(x), ceil_mode)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
-               return_mask=False, data_format="NCDHW"):
+               return_mask=False, data_format="NCDHW", name=None):
     return _pool(x, kernel_size, stride, padding, 3, data_format,
                  jax.lax.max, _max_init(x), ceil_mode)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
-               exclusive=True, data_format="NCHW"):
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
     summed = _pool(x, kernel_size, stride, padding, 2, data_format,
                    jax.lax.add, 0.0, ceil_mode)
+    if divisor_override is not None:
+        return summed / float(divisor_override)
     if exclusive:
         ones = jnp.ones_like(x)
         counts = _pool(ones, kernel_size, stride, padding, 2, data_format,
@@ -79,7 +82,7 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
-               exclusive=True):
+               exclusive=True, name=None):
     summed = _pool(x, kernel_size, stride, padding, 1, "NCL",
                    jax.lax.add, 0.0, ceil_mode)
     if exclusive:
@@ -90,9 +93,12 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
-               exclusive=True, data_format="NCDHW"):
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
     summed = _pool(x, kernel_size, stride, padding, 3, data_format,
                    jax.lax.add, 0.0, ceil_mode)
+    if divisor_override is not None:
+        return summed / float(divisor_override)
     if exclusive:
         counts = _pool(jnp.ones_like(x), kernel_size, stride, padding, 3,
                        data_format, jax.lax.add, 0.0, ceil_mode)
@@ -100,7 +106,7 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     return summed / float(np.prod(_tuple(kernel_size, 3)))
 
 
-def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
     """Reference: pool2d with adaptive=True."""
     oh, ow = _tuple(output_size, 2)
     if data_format == "NCHW":
@@ -119,20 +125,36 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
     return jax.image.resize(x, target, method="linear").astype(x.dtype)
 
 
-def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
+def adaptive_max_pool2d(x, output_size, return_mask=False,
+                        data_format="NCHW", name=None):
     oh, ow = _tuple(output_size, 2)
     if data_format == "NCHW":
         n, c, h, w = x.shape
         assert h % oh == 0 and w % ow == 0, \
             "adaptive_max_pool2d requires divisible sizes on TPU"
-        return jnp.max(jnp.reshape(x, (n, c, oh, h // oh, ow, w // ow)),
-                       axis=(3, 5))
+        win = jnp.reshape(x, (n, c, oh, h // oh, ow, w // ow))
+        out = jnp.max(win, axis=(3, 5))
+        if not return_mask:
+            return out
+        # flattened argmax over each (kh, kw) window -> global h*w index,
+        # matching the reference's max_pool_with_index mask layout
+        kh, kw = h // oh, w // ow
+        flat = jnp.reshape(jnp.moveaxis(win, 4, 3),
+                           (n, c, oh, ow, kh * kw))
+        arg = jnp.argmax(flat, axis=-1)
+        wr, wc = arg // kw, arg % kw
+        gi = (jnp.arange(oh)[:, None] * kh + wr) * w \
+            + jnp.arange(ow)[None, :] * kw + wc
+        return out, gi.astype(jnp.int32)
     n, h, w, c = x.shape
-    return jnp.max(jnp.reshape(x, (n, oh, h // oh, ow, w // ow, c)),
-                   axis=(2, 4))
+    out = jnp.max(jnp.reshape(x, (n, oh, h // oh, ow, w // ow, c)),
+                  axis=(2, 4))
+    if return_mask:
+        raise NotImplementedError("return_mask requires NCHW")
+    return out
 
 
-def adaptive_avg_pool1d(x, output_size):
+def adaptive_avg_pool1d(x, output_size, name=None):
     n, c, l = x.shape
     o = output_size if isinstance(output_size, int) else output_size[0]
     assert l % o == 0
@@ -144,14 +166,20 @@ def global_avg_pool2d(x, data_format="NCHW"):
     return jnp.mean(x, axis=axes, keepdims=True)
 
 
-def adaptive_max_pool1d(x, output_size):
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
     n, c, l = x.shape
     o = output_size if isinstance(output_size, int) else output_size[0]
     assert l % o == 0, "adaptive_max_pool1d requires divisible sizes on TPU"
-    return jnp.max(jnp.reshape(x, (n, c, o, l // o)), axis=3)
+    win = jnp.reshape(x, (n, c, o, l // o))
+    out = jnp.max(win, axis=3)
+    if return_mask:
+        arg = jnp.argmax(win, axis=3)
+        gi = jnp.arange(o) * (l // o) + arg
+        return out, gi.astype(jnp.int32)
+    return out
 
 
-def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
     od, oh, ow = _tuple(output_size, 3)
     if data_format == "NCDHW":
         n, c, d, h, w = x.shape
@@ -165,14 +193,28 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
         x, (n, od, d // od, oh, h // oh, ow, w // ow, c)), axis=(2, 4, 6))
 
 
-def adaptive_max_pool3d(x, output_size, data_format="NCDHW"):
+def adaptive_max_pool3d(x, output_size, return_mask=False,
+                        data_format="NCDHW", name=None):
     od, oh, ow = _tuple(output_size, 3)
     if data_format == "NCDHW":
         n, c, d, h, w = x.shape
         assert d % od == 0 and h % oh == 0 and w % ow == 0
-        return jnp.max(jnp.reshape(
-            x, (n, c, od, d // od, oh, h // oh, ow, w // ow)),
-            axis=(3, 5, 7))
+        win = jnp.reshape(
+            x, (n, c, od, d // od, oh, h // oh, ow, w // ow))
+        out = jnp.max(win, axis=(3, 5, 7))
+        if not return_mask:
+            return out
+        kd, kh, kw = d // od, h // oh, w // ow
+        flat = jnp.reshape(jnp.transpose(
+            win, (0, 1, 2, 4, 6, 3, 5, 7)),
+            (n, c, od, oh, ow, kd * kh * kw))
+        arg = jnp.argmax(flat, axis=-1)
+        wd, rem = arg // (kh * kw), arg % (kh * kw)
+        wr, wc = rem // kw, rem % kw
+        gi = ((jnp.arange(od)[:, None, None] * kd + wd) * h
+              + jnp.arange(oh)[None, :, None] * kh + wr) * w \
+            + jnp.arange(ow)[None, None, :] * kw + wc
+        return out, gi.astype(jnp.int32)
     n, d, h, w, c = x.shape
     assert d % od == 0 and h % oh == 0 and w % ow == 0
     return jnp.max(jnp.reshape(

@@ -39,7 +39,7 @@ class Initializer:
 
 
 class Constant(Initializer):
-    def __init__(self, value=0.0):
+    def __init__(self, value=0.0, name=None):
         self.value = value
 
     def __call__(self, shape, dtype=None):
@@ -48,7 +48,7 @@ class Constant(Initializer):
 
 
 class Uniform(Initializer):
-    def __init__(self, low=-1.0, high=1.0):
+    def __init__(self, low=-1.0, high=1.0, name=None):
         self.low, self.high = low, high
 
     def __call__(self, shape, dtype=None):
@@ -58,7 +58,7 @@ class Uniform(Initializer):
 
 
 class Normal(Initializer):
-    def __init__(self, mean=0.0, std=1.0):
+    def __init__(self, mean=0.0, std=1.0, name=None):
         self.mean, self.std = mean, std
 
     def __call__(self, shape, dtype=None):
@@ -68,7 +68,7 @@ class Normal(Initializer):
 
 
 class TruncatedNormal(Initializer):
-    def __init__(self, mean=0.0, std=1.0):
+    def __init__(self, mean=0.0, std=1.0, name=None):
         self.mean, self.std = mean, std
 
     def __call__(self, shape, dtype=None):
@@ -79,7 +79,7 @@ class TruncatedNormal(Initializer):
 
 
 class XavierUniform(Initializer):
-    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
         self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
 
     def __call__(self, shape, dtype=None):
@@ -93,7 +93,7 @@ class XavierUniform(Initializer):
 
 
 class XavierNormal(Initializer):
-    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
         self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
 
     def __call__(self, shape, dtype=None):
@@ -135,7 +135,7 @@ class KaimingNormal(Initializer):
 
 
 class Assign(Initializer):
-    def __init__(self, value):
+    def __init__(self, value, name=None):
         self.value = value
 
     def __call__(self, shape, dtype=None):

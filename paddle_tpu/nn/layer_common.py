@@ -114,20 +114,22 @@ class Identity(Layer):
 
 class Upsample(Layer):
     def __init__(self, size=None, scale_factor=None, mode="nearest",
-                 align_corners=False, data_format="NCHW", name=None):
+                 align_corners=False, align_mode=0, data_format="NCHW",
+                 name=None):
         super().__init__()
         self.size, self.scale_factor = size, scale_factor
         self.mode, self.align_corners = mode, align_corners
-        self.data_format = data_format
+        self.align_mode, self.data_format = align_mode, data_format
 
     def forward(self, x):
         return F.interpolate(x, self.size, self.scale_factor, self.mode,
-                             self.align_corners, self.data_format)
+                             self.align_corners, self.align_mode,
+                             self.data_format)
 
 
 class Pad2D(Layer):
     def __init__(self, padding, mode="constant", value=0.0,
-                 data_format="NCHW"):
+                 data_format="NCHW", name=None):
         super().__init__()
         self.padding, self.mode = padding, mode
         self.value, self.data_format = value, data_format
@@ -137,7 +139,7 @@ class Pad2D(Layer):
 
 
 class PixelShuffle(Layer):
-    def __init__(self, upscale_factor, data_format="NCHW"):
+    def __init__(self, upscale_factor, data_format="NCHW", name=None):
         super().__init__()
         self.upscale_factor = upscale_factor
         self.data_format = data_format
@@ -366,7 +368,7 @@ class Dropout3D(Layer):
 
 class Pad1D(Layer):
     def __init__(self, padding, mode="constant", value=0.0,
-                 data_format="NCL"):
+                 data_format="NCL", name=None):
         super().__init__()
         self.padding, self.mode = padding, mode
         self.value, self.data_format = value, data_format
@@ -378,7 +380,7 @@ class Pad1D(Layer):
 
 class Pad3D(Layer):
     def __init__(self, padding, mode="constant", value=0.0,
-                 data_format="NCDHW"):
+                 data_format="NCDHW", name=None):
         super().__init__()
         self.padding, self.mode = padding, mode
         self.value, self.data_format = value, data_format
@@ -413,7 +415,7 @@ class UpsamplingNearest2D(Layer):
 
     def forward(self, x):
         return F.interpolate(x, self.size, self.scale_factor, "nearest",
-                             False, self.data_format)
+                             False, data_format=self.data_format)
 
 
 class UpsamplingBilinear2D(Layer):
@@ -425,7 +427,7 @@ class UpsamplingBilinear2D(Layer):
 
     def forward(self, x):
         return F.interpolate(x, self.size, self.scale_factor, "bilinear",
-                             True, self.data_format)
+                             True, data_format=self.data_format)
 
 
 class CosineSimilarity(Layer):

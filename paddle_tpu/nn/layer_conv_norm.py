@@ -55,9 +55,11 @@ class _ConvNd(Layer):
               2: (F.conv2d, F.conv2d_transpose),
               3: (F.conv3d, F.conv3d_transpose)}[self._n][self._transpose]
         if self._transpose:
+            # keyword args: conv{1,3}d_transpose and conv2d_transpose
+            # order groups/dilation differently (reference arity)
             return fn(x, self.weight, self.bias, self.stride, self.padding,
-                      self.output_padding, self.dilation, self.groups,
-                      self.data_format)
+                      self.output_padding, dilation=self.dilation,
+                      groups=self.groups, data_format=self.data_format)
         return fn(x, self.weight, self.bias, self.stride, self.padding,
                   self.dilation, self.groups, self.data_format)
 
@@ -357,14 +359,17 @@ class MaxPool2D(Layer):
 
 class AvgPool2D(Layer):
     def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
-                 exclusive=True, data_format="NCHW", name=None):
+                 exclusive=True, divisor_override=None, data_format="NCHW",
+                 name=None):
         super().__init__()
-        self.args = (kernel_size, stride, padding, ceil_mode, exclusive)
+        self.args = (kernel_size, stride, padding, ceil_mode, exclusive,
+                     divisor_override)
         self.data_format = data_format
 
     def forward(self, x):
-        k, s, p, c, e = self.args
-        return F.avg_pool2d(x, k, s, p, c, e, data_format=self.data_format)
+        k, s, p, c, e, d = self.args
+        return F.avg_pool2d(x, k, s, p, c, e, d,
+                            data_format=self.data_format)
 
 
 class MaxPool1D(Layer):
@@ -403,14 +408,17 @@ class MaxPool3D(Layer):
 
 class AvgPool3D(Layer):
     def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
-                 exclusive=True, data_format="NCDHW", name=None):
+                 exclusive=True, divisor_override=None,
+                 data_format="NCDHW", name=None):
         super().__init__()
-        self.args = (kernel_size, stride, padding, ceil_mode, exclusive)
+        self.args = (kernel_size, stride, padding, ceil_mode, exclusive,
+                     divisor_override)
         self.data_format = data_format
 
     def forward(self, x):
-        k, s, p, c, e = self.args
-        return F.avg_pool3d(x, k, s, p, c, e, data_format=self.data_format)
+        k, s, p, c, e, d = self.args
+        return F.avg_pool3d(x, k, s, p, c, e, d,
+                            data_format=self.data_format)
 
 
 class AdaptiveAvgPool2D(Layer):

@@ -265,17 +265,20 @@ class Momentum(Optimizer):
 
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
-                 multi_precision=False, name=None):
+                 multi_precision=False, rescale_grad=1.0, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          multi_precision, name)
         self._momentum = momentum
         self._nesterov = use_nesterov
+        self._rescale_grad = float(rescale_grad)
 
     def _init_slot(self, p):
         return {"velocity": jnp.zeros_like(
             p.astype(jnp.float32) if self._multi_precision else p)}
 
     def _update(self, p, g, slots, lr, step, name):
+        if self._rescale_grad != 1.0:
+            g = g * self._rescale_grad
         v = self._momentum * slots["velocity"].astype(p.dtype) + g
         if self._nesterov:
             new_p = p - lr * (g + self._momentum * v)

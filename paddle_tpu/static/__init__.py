@@ -134,7 +134,7 @@ class Executor(_ReplayExecutor):
 # ------------------------------------------------------- backward / grads
 
 def append_backward(loss, parameter_list=None, no_grad_set=None,
-                    callbacks=None):
+                    callbacks=None, checkpoints=None):
     """Reference: `fluid/backward.py append_backward` — marks the program
     so `Executor.run` computes parameter grads (fetchable as
     '<param_name>@GRAD'). Returns [(param, grad_name)] like the reference's
@@ -288,8 +288,8 @@ class CompiledProgram:
     """Reference: compiler.py CompiledProgram — the replay Executor jit-
     compiles every program already; this wrapper keeps script parity."""
 
-    def __init__(self, program, build_strategy=None):
-        self._program = program
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
         self._build_strategy = build_strategy
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
@@ -346,8 +346,8 @@ def load_program_state(model_path, var_list=None):
     return _load(model_path + ".pdparams")
 
 
-def set_program_state(program, state):
-    program.set_state_dict(state)
+def set_program_state(program, state_dict):
+    program.set_state_dict(state_dict)
 
 
 def normalize_program(program, feed_vars, fetch_vars):

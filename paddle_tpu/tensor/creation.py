@@ -35,42 +35,42 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
     return arr
 
 
-def full(shape, fill_value, dtype=None):
+def full(shape, fill_value, dtype=None, name=None):
     if dtype is None:
         dtype = get_default_dtype() if isinstance(fill_value, float) else None
     return jnp.full(_shape(shape), fill_value, dtype=convert_dtype(dtype))
 
 
-def full_like(x, fill_value, dtype=None):
+def full_like(x, fill_value, dtype=None, name=None):
     return jnp.full_like(x, fill_value, dtype=convert_dtype(dtype))
 
 
-def zeros(shape, dtype=None):
+def zeros(shape, dtype=None, name=None):
     return jnp.zeros(_shape(shape), dtype=convert_dtype(dtype) or get_default_dtype())
 
 
-def zeros_like(x, dtype=None):
+def zeros_like(x, dtype=None, name=None):
     return jnp.zeros_like(x, dtype=convert_dtype(dtype))
 
 
-def ones(shape, dtype=None):
+def ones(shape, dtype=None, name=None):
     return jnp.ones(_shape(shape), dtype=convert_dtype(dtype) or get_default_dtype())
 
 
-def ones_like(x, dtype=None):
+def ones_like(x, dtype=None, name=None):
     return jnp.ones_like(x, dtype=convert_dtype(dtype))
 
 
-def empty(shape, dtype=None):
+def empty(shape, dtype=None, name=None):
     # XLA has no uninitialized alloc; zeros compiles to a fusion-friendly fill.
     return zeros(shape, dtype)
 
 
-def empty_like(x, dtype=None):
+def empty_like(x, dtype=None, name=None):
     return zeros_like(x, dtype)
 
 
-def arange(start=0, end=None, step=1, dtype=None):
+def arange(start=0, end=None, step=1, dtype=None, name=None):
     if end is None:
         start, end = 0, start
     dtype = convert_dtype(dtype)
@@ -92,12 +92,12 @@ def logspace(start, stop, num, base=10.0, dtype=None):
                         dtype=convert_dtype(dtype) or get_default_dtype())
 
 
-def eye(num_rows, num_columns=None, dtype=None):
+def eye(num_rows, num_columns=None, dtype=None, name=None):
     return jnp.eye(num_rows, num_columns,
                    dtype=convert_dtype(dtype) or get_default_dtype())
 
 
-def diag(x, offset=0, padding_value=0):
+def diag(x, offset=0, padding_value=0, name=None):
     x = jnp.asarray(x)
     if x.ndim == 1 and padding_value != 0:
         n = x.shape[0] + abs(offset)
@@ -107,15 +107,15 @@ def diag(x, offset=0, padding_value=0):
     return jnp.diag(x, k=offset)
 
 
-def diagflat(x, offset=0):
+def diagflat(x, offset=0, name=None):
     return jnp.diagflat(jnp.asarray(x), k=offset)
 
 
-def tril(x, diagonal=0):
+def tril(x, diagonal=0, name=None):
     return jnp.tril(x, k=diagonal)
 
 
-def triu(x, diagonal=0):
+def triu(x, diagonal=0, name=None):
     return jnp.triu(x, k=diagonal)
 
 
@@ -132,7 +132,7 @@ def clone(x):
     return jnp.copy(x)
 
 
-def numel(x):
+def numel(x, name=None):
     return jnp.asarray(x).size
 
 

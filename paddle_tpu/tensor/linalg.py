@@ -13,7 +13,7 @@ import jax.numpy as jnp
 from .math import matmul, mm, bmm, dot, mv, t  # noqa: F401  (re-export parity)
 
 
-def norm(x, p="fro", axis=None, keepdim=False):
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
     if p == "fro":
         if axis is None:
             return jnp.sqrt(jnp.sum(jnp.square(x)))
@@ -57,7 +57,7 @@ def slogdet(x):
     return jnp.stack([sign, logabs])
 
 
-def inverse(x):
+def inverse(x, name=None):
     return jnp.linalg.inv(x)
 
 
@@ -65,7 +65,7 @@ def pinv(x, rcond=1e-15, hermitian=False):
     return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
 
 
-def cholesky(x, upper=False):
+def cholesky(x, upper=False, name=None):
     L = jnp.linalg.cholesky(x)
     return jnp.swapaxes(L, -1, -2) if upper else L
 
@@ -117,7 +117,7 @@ def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
         unit_diagonal=unitriangular)
 
 
-def cross(x, y, axis=-1):
+def cross(x, y, axis=-1, name=None):
     return jnp.cross(x, y, axis=axis)
 
 

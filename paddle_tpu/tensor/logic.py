@@ -9,35 +9,35 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def equal(x, y):
+def equal(x, y, name=None):
     return jnp.equal(x, y)
 
 
-def not_equal(x, y):
+def not_equal(x, y, name=None):
     return jnp.not_equal(x, y)
 
 
-def greater_than(x, y):
+def greater_than(x, y, name=None):
     return jnp.greater(x, y)
 
 
-def greater_equal(x, y):
+def greater_equal(x, y, name=None):
     return jnp.greater_equal(x, y)
 
 
-def less_than(x, y):
+def less_than(x, y, name=None):
     return jnp.less(x, y)
 
 
-def less_equal(x, y):
+def less_equal(x, y, name=None):
     return jnp.less_equal(x, y)
 
 
-def equal_all(x, y):
+def equal_all(x, y, name=None):
     return jnp.array_equal(x, y)
 
 
-def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
     return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
 
 
@@ -61,19 +61,23 @@ def logical_not(x):
     return jnp.logical_not(x)
 
 
-def bitwise_and(x, y):
+# `out=` is accepted for API parity with the reference's pre-allocated
+# output tensors (bitwise_op); jax arrays are immutable, so the result
+# is always returned (out is never written through).
+
+def bitwise_and(x, y, out=None, name=None):
     return jnp.bitwise_and(x, y)
 
 
-def bitwise_or(x, y):
+def bitwise_or(x, y, out=None, name=None):
     return jnp.bitwise_or(x, y)
 
 
-def bitwise_xor(x, y):
+def bitwise_xor(x, y, out=None, name=None):
     return jnp.bitwise_xor(x, y)
 
 
-def bitwise_not(x):
+def bitwise_not(x, out=None, name=None):
     return jnp.bitwise_not(x)
 
 

@@ -19,7 +19,7 @@ import numpy as np
 from ..core.dtypes import convert_dtype
 
 
-def reshape(x, shape):
+def reshape(x, shape, name=None):
     return jnp.reshape(x, tuple(int(s) for s in shape))
 
 
@@ -35,7 +35,7 @@ def swapaxes(x, axis0, axis1):
     return jnp.swapaxes(x, axis0, axis1)
 
 
-def squeeze(x, axis=None):
+def squeeze(x, axis=None, name=None):
     if axis is None:
         return jnp.squeeze(x)
     if isinstance(axis, int):
@@ -44,17 +44,17 @@ def squeeze(x, axis=None):
     return jnp.squeeze(x, axis=axis) if axis else x
 
 
-def unsqueeze(x, axis):
+def unsqueeze(x, axis, name=None):
     if isinstance(axis, int):
         axis = (axis,)
     return jnp.expand_dims(x, axis=tuple(axis))
 
 
-def concat(x, axis=0):
+def concat(x, axis=0, name=None):
     return jnp.concatenate(list(x), axis=int(axis))
 
 
-def stack(x, axis=0):
+def stack(x, axis=0, name=None):
     return jnp.stack(list(x), axis=axis)
 
 
@@ -64,7 +64,7 @@ def unstack(x, axis=0, num=None):
             for s in jnp.split(x, n, axis=axis)]
 
 
-def split(x, num_or_sections, axis=0):
+def split(x, num_or_sections, axis=0, name=None):
     axis = int(axis)
     if isinstance(num_or_sections, int):
         return jnp.split(x, num_or_sections, axis=axis)
@@ -77,11 +77,11 @@ def split(x, num_or_sections, axis=0):
     return jnp.split(x, offsets, axis=axis)
 
 
-def chunk(x, chunks, axis=0):
+def chunk(x, chunks, axis=0, name=None):
     return jnp.array_split(x, chunks, axis=axis)
 
 
-def flatten(x, start_axis=0, stop_axis=-1):
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
     ndim = jnp.ndim(x)
     start = start_axis % ndim
     stop = stop_axis % ndim
@@ -97,7 +97,7 @@ def slice(x, axes, starts, ends):
     return x[tuple(idx)]
 
 
-def strided_slice(x, axes, starts, ends, strides):
+def strided_slice(x, axes, starts, ends, strides, name=None):
     idx = [builtins.slice(None)] * jnp.ndim(x)
     for ax, st, en, sr in zip(axes, starts, ends, strides):
         idx[ax] = builtins.slice(int(st), int(en), int(sr))
@@ -110,17 +110,17 @@ def crop(x, shape, offsets=None):
                                  [int(s) for s in shape])
 
 
-def gather(x, index, axis=0):
+def gather(x, index, axis=0, name=None):
     """Reference: gather_op — select rows of `x` along `axis` by `index`."""
     return jnp.take(x, jnp.reshape(index, (-1,)), axis=axis)
 
 
-def gather_nd(x, index):
+def gather_nd(x, index, name=None):
     index = jnp.asarray(index)
     return x[tuple(jnp.moveaxis(index, -1, 0))]
 
 
-def scatter(x, index, updates, overwrite=True):
+def scatter(x, index, updates, overwrite=True, name=None):
     """Reference: scatter_op. overwrite=False accumulates (scatter_add)."""
     index = jnp.reshape(index, (-1,))
     if overwrite:
@@ -128,7 +128,7 @@ def scatter(x, index, updates, overwrite=True):
     return x.at[index].add(updates)
 
 
-def scatter_nd_add(x, index, updates):
+def scatter_nd_add(x, index, updates, name=None):
     index = jnp.asarray(index)
     return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
 
@@ -146,7 +146,7 @@ def take_along_axis(arr, indices, axis):
     return jnp.take_along_axis(arr, indices, axis=axis)
 
 
-def index_select(x, index, axis=0):
+def index_select(x, index, axis=0, name=None):
     return jnp.take(x, jnp.reshape(index, (-1,)), axis=axis)
 
 
@@ -154,11 +154,11 @@ def index_sample(x, index):
     return jnp.take_along_axis(x, index, axis=1)
 
 
-def tile(x, repeat_times):
+def tile(x, repeat_times, name=None):
     return jnp.tile(x, tuple(repeat_times))
 
 
-def expand(x, shape):
+def expand(x, shape, name=None):
     shape = tuple(int(s) for s in shape)
     # paddle allows -1 meaning "keep this dim"
     x_shape = (1,) * (len(shape) - jnp.ndim(x)) + tuple(x.shape)
@@ -166,19 +166,19 @@ def expand(x, shape):
     return jnp.broadcast_to(jnp.reshape(x, x_shape), shape)
 
 
-def expand_as(x, y):
+def expand_as(x, y, name=None):
     return expand(x, y.shape)
 
 
-def broadcast_to(x, shape):
+def broadcast_to(x, shape, name=None):
     return jnp.broadcast_to(x, tuple(shape))
 
 
-def broadcast_tensors(inputs):
-    return list(jnp.broadcast_arrays(*inputs))
+def broadcast_tensors(input, name=None):
+    return list(jnp.broadcast_arrays(*input))
 
 
-def flip(x, axis):
+def flip(x, axis, name=None):
     if isinstance(axis, int):
         axis = [axis]
     return jnp.flip(x, axis=tuple(axis))
@@ -188,7 +188,7 @@ def rot90(x, k=1, axes=(0, 1)):
     return jnp.rot90(x, k=k, axes=tuple(axes))
 
 
-def roll(x, shifts, axis=None):
+def roll(x, shifts, axis=None, name=None):
     return jnp.roll(x, shifts, axis=axis)
 
 
@@ -205,11 +205,17 @@ def unbind(input, axis=0):
 
 
 def unique(x, return_index=False, return_inverse=False, return_counts=False,
-           axis=None):
-    """Eager-only (data-dependent output shape; reference: unique_op)."""
+           axis=None, dtype="int64", name=None):
+    """Eager-only (data-dependent output shape; reference: unique_op).
+
+    `dtype` sets the index/inverse/counts output dtype, as in the
+    reference (`python/paddle/tensor/manipulation.py:714`)."""
     res = jnp.unique(np.asarray(x), return_index=return_index,
                      return_inverse=return_inverse,
                      return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        idx_dtype = convert_dtype(dtype)
+        res = (res[0],) + tuple(jnp.asarray(r, idx_dtype) for r in res[1:])
     return res
 
 
@@ -233,7 +239,7 @@ def unique_consecutive(x, return_inverse=False, return_counts=False,
     return out[0] if len(out) == 1 else tuple(out)
 
 
-def masked_select(x, mask):
+def masked_select(x, mask, name=None):
     """Eager-only: output shape is data-dependent."""
     return jnp.asarray(np.asarray(x)[np.asarray(mask)])
 
@@ -242,7 +248,7 @@ def masked_fill(x, mask, value):
     return jnp.where(mask, jnp.asarray(value, dtype=jnp.asarray(x).dtype), x)
 
 
-def where(condition, x=None, y=None):
+def where(condition, x=None, y=None, name=None):
     if x is None and y is None:
         return nonzero(condition, as_tuple=True)
     return jnp.where(condition, x, y)
@@ -323,17 +329,17 @@ reverse = flip
 # return the new array; the reference's mutation contract is documented at
 # the Tensor wrapper level.
 
-def reshape_(x, shape):
+def reshape_(x, shape, name=None):
     return reshape(x, shape)
 
 
-def squeeze_(x, axis=None):
+def squeeze_(x, axis=None, name=None):
     return squeeze(x, axis)
 
 
-def unsqueeze_(x, axis):
+def unsqueeze_(x, axis, name=None):
     return unsqueeze(x, axis)
 
 
-def scatter_(x, index, updates, overwrite=True):
+def scatter_(x, index, updates, overwrite=True, name=None):
     return scatter(x, index, updates, overwrite=overwrite)

@@ -16,42 +16,42 @@ from ..core.dtypes import convert_dtype
 
 # --- binary elementwise (broadcast rules == numpy == paddle) ---
 
-def add(x, y):
+def add(x, y, name=None):
     return jnp.add(x, y)
 
 
-def subtract(x, y):
+def subtract(x, y, name=None):
     return jnp.subtract(x, y)
 
 
-def multiply(x, y):
+def multiply(x, y, name=None):
     return jnp.multiply(x, y)
 
 
-def divide(x, y):
+def divide(x, y, name=None):
     return jnp.divide(x, y)
 
 
-def floor_divide(x, y):
+def floor_divide(x, y, name=None):
     return jnp.floor_divide(x, y)
 
 
-def mod(x, y):
+def mod(x, y, name=None):
     return jnp.mod(x, y)
 
 
 remainder = mod
 
 
-def pow(x, y):
+def pow(x, y, name=None):
     return jnp.power(x, y)
 
 
-def maximum(x, y):
+def maximum(x, y, name=None):
     return jnp.maximum(x, y)
 
 
-def minimum(x, y):
+def minimum(x, y, name=None):
     return jnp.minimum(x, y)
 
 
@@ -63,7 +63,7 @@ def fmin(x, y):
     return jnp.fmin(x, y)
 
 
-def atan2(x, y):
+def atan2(x, y, name=None):
     return jnp.arctan2(x, y)
 
 
@@ -95,7 +95,7 @@ def outer(x, y):
     return jnp.outer(x, y)
 
 
-def kron(x, y):
+def kron(x, y, name=None):
     return jnp.kron(x, y)
 
 
@@ -105,7 +105,7 @@ def abs(x):
     return jnp.abs(x)
 
 
-def neg(x):
+def neg(x, name=None):
     return jnp.negative(x)
 
 
@@ -121,15 +121,15 @@ def log(x):
     return jnp.log(x)
 
 
-def log2(x):
+def log2(x, name=None):
     return jnp.log2(x)
 
 
-def log10(x):
+def log10(x, name=None):
     return jnp.log10(x)
 
 
-def log1p(x):
+def log1p(x, name=None):
     return jnp.log1p(x)
 
 
@@ -145,7 +145,7 @@ def square(x):
     return jnp.square(x)
 
 
-def sign(x):
+def sign(x, name=None):
     return jnp.sign(x)
 
 
@@ -161,8 +161,8 @@ def round(x):
     return jnp.round(x)
 
 
-def trunc(x):
-    return jnp.trunc(x)
+def trunc(input, name=None):
+    return jnp.trunc(input)
 
 
 def frac(x):
@@ -205,7 +205,7 @@ def cosh(x):
     return jnp.cosh(x)
 
 
-def tanh(x):
+def tanh(x, name=None):
     return jnp.tanh(x)
 
 
@@ -229,7 +229,7 @@ def erfinv(x):
     return jax.scipy.special.erfinv(x)
 
 
-def digamma(x):
+def digamma(x, name=None):
     return jax.scipy.special.digamma(x)
 
 
@@ -247,19 +247,19 @@ def logit(x, eps=None):
     return jnp.log(x) - jnp.log1p(-x)
 
 
-def clip(x, min=None, max=None):
+def clip(x, min=None, max=None, name=None):
     return jnp.clip(x, min, max)
 
 
-def isnan(x):
+def isnan(x, name=None):
     return jnp.isnan(x)
 
 
-def isinf(x):
+def isinf(x, name=None):
     return jnp.isinf(x)
 
 
-def isfinite(x):
+def isfinite(x, name=None):
     return jnp.isfinite(x)
 
 
@@ -271,15 +271,15 @@ def angle(x):
     return jnp.angle(x)
 
 
-def conj(x):
+def conj(x, name=None):
     return jnp.conj(x)
 
 
-def real(x):
+def real(x, name=None):
     return jnp.real(x)
 
 
-def imag(x):
+def imag(x, name=None):
     return jnp.imag(x)
 
 
@@ -299,11 +299,11 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
     return (x + bias) * scale
 
 
-def increment(x, value=1.0):
+def increment(x, value=1.0, name=None):
     return x + value
 
 
-def addmm(input, x, y, beta=1.0, alpha=1.0):
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
     return beta * input + alpha * jnp.matmul(x, y)
 
 
@@ -329,20 +329,20 @@ def _axis(axis):
     return int(axis)
 
 
-def sum(x, axis=None, dtype=None, keepdim=False):
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
     return jnp.sum(x, axis=_axis(axis), dtype=convert_dtype(dtype),
                    keepdims=keepdim)
 
 
-def mean(x, axis=None, keepdim=False):
+def mean(x, axis=None, keepdim=False, name=None):
     return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
 
 
-def max(x, axis=None, keepdim=False):
+def max(x, axis=None, keepdim=False, name=None):
     return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
 
 
-def min(x, axis=None, keepdim=False):
+def min(x, axis=None, keepdim=False, name=None):
     return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
 
 
@@ -354,20 +354,20 @@ def amin(x, axis=None, keepdim=False):
     return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
 
 
-def prod(x, axis=None, keepdim=False, dtype=None):
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
     return jnp.prod(x, axis=_axis(axis), dtype=convert_dtype(dtype),
                     keepdims=keepdim)
 
 
-def logsumexp(x, axis=None, keepdim=False):
+def logsumexp(x, axis=None, keepdim=False, name=None):
     return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
 
 
-def all(x, axis=None, keepdim=False):
+def all(x, axis=None, keepdim=False, name=None):
     return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
 
 
-def any(x, axis=None, keepdim=False):
+def any(x, axis=None, keepdim=False, name=None):
     return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
 
 
@@ -386,7 +386,7 @@ def nanmean(x, axis=None, keepdim=False):
 
 # --- scans (reference: cumsum_op etc.) ---
 
-def cumsum(x, axis=None, dtype=None):
+def cumsum(x, axis=None, dtype=None, name=None):
     if axis is None:
         x = jnp.reshape(x, (-1,))
         axis = 0
@@ -424,7 +424,7 @@ def trapezoid(y, x=None, dx=None, axis=-1):
 
 # --- matmul family (the MXU path) ---
 
-def matmul(x, y, transpose_x=False, transpose_y=False):
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     """Reference: matmul_v2 op (`operators/matmul_v2_op.*` → cuBLAS).
 
     Lowers to a single dot_general; XLA tiles it onto the MXU. Keep operands
@@ -440,28 +440,28 @@ def matmul(x, y, transpose_x=False, transpose_y=False):
     return jnp.matmul(x, y)
 
 
-def mm(x, y):
-    return jnp.matmul(x, y)
+def mm(input, mat2, name=None):
+    return jnp.matmul(input, mat2)
 
 
-def bmm(x, y):
+def bmm(x, y, name=None):
     return jax.lax.batch_matmul(x, y)
 
 
-def dot(x, y):
+def dot(x, y, name=None):
     if jnp.ndim(x) == 2:
         return jnp.sum(x * y, axis=-1, keepdims=True)
     return jnp.dot(x, y)
 
 
-def mv(x, vec):
+def mv(x, vec, name=None):
     return jnp.matmul(x, vec)
 
 
-def t(x):
-    if jnp.ndim(x) < 2:
-        return x
-    return jnp.swapaxes(x, -1, -2)
+def t(input, name=None):
+    if jnp.ndim(input) < 2:
+        return input
+    return jnp.swapaxes(input, -1, -2)
 
 
 # --- misc ---
@@ -483,7 +483,7 @@ def broadcast_shape(x_shape, y_shape):
     return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
 
 
-def add_n(inputs):
+def add_n(inputs, name=None):
     """Reference: `paddle.add_n` (sum_op) — elementwise sum of a list."""
     if not isinstance(inputs, (list, tuple)):
         return jnp.asarray(inputs)
@@ -493,12 +493,12 @@ def add_n(inputs):
     return total
 
 
-def trace(x, offset=0, axis1=0, axis2=1):
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
     """Reference: `paddle.trace` (trace_op)."""
     return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
 
 
-def diagonal(x, offset=0, axis1=0, axis2=1):
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
     """Reference: `paddle.diagonal` (diagonal_op)."""
     return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
 
@@ -508,5 +508,5 @@ def floor_mod(x, y):
     return mod(x, y)
 
 
-def tanh_(x):  # inplace alias: plain op in a functional world
+def tanh_(x, name=None):  # inplace alias: plain op in a functional world
     return jnp.tanh(x)

@@ -22,33 +22,33 @@ def _shape(shape):
     return tuple(int(s) for s in shape)
 
 
-def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0):
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
     dtype = convert_dtype(dtype) or get_default_dtype()
     key = jax.random.key(seed) if seed else next_key()
     return jax.random.uniform(key, _shape(shape), dtype=dtype,
                               minval=min, maxval=max)
 
 
-def rand(shape, dtype=None):
+def rand(shape, dtype=None, name=None):
     return uniform(shape, dtype=dtype, min=0.0, max=1.0)
 
 
-def normal(mean=0.0, std=1.0, shape=None):
+def normal(mean=0.0, std=1.0, shape=None, name=None):
     shape = _shape(shape if shape is not None else [1])
     sample = jax.random.normal(next_key(), shape, dtype=get_default_dtype())
     return sample * std + mean
 
 
-def randn(shape, dtype=None):
+def randn(shape, dtype=None, name=None):
     dtype = convert_dtype(dtype) or get_default_dtype()
     return jax.random.normal(next_key(), _shape(shape), dtype=dtype)
 
 
-def standard_normal(shape, dtype=None):
+def standard_normal(shape, dtype=None, name=None):
     return randn(shape, dtype)
 
 
-def randint(low=0, high=None, shape=(1,), dtype=None):
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
     if high is None:
         low, high = 0, low
     dtype = convert_dtype(dtype) or dtypes.int64
@@ -60,12 +60,12 @@ def randint_like(x, low=0, high=None):
     return randint(low, high, shape=x.shape, dtype=x.dtype)
 
 
-def randperm(n, dtype=None):
+def randperm(n, dtype=None, name=None):
     dtype = convert_dtype(dtype) or dtypes.int64
     return jax.random.permutation(next_key(), n).astype(dtype)
 
 
-def bernoulli(x):
+def bernoulli(x, name=None):
     return jax.random.bernoulli(next_key(), p=x).astype(x.dtype)
 
 
@@ -73,7 +73,7 @@ def poisson(x):
     return jax.random.poisson(next_key(), lam=x).astype(x.dtype)
 
 
-def multinomial(x, num_samples=1, replacement=False):
+def multinomial(x, num_samples=1, replacement=False, name=None):
     logits = jnp.log(jnp.clip(x, 1e-30, None))
     if replacement:
         return jax.random.categorical(
@@ -94,9 +94,13 @@ def normal_like(x, mean=0.0, std=1.0):
     return jax.random.normal(next_key(), x.shape, dtype=x.dtype) * std + mean
 
 
-def check_shape(shape):
+def check_shape(shape, op_name="", expected_shape_type=(list, tuple),
+                expected_element_type=(int,),
+                expected_tensor_dtype=("int32", "int64")):
     """Validate a shape argument before creation ops (reference:
-    fluid/layers/utils.py check_shape, exported as `paddle.check_shape`)."""
+    fluid/data_feeder.py:142 check_shape, exported as
+    `paddle.check_shape`). The expected_* arguments are accepted for
+    signature parity; validation here is dtype/kind based."""
     if hasattr(shape, "dtype"):  # traced/array shape: dtype must be integral
         import numpy as np
         if np.dtype(shape.dtype).kind not in "iu":

@@ -9,30 +9,30 @@ import jax
 import jax.numpy as jnp
 
 
-def argmax(x, axis=None, keepdim=False, dtype="int64"):
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
     from ..core.dtypes import convert_dtype
     res = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
     return res.astype(convert_dtype(dtype))
 
 
-def argmin(x, axis=None, keepdim=False, dtype="int64"):
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
     from ..core.dtypes import convert_dtype
     res = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
     return res.astype(convert_dtype(dtype))
 
 
-def argsort(x, axis=-1, descending=False, stable=True):
+def argsort(x, axis=-1, descending=False, stable=True, name=None):
     idx = jnp.argsort(x, axis=axis, stable=stable,
                       descending=descending)
     return idx
 
 
-def sort(x, axis=-1, descending=False):
+def sort(x, axis=-1, descending=False, name=None):
     out = jnp.sort(x, axis=axis, descending=descending)
     return out
 
 
-def topk(x, k, axis=None, largest=True, sorted=True):
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
     """Reference: top_k_v2_op. Lowers to lax.top_k on the last axis."""
     if axis is None:
         axis = -1

@@ -15,10 +15,12 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def sequence_mask(lengths, maxlen: Optional[int] = None,
-                  dtype="bool"):
-    """Reference: sequence_mask op — [b] lengths → [b, maxlen] mask."""
-    lengths = jnp.asarray(lengths)
+def sequence_mask(x, maxlen: Optional[int] = None,
+                  dtype="bool", name=None):
+    """Reference: sequence_mask op — [b] lengths → [b, maxlen] mask.
+    First param is `x` (the lengths tensor) for keyword parity with
+    `paddle.nn.functional.sequence_mask`."""
+    lengths = jnp.asarray(x)
     if maxlen is None:
         maxlen = int(jnp.max(lengths))
     row = jnp.arange(maxlen)

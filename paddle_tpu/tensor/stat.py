@@ -17,17 +17,17 @@ def mean(x, axis=None, keepdim=False):
     return jnp.mean(x, axis=_ax(axis), keepdims=keepdim)
 
 
-def var(x, axis=None, unbiased=True, keepdim=False):
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
     return jnp.var(x, axis=_ax(axis), ddof=1 if unbiased else 0,
                    keepdims=keepdim)
 
 
-def std(x, axis=None, unbiased=True, keepdim=False):
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
     return jnp.std(x, axis=_ax(axis), ddof=1 if unbiased else 0,
                    keepdims=keepdim)
 
 
-def median(x, axis=None, keepdim=False):
+def median(x, axis=None, keepdim=False, name=None):
     return jnp.median(x, axis=_ax(axis), keepdims=keepdim)
 
 

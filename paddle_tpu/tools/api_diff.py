@@ -45,6 +45,168 @@ def ref_public_names(path: str, prefer_all: bool = True):
     return {n for n in names if not n.startswith("_")}
 
 
+def _module_file(ref_root: str, mod_dotted: str):
+    """Map a dotted module path under python/ to a file, or None."""
+    rel = mod_dotted.replace(".", "/")
+    for cand in (rel + ".py", rel + "/__init__.py"):
+        p = os.path.join(ref_root, "python", cand)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def _argspec_of(node: ast.AST):
+    """(param names, n_defaults, has_vararg, has_kwarg) of a def/class."""
+    if isinstance(node, ast.ClassDef):
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and item.name == "__init__":
+                node = item
+                break
+        else:
+            return None
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    a = node.args
+    names = [p.arg for p in a.args + a.kwonlyargs]
+    if names and names[0] in ("self", "cls"):
+        names = names[1:]
+    n_def = len(a.defaults) + sum(1 for d in a.kw_defaults if d is not None)
+    return (names, n_def, a.vararg is not None, a.kwarg is not None)
+
+
+def resolve_ref_def(ref_root: str, mod_dotted: str, name: str, depth=0):
+    """Find the AST def of `name` reachable from reference module
+    `mod_dotted` (dotted, e.g. 'paddle.nn'), following explicit
+    ImportFrom chains up to 8 hops. Returns an argspec tuple or None
+    (None = defined in C++/pybind or via star-import — unresolvable)."""
+    if depth > 8:
+        return None
+    path = _module_file(ref_root, mod_dotted)
+    if path is None:
+        return None
+    try:
+        tree = ast.parse(open(path).read())
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)) and node.name == name:
+            return _argspec_of(node)
+    is_pkg = path.endswith("__init__.py")
+    for node in tree.body:
+        if not isinstance(node, ast.ImportFrom):
+            continue
+        for a in node.names:
+            if (a.asname or a.name) != name or a.name == "*":
+                continue
+            if node.level:  # relative import
+                base = mod_dotted.split(".")
+                # level 1 inside a package = the package itself
+                up = node.level - (1 if is_pkg else 0)
+                base = base[:len(base) - up] if up else base
+                target = ".".join(base + ([node.module] if node.module
+                                          else []))
+            else:
+                target = node.module or ""
+            spec = resolve_ref_def(ref_root, target, a.name, depth + 1)
+            if spec is not None:
+                return spec
+            # `from x import y` where y is a submodule, not a def
+            sub = _module_file(ref_root, target + "." + a.name)
+            if sub and name != a.name:
+                return None
+    return None
+
+
+def live_argspec(obj):
+    """Argspec of a live paddle_tpu object, shaped like _argspec_of."""
+    import inspect
+
+    if isinstance(obj, type):
+        obj = obj.__init__
+    try:
+        sig = inspect.signature(obj)
+    except (TypeError, ValueError):
+        return None
+    names, n_def, var, kw = [], 0, False, False
+    for p in sig.parameters.values():
+        if p.name in ("self", "cls"):
+            continue
+        if p.kind == p.VAR_POSITIONAL:
+            var = True
+        elif p.kind == p.VAR_KEYWORD:
+            kw = True
+        else:
+            names.append(p.name)
+            if p.default is not p.empty:
+                n_def += 1
+    return (names, n_def, var, kw)
+
+
+def compare_signature(ref_spec, our_spec):
+    """Mismatch description or None.
+
+    Rule (the arity freeze, VERDICT r3 weak #5): every reference
+    parameter name must be accepted by ours (by name, or via **kwargs),
+    and every reference REQUIRED (no-default) parameter must exist by
+    name in ours. Ours may add parameters or relax requiredness —
+    that's API growth, not breakage."""
+    r_names, r_ndef, _, _ = ref_spec
+    o_names, _, _, o_kw = our_spec
+    ours = set(o_names)
+    missing = [n for n in r_names if n not in ours]
+    if missing and not o_kw:
+        return f"missing params {missing} (ref has {r_names})"
+    required = r_names[:len(r_names) - r_ndef]
+    req_missing = [n for n in required if n not in ours]
+    if req_missing and not o_kw:
+        return f"missing REQUIRED params {req_missing}"
+    return None
+
+
+def run_signature_diff(ref_root: str, out=sys.stdout, namespaces=None):
+    """Signature-level audit: for every public name resolvable to a
+    Python def in the reference tree, compare argspecs with the live
+    paddle_tpu object. Returns (n_mismatch, n_compared)."""
+    import paddle_tpu
+
+    n_cmp = n_bad = 0
+    for display, rel, attr in (namespaces or NAMESPACES):
+        path = os.path.join(ref_root, "python", "paddle", rel)
+        names = ref_public_names(path)
+        if not names:
+            continue
+        ref_mod = "paddle" + ("." + rel[:-3].replace("/", ".")
+                              .replace(".__init__", "") if rel !=
+                              "__init__.py" else "")
+        mod = paddle_tpu
+        for part in attr.split("."):
+            if part:
+                mod = getattr(mod, part, None)
+            if mod is None:
+                break
+        if mod is None:
+            continue
+        for name in sorted(names):
+            obj = getattr(mod, name, None)
+            if obj is None or not callable(obj):
+                continue
+            ref_spec = resolve_ref_def(ref_root, ref_mod, name)
+            if ref_spec is None:
+                continue
+            our_spec = live_argspec(obj)
+            if our_spec is None:
+                continue
+            n_cmp += 1
+            bad = compare_signature(ref_spec, our_spec)
+            if bad:
+                n_bad += 1
+                print(f"SIG {display}.{name}: {bad}", file=out)
+    print(f"signatures compared: {n_cmp}, mismatches: {n_bad}", file=out)
+    return n_bad, n_cmp
+
+
 #: (display name, reference path relative to python/paddle/, attr path)
 NAMESPACES = [
     ("paddle", "__init__.py", ""),
@@ -114,9 +276,15 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--ref", default="/root/reference",
                     help="reference source tree root")
+    ap.add_argument("--signatures", action="store_true",
+                    help="also audit argspecs (names + requiredness) "
+                         "against the reference defs")
     args = ap.parse_args(argv)
     missing, skipped = run_diff(args.ref)
-    return 1 if (missing or skipped) else 0
+    bad = 0
+    if args.signatures:
+        bad, _ = run_signature_diff(args.ref)
+    return 1 if (missing or skipped or bad) else 0
 
 
 if __name__ == "__main__":

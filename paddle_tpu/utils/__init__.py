@@ -31,16 +31,19 @@ def run_check():
     return True
 
 
-def deprecated(update_to="", since="", reason=""):
-    """Reference: utils/deprecated.py decorator."""
+def deprecated(update_to="", since="", reason="", level=0):
+    """Reference: utils/deprecated.py decorator. level 0/1 warn on call;
+    level 2 raises (the reference's hard-removal stage)."""
 
     def decorator(fn):
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
             hint = f"; use {update_to} instead" if update_to else ""
-            warnings.warn(
-                f"{fn.__name__} is deprecated since {since or 'n/a'}"
-                f"{hint}. {reason}", DeprecationWarning, stacklevel=2)
+            msg = (f"{fn.__name__} is deprecated since {since or 'n/a'}"
+                   f"{hint}. {reason}")
+            if level >= 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
             return fn(*args, **kwargs)
         return wrapper
     return decorator

@@ -189,11 +189,13 @@ def yolo_loss(outputs: List[jax.Array], gt_box, gt_class,
               anchors: Sequence[int] = ANCHORS,
               anchor_masks=None, num_classes: int = 80,
               ignore_thresh: float = 0.7,
-              downsample_ratios=(32, 16, 8)):
+              downsample_ratios=(32, 16, 8), gt_score=None):
     """YOLOv3 loss (reference: `yolov3_loss_op.h` CalcYolov3Loss).
 
     gt_box: [B, MAX, 4] (cx, cy, w, h) normalized to [0,1];
     gt_class: [B, MAX] int label, < 0 for padding slots.
+    gt_score: [B, MAX] optional per-gt weight (mixup), multiplied into
+    the reference's 2-w*h box weight.
     Fully vectorized, static shapes: each gt picks its best wh-IoU anchor
     over all 9; the owning scale scatters targets at the center cell.
     """
@@ -239,6 +241,8 @@ def yolo_loss(outputs: List[jax.Array], gt_box, gt_class,
         th = jnp.log(jnp.maximum(gwh[..., 1] / sel_h, 1e-9))
         # reference box weight: 2 - w*h (small boxes weigh more)
         bw = 2.0 - gt_box[..., 2] * gt_box[..., 3]
+        if gt_score is not None:
+            bw = bw * gt_score
 
         # invalid slots (padding / other-scale gts) scatter to an
         # OUT-OF-BOUNDS cell dropped by XLA — writing 0.0 at their

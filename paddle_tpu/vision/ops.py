@@ -54,17 +54,24 @@ iou_similarity = box_iou  # reference alias (`iou_similarity_op.cc`)
 
 def yolo_box(x, img_size, anchors: Sequence[int], class_num: int,
              conf_thresh: float = 0.01, downsample_ratio: int = 32,
-             clip_bbox: bool = True, scale_x_y: float = 1.0):
+             clip_bbox: bool = True, name=None, scale_x_y: float = 1.0,
+             iou_aware: bool = False, iou_aware_factor: float = 0.5):
     """Decode one YOLO head (`yolo_box_op.cc`).
 
     x: [N, A*(5+C), H, W]; img_size: [N, 2] (h, w) int.
     Returns (boxes [N, A*H*W, 4] xyxy in image coords,
              scores [N, A*H*W, C]) — scores zeroed where objectness
     < conf_thresh (the reference's masking, not dynamic filtering).
+    iou_aware (PP-YOLO): x carries A extra leading IoU channels,
+    [N, A*(6+C), H, W]; conf = obj^(1-f) * sigmoid(ioup)^f.
     """
     anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
     A = anchors.shape[0]
     N, _, H, W = x.shape
+    ioup = None
+    if iou_aware:
+        ioup = jax.nn.sigmoid(x[:, :A].reshape(N, A, H, W))
+        x = x[:, A:]
     x = x.reshape(N, A, 5 + class_num, H, W)
     tx, ty, tw, th, tobj = (x[:, :, 0], x[:, :, 1], x[:, :, 2],
                             x[:, :, 3], x[:, :, 4])
@@ -98,6 +105,8 @@ def yolo_box(x, img_size, anchors: Sequence[int], class_num: int,
     boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(N, -1, 4)
 
     obj = jax.nn.sigmoid(tobj)
+    if ioup is not None:
+        obj = obj ** (1.0 - iou_aware_factor) * ioup ** iou_aware_factor
     obj = jnp.where(obj < conf_thresh, 0.0, obj)
     scores = (jax.nn.sigmoid(tcls) * obj[:, :, None]) \
         .transpose(0, 1, 3, 4, 2).reshape(N, -1, class_num)
@@ -333,7 +342,7 @@ def multiclass_nms(bboxes, scores, score_threshold: float = 0.01,
 
 
 def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
-                  dilation=1, deformable_groups=1, groups=1, mask=None):
+                  dilation=1, deformable_groups=1, groups=1, mask=None, name=None):
     """Deformable convolution v1/v2 (reference: `paddle.vision.ops.
     deform_conv2d`, deformable_conv_op.cu). Kernel taps sample the input
     at learned offsets via bilinear interpolation, then contract like a
@@ -448,15 +457,15 @@ class DeformConv2D(_Layer):
             mask=mask)
 
 
-def read_file(path):
+def read_file(filename, name=None):
     """Reference: `paddle.vision.ops.read_file` — raw file bytes as a
     uint8 tensor."""
-    with open(path, "rb") as f:
+    with open(filename, "rb") as f:
         data = f.read()
     return jnp.frombuffer(data, dtype=jnp.uint8)
 
 
-def decode_jpeg(x, mode="unchanged"):
+def decode_jpeg(x, mode="unchanged", name=None):
     """Reference: `paddle.vision.ops.decode_jpeg` (nvjpeg). Decodes via
     PIL on host; returns CHW uint8."""
     import io
@@ -478,4 +487,28 @@ def decode_jpeg(x, mode="unchanged"):
     return jnp.asarray(arr)
 
 
-from .models.yolo import yolo_loss  # noqa: F401,E402
+from .models.yolo import yolo_loss as _yolo_loss_multi  # noqa: E402
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """Reference-arity per-scale YOLOv3 loss (`yolov3_loss_op.h`): one
+    head `x` with its `anchor_mask` slice of the flat `anchors` list.
+    The multi-scale training path is `models.yolo.yolo_loss`, which this
+    wraps with a single output. `use_label_smooth`/`scale_x_y` are the
+    reference's kernel toggles; the lowering uses the default (off/1.0)
+    formulation."""
+    if isinstance(x, (list, tuple)):  # tolerate the multi-scale call style
+        return _yolo_loss_multi(list(x), gt_box, gt_label, anchors=anchors,
+                                anchor_masks=anchor_mask,
+                                num_classes=class_num,
+                                ignore_thresh=ignore_thresh,
+                                downsample_ratios=downsample_ratio,
+                                gt_score=gt_score)
+    return _yolo_loss_multi([x], gt_box, gt_label, anchors=anchors,
+                            anchor_masks=[list(anchor_mask)],
+                            num_classes=class_num,
+                            ignore_thresh=ignore_thresh,
+                            downsample_ratios=(downsample_ratio,),
+                            gt_score=gt_score)

@@ -22,14 +22,35 @@ class Compose:
 
 
 class BaseTransform:
-    def __call__(self, img):
-        return self._apply_image(np.asarray(img))
+    """`keys` (reference: transforms.BaseTransform) routes tuple inputs:
+    each element is dispatched to `_apply_<key>` ("image" -> the numpy
+    image path; unknown keys pass through unchanged). keys=None keeps
+    the common single-image calling convention."""
+
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, inputs):
+        if getattr(self, "keys", None) is None:
+            return self._apply_image(np.asarray(inputs))
+        if not isinstance(inputs, (list, tuple)):
+            inputs = (inputs,)
+        outs = []
+        for key, data in zip(self.keys, inputs):
+            fn = getattr(self, "_apply_" + key, None)
+            if key == "image":
+                data = self._apply_image(np.asarray(data))
+            elif fn is not None:
+                data = fn(data)
+            outs.append(data)
+        return tuple(outs) if len(outs) > 1 else outs[0]
 
 
 class ToTensor(BaseTransform):
     """HWC uint8 [0,255] → CHW float32 [0,1] (reference: to_tensor)."""
 
-    def __init__(self, data_format="CHW"):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
         self.data_format = data_format
 
     def _apply_image(self, img):
@@ -45,7 +66,9 @@ class ToTensor(BaseTransform):
 
 class Normalize(BaseTransform):
     def __init__(self, mean=0.0, std=1.0, data_format="CHW",
-                 to_rgb=False):
+                 to_rgb=False, keys=None):
+        super().__init__(keys)
+        self.to_rgb = to_rgb
         if isinstance(mean, numbers.Number):
             mean = [mean, mean, mean]
         if isinstance(std, numbers.Number):
@@ -57,7 +80,11 @@ class Normalize(BaseTransform):
     def _apply_image(self, img):
         img = img.astype(np.float32)
         if self.data_format == "CHW":
+            if self.to_rgb:
+                img = img[::-1]
             return (img - self.mean[:, None, None]) / self.std[:, None, None]
+        if self.to_rgb:
+            img = img[..., ::-1]
         return (img - self.mean) / self.std
 
 
@@ -77,7 +104,8 @@ def _resize_np(img, size):
 
 
 class Resize(BaseTransform):
-    def __init__(self, size, interpolation="nearest"):
+    def __init__(self, size, interpolation="nearest", keys=None):
+        super().__init__(keys)
         self.size = size
 
     def _apply_image(self, img):
@@ -85,7 +113,8 @@ class Resize(BaseTransform):
 
 
 class CenterCrop(BaseTransform):
-    def __init__(self, size):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
         self.size = (size, size) if isinstance(size, int) else tuple(size)
 
     def _apply_image(self, img):
@@ -97,24 +126,31 @@ class CenterCrop(BaseTransform):
 
 
 class RandomCrop(BaseTransform):
-    def __init__(self, size, padding=None):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
         self.size = (size, size) if isinstance(size, int) else tuple(size)
         self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill, self.padding_mode = fill, padding_mode
 
     def _apply_image(self, img):
         if self.padding:
-            p = self.padding
-            pad = ((p, p), (p, p)) + ((0, 0),) * (img.ndim - 2)
-            img = np.pad(img, pad, mode="constant")
+            img = pad(img, self.padding, self.fill, self.padding_mode)
         h, w = img.shape[:2]
         th, tw = self.size
+        if self.pad_if_needed and (h < th or w < tw):
+            img = pad(img, (0, 0, max(0, tw - w), max(0, th - h)),
+                      self.fill, self.padding_mode)
+            h, w = img.shape[:2]
         i = random.randint(0, max(0, h - th))
         j = random.randint(0, max(0, w - tw))
         return img[i:i + th, j:j + tw]
 
 
 class RandomHorizontalFlip(BaseTransform):
-    def __init__(self, prob=0.5):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
         self.prob = prob
 
     def _apply_image(self, img):
@@ -124,7 +160,8 @@ class RandomHorizontalFlip(BaseTransform):
 
 
 class RandomVerticalFlip(BaseTransform):
-    def __init__(self, prob=0.5):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
         self.prob = prob
 
     def _apply_image(self, img):
@@ -134,7 +171,8 @@ class RandomVerticalFlip(BaseTransform):
 
 
 class Transpose(BaseTransform):
-    def __init__(self, order=(2, 0, 1)):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
         self.order = order
 
     def _apply_image(self, img):
@@ -144,20 +182,20 @@ class Transpose(BaseTransform):
 
 
 # functional aliases (paddle.vision.transforms.functional subset)
-def to_tensor(img, data_format="CHW"):
-    return ToTensor(data_format)(img)
+def to_tensor(pic, data_format="CHW"):
+    return ToTensor(data_format)(pic)
 
 
-def normalize(img, mean, std, data_format="CHW"):
-    return Normalize(mean, std, data_format)(img)
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format, to_rgb)(img)
 
 
 def resize(img, size, interpolation="nearest"):
     return _resize_np(np.asarray(img), size)
 
 
-def center_crop(img, size):
-    return CenterCrop(size)(img)
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
 
 
 def hflip(img):
@@ -318,7 +356,8 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
 # ---- transform classes
 
 class BrightnessTransform(BaseTransform):
-    def __init__(self, value):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
         if value < 0:
             raise ValueError("brightness value must be non-negative")
         self.value = float(value)
@@ -331,7 +370,8 @@ class BrightnessTransform(BaseTransform):
 
 
 class ContrastTransform(BaseTransform):
-    def __init__(self, value):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
         if value < 0:
             raise ValueError("contrast value must be non-negative")
         self.value = float(value)
@@ -344,7 +384,8 @@ class ContrastTransform(BaseTransform):
 
 
 class SaturationTransform(BaseTransform):
-    def __init__(self, value):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
         if value < 0:
             raise ValueError("saturation value must be non-negative")
         self.value = float(value)
@@ -357,7 +398,8 @@ class SaturationTransform(BaseTransform):
 
 
 class HueTransform(BaseTransform):
-    def __init__(self, value):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
         if not 0 <= value <= 0.5:
             raise ValueError("hue value must be in [0, 0.5]")
         self.value = float(value)
@@ -372,7 +414,9 @@ class ColorJitter(BaseTransform):
     """Randomly ordered brightness/contrast/saturation/hue jitter
     (reference: transforms.ColorJitter)."""
 
-    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
         self.transforms = [BrightnessTransform(brightness),
                            ContrastTransform(contrast),
                            SaturationTransform(saturation),
@@ -387,7 +431,8 @@ class ColorJitter(BaseTransform):
 
 
 class Grayscale(BaseTransform):
-    def __init__(self, num_output_channels=1):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
         self.num_output_channels = num_output_channels
 
     def _apply_image(self, img):
@@ -395,7 +440,9 @@ class Grayscale(BaseTransform):
 
 
 class Pad(BaseTransform):
-    def __init__(self, padding, fill=0, padding_mode="constant"):
+    def __init__(self, padding, fill=0, padding_mode="constant",
+                 keys=None):
+        super().__init__(keys)
         self.padding, self.fill = padding, fill
         self.padding_mode = padding_mode
 
@@ -405,7 +452,8 @@ class Pad(BaseTransform):
 
 class RandomRotation(BaseTransform):
     def __init__(self, degrees, interpolation="nearest", expand=False,
-                 center=None, fill=0):
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
         if isinstance(degrees, numbers.Number):
             degrees = (-abs(degrees), abs(degrees))
         self.degrees = tuple(degrees)
@@ -424,7 +472,8 @@ class RandomResizedCrop(BaseTransform):
     transforms.RandomResizedCrop, the Inception-style augmentation)."""
 
     def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
-                 interpolation="nearest"):
+                 interpolation="nearest", keys=None):
+        super().__init__(keys)
         self.size = (size, size) if isinstance(size, int) else tuple(size)
         self.scale, self.ratio = scale, ratio
         self.interpolation = interpolation

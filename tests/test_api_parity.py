@@ -259,3 +259,36 @@ def test_all_namespaces_complete():
     buf = _io.StringIO()
     missing, skipped = run_diff(REF_ROOT, out=buf)
     assert missing == 0 and skipped == 0, buf.getvalue()
+
+
+@pytest.mark.skipif(not os.path.exists(REF_INIT),
+                    reason="reference tree not mounted")
+def test_signature_freeze():
+    """Signature-level gate (reference: tools/print_signatures.py +
+    check_api_compatible.py): every public callable resolvable to a
+    Python def in the reference tree must accept the reference's
+    parameter NAMES, and its required params, by name. A wrong-arity
+    shim (e.g. dropping `name=` or renaming `x`) fails here."""
+    import io as _io
+
+    from paddle_tpu.tools.api_diff import run_signature_diff
+    buf = _io.StringIO()
+    bad, compared = run_signature_diff(REF_ROOT, out=buf)
+    assert compared > 500, f"signature sweep shrank: only {compared}"
+    assert bad == 0, buf.getvalue()
+
+
+@pytest.mark.skipif(not os.path.exists(REF_INIT),
+                    reason="reference tree not mounted")
+def test_signature_freeze_catches_arity_break():
+    """The gate actually bites: a deliberately wrong argspec for a
+    known API is reported as a mismatch."""
+    from paddle_tpu.tools.api_diff import (compare_signature, live_argspec,
+                                           resolve_ref_def)
+    ref = resolve_ref_def(REF_ROOT, "paddle.tensor.math", "add")
+    assert ref is not None
+
+    def bad_add(a, b):  # wrong param names, no **kwargs
+        return a + b
+
+    assert compare_signature(ref, live_argspec(bad_add)) is not None
