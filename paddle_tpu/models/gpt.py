@@ -115,8 +115,14 @@ class GPTDecoderLayer(Layer):
         qkv = jnp.reshape(qkv, (b, s, 3, h, hd))
         # heads sharded over 'model' (column shards = contiguous head groups)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True,
-                                              training=self.training)
+        sp_attn = getattr(self, "_sp_attention", None)
+        if sp_attn is not None:
+            # sequence-parallel ring attention over the 'sequence' mesh
+            # axis (set by build_train_step when the mesh has one)
+            attn = sp_attn(q, k, v)
+        else:
+            attn = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                                  training=self.training)
         # named so the "dots_attn" remat policy can SAVE it (skips the
         # flash-kernel forward replay in the backward pass)
         from jax.ad_checkpoint import checkpoint_name
@@ -260,14 +266,17 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
                      num_microbatches: int = 1, remat: bool = True,
                      donate: bool = True, pipeline_schedule: str = "gpipe",
                      remat_policy: str = "dots", loss_chunks: int = 0,
-                     zero_stage: int = 2):
+                     zero_stage: int = 2, sequence_zigzag: bool = True):
     """Build the one compiled hybrid-parallel training step.
 
     Parallelism comes entirely from the mesh axes: 'data' (DP — batch dim),
     'model' (TP — weight PartitionSpecs), 'pipe' (PP — stacked blocks via
     the CollectivePermute schedule), 'sharding' (ZeRO — optimizer-state
-    specs). This replaces the reference's whole meta-optimizer chain
-    (`fleet_base.py:1288` → StrategyCompiler → program rewriting).
+    specs), 'sequence' (SP — activations sharded on the seq dim with
+    zigzag-balanced causal ring attention in every decoder layer;
+    composes with dp×tp×zero, pp excluded). This replaces the
+    reference's whole meta-optimizer chain (`fleet_base.py:1288` →
+    StrategyCompiler → program rewriting).
 
     Returns (step_fn, state) where state = (outer, stacked_blocks,
     opt_state) and step_fn(state, batch) -> (state, loss);
@@ -278,6 +287,7 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
     cfg = model.config
     axis = dict(zip(mesh.axis_names, mesh.devices.shape))
     pp = axis.get("pipe", 1)
+    sp = axis.get("sequence", 1)
     assert cfg.num_layers % pp == 0, "num_layers must divide pipe axis"
     layers_per_stage = cfg.num_layers // pp
     if pp > 1 and num_microbatches < pp:
@@ -285,13 +295,30 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
             f"num_microbatches={num_microbatches} < pipeline stages "
             f"{pp}: the schedule needs at least one microbatch per stage; "
             f"using {pp}", stacklevel=2)
+    if sp > 1:
+        # sequence parallelism composes with dp x tp x zero; the pipeline
+        # schedules split the batch dim, which is orthogonal but untested
+        # together — keep the claim honest
+        assert pp == 1, "sequence axis with pipe axis is unsupported"
+        if loss_chunks > 1:
+            warnings.warn("loss_chunks disabled under sequence "
+                          "parallelism (the chunk scan would re-slice the "
+                          "sequence-sharded dim)", stacklevel=2)
+            loss_chunks = 0
 
     outer, block_list = _split_params(model)
     stacked = stack_stage_params(block_list)  # leaves [L, ...]
     template = model.gpt.layers[0]
 
     def block_apply(bparams, x):
-        out, _ = functional_call(template, bparams, x)
+        # _sp_attention is scoped to THIS trace (set/restore, not a
+        # permanent template mutation): the model stays usable eagerly
+        # and under other meshes after the step is built
+        template._sp_attention = sp_attn_fn
+        try:
+            out, _ = functional_call(template, bparams, x)
+        finally:
+            template._sp_attention = None
         return out
 
     if remat_policy == "full":
@@ -316,8 +343,12 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
         # pushes/pops the scoped key within one trace, so no inner-trace
         # key tracer survives in the thread-local scope (leak otherwise)
         from ..framework.random import rng_guard
-        with rng_guard(key):
-            out, _ = functional_call(template, bparams, x)
+        template._sp_attention = sp_attn_fn
+        try:
+            with rng_guard(key):
+                out, _ = functional_call(template, bparams, x)
+        finally:
+            template._sp_attention = None
         return out
 
     def stage_blocks(stage_p, h, key=None):
@@ -350,9 +381,36 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
             lambda a: a.reshape((pp, layers_per_stage) + a.shape[1:]),
             stacked_p)
 
-    def embed_fwd(input_ids):
-        x = model.gpt.embeddings(input_ids)
-        return _constrain(x, ("data", "sharding"), None, None)
+    seq_axis = "sequence" if sp > 1 else None
+
+    def embed_fwd(input_ids, position_ids=None):
+        x = model.gpt.embeddings(input_ids, position_ids)
+        return _constrain(x, ("data", "sharding"), seq_axis, None)
+
+    if sp > 1:
+        from ..distributed.meta_parallel.sequence_parallel import (
+            make_sp_attention, zigzag_permutation)
+        sp_attn_fn = make_sp_attention(
+            mesh, mode="ring", causal=True, zigzag=sequence_zigzag,
+            jit=False)
+
+        def sp_layout(input_ids, labels):
+            """Zigzag-reorder tokens so each rank gets an equal share of
+            causal-mask work; position ids carry the original positions
+            (loss is a position-wise mean — invariant to the reorder)."""
+            if not sequence_zigzag:
+                return input_ids, labels, None
+            zperm = jnp.asarray(
+                zigzag_permutation(input_ids.shape[1], sp), jnp.int32)
+            ids_z = jnp.take(input_ids, zperm, axis=1)
+            labels_z = jnp.take(labels, zperm, axis=1)
+            pos = jnp.broadcast_to(zperm[None, :], ids_z.shape)
+            return ids_z, labels_z, pos
+    else:
+        sp_attn_fn = None
+
+        def sp_layout(input_ids, labels):
+            return input_ids, labels, None
 
     def trunk(stacked_p, x, key=None):
         """Apply all L blocks: scan over layers (and pipeline over stages
@@ -393,7 +451,7 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
 
     def loss_fn(params, batch):
         outer_p, stacked_p = params
-        input_ids, labels = batch
+        input_ids, labels, pos_ids = sp_layout(*batch)
         # embeddings + ln_f + head run via functional_call on the model with
         # outer params; trunk handled functionally
         def fwd():
@@ -405,10 +463,10 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
                 from ..framework.random import next_key, rng_guard
                 base = next_key()
                 with rng_guard(jax.random.fold_in(base, 0)):
-                    x = embed_fwd(input_ids)
+                    x = embed_fwd(input_ids, pos_ids)
                 x = trunk(stacked_p, x, key=jax.random.fold_in(base, 1))
             else:
-                x = embed_fwd(input_ids)
+                x = embed_fwd(input_ids, pos_ids)
                 x = trunk(stacked_p, x)
             return lm_loss(x, labels)
         out, _ = functional_call_outer(model, outer_p, fwd)
@@ -593,8 +651,8 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
     # states — the batch splits over data×sharding jointly (reference:
     # sharding_degree multiplies dp for the data split,
     # sharding_optimizer.py:968 _build_groups)
-    batch_sharding = (ns(P(("data", "sharding"), None)),
-                      ns(P(("data", "sharding"), None)))
+    batch_sharding = (ns(P(("data", "sharding"), seq_axis)),
+                      ns(P(("data", "sharding"), seq_axis)))
 
     if cfg.dropout > 0.0:
         step_jit = jax.jit(

@@ -55,3 +55,76 @@ class TestUlysses:
         ref = _xla_attention(q, k, v, None, 0.0, causal, False, None)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-4, atol=2e-5)
+
+
+class TestZigzagRing:
+    def test_zigzag_matches_dense(self, sp_mesh):
+        """Zigzag-layout ring attention == dense attention computed on the
+        zigzag-permuted inputs (positions thread the true causal mask)."""
+        from paddle_tpu.distributed.meta_parallel.sequence_parallel import \
+            zigzag_permutation
+        q, k, v = _qkv(s=32)
+        perm = zigzag_permutation(32, 8)
+        qz, kz, vz = (jnp.take(t, perm, axis=1) for t in (q, k, v))
+        fn = make_sp_attention(sp_mesh, mode="ring", causal=True,
+                               zigzag=True)
+        out_z = fn(qz, kz, vz)
+        # dense reference in the ORIGINAL order, then permuted
+        ref = _xla_attention(q, k, v, None, 0.0, True, False, None)
+        np.testing.assert_allclose(np.asarray(out_z),
+                                   np.asarray(jnp.take(ref, perm, axis=1)),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_zigzag_permutation_is_permutation(self):
+        from paddle_tpu.distributed.meta_parallel.sequence_parallel import \
+            zigzag_permutation
+        perm = zigzag_permutation(64, 4)
+        assert sorted(perm.tolist()) == list(range(64))
+        # rank r's shard holds chunk r and chunk 2*sp-1-r
+        shard0 = perm[:16]
+        assert set(shard0.tolist()) == set(range(0, 8)) | set(range(56, 64))
+
+
+class TestSPTrainStep:
+    """SP composed into the flagship step (VERDICT r3 item 7): loss
+    parity between an sp=4 x dp=2 mesh and a plain dp=1 run."""
+
+    def _loss(self, mesh_fn, **kw):
+        import paddle_tpu as pt
+        from paddle_tpu.models import GPTForPretraining, build_train_step, \
+            gpt_tiny
+
+        mesh = mesh_fn()   # build right before use: _constrain reads the
+        pt.seed(0)         # global mesh set by build_mesh
+        cfg = gpt_tiny()
+        model = GPTForPretraining(cfg)
+        opt = pt.optimizer.AdamW(learning_rate=1e-4)
+        step, state = build_train_step(model, opt, mesh, **kw)
+        rs = np.random.RandomState(7)
+        ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (8, 64)), jnp.int32)
+        labels = jnp.asarray(rs.randint(0, cfg.vocab_size, (8, 64)),
+                             jnp.int32)
+        losses = []
+        for _ in range(2):
+            state, loss = step(state, (ids, labels))
+            losses.append(float(loss))
+        return losses
+
+    def test_sp_loss_parity(self):
+        l_sp = self._loss(lambda: build_mesh(dp=2, sp=4))
+        l_ref = self._loss(lambda: build_mesh(dp=1))
+        np.testing.assert_allclose(l_sp, l_ref, rtol=2e-4)
+
+    def test_sp_contiguous_loss_parity(self):
+        """Non-zigzag (contiguous) SP layout also matches."""
+        l_sp = self._loss(lambda: build_mesh(dp=2, sp=4),
+                          sequence_zigzag=False)
+        l_ref = self._loss(lambda: build_mesh(dp=1))
+        np.testing.assert_allclose(l_sp, l_ref, rtol=2e-4)
+
+    def test_sp_with_tp_and_zero(self):
+        """4-way compose: dp(sharding) x tp x sp in ONE step."""
+        l = self._loss(lambda: build_mesh(sharding=2, mp=2, sp=2),
+                       zero_stage=3)
+        l_ref = self._loss(lambda: build_mesh(dp=1))
+        np.testing.assert_allclose(l, l_ref, rtol=2e-4)
