@@ -53,16 +53,129 @@ def cond(pred, true_fn: Callable, false_fn: Callable, name=None):
                     operand=None)
 
 
+def _static_while_loop(cond_fn, body_fn, loop_vars):
+    """Sub-program capture for build-time while_loop (reference:
+    `while_op.cc` + control_flow.py:1115, where cond/body live in a
+    nested Block run by the C++ WhileOp executor).
+
+    cond_fn/body_fn are traced ONCE over fresh sub-Variables; every op
+    they emit is captured into a sub-Program (`capture_program`). The
+    outer program gets a single op whose fn replays the captured ops
+    inside `lax.while_loop` — loop-carried values bind to the
+    sub-Variable names, captured outer Variables ride in as loop
+    invariants. Loop shapes must be iteration-static (the XLA contract,
+    same as the reference's RaiseError on shape-changing while bodies).
+    Reverse-mode grads through the loop are not defined (lax.while_loop
+    is not reverse-differentiable) — matching decode/inference usage.
+    """
+    from .program import Program, Variable, capture_program, record
+
+    loop_vars = list(loop_vars)
+    enforce(all(_is_static_var(v) for v in loop_vars),
+            "static while_loop: every loop var must be a static Variable")
+    sub = Program()
+    svars = []
+    for i, v in enumerate(loop_vars):
+        sv = Variable(sub, f"__loop_carry_{i}", v.shape, v.dtype)
+        sub._vars[sv.name] = sv
+        svars.append(sv)
+
+    with capture_program(sub):
+        cond_v = cond_fn(*svars)
+        out = body_fn(*svars)
+    body_out = list(out) if isinstance(out, (list, tuple)) else [out]
+    enforce(len(body_out) == len(loop_vars),
+            "body_fn must return as many values as loop_vars")
+    enforce(_is_static_var(cond_v),
+            "cond_fn must return a static Variable (record at least one "
+            "op on the loop vars)")
+
+    # captured outer Variables = sub-op inputs owned by another program
+    carry_names = {sv.name for sv in svars}
+    sub_names = set(carry_names)
+    for op in sub.ops:
+        sub_names.update(o.name for o in op.outputs)
+    invariants = []
+    seen = set()
+    for op in sub.ops:
+        for iv in op.inputs:
+            if iv.name not in sub_names and iv.name not in seen:
+                seen.add(iv.name)
+                invariants.append(iv)
+
+    all_ops = list(sub.ops)
+
+    def _ancestors(targets):
+        """Ops needed (transitively) for `targets` — cond must not pay
+        for body-only ops: XLA cannot CSE across a while op's separate
+        cond and body computations."""
+        need = {t.name for t in targets if _is_static_var(t)}
+        sel = []
+        for op in reversed(all_ops):
+            if any(o.name in need for o in op.outputs):
+                sel.append(op)
+                need.update(iv.name for iv in op.inputs)
+        return list(reversed(sel))
+
+    cond_ops = _ancestors([cond_v])
+    body_ops = _ancestors(body_out)
+
+    def _replay(env, targets, ops):
+        for op in ops:
+            if all(o.name in env for o in op.outputs):
+                continue
+            call_with, _ = op.arg_template
+            vals = [env[v.name] for v in op.inputs]
+            if op.layer is not None:
+                lp = {n: p.value for n, p in op.layer.named_parameters()}
+                lb = {n: b.value for n, b in
+                      (op.layer.named_buffers()
+                       if hasattr(op.layer, "named_buffers") else {})}
+                o, _nb = call_with(vals, op.attrs, lp, lb or None)
+            else:
+                o, _ = call_with(vals, op.attrs)
+            flat = jax.tree.flatten(o)[0]
+            for var, val in zip(op.outputs, flat):
+                env[var.name] = val
+        return [env[t.name] for t in targets]
+
+    n_carry = len(svars)
+
+    def while_fn(*vals):
+        carry0 = tuple(jnp.asarray(v) for v in vals[:n_carry])
+        inv = dict(zip((iv.name for iv in invariants), vals[n_carry:]))
+
+        def mkenv(carry):
+            env = dict(inv)
+            env.update(zip((sv.name for sv in svars), carry))
+            return env
+
+        def cond(carry):
+            c = _replay(mkenv(carry), [cond_v], cond_ops)[0]
+            return jnp.reshape(jnp.asarray(c, bool), ())
+
+        def body(carry):
+            outs = _replay(mkenv(carry), body_out, body_ops)
+            # preserve carry dtypes/shapes (XLA while invariant)
+            return tuple(jnp.asarray(o, c.dtype)
+                         for o, c in zip(outs, carry0))
+
+        return lax.while_loop(cond, body, carry0)
+
+    outs = record(while_fn, tuple(loop_vars + invariants), {},
+                  hint="while", op_type="while")
+    return list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+
 def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars,
                is_test=False, name=None):
-    """Reference: control_flow.py:1115. loop_vars is a list/tuple pytree."""
+    """Reference: control_flow.py:1115. loop_vars is a list/tuple pytree.
+    Build-time static Variables go through sub-program capture
+    (`_static_while_loop`); traced values lower to lax.while_loop;
+    concrete values run the Python loop eagerly."""
     loop_vars = tuple(loop_vars)
     if any(_is_static_var(v) for v in loop_vars):
-        raise NotImplementedError(
-            "static.nn.while_loop over build-time Variables needs "
-            "sub-program capture, which the record/replay engine does "
-            "not implement; run the loop inside @paddle.jit.to_static "
-            "(where it lowers to lax.while_loop) instead.")
+        return _static_while_loop(cond_fn, body_fn, loop_vars)
 
     concrete = not any(_is_traced(v) for v in jax.tree.leaves(loop_vars))
     if concrete:
@@ -556,13 +669,14 @@ def crf_decoding(input, param_attr=None, label=None, length=None,
                   hint=name or "crf_decoding")
 
 
-def deform_conv2d(input, offset, mask=None, num_filters=1, filter_size=3,
+def deform_conv2d(x, offset, mask=None, num_filters=1, filter_size=3,
                   stride=1, padding=0, dilation=1, groups=1,
-                  deformable_groups=1, im2col_step=1, param_attr=None,
+                  deformable_groups=1, im2col_step=1, weight_attr=None,
                   bias_attr=None, modulated=True, name=None):
     """Reference: fluid/layers/nn.py deformable_conv (deformable_conv_op).
     Thin static builder over `vision.ops.deform_conv2d` (the bilinear-
     sampled tap implementation lives there)."""
+    input, param_attr = x, weight_attr
     from ..nn.layer import Layer
     from ..vision import ops as V
 
@@ -730,7 +844,7 @@ def sequence_reshape(input, new_dim):
     return run(input)
 
 
-def sequence_expand_as(x, y):
+def sequence_expand_as(x, y, name=None):
     from ..tensor import sequence as S
 
     def run(a, b):
@@ -743,7 +857,7 @@ def sequence_expand_as(x, y):
     return run(x, y)
 
 
-def sequence_scatter(input, index, updates):
+def sequence_scatter(input, index, updates, name=None):
     def run(x, idx, upd):
         return x.at[idx].add(upd)
 

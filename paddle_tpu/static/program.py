@@ -40,16 +40,29 @@ import jax.numpy as jnp
 class Variable:
     """Symbolic handle for a value produced inside a Program."""
 
-    def __init__(self, program: "Program", name: str, shape, dtype,
-                 is_data: bool = False, lod_level: int = 0):
-        self.program = program
+    def __init__(self, block, name: str = None, shape=None, dtype=None,
+                 is_data: bool = False, lod_level: int = 0, type=None,
+                 capacity=None, persistable=False, error_clip=None,
+                 stop_gradient=None, need_check_feed=False,
+                 belong_to_optimizer=False):
+        # first positional is the owning Program (the reference's Block;
+        # ref: framework.py Variable.__init__ — extra params accepted
+        # for constructor parity)
+        self.program = block
         self.name = name
-        self.shape = tuple(shape)
-        self.dtype = np.dtype(dtype)
+        # the reference allows shape-/dtype-less variables (RAW types)
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = np.dtype(dtype) if dtype is not None else None
         self.is_data = is_data
         self.lod_level = lod_level
-        self.stop_gradient = is_data
-        self.persistable = False
+        self.type = type
+        self.capacity = capacity
+        self.error_clip = error_clip
+        self.need_check_feed = need_check_feed
+        self.belong_to_optimizer = belong_to_optimizer
+        self.stop_gradient = is_data if stop_gradient is None \
+            else stop_gradient
+        self.persistable = persistable
 
     # ---- numpy-style niceties
     @property
@@ -362,6 +375,30 @@ def _placeholder(var: Variable):
     return jax.ShapeDtypeStruct(shape, var.dtype)
 
 
+#: active sub-program capture (static.nn.while_loop): when set, record()
+#: appends ops here instead of the first input Variable's program — loop
+#: bodies may mix loop-carried sub-Variables with captured outer
+#: Variables, and every op they emit belongs to the sub-program.
+_capture_stack: List["Program"] = []
+
+
+class capture_program:
+    """Scope that redirects record() into `prog` (sub-program capture,
+    the record/replay analogue of the reference's nested-Block builders
+    in `fluid/layers/control_flow.py`)."""
+
+    def __init__(self, prog: "Program"):
+        self.prog = prog
+
+    def __enter__(self):
+        _capture_stack.append(self.prog)
+        return self.prog
+
+    def __exit__(self, *exc):
+        _capture_stack.pop()
+        return False
+
+
 def record(fn: Callable, args: tuple, kwargs: dict, layer=None,
            hint: str = "tmp", op_type: str = "op"):
     """Record `fn(*args, **kwargs)` (Variables among args become runtime
@@ -384,7 +421,7 @@ def record(fn: Callable, args: tuple, kwargs: dict, layer=None,
                [v for kv in kwargs.values() for v in _vars_in(kv)]
     if not var_args:
         raise ValueError("record() needs at least one Variable input")
-    prog = var_args[0].program
+    prog = _capture_stack[-1] if _capture_stack else var_args[0].program
 
     kwargs = dict(kwargs)
     if layer is None:
@@ -475,6 +512,10 @@ _DISPATCH_TOP = [
     "subtract", "divide", "sqrt", "square", "abs", "clip", "flatten",
     "argmax", "argmin", "exp", "log", "stack", "tanh", "pow", "maximum",
     "minimum",
+    # while_loop-body staples (reference: control_flow/compare ops)
+    "increment", "less_than", "less_equal", "greater_than",
+    "greater_equal", "equal", "not_equal", "logical_and", "logical_or",
+    "logical_not", "scatter", "gather", "where", "assign",
 ]
 _DISPATCH_F = [
     "relu", "sigmoid", "tanh", "softmax", "cross_entropy",

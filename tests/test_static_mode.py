@@ -608,11 +608,67 @@ class TestReviewRegressions:
         assert abs(run(3.0) - 6.0) < 1e-5
         assert abs(run(-2.0) - (-3.0)) < 1e-5
 
-    def test_while_loop_static_var_raises(self, static_mode):
+    def test_while_loop_static_scalar(self, static_mode):
+        """Build-time while_loop via sub-program capture (VERDICT r3
+        item 5 — the reference's while_op nested Block)."""
         main, startup = _programs()
         with paddle.static.program_guard(main, startup):
             x = paddle.static.data("x", [None], "float32")
             m = paddle.mean(x)
-            with pytest.raises(NotImplementedError, match="to_static"):
-                paddle.static.nn.while_loop(lambda v: v < 10,
-                                            lambda v: v + 1, [m])
+            (out,) = paddle.static.nn.while_loop(lambda v: v < 10.0,
+                                                 lambda v: v + 3.0, [m])
+        exe = paddle.static.Executor()
+        r = float(exe.run(main, feed={"x": np.full((4,), 1.5, np.float32)},
+                          fetch_list=[out])[0])
+        # 1.5 -> 4.5 -> 7.5 -> 10.5
+        assert abs(r - 10.5) < 1e-5
+
+    def test_while_loop_captures_outer_variable(self, static_mode):
+        """Loop body closes over an outer Variable (loop invariant)."""
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None], "float32")
+            step = paddle.mean(x)            # outer var used in the body
+            i = paddle.sum(x * 0.0)          # starts at 0
+            (cnt,) = paddle.static.nn.while_loop(
+                lambda v: v < 6.0, lambda v: v + step, [i])
+        exe = paddle.static.Executor()
+        r = float(exe.run(main, feed={"x": np.full((2,), 2.0, np.float32)},
+                          fetch_list=[cnt])[0])
+        assert abs(r - 6.0) < 1e-5  # 0 -> 2 -> 4 -> 6
+
+    def test_while_loop_greedy_decode(self, static_mode):
+        """Decode-style loop: tensor carry updated per step with scatter
+        (the static machine-translation decode pattern, reference book
+        example ported to buffer-update form)."""
+        max_len = 5
+        main, startup = _programs()
+        with paddle.static.program_guard(main, startup):
+            logits_w = paddle.static.data("w", [3, 3], "float32")
+            start = paddle.static.data("s", [1], "float32")
+            buf = paddle.concat([start * 0.0] * max_len)   # [max_len]
+            i = paddle.sum(start * 0.0)
+            tok = paddle.sum(start)
+
+            def cond(i, tok, buf):
+                return i < float(max_len)
+
+            def body(i, tok, buf):
+                row = paddle.cast(tok, "int32")
+                scores = paddle.gather(logits_w, row)       # [3]
+                nxt = paddle.cast(paddle.argmax(scores), "float32")
+                buf = paddle.scatter(
+                    paddle.reshape(buf, [max_len, 1]),
+                    paddle.reshape(paddle.cast(i, "int64"), [1]),
+                    paddle.reshape(nxt, [1, 1]))
+                return [i + 1.0, nxt, paddle.reshape(buf, [max_len])]
+
+            i_f, tok_f, buf_f = paddle.static.nn.while_loop(
+                cond, body, [i, tok, buf])
+        exe = paddle.static.Executor()
+        # transition matrix: argmax row k -> token (k+1) % 3
+        w = np.eye(3, dtype=np.float32)[:, [1, 2, 0]].T
+        out = exe.run(main, feed={"w": w.astype(np.float32),
+                                  "s": np.zeros(1, np.float32)},
+                      fetch_list=[buf_f])[0]
+        np.testing.assert_allclose(out, [1, 2, 0, 1, 2], atol=1e-6)
