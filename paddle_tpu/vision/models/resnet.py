@@ -22,14 +22,18 @@ class BasicBlock(Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, dilation=1, norm_layer=None):
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
-        norm_layer = norm_layer or BatchNorm2D
+        df = data_format
+        norm_layer = norm_layer or (
+            lambda c: BatchNorm2D(c, data_format=df))
         self.conv1 = Conv2D(inplanes, planes, 3, stride=stride, padding=1,
-                            bias_attr=False)
+                            bias_attr=False, data_format=df)
         self.bn1 = norm_layer(planes)
         self.relu = ReLU()
-        self.conv2 = Conv2D(planes, planes, 3, padding=1, bias_attr=False)
+        self.conv2 = Conv2D(planes, planes, 3, padding=1, bias_attr=False,
+                            data_format=df)
         self.bn2 = norm_layer(planes)
         self.downsample = downsample
         self.stride = stride
@@ -47,18 +51,22 @@ class BottleneckBlock(Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, dilation=1, norm_layer=None):
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
-        norm_layer = norm_layer or BatchNorm2D
+        df = data_format
+        norm_layer = norm_layer or (
+            lambda c: BatchNorm2D(c, data_format=df))
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = Conv2D(inplanes, width, 1, bias_attr=False)
+        self.conv1 = Conv2D(inplanes, width, 1, bias_attr=False,
+                            data_format=df)
         self.bn1 = norm_layer(width)
         self.conv2 = Conv2D(width, width, 3, stride=stride, padding=dilation,
                             groups=groups, dilation=dilation,
-                            bias_attr=False)
+                            bias_attr=False, data_format=df)
         self.bn2 = norm_layer(width)
         self.conv3 = Conv2D(width, planes * self.expansion, 1,
-                            bias_attr=False)
+                            bias_attr=False, data_format=df)
         self.bn3 = norm_layer(planes * self.expansion)
         self.relu = ReLU()
         self.downsample = downsample
@@ -73,43 +81,63 @@ class BottleneckBlock(Layer):
         return self.relu(out + identity)
 
 
+#: per-depth stage lists (single source for ResNet(depth=int) and the
+#: factory table below)
+_DEPTH_CFG = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
+              101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
+
+
 class ResNet(Layer):
     """Reference: resnet.py ResNet."""
 
-    def __init__(self, block, depth_cfg, num_classes=1000, with_pool=True,
-                 groups=1, width_per_group=64):
+    _DEPTH_CFG = _DEPTH_CFG
+
+    def __init__(self, block, depth, num_classes=1000, with_pool=True,
+                 groups=1, width_per_group=64, data_format="NCHW"):
         super().__init__()
+        # reference takes the int depth (50/101/...); a per-stage list is
+        # also accepted for custom stacks. data_format="NHWC" runs the
+        # whole trunk channels-last — the TPU-native conv layout (no
+        # layout-assignment transposes around each conv+BN); weights stay
+        # OIHW so state dicts are format-independent.
+        depth_cfg = self._DEPTH_CFG[depth] if isinstance(depth, int) \
+            else list(depth)
+        df = data_format
+        self.data_format = df
         self.inplanes = 64
         self.groups = groups
         self.base_width = width_per_group
-        self.conv1 = Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False)
-        self.bn1 = BatchNorm2D(64)
+        self.conv1 = Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False,
+                            data_format=df)
+        self.bn1 = BatchNorm2D(64, data_format=df)
         self.relu = ReLU()
-        self.maxpool = MaxPool2D(3, stride=2, padding=1)
+        self.maxpool = MaxPool2D(3, stride=2, padding=1, data_format=df)
         self.layer1 = self._make_layer(block, 64, depth_cfg[0])
         self.layer2 = self._make_layer(block, 128, depth_cfg[1], stride=2)
         self.layer3 = self._make_layer(block, 256, depth_cfg[2], stride=2)
         self.layer4 = self._make_layer(block, 512, depth_cfg[3], stride=2)
         self.with_pool = with_pool
         if with_pool:
-            self.avgpool = AdaptiveAvgPool2D((1, 1))
+            self.avgpool = AdaptiveAvgPool2D((1, 1), data_format=df)
         self.num_classes = num_classes
         if num_classes > 0:
             self.fc = Linear(512 * block.expansion, num_classes)
 
     def _make_layer(self, block, planes, blocks, stride=1):
+        df = self.data_format
         downsample = None
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = Sequential(
                 Conv2D(self.inplanes, planes * block.expansion, 1,
-                       stride=stride, bias_attr=False),
-                BatchNorm2D(planes * block.expansion))
+                       stride=stride, bias_attr=False, data_format=df),
+                BatchNorm2D(planes * block.expansion, data_format=df))
         layers = [block(self.inplanes, planes, stride, downsample,
-                        self.groups, self.base_width)]
+                        self.groups, self.base_width, data_format=df)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes, groups=self.groups,
-                                base_width=self.base_width))
+                                base_width=self.base_width,
+                                data_format=df))
         return Sequential(*layers)
 
     def forward(self, x):
@@ -126,11 +154,8 @@ class ResNet(Layer):
         return x
 
 
-_CFG = {18: (BasicBlock, [2, 2, 2, 2]),
-        34: (BasicBlock, [3, 4, 6, 3]),
-        50: (BottleneckBlock, [3, 4, 6, 3]),
-        101: (BottleneckBlock, [3, 4, 23, 3]),
-        152: (BottleneckBlock, [3, 8, 36, 3])}
+_CFG = {d: (BasicBlock if d < 50 else BottleneckBlock, _DEPTH_CFG[d])
+        for d in _DEPTH_CFG}
 
 
 def _resnet(depth, pretrained=False, **kwargs):

@@ -33,11 +33,12 @@ ANCHOR_MASKS = [[6, 7, 8], [3, 4, 5], [0, 1, 2]]
 
 
 class ConvBNLayer(Layer):
-    def __init__(self, cin, cout, k=3, stride=1, act="leaky"):
+    def __init__(self, cin, cout, k=3, stride=1, act="leaky",
+                 data_format="NCHW"):
         super().__init__()
         self.conv = Conv2D(cin, cout, k, stride=stride, padding=k // 2,
-                           bias_attr=False)
-        self.bn = BatchNorm2D(cout)
+                           bias_attr=False, data_format=data_format)
+        self.bn = BatchNorm2D(cout, data_format=data_format)
         self.act = act
 
     def forward(self, x):
@@ -46,10 +47,10 @@ class ConvBNLayer(Layer):
 
 
 class BasicBlock(Layer):
-    def __init__(self, ch):
+    def __init__(self, ch, data_format="NCHW"):
         super().__init__()
-        self.conv1 = ConvBNLayer(ch, ch // 2, k=1)
-        self.conv2 = ConvBNLayer(ch // 2, ch, k=3)
+        self.conv1 = ConvBNLayer(ch, ch // 2, k=1, data_format=data_format)
+        self.conv2 = ConvBNLayer(ch // 2, ch, k=3, data_format=data_format)
 
     def forward(self, x):
         return x + self.conv2(self.conv1(x))
@@ -58,15 +59,19 @@ class BasicBlock(Layer):
 class DarkNet53(Layer):
     """YOLOv3 backbone; returns (C3, C4, C5). Stage depths 1/2/8/8/4."""
 
-    def __init__(self, depths=(1, 2, 8, 8, 4), base=32):
+    def __init__(self, depths=(1, 2, 8, 8, 4), base=32,
+                 data_format="NCHW"):
         super().__init__()
-        self.stem = ConvBNLayer(3, base, k=3)
+        df = data_format
+        self.stem = ConvBNLayer(3, base, k=3, data_format=df)
         stages, downs = [], []
         cin = base
         for i, n in enumerate(depths):
             cout = cin * 2
-            downs.append(ConvBNLayer(cin, cout, k=3, stride=2))
-            stages.append(LayerList([BasicBlock(cout) for _ in range(n)]))
+            downs.append(ConvBNLayer(cin, cout, k=3, stride=2,
+                                     data_format=df))
+            stages.append(LayerList([BasicBlock(cout, data_format=df)
+                                     for _ in range(n)]))
             cin = cout
         self.downs = LayerList(downs)
         self.stages = LayerList(stages)
@@ -86,14 +91,15 @@ class YoloDetectionBlock(Layer):
     """5-conv neck block (reference assembly in PaddleDetection's
     YOLOv3 head; op-level pieces are core `detection.py`)."""
 
-    def __init__(self, cin, ch):
+    def __init__(self, cin, ch, data_format="NCHW"):
         super().__init__()
-        self.conv0 = ConvBNLayer(cin, ch, k=1)
-        self.conv1 = ConvBNLayer(ch, ch * 2, k=3)
-        self.conv2 = ConvBNLayer(ch * 2, ch, k=1)
-        self.conv3 = ConvBNLayer(ch, ch * 2, k=3)
-        self.route = ConvBNLayer(ch * 2, ch, k=1)
-        self.tip = ConvBNLayer(ch, ch * 2, k=3)
+        df = data_format
+        self.conv0 = ConvBNLayer(cin, ch, k=1, data_format=df)
+        self.conv1 = ConvBNLayer(ch, ch * 2, k=3, data_format=df)
+        self.conv2 = ConvBNLayer(ch * 2, ch, k=1, data_format=df)
+        self.conv3 = ConvBNLayer(ch, ch * 2, k=3, data_format=df)
+        self.route = ConvBNLayer(ch * 2, ch, k=1, data_format=df)
+        self.tip = ConvBNLayer(ch, ch * 2, k=3, data_format=df)
 
     def forward(self, x):
         x = self.conv3(self.conv2(self.conv1(self.conv0(x))))
@@ -110,36 +116,54 @@ class YOLOv3(Layer):
 
     def __init__(self, num_classes: int = 80,
                  anchors: Sequence[int] = ANCHORS,
-                 anchor_masks=None):
+                 anchor_masks=None, data_format="NCHW"):
         super().__init__()
+        df = data_format
+        self.data_format = df
         self.num_classes = num_classes
         self.anchors = list(anchors)
         self.anchor_masks = anchor_masks or ANCHOR_MASKS
-        self.backbone = DarkNet53()
+        self.backbone = DarkNet53(data_format=df)
         cins = (1024, 768, 384)     # C5; ch(512)//2+C4; ch(256)//2+C3
         chs = (512, 256, 128)
         blocks, heads, routes = [], [], []
         for i, (cin, ch) in enumerate(zip(cins, chs)):
-            blocks.append(YoloDetectionBlock(cin, ch))
+            blocks.append(YoloDetectionBlock(cin, ch, data_format=df))
             na = len(self.anchor_masks[i])
-            heads.append(Conv2D(ch * 2, na * (5 + num_classes), 1))
+            heads.append(Conv2D(ch * 2, na * (5 + num_classes), 1,
+                                data_format=df))
             if i < 2:
-                routes.append(ConvBNLayer(ch, ch // 2, k=1))
+                routes.append(ConvBNLayer(ch, ch // 2, k=1, data_format=df))
         self.blocks = LayerList(blocks)
         self.heads = LayerList(heads)
         self.routes = LayerList(routes)
 
     def forward(self, x):
+        """x: [B,3,H,W] (NCHW model) or [B,H,W,3] (NHWC model). Head
+        maps always return NCHW [B, na*(5+nc), h, w] — the yolo_loss /
+        yolo_box contract — so only the 3 outputs pay a transpose when
+        the trunk runs channels-last."""
+        nhwc = self.data_format == "NHWC"
         c3, c4, c5 = self.backbone(x)
         outs, feat = [], c5
         for i, (blk, head) in enumerate(zip(self.blocks, self.heads)):
             route, tip = blk(feat)
-            outs.append(head(tip))
+            out = head(tip)
+            outs.append(jnp.transpose(out, (0, 3, 1, 2)) if nhwc else out)
             if i < 2:
                 r = self.routes[i](route)
-                b, c, h, w = r.shape
-                r = jax.image.resize(r, (b, c, h * 2, w * 2), "nearest")
-                feat = jnp.concatenate([r, c4 if i == 0 else c3], axis=1)
+                if nhwc:
+                    b, h, w, c = r.shape
+                    r = jax.image.resize(r, (b, h * 2, w * 2, c),
+                                         "nearest")
+                    feat = jnp.concatenate([r, c4 if i == 0 else c3],
+                                           axis=-1)
+                else:
+                    b, c, h, w = r.shape
+                    r = jax.image.resize(r, (b, c, h * 2, w * 2),
+                                         "nearest")
+                    feat = jnp.concatenate([r, c4 if i == 0 else c3],
+                                           axis=1)
         return outs
 
     def predict(self, img, img_size, conf_thresh=0.01, nms_topk=100,

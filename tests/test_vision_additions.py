@@ -129,3 +129,49 @@ class TestReviewRegressions:
         gray = np.full((4, 4), 7, np.uint8)
         np.testing.assert_array_equal(T.adjust_saturation(gray, 0.3), gray)
         np.testing.assert_array_equal(T.adjust_hue(gray, 0.3), gray)
+
+
+class TestChannelsLast:
+    """NHWC (channels-last) trunks produce identical outputs to NCHW with
+    the same OIHW weights — the TPU-native conv layout (bench runs it)."""
+
+    def test_resnet_nhwc_parity(self):
+        import numpy as np
+        import jax.numpy as jnp
+        import paddle_tpu as pt
+        from paddle_tpu.nn.layer import load_state
+        from paddle_tpu.vision.models import resnet18
+
+        pt.seed(0)
+        m1 = resnet18(num_classes=7)
+        m2 = resnet18(num_classes=7, data_format="NHWC")
+        load_state(m2, {n: p.value for n, p in m1.named_parameters()})
+        m1.eval(); m2.eval()
+        x = np.random.RandomState(0).randn(2, 3, 64, 64).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(m1(jnp.asarray(x))),
+            np.asarray(m2(jnp.asarray(x.transpose(0, 2, 3, 1)))),
+            rtol=2e-4, atol=2e-4)
+
+    def test_yolo_nhwc_parity(self):
+        import numpy as np
+        import jax.numpy as jnp
+        import paddle_tpu as pt
+        from paddle_tpu.nn.layer import load_state
+        from paddle_tpu.vision.models import yolov3_darknet53
+
+        pt.seed(0)
+        m1 = yolov3_darknet53(num_classes=4)
+        m2 = yolov3_darknet53(num_classes=4, data_format="NHWC")
+        load_state(m2, {n: p.value for n, p in m1.named_parameters()})
+        b1 = {n: b.value for n, b in m1.named_buffers()}
+        for n, b in m2.named_buffers():
+            b.value = b1[n]
+        m1.eval(); m2.eval()
+        x = np.random.RandomState(0).randn(1, 3, 64, 64).astype(np.float32)
+        o1 = m1(jnp.asarray(x))
+        o2 = m2(jnp.asarray(x.transpose(0, 2, 3, 1)))
+        for a, b in zip(o1, o2):
+            assert a.shape == b.shape
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4)
