@@ -70,13 +70,16 @@ def sharded_state_specs(params: Dict[str, jax.Array],
     return specs
 
 
-def place_sharded_state(opt_state, specs):
-    """device_put the optimizer state per the spec tree (eager path)."""
+def place_sharded_state(opt_state, specs, memory_kind=None):
+    """device_put the optimizer state per the spec tree (eager path).
+    memory_kind="pinned_host" keeps slots resident in host memory (the
+    reference's sharding offload, offload_helper.py)."""
     mesh = get_mesh_or_none()
     if mesh is None:
         return opt_state
+    kw = {"memory_kind": memory_kind} if memory_kind else {}
     return jax.tree.map(
-        lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
+        lambda v, s: jax.device_put(v, NamedSharding(mesh, s, **kw)),
         opt_state, specs,
         is_leaf=lambda v: isinstance(v, jax.Array) or isinstance(v, P))
 
@@ -92,12 +95,18 @@ class DygraphShardingOptimizer:
 
     def __init__(self, hcg=None, user_defined_strategy=None,
                  params=None, inner_optimizer_class=None,
-                 inner_opt: Optional[Optimizer] = None, **inner_kw):
+                 inner_opt: Optional[Optimizer] = None, offload=None,
+                 **inner_kw):
         if inner_opt is None:
             inner_opt = inner_optimizer_class(parameters=params, **inner_kw)
         self._inner = inner_opt
         self._hcg = hcg
         self._placed = False
+        if offload is None and user_defined_strategy is not None:
+            offload = getattr(user_defined_strategy, "sharding_configs",
+                              {}).get("offload", False)
+        self._offload = bool(offload)
+        self._specs = None
 
     def __getattr__(self, item):
         return getattr(self._inner, item)
@@ -112,13 +121,32 @@ class DygraphShardingOptimizer:
         specs = sharded_state_specs(params, self._inner._accumulators,
                                     pspecs)
         self._inner._accumulators = place_sharded_state(
-            self._inner._accumulators, specs)
+            self._inner._accumulators, specs,
+            memory_kind="pinned_host" if self._offload else None)
+        self._specs = specs
         self._placed = True
+
+    def _offload_roundtrip(self, run):
+        """Stream slots host -> device for the update, then back —
+        the eager-mode analogue of build_train_step(offload=True)."""
+        self._inner._accumulators = place_sharded_state(
+            self._inner._accumulators, self._specs)
+        try:
+            return run()
+        finally:
+            self._inner._accumulators = place_sharded_state(
+                self._inner._accumulators, self._specs,
+                memory_kind="pinned_host")
 
     def step(self, grads=None):
         self._place()
+        if self._offload:
+            return self._offload_roundtrip(lambda: self._inner.step(grads))
         return self._inner.step(grads)
 
     def minimize(self, loss_fn, *args):
         self._place()
+        if self._offload:
+            return self._offload_roundtrip(
+                lambda: self._inner.minimize(loss_fn, *args))
         return self._inner.minimize(loss_fn, *args)

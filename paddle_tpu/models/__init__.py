@@ -16,6 +16,8 @@ from .gpt import (  # noqa: F401
     gpt_tiny,
     gpt_345m,
     gpt_1p3b,
+    gpt_2p6b,
+    gpt_6p7b,
     ernie_10b,
 )
 from .bert import (  # noqa: F401
