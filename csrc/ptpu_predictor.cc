@@ -1,0 +1,985 @@
+// Native-deployment predictor: load a paddle_tpu-exported ONNX artifact
+// and execute it from C/C++ with NO Python in the serving process.
+//
+// Reference counterpart: the C inference API
+// (paddle/fluid/inference/capi_exp/pd_inference_api.h:1) over
+// AnalysisPredictor (inference/api/analysis_predictor.cc:381). The
+// TPU-native deployment artifact is the ONNX wire file emitted by
+// paddle_tpu.onnx.export (a jaxpr walk, onnx/converter.py); this TU is a
+// dependency-free interpreter for exactly that op subset: a ~150-line
+// protobuf wire parser + a dtype-tagged tensor interpreter. Heavy server
+// deployments would hand the same artifact to an optimizing runtime; this
+// keeps the "C caller, zero Python" contract testable and self-contained.
+//
+// Build: part of csrc/Makefile -> paddle_tpu/_native_predictor.so
+// C ABI at the bottom (ptpu_predictor_*). Thread-compatible: one
+// predictor per thread, no globals.
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ------------------------------------------------------------ protobuf wire
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end) {
+      uint8_t b = *p++;
+      v |= uint64_t(b & 0x7F) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+      if (shift > 63) break;
+    }
+    ok = false;
+    return 0;
+  }
+  // iterate fields; cb(field, wire, payload_reader_or_value)
+  template <class F>
+  void fields(F cb) {
+    while (ok && p < end) {
+      uint64_t key = varint();
+      int field = int(key >> 3), wire = int(key & 7);
+      if (wire == 0) {
+        uint64_t v = varint();
+        cb(field, wire, Reader{nullptr, nullptr}, v);
+      } else if (wire == 2) {
+        uint64_t len = varint();
+        if (p + len > end) { ok = false; return; }
+        cb(field, wire, Reader{p, p + len}, 0);
+        p += len;
+      } else if (wire == 5) {
+        if (p + 4 > end) { ok = false; return; }
+        cb(field, wire, Reader{p, p + 4}, 0);
+        p += 4;
+      } else if (wire == 1) {
+        if (p + 8 > end) { ok = false; return; }
+        cb(field, wire, Reader{p, p + 8}, 0);
+        p += 8;
+      } else {
+        ok = false;
+        return;
+      }
+    }
+  }
+  std::string str() const { return std::string((const char*)p, end - p); }
+  std::vector<int64_t> packed_varints() const {
+    Reader r{p, end};
+    std::vector<int64_t> out;
+    while (r.ok && r.p < r.end) {
+      uint64_t v = r.varint();
+      out.push_back(int64_t(v));  // two's complement for negatives
+    }
+    return out;
+  }
+};
+
+// ----------------------------------------------------------------- tensors
+// ONNX TensorProto dtype codes (subset)
+enum { DT_F32 = 1, DT_U8 = 2, DT_I32 = 6, DT_I64 = 7, DT_BOOL = 9,
+       DT_F64 = 11 };
+
+struct Tensor {
+  std::vector<int64_t> dims;
+  int dtype = DT_F32;
+  std::vector<float> f;    // DT_F32 / DT_F64 (converted)
+  std::vector<int64_t> i;  // DT_I32 / DT_I64 / DT_BOOL / DT_U8
+  int64_t numel() const {
+    int64_t n = 1;
+    for (auto d : dims) n *= d;
+    return n;
+  }
+  bool is_float() const { return dtype == DT_F32 || dtype == DT_F64; }
+  double at(int64_t k) const { return is_float() ? f[k] : double(i[k]); }
+  void alloc() {
+    if (is_float()) f.assign(size_t(numel()), 0.f);
+    else i.assign(size_t(numel()), 0);
+  }
+  void set(int64_t k, double v) {
+    if (is_float()) f[k] = float(v);
+    else i[k] = int64_t(v);
+  }
+};
+
+struct Attr {
+  float fval = 0;
+  int64_t ival = 0;
+  std::string sval;
+  std::vector<int64_t> ints;
+  std::vector<float> floats;
+  Tensor t;
+  int type = 0;
+};
+
+struct Node {
+  std::string op;
+  std::vector<std::string> inputs, outputs;
+  std::map<std::string, Attr> attrs;
+};
+
+struct Graph {
+  std::vector<Node> nodes;
+  std::map<std::string, Tensor> initializers;
+  std::vector<std::string> input_names, output_names;
+  std::map<std::string, std::vector<int64_t>> input_dims;
+  std::map<std::string, int> input_dtypes;
+};
+
+Tensor parse_tensor(Reader r) {
+  Tensor t;
+  std::string raw;
+  r.fields([&](int field, int wire, Reader sub, uint64_t v) {
+    if (field == 1 && wire == 2) t.dims = sub.packed_varints();
+    else if (field == 1 && wire == 0) t.dims.push_back(int64_t(v));
+    else if (field == 2) t.dtype = int(v);
+    else if (field == 9) raw = sub.str();
+  });
+  int64_t n = t.numel();
+  if (t.dtype == DT_F32) {
+    t.f.resize(size_t(n));
+    if (raw.size() >= size_t(n) * 4) memcpy(t.f.data(), raw.data(), n * 4);
+  } else if (t.dtype == DT_F64) {
+    t.f.resize(size_t(n));
+    const double* d = (const double*)raw.data();
+    for (int64_t k = 0; k < n; ++k) t.f[size_t(k)] = float(d[k]);
+    t.dtype = DT_F32;
+  } else if (t.dtype == DT_I64) {
+    t.i.resize(size_t(n));
+    if (raw.size() >= size_t(n) * 8) memcpy(t.i.data(), raw.data(), n * 8);
+  } else if (t.dtype == DT_I32) {
+    t.i.resize(size_t(n));
+    const int32_t* d = (const int32_t*)raw.data();
+    for (int64_t k = 0; k < n; ++k) t.i[size_t(k)] = d[k];
+  } else if (t.dtype == DT_BOOL || t.dtype == DT_U8) {
+    t.i.resize(size_t(n));
+    const uint8_t* d = (const uint8_t*)raw.data();
+    for (int64_t k = 0; k < n; ++k) t.i[size_t(k)] = d[k];
+  } else {
+    throw std::runtime_error("initializer dtype " +
+                             std::to_string(t.dtype) + " unsupported");
+  }
+  return t;
+}
+
+Attr parse_attr(Reader r, std::string* name) {
+  Attr a;
+  r.fields([&](int field, int wire, Reader sub, uint64_t v) {
+    if (field == 1) *name = sub.str();
+    else if (field == 2) memcpy(&a.fval, sub.p, 4);
+    else if (field == 3) a.ival = int64_t(v);
+    else if (field == 4) a.sval = sub.str();
+    else if (field == 5) a.t = parse_tensor(sub);
+    else if (field == 7) {  // packed floats
+      const float* d = (const float*)sub.p;
+      a.floats.assign(d, d + (sub.end - sub.p) / 4);
+    } else if (field == 8) {
+      if (wire == 2) a.ints = sub.packed_varints();
+      else a.ints.push_back(int64_t(v));
+    } else if (field == 20) a.type = int(v);
+  });
+  return a;
+}
+
+Node parse_node(Reader r) {
+  Node n;
+  r.fields([&](int field, int, Reader sub, uint64_t) {
+    if (field == 1) n.inputs.push_back(sub.str());
+    else if (field == 2) n.outputs.push_back(sub.str());
+    else if (field == 4) n.op = sub.str();
+    else if (field == 5) {
+      std::string name;
+      Attr a = parse_attr(sub, &name);
+      n.attrs[name] = a;
+    }
+  });
+  return n;
+}
+
+void parse_value_info(Reader r, std::string* name, std::vector<int64_t>* dims,
+                      int* dtype) {
+  r.fields([&](int field, int, Reader sub, uint64_t) {
+    if (field == 1) *name = sub.str();
+    else if (field == 2) {  // TypeProto
+      sub.fields([&](int f2, int, Reader s2, uint64_t) {
+        if (f2 != 1) return;  // tensor_type
+        s2.fields([&](int f3, int, Reader s3, uint64_t v3) {
+          if (f3 == 1) *dtype = int(v3);
+          else if (f3 == 2) {  // shape
+            s3.fields([&](int f4, int, Reader s4, uint64_t) {
+              if (f4 != 1) return;  // dim
+              s4.fields([&](int f5, int, Reader, uint64_t v5) {
+                if (f5 == 1) dims->push_back(int64_t(v5));
+              });
+            });
+          }
+        });
+      });
+    }
+  });
+}
+
+Graph parse_model(const std::string& bytes) {
+  Graph g;
+  Reader top{(const uint8_t*)bytes.data(),
+             (const uint8_t*)bytes.data() + bytes.size()};
+  top.fields([&](int field, int, Reader sub, uint64_t) {
+    if (field != 7) return;  // ModelProto.graph
+    sub.fields([&](int f2, int, Reader s2, uint64_t) {
+      if (f2 == 1) g.nodes.push_back(parse_node(s2));
+      else if (f2 == 5) {
+        // initializer: need the name field (8) too
+        std::string name;
+        Reader nr = s2;
+        nr.fields([&](int f3, int, Reader s3, uint64_t) {
+          if (f3 == 8) name = s3.str();
+        });
+        g.initializers[name] = parse_tensor(s2);
+      } else if (f2 == 11 || f2 == 12) {
+        std::string name;
+        std::vector<int64_t> dims;
+        int dt = DT_F32;
+        parse_value_info(s2, &name, &dims, &dt);
+        if (f2 == 11) {
+          g.input_names.push_back(name);
+          g.input_dims[name] = dims;
+          g.input_dtypes[name] = dt;
+        } else {
+          g.output_names.push_back(name);
+        }
+      }
+    });
+  });
+  if (!top.ok) throw std::runtime_error("malformed model protobuf");
+  return g;
+}
+
+// ------------------------------------------------------------ broadcasting
+std::vector<int64_t> bcast_dims(const std::vector<int64_t>& a,
+                                const std::vector<int64_t>& b) {
+  size_t rank = std::max(a.size(), b.size());
+  std::vector<int64_t> out(rank);
+  for (size_t k = 0; k < rank; ++k) {
+    int64_t da = k < rank - a.size() ? 1 : a[k - (rank - a.size())];
+    int64_t db = k < rank - b.size() ? 1 : b[k - (rank - b.size())];
+    if (da != db && da != 1 && db != 1)
+      throw std::runtime_error("broadcast mismatch");
+    out[k] = std::max(da, db);
+  }
+  return out;
+}
+
+std::vector<int64_t> strides_for(const std::vector<int64_t>& dims) {
+  std::vector<int64_t> s(dims.size());
+  int64_t acc = 1;
+  for (int k = int(dims.size()) - 1; k >= 0; --k) {
+    s[size_t(k)] = acc;
+    acc *= dims[size_t(k)];
+  }
+  return s;
+}
+
+// index of `flat` (in out dims) within operand dims (right-aligned bcast)
+int64_t bcast_index(int64_t flat, const std::vector<int64_t>& out_dims,
+                    const std::vector<int64_t>& in_dims) {
+  auto ostr = strides_for(out_dims);
+  auto istr = strides_for(in_dims);
+  int64_t idx = 0;
+  size_t off = out_dims.size() - in_dims.size();
+  for (size_t k = 0; k < out_dims.size(); ++k) {
+    int64_t coord = (flat / ostr[k]) % out_dims[k];
+    if (k >= off) {
+      int64_t d = in_dims[k - off];
+      idx += (d == 1 ? 0 : coord) * istr[k - off];
+    }
+  }
+  return idx;
+}
+
+// ----------------------------------------------------------------- executor
+struct Predictor {
+  Graph g;
+  std::map<std::string, Tensor> env;
+  std::vector<Tensor> outputs;
+  std::vector<std::string> last_err_names;
+
+  const Tensor& in(const Node& n, size_t k) {
+    auto it = env.find(n.inputs[k]);
+    if (it == env.end())
+      throw std::runtime_error("missing input tensor '" + n.inputs[k] +
+                               "' for op " + n.op);
+    return it->second;
+  }
+
+  static int64_t attr_i(const Node& n, const char* name, int64_t dflt) {
+    auto it = n.attrs.find(name);
+    return it == n.attrs.end() ? dflt : it->second.ival;
+  }
+  static std::vector<int64_t> attr_ints(const Node& n, const char* name) {
+    auto it = n.attrs.find(name);
+    return it == n.attrs.end() ? std::vector<int64_t>{} : it->second.ints;
+  }
+
+  void run_node(const Node& n);
+  void run() {
+    outputs.clear();
+    for (const auto& n : g.nodes) run_node(n);
+    for (const auto& name : g.output_names) {
+      auto it = env.find(name);
+      if (it == env.end())
+        throw std::runtime_error("output '" + name + "' never produced");
+      outputs.push_back(it->second);
+    }
+  }
+};
+
+double apply_binary(const std::string& op, double a, double b) {
+  if (op == "Add") return a + b;
+  if (op == "Sub") return a - b;
+  if (op == "Mul") return a * b;
+  if (op == "Div") return a / b;
+  if (op == "Max") return std::max(a, b);
+  if (op == "Min") return std::min(a, b);
+  if (op == "Pow") return std::pow(a, b);
+  if (op == "Mod") return std::fmod(a, b);
+  if (op == "Less") return a < b;
+  if (op == "LessOrEqual") return a <= b;
+  if (op == "Greater") return a > b;
+  if (op == "GreaterOrEqual") return a >= b;
+  if (op == "Equal") return a == b;
+  if (op == "And") return (a != 0) && (b != 0);
+  if (op == "Or") return (a != 0) || (b != 0);
+  if (op == "Xor") return (a != 0) != (b != 0);
+  throw std::runtime_error("binary op " + op);
+}
+
+double apply_unary(const std::string& op, double a) {
+  if (op == "Neg") return -a;
+  if (op == "Abs") return std::fabs(a);
+  if (op == "Exp") return std::exp(a);
+  if (op == "Log") return std::log(a);
+  if (op == "Sqrt") return std::sqrt(a);
+  if (op == "Reciprocal") return 1.0 / a;
+  if (op == "Sigmoid") return 1.0 / (1.0 + std::exp(-a));
+  if (op == "Tanh") return std::tanh(a);
+  if (op == "Erf") return std::erf(a);
+  if (op == "Floor") return std::floor(a);
+  if (op == "Ceil") return std::ceil(a);
+  if (op == "Round") return std::nearbyint(a);
+  if (op == "Sign") return a > 0 ? 1 : (a < 0 ? -1 : 0);
+  if (op == "Relu") return a > 0 ? a : 0;
+  if (op == "Not") return a == 0;
+  if (op == "Sin") return std::sin(a);
+  if (op == "Cos") return std::cos(a);
+  if (op == "Tan") return std::tan(a);
+  if (op == "Asin") return std::asin(a);
+  if (op == "Acos") return std::acos(a);
+  if (op == "Atan") return std::atan(a);
+  if (op == "Sinh") return std::sinh(a);
+  if (op == "Cosh") return std::cosh(a);
+  if (op == "Asinh") return std::asinh(a);
+  if (op == "Acosh") return std::acosh(a);
+  if (op == "Atanh") return std::atanh(a);
+  throw std::runtime_error("unary op " + op);
+}
+
+static const char* kBinaryOps[] = {
+    "Add", "Sub", "Mul", "Div", "Max", "Min", "Pow", "Mod", "Less",
+    "LessOrEqual", "Greater", "GreaterOrEqual", "Equal", "And", "Or",
+    "Xor"};
+static const char* kUnaryOps[] = {
+    "Neg", "Abs", "Exp", "Log", "Sqrt", "Reciprocal", "Sigmoid", "Tanh",
+    "Erf", "Floor", "Ceil", "Round", "Sign", "Relu", "Not", "Sin", "Cos",
+    "Tan", "Asin", "Acos", "Atan", "Sinh", "Cosh", "Asinh", "Acosh",
+    "Atanh"};
+
+bool contains(const char* const* arr, size_t n, const std::string& s) {
+  for (size_t k = 0; k < n; ++k)
+    if (s == arr[k]) return true;
+  return false;
+}
+
+void Predictor::run_node(const Node& n) {
+  const std::string& op = n.op;
+  auto out = [&](Tensor t) { env[n.outputs[0]] = std::move(t); };
+
+  if (op == "Identity") {
+    env[n.outputs[0]] = in(n, 0);
+  } else if (contains(kBinaryOps, sizeof(kBinaryOps) / sizeof(char*), op)) {
+    const Tensor &a = in(n, 0), &b = in(n, 1);
+    Tensor o;
+    o.dims = bcast_dims(a.dims, b.dims);
+    bool cmp = (op == "Less" || op == "LessOrEqual" || op == "Greater" ||
+                op == "GreaterOrEqual" || op == "Equal" || op == "And" ||
+                op == "Or" || op == "Xor");
+    o.dtype = cmp ? DT_BOOL
+                  : ((a.is_float() || b.is_float()) ? DT_F32 : a.dtype);
+    o.alloc();
+    for (int64_t k = 0; k < o.numel(); ++k)
+      o.set(k, apply_binary(op, a.at(bcast_index(k, o.dims, a.dims)),
+                            b.at(bcast_index(k, o.dims, b.dims))));
+    out(std::move(o));
+  } else if (contains(kUnaryOps, sizeof(kUnaryOps) / sizeof(char*), op)) {
+    const Tensor& a = in(n, 0);
+    Tensor o;
+    o.dims = a.dims;
+    o.dtype = (op == "Not") ? DT_BOOL : a.dtype;
+    o.alloc();
+    for (int64_t k = 0; k < o.numel(); ++k)
+      o.set(k, apply_unary(op, a.at(k)));
+    out(std::move(o));
+  } else if (op == "Clip") {
+    const Tensor& a = in(n, 0);
+    double lo = in(n, 1).at(0), hi = in(n, 2).at(0);
+    Tensor o = a;
+    for (int64_t k = 0; k < o.numel(); ++k)
+      o.set(k, std::min(hi, std::max(lo, a.at(k))));
+    out(std::move(o));
+  } else if (op == "Where") {
+    const Tensor &c = in(n, 0), &x = in(n, 1), &y = in(n, 2);
+    Tensor o;
+    o.dims = bcast_dims(bcast_dims(c.dims, x.dims), y.dims);
+    o.dtype = x.dtype;
+    o.alloc();
+    for (int64_t k = 0; k < o.numel(); ++k) {
+      bool cond = c.at(bcast_index(k, o.dims, c.dims)) != 0;
+      o.set(k, cond ? x.at(bcast_index(k, o.dims, x.dims))
+                    : y.at(bcast_index(k, o.dims, y.dims)));
+    }
+    out(std::move(o));
+  } else if (op == "Cast") {
+    const Tensor& a = in(n, 0);
+    Tensor o;
+    o.dims = a.dims;
+    o.dtype = int(attr_i(n, "to", DT_F32));
+    if (o.dtype == DT_F64) o.dtype = DT_F32;
+    o.alloc();
+    for (int64_t k = 0; k < o.numel(); ++k)
+      o.set(k, o.dtype == DT_BOOL ? (a.at(k) != 0) : a.at(k));
+    out(std::move(o));
+  } else if (op == "Reshape") {
+    const Tensor& a = in(n, 0);
+    const Tensor& shp = in(n, 1);
+    Tensor o = a;
+    o.dims.assign(shp.i.begin(), shp.i.end());
+    out(std::move(o));
+  } else if (op == "Transpose") {
+    const Tensor& a = in(n, 0);
+    auto perm = attr_ints(n, "perm");
+    Tensor o;
+    o.dtype = a.dtype;
+    o.dims.resize(a.dims.size());
+    for (size_t k = 0; k < perm.size(); ++k)
+      o.dims[k] = a.dims[size_t(perm[k])];
+    o.alloc();
+    auto istr = strides_for(a.dims);
+    auto ostr = strides_for(o.dims);
+    for (int64_t k = 0; k < o.numel(); ++k) {
+      int64_t src = 0;
+      for (size_t d = 0; d < o.dims.size(); ++d)
+        src += ((k / ostr[d]) % o.dims[d]) * istr[size_t(perm[d])];
+      o.set(k, a.at(src));
+    }
+    out(std::move(o));
+  } else if (op == "Concat") {
+    int64_t rank = int64_t(in(n, 0).dims.size());
+    int64_t axis = attr_i(n, "axis", 0);
+    if (axis < 0) axis += rank;
+    Tensor o;
+    o.dtype = in(n, 0).dtype;
+    o.dims = in(n, 0).dims;
+    int64_t total = 0;
+    for (size_t k = 0; k < n.inputs.size(); ++k)
+      total += in(n, k).dims[size_t(axis)];
+    o.dims[size_t(axis)] = total;
+    o.alloc();
+    auto ostr = strides_for(o.dims);
+    int64_t offset = 0;
+    for (size_t t = 0; t < n.inputs.size(); ++t) {
+      const Tensor& a = in(n, t);
+      auto istr = strides_for(a.dims);
+      for (int64_t k = 0; k < a.numel(); ++k) {
+        int64_t dst = 0;
+        for (size_t d = 0; d < a.dims.size(); ++d) {
+          int64_t coord = (k / istr[d]) % a.dims[d];
+          if (int64_t(d) == axis) coord += offset;
+          dst += coord * ostr[d];
+        }
+        o.set(dst, a.at(k));
+      }
+      offset += a.dims[size_t(axis)];
+    }
+    out(std::move(o));
+  } else if (op == "Expand") {
+    const Tensor& a = in(n, 0);
+    const Tensor& shp = in(n, 1);
+    std::vector<int64_t> want(shp.i.begin(), shp.i.end());
+    Tensor o;
+    o.dims = bcast_dims(a.dims, want);
+    o.dtype = a.dtype;
+    o.alloc();
+    for (int64_t k = 0; k < o.numel(); ++k)
+      o.set(k, a.at(bcast_index(k, o.dims, a.dims)));
+    out(std::move(o));
+  } else if (op == "Slice") {
+    const Tensor& a = in(n, 0);
+    const Tensor &st = in(n, 1), &en = in(n, 2);
+    std::vector<int64_t> axes, steps;
+    if (n.inputs.size() > 3)
+      axes.assign(in(n, 3).i.begin(), in(n, 3).i.end());
+    else
+      for (size_t k = 0; k < st.i.size(); ++k) axes.push_back(int64_t(k));
+    if (n.inputs.size() > 4)
+      steps.assign(in(n, 4).i.begin(), in(n, 4).i.end());
+    else
+      steps.assign(axes.size(), 1);
+    std::vector<int64_t> begin(a.dims.size(), 0), stride(a.dims.size(), 1),
+        count = a.dims;
+    for (size_t k = 0; k < axes.size(); ++k) {
+      int64_t ax = axes[k] < 0 ? axes[k] + int64_t(a.dims.size()) : axes[k];
+      int64_t dim = a.dims[size_t(ax)];
+      int64_t s = st.i[k], e = en.i[k], sp = steps[k];
+      if (s < 0) s += dim;
+      if (e < -dim) e = sp < 0 ? -1 : 0;  // INT64_MIN+1 marker for reverse
+      else if (e < 0) e += dim;
+      if (sp > 0) {
+        s = std::min(std::max(s, int64_t(0)), dim);
+        e = std::min(std::max(e, int64_t(0)), dim);
+        count[size_t(ax)] = std::max(int64_t(0), (e - s + sp - 1) / sp);
+      } else {
+        s = std::min(std::max(s, int64_t(0)), dim - 1);
+        e = std::max(e, int64_t(-1));
+        count[size_t(ax)] = std::max(int64_t(0), (s - e - sp - 1) / (-sp));
+      }
+      begin[size_t(ax)] = s;
+      stride[size_t(ax)] = sp;
+    }
+    Tensor o;
+    o.dims = count;
+    o.dtype = a.dtype;
+    o.alloc();
+    auto istr = strides_for(a.dims);
+    auto ostr = strides_for(o.dims);
+    for (int64_t k = 0; k < o.numel(); ++k) {
+      int64_t src = 0;
+      for (size_t d = 0; d < o.dims.size(); ++d) {
+        int64_t coord = begin[d] + ((k / ostr[d]) % o.dims[d]) * stride[d];
+        src += coord * istr[d];
+      }
+      o.set(k, a.at(src));
+    }
+    out(std::move(o));
+  } else if (op == "Gather") {
+    const Tensor &a = in(n, 0), &idx = in(n, 1);
+    int64_t axis = attr_i(n, "axis", 0);
+    if (axis < 0) axis += int64_t(a.dims.size());
+    Tensor o;
+    o.dtype = a.dtype;
+    for (int64_t d = 0; d < axis; ++d) o.dims.push_back(a.dims[size_t(d)]);
+    for (auto d : idx.dims) o.dims.push_back(d);
+    for (size_t d = size_t(axis) + 1; d < a.dims.size(); ++d)
+      o.dims.push_back(a.dims[d]);
+    o.alloc();
+    auto istr = strides_for(a.dims);
+    auto ostr = strides_for(o.dims);
+    int64_t ax_dim = a.dims[size_t(axis)];
+    for (int64_t k = 0; k < o.numel(); ++k) {
+      int64_t src = 0;
+      size_t od = 0;
+      for (int64_t d = 0; d < axis; ++d, ++od)
+        src += ((k / ostr[od]) % o.dims[od]) * istr[size_t(d)];
+      int64_t iflat = 0;
+      auto xstr = strides_for(idx.dims);
+      for (size_t d = 0; d < idx.dims.size(); ++d, ++od)
+        iflat += ((k / ostr[od]) % o.dims[od]) * xstr[d];
+      int64_t iv = idx.i.empty() ? int64_t(idx.at(iflat)) : idx.i[iflat];
+      if (iv < 0) iv += ax_dim;
+      src += iv * istr[size_t(axis)];
+      for (size_t d = size_t(axis) + 1; d < a.dims.size(); ++d, ++od)
+        src += ((k / ostr[od]) % o.dims[od]) * istr[d];
+      o.set(k, a.at(src));
+    }
+    out(std::move(o));
+  } else if (op == "MatMul") {
+    const Tensor &a = in(n, 0), &b = in(n, 1);
+    if (b.dims.size() > 2) throw std::runtime_error("MatMul rhs rank > 2");
+    int64_t k_dim = a.dims.back();
+    int64_t nn = b.dims.size() == 2 ? b.dims[1] : 1;
+    int64_t batch = a.numel() / (a.dims.back() *
+                                 (a.dims.size() >= 2
+                                      ? a.dims[a.dims.size() - 2]
+                                      : 1));
+    int64_t m = a.dims.size() >= 2 ? a.dims[a.dims.size() - 2] : 1;
+    Tensor o;
+    o.dtype = DT_F32;
+    o.dims.assign(a.dims.begin(), a.dims.end() - 1);
+    if (b.dims.size() == 2) o.dims.push_back(nn);
+    o.alloc();
+    for (int64_t bb = 0; bb < batch; ++bb)
+      for (int64_t mm = 0; mm < m; ++mm)
+        for (int64_t jj = 0; jj < nn; ++jj) {
+          double acc = 0;
+          for (int64_t kk = 0; kk < k_dim; ++kk)
+            acc += a.at((bb * m + mm) * k_dim + kk) *
+                   b.at(b.dims.size() == 2 ? kk * nn + jj : kk);
+          o.set((bb * m + mm) * nn + jj, acc);
+        }
+    out(std::move(o));
+  } else if (op == "Conv") {
+    const Tensor &x = in(n, 0), &w = in(n, 1);
+    if (x.dims.size() != 4) throw std::runtime_error("Conv: only 2-D");
+    auto strides = attr_ints(n, "strides");
+    auto pads = attr_ints(n, "pads");
+    auto dil = attr_ints(n, "dilations");
+    int64_t group = attr_i(n, "group", 1);
+    if (strides.empty()) strides = {1, 1};
+    if (pads.empty()) pads = {0, 0, 0, 0};
+    if (dil.empty()) dil = {1, 1};
+    int64_t N = x.dims[0], C = x.dims[1], H = x.dims[2], W = x.dims[3];
+    int64_t OC = w.dims[0], ICG = w.dims[1], KH = w.dims[2], KW = w.dims[3];
+    int64_t OH = (H + pads[0] + pads[2] - dil[0] * (KH - 1) - 1) /
+                     strides[0] + 1;
+    int64_t OW = (W + pads[1] + pads[3] - dil[1] * (KW - 1) - 1) /
+                     strides[1] + 1;
+    int64_t ocg = OC / group;
+    Tensor o;
+    o.dtype = DT_F32;
+    o.dims = {N, OC, OH, OW};
+    o.alloc();
+    for (int64_t nn = 0; nn < N; ++nn)
+      for (int64_t oc = 0; oc < OC; ++oc) {
+        int64_t g0 = (oc / ocg) * ICG;  // first input channel of group
+        for (int64_t oh = 0; oh < OH; ++oh)
+          for (int64_t ow = 0; ow < OW; ++ow) {
+            double acc = 0;
+            for (int64_t ic = 0; ic < ICG; ++ic)
+              for (int64_t kh = 0; kh < KH; ++kh) {
+                int64_t ih = oh * strides[0] - pads[0] + kh * dil[0];
+                if (ih < 0 || ih >= H) continue;
+                for (int64_t kw = 0; kw < KW; ++kw) {
+                  int64_t iw = ow * strides[1] - pads[1] + kw * dil[1];
+                  if (iw < 0 || iw >= W) continue;
+                  acc += x.f[size_t(((nn * C + g0 + ic) * H + ih) * W +
+                                    iw)] *
+                         w.f[size_t(((oc * ICG + ic) * KH + kh) * KW +
+                                    kw)];
+                }
+              }
+            o.f[size_t(((nn * OC + oc) * OH + oh) * OW + ow)] = float(acc);
+          }
+      }
+    out(std::move(o));
+  } else if (op == "MaxPool" || op == "AveragePool") {
+    const Tensor& x = in(n, 0);
+    auto ks = attr_ints(n, "kernel_shape");
+    auto strides = attr_ints(n, "strides");
+    auto pads = attr_ints(n, "pads");
+    if (strides.empty()) strides.assign(ks.size(), 1);
+    if (pads.empty()) pads.assign(ks.size() * 2, 0);
+    if (x.dims.size() != 4 || ks.size() != 2)
+      throw std::runtime_error(op + ": only 2-D");
+    bool include_pad = attr_i(n, "count_include_pad", 0) != 0;
+    int64_t N = x.dims[0], C = x.dims[1], H = x.dims[2], W = x.dims[3];
+    int64_t OH = (H + pads[0] + pads[2] - ks[0]) / strides[0] + 1;
+    int64_t OW = (W + pads[1] + pads[3] - ks[1]) / strides[1] + 1;
+    Tensor o;
+    o.dtype = DT_F32;
+    o.dims = {N, C, OH, OW};
+    o.alloc();
+    for (int64_t nn = 0; nn < N; ++nn)
+      for (int64_t c = 0; c < C; ++c)
+        for (int64_t oh = 0; oh < OH; ++oh)
+          for (int64_t ow = 0; ow < OW; ++ow) {
+            double best = -1e30, sum = 0;
+            int64_t cnt = 0;
+            for (int64_t kh = 0; kh < ks[0]; ++kh)
+              for (int64_t kw = 0; kw < ks[1]; ++kw) {
+                int64_t ih = oh * strides[0] - pads[0] + kh;
+                int64_t iw = ow * strides[1] - pads[1] + kw;
+                if (ih < 0 || ih >= H || iw < 0 || iw >= W) continue;
+                double v =
+                    x.f[size_t(((nn * C + c) * H + ih) * W + iw)];
+                best = std::max(best, v);
+                sum += v;
+                ++cnt;
+              }
+            double denom = include_pad ? double(ks[0] * ks[1])
+                                       : double(std::max(cnt, int64_t(1)));
+            o.f[size_t(((nn * C + c) * OH + oh) * OW + ow)] =
+                float(op == "MaxPool" ? best : sum / denom);
+          }
+    out(std::move(o));
+  } else if (op == "ReduceSum" || op == "ReduceMax" || op == "ReduceMin" ||
+             op == "ReduceProd" || op == "ReduceMean") {
+    const Tensor& a = in(n, 0);
+    std::vector<int64_t> axes = attr_ints(n, "axes");
+    if (axes.empty() && n.inputs.size() > 1)
+      axes.assign(in(n, 1).i.begin(), in(n, 1).i.end());
+    bool keep = attr_i(n, "keepdims", 1) != 0;
+    std::vector<bool> red(a.dims.size(), axes.empty());
+    for (auto ax : axes)
+      red[size_t(ax < 0 ? ax + int64_t(a.dims.size()) : ax)] = true;
+    Tensor o;
+    o.dtype = a.dtype;
+    for (size_t d = 0; d < a.dims.size(); ++d) {
+      if (!red[d]) o.dims.push_back(a.dims[d]);
+      else if (keep) o.dims.push_back(1);
+    }
+    o.alloc();
+    double init = op == "ReduceMax" ? -1e300
+                  : op == "ReduceMin" ? 1e300
+                  : op == "ReduceProd" ? 1.0 : 0.0;
+    std::vector<double> acc(size_t(o.numel()), init);
+    std::vector<int64_t> counts(size_t(o.numel()), 0);
+    auto istr = strides_for(a.dims);
+    auto ostr = strides_for(o.dims);
+    for (int64_t k = 0; k < a.numel(); ++k) {
+      int64_t dst = 0;
+      size_t od = 0;
+      for (size_t d = 0; d < a.dims.size(); ++d) {
+        int64_t coord = (k / istr[d]) % a.dims[d];
+        if (!red[d]) dst += coord * ostr[od++];
+        else if (keep) od++;  // coord 0
+      }
+      double v = a.at(k);
+      if (op == "ReduceMax") acc[size_t(dst)] = std::max(acc[size_t(dst)], v);
+      else if (op == "ReduceMin")
+        acc[size_t(dst)] = std::min(acc[size_t(dst)], v);
+      else if (op == "ReduceProd") acc[size_t(dst)] *= v;
+      else acc[size_t(dst)] += v;
+      counts[size_t(dst)]++;
+    }
+    for (int64_t k = 0; k < o.numel(); ++k)
+      o.set(k, op == "ReduceMean" ? acc[size_t(k)] / double(counts[size_t(k)])
+                                  : acc[size_t(k)]);
+    out(std::move(o));
+  } else if (op == "ArgMax" || op == "ArgMin") {
+    const Tensor& a = in(n, 0);
+    int64_t axis = attr_i(n, "axis", 0);
+    if (axis < 0) axis += int64_t(a.dims.size());
+    bool keep = attr_i(n, "keepdims", 1) != 0;
+    Tensor o;
+    o.dtype = DT_I64;
+    for (size_t d = 0; d < a.dims.size(); ++d) {
+      if (int64_t(d) != axis) o.dims.push_back(a.dims[d]);
+      else if (keep) o.dims.push_back(1);
+    }
+    o.alloc();
+    auto istr = strides_for(a.dims);
+    int64_t ax_dim = a.dims[size_t(axis)];
+    for (int64_t k = 0; k < o.numel(); ++k) {
+      // decompose k into non-axis coords
+      int64_t base = 0;
+      size_t od = 0;
+      auto ostr = strides_for(o.dims);
+      for (size_t d = 0; d < a.dims.size(); ++d) {
+        if (int64_t(d) == axis) { if (keep) od++; continue; }
+        base += ((k / ostr[od]) % o.dims[od]) * istr[d];
+        od++;
+      }
+      double best = op == "ArgMax" ? -1e300 : 1e300;
+      int64_t arg = 0;
+      for (int64_t j = 0; j < ax_dim; ++j) {
+        double v = a.at(base + j * istr[size_t(axis)]);
+        if ((op == "ArgMax" && v > best) || (op == "ArgMin" && v < best)) {
+          best = v;
+          arg = j;
+        }
+      }
+      o.i[size_t(k)] = arg;
+    }
+    out(std::move(o));
+  } else if (op == "CumSum") {
+    const Tensor& a = in(n, 0);
+    int64_t axis = int64_t(in(n, 1).at(0));
+    if (axis < 0) axis += int64_t(a.dims.size());
+    Tensor o = a;
+    auto istr = strides_for(a.dims);
+    int64_t ax_dim = a.dims[size_t(axis)];
+    for (int64_t k = 0; k < a.numel(); ++k) {
+      int64_t coord = (k / istr[size_t(axis)]) % ax_dim;
+      if (coord > 0) o.set(k, o.at(k) + o.at(k - istr[size_t(axis)]));
+    }
+    out(std::move(o));
+  } else if (op == "Pad") {
+    const Tensor& a = in(n, 0);
+    const Tensor& pads = in(n, 1);
+    double cval = n.inputs.size() > 2 ? in(n, 2).at(0) : 0.0;
+    size_t rank = a.dims.size();
+    Tensor o;
+    o.dtype = a.dtype;
+    for (size_t d = 0; d < rank; ++d)
+      o.dims.push_back(a.dims[d] + pads.i[d] + pads.i[d + rank]);
+    o.alloc();
+    for (int64_t k = 0; k < o.numel(); ++k) o.set(k, cval);
+    auto istr = strides_for(a.dims);
+    auto ostr = strides_for(o.dims);
+    for (int64_t k = 0; k < a.numel(); ++k) {
+      int64_t dst = 0;
+      for (size_t d = 0; d < rank; ++d)
+        dst += (((k / istr[d]) % a.dims[d]) + pads.i[d]) * ostr[d];
+      o.set(dst, a.at(k));
+    }
+    out(std::move(o));
+  } else if (op == "Softmax") {
+    const Tensor& a = in(n, 0);
+    int64_t axis = attr_i(n, "axis", -1);
+    if (axis < 0) axis += int64_t(a.dims.size());
+    Tensor o = a;
+    auto istr = strides_for(a.dims);
+    int64_t ax_dim = a.dims[size_t(axis)];
+    int64_t outer = a.numel() / ax_dim;
+    for (int64_t b = 0; b < outer; ++b) {
+      // map outer index to base offset
+      int64_t base = 0, rem = b;
+      for (size_t d = 0; d < a.dims.size(); ++d) {
+        if (int64_t(d) == axis) continue;
+        int64_t sz = a.dims[d];
+        // recompute strides over non-axis dims (row-major)
+        int64_t block = 1;
+        for (size_t d2 = d + 1; d2 < a.dims.size(); ++d2)
+          if (int64_t(d2) != axis) block *= a.dims[d2];
+        int64_t coord = (rem / block) % sz;
+        base += coord * istr[d];
+      }
+      double mx = -1e300;
+      for (int64_t j = 0; j < ax_dim; ++j)
+        mx = std::max(mx, a.at(base + j * istr[size_t(axis)]));
+      double sum = 0;
+      for (int64_t j = 0; j < ax_dim; ++j)
+        sum += std::exp(a.at(base + j * istr[size_t(axis)]) - mx);
+      for (int64_t j = 0; j < ax_dim; ++j) {
+        int64_t at = base + j * istr[size_t(axis)];
+        o.set(at, std::exp(a.at(at) - mx) / sum);
+      }
+    }
+    out(std::move(o));
+  } else {
+    throw std::runtime_error("op '" + op + "' not supported by the native "
+                             "predictor (re-export or extend "
+                             "csrc/ptpu_predictor.cc)");
+  }
+}
+
+void fill_error(char* err, int err_len, const std::string& msg) {
+  if (err && err_len > 0) {
+    std::snprintf(err, size_t(err_len), "%s", msg.c_str());
+  }
+}
+
+}  // namespace
+
+// -------------------------------------------------------------------- C ABI
+extern "C" {
+
+typedef struct PTPU_Predictor PTPU_Predictor;
+
+__attribute__((visibility("default")))
+PTPU_Predictor* ptpu_predictor_create(const char* model_path, char* err,
+                                      int err_len) {
+  try {
+    std::ifstream f(model_path, std::ios::binary);
+    if (!f) throw std::runtime_error(std::string("cannot open ") +
+                                     model_path);
+    std::stringstream ss;
+    ss << f.rdbuf();
+    auto* p = new Predictor();
+    p->g = parse_model(ss.str());
+    for (const auto& kv : p->g.initializers) p->env[kv.first] = kv.second;
+    return (PTPU_Predictor*)p;
+  } catch (const std::exception& e) {
+    fill_error(err, err_len, e.what());
+    return nullptr;
+  }
+}
+
+__attribute__((visibility("default")))
+void ptpu_predictor_destroy(PTPU_Predictor* h) {
+  delete (Predictor*)h;
+}
+
+__attribute__((visibility("default")))
+int ptpu_predictor_num_inputs(PTPU_Predictor* h) {
+  return int(((Predictor*)h)->g.input_names.size());
+}
+
+__attribute__((visibility("default")))
+int ptpu_predictor_num_outputs(PTPU_Predictor* h) {
+  return int(((Predictor*)h)->g.output_names.size());
+}
+
+__attribute__((visibility("default")))
+const char* ptpu_predictor_input_name(PTPU_Predictor* h, int i) {
+  auto* p = (Predictor*)h;
+  if (i < 0 || size_t(i) >= p->g.input_names.size()) return "";
+  return p->g.input_names[size_t(i)].c_str();
+}
+
+__attribute__((visibility("default")))
+int ptpu_predictor_set_input(PTPU_Predictor* h, const char* name,
+                             const float* data, const int64_t* dims,
+                             int ndim, char* err, int err_len) {
+  try {
+    auto* p = (Predictor*)h;
+    Tensor t;
+    t.dtype = DT_F32;
+    t.dims.assign(dims, dims + ndim);
+    t.f.assign(data, data + t.numel());
+    p->env[name] = std::move(t);
+    return 0;
+  } catch (const std::exception& e) {
+    fill_error(err, err_len, e.what());
+    return 1;
+  }
+}
+
+__attribute__((visibility("default")))
+int ptpu_predictor_run(PTPU_Predictor* h, char* err, int err_len) {
+  try {
+    ((Predictor*)h)->run();
+    return 0;
+  } catch (const std::exception& e) {
+    fill_error(err, err_len, e.what());
+    return 1;
+  }
+}
+
+__attribute__((visibility("default")))
+int ptpu_predictor_output_ndim(PTPU_Predictor* h, int i) {
+  auto* p = (Predictor*)h;
+  if (i < 0 || size_t(i) >= p->outputs.size()) return -1;
+  return int(p->outputs[size_t(i)].dims.size());
+}
+
+__attribute__((visibility("default")))
+const int64_t* ptpu_predictor_output_dims(PTPU_Predictor* h, int i) {
+  auto* p = (Predictor*)h;
+  if (i < 0 || size_t(i) >= p->outputs.size()) return nullptr;
+  return p->outputs[size_t(i)].dims.data();
+}
+
+// Output data as float32 (int outputs are converted in place once).
+__attribute__((visibility("default")))
+const float* ptpu_predictor_output_data(PTPU_Predictor* h, int i) {
+  auto* p = (Predictor*)h;
+  if (i < 0 || size_t(i) >= p->outputs.size()) return nullptr;
+  Tensor& t = p->outputs[size_t(i)];
+  if (!t.is_float() && t.f.size() != size_t(t.numel())) {
+    t.f.resize(size_t(t.numel()));
+    for (int64_t k = 0; k < t.numel(); ++k) t.f[size_t(k)] = float(t.i[k]);
+  }
+  return t.f.data();
+}
+
+}  // extern "C"

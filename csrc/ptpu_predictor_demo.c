@@ -1,0 +1,67 @@
+/* Pure-C serving demo: load an exported model and run inference with no
+ * Python anywhere in the process. Mirrors the reference's C API usage
+ * (capi_exp/pd_inference_api.h). Usage:
+ *   ptpu_predictor_demo <model.onnx> <n_floats_in> <d0> <d1> ...
+ * Feeds zeros of the given shape to the first input, prints the first
+ * 8 output values. */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef struct PTPU_Predictor PTPU_Predictor;
+PTPU_Predictor* ptpu_predictor_create(const char*, char*, int);
+void ptpu_predictor_destroy(PTPU_Predictor*);
+int ptpu_predictor_num_inputs(PTPU_Predictor*);
+int ptpu_predictor_num_outputs(PTPU_Predictor*);
+const char* ptpu_predictor_input_name(PTPU_Predictor*, int);
+int ptpu_predictor_set_input(PTPU_Predictor*, const char*, const float*,
+                             const int64_t*, int, char*, int);
+int ptpu_predictor_run(PTPU_Predictor*, char*, int);
+int ptpu_predictor_output_ndim(PTPU_Predictor*, int);
+const int64_t* ptpu_predictor_output_dims(PTPU_Predictor*, int);
+const float* ptpu_predictor_output_data(PTPU_Predictor*, int);
+
+int main(int argc, char** argv) {
+  char err[512] = {0};
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s model.onnx d0 [d1 ...]\n", argv[0]);
+    return 2;
+  }
+  PTPU_Predictor* p = ptpu_predictor_create(argv[1], err, sizeof(err));
+  if (!p) {
+    fprintf(stderr, "create failed: %s\n", err);
+    return 1;
+  }
+  int ndim = argc - 2;
+  int64_t dims[8];
+  int64_t n = 1;
+  for (int k = 0; k < ndim; ++k) {
+    dims[k] = atoll(argv[2 + k]);
+    n *= dims[k];
+  }
+  float* data = (float*)calloc((size_t)n, sizeof(float));
+  const char* name = ptpu_predictor_input_name(p, 0);
+  if (ptpu_predictor_set_input(p, name, data, dims, ndim, err,
+                               sizeof(err)) ||
+      ptpu_predictor_run(p, err, sizeof(err))) {
+    fprintf(stderr, "run failed: %s\n", err);
+    return 1;
+  }
+  int od = ptpu_predictor_output_ndim(p, 0);
+  const int64_t* odims = ptpu_predictor_output_dims(p, 0);
+  const float* out = ptpu_predictor_output_data(p, 0);
+  int64_t total = 1;
+  printf("output dims:");
+  for (int k = 0; k < od; ++k) {
+    printf(" %lld", (long long)odims[k]);
+    total *= odims[k];
+  }
+  printf("\nvalues:");
+  for (int64_t k = 0; k < (total < 8 ? total : 8); ++k)
+    printf(" %.6f", out[k]);
+  printf("\n");
+  free(data);
+  ptpu_predictor_destroy(p);
+  return 0;
+}
