@@ -57,8 +57,13 @@ def _params():
 
 # ---------------------------------------------------------------- forward
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, causal, scale, nk):
+def _fwd_kernel(q_ref, k_ref, v_ref, *refs, causal, scale, nk,
+                masked=False):
+    if masked:
+        mask_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+        mask_ref = None
     iq, jk = pl.program_id(1), pl.program_id(2)
 
     @pl.when(jk == 0)
@@ -81,8 +86,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
             k_pos = jk * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if mask_ref is not None:
+            # k-side padding mask (1=keep): [BK] from the stat-lane array
+            s = jnp.where(mask_ref[0][:, 0][None, :] > 0, s, NEG_INF)
         m_prev = m_ref[:, 0:1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # fully-masked row guard: m_new == NEG_INF would make the masked
+        # exp(s - m_new) = 1; clamp so p stays 0 and the row sums to 0
+        m_new = jnp.where(m_new > 0.5 * NEG_INF, m_new, 0.0)
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m_prev - m_new)
         l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=1, keepdims=True)
@@ -100,7 +111,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
                                               lse_ref.shape[1:], (0,))
 
 
-def _fwd(q3, k3, v3, causal, scale):
+def _fwd(q3, k3, v3, causal, scale, mask3=None, heads=1):
     bh, s, d = q3.shape
     blk = _block_for(s)
     n = s // blk
@@ -108,10 +119,21 @@ def _fwd(q3, k3, v3, causal, scale):
                       memory_space=pltpu.VMEM)
     kt = pl.BlockSpec((1, blk, d), lambda b, i, j: (b, j, 0),
                       memory_space=pltpu.VMEM)
+    in_specs = [qt, kt, kt]
+    args = [q3, k3, v3]
+    if mask3 is not None:
+        # k-side mask rides the stat-lane layout, tiled by the K index;
+        # it stays [batch, s, LANE] — every head of a batch row reads the
+        # same block via the b // heads index map (heads is static)
+        in_specs.append(pl.BlockSpec((1, blk, LANE),
+                                     lambda b, i, j: (b // heads, j, 0),
+                                     memory_space=pltpu.VMEM))
+        args.append(mask3)
     o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, causal=causal, scale=scale, nk=n),
+        functools.partial(_fwd_kernel, causal=causal, scale=scale, nk=n,
+                          masked=mask3 is not None),
         grid=(bh, n, n),
-        in_specs=[qt, kt, kt],
+        in_specs=in_specs,
         out_specs=[qt,
                    pl.BlockSpec((1, blk, LANE), lambda b, i, j: (b, i, 0),
                                 memory_space=pltpu.VMEM)],
@@ -122,14 +144,19 @@ def _fwd(q3, k3, v3, causal, scale):
                         pltpu.VMEM((blk, 128), jnp.float32)],
         interpret=_interpret(),
         **_params(),
-    )(q3, k3, v3)
+    )(*args)
     return o, lse
 
 
 # --------------------------------------------------------------- backward
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               acc_ref, *, causal, scale, nk):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+               causal, scale, nk, masked=False):
+    if masked:
+        mask_ref, dq_ref, acc_ref = refs
+    else:
+        dq_ref, acc_ref = refs
+        mask_ref = None
     iq, jk = pl.program_id(1), pl.program_id(2)
 
     @pl.when(jk == 0)
@@ -153,6 +180,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             k_pos = jk * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if mask_ref is not None:
+            s = jnp.where(mask_ref[0][:, 0][None, :] > 0, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -166,8 +195,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, causal, scale, nq):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+                causal, scale, nq, masked=False):
+    if masked:
+        mask_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = refs
+        mask_ref = None
     jk, i = pl.program_id(1), pl.program_id(2)
 
     @pl.when(i == 0)
@@ -192,6 +226,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_pos = jk * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if mask_ref is not None:
+            s = jnp.where(mask_ref[0][:, 0][None, :] > 0, s, NEG_INF)
         p = jnp.exp(s - lse)                              # [BQ, BK]
         pc = p.astype(do.dtype)
         dv_acc[:] += jax.lax.dot_general(
@@ -210,7 +246,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(causal, scale, res, g):
+def _bwd_impl(causal, scale, res, g, mask3=None, heads=1):
     q3, k3, v3, o3, lse = res
     bh, s, d = q3.shape
     blk = _block_for(s)
@@ -233,22 +269,35 @@ def _bwd(causal, scale, res, g):
     lse_i = pl.BlockSpec((1, blk, LANE), tile_i, memory_space=pltpu.VMEM)
     lse_j = pl.BlockSpec((1, blk, LANE), tile_j, memory_space=pltpu.VMEM)
 
+    masked = mask3 is not None
+    mj = pl.BlockSpec((1, blk, LANE), lambda b, i, j: (b // heads, j, 0),
+                      memory_space=pltpu.VMEM)
+    mi = pl.BlockSpec((1, blk, LANE), lambda b, i, j: (b // heads, i, 0),
+                      memory_space=pltpu.VMEM)
+    # dq grid: (bh, q_tile, k_tile) — the k-side mask follows axis 2
+    dq_in = [ti, tj, tj, ti, lse_i, lse_i] + ([mj] if masked else [])
+    dq_args = [q3, k3, v3, do3, lse, delta3] + ([mask3] if masked else [])
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, causal=causal, scale=scale, nk=n),
+        functools.partial(_dq_kernel, causal=causal, scale=scale, nk=n,
+                          masked=masked),
         grid=(bh, n, n),
-        in_specs=[ti, tj, tj, ti, lse_i, lse_i],
+        in_specs=dq_in,
         out_specs=[ti],
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), q3.dtype)],
         scratch_shapes=[pltpu.VMEM((blk, d), jnp.float32)],
         interpret=_interpret(),
         **_params(),
-    )(q3, k3, v3, do3, lse, delta3)[0]
+    )(*dq_args)[0]
 
-    # grid dims: (bh, k_tile, q_tile) — q is the reduce (innermost) dim
+    # grid dims: (bh, k_tile, q_tile) — q is the reduce (innermost) dim;
+    # the k-side mask follows axis 1 here
+    dkv_in = [tj, ti, ti, tj, lse_j, lse_j] + ([mi] if masked else [])
+    dkv_args = [q3, k3, v3, do3, lse, delta3] + ([mask3] if masked else [])
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, causal=causal, scale=scale, nq=n),
+        functools.partial(_dkv_kernel, causal=causal, scale=scale, nq=n,
+                          masked=masked),
         grid=(bh, n, n),
-        in_specs=[tj, ti, ti, tj, lse_j, lse_j],
+        in_specs=dkv_in,
         out_specs=[ti, ti],
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), k3.dtype),
                    jax.ShapeDtypeStruct((bh, s, d), v3.dtype)],
@@ -256,8 +305,12 @@ def _bwd(causal, scale, res, g):
                         pltpu.VMEM((blk, d), jnp.float32)],
         interpret=_interpret(),
         **_params(),
-    )(q3, k3, v3, do3, lse, delta3)
+    )(*dkv_args)
     return dq, dk, dv
+
+
+def _bwd(causal, scale, res, g):
+    return _bwd_impl(causal, scale, res, g)
 
 
 # ------------------------------------------------------------- public op
@@ -276,9 +329,37 @@ def _flash3_fwd(q3, k3, v3, causal, scale):
 _flash3.defvjp(_flash3_fwd, _bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash3m(q3, k3, v3, mask3, causal, scale, heads):
+    o, _ = _fwd(q3, k3, v3, causal, scale, mask3=mask3, heads=heads)
+    return o
+
+
+def _flash3m_fwd(q3, k3, v3, mask3, causal, scale, heads):
+    o, lse = _fwd(q3, k3, v3, causal, scale, mask3=mask3, heads=heads)
+    return o, (q3, k3, v3, o, lse, mask3)
+
+
+def _flash3m_bwd(causal, scale, heads, res, g):
+    q3, k3, v3, o3, lse, mask3 = res
+    dq, dk, dv = _bwd_impl(causal, scale, (q3, k3, v3, o3, lse), g,
+                           mask3=mask3, heads=heads)
+    return dq, dk, dv, jnp.zeros_like(mask3)
+
+
+_flash3m.defvjp(_flash3m_fwd, _flash3m_bwd)
+
+
 def flash_attention(query, key, value, causal: bool = False,
-                    scale=None):
-    """[b, s, h, d] fused attention. Requires s % 128 == 0."""
+                    scale=None, kv_mask=None):
+    """[b, s, h, d] fused attention. Requires s % 128 == 0.
+
+    kv_mask ([b, s], bool/0-1, optional): k-side padding mask — 1 keeps
+    the key position, 0 masks it for every query (the padded-batch BERT
+    attention mask; reference: the mask input of
+    `operators/fused/multihead_matmul_op.cu:1`). Fully-masked rows
+    return 0. Mask cotangent is zero (it is a selection, not a value).
+    """
     b, s, h, d = query.shape
     if s % 128 != 0:
         raise ValueError(f"flash_attention needs seq % 128 == 0, "
@@ -288,5 +369,13 @@ def flash_attention(query, key, value, causal: bool = False,
     def to3(x):
         return jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
 
-    o3 = _flash3(to3(query), to3(key), to3(value), causal, scale)
+    if kv_mask is None:
+        o3 = _flash3(to3(query), to3(key), to3(value), causal, scale)
+    else:
+        # [batch, s, LANE] — heads share the batch row via the kernels'
+        # b // heads index map (no h-fold HBM duplication)
+        m = jnp.asarray(kv_mask, jnp.float32)             # [b, s]
+        m3 = jnp.broadcast_to(m[:, :, None], (b, s, LANE))
+        o3 = _flash3m(to3(query), to3(key), to3(value), m3, causal, scale,
+                      h)
     return jnp.swapaxes(o3.reshape(b, h, s, d), 1, 2)
