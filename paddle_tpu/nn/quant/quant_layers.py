@@ -60,9 +60,22 @@ class FakeQuantMovingAverageAbsMax(Layer):
         return fake_quant(x, new_scale, self.quant_bits)
 
 
+def _int8_quantize(x, step):
+    """x / step rounded into int8 range (symmetric)."""
+    return jnp.clip(jnp.round(x / jnp.maximum(step, 1e-12)),
+                    -127, 127).astype(jnp.int8)
+
+
 class QuantizedLinear(Layer):
     """Reference: quant_layers.py QuantizedLinear — wraps a float Linear
-    with weight+activation fake quant."""
+    with weight+activation fake quant. With `int8_execution` set (see
+    `quantization.convert_to_int8`) the matmul actually RUNS on int8
+    operands with an int32 accumulator (lax.dot_general — the MXU int8
+    path) and per-OUTPUT-channel weight scales, matching the reference's
+    calibrated int8 execution (inference/api/mkldnn_quantizer.cc,
+    tensorrt/trt_int8_calibrator.cc) instead of merely annotating."""
+
+    int8_execution = False
 
     def __init__(self, layer, weight_bits=8, activation_bits=8,
                  moving_rate=0.9, **kwargs):
@@ -73,14 +86,42 @@ class QuantizedLinear(Layer):
                                                       moving_rate)
 
     def forward(self, x):
+        if self.int8_execution:
+            return self._forward_int8(x)
         x = self.act_quant(x)
         w = self.weight_quant(jnp.asarray(self.inner.weight))
         b = self.inner.bias
         return F.linear(x, w, None if b is None else jnp.asarray(b))
 
+    def _forward_int8(self, x):
+        if self.training:
+            raise RuntimeError(
+                "int8 execution is inference-only (jnp.round has no "
+                "gradient); keep fake-quant mode for training")
+        qmax = float(2 ** (self.act_quant.quant_bits - 1) - 1)
+        w = jnp.asarray(self.inner.weight)            # [in, out]
+        w_step = jnp.max(jnp.abs(w), axis=0) / qmax   # per out channel
+        a_step = jnp.maximum(
+            jnp.asarray(self.act_quant.scale.value, jnp.float32),
+            1e-8) / qmax
+        x_i8 = _int8_quantize(x, a_step)
+        w_i8 = _int8_quantize(w, w_step[None, :])
+        acc = jax.lax.dot_general(
+            x_i8, w_i8, (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * (a_step * w_step)
+        b = self.inner.bias
+        if b is not None:
+            y = y + jnp.asarray(b, jnp.float32)
+        return y.astype(x.dtype)
+
 
 class QuantizedConv2D(Layer):
-    """Reference: quant_layers.py QuantizedConv2D."""
+    """Reference: quant_layers.py QuantizedConv2D. `int8_execution` runs
+    the conv on int8 operands / int32 accumulator with per-output-channel
+    weight scales (see QuantizedLinear)."""
+
+    int8_execution = False
 
     def __init__(self, layer, weight_bits=8, activation_bits=8,
                  moving_rate=0.9, **kwargs):
@@ -91,10 +132,48 @@ class QuantizedConv2D(Layer):
                                                       moving_rate)
 
     def forward(self, x):
+        if self.int8_execution:
+            return self._forward_int8(x)
         x = self.act_quant(x)
         inner = self.inner
         w = self.weight_quant(jnp.asarray(inner.weight))
         return F.conv2d(
             x, w, None if inner.bias is None else jnp.asarray(inner.bias),
             stride=inner.stride, padding=inner.padding,
-            dilation=inner.dilation, groups=inner.groups)
+            dilation=inner.dilation, groups=inner.groups,
+            data_format=getattr(inner, "data_format", "NCHW"))
+
+    def _forward_int8(self, x):
+        if self.training:
+            raise RuntimeError(
+                "int8 execution is inference-only (jnp.round has no "
+                "gradient); keep fake-quant mode for training")
+        inner = self.inner
+        fmt = getattr(inner, "data_format", "NCHW")
+        qmax = float(2 ** (self.act_quant.quant_bits - 1) - 1)
+        w = jnp.asarray(inner.weight)                 # [oc, ic/g, kh, kw]
+        w_step = jnp.max(jnp.abs(w), axis=(1, 2, 3)) / qmax   # [oc]
+        a_step = jnp.maximum(
+            jnp.asarray(self.act_quant.scale.value, jnp.float32),
+            1e-8) / qmax
+        x_i8 = _int8_quantize(x, a_step)
+        w_i8 = _int8_quantize(w, w_step[:, None, None, None])
+        # direct lax conv: int8 operands, int32 accumulator (the int8
+        # conv path; F.conv2d would keep the operand dtype and overflow)
+        from ..functional.conv import _padding, _tuple
+        acc = jax.lax.conv_general_dilated(
+            x_i8, w_i8, window_strides=_tuple(inner.stride, 2),
+            padding=_padding(inner.padding, 2),
+            rhs_dilation=_tuple(inner.dilation, 2),
+            feature_group_count=inner.groups,
+            dimension_numbers=(fmt, "OIHW", fmt),
+            preferred_element_type=jnp.int32)
+        scale = a_step * w_step
+        if fmt == "NCHW":
+            y = acc.astype(jnp.float32) * scale[None, :, None, None]
+        else:
+            y = acc.astype(jnp.float32) * scale
+        if inner.bias is not None:
+            b = jnp.asarray(inner.bias, jnp.float32)
+            y = y + (jnp.reshape(b, (1, -1, 1, 1)) if fmt == "NCHW" else b)
+        return y.astype(x.dtype)

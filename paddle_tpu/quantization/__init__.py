@@ -71,6 +71,29 @@ def _quant_scales(model: Layer) -> Dict[str, float]:
     return scales
 
 
+def convert_to_int8(model: Layer) -> Layer:
+    """Flip every quantized sublayer into REAL int8 execution: matmuls/
+    convs run on int8 operands with int32 accumulators and per-output-
+    channel weight scales (reference: calibrated int8 execution,
+    `inference/api/mkldnn_quantizer.cc:1`,
+    `tensorrt/trt_int8_calibrator.cc:1` — not just annotation). Call
+    after training/calibration; the model should be in eval mode."""
+    n = 0
+    for _, sub in model.named_sublayers():
+        if isinstance(sub, (QuantizedLinear, QuantizedConv2D)):
+            sub.int8_execution = True
+            n += 1
+    if isinstance(model, (QuantizedLinear, QuantizedConv2D)):
+        model.int8_execution = True
+        n += 1
+    if n == 0:
+        import warnings
+        warnings.warn("convert_to_int8: no quantized layers found",
+                      stacklevel=2)
+    model.eval()
+    return model
+
+
 class QAT:
     """Quantization-aware training driver (reference:
     `ImperativeQuantAware`, qat.py)."""
@@ -94,17 +117,42 @@ class QAT:
         return model
 
     def save_quantized_model(self, model: Layer, path: str,
-                             input_spec=None, **config):
-        """Export int8-annotated StableHLO via jit.save + a sidecar
+                             input_spec=None, int8_execution=True,
+                             **config):
+        """Export quantized StableHLO via jit.save + a sidecar
         `<path>.quant.json` with the frozen scales (reference:
         `save_quantized_model` emitting the inference program with
-        quant/dequant ops and thresholds)."""
+        quant/dequant ops and thresholds).
+
+        int8_execution=True (default) converts the quantized layers to
+        REAL int8 compute first (`convert_to_int8`), so the exported
+        program's matmuls/convs execute on int8 — what the reference's
+        downstream runtimes do with the annotations. Pass False to keep
+        the fake-quant (float-simulated) form."""
         from ..jit import save as jit_save
         model.eval()
-        jit_save(model, path, input_spec=input_spec, **config)
-        meta = {"weight_bits": self.weight_bits,
-                "activation_bits": self.activation_bits,
-                "scales": _quant_scales(model)}
+        saved_flags = None
+        if int8_execution:
+            # convert for the EXPORT only, then restore — exporting must
+            # not change the live model's execution mode (training after
+            # export would otherwise silently get zero weight grads:
+            # the int8 path has no straight-through estimator)
+            saved_flags = {id(sub): sub.int8_execution
+                           for _, sub in model.named_sublayers()
+                           if isinstance(sub, (QuantizedLinear,
+                                               QuantizedConv2D))}
+            convert_to_int8(model)
+        try:
+            jit_save(model, path, input_spec=input_spec, **config)
+            meta = {"weight_bits": self.weight_bits,
+                    "activation_bits": self.activation_bits,
+                    "int8_execution": bool(int8_execution),
+                    "scales": _quant_scales(model)}
+        finally:
+            if saved_flags is not None:
+                for _, sub in model.named_sublayers():
+                    if id(sub) in saved_flags:
+                        sub.int8_execution = saved_flags[id(sub)]
         with open(path + ".quant.json", "w") as f:
             json.dump(meta, f, indent=1)
         return meta

@@ -76,13 +76,15 @@ class TestQATWorkflow:
         acc_q = _accuracy(qnet, X, Y)
         assert acc_q >= acc_fp32 - 0.01, (acc_q, acc_fp32)
 
-        # export int8-annotated StableHLO + scales sidecar, reload, parity
+        # export fake-quant StableHLO + scales sidecar, reload, parity
+        # (int8_execution=False keeps the float-simulated export form;
+        # the int8-executing default is covered in TestInt8Execution)
         qat = QAT()
         path = str(tmp_path / "lenet_int8")
         from paddle_tpu.static import InputSpec
         meta = qat.save_quantized_model(
-            qnet, path, input_spec=[InputSpec([None, 1, 28, 28],
-                                              "float32")])
+            qnet, path, int8_execution=False,
+            input_spec=[InputSpec([None, 1, 28, 28], "float32")])
         assert os.path.exists(path + ".quant.json")
         assert any(k.endswith("activation_scale") for k in meta["scales"])
         loaded = pt.jit.load(path)
@@ -113,3 +115,64 @@ class TestPTQWorkflow:
         assert all(s > 0 for s in scales)
         acc_q = _accuracy(qnet, X, Y)
         assert acc_q >= acc_fp32 - 0.02, (acc_q, acc_fp32)
+
+
+class TestInt8Execution:
+    """VERDICT r3 item 9: the exported program EXECUTES int8 (reference:
+    calibrated int8 execution in mkldnn_quantizer.cc /
+    trt_int8_calibrator.cc), not just annotation."""
+
+    def test_int8_ops_in_jaxpr_and_accuracy(self):
+        X, Y = _toy_data()
+        pt.seed(0)
+        qnet = _lenet()
+        QAT().quantize(qnet)
+        qnet.train()
+        _fit(qnet, X, Y)
+        acc_fake = _accuracy(qnet, X, Y)
+
+        from paddle_tpu.quantization import convert_to_int8
+        convert_to_int8(qnet)
+        # 1) the traced program really computes in int8: int8-operand
+        # dot_general/conv with int32 accumulation
+        from paddle_tpu.nn.layer import functional_call, trainable_state
+        jaxpr = jax.make_jaxpr(
+            lambda p, x: functional_call(qnet, p, x)[0])(
+                trainable_state(qnet), jnp.asarray(X[:4]))
+        txt = str(jaxpr)
+        assert "int8" in txt and "preferred_element_type=int32" in txt, \
+            txt[:2000]
+        # 2) executed-int8 accuracy within 1% of the QAT fake-quant model
+        acc_int8 = _accuracy(qnet, X, Y)
+        assert acc_int8 >= acc_fake - 0.01, (acc_int8, acc_fake)
+
+    def test_save_quantized_model_exports_int8_program(self, tmp_path):
+        X, Y = _toy_data()
+        pt.seed(1)
+        qnet = _lenet()
+        QAT().quantize(qnet)
+        qnet.train()
+        _fit(qnet, X, Y)
+        qat = QAT()
+        path = str(tmp_path / "lenet_int8exec")
+        from paddle_tpu.static import InputSpec
+        meta = qat.save_quantized_model(
+            qnet, path,
+            input_spec=[InputSpec([None, 1, 28, 28], "float32")])
+        assert meta["int8_execution"] is True
+        # (the int8-ness of the traced program is asserted via jaxpr in
+        # test_int8_ops_in_jaxpr_and_accuracy; the .pdmodel blob is an
+        # opaque serialized-export container)
+        # export must NOT flip the live model: it stays fake-quant
+        from paddle_tpu.nn.quant.quant_layers import QuantizedConv2D
+        assert all(not sub.int8_execution
+                   for _, sub in qnet.named_sublayers()
+                   if isinstance(sub, QuantizedConv2D))
+        loaded = pt.jit.load(path)
+        from paddle_tpu.nn.layer import functional_call, trainable_state
+        from paddle_tpu.quantization import convert_to_int8
+        convert_to_int8(qnet)   # compare int8-vs-int8
+        a = np.asarray(loaded(X[:8]))
+        b, _ = functional_call(qnet, trainable_state(qnet),
+                               jnp.asarray(X[:8]))
+        np.testing.assert_allclose(a, np.asarray(b), rtol=1e-4, atol=1e-5)
