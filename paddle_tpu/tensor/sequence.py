@@ -211,3 +211,70 @@ def segment_min(data, segment_ids, num_segments: Optional[int] = None):
     n = num_segments or int(jnp.max(segment_ids)) + 1
     init = jnp.full((n,) + data.shape[1:], jnp.inf, data.dtype)
     return init.at[segment_ids].min(data)
+
+
+def sequence_expand_as(x, y_lengths):
+    """Reference: sequence_expand_as op (`sequence_expand_as_op.cc`) —
+    row i of x repeats y_lengths[i] times. Static-shape form: output
+    capacity sum(max) rows with a repeat-index gather; use the padded
+    [B, T] layout — x [B, ...] -> [B, T, ...] tiled then masked."""
+    x = jnp.asarray(x)
+    lens = jnp.asarray(y_lengths)
+    T = int(np.max(np.asarray(y_lengths)))
+    tiled = jnp.repeat(x[:, None], T, axis=1)
+    m = sequence_mask(lens, T, dtype=x.dtype)
+    return tiled * m.reshape(m.shape + (1,) * (x.ndim - 1))
+
+
+def sequence_reshape(x, lengths, new_dim: int):
+    """Reference: sequence_reshape op — re-chunk each sequence's
+    [len_i, D] rows into [len_i*D/new_dim, new_dim]. Padded layout:
+    [B, T, D] -> [B, T*D//new_dim, new_dim] with lengths scaled by
+    D/new_dim (requires T*D % new_dim == 0)."""
+    B, T, D = x.shape
+    assert (T * D) % new_dim == 0, (T, D, new_dim)
+    out = jnp.reshape(x, (B, T * D // new_dim, new_dim))
+    new_lengths = jnp.asarray(lengths) * D // new_dim
+    return out, new_lengths
+
+
+def sequence_erase(x, lengths, tokens):
+    """Reference: sequence_erase op — drop the listed token ids from
+    each sequence, compacting left (padded [B, T] int layout; returns
+    (out, new_lengths); freed tail slots are 0)."""
+    x = jnp.asarray(x)
+    lens = jnp.asarray(lengths)
+    B, T = x.shape
+    valid = sequence_mask(lens, T, dtype="bool")
+    keep = valid
+    for t in np.asarray(tokens).reshape(-1):
+        keep = keep & (x != int(t))
+    # stable compaction: position = rank of kept element in its row
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    out = jnp.zeros_like(x)
+    dst = jnp.where(keep, pos, T)          # dropped -> out-of-bounds
+    out = out.at[jnp.arange(B)[:, None], dst].set(
+        jnp.where(keep, x, 0), mode="drop")
+    return out, jnp.sum(keep.astype(jnp.int32), axis=1)
+
+
+def sequence_topk_avg_pooling(x, lengths, topks, channel_num: int = 1):
+    """Reference: sequence_topk_avg_pooling op — for each k in `topks`,
+    average the top-k values per (row, channel) over the valid length.
+    x [B, C, T] -> [B, C*len(topks)]."""
+    x = jnp.asarray(x)
+    lens = jnp.asarray(lengths)
+    B, C, T = x.shape
+    m = sequence_mask(lens, T, dtype="bool")[:, None, :]   # [B,1,T]
+    neg = jnp.where(m, x, -jnp.inf)
+    kmax = max(int(k) for k in topks)
+    top, _ = jax.lax.top_k(neg, min(kmax, T))              # [B,C,kmax]
+    finite = jnp.isfinite(top)
+    top = jnp.where(finite, top, 0.0)
+    outs = []
+    for k in topks:
+        k = min(int(k), T)
+        cnt = jnp.sum(finite[..., :k].astype(jnp.float32), axis=-1)
+        outs.append(jnp.sum(top[..., :k], axis=-1)
+                    / jnp.maximum(cnt, 1.0))
+    return jnp.concatenate(outs, axis=-1).reshape(B, C * len(topks))

@@ -45,7 +45,8 @@ def box_iou(boxes1, boxes2, eps: float = 1e-10):
     return inter / (a1 + a2 - inter + eps)
 
 
-iou_similarity = box_iou  # reference alias (`iou_similarity_op.cc`)
+# `iou_similarity` (the reference's box_normalized-aware op,
+# `iou_similarity_op.cc`) is defined in the detection tranche below.
 
 
 # ---------------------------------------------------------------------------
@@ -512,3 +513,257 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
                             ignore_thresh=ignore_thresh,
                             downsample_ratios=(downsample_ratio,),
                             gt_score=gt_score)
+
+
+# ---------------------------------------------------------------------------
+# Detection tranche (round 4): RCNN/SSD-family ops
+# ---------------------------------------------------------------------------
+
+def anchor_generator(feature_hw, anchor_sizes=(64., 128., 256., 512.),
+                     aspect_ratios=(0.5, 1.0, 2.0),
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0),
+                     offset=0.5):
+    """RPN anchors (`detection/anchor_generator_op.cc`). Returns
+    (anchors [H, W, A, 4] xyxy in IMAGE pixels, variances same shape)."""
+    H, W = feature_hw
+    ws, hs = [], []
+    for size in anchor_sizes:
+        area = float(size) * float(size)
+        for ar in aspect_ratios:
+            w = np.sqrt(area / ar)
+            ws.append(w)
+            hs.append(w * ar)
+    wh = np.stack([np.asarray(ws), np.asarray(hs)], -1)  # [A, 2]
+    cx = (np.arange(W, dtype=np.float32) + offset) * stride[0]
+    cy = (np.arange(H, dtype=np.float32) + offset) * stride[1]
+    cxg, cyg = np.meshgrid(cx, cy)
+    bw = wh[None, None, :, 0] / 2
+    bh = wh[None, None, :, 1] / 2
+    anchors = np.stack([cxg[..., None] - bw, cyg[..., None] - bh,
+                        cxg[..., None] + bw, cyg[..., None] + bh], -1)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          anchors.shape).copy()
+    return jnp.asarray(anchors, jnp.float32), jnp.asarray(var)
+
+
+def density_prior_box(feature_hw, image_hw, densities=(4, 2, 1),
+                      fixed_sizes=(32.0, 64.0, 128.0),
+                      fixed_ratios=(1.0,),
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5):
+    """Densified SSD priors (`detection/density_prior_box_op.cc`):
+    each fixed_size spawns density^2 shifted centers. Returns
+    (boxes [H, W, P, 4] normalized xyxy, variances)."""
+    H, W = feature_hw
+    img_h, img_w = image_hw
+    step_h = steps[0] or img_h / H
+    step_w = steps[1] or img_w / W
+    centers_x = (np.arange(W, dtype=np.float32) + offset) * step_w
+    centers_y = (np.arange(H, dtype=np.float32) + offset) * step_h
+    out = []
+    for size, density in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            shift = size / density
+            for di in range(density):
+                for dj in range(density):
+                    dx = (dj + 0.5) * shift - size / 2.0
+                    dy = (di + 0.5) * shift - size / 2.0
+                    cxg, cyg = np.meshgrid(centers_x + dx, centers_y + dy)
+                    out.append(np.stack(
+                        [(cxg - bw / 2) / img_w, (cyg - bh / 2) / img_h,
+                         (cxg + bw / 2) / img_w, (cyg + bh / 2) / img_h],
+                        -1))
+    boxes = np.stack(out, axis=2)                         # [H, W, P, 4]
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          boxes.shape).copy()
+    return jnp.asarray(boxes, jnp.float32), jnp.asarray(var)
+
+
+def iou_similarity(x, y, box_normalized=True):
+    """Pairwise IoU [N, 4] x [M, 4] -> [N, M]
+    (`detection/iou_similarity_op.cc`). Differentiable."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    off = 0.0 if box_normalized else 1.0
+    ax = (x[:, 2] - x[:, 0] + off) * (x[:, 3] - x[:, 1] + off)
+    ay = (y[:, 2] - y[:, 0] + off) * (y[:, 3] - y[:, 1] + off)
+    ix = jnp.maximum(jnp.minimum(x[:, None, 2], y[None, :, 2]) -
+                     jnp.maximum(x[:, None, 0], y[None, :, 0]) + off, 0.0)
+    iy = jnp.maximum(jnp.minimum(x[:, None, 3], y[None, :, 3]) -
+                     jnp.maximum(x[:, None, 1], y[None, :, 1]) + off, 0.0)
+    inter = ix * iy
+    return inter / jnp.maximum(ax[:, None] + ay[None, :] - inter, 1e-10)
+
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes to image bounds (`detection/box_clip_op.cc`).
+    input [..., 4] xyxy; im_info [3] = (h, w, scale) — boxes live in the
+    ORIGINAL image, so bounds are round(h/scale)-1 / round(w/scale)-1
+    (the reference's GetImInfo); [2] = (h, w) clips to h-1/w-1."""
+    b = jnp.asarray(input)
+    info = jnp.asarray(im_info, b.dtype).reshape(-1)
+    if info.shape[0] >= 3:
+        h = jnp.round(info[0] / info[2])
+        w = jnp.round(info[1] / info[2])
+    else:
+        h, w = info[0], info[1]
+    return jnp.stack([jnp.clip(b[..., 0], 0.0, w - 1),
+                      jnp.clip(b[..., 1], 0.0, h - 1),
+                      jnp.clip(b[..., 2], 0.0, w - 1),
+                      jnp.clip(b[..., 3], 0.0, h - 1)], axis=-1)
+
+
+def bipartite_match(dist_matrix):
+    """Greedy bipartite matching (`detection/bipartite_match_op.cc`,
+    match_type='bipartite'): repeatedly take the globally-largest entry,
+    retire its row and column. dist [N, M] -> (match_indices [M] int32
+    row matched to each column or -1, match_dist [M])."""
+    d = jnp.asarray(dist_matrix, jnp.float32)
+    n, m = d.shape
+    steps = min(n, m)
+
+    def body(carry, _):
+        d, idx, dist = carry
+        flat = jnp.argmax(d)
+        i, j = flat // m, flat % m
+        best = d[i, j]
+        take = best > 0
+        idx = jnp.where(take, idx.at[j].set(i.astype(jnp.int32)), idx)
+        dist = jnp.where(take, dist.at[j].set(best), dist)
+        d = jnp.where(take, d.at[i, :].set(-1.0).at[:, j].set(-1.0), d)
+        return (d, idx, dist), None
+
+    idx0 = jnp.full((m,), -1, jnp.int32)
+    dist0 = jnp.zeros((m,), jnp.float32)
+    (_, idx, dist), _ = jax.lax.scan(body, (d, idx0, dist0), None,
+                                     length=steps)
+    return idx, dist
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0):
+    """Gather per-column targets by match index
+    (`detection/target_assign_op.cc`): out[j] = input[matched[j]] where
+    matched >= 0, else mismatch_value; weight 1 where matched else 0."""
+    x = jnp.asarray(input)
+    mi = jnp.asarray(matched_indices)
+    valid = mi >= 0
+    safe = jnp.where(valid, mi, 0)
+    out = jnp.where(valid[..., None] if x.ndim > 1 else valid,
+                    x[safe], mismatch_value)
+    w = valid.astype(jnp.float32)
+    if negative_indices is not None:
+        neg = jnp.zeros_like(w).at[jnp.asarray(negative_indices)].set(1.0)
+        w = jnp.maximum(w, neg)
+    return out, w
+
+
+def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0):
+    """Matrix NMS (`detection/matrix_nms_op.cc`, SOLOv2): parallel decay
+    of every box's score by its IoU with higher-scored same-class boxes —
+    no sequential suppression loop, so it lowers to pure matmul-shaped
+    XLA. bboxes [N, 4]; scores [C, N]. Returns (out [keep_top_k, 6]
+    (class, score, x1, y1, x2, y2), rows past the kept count padded -1;
+    num_kept)."""
+    boxes = jnp.asarray(bboxes, jnp.float32)
+    sc = jnp.asarray(scores, jnp.float32)
+    C, N = sc.shape
+    top = min(nms_top_k, N)
+
+    def per_class(cls_scores):
+        # score_threshold filters CANDIDATES (pre-decay, the reference's
+        # selection step); post_threshold filters after decay
+        cls_scores = jnp.where(cls_scores > score_threshold,
+                               cls_scores, 0.0)
+        s, order = jax.lax.top_k(cls_scores, top)
+        b = boxes[order]
+        iou = iou_similarity(b, b)                       # [top, top]
+        tri = jnp.tril(jnp.ones((top, top), bool), k=-1)
+        ious = jnp.where(tri, iou, 0.0)                  # j<i: higher rank
+        max_iou = jnp.max(ious, axis=1)                  # compensate term
+        if use_gaussian:
+            decay = jnp.exp(-(ious ** 2 - max_iou[None, :] ** 2)
+                            / gaussian_sigma)
+        else:
+            decay = (1.0 - ious) / jnp.maximum(1.0 - max_iou[None, :],
+                                               1e-10)
+        decay = jnp.min(jnp.where(tri, decay, 1.0), axis=1)
+        return s * decay, b
+
+    dec, bs = jax.vmap(per_class)(sc)                    # [C, top], [C, top, 4]
+    cls_ids = jnp.broadcast_to(jnp.arange(C)[:, None], (C, top))
+    flat_s = dec.reshape(-1)
+    flat_b = bs.reshape(-1, 4)
+    flat_c = cls_ids.reshape(-1)
+    k = min(keep_top_k, flat_s.shape[0])
+    best, sel = jax.lax.top_k(flat_s, k)
+    keep = (best > post_threshold) & (best > 0.0)
+    out = jnp.concatenate([
+        jnp.where(keep, flat_c[sel], -1).astype(jnp.float32)[:, None],
+        jnp.where(keep, best, -1.0)[:, None],
+        jnp.where(keep[:, None], flat_b[sel], -1.0)], axis=1)
+    return out, jnp.sum(keep.astype(jnp.int32))
+
+
+def polygon_box_transform(input, name=None):
+    """(`detection/polygon_box_transform_op.cc`): quad-offset maps to
+    absolute coords — input [N, 8k, H, W] at 1/4 geo resolution; the ref
+    kernel computes out = 4*index - in (even channels use the col index,
+    odd the row index)."""
+    x = jnp.asarray(input)
+    n, c, h, w = x.shape
+    col = jnp.broadcast_to(jnp.arange(w, dtype=x.dtype), (h, w))
+    row = jnp.broadcast_to(jnp.arange(h, dtype=x.dtype)[:, None], (h, w))
+    even = jnp.arange(c) % 2 == 0
+    grid = jnp.where(even[:, None, None], col[None], row[None])
+    return 4.0 * grid[None] - x
+
+
+def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       name=None):
+    """RPN proposal generation (`detection/generate_proposals_op.cc`),
+    static-shape XLA form: top-k -> decode -> clip -> size-filter ->
+    fixed-size NMS. scores [A*H*W] (objectness, single image),
+    bbox_deltas [A*H*W, 4], anchors/variances [A*H*W, 4].
+    Returns (rois [post_nms_top_n, 4], roi_scores [post_nms_top_n]) —
+    trailing rows score 0 when fewer survive (the fixed-capacity pad of
+    this framework's detection contract)."""
+    s = jnp.asarray(scores).reshape(-1)
+    d = jnp.asarray(bbox_deltas).reshape(-1, 4)
+    a = jnp.asarray(anchors).reshape(-1, 4)
+    v = jnp.asarray(variances).reshape(-1, 4)
+    top = min(pre_nms_top_n, s.shape[0])
+    sc, order = jax.lax.top_k(s, top)
+    d, a, v = d[order], a[order], v[order]
+    # decode (box_coder decode_center_size semantics)
+    aw = a[:, 2] - a[:, 0] + 1.0
+    ah = a[:, 3] - a[:, 1] + 1.0
+    acx = a[:, 0] + aw * 0.5
+    acy = a[:, 1] + ah * 0.5
+    cx = v[:, 0] * d[:, 0] * aw + acx
+    cy = v[:, 1] * d[:, 1] * ah + acy
+    bw = jnp.exp(jnp.minimum(v[:, 2] * d[:, 2], 10.0)) * aw
+    bh = jnp.exp(jnp.minimum(v[:, 3] * d[:, 3], 10.0)) * ah
+    boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
+                       cx + bw / 2, cy + bh / 2], -1)
+    boxes = box_clip(boxes, im_shape)
+    ww = boxes[:, 2] - boxes[:, 0] + 1.0
+    hh = boxes[:, 3] - boxes[:, 1] + 1.0
+    valid = (ww >= min_size) & (hh >= min_size)
+    sc = jnp.where(valid, sc, -1.0)
+    keep = nms(boxes, sc, iou_threshold=nms_thresh) & valid
+    masked = jnp.where(keep, sc, -jnp.inf)
+    k = min(post_nms_top_n, masked.shape[0])
+    best, sel = jax.lax.top_k(masked, k)
+    alive = jnp.isfinite(best)
+    rois = jnp.where(alive[:, None], boxes[sel], 0.0)
+    roi_scores = jnp.where(alive, best, 0.0)
+    return rois, roi_scores

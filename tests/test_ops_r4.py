@@ -1,0 +1,176 @@
+"""Round-4 op tranche: detection (anchor/density priors, iou, clip,
+bipartite match, target assign, matrix NMS, proposals, polygon) and the
+remaining sequence ops — vs hand NumPy references, gradcheck where
+differentiable (reference: operators/detection/, operators/sequence_ops/).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.vision.ops as V
+import paddle_tpu.tensor.sequence as S
+
+
+def _gradcheck(f, *args, eps=1e-3, rtol=5e-2, atol=5e-4):
+    """Finite-difference check of jax.grad on a scalar-valued f."""
+    g = jax.grad(f)(*args)
+    x = args[0]
+    flat = np.asarray(x).ravel()
+    for k in np.random.RandomState(0).choice(flat.size,
+                                             size=min(6, flat.size),
+                                             replace=False):
+        d = np.zeros_like(flat)
+        d[k] = eps
+        xp = jnp.asarray((flat + d).reshape(x.shape))
+        xm = jnp.asarray((flat - d).reshape(x.shape))
+        num = (f(xp, *args[1:]) - f(xm, *args[1:])) / (2 * eps)
+        np.testing.assert_allclose(np.asarray(g).ravel()[k], num,
+                                   rtol=rtol, atol=atol)
+
+
+class TestDetectionTranche:
+    def test_anchor_generator_shapes_and_centers(self):
+        a, v = V.anchor_generator((4, 5), anchor_sizes=(64.0,),
+                                  aspect_ratios=(1.0,), stride=(16., 16.))
+        assert a.shape == (4, 5, 1, 4) and v.shape == a.shape
+        # first anchor centered at (8, 8) with size 64
+        np.testing.assert_allclose(np.asarray(a[0, 0, 0]),
+                                   [8 - 32, 8 - 32, 8 + 32, 8 + 32])
+
+    def test_density_prior_box_counts(self):
+        b, v = V.density_prior_box((2, 2), (32, 32), densities=(2, 1),
+                                   fixed_sizes=(8.0, 16.0))
+        # P = 2^2 + 1^2 = 5 priors per cell
+        assert b.shape == (2, 2, 5, 4) and v.shape == b.shape
+
+    def test_iou_similarity_values_and_grads(self):
+        x = jnp.asarray([[0., 0., 2., 2.]])
+        y = jnp.asarray([[1., 1., 3., 3.], [0., 0., 2., 2.]])
+        iou = V.iou_similarity(x, y)
+        np.testing.assert_allclose(np.asarray(iou), [[1 / 7, 1.0]],
+                                   rtol=1e-6)
+        x0 = jnp.asarray(np.random.RandomState(0).rand(3, 4) * 2)
+        x0 = x0.at[:, 2:].add(2.0)  # ensure x2>x1, y2>y1
+        y0 = jnp.asarray([[0.5, 0.5, 2.5, 2.5]])
+        _gradcheck(lambda a: jnp.sum(V.iou_similarity(a, y0)), x0)
+
+    def test_box_clip(self):
+        b = jnp.asarray([[-5., -5., 50., 60.], [1., 2., 3., 4.]])
+        out = V.box_clip(b, jnp.asarray([20., 30., 1.0]))
+        np.testing.assert_allclose(np.asarray(out),
+                                   [[0, 0, 29, 19], [1, 2, 3, 4]])
+
+    def test_bipartite_match_greedy(self):
+        d = jnp.asarray([[0.9, 0.1], [0.8, 0.7]])
+        idx, dist = V.bipartite_match(d)
+        # global max 0.9 -> row0/col0; remaining best col1 <- row1 (0.7)
+        assert idx.tolist() == [0, 1]
+        np.testing.assert_allclose(np.asarray(dist), [0.9, 0.7])
+
+    def test_target_assign(self):
+        x = jnp.asarray([[1., 2.], [3., 4.], [5., 6.]])
+        out, w = V.target_assign(x, jnp.asarray([2, -1, 0]),
+                                 mismatch_value=9.0)
+        np.testing.assert_allclose(np.asarray(out),
+                                   [[5, 6], [9, 9], [1, 2]])
+        np.testing.assert_allclose(np.asarray(w), [1, 0, 1])
+
+    def test_matrix_nms_keeps_separated_boxes(self):
+        boxes = jnp.asarray([[0., 0., 10., 10.], [0., 0., 10.5, 10.],
+                             [50., 50., 60., 60.]])
+        scores = jnp.asarray([[0.9, 0.8, 0.7]])
+        out, n = V.matrix_nms(boxes, scores, keep_top_k=3,
+                              score_threshold=0.3)
+        got = np.asarray(out)
+        # best box survives at full score; far box barely decayed;
+        # near-duplicate decayed hard
+        assert got[0][1] == pytest.approx(0.9, abs=1e-6)
+        assert int(n) >= 2
+        assert got[1][1] == pytest.approx(0.7, abs=0.02)
+
+    def test_polygon_box_transform(self):
+        """Reference kernel: out = 4*index - in (geo maps at 1/4 res)."""
+        x = jnp.zeros((1, 2, 2, 3))
+        out = V.polygon_box_transform(x)
+        np.testing.assert_allclose(np.asarray(out[0, 0]),
+                                   [[0, 4, 8], [0, 4, 8]])
+        np.testing.assert_allclose(np.asarray(out[0, 1]),
+                                   [[0, 0, 0], [4, 4, 4]])
+
+    def test_box_clip_respects_scale(self):
+        """im_info=(h, w, scale): bounds are round(h/scale)-1."""
+        b = jnp.asarray([[0., 0., 500., 700.]])
+        out = V.box_clip(b, jnp.asarray([800., 600., 2.0]))
+        np.testing.assert_allclose(np.asarray(out),
+                                   [[0, 0, 299, 399]])
+
+    def test_matrix_nms_post_threshold_only_after_decay(self):
+        """A decayed-but-positive score survives post_threshold=0 even
+        below score_threshold (reference: pre-decay candidate filter,
+        post-decay output filter)."""
+        boxes = jnp.asarray([[0., 0., 10., 10.], [0., 0., 10., 10.01]])
+        scores = jnp.asarray([[0.9, 0.6]])
+        out, n = V.matrix_nms(boxes, scores, score_threshold=0.5,
+                              post_threshold=0.0, keep_top_k=2)
+        assert int(n) == 2            # near-dup decays to ~0 but > 0
+        assert np.asarray(out)[1][1] < 0.05
+
+    def test_generate_proposals_end_to_end(self):
+        rs = np.random.RandomState(0)
+        A = 12
+        anchors = np.stack([np.zeros(A), np.zeros(A),
+                            np.full(A, 10.0), np.full(A, 10.0)], -1) \
+            + rs.rand(A, 4) * 2
+        scores = rs.rand(A).astype(np.float32)
+        deltas = (rs.rand(A, 4).astype(np.float32) - 0.5) * 0.2
+        var = np.full((A, 4), 0.1, np.float32)
+        rois, rsc = V.generate_proposals(
+            jnp.asarray(scores), jnp.asarray(deltas),
+            jnp.asarray([50., 50.]), jnp.asarray(anchors),
+            jnp.asarray(var), pre_nms_top_n=8, post_nms_top_n=4,
+            nms_thresh=0.8, min_size=1.0)
+        assert rois.shape == (4, 4) and rsc.shape == (4,)
+        got = np.asarray(rsc)
+        assert (got[:-1] >= got[1:] - 1e-6).all()  # sorted
+        assert got[0] == pytest.approx(float(scores.max()), abs=1e-6)
+
+
+class TestSequenceTranche:
+    def test_sequence_expand_as(self):
+        x = jnp.asarray([[1., 2.], [3., 4.]])
+        out = S.sequence_expand_as(x, [1, 3])
+        assert out.shape == (2, 3, 2)
+        np.testing.assert_allclose(np.asarray(out[0]),
+                                   [[1, 2], [0, 0], [0, 0]])
+        np.testing.assert_allclose(np.asarray(out[1]),
+                                   [[3, 4], [3, 4], [3, 4]])
+
+    def test_sequence_reshape(self):
+        x = jnp.arange(12.0).reshape(1, 3, 4)
+        out, lens = S.sequence_reshape(x, jnp.asarray([2]), new_dim=2)
+        assert out.shape == (1, 6, 2)
+        np.testing.assert_allclose(np.asarray(lens), [4])
+
+    def test_sequence_erase(self):
+        x = jnp.asarray([[2, 1, 2, 3, 0], [5, 2, 5, 0, 0]])
+        out, lens = S.sequence_erase(x, jnp.asarray([4, 3]), tokens=[2])
+        np.testing.assert_allclose(np.asarray(out),
+                                   [[1, 3, 0, 0, 0], [5, 5, 0, 0, 0]])
+        np.testing.assert_allclose(np.asarray(lens), [2, 2])
+
+    def test_sequence_topk_avg_pooling(self):
+        x = jnp.asarray([[[3., 1., 2., -1.]]])        # [1, 1, 4]
+        out = S.sequence_topk_avg_pooling(x, jnp.asarray([3]),
+                                          topks=(1, 2))
+        # valid = [3,1,2]; top1 avg = 3; top2 avg = 2.5
+        np.testing.assert_allclose(np.asarray(out), [[3.0, 2.5]])
+
+    def test_sequence_conv_grad(self):
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(2, 4, 3).astype(np.float32))
+        w = jnp.asarray(rs.randn(9, 5).astype(np.float32))
+        _gradcheck(lambda a: jnp.sum(
+            S.sequence_conv(a, w, context_length=3) ** 2), x,
+            rtol=7e-2, atol=2e-3)
