@@ -1,0 +1,114 @@
+"""Book example (reference: tests/book/test_machine_translation.py's
+STATIC decode half, `fluid/layers/control_flow.py while_loop:1115 +
+while_op.cc`): a greedy decoder written as a classic static-graph
+`static.nn.while_loop` over build-time Variables — the loop's cond/body
+are captured into a sub-program (static/program.py capture_program) and
+replayed inside lax.while_loop by the one-jit Executor.
+
+A tiny "next-token" RNN cell is trained in dygraph, its weights are fed
+into a static program whose while_loop greedily decodes a fixed-length
+output buffer (TensorArray-free: scatter into a static [max_len] buffer,
+the XLA-native form of the book's array_write pattern).
+
+Run: python examples/static_rnn_decode.py
+"""
+import numpy as np
+
+
+def main(vocab=16, hidden=24, max_len=6):
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+
+    rs = np.random.RandomState(0)
+    # "language": token t is followed by (3*t + 1) % vocab
+    follow = (3 * np.arange(vocab) + 1) % vocab
+
+    # --- train a one-step predictor eagerly (embedding -> fc -> logits)
+    emb = paddle.nn.Embedding(vocab, hidden)
+    fc = paddle.nn.Linear(hidden, vocab)
+    opt = paddle.optimizer.Adam(
+        learning_rate=0.1,
+        parameters=list(emb.parameters()) + list(fc.parameters()))
+    ce = paddle.nn.CrossEntropyLoss()
+    import jax
+    from paddle_tpu.nn.layer import functional_call, trainable_state
+
+    xs = rs.randint(0, vocab, (256,))
+    ys = follow[xs]
+
+    def loss_fn(params):
+        e, _ = functional_call(emb, {k[4:]: v for k, v in params.items()
+                                     if k.startswith("emb.")},
+                               jnp.asarray(xs))
+        lo, _ = functional_call(fc, {k[3:]: v for k, v in params.items()
+                                     if k.startswith("fc.")}, e)
+        return ce(lo, jnp.asarray(ys))
+
+    params = {**{f"emb.{k}": v for k, v in trainable_state(emb).items()},
+              **{f"fc.{k}": v for k, v in trainable_state(fc).items()}}
+    opt_state = opt.init_state(params)
+    for _ in range(60):
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        params, opt_state = opt.apply(params, g, opt_state)
+    W = np.asarray(params["emb.weight"])
+    Wf = np.asarray(params["fc.weight"])
+    bf = np.asarray(params["fc.bias"])
+    print(f"train loss {float(loss):.4f}")
+
+    # --- classic static decode: while_loop over build-time Variables
+    paddle.enable_static()
+    try:
+        main_prog = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main_prog, startup):
+            table = paddle.static.data("table", [vocab, hidden], "float32")
+            proj = paddle.static.data("proj", [hidden, vocab], "float32")
+            bias = paddle.static.data("bias", [vocab], "float32")
+            start = paddle.static.data("start", [1], "float32")
+
+            buf = paddle.concat([start * 0.0] * max_len)   # [max_len]
+            i = paddle.sum(start * 0.0)
+            tok = paddle.sum(start)
+
+            def cond(i, tok, buf):
+                return i < float(max_len)
+
+            def body(i, tok, buf):
+                row = paddle.cast(tok, "int32")
+                h = paddle.gather(table, row)              # [hidden]
+                logits = paddle.matmul(
+                    paddle.reshape(h, [1, hidden]), proj)  # [1, vocab]
+                logits = logits + paddle.reshape(bias, [1, vocab])
+                nxt = paddle.cast(paddle.argmax(
+                    paddle.reshape(logits, [vocab])), "float32")
+                buf = paddle.scatter(
+                    paddle.reshape(buf, [max_len, 1]),
+                    paddle.reshape(paddle.cast(i, "int64"), [1]),
+                    paddle.reshape(nxt, [1, 1]))
+                return [i + 1.0, nxt, paddle.reshape(buf, [max_len])]
+
+            _, _, decoded = paddle.static.nn.while_loop(
+                cond, body, [i, tok, buf])
+
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        start_tok = 2
+        out = exe.run(main_prog,
+                      feed={"table": W, "proj": Wf, "bias": bf,
+                            "start": np.asarray([start_tok], np.float32)},
+                      fetch_list=[decoded])[0]
+        got = [int(v) for v in out]
+        want = []
+        t = start_tok
+        for _ in range(max_len):
+            t = int(follow[t])
+            want.append(t)
+        print(f"decoded {got} expected {want}")
+        assert got == want, (got, want)
+        print("static while_loop decode OK")
+    finally:
+        paddle.disable_static()
+
+
+if __name__ == "__main__":
+    main()

@@ -66,3 +66,8 @@ def test_ocr_pipeline_example():
     l0, l1, boxes = ocr_pipeline.main(steps=25)
     assert l1 < l0
     assert boxes, "detector found no box"
+
+
+def test_static_rnn_decode_example():
+    import static_rnn_decode
+    static_rnn_decode.main()   # asserts greedy decode == ground truth
