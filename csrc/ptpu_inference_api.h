@@ -1,0 +1,54 @@
+/* paddle_tpu native inference C API.
+ *
+ * Reference counterpart: paddle/fluid/inference/capi_exp/pd_inference_api.h
+ * (PD_PredictorCreate / PD_PredictorRun / PD_TensorCopyToCpu...).
+ *
+ * The deployment artifact is the self-contained ONNX wire file emitted by
+ * `paddle_tpu.onnx.export(layer, path, input_spec=...)` (or
+ * `QAT.save_quantized_model`). Link against paddle_tpu/_native_predictor.so;
+ * no Python, protobuf, or ONNX runtime is needed in the serving process —
+ * see csrc/ptpu_predictor_demo.c for a complete caller.
+ *
+ * Thread-compatibility: one predictor per thread; no global state.
+ */
+#ifndef PTPU_INFERENCE_API_H_
+#define PTPU_INFERENCE_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PTPU_Predictor PTPU_Predictor;
+
+/* Load a model file. Returns NULL on failure and writes a message into
+ * err (truncated to err_len). */
+PTPU_Predictor* ptpu_predictor_create(const char* model_path, char* err,
+                                      int err_len);
+void ptpu_predictor_destroy(PTPU_Predictor*);
+
+int ptpu_predictor_num_inputs(PTPU_Predictor*);
+int ptpu_predictor_num_outputs(PTPU_Predictor*);
+const char* ptpu_predictor_input_name(PTPU_Predictor*, int i);
+
+/* Bind a float32 input by name (row-major, dims[ndim]). Returns 0 on
+ * success, nonzero + err message otherwise. */
+int ptpu_predictor_set_input(PTPU_Predictor*, const char* name,
+                             const float* data, const int64_t* dims,
+                             int ndim, char* err, int err_len);
+
+/* Execute the graph. Returns 0 on success. */
+int ptpu_predictor_run(PTPU_Predictor*, char* err, int err_len);
+
+/* Output i of the last run. dims/data pointers stay valid until the next
+ * run or destroy; integer outputs are materialized as float32. */
+int ptpu_predictor_output_ndim(PTPU_Predictor*, int i);
+const int64_t* ptpu_predictor_output_dims(PTPU_Predictor*, int i);
+const float* ptpu_predictor_output_data(PTPU_Predictor*, int i);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* PTPU_INFERENCE_API_H_ */

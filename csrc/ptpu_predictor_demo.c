@@ -9,18 +9,7 @@
 #include <stdlib.h>
 #include <string.h>
 
-typedef struct PTPU_Predictor PTPU_Predictor;
-PTPU_Predictor* ptpu_predictor_create(const char*, char*, int);
-void ptpu_predictor_destroy(PTPU_Predictor*);
-int ptpu_predictor_num_inputs(PTPU_Predictor*);
-int ptpu_predictor_num_outputs(PTPU_Predictor*);
-const char* ptpu_predictor_input_name(PTPU_Predictor*, int);
-int ptpu_predictor_set_input(PTPU_Predictor*, const char*, const float*,
-                             const int64_t*, int, char*, int);
-int ptpu_predictor_run(PTPU_Predictor*, char*, int);
-int ptpu_predictor_output_ndim(PTPU_Predictor*, int);
-const int64_t* ptpu_predictor_output_dims(PTPU_Predictor*, int);
-const float* ptpu_predictor_output_data(PTPU_Predictor*, int);
+#include "ptpu_inference_api.h"
 
 int main(int argc, char** argv) {
   char err[512] = {0};
