@@ -277,7 +277,7 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
                      donate: bool = True, pipeline_schedule: str = "gpipe",
                      remat_policy: str = "dots", loss_chunks: int = 0,
                      zero_stage: int = 2, sequence_zigzag: bool = True,
-                     offload: bool = False):
+                     sequence_mode: str = "ring", offload: bool = False):
     """Build the one compiled hybrid-parallel training step.
 
     Parallelism comes entirely from the mesh axes: 'data' (DP — batch dim),
@@ -409,9 +409,14 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
     if sp > 1:
         from ..distributed.meta_parallel.sequence_parallel import (
             make_sp_attention, zigzag_permutation)
+        if sequence_mode == "ulysses":
+            # all-to-all resharding: every chip sees the FULL sequence
+            # for its head slice, so the contiguous layout is already
+            # causal-balanced — no zigzag
+            sequence_zigzag = False
         sp_attn_fn = make_sp_attention(
-            mesh, mode="ring", causal=True, zigzag=sequence_zigzag,
-            jit=False)
+            mesh, mode=sequence_mode, causal=True,
+            zigzag=sequence_zigzag, jit=False)
 
         def sp_layout(input_ids, labels):
             """Zigzag-reorder tokens so each rank gets an equal share of
