@@ -288,3 +288,23 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
     out = (sample(x0, y0) * wa[:, None] + sample(x0, y1) * wb[:, None] +
            sample(x1, y0) * wc[:, None] + sample(x1, y1) * wd[:, None])
     return out.astype(x.dtype)
+
+
+def shuffle_channel(x, group: int, name=None):
+    """ShuffleNet channel shuffle (`shuffle_channel_op.cc`):
+    [N, C, H, W] -> reshape [N, g, C/g, H, W] -> swap -> flatten."""
+    n, c, h, w = x.shape
+    assert c % group == 0, (c, group)
+    return jnp.reshape(
+        jnp.swapaxes(jnp.reshape(x, (n, group, c // group, h, w)), 1, 2),
+        (n, c, h, w))
+
+
+def fsp_matrix(x, y):
+    """Flow-of-solution-procedure matrix (`fsp_op.cc`, distillation):
+    [N, C1, H, W] x [N, C2, H, W] -> [N, C1, C2] = x·yᵀ / (H*W)."""
+    n, c1, h, w = x.shape
+    c2 = y.shape[1]
+    xf = jnp.reshape(x, (n, c1, h * w))
+    yf = jnp.reshape(y, (n, c2, h * w))
+    return jnp.einsum("nab,ncb->nac", xf, yf) / float(h * w)

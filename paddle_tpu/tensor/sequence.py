@@ -278,3 +278,84 @@ def sequence_topk_avg_pooling(x, lengths, topks, channel_num: int = 1):
         outs.append(jnp.sum(top[..., :k], axis=-1)
                     / jnp.maximum(cnt, 1.0))
     return jnp.concatenate(outs, axis=-1).reshape(B, C * len(topks))
+
+
+def edit_distance(input, label, input_length=None, label_length=None,
+                  normalized=True):
+    """Levenshtein distance per batch row (`edit_distance_op.cc`, the
+    OCR/ASR eval metric). Padded [B, T]/[B, S] int layouts with optional
+    lengths. Returns (dist [B, 1] float32, seq_num [B] erased? — the
+    reference returns sequence count; here (dist, total_pairs)).
+
+    Dynamic programming over a lax.scan per row pair — O(T*S) static
+    work, no data-dependent shapes."""
+    x = jnp.asarray(input)
+    y = jnp.asarray(label)
+    B, T = x.shape
+    S = y.shape[1]
+    xl = jnp.full((B,), T) if input_length is None \
+        else jnp.asarray(input_length)
+    yl = jnp.full((B,), S) if label_length is None \
+        else jnp.asarray(label_length)
+
+    # mask pads with distinct sentinels so they never match
+    xm = jnp.where(jnp.arange(T)[None, :] < xl[:, None], x, -1)
+    ym = jnp.where(jnp.arange(S)[None, :] < yl[:, None], y, -2)
+
+    def one_masked(xr, yr, nx, ny):
+        # run dp on masked rows, but the dp above always consumes full T
+        # rows; pads (-1) mismatch everything, inflating the tail. To get
+        # the true distance, run dp where pad rows COPY the previous row
+        # (free skip): cost of x-pad = 0 insertion.
+        # initial row capped at ny (y pads are free skips)
+        row0 = jnp.where(jnp.arange(S + 1) <= ny,
+                         jnp.arange(S + 1, dtype=jnp.float32),
+                         ny.astype(jnp.float32))
+
+        def step(prev, i):
+            xi = xr[i]
+            is_pad = i >= nx
+
+            def inner(carry, j):
+                left, diag = carry
+                y_pad = j >= ny
+                up = prev[j + 1]
+                sub = diag + jnp.where(xi == yr[j], 0.0, 1.0)
+                val = jnp.minimum(jnp.minimum(left + 1.0, up + 1.0), sub)
+                val = jnp.where(y_pad, left, val)   # y pad: free copy
+                return (val, prev[j + 1]), val
+
+            first = jnp.where(is_pad, prev[0], prev[0] + 1.0)
+            (_, _), vals = jax.lax.scan(inner, (first, prev[0]),
+                                        jnp.arange(S))
+            new_row = jnp.concatenate([first[None], vals])
+            new_row = jnp.where(is_pad, prev, new_row)  # x pad: skip row
+            return new_row, None
+
+        final, _ = jax.lax.scan(step, row0, jnp.arange(T))
+        return final[ny]
+
+    dist = jax.vmap(one_masked)(xm, ym, xl, yl)
+    if normalized:
+        dist = dist / jnp.maximum(yl.astype(jnp.float32), 1.0)
+    return dist[:, None], jnp.asarray(B)
+
+
+def ctc_align(input, input_length=None, blank=0, padding_value=0):
+    """CTC greedy decode alignment (`ctc_align_op.cc`): collapse repeats,
+    drop blanks. Padded [B, T] int ids -> ([B, T] compacted ids padded
+    with padding_value, [B] output lengths)."""
+    x = jnp.asarray(input)
+    B, T = x.shape
+    n = jnp.full((B,), T) if input_length is None \
+        else jnp.asarray(input_length)
+    valid = jnp.arange(T)[None, :] < n[:, None]
+    prev = jnp.concatenate([jnp.full((B, 1), -1, x.dtype), x[:, :-1]],
+                           axis=1)
+    keep = valid & (x != blank) & (x != prev)
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    out = jnp.full_like(x, padding_value)
+    dst = jnp.where(keep, pos, T)
+    out = out.at[jnp.arange(B)[:, None], dst].set(
+        jnp.where(keep, x, padding_value), mode="drop")
+    return out, jnp.sum(keep.astype(jnp.int32), axis=1)

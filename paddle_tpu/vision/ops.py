@@ -743,17 +743,8 @@ def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
     top = min(pre_nms_top_n, s.shape[0])
     sc, order = jax.lax.top_k(s, top)
     d, a, v = d[order], a[order], v[order]
-    # decode (box_coder decode_center_size semantics)
-    aw = a[:, 2] - a[:, 0] + 1.0
-    ah = a[:, 3] - a[:, 1] + 1.0
-    acx = a[:, 0] + aw * 0.5
-    acy = a[:, 1] + ah * 0.5
-    cx = v[:, 0] * d[:, 0] * aw + acx
-    cy = v[:, 1] * d[:, 1] * ah + acy
-    bw = jnp.exp(jnp.minimum(v[:, 2] * d[:, 2], 10.0)) * aw
-    bh = jnp.exp(jnp.minimum(v[:, 3] * d[:, 3], 10.0)) * ah
-    boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
-                       cx + bw / 2, cy + bh / 2], -1)
+    # box_coder decode_center_size semantics (+1 box widths)
+    boxes = _decode_center_size(d, a, variances=v, plus_one=1.0)
     boxes = box_clip(boxes, im_shape)
     ww = boxes[:, 2] - boxes[:, 0] + 1.0
     hh = boxes[:, 3] - boxes[:, 1] + 1.0
@@ -767,3 +758,332 @@ def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
     rois = jnp.where(alive[:, None], boxes[sel], 0.0)
     roi_scores = jnp.where(alive, best, 0.0)
     return rois, roi_scores
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    """Route RoIs to FPN levels (`detection/distribute_fpn_proposals_op.cc`):
+    level = floor(refer_level + log2(sqrt(area)/refer_scale)), clipped to
+    [min_level, max_level]. XLA static-shape form: instead of variable-
+    length per-level lists, returns
+    (multi_rois: list of [N, 4] per level with non-members zeroed,
+     level_masks: list of [N] bool, restore_index [N] int32 = identity
+     composition order). Downstream roi_align consumes (rois, mask) —
+    masked rows pool to zeros and are dropped by the mask at gather-back.
+    """
+    rois = jnp.asarray(fpn_rois)
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    scale = jnp.sqrt(jnp.maximum(w * h, 1e-12))
+    lvl = jnp.floor(refer_level + jnp.log2(scale / refer_scale + 1e-12))
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    multi_rois, masks = [], []
+    for L in range(min_level, max_level + 1):
+        m = lvl == L
+        multi_rois.append(jnp.where(m[:, None], rois, 0.0))
+        masks.append(m)
+    restore = jnp.arange(rois.shape[0], dtype=jnp.int32)
+    return multi_rois, masks, restore
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, post_nms_top_n,
+                          name=None):
+    """Merge per-level proposals and keep the global top-N by score
+    (`detection/collect_fpn_proposals_op.cc`). Static-shape: inputs are
+    the fixed-capacity per-level tensors (masked rows score <= 0);
+    returns (rois [post_nms_top_n, 4], scores [post_nms_top_n])."""
+    rois = jnp.concatenate([jnp.asarray(r) for r in multi_rois], axis=0)
+    scores = jnp.concatenate([jnp.asarray(s).reshape(-1)
+                              for s in multi_scores], axis=0)
+    k = min(post_nms_top_n, scores.shape[0])
+    best, sel = jax.lax.top_k(scores, k)
+    alive = best > 0
+    return (jnp.where(alive[:, None], rois[sel], 0.0),
+            jnp.where(alive, best, 0.0))
+
+
+def rpn_target_assign(anchors, gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=False,
+                      seed=0):
+    """RPN anchor labeling (`detection/rpn_target_assign_op.cc`),
+    static-shape form: returns per-ANCHOR tensors instead of gathered
+    index lists — (labels [N] int32 1 fg / 0 bg / -1 ignore,
+    matched_gt [N] int32, max_iou [N]).
+
+    Rules (reference CalcRpnLabels): fg if IoU >= positive_overlap or if
+    the anchor is the argmax for some gt; bg if max IoU <
+    negative_overlap; else ignored. Subsampling to
+    rpn_batch_size_per_im keeps the highest-IoU fg and lowest-IoU bg
+    (the deterministic variant of the reference's random sampler)."""
+    a = jnp.asarray(anchors).reshape(-1, 4)
+    g = jnp.asarray(gt_boxes).reshape(-1, 4)
+    iou = iou_similarity(a, g)                           # [N, M]
+    if is_crowd is not None:
+        valid_gt = ~jnp.asarray(is_crowd, bool)
+        iou = jnp.where(valid_gt[None, :], iou, -1.0)
+    max_iou = jnp.max(iou, axis=1)
+    matched = jnp.argmax(iou, axis=1).astype(jnp.int32)
+    # anchors that are the best for some gt are fg regardless of IoU
+    best_per_gt = jnp.max(iou, axis=0)                   # [M]
+    is_best = jnp.any((iou >= best_per_gt[None, :] - 1e-6) &
+                      (best_per_gt[None, :] > 0), axis=1)
+    fg = (max_iou >= rpn_positive_overlap) | is_best
+    bg = (~fg) & (max_iou < rpn_negative_overlap)
+    labels = jnp.where(fg, 1, jnp.where(bg, 0, -1)).astype(jnp.int32)
+    # deterministic subsample: keep top-k fg by IoU, top-k bg by
+    # (1 - IoU); the rest flip to ignore
+    n = labels.shape[0]
+    num_fg = int(rpn_fg_fraction * rpn_batch_size_per_im)
+    fg_rank_scores = jnp.where(fg, max_iou, -1.0)
+    k_fg = min(num_fg, n)
+    fg_kth = jax.lax.top_k(fg_rank_scores, k_fg)[0][-1]
+    fg_keep = fg & (fg_rank_scores >= fg_kth)
+    num_bg = rpn_batch_size_per_im - num_fg
+    bg_rank = jnp.where(bg, 1.0 - max_iou, -1.0)
+    k_bg = min(num_bg, n)
+    bg_kth = jax.lax.top_k(bg_rank, k_bg)[0][-1]
+    bg_keep = bg & (bg_rank >= bg_kth)
+    labels = jnp.where(fg & ~fg_keep, -1, labels)
+    labels = jnp.where(bg & ~bg_keep, -1, labels)
+    return labels, matched, max_iou
+
+
+def mine_hard_examples(cls_loss, match_indices, neg_pos_ratio=3.0,
+                       mining_type="max_negative", neg_dist_threshold=None,
+                       sample_size=None):
+    """OHEM negative mining for SSD (`detection/mine_hard_examples_op.cc`,
+    max_negative mode): per row (batch), keep the
+    neg_pos_ratio * num_pos highest-loss negatives. Static-shape form:
+    returns a bool mask [B, P] of selected negatives (the reference's
+    NegIndices LoD list as a mask)."""
+    loss = jnp.asarray(cls_loss)
+    mi = jnp.asarray(match_indices)
+    is_neg = mi < 0
+    num_pos = jnp.sum((~is_neg).astype(jnp.int32), axis=1)  # [B]
+    limit = jnp.ceil(num_pos.astype(jnp.float32) * neg_pos_ratio) \
+        .astype(jnp.int32)
+    if sample_size is not None:
+        limit = jnp.minimum(limit, sample_size)
+    neg_loss = jnp.where(is_neg, loss, -jnp.inf)
+    order = jnp.argsort(-neg_loss, axis=1)
+    rank = jnp.zeros_like(mi).at[
+        jnp.arange(mi.shape[0])[:, None], order].set(
+        jnp.broadcast_to(jnp.arange(mi.shape[1]), mi.shape))
+    return is_neg & (rank < limit[:, None]) & jnp.isfinite(neg_loss)
+
+
+def locality_aware_nms(boxes, scores, iou_threshold=0.3,
+                       merge_threshold=None):
+    """EAST-style locality-aware NMS
+    (reference consumer: the EAST/OCR postprocess over
+    `multiclass_nms`): weighted-merge chains of overlapping boxes
+    (score-weighted coordinate average), then standard NMS. Static
+    shape: [N, 4]+[N] -> (merged boxes [N, 4], merged scores [N],
+    keep mask [N])."""
+    if merge_threshold is None:
+        merge_threshold = iou_threshold
+    b = jnp.asarray(boxes, jnp.float32)
+    s = jnp.asarray(scores, jnp.float32)
+    iou = iou_similarity(b, b)
+    near = (iou >= merge_threshold) & (s[None, :] > 0)
+    wsum = jnp.sum(jnp.where(near, s[None, :], 0.0), axis=1)
+    merged = jnp.einsum("nm,md->nd",
+                        jnp.where(near, s[None, :], 0.0), b) \
+        / jnp.maximum(wsum, 1e-10)[:, None]
+    # EAST merge accumulates chain scores: a chain of medium boxes can
+    # outrank one isolated high-score box
+    merged_scores = jnp.where(s > 0, wsum, 0.0)
+    keep = nms(merged, merged_scores,
+               iou_threshold=iou_threshold) & (s > 0)
+    return merged, merged_scores, keep
+
+
+def _decode_center_size(deltas, anchors, variances=None, plus_one=0.0,
+                        clamp=10.0):
+    """Variance-aware center-size delta decode shared by
+    generate_proposals / retinanet_detection_output (the functional core
+    of box_coder's decode_center_size for flat [N, 4] inputs)."""
+    a = anchors
+    d = deltas
+    aw = a[:, 2] - a[:, 0] + plus_one
+    ah = a[:, 3] - a[:, 1] + plus_one
+    acx = a[:, 0] + aw * 0.5
+    acy = a[:, 1] + ah * 0.5
+    v = jnp.ones((4,), d.dtype) if variances is None else variances
+    cx = v[..., 0] * d[:, 0] * aw + acx
+    cy = v[..., 1] * d[:, 1] * ah + acy
+    bw = jnp.exp(jnp.minimum(v[..., 2] * d[:, 2], clamp)) * aw
+    bh = jnp.exp(jnp.minimum(v[..., 3] * d[:, 3], clamp)) * ah
+    return jnp.stack([cx - bw / 2, cy - bh / 2,
+                      cx + bw / 2, cy + bh / 2], -1)
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info=None,
+                               score_threshold=0.05, nms_top_k=1000,
+                               nms_threshold=0.3, keep_top_k=100,
+                               nms_eta=1.0):
+    """RetinaNet decode + NMS (`detection/retinanet_detection_output_op.cc`),
+    single image, static shapes. bboxes/anchors: lists of [Ni, 4] per FPN
+    level (bboxes are center-size deltas vs their anchors); scores:
+    lists of [Ni, C] SIGMOID class scores. Returns
+    ([keep_top_k, 6] rows (class, score, x1, y1, x2, y2), num_kept) with
+    -1 padding — the fixed-capacity contract."""
+    ds = jnp.concatenate([jnp.asarray(b).reshape(-1, 4) for b in bboxes])
+    ss = jnp.concatenate([jnp.asarray(s) for s in scores])     # [N, C]
+    an = jnp.concatenate([jnp.asarray(a).reshape(-1, 4) for a in anchors])
+    # variance-free retinanet convention
+    boxes = _decode_center_size(ds, an)
+    if im_info is not None:
+        boxes = box_clip(boxes, jnp.asarray(im_info))
+    sc = jnp.where(ss > score_threshold, ss, 0.0)              # [N, C]
+    C = sc.shape[1]
+    top = min(nms_top_k, sc.shape[0])
+
+    def per_class(cls_scores):
+        s, order = jax.lax.top_k(cls_scores, top)
+        b = boxes[order]
+        keep = nms(b, s, iou_threshold=nms_threshold) & (s > 0)
+        return jnp.where(keep, s, 0.0), b
+
+    s_cls, b_cls = jax.vmap(per_class)(sc.T)                   # [C, top]
+    flat_s = s_cls.reshape(-1)
+    flat_b = b_cls.reshape(-1, 4)
+    flat_c = jnp.broadcast_to(jnp.arange(C)[:, None],
+                              (C, top)).reshape(-1)
+    k = min(keep_top_k, flat_s.shape[0])
+    best, sel = jax.lax.top_k(flat_s, k)
+    alive = best > 0
+    out = jnp.concatenate([
+        jnp.where(alive, flat_c[sel], -1).astype(jnp.float32)[:, None],
+        jnp.where(alive, best, -1.0)[:, None],
+        jnp.where(alive[:, None], flat_b[sel], -1.0)], axis=1)
+    return out, jnp.sum(alive.astype(jnp.int32))
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, gt_boxes,
+                             batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.5, bg_thresh_hi=0.5,
+                             bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=81, is_crowd=None):
+    """RCNN head sampling (`detection/generate_proposal_labels_op.cc`),
+    single image, static-shape deterministic variant: rois labeled by
+    max-IoU gt; fg = IoU >= fg_thresh (top fg_fraction*batch kept by
+    IoU), bg = IoU in [bg_thresh_lo, bg_thresh_hi) (lowest-IoU kept).
+    Returns (rois [B, 4], labels [B] int32 (class id, 0 = background,
+    -1 = pad), bbox_targets [B, 4] encoded vs the matched gt,
+    fg_mask [B] bool) with B = batch_size_per_im."""
+    rois = jnp.concatenate([jnp.asarray(rpn_rois).reshape(-1, 4),
+                            jnp.asarray(gt_boxes).reshape(-1, 4)])
+    g = jnp.asarray(gt_boxes).reshape(-1, 4)
+    gcls = jnp.asarray(gt_classes).reshape(-1)
+    iou = iou_similarity(rois, g)
+    if is_crowd is not None:
+        iou = jnp.where(~jnp.asarray(is_crowd, bool)[None, :], iou, -1.0)
+    max_iou = jnp.max(iou, axis=1)
+    matched = jnp.argmax(iou, axis=1)
+    fg = max_iou >= fg_thresh
+    bg = (max_iou < bg_thresh_hi) & (max_iou >= bg_thresh_lo)
+    B = batch_size_per_im
+    n_fg = int(fg_fraction * B)
+    n = rois.shape[0]
+    fg_rank = jnp.where(fg, max_iou, -1.0)
+    _, fg_sel = jax.lax.top_k(fg_rank, min(n_fg, n))
+    fg_ok = fg[fg_sel]
+    bg_rank = jnp.where(bg, 1.0 - max_iou, -1.0)
+    _, bg_sel = jax.lax.top_k(bg_rank, min(B - n_fg, n))
+    bg_ok = bg[bg_sel]
+    sel = jnp.concatenate([fg_sel, bg_sel])
+    ok = jnp.concatenate([fg_ok, bg_ok])
+    is_fg = jnp.concatenate([fg_ok, jnp.zeros_like(bg_ok)])
+    out_rois = jnp.where(ok[:, None], rois[sel], 0.0)
+    labels = jnp.where(is_fg, gcls[matched[sel]].astype(jnp.int32),
+                       jnp.where(ok, 0, -1).astype(jnp.int32))
+    # encode fg targets vs matched gt (encode_center_size w/ weights)
+    mg = g[matched[sel]]
+    rw = out_rois[:, 2] - out_rois[:, 0] + 1e-6
+    rh = out_rois[:, 3] - out_rois[:, 1] + 1e-6
+    rcx = out_rois[:, 0] + rw * 0.5
+    rcy = out_rois[:, 1] + rh * 0.5
+    gw = mg[:, 2] - mg[:, 0] + 1e-6
+    gh = mg[:, 3] - mg[:, 1] + 1e-6
+    gcx = mg[:, 0] + gw * 0.5
+    gcy = mg[:, 1] + gh * 0.5
+    wts = jnp.asarray(bbox_reg_weights, jnp.float32)
+    t = jnp.stack([(gcx - rcx) / rw / wts[0],
+                   (gcy - rcy) / rh / wts[1],
+                   jnp.log(gw / rw) / wts[2],
+                   jnp.log(gh / rh) / wts[3]], -1)
+    bbox_targets = jnp.where(is_fg[:, None], t, 0.0)
+    return out_rois, labels, bbox_targets, is_fg
+
+
+def psroi_pool(x, boxes, output_channels, spatial_scale, pooled_height,
+               pooled_width, boxes_num=None, name=None):
+    """Position-sensitive RoI pooling (`psroi_pool_op.cc`, R-FCN):
+    input [N, C, H, W] with C = output_channels * ph * pw; each output
+    bin (i, j) average-pools ITS OWN channel group over the bin's
+    spatial extent. boxes [R, 4] xyxy in image coords (batch 0 —
+    single-image static form). Returns [R, output_channels, ph, pw]."""
+    x = jnp.asarray(x)
+    b = jnp.asarray(boxes, jnp.float32) * spatial_scale
+    n, c, h, w = x.shape
+    ph, pw = pooled_height, pooled_width
+    assert c == output_channels * ph * pw, (c, output_channels, ph, pw)
+    feat = jnp.reshape(x[0], (output_channels, ph, pw, h, w))
+
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one(box):
+        x1, y1, x2, y2 = box[0], box[1], box[2], box[3]
+        bh = jnp.maximum(y2 - y1, 0.1) / ph
+        bw = jnp.maximum(x2 - x1, 0.1) / pw
+        i = jnp.arange(ph, dtype=jnp.float32)[:, None]       # bin row
+        j = jnp.arange(pw, dtype=jnp.float32)[None, :]
+        y_lo = jnp.floor(y1 + i * bh)
+        y_hi = jnp.ceil(y1 + (i + 1) * bh)
+        x_lo = jnp.floor(x1 + j * bw)
+        x_hi = jnp.ceil(x1 + (j + 1) * bw)
+        in_y = (ys[None, None, :] >= y_lo[..., None]) & \
+               (ys[None, None, :] < y_hi[..., None])         # [ph,pw,h]
+        in_x = (xs[None, None, :] >= x_lo[..., None]) & \
+               (xs[None, None, :] < x_hi[..., None])         # [ph,pw,w]
+        m = in_y[..., :, None] & in_x[..., None, :]          # [ph,pw,h,w]
+        mf = m.astype(x.dtype)
+        s = jnp.einsum("cijhw,ijhw->cij", feat, mf)
+        cnt = jnp.maximum(jnp.sum(mf, axis=(-1, -2)), 1.0)
+        return s / cnt[None]
+
+    return jax.vmap(one)(b)
+
+
+def correlation(x, y, pad_size, kernel_size, max_displacement, stride1,
+                stride2, corr_type_multiply=1):
+    """Correlation volume (`correlation_op.cc`, FlowNet): for each
+    displacement (dy, dx) in a (2d+1)^2 grid, mean over channels of
+    x · shift(y). Static form for the kernel_size=1 / stride1=1 config
+    (the FlowNet paper setting); other configs are rejected, not
+    silently approximated. Returns [N, (2d+1)^2, H, W] with
+    d = max_displacement // stride2."""
+    if kernel_size != 1 or stride1 != 1 or corr_type_multiply != 1:
+        raise NotImplementedError(
+            "correlation: only kernel_size=1, stride1=1, "
+            "corr_type_multiply=1 is implemented")
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    n, c, h, w = x.shape
+    d = max_displacement // stride2
+    outs = []
+    for dy in range(-d, d + 1):
+        for dx in range(-d, d + 1):
+            # ys_[i, j] = y[i + dy*s, j + dx*s]; rows/cols that wrapped
+            # around are invalid: valid i satisfies 0 <= i + dy*s < h
+            ys_ = jnp.roll(y, (-dy * stride2, -dx * stride2), axis=(2, 3))
+            valid = jnp.zeros((h, w), x.dtype).at[
+                max(0, -dy * stride2):h + min(0, -dy * stride2),
+                max(0, -dx * stride2):w + min(0, -dx * stride2)].set(1.0)
+            outs.append(jnp.mean(x * ys_, axis=1) * valid[None])
+    return jnp.stack(outs, axis=1)

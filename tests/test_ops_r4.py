@@ -174,3 +174,180 @@ class TestSequenceTranche:
         _gradcheck(lambda a: jnp.sum(
             S.sequence_conv(a, w, context_length=3) ** 2), x,
             rtol=7e-2, atol=2e-3)
+
+
+class TestDetectionTranche2:
+    def test_distribute_and_collect_fpn(self):
+        rois = jnp.asarray([[0., 0., 10., 10.],      # small -> low level
+                            [0., 0., 300., 300.]])   # big -> high level
+        multi, masks, restore = V.distribute_fpn_proposals(
+            rois, min_level=2, max_level=5, refer_level=4,
+            refer_scale=224)
+        assert len(multi) == 4 and len(masks) == 4
+        lvl_of = [int(np.argmax([bool(m[i]) for m in masks]))
+                  for i in range(2)]
+        assert lvl_of[0] < lvl_of[1]          # smaller box -> lower level
+        assert restore.tolist() == [0, 1]
+        # collect: global top-k by score
+        scores = [jnp.where(m, jnp.asarray([0.5, 0.9]), 0.0)
+                  for m in masks]
+        out_rois, out_scores = V.collect_fpn_proposals(multi, scores, 2)
+        assert abs(float(out_scores[0]) - 0.9) < 1e-6
+
+    def test_rpn_target_assign_rules(self):
+        anchors = jnp.asarray([[0., 0., 10., 10.],
+                               [100., 100., 110., 110.],
+                               [1., 1., 11., 11.]])
+        gt = jnp.asarray([[0., 0., 10., 10.]])
+        labels, matched, miou = V.rpn_target_assign(
+            anchors, gt, rpn_positive_overlap=0.7,
+            rpn_negative_overlap=0.3, rpn_batch_size_per_im=4)
+        got = labels.tolist()
+        assert got[0] == 1          # IoU 1.0 -> fg
+        assert got[1] == 0          # IoU 0 -> bg
+        assert matched.tolist()[0] == 0
+
+    def test_mine_hard_examples_ratio(self):
+        loss = jnp.asarray([[5., 4., 3., 2., 1., 0.5]])
+        match = jnp.asarray([[0, -1, -1, -1, -1, -1]])  # 1 pos, 5 neg
+        sel = V.mine_hard_examples(loss, match, neg_pos_ratio=3.0)
+        # 3 highest-loss negatives selected
+        assert sel.tolist() == [[False, True, True, True, False, False]]
+
+    def test_locality_aware_nms_merges(self):
+        b = jnp.asarray([[0., 0., 10., 10.], [0., 0., 10.2, 10.],
+                         [50., 50., 60., 60.]])
+        s = jnp.asarray([0.6, 0.6, 0.9])
+        merged, scores, keep = V.locality_aware_nms(b, s,
+                                                    iou_threshold=0.3)
+        # the two overlapping boxes merge toward their weighted mean
+        assert abs(float(merged[0, 2]) - 10.1) < 1e-5
+        assert bool(keep[2])
+
+    def test_retinanet_detection_output(self):
+        anchors = [jnp.asarray([[0., 0., 10., 10.],
+                                [40., 40., 60., 60.]])]
+        deltas = [jnp.zeros((2, 4))]
+        scores = [jnp.asarray([[0.9, 0.01], [0.02, 0.7]])]
+        out, n = V.retinanet_detection_output(
+            deltas, scores, anchors, im_info=jnp.asarray([100., 100., 1.]),
+            keep_top_k=4)
+        got = np.asarray(out)
+        assert int(n) == 2
+        assert got[0][0] == 0 and abs(got[0][1] - 0.9) < 1e-6
+        assert got[1][0] == 1 and abs(got[1][1] - 0.7) < 1e-6
+        np.testing.assert_allclose(got[0][2:], [0, 0, 10, 10], atol=1e-4)
+
+    def test_generate_proposal_labels(self):
+        rois = jnp.asarray([[0., 0., 10., 10.],     # IoU 1 with gt0 -> fg
+                            [100., 100., 110., 110.]])  # IoU 0 -> bg
+        gt = jnp.asarray([[0., 0., 10., 10.]])
+        cls = jnp.asarray([7])
+        out_rois, labels, targets, fg = V.generate_proposal_labels(
+            rois, cls, gt, batch_size_per_im=4, fg_fraction=0.5,
+            fg_thresh=0.5)
+        got = labels.tolist()
+        assert 7 in got         # the fg roi carries its gt class
+        assert 0 in got         # the far roi is background
+        # fg rows encode ~zero offsets vs their own gt
+        k = got.index(7)
+        np.testing.assert_allclose(np.asarray(targets[k]), 0.0, atol=1e-3)
+
+
+class TestOpLongTail:
+    def test_edit_distance_matches_python(self):
+        import paddle_tpu.tensor.sequence as S
+
+        def ed(a, b):
+            dp = np.zeros((len(a) + 1, len(b) + 1))
+            dp[:, 0] = np.arange(len(a) + 1)
+            dp[0, :] = np.arange(len(b) + 1)
+            for i in range(1, len(a) + 1):
+                for j in range(1, len(b) + 1):
+                    dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1,
+                                   dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+            return dp[-1, -1]
+
+        rs = np.random.RandomState(0)
+        for _ in range(4):
+            na, nb = rs.randint(1, 6), rs.randint(1, 7)
+            a = rs.randint(1, 5, (na,))
+            b = rs.randint(1, 5, (nb,))
+            A = np.zeros((1, 8), np.int32)
+            A[0, :na] = a
+            B = np.zeros((1, 9), np.int32)
+            B[0, :nb] = b
+            d, _ = S.edit_distance(jnp.asarray(A), jnp.asarray(B),
+                                   jnp.asarray([na]), jnp.asarray([nb]),
+                                   normalized=False)
+            assert abs(float(d[0, 0]) - ed(list(a), list(b))) < 1e-5
+
+    def test_ctc_align(self):
+        import paddle_tpu.tensor.sequence as S
+        out, n = S.ctc_align(jnp.asarray([[0, 1, 1, 0, 2, 2, 3, 0]]),
+                             blank=0)
+        assert out[0, :3].tolist() == [1, 2, 3] and int(n[0]) == 3
+
+    def test_shuffle_channel(self):
+        import paddle_tpu.nn.functional as F
+        x = jnp.arange(8.0).reshape(1, 8, 1, 1)
+        out = F.shuffle_channel(x, group=2)
+        assert out.reshape(-1).tolist() == [0, 4, 1, 5, 2, 6, 3, 7]
+
+    def test_fsp_matrix(self):
+        import paddle_tpu.nn.functional as F
+        a = jnp.asarray(np.random.RandomState(0).randn(2, 3, 4, 4),
+                        jnp.float32)
+        b = jnp.asarray(np.random.RandomState(1).randn(2, 5, 4, 4),
+                        jnp.float32)
+        want = np.einsum("nahw,nbhw->nab", np.asarray(a),
+                         np.asarray(b)) / 16.0
+        np.testing.assert_allclose(np.asarray(F.fsp_matrix(a, b)), want,
+                                   rtol=1e-5)
+
+    def test_psroi_pool_position_sensitive(self):
+        """Each output bin pools its OWN channel group."""
+        ph = pw = 2
+        oc = 1
+        x = np.zeros((1, oc * ph * pw, 4, 4), np.float32)
+        # channel k responds only in bin k; fill distinct constants
+        for k in range(ph * pw):
+            x[0, k] = k + 1
+        o = V.psroi_pool(jnp.asarray(x), jnp.asarray([[0., 0., 4., 4.]]),
+                         output_channels=oc, spatial_scale=1.0,
+                         pooled_height=ph, pooled_width=pw)
+        np.testing.assert_allclose(np.asarray(o[0, 0]),
+                                   [[1, 2], [3, 4]], atol=1e-6)
+
+    def test_correlation_center(self):
+        x = jnp.asarray(np.random.RandomState(2).randn(1, 4, 6, 6),
+                        jnp.float32)
+        y = jnp.asarray(np.random.RandomState(3).randn(1, 4, 6, 6),
+                        jnp.float32)
+        c = V.correlation(x, y, pad_size=1, kernel_size=1,
+                          max_displacement=1, stride1=1, stride2=1)
+        assert c.shape == (1, 9, 6, 6)
+        np.testing.assert_allclose(
+            np.asarray(c[0, 4]),
+            np.mean(np.asarray(x[0]) * np.asarray(y[0]), 0), rtol=1e-5)
+
+    def test_correlation_edge_invalidated(self):
+        """Displacement channels zero the wrapped-around edge, not the
+        valid one (dy=+1: valid target rows are [0, h-2])."""
+        x = jnp.ones((1, 1, 4, 4))
+        y = jnp.ones((1, 1, 4, 4))
+        c = V.correlation(x, y, pad_size=1, kernel_size=1,
+                          max_displacement=1, stride1=1, stride2=1)
+        ch = np.asarray(c[0, 7])      # (dy=+1, dx=0)
+        assert ch[:3].min() == 1.0 and ch[3].max() == 0.0, ch
+        with pytest.raises(NotImplementedError):
+            V.correlation(x, y, 1, 3, 1, 1, 1)
+
+    def test_locality_aware_nms_accumulates_scores(self):
+        b = jnp.asarray([[0., 0., 10., 10.], [0., 0., 10.2, 10.],
+                         [50., 50., 60., 60.]])
+        s = jnp.asarray([0.6, 0.6, 0.9])
+        merged, scores, keep = V.locality_aware_nms(b, s,
+                                                    iou_threshold=0.3)
+        # the merged chain outranks the isolated higher-score box
+        assert float(scores[0]) > float(scores[2])
