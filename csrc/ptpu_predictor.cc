@@ -88,8 +88,8 @@ struct Reader {
 
 // ----------------------------------------------------------------- tensors
 // ONNX TensorProto dtype codes (subset)
-enum { DT_F32 = 1, DT_U8 = 2, DT_I32 = 6, DT_I64 = 7, DT_BOOL = 9,
-       DT_F64 = 11 };
+enum { DT_F32 = 1, DT_U8 = 2, DT_I8 = 3, DT_I32 = 6, DT_I64 = 7,
+       DT_BOOL = 9, DT_F64 = 11 };
 
 struct Tensor {
   std::vector<int64_t> dims;
@@ -165,6 +165,10 @@ Tensor parse_tensor(Reader r) {
   } else if (t.dtype == DT_BOOL || t.dtype == DT_U8) {
     t.i.resize(size_t(n));
     const uint8_t* d = (const uint8_t*)raw.data();
+    for (int64_t k = 0; k < n; ++k) t.i[size_t(k)] = d[k];
+  } else if (t.dtype == DT_I8) {
+    t.i.resize(size_t(n));
+    const int8_t* d = (const int8_t*)raw.data();
     for (int64_t k = 0; k < n; ++k) t.i[size_t(k)] = d[k];
   } else {
     throw std::runtime_error("initializer dtype " +
@@ -465,8 +469,13 @@ void Predictor::run_node(const Node& n) {
     o.dtype = int(attr_i(n, "to", DT_F32));
     if (o.dtype == DT_F64) o.dtype = DT_F32;
     o.alloc();
-    for (int64_t k = 0; k < o.numel(); ++k)
-      o.set(k, o.dtype == DT_BOOL ? (a.at(k) != 0) : a.at(k));
+    for (int64_t k = 0; k < o.numel(); ++k) {
+      double v = a.at(k);
+      if (o.dtype == DT_BOOL) v = (v != 0);
+      else if (o.dtype == DT_I8)   // wrap like a C int8_t conversion
+        v = double(int8_t(int64_t(v)));
+      o.set(k, v);
+    }
     out(std::move(o));
   } else if (op == "Reshape") {
     const Tensor& a = in(n, 0);
@@ -670,10 +679,8 @@ void Predictor::run_node(const Node& n) {
                 for (int64_t kw = 0; kw < KW; ++kw) {
                   int64_t iw = ow * strides[1] - pads[1] + kw * dil[1];
                   if (iw < 0 || iw >= W) continue;
-                  acc += x.f[size_t(((nn * C + g0 + ic) * H + ih) * W +
-                                    iw)] *
-                         w.f[size_t(((oc * ICG + ic) * KH + kh) * KW +
-                                    kw)];
+                  acc += x.at(((nn * C + g0 + ic) * H + ih) * W + iw) *
+                         w.at(((oc * ICG + ic) * KH + kh) * KW + kw);
                 }
               }
             o.f[size_t(((nn * OC + oc) * OH + oh) * OW + ow)] = float(acc);
@@ -708,8 +715,7 @@ void Predictor::run_node(const Node& n) {
                 int64_t ih = oh * strides[0] - pads[0] + kh;
                 int64_t iw = ow * strides[1] - pads[1] + kw;
                 if (ih < 0 || ih >= H || iw < 0 || iw >= W) continue;
-                double v =
-                    x.f[size_t(((nn * C + c) * H + ih) * W + iw)];
+                double v = x.at(((nn * C + c) * H + ih) * W + iw);
                 best = std::max(best, v);
                 sum += v;
                 ++cnt;

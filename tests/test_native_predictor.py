@@ -149,3 +149,24 @@ class TestNativePredictor:
         got = np.asarray([float(v) for v in
                           r.stdout.split("values:")[1].split()])
         np.testing.assert_allclose(got, want[:8], rtol=1e-4, atol=1e-5)
+
+    def test_int8_artifact_serves_natively(self, lib, tmp_path):
+        """The int8-EXECUTING export (convert_to_int8) round-trips
+        through the C predictor — native int8 serving."""
+        import paddle_tpu as pt
+        from paddle_tpu.onnx.converter import trace_to_onnx
+        from paddle_tpu.quantization import QAT, convert_to_int8
+
+        pt.seed(0)
+        net = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.ReLU(),
+                               pt.nn.Linear(16, 4))
+        QAT().quantize(net)
+        x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        net.train()
+        net(jnp.asarray(x))          # one observer pass
+        net.eval()
+        convert_to_int8(net)
+        want = np.asarray(net(jnp.asarray(x)))
+        model_bytes = trace_to_onnx(lambda a: net(a), (jnp.asarray(x),))
+        got = _run_native(lib, model_bytes, x, tmp_path)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
