@@ -151,3 +151,163 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
     _, topk = jax.lax.top_k(input, k)
     hit = jnp.any(topk == label[:, None], axis=1)
     return jnp.mean(hit.astype(jnp.float32))
+
+
+def mean_iou(input, label, num_classes):
+    """Reference: `mean_iou_op.cc` (segmentation): per-class IoU from
+    the confusion counts; returns (mean_iou scalar, out_wrong [C],
+    out_correct [C])."""
+    import jax.numpy as jnp
+    pred = jnp.asarray(input).reshape(-1)
+    lab = jnp.asarray(label).reshape(-1)
+    correct = jnp.zeros((num_classes,), jnp.int32).at[
+        jnp.where(pred == lab, lab, num_classes)].add(1, mode="drop")
+    pred_cnt = jnp.zeros((num_classes,), jnp.int32).at[pred].add(
+        1, mode="drop")
+    lab_cnt = jnp.zeros((num_classes,), jnp.int32).at[lab].add(
+        1, mode="drop")
+    union = pred_cnt + lab_cnt - correct
+    present = union > 0
+    iou = jnp.where(present, correct / jnp.maximum(union, 1), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(
+        jnp.sum(present.astype(jnp.float32)), 1.0)
+    wrong = pred_cnt - correct
+    return miou, wrong, correct
+
+
+def chunk_eval(input, label, chunk_scheme="IOB", num_chunk_types=None,
+               excluded_chunk_types=None, seq_length=None):
+    """Reference: `chunk_eval_op.cc` (NER F1): decode chunks from
+    IOB/IOE/IOBES tag sequences and count precision/recall/F1. Eager
+    (host) like the reference's CPU-only kernel. input/label: [B, T]
+    int tag ids; returns (precision, recall, f1, num_infer, num_label,
+    num_correct)."""
+    import numpy as np
+
+    if num_chunk_types is None:
+        raise ValueError("chunk_eval needs num_chunk_types (the O tag id "
+                         "is num_chunk_types * tags_per_type)")
+
+    def decode(row, n):
+        chunks = []
+        start, ctype = None, None
+        for t in range(int(n)):
+            tag = int(row[t])
+            if chunk_scheme == "IOB":
+                is_o = (num_chunk_types is not None and
+                        tag == num_chunk_types * 2) or tag < 0
+                if is_o:
+                    if start is not None:
+                        chunks.append((start, t - 1, ctype))
+                        start = None
+                    continue
+                ty, pos = tag // 2, tag % 2          # pos 0 = B, 1 = I
+                if pos == 0 or ctype != ty:
+                    if start is not None:
+                        chunks.append((start, t - 1, ctype))
+                    start, ctype = t, ty
+            else:
+                raise NotImplementedError(chunk_scheme)
+        if start is not None:
+            chunks.append((start, int(n) - 1, ctype))
+        if excluded_chunk_types:
+            chunks = [c for c in chunks
+                      if c[2] not in set(excluded_chunk_types)]
+        return set(chunks)
+
+    pred = np.asarray(input)
+    lab = np.asarray(label)
+    B, T = pred.shape
+    lens = np.full((B,), T) if seq_length is None else np.asarray(
+        seq_length)
+    n_inf = n_lab = n_cor = 0
+    for i in range(B):
+        pi = decode(pred[i], lens[i])
+        li = decode(lab[i], lens[i])
+        n_inf += len(pi)
+        n_lab += len(li)
+        n_cor += len(pi & li)
+    precision = n_cor / n_inf if n_inf else 0.0
+    recall = n_cor / n_lab if n_lab else 0.0
+    f1 = (2 * precision * recall / (precision + recall)
+          if precision + recall else 0.0)
+    return precision, recall, f1, n_inf, n_lab, n_cor
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_version="integral"):
+    """Reference: `detection_map_op.cc` — mean average precision over
+    one image set. Eager host computation (an eval metric).
+    detect_res: [M, 6] rows (class, score, x1, y1, x2, y2);
+    label: [N, 6] rows (class, x1, y1, x2, y2, difficult) or [N, 5]
+    without the difficult flag. Returns the mAP scalar."""
+    import numpy as np
+
+    det = np.asarray(detect_res, np.float64)
+    gt = np.asarray(label, np.float64)
+    has_diff = gt.shape[1] >= 6
+    aps = []
+    for c in range(class_num):
+        if c == background_label:
+            continue
+        g = gt[gt[:, 0] == c]
+        difficult = g[:, 5].astype(bool) if has_diff else \
+            np.zeros(len(g), bool)
+        if not evaluate_difficult:
+            n_pos = int((~difficult).sum())
+        else:
+            n_pos = len(g)
+        d = det[det[:, 0] == c]
+        if n_pos == 0:
+            # VOC/reference convention: classes absent from the ground
+            # truth are skipped, not averaged in as 0
+            continue
+        d = d[np.argsort(-d[:, 1])]
+        used = np.zeros(len(g), bool)
+        tp = np.zeros(len(d))
+        fp = np.zeros(len(d))
+        for k, row in enumerate(d):
+            best, best_j = 0.0, -1
+            for j, grow in enumerate(g):
+                x1 = max(row[2], grow[1])
+                y1 = max(row[3], grow[2])
+                x2 = min(row[4], grow[3])
+                y2 = min(row[5], grow[4])
+                iw, ih = max(0.0, x2 - x1), max(0.0, y2 - y1)
+                inter = iw * ih
+                if inter <= 0:
+                    continue
+                ua = ((row[4] - row[2]) * (row[5] - row[3]) +
+                      (grow[3] - grow[1]) * (grow[4] - grow[2]) - inter)
+                iou = inter / ua
+                if iou > best:
+                    best, best_j = iou, j
+            if best >= overlap_threshold and best_j >= 0:
+                if not evaluate_difficult and difficult[best_j]:
+                    continue
+                if not used[best_j]:
+                    tp[k] = 1
+                    used[best_j] = True
+                else:
+                    fp[k] = 1
+            else:
+                fp[k] = 1
+        ctp = np.cumsum(tp)
+        cfp = np.cumsum(fp)
+        rec = ctp / n_pos
+        prec = ctp / np.maximum(ctp + cfp, 1e-12)
+        if ap_version == "11point":
+            ap = float(np.mean([prec[rec >= t].max() if (rec >= t).any()
+                                else 0.0
+                                for t in np.linspace(0, 1, 11)]))
+        else:
+            # integral / VOC-style accumulation
+            mrec = np.concatenate([[0.0], rec, [1.0]])
+            mpre = np.concatenate([[0.0], prec, [0.0]])
+            for i in range(len(mpre) - 2, -1, -1):
+                mpre[i] = max(mpre[i], mpre[i + 1])
+            idx = np.where(mrec[1:] != mrec[:-1])[0]
+            ap = float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+        aps.append(ap)
+    return float(np.mean(aps)) if aps else 0.0

@@ -308,3 +308,111 @@ def fsp_matrix(x, y):
     xf = jnp.reshape(x, (n, c1, h * w))
     yf = jnp.reshape(y, (n, c2, h * w))
     return jnp.einsum("nab,ncb->nac", xf, yf) / float(h * w)
+
+
+def affine_channel(x, scale, bias, data_format="NCHW"):
+    """Reference: `affine_channel_op.cc` — per-channel x*scale + bias
+    (the frozen-BN form used by detection backbones)."""
+    s = jnp.reshape(jnp.asarray(scale), (1, -1, 1, 1)
+                    if data_format == "NCHW" else (1, 1, 1, -1))
+    b = jnp.reshape(jnp.asarray(bias), s.shape)
+    return x * s + b
+
+
+def add_position_encoding(x, alpha=1.0, beta=1.0):
+    """Reference: `add_position_encoding_op.cc` — alpha*x + beta*PE with
+    the sin/cos transformer table; x [B, T, D]."""
+    b, t, d = x.shape
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    i = jnp.arange(d // 2, dtype=jnp.float32)[None, :]
+    angle = pos / jnp.power(10000.0, 2.0 * i / d)
+    pe = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=1)
+    return alpha * x + beta * pe[None].astype(x.dtype)
+
+
+def im2sequence(x, filter_size=1, stride=1, padding=0):
+    """Reference: `im2sequence_op.cc` (OCR): sliding patches flattened
+    to a sequence — [N, C, H, W] -> [N, oh*ow, C*fh*fw]."""
+    fh, fw = _pair(filter_size)
+    cols = unfold(x, (fh, fw), strides=_pair(stride),
+                  paddings=_pair(padding))           # [N, C*fh*fw, L]
+    return jnp.swapaxes(cols, 1, 2)
+
+
+def similarity_focus(x, axis, indexes):
+    """Reference: `similarity_focus_op.cc` — build a 0/1 focus mask via
+    GREEDY cell selection on the chosen channel plane: repeatedly take
+    the largest remaining cell whose row AND column are both unused
+    (each row/column holds at most one selected cell, min(H, W) picks);
+    selected cells light up across all channels."""
+    if axis != 1:
+        raise NotImplementedError("similarity_focus: axis != 1")
+    n, c, h, w = x.shape
+    mask = jnp.zeros((n, h, w), jnp.bool_)
+    for idx in indexes:
+        plane = x[:, idx]                           # [N, H, W]
+
+        def pick(carry, _):
+            m, row_used, col_used = carry
+            avail = (~row_used[:, :, None]) & (~col_used[:, None, :])
+            neg = jnp.where(avail, plane, -jnp.inf)
+            flat = jnp.argmax(neg.reshape(n, -1), axis=1)
+            r, col = flat // w, flat % w
+            m = m.at[jnp.arange(n), r, col].set(True)
+            row_used = row_used.at[jnp.arange(n), r].set(True)
+            col_used = col_used.at[jnp.arange(n), col].set(True)
+            return (m, row_used, col_used), None
+
+        (m, _, _), _ = jax.lax.scan(
+            pick, (jnp.zeros((n, h, w), jnp.bool_),
+                   jnp.zeros((n, h), jnp.bool_),
+                   jnp.zeros((n, w), jnp.bool_)),
+            None, length=min(h, w))
+        mask = mask | m
+    return jnp.broadcast_to(mask[:, None], x.shape).astype(x.dtype)
+
+
+def conv_shift(x, y):
+    """Reference: `conv_shift_op.cc` — circular correlation of each row
+    of x [B, M] with the kernel row y [B, N] (N odd, N <= M)."""
+    B, M = x.shape
+    N = y.shape[1]
+    half = N // 2
+    outs = []
+    for k in range(N):
+        outs.append(jnp.roll(x, half - k, axis=1) * y[:, k:k + 1])
+    return sum(outs)
+
+
+def spp(x, pyramid_height=3, pool_type="max"):
+    """Reference: `spp_op.cc` (spatial pyramid pooling): concat of
+    1x1, 2x2, ... 2^(h-1) bin poolings -> [N, C*sum(4^l)]. Arbitrary
+    H/W: bins use ceil/floor boundaries (the SPP-net kernel-size
+    formula), realized as masked reductions."""
+    n, c, h, w = x.shape
+    ys = jnp.arange(h)
+    xs = jnp.arange(w)
+    outs = []
+    for level in range(pyramid_height):
+        bins = 2 ** level
+        i = jnp.arange(bins)
+        y_lo = jnp.floor(i * h / bins).astype(jnp.int32)
+        y_hi = jnp.ceil((i + 1) * h / bins).astype(jnp.int32)
+        x_lo = jnp.floor(i * w / bins).astype(jnp.int32)
+        x_hi = jnp.ceil((i + 1) * w / bins).astype(jnp.int32)
+        in_y = (ys[None, :] >= y_lo[:, None]) & \
+               (ys[None, :] < y_hi[:, None])          # [bins, h]
+        in_x = (xs[None, :] >= x_lo[:, None]) & \
+               (xs[None, :] < x_hi[:, None])          # [bins, w]
+        m = in_y[:, None, :, None] & in_x[None, :, None, :]  # [bi,bj,h,w]
+        if pool_type == "max":
+            masked = jnp.where(m[None, None], x[:, :, None, None],
+                               -jnp.inf)
+            pooled = jnp.max(masked, axis=(-1, -2))   # [N, C, bi, bj]
+        else:
+            mf = m.astype(x.dtype)
+            s = jnp.einsum("nchw,ijhw->ncij", x, mf)
+            pooled = s / jnp.maximum(
+                jnp.sum(mf, axis=(-1, -2)), 1.0)[None, None]
+        outs.append(jnp.reshape(pooled, (n, -1)))
+    return jnp.concatenate(outs, axis=1)

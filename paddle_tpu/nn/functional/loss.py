@@ -277,3 +277,88 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
     l2 = jnp.sum(anchor * anchor) / anchor.shape[0] \
         + jnp.sum(positive * positive) / positive.shape[0]
     return ce + l2_reg * l2 * 0.25
+
+
+def huber_loss(input, label, delta=1.0):
+    """Reference: `huber_loss_op.cc` — quadratic within |r| <= delta,
+    linear outside (NO mean reduction, elementwise like the ref)."""
+    r = jnp.asarray(label) - jnp.asarray(input)
+    a = jnp.abs(r)
+    return jnp.where(a <= delta, 0.5 * r * r,
+                     delta * (a - 0.5 * delta))
+
+
+def hinge_loss(input, label):
+    """Reference: `hinge_loss_op.cc` — max(1 - pred*sign, 0) with
+    label in {0, 1} mapped to {-1, +1}."""
+    sign = 2.0 * jnp.asarray(label, jnp.float32) - 1.0
+    return jnp.maximum(1.0 - jnp.asarray(input) * sign, 0.0)
+
+
+def rank_loss(label, left, right):
+    """Reference: `rank_loss_op.cc` (RankNet pairwise):
+    C = log(1 + exp(o)) - label*o with o = left - right."""
+    o = jnp.asarray(left) - jnp.asarray(right)
+    return jnp.log1p(jnp.exp(-jnp.abs(o))) + jnp.maximum(o, 0.0) \
+        - jnp.asarray(label) * o
+
+
+def bpr_loss(input, label):
+    """Reference: `bpr_loss_op.cc` (Bayesian personalized ranking):
+    mean over negatives of -log(sigmoid(score_pos - score_neg));
+    input [N, C] scores, label [N] or [N, 1] positive index."""
+    x = jnp.asarray(input)
+    y = jnp.asarray(label).reshape(-1)
+    pos = jnp.take_along_axis(x, y[:, None], axis=1)
+    diff = pos - x                                  # [N, C]
+    neg_mask = jax.nn.one_hot(y, x.shape[1]) == 0
+    ll = jax.nn.log_sigmoid(diff)
+    return -(jnp.sum(ll * neg_mask, axis=1, keepdims=True)
+             / jnp.maximum(jnp.sum(neg_mask, axis=1, keepdims=True), 1))
+
+
+def center_loss(input, label, centers, alpha=0.1, update_center=True):
+    """Reference: `center_loss_op.cc` (face-rec auxiliary loss):
+    0.5*||x - c_y||^2 per sample; returns (loss [N, 1], new_centers)
+    where centers move toward their class means at rate alpha."""
+    x = jnp.asarray(input)
+    y = jnp.asarray(label).reshape(-1)
+    c = jnp.asarray(centers)
+    cy = c[y]
+    diff = x - cy
+    loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+    if update_center:
+        num = jnp.zeros((c.shape[0],), x.dtype).at[y].add(1.0)
+        upd = jnp.zeros_like(c).at[y].add(diff)
+        c = c + alpha * upd / (num[:, None] + 1.0)
+    return loss, c
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """Reference: `teacher_student_sigmoid_loss_op.h` (CTR
+    distillation). Label encodes click z AND teacher score z':
+    -2 -> no teacher, no click; -1 -> no teacher, click;
+    [0, 1) -> teacher z'=label, no click; [1, 2] -> teacher
+    z'=label-1, click. Each present part contributes the sigmoid
+    log-loss max(x,0) - x*target + log(1+exp(-|x|))."""
+    x = jnp.clip(jnp.asarray(input, jnp.float32), soft_max_lower_bound,
+                 soft_max_up_bound)
+    y = jnp.asarray(label, jnp.float32)
+    sp = jnp.log1p(jnp.exp(-jnp.abs(x)))          # log(1+exp(-|x|))
+    mx = jnp.maximum(x, 0.0)
+    part = lambda target: mx - x * target + sp    # noqa: E731
+    return jnp.where(
+        y < -1.0, part(0.0),
+        jnp.where(y < 0.0, part(1.0),
+                  jnp.where(y < 1.0, part(0.0) + part(y),
+                            part(1.0) + part(y - 1.0))))
+
+
+def modified_huber_loss(input, label):
+    """Reference: `modified_huber_loss_op.cc`: label {0,1} -> {-1,+1};
+    z = pred*sign; piecewise (1-z)^2 clipped / -4z."""
+    sign = 2.0 * jnp.asarray(label, jnp.float32) - 1.0
+    z = jnp.asarray(input) * sign
+    return jnp.where(z >= -1.0, jnp.square(jnp.maximum(1.0 - z, 0.0)),
+                     -4.0 * z)

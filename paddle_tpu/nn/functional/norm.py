@@ -142,3 +142,10 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
     summed = jax.lax.reduce_window(sq, 0.0, jax.lax.add, tuple(window),
                                    (1,) * x.ndim, pads)
     return x / jnp.power(k + alpha * summed, beta)
+
+
+def lrn(x, n=5, k=1.0, alpha=1e-4, beta=0.75, data_format="NCHW"):
+    """Reference: `lrn_op.cc` — the classic AlexNet local response norm
+    (alias of local_response_norm with the 1.x argument names)."""
+    return local_response_norm(x, size=n, alpha=alpha, beta=beta, k=k,
+                               data_format=data_format)

@@ -219,3 +219,28 @@ def adaptive_max_pool3d(x, output_size, return_mask=False,
     assert d % od == 0 and h % oh == 0 and w % ow == 0
     return jnp.max(jnp.reshape(
         x, (n, od, d // od, oh, h // oh, ow, w // ow, c)), axis=(2, 4, 6))
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """Reference: `unpool_op.cc` — inverse of max_pool2d with
+    return_mask: scatter each pooled value back to its argmax position
+    (indices are global h*w positions, the max_pool_with_index
+    convention); everything else is 0."""
+    assert data_format == "NCHW", "max_unpool2d: NCHW only"
+    k = _tuple(kernel_size, 2)
+    s = _tuple(stride, 2) if stride is not None else k
+    p = _tuple(padding, 2)
+    n, c, ph, pw = x.shape
+    if output_size is None:
+        H = (ph - 1) * s[0] - 2 * p[0] + k[0]
+        W = (pw - 1) * s[1] - 2 * p[1] + k[1]
+    else:
+        H, W = output_size[-2], output_size[-1]
+    flat = jnp.zeros((n, c, H * W), x.dtype)
+    idx = jnp.reshape(jnp.asarray(indices, jnp.int32), (n, c, ph * pw))
+    vals = jnp.reshape(x, (n, c, ph * pw))
+    flat = flat.at[jnp.arange(n)[:, None, None],
+                   jnp.arange(c)[None, :, None], idx].set(
+        vals, mode="drop")
+    return jnp.reshape(flat, (n, c, H, W))

@@ -343,3 +343,69 @@ def unsqueeze_(x, axis, name=None):
 
 def scatter_(x, index, updates, overwrite=True, name=None):
     return scatter(x, index, updates, overwrite=overwrite)
+
+
+def pad_constant_like(x, y, pad_value=0.0):
+    """Reference: `pad_constant_like_op.cc` — pad y up to x's shape
+    with pad_value (trailing pads per dim)."""
+    y = jnp.asarray(y)
+    widths = [(0, int(dx) - int(dy)) for dx, dy in zip(x.shape, y.shape)]
+    return jnp.pad(y, widths, constant_values=pad_value)
+
+
+def partial_concat(xs, start_index=0, length=-1):
+    """Reference: `partial_concat_op.cc` — concat a column slice
+    [start, start+length) of each [N, C] input along axis 1."""
+    outs = []
+    for a in xs:
+        a = jnp.asarray(a)
+        end = a.shape[1] if length < 0 else start_index + length
+        outs.append(a[:, start_index:end])
+    return jnp.concatenate(outs, axis=1)
+
+
+def partial_sum(xs, start_index=0, length=-1):
+    """Reference: `partial_sum_op.cc` — elementwise sum of the same
+    column slice of each input."""
+    outs = []
+    for a in xs:
+        a = jnp.asarray(a)
+        end = a.shape[1] if length < 0 else start_index + length
+        outs.append(a[:, start_index:end])
+    return sum(outs[1:], outs[0])
+
+
+def minus(x, y, name=None):
+    """Reference: `minus_op.cc` (1.x alias of subtract)."""
+    return jnp.subtract(x, y)
+
+
+def unique_with_counts(x, dtype="int32"):
+    """Reference: `unique_with_counts_op.cc` — eager (data-dependent
+    shapes): returns (unique values, index of each input element in the
+    unique list, counts)."""
+    arr = np.asarray(x)
+    uniq, inverse, counts = np.unique(arr, return_inverse=True,
+                                      return_counts=True)
+    dt = convert_dtype(dtype)
+    return (jnp.asarray(uniq), jnp.asarray(inverse.astype(dt)),
+            jnp.asarray(counts.astype(dt)))
+
+
+def shuffle_batch(x, seed=None):
+    """Reference: `shuffle_batch_op.cc` — random permutation of rows
+    (eager host-side permutation, matching the CPU-only ref kernel)."""
+    arr = np.asarray(x)
+    rs = np.random.RandomState(seed)
+    perm = rs.permutation(arr.shape[0])
+    return jnp.asarray(arr[perm]), jnp.asarray(perm.astype(np.int64))
+
+
+def space_to_depth(x, blocksize, name=None):
+    """Reference: `space_to_depth_op.cc` — [N, C, H, W] ->
+    [N, C*b*b, H/b, W/b]."""
+    n, c, h, w = x.shape
+    b = int(blocksize)
+    x = jnp.reshape(x, (n, c, h // b, b, w // b, b))
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return jnp.reshape(x, (n, c * b * b, h // b, w // b))

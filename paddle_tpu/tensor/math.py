@@ -510,3 +510,42 @@ def floor_mod(x, y):
 
 def tanh_(x, name=None):  # inplace alias: plain op in a functional world
     return jnp.tanh(x)
+
+
+def l1_norm(x, name=None):
+    """Reference: `l1_norm_op.cc` — sum of absolute values (scalar)."""
+    return jnp.sum(jnp.abs(x))
+
+
+def squared_l2_norm(x, name=None):
+    """Reference: `squared_l2_norm_op.cc` — sum of squares (scalar)."""
+    return jnp.sum(jnp.square(x))
+
+
+def squared_l2_distance(x, y):
+    """Reference: `squared_l2_distance_op.cc` — per-row ||x-y||^2;
+    returns (distance [N, 1], sub [N, D]) like the ref (sub is reused
+    by its grad)."""
+    sub = jnp.asarray(x) - jnp.asarray(y)
+    return jnp.sum(jnp.square(sub), axis=-1, keepdims=True), sub
+
+
+def cos_sim(X, Y):
+    """Reference: `cos_sim_op.cc` — per-row cosine similarity
+    [N, D] x [N or 1, D] -> [N, 1]."""
+    X = jnp.asarray(X)
+    Y = jnp.asarray(Y)
+    dot = jnp.sum(X * Y, axis=-1, keepdims=True)
+    nx = jnp.linalg.norm(X, axis=-1, keepdims=True)
+    ny = jnp.linalg.norm(Y, axis=-1, keepdims=True)
+    return dot / jnp.maximum(nx * ny, 1e-12)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64"):
+    """Reference: `sampling_id_op.cc` — sample one column index per row
+    of a probability matrix [N, C]."""
+    from ..framework.random import next_key
+    key = next_key() if seed == 0 else jax.random.key(seed)
+    idx = jax.random.categorical(key, jnp.log(jnp.clip(x, 1e-12, None)),
+                                 axis=-1)
+    return idx.astype(convert_dtype(dtype))

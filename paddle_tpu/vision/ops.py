@@ -819,6 +819,10 @@ def rpn_target_assign(anchors, gt_boxes, is_crowd=None, im_info=None,
     (the deterministic variant of the reference's random sampler)."""
     a = jnp.asarray(anchors).reshape(-1, 4)
     g = jnp.asarray(gt_boxes).reshape(-1, 4)
+    if g.shape[0] == 0:   # no annotations: everything is background
+        n = a.shape[0]
+        return (jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32),
+                jnp.zeros((n,), jnp.float32))
     iou = iou_similarity(a, g)                           # [N, M]
     if is_crowd is not None:
         valid_gt = ~jnp.asarray(is_crowd, bool)
@@ -975,9 +979,17 @@ def generate_proposal_labels(rpn_rois, gt_classes, gt_boxes,
     Returns (rois [B, 4], labels [B] int32 (class id, 0 = background,
     -1 = pad), bbox_targets [B, 4] encoded vs the matched gt,
     fg_mask [B] bool) with B = batch_size_per_im."""
-    rois = jnp.concatenate([jnp.asarray(rpn_rois).reshape(-1, 4),
-                            jnp.asarray(gt_boxes).reshape(-1, 4)])
     g = jnp.asarray(gt_boxes).reshape(-1, 4)
+    if g.shape[0] == 0:   # no annotations: all-background batch
+        B = batch_size_per_im
+        r = jnp.asarray(rpn_rois).reshape(-1, 4)
+        k = min(B, r.shape[0])
+        rois0 = jnp.zeros((B, 4), jnp.float32).at[:k].set(r[:k])
+        labels0 = jnp.concatenate([
+            jnp.zeros((k,), jnp.int32), jnp.full((B - k,), -1, jnp.int32)])
+        return (rois0, labels0, jnp.zeros((B, 4), jnp.float32),
+                jnp.zeros((B,), bool))
+    rois = jnp.concatenate([jnp.asarray(rpn_rois).reshape(-1, 4), g])
     gcls = jnp.asarray(gt_classes).reshape(-1)
     iou = iou_similarity(rois, g)
     if is_crowd is not None:
@@ -1003,12 +1015,13 @@ def generate_proposal_labels(rpn_rois, gt_classes, gt_boxes,
                        jnp.where(ok, 0, -1).astype(jnp.int32))
     # encode fg targets vs matched gt (encode_center_size w/ weights)
     mg = g[matched[sel]]
-    rw = out_rois[:, 2] - out_rois[:, 0] + 1e-6
-    rh = out_rois[:, 3] - out_rois[:, 1] + 1e-6
+    # +1 box widths: the detection stack's coder convention (BoxToDelta)
+    rw = out_rois[:, 2] - out_rois[:, 0] + 1.0
+    rh = out_rois[:, 3] - out_rois[:, 1] + 1.0
     rcx = out_rois[:, 0] + rw * 0.5
     rcy = out_rois[:, 1] + rh * 0.5
-    gw = mg[:, 2] - mg[:, 0] + 1e-6
-    gh = mg[:, 3] - mg[:, 1] + 1e-6
+    gw = mg[:, 2] - mg[:, 0] + 1.0
+    gh = mg[:, 3] - mg[:, 1] + 1.0
     gcx = mg[:, 0] + gw * 0.5
     gcy = mg[:, 1] + gh * 0.5
     wts = jnp.asarray(bbox_reg_weights, jnp.float32)
@@ -1087,3 +1100,68 @@ def correlation(x, y, pad_size, kernel_size, max_displacement, stride1,
                 max(0, -dx * stride2):w + min(0, -dx * stride2)].set(1.0)
             outs.append(jnp.mean(x * ys_, axis=1) * valid[None])
     return jnp.stack(outs, axis=1)
+
+
+def roi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+             name=None):
+    """Max RoI pooling (`roi_pool_op.cc`): like roi_align but hard max
+    over each bin's integer grid cells. x [N, C, H, W] (batch 0 static
+    form); boxes [R, 4] xyxy. Returns [R, C, oh, ow]."""
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    x = jnp.asarray(x)
+    b = jnp.round(jnp.asarray(boxes, jnp.float32) * spatial_scale)
+    n, c, h, w = x.shape
+    feat = x[0]
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one(box):
+        x1, y1, x2, y2 = box
+        bh = jnp.maximum(y2 - y1 + 1.0, 1.0) / oh
+        bw = jnp.maximum(x2 - x1 + 1.0, 1.0) / ow
+        i = jnp.arange(oh, dtype=jnp.float32)[:, None]
+        j = jnp.arange(ow, dtype=jnp.float32)[None, :]
+        y_lo = jnp.floor(y1 + i * bh)
+        y_hi = jnp.ceil(y1 + (i + 1) * bh)
+        x_lo = jnp.floor(x1 + j * bw)
+        x_hi = jnp.ceil(x1 + (j + 1) * bw)
+        in_y = (ys[None, None, :] >= y_lo[..., None]) & \
+               (ys[None, None, :] < y_hi[..., None])     # [oh,ow,h]
+        in_x = (xs[None, None, :] >= x_lo[..., None]) & \
+               (xs[None, None, :] < x_hi[..., None])     # [oh,ow,w]
+        m = in_y[:, :, :, None] & in_x[:, :, None, :]    # [oh,ow,h,w]
+        masked = jnp.where(m[None], feat[:, None, None], -jnp.inf)
+        out = jnp.max(masked, axis=(-1, -2))             # [C, oh, ow]
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return jax.vmap(one)(b)
+
+
+def cvm(x, cvm_input, use_cvm=True):
+    """Reference: `cvm_op.cc` (CTR continuous value model): with
+    use_cvm, overwrite the first two columns with log-transformed
+    show/click stats; else strip them."""
+    x = jnp.asarray(x)
+    c = jnp.asarray(cvm_input, x.dtype)                  # [N, 2] show,clk
+    show = jnp.log(c[:, 0] + 1.0)
+    ctr = jnp.log(c[:, 1] + 1.0) - show
+    if use_cvm:
+        return jnp.concatenate([show[:, None], ctr[:, None], x[:, 2:]],
+                               axis=1)
+    return x[:, 2:]
+
+
+def random_crop(x, shape, seed=0):
+    """Reference: `random_crop_op.cc` — random spatial crop of the
+    trailing dims to `shape` (eager host-side offsets)."""
+    arr = np.asarray(x)
+    rs = np.random.RandomState(seed or None)
+    nd = len(shape)
+    offs = [rs.randint(0, arr.shape[arr.ndim - nd + k] - shape[k] + 1)
+            for k in range(nd)]
+    idx = tuple([slice(None)] * (arr.ndim - nd) +
+                [slice(o, o + s) for o, s in zip(offs, shape)])
+    return jnp.asarray(arr[idx])

@@ -1,0 +1,274 @@
+"""Round-4 tranche B: the fluid.layers long tail — losses, misc tensor
+ops, image ops, and eval metrics (reference: operators/<name>_op.cc per
+docstring citations in the implementations).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+import paddle_tpu.nn.functional.loss as L
+import paddle_tpu.vision.ops as V
+import paddle_tpu.metric as M
+import paddle_tpu.tensor.math as TM
+import paddle_tpu.tensor.manipulation as TMa
+
+
+class TestLossZoo:
+    def test_huber_piecewise(self):
+        assert float(L.huber_loss(jnp.asarray(0.), jnp.asarray(0.5))) \
+            == pytest.approx(0.125)
+        assert float(L.huber_loss(jnp.asarray(0.), jnp.asarray(3.0))) \
+            == pytest.approx(2.5)   # delta*(|r| - delta/2)
+
+    def test_hinge_and_modified_huber(self):
+        assert float(L.hinge_loss(jnp.asarray(0.5),
+                                  jnp.asarray(1.0))) == 0.5
+        assert float(L.hinge_loss(jnp.asarray(2.0), jnp.asarray(1.0))) == 0
+        assert float(L.modified_huber_loss(jnp.asarray(-1.0),
+                                           jnp.asarray(1.0))) == 4.0
+        assert float(L.modified_huber_loss(jnp.asarray(0.5),
+                                           jnp.asarray(1.0))) == \
+            pytest.approx(0.25)
+
+    def test_rank_loss_matches_formula(self):
+        o = 1.5
+        want = np.log1p(np.exp(o)) - 1.0 * o
+        got = float(L.rank_loss(jnp.asarray(1.0), jnp.asarray(2.0),
+                                jnp.asarray(0.5)))
+        assert got == pytest.approx(want, rel=1e-6)
+
+    def test_bpr_loss_positive_and_grads(self):
+        x = jnp.asarray([[2.0, 1.0, 0.0]])
+        loss = L.bpr_loss(x, jnp.asarray([0]))
+        assert float(loss[0, 0]) > 0
+        g = jax.grad(lambda a: jnp.sum(L.bpr_loss(a, jnp.asarray([0]))))(x)
+        assert float(g[0, 0]) < 0      # raising the positive lowers loss
+
+    def test_center_loss_moves_centers(self):
+        x = jnp.ones((2, 4))
+        loss, newc = L.center_loss(x, jnp.asarray([0, 0]),
+                                   jnp.zeros((3, 4)), alpha=0.5)
+        assert float(loss[0, 0]) == pytest.approx(2.0)
+        assert float(newc[0, 0]) > 0      # center 0 moved toward x
+        assert float(newc[1, 0]) == 0     # untouched class
+
+    def test_teacher_student_loss_branches(self):
+        """Reference label encoding (teacher_student_sigmoid_loss_op.h):
+        -2 no-teacher/no-click; -1 no-teacher/click; [0,1) teacher z',
+        no click; [1,2] teacher z'-1, click."""
+        x = jnp.asarray(0.0)
+        sp = np.log(2.0)
+        # no teacher, no click: one sigmoid part with target 0
+        assert float(L.teacher_student_sigmoid_loss(
+            x, jnp.asarray(-2.0))) == pytest.approx(sp, rel=1e-6)
+        # no teacher, click: target 1 (same value at x=0)
+        assert float(L.teacher_student_sigmoid_loss(
+            x, jnp.asarray(-1.0))) == pytest.approx(sp, rel=1e-6)
+        # teacher z'=0.5, no click: two parts
+        assert float(L.teacher_student_sigmoid_loss(
+            x, jnp.asarray(0.5))) == pytest.approx(2 * sp, rel=1e-6)
+        # click + teacher: x != 0 distinguishes the targets
+        x1 = jnp.asarray(1.0)
+        want = (max(1.0, 0) - 1.0 * 1.0 + np.log1p(np.exp(-1.0))) +                (max(1.0, 0) - 1.0 * 0.5 + np.log1p(np.exp(-1.0)))
+        assert float(L.teacher_student_sigmoid_loss(
+            x1, jnp.asarray(1.5))) == pytest.approx(want, rel=1e-6)
+
+
+class TestMiscTensorOps:
+    def test_l1_l2_norms_and_distance(self):
+        assert float(TM.l1_norm(jnp.asarray([-1., 2.]))) == 3.0
+        assert float(TM.squared_l2_norm(jnp.asarray([3., 4.]))) == 25.0
+        d, sub = TM.squared_l2_distance(jnp.ones((2, 3)), jnp.zeros((2, 3)))
+        assert d.shape == (2, 1) and float(d[0, 0]) == 3.0
+
+    def test_cos_sim_rows(self):
+        a = jnp.asarray([[1., 0.], [0., 2.]])
+        got = TM.cos_sim(a, jnp.asarray([[1., 0.]]))
+        np.testing.assert_allclose(np.asarray(got), [[1.0], [0.0]],
+                                   atol=1e-6)
+
+    def test_sampling_id_distribution(self):
+        pt.seed(0)
+        probs = jnp.asarray([[0.0, 1.0, 0.0]] * 8)
+        ids = TM.sampling_id(probs)
+        assert ids.tolist() == [1] * 8
+
+    def test_pad_constant_like(self):
+        out = TMa.pad_constant_like(jnp.zeros((3, 4)), jnp.ones((2, 2)),
+                                    9.0)
+        assert out.shape == (3, 4)
+        assert float(out[2, 3]) == 9.0 and float(out[0, 0]) == 1.0
+
+    def test_partial_concat_sum_minus(self):
+        a, b = jnp.ones((2, 4)), 2 * jnp.ones((2, 4))
+        assert TMa.partial_concat([a, b], 1, 2).shape == (2, 4)
+        np.testing.assert_allclose(
+            np.asarray(TMa.partial_sum([a, b], 0, 2)), 3.0)
+        assert float(TMa.minus(jnp.asarray(3.0), jnp.asarray(1.0))) == 2.0
+
+    def test_unique_with_counts(self):
+        u, inv, cnt = TMa.unique_with_counts(jnp.asarray([3, 1, 3]))
+        assert u.tolist() == [1, 3]
+        assert cnt.tolist() == [1, 2]
+        assert inv.tolist() == [1, 0, 1]
+
+    def test_shuffle_batch_is_permutation(self):
+        x = jnp.arange(6.0).reshape(3, 2)
+        out, perm = TMa.shuffle_batch(x, seed=3)
+        assert sorted(np.asarray(out)[:, 0].tolist()) == [0.0, 2.0, 4.0]
+
+    def test_space_to_depth_roundtrip_shape(self):
+        x = jnp.arange(16.0).reshape(1, 1, 4, 4)
+        out = TMa.space_to_depth(x, 2)
+        assert out.shape == (1, 4, 2, 2)
+        # top-left output pixel carries the 2x2 block's corner values
+        np.testing.assert_allclose(np.asarray(out[0, :, 0, 0]),
+                                   [0, 1, 4, 5])
+
+
+class TestImageOps:
+    def test_affine_channel_is_frozen_bn(self):
+        x = jnp.ones((1, 2, 2, 2))
+        out = F.affine_channel(x, jnp.asarray([2., 3.]),
+                               jnp.asarray([1., 0.]))
+        np.testing.assert_allclose(np.asarray(out[0, 0]), 3.0)
+        np.testing.assert_allclose(np.asarray(out[0, 1]), 3.0)
+
+    def test_add_position_encoding_beta_only(self):
+        pe = F.add_position_encoding(jnp.zeros((1, 4, 8)), alpha=0.0)
+        # position 0: sin(0)=0 for first half, cos(0)=1 for second
+        np.testing.assert_allclose(np.asarray(pe[0, 0, :4]), 0.0,
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(pe[0, 0, 4:]), 1.0,
+                                   atol=1e-6)
+
+    def test_im2sequence(self):
+        x = jnp.arange(16.0).reshape(1, 1, 4, 4)
+        seq = F.im2sequence(x, 2, 2)
+        assert seq.shape == (1, 4, 4)
+        np.testing.assert_allclose(np.asarray(seq[0, 0]), [0, 1, 4, 5])
+
+    def test_spp_output_size(self):
+        x = jnp.ones((2, 3, 8, 8))
+        assert F.spp(x, 3).shape == (2, 3 * (1 + 4 + 16))
+
+    def test_conv_shift_circular(self):
+        x = jnp.asarray([[1., 2., 3., 4.]])
+        y = jnp.asarray([[0., 1., 0.]])   # identity kernel
+        np.testing.assert_allclose(np.asarray(F.conv_shift(x, y)),
+                                   [[1, 2, 3, 4]])
+
+    def test_max_unpool2d_inverts_argmax(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(1, 2, 8, 8),
+                        jnp.float32)
+        out, gi = F.adaptive_max_pool2d(x, 4, return_mask=True)
+        un = F.max_unpool2d(out, gi, kernel_size=2, stride=2)
+        assert un.shape == x.shape
+        # every pooled value lands back somewhere; sums match
+        assert float(jnp.sum(un)) == pytest.approx(float(jnp.sum(out)),
+                                                   rel=1e-5)
+
+    def test_roi_pool_max_semantics(self):
+        x = np.zeros((1, 1, 8, 8), np.float32)
+        x[0, 0, 1, 1] = 5.0
+        r = V.roi_pool(jnp.asarray(x), jnp.asarray([[0., 0., 3., 3.]]),
+                       output_size=2)
+        assert float(jnp.max(r)) == 5.0
+
+    def test_cvm_use_and_strip(self):
+        x = jnp.ones((2, 6))
+        c = jnp.asarray([[np.e - 1, np.e - 1]] * 2, jnp.float32)
+        out = V.cvm(x, c, use_cvm=True)
+        assert out.shape == (2, 6)
+        assert float(out[0, 0]) == pytest.approx(1.0, rel=1e-5)
+        assert V.cvm(x, c, use_cvm=False).shape == (2, 4)
+
+    def test_random_crop_shape(self):
+        out = V.random_crop(jnp.ones((2, 3, 10, 10)), (6, 6), seed=1)
+        assert out.shape == (2, 3, 6, 6)
+
+    def test_lrn_alias(self):
+        x = jnp.ones((1, 4, 4, 4))
+        np.testing.assert_allclose(np.asarray(F.lrn(x)),
+                                   np.asarray(F.local_response_norm(x, 5)))
+
+
+class TestEvalMetrics:
+    def test_mean_iou(self):
+        miou, wrong, correct = M.mean_iou(jnp.asarray([0, 1, 1]),
+                                          jnp.asarray([0, 1, 0]), 2)
+        # class0: inter 1, union 2 -> 0.5; class1: inter 1, union 2 -> 0.5
+        assert float(miou) == pytest.approx(0.5)
+
+    def test_chunk_eval_perfect_and_partial(self):
+        # tags: type0 B=0 I=1, type1 B=2 I=3, O=4 (num_chunk_types=2)
+        perfect = M.chunk_eval(jnp.asarray([[0, 1, 4, 2]]),
+                               jnp.asarray([[0, 1, 4, 2]]),
+                               num_chunk_types=2)
+        assert perfect[2] == 1.0
+        partial = M.chunk_eval(jnp.asarray([[0, 4, 4, 2]]),
+                               jnp.asarray([[0, 1, 4, 2]]),
+                               num_chunk_types=2)
+        assert 0 < partial[2] < 1.0
+
+    def test_detection_map_perfect_and_miss(self):
+        det = np.asarray([[1, 0.9, 0, 0, 10, 10]])
+        gt = np.asarray([[1, 0, 0, 10, 10, 0]])
+        assert M.detection_map(det, gt, 2) == pytest.approx(1.0)
+        det2 = np.asarray([[1, 0.9, 50, 50, 60, 60]])
+        assert M.detection_map(det2, gt, 2) == pytest.approx(0.0)
+
+
+class TestReviewFixRegressions:
+    def test_similarity_focus_greedy(self):
+        """Each row/column holds at most one selected cell."""
+        x = jnp.asarray([[[[3., 0., 0.],
+                           [0., 2., 0.],
+                           [0., 0., 1.]]]])
+        m = F.similarity_focus(x, 1, [0])
+        np.testing.assert_allclose(np.asarray(m[0, 0]), np.eye(3))
+
+    def test_spp_non_divisible(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(1, 2, 6, 6),
+                        jnp.float32)
+        out = F.spp(x, 3)                 # bins 1, 2, 4 with 6x6 input
+        assert out.shape == (1, 2 * (1 + 4 + 16))
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_rpn_and_labels_empty_gt(self):
+        anchors = jnp.asarray([[0., 0., 10., 10.]])
+        empty = jnp.zeros((0, 4))
+        labels, matched, miou = V.rpn_target_assign(anchors, empty)
+        assert labels.tolist() == [0]
+        rois, lab, tg, fg = V.generate_proposal_labels(
+            anchors, jnp.zeros((0,), jnp.int32), empty,
+            batch_size_per_im=4)
+        assert lab.tolist()[0] == 0 and not bool(fg.any())
+
+    def test_proposal_labels_plus_one_widths(self):
+        """fg targets use the +1 box-width convention (BoxToDelta)."""
+        rois = jnp.asarray([[0., 0., 9., 9.]])
+        gt = jnp.asarray([[0., 0., 10., 10.]])
+        _, lab, tg, fg = V.generate_proposal_labels(
+            rois, jnp.asarray([5]), gt, batch_size_per_im=4,
+            fg_fraction=1.0, fg_thresh=0.5,
+            bbox_reg_weights=(1., 1., 1., 1.))
+        # fg rows: the appended gt itself (target 0) AND our roi, whose
+        # dw must be log((10+1)/(9+1)) under the +1 convention
+        fg_tgts = [float(tg[i, 2]) for i, l in enumerate(lab.tolist())
+                   if l == 5]
+        assert any(abs(t - np.log(11.0 / 10.0)) < 1e-5 for t in fg_tgts),             fg_tgts
+
+    def test_chunk_eval_requires_num_types(self):
+        with pytest.raises(ValueError):
+            M.chunk_eval(jnp.asarray([[0]]), jnp.asarray([[0]]))
+
+    def test_detection_map_skips_gtless_classes(self):
+        det = np.asarray([[1, 0.9, 0, 0, 10, 10],
+                          [3, 0.8, 0, 0, 5, 5]])       # class 3: no gt
+        gt = np.asarray([[1, 0, 0, 10, 10, 0]])
+        assert M.detection_map(det, gt, 4) == pytest.approx(1.0)
