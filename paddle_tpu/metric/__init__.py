@@ -171,7 +171,10 @@ def mean_iou(input, label, num_classes):
     iou = jnp.where(present, correct / jnp.maximum(union, 1), 0.0)
     miou = jnp.sum(iou) / jnp.maximum(
         jnp.sum(present.astype(jnp.float32)), 1.0)
-    wrong = pred_cnt - correct
+    # reference (mean_iou_op.h:96): a misclassified pixel increments
+    # out_wrong for BOTH its predicted and its label class, so
+    # wrong + correct == union and streaming accumulation works
+    wrong = (pred_cnt - correct) + (lab_cnt - correct)
     return miou, wrong, correct
 
 
@@ -199,10 +202,10 @@ def chunk_eval(input, label, chunk_scheme="IOB", num_chunk_types=None,
                 if is_o:
                     if start is not None:
                         chunks.append((start, t - 1, ctype))
-                        start = None
+                        start, ctype = None, None
                     continue
                 ty, pos = tag // 2, tag % 2          # pos 0 = B, 1 = I
-                if pos == 0 or ctype != ty:
+                if pos == 0 or ctype != ty or start is None:
                     if start is not None:
                         chunks.append((start, t - 1, ctype))
                     start, ctype = t, ty

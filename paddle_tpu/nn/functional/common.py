@@ -323,9 +323,12 @@ def add_position_encoding(x, alpha=1.0, beta=1.0):
     """Reference: `add_position_encoding_op.cc` — alpha*x + beta*PE with
     the sin/cos transformer table; x [B, T, D]."""
     b, t, d = x.shape
+    half = d // 2
     pos = jnp.arange(t, dtype=jnp.float32)[:, None]
-    i = jnp.arange(d // 2, dtype=jnp.float32)[None, :]
-    angle = pos / jnp.power(10000.0, 2.0 * i / d)
+    i = jnp.arange(half, dtype=jnp.float32)[None, :]
+    # reference exponent (add_position_encoding_op.h:85): k/(half-1)
+    denom = float(max(half - 1, 1))
+    angle = pos / jnp.power(10000.0, i / denom)
     pe = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=1)
     return alpha * x + beta * pe[None].astype(x.dtype)
 

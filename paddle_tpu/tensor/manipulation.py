@@ -382,14 +382,20 @@ def minus(x, y, name=None):
 
 def unique_with_counts(x, dtype="int32"):
     """Reference: `unique_with_counts_op.cc` — eager (data-dependent
-    shapes): returns (unique values, index of each input element in the
-    unique list, counts)."""
-    arr = np.asarray(x)
-    uniq, inverse, counts = np.unique(arr, return_inverse=True,
+    shapes): returns (unique values in FIRST-OCCURRENCE order — the
+    reference's hash-map insertion order, unique_op.h:61 — index of
+    each input element in the unique list, counts)."""
+    arr = np.asarray(x).reshape(-1)
+    _, first, inv, counts = np.unique(arr, return_index=True,
+                                      return_inverse=True,
                                       return_counts=True)
+    order = np.argsort(first)            # sorted-unique -> occurrence
+    rank = np.empty_like(order)
+    rank[order] = np.arange(len(order))
     dt = convert_dtype(dtype)
-    return (jnp.asarray(uniq), jnp.asarray(inverse.astype(dt)),
-            jnp.asarray(counts.astype(dt)))
+    return (jnp.asarray(arr[np.sort(first)]),
+            jnp.asarray(rank[inv].astype(dt)),
+            jnp.asarray(counts[order].astype(dt)))
 
 
 def shuffle_batch(x, seed=None):

@@ -359,3 +359,120 @@ def ctc_align(input, input_length=None, blank=0, padding_value=0):
     out = out.at[jnp.arange(B)[:, None], dst].set(
         jnp.where(keep, x, padding_value), mode="drop")
     return out, jnp.sum(keep.astype(jnp.int32), axis=1)
+
+
+def tdm_child(x, node_nums, child_nums, tree_info):
+    """Reference: `tdm_child_op.cc` (tree-based deep match recall):
+    look up each node id's children in the flat tree table.
+    tree_info [node_nums, 3 + child_nums]: (item_id, layer, parent,
+    children...). Returns (child ids [.., child_nums],
+    leaf_mask same shape: 1 where the child is a leaf (item_id > 0))."""
+    ids = jnp.asarray(x)
+    info = jnp.asarray(tree_info)
+    children = info[:, 3:3 + child_nums]
+    ch = children[ids]                         # [..., child_nums]
+    item = info[:, 0]
+    leaf = (item[jnp.clip(ch, 0, node_nums - 1)] > 0) & (ch > 0)
+    return ch, leaf.astype(ids.dtype)
+
+
+def tdm_sampler(x, neg_samples_num_list, layer_node_num_list,
+                tree_travel, tree_layer, output_positive=True, seed=0):
+    """Reference: `tdm_sampler_op.cc` — per input item, walk its travel
+    path and draw negatives from each tree layer. Eager host sampling
+    (training-data prep, like the reference's CPU kernel). Returns
+    (sample ids [B, total], labels [B, total], mask [B, total])."""
+    import numpy
+    rs = numpy.random.RandomState(seed or None)
+    travel = numpy.asarray(tree_travel)          # [items, layers]
+    layers = [numpy.asarray(l) for l in tree_layer]
+    ids = numpy.asarray(x).reshape(-1)
+    out_ids, out_lab = [], []
+    for item in ids:
+        row_i, row_l = [], []
+        for li, neg_n in enumerate(neg_samples_num_list):
+            pos = int(travel[item, li])
+            if output_positive:
+                row_i.append(pos)
+                row_l.append(1)
+            pool = layers[li]
+            cand = pool[pool != pos]
+            take = min(neg_n, len(cand))
+            row_i.extend(rs.choice(cand, size=take, replace=False)
+                         .tolist() + [0] * (neg_n - take))
+            row_l.extend([0] * neg_n)
+        out_ids.append(row_i)
+        out_lab.append(row_l)
+    ids_a = numpy.asarray(out_ids, numpy.int64)
+    lab_a = numpy.asarray(out_lab, numpy.int64)
+    return (jnp.asarray(ids_a), jnp.asarray(lab_a),
+            jnp.asarray((ids_a > 0) | (lab_a > 0)).astype(jnp.int64))
+
+
+def var_conv_2d(x, lengths_h, lengths_w, w_filter, input_channel,
+                output_channel, filter_size, stride=1):
+    """Reference: `var_conv_2d_op.cc` (text matching): per-sample
+    variable-size 2-D conv over a padded [B, C, H, W] batch — realized
+    as a dense conv with the padding masked out before and after."""
+    from ..nn.functional.conv import conv2d
+    x = jnp.asarray(x)
+    B, C, H, W = x.shape
+    lh = jnp.asarray(lengths_h)
+    lw = jnp.asarray(lengths_w)
+    hm = sequence_mask(lh, H, dtype=x.dtype)
+    wm = sequence_mask(lw, W, dtype=x.dtype)
+    m = hm[:, None, :, None] * wm[:, None, None, :]
+    y = conv2d(x * m, w_filter, stride=stride,
+               padding=filter_size // 2)
+    # output mask at the POST-STRIDE resolution: ceil(len/stride)
+    oh, ow = y.shape[2], y.shape[3]
+    ohm = sequence_mask(-(-lh // stride), oh, dtype=y.dtype)
+    owm = sequence_mask(-(-lw // stride), ow, dtype=y.dtype)
+    return y * (ohm[:, None, :, None] * owm[:, None, None, :])
+
+
+def match_matrix_tensor(x, y, w, lengths_x=None, lengths_y=None):
+    """Reference: `match_matrix_tensor_op.cc` (text matching): bilinear
+    match tensor out[b, t, i, j] = x[b, i] · W[t] · y[b, j] for each
+    channel t; padded positions zeroed."""
+    x = jnp.asarray(x)                           # [B, Lx, D]
+    y = jnp.asarray(y)                           # [B, Ly, D]
+    W = jnp.asarray(w)                           # [T, D, D] or [D, T, D]
+    if W.ndim == 3 and W.shape[0] == x.shape[-1]:
+        W = jnp.swapaxes(W, 0, 1)                # -> [T, D, D]
+    out = jnp.einsum("bid,tde,bje->btij", x, W, y)
+    if lengths_x is not None:
+        mx = sequence_mask(jnp.asarray(lengths_x), x.shape[1],
+                           dtype=out.dtype)
+        out = out * mx[:, None, :, None]
+    if lengths_y is not None:
+        my = sequence_mask(jnp.asarray(lengths_y), y.shape[1],
+                           dtype=out.dtype)
+        out = out * my[:, None, None, :]
+    return out
+
+
+def pyramid_hash(x, num_emb, space_len, pyramid_layer, rand_len=16,
+                 drop_out_percent=0, white_list_len=0, black_list_len=0,
+                 seed=0, lr=1.0, param=None):
+    """Reference: `pyramid_hash_op.cc` (text matching): hash every
+    n-gram (n = 2..pyramid_layer) of the id sequence into an embedding
+    table and sum-pool per position. Simplified deterministic FNV-style
+    hash; param is the [space_len, num_emb] table. x [B, T] int ids ->
+    [B, T, num_emb]."""
+    ids = jnp.asarray(x)
+    B, T = ids.shape
+    table = jnp.asarray(param)
+    out = jnp.zeros((B, T, num_emb), table.dtype)
+    for n in range(2, pyramid_layer + 1):
+        if n > T:
+            break
+        # rolling polynomial hash of each n-gram starting at t
+        h = jnp.zeros((B, T - n + 1), jnp.uint32)
+        for k in range(n):
+            h = h * jnp.uint32(16777619) ^ ids[:, k:T - n + 1 + k] \
+                .astype(jnp.uint32)
+        idx = (h % jnp.uint32(table.shape[0])).astype(jnp.int32)
+        emb = table[idx]                         # [B, T-n+1, num_emb]
+        out = out.at[:, :T - n + 1].add(emb)
+    return out

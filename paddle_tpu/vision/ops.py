@@ -819,9 +819,12 @@ def rpn_target_assign(anchors, gt_boxes, is_crowd=None, im_info=None,
     (the deterministic variant of the reference's random sampler)."""
     a = jnp.asarray(anchors).reshape(-1, 4)
     g = jnp.asarray(gt_boxes).reshape(-1, 4)
-    if g.shape[0] == 0:   # no annotations: everything is background
+    if g.shape[0] == 0:   # no annotations: everything is background,
+        # but still subsampled to the op's per-image budget (excess
+        # flips to ignore, matching the normal path's bg sampling)
         n = a.shape[0]
-        return (jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32),
+        labels = jnp.where(jnp.arange(n) < rpn_batch_size_per_im, 0, -1)
+        return (labels.astype(jnp.int32), jnp.zeros((n,), jnp.int32),
                 jnp.zeros((n,), jnp.float32))
     iou = iou_similarity(a, g)                           # [N, M]
     if is_crowd is not None:
@@ -1165,3 +1168,104 @@ def random_crop(x, shape, seed=0):
     idx = tuple([slice(None)] * (arr.ndim - nd) +
                 [slice(o, o + s) for o, s in zip(offs, shape)])
     return jnp.asarray(arr[idx])
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box,
+                           box_score, box_clip_value=4.135):
+    """Reference: `box_decoder_and_assign_op.cc` (RCNN test-time):
+    decode per-class deltas [N, C*4] against priors, then assign each
+    row its best-scoring class's box. Returns (decoded [N, C, 4],
+    assigned [N, 4])."""
+    p = jnp.asarray(prior_box)
+    v = jnp.asarray(prior_box_var)
+    d = jnp.asarray(target_box)
+    s = jnp.asarray(box_score)
+    N = p.shape[0]
+    C = s.shape[1]
+    d = d.reshape(N, C, 4)
+    pw = p[:, 2] - p[:, 0] + 1.0
+    ph = p[:, 3] - p[:, 1] + 1.0
+    pcx = p[:, 0] + pw * 0.5
+    pcy = p[:, 1] + ph * 0.5
+    cx = v[:, None, 0] * d[..., 0] * pw[:, None] + pcx[:, None]
+    cy = v[:, None, 1] * d[..., 1] * ph[:, None] + pcy[:, None]
+    bw = jnp.exp(jnp.minimum(v[:, None, 2] * d[..., 2],
+                             box_clip_value)) * pw[:, None]
+    bh = jnp.exp(jnp.minimum(v[:, None, 3] * d[..., 3],
+                             box_clip_value)) * ph[:, None]
+    decoded = jnp.stack([cx - bw / 2, cy - bh / 2,
+                         cx + bw / 2 - 1.0, cy + bh / 2 - 1.0], -1)
+    # reference (box_decoder_and_assign_op.h:82): the background class
+    # j == 0 never wins the assignment
+    if C > 1:
+        best = jnp.argmax(s[:, 1:], axis=1) + 1
+    else:
+        best = jnp.zeros((N,), jnp.int32)
+    assigned = jnp.take_along_axis(
+        decoded, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
+    return decoded, assigned
+
+
+def roi_perspective_transform(x, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0):
+    """Reference: `roi_perspective_transform_op.cc` (OCR EAST/quad
+    RoIs): warp each quadrilateral RoI to a fixed rectangle via the
+    perspective transform, bilinear sampling. x [N, C, H, W] (batch 0
+    static form); rois [R, 8] quad corners (x1..y4, clockwise from
+    top-left). Returns [R, C, th, tw]."""
+    x = jnp.asarray(x)
+    q = jnp.asarray(rois, jnp.float32) * spatial_scale
+    n, c, h, w = x.shape
+    th, tw = transformed_height, transformed_width
+    feat = x[0]
+
+    def one(quad):
+        # solve the 3x3 homography mapping the output rectangle's
+        # corners to the quad (standard 8-equation system)
+        src = jnp.asarray([[0.0, 0.0], [tw - 1.0, 0.0],
+                           [tw - 1.0, th - 1.0], [0.0, th - 1.0]])
+        dst = quad.reshape(4, 2)
+        A = []
+        b = []
+        for k in range(4):
+            sx, sy = src[k, 0], src[k, 1]
+            dx, dy = dst[k, 0], dst[k, 1]
+            A.append(jnp.stack([sx, sy, jnp.asarray(1.0), sx * 0, sx * 0,
+                                sx * 0, -sx * dx, -sy * dx]))
+            b.append(dx)
+            A.append(jnp.stack([sx * 0, sx * 0, sx * 0, sx, sy,
+                                jnp.asarray(1.0), -sx * dy, -sy * dy]))
+            b.append(dy)
+        A = jnp.stack(A)
+        bv = jnp.stack(b)
+        hvec = jnp.linalg.solve(A, bv)
+        H = jnp.concatenate([hvec, jnp.ones((1,))]).reshape(3, 3)
+        # sample: output grid -> source coords
+        gy, gx = jnp.meshgrid(jnp.arange(th, dtype=jnp.float32),
+                              jnp.arange(tw, dtype=jnp.float32),
+                              indexing="ij")
+        ones = jnp.ones_like(gx)
+        pts = jnp.stack([gx, gy, ones], 0).reshape(3, -1)
+        mapped = H @ pts
+        sx = mapped[0] / jnp.maximum(jnp.abs(mapped[2]), 1e-8) \
+            * jnp.sign(mapped[2])
+        sy = mapped[1] / jnp.maximum(jnp.abs(mapped[2]), 1e-8) \
+            * jnp.sign(mapped[2])
+        x0 = jnp.floor(sx)
+        y0 = jnp.floor(sy)
+        wx = sx - x0
+        wy = sy - y0
+
+        def tap(yy, xx):
+            valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+            yc = jnp.clip(yy.astype(jnp.int32), 0, h - 1)
+            xc = jnp.clip(xx.astype(jnp.int32), 0, w - 1)
+            return feat[:, yc, xc] * valid.astype(x.dtype)
+
+        out = (tap(y0, x0) * (1 - wy) * (1 - wx) +
+               tap(y0, x0 + 1) * (1 - wy) * wx +
+               tap(y0 + 1, x0) * wy * (1 - wx) +
+               tap(y0 + 1, x0 + 1) * wy * wx)
+        return out.reshape(c, th, tw)
+
+    return jax.vmap(one)(q)

@@ -110,11 +110,12 @@ class TestMiscTensorOps:
             np.asarray(TMa.partial_sum([a, b], 0, 2)), 3.0)
         assert float(TMa.minus(jnp.asarray(3.0), jnp.asarray(1.0))) == 2.0
 
-    def test_unique_with_counts(self):
+    def test_unique_with_counts_first_occurrence_order(self):
+        """Reference emits uniques in first-occurrence order."""
         u, inv, cnt = TMa.unique_with_counts(jnp.asarray([3, 1, 3]))
-        assert u.tolist() == [1, 3]
-        assert cnt.tolist() == [1, 2]
-        assert inv.tolist() == [1, 0, 1]
+        assert u.tolist() == [3, 1]
+        assert cnt.tolist() == [2, 1]
+        assert inv.tolist() == [0, 1, 0]
 
     def test_shuffle_batch_is_permutation(self):
         x = jnp.arange(6.0).reshape(3, 2)
@@ -203,6 +204,9 @@ class TestEvalMetrics:
                                           jnp.asarray([0, 1, 0]), 2)
         # class0: inter 1, union 2 -> 0.5; class1: inter 1, union 2 -> 0.5
         assert float(miou) == pytest.approx(0.5)
+        # ref semantics: a miss increments wrong for BOTH classes
+        assert wrong.tolist() == [1, 1]
+        assert correct.tolist() == [1, 1]
 
     def test_chunk_eval_perfect_and_partial(self):
         # tags: type0 B=0 I=1, type1 B=2 I=3, O=4 (num_chunk_types=2)
@@ -272,3 +276,117 @@ class TestReviewFixRegressions:
                           [3, 0.8, 0, 0, 5, 5]])       # class 3: no gt
         gt = np.asarray([[1, 0, 0, 10, 10, 0]])
         assert M.detection_map(det, gt, 4) == pytest.approx(1.0)
+
+
+class TestFinalStragglers:
+    def test_box_decoder_and_assign(self):
+        priors = jnp.asarray([[0., 0., 9., 9.]])
+        var = jnp.full((1, 4), 1.0)
+        deltas = jnp.zeros((1, 8))         # 2 classes, zero deltas
+        scores = jnp.asarray([[0.2, 0.8]])
+        decoded, assigned = V.box_decoder_and_assign(priors, var, deltas,
+                                                     scores)
+        assert decoded.shape == (1, 2, 4) and assigned.shape == (1, 4)
+        # zero deltas decode back to the prior (+1 convention, -1 ends)
+        np.testing.assert_allclose(np.asarray(assigned[0]),
+                                   [0, 0, 9, 9], atol=1e-4)
+
+    def test_roi_perspective_transform_identity(self):
+        """An axis-aligned quad equal to the output rect is identity."""
+        x = jnp.asarray(np.random.RandomState(0).randn(1, 2, 8, 8),
+                        jnp.float32)
+        th = tw = 4
+        quad = jnp.asarray([[0., 0., 3., 0., 3., 3., 0., 3.]])
+        out = V.roi_perspective_transform(x, quad, th, tw)
+        assert out.shape == (1, 2, 4, 4)
+        np.testing.assert_allclose(np.asarray(out[0, :, :4, :4]),
+                                   np.asarray(x[0, :, :4, :4]), atol=1e-4)
+
+    def test_tdm_child_lookup(self):
+        import paddle_tpu.tensor.sequence as S
+        #           item, layer, parent, c1, c2
+        tree = jnp.asarray([[0, 0, 0, 1, 2],
+                            [7, 1, 0, 0, 0],
+                            [8, 1, 0, 0, 0]])
+        ch, leaf = S.tdm_child(jnp.asarray([0]), 3, 2, tree)
+        assert ch.tolist() == [[1, 2]]
+        assert leaf.tolist() == [[1, 1]]
+
+    def test_tdm_sampler_shapes_and_labels(self):
+        import paddle_tpu.tensor.sequence as S
+        travel = np.asarray([[1, 3], [2, 4]])     # item -> path
+        layers = [np.asarray([1, 2]), np.asarray([3, 4, 5, 6])]
+        ids, lab, mask = S.tdm_sampler(jnp.asarray([0, 1]), [1, 2],
+                                       [2, 4], travel, layers, seed=1)
+        assert ids.shape == (2, 2 + 3)            # (pos+1neg)+(pos+2neg)
+        assert lab[0].tolist()[0] == 1 and lab[0].tolist()[1] == 0
+        # positives follow the travel path
+        assert int(ids[0, 0]) == 1 and int(ids[1, 0]) == 2
+
+    def test_match_matrix_tensor(self):
+        import paddle_tpu.tensor.sequence as S
+        x = jnp.ones((1, 2, 3))
+        y = jnp.ones((1, 4, 3))
+        w = jnp.stack([jnp.eye(3), 2 * jnp.eye(3)])
+        out = S.match_matrix_tensor(x, y, w)
+        assert out.shape == (1, 2, 2, 4)
+        np.testing.assert_allclose(np.asarray(out[0, 0]), 3.0)
+        np.testing.assert_allclose(np.asarray(out[0, 1]), 6.0)
+
+    def test_var_conv_2d_masks_padding(self):
+        import paddle_tpu.tensor.sequence as S
+        x = jnp.ones((2, 1, 6, 6))
+        w = jnp.ones((1, 1, 3, 3))
+        y = S.var_conv_2d(x, jnp.asarray([6, 3]), jnp.asarray([6, 3]),
+                          w, 1, 1, 3)
+        assert y.shape == (2, 1, 6, 6)
+        assert float(jnp.sum(jnp.abs(y[1, :, 3:, :]))) == 0.0
+
+    def test_pyramid_hash_shapes(self):
+        import paddle_tpu.tensor.sequence as S
+        table = jnp.asarray(np.random.RandomState(0).randn(64, 8),
+                            jnp.float32)
+        out = S.pyramid_hash(jnp.asarray([[1, 2, 3, 4]]), 8, 64, 3,
+                             param=table)
+        assert out.shape == (1, 4, 8)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_chunk_eval_i_after_o_starts_chunk(self):
+        """[B-0, O, I-0]: the I after O begins a NEW chunk (reference
+        ChunkBegin: any non-O after O starts one)."""
+        p, r, f1, ni, nl, nc = M.chunk_eval(
+            jnp.asarray([[0, 4, 1]]), jnp.asarray([[0, 4, 1]]),
+            num_chunk_types=2)
+        assert ni == 2 and nl == 2 and f1 == 1.0
+
+    def test_box_decoder_assign_skips_background(self):
+        priors = jnp.asarray([[0., 0., 9., 9.]])
+        var = jnp.full((1, 4), 1.0)
+        deltas = jnp.asarray([[0., 0., 0., 0., 5., 5., 0., 0.]])
+        scores = jnp.asarray([[0.9, 0.1]])     # background wins raw max
+        _, assigned = V.box_decoder_and_assign(priors, var, deltas,
+                                               scores)
+        # class 1's (shifted) box is assigned, not background's
+        assert float(assigned[0, 0]) > 10.0
+
+    def test_var_conv_2d_stride(self):
+        import paddle_tpu.tensor.sequence as S
+        x = jnp.ones((1, 1, 6, 6))
+        w = jnp.ones((1, 1, 3, 3))
+        y = S.var_conv_2d(x, jnp.asarray([4]), jnp.asarray([4]),
+                          w, 1, 1, 3, stride=2)
+        assert y.shape[2] == 3      # strided output masked, no crash
+
+    def test_rpn_empty_gt_respects_budget(self):
+        anchors = jnp.asarray(np.random.rand(10, 4) * 10)
+        labels, _, _ = V.rpn_target_assign(anchors, jnp.zeros((0, 4)),
+                                           rpn_batch_size_per_im=4)
+        assert labels.tolist().count(0) == 4
+        assert labels.tolist().count(-1) == 6
+
+    def test_add_position_encoding_ref_formula(self):
+        pe = F.add_position_encoding(jnp.zeros((1, 2, 8)), alpha=0.0)
+        # position 1, k: angle = 1/10000^(k/3)
+        want_sin = [np.sin(1.0 / 10000 ** (k / 3.0)) for k in range(4)]
+        np.testing.assert_allclose(np.asarray(pe[0, 1, :4]), want_sin,
+                                   rtol=1e-5)
