@@ -7,8 +7,13 @@ from .optimizer import (  # noqa: F401
     Adam,
     Adamax,
     AdamW,
+    DecayedAdagrad,
+    Dpsgd,
+    Ftrl,
     Lamb,
     LarsMomentum,
+    ProximalAdagrad,
+    ProximalGD,
     Momentum,
     Optimizer,
     RMSProp,

@@ -509,3 +509,129 @@ class LarsMomentum(Optimizer):
         v = self._momentum * slots["velocity"].astype(p.dtype) + \
             lr * local_lr * (g + wd * p)
         return p - v, {**slots, "velocity": v}
+
+
+class Ftrl(Optimizer):
+    """Reference: ftrl_op — Follow The Regularized Leader
+    (McMahan et al.): z/n accumulators with l1/l2 shrinkage."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0,
+                 lr_power=-0.5, parameters=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         False, name)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _init_slot(self, p):
+        return {"squared": jnp.zeros_like(p),
+                "linear": jnp.zeros_like(p)}
+
+    def _update(self, p, g, slots, lr, step, name):
+        n, z = slots["squared"], slots["linear"]
+        new_n = n + jnp.square(g)
+        if self._lr_power == -0.5:
+            sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+        else:
+            sigma = (jnp.power(new_n, -self._lr_power)
+                     - jnp.power(n, -self._lr_power)) / lr
+        new_z = z + g - sigma * p
+        # reference ftrl_op.h:92: the quadratic term is 2*l2
+        if self._lr_power == -0.5:
+            denom = 2.0 * self._l2 + jnp.sqrt(new_n) / lr
+        else:
+            denom = 2.0 * self._l2 + jnp.power(new_n, -self._lr_power) / lr
+        pre = jnp.clip(new_z, -self._l1, self._l1) - new_z
+        new_p = jnp.where(jnp.abs(new_z) > self._l1, pre / denom, 0.0)
+        return new_p, {"squared": new_n, "linear": new_z}
+
+
+class Dpsgd(Optimizer):
+    """Reference: dpsgd_op.h — differentially-private SGD: scale the
+    grad down when its l2 norm exceeds `clip`, then step on
+    grad + N(0, sigma)/batch_size (the reference adds the raw Gaussian
+    divided by batch_size; privacy accounting is the caller's)."""
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
+                 sigma=1.0, parameters=None, seed=0, name=None):
+        super().__init__(learning_rate, parameters, None, None, False,
+                         name)
+        self._clip = clip
+        self._batch = batch_size
+        self._sigma = sigma
+        self._seed = seed
+
+    def _init_slot(self, p):
+        return {}
+
+    def _update(self, p, g, slots, lr, step, name):
+        import zlib
+        gn = jnp.linalg.norm(jnp.ravel(g))
+        g = g / jnp.maximum(1.0, gn / self._clip)
+        # key derived from (seed, step, param name) — NOT the global RNG
+        # stream, which may not be scoped inside a jitted train step
+        key = jax.random.fold_in(jax.random.key(self._seed), step)
+        key = jax.random.fold_in(key, zlib.crc32(name.encode()) &
+                                 0x7FFFFFFF)
+        noise = self._sigma * jax.random.normal(key, g.shape, g.dtype)
+        return p - lr * (g + noise / self._batch), slots
+
+
+class ProximalAdagrad(Optimizer):
+    """Reference: proximal_adagrad_op — adagrad step followed by the
+    proximal l1/l2 shrinkage operator."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0,
+                 parameters=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         False, name)
+        self._l1, self._l2 = l1, l2
+
+    def _init_slot(self, p):
+        return {"moment": jnp.zeros_like(p)}
+
+    def _update(self, p, g, slots, lr, step, name):
+        acc = slots["moment"] + jnp.square(g)
+        # reference proximal_adagrad_op.h:51-57: ADAPTIVE lr for the
+        # gradient step, PLAIN lr for the l1/l2 shrinkage
+        prox = p - lr * g / (jnp.sqrt(acc) + 1e-10)
+        new_p = jnp.sign(prox) * jnp.maximum(
+            jnp.abs(prox) - lr * self._l1, 0.0) / (1.0 + lr * self._l2)
+        return new_p, {"moment": acc}
+
+
+class ProximalGD(Optimizer):
+    """Reference: proximal_gd_op — plain GD + proximal shrinkage."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0,
+                 parameters=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         False, name)
+        self._l1, self._l2 = l1, l2
+
+    def _init_slot(self, p):
+        return {}
+
+    def _update(self, p, g, slots, lr, step, name):
+        prox = p - lr * g
+        new_p = jnp.sign(prox) * jnp.maximum(
+            jnp.abs(prox) - lr * self._l1, 0.0) / (1.0 + lr * self._l2)
+        return new_p, slots
+
+
+class DecayedAdagrad(Optimizer):
+    """Reference: decayed_adagrad_op — adagrad with a decaying
+    accumulator: acc = decay*acc + (1-decay)*g^2."""
+
+    def __init__(self, learning_rate=0.001, decay=0.95, epsilon=1e-6,
+                 parameters=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         False, name)
+        self._decay, self._eps = decay, epsilon
+
+    def _init_slot(self, p):
+        return {"moment": jnp.zeros_like(p)}
+
+    def _update(self, p, g, slots, lr, step, name):
+        acc = self._decay * slots["moment"] + \
+            (1.0 - self._decay) * jnp.square(g)
+        return p - lr * g / (jnp.sqrt(acc) + self._eps), {"moment": acc}

@@ -392,10 +392,15 @@ def tdm_sampler(x, neg_samples_num_list, layer_node_num_list,
         row_i, row_l = [], []
         for li, neg_n in enumerate(neg_samples_num_list):
             pos = int(travel[item, li])
+            padded = pos == 0   # travel padding: no positive this layer
             if output_positive:
                 row_i.append(pos)
-                row_l.append(1)
+                row_l.append(0 if padded else 1)
             pool = layers[li]
+            if padded:
+                row_i.extend([0] * neg_n)
+                row_l.extend([0] * neg_n)
+                continue
             cand = pool[pool != pos]
             take = min(neg_n, len(cand))
             row_i.extend(rs.choice(cand, size=take, replace=False)
@@ -437,10 +442,10 @@ def match_matrix_tensor(x, y, w, lengths_x=None, lengths_y=None):
     channel t; padded positions zeroed."""
     x = jnp.asarray(x)                           # [B, Lx, D]
     y = jnp.asarray(y)                           # [B, Ly, D]
-    W = jnp.asarray(w)                           # [T, D, D] or [D, T, D]
-    if W.ndim == 3 and W.shape[0] == x.shape[-1]:
-        W = jnp.swapaxes(W, 0, 1)                # -> [T, D, D]
-    out = jnp.einsum("bid,tde,bje->btij", x, W, y)
+    # reference weight layout (match_matrix_tensor_op.cc:58): [D, T, D]
+    # with dim_t in the middle — no shape sniffing
+    W = jnp.asarray(w)                           # [D, T, D]
+    out = jnp.einsum("bid,dte,bje->btij", x, W, y)
     if lengths_x is not None:
         mx = sequence_mask(jnp.asarray(lengths_x), x.shape[1],
                            dtype=out.dtype)

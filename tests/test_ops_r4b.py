@@ -327,7 +327,8 @@ class TestFinalStragglers:
         import paddle_tpu.tensor.sequence as S
         x = jnp.ones((1, 2, 3))
         y = jnp.ones((1, 4, 3))
-        w = jnp.stack([jnp.eye(3), 2 * jnp.eye(3)])
+        # reference layout [D, T, D] (dim_t in the middle)
+        w = jnp.stack([jnp.eye(3), 2 * jnp.eye(3)]).transpose(1, 0, 2)
         out = S.match_matrix_tensor(x, y, w)
         assert out.shape == (1, 2, 2, 4)
         np.testing.assert_allclose(np.asarray(out[0, 0]), 3.0)
@@ -390,3 +391,79 @@ class TestFinalStragglers:
         want_sin = [np.sin(1.0 / 10000 ** (k / 3.0)) for k in range(4)]
         np.testing.assert_allclose(np.asarray(pe[0, 1, :4]), want_sin,
                                    rtol=1e-5)
+
+
+class TestOptimizerKernels1x:
+    """The 1.x optimizer kernel family (operators/optimizers/): each
+    update rule drives a quadratic to ~0 and matches its slot shapes."""
+
+    @pytest.mark.parametrize("cls,kw", [
+        ("Ftrl", dict(learning_rate=0.5)),
+        ("Dpsgd", dict(learning_rate=0.1, sigma=0.0)),
+        ("ProximalAdagrad", dict(learning_rate=0.5)),
+        ("ProximalGD", dict(learning_rate=0.1)),
+        ("DecayedAdagrad", dict(learning_rate=0.5)),
+    ])
+    def test_converges(self, cls, kw):
+        opt = getattr(pt.optimizer, cls)(**kw)
+        params = {"w": jnp.asarray([3.0, -2.0])}
+        st = opt.init_state(params)
+
+        def loss(p):
+            return jnp.sum(p["w"] ** 2)
+
+        l0 = float(loss(params))
+        for _ in range(30):
+            g = jax.grad(loss)(params)
+            params, st = opt.apply(params, g, st)
+        assert float(loss(params)) < l0 * 0.5
+
+    def test_proximal_l1_sparsifies(self):
+        opt = pt.optimizer.ProximalGD(learning_rate=0.5, l1=1.0)
+        params = {"w": jnp.asarray([0.1, 5.0])}
+        st = opt.init_state(params)
+        g = {"w": jnp.zeros(2)}
+        params, st = opt.apply(params, g, st)
+        assert float(params["w"][0]) == 0.0     # shrunk to exactly 0
+        assert float(params["w"][1]) > 0.0
+
+    def test_ftrl_l1_sparsifies(self):
+        opt = pt.optimizer.Ftrl(learning_rate=0.5, l1=10.0)
+        params = {"w": jnp.asarray([0.05])}
+        st = opt.init_state(params)
+        for _ in range(3):
+            g = jax.grad(lambda p: jnp.sum(p["w"] ** 2))(params)
+            params, st = opt.apply(params, g, st)
+        assert float(params["w"][0]) == 0.0
+
+    def test_tdm_sampler_padded_travel_masks(self):
+        import paddle_tpu.tensor.sequence as S
+        travel = np.asarray([[1, 0]])       # padded at layer 2
+        layers = [np.asarray([1, 2]), np.asarray([3, 4])]
+        ids, lab, mask = S.tdm_sampler(jnp.asarray([0]), [1, 1], [2, 2],
+                                       travel, layers, seed=0)
+        row_l = lab[0].tolist()
+        row_m = mask[0].tolist()
+        assert row_l[2] == 0 and row_m[2] == 0   # padded positive masked
+
+    def test_ftrl_matches_reference_l2(self):
+        """FTRL quadratic term is 2*l2 (ftrl_op.h:92)."""
+        opt = pt.optimizer.Ftrl(learning_rate=1.0, l2=0.5)
+        params = {"w": jnp.asarray([1.0])}
+        st = opt.init_state(params)
+        g = {"w": jnp.asarray([0.5])}
+        params, st = opt.apply(params, g, st)
+        # hand: n=0.25 sigma=0.5 z=0.5-0.5 = 0; |z|<=l1(0) -> w=0? l1=0:
+        # w = -z/(2*l2 + sqrt(n)/lr) = 0/(1+0.5) = 0
+        assert float(params["w"][0]) == pytest.approx(0.0)
+
+    def test_proximal_adagrad_plain_lr_shrinkage(self):
+        """Shrinkage threshold is lr*l1, not the adaptive lr
+        (proximal_adagrad_op.h:55)."""
+        opt = pt.optimizer.ProximalAdagrad(learning_rate=0.5, l1=0.1)
+        params = {"w": jnp.asarray([1.0])}
+        st = opt.init_state(params)
+        g = {"w": jnp.asarray([2.0])}   # large accumulated grad
+        params, st = opt.apply(params, g, st)
+        # prox = 1 - 0.5*2/2 = 0.5; shrink by lr*l1 = 0.05 -> 0.45
+        assert float(params["w"][0]) == pytest.approx(0.45, abs=1e-6)
