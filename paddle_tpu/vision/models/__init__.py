@@ -16,6 +16,13 @@ from .resnet import (  # noqa: F401
     resnet152,
     wide_resnet50_2,
 )
+from .rcnn import (  # noqa: F401
+    FPN,
+    FasterRCNN,
+    MaskHead,
+    faster_rcnn,
+    mask_rcnn,
+)
 from .yolo import (  # noqa: F401
     DarkNet53,
     YOLOv3,

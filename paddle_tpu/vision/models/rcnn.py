@@ -1,0 +1,367 @@
+"""Faster R-CNN (+ optional mask head) — two-stage detection family.
+
+Reference mapping: the reference ships the op layer for this family in
+core (`operators/detection/`: anchor_generator, rpn_target_assign,
+generate_proposals, generate_proposal_labels, roi_align,
+generate_mask_labels, box_coder), with model assembly in
+PaddleDetection. Here the assembly is TPU-first on exactly those ops'
+paddle_tpu ports (vision/ops.py):
+
+  * one fused backbone+FPN forward (ResNet trunk, channels-last capable);
+  * RPN head over every FPN level with shared conv;
+  * STATIC-SHAPE two-stage training: proposals/sampling use the
+    fixed-capacity contracts of generate_proposals /
+    generate_proposal_labels (masked rows, no dynamic shapes), so the
+    whole training step jits into one XLA program;
+  * RoIAlign pooling + 2-FC box head (+ small mask head when
+    `with_mask`).
+
+Anchor/target hyperparameters follow the Faster R-CNN defaults.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...nn import functional as F
+from ...nn.layer import Layer
+from ...nn.layer_common import Linear
+from ...nn.layer_conv_norm import Conv2D
+from .. import ops as V
+from .resnet import resnet18, resnet50
+
+
+class FPN(Layer):
+    """Feature pyramid (reference assembly; lateral 1x1 + top-down)."""
+
+    def __init__(self, in_channels: List[int], out_channel: int = 256):
+        super().__init__()
+        self.laterals = [Conv2D(c, out_channel, 1) for c in in_channels]
+        self.outputs = [Conv2D(out_channel, out_channel, 3, padding=1)
+                        for _ in in_channels]
+        for i, l in enumerate(self.laterals):
+            setattr(self, f"lateral{i}", l)
+        for i, o in enumerate(self.outputs):
+            setattr(self, f"output{i}", o)
+
+    def forward(self, feats):
+        lat = [l(f) for l, f in zip(self.laterals, feats)]
+        for i in range(len(lat) - 2, -1, -1):
+            b, c, h, w = lat[i].shape
+            up = jax.image.resize(lat[i + 1], (b, c, h, w), "nearest")
+            lat[i] = lat[i] + up
+        return [o(x) for o, x in zip(self.outputs, lat)]
+
+
+class RPNHead(Layer):
+    """Shared 3x3 conv + objectness/delta 1x1s over each level."""
+
+    def __init__(self, channel: int = 256, num_anchors: int = 3):
+        super().__init__()
+        self.conv = Conv2D(channel, channel, 3, padding=1)
+        self.cls = Conv2D(channel, num_anchors, 1)
+        self.reg = Conv2D(channel, num_anchors * 4, 1)
+
+    def forward(self, feats):
+        outs = []
+        for f in feats:
+            h = F.relu(self.conv(f))
+            outs.append((self.cls(h), self.reg(h)))
+        return outs
+
+
+class BoxHead(Layer):
+    """2-FC head: class scores + per-class box deltas."""
+
+    def __init__(self, in_dim: int, num_classes: int, fc_dim: int = 1024):
+        super().__init__()
+        self.fc1 = Linear(in_dim, fc_dim)
+        self.fc2 = Linear(fc_dim, fc_dim)
+        self.cls = Linear(fc_dim, num_classes)
+        self.reg = Linear(fc_dim, num_classes * 4)
+
+    def forward(self, x):
+        x = F.relu(self.fc1(x))
+        x = F.relu(self.fc2(x))
+        return self.cls(x), self.reg(x)
+
+
+class MaskHead(Layer):
+    """4-conv + deconv mask head (Mask R-CNN)."""
+
+    def __init__(self, channel: int = 256, num_classes: int = 81):
+        super().__init__()
+        self.convs = [Conv2D(channel, channel, 3, padding=1)
+                      for _ in range(4)]
+        for i, c in enumerate(self.convs):
+            setattr(self, f"conv{i}", c)
+        from ...nn.layer_conv_norm import Conv2DTranspose
+        self.deconv = Conv2DTranspose(channel, channel, 2, stride=2)
+        self.predict = Conv2D(channel, num_classes, 1)
+
+    def forward(self, x):
+        for c in self.convs:
+            x = F.relu(c(x))
+        x = F.relu(self.deconv(x))
+        return self.predict(x)
+
+
+class FasterRCNN(Layer):
+    """Two-stage detector on the ported reference detection ops.
+
+    Single-image static-shape contract (batch loops vmap/scan outside):
+    `training_losses(image, gt_boxes, gt_classes)` returns the loss
+    dict; `predict(image)` returns (boxes, scores, labels) at fixed
+    capacity.
+    """
+
+    def __init__(self, num_classes: int = 81, backbone: str = "resnet18",
+                 fpn_channel: int = 64, pool_resolution: int = 7,
+                 rpn_post_nms: int = 64, rcnn_batch: int = 32,
+                 anchor_sizes=(32.0,), aspect_ratios=(0.5, 1.0, 2.0),
+                 with_mask: bool = False):
+        super().__init__()
+        trunk = resnet50() if backbone == "resnet50" else resnet18()
+        self.conv1, self.bn1 = trunk.conv1, trunk.bn1
+        self.maxpool = trunk.maxpool
+        self.layer1, self.layer2 = trunk.layer1, trunk.layer2
+        self.layer3, self.layer4 = trunk.layer3, trunk.layer4
+        cexp = 4 if backbone == "resnet50" else 1
+        chans = [64 * cexp, 128 * cexp, 256 * cexp, 512 * cexp]
+        self.fpn = FPN(chans, fpn_channel)
+        self.rpn = RPNHead(fpn_channel, len(anchor_sizes) *
+                           len(aspect_ratios))
+        self.box_head = BoxHead(fpn_channel * pool_resolution ** 2,
+                                num_classes)
+        self.mask_head = MaskHead(fpn_channel, num_classes) \
+            if with_mask else None
+        self.num_classes = num_classes
+        self.pool_resolution = pool_resolution
+        self.rpn_post_nms = rpn_post_nms
+        self.rcnn_batch = rcnn_batch
+        self.anchor_sizes = anchor_sizes
+        self.aspect_ratios = aspect_ratios
+        self.strides = (4, 8, 16, 32)
+
+    def forward(self, image, gt_boxes=None, gt_classes=None,
+                gt_masks=None):
+        """Training (gt given): the loss dict; else fixed-capacity
+        detections. Use with `nn.layer.functional_call` for the
+        pure-params training step."""
+        if gt_boxes is not None:
+            return self.training_losses(image, gt_boxes, gt_classes,
+                                        gt_masks=gt_masks)
+        return self.predict(image)
+
+    # ---- pieces -----------------------------------------------------
+
+    def backbone(self, x):
+        x = self.maxpool(F.relu(self.bn1(self.conv1(x))))
+        c2 = self.layer1(x)
+        c3 = self.layer2(c2)
+        c4 = self.layer3(c3)
+        c5 = self.layer4(c4)
+        return self.fpn([c2, c3, c4, c5])
+
+    def _anchors(self, feats):
+        out = []
+        for f, s in zip(feats, self.strides):
+            a, _ = V.anchor_generator(
+                (f.shape[2], f.shape[3]),
+                anchor_sizes=[sz * s / 4 for sz in self.anchor_sizes],
+                aspect_ratios=self.aspect_ratios, stride=(s, s))
+            out.append(jnp.reshape(a, (-1, 4)))
+        return out
+
+    def _proposals(self, feats, rpn_outs, im_hw, anchors_per_level):
+        """Top proposals across levels (fixed capacity)."""
+        all_rois, all_scores = [], []
+        per_level = max(self.rpn_post_nms // len(feats), 8)
+        for (cls, reg), anchors in zip(rpn_outs, anchors_per_level):
+            n, a, h, w = cls.shape
+            scores = jax.nn.sigmoid(jnp.reshape(
+                jnp.transpose(cls, (0, 2, 3, 1)), (-1,)))
+            deltas = jnp.reshape(jnp.transpose(
+                jnp.reshape(reg, (n, a, 4, h, w)), (0, 3, 4, 1, 2)),
+                (-1, 4))
+            var = jnp.full((anchors.shape[0], 4), 1.0, jnp.float32)
+            rois, rsc = V.generate_proposals(
+                scores, deltas, jnp.asarray(im_hw, jnp.float32), anchors,
+                var, pre_nms_top_n=min(256, scores.shape[0]),
+                post_nms_top_n=per_level, nms_thresh=0.7, min_size=1.0)
+            all_rois.append(rois)
+            all_scores.append(rsc)
+        rois, scores = V.collect_fpn_proposals(
+            all_rois, all_scores, self.rpn_post_nms)
+        return rois, scores
+
+    def _pool(self, feats, rois):
+        """Distribute rois to FPN levels, roi_align each, gather back."""
+        multi, masks, _ = V.distribute_fpn_proposals(
+            rois, min_level=0, max_level=3, refer_level=2,
+            refer_scale=224)
+        pooled = jnp.zeros((rois.shape[0], feats[0].shape[1],
+                            self.pool_resolution, self.pool_resolution),
+                           feats[0].dtype)
+        for lvl, (f, m, r) in enumerate(zip(feats, masks, multi)):
+            p = V.roi_align(f, r / float(self.strides[lvl]),
+                            output_size=self.pool_resolution)
+            pooled = jnp.where(m[:, None, None, None], p, pooled)
+        return pooled
+
+    # ---- training ---------------------------------------------------
+
+    def training_losses(self, image, gt_boxes, gt_classes,
+                        gt_masks=None):
+        """image [1, 3, H, W]; gt_boxes [G, 4] xyxy; gt_classes [G] int
+        (>0; 0 is background). gt_masks [G, H, W] {0,1} dense rasters
+        (host-rasterized once by the data pipeline, e.g. via
+        `ops.generate_mask_labels`'s polygon rasterizer) enable the
+        Mask R-CNN mask loss when the model has a mask head."""
+        feats = self.backbone(image)
+        rpn_outs = self.rpn(feats)
+        im_hw = (image.shape[2], image.shape[3])
+        anchors_per_level = self._anchors(feats)   # computed ONCE
+
+        # RPN losses over all levels' anchors
+        rpn_cls_losses, rpn_reg_losses = [], []
+        for (cls, reg), anchors in zip(rpn_outs, anchors_per_level):
+            labels, matched, miou = V.rpn_target_assign(
+                anchors, gt_boxes, rpn_batch_size_per_im=64)
+            n, a, h, w = cls.shape
+            logits = jnp.reshape(jnp.transpose(cls, (0, 2, 3, 1)), (-1,))
+            deltas = jnp.reshape(jnp.transpose(
+                jnp.reshape(reg, (n, a, 4, h, w)), (0, 3, 4, 1, 2)),
+                (-1, 4))
+            valid = labels >= 0
+            tgt = (labels == 1).astype(jnp.float32)
+            cls_l = F.binary_cross_entropy_with_logits(
+                logits, tgt, reduction="none")
+            rpn_cls_losses.append(
+                jnp.sum(jnp.where(valid, cls_l, 0.0)) /
+                jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0))
+            # reg loss on positives: smooth-l1 on encoded targets
+            mg = gt_boxes[matched]
+            aw = anchors[:, 2] - anchors[:, 0] + 1.0
+            ah = anchors[:, 3] - anchors[:, 1] + 1.0
+            acx = anchors[:, 0] + aw * 0.5
+            acy = anchors[:, 1] + ah * 0.5
+            gw = mg[:, 2] - mg[:, 0] + 1.0
+            gh = mg[:, 3] - mg[:, 1] + 1.0
+            gcx = mg[:, 0] + gw * 0.5
+            gcy = mg[:, 1] + gh * 0.5
+            t = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                           jnp.log(gw / aw), jnp.log(gh / ah)], -1)
+            pos = (labels == 1).astype(jnp.float32)[:, None]
+            reg_l = F.smooth_l1_loss(deltas, t, reduction="none") * pos
+            rpn_reg_losses.append(
+                jnp.sum(reg_l) / jnp.maximum(jnp.sum(pos) * 4.0, 1.0))
+
+        rois, _ = self._proposals(feats, rpn_outs, im_hw,
+                                  anchors_per_level)
+        rois, labels, bbox_t, fg, matched_gt = V.generate_proposal_labels(
+            rois, gt_classes, gt_boxes,
+            batch_size_per_im=self.rcnn_batch, fg_thresh=0.5,
+            class_nums=self.num_classes)
+        pooled = self._pool(feats, rois)
+        flat = jnp.reshape(pooled, (pooled.shape[0], -1))
+        cls_scores, box_deltas = self.box_head(flat)
+
+        valid = labels >= 0
+        safe = jnp.maximum(labels, 0)
+        ce = F.cross_entropy(cls_scores, safe, reduction="none")
+        rcnn_cls = jnp.sum(jnp.where(valid, ce, 0.0)) / \
+            jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+        # per-class reg: gather the matched class's 4 deltas
+        bd = jnp.reshape(box_deltas, (-1, self.num_classes, 4))
+        sel = jnp.take_along_axis(
+            bd, safe[:, None, None].repeat(4, -1), axis=1)[:, 0]
+        reg = F.smooth_l1_loss(sel, bbox_t, reduction="none") * \
+            fg.astype(jnp.float32)[:, None]
+        rcnn_reg = jnp.sum(reg) / jnp.maximum(
+            jnp.sum(fg.astype(jnp.float32)) * 4.0, 1.0)
+
+        losses = {"rpn_cls": sum(rpn_cls_losses) / len(rpn_cls_losses),
+                  "rpn_reg": sum(rpn_reg_losses) / len(rpn_reg_losses),
+                  "rcnn_cls": rcnn_cls, "rcnn_reg": rcnn_reg}
+        if self.mask_head is not None and gt_masks is not None:
+            # mask targets under jit: crop+resize the matched gt's dense
+            # raster to the mask head's output resolution via roi_align
+            mask_logits = self.mask_head(pooled)        # [R, C, 2r, 2r]
+            mr = mask_logits.shape[-1]
+            safe_gt = jnp.maximum(matched_gt, 0)
+            rasters = jnp.asarray(gt_masks, jnp.float32)[safe_gt]
+            per_roi = jax.vmap(
+                lambda m, r: V.roi_align(m[None, None], r[None],
+                                         output_size=mr)[0, 0])(
+                rasters, rois)
+            tgt = (per_roi > 0.5).astype(jnp.float32)   # [R, mr, mr]
+            sel_mask = jnp.take_along_axis(
+                mask_logits, safe[:, None, None, None].repeat(
+                    mr, -1).repeat(mr, -2), axis=1)[:, 0]
+            ml = F.binary_cross_entropy_with_logits(
+                sel_mask, tgt, reduction="none")
+            fgf = fg.astype(jnp.float32)[:, None, None]
+            losses["mask"] = jnp.sum(ml * fgf) / jnp.maximum(
+                jnp.sum(fgf) * mr * mr, 1.0)
+        losses["total"] = sum(v for k, v in losses.items()
+                              if k != "total")
+        return losses
+
+    # ---- inference --------------------------------------------------
+
+    def predict(self, image, score_threshold=0.05, keep_top_k=100):
+        """Fixed-capacity detections: ([keep_top_k, 6] rows
+        (class, score, x1, y1, x2, y2; -1 padding), num_kept)."""
+        feats = self.backbone(image)
+        rpn_outs = self.rpn(feats)
+        rois, _ = self._proposals(feats, rpn_outs,
+                                  (image.shape[2], image.shape[3]),
+                                  self._anchors(feats))
+        pooled = self._pool(feats, rois)
+        flat = jnp.reshape(pooled, (pooled.shape[0], -1))
+        cls_scores, box_deltas = self.box_head(flat)
+        probs = jax.nn.softmax(cls_scores, axis=-1)
+        var = jnp.full((rois.shape[0], 4), 1.0, jnp.float32)
+        decoded, assigned = V.box_decoder_and_assign(
+            rois, var, box_deltas, probs)
+        out, n = V.matrix_nms(assigned, probs[:, 1:].T,
+                              score_threshold=score_threshold,
+                              keep_top_k=keep_top_k)
+        # matrix_nms saw classes 1..C-1 as rows 0..: re-offset ids
+        out = out.at[:, 0].set(jnp.where(out[:, 0] >= 0,
+                                         out[:, 0] + 1.0, -1.0))
+        return out, n
+
+    def predict_masks(self, image):
+        """Per-RoI instance masks (Mask R-CNN): returns (rois [R, 4],
+        masks [R, 2r, 2r] sigmoid probabilities for each RoI's best
+        non-background class)."""
+        assert self.mask_head is not None, "built without with_mask"
+        feats = self.backbone(image)
+        rpn_outs = self.rpn(feats)
+        rois, _ = self._proposals(feats, rpn_outs,
+                                  (image.shape[2], image.shape[3]),
+                                  self._anchors(feats))
+        pooled = self._pool(feats, rois)
+        flat = jnp.reshape(pooled, (pooled.shape[0], -1))
+        cls_scores, _ = self.box_head(flat)
+        best = jnp.argmax(cls_scores[:, 1:], axis=1) + 1
+        mask_logits = self.mask_head(pooled)
+        mr = mask_logits.shape[-1]
+        sel = jnp.take_along_axis(
+            mask_logits, best[:, None, None, None].repeat(
+                mr, -1).repeat(mr, -2), axis=1)[:, 0]
+        return rois, jax.nn.sigmoid(sel)
+
+
+def faster_rcnn(num_classes: int = 81, **kw) -> FasterRCNN:
+    return FasterRCNN(num_classes=num_classes, **kw)
+
+
+def mask_rcnn(num_classes: int = 81, **kw) -> FasterRCNN:
+    kw.setdefault("with_mask", True)
+    return FasterRCNN(num_classes=num_classes, **kw)

@@ -991,7 +991,7 @@ def generate_proposal_labels(rpn_rois, gt_classes, gt_boxes,
         labels0 = jnp.concatenate([
             jnp.zeros((k,), jnp.int32), jnp.full((B - k,), -1, jnp.int32)])
         return (rois0, labels0, jnp.zeros((B, 4), jnp.float32),
-                jnp.zeros((B,), bool))
+                jnp.zeros((B,), bool), jnp.full((B,), -1, jnp.int32))
     rois = jnp.concatenate([jnp.asarray(rpn_rois).reshape(-1, 4), g])
     gcls = jnp.asarray(gt_classes).reshape(-1)
     iou = iou_similarity(rois, g)
@@ -1014,6 +1014,7 @@ def generate_proposal_labels(rpn_rois, gt_classes, gt_boxes,
     ok = jnp.concatenate([fg_ok, bg_ok])
     is_fg = jnp.concatenate([fg_ok, jnp.zeros_like(bg_ok)])
     out_rois = jnp.where(ok[:, None], rois[sel], 0.0)
+    matched_out = jnp.where(is_fg, matched[sel].astype(jnp.int32), -1)
     labels = jnp.where(is_fg, gcls[matched[sel]].astype(jnp.int32),
                        jnp.where(ok, 0, -1).astype(jnp.int32))
     # encode fg targets vs matched gt (encode_center_size w/ weights)
@@ -1033,7 +1034,7 @@ def generate_proposal_labels(rpn_rois, gt_classes, gt_boxes,
                    jnp.log(gw / rw) / wts[2],
                    jnp.log(gh / rh) / wts[3]], -1)
     bbox_targets = jnp.where(is_fg[:, None], t, 0.0)
-    return out_rois, labels, bbox_targets, is_fg
+    return out_rois, labels, bbox_targets, is_fg, matched_out
 
 
 def psroi_pool(x, boxes, output_channels, spatial_scale, pooled_height,
@@ -1269,3 +1270,55 @@ def roi_perspective_transform(x, rois, transformed_height,
         return out.reshape(c, th, tw)
 
     return jax.vmap(one)(q)
+
+
+def _rasterize_polygon(poly, ys, xs):
+    """Even-odd point-in-polygon over a grid (host numpy): poly flat
+    [x0, y0, x1, y1, ...]; ys/xs 1-D sample coords -> [len(ys), len(xs)]
+    bool."""
+    px = np.asarray(poly[0::2], np.float64)
+    py = np.asarray(poly[1::2], np.float64)
+    n = len(px)
+    gy, gx = np.meshgrid(ys, xs, indexing="ij")
+    inside = np.zeros(gy.shape, bool)
+    j = n - 1
+    for i in range(n):
+        cond = ((py[i] > gy) != (py[j] > gy))
+        denom = py[j] - py[i]
+        denom = np.where(np.abs(denom) < 1e-12, 1e-12, denom)
+        xint = (px[j] - px[i]) * (gy - py[i]) / denom + px[i]
+        inside ^= cond & (gx < xint)
+        j = i
+    return inside
+
+
+def generate_mask_labels(rois, labels, matched_gt, gt_polys,
+                         resolution=28):
+    """Mask R-CNN mask targets
+    (`detection/generate_mask_labels_op.cc` + mask_util.cc, simplified
+    single-image eager form): for each fg RoI (label > 0), rasterize its
+    matched gt polygon cropped to the RoI box at resolution^2.
+
+    rois [R, 4] xyxy; labels [R] int (0 bg, -1 pad); matched_gt [R] int
+    index into gt_polys; gt_polys: list of flat [x0,y0,x1,y1,...]
+    polygons (image coords). Returns (mask_targets
+    [R, resolution, resolution] float32 in {0,1} — zeros for non-fg,
+    fg_mask [R] bool)."""
+    rois_np = np.asarray(rois, np.float64)
+    labs = np.asarray(labels)
+    mi = np.asarray(matched_gt)
+    R = rois_np.shape[0]
+    out = np.zeros((R, resolution, resolution), np.float32)
+    fg = labs > 0
+    for r in range(R):
+        if not fg[r]:
+            continue
+        x1, y1, x2, y2 = rois_np[r]
+        w = max(x2 - x1, 1e-3)
+        h = max(y2 - y1, 1e-3)
+        # sample at output-cell centers inside the roi
+        ys = y1 + (np.arange(resolution) + 0.5) * h / resolution
+        xs = x1 + (np.arange(resolution) + 0.5) * w / resolution
+        poly = gt_polys[int(mi[r])]
+        out[r] = _rasterize_polygon(poly, ys, xs).astype(np.float32)
+    return jnp.asarray(out), jnp.asarray(fg)

@@ -243,7 +243,7 @@ class TestDetectionTranche2:
                             [100., 100., 110., 110.]])  # IoU 0 -> bg
         gt = jnp.asarray([[0., 0., 10., 10.]])
         cls = jnp.asarray([7])
-        out_rois, labels, targets, fg = V.generate_proposal_labels(
+        out_rois, labels, targets, fg, _ = V.generate_proposal_labels(
             rois, cls, gt, batch_size_per_im=4, fg_fraction=0.5,
             fg_thresh=0.5)
         got = labels.tolist()

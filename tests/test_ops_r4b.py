@@ -248,7 +248,7 @@ class TestReviewFixRegressions:
         empty = jnp.zeros((0, 4))
         labels, matched, miou = V.rpn_target_assign(anchors, empty)
         assert labels.tolist() == [0]
-        rois, lab, tg, fg = V.generate_proposal_labels(
+        rois, lab, tg, fg, _ = V.generate_proposal_labels(
             anchors, jnp.zeros((0,), jnp.int32), empty,
             batch_size_per_im=4)
         assert lab.tolist()[0] == 0 and not bool(fg.any())
@@ -257,7 +257,7 @@ class TestReviewFixRegressions:
         """fg targets use the +1 box-width convention (BoxToDelta)."""
         rois = jnp.asarray([[0., 0., 9., 9.]])
         gt = jnp.asarray([[0., 0., 10., 10.]])
-        _, lab, tg, fg = V.generate_proposal_labels(
+        _, lab, tg, fg, _m = V.generate_proposal_labels(
             rois, jnp.asarray([5]), gt, batch_size_per_im=4,
             fg_fraction=1.0, fg_thresh=0.5,
             bbox_reg_weights=(1., 1., 1., 1.))
@@ -467,3 +467,25 @@ class TestOptimizerKernels1x:
         params, st = opt.apply(params, g, st)
         # prox = 1 - 0.5*2/2 = 0.5; shrink by lr*l1 = 0.05 -> 0.45
         assert float(params["w"][0]) == pytest.approx(0.45, abs=1e-6)
+
+
+class TestMaskLabels:
+    def test_generate_mask_labels_half_square(self):
+        rois = jnp.asarray([[0., 0., 10., 10.], [0., 0., 4., 4.]])
+        polys = [[0., 0., 5., 0., 5., 10., 0., 10.]]
+        m, fg = V.generate_mask_labels(rois, jnp.asarray([1, 0]),
+                                       jnp.asarray([0, 0]), polys,
+                                       resolution=8)
+        assert fg.tolist() == [True, False]
+        got = np.asarray(m[0])
+        assert got[:, :4].min() == 1.0 and got[:, 4:].max() == 0.0
+        assert float(np.asarray(m[1]).max()) == 0.0
+
+    def test_generate_mask_labels_triangle(self):
+        rois = jnp.asarray([[0., 0., 8., 8.]])
+        polys = [[0., 0., 8., 0., 0., 8.]]     # upper-left triangle
+        m, fg = V.generate_mask_labels(rois, jnp.asarray([3]),
+                                       jnp.asarray([0]), polys,
+                                       resolution=16)
+        frac = float(np.mean(np.asarray(m[0])))
+        assert abs(frac - 0.5) < 0.1           # half the box filled

@@ -122,6 +122,13 @@ class TestSPTrainStep:
         l_ref = self._loss(lambda: build_mesh(dp=1))
         np.testing.assert_allclose(l_sp, l_ref, rtol=2e-4)
 
+    def test_sp_ulysses_loss_parity(self):
+        """Ulysses all-to-all mode inside the composed step."""
+        l_sp = self._loss(lambda: build_mesh(dp=2, sp=4),
+                          sequence_mode="ulysses")
+        l_ref = self._loss(lambda: build_mesh(dp=1))
+        np.testing.assert_allclose(l_sp, l_ref, rtol=2e-4)
+
     def test_sp_with_tp_and_zero(self):
         """4-way compose: dp(sharding) x tp x sp in ONE step."""
         l = self._loss(lambda: build_mesh(sharding=2, mp=2, sp=2),
@@ -154,10 +161,3 @@ class TestOffload:
         assert opt_state["step"].sharding.memory_kind == "device"
         assert all(v.sharding.memory_kind == "device"
                    for v in jax.tree.leaves(state[0]))
-
-    def test_sp_ulysses_loss_parity(self):
-        """Ulysses all-to-all mode inside the composed step."""
-        l_sp = self._loss(lambda: build_mesh(dp=2, sp=4),
-                          sequence_mode="ulysses")
-        l_ref = self._loss(lambda: build_mesh(dp=1))
-        np.testing.assert_allclose(l_sp, l_ref, rtol=2e-4)
