@@ -1,0 +1,84 @@
+"""Detection example: train the tiny Faster R-CNN on a synthetic
+"find the bright square" task (the two-stage pipeline the reference
+ecosystem builds from operators/detection/*), then decode detections.
+
+Run: python examples/detection_rcnn.py [--steps N]
+"""
+import argparse
+
+import numpy as np
+
+
+def _sample(rs, size=64):
+    img = rs.rand(1, 3, size, size).astype(np.float32) * 0.1
+    w = rs.randint(16, 28)
+    x0 = rs.randint(2, size - w - 2)
+    y0 = rs.randint(2, size - w - 2)
+    img[0, :, y0:y0 + w, x0:x0 + w] += 1.0
+    box = np.asarray([[x0, y0, x0 + w, y0 + w]], np.float32)
+    return img, box
+
+
+def main(steps=25):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.layer import (buffer_state, functional_call,
+                                     trainable_state)
+    from paddle_tpu.vision.models import faster_rcnn
+
+    paddle.seed(0)
+    model = faster_rcnn(num_classes=2, rpn_post_nms=16, rcnn_batch=8,
+                        fpn_channel=32)
+    model.train()
+    params = trainable_state(model)
+    buffers = buffer_state(model)
+    opt = paddle.optimizer.Adam(learning_rate=3e-4)
+    opt_state = opt.init_state(params)
+    gt_c = jnp.asarray([1])
+
+    @jax.jit
+    def step(params, opt_state, img, gt_b):
+        def loss_fn(p):
+            losses, _ = functional_call(model, p, img, gt_b, gt_c,
+                                        buffers=buffers)
+            return losses["total"]
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        params, opt_state = opt.apply(params, g, opt_state)
+        return params, opt_state, loss
+
+    rs = np.random.RandomState(0)
+    first = last = None
+    for i in range(steps):
+        img, box = _sample(rs)
+        params, opt_state, loss = step(params, opt_state,
+                                       jnp.asarray(img), jnp.asarray(box))
+        if first is None:
+            first = float(loss)
+        last = float(loss)
+        if i % 5 == 0:
+            print(f"step {i:3d} loss {float(loss):.4f}")
+
+    print(f"loss {first:.3f} -> {last:.3f}")
+
+    # decode one image
+    from paddle_tpu.nn.layer import load_state
+    load_state(model, params)
+    model.eval()
+    img, box = _sample(rs)
+    out, n = model.predict(jnp.asarray(img), score_threshold=0.05,
+                           keep_top_k=5)
+    print("gt box:", box[0].tolist())
+    print("detections kept:", int(n))
+    for row in np.asarray(out):
+        if row[0] >= 0:
+            print(f"  class {int(row[0])} score {row[1]:.3f} "
+                  f"box {row[2:].round(1).tolist()}")
+    return first, last
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=25)
+    main(ap.parse_args().steps)

@@ -71,3 +71,9 @@ def test_ocr_pipeline_example():
 def test_static_rnn_decode_example():
     import static_rnn_decode
     static_rnn_decode.main()   # asserts greedy decode == ground truth
+
+
+def test_detection_rcnn_example():
+    import detection_rcnn
+    first, last = detection_rcnn.main(steps=12)
+    assert last < first
