@@ -16,6 +16,10 @@ from .resnet import (  # noqa: F401
     resnet152,
     wide_resnet50_2,
 )
+from .ssd import (  # noqa: F401
+    SSD,
+    ssd,
+)
 from .rcnn import (  # noqa: F401
     FPN,
     FasterRCNN,

@@ -1,0 +1,196 @@
+"""SSD — single-shot multibox detector family.
+
+Reference mapping: op layer in core (`operators/detection/`: prior_box,
+iou_similarity, bipartite_match, target_assign, mine_hard_examples,
+box_coder, multiclass_nms — the `fluid/layers/detection.py ssd_loss` /
+`detection_output` assembly), models in the ecosystem. TPU-first
+assembly on the paddle_tpu ports: static shapes end to end — matching is
+masked argmax, OHEM is the `mine_hard_examples` rank mask, and the whole
+training step jits into one XLA program.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...nn import functional as F
+from ...nn.layer import Layer
+from ...nn.layer_conv_norm import BatchNorm2D, Conv2D
+from .. import ops as V
+
+
+class _ConvBN(Layer):
+    def __init__(self, cin, cout, k=3, stride=1):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, k, stride=stride, padding=k // 2,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(cout)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+class SSDBackbone(Layer):
+    """Small VGG-ish trunk emitting 3 scales (stride 8/16/32)."""
+
+    def __init__(self, base=32):
+        super().__init__()
+        self.b1 = _ConvBN(3, base)
+        self.b2 = _ConvBN(base, base, stride=2)          # /2
+        self.b3 = _ConvBN(base, base * 2, stride=2)      # /4
+        self.b4 = _ConvBN(base * 2, base * 2, stride=2)  # /8  -> f1
+        self.b5 = _ConvBN(base * 2, base * 4, stride=2)  # /16 -> f2
+        self.b6 = _ConvBN(base * 4, base * 4, stride=2)  # /32 -> f3
+
+    def forward(self, x):
+        x = self.b3(self.b2(self.b1(x)))
+        f1 = self.b4(x)
+        f2 = self.b5(f1)
+        f3 = self.b6(f2)
+        return [f1, f2, f3]
+
+
+class SSD(Layer):
+    """Single-image static-shape SSD.
+
+    training_losses(image [1,3,H,W], gt_boxes [G,4] NORMALIZED xyxy,
+    gt_classes [G] int > 0) -> loss dict; predict(image) -> fixed
+    capacity ([keep_top_k, 6], num_kept)."""
+
+    def __init__(self, num_classes: int = 21, base: int = 32,
+                 min_sizes=(0.1, 0.3, 0.6), max_sizes=(0.3, 0.6, 0.9),
+                 aspect_ratios=(2.0,), neg_pos_ratio: float = 3.0,
+                 variance=(0.1, 0.1, 0.2, 0.2)):
+        super().__init__()
+        self.backbone = SSDBackbone(base)
+        self.num_classes = num_classes
+        self.min_sizes = min_sizes
+        self.max_sizes = max_sizes
+        self.aspect_ratios = aspect_ratios
+        self.neg_pos_ratio = neg_pos_ratio
+        self.variance = variance
+        # priors per cell must mirror prior_box's dedup'd ratio
+        # expansion (ops.prior_box flip=True): [1.0] + each new ratio +
+        # its reciprocal, plus the sqrt(min*max) prior
+        ars = [1.0]
+        for ar in aspect_ratios:
+            if not any(abs(ar - a) < 1e-6 for a in ars):
+                ars.append(float(ar))
+                ars.append(1.0 / float(ar))
+        self.ppc = len(ars) + 1
+        chans = [base * 2, base * 4, base * 4]
+        self.loc_heads = [Conv2D(c, self.ppc * 4, 3, padding=1)
+                          for c in chans]
+        self.cls_heads = [Conv2D(c, self.ppc * num_classes, 3, padding=1)
+                          for c in chans]
+        for i, (l, c) in enumerate(zip(self.loc_heads, self.cls_heads)):
+            setattr(self, f"loc{i}", l)
+            setattr(self, f"cls{i}", c)
+
+    def forward(self, image, gt_boxes=None, gt_classes=None):
+        if gt_boxes is not None:
+            return self.training_losses(image, gt_boxes, gt_classes)
+        return self.predict(image)
+
+    # ---- pieces -----------------------------------------------------
+
+    def _heads(self, feats):
+        locs, confs = [], []
+        for f, lh, ch in zip(feats, self.loc_heads, self.cls_heads):
+            locs.append(jnp.reshape(jnp.transpose(
+                lh(f), (0, 2, 3, 1)), (-1, 4)))
+            confs.append(jnp.reshape(jnp.transpose(
+                ch(f), (0, 2, 3, 1)), (-1, self.num_classes)))
+        return jnp.concatenate(locs), jnp.concatenate(confs)
+
+    def _priors(self, feats, image_hw):
+        boxes = []
+        for i, f in enumerate(feats):
+            b, v = V.prior_box(
+                (f.shape[2], f.shape[3]), image_hw,
+                min_sizes=[self.min_sizes[i] * image_hw[0]],
+                max_sizes=[self.max_sizes[i] * image_hw[0]],
+                aspect_ratios=self.aspect_ratios, flip=True, clip=True)
+            boxes.append(jnp.reshape(b, (-1, 4)))  # already normalized
+        return jnp.concatenate(boxes)            # [P, 4] normalized
+
+    # ---- training (ssd_loss assembly) -------------------------------
+
+    def training_losses(self, image, gt_boxes, gt_classes):
+        feats = self.backbone(image)
+        locs, confs = self._heads(feats)
+        priors = self._priors(feats, (image.shape[2], image.shape[3]))
+        P = priors.shape[0]
+
+        iou = V.iou_similarity(priors, gt_boxes)          # [P, G]
+        best_iou = jnp.max(iou, axis=1)
+        matched = jnp.argmax(iou, axis=1)
+        # bipartite half of the reference's matching: each gt's best
+        # prior is positive AND is REASSIGNED to that gt (otherwise an
+        # overlapped gt could end with zero positives)
+        G = gt_boxes.shape[0]
+        best_prior = jnp.argmax(iou, axis=0)              # [G]
+        matched = matched.at[best_prior].set(jnp.arange(G))
+        forced = jnp.zeros((P,), bool).at[best_prior].set(True)
+        pos = (best_iou >= 0.5) | forced
+        match_idx = jnp.where(pos, matched, -1)
+
+        labels = jnp.where(pos, gt_classes[matched], 0)   # 0 = background
+        ce = F.cross_entropy(confs, labels, reduction="none")
+        neg_sel = V.mine_hard_examples(ce[None], match_idx[None],
+                                       neg_pos_ratio=self.neg_pos_ratio)[0]
+        n_pos = jnp.maximum(jnp.sum(pos.astype(jnp.float32)), 1.0)
+        conf_loss = jnp.sum(jnp.where(pos | neg_sel, ce, 0.0)) / n_pos
+
+        # localization: encode matched gts against priors (center-size
+        # with variance, the box_coder encode convention)
+        mg = gt_boxes[matched]
+        pw = priors[:, 2] - priors[:, 0]
+        ph = priors[:, 3] - priors[:, 1]
+        pcx = priors[:, 0] + pw * 0.5
+        pcy = priors[:, 1] + ph * 0.5
+        gw = jnp.maximum(mg[:, 2] - mg[:, 0], 1e-6)
+        gh = jnp.maximum(mg[:, 3] - mg[:, 1], 1e-6)
+        gcx = mg[:, 0] + gw * 0.5
+        gcy = mg[:, 1] + gh * 0.5
+        v = jnp.asarray(self.variance)
+        t = jnp.stack([(gcx - pcx) / pw / v[0], (gcy - pcy) / ph / v[1],
+                       jnp.log(gw / pw) / v[2],
+                       jnp.log(gh / ph) / v[3]], -1)
+        ll = F.smooth_l1_loss(locs, t, reduction="none") * \
+            pos.astype(jnp.float32)[:, None]
+        loc_loss = jnp.sum(ll) / n_pos
+
+        return {"conf": conf_loss, "loc": loc_loss,
+                "total": conf_loss + loc_loss}
+
+    # ---- inference (detection_output) -------------------------------
+
+    def predict(self, image, score_threshold=0.05, nms_threshold=0.45,
+                keep_top_k=100):
+        """detection_output: decode via the shared center-size coder,
+        scale to pixels (x by W, y by H), hard NMS at nms_threshold."""
+        from ..ops import _decode_center_size
+        feats = self.backbone(image)
+        locs, confs = self._heads(feats)
+        priors = self._priors(feats, (image.shape[2], image.shape[3]))
+        v = jnp.asarray(self.variance)
+        boxes = _decode_center_size(locs, priors, variances=v)
+        H, W = image.shape[2], image.shape[3]
+        scale = jnp.asarray([W, H, W, H], boxes.dtype)
+        boxes = jnp.clip(boxes, 0.0, 1.0) * scale
+        probs = jax.nn.softmax(confs, axis=-1)
+        out, n = V.multiclass_nms(boxes, probs[:, 1:].T,
+                                  score_threshold=score_threshold,
+                                  nms_threshold=nms_threshold,
+                                  keep_top_k=keep_top_k)
+        out = out.at[:, 0].set(jnp.where(out[:, 0] >= 0,
+                                         out[:, 0] + 1.0, -1.0))
+        return out, n
+
+
+def ssd(num_classes: int = 21, **kw) -> SSD:
+    return SSD(num_classes=num_classes, **kw)

