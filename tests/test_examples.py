@@ -2,6 +2,8 @@
 import os
 import sys
 
+import numpy as np
+
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "examples"))
 
@@ -77,3 +79,12 @@ def test_detection_rcnn_example():
     import detection_rcnn
     first, last = detection_rcnn.main(steps=12)
     assert last < first
+
+
+def test_dcgan_example():
+    import dcgan
+    hist, data_mean, fake_mean = dcgan.main(steps=40)
+    assert all(np.isfinite(d) and np.isfinite(g) for d, g in hist)
+    # generator MOVED toward the data distribution: closer to data_mean
+    # than a fresh (near-zero-mean) tanh generator starts
+    assert abs(fake_mean - data_mean) < 0.75 * abs(data_mean)
