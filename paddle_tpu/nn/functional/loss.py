@@ -362,3 +362,43 @@ def modified_huber_loss(input, label):
     z = jnp.asarray(input) * sign
     return jnp.where(z >= -1.0, jnp.square(jnp.maximum(1.0 - z, 0.0)),
                      -4.0 * z)
+
+
+def sample_logits(logits, label, num_samples, seed=0, remove_accidental_hits=True):
+    """Reference: `sample_logits_op.cc` — sampled-softmax prep for big
+    vocabularies: keep the true label's logit plus `num_samples`
+    uniformly sampled negatives, adjusted by -log(expected count) so
+    full-softmax probabilities are approximated.
+
+    logits [B, V]; label [B] int. Returns (sampled_logits
+    [B, 1 + num_samples], sampled_labels [B] (always 0: the true class
+    sits in column 0), sample_ids [B, num_samples])."""
+    from ...framework.random import next_key
+    B, V = logits.shape
+    # seed may be a TRACED value (fresh per jitted step); the 0-means-
+    # global-stream convention applies only to concrete host integers
+    # (python or numpy scalars)
+    import numpy as _np
+    if isinstance(seed, (int, _np.integer)) and int(seed) == 0:
+        key = next_key()
+    else:
+        key = jax.random.key(seed)
+    ids = jax.random.randint(key, (B, num_samples), 0, V)
+    true_logit = jnp.take_along_axis(logits, label[:, None], axis=1)
+    neg = jnp.take_along_axis(logits, ids, axis=1)
+    # uniform sampling: Q(y) = num_samples / V; subtract log-expected
+    logq = jnp.log(jnp.asarray(num_samples / V, logits.dtype))
+    neg = neg - logq
+    if remove_accidental_hits:
+        neg = jnp.where(ids == label[:, None], -1e20, neg)
+    out = jnp.concatenate([true_logit - logq, neg], axis=1)
+    return out, jnp.zeros((B,), jnp.int32), ids
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       seed=0):
+    """Sampled-softmax CE (the training use of `sample_logits`):
+    mean CE of the true class against sampled negatives."""
+    s_logits, s_labels, _ = sample_logits(logits, label, num_samples,
+                                          seed=seed)
+    return cross_entropy(s_logits, s_labels)

@@ -481,3 +481,37 @@ def pyramid_hash(x, num_emb, space_len, pyramid_layer, rand_len=16,
         emb = table[idx]                         # [B, T-n+1, num_emb]
         out = out.at[:, :T - n + 1].add(emb)
     return out
+
+
+def batch_fc(input, w, bias=None):
+    """Reference: `batch_fc_op.cc` (PaddleRec slot-wise FC):
+    x [slot, B, in] @ w [slot, in, out] (+ bias [slot, out])."""
+    x = jnp.asarray(input)
+    out = jnp.einsum("sbi,sio->sbo", x, jnp.asarray(w))
+    if bias is not None:
+        out = out + jnp.asarray(bias)[:, None, :]
+    return out
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0):
+    """Reference: `filter_by_instag_op.cc` — keep rows whose tag set
+    intersects `filter_tag` (eager host op like the reference's CPU
+    kernel). ins [N, D]; ins_tag: list of per-row tag lists (or [N]
+    ints); filter_tag: iterable of tags. Returns (filtered rows,
+    kept row indices, loss_weight [kept, 1])."""
+    x = np.asarray(ins)
+    want = set(int(t) for t in np.asarray(filter_tag).reshape(-1))
+    keep = []
+    for i in range(x.shape[0]):
+        tags = ins_tag[i] if isinstance(ins_tag, (list, tuple)) \
+            else [ins_tag[i]]
+        if want & set(int(t) for t in np.asarray(tags).reshape(-1)):
+            keep.append(i)
+    if not keep:
+        out = np.full((1,) + x.shape[1:], out_val_if_empty, x.dtype)
+        return (jnp.asarray(out), jnp.asarray([0]),
+                jnp.zeros((1, 1), jnp.float32))
+    out = x[np.asarray(keep)]
+    return (jnp.asarray(out), jnp.asarray(np.asarray(keep)),
+            jnp.ones((len(keep), 1), jnp.float32))

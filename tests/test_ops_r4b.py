@@ -489,3 +489,49 @@ class TestMaskLabels:
                                        resolution=16)
         frac = float(np.mean(np.asarray(m[0])))
         assert abs(frac - 0.5) < 0.1           # half the box filled
+
+
+class TestSampledSoftmaxAndRecOps:
+    def test_sample_logits_layout(self):
+        import paddle_tpu.nn.functional.loss as L
+        pt.seed(0)
+        logits = jnp.asarray(np.random.RandomState(0).randn(4, 100),
+                             jnp.float32)
+        label = jnp.asarray([3, 50, 7, 99])
+        out, lab, ids = L.sample_logits(logits, label, 20)
+        assert out.shape == (4, 21)
+        assert lab.tolist() == [0] * 4
+        # true logit in column 0, shifted by -log(Q) (uniform sampling)
+        np.testing.assert_allclose(
+            np.asarray(out[:, 0]) - np.asarray(
+                jnp.take_along_axis(logits, label[:, None], 1)[:, 0]),
+            -np.log(20 / 100), rtol=1e-5)
+
+    def test_sampled_softmax_gradient_direction(self):
+        import paddle_tpu.nn.functional.loss as L
+        pt.seed(0)
+        logits = jnp.asarray(np.random.RandomState(0).randn(4, 100),
+                             jnp.float32)
+        label = jnp.asarray([3, 50, 7, 99])
+        g = jax.grad(lambda lg: L.sampled_softmax_with_cross_entropy(
+            lg, label, 20, seed=1))(logits)
+        assert float(g[0, 3]) < 0   # raising the true logit helps
+
+    def test_batch_fc(self):
+        import paddle_tpu.tensor.sequence as S
+        out = S.batch_fc(jnp.ones((3, 2, 4)), jnp.ones((3, 4, 5)),
+                         jnp.ones((3, 5)))
+        assert out.shape == (3, 2, 5)
+        np.testing.assert_allclose(np.asarray(out), 5.0)
+
+    def test_filter_by_instag(self):
+        import paddle_tpu.tensor.sequence as S
+        rows, idx, w = S.filter_by_instag(
+            np.eye(4, dtype=np.float32), [[1], [2], [1, 3], [4]], [1])
+        assert idx.tolist() == [0, 2]
+        assert rows.shape == (2, 4) and w.shape == (2, 1)
+        # empty intersection: the documented fallback row
+        rows, idx, w = S.filter_by_instag(
+            np.eye(2, dtype=np.float32), [[5], [6]], [1],
+            out_val_if_empty=7)
+        assert float(rows[0, 0]) == 7.0 and float(w[0, 0]) == 0.0
