@@ -599,11 +599,13 @@ def iou_similarity(x, y, box_normalized=True):
     return inter / jnp.maximum(ax[:, None] + ay[None, :] - inter, 1e-10)
 
 
-def box_clip(input, im_info, name=None):
+def box_clip(input, im_info, pixel_offset=True, name=None):
     """Clip boxes to image bounds (`detection/box_clip_op.cc`).
     input [..., 4] xyxy; im_info [3] = (h, w, scale) — boxes live in the
     ORIGINAL image, so bounds are round(h/scale)-1 / round(w/scale)-1
-    (the reference's GetImInfo); [2] = (h, w) clips to h-1/w-1."""
+    (the reference's GetImInfo); [2] = (h, w) clips to h-1/w-1.
+    pixel_offset=False drops the -1 (v2 / `generate_proposals_v2_op.cc`
+    semantics: bounds are [0, w] / [0, h])."""
     b = jnp.asarray(input)
     info = jnp.asarray(im_info, b.dtype).reshape(-1)
     if info.shape[0] >= 3:
@@ -611,10 +613,11 @@ def box_clip(input, im_info, name=None):
         w = jnp.round(info[1] / info[2])
     else:
         h, w = info[0], info[1]
-    return jnp.stack([jnp.clip(b[..., 0], 0.0, w - 1),
-                      jnp.clip(b[..., 1], 0.0, h - 1),
-                      jnp.clip(b[..., 2], 0.0, w - 1),
-                      jnp.clip(b[..., 3], 0.0, h - 1)], axis=-1)
+    off = 1.0 if pixel_offset else 0.0
+    return jnp.stack([jnp.clip(b[..., 0], 0.0, w - off),
+                      jnp.clip(b[..., 1], 0.0, h - off),
+                      jnp.clip(b[..., 2], 0.0, w - off),
+                      jnp.clip(b[..., 3], 0.0, h - off)], axis=-1)
 
 
 def bipartite_match(dist_matrix):
@@ -728,11 +731,13 @@ def polygon_box_transform(input, name=None):
 def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
                        pre_nms_top_n=6000, post_nms_top_n=1000,
                        nms_thresh=0.5, min_size=0.1, eta=1.0,
-                       name=None):
-    """RPN proposal generation (`detection/generate_proposals_op.cc`),
-    static-shape XLA form: top-k -> decode -> clip -> size-filter ->
-    fixed-size NMS. scores [A*H*W] (objectness, single image),
-    bbox_deltas [A*H*W, 4], anchors/variances [A*H*W, 4].
+                       pixel_offset=True, name=None):
+    """RPN proposal generation (`detection/generate_proposals_op.cc`;
+    pixel_offset=False gives `detection/generate_proposals_v2_op.cc`
+    semantics — no +1 pixel widths, clip to [0, w] instead of
+    [0, w-1]), static-shape XLA form: top-k -> decode -> clip ->
+    size-filter -> fixed-size NMS. scores [A*H*W] (objectness, single
+    image), bbox_deltas [A*H*W, 4], anchors/variances [A*H*W, 4].
     Returns (rois [post_nms_top_n, 4], roi_scores [post_nms_top_n]) —
     trailing rows score 0 when fewer survive (the fixed-capacity pad of
     this framework's detection contract)."""
@@ -743,12 +748,32 @@ def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
     top = min(pre_nms_top_n, s.shape[0])
     sc, order = jax.lax.top_k(s, top)
     d, a, v = d[order], a[order], v[order]
-    # box_coder decode_center_size semantics (+1 box widths)
-    boxes = _decode_center_size(d, a, variances=v, plus_one=1.0)
-    boxes = box_clip(boxes, im_shape)
-    ww = boxes[:, 2] - boxes[:, 0] + 1.0
-    hh = boxes[:, 3] - boxes[:, 1] + 1.0
-    valid = (ww >= min_size) & (hh >= min_size)
+    off = 1.0 if pixel_offset else 0.0
+    # box_coder decode_center_size semantics (+1 widths, -1 max corner
+    # under v1)
+    boxes = _decode_center_size(d, a, variances=v, plus_one=off)
+    # im_shape may be (h, w) or v1's im_info (h, w, scale); the clip is
+    # against the SCALED image either way (reference clip_tiled_boxes
+    # gets im_info[:2] verbatim) — scale only rescales the size filter.
+    info = jnp.asarray(im_shape, boxes.dtype).reshape(-1)
+    h, w = info[0], info[1]
+    scale = info[2] if info.shape[0] >= 3 else jnp.asarray(1.0, boxes.dtype)
+    boxes = box_clip(boxes, jnp.stack([h, w]), pixel_offset=pixel_offset)
+    # reference filter_boxes: min_size clamps to >= 1; under v1 the box
+    # sides are measured at the ORIGINAL image scale and centers must
+    # fall inside the image.
+    min_size = max(min_size, 1.0)
+    ww = boxes[:, 2] - boxes[:, 0] + off
+    hh = boxes[:, 3] - boxes[:, 1] + off
+    if pixel_offset:
+        ww_orig = (boxes[:, 2] - boxes[:, 0]) / scale + 1.0
+        hh_orig = (boxes[:, 3] - boxes[:, 1]) / scale + 1.0
+        x_ctr = boxes[:, 0] + ww * 0.5
+        y_ctr = boxes[:, 1] + hh * 0.5
+        valid = ((ww_orig >= min_size) & (hh_orig >= min_size)
+                 & (x_ctr < w) & (y_ctr < h))
+    else:
+        valid = (ww >= min_size) & (hh >= min_size)
     sc = jnp.where(valid, sc, -1.0)
     keep = nms(boxes, sc, iou_threshold=nms_thresh) & valid
     masked = jnp.where(keep, sc, -jnp.inf)
@@ -911,7 +936,10 @@ def _decode_center_size(deltas, anchors, variances=None, plus_one=0.0,
                         clamp=10.0):
     """Variance-aware center-size delta decode shared by
     generate_proposals / retinanet_detection_output (the functional core
-    of box_coder's decode_center_size for flat [N, 4] inputs)."""
+    of box_coder's decode_center_size for flat [N, 4] inputs).
+    plus_one=1 is the un-normalized pixel-box convention: widths are
+    measured +1 AND the max corner comes back -1 (reference box_coder:
+    `proposals[:, 2] = cx + w/2 - offset`)."""
     a = anchors
     d = deltas
     aw = a[:, 2] - a[:, 0] + plus_one
@@ -924,7 +952,7 @@ def _decode_center_size(deltas, anchors, variances=None, plus_one=0.0,
     bw = jnp.exp(jnp.minimum(v[..., 2] * d[:, 2], clamp)) * aw
     bh = jnp.exp(jnp.minimum(v[..., 3] * d[:, 3], clamp)) * ah
     return jnp.stack([cx - bw / 2, cy - bh / 2,
-                      cx + bw / 2, cy + bh / 2], -1)
+                      cx + bw / 2 - plus_one, cy + bh / 2 - plus_one], -1)
 
 
 def retinanet_detection_output(bboxes, scores, anchors, im_info=None,
@@ -940,10 +968,16 @@ def retinanet_detection_output(bboxes, scores, anchors, im_info=None,
     ds = jnp.concatenate([jnp.asarray(b).reshape(-1, 4) for b in bboxes])
     ss = jnp.concatenate([jnp.asarray(s) for s in scores])     # [N, C]
     an = jnp.concatenate([jnp.asarray(a).reshape(-1, 4) for a in anchors])
-    # variance-free retinanet convention
-    boxes = _decode_center_size(ds, an)
+    # variance-free retinanet convention: +1 anchor widths, -1 max
+    # corner, boxes mapped back to the ORIGINAL image (divide by
+    # im_scale) before clipping to round(w/scale)-1 — reference kernel
+    # `retinanet_detection_output_op.cc:272-312`.
+    boxes = _decode_center_size(ds, an, plus_one=1.0)
     if im_info is not None:
-        boxes = box_clip(boxes, jnp.asarray(im_info))
+        info = jnp.asarray(im_info, boxes.dtype).reshape(-1)
+        if info.shape[0] >= 3:
+            boxes = boxes / info[2]
+        boxes = box_clip(boxes, info)
     sc = jnp.where(ss > score_threshold, ss, 0.0)              # [N, C]
     C = sc.shape[1]
     top = min(nms_top_k, sc.shape[0])

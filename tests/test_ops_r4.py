@@ -136,6 +136,57 @@ class TestDetectionTranche:
         assert (got[:-1] >= got[1:] - 1e-6).all()  # sorted
         assert got[0] == pytest.approx(float(scores.max()), abs=1e-6)
 
+    def test_generate_proposals_v2_pixel_offset(self):
+        """pixel_offset=False (`generate_proposals_v2_op.cc`): decode
+        without +1 widths, clip to [0, w] not [0, w-1]. One far-out
+        anchor must clip exactly to the image edge under each rule."""
+        anchors = np.asarray([[0., 0., 10., 10.],
+                              [40., 40., 60., 60.]], np.float32)
+        scores = np.asarray([0.9, 0.8], np.float32)
+        deltas = np.zeros((2, 4), np.float32)
+        var = np.ones((2, 4), np.float32)
+        common = dict(pre_nms_top_n=2, post_nms_top_n=2,
+                      nms_thresh=0.9, min_size=1.0)
+        rois_v2, _ = V.generate_proposals(
+            jnp.asarray(scores), jnp.asarray(deltas),
+            jnp.asarray([50., 50.]), jnp.asarray(anchors),
+            jnp.asarray(var), pixel_offset=False, **common)
+        rois_v1, _ = V.generate_proposals(
+            jnp.asarray(scores), jnp.asarray(deltas),
+            jnp.asarray([50., 50.]), jnp.asarray(anchors),
+            jnp.asarray(var), pixel_offset=True, **common)
+        # zero deltas: v2 decode is the anchor itself, clipped to 50
+        # (rows sorted by score: 0.9 -> anchor 0, 0.8 -> anchor 1)
+        np.testing.assert_allclose(np.asarray(rois_v2)[0],
+                                   [0., 0., 10., 10.], atol=1e-5)
+        np.testing.assert_allclose(np.asarray(rois_v2)[1],
+                                   [40., 40., 50., 50.], atol=1e-5)
+        # v1 clips the same far-out box to w-1 = 49
+        assert np.asarray(rois_v1)[1][2] == pytest.approx(49.0)
+
+    def test_generate_proposals_v1_scale_and_min_size(self):
+        """v1 filter_boxes measures sides at the ORIGINAL image scale
+        (side/scale + 1) and clamps min_size to >= 1 (reference
+        test_generate_proposals_op.py filter_boxes). At scale=2 a
+        4px box measures 3 (kept at min_size=3), a 2px box measures 2
+        (dropped)."""
+        anchors = np.asarray([[0., 0., 4., 4.],
+                              [10., 10., 12., 12.]], np.float32)
+        scores = np.asarray([0.9, 0.8], np.float32)
+        deltas = np.zeros((2, 4), np.float32)
+        var = np.ones((2, 4), np.float32)
+        rois, rsc = V.generate_proposals(
+            jnp.asarray(scores), jnp.asarray(deltas),
+            jnp.asarray([50., 50., 2.0]), jnp.asarray(anchors),
+            jnp.asarray(var), pre_nms_top_n=2, post_nms_top_n=2,
+            nms_thresh=0.9, min_size=3.0, pixel_offset=True)
+        rsc = np.asarray(rsc)
+        assert rsc[0] == pytest.approx(0.9)   # 4px box survives
+        assert rsc[1] == 0.0                  # 2px box filtered
+        # zero deltas + v1 (-1 max corner) decode the anchor exactly
+        np.testing.assert_allclose(np.asarray(rois)[0],
+                                   [0., 0., 4., 4.], atol=1e-5)
+
 
 class TestSequenceTranche:
     def test_sequence_expand_as(self):
@@ -237,6 +288,20 @@ class TestDetectionTranche2:
         assert got[0][0] == 0 and abs(got[0][1] - 0.9) < 1e-6
         assert got[1][0] == 1 and abs(got[1][1] - 0.7) < 1e-6
         np.testing.assert_allclose(got[0][2:], [0, 0, 10, 10], atol=1e-4)
+
+    def test_retinanet_detection_output_im_scale(self):
+        """im_info=(h, w, scale): decoded boxes map back to the ORIGINAL
+        image (divide by scale) before clipping
+        (`retinanet_detection_output_op.cc:304-312`)."""
+        anchors = [jnp.asarray([[0., 0., 10., 10.]])]
+        deltas = [jnp.zeros((1, 4))]
+        scores = [jnp.asarray([[0.9]])]
+        out, n = V.retinanet_detection_output(
+            deltas, scores, anchors, im_info=jnp.asarray([100., 100., 2.]),
+            keep_top_k=2)
+        got = np.asarray(out)
+        assert int(n) == 1
+        np.testing.assert_allclose(got[0][2:], [0, 0, 5, 5], atol=1e-4)
 
     def test_generate_proposal_labels(self):
         rois = jnp.asarray([[0., 0., 10., 10.],     # IoU 1 with gt0 -> fg
