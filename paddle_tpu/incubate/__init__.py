@@ -7,3 +7,9 @@ from .optimizer import (  # noqa: F401
     ModelAverage,
 )
 from . import checkpoint  # noqa: F401
+from . import contrib_ops  # noqa: F401
+from .contrib_ops import (  # noqa: F401
+    bilateral_slice,
+    rank_attention,
+    tree_conv,
+)

@@ -314,3 +314,54 @@ def detection_map(detect_res, label, class_num, background_label=0,
             ap = float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
         aps.append(ap)
     return float(np.mean(aps)) if aps else 0.0
+
+
+def positive_negative_pair(score, label, query_id, weight=None,
+                           accumulate=None, column=-1):
+    """Ranking pair statistics (`positive_negative_pair_op.h`): over all
+    unordered doc pairs sharing a query id whose labels DIFFER, count
+    concordant (positive), discordant (negative), and score-tied
+    (neutral) pairs, each weighted by the pair's mean weight. Faithful
+    to the reference kernel, a score tie adds its weight to BOTH the
+    neutral and the negative counter (the kernel's ternary runs after
+    the tie branch).
+
+    score [N, D] (the `column` selects which score column, negative
+    counts from the right), label [N, 1] or [N], query_id [N] int,
+    weight [N] optional, accumulate optional (pos, neg, neu) running
+    totals. Returns (positive, negative, neutral) scalars.
+    """
+    s = jnp.asarray(score)
+    if s.ndim == 2:
+        s = s[:, column]
+    else:
+        s = s.reshape(-1)
+    if not jnp.issubdtype(s.dtype, jnp.floating):
+        s = s.astype(jnp.float32)
+    l = jnp.asarray(label).reshape(-1).astype(s.dtype)
+    q = jnp.asarray(query_id).reshape(-1)
+    w = (jnp.ones_like(s) if weight is None
+         else jnp.asarray(weight).reshape(-1).astype(s.dtype))
+    n = s.shape[0]
+    idx = jnp.arange(n)
+
+    # O(N^2) pair work like the reference, but streamed one row at a
+    # time (lax.fori_loop) so memory stays O(N) — no N^2/2 index
+    # materialization for large eval batches.
+    def body(i, acc):
+        pos, neg, neu = acc
+        m = ((idx > i) & (q == q[i]) & (l != l[i])).astype(s.dtype) \
+            * (w + w[i]) * 0.5
+        ds = s[i] - s
+        dl = l[i] - l
+        pos = pos + jnp.sum(m * (ds * dl > 0.0).astype(s.dtype))
+        neg = neg + jnp.sum(m * (ds * dl <= 0.0).astype(s.dtype))
+        neu = neu + jnp.sum(m * (ds == 0.0).astype(s.dtype))
+        return pos, neg, neu
+
+    zero = jnp.asarray(0.0, s.dtype)
+    pos, neg, neu = jax.lax.fori_loop(0, n, body, (zero, zero, zero))
+    if accumulate is not None:
+        ap, an, au = accumulate
+        pos, neg, neu = pos + ap, neg + an, neu + au
+    return pos, neg, neu

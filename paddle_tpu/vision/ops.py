@@ -32,16 +32,19 @@ import numpy as np
 # IoU
 # ---------------------------------------------------------------------------
 
-def box_iou(boxes1, boxes2, eps: float = 1e-10):
-    """Pairwise IoU of [N,4] × [M,4] xyxy boxes → [N,M]."""
+def box_iou(boxes1, boxes2, eps: float = 1e-10, pixel_offset: bool = False):
+    """Pairwise IoU of [N,4] × [M,4] xyxy boxes → [N,M].
+    pixel_offset=True measures widths +1 (the reference's
+    JaccardOverlap(..., normalized=false), `detection/nms_util.h`)."""
+    off = 1.0 if pixel_offset else 0.0
     b1 = boxes1[:, None, :]
     b2 = boxes2[None, :, :]
     lt = jnp.maximum(b1[..., :2], b2[..., :2])
     rb = jnp.minimum(b1[..., 2:], b2[..., 2:])
-    wh = jnp.clip(rb - lt, 0.0)
+    wh = jnp.clip(rb - lt + off, 0.0)
     inter = wh[..., 0] * wh[..., 1]
-    a1 = (b1[..., 2] - b1[..., 0]) * (b1[..., 3] - b1[..., 1])
-    a2 = (b2[..., 2] - b2[..., 0]) * (b2[..., 3] - b2[..., 1])
+    a1 = (b1[..., 2] - b1[..., 0] + off) * (b1[..., 3] - b1[..., 1] + off)
+    a2 = (b2[..., 2] - b2[..., 0] + off) * (b2[..., 3] - b2[..., 1] + off)
     return inter / (a1 + a2 - inter + eps)
 
 
@@ -286,14 +289,16 @@ def roi_align(x, boxes, boxes_num=None, output_size=(1, 1),
 # NMS
 # ---------------------------------------------------------------------------
 
-def nms(boxes, scores, iou_threshold: float = 0.3):
+def nms(boxes, scores, iou_threshold: float = 0.3,
+        pixel_offset: bool = False):
     """Single-class NMS keep-mask (`nms` building block of
     `multiclass_nms_op.cc`). Returns a bool keep mask [N] — fixed shape;
-    callers top-k/pad as needed."""
+    callers top-k/pad as needed. pixel_offset selects the +1-width IoU
+    (`nms_util.h JaccardOverlap` normalized=false)."""
     n = boxes.shape[0]
     order = jnp.argsort(-scores)
     b = boxes[order]
-    ious = box_iou(b, b)
+    ious = box_iou(b, b, pixel_offset=pixel_offset)
 
     def body(i, keep):
         sup = (ious[i] > iou_threshold) & (jnp.arange(n) > i) & keep[i]
@@ -307,11 +312,13 @@ def nms(boxes, scores, iou_threshold: float = 0.3):
 
 def multiclass_nms(bboxes, scores, score_threshold: float = 0.01,
                    nms_threshold: float = 0.3, keep_top_k: int = 100,
-                   nms_top_k: int = 400, background_label: int = -1):
+                   nms_top_k: int = 400, background_label: int = -1,
+                   normalized: bool = True):
     """Multi-class NMS (`multiclass_nms_op.cc`) with the XLA contract:
     fixed-size output + valid count instead of LoD.
 
-    bboxes: [M, 4]; scores: [C, M] (per-class). Returns
+    bboxes: [M, 4]; scores: [C, M] (per-class). normalized=False uses
+    the +1-width pixel IoU (JaccardOverlap normalized=false). Returns
     (out [keep_top_k, 6] = (class, score, x1, y1, x2, y2) padded with
     -1/0, num_valid int) — reference output layout, dense.
     """
@@ -321,7 +328,8 @@ def multiclass_nms(bboxes, scores, score_threshold: float = 0.01,
     def per_class(c_scores):
         s = jnp.where(c_scores >= score_threshold, c_scores, 0.0)
         top_s, top_i = lax.top_k(s, k)
-        keep = nms(bboxes[top_i], top_s, nms_threshold)
+        keep = nms(bboxes[top_i], top_s, nms_threshold,
+                   pixel_offset=not normalized)
         keep = keep & (top_s > 0.0)
         return top_s * keep, top_i, keep
 
@@ -775,7 +783,8 @@ def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
     else:
         valid = (ww >= min_size) & (hh >= min_size)
     sc = jnp.where(valid, sc, -1.0)
-    keep = nms(boxes, sc, iou_threshold=nms_thresh) & valid
+    keep = nms(boxes, sc, iou_threshold=nms_thresh,
+               pixel_offset=pixel_offset) & valid
     masked = jnp.where(keep, sc, -jnp.inf)
     k = min(post_nms_top_n, masked.shape[0])
     best, sel = jax.lax.top_k(masked, k)
@@ -985,7 +994,9 @@ def retinanet_detection_output(bboxes, scores, anchors, im_info=None,
     def per_class(cls_scores):
         s, order = jax.lax.top_k(cls_scores, top)
         b = boxes[order]
-        keep = nms(b, s, iou_threshold=nms_threshold) & (s > 0)
+        # reference NMSFast uses JaccardOverlap(..., normalized=false)
+        keep = nms(b, s, iou_threshold=nms_threshold,
+                   pixel_offset=True) & (s > 0)
         return jnp.where(keep, s, 0.0), b
 
     s_cls, b_cls = jax.vmap(per_class)(sc.T)                   # [C, top]
@@ -1356,3 +1367,189 @@ def generate_mask_labels(rois, labels, matched_gt, gt_polys,
         poly = gt_polys[int(mi[r])]
         out[r] = _rasterize_polygon(poly, ys, xs).astype(np.float32)
     return jnp.asarray(out), jnp.asarray(fg)
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, batch_indices=None,
+               name=None):
+    """Precise RoI pooling (`prroi_pool_op.cc`, fluid.layers.prroi_pool;
+    PrRoIPool, "Acquisition of Localization Confidence for Accurate
+    Object Detection"): the EXACT integral of the bilinearly
+    interpolated feature over each bin, divided by the bin area — no
+    sampling grid.
+
+    TPU form: the bilinear surface is separable, so the 2-D integral
+    collapses to closed-form 1-D hat-function integrals
+    ``out[r,c,py,px] = sum_ij WY[r,py,i] WX[r,px,j] x[b_r,c,i,j] / area``
+    — two small weight tensors and one einsum (MXU work), differentiable
+    in BOTH the features and the roi coordinates (the reference ships a
+    hand-written coordinate backward; autodiff gives it here).
+
+    input [N, C, H, W]; rois [R, 4] xyxy; batch_indices [R] int
+    (batch_roi_nums [N] per-image counts also accepted). Output
+    [R, C, pooled_height, pooled_width].
+    """
+    x = jnp.asarray(input)
+    r = jnp.asarray(rois)
+    N, C, H, W = x.shape
+    R = r.shape[0]
+    ph, pw = int(pooled_height), int(pooled_width)
+    if batch_indices is None:
+        if batch_roi_nums is not None:
+            counts = jnp.asarray(batch_roi_nums, jnp.int32)
+            batch_indices = jnp.repeat(jnp.arange(N, dtype=jnp.int32),
+                                       counts, total_repeat_length=R)
+        else:
+            batch_indices = jnp.zeros((R,), jnp.int32)
+    else:
+        batch_indices = jnp.asarray(batch_indices, jnp.int32)
+
+    def hat_integral(a, b, size):
+        """integral of max(0, 1-|t-i|) over [a, b] for i in 0..size-1:
+        closed-form piecewise-quadratic, shape [..., size]."""
+        i = jnp.arange(size, dtype=x.dtype)
+        a = a[..., None]
+        b = b[..., None]
+        r1 = jnp.clip(a, i - 1.0, i)
+        r2 = jnp.clip(b, i - 1.0, i)
+        rise = ((r2 - (i - 1.0)) ** 2 - (r1 - (i - 1.0)) ** 2) * 0.5
+        f1 = jnp.clip(a, i, i + 1.0)
+        f2 = jnp.clip(b, i, i + 1.0)
+        fall = ((i + 1.0 - f1) ** 2 - (i + 1.0 - f2) ** 2) * 0.5
+        return rise + fall
+
+    def one_roi(box, bi):
+        x1 = box[0] * spatial_scale
+        y1 = box[1] * spatial_scale
+        x2 = box[2] * spatial_scale
+        y2 = box[3] * spatial_scale
+        bw = (x2 - x1) / pw
+        bh = (y2 - y1) / ph
+        px = jnp.arange(pw, dtype=x.dtype)
+        py = jnp.arange(ph, dtype=x.dtype)
+        wx = hat_integral(x1 + px * bw, x1 + (px + 1.0) * bw, W)  # [pw, W]
+        wy = hat_integral(y1 + py * bh, y1 + (py + 1.0) * bh, H)  # [ph, H]
+        acc = jnp.einsum("pi,qj,cij->cpq", wy, wx, x[bi])
+        # reference prroi_pool_op.h: win size clamps EACH side to >= 0
+        # before multiplying, so a roi inverted in both axes is still
+        # empty (area 0 -> output 0), not positive-area
+        area = jnp.maximum(bw, 0.0) * jnp.maximum(bh, 0.0)
+        return jnp.where(area > 0.0, acc / jnp.maximum(area, 1e-12), 0.0)
+
+    return jax.vmap(one_roi)(r.astype(x.dtype), batch_indices)
+
+
+def deformable_roi_pooling(input, rois, trans=None, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1,
+                           position_sensitive=False, batch_indices=None,
+                           name=None):
+    """Deformable (PS-)RoI pooling (`deformable_psroi_pooling_op.h`,
+    fluid.layers.deformable_roi_pooling): average-pool a
+    sample_per_part^2 grid per bin, each sample bilinearly interpolated
+    at a position shifted by the learned normalized offsets in `trans`
+    (scaled by trans_std and the roi size); position_sensitive selects
+    input channel (c_out*gh + by)*gw + bx per bin (R-FCN style).
+
+    input [N, C, H, W]; rois [R, 4] xyxy (image coords, un-scaled);
+    trans [R, 2*num_classes, part_h, part_w]; batch_indices [R] int.
+    Output [R, output_dim, pooled_height, pooled_width] with
+    output_dim = C // (gh*gw) when position_sensitive else C.
+    Samples falling outside [-0.5, size-0.5] are excluded from the
+    average (the kernel's `continue` + count divide). Differentiable in
+    input AND trans (offset grads via autodiff through the bilinear
+    sample positions).
+    """
+    x = jnp.asarray(input)
+    r = jnp.asarray(rois)
+    N, C, H, W = x.shape
+    R = r.shape[0]
+    gh, gw = (group_size if not isinstance(group_size, int)
+              else (group_size, group_size))
+    ph, pw = int(pooled_height), int(pooled_width)
+    sp = int(sample_per_part)
+    out_dim = C // (gh * gw) if position_sensitive else C
+    if part_size is None:
+        part_h, part_w = ph, pw
+    else:
+        part_h, part_w = (part_size if not isinstance(part_size, int)
+                          else (part_size, part_size))
+    if batch_indices is None:
+        batch_indices = jnp.zeros((R,), jnp.int32)
+    else:
+        batch_indices = jnp.asarray(batch_indices, jnp.int32)
+    if no_trans or trans is None:
+        num_classes = 1
+        tr = jnp.zeros((R, 2, part_h, part_w), x.dtype)
+    else:
+        tr = jnp.asarray(trans, x.dtype)
+        num_classes = tr.shape[1] // 2
+    ch_each_class = max(out_dim // num_classes, 1)
+
+    # static per-bin index maps
+    pyi = jnp.arange(ph)
+    pxi = jnp.arange(pw)
+    part_y = jnp.clip((pyi * part_h) // ph, 0, part_h - 1)    # [ph]
+    part_x = jnp.clip((pxi * part_w) // pw, 0, part_w - 1)    # [pw]
+    bin_gy = jnp.clip((pyi * gh) // ph, 0, gh - 1)            # [ph]
+    bin_gx = jnp.clip((pxi * gw) // pw, 0, gw - 1)            # [pw]
+    cts = jnp.arange(out_dim)
+    class_id = cts // ch_each_class                            # [out_dim]
+
+    def cround(v):
+        # C round(): half away from zero (jnp.round is half-to-even,
+        # which would shift the window a pixel at half-integer coords)
+        return jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
+
+    def one_roi(box, t, bi):
+        x1 = cround(box[0]) * spatial_scale - 0.5
+        y1 = cround(box[1]) * spatial_scale - 0.5
+        x2 = (cround(box[2]) + 1.0) * spatial_scale - 0.5
+        y2 = (cround(box[3]) + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bw = rw / pw
+        bh = rh / ph
+        sbw = bw / sp
+        sbh = bh / sp
+        # offsets per (out_c, ph, pw): trans[2*cls(+1), part_y, part_x]
+        tx = t[2 * class_id][:, part_y][:, :, part_x] * trans_std
+        ty = t[2 * class_id + 1][:, part_y][:, :, part_x] * trans_std
+        wstart = (pxi.astype(x.dtype) * bw + x1)[None, None, :] + tx * rw
+        hstart = (pyi.astype(x.dtype) * bh + y1)[None, :, None] + ty * rh
+        # sample grid [out_dim, ph, pw, sp, sp]
+        ws = wstart[..., None, None] + \
+            jnp.arange(sp, dtype=x.dtype)[None, None, None, None, :] * sbw
+        hs = hstart[..., None, None] + \
+            jnp.arange(sp, dtype=x.dtype)[None, None, None, :, None] * sbh
+        ok = ((ws >= -0.5) & (ws <= W - 0.5)
+              & (hs >= -0.5) & (hs <= H - 0.5))
+        wc = jnp.clip(ws, 0.0, W - 1.0)
+        hc = jnp.clip(hs, 0.0, H - 1.0)
+        x0 = jnp.floor(wc).astype(jnp.int32)
+        y0 = jnp.floor(hc).astype(jnp.int32)
+        x1i = jnp.ceil(wc).astype(jnp.int32)
+        y1i = jnp.ceil(hc).astype(jnp.int32)
+        dx = wc - x0
+        dy = hc - y0
+        if position_sensitive:
+            cin = ((cts * gh)[:, None] + bin_gy[None, :])[:, :, None] \
+                * gw + bin_gx[None, None, :]                   # [O, ph, pw]
+            cin = jnp.broadcast_to(cin[..., None, None], x0.shape)
+        else:
+            cin = jnp.broadcast_to(cts[:, None, None, None, None], x0.shape)
+        img = x[bi]                                            # [C, H, W]
+        v00 = img[cin, y0, x0]
+        v01 = img[cin, y1i, x0]
+        v10 = img[cin, y0, x1i]
+        v11 = img[cin, y1i, x1i]
+        val = ((1 - dx) * (1 - dy) * v00 + (1 - dx) * dy * v01
+               + dx * (1 - dy) * v10 + dx * dy * v11)
+        val = jnp.where(ok, val, 0.0)
+        cnt = jnp.sum(ok.astype(x.dtype), axis=(-1, -2))
+        return jnp.where(cnt > 0,
+                         jnp.sum(val, axis=(-1, -2)) / jnp.maximum(cnt, 1.0),
+                         0.0)
+
+    return jax.vmap(one_roi)(r.astype(x.dtype), tr, batch_indices)

@@ -1,0 +1,460 @@
+"""Contrib op tranche: tree_conv, rank_attention, bilateral_slice,
+prroi_pool, deformable_roi_pooling, positive_negative_pair.
+
+Each op is checked against an independent numpy port of the reference
+kernel's semantics (tree2col.cc, rank_attention.cu.h,
+bilateral_slice_op.cu, deformable_psroi_pooling_op.h,
+positive_negative_pair_op.h) plus gradchecks via the OpTest harness.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.incubate import bilateral_slice, rank_attention, tree_conv
+from paddle_tpu.metric import positive_negative_pair
+from paddle_tpu.vision.ops import deformable_roi_pooling, prroi_pool
+
+from op_test import check_grad
+
+
+class TestTreeConv:
+    def _ref_patches(self, edges, n, max_depth):
+        """Numpy port of tree2col.cc construct_tree/construct_patch."""
+        tr = [[] for _ in range(n + 2)]
+        for u, v in edges:
+            if u == 0 or v == 0:
+                break
+            tr[u].append(v)
+
+        def patch(root):
+            # (node, index, pclen, depth) — DFS matching the reference
+            out = [(root, 1, 1, 0)]
+            stack = [(root, 1, 1, 0)]
+            visited = {root}
+            while stack:
+                node, idx, pcl, dep = stack[-1]
+                end = True
+                for i, v in enumerate(tr[node]):
+                    if v not in visited and dep + 1 < max_depth:
+                        visited.add(v)
+                        stack.append((v, i, len(tr[node]), dep + 1))
+                        out.append((v, i + 1, len(tr[node]), dep + 1))
+                        end = False
+                if end:
+                    stack.pop()
+            return out
+
+        return [patch(u) for u in range(1, n + 1)]
+
+    def test_matches_tree2col_reference(self):
+        rs = np.random.RandomState(0)
+        n, f, o, k, depth = 6, 4, 3, 2, 3
+        #       1
+        #      / \
+        #     2   3
+        #    / \   \
+        #   4   5   6
+        edges = [(1, 2), (1, 3), (2, 4), (2, 5), (3, 6)]
+        feats = rs.randn(n, f).astype(np.float32)
+        filt = rs.randn(f, 3, o, k).astype(np.float32)
+        pad = edges + [(0, 0)] * 3
+        out = tree_conv(jnp.asarray(feats), jnp.asarray(pad, jnp.int32),
+                        jnp.asarray(filt), max_depth=depth)
+        ref = np.zeros((n, o, k), np.float32)
+        for u_idx, pat in enumerate(self._ref_patches(edges, n, depth)):
+            pt = np.zeros(f)
+            pl = np.zeros(f)
+            pr = np.zeros(f)
+            for node, idx, pcl, dep in pat:
+                eta_t = (depth - dep) / depth
+                sib = 0.5 if pcl == 1 else (idx - 1.0) / (pcl - 1.0)
+                # tree2col.h: eta_r = (1-eta_t)*(1-ETA_L), not (1-sib)
+                eta_l = (1 - eta_t) * sib
+                eta_r = (1 - eta_t) * (1 - eta_l)
+                fv = feats[node - 1]
+                pt += eta_t * fv
+                pl += eta_l * fv
+                pr += eta_r * fv
+            ref[u_idx] = (np.einsum("c,cok->ok", pt, filt[:, 0])
+                          + np.einsum("c,cok->ok", pl, filt[:, 1])
+                          + np.einsum("c,cok->ok", pr, filt[:, 2]))
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_gradcheck_and_jit(self):
+        rs = np.random.RandomState(1)
+        feats = rs.randn(4, 3).astype(np.float32)
+        filt = rs.randn(3, 3, 2, 1).astype(np.float32)
+        edges = jnp.asarray([(1, 2), (1, 3), (3, 4)], jnp.int32)
+        check_grad(lambda x, w: tree_conv(x, edges, w, max_depth=2),
+                   [feats, filt], idx=0)
+        check_grad(lambda x, w: tree_conv(x, edges, w, max_depth=2),
+                   [feats, filt], idx=1)
+        eager = tree_conv(jnp.asarray(feats), edges, jnp.asarray(filt))
+        jitted = jax.jit(lambda x, w: tree_conv(x, edges, w))(
+            jnp.asarray(feats), jnp.asarray(filt))
+        np.testing.assert_allclose(np.asarray(eager), np.asarray(jitted),
+                                   rtol=1e-6)
+
+    def test_batched(self):
+        rs = np.random.RandomState(2)
+        feats = rs.randn(2, 4, 3).astype(np.float32)
+        edges = jnp.asarray([[(1, 2), (2, 3)], [(1, 4), (0, 0)]],
+                            jnp.int32)
+        filt = rs.randn(3, 3, 2, 2).astype(np.float32)
+        out = tree_conv(jnp.asarray(feats), edges, jnp.asarray(filt))
+        assert out.shape == (2, 4, 2, 2)
+        one = tree_conv(jnp.asarray(feats[1]), edges[1], jnp.asarray(filt))
+        np.testing.assert_allclose(np.asarray(out[1]), np.asarray(one),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestRankAttention:
+    def _ref(self, x, ro, param, max_rank):
+        """Numpy port of rank_attention.cu.h expand kernels + bmm."""
+        n, d = x.shape
+        p = param.shape[1]
+        out = np.zeros((n, p), x.dtype)
+        for i in range(n):
+            lower = ro[i, 0] - 1
+            xi = np.zeros((max_rank * d,), x.dtype)
+            pi = np.zeros((max_rank * d, p), x.dtype)
+            for k in range(max_rank):
+                faster = ro[i, 2 * k + 1] - 1
+                if lower < 0 or faster < 0:
+                    continue
+                idx = ro[i, 2 * k + 2]
+                xi[k * d:(k + 1) * d] = x[idx]
+                start = lower * max_rank + faster
+                pi[k * d:(k + 1) * d] = param[start * d:(start + 1) * d]
+            out[i] = xi @ pi
+        return out
+
+    def test_matches_reference(self):
+        rs = np.random.RandomState(3)
+        n, d, p, mr = 5, 2, 3, 3
+        x = rs.randn(n, d).astype(np.float32)
+        param = rs.randn(d * mr * mr, p).astype(np.float32)
+        ro = np.zeros((n, 2 * mr + 1), np.int32)
+        for i in range(n):
+            ro[i, 0] = rs.randint(0, mr + 1)          # own rank, 0=missing
+            for k in range(mr):
+                ro[i, 2 * k + 1] = rs.randint(0, mr + 1)
+                ro[i, 2 * k + 2] = rs.randint(0, n)
+        out = rank_attention(jnp.asarray(x), jnp.asarray(ro),
+                             jnp.asarray(param), max_rank=mr)
+        np.testing.assert_allclose(np.asarray(out),
+                                   self._ref(x, ro, param, mr),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_gradcheck(self):
+        rs = np.random.RandomState(4)
+        x = rs.randn(3, 2).astype(np.float32)
+        param = rs.randn(2 * 4, 2).astype(np.float32)
+        ro = jnp.asarray([[1, 1, 0, 2, 1], [2, 2, 2, 0, 0],
+                          [1, 0, 0, 1, 2]], jnp.int32)
+        check_grad(lambda a, b: rank_attention(a, ro, b, max_rank=2),
+                   [x, param], idx=0)
+        check_grad(lambda a, b: rank_attention(a, ro, b, max_rank=2),
+                   [x, param], idx=1)
+
+
+class TestBilateralSlice:
+    def _ref(self, x, guide, grid, has_offset):
+        """Numpy port of BilateralSliceCudaForwardKernel."""
+        b, ci, h, w = x.shape
+        _, gc, gd, gh, gw = grid.shape
+        stride = ci + 1 if has_offset else ci
+        co = gc // stride
+        out = np.zeros((b, co, h, w), np.float32)
+        for bb in range(b):
+            for oc in range(co):
+                for y in range(h):
+                    for xx_ in range(w):
+                        gx = (xx_ + 0.5) * gw / w
+                        gy = (y + 0.5) * gh / h
+                        gz = guide[bb, y, xx_] * gd
+                        fx = int(np.floor(gx - 0.5))
+                        fy = int(np.floor(gy - 0.5))
+                        fz = int(np.floor(gz - 0.5))
+                        val = 0.0
+                        for in_c in range(stride):
+                            cs = 0.0
+                            for xi in range(fx, fx + 2):
+                                x_ = min(max(xi, 0), gw - 1)
+                                wx = max(1 - abs(xi + 0.5 - gx), 0)
+                                for yi in range(fy, fy + 2):
+                                    y_ = min(max(yi, 0), gh - 1)
+                                    wy = max(1 - abs(yi + 0.5 - gy), 0)
+                                    for zi in range(fz, fz + 2):
+                                        z_ = min(max(zi, 0), gd - 1)
+                                        dz = zi + 0.5 - gz
+                                        wz = max(
+                                            1 - np.sqrt(dz * dz + 1e-8), 0)
+                                        c_ = stride * oc + in_c
+                                        cs += grid[bb, c_, z_, y_, x_] \
+                                            * wx * wy * wz
+                            if in_c < ci:
+                                val += cs * x[bb, in_c, y, xx_]
+                            else:
+                                val += cs
+                        out[bb, oc, y, xx_] = val
+        return out
+
+    @pytest.mark.parametrize("has_offset", [False, True])
+    def test_matches_reference(self, has_offset):
+        rs = np.random.RandomState(5)
+        b, ci, co, h, w = 1, 2, 2, 4, 5
+        gd, gh, gw = 3, 2, 3
+        stride = ci + 1 if has_offset else ci
+        x = rs.randn(b, ci, h, w).astype(np.float32)
+        guide = rs.rand(b, h, w).astype(np.float32)
+        grid = rs.randn(b, co * stride, gd, gh, gw).astype(np.float32)
+        out = bilateral_slice(jnp.asarray(x), jnp.asarray(guide),
+                              jnp.asarray(grid), has_offset=has_offset)
+        np.testing.assert_allclose(np.asarray(out),
+                                   self._ref(x, guide, grid, has_offset),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_gradcheck(self):
+        rs = np.random.RandomState(6)
+        x = rs.randn(1, 1, 3, 3).astype(np.float32)
+        guide = (rs.rand(1, 3, 3) * 0.8 + 0.1).astype(np.float32)
+        grid = rs.randn(1, 2, 2, 2, 2).astype(np.float32)
+        check_grad(lambda a, g: bilateral_slice(a, jnp.asarray(guide), g,
+                                                has_offset=True),
+                   [x, grid], idx=0)
+        check_grad(lambda a, g: bilateral_slice(a, jnp.asarray(guide), g,
+                                                has_offset=True),
+                   [x, grid], idx=1)
+
+
+class TestPrRoiPool:
+    def test_constant_field_integrates_exactly(self):
+        """On a constant feature map the precise integral equals the
+        constant wherever the roi is interior."""
+        x = jnp.full((1, 1, 8, 8), 3.0)
+        rois = jnp.asarray([[1.0, 1.0, 6.0, 6.0]])
+        out = prroi_pool(x, rois, pooled_height=2, pooled_width=2)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.full((1, 1, 2, 2), 3.0), rtol=1e-5)
+
+    def test_linear_ramp_exact_integral(self):
+        """Bilinear interp of f(x)=x is exact, so the precise integral
+        over a bin is the ramp's mean at the bin center."""
+        W = 10
+        ramp = jnp.broadcast_to(jnp.arange(W, dtype=jnp.float32),
+                                (1, 1, 8, W))
+        rois = jnp.asarray([[2.0, 2.0, 6.0, 6.0]])
+        out = prroi_pool(ramp, rois, pooled_height=1, pooled_width=2)
+        # bins [2,4]x[2,6] and [4,6]x[2,6]: mean of x over them = 3, 5
+        np.testing.assert_allclose(np.asarray(out)[0, 0, 0], [3.0, 5.0],
+                                   rtol=1e-5)
+
+    def test_grad_wrt_input_and_rois(self):
+        rs = np.random.RandomState(7)
+        x = rs.randn(1, 2, 6, 6).astype(np.float32)
+        rois = np.asarray([[1.2, 1.1, 4.7, 4.4]], np.float32)
+        check_grad(lambda a, r: prroi_pool(a, r, pooled_height=2,
+                                           pooled_width=2),
+                   [x, rois], idx=0)
+        # PrRoI's headline property: differentiable in the coordinates
+        check_grad(lambda a, r: prroi_pool(a, r, pooled_height=2,
+                                           pooled_width=2),
+                   [x, rois], idx=1, rtol=2e-2, atol=5e-3)
+
+    def test_batch_roi_nums(self):
+        # roi interior to [0, 3]x[0, 3] where the bilinear surface of a
+        # constant map is exactly constant
+        x = jnp.stack([jnp.full((1, 4, 4), 1.0), jnp.full((1, 4, 4), 5.0)])
+        rois = jnp.asarray([[0.5, 0.5, 2.5, 2.5]] * 3)
+        out = prroi_pool(x, rois, batch_roi_nums=jnp.asarray([1, 2]))
+        np.testing.assert_allclose(np.asarray(out).reshape(-1),
+                                   [1.0, 5.0, 5.0], rtol=1e-5)
+
+
+class TestDeformableRoiPooling:
+    def _ref(self, x, rois, trans, no_trans, scale, group, pooled, part,
+             sp, std, ps, bidx):
+        """Numpy port of DeformablePSROIPoolForwardCPUKernel."""
+        N, C, H, W = x.shape
+        gh, gw = group
+        ph, pw = pooled
+        part_h, part_w = part
+        out_dim = C // (gh * gw) if ps else C
+        ncls = 1 if no_trans else trans.shape[1] // 2
+        cec = max(out_dim // ncls, 1)
+        R = rois.shape[0]
+
+        def cround(v):
+            # C round(): half away from zero (NOT python/banker's round)
+            return np.sign(v) * np.floor(np.abs(v) + 0.5)
+
+        out = np.zeros((R, out_dim, ph, pw), np.float32)
+        for n in range(R):
+            x1 = cround(rois[n, 0]) * scale - 0.5
+            y1 = cround(rois[n, 1]) * scale - 0.5
+            x2 = (cround(rois[n, 2]) + 1) * scale - 0.5
+            y2 = (cround(rois[n, 3]) + 1) * scale - 0.5
+            rw = max(x2 - x1, 0.1)
+            rh = max(y2 - y1, 0.1)
+            bh, bw = rh / ph, rw / pw
+            sbh, sbw = bh / sp, bw / sp
+            for c in range(out_dim):
+                cls = c // cec
+                for py in range(ph):
+                    for px in range(pw):
+                        p_h = int(np.floor(py / ph * part_h))
+                        p_w = int(np.floor(px / pw * part_w))
+                        if no_trans:
+                            tx = ty = 0.0
+                        else:
+                            tx = trans[n, 2 * cls, p_h, p_w] * std
+                            ty = trans[n, 2 * cls + 1, p_h, p_w] * std
+                        ws = px * bw + x1 + tx * rw
+                        hs = py * bh + y1 + ty * rh
+                        s = 0.0
+                        cnt = 0
+                        bgw = min(max(px * gw // pw, 0), gw - 1)
+                        bgh = min(max(py * gh // ph, 0), gh - 1)
+                        for ih in range(sp):
+                            for iw in range(sp):
+                                wpt = ws + iw * sbw
+                                hpt = hs + ih * sbh
+                                if (wpt < -0.5 or wpt > W - 0.5
+                                        or hpt < -0.5 or hpt > H - 0.5):
+                                    continue
+                                wpt = min(max(wpt, 0), W - 1)
+                                hpt = min(max(hpt, 0), H - 1)
+                                cin = ((c * gh + bgh) * gw + bgw) if ps \
+                                    else c
+                                xx0 = int(np.floor(wpt))
+                                yy0 = int(np.floor(hpt))
+                                xx1 = int(np.ceil(wpt))
+                                yy1 = int(np.ceil(hpt))
+                                dx = wpt - xx0
+                                dy = hpt - yy0
+                                img = x[bidx[n], cin]
+                                v = ((1 - dx) * (1 - dy) * img[yy0, xx0]
+                                     + (1 - dx) * dy * img[yy1, xx0]
+                                     + dx * (1 - dy) * img[yy0, xx1]
+                                     + dx * dy * img[yy1, xx1])
+                                s += v
+                                cnt += 1
+                        out[n, c, py, px] = 0.0 if cnt == 0 else s / cnt
+        return out
+
+    def test_matches_reference_plain(self):
+        rs = np.random.RandomState(8)
+        x = rs.randn(1, 3, 8, 8).astype(np.float32)
+        # the half-integer roi exercises C-round (2.5 -> 3) vs
+        # banker's round (2.5 -> 2) in the window origin
+        rois = np.asarray([[1, 1, 5, 5], [0, 2, 6, 7], [2.5, 1.5, 5.5, 6.5]],
+                          np.float32)
+        out = deformable_roi_pooling(
+            jnp.asarray(x), jnp.asarray(rois), no_trans=True,
+            spatial_scale=1.0, pooled_height=2, pooled_width=2,
+            sample_per_part=2)
+        ref = self._ref(x, rois, None, True, 1.0, (1, 1), (2, 2), (2, 2),
+                        2, 0.1, False, [0, 0, 0])
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_matches_reference_deformable_ps(self):
+        rs = np.random.RandomState(9)
+        gh = gw = 2
+        co = 2
+        x = rs.randn(2, co * gh * gw, 10, 10).astype(np.float32)
+        rois = np.asarray([[1, 1, 7, 7], [2, 0, 9, 8]], np.float32)
+        trans = (rs.randn(2, 2, 2, 2) * 0.5).astype(np.float32)
+        bidx = np.asarray([0, 1], np.int32)
+        out = deformable_roi_pooling(
+            jnp.asarray(x), jnp.asarray(rois), jnp.asarray(trans),
+            spatial_scale=0.5, group_size=(gh, gw), pooled_height=2,
+            pooled_width=2, part_size=(2, 2), sample_per_part=3,
+            trans_std=0.2, position_sensitive=True,
+            batch_indices=jnp.asarray(bidx))
+        ref = self._ref(x, rois, trans, False, 0.5, (gh, gw), (2, 2),
+                        (2, 2), 3, 0.2, True, bidx)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_grad_wrt_input_and_trans(self):
+        rs = np.random.RandomState(10)
+        x = rs.randn(1, 2, 6, 6).astype(np.float32)
+        rois = jnp.asarray([[1, 1, 4, 4]], jnp.float32)
+        trans = (rs.randn(1, 2, 2, 2) * 0.3).astype(np.float32)
+        fn = lambda a, t: deformable_roi_pooling(
+            a, rois, t, pooled_height=2, pooled_width=2,
+            part_size=(2, 2), sample_per_part=2, trans_std=0.1)
+        check_grad(fn, [x, trans], idx=0)
+        check_grad(fn, [x, trans], idx=1, rtol=2e-2, atol=5e-3)
+
+
+class TestPixelOffsetIoU:
+    def test_nms_pixel_offset_convention(self):
+        """11x11-px boxes [0,0,10,10] vs [3,0,13,10]: pixel IoU
+        (JaccardOverlap normalized=false) = 88/154 = 0.571, normalized
+        IoU = 70/130 = 0.538 — at thresh 0.55 only the pixel convention
+        suppresses the second box."""
+        from paddle_tpu.vision.ops import box_iou, nms
+        b = jnp.asarray([[0., 0., 10., 10.], [3., 0., 13., 10.]])
+        s = jnp.asarray([0.9, 0.8])
+        iou_n = float(box_iou(b[:1], b[1:])[0, 0])
+        iou_p = float(box_iou(b[:1], b[1:], pixel_offset=True)[0, 0])
+        assert abs(iou_n - 70.0 / 130.0) < 1e-5
+        assert abs(iou_p - 88.0 / 154.0) < 1e-5
+        keep_n = np.asarray(nms(b, s, iou_threshold=0.55))
+        keep_p = np.asarray(nms(b, s, iou_threshold=0.55,
+                                pixel_offset=True))
+        assert keep_n.tolist() == [True, True]
+        assert keep_p.tolist() == [True, False]
+
+    def test_prroi_inverted_roi_is_empty(self):
+        x = jnp.full((1, 1, 8, 8), 3.0)
+        out = prroi_pool(x, jnp.asarray([[5., 5., 1., 1.]]),
+                         pooled_height=2, pooled_width=2)
+        np.testing.assert_allclose(np.asarray(out), 0.0)
+
+
+class TestPositiveNegativePair:
+    def test_matches_reference_counts(self):
+        # query 1: docs (s=3,l=1),(s=2,l=0),(s=2,l=1); query 2: 2 docs
+        score = jnp.asarray([3.0, 2.0, 2.0, 1.0, 5.0])
+        label = jnp.asarray([1.0, 0.0, 1.0, 1.0, 0.0])
+        qid = jnp.asarray([1, 1, 1, 2, 2])
+        pos, neg, neu = positive_negative_pair(score, label, qid)
+        # q1 pairs: (0,1) concordant; (1,2) tie -> neu AND neg;
+        # (0,2) same label skipped. q2: (3,4) discordant.
+        assert float(pos) == 1.0
+        assert float(neg) == 2.0
+        assert float(neu) == 1.0
+
+    def test_weight_and_accumulate_and_column(self):
+        score = jnp.asarray([[0.0, 3.0], [0.0, 2.0]])
+        label = jnp.asarray([[1.0], [0.0]])
+        qid = jnp.asarray([7, 7])
+        w = jnp.asarray([2.0, 4.0])
+        pos, neg, neu = positive_negative_pair(
+            score, label, qid, weight=w, accumulate=(10.0, 20.0, 30.0),
+            column=-1)
+        assert float(pos) == 13.0      # 10 + (2+4)/2
+        assert float(neg) == 20.0
+        assert float(neu) == 30.0
+
+    def test_jit(self):
+        score = jnp.asarray([1.0, 2.0, 3.0])
+        label = jnp.asarray([0.0, 1.0, 0.0])
+        qid = jnp.asarray([1, 1, 1])
+        eager = positive_negative_pair(score, label, qid)
+        jitted = jax.jit(positive_negative_pair)(score, label, qid)
+        for a, b in zip(eager, jitted):
+            assert float(a) == float(b)
+
+    def test_integer_scores(self):
+        pos, neg, neu = positive_negative_pair(
+            jnp.asarray([3, 2, 2]), jnp.asarray([1, 0, 1]),
+            jnp.asarray([1, 1, 1]))
+        assert float(pos) == 1.0 and float(neg) == 1.0 \
+            and float(neu) == 1.0
