@@ -514,7 +514,13 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
     flatname_params = dict(outer)
     flatname_params.update({f"blocks.{n}": v for n, v in stacked.items()})
 
-    opt_state0 = optimizer.init_state(flatname_params)
+    if offload:
+        # structure only: materializing the full [L, ...] slot zeros on
+        # device before moving them to host would transiently cost the
+        # whole optimizer HBM the offload exists to avoid
+        opt_state0 = jax.eval_shape(optimizer.init_state, flatname_params)
+    else:
+        opt_state0 = optimizer.init_state(flatname_params)
 
     def value_and_grad_1f1b(params, batch, rng=None):
         """Loss + grads via the 1F1B schedule (SectionWorker mode 1,
@@ -578,6 +584,21 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
     if pipeline_schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline_schedule {pipeline_schedule!r}")
 
+    def _loss_and_grads(params_pair, batch, rng):
+        if use_1f1b:
+            return value_and_grad_1f1b(params_pair, batch, rng)
+        if rng is None:
+            return jax.value_and_grad(loss_fn)(params_pair, batch)
+        # scope the traced key so Dropout draws fresh masks per step
+        # (an unscoped next_key() inside jit would bake one constant
+        # mask into the compiled program)
+        from ..framework.random import rng_guard
+
+        def lf(params, batch_):
+            with rng_guard(rng):
+                return loss_fn(params, batch_)
+        return jax.value_and_grad(lf)(params_pair, batch)
+
     def step(state, batch, rng=None):
         if cfg.dropout > 0.0 and rng is None:
             # without a key the dropout draws would fall back to the
@@ -587,23 +608,7 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
                 "cfg.dropout > 0 requires step(state, batch, rng_key) — "
                 "pass a fresh jax.random key every step")
         outer_p, stacked_p, opt_state = state
-        if use_1f1b:
-            loss, grads = value_and_grad_1f1b((outer_p, stacked_p), batch,
-                                              rng)
-        elif rng is None:
-            loss, grads = jax.value_and_grad(loss_fn)((outer_p, stacked_p),
-                                                      batch)
-        else:
-            # scope the traced key so Dropout draws fresh masks per step
-            # (an unscoped next_key() inside jit would bake one constant
-            # mask into the compiled program)
-            from ..framework.random import rng_guard
-
-            def lf(params, batch):
-                with rng_guard(rng):
-                    return loss_fn(params, batch)
-            loss, grads = jax.value_and_grad(lf)((outer_p, stacked_p),
-                                                 batch)
+        loss, grads = _loss_and_grads((outer_p, stacked_p), batch, rng)
         g_outer, g_stacked = grads
         flat_p = dict(outer_p)
         flat_p.update({f"blocks.{n}": v for n, v in stacked_p.items()})
@@ -619,18 +624,7 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
                               v, ns(opt_spec(n, v)))
                           if jnp.ndim(v) else v)
                       for n, v in flat_g.items()}
-        if offload:
-            # stream slots host -> device for the update (step counter
-            # stays on device — annotating it confuses the partitioner)
-            opt_state = dict(opt_state, slots=jax.device_put(
-                opt_state["slots"], opt_state_dev_shardings["slots"]))
         new_flat, new_opt = optimizer.apply(flat_p, flat_g, opt_state)
-        if offload:
-            # ...and back to their pinned_host residence (out_shardings
-            # carry the host memory kind, this makes the intent explicit
-            # in the traced program)
-            new_opt = dict(new_opt, slots=jax.device_put(
-                new_opt["slots"], opt_state_shardings["slots"]))
         new_outer = {n: new_flat[n] for n in outer_p}
         new_stacked = {n: new_flat[f"blocks.{n}"] for n in stacked_p}
         return (new_outer, new_stacked, new_opt), loss
@@ -677,29 +671,33 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
         outer_param_specs = outer_specs
         stacked_param_specs = stacked_specs
 
-    is_spec = lambda s: isinstance(s, P)  # noqa: E731
-    opt_state_dev_shardings = jax.tree.map(ns, opt_state_specs,
-                                           is_leaf=is_spec)
-    if offload:
-        def ns_host(spec):
-            return NamedSharding(mesh, spec, memory_kind="pinned_host")
-        opt_state_shardings = {
-            "step": ns(opt_state_specs["step"]),
-            "slots": jax.tree.map(ns_host, opt_state_specs["slots"],
-                                  is_leaf=is_spec)}
-    else:
-        opt_state_shardings = opt_state_dev_shardings
-
-    state_shardings = (
-        {n: ns(s) for n, s in outer_param_specs.items()},
-        {n: ns(s) for n, s in stacked_param_specs.items()},
-        opt_state_shardings)
     # ZeRO semantics: the 'sharding' axis IS data parallelism with sharded
     # states — the batch splits over data×sharding jointly (reference:
     # sharding_degree multiplies dp for the data split,
     # sharding_optimizer.py:968 _build_groups)
     batch_sharding = (ns(P(("data", "sharding"), seq_axis)),
                       ns(P(("data", "sharding"), seq_axis)))
+
+    if offload:
+        def ns_host(spec):
+            return NamedSharding(mesh, spec, memory_kind="pinned_host")
+        return _build_offload_chunked_step(
+            cfg=cfg, optimizer=optimizer, outer=outer, stacked=stacked,
+            opt_state0=opt_state0, opt_spec=opt_spec, ns=ns,
+            ns_host=ns_host, shard_axis=shard_axis,
+            loss_and_grads=_loss_and_grads,
+            outer_param_specs=outer_param_specs,
+            stacked_param_specs=stacked_param_specs,
+            batch_sharding=batch_sharding, donate=donate, pp=pp)
+
+    is_spec = lambda s: isinstance(s, P)  # noqa: E731
+    opt_state_shardings = jax.tree.map(ns, opt_state_specs,
+                                       is_leaf=is_spec)
+
+    state_shardings = (
+        {n: ns(s) for n, s in outer_param_specs.items()},
+        {n: ns(s) for n, s in stacked_param_specs.items()},
+        opt_state_shardings)
 
     if cfg.dropout > 0.0:
         step_jit = jax.jit(
@@ -718,6 +716,263 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
     state0 = jax.device_put(
         (outer, stacked, opt_state0), state_shardings)
     return step_jit, state0
+
+
+# per-chunk optimizer-slot bytes allowed on device at once in the
+# offloaded update (the streaming window, not a model-size limit)
+_OFFLOAD_CHUNK_BYTES = 1 << 30
+
+
+def _build_offload_chunked_step(*, cfg, optimizer, outer, stacked,
+                                opt_state0, opt_spec, ns, ns_host,
+                                shard_axis, loss_and_grads,
+                                outer_param_specs, stacked_param_specs,
+                                batch_sharding, donate, pp):
+    """Host-offloaded train step with a CHUNKED optimizer update.
+
+    The reference's sharding offload (`fleet/meta_optimizers/sharding/
+    offload_helper.py:1`) keeps Adam slots in host memory and streams
+    them through device memory parameter-group by parameter-group. A
+    single-jit version of that (slots device_put'd in one go) is
+    useless: XLA counts the whole optimizer state against peak HBM and
+    an ERNIE-1.3B step OOMs exactly as if there were no offload. This
+    builds three compiled programs instead:
+
+      1. grad phase — loss + grads (+ global-norm clip, + ZeRO grad
+         layout), params resident, slots untouched;
+      2. one chunk-update program, reused for every chunk of k decoder
+         blocks: dynamic-slice the [L, ...] param/grad stacks at a
+         TRACED offset (one compile for all chunks), stream that
+         chunk's slots host->device, update, write params back with
+         dynamic-update-slice, stream new slots back out;
+      3. outer update — embeddings/final-LN slots streamed the same way.
+
+    Peak HBM = params + grads + ONE chunk of slots, so the largest
+    trainable size is bounded by params+grads+activations — the
+    offload promise. Slots at rest are tuples of per-chunk arrays in
+    `pinned_host` memory; they never exist stacked on device.
+    """
+    import numpy as onp
+
+    L = cfg.num_layers
+    if pp != 1:
+        raise ValueError(
+            "offload=True requires pipe=1: the chunked update slices the "
+            "block stack, which the pipeline axis partitions")
+    if not optimizer._elementwise_update:
+        raise ValueError(
+            f"offload=True cannot stream {type(optimizer).__name__}: its "
+            "update is a whole-tensor norm (trust ratio), so per-chunk "
+            "streaming would change the numerics. Use an elementwise "
+            "rule (Adam/AdamW/Momentum/...) or offload=False")
+
+    slot_struct = opt_state0["slots"]
+    # conservative (unsharded) byte estimate: shard_spec_for may leave a
+    # leaf replicated, so dividing by shard_axis here could pick a chunk
+    # shard_axis x over budget on some device
+    per_layer = sum(
+        int(onp.prod(v.shape[1:])) * v.dtype.itemsize
+        for n, slots in slot_struct.items() if n.startswith("blocks.")
+        for v in slots.values())
+    k = 1
+    for d in range(1, L + 1):
+        if L % d == 0 and d * per_layer <= _OFFLOAD_CHUNK_BYTES:
+            k = d
+    n_chunks = L // k
+    starts = [onp.int32(ci * k) for ci in range(n_chunks)]
+
+    # ---- host-resident initial slots, built without an HBM detour ----
+    # _init_slot runs on the CPU backend so non-zero initial values
+    # (e.g. Adagrad's initial_accumulator_value) are honored exactly as
+    # in the resident path, without materializing [L, ...] on the TPU
+    try:
+        cpu0 = jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        cpu0 = None  # no CPU backend: chunk-sized device transient is fine
+
+    def init_slot_values(shape, dtype):
+        if cpu0 is not None:
+            with jax.default_device(cpu0):
+                vals = optimizer._init_slot(jnp.zeros(shape, dtype))
+        else:
+            vals = optimizer._init_slot(jnp.zeros(shape, dtype))
+        return {sn: onp.asarray(v) for sn, v in vals.items()}
+
+    stacked_slot_names = [n for n in slot_struct if n.startswith("blocks.")]
+    outer_slot_names = [n for n in slot_struct
+                        if not n.startswith("blocks.")]
+
+    chunk_slot_shardings = {}   # pname -> {sname: host sharding (chunk)}
+    chunk_slot_dev = {}         # same specs, device memory (stream target)
+    slots_host = {}             # pname -> {sname: tuple of n_chunks arrays}
+    for pname in stacked_slot_names:
+        src = stacked[pname[len("blocks."):]]
+        init_vals = init_slot_values((k,) + tuple(src.shape[1:]),
+                                     src.dtype)
+        per_shard, per_chunks, per_dev = {}, {}, {}
+        for sname, sd in slot_struct[pname].items():
+            cshape = (k,) + tuple(sd.shape[1:])
+            cstruct = jax.ShapeDtypeStruct(cshape, sd.dtype)
+            hshard = ns_host(opt_spec(pname, cstruct))
+            per_shard[sname] = hshard
+            per_dev[sname] = ns(opt_spec(pname, cstruct))
+            if sname == "master":
+                # master weights initialize FROM the params, not zeros
+                per_chunks[sname] = tuple(
+                    jax.device_put(
+                        onp.asarray(jax.device_get(
+                            src[ci * k:(ci + 1) * k]), onp.float32),
+                        hshard)
+                    for ci in range(n_chunks))
+            else:
+                # one transfer, shared by every chunk slot: jax arrays
+                # are immutable and each slot is wholesale-replaced by
+                # the first step's update
+                v0 = jax.device_put(init_vals[sname], hshard)
+                per_chunks[sname] = (v0,) * n_chunks
+        chunk_slot_shardings[pname] = per_shard
+        chunk_slot_dev[pname] = per_dev
+        slots_host[pname] = per_chunks
+
+    outer_slot_shardings = {}
+    outer_slot_dev = {}
+    for pname in outer_slot_names:
+        init_vals = init_slot_values(tuple(outer[pname].shape),
+                                     outer[pname].dtype)
+        per_shard, per, per_dev = {}, {}, {}
+        for sname, sd in slot_struct[pname].items():
+            hshard = ns_host(opt_spec(pname, sd))
+            per_shard[sname] = hshard
+            per_dev[sname] = ns(opt_spec(pname, sd))
+            if sname == "master":
+                per[sname] = jax.device_put(
+                    onp.asarray(jax.device_get(outer[pname]), onp.float32),
+                    hshard)
+            else:
+                per[sname] = jax.device_put(init_vals[sname], hshard)
+        outer_slot_shardings[pname] = per_shard
+        outer_slot_dev[pname] = per_dev
+        slots_host[pname] = per
+
+    # ---- compiled programs ----
+    outer_shardings = {n: ns(s) for n, s in outer_param_specs.items()}
+    stacked_shardings = {n: ns(s) for n, s in stacked_param_specs.items()}
+    g_outer_shardings = {n: ns(opt_spec(n, outer[n])) for n in outer}
+    g_stacked_shardings = {n: ns(opt_spec(f"blocks.{n}", stacked[n]))
+                           for n in stacked}
+
+    def grad_phase(params_pair, opt_step, batch, rng=None):
+        loss, (g_outer, g_stacked) = loss_and_grads(params_pair, batch,
+                                                    rng)
+        flat_g = dict(g_outer)
+        flat_g.update({f"blocks.{n}": v for n, v in g_stacked.items()})
+        if shard_axis > 1:
+            flat_g = {n: (jax.lax.with_sharding_constraint(
+                              v, ns(opt_spec(n, v)))
+                          if jnp.ndim(v) else v)
+                      for n, v in flat_g.items()}
+        if optimizer._grad_clip is not None:
+            # global-norm clip sees the FULL grad set here; the per-chunk
+            # updates below must not clip again
+            flat_g = optimizer._grad_clip(flat_g)
+        g_outer = {n: flat_g[n] for n in g_outer}
+        g_stacked = {n: flat_g[f"blocks.{n}"] for n in g_stacked}
+        return loss, g_outer, g_stacked, opt_step + 1
+
+    grad_kwargs = dict(
+        in_shardings=((outer_shardings, stacked_shardings), ns(P()),
+                      batch_sharding),
+        out_shardings=(None, g_outer_shardings, g_stacked_shardings,
+                       ns(P())))
+    if cfg.dropout > 0.0:
+        grad_kwargs["in_shardings"] = grad_kwargs["in_shardings"] + (None,)
+        grad_jit = jax.jit(grad_phase, **grad_kwargs)
+    else:
+        grad_jit = jax.jit(functools.partial(grad_phase, rng=None),
+                           **grad_kwargs)
+
+    def chunk_update(stacked_p, g_stacked, slots_chunk, new_step, start):
+        p_c = {f"blocks.{n}": jax.lax.dynamic_slice_in_dim(v, start, k, 0)
+               for n, v in stacked_p.items()}
+        g_c = {f"blocks.{n}":
+               jax.lax.dynamic_slice_in_dim(g_stacked[n], start, k, 0)
+               for n in stacked_p}
+        new_p_c, new_slots = optimizer.apply_named(p_c, g_c, slots_chunk,
+                                                   new_step)
+        new_stacked = {
+            n: jax.lax.dynamic_update_slice_in_dim(
+                stacked_p[n], new_p_c[f"blocks.{n}"].astype(
+                    stacked_p[n].dtype), start, 0)
+            for n in stacked_p}
+        return new_stacked, new_slots
+
+    # slots cross the host<->device boundary OUTSIDE the jits, as plain
+    # transfers in the orchestrator below: in-jit memory-space changes
+    # (annotate_device_placement) break the SPMD partitioner on
+    # multi-device meshes, and outside-jit copies dispatch async anyway,
+    # pipelining chunk i+1's upload behind chunk i's compute
+    chunk_jit = jax.jit(
+        chunk_update,
+        in_shardings=(stacked_shardings, g_stacked_shardings,
+                      chunk_slot_dev, ns(P()), None),
+        out_shardings=(stacked_shardings, chunk_slot_dev),
+        donate_argnums=(0, 2) if donate else ())
+
+    def outer_update(outer_p, g_outer, outer_slots, new_step):
+        return optimizer.apply_named(outer_p, g_outer, outer_slots,
+                                     new_step)
+
+    outer_jit = jax.jit(
+        outer_update,
+        in_shardings=(outer_shardings, g_outer_shardings,
+                      outer_slot_dev, ns(P())),
+        out_shardings=(outer_shardings, outer_slot_dev),
+        donate_argnums=(0, 2) if donate else ())
+
+    def step_fn(state, batch, rng=None):
+        if cfg.dropout > 0.0 and rng is None:
+            raise ValueError(
+                "cfg.dropout > 0 requires step(state, batch, rng_key) — "
+                "pass a fresh jax.random key every step")
+        outer_p, stacked_p, opt_state = state
+        if cfg.dropout > 0.0:
+            loss, g_outer, g_stacked, new_step = grad_jit(
+                (outer_p, stacked_p), opt_state["step"], batch, rng)
+        else:
+            loss, g_outer, g_stacked, new_step = grad_jit(
+                (outer_p, stacked_p), opt_state["step"], batch)
+        slots = opt_state["slots"]
+        new_stacked = stacked_p
+        chunk_results = []
+        for ci in range(n_chunks):
+            slots_chunk = jax.device_put(
+                {n: {sname: slots[n][sname][ci] for sname in slots[n]}
+                 for n in stacked_slot_names}, chunk_slot_dev)
+            new_stacked, new_chunk = chunk_jit(
+                new_stacked, g_stacked, slots_chunk, new_step, starts[ci])
+            # back to pinned_host residence; dropping the device ref
+            # frees the chunk's HBM before chunk ci+2 uploads
+            chunk_results.append(
+                jax.device_put(new_chunk, chunk_slot_shardings))
+        outer_slots = jax.device_put(
+            {n: slots[n] for n in outer_slot_names}, outer_slot_dev)
+        new_outer, new_outer_slots = outer_jit(outer_p, g_outer,
+                                               outer_slots, new_step)
+        new_outer_slots = jax.device_put(new_outer_slots,
+                                         outer_slot_shardings)
+        new_slots = {n: {sname: tuple(cr[n][sname]
+                                      for cr in chunk_results)
+                         for sname in slots[n]}
+                     for n in stacked_slot_names}
+        new_slots.update(new_outer_slots)
+        return (new_outer, new_stacked,
+                {"step": new_step, "slots": new_slots}), loss
+
+    state0 = (jax.device_put(outer, outer_shardings),
+              jax.device_put(stacked, stacked_shardings),
+              {"step": jax.device_put(jnp.zeros((), jnp.int32), ns(P())),
+               "slots": slots_host})
+    return step_fn, state0
 
 
 def sync_params_to_model(model: GPTForPretraining, state):

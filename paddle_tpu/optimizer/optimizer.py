@@ -116,9 +116,25 @@ class Optimizer:
               state: Dict[str, Any]):
         """Pure update: returns (new_params, new_state). Call inside jit."""
         step = state["step"] + 1
-        lr = self._lr_value(step)
         if self._grad_clip is not None:
             grads = self._grad_clip(grads)
+        new_params, new_slots = self.apply_named(params, grads,
+                                                 state["slots"], step)
+        return new_params, {"step": step, "slots": new_slots}
+
+    def apply_named(self, params: Dict[str, jax.Array],
+                    grads: Dict[str, jax.Array],
+                    slots_map: Dict[str, Dict[str, jax.Array]],
+                    step: jax.Array):
+        """Update one named subset of params with an already-bumped step
+        counter and already-clipped grads. The chunk-level core of
+        `apply`, exposed so host-offloaded steps can stream optimizer
+        slots through HBM one chunk at a time (reference:
+        `fleet/meta_optimizers/sharding/offload_helper.py:1`) — global
+        clip and the step bump happen once in the caller, this runs per
+        chunk. The update math is elementwise per param, so a chunk may
+        be a [k, ...] stack of k block-params updated as one tensor."""
+        lr = self._lr_value(step)
         # regularization (coupled, reference: regularizer appended to grad;
         # per-param Parameter.regularizer overrides the optimizer-global
         # weight_decay — `fluid/regularizer.py append_regularization_ops`)
@@ -128,7 +144,7 @@ class Optimizer:
         new_params, new_slots = {}, {}
         for name, p in params.items():
             g = grads.get(name)
-            slots = dict(state["slots"][name])
+            slots = dict(slots_map[name])
             if g is None:
                 new_params[name] = p
                 new_slots[name] = slots
@@ -153,9 +169,14 @@ class Optimizer:
             else:
                 new_params[name] = new_p.astype(p.dtype)
             new_slots[name] = slots
-        return new_params, {"step": step, "slots": new_slots}
+        return new_params, new_slots
 
     _couple_wd = True  # AdamW overrides (decoupled)
+    # True when _update is elementwise over the param tensor, which lets
+    # offloaded steps batch k stacked block-params through one chunk
+    # update. Norm-based rules (LARS/Lamb trust ratios) are NOT — their
+    # result depends on the tensor partitioning they are handed.
+    _elementwise_update = True
 
     # --- eager/imperative API (paddle parity) ---
 
@@ -444,6 +465,8 @@ class RMSProp(Optimizer):
 class Lamb(Optimizer):
     """Reference: lamb_op — layerwise trust-ratio Adam (BERT large-batch)."""
 
+    _elementwise_update = False  # trust ratio is a whole-tensor norm
+
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
                  beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
                  grad_clip=None, exclude_from_weight_decay_fn=None,
@@ -482,6 +505,8 @@ class Lamb(Optimizer):
 class LarsMomentum(Optimizer):
     """Reference: lars_momentum_op — layerwise LR scaling (ResNet
     large-batch)."""
+
+    _elementwise_update = False  # local LR is a whole-tensor norm ratio
 
     def __init__(self, learning_rate=0.001, momentum=0.9,
                  lars_coeff=0.001, lars_weight_decay=0.0005, parameters=None,
@@ -550,6 +575,10 @@ class Dpsgd(Optimizer):
     grad down when its l2 norm exceeds `clip`, then step on
     grad + N(0, sigma)/batch_size (the reference adds the raw Gaussian
     divided by batch_size; privacy accounting is the caller's)."""
+
+    # per-tensor DP clip norm + name-derived noise key: chunk streaming
+    # would change the clip scale AND correlate noise across chunks
+    _elementwise_update = False
 
     def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
                  sigma=1.0, parameters=None, seed=0, name=None):
