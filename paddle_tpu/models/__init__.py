@@ -15,6 +15,7 @@ from .gpt import (  # noqa: F401
     build_train_step,
     gpt_tiny,
     gpt_345m,
+    gpt_760m,
     gpt_1p3b,
     gpt_2p6b,
     gpt_6p7b,

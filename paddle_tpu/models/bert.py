@@ -44,8 +44,10 @@ class BertConfig:
 
 
 def bert_tiny(**kw) -> BertConfig:
-    return BertConfig(vocab_size=512, hidden_size=64, num_layers=2,
-                      num_heads=4, max_position_embeddings=128, **kw)
+    for k, v in dict(vocab_size=512, hidden_size=64, num_layers=2,
+                     num_heads=4, max_position_embeddings=128).items():
+        kw.setdefault(k, v)
+    return BertConfig(**kw)
 
 
 def bert_base(**kw) -> BertConfig:
@@ -156,9 +158,19 @@ class BertPretrainingHeads(Layer):
                                                   is_bias=True)
         self.seq_relationship = Linear(cfg.hidden_size, 2)
 
-    def forward(self, sequence_output, pooled_output, embedding_weight):
+    def forward(self, sequence_output, pooled_output, embedding_weight,
+                masked_positions=None):
         # embedding_weight passed (not stored) so the tied table stays a
         # single Parameter slot under bert.embeddings — one grad, one update
+        if masked_positions is not None:
+            # gather the ~15% masked positions BEFORE the transform and
+            # vocab projection (reference: BertPretrainingHeads.forward
+            # gathers sequence_output at masked_positions) — the MLM head
+            # then costs P/S of the dense version and the [B, S, V]
+            # logits tensor never exists
+            pos = masked_positions.astype(jnp.int32)
+            sequence_output = jnp.take_along_axis(
+                sequence_output, pos[..., None], axis=1)
         x = self.layer_norm(F.gelu(self.transform(sequence_output)))
         logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
                             jnp.asarray(embedding_weight).astype(jnp.float32))
@@ -180,23 +192,48 @@ class BertForPretraining(Layer):
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
                 masked_lm_labels=None, next_sentence_labels=None,
-                masked_lm_weights=None):
+                masked_lm_weights=None, masked_positions=None):
         seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
         logits, nsp = self.cls(
-            seq, pooled, self.bert.embeddings.word_embeddings.weight)
+            seq, pooled, self.bert.embeddings.word_embeddings.weight,
+            masked_positions=masked_positions)
         if masked_lm_labels is None:
             return logits, nsp
-        # MLM loss: ignore_index = -1 (unmasked positions)
-        logits32 = logits.astype(jnp.float32)
-        lse = jax.nn.logsumexp(logits32, axis=-1)
-        lab = jnp.maximum(masked_lm_labels, 0).astype(jnp.int32)
-        picked = jnp.take_along_axis(logits32, lab[..., None],
-                                     axis=-1)[..., 0]
-        per_tok = lse - picked
+        # MLM loss: ignore_index = -1 (unmasked / padded prediction slots).
+        # With masked_positions, labels are [B, P] aligned to the gathered
+        # slots; dense labels [B, S] take a chunked scan so the fp32
+        # [B, S, V] CE fusion never materializes (the one-fusion version
+        # spilled 208M of vmem registers on TPU at seq 512)
         mask = (masked_lm_labels >= 0).astype(jnp.float32)
         if masked_lm_weights is not None:
             mask = mask * masked_lm_weights.astype(jnp.float32)
-        mlm = jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        lab = jnp.maximum(masked_lm_labels, 0).astype(jnp.int32)
+
+        def ce_sum(lg, lab_c, mask_c):
+            lg = lg.astype(jnp.float32)
+            lse = jax.nn.logsumexp(lg, axis=-1)
+            picked = jnp.take_along_axis(lg, lab_c[..., None],
+                                         axis=-1)[..., 0]
+            return jnp.sum((lse - picked) * mask_c)
+
+        s = logits.shape[1]
+        cs = 128 if (masked_positions is None and s % 128 == 0
+                     and s > 128) else s
+        if cs == s:
+            tot = ce_sum(logits, lab, mask)
+        else:
+            n = s // cs
+            split = lambda a: jnp.moveaxis(  # noqa: E731
+                a.reshape(a.shape[0], n, cs, *a.shape[2:]), 1, 0)
+
+            def chunk(acc, xs):
+                lg, lab_c, mask_c = xs
+                return acc + ce_sum(lg, lab_c, mask_c), None
+
+            tot, _ = jax.lax.scan(
+                jax.checkpoint(chunk), jnp.zeros((), jnp.float32),
+                (split(logits), split(lab), split(mask)))
+        mlm = tot / jnp.maximum(jnp.sum(mask), 1.0)
         if next_sentence_labels is None:
             return mlm
         nsp32 = nsp.astype(jnp.float32)

@@ -62,6 +62,11 @@ def gpt_345m(**kw) -> GPTConfig:
                      num_heads=16, **kw)
 
 
+def gpt_760m(**kw) -> GPTConfig:
+    return GPTConfig(vocab_size=50304, hidden_size=1536, num_layers=24,
+                     num_heads=16, **kw)
+
+
 def gpt_1p3b(**kw) -> GPTConfig:
     return GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
                      num_heads=32, **kw)
@@ -277,7 +282,8 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
                      donate: bool = True, pipeline_schedule: str = "gpipe",
                      remat_policy: str = "dots", loss_chunks: int = 0,
                      zero_stage: int = 2, sequence_zigzag: bool = True,
-                     sequence_mode: str = "ring", offload: bool = False):
+                     sequence_mode: str = "ring", offload: bool = False,
+                     offload_memory_kind: str = "pinned_host"):
     """Build the one compiled hybrid-parallel training step.
 
     Parallelism comes entirely from the mesh axes: 'data' (DP — batch dim),
@@ -679,8 +685,12 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
                       ns(P(("data", "sharding"), seq_axis)))
 
     if offload:
+        # pinned_host is the reference-offload default (DMA-able); some
+        # workers cap the pinned pool well below their RAM — 'unpinned_host'
+        # rests slots in ordinary host memory instead (staged transfers)
         def ns_host(spec):
-            return NamedSharding(mesh, spec, memory_kind="pinned_host")
+            return NamedSharding(mesh, spec,
+                                 memory_kind=offload_memory_kind)
         return _build_offload_chunked_step(
             cfg=cfg, optimizer=optimizer, outer=outer, stacked=stacked,
             opt_state0=opt_state0, opt_spec=opt_spec, ns=ns,
@@ -891,6 +901,14 @@ def _build_offload_chunked_step(*, cfg, optimizer, outer, stacked,
         grad_jit = jax.jit(functools.partial(grad_phase, rng=None),
                            **grad_kwargs)
 
+    # smallest block param: its updated value doubles as a 4-byte
+    # completion probe the orchestrator can ACTUALLY sync on — through
+    # the axon tunnel block_until_ready returns early, so backpressure
+    # must ride a real host transfer (bench.py's float(loss) trick)
+    import numpy as _np
+    probe_name = min(stacked,
+                     key=lambda n: int(_np.prod(stacked[n].shape[1:])))
+
     def chunk_update(stacked_p, g_stacked, slots_chunk, new_step, start):
         p_c = {f"blocks.{n}": jax.lax.dynamic_slice_in_dim(v, start, k, 0)
                for n, v in stacked_p.items()}
@@ -904,7 +922,9 @@ def _build_offload_chunked_step(*, cfg, optimizer, outer, stacked,
                 stacked_p[n], new_p_c[f"blocks.{n}"].astype(
                     stacked_p[n].dtype), start, 0)
             for n in stacked_p}
-        return new_stacked, new_slots
+        probe = jnp.sum(new_p_c[f"blocks.{probe_name}"]).astype(
+            jnp.float32)
+        return new_stacked, new_slots, probe
 
     # slots cross the host<->device boundary OUTSIDE the jits, as plain
     # transfers in the orchestrator below: in-jit memory-space changes
@@ -915,7 +935,7 @@ def _build_offload_chunked_step(*, cfg, optimizer, outer, stacked,
         chunk_update,
         in_shardings=(stacked_shardings, g_stacked_shardings,
                       chunk_slot_dev, ns(P()), None),
-        out_shardings=(stacked_shardings, chunk_slot_dev),
+        out_shardings=(stacked_shardings, chunk_slot_dev, ns(P())),
         donate_argnums=(0, 2) if donate else ())
 
     def outer_update(outer_p, g_outer, outer_slots, new_step):
@@ -929,6 +949,14 @@ def _build_offload_chunked_step(*, cfg, optimizer, outer, stacked,
         out_shardings=(outer_shardings, outer_slot_dev),
         donate_argnums=(0, 2) if donate else ())
 
+    import os as _os
+    _sync = _os.environ.get("PTPU_OFFLOAD_SYNC") == "1"
+
+    def _trace(tag, value):
+        if _sync:
+            jax.block_until_ready(value)
+            print(f"offload-step: {tag} done", flush=True)
+
     def step_fn(state, batch, rng=None):
         if cfg.dropout > 0.0 and rng is None:
             raise ValueError(
@@ -941,23 +969,39 @@ def _build_offload_chunked_step(*, cfg, optimizer, outer, stacked,
         else:
             loss, g_outer, g_stacked, new_step = grad_jit(
                 (outer_p, stacked_p), opt_state["step"], batch)
+        _trace("grad", loss)
         slots = opt_state["slots"]
         new_stacked = stacked_p
         chunk_results = []
+        probes = []
         for ci in range(n_chunks):
+            if ci >= 2:
+                # backpressure: dispatch is async, so without this the
+                # Python loop uploads EVERY chunk's slots before the
+                # first update frees any — the whole optimizer state
+                # lands on device at once and the step OOMs exactly
+                # like the unchunked version. The probe read is a REAL
+                # 4-byte host transfer (block_until_ready returns early
+                # through the axon tunnel): once chunk ci-2's update
+                # has executed, its donated slot buffers are free, so
+                # at most ~2 chunks of slots are in flight on device
+                float(probes[ci - 2])
             slots_chunk = jax.device_put(
                 {n: {sname: slots[n][sname][ci] for sname in slots[n]}
                  for n in stacked_slot_names}, chunk_slot_dev)
-            new_stacked, new_chunk = chunk_jit(
+            new_stacked, new_chunk, probe = chunk_jit(
                 new_stacked, g_stacked, slots_chunk, new_step, starts[ci])
-            # back to pinned_host residence; dropping the device ref
-            # frees the chunk's HBM before chunk ci+2 uploads
+            probes.append(probe)
+            # back to host residence; dropping the device ref frees the
+            # chunk's HBM before chunk ci+2 uploads
             chunk_results.append(
                 jax.device_put(new_chunk, chunk_slot_shardings))
+            _trace(f"chunk {ci}/{n_chunks}", chunk_results[-1])
         outer_slots = jax.device_put(
             {n: slots[n] for n in outer_slot_names}, outer_slot_dev)
         new_outer, new_outer_slots = outer_jit(outer_p, g_outer,
                                                outer_slots, new_step)
+        _trace("outer", new_outer_slots)
         new_outer_slots = jax.device_put(new_outer_slots,
                                          outer_slot_shardings)
         new_slots = {n: {sname: tuple(cr[n][sname]

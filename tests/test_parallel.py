@@ -395,6 +395,67 @@ class TestBert:
                      next_sentence_labels=nsp)
         assert np.isfinite(float(loss))
 
+    def test_masked_positions_gather_matches_dense_loss(self):
+        """The reference head gathers masked_positions before the vocab
+        projection (BertPretrainingHeads.forward); the gathered loss must
+        equal the dense ignore_index(-1) loss over the same mask set."""
+        from paddle_tpu.models import BertForPretraining, bert_tiny
+        pt.seed(0)
+        model = BertForPretraining(bert_tiny())
+        rs = np.random.RandomState(1)
+        b, s, p = 2, 16, 4
+        ids = jnp.asarray(rs.randint(0, 512, (b, s)), jnp.int32)
+        positions = np.stack([np.sort(rs.choice(s, p, replace=False))
+                              for _ in range(b)])
+        labels_p = rs.randint(0, 512, (b, p)).astype(np.int32)
+        labels_p[1, -1] = -1  # ragged prediction count pads with -1
+        dense = np.full((b, s), -1, np.int32)
+        for i in range(b):
+            for j in range(p):
+                if labels_p[i, j] >= 0:
+                    dense[i, positions[i, j]] = labels_p[i, j]
+        nsp = jnp.asarray([0, 1], jnp.int32)
+        l_gather = model(ids, masked_lm_labels=jnp.asarray(labels_p),
+                         next_sentence_labels=nsp,
+                         masked_positions=jnp.asarray(positions))
+        l_dense = model(ids, masked_lm_labels=jnp.asarray(dense),
+                        next_sentence_labels=nsp)
+        np.testing.assert_allclose(float(l_gather), float(l_dense),
+                                   rtol=1e-5)
+
+    def test_bert_chunked_dense_ce_matches_unchunked(self):
+        """Dense [B,S] labels at seq % 128 == 0 take the chunked-scan CE
+        (the one-fusion version spilled vmem on TPU); same loss."""
+        from paddle_tpu.models import BertForPretraining, bert_tiny
+        pt.seed(0)
+        # max_position_embeddings must cover the 256-seq chunked path
+        # (128-pos default gathers OOB -> NaN, and allclose(nan, nan)
+        # passes silently)
+        model = BertForPretraining(
+            bert_tiny(max_position_embeddings=256))
+        rs = np.random.RandomState(2)
+        ids = jnp.asarray(rs.randint(0, 512, (2, 256)), jnp.int32)
+        labels = jnp.where(jnp.asarray(rs.rand(2, 256) < 0.15), ids, -1)
+        nsp = jnp.asarray([0, 1], jnp.int32)
+        l_chunked = model(ids, masked_lm_labels=labels,
+                          next_sentence_labels=nsp)
+        # numpy reference over the returned logits (no-labels call)
+        logits, nsp_logits = model(ids)
+        lg = np.asarray(logits, np.float32)
+        lse = np.log(np.exp(lg - lg.max(-1, keepdims=True)).sum(-1)) \
+            + lg.max(-1)
+        lab = np.maximum(np.asarray(labels), 0)
+        picked = np.take_along_axis(lg, lab[..., None], -1)[..., 0]
+        m = (np.asarray(labels) >= 0).astype(np.float32)
+        mlm = ((lse - picked) * m).sum() / m.sum()
+        ns = np.asarray(nsp_logits, np.float32)
+        ns_lse = np.log(np.exp(ns - ns.max(-1, keepdims=True)).sum(-1)) \
+            + ns.max(-1)
+        ns_picked = np.take_along_axis(
+            ns, np.asarray(nsp)[:, None], -1)[:, 0]
+        expected = mlm + (ns_lse - ns_picked).mean()
+        np.testing.assert_allclose(float(l_chunked), expected, rtol=2e-5)
+
     def test_bert_padding_mask(self):
         from paddle_tpu.models import BertModel, bert_tiny
         pt.seed(0)
