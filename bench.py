@@ -310,7 +310,12 @@ def _bench_resnet_at(batch: int) -> float:
     # layout-assignment transposes around each conv+BN (VERDICT r3
     # item 2); weights stay OIHW so state dicts are unchanged
     fmt = os.environ.get("PTPU_BENCH_CONV_FORMAT", "NHWC")
-    model = resnet50(data_format=fmt)
+    # space_to_depth stem is an EXACT reformulation of the 7x7/s2 stem
+    # (tests/test_vision_additions.py::TestSpaceToDepthStem); C_in 3->12
+    # turns the worst-utilization conv into dense MXU work
+    stem = os.environ.get("PTPU_BENCH_RESNET_STEM",
+                          "space_to_depth" if fmt == "NHWC" else "conv")
+    model = resnet50(data_format=fmt, stem=stem)
     opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
     params = trainable_state(model)
     buffers = buffer_state(model)

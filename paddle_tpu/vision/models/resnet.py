@@ -93,17 +93,29 @@ class ResNet(Layer):
     _DEPTH_CFG = _DEPTH_CFG
 
     def __init__(self, block, depth, num_classes=1000, with_pool=True,
-                 groups=1, width_per_group=64, data_format="NCHW"):
+                 groups=1, width_per_group=64, data_format="NCHW",
+                 stem="conv"):
         super().__init__()
         # reference takes the int depth (50/101/...); a per-stage list is
         # also accepted for custom stacks. data_format="NHWC" runs the
         # whole trunk channels-last — the TPU-native conv layout (no
         # layout-assignment transposes around each conv+BN); weights stay
         # OIHW so state dicts are format-independent.
+        # stem="space_to_depth" computes the SAME stem conv as an exact
+        # 4x4/stride-1 convolution on 2x2-block-flattened input (the
+        # MLPerf TPU formulation): C_in goes 3 -> 12 and the stride-2
+        # 7x7 kernel becomes dense MXU work; conv1's stored weight stays
+        # [64, 3, 7, 7] (state-dict parity) and is re-laid-out at
+        # trace time. NHWC-only.
         depth_cfg = self._DEPTH_CFG[depth] if isinstance(depth, int) \
             else list(depth)
         df = data_format
         self.data_format = df
+        if stem not in ("conv", "space_to_depth"):
+            raise ValueError(f"unknown stem {stem!r}")
+        if stem == "space_to_depth" and df != "NHWC":
+            raise ValueError("space_to_depth stem requires NHWC")
+        self.stem = stem
         self.inplanes = 64
         self.groups = groups
         self.base_width = width_per_group
@@ -140,8 +152,37 @@ class ResNet(Layer):
                                 data_format=df))
         return Sequential(*layers)
 
+    def _stem_space_to_depth(self, x):
+        """Exact reformulation of conv1 (7x7 stride 2 pad 3): pad the
+        kernel to 8x8 (one zero row/col top-left), view both kernel and
+        input as 2x2 sub-pixel phases, and convolve 4x4 stride 1 over
+        the [B, H/2, W/2, 4*C] space-to-depth input. Same math as
+        y[p,q] = sum x[2p+i-3, 2q+j-3, c] w[i,j,c] with i=2a+r-1:
+        x phase (r,s) at block (p-2+a, q-2+b) times w8[2a+r, 2b+s, c]."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        b, h, w, c = x.shape
+        xs = x.reshape(b, h // 2, 2, w // 2, 2, c)
+        xs = jnp.transpose(xs, (0, 1, 3, 2, 4, 5))
+        xs = xs.reshape(b, h // 2, w // 2, 4 * c)
+        wt = self.conv1.weight.value          # [O, C, 7, 7] stored OIHW
+        o = wt.shape[0]
+        w8 = jnp.pad(wt, ((0, 0), (0, 0), (1, 0), (1, 0)))
+        # w8[o, c, 2a+r, 2b+s] -> ws[o, (r, s, c), a, b]
+        ws = w8.reshape(o, c, 4, 2, 4, 2)
+        ws = jnp.transpose(ws, (0, 3, 5, 1, 2, 4))   # o, r, s, c, a, b
+        ws = ws.reshape(o, 4 * c, 4, 4)
+        from ...amp.auto_cast import maybe_autocast
+        xs, ws = maybe_autocast(xs, ws, op="conv")
+        return lax.conv_general_dilated(
+            xs, ws, window_strides=(1, 1), padding=((2, 1), (2, 1)),
+            dimension_numbers=("NHWC", "OIHW", "NHWC"))
+
     def forward(self, x):
-        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        x = (self._stem_space_to_depth(x)
+             if self.stem == "space_to_depth" else self.conv1(x))
+        x = self.maxpool(self.relu(self.bn1(x)))
         x = self.layer1(x)
         x = self.layer2(x)
         x = self.layer3(x)

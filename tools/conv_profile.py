@@ -17,6 +17,7 @@ axon tunnel (see bench.py header).
 from __future__ import annotations
 
 import functools
+import os
 import sys
 import time
 
@@ -108,12 +109,11 @@ def main(batch=256):
     def opt_only(p, g, s):
         return opt.apply(p, g, s)
 
-    _, g = jax.jit(lambda p, b: grads_only(p, b, x, y))(params, buffers)
+    _, g = grads_only(params, buffers, x, y)
     dt = timed(lambda: opt_only(params, g, opt_state), steps=6)
     print(f"optimizer      : {dt * 1e3:8.2f} ms", flush=True)
 
     # ---- per-stage forward (eval-mode BN: frozen running stats) ----
-    import jax.numpy as jnp2  # noqa: F401
     model.eval()
 
     def sub_tree(tree, prefix):
@@ -148,7 +148,10 @@ def main(batch=256):
     model.train()
 
     # ---- conv microbench over ResNet-50 shapes ----
-    peak = 197e12 if "v5 lite" in dev.device_kind else 459e12
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import peak_flops
+    peak = peak_flops(dev.device_kind)
     shapes = [
         # (H, Cin, Cout, k, stride)  NHWC fwd shapes of ResNet-50
         (224, 3, 64, 7, 2),

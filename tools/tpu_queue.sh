@@ -1,0 +1,50 @@
+#!/bin/bash
+# Round-4 TPU measurement queue. Run when the axon tunnel is healthy.
+# Each item is an isolated subprocess with a hard timeout; results
+# persist to BENCH_PARTIAL.json via bench.py's checkpointing, and this
+# script's log captures everything else. Safe to re-run — bench items
+# overwrite their own entries.
+set -u
+cd "$(dirname "$0")/.."
+LOG=tpu_queue.log
+echo "=== tpu_queue $(date -u +%FT%TZ) ===" | tee -a "$LOG"
+
+probe() {
+  timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1
+}
+
+run_item() {
+  local name="$1" tmo="$2"; shift 2
+  echo "--- $name ($(date -u +%T)) ---" | tee -a "$LOG"
+  timeout "$tmo" "$@" >>"$LOG" 2>&1
+  local rc=$?
+  echo "--- $name rc=$rc ---" | tee -a "$LOG"
+  return $rc
+}
+
+if ! probe; then
+  echo "tunnel down; aborting" | tee -a "$LOG"
+  exit 1
+fi
+
+# 1. BERT (masked_positions fix) — expect minutes, not a 20-min spill
+run_item bert 900 env PTPU_BENCH_ONLY=bert python bench.py
+
+# 2. Config 5 ladder: 1.3B with unpinned_host offload, fall to 760M
+if ! run_item ernie_1p3b 1800 env PTPU_BENCH_ONLY=ernie:1p3b python bench.py; then
+  probe || { echo "tunnel died after 1p3b" | tee -a "$LOG"; exit 1; }
+  run_item ernie_0p76b 1200 env PTPU_BENCH_ONLY=ernie:0p76b python bench.py
+fi
+
+probe || { echo "tunnel died" | tee -a "$LOG"; exit 1; }
+
+# 3. ResNet stems A/B at the two best batches
+run_item resnet_s2d_256 900 env PTPU_BENCH_ONLY=resnet:256 python bench.py
+run_item resnet_s2d_512 900 env PTPU_BENCH_ONLY=resnet:512 python bench.py
+run_item resnet_conv_256 900 env PTPU_BENCH_RESNET_STEM=conv \
+  PTPU_BENCH_ONLY=resnet:256 python bench.py
+
+# 4. Decomposition profile (batch 256)
+run_item conv_profile 1200 python tools/conv_profile.py 256
+
+echo "=== queue done $(date -u +%FT%TZ) ===" | tee -a "$LOG"
