@@ -65,7 +65,11 @@ def persist_partial(entry: dict) -> None:
             data = []
     except Exception:  # noqa: BLE001 — never let bookkeeping kill a bench
         data = []
-    data = [e for e in data if e.get("metric") != entry.get("metric")]
+    def key(e):
+        # A/B arms (stem, size) of one metric must not clobber each other
+        return (e.get("metric"), e.get("batch"), e.get("stem"),
+                e.get("size"))
+    data = [e for e in data if key(e) != key(entry)]
     data.append(dict(entry, ts=time.strftime("%Y-%m-%dT%H:%M:%S")))
     try:
         tmp = PARTIAL_PATH + ".tmp"
@@ -359,6 +363,11 @@ def bench_resnet(batch: int = 64) -> dict:
     return {"metric": "resnet50_train_imgs_per_sec_per_chip",
             "value": round(imgs, 1), "unit": "imgs/s/chip",
             "batch": batch,
+            "stem": os.environ.get(
+                "PTPU_BENCH_RESNET_STEM",
+                "space_to_depth" if os.environ.get(
+                    "PTPU_BENCH_CONV_FORMAT", "NHWC") == "NHWC"
+                else "conv"),
             "vs_baseline": round(mfu / 0.35, 4)}
 
 
@@ -443,8 +452,13 @@ def bench_ernie(size: str = "2p6b") -> dict:
     seq, batch, steps, warmup = 1024, 1 * n_dev, 8, 2
     mesh = build_mesh(dp=n_dev)
     model = GPTForPretraining(cfg)
+    # >=2.6B: params must rest bf16 (fp32 params+grads alone exceed
+    # HBM); fp32 master weights join the host-offloaded slots
+    # (reference pure-fp16 + multi-precision adam)
+    o2 = size in ("10b", "6p7b", "2p6b")
     opt = pt.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
-                             grad_clip=pt.nn.ClipGradByGlobalNorm(1.0))
+                             grad_clip=pt.nn.ClipGradByGlobalNorm(1.0),
+                             multi_precision=o2)
     # pinned_host can exhaust the worker's DMA pool at 1.3B+ slot sizes
     # (the whole axon session dies RESOURCE_EXHAUSTED after step 1);
     # unpinned host RAM is the robust resting space for the bench
@@ -452,7 +466,8 @@ def bench_ernie(size: str = "2p6b") -> dict:
         model, opt, mesh, remat=True, remat_policy="full", loss_chunks=8,
         offload=True,
         offload_memory_kind=os.environ.get("PTPU_OFFLOAD_MEMKIND",
-                                           "unpinned_host"))
+                                           "unpinned_host"),
+        param_dtype=jnp.bfloat16 if o2 else None)
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)),
                       jnp.int32)
@@ -551,6 +566,9 @@ def _child_only(only: str) -> int:
             fns = {"resnet": bench_resnet, "yolo": bench_yolo,
                    "bert": bench_bert}
             res = fns[name](batch=int(batch)) if batch else fns[name]()
+        # checkpoint directly: standalone PTPU_BENCH_ONLY runs (e.g.
+        # tools/tpu_queue.sh) must survive a later tunnel wedge too
+        persist_partial(res)
         print(json.dumps(res), flush=True)
         return 0
     except Exception as e:  # noqa: BLE001

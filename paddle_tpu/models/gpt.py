@@ -283,7 +283,8 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
                      remat_policy: str = "dots", loss_chunks: int = 0,
                      zero_stage: int = 2, sequence_zigzag: bool = True,
                      sequence_mode: str = "ring", offload: bool = False,
-                     offload_memory_kind: str = "pinned_host"):
+                     offload_memory_kind: str = "pinned_host",
+                     param_dtype=None):
     """Build the one compiled hybrid-parallel training step.
 
     Parallelism comes entirely from the mesh axes: 'data' (DP — batch dim),
@@ -333,6 +334,22 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
 
     outer, block_list = _split_params(model)
     stacked = stack_stage_params(block_list)  # leaves [L, ...]
+    if param_dtype is not None:
+        # O2-style residency: params rest in param_dtype (bf16 halves
+        # param+grad HBM — the 2.6B offload point exists because of
+        # this); pair with optimizer multi_precision=True so fp32
+        # master weights live in the (host-offloadable) slots.
+        # Reference: pure-fp16 + master weights
+        # (`contrib/mixed_precision/decorator.py`, adam multi-precision)
+        cast = lambda v: (v.astype(param_dtype)  # noqa: E731
+                          if jnp.issubdtype(v.dtype, jnp.floating) else v)
+        outer = {n: cast(v) for n, v in outer.items()}
+        stacked = {n: cast(v) for n, v in stacked.items()}
+        if not getattr(optimizer, "_multi_precision", False):
+            warnings.warn(
+                "param_dtype set without optimizer multi_precision=True: "
+                "no fp32 master weights — low-precision updates will "
+                "accumulate rounding error", stacklevel=2)
     template = model.gpt.layers[0]
 
     def block_apply(bparams, x):

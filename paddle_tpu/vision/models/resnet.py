@@ -163,6 +163,10 @@ class ResNet(Layer):
         from jax import lax
 
         b, h, w, c = x.shape
+        if h % 2 or w % 2:
+            raise ValueError(
+                f"space_to_depth stem needs even spatial dims, got "
+                f"{h}x{w}; use stem='conv' for odd input sizes")
         xs = x.reshape(b, h // 2, 2, w // 2, 2, c)
         xs = jnp.transpose(xs, (0, 1, 3, 2, 4, 5))
         xs = xs.reshape(b, h // 2, w // 2, 4 * c)

@@ -226,6 +226,42 @@ class TestOffload:
         leaf = jax.tree.leaves(state_off[2]["slots"][some])[0]
         assert float(jnp.min(leaf)) >= 0.5
 
+    def test_o2_offload_bf16_params_fp32_master(self):
+        """param_dtype=bf16 + multi_precision: params rest bf16 on
+        device (halving param+grad HBM — the 2.6B single-chip point),
+        fp32 master weights rest in host memory with the moments, and
+        training still converges. Reference: pure-fp16 decorator +
+        adam multi-precision."""
+        import paddle_tpu as pt
+        from paddle_tpu.models import GPTForPretraining, \
+            build_train_step, gpt_tiny
+
+        pt.seed(0)
+        cfg = gpt_tiny()
+        mesh = build_mesh(dp=2)
+        m = GPTForPretraining(cfg)
+        o = pt.optimizer.AdamW(learning_rate=1e-3, weight_decay=0.01,
+                               grad_clip=pt.nn.ClipGradByGlobalNorm(1.0),
+                               multi_precision=True)
+        step, state = build_train_step(m, o, mesh, offload=True,
+                                       param_dtype=jnp.bfloat16)
+        outer_p, stacked_p, opt_state = state
+        assert all(v.dtype == jnp.bfloat16 for v in outer_p.values())
+        assert all(v.dtype == jnp.bfloat16 for v in stacked_p.values())
+        s0 = next(v for n, v in opt_state["slots"].items()
+                  if n.startswith("blocks."))
+        master = s0["master"][0]
+        assert master.dtype == jnp.float32
+        assert master.sharding.memory_kind == "pinned_host"
+        rs = np.random.RandomState(0)
+        ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (4, 32)),
+                          jnp.int32)
+        losses = []
+        for _ in range(8):
+            state, loss = step(state, (ids, ids))
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] - 0.5, losses
+
     def test_offload_rejects_norm_based_optimizers(self):
         import paddle_tpu as pt
         from paddle_tpu.models import GPTForPretraining, \
