@@ -334,6 +334,7 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
 
     outer, block_list = _split_params(model)
     stacked = stack_stage_params(block_list)  # leaves [L, ...]
+    master_src = (outer, stacked)  # pre-cast fp32 leaves for master init
     if param_dtype is not None:
         # O2-style residency: params rest in param_dtype (bf16 halves
         # param+grad HBM — the 2.6B offload point exists because of
@@ -544,6 +545,16 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
         opt_state0 = jax.eval_shape(optimizer.init_state, flatname_params)
     else:
         opt_state0 = optimizer.init_state(flatname_params)
+        if param_dtype is not None:
+            # masters must come from the PRE-cast fp32 weights — fp32
+            # (bf16(w)) throws away the mantissa bits the masters exist
+            # to keep
+            m_outer, m_stacked = master_src
+            for n, slots in opt_state0["slots"].items():
+                if "master" in slots:
+                    src = (m_stacked[n[len("blocks."):]]
+                           if n.startswith("blocks.") else m_outer[n])
+                    slots["master"] = src.astype(jnp.float32)
 
     def value_and_grad_1f1b(params, batch, rng=None):
         """Loss + grads via the 1F1B schedule (SectionWorker mode 1,
@@ -715,7 +726,8 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
             loss_and_grads=_loss_and_grads,
             outer_param_specs=outer_param_specs,
             stacked_param_specs=stacked_param_specs,
-            batch_sharding=batch_sharding, donate=donate, pp=pp)
+            batch_sharding=batch_sharding, donate=donate, pp=pp,
+            master_src=master_src)
 
     is_spec = lambda s: isinstance(s, P)  # noqa: E731
     opt_state_shardings = jax.tree.map(ns, opt_state_specs,
@@ -754,7 +766,8 @@ def _build_offload_chunked_step(*, cfg, optimizer, outer, stacked,
                                 opt_state0, opt_spec, ns, ns_host,
                                 shard_axis, loss_and_grads,
                                 outer_param_specs, stacked_param_specs,
-                                batch_sharding, donate, pp):
+                                batch_sharding, donate, pp,
+                                master_src=None):
     """Host-offloaded train step with a CHUNKED optimizer update.
 
     The reference's sharding offload (`fleet/meta_optimizers/sharding/
@@ -828,14 +841,20 @@ def _build_offload_chunked_step(*, cfg, optimizer, outer, stacked,
     stacked_slot_names = [n for n in slot_struct if n.startswith("blocks.")]
     outer_slot_names = [n for n in slot_struct
                         if not n.startswith("blocks.")]
+    # master weights init from the PRE-param_dtype-cast fp32 leaves
+    m_outer, m_stacked = master_src if master_src is not None \
+        else (outer, stacked)
 
     chunk_slot_shardings = {}   # pname -> {sname: host sharding (chunk)}
     chunk_slot_dev = {}         # same specs, device memory (stream target)
     slots_host = {}             # pname -> {sname: tuple of n_chunks arrays}
     for pname in stacked_slot_names:
-        src = stacked[pname[len("blocks."):]]
-        init_vals = init_slot_values((k,) + tuple(src.shape[1:]),
-                                     src.dtype)
+        # slot template from the RESIDENT (possibly cast) params so
+        # moment dtypes match slot_struct; masters from the fp32 source
+        src_cast = stacked[pname[len("blocks."):]]
+        src_master = m_stacked[pname[len("blocks."):]]
+        init_vals = init_slot_values((k,) + tuple(src_cast.shape[1:]),
+                                     src_cast.dtype)
         per_shard, per_chunks, per_dev = {}, {}, {}
         for sname, sd in slot_struct[pname].items():
             cshape = (k,) + tuple(sd.shape[1:])
@@ -848,7 +867,8 @@ def _build_offload_chunked_step(*, cfg, optimizer, outer, stacked,
                 per_chunks[sname] = tuple(
                     jax.device_put(
                         onp.asarray(jax.device_get(
-                            src[ci * k:(ci + 1) * k]), onp.float32),
+                            src_master[ci * k:(ci + 1) * k]),
+                            onp.float32),
                         hshard)
                     for ci in range(n_chunks))
             else:
@@ -873,8 +893,8 @@ def _build_offload_chunked_step(*, cfg, optimizer, outer, stacked,
             per_dev[sname] = ns(opt_spec(pname, sd))
             if sname == "master":
                 per[sname] = jax.device_put(
-                    onp.asarray(jax.device_get(outer[pname]), onp.float32),
-                    hshard)
+                    onp.asarray(jax.device_get(m_outer[pname]),
+                                onp.float32), hshard)
             else:
                 per[sname] = jax.device_put(init_vals[sname], hshard)
         outer_slot_shardings[pname] = per_shard

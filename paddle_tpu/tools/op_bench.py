@@ -116,6 +116,40 @@ def _standard_ops() -> Dict[str, Callable]:
         lens = jnp.asarray(rs.randint(64, 256, (32,)), jnp.int32)
         return (lambda: S.sequence_topk_avg_pooling(x, lens, (1, 3, 5)))
 
+    def masked_flash_attention():
+        # r4 kernel path: k-side padding mask variant of the Pallas
+        # flash kernel (falls back to XLA off-TPU — still a valid gate)
+        from ..nn import functional as F
+        q = jnp.asarray(rs.randn(4, 256, 8, 64), jnp.bfloat16)
+        mask = jnp.asarray(
+            np.arange(256)[None, None, None, :] <
+            rs.randint(128, 257, (4,))[:, None, None, None])
+        return (lambda: F.scaled_dot_product_attention(
+            q, q, q, attn_mask=mask))
+
+    def s2d_stem():
+        # r4 conv path: space-to-depth stem reformulation
+        from ..vision.models import resnet18
+        import paddle_tpu as pt
+        pt.seed(0)
+        m = resnet18(data_format="NHWC", stem="space_to_depth",
+                     num_classes=0, with_pool=False)
+        m.eval()
+        x = jnp.asarray(rs.randn(4, 64, 64, 3), jnp.float32)
+        return (lambda: m._stem_space_to_depth(x))
+
+    def chunked_mlm_ce():
+        # r4 loss path: BERT dense-label CE via checkpointed chunk scan
+        from ..models import BertForPretraining, bert_tiny
+        import paddle_tpu as pt
+        pt.seed(0)
+        model = BertForPretraining(bert_tiny(max_position_embeddings=256))
+        ids = jnp.asarray(rs.randint(0, 512, (2, 256)), jnp.int32)
+        lab = jnp.where(jnp.asarray(rs.rand(2, 256) < 0.15), ids, -1)
+        nsp = jnp.asarray([0, 1], jnp.int32)
+        return (lambda: model(ids, masked_lm_labels=lab,
+                              next_sentence_labels=nsp))
+
     def ps_push_pull():
         # keeps the PS wire honest (VERDICT r3 weak 6): pickle round-trip
         # cost of one dense push+pull through the table codec
@@ -135,6 +169,8 @@ def _standard_ops() -> Dict[str, Callable]:
             "deform_conv2d": deform_conv2d, "grid_sample": grid_sample,
             "beam_search": beam_search, "iou_similarity": iou_similarity,
             "matrix_nms": matrix_nms, "seq_topk_pool": seq_topk_pool,
+            "masked_flash_attention": masked_flash_attention,
+            "s2d_stem": s2d_stem, "chunked_mlm_ce": chunked_mlm_ce,
             "ps_push_pull": ps_push_pull}
 
 
