@@ -88,3 +88,13 @@ def test_dcgan_example():
     # generator MOVED toward the data distribution: closer to data_mean
     # than a fresh (near-zero-mean) tanh generator starts
     assert abs(fake_mean - data_mean) < 0.75 * abs(data_mean)
+
+
+def test_ernie_offload_pretrain_example():
+    import ernie_offload_pretrain
+    losses, kinds = ernie_offload_pretrain.main(steps=6)
+    assert losses[-1] < losses[0]
+    # the point of the example: slots (incl. masters) rest on the host
+    assert kinds and all(k in ("pinned_host", "unpinned_host")
+                         for k in kinds.values()), kinds
+    assert "master" in kinds
