@@ -262,6 +262,32 @@ class TestOffload:
             losses.append(float(loss))
         assert losses[-1] < losses[0] - 0.5, losses
 
+    def test_offload_with_dropout_threads_rng(self):
+        """cfg.dropout > 0 routes the per-step key through the chunked
+        grad jit; a missing key must raise, fresh keys must train."""
+        import paddle_tpu as pt
+        from paddle_tpu.models import GPTForPretraining, \
+            build_train_step, gpt_tiny
+
+        pt.seed(0)
+        cfg = gpt_tiny(dropout=0.1)
+        mesh = build_mesh(dp=2)
+        m = GPTForPretraining(cfg)
+        o = pt.optimizer.AdamW(learning_rate=1e-3)
+        step, state = build_train_step(m, o, mesh, offload=True)
+        rs = np.random.RandomState(0)
+        ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (4, 32)),
+                          jnp.int32)
+        key = jax.random.PRNGKey(0)
+        losses = []
+        for i in range(5):
+            state, loss = step(state, (ids, ids),
+                               jax.random.fold_in(key, i))
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+        with pytest.raises(ValueError, match="rng"):
+            step(state, (ids, ids))
+
     def test_offload_rejects_norm_based_optimizers(self):
         import paddle_tpu as pt
         from paddle_tpu.models import GPTForPretraining, \

@@ -30,10 +30,14 @@ fi
 # 1. BERT (masked_positions fix) — expect minutes, not a 20-min spill
 run_item bert 900 env PTPU_BENCH_ONLY=bert python bench.py
 
-# 2. Config 5 ladder: 1.3B with unpinned_host offload, fall to 760M
-if ! run_item ernie_1p3b 1800 env PTPU_BENCH_ONLY=ernie:1p3b python bench.py; then
+# 2. Config 5 ladder, ASCENDING: bank the known-good 760M number first
+# (a bigger size can wedge the tunnel and cost the rest of the window),
+# then climb 1.3B -> 2.6B (bf16 + fp32 host masters), probing between
+run_item ernie_0p76b 1200 env PTPU_BENCH_ONLY=ernie:0p76b python bench.py
+probe || { echo "tunnel died after 0p76b" | tee -a "$LOG"; exit 1; }
+if run_item ernie_1p3b 1800 env PTPU_BENCH_ONLY=ernie:1p3b python bench.py; then
   probe || { echo "tunnel died after 1p3b" | tee -a "$LOG"; exit 1; }
-  run_item ernie_0p76b 1200 env PTPU_BENCH_ONLY=ernie:0p76b python bench.py
+  run_item ernie_2p6b 1800 env PTPU_BENCH_ONLY=ernie:2p6b python bench.py
 fi
 
 probe || { echo "tunnel died" | tee -a "$LOG"; exit 1; }
