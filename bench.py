@@ -66,9 +66,10 @@ def persist_partial(entry: dict) -> None:
     except Exception:  # noqa: BLE001 — never let bookkeeping kill a bench
         data = []
     def key(e):
-        # A/B arms (stem, size) of one metric must not clobber each other
+        # A/B arms (stem, size, headline variant) of one metric must
+        # not clobber each other
         return (e.get("metric"), e.get("batch"), e.get("stem"),
-                e.get("size"))
+                e.get("size"), e.get("config"))
 
     def stale(e):
         # rows written before a field existed (e.g. pre-'stem' resnet
@@ -76,7 +77,7 @@ def persist_partial(entry: dict) -> None:
         # config: treat their missing fields as wildcards
         if e.get("metric") != entry.get("metric"):
             return False
-        for f in ("batch", "stem", "size"):
+        for f in ("batch", "stem", "size", "config"):
             if e.get(f) is not None and e.get(f) != entry.get(f):
                 return False
         return True
@@ -183,12 +184,17 @@ def _timed_steps(step, state, steps, warmup):
 
 # ---------------------------------------------------------------- configs
 
-def bench_gpt(on_tpu: bool) -> dict:
+def bench_gpt(on_tpu: bool, variant: str = "") -> dict:
     """BASELINE config 3 (headline): GPT-345M, hybrid-capable train step.
 
     Winning single-chip config measured r3 on v5e: batch 8, selective
     remat (dots policy), chunked fused logits+CE (8 chunks), Pallas
-    flash attention at seq 1024 → 31.4k tok/s/chip = 38.6% MFU."""
+    flash attention at seq 1024 → 31.4k tok/s/chip = 38.6% MFU.
+
+    `variant` arms explore the remaining headroom AFTER the known-good
+    number is banked: 'b16' doubles the batch, 'nr' drops remat (345M
+    activations fit HBM — recompute is pure overhead if so), 'b16nr'
+    both. main() replaces the final headline if an arm is faster."""
     import jax
     import jax.numpy as jnp
     import paddle_tpu as pt
@@ -200,7 +206,7 @@ def bench_gpt(on_tpu: bool) -> dict:
     seq = 1024
     if on_tpu:
         cfg = gpt_345m()
-        batch = 8 * n_dev
+        batch = (16 if "b16" in variant else 8) * n_dev
         steps, warmup, chunks = 20, 3, 8
     else:  # local smoke / degraded: tiny config runnable anywhere
         from paddle_tpu.models import gpt_tiny
@@ -214,7 +220,8 @@ def bench_gpt(on_tpu: bool) -> dict:
     opt = pt.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
                              grad_clip=pt.nn.ClipGradByGlobalNorm(1.0))
     step, state = build_train_step(model, opt, mesh, num_microbatches=1,
-                                   remat=True, remat_policy="dots",
+                                   remat="nr" not in variant,
+                                   remat_policy="dots",
                                    loss_chunks=chunks)
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)),
@@ -232,6 +239,7 @@ def bench_gpt(on_tpu: bool) -> dict:
                   if on_tpu else "gpt_tiny_cpu_tokens_per_sec",
         "value": round(tokens_per_sec_chip, 1),
         "unit": "tokens/s/chip",
+        "config": variant or "base",
         "vs_baseline": round(mfu / 0.35, 4),
     }
 
@@ -544,10 +552,12 @@ _SECONDARY_LADDERS = (
     ("resnet", (768, 512, 256), 600),
     ("yolo", (48, 32, 24), 600),
     ("bert", (None,), 600),
-    # config 5 ladder: walk DOWN from 10B until one fits the chip; the
-    # "best" pick keys on value, so report ONLY the largest that ran —
-    # each failed size exits nonzero and is skipped
-    ("ernie", ("10b", "6p7b", "2p6b", "1p3b", "0p76b"), 900),
+    # config 5 ladder, ASCENDING: bank the known-good smallest size
+    # first, then climb until a size fails — a big-size runtime OOM can
+    # wedge the tunnel (r4: a 1.3B pinned-pool exhaustion killed the
+    # whole session), and descending would lose every size behind it.
+    # Reported best = the LARGEST size that ran.
+    ("ernie", ("0p76b", "1p3b", "2p6b", "6p7b", "10b"), 900),
 )
 
 
@@ -559,10 +569,10 @@ def _run_secondary_ladder(name: str, batches, timeout: float) -> None:
         if res is not None:
             results.append(res)
             persist_partial(res)  # checkpoint every attempt, not just best
-            if name == "ernie":
-                break  # sizes walk DOWN: first success = largest that fits
+        elif name == "ernie":
+            break  # sizes climb UP: first failure ends the ladder
     if results:
-        best = results[0] if name == "ernie" else \
+        best = results[-1] if name == "ernie" else \
             max(results, key=lambda r: r.get("value", 0.0))
         persist_partial(best)
         print(json.dumps(best), flush=True)
@@ -578,7 +588,8 @@ def _child_only(only: str) -> int:
     try:
         if name == "gpt":
             import jax
-            res = bench_gpt(jax.default_backend() == "tpu")
+            res = bench_gpt(jax.default_backend() == "tpu",
+                            variant=batch)
         elif name == "ernie":
             res = bench_ernie(size=batch) if batch else bench_ernie()
         else:
@@ -630,7 +641,26 @@ def main():
                     time.sleep(60)
                 if os.environ.get("PTPU_BENCH_SECONDARY", "1") == "1":
                     for name, batches, timeout in _SECONDARY_LADDERS:
-                        _run_secondary_ladder(name, batches, timeout)
+                        if name != "ernie":
+                            _run_secondary_ladder(name, batches, timeout)
+                    # headline variant arms AFTER the safe configs:
+                    # replace the final headline if one is faster. The
+                    # child already persisted (TPU-only guard); only a
+                    # REAL TPU headline metric may be promoted — a
+                    # CPU-fallback child reports the tiny-model metric
+                    # and must never become the headline
+                    for var in ("b16", "nr", "b16nr"):
+                        res = _run_secondary_attempt(f"gpt:{var}", 700)
+                        if (res is not None and res.get("metric") ==
+                                "gpt345m_pretrain_tokens_per_sec_per_chip"
+                                and (out is None
+                                     or res["value"] > out["value"])):
+                            out = res
+                    # the offload ladder LAST: a big-size runtime OOM
+                    # can wedge the tunnel for the rest of the run
+                    for name, batches, timeout in _SECONDARY_LADDERS:
+                        if name == "ernie":
+                            _run_secondary_ladder(name, batches, timeout)
                 if out is None:  # headline child never succeeded
                     out = bench_gpt(on_tpu)
                     persist_partial(out)
