@@ -129,3 +129,35 @@ class TestOnnxExport:
             with pytest.raises(UnsupportedPrimitive):
                 export(Weird(), os.path.join(td, "w"),
                        input_spec=[np.ones((4,), np.float32)])
+
+
+class TestTransformerExport:
+    def test_bert_encoder_exports_and_matches(self):
+        """A full transformer encoder (embeddings + gather, einsum
+        attention, softmax, gelu, layernorm, pooler tanh) exports to
+        real ONNX wire format and the numpy runtime reproduces the
+        bf16-computed forward within bf16 tolerance (reference:
+        paddle2onnx exporting BERT)."""
+        import os
+        import tempfile
+
+        import jax.numpy as jnp
+        from paddle_tpu.models import BertModel, bert_tiny
+        from paddle_tpu.static import InputSpec
+
+        pt.seed(0)
+        m = BertModel(bert_tiny())
+        m.eval()
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "bert")
+            export(m, path, input_spec=[InputSpec([2, 16], "int64")])
+            model = reference_runtime.load(path + ".onnx")
+            ids = np.random.RandomState(0).randint(
+                0, 512, (2, 16)).astype("int64")
+            outs = reference_runtime.run(model, {"x0": ids})
+        seq, pooled = m(jnp.asarray(ids.astype("int32")))
+        np.testing.assert_allclose(outs[0], np.asarray(seq, np.float32),
+                                   rtol=0.05, atol=0.05)
+        np.testing.assert_allclose(outs[1],
+                                   np.asarray(pooled, np.float32),
+                                   rtol=0.05, atol=0.05)
