@@ -178,3 +178,55 @@ class TestAttentionTorchParity:
             out_t = theirs(torch.tensor(x))
         np.testing.assert_allclose(np.asarray(out_o), out_t.numpy(),
                                    rtol=1e-5, atol=1e-5)
+
+
+class TestConvBnTorchParity:
+    """Conv2D / BatchNorm2D numerics vs torch (reference kernels:
+    conv_cudnn_op, batch_norm_op)."""
+
+    @pytest.mark.parametrize("stride,padding,dilation,groups", [
+        (1, 1, 1, 1), (2, 2, 1, 1), (1, 2, 2, 1), (1, 1, 1, 4)])
+    def test_conv2d_matches_torch(self, stride, padding, dilation, groups):
+        pt.seed(6)
+        ours = nn.Conv2D(8, 16, 3, stride=stride, padding=padding,
+                         dilation=dilation, groups=groups)
+        theirs = torch.nn.Conv2d(8, 16, 3, stride=stride, padding=padding,
+                                 dilation=dilation, groups=groups)
+        theirs.weight.data = torch.tensor(
+            np.asarray(ours.weight.value).copy())  # both OIHW
+        theirs.bias.data = torch.tensor(np.asarray(ours.bias.value).copy())
+        x = np.random.RandomState(6).randn(2, 8, 12, 12).astype(np.float32)
+        out_o = ours(jnp.asarray(x))
+        with torch.no_grad():
+            out_t = theirs(torch.tensor(x))
+        np.testing.assert_allclose(np.asarray(out_o), out_t.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_batchnorm2d_train_and_eval_match_torch(self):
+        pt.seed(7)
+        ours = nn.BatchNorm2D(6)
+        theirs = torch.nn.BatchNorm2d(6)
+        theirs.weight.data = torch.tensor(
+            np.asarray(ours.weight.value).copy())
+        theirs.bias.data = torch.tensor(np.asarray(ours.bias.value).copy())
+        rs = np.random.RandomState(7)
+        ours.train(), theirs.train()
+        for i in range(3):  # running stats accumulate identically
+            x = rs.randn(4, 6, 5, 5).astype(np.float32)
+            out_o = ours(jnp.asarray(x))
+            with torch.no_grad():
+                out_t = theirs(torch.tensor(x))
+            np.testing.assert_allclose(np.asarray(out_o), out_t.numpy(),
+                                       rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(ours._mean.value), theirs.running_mean.numpy(),
+            rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(ours._variance.value), theirs.running_var.numpy(),
+            rtol=1e-4, atol=1e-5)
+        ours.eval(), theirs.eval()
+        x = rs.randn(4, 6, 5, 5).astype(np.float32)
+        with torch.no_grad():
+            out_t = theirs(torch.tensor(x))
+        np.testing.assert_allclose(np.asarray(ours(jnp.asarray(x))),
+                                   out_t.numpy(), rtol=1e-4, atol=1e-5)
