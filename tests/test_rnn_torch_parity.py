@@ -192,9 +192,7 @@ class TestConvBnTorchParity:
                          dilation=dilation, groups=groups)
         theirs = torch.nn.Conv2d(8, 16, 3, stride=stride, padding=padding,
                                  dilation=dilation, groups=groups)
-        theirs.weight.data = torch.tensor(
-            np.asarray(ours.weight.value).copy())  # both OIHW
-        theirs.bias.data = torch.tensor(np.asarray(ours.bias.value).copy())
+        _copy_norm(ours, theirs)  # conv weights are OIHW on both sides
         x = np.random.RandomState(6).randn(2, 8, 12, 12).astype(np.float32)
         out_o = ours(jnp.asarray(x))
         with torch.no_grad():
@@ -206,9 +204,7 @@ class TestConvBnTorchParity:
         pt.seed(7)
         ours = nn.BatchNorm2D(6)
         theirs = torch.nn.BatchNorm2d(6)
-        theirs.weight.data = torch.tensor(
-            np.asarray(ours.weight.value).copy())
-        theirs.bias.data = torch.tensor(np.asarray(ours.bias.value).copy())
+        _copy_norm(ours, theirs)
         rs = np.random.RandomState(7)
         ours.train(), theirs.train()
         for i in range(3):  # running stats accumulate identically
