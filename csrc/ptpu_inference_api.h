@@ -38,6 +38,15 @@ int ptpu_predictor_set_input(PTPU_Predictor*, const char* name,
                              const float* data, const int64_t* dims,
                              int ndim, char* err, int err_len);
 
+/* Integer inputs (token ids, lengths) — reference C API parity:
+ * PD_DataType INT32/INT64 in capi_exp/pd_inference_api.h. */
+int ptpu_predictor_set_input_i32(PTPU_Predictor*, const char* name,
+                                 const int32_t* data, const int64_t* dims,
+                                 int ndim, char* err, int err_len);
+int ptpu_predictor_set_input_i64(PTPU_Predictor*, const char* name,
+                                 const int64_t* data, const int64_t* dims,
+                                 int ndim, char* err, int err_len);
+
 /* Execute the graph. Returns 0 on success. */
 int ptpu_predictor_run(PTPU_Predictor*, char* err, int err_len);
 

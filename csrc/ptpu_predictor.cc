@@ -622,7 +622,40 @@ void Predictor::run_node(const Node& n) {
     out(std::move(o));
   } else if (op == "MatMul") {
     const Tensor &a = in(n, 0), &b = in(n, 1);
-    if (b.dims.size() > 2) throw std::runtime_error("MatMul rhs rank > 2");
+    if (b.dims.size() > 2) {
+      /* batched matmul [B..., M, K] x [B..., K, N] — the ONNX exporter
+       * lowers every jax dot_general (attention included) to this via
+       * transpose/reshape, so transformer artifacts serve natively. */
+      if (a.dims.size() != b.dims.size())
+        throw std::runtime_error("MatMul: batched ranks differ");
+      size_t r = a.dims.size();
+      int64_t batch = 1;
+      for (size_t d = 0; d + 2 < r; ++d) {
+        if (a.dims[d] != b.dims[d])
+          throw std::runtime_error("MatMul: batch dims differ");
+        batch *= a.dims[d];
+      }
+      int64_t m = a.dims[r - 2], k_d = a.dims[r - 1];
+      if (b.dims[r - 2] != k_d)
+        throw std::runtime_error("MatMul: inner dims differ");
+      int64_t nn = b.dims[r - 1];
+      Tensor o;
+      o.dtype = DT_F32;
+      o.dims.assign(a.dims.begin(), a.dims.end() - 1);
+      o.dims.push_back(nn);
+      o.alloc();
+      for (int64_t bb = 0; bb < batch; ++bb)
+        for (int64_t mm = 0; mm < m; ++mm)
+          for (int64_t jj = 0; jj < nn; ++jj) {
+            double acc = 0;
+            for (int64_t kk = 0; kk < k_d; ++kk)
+              acc += a.at((bb * m + mm) * k_d + kk) *
+                     b.at((bb * k_d + kk) * nn + jj);
+            o.set((bb * m + mm) * nn + jj, acc);
+          }
+      out(std::move(o));
+      return;
+    }
     int64_t k_dim = a.dims.back();
     int64_t nn = b.dims.size() == 2 ? b.dims[1] : 1;
     int64_t batch = a.numel() / (a.dims.back() *
@@ -887,6 +920,27 @@ void fill_error(char* err, int err_len, const std::string& msg) {
 }  // namespace
 
 // -------------------------------------------------------------------- C ABI
+/* Integer inputs (token ids, lengths) — the reference C API exposes
+ * PD_DataType INT32/INT64 (`capi_exp/pd_inference_api.h`); without
+ * these, embedding/transformer artifacts cannot be served natively. */
+template <class T>
+static int set_input_int(void* h, const char* name, const T* data,
+                         const int64_t* dims, int ndim, int dtype,
+                         char* err, int err_len) {
+  try {
+    auto* p = (Predictor*)h;
+    Tensor t;
+    t.dtype = dtype;
+    t.dims.assign(dims, dims + ndim);
+    t.i.assign(data, data + t.numel());
+    p->env[name] = std::move(t);
+    return 0;
+  } catch (const std::exception& e) {
+    fill_error(err, err_len, e.what());
+    return 1;
+  }
+}
+
 extern "C" {
 
 typedef struct PTPU_Predictor PTPU_Predictor;
@@ -948,6 +1002,20 @@ int ptpu_predictor_set_input(PTPU_Predictor* h, const char* name,
     fill_error(err, err_len, e.what());
     return 1;
   }
+}
+
+__attribute__((visibility("default")))
+int ptpu_predictor_set_input_i32(PTPU_Predictor* h, const char* name,
+                                 const int32_t* data, const int64_t* dims,
+                                 int ndim, char* err, int err_len) {
+  return set_input_int(h, name, data, dims, ndim, DT_I32, err, err_len);
+}
+
+__attribute__((visibility("default")))
+int ptpu_predictor_set_input_i64(PTPU_Predictor* h, const char* name,
+                                 const int64_t* data, const int64_t* dims,
+                                 int ndim, char* err, int err_len) {
+  return set_input_int(h, name, data, dims, ndim, DT_I64, err, err_len);
 }
 
 __attribute__((visibility("default")))

@@ -86,6 +86,9 @@ _ELEMENTWISE = {
     "sinh": "Sinh", "cosh": "Cosh",
     "asinh": "Asinh", "acosh": "Acosh", "atanh": "Atanh",
     "stop_gradient": "Identity", "copy": "Identity",
+    # sharding annotations are compile-time placement hints; the
+    # serialized inference graph is single-host, so they erase
+    "sharding_constraint": "Identity",
 }
 
 # ONNX And/Or/Not/Xor are boolean-only; jax's primitives are bitwise
@@ -96,30 +99,6 @@ _COMPARE = {"eq": "Equal", "lt": "Less", "le": "LessOrEqual",
 
 _REDUCE = {"reduce_sum": "ReduceSum", "reduce_max": "ReduceMax",
            "reduce_min": "ReduceMin", "reduce_prod": "ReduceProd"}
-
-_LETTERS = "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ"
-
-
-def _einsum_equation(dn, lhs_rank, rhs_rank):
-    (lc, rc), (lb, rb) = dn
-    lhs = [""] * lhs_rank
-    rhs = [""] * rhs_rank
-    it = iter(_LETTERS)
-    for i, j in zip(lb, rb):
-        lhs[i] = rhs[j] = next(it)
-    for i, j in zip(lc, rc):
-        lhs[i] = rhs[j] = next(it)
-    out = [lhs[i] for i in lb]
-    for i in range(lhs_rank):
-        if not lhs[i]:
-            lhs[i] = next(it)
-            out.append(lhs[i])
-    for j in range(rhs_rank):
-        if not rhs[j]:
-            rhs[j] = next(it)
-            out.append(rhs[j])
-    return f"{''.join(lhs)},{''.join(rhs)}->{''.join(out)}"
-
 
 def _conv(g: _Graph, eqn, ins):
     p = eqn.params
@@ -304,8 +283,39 @@ def _convert_eqn(g: _Graph, eqn):
                 and rc[0] == rhs_rank - 2 + (rhs_rank == 1):
             out(g.add("MatMul", ins))
         else:
-            out(g.add("Einsum", ins,
-                      equation=_einsum_equation(dn, lhs_rank, rhs_rank)))
+            # general case: transpose each side to
+            # [batch..., free..., contract...] / [batch, contract, free],
+            # flatten to rank-3, batched MatMul, reshape to XLA's output
+            # order (batch, lhs free, rhs free). Standard ops only —
+            # ONNX Einsum is opset-12+ and absent from many runtimes
+            # (incl. csrc/ptpu_predictor.cc)
+            lshape = tuple(eqn.invars[0].aval.shape)
+            rshape = tuple(eqn.invars[1].aval.shape)
+            lfree = [d for d in range(lhs_rank)
+                     if d not in lb and d not in lc]
+            rfree = [d for d in range(rhs_rank)
+                     if d not in rb and d not in rc]
+
+            def prod(dims, shape):
+                p = 1
+                for d in dims:
+                    p *= shape[d]
+                return p
+
+            bsz = prod(lb, lshape)
+            msz, ksz = prod(lfree, lshape), prod(lc, lshape)
+            nsz = prod(rfree, rshape)
+            lt = g.add("Transpose", [ins[0]],
+                       perm=[int(d) for d in (*lb, *lfree, *lc)])[0]
+            l3 = g.add("Reshape", [lt, g.constant(
+                np.asarray([bsz, msz, ksz], np.int64), "lshape")])[0]
+            rt = g.add("Transpose", [ins[1]],
+                       perm=[int(d) for d in (*rb, *rc, *rfree)])[0]
+            r3 = g.add("Reshape", [rt, g.constant(
+                np.asarray([bsz, ksz, nsz], np.int64), "rshape")])[0]
+            mm = g.add("MatMul", [l3, r3])[0]
+            oshape = np.asarray(eqn.outvars[0].aval.shape, np.int64)
+            out(g.add("Reshape", [mm, g.constant(oshape, "oshape")]))
     elif prim == "conv_general_dilated":
         out(_conv(g, eqn, ins))
     elif prim == "reduce_window_max":
