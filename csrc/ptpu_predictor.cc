@@ -622,59 +622,44 @@ void Predictor::run_node(const Node& n) {
     out(std::move(o));
   } else if (op == "MatMul") {
     const Tensor &a = in(n, 0), &b = in(n, 1);
-    if (b.dims.size() > 2) {
-      /* batched matmul [B..., M, K] x [B..., K, N] — the ONNX exporter
-       * lowers every jax dot_general (attention included) to this via
+    const size_t ra = a.dims.size(), rb = b.dims.size();
+    const bool batched_b = rb > 2;
+    int64_t k_d = a.dims.back();
+    int64_t m = ra >= 2 ? a.dims[ra - 2] : 1;
+    int64_t nn, batch;
+    Tensor o;
+    o.dtype = DT_F32;
+    if (batched_b) {
+      /* [B..., M, K] x [B..., K, N] — the ONNX exporter lowers every
+       * jax dot_general (attention included) to this via
        * transpose/reshape, so transformer artifacts serve natively. */
-      if (a.dims.size() != b.dims.size())
-        throw std::runtime_error("MatMul: batched ranks differ");
-      size_t r = a.dims.size();
-      int64_t batch = 1;
-      for (size_t d = 0; d + 2 < r; ++d) {
+      if (ra != rb) throw std::runtime_error("MatMul: batched ranks differ");
+      batch = 1;
+      for (size_t d = 0; d + 2 < ra; ++d) {
         if (a.dims[d] != b.dims[d])
           throw std::runtime_error("MatMul: batch dims differ");
         batch *= a.dims[d];
       }
-      int64_t m = a.dims[r - 2], k_d = a.dims[r - 1];
-      if (b.dims[r - 2] != k_d)
+      if (b.dims[rb - 2] != k_d)
         throw std::runtime_error("MatMul: inner dims differ");
-      int64_t nn = b.dims[r - 1];
-      Tensor o;
-      o.dtype = DT_F32;
+      nn = b.dims[rb - 1];
       o.dims.assign(a.dims.begin(), a.dims.end() - 1);
       o.dims.push_back(nn);
-      o.alloc();
-      for (int64_t bb = 0; bb < batch; ++bb)
-        for (int64_t mm = 0; mm < m; ++mm)
-          for (int64_t jj = 0; jj < nn; ++jj) {
-            double acc = 0;
-            for (int64_t kk = 0; kk < k_d; ++kk)
-              acc += a.at((bb * m + mm) * k_d + kk) *
-                     b.at((bb * k_d + kk) * nn + jj);
-            o.set((bb * m + mm) * nn + jj, acc);
-          }
-      out(std::move(o));
-      return;
+    } else {
+      nn = rb == 2 ? b.dims[1] : 1;
+      batch = a.numel() / (k_d * m);
+      o.dims.assign(a.dims.begin(), a.dims.end() - 1);
+      if (rb == 2) o.dims.push_back(nn);
     }
-    int64_t k_dim = a.dims.back();
-    int64_t nn = b.dims.size() == 2 ? b.dims[1] : 1;
-    int64_t batch = a.numel() / (a.dims.back() *
-                                 (a.dims.size() >= 2
-                                      ? a.dims[a.dims.size() - 2]
-                                      : 1));
-    int64_t m = a.dims.size() >= 2 ? a.dims[a.dims.size() - 2] : 1;
-    Tensor o;
-    o.dtype = DT_F32;
-    o.dims.assign(a.dims.begin(), a.dims.end() - 1);
-    if (b.dims.size() == 2) o.dims.push_back(nn);
     o.alloc();
     for (int64_t bb = 0; bb < batch; ++bb)
       for (int64_t mm = 0; mm < m; ++mm)
         for (int64_t jj = 0; jj < nn; ++jj) {
           double acc = 0;
-          for (int64_t kk = 0; kk < k_dim; ++kk)
-            acc += a.at((bb * m + mm) * k_dim + kk) *
-                   b.at(b.dims.size() == 2 ? kk * nn + jj : kk);
+          for (int64_t kk = 0; kk < k_d; ++kk)
+            acc += a.at((bb * m + mm) * k_d + kk) *
+                   b.at(batched_b ? (bb * k_d + kk) * nn + jj
+                                  : (rb == 2 ? kk * nn + jj : kk));
           o.set((bb * m + mm) * nn + jj, acc);
         }
     out(std::move(o));

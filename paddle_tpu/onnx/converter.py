@@ -89,6 +89,8 @@ _ELEMENTWISE = {
     # sharding annotations are compile-time placement hints; the
     # serialized inference graph is single-host, so they erase
     "sharding_constraint": "Identity",
+    # name_p is a debug-labelling no-op
+    "name": "Identity",
 }
 
 # ONNX And/Or/Not/Xor are boolean-only; jax's primitives are bitwise

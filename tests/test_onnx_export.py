@@ -147,3 +147,16 @@ class TestTransformerExport:
         ids = np.random.RandomState(0).randint(
             0, 512, (2, 16)).astype(np.int32)
         _roundtrip(m, [ids], atol=0.05, rtol=0.05)
+
+    def test_gpt_decoder_exports_and_matches(self):
+        """GPT causal decoder (flash-attention dispatch falls back to
+        XLA on CPU trace; name_p labels erase to Identity) exports and
+        round-trips: logits parity within bf16 tolerance."""
+        from paddle_tpu.models import GPTForPretraining, gpt_tiny
+
+        pt.seed(0)
+        m = GPTForPretraining(gpt_tiny())
+        m.eval()
+        ids = np.random.RandomState(0).randint(
+            0, 512, (1, 16)).astype(np.int32)
+        _roundtrip(m, [ids], atol=0.05, rtol=0.05)
