@@ -432,9 +432,103 @@ def _convert_eqn(g: _Graph, eqn):
                                    np.int64), "en")
         ax = g.constant(np.asarray(range(rank), np.int64), "ax")
         out(g.add("Slice", [ins[0], st, en, ax]))
+    elif prim == "split":
+        # one Slice per piece: ONNX Split exists, but Slice keeps the
+        # artifact runnable on the minimal runtimes
+        axis = int(eqn.params["axis"])
+        sizes = [int(v) for v in eqn.params["sizes"]]
+        ax = g.constant(np.asarray([axis], np.int64), "ax")
+        offset = 0
+        names = []
+        for sz in sizes:
+            st = g.constant(np.asarray([offset], np.int64), "st")
+            en = g.constant(np.asarray([offset + sz], np.int64), "en")
+            names.append(g.add("Slice", [ins[0], st, en, ax])[0])
+            offset += sz
+        out(names)
+    elif prim == "scan":
+        _scan_unroll(g, eqn, ins)
     else:
         raise UnsupportedPrimitive(
             f"primitive '{prim}' has no ONNX mapping")
+
+
+_SCAN_UNROLL_MAX = 512
+
+
+def _scan_unroll(g: _Graph, eqn, ins):
+    """lax.scan → static unroll (length is a traced constant). ONNX has
+    Scan/Loop, but unrolling keeps artifacts runnable on minimal
+    runtimes (the C predictor, the numpy reference) — RNN/LSTM/GRU
+    layers run time steps through scan (`nn/layer_rnn.py RNN.forward`),
+    so this is what makes CRNN-class models exportable. Body vars are
+    REBOUND each iteration (names are keyed by var identity)."""
+    p = eqn.params
+    length = int(p["length"])
+    if length == 0:
+        raise UnsupportedPrimitive("scan with length 0 (empty unroll "
+                                   "would emit a zero-input Concat)")
+    if length > _SCAN_UNROLL_MAX:
+        raise UnsupportedPrimitive(
+            f"scan length {length} > unroll limit {_SCAN_UNROLL_MAX}")
+    closed = p["jaxpr"]
+    consts_j, body = closed.consts, closed.jaxpr
+    n_consts = int(p["num_consts"])
+    n_carry = int(p["num_carry"])
+    reverse = bool(p.get("reverse", False))
+    const_names = list(ins[:n_consts])
+    carry_names = list(ins[n_consts:n_consts + n_carry])
+    xs_names = list(ins[n_consts + n_carry:])
+    n_ys = len(eqn.outvars) - n_carry
+    ys_steps = [[] for _ in range(n_ys)]
+    order = range(length - 1, -1, -1) if reverse else range(length)
+    for t in order:
+        xt_names = []
+        for xi, xn in enumerate(xs_names):
+            idx = g.constant(np.asarray(t, np.int64), "t")
+            xt = g.add("Gather", [xn, idx], axis=0)[0]
+            # 0-d index round-trips as [1] through the wire format on
+            # some runtimes; pin the step slice to the body's static
+            # input shape
+            bshape = tuple(
+                body.invars[n_consts + n_carry + xi].aval.shape)
+            xt = g.add("Reshape", [xt, g.constant(
+                np.asarray(bshape, np.int64), "xshape")])[0]
+            xt_names.append(xt)
+        # clear every body binding from the previous iteration
+        for v in list(body.invars) + list(body.constvars):
+            g.names.pop(id(v), None)
+        for beq in body.eqns:
+            for ov in beq.outvars:
+                g.names.pop(id(ov), None)
+        for cv, cval in zip(body.constvars, consts_j):
+            g.set_name(cv, g.constant(np.asarray(cval), "const"))
+        for bv, nm in zip(body.invars,
+                          const_names + carry_names + xt_names):
+            g.set_name(bv, nm)
+        for beq in body.eqns:
+            _convert_eqn(g, beq)
+        outs_names = [g.name_of(ov) for ov in body.outvars]
+        carry_names = list(outs_names[:n_carry])
+        for yi in range(n_ys):
+            ys_steps[yi].append(outs_names[n_carry + yi])
+    for ci in range(n_carry):
+        g.add("Identity", [carry_names[ci]],
+              outputs=[g.name_of(eqn.outvars[ci])])
+    for yi in range(n_ys):
+        steps = ys_steps[yi]
+        if reverse:
+            steps = steps[::-1]
+        y_shape = tuple(eqn.outvars[n_carry + yi].aval.shape)
+        step_shape = g.constant(
+            np.asarray((1,) + y_shape[1:], np.int64), "yshape")
+        expanded = [g.add("Reshape", [s_, step_shape])[0] for s_ in steps]
+        if len(expanded) == 1:
+            g.add("Identity", expanded,
+                  outputs=[g.name_of(eqn.outvars[n_carry + yi])])
+        else:
+            g.add("Concat", expanded, axis=0,
+                  outputs=[g.name_of(eqn.outvars[n_carry + yi])])
 
 
 def jaxpr_to_onnx_graph(closed_jaxpr, input_names=None,

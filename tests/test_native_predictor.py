@@ -31,9 +31,14 @@ def _build():
 def lib():
     try:
         _build()  # incremental: no-op when current, rebuilds stale
-    except Exception:
-        if not os.path.exists(LIB):   # no toolchain AND no prebuilt .so
+    except FileNotFoundError:
+        # no make/compiler on PATH: fall back to a prebuilt .so if any
+        if not os.path.exists(LIB):
             raise
+    except subprocess.CalledProcessError as e:
+        # a real COMPILE error must never be masked by a stale binary
+        raise RuntimeError(
+            f"native predictor build failed:\n{e.stderr}") from e
     lib = ctypes.CDLL(LIB)
     lib.ptpu_predictor_create.restype = ctypes.c_void_p
     lib.ptpu_predictor_create.argtypes = [ctypes.c_char_p, ctypes.c_char_p,

@@ -160,3 +160,36 @@ class TestTransformerExport:
         ids = np.random.RandomState(0).randint(
             0, 512, (1, 16)).astype(np.int32)
         _roundtrip(m, [ids], atol=0.05, rtol=0.05)
+
+
+class TestRecurrentExport:
+    """lax.scan-based layers export via static unrolling
+    (converter._scan_unroll) — RNN/LSTM/GRU and the CRNN OCR
+    recognizer become deployable artifacts."""
+
+    @pytest.mark.parametrize("cls_name", ["LSTM", "GRU", "SimpleRNN"])
+    def test_rnn_layer_exports(self, cls_name):
+        pt.seed(0)
+        rnn = getattr(pt.nn, cls_name)(6, 8)
+
+        class Wrap(pt.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.rnn = rnn
+
+            def forward(self, x):
+                return self.rnn(x)[0]
+
+        w = Wrap()
+        x = np.random.RandomState(0).randn(2, 7, 6).astype(np.float32)
+        _roundtrip(w, [x], atol=1e-4)
+
+    def test_crnn_ocr_exports(self):
+        from paddle_tpu.vision.models import crnn_ocr
+
+        pt.seed(0)
+        m = crnn_ocr(num_classes=50)
+        m.eval()
+        x = np.random.RandomState(0).randn(1, 3, 32, 60).astype(
+            np.float32)
+        _roundtrip(m, [x], atol=2e-3, rtol=2e-3)
