@@ -70,10 +70,14 @@ def lib():
     return lib
 
 
-def _run_native(lib, model_bytes, x, tmp_path):
-    path = os.path.join(str(tmp_path), "model.onnx")
-    with open(path, "wb") as f:
-        f.write(model_bytes)
+def _run_native(lib, model, x, tmp_path):
+    """`model` is ONNX bytes (written to tmp) or an existing file path."""
+    if isinstance(model, (bytes, bytearray)):
+        path = os.path.join(str(tmp_path), "model.onnx")
+        with open(path, "wb") as f:
+            f.write(model)
+    else:
+        path = model
     err = ctypes.create_string_buffer(512)
     h = lib.ptpu_predictor_create(path.encode(), err, 512)
     assert h, err.value.decode()
@@ -236,3 +240,26 @@ class TestTransformerServing:
         # the jax model computes in bf16; the C interpreter in fp64/fp32
         np.testing.assert_allclose(got, np.asarray(seq, np.float32),
                                    rtol=0.05, atol=0.05)
+
+    def test_crnn_ocr_serves_natively(self, lib, tmp_path):
+        """The CRNN recognizer (conv trunk + bidirectional LSTM head,
+        exported via scan unrolling) serves from C — the OCR deployment
+        story end to end, no Python."""
+        import paddle_tpu as pt
+        from paddle_tpu.static import InputSpec
+        from paddle_tpu.vision.models import crnn_ocr
+
+        pt.seed(0)
+        m = crnn_ocr(num_classes=50)
+        m.eval()
+        path = pt.onnx.export(
+            m, os.path.join(str(tmp_path), "crnn"),
+            input_spec=[InputSpec([1, 3, 32, 60], "float32")])
+        x = np.random.RandomState(0).randn(1, 3, 32, 60).astype(
+            np.float32)
+        got = _run_native(lib, path, x, tmp_path)
+        import jax.numpy as jnp
+        ref = m(jnp.asarray(x))
+        ref = ref[0] if isinstance(ref, (tuple, list)) else ref
+        np.testing.assert_allclose(got, np.asarray(ref, np.float32),
+                                   rtol=2e-3, atol=2e-3)
