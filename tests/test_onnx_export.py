@@ -193,3 +193,15 @@ class TestRecurrentExport:
         x = np.random.RandomState(0).randn(1, 3, 32, 60).astype(
             np.float32)
         _roundtrip(m, [x], atol=2e-3, rtol=2e-3)
+
+    def test_yolov3_trunk_exports(self):
+        """YOLOv3-DarkNet53 (conv trunk + 3 detection heads) exports;
+        bf16-model tolerance (raw head logits have 1e2 magnitudes)."""
+        from paddle_tpu.vision.models import yolov3_darknet53
+
+        pt.seed(0)
+        m = yolov3_darknet53(num_classes=20)
+        m.eval()
+        x = np.random.RandomState(0).randn(1, 3, 128, 128).astype(
+            np.float32)
+        _roundtrip(m, [x], atol=0.1, rtol=0.1)
