@@ -143,22 +143,38 @@ def _pool(g: _Graph, eqn, ins, kind: str):
         raise UnsupportedPrimitive("reduce_window base_dilation")
     if any(d != 1 for d in p.get("window_dilation") or ()):
         raise UnsupportedPrimitive("reduce_window window_dilation")
+    post_perm = None
+    if len(wd) == 4 and wd[0] == 1 and wd[-1] == 1 and ws[0] == 1 \
+            and ws[-1] == 1 and pad[0] == (0, 0) and pad[-1] == (0, 0) \
+            and (wd[1] != 1 or wd[2] != 1):
+        # channels-last window (NHWC trunks): pool in NCHW between
+        # transposes — ONNX pooling is channels-first only
+        ins = [g.add("Transpose", ins, perm=[0, 3, 1, 2])[0]]
+        wd = (1, 1, wd[1], wd[2])
+        ws = (1, 1, ws[1], ws[2])
+        pad = ((0, 0), (0, 0), pad[1], pad[2])
+        post_perm = [0, 2, 3, 1]
     if wd[0] != 1 or wd[1] != 1 or ws[0] != 1 or ws[1] != 1 \
             or pad[0] != (0, 0) or pad[1] != (0, 0):
         raise UnsupportedPrimitive(
             f"reduce_window over non-spatial dims: {wd}")
     pads = [int(b) for b, _ in pad[2:]] + [int(e) for _, e in pad[2:]]
     if kind == "max":
-        return g.add("MaxPool", ins, kernel_shape=list(wd[2:]),
-                     strides=list(ws[2:]), pads=pads)
-    # sum pool = AveragePool(count_include_pad) * prod(window)
-    y = g.add("AveragePool", ins, kernel_shape=list(wd[2:]),
-              strides=list(ws[2:]), pads=pads, count_include_pad=1)[0]
-    out_dt = np.dtype(eqn.outvars[0].aval.dtype)
-    if out_dt == np.dtype(jnp.bfloat16):
-        out_dt = np.dtype(np.float32)
-    scale = g.constant(np.asarray(float(np.prod(wd)), out_dt), "winsize")
-    return g.add("Mul", [y, scale])
+        y = g.add("MaxPool", ins, kernel_shape=list(wd[2:]),
+                  strides=list(ws[2:]), pads=pads)[0]
+    else:
+        # sum pool = AveragePool(count_include_pad) * prod(window)
+        y = g.add("AveragePool", ins, kernel_shape=list(wd[2:]),
+                  strides=list(ws[2:]), pads=pads, count_include_pad=1)[0]
+        out_dt = np.dtype(eqn.outvars[0].aval.dtype)
+        if out_dt == np.dtype(jnp.bfloat16):
+            out_dt = np.dtype(np.float32)
+        scale = g.constant(np.asarray(float(np.prod(wd)), out_dt),
+                           "winsize")
+        y = g.add("Mul", [y, scale])[0]
+    if post_perm is not None:
+        return g.add("Transpose", [y], perm=post_perm)
+    return [y]
 
 
 def _gather(g: _Graph, eqn, ins):

@@ -205,3 +205,17 @@ class TestRecurrentExport:
         x = np.random.RandomState(0).randn(1, 3, 128, 128).astype(
             np.float32)
         _roundtrip(m, [x], atol=0.1, rtol=0.1)
+
+    def test_nhwc_s2d_resnet_exports(self):
+        """The NHWC + space_to_depth bench trunk exports: channels-last
+        pooling lowers through NCHW transposes (ONNX pools are
+        channels-first only)."""
+        from paddle_tpu.vision.models import resnet18
+
+        pt.seed(0)
+        m = resnet18(data_format="NHWC", stem="space_to_depth",
+                     num_classes=10)
+        m.eval()
+        x = np.random.RandomState(0).randn(1, 64, 64, 3).astype(
+            np.float32)
+        _roundtrip(m, [x], atol=2e-3, rtol=2e-3)
