@@ -65,6 +65,13 @@ def persist_partial(entry: dict) -> None:
             data = []
     except Exception:  # noqa: BLE001 — never let bookkeeping kill a bench
         data = []
+    # Migrate rows written before the 'config' field existed: a GPT
+    # headline row without it IS a config='base' run — without the
+    # stamp, stale()'s wildcard matching would let the first variant
+    # arm (config='b16') delete the banked base number (ADVICE r4).
+    for e in data:
+        if e.get("metric") == "gpt345m_pretrain_tokens_per_sec_per_chip":
+            e.setdefault("config", "base")
     def key(e):
         # A/B arms (stem, size, headline variant) of one metric must
         # not clobber each other
@@ -89,6 +96,26 @@ def persist_partial(entry: dict) -> None:
             json.dump(data, f, indent=1)
         os.replace(tmp, PARTIAL_PATH)
     except Exception:  # noqa: BLE001
+        pass
+
+
+def emit_prior_hw_rows(limit: int = 8) -> None:
+    """Print the banked real-hardware rows from BENCH_PARTIAL.json as
+    JSON lines stamped `prior_hw: true`.
+
+    Called on every degraded/CPU-fallback exit so a tunnel outage never
+    reduces the round's bench tail to a CPU number (VERDICT r4 item 8):
+    the driver's recorded tail then still carries the newest
+    provenance-stamped hardware measurements next to the clearly-marked
+    degraded headline."""
+    try:
+        with open(PARTIAL_PATH) as f:
+            data = json.load(f)
+        if not isinstance(data, list):
+            return
+        for e in data[-limit:]:
+            print(json.dumps(dict(e, prior_hw=True)), flush=True)
+    except Exception:  # noqa: BLE001 — bookkeeping must not kill a bench
         pass
 
 
@@ -119,7 +146,12 @@ def probe_backend(timeout: float = PROBE_TIMEOUT_S) -> bool:
     with sleeps in between give a recovering tunnel time to come back
     without burning the whole bench budget on one hung handshake."""
     code = "import jax; jax.devices(); print('PROBE_OK')"
-    ladder = [min(90, timeout), min(180, timeout), timeout]
+    # Two attempts, not three: r4 burned 690s of probe budget on a dead
+    # tunnel before degrading (VERDICT r4 weak #1 follow-through). A
+    # healthy tunnel answers in <90s; one longer retry covers recovery.
+    ladder = [min(90, timeout), timeout]
+    if ladder[0] == ladder[1]:
+        ladder = ladder[:1]
     for attempt, t in enumerate(ladder):
         p = subprocess.Popen([sys.executable, "-c", code],
                              stdout=subprocess.PIPE,
@@ -684,6 +716,8 @@ def main():
             out = {"metric": "bench_error", "value": 0.0, "unit": "none",
                    "vs_baseline": 0.0, "degraded": True,
                    "error": f"{type(e2).__name__}: {e2}"[:300]}
+    if out is not None and out.get("degraded"):
+        emit_prior_hw_rows()
     print(json.dumps(out))
 
 

@@ -908,11 +908,32 @@ void fill_error(char* err, int err_len, const std::string& msg) {
 /* Integer inputs (token ids, lengths) — the reference C API exposes
  * PD_DataType INT32/INT64 (`capi_exp/pd_inference_api.h`); without
  * these, embedding/transformer artifacts cannot be served natively. */
+/* Caller-supplied dims are untrusted: a negative ndim/dim or an
+ * int64-overflowing product would produce a bogus numel() and an
+ * out-of-bounds read of `data`. ndim == 0 is a valid scalar (empty
+ * dims, numel 1); dims may then be null. */
+static void check_dims(const int64_t* dims, int ndim) {
+  if (ndim < 0) throw std::runtime_error("set_input: ndim must be >= 0");
+  if (ndim > 0 && !dims)
+    throw std::runtime_error("set_input: dims is null");
+  int64_t n = 1;
+  for (int k = 0; k < ndim; ++k) {
+    if (dims[k] < 0)
+      throw std::runtime_error("set_input: negative dim at index " +
+                               std::to_string(k));
+    if (dims[k] > 0 && n > (int64_t(1) << 40) / dims[k])
+      throw std::runtime_error("set_input: element count overflows "
+                               "the 2^40 sanity cap");
+    n *= dims[k];
+  }
+}
+
 template <class T>
 static int set_input_int(void* h, const char* name, const T* data,
                          const int64_t* dims, int ndim, int dtype,
                          char* err, int err_len) {
   try {
+    check_dims(dims, ndim);
     auto* p = (Predictor*)h;
     Tensor t;
     t.dtype = dtype;
@@ -976,6 +997,7 @@ int ptpu_predictor_set_input(PTPU_Predictor* h, const char* name,
                              const float* data, const int64_t* dims,
                              int ndim, char* err, int err_len) {
   try {
+    check_dims(dims, ndim);
     auto* p = (Predictor*)h;
     Tensor t;
     t.dtype = DT_F32;

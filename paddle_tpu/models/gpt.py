@@ -789,10 +789,15 @@ def _build_offload_chunked_step(*, cfg, optimizer, outer, stacked,
          dynamic-update-slice, stream new slots back out;
       3. outer update — embeddings/final-LN slots streamed the same way.
 
-    Peak HBM = params + grads + ONE chunk of slots, so the largest
-    trainable size is bounded by params+grads+activations — the
-    offload promise. Slots at rest are tuples of per-chunk arrays in
-    `pinned_host` memory; they never exist stacked on device.
+    Peak HBM = params + grads + up to ~TWO chunks of slots: the
+    backpressure sync below waits on chunk ci-2, deliberately leaving
+    two chunks' transfers in flight to overlap copy with compute, and
+    chunk sizing uses the conservative UNSHARDED byte estimate — so
+    budget ~2x `_OFFLOAD_CHUNK_BYTES` of slot residency when capacity
+    planning at 10B-class sizes. The largest trainable size is still
+    bounded by params+grads+activations — the offload promise. Slots
+    at rest are tuples of per-chunk arrays in `pinned_host` memory;
+    they never exist stacked on device.
     """
     import numpy as onp
 

@@ -215,7 +215,7 @@ def _convert_eqn(g: _Graph, eqn):
 
     if prim in ("jit", "pjit", "closed_call", "custom_jvp_call",
                 "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
-                "checkpoint", "custom_jvp_call_jaxpr"):
+                "remat2", "checkpoint", "custom_jvp_call_jaxpr"):
         inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr") \
             or eqn.params.get("fun_jaxpr")
         if inner is None:
