@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Native C-ABI predictor vs Python/XLA predictor benchmark.
+
+VERDICT r4 item 5 acceptance gate: the C predictor (csrc/
+ptpu_predictor.cc — blocked threaded SGEMM + im2col conv + op-code
+dispatch) must serve ResNet-18 within 10x of the Python/XLA CPU
+predictor. Also times the int8 artifact vs fp32 (VERDICT r4 item 10).
+
+Reference bar: the native AnalysisPredictor engine
+(`/root/reference/paddle/fluid/inference/api/analysis_predictor.cc:381`)
+over the C API (`capi_exp/pd_inference_api.h:1`).
+
+Run: python tools/predictor_bench.py  (CPU-only; forces jax to CPU)
+Prints one JSON line per measurement and a final summary line with the
+native/XLA ratio.
+"""
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def build_artifact(tmp, batch):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.onnx import export
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu.vision.models import resnet18
+
+    model = resnet18(num_classes=1000)
+    model.eval()
+    path = export(model, os.path.join(tmp, "resnet18"),
+                  input_spec=[InputSpec([batch, 3, 224, 224], "float32")])
+    return model, path
+
+
+def time_native(path, x, steps=5, warmup=1):
+    lib = ctypes.CDLL(os.path.join(REPO, "paddle_tpu",
+                                   "_native_predictor.so"))
+    lib.ptpu_predictor_create.restype = ctypes.c_void_p
+    err = ctypes.create_string_buffer(512)
+    h = lib.ptpu_predictor_create(path.encode(), err, 512)
+    assert h, err.value.decode()
+    nd = len(x.shape)
+    dims = (ctypes.c_int64 * nd)(*x.shape)
+    data = x.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    lib.ptpu_predictor_input_name.restype = ctypes.c_char_p
+    name = lib.ptpu_predictor_input_name(ctypes.c_void_p(h), 0)
+
+    def once():
+        rc = lib.ptpu_predictor_set_input(ctypes.c_void_p(h), name, data,
+                                          dims, nd, err, 512)
+        assert rc == 0, err.value.decode()
+        rc = lib.ptpu_predictor_run(ctypes.c_void_p(h), err, 512)
+        assert rc == 0, err.value.decode()
+
+    for _ in range(warmup):
+        once()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        once()
+    dt = (time.perf_counter() - t0) / steps
+
+    # fetch the output for a correctness cross-check
+    import numpy as np
+    lib.ptpu_predictor_output_ndim.restype = ctypes.c_int
+    lib.ptpu_predictor_output_dims.restype = \
+        ctypes.POINTER(ctypes.c_int64)
+    lib.ptpu_predictor_output_data.restype = \
+        ctypes.POINTER(ctypes.c_float)
+    nd = lib.ptpu_predictor_output_ndim(ctypes.c_void_p(h), 0)
+    dd = lib.ptpu_predictor_output_dims(ctypes.c_void_p(h), 0)
+    shape = [dd[k] for k in range(nd)]
+    numel = int(np.prod(shape)) if shape else 1
+    dp = lib.ptpu_predictor_output_data(ctypes.c_void_p(h), 0)
+    out = np.ctypeslib.as_array(dp, (numel,)).copy()
+    lib.ptpu_predictor_destroy(ctypes.c_void_p(h))
+    return dt, out
+
+
+def time_xla(model, x, steps=10, warmup=2):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.nn.layer import (buffer_state, functional_call,
+                                     trainable_state)
+
+    params = trainable_state(model)
+    buffers = buffer_state(model)
+
+    @jax.jit
+    def fwd(params, x):
+        out, _ = functional_call(model, params, x, buffers=buffers)
+        return out
+
+    xj = jnp.asarray(x)
+    out = fwd(params, xj)
+    out.block_until_ready()
+    for _ in range(warmup):
+        fwd(params, xj).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        fwd(params, xj).block_until_ready()
+    dt = (time.perf_counter() - t0) / steps
+    import numpy as np
+    return dt, np.asarray(out)
+
+
+def _export_bytes(tmp, name, fn, args):
+    from paddle_tpu.onnx.converter import trace_to_onnx
+    path = os.path.join(tmp, name + ".onnx")
+    with open(path, "wb") as f:
+        f.write(trace_to_onnx(fn, args))
+    return path
+
+
+def bench_int8(tmp):
+    """int8-executing artifact vs the same fp32 MLP through the C
+    predictor (VERDICT r4 item 10: the int8 path existed untimed)."""
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.quantization import QAT, convert_to_int8
+
+    def mlp():
+        pt.seed(0)
+        return pt.nn.Sequential(pt.nn.Linear(512, 2048), pt.nn.ReLU(),
+                                pt.nn.Linear(2048, 2048), pt.nn.ReLU(),
+                                pt.nn.Linear(2048, 512))
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 512).astype(np.float32)
+
+    net_f = mlp()
+    net_f.eval()
+    p_f = _export_bytes(tmp, "mlp_f32", lambda a: net_f(a),
+                        (jnp.asarray(x),))
+
+    net_q = mlp()
+    QAT().quantize(net_q)
+    net_q.train()
+    net_q(jnp.asarray(x))   # observer pass
+    net_q.eval()
+    convert_to_int8(net_q)
+    p_q = _export_bytes(tmp, "mlp_int8", lambda a: net_q(a),
+                        (jnp.asarray(x),))
+
+    dt_f, _ = time_native(p_f, x, steps=10, warmup=2)
+    dt_q, _ = time_native(p_q, x, steps=10, warmup=2)
+    print(json.dumps({"metric": "mlp_native_fp32_ms",
+                      "value": round(dt_f * 1e3, 2), "unit": "ms"}),
+          flush=True)
+    print(json.dumps({"metric": "mlp_native_int8_ms",
+                      "value": round(dt_q * 1e3, 2), "unit": "ms",
+                      "int8_over_fp32": round(dt_q / dt_f, 2)}),
+          flush=True)
+
+
+def main():
+    import tempfile
+
+    import numpy as np
+
+    batch = int(os.environ.get("PTPU_PREDBENCH_BATCH", "1"))
+    with tempfile.TemporaryDirectory() as tmp:
+        model, path = build_artifact(tmp, batch)
+        rs = np.random.RandomState(0)
+        x = rs.randn(batch, 3, 224, 224).astype(np.float32)
+
+        dt_xla, out_xla = time_xla(model, x)
+        print(json.dumps({"metric": "resnet18_xla_cpu_ms",
+                          "value": round(dt_xla * 1e3, 2), "unit": "ms",
+                          "batch": batch}), flush=True)
+
+        dt_nat, out_nat = time_native(path, x)
+        print(json.dumps({"metric": "resnet18_native_c_ms",
+                          "value": round(dt_nat * 1e3, 2), "unit": "ms",
+                          "batch": batch}), flush=True)
+
+        np.testing.assert_allclose(
+            out_nat.reshape(out_xla.shape), out_xla, rtol=2e-3, atol=2e-4)
+        ratio = dt_nat / dt_xla
+        print(json.dumps({
+            "metric": "resnet18_native_over_xla_ratio",
+            "value": round(ratio, 2), "unit": "x",
+            "within_10x": bool(ratio <= 10.0)}), flush=True)
+
+        bench_int8(tmp)
+
+
+if __name__ == "__main__":
+    main()
