@@ -20,8 +20,13 @@ Supported subset (same shape the reference's transformers handle):
   * `for <name> in range(...)` — lowered to the while conversion
     (start/stop/step snapshotted at entry; non-literal step keeps
     Python semantics since the direction is unknowable statically).
-`for` over other iterables stays untouched Python; `break`/`continue`
-inside converted loops raise a clear error at transform time.
+`for` over other iterables stays untouched Python. `break`/`continue`
+inside converted loops are DESUGARED into carried boolean flags before
+conversion (reference: `break_continue_transformer.py`): `break` sets a
+break flag checked by the loop condition, `continue` sets a skip flag
+guarding the rest of that iteration's body. One Python-semantics corner
+is documented at `_desugar_bc`: after a traced `break` in a converted
+`for`, the loop variable holds one extra increment.
 """
 from __future__ import annotations
 
@@ -92,6 +97,20 @@ def _pt_if(pred, true_fn, false_fn, operands):
                         *(operands[i] for i in dyn_idx))
 
 
+def _pt_not(x):
+    """`not skip` that also works on tracers (guards desugared
+    continue/break regions)."""
+    return jnp.logical_not(x) if _is_traced(x) else (not x)
+
+
+def _pt_and_not(brk, test):
+    """`(not brk) and test` for loop conditions, tracer-safe on either
+    side."""
+    if _is_traced(brk) or _is_traced(test):
+        return jnp.logical_and(jnp.logical_not(brk), test)
+    return (not brk) and test
+
+
 def _pt_while(cond_fn, body_fn, carry, assigned):
     """Runtime dispatch for a rewritten `while` (reference:
     convert_while_loop). `assigned[i]` marks carry slots the body
@@ -147,9 +166,12 @@ def _names(nodes) -> "_Names":
 
 class _Unsupported(ast.NodeVisitor):
     def visit_Break(self, node):
+        # reachable only for break/continue OUTSIDE any converted loop
+        # (e.g. inside an if within a `for` over a plain iterable) —
+        # converted while/for desugar theirs before if-conversion runs
         raise NotImplementedError(
-            "to_static AST fallback: break inside a converted while is "
-            "not supported — restructure with the loop condition")
+            "to_static AST fallback: break/continue here is only "
+            "supported inside a converted while/for-range loop")
 
     visit_Continue = visit_Break
 
@@ -267,11 +289,23 @@ class ControlFlowTransformer(ast.NodeTransformer):
         """`for i in range(...)` lowers to the while conversion (traced
         bounds become lax.while_loop; reference: loop_transformer's
         for-range handling). Other iterables stay untouched Python."""
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and not node.iter.keywords
+                    and isinstance(node.target, ast.Name)
+                    and not node.orelse)
+        # desugar THIS loop's break/continue before inner-if conversion
+        # (and before the index bump is appended: `continue` must still
+        # advance the loop variable, so the bump stays outside the
+        # skip guard)
+        pre_bc, wrap_bc = [], (lambda t: t)
+        if is_range:
+            node.body, _, pre_bc, wrap_bc = \
+                self._maybe_desugar_loop_body(node.body)
         node = self.generic_visit(node)
         it = node.iter
-        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
-                and it.func.id == "range" and not it.keywords
-                and isinstance(node.target, ast.Name) and not node.orelse):
+        if not is_range:
             return node
         a = it.args
         start = a[0] if len(a) >= 2 else ast.Constant(value=0)
@@ -308,18 +342,122 @@ class ControlFlowTransformer(ast.NodeTransformer):
                              op=ast.Add(),
                              value=ast.Name(id=step_t, ctx=ast.Load()))
         wnode = ast.While(
-            test=ast.Compare(left=ast.Name(id=tgt, ctx=ast.Load()),
-                             ops=[ast.Gt() if desc else ast.Lt()],
-                             comparators=[ast.Name(id=stop_t,
-                                                   ctx=ast.Load())]),
+            test=wrap_bc(ast.Compare(
+                left=ast.Name(id=tgt, ctx=ast.Load()),
+                ops=[ast.Gt() if desc else ast.Lt()],
+                comparators=[ast.Name(id=stop_t, ctx=ast.Load())])),
             body=list(node.body) + [bump], orelse=[])
         converted = self.visit_While(wnode)
-        return pre + (converted if isinstance(converted, list)
-                      else [converted])
+        return pre_bc + pre + (converted if isinstance(converted, list)
+                               else [converted])
+
+    # -- break / continue desugaring --------------------------------------
+
+    @staticmethod
+    def _has_bc(nodes) -> bool:
+        """True if a Break/Continue belonging to THIS loop level exists
+        (not inside nested loops or function defs)."""
+        class V(ast.NodeVisitor):
+            found = False
+
+            def visit_Break(self, n):
+                self.found = True
+
+            visit_Continue = visit_Break
+
+            def visit_While(self, n):
+                pass
+
+            def visit_For(self, n):
+                pass
+
+            def visit_FunctionDef(self, n):
+                pass
+        v = V()
+        for n in nodes:
+            v.visit(n)
+        return v.found
+
+    def _desugar_bc(self, stmts, brk, skip):
+        """Rewrite this loop level's Break/Continue into flag
+        assignments (reference: `dygraph_to_static/
+        break_continue_transformer.py` does the same flag rewrite on the
+        program AST):
+
+          break    ->  brk = True; skip = True   (rest unreachable)
+          continue ->  skip = True               (rest unreachable)
+          if containing either: rewrite branches, then guard the REST
+          of the surrounding block with `if not skip:`.
+
+        Runs BEFORE inner-if conversion, so the guard ifs convert to
+        lax.cond like any other if when values are traced. Semantics
+        corner: in a converted `for`, the index bump stays outside the
+        guard (continue must advance the loop variable), so after a
+        `break` the loop variable carries one extra increment."""
+        def tassign(name, val):
+            return ast.Assign(
+                targets=[ast.Name(id=name, ctx=ast.Store())],
+                value=ast.Constant(value=val))
+
+        out = []
+        for i, st in enumerate(stmts):
+            if isinstance(st, ast.Break):
+                return out + [tassign(brk, True), tassign(skip, True)]
+            if isinstance(st, ast.Continue):
+                return out + [tassign(skip, True)]
+            if isinstance(st, ast.If) and self._has_bc([st]):
+                new_if = ast.If(
+                    test=st.test,
+                    body=self._desugar_bc(st.body, brk, skip)
+                         or [ast.Pass()],
+                    orelse=self._desugar_bc(st.orelse, brk, skip))
+                out.append(new_if)
+                rest = self._desugar_bc(stmts[i + 1:], brk, skip)
+                if rest:
+                    guard = ast.Call(
+                        func=ast.Name(id="__pt_not", ctx=ast.Load()),
+                        args=[ast.Name(id=skip, ctx=ast.Load())],
+                        keywords=[])
+                    out.append(ast.If(test=guard, body=rest, orelse=[]))
+                return out
+            out.append(st)
+        return out
+
+    def _maybe_desugar_loop_body(self, body):
+        """If `body` (a converted loop's) has break/continue, desugar
+        and return (new_body, brk_name, pre_stmts, test_wrap) where
+        test_wrap wraps the loop test with `not brk and ...`."""
+        if not self._has_bc(body):
+            return body, None, [], lambda t: t
+        # single underscore: the `__pt_` prefix is excluded from loop
+        # carries, and these flags MUST be carried
+        n = self._fresh("n")[len("__pt_n_"):]
+        brk, skip = f"_pt_brk_{n}", f"_pt_skip_{n}"
+        new_body = [ast.Assign(
+            targets=[ast.Name(id=skip, ctx=ast.Store())],
+            value=ast.Constant(value=False))] + \
+            self._desugar_bc(body, brk, skip)
+        # both flags need a binding BEFORE the loop: they ride the carry
+        # (assigned in the body), and an unbound carry slot reads as
+        # _UNDEF at the call site
+        pre = [ast.Assign(targets=[ast.Name(id=brk, ctx=ast.Store())],
+                          value=ast.Constant(value=False)),
+               ast.Assign(targets=[ast.Name(id=skip, ctx=ast.Store())],
+                          value=ast.Constant(value=False))]
+
+        def wrap(test):
+            return ast.Call(
+                func=ast.Name(id="__pt_and_not", ctx=ast.Load()),
+                args=[ast.Name(id=brk, ctx=ast.Load()), test],
+                keywords=[])
+        return new_body, brk, pre, wrap
 
     # -- While ------------------------------------------------------------
 
     def visit_While(self, node):
+        body, _, pre, wrap = self._maybe_desugar_loop_body(node.body)
+        node.body = body
+        node.test = wrap(node.test)
         node = self.generic_visit(node)
         _Unsupported().generic_visit(ast.Module(body=node.body,
                                                 type_ignores=[]))
@@ -354,7 +492,7 @@ class ControlFlowTransformer(ast.NodeTransformer):
                                       for t in tmps], ctx=ast.Load()),
                       ast.Tuple(elts=assigned, ctx=ast.Load())],
                 keywords=[]))
-        return [c_def, b_def] + reads + [call]
+        return pre + [c_def, b_def] + reads + [call]
 
 
 @functools.lru_cache(maxsize=256)
@@ -378,6 +516,8 @@ def _convert(func: Callable) -> Callable:
     glb["__pt_if"] = _pt_if
     glb["__pt_while"] = _pt_while
     glb["__pt_undef"] = _UNDEF
+    glb["__pt_not"] = _pt_not
+    glb["__pt_and_not"] = _pt_and_not
     if func.__closure__:
         for name, cell in zip(func.__code__.co_freevars, func.__closure__):
             glb.setdefault(name, cell.cell_contents)

@@ -96,10 +96,45 @@ class TestConvertWhile:
 
         assert int(steps(jnp.asarray(6, jnp.int32))) == 8
 
-    def test_break_raises_clear_error(self):
+    def test_break_in_traced_while(self):
+        """break desugars to a carried flag (r5; reference:
+        break_continue_transformer.py) — the loop must stop the first
+        time the flag is set even though lax.while_loop has no early
+        exit."""
         def f(x):
-            while x[0] > 0:
-                break
+            i = jnp.zeros((), jnp.int32)
+            while i < 100:
+                x = x + 1.0
+                if x[0] > 4.5:
+                    break
+                i = i + 1
+            return x
+
+        out = convert_control_flow(f)(jnp.ones((1,)))
+        assert float(out[0]) == 5.0
+
+    def test_continue_in_traced_for(self):
+        def f(x, n):
+            s = x * 0
+            for k in range(n):
+                if k % 2 == 0:
+                    continue
+                s = s + x * k
+            return s
+
+        out = convert_control_flow(f)(jnp.ones((2,)),
+                                      jnp.asarray(10, jnp.int32))
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.full(2, 1.0 + 3 + 5 + 7 + 9))
+
+    def test_break_outside_converted_loop_raises(self):
+        """break inside an if within a for-over-iterable (a loop that
+        stays plain Python) still raises the clear error — the if
+        converts but its break has no converted loop to belong to."""
+        def f(x):
+            for v in [1, 2, 3]:
+                if x[0] > 0:
+                    break
             return x
 
         with pytest.raises(NotImplementedError, match="break"):

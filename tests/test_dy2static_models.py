@@ -1,0 +1,69 @@
+"""Full-model dy2static parity (VERDICT r4 item 6).
+
+The reference pushes whole models through `@to_static` and asserts
+dygraph equality (`python/paddle/fluid/tests/unittests/
+dygraph_to_static/test_bert.py:1`, `test_transformer.py:1`,
+`test_yolov3.py:1`). Same contract here: BERT encoder, the seq2seq
+transformer, and the YOLOv3 trunk run under `paddle_tpu.jit.to_static`
+and must match their eager forwards numerically (to_static here is a
+shape-specialized jit over the layer's functional form — equality is
+fp-exact up to XLA fusion reassociation, so tight tolerances hold).
+"""
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.jit import to_static
+
+
+def _eager_then_static(model, *args, tol=1e-5):
+    model.eval()
+    want = model(*args)
+    want = want if isinstance(want, (list, tuple)) else [want]
+    want = [np.asarray(w) for w in want]
+    to_static(model)   # shadows forward with the jitted StaticFunction
+    got = model(*args)
+    got = got if isinstance(got, (list, tuple)) else [got]
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), w, rtol=tol, atol=tol)
+
+
+class TestBertToStatic:
+    def test_bert_encoder_parity(self):
+        from paddle_tpu.models import BertModel, bert_tiny
+
+        pt.seed(0)
+        m = BertModel(bert_tiny())
+        rs = np.random.RandomState(0)
+        ids = jnp.asarray(rs.randint(0, 128, (2, 16)), jnp.int32)
+        _eager_then_static(m, ids)
+
+
+class TestTransformerToStatic:
+    def test_seq2seq_transformer_parity(self):
+        from paddle_tpu.models.transformer import TransformerModel
+
+        pt.seed(0)
+        m = TransformerModel(src_vocab_size=64, trg_vocab_size=64,
+                             max_length=32, d_model=32, n_head=4,
+                             num_encoder_layers=2, num_decoder_layers=2,
+                             d_inner_hid=64, dropout=0.0)
+        rs = np.random.RandomState(0)
+        src = jnp.asarray(rs.randint(2, 64, (2, 12)), jnp.int32)
+        trg = jnp.asarray(rs.randint(2, 64, (2, 10)), jnp.int32)
+        _eager_then_static(m, src, trg)
+
+
+class TestYOLOv3ToStatic:
+    def test_yolov3_trunk_parity(self):
+        from paddle_tpu.vision.models import yolov3_darknet53
+
+        pt.seed(0)
+        m = yolov3_darknet53(num_classes=8)
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(1, 3, 128, 128), jnp.float32)
+        _eager_then_static(m, x, tol=2e-4)
