@@ -202,8 +202,18 @@ class InMemoryDataset(DatasetBase):
         per_target: Dict[int, list] = {}
         for rec, t in zip(self._records, targets):
             per_target.setdefault(int(t), []).append(rec)
-        self._records = svc.exchange_records(per_target,
-                                             tag=f"ds{self._seed}")
+        try:
+            self._records = svc.exchange_records(per_target,
+                                                 tag=f"ds{self._seed}")
+        except TypeError as e:
+            # the PS wire moves DATA (arrays/scalars/str/bytes/
+            # lists/tuples/dicts), never pickled objects; custom record
+            # classes from set_parse_ins must be converted to tuples of
+            # arrays before a multi-rank global_shuffle
+            raise TypeError(
+                "global_shuffle records must be wire-encodable data "
+                "(tuples/lists of numpy arrays, scalars, str/bytes) — "
+                f"{e}") from e
         self.local_shuffle(seed)
 
     # -- sizes ------------------------------------------------------------

@@ -1,9 +1,11 @@
 """Sharded embedding table + TCP table service.
 
-See package docstring for the reference mapping. Wire protocol: pickled
-(op, table, payload) tuples over `multiprocessing.connection` (length-
-prefixed, HMAC-authenticated by authkey) — the brpc `sendrecv.proto`
-equivalent at test scale.
+See package docstring for the reference mapping. Wire protocol:
+length-prefixed BINARY (op, table, payload) messages (`wire.py` tagged
+encoding — ndarrays ship as dtype+dims+raw bytes, never pickle) over
+`multiprocessing.connection` transports whose connect handshake is
+HMAC-authenticated by authkey — the brpc `sendrecv.proto` equivalent
+(reference: `distributed/service/brpc_ps_server.cc:1`).
 """
 from __future__ import annotations
 
@@ -14,6 +16,8 @@ from multiprocessing.connection import Client, Listener
 from typing import Dict, Optional
 
 import numpy as np
+
+from .wire import recv_msg, send_msg
 
 _AUTHKEY_BASE = b"ptpu-ps-"
 _PORT_OFFSET = 200  # launcher endpoints use MASTER_PORT+1+rank; stay clear
@@ -154,36 +158,36 @@ class TableService:
         try:
             while not self._stop:
                 try:
-                    op, table, payload = conn.recv()
+                    op, table, payload = recv_msg(conn)
                 except (EOFError, OSError):
                     return
                 if op == "pull":
-                    conn.send(self._shards[table].pull(payload))
+                    send_msg(conn, self._shards[table].pull(payload))
                 elif op == "push":
                     ids, grads = payload
                     self._shards[table].push(ids, grads)
-                    conn.send(b"ok")
+                    send_msg(conn, b"ok")
                 elif op == "barrier_probe":
-                    conn.send(b"ok")
+                    send_msg(conn, b"ok")
                 elif op == "kv_put":
                     with self._kv_lock:
                         self._kv[table] = payload
-                    conn.send(b"ok")
+                    send_msg(conn, b"ok")
                 elif op == "kv_get":
                     with self._kv_lock:
-                        conn.send(self._kv.get(table))
+                        send_msg(conn, self._kv.get(table))
                 elif op == "kv_prefix":
                     with self._kv_lock:
-                        conn.send({k: v for k, v in self._kv.items()
-                                   if k.startswith(table)})
+                        send_msg(conn, {k: v for k, v in self._kv.items()
+                                      if k.startswith(table)})
                 elif op == "kv_del":
                     with self._kv_lock:
                         self._kv.pop(table, None)
-                    conn.send(b"ok")
+                    send_msg(conn, b"ok")
                 elif op == "shuffle_recv":
                     with self._shuffle_lock:
                         self._shuffle_buf.extend(payload)
-                    conn.send(b"ok")
+                    send_msg(conn, b"ok")
                 elif op == "heter_call":
                     # heterogeneous split training (reference:
                     # heter_client/server.cc): run a registered function
@@ -191,13 +195,13 @@ class TableService:
                     # on behalf of a CPU-side worker
                     fn = self._heter_fns.get(table)
                     if fn is None:
-                        conn.send(KeyError(f"heter fn {table!r} "
-                                           "not registered"))
+                        send_msg(conn, ("err", f"KeyError: heter fn "
+                                            f"{table!r} not registered"))
                     else:
                         try:
-                            conn.send(("ok", fn(*payload)))
+                            send_msg(conn, ("ok", fn(*payload)))
                         except Exception as e:  # noqa: BLE001
-                            conn.send(("err", repr(e)))
+                            send_msg(conn, ("err", repr(e)))
         finally:
             try:
                 conn.close()
@@ -236,8 +240,8 @@ class TableService:
         # async pushes must not interleave send/recv with the caller's
         # kv/barrier/pull RPCs (crossed replies otherwise)
         with self._rpc_locks[peer]:
-            c.send((op, table, payload))
-            return c.recv()
+            send_msg(c, (op, table, payload))
+            return recv_msg(c)
 
     def register(self, name: str, vocab: int, dim: int, lr: float = 0.1,
                  seed: int = 0) -> "ShardedEmbeddingTable":
@@ -350,10 +354,13 @@ class TableService:
         if peer == self.rank:
             return self._heter_fns[name](*args)
         res = self._rpc(peer, "heter_call", name, args)
-        if isinstance(res, Exception):
-            raise res
         status, payload = res
         if status != "ok":
+            # preserve the pre-binary-wire contract: unregistered fn
+            # surfaced as KeyError (the server used to ship the
+            # exception object itself; the wire now moves data only)
+            if payload.startswith("KeyError"):
+                raise KeyError(payload)
             raise RuntimeError(f"heter_call {name!r} on rank {peer} "
                                f"failed: {payload}")
         return payload

@@ -26,7 +26,10 @@ conversion (reference: `break_continue_transformer.py`): `break` sets a
 break flag checked by the loop condition, `continue` sets a skip flag
 guarding the rest of that iteration's body. One Python-semantics corner
 is documented at `_desugar_bc`: after a traced `break` in a converted
-`for`, the loop variable holds one extra increment.
+`for`, the loop variable holds one extra increment. A `for` with a
+NON-literal step stays plain Python and cannot be desugared, so a
+break/continue inside one of its `if`s raises the clear
+NotImplementedError rather than silently changing behavior.
 """
 from __future__ import annotations
 
@@ -103,12 +106,18 @@ def _pt_not(x):
     return jnp.logical_not(x) if _is_traced(x) else (not x)
 
 
-def _pt_and_not(brk, test):
-    """`(not brk) and test` for loop conditions, tracer-safe on either
-    side."""
-    if _is_traced(brk) or _is_traced(test):
-        return jnp.logical_and(jnp.logical_not(brk), test)
-    return (not brk) and test
+def _pt_and_not(brk, test_thunk):
+    """`(not brk) and <test>` for loop conditions. The test rides in a
+    thunk so the CONCRETE path short-circuits like Python's `break`
+    (the test must not be re-evaluated after break — it may index with
+    a now-out-of-range variable). On the traced path lax.while_loop
+    evaluates the condition every tick by construction, so the thunk
+    runs and combines via logical_and."""
+    if _is_traced(brk):
+        return jnp.logical_and(jnp.logical_not(brk), test_thunk())
+    if brk:
+        return False
+    return test_thunk()
 
 
 def _pt_while(cond_fn, body_fn, carry, assigned):
@@ -295,6 +304,13 @@ class ControlFlowTransformer(ast.NodeTransformer):
                     and not node.iter.keywords
                     and isinstance(node.target, ast.Name)
                     and not node.orelse)
+        if is_range and len(node.iter.args) == 3 and \
+                self._const_value(node.iter.args[2]) is None:
+            # non-literal step keeps Python semantics (direction
+            # unknowable statically) — and therefore MUST NOT be
+            # desugared: stripped break/continue flags with no loop
+            # machinery would silently change behavior
+            is_range = False
         # desugar THIS loop's break/continue before inner-if conversion
         # (and before the index bump is appended: `continue` must still
         # advance the loop variable, so the bump stays outside the
@@ -312,15 +328,7 @@ class ControlFlowTransformer(ast.NodeTransformer):
         stop = a[1] if len(a) >= 2 else a[0]
         step = a[2] if len(a) == 3 else ast.Constant(value=1)
 
-        def _const(n):
-            if isinstance(n, ast.Constant):
-                return n.value
-            if isinstance(n, ast.UnaryOp) and isinstance(n.op, ast.USub) \
-                    and isinstance(n.operand, ast.Constant):
-                return -n.operand.value   # -2 parses as USub(Constant(2))
-            return None
-
-        sv = _const(step)
+        sv = self._const_value(step)
         if sv is None and len(a) == 3:
             # non-literal step: loop direction unknowable at transform
             # time — keep Python semantics
@@ -352,6 +360,15 @@ class ControlFlowTransformer(ast.NodeTransformer):
                                else [converted])
 
     # -- break / continue desugaring --------------------------------------
+
+    @staticmethod
+    def _const_value(n):
+        if isinstance(n, ast.Constant):
+            return n.value
+        if isinstance(n, ast.UnaryOp) and isinstance(n.op, ast.USub) \
+                and isinstance(n.operand, ast.Constant):
+            return -n.operand.value   # -2 parses as USub(Constant(2))
+        return None
 
     @staticmethod
     def _has_bc(nodes) -> bool:
@@ -446,9 +463,13 @@ class ControlFlowTransformer(ast.NodeTransformer):
                           value=ast.Constant(value=False))]
 
         def wrap(test):
+            thunk = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=test)
             return ast.Call(
                 func=ast.Name(id="__pt_and_not", ctx=ast.Load()),
-                args=[ast.Name(id=brk, ctx=ast.Load()), test],
+                args=[ast.Name(id=brk, ctx=ast.Load()), thunk],
                 keywords=[])
         return new_body, brk, pre, wrap
 

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""PS wire throughput micro-bench (VERDICT r4 item 7 acceptance).
+
+Two processes, one table: rank 1 hammers pull and push RPCs against
+rank 0's shard over the binary wire (`distributed/ps/wire.py`) and
+reports ops/s and effective MB/s. Run: python tools/ps_bench.py
+"""
+from __future__ import annotations
+
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+VOCAB, DIM, BATCH, OPS = 100_000, 64, 512, 300
+
+
+def _worker(rank, port, q):
+    os.environ["MASTER_PORT"] = str(port)
+    import numpy as np
+    from paddle_tpu.distributed.ps.table import TableService
+
+    svc = TableService(rank, 2, port)
+    svc.register("emb", VOCAB, DIM, lr=0.1, seed=0)
+    rs = np.random.RandomState(rank)
+    # all ids on the PEER's shard -> every op is a real network RPC
+    lo = 0 if rank == 1 else VOCAB // 2
+    ids = rs.randint(lo, lo + VOCAB // 2 - 1, BATCH)
+    grads = rs.randn(BATCH, DIM).astype(np.float32)
+
+    if rank == 1:
+        svc.pull("emb", ids)                      # connect + warm
+        t0 = time.perf_counter()
+        for _ in range(OPS):
+            svc.pull("emb", ids)
+        dt_pull = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(OPS):
+            svc.push("emb", ids, grads, sync=True)
+        dt_push = time.perf_counter() - t0
+        row_bytes = BATCH * DIM * 4
+        q.put({
+            "pull_ops_s": round(OPS / dt_pull, 1),
+            "pull_MB_s": round(OPS * row_bytes / dt_pull / 1e6, 1),
+            "push_ops_s": round(OPS / dt_push, 1),
+            "push_MB_s": round(OPS * row_bytes / dt_push / 1e6, 1),
+            "batch": BATCH, "dim": DIM,
+        })
+        svc.barrier("psbench")
+    else:
+        svc.barrier("psbench")
+    svc.shutdown()
+
+
+def main():
+    port = 29650
+    q: "mp.Queue" = mp.Queue()
+    ps = [mp.Process(target=_worker, args=(r, port, q)) for r in (0, 1)]
+    for p in ps:
+        p.start()
+    res = q.get(timeout=120)
+    for p in ps:
+        p.join(timeout=30)
+    print(json.dumps({"metric": "ps_wire_pull_ops_per_s",
+                      "value": res["pull_ops_s"], "unit": "ops/s",
+                      **{k: v for k, v in res.items()
+                         if k != "pull_ops_s"}}))
+
+
+if __name__ == "__main__":
+    mp.set_start_method("spawn")
+    main()
