@@ -1,0 +1,162 @@
+// Package ptpu is the Go binding for the paddle_tpu native inference
+// C API (csrc/ptpu_inference_api.h).
+//
+// Reference counterpart: the Go inference API at
+// /root/reference/paddle/fluid/inference/goapi/ (predictor.go wrapping
+// the capi_exp C API). Same shape here: a cgo wrapper over
+// ptpu_predictor_* with no Python in the serving process.
+//
+// Build: the shared object lives at paddle_tpu/_native_predictor.so
+// (built by csrc/Makefile). Example:
+//
+//	CGO_LDFLAGS="-L$REPO/paddle_tpu -l:_native_predictor.so \
+//	    -Wl,-rpath,$REPO/paddle_tpu" \
+//	CGO_CFLAGS="-I$REPO/csrc" go test ./goapi
+//
+// The test skips itself when the artifact fixture is absent; generate
+// one with:
+//
+//	python -c "import paddle_tpu as pt, numpy as np; \
+//	  net = pt.nn.Sequential(pt.nn.Linear(8, 4)); \
+//	  pt.onnx.export(net, 'goapi/testdata/lin', \
+//	      input_spec=[pt.static.InputSpec([2, 8], 'float32')])"
+package ptpu
+
+/*
+#include <stdlib.h>
+#include "ptpu_inference_api.h"
+*/
+import "C"
+
+import (
+	"errors"
+	"runtime"
+	"unsafe"
+)
+
+// Predictor wraps one PTPU_Predictor. Not safe for concurrent use;
+// create one per goroutine (the C API is thread-compatible, not
+// thread-safe, matching the reference's per-thread predictors).
+type Predictor struct {
+	p *C.PTPU_Predictor
+}
+
+const errLen = 512
+
+func lastErr(buf []C.char) error {
+	return errors.New(C.GoString(&buf[0]))
+}
+
+// NewPredictor loads an exported ONNX artifact
+// (paddle_tpu.onnx.export / QAT.save_quantized_model output).
+func NewPredictor(modelPath string) (*Predictor, error) {
+	cpath := C.CString(modelPath)
+	defer C.free(unsafe.Pointer(cpath))
+	buf := make([]C.char, errLen)
+	p := C.ptpu_predictor_create(cpath, &buf[0], errLen)
+	if p == nil {
+		return nil, lastErr(buf)
+	}
+	pred := &Predictor{p: p}
+	runtime.SetFinalizer(pred, func(x *Predictor) { x.Destroy() })
+	return pred, nil
+}
+
+// Destroy frees the native predictor. Safe to call twice.
+func (p *Predictor) Destroy() {
+	if p.p != nil {
+		C.ptpu_predictor_destroy(p.p)
+		p.p = nil
+	}
+}
+
+func (p *Predictor) NumInputs() int {
+	return int(C.ptpu_predictor_num_inputs(p.p))
+}
+
+func (p *Predictor) NumOutputs() int {
+	return int(C.ptpu_predictor_num_outputs(p.p))
+}
+
+func (p *Predictor) InputName(i int) string {
+	return C.GoString(C.ptpu_predictor_input_name(p.p, C.int(i)))
+}
+
+func dimsPtr(dims []int64) (*C.int64_t, C.int) {
+	if len(dims) == 0 {
+		return nil, 0
+	}
+	return (*C.int64_t)(unsafe.Pointer(&dims[0])), C.int(len(dims))
+}
+
+// SetInput binds a float32 input tensor (row-major).
+func (p *Predictor) SetInput(name string, data []float32,
+	dims []int64) error {
+	cname := C.CString(name)
+	defer C.free(unsafe.Pointer(cname))
+	buf := make([]C.char, errLen)
+	dp, nd := dimsPtr(dims)
+	rc := C.ptpu_predictor_set_input(p.p, cname,
+		(*C.float)(unsafe.Pointer(&data[0])), dp, nd, &buf[0], errLen)
+	if rc != 0 {
+		return lastErr(buf)
+	}
+	return nil
+}
+
+// SetInputInt32 binds an int32 input (token ids, lengths).
+func (p *Predictor) SetInputInt32(name string, data []int32,
+	dims []int64) error {
+	cname := C.CString(name)
+	defer C.free(unsafe.Pointer(cname))
+	buf := make([]C.char, errLen)
+	dp, nd := dimsPtr(dims)
+	rc := C.ptpu_predictor_set_input_i32(p.p, cname,
+		(*C.int32_t)(unsafe.Pointer(&data[0])), dp, nd, &buf[0], errLen)
+	if rc != 0 {
+		return lastErr(buf)
+	}
+	return nil
+}
+
+// SetInputInt64 binds an int64 input.
+func (p *Predictor) SetInputInt64(name string, data []int64,
+	dims []int64) error {
+	cname := C.CString(name)
+	defer C.free(unsafe.Pointer(cname))
+	buf := make([]C.char, errLen)
+	dp, nd := dimsPtr(dims)
+	rc := C.ptpu_predictor_set_input_i64(p.p, cname,
+		(*C.int64_t)(unsafe.Pointer(&data[0])), dp, nd, &buf[0], errLen)
+	if rc != 0 {
+		return lastErr(buf)
+	}
+	return nil
+}
+
+// Run executes the graph.
+func (p *Predictor) Run() error {
+	buf := make([]C.char, errLen)
+	if rc := C.ptpu_predictor_run(p.p, &buf[0], errLen); rc != 0 {
+		return lastErr(buf)
+	}
+	return nil
+}
+
+// Output returns output i of the last Run as (data, dims). The slices
+// are COPIES — valid after the next Run, unlike the C pointers.
+func (p *Predictor) Output(i int) ([]float32, []int64) {
+	nd := int(C.ptpu_predictor_output_ndim(p.p, C.int(i)))
+	cdims := C.ptpu_predictor_output_dims(p.p, C.int(i))
+	dims := make([]int64, nd)
+	n := int64(1)
+	cd := unsafe.Slice((*int64)(unsafe.Pointer(cdims)), nd)
+	for k := 0; k < nd; k++ {
+		dims[k] = cd[k]
+		n *= cd[k]
+	}
+	cdata := C.ptpu_predictor_output_data(p.p, C.int(i))
+	out := make([]float32, n)
+	copy(out, unsafe.Slice((*float32)(unsafe.Pointer(cdata)), n))
+	return out, dims
+}

@@ -1,0 +1,59 @@
+package ptpu
+
+import (
+	"math"
+	"os"
+	"testing"
+)
+
+// Round-trips a small exported Linear artifact through the C ABI
+// (mirrors /root/reference/paddle/fluid/inference/goapi tests: load,
+// bind, run, fetch). Skips when the fixture is absent — generate with
+// the command in the package docstring.
+func TestPredictorRoundTrip(t *testing.T) {
+	const fixture = "testdata/lin.onnx"
+	if _, err := os.Stat(fixture); err != nil {
+		t.Skipf("fixture %s absent — generate per package docs", fixture)
+	}
+	p, err := NewPredictor(fixture)
+	if err != nil {
+		t.Fatal(err)
+	}
+	defer p.Destroy()
+
+	if p.NumInputs() != 1 {
+		t.Fatalf("inputs = %d, want 1", p.NumInputs())
+	}
+	x := make([]float32, 2*8)
+	for i := range x {
+		x[i] = float32(i) * 0.125
+	}
+	if err := p.SetInput(p.InputName(0), x, []int64{2, 8}); err != nil {
+		t.Fatal(err)
+	}
+	if err := p.Run(); err != nil {
+		t.Fatal(err)
+	}
+	out, dims := p.Output(0)
+	if len(dims) != 2 || dims[0] != 2 || dims[1] != 4 {
+		t.Fatalf("dims = %v, want [2 4]", dims)
+	}
+	for _, v := range out {
+		if math.IsNaN(float64(v)) {
+			t.Fatal("NaN in output")
+		}
+	}
+	// determinism: same input, same output
+	if err := p.SetInput(p.InputName(0), x, []int64{2, 8}); err != nil {
+		t.Fatal(err)
+	}
+	if err := p.Run(); err != nil {
+		t.Fatal(err)
+	}
+	out2, _ := p.Output(0)
+	for i := range out {
+		if out[i] != out2[i] {
+			t.Fatalf("output not deterministic at %d", i)
+		}
+	}
+}

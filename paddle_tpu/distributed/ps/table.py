@@ -161,6 +161,14 @@ class TableService:
                     op, table, payload = recv_msg(conn)
                 except (EOFError, OSError):
                     return
+                except ValueError as e:
+                    # malformed frame (wire.loads protocol error): drop
+                    # THIS connection cleanly; the serve thread and the
+                    # service survive a garbled/malicious peer
+                    import sys
+                    print(f"ps: dropping connection on malformed "
+                          f"frame: {e}", file=sys.stderr)
+                    return
                 if op == "pull":
                     send_msg(conn, self._shards[table].pull(payload))
                 elif op == "push":
@@ -358,8 +366,11 @@ class TableService:
         if status != "ok":
             # preserve the pre-binary-wire contract: unregistered fn
             # surfaced as KeyError (the server used to ship the
-            # exception object itself; the wire now moves data only)
-            if payload.startswith("KeyError"):
+            # exception object itself; the wire now moves data only).
+            # Match the exact server sentinel — a KeyError raised
+            # INSIDE a registered fn reprs as "KeyError('...')" and
+            # must stay a RuntimeError like any other fn failure
+            if payload.startswith("KeyError: heter fn"):
                 raise KeyError(payload)
             raise RuntimeError(f"heter_call {name!r} on rank {peer} "
                                f"failed: {payload}")

@@ -63,6 +63,11 @@ def _pack(obj: Any, out: list) -> None:
         b = bytes(obj)
         out.append(bytes([_T_BYTES]) + _U32.pack(len(b)) + b)
     elif isinstance(obj, np.ndarray):
+        if obj.dtype == object or obj.dtype.hasobject:
+            # tobytes() on an object array would ship raw POINTERS the
+            # receiver cannot decode — fail here, at the sender, with
+            # the clear message (dataset.py relays it for shuffles)
+            raise TypeError("PS wire cannot encode object-dtype arrays")
         # ascontiguousarray promotes 0-d to (1,): reshape back so array
         # shape round-trips exactly (a 0-d loss must not grow an axis)
         a = np.ascontiguousarray(obj).reshape(obj.shape)

@@ -65,7 +65,7 @@ def estimate(cfg, *, batch: int, seq: int, tp: int = 1, shard: int = 1,
 
     P = param_count(cfg)
     d, L, V = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
-    act_bytes = 2 if param_dtype_bytes == 2 or cfg.dtype_bytes == 2 else 4
+    act_bytes = 2 if param_dtype_bytes == 2 or _cfg_bytes(cfg) == 2 else 4
     b_local = max(1, batch)   # caller passes the PER-CHIP batch
 
     param_shard = tp * (shard if zero_stage >= 3 else 1)
@@ -138,12 +138,10 @@ def validate_scaled():
     from paddle_tpu.models import GPTConfig
     cfg = GPTConfig(vocab_size=4096, hidden_size=256, num_layers=8,
                     num_heads=8, max_position_embeddings=512)
-    cfg.dtype_bytes = _cfg_bytes(cfg)
     e8 = estimate(cfg, batch=2, seq=512, tp=2, shard=4, zero_stage=3,
                   remat="full", loss_chunks=8, param_dtype_bytes=4)
     cfg16 = GPTConfig(vocab_size=4096, hidden_size=256, num_layers=16,
                       num_heads=8, max_position_embeddings=512)
-    cfg16.dtype_bytes = _cfg_bytes(cfg16)
     e16 = estimate(cfg16, batch=2, seq=512, tp=2, shard=4, zero_stage=3,
                    remat="full", loss_chunks=8, param_dtype_bytes=4)
     analytic_slope = (e16["total"] - e8["total"]) / 8.0
@@ -170,7 +168,6 @@ def main():
     # intended pod split for config 5: v5e-16, zero3 sharding=8 x tp=2,
     # bf16 params + fp32 masters offloaded to host
     cfg = ernie_10b()
-    cfg.dtype_bytes = _cfg_bytes(cfg)
     est = estimate(cfg, batch=1, seq=2048, tp=2, shard=8, zero_stage=3,
                    offload=True, param_dtype_bytes=2,
                    multi_precision=True, remat="full", loss_chunks=16)
@@ -184,7 +181,6 @@ def main():
 
     # single-chip offload ladder point: 2.6B bf16 + host masters
     cfg = gpt_2p6b()
-    cfg.dtype_bytes = _cfg_bytes(cfg)
     est = estimate(cfg, batch=1, seq=1024, tp=1, shard=1, zero_stage=2,
                    offload=True, param_dtype_bytes=2,
                    multi_precision=True, remat="full", loss_chunks=8)
