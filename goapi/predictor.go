@@ -34,6 +34,10 @@ import (
 	"unsafe"
 )
 
+// Every method ends with runtime.KeepAlive(p): without it the GC may
+// collect p (running the finalizer's destroy) while C code is still
+// executing on the native predictor — a use-after-free.
+
 // Predictor wraps one PTPU_Predictor. Not safe for concurrent use;
 // create one per goroutine (the C API is thread-compatible, not
 // thread-safe, matching the reference's per-thread predictors).
@@ -67,19 +71,26 @@ func (p *Predictor) Destroy() {
 	if p.p != nil {
 		C.ptpu_predictor_destroy(p.p)
 		p.p = nil
+		runtime.SetFinalizer(p, nil)
 	}
 }
 
 func (p *Predictor) NumInputs() int {
-	return int(C.ptpu_predictor_num_inputs(p.p))
+	n := int(C.ptpu_predictor_num_inputs(p.p))
+	runtime.KeepAlive(p)
+	return n
 }
 
 func (p *Predictor) NumOutputs() int {
-	return int(C.ptpu_predictor_num_outputs(p.p))
+	n := int(C.ptpu_predictor_num_outputs(p.p))
+	runtime.KeepAlive(p)
+	return n
 }
 
 func (p *Predictor) InputName(i int) string {
-	return C.GoString(C.ptpu_predictor_input_name(p.p, C.int(i)))
+	s := C.GoString(C.ptpu_predictor_input_name(p.p, C.int(i)))
+	runtime.KeepAlive(p)
+	return s
 }
 
 func dimsPtr(dims []int64) (*C.int64_t, C.int) {
@@ -95,9 +106,14 @@ func (p *Predictor) SetInput(name string, data []float32,
 	cname := C.CString(name)
 	defer C.free(unsafe.Pointer(cname))
 	buf := make([]C.char, errLen)
+	if len(data) == 0 {
+		return errors.New("SetInput: empty data slice")
+	}
 	dp, nd := dimsPtr(dims)
 	rc := C.ptpu_predictor_set_input(p.p, cname,
 		(*C.float)(unsafe.Pointer(&data[0])), dp, nd, &buf[0], errLen)
+	runtime.KeepAlive(p)
+	runtime.KeepAlive(data)
 	if rc != 0 {
 		return lastErr(buf)
 	}
@@ -110,9 +126,14 @@ func (p *Predictor) SetInputInt32(name string, data []int32,
 	cname := C.CString(name)
 	defer C.free(unsafe.Pointer(cname))
 	buf := make([]C.char, errLen)
+	if len(data) == 0 {
+		return errors.New("SetInputInt32: empty data slice")
+	}
 	dp, nd := dimsPtr(dims)
 	rc := C.ptpu_predictor_set_input_i32(p.p, cname,
 		(*C.int32_t)(unsafe.Pointer(&data[0])), dp, nd, &buf[0], errLen)
+	runtime.KeepAlive(p)
+	runtime.KeepAlive(data)
 	if rc != 0 {
 		return lastErr(buf)
 	}
@@ -125,9 +146,14 @@ func (p *Predictor) SetInputInt64(name string, data []int64,
 	cname := C.CString(name)
 	defer C.free(unsafe.Pointer(cname))
 	buf := make([]C.char, errLen)
+	if len(data) == 0 {
+		return errors.New("SetInputInt64: empty data slice")
+	}
 	dp, nd := dimsPtr(dims)
 	rc := C.ptpu_predictor_set_input_i64(p.p, cname,
 		(*C.int64_t)(unsafe.Pointer(&data[0])), dp, nd, &buf[0], errLen)
+	runtime.KeepAlive(p)
+	runtime.KeepAlive(data)
 	if rc != 0 {
 		return lastErr(buf)
 	}
@@ -137,7 +163,9 @@ func (p *Predictor) SetInputInt64(name string, data []int64,
 // Run executes the graph.
 func (p *Predictor) Run() error {
 	buf := make([]C.char, errLen)
-	if rc := C.ptpu_predictor_run(p.p, &buf[0], errLen); rc != 0 {
+	rc := C.ptpu_predictor_run(p.p, &buf[0], errLen)
+	runtime.KeepAlive(p)
+	if rc != 0 {
 		return lastErr(buf)
 	}
 	return nil
@@ -158,5 +186,6 @@ func (p *Predictor) Output(i int) ([]float32, []int64) {
 	cdata := C.ptpu_predictor_output_data(p.p, C.int(i))
 	out := make([]float32, n)
 	copy(out, unsafe.Slice((*float32)(unsafe.Pointer(cdata)), n))
+	runtime.KeepAlive(p)
 	return out, dims
 }

@@ -63,7 +63,7 @@ def _pack(obj: Any, out: list) -> None:
         b = bytes(obj)
         out.append(bytes([_T_BYTES]) + _U32.pack(len(b)) + b)
     elif isinstance(obj, np.ndarray):
-        if obj.dtype == object or obj.dtype.hasobject:
+        if obj.dtype.hasobject:  # object dtype or structured-with-objects
             # tobytes() on an object array would ship raw POINTERS the
             # receiver cannot decode — fail here, at the sender, with
             # the clear message (dataset.py relays it for shuffles)

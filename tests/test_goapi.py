@@ -38,6 +38,10 @@ def test_go_round_trip(tmp_path):
     import paddle_tpu as pt
     from paddle_tpu.static import InputSpec
 
+    # ensure the .so exists (fresh checkout): same build the predictor
+    # tests use
+    subprocess.run(["make", "all"], cwd=os.path.join(REPO, "csrc"),
+                   check=True, capture_output=True, timeout=300)
     td = os.path.join(GOAPI, "testdata")
     os.makedirs(td, exist_ok=True)
     pt.seed(0)

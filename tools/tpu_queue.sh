@@ -51,4 +51,14 @@ run_item resnet_conv_256 900 env PTPU_BENCH_RESNET_STEM=conv \
 # 4. Decomposition profile (batch 256)
 run_item conv_profile 1200 python tools/conv_profile.py 256
 
+# 5. YOLO + GPT headline re-bank (freshest hardware rows for r5)
+run_item yolo_48 900 env PTPU_BENCH_ONLY=yolo:48 python bench.py
+run_item gpt_base 900 env PTPU_BENCH_ONLY=gpt python bench.py
+
+# 6. flash-attention vs XLA A/B at 2k/8k (VERDICT r4 item 10): backs
+# the kernel docstring claims with on-chip numbers
+run_item flash_ab 1200 python -m paddle_tpu.tools.op_bench \
+  --ops flash_attn_2k,xla_attn_2k,flash_attn_8k,xla_attn_8k \
+  --out flash_ab_tpu.json
+
 echo "=== queue done $(date -u +%FT%TZ) ===" | tee -a "$LOG"
