@@ -226,7 +226,10 @@ def bench_gpt(on_tpu: bool, variant: str = "") -> dict:
     `variant` arms explore the remaining headroom AFTER the known-good
     number is banked: 'b16' doubles the batch, 'nr' drops remat (345M
     activations fit HBM — recompute is pure overhead if so), 'b16nr'
-    both. main() replaces the final headline if an arm is faster."""
+    both, 'da' switches to the dots_attn remat policy (keeps the named
+    attention output so the backward skips the flash-forward replay —
+    ~16MB/layer of residency for one less kernel pass). main()
+    replaces the final headline if an arm is faster."""
     import jax
     import jax.numpy as jnp
     import paddle_tpu as pt
@@ -253,7 +256,8 @@ def bench_gpt(on_tpu: bool, variant: str = "") -> dict:
                              grad_clip=pt.nn.ClipGradByGlobalNorm(1.0))
     step, state = build_train_step(model, opt, mesh, num_microbatches=1,
                                    remat="nr" not in variant,
-                                   remat_policy="dots",
+                                   remat_policy="dots_attn"
+                                   if "da" in variant else "dots",
                                    loss_chunks=chunks)
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)),
@@ -681,7 +685,7 @@ def main():
                     # REAL TPU headline metric may be promoted — a
                     # CPU-fallback child reports the tiny-model metric
                     # and must never become the headline
-                    for var in ("b16", "nr", "b16nr"):
+                    for var in ("b16", "nr", "b16nr", "da", "b16da"):
                         res = _run_secondary_attempt(f"gpt:{var}", 700)
                         if (res is not None and res.get("metric") ==
                                 "gpt345m_pretrain_tokens_per_sec_per_chip"

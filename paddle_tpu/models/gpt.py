@@ -292,9 +292,10 @@ def build_train_step(model: GPTForPretraining, optimizer, mesh,
     the CollectivePermute schedule), 'sharding' (ZeRO — optimizer-state
     specs), 'sequence' (SP — activations sharded on the seq dim with
     zigzag-balanced causal ring attention in every decoder layer;
-    composes with dp×tp×zero, pp excluded). This replaces the
-    reference's whole meta-optimizer chain (`fleet_base.py:1288` →
-    StrategyCompiler → program rewriting).
+    composes with dp×tp×zero AND pp — the schedules split the batch
+    dim into microbatches, orthogonal to the sequence shard). This
+    replaces the reference's whole meta-optimizer chain
+    (`fleet_base.py:1288` → StrategyCompiler → program rewriting).
 
     Returns (step_fn, state) where state = (outer, stacked_blocks,
     opt_state) and step_fn(state, batch) -> (state, loss);
