@@ -875,19 +875,35 @@ void Predictor::run_node(const Node& n) {
   } else if (op == "Transpose") {
     const Tensor& a = in(n, 0);
     auto perm = attr_ints(n, "perm");
+    if (perm.empty())  // ONNX default: reverse the axes
+      for (size_t d = a.dims.size(); d-- > 0;)
+        perm.push_back(int64_t(d));
     Tensor o;
     o.dtype = a.dtype;
     o.dims.resize(a.dims.size());
     for (size_t k = 0; k < perm.size(); ++k)
       o.dims[k] = a.dims[size_t(perm[k])];
     o.alloc();
+    // odometer walk: src index updated incrementally per output
+    // element (every attention matmul lowers through Transpose — the
+    // old per-element div/mod chain dominated transformer serving)
     auto istr = strides_for(a.dims);
-    auto ostr = strides_for(o.dims);
-    for (int64_t k = 0; k < o.numel(); ++k) {
-      int64_t src = 0;
-      for (size_t d = 0; d < o.dims.size(); ++d)
-        src += ((k / ostr[d]) % o.dims[d]) * istr[size_t(perm[d])];
-      o.set(k, a.at(src));
+    const size_t r = o.dims.size();
+    std::vector<int64_t> sstr(r), ctr(r, 0);
+    for (size_t d = 0; d < r; ++d) sstr[d] = istr[size_t(perm[d])];
+    const int64_t nel = o.numel();
+    int64_t src = 0;
+    const bool flt = a.is_float();
+    for (int64_t k = 0; k < nel; ++k) {
+      if (flt) o.f[size_t(k)] = a.f[size_t(src)];
+      else o.i[size_t(k)] = a.i[size_t(src)];
+      for (size_t d = r; d-- > 0;) {
+        ++ctr[d];
+        src += sstr[d];
+        if (ctr[d] < o.dims[d]) break;
+        src -= sstr[d] * o.dims[d];
+        ctr[d] = 0;
+      }
     }
     out(std::move(o));
   } else if (op == "Concat") {
@@ -989,25 +1005,30 @@ void Predictor::run_node(const Node& n) {
     for (size_t d = size_t(axis) + 1; d < a.dims.size(); ++d)
       o.dims.push_back(a.dims[d]);
     o.alloc();
-    auto istr = strides_for(a.dims);
-    auto ostr = strides_for(o.dims);
     int64_t ax_dim = a.dims[size_t(axis)];
-    for (int64_t k = 0; k < o.numel(); ++k) {
-      int64_t src = 0;
-      size_t od = 0;
-      for (int64_t d = 0; d < axis; ++d, ++od)
-        src += ((k / ostr[od]) % o.dims[od]) * istr[size_t(d)];
-      int64_t iflat = 0;
-      auto xstr = strides_for(idx.dims);
-      for (size_t d = 0; d < idx.dims.size(); ++d, ++od)
-        iflat += ((k / ostr[od]) % o.dims[od]) * xstr[d];
-      int64_t iv = idx.i.empty() ? int64_t(idx.at(iflat)) : idx.i[iflat];
-      if (iv < 0) iv += ax_dim;
-      src += iv * istr[size_t(axis)];
-      for (size_t d = size_t(axis) + 1; d < a.dims.size(); ++d, ++od)
-        src += ((k / ostr[od]) % o.dims[od]) * istr[d];
-      o.set(k, a.at(src));
-    }
+    /* row-copy formulation: output = [outer, idx..., inner] where
+     * inner = contiguous tail of `a` after `axis` — copy `inner`
+     * elements per (outer, index) pair instead of re-deriving every
+     * coordinate per element. */
+    int64_t inner = 1;
+    for (size_t d = size_t(axis) + 1; d < a.dims.size(); ++d)
+      inner *= a.dims[d];
+    int64_t outer = 1;
+    for (int64_t d = 0; d < axis; ++d) outer *= a.dims[size_t(d)];
+    const int64_t nidx = idx.numel();
+    for (int64_t ou = 0; ou < outer; ++ou)
+      for (int64_t j = 0; j < nidx; ++j) {
+        int64_t iv = idx.i.empty() ? int64_t(idx.at(j)) : idx.i[size_t(j)];
+        if (iv < 0) iv += ax_dim;
+        const int64_t src = (ou * ax_dim + iv) * inner;
+        const int64_t dst = (ou * nidx + j) * inner;
+        if (a.is_float())
+          std::memcpy(o.f.data() + dst, a.f.data() + src,
+                      size_t(inner) * sizeof(float));
+        else
+          std::memcpy(o.i.data() + dst, a.i.data() + src,
+                      size_t(inner) * sizeof(int64_t));
+      }
     out(std::move(o));
   } else if (op == "MatMul") {
     const Tensor &a = in(n, 0), &b = in(n, 1);
@@ -1233,9 +1254,47 @@ void Predictor::run_node(const Node& n) {
       else if (keep) o.dims.push_back(1);
     }
     o.alloc();
-    double init = op == "ReduceMax" ? -1e300
-                  : op == "ReduceMin" ? 1e300
-                  : op == "ReduceProd" ? 1.0 : 0.0;
+    const int rc = op == "ReduceMax" ? 1 : op == "ReduceMin" ? 2
+                   : op == "ReduceProd" ? 3 : op == "ReduceMean" ? 4 : 0;
+    const double init = rc == 1 ? -1e300 : rc == 2 ? 1e300
+                        : rc == 3 ? 1.0 : 0.0;
+    // fast path: reduced axes form a contiguous SUFFIX (softmax/LN
+    // reductions after export are all last-axis) — contiguous row
+    // scans instead of per-element rank-deep div/mod
+    size_t split = a.dims.size();
+    while (split > 0 && red[split - 1]) --split;
+    bool suffix = true;
+    for (size_t d = 0; d < split; ++d)
+      if (red[d]) { suffix = false; break; }
+    if (suffix && a.is_float()) {
+      int64_t inner = 1, outer = 1;
+      for (size_t d = split; d < a.dims.size(); ++d) inner *= a.dims[d];
+      for (size_t d = 0; d < split; ++d) outer *= a.dims[d];
+      const float* af = a.f.data();
+      for (int64_t ou = 0; ou < outer; ++ou) {
+        const float* row = af + ou * inner;
+        double accv = init;
+        switch (rc) {
+          case 1:
+            for (int64_t j = 0; j < inner; ++j)
+              accv = std::max(accv, double(row[j]));
+            break;
+          case 2:
+            for (int64_t j = 0; j < inner; ++j)
+              accv = std::min(accv, double(row[j]));
+            break;
+          case 3:
+            for (int64_t j = 0; j < inner; ++j) accv *= row[j];
+            break;
+          default:
+            for (int64_t j = 0; j < inner; ++j) accv += row[j];
+        }
+        if (rc == 4) accv /= double(inner);
+        o.f[size_t(ou)] = float(accv);
+      }
+      out(std::move(o));
+      return;
+    }
     std::vector<double> acc(size_t(o.numel()), init);
     std::vector<int64_t> counts(size_t(o.numel()), 0);
     auto istr = strides_for(a.dims);
@@ -1249,16 +1308,17 @@ void Predictor::run_node(const Node& n) {
         else if (keep) od++;  // coord 0
       }
       double v = a.at(k);
-      if (op == "ReduceMax") acc[size_t(dst)] = std::max(acc[size_t(dst)], v);
-      else if (op == "ReduceMin")
-        acc[size_t(dst)] = std::min(acc[size_t(dst)], v);
-      else if (op == "ReduceProd") acc[size_t(dst)] *= v;
-      else acc[size_t(dst)] += v;
+      switch (rc) {
+        case 1: acc[size_t(dst)] = std::max(acc[size_t(dst)], v); break;
+        case 2: acc[size_t(dst)] = std::min(acc[size_t(dst)], v); break;
+        case 3: acc[size_t(dst)] *= v; break;
+        default: acc[size_t(dst)] += v;
+      }
       counts[size_t(dst)]++;
     }
     for (int64_t k = 0; k < o.numel(); ++k)
-      o.set(k, op == "ReduceMean" ? acc[size_t(k)] / double(counts[size_t(k)])
-                                  : acc[size_t(k)]);
+      o.set(k, rc == 4 ? acc[size_t(k)] / double(counts[size_t(k)])
+                       : acc[size_t(k)]);
     out(std::move(o));
   } else if (op == "ArgMax" || op == "ArgMin") {
     const Tensor& a = in(n, 0);

@@ -163,6 +163,75 @@ def bench_int8(tmp):
           flush=True)
 
 
+def bench_bert_tiny(tmp):
+    """Transformer serving through the C engine vs XLA: BERT-tiny with
+    int32 token ids — the path where every attention dot_general lowers
+    to Transpose/Reshape/batched-MatMul (r5: odometer transpose +
+    row-copy gather keep these off the scalar fallback)."""
+    import ctypes
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.models import BertModel, bert_tiny
+    from paddle_tpu.nn.layer import (buffer_state, functional_call,
+                                     trainable_state)
+    from paddle_tpu.static import InputSpec
+
+    pt.seed(0)
+    m = BertModel(bert_tiny())
+    m.eval()
+    path = pt.onnx.export(m, os.path.join(tmp, "bert_tiny"),
+                          input_spec=[InputSpec([4, 128], "int32")])
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, bert_tiny().vocab_size, (4, 128)).astype(np.int32)
+
+    params = trainable_state(m)
+    buffers = buffer_state(m)
+
+    @jax.jit
+    def fwd(params, ids):
+        out, _ = functional_call(m, params, ids, buffers=buffers)
+        return out[0] if isinstance(out, (tuple, list)) else out
+
+    xj = jnp.asarray(ids)
+    fwd(params, xj).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(10):
+        fwd(params, xj).block_until_ready()
+    dt_xla = (time.perf_counter() - t0) / 10
+
+    lib = ctypes.CDLL(os.path.join(REPO, "paddle_tpu",
+                                   "_native_predictor.so"))
+    lib.ptpu_predictor_create.restype = ctypes.c_void_p
+    lib.ptpu_predictor_input_name.restype = ctypes.c_char_p
+    err = ctypes.create_string_buffer(512)
+    h = lib.ptpu_predictor_create(path.encode(), err, 512)
+    assert h, err.value.decode()
+    name = lib.ptpu_predictor_input_name(ctypes.c_void_p(h), 0)
+    dims = (ctypes.c_int64 * 2)(4, 128)
+    data = ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+    def once():
+        assert lib.ptpu_predictor_set_input_i32(
+            ctypes.c_void_p(h), name, data, dims, 2, err, 512) == 0, \
+            err.value.decode()
+        assert lib.ptpu_predictor_run(ctypes.c_void_p(h), err, 512) == 0, \
+            err.value.decode()
+
+    once()
+    t0 = time.perf_counter()
+    for _ in range(5):
+        once()
+    dt_nat = (time.perf_counter() - t0) / 5
+    lib.ptpu_predictor_destroy(ctypes.c_void_p(h))
+    print(json.dumps({"metric": "bert_tiny_native_over_xla_ratio",
+                      "value": round(dt_nat / dt_xla, 2), "unit": "x",
+                      "native_ms": round(dt_nat * 1e3, 2),
+                      "xla_ms": round(dt_xla * 1e3, 2)}), flush=True)
+
+
 def main():
     import tempfile
 
@@ -193,6 +262,7 @@ def main():
             "within_10x": bool(ratio <= 10.0)}), flush=True)
 
         bench_int8(tmp)
+        bench_bert_tiny(tmp)
 
 
 if __name__ == "__main__":
