@@ -785,6 +785,22 @@ void Predictor::run_node(const Node& n) {
           bcast_walk(o.dims, a.dims, b.dims, [&](int64_t k, int64_t ai,
               int64_t bi) { of[k] = std::min(af[ai], bf[bi]); });
           break;
+        case B_POW:
+          // GELU/LN graphs are full of pow(x, 2|3|0.5) with a scalar
+          // exponent — std::pow per element is ~20x a multiply
+          if (b.numel() == 1 && bf[0] == 2.0f) {
+            for (int64_t k = 0; k < o.numel(); ++k)
+              of[k] = af[k] * af[k];
+          } else if (b.numel() == 1 && bf[0] == 3.0f) {
+            for (int64_t k = 0; k < o.numel(); ++k)
+              of[k] = af[k] * af[k] * af[k];
+          } else {
+            // no sqrt shortcut for exponent 0.5: IEEE pow(-inf, .5)
+            // is +inf and pow(-0., .5) is +0., sqrt disagrees on both
+            bcast_walk(o.dims, a.dims, b.dims, [&](int64_t k, int64_t ai,
+                int64_t bi) { of[k] = std::pow(af[ai], bf[bi]); });
+          }
+          break;
         default:
           bcast_walk(o.dims, a.dims, b.dims, [&](int64_t k, int64_t ai,
               int64_t bi) {
@@ -984,14 +1000,36 @@ void Predictor::run_node(const Node& n) {
     o.dtype = a.dtype;
     o.alloc();
     auto istr = strides_for(a.dims);
-    auto ostr = strides_for(o.dims);
-    for (int64_t k = 0; k < o.numel(); ++k) {
-      int64_t src = 0;
-      for (size_t d = 0; d < o.dims.size(); ++d) {
-        int64_t coord = begin[d] + ((k / ostr[d]) % o.dims[d]) * stride[d];
-        src += coord * istr[d];
+    const size_t r = o.dims.size();
+    /* odometer + contiguous-tail memcpy: find the longest suffix of
+     * unit-step, full-width axes — those positions copy as one run. */
+    size_t tail = r;
+    int64_t run = 1;
+    while (tail > 0 && stride[tail - 1] == 1 && begin[tail - 1] == 0 &&
+           count[tail - 1] == a.dims[tail - 1]) {
+      --tail;
+      run *= count[tail];
+    }
+    // src base index for the block at the current odometer position
+    std::vector<int64_t> ctr(r, 0);
+    int64_t base = 0;
+    for (size_t d = 0; d < tail; ++d) base += begin[d] * istr[d];
+    const int64_t blocks = o.numel() / std::max<int64_t>(run, 1);
+    const bool flt = a.is_float();
+    for (int64_t b = 0; b < blocks; ++b) {
+      if (flt)
+        std::memcpy(o.f.data() + b * run, a.f.data() + base,
+                    size_t(run) * sizeof(float));
+      else
+        std::memcpy(o.i.data() + b * run, a.i.data() + base,
+                    size_t(run) * sizeof(int64_t));
+      for (size_t d = tail; d-- > 0;) {
+        ++ctr[d];
+        base += stride[d] * istr[d];
+        if (ctr[d] < count[d]) break;
+        base -= stride[d] * istr[d] * count[d];
+        ctr[d] = 0;
       }
-      o.set(k, a.at(src));
     }
     out(std::move(o));
   } else if (op == "Gather") {
