@@ -499,6 +499,19 @@ static void igemm(const int32_t* A, const int32_t* B, int32_t* C,
   });
 }
 
+/* Exact-int8 eligibility for the int32 GEMM paths (MatMul and Conv
+ * share this): all operand values must fit int8, and the reduction
+ * depth K must keep the worst-case accumulation 128*128*K strictly
+ * below 2^31 (strict '<': K == 2^31/128^2 would reach exactly
+ * INT32_MAX+1). */
+static bool int8_exact(const std::vector<int64_t>& av,
+                       const std::vector<int64_t>& bv, int64_t K) {
+  if (K >= (int64_t(1) << 31) / (128 * 128)) return false;
+  auto in8 = [](int64_t v) { return v >= -128 && v <= 127; };
+  return std::all_of(av.begin(), av.end(), in8) &&
+         std::all_of(bv.begin(), bv.end(), in8);
+}
+
 // op-code dispatch: resolved ONCE per node (see apply_binary/apply_unary
 // below for the name->code mapping)
 enum BinCode {
@@ -1108,18 +1121,10 @@ void Predictor::run_node(const Node& n) {
               b.f.data() + (batched_b ? bb * k_d * nn : 0),
               o.f.data() + bb * m * nn, m, nn, k_d);
     } else if (!a.is_float() && !b.is_float() && rb >= 2 &&
-               k_d <= (int64_t(1) << 31) / (128 * 128) &&
-               [&] {
-                 // int8-range guard: this path is EXACT only for int8
-                 // operands (int32 accumulation headroom 127^2 * K);
-                 // int64 index/counter arithmetic must keep the exact
-                 // double-accumulating scalar path
-                 const auto in8 = [](int64_t v) {
-                   return v >= -128 && v <= 127;
-                 };
-                 return std::all_of(a.i.begin(), a.i.end(), in8) &&
-                        std::all_of(b.i.begin(), b.i.end(), in8);
-               }()) {
+               // int8-range guard: this path is EXACT only for int8
+               // operands; int64 index/counter arithmetic must keep
+               // the exact double-accumulating scalar path
+               int8_exact(a.i, b.i, k_d)) {
       // int8-executing artifacts: int32 GEMM (exact for the int8 value
       // range at this K; anything else falls through to the scalar path)
       std::vector<int32_t> a32(size_t(m * k_d)), acc(size_t(m * nn));
@@ -1213,6 +1218,43 @@ void Predictor::run_node(const Node& n) {
           sgemm(w.f.data() + g * ocg * CK, src,
                 o.f.data() + (nn * OC + g * ocg) * P, ocg, P, CK);
         }
+    } else if (!x.is_float() && !w.is_float() &&
+               int8_exact(x.i, w.i, ICG * KH * KW)) {
+      /* int8-executing conv (QAT convert_to_int8 artifacts): same
+       * im2col formulation feeding the int32 GEMM — exact for int8
+       * operands with int32 accumulation. Group outer so each group's
+       * weight panel widens to int32 ONCE, not once per image. */
+      const int64_t P = OH * OW, CK = ICG * KH * KW;
+      std::vector<int32_t> col(size_t(CK * P)), w32(size_t(ocg * CK));
+      std::vector<int32_t> acc(size_t(ocg * P));
+      for (int64_t g = 0; g < group; ++g) {
+        const int64_t* wg = w.i.data() + g * ocg * CK;
+        for (int64_t k = 0; k < ocg * CK; ++k)
+          w32[size_t(k)] = int32_t(wg[k]);
+        for (int64_t nn = 0; nn < N; ++nn) {
+          const int64_t* xg = x.i.data() + (nn * C + g * ICG) * H * W;
+          parallel_for(CK, 64, [&](int64_t r0, int64_t r1) {
+            for (int64_t rr = r0; rr < r1; ++rr) {
+              const int64_t ic = rr / (KH * KW);
+              const int64_t kh = (rr / KW) % KH, kw = rr % KW;
+              int32_t* dst = col.data() + rr * P;
+              const int64_t* plane = xg + ic * H * W;
+              for (int64_t oh = 0; oh < OH; ++oh) {
+                const int64_t ih = oh * strides[0] - pads[0] + kh * dil[0];
+                for (int64_t ow = 0; ow < OW; ++ow) {
+                  const int64_t iw = ow * strides[1] - pads[1] + kw * dil[1];
+                  dst[oh * OW + ow] =
+                      (ih < 0 || ih >= H || iw < 0 || iw >= W)
+                          ? 0 : int32_t(plane[ih * W + iw]);
+                }
+              }
+            }
+          });
+          igemm(w32.data(), col.data(), acc.data(), ocg, P, CK);
+          float* of = o.f.data() + (nn * OC + g * ocg) * P;
+          for (int64_t k = 0; k < ocg * P; ++k) of[k] = float(acc[size_t(k)]);
+        }
+      }
     } else {
       for (int64_t nn = 0; nn < N; ++nn)
         for (int64_t oc = 0; oc < OC; ++oc) {

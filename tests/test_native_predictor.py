@@ -263,3 +263,29 @@ class TestTransformerServing:
         ref = ref[0] if isinstance(ref, (tuple, list)) else ref
         np.testing.assert_allclose(got, np.asarray(ref, np.float32),
                                    rtol=2e-3, atol=2e-3)
+
+
+class TestInt8ConvServing:
+    def test_int8_conv_artifact_serves_natively(self, lib, tmp_path):
+        """A QAT conv net converted to int8 EXECUTION serves through
+        the C predictor's integer im2col+GEMM path (r5) with parity
+        against the jax int8 forward."""
+        import paddle_tpu as pt
+        from paddle_tpu.onnx.converter import trace_to_onnx
+        from paddle_tpu.quantization import QAT, convert_to_int8
+
+        pt.seed(0)
+        net = pt.nn.Sequential(
+            pt.nn.Conv2D(3, 8, 3, padding=1), pt.nn.ReLU(),
+            pt.nn.Conv2D(8, 4, 3, stride=2, padding=1))
+        QAT().quantize(net)
+        x = np.random.RandomState(0).randn(2, 3, 16, 16).astype(np.float32)
+        net.train()
+        net(jnp.asarray(x))          # observer pass
+        net.eval()
+        convert_to_int8(net)
+        want = np.asarray(net(jnp.asarray(x)))
+        model_bytes = trace_to_onnx(lambda a: net(a), (jnp.asarray(x),))
+        got = _run_native(lib, model_bytes, x, tmp_path)
+        np.testing.assert_allclose(got.reshape(want.shape), want,
+                                   rtol=1e-4, atol=1e-4)
