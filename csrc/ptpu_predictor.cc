@@ -1241,11 +1241,16 @@ void Predictor::run_node(const Node& n) {
               const int64_t* plane = xg + ic * H * W;
               for (int64_t oh = 0; oh < OH; ++oh) {
                 const int64_t ih = oh * strides[0] - pads[0] + kh * dil[0];
+                if (ih < 0 || ih >= H) {  // hoisted like the float path
+                  std::memset(dst + oh * OW, 0,
+                              size_t(OW) * sizeof(int32_t));
+                  continue;
+                }
+                const int64_t* row = plane + ih * W;
                 for (int64_t ow = 0; ow < OW; ++ow) {
                   const int64_t iw = ow * strides[1] - pads[1] + kw * dil[1];
                   dst[oh * OW + ow] =
-                      (ih < 0 || ih >= H || iw < 0 || iw >= W)
-                          ? 0 : int32_t(plane[ih * W + iw]);
+                      (iw < 0 || iw >= W) ? 0 : int32_t(row[iw]);
                 }
               }
             }

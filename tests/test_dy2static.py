@@ -278,3 +278,21 @@ class TestReviewRegressions:
 
         conv = convert_control_flow(f)
         assert float(conv(jnp.zeros(()), 4)) == 4.0
+
+
+class TestForOverTensor:
+    def test_for_over_tensor_rows(self):
+        """Reference parity (`dygraph_to_static/loop_transformer.py`
+        for-over-Variable): iterating a traced tensor's leading axis
+        works under to_static — jax tracers unroll __iter__ over the
+        static leading dim, so no AST conversion is even needed."""
+        @to_static
+        def rowsum(x):
+            s = jnp.zeros((x.shape[1],))
+            for row in x:
+                s = s + row
+            return s
+
+        x = jnp.asarray(np.arange(12, dtype=np.float32).reshape(3, 4))
+        np.testing.assert_allclose(np.asarray(rowsum(x)),
+                                   np.asarray(x).sum(0))
