@@ -1,0 +1,127 @@
+// Native unit tests for the predictor TU internals — the cc_test
+// analogue (reference: gtest cc_test targets per CMakeLists, e.g.
+// `paddle/fluid/framework/data_type_test.cc`). Plain asserts, no test
+// framework dependency; exit 0 = pass. Includes the predictor TU
+// directly so the anonymous-namespace kernels (sgemm/igemm/bcast_walk/
+// int8_exact/check_dims) are testable without widening their linkage.
+//
+// Build + run: make selftest (csrc/Makefile); wrapped by
+// tests/test_native_selftest.py.
+#include "ptpu_predictor.cc"
+
+// asserts ARE the test — never compile them out, even under a
+// release-style CXXFLAGS override carrying -DNDEBUG
+#undef NDEBUG
+#include <cassert>
+#include <cstdio>
+#include <random>
+
+namespace {
+
+void test_sgemm_matches_naive() {
+  std::mt19937 rng(0);
+  std::uniform_real_distribution<float> d(-1.f, 1.f);
+  const int64_t M = 17, N = 33, K = 29;
+  std::vector<float> A(M * K), B(K * N), C(M * N), ref(M * N, 0.f);
+  for (auto& v : A) v = d(rng);
+  for (auto& v : B) v = d(rng);
+  sgemm(A.data(), B.data(), C.data(), M, N, K);
+  for (int64_t m = 0; m < M; ++m)
+    for (int64_t j = 0; j < N; ++j) {
+      float acc = 0.f;
+      for (int64_t k = 0; k < K; ++k) acc += A[m * K + k] * B[k * N + j];
+      ref[m * N + j] = acc;
+    }
+  for (int64_t i = 0; i < M * N; ++i)
+    assert(std::fabs(C[i] - ref[i]) <= 1e-4f * (1.f + std::fabs(ref[i])));
+}
+
+void test_sgemm_propagates_nan_through_zero() {
+  // IEEE: 0 * NaN must stay NaN (the zero-skip regression guard)
+  const float nan = std::nanf("");
+  std::vector<float> A{0.f, 1.f}, B{nan, 2.f}, C(1);
+  sgemm(A.data(), B.data(), C.data(), 1, 1, 2);
+  assert(std::isnan(C[0]));
+}
+
+void test_igemm_exact() {
+  std::mt19937 rng(1);
+  std::uniform_int_distribution<int> d(-128, 127);
+  const int64_t M = 9, N = 13, K = 21;
+  std::vector<int32_t> A(M * K), B(K * N), C(M * N);
+  for (auto& v : A) v = d(rng);
+  for (auto& v : B) v = d(rng);
+  igemm(A.data(), B.data(), C.data(), M, N, K);
+  for (int64_t m = 0; m < M; ++m)
+    for (int64_t j = 0; j < N; ++j) {
+      int64_t acc = 0;
+      for (int64_t k = 0; k < K; ++k)
+        acc += int64_t(A[m * K + k]) * B[k * N + j];
+      assert(C[m * N + j] == acc);
+    }
+}
+
+void test_int8_exact_bounds() {
+  std::vector<int64_t> ok{-128, 127, 0}, bad{-129}, big{128};
+  const int64_t kmax = (int64_t(1) << 31) / (128 * 128);
+  assert(int8_exact(ok, ok, kmax - 1));
+  assert(!int8_exact(ok, ok, kmax));      // strict: 2^31 would overflow
+  assert(!int8_exact(bad, ok, 4));
+  assert(!int8_exact(ok, big, 4));
+}
+
+void test_bcast_walk_matches_divmod() {
+  // [2,3,4] (x) [3,1] -> [2,3,4]; compare odometer against bcast_index
+  std::vector<int64_t> od{2, 3, 4}, ad{2, 3, 4}, bd{3, 1};
+  bcast_walk(od, ad, bd, [&](int64_t k, int64_t ai, int64_t bi) {
+    assert(ai == bcast_index(k, od, ad));
+    assert(bi == bcast_index(k, od, bd));
+  });
+  // scalar operand
+  std::vector<int64_t> sd{};
+  bcast_walk(od, ad, sd, [&](int64_t, int64_t, int64_t bi) {
+    assert(bi == 0);
+  });
+}
+
+void test_check_dims_rejects() {
+  int64_t neg[2] = {2, -1};
+  bool threw = false;
+  try {
+    check_dims(neg, 2);
+  } catch (const std::exception&) {
+    threw = true;
+  }
+  assert(threw);
+  int64_t huge[2] = {3037000500LL, 3037000500LL};
+  threw = false;
+  try {
+    check_dims(huge, 2);
+  } catch (const std::exception&) {
+    threw = true;
+  }
+  assert(threw);
+  check_dims(nullptr, 0);  // 0-d scalar is legal
+}
+
+void test_parallel_for_covers_range() {
+  std::vector<int> hit(1000, 0);
+  parallel_for(1000, 7, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) hit[size_t(i)]++;
+  });
+  for (int v : hit) assert(v == 1);
+}
+
+}  // namespace
+
+int main() {
+  test_sgemm_matches_naive();
+  test_sgemm_propagates_nan_through_zero();
+  test_igemm_exact();
+  test_int8_exact_bounds();
+  test_bcast_walk_matches_divmod();
+  test_check_dims_rejects();
+  test_parallel_for_covers_range();
+  std::printf("ptpu_selftest: all native unit tests passed\n");
+  return 0;
+}

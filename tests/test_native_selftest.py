@@ -1,0 +1,22 @@
+"""Native (C++) unit tests — the reference's cc_test idiom.
+
+Reference: gtest cc_test targets per CMakeLists (e.g.
+`paddle/fluid/framework/data_type_test.cc`). Here a single dependency-
+free binary (`csrc/ptpu_selftest.cc`) asserts the predictor TU's
+internal kernels: sgemm vs naive (incl. 0*NaN IEEE propagation), exact
+int32 igemm, the int8_exact overflow bound, the odometer broadcast
+walk vs the div/mod reference, input-dim validation, and worker-pool
+range coverage.
+"""
+import os
+import subprocess
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_native_selftest_passes():
+    r = subprocess.run(["make", "selftest"],
+                      cwd=os.path.join(REPO, "csrc"),
+                      capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "all native unit tests passed" in r.stdout
