@@ -95,9 +95,23 @@ def _pt_if(pred, true_fn, false_fn, operands):
             return fn(*full)
         return wrapped
 
-    return jax.lax.cond(jnp.asarray(pred).astype(bool).reshape(()),
-                        mk(true_fn), mk(false_fn),
-                        *(operands[i] for i in dyn_idx))
+    try:
+        return jax.lax.cond(jnp.asarray(pred).astype(bool).reshape(()),
+                            mk(true_fn), mk(false_fn),
+                            *(operands[i] for i in dyn_idx))
+    except (TypeError, AttributeError) as e:
+        # lax.cond's structure-mismatch errors are cryptic when a
+        # branch output is _UNDEF/None (a name bound in only one
+        # branch, or an early return on only one path) — jax's error
+        # formatter can even crash on the sentinel. Surface the
+        # actionable rule instead.
+        raise NotImplementedError(
+            "to_static: a traced `if` must bind the same variables "
+            "with the same array structure in BOTH branches (early "
+            "returns included: every path must return a value of the "
+            "same structure; a variable first assigned in the "
+            "fall-through after a one-sided return counts as bound in "
+            f"only one branch). Underlying jax error: {e}") from e
 
 
 def _pt_not(x):
@@ -171,6 +185,125 @@ def _names(nodes) -> "_Names":
     for n in nodes:
         v.visit(n)
     return v
+
+
+def _pt_resolve_return(flag, val):
+    """Final value of a function whose early `return`s were desugared
+    into (flag, value) carries. Concrete flag keeps exact Python
+    semantics (fall-through -> None); a traced flag means every path
+    merged a value through lax.cond, so `val` IS the result (matching
+    the reference's requirement that converted traced returns bind a
+    value on every path)."""
+    if _is_traced(flag):
+        return val
+    return val if flag else None
+
+
+def _has_early_return(stmts) -> bool:
+    """Return statements at this function's if-nesting level (not
+    inside loops or nested defs, which keep their own handling)."""
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_Return(self, n):
+            self.found = True
+
+        def visit_While(self, n):
+            pass
+
+        def visit_For(self, n):
+            pass
+
+        def visit_FunctionDef(self, n):
+            pass
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _desugar_returns(body):
+    """Rewrite `return` inside If statements into `_pt_retf/_pt_retv`
+    carries (reference: `dygraph_to_static/return_transformer.py`).
+
+    Runs BEFORE control-flow conversion, so the generated guard-ifs
+    convert to lax.cond like any other if. Returns directly inside
+    loops are NOT handled here — the loop conversion raises its clear
+    NotImplementedError for those. With a TRACED condition, both
+    branches must bind a return value of the same structure (if/else
+    both returning, or a prior return value of matching shape) — the
+    same constraint the reference imposes; a mismatch (including
+    fall-through code that binds NEW locals after a one-sided traced
+    return) raises _pt_if's clear NotImplementedError naming the
+    rule. Concrete conditions keep full Python semantics."""
+    RF, RV = "_pt_retf", "_pt_retv"
+
+    def assign(name, value):
+        return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                          value=value)
+
+    def always_returns(stmts) -> bool:
+        """Every path through `stmts` ends in a Return (loops/defs are
+        opaque — treated as not-returning)."""
+        if not stmts:
+            return False
+        last = stmts[-1]
+        if isinstance(last, ast.Return):
+            return True
+        if isinstance(last, ast.If):
+            return always_returns(last.body) and always_returns(last.orelse)
+        return False
+
+    def rewrite(stmts):
+        out = []
+        for i, st in enumerate(stmts):
+            if isinstance(st, ast.Return):
+                out.append(assign(RV, st.value or
+                                  ast.Constant(value=None)))
+                out.append(assign(RF, ast.Constant(value=True)))
+                return out                      # rest unreachable
+            if isinstance(st, ast.If) and _has_early_return([st]):
+                rest = stmts[i + 1:]
+                if always_returns(st.body) and not st.orelse:
+                    # `if c: ... return a` + rest == if/else: the rest
+                    # runs exactly when the branch did not return, so
+                    # fold it into orelse — BOTH lax.cond branches then
+                    # bind the return value, which the traced path
+                    # requires (a guard-if would leave the false branch
+                    # with the unset None and break the cond pytree)
+                    new_if = ast.If(test=st.test,
+                                    body=rewrite(st.body),
+                                    orelse=rewrite(rest) or [ast.Pass()])
+                    return out + [new_if]
+                new_if = ast.If(test=st.test,
+                                body=rewrite(st.body) or [ast.Pass()],
+                                orelse=rewrite(st.orelse))
+                out.append(new_if)
+                rest_rw = rewrite(rest)
+                if rest_rw:
+                    guard = ast.Call(
+                        func=ast.Name(id="__pt_not", ctx=ast.Load()),
+                        args=[ast.Name(id=RF, ctx=ast.Load())],
+                        keywords=[])
+                    out.append(ast.If(test=guard, body=rest_rw,
+                                      orelse=[]))
+                return out
+            out.append(st)
+        return out
+
+    # fast path: no early returns -> untouched (the common case, and it
+    # keeps straight-line functions free of the flag machinery)
+    early = any(isinstance(s, ast.If) and _has_early_return([s])
+                for s in body)
+    if not early:
+        return body
+    new_body = [assign(RF, ast.Constant(value=False)),
+                assign(RV, ast.Constant(value=None))] + rewrite(body)
+    new_body.append(ast.Return(value=ast.Call(
+        func=ast.Name(id="__pt_resolve_return", ctx=ast.Load()),
+        args=[ast.Name(id=RF, ctx=ast.Load()),
+              ast.Name(id=RV, ctx=ast.Load())], keywords=[])))
+    return new_body
 
 
 class _Unsupported(ast.NodeVisitor):
@@ -529,6 +662,9 @@ def _convert(func: Callable) -> Callable:
     fdef = tree.body[0]
     # drop decorators (e.g. @to_static) — we're already inside the wrapper
     fdef.decorator_list = []
+    # early returns inside ifs become flag+value carries BEFORE the
+    # if-conversion (reference: return_transformer runs first too)
+    fdef.body = _desugar_returns(fdef.body)
     new = ControlFlowTransformer().visit(tree)
     ast.fix_missing_locations(new)
     code = compile(new, filename=f"<dy2static {func.__name__}>",
@@ -539,6 +675,7 @@ def _convert(func: Callable) -> Callable:
     glb["__pt_undef"] = _UNDEF
     glb["__pt_not"] = _pt_not
     glb["__pt_and_not"] = _pt_and_not
+    glb["__pt_resolve_return"] = _pt_resolve_return
     if func.__closure__:
         for name, cell in zip(func.__code__.co_freevars, func.__closure__):
             glb.setdefault(name, cell.cell_contents)

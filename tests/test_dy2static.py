@@ -296,3 +296,92 @@ class TestForOverTensor:
         x = jnp.asarray(np.arange(12, dtype=np.float32).reshape(3, 4))
         np.testing.assert_allclose(np.asarray(rowsum(x)),
                                    np.asarray(x).sum(0))
+
+
+class TestEarlyReturn:
+    """Early `return` inside converted ifs (r5; reference:
+    `dygraph_to_static/return_transformer.py`): desugared into
+    flag+value carries before if-conversion."""
+
+    def test_both_branches_return_traced(self):
+        def f(x):
+            if jnp.sum(x) > 0:
+                return x * 2.0
+            return x - 1.0
+
+        c = convert_control_flow(f)
+        np.testing.assert_allclose(np.asarray(c(jnp.ones(3))),
+                                   2 * np.ones(3))
+        np.testing.assert_allclose(np.asarray(c(-jnp.ones(3))),
+                                   -2 * np.ones(3))
+
+    def test_nested_returns(self):
+        def f(x):
+            if x[0] > 0:
+                if x[1] > 0:
+                    return x.sum()
+                return x[0]
+            return jnp.zeros(())
+
+        c = convert_control_flow(f)
+        assert float(c(jnp.asarray([1.0, 2.0]))) == 3.0
+        assert float(c(jnp.asarray([1.0, -2.0]))) == 1.0
+        assert float(c(jnp.asarray([-1.0, 2.0]))) == 0.0
+
+    def test_concrete_early_return_after_traced_loop(self):
+        """A concrete-condition early return must not break conversion
+        forced by an unrelated traced while (the pre-r5 failure: ANY
+        return inside an if raised once the AST converter ran)."""
+        def f(x, flag):
+            i = jnp.zeros((), jnp.int32)
+            while i < 3:
+                x = x * 2.0
+                i = i + 1
+            if flag:
+                return x + 100.0
+            return x
+
+        c = convert_control_flow(f)
+        assert float(c(jnp.ones(()), True)) == 108.0
+        assert float(c(jnp.ones(()), False)) == 8.0
+
+    def test_fallthrough_returns_none_on_concrete_path(self):
+        def f(x, flag):
+            if flag:
+                return x
+
+        c = convert_control_flow(f)
+        assert c(jnp.ones(()), False) is None
+        assert float(c(jnp.ones(()), True)) == 1.0
+
+    def test_return_in_loop_still_raises(self):
+        def f(x):
+            i = jnp.zeros((), jnp.int32)
+            while i < 3:
+                return x      # returns in loops keep the clear error
+            return x
+
+        with pytest.raises(NotImplementedError, match="return"):
+            convert_control_flow(f)(jnp.ones(()))
+
+    def test_one_sided_traced_return_raises_clear_error(self):
+        """Review repros: a traced one-sided return whose fall-through
+        binds new locals must fail with the module's actionable error,
+        not jax's internal formatter crash."""
+        def g1(x):
+            if jnp.sum(x) > 0:
+                return x
+            z = x * 2.0
+            return z
+
+        def g2(x):
+            if jnp.sum(x) > 0:
+                y = x * 2.0
+            else:
+                return x
+            return y
+
+        for g in (g1, g2):
+            with pytest.raises(NotImplementedError,
+                               match="BOTH branches"):
+                jax.jit(convert_control_flow(g))(jnp.ones(3))
