@@ -68,9 +68,11 @@ def _is_traced(x) -> bool:
 
 def _jaxable(x) -> bool:
     """True if x can ride a lax.cond/while operand (pytree of arrays /
-    scalars). Objects like Layers, modules, or _UNDEF are closure-
-    captured instead."""
-    if x is _UNDEF:
+    scalars). Objects like Layers, modules, _UNDEF — and bare None,
+    whose empty pytree would otherwise vacuously pass and then break
+    the carry structure the first time a body assigns it an array —
+    are closure-captured instead."""
+    if x is _UNDEF or x is None:
         return False
     leaves = jax.tree.leaves(x)
     return all(isinstance(v, (jax.Array, np.ndarray, int, float, bool,
@@ -149,8 +151,12 @@ def _pt_while(cond_fn, body_fn, carry, assigned):
         if i not in dyn_idx and assigned[i]:
             raise TypeError(
                 "to_static while: loop variable assigned in the body has "
-                f"a non-array value {o!r} — traced while_loop carries "
-                "must be arrays/scalars")
+                f"a non-array value {o!r} before the loop — traced "
+                "while_loop carries are fixed-structure arrays/scalars. "
+                "This includes `return` inside a TRACED loop (the return "
+                "value slot starts as None): early returns in loops "
+                "need a concretely-executed loop, or restructure to "
+                "assign a variable and return after the loop")
 
     def full(dyn):
         out = list(carry)
@@ -199,27 +205,40 @@ def _pt_resolve_return(flag, val):
     return val if flag else None
 
 
-def _has_early_return(stmts) -> bool:
-    """Return statements at this function's if-nesting level (not
-    inside loops or nested defs, which keep their own handling)."""
-    class V(ast.NodeVisitor):
-        found = False
+def _loop_converts(st) -> bool:
+    """True if this While/For WILL be converted rather than left plain
+    Python — ONE predicate shared by the for/while converters and the
+    return desugar so the two can never drift (a desugared flag+break
+    inside a loop that stays Python would be a spurious error)."""
+    if isinstance(st, ast.While):
+        return not st.orelse
+    if not isinstance(st, ast.For):
+        return False
+    it = st.iter
+    is_range = (isinstance(it, ast.Call)
+                and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and isinstance(st.target, ast.Name) and not st.orelse)
+    if is_range and len(it.args) == 3 and \
+            ControlFlowTransformer._const_value(it.args[2]) is None:
+        return False   # non-literal step keeps Python semantics
+    return is_range
 
-        def visit_Return(self, n):
-            self.found = True
 
-        def visit_While(self, n):
-            pass
-
-        def visit_For(self, n):
-            pass
-
-        def visit_FunctionDef(self, n):
-            pass
-    v = V()
+def _has_desugarable_return(stmts) -> bool:
+    """Returns reachable through if statements and CONVERTIBLE loops
+    (nested defs and plain-Python loops keep their own returns)."""
     for s in stmts:
-        v.visit(s)
-    return v.found
+        if isinstance(s, ast.Return):
+            return True
+        if isinstance(s, ast.If):
+            if _has_desugarable_return(s.body) or \
+                    _has_desugarable_return(s.orelse):
+                return True
+        elif isinstance(s, (ast.While, ast.For)):
+            if _loop_converts(s) and _has_desugarable_return(s.body):
+                return True
+    return False
 
 
 def _desugar_returns(body):
@@ -227,9 +246,12 @@ def _desugar_returns(body):
     carries (reference: `dygraph_to_static/return_transformer.py`).
 
     Runs BEFORE control-flow conversion, so the generated guard-ifs
-    convert to lax.cond like any other if. Returns directly inside
-    loops are NOT handled here — the loop conversion raises its clear
-    NotImplementedError for those. With a TRACED condition, both
+    convert to lax.cond like any other if. Returns inside LOOPS become
+    flag-sets followed by `break` (the break/continue desugar then
+    carries the exit through the converted loop); after such a loop —
+    and inside enclosing loop bodies — the rest of the block is
+    guarded (or re-broken) on the return flag. With a TRACED condition,
+    both
     branches must bind a return value of the same structure (if/else
     both returning, or a prior return value of matching shape) — the
     same constraint the reference imposes; a mismatch (including
@@ -254,47 +276,67 @@ def _desugar_returns(body):
             return always_returns(last.body) and always_returns(last.orelse)
         return False
 
-    def rewrite(stmts):
+    def guard_rest(out, rest_rw, in_loop):
+        """After a statement that may have set the return flag: inside
+        a loop body, re-break (a skip-guard alone would spin the loop);
+        otherwise guard the rest of the block on the flag."""
+        if not rest_rw and not in_loop:
+            return out
+        if in_loop:
+            out.append(ast.If(test=ast.Name(id=RF, ctx=ast.Load()),
+                              body=[ast.Break()], orelse=[]))
+            return out + rest_rw
+        guard = ast.Call(func=ast.Name(id="__pt_not", ctx=ast.Load()),
+                         args=[ast.Name(id=RF, ctx=ast.Load())],
+                         keywords=[])
+        out.append(ast.If(test=guard, body=rest_rw, orelse=[]))
+        return out
+
+    def rewrite(stmts, in_loop=False):
         out = []
         for i, st in enumerate(stmts):
             if isinstance(st, ast.Return):
                 out.append(assign(RV, st.value or
                                   ast.Constant(value=None)))
                 out.append(assign(RF, ast.Constant(value=True)))
+                if in_loop:
+                    out.append(ast.Break())
                 return out                      # rest unreachable
-            if isinstance(st, ast.If) and _has_early_return([st]):
+            if isinstance(st, ast.If) and _has_desugarable_return([st]):
                 rest = stmts[i + 1:]
-                if always_returns(st.body) and not st.orelse:
+                if always_returns(st.body) and not st.orelse \
+                        and not in_loop:
                     # `if c: ... return a` + rest == if/else: the rest
                     # runs exactly when the branch did not return, so
                     # fold it into orelse — BOTH lax.cond branches then
                     # bind the return value, which the traced path
                     # requires (a guard-if would leave the false branch
-                    # with the unset None and break the cond pytree)
+                    # with the unset None and break the cond pytree).
+                    # Not inside loops: the branch ends in Break there,
+                    # and break may not ride a converted if-branch.
                     new_if = ast.If(test=st.test,
                                     body=rewrite(st.body),
                                     orelse=rewrite(rest) or [ast.Pass()])
                     return out + [new_if]
-                new_if = ast.If(test=st.test,
-                                body=rewrite(st.body) or [ast.Pass()],
-                                orelse=rewrite(st.orelse))
+                new_if = ast.If(
+                    test=st.test,
+                    body=rewrite(st.body, in_loop) or [ast.Pass()],
+                    orelse=rewrite(st.orelse, in_loop))
                 out.append(new_if)
-                rest_rw = rewrite(rest)
-                if rest_rw:
-                    guard = ast.Call(
-                        func=ast.Name(id="__pt_not", ctx=ast.Load()),
-                        args=[ast.Name(id=RF, ctx=ast.Load())],
-                        keywords=[])
-                    out.append(ast.If(test=guard, body=rest_rw,
-                                      orelse=[]))
-                return out
+                return guard_rest(out, rewrite(rest, in_loop), in_loop)
+            if isinstance(st, (ast.While, ast.For)) and \
+                    _loop_converts(st) and \
+                    _has_desugarable_return(st.body):
+                st.body = rewrite(st.body, in_loop=True)
+                out.append(st)
+                return guard_rest(out, rewrite(stmts[i + 1:], in_loop),
+                                  in_loop)
             out.append(st)
         return out
 
-    # fast path: no early returns -> untouched (the common case, and it
-    # keeps straight-line functions free of the flag machinery)
-    early = any(isinstance(s, ast.If) and _has_early_return([s])
-                for s in body)
+    # fast path: no early returns anywhere -> untouched (the common
+    # case keeps straight-line functions free of the flag machinery)
+    early = _has_desugarable_return(body)
     if not early:
         return body
     new_body = [assign(RF, ast.Constant(value=False)),
@@ -430,20 +472,11 @@ class ControlFlowTransformer(ast.NodeTransformer):
     def visit_For(self, node):
         """`for i in range(...)` lowers to the while conversion (traced
         bounds become lax.while_loop; reference: loop_transformer's
-        for-range handling). Other iterables stay untouched Python."""
-        is_range = (isinstance(node.iter, ast.Call)
-                    and isinstance(node.iter.func, ast.Name)
-                    and node.iter.func.id == "range"
-                    and not node.iter.keywords
-                    and isinstance(node.target, ast.Name)
-                    and not node.orelse)
-        if is_range and len(node.iter.args) == 3 and \
-                self._const_value(node.iter.args[2]) is None:
-            # non-literal step keeps Python semantics (direction
-            # unknowable statically) — and therefore MUST NOT be
-            # desugared: stripped break/continue flags with no loop
-            # machinery would silently change behavior
-            is_range = False
+        for-range handling). Other iterables stay untouched Python.
+        `_loop_converts` is the ONE criteria predicate (shared with the
+        return desugar) — a non-literal step keeps Python semantics and
+        MUST NOT be desugared either way."""
+        is_range = _loop_converts(node)
         # desugar THIS loop's break/continue before inner-if conversion
         # (and before the index bump is appended: `continue` must still
         # advance the loop variable, so the bump stays outside the

@@ -354,15 +354,94 @@ class TestEarlyReturn:
         assert c(jnp.ones(()), False) is None
         assert float(c(jnp.ones(()), True)) == 1.0
 
-    def test_return_in_loop_still_raises(self):
-        def f(x):
+    def test_return_from_concrete_while(self):
+        """Returns inside converted loops desugar into flag + break
+        (r5 follow-up): the loop exits and the rest of the function is
+        skipped. CONCRETE path (eager arrays, no jit): traced loops
+        cannot host an early return — lax.while_loop carries are
+        fixed-structure and the return slot starts as None — and raise
+        the clear rule error (tested below)."""
+        def f(n):
             i = jnp.zeros((), jnp.int32)
-            while i < 3:
-                return x      # returns in loops keep the clear error
+            while i < 100:
+                if n == 1:
+                    return i
+                n = jnp.where(n % 2 == 0, n // 2, 3 * n + 1)
+                i = i + 1
+            return i
+
+        c = convert_control_flow(f)
+        assert int(c(jnp.asarray(6, jnp.int32))) == 8
+        assert int(c(jnp.asarray(1, jnp.int32))) == 0
+
+    def test_return_from_nested_concrete_loops(self):
+        def f(x):
+            total = jnp.zeros(())
+            i = jnp.zeros((), jnp.int32)
+            while i < 5:
+                j = jnp.zeros((), jnp.int32)
+                while j < 5:
+                    total = total + x
+                    if total > 6.5:
+                        return total
+                    j = j + 1
+                i = i + 1
+            return total
+
+        c = convert_control_flow(f)
+        assert float(c(jnp.asarray(1.0))) == 7.0
+
+    def test_return_in_traced_loop_raises_clear_rule(self):
+        """Under jit (what to_static always does), a loop whose
+        condition traces cannot desugar an early return — the clear
+        fixed-structure-carry error must fire, not jax's cryptic
+        pytree mismatch."""
+        def f(n):
+            i = jnp.zeros((), jnp.int32)
+            while i < 100:
+                if n == 1:
+                    return i
+                n = jnp.where(n % 2 == 0, n // 2, 3 * n + 1)
+                i = i + 1
+            return i
+
+        with pytest.raises(TypeError, match="early returns in loops"):
+            jax.jit(convert_control_flow(f))(jnp.asarray(6, jnp.int32))
+
+    def test_return_from_loop_nested_in_if(self):
+        """The desugar reaches convertible loops through enclosing
+        ifs (review repro: same code one indent deeper must not
+        raise)."""
+        def g(x, n):
+            if x.sum() >= 0:
+                s = x * 0
+                for k in range(n):
+                    s = s + x
+                    if s[0] > 2.5:
+                        return s * 10.0
+                return s
             return x
 
-        with pytest.raises(NotImplementedError, match="return"):
-            convert_control_flow(f)(jnp.ones(()))
+        c = convert_control_flow(g)
+        np.testing.assert_allclose(
+            np.asarray(c(jnp.ones(2), jnp.asarray(9, jnp.int32))),
+            30.0 * np.ones(2))
+        np.testing.assert_allclose(
+            np.asarray(c(jnp.ones(2), jnp.asarray(2, jnp.int32))),
+            2.0 * np.ones(2))
+
+    def test_return_in_plain_python_loop_keeps_clear_error(self):
+        """A for-over-iterable stays plain Python; a return inside one
+        of its converted ifs cannot desugar (a real break cannot ride
+        a cond branch) and keeps the clear error."""
+        def f(xs):
+            for v in xs:
+                if v > 2:
+                    return v
+            return -1
+
+        with pytest.raises(NotImplementedError):
+            convert_control_flow(f)([1, 2, 5])
 
     def test_one_sided_traced_return_raises_clear_error(self):
         """Review repros: a traced one-sided return whose fall-through
