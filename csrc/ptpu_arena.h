@@ -1,0 +1,128 @@
+// Best-fit free-list machinery shared by the runtime host allocator
+// (csrc/ptpu_runtime.cc BestFitArena — real memory, grown in malloc'd
+// chunks) and the native predictor's static memory planner
+// (csrc/ptpu_predictor.cc plan_memory — a *virtual* offset space whose
+// final size becomes the one serving arena). Both need the same core:
+// free blocks kept in a size-ordered multimap for best-fit lookup and an
+// address-ordered map for adjacency coalescing.
+//
+// Reference counterpart: the free-list bookkeeping inside
+// memory/allocation/auto_growth_best_fit_allocator.cc and the inference
+// memory-optimize pass (inference/analysis/passes/memory_optimize_pass.cc)
+// which plans tensor offsets from lifetimes the same way.
+#ifndef PTPU_ARENA_H_
+#define PTPU_ARENA_H_
+
+#include <cstddef>
+#include <cstdint>
+#include <map>
+
+namespace ptpu {
+
+// P is a pointer-like address type: char* for the runtime allocator,
+// uint64_t byte offsets for the planner. Requires +, comparison.
+template <class P>
+class BestFitFreeList {
+ public:
+  // Insert block [p, p+n), coalescing with free neighbors.
+  void Add(P p, size_t n) {
+    auto next = by_addr_.find(p + n);
+    if (next != by_addr_.end()) {
+      size_t nn = next->second;
+      Erase(p + n, nn);
+      n += nn;
+    }
+    auto prev = by_addr_.lower_bound(p);
+    if (prev != by_addr_.begin()) {
+      --prev;
+      if (prev->first + prev->second == p) {
+        P pp = prev->first;
+        size_t pn = prev->second;
+        Erase(pp, pn);
+        p = pp;
+        n += pn;
+      }
+    }
+    by_addr_[p] = n;
+    by_size_.emplace(n, p);
+  }
+
+  // Best-fit: smallest free block of size >= n. Removes the block and
+  // returns its base and full size (caller re-Adds any remainder).
+  bool Take(size_t n, P* out_p, size_t* out_n) {
+    auto it = by_size_.lower_bound(n);
+    if (it == by_size_.end()) return false;
+    *out_p = it->second;
+    *out_n = it->first;
+    Erase(*out_p, *out_n);
+    return true;
+  }
+
+  void Erase(P p, size_t n) {
+    by_addr_.erase(p);
+    auto range = by_size_.equal_range(n);
+    for (auto i = range.first; i != range.second; ++i) {
+      if (i->second == p) {
+        by_size_.erase(i);
+        break;
+      }
+    }
+  }
+
+  bool Empty() const { return by_addr_.empty(); }
+
+  // size of the free block ending exactly at `end`, 0 if none — lets
+  // a growing arena extend a partially-free tail instead of appending
+  // a full new block after it
+  size_t TailAt(P end) const {
+    if (by_addr_.empty()) return 0;
+    auto it = by_addr_.lower_bound(end);
+    if (it == by_addr_.begin()) return 0;
+    --it;
+    return it->first + it->second == end ? it->second : 0;
+  }
+
+ private:
+  std::map<P, size_t> by_addr_;
+  std::multimap<size_t, P> by_size_;
+};
+
+// Offset-space arena for static memory planning: Alloc/Free operate on
+// byte offsets during the load-time lifetime walk; Size() afterwards is
+// the peak footprint — the single allocation the executor makes.
+class PlanArena {
+ public:
+  explicit PlanArena(size_t align = 64) : align_(align) {}
+
+  uint64_t Alloc(size_t n) {
+    n = RoundUp(n ? n : 1);
+    uint64_t p = 0;
+    size_t block = 0;
+    if (!free_.Take(n, &p, &block)) {
+      // grow the virtual space by only the UNCOVERED portion: a free
+      // tail block is extended (Add coalesces), keeping Size() at the
+      // true peak footprint
+      const size_t tail = free_.TailAt(size_);  // < n, else Take hit
+      free_.Add(size_, n - tail);
+      size_ += n - tail;
+      free_.Take(n, &p, &block);
+    }
+    if (block > n) free_.Add(p + n, block - n);
+    return p;
+  }
+
+  void Free(uint64_t off, size_t n) { free_.Add(off, RoundUp(n ? n : 1)); }
+
+  uint64_t Size() const { return size_; }
+
+ private:
+  size_t RoundUp(size_t n) const { return (n + align_ - 1) / align_ * align_; }
+
+  BestFitFreeList<uint64_t> free_;
+  uint64_t size_ = 0;
+  size_t align_;
+};
+
+}  // namespace ptpu
+
+#endif  // PTPU_ARENA_H_

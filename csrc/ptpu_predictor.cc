@@ -28,11 +28,15 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <set>
 #include <sstream>
 #include <stdexcept>
 #include <string>
 #include <thread>
+#include <type_traits>
 #include <vector>
+
+#include "ptpu_arena.h"
 
 namespace {
 
@@ -99,11 +103,89 @@ struct Reader {
 enum { DT_F32 = 1, DT_U8 = 2, DT_I8 = 3, DT_I32 = 6, DT_I64 = 7,
        DT_BOOL = 9, DT_F64 = 11 };
 
+/* Tensor storage: either an owning vector or a borrowed view into the
+ * predictor's planned arena (static memory planner, see plan_memory).
+ * Copies always deep-copy into owned storage — a Tensor copied out of
+ * `env` (Identity, run outputs) must survive the arena being rewritten
+ * by the next run. Moves keep the binding. */
+template <class T>
+class Buf {
+ public:
+  Buf() = default;
+  Buf(const Buf& o) : own_(o.begin(), o.end()) {}
+  Buf(Buf&& o) noexcept = default;
+  Buf& operator=(const Buf& o) {
+    if (this != &o) {
+      own_.assign(o.begin(), o.end());
+      ext_ = nullptr;
+      extn_ = 0;
+    }
+    return *this;
+  }
+  Buf& operator=(Buf&& o) noexcept = default;
+
+  T* data() { return ext_ ? ext_ : own_.data(); }
+  const T* data() const { return ext_ ? ext_ : own_.data(); }
+  size_t size() const { return ext_ ? extn_ : own_.size(); }
+  bool empty() const { return size() == 0; }
+  T& operator[](size_t k) { return data()[k]; }
+  const T& operator[](size_t k) const { return data()[k]; }
+  T* begin() { return data(); }
+  T* end() { return data() + size(); }
+  const T* begin() const { return data(); }
+  const T* end() const { return data() + size(); }
+
+  template <class It,
+            class = typename std::enable_if<
+                !std::is_integral<It>::value>::type>
+  void assign(It first, It last) {
+    own_.assign(first, last);
+    ext_ = nullptr;
+    extn_ = 0;
+  }
+  void assign(size_t n, T v) {
+    own_.assign(n, v);
+    ext_ = nullptr;
+    extn_ = 0;
+  }
+  void resize(size_t n) {
+    if (ext_) {  // degrade to owning, preserving contents like vector
+      own_.assign(ext_, ext_ + std::min(extn_, n));
+      ext_ = nullptr;
+      extn_ = 0;
+    }
+    own_.resize(n);
+  }
+  // borrow arena storage; contents are whatever the arena holds — every
+  // op fully writes its output (audited), so no zero-fill is needed
+  void bind(T* p, size_t n) {
+    own_.clear();
+    ext_ = p;
+    extn_ = n;
+  }
+
+ private:
+  T* ext_ = nullptr;
+  size_t extn_ = 0;
+  std::vector<T> own_;
+};
+
+/* Where Tensor::alloc should place the next output: set by the executor
+ * per node from the static memory plan; consumed at most once (one
+ * output per node). thread_local because predictors are
+ * one-per-thread by contract. */
+struct AllocHint {
+  char* base = nullptr;
+  size_t bytes = 0;
+  bool used = false;
+};
+static thread_local AllocHint* g_alloc_hint = nullptr;
+
 struct Tensor {
   std::vector<int64_t> dims;
   int dtype = DT_F32;
-  std::vector<float> f;    // DT_F32 / DT_F64 (converted)
-  std::vector<int64_t> i;  // DT_I32 / DT_I64 / DT_BOOL / DT_U8
+  Buf<float> f;    // DT_F32 / DT_F64 (converted)
+  Buf<int64_t> i;  // DT_I32 / DT_I64 / DT_BOOL / DT_U8
   int64_t numel() const {
     int64_t n = 1;
     for (auto d : dims) n *= d;
@@ -112,8 +194,16 @@ struct Tensor {
   bool is_float() const { return dtype == DT_F32 || dtype == DT_F64; }
   double at(int64_t k) const { return is_float() ? f[k] : double(i[k]); }
   void alloc() {
-    if (is_float()) f.assign(size_t(numel()), 0.f);
-    else i.assign(size_t(numel()), 0);
+    const size_t n = size_t(numel());
+    const size_t bytes = n * (is_float() ? sizeof(float) : sizeof(int64_t));
+    if (g_alloc_hint && !g_alloc_hint->used && bytes <= g_alloc_hint->bytes) {
+      g_alloc_hint->used = true;
+      if (is_float()) f.bind(reinterpret_cast<float*>(g_alloc_hint->base), n);
+      else i.bind(reinterpret_cast<int64_t*>(g_alloc_hint->base), n);
+      return;
+    }
+    if (is_float()) f.assign(n, 0.f);
+    else i.assign(n, int64_t(0));
   }
   void set(int64_t k, double v) {
     if (is_float()) f[k] = float(v);
@@ -343,8 +433,18 @@ static int num_threads() {
  * costs tens of microseconds x threads, paid once per node per
  * inference in a deep model. Workers park on a condition variable
  * between dispatches; the caller thread participates in the chunk
- * loop. Nested calls from inside a worker run serially (thread_local
- * guard) instead of deadlocking the pool. */
+ * loop (chunked-range claiming via the atomic `next_` cursor IS the
+ * work stealing — fast workers keep taking chunks until the range is
+ * drained). Nested calls from inside a worker run serially
+ * (thread_local guard) instead of deadlocking the pool.
+ *
+ * The pool is process-global but predictors are one-per-thread, so two
+ * predictor threads can dispatch concurrently; `dispatch_mu_`
+ * serializes whole dispatches (overwriting fn_/n_/chunk_ and resetting
+ * done_ mid-flight corrupted outputs or deadlocked cv_done_ before).
+ * One GEMM already saturates every core, so serializing dispatch loses
+ * nothing and keeps N predictors from oversubscribing N*cores
+ * threads. */
 class WorkPool {
  public:
   static WorkPool& inst() {
@@ -358,6 +458,7 @@ class WorkPool {
       fn(0, n);
       return;
     }
+    std::lock_guard<std::mutex> dispatch(dispatch_mu_);
     const int64_t parts = int64_t(workers_.size() + 1) * 4;
     {
       std::lock_guard<std::mutex> l(mu_);
@@ -369,7 +470,23 @@ class WorkPool {
       ++epoch_;
     }
     cv_go_.notify_all();
-    drain(fn, n, chunk_);
+    // the caller thread acts as a worker for this dispatch: mark it so
+    // a nested parallel_for from inside fn runs serially instead of
+    // re-entering run() and self-deadlocking on dispatch_mu_
+    in_worker_ = true;
+    try {
+      drain(fn, n, chunk_);
+    } catch (...) {
+      // fn threw on the caller's chunk: restore the flag and STILL
+      // wait for the pool — workers may be mid-fn, and fn_ must not
+      // dangle past this frame
+      in_worker_ = false;
+      std::unique_lock<std::mutex> l(mu_);
+      cv_done_.wait(l, [&] { return done_ == int(workers_.size()); });
+      fn_ = nullptr;
+      throw;
+    }
+    in_worker_ = false;
     std::unique_lock<std::mutex> l(mu_);
     cv_done_.wait(l, [&] { return done_ == int(workers_.size()); });
     fn_ = nullptr;
@@ -423,7 +540,7 @@ class WorkPool {
   }
 
   std::vector<std::thread> workers_;
-  std::mutex mu_;
+  std::mutex mu_, dispatch_mu_;
   std::condition_variable cv_go_, cv_done_;
   const std::function<void(int64_t, int64_t)>* fn_ = nullptr;
   int64_t n_ = 0, chunk_ = 1;
@@ -440,61 +557,439 @@ static void parallel_for(int64_t n, int64_t grain, const F& fn) {
   WorkPool::inst().run(n, grain, fn);
 }
 
-/* C[M,N] = A[M,K] @ B[K,N], all row-major. Row-parallel; the j-inner
- * loop over a contiguous B row autovectorizes under -O2/-O3. fp32
- * accumulation (the scalar path accumulated in double; fp32 matches
- * what XLA's CPU GEMM does and is bit-compatible with the fp32
- * artifact contract). */
-static void sgemm(const float* A, const float* B, float* C,
-                  int64_t M, int64_t N, int64_t K) {
-  parallel_for(M, std::max<int64_t>(int64_t(1), 16384 / std::max<int64_t>(N, 1)),
-               [&](int64_t m0, int64_t m1) {
-    constexpr int64_t KB = 128;  // K blocking keeps the B panel in L1/L2
-    for (int64_t m = m0; m < m1; ++m)
-      std::memset(C + m * N, 0, size_t(N) * sizeof(float));
-    for (int64_t k0 = 0; k0 < K; k0 += KB) {
-      const int64_t k1 = std::min(K, k0 + KB);
-      for (int64_t m = m0; m < m1; ++m) {
-        const float* a = A + m * K;
-        float* c = C + m * N;
-        for (int64_t k = k0; k < k1; ++k) {
-          // no zero-skip: 0 * Inf/NaN must stay NaN (IEEE), matching
-          // the scalar fallback and XLA on masked/one-hot operands
-          const float av = a[k];
-          const float* b = B + k * N;
-          for (int64_t j = 0; j < N; ++j) c[j] += av * b[j];
+/* ------------------------------------------------------------------
+ * Packed cache-blocked GEMM: C[M,N] = A[M,K] @ B[K,N], row-major.
+ *
+ * BLIS-style formulation: both operands are repacked into contiguous
+ * panel buffers — A into MR-row panels laid out [panel][k][r], B into
+ * NR-column panels laid out [panel][k][c] — so the inner kernel reads
+ * both operands with stride-1 and keeps an MR x NR accumulator block
+ * entirely in registers across a KC-deep slice (6x16 fp32 = 12 ymm
+ * accumulators + broadcast + B row under AVX2). K is blocked by KC so
+ * the NR-wide B slice (NR*KC*4 = 20 KB) stays L1-resident while a row
+ * block of A panels streams through L2. The k-loop accumulation order
+ * is unchanged from the old blocked loop, and there is no zero-skip:
+ * 0 * Inf/NaN must stay NaN (IEEE), matching the scalar fallback and
+ * XLA on masked/one-hot operands (packed zero PADDING lanes never
+ * reach memory, so they cannot launder a NaN).
+ *
+ * The same machinery serves fp32 and the int8-executing int32 path
+ * (int64 multiplies have no AVX2 form; int8 operands with int32
+ * accumulation are exact for K < 2^31/128^2, enforced by int8_exact).
+ * The epilogue fuses bias (per-row for conv's [oc, P] layout, per-col
+ * for MatMul's [M, out_features]) and the activation into the final
+ * register-block writeback — the load-time op-fusion pass rewrites
+ * conv+bias+relu / gemm+bias+act chains onto these arguments. */
+constexpr int64_t MR = 6, NR = 16, KC = 320;
+
+enum { ACT_NONE = 0, ACT_RELU = 1, ACT_SIGMOID = 2, ACT_TANH = 3 };
+
+static inline float act_apply(float v, int act) {
+  switch (act) {
+    case ACT_RELU: return v > 0.f ? v : 0.f;
+    case ACT_SIGMOID: return float(1.0 / (1.0 + std::exp(-double(v))));
+    case ACT_TANH: return float(std::tanh(double(v)));
+    default: return v;
+  }
+}
+static inline int32_t act_apply(int32_t v, int act) {
+  return act == ACT_RELU ? (v > 0 ? v : 0) : v;
+}
+
+static inline int64_t a_pack_size(int64_t M, int64_t K) {
+  return ((M + MR - 1) / MR) * K * MR;
+}
+static inline int64_t b_pack_size(int64_t K, int64_t N) {
+  return ((N + NR - 1) / NR) * K * NR;
+}
+
+// S: source element type (float / int64 widened storage), T: compute type
+template <class S, class T>
+static void pack_a(const S* A, int64_t M, int64_t K, T* out) {
+  const int64_t panels = (M + MR - 1) / MR;
+  // a panel costs K*MR element moves: stay serial unless that pays
+  // for a pool dispatch
+  const int64_t grain =
+      std::max<int64_t>(1, 65536 / std::max<int64_t>(K * MR, 1));
+  parallel_for(panels, grain, [&](int64_t p0, int64_t p1) {
+    for (int64_t p = p0; p < p1; ++p) {
+      T* dst = out + p * K * MR;
+      const int64_t mr = std::min(MR, M - p * MR);
+      for (int64_t r = 0; r < mr; ++r) {
+        const S* src = A + (p * MR + r) * K;
+        for (int64_t k = 0; k < K; ++k) dst[k * MR + r] = T(src[k]);
+      }
+      for (int64_t r = mr; r < MR; ++r)  // fringe rows pad with zeros
+        for (int64_t k = 0; k < K; ++k) dst[k * MR + r] = T(0);
+    }
+  });
+}
+
+template <class S, class T>
+static void pack_b(const S* B, int64_t K, int64_t N, T* out) {
+  const int64_t panels = (N + NR - 1) / NR;
+  const int64_t grain =
+      std::max<int64_t>(1, 65536 / std::max<int64_t>(K * NR, 1));
+  parallel_for(panels, grain, [&](int64_t p0, int64_t p1) {
+    for (int64_t p = p0; p < p1; ++p) {
+      T* dst = out + p * K * NR;
+      const int64_t j0 = p * NR, w = std::min(NR, N - j0);
+      for (int64_t k = 0; k < K; ++k) {
+        const S* src = B + k * N + j0;
+        T* d = dst + k * NR;
+        for (int64_t c = 0; c < w; ++c) d[c] = T(src[c]);
+        for (int64_t c = w; c < NR; ++c) d[c] = T(0);
+      }
+    }
+  });
+}
+
+/* One MR x NR register tile over a KC-deep panel slice. `first` zeroes
+ * the accumulator (k0 == 0), otherwise the partial C block is loaded;
+ * `last` applies the fused bias/activation epilogue on writeback.
+ * bias_n/bias_m are pre-offset to this tile's column/row origin. */
+template <class T>
+static inline void micro_kernel(const T* Ap, const T* Bp, T* C, int64_t ldc,
+                                int64_t kc, int64_t mr, int64_t nr,
+                                bool first, bool last, const T* bias_n,
+                                const T* bias_m, int act) {
+  T acc[MR][NR];
+  for (int r = 0; r < MR; ++r)
+    for (int c = 0; c < NR; ++c) acc[r][c] = T(0);
+  if (!first)
+    for (int64_t r = 0; r < mr; ++r)
+      for (int64_t c = 0; c < nr; ++c) acc[r][c] = C[r * ldc + c];
+  for (int64_t k = 0; k < kc; ++k) {
+    const T* a = Ap + k * MR;
+    const T* b = Bp + k * NR;
+    for (int r = 0; r < MR; ++r) {
+      const T av = a[r];
+      for (int c = 0; c < NR; ++c) acc[r][c] += av * b[c];
+    }
+  }
+  if (last && (bias_n || bias_m || act != ACT_NONE)) {
+    for (int64_t r = 0; r < mr; ++r) {
+      const T bm = bias_m ? bias_m[r] : T(0);
+      for (int64_t c = 0; c < nr; ++c) {
+        const T v = acc[r][c] + bm + (bias_n ? bias_n[c] : T(0));
+        C[r * ldc + c] = act_apply(v, act);
+      }
+    }
+  } else {
+    for (int64_t r = 0; r < mr; ++r)
+      for (int64_t c = 0; c < nr; ++c) C[r * ldc + c] = acc[r][c];
+  }
+}
+
+#if defined(__AVX2__) && defined(__FMA__)
+#include <immintrin.h>
+/* Hand-vectorized full-tile fp32 micro-kernel: 6x16 = 12 ymm
+ * accumulators + 2 B lanes + 1 broadcast — 15 of 16 registers, the
+ * classic AVX2 register allocation. GCC only partially promotes the
+ * generic template's accumulator array (measured ~5 GFLOP/s/core vs
+ * ~50 here), so the hot full tiles get intrinsics; fringe tiles and
+ * int32 stay on the generic kernel. */
+static inline void micro_tile_avx2(const float* Ap, const float* Bp,
+                                   float* C, int64_t ldc, int64_t kc,
+                                   bool first, bool last,
+                                   const float* bias_n, const float* bias_m,
+                                   int act) {
+  __m256 acc[MR][2];
+  if (first) {
+    for (int r = 0; r < MR; ++r)
+      acc[r][0] = acc[r][1] = _mm256_setzero_ps();
+  } else {
+    for (int r = 0; r < MR; ++r) {
+      acc[r][0] = _mm256_loadu_ps(C + r * ldc);
+      acc[r][1] = _mm256_loadu_ps(C + r * ldc + 8);
+    }
+  }
+  for (int64_t k = 0; k < kc; ++k) {
+    const __m256 b0 = _mm256_loadu_ps(Bp + k * NR);
+    const __m256 b1 = _mm256_loadu_ps(Bp + k * NR + 8);
+    const float* a = Ap + k * MR;
+    for (int r = 0; r < MR; ++r) {
+      const __m256 av = _mm256_broadcast_ss(a + r);
+      acc[r][0] = _mm256_fmadd_ps(av, b0, acc[r][0]);
+      acc[r][1] = _mm256_fmadd_ps(av, b1, acc[r][1]);
+    }
+  }
+  if (last && (bias_n || bias_m || act != ACT_NONE)) {
+    if (act == ACT_NONE || act == ACT_RELU) {
+      const __m256 zero = _mm256_setzero_ps();
+      const __m256 bn0 = bias_n ? _mm256_loadu_ps(bias_n) : zero;
+      const __m256 bn1 = bias_n ? _mm256_loadu_ps(bias_n + 8) : zero;
+      for (int r = 0; r < MR; ++r) {
+        const __m256 bm =
+            bias_m ? _mm256_broadcast_ss(bias_m + r) : zero;
+        __m256 v0 = _mm256_add_ps(_mm256_add_ps(acc[r][0], bn0), bm);
+        __m256 v1 = _mm256_add_ps(_mm256_add_ps(acc[r][1], bn1), bm);
+        if (act == ACT_RELU) {
+          v0 = _mm256_max_ps(v0, zero);
+          v1 = _mm256_max_ps(v1, zero);
+        }
+        _mm256_storeu_ps(C + r * ldc, v0);
+        _mm256_storeu_ps(C + r * ldc + 8, v1);
+      }
+    } else {  // transcendental epilogue: spill the tile, apply scalar
+      float tile[MR][NR];
+      for (int r = 0; r < MR; ++r) {
+        _mm256_storeu_ps(tile[r], acc[r][0]);
+        _mm256_storeu_ps(tile[r] + 8, acc[r][1]);
+      }
+      for (int r = 0; r < MR; ++r) {
+        const float bm = bias_m ? bias_m[r] : 0.f;
+        for (int c = 0; c < NR; ++c)
+          C[r * ldc + c] = act_apply(
+              tile[r][c] + bm + (bias_n ? bias_n[c] : 0.f), act);
+      }
+    }
+  } else {
+    for (int r = 0; r < MR; ++r) {
+      _mm256_storeu_ps(C + r * ldc, acc[r][0]);
+      _mm256_storeu_ps(C + r * ldc + 8, acc[r][1]);
+    }
+  }
+}
+/* int32 sibling (the int8-executing artifacts): vpmulld + vpaddd, same
+ * 6x16 register tiling. No bias/act epilogue — the integer paths are
+ * never fusion targets (their dequant chains carry Casts). */
+static inline void micro_tile_avx2_i32(const int32_t* Ap, const int32_t* Bp,
+                                       int32_t* C, int64_t ldc, int64_t kc,
+                                       bool first) {
+  __m256i acc[MR][2];
+  if (first) {
+    for (int r = 0; r < MR; ++r)
+      acc[r][0] = acc[r][1] = _mm256_setzero_si256();
+  } else {
+    for (int r = 0; r < MR; ++r) {
+      acc[r][0] =
+          _mm256_loadu_si256(reinterpret_cast<const __m256i*>(C + r * ldc));
+      acc[r][1] = _mm256_loadu_si256(
+          reinterpret_cast<const __m256i*>(C + r * ldc + 8));
+    }
+  }
+  for (int64_t k = 0; k < kc; ++k) {
+    const __m256i b0 =
+        _mm256_loadu_si256(reinterpret_cast<const __m256i*>(Bp + k * NR));
+    const __m256i b1 = _mm256_loadu_si256(
+        reinterpret_cast<const __m256i*>(Bp + k * NR + 8));
+    const int32_t* a = Ap + k * MR;
+    for (int r = 0; r < MR; ++r) {
+      const __m256i av = _mm256_set1_epi32(a[r]);
+      acc[r][0] = _mm256_add_epi32(acc[r][0], _mm256_mullo_epi32(av, b0));
+      acc[r][1] = _mm256_add_epi32(acc[r][1], _mm256_mullo_epi32(av, b1));
+    }
+  }
+  for (int r = 0; r < MR; ++r) {
+    _mm256_storeu_si256(reinterpret_cast<__m256i*>(C + r * ldc),
+                        acc[r][0]);
+    _mm256_storeu_si256(reinterpret_cast<__m256i*>(C + r * ldc + 8),
+                        acc[r][1]);
+  }
+}
+#endif  // __AVX2__ && __FMA__
+
+// full-tile dispatch: fp32/int32 go to the intrinsics kernels when built
+template <class T>
+static inline void micro_tile(const T* Ap, const T* Bp, T* C, int64_t ldc,
+                              int64_t kc, int64_t mr, int64_t nr,
+                              bool first, bool last, const T* bias_n,
+                              const T* bias_m, int act) {
+  micro_kernel(Ap, Bp, C, ldc, kc, mr, nr, first, last, bias_n, bias_m,
+               act);
+}
+#if defined(__AVX2__) && defined(__FMA__)
+static inline void micro_tile(const float* Ap, const float* Bp, float* C,
+                              int64_t ldc, int64_t kc, int64_t mr,
+                              int64_t nr, bool first, bool last,
+                              const float* bias_n, const float* bias_m,
+                              int act) {
+  if (mr == MR && nr == NR)
+    micro_tile_avx2(Ap, Bp, C, ldc, kc, first, last, bias_n, bias_m, act);
+  else
+    micro_kernel(Ap, Bp, C, ldc, kc, mr, nr, first, last, bias_n, bias_m,
+                 act);
+}
+static inline void micro_tile(const int32_t* Ap, const int32_t* Bp,
+                              int32_t* C, int64_t ldc, int64_t kc,
+                              int64_t mr, int64_t nr, bool first,
+                              bool last, const int32_t* bias_n,
+                              const int32_t* bias_m, int act) {
+  if (mr == MR && nr == NR && !bias_n && !bias_m && act == ACT_NONE)
+    micro_tile_avx2_i32(Ap, Bp, C, ldc, kc, first);
+  else
+    micro_kernel(Ap, Bp, C, ldc, kc, mr, nr, first, last, bias_n, bias_m,
+                 act);
+}
+#endif
+
+/* Macro-kernel over pre-packed panels. Work is a 2-D grid of
+ * (column-tile, row-block) tasks sized to ~3 tasks per thread so the
+ * WorkPool's chunked-range stealing load-balances ragged shapes (late
+ * ResNet convs: P = 49 columns but 512 rows; early: the reverse). */
+template <class T>
+static void gemm_compute(const T* Apack, const T* Bpack, T* C,
+                         int64_t M, int64_t N, int64_t K,
+                         const T* bias_n, const T* bias_m, int act) {
+  const int64_t ntn = (N + NR - 1) / NR;
+  const int64_t mp = (M + MR - 1) / MR;
+  const int64_t want = int64_t(3) * num_threads();
+  int64_t nbm = std::max<int64_t>(
+      int64_t(1), std::min(mp, (want + ntn - 1) / ntn));
+  const int64_t per_blk = (mp + nbm - 1) / nbm;
+  nbm = (mp + per_blk - 1) / per_blk;
+  // small problems (attention-head matmuls) run serially: the compute
+  // is microseconds, a pool dispatch is not
+  const int64_t grain = M * N * K < (int64_t(1) << 21) ? ntn * nbm : 1;
+  parallel_for(ntn * nbm, grain, [&](int64_t t0, int64_t t1) {
+    for (int64_t t = t0; t < t1; ++t) {
+      const int64_t np = t % ntn, mb = t / ntn;
+      const int64_t p_lo = mb * per_blk;
+      const int64_t p_hi = std::min(mp, p_lo + per_blk);
+      const int64_t j0 = np * NR, nr = std::min(NR, N - j0);
+      for (int64_t k0 = 0; k0 < K; k0 += KC) {
+        const int64_t kc = std::min(KC, K - k0);
+        const bool first = k0 == 0, last = k0 + kc == K;
+        for (int64_t p = p_lo; p < p_hi; ++p) {
+          const int64_t m0 = p * MR, mr = std::min(MR, M - m0);
+          micro_tile(Apack + p * K * MR + k0 * MR,
+                     Bpack + np * K * NR + k0 * NR, C + m0 * N + j0, N,
+                     kc, mr, nr, first, last,
+                     bias_n ? bias_n + j0 : nullptr,
+                     bias_m ? bias_m + m0 : nullptr, act);
         }
       }
     }
   });
 }
 
-/* Integer sibling of sgemm for the int8-executing artifacts. int32
- * lanes, not int64: int64 multiplies have no AVX2 form (the loop would
- * stay scalar — measured 16x slower than sgemm), while int8 operands
- * with int32 accumulation — the quantized-execution contract — are
- * exact for K up to 2^31 / 127^2 ~ 133K and vectorize fully. Callers
- * copy the widened int64 storage into int32 panels first. */
-static void igemm(const int32_t* A, const int32_t* B, int32_t* C,
-                  int64_t M, int64_t N, int64_t K) {
-  parallel_for(M, std::max<int64_t>(int64_t(1),
-                                    16384 / std::max<int64_t>(N, 1)),
-               [&](int64_t m0, int64_t m1) {
-    constexpr int64_t KB = 128;
-    for (int64_t m = m0; m < m1; ++m)
-      std::memset(C + m * N, 0, size_t(N) * sizeof(int32_t));
-    for (int64_t k0 = 0; k0 < K; k0 += KB) {
-      const int64_t k1 = std::min(K, k0 + KB);
-      for (int64_t m = m0; m < m1; ++m) {
-        const int32_t* a = A + m * K;
-        int32_t* c = C + m * N;
-        for (int64_t k = k0; k < k1; ++k) {
-          const int32_t av = a[k];
-          if (av == 0) continue;
-          const int32_t* b = B + k * N;
-          for (int64_t j = 0; j < N; ++j) c[j] += av * b[j];
+template <class T>
+static std::vector<T>& pack_scratch(int which) {
+  static thread_local std::vector<T> bufs[2];
+  return bufs[which];
+}
+
+/* Full GEMM: packs whichever operand has no pre-packed panel (weights
+ * are pre-packed ONCE at load time by Predictor::prepack_weights) and
+ * runs the macro-kernel. */
+template <class T, class SA, class SB>
+static void gemm_bias_act(const SA* A, const SB* B, T* C, int64_t M,
+                          int64_t N, int64_t K, const T* Apack_pre,
+                          const T* Bpack_pre, const T* bias_n,
+                          const T* bias_m, int act) {
+  const T* Ap = Apack_pre;
+  const T* Bp = Bpack_pre;
+  if (!Ap) {
+    auto& buf = pack_scratch<T>(0);
+    buf.resize(size_t(a_pack_size(M, K)));
+    pack_a<SA, T>(A, M, K, buf.data());
+    Ap = buf.data();
+  }
+  if (!Bp) {
+    auto& buf = pack_scratch<T>(1);
+    buf.resize(size_t(b_pack_size(K, N)));
+    pack_b<SB, T>(B, K, N, buf.data());
+    Bp = buf.data();
+  }
+  gemm_compute(Ap, Bp, C, M, N, K, bias_n, bias_m, act);
+}
+
+// plain entry points (the selftest surface; the executor calls
+// gemm_bias_act directly to thread pre-packed panels and epilogues)
+[[maybe_unused]] static void sgemm(const float* A, const float* B,
+                                   float* C, int64_t M, int64_t N,
+                                   int64_t K) {
+  gemm_bias_act<float>(A, B, C, M, N, K, nullptr, nullptr, nullptr,
+                       nullptr, ACT_NONE);
+}
+[[maybe_unused]] static void igemm(const int32_t* A, const int32_t* B,
+                                   int32_t* C, int64_t M, int64_t N,
+                                   int64_t K) {
+  gemm_bias_act<int32_t>(A, B, C, M, N, K, nullptr, nullptr, nullptr,
+                         nullptr, ACT_NONE);
+}
+
+/* Implicit im2col: pack the conv patch matrix col[CK, P] for one
+ * (image, group) DIRECTLY into B-panel layout, skipping the col
+ * materialization entirely (one pass over CK*P instead of im2col +
+ * pack). Row r of col maps to (ic, kh, kw); columns walk (oh, ow) in
+ * SEGMENTS — for unit horizontal stride each output row is a zero-pad
+ * | contiguous-copy | zero-pad triple, so the hot path is straight-line
+ * copies through a column-tile cursor instead of per-element bounds
+ * checks. Out-of-image taps and the last tile's fringe zero-fill. */
+template <class S, class T>
+static void pack_b_im2col(const S* xg, int64_t ICG, int64_t H, int64_t W,
+                          int64_t KH, int64_t KW, int64_t OH, int64_t OW,
+                          int64_t sh, int64_t sw, int64_t ph, int64_t pw,
+                          int64_t dh, int64_t dw, T* out) {
+  const int64_t CK = ICG * KH * KW;
+  const int64_t tile_step = CK * NR;
+  parallel_for(CK, 8, [&](int64_t r0, int64_t r1) {
+    for (int64_t r = r0; r < r1; ++r) {
+      const int64_t ic = r / (KH * KW);
+      const int64_t kh = (r / KW) % KH, kw = r % KW;
+      const S* plane = xg + ic * H * W;
+      const int64_t ih_off = kh * dh - ph, iw_off = kw * dw - pw;
+      // cursor into the packed layout: row r of the current column
+      // tile; c wraps at NR, advancing one tile per wrap
+      T* dst = out + r * NR;
+      int64_t c = 0;
+      const auto put_zeros = [&](int64_t len) {
+        while (len > 0) {
+          const int64_t take = std::min(len, NR - c);
+          for (int64_t t = 0; t < take; ++t) dst[c + t] = T(0);
+          c += take;
+          len -= take;
+          if (c == NR) {
+            c = 0;
+            dst += tile_step;
+          }
+        }
+      };
+      const auto put_run = [&](const S* src, int64_t len) {
+        while (len > 0) {
+          const int64_t take = std::min(len, NR - c);
+          for (int64_t t = 0; t < take; ++t) dst[c + t] = T(src[t]);
+          src += take;
+          c += take;
+          len -= take;
+          if (c == NR) {
+            c = 0;
+            dst += tile_step;
+          }
+        }
+      };
+      for (int64_t oh = 0; oh < OH; ++oh) {
+        const int64_t ih = oh * sh + ih_off;
+        if (ih < 0 || ih >= H) {
+          put_zeros(OW);
+          continue;
+        }
+        const S* row = plane + ih * W;
+        if (sw == 1) {
+          const int64_t lo = std::max<int64_t>(0, -iw_off);
+          const int64_t hi = std::min(OW, W - iw_off);
+          if (hi <= lo) {
+            put_zeros(OW);
+            continue;
+          }
+          put_zeros(lo);
+          put_run(row + lo + iw_off, hi - lo);
+          put_zeros(OW - hi);
+        } else {
+          for (int64_t ow = 0; ow < OW; ++ow) {
+            const int64_t iw = ow * sw + iw_off;
+            dst[c] = (iw < 0 || iw >= W) ? T(0) : T(row[iw]);
+            if (++c == NR) {
+              c = 0;
+              dst += tile_step;
+            }
+          }
         }
       }
+      if (c)  // zero-pad the last tile's fringe columns
+        for (; c < NR; ++c) dst[c] = T(0);
     }
   });
 }
@@ -503,13 +998,20 @@ static void igemm(const int32_t* A, const int32_t* B, int32_t* C,
  * share this): all operand values must fit int8, and the reduction
  * depth K must keep the worst-case accumulation 128*128*K strictly
  * below 2^31 (strict '<': K == 2^31/128^2 would reach exactly
- * INT32_MAX+1). */
-static bool int8_exact(const std::vector<int64_t>& av,
-                       const std::vector<int64_t>& bv, int64_t K) {
-  if (K >= (int64_t(1) << 31) / (128 * 128)) return false;
-  auto in8 = [](int64_t v) { return v >= -128 && v <= 127; };
-  return std::all_of(av.begin(), av.end(), in8) &&
-         std::all_of(bv.begin(), bv.end(), in8);
+ * INT32_MAX+1). Split so prepack_weights can cache the (expensive)
+ * value scan for constant weights. */
+static bool int8_depth_ok(int64_t K) {
+  return K < (int64_t(1) << 31) / (128 * 128);
+}
+static bool int8_vals_ok(const int64_t* v, size_t n) {
+  for (size_t k = 0; k < n; ++k)
+    if (v[k] < -128 || v[k] > 127) return false;
+  return true;
+}
+template <class VA, class VB>  // Buf or std::vector int64 storage
+static bool int8_exact(const VA& av, const VB& bv, int64_t K) {
+  return int8_depth_ok(K) && int8_vals_ok(av.data(), av.size()) &&
+         int8_vals_ok(bv.data(), bv.size());
 }
 
 // op-code dispatch: resolved ONCE per node (see apply_binary/apply_unary
@@ -606,7 +1108,10 @@ static double apply_un_code(UnCode c, double a) {
 
 /* Walk every element of the broadcast output, handing the callback the
  * flat output index plus both operand indices — incremental odometer
- * carries instead of the old per-element div/mod chains. */
+ * carries instead of the old per-element div/mod chains. Large outputs
+ * are chunked across the WorkPool: each chunk pays one div/mod
+ * decomposition to seed its odometer, then walks incrementally. The
+ * callback must write only its own output element. */
 template <class F>
 static void bcast_walk(const std::vector<int64_t>& odims,
                        const std::vector<int64_t>& adims,
@@ -619,25 +1124,34 @@ static void bcast_walk(const std::vector<int64_t>& odims,
     return;
   }
   auto as = strides_for(adims), bs = strides_for(bdims);
-  std::vector<int64_t> ast(r, 0), bst(r, 0), ctr(r, 0);
+  auto ostr = strides_for(odims);
+  std::vector<int64_t> ast(r, 0), bst(r, 0);
   const size_t ao = r - adims.size(), bo = r - bdims.size();
   for (size_t d = 0; d < r; ++d) {
     if (d >= ao && adims[d - ao] != 1) ast[d] = as[d - ao];
     if (d >= bo && bdims[d - bo] != 1) bst[d] = bs[d - bo];
   }
-  int64_t ai = 0, bi = 0;
-  for (int64_t k = 0; k < total; ++k) {
-    f(k, ai, bi);
-    for (size_t d = r; d-- > 0;) {
-      ++ctr[d];
-      ai += ast[d];
-      bi += bst[d];
-      if (ctr[d] < odims[d]) break;
-      ai -= ast[d] * odims[d];
-      bi -= bst[d] * odims[d];
-      ctr[d] = 0;
+  parallel_for(total, 1 << 15, [&](int64_t lo, int64_t hi) {
+    std::vector<int64_t> ctr(r, 0);
+    int64_t ai = 0, bi = 0;
+    for (size_t d = 0; d < r; ++d) {
+      ctr[d] = (lo / ostr[d]) % odims[d];
+      ai += ctr[d] * ast[d];
+      bi += ctr[d] * bst[d];
     }
-  }
+    for (int64_t k = lo; k < hi; ++k) {
+      f(k, ai, bi);
+      for (size_t d = r; d-- > 0;) {
+        ++ctr[d];
+        ai += ast[d];
+        bi += bst[d];
+        if (ctr[d] < odims[d]) break;
+        ai -= ast[d] * odims[d];
+        bi -= bst[d] * odims[d];
+        ctr[d] = 0;
+      }
+    }
+  });
 }
 
 // ----------------------------------------------------------------- executor
@@ -646,6 +1160,31 @@ struct Predictor {
   std::map<std::string, Tensor> env;
   std::vector<Tensor> outputs;
   std::vector<std::string> last_err_names;
+
+  /* Weights pre-packed at load time into GEMM panel layout (A-side for
+   * Conv's [ocg, CK] filters per group, B-side for MatMul's [K, N]),
+   * keyed by initializer name (+ group for conv). Serving then never
+   * repacks or rescans a constant operand. */
+  struct PackedMat {
+    std::vector<float> f;
+    std::vector<int32_t> i;
+    bool int8_ok = false;
+  };
+  std::map<std::string, PackedMat> packed_w_;
+
+  /* Static memory plan: one byte offset per node output into a single
+   * arena sized to the peak over the lifetime walk (see plan_memory). */
+  struct PlanSlot {
+    uint64_t off = 0;
+    size_t bytes = 0;
+    bool valid = false;
+  };
+  std::vector<PlanSlot> plan_;
+  std::vector<char> arena_storage_;
+  char* arena_base_ = nullptr;
+  uint64_t arena_bytes_ = 0;
+  bool planned_ = false;
+  int fused_nodes_ = 0;
 
   const Tensor& in(const Node& n, size_t k) {
     auto it = env.find(n.inputs[k]);
@@ -664,35 +1203,32 @@ struct Predictor {
     return it == n.attrs.end() ? std::vector<int64_t>{} : it->second.ints;
   }
 
+  const PackedMat* packed_lookup(const std::string& key) const {
+    auto it = packed_w_.find(key);
+    return it == packed_w_.end() ? nullptr : &it->second;
+  }
+
+  /* An initializer sharing a name with a graph INPUT is only the
+   * caller-overridable default (ONNX semantics): nothing at load time
+   * may treat it as a constant — not the folder, not the fuser, not
+   * weight pre-packing. */
+  std::set<std::string> overridable_;
+
+  const Tensor* const_initializer(const std::string& name) const {
+    if (overridable_.count(name)) return nullptr;
+    auto it = g.initializers.find(name);
+    return it == g.initializers.end() ? nullptr : &it->second;
+  }
+
   void run_node(const Node& n);
-  /* Constant folding — the load-time optimization pass (reference:
-   * AnalysisPredictor::OptimizeInferenceProgram's pass pipeline,
-   * `inference/api/analysis_predictor.cc:621`). Any node whose inputs
-   * are all initializers (or folded outputs) runs ONCE here and its
-   * outputs become initializers. The big win is int8 artifacts: the
-   * whole weight-quantization subgraph (Abs/ReduceMax/Div/Round/Clip/
-   * Cast over every weight matrix) folds away, leaving only activation
-   * quantization + the integer GEMM at serve time. */
-  void fold_constants() {
-    std::vector<Node> kept;
-    for (const auto& n : g.nodes) {
-      bool all_const = true;
-      for (const auto& i : n.inputs)
-        if (!g.initializers.count(i)) { all_const = false; break; }
-      if (!all_const) {
-        kept.push_back(n);
-        continue;
-      }
-      try {
-        run_node(n);
-      } catch (const std::exception&) {
-        kept.push_back(n);  // unsupported here -> fails at run() as before
-        continue;
-      }
-      for (const auto& o : n.outputs) g.initializers[o] = env[o];
-    }
-    g.nodes.swap(kept);
-    // a folded-away intermediate read by no surviving node can be freed
+
+  void add_initializer(const std::string& name, Tensor t) {
+    env[name] = t;
+    g.initializers[name] = std::move(t);
+  }
+
+  // drop initializers (and their env copies) no surviving node reads
+  void prune_dead_initializers() {
     std::map<std::string, int> live;
     for (const auto& n : g.nodes)
       for (const auto& i : n.inputs) ++live[i];
@@ -707,26 +1243,528 @@ struct Predictor {
     }
   }
 
+  /* Constant folding — the load-time optimization pass (reference:
+   * AnalysisPredictor::OptimizeInferenceProgram's pass pipeline,
+   * `inference/api/analysis_predictor.cc:621`). Any node whose inputs
+   * are all initializers (or folded outputs) runs ONCE here and its
+   * outputs become initializers. The big win is int8 artifacts: the
+   * whole weight-quantization subgraph (Abs/ReduceMax/Div/Round/Clip/
+   * Cast over every weight matrix) folds away, leaving only activation
+   * quantization + the integer GEMM at serve time.
+   *
+   * An initializer that shares a name with a graph INPUT is only a
+   * default value the caller may override (ONNX semantics), so it is
+   * NOT constant: folding it would silently ignore a later
+   * ptpu_predictor_set_input on that name. */
+  void fold_constants() {
+    overridable_.clear();
+    overridable_.insert(g.input_names.begin(), g.input_names.end());
+    std::vector<Node> kept;
+    for (const auto& n : g.nodes) {
+      bool all_const = true;
+      for (const auto& i : n.inputs)
+        if (!const_initializer(i)) {
+          all_const = false;
+          break;
+        }
+      if (!all_const) {
+        kept.push_back(n);
+        continue;
+      }
+      try {
+        run_node(n);
+      } catch (const std::exception&) {
+        kept.push_back(n);  // unsupported here -> fails at run() as before
+        continue;
+      }
+      for (const auto& o : n.outputs) g.initializers[o] = env[o];
+    }
+    g.nodes.swap(kept);
+    prune_dead_initializers();
+  }
+
+  bool act_code_of(const Node& n, int* act) const {
+    if (n.op == "Relu") { *act = ACT_RELU; return true; }
+    if (n.op == "Sigmoid") { *act = ACT_SIGMOID; return true; }
+    if (n.op == "Tanh") { *act = ACT_TANH; return true; }
+    if (n.op == "Max" && n.inputs.size() == 2) {
+      // the exporter lowers relu to Max(x, 0-scalar-const)
+      for (int side = 0; side < 2; ++side) {
+        const Tensor* t = const_initializer(n.inputs[size_t(side)]);
+        if (t && t->is_float() && t->numel() == 1 && t->f[0] == 0.f) {
+          *act = ACT_RELU;
+          return true;
+        }
+      }
+    }
+    return false;
+  }
+
+  // true when `name` is a float initializer broadcasting per-channel
+  // over NCHW (scalar, [C,1,1] or [1,C,1,1]); fills out[C]
+  bool channel_const(const std::string& name, int64_t C,
+                     std::vector<float>* out) const {
+    const Tensor* tp = const_initializer(name);
+    if (!tp || !tp->is_float()) return false;
+    const Tensor& t = *tp;
+    if (t.numel() == 1) {
+      out->assign(size_t(C), t.f[0]);
+      return true;
+    }
+    if (t.numel() != C) return false;
+    const auto& d = t.dims;
+    if (d.size() < 3 || d.size() > 4) return false;
+    const size_t off = 4 - d.size();
+    for (size_t k = 0; k < d.size(); ++k)
+      if (d[k] != ((k + off == 1) ? C : 1)) return false;
+    out->assign(t.f.begin(), t.f.end());
+    return true;
+  }
+
+  // float initializer broadcasting per-last-dim over a GEMM output
+  // (scalar or dims all 1 except last == N); fills out[N]
+  bool lastdim_const(const std::string& name, int64_t N,
+                     std::vector<float>* out) const {
+    const Tensor* tp = const_initializer(name);
+    if (!tp || !tp->is_float()) return false;
+    const Tensor& t = *tp;
+    if (t.numel() == 1) {
+      out->assign(size_t(N), t.f[0]);
+      return true;
+    }
+    if (t.numel() != N || t.dims.empty() || t.dims.back() != N)
+      return false;
+    for (size_t k = 0; k + 1 < t.dims.size(); ++k)
+      if (t.dims[k] != 1) return false;
+    out->assign(t.f.begin(), t.f.end());
+    return true;
+  }
+
+  /* Load-time graph rewrite (reference: the conv_bn_fuse /
+   * conv_elementwise_add_act_fuse IR passes the AnalysisPredictor runs
+   * before serving). Three rewrites, in order:
+   *   1. Identity elimination (the exporter emits copy chains).
+   *   2. Conv + per-channel affine chain + relu -> PtpuFusedConv: the
+   *      eval-mode batchnorm lowers to Sub/Mul/Mul/Add over per-channel
+   *      constants; the multiplicative part folds into the conv WEIGHTS
+   *      and the additive part becomes a fused bias, so the whole chain
+   *      collapses into the GEMM epilogue.
+   *   3. MatMul + bias Add (+ activation) -> PtpuFusedGemm.
+   * Only single-consumer, non-graph-output intermediates fuse; every
+   * eliminated node removes a full-tensor materialization pass from the
+   * serving hot path. */
+  void fuse_ops() {
+    const std::set<std::string> outset(g.output_names.begin(),
+                                       g.output_names.end());
+    // 1. Identity elimination: rewrite consumers through the alias
+    {
+      std::map<std::string, std::string> alias;
+      std::vector<Node> kept;
+      for (auto& n : g.nodes) {
+        for (auto& i : n.inputs) {
+          auto it = alias.find(i);
+          if (it != alias.end()) i = it->second;
+        }
+        if (n.op == "Identity" && !outset.count(n.outputs[0]))
+          alias[n.outputs[0]] = n.inputs[0];
+        else
+          kept.push_back(std::move(n));
+      }
+      g.nodes.swap(kept);
+    }
+
+    std::map<std::string, int> use_count;
+    std::map<std::string, size_t> consumer;  // name -> unique consumer idx
+    for (size_t k = 0; k < g.nodes.size(); ++k)
+      for (const auto& i : g.nodes[k].inputs) {
+        ++use_count[i];
+        consumer[i] = k;
+      }
+    for (const auto& name : g.output_names) ++use_count[name];
+
+    std::vector<char> dead(g.nodes.size(), 0);
+    std::map<size_t, Node> placed;  // last chain position -> fused node
+
+    for (size_t idx = 0; idx < g.nodes.size(); ++idx) {
+      Node& n = g.nodes[idx];
+      if (dead[idx] || n.outputs.size() != 1) continue;
+
+      if (n.op == "Conv" && n.inputs.size() == 2) {
+        const Tensor* wt = const_initializer(n.inputs[1]);
+        if (!wt || !wt->is_float() || wt->dims.size() != 4) continue;
+        const int64_t OC = wt->dims[0];
+        std::vector<float> scale(size_t(OC), 1.f), bias(size_t(OC), 0.f);
+        std::vector<float> c;
+        int act = ACT_NONE;
+        bool scaled = false;
+        std::vector<size_t> chain;
+        std::string cur = n.outputs[0];
+        while (!outset.count(cur) && use_count[cur] == 1) {
+          const size_t j = consumer[cur];
+          if (j <= idx || dead[j]) break;
+          const Node& m = g.nodes[j];
+          if (m.outputs.size() != 1) break;
+          if (act_code_of(m, &act)) {
+            chain.push_back(j);
+            cur = m.outputs[0];
+            break;  // affine cannot fold through a nonlinearity
+          }
+          if (m.inputs.size() != 2) break;
+          const bool cur_first = m.inputs[0] == cur;
+          const std::string& other = m.inputs[cur_first ? 1 : 0];
+          if (!channel_const(other, OC, &c)) break;
+          if (m.op == "Add") {
+            for (int64_t q = 0; q < OC; ++q) bias[size_t(q)] += c[size_t(q)];
+          } else if (m.op == "Sub" && cur_first) {
+            for (int64_t q = 0; q < OC; ++q) bias[size_t(q)] -= c[size_t(q)];
+          } else if (m.op == "Sub") {  // c - cur
+            for (int64_t q = 0; q < OC; ++q) {
+              scale[size_t(q)] = -scale[size_t(q)];
+              bias[size_t(q)] = c[size_t(q)] - bias[size_t(q)];
+            }
+            scaled = true;
+          } else if (m.op == "Mul") {
+            for (int64_t q = 0; q < OC; ++q) {
+              scale[size_t(q)] *= c[size_t(q)];
+              bias[size_t(q)] *= c[size_t(q)];
+            }
+            scaled = true;
+          } else if (m.op == "Div" && cur_first) {
+            for (int64_t q = 0; q < OC; ++q) {
+              scale[size_t(q)] /= c[size_t(q)];
+              bias[size_t(q)] /= c[size_t(q)];
+            }
+            scaled = true;
+          } else {
+            break;
+          }
+          chain.push_back(j);
+          cur = m.outputs[0];
+        }
+        if (chain.empty()) continue;
+        Node f;
+        f.op = "PtpuFusedConv";
+        f.attrs = n.attrs;
+        Attr aa;
+        aa.ival = act;
+        f.attrs["ptpu_act"] = aa;
+        std::string wname = n.inputs[1];
+        if (scaled) {
+          Tensor w2 = *wt;
+          const int64_t per_oc = w2.numel() / OC;
+          for (int64_t q = 0; q < OC; ++q)
+            for (int64_t t = 0; t < per_oc; ++t)
+              w2.f[size_t(q * per_oc + t)] *= scale[size_t(q)];
+          wname = n.inputs[1] + "__bnfold" + std::to_string(idx);
+          add_initializer(wname, std::move(w2));
+        }
+        const std::string bname = "__ptpu_bias_" + std::to_string(idx);
+        Tensor bt;
+        bt.dtype = DT_F32;
+        bt.dims = {OC};
+        bt.f.assign(bias.begin(), bias.end());
+        add_initializer(bname, std::move(bt));
+        f.inputs = {n.inputs[0], wname, bname};
+        f.outputs = {cur};
+        dead[idx] = 1;
+        for (size_t j : chain) dead[j] = 1;
+        fused_nodes_ += int(chain.size());
+        placed[chain.back()] = std::move(f);
+
+      } else if (n.op == "MatMul" && n.inputs.size() == 2) {
+        const Tensor* bt2 = const_initializer(n.inputs[1]);
+        if (!bt2 || !bt2->is_float() || bt2->dims.size() < 2) continue;
+        const int64_t N = bt2->dims.back();
+        std::vector<float> bias;
+        int act = ACT_NONE;
+        std::vector<size_t> chain;
+        std::string cur = n.outputs[0];
+        // optional bias Add
+        if (!outset.count(cur) && use_count[cur] == 1) {
+          const size_t j = consumer[cur];
+          if (j > idx && !dead[j] && g.nodes[j].op == "Add" &&
+              g.nodes[j].outputs.size() == 1 &&
+              g.nodes[j].inputs.size() == 2) {
+            const Node& m = g.nodes[j];
+            const bool cur_first = m.inputs[0] == cur;
+            if (lastdim_const(m.inputs[cur_first ? 1 : 0], N, &bias)) {
+              chain.push_back(j);
+              cur = m.outputs[0];
+            }
+          }
+        }
+        // optional activation
+        if (!outset.count(cur) && use_count[cur] == 1) {
+          const size_t j = consumer[cur];
+          if (j > idx && !dead[j] && g.nodes[j].outputs.size() == 1) {
+            int a2 = ACT_NONE;
+            if (act_code_of(g.nodes[j], &a2)) {
+              act = a2;
+              chain.push_back(j);
+              cur = g.nodes[j].outputs[0];
+            }
+          }
+        }
+        if (chain.empty()) continue;
+        if (bias.empty()) bias.assign(size_t(N), 0.f);
+        Node f;
+        f.op = "PtpuFusedGemm";
+        Attr aa;
+        aa.ival = act;
+        f.attrs["ptpu_act"] = aa;
+        const std::string bname = "__ptpu_bias_" + std::to_string(idx);
+        Tensor bt;
+        bt.dtype = DT_F32;
+        bt.dims = {N};
+        bt.f.assign(bias.begin(), bias.end());
+        add_initializer(bname, std::move(bt));
+        f.inputs = {n.inputs[0], n.inputs[1], bname};
+        f.outputs = {cur};
+        dead[idx] = 1;
+        for (size_t j : chain) dead[j] = 1;
+        fused_nodes_ += int(chain.size());
+        placed[chain.back()] = std::move(f);
+
+      } else if (bin_code(n.op) != B_NONE && bin_code(n.op) <= B_MIN &&
+                 n.inputs.size() == 2) {
+        // arithmetic binary + activation (the residual-join Add + relu
+        // every ResNet block ends with): one fused elementwise pass
+        const std::string& cur = n.outputs[0];
+        if (outset.count(cur) || use_count[cur] != 1) continue;
+        const size_t j = consumer[cur];
+        if (j <= idx || dead[j] || g.nodes[j].outputs.size() != 1)
+          continue;
+        int act = ACT_NONE;
+        if (!act_code_of(g.nodes[j], &act)) continue;
+        Node f;
+        f.op = "PtpuFusedBinary";
+        Attr ab;
+        ab.ival = bin_code(n.op);
+        f.attrs["ptpu_bin"] = ab;
+        Attr aa;
+        aa.ival = act;
+        f.attrs["ptpu_act"] = aa;
+        f.inputs = n.inputs;
+        f.outputs = {g.nodes[j].outputs[0]};
+        dead[idx] = 1;
+        dead[j] = 1;
+        fused_nodes_ += 1;
+        placed[j] = std::move(f);
+      }
+    }
+
+    if (placed.empty() && std::none_of(dead.begin(), dead.end(),
+                                       [](char d) { return d != 0; })) {
+      prune_dead_initializers();
+      return;
+    }
+    std::vector<Node> rebuilt;
+    rebuilt.reserve(g.nodes.size());
+    for (size_t k = 0; k < g.nodes.size(); ++k) {
+      auto it = placed.find(k);
+      if (it != placed.end())
+        rebuilt.push_back(std::move(it->second));
+      else if (!dead[k])
+        rebuilt.push_back(std::move(g.nodes[k]));
+    }
+    g.nodes.swap(rebuilt);
+    prune_dead_initializers();
+  }
+
+  /* Pre-pack constant GEMM operands into panel layout once at load
+   * (weights dominate pack traffic at serve time otherwise); for int
+   * weights the int8 value scan result is cached too, so the serve-time
+   * exactness check only scans activations. */
+  void prepack_weights() {
+    for (const auto& n : g.nodes) {
+      if ((n.op == "Conv" || n.op == "PtpuFusedConv") &&
+          n.inputs.size() >= 2) {
+        const Tensor* wp = const_initializer(n.inputs[1]);
+        if (!wp || wp->dims.size() != 4) continue;
+        const Tensor& w = *wp;
+        const int64_t group = attr_i(n, "group", 1);
+        const int64_t OC = w.dims[0];
+        if (group <= 0 || OC % group) continue;
+        const int64_t ocg = OC / group;
+        const int64_t CK = w.dims[1] * w.dims[2] * w.dims[3];
+        const std::string key =
+            "a:" + n.inputs[1] + ":" + std::to_string(group);
+        if (packed_w_.count(key)) continue;
+        PackedMat pm;
+        const int64_t apsz = a_pack_size(ocg, CK);
+        if (w.is_float()) {
+          pm.f.resize(size_t(apsz * group));
+          for (int64_t gi = 0; gi < group; ++gi)
+            pack_a<float, float>(w.f.data() + gi * ocg * CK, ocg, CK,
+                                 pm.f.data() + gi * apsz);
+        } else {
+          pm.int8_ok = int8_vals_ok(w.i.data(), w.i.size());
+          if (pm.int8_ok) {
+            pm.i.resize(size_t(apsz * group));
+            for (int64_t gi = 0; gi < group; ++gi)
+              pack_a<int64_t, int32_t>(w.i.data() + gi * ocg * CK, ocg, CK,
+                                       pm.i.data() + gi * apsz);
+          }
+        }
+        packed_w_[key] = std::move(pm);
+      } else if ((n.op == "MatMul" || n.op == "PtpuFusedGemm") &&
+                 n.inputs.size() >= 2) {
+        const Tensor* bp = const_initializer(n.inputs[1]);
+        if (!bp || bp->dims.size() != 2) continue;
+        const Tensor& b = *bp;
+        const int64_t K = b.dims[0], N = b.dims[1];
+        const std::string key = "b:" + n.inputs[1];
+        if (packed_w_.count(key)) continue;
+        PackedMat pm;
+        if (b.is_float()) {
+          pm.f.resize(size_t(b_pack_size(K, N)));
+          pack_b<float, float>(b.f.data(), K, N, pm.f.data());
+        } else {
+          pm.int8_ok = int8_vals_ok(b.i.data(), b.i.size());
+          if (pm.int8_ok) {
+            pm.i.resize(size_t(b_pack_size(K, N)));
+            pack_b<int64_t, int32_t>(b.i.data(), K, N, pm.i.data());
+          }
+        }
+        packed_w_[key] = std::move(pm);
+      }
+    }
+  }
+
+  /* Static memory planner (reference: memory_optimize_pass computing
+   * tensor lifetimes over the IR graph and assigning shared offsets).
+   * The exported artifact has static input shapes, so one load-time
+   * dry run with dummy inputs yields every intermediate's exact byte
+   * size; a def/last-use walk over the node list then assigns each
+   * output an offset in one arena via the shared best-fit machinery
+   * (ptpu::PlanArena over csrc/ptpu_arena.h). Serving binds outputs
+   * into the arena — zero per-run allocation or zero-fill on the hot
+   * path. Falls back to per-tensor allocation whenever shapes are
+   * dynamic or the caller binds inputs with different dims. */
+  void plan_memory() {
+    planned_ = false;
+    if (g.nodes.empty()) return;
+    for (const auto& name : g.input_names) {
+      auto it = g.input_dims.find(name);
+      if (it == g.input_dims.end()) return;
+      for (auto d : it->second)
+        if (d <= 0) return;  // symbolic/dynamic dim: no static plan
+    }
+    for (const auto& n : g.nodes)
+      if (n.outputs.size() != 1) return;
+    // dummy zero inputs (initializer-shadowed inputs keep the default)
+    std::vector<std::string> dummies;
+    for (const auto& name : g.input_names) {
+      if (g.initializers.count(name)) continue;
+      Tensor t;
+      t.dims = g.input_dims[name];
+      auto dt = g.input_dtypes.find(name);
+      t.dtype = dt == g.input_dtypes.end() ? DT_F32 : dt->second;
+      if (t.dtype == DT_F64) t.dtype = DT_F32;
+      t.alloc();
+      env[name] = std::move(t);
+      dummies.push_back(name);
+    }
+    // whatever happens, the dry run must not leak into serving state: a
+    // run() without set_input must still fail 'missing input tensor'
+    // (not silently compute f(0)), and the dry-run intermediates must
+    // not sit in memory until the first real run
+    const auto scrub = [&] {
+      for (const auto& name : dummies) env.erase(name);
+      for (const auto& n : g.nodes)
+        for (const auto& o : n.outputs)
+          if (!g.initializers.count(o)) env.erase(o);
+    };
+    std::vector<size_t> bytes(g.nodes.size(), 0);
+    try {
+      for (size_t k = 0; k < g.nodes.size(); ++k) {
+        run_node(g.nodes[k]);
+        const Tensor& t = env[g.nodes[k].outputs[0]];
+        bytes[k] = size_t(t.numel()) *
+                   (t.is_float() ? sizeof(float) : sizeof(int64_t));
+      }
+    } catch (const std::exception&) {
+      scrub();
+      return;  // a data-dependent op at zero input: serve unplanned
+    }
+    scrub();
+    std::map<std::string, size_t> def_of, last_use;
+    for (size_t k = 0; k < g.nodes.size(); ++k)
+      def_of[g.nodes[k].outputs[0]] = k;
+    for (size_t k = 0; k < g.nodes.size(); ++k)
+      for (const auto& i : g.nodes[k].inputs)
+        if (def_of.count(i)) last_use[i] = k;
+    for (const auto& name : g.output_names)
+      last_use[name] = g.nodes.size();  // outputs live to the end
+    ptpu::PlanArena arena(64);
+    plan_.assign(g.nodes.size(), PlanSlot{});
+    for (size_t k = 0; k < g.nodes.size(); ++k) {
+      plan_[k].bytes = bytes[k];
+      plan_[k].off = arena.Alloc(bytes[k]);
+      plan_[k].valid = true;
+      std::set<std::string> ended(g.nodes[k].inputs.begin(),
+                                  g.nodes[k].inputs.end());
+      ended.insert(g.nodes[k].outputs[0]);  // dead output frees at once
+      for (const auto& nm : ended) {
+        auto d = def_of.find(nm);
+        if (d == def_of.end()) continue;
+        auto lu = last_use.find(nm);
+        const size_t last = lu == last_use.end() ? d->second : lu->second;
+        if (last == k)
+          arena.Free(plan_[d->second].off, plan_[d->second].bytes);
+      }
+    }
+    arena_bytes_ = arena.Size();
+    arena_storage_.assign(size_t(arena_bytes_) + 64, 0);
+    arena_base_ = arena_storage_.data();
+    arena_base_ += (64 - (reinterpret_cast<uintptr_t>(arena_base_) & 63)) & 63;
+    planned_ = true;
+  }
+
+  bool inputs_match_plan() const {
+    for (const auto& name : g.input_names) {
+      auto it = env.find(name);
+      auto want = g.input_dims.find(name);
+      if (it == env.end() || want == g.input_dims.end()) return false;
+      if (it->second.dims != want->second) return false;
+    }
+    return true;
+  }
+
   void run() {
     outputs.clear();
     static const bool profile =
         std::getenv("PTPU_PREDICTOR_PROFILE") != nullptr;
-    if (profile) {
+    const bool use_plan = planned_ && inputs_match_plan();
+    std::map<std::string, double> acc;
+    try {
+      for (size_t k = 0; k < g.nodes.size(); ++k) {
+        AllocHint hint{use_plan && plan_[k].valid
+                           ? arena_base_ + plan_[k].off
+                           : nullptr,
+                       use_plan && plan_[k].valid ? plan_[k].bytes : 0,
+                       false};
+        g_alloc_hint = hint.base ? &hint : nullptr;
+        if (profile) {
+          auto t0 = std::chrono::steady_clock::now();
+          run_node(g.nodes[k]);
+          acc[g.nodes[k].op] += std::chrono::duration<double>(
+              std::chrono::steady_clock::now() - t0).count();
+        } else {
+          run_node(g.nodes[k]);
+        }
+        g_alloc_hint = nullptr;
+      }
+    } catch (...) {
+      g_alloc_hint = nullptr;  // never leave a dangling stack hint
+      throw;
+    }
+    if (profile)
       // per-op-type cumulative wall time to stderr — the doctor's view
       // for "which op dominates this artifact"
-      std::map<std::string, double> acc;
-      for (const auto& n : g.nodes) {
-        auto t0 = std::chrono::steady_clock::now();
-        run_node(n);
-        acc[n.op] += std::chrono::duration<double>(
-            std::chrono::steady_clock::now() - t0).count();
-      }
       for (const auto& kv : acc)
         std::fprintf(stderr, "ptpu_profile %-20s %.3f ms\n",
                      kv.first.c_str(), kv.second * 1e3);
-    } else {
-      for (const auto& n : g.nodes) run_node(n);
-    }
     for (const auto& name : g.output_names) {
       auto it = env.find(name);
       if (it == env.end())
@@ -759,18 +1797,100 @@ void Predictor::run_node(const Node& n) {
 
   if (op == "Identity") {
     env[n.outputs[0]] = in(n, 0);
-  } else if (contains(kBinaryOps, sizeof(kBinaryOps) / sizeof(char*), op)) {
+  } else if (op == "PtpuFusedBinary" ||
+             contains(kBinaryOps, sizeof(kBinaryOps) / sizeof(char*), op)) {
     const Tensor &a = in(n, 0), &b = in(n, 1);
+    const bool fusedb = op == "PtpuFusedBinary";
+    // resolved once, not per element (fused nodes carry the code)
+    const BinCode code =
+        fusedb ? BinCode(attr_i(n, "ptpu_bin", B_ADD)) : bin_code(op);
+    const int bact =
+        fusedb ? int(attr_i(n, "ptpu_act", ACT_NONE)) : ACT_NONE;
     Tensor o;
     o.dims = bcast_dims(a.dims, b.dims);
-    bool cmp = (op == "Less" || op == "LessOrEqual" || op == "Greater" ||
-                op == "GreaterOrEqual" || op == "Equal" || op == "And" ||
-                op == "Or" || op == "Xor");
+    bool cmp = code >= B_LT && code <= B_XOR;
     o.dtype = cmp ? DT_BOOL
                   : ((a.is_float() || b.is_float()) ? DT_F32 : a.dtype);
     o.alloc();
-    const BinCode code = bin_code(op);  // resolved once, not per element
-    if (a.is_float() && b.is_float() && o.dtype == DT_F32) {
+    if (a.is_float() && b.is_float() && o.dtype == DT_F32 &&
+        code <= B_MIN &&
+        (a.dims == b.dims || a.numel() == 1 || b.numel() == 1)) {
+      /* same-shape or scalar-operand arithmetic (residual joins,
+       * attention scaling): flat loop — serial when small (a pool
+       * dispatch costs more than the op), threaded chunks when big —
+       * with the fused activation applied in the same pass; these are
+       * memory-bound, so one pass instead of the op-then-relu pair
+       * halves the traffic. */
+      const bool as = a.numel() == 1 && o.numel() != 1;
+      const bool bs = b.numel() == 1 && o.numel() != 1;
+      const float *af = a.f.data(), *bf = b.f.data();
+      float* of = o.f.data();
+      parallel_for(o.numel(), 1 << 16, [&](int64_t lo, int64_t hi) {
+        for (int64_t k = lo; k < hi; ++k) {
+          const float x = af[as ? 0 : k], y = bf[bs ? 0 : k];
+          float v;
+          switch (code) {
+            case B_ADD: v = x + y; break;
+            case B_SUB: v = x - y; break;
+            case B_MUL: v = x * y; break;
+            case B_DIV: v = x / y; break;
+            case B_MAX: v = std::max(x, y); break;
+            default: v = std::min(x, y);
+          }
+          of[k] = bact == ACT_NONE ? v : act_apply(v, bact);
+        }
+      });
+      out(std::move(o));
+      return;
+    }
+    if (a.is_float() && b.is_float() && o.dtype == DT_F32 &&
+        code <= B_MIN && o.dims.size() >= 2 && o.dims.back() > 1) {
+      /* row-broadcast: one operand is constant along the last axis
+       * (layernorm's mean/rstd [.., 1] against [.., D]) — one operand
+       * index per ROW, flat vectorizable inner loops. */
+      const auto row_const = [](const Tensor& t) {
+        return t.dims.empty() || t.dims.back() == 1;
+      };
+      const bool b_row = a.dims == o.dims && row_const(b);
+      const bool a_row = !b_row && b.dims == o.dims && row_const(a);
+      if (b_row || a_row) {
+        const int64_t inner = o.dims.back();
+        const int64_t rows = o.numel() / inner;
+        const Tensor& full = b_row ? a : b;
+        const Tensor& rc = b_row ? b : a;
+        const float* ff = full.f.data();
+        const float* rf = rc.f.data();
+        float* of = o.f.data();
+        parallel_for(
+            rows, std::max<int64_t>(1, 65536 / inner),
+            [&](int64_t r0, int64_t r1) {
+          for (int64_t row = r0; row < r1; ++row) {
+            const float rv =
+                rf[bcast_index(row * inner, o.dims, rc.dims)];
+            const float* src = ff + row * inner;
+            float* dst = of + row * inner;
+            for (int64_t j = 0; j < inner; ++j) {
+              const float x = b_row ? src[j] : rv;
+              const float y = b_row ? rv : src[j];
+              float v;
+              switch (code) {
+                case B_ADD: v = x + y; break;
+                case B_SUB: v = x - y; break;
+                case B_MUL: v = x * y; break;
+                case B_DIV: v = x / y; break;
+                case B_MAX: v = std::max(x, y); break;
+                default: v = std::min(x, y);
+              }
+              dst[j] = bact == ACT_NONE ? v : act_apply(v, bact);
+            }
+          }
+        });
+        out(std::move(o));
+        return;
+      }
+    }
+    if (a.is_float() && b.is_float() && o.dtype == DT_F32 &&
+        bact == ACT_NONE) {
       const float *af = a.f.data(), *bf = b.f.data();
       float* of = o.f.data();
       switch (code) {  // the arithmetic hot set gets branch-free loops
@@ -823,7 +1943,9 @@ void Predictor::run_node(const Node& n) {
     } else {
       bcast_walk(o.dims, a.dims, b.dims,
                  [&](int64_t k, int64_t ai, int64_t bi) {
-        o.set(k, apply_bin_code(code, a.at(ai), b.at(bi)));
+        double v = apply_bin_code(code, a.at(ai), b.at(bi));
+        if (bact != ACT_NONE) v = act_apply(float(v), bact);
+        o.set(k, v);
       });
     }
     out(std::move(o));
@@ -838,24 +1960,36 @@ void Predictor::run_node(const Node& n) {
     if (a.is_float() && o.is_float()) {
       const float* af = a.f.data();
       float* of = o.f.data();
-      switch (code) {
-        case U_RELU:
-          for (int64_t k = 0; k < nel; ++k)
-            of[k] = af[k] > 0.f ? af[k] : 0.f;
-          break;
-        case U_NEG:
-          for (int64_t k = 0; k < nel; ++k) of[k] = -af[k];
-          break;
-        case U_ABS:
-          for (int64_t k = 0; k < nel; ++k) of[k] = std::fabs(af[k]);
-          break;
-        case U_SQRT:
-          for (int64_t k = 0; k < nel; ++k) of[k] = std::sqrt(af[k]);
-          break;
-        default:
-          for (int64_t k = 0; k < nel; ++k)
-            of[k] = float(apply_un_code(code, af[k]));
-      }
+      // threaded element chunks: the transcendental set (Exp in every
+      // softmax, Erf in every GELU) is compute-bound and scales; the
+      // cheap set is memory-bound, so it needs much more work per
+      // chunk before a pool dispatch pays off
+      const bool cheap = code == U_RELU || code == U_NEG ||
+                         code == U_ABS || code == U_SQRT ||
+                         code == U_FLOOR || code == U_CEIL ||
+                         code == U_ROUND || code == U_SIGN ||
+                         code == U_NOT;
+      parallel_for(nel, cheap ? (1 << 16) : (1 << 13),
+                   [&](int64_t lo, int64_t hi) {
+        switch (code) {
+          case U_RELU:
+            for (int64_t k = lo; k < hi; ++k)
+              of[k] = af[k] > 0.f ? af[k] : 0.f;
+            break;
+          case U_NEG:
+            for (int64_t k = lo; k < hi; ++k) of[k] = -af[k];
+            break;
+          case U_ABS:
+            for (int64_t k = lo; k < hi; ++k) of[k] = std::fabs(af[k]);
+            break;
+          case U_SQRT:
+            for (int64_t k = lo; k < hi; ++k) of[k] = std::sqrt(af[k]);
+            break;
+          default:
+            for (int64_t k = lo; k < hi; ++k)
+              of[k] = float(apply_un_code(code, af[k]));
+        }
+      });
     } else {
       for (int64_t k = 0; k < nel; ++k)
         o.set(k, apply_un_code(code, a.at(k)));
@@ -887,20 +2021,64 @@ void Predictor::run_node(const Node& n) {
     o.dtype = int(attr_i(n, "to", DT_F32));
     if (o.dtype == DT_F64) o.dtype = DT_F32;
     o.alloc();
-    for (int64_t k = 0; k < o.numel(); ++k) {
-      double v = a.at(k);
-      if (o.dtype == DT_BOOL) v = (v != 0);
-      else if (o.dtype == DT_I8)   // wrap like a C int8_t conversion
-        v = double(int8_t(int64_t(v)));
-      o.set(k, v);
-    }
+    // threaded typed loops: int8 artifacts cast every activation
+    // tensor twice per layer (quantize + dequantize) — the old serial
+    // double-dispatch loop was their top serving cost
+    const int64_t nel = o.numel();
+    const int od = o.dtype;
+    const float* af = a.f.data();
+    const int64_t* ai = a.i.data();
+    float* of = o.f.data();
+    int64_t* oi = o.i.data();
+    const bool aflt = a.is_float(), oflt = o.is_float();
+    parallel_for(nel, 1 << 15, [&](int64_t lo, int64_t hi) {
+      for (int64_t k = lo; k < hi; ++k) {
+        const double v = aflt ? double(af[k]) : double(ai[k]);
+        if (oflt) {
+          of[k] = float(v);
+        } else if (od == DT_BOOL) {
+          oi[k] = v != 0;
+        } else if (od == DT_I8) {  // wrap like a C int8_t conversion
+          oi[k] = int8_t(int64_t(v));
+        } else {
+          oi[k] = int64_t(v);
+        }
+      }
+    });
     out(std::move(o));
   } else if (op == "Reshape") {
     const Tensor& a = in(n, 0);
     const Tensor& shp = in(n, 1);
-    Tensor o = a;
-    o.dims.assign(shp.i.begin(), shp.i.end());
-    out(std::move(o));
+    std::vector<int64_t> want(shp.i.begin(), shp.i.end());
+    int64_t wn = 1;
+    bool concrete = true;
+    for (auto d : want) {
+      if (d <= 0) concrete = false;
+      wn *= d;
+    }
+    if (concrete && wn == a.numel()) {
+      // plain copy into the (possibly arena-bound) output — threaded
+      // memcpy instead of a per-run owning deep copy
+      Tensor o;
+      o.dtype = a.dtype;
+      o.dims = std::move(want);
+      o.alloc();
+      const int64_t esz = a.is_float() ? 4 : 8;
+      const char* src = a.is_float()
+                            ? reinterpret_cast<const char*>(a.f.data())
+                            : reinterpret_cast<const char*>(a.i.data());
+      char* dst = o.is_float() ? reinterpret_cast<char*>(o.f.data())
+                               : reinterpret_cast<char*>(o.i.data());
+      parallel_for(wn, 1 << 16, [&](int64_t lo, int64_t hi) {
+        std::memcpy(dst + lo * esz, src + lo * esz,
+                    size_t(hi - lo) * size_t(esz));
+      });
+      out(std::move(o));
+    } else {  // 0/-1 markers: keep the legacy storage-carrying copy
+      Tensor o = a;
+      o.dims = std::move(want);
+      out(std::move(o));
+    }
   } else if (op == "Transpose") {
     const Tensor& a = in(n, 0);
     auto perm = attr_ints(n, "perm");
@@ -915,25 +2093,52 @@ void Predictor::run_node(const Node& n) {
     o.alloc();
     // odometer walk: src index updated incrementally per output
     // element (every attention matmul lowers through Transpose — the
-    // old per-element div/mod chain dominated transformer serving)
+    // old per-element div/mod chain dominated transformer serving);
+    // parallel over slabs of the outermost output axis
     auto istr = strides_for(a.dims);
     const size_t r = o.dims.size();
-    std::vector<int64_t> sstr(r), ctr(r, 0);
+    std::vector<int64_t> sstr(r);
     for (size_t d = 0; d < r; ++d) sstr[d] = istr[size_t(perm[d])];
     const int64_t nel = o.numel();
-    int64_t src = 0;
+    // flatten leading output axes into parallel "rows" until there is
+    // enough of them to spread across the pool; each row seeds its
+    // source index once (div/mod), then walks the tail incrementally
+    size_t split = 0;
+    int64_t rows = 1;
+    while (split + 1 < r && rows < 4 * int64_t(num_threads()))
+      rows *= o.dims[split++];
+    const int64_t slab = rows ? nel / rows : 0;
     const bool flt = a.is_float();
-    for (int64_t k = 0; k < nel; ++k) {
-      if (flt) o.f[size_t(k)] = a.f[size_t(src)];
-      else o.i[size_t(k)] = a.i[size_t(src)];
-      for (size_t d = r; d-- > 0;) {
-        ++ctr[d];
-        src += sstr[d];
-        if (ctr[d] < o.dims[d]) break;
-        src -= sstr[d] * o.dims[d];
-        ctr[d] = 0;
+    const float* af = a.f.data();
+    const int64_t* ai = a.i.data();
+    float* of = o.f.data();
+    int64_t* oi = o.i.data();
+    parallel_for(rows, std::max<int64_t>(1, 65536 / std::max<int64_t>(
+                                                       slab, 1)),
+                 [&](int64_t c0, int64_t c1) {
+      std::vector<int64_t> ctr(r, 0);
+      for (int64_t cc = c0; cc < c1; ++cc) {
+        ctr.assign(r, 0);
+        int64_t src = 0, rem = cc;
+        for (size_t d = split; d-- > 0;) {
+          const int64_t coord = rem % o.dims[d];
+          rem /= o.dims[d];
+          src += coord * sstr[d];
+        }
+        const int64_t k0 = cc * slab;
+        for (int64_t k = 0; k < slab; ++k) {
+          if (flt) of[size_t(k0 + k)] = af[size_t(src)];
+          else oi[size_t(k0 + k)] = ai[size_t(src)];
+          for (size_t d = r; d-- > split;) {
+            ++ctr[d];
+            src += sstr[d];
+            if (ctr[d] < o.dims[d]) break;
+            src -= sstr[d] * o.dims[d];
+            ctr[d] = 0;
+          }
+        }
       }
-    }
+    });
     out(std::move(o));
   } else if (op == "Concat") {
     int64_t rank = int64_t(in(n, 0).dims.size());
@@ -1071,6 +2276,15 @@ void Predictor::run_node(const Node& n) {
       for (int64_t j = 0; j < nidx; ++j) {
         int64_t iv = idx.i.empty() ? int64_t(idx.at(j)) : idx.i[size_t(j)];
         if (iv < 0) iv += ax_dim;
+        // indices arrive over the C ABI (token ids etc.) and are
+        // untrusted: an out-of-range id would read (memcpy!) a full
+        // row out of bounds — throw like check_dims does for dims
+        if (iv < 0 || iv >= ax_dim)
+          throw std::runtime_error(
+              "Gather: index " +
+              std::to_string(idx.i.empty() ? int64_t(idx.at(j))
+                                           : idx.i[size_t(j)]) +
+              " out of range for axis dim " + std::to_string(ax_dim));
         const int64_t src = (ou * ax_dim + iv) * inner;
         const int64_t dst = (ou * nidx + j) * inner;
         if (a.is_float())
@@ -1081,8 +2295,11 @@ void Predictor::run_node(const Node& n) {
                       size_t(inner) * sizeof(int64_t));
       }
     out(std::move(o));
-  } else if (op == "MatMul") {
+  } else if (op == "MatMul" || op == "PtpuFusedGemm") {
     const Tensor &a = in(n, 0), &b = in(n, 1);
+    const bool fused = op == "PtpuFusedGemm";
+    const Tensor* fb = fused ? &in(n, 2) : nullptr;
+    const int act = fused ? int(attr_i(n, "ptpu_act", ACT_NONE)) : ACT_NONE;
     const size_t ra = a.dims.size(), rb = b.dims.size();
     const bool batched_b = rb > 2;
     int64_t k_d = a.dims.back();
@@ -1113,32 +2330,64 @@ void Predictor::run_node(const Node& n) {
       if (rb == 2) o.dims.push_back(nn);
     }
     o.alloc();
+    const float* bias_n =
+        fb && fb->is_float() && fb->numel() == nn ? fb->f.data() : nullptr;
+    const PackedMat* pw =
+        batched_b ? nullptr : packed_lookup("b:" + n.inputs[1]);
     if (a.is_float() && b.is_float() && rb >= 2) {
-      // blocked threaded SGEMM; for non-batched B every batch reuses
-      // the same [K,N] panel, for batched B each batch has its own
-      for (int64_t bb = 0; bb < batch; ++bb)
-        sgemm(a.f.data() + bb * m * k_d,
-              b.f.data() + (batched_b ? bb * k_d * nn : 0),
-              o.f.data() + bb * m * nn, m, nn, k_d);
+      if (!batched_b) {
+        // leading dims of A collapse into M: one packed macro-kernel
+        // call over the whole batch, one shared (pre-packed) B panel
+        gemm_bias_act<float>(a.f.data(), b.f.data(), o.f.data(),
+                             batch * m, nn, k_d,
+                             nullptr, pw && !pw->f.empty() ? pw->f.data()
+                                                          : nullptr,
+                             bias_n, nullptr, act);
+      } else {
+        // batched (attention heads): the per-element GEMMs are tiny, so
+        // parallelism comes from the BATCH axis — each worker packs and
+        // computes its elements serially (in_worker_ keeps the inner
+        // parallel_fors from re-dispatching)
+        parallel_for(batch, 1, [&](int64_t b0, int64_t b1) {
+          for (int64_t bb = b0; bb < b1; ++bb)
+            gemm_bias_act<float>(a.f.data() + bb * m * k_d,
+                                 b.f.data() + bb * k_d * nn,
+                                 o.f.data() + bb * m * nn, m, nn, k_d,
+                                 nullptr, nullptr, bias_n, nullptr, act);
+        });
+      }
     } else if (!a.is_float() && !b.is_float() && rb >= 2 &&
                // int8-range guard: this path is EXACT only for int8
                // operands; int64 index/counter arithmetic must keep
-               // the exact double-accumulating scalar path
-               int8_exact(a.i, b.i, k_d)) {
-      // int8-executing artifacts: int32 GEMM (exact for the int8 value
-      // range at this K; anything else falls through to the scalar path)
-      std::vector<int32_t> a32(size_t(m * k_d)), acc(size_t(m * nn));
-      std::vector<int32_t> b32(size_t(k_d * nn));
-      for (int64_t bb = 0; bb < batch; ++bb) {
-        const int64_t* ap = a.i.data() + bb * m * k_d;
-        for (int64_t k = 0; k < m * k_d; ++k) a32[size_t(k)] = int32_t(ap[k]);
-        const int64_t* bp = b.i.data() + (batched_b ? bb * k_d * nn : 0);
-        if (bb == 0 || batched_b)
-          for (int64_t k = 0; k < k_d * nn; ++k)
-            b32[size_t(k)] = int32_t(bp[k]);
-        igemm(a32.data(), b32.data(), acc.data(), m, nn, k_d);
-        float* of = o.f.data() + bb * m * nn;
-        for (int64_t k = 0; k < m * nn; ++k) of[k] = float(acc[size_t(k)]);
+               // the exact double-accumulating scalar path. A load-time
+               // packed weight caches its value scan in int8_ok.
+               int8_depth_ok(k_d) && int8_vals_ok(a.i.data(), a.i.size()) &&
+               (pw ? pw->int8_ok
+                   : int8_vals_ok(b.i.data(), b.i.size()))) {
+      // int8-executing artifacts: packed int32 GEMM, widening directly
+      // from the int64 storage into the panel buffers
+      if (!batched_b) {
+        std::vector<int32_t> acc(size_t(batch * m * nn));
+        gemm_bias_act<int32_t, int64_t, int64_t>(
+            a.i.data(), b.i.data(), acc.data(), batch * m, nn, k_d,
+            nullptr, pw && !pw->i.empty() ? pw->i.data() : nullptr,
+            nullptr, nullptr, ACT_NONE);
+        float* of = o.f.data();
+        for (int64_t k = 0; k < batch * m * nn; ++k)
+          of[k] = float(acc[size_t(k)]);
+      } else {
+        parallel_for(batch, 1, [&](int64_t b0, int64_t b1) {
+          std::vector<int32_t> bacc(size_t(m * nn));
+          for (int64_t bb = b0; bb < b1; ++bb) {
+            gemm_bias_act<int32_t, int64_t, int64_t>(
+                a.i.data() + bb * m * k_d, b.i.data() + bb * k_d * nn,
+                bacc.data(), m, nn, k_d, nullptr, nullptr, nullptr,
+                nullptr, ACT_NONE);
+            float* of = o.f.data() + bb * m * nn;
+            for (int64_t k = 0; k < m * nn; ++k)
+              of[k] = float(bacc[size_t(k)]);
+          }
+        });
       }
     } else {
       for (int64_t bb = 0; bb < batch; ++bb)
@@ -1149,12 +2398,17 @@ void Predictor::run_node(const Node& n) {
               acc += a.at((bb * m + mm) * k_d + kk) *
                      b.at(batched_b ? (bb * k_d + kk) * nn + jj
                                     : (rb == 2 ? kk * nn + jj : kk));
+            if (fb) acc = act_apply(float(acc + fb->at(jj % fb->numel())),
+                                    act);
             o.set((bb * m + mm) * nn + jj, acc);
           }
     }
     out(std::move(o));
-  } else if (op == "Conv") {
+  } else if (op == "Conv" || op == "PtpuFusedConv") {
     const Tensor &x = in(n, 0), &w = in(n, 1);
+    const bool fused = op == "PtpuFusedConv";
+    const Tensor* fb = fused ? &in(n, 2) : nullptr;
+    const int act = fused ? int(attr_i(n, "ptpu_act", ACT_NONE)) : ACT_NONE;
     if (x.dims.size() != 4) throw std::runtime_error("Conv: only 2-D");
     auto strides = attr_ints(n, "strides");
     auto pads = attr_ints(n, "pads");
@@ -1174,92 +2428,70 @@ void Predictor::run_node(const Node& n) {
     o.dtype = DT_F32;
     o.dims = {N, OC, OH, OW};
     o.alloc();
+    const PackedMat* pw =
+        packed_lookup("a:" + n.inputs[1] + ":" + std::to_string(group));
+    const int64_t P = OH * OW, CK = ICG * KH * KW;
+    const int64_t apsz = a_pack_size(ocg, CK);
+    const bool unit = (KH == 1 && KW == 1 && strides[0] == 1 &&
+                       strides[1] == 1 && pads[0] == 0 && pads[1] == 0 &&
+                       pads[2] == 0 && pads[3] == 0);
     if (x.is_float() && w.is_float()) {
-      /* im2col + SGEMM: per (image, group) build the patch matrix
-       * col[ICG*KH*KW, OH*OW] once, then the conv is one GEMM of the
-       * group's [ocg, ICG*KH*KW] filters against it — the MXU-style
-       * formulation, here feeding the threaded CPU GEMM. 1x1/s1/p0
-       * convs skip the copy: the input slice IS the col matrix. */
-      const int64_t P = OH * OW, CK = ICG * KH * KW;
-      const bool unit = (KH == 1 && KW == 1 && strides[0] == 1 &&
-                         strides[1] == 1 && pads[0] == 0 && pads[1] == 0 &&
-                         pads[2] == 0 && pads[3] == 0);
-      std::vector<float> col;
-      if (!unit) col.resize(size_t(CK * P));
+      /* Implicit im2col + packed GEMM: per (image, group) the patch
+       * matrix col[ICG*KH*KW, OH*OW] is packed straight into B-panel
+       * layout (no col materialization), then the conv is one packed
+       * GEMM of the group's pre-packed [ocg, CK] filter panels against
+       * it — the MXU-style formulation on the cache-blocked CPU
+       * micro-kernel, with the fused bias+activation applied in the
+       * epilogue writeback. */
+      auto& bbuf = pack_scratch<float>(1);
+      bbuf.resize(size_t(b_pack_size(CK, P)));
       for (int64_t nn = 0; nn < N; ++nn)
         for (int64_t g = 0; g < group; ++g) {
           const float* xg = x.f.data() + (nn * C + g * ICG) * H * W;
-          const float* src = xg;
-          if (!unit) {
-            float* cp = col.data();
-            parallel_for(CK, 64, [&](int64_t r0, int64_t r1) {
-              for (int64_t r = r0; r < r1; ++r) {
-                const int64_t ic = r / (KH * KW);
-                const int64_t kh = (r / KW) % KH, kw = r % KW;
-                float* dst = cp + r * P;
-                const float* plane = xg + ic * H * W;
-                for (int64_t oh = 0; oh < OH; ++oh) {
-                  const int64_t ih = oh * strides[0] - pads[0] + kh * dil[0];
-                  if (ih < 0 || ih >= H) {
-                    std::memset(dst + oh * OW, 0, size_t(OW) * sizeof(float));
-                    continue;
-                  }
-                  const float* row = plane + ih * W;
-                  for (int64_t ow = 0; ow < OW; ++ow) {
-                    const int64_t iw = ow * strides[1] - pads[1] + kw * dil[1];
-                    dst[oh * OW + ow] =
-                        (iw < 0 || iw >= W) ? 0.f : row[iw];
-                  }
-                }
-              }
-            });
-            src = cp;
-          }
-          sgemm(w.f.data() + g * ocg * CK, src,
-                o.f.data() + (nn * OC + g * ocg) * P, ocg, P, CK);
+          if (unit)  // the input slice IS the col matrix: plain pack
+            pack_b<float, float>(xg, CK, P, bbuf.data());
+          else
+            pack_b_im2col<float, float>(xg, ICG, H, W, KH, KW, OH, OW,
+                                        strides[0], strides[1], pads[0],
+                                        pads[1], dil[0], dil[1],
+                                        bbuf.data());
+          gemm_bias_act<float>(
+              w.f.data() + g * ocg * CK, xg,
+              o.f.data() + (nn * OC + g * ocg) * P, ocg, P, CK,
+              pw && !pw->f.empty() ? pw->f.data() + g * apsz : nullptr,
+              bbuf.data(), nullptr,
+              fb ? fb->f.data() + g * ocg : nullptr, act);
         }
-    } else if (!x.is_float() && !w.is_float() &&
-               int8_exact(x.i, w.i, ICG * KH * KW)) {
-      /* int8-executing conv (QAT convert_to_int8 artifacts): same
-       * im2col formulation feeding the int32 GEMM — exact for int8
-       * operands with int32 accumulation. Group outer so each group's
-       * weight panel widens to int32 ONCE, not once per image. */
-      const int64_t P = OH * OW, CK = ICG * KH * KW;
-      std::vector<int32_t> col(size_t(CK * P)), w32(size_t(ocg * CK));
+    } else if (!x.is_float() && !w.is_float() && int8_depth_ok(CK) &&
+               int8_vals_ok(x.i.data(), x.i.size()) &&
+               (pw ? pw->int8_ok
+                   : int8_vals_ok(w.i.data(), w.i.size()))) {
+      /* int8-executing conv (QAT convert_to_int8 artifacts): identical
+       * packed formulation on int32 lanes — exact for int8 operands
+       * with int32 accumulation. The panel packers widen straight from
+       * the int64 storage; pre-packed weights skip the per-run value
+       * scan via the cached int8_ok. */
+      auto& bbuf = pack_scratch<int32_t>(1);
+      bbuf.resize(size_t(b_pack_size(CK, P)));
       std::vector<int32_t> acc(size_t(ocg * P));
-      for (int64_t g = 0; g < group; ++g) {
-        const int64_t* wg = w.i.data() + g * ocg * CK;
-        for (int64_t k = 0; k < ocg * CK; ++k)
-          w32[size_t(k)] = int32_t(wg[k]);
-        for (int64_t nn = 0; nn < N; ++nn) {
+      for (int64_t nn = 0; nn < N; ++nn)
+        for (int64_t g = 0; g < group; ++g) {
           const int64_t* xg = x.i.data() + (nn * C + g * ICG) * H * W;
-          parallel_for(CK, 64, [&](int64_t r0, int64_t r1) {
-            for (int64_t rr = r0; rr < r1; ++rr) {
-              const int64_t ic = rr / (KH * KW);
-              const int64_t kh = (rr / KW) % KH, kw = rr % KW;
-              int32_t* dst = col.data() + rr * P;
-              const int64_t* plane = xg + ic * H * W;
-              for (int64_t oh = 0; oh < OH; ++oh) {
-                const int64_t ih = oh * strides[0] - pads[0] + kh * dil[0];
-                if (ih < 0 || ih >= H) {  // hoisted like the float path
-                  std::memset(dst + oh * OW, 0,
-                              size_t(OW) * sizeof(int32_t));
-                  continue;
-                }
-                const int64_t* row = plane + ih * W;
-                for (int64_t ow = 0; ow < OW; ++ow) {
-                  const int64_t iw = ow * strides[1] - pads[1] + kw * dil[1];
-                  dst[oh * OW + ow] =
-                      (iw < 0 || iw >= W) ? 0 : int32_t(row[iw]);
-                }
-              }
-            }
-          });
-          igemm(w32.data(), col.data(), acc.data(), ocg, P, CK);
+          if (unit)
+            pack_b<int64_t, int32_t>(xg, CK, P, bbuf.data());
+          else
+            pack_b_im2col<int64_t, int32_t>(xg, ICG, H, W, KH, KW, OH, OW,
+                                            strides[0], strides[1],
+                                            pads[0], pads[1], dil[0],
+                                            dil[1], bbuf.data());
+          gemm_bias_act<int32_t, int64_t, int64_t>(
+              w.i.data() + g * ocg * CK, xg, acc.data(), ocg, P, CK,
+              pw && !pw->i.empty() ? pw->i.data() + g * apsz : nullptr,
+              bbuf.data(), nullptr, nullptr, ACT_NONE);
           float* of = o.f.data() + (nn * OC + g * ocg) * P;
-          for (int64_t k = 0; k < ocg * P; ++k) of[k] = float(acc[size_t(k)]);
+          for (int64_t k = 0; k < ocg * P; ++k)
+            of[k] = float(acc[size_t(k)]);
         }
-      }
     } else {
       for (int64_t nn = 0; nn < N; ++nn)
         for (int64_t oc = 0; oc < OC; ++oc) {
@@ -1278,7 +2510,9 @@ void Predictor::run_node(const Node& n) {
                            w.at(((oc * ICG + ic) * KH + kh) * KW + kw);
                   }
                 }
-              o.f[size_t(((nn * OC + oc) * OH + oh) * OW + ow)] = float(acc);
+              float v = float(acc);
+              if (fb) v = act_apply(v + fb->f[size_t(oc)], act);
+              o.f[size_t(((nn * OC + oc) * OH + oh) * OW + ow)] = v;
             }
         }
     }
@@ -1300,6 +2534,47 @@ void Predictor::run_node(const Node& n) {
     o.dtype = DT_F32;
     o.dims = {N, C, OH, OW};
     o.alloc();
+    const bool is_max = op == "MaxPool";
+    if (x.is_float()) {
+      // plane-parallel float pooling: the window walk reads the input
+      // plane directly (no per-element dtype dispatch)
+      const float* xf = x.f.data();
+      float* of = o.f.data();
+      parallel_for(N * C, 1, [&](int64_t p0, int64_t p1) {
+        for (int64_t pl = p0; pl < p1; ++pl) {
+          const float* plane = xf + pl * H * W;
+          float* dst = of + pl * OH * OW;
+          for (int64_t oh = 0; oh < OH; ++oh) {
+            const int64_t h0 = std::max<int64_t>(0, oh * strides[0] -
+                                                        pads[0]);
+            const int64_t h1 = std::min(H, oh * strides[0] - pads[0] +
+                                               ks[0]);
+            for (int64_t ow = 0; ow < OW; ++ow) {
+              const int64_t w0 = std::max<int64_t>(0, ow * strides[1] -
+                                                          pads[1]);
+              const int64_t w1 = std::min(W, ow * strides[1] - pads[1] +
+                                                 ks[1]);
+              float best = -1e30f;  // matches the generic path's init
+              double sum = 0;
+              for (int64_t ih = h0; ih < h1; ++ih) {
+                const float* row = plane + ih * W;
+                for (int64_t iw = w0; iw < w1; ++iw) {
+                  best = std::max(best, row[iw]);
+                  sum += row[iw];
+                }
+              }
+              const int64_t cnt = (h1 - h0) * (w1 - w0);
+              const double denom =
+                  include_pad ? double(ks[0] * ks[1])
+                              : double(std::max(cnt, int64_t(1)));
+              dst[oh * OW + ow] = is_max ? best : float(sum / denom);
+            }
+          }
+        }
+      });
+      out(std::move(o));
+      return;
+    }
     for (int64_t nn = 0; nn < N; ++nn)
       for (int64_t c = 0; c < C; ++c)
         for (int64_t oh = 0; oh < OH; ++oh)
@@ -1319,7 +2594,7 @@ void Predictor::run_node(const Node& n) {
             double denom = include_pad ? double(ks[0] * ks[1])
                                        : double(std::max(cnt, int64_t(1)));
             o.f[size_t(((nn * C + c) * OH + oh) * OW + ow)] =
-                float(op == "MaxPool" ? best : sum / denom);
+                float(is_max ? best : sum / denom);
           }
     out(std::move(o));
   } else if (op == "ReduceSum" || op == "ReduceMax" || op == "ReduceMin" ||
@@ -1356,27 +2631,32 @@ void Predictor::run_node(const Node& n) {
       for (size_t d = split; d < a.dims.size(); ++d) inner *= a.dims[d];
       for (size_t d = 0; d < split; ++d) outer *= a.dims[d];
       const float* af = a.f.data();
-      for (int64_t ou = 0; ou < outer; ++ou) {
-        const float* row = af + ou * inner;
-        double accv = init;
-        switch (rc) {
-          case 1:
-            for (int64_t j = 0; j < inner; ++j)
-              accv = std::max(accv, double(row[j]));
-            break;
-          case 2:
-            for (int64_t j = 0; j < inner; ++j)
-              accv = std::min(accv, double(row[j]));
-            break;
-          case 3:
-            for (int64_t j = 0; j < inner; ++j) accv *= row[j];
-            break;
-          default:
-            for (int64_t j = 0; j < inner; ++j) accv += row[j];
+      float* of = o.f.data();
+      parallel_for(outer,
+                   std::max<int64_t>(1, 65536 / std::max<int64_t>(inner, 1)),
+                   [&](int64_t o0, int64_t o1) {
+        for (int64_t ou = o0; ou < o1; ++ou) {
+          const float* row = af + ou * inner;
+          double accv = init;
+          switch (rc) {
+            case 1:
+              for (int64_t j = 0; j < inner; ++j)
+                accv = std::max(accv, double(row[j]));
+              break;
+            case 2:
+              for (int64_t j = 0; j < inner; ++j)
+                accv = std::min(accv, double(row[j]));
+              break;
+            case 3:
+              for (int64_t j = 0; j < inner; ++j) accv *= row[j];
+              break;
+            default:
+              for (int64_t j = 0; j < inner; ++j) accv += row[j];
+          }
+          if (rc == 4) accv /= double(inner);
+          of[ou] = float(accv);
         }
-        if (rc == 4) accv /= double(inner);
-        o.f[size_t(ou)] = float(accv);
-      }
+      });
       out(std::move(o));
       return;
     }
@@ -1581,6 +2861,14 @@ PTPU_Predictor* ptpu_predictor_create(const char* model_path, char* err,
     p->g = parse_model(ss.str());
     for (const auto& kv : p->g.initializers) p->env[kv.first] = kv.second;
     p->fold_constants();
+    // PTPU_PREDICTOR_OPT=0 keeps the unoptimized graph — the parity
+    // baseline the fused/planned path is tested against
+    const char* opt = std::getenv("PTPU_PREDICTOR_OPT");
+    if (!opt || std::strcmp(opt, "0") != 0) {
+      p->fuse_ops();
+      p->prepack_weights();
+      p->plan_memory();
+    }
     return (PTPU_Predictor*)p;
   } catch (const std::exception& e) {
     fill_error(err, err_len, e.what());
@@ -1596,6 +2884,26 @@ void ptpu_predictor_destroy(PTPU_Predictor* h) {
 __attribute__((visibility("default")))
 int ptpu_predictor_num_inputs(PTPU_Predictor* h) {
   return int(((Predictor*)h)->g.input_names.size());
+}
+
+// introspection: node count after load-time rewrites (fusion shrinks
+// it), count of nodes eliminated by fusion, and the planned arena size
+// in bytes (0 when the artifact has dynamic shapes and serving fell
+// back to per-tensor allocation)
+__attribute__((visibility("default")))
+int ptpu_predictor_num_nodes(PTPU_Predictor* h) {
+  return int(((Predictor*)h)->g.nodes.size());
+}
+
+__attribute__((visibility("default")))
+int ptpu_predictor_fused_nodes(PTPU_Predictor* h) {
+  return ((Predictor*)h)->fused_nodes_;
+}
+
+__attribute__((visibility("default")))
+int64_t ptpu_predictor_arena_bytes(PTPU_Predictor* h) {
+  auto* p = (Predictor*)h;
+  return p->planned_ ? int64_t(p->arena_bytes_) : 0;
 }
 
 __attribute__((visibility("default")))

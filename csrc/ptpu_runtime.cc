@@ -31,6 +31,8 @@
 #include <thread>
 #include <vector>
 
+#include "ptpu_arena.h"
+
 #if defined(_WIN32)
 #define PTPU_EXPORT extern "C" __declspec(dllexport)
 #else
@@ -52,7 +54,10 @@ PTPU_EXPORT const char *ptpu_last_error() { return g_last_error.c_str(); }
 //
 // Mirrors AutoGrowthBestFitAllocator: allocation rounded to an alignment
 // unit, free blocks kept in a size-ordered multimap, adjacent free blocks
-// coalesced, arena grows by max(chunk, request) when no block fits.
+// coalesced, arena grows by max(chunk, request) when no block fits. The
+// free-block bookkeeping is the shared ptpu::BestFitFreeList
+// (csrc/ptpu_arena.h), the same machinery the native predictor's static
+// memory planner uses in offset space.
 // ---------------------------------------------------------------------------
 namespace {
 
@@ -77,16 +82,13 @@ class BestFitArena {
     // the address simultaneously free and allocated
     if (n == 0) n = 1;
     n = RoundUp(n);
-    auto it = free_by_size_.lower_bound(n);
-    if (it == free_by_size_.end()) {
+    char *base;
+    size_t block;
+    if (!free_.Take(n, &base, &block)) {
       if (!Grow(n)) return nullptr;
-      it = free_by_size_.lower_bound(n);
-      if (it == free_by_size_.end()) return nullptr;
+      if (!free_.Take(n, &base, &block)) return nullptr;
     }
-    char *base = static_cast<char *>(it->second);
-    size_t block = it->first;
-    EraseFree(base, block);
-    if (block > n) AddFree(base + n, block - n);
+    if (block > n) free_.Add(base + n, block - n);
     allocated_[base] = n;
     in_use_ += n;
     peak_ = std::max(peak_, in_use_);
@@ -100,7 +102,7 @@ class BestFitArena {
     size_t n = it->second;
     allocated_.erase(it);
     in_use_ -= n;
-    Coalesce(static_cast<char *>(p), n);
+    free_.Add(static_cast<char *>(p), n);
     return true;
   }
 
@@ -127,55 +129,15 @@ class BestFitArena {
     }
     chunks_.push_back({base, sz});
     reserved_ += sz;
-    AddFree(static_cast<char *>(base), sz);
+    free_.Add(static_cast<char *>(base), sz);
     return true;
-  }
-
-  void AddFree(char *p, size_t n) {
-    free_by_addr_[p] = n;
-    free_by_size_.emplace(n, p);
-  }
-
-  void EraseFree(char *p, size_t n) {
-    free_by_addr_.erase(p);
-    auto range = free_by_size_.equal_range(n);
-    for (auto i = range.first; i != range.second; ++i) {
-      if (i->second == p) {
-        free_by_size_.erase(i);
-        break;
-      }
-    }
-  }
-
-  void Coalesce(char *p, size_t n) {
-    // merge with next
-    auto next = free_by_addr_.find(p + n);
-    if (next != free_by_addr_.end()) {
-      size_t nn = next->second;
-      EraseFree(p + n, nn);
-      n += nn;
-    }
-    // merge with prev
-    auto prev = free_by_addr_.lower_bound(p);
-    if (prev != free_by_addr_.begin()) {
-      --prev;
-      char *pp = static_cast<char *>(prev->first);
-      if (pp + prev->second == p) {
-        size_t pn = prev->second;
-        EraseFree(pp, pn);
-        p = pp;
-        n += pn;
-      }
-    }
-    AddFree(p, n);
   }
 
   std::mutex mu_;
   size_t chunk_size_, align_;
   size_t in_use_ = 0, peak_ = 0, reserved_ = 0;
   std::vector<Chunk> chunks_;
-  std::map<void *, size_t> free_by_addr_;
-  std::multimap<size_t, void *> free_by_size_;
+  ptpu::BestFitFreeList<char *> free_;
   std::map<void *, size_t> allocated_;
 };
 

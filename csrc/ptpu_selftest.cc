@@ -112,6 +112,140 @@ void test_parallel_for_covers_range() {
   for (int v : hit) assert(v == 1);
 }
 
+/* Fringe sweep for the packed cache-blocked GEMM: every (M % MR,
+ * N % NR) combination plus K crossing a KC boundary must match the
+ * naive triple loop — the panel zero-padding and partial-tile
+ * load/store paths are all exercised. */
+void test_packed_gemm_fringe_sweep() {
+  std::mt19937 rng(7);
+  std::uniform_real_distribution<float> d(-1.f, 1.f);
+  for (int64_t M : {1, 5, 6, 7, 13}) {
+    for (int64_t N : {1, 15, 16, 17, 33}) {
+      for (int64_t K : {1, 31, 321}) {  // 321 crosses the KC=320 block
+        std::vector<float> A(size_t(M * K)), B(size_t(K * N));
+        std::vector<float> C(size_t(M * N), -7.f);
+        for (auto& v : A) v = d(rng);
+        for (auto& v : B) v = d(rng);
+        sgemm(A.data(), B.data(), C.data(), M, N, K);
+        for (int64_t m = 0; m < M; ++m)
+          for (int64_t j = 0; j < N; ++j) {
+            float acc = 0.f;
+            for (int64_t k = 0; k < K; ++k)
+              acc += A[size_t(m * K + k)] * B[size_t(k * N + j)];
+            assert(std::fabs(C[size_t(m * N + j)] - acc) <=
+                   2e-4f * (1.f + std::fabs(acc)));
+          }
+      }
+    }
+  }
+}
+
+/* The fused epilogue: bias-per-column + relu must equal gemm followed
+ * by the separate add/max passes (the op-fusion contract). */
+void test_gemm_bias_act_epilogue() {
+  std::mt19937 rng(11);
+  std::uniform_real_distribution<float> d(-1.f, 1.f);
+  const int64_t M = 13, N = 21, K = 37;
+  std::vector<float> A(size_t(M * K)), B(size_t(K * N));
+  std::vector<float> bias(size_t(N), 0.f);
+  std::vector<float> C(size_t(M * N)), R(size_t(M * N));
+  for (auto& v : A) v = d(rng);
+  for (auto& v : B) v = d(rng);
+  for (auto& v : bias) v = d(rng);
+  gemm_bias_act<float>(A.data(), B.data(), C.data(), M, N, K, nullptr,
+                       nullptr, bias.data(), nullptr, ACT_RELU);
+  sgemm(A.data(), B.data(), R.data(), M, N, K);
+  for (int64_t m = 0; m < M; ++m)
+    for (int64_t j = 0; j < N; ++j) {
+      const float want =
+          std::max(0.f, R[size_t(m * N + j)] + bias[size_t(j)]);
+      assert(std::fabs(C[size_t(m * N + j)] - want) <= 1e-5f);
+    }
+  // bias per ROW (the conv layout)
+  std::vector<float> bm(size_t(M), 0.f);
+  for (auto& v : bm) v = d(rng);
+  gemm_bias_act<float>(A.data(), B.data(), C.data(), M, N, K, nullptr,
+                       nullptr, nullptr, bm.data(), ACT_NONE);
+  for (int64_t m = 0; m < M; ++m)
+    for (int64_t j = 0; j < N; ++j)
+      assert(std::fabs(C[size_t(m * N + j)] -
+                       (R[size_t(m * N + j)] + bm[size_t(m)])) <= 1e-5f);
+}
+
+/* WorkPool concurrency: two threads dispatching interleaved
+ * parallel_for batches (two predictors serving concurrently — the r5
+ * singleton race). Each thread owns a disjoint array; any cross-talk
+ * between dispatches corrupts a counter. */
+void test_workpool_two_thread_stress() {
+  const int iters = 200;
+  auto worker = [&](std::vector<int>* hits) {
+    for (int it = 0; it < iters; ++it) {
+      std::fill(hits->begin(), hits->end(), 0);
+      parallel_for(int64_t(hits->size()), 3, [&](int64_t lo, int64_t hi) {
+        for (int64_t i = lo; i < hi; ++i) (*hits)[size_t(i)]++;
+      });
+      for (int v : *hits) assert(v == 1);
+    }
+  };
+  std::vector<int> h1(997, 0), h2(1501, 0);
+  std::thread t1(worker, &h1), t2(worker, &h2);
+  t1.join();
+  t2.join();
+}
+
+/* PlanArena: disjoint lifetimes share offsets; the virtual size stays
+ * at the peak, and freed space coalesces for bigger later tensors. */
+void test_plan_arena_reuses_offsets() {
+  ptpu::PlanArena a(64);
+  const uint64_t o1 = a.Alloc(100);  // rounds to 128
+  const uint64_t o2 = a.Alloc(50);
+  a.Free(o1, 100);
+  const uint64_t o3 = a.Alloc(100);  // must reuse o1's block
+  assert(o3 == o1);
+  a.Free(o2, 50);
+  a.Free(o3, 100);
+  const uint64_t o4 = a.Alloc(192);  // coalesced: fits in freed space
+  assert(o4 == 0);
+  assert(a.Size() == 192);  // 128 + 64, never grew past the peak
+  // tail-aware growth: a partially-free tail extends instead of a
+  // whole new block appended after it
+  ptpu::PlanArena b(64);
+  const uint64_t p1 = b.Alloc(64);
+  b.Free(p1, 64);
+  const uint64_t p2 = b.Alloc(128);  // reuses the 64-byte free tail
+  assert(p2 == 0);
+  assert(b.Size() == 128);
+}
+
+/* pack_b_im2col's segment emitter against the naive per-element
+ * reference for strided + padded + dilated taps. */
+void test_pack_b_im2col_matches_reference() {
+  const int64_t ICG = 3, H = 7, W = 9, KH = 3, KW = 3;
+  const int64_t sh = 2, sw = 1, ph = 1, pw = 2, dh = 1, dw = 2;
+  const int64_t OH = (H + 2 * ph - dh * (KH - 1) - 1) / sh + 1;
+  const int64_t OW = (W + 2 * pw - dw * (KW - 1) - 1) / sw + 1;
+  const int64_t P = OH * OW, CK = ICG * KH * KW;
+  std::vector<float> x(size_t(ICG * H * W));
+  for (size_t k = 0; k < x.size(); ++k) x[k] = float(k) * 0.25f - 3.f;
+  std::vector<float> packed(size_t(b_pack_size(CK, P)), -9.f);
+  pack_b_im2col<float, float>(x.data(), ICG, H, W, KH, KW, OH, OW, sh, sw,
+                              ph, pw, dh, dw, packed.data());
+  for (int64_t r = 0; r < CK; ++r) {
+    const int64_t ic = r / (KH * KW), kh = (r / KW) % KH, kw = r % KW;
+    for (int64_t p = 0; p < P; ++p) {
+      const int64_t oh = p / OW, ow = p % OW;
+      const int64_t ih = oh * sh - ph + kh * dh;
+      const int64_t iw = ow * sw - pw + kw * dw;
+      const float want = (ih < 0 || ih >= H || iw < 0 || iw >= W)
+                             ? 0.f
+                             : x[size_t((ic * H + ih) * W + iw)];
+      const float got =
+          packed[size_t(((p / NR) * CK + r) * NR + (p % NR))];
+      assert(got == want);
+    }
+  }
+}
+
 }  // namespace
 
 int main() {
@@ -122,6 +256,11 @@ int main() {
   test_bcast_walk_matches_divmod();
   test_check_dims_rejects();
   test_parallel_for_covers_range();
+  test_packed_gemm_fringe_sweep();
+  test_gemm_bias_act_epilogue();
+  test_workpool_two_thread_stress();
+  test_plan_arena_reuses_offsets();
+  test_pack_b_im2col_matches_reference();
   std::printf("ptpu_selftest: all native unit tests passed\n");
   return 0;
 }

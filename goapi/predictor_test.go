@@ -1,6 +1,7 @@
 package ptpu
 
 import (
+	"errors"
 	"math"
 	"os"
 	"testing"
@@ -54,6 +55,78 @@ func TestPredictorRoundTrip(t *testing.T) {
 	for i := range out {
 		if out[i] != out2[i] {
 			t.Fatalf("output not deterministic at %d", i)
+		}
+	}
+}
+
+// Two predictors on two goroutines: the C engine's WorkPool is
+// process-global, and cgo calls run off the Go scheduler's OS threads
+// concurrently — this is exactly the cross-predictor dispatch race the
+// r6 WorkPool fix serializes. Every iteration must reproduce the
+// serial answer bit-for-bit.
+func TestConcurrentPredictors(t *testing.T) {
+	const fixture = "testdata/lin.onnx"
+	if _, err := os.Stat(fixture); err != nil {
+		t.Skipf("fixture %s absent — generate per package docs", fixture)
+	}
+	want := func(x []float32) []float32 {
+		p, err := NewPredictor(fixture)
+		if err != nil {
+			t.Fatal(err)
+		}
+		defer p.Destroy()
+		if err := p.SetInput(p.InputName(0), x, []int64{2, 8}); err != nil {
+			t.Fatal(err)
+		}
+		if err := p.Run(); err != nil {
+			t.Fatal(err)
+		}
+		out, _ := p.Output(0)
+		return out
+	}
+	xs := make([][]float32, 2)
+	wants := make([][]float32, 2)
+	for g := 0; g < 2; g++ {
+		xs[g] = make([]float32, 2*8)
+		for i := range xs[g] {
+			xs[g][i] = float32(i*(g+1)) * 0.0625
+		}
+		wants[g] = want(xs[g])
+	}
+	errs := make(chan error, 2)
+	for g := 0; g < 2; g++ {
+		go func(g int) {
+			p, err := NewPredictor(fixture)
+			if err != nil {
+				errs <- err
+				return
+			}
+			defer p.Destroy()
+			for it := 0; it < 100; it++ {
+				if err := p.SetInput(p.InputName(0), xs[g],
+					[]int64{2, 8}); err != nil {
+					errs <- err
+					return
+				}
+				if err := p.Run(); err != nil {
+					errs <- err
+					return
+				}
+				out, _ := p.Output(0)
+				for i := range out {
+					if out[i] != wants[g][i] {
+						errs <- errors.New("concurrent run diverged " +
+							"from serial result")
+						return
+					}
+				}
+			}
+			errs <- nil
+		}(g)
+	}
+	for g := 0; g < 2; g++ {
+		if err := <-errs; err != nil {
+			t.Fatal(err)
 		}
 	}
 }

@@ -284,3 +284,150 @@ def aes_ctr_xcrypt(key: bytes, iv: bytes, data: bytes) -> bytes:
     out = ctypes.create_string_buffer(len(data))
     lib().ptpu_aes_ctr_xcrypt(key, iv, data, out, len(data))
     return out.raw
+
+
+# ---------------------------------------------------------------------------
+# Native predictor binding (csrc/ptpu_predictor.cc — the no-Python C
+# serving engine). This is the Python-side convenience wrapper over the
+# same C ABI the Go binding and the pure-C demo use; tests keep their
+# hand-rolled ctypes to exercise the raw ABI.
+# ---------------------------------------------------------------------------
+
+_PRED_SO = os.path.join(_PKG_DIR, "_native_predictor.so")
+_PRED_LIB: Optional[ctypes.CDLL] = None
+_PRED_LOCK = threading.Lock()
+
+
+def _predictor_lib() -> ctypes.CDLL:
+    global _PRED_LIB
+    with _PRED_LOCK:
+        if _PRED_LIB is not None:
+            return _PRED_LIB
+        lib = ctypes.CDLL(_PRED_SO)
+        c = ctypes
+        lib.ptpu_predictor_create.restype = c.c_void_p
+        lib.ptpu_predictor_create.argtypes = [c.c_char_p, c.c_char_p,
+                                              c.c_int]
+        lib.ptpu_predictor_destroy.argtypes = [c.c_void_p]
+        lib.ptpu_predictor_num_inputs.argtypes = [c.c_void_p]
+        lib.ptpu_predictor_num_outputs.argtypes = [c.c_void_p]
+        lib.ptpu_predictor_num_nodes.argtypes = [c.c_void_p]
+        lib.ptpu_predictor_fused_nodes.argtypes = [c.c_void_p]
+        lib.ptpu_predictor_arena_bytes.restype = c.c_int64
+        lib.ptpu_predictor_arena_bytes.argtypes = [c.c_void_p]
+        lib.ptpu_predictor_input_name.restype = c.c_char_p
+        lib.ptpu_predictor_input_name.argtypes = [c.c_void_p, c.c_int]
+        lib.ptpu_predictor_set_input.argtypes = [
+            c.c_void_p, c.c_char_p, c.POINTER(c.c_float),
+            c.POINTER(c.c_int64), c.c_int, c.c_char_p, c.c_int]
+        lib.ptpu_predictor_set_input_i32.argtypes = [
+            c.c_void_p, c.c_char_p, c.POINTER(c.c_int32),
+            c.POINTER(c.c_int64), c.c_int, c.c_char_p, c.c_int]
+        lib.ptpu_predictor_set_input_i64.argtypes = [
+            c.c_void_p, c.c_char_p, c.POINTER(c.c_int64),
+            c.POINTER(c.c_int64), c.c_int, c.c_char_p, c.c_int]
+        lib.ptpu_predictor_run.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+        lib.ptpu_predictor_output_ndim.argtypes = [c.c_void_p, c.c_int]
+        lib.ptpu_predictor_output_dims.restype = c.POINTER(c.c_int64)
+        lib.ptpu_predictor_output_dims.argtypes = [c.c_void_p, c.c_int]
+        lib.ptpu_predictor_output_data.restype = c.POINTER(c.c_float)
+        lib.ptpu_predictor_output_data.argtypes = [c.c_void_p, c.c_int]
+        _PRED_LIB = lib
+        return lib
+
+
+class NativePredictor:
+    """One loaded artifact. Thread-compatible: one instance per thread
+    (concurrent instances are safe — the engine serializes its worker
+    pool dispatches internally)."""
+
+    def __init__(self, model_path: str):
+        import numpy as np  # local: keep module import light
+        self._np = np
+        self._lib = _predictor_lib()
+        self._err = ctypes.create_string_buffer(512)
+        self._h = self._lib.ptpu_predictor_create(
+            model_path.encode(), self._err, 512)
+        if not self._h:
+            raise RuntimeError("ptpu_predictor_create: " +
+                               self._err.value.decode())
+
+    def close(self):
+        if self._h:
+            self._lib.ptpu_predictor_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:   # interpreter teardown
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _handle(self):
+        # a NULL handle would segfault inside the C library; fail here
+        if self._h is None:
+            raise RuntimeError("NativePredictor is closed")
+        return self._h
+
+    # load-time optimization introspection
+    @property
+    def num_nodes(self) -> int:
+        return self._lib.ptpu_predictor_num_nodes(self._handle())
+
+    @property
+    def fused_nodes(self) -> int:
+        return self._lib.ptpu_predictor_fused_nodes(self._handle())
+
+    @property
+    def arena_bytes(self) -> int:
+        """Planned serving arena size; 0 when shapes were dynamic and
+        the engine fell back to per-tensor allocation."""
+        return self._lib.ptpu_predictor_arena_bytes(self._handle())
+
+    def input_name(self, i: int = 0) -> str:
+        return self._lib.ptpu_predictor_input_name(self._handle(),
+                                                   i).decode()
+
+    def set_input(self, name: str, arr) -> None:
+        np = self._np
+        c = ctypes
+        arr = np.ascontiguousarray(arr)
+        dims = (c.c_int64 * arr.ndim)(*arr.shape)
+        if arr.dtype == np.float32:
+            rc = self._lib.ptpu_predictor_set_input(
+                self._handle(), name.encode(),
+                arr.ctypes.data_as(c.POINTER(c.c_float)), dims, arr.ndim,
+                self._err, 512)
+        elif arr.dtype == np.int32:
+            rc = self._lib.ptpu_predictor_set_input_i32(
+                self._handle(), name.encode(),
+                arr.ctypes.data_as(c.POINTER(c.c_int32)), dims, arr.ndim,
+                self._err, 512)
+        elif arr.dtype == np.int64:
+            rc = self._lib.ptpu_predictor_set_input_i64(
+                self._handle(), name.encode(),
+                arr.ctypes.data_as(c.POINTER(c.c_int64)), dims, arr.ndim,
+                self._err, 512)
+        else:
+            raise TypeError(f"unsupported input dtype {arr.dtype}")
+        if rc != 0:
+            raise RuntimeError("set_input: " + self._err.value.decode())
+
+    def run(self) -> None:
+        if self._lib.ptpu_predictor_run(self._handle(), self._err, 512) != 0:
+            raise RuntimeError("run: " + self._err.value.decode())
+
+    def output(self, i: int = 0):
+        np = self._np
+        nd = self._lib.ptpu_predictor_output_ndim(self._handle(), i)
+        dims = self._lib.ptpu_predictor_output_dims(self._handle(), i)
+        shape = tuple(dims[k] for k in range(nd))
+        data = self._lib.ptpu_predictor_output_data(self._handle(), i)
+        n = int(np.prod(shape)) if shape else 1
+        return np.ctypeslib.as_array(data, shape=(n,)).reshape(shape).copy()

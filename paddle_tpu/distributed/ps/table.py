@@ -200,16 +200,23 @@ class TableService:
                     # heterogeneous split training (reference:
                     # heter_client/server.cc): run a registered function
                     # (e.g. the jitted dense step on the device owner)
-                    # on behalf of a CPU-side worker
+                    # on behalf of a CPU-side worker. Failures travel as
+                    # a STRUCTURED ('err', kind, msg) tuple — the client
+                    # dispatches on `kind`, never on message prefixes (a
+                    # registered fn whose error text happens to start
+                    # with "KeyError: heter fn" must stay a plain
+                    # remote-failure, not an unregistered-fn KeyError)
                     fn = self._heter_fns.get(table)
                     if fn is None:
-                        send_msg(conn, ("err", f"KeyError: heter fn "
-                                            f"{table!r} not registered"))
+                        send_msg(conn, ("err", "unregistered",
+                                        f"heter fn {table!r} not "
+                                        f"registered on rank "
+                                        f"{self.rank}"))
                     else:
                         try:
                             send_msg(conn, ("ok", fn(*payload)))
                         except Exception as e:  # noqa: BLE001
-                            send_msg(conn, ("err", repr(e)))
+                            send_msg(conn, ("err", "exception", repr(e)))
         finally:
             try:
                 conn.close()
@@ -362,19 +369,17 @@ class TableService:
         if peer == self.rank:
             return self._heter_fns[name](*args)
         res = self._rpc(peer, "heter_call", name, args)
-        status, payload = res
-        if status != "ok":
-            # preserve the pre-binary-wire contract: unregistered fn
-            # surfaced as KeyError (the server used to ship the
-            # exception object itself; the wire now moves data only).
-            # Match the exact server sentinel — a KeyError raised
-            # INSIDE a registered fn reprs as "KeyError('...')" and
-            # must stay a RuntimeError like any other fn failure
-            if payload.startswith("KeyError: heter fn"):
-                raise KeyError(payload)
+        if res[0] != "ok":
+            # structured status: ('err', kind, msg). Dispatch on the
+            # explicit kind — the pre-r6 contract matched the string
+            # prefix "KeyError: heter fn", which misclassified any
+            # registered fn failing with that exact message text
+            _, kind, msg = res
+            if kind == "unregistered":
+                raise KeyError(msg)
             raise RuntimeError(f"heter_call {name!r} on rank {peer} "
-                               f"failed: {payload}")
-        return payload
+                               f"failed: {msg}")
+        return res[1]
 
     # ---- KV store (rank 0 hosts; reference: gloo HTTP-KV / etcd) --------
 

@@ -11,6 +11,12 @@ construction, so a malicious peer can at worst deliver wrong data, not
 code execution. Connection-level auth stays the
 multiprocessing.connection HMAC challenge (authkey) underneath.
 
+Every frame leads with a one-byte PROTOCOL VERSION (WIRE_VERSION): a
+mixed-version cluster (old pickle peer or a future layout change)
+fails immediately with an explicit version-mismatch error instead of
+opaque malformed-frame drops mid-training.
+
+Layout per frame: 1-byte version, then one value.
 Layout per value: 1-byte tag, then
   INT    int64-LE            FLOAT  float64-LE
   STR    u32 len + utf-8     BYTES  u32 len + raw
@@ -27,6 +33,8 @@ import struct
 from typing import Any
 
 import numpy as np
+
+WIRE_VERSION = 1
 
 _T_NONE = 0
 _T_TRUE = 1
@@ -97,7 +105,7 @@ def _pack(obj: Any, out: list) -> None:
 
 
 def dumps(obj: Any) -> bytes:
-    out: list = []
+    out: list = [bytes([WIRE_VERSION])]
     _pack(obj, out)
     return b"".join(out)
 
@@ -162,8 +170,18 @@ def _unpack(buf: memoryview, off: int):
 
 
 def loads(data: bytes) -> Any:
+    if not data:
+        raise ValueError("PS wire: empty frame")
+    if data[0] != WIRE_VERSION:
+        # the FIRST check: a peer speaking another protocol revision
+        # (or the pre-version pickle wire) must fail with an explicit,
+        # actionable error, not a tag-decoding surprise further in
+        raise ValueError(
+            f"PS wire: protocol version mismatch (got {data[0]}, "
+            f"expected {WIRE_VERSION}) — all ranks must run the same "
+            f"paddle_tpu wire revision")
     try:
-        obj, off = _unpack(memoryview(data), 0)
+        obj, off = _unpack(memoryview(data), 1)
     except ValueError:
         raise
     except Exception as e:  # noqa: BLE001 — uniform protocol-error type

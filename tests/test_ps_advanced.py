@@ -85,6 +85,47 @@ class TestHeterSplitTraining:
         assert svc.heter_call(0, "f", 21) == 42
         svc.finalize()
 
+    def test_heter_wire_status_kinds(self):
+        """r6: the heter wire ships a structured ('err', kind, msg)
+        status. An unregistered fn surfaces as KeyError; a REGISTERED
+        fn that fails — even with a message spoofing the old
+        'KeyError: heter fn' prefix — stays a RuntimeError."""
+        s0 = TableService(0, 2, port_base=9610)
+        s1 = TableService(1, 2, port_base=9610)
+        try:
+            s0.register_heter_fn("ok", lambda a: a + 1)
+            s0.register_heter_fn(
+                "boom", lambda: (_ for _ in ()).throw(
+                    RuntimeError("KeyError: heter fn spoof")))
+            # remote success
+            assert s1.heter_call(0, "ok", 41) == 42
+            # remote unregistered -> KeyError with the fn name
+            with pytest.raises(KeyError, match="nope"):
+                s1.heter_call(0, "nope")
+            # remote fn failure with a spoofed prefix -> RuntimeError
+            with pytest.raises(RuntimeError, match="spoof"):
+                s1.heter_call(0, "boom")
+        finally:
+            s0.finalize()
+            s1.finalize()
+
+    def test_wire_protocol_version_mismatch(self):
+        """r6: every frame leads with a protocol version byte; a frame
+        from another revision fails loudly and explicitly."""
+        from paddle_tpu.distributed.ps import wire
+
+        frame = wire.dumps(("pull", "t", 123))
+        assert frame[0] == wire.WIRE_VERSION
+        assert wire.loads(frame) == ("pull", "t", 123)
+        bad = bytes([wire.WIRE_VERSION + 1]) + frame[1:]
+        with pytest.raises(ValueError, match="version mismatch"):
+            wire.loads(bad)
+        # a pre-version pickle frame starts with protocol-2 opcode 0x80
+        with pytest.raises(ValueError, match="version mismatch"):
+            wire.loads(b"\x80\x04\x95")
+        with pytest.raises(ValueError, match="empty"):
+            wire.loads(b"")
+
     def test_two_rank_heter_training_loss_decreases(self, tmp_path):
         import json
         import os
