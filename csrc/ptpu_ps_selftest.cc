@@ -1,0 +1,326 @@
+// Native unit tests for the PS shard table + data-plane server TUs —
+// the cc_test analogue (same harness idiom as ptpu_selftest.cc: plain
+// asserts, exit 0 = pass; wrapped by tests/test_native_selftest.py via
+// `make selftest`).
+#include "ptpu_ps_server.cc"
+#include "ptpu_ps_table.cc"
+
+// asserts ARE the test — never compile them out, even under a
+// release-style CXXFLAGS override carrying -DNDEBUG
+#undef NDEBUG
+#include <atomic>
+#include <cassert>
+#include <cstdio>
+#include <random>
+#include <thread>
+
+namespace {
+
+constexpr float kTol = 1e-5f;
+
+bool close(float a, float b, float tol = kTol) {
+  return std::fabs(a - b) <= tol * (1.f + std::fabs(b));
+}
+
+void fill_random(void *h, std::mt19937 &rng) {
+  auto *t = static_cast<PsTable *>(h);
+  std::uniform_real_distribution<float> d(-1.f, 1.f);
+  for (int64_t i = 0; i < t->rows * t->dim; ++i) t->w[i] = d(rng);
+}
+
+void test_pull_gathers_rows() {
+  void *h = ptpu_ps_table_create(8, 3, PTPU_PS_SGD, 0.1f, 0, 0, 0);
+  assert(h);
+  float *w = ptpu_ps_table_data(h);
+  for (int64_t i = 0; i < 8 * 3; ++i) w[i] = float(i);
+  const int64_t ids[4] = {7, 0, 3, 7};
+  float out[12];
+  assert(ptpu_ps_table_pull(h, ids, 4, out) == 0);
+  for (int64_t i = 0; i < 4; ++i)
+    for (int64_t d = 0; d < 3; ++d)
+      assert(out[i * 3 + d] == float(ids[i] * 3 + d));
+  ptpu_ps_table_destroy(h);
+}
+
+void test_pull_bounds_checked() {
+  void *h = ptpu_ps_table_create(4, 2, PTPU_PS_SGD, 0.1f, 0, 0, 0);
+  const int64_t bad_hi[1] = {4}, bad_lo[1] = {-1};
+  float out[2];
+  assert(ptpu_ps_table_pull(h, bad_hi, 1, out) == -1);
+  assert(std::string(ptpu_ps_last_error()).find("out of range") !=
+         std::string::npos);
+  assert(ptpu_ps_table_pull(h, bad_lo, 1, out) == -1);
+  assert(ptpu_ps_table_push(h, bad_hi, 1, out) == -1);
+  ptpu_ps_table_destroy(h);
+}
+
+void test_push_sgd_coalesces_duplicates() {
+  void *h = ptpu_ps_table_create(6, 2, PTPU_PS_SGD, 0.5f, 0, 0, 0);
+  auto *t = static_cast<PsTable *>(h);
+  for (int64_t i = 0; i < 12; ++i) t->w[i] = 1.f;
+  // row 2 hit twice: grads accumulate BEFORE the single update
+  const int64_t ids[3] = {2, 4, 2};
+  const float g[6] = {1.f, 0.f, 3.f, 3.f, 0.5f, 0.5f};
+  assert(ptpu_ps_table_push(h, ids, 3, g) == 0);
+  assert(close(t->w[2 * 2 + 0], 1.f - 0.5f * 1.5f));
+  assert(close(t->w[2 * 2 + 1], 1.f - 0.5f * 0.5f));
+  assert(close(t->w[4 * 2 + 0], 1.f - 0.5f * 3.f));
+  assert(close(t->w[4 * 2 + 1], 1.f - 0.5f * 3.f));
+  assert(close(t->w[0], 1.f));  // untouched row
+  ptpu_ps_table_destroy(h);
+}
+
+void test_push_adagrad_matches_reference() {
+  const float lr = 0.3f, eps = 1e-8f;
+  void *h = ptpu_ps_table_create(4, 2, PTPU_PS_ADAGRAD, lr, 0, 0, eps);
+  auto *t = static_cast<PsTable *>(h);
+  std::mt19937 rng(7);
+  fill_random(h, rng);
+  float w0[2] = {t->w[2], t->w[3]};  // row 1
+  float g2ref[2] = {0.f, 0.f};
+  const int64_t ids[1] = {1};
+  for (int step = 0; step < 3; ++step) {
+    const float g[2] = {0.5f + step, -0.25f};
+    assert(ptpu_ps_table_push(h, ids, 1, g) == 0);
+    for (int d = 0; d < 2; ++d) {
+      g2ref[d] += g[d] * g[d];
+      w0[d] -= lr * g[d] / (std::sqrt(g2ref[d]) + eps);
+    }
+  }
+  assert(close(t->w[2], w0[0]) && close(t->w[3], w0[1]));
+  ptpu_ps_table_destroy(h);
+}
+
+void test_push_adam_per_row_step() {
+  const float lr = 0.1f, b1 = 0.9f, b2 = 0.999f, eps = 1e-8f;
+  void *h = ptpu_ps_table_create(4, 1, PTPU_PS_ADAM, lr, b1, b2, eps);
+  auto *t = static_cast<PsTable *>(h);
+  t->w[0] = 1.f;
+  t->w[2] = 1.f;
+  // row 0 updated twice, row 2 once — row 2's bias correction must use
+  // ITS step count (1), not a global one
+  const int64_t id0[1] = {0}, id2[1] = {2};
+  const float g[1] = {0.5f};
+  float m = 0.f, v = 0.f, w = 1.f;
+  for (int step = 1; step <= 2; ++step) {
+    assert(ptpu_ps_table_push(h, id0, 1, g) == 0);
+    m = b1 * m + (1 - b1) * g[0];
+    v = b2 * v + (1 - b2) * g[0] * g[0];
+    const float mhat = m / (1 - std::pow(b1, float(step)));
+    const float vhat = v / (1 - std::pow(b2, float(step)));
+    w -= lr * mhat / (std::sqrt(vhat) + eps);
+  }
+  assert(close(t->w[0], w));
+  assert(ptpu_ps_table_push(h, id2, 1, g) == 0);
+  const float mhat1 = ((1 - b1) * g[0]) / (1 - b1);
+  const float vhat1 = ((1 - b2) * g[0] * g[0]) / (1 - b2);
+  assert(close(t->w[2], 1.f - lr * mhat1 / (std::sqrt(vhat1) + eps)));
+  assert(t->steps[0] == 2 && t->steps[2] == 1 && t->steps[1] == 0);
+  ptpu_ps_table_destroy(h);
+}
+
+void test_arena_layout_disjoint() {
+  // PlanArena must hand out non-overlapping, aligned regions inside
+  // the one block
+  void *h = ptpu_ps_table_create(16, 8, PTPU_PS_ADAM, 0.1f, 0.9f,
+                                 0.999f, 1e-8f);
+  auto *t = static_cast<PsTable *>(h);
+  const size_t wn = 16 * 8 * sizeof(float);
+  auto b = [&](void *p) { return reinterpret_cast<char *>(p); };
+  assert(b(t->w) >= t->base && b(t->w) + wn <= t->base + t->bytes);
+  assert(b(t->slot0) >= b(t->w) + wn || b(t->w) >= b(t->slot0) + wn);
+  assert(b(t->slot1) >= t->base && b(t->slot1) + wn <= t->base + t->bytes);
+  assert(reinterpret_cast<uintptr_t>(t->w) % 64 == 0 ||
+         reinterpret_cast<uintptr_t>(t->base) % 64 != 0);
+  ptpu_ps_table_destroy(h);
+}
+
+void test_concurrent_pulls_and_push() {
+  // shared-lock pulls racing an exclusive-lock push: every pulled row
+  // must be either the before or the after value, never a torn mix
+  const int64_t rows = 64, dim = 16;
+  void *h = ptpu_ps_table_create(rows, dim, PTPU_PS_SGD, 1.f, 0, 0, 0);
+  auto *t = static_cast<PsTable *>(h);
+  for (int64_t i = 0; i < rows * dim; ++i) t->w[i] = 1.f;
+  std::vector<int64_t> all(rows);
+  for (int64_t i = 0; i < rows; ++i) all[i] = i;
+  std::vector<float> ones(size_t(rows) * dim, 1.f);
+
+  std::atomic<bool> bad{false};
+  auto puller = [&]() {
+    std::vector<float> out(size_t(rows) * dim);
+    for (int it = 0; it < 200; ++it) {
+      if (ptpu_ps_table_pull(h, all.data(), rows, out.data()) != 0) {
+        bad = true;
+        return;
+      }
+      for (int64_t r = 0; r < rows; ++r) {
+        const float first = out[r * dim];
+        for (int64_t d = 1; d < dim; ++d)
+          if (out[r * dim + d] != first) {  // torn row
+            bad = true;
+            return;
+          }
+      }
+    }
+  };
+  std::thread p1(puller), p2(puller);
+  for (int it = 0; it < 200; ++it)
+    assert(ptpu_ps_table_push(h, all.data(), rows, ones.data()) == 0);
+  p1.join();
+  p2.join();
+  assert(!bad.load());
+  // 200 pushes of grad 1 at lr 1: every weight is 1 - 200
+  for (int64_t i = 0; i < rows * dim; ++i) assert(t->w[i] == -199.f);
+  ptpu_ps_table_destroy(h);
+}
+
+void test_create_rejects_bad_args() {
+  assert(ptpu_ps_table_create(0, 4, PTPU_PS_SGD, 0.1f, 0, 0, 0) ==
+         nullptr);
+  assert(ptpu_ps_table_create(4, 4, 99, 0.1f, 0, 0, 0) == nullptr);
+}
+
+// ---- data-plane server (ptpu_ps_server.cc) ------------------------------
+
+void test_sha256_known_vector() {
+  // FIPS 180-2 "abc"
+  Sha256 s;
+  s.Update(reinterpret_cast<const uint8_t *>("abc"), 3);
+  uint8_t out[32];
+  s.Final(out);
+  const uint8_t want[32] = {
+      0xba, 0x78, 0x16, 0xbf, 0x8f, 0x01, 0xcf, 0xea, 0x41, 0x41, 0x40,
+      0xde, 0x5d, 0xae, 0x22, 0x23, 0xb0, 0x03, 0x61, 0xa3, 0x96, 0x17,
+      0x7a, 0x9c, 0xb4, 0x10, 0xff, 0x61, 0xf2, 0x00, 0x15, 0xad};
+  assert(std::memcmp(out, want, 32) == 0);
+  // RFC 4231 test case 2: HMAC-SHA256("Jefe", "what do ya want ...")
+  uint8_t mac[32];
+  const char *key = "Jefe";
+  const char *msg = "what do ya want for nothing?";
+  HmacSha256(reinterpret_cast<const uint8_t *>(key), 4,
+             reinterpret_cast<const uint8_t *>(msg), std::strlen(msg),
+             mac);
+  const uint8_t want2[8] = {0x5b, 0xdc, 0xc1, 0x46,
+                            0xbf, 0x60, 0x75, 0x4e};
+  assert(std::memcmp(mac, want2, 8) == 0);
+}
+
+int dial(int port) {
+  const int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  assert(fd >= 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(uint16_t(port));
+  assert(::connect(fd, reinterpret_cast<sockaddr *>(&addr),
+                   sizeof(addr)) == 0);
+  return fd;
+}
+
+bool client_handshake(int fd, const std::string &key) {
+  uint8_t nonce[16];
+  if (!ReadExact(fd, nonce, 16)) return false;
+  uint8_t mac[32];
+  HmacSha256(reinterpret_cast<const uint8_t *>(key.data()), key.size(),
+             nonce, 16, mac);
+  const uint8_t lenb[4] = {32, 0, 0, 0};
+  if (!WriteExact(fd, lenb, 4) || !WriteExact(fd, mac, 32)) return false;
+  uint8_t ok = 0;
+  return ReadExact(fd, &ok, 1) && ok == 0x01;
+}
+
+void send_client_frame(int fd, const std::vector<uint8_t> &payload) {
+  const uint32_t n = uint32_t(payload.size());
+  const uint8_t lenb[4] = {uint8_t(n), uint8_t(n >> 8), uint8_t(n >> 16),
+                           uint8_t(n >> 24)};
+  assert(WriteExact(fd, lenb, 4));
+  assert(WriteExact(fd, payload.data(), n));
+}
+
+std::vector<uint8_t> recv_client_frame(int fd) {
+  uint8_t lenb[4];
+  assert(ReadExact(fd, lenb, 4));
+  const uint32_t n = uint32_t(lenb[0]) | uint32_t(lenb[1]) << 8 |
+                     uint32_t(lenb[2]) << 16 | uint32_t(lenb[3]) << 24;
+  std::vector<uint8_t> out(n);
+  assert(ReadExact(fd, out.data(), n));
+  return out;
+}
+
+void test_server_pull_push_roundtrip() {
+  void *t = ptpu_ps_table_create(8, 2, PTPU_PS_SGD, 1.f, 0, 0, 0);
+  auto *pt = static_cast<PsTable *>(t);
+  for (int64_t i = 0; i < 16; ++i) pt->w[i] = float(i);
+  void *srv = ptpu_ps_server_start(0, "k3y", 3, /*loopback_only=*/1);
+  assert(srv);
+  // shard offset lo=100: the server must translate global->local ids
+  assert(ptpu_ps_server_register(srv, "emb", t, 100) == 0);
+  const int port = ptpu_ps_server_port(srv);
+  assert(port > 0);
+
+  const int fd = dial(port);
+  assert(client_handshake(fd, "k3y"));
+
+  // PULL_REQ for global ids {103, 100}
+  std::vector<uint8_t> req = {1, 0x50, 3, 'e', 'm', 'b', 2, 0, 0, 0};
+  const int64_t gids[2] = {103, 100};
+  const auto *gb = reinterpret_cast<const uint8_t *>(gids);
+  req.insert(req.end(), gb, gb + 16);
+  send_client_frame(fd, req);
+  auto rep = recv_client_frame(fd);
+  assert(rep.size() == 10 + 2 * 2 * 4 && rep[1] == 0x51);
+  const auto *rows = reinterpret_cast<const float *>(rep.data() + 10);
+  assert(rows[0] == 6.f && rows[1] == 7.f);  // row 3
+  assert(rows[2] == 0.f && rows[3] == 1.f);  // row 0
+
+  // PUSH_REQ: grad 1 to global id 103 twice (coalesced, lr=1)
+  std::vector<uint8_t> push = {1, 0x52, 3, 'e', 'm', 'b',
+                               0,                 // flags
+                               2, 0, 0, 0,        // n
+                               2, 0, 0, 0};       // dim
+  push.insert(push.end(), gb, gb + 8);            // id 103
+  push.insert(push.end(), gb, gb + 8);            // id 103 again
+  const float g[4] = {1.f, 0.5f, 2.f, 0.25f};
+  const auto *gp = reinterpret_cast<const uint8_t *>(g);
+  push.insert(push.end(), gp, gp + 16);
+  send_client_frame(fd, push);
+  auto ok = recv_client_frame(fd);
+  assert(ok.size() == 2 && ok[1] == 0x53);
+  assert(pt->w[6] == 6.f - 3.f && pt->w[7] == 7.f - 0.75f);
+
+  // unknown table -> ERR frame, connection stays usable
+  std::vector<uint8_t> bad = {1, 0x50, 2, 'n', 'o', 1, 0, 0, 0};
+  bad.insert(bad.end(), gb, gb + 8);
+  send_client_frame(fd, bad);
+  auto err = recv_client_frame(fd);
+  assert(err.size() >= 2 && err[1] == 0x54);
+  send_client_frame(fd, req);
+  assert(recv_client_frame(fd)[1] == 0x51);
+
+  ::close(fd);
+  // bad authkey must be rejected
+  const int fd2 = dial(port);
+  assert(!client_handshake(fd2, "wrong"));
+  ::close(fd2);
+
+  ptpu_ps_server_stop(srv);
+  ptpu_ps_table_destroy(t);
+}
+
+}  // namespace
+
+int main() {
+  test_pull_gathers_rows();
+  test_pull_bounds_checked();
+  test_push_sgd_coalesces_duplicates();
+  test_push_adagrad_matches_reference();
+  test_push_adam_per_row_step();
+  test_arena_layout_disjoint();
+  test_concurrent_pulls_and_push();
+  test_create_rejects_bad_args();
+  test_sha256_known_vector();
+  test_server_pull_push_roundtrip();
+  std::printf("all native ps-table unit tests passed\n");
+  return 0;
+}

@@ -1,0 +1,580 @@
+// C-hosted PS data-plane server — the wire half of the native PS hot
+// path (csrc/ptpu_ps_table.cc holds the storage half).
+//
+// Reference counterpart: the brpc service loop of
+// distributed/service/brpc_ps_server.cc — request parsing, the table
+// gather/scatter, and the reply write all happen in C++ worker
+// threads; Python never touches a hot frame. The Python TableService
+// keeps the CONTROL plane (kv store, barriers, shuffle, heter calls)
+// on its multiprocessing.connection listener and advertises this
+// data-plane port for pull/push only.
+//
+// Protocol (mirrors distributed/ps/wire.py fast frames):
+//   * connect: server sends a 16-byte random nonce; the client answers
+//     with one frame containing HMAC-SHA256(authkey, nonce); server
+//     replies one byte 0x01 and the session is open (the
+//     multiprocessing.connection HMAC challenge, restated for a C peer
+//     that cannot speak Python's banner format).
+//   * frames: u32-LE length prefix + payload in BOTH directions. The
+//     payload is exactly a wire.py fast frame: version byte, tag byte
+//     (0x50 PULL_REQ / 0x52 PUSH_REQ in; 0x51 PULL_REP / 0x53 OK /
+//     0x54 ERR out), fixed little-endian layout.
+//   * pull replies are gathered straight into the connection's reused
+//     reply buffer — zero per-frame allocation in steady state.
+//
+// Concurrency: one detached-joinable thread per accepted connection
+// (the brpc worker-pool analogue): a slow client stalls only its own
+// socket. Table access synchronizes inside ptpu_ps_table.cc (shared
+// lock pulls / exclusive pushes).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "ptpu_ps_table.h"
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// SHA-256 + HMAC (public-domain-style compact implementation) — the
+// connect handshake MAC. Self-contained so the PS .so has no deps.
+// ---------------------------------------------------------------------------
+
+struct Sha256 {
+  uint32_t h[8];
+  uint64_t len = 0;
+  uint8_t buf[64];
+  size_t buf_n = 0;
+
+  Sha256() {
+    static const uint32_t init[8] = {
+        0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+        0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+    std::memcpy(h, init, sizeof(h));
+  }
+
+  static uint32_t Rotr(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+  }
+
+  void Block(const uint8_t *p) {
+    static const uint32_t k[64] = {
+        0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+        0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+        0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+        0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+        0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+        0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+        0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+        0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+        0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+        0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+        0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+        0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+        0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+    uint32_t w[64];
+    for (int i = 0; i < 16; ++i)
+      w[i] = uint32_t(p[4 * i]) << 24 | uint32_t(p[4 * i + 1]) << 16 |
+             uint32_t(p[4 * i + 2]) << 8 | p[4 * i + 3];
+    for (int i = 16; i < 64; ++i) {
+      const uint32_t s0 =
+          Rotr(w[i - 15], 7) ^ Rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      const uint32_t s1 =
+          Rotr(w[i - 2], 17) ^ Rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; ++i) {
+      const uint32_t s1 = Rotr(e, 6) ^ Rotr(e, 11) ^ Rotr(e, 25);
+      const uint32_t ch = (e & f) ^ (~e & g);
+      const uint32_t t1 = hh + s1 + ch + k[i] + w[i];
+      const uint32_t s0 = Rotr(a, 2) ^ Rotr(a, 13) ^ Rotr(a, 22);
+      const uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      const uint32_t t2 = s0 + maj;
+      hh = g;
+      g = f;
+      f = e;
+      e = d + t1;
+      d = c;
+      c = b;
+      b = a;
+      a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void Update(const uint8_t *p, size_t n) {
+    len += n;
+    while (n) {
+      const size_t take = std::min(n, sizeof(buf) - buf_n);
+      std::memcpy(buf + buf_n, p, take);
+      buf_n += take;
+      p += take;
+      n -= take;
+      if (buf_n == 64) {
+        Block(buf);
+        buf_n = 0;
+      }
+    }
+  }
+
+  void Final(uint8_t out[32]) {
+    const uint64_t bits = len * 8;
+    const uint8_t one = 0x80, zero = 0;
+    Update(&one, 1);
+    while (buf_n != 56) Update(&zero, 1);
+    uint8_t lenb[8];
+    for (int i = 0; i < 8; ++i) lenb[i] = uint8_t(bits >> (56 - 8 * i));
+    Update(lenb, 8);
+    for (int i = 0; i < 8; ++i) {
+      out[4 * i] = uint8_t(h[i] >> 24);
+      out[4 * i + 1] = uint8_t(h[i] >> 16);
+      out[4 * i + 2] = uint8_t(h[i] >> 8);
+      out[4 * i + 3] = uint8_t(h[i]);
+    }
+  }
+};
+
+void HmacSha256(const uint8_t *key, size_t key_n, const uint8_t *msg,
+                size_t msg_n, uint8_t out[32]) {
+  uint8_t k[64] = {0};
+  if (key_n > 64) {
+    Sha256 s;
+    s.Update(key, key_n);
+    s.Final(k);
+  } else {
+    std::memcpy(k, key, key_n);
+  }
+  uint8_t ipad[64], opad[64];
+  for (int i = 0; i < 64; ++i) {
+    ipad[i] = k[i] ^ 0x36;
+    opad[i] = k[i] ^ 0x5c;
+  }
+  uint8_t inner[32];
+  Sha256 si;
+  si.Update(ipad, 64);
+  si.Update(msg, msg_n);
+  si.Final(inner);
+  Sha256 so;
+  so.Update(opad, 64);
+  so.Update(inner, 32);
+  so.Final(out);
+}
+
+// ---------------------------------------------------------------------------
+// Frame constants — keep in sync with distributed/ps/wire.py.
+// ---------------------------------------------------------------------------
+
+constexpr uint8_t kWireVersion = 1;
+constexpr uint8_t kTagPullReq = 0x50;
+constexpr uint8_t kTagPullRep = 0x51;
+constexpr uint8_t kTagPushReq = 0x52;
+constexpr uint8_t kTagOk = 0x53;
+constexpr uint8_t kTagErr = 0x54;
+constexpr uint32_t kMaxFrame = 1u << 30;
+
+bool ReadExact(int fd, void *p, size_t n) {
+  auto *c = static_cast<char *>(p);
+  while (n) {
+    const ssize_t r = ::read(fd, c, n);
+    if (r <= 0) return false;
+    c += r;
+    n -= size_t(r);
+  }
+  return true;
+}
+
+bool WriteExact(int fd, const void *p, size_t n) {
+  auto *c = static_cast<const char *>(p);
+  while (n) {
+    const ssize_t r = ::write(fd, c, n);
+    if (r <= 0) return false;
+    c += r;
+    n -= size_t(r);
+  }
+  return true;
+}
+
+struct ShardEntry {
+  void *table;
+  int64_t lo;  // global-id offset of this shard's first row
+};
+
+struct PsServer {
+  int listen_fd = -1;
+  int port = 0;
+  std::string authkey;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::mutex mu;  // guards tables + conn bookkeeping
+  std::map<std::string, ShardEntry> tables;
+  std::vector<int> conn_fds;
+  std::vector<std::thread> conn_threads;
+  std::vector<std::thread::id> done_threads;  // finished, join pending
+
+  ~PsServer() { Stop(); }
+
+  void Stop() {
+    if (stop.exchange(true)) return;
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      ::close(listen_fd);
+      listen_fd = -1;
+    }
+    {
+      std::lock_guard<std::mutex> g(mu);
+      for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
+    }
+    if (accept_thread.joinable()) accept_thread.join();
+    std::vector<std::thread> ts;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      ts.swap(conn_threads);
+    }
+    for (auto &t : ts)
+      if (t.joinable()) t.join();
+    {
+      std::lock_guard<std::mutex> g(mu);
+      for (int fd : conn_fds) ::close(fd);
+      conn_fds.clear();
+    }
+  }
+
+  bool SendFrame(int fd, const uint8_t *payload, uint32_t n,
+                 std::vector<uint8_t> *buf) {
+    // one contiguous write: u32-LE length + payload (the payload is
+    // already in *buf with 4 bytes of headroom when buf != null)
+    if (buf) {
+      (*buf)[0] = uint8_t(n);
+      (*buf)[1] = uint8_t(n >> 8);
+      (*buf)[2] = uint8_t(n >> 16);
+      (*buf)[3] = uint8_t(n >> 24);
+      return WriteExact(fd, buf->data(), size_t(n) + 4);
+    }
+    uint8_t hdr[4] = {uint8_t(n), uint8_t(n >> 8), uint8_t(n >> 16),
+                      uint8_t(n >> 24)};
+    return WriteExact(fd, hdr, 4) && WriteExact(fd, payload, n);
+  }
+
+  bool SendErr(int fd, const std::string &msg) {
+    std::vector<uint8_t> f(4 + 2 + 4 + msg.size());
+    f[4] = kWireVersion;
+    f[5] = kTagErr;
+    const uint32_t n = uint32_t(msg.size());
+    f[6] = uint8_t(n);
+    f[7] = uint8_t(n >> 8);
+    f[8] = uint8_t(n >> 16);
+    f[9] = uint8_t(n >> 24);
+    std::memcpy(f.data() + 10, msg.data(), msg.size());
+    return SendFrame(fd, nullptr, uint32_t(f.size() - 4), &f);
+  }
+
+  bool Handshake(int fd) {
+    uint8_t nonce[16];
+    std::random_device rd;
+    for (auto &b : nonce) b = uint8_t(rd());
+    if (!WriteExact(fd, nonce, sizeof(nonce))) return false;
+    uint8_t lenb[4];
+    if (!ReadExact(fd, lenb, 4)) return false;
+    const uint32_t n = uint32_t(lenb[0]) | uint32_t(lenb[1]) << 8 |
+                       uint32_t(lenb[2]) << 16 | uint32_t(lenb[3]) << 24;
+    if (n != 32) return false;
+    uint8_t got[32], want[32];
+    if (!ReadExact(fd, got, 32)) return false;
+    HmacSha256(reinterpret_cast<const uint8_t *>(authkey.data()),
+               authkey.size(), nonce, sizeof(nonce), want);
+    uint8_t diff = 0;  // constant-time compare
+    for (int i = 0; i < 32; ++i) diff |= uint8_t(got[i] ^ want[i]);
+    if (diff) return false;
+    const uint8_t ok = 0x01;
+    return WriteExact(fd, &ok, 1);
+  }
+
+  void Serve(int fd) {
+    std::vector<uint8_t> req;
+    std::vector<uint8_t> rep;  // reused: [4B length][frame payload]
+    std::vector<int64_t> local;
+    if (!Handshake(fd)) return;
+    for (;;) {
+      uint8_t lenb[4];
+      if (!ReadExact(fd, lenb, 4)) return;
+      const uint32_t n = uint32_t(lenb[0]) | uint32_t(lenb[1]) << 8 |
+                         uint32_t(lenb[2]) << 16 |
+                         uint32_t(lenb[3]) << 24;
+      if (n < 2 || n > kMaxFrame) return;
+      if (req.size() < n) req.resize(n);
+      if (!ReadExact(fd, req.data(), n)) return;
+      if (req[0] != kWireVersion) return;
+      const uint8_t tag = req[1];
+      if (tag != kTagPullReq && tag != kTagPushReq) return;
+      // [u8 tlen][table]
+      if (n < 3) return;
+      const uint8_t tlen = req[2];
+      size_t off = 3 + tlen;
+      if (n < off) return;
+      const std::string table(reinterpret_cast<char *>(req.data() + 3),
+                              tlen);
+      ShardEntry entry;
+      {
+        std::lock_guard<std::mutex> g(mu);
+        auto it = tables.find(table);
+        if (it == tables.end()) {
+          if (!SendErr(fd, "unknown table '" + table +
+                               "' on data plane"))
+            return;
+          continue;
+        }
+        entry = it->second;
+      }
+      if (tag == kTagPullReq) {
+        // [u32 n][n x i64 ids]
+        if (n < off + 4) return;
+        uint32_t cnt;
+        std::memcpy(&cnt, req.data() + off, 4);
+        off += 4;
+        if (n != off + 8ull * cnt) return;
+        // bound the REPLY like the request: a small ids frame must not
+        // be able to demand a multi-GB gather allocation
+        if (10 + size_t(cnt) * size_t(ptpu_ps_table_dim(entry.table)) *
+                4 > kMaxFrame) {
+          if (!SendErr(fd, "pull reply would exceed frame limit"))
+            return;
+          continue;
+        }
+        const auto *ids =
+            reinterpret_cast<const int64_t *>(req.data() + off);
+        const int64_t rows = ptpu_ps_table_rows(entry.table);
+        const int64_t dim = ptpu_ps_table_dim(entry.table);
+        const size_t row_b = size_t(dim) * 4;
+        const size_t body = size_t(cnt) * row_b;
+        // reply = length + header + gathered rows in the REUSED
+        // per-connection buffer, shipped with one write. (A
+        // row-pointer writev was tried first — 512 iovecs of 256B
+        // cost more in per-segment kernel overhead than the one
+        // 131KB gather memcpy saves.)
+        if (rep.size() < 14 + body) rep.resize(14 + body);
+        const uint32_t flen = uint32_t(10 + body);
+        rep[0] = uint8_t(flen);
+        rep[1] = uint8_t(flen >> 8);
+        rep[2] = uint8_t(flen >> 16);
+        rep[3] = uint8_t(flen >> 24);
+        rep[4] = kWireVersion;
+        rep[5] = kTagPullRep;
+        std::memcpy(rep.data() + 6, &cnt, 4);
+        const uint32_t d32 = uint32_t(dim);
+        std::memcpy(rep.data() + 10, &d32, 4);
+        float *w = ptpu_ps_table_data(entry.table);
+        auto *out = reinterpret_cast<float *>(rep.data() + 14);
+        bool bad = false;
+        ptpu_ps_table_rdlock(entry.table);
+        for (uint32_t i = 0; i < cnt; ++i) {
+          const int64_t id = ids[i] - entry.lo;
+          if (id < 0 || id >= rows) {
+            bad = true;
+            break;
+          }
+          std::memcpy(out + size_t(i) * dim, w + id * dim, row_b);
+        }
+        ptpu_ps_table_rdunlock(entry.table);
+        if (bad) {
+          if (!SendErr(fd, "pull id out of shard range")) return;
+          continue;
+        }
+        if (!WriteExact(fd, rep.data(), 4 + size_t(flen))) return;
+      } else {
+        // [u8 flags][u32 n][u32 dim][ids][grads]
+        if (n < off + 9) return;
+        const bool is_async = req[off] != 0;
+        (void)is_async;  // C applies inline — ack-after-apply is a
+                         // strictly stronger contract than coalesce
+        uint32_t cnt, d32;
+        std::memcpy(&cnt, req.data() + off + 1, 4);
+        std::memcpy(&d32, req.data() + off + 5, 4);
+        off += 9;
+        if (n != off + 8ull * cnt + 4ull * cnt * d32) return;
+        const int64_t dim = ptpu_ps_table_dim(entry.table);
+        if (cnt == 0) {  // empty push (dim underivable): trivially ok
+          if (rep.size() < 6) rep.resize(6);
+          rep[4] = kWireVersion;
+          rep[5] = kTagOk;
+          if (!SendFrame(fd, nullptr, 2, &rep)) return;
+          continue;
+        }
+        if (int64_t(d32) != dim) {
+          // application error, not a protocol error: the frame parsed
+          // fine — answer like the Python plane instead of hanging up
+          if (!SendErr(fd, "push dim " + std::to_string(d32) +
+                               " != table dim " + std::to_string(dim)))
+            return;
+          continue;
+        }
+        const auto *ids =
+            reinterpret_cast<const int64_t *>(req.data() + off);
+        const auto *grads = reinterpret_cast<const float *>(
+            req.data() + off + 8ull * cnt);
+        if (local.size() < cnt) local.resize(cnt);
+        for (uint32_t i = 0; i < cnt; ++i)
+          local[i] = ids[i] - entry.lo;
+        if (ptpu_ps_table_push(entry.table, local.data(), cnt, grads) !=
+            0) {
+          if (!SendErr(fd, ptpu_ps_last_error())) return;
+          continue;
+        }
+        if (rep.size() < 6) rep.resize(6);
+        rep[4] = kWireVersion;
+        rep[5] = kTagOk;
+        if (!SendFrame(fd, nullptr, 2, &rep)) return;
+      }
+    }
+  }
+
+  // Join threads whose connections have closed — without this, a
+  // long-lived server under connection churn (one Channel per client
+  // phase) accumulates zombie std::threads until Stop().
+  void ReapFinished() {
+    std::vector<std::thread> reap;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      if (done_threads.empty()) return;
+      for (auto it = conn_threads.begin(); it != conn_threads.end();) {
+        const auto tid = it->get_id();
+        if (std::find(done_threads.begin(), done_threads.end(), tid) !=
+            done_threads.end()) {
+          reap.push_back(std::move(*it));
+          it = conn_threads.erase(it);
+        } else {
+          ++it;
+        }
+      }
+      done_threads.clear();
+    }
+    for (auto &t : reap)
+      if (t.joinable()) t.join();
+  }
+
+  void AcceptLoop() {
+    for (;;) {
+      const int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) return;  // listener closed by Stop
+      if (stop.load()) {
+        ::close(fd);
+        return;
+      }
+      ReapFinished();
+      const int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      // deep pipelines keep several MB in flight per connection; a
+      // large send buffer keeps the reply writes from stalling
+      const int buf = 4 << 20;
+      ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+      ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+      std::lock_guard<std::mutex> g(mu);
+      conn_fds.push_back(fd);
+      conn_threads.emplace_back([this, fd]() {
+        // an escaping exception (e.g. bad_alloc on a hostile frame)
+        // would std::terminate the whole process — contain it to this
+        // connection, like the Python plane's drop-on-malformed
+        try {
+          Serve(fd);
+        } catch (...) {
+        }
+        {
+          // prune BEFORE close: once closed, the OS may reuse the fd
+          // number and Stop() must not shutdown an unrelated socket
+          std::lock_guard<std::mutex> g2(mu);
+          conn_fds.erase(
+              std::remove(conn_fds.begin(), conn_fds.end(), fd),
+              conn_fds.end());
+          done_threads.push_back(std::this_thread::get_id());
+        }
+        ::close(fd);
+      });
+    }
+  }
+};
+
+thread_local std::string g_srv_error;
+
+}  // namespace
+
+PTPU_PS_EXPORT const char *ptpu_ps_server_last_error(void) {
+  return g_srv_error.c_str();
+}
+
+// Start the data-plane server on `port` (0 picks a free one;
+// ptpu_ps_server_port reports it). `loopback_only` nonzero binds
+// 127.0.0.1 — single-host jobs must not expose pull/push to the
+// network (the Python control plane makes the same choice). Returns
+// NULL on error.
+PTPU_PS_EXPORT void *ptpu_ps_server_start(int port, const char *authkey,
+                                          int authkey_len,
+                                          int loopback_only) {
+  auto *s = new PsServer();
+  s->authkey.assign(authkey, size_t(authkey_len));
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    g_srv_error = "ptpu_ps_server_start: socket() failed";
+    delete s;
+    return nullptr;
+  }
+  const int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one,
+               sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr =
+      htonl(loopback_only ? INADDR_LOOPBACK : INADDR_ANY);
+  addr.sin_port = htons(uint16_t(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr *>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 64) != 0) {
+    g_srv_error = "ptpu_ps_server_start: bind/listen on port " +
+                  std::to_string(port) + " failed";
+    ::close(s->listen_fd);
+    s->listen_fd = -1;
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(s->listen_fd, reinterpret_cast<sockaddr *>(&addr),
+                &alen);
+  s->port = int(ntohs(addr.sin_port));
+  s->accept_thread = std::thread([s]() { s->AcceptLoop(); });
+  return s;
+}
+
+PTPU_PS_EXPORT int ptpu_ps_server_port(void *h) {
+  return static_cast<PsServer *>(h)->port;
+}
+
+// Expose `table` (a ptpu_ps_table handle) as `name` with global-id
+// offset `lo` — the server subtracts lo before the bounds-checked
+// local gather/scatter.
+PTPU_PS_EXPORT int ptpu_ps_server_register(void *h, const char *name,
+                                           void *table, int64_t lo) {
+  auto *s = static_cast<PsServer *>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  s->tables[name] = ShardEntry{table, lo};
+  return 0;
+}
+
+PTPU_PS_EXPORT void ptpu_ps_server_stop(void *h) {
+  auto *s = static_cast<PsServer *>(h);
+  s->Stop();
+  delete s;
+}

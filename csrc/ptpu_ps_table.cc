@@ -1,0 +1,273 @@
+// Native parameter-server shard table — the C-hosted PS hot path.
+//
+// Reference counterpart: distributed/ps/table/memory_sparse_table.cc +
+// common_dense_table.cc behind the brpc service
+// (distributed/service/brpc_ps_server.cc): row storage lives in the
+// server process, the optimizer runs inside the table on push, and the
+// wire only ever moves contiguous row blocks. The Python table service
+// (paddle_tpu/distributed/ps/table.py) keeps protocol/routing and
+// delegates the per-row work here via ctypes.
+//
+// Layout: one contiguous allocation per shard whose internal offsets
+// (weights, optimizer slots, per-row step counters) are planned by the
+// shared ptpu::PlanArena (csrc/ptpu_arena.h) — the same best-fit
+// machinery the runtime allocator and the predictor's memory planner
+// use. Concurrency: pulls take a shared lock and run in parallel
+// (the table service serves each accepted connection from its own
+// thread); pushes take the exclusive lock.
+
+#include "ptpu_ps_table.h"
+
+#include <cmath>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <new>
+#include <shared_mutex>
+#include <string>
+#include <vector>
+
+#include "ptpu_arena.h"
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string &msg) { g_last_error = msg; }
+
+struct PsTable {
+  int64_t rows = 0;
+  int64_t dim = 0;
+  int optimizer = PTPU_PS_SGD;
+  float lr = 0.1f;
+  float beta1 = 0.9f;
+  float beta2 = 0.999f;
+  float eps = 1e-8f;
+
+  // one arena block; offsets planned by PlanArena
+  char *base = nullptr;
+  uint64_t bytes = 0;
+  float *w = nullptr;        // rows * dim weights
+  float *slot0 = nullptr;    // adagrad g2 / adam m   (rows * dim)
+  float *slot1 = nullptr;    // adam v                (rows * dim)
+  int64_t *steps = nullptr;  // adam per-row step count (rows)
+
+  std::shared_mutex mu;
+
+  // push scratch, reused across calls (guarded by the exclusive lock):
+  // open-addressed id->slot map + first-seen unique list + accumulators
+  std::vector<int64_t> hash_keys;
+  std::vector<int32_t> hash_slots;
+  std::vector<int64_t> uniq;
+  std::vector<float> acc;
+};
+
+// Coalesce duplicate ids: fills t->uniq (first-seen order) and t->acc
+// (per-unique accumulated grads, accumulation following the original
+// occurrence order — the same order np.add.at applies). Returns false
+// on an out-of-range id.
+bool coalesce(PsTable *t, const int64_t *ids, int64_t n,
+              const float *grads) {
+  const int64_t dim = t->dim;
+  uint64_t cap = 16;
+  while (cap < uint64_t(n) * 2) cap <<= 1;
+  t->hash_keys.assign(cap, -1);
+  t->hash_slots.assign(cap, -1);
+  t->uniq.clear();
+  t->acc.clear();
+  const uint64_t mask = cap - 1;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t id = ids[i];
+    if (id < 0 || id >= t->rows) {
+      set_error("ptpu_ps_table_push: id " + std::to_string(id) +
+                " out of range [0, " + std::to_string(t->rows) + ")");
+      return false;
+    }
+    // splitmix-style scramble keeps clustered id ranges from probing
+    uint64_t hpos = (uint64_t(id) * 0x9E3779B97F4A7C15ull) & mask;
+    int32_t slot = -1;
+    for (;;) {
+      const int64_t k = t->hash_keys[hpos];
+      if (k == id) {
+        slot = t->hash_slots[hpos];
+        break;
+      }
+      if (k < 0) {
+        slot = int32_t(t->uniq.size());
+        t->hash_keys[hpos] = id;
+        t->hash_slots[hpos] = slot;
+        t->uniq.push_back(id);
+        t->acc.resize(t->acc.size() + dim, 0.f);
+        break;
+      }
+      hpos = (hpos + 1) & mask;
+    }
+    float *a = t->acc.data() + int64_t(slot) * dim;
+    const float *g = grads + i * dim;
+    for (int64_t d = 0; d < dim; ++d) a[d] += g[d];
+  }
+  return true;
+}
+
+void apply_update(PsTable *t) {
+  const int64_t dim = t->dim;
+  const float lr = t->lr;
+  for (size_t u = 0; u < t->uniq.size(); ++u) {
+    const int64_t row = t->uniq[u];
+    const float *g = t->acc.data() + int64_t(u) * dim;
+    float *w = t->w + row * dim;
+    switch (t->optimizer) {
+      case PTPU_PS_SGD:
+        for (int64_t d = 0; d < dim; ++d) w[d] -= lr * g[d];
+        break;
+      case PTPU_PS_ADAGRAD: {
+        float *g2 = t->slot0 + row * dim;
+        for (int64_t d = 0; d < dim; ++d) {
+          g2[d] += g[d] * g[d];
+          w[d] -= lr * g[d] / (std::sqrt(g2[d]) + t->eps);
+        }
+        break;
+      }
+      case PTPU_PS_ADAM: {
+        // per-row step count — the sparse-Adam contract: a row's bias
+        // correction advances only when the row is touched (reference:
+        // table/sparse_sgd_rule.cc SparseAdamSGDRule)
+        float *m = t->slot0 + row * dim;
+        float *v = t->slot1 + row * dim;
+        const int64_t step = ++t->steps[row];
+        const float bc1 = 1.f - std::pow(t->beta1, float(step));
+        const float bc2 = 1.f - std::pow(t->beta2, float(step));
+        for (int64_t d = 0; d < dim; ++d) {
+          m[d] = t->beta1 * m[d] + (1.f - t->beta1) * g[d];
+          v[d] = t->beta2 * v[d] + (1.f - t->beta2) * g[d] * g[d];
+          const float mhat = m[d] / bc1;
+          const float vhat = v[d] / bc2;
+          w[d] -= lr * mhat / (std::sqrt(vhat) + t->eps);
+        }
+        break;
+      }
+    }
+  }
+}
+
+}  // namespace
+
+PTPU_PS_EXPORT const char *ptpu_ps_last_error(void) {
+  return g_last_error.c_str();
+}
+
+PTPU_PS_EXPORT const char *ptpu_ps_version(void) { return "ptpu-ps-1"; }
+
+PTPU_PS_EXPORT void *ptpu_ps_table_create(int64_t rows, int64_t dim,
+                                          int optimizer, float lr,
+                                          float beta1, float beta2,
+                                          float eps) {
+  if (rows <= 0 || dim <= 0) {
+    set_error("ptpu_ps_table_create: rows and dim must be positive");
+    return nullptr;
+  }
+  if (optimizer < PTPU_PS_SGD || optimizer > PTPU_PS_ADAM) {
+    set_error("ptpu_ps_table_create: unknown optimizer kind " +
+              std::to_string(optimizer));
+    return nullptr;
+  }
+  auto *t = new (std::nothrow) PsTable();
+  if (!t) {
+    set_error("ptpu_ps_table_create: out of memory");
+    return nullptr;
+  }
+  t->rows = rows;
+  t->dim = dim;
+  t->optimizer = optimizer;
+  t->lr = lr;
+  t->beta1 = beta1;
+  t->beta2 = beta2;
+  t->eps = eps;
+
+  // plan the single block: weights + whatever slots the optimizer
+  // needs, 64B-aligned offsets from the shared planner
+  ptpu::PlanArena plan(64);
+  const size_t wn = size_t(rows) * size_t(dim) * sizeof(float);
+  const uint64_t off_w = plan.Alloc(wn);
+  uint64_t off_s0 = 0, off_s1 = 0, off_steps = 0;
+  const bool has_s0 = optimizer != PTPU_PS_SGD;
+  const bool has_s1 = optimizer == PTPU_PS_ADAM;
+  if (has_s0) off_s0 = plan.Alloc(wn);
+  if (has_s1) {
+    off_s1 = plan.Alloc(wn);
+    off_steps = plan.Alloc(size_t(rows) * sizeof(int64_t));
+  }
+  t->bytes = plan.Size();
+  t->base = static_cast<char *>(std::calloc(1, t->bytes));
+  if (!t->base) {
+    set_error("ptpu_ps_table_create: allocation of " +
+              std::to_string(t->bytes) + " bytes failed");
+    delete t;
+    return nullptr;
+  }
+  t->w = reinterpret_cast<float *>(t->base + off_w);
+  if (has_s0) t->slot0 = reinterpret_cast<float *>(t->base + off_s0);
+  if (has_s1) {
+    t->slot1 = reinterpret_cast<float *>(t->base + off_s1);
+    t->steps = reinterpret_cast<int64_t *>(t->base + off_steps);
+  }
+  return t;
+}
+
+PTPU_PS_EXPORT void ptpu_ps_table_destroy(void *h) {
+  auto *t = static_cast<PsTable *>(h);
+  if (!t) return;
+  std::free(t->base);
+  delete t;
+}
+
+PTPU_PS_EXPORT float *ptpu_ps_table_data(void *h) {
+  return static_cast<PsTable *>(h)->w;
+}
+
+PTPU_PS_EXPORT int64_t ptpu_ps_table_rows(void *h) {
+  return static_cast<PsTable *>(h)->rows;
+}
+
+PTPU_PS_EXPORT int64_t ptpu_ps_table_dim(void *h) {
+  return static_cast<PsTable *>(h)->dim;
+}
+
+PTPU_PS_EXPORT uint64_t ptpu_ps_table_bytes(void *h) {
+  return static_cast<PsTable *>(h)->bytes;
+}
+
+PTPU_PS_EXPORT int ptpu_ps_table_pull(void *h, const int64_t *ids,
+                                      int64_t n, float *out) {
+  auto *t = static_cast<PsTable *>(h);
+  const int64_t dim = t->dim;
+  std::shared_lock<std::shared_mutex> lock(t->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t id = ids[i];
+    if (id < 0 || id >= t->rows) {
+      set_error("ptpu_ps_table_pull: id " + std::to_string(id) +
+                " out of range [0, " + std::to_string(t->rows) + ")");
+      return -1;
+    }
+    std::memcpy(out + i * dim, t->w + id * dim, size_t(dim) * sizeof(float));
+  }
+  return 0;
+}
+
+PTPU_PS_EXPORT int ptpu_ps_table_push(void *h, const int64_t *ids,
+                                      int64_t n, const float *grads) {
+  auto *t = static_cast<PsTable *>(h);
+  if (n <= 0) return 0;
+  std::unique_lock<std::shared_mutex> lock(t->mu);
+  if (!coalesce(t, ids, n, grads)) return -1;
+  apply_update(t);
+  return 0;
+}
+
+PTPU_PS_EXPORT void ptpu_ps_table_rdlock(void *h) {
+  static_cast<PsTable *>(h)->mu.lock_shared();
+}
+
+PTPU_PS_EXPORT void ptpu_ps_table_rdunlock(void *h) {
+  static_cast<PsTable *>(h)->mu.unlock_shared();
+}

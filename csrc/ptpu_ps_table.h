@@ -1,0 +1,74 @@
+// C ABI for the native parameter-server shard table
+// (csrc/ptpu_ps_table.cc — the C-hosted PS hot path).
+//
+// Reference counterpart: the brpc PS service's table storage
+// (distributed/ps/table/common_dense_table.cc /
+// common_sparse_table.cc — MemorySparseTable row storage with the
+// optimizer applied server-side inside the table). Here the shard's
+// rows plus per-row optimizer slots live in ONE contiguous allocation
+// laid out by the shared ptpu::PlanArena (csrc/ptpu_arena.h), and the
+// hot ops are bounds-checked gather (pull) and duplicate-coalescing
+// scatter-update (push).
+//
+// Consumed via ctypes (paddle_tpu/core/native.py NativePsTable); the
+// numpy `_Shard` in distributed/ps/table.py remains the byte-parity
+// fallback when this library is absent.
+#ifndef PTPU_PS_TABLE_H_
+#define PTPU_PS_TABLE_H_
+
+#include <stdint.h>
+
+#if defined(_WIN32)
+#define PTPU_PS_EXPORT extern "C" __declspec(dllexport)
+#else
+#define PTPU_PS_EXPORT extern "C" __attribute__((visibility("default")))
+#endif
+
+// Server-side optimizers applied by push (reference: the accessor /
+// sparse-optimizer kinds in table/sparse_sgd_rule.cc).
+enum PtpuPsOptimizer {
+  PTPU_PS_SGD = 0,      // w -= lr * g
+  PTPU_PS_ADAGRAD = 1,  // g2 += g*g; w -= lr * g / (sqrt(g2) + eps)
+  PTPU_PS_ADAM = 2,     // per-row step count; bias-corrected m/v
+};
+
+PTPU_PS_EXPORT const char *ptpu_ps_last_error(void);
+PTPU_PS_EXPORT const char *ptpu_ps_version(void);
+
+// Create a shard of `rows` x `dim` float32 weights (plus optimizer
+// slots as the kind requires). Returns NULL on error.
+PTPU_PS_EXPORT void *ptpu_ps_table_create(int64_t rows, int64_t dim,
+                                          int optimizer, float lr,
+                                          float beta1, float beta2,
+                                          float eps);
+PTPU_PS_EXPORT void ptpu_ps_table_destroy(void *h);
+
+// Direct pointer to the row-major weight block — the binding wraps it
+// as a numpy view for seeded init and parity inspection. The caller
+// must not hold the view across destroy.
+PTPU_PS_EXPORT float *ptpu_ps_table_data(void *h);
+PTPU_PS_EXPORT int64_t ptpu_ps_table_rows(void *h);
+PTPU_PS_EXPORT int64_t ptpu_ps_table_dim(void *h);
+// Total bytes of the one arena allocation (weights + slots).
+PTPU_PS_EXPORT uint64_t ptpu_ps_table_bytes(void *h);
+
+// Gather rows[ids[i]] into out (n x dim, row-major). Local ids.
+// Concurrent pulls run in parallel (shared lock). Returns 0, or -1
+// with ptpu_ps_last_error set (out-of-range id).
+PTPU_PS_EXPORT int ptpu_ps_table_pull(void *h, const int64_t *ids,
+                                      int64_t n, float *out);
+
+// Scatter-update: duplicate ids accumulate their grads first, then the
+// optimizer updates each unique row once (exclusive lock). Returns 0,
+// or -1 with ptpu_ps_last_error set (out-of-range id).
+PTPU_PS_EXPORT int ptpu_ps_table_push(void *h, const int64_t *ids,
+                                      int64_t n, const float *grads);
+
+// Reader-lock bracket for callers that stream rows out WITHOUT a
+// gather copy (the data-plane server writev's row pointers straight
+// into the socket): rows are stable between rdlock and unlock;
+// concurrent pulls proceed, pushes wait.
+PTPU_PS_EXPORT void ptpu_ps_table_rdlock(void *h);
+PTPU_PS_EXPORT void ptpu_ps_table_rdunlock(void *h);
+
+#endif  // PTPU_PS_TABLE_H_

@@ -287,6 +287,226 @@ def aes_ctr_xcrypt(key: bytes, iv: bytes, data: bytes) -> bytes:
 
 
 # ---------------------------------------------------------------------------
+# Native PS shard table binding (csrc/ptpu_ps_table.cc — the C-hosted
+# parameter-server hot path). The table service (distributed/ps/table.py)
+# routes its per-row gather/scatter-update work here; the numpy _Shard
+# stays as the parity fallback when the .so is absent.
+# ---------------------------------------------------------------------------
+
+_PS_SO = os.path.join(_PKG_DIR, "_native_ps.so")
+_PS_SRCS = [os.path.join(os.path.dirname(_PKG_DIR), "csrc", f)
+            for f in ("ptpu_ps_table.cc", "ptpu_ps_server.cc")]
+_PS_LIB: Optional[ctypes.CDLL] = None
+_PS_TRIED = False
+_PS_LOCK = threading.Lock()
+
+PS_OPTIMIZERS = {"sgd": 0, "adagrad": 1, "adam": 2}
+
+
+def _ps_load() -> Optional[ctypes.CDLL]:
+    global _PS_LIB, _PS_TRIED
+    with _PS_LOCK:
+        if _PS_TRIED:
+            return _PS_LIB
+        _PS_TRIED = True
+        if not os.path.exists(_PS_SO):
+            if not all(os.path.exists(s) for s in _PS_SRCS):
+                return None
+            cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared",
+                   "-pthread", "-fvisibility=hidden", "-o", _PS_SO,
+                   *_PS_SRCS]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=120)
+            except (OSError, subprocess.SubprocessError):
+                return None
+        try:
+            lib = ctypes.CDLL(_PS_SO)
+        except OSError:
+            return None
+        c = ctypes
+        try:
+            lib.ptpu_ps_last_error.restype = c.c_char_p
+            lib.ptpu_ps_version.restype = c.c_char_p
+            lib.ptpu_ps_table_create.restype = c.c_void_p
+            lib.ptpu_ps_table_create.argtypes = [
+                c.c_int64, c.c_int64, c.c_int, c.c_float, c.c_float,
+                c.c_float, c.c_float]
+            lib.ptpu_ps_table_destroy.argtypes = [c.c_void_p]
+            lib.ptpu_ps_table_data.restype = c.POINTER(c.c_float)
+            lib.ptpu_ps_table_data.argtypes = [c.c_void_p]
+            for f in ("ptpu_ps_table_rows", "ptpu_ps_table_dim"):
+                getattr(lib, f).restype = c.c_int64
+                getattr(lib, f).argtypes = [c.c_void_p]
+            lib.ptpu_ps_table_bytes.restype = c.c_uint64
+            lib.ptpu_ps_table_bytes.argtypes = [c.c_void_p]
+            lib.ptpu_ps_table_pull.argtypes = [
+                c.c_void_p, c.POINTER(c.c_int64), c.c_int64,
+                c.POINTER(c.c_float)]
+            lib.ptpu_ps_table_push.argtypes = [
+                c.c_void_p, c.POINTER(c.c_int64), c.c_int64,
+                c.POINTER(c.c_float)]
+        except AttributeError:
+            # stale prebuilt .so missing symbols: treat as unavailable
+            # (delete paddle_tpu/_native_ps.so and re-import to rebuild)
+            return None
+        try:
+            lib.ptpu_ps_server_last_error.restype = c.c_char_p
+            lib.ptpu_ps_server_start.restype = c.c_void_p
+            lib.ptpu_ps_server_start.argtypes = [c.c_int, c.c_char_p,
+                                                 c.c_int, c.c_int]
+            lib.ptpu_ps_server_port.restype = c.c_int
+            lib.ptpu_ps_server_port.argtypes = [c.c_void_p]
+            lib.ptpu_ps_server_register.argtypes = [
+                c.c_void_p, c.c_char_p, c.c_void_p, c.c_int64]
+            lib.ptpu_ps_server_stop.argtypes = [c.c_void_p]
+            lib._ptpu_has_ps_server = True
+        except AttributeError:
+            lib._ptpu_has_ps_server = False
+        _PS_LIB = lib
+        return _PS_LIB
+
+
+def ps_table_available() -> bool:
+    return _ps_load() is not None
+
+
+def ps_server_available() -> bool:
+    l = _ps_load()
+    return l is not None and l._ptpu_has_ps_server
+
+
+class PsDataServer:
+    """C-hosted PS data-plane server: a thread-per-connection TCP loop
+    inside _native_ps.so that serves the wire.py fast pull/push frames
+    for registered `NativePsTable` shards — Python never touches a hot
+    frame (reference: the brpc worker threads of brpc_ps_server.cc).
+    The Python TableService keeps the control plane and advertises this
+    port over it."""
+
+    def __init__(self, port: int, authkey: bytes,
+                 loopback_only: bool = True):
+        l = _ps_load()
+        if l is None or not l._ptpu_has_ps_server:
+            raise RuntimeError("native PS data-plane server unavailable")
+        self._l = l
+        self._tables = {}   # name -> NativePsTable (keep shards alive)
+        self._h = l.ptpu_ps_server_start(port, authkey, len(authkey),
+                                         1 if loopback_only else 0)
+        if not self._h:
+            raise OSError(l.ptpu_ps_server_last_error().decode())
+        self.port = int(l.ptpu_ps_server_port(self._h))
+
+    def register(self, name: str, table: NativePsTable, lo: int):
+        """Expose `table` as `name`; the server maps global ids by
+        subtracting `lo` (the shard's first global row)."""
+        self._l.ptpu_ps_server_register(self._h, name.encode(),
+                                        table._h, lo)
+        self._tables[name] = table
+
+    def stop(self):
+        if getattr(self, "_h", None):
+            self._l.ptpu_ps_server_stop(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:   # interpreter teardown
+            pass
+
+
+class NativePsTable:
+    """One C-hosted shard: `rows` x `dim` float32 weights plus the
+    optimizer's per-row slots in one contiguous arena block. pull() is
+    a bounds-checked gather (concurrent pulls run in parallel under a
+    shared lock in C); push() coalesces duplicate ids then applies the
+    server-side optimizer (sgd / adagrad / adam)."""
+
+    def __init__(self, rows: int, dim: int, optimizer: str = "sgd",
+                 lr: float = 0.1, beta1: float = 0.9,
+                 beta2: float = 0.999, eps: float = 1e-8):
+        import numpy as np
+        self._np = np
+        l = _ps_load()
+        if l is None:
+            raise RuntimeError("native PS table unavailable (no "
+                               "_native_ps.so and no g++ to build it)")
+        if optimizer not in PS_OPTIMIZERS:
+            raise ValueError(f"unknown PS optimizer {optimizer!r}; "
+                             f"expected one of {sorted(PS_OPTIMIZERS)}")
+        self._l = l
+        self.rows, self.dim = int(rows), int(dim)
+        self._h = l.ptpu_ps_table_create(
+            self.rows, self.dim, PS_OPTIMIZERS[optimizer], lr, beta1,
+            beta2, eps)
+        if not self._h:
+            raise MemoryError(l.ptpu_ps_last_error().decode())
+
+    @property
+    def data(self):
+        """numpy view of the weight block (rows, dim) — writable, used
+        for seeded init and parity inspection."""
+        ptr = self._l.ptpu_ps_table_data(self._h)
+        return self._np.ctypeslib.as_array(
+            ptr, shape=(self.rows, self.dim))
+
+    @property
+    def nbytes(self) -> int:
+        return int(self._l.ptpu_ps_table_bytes(self._h))
+
+    def pull_into(self, local_ids, out) -> None:
+        """Gather rows[local_ids] into the preallocated float32 array
+        `out` (n, dim) — the wire fast path hands the reply buffer's
+        body view straight in, so the gather IS the serialization."""
+        np, c = self._np, ctypes
+        ids = np.ascontiguousarray(local_ids, np.int64)
+        if out.dtype != np.float32 or not out.flags.c_contiguous:
+            raise ValueError("pull_into needs a C-contiguous float32 out")
+        if out.size != ids.size * self.dim:
+            # the C gather writes ids.size*dim floats unconditionally —
+            # a short buffer would be a heap overrun, not an exception
+            raise ValueError(f"pull_into out size {out.size} != "
+                             f"{ids.size} ids x dim {self.dim}")
+        rc = self._l.ptpu_ps_table_pull(
+            self._h, ids.ctypes.data_as(c.POINTER(c.c_int64)), ids.size,
+            out.ctypes.data_as(c.POINTER(c.c_float)))
+        if rc != 0:
+            raise ValueError(self._l.ptpu_ps_last_error().decode())
+
+    def pull(self, local_ids):
+        np = self._np
+        ids = np.ascontiguousarray(local_ids, np.int64)
+        out = np.empty((ids.size, self.dim), np.float32)
+        self.pull_into(ids, out)
+        return out
+
+    def push(self, local_ids, grads) -> None:
+        np, c = self._np, ctypes
+        ids = np.ascontiguousarray(local_ids, np.int64)
+        g = np.ascontiguousarray(grads, np.float32)
+        if g.size != ids.size * self.dim:
+            raise ValueError(f"push grads size {g.size} != "
+                             f"{ids.size} ids x dim {self.dim}")
+        rc = self._l.ptpu_ps_table_push(
+            self._h, ids.ctypes.data_as(c.POINTER(c.c_int64)), ids.size,
+            g.ctypes.data_as(c.POINTER(c.c_float)))
+        if rc != 0:
+            raise ValueError(self._l.ptpu_ps_last_error().decode())
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._l.ptpu_ps_table_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:   # interpreter teardown
+            pass
+
+
+# ---------------------------------------------------------------------------
 # Native predictor binding (csrc/ptpu_predictor.cc — the no-Python C
 # serving engine). This is the Python-side convenience wrapper over the
 # same C ABI the Go binding and the pure-C demo use; tests keep their

@@ -40,18 +40,40 @@ class UtilBase:
         from .utils.fs import LocalFS
         self.fs_client = LocalFS()
 
+    _ar_seq = 0
+
     def all_reduce(self, input, mode="sum", comm_world="worker"):
-        import jax.numpy as jnp
+        """Host-side cross-rank reduce. Single process: identity (a
+        world of one). Multi-process: rides the PS KV store + barrier
+        (the repo's Gloo replacement) — NOT the eager XLA collectives,
+        which raise outside a trace in multi-process jobs precisely
+        because they cannot communicate there."""
         import numpy as np
-        from .. import collective
-        ops = {"sum": collective.ReduceOp.SUM,
-               "max": collective.ReduceOp.MAX,
-               "min": collective.ReduceOp.MIN}
-        if mode not in ops:
-            raise ValueError(f"all_reduce mode must be one of {set(ops)},"
-                             f" got {mode!r}")
-        out = collective.all_reduce(jnp.asarray(input), op=ops[mode])
-        return np.asarray(out)
+        reducers = {"sum": lambda a: a.sum(axis=0),
+                    "max": lambda a: a.max(axis=0),
+                    "min": lambda a: a.min(axis=0)}
+        if mode not in reducers:
+            raise ValueError(f"all_reduce mode must be one of "
+                             f"{set(reducers)}, got {mode!r}")
+        arr = np.asarray(input)
+        from .fleet_base import worker_num
+        if max(worker_num(), 1) <= 1:
+            return arr
+        from ..ps import wire
+        from ..ps.table import init_table_service
+        svc = init_table_service()
+        seq = UtilBase._ar_seq
+        UtilBase._ar_seq += 1
+        prefix = f"__util_allreduce__/{seq}/"
+        svc.kv_put(prefix + str(svc.rank), wire.dumps(arr))
+        svc.barrier(f"util-allreduce/{seq}")
+        vals = [wire.loads(v)
+                for _, v in sorted(svc.kv_prefix(prefix).items())]
+        out = reducers[mode](np.stack(vals))
+        # all ranks have read before anyone cleans its key up
+        svc.barrier(f"util-allreduce-exit/{seq}")
+        svc.kv_del(prefix + str(svc.rank))
+        return out
 
     def barrier(self, comm_world="worker"):
         from .. import collective

@@ -6,17 +6,36 @@ encoding — ndarrays ship as dtype+dims+raw bytes, never pickle) over
 `multiprocessing.connection` transports whose connect handshake is
 HMAC-authenticated by authkey — the brpc `sendrecv.proto` equivalent
 (reference: `distributed/service/brpc_ps_server.cc:1`).
+
+Hot-path architecture (reference: the C++ brpc PS service,
+`brpc_ps_server.cc` + `table/memory_sparse_table.cc`):
+
+* row storage + the server-side optimizer live in the C runtime
+  (`csrc/ptpu_ps_table.cc` via `core.native.NativePsTable`) when the
+  native library is present — the numpy `_Shard` arrays remain the
+  byte-parity fallback (``PTPU_PS_NATIVE=0`` forces it);
+* each accepted connection is served from its own thread, so one slow
+  client never serializes the service;
+* pull/push ride the fixed-layout fast frames in `wire.py` — the
+  server gathers rows straight into the preallocated reply frame;
+* async pushes coalesce SERVER-side per table (flags bit0): the server
+  acks immediately, an applier thread merges queued (ids, grads) into
+  one scatter-update, and `push_drain` barriers the queue for flush();
+* clients pipeline pulls (`pull_many` / `Channel`) with a bounded
+  in-flight depth instead of paying a full round trip per request.
 """
 from __future__ import annotations
 
+import collections
 import os
 import queue
 import threading
 from multiprocessing.connection import Client, Listener
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
+from . import wire
 from .wire import recv_msg, send_msg
 
 _AUTHKEY_BASE = b"ptpu-ps-"
@@ -25,6 +44,118 @@ _PORT_OFFSET = 200  # launcher endpoints use MASTER_PORT+1+rank; stay clear
 
 def _authkey() -> bytes:
     return _AUTHKEY_BASE + os.environ.get("MASTER_PORT", "0").encode()
+
+
+class _DataConn:
+    """Client side of the C data-plane socket (`csrc/ptpu_ps_server.cc`
+    via `core.native.PsDataServer`): u32-LE length-prefixed wire.py
+    fast frames over a TCP_NODELAY stream, opened with the HMAC-SHA256
+    nonce handshake. API-compatible with the send_bytes/recv_bytes
+    subset of multiprocessing Connection the fast paths use —
+    `recv_bytes` returns a zero-copy view of a reused buffer, valid
+    until the NEXT recv on this connection."""
+
+    def __init__(self, host: str, port: int, authkey: bytes):
+        import hmac
+        import socket
+        import struct
+        self._struct = struct
+        s = socket.create_connection((host, port), timeout=60)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # match the server's buffer: pipelined replies keep MBs in
+        # flight per connection
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4 << 20)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4 << 20)
+        self._s = s
+        nonce = self._recv_exact(bytearray(16))
+        mac = hmac.new(authkey, bytes(nonce), "sha256").digest()
+        s.sendall(struct.pack("<I", 32) + mac)
+        ok = self._recv_exact(bytearray(1))
+        if bytes(ok) != b"\x01":
+            raise ConnectionError("PS data-plane handshake rejected")
+        self._buf = bytearray(1 << 16)
+        self._hdr = bytearray(4)
+
+    def _recv_exact(self, buf: bytearray):
+        view = memoryview(buf)
+        while view:
+            n = self._s.recv_into(view)
+            if n == 0:
+                raise EOFError("PS data-plane connection closed")
+            view = view[n:]
+        return buf
+
+    def send_bytes(self, payload) -> None:
+        hdr = self._struct.pack("<I", len(payload))
+        # scatter-gather: header + body in one syscall, no concat copy
+        sent = self._s.sendmsg((hdr, payload))
+        if sent < 4:
+            self._s.sendall(hdr[sent:])
+            sent = 4
+        if sent - 4 < len(payload):
+            self._s.sendall(memoryview(payload)[sent - 4:])
+
+    def recv_bytes(self):
+        self._recv_exact(self._hdr)
+        (n,) = self._struct.unpack("<I", self._hdr)
+        if n > len(self._buf):
+            self._buf = bytearray(n)   # old views keep the old buffer
+        view = memoryview(self._buf)[:n]
+        while view:
+            got = self._s.recv_into(view)
+            if got == 0:
+                raise EOFError("PS data-plane connection closed")
+            view = view[got:]
+        return memoryview(self._buf)[:n]
+
+    def recv_pull_into(self, out: np.ndarray) -> None:
+        """Receive a PULL_REP with the body landing DIRECTLY in the
+        C-contiguous float32 array `out` (n, dim): the kernel's
+        copy-out is the only client-side move of row data. Raises
+        RuntimeError for ERR replies, ValueError on shape mismatch."""
+        self.recv_pull_into_seq([out])
+
+    def recv_pull_into_seq(self, outs) -> None:
+        """Receive ONE merged PULL_REP whose body is the concatenated
+        rows of several logical pulls (the vectorized batch RPC reply),
+        de-multiplexing the stream straight into each destination
+        array — no combined staging buffer exists on either side."""
+        self._recv_exact(self._hdr)
+        (n,) = self._struct.unpack("<I", self._hdr)
+        head = self._recv_exact(bytearray(2))
+        if head[0] != wire.WIRE_VERSION:
+            raise ValueError("PS wire: protocol version mismatch on "
+                             "data plane")
+        tag = head[1]
+        if tag == wire.TAG_ERR:
+            rest = self._recv_exact(bytearray(n - 2))
+            raise RuntimeError("PS remote error: " +
+                               bytes(rest[4:]).decode())
+        if tag != wire.TAG_PULL_REP:
+            self._recv_exact(bytearray(n - 2))
+            raise ValueError(f"PS wire: expected PULL_REP, got tag "
+                             f"{tag:#x}")
+        dims = self._recv_exact(bytearray(8))
+        cnt, dim = self._struct.unpack("<II", dims)
+        body = n - 10
+        want = sum(o.nbytes for o in outs)
+        if body != want or cnt * dim * 4 != body:
+            self._recv_exact(bytearray(body))
+            raise ValueError(f"PS wire: pull reply {cnt}x{dim} does "
+                             f"not match {len(outs)} merged outputs")
+        for out in outs:
+            view = memoryview(out).cast("B")
+            while view:
+                got = self._s.recv_into(view)
+                if got == 0:
+                    raise EOFError("PS data-plane connection closed")
+                view = view[got:]
+
+    def close(self):
+        try:
+            self._s.close()
+        except OSError:
+            pass
 
 
 def _shard_bounds(vocab: int, world: int, rank: int):
@@ -68,32 +199,119 @@ def _rows_normal(seed: int, lo: int, rows: int, dim: int,
     return out
 
 
+_OPTIMIZERS = ("sgd", "adagrad", "adam")
+
+
+def _native_wanted() -> bool:
+    return os.environ.get("PTPU_PS_NATIVE", "1") != "0"
+
+
 class _Shard:
     """This process's rows of one table: the contiguous id block
-    [lo, hi) (reference placement: `ps_dispatcher.py`)."""
+    [lo, hi) (reference placement: `ps_dispatcher.py`).
+
+    Storage backend: `NativePsTable` (C-hosted rows + optimizer slots,
+    its own reader/writer lock) when available; numpy arrays with the
+    same update formulas otherwise. `self.data` is always a (rows, dim)
+    float32 view of the live weights — for the native backend it views
+    the C arena directly, so seeded init and parity inspection need no
+    copies.
+    """
 
     def __init__(self, name: str, vocab: int, dim: int, rank: int,
-                 world: int, lr: float, seed: int):
+                 world: int, lr: float, seed: int,
+                 optimizer: str = "sgd", beta1: float = 0.9,
+                 beta2: float = 0.999, eps: float = 1e-8):
+        if optimizer not in _OPTIMIZERS:
+            raise ValueError(f"unknown PS optimizer {optimizer!r}; "
+                             f"expected one of {_OPTIMIZERS}")
         self.name, self.vocab, self.dim = name, vocab, dim
         self.rank, self.world, self.lr = rank, world, lr
+        self.optimizer = optimizer
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
         self.lo, self.hi, self.block = _shard_bounds(vocab, world, rank)
-        self.data = _rows_normal(seed, self.lo, self.hi - self.lo, dim,
-                                 0.02)
+        rows = self.hi - self.lo
+        init = _rows_normal(seed, self.lo, rows, dim, 0.02)
+        self._native = None
+        if rows > 0 and _native_wanted():
+            from ...core import native
+            if native.ps_table_available():
+                self._native = native.NativePsTable(
+                    rows, dim, optimizer, lr, beta1, beta2, eps)
+                self._native.data[:] = init
+        if self._native is not None:
+            self.data = self._native.data
+        else:
+            self.data = init
+            if optimizer != "sgd":
+                self._g2 = np.zeros((rows, dim), np.float32)
+            if optimizer == "adam":
+                self._m = self._g2   # slot0 doubles as adam m
+                self._v = np.zeros((rows, dim), np.float32)
+                self._t = np.zeros(rows, np.int64)
         self._lock = threading.Lock()
 
+    @property
+    def native(self) -> bool:
+        return self._native is not None
+
+    def _local(self, ids: np.ndarray) -> np.ndarray:
+        local = np.asarray(ids, np.int64) - self.lo
+        if self._native is None and local.size and (
+                local.min() < 0 or local.max() >= self.hi - self.lo):
+            # the native path bounds-checks in C; mirror it here so a
+            # garbled/malicious id can't wrap around into another row
+            raise ValueError(f"table {self.name!r}: id out of shard "
+                             f"range [{self.lo}, {self.hi})")
+        return local
+
     def pull(self, ids: np.ndarray) -> np.ndarray:
+        out = np.empty((np.asarray(ids).size, self.dim), np.float32)
+        self.pull_into(ids, out)
+        return out
+
+    def pull_into(self, ids: np.ndarray, out: np.ndarray) -> None:
+        """Gather rows for global `ids` directly into `out` (n, dim) —
+        the serve loop hands in the reply frame's body view, making the
+        gather itself the serialization."""
+        local = self._local(ids)
+        if self._native is not None:
+            self._native.pull_into(local, out)
+            return
         with self._lock:
-            return self.data[ids - self.lo]
+            np.take(self.data, local, axis=0, out=out)
 
     def push(self, ids: np.ndarray, grads: np.ndarray):
-        """Server-side SGD (reference: optimizer runs in the table,
+        """Server-side optimizer runs in the table (reference:
         `common_sparse_table.cc`); duplicate ids accumulate first."""
+        local = self._local(ids)
+        g = np.asarray(grads, np.float32).reshape(local.size, self.dim)
+        if self._native is not None:
+            self._native.push(local, g)
+            return
         with self._lock:
             # scatter-add duplicates, then one update per unique row
-            uniq, inv = np.unique(ids - self.lo, return_inverse=True)
+            uniq, inv = np.unique(local, return_inverse=True)
             acc = np.zeros((len(uniq), self.dim), np.float32)
-            np.add.at(acc, inv, grads)
-            self.data[uniq] -= self.lr * acc
+            np.add.at(acc, inv, g)
+            if self.optimizer == "sgd":
+                self.data[uniq] -= self.lr * acc
+            elif self.optimizer == "adagrad":
+                g2 = self._g2[uniq] + acc * acc
+                self._g2[uniq] = g2
+                self.data[uniq] -= self.lr * acc / (np.sqrt(g2) +
+                                                    self.eps)
+            else:  # adam with per-row step counts (sparse-Adam rule)
+                self._t[uniq] += 1
+                t = self._t[uniq].astype(np.float32)[:, None]
+                m = self.beta1 * self._m[uniq] + (1 - self.beta1) * acc
+                v = self.beta2 * self._v[uniq] + \
+                    (1 - self.beta2) * acc * acc
+                self._m[uniq], self._v[uniq] = m, v
+                mhat = m / (1 - self.beta1 ** t)
+                vhat = v / (1 - self.beta2 ** t)
+                self.data[uniq] -= self.lr * mhat / (np.sqrt(vhat) +
+                                                     self.eps)
 
 
 class TableService:
@@ -116,10 +334,32 @@ class TableService:
         self._conns: Dict[int, object] = {}
         self._conn_lock = threading.Lock()
         self._rpc_locks: Dict[int, threading.Lock] = {}
+        # C data-plane (csrc/ptpu_ps_server.cc): serves fast pull/push
+        # frames for native shards without Python in the loop. Started
+        # lazily by register(); peers learn the port over the control
+        # plane ("data_port" op). Deterministic port: control ports use
+        # [PORT_OFFSET, PORT_OFFSET+world), data uses the next block.
+        self._data_server = None
+        self._data_port_nominal = port_base + _PORT_OFFSET + world + rank
+        self._data_ports: Dict[tuple, int] = {}    # (peer, table) -> port
+        self._data_conns: Dict[int, _DataConn] = {}
+        self._data_locks: Dict[int, threading.Lock] = {}
         self._stop = False
         self._async_q: "queue.Queue" = queue.Queue()
         self._listener = None
         self._threads = []
+        # server-side async-push coalescing (reference: the merge-then-
+        # apply DenseOptimizer path of `service/communicator.cc`, here on
+        # the RECEIVING side): async fast-frame pushes append to
+        # _pending[table] and are acked immediately; _apply_loop (or the
+        # next pull of that table — read-your-writes) merges the queued
+        # (ids, grads) into ONE scatter-update.
+        self._pending: Dict[str, list] = {}
+        self._pending_cv = threading.Condition()
+        self._applying = 0
+        # peers holding coalesced pushes from us (flush barriers them)
+        self._async_peers: set = set()
+        self._async_peers_lock = threading.Lock()
         # generic KV (rank 0 is the store) — backs elastic membership and
         # cross-rank barriers (reference: gloo HTTP-KV / etcd rendezvous)
         self._kv: Dict[str, bytes] = {}
@@ -133,13 +373,16 @@ class TableService:
         self._heter_fns: Dict[str, object] = {}
         if world > 1:
             self._listener = Listener((self._bind_host, self._ports[rank]),
-                                      authkey=_authkey())
+                                      backlog=64, authkey=_authkey())
             t = threading.Thread(target=self._accept_loop, daemon=True)
             t.start()
             self._threads.append(t)
         ta = threading.Thread(target=self._async_push_loop, daemon=True)
         ta.start()
         self._threads.append(ta)
+        tp = threading.Thread(target=self._apply_loop, daemon=True)
+        tp.start()
+        self._threads.append(tp)
 
     # ---- server side ----------------------------------------------------
 
@@ -158,12 +401,18 @@ class TableService:
         try:
             while not self._stop:
                 try:
-                    op, table, payload = recv_msg(conn)
+                    data = conn.recv_bytes()
                 except (EOFError, OSError):
                     return
+                try:
+                    tag = wire.fast_tag(data)
+                    if tag >= 0:
+                        self._serve_fast(conn, tag, data)
+                        continue
+                    op, table, payload = wire.loads(data)
                 except ValueError as e:
-                    # malformed frame (wire.loads protocol error): drop
-                    # THIS connection cleanly; the serve thread and the
+                    # malformed frame (wire protocol error): drop THIS
+                    # connection cleanly; the serve thread and the
                     # service survive a garbled/malicious peer
                     import sys
                     print(f"ps: dropping connection on malformed "
@@ -175,6 +424,22 @@ class TableService:
                     ids, grads = payload
                     self._shards[table].push(ids, grads)
                     send_msg(conn, b"ok")
+                elif op == "push_drain":
+                    # barrier for server-side coalescing: reply once the
+                    # pending queue is empty and no apply is in flight
+                    with self._pending_cv:
+                        while (self._pending or self._applying) and \
+                                not self._stop:
+                            self._pending_cv.wait(0.5)
+                    send_msg(conn, b"ok")
+                elif op == "data_port":
+                    # advertise the C data plane for `table` (None when
+                    # the shard is numpy-hosted or the server is off)
+                    port = None
+                    if self._data_server is not None and \
+                            table in self._data_server._tables:
+                        port = self._data_server.port
+                    send_msg(conn, port)
                 elif op == "barrier_probe":
                     send_msg(conn, b"ok")
                 elif op == "kv_put":
@@ -223,28 +488,118 @@ class TableService:
             except OSError:
                 pass
 
+    def _serve_fast(self, conn, tag: int, data):
+        """Fixed-layout pull/push frames — the hot path. Protocol-level
+        garbage raises ValueError (dropping the connection, same as the
+        generic decoder); application errors (unknown table, id out of
+        range) travel back as ERR frames so the client can raise."""
+        try:
+            if tag == wire.TAG_PULL_REQ:
+                table, ids = wire.parse_pull_req(data)
+            elif tag == wire.TAG_PUSH_REQ:
+                table, ids, grads, is_async = wire.parse_push_req(data)
+            else:
+                raise ValueError(f"PS wire: unexpected fast request "
+                                 f"tag {tag:#x}")
+        except ValueError:
+            raise
+        except Exception as e:  # header garbage: uniform protocol error
+            raise ValueError(f"PS wire: malformed fast frame "
+                             f"({type(e).__name__}: {e})") from e
+        shard = self._shards.get(table)
+        if shard is None:
+            conn.send_bytes(wire.build_err(
+                f"unknown table {table!r} on rank {self.rank}"))
+            return
+        if tag == wire.TAG_PULL_REQ:
+            if self._pending:
+                # read-your-writes: merge queued async pushes for this
+                # table before serving rows from it. A bad queued batch
+                # (async pushes were acked before validation) must not
+                # take down this INNOCENT puller's connection — it is
+                # dropped, the same fate the applier thread gives it.
+                try:
+                    self._apply_pending(table)
+                except Exception:
+                    pass
+            frame, body = wire.alloc_pull_rep(ids.size, shard.dim)
+            try:
+                shard.pull_into(ids, body)
+            except ValueError as e:
+                conn.send_bytes(wire.build_err(str(e)))
+                return
+            conn.send_bytes(frame)
+        else:
+            if is_async:
+                with self._pending_cv:
+                    self._pending.setdefault(table, []).append(
+                        (ids, grads))
+                    self._pending_cv.notify_all()
+                conn.send_bytes(wire.OK_FRAME)
+            else:
+                try:
+                    shard.push(ids, grads)
+                except ValueError as e:
+                    conn.send_bytes(wire.build_err(str(e)))
+                    return
+                conn.send_bytes(wire.OK_FRAME)
+
+    def _apply_pending(self, table: str):
+        with self._pending_cv:
+            items = self._pending.pop(table, None)
+            if items:
+                self._applying += 1
+        if not items:
+            return
+        try:
+            flat = np.concatenate([i for i, _ in items])
+            g = np.concatenate([x for _, x in items])
+            self._shards[table].push(flat, g)
+        finally:
+            with self._pending_cv:
+                self._applying -= 1
+                self._pending_cv.notify_all()
+
+    def _apply_loop(self):
+        """Applier thread: merges each table's queued async pushes into
+        one scatter-update per drain."""
+        while True:
+            with self._pending_cv:
+                while not self._pending and not self._stop:
+                    self._pending_cv.wait(0.1)
+                if self._stop and not self._pending:
+                    return
+                tables = list(self._pending)
+            for table in tables:
+                try:
+                    self._apply_pending(table)
+                except Exception:   # shard gone mid-shutdown: drop
+                    pass
+
     # ---- client side ----------------------------------------------------
+
+    def _dial(self, peer: int, timeout_s: float = 60.0):
+        """Open a NEW connection to a peer, retrying while it comes up
+        (jax init can take seconds) — the reference's brpc channel
+        connect retries (`brpc_ps_client.cc`)."""
+        import time
+        deadline = time.time() + timeout_s
+        delay = 0.05
+        while True:
+            try:
+                return Client((self._hosts[peer], self._ports[peer]),
+                              authkey=_authkey())
+            except (ConnectionRefusedError, OSError):
+                if time.time() > deadline:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
 
     def _conn(self, peer: int, timeout_s: float = 60.0):
         with self._conn_lock:
             c = self._conns.get(peer)
             if c is None:
-                # peers come up at their own pace (jax init can take
-                # seconds) — retry with backoff like the reference's brpc
-                # channel connect (`brpc_ps_client.cc` connect retries)
-                import time
-                deadline = time.time() + timeout_s
-                delay = 0.05
-                while True:
-                    try:
-                        c = Client((self._hosts[peer], self._ports[peer]),
-                                   authkey=_authkey())
-                        break
-                    except (ConnectionRefusedError, OSError):
-                        if time.time() > deadline:
-                            raise
-                        time.sleep(delay)
-                        delay = min(delay * 2, 1.0)
+                c = self._dial(peer, timeout_s)
                 self._conns[peer] = c
                 self._rpc_locks[peer] = threading.Lock()
             return c
@@ -258,10 +613,101 @@ class TableService:
             send_msg(c, (op, table, payload))
             return recv_msg(c)
 
+    def _data_conn_for(self, peer: int, table: str):
+        """The shared C data-plane connection for (peer, table), or None
+        when the peer serves that table from Python. Positive answers
+        cache; a None answer is re-asked (the peer may register the
+        table on its data plane later)."""
+        key = (peer, table)
+        port = self._data_ports.get(key)
+        if port is None:
+            try:
+                port = self._rpc(peer, "data_port", table, None)
+            except (EOFError, OSError):
+                return None
+            if port is None:
+                return None
+            self._data_ports[key] = port
+        with self._conn_lock:
+            dc = self._data_conns.get(peer)
+            if dc is None:
+                dc = _DataConn(self._hosts[peer], port, _authkey())
+                self._data_conns[peer] = dc
+                self._data_locks[peer] = threading.Lock()
+        return dc
+
+    def _fast_conn(self, peer: int, table: str):
+        """(conn, lock) for fast pull/push frames to `peer` — the C
+        data-plane socket when the peer hosts `table` natively, else
+        the cached control connection."""
+        dc = self._data_conn_for(peer, table)
+        if dc is not None:
+            return dc, self._data_locks[peer]
+        return self._conn(peer), self._rpc_locks[peer]
+
+    def _new_fast_conn(self, peer: int, table: str):
+        """A DEDICATED fast connection (Channel): its own socket, so
+        concurrent client threads don't serialize."""
+        port = None
+        try:
+            port = self._rpc(peer, "data_port", table, None)
+        except (EOFError, OSError):
+            pass
+        if port is not None:
+            return _DataConn(self._hosts[peer], port, _authkey())
+        return self._dial(peer)
+
+    def _rpc_pull_into(self, peer: int, table: str, sub: np.ndarray,
+                       out: np.ndarray, mask) -> None:
+        """Remote pull whose rows land in out[mask] (out[:] when mask is
+        None). The reply view may alias the connection's reused receive
+        buffer, so the copy into `out` happens under the conn lock."""
+        c, lock = self._fast_conn(peer, table)
+        req = wire.build_pull_req(table, sub)
+        with lock:
+            c.send_bytes(req)
+            if mask is None and isinstance(c, _DataConn):
+                c.recv_pull_into(out)   # body lands straight in out
+                return
+            reply = c.recv_bytes()
+            wire.check_reply(reply, wire.TAG_PULL_REP)
+            rows = wire.parse_pull_rep(reply)
+            if mask is None:
+                out[:] = rows
+            else:
+                out[mask] = rows
+
+    def _rpc_push(self, peer: int, table: str, sub: np.ndarray,
+                  g: np.ndarray, is_async: bool = False):
+        c, lock = self._fast_conn(peer, table)
+        req = wire.build_push_req(table, sub, g, is_async)
+        with lock:
+            c.send_bytes(req)
+            reply = c.recv_bytes()
+            wire.check_reply(reply, wire.TAG_OK)
+
     def register(self, name: str, vocab: int, dim: int, lr: float = 0.1,
-                 seed: int = 0) -> "ShardedEmbeddingTable":
-        self._shards[name] = _Shard(name, vocab, dim, self.rank,
-                                    self.world, lr, seed)
+                 seed: int = 0, optimizer: str = "sgd",
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 eps: float = 1e-8) -> "ShardedEmbeddingTable":
+        shard = _Shard(name, vocab, dim, self.rank, self.world, lr,
+                       seed, optimizer, beta1, beta2, eps)
+        self._shards[name] = shard
+        if shard.native and self.world > 1:
+            from ...core import native
+            if self._data_server is None and \
+                    native.ps_server_available():
+                try:
+                    # bind scope mirrors the control plane: loopback
+                    # unless the job spans hosts
+                    self._data_server = native.PsDataServer(
+                        self._data_port_nominal, _authkey(),
+                        loopback_only=self._bind_host == "127.0.0.1")
+                except OSError:
+                    self._data_server = None   # port taken: Python plane
+            if self._data_server is not None:
+                self._data_server.register(name, shard._native,
+                                           shard.lo)
         return ShardedEmbeddingTable(self, name, vocab, dim)
 
     def _owner(self, table: str, flat: np.ndarray) -> np.ndarray:
@@ -280,10 +726,105 @@ class TableService:
             if not m.any():
                 continue
             sub = flat[m]
-            rows = (self._shards[table].pull(sub) if peer == self.rank
-                    else self._rpc(peer, "pull", table, sub))
-            out[m] = rows
+            if peer == self.rank:
+                out[m] = self._shards[table].pull(sub)
+            else:
+                full = bool(m.all())
+                self._rpc_pull_into(peer, table, sub, out,
+                                    None if full else m)
         return out.reshape(tuple(np.shape(ids)) + (dim,))
+
+    # rows per merged wire frame: big enough to amortize per-frame
+    # syscall + header costs, small enough to bound in-flight memory
+    # (depth * MERGE_ROWS * dim * 4 bytes per peer connection)
+    MERGE_ROWS = int(os.environ.get("PTPU_PS_MERGE_ROWS", 4096))
+
+    def pull_many(self, table: str, ids_list, depth: int = 16) -> List[
+            np.ndarray]:
+        """Pipelined, VECTORIZED batch of pulls (reference: the async
+        Communicator merging queued requests per table +
+        `brpc_ps_client.cc` keeping many RPCs in flight). Consecutive
+        pulls bound for the same peer merge into one wire frame (up to
+        MERGE_ROWS rows) whose reply streams straight back into each
+        destination array, and up to `depth` frames ride each
+        connection before the first reply is awaited — throughput is
+        bounded by the wire, not by request latency or per-frame
+        overhead. Results match `[pull(table, ids) for ids in
+        ids_list]` exactly."""
+        shard = self._shards[table]
+        dim = shard.dim
+        flats, outs, shapes = [], [], []
+        per_peer: Dict[int, list] = collections.defaultdict(list)
+        for i, ids in enumerate(ids_list):
+            flat = np.asarray(ids).reshape(-1)
+            flats.append(flat)
+            shapes.append(tuple(np.shape(ids)))
+            outs.append(np.empty((flat.size, dim), np.float32))
+            owner = self._owner(table, flat)
+            for peer in range(self.world):
+                m = owner == peer
+                if not m.any():
+                    continue
+                if peer == self.rank:
+                    if m.all():
+                        shard.pull_into(flat, outs[i])
+                    else:
+                        outs[i][m] = shard.pull(flat[m])
+                else:
+                    full = bool(m.all())
+                    per_peer[peer].append(
+                        (i, None if full else m, flat if full
+                         else flat[m]))
+        for peer, jobs in per_peer.items():
+            c, lock = self._fast_conn(peer, table)
+            direct = isinstance(c, _DataConn)
+            # merge consecutive jobs into wire frames of <= MERGE_ROWS
+            groups, cur, rows = [], [], 0
+            for job in jobs:
+                cur.append(job)
+                rows += job[2].size
+                if rows >= self.MERGE_ROWS:
+                    groups.append(cur)
+                    cur, rows = [], 0
+            if cur:
+                groups.append(cur)
+            with lock:
+                inflight = collections.deque()
+
+                def finish():
+                    grp = inflight.popleft()
+                    if direct and all(m is None for _, m, _ in grp):
+                        c.recv_pull_into_seq([outs[i]
+                                              for i, _, _ in grp])
+                        return
+                    reply = c.recv_bytes()
+                    wire.check_reply(reply, wire.TAG_PULL_REP)
+                    rows = wire.parse_pull_rep(reply)
+                    off = 0
+                    for i, m, sub in grp:
+                        chunk = rows[off:off + sub.size]
+                        off += sub.size
+                        if m is None:
+                            outs[i][:] = chunk
+                        else:
+                            outs[i][m] = chunk
+                for grp in groups:
+                    cat = grp[0][2] if len(grp) == 1 else \
+                        np.concatenate([sub for _, _, sub in grp])
+                    c.send_bytes(wire.build_pull_req(table, cat))
+                    inflight.append(grp)
+                    if len(inflight) >= depth:
+                        finish()
+                while inflight:
+                    finish()
+        return [o.reshape(s + (dim,)) for o, s in zip(outs, shapes)]
+
+    def open_channel(self, peer: int, depth: int = 16) -> "Channel":
+        """Dedicated pipelined client connection to one peer — each
+        channel is independent of the cached RPC connection and of other
+        channels, so concurrent client threads don't serialize on one
+        socket (the server runs a thread per accepted connection)."""
+        return Channel(self, peer, depth)
 
     def push(self, table: str, ids: np.ndarray, grads: np.ndarray,
              sync: bool = True):
@@ -291,13 +832,15 @@ class TableService:
         communicator thread (reference: async `Communicator` batching,
         `service/communicator.cc`)."""
         flat = np.asarray(ids).reshape(-1)
+        if flat.size == 0:
+            return   # nothing to scatter (reshape(0, -1) would raise)
         g = np.asarray(grads, np.float32).reshape(flat.size, -1)
         if not sync:
             self._async_q.put((table, flat, g))
             return
         self._push_now(table, flat, g)
 
-    def _push_now(self, table, flat, g):
+    def _push_now(self, table, flat, g, is_async: bool = False):
         owner = self._owner(table, flat)
         for peer in range(self.world):
             m = owner == peer
@@ -306,13 +849,18 @@ class TableService:
             if peer == self.rank:
                 self._shards[table].push(flat[m], g[m])
             else:
-                self._rpc(peer, "push", table, (flat[m], g[m]))
+                self._rpc_push(peer, table, flat[m], g[m], is_async)
+                if is_async:
+                    with self._async_peers_lock:
+                        self._async_peers.add(peer)
 
     def _async_push_loop(self):
         """Communicator thread: drains queued pushes and COALESCES
         same-table grads into one RPC per peer per drain (reference:
         async `Communicator` batching by send_queue,
-        `service/communicator.cc` — merge then send)."""
+        `service/communicator.cc` — merge then send). Remote sends carry
+        the async flag, so the receiving server coalesces further and
+        acks without waiting for the update."""
         while True:
             item = self._async_q.get()
             if item is None:
@@ -347,11 +895,18 @@ class TableService:
         for table, items in by_table.items():
             flat = np.concatenate([f for f, _ in items])
             g = np.concatenate([x for _, x in items])
-            self._push_now(table, flat, g)
+            self._push_now(table, flat, g, is_async=True)
 
     def flush(self):
-        """Drain queued async pushes (reference: Communicator barrier)."""
+        """Drain queued async pushes (reference: Communicator barrier):
+        wait for the local communicator queue, then barrier every peer
+        holding our server-side-coalesced pushes."""
         self._async_q.join()
+        with self._async_peers_lock:
+            peers = sorted(self._async_peers)
+            self._async_peers.clear()
+        for peer in peers:
+            self._rpc(peer, "push_drain", "", None)
 
     # ---- heterogeneous split training (reference: N29
     # `heter_client.cc`/`heter_server.cc`, `heterxpu_trainer.cc`:
@@ -470,6 +1025,8 @@ class TableService:
     def shutdown(self):
         self._stop = True
         self._async_q.put(None)
+        with self._pending_cv:
+            self._pending_cv.notify_all()
         if self._listener is not None:
             try:
                 self._listener.close()
@@ -482,6 +1039,90 @@ class TableService:
                 except OSError:
                     pass
             self._conns.clear()
+            for dc in self._data_conns.values():
+                dc.close()
+            self._data_conns.clear()
+        if self._data_server is not None:
+            self._data_server.stop()
+            self._data_server = None
+
+
+class Channel:
+    """A dedicated pipelined client connection to one peer (reference:
+    one brpc Channel per communication thread). Keeps up to `depth`
+    pull requests in flight; `pull` drains outstanding traffic first so
+    results are always consistent. NOT thread-safe — one channel per
+    client thread is the intended shape."""
+
+    def __init__(self, svc: TableService, peer: int, depth: int = 16):
+        if peer == svc.rank:
+            raise ValueError("channels connect to REMOTE peers; local "
+                             "shards are called directly")
+        self._svc, self.peer, self.depth = svc, peer, depth
+        self._c = None   # dialed on first use, once the table is known
+        self._inflight: collections.deque = collections.deque()
+
+    def _ensure(self, table: str):
+        if self._c is None:
+            self._c = self._svc._new_fast_conn(self.peer, table)
+        return self._c
+
+    def pull_nowait(self, table: str, ids, out: np.ndarray):
+        """Issue a pull whose rows land in `out` (n, dim); blocks only
+        when `depth` requests are already outstanding."""
+        self._ensure(table).send_bytes(wire.build_pull_req(
+            table, np.asarray(ids).reshape(-1)))
+        self._inflight.append(("pull", out))
+        while len(self._inflight) > self.depth:
+            self._finish_one()
+
+    def push_async(self, table: str, ids, grads):
+        """Fire-and-forget push: the server acks after enqueueing into
+        its coalescer (data plane: after applying); the ack is
+        collected lazily."""
+        self._ensure(table).send_bytes(wire.build_push_req(
+            table, np.asarray(ids).reshape(-1),
+            np.asarray(grads, np.float32), True))
+        self._inflight.append(("push", None))
+        while len(self._inflight) > self.depth:
+            self._finish_one()
+
+    def _finish_one(self):
+        kind, out = self._inflight.popleft()
+        if kind == "pull" and isinstance(self._c, _DataConn):
+            self._c.recv_pull_into(out)
+            return
+        reply = self._c.recv_bytes()
+        if kind == "pull":
+            wire.check_reply(reply, wire.TAG_PULL_REP)
+            out[:] = wire.parse_pull_rep(reply)
+        else:
+            wire.check_reply(reply, wire.TAG_OK)
+
+    def drain(self):
+        """Collect every outstanding reply."""
+        while self._inflight:
+            self._finish_one()
+
+    def pull(self, table: str, ids) -> np.ndarray:
+        flat = np.asarray(ids).reshape(-1)
+        self.drain()
+        out = np.empty((flat.size, self._svc._shards[table].dim),
+                       np.float32)
+        self.pull_nowait(table, flat, out)
+        self.drain()
+        return out.reshape(tuple(np.shape(ids)) + out.shape[-1:])
+
+    def close(self):
+        try:
+            self.drain()
+        except (EOFError, OSError, ValueError, RuntimeError):
+            pass
+        if self._c is not None:
+            try:
+                self._c.close()
+            except OSError:
+                pass
 
 
 class ShardedEmbeddingTable:

@@ -202,3 +202,164 @@ def send_msg(conn, obj: Any) -> None:
 
 def recv_msg(conn) -> Any:
     return loads(conn.recv_bytes())
+
+
+# ---------------------------------------------------------------------------
+# Fast frames — the zero-copy hot path for the dominant pull/push RPCs.
+#
+# The generic tagged encoding above costs one `tobytes` copy plus one
+# `b"".join` copy per array, on both sides of every RPC. At PS serving
+# rates that is the wire's whole budget, so the four hot messages get
+# fixed binary layouts (brpc analogue: the dedicated PsService method
+# ids in sendrecv.proto, vs a generic variant encoding):
+#
+#   PULL_REQ  [ver][0x50][u8 tlen][table][u32 n][n x i64-LE ids]
+#   PULL_REP  [ver][0x51][u32 n][u32 dim][n*dim x f32-LE rows]
+#   PUSH_REQ  [ver][0x52][u8 tlen][table][u8 flags][u32 n][u32 dim]
+#             [n x i64-LE ids][n*dim x f32-LE grads]   flags bit0=async
+#   OK_REP    [ver][0x53]
+#   ERR_REP   [ver][0x54][u32 len][utf-8 message]
+#
+# The reply body is never concatenated: `alloc_pull_rep` hands the
+# server a preallocated frame whose body is a float32 view, the shard
+# gather writes rows straight into it, and the one buffer goes to
+# send_bytes. Parsers return zero-copy views over the received buffer.
+# Fast tags start at 0x50, disjoint from the value tags above, so a
+# frame's second byte dispatches between the two encodings; version
+# mismatch fails identically to `loads`.
+# ---------------------------------------------------------------------------
+
+TAG_PULL_REQ = 0x50
+TAG_PULL_REP = 0x51
+TAG_PUSH_REQ = 0x52
+TAG_OK = 0x53
+TAG_ERR = 0x54
+_FAST_MIN, _FAST_MAX = TAG_PULL_REQ, TAG_ERR
+
+OK_FRAME = bytes([WIRE_VERSION, TAG_OK])
+
+_U32x2 = struct.Struct("<II")
+
+
+def fast_tag(data) -> int:
+    """The fast-frame tag of a received buffer, or -1 for generic
+    frames. Raises the same version-mismatch error as `loads`."""
+    if len(data) < 2:
+        return -1
+    if data[0] != WIRE_VERSION:
+        raise ValueError(
+            f"PS wire: protocol version mismatch (got {data[0]}, "
+            f"expected {WIRE_VERSION}) — all ranks must run the same "
+            f"paddle_tpu wire revision")
+    tag = data[1]
+    return tag if _FAST_MIN <= tag <= _FAST_MAX else -1
+
+
+def _table_header(tag: int, table: str) -> bytes:
+    tb = table.encode()
+    if len(tb) > 255:
+        raise ValueError("PS wire: table name too long for fast frame")
+    return bytes([WIRE_VERSION, tag, len(tb)]) + tb
+
+
+def build_pull_req(table: str, ids: np.ndarray) -> bytes:
+    ids = np.ascontiguousarray(ids, np.dtype("<i8"))
+    return (_table_header(TAG_PULL_REQ, table) + _U32.pack(ids.size) +
+            ids.tobytes())
+
+
+def parse_pull_req(data):
+    """-> (table, ids) — ids a zero-copy int64 view of `data`."""
+    buf = memoryview(data)
+    tlen = buf[2]
+    off = 3 + tlen
+    table = bytes(buf[3:off]).decode()
+    (n,) = _U32.unpack_from(buf, off)
+    off += 4
+    if len(buf) != off + 8 * n:
+        raise ValueError("PS wire: truncated pull request")
+    return table, np.frombuffer(buf, np.dtype("<i8"), count=n, offset=off)
+
+
+_PULL_REP_HDR = 2 + _U32x2.size
+
+
+def alloc_pull_rep(n: int, dim: int):
+    """-> (frame, body): a preallocated PULL_REP frame and the (n, dim)
+    float32 view of its body for the gather to fill."""
+    frame = bytearray(_PULL_REP_HDR + 4 * n * dim)
+    frame[0], frame[1] = WIRE_VERSION, TAG_PULL_REP
+    _U32x2.pack_into(frame, 2, n, dim)
+    body = np.frombuffer(frame, np.dtype("<f4"),
+                         offset=_PULL_REP_HDR).reshape(n, dim)
+    return frame, body
+
+
+def parse_pull_rep(data):
+    """-> (n, dim) float32 zero-copy view of the reply body."""
+    buf = memoryview(data)
+    n, dim = _U32x2.unpack_from(buf, 2)
+    if len(buf) != _PULL_REP_HDR + 4 * n * dim:
+        raise ValueError("PS wire: truncated pull reply")
+    return np.frombuffer(buf, np.dtype("<f4"), count=n * dim,
+                         offset=_PULL_REP_HDR).reshape(n, dim)
+
+
+def build_push_req(table: str, ids: np.ndarray, grads: np.ndarray,
+                   is_async: bool = False) -> bytearray:
+    ids = np.ascontiguousarray(ids, np.dtype("<i8"))
+    grads = np.ascontiguousarray(grads, np.dtype("<f4"))
+    n = ids.size
+    dim = grads.size // max(n, 1)
+    if grads.size != n * dim:
+        raise ValueError("PS wire: grads size not a multiple of ids")
+    hdr = (_table_header(TAG_PUSH_REQ, table) +
+           bytes([1 if is_async else 0]) + _U32x2.pack(n, dim))
+    frame = bytearray(len(hdr) + 8 * n + 4 * n * dim)
+    frame[:len(hdr)] = hdr
+    frame[len(hdr):len(hdr) + 8 * n] = ids.tobytes()
+    frame[len(hdr) + 8 * n:] = grads.tobytes()
+    return frame
+
+
+def parse_push_req(data):
+    """-> (table, ids, grads, is_async) — ids/grads zero-copy views."""
+    buf = memoryview(data)
+    tlen = buf[2]
+    off = 3 + tlen
+    table = bytes(buf[3:off]).decode()
+    is_async = bool(buf[off])
+    n, dim = _U32x2.unpack_from(buf, off + 1)
+    off += 1 + _U32x2.size
+    if len(buf) != off + 8 * n + 4 * n * dim:
+        raise ValueError("PS wire: truncated push request")
+    ids = np.frombuffer(buf, np.dtype("<i8"), count=n, offset=off)
+    grads = np.frombuffer(buf, np.dtype("<f4"), count=n * dim,
+                          offset=off + 8 * n).reshape(n, dim)
+    return table, ids, grads, is_async
+
+
+def build_err(msg: str) -> bytes:
+    b = msg.encode()
+    return bytes([WIRE_VERSION, TAG_ERR]) + _U32.pack(len(b)) + b
+
+
+def parse_err(data) -> str:
+    buf = memoryview(data)
+    (n,) = _U32.unpack_from(buf, 2)
+    raw = bytes(buf[6:6 + n])
+    if len(raw) != n:
+        raise ValueError("PS wire: truncated error frame")
+    return raw.decode()
+
+
+def check_reply(data, expect_tag: int):
+    """Validate a fast reply: raises RuntimeError carrying the server's
+    message for ERR frames, ValueError for the wrong frame kind."""
+    tag = fast_tag(data)
+    if tag == TAG_ERR:
+        raise RuntimeError(f"PS remote error: {parse_err(data)}")
+    if tag != expect_tag:
+        raise ValueError(f"PS wire: expected fast tag {expect_tag:#x}, "
+                         f"got {tag:#x}")
+    return data

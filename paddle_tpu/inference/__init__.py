@@ -101,6 +101,36 @@ class Predictor:
         self._layer = jit_load(config.model_dir())
         self._feeds = {}
         self._outputs = {}
+        self._scrub_dropout()
+
+    def _scrub_dropout(self):
+        """Load-time dropout-removal (reference:
+        `OptimizeInferenceProgram` running delete_dropout_op_pass).
+
+        `jit.save` traces in eval mode AND runs the registered
+        `dropout_removal` ir pass before export, so a paddle_tpu
+        artifact arrives clean and this check is the cheap no-op
+        branch. An artifact that still carries RNG ops (produced by
+        external tooling or an old save) is serialized StableHLO — the
+        jaxpr-level pass cannot see inside it, so the predictor flags
+        it loudly instead of serving nondeterministic outputs
+        silently."""
+        self._dropout_scrubbed = False
+        try:
+            mlir = self._layer._exported.mlir_module()
+        except Exception:
+            return
+        if "stablehlo.rng" in mlir or "threefry" in mlir:
+            import warnings
+            warnings.warn(
+                "inference.Predictor: the loaded artifact samples "
+                "randomness (train-mode dropout was baked in at "
+                "export). Re-export it with paddle_tpu.jit.save — its "
+                "dropout_removal pass strips the mask — or apply "
+                "ir.Program.apply_pass('dropout_removal') before "
+                "export.", stacklevel=3)
+        else:
+            self._dropout_scrubbed = True
 
     def get_input_names(self) -> List[str]:
         return self._layer.input_names() or ["x"]

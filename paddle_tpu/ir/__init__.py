@@ -17,8 +17,11 @@ unlike the reference there is no second proto format to keep in sync.
 
 Most reference passes (fusion, memory reuse, layout) are subsumed by
 XLA; the infra here exists for the passes XLA can NOT see: framework-
-level rewrites like dropout removal for inference, collective
-annotation, quant/dequant insertion, or DCE after head-pruning.
+level rewrites — `dead_code_elimination`, and `dropout_removal` (the
+inference rewrite `jit.save` applies before export and
+`inference.Predictor` checks on load; reference:
+`delete_dropout_op_pass.cc`). Quant/dequant insertion and DCE after
+head-pruning are further candidates on the same registry.
 """
 from __future__ import annotations
 
@@ -107,12 +110,20 @@ class Program:
 
     def apply_pass(self, name_or_fn) -> "Program":
         """Run a registered pass (or a callable eqns->eqns) and return a
-        NEW Program (reference: `ir/pass.h` Pass::Apply)."""
+        NEW Program (reference: `ir/pass.h` Pass::Apply). A pass may
+        return either the new eqn list or an (eqns, outvars) pair —
+        rewrites that replace a program OUTPUT (e.g. dropout as the
+        last op) need to retarget outvars as well."""
         fn = PassRegistry.get(name_or_fn) if isinstance(name_or_fn, str) \
             else name_or_fn
         jaxpr = self.closed.jaxpr
-        new_eqns = fn(list(jaxpr.eqns), jaxpr)
-        new_jaxpr = jaxpr.replace(eqns=new_eqns)
+        res = fn(list(jaxpr.eqns), jaxpr)
+        if isinstance(res, tuple):
+            new_eqns, new_outvars = res
+            new_jaxpr = jaxpr.replace(eqns=new_eqns,
+                                      outvars=list(new_outvars))
+        else:
+            new_jaxpr = jaxpr.replace(eqns=res)
         return Program(self.closed.replace(jaxpr=new_jaxpr))
 
     # -- execution / export ----------------------------------------------
@@ -182,6 +193,186 @@ def dead_code_elimination(eqns, jaxpr):
                 if not isinstance(v, Literal):
                     live.add(id(v))
     return list(reversed(kept))
+
+
+_RNG_PRIMS = frozenset({
+    "random_seed", "random_split", "random_bits", "random_wrap",
+    "random_fold_in", "random_unwrap", "random_gamma", "threefry2x32"})
+
+
+def _inner_jaxprs(params: dict):
+    for v in params.values():
+        if hasattr(v, "jaxpr"):        # ClosedJaxpr (pjit, custom_* ...)
+            yield v.jaxpr
+        elif hasattr(v, "eqns"):       # raw Jaxpr
+            yield v
+
+
+def _jaxpr_has_rng(jaxpr) -> bool:
+    for e in jaxpr.eqns:
+        if e.primitive.name in _RNG_PRIMS:
+            return True
+        for inner in _inner_jaxprs(e.params):
+            if _jaxpr_has_rng(inner):
+                return True
+    return False
+
+
+def has_rng_ops(closed_jaxpr) -> bool:
+    """True when the program samples randomness (dropout and friends) —
+    the load/save hooks use this to decide whether `dropout_removal`
+    has anything to do."""
+    return _jaxpr_has_rng(closed_jaxpr.jaxpr)
+
+
+def _is_zero(v, producers, depth: int = 0) -> bool:
+    from jax.extend.core import Literal
+    if isinstance(v, Literal):
+        try:
+            import numpy as np
+            return float(np.asarray(v.val)) == 0.0
+        except (TypeError, ValueError):
+            return False
+    if depth > 4:
+        return False
+    e = producers.get(id(v))
+    if e is not None and e.primitive.name in ("broadcast_in_dim",
+                                              "convert_element_type"):
+        return _is_zero(e.invars[0], producers, depth + 1)
+    return False
+
+
+def _keep_prob(pred, producers, depth: int = 0):
+    """The bernoulli keep probability behind a dropout mask predicate,
+    or None when it cannot be established. jax.random.bernoulli traces
+    as `pjit[name=_bernoulli](key, p)` with p a scalar literal; the
+    mask may pass through broadcasts/converts on its way to the
+    select."""
+    from jax.extend.core import Literal
+    if depth > 4 or isinstance(pred, Literal):
+        return None
+    e = producers.get(id(pred))
+    if e is None:
+        return None
+    name = e.primitive.name
+    if name == "pjit" and e.params.get("name") == "_bernoulli" and \
+            len(e.invars) == 2 and isinstance(e.invars[1], Literal):
+        try:
+            import numpy as np
+            return float(np.asarray(e.invars[1].val))
+        except (TypeError, ValueError):
+            return None
+    if name in ("broadcast_in_dim", "convert_element_type", "reshape"):
+        return _keep_prob(e.invars[0], producers, depth + 1)
+    return None
+
+
+@PassRegistry.register("dropout_removal")
+def dropout_removal(eqns, jaxpr):
+    """Remove train-mode dropout for inference (reference:
+    `delete_dropout_op_pass.cc`; here over the jaxpr).
+
+    A dropout site is a select whose PREDICATE is RNG-derived
+    (`where(bernoulli(key, keep), x / keep, 0)` in the default
+    upscale_in_train mode): taint vars forward from the RNG primitives,
+    find select_n / pjit-`_where` eqns with a tainted predicate and a
+    zero branch, VERIFY the kept branch is `x / keep` with the divisor
+    equal to the bernoulli keep probability, and rewire consumers to x
+    — exactly the eval-mode (training=False) semantics. Sites that
+    don't match the proven pattern (downscale_in_infer, whose eval
+    semantics is x*(1-p), or a div that is user arithmetic rather than
+    the upscale) are conservatively LEFT IN PLACE — never a silent
+    numerics change — and `has_rng_ops` still reports them. The
+    orphaned RNG chain then falls to DCE. A site whose result is a
+    direct program output (dropout as the model's last op) retargets
+    the outvar via the (eqns, outvars) pass return form.
+    """
+    from jax.extend.core import Literal
+    tainted: set = set()
+
+    def is_tainted(v) -> bool:
+        return not isinstance(v, Literal) and id(v) in tainted
+
+    producers = {}
+    for e in eqns:
+        rng_src = e.primitive.name in _RNG_PRIMS or any(
+            _jaxpr_has_rng(inner) for inner in _inner_jaxprs(e.params))
+        if rng_src or any(is_tainted(v) for v in e.invars):
+            for v in e.outvars:
+                tainted.add(id(v))
+        for v in e.outvars:
+            producers[id(v)] = e
+
+    subst = {}          # id(select outvar) -> replacement var
+    drop: set = set()   # id(eqn) to delete
+    for e in eqns:
+        name = e.primitive.name
+        if name == "select_n" and len(e.invars) == 3:
+            pred, on_false, on_true = e.invars
+            cases = [on_false, on_true]
+        elif name == "pjit" and e.params.get("name") == "_where" and \
+                len(e.invars) == 3:
+            pred, on_true, on_false = e.invars
+            cases = [on_false, on_true]
+        else:
+            continue
+        if not is_tainted(pred):
+            continue
+        zero = [c for c in cases if _is_zero(c, producers)]
+        kept = [c for c in cases if not _is_zero(c, producers)]
+        if len(zero) != 1 or len(kept) != 1:
+            continue
+        v = kept[0]
+        if isinstance(v, Literal):
+            continue
+        # Only rewrite the PROVEN upscale_in_train shape
+        # where(bern(keep), x / keep, 0): the kept branch must be a div
+        # whose literal divisor equals the bernoulli keep probability.
+        # Anything else — downscale_in_infer (eval semantics x*(1-p),
+        # not x) or a kept branch whose div is the USER's arithmetic —
+        # is left in place rather than silently changing numerics; the
+        # save hook's has_rng_ops recheck then warns.
+        keep = _keep_prob(pred, producers)
+        pe = producers.get(id(v))
+        if keep is None or pe is None or pe.primitive.name != "div" \
+                or not isinstance(pe.invars[1], Literal):
+            continue
+        try:
+            import numpy as np
+            divisor = float(np.asarray(pe.invars[1].val))
+        except (TypeError, ValueError):
+            continue
+        if abs(divisor - keep) > 1e-6 * max(1.0, abs(keep)):
+            continue
+        v = pe.invars[0]    # x / keep -> x (exact eval-mode value)
+        if len(e.outvars) != 1:
+            continue
+        subst[id(e.outvars[0])] = v
+        drop.add(id(e))
+    if not subst:
+        return eqns
+
+    def resolve(v):
+        while not isinstance(v, Literal) and id(v) in subst:
+            v = subst[id(v)]
+        return v
+
+    new_eqns = []
+    for e in eqns:
+        if id(e) in drop:
+            continue
+        if any(not isinstance(v, Literal) and id(v) in subst
+               for v in e.invars):
+            e = e.replace(invars=[resolve(v) for v in e.invars])
+        new_eqns.append(e)
+    new_outvars = [resolve(v) for v in jaxpr.outvars]
+    return (dead_code_elimination(new_eqns,
+                                  jaxpr.replace(outvars=new_outvars)),
+            new_outvars)
+
+
+# the ISSUE/VERDICT spelling — same pass object under both names
+PassRegistry._passes["dropout-removal"] = dropout_removal
 
 
 @PassRegistry.register("op_stats")

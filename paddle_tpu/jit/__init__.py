@@ -197,12 +197,42 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **config):
         return out
 
     from jax import export as jax_export
-    exported = jax_export.export(jax.jit(fwd))(
-        jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
-                     params),
-        jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
-                     buffers),
-        *abstract)
+    p_avals = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
+    b_avals = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), buffers)
+
+    # inference pass on the export trace: eval() above silences
+    # well-behaved dropout, but a forward that hardcodes training=True
+    # would bake an RNG mask into the artifact — run the registered
+    # dropout-removal pass so the serialized StableHLO is
+    # deterministic (reference: OptimizeInferenceProgram running
+    # delete_dropout_op_pass before serialization)
+    from ..ir import Program, has_rng_ops
+    closed, out_shape = jax.make_jaxpr(fwd, return_shape=True)(
+        p_avals, b_avals, *abstract)
+    if has_rng_ops(closed):
+        cleaned = Program(closed).apply_pass("dropout_removal").closed
+        out_tree = jax.tree.structure(out_shape)
+
+        def fwd_clean(params, buffers, *args):
+            flat = jax.tree.leaves((params, buffers, args))
+            out = jax.core.eval_jaxpr(cleaned.jaxpr, cleaned.consts,
+                                      *flat)
+            # restore the model's output pytree: the artifact must not
+            # change structure depending on whether RNG was present
+            return jax.tree.unflatten(out_tree, out)
+        export_fn = fwd_clean
+        if has_rng_ops(cleaned):
+            import warnings
+            warnings.warn(
+                "jit.save: the traced forward still samples randomness "
+                "after dropout_removal — the exported artifact will "
+                "not be deterministic", stacklevel=2)
+    else:
+        export_fn = fwd
+    exported = jax_export.export(jax.jit(export_fn))(
+        p_avals, b_avals, *abstract)
     write_artifact(path, exported.serialize(), params, buffers,
                    [getattr(s, "name", None) or f"x{i}"
                     for i, s in enumerate(input_spec)])

@@ -1,0 +1,85 @@
+"""ASP (2:4 structured sparsity) tests — VERDICT r5 weak #6: the
+module (`incubate/asp.py`, reference `fluid/contrib/sparsity/`) was
+imported by no test. Covers mask correctness, the density assertion,
+and optimizer re-masking after a step."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.incubate import asp
+
+
+class TestMasks:
+    def test_create_mask_keeps_top2_of_4_by_magnitude(self):
+        w = np.array([[0.1, -3.0, 0.2, 2.0],
+                      [-5.0, 0.0, 1.0, -0.5]], np.float32)
+        m = asp.create_mask(w, n=2, m=4)
+        np.testing.assert_array_equal(m, [[0, 1, 0, 1], [1, 0, 1, 0]])
+
+    def test_mask_is_2_in_4_for_random_weights(self):
+        w = np.random.RandomState(0).randn(16, 32).astype(np.float32)
+        m = asp.create_mask(w)
+        groups = m.reshape(-1, 4).sum(axis=1)
+        np.testing.assert_array_equal(groups, np.full(groups.shape, 2.0))
+        assert asp.check_mask_1d(w * m)
+
+    def test_check_mask_1d_rejects_dense_rows(self):
+        bad = np.ones((2, 4), np.float32)        # 4 of 4 nonzero
+        assert not asp.check_mask_1d(bad)
+        assert not asp.check_mask_1d(np.ones((2, 3), np.float32))  # %4
+
+    def test_indivisible_last_dim_returns_identity(self):
+        w = np.random.RandomState(1).randn(3, 5).astype(np.float32)
+        np.testing.assert_array_equal(asp.create_mask(w, m=4),
+                                      np.ones_like(w))
+
+    def test_calculate_density(self):
+        w = np.random.RandomState(2).randn(8, 8).astype(np.float32)
+        assert asp.calculate_density(w) == 1.0
+        pruned = w * asp.create_mask(w)
+        assert asp.calculate_density(pruned) == pytest.approx(0.5)
+
+
+class TestPruneAndRemask:
+    def _net(self):
+        pt.seed(0)
+        return pt.nn.Linear(8, 4)
+
+    def test_prune_model_halves_density(self):
+        net = self._net()
+        asp.ASPHelper.reset()
+        pruned = asp.prune_model(net)
+        assert pruned >= 1
+        w = np.asarray(net.weight.value)
+        assert asp.check_mask_1d(w)
+        assert asp.calculate_density(w) == pytest.approx(0.5, abs=0.05)
+
+    def test_decorated_optimizer_remasks_after_step(self):
+        net = self._net()
+        asp.ASPHelper.reset()
+        asp.prune_model(net)
+        zero_before = np.asarray(net.weight.value) == 0
+        opt = asp.decorate(pt.optimizer.SGD(0.5,
+                                            parameters=net.parameters()))
+        # a dense grad would revive every pruned entry without ASP
+        grads = {n: jnp.ones_like(p.value)
+                 for n, p in opt._inner._params.items()}
+        opt.step(grads)
+        w = np.asarray(net.weight.value)
+        assert asp.check_mask_1d(w)
+        # pruned entries stay exactly zero; surviving entries moved
+        assert (w[zero_before] == 0).all()
+        assert (w[~zero_before] != 0).any()
+
+    def test_undecorated_step_revives_pruned_entries(self):
+        """Control: without decorate() the same dense grad destroys the
+        2:4 pattern — proving the re-mask is what preserves it."""
+        net = self._net()
+        asp.ASPHelper.reset()
+        asp.prune_model(net)
+        opt = pt.optimizer.SGD(0.5, parameters=net.parameters())
+        grads = {n: jnp.ones_like(p.value)
+                 for n, p in opt._params.items()}
+        opt.step(grads)
+        assert not asp.check_mask_1d(np.asarray(net.weight.value))

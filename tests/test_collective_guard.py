@@ -1,0 +1,73 @@
+"""Eager-collective guard (VERDICT r5 item 7, ISSUE r7 satellite).
+
+The reference's eager collectives really communicate (NCCL,
+`collective.py:413`); the TPU-native eager path cannot — with
+world_size > 1 it used to silently return the input, a silent semantic
+divergence. It must now raise with guidance. Traced calls and
+single-process eager calls keep their semantics.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.distributed.collective as C
+
+
+@pytest.fixture
+def world4(monkeypatch):
+    monkeypatch.setattr(C, "get_world_size", lambda: 4)
+
+
+EAGER_OPS = [
+    ("all_reduce", lambda x: C.all_reduce(x)),
+    ("all_gather", lambda x: C.all_gather(x)),
+    ("reduce_scatter", lambda x: C.reduce_scatter(x)),
+    ("broadcast", lambda x: C.broadcast(x)),
+    ("reduce", lambda x: C.reduce(x)),
+    ("scatter", lambda x: C.scatter(x)),
+    ("alltoall", lambda x: C.alltoall(x)),
+    ("all_to_all_single", lambda x: C.all_to_all_single(x)),
+    ("send", lambda x: C.send(x)),
+    ("recv", lambda x: C.recv(x)),
+    ("p2p_push", lambda x: C.p2p_push(x, [(0, 1)])),
+]
+
+
+class TestEagerGuard:
+    @pytest.mark.parametrize("name,fn", EAGER_OPS,
+                             ids=[n for n, _ in EAGER_OPS])
+    def test_eager_multiproc_raises_with_guidance(self, world4, name,
+                                                  fn):
+        x = jnp.ones((4, 4))
+        with pytest.raises(RuntimeError) as ei:
+            fn(x)
+        msg = str(ei.value)
+        assert name in msg                  # names the op
+        assert "traced" in msg              # says what to do instead
+        assert "MIGRATION.md" in msg or "ps" in msg
+
+    def test_single_process_eager_stays_identity(self):
+        assert C.get_world_size() == 1
+        x = jnp.asarray(np.random.RandomState(0).randn(4, 4),
+                        jnp.float32)
+        np.testing.assert_array_equal(np.asarray(C.all_reduce(x)),
+                                      np.asarray(x))
+        np.testing.assert_array_equal(np.asarray(C.broadcast(x)),
+                                      np.asarray(x))
+
+    def test_traced_calls_do_not_hit_the_guard(self, world4):
+        # tracing with an unmapped axis falls back to identity without
+        # raising — the guard is strictly an EAGER-path check
+        x = jnp.ones((4,))
+        jax.make_jaxpr(lambda t: C.all_reduce(t))(x)
+        jax.make_jaxpr(lambda t: C.reduce_scatter(t))(x)
+        jax.make_jaxpr(lambda t: C.broadcast(t))(x)
+
+    def test_scatter_with_tensor_list_selects_local_chunk(self, world4):
+        # the list form is a LOCAL selection, not communication — it
+        # must keep working in eager multi-process mode
+        chunks = [jnp.full((2,), float(i)) for i in range(4)]
+        got = C.scatter(jnp.ones(()), tensor_list=chunks, src=0)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(chunks[C.get_rank()]))

@@ -1,12 +1,15 @@
 """Native (C++) unit tests — the reference's cc_test idiom.
 
 Reference: gtest cc_test targets per CMakeLists (e.g.
-`paddle/fluid/framework/data_type_test.cc`). Here a single dependency-
-free binary (`csrc/ptpu_selftest.cc`) asserts the predictor TU's
-internal kernels: sgemm vs naive (incl. 0*NaN IEEE propagation), exact
-int32 igemm, the int8_exact overflow bound, the odometer broadcast
-walk vs the div/mod reference, input-dim validation, and worker-pool
-range coverage.
+`paddle/fluid/framework/data_type_test.cc`). Two dependency-free
+binaries: `csrc/ptpu_selftest.cc` asserts the predictor TU's internal
+kernels (sgemm vs naive incl. 0*NaN IEEE propagation, exact int32
+igemm, the int8_exact overflow bound, broadcast walk, input-dim
+validation, worker-pool coverage); `csrc/ptpu_ps_selftest.cc` asserts
+the PS shard table + data-plane server (gather/bounds, per-optimizer
+update formulas vs naive references, duplicate coalescing, torn-read
+freedom under concurrent pull/push, SHA-256/HMAC known vectors, and a
+full socket round-trip incl. bad-authkey rejection).
 """
 import os
 import subprocess
@@ -20,3 +23,4 @@ def test_native_selftest_passes():
                       capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "all native unit tests passed" in r.stdout
+    assert "all native ps-table unit tests passed" in r.stdout

@@ -1,0 +1,76 @@
+"""ps_bench `--out` persistence + native parity contract (ISSUE r7
+satellite; pattern of tests/test_bench_persist.py).
+
+Runs `tools/ps_bench.py` as a subprocess with a shrunken 2-proc config
+(1 server + 1 client, tiny table), asserts the persisted JSON schema,
+and asserts the native-table pull/push parity rows the bench computes
+against the numpy shard (byte-identical pull, allclose push update).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "tools", "ps_bench.py")
+
+
+@pytest.fixture(scope="module")
+def bench_out(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("psb") / "BENCH_PS.json")
+    env = dict(os.environ)
+    env.update({
+        "PTPU_PSBENCH_VOCAB": "2048", "PTPU_PSBENCH_DIM": "8",
+        "PTPU_PSBENCH_BATCH": "32", "PTPU_PSBENCH_OPS": "30",
+        "PTPU_PSBENCH_CLIENTS": "1", "PTPU_PSBENCH_DEPTH": "4",
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        # a fixed port would collide with concurrently-running PS
+        # tests; shift this run's port block
+        "MASTER_PORT": "29810",
+    })
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, BENCH, "--out", out], cwd=REPO,
+                       env=env, capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, \
+        f"rc={r.returncode}\nstdout:{r.stdout[-2000:]}\n" \
+        f"stderr:{r.stderr[-2000:]}"
+    with open(out) as f:
+        return json.load(f)
+
+
+class TestPsBenchPersist:
+    def test_schema(self, bench_out):
+        assert bench_out["bench"] == "ps_bench"
+        for key in ("vocab", "dim", "batch", "ops", "clients", "depth"):
+            assert isinstance(bench_out[key], int)
+        rows = bench_out["measurements"]
+        assert rows, "no measurements persisted"
+        for row in rows:
+            assert {"metric", "value", "unit"} <= set(row)
+
+    def test_throughput_rows_present_and_positive(self, bench_out):
+        by = {r["metric"]: r for r in bench_out["measurements"]}
+        for m in ("ps_pull_sync_ops_per_s", "ps_wire_pull_ops_per_s",
+                  "ps_push_sync_ops_per_s", "ps_push_async_ops_per_s"):
+            assert m in by, f"missing {m}"
+            assert by[m]["value"] > 0
+            assert by[m]["unit"] == "ops/s"
+        assert by["ps_wire_pull_ops_per_s"]["pipelined"] is True
+
+    def test_native_parity_rows(self, bench_out):
+        """Acceptance: byte-identical pull / allclose push update
+        between the native and numpy shard paths, per optimizer."""
+        from paddle_tpu.core import native
+        by = {r["metric"]: r for r in bench_out["measurements"]}
+        if not native.ps_table_available():
+            assert "ps_native_parity" in by   # explicit unavailable row
+            pytest.skip("native PS table unavailable in this env")
+        for opt in ("sgd", "adagrad", "adam"):
+            row = by[f"ps_native_parity_{opt}"]
+            assert row["pull_byte_identical"] is True
+            assert row["push_allclose"] is True
+            assert row["value"] == 1

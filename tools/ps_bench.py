@@ -1,9 +1,27 @@
 #!/usr/bin/env python
-"""PS wire throughput micro-bench (VERDICT r4 item 7 acceptance).
+"""PS service throughput bench (VERDICT r4 item 7 / r5 "Next round" 9).
 
-Two processes, one table: rank 1 hammers pull and push RPCs against
-rank 0's shard over the binary wire (`distributed/ps/wire.py`) and
-reports ops/s and effective MB/s. Run: python tools/ps_bench.py
+One server rank hosts a shard (C-hosted native table when the library
+is present); NCLIENTS client processes hammer it over the binary wire
+(`distributed/ps/wire.py` fast frames). Measured phases:
+
+  1. sync pull        — one client, one request in flight (the r5
+                        configuration: latency-bound, comparable to the
+                        2.7k ops/s r5 headline);
+  2. pipelined pull   — every client keeps DEPTH pulls in flight
+                        (`TableService.pull_many`); the aggregate is
+                        the service-throughput headline;
+  3. sync push        — one client;
+  4. async push       — every client, server-side coalescing + drain.
+
+A native-vs-numpy parity check (byte-identical pull, allclose push
+update for sgd/adagrad/adam) runs in-process and is recorded with the
+measurements. `--out FILE.json` persists every row
+(BENCH_PS_rNN.json style, same shape as tools/predictor_bench.py).
+
+Config via env: PTPU_PSBENCH_{VOCAB,DIM,BATCH,OPS,CLIENTS,DEPTH}
+(tests/test_ps_bench_persist.py runs a shrunken 2-proc config).
+Run: python tools/ps_bench.py [--out BENCH_PS_rNN.json]
 """
 from __future__ import annotations
 
@@ -16,59 +34,201 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-VOCAB, DIM, BATCH, OPS = 100_000, 64, 512, 300
+VOCAB = int(os.environ.get("PTPU_PSBENCH_VOCAB", 100_000))
+DIM = int(os.environ.get("PTPU_PSBENCH_DIM", 64))
+BATCH = int(os.environ.get("PTPU_PSBENCH_BATCH", 512))
+OPS = int(os.environ.get("PTPU_PSBENCH_OPS", 1000))
+# service throughput needs enough concurrent clients to cover request
+# latency; leave headroom for the server + OS on small boxes
+NCLIENTS = int(os.environ.get(
+    "PTPU_PSBENCH_CLIENTS",
+    max(2, min(20, (os.cpu_count() or 8) * 5 // 6))))
+DEPTH = int(os.environ.get("PTPU_PSBENCH_DEPTH", 6))
+# wider request merging than the library default: the bench hammers one
+# table, exactly the shape merging amortizes
+os.environ.setdefault("PTPU_PS_MERGE_ROWS", "8192")
+
+RESULTS: list = []
 
 
-def _worker(rank, port, q):
+def emit(row: dict):
+    RESULTS.append(row)
+    print(json.dumps(row), flush=True)
+
+
+def _worker(rank, world, port, q):
     os.environ["MASTER_PORT"] = str(port)
     import numpy as np
     from paddle_tpu.distributed.ps.table import TableService
 
-    svc = TableService(rank, 2, port)
+    svc = TableService(rank, world, port)
     svc.register("emb", VOCAB, DIM, lr=0.1, seed=0)
+    # every rank has registered before the first pull can arrive
+    svc.barrier("psbench-reg", timeout_s=600)
+    block = svc._shards["emb"].block
     rs = np.random.RandomState(rank)
-    # all ids on the PEER's shard -> every op is a real network RPC
-    lo = 0 if rank == 1 else VOCAB // 2
-    ids = rs.randint(lo, lo + VOCAB // 2 - 1, BATCH)
+    # every id on rank 0's shard -> every client op is a real wire RPC
+    # against the ONE server under test
+    ids = rs.randint(0, block, BATCH).astype(np.int64)
     grads = rs.randn(BATCH, DIM).astype(np.float32)
+    row_bytes = BATCH * DIM * 4
 
-    if rank == 1:
-        svc.pull("emb", ids)                      # connect + warm
-        t0 = time.perf_counter()
-        for _ in range(OPS):
-            svc.pull("emb", ids)
-        dt_pull = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        for _ in range(OPS):
-            svc.push("emb", ids, grads, sync=True)
-        dt_push = time.perf_counter() - t0
-        row_bytes = BATCH * DIM * 4
-        q.put({
-            "pull_ops_s": round(OPS / dt_pull, 1),
-            "pull_MB_s": round(OPS * row_bytes / dt_pull / 1e6, 1),
-            "push_ops_s": round(OPS / dt_push, 1),
-            "push_MB_s": round(OPS * row_bytes / dt_push / 1e6, 1),
-            "batch": BATCH, "dim": DIM,
-        })
-        svc.barrier("psbench")
+    if rank == 0:
+        # the server participates in every phase barrier the clients
+        # synchronize on, then just serves
+        for name in ("psbench-go", "psbench-pipe", "psbench-push",
+                     "psbench-done"):
+            svc.barrier(name, timeout_s=900)
+        q.put({"rank": 0, "native": svc._shards["emb"].native})
     else:
-        svc.barrier("psbench")
+        svc.pull("emb", ids)                      # connect + warm
+        svc.barrier("psbench-go", timeout_s=900)
+        res = {"rank": rank}
+
+        if rank == 1:
+            # phase 1: sync pull (one request in flight — r5 config)
+            t0 = time.perf_counter()
+            for _ in range(OPS):
+                svc.pull("emb", ids)
+            res["dt_pull_sync"] = time.perf_counter() - t0
+
+        # phase 2: pipelined pulls, all clients simultaneously
+        svc.barrier("psbench-pipe", timeout_s=900)
+        reqs = [ids] * OPS
+        t0 = time.perf_counter()
+        svc.pull_many("emb", reqs, depth=DEPTH)
+        res["dt_pull_pipe"] = time.perf_counter() - t0
+
+        if rank == 1:
+            # phase 3: sync push
+            t0 = time.perf_counter()
+            for _ in range(OPS):
+                svc.push("emb", ids, grads, sync=True)
+            res["dt_push_sync"] = time.perf_counter() - t0
+
+        # phase 4: async pushes with server-side coalescing, then drain
+        svc.barrier("psbench-push", timeout_s=900)
+        ch = svc.open_channel(0, depth=DEPTH)
+        t0 = time.perf_counter()
+        for _ in range(OPS):
+            ch.push_async("emb", ids, grads)
+        ch.drain()
+        svc._rpc(0, "push_drain", "", None)
+        res["dt_push_async"] = time.perf_counter() - t0
+        ch.close()
+
+        res["row_bytes"] = row_bytes
+        q.put(res)
+        svc.barrier("psbench-done", timeout_s=600)
     svc.shutdown()
 
 
+def _parity_rows():
+    """Native vs numpy shard parity, no network (acceptance: byte-
+    identical pull, allclose push update)."""
+    import numpy as np
+
+    from paddle_tpu.core import native
+    from paddle_tpu.distributed.ps.table import _Shard
+
+    if not native.ps_table_available():
+        return [{"metric": "ps_native_parity", "value": 0,
+                 "unit": "bool", "note": "native table unavailable"}]
+    rows = []
+    rs = np.random.RandomState(0)
+    vocab, dim = 1024, 16
+    ids = rs.randint(0, vocab, 256)
+    grads = rs.randn(256, dim).astype(np.float32)
+    for opt in ("sgd", "adagrad", "adam"):
+        nat = _Shard("p", vocab, dim, 0, 1, 0.1, 3, optimizer=opt)
+        os.environ["PTPU_PS_NATIVE"] = "0"
+        try:
+            ref = _Shard("p", vocab, dim, 0, 1, 0.1, 3, optimizer=opt)
+        finally:
+            del os.environ["PTPU_PS_NATIVE"]
+        assert nat.native and not ref.native
+        pull_exact = bool(
+            nat.pull(ids).tobytes() == ref.pull(ids).tobytes())
+        for _ in range(3):
+            nat.push(ids, grads)
+            ref.push(ids, grads)
+        push_close = bool(np.allclose(nat.data, ref.data, rtol=1e-5,
+                                      atol=1e-6))
+        rows.append({"metric": f"ps_native_parity_{opt}",
+                     "value": int(pull_exact and push_close),
+                     "unit": "bool", "pull_byte_identical": pull_exact,
+                     "push_allclose": push_close})
+    return rows
+
+
 def main():
+    out_path = None
+    if "--out" in sys.argv:
+        idx = sys.argv.index("--out")
+        if idx + 1 >= len(sys.argv):
+            sys.exit("usage: ps_bench.py [--out RESULTS.json]")
+        out_path = sys.argv[idx + 1]
+
+    world = 1 + NCLIENTS
     port = 29650
     q: "mp.Queue" = mp.Queue()
-    ps = [mp.Process(target=_worker, args=(r, port, q)) for r in (0, 1)]
+    ps = [mp.Process(target=_worker, args=(r, world, port, q))
+          for r in range(world)]
     for p in ps:
         p.start()
-    res = q.get(timeout=120)
+    res = {}
+    for _ in range(world):
+        r = q.get(timeout=600)
+        res[r.pop("rank")] = r
     for p in ps:
-        p.join(timeout=30)
-    print(json.dumps({"metric": "ps_wire_pull_ops_per_s",
-                      "value": res["pull_ops_s"], "unit": "ops/s",
-                      **{k: v for k, v in res.items()
-                         if k != "pull_ops_s"}}))
+        p.join(timeout=60)
+
+    row_bytes = res[1]["row_bytes"]
+    native_engaged = bool(res[0]["native"])
+
+    def rate(dt, n=OPS):
+        return round(n / dt, 1), round(n * row_bytes / dt / 1e6, 1)
+
+    sync_ops, sync_mb = rate(res[1]["dt_pull_sync"])
+    emit({"metric": "ps_pull_sync_ops_per_s", "value": sync_ops,
+          "unit": "ops/s", "MB_s": sync_mb, "batch": BATCH, "dim": DIM,
+          "clients": 1, "native_table": native_engaged})
+
+    # aggregate service throughput: total ops over the longest client
+    pipe_total = OPS * NCLIENTS
+    pipe_wall = max(res[r]["dt_pull_pipe"] for r in range(1, world))
+    pipe_ops = round(pipe_total / pipe_wall, 1)
+    emit({"metric": "ps_wire_pull_ops_per_s", "value": pipe_ops,
+          "unit": "ops/s",
+          "MB_s": round(pipe_total * row_bytes / pipe_wall / 1e6, 1),
+          "batch": BATCH, "dim": DIM, "clients": NCLIENTS,
+          "depth": DEPTH, "pipelined": True,
+          "native_table": native_engaged})
+
+    push_ops, push_mb = rate(res[1]["dt_push_sync"])
+    emit({"metric": "ps_push_sync_ops_per_s", "value": push_ops,
+          "unit": "ops/s", "MB_s": push_mb, "batch": BATCH, "dim": DIM,
+          "clients": 1, "native_table": native_engaged})
+
+    apush_total = OPS * NCLIENTS
+    apush_wall = max(res[r]["dt_push_async"] for r in range(1, world))
+    emit({"metric": "ps_push_async_ops_per_s",
+          "value": round(apush_total / apush_wall, 1), "unit": "ops/s",
+          "MB_s": round(apush_total * row_bytes / apush_wall / 1e6, 1),
+          "batch": BATCH, "dim": DIM, "clients": NCLIENTS,
+          "depth": DEPTH, "coalesced": True,
+          "native_table": native_engaged})
+
+    for row in _parity_rows():
+        emit(row)
+
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump({"bench": "ps_bench", "vocab": VOCAB, "dim": DIM,
+                       "batch": BATCH, "ops": OPS,
+                       "clients": NCLIENTS, "depth": DEPTH,
+                       "measurements": RESULTS}, f, indent=1)
+        print(f"# persisted to {out_path}", flush=True)
 
 
 if __name__ == "__main__":
