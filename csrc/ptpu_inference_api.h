@@ -56,6 +56,25 @@ int ptpu_predictor_output_ndim(PTPU_Predictor*, int i);
 const int64_t* ptpu_predictor_output_dims(PTPU_Predictor*, int i);
 const float* ptpu_predictor_output_data(PTPU_Predictor*, int i);
 
+/* Serving stats since load (always-on): JSON {"runs","total_run_us",
+ * "run_us":{count,sum,buckets[32] log2-us},"ops":{op:{calls,time_us,
+ * bytes}}}. Pointer valid until the next stats_json call on this
+ * predictor (or destroy). */
+const char* ptpu_predictor_stats_json(PTPU_Predictor*);
+void ptpu_predictor_stats_reset(PTPU_Predictor*);
+
+/* Wire a host profiler into op execution (process-global; NULLs
+ * unwire). record_fn(name, begin_us, end_us) receives one span per
+ * executed op (steady-clock microseconds) plus "predictor::run";
+ * spans are emitted only while enabled_fn() returns nonzero. The
+ * Python binding passes _native.so's ptpu_profiler_record /
+ * ptpu_profiler_enabled so serving shares the training chrome trace;
+ * other hosts can pass their own collectors. */
+void ptpu_predictor_set_profiler(
+    void (*record_fn)(const char* name, int64_t begin_us,
+                      int64_t end_us),
+    int (*enabled_fn)(void));
+
 #ifdef __cplusplus
 }  /* extern "C" */
 #endif

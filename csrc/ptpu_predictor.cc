@@ -37,8 +37,23 @@
 #include <vector>
 
 #include "ptpu_arena.h"
+#include "ptpu_stats.h"
 
 namespace {
+
+// ---------------------------------------------------------------- profiler
+// Host-profiler hook: the chrome-trace Profiler singleton lives in
+// _native.so (csrc/ptpu_runtime.cc) and this TU must stay
+// dependency-free, so the binding layer (core/native.py) hands over
+// the three entry points as raw function pointers via
+// ptpu_predictor_set_profiler. When wired AND the profiler is
+// enabled, every executed op emits a RecordEvent span — a serving run
+// lands in the same chrome trace as training ranks
+// (profiler/timeline.py merges them).
+typedef void (*ProfRecordFn)(const char *, int64_t, int64_t);
+typedef int (*ProfEnabledFn)();
+std::atomic<ProfRecordFn> g_prof_record{nullptr};
+std::atomic<ProfEnabledFn> g_prof_enabled{nullptr};
 
 // ------------------------------------------------------------ protobuf wire
 struct Reader {
@@ -1186,6 +1201,42 @@ struct Predictor {
   bool planned_ = false;
   int fused_nodes_ = 0;
 
+  /* Serving stats (csrc/ptpu_stats.h): per-op-type cumulative calls /
+   * wall time / output bytes plus a per-run latency histogram.
+   * Always-on — two steady-clock reads and a pointer bump per node
+   * (run() is single-threaded per instance, so the op aggregates are
+   * plain integers; the histogram shares the lock-free type the PS
+   * server uses). Exposed via ptpu_predictor_stats_json/reset. */
+  struct OpStat {
+    uint64_t calls = 0;
+    uint64_t time_us = 0;
+    uint64_t bytes = 0;
+  };
+  std::map<std::string, OpStat> op_stats_;
+  std::vector<OpStat*> node_stat_;  // per-node pointer into op_stats_
+  ptpu::Histogram run_us_;
+  uint64_t runs_ = 0;
+  uint64_t run_time_us_ = 0;
+  std::string stats_json_;
+
+  /* Rebuild the node -> OpStat index after the load-time rewrites
+   * settle the node list (fusion renames/removes nodes). std::map
+   * nodes are pointer-stable, so the hot loop never rehashes. */
+  void build_stats_index() {
+    node_stat_.clear();
+    node_stat_.reserve(g.nodes.size());
+    for (const auto& n : g.nodes)
+      node_stat_.push_back(&op_stats_[n.op]);
+  }
+
+  void reset_stats() {
+    op_stats_.clear();
+    run_us_.Reset();
+    runs_ = 0;
+    run_time_us_ = 0;
+    build_stats_index();
+  }
+
   const Tensor& in(const Node& n, size_t k) {
     auto it = env.find(n.inputs[k]);
     if (it == env.end())
@@ -1736,7 +1787,14 @@ struct Predictor {
     static const bool profile =
         std::getenv("PTPU_PREDICTOR_PROFILE") != nullptr;
     const bool use_plan = planned_ && inputs_match_plan();
-    std::map<std::string, double> acc;
+    if (node_stat_.size() != g.nodes.size()) build_stats_index();
+    const ProfEnabledFn enabled_fn =
+        g_prof_enabled.load(std::memory_order_relaxed);
+    const ProfRecordFn record_fn =
+        g_prof_record.load(std::memory_order_relaxed);
+    // RecordEvent spans only when the host profiler is wired AND on
+    const bool trace = enabled_fn && record_fn && enabled_fn();
+    const int64_t run_t0 = ptpu::NowUs();
     try {
       for (size_t k = 0; k < g.nodes.size(); ++k) {
         AllocHint hint{use_plan && plan_[k].valid
@@ -1745,26 +1803,40 @@ struct Predictor {
                        use_plan && plan_[k].valid ? plan_[k].bytes : 0,
                        false};
         g_alloc_hint = hint.base ? &hint : nullptr;
-        if (profile) {
-          auto t0 = std::chrono::steady_clock::now();
-          run_node(g.nodes[k]);
-          acc[g.nodes[k].op] += std::chrono::duration<double>(
-              std::chrono::steady_clock::now() - t0).count();
-        } else {
-          run_node(g.nodes[k]);
-        }
+        const Node& n = g.nodes[k];
+        const int64_t t0 = ptpu::NowUs();
+        run_node(n);
+        const int64_t t1 = ptpu::NowUs();
         g_alloc_hint = nullptr;
+        OpStat* s = node_stat_[k];
+        s->calls += 1;
+        s->time_us += uint64_t(t1 - t0);
+        if (!n.outputs.empty()) {
+          auto it = env.find(n.outputs[0]);
+          if (it != env.end()) {
+            const Tensor& t = it->second;
+            s->bytes += uint64_t(t.numel()) *
+                        (t.is_float() ? sizeof(float) : sizeof(int64_t));
+          }
+        }
+        if (trace) record_fn(n.op.c_str(), t0, t1);
       }
     } catch (...) {
       g_alloc_hint = nullptr;  // never leave a dangling stack hint
       throw;
     }
+    const int64_t run_t1 = ptpu::NowUs();
+    runs_ += 1;
+    run_time_us_ += uint64_t(run_t1 - run_t0);
+    run_us_.Observe(uint64_t(run_t1 - run_t0));
+    if (trace) record_fn("predictor::run", run_t0, run_t1);
     if (profile)
       // per-op-type cumulative wall time to stderr — the doctor's view
       // for "which op dominates this artifact"
-      for (const auto& kv : acc)
-        std::fprintf(stderr, "ptpu_profile %-20s %.3f ms\n",
-                     kv.first.c_str(), kv.second * 1e3);
+      for (const auto& kv : op_stats_)
+        std::fprintf(stderr, "ptpu_profile %-20s %.3f ms (%llu calls)\n",
+                     kv.first.c_str(), double(kv.second.time_us) * 1e-3,
+                     (unsigned long long)kv.second.calls);
     for (const auto& name : g.output_names) {
       auto it = env.find(name);
       if (it == env.end())
@@ -2869,6 +2941,7 @@ PTPU_Predictor* ptpu_predictor_create(const char* model_path, char* err,
       p->prepack_weights();
       p->plan_memory();
     }
+    p->build_stats_index();
     return (PTPU_Predictor*)p;
   } catch (const std::exception& e) {
     fill_error(err, err_len, e.what());
@@ -2974,6 +3047,61 @@ const int64_t* ptpu_predictor_output_dims(PTPU_Predictor* h, int i) {
   auto* p = (Predictor*)h;
   if (i < 0 || size_t(i) >= p->outputs.size()) return nullptr;
   return p->outputs[size_t(i)].dims.data();
+}
+
+// ---- observability --------------------------------------------------
+// Serving stats snapshot as JSON: {"runs":N,"total_run_us":T,
+// "run_us":{count,sum,buckets[32]},"ops":{op:{calls,time_us,bytes}}}.
+// The returned pointer is owned by the predictor and valid until the
+// next stats_json call on the same handle (or destroy). Same
+// thread-compatibility contract as run().
+__attribute__((visibility("default")))
+const char* ptpu_predictor_stats_json(PTPU_Predictor* h) {
+  auto* p = (Predictor*)h;
+  std::string out = "{";
+  ptpu::AppendJsonU64(&out, "runs", p->runs_);
+  out += ',';
+  ptpu::AppendJsonU64(&out, "total_run_us", p->run_time_us_);
+  out += ',';
+  ptpu::AppendJsonHist(&out, "run_us", p->run_us_);
+  out += ",\"ops\":{";
+  bool first = true;
+  for (const auto& kv : p->op_stats_) {
+    if (kv.second.calls == 0) continue;  // index entries never executed
+    if (!first) out += ',';
+    first = false;
+    out += '"';
+    out += ptpu::JsonEscape(kv.first);
+    out += "\":{";
+    ptpu::AppendJsonU64(&out, "calls", kv.second.calls);
+    out += ',';
+    ptpu::AppendJsonU64(&out, "time_us", kv.second.time_us);
+    out += ',';
+    ptpu::AppendJsonU64(&out, "bytes", kv.second.bytes);
+    out += '}';
+  }
+  out += "}}";
+  p->stats_json_.swap(out);
+  return p->stats_json_.c_str();
+}
+
+__attribute__((visibility("default")))
+void ptpu_predictor_stats_reset(PTPU_Predictor* h) {
+  ((Predictor*)h)->reset_stats();
+}
+
+// Wire the host profiler (csrc/ptpu_runtime.cc Profiler) into this TU:
+// `record_fn` = ptpu_profiler_record, `enabled_fn` =
+// ptpu_profiler_enabled, both passed as raw addresses by the binding
+// layer (the two .so files must stay independent). Timestamps are
+// steady-clock microseconds on both sides, so predictor spans align
+// with RecordEvent spans in one chrome trace. Process-global; pass
+// nulls to unwire.
+__attribute__((visibility("default")))
+void ptpu_predictor_set_profiler(ProfRecordFn record_fn,
+                                 ProfEnabledFn enabled_fn) {
+  g_prof_record.store(record_fn, std::memory_order_relaxed);
+  g_prof_enabled.store(enabled_fn, std::memory_order_relaxed);
 }
 
 // Output data as float32 (int outputs are converted in place once).

@@ -10,8 +10,10 @@
 #undef NDEBUG
 #include <atomic>
 #include <cassert>
+#include <chrono>
 #include <cstdio>
 #include <random>
+#include <string>
 #include <thread>
 
 namespace {
@@ -181,6 +183,64 @@ void test_create_rejects_bad_args() {
   assert(ptpu_ps_table_create(4, 4, 99, 0.1f, 0, 0, 0) == nullptr);
 }
 
+bool json_has(const std::string &json, const std::string &frag) {
+  return json.find(frag) != std::string::npos;
+}
+
+void test_table_stats_counters() {
+  void *h = ptpu_ps_table_create(8, 2, PTPU_PS_SGD, 0.1f, 0, 0, 0);
+  const int64_t ids[3] = {1, 5, 1};  // one duplicate
+  float out[6];
+  const float g[6] = {0, 0, 0, 0, 0, 0};
+  assert(ptpu_ps_table_pull(h, ids, 3, out) == 0);
+  assert(ptpu_ps_table_pull(h, ids, 2, out) == 0);
+  assert(ptpu_ps_table_push(h, ids, 3, g) == 0);
+  ptpu_ps_table_note_pull(h, 7);  // external-gather credit path
+  std::string j = ptpu_ps_table_stats_json(h);
+  assert(json_has(j, "\"pull_ops\":3"));
+  assert(json_has(j, "\"pull_rows\":12"));  // 3 + 2 + 7
+  assert(json_has(j, "\"push_ops\":1"));
+  assert(json_has(j, "\"push_rows\":3"));
+  // 3 pushed rows collapsed to 2 unique -> 1 coalesced
+  assert(json_has(j, "\"push_coalesced_rows\":1"));
+  // a failed pull (out-of-range id) must not count
+  const int64_t bad[1] = {99};
+  assert(ptpu_ps_table_pull(h, bad, 1, out) == -1);
+  j = ptpu_ps_table_stats_json(h);
+  assert(json_has(j, "\"pull_ops\":3"));
+  ptpu_ps_table_stats_reset(h);
+  j = ptpu_ps_table_stats_json(h);
+  assert(json_has(j, "\"pull_ops\":0"));
+  assert(json_has(j, "\"push_coalesced_rows\":0"));
+  ptpu_ps_table_destroy(h);
+}
+
+void test_stats_hist_buckets() {
+  // log2 bucket layout shared with paddle_tpu/profiler/stats.py —
+  // boundaries must match exactly or native/python merges skew
+  assert(ptpu::HistBucketOf(0) == 0);
+  assert(ptpu::HistBucketOf(1) == 1);
+  assert(ptpu::HistBucketOf(2) == 2);
+  assert(ptpu::HistBucketOf(3) == 2);
+  assert(ptpu::HistBucketOf(4) == 3);
+  assert(ptpu::HistBucketOf(1023) == 10);
+  assert(ptpu::HistBucketOf(1024) == 11);
+  assert(ptpu::HistBucketOf(~0ull) == ptpu::kHistBuckets - 1);
+  ptpu::Histogram hst;
+  hst.Observe(0);
+  hst.Observe(3);
+  hst.Observe(3);
+  assert(hst.count.load() == 3 && hst.sum.load() == 6);
+  assert(hst.buckets[0].load() == 1 && hst.buckets[2].load() == 2);
+  // relaxed counters still sum exactly under contention
+  ptpu::Counter c;
+  std::thread a([&] { for (int i = 0; i < 50000; ++i) c.Add(1); });
+  std::thread b([&] { for (int i = 0; i < 50000; ++i) c.Add(2); });
+  a.join();
+  b.join();
+  assert(c.Get() == 150000);
+}
+
 // ---- data-plane server (ptpu_ps_server.cc) ------------------------------
 
 void test_sha256_known_vector() {
@@ -298,6 +358,35 @@ void test_server_pull_push_roundtrip() {
   send_client_frame(fd, req);
   assert(recv_client_frame(fd)[1] == 0x51);
 
+  // wire stats saw 2 successful pulls (4 rows), 1 push (2 rows), the
+  // unknown-table ERR frame, and credited the table's storage view.
+  // Counters land AFTER the reply write, so the serve thread may trail
+  // the client's recv by an instant — poll briefly.
+  std::string sj, global;
+  for (int spin = 0; spin < 200; ++spin) {
+    sj = ptpu_ps_server_stats_json(srv);
+    // the GLOBAL wire counters only — the per-table sections repeat
+    // the same key names, so asserting on the whole JSON would let a
+    // dead global counter hide behind a live per-table one
+    global = sj.substr(0, sj.find("\"tables\""));
+    if (json_has(global, "\"pull_ops\":2")) break;
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  }
+  assert(json_has(global, "\"pull_ops\":2"));
+  assert(json_has(global, "\"pull_rows\":4"));
+  assert(json_has(global, "\"push_ops\":1"));
+  assert(json_has(global, "\"push_rows\":2"));
+  assert(json_has(global, "\"err_frames\":1"));
+  assert(json_has(sj, "\"emb\""));
+  assert(!json_has(global, "\"count\":0,\"sum\":0"));  // latency seen
+  const std::string tj = ptpu_ps_table_stats_json(t);
+  assert(json_has(tj, "\"pull_ops\":2") && json_has(tj, "\"pull_rows\":4"));
+  ptpu_ps_server_stats_reset(srv);
+  const std::string rj = ptpu_ps_server_stats_json(srv);
+  assert(json_has(rj, "\"pull_ops\":0"));
+  assert(json_has(std::string(ptpu_ps_table_stats_json(t)),
+                  "\"pull_ops\":0"));
+
   ::close(fd);
   // bad authkey must be rejected
   const int fd2 = dial(port);
@@ -319,6 +408,8 @@ int main() {
   test_arena_layout_disjoint();
   test_concurrent_pulls_and_push();
   test_create_rejects_bad_args();
+  test_table_stats_counters();
+  test_stats_hist_buckets();
   test_sha256_known_vector();
   test_server_pull_push_roundtrip();
   std::printf("all native ps-table unit tests passed\n");

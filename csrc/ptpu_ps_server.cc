@@ -38,6 +38,7 @@
 #include <cstdint>
 #include <cstring>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <random>
 #include <string>
@@ -45,6 +46,7 @@
 #include <vector>
 
 #include "ptpu_ps_table.h"
+#include "ptpu_stats.h"
 
 namespace {
 
@@ -210,9 +212,53 @@ bool WriteExact(int fd, const void *p, size_t n) {
   return true;
 }
 
+// Wire-level counters for one exposed table (ptpu_stats.h relaxed
+// atomics; storage-level counters live inside the table itself).
+struct TableWireStats {
+  ptpu::Counter pull_ops, pull_rows, push_ops, push_rows, bytes_in,
+      bytes_out;
+
+  void Reset() {
+    pull_ops.Reset();
+    pull_rows.Reset();
+    push_ops.Reset();
+    push_rows.Reset();
+    bytes_in.Reset();
+    bytes_out.Reset();
+  }
+};
+
+// Server-global wire counters + serve-latency histograms. Always-on:
+// a handful of relaxed fetch_adds and two NowUs reads per frame —
+// noise against the frame's own syscalls (bench-verified <3% on the
+// pipelined pull phase).
+struct ServerStats {
+  ptpu::Counter pull_ops, pull_rows, push_ops, push_rows, bytes_in,
+      bytes_out, err_frames, proto_errors, handshake_fails,
+      conns_accepted;
+  std::atomic<int64_t> conns_active{0};
+  ptpu::Histogram pull_us, push_us;  // frame-read -> reply-written
+
+  void Reset() {
+    pull_ops.Reset();
+    pull_rows.Reset();
+    push_ops.Reset();
+    push_rows.Reset();
+    bytes_in.Reset();
+    bytes_out.Reset();
+    err_frames.Reset();
+    proto_errors.Reset();
+    handshake_fails.Reset();
+    conns_accepted.Reset();
+    pull_us.Reset();
+    push_us.Reset();
+  }
+};
+
 struct ShardEntry {
   void *table;
   int64_t lo;  // global-id offset of this shard's first row
+  TableWireStats *wire;  // owned by PsServer::table_stats
 };
 
 struct PsServer {
@@ -223,6 +269,10 @@ struct PsServer {
   std::thread accept_thread;
   std::mutex mu;  // guards tables + conn bookkeeping
   std::map<std::string, ShardEntry> tables;
+  // per-table wire stats: pointers are handed to ShardEntry copies, so
+  // entries are never erased (re-register reuses the slot)
+  std::map<std::string, std::unique_ptr<TableWireStats>> table_stats;
+  ServerStats stats;
   std::vector<int> conn_fds;
   std::vector<std::thread> conn_threads;
   std::vector<std::thread::id> done_threads;  // finished, join pending
@@ -281,6 +331,8 @@ struct PsServer {
     f[8] = uint8_t(n >> 16);
     f[9] = uint8_t(n >> 24);
     std::memcpy(f.data() + 10, msg.data(), msg.size());
+    stats.err_frames.Add(1);
+    stats.bytes_out.Add(f.size());
     return SendFrame(fd, nullptr, uint32_t(f.size() - 4), &f);
   }
 
@@ -309,24 +361,32 @@ struct PsServer {
     std::vector<uint8_t> req;
     std::vector<uint8_t> rep;  // reused: [4B length][frame payload]
     std::vector<int64_t> local;
-    if (!Handshake(fd)) return;
+    if (!Handshake(fd)) {
+      stats.handshake_fails.Add(1);
+      return;
+    }
+    // drop-the-connection protocol errors are counted before the
+    // return — the wire half of the Python plane's frame_errors
+    const auto proto_err = [this]() { stats.proto_errors.Add(1); };
     for (;;) {
       uint8_t lenb[4];
       if (!ReadExact(fd, lenb, 4)) return;
       const uint32_t n = uint32_t(lenb[0]) | uint32_t(lenb[1]) << 8 |
                          uint32_t(lenb[2]) << 16 |
                          uint32_t(lenb[3]) << 24;
-      if (n < 2 || n > kMaxFrame) return;
+      if (n < 2 || n > kMaxFrame) return proto_err();
       if (req.size() < n) req.resize(n);
       if (!ReadExact(fd, req.data(), n)) return;
-      if (req[0] != kWireVersion) return;
+      const int64_t t0 = ptpu::NowUs();
+      stats.bytes_in.Add(4 + uint64_t(n));
+      if (req[0] != kWireVersion) return proto_err();
       const uint8_t tag = req[1];
-      if (tag != kTagPullReq && tag != kTagPushReq) return;
+      if (tag != kTagPullReq && tag != kTagPushReq) return proto_err();
       // [u8 tlen][table]
-      if (n < 3) return;
+      if (n < 3) return proto_err();
       const uint8_t tlen = req[2];
       size_t off = 3 + tlen;
-      if (n < off) return;
+      if (n < off) return proto_err();
       const std::string table(reinterpret_cast<char *>(req.data() + 3),
                               tlen);
       ShardEntry entry;
@@ -341,13 +401,14 @@ struct PsServer {
         }
         entry = it->second;
       }
+      entry.wire->bytes_in.Add(4 + uint64_t(n));
       if (tag == kTagPullReq) {
         // [u32 n][n x i64 ids]
-        if (n < off + 4) return;
+        if (n < off + 4) return proto_err();
         uint32_t cnt;
         std::memcpy(&cnt, req.data() + off, 4);
         off += 4;
-        if (n != off + 8ull * cnt) return;
+        if (n != off + 8ull * cnt) return proto_err();
         // bound the REPLY like the request: a small ids frame must not
         // be able to demand a multi-GB gather allocation
         if (10 + size_t(cnt) * size_t(ptpu_ps_table_dim(entry.table)) *
@@ -396,9 +457,17 @@ struct PsServer {
           continue;
         }
         if (!WriteExact(fd, rep.data(), 4 + size_t(flen))) return;
+        ptpu_ps_table_note_pull(entry.table, int64_t(cnt));
+        stats.pull_ops.Add(1);
+        stats.pull_rows.Add(cnt);
+        stats.bytes_out.Add(4 + uint64_t(flen));
+        stats.pull_us.Observe(uint64_t(ptpu::NowUs() - t0));
+        entry.wire->pull_ops.Add(1);
+        entry.wire->pull_rows.Add(cnt);
+        entry.wire->bytes_out.Add(4 + uint64_t(flen));
       } else {
         // [u8 flags][u32 n][u32 dim][ids][grads]
-        if (n < off + 9) return;
+        if (n < off + 9) return proto_err();
         const bool is_async = req[off] != 0;
         (void)is_async;  // C applies inline — ack-after-apply is a
                          // strictly stronger contract than coalesce
@@ -406,13 +475,23 @@ struct PsServer {
         std::memcpy(&cnt, req.data() + off + 1, 4);
         std::memcpy(&d32, req.data() + off + 5, 4);
         off += 9;
-        if (n != off + 8ull * cnt + 4ull * cnt * d32) return;
+        if (n != off + 8ull * cnt + 4ull * cnt * d32) return proto_err();
         const int64_t dim = ptpu_ps_table_dim(entry.table);
+        const auto count_push = [&](uint32_t rows) {
+          stats.push_ops.Add(1);
+          stats.push_rows.Add(rows);
+          stats.bytes_out.Add(6);  // 4B length + OK frame
+          stats.push_us.Observe(uint64_t(ptpu::NowUs() - t0));
+          entry.wire->push_ops.Add(1);
+          entry.wire->push_rows.Add(rows);
+          entry.wire->bytes_out.Add(6);
+        };
         if (cnt == 0) {  // empty push (dim underivable): trivially ok
           if (rep.size() < 6) rep.resize(6);
           rep[4] = kWireVersion;
           rep[5] = kTagOk;
           if (!SendFrame(fd, nullptr, 2, &rep)) return;
+          count_push(0);
           continue;
         }
         if (int64_t(d32) != dim) {
@@ -439,6 +518,7 @@ struct PsServer {
         rep[4] = kWireVersion;
         rep[5] = kTagOk;
         if (!SendFrame(fd, nullptr, 2, &rep)) return;
+        count_push(cnt);
       }
     }
   }
@@ -476,6 +556,7 @@ struct PsServer {
         return;
       }
       ReapFinished();
+      stats.conns_accepted.Add(1);
       const int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       // deep pipelines keep several MB in flight per connection; a
@@ -489,10 +570,12 @@ struct PsServer {
         // an escaping exception (e.g. bad_alloc on a hostile frame)
         // would std::terminate the whole process — contain it to this
         // connection, like the Python plane's drop-on-malformed
+        stats.conns_active.fetch_add(1, std::memory_order_relaxed);
         try {
           Serve(fd);
         } catch (...) {
         }
+        stats.conns_active.fetch_sub(1, std::memory_order_relaxed);
         {
           // prune BEFORE close: once closed, the OS may reuse the fd
           // number and Stop() must not shutdown an unrelated socket
@@ -569,8 +652,83 @@ PTPU_PS_EXPORT int ptpu_ps_server_register(void *h, const char *name,
                                            void *table, int64_t lo) {
   auto *s = static_cast<PsServer *>(h);
   std::lock_guard<std::mutex> g(s->mu);
-  s->tables[name] = ShardEntry{table, lo};
+  auto &ws = s->table_stats[name];
+  if (!ws) ws.reset(new TableWireStats());
+  s->tables[name] = ShardEntry{table, lo, ws.get()};
   return 0;
+}
+
+// JSON snapshot: {"server":{global wire counters + pull_us/push_us
+// histograms}, "tables":{name:{"wire":{...},"table":{storage counters
+// from ptpu_ps_table_stats_json}}}}. Returned pointer is a
+// thread-local render buffer, valid until the calling thread's next
+// ptpu_ps_server_stats_json call.
+PTPU_PS_EXPORT const char *ptpu_ps_server_stats_json(void *h) {
+  thread_local std::string g_json;
+  auto *s = static_cast<PsServer *>(h);
+  std::string out = "{\"server\":{";
+  const ServerStats &st = s->stats;
+  const struct { const char *name; const ptpu::Counter *c; } cs[] = {
+      {"pull_ops", &st.pull_ops},       {"pull_rows", &st.pull_rows},
+      {"push_ops", &st.push_ops},       {"push_rows", &st.push_rows},
+      {"bytes_in", &st.bytes_in},       {"bytes_out", &st.bytes_out},
+      {"err_frames", &st.err_frames},   {"proto_errors", &st.proto_errors},
+      {"handshake_fails", &st.handshake_fails},
+      {"conns_accepted", &st.conns_accepted},
+  };
+  for (const auto &kv : cs) {
+    ptpu::AppendJsonU64(&out, kv.name, kv.c->Get());
+    out += ',';
+  }
+  ptpu::AppendJsonU64(&out, "conns_active",
+                      uint64_t(st.conns_active.load(
+                          std::memory_order_relaxed)));
+  out += ',';
+  ptpu::AppendJsonHist(&out, "pull_us", st.pull_us);
+  out += ',';
+  ptpu::AppendJsonHist(&out, "push_us", st.push_us);
+  out += "},\"tables\":{";
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    bool first = true;
+    for (const auto &kv : s->tables) {
+      if (!first) out += ',';
+      first = false;
+      out += '"';
+      out += ptpu::JsonEscape(kv.first);
+      out += "\":{\"wire\":{";
+      const TableWireStats &w = *kv.second.wire;
+      const struct { const char *name; const ptpu::Counter *c; } ws[] = {
+          {"pull_ops", &w.pull_ops},   {"pull_rows", &w.pull_rows},
+          {"push_ops", &w.push_ops},   {"push_rows", &w.push_rows},
+          {"bytes_in", &w.bytes_in},   {"bytes_out", &w.bytes_out},
+      };
+      bool wfirst = true;
+      for (const auto &c : ws) {
+        if (!wfirst) out += ',';
+        wfirst = false;
+        ptpu::AppendJsonU64(&out, c.name, c.c->Get());
+      }
+      out += "},\"table\":";
+      out += ptpu_ps_table_stats_json(kv.second.table);
+      out += '}';
+    }
+  }
+  out += "}}";
+  g_json.swap(out);
+  return g_json.c_str();
+}
+
+// Reset wire counters (global + per-table) AND the storage counters of
+// every registered table — one call zeroes the whole serving view.
+PTPU_PS_EXPORT void ptpu_ps_server_stats_reset(void *h) {
+  auto *s = static_cast<PsServer *>(h);
+  s->stats.Reset();
+  std::lock_guard<std::mutex> g(s->mu);
+  for (auto &kv : s->tables) {
+    kv.second.wire->Reset();
+    ptpu_ps_table_stats_reset(kv.second.table);
+  }
 }
 
 PTPU_PS_EXPORT void ptpu_ps_server_stop(void *h) {

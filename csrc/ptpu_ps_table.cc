@@ -28,6 +28,7 @@
 #include <vector>
 
 #include "ptpu_arena.h"
+#include "ptpu_stats.h"
 
 namespace {
 
@@ -53,6 +54,11 @@ struct PsTable {
   int64_t *steps = nullptr;  // adam per-row step count (rows)
 
   std::shared_mutex mu;
+
+  // storage-level counters (ptpu_stats.h): relaxed atomics, safe to
+  // bump under either lock mode and to snapshot without any lock
+  ptpu::Counter pull_ops, pull_rows, push_ops, push_rows,
+      push_coalesced_rows;
 
   // push scratch, reused across calls (guarded by the exclusive lock):
   // open-addressed id->slot map + first-seen unique list + accumulators
@@ -251,6 +257,8 @@ PTPU_PS_EXPORT int ptpu_ps_table_pull(void *h, const int64_t *ids,
     }
     std::memcpy(out + i * dim, t->w + id * dim, size_t(dim) * sizeof(float));
   }
+  t->pull_ops.Add(1);
+  t->pull_rows.Add(uint64_t(n));
   return 0;
 }
 
@@ -261,6 +269,9 @@ PTPU_PS_EXPORT int ptpu_ps_table_push(void *h, const int64_t *ids,
   std::unique_lock<std::shared_mutex> lock(t->mu);
   if (!coalesce(t, ids, n, grads)) return -1;
   apply_update(t);
+  t->push_ops.Add(1);
+  t->push_rows.Add(uint64_t(n));
+  t->push_coalesced_rows.Add(uint64_t(n) - t->uniq.size());
   return 0;
 }
 
@@ -270,4 +281,40 @@ PTPU_PS_EXPORT void ptpu_ps_table_rdlock(void *h) {
 
 PTPU_PS_EXPORT void ptpu_ps_table_rdunlock(void *h) {
   static_cast<PsTable *>(h)->mu.unlock_shared();
+}
+
+PTPU_PS_EXPORT void ptpu_ps_table_note_pull(void *h, int64_t nrows) {
+  auto *t = static_cast<PsTable *>(h);
+  t->pull_ops.Add(1);
+  t->pull_rows.Add(uint64_t(nrows));
+}
+
+PTPU_PS_EXPORT const char *ptpu_ps_table_stats_json(void *h) {
+  // thread_local render buffer (like g_last_error): concurrent
+  // snapshotters never clobber each other's in-flight c_str
+  thread_local std::string g_stats_json;
+  auto *t = static_cast<PsTable *>(h);
+  std::string out = "{";
+  ptpu::AppendJsonU64(&out, "pull_ops", t->pull_ops.Get());
+  out += ',';
+  ptpu::AppendJsonU64(&out, "pull_rows", t->pull_rows.Get());
+  out += ',';
+  ptpu::AppendJsonU64(&out, "push_ops", t->push_ops.Get());
+  out += ',';
+  ptpu::AppendJsonU64(&out, "push_rows", t->push_rows.Get());
+  out += ',';
+  ptpu::AppendJsonU64(&out, "push_coalesced_rows",
+                      t->push_coalesced_rows.Get());
+  out += '}';
+  g_stats_json.swap(out);
+  return g_stats_json.c_str();
+}
+
+PTPU_PS_EXPORT void ptpu_ps_table_stats_reset(void *h) {
+  auto *t = static_cast<PsTable *>(h);
+  t->pull_ops.Reset();
+  t->pull_rows.Reset();
+  t->push_ops.Reset();
+  t->push_rows.Reset();
+  t->push_coalesced_rows.Reset();
 }

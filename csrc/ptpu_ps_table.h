@@ -71,4 +71,20 @@ PTPU_PS_EXPORT int ptpu_ps_table_push(void *h, const int64_t *ids,
 PTPU_PS_EXPORT void ptpu_ps_table_rdlock(void *h);
 PTPU_PS_EXPORT void ptpu_ps_table_rdunlock(void *h);
 
+// ---- observability (csrc/ptpu_stats.h core) -------------------------
+// Storage-level counters, always-on relaxed atomics: pull_ops /
+// pull_rows / push_ops / push_rows / push_coalesced_rows (duplicate
+// ids merged before the optimizer ran). The numpy fallback shard
+// (distributed/ps/table.py) maintains the same names so native and
+// fallback snapshots are comparable.
+
+// JSON snapshot of the table's counters. The returned pointer is a
+// thread-local render buffer, valid until the calling thread's next
+// ptpu_ps_table_stats_json call.
+PTPU_PS_EXPORT const char *ptpu_ps_table_stats_json(void *h);
+PTPU_PS_EXPORT void ptpu_ps_table_stats_reset(void *h);
+// Credit a pull served by an external gather (the data-plane server
+// copies rows under rdlock without calling ptpu_ps_table_pull).
+PTPU_PS_EXPORT void ptpu_ps_table_note_pull(void *h, int64_t nrows);
+
 #endif  // PTPU_PS_TABLE_H_

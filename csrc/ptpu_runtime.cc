@@ -350,6 +350,12 @@ class Profiler {
 
 PTPU_EXPORT void ptpu_profiler_enable() { Profiler::Get().Enable(); }
 PTPU_EXPORT void ptpu_profiler_disable() { Profiler::Get().Disable(); }
+// cheap on/off probe — the predictor's RecordEvent hook gates per-op
+// span emission on it (core/native.py passes this fn's address to
+// ptpu_predictor_set_profiler)
+PTPU_EXPORT int ptpu_profiler_enabled() {
+  return Profiler::Get().Enabled() ? 1 : 0;
+}
 PTPU_EXPORT int64_t ptpu_profiler_now_us() { return Profiler::Get().NowUs(); }
 PTPU_EXPORT void ptpu_profiler_record(const char *name, int64_t begin_us,
                                       int64_t end_us) {

@@ -246,6 +246,40 @@ void test_pack_b_im2col_matches_reference() {
   }
 }
 
+void test_predictor_run_stats_accumulate() {
+  // hand-built one-node graph: run() must time the node, count the
+  // run, and render it all in stats_json (the ABI the Python binding
+  // parses); reset must zero it
+  Predictor p;
+  Node n;
+  n.op = "Relu";
+  n.inputs = {"x"};
+  n.outputs = {"y"};
+  p.g.nodes.push_back(n);
+  p.g.output_names = {"y"};
+  Tensor x;
+  x.dtype = DT_F32;
+  x.dims = {4};
+  const std::vector<float> vals{-1.f, 2.f, -3.f, 4.f};
+  x.f.assign(vals.begin(), vals.end());
+  p.env["x"] = x;
+  p.build_stats_index();
+  p.run();
+  p.env["x"] = x;
+  p.run();
+  assert(p.runs_ == 2);
+  assert(p.op_stats_["Relu"].calls == 2);
+  assert(p.op_stats_["Relu"].bytes == 2 * 4 * sizeof(float));
+  assert(p.run_us_.count.load() == 2);
+  const std::string j =
+      ptpu_predictor_stats_json((PTPU_Predictor*)&p);
+  assert(j.find("\"runs\":2") != std::string::npos);
+  assert(j.find("\"Relu\"") != std::string::npos);
+  assert(j.find("\"calls\":2") != std::string::npos);
+  ptpu_predictor_stats_reset((PTPU_Predictor*)&p);
+  assert(p.runs_ == 0 && p.op_stats_["Relu"].calls == 0);
+}
+
 }  // namespace
 
 int main() {
@@ -261,6 +295,7 @@ int main() {
   test_workpool_two_thread_stress();
   test_plan_arena_reuses_offsets();
   test_pack_b_im2col_matches_reference();
+  test_predictor_run_stats_accumulate();
   std::printf("ptpu_selftest: all native unit tests passed\n");
   return 0;
 }

@@ -1,0 +1,130 @@
+// Shared lock-free stats core for the native subsystems (reference:
+// platform/monitor.h StatValue + the bvar counters behind brpc's
+// /vars page). One header, no TU: relaxed-atomic counters and
+// fixed-bucket log2 latency histograms that both the native predictor
+// (csrc/ptpu_predictor.cc) and the PS table/server
+// (csrc/ptpu_ps_table.cc, csrc/ptpu_ps_server.cc) embed, plus the
+// JSON render helpers their *_stats_json ABI calls share.
+//
+// Cost model: always-on. An idle subsystem pays nothing; a hot path
+// pays one relaxed fetch_add per counter touch and three per
+// histogram observation — no locks, no allocation, no syscalls.
+// Python keeps the SAME bucket layout (paddle_tpu/profiler/stats.py)
+// so native and fallback snapshots merge bucket-for-bucket.
+#ifndef PTPU_STATS_H_
+#define PTPU_STATS_H_
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <string>
+
+namespace ptpu {
+
+inline int64_t NowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct Counter {
+  std::atomic<uint64_t> v{0};
+
+  void Add(uint64_t d) { v.fetch_add(d, std::memory_order_relaxed); }
+  uint64_t Get() const { return v.load(std::memory_order_relaxed); }
+  void Reset() { v.store(0, std::memory_order_relaxed); }
+};
+
+// Log2 histogram: bucket 0 counts value 0, bucket b (1..kHistBuckets-2)
+// counts values in [2^(b-1), 2^b), the last bucket is the overflow
+// tail. 32 buckets cover 0 .. >1073s when values are microseconds.
+constexpr int kHistBuckets = 32;
+
+inline int HistBucketOf(uint64_t v) {
+  if (v == 0) return 0;
+  int bits = 0;
+#if defined(__GNUC__) || defined(__clang__)
+  bits = 64 - __builtin_clzll(v);
+#else
+  while (v) {
+    ++bits;
+    v >>= 1;
+  }
+#endif
+  return bits < kHistBuckets - 1 ? bits : kHistBuckets - 1;
+}
+
+struct Histogram {
+  std::atomic<uint64_t> buckets[kHistBuckets] = {};
+  std::atomic<uint64_t> count{0};
+  std::atomic<uint64_t> sum{0};
+
+  void Observe(uint64_t v) {
+    buckets[HistBucketOf(v)].fetch_add(1, std::memory_order_relaxed);
+    count.fetch_add(1, std::memory_order_relaxed);
+    sum.fetch_add(v, std::memory_order_relaxed);
+  }
+
+  void Reset() {
+    for (auto &b : buckets) b.store(0, std::memory_order_relaxed);
+    count.store(0, std::memory_order_relaxed);
+    sum.store(0, std::memory_order_relaxed);
+  }
+};
+
+inline std::string JsonEscape(const std::string &s) {
+  std::string out;
+  out.reserve(s.size() + 8);
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += static_cast<char>(c);
+        }
+    }
+  }
+  return out;
+}
+
+// `"name":value` — callers add the separating commas/braces.
+inline void AppendJsonU64(std::string *out, const char *name,
+                          uint64_t v) {
+  *out += '"';
+  *out += name;
+  *out += "\":";
+  *out += std::to_string(v);
+}
+
+// `"name":{"count":..,"sum":..,"buckets":[..]}` — the shape
+// paddle_tpu/profiler/stats.py Histogram.to_dict() emits, so snapshots
+// from either side merge field-for-field.
+inline void AppendJsonHist(std::string *out, const char *name,
+                           const Histogram &h) {
+  *out += '"';
+  *out += name;
+  *out += "\":{";
+  AppendJsonU64(out, "count", h.count.load(std::memory_order_relaxed));
+  *out += ',';
+  AppendJsonU64(out, "sum", h.sum.load(std::memory_order_relaxed));
+  *out += ",\"buckets\":[";
+  for (int b = 0; b < kHistBuckets; ++b) {
+    if (b) *out += ',';
+    *out += std::to_string(
+        h.buckets[b].load(std::memory_order_relaxed));
+  }
+  *out += "]}";
+}
+
+}  // namespace ptpu
+
+#endif  // PTPU_STATS_H_

@@ -189,3 +189,29 @@ func (p *Predictor) Output(i int) ([]float32, []int64) {
 	runtime.KeepAlive(p)
 	return out, dims
 }
+
+// StatsJSON returns the predictor's serving stats snapshot (always-on
+// per-op calls/time/bytes + per-run latency histogram) as the JSON
+// string ptpu_predictor_stats_json renders — unmarshal with
+// encoding/json if structured access is needed.
+func (p *Predictor) StatsJSON() string {
+	s := C.GoString(C.ptpu_predictor_stats_json(p.p))
+	runtime.KeepAlive(p)
+	return s
+}
+
+// StatsReset zeroes the serving stats.
+func (p *Predictor) StatsReset() {
+	C.ptpu_predictor_stats_reset(p.p)
+	runtime.KeepAlive(p)
+}
+
+// SetProfiler wires host-profiler callbacks into op execution
+// (process-global; nil unwires). The arguments must be C FUNCTION
+// pointers matching the ptpu_inference_api.h signatures — e.g.
+// dlsym'd from a collector library; Go functions cannot be passed
+// directly without a cgo export trampoline.
+func SetProfiler(recordFn, enabledFn unsafe.Pointer) {
+	C.ptpu_predictor_set_profiler(
+		(*[0]byte)(recordFn), (*[0]byte)(enabledFn))
+}

@@ -107,6 +107,11 @@ def _load() -> Optional[ctypes.CDLL]:
             lib._ptpu_has_feed = True
         except AttributeError:
             lib._ptpu_has_feed = False
+        try:
+            lib.ptpu_profiler_enabled.restype = ctypes.c_int
+            lib._ptpu_has_prof_enabled = True
+        except AttributeError:  # stale prebuilt .so
+            lib._ptpu_has_prof_enabled = False
         _LIB = lib
         return _LIB
 
@@ -351,6 +356,15 @@ def _ps_load() -> Optional[ctypes.CDLL]:
             # (delete paddle_tpu/_native_ps.so and re-import to rebuild)
             return None
         try:
+            lib.ptpu_ps_table_stats_json.restype = c.c_char_p
+            lib.ptpu_ps_table_stats_json.argtypes = [c.c_void_p]
+            lib.ptpu_ps_table_stats_reset.argtypes = [c.c_void_p]
+            lib.ptpu_ps_table_note_pull.argtypes = [c.c_void_p,
+                                                    c.c_int64]
+            lib._ptpu_has_ps_stats = True
+        except AttributeError:   # stale prebuilt .so: stats degrade
+            lib._ptpu_has_ps_stats = False
+        try:
             lib.ptpu_ps_server_last_error.restype = c.c_char_p
             lib.ptpu_ps_server_start.restype = c.c_void_p
             lib.ptpu_ps_server_start.argtypes = [c.c_int, c.c_char_p,
@@ -363,6 +377,13 @@ def _ps_load() -> Optional[ctypes.CDLL]:
             lib._ptpu_has_ps_server = True
         except AttributeError:
             lib._ptpu_has_ps_server = False
+        try:
+            lib.ptpu_ps_server_stats_json.restype = c.c_char_p
+            lib.ptpu_ps_server_stats_json.argtypes = [c.c_void_p]
+            lib.ptpu_ps_server_stats_reset.argtypes = [c.c_void_p]
+            lib._ptpu_has_ps_server_stats = True
+        except AttributeError:
+            lib._ptpu_has_ps_server_stats = False
         _PS_LIB = lib
         return _PS_LIB
 
@@ -403,6 +424,24 @@ class PsDataServer:
         self._l.ptpu_ps_server_register(self._h, name.encode(),
                                         table._h, lo)
         self._tables[name] = table
+
+    def stats(self) -> Optional[dict]:
+        """Wire + per-table stats snapshot of the C serve loop
+        (`ptpu_ps_server_stats_json`): {"server": {...counters,
+        pull_us/push_us histograms...}, "tables": {name: {"wire": ...,
+        "table": storage counters}}}. None when the .so predates the
+        stats ABI."""
+        if not getattr(self, "_h", None) or \
+                not self._l._ptpu_has_ps_server_stats:
+            return None
+        import json
+        return json.loads(
+            self._l.ptpu_ps_server_stats_json(self._h).decode())
+
+    def stats_reset(self) -> None:
+        if getattr(self, "_h", None) and \
+                self._l._ptpu_has_ps_server_stats:
+            self._l.ptpu_ps_server_stats_reset(self._h)
 
     def stop(self):
         if getattr(self, "_h", None):
@@ -494,6 +533,22 @@ class NativePsTable:
         if rc != 0:
             raise ValueError(self._l.ptpu_ps_last_error().decode())
 
+    def stats(self) -> Optional[dict]:
+        """Storage-level counters (pull/push ops, rows, coalesced
+        rows) — the same names the numpy fallback shard keeps, so
+        native-vs-fallback snapshots are comparable. None when the .so
+        predates the stats ABI."""
+        if not getattr(self, "_h", None) or \
+                not self._l._ptpu_has_ps_stats:
+            return None
+        import json
+        return json.loads(
+            self._l.ptpu_ps_table_stats_json(self._h).decode())
+
+    def stats_reset(self) -> None:
+        if getattr(self, "_h", None) and self._l._ptpu_has_ps_stats:
+            self._l.ptpu_ps_table_stats_reset(self._h)
+
     def close(self):
         if getattr(self, "_h", None):
             self._l.ptpu_ps_table_destroy(self._h)
@@ -552,6 +607,25 @@ def _predictor_lib() -> ctypes.CDLL:
         lib.ptpu_predictor_output_dims.argtypes = [c.c_void_p, c.c_int]
         lib.ptpu_predictor_output_data.restype = c.POINTER(c.c_float)
         lib.ptpu_predictor_output_data.argtypes = [c.c_void_p, c.c_int]
+        try:
+            lib.ptpu_predictor_stats_json.restype = c.c_char_p
+            lib.ptpu_predictor_stats_json.argtypes = [c.c_void_p]
+            lib.ptpu_predictor_stats_reset.argtypes = [c.c_void_p]
+            lib.ptpu_predictor_set_profiler.argtypes = [c.c_void_p,
+                                                        c.c_void_p]
+            lib._ptpu_has_pred_stats = True
+        except AttributeError:   # stale prebuilt .so: stats degrade
+            lib._ptpu_has_pred_stats = False
+        # Wire the host profiler (csrc/ptpu_runtime.cc, a separate .so)
+        # into the predictor: per-op RecordEvent spans when profiling
+        # is on, so serving runs land in the same chrome trace as
+        # training ranks (profiler/timeline.py merges them).
+        if lib._ptpu_has_pred_stats and available():
+            rl = _load()
+            if getattr(rl, "_ptpu_has_prof_enabled", False):
+                lib.ptpu_predictor_set_profiler(
+                    c.cast(rl.ptpu_profiler_record, c.c_void_p),
+                    c.cast(rl.ptpu_profiler_enabled, c.c_void_p))
         _PRED_LIB = lib
         return lib
 
@@ -643,6 +717,21 @@ class NativePredictor:
         if self._lib.ptpu_predictor_run(self._handle(), self._err, 512) != 0:
             raise RuntimeError("run: " + self._err.value.decode())
 
+    def stats(self) -> Optional[dict]:
+        """Serving stats since load/reset: {"runs", "total_run_us",
+        "run_us": log2-histogram, "ops": {op: {"calls", "time_us",
+        "bytes"}}}. Always-on in the C engine; None when the .so
+        predates the stats ABI."""
+        if not self._lib._ptpu_has_pred_stats:
+            return None
+        import json
+        return json.loads(
+            self._lib.ptpu_predictor_stats_json(self._handle()).decode())
+
+    def stats_reset(self) -> None:
+        if self._lib._ptpu_has_pred_stats:
+            self._lib.ptpu_predictor_stats_reset(self._handle())
+
     def output(self, i: int = 0):
         np = self._np
         nd = self._lib.ptpu_predictor_output_ndim(self._handle(), i)
@@ -651,3 +740,55 @@ class NativePredictor:
         data = self._lib.ptpu_predictor_output_data(self._handle(), i)
         n = int(np.prod(shape)) if shape else 1
         return np.ctypeslib.as_array(data, shape=(n,)).reshape(shape).copy()
+
+
+# ---------------------------------------------------------------------------
+# C ABI manifest — every exported symbol this binding layer (or the
+# tests' hand-rolled ctypes) relies on, per shared object. The tier-1
+# ABI-drift test (tests/test_observability.py) dlopen-checks each list
+# against the built .so, so a symbol dropped or renamed in csrc fails
+# at test time instead of at the first ctypes call in production.
+# Adding a binding above? Add its symbol here.
+# ---------------------------------------------------------------------------
+
+ABI_SYMBOLS = {
+    "_native.so": (
+        "ptpu_last_error", "ptpu_version",
+        "ptpu_arena_create", "ptpu_arena_destroy", "ptpu_arena_alloc",
+        "ptpu_arena_free", "ptpu_arena_in_use", "ptpu_arena_peak",
+        "ptpu_arena_reserved",
+        "ptpu_queue_create", "ptpu_queue_destroy", "ptpu_queue_push",
+        "ptpu_queue_pop", "ptpu_queue_close", "ptpu_queue_size",
+        "ptpu_profiler_enable", "ptpu_profiler_disable",
+        "ptpu_profiler_enabled", "ptpu_profiler_now_us",
+        "ptpu_profiler_record", "ptpu_profiler_dump",
+        "ptpu_profiler_count", "ptpu_profiler_clear",
+        "ptpu_stat_add", "ptpu_stat_get", "ptpu_stat_reset",
+        "ptpu_aes_ctr_xcrypt", "ptpu_feed_count", "ptpu_feed_parse",
+    ),
+    "_native_ps.so": (
+        "ptpu_ps_last_error", "ptpu_ps_version",
+        "ptpu_ps_table_create", "ptpu_ps_table_destroy",
+        "ptpu_ps_table_data", "ptpu_ps_table_rows",
+        "ptpu_ps_table_dim", "ptpu_ps_table_bytes",
+        "ptpu_ps_table_pull", "ptpu_ps_table_push",
+        "ptpu_ps_table_rdlock", "ptpu_ps_table_rdunlock",
+        "ptpu_ps_table_stats_json", "ptpu_ps_table_stats_reset",
+        "ptpu_ps_table_note_pull",
+        "ptpu_ps_server_last_error", "ptpu_ps_server_start",
+        "ptpu_ps_server_port", "ptpu_ps_server_register",
+        "ptpu_ps_server_stop", "ptpu_ps_server_stats_json",
+        "ptpu_ps_server_stats_reset",
+    ),
+    "_native_predictor.so": (
+        "ptpu_predictor_create", "ptpu_predictor_destroy",
+        "ptpu_predictor_num_inputs", "ptpu_predictor_num_outputs",
+        "ptpu_predictor_num_nodes", "ptpu_predictor_fused_nodes",
+        "ptpu_predictor_arena_bytes", "ptpu_predictor_input_name",
+        "ptpu_predictor_set_input", "ptpu_predictor_set_input_i32",
+        "ptpu_predictor_set_input_i64", "ptpu_predictor_run",
+        "ptpu_predictor_output_ndim", "ptpu_predictor_output_dims",
+        "ptpu_predictor_output_data", "ptpu_predictor_stats_json",
+        "ptpu_predictor_stats_reset", "ptpu_predictor_set_profiler",
+    ),
+}

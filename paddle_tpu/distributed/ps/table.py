@@ -35,6 +35,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ...profiler import stats as pstats
 from . import wire
 from .wire import recv_msg, send_msg
 
@@ -250,10 +251,38 @@ class _Shard:
                 self._v = np.zeros((rows, dim), np.float32)
                 self._t = np.zeros(rows, np.int64)
         self._lock = threading.Lock()
+        # storage-level counters for the NUMPY backend only — the
+        # native table counts inside C (same names), so stats() is one
+        # contract whichever backend serves (csrc/ptpu_ps_table.cc)
+        self._stats = pstats.Registry()
 
     @property
     def native(self) -> bool:
         return self._native is not None
+
+    _STAT_NAMES = ("pull_ops", "pull_rows", "push_ops", "push_rows",
+                   "push_coalesced_rows")
+
+    def stats(self) -> dict:
+        """Storage-level counters with the SAME names whichever backend
+        holds the rows: the native table renders them from C
+        (`ptpu_ps_table_stats_json`), the numpy fallback from its own
+        registry — native-vs-fallback snapshots are comparable."""
+        if self._native is not None:
+            snap = self._native.stats() or {}
+        else:
+            snap = self._stats.snapshot()
+        out = {"backend": "native" if self._native is not None
+               else "numpy"}
+        for k in self._STAT_NAMES:
+            out[k] = int(snap.get(k, 0))
+        return out
+
+    def stats_reset(self) -> None:
+        if self._native is not None:
+            self._native.stats_reset()
+        else:
+            self._stats.reset()
 
     def _local(self, ids: np.ndarray) -> np.ndarray:
         local = np.asarray(ids, np.int64) - self.lo
@@ -280,6 +309,8 @@ class _Shard:
             return
         with self._lock:
             np.take(self.data, local, axis=0, out=out)
+        self._stats.counter("pull_ops").add(1)
+        self._stats.counter("pull_rows").add(int(local.size))
 
     def push(self, ids: np.ndarray, grads: np.ndarray):
         """Server-side optimizer runs in the table (reference:
@@ -289,9 +320,13 @@ class _Shard:
         if self._native is not None:
             self._native.push(local, g)
             return
+        self._stats.counter("push_ops").add(1)
+        self._stats.counter("push_rows").add(int(local.size))
         with self._lock:
             # scatter-add duplicates, then one update per unique row
             uniq, inv = np.unique(local, return_inverse=True)
+            self._stats.counter("push_coalesced_rows").add(
+                int(local.size) - len(uniq))
             acc = np.zeros((len(uniq), self.dim), np.float32)
             np.add.at(acc, inv, g)
             if self.optimizer == "sgd":
@@ -348,6 +383,12 @@ class TableService:
         self._async_q: "queue.Queue" = queue.Queue()
         self._listener = None
         self._threads = []
+        # wire-level stats of the PYTHON serve plane — same counter
+        # names as the C data-plane server's ServerStats
+        # (csrc/ptpu_ps_server.cc), so stats_snapshot() merges the two
+        # planes field-for-field; plus client-side pipelining counters
+        self._wire_stats = pstats.Registry()
+        self._client_stats = pstats.Registry()
         # server-side async-push coalescing (reference: the merge-then-
         # apply DenseOptimizer path of `service/communicator.cc`, here on
         # the RECEIVING side): async fast-frame pushes append to
@@ -415,13 +456,20 @@ class TableService:
                     # connection cleanly; the serve thread and the
                     # service survive a garbled/malicious peer
                     import sys
+                    self._wire_stats.counter("proto_errors").add(1)
                     print(f"ps: dropping connection on malformed "
                           f"frame: {e}", file=sys.stderr)
                     return
                 if op == "pull":
+                    self._wire_stats.counter("pull_ops").add(1)
+                    self._wire_stats.counter("pull_rows").add(
+                        int(np.asarray(payload).size))
                     send_msg(conn, self._shards[table].pull(payload))
                 elif op == "push":
                     ids, grads = payload
+                    self._wire_stats.counter("push_ops").add(1)
+                    self._wire_stats.counter("push_rows").add(
+                        int(np.asarray(ids).size))
                     self._shards[table].push(ids, grads)
                     send_msg(conn, b"ok")
                 elif op == "push_drain":
@@ -441,6 +489,13 @@ class TableService:
                         port = self._data_server.port
                     send_msg(conn, port)
                 elif op == "barrier_probe":
+                    send_msg(conn, b"ok")
+                elif op == "stats":
+                    # live observability snapshot (tools/ps_stats.py
+                    # polls this; ps_bench embeds it per phase)
+                    send_msg(conn, self.stats_snapshot())
+                elif op == "stats_reset":
+                    self.stats_reset()
                     send_msg(conn, b"ok")
                 elif op == "kv_put":
                     with self._kv_lock:
@@ -488,11 +543,23 @@ class TableService:
             except OSError:
                 pass
 
+    def _send_err(self, conn, msg: str) -> None:
+        frame = wire.build_err(msg)
+        self._wire_stats.counter("err_frames").add(1)
+        self._wire_stats.counter("bytes_out").add(len(frame) + 4)
+        conn.send_bytes(frame)
+
     def _serve_fast(self, conn, tag: int, data):
         """Fixed-layout pull/push frames — the hot path. Protocol-level
         garbage raises ValueError (dropping the connection, same as the
         generic decoder); application errors (unknown table, id out of
-        range) travel back as ERR frames so the client can raise."""
+        range) travel back as ERR frames so the client can raise.
+        Counters mirror the C data plane's ServerStats names
+        (csrc/ptpu_ps_server.cc) so the planes merge."""
+        import time
+        t0 = time.perf_counter()
+        ws = self._wire_stats
+        ws.counter("bytes_in").add(len(data) + 4)
         try:
             if tag == wire.TAG_PULL_REQ:
                 table, ids = wire.parse_pull_req(data)
@@ -502,14 +569,16 @@ class TableService:
                 raise ValueError(f"PS wire: unexpected fast request "
                                  f"tag {tag:#x}")
         except ValueError:
+            ws.counter("proto_errors").add(1)
             raise
         except Exception as e:  # header garbage: uniform protocol error
+            ws.counter("proto_errors").add(1)
             raise ValueError(f"PS wire: malformed fast frame "
                              f"({type(e).__name__}: {e})") from e
         shard = self._shards.get(table)
         if shard is None:
-            conn.send_bytes(wire.build_err(
-                f"unknown table {table!r} on rank {self.rank}"))
+            self._send_err(conn,
+                           f"unknown table {table!r} on rank {self.rank}")
             return
         if tag == wire.TAG_PULL_REQ:
             if self._pending:
@@ -526,9 +595,14 @@ class TableService:
             try:
                 shard.pull_into(ids, body)
             except ValueError as e:
-                conn.send_bytes(wire.build_err(str(e)))
+                self._send_err(conn, str(e))
                 return
             conn.send_bytes(frame)
+            ws.counter("pull_ops").add(1)
+            ws.counter("pull_rows").add(int(ids.size))
+            ws.counter("bytes_out").add(len(frame) + 4)
+            ws.histogram("pull_us").observe(
+                (time.perf_counter() - t0) * 1e6)
         else:
             if is_async:
                 with self._pending_cv:
@@ -536,13 +610,19 @@ class TableService:
                         (ids, grads))
                     self._pending_cv.notify_all()
                 conn.send_bytes(wire.OK_FRAME)
+                ws.counter("async_push_queued_frames").add(1)
             else:
                 try:
                     shard.push(ids, grads)
                 except ValueError as e:
-                    conn.send_bytes(wire.build_err(str(e)))
+                    self._send_err(conn, str(e))
                     return
                 conn.send_bytes(wire.OK_FRAME)
+            ws.counter("push_ops").add(1)
+            ws.counter("push_rows").add(int(ids.size))
+            ws.counter("bytes_out").add(len(wire.OK_FRAME) + 4)
+            ws.histogram("push_us").observe(
+                (time.perf_counter() - t0) * 1e6)
 
     def _apply_pending(self, table: str):
         with self._pending_cv:
@@ -554,6 +634,11 @@ class TableService:
         try:
             flat = np.concatenate([i for i, _ in items])
             g = np.concatenate([x for _, x in items])
+            # server-side coalescing: N queued frames became ONE
+            # scatter-update (the merge the async ack bought)
+            self._wire_stats.counter("async_push_applied_batches").add(1)
+            self._wire_stats.counter("async_push_merged_frames").add(
+                len(items) - 1)
             self._shards[table].push(flat, g)
         finally:
             with self._pending_cv:
@@ -664,6 +749,8 @@ class TableService:
         buffer, so the copy into `out` happens under the conn lock."""
         c, lock = self._fast_conn(peer, table)
         req = wire.build_pull_req(table, sub)
+        self._client_stats.counter("pull_frames").add(1)
+        self._client_stats.counter("pull_reqs").add(1)
         with lock:
             c.send_bytes(req)
             if mask is None and isinstance(c, _DataConn):
@@ -681,6 +768,7 @@ class TableService:
                   g: np.ndarray, is_async: bool = False):
         c, lock = self._fast_conn(peer, table)
         req = wire.build_push_req(table, sub, g, is_async)
+        self._client_stats.counter("push_frames").add(1)
         with lock:
             c.send_bytes(req)
             reply = c.recv_bytes()
@@ -788,6 +876,12 @@ class TableService:
                     cur, rows = [], 0
             if cur:
                 groups.append(cur)
+            # pipeline-merge accounting: len(jobs) logical pulls rode
+            # len(groups) wire frames on this connection
+            self._client_stats.counter("pull_frames").add(len(groups))
+            self._client_stats.counter("pull_reqs").add(len(jobs))
+            self._client_stats.counter("pull_merged_reqs").add(
+                len(jobs) - len(groups))
             with lock:
                 inflight = collections.deque()
 
@@ -896,6 +990,54 @@ class TableService:
             flat = np.concatenate([f for f, _ in items])
             g = np.concatenate([x for _, x in items])
             self._push_now(table, flat, g, is_async=True)
+
+    # ---- observability (control-plane "stats" op; tools/ps_stats.py
+    # polls it, tools/ps_bench.py embeds it per phase) -----------------
+
+    def stats_snapshot(self) -> dict:
+        """Everything this PS node can observe, as one plain dict:
+
+        * ``tables`` — per-shard storage counters (pull/push ops, rows,
+          coalesced rows), same names for native and numpy backends;
+          native shards exposed on the C data plane also carry their
+          wire-level view under ``wire_native``.
+        * ``wire`` — Python-plane + C-data-plane serve counters MERGED
+          (pull/push ops/rows, bytes in/out, err/proto counters,
+          pull_us/push_us log2 latency histograms).
+        * ``wire_py`` / ``wire_native`` — the unmerged halves.
+        * ``client`` — this node's client-side pipelining counters
+          (frames sent, logical pulls merged into frames).
+        """
+        native_srv = self._data_server.stats() \
+            if self._data_server is not None else None
+        wire_py = self._wire_stats.snapshot()
+        tables = {}
+        for name, shard in self._shards.items():
+            t = shard.stats()
+            if native_srv and name in native_srv.get("tables", {}):
+                t["wire_native"] = native_srv["tables"][name]["wire"]
+            tables[name] = t
+        return {
+            "rank": self.rank,
+            "world": self.world,
+            "native_data_plane": self._data_server is not None,
+            "wire": pstats.merge(wire_py,
+                                 (native_srv or {}).get("server")),
+            "wire_py": wire_py,
+            "wire_native": (native_srv or {}).get("server"),
+            "client": self._client_stats.snapshot(),
+            "tables": tables,
+        }
+
+    def stats_reset(self) -> None:
+        """Zero every counter this node owns (wire, client, storage —
+        both planes)."""
+        self._wire_stats.reset()
+        self._client_stats.reset()
+        if self._data_server is not None:
+            self._data_server.stats_reset()
+        for shard in self._shards.values():
+            shard.stats_reset()
 
     def flush(self):
         """Drain queued async pushes (reference: Communicator barrier):

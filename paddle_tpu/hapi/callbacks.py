@@ -178,6 +178,76 @@ class EarlyStopping(Callback):
                 self.stopped = True
 
 
+class BenchmarkLogger(Callback):
+    """Step-time / throughput logger (the observability layer's
+    trainer-side view). Every train batch lands in the process-default
+    stats registry (`paddle_tpu.profiler.stats.REGISTRY`: a
+    `train_step_us` log2 histogram + `train_steps` / `train_samples`
+    counters — the same shapes the PS server and native predictor
+    export, so one Prometheus page covers the whole stack), and every
+    `log_freq` steps the recent steps/s (+ samples/s when the batch
+    size is known) is printed."""
+
+    def __init__(self, log_freq=50, batch_size=None, verbose=1):
+        super().__init__()
+        self.log_freq = max(1, int(log_freq))
+        self.batch_size = batch_size
+        self.verbose = verbose
+        from ..profiler import stats as pstats
+        self._hist = pstats.REGISTRY.histogram("train_step_us")
+        self._steps = pstats.REGISTRY.counter("train_steps")
+        self._samples = pstats.REGISTRY.counter("train_samples")
+        self._t0 = None
+        self._win_t = 0.0
+        self._win_n = 0
+        # REGISTRY counters are cumulative across runs (Prometheus
+        # counter semantics); the end-of-run summary must not be, so
+        # this run's totals are tracked per instance
+        self._run_t = 0.0
+        self._run_n = 0
+
+    def _batch(self, logs):
+        bs = (logs or {}).get("batch_size", self.batch_size)
+        try:
+            return int(bs) if bs is not None else None
+        except (TypeError, ValueError):
+            return None
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._t0 = time.perf_counter()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._t0 is None:
+            return
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
+        self._hist.observe(dt * 1e6)
+        self._steps.add(1)
+        bs = self._batch(logs)
+        if bs:
+            self._samples.add(bs)
+        self._run_t += dt
+        self._run_n += 1
+        self._win_t += dt
+        self._win_n += 1
+        if self.verbose and self._win_n >= self.log_freq and \
+                self._win_t > 0:
+            sps = self._win_n / self._win_t
+            msg = (f"benchmark: {self._win_t / self._win_n * 1e3:.2f} "
+                   f"ms/step, {sps:.1f} steps/s")
+            if bs:
+                msg += f", {sps * bs:.1f} samples/s"
+            print(msg, flush=True)
+            self._win_t = 0.0
+            self._win_n = 0
+
+    def on_train_end(self, logs=None):
+        if self.verbose and self._run_n:
+            avg_ms = self._run_t / self._run_n * 1e3
+            print(f"benchmark: trained {self._run_n} steps, "
+                  f"avg {avg_ms:.2f} ms/step", flush=True)
+
+
 class VisualDL(Callback):
     """Reference: callbacks.py:841 — logs scalars; VisualDL the package
     doesn't exist here, so scalars append to a plain JSONL file that any

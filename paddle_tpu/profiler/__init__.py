@@ -9,10 +9,12 @@ export chrome://tracing-compatible traces (`tools/timeline.py` parity).
 from __future__ import annotations
 
 import contextlib
+import functools
 import os
 from typing import Optional
 
 from ..core import native
+from . import stats  # noqa: F401  (re-export: profiler.stats registry)
 
 
 class RecordEvent:
@@ -42,6 +44,18 @@ class RecordEvent:
 
     def end(self):
         self.__exit__()
+
+    def __call__(self, fn):
+        """Decorator form: every call of `fn` runs inside a scoped
+        event named after this RecordEvent (reference:
+        `platform/profiler.py` RecordEvent's decorator usage)."""
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            # a fresh scope per call — decorating with ONE RecordEvent
+            # instance must stay reentrant/nestable
+            with RecordEvent(self.name):
+                return fn(*args, **kwargs)
+        return wrapped
 
 
 def start_profiler(tracer_option: str = "Default"):

@@ -1,0 +1,212 @@
+"""Cross-stack metrics registry (reference: `platform/monitor.h`
+StatValue registry + the bvar counters behind brpc's /vars page).
+
+One small metrics core shared by every layer that reports:
+
+* `Counter` / `Histogram` — thread-safe named stats. The histogram is
+  the SAME fixed 32-bucket log2 layout as the native core
+  (`csrc/ptpu_stats.h`): bucket 0 counts value 0, bucket b counts
+  values in ``[2**(b-1), 2**b)``, the last bucket is the overflow
+  tail. Identical layouts mean native snapshots (predictor, PS data
+  plane) and Python snapshots (PS fallback plane, hapi callbacks)
+  merge bucket-for-bucket.
+* `Registry.snapshot()` — a plain-dict view (ints for counters,
+  ``{"count", "sum", "buckets"}`` dicts for histograms) that travels
+  over the PS control plane's ``"stats"`` op as ordinary wire data.
+* `merge()` — sum any number of such snapshots (native + fallback,
+  or successive polls) field-for-field.
+* `prometheus_text()` — render a snapshot in Prometheus exposition
+  format; `tools/ps_stats.py --prom` serves it for scraping.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+HIST_BUCKETS = 32  # == ptpu::kHistBuckets (csrc/ptpu_stats.h)
+
+
+def hist_bucket_of(v: int) -> int:
+    """Bucket index of a non-negative integer value (log2 layout)."""
+    if v <= 0:
+        return 0
+    return min(int(v).bit_length(), HIST_BUCKETS - 1)
+
+
+class Counter:
+    """Monotonic counter. `add` is exact under threads (the PS serve
+    threads bump these concurrently), so it locks — the lock is shared
+    per registry and uncontended at PS frame rates."""
+
+    __slots__ = ("_lock", "_v")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self._v = 0
+
+    def add(self, n: int = 1) -> None:
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self) -> int:
+        return self._v
+
+    def reset(self) -> None:
+        with self._lock:
+            self._v = 0
+
+
+class Histogram:
+    """Fixed-bucket log2 histogram (native-layout twin)."""
+
+    __slots__ = ("_lock", "buckets", "count", "sum")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.buckets = [0] * HIST_BUCKETS
+        self.count = 0
+        self.sum = 0
+
+    def observe(self, v) -> None:
+        v = int(v)
+        with self._lock:
+            self.buckets[hist_bucket_of(v)] += 1
+            self.count += 1
+            self.sum += v
+
+    def to_dict(self) -> dict:
+        return {"count": self.count, "sum": self.sum,
+                "buckets": list(self.buckets)}
+
+    def reset(self) -> None:
+        with self._lock:
+            self.buckets = [0] * HIST_BUCKETS
+            self.count = 0
+            self.sum = 0
+
+
+class Registry:
+    """Named Counter/Histogram set with a dict snapshot. Stats are
+    created on first use, so call sites never pre-declare."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: Dict[str, object] = {}
+
+    def counter(self, name: str) -> Counter:
+        s = self._stats.get(name)
+        if s is None:
+            with self._lock:
+                s = self._stats.setdefault(name, Counter(self._lock))
+        if not isinstance(s, Counter):
+            raise TypeError(f"stat {name!r} is not a Counter")
+        return s
+
+    def histogram(self, name: str) -> Histogram:
+        s = self._stats.get(name)
+        if s is None:
+            with self._lock:
+                s = self._stats.setdefault(name, Histogram(self._lock))
+        if not isinstance(s, Histogram):
+            raise TypeError(f"stat {name!r} is not a Histogram")
+        return s
+
+    def snapshot(self) -> dict:
+        out = {}
+        for name, s in list(self._stats.items()):
+            out[name] = s.value if isinstance(s, Counter) else s.to_dict()
+        return out
+
+    def reset(self) -> None:
+        for s in list(self._stats.values()):
+            s.reset()
+
+
+# Process-default registry: trainer-side metrics (hapi callbacks etc.)
+# land here so one prometheus_text(REGISTRY.snapshot()) exposes them.
+REGISTRY = Registry()
+
+
+def merge(*snapshots) -> dict:
+    """Sum snapshot dicts field-for-field: numbers add, bucket lists
+    add element-wise, nested dicts (histograms, per-table sections)
+    recurse. `None` entries are skipped, so
+    `merge(py_side, native_side_or_None)` just works. Non-summable
+    values (backend tags, bools, rank labels…) keep the FIRST
+    occurrence — merging two full `stats_snapshot()` dicts never
+    concatenates strings or adds flags."""
+    def summable(v):
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    out: dict = {}
+    for snap in snapshots:
+        if not snap:
+            continue
+        for k, v in snap.items():
+            if k not in out:
+                out[k] = [x + 0 for x in v] if isinstance(v, list) else \
+                    (merge(v) if isinstance(v, dict) else v)
+            elif isinstance(v, dict) and isinstance(out[k], dict):
+                out[k] = merge(out[k], v)
+            elif isinstance(v, list) and isinstance(out[k], list):
+                out[k] = [a + b for a, b in zip(out[k], v)]
+            elif summable(v) and summable(out[k]):
+                out[k] = out[k] + v
+            # else: tag/flag (or type mismatch) — first occurrence wins
+    return out
+
+
+def _prom_name(*parts: str) -> str:
+    name = "_".join(p for p in parts if p)
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def _is_hist(v) -> bool:
+    return isinstance(v, dict) and set(v) >= {"count", "sum", "buckets"}
+
+
+def _prom_emit(lines, name, v, labels: str):
+    if _is_hist(v):
+        lines.append(f"# TYPE {name} histogram")
+        cum = 0
+        for b, c in enumerate(v["buckets"]):
+            cum += c
+            le = "0" if b == 0 else ("+Inf" if b == HIST_BUCKETS - 1
+                                     else str(2 ** b - 1))
+            sep = "," if labels else ""
+            lines.append(f'{name}_bucket{{{labels}{sep}le="{le}"}} {cum}')
+        lines.append(f"{name}_sum{{{labels}}} {v['sum']}" if labels
+                     else f"{name}_sum {v['sum']}")
+        lines.append(f"{name}_count{{{labels}}} {v['count']}" if labels
+                     else f"{name}_count {v['count']}")
+    else:
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name}{{{labels}}} {v}" if labels
+                     else f"{name} {v}")
+
+
+def prometheus_text(snapshot: dict, prefix: str = "ptpu",
+                    labels: Optional[Dict[str, str]] = None) -> str:
+    """Render a (possibly nested) snapshot in Prometheus exposition
+    format. Nested dict keys join the metric name with ``_``, except a
+    ``"tables"`` level: its children become a ``table="<name>"`` label
+    (per-table stats stay one metric family)."""
+    base = ",".join(f'{k}="{v}"' for k, v in (labels or {}).items())
+    lines: list = []
+
+    def walk(path, node, lbl):
+        for k, v in node.items():
+            if k == "tables" and isinstance(v, dict):
+                for tname, tnode in v.items():
+                    sep = "," if lbl else ""
+                    walk(path + ["table"], tnode,
+                         f'{lbl}{sep}table="{tname}"')
+            elif isinstance(v, dict) and not _is_hist(v):
+                walk(path + [k], v, lbl)
+            elif isinstance(v, (int, float)) or _is_hist(v):
+                _prom_emit(lines, _prom_name(prefix, *path, k), v, lbl)
+            # strings/None (backend tags etc.) are not metrics: skipped
+
+    walk([], snapshot, base)
+    return "\n".join(lines) + "\n"

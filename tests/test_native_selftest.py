@@ -5,11 +5,14 @@ Reference: gtest cc_test targets per CMakeLists (e.g.
 binaries: `csrc/ptpu_selftest.cc` asserts the predictor TU's internal
 kernels (sgemm vs naive incl. 0*NaN IEEE propagation, exact int32
 igemm, the int8_exact overflow bound, broadcast walk, input-dim
-validation, worker-pool coverage); `csrc/ptpu_ps_selftest.cc` asserts
-the PS shard table + data-plane server (gather/bounds, per-optimizer
-update formulas vs naive references, duplicate coalescing, torn-read
-freedom under concurrent pull/push, SHA-256/HMAC known vectors, and a
-full socket round-trip incl. bad-authkey rejection).
+validation, worker-pool coverage) plus the serving-stats accumulation
+of run(); `csrc/ptpu_ps_selftest.cc` asserts the PS shard table +
+data-plane server (gather/bounds, per-optimizer update formulas vs
+naive references, duplicate coalescing, torn-read freedom under
+concurrent pull/push, SHA-256/HMAC known vectors, a full socket
+round-trip incl. bad-authkey rejection, and the csrc/ptpu_stats.h
+counters/histograms: log2 bucket boundaries, exact relaxed-atomic sums
+under threads, table + server wire stats JSON incl. reset).
 """
 import os
 import subprocess

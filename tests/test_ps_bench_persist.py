@@ -61,6 +61,21 @@ class TestPsBenchPersist:
             assert by[m]["unit"] == "ops/s"
         assert by["ps_wire_pull_ops_per_s"]["pipelined"] is True
 
+    def test_server_stats_phases_and_consistency(self, bench_out):
+        """ISSUE 3: --out embeds a per-phase server stats snapshot and
+        the final totals match client-observed counts exactly."""
+        phases = bench_out["server_stats_phases"]
+        assert set(phases) == {"go", "pipe", "push", "done"}
+        done = phases["done"]
+        assert "wire" in done and "tables" in done
+        assert done["tables"]["emb"]["pull_rows"] > 0
+        by = {r["metric"]: r for r in bench_out["measurements"]}
+        row = by["ps_stats_consistency"]
+        assert row["value"] == 1, row
+        assert row["server_pull_rows"] == row["expected_pull_rows"]
+        assert row["cli_pull_rows"] == row["expected_pull_rows"]
+        assert row["server_push_rows"] == row["expected_push_rows"]
+
     def test_native_parity_rows(self, bench_out):
         """Acceptance: byte-identical pull / allclose push update
         between the native and numpy shard paths, per optimizer."""

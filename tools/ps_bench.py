@@ -75,11 +75,26 @@ def _worker(rank, world, port, q):
 
     if rank == 0:
         # the server participates in every phase barrier the clients
-        # synchronize on, then just serves
+        # synchronize on, then just serves. After each barrier opens it
+        # takes a stats snapshot: "go" ≈ warm-up traffic only, "pipe" ≈
+        # + sync pulls, "push" ≈ + pipelined pulls + sync pushes,
+        # "done" = EXACT totals (every client drained before it). The
+        # intermediate cuts can trail the barrier by a beat; only
+        # "done" is exact.
+        snaps = {}
         for name in ("psbench-go", "psbench-pipe", "psbench-push",
                      "psbench-done"):
             svc.barrier(name, timeout_s=900)
-        q.put({"rank": 0, "native": svc._shards["emb"].native})
+            snaps[name.split("-", 1)[1]] = svc.stats_snapshot()
+        # the live-poll proof: fetch the same totals over the control
+        # plane the way an operator would (tools/ps_stats.py)
+        try:
+            from tools.ps_stats import fetch_stats
+            cli_snap = fetch_stats(port, rank=0, timeout_s=30)
+        except Exception as e:  # noqa: BLE001 — keep the bench alive
+            cli_snap = {"error": repr(e)}
+        q.put({"rank": 0, "native": svc._shards["emb"].native,
+               "stats_phases": snaps, "stats_cli": cli_snap})
     else:
         svc.pull("emb", ids)                      # connect + warm
         svc.barrier("psbench-go", timeout_s=900)
@@ -222,12 +237,45 @@ def main():
     for row in _parity_rows():
         emit(row)
 
+    # server-side observability (ISSUE 3): the "done" snapshot's totals
+    # must match the client-side op counts EXACTLY — warm-up pulls
+    # (1/client) + sync pulls (OPS) + pipelined pulls (OPS/client), and
+    # sync (OPS) + async (OPS/client) pushes, each of BATCH rows. The
+    # same totals fetched over the control plane the way
+    # tools/ps_stats.py does prove the live-poll path.
+    stats_phases = res[0].get("stats_phases") or {}
+    final = stats_phases.get("done") or {}
+    cli = res[0].get("stats_cli") or {}
+    exp_pull_rows = BATCH * (NCLIENTS + OPS + OPS * NCLIENTS)
+    exp_push_rows = BATCH * OPS * (1 + NCLIENTS)
+    wire = final.get("wire", {})
+    cli_wire = cli.get("wire", {})
+    emit({"metric": "ps_stats_consistency",
+          "value": int(wire.get("pull_rows") == exp_pull_rows and
+                       wire.get("push_rows") == exp_push_rows and
+                       cli_wire.get("pull_rows") == exp_pull_rows and
+                       cli_wire.get("push_rows") == exp_push_rows),
+          "unit": "bool",
+          "expected_pull_rows": exp_pull_rows,
+          "server_pull_rows": wire.get("pull_rows"),
+          "cli_pull_rows": cli_wire.get("pull_rows"),
+          "expected_push_rows": exp_push_rows,
+          "server_push_rows": wire.get("push_rows"),
+          "cli_push_rows": cli_wire.get("push_rows"),
+          "server_coalesced_dup_rows":
+              (final.get("tables", {}).get("emb", {})
+                    .get("push_coalesced_rows")),
+          "server_async_merged_frames":
+              wire.get("async_push_merged_frames", 0)})
+
     if out_path:
         with open(out_path, "w") as f:
             json.dump({"bench": "ps_bench", "vocab": VOCAB, "dim": DIM,
                        "batch": BATCH, "ops": OPS,
                        "clients": NCLIENTS, "depth": DEPTH,
-                       "measurements": RESULTS}, f, indent=1)
+                       "measurements": RESULTS,
+                       "server_stats_phases": stats_phases}, f,
+                      indent=1)
         print(f"# persisted to {out_path}", flush=True)
 
 

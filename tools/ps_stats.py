@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Poll a LIVE PS node's observability snapshot (ISSUE 3 tentpole).
+
+Connects to the node's control plane (the multiprocessing.connection
+listener `distributed/ps/table.py` serves) and issues the `"stats"`
+op — the reference analogue of curling a brpc server's /vars page.
+Works against any running TableService: a training job, a
+`tools/ps_bench.py` server mid-run, or the shrunken test config.
+
+Output modes:
+  (default)      pretty JSON snapshot
+  --prom         Prometheus exposition text (profiler/stats.py
+                 prometheus_text) — pipe to a file node_exporter-style
+                 or serve it from a sidecar
+  --watch SEC    poll every SEC seconds; prints pull/push ops/s and
+                 MB/s deltas between polls plus the snapshot
+  --reset        zero the node's counters ("stats_reset" op) and exit
+
+Addressing mirrors the launcher env contract: the control port of rank
+R is MASTER_PORT + 200 + R and the connection authkey derives from
+MASTER_PORT (same derivation as the service itself).
+
+Run: python tools/ps_stats.py [--master-port 8476] [--rank 0]
+         [--host 127.0.0.1] [--prom | --watch 2 | --reset]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def fetch_stats(master_port: int, rank: int = 0,
+                host: str = "127.0.0.1", op: str = "stats",
+                timeout_s: float = 10.0):
+    """One control-plane round trip; returns the decoded snapshot (or
+    b"ok" for "stats_reset"). Importable — the tests and ps_bench use
+    this instead of shelling out."""
+    from multiprocessing.connection import Client
+
+    from paddle_tpu.distributed.ps import table as T
+    from paddle_tpu.distributed.ps.wire import recv_msg, send_msg
+
+    authkey = T._AUTHKEY_BASE + str(master_port).encode()
+    port = master_port + T._PORT_OFFSET + rank
+    deadline = time.time() + timeout_s
+    while True:
+        try:
+            conn = Client((host, port), authkey=authkey)
+            break
+        except (ConnectionRefusedError, OSError):
+            if time.time() > deadline:
+                raise
+            time.sleep(0.1)
+    try:
+        send_msg(conn, (op, "", None))
+        return recv_msg(conn)
+    finally:
+        conn.close()
+
+
+def _rates(prev: dict, cur: dict, dt: float) -> str:
+    def d(key):
+        return (cur.get("wire", {}).get(key, 0) -
+                prev.get("wire", {}).get(key, 0))
+    mb = (d("bytes_in") + d("bytes_out")) / dt / 1e6
+    return (f"pull {d('pull_ops') / dt:,.0f} ops/s "
+            f"({d('pull_rows') / dt:,.0f} rows/s) | "
+            f"push {d('push_ops') / dt:,.0f} ops/s "
+            f"({d('push_rows') / dt:,.0f} rows/s) | "
+            f"{mb:,.1f} MB/s")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="poll a live PS node's stats snapshot")
+    ap.add_argument("--master-port", type=int,
+                    default=int(os.environ.get("MASTER_PORT", "8476")))
+    ap.add_argument("--rank", type=int, default=0)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--prom", action="store_true",
+                    help="Prometheus exposition format")
+    ap.add_argument("--watch", type=float, default=None, metavar="SEC",
+                    help="poll every SEC seconds with ops/s deltas")
+    ap.add_argument("--reset", action="store_true",
+                    help="zero the node's counters and exit")
+    a = ap.parse_args(argv)
+
+    if a.reset:
+        fetch_stats(a.master_port, a.rank, a.host, op="stats_reset")
+        print(f"rank {a.rank} stats reset")
+        return
+
+    from paddle_tpu.profiler.stats import prometheus_text
+
+    def render(snap):
+        if a.prom:
+            return prometheus_text(
+                snap, prefix="ptpu_ps",
+                labels={"rank": str(snap.get("rank", a.rank))})
+        return json.dumps(snap, indent=1, sort_keys=True)
+
+    snap = fetch_stats(a.master_port, a.rank, a.host)
+    last = time.time()
+    print(render(snap), flush=True)
+    if a.watch is None:
+        return
+    while True:
+        time.sleep(a.watch)
+        nxt = fetch_stats(a.master_port, a.rank, a.host)
+        now = time.time()
+        print(f"# {time.strftime('%H:%M:%S')} "
+              f"{_rates(snap, nxt, max(1e-9, now - last))}",
+              flush=True)
+        print(render(nxt), flush=True)
+        snap, last = nxt, now
+
+
+if __name__ == "__main__":
+    main()
