@@ -26,11 +26,43 @@ typedef struct PTPU_Predictor PTPU_Predictor;
  * err (truncated to err_len). */
 PTPU_Predictor* ptpu_predictor_create(const char* model_path, char* err,
                                       int err_len);
+
+/* Extended create. batch_override > 0 re-plans the artifact for that
+ * leading (batch) dim — the serving micro-batcher builds its bucket
+ * ladder with this so batched runs stay on the zero-alloc planned
+ * arena. threads > 0 gives the instance a PRIVATE worker sub-pool
+ * (including the calling thread), so concurrent instances scale
+ * instead of serializing on the shared pool's dispatch mutex. 0/0 ==
+ * ptpu_predictor_create. */
+PTPU_Predictor* ptpu_predictor_create_opts(const char* model_path,
+                                           int64_t batch_override,
+                                           int threads, char* err,
+                                           int err_len);
 void ptpu_predictor_destroy(PTPU_Predictor*);
+
+/* Shared execution contexts: a host owning several predictors (one
+ * serving instance's bucket ladder) attaches ONE sub-pool to all of
+ * them. Pools attached via set_pool are borrowed — destroy them after
+ * every predictor using them; NULL detaches. */
+void* ptpu_workpool_create(int threads);
+void ptpu_workpool_destroy(void* pool);
+void ptpu_predictor_set_pool(PTPU_Predictor*, void* pool);
 
 int ptpu_predictor_num_inputs(PTPU_Predictor*);
 int ptpu_predictor_num_outputs(PTPU_Predictor*);
 const char* ptpu_predictor_input_name(PTPU_Predictor*, int i);
+
+/* Input signature introspection (dims reflect a create_opts batch
+ * override). dtype is the ONNX TensorProto code (1 f32, 6 i32,
+ * 7 i64). */
+int ptpu_predictor_input_ndim(PTPU_Predictor*, int i);
+const int64_t* ptpu_predictor_input_dims(PTPU_Predictor*, int i);
+int ptpu_predictor_input_dtype(PTPU_Predictor*, int i);
+
+/* Runs since load/reset that missed the planned-arena zero-alloc path
+ * (dynamic shapes or inputs bound with dims differing from the plan).
+ * Also rendered as "dynamic_shape_fallback" in stats_json. */
+int64_t ptpu_predictor_dynamic_fallbacks(PTPU_Predictor*);
 
 /* Bind a float32 input by name (row-major, dims[ndim]). Returns 0 on
  * success, nonzero + err message otherwise. */
@@ -74,6 +106,39 @@ void ptpu_predictor_set_profiler(
     void (*record_fn)(const char* name, int64_t begin_us,
                       int64_t end_us),
     int (*enabled_fn)(void));
+
+/* ------------------------------------------------------------------ */
+/* Concurrent serving runtime (csrc/ptpu_serving.cc): a C-hosted TCP
+ * inference server over the predictor — HMAC-SHA256 nonce handshake +
+ * u32-LE framed INFER wire (the PS data-plane framing), a dynamic
+ * micro-batcher (flush at max_batch or deadline_us), N parallel
+ * predictor instances each with its own worker sub-pool and a
+ * pre-planned bucket ladder of batch sizes {1,2,4,...,max_batch}.
+ *
+ * ptpu_serving_start: port 0 picks a free port (ptpu_serving_port
+ * reports it); instances <= 0 defaults to 2; threads_per_instance
+ * <= 0 splits the host cores evenly; loopback_only nonzero binds
+ * 127.0.0.1. Returns NULL on error (message in err). */
+void* ptpu_serving_start(const char* model_path, int port,
+                         const char* authkey, int authkey_len,
+                         int max_batch, int64_t deadline_us,
+                         int instances, int threads_per_instance,
+                         int loopback_only, char* err, int err_len);
+int ptpu_serving_port(void*);
+
+/* Effective configuration as JSON (buckets built, instances, model
+ * input signature). Pointer valid until the calling thread's next
+ * config_json/stats_json call on any serving handle. */
+const char* ptpu_serving_config_json(void*);
+
+/* Serving stats snapshot as JSON: wire counters (requests, replies,
+ * errors, bytes, conns), batcher counters (batches, batched_requests,
+ * bucket_miss, dynamic_shape_fallback, deadline/full flushes) and
+ * histograms (queue_depth, batch_fill, enqueue-to-reply e2e_us,
+ * batch run_us). Same pointer contract as config_json. */
+const char* ptpu_serving_stats_json(void*);
+void ptpu_serving_stats_reset(void*);
+void ptpu_serving_stop(void*);
 
 #ifdef __cplusplus
 }  /* extern "C" */

@@ -453,15 +453,24 @@ static int num_threads() {
  * drained). Nested calls from inside a worker run serially
  * (thread_local guard) instead of deadlocking the pool.
  *
- * The pool is process-global but predictors are one-per-thread, so two
- * predictor threads can dispatch concurrently; `dispatch_mu_`
- * serializes whole dispatches (overwriting fn_/n_/chunk_ and resetting
- * done_ mid-flight corrupted outputs or deadlocked cv_done_ before).
- * One GEMM already saturates every core, so serializing dispatch loses
- * nothing and keeps N predictors from oversubscribing N*cores
- * threads. */
+ * The default pool is process-global and `dispatch_mu_` serializes
+ * whole dispatches (overwriting fn_/n_/chunk_ and resetting done_
+ * mid-flight corrupted outputs or deadlocked cv_done_ before). One
+ * GEMM can saturate every core, so serialized dispatch loses nothing
+ * for a single predictor — but it also means N concurrent predictors
+ * serve at 1x aggregate. For concurrent serving, WorkPool is now
+ * instantiable: a predictor (or a serving instance) can own a PRIVATE
+ * sub-pool of W threads, and run() routes its dispatches there via the
+ * thread_local g_active_pool, so two instances with disjoint sub-pools
+ * execute truly in parallel instead of queueing on the global
+ * dispatch mutex. */
 class WorkPool {
  public:
+  explicit WorkPool(int n_workers) {
+    for (int t = 0; t < n_workers; ++t)
+      workers_.emplace_back([this] { worker(); });
+  }
+
   static WorkPool& inst() {
     static WorkPool p(num_threads() - 1);
     return p;
@@ -475,35 +484,52 @@ class WorkPool {
     }
     std::lock_guard<std::mutex> dispatch(dispatch_mu_);
     const int64_t parts = int64_t(workers_.size() + 1) * 4;
+    const int64_t chunk = std::max(grain, (n + parts - 1) / parts);
+    const int64_t chunks = (n + chunk - 1) / chunk;
     {
       std::lock_guard<std::mutex> l(mu_);
       fn_ = &fn;
       n_ = n;
-      chunk_ = std::max(grain, (n + parts - 1) / parts);
+      chunk_ = chunk;
       next_.store(0, std::memory_order_relaxed);
-      done_ = 0;
       ++epoch_;
     }
-    cv_go_.notify_all();
+    /* Wake only as many workers as there are chunks beyond the
+     * caller's own: a 2-chunk elementwise op used to broadcast-wake
+     * the whole pool and then wait for EVERY worker to wake and ack —
+     * ~0.5 ms of pure futex traffic per op on a wide box. Workers
+     * that stay asleep never join the epoch, and the completion wait
+     * below only covers workers that actually claimed work. */
+    const int wake = int(std::min<int64_t>(int64_t(workers_.size()),
+                                           chunks - 1));
+    if (wake >= int(workers_.size())) {
+      cv_go_.notify_all();  // one broadcast beats W futex calls
+    } else {
+      for (int w = 0; w < wake; ++w) cv_go_.notify_one();
+    }
     // the caller thread acts as a worker for this dispatch: mark it so
     // a nested parallel_for from inside fn runs serially instead of
     // re-entering run() and self-deadlocking on dispatch_mu_
     in_worker_ = true;
     try {
-      drain(fn, n, chunk_);
+      drain(fn, n, chunk);
     } catch (...) {
       // fn threw on the caller's chunk: restore the flag and STILL
-      // wait for the pool — workers may be mid-fn, and fn_ must not
-      // dangle past this frame
+      // wait for the joined workers — fn_ must not dangle past this
+      // frame
       in_worker_ = false;
       std::unique_lock<std::mutex> l(mu_);
-      cv_done_.wait(l, [&] { return done_ == int(workers_.size()); });
+      cv_done_.wait(l, [&] {
+        return active_ == 0 && next_.load(std::memory_order_relaxed) >= n_;
+      });
       fn_ = nullptr;
       throw;
     }
     in_worker_ = false;
     std::unique_lock<std::mutex> l(mu_);
-    cv_done_.wait(l, [&] { return done_ == int(workers_.size()); });
+    cv_done_.wait(l, [&] {
+      return active_ == 0 && next_.load(std::memory_order_relaxed) >= n_;
+    });
     fn_ = nullptr;
   }
 
@@ -517,11 +543,6 @@ class WorkPool {
   }
 
  private:
-  explicit WorkPool(int n_workers) {
-    for (int t = 0; t < n_workers; ++t)
-      workers_.emplace_back([this] { worker(); });
-  }
-
   void drain(const std::function<void(int64_t, int64_t)>& fn, int64_t n,
              int64_t chunk) {
     for (;;) {
@@ -545,11 +566,13 @@ class WorkPool {
         fn = fn_;
         n = n_;
         chunk = chunk_;
+        if (!fn) continue;  // dispatch already fully retired
+        ++active_;  // joined while fn_ was valid: the caller waits for us
       }
       drain(*fn, n, chunk);
       {
         std::lock_guard<std::mutex> l(mu_);
-        if (++done_ == int(workers_.size())) cv_done_.notify_one();
+        if (--active_ == 0) cv_done_.notify_one();
       }
     }
   }
@@ -560,16 +583,31 @@ class WorkPool {
   const std::function<void(int64_t, int64_t)>* fn_ = nullptr;
   int64_t n_ = 0, chunk_ = 1;
   std::atomic<int64_t> next_{0};
-  int epoch_ = 0, done_ = 0;
+  int epoch_ = 0, active_ = 0;
   bool stop_ = false;
   static thread_local bool in_worker_;
 };
 
 thread_local bool WorkPool::in_worker_ = false;
 
+/* The execution context of the current thread: parallel_for dispatches
+ * to the private sub-pool a predictor was created with (PoolScope set
+ * by Predictor::run), falling back to the shared global pool. Private
+ * pools are what make N predictor instances scale — each instance's
+ * GEMMs fan out over its own workers with its own dispatch mutex. */
+static thread_local WorkPool* g_active_pool = nullptr;
+
+struct PoolScope {
+  WorkPool* prev;
+  explicit PoolScope(WorkPool* p) : prev(g_active_pool) {
+    if (p) g_active_pool = p;
+  }
+  ~PoolScope() { g_active_pool = prev; }
+};
+
 template <class F>
 static void parallel_for(int64_t n, int64_t grain, const F& fn) {
-  WorkPool::inst().run(n, grain, fn);
+  (g_active_pool ? *g_active_pool : WorkPool::inst()).run(n, grain, fn);
 }
 
 /* ------------------------------------------------------------------
@@ -885,6 +923,67 @@ static std::vector<T>& pack_scratch(int which) {
   return bufs[which];
 }
 
+/* M == 1 GEMV: the batch-1 serving shape. The macro-kernel pads a
+ * single row up to the MR=6 register tile — 6x wasted MACs through
+ * the non-vectorized fringe kernel (measured 5.8 ms for the batch-1
+ * MLP vs 2.9 ms for batch SIXTY-FOUR). These paths compute the one
+ * row directly: per packed B panel (or raw row-major B), broadcast
+ * x[k] and axpy 16-wide — auto-vectorizable fixed-bound inner loops.
+ * Accumulation stays k-ascending per output, the macro-kernel's
+ * order. */
+template <class T, class SA>
+static void gemv_packed(const SA* A, const T* Bpack, T* C, int64_t N,
+                        int64_t K, const T* bias_n, T bias_m0,
+                        int act) {
+  const int64_t ntn = (N + NR - 1) / NR;
+  const int64_t grain = N * K < (int64_t(1) << 21) ? ntn : 1;
+  parallel_for(ntn, grain, [&](int64_t p0, int64_t p1) {
+    for (int64_t p = p0; p < p1; ++p) {
+      const T* Bp = Bpack + p * K * NR;
+      T acc[NR] = {};
+      for (int64_t k = 0; k < K; ++k) {
+        const T av = T(A[k]);
+        const T* b = Bp + k * NR;
+        for (int c = 0; c < NR; ++c) acc[c] += av * b[c];
+      }
+      const int64_t j0 = p * NR, nr = std::min(NR, N - j0);
+      for (int64_t c = 0; c < nr; ++c) {
+        const T v =
+            acc[c] + bias_m0 + (bias_n ? bias_n[j0 + c] : T(0));
+        C[j0 + c] = act_apply(v, act);
+      }
+    }
+  });
+}
+
+template <class T, class SA, class SB>
+static void gemv_raw(const SA* A, const SB* B, T* C, int64_t N,
+                     int64_t K, const T* bias_n, T bias_m0, int act) {
+  // no pre-packed panel: stream row-major B once (packing it first
+  // would cost more than the whole product)
+  const int64_t chunk = 512;
+  const int64_t nch = (N + chunk - 1) / chunk;
+  const int64_t grain = N * K < (int64_t(1) << 21) ? nch : 1;
+  parallel_for(nch, grain, [&](int64_t c0, int64_t c1) {
+    for (int64_t ch = c0; ch < c1; ++ch) {
+      const int64_t j0 = ch * chunk, j1 = std::min(N, j0 + chunk);
+      T acc[chunk];
+      for (int64_t j = j0; j < j1; ++j) acc[j - j0] = T(0);
+      for (int64_t k = 0; k < K; ++k) {
+        const T av = T(A[k]);
+        const SB* row = B + k * N;
+        for (int64_t j = j0; j < j1; ++j)
+          acc[j - j0] += av * T(row[j]);
+      }
+      for (int64_t j = j0; j < j1; ++j) {
+        const T v =
+            acc[j - j0] + bias_m0 + (bias_n ? bias_n[j] : T(0));
+        C[j] = act_apply(v, act);
+      }
+    }
+  });
+}
+
 /* Full GEMM: packs whichever operand has no pre-packed panel (weights
  * are pre-packed ONCE at load time by Predictor::prepack_weights) and
  * runs the macro-kernel. */
@@ -893,6 +992,14 @@ static void gemm_bias_act(const SA* A, const SB* B, T* C, int64_t M,
                           int64_t N, int64_t K, const T* Apack_pre,
                           const T* Bpack_pre, const T* bias_n,
                           const T* bias_m, int act) {
+  if (M == 1 && !Apack_pre) {  // batch-1 serving: direct GEMV
+    const T bm0 = bias_m ? bias_m[0] : T(0);
+    if (Bpack_pre)
+      gemv_packed<T, SA>(A, Bpack_pre, C, N, K, bias_n, bm0, act);
+    else
+      gemv_raw<T, SA, SB>(A, B, C, N, K, bias_n, bm0, act);
+    return;
+  }
   const T* Ap = Apack_pre;
   const T* Bp = Bpack_pre;
   if (!Ap) {
@@ -1067,6 +1174,38 @@ static UnCode un_code(const std::string& op) {
   return it == m.end() ? U_NONE : it->second;
 }
 
+/* Specialization dispatchers for the float elementwise fast paths: the
+ * binary op and the fused activation become template parameters of the
+ * inner loops, so they vectorize. The old form — a per-element switch
+ * on runtime codes — measured ~10x slower than the specialized loops
+ * (1.4 ns/elem vs 0.15) and dominated int8 artifacts, whose
+ * quant/dequant epilogues are pure elementwise traffic. The float
+ * arithmetic and operand order are IDENTICAL to the generic forms
+ * (std::max/min keep their NaN-ordering semantics). */
+template <class F>
+static void with_bin_op(int code, F&& f) {
+  switch (code) {
+    case B_ADD: f([](float x, float y) { return x + y; }); break;
+    case B_SUB: f([](float x, float y) { return x - y; }); break;
+    case B_MUL: f([](float x, float y) { return x * y; }); break;
+    case B_DIV: f([](float x, float y) { return x / y; }); break;
+    case B_MAX: f([](float x, float y) { return std::max(x, y); }); break;
+    default: f([](float x, float y) { return std::min(x, y); }); break;
+  }
+}
+
+template <class F>
+static void with_act(int act, F&& f) {
+  switch (act) {
+    case ACT_RELU: f([](float v) { return v > 0.f ? v : 0.f; }); break;
+    case ACT_SIGMOID:
+      f([](float v) { return act_apply(v, ACT_SIGMOID); });
+      break;
+    case ACT_TANH: f([](float v) { return act_apply(v, ACT_TANH); }); break;
+    default: f([](float v) { return v; }); break;
+  }
+}
+
 static double apply_bin_code(BinCode c, double a, double b) {
   switch (c) {
     case B_ADD: return a + b;
@@ -1201,6 +1340,13 @@ struct Predictor {
   bool planned_ = false;
   int fused_nodes_ = 0;
 
+  /* Private execution context (nullptr = shared global pool). Owned
+   * when created via ptpu_predictor_create_opts(threads > 0), borrowed
+   * when attached via ptpu_predictor_set_pool (the serving runtime
+   * shares one sub-pool across an instance's bucket predictors). */
+  WorkPool* pool_ = nullptr;
+  std::unique_ptr<WorkPool> owned_pool_;
+
   /* Serving stats (csrc/ptpu_stats.h): per-op-type cumulative calls /
    * wall time / output bytes plus a per-run latency histogram.
    * Always-on — two steady-clock reads and a pointer bump per node
@@ -1217,6 +1363,13 @@ struct Predictor {
   ptpu::Histogram run_us_;
   uint64_t runs_ = 0;
   uint64_t run_time_us_ = 0;
+  /* Runs that missed the planned-arena zero-alloc path (dynamic
+   * shapes, or inputs bound with dims differing from the plan) — the
+   * bucket-ladder coverage signal the serving runtime polls. Atomic:
+   * unlike the rest of the stats (read via stats_json on the owning
+   * thread), the serving runtime reads this one CROSS-THREAD while an
+   * instance worker is mid-run. */
+  std::atomic<uint64_t> dyn_fallback_runs_{0};
   std::string stats_json_;
 
   /* Rebuild the node -> OpStat index after the load-time rewrites
@@ -1234,6 +1387,7 @@ struct Predictor {
     run_us_.Reset();
     runs_ = 0;
     run_time_us_ = 0;
+    dyn_fallback_runs_.store(0, std::memory_order_relaxed);
     build_stats_index();
   }
 
@@ -1391,6 +1545,147 @@ struct Predictor {
     return true;
   }
 
+  // scalar float initializer (numel 1) — quant-chain operands
+  const Tensor* scalar_const(const std::string& name) const {
+    const Tensor* t = const_initializer(name);
+    return t && t->is_float() && t->numel() == 1 ? t : nullptr;
+  }
+
+  /* int8 activation-quantization chain fusion. The convert_to_int8
+   * artifacts spend more serve time OUTSIDE the integer GEMM than in
+   * it: per layer the exporter emits Div(x,s) -> Round -> Max(lo,.) ->
+   * Min(hi,.) -> Cast(int8) to quantize the activation and
+   * Cast(float) -> Mul(scale) to dequantize the GEMM output — seven
+   * full memory-bound tensor passes (plus seven pool dispatches) per
+   * layer, which measured ~6.3 of the int8 MLP's 9.7 ms while the
+   * GEMMs took ~3 (BENCH_SELF_r06 regression, ISSUE r8 satellite).
+   * Collapsing each chain into one fused single-pass op (PtpuQuantize
+   * / PtpuDequant) removes ~10 passes per layer; the executor
+   * replicates the exact per-element arithmetic of the original node
+   * sequence, so optimized output stays BITWISE equal to the
+   * PTPU_PREDICTOR_OPT=0 baseline (asserted by
+   * tests/test_native_predictor.py::test_fused_planned_parity_int8). */
+  void fuse_quant_ops() {
+    const std::set<std::string> outset(g.output_names.begin(),
+                                       g.output_names.end());
+    std::map<std::string, int> use_count;
+    std::map<std::string, size_t> consumer;
+    for (size_t k = 0; k < g.nodes.size(); ++k)
+      for (const auto& i : g.nodes[k].inputs) {
+        ++use_count[i];
+        consumer[i] = k;
+      }
+    for (const auto& name : g.output_names) ++use_count[name];
+
+    std::vector<char> dead(g.nodes.size(), 0);
+    std::map<size_t, Node> placed;
+
+    // single-consumer successor of `cur` past position idx, or npos
+    const auto next_of = [&](const std::string& cur, size_t idx) {
+      if (outset.count(cur) || use_count[cur] != 1) return size_t(-1);
+      auto it = consumer.find(cur);
+      if (it == consumer.end() || it->second <= idx || dead[it->second])
+        return size_t(-1);
+      return it->second;
+    };
+
+    for (size_t idx = 0; idx < g.nodes.size(); ++idx) {
+      Node& n = g.nodes[idx];
+      if (dead[idx] || n.outputs.size() != 1) continue;
+
+      if (n.op == "Div" && n.inputs.size() == 2 &&
+          scalar_const(n.inputs[1])) {
+        // Div(x, s) -> Round -> Max(lo,.) -> Min(hi,.) -> Cast(int8)
+        const size_t j1 = next_of(n.outputs[0], idx);
+        if (j1 == size_t(-1) || g.nodes[j1].op != "Round" ||
+            g.nodes[j1].outputs.size() != 1)
+          continue;
+        const size_t j2 = next_of(g.nodes[j1].outputs[0], j1);
+        if (j2 == size_t(-1) || g.nodes[j2].op != "Max" ||
+            g.nodes[j2].inputs.size() != 2 ||
+            g.nodes[j2].outputs.size() != 1)
+          continue;
+        const Node& mx = g.nodes[j2];
+        const bool max_cfirst = scalar_const(mx.inputs[0]) != nullptr;
+        const std::string lo_name =
+            max_cfirst ? mx.inputs[0] : mx.inputs[1];
+        if (!scalar_const(lo_name)) continue;
+        const size_t j3 = next_of(mx.outputs[0], j2);
+        if (j3 == size_t(-1) || g.nodes[j3].op != "Min" ||
+            g.nodes[j3].inputs.size() != 2 ||
+            g.nodes[j3].outputs.size() != 1)
+          continue;
+        const Node& mn = g.nodes[j3];
+        const bool min_cfirst = scalar_const(mn.inputs[0]) != nullptr;
+        const std::string hi_name =
+            min_cfirst ? mn.inputs[0] : mn.inputs[1];
+        if (!scalar_const(hi_name)) continue;
+        const size_t j4 = next_of(mn.outputs[0], j3);
+        if (j4 == size_t(-1) || g.nodes[j4].op != "Cast" ||
+            g.nodes[j4].outputs.size() != 1 ||
+            attr_i(g.nodes[j4], "to", DT_F32) != DT_I8)
+          continue;
+        Node f;
+        f.op = "PtpuQuantize";
+        f.inputs = {n.inputs[0], n.inputs[1], lo_name, hi_name};
+        f.outputs = {g.nodes[j4].outputs[0]};
+        Attr amc;
+        amc.ival = max_cfirst ? 1 : 0;
+        f.attrs["q_max_cfirst"] = amc;
+        Attr anc;
+        anc.ival = min_cfirst ? 1 : 0;
+        f.attrs["q_min_cfirst"] = anc;
+        dead[idx] = dead[j1] = dead[j2] = dead[j3] = 1;
+        dead[j4] = 1;
+        fused_nodes_ += 4;
+        placed[j4] = std::move(f);
+
+      } else if (n.op == "Cast" && n.inputs.size() == 1 &&
+                 attr_i(n, "to", DT_F32) == DT_F32) {
+        // Cast(int -> float) -> Mul(scale const, per-last-dim or
+        // scalar): the dequantization of an integer GEMM output
+        const size_t j1 = next_of(n.outputs[0], idx);
+        if (j1 == size_t(-1) || g.nodes[j1].op != "Mul" ||
+            g.nodes[j1].inputs.size() != 2 ||
+            g.nodes[j1].outputs.size() != 1)
+          continue;
+        const Node& m = g.nodes[j1];
+        const bool cur_first = m.inputs[0] == n.outputs[0];
+        const std::string& sname = m.inputs[cur_first ? 1 : 0];
+        const Tensor* st = const_initializer(sname);
+        if (!st || !st->is_float()) continue;
+        bool lastdim = st->numel() == 1;
+        if (!lastdim && !st->dims.empty() &&
+            st->dims.back() == st->numel()) {
+          lastdim = true;
+          for (size_t d = 0; d + 1 < st->dims.size(); ++d)
+            if (st->dims[d] != 1) lastdim = false;
+        }
+        if (!lastdim) continue;
+        Node f;
+        f.op = "PtpuDequant";
+        f.inputs = {n.inputs[0], sname};
+        f.outputs = {m.outputs[0]};
+        dead[idx] = dead[j1] = 1;
+        fused_nodes_ += 1;
+        placed[j1] = std::move(f);
+      }
+    }
+
+    if (placed.empty()) return;
+    std::vector<Node> rebuilt;
+    rebuilt.reserve(g.nodes.size());
+    for (size_t k = 0; k < g.nodes.size(); ++k) {
+      auto it = placed.find(k);
+      if (it != placed.end())
+        rebuilt.push_back(std::move(it->second));
+      else if (!dead[k])
+        rebuilt.push_back(std::move(g.nodes[k]));
+    }
+    g.nodes.swap(rebuilt);
+    prune_dead_initializers();
+  }
+
   /* Load-time graph rewrite (reference: the conv_bn_fuse /
    * conv_elementwise_add_act_fuse IR passes the AnalysisPredictor runs
    * before serving). Three rewrites, in order:
@@ -1404,26 +1699,33 @@ struct Predictor {
    * Only single-consumer, non-graph-output intermediates fuse; every
    * eliminated node removes a full-tensor materialization pass from the
    * serving hot path. */
+  // Identity elimination: rewrite consumers through the alias. Runs
+  // before BOTH fusion passes (the exporter's copy chains interleave
+  // the quantize patterns too).
+  void eliminate_identities() {
+    const std::set<std::string> outset(g.output_names.begin(),
+                                       g.output_names.end());
+    std::map<std::string, std::string> alias;
+    std::vector<Node> kept;
+    for (auto& n : g.nodes) {
+      for (auto& i : n.inputs) {
+        auto it = alias.find(i);
+        if (it != alias.end()) i = it->second;
+      }
+      if (n.op == "Identity" && !outset.count(n.outputs[0]))
+        alias[n.outputs[0]] = n.inputs[0];
+      else
+        kept.push_back(std::move(n));
+    }
+    g.nodes.swap(kept);
+  }
+
+  // precondition: eliminate_identities() already ran (create calls
+  // it once, before fuse_quant_ops — copy chains interleave BOTH
+  // passes' patterns)
   void fuse_ops() {
     const std::set<std::string> outset(g.output_names.begin(),
                                        g.output_names.end());
-    // 1. Identity elimination: rewrite consumers through the alias
-    {
-      std::map<std::string, std::string> alias;
-      std::vector<Node> kept;
-      for (auto& n : g.nodes) {
-        for (auto& i : n.inputs) {
-          auto it = alias.find(i);
-          if (it != alias.end()) i = it->second;
-        }
-        if (n.op == "Identity" && !outset.count(n.outputs[0]))
-          alias[n.outputs[0]] = n.inputs[0];
-        else
-          kept.push_back(std::move(n));
-      }
-      g.nodes.swap(kept);
-    }
-
     std::map<std::string, int> use_count;
     std::map<std::string, size_t> consumer;  // name -> unique consumer idx
     for (size_t k = 0; k < g.nodes.size(); ++k)
@@ -1786,7 +2088,11 @@ struct Predictor {
     outputs.clear();
     static const bool profile =
         std::getenv("PTPU_PREDICTOR_PROFILE") != nullptr;
+    // route this run's parallel_for dispatches to the private sub-pool
+    PoolScope pool_scope(pool_);
     const bool use_plan = planned_ && inputs_match_plan();
+    if (!use_plan)
+      dyn_fallback_runs_.fetch_add(1, std::memory_order_relaxed);
     if (node_stat_.size() != g.nodes.size()) build_stats_index();
     const ProfEnabledFn enabled_fn =
         g_prof_enabled.load(std::memory_order_relaxed);
@@ -1897,20 +2203,23 @@ void Predictor::run_node(const Node& n) {
       const bool bs = b.numel() == 1 && o.numel() != 1;
       const float *af = a.f.data(), *bf = b.f.data();
       float* of = o.f.data();
-      parallel_for(o.numel(), 1 << 16, [&](int64_t lo, int64_t hi) {
-        for (int64_t k = lo; k < hi; ++k) {
-          const float x = af[as ? 0 : k], y = bf[bs ? 0 : k];
-          float v;
-          switch (code) {
-            case B_ADD: v = x + y; break;
-            case B_SUB: v = x - y; break;
-            case B_MUL: v = x * y; break;
-            case B_DIV: v = x / y; break;
-            case B_MAX: v = std::max(x, y); break;
-            default: v = std::min(x, y);
-          }
-          of[k] = bact == ACT_NONE ? v : act_apply(v, bact);
-        }
+      with_bin_op(code, [&](auto op) {
+        with_act(bact, [&](auto act) {
+          parallel_for(o.numel(), 1 << 16, [&](int64_t lo, int64_t hi) {
+            if (as) {
+              const float av = af[0];
+              for (int64_t k = lo; k < hi; ++k)
+                of[k] = act(op(av, bf[k]));
+            } else if (bs) {
+              const float bv = bf[0];
+              for (int64_t k = lo; k < hi; ++k)
+                of[k] = act(op(af[k], bv));
+            } else {
+              for (int64_t k = lo; k < hi; ++k)
+                of[k] = act(op(af[k], bf[k]));
+            }
+          });
+        });
       });
       out(std::move(o));
       return;
@@ -1933,29 +2242,70 @@ void Predictor::run_node(const Node& n) {
         const float* ff = full.f.data();
         const float* rf = rc.f.data();
         float* of = o.f.data();
-        parallel_for(
-            rows, std::max<int64_t>(1, 65536 / inner),
-            [&](int64_t r0, int64_t r1) {
-          for (int64_t row = r0; row < r1; ++row) {
-            const float rv =
-                rf[bcast_index(row * inner, o.dims, rc.dims)];
-            const float* src = ff + row * inner;
-            float* dst = of + row * inner;
-            for (int64_t j = 0; j < inner; ++j) {
-              const float x = b_row ? src[j] : rv;
-              const float y = b_row ? rv : src[j];
-              float v;
-              switch (code) {
-                case B_ADD: v = x + y; break;
-                case B_SUB: v = x - y; break;
-                case B_MUL: v = x * y; break;
-                case B_DIV: v = x / y; break;
-                case B_MAX: v = std::max(x, y); break;
-                default: v = std::min(x, y);
+        with_bin_op(code, [&](auto op) {
+          with_act(bact, [&](auto act) {
+            parallel_for(
+                rows, std::max<int64_t>(1, 65536 / inner),
+                [&](int64_t r0, int64_t r1) {
+              for (int64_t row = r0; row < r1; ++row) {
+                const float rv =
+                    rf[bcast_index(row * inner, o.dims, rc.dims)];
+                const float* src = ff + row * inner;
+                float* dst = of + row * inner;
+                if (b_row) {
+                  for (int64_t j = 0; j < inner; ++j)
+                    dst[j] = act(op(src[j], rv));
+                } else {
+                  for (int64_t j = 0; j < inner; ++j)
+                    dst[j] = act(op(rv, src[j]));
+                }
               }
-              dst[j] = bact == ACT_NONE ? v : act_apply(v, bact);
-            }
-          }
+            });
+          });
+        });
+        out(std::move(o));
+        return;
+      }
+    }
+    if (a.is_float() && b.is_float() && o.dtype == DT_F32 &&
+        code <= B_MIN && o.dims.size() >= 2) {
+      /* last-axis vector broadcast: one operand is a [1,..,N] vector
+       * against a full [..,N] tensor — the bias-add (+act) epilogue
+       * shape of every un-fusable GEMM/dequant chain. One vector
+       * lookup per column, flat row loops, act applied in the same
+       * pass (the generic walk below computes in double and cannot
+       * carry the fused activation). */
+      const int64_t inner = o.dims.back();
+      const auto vec_like = [&](const Tensor& t) {
+        return t.numel() == inner && !t.dims.empty() &&
+               t.dims.back() == inner;
+      };
+      const bool b_vec = a.dims == o.dims && vec_like(b);
+      const bool a_vec = !b_vec && b.dims == o.dims && vec_like(a);
+      if (b_vec || a_vec) {
+        const int64_t rows = o.numel() / inner;
+        const float* ff = (b_vec ? a : b).f.data();
+        const float* vf = (b_vec ? b : a).f.data();
+        float* of = o.f.data();
+        with_bin_op(code, [&](auto op) {
+          with_act(bact, [&](auto act) {
+            parallel_for(
+                rows,
+                std::max<int64_t>(1, 65536 / std::max<int64_t>(inner, 1)),
+                [&](int64_t r0, int64_t r1) {
+              for (int64_t row = r0; row < r1; ++row) {
+                const float* src = ff + row * inner;
+                float* dst = of + row * inner;
+                if (b_vec) {
+                  for (int64_t j = 0; j < inner; ++j)
+                    dst[j] = act(op(src[j], vf[j]));
+                } else {
+                  for (int64_t j = 0; j < inner; ++j)
+                    dst[j] = act(op(vf[j], src[j]));
+                }
+              }
+            });
+          });
         });
         out(std::move(o));
         return;
@@ -2858,6 +3208,69 @@ void Predictor::run_node(const Node& n) {
       }
     }
     out(std::move(o));
+  } else if (op == "PtpuQuantize") {
+    /* Fused int8 activation quantization (Div/Round/Max/Min/Cast in
+     * ONE pass). The per-element arithmetic replays the original node
+     * sequence step for step — float division, nearbyint on double,
+     * std::max/min in the original operand order, the Cast's
+     * int8_t(int64_t(double)) wrap — so the fused output is bitwise
+     * identical to the unfused chain. */
+    const Tensor& a = in(n, 0);
+    const float s = in(n, 1).f[0];
+    const float lo = in(n, 2).f[0], hi = in(n, 3).f[0];
+    const bool max_cf = attr_i(n, "q_max_cfirst", 1) != 0;
+    const bool min_cf = attr_i(n, "q_min_cfirst", 1) != 0;
+    Tensor o;
+    o.dims = a.dims;
+    o.dtype = DT_I8;
+    o.alloc();
+    int64_t* oi = o.i.data();
+    const auto quant = [&](float d) {
+      const float r = float(std::nearbyint(double(d)));
+      const float m = max_cf ? std::max(lo, r) : std::max(r, lo);
+      const float c = min_cf ? std::min(hi, m) : std::min(m, hi);
+      return int64_t(int8_t(int64_t(double(c))));
+    };
+    if (a.is_float()) {
+      const float* af = a.f.data();
+      parallel_for(o.numel(), 1 << 15, [&](int64_t k0, int64_t k1) {
+        for (int64_t k = k0; k < k1; ++k) oi[k] = quant(af[k] / s);
+      });
+    } else {  // integer input took the generic double-div path before
+      parallel_for(o.numel(), 1 << 15, [&](int64_t k0, int64_t k1) {
+        for (int64_t k = k0; k < k1; ++k)
+          oi[k] = quant(float(a.at(k) / double(s)));
+      });
+    }
+    out(std::move(o));
+  } else if (op == "PtpuDequant") {
+    /* Fused dequantization: Cast(int -> float) + Mul by a scalar or
+     * per-last-dim scale vector in ONE pass. float(int64) rounds the
+     * same integer the old Cast's float(double(int64)) did, and the
+     * multiply is the same float multiply the bcast Mul ran. */
+    const Tensor& a = in(n, 0);
+    const Tensor& sc = in(n, 1);
+    const int64_t ns = sc.numel();
+    if (ns != 1 && (a.dims.empty() || a.dims.back() != ns))
+      throw std::runtime_error("PtpuDequant: scale length " +
+                               std::to_string(ns) +
+                               " does not match the last input dim");
+    Tensor o;
+    o.dims = a.dims;
+    o.dtype = DT_F32;
+    o.alloc();
+    float* of = o.f.data();
+    const float* sf = sc.f.data();
+    const bool aflt = a.is_float();
+    const float* af = a.f.data();
+    const int64_t* ai = a.i.data();
+    parallel_for(o.numel(), 1 << 15, [&](int64_t k0, int64_t k1) {
+      for (int64_t k = k0; k < k1; ++k) {
+        const float v = aflt ? af[k] : float(ai[k]);
+        of[k] = v * (ns == 1 ? sf[0] : sf[k % ns]);
+      }
+    });
+    out(std::move(o));
   } else {
     throw std::runtime_error("op '" + op + "' not supported by the native "
                              "predictor (re-export or extend "
@@ -2920,33 +3333,99 @@ extern "C" {
 
 typedef struct PTPU_Predictor PTPU_Predictor;
 
-__attribute__((visibility("default")))
-PTPU_Predictor* ptpu_predictor_create(const char* model_path, char* err,
-                                      int err_len) {
+static PTPU_Predictor* predictor_create_impl(const char* model_path,
+                                             int64_t batch_override,
+                                             int threads, char* err,
+                                             int err_len) {
   try {
     std::ifstream f(model_path, std::ios::binary);
     if (!f) throw std::runtime_error(std::string("cannot open ") +
                                      model_path);
     std::stringstream ss;
     ss << f.rdbuf();
-    auto* p = new Predictor();
+    std::unique_ptr<Predictor> p(new Predictor());
     p->g = parse_model(ss.str());
+    /* Bucket-ladder support (the serving micro-batcher): re-plan the
+     * SAME artifact for a different leading (batch) dim — every
+     * overridable graph input's axis 0 is rewritten before the
+     * load-time dry run, so fusion, weight pre-packing and the arena
+     * plan all settle at the override batch and batched runs stay on
+     * the zero-alloc path. */
+    if (batch_override > 0)
+      for (const auto& name : p->g.input_names) {
+        if (p->g.initializers.count(name)) continue;  // default-valued
+        auto it = p->g.input_dims.find(name);
+        if (it != p->g.input_dims.end() && !it->second.empty())
+          it->second[0] = batch_override;
+      }
     for (const auto& kv : p->g.initializers) p->env[kv.first] = kv.second;
     p->fold_constants();
     // PTPU_PREDICTOR_OPT=0 keeps the unoptimized graph — the parity
     // baseline the fused/planned path is tested against
     const char* opt = std::getenv("PTPU_PREDICTOR_OPT");
     if (!opt || std::strcmp(opt, "0") != 0) {
+      p->eliminate_identities();
+      p->fuse_quant_ops();
       p->fuse_ops();
       p->prepack_weights();
       p->plan_memory();
     }
     p->build_stats_index();
-    return (PTPU_Predictor*)p;
+    if (threads > 0) {
+      // private execution context: this instance's parallel_for work
+      // runs on its own sub-pool instead of the shared global one
+      p->owned_pool_.reset(new WorkPool(threads - 1));
+      p->pool_ = p->owned_pool_.get();
+    }
+    return (PTPU_Predictor*)p.release();
   } catch (const std::exception& e) {
     fill_error(err, err_len, e.what());
     return nullptr;
   }
+}
+
+__attribute__((visibility("default")))
+PTPU_Predictor* ptpu_predictor_create(const char* model_path, char* err,
+                                      int err_len) {
+  return predictor_create_impl(model_path, 0, 0, err, err_len);
+}
+
+/* Extended create: `batch_override` > 0 re-plans the artifact's input
+ * batch dim (bucket-ladder serving); `threads` > 0 gives the instance
+ * a PRIVATE worker sub-pool of that many threads (including the
+ * calling thread) so concurrent instances scale instead of
+ * serializing on the shared pool's dispatch mutex. 0/0 behaves
+ * exactly like ptpu_predictor_create. */
+__attribute__((visibility("default")))
+PTPU_Predictor* ptpu_predictor_create_opts(const char* model_path,
+                                           int64_t batch_override,
+                                           int threads, char* err,
+                                           int err_len) {
+  return predictor_create_impl(model_path, batch_override, threads, err,
+                               err_len);
+}
+
+/* Shared execution contexts for multi-predictor hosts (the serving
+ * runtime attaches ONE sub-pool per instance to all of that
+ * instance's bucket predictors). A pool attached via set_pool is
+ * BORROWED: the caller owns it and must destroy it after every
+ * predictor using it. Passing a null pool detaches (back to the
+ * shared global pool). */
+__attribute__((visibility("default")))
+void* ptpu_workpool_create(int threads) {
+  return new WorkPool(threads > 0 ? threads - 1 : 0);
+}
+
+__attribute__((visibility("default")))
+void ptpu_workpool_destroy(void* pool) {
+  delete (WorkPool*)pool;
+}
+
+__attribute__((visibility("default")))
+void ptpu_predictor_set_pool(PTPU_Predictor* h, void* pool) {
+  auto* p = (Predictor*)h;
+  p->pool_ = (WorkPool*)pool;
+  if (p->owned_pool_.get() != p->pool_) p->owned_pool_.reset();
 }
 
 __attribute__((visibility("default")))
@@ -2989,6 +3468,41 @@ const char* ptpu_predictor_input_name(PTPU_Predictor* h, int i) {
   auto* p = (Predictor*)h;
   if (i < 0 || size_t(i) >= p->g.input_names.size()) return "";
   return p->g.input_names[size_t(i)].c_str();
+}
+
+/* Input signature introspection (the serving runtime validates and
+ * stitches request tensors against these; after a create_opts batch
+ * override the dims reflect the OVERRIDDEN batch). dtype is the ONNX
+ * TensorProto code (1 f32, 6 i32, 7 i64). */
+__attribute__((visibility("default")))
+int ptpu_predictor_input_ndim(PTPU_Predictor* h, int i) {
+  auto* p = (Predictor*)h;
+  if (i < 0 || size_t(i) >= p->g.input_names.size()) return -1;
+  auto it = p->g.input_dims.find(p->g.input_names[size_t(i)]);
+  return it == p->g.input_dims.end() ? -1 : int(it->second.size());
+}
+
+__attribute__((visibility("default")))
+const int64_t* ptpu_predictor_input_dims(PTPU_Predictor* h, int i) {
+  auto* p = (Predictor*)h;
+  if (i < 0 || size_t(i) >= p->g.input_names.size()) return nullptr;
+  auto it = p->g.input_dims.find(p->g.input_names[size_t(i)]);
+  return it == p->g.input_dims.end() ? nullptr : it->second.data();
+}
+
+__attribute__((visibility("default")))
+int ptpu_predictor_input_dtype(PTPU_Predictor* h, int i) {
+  auto* p = (Predictor*)h;
+  if (i < 0 || size_t(i) >= p->g.input_names.size()) return -1;
+  auto it = p->g.input_dtypes.find(p->g.input_names[size_t(i)]);
+  return it == p->g.input_dtypes.end() ? DT_F32 : it->second;
+}
+
+// runs that missed the planned-arena path since load/reset
+__attribute__((visibility("default")))
+int64_t ptpu_predictor_dynamic_fallbacks(PTPU_Predictor* h) {
+  return int64_t(((Predictor*)h)->dyn_fallback_runs_.load(
+      std::memory_order_relaxed));
 }
 
 __attribute__((visibility("default")))
@@ -3062,6 +3576,10 @@ const char* ptpu_predictor_stats_json(PTPU_Predictor* h) {
   ptpu::AppendJsonU64(&out, "runs", p->runs_);
   out += ',';
   ptpu::AppendJsonU64(&out, "total_run_us", p->run_time_us_);
+  out += ',';
+  ptpu::AppendJsonU64(
+      &out, "dynamic_shape_fallback",
+      p->dyn_fallback_runs_.load(std::memory_order_relaxed));
   out += ',';
   ptpu::AppendJsonHist(&out, "run_us", p->run_us_);
   out += ",\"ops\":{";

@@ -45,138 +45,17 @@
 #include <thread>
 #include <vector>
 
+#include "ptpu_hmac.h"
 #include "ptpu_ps_table.h"
 #include "ptpu_stats.h"
+#include "ptpu_wire.h"
 
 namespace {
 
-// ---------------------------------------------------------------------------
-// SHA-256 + HMAC (public-domain-style compact implementation) — the
-// connect handshake MAC. Self-contained so the PS .so has no deps.
-// ---------------------------------------------------------------------------
-
-struct Sha256 {
-  uint32_t h[8];
-  uint64_t len = 0;
-  uint8_t buf[64];
-  size_t buf_n = 0;
-
-  Sha256() {
-    static const uint32_t init[8] = {
-        0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
-        0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
-    std::memcpy(h, init, sizeof(h));
-  }
-
-  static uint32_t Rotr(uint32_t x, int n) {
-    return (x >> n) | (x << (32 - n));
-  }
-
-  void Block(const uint8_t *p) {
-    static const uint32_t k[64] = {
-        0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
-        0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
-        0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
-        0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
-        0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
-        0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
-        0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
-        0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
-        0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
-        0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
-        0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
-        0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
-        0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
-    uint32_t w[64];
-    for (int i = 0; i < 16; ++i)
-      w[i] = uint32_t(p[4 * i]) << 24 | uint32_t(p[4 * i + 1]) << 16 |
-             uint32_t(p[4 * i + 2]) << 8 | p[4 * i + 3];
-    for (int i = 16; i < 64; ++i) {
-      const uint32_t s0 =
-          Rotr(w[i - 15], 7) ^ Rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
-      const uint32_t s1 =
-          Rotr(w[i - 2], 17) ^ Rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
-      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
-    }
-    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
-             g = h[6], hh = h[7];
-    for (int i = 0; i < 64; ++i) {
-      const uint32_t s1 = Rotr(e, 6) ^ Rotr(e, 11) ^ Rotr(e, 25);
-      const uint32_t ch = (e & f) ^ (~e & g);
-      const uint32_t t1 = hh + s1 + ch + k[i] + w[i];
-      const uint32_t s0 = Rotr(a, 2) ^ Rotr(a, 13) ^ Rotr(a, 22);
-      const uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
-      const uint32_t t2 = s0 + maj;
-      hh = g;
-      g = f;
-      f = e;
-      e = d + t1;
-      d = c;
-      c = b;
-      b = a;
-      a = t1 + t2;
-    }
-    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
-    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
-  }
-
-  void Update(const uint8_t *p, size_t n) {
-    len += n;
-    while (n) {
-      const size_t take = std::min(n, sizeof(buf) - buf_n);
-      std::memcpy(buf + buf_n, p, take);
-      buf_n += take;
-      p += take;
-      n -= take;
-      if (buf_n == 64) {
-        Block(buf);
-        buf_n = 0;
-      }
-    }
-  }
-
-  void Final(uint8_t out[32]) {
-    const uint64_t bits = len * 8;
-    const uint8_t one = 0x80, zero = 0;
-    Update(&one, 1);
-    while (buf_n != 56) Update(&zero, 1);
-    uint8_t lenb[8];
-    for (int i = 0; i < 8; ++i) lenb[i] = uint8_t(bits >> (56 - 8 * i));
-    Update(lenb, 8);
-    for (int i = 0; i < 8; ++i) {
-      out[4 * i] = uint8_t(h[i] >> 24);
-      out[4 * i + 1] = uint8_t(h[i] >> 16);
-      out[4 * i + 2] = uint8_t(h[i] >> 8);
-      out[4 * i + 3] = uint8_t(h[i]);
-    }
-  }
-};
-
-void HmacSha256(const uint8_t *key, size_t key_n, const uint8_t *msg,
-                size_t msg_n, uint8_t out[32]) {
-  uint8_t k[64] = {0};
-  if (key_n > 64) {
-    Sha256 s;
-    s.Update(key, key_n);
-    s.Final(k);
-  } else {
-    std::memcpy(k, key, key_n);
-  }
-  uint8_t ipad[64], opad[64];
-  for (int i = 0; i < 64; ++i) {
-    ipad[i] = k[i] ^ 0x36;
-    opad[i] = k[i] ^ 0x5c;
-  }
-  uint8_t inner[32];
-  Sha256 si;
-  si.Update(ipad, 64);
-  si.Update(msg, msg_n);
-  si.Final(inner);
-  Sha256 so;
-  so.Update(opad, 64);
-  so.Update(inner, 32);
-  so.Final(out);
-}
+// SHA-256 + HMAC live in the shared csrc/ptpu_hmac.h (the serving
+// runtime's handshake uses the same MAC).
+using ptpu::HmacSha256;
+using ptpu::Sha256;
 
 // ---------------------------------------------------------------------------
 // Frame constants — keep in sync with distributed/ps/wire.py.
@@ -190,27 +69,9 @@ constexpr uint8_t kTagOk = 0x53;
 constexpr uint8_t kTagErr = 0x54;
 constexpr uint32_t kMaxFrame = 1u << 30;
 
-bool ReadExact(int fd, void *p, size_t n) {
-  auto *c = static_cast<char *>(p);
-  while (n) {
-    const ssize_t r = ::read(fd, c, n);
-    if (r <= 0) return false;
-    c += r;
-    n -= size_t(r);
-  }
-  return true;
-}
-
-bool WriteExact(int fd, const void *p, size_t n) {
-  auto *c = static_cast<const char *>(p);
-  while (n) {
-    const ssize_t r = ::write(fd, c, n);
-    if (r <= 0) return false;
-    c += r;
-    n -= size_t(r);
-  }
-  return true;
-}
+// exact socket I/O lives in the shared csrc/ptpu_wire.h
+using ptpu::ReadExact;
+using ptpu::WriteExact;
 
 // Wire-level counters for one exposed table (ptpu_stats.h relaxed
 // atomics; storage-level counters live inside the table itself).
@@ -336,32 +197,12 @@ struct PsServer {
     return SendFrame(fd, nullptr, uint32_t(f.size() - 4), &f);
   }
 
-  bool Handshake(int fd) {
-    uint8_t nonce[16];
-    std::random_device rd;
-    for (auto &b : nonce) b = uint8_t(rd());
-    if (!WriteExact(fd, nonce, sizeof(nonce))) return false;
-    uint8_t lenb[4];
-    if (!ReadExact(fd, lenb, 4)) return false;
-    const uint32_t n = uint32_t(lenb[0]) | uint32_t(lenb[1]) << 8 |
-                       uint32_t(lenb[2]) << 16 | uint32_t(lenb[3]) << 24;
-    if (n != 32) return false;
-    uint8_t got[32], want[32];
-    if (!ReadExact(fd, got, 32)) return false;
-    HmacSha256(reinterpret_cast<const uint8_t *>(authkey.data()),
-               authkey.size(), nonce, sizeof(nonce), want);
-    uint8_t diff = 0;  // constant-time compare
-    for (int i = 0; i < 32; ++i) diff |= uint8_t(got[i] ^ want[i]);
-    if (diff) return false;
-    const uint8_t ok = 0x01;
-    return WriteExact(fd, &ok, 1);
-  }
 
   void Serve(int fd) {
     std::vector<uint8_t> req;
     std::vector<uint8_t> rep;  // reused: [4B length][frame payload]
     std::vector<int64_t> local;
-    if (!Handshake(fd)) {
+    if (!ptpu::ServerHandshake(fd, authkey)) {
       stats.handshake_fails.Add(1);
       return;
     }
@@ -550,7 +391,16 @@ struct PsServer {
   void AcceptLoop() {
     for (;;) {
       const int fd = ::accept(listen_fd, nullptr, nullptr);
-      if (fd < 0) return;  // listener closed by Stop
+      if (fd < 0) {
+        // transient accept failures (peer RST, EINTR, momentary fd
+        // exhaustion) must not stop the server from accepting; only
+        // the Stop()-closed listener ends the loop
+        if (!stop.load() && ptpu::AcceptErrnoIsTransient(errno)) {
+          std::this_thread::sleep_for(std::chrono::milliseconds(5));
+          continue;
+        }
+        return;
+      }
       if (stop.load()) {
         ::close(fd);
         return;

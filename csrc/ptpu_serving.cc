@@ -1,0 +1,1051 @@
+// C-hosted concurrent inference serving runtime — the wire + batching
+// half of native serving (csrc/ptpu_predictor.cc holds the execution
+// half, reached ONLY through its public C ABI in
+// csrc/ptpu_inference_api.h so the layering stays testable).
+//
+// Reference counterpart: the multi-threaded serving stack over
+// AnalysisPredictor — `paddle_infer::services::PredictorPool` fanned
+// out behind a request server, plus the dynamic batching every
+// serving system grows (Clipper NSDI'17; batching queues in Orca
+// OSDI'22). Three pieces:
+//
+//   * Parallel instances: N serving instances, each owning a PRIVATE
+//     WorkPool sub-pool (ptpu_workpool_create) attached to all of its
+//     predictors, so concurrent batches execute truly in parallel
+//     instead of serializing on the global dispatch mutex.
+//   * Dynamic micro-batcher: a lock+condvar FIFO of requests that
+//     flushes when `max_batch` rows accumulate or `deadline_us` has
+//     passed since the oldest queued request; requests are stitched
+//     into one batched run and de-muxed row-wise, strictly FIFO.
+//   * Bucket ladder: at load time the artifact is re-planned for
+//     batch sizes {1,2,4,...,max_batch} (ptpu_predictor_create_opts
+//     batch_override), so every batched run binds into a pre-planned
+//     arena — zero per-run allocation. A flush whose row count has no
+//     exact bucket pads up to the next one (counted in bucket_miss);
+//     runs that still fall off a planned arena surface in
+//     dynamic_shape_fallback.
+//
+// Wire protocol (mirrors the PS data plane, csrc/ptpu_ps_server.cc):
+//   * connect: 16-byte nonce -> HMAC-SHA256(authkey, nonce) frame ->
+//     one byte 0x01 (csrc/ptpu_hmac.h).
+//   * frames: u32-LE length prefix + payload both ways; payload leads
+//     with [u8 version][u8 tag].
+//       0x60 INFER_REQ  [u64 req_id][u16 n_inputs] then per input
+//                       [u8 onnx_dtype][u8 ndim][ndim x i64 dims][raw]
+//       0x61 INFER_REP  [u64 req_id][u16 n_outputs] then per output
+//                       [u8 ndim][ndim x i64 dims][f32 raw]
+//       0x62 INFER_ERR  [u64 req_id][u32 len][msg]
+//       0x63 META_REQ   (empty) -> 0x64 META_REP [u32 len][json]
+//   req_id is caller-chosen; replies may interleave across a
+//   connection's in-flight requests (client pipelining).
+//
+// Build: linked with ptpu_predictor.cc into
+// paddle_tpu/_native_predictor.so (csrc/Makefile); unit-tested by
+// csrc/ptpu_serving_selftest.cc.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "ptpu_hmac.h"
+#include "ptpu_inference_api.h"
+#include "ptpu_stats.h"
+#include "ptpu_wire.h"
+
+namespace {
+
+constexpr uint8_t kSvWireVersion = 1;
+constexpr uint8_t kTagInferReq = 0x60;
+constexpr uint8_t kTagInferRep = 0x61;
+constexpr uint8_t kTagInferErr = 0x62;
+constexpr uint8_t kTagMetaReq = 0x63;
+constexpr uint8_t kTagMetaRep = 0x64;
+constexpr uint32_t kSvMaxFrame = 1u << 30;
+constexpr int kSvMaxNdim = 16;
+
+// ONNX TensorProto dtype codes accepted on the wire
+enum { SV_F32 = 1, SV_I32 = 6, SV_I64 = 7 };
+
+inline int sv_dtype_size(int dt) {
+  return dt == SV_I64 ? 8 : dt == SV_I32 || dt == SV_F32 ? 4 : 0;
+}
+
+// exact I/O + frame codec live in the shared csrc/ptpu_wire.h
+using ptpu::GetU32;
+using ptpu::PutU32;
+using ptpu::ReadExact;
+using ptpu::WriteExact;
+
+/* One client connection. Replies are written by batcher instance
+ * threads while the conn's reader thread parses the next request, so
+ * writes serialize on wmu; `closed` keeps a late reply from writing
+ * into a recycled fd. */
+struct SvConn {
+  int fd = -1;
+  std::mutex wmu;
+  bool closed = false;
+
+  bool Send(const std::vector<uint8_t>& frame) {
+    std::lock_guard<std::mutex> g(wmu);
+    if (closed) return false;
+    if (!WriteExact(fd, frame.data(), frame.size())) {
+      // SO_SNDTIMEO expired (client stopped reading) or hard error:
+      // break the connection so instance workers never stall on it
+      // again and the reader thread unblocks
+      closed = true;
+      ::shutdown(fd, SHUT_RDWR);
+      return false;
+    }
+    return true;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> g(wmu);
+    if (!closed) {
+      closed = true;
+      ::shutdown(fd, SHUT_RDWR);
+    }
+  }
+};
+
+struct SvInput {
+  int dtype = SV_F32;
+  std::vector<int64_t> dims;
+  std::vector<uint8_t> data;
+};
+
+struct SvRequest {
+  uint64_t id = 0;
+  int64_t rows = 0;
+  std::vector<SvInput> inputs;
+  std::shared_ptr<SvConn> conn;
+  int64_t t_enq_us = 0;
+};
+
+// Always-on counters/histograms (csrc/ptpu_stats.h relaxed atomics).
+struct SvStats {
+  ptpu::Counter requests, replies, req_errors, batches,
+      batched_requests, batched_rows, bucket_miss, full_flushes,
+      deadline_flushes, bytes_in, bytes_out, err_frames, proto_errors,
+      handshake_fails, conns_accepted;
+  std::atomic<int64_t> conns_active{0};
+  ptpu::Histogram queue_depth, batch_fill, e2e_us, run_us;
+
+  void Reset() {
+    requests.Reset();
+    replies.Reset();
+    req_errors.Reset();
+    batches.Reset();
+    batched_requests.Reset();
+    batched_rows.Reset();
+    bucket_miss.Reset();
+    full_flushes.Reset();
+    deadline_flushes.Reset();
+    bytes_in.Reset();
+    bytes_out.Reset();
+    err_frames.Reset();
+    proto_errors.Reset();
+    handshake_fails.Reset();
+    conns_accepted.Reset();
+    queue_depth.Reset();
+    batch_fill.Reset();
+    e2e_us.Reset();
+    run_us.Reset();
+  }
+};
+
+/* Dynamic micro-batcher: a bounded FIFO request queue drained by N
+ * instance workers. A worker flushes when `max_batch` rows are queued
+ * or `deadline_us` has elapsed since the OLDEST queued request —
+ * batch-1 latency under light load never exceeds the deadline, and
+ * under heavy load batches fill before the timer matters. Whole
+ * requests only (no splitting), strictly FIFO, so de-muxed replies
+ * preserve per-connection submission order. The runner is injected:
+ * the server hands the stitched batch to a predictor instance; the
+ * selftest injects a recording fake. */
+class SvBatcher {
+ public:
+  using Runner = std::function<void(int instance,
+                                    std::vector<SvRequest>& batch)>;
+
+  SvBatcher(int64_t max_batch, int64_t deadline_us, int instances,
+            SvStats* stats, Runner runner)
+      : max_batch_(max_batch),
+        deadline_us_(deadline_us),
+        max_queue_rows_(std::max<int64_t>(64, 16 * max_batch)),
+        stats_(stats),
+        runner_(std::move(runner)) {
+    for (int i = 0; i < instances; ++i)
+      workers_.emplace_back([this, i] { worker(i); });
+  }
+
+  ~SvBatcher() { stop(); }
+
+  bool enqueue(SvRequest&& r, std::string* why) {
+    std::unique_lock<std::mutex> l(mu_);
+    if (stop_) {
+      if (why) *why = "server stopping";
+      return false;
+    }
+    if (r.rows < 1 || r.rows > max_batch_) {
+      if (why)
+        *why = "request rows " + std::to_string(r.rows) +
+               " outside [1, max_batch=" + std::to_string(max_batch_) +
+               "]";
+      return false;
+    }
+    if (rows_queued_ + r.rows > max_queue_rows_) {
+      // bounded backpressure: a flood of producers must not grow the
+      // queue (and its payload copies) without limit
+      if (why) *why = "request queue full";
+      return false;
+    }
+    rows_queued_ += r.rows;
+    q_.push_back(std::move(r));
+    stats_->queue_depth.Observe(uint64_t(q_.size()));
+    cv_.notify_one();
+    return true;
+  }
+
+  // stop workers; remaining queued requests are returned to the
+  // caller (the server errors them out before closing connections)
+  std::deque<SvRequest> stop() {
+    {
+      std::lock_guard<std::mutex> l(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : workers_)
+      if (t.joinable()) t.join();
+    workers_.clear();
+    std::lock_guard<std::mutex> l(mu_);
+    rows_queued_ = 0;
+    return std::move(q_);
+  }
+
+  int64_t queued_rows() const {
+    std::lock_guard<std::mutex> l(mu_);
+    return rows_queued_;
+  }
+
+ private:
+  void worker(int instance) {
+    std::unique_lock<std::mutex> l(mu_);
+    for (;;) {
+      cv_.wait(l, [&] { return stop_ || !q_.empty(); });
+      if (q_.empty()) {
+        if (stop_) return;
+        continue;
+      }
+      // wait for the batch to fill, but never past the oldest
+      // request's deadline
+      const int64_t deadline = q_.front().t_enq_us + deadline_us_;
+      while (!stop_ && rows_queued_ < max_batch_) {
+        const int64_t now = ptpu::NowUs();
+        if (now >= deadline) break;
+        cv_.wait_for(l, std::chrono::microseconds(deadline - now));
+        if (q_.empty()) break;  // another instance drained it
+      }
+      if (q_.empty()) {
+        if (stop_) return;
+        continue;
+      }
+      std::vector<SvRequest> batch;
+      int64_t rows = 0;
+      while (!q_.empty() && rows + q_.front().rows <= max_batch_) {
+        rows += q_.front().rows;
+        batch.push_back(std::move(q_.front()));
+        q_.pop_front();
+      }
+      rows_queued_ -= rows;
+      (rows >= max_batch_ ? stats_->full_flushes
+                          : stats_->deadline_flushes)
+          .Add(1);
+      stats_->batches.Add(1);
+      stats_->batched_requests.Add(batch.size());
+      stats_->batched_rows.Add(uint64_t(rows));
+      stats_->batch_fill.Observe(uint64_t(rows));
+      if (!q_.empty()) cv_.notify_one();  // more work for a sibling
+      l.unlock();
+      runner_(instance, batch);
+      l.lock();
+    }
+  }
+
+  const int64_t max_batch_, deadline_us_, max_queue_rows_;
+  SvStats* stats_;
+  Runner runner_;
+  mutable std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<SvRequest> q_;
+  int64_t rows_queued_ = 0;
+  bool stop_ = false;
+  std::vector<std::thread> workers_;
+};
+
+// model input signature, captured once from the bucket-1 predictor
+struct SvInputSig {
+  std::string name;
+  int dtype = SV_F32;
+  std::vector<int64_t> tail;  // dims past the batch axis
+  int64_t row_elems = 1;
+};
+
+struct SvInstance {
+  void* pool = nullptr;                       // ptpu_workpool handle
+  std::map<int64_t, PTPU_Predictor*> buckets;  // batch size -> handle
+  std::vector<std::vector<uint8_t>> stage;     // per-input batch bufs
+
+  ~SvInstance() {
+    for (auto& kv : buckets) ptpu_predictor_destroy(kv.second);
+    if (pool) ptpu_workpool_destroy(pool);
+  }
+};
+
+struct SvServer {
+  std::string model_path;
+  std::string authkey;
+  int listen_fd = -1;
+  int port = 0;
+  int64_t max_batch = 8;
+  int64_t deadline_us = 2000;
+  int instances = 2;
+  int threads_per_instance = 0;
+  std::vector<int64_t> ladder;
+  std::vector<SvInputSig> sig;
+  int n_outputs = 0;
+  std::string meta_json;
+
+  std::vector<std::unique_ptr<SvInstance>> insts;
+  std::unique_ptr<SvBatcher> batcher;
+  SvStats stats;
+
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::mutex conn_mu;
+  std::vector<std::shared_ptr<SvConn>> conns;
+  std::vector<std::thread> conn_threads;
+  std::vector<std::thread::id> done_threads;
+
+  ~SvServer() { Stop(); }
+
+  // ---------------------------------------------------------- start
+  // throws std::runtime_error on any setup failure
+  void Start(int want_port, int loopback_only) {
+    char err[512] = {0};
+    // bucket ladder: {1, 2, 4, ..., max_batch}; each predictor is
+    // re-planned for its bucket so batched runs stay zero-alloc
+    for (int64_t b = 1; b < max_batch; b *= 2) ladder.push_back(b);
+    ladder.push_back(max_batch);
+
+    const int hw = [] {
+      const char* e = std::getenv("PTPU_PREDICTOR_THREADS");
+      int v = e ? std::atoi(e) : 0;
+      if (v <= 0) v = int(std::thread::hardware_concurrency());
+      return std::max(1, v);
+    }();
+    if (threads_per_instance <= 0)
+      threads_per_instance = std::max(1, hw / std::max(1, instances));
+
+    for (int i = 0; i < instances; ++i) {
+      auto inst = std::unique_ptr<SvInstance>(new SvInstance());
+      inst->pool = ptpu_workpool_create(threads_per_instance);
+      for (int64_t b : ladder) {
+        PTPU_Predictor* p = ptpu_predictor_create_opts(
+            model_path.c_str(), b, 0, err, sizeof(err));
+        if (!p)
+          throw std::runtime_error(std::string("bucket ") +
+                                   std::to_string(b) + ": " + err);
+        ptpu_predictor_set_pool(p, inst->pool);
+        inst->buckets[b] = p;
+      }
+      insts.push_back(std::move(inst));
+    }
+
+    // input signature from the bucket-1 predictor (tail dims shared
+    // by every bucket; the batch axis is the override)
+    PTPU_Predictor* p1 = insts[0]->buckets[1];
+    const int nin = ptpu_predictor_num_inputs(p1);
+    if (nin <= 0) throw std::runtime_error("model has no inputs");
+    for (int i = 0; i < nin; ++i) {
+      SvInputSig s;
+      s.name = ptpu_predictor_input_name(p1, i);
+      s.dtype = ptpu_predictor_input_dtype(p1, i);
+      if (s.dtype == 11) s.dtype = SV_F32;  // f64 parses as f32
+      if (sv_dtype_size(s.dtype) == 0)
+        throw std::runtime_error("input '" + s.name +
+                                 "' has unsupported dtype " +
+                                 std::to_string(s.dtype));
+      const int nd = ptpu_predictor_input_ndim(p1, i);
+      const int64_t* d = ptpu_predictor_input_dims(p1, i);
+      if (nd < 1 || !d)
+        throw std::runtime_error("input '" + s.name +
+                                 "' needs a batch axis to serve");
+      for (int k = 1; k < nd; ++k) {
+        if (d[k] <= 0)
+          throw std::runtime_error("input '" + s.name +
+                                   "' has dynamic dims");
+        s.tail.push_back(d[k]);
+        s.row_elems *= d[k];
+      }
+      sig.push_back(std::move(s));
+    }
+    n_outputs = ptpu_predictor_num_outputs(p1);
+
+    /* Probe every bucket with a zero batch once: a graph that is not
+     * batch-polymorphic (static Reshape constants baked to the export
+     * batch) fails HERE, at load, not on the first live batch. Failed
+     * buckets > 1 are dropped and max_batch capped to the largest
+     * surviving bucket; a failing bucket 1 fails start. */
+    std::vector<int64_t> ok_ladder;
+    for (int64_t b : ladder) {
+      std::string perr;
+      if (ProbeBucket(b, &perr)) {
+        ok_ladder.push_back(b);
+      } else if (b == 1) {
+        throw std::runtime_error("bucket-1 probe failed: " + perr);
+      } else {
+        for (auto& inst : insts) {
+          ptpu_predictor_destroy(inst->buckets[b]);
+          inst->buckets.erase(b);
+        }
+      }
+    }
+    ladder = ok_ladder;
+    max_batch = ladder.back();
+
+    for (auto& inst : insts) inst->stage.resize(sig.size());
+
+    BuildMetaJson();
+
+    batcher.reset(new SvBatcher(
+        max_batch, deadline_us, instances, &stats,
+        [this](int instance, std::vector<SvRequest>& batch) {
+          RunBatch(instance, batch);
+        }));
+
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) throw std::runtime_error("socket() failed");
+    const int one = 1;
+    ::setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr =
+        htonl(loopback_only ? INADDR_LOOPBACK : INADDR_ANY);
+    addr.sin_port = htons(uint16_t(want_port));
+    if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0 ||
+        ::listen(listen_fd, 128) != 0)
+      throw std::runtime_error("bind/listen on port " +
+                               std::to_string(want_port) + " failed");
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port = int(ntohs(addr.sin_port));
+    accept_thread = std::thread([this] { AcceptLoop(); });
+  }
+
+  bool ProbeBucket(int64_t b, std::string* perr) {
+    char err[512] = {0};
+    for (auto& inst : insts) {
+      PTPU_Predictor* p = inst->buckets[b];
+      for (size_t i = 0; i < sig.size(); ++i) {
+        std::vector<int64_t> dims;
+        dims.push_back(b);
+        dims.insert(dims.end(), sig[i].tail.begin(), sig[i].tail.end());
+        const int64_t n = b * sig[i].row_elems;
+        int rc;
+        if (sig[i].dtype == SV_F32) {
+          std::vector<float> z(size_t(n), 0.f);
+          rc = ptpu_predictor_set_input(p, sig[i].name.c_str(), z.data(),
+                                        dims.data(), int(dims.size()),
+                                        err, sizeof(err));
+        } else if (sig[i].dtype == SV_I32) {
+          std::vector<int32_t> z(size_t(n), 0);
+          rc = ptpu_predictor_set_input_i32(p, sig[i].name.c_str(),
+                                            z.data(), dims.data(),
+                                            int(dims.size()), err,
+                                            sizeof(err));
+        } else {
+          std::vector<int64_t> z(size_t(n), 0);
+          rc = ptpu_predictor_set_input_i64(p, sig[i].name.c_str(),
+                                            z.data(), dims.data(),
+                                            int(dims.size()), err,
+                                            sizeof(err));
+        }
+        if (rc != 0) {
+          *perr = err;
+          return false;
+        }
+      }
+      if (ptpu_predictor_run(p, err, sizeof(err)) != 0) {
+        *perr = err;
+        return false;
+      }
+      // every output must carry the batch on axis 0 or de-muxing
+      // replies row-wise would hand clients other requests' data
+      for (int o = 0; o < n_outputs; ++o) {
+        const int nd = ptpu_predictor_output_ndim(p, o);
+        const int64_t* od = ptpu_predictor_output_dims(p, o);
+        if (nd < 1 || !od || od[0] != b) {
+          *perr = "output " + std::to_string(o) +
+                  " does not carry the batch on axis 0";
+          return false;
+        }
+      }
+    }
+    return true;
+  }
+
+  void BuildMetaJson() {
+    std::string out = "{\"version\":1,";
+    ptpu::AppendJsonU64(&out, "max_batch", uint64_t(max_batch));
+    out += ',';
+    ptpu::AppendJsonU64(&out, "deadline_us", uint64_t(deadline_us));
+    out += ',';
+    ptpu::AppendJsonU64(&out, "instances", uint64_t(instances));
+    out += ',';
+    ptpu::AppendJsonU64(&out, "threads_per_instance",
+                        uint64_t(threads_per_instance));
+    out += ",\"buckets\":[";
+    for (size_t k = 0; k < ladder.size(); ++k) {
+      if (k) out += ',';
+      out += std::to_string(ladder[k]);
+    }
+    out += "],";
+    ptpu::AppendJsonU64(&out, "n_outputs", uint64_t(n_outputs));
+    out += ",\"inputs\":[";
+    for (size_t i = 0; i < sig.size(); ++i) {
+      if (i) out += ',';
+      out += "{\"name\":\"" + ptpu::JsonEscape(sig[i].name) + "\",";
+      ptpu::AppendJsonU64(&out, "dtype", uint64_t(sig[i].dtype));
+      out += ",\"tail_dims\":[";
+      for (size_t k = 0; k < sig[i].tail.size(); ++k) {
+        if (k) out += ',';
+        out += std::to_string(sig[i].tail[k]);
+      }
+      out += "]}";
+    }
+    out += "]}";
+    meta_json = std::move(out);
+  }
+
+  // ------------------------------------------------------ batch run
+  void SendErrFrame(const std::shared_ptr<SvConn>& conn, uint64_t id,
+                    const std::string& msg) {
+    std::vector<uint8_t> f(4 + 2 + 8 + 4 + msg.size());
+    PutU32(f.data(), uint32_t(f.size() - 4));
+    f[4] = kSvWireVersion;
+    f[5] = kTagInferErr;
+    std::memcpy(f.data() + 6, &id, 8);
+    PutU32(f.data() + 14, uint32_t(msg.size()));
+    std::memcpy(f.data() + 18, msg.data(), msg.size());
+    stats.err_frames.Add(1);
+    stats.req_errors.Add(1);
+    stats.bytes_out.Add(f.size());
+    conn->Send(f);
+  }
+
+  void RunBatch(int instance, std::vector<SvRequest>& batch) {
+    SvInstance& inst = *insts[size_t(instance)];
+    int64_t rows = 0;
+    for (const auto& r : batch) rows += r.rows;
+    // smallest bucket that fits; pad rows up to it (zero rows — their
+    // outputs are computed and discarded, which keeps the run on the
+    // bucket's pre-planned arena instead of falling off-plan)
+    int64_t bucket = ladder.back();
+    for (int64_t b : ladder)
+      if (b >= rows) {
+        bucket = b;
+        break;
+      }
+    if (bucket != rows) stats.bucket_miss.Add(1);
+    PTPU_Predictor* p = inst.buckets[bucket];
+
+    char err[512] = {0};
+    const auto fail_all = [&](const std::string& msg) {
+      for (auto& r : batch) SendErrFrame(r.conn, r.id, msg);
+    };
+
+    for (size_t i = 0; i < sig.size(); ++i) {
+      const size_t esz = size_t(sv_dtype_size(sig[i].dtype));
+      const size_t row_b = size_t(sig[i].row_elems) * esz;
+      auto& buf = inst.stage[i];
+      const size_t need = size_t(bucket) * row_b;
+      if (buf.size() < need) buf.resize(need);
+      size_t off = 0;
+      for (const auto& r : batch) {
+        std::memcpy(buf.data() + off, r.inputs[i].data.data(),
+                    r.inputs[i].data.size());
+        off += r.inputs[i].data.size();
+      }
+      if (off < need) std::memset(buf.data() + off, 0, need - off);
+      std::vector<int64_t> dims;
+      dims.push_back(bucket);
+      dims.insert(dims.end(), sig[i].tail.begin(), sig[i].tail.end());
+      int rc;
+      if (sig[i].dtype == SV_F32)
+        rc = ptpu_predictor_set_input(
+            p, sig[i].name.c_str(),
+            reinterpret_cast<const float*>(buf.data()), dims.data(),
+            int(dims.size()), err, sizeof(err));
+      else if (sig[i].dtype == SV_I32)
+        rc = ptpu_predictor_set_input_i32(
+            p, sig[i].name.c_str(),
+            reinterpret_cast<const int32_t*>(buf.data()), dims.data(),
+            int(dims.size()), err, sizeof(err));
+      else
+        rc = ptpu_predictor_set_input_i64(
+            p, sig[i].name.c_str(),
+            reinterpret_cast<const int64_t*>(buf.data()), dims.data(),
+            int(dims.size()), err, sizeof(err));
+      if (rc != 0) return fail_all(std::string("set_input: ") + err);
+    }
+
+    const int64_t t0 = ptpu::NowUs();
+    if (ptpu_predictor_run(p, err, sizeof(err)) != 0)
+      return fail_all(std::string("run: ") + err);
+    stats.run_us.Observe(uint64_t(ptpu::NowUs() - t0));
+
+    // de-mux row-wise, FIFO: request k gets rows [row_off, row_off +
+    // rows_k) of every output
+    struct OutView {
+      const float* data;
+      std::vector<int64_t> dims;
+      int64_t row_elems;
+    };
+    std::vector<OutView> outs;
+    for (int o = 0; o < n_outputs; ++o) {
+      OutView v;
+      const int nd = ptpu_predictor_output_ndim(p, o);
+      const int64_t* od = ptpu_predictor_output_dims(p, o);
+      v.data = ptpu_predictor_output_data(p, o);
+      if (nd < 1 || !od || !v.data || od[0] != bucket)
+        return fail_all("output " + std::to_string(o) +
+                        " lost the batch axis");
+      v.dims.assign(od, od + nd);
+      v.row_elems = 1;
+      for (int k = 1; k < nd; ++k) v.row_elems *= od[k];
+      outs.push_back(std::move(v));
+    }
+
+    int64_t row_off = 0;
+    for (auto& r : batch) {
+      // frame: [len][ver][tag][id][u16 n_outputs] + outputs
+      size_t fsz = 4 + 2 + 8 + 2;
+      for (const auto& v : outs)
+        fsz += 1 + v.dims.size() * 8 +
+               size_t(r.rows) * size_t(v.row_elems) * 4;
+      std::vector<uint8_t> f(fsz);
+      PutU32(f.data(), uint32_t(fsz - 4));
+      f[4] = kSvWireVersion;
+      f[5] = kTagInferRep;
+      std::memcpy(f.data() + 6, &r.id, 8);
+      const uint16_t no16 = uint16_t(n_outputs);
+      std::memcpy(f.data() + 14, &no16, 2);
+      size_t off = 16;
+      for (const auto& v : outs) {
+        f[off++] = uint8_t(v.dims.size());
+        int64_t d0 = r.rows;
+        std::memcpy(f.data() + off, &d0, 8);
+        off += 8;
+        for (size_t k = 1; k < v.dims.size(); ++k) {
+          std::memcpy(f.data() + off, &v.dims[k], 8);
+          off += 8;
+        }
+        const size_t nb = size_t(r.rows) * size_t(v.row_elems) * 4;
+        std::memcpy(f.data() + off, v.data + row_off * v.row_elems, nb);
+        off += nb;
+      }
+      row_off += r.rows;
+      if (r.conn->Send(f)) {
+        stats.replies.Add(1);
+        stats.bytes_out.Add(f.size());
+        stats.e2e_us.Observe(uint64_t(ptpu::NowUs() - r.t_enq_us));
+      }
+    }
+  }
+
+  // ------------------------------------------------------ wire loop
+
+  void Serve(const std::shared_ptr<SvConn>& conn) {
+    const int fd = conn->fd;
+    if (!ptpu::ServerHandshake(fd, authkey)) {
+      stats.handshake_fails.Add(1);
+      return;
+    }
+    std::vector<uint8_t> req;
+    const auto proto_err = [this] { stats.proto_errors.Add(1); };
+    for (;;) {
+      uint8_t lenb[4];
+      if (!ReadExact(fd, lenb, 4)) return;
+      const uint32_t n = GetU32(lenb);
+      if (n < 2 || n > kSvMaxFrame) return proto_err();
+      if (req.size() < n) req.resize(n);
+      if (!ReadExact(fd, req.data(), n)) return;
+      stats.bytes_in.Add(4 + uint64_t(n));
+      if (req[0] != kSvWireVersion) return proto_err();
+      const uint8_t tag = req[1];
+      if (tag == kTagMetaReq) {
+        std::vector<uint8_t> f(4 + 2 + 4 + meta_json.size());
+        PutU32(f.data(), uint32_t(f.size() - 4));
+        f[4] = kSvWireVersion;
+        f[5] = kTagMetaRep;
+        PutU32(f.data() + 6, uint32_t(meta_json.size()));
+        std::memcpy(f.data() + 10, meta_json.data(), meta_json.size());
+        stats.bytes_out.Add(f.size());
+        if (!conn->Send(f)) return;
+        continue;
+      }
+      if (tag != kTagInferReq) return proto_err();
+      // [u64 req_id][u16 n_inputs] per input:
+      // [u8 dtype][u8 ndim][ndim x i64][raw]
+      if (n < 2 + 8 + 2) return proto_err();
+      SvRequest r;
+      std::memcpy(&r.id, req.data() + 2, 8);
+      uint16_t nin;
+      std::memcpy(&nin, req.data() + 10, 2);
+      size_t off = 12;
+      std::string bad;
+      if (nin != sig.size())
+        bad = "expected " + std::to_string(sig.size()) +
+              " inputs, got " + std::to_string(nin);
+      r.inputs.resize(sig.size());
+      int64_t rows = -1;
+      for (size_t i = 0; bad.empty() && i < sig.size(); ++i) {
+        if (n < off + 2) return proto_err();
+        const int dt = req[off];
+        const int nd = req[off + 1];
+        off += 2;
+        if (nd < 1 || nd > kSvMaxNdim || n < off + size_t(nd) * 8)
+          return proto_err();
+        SvInput& in = r.inputs[i];
+        in.dtype = dt;
+        in.dims.resize(size_t(nd));
+        std::memcpy(in.dims.data(), req.data() + off, size_t(nd) * 8);
+        off += size_t(nd) * 8;
+        if (dt != sig[i].dtype) {
+          bad = "input '" + sig[i].name + "': dtype " +
+                std::to_string(dt) + " != model dtype " +
+                std::to_string(sig[i].dtype);
+          break;
+        }
+        if (size_t(nd) != sig[i].tail.size() + 1) {
+          bad = "input '" + sig[i].name + "': ndim " +
+                std::to_string(nd) + " != " +
+                std::to_string(sig[i].tail.size() + 1);
+          break;
+        }
+        for (size_t k = 0; k < sig[i].tail.size(); ++k)
+          if (in.dims[k + 1] != sig[i].tail[k]) {
+            bad = "input '" + sig[i].name +
+                  "': non-batch dims do not match the model";
+            break;
+          }
+        if (!bad.empty()) break;
+        if (in.dims[0] < 1) {
+          bad = "input '" + sig[i].name + "': batch dim must be >= 1";
+          break;
+        }
+        if (rows < 0) rows = in.dims[0];
+        else if (in.dims[0] != rows) {
+          bad = "inputs disagree on the batch dim";
+          break;
+        }
+        const size_t nb = size_t(in.dims[0]) *
+                          size_t(sig[i].row_elems) *
+                          size_t(sv_dtype_size(sig[i].dtype));
+        if (n < off + nb) return proto_err();
+        in.data.assign(req.data() + off, req.data() + off + nb);
+        off += nb;
+      }
+      stats.requests.Add(1);
+      if (!bad.empty()) {
+        SendErrFrame(conn, r.id, bad);
+        continue;
+      }
+      r.rows = rows;
+      r.conn = conn;
+      r.t_enq_us = ptpu::NowUs();
+      // backpressure: retry briefly before refusing — closed-loop
+      // clients outrunning the instances see latency, not errors.
+      // enqueue only moves the request on success, so r stays intact
+      // across failed attempts; id/conn are saved for the error path.
+      std::string why;
+      const uint64_t rid = r.id;
+      bool okq = false;
+      for (int attempt = 0; attempt < 200; ++attempt) {
+        okq = batcher->enqueue(std::move(r), &why);
+        if (okq || why != "request queue full") break;
+        std::this_thread::sleep_for(std::chrono::microseconds(500));
+      }
+      if (!okq) SendErrFrame(conn, rid, why);
+    }
+  }
+
+  void ReapFinished() {
+    std::vector<std::thread> reap;
+    {
+      std::lock_guard<std::mutex> g(conn_mu);
+      if (done_threads.empty()) return;
+      for (auto it = conn_threads.begin(); it != conn_threads.end();) {
+        if (std::find(done_threads.begin(), done_threads.end(),
+                      it->get_id()) != done_threads.end()) {
+          reap.push_back(std::move(*it));
+          it = conn_threads.erase(it);
+        } else {
+          ++it;
+        }
+      }
+      done_threads.clear();
+    }
+    for (auto& t : reap)
+      if (t.joinable()) t.join();
+  }
+
+  void AcceptLoop() {
+    for (;;) {
+      const int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        // a transient accept failure (peer RST, EINTR, momentary fd
+        // exhaustion) must not permanently stop the server from
+        // accepting; only the Stop()-closed listener ends the loop
+        if (!stop.load() && ptpu::AcceptErrnoIsTransient(errno)) {
+          std::this_thread::sleep_for(std::chrono::milliseconds(5));
+          continue;
+        }
+        return;
+      }
+      if (stop.load()) {
+        ::close(fd);
+        return;
+      }
+      ReapFinished();
+      stats.conns_accepted.Add(1);
+      const int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      const int buf = 4 << 20;
+      ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+      ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+      // bound reply writes: a client that stops READING replies would
+      // otherwise block an instance worker inside Send forever once
+      // its 4MB send buffer fills (and hang Stop with it)
+      struct timeval tv{10, 0};
+      ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+      auto conn = std::make_shared<SvConn>();
+      conn->fd = fd;
+      std::lock_guard<std::mutex> g(conn_mu);
+      conns.push_back(conn);
+      conn_threads.emplace_back([this, conn] {
+        stats.conns_active.fetch_add(1, std::memory_order_relaxed);
+        try {
+          Serve(conn);
+        } catch (...) {
+        }
+        stats.conns_active.fetch_sub(1, std::memory_order_relaxed);
+        conn->Close();
+        {
+          std::lock_guard<std::mutex> g2(conn_mu);
+          conns.erase(std::remove(conns.begin(), conns.end(), conn),
+                      conns.end());
+          done_threads.push_back(std::this_thread::get_id());
+        }
+        ::close(conn->fd);
+      });
+    }
+  }
+
+  void Stop() {
+    if (stop.exchange(true)) return;
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      ::close(listen_fd);
+      listen_fd = -1;
+    }
+    if (accept_thread.joinable()) accept_thread.join();
+    // stop the batcher FIRST (in-flight batches reply over still-open
+    // conns, leftover queued requests get explicit errors) but keep
+    // the OBJECT alive until the conn reader threads are joined —
+    // they may still call enqueue(), which answers "server stopping"
+    // on a stopped batcher but would be UB on a destroyed one
+    std::deque<SvRequest> leftover;
+    if (batcher) leftover = batcher->stop();
+    for (auto& r : leftover)
+      SendErrFrame(r.conn, r.id, "server stopping");
+    {
+      std::lock_guard<std::mutex> g(conn_mu);
+      for (auto& c : conns) c->Close();
+    }
+    std::vector<std::thread> ts;
+    {
+      std::lock_guard<std::mutex> g(conn_mu);
+      ts.swap(conn_threads);
+      done_threads.clear();
+    }
+    for (auto& t : ts)
+      if (t.joinable()) t.join();
+    batcher.reset();
+  }
+
+  // --------------------------------------------------------- stats
+  std::string StatsJson() {
+    std::string out = "{\"server\":{";
+    const struct {
+      const char* name;
+      const ptpu::Counter* c;
+    } cs[] = {
+        {"requests", &stats.requests},
+        {"replies", &stats.replies},
+        {"req_errors", &stats.req_errors},
+        {"err_frames", &stats.err_frames},
+        {"proto_errors", &stats.proto_errors},
+        {"handshake_fails", &stats.handshake_fails},
+        {"conns_accepted", &stats.conns_accepted},
+        {"bytes_in", &stats.bytes_in},
+        {"bytes_out", &stats.bytes_out},
+    };
+    for (const auto& kv : cs) {
+      ptpu::AppendJsonU64(&out, kv.name, kv.c->Get());
+      out += ',';
+    }
+    ptpu::AppendJsonU64(
+        &out, "conns_active",
+        uint64_t(stats.conns_active.load(std::memory_order_relaxed)));
+    out += "},\"batcher\":{";
+    const struct {
+      const char* name;
+      const ptpu::Counter* c;
+    } bs[] = {
+        {"batches", &stats.batches},
+        {"batched_requests", &stats.batched_requests},
+        {"batched_rows", &stats.batched_rows},
+        {"bucket_miss", &stats.bucket_miss},
+        {"full_flushes", &stats.full_flushes},
+        {"deadline_flushes", &stats.deadline_flushes},
+    };
+    for (const auto& kv : bs) {
+      ptpu::AppendJsonU64(&out, kv.name, kv.c->Get());
+      out += ',';
+    }
+    // bucket-ladder coverage: runs that fell off a planned arena,
+    // summed over every instance's bucket predictors (delta since the
+    // last stats_reset — see dyn_fallback_base_)
+    const uint64_t dyn = DynFallbackSum();
+    const uint64_t base =
+        dyn_fallback_base_.load(std::memory_order_relaxed);
+    ptpu::AppendJsonU64(&out, "dynamic_shape_fallback",
+                        dyn > base ? dyn - base : 0);
+    out += ',';
+    ptpu::AppendJsonHist(&out, "queue_depth", stats.queue_depth);
+    out += ',';
+    ptpu::AppendJsonHist(&out, "batch_fill", stats.batch_fill);
+    out += ',';
+    ptpu::AppendJsonHist(&out, "e2e_us", stats.e2e_us);
+    out += ',';
+    ptpu::AppendJsonHist(&out, "run_us", stats.run_us);
+    out += "}}";
+    return out;
+  }
+
+  uint64_t DynFallbackSum() const {
+    uint64_t dyn = 0;
+    for (const auto& inst : insts)
+      for (const auto& kv : inst->buckets)
+        dyn += uint64_t(ptpu_predictor_dynamic_fallbacks(kv.second));
+    return dyn;
+  }
+
+  /* Reset zeroes the serving counters only. The bucket predictors'
+   * own stats are NOT reset — an instance worker may be mid-run, and
+   * ptpu_predictor_stats_reset rebuilds structures run() is holding
+   * pointers into (the predictor is thread-compatible, not
+   * thread-safe). dynamic_shape_fallback instead resets by baseline
+   * subtraction against the predictors' monotonic atomic counters. */
+  std::atomic<uint64_t> dyn_fallback_base_{0};
+
+  void StatsReset() {
+    stats.Reset();
+    dyn_fallback_base_.store(DynFallbackSum(),
+                             std::memory_order_relaxed);
+  }
+};
+
+thread_local std::string g_sv_json;
+
+}  // namespace
+
+extern "C" {
+
+__attribute__((visibility("default")))
+void* ptpu_serving_start(const char* model_path, int port,
+                         const char* authkey, int authkey_len,
+                         int max_batch, int64_t deadline_us,
+                         int instances, int threads_per_instance,
+                         int loopback_only, char* err, int err_len) {
+  auto* s = new SvServer();
+  try {
+    s->model_path = model_path ? model_path : "";
+    s->authkey.assign(authkey ? authkey : "",
+                      authkey_len > 0 ? size_t(authkey_len) : 0);
+    s->max_batch = max_batch > 0 ? max_batch : 8;
+    s->deadline_us = deadline_us > 0 ? deadline_us : 2000;
+    s->instances = instances > 0 ? instances : 2;
+    s->threads_per_instance = threads_per_instance;
+    s->Start(port, loopback_only);
+    return s;
+  } catch (const std::exception& e) {
+    if (err && err_len > 0)
+      std::snprintf(err, size_t(err_len), "%s", e.what());
+    delete s;
+    return nullptr;
+  }
+}
+
+__attribute__((visibility("default")))
+int ptpu_serving_port(void* h) {
+  return static_cast<SvServer*>(h)->port;
+}
+
+__attribute__((visibility("default")))
+const char* ptpu_serving_config_json(void* h) {
+  g_sv_json = static_cast<SvServer*>(h)->meta_json;
+  return g_sv_json.c_str();
+}
+
+__attribute__((visibility("default")))
+const char* ptpu_serving_stats_json(void* h) {
+  g_sv_json = static_cast<SvServer*>(h)->StatsJson();
+  return g_sv_json.c_str();
+}
+
+__attribute__((visibility("default")))
+void ptpu_serving_stats_reset(void* h) {
+  static_cast<SvServer*>(h)->StatsReset();
+}
+
+__attribute__((visibility("default")))
+void ptpu_serving_stop(void* h) {
+  auto* s = static_cast<SvServer*>(h);
+  s->Stop();
+  delete s;
+}
+
+}  // extern "C"

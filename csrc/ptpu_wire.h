@@ -1,0 +1,90 @@
+// Shared TCP wire machinery for the native servers — exact-length
+// socket I/O, u32-LE frame length codec, and the HMAC-SHA256 nonce
+// handshake — used by BOTH the PS data plane (csrc/ptpu_ps_server.cc)
+// and the inference serving runtime (csrc/ptpu_serving.cc). Factored
+// so a fix lands once (the two serve loops themselves differ: table
+// gather/scatter vs batcher enqueue).
+#ifndef PTPU_WIRE_H_
+#define PTPU_WIRE_H_
+
+#include <errno.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <random>
+#include <string>
+
+#include "ptpu_hmac.h"
+
+namespace ptpu {
+
+inline bool ReadExact(int fd, void *p, size_t n) {
+  auto *c = static_cast<char *>(p);
+  while (n) {
+    const ssize_t r = ::read(fd, c, n);
+    if (r <= 0) return false;
+    c += r;
+    n -= size_t(r);
+  }
+  return true;
+}
+
+inline bool WriteExact(int fd, const void *p, size_t n) {
+  auto *c = static_cast<const char *>(p);
+  while (n) {
+    const ssize_t r = ::write(fd, c, n);
+    if (r <= 0) return false;
+    c += r;
+    n -= size_t(r);
+  }
+  return true;
+}
+
+inline void PutU32(uint8_t *p, uint32_t v) {
+  p[0] = uint8_t(v);
+  p[1] = uint8_t(v >> 8);
+  p[2] = uint8_t(v >> 16);
+  p[3] = uint8_t(v >> 24);
+}
+
+inline uint32_t GetU32(const uint8_t *p) {
+  return uint32_t(p[0]) | uint32_t(p[1]) << 8 | uint32_t(p[2]) << 16 |
+         uint32_t(p[3]) << 24;
+}
+
+/* Server side of the connect handshake: send a 16-byte random nonce,
+ * expect one frame holding HMAC-SHA256(authkey, nonce), answer one
+ * byte 0x01 (the multiprocessing.connection HMAC challenge restated
+ * for C peers). Constant-time MAC compare. */
+inline bool ServerHandshake(int fd, const std::string &authkey) {
+  uint8_t nonce[16];
+  std::random_device rd;
+  for (auto &b : nonce) b = uint8_t(rd());
+  if (!WriteExact(fd, nonce, sizeof(nonce))) return false;
+  uint8_t lenb[4];
+  if (!ReadExact(fd, lenb, 4)) return false;
+  if (GetU32(lenb) != 32) return false;
+  uint8_t got[32], want[32];
+  if (!ReadExact(fd, got, 32)) return false;
+  HmacSha256(reinterpret_cast<const uint8_t *>(authkey.data()),
+             authkey.size(), nonce, sizeof(nonce), want);
+  uint8_t diff = 0;
+  for (int i = 0; i < 32; ++i) diff |= uint8_t(got[i] ^ want[i]);
+  if (diff) return false;
+  const uint8_t ok = 0x01;
+  return WriteExact(fd, &ok, 1);
+}
+
+/* accept() errno triage for the server loops: a transient failure
+ * (peer RST before accept, EINTR, momentary fd exhaustion) must not
+ * permanently stop a serving process from accepting — only a closed
+ * listener (Stop) ends the loop. */
+inline bool AcceptErrnoIsTransient(int err) {
+  return err == ECONNABORTED || err == EINTR || err == EMFILE ||
+         err == ENFILE || err == ENOBUFS || err == ENOMEM ||
+         err == EPROTO;
+}
+
+}  // namespace ptpu
+
+#endif  // PTPU_WIRE_H_

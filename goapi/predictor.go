@@ -66,6 +66,78 @@ func NewPredictor(modelPath string) (*Predictor, error) {
 	return pred, nil
 }
 
+// NewPredictorWithOptions loads an artifact with the serving-era
+// knobs: batchOverride > 0 re-plans the model for that leading
+// (batch) dim — the bucket-ladder trick the C serving runtime uses —
+// and threads > 0 gives the instance a PRIVATE worker sub-pool so
+// concurrent predictors scale instead of serializing on the shared
+// pool's dispatch mutex.
+func NewPredictorWithOptions(modelPath string, batchOverride int64,
+	threads int) (*Predictor, error) {
+	cpath := C.CString(modelPath)
+	defer C.free(unsafe.Pointer(cpath))
+	buf := make([]C.char, errLen)
+	p := C.ptpu_predictor_create_opts(cpath, C.int64_t(batchOverride),
+		C.int(threads), &buf[0], errLen)
+	if p == nil {
+		return nil, lastErr(buf)
+	}
+	pred := &Predictor{p: p}
+	runtime.SetFinalizer(pred, func(x *Predictor) { x.Destroy() })
+	return pred, nil
+}
+
+// WorkPool is a shared execution context: attach one pool to several
+// predictors (a serving instance's bucket ladder) via SetPool. The
+// pool is borrowed — Destroy it only after every predictor using it.
+type WorkPool struct{ p unsafe.Pointer }
+
+func NewWorkPool(threads int) *WorkPool {
+	return &WorkPool{p: C.ptpu_workpool_create(C.int(threads))}
+}
+
+func (w *WorkPool) Destroy() {
+	if w.p != nil {
+		C.ptpu_workpool_destroy(w.p)
+		w.p = nil
+	}
+}
+
+// SetPool attaches a shared WorkPool (nil detaches back to the global
+// pool).
+func (p *Predictor) SetPool(w *WorkPool) {
+	if w == nil {
+		C.ptpu_predictor_set_pool(p.p, nil)
+	} else {
+		C.ptpu_predictor_set_pool(p.p, w.p)
+	}
+	runtime.KeepAlive(p)
+}
+
+// InputSignature returns input i's dims (reflecting a batch
+// override) and ONNX dtype code (1 f32, 6 i32, 7 i64).
+func (p *Predictor) InputSignature(i int) ([]int64, int) {
+	nd := int(C.ptpu_predictor_input_ndim(p.p, C.int(i)))
+	var dims []int64
+	if nd > 0 {
+		cd := C.ptpu_predictor_input_dims(p.p, C.int(i))
+		src := unsafe.Slice((*int64)(unsafe.Pointer(cd)), nd)
+		dims = make([]int64, nd)
+		copy(dims, src)
+	}
+	dt := int(C.ptpu_predictor_input_dtype(p.p, C.int(i)))
+	runtime.KeepAlive(p)
+	return dims, dt
+}
+
+// DynamicFallbacks counts runs since load/reset that missed the
+// planned-arena zero-alloc path.
+func (p *Predictor) DynamicFallbacks() int64 {
+	n := int64(C.ptpu_predictor_dynamic_fallbacks(p.p))
+	runtime.KeepAlive(p)
+	return n
+}
+
 // Destroy frees the native predictor. Safe to call twice.
 func (p *Predictor) Destroy() {
 	if p.p != nil {

@@ -583,6 +583,39 @@ def _predictor_lib() -> ctypes.CDLL:
         lib.ptpu_predictor_create.restype = c.c_void_p
         lib.ptpu_predictor_create.argtypes = [c.c_char_p, c.c_char_p,
                                               c.c_int]
+        try:
+            lib.ptpu_predictor_create_opts.restype = c.c_void_p
+            lib.ptpu_predictor_create_opts.argtypes = [
+                c.c_char_p, c.c_int64, c.c_int, c.c_char_p, c.c_int]
+            lib.ptpu_workpool_create.restype = c.c_void_p
+            lib.ptpu_workpool_create.argtypes = [c.c_int]
+            lib.ptpu_workpool_destroy.argtypes = [c.c_void_p]
+            lib.ptpu_predictor_set_pool.argtypes = [c.c_void_p,
+                                                    c.c_void_p]
+            lib.ptpu_predictor_input_ndim.argtypes = [c.c_void_p,
+                                                      c.c_int]
+            lib.ptpu_predictor_input_dims.restype = c.POINTER(c.c_int64)
+            lib.ptpu_predictor_input_dims.argtypes = [c.c_void_p,
+                                                      c.c_int]
+            lib.ptpu_predictor_input_dtype.argtypes = [c.c_void_p,
+                                                       c.c_int]
+            lib.ptpu_predictor_dynamic_fallbacks.restype = c.c_int64
+            lib.ptpu_predictor_dynamic_fallbacks.argtypes = [c.c_void_p]
+            lib.ptpu_serving_start.restype = c.c_void_p
+            lib.ptpu_serving_start.argtypes = [
+                c.c_char_p, c.c_int, c.c_char_p, c.c_int, c.c_int,
+                c.c_int64, c.c_int, c.c_int, c.c_int, c.c_char_p,
+                c.c_int]
+            lib.ptpu_serving_port.argtypes = [c.c_void_p]
+            lib.ptpu_serving_config_json.restype = c.c_char_p
+            lib.ptpu_serving_config_json.argtypes = [c.c_void_p]
+            lib.ptpu_serving_stats_json.restype = c.c_char_p
+            lib.ptpu_serving_stats_json.argtypes = [c.c_void_p]
+            lib.ptpu_serving_stats_reset.argtypes = [c.c_void_p]
+            lib.ptpu_serving_stop.argtypes = [c.c_void_p]
+            lib._ptpu_has_serving = True
+        except AttributeError:   # stale prebuilt .so: serving degrades
+            lib._ptpu_has_serving = False
         lib.ptpu_predictor_destroy.argtypes = [c.c_void_p]
         lib.ptpu_predictor_num_inputs.argtypes = [c.c_void_p]
         lib.ptpu_predictor_num_outputs.argtypes = [c.c_void_p]
@@ -631,17 +664,31 @@ def _predictor_lib() -> ctypes.CDLL:
 
 
 class NativePredictor:
-    """One loaded artifact. Thread-compatible: one instance per thread
-    (concurrent instances are safe — the engine serializes its worker
-    pool dispatches internally)."""
+    """One loaded artifact. Thread-compatible: one instance per thread.
 
-    def __init__(self, model_path: str):
+    `threads` > 0 gives the instance a PRIVATE worker sub-pool so
+    concurrent instances scale instead of serializing on the shared
+    pool's dispatch mutex; `batch_override` > 0 re-plans the artifact
+    for that leading (batch) dim — the serving bucket ladder."""
+
+    def __init__(self, model_path: str, batch_override: int = 0,
+                 threads: int = 0):
         import numpy as np  # local: keep module import light
         self._np = np
         self._lib = _predictor_lib()
         self._err = ctypes.create_string_buffer(512)
-        self._h = self._lib.ptpu_predictor_create(
-            model_path.encode(), self._err, 512)
+        if (batch_override or threads) and \
+                not getattr(self._lib, "_ptpu_has_serving", False):
+            raise RuntimeError(
+                "batch_override/threads need the serving-era ABI "
+                "(stale _native_predictor.so: delete it and re-import)")
+        if batch_override or threads:
+            self._h = self._lib.ptpu_predictor_create_opts(
+                model_path.encode(), batch_override, threads,
+                self._err, 512)
+        else:
+            self._h = self._lib.ptpu_predictor_create(
+                model_path.encode(), self._err, 512)
         if not self._h:
             raise RuntimeError("ptpu_predictor_create: " +
                                self._err.value.decode())
@@ -687,6 +734,27 @@ class NativePredictor:
     def input_name(self, i: int = 0) -> str:
         return self._lib.ptpu_predictor_input_name(self._handle(),
                                                    i).decode()
+
+    def input_signature(self, i: int = 0):
+        """(name, onnx_dtype_code, dims) of input i — dims reflect a
+        batch_override. Needs the serving-era ABI; None otherwise."""
+        if not getattr(self._lib, "_ptpu_has_serving", False):
+            return None
+        h = self._handle()
+        nd = self._lib.ptpu_predictor_input_ndim(h, i)
+        dims = self._lib.ptpu_predictor_input_dims(h, i)
+        return (self.input_name(i),
+                int(self._lib.ptpu_predictor_input_dtype(h, i)),
+                [dims[k] for k in range(nd)] if nd > 0 else [])
+
+    @property
+    def dynamic_fallbacks(self) -> int:
+        """Runs since load/reset that missed the planned-arena
+        zero-alloc path (also in stats()['dynamic_shape_fallback'])."""
+        if not getattr(self._lib, "_ptpu_has_serving", False):
+            return -1
+        return int(self._lib.ptpu_predictor_dynamic_fallbacks(
+            self._handle()))
 
     def set_input(self, name: str, arr) -> None:
         np = self._np
@@ -742,6 +810,16 @@ class NativePredictor:
         return np.ctypeslib.as_array(data, shape=(n,)).reshape(shape).copy()
 
 
+def serving_available() -> bool:
+    """True when _native_predictor.so carries the concurrent serving
+    runtime (ptpu_serving_* ABI)."""
+    try:
+        return bool(getattr(_predictor_lib(), "_ptpu_has_serving",
+                            False))
+    except OSError:
+        return False
+
+
 # ---------------------------------------------------------------------------
 # C ABI manifest — every exported symbol this binding layer (or the
 # tests' hand-rolled ctypes) relies on, per shared object. The tier-1
@@ -781,14 +859,23 @@ ABI_SYMBOLS = {
         "ptpu_ps_server_stats_reset",
     ),
     "_native_predictor.so": (
-        "ptpu_predictor_create", "ptpu_predictor_destroy",
+        "ptpu_predictor_create", "ptpu_predictor_create_opts",
+        "ptpu_predictor_destroy",
+        "ptpu_workpool_create", "ptpu_workpool_destroy",
+        "ptpu_predictor_set_pool",
         "ptpu_predictor_num_inputs", "ptpu_predictor_num_outputs",
         "ptpu_predictor_num_nodes", "ptpu_predictor_fused_nodes",
         "ptpu_predictor_arena_bytes", "ptpu_predictor_input_name",
+        "ptpu_predictor_input_ndim", "ptpu_predictor_input_dims",
+        "ptpu_predictor_input_dtype",
+        "ptpu_predictor_dynamic_fallbacks",
         "ptpu_predictor_set_input", "ptpu_predictor_set_input_i32",
         "ptpu_predictor_set_input_i64", "ptpu_predictor_run",
         "ptpu_predictor_output_ndim", "ptpu_predictor_output_dims",
         "ptpu_predictor_output_data", "ptpu_predictor_stats_json",
         "ptpu_predictor_stats_reset", "ptpu_predictor_set_profiler",
+        "ptpu_serving_start", "ptpu_serving_port",
+        "ptpu_serving_config_json", "ptpu_serving_stats_json",
+        "ptpu_serving_stats_reset", "ptpu_serving_stop",
     ),
 }

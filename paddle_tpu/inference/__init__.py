@@ -206,6 +206,17 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
+def create_server(model_path: str, **kwargs):
+    """Start the C-hosted concurrent serving runtime for an exported
+    ONNX artifact: dynamic micro-batching, N parallel predictor
+    instances, framed-HMAC TCP data plane (csrc/ptpu_serving.cc). See
+    paddle_tpu.inference.serving.create_server for the knobs; returns
+    an InferenceServer (use .client() for a connected
+    InferenceClient)."""
+    from .serving import create_server as _cs
+    return _cs(model_path, **kwargs)
+
+
 class DataType:
     """Reference: paddle_infer.DataType enum (inference/api/paddle_api.h)."""
     FLOAT32 = 0

@@ -1,0 +1,275 @@
+"""Concurrent native serving — Python face of csrc/ptpu_serving.cc.
+
+Reference counterpart: `paddle_infer::services::PredictorPool` plus
+the request server every production deployment wraps around it. Here
+the whole hot path is C-hosted: `create_server` starts the in-process
+C serving runtime (dynamic micro-batcher flushing at `max_batch` rows
+or `deadline_us`, N parallel predictor instances each on a private
+worker sub-pool, a pre-planned bucket ladder of batch sizes so batched
+runs stay on the zero-alloc arena path), serving u32-LE framed INFER
+requests over TCP behind the same HMAC-SHA256 nonce handshake the PS
+data plane uses. Python only starts/stops the server and polls stats;
+no request ever touches the interpreter.
+
+`InferenceClient` is the reference client: it speaks the framed wire
+protocol directly (handshake, META, INFER), supports `infer` (one
+round trip) and `infer_many` (pipelined — several requests in flight
+on one connection, which is how a single client still benefits from
+server-side batching).
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import hmac as _hmac
+import json
+import os
+import socket
+import struct
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+WIRE_VERSION = 1
+TAG_INFER_REQ = 0x60
+TAG_INFER_REP = 0x61
+TAG_INFER_ERR = 0x62
+TAG_META_REQ = 0x63
+TAG_META_REP = 0x64
+
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+_I64 = struct.Struct("<q")
+
+# ONNX TensorProto codes on the wire
+_DT_F32, _DT_I32, _DT_I64 = 1, 6, 7
+_NP_TO_DT = {"float32": _DT_F32, "int32": _DT_I32, "int64": _DT_I64}
+_DT_TO_NP = {_DT_F32: np.float32, _DT_I32: np.int32, _DT_I64: np.int64}
+
+
+class ServingError(RuntimeError):
+    """Server-side INFER_ERR reply (validation or execution failure)."""
+
+
+class InferenceServer:
+    """One C-hosted serving runtime bound to a TCP port.
+
+    The handle owns the C server: predictor instances, batcher threads
+    and the accept loop all live in _native_predictor.so. `stats()` /
+    `config()` parse the C snapshots; `stop()` (or GC) tears the
+    runtime down."""
+
+    def __init__(self, model_path: str, port: int = 0,
+                 authkey: Optional[bytes] = None, max_batch: int = 8,
+                 deadline_us: int = 2000, instances: int = 2,
+                 threads_per_instance: int = 0,
+                 loopback_only: bool = True):
+        from ..core.native import _predictor_lib
+        lib = _predictor_lib()
+        if not getattr(lib, "_ptpu_has_serving", False):
+            raise RuntimeError(
+                "native serving unavailable (stale "
+                "_native_predictor.so: delete it and re-import)")
+        self._lib = lib
+        self.authkey = authkey if authkey is not None else os.urandom(16)
+        err = ctypes.create_string_buffer(512)
+        self._h = lib.ptpu_serving_start(
+            model_path.encode(), port, self.authkey, len(self.authkey),
+            max_batch, deadline_us, instances, threads_per_instance,
+            1 if loopback_only else 0, err, 512)
+        if not self._h:
+            raise RuntimeError("ptpu_serving_start: " +
+                               err.value.decode())
+        self.port = int(lib.ptpu_serving_port(self._h))
+
+    def _handle(self):
+        # a NULL handle would segfault inside the C runtime; fail here
+        if not getattr(self, "_h", None):
+            raise RuntimeError("InferenceServer is stopped")
+        return self._h
+
+    def config(self) -> dict:
+        """Effective configuration (buckets built after probing,
+        instances, input signature)."""
+        return json.loads(
+            self._lib.ptpu_serving_config_json(self._handle()).decode())
+
+    def stats(self) -> dict:
+        """{"server": wire counters, "batcher": batching counters +
+        queue_depth/batch_fill/e2e_us/run_us log2 histograms,
+        dynamic_shape_fallback}."""
+        return json.loads(
+            self._lib.ptpu_serving_stats_json(self._handle()).decode())
+
+    def stats_reset(self) -> None:
+        self._lib.ptpu_serving_stats_reset(self._handle())
+
+    def client(self, host: str = "127.0.0.1") -> "InferenceClient":
+        self._handle()   # a stopped server has no port to dial
+        return InferenceClient(self.port, self.authkey, host=host)
+
+    def stop(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.ptpu_serving_stop(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:   # interpreter teardown
+            pass
+
+
+def create_server(model_path: str, **kwargs) -> InferenceServer:
+    """Start the C serving runtime for an exported artifact.
+
+    Keyword knobs: `port` (0 = pick free), `authkey` (bytes; random by
+    default — read it back from `.authkey`), `max_batch`,
+    `deadline_us`, `instances`, `threads_per_instance` (0 = split host
+    cores evenly), `loopback_only`."""
+    return InferenceServer(model_path, **kwargs)
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("serving connection closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class InferenceClient:
+    """Framed-wire client for the native serving runtime."""
+
+    def __init__(self, port: int, authkey: bytes,
+                 host: str = "127.0.0.1", timeout_s: float = 60.0):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._next_id = 0
+        nonce = _read_exact(self._sock, 16)
+        mac = _hmac.new(authkey, nonce, hashlib.sha256).digest()
+        self._sock.sendall(_U32.pack(len(mac)) + mac)
+        if _read_exact(self._sock, 1) != b"\x01":
+            raise ConnectionError("serving handshake rejected")
+
+    # ------------------------------------------------------- framing
+    def _send_frame(self, payload: bytes) -> None:
+        self._sock.sendall(_U32.pack(len(payload)) + payload)
+
+    def _read_frame(self) -> bytes:
+        n = _U32.unpack(_read_exact(self._sock, 4))[0]
+        return _read_exact(self._sock, n)
+
+    def meta(self) -> dict:
+        self._send_frame(bytes([WIRE_VERSION, TAG_META_REQ]))
+        f = self._read_frame()
+        if len(f) < 6 or f[1] != TAG_META_REP:
+            raise ConnectionError("bad META reply")
+        (mlen,) = _U32.unpack_from(f, 2)
+        return json.loads(f[6:6 + mlen].decode())
+
+    # --------------------------------------------------------- infer
+    def _encode_request(self, req_id: int,
+                        arrays: Sequence[np.ndarray]) -> bytes:
+        parts = [bytes([WIRE_VERSION, TAG_INFER_REQ]),
+                 _U64.pack(req_id), struct.pack("<H", len(arrays))]
+        for a in arrays:
+            a = np.ascontiguousarray(a)
+            dt = _NP_TO_DT.get(a.dtype.name)
+            if dt is None:
+                raise TypeError(f"unsupported input dtype {a.dtype}")
+            parts.append(bytes([dt, a.ndim]))
+            parts.append(b"".join(_I64.pack(d) for d in a.shape))
+            parts.append(a.tobytes())
+        return b"".join(parts)
+
+    @staticmethod
+    def _decode_reply(f: bytes):
+        """-> (req_id, outputs-list | ServingError). Server-side
+        request errors come back as a VALUE so pipelined readers can
+        keep draining the stream in sync; plain infer() raises it."""
+        req_id = _U64.unpack_from(f, 2)[0]
+        if f[1] == TAG_INFER_ERR:
+            (mlen,) = _U32.unpack_from(f, 10)
+            return req_id, ServingError(f[14:14 + mlen].decode())
+        if f[1] != TAG_INFER_REP:
+            raise ConnectionError(f"unexpected reply tag {f[1]:#x}")
+        (nout,) = struct.unpack_from("<H", f, 10)
+        off = 12
+        outs = []
+        for _ in range(nout):
+            nd = f[off]
+            off += 1
+            dims = [_I64.unpack_from(f, off + 8 * k)[0]
+                    for k in range(nd)]
+            off += 8 * nd
+            n = int(np.prod(dims)) if dims else 1
+            arr = np.frombuffer(f, np.float32, n, off).reshape(dims)
+            off += n * 4
+            outs.append(arr.copy())
+        return req_id, outs
+
+    def infer(self, *arrays) -> List[np.ndarray]:
+        """One request, one reply (float32 outputs). Raises
+        ServingError on a server-side INFER_ERR."""
+        rid = self._next_id
+        self._next_id += 1
+        self._send_frame(self._encode_request(rid, arrays))
+        got_id, outs = self._decode_reply(self._read_frame())
+        if got_id != rid:
+            raise ConnectionError(
+                f"reply id {got_id} != request id {rid}")
+        if isinstance(outs, ServingError):
+            raise outs
+        return outs
+
+    def infer_many(self, requests: Sequence[Sequence[np.ndarray]],
+                   depth: int = 8, return_exceptions: bool = False):
+        """Pipelined inference: keep up to `depth` requests in flight
+        on this connection — a single client's requests then batch
+        server-side. Results come back in request order. A per-request
+        server error never desyncs the stream: every in-flight reply
+        is still drained; with `return_exceptions` the failed entries
+        are the ServingError instances, otherwise the first error
+        re-raises after the pipeline is drained."""
+        results: List[object] = [None] * len(requests)
+        pending = {}
+        sent = 0
+        done = 0
+        while done < len(requests):
+            while sent < len(requests) and len(pending) < depth:
+                rid = self._next_id
+                self._next_id += 1
+                pending[rid] = sent
+                self._send_frame(
+                    self._encode_request(rid, requests[sent]))
+                sent += 1
+            got_id, outs = self._decode_reply(self._read_frame())
+            results[pending.pop(got_id)] = outs
+            done += 1
+        if not return_exceptions:
+            for r in results:
+                if isinstance(r, ServingError):
+                    raise r
+        return results
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

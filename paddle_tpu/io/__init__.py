@@ -19,4 +19,5 @@ from .sampler import (  # noqa: F401
     WeightedRandomSampler,
 )
 from .worker import WorkerInfo, get_worker_info  # noqa: F401
-from .device_buffer import DeviceBufferedReader, device_buffered  # noqa: F401
+from .device_buffer import (DeviceBufferedReader, HostPrefetcher,  # noqa: F401
+                            device_buffered, host_prefetched)

@@ -187,10 +187,26 @@ class DataLoader:
                 t.join(timeout=5)
 
     def __iter__(self):
+        import os
+        src = self._batches()
+        # host-side double buffering: with in-process loading
+        # (num_workers <= 0) batch prep runs inline on the consumer
+        # thread; a HostPrefetcher worker pulls `prefetch_factor`
+        # batches ahead so collate overlaps the consumer's compute
+        # (worker modes already overlap via their own threads/procs).
+        # The thread lives only while this iterator does; a process
+        # that os.fork()s WHILE another loader is mid-iteration can
+        # set PTPU_HOST_PREFETCH=0 to keep iteration thread-free
+        # (fork-with-threads hazard — jax's runtime threads make fork
+        # unsafe in principle already).
+        if self.num_workers <= 0 and self.use_buffer_reader and \
+                os.environ.get("PTPU_HOST_PREFETCH", "1") != "0":
+            from .device_buffer import host_prefetched
+            src = host_prefetched(src, depth=self.prefetch_factor)
         if not self.use_buffer_reader:
-            yield from self._batches()
+            yield from src
             return
         # device double-buffering (buffered_reader.cc equivalent) — one
         # implementation, shared with the standalone reader
         from .device_buffer import device_buffered
-        yield from device_buffered(self._batches(), buffer_size=2)
+        yield from device_buffered(src, buffer_size=2)

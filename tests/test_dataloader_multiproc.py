@@ -191,3 +191,60 @@ class TestDeviceBufferedReader:
         got = [np.asarray(b[0] if isinstance(b, (list, tuple)) else b)
                for b in device_buffered(dl)]
         assert sum(g.shape[0] for g in got) == 6
+
+
+class TestHostPrefetcher:
+    """Host-side double buffering (ISSUE r8 satellite): a background
+    thread pulls batches ahead so collate overlaps consumer compute.
+    The overlap path must yield IDENTICAL batches, in order, to the
+    serial path."""
+
+    def test_overlap_matches_serial_dataloader(self):
+        import numpy as np
+        from paddle_tpu.io import DataLoader, TensorDataset
+
+        rs = np.random.RandomState(0)
+        data = rs.randn(23, 4).astype(np.float32)
+        ds = TensorDataset([data])
+        # serial: no buffer reader, no prefetch thread
+        serial = [np.asarray(b[0] if isinstance(b, (list, tuple)) else b)
+                  for b in DataLoader(ds, batch_size=4, shuffle=False,
+                                      use_buffer_reader=False)]
+        # overlapped: buffer reader on -> HostPrefetcher + device buffer
+        overlap = [np.asarray(b[0] if isinstance(b, (list, tuple)) else b)
+                   for b in DataLoader(ds, batch_size=4, shuffle=False,
+                                       use_buffer_reader=True)]
+        assert len(serial) == len(overlap) == 6  # 5 full + tail of 3
+        for s, o in zip(serial, overlap):
+            np.testing.assert_array_equal(s, o)
+
+    def test_prefetcher_preserves_order_and_reraises(self):
+        import numpy as np
+        import pytest
+        from paddle_tpu.io import host_prefetched
+
+        out = list(host_prefetched((np.full((2,), i) for i in range(50)),
+                                   depth=3))
+        assert [int(b[0]) for b in out] == list(range(50))
+
+        def boom():
+            yield np.zeros((1,))
+            raise ValueError("producer failed")
+
+        it = iter(host_prefetched(boom(), depth=2))
+        next(it)
+        with pytest.raises(ValueError, match="producer failed"):
+            for _ in it:
+                pass
+
+    def test_early_consumer_exit_stops_worker(self):
+        import threading
+        import numpy as np
+        from paddle_tpu.io import host_prefetched
+
+        n0 = threading.active_count()
+        it = iter(host_prefetched((np.zeros((1,)) for _ in range(1000)),
+                                  depth=2))
+        next(it)
+        it.close()  # generator finally: stop flag + join
+        assert threading.active_count() <= n0 + 1

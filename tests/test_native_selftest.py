@@ -12,7 +12,14 @@ naive references, duplicate coalescing, torn-read freedom under
 concurrent pull/push, SHA-256/HMAC known vectors, a full socket
 round-trip incl. bad-authkey rejection, and the csrc/ptpu_stats.h
 counters/histograms: log2 bucket boundaries, exact relaxed-atomic sums
-under threads, table + server wire stats JSON incl. reset).
+under threads, table + server wire stats JSON incl. reset);
+`csrc/ptpu_serving_selftest.cc` asserts the serving runtime (batcher
+deadline/full flushes, partial final batch, FIFO de-mux ordering,
+batcher stats exactness, the two-instance >= 1.3x private-sub-pool
+concurrency stress, HMAC handshake accept/reject, batched INFER
+round-trips with row de-mux parity, bucket_miss accounting and
+server-counter exactness — all over a hand-rolled ONNX artifact, no
+Python in the loop).
 """
 import os
 import subprocess
@@ -23,7 +30,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def test_native_selftest_passes():
     r = subprocess.run(["make", "selftest"],
                       cwd=os.path.join(REPO, "csrc"),
-                      capture_output=True, text=True, timeout=300)
+                      capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "all native unit tests passed" in r.stdout
     assert "all native ps-table unit tests passed" in r.stdout
+    assert "all native serving unit tests passed" in r.stdout

@@ -1,0 +1,293 @@
+"""Concurrent serving runtime (csrc/ptpu_serving.cc) + parallel
+predictor instances — ISSUE r8 tentpole tests.
+
+The C internals (batcher flush semantics, FIFO de-mux, HMAC socket
+round trips) are covered by csrc/ptpu_serving_selftest.cc via
+tests/test_native_selftest.py; this module exercises the FULL Python
+chain: exported artifact -> create_server -> InferenceClient over TCP
+-> numeric parity vs a local predictor, plus the two-instance
+concurrency contract (output parity under contention AND the >= 1.3x
+aggregate-throughput guard) and the dynamic_shape_fallback stats
+counter.
+"""
+import os
+import subprocess
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build():
+    subprocess.run(["make", "all"], cwd=os.path.join(REPO, "csrc"),
+                   check=True, capture_output=True)
+
+
+@pytest.fixture(scope="module")
+def built():
+    try:
+        _build()
+    except FileNotFoundError:
+        if not os.path.exists(os.path.join(REPO, "paddle_tpu",
+                                           "_native_predictor.so")):
+            raise
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(f"native build failed:\n{e.stderr}") from e
+    from paddle_tpu.core import native
+    if not native.serving_available():
+        pytest.skip("native serving runtime unavailable")
+    return True
+
+
+@pytest.fixture(scope="module")
+def mlp_artifact(built, tmp_path_factory):
+    import paddle_tpu as pt
+    from paddle_tpu.onnx.converter import trace_to_onnx
+
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(32, 64), pt.nn.ReLU(),
+                           pt.nn.Linear(64, 8))
+    net.eval()
+    x = np.zeros((4, 32), np.float32)
+    path = str(tmp_path_factory.mktemp("sv") / "mlp.onnx")
+    with open(path, "wb") as f:
+        f.write(trace_to_onnx(lambda a: net(a), (jnp.asarray(x),)))
+    return path
+
+
+class TestServingServer:
+    def test_round_trip_parity_and_counters(self, mlp_artifact):
+        from paddle_tpu.core.native import NativePredictor
+        from paddle_tpu.inference import create_server
+
+        ref = NativePredictor(mlp_artifact)
+        with create_server(mlp_artifact, max_batch=4, deadline_us=1500,
+                           instances=2) as srv:
+            cli = srv.client()
+            meta = cli.meta()
+            assert meta["buckets"] == [1, 2, 4]
+            assert meta["inputs"][0]["tail_dims"] == [32]
+            rs = np.random.RandomState(0)
+            for rows in (1, 2, 3, 4):
+                x = rs.randn(rows, 32).astype(np.float32)
+                out = cli.infer(x)
+                ref.set_input(ref.input_name(0), x)
+                ref.run()
+                np.testing.assert_allclose(out[0], ref.output(0),
+                                           rtol=1e-5, atol=1e-6)
+            st = srv.stats()
+            assert st["server"]["requests"] == 4
+            assert st["server"]["replies"] == 4
+            assert st["server"]["req_errors"] == 0
+            assert st["batcher"]["batched_requests"] == 4
+            # rows=3 had no exact bucket -> padded run counted
+            assert st["batcher"]["bucket_miss"] == 1
+            # every batched run stayed on a pre-planned arena
+            assert st["batcher"]["dynamic_shape_fallback"] == 0
+            # e2e latency histogram observed every reply
+            assert st["batcher"]["e2e_us"]["count"] == 4
+            cli.close()
+        # a stopped server raises instead of handing NULL to the C ABI
+        with pytest.raises(RuntimeError, match="stopped"):
+            srv.stats()
+        ref.close()
+
+    def test_pipelined_requests_batch_and_demux(self, mlp_artifact):
+        from paddle_tpu.core.native import NativePredictor
+        from paddle_tpu.inference import create_server
+
+        ref = NativePredictor(mlp_artifact)
+        with create_server(mlp_artifact, max_batch=4, deadline_us=4000,
+                           instances=1) as srv:
+            cli = srv.client()
+            rs = np.random.RandomState(1)
+            reqs = [[rs.randn(1, 32).astype(np.float32)]
+                    for _ in range(12)]
+            res = cli.infer_many(reqs, depth=6)
+            for req, out in zip(reqs, res):
+                ref.set_input(ref.input_name(0), req[0])
+                ref.run()
+                np.testing.assert_allclose(out[0], ref.output(0),
+                                           rtol=1e-5, atol=1e-6)
+            st = srv.stats()
+            assert st["server"]["replies"] == 12
+            # pipelining + batching: far fewer runs than requests
+            assert st["batcher"]["batches"] < 12
+            cli.close()
+        ref.close()
+
+    def test_validation_errors_and_bad_authkey(self, mlp_artifact):
+        from paddle_tpu.inference import create_server
+        from paddle_tpu.inference.serving import (InferenceClient,
+                                                  ServingError)
+
+        with create_server(mlp_artifact, max_batch=4,
+                           instances=1) as srv:
+            cli = srv.client()
+            with pytest.raises(ServingError, match="non-batch dims"):
+                cli.infer(np.zeros((1, 33), np.float32))
+            with pytest.raises(ServingError, match="dtype"):
+                cli.infer(np.zeros((1, 32), np.int64))
+            with pytest.raises(ServingError, match="max_batch"):
+                cli.infer(np.zeros((9, 32), np.float32))
+            # the connection survives request-level errors
+            out = cli.infer(np.zeros((1, 32), np.float32))
+            assert out[0].shape == (1, 8)
+            # a pipelined batch with one bad request must not desync:
+            # every good reply still lands in its slot, the error
+            # surfaces per-entry (or re-raises after draining)
+            reqs = [[np.ones((1, 32), np.float32)],
+                    [np.ones((1, 33), np.float32)],   # bad dims
+                    [np.ones((1, 32), np.float32)]]
+            res = cli.infer_many(reqs, depth=3, return_exceptions=True)
+            assert res[0][0].shape == (1, 8)
+            assert isinstance(res[1], ServingError)
+            assert res[2][0].shape == (1, 8)
+            with pytest.raises(ServingError, match="non-batch dims"):
+                cli.infer_many(reqs, depth=3)
+            # ...and the stream is STILL in sync afterwards
+            out = cli.infer(np.zeros((1, 32), np.float32))
+            assert out[0].shape == (1, 8)
+            st = srv.stats()
+            assert st["server"]["req_errors"] == 5
+            assert st["server"]["replies"] == 6
+            cli.close()
+            with pytest.raises(ConnectionError):
+                InferenceClient(srv.port, b"wrong-key")
+
+
+class TestParallelInstances:
+    """Tentpole contract: N concurrent predictor instances actually
+    scale (private sub-pools) with outputs identical under
+    contention."""
+
+    def test_two_instances_parity_under_contention(self, built,
+                                                   tmp_path):
+        import paddle_tpu as pt
+        from paddle_tpu.core.native import NativePredictor
+        from paddle_tpu.onnx.converter import trace_to_onnx
+
+        pt.seed(0)
+        paths, xs, wants = [], [], []
+        for i, width in enumerate((48, 80)):
+            net = pt.nn.Sequential(pt.nn.Linear(32, width), pt.nn.ReLU(),
+                                   pt.nn.Linear(width, 8))
+            net.eval()
+            x = np.random.RandomState(20 + i).randn(16, 32).astype(
+                np.float32)
+            path = str(tmp_path / f"m{i}.onnx")
+            with open(path, "wb") as f:
+                f.write(trace_to_onnx(lambda a, n=net: n(a),
+                                      (jnp.asarray(x),)))
+            p = NativePredictor(path)
+            p.set_input(p.input_name(0), x)
+            p.run()
+            wants.append(p.output(0))
+            p.close()
+            paths.append(path)
+            xs.append(x)
+
+        failures = []
+
+        def serve(i):
+            try:
+                with NativePredictor(paths[i], threads=2) as p:
+                    name = p.input_name(0)
+                    for _ in range(50):
+                        p.set_input(name, xs[i])
+                        p.run()
+                        np.testing.assert_array_equal(p.output(0),
+                                                      wants[i])
+            except Exception as e:  # noqa: BLE001
+                failures.append((i, e))
+
+        ts = [threading.Thread(target=serve, args=(i,))
+              for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not failures, failures
+
+    def test_two_instance_aggregate_speedup(self, built, tmp_path):
+        """>= 1.3x aggregate throughput: two instances on two threads
+        with single-thread private pools vs the same work serialized.
+        (The C selftest asserts the same bound on the raw ABI; this is
+        the ctypes/NativePredictor face.)"""
+        import paddle_tpu as pt
+        from paddle_tpu.core.native import NativePredictor
+        from paddle_tpu.onnx.converter import trace_to_onnx
+
+        pt.seed(0)
+        net = pt.nn.Sequential(pt.nn.Linear(256, 256), pt.nn.ReLU(),
+                               pt.nn.Linear(256, 256))
+        net.eval()
+        x = np.random.RandomState(0).randn(64, 256).astype(np.float32)
+        path = str(tmp_path / "wide.onnx")
+        with open(path, "wb") as f:
+            f.write(trace_to_onnx(lambda a: net(a), (jnp.asarray(x),)))
+
+        ps = [NativePredictor(path, threads=1) for _ in range(2)]
+        name = ps[0].input_name(0)
+
+        def loop(p, iters=20):
+            for _ in range(iters):
+                p.set_input(name, x)
+                p.run()
+
+        for p in ps:
+            loop(p, 3)  # warm
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for p in ps:
+                loop(p)
+            serial = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            ts = [threading.Thread(target=loop, args=(p,)) for p in ps]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            conc = time.perf_counter() - t0
+            best = max(best, serial / conc)
+        for p in ps:
+            p.close()
+        assert best >= 1.3, f"aggregate speedup {best:.2f}x < 1.3x"
+
+
+class TestDynamicShapeFallback:
+    def test_counter_in_stats_json(self, built, tmp_path):
+        """Satellite: runs that miss the planned-arena path are
+        observable from ptpu_predictor_stats_json."""
+        import paddle_tpu as pt
+        from paddle_tpu.core.native import NativePredictor
+        from paddle_tpu.onnx.converter import trace_to_onnx
+
+        pt.seed(0)
+        net = pt.nn.Sequential(pt.nn.Linear(8, 4))
+        net.eval()
+        x4 = np.zeros((4, 8), np.float32)
+        path = str(tmp_path / "m.onnx")
+        with open(path, "wb") as f:
+            f.write(trace_to_onnx(lambda a: net(a), (jnp.asarray(x4),)))
+        with NativePredictor(path) as p:
+            name = p.input_name(0)
+            p.set_input(name, x4)
+            p.run()                       # planned shape: no fallback
+            assert p.stats()["dynamic_shape_fallback"] == 0
+            assert p.dynamic_fallbacks == 0
+            p.set_input(name, np.zeros((2, 8), np.float32))
+            p.run()                       # off-plan batch: fallback
+            p.set_input(name, x4)
+            p.run()
+            st = p.stats()
+            assert st["dynamic_shape_fallback"] == 1
+            assert p.dynamic_fallbacks == 1
+            p.stats_reset()
+            assert p.stats()["dynamic_shape_fallback"] == 0

@@ -1,0 +1,93 @@
+"""serving_bench `--out` persistence contract (ISSUE r8 satellite;
+pattern of tests/test_ps_bench_persist.py).
+
+Runs `tools/serving_bench.py` as a subprocess with a shrunken 2-client
+config, asserts the persisted JSON schema, and asserts the
+server-vs-client counter exactness rows (requests == replies ==
+client-observed ops in EVERY phase). The 3x throughput acceptance is
+NOT asserted here — a 2-client smoke config cannot fill batches the
+way the committed BENCH_SERVE run does.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "tools", "serving_bench.py")
+
+
+@pytest.fixture(scope="module")
+def bench_out(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("svb") / "BENCH_SERVE.json")
+    env = dict(os.environ)
+    env.update({
+        "PTPU_SRVBENCH_CLIENTS": "2", "PTPU_SRVBENCH_OPS": "25",
+        "PTPU_SRVBENCH_MAX_BATCH": "4",
+        "PTPU_SRVBENCH_DEADLINE_US": "1500",
+        "PTPU_SRVBENCH_INSTANCES": "2",
+        "PTPU_SRVBENCH_SKIP_BUILD": "1",
+        "JAX_PLATFORMS": "cpu",
+        # the bench's -march=native rebuild is a benchmarking opt-in;
+        # keep the smoke test on the portable build the suite uses
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, BENCH, "--out", out], cwd=REPO,
+                       env=env, capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, \
+        f"rc={r.returncode}\nstdout:{r.stdout[-2000:]}\n" \
+        f"stderr:{r.stderr[-2000:]}"
+    with open(out) as f:
+        return json.load(f)
+
+
+class TestServingBenchPersist:
+    def test_schema(self, bench_out):
+        assert bench_out["bench"] == "serving_bench"
+        for key in ("clients", "ops", "max_batch", "deadline_us",
+                    "instances"):
+            assert isinstance(bench_out[key], int)
+        rows = bench_out["measurements"]
+        assert rows, "no measurements persisted"
+        for row in rows:
+            assert {"metric", "value", "unit"} <= set(row)
+
+    def test_throughput_rows_present_and_positive(self, bench_out):
+        by = {r["metric"]: r for r in bench_out["measurements"]}
+        for m in ("serve_seq_batch1_ops_per_s",
+                  "serve_concurrent_nobatch_ops_per_s",
+                  "serve_concurrent_batched_ops_per_s"):
+            assert m in by, f"missing {m}"
+            assert by[m]["value"] > 0
+            assert by[m]["unit"] == "ops/s"
+        assert by["serve_batched_over_seq_ratio"]["value"] > 0
+        batched = by["serve_concurrent_batched_ops_per_s"]
+        assert batched["mean_batch_fill"] >= 1.0
+        assert batched["buckets"][0] == 1
+
+    def test_counters_exact_every_phase(self, bench_out):
+        """Acceptance discipline: server-side wire/batch counters equal
+        client-observed request counts EXACTLY."""
+        by = {r["metric"]: r for r in bench_out["measurements"]}
+        row = by["serve_stats_consistency"]
+        assert row["value"] == 1, row
+        assert len(row["phases"]) == 3
+        for phase in row["phases"]:
+            assert phase["exact"] is True, phase
+            assert phase["requests"] == phase["expected"]
+            assert phase["replies"] == phase["expected"]
+            assert phase["batched_requests"] == phase["expected"]
+            assert phase["req_errors"] == 0
+            assert phase["dynamic_shape_fallback"] == 0
+
+    def test_stats_phases_embedded(self, bench_out):
+        phases = bench_out["server_stats_phases"]
+        assert set(phases) == {"seq_batch1", "concurrent_nobatch",
+                               "concurrent_batched"}
+        for st in phases.values():
+            assert "server" in st and "batcher" in st
+            assert st["batcher"]["batch_fill"]["count"] > 0

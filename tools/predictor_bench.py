@@ -157,8 +157,23 @@ def bench_int8(tmp):
     emit({"metric": "mlp_native_fp32_ms",
           "value": round(dt_f * 1e3, 2), "unit": "ms"})
     emit({"metric": "mlp_native_int8_ms",
-          "value": round(dt_q * 1e3, 2), "unit": "ms",
-          "int8_over_fp32": round(dt_q / dt_f, 2)})
+          "value": round(dt_q * 1e3, 2), "unit": "ms"})
+    # FIRST-CLASS ratio metric with a regression gate (ISSUE r8
+    # satellite): r06 shipped the int8 MLP at 3.24x SLOWER than fp32
+    # because the activation quantize/dequantize chains ran as ~11
+    # unfused memory-bound passes per layer; the load-time
+    # PtpuQuantize/PtpuDequant fusion (csrc/ptpu_predictor.cc
+    # fuse_quant_ops) + specialized elementwise loops brought it to
+    # ~1.6-1.8x on this machine. int8 still trails fp32 — the int32
+    # AVX2 kernel is no faster than FMA and the quant traffic is extra
+    # work — so the gate holds the REGRESSION line (< 2.5x), not a
+    # speedup claim. If this trips, profile the Ptpu* quant ops first.
+    ratio = round(dt_q / dt_f, 2)
+    emit({"metric": "mlp_int8_over_fp32_ratio", "value": ratio,
+          "unit": "x", "regression_gate": 2.5,
+          "within_gate": bool(ratio <= 2.5),
+          "note": "r06 regression was 3.24x; fixed by load-time "
+                  "quant-chain fusion (PtpuQuantize/PtpuDequant)"})
 
 
 def bench_bert_tiny(tmp):
