@@ -264,17 +264,27 @@ Tensor parse_tensor(Reader r) {
     t.f.resize(size_t(n));
     if (raw.size() >= size_t(n) * 4) memcpy(t.f.data(), raw.data(), n * 4);
   } else if (t.dtype == DT_F64) {
+    // raw sits at an arbitrary protobuf offset: per-element memcpy
+    // (one unaligned mov) instead of a cast-deref, which is UB
     t.f.resize(size_t(n));
-    const double* d = (const double*)raw.data();
-    for (int64_t k = 0; k < n; ++k) t.f[size_t(k)] = float(d[k]);
+    if (raw.size() >= size_t(n) * 8)
+      for (int64_t k = 0; k < n; ++k) {
+        double dv;
+        memcpy(&dv, raw.data() + 8 * k, 8);
+        t.f[size_t(k)] = float(dv);
+      }
     t.dtype = DT_F32;
   } else if (t.dtype == DT_I64) {
     t.i.resize(size_t(n));
     if (raw.size() >= size_t(n) * 8) memcpy(t.i.data(), raw.data(), n * 8);
   } else if (t.dtype == DT_I32) {
     t.i.resize(size_t(n));
-    const int32_t* d = (const int32_t*)raw.data();
-    for (int64_t k = 0; k < n; ++k) t.i[size_t(k)] = d[k];
+    if (raw.size() >= size_t(n) * 4)
+      for (int64_t k = 0; k < n; ++k) {
+        int32_t iv;
+        memcpy(&iv, raw.data() + 4 * k, 4);
+        t.i[size_t(k)] = iv;
+      }
   } else if (t.dtype == DT_BOOL || t.dtype == DT_U8) {
     t.i.resize(size_t(n));
     const uint8_t* d = (const uint8_t*)raw.data();
@@ -298,9 +308,10 @@ Attr parse_attr(Reader r, std::string* name) {
     else if (field == 3) a.ival = int64_t(v);
     else if (field == 4) a.sval = sub.str();
     else if (field == 5) a.t = parse_tensor(sub);
-    else if (field == 7) {  // packed floats
-      const float* d = (const float*)sub.p;
-      a.floats.assign(d, d + (sub.end - sub.p) / 4);
+    else if (field == 7) {  // packed floats (arbitrary file offset)
+      a.floats.resize(size_t(sub.end - sub.p) / 4);
+      if (!a.floats.empty())
+        memcpy(a.floats.data(), sub.p, a.floats.size() * 4);
     } else if (field == 8) {
       if (wire == 2) a.ints = sub.packed_varints();
       else a.ints.push_back(int64_t(v));
@@ -3315,6 +3326,8 @@ static int set_input_int(void* h, const char* name, const T* data,
                          const int64_t* dims, int ndim, int dtype,
                          char* err, int err_len) {
   try {
+    if (!h || !name || !data)
+      throw std::runtime_error("set_input: null handle or buffer");
     check_dims(dims, ndim);
     auto* p = (Predictor*)h;
     Tensor t;
@@ -3418,23 +3431,27 @@ void* ptpu_workpool_create(int threads) {
 
 __attribute__((visibility("default")))
 void ptpu_workpool_destroy(void* pool) {
+  if (!pool) return;
   delete (WorkPool*)pool;
 }
 
 __attribute__((visibility("default")))
 void ptpu_predictor_set_pool(PTPU_Predictor* h, void* pool) {
   auto* p = (Predictor*)h;
+  if (!p) return;
   p->pool_ = (WorkPool*)pool;
   if (p->owned_pool_.get() != p->pool_) p->owned_pool_.reset();
 }
 
 __attribute__((visibility("default")))
 void ptpu_predictor_destroy(PTPU_Predictor* h) {
+  if (!h) return;
   delete (Predictor*)h;
 }
 
 __attribute__((visibility("default")))
 int ptpu_predictor_num_inputs(PTPU_Predictor* h) {
+  if (!h) return 0;
   return int(((Predictor*)h)->g.input_names.size());
 }
 
@@ -3444,28 +3461,33 @@ int ptpu_predictor_num_inputs(PTPU_Predictor* h) {
 // back to per-tensor allocation)
 __attribute__((visibility("default")))
 int ptpu_predictor_num_nodes(PTPU_Predictor* h) {
+  if (!h) return 0;
   return int(((Predictor*)h)->g.nodes.size());
 }
 
 __attribute__((visibility("default")))
 int ptpu_predictor_fused_nodes(PTPU_Predictor* h) {
+  if (!h) return 0;
   return ((Predictor*)h)->fused_nodes_;
 }
 
 __attribute__((visibility("default")))
 int64_t ptpu_predictor_arena_bytes(PTPU_Predictor* h) {
   auto* p = (Predictor*)h;
+  if (!p) return 0;
   return p->planned_ ? int64_t(p->arena_bytes_) : 0;
 }
 
 __attribute__((visibility("default")))
 int ptpu_predictor_num_outputs(PTPU_Predictor* h) {
+  if (!h) return 0;
   return int(((Predictor*)h)->g.output_names.size());
 }
 
 __attribute__((visibility("default")))
 const char* ptpu_predictor_input_name(PTPU_Predictor* h, int i) {
   auto* p = (Predictor*)h;
+  if (!p) return "";
   if (i < 0 || size_t(i) >= p->g.input_names.size()) return "";
   return p->g.input_names[size_t(i)].c_str();
 }
@@ -3477,6 +3499,7 @@ const char* ptpu_predictor_input_name(PTPU_Predictor* h, int i) {
 __attribute__((visibility("default")))
 int ptpu_predictor_input_ndim(PTPU_Predictor* h, int i) {
   auto* p = (Predictor*)h;
+  if (!p) return -1;
   if (i < 0 || size_t(i) >= p->g.input_names.size()) return -1;
   auto it = p->g.input_dims.find(p->g.input_names[size_t(i)]);
   return it == p->g.input_dims.end() ? -1 : int(it->second.size());
@@ -3485,6 +3508,7 @@ int ptpu_predictor_input_ndim(PTPU_Predictor* h, int i) {
 __attribute__((visibility("default")))
 const int64_t* ptpu_predictor_input_dims(PTPU_Predictor* h, int i) {
   auto* p = (Predictor*)h;
+  if (!p) return nullptr;
   if (i < 0 || size_t(i) >= p->g.input_names.size()) return nullptr;
   auto it = p->g.input_dims.find(p->g.input_names[size_t(i)]);
   return it == p->g.input_dims.end() ? nullptr : it->second.data();
@@ -3493,6 +3517,7 @@ const int64_t* ptpu_predictor_input_dims(PTPU_Predictor* h, int i) {
 __attribute__((visibility("default")))
 int ptpu_predictor_input_dtype(PTPU_Predictor* h, int i) {
   auto* p = (Predictor*)h;
+  if (!p) return -1;
   if (i < 0 || size_t(i) >= p->g.input_names.size()) return -1;
   auto it = p->g.input_dtypes.find(p->g.input_names[size_t(i)]);
   return it == p->g.input_dtypes.end() ? DT_F32 : it->second;
@@ -3501,6 +3526,7 @@ int ptpu_predictor_input_dtype(PTPU_Predictor* h, int i) {
 // runs that missed the planned-arena path since load/reset
 __attribute__((visibility("default")))
 int64_t ptpu_predictor_dynamic_fallbacks(PTPU_Predictor* h) {
+  if (!h) return 0;
   return int64_t(((Predictor*)h)->dyn_fallback_runs_.load(
       std::memory_order_relaxed));
 }
@@ -3510,6 +3536,8 @@ int ptpu_predictor_set_input(PTPU_Predictor* h, const char* name,
                              const float* data, const int64_t* dims,
                              int ndim, char* err, int err_len) {
   try {
+    if (!h || !name || !data)
+      throw std::runtime_error("set_input: null handle or buffer");
     check_dims(dims, ndim);
     auto* p = (Predictor*)h;
     Tensor t;
@@ -3541,6 +3569,7 @@ int ptpu_predictor_set_input_i64(PTPU_Predictor* h, const char* name,
 __attribute__((visibility("default")))
 int ptpu_predictor_run(PTPU_Predictor* h, char* err, int err_len) {
   try {
+    if (!h) throw std::runtime_error("run: null predictor handle");
     ((Predictor*)h)->run();
     return 0;
   } catch (const std::exception& e) {
@@ -3552,6 +3581,7 @@ int ptpu_predictor_run(PTPU_Predictor* h, char* err, int err_len) {
 __attribute__((visibility("default")))
 int ptpu_predictor_output_ndim(PTPU_Predictor* h, int i) {
   auto* p = (Predictor*)h;
+  if (!p) return -1;
   if (i < 0 || size_t(i) >= p->outputs.size()) return -1;
   return int(p->outputs[size_t(i)].dims.size());
 }
@@ -3559,6 +3589,7 @@ int ptpu_predictor_output_ndim(PTPU_Predictor* h, int i) {
 __attribute__((visibility("default")))
 const int64_t* ptpu_predictor_output_dims(PTPU_Predictor* h, int i) {
   auto* p = (Predictor*)h;
+  if (!p) return nullptr;
   if (i < 0 || size_t(i) >= p->outputs.size()) return nullptr;
   return p->outputs[size_t(i)].dims.data();
 }
@@ -3572,6 +3603,7 @@ const int64_t* ptpu_predictor_output_dims(PTPU_Predictor* h, int i) {
 __attribute__((visibility("default")))
 const char* ptpu_predictor_stats_json(PTPU_Predictor* h) {
   auto* p = (Predictor*)h;
+  if (!p) return "{}";
   std::string out = "{";
   ptpu::AppendJsonU64(&out, "runs", p->runs_);
   out += ',';
@@ -3605,6 +3637,7 @@ const char* ptpu_predictor_stats_json(PTPU_Predictor* h) {
 
 __attribute__((visibility("default")))
 void ptpu_predictor_stats_reset(PTPU_Predictor* h) {
+  if (!h) return;
   ((Predictor*)h)->reset_stats();
 }
 
@@ -3626,6 +3659,7 @@ void ptpu_predictor_set_profiler(ProfRecordFn record_fn,
 __attribute__((visibility("default")))
 const float* ptpu_predictor_output_data(PTPU_Predictor* h, int i) {
   auto* p = (Predictor*)h;
+  if (!p) return nullptr;
   if (i < 0 || size_t(i) >= p->outputs.size()) return nullptr;
   Tensor& t = p->outputs[size_t(i)];
   if (!t.is_float() && t.f.size() != size_t(t.numel())) {

@@ -330,9 +330,12 @@ void test_server_pull_push_roundtrip() {
   send_client_frame(fd, req);
   auto rep = recv_client_frame(fd);
   assert(rep.size() == 10 + 2 * 2 * 4 && rep[1] == 0x51);
-  const auto *rows = reinterpret_cast<const float *>(rep.data() + 10);
-  assert(rows[0] == 6.f && rows[1] == 7.f);  // row 3
-  assert(rows[2] == 0.f && rows[3] == 1.f);  // row 0
+  // the f32 body starts at +10 (odd alignment): unaligned-safe reads
+  const auto row_at = [&](size_t k) {
+    return ptpu::GetF32(rep.data() + 10 + 4 * k);
+  };
+  assert(row_at(0) == 6.f && row_at(1) == 7.f);  // row 3
+  assert(row_at(2) == 0.f && row_at(3) == 1.f);  // row 0
 
   // PUSH_REQ: grad 1 to global id 103 twice (coalesced, lr=1)
   std::vector<uint8_t> push = {1, 0x52, 3, 'e', 'm', 'b',

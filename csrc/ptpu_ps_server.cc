@@ -142,16 +142,21 @@ struct PsServer {
 
   void Stop() {
     if (stop.exchange(true)) return;
-    if (listen_fd >= 0) {
-      ::shutdown(listen_fd, SHUT_RDWR);
-      ::close(listen_fd);
-      listen_fd = -1;
-    }
+    // shutdown() wakes the blocked accept() (EINVAL) but keeps the fd
+    // alive; closing or clearing listen_fd BEFORE the join would race
+    // the accept thread's concurrent read of it (TSan-caught in the
+    // serving twin of this loop) and invite fd-number reuse while
+    // accept() still holds the old value
+    if (listen_fd >= 0) ::shutdown(listen_fd, SHUT_RDWR);
     {
       std::lock_guard<std::mutex> g(mu);
       for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
     }
     if (accept_thread.joinable()) accept_thread.join();
+    if (listen_fd >= 0) {
+      ::close(listen_fd);
+      listen_fd = -1;
+    }
     std::vector<std::thread> ts;
     {
       std::lock_guard<std::mutex> g(mu);
@@ -258,8 +263,9 @@ struct PsServer {
             return;
           continue;
         }
-        const auto *ids =
-            reinterpret_cast<const int64_t *>(req.data() + off);
+        // ids sit at 7+tlen into the frame — any alignment; every
+        // read goes through the unaligned-safe GetI64
+        const uint8_t *ids_b = req.data() + off;
         const int64_t rows = ptpu_ps_table_rows(entry.table);
         const int64_t dim = ptpu_ps_table_dim(entry.table);
         const size_t row_b = size_t(dim) * 4;
@@ -270,27 +276,25 @@ struct PsServer {
         // cost more in per-segment kernel overhead than the one
         // 131KB gather memcpy saves.)
         if (rep.size() < 14 + body) rep.resize(14 + body);
+        ptpu::PutU32(rep.data(), uint32_t(10 + body));
         const uint32_t flen = uint32_t(10 + body);
-        rep[0] = uint8_t(flen);
-        rep[1] = uint8_t(flen >> 8);
-        rep[2] = uint8_t(flen >> 16);
-        rep[3] = uint8_t(flen >> 24);
         rep[4] = kWireVersion;
         rep[5] = kTagPullRep;
-        std::memcpy(rep.data() + 6, &cnt, 4);
-        const uint32_t d32 = uint32_t(dim);
-        std::memcpy(rep.data() + 10, &d32, 4);
-        float *w = ptpu_ps_table_data(entry.table);
-        auto *out = reinterpret_cast<float *>(rep.data() + 14);
+        ptpu::PutU32(rep.data() + 6, cnt);
+        ptpu::PutU32(rep.data() + 10, uint32_t(dim));
+        const float *w = ptpu_ps_table_data(entry.table);
+        // gather straight into the reply as BYTES: the f32 rows start
+        // at +14, which is not 4-aligned, so a float* view would be UB
+        uint8_t *out = rep.data() + 14;
         bool bad = false;
         ptpu_ps_table_rdlock(entry.table);
         for (uint32_t i = 0; i < cnt; ++i) {
-          const int64_t id = ids[i] - entry.lo;
+          const int64_t id = ptpu::GetI64(ids_b + 8 * i) - entry.lo;
           if (id < 0 || id >= rows) {
             bad = true;
             break;
           }
-          std::memcpy(out + size_t(i) * dim, w + id * dim, row_b);
+          std::memcpy(out + size_t(i) * row_b, w + id * dim, row_b);
         }
         ptpu_ps_table_rdunlock(entry.table);
         if (bad) {
@@ -343,15 +347,17 @@ struct PsServer {
             return;
           continue;
         }
-        const auto *ids =
-            reinterpret_cast<const int64_t *>(req.data() + off);
-        const auto *grads = reinterpret_cast<const float *>(
-            req.data() + off + 8ull * cnt);
+        // ids/grads sit at arbitrary offsets (table-name length shifts
+        // them): ids are read via the unaligned-safe GetI64; grads are
+        // handed to the table as a BYTE pointer — ptpu_ps_table_push
+        // reads each f32 with memcpy, so no aligned copy is needed
+        const uint8_t *ids_b = req.data() + off;
+        const uint8_t *grads_b = req.data() + off + 8ull * cnt;
         if (local.size() < cnt) local.resize(cnt);
         for (uint32_t i = 0; i < cnt; ++i)
-          local[i] = ids[i] - entry.lo;
-        if (ptpu_ps_table_push(entry.table, local.data(), cnt, grads) !=
-            0) {
+          local[i] = ptpu::GetI64(ids_b + 8 * i) - entry.lo;
+        if (ptpu_ps_table_push_raw(entry.table, local.data(), cnt,
+                                   grads_b) != 0) {
           if (!SendErr(fd, ptpu_ps_last_error())) return;
           continue;
         }
@@ -458,7 +464,8 @@ PTPU_PS_EXPORT void *ptpu_ps_server_start(int port, const char *authkey,
                                           int authkey_len,
                                           int loopback_only) {
   auto *s = new PsServer();
-  s->authkey.assign(authkey, size_t(authkey_len));
+  if (authkey && authkey_len > 0)
+    s->authkey.assign(authkey, size_t(authkey_len));
   s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (s->listen_fd < 0) {
     g_srv_error = "ptpu_ps_server_start: socket() failed";
@@ -491,8 +498,11 @@ PTPU_PS_EXPORT void *ptpu_ps_server_start(int port, const char *authkey,
   return s;
 }
 
+// Handle-taking entries guard NULL like the table ABI: defined error
+// returns beat segfaults when a binding races teardown.
 PTPU_PS_EXPORT int ptpu_ps_server_port(void *h) {
-  return static_cast<PsServer *>(h)->port;
+  auto *s = static_cast<PsServer *>(h);
+  return s ? s->port : -1;
 }
 
 // Expose `table` (a ptpu_ps_table handle) as `name` with global-id
@@ -501,6 +511,10 @@ PTPU_PS_EXPORT int ptpu_ps_server_port(void *h) {
 PTPU_PS_EXPORT int ptpu_ps_server_register(void *h, const char *name,
                                            void *table, int64_t lo) {
   auto *s = static_cast<PsServer *>(h);
+  if (!s || !name || !table) {
+    g_srv_error = "ptpu_ps_server_register: null handle or table";
+    return -1;
+  }
   std::lock_guard<std::mutex> g(s->mu);
   auto &ws = s->table_stats[name];
   if (!ws) ws.reset(new TableWireStats());
@@ -516,6 +530,7 @@ PTPU_PS_EXPORT int ptpu_ps_server_register(void *h, const char *name,
 PTPU_PS_EXPORT const char *ptpu_ps_server_stats_json(void *h) {
   thread_local std::string g_json;
   auto *s = static_cast<PsServer *>(h);
+  if (!s) return "{}";
   std::string out = "{\"server\":{";
   const ServerStats &st = s->stats;
   const struct { const char *name; const ptpu::Counter *c; } cs[] = {
@@ -573,6 +588,7 @@ PTPU_PS_EXPORT const char *ptpu_ps_server_stats_json(void *h) {
 // every registered table — one call zeroes the whole serving view.
 PTPU_PS_EXPORT void ptpu_ps_server_stats_reset(void *h) {
   auto *s = static_cast<PsServer *>(h);
+  if (!s) return;
   s->stats.Reset();
   std::lock_guard<std::mutex> g(s->mu);
   for (auto &kv : s->tables) {
@@ -583,6 +599,7 @@ PTPU_PS_EXPORT void ptpu_ps_server_stats_reset(void *h) {
 
 PTPU_PS_EXPORT void ptpu_ps_server_stop(void *h) {
   auto *s = static_cast<PsServer *>(h);
+  if (!s) return;
   s->Stop();
   delete s;
 }

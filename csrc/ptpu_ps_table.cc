@@ -71,9 +71,12 @@ struct PsTable {
 // Coalesce duplicate ids: fills t->uniq (first-seen order) and t->acc
 // (per-unique accumulated grads, accumulation following the original
 // occurrence order — the same order np.add.at applies). Returns false
-// on an out-of-range id.
+// on an out-of-range id. `grads` is a BYTE pointer: the data-plane
+// server hands a view into the received frame, whose f32 block lands
+// at whatever offset the table-name length left it — each value is
+// read with a 4-byte memcpy (one unaligned mov, no copy, no UB).
 bool coalesce(PsTable *t, const int64_t *ids, int64_t n,
-              const float *grads) {
+              const unsigned char *grads) {
   const int64_t dim = t->dim;
   uint64_t cap = 16;
   while (cap < uint64_t(n) * 2) cap <<= 1;
@@ -109,8 +112,12 @@ bool coalesce(PsTable *t, const int64_t *ids, int64_t n,
       hpos = (hpos + 1) & mask;
     }
     float *a = t->acc.data() + int64_t(slot) * dim;
-    const float *g = grads + i * dim;
-    for (int64_t d = 0; d < dim; ++d) a[d] += g[d];
+    const unsigned char *g = grads + size_t(i) * size_t(dim) * 4;
+    for (int64_t d = 0; d < dim; ++d) {
+      float gv;
+      std::memcpy(&gv, g + 4 * d, 4);
+      a[d] += gv;
+    }
   }
   return true;
 }
@@ -227,25 +234,36 @@ PTPU_PS_EXPORT void ptpu_ps_table_destroy(void *h) {
   delete t;
 }
 
+// Every handle-taking entry guards against a NULL handle: the ABI is
+// consumed from ctypes/cgo where a teardown race or a failed create
+// can hand back a null — a defined error return beats a segfault.
 PTPU_PS_EXPORT float *ptpu_ps_table_data(void *h) {
-  return static_cast<PsTable *>(h)->w;
+  auto *t = static_cast<PsTable *>(h);
+  return t ? t->w : nullptr;
 }
 
 PTPU_PS_EXPORT int64_t ptpu_ps_table_rows(void *h) {
-  return static_cast<PsTable *>(h)->rows;
+  auto *t = static_cast<PsTable *>(h);
+  return t ? t->rows : 0;
 }
 
 PTPU_PS_EXPORT int64_t ptpu_ps_table_dim(void *h) {
-  return static_cast<PsTable *>(h)->dim;
+  auto *t = static_cast<PsTable *>(h);
+  return t ? t->dim : 0;
 }
 
 PTPU_PS_EXPORT uint64_t ptpu_ps_table_bytes(void *h) {
-  return static_cast<PsTable *>(h)->bytes;
+  auto *t = static_cast<PsTable *>(h);
+  return t ? t->bytes : 0;
 }
 
 PTPU_PS_EXPORT int ptpu_ps_table_pull(void *h, const int64_t *ids,
                                       int64_t n, float *out) {
   auto *t = static_cast<PsTable *>(h);
+  if (!t || !ids || !out) {
+    set_error("ptpu_ps_table_pull: null handle or buffer");
+    return -1;
+  }
   const int64_t dim = t->dim;
   std::shared_lock<std::shared_mutex> lock(t->mu);
   for (int64_t i = 0; i < n; ++i) {
@@ -262,12 +280,18 @@ PTPU_PS_EXPORT int ptpu_ps_table_pull(void *h, const int64_t *ids,
   return 0;
 }
 
-PTPU_PS_EXPORT int ptpu_ps_table_push(void *h, const int64_t *ids,
-                                      int64_t n, const float *grads) {
+PTPU_PS_EXPORT int ptpu_ps_table_push_raw(void *h, const int64_t *ids,
+                                          int64_t n,
+                                          const void *grads) {
   auto *t = static_cast<PsTable *>(h);
+  if (!t || !ids || !grads) {
+    set_error("ptpu_ps_table_push: null handle or buffer");
+    return -1;
+  }
   if (n <= 0) return 0;
   std::unique_lock<std::shared_mutex> lock(t->mu);
-  if (!coalesce(t, ids, n, grads)) return -1;
+  if (!coalesce(t, ids, n, static_cast<const unsigned char *>(grads)))
+    return -1;
   apply_update(t);
   t->push_ops.Add(1);
   t->push_rows.Add(uint64_t(n));
@@ -275,16 +299,26 @@ PTPU_PS_EXPORT int ptpu_ps_table_push(void *h, const int64_t *ids,
   return 0;
 }
 
+PTPU_PS_EXPORT int ptpu_ps_table_push(void *h, const int64_t *ids,
+                                      int64_t n, const float *grads) {
+  return ptpu_ps_table_push_raw(h, ids, n, grads);
+}
+
 PTPU_PS_EXPORT void ptpu_ps_table_rdlock(void *h) {
-  static_cast<PsTable *>(h)->mu.lock_shared();
+  auto *t = static_cast<PsTable *>(h);
+  if (!t) return;
+  t->mu.lock_shared();
 }
 
 PTPU_PS_EXPORT void ptpu_ps_table_rdunlock(void *h) {
-  static_cast<PsTable *>(h)->mu.unlock_shared();
+  auto *t = static_cast<PsTable *>(h);
+  if (!t) return;
+  t->mu.unlock_shared();
 }
 
 PTPU_PS_EXPORT void ptpu_ps_table_note_pull(void *h, int64_t nrows) {
   auto *t = static_cast<PsTable *>(h);
+  if (!t) return;
   t->pull_ops.Add(1);
   t->pull_rows.Add(uint64_t(nrows));
 }
@@ -294,6 +328,7 @@ PTPU_PS_EXPORT const char *ptpu_ps_table_stats_json(void *h) {
   // snapshotters never clobber each other's in-flight c_str
   thread_local std::string g_stats_json;
   auto *t = static_cast<PsTable *>(h);
+  if (!t) return "{}";
   std::string out = "{";
   ptpu::AppendJsonU64(&out, "pull_ops", t->pull_ops.Get());
   out += ',';
@@ -312,6 +347,7 @@ PTPU_PS_EXPORT const char *ptpu_ps_table_stats_json(void *h) {
 
 PTPU_PS_EXPORT void ptpu_ps_table_stats_reset(void *h) {
   auto *t = static_cast<PsTable *>(h);
+  if (!t) return;
   t->pull_ops.Reset();
   t->pull_rows.Reset();
   t->push_ops.Reset();

@@ -64,6 +64,14 @@ PTPU_PS_EXPORT int ptpu_ps_table_pull(void *h, const int64_t *ids,
 PTPU_PS_EXPORT int ptpu_ps_table_push(void *h, const int64_t *ids,
                                       int64_t n, const float *grads);
 
+// Same update, but `grads` is an UNALIGNED byte buffer of n*dim LE f32
+// values — the data-plane server passes a view straight into the
+// received frame (whose float block lands at whatever offset the
+// table-name length left it); values are read with per-element memcpy
+// so no aligned staging copy is ever made.
+PTPU_PS_EXPORT int ptpu_ps_table_push_raw(void *h, const int64_t *ids,
+                                          int64_t n, const void *grads);
+
 // Reader-lock bracket for callers that stream rows out WITHOUT a
 // gather copy (the data-plane server writev's row pointers straight
 // into the socket): rows are stable between rdlock and unlock;

@@ -32,6 +32,7 @@
 #include <vector>
 
 #include "ptpu_arena.h"
+#include "ptpu_sync.h"
 
 #if defined(_WIN32)
 #define PTPU_EXPORT extern "C" __declspec(dllexport)
@@ -143,25 +144,35 @@ class BestFitArena {
 
 }  // namespace
 
+// Handle-taking entries guard NULL: the ABI is driven from ctypes,
+// where a failed create or a teardown race can hand a null back — a
+// defined error return beats a segfault (tools/ptpu_check.py lints
+// every handle entry for this).
 PTPU_EXPORT void *ptpu_arena_create(uint64_t chunk_size, uint64_t alignment) {
   return new BestFitArena(chunk_size, alignment ? alignment : 64);
 }
 PTPU_EXPORT void ptpu_arena_destroy(void *a) {
+  if (!a) return;
   delete static_cast<BestFitArena *>(a);
 }
 PTPU_EXPORT void *ptpu_arena_alloc(void *a, uint64_t n) {
+  if (!a) return nullptr;
   return static_cast<BestFitArena *>(a)->Alloc(n);
 }
 PTPU_EXPORT int ptpu_arena_free(void *a, void *p) {
+  if (!a) return -1;
   return static_cast<BestFitArena *>(a)->Free(p) ? 0 : -1;
 }
 PTPU_EXPORT uint64_t ptpu_arena_in_use(void *a) {
+  if (!a) return 0;
   return static_cast<BestFitArena *>(a)->InUse();
 }
 PTPU_EXPORT uint64_t ptpu_arena_peak(void *a) {
+  if (!a) return 0;
   return static_cast<BestFitArena *>(a)->Peak();
 }
 PTPU_EXPORT uint64_t ptpu_arena_reserved(void *a) {
+  if (!a) return 0;
   return static_cast<BestFitArena *>(a)->Reserved();
 }
 
@@ -216,7 +227,7 @@ class BlockingQueue {
       cv_.wait(l, pred);
       return true;
     }
-    return cv_.wait_for(l, std::chrono::milliseconds(timeout_ms), pred);
+    return ptpu::CvWaitForUs(cv_, l, int64_t(timeout_ms) * 1000, pred);
   }
 
   std::mutex mu_;
@@ -232,18 +243,23 @@ PTPU_EXPORT void *ptpu_queue_create(uint64_t capacity) {
   return new BlockingQueue(capacity);
 }
 PTPU_EXPORT void ptpu_queue_destroy(void *q) {
+  if (!q) return;
   delete static_cast<BlockingQueue *>(q);
 }
 PTPU_EXPORT int ptpu_queue_push(void *q, int64_t v, int timeout_ms) {
+  if (!q) return -1;
   return static_cast<BlockingQueue *>(q)->Push(v, timeout_ms);
 }
 PTPU_EXPORT int ptpu_queue_pop(void *q, int64_t *out, int timeout_ms) {
+  if (!q || !out) return -1;
   return static_cast<BlockingQueue *>(q)->Pop(out, timeout_ms);
 }
 PTPU_EXPORT void ptpu_queue_close(void *q) {
+  if (!q) return;
   static_cast<BlockingQueue *>(q)->Close();
 }
 PTPU_EXPORT uint64_t ptpu_queue_size(void *q) {
+  if (!q) return 0;
   return static_cast<BlockingQueue *>(q)->Size();
 }
 

@@ -69,6 +69,7 @@
 #include "ptpu_hmac.h"
 #include "ptpu_inference_api.h"
 #include "ptpu_stats.h"
+#include "ptpu_sync.h"
 #include "ptpu_wire.h"
 
 namespace {
@@ -262,7 +263,7 @@ class SvBatcher {
       while (!stop_ && rows_queued_ < max_batch_) {
         const int64_t now = ptpu::NowUs();
         if (now >= deadline) break;
-        cv_.wait_for(l, std::chrono::microseconds(deadline - now));
+        ptpu::CvWaitForUs(cv_, l, deadline - now);
         if (q_.empty()) break;  // another instance drained it
       }
       if (q_.empty()) {
@@ -875,12 +876,16 @@ struct SvServer {
 
   void Stop() {
     if (stop.exchange(true)) return;
+    // shutdown() wakes the blocked accept() (EINVAL) but keeps the fd
+    // alive; closing or clearing listen_fd BEFORE the join would race
+    // the accept thread's concurrent read of it (TSan-caught) and
+    // invite fd-number reuse while accept() still holds the old value
+    if (listen_fd >= 0) ::shutdown(listen_fd, SHUT_RDWR);
+    if (accept_thread.joinable()) accept_thread.join();
     if (listen_fd >= 0) {
-      ::shutdown(listen_fd, SHUT_RDWR);
       ::close(listen_fd);
       listen_fd = -1;
     }
-    if (accept_thread.joinable()) accept_thread.join();
     // stop the batcher FIRST (in-flight batches reply over still-open
     // conns, leftover queued requests get explicit errors) but keep
     // the OBJECT alive until the conn reader threads are joined —
@@ -1019,31 +1024,41 @@ void* ptpu_serving_start(const char* model_path, int port,
   }
 }
 
+// Handle-taking entries guard NULL (a failed start returns NULL; a
+// binding must be able to pass that back without a segfault).
 __attribute__((visibility("default")))
 int ptpu_serving_port(void* h) {
-  return static_cast<SvServer*>(h)->port;
+  auto* s = static_cast<SvServer*>(h);
+  return s ? s->port : -1;
 }
 
 __attribute__((visibility("default")))
 const char* ptpu_serving_config_json(void* h) {
-  g_sv_json = static_cast<SvServer*>(h)->meta_json;
+  auto* s = static_cast<SvServer*>(h);
+  if (!s) return "{}";
+  g_sv_json = s->meta_json;
   return g_sv_json.c_str();
 }
 
 __attribute__((visibility("default")))
 const char* ptpu_serving_stats_json(void* h) {
-  g_sv_json = static_cast<SvServer*>(h)->StatsJson();
+  auto* s = static_cast<SvServer*>(h);
+  if (!s) return "{}";
+  g_sv_json = s->StatsJson();
   return g_sv_json.c_str();
 }
 
 __attribute__((visibility("default")))
 void ptpu_serving_stats_reset(void* h) {
-  static_cast<SvServer*>(h)->StatsReset();
+  auto* s = static_cast<SvServer*>(h);
+  if (!s) return;
+  s->StatsReset();
 }
 
 __attribute__((visibility("default")))
 void ptpu_serving_stop(void* h) {
   auto* s = static_cast<SvServer*>(h);
+  if (!s) return;
   s->Stop();
   delete s;
 }

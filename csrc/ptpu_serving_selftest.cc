@@ -115,6 +115,52 @@ std::string write_model_file(const std::string& bytes,
   return path;
 }
 
+// ----------------------------------------------- wire codec (UB-free)
+/* Byte-exact round-trip of every ptpu_wire.h field codec at EVERY
+ * misalignment 0..7: the codecs must (a) reproduce the value, (b) lay
+ * bytes down little-endian exactly as wire.py / serving.py struct
+ * packs do, and (c) stay UB-free on odd offsets — cast-deref versions
+ * of these helpers are what UBSan used to flag on real frames. */
+void test_wire_codec_round_trip() {
+  alignas(8) uint8_t buf[64];
+  const uint64_t u64v = 0x0123456789abcdefull;
+  const uint32_t u32v = 0xdeadbeefu;
+  const uint16_t u16v = 0xbeadu;
+  const int64_t i64v = -0x0123456789abcdll;
+  const float f32v = -1234.5678f;
+  for (size_t off = 0; off < 8; ++off) {
+    std::memset(buf, 0xa5, sizeof(buf));
+    ptpu::PutU64(buf + off, u64v);
+    assert(ptpu::GetU64(buf + off) == u64v);
+    // little-endian byte layout, exactly struct.pack('<Q', v)
+    for (int k = 0; k < 8; ++k)
+      assert(buf[off + size_t(k)] == uint8_t(u64v >> (8 * k)));
+    assert(buf[off + 8] == 0xa5);  // no overwrite past the field
+
+    ptpu::PutU32(buf + off, u32v);
+    assert(ptpu::GetU32(buf + off) == u32v);
+    for (int k = 0; k < 4; ++k)
+      assert(buf[off + size_t(k)] == uint8_t(u32v >> (8 * k)));
+
+    ptpu::PutU16(buf + off, u16v);
+    assert(ptpu::GetU16(buf + off) == u16v);
+    assert(buf[off] == 0xad && buf[off + 1] == 0xbe);
+
+    ptpu::PutI64(buf + off, i64v);
+    assert(ptpu::GetI64(buf + off) == i64v);
+
+    ptpu::PutF32(buf + off, f32v);
+    assert(ptpu::GetF32(buf + off) == f32v);  // bit-exact round trip
+    uint32_t bits;
+    std::memcpy(&bits, &f32v, 4);
+    for (int k = 0; k < 4; ++k)  // IEEE bits in LE order ('<f4')
+      assert(buf[off + size_t(k)] == uint8_t(bits >> (8 * k)));
+  }
+  // known-answer: GetU32 over a literal LE byte string
+  const uint8_t le[4] = {0x78, 0x56, 0x34, 0x12};
+  assert(ptpu::GetU32(le) == 0x12345678u);
+}
+
 // ---------------------------------------------------- batcher tests
 SvRequest make_req(uint64_t id, int64_t rows) {
   SvRequest r;
@@ -406,13 +452,16 @@ void test_serving_socket_round_trip() {
   int64_t odims[2];
   std::memcpy(odims, rep.data() + 13, 16);
   assert(odims[0] == 3 && odims[1] == N);
-  const float* y = reinterpret_cast<const float*>(rep.data() + 29);
+  // the f32 body starts at +29 (odd offset): unaligned-safe reads
+  const auto y_at = [&](int64_t k) {
+    return ptpu::GetF32(rep.data() + 29 + 4 * k);
+  };
   for (int64_t r = 0; r < 3; ++r)
     for (int64_t j = 0; j < N; ++j) {
       float acc = 0.f;
       for (int64_t k = 0; k < K; ++k)
         acc += x[size_t(r * K + k)] * W[size_t(k * N + j)];
-      assert(std::fabs(y[r * N + j] - acc) <=
+      assert(std::fabs(y_at(r * N + j) - acc) <=
              1e-4f * (1.f + std::fabs(acc)));
     }
 
@@ -524,6 +573,7 @@ void test_serving_pipelined_requests_batch() {
 }  // namespace
 
 int main() {
+  test_wire_codec_round_trip();
   test_batcher_deadline_flush();
   test_batcher_full_flush_and_partial_final();
   test_batcher_fifo_order_and_stats_exact();

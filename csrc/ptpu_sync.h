@@ -1,0 +1,66 @@
+// Condition-variable timed-wait helpers shared by the native TUs
+// (csrc/ptpu_serving.cc batcher, csrc/ptpu_runtime.cc blocking queue).
+//
+// Why this exists: libstdc++ (>= 9) lowers steady-clock
+// condition_variable::wait_for / wait_until to pthread_cond_clockwait,
+// which the libtsan shipped with gcc-10 does NOT intercept. An
+// unintercepted wait means TSan never sees the mutex being released
+// and reacquired inside the wait, its lockset goes inconsistent, and
+// it then reports phantom "double lock of a mutex" plus data races on
+// perfectly lock-protected state (reproduced in isolation on this
+// toolchain; both sides of the reported races hold the same mutex).
+//
+// Under TSan we therefore wait on the SYSTEM clock, which lowers to
+// the intercepted pthread_cond_timedwait. A wall-clock jump during the
+// wait can lengthen/shorten the timeout — harmless for a sanitizer
+// run, and every call site re-checks its predicate/deadline in a loop
+// anyway (the lint in tools/ptpu_check.py enforces that). Production
+// builds keep the steady clock.
+#ifndef PTPU_SYNC_H_
+#define PTPU_SYNC_H_
+
+#include <chrono>
+#include <condition_variable>
+#include <mutex>
+
+#if defined(__SANITIZE_THREAD__)
+#define PTPU_TSAN_BUILD 1
+#elif defined(__has_feature)
+#if __has_feature(thread_sanitizer)
+#define PTPU_TSAN_BUILD 1
+#endif
+#endif
+
+namespace ptpu {
+
+// Timed wait without predicate: the caller MUST loop on its own
+// predicate/deadline around this (condvar waits wake spuriously).
+inline void CvWaitForUs(std::condition_variable &cv,
+                        std::unique_lock<std::mutex> &l, int64_t usec) {
+#if defined(PTPU_TSAN_BUILD)
+  cv.wait_until(l, std::chrono::system_clock::now() +
+                       std::chrono::microseconds(usec));
+#else
+  cv.wait_for(l, std::chrono::microseconds(usec));
+#endif
+}
+
+// Timed wait with predicate; returns the predicate's final value
+// (false == timed out with the predicate still unsatisfied).
+template <class Pred>
+inline bool CvWaitForUs(std::condition_variable &cv,
+                        std::unique_lock<std::mutex> &l, int64_t usec,
+                        Pred pred) {
+#if defined(PTPU_TSAN_BUILD)
+  return cv.wait_until(l,
+                       std::chrono::system_clock::now() +
+                           std::chrono::microseconds(usec),
+                       pred);
+#else
+  return cv.wait_for(l, std::chrono::microseconds(usec), pred);
+#endif
+}
+
+}  // namespace ptpu
+
+#endif  // PTPU_SYNC_H_

@@ -11,6 +11,7 @@
 #include <unistd.h>
 
 #include <cstdint>
+#include <cstring>
 #include <random>
 #include <string>
 
@@ -40,6 +41,15 @@ inline bool WriteExact(int fd, const void *p, size_t n) {
   return true;
 }
 
+/* Unaligned-safe little-endian field codec. Wire frames pack fields at
+ * arbitrary byte offsets (a table-name or dim count shifts everything
+ * after it), so a cast-deref like *(const uint32_t*)p is undefined
+ * behavior the moment the offset is not a multiple of the type's
+ * alignment — UBSan's -fsanitize=alignment flags it on real frames.
+ * Every multi-byte field therefore goes through these helpers: the
+ * byte-wise forms are explicit LE, the memcpy forms compile to a
+ * single unaligned mov on x86/arm64 (no cost) and are well-defined on
+ * any alignment. Use these — never cast-deref into a frame buffer. */
 inline void PutU32(uint8_t *p, uint32_t v) {
   p[0] = uint8_t(v);
   p[1] = uint8_t(v >> 8);
@@ -50,6 +60,44 @@ inline void PutU32(uint8_t *p, uint32_t v) {
 inline uint32_t GetU32(const uint8_t *p) {
   return uint32_t(p[0]) | uint32_t(p[1]) << 8 | uint32_t(p[2]) << 16 |
          uint32_t(p[3]) << 24;
+}
+
+inline void PutU64(uint8_t *p, uint64_t v) {
+  PutU32(p, uint32_t(v));
+  PutU32(p + 4, uint32_t(v >> 32));
+}
+
+inline uint64_t GetU64(const uint8_t *p) {
+  return uint64_t(GetU32(p)) | uint64_t(GetU32(p + 4)) << 32;
+}
+
+inline void PutU16(uint8_t *p, uint16_t v) {
+  p[0] = uint8_t(v);
+  p[1] = uint8_t(v >> 8);
+}
+
+inline uint16_t GetU16(const uint8_t *p) {
+  return uint16_t(uint16_t(p[0]) | uint16_t(p[1]) << 8);
+}
+
+inline void PutI64(uint8_t *p, int64_t v) { PutU64(p, uint64_t(v)); }
+
+inline int64_t GetI64(const uint8_t *p) { return int64_t(GetU64(p)); }
+
+/* f32/f64 fields are IEEE-754 bit patterns in LE byte order (numpy
+ * '<f4'/'<f8'); memcpy through the same-width integer keeps the value
+ * bit-exact without ever forming a misaligned float reference. */
+inline void PutF32(uint8_t *p, float v) {
+  uint32_t bits;
+  std::memcpy(&bits, &v, 4);
+  PutU32(p, bits);
+}
+
+inline float GetF32(const uint8_t *p) {
+  const uint32_t bits = GetU32(p);
+  float v;
+  std::memcpy(&v, &bits, 4);
+  return v;
 }
 
 /* Server side of the connect handshake: send a 16-byte random nonce,

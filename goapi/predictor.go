@@ -37,6 +37,11 @@ import (
 // Every method ends with runtime.KeepAlive(p): without it the GC may
 // collect p (running the finalizer's destroy) while C code is still
 // executing on the native predictor — a use-after-free.
+//
+// Handle discipline: the C ABI itself guards NULL handles (enforced by
+// tools/ptpu_check.py's nullcheck lint), and the wrappers below
+// additionally fail fast on a Destroyed predictor so Go callers get an
+// error/zero value instead of the C side's defensive defaults.
 
 // Predictor wraps one PTPU_Predictor. Not safe for concurrent use;
 // create one per goroutine (the C API is thread-compatible, not
@@ -117,6 +122,9 @@ func (p *Predictor) SetPool(w *WorkPool) {
 // InputSignature returns input i's dims (reflecting a batch
 // override) and ONNX dtype code (1 f32, 6 i32, 7 i64).
 func (p *Predictor) InputSignature(i int) ([]int64, int) {
+	if p.p == nil {
+		return nil, -1
+	}
 	nd := int(C.ptpu_predictor_input_ndim(p.p, C.int(i)))
 	var dims []int64
 	if nd > 0 {
@@ -234,6 +242,9 @@ func (p *Predictor) SetInputInt64(name string, data []int64,
 
 // Run executes the graph.
 func (p *Predictor) Run() error {
+	if p.p == nil {
+		return errors.New("Run: predictor is destroyed")
+	}
 	buf := make([]C.char, errLen)
 	rc := C.ptpu_predictor_run(p.p, &buf[0], errLen)
 	runtime.KeepAlive(p)
@@ -244,10 +255,22 @@ func (p *Predictor) Run() error {
 }
 
 // Output returns output i of the last Run as (data, dims). The slices
-// are COPIES — valid after the next Run, unlike the C pointers.
+// are COPIES — valid after the next Run, unlike the C pointers. A
+// destroyed predictor (or an out-of-range i) yields nil, nil — the C
+// side answers ndim -1 / nil pointers, which must not reach make().
 func (p *Predictor) Output(i int) ([]float32, []int64) {
+	if p.p == nil {
+		return nil, nil
+	}
 	nd := int(C.ptpu_predictor_output_ndim(p.p, C.int(i)))
 	cdims := C.ptpu_predictor_output_dims(p.p, C.int(i))
+	// nd == 0 is a valid rank-0 scalar (cdims may legitimately be nil
+	// for an empty dims vector); only a negative ndim or a missing
+	// dims pointer for nd > 0 signals an invalid handle/index
+	if nd < 0 || (nd > 0 && cdims == nil) {
+		runtime.KeepAlive(p)
+		return nil, nil
+	}
 	dims := make([]int64, nd)
 	n := int64(1)
 	cd := unsafe.Slice((*int64)(unsafe.Pointer(cdims)), nd)

@@ -850,6 +850,7 @@ ABI_SYMBOLS = {
         "ptpu_ps_table_data", "ptpu_ps_table_rows",
         "ptpu_ps_table_dim", "ptpu_ps_table_bytes",
         "ptpu_ps_table_pull", "ptpu_ps_table_push",
+        "ptpu_ps_table_push_raw",
         "ptpu_ps_table_rdlock", "ptpu_ps_table_rdunlock",
         "ptpu_ps_table_stats_json", "ptpu_ps_table_stats_reset",
         "ptpu_ps_table_note_pull",

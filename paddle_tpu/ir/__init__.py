@@ -113,7 +113,15 @@ class Program:
         NEW Program (reference: `ir/pass.h` Pass::Apply). A pass may
         return either the new eqn list or an (eqns, outvars) pair —
         rewrites that replace a program OUTPUT (e.g. dropout as the
-        last op) need to retarget outvars as well."""
+        last op) need to retarget outvars as well.
+
+        When IR verification is on (PTPU_IR_VERIFY=1 or
+        `ir.verify.set_verify(True)`; tier-1 runs with it on), the
+        result is checked against the jaxpr well-formedness invariants
+        (defs-before-uses, SSA, no dangling outvars, fused-op arity)
+        IMMEDIATELY — a buggy pass fails here with the pass named,
+        instead of miscompiling at the next trace."""
+        from . import verify as _verify
         fn = PassRegistry.get(name_or_fn) if isinstance(name_or_fn, str) \
             else name_or_fn
         jaxpr = self.closed.jaxpr
@@ -124,7 +132,10 @@ class Program:
                                       outvars=list(new_outvars))
         else:
             new_jaxpr = jaxpr.replace(eqns=res)
-        return Program(self.closed.replace(jaxpr=new_jaxpr))
+        out = Program(self.closed.replace(jaxpr=new_jaxpr))
+        pass_name = name_or_fn if isinstance(name_or_fn, str) else \
+            getattr(fn, "__name__", repr(fn))
+        return _verify.maybe_verify(out, pass_name=pass_name)
 
     # -- execution / export ----------------------------------------------
 

@@ -12,6 +12,12 @@ here before first backend use routes everything to CPU.
 """
 import os
 
+# Tier-1 runs every registered IR pass under the jaxpr well-formedness
+# verifier (paddle_tpu/ir/verify.py): a pass that breaks
+# defs-before-uses / SSA / outvar wiring fails AT the pass, loudly,
+# instead of miscompiling later. Off by default in production.
+os.environ.setdefault("PTPU_IR_VERIFY", "1")
+
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                            " --xla_force_host_platform_device_count=8")

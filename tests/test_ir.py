@@ -116,6 +116,86 @@ class TestProgram:
         ref = np.asarray(net.fc(jnp.asarray(x)))
         np.testing.assert_allclose(a, ref, rtol=1e-5)
 
+    def test_verifier_runs_after_passes_in_tier1(self, monkeypatch):
+        """conftest turns PTPU_IR_VERIFY on for the whole suite; a
+        well-formed program must sail through every registered
+        data-plane pass with the verifier active."""
+        from paddle_tpu.ir import verify
+        # pin the tier-1 contract even if a runner overrode the env
+        monkeypatch.setenv("PTPU_IR_VERIFY", "1")
+        assert verify.enabled()
+        p = Program.capture(_fn, jnp.ones((4,)))
+        for name in ("dead_code_elimination", "dropout_removal"):
+            p.apply_pass(name)    # would raise IRVerificationError
+
+    def test_verifier_rejects_defs_before_uses_violation(self):
+        """A hand-broken graph — the producing eqn deleted, its
+        consumer kept — must be rejected AT the pass, by name."""
+        from paddle_tpu.ir import verify
+
+        def drop_first_eqn(eqns, jaxpr):
+            return eqns[1:]
+
+        p = Program.capture(lambda x: (x + 1.0) * 2.0, jnp.ones((3,)))
+        with pytest.raises(verify.IRVerificationError,
+                           match="drop_first_eqn.*defs-before-uses"):
+            p.apply_pass(drop_first_eqn)
+
+    def test_verifier_rejects_dangling_outvar(self):
+        from paddle_tpu.ir import verify
+
+        def orphan_output(eqns, jaxpr):
+            # keep the eqns but point the program output at the var the
+            # LAST eqn used to define after deleting that eqn — the
+            # dropout_removal outvar-retarget bug shape
+            return eqns[:-1], list(jaxpr.outvars)
+
+        p = Program.capture(lambda x: (x + 1.0) * 2.0, jnp.ones((3,)))
+        with pytest.raises(verify.IRVerificationError,
+                           match="dangling"):
+            p.apply_pass(orphan_output)
+
+    def test_verifier_rejects_broken_fused_op_arity(self):
+        """pjit eqns are the jaxpr spelling of a fused subgraph; a pass
+        that drops an operand without rewriting the inner jaxpr must be
+        caught by the arity check."""
+        from paddle_tpu.ir import verify
+
+        def f(x, y):
+            return jax.jit(lambda a, b: a * b + 1.0)(x, y)
+
+        p = Program.capture(f, jnp.ones((2,)), jnp.ones((2,)))
+        pjit_eqns = [e for e in p.closed.jaxpr.eqns
+                     if e.primitive.name == "pjit"]
+        assert pjit_eqns, "expected a pjit eqn in the traced program"
+
+        def drop_pjit_operand(eqns, jaxpr):
+            out = []
+            for e in eqns:
+                if e.primitive.name == "pjit":
+                    e = e.replace(invars=list(e.invars)[:-1])
+                out.append(e)
+            return out
+
+        with pytest.raises(verify.IRVerificationError,
+                           match="arity"):
+            p.apply_pass(drop_pjit_operand)
+
+    def test_verifier_flag_gates_the_check(self):
+        """With verification forced off, the same broken pass goes
+        through un-checked (the production default)."""
+        from paddle_tpu.ir import verify
+
+        def drop_first_eqn(eqns, jaxpr):
+            return eqns[1:]
+
+        p = Program.capture(lambda x: (x + 1.0) * 2.0, jnp.ones((3,)))
+        verify.set_verify(False)
+        try:
+            p.apply_pass(drop_first_eqn)   # no verification, no raise
+        finally:
+            verify.set_verify(None)        # back to the env default
+
     def test_custom_pass_and_registry(self):
         @PassRegistry.register("drop_all_sin")
         def drop_sin(eqns, jaxpr):

@@ -1,0 +1,286 @@
+"""tools/ptpu_check.py — the repo-specific static-analysis gate.
+
+Two-sided coverage, per checker:
+  * the LIVE tree reports 0 findings (the suite is a standing gate —
+    any contract drift fails tier-1 here);
+  * a fixture tree with ONE deliberately seeded violation is flagged,
+    and the same fixture without the mutation is clean (so the flag
+    comes from the seed, not from fixture-assembly noise).
+
+Fixtures are copies of the real contract files (anchored with
+assert-in-source checks so a refactor that moves the pattern fails
+loudly here instead of silently weakening the test).
+"""
+import importlib.util
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHECK = os.path.join(REPO, "tools", "ptpu_check.py")
+
+spec = importlib.util.spec_from_file_location("ptpu_check", CHECK)
+ptpu_check = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(ptpu_check)
+
+
+ABI_FILES = [
+    "csrc/ptpu_runtime.cc", "csrc/ptpu_ps_table.cc",
+    "csrc/ptpu_ps_server.cc", "csrc/ptpu_predictor.cc",
+    "csrc/ptpu_serving.cc", "csrc/ptpu_inference_api.h",
+    "paddle_tpu/core/native.py", "goapi/predictor.go",
+]
+WIRE_FILES = [
+    "csrc/ptpu_ps_server.cc", "csrc/ptpu_serving.cc",
+    "paddle_tpu/distributed/ps/wire.py",
+    "paddle_tpu/inference/serving.py",
+]
+STATS_FILES = [
+    "csrc/ptpu_ps_table.cc", "csrc/ptpu_ps_server.cc",
+    "csrc/ptpu_stats.h", "paddle_tpu/distributed/ps/table.py",
+    "paddle_tpu/profiler/stats.py",
+]
+
+
+def _fixture(tmp_path, rels):
+    root = tmp_path / "tree"
+    for rel in rels:
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copyfile(os.path.join(REPO, rel), dst)
+    return root
+
+
+def _mutate(root, rel, old, new):
+    p = root / rel
+    src = p.read_text()
+    assert old in src, f"fixture anchor {old!r} vanished from {rel}"
+    p.write_text(src.replace(old, new))
+
+
+def _run(root, checker):
+    return ptpu_check.run(str(root), [checker])
+
+
+class TestLiveTree:
+    def test_live_tree_has_zero_findings(self):
+        """The standing gate: every checker clean on the repo, via the
+        real CLI (exit code contract included)."""
+        r = subprocess.run([sys.executable, CHECK], cwd=REPO,
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "0 finding(s)" in r.stdout
+
+    def test_cli_lists_all_checkers(self):
+        r = subprocess.run([sys.executable, CHECK, "--list"],
+                           capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0
+        names = set(r.stdout.split())
+        assert names == {"abi", "wire", "stats", "locks", "nullcheck"}
+
+
+class TestAbiChecker:
+    def test_clean_fixture(self, tmp_path):
+        assert _run(_fixture(tmp_path, ABI_FILES), "abi") == []
+
+    def test_catches_manifest_rename(self, tmp_path):
+        """Renaming one manifest entry must flag BOTH directions: the C
+        export no longer listed, and the manifest name no C TU exports."""
+        root = _fixture(tmp_path, ABI_FILES)
+        _mutate(root, "paddle_tpu/core/native.py",
+                '"ptpu_ps_table_pull",', '"ptpu_ps_table_pulx",')
+        msgs = [f.message for f in _run(root, "abi")]
+        assert any("ptpu_ps_table_pull is exported" in m for m in msgs)
+        assert any("ptpu_ps_table_pulx" in m and "no csrc TU" in m
+                   for m in msgs)
+
+    def test_catches_header_decl_without_export(self, tmp_path):
+        """A function declared in the public C header but deleted from
+        the TU is exactly the drift that breaks cgo at link time."""
+        root = _fixture(tmp_path, ABI_FILES)
+        _mutate(root, "csrc/ptpu_inference_api.h",
+                "int ptpu_serving_port(void*);",
+                "int ptpu_serving_portt(void*);")
+        msgs = [f.message for f in _run(root, "abi")]
+        assert any("ptpu_serving_portt" in m and "not exported" in m
+                   for m in msgs)
+
+    def test_catches_goapi_call_without_decl(self, tmp_path):
+        root = _fixture(tmp_path, ABI_FILES)
+        _mutate(root, "goapi/predictor.go",
+                "C.ptpu_predictor_run(p.p", "C.ptpu_predictor_runx(p.p")
+        msgs = [f.message for f in _run(root, "abi")]
+        assert any("ptpu_predictor_runx" in m and "does not declare" in m
+                   for m in msgs)
+
+
+class TestWireChecker:
+    def test_clean_fixture(self, tmp_path):
+        assert _run(_fixture(tmp_path, WIRE_FILES), "wire") == []
+
+    def test_catches_ps_tag_drift(self, tmp_path):
+        root = _fixture(tmp_path, WIRE_FILES)
+        _mutate(root, "paddle_tpu/distributed/ps/wire.py",
+                "TAG_PULL_REQ = 0x50", "TAG_PULL_REQ = 0x55")
+        msgs = [f.message for f in _run(root, "wire")]
+        assert any("kTagPullReq" in m and "drift" in m for m in msgs)
+
+    def test_catches_serving_version_drift(self, tmp_path):
+        root = _fixture(tmp_path, WIRE_FILES)
+        _mutate(root, "paddle_tpu/inference/serving.py",
+                "WIRE_VERSION = 1", "WIRE_VERSION = 2")
+        msgs = [f.message for f in _run(root, "wire")]
+        assert any("kSvWireVersion" in m for m in msgs)
+
+    def test_catches_layout_drift(self, tmp_path):
+        """Shrinking the C PULL_REP header is the byte-offset class of
+        drift the tag check cannot see."""
+        root = _fixture(tmp_path, WIRE_FILES)
+        _mutate(root, "csrc/ptpu_ps_server.cc",
+                "PutU32(rep.data(), uint32_t(10 + body));",
+                "PutU32(rep.data(), uint32_t(8 + body));")
+        msgs = [f.message for f in _run(root, "wire")]
+        assert any("PULL_REP header" in m for m in msgs)
+
+
+class TestStatsChecker:
+    def test_clean_fixture(self, tmp_path):
+        assert _run(_fixture(tmp_path, STATS_FILES), "stats") == []
+
+    def test_catches_counter_rename(self, tmp_path):
+        """Renaming the Python twin of a C-rendered counter breaks
+        snapshot merging — the core twin-registry contract."""
+        root = _fixture(tmp_path, STATS_FILES)
+        _mutate(root, "paddle_tpu/distributed/ps/table.py",
+                '"pull_ops"', '"pull_opz"')
+        msgs = [f.message for f in _run(root, "stats")]
+        assert any("'pull_ops'" in m and "twin-registry drift" in m
+                   for m in msgs)
+
+    def test_catches_bucket_layout_drift(self, tmp_path):
+        root = _fixture(tmp_path, STATS_FILES)
+        _mutate(root, "paddle_tpu/profiler/stats.py",
+                "HIST_BUCKETS = 32", "HIST_BUCKETS = 16")
+        msgs = [f.message for f in _run(root, "stats")]
+        assert any("bucket-for-bucket" in m for m in msgs)
+
+
+class TestLocksChecker:
+    def test_clean_on_live_csrc(self):
+        assert ptpu_check.check_locks(REPO) == []
+
+    def test_catches_predicate_free_wait(self, tmp_path):
+        root = tmp_path / "tree"
+        (root / "csrc").mkdir(parents=True)
+        (root / "csrc" / "bad_locks.cc").write_text(
+            "void f(std::condition_variable& cv,\n"
+            "       std::unique_lock<std::mutex>& l) {\n"
+            "  cv.wait(l);\n"
+            "}\n")
+        msgs = [f.message for f in _run(root, "locks")]
+        assert any("without a predicate" in m for m in msgs)
+
+    def test_catches_unlooped_timed_wait(self, tmp_path):
+        root = tmp_path / "tree"
+        (root / "csrc").mkdir(parents=True)
+        (root / "csrc" / "bad_locks.cc").write_text(
+            "void f(std::condition_variable& cv,\n"
+            "       std::unique_lock<std::mutex>& l) {\n"
+            "  cv.wait_for(l, std::chrono::seconds(1));\n"
+            "}\n")
+        msgs = [f.message for f in _run(root, "locks")]
+        assert any("re-check loop" in m for m in msgs)
+
+    def test_catches_unlooped_cvwaitforus_wrapper(self, tmp_path):
+        """The sanctioned ptpu_sync.h wrapper is linted like the raw
+        waits: its 3-arg (predicate-free) form outside a re-check loop
+        is the same spurious-wakeup bug."""
+        root = tmp_path / "tree"
+        (root / "csrc").mkdir(parents=True)
+        (root / "csrc" / "bad_wrap.cc").write_text(
+            "void f(std::condition_variable& cv,\n"
+            "       std::unique_lock<std::mutex>& l) {\n"
+            "  ptpu::CvWaitForUs(cv, l, 1000);\n"
+            "}\n")
+        msgs = [f.message for f in _run(root, "locks")]
+        assert any("CvWaitForUs" in m and "re-check loop" in m
+                   for m in msgs)
+
+    def test_allows_timed_wait_inside_loop(self, tmp_path):
+        root = tmp_path / "tree"
+        (root / "csrc").mkdir(parents=True)
+        (root / "csrc" / "ok_locks.cc").write_text(
+            "void f(std::condition_variable& cv,\n"
+            "       std::unique_lock<std::mutex>& l, bool& done) {\n"
+            "  while (!done) {\n"
+            "    cv.wait_for(l, std::chrono::seconds(1));\n"
+            "  }\n"
+            "}\n")
+        assert _run(root, "locks") == []
+
+    def test_catches_raw_pthread_and_sync_builtins(self, tmp_path):
+        root = tmp_path / "tree"
+        (root / "csrc").mkdir(parents=True)
+        (root / "csrc" / "bad_prims.cc").write_text(
+            "void f(pthread_mutex_t* m, long* c) {\n"
+            "  pthread_mutex_lock(m);\n"
+            "  __sync_fetch_and_add(c, 1);\n"
+            "  pthread_mutex_unlock(m);\n"
+            "}\n")
+        msgs = [f.message for f in _run(root, "locks")]
+        assert any("pthread_mutex_lock" in m for m in msgs)
+        assert any("__sync_fetch_and_add" in m for m in msgs)
+
+
+class TestNullcheckChecker:
+    def test_clean_on_live_csrc(self):
+        assert ptpu_check.check_nullcheck(REPO) == []
+
+    def test_catches_unguarded_handle_entry(self, tmp_path):
+        root = tmp_path / "tree"
+        (root / "csrc").mkdir(parents=True)
+        (root / "csrc" / "bad_abi.cc").write_text(
+            'extern "C" int ptpu_bad_entry(void *h) {\n'
+            "  return static_cast<int *>(h)[0];\n"
+            "}\n")
+        msgs = [f.message for f in _run(root, "nullcheck")]
+        assert any("ptpu_bad_entry" in m and "NULL guard" in m
+                   for m in msgs)
+
+    def test_accepts_guarded_and_delegating_entries(self, tmp_path):
+        root = tmp_path / "tree"
+        (root / "csrc").mkdir(parents=True)
+        (root / "csrc" / "ok_abi.cc").write_text(
+            'extern "C" int ptpu_ok_a(void *h) {\n'
+            "  auto *t = static_cast<int *>(h);\n"
+            "  if (!t) return -1;\n"
+            "  return t[0];\n"
+            "}\n"
+            'extern "C" int ptpu_ok_b(void *h) {\n'
+            "  return ptpu_ok_a(h);\n"
+            "}\n")
+        assert _run(root, "nullcheck") == []
+
+
+class TestFindingPlumbing:
+    def test_json_output_and_exit_code(self, tmp_path):
+        root = _fixture(tmp_path, WIRE_FILES)
+        _mutate(root, "paddle_tpu/distributed/ps/wire.py",
+                "TAG_PULL_REQ = 0x50", "TAG_PULL_REQ = 0x55")
+        r = subprocess.run(
+            [sys.executable, CHECK, "--root", str(root), "--check",
+             "wire", "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert r.returncode == 1
+        import json
+        findings = json.loads(r.stdout)
+        assert findings and findings[0]["checker"] == "wire"
+
+    def test_missing_contract_file_is_a_finding(self, tmp_path):
+        root = _fixture(tmp_path, WIRE_FILES)
+        os.remove(root / "paddle_tpu/distributed/ps/wire.py")
+        msgs = [f.message for f in _run(root, "wire")]
+        assert any("file missing" in m for m in msgs)

@@ -1,0 +1,747 @@
+#!/usr/bin/env python3
+"""ptpu_check — repo-specific static analysis for the native runtime's
+cross-language seams (reference: the `tools/` checkers the upstream
+project gates CI on — `check_api_compatible.py`, op-registry
+consistency scripts, `enforce.h` discipline).
+
+The repro carries four hand-maintained contracts between C, Python and
+Go that the compiler cannot see across:
+
+  abi        exported `ptpu_*` symbols in csrc  ==  the ABI_SYMBOLS
+             manifest in paddle_tpu/core/native.py  ==  the
+             declarations in csrc/ptpu_inference_api.h  ==  the
+             `C.ptpu_*` calls in goapi/predictor.go
+  wire       frame tags / protocol version / fixed field offsets in
+             csrc (ptpu_ps_server.cc, ptpu_serving.cc)  ==  their
+             Python twins (distributed/ps/wire.py, inference/serving.py)
+  stats      counter names the C JSON renderers emit  ==  the names the
+             Python twin registry (profiler/stats.py call sites in
+             distributed/ps/table.py) maintains; histogram layout
+             (kHistBuckets) identical on both sides
+  locks      condvar discipline in csrc: every wait has a predicate (or
+             sits in a re-check loop), no bare pthread_* / __sync_* /
+             __atomic_* primitives (std:: only — TSan-visible and
+             portable)
+  nullcheck  every extern-C ABI entry taking an opaque handle guards
+             NULL before dereferencing (ctypes/cgo can always hand one
+             back after a failed create or a teardown race)
+
+No clang, no compilation: regex/AST over the sources, so the suite runs
+in milliseconds and anywhere. Exit 0 == no findings. Each checker is
+unit-tested against fixture trees with deliberately seeded violations
+in tests/test_static_checks.py.
+
+Usage:
+  python tools/ptpu_check.py                 # all checkers, repo root
+  python tools/ptpu_check.py --check wire    # one checker
+  python tools/ptpu_check.py --root DIR      # another tree (fixtures)
+  python tools/ptpu_check.py --json          # machine-readable output
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import struct
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class Finding:
+    def __init__(self, checker: str, path: str, line: int, message: str):
+        self.checker = checker
+        self.path = path
+        self.line = line
+        self.message = message
+
+    def to_dict(self) -> dict:
+        return {"checker": self.checker, "path": self.path,
+                "line": self.line, "message": self.message}
+
+    def __str__(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: [{self.checker}] {self.message}"
+
+
+def _read(root: str, rel: str) -> Optional[str]:
+    p = os.path.join(root, rel)
+    if not os.path.exists(p):
+        return None
+    with open(p, "r", encoding="utf-8", errors="replace") as f:
+        return f.read()
+
+
+def _require(root: str, rel: str, checker: str,
+             findings: List[Finding]) -> Optional[str]:
+    src = _read(root, rel)
+    if src is None:
+        findings.append(Finding(checker, rel, 0,
+                                f"file missing (contract file for the "
+                                f"'{checker}' checker)"))
+    return src
+
+
+def strip_c_comments(src: str, keep_strings: bool = False) -> str:
+    """Blank out // and /* */ comments — and, unless `keep_strings`,
+    string literals too — preserving line structure so reported line
+    numbers stay valid."""
+    out = []
+    i, n = 0, len(src)
+    mode = None  # None | 'line' | 'block' | 'str' | 'chr'
+    while i < n:
+        c = src[i]
+        nxt = src[i + 1] if i + 1 < n else ""
+        if mode is None:
+            if c == "/" and nxt == "/":
+                mode = "line"
+                out.append("  ")
+                i += 2
+                continue
+            if c == "/" and nxt == "*":
+                mode = "block"
+                out.append("  ")
+                i += 2
+                continue
+            if c == '"':
+                mode = "str"
+                out.append('"')
+                i += 1
+                continue
+            if c == "'":
+                mode = "chr"
+                out.append("'")
+                i += 1
+                continue
+            out.append(c)
+        elif mode == "line":
+            if c == "\n":
+                mode = None
+                out.append("\n")
+            else:
+                out.append(" ")
+        elif mode == "block":
+            if c == "*" and nxt == "/":
+                mode = None
+                out.append("  ")
+                i += 2
+                continue
+            out.append("\n" if c == "\n" else " ")
+        elif mode in ("str", "chr"):
+            quote = '"' if mode == "str" else "'"
+            if c == "\\":
+                out.append(src[i:i + 2] if keep_strings else "  ")
+                i += 2
+                continue
+            if c == quote:
+                mode = None
+                out.append(quote)
+            else:
+                out.append(c if keep_strings else " ")
+        i += 1
+    return "".join(out)
+
+
+def _lineno(src: str, pos: int) -> int:
+    return src.count("\n", 0, pos) + 1
+
+
+# ---------------------------------------------------------------------------
+# checker: abi
+# ---------------------------------------------------------------------------
+
+# csrc definition files per shared object — the unit the manifest keys on
+SO_SOURCES = {
+    "_native.so": ["csrc/ptpu_runtime.cc"],
+    "_native_ps.so": ["csrc/ptpu_ps_table.cc", "csrc/ptpu_ps_server.cc"],
+    "_native_predictor.so": ["csrc/ptpu_predictor.cc",
+                             "csrc/ptpu_serving.cc"],
+}
+
+_EXPORT_RES = [
+    re.compile(r"\bPTPU_EXPORT\b[^(;{]*?\b(ptpu_\w+)\s*\("),
+    re.compile(r"\bPTPU_PS_EXPORT\b[^(;{]*?\b(ptpu_\w+)\s*\("),
+    re.compile(r'__attribute__\(\(visibility\("default"\)\)\)\s*'
+               r"[^(;{]*?\b(ptpu_\w+)\s*\(", re.S),
+]
+
+
+def c_exported_symbols(src: str) -> Dict[str, int]:
+    """name -> line of every exported ptpu_* definition in a csrc TU."""
+    clean = strip_c_comments(src)
+    # comment-stripping blanks the string inside visibility("default");
+    # recover it so the attribute regex still matches
+    clean = clean.replace('visibility("       ")', 'visibility("default")')
+    out: Dict[str, int] = {}
+    for rx in _EXPORT_RES:
+        for m in rx.finditer(clean):
+            out.setdefault(m.group(1), _lineno(clean, m.start(1)))
+    return out
+
+
+def manifest_symbols(native_py: str, rel: str,
+                     findings: List[Finding]) -> Dict[str, Set[str]]:
+    """ABI_SYMBOLS from core/native.py, parsed statically via ast."""
+    try:
+        tree = ast.parse(native_py)
+    except SyntaxError as e:
+        findings.append(Finding("abi", rel, e.lineno or 0,
+                                f"cannot parse: {e.msg}"))
+        return {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "ABI_SYMBOLS":
+                    try:
+                        val = ast.literal_eval(node.value)
+                        return {k: set(v) for k, v in val.items()}
+                    except (ValueError, TypeError):
+                        findings.append(Finding(
+                            "abi", rel, node.lineno,
+                            "ABI_SYMBOLS is not a literal dict"))
+                        return {}
+    findings.append(Finding("abi", rel, 0, "ABI_SYMBOLS manifest not found"))
+    return {}
+
+
+def header_decls(header: str) -> Dict[str, int]:
+    clean = strip_c_comments(header)
+    out: Dict[str, int] = {}
+    for m in re.finditer(r"\b(ptpu_\w+)\s*\(", clean):
+        out.setdefault(m.group(1), _lineno(clean, m.start(1)))
+    return out
+
+
+def check_abi(root: str) -> List[Finding]:
+    f: List[Finding] = []
+    native_rel = "paddle_tpu/core/native.py"
+    native_py = _require(root, native_rel, "abi", f)
+    manifest = manifest_symbols(native_py, native_rel, f) if native_py else {}
+
+    exported: Dict[str, Dict[str, Tuple[str, int]]] = {}
+    for so, rels in SO_SOURCES.items():
+        exported[so] = {}
+        for rel in rels:
+            src = _require(root, rel, "abi", f)
+            if src is None:
+                continue
+            for name, line in c_exported_symbols(src).items():
+                exported[so][name] = (rel, line)
+
+    # 1) three-way: exported-in-C <-> listed-in-manifest, per .so
+    for so in SO_SOURCES:
+        c_syms = set(exported.get(so, {}))
+        m_syms = manifest.get(so, set())
+        if not manifest:
+            break
+        for name in sorted(c_syms - m_syms):
+            rel, line = exported[so][name]
+            f.append(Finding("abi", rel, line,
+                             f"{name} is exported by {so} sources but "
+                             f"missing from ABI_SYMBOLS['{so}'] in "
+                             f"core/native.py"))
+        for name in sorted(m_syms - c_syms):
+            f.append(Finding("abi", native_rel, 0,
+                             f"ABI_SYMBOLS['{so}'] lists {name} but no "
+                             f"csrc TU of {so} exports it"))
+
+    # 2) public C header <-> predictor TU exports + manifest
+    hdr_rel = "csrc/ptpu_inference_api.h"
+    hdr = _require(root, hdr_rel, "abi", f)
+    if hdr is not None:
+        decls = header_decls(hdr)
+        pred_syms = set(exported.get("_native_predictor.so", {}))
+        pred_manifest = manifest.get("_native_predictor.so", set())
+        for name, line in sorted(decls.items()):
+            if pred_syms and name not in pred_syms:
+                f.append(Finding("abi", hdr_rel, line,
+                                 f"{name} is declared in the public C "
+                                 f"header but not exported by the "
+                                 f"predictor/serving TUs"))
+            if manifest and name not in pred_manifest:
+                f.append(Finding("abi", hdr_rel, line,
+                                 f"{name} is declared in the public C "
+                                 f"header but missing from ABI_SYMBOLS"
+                                 f"['_native_predictor.so']"))
+
+    # 3) Go binding <-> public C header
+    go_rel = "goapi/predictor.go"
+    go = _require(root, go_rel, "abi", f)
+    if go is not None and hdr is not None:
+        decls = header_decls(hdr)
+        for m in re.finditer(r"\bC\.(ptpu_\w+)\b", go):
+            name = m.group(1)
+            if name not in decls:
+                f.append(Finding("abi", go_rel, _lineno(go, m.start()),
+                                 f"goapi calls C.{name} but "
+                                 f"ptpu_inference_api.h does not declare "
+                                 f"it"))
+    return f
+
+
+# ---------------------------------------------------------------------------
+# checker: wire
+# ---------------------------------------------------------------------------
+
+def py_int_constants(src: str, rel: str, checker: str,
+                     findings: List[Finding]) -> Dict[str, int]:
+    """Top-level NAME = <int literal> assignments (0x.. included)."""
+    out: Dict[str, int] = {}
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        findings.append(Finding(checker, rel, e.lineno or 0,
+                                f"cannot parse: {e.msg}"))
+        return out
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            try:
+                v = ast.literal_eval(node.value)
+            except (ValueError, TypeError):
+                continue
+            if isinstance(v, int) and not isinstance(v, bool):
+                out[node.targets[0].id] = v
+    return out
+
+
+def c_u8_constants(src: str) -> Dict[str, Tuple[int, int]]:
+    """constexpr uint8_t kName = 0x..;  ->  name: (value, line)."""
+    clean = strip_c_comments(src)
+    out: Dict[str, Tuple[int, int]] = {}
+    for m in re.finditer(
+            r"constexpr\s+uint8_t\s+(k\w+)\s*=\s*(0x[0-9a-fA-F]+|\d+)\s*;",
+            clean):
+        out[m.group(1)] = (int(m.group(2), 0), _lineno(clean, m.start()))
+    return out
+
+
+# canonical tag names: C constant -> Python constant, per protocol
+PS_TAGS = {"kTagPullReq": "TAG_PULL_REQ", "kTagPullRep": "TAG_PULL_REP",
+           "kTagPushReq": "TAG_PUSH_REQ", "kTagOk": "TAG_OK",
+           "kTagErr": "TAG_ERR"}
+SV_TAGS = {"kTagInferReq": "TAG_INFER_REQ", "kTagInferRep": "TAG_INFER_REP",
+           "kTagInferErr": "TAG_INFER_ERR", "kTagMetaReq": "TAG_META_REQ",
+           "kTagMetaRep": "TAG_META_REP"}
+
+
+def _py_struct_size(src: str, var: str) -> Optional[int]:
+    """Size of `var = struct.Struct("<fmt>")` defined in the module."""
+    m = re.search(rf'^{re.escape(var)}\s*=\s*struct\.Struct\("([^"]+)"\)',
+                  src, re.M)
+    return struct.calcsize(m.group(1)) if m else None
+
+
+def _tag_parity(c_rel: str, c_consts, py_rel: str, py_consts, tag_map,
+                c_ver_name: str, findings: List[Finding]) -> None:
+    for c_name, py_name in tag_map.items():
+        if c_name not in c_consts:
+            findings.append(Finding("wire", c_rel, 0,
+                                    f"tag constant {c_name} not found"))
+            continue
+        if py_name not in py_consts:
+            findings.append(Finding("wire", py_rel, 0,
+                                    f"tag constant {py_name} not found"))
+            continue
+        cv, line = c_consts[c_name]
+        pv = py_consts[py_name]
+        if cv != pv:
+            findings.append(Finding(
+                "wire", c_rel, line,
+                f"{c_name} = {cv:#x} in C but {py_name} = {pv:#x} in "
+                f"{py_rel} — wire tag drift"))
+    if c_ver_name in c_consts and "WIRE_VERSION" in py_consts:
+        cv, line = c_consts[c_ver_name]
+        if cv != py_consts["WIRE_VERSION"]:
+            findings.append(Finding(
+                "wire", c_rel, line,
+                f"{c_ver_name} = {cv} in C but WIRE_VERSION = "
+                f"{py_consts['WIRE_VERSION']} in {py_rel}"))
+
+
+def check_wire(root: str) -> List[Finding]:
+    f: List[Finding] = []
+    ps_rel, sv_rel = "csrc/ptpu_ps_server.cc", "csrc/ptpu_serving.cc"
+    pyw_rel, pys_rel = ("paddle_tpu/distributed/ps/wire.py",
+                       "paddle_tpu/inference/serving.py")
+    ps_c = _require(root, ps_rel, "wire", f)
+    sv_c = _require(root, sv_rel, "wire", f)
+    pyw = _require(root, pyw_rel, "wire", f)
+    pys = _require(root, pys_rel, "wire", f)
+
+    # ---- PS data-plane tags + version
+    if ps_c is not None and pyw is not None:
+        c_consts = c_u8_constants(ps_c)
+        py_consts = py_int_constants(pyw, pyw_rel, "wire", f)
+        _tag_parity(ps_rel, c_consts, pyw_rel, py_consts, PS_TAGS,
+                    "kWireVersion", f)
+
+        # layout probe: PULL_REP header is [ver][tag][u32 n][u32 dim] =
+        # 10 payload bytes. Python: _PULL_REP_HDR = 2 + Struct("<II");
+        # C: the reply writes its frame length as 10 + body and the
+        # gather body at rep.data() + 14 (4B length prefix + 10).
+        u32x2 = _py_struct_size(pyw, "_U32x2")
+        if u32x2 is None:
+            f.append(Finding("wire", pyw_rel, 0,
+                             "_U32x2 struct definition not found"))
+        else:
+            py_hdr = 2 + u32x2
+            clean = strip_c_comments(ps_c)
+            m = re.search(r"PutU32\(rep\.data\(\),\s*uint32_t\((\d+)\s*\+"
+                          r"\s*body\)\)", clean)
+            if not m:
+                f.append(Finding("wire", ps_rel, 0,
+                                 "PULL_REP frame-length expression not "
+                                 "found (layout probe)"))
+            elif int(m.group(1)) != py_hdr:
+                f.append(Finding(
+                    "wire", ps_rel, _lineno(clean, m.start()),
+                    f"PULL_REP header is {m.group(1)} bytes in C but "
+                    f"_PULL_REP_HDR = {py_hdr} in wire.py"))
+            m = re.search(r"rep\.data\(\)\s*\+\s*(\d+);", clean)
+            if m and int(m.group(1)) != py_hdr + 4:
+                f.append(Finding(
+                    "wire", ps_rel, _lineno(clean, m.start()),
+                    f"PULL_REP body lands at +{m.group(1)} in the C "
+                    f"reply buffer; expected 4-byte length prefix + "
+                    f"{py_hdr}"))
+            # PUSH_REQ fixed block after the table name:
+            # [u8 flags][u32 n][u32 dim] = 1 + 8 = 9 bytes
+            want = 1 + u32x2
+            if not re.search(rf"n\s*<\s*off\s*\+\s*{want}\b", clean):
+                f.append(Finding(
+                    "wire", ps_rel, 0,
+                    f"PUSH_REQ fixed-header size check (off + {want} "
+                    f"for flags+n+dim, per wire.py) not found in the C "
+                    f"parser — layout drift or probe went stale"))
+
+    # ---- serving tags + version
+    if sv_c is not None and pys is not None:
+        c_consts = c_u8_constants(sv_c)
+        py_consts = py_int_constants(pys, pys_rel, "wire", f)
+        _tag_parity(sv_rel, c_consts, pys_rel, py_consts, SV_TAGS,
+                    "kSvWireVersion", f)
+
+        # layout probe: INFER frames lead with [ver][tag][u64 req_id]
+        # [u16 count] = 12 payload bytes; the C parser enforces
+        # n >= 2 + 8 + 2 and Python unpacks the count at offset 10.
+        clean = strip_c_comments(sv_c)
+        if not re.search(r"n\s*<\s*2\s*\+\s*8\s*\+\s*2", clean):
+            f.append(Finding("wire", sv_rel, 0,
+                             "INFER_REQ minimum-size check (2 + 8 + 2) "
+                             "not found (layout probe)"))
+        if not re.search(r'unpack_from\(\s*f,\s*10\s*\)|"<H",\s*f,\s*10',
+                         pys):
+            f.append(Finding("wire", pys_rel, 0,
+                             "INFER reply count at payload offset 10 "
+                             "not found (layout probe)"))
+    return f
+
+
+# ---------------------------------------------------------------------------
+# checker: stats
+# ---------------------------------------------------------------------------
+
+def c_json_names(src: str) -> Dict[str, int]:
+    """Counter/histogram names a C renderer emits: AppendJsonU64/Hist
+    first-arg literals plus the {"name", &stat} table initializers.
+    Scans comment-stripped source (string literals kept — they ARE the
+    names), so a commented-out renderer line is not collected as a live
+    name."""
+    src = strip_c_comments(src, keep_strings=True)
+    out: Dict[str, int] = {}
+    for m in re.finditer(r'AppendJson(?:U64|Hist)\(\s*&?\w+,\s*"(\w+)"',
+                         src):
+        out.setdefault(m.group(1), _lineno(src, m.start()))
+    for m in re.finditer(r'\{"(\w+)",\s*&', src):
+        out.setdefault(m.group(1), _lineno(src, m.start()))
+    return out
+
+
+def py_stat_names(src: str) -> Set[str]:
+    return set(re.findall(r'\.(?:counter|histogram)\("(\w+)"\)', src))
+
+
+# C-only wire counters: the Python control-plane has no handshake (the
+# multiprocessing listener authenticates internally) and tracks
+# connection lifetime differently. Additions here must be justified.
+PS_SERVER_C_ONLY = {"handshake_fails", "conns_accepted", "conns_active"}
+
+
+def check_stats(root: str) -> List[Finding]:
+    f: List[Finding] = []
+    tbl_rel, srv_rel = "csrc/ptpu_ps_table.cc", "csrc/ptpu_ps_server.cc"
+    py_rel = "paddle_tpu/distributed/ps/table.py"
+    stats_rel = "paddle_tpu/profiler/stats.py"
+    hdr_rel = "csrc/ptpu_stats.h"
+    tbl = _require(root, tbl_rel, "stats", f)
+    srv = _require(root, srv_rel, "stats", f)
+    py = _require(root, py_rel, "stats", f)
+    pystats = _require(root, stats_rel, "stats", f)
+    hdr = _require(root, hdr_rel, "stats", f)
+
+    py_names = py_stat_names(py) if py is not None else set()
+
+    # storage twin: the C table's counter set must be maintained
+    # verbatim by the numpy fallback shard (snapshots merge by name)
+    if tbl is not None and py is not None:
+        for name, line in sorted(c_json_names(tbl).items()):
+            if name not in py_names:
+                f.append(Finding(
+                    "stats", tbl_rel, line,
+                    f"C table renderer emits '{name}' but "
+                    f"distributed/ps/table.py never maintains a stat "
+                    f"of that name — twin-registry drift"))
+
+    # wire twin: every server counter must exist Python-side unless it
+    # is on the documented C-only list
+    if srv is not None and py is not None:
+        for name, line in sorted(c_json_names(srv).items()):
+            if name not in py_names and name not in PS_SERVER_C_ONLY:
+                f.append(Finding(
+                    "stats", srv_rel, line,
+                    f"C PS-server renderer emits '{name}' but "
+                    f"distributed/ps/table.py never maintains it and it "
+                    f"is not on the documented C-only list"))
+
+    # histogram layout: bucket count and dict shape must match
+    if hdr is not None and pystats is not None:
+        m = re.search(r"kHistBuckets\s*=\s*(\d+)", hdr)
+        pyb = py_int_constants(pystats, stats_rel, "stats",
+                               f).get("HIST_BUCKETS")
+        if m is None:
+            f.append(Finding("stats", hdr_rel, 0,
+                             "kHistBuckets not found"))
+        elif pyb is None:
+            f.append(Finding("stats", stats_rel, 0,
+                             "HIST_BUCKETS not found"))
+        elif int(m.group(1)) != pyb:
+            f.append(Finding(
+                "stats", hdr_rel, _lineno(hdr, m.start()),
+                f"kHistBuckets = {m.group(1)} but profiler/stats.py "
+                f"HIST_BUCKETS = {pyb} — snapshots no longer merge "
+                f"bucket-for-bucket"))
+        for key in ("count", "sum", "buckets"):
+            if f'"{key}"' not in hdr:
+                f.append(Finding("stats", hdr_rel, 0,
+                                 f"C histogram JSON lacks the '{key}' "
+                                 f"field profiler/stats.py renders"))
+    return f
+
+
+# ---------------------------------------------------------------------------
+# checker: locks
+# ---------------------------------------------------------------------------
+
+# ptpu_sync.h IS the sanctioned wrapper around the raw timed waits (it
+# exists to reroute them under TSan), so the wait rules skip it.
+LOCK_EXEMPT_FILES = {"ptpu_sync.h"}
+
+
+def _top_level_arg_count(clean: str, open_paren: int) -> int:
+    """Number of comma-separated args of the call whose '(' is at
+    open_paren. Returns -1 on unbalanced input."""
+    depth, args, i, n = 0, 0, open_paren, len(clean)
+    saw_token = False
+    while i < n:
+        c = clean[i]
+        if c in "([{":
+            depth += 1
+        elif c in ")]}":
+            depth -= 1
+            if depth == 0:
+                return args + 1 if saw_token else 0
+        elif depth == 1:
+            if c == ",":
+                args += 1
+            elif not c.isspace():
+                saw_token = True
+        i += 1
+    return -1
+
+
+def check_locks(root: str) -> List[Finding]:
+    f: List[Finding] = []
+    csrc = os.path.join(root, "csrc")
+    if not os.path.isdir(csrc):
+        f.append(Finding("locks", "csrc", 0, "csrc directory missing"))
+        return f
+    for fname in sorted(os.listdir(csrc)):
+        if not (fname.endswith(".cc") or fname.endswith(".h")):
+            continue
+        rel = f"csrc/{fname}"
+        src = _read(root, rel)
+        if src is None:
+            continue
+        clean = strip_c_comments(src)
+        lines = clean.split("\n")
+
+        if fname not in LOCK_EXEMPT_FILES:
+            # condvar wait must carry a predicate: a bare wait(lock)
+            # returns on spurious wakeups with no recheck
+            for m in re.finditer(r"\.\s*wait\s*(\()", clean):
+                if _top_level_arg_count(clean, m.start(1)) == 1:
+                    f.append(Finding(
+                        "locks", rel, _lineno(clean, m.start()),
+                        "condition_variable wait() without a predicate "
+                        "— spurious wakeups return with the condition "
+                        "unchecked; pass a predicate lambda"))
+            # timed waits without a predicate are only sound inside an
+            # explicit re-check loop. Covers the raw wait_for/wait_until
+            # forms AND the sanctioned ptpu::CvWaitForUs wrapper
+            # (ptpu_sync.h): its 3-arg form (cv, lock, usec) has no
+            # predicate; the 4-arg form rechecks internally.
+            for m in re.finditer(
+                    r"\b(\w*[Ww]ait_(?:for|until)\w*|CvWaitForUs)"
+                    r"\s*(\()", clean):
+                argc = _top_level_arg_count(clean, m.start(2))
+                predicated = argc == 4 if m.group(1) == "CvWaitForUs" \
+                    else argc != 2
+                if predicated:
+                    continue  # predicated form rechecks internally
+                ln = _lineno(clean, m.start())
+                ctx = "\n".join(lines[max(0, ln - 7):ln])
+                if not re.search(r"\bwhile\s*\(|\bfor\s*\(\s*;\s*;", ctx):
+                    f.append(Finding(
+                        "locks", rel, ln,
+                        f"{m.group(1)} without predicate is not inside "
+                        f"a visible re-check loop (checked 6 lines up) "
+                        f"— wrap it in while(pred) or use the "
+                        f"predicated overload"))
+
+        # std:: primitives only: raw pthread_/__sync_/__atomic_ calls
+        # bypass RAII and the TSan interceptor story the tree relies on
+        for m in re.finditer(r"\b(pthread_\w+|__sync_\w+|__atomic_\w+)"
+                             r"\s*\(", clean):
+            f.append(Finding(
+                "locks", rel, _lineno(clean, m.start()),
+                f"raw {m.group(1)}() call — use the std:: concurrency "
+                f"primitives (RAII, TSan-visible)"))
+    return f
+
+
+# ---------------------------------------------------------------------------
+# checker: nullcheck
+# ---------------------------------------------------------------------------
+
+HANDLE_PARAM = re.compile(
+    r"^(?:void|PTPU_Predictor)\s*\*\s*(\w+)\s*$")
+
+
+def _c_functions(clean: str):
+    """Yield (name, params, body, line) for ptpu_* function DEFINITIONS."""
+    for m in re.finditer(r"\b(ptpu_\w+)\s*\(([^;{)]*)\)\s*\{", clean):
+        name, params = m.group(1), m.group(2)
+        # walk to the matching close brace
+        depth, i, n = 1, m.end(), len(clean)
+        while i < n and depth:
+            if clean[i] == "{":
+                depth += 1
+            elif clean[i] == "}":
+                depth -= 1
+            i += 1
+        yield name, params, clean[m.end():i], _lineno(clean, m.start())
+
+
+def check_nullcheck(root: str) -> List[Finding]:
+    f: List[Finding] = []
+    csrc = os.path.join(root, "csrc")
+    if not os.path.isdir(csrc):
+        f.append(Finding("nullcheck", "csrc", 0, "csrc directory missing"))
+        return f
+    for fname in sorted(os.listdir(csrc)):
+        if not fname.endswith(".cc"):
+            continue
+        rel = f"csrc/{fname}"
+        src = _read(root, rel)
+        if src is None:
+            continue
+        clean = strip_c_comments(src)
+        for name, params, body, line in _c_functions(clean):
+            first = params.split(",")[0].strip() if params.strip() else ""
+            pm = HANDLE_PARAM.match(first)
+            if not pm:
+                continue  # not a handle-taking ABI entry
+            h = pm.group(1)
+            head = body[:400]
+            # the idiomatic bodies first cast the handle into a typed
+            # local and guard THAT: accept guards on any alias of h
+            names = {h}
+            for am in re.finditer(
+                    rf"(\w+)\s*=\s*(?:static_cast<[^>]*>\s*\(\s*{h}\s*\)"
+                    rf"|\(\s*\w+\s*\*\s*\)\s*{h}\b)", head):
+                names.add(am.group(1))
+            alias = "|".join(sorted(names))
+            guarded = (
+                re.search(rf"if\s*\(\s*!\s*(?:{alias})\b", head) or
+                re.search(rf"if\s*\(\s*(?:{alias})\s*==\s*(?:nullptr|NULL)",
+                          head) or
+                re.search(rf"\b(?:{alias})\s*\?", head) or    # t ? x : y
+                # delegation: the entry forwards the handle verbatim as
+                # the first argument (the callee carries the guard —
+                # e.g. set_input_int, ptpu_ps_table_push_raw)
+                re.search(rf"return\s+\w+\(\s*{h}\b", head))
+            if not guarded:
+                f.append(Finding(
+                    "nullcheck", rel, line,
+                    f"C ABI entry {name}() dereferences handle "
+                    f"'{h}' without a NULL guard (first statements) — "
+                    f"ctypes/cgo callers can pass NULL after a failed "
+                    f"create or a teardown race"))
+    return f
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+CHECKERS = {
+    "abi": check_abi,
+    "wire": check_wire,
+    "stats": check_stats,
+    "locks": check_locks,
+    "nullcheck": check_nullcheck,
+}
+
+
+def run(root: str, names: List[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for name in names:
+        findings.extend(CHECKERS[name](root))
+    return findings
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--root", default=REPO,
+                    help="tree to check (default: this repo)")
+    ap.add_argument("--check", action="append", choices=sorted(CHECKERS),
+                    help="run only the named checker(s)")
+    ap.add_argument("--list", action="store_true",
+                    help="list checkers and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as JSON")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in sorted(CHECKERS):
+            print(name)
+        return 0
+
+    names = args.check or sorted(CHECKERS)
+    findings = run(os.path.abspath(args.root), names)
+    if args.json:
+        print(json.dumps([x.to_dict() for x in findings], indent=2))
+    else:
+        for x in findings:
+            print(x)
+        print(f"ptpu_check: {len(findings)} finding(s) from "
+              f"{len(names)} checker(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

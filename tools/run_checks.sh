@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# Single correctness-tooling entrypoint (the CI gate every perf PR runs
+# against — reference: the upstream tools/ check scripts chained in CI).
+#
+#   build            the three shipping .so artifacts (-Werror on)
+#   sancheck         all three C selftests + the pure-C demo under
+#                    ASan+UBSan, fail-fast; TSan leg when libtsan exists
+#   ptpu_check       the 5 static checkers (ABI / wire / stats / locks /
+#                    nullcheck) — 0 findings required
+#   selftest         the plain (uninstrumented) native selftests
+#
+# Usage: tools/run_checks.sh [-j N]
+set -euo pipefail
+
+JOBS=4
+while getopts "j:" opt; do
+  case "$opt" in
+    j) JOBS="$OPTARG" ;;
+    *) echo "usage: $0 [-j N]" >&2; exit 2 ;;
+  esac
+done
+
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$ROOT"
+
+step() { printf '\n== %s ==\n' "$*"; }
+
+step "build (shipping .so artifacts, -Werror)"
+make -C csrc -j"$JOBS" all
+
+step "sancheck: ASan+UBSan (selftests + demo, fail-fast)"
+make -C csrc -j"$JOBS" sancheck SAN=asan,ubsan
+
+if echo 'int main(){return 0;}' | "${CXX:-g++}" -fsanitize=thread -x c++ - \
+    -o /tmp/ptpu_tsan_probe.$$ 2>/dev/null && \
+    /tmp/ptpu_tsan_probe.$$ 2>/dev/null; then
+  rm -f /tmp/ptpu_tsan_probe.$$
+  step "sancheck: TSan (empty suppression list)"
+  make -C csrc -j"$JOBS" sancheck SAN=tsan
+else
+  rm -f /tmp/ptpu_tsan_probe.$$
+  step "sancheck: TSan SKIPPED (no usable libtsan on this machine)"
+fi
+
+step "ptpu_check: static analysis (abi / wire / stats / locks / nullcheck)"
+python3 tools/ptpu_check.py
+
+step "native selftests (uninstrumented)"
+make -C csrc -j"$JOBS" selftest
+
+printf '\nrun_checks: ALL GREEN\n'
