@@ -1,0 +1,875 @@
+// Implementation of the shared epoll network core (see ptpu_net.h for
+// the threading contract). Compiled into BOTH shipping .so artifacts
+// (csrc/Makefile links it next to each server TU) and single-TU
+//-included by the selftests.
+#include "ptpu_net.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <unordered_map>
+
+#include "ptpu_hmac.h"
+#include "ptpu_wire.h"
+
+namespace ptpu {
+namespace net {
+
+namespace {
+
+// One writev flushes up to this many queued reply buffers (well under
+// any IOV_MAX; more coalescing buys nothing once past a dozen).
+constexpr int kFlushIov = 16;
+constexpr int kEpollBatch = 128;
+constexpr size_t kReadChunk = 64 << 10;
+constexpr size_t kPoolCap = 8;  // pooled reply buffers kept per conn
+// only pool buffers up to this capacity: the steady-state reply sizes
+// (KBs..hundreds of KBs) reuse without allocation, while a one-off
+// multi-MB reply's buffer is freed on flush instead of being retained
+// per connection for the rest of its life (x kPoolCap x C10K conns)
+constexpr size_t kPoolMaxBufBytes = 1 << 20;
+
+bool SetNonBlocking(int fd) {
+  const int fl = ::fcntl(fd, F_GETFL, 0);
+  return fl >= 0 && ::fcntl(fd, F_SETFL, fl | O_NONBLOCK) == 0;
+}
+
+int64_t EnvI64(const char* name, int64_t dflt) {
+  const char* e = std::getenv(name);
+  if (!e || !*e) return dflt;
+  char* end = nullptr;
+  const long long v = std::strtoll(e, &end, 10);
+  return (end && *end == '\0') ? int64_t(v) : dflt;
+}
+
+}  // namespace
+
+Options OptionsFromEnv(Options base) {
+  base.event_threads =
+      int(EnvI64("PTPU_NET_THREADS", base.event_threads));
+  base.max_conns = EnvI64("PTPU_NET_MAX_CONNS", base.max_conns);
+  base.handshake_timeout_us =
+      EnvI64("PTPU_NET_HANDSHAKE_US", base.handshake_timeout_us);
+  base.idle_timeout_us = EnvI64("PTPU_NET_IDLE_US", base.idle_timeout_us);
+  base.sockbuf_bytes =
+      int(EnvI64("PTPU_NET_SOCKBUF", base.sockbuf_bytes));
+  base.max_out_bytes =
+      size_t(EnvI64("PTPU_NET_MAX_OUT", int64_t(base.max_out_bytes)));
+  return base;
+}
+
+// ---------------------------------------------------------------------------
+// EventLoop
+// ---------------------------------------------------------------------------
+
+class EventLoop {
+ public:
+  EventLoop(const Options& opt, const Callbacks& cbs, Stats* stats)
+      : opt_(opt), cbs_(cbs), stats_(stats) {}
+
+  ~EventLoop() {
+    if (ep_ >= 0) ::close(ep_);
+    if (wake_fd_ >= 0) ::close(wake_fd_);
+  }
+
+  bool Init() {
+    ep_ = ::epoll_create1(EPOLL_CLOEXEC);
+    wake_fd_ = ::eventfd(0, EFD_CLOEXEC | EFD_NONBLOCK);
+    if (ep_ < 0 || wake_fd_ < 0) return false;
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.ptr = nullptr;  // nullptr marks the wake eventfd
+    return ::epoll_ctl(ep_, EPOLL_CTL_ADD, wake_fd_, &ev) == 0;
+  }
+
+  void StartThread() {
+    th_ = std::thread([this] { Run(); });
+  }
+
+  void Join() {
+    if (th_.joinable()) th_.join();
+  }
+
+  // ---- cross-thread entry points (inbox + eventfd wake) ----
+
+  void PostAdopt(const ConnPtr& c) { Post(Task{Task::kAdopt, c}); }
+  void PostFlush(const ConnPtr& c) { Post(Task{Task::kFlush, c}); }
+  void PostClose(const ConnPtr& c) { Post(Task{Task::kClose, c}); }
+  void PostDrain() { Post(Task{Task::kDrain, nullptr}); }
+
+  bool IsOwnerThread() const {
+    return std::this_thread::get_id() == th_.get_id();
+  }
+
+  // Owner-thread send fast path: batch the flush for end-of-iteration
+  // instead of paying an eventfd syscall per reply.
+  void NoteLocalFlush(const ConnPtr& c) { local_flush_.push_back(c); }
+
+ private:
+  friend class Server;
+
+  struct Task {
+    enum Kind { kAdopt, kFlush, kClose, kDrain } kind;
+    ConnPtr conn;
+  };
+
+  void Post(Task t) {
+    {
+      std::lock_guard<std::mutex> g(inbox_mu_);
+      inbox_.push_back(std::move(t));
+    }
+    const uint64_t one = 1;
+    // a full eventfd counter (never at 1-per-post rates) still wakes
+    const ssize_t r = ::write(wake_fd_, &one, sizeof(one));
+    (void)r;
+  }
+
+  enum class CloseWhy { kAuto, kHandshakeTimeout, kIdle, kDrain };
+
+  void Run() {
+    std::vector<Task> tasks;
+    epoll_event evs[kEpollBatch];
+    for (;;) {
+      const int timeout_ms = ComputeTimeoutMs();
+      const int n = ::epoll_wait(ep_, evs, kEpollBatch, timeout_ms);
+      stats_->epoll_wakeups.Add(1);
+      if (n < 0 && errno != EINTR) break;  // epoll fd gone: bail
+      /* Clear the wake eventfd BEFORE swapping the inbox. The other
+       * order loses wakeups: a task posted between the swap and the
+       * read-clear has its eventfd signal consumed while the task
+       * itself is left stranded in the inbox, and the loop then
+       * blocks indefinitely in epoll_wait (reproduced: Drain() posted
+       * into exactly that window hung the selftest ~50% of runs).
+       * With clear-then-swap, any post the swap misses wrote the
+       * eventfd after our read, so the next epoll_wait wakes. */
+      {
+        uint64_t v;
+        const ssize_t r = ::read(wake_fd_, &v, sizeof(v));
+        (void)r;  // EAGAIN when nothing pending — fine
+      }
+      {
+        std::lock_guard<std::mutex> g(inbox_mu_);
+        tasks.swap(inbox_);
+      }
+      for (auto& t : tasks) RunTask(t);
+      tasks.clear();
+      for (int i = 0; i < std::max(n, 0); ++i) {
+        if (evs[i].data.ptr == nullptr) continue;  // wake eventfd
+        auto* c = static_cast<Conn*>(evs[i].data.ptr);
+        if (c->state_ == Conn::St::kClosed) continue;
+        if (evs[i].events & (EPOLLERR | EPOLLHUP)) {
+          CloseConn(c, CloseWhy::kAuto);
+          continue;
+        }
+        if (evs[i].events & EPOLLOUT) FlushConn(c);
+        if ((evs[i].events & EPOLLIN) && !draining_) HandleReadable(c);
+      }
+      ProcessDeferred();
+      CheckDeadlines();
+      for (auto& c : local_flush_)
+        if (c->state_ != Conn::St::kClosed) FlushConn(c.get());
+      local_flush_.clear();
+      graveyard_.clear();
+      if (draining_ && DrainTick()) break;
+    }
+  }
+
+  void RunTask(Task& t) {
+    switch (t.kind) {
+      case Task::kAdopt:
+        Adopt(t.conn);
+        break;
+      case Task::kFlush:
+        if (t.conn->state_ != Conn::St::kClosed) FlushConn(t.conn.get());
+        break;
+      case Task::kClose:
+        if (t.conn->state_ != Conn::St::kClosed)
+          CloseConn(t.conn.get(), CloseWhy::kAuto);
+        break;
+      case Task::kDrain:
+        draining_ = true;
+        drain_deadline_ = NowUs() + opt_.drain_timeout_us;
+        break;
+    }
+  }
+
+  void Adopt(const ConnPtr& c) {
+    c->loop_ = this;
+    c->state_ = Conn::St::kAwaitMac;
+    c->handshake_deadline_ = NowUs() + opt_.handshake_timeout_us;
+    ++awaiting_mac_;
+    // the acceptor already set O_NONBLOCK; re-assert it here so EVERY
+    // fd entering this epoll set is provably nonblocking (the `net`
+    // checker in tools/ptpu_check.py keys on this call)
+    SetNonBlocking(c->fd_);
+    // the nonce goes out through the normal (nonblocking) write path
+    std::random_device rd;
+    for (auto& b : c->nonce_) b = uint8_t(rd());
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.ptr = c.get();
+    if (::epoll_ctl(ep_, EPOLL_CTL_ADD, c->fd_, &ev) != 0) {
+      FinishClose(c.get());
+      return;
+    }
+    conns_.emplace(c->fd_, c);
+    {
+      std::lock_guard<std::mutex> g(c->omu_);
+      Conn::OutBuf ob;
+      ob.b.assign(c->nonce_, c->nonce_ + sizeof(c->nonce_));
+      c->outq_.push_back(std::move(ob));
+    }
+    FlushConn(c.get());
+  }
+
+  // ---------------------------------------------------------- reads
+
+  void HandleReadable(Conn* c) {
+    if (c->read_paused_) return;
+    if (opt_.idle_timeout_us > 0)
+      c->idle_deadline_ = NowUs() + opt_.idle_timeout_us;
+    // fairness budget: one firehose connection must not monopolize
+    // its event thread — level-triggered epoll re-delivers the rest
+    int64_t budget = 1 << 20;
+    while (budget > 0) {
+      if (c->in_.size() - c->in_tail_ < kReadChunk) {
+        if (c->in_head_ > 0) {  // compact before growing
+          std::memmove(c->in_.data(), c->in_.data() + c->in_head_,
+                       c->in_tail_ - c->in_head_);
+          c->in_tail_ -= c->in_head_;
+          c->in_head_ = 0;
+        }
+        if (c->in_.size() - c->in_tail_ < kReadChunk)
+          c->in_.resize(c->in_tail_ + kReadChunk);
+      }
+      const ssize_t r = ::read(c->fd_, c->in_.data() + c->in_tail_,
+                               c->in_.size() - c->in_tail_);
+      if (r == 0) {
+        CloseConn(c, CloseWhy::kAuto);
+        return;
+      }
+      if (r < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        if (errno == EINTR) continue;
+        CloseConn(c, CloseWhy::kAuto);
+        return;
+      }
+      c->in_tail_ += size_t(r);
+      budget -= r;
+      if (!ParseFrames(c)) return;  // closed (or paused) inside
+      if (c->read_paused_) return;
+    }
+    if (c->in_head_ == c->in_tail_) c->in_head_ = c->in_tail_ = 0;
+  }
+
+  // Dispatch every complete frame in the buffer. Returns false when
+  // the conn was closed.
+  bool ParseFrames(Conn* c) {
+    while (c->state_ != Conn::St::kClosed && !c->read_paused_) {
+      const size_t avail = c->in_tail_ - c->in_head_;
+      if (avail < 4) break;
+      const uint32_t n = GetU32(c->in_.data() + c->in_head_);
+      if (n > opt_.max_frame) {
+        if (cbs_.on_oversize) cbs_.on_oversize(c->shared_from_this());
+        CloseConn(c, CloseWhy::kAuto);
+        return false;
+      }
+      if (c->state_ == Conn::St::kAwaitMac && n != 32) {
+        // reject BEFORE buffering: a pre-auth client must not be able
+        // to demand a max_frame-sized allocation by claiming a huge
+        // handshake frame (the old blocking ServerHandshake checked
+        // the length before reading a byte of payload)
+        CloseConn(c, CloseWhy::kAuto);  // pre-open: handshake_fails
+        return false;
+      }
+      if (avail - 4 < n) {
+        // make room for the whole frame so the next reads can land
+        if (c->in_.size() - c->in_head_ < size_t(n) + 4) {
+          std::memmove(c->in_.data(), c->in_.data() + c->in_head_,
+                       avail);
+          c->in_tail_ = avail;
+          c->in_head_ = 0;
+          if (c->in_.size() < size_t(n) + 4)
+            c->in_.resize(size_t(n) + 4);
+        }
+        break;
+      }
+      const uint8_t* payload = c->in_.data() + c->in_head_ + 4;
+      if (c->state_ == Conn::St::kAwaitMac) {
+        if (!CheckMac(c, payload, n)) {
+          CloseConn(c, CloseWhy::kAuto);  // pre-open: handshake_fails
+          return false;
+        }
+        c->in_head_ += 4 + size_t(n);
+        continue;
+      }
+      if (!DispatchFrame(c, payload, n)) return false;
+      // eager flush: a reply this frame generated goes on the wire
+      // BEFORE the next queued frame is parsed, so a pipelined client
+      // overlaps its next request with this reply's transfer (the
+      // old thread-per-conn loop's write-after-gather timing; without
+      // this, deep pull pipelines stall ~14% of their throughput
+      // waiting for a whole batch of gathers to finish)
+      if (c->state_ != Conn::St::kClosed) {
+        bool have;
+        {
+          std::lock_guard<std::mutex> g(c->omu_);
+          have = !c->outq_.empty();
+        }
+        if (have) FlushConn(c);
+      }
+    }
+    if (c->in_head_ == c->in_tail_) c->in_head_ = c->in_tail_ = 0;
+    return true;
+  }
+
+  bool CheckMac(Conn* c, const uint8_t* mac, uint32_t n) {
+    if (n != 32) return false;
+    uint8_t want[32];
+    HmacSha256(
+        reinterpret_cast<const uint8_t*>(opt_.authkey.data()),
+        opt_.authkey.size(), c->nonce_, sizeof(c->nonce_), want);
+    uint8_t diff = 0;
+    for (int i = 0; i < 32; ++i) diff |= uint8_t(mac[i] ^ want[i]);
+    if (diff) return false;
+    c->state_ = Conn::St::kOpen;
+    c->handshake_deadline_ = 0;
+    --awaiting_mac_;
+    if (opt_.idle_timeout_us > 0)
+      c->idle_deadline_ = NowUs() + opt_.idle_timeout_us;
+    {
+      std::lock_guard<std::mutex> g(c->omu_);
+      Conn::OutBuf ob;
+      ob.b.assign(1, uint8_t(0x01));  // handshake ack byte
+      c->outq_.push_back(std::move(ob));
+    }
+    NoteLocalFlush(c->shared_from_this());
+    if (cbs_.on_open) cbs_.on_open(c->shared_from_this());
+    return true;
+  }
+
+  // One on_frame dispatch (first attempt or a kDefer retry). Returns
+  // false when the conn was closed.
+  bool DispatchFrame(Conn* c, const uint8_t* payload, uint32_t n) {
+    FrameResult r;
+    try {
+      r = cbs_.on_frame(c->shared_from_this(), payload, n);
+    } catch (...) {
+      // a hostile frame (e.g. bad_alloc building a near-max reply)
+      // must cost ONE connection, not the process — the same
+      // containment the old per-connection threads carried
+      CloseConn(c, CloseWhy::kAuto);
+      return false;
+    }
+    switch (r) {
+      case FrameResult::kOk:
+        c->in_head_ += 4 + size_t(n);
+        if (c->defer_since_) {  // deferred frame finally consumed
+          c->defer_since_ = 0;
+          DropDeferred(c);
+          ResumeReads(c);
+        }
+        return true;
+      case FrameResult::kClose:
+        CloseConn(c, CloseWhy::kAuto);
+        return false;
+      case FrameResult::kDefer:
+      default:
+        if (!c->defer_since_) {
+          c->defer_since_ = NowUs();
+          deferred_.push_back(c);
+        }
+        c->defer_retry_at_ = NowUs() + opt_.defer_retry_us;
+        PauseReads(c);
+        return true;
+    }
+  }
+
+  void DropDeferred(Conn* c) {
+    deferred_.erase(std::remove(deferred_.begin(), deferred_.end(), c),
+                    deferred_.end());
+  }
+
+  void PauseReads(Conn* c) {
+    if (c->read_paused_) return;
+    c->read_paused_ = true;
+    ArmEpoll(c);
+  }
+
+  void ResumeReads(Conn* c) {
+    if (!c->read_paused_) return;
+    c->read_paused_ = false;
+    ArmEpoll(c);
+  }
+
+  void ArmEpoll(Conn* c) {
+    epoll_event ev{};
+    ev.events = (c->read_paused_ ? 0u : unsigned(EPOLLIN)) |
+                (c->want_write_ ? unsigned(EPOLLOUT) : 0u);
+    ev.data.ptr = c;
+    ::epoll_ctl(ep_, EPOLL_CTL_MOD, c->fd_, &ev);
+  }
+
+  // --------------------------------------------------------- writes
+
+  void FlushConn(Conn* c) {
+    std::unique_lock<std::mutex> g(c->omu_);
+    c->flush_posted_ = false;
+    bool fatal = false;
+    while (!c->outq_.empty()) {
+      iovec iov[kFlushIov];
+      int cnt = 0;
+      for (auto it = c->outq_.begin();
+           it != c->outq_.end() && cnt < kFlushIov; ++it, ++cnt) {
+        iov[cnt].iov_base = it->b.data() + it->off;
+        iov[cnt].iov_len = it->b.size() - it->off;
+      }
+      const ssize_t w = ::writev(c->fd_, iov, cnt);
+      if (w < 0) {
+        if (errno == EINTR) continue;
+        if (errno != EAGAIN && errno != EWOULDBLOCK) fatal = true;
+        break;
+      }
+      size_t left = size_t(w);
+      c->out_bytes_ -= std::min(left, c->out_bytes_);
+      while (left > 0 && !c->outq_.empty()) {
+        Conn::OutBuf& ob = c->outq_.front();
+        const size_t rem = ob.b.size() - ob.off;
+        if (left >= rem) {
+          left -= rem;
+          if (c->pool_.size() < kPoolCap &&
+              ob.b.capacity() <= kPoolMaxBufBytes) {
+            ob.b.clear();
+            c->pool_.push_back(std::move(ob.b));
+          }
+          c->outq_.pop_front();
+        } else {
+          ob.off += left;
+          left = 0;
+        }
+      }
+    }
+    const bool pending = !c->outq_.empty();
+    g.unlock();
+    if (fatal) {
+      CloseConn(c, CloseWhy::kAuto);
+      return;
+    }
+    if (pending) {
+      stats_->partial_write_flushes.Add(1);
+      if (!c->want_write_) {
+        c->want_write_ = true;
+        ArmEpoll(c);
+      }
+    } else {
+      if (c->want_write_) {
+        c->want_write_ = false;
+        ArmEpoll(c);
+      }
+      if (draining_) CloseConn(c, CloseWhy::kDrain);
+    }
+  }
+
+  // ------------------------------------------------------ deadlines
+
+  // Deadline scan (handshake + idle): O(conns), but only on the scan
+  // cadence and only while a conn is mid-handshake or idle tracking
+  // is on — a steady-state open fleet pays nothing here.
+  void CheckDeadlines() {
+    if (conns_.empty()) return;
+    if (awaiting_mac_ == 0 && opt_.idle_timeout_us <= 0) return;
+    const int64_t now = NowUs();
+    if (now < next_scan_us_) return;
+    next_scan_us_ = now + ScanPeriodUs();
+    std::vector<Conn*> expired;
+    for (auto& kv : conns_) {
+      Conn* c = kv.second.get();
+      if (c->state_ == Conn::St::kAwaitMac &&
+          c->handshake_deadline_ > 0 && now >= c->handshake_deadline_) {
+        expired.push_back(c);
+      } else if (c->state_ == Conn::St::kOpen &&
+                 c->idle_deadline_ > 0 && now >= c->idle_deadline_ &&
+                 !c->defer_since_) {
+        // a conn still draining queued replies (slow reader mid
+        // transfer) or with a request handed off to the server's own
+        // pipeline (pending_work_: e.g. in the serving micro-batcher)
+        // is ACTIVE, not idle — cutting it would drop the reply
+        bool busy =
+            c->pending_work_.load(std::memory_order_relaxed) > 0;
+        if (!busy) {
+          std::lock_guard<std::mutex> g(c->omu_);
+          busy = !c->outq_.empty();
+        }
+        if (busy)
+          c->idle_deadline_ = now + opt_.idle_timeout_us;
+        else
+          expired.push_back(c);
+      }
+    }
+    for (Conn* c : expired)
+      CloseConn(c, c->state_ == Conn::St::kAwaitMac
+                       ? CloseWhy::kHandshakeTimeout
+                       : CloseWhy::kIdle);
+  }
+
+  // Deferred-frame retries run every loop iteration on their own fine
+  // deadline (defer_retry_us, default 500us) over the SMALL deferred_
+  // list — not gated behind the coarse deadline-scan cadence.
+  void ProcessDeferred() {
+    if (deferred_.empty()) return;
+    const int64_t now = NowUs();
+    std::vector<Conn*> retry;
+    for (Conn* c : deferred_)
+      if (now >= c->defer_retry_at_) retry.push_back(c);
+    for (Conn* c : retry) {
+      if (c->state_ != Conn::St::kOpen || !c->defer_since_) continue;
+      const size_t avail = c->in_tail_ - c->in_head_;
+      if (avail < 4) continue;  // defensive: defer always holds a frame
+      const uint32_t n = GetU32(c->in_.data() + c->in_head_);
+      c->read_paused_ = false;  // let DispatchFrame re-pause on kDefer
+      if (DispatchFrame(c, c->in_.data() + c->in_head_ + 4, n)) {
+        if (!c->read_paused_ && c->state_ == Conn::St::kOpen) {
+          ArmEpoll(c);
+          ParseFrames(c);  // consume any frames queued behind it
+        }
+      }
+    }
+  }
+
+  int64_t ScanPeriodUs() const {
+    int64_t p = 50 * 1000;
+    if (opt_.idle_timeout_us > 0)
+      p = std::min(p, std::max<int64_t>(opt_.idle_timeout_us / 4, 1000));
+    if (opt_.handshake_timeout_us > 0)
+      p = std::min(p, std::max<int64_t>(opt_.handshake_timeout_us / 4,
+                                        1000));
+    return p;
+  }
+
+  // O(1) in the connection count (plus the small deferred_ list): a
+  // steady-state fleet of open conns with idle tracking off blocks
+  // indefinitely and wakes purely on events.
+  int ComputeTimeoutMs() {
+    if (draining_) return 10;
+    int64_t next = INT64_MAX;
+    for (Conn* c : deferred_)
+      next = std::min(next, c->defer_retry_at_);
+    if (awaiting_mac_ > 0 ||
+        (opt_.idle_timeout_us > 0 && !conns_.empty()))
+      next = std::min(next, next_scan_us_);
+    if (next == INT64_MAX) return -1;
+    const int64_t us = std::max<int64_t>(next - NowUs(), 0);
+    return int(std::min<int64_t>((us + 999) / 1000, 1000));
+  }
+
+  // ---------------------------------------------------------- close
+
+  void CloseConn(Conn* c, CloseWhy why) {
+    if (c->state_ == Conn::St::kClosed) return;
+    if (why == CloseWhy::kHandshakeTimeout) {
+      stats_->handshake_timeouts.Add(1);
+    } else if (why == CloseWhy::kIdle) {
+      stats_->idle_closes.Add(1);
+    } else if (why == CloseWhy::kAuto &&
+               c->state_ == Conn::St::kAwaitMac) {
+      // any pre-open failure (bad MAC, wrong length, peer hangup)
+      // counts like the old blocking ServerHandshake() == false
+      stats_->handshake_fails.Add(1);
+    }
+    FinishClose(c);
+  }
+
+  void FinishClose(Conn* c) {
+    const bool was_open = c->state_ == Conn::St::kOpen;
+    if (c->state_ == Conn::St::kAwaitMac && awaiting_mac_ > 0)
+      --awaiting_mac_;
+    if (c->defer_since_) {
+      c->defer_since_ = 0;
+      DropDeferred(c);
+    }
+    c->state_ = Conn::St::kClosed;
+    {
+      std::lock_guard<std::mutex> g(c->omu_);
+      c->closed_ = true;
+      c->outq_.clear();
+      c->out_bytes_ = 0;
+    }
+    ::epoll_ctl(ep_, EPOLL_CTL_DEL, c->fd_, nullptr);
+    ::close(c->fd_);
+    stats_->active_conns.fetch_sub(1, std::memory_order_relaxed);
+    ConnPtr self;
+    auto it = conns_.find(c->fd_);
+    if (it != conns_.end()) {
+      // keep the object alive until the current event batch ends —
+      // epoll events already harvested may still point at it
+      self = it->second;
+      graveyard_.push_back(self);
+      conns_.erase(it);
+    } else {
+      self = c->shared_from_this();
+    }
+    c->fd_ = -1;
+    if (was_open && cbs_.on_close) cbs_.on_close(self);
+  }
+
+  // Returns true when the loop is fully drained and should exit.
+  bool DrainTick() {
+    const int64_t now = NowUs();
+    std::vector<Conn*> finish;
+    for (auto& kv : conns_) {
+      Conn* c = kv.second.get();
+      bool empty;
+      {
+        std::lock_guard<std::mutex> g(c->omu_);
+        empty = c->outq_.empty();
+      }
+      if (empty || now >= drain_deadline_) finish.push_back(c);
+    }
+    for (Conn* c : finish) CloseConn(c, CloseWhy::kDrain);
+    graveyard_.clear();
+    if (!conns_.empty() && now < drain_deadline_) return false;
+    auto remaining = conns_;
+    for (auto& kv : remaining) CloseConn(kv.second.get(), CloseWhy::kDrain);
+    graveyard_.clear();
+    conns_.clear();
+    return true;
+  }
+
+  const Options opt_;
+  const Callbacks cbs_;
+  Stats* stats_;
+  int ep_ = -1, wake_fd_ = -1;
+  std::thread th_;
+  std::mutex inbox_mu_;
+  std::vector<Task> inbox_;
+  std::unordered_map<int, ConnPtr> conns_;
+  std::vector<ConnPtr> graveyard_;
+  std::vector<ConnPtr> local_flush_;
+  std::vector<Conn*> deferred_;  // conns holding a kDefer'd frame
+  int64_t awaiting_mac_ = 0;     // conns still mid-handshake
+  bool draining_ = false;
+  int64_t drain_deadline_ = 0;
+  int64_t next_scan_us_ = 0;
+};
+
+// ---------------------------------------------------------------------------
+// Conn
+// ---------------------------------------------------------------------------
+
+bool Conn::SendPayload(std::vector<uint8_t>&& buf) {
+  if (buf.size() < 4) return false;
+  PutU32(buf.data(), uint32_t(buf.size() - 4));
+  EventLoop* loop = loop_;
+  bool post_remote = false, post_local = false, kill = false;
+  {
+    std::lock_guard<std::mutex> g(omu_);
+    if (closed_) return false;
+    if (max_out_bytes_ > 0 && out_bytes_ >= max_out_bytes_) {
+      // peer stopped reading: cut the connection instead of buffering
+      // its replies without bound (old SO_SNDTIMEO semantics). The
+      // check is >= BEFORE adding, so a single protocol-legal frame
+      // of any size (up to max_frame) always queues — the cap bounds
+      // ACCUMULATION across frames, never one reply.
+      closed_ = true;
+      outq_.clear();
+      out_bytes_ = 0;
+      kill = true;
+    } else {
+      out_bytes_ += buf.size();
+      OutBuf ob;
+      ob.b = std::move(buf);
+      outq_.push_back(std::move(ob));
+      if (!flush_posted_) {
+        flush_posted_ = true;
+        if (loop->IsOwnerThread())
+          post_local = true;
+        else
+          post_remote = true;
+      }
+    }
+  }
+  if (kill) {
+    loop->PostClose(shared_from_this());
+    return false;
+  }
+  if (post_local) loop->NoteLocalFlush(shared_from_this());
+  if (post_remote) loop->PostFlush(shared_from_this());
+  return true;
+}
+
+bool Conn::SendCopy(const uint8_t* payload, size_t n) {
+  std::vector<uint8_t> buf = AcquireBuf();
+  buf.resize(4 + n);
+  std::memcpy(buf.data() + 4, payload, n);
+  return SendPayload(std::move(buf));
+}
+
+std::vector<uint8_t> Conn::AcquireBuf() {
+  std::lock_guard<std::mutex> g(omu_);
+  if (!pool_.empty()) {
+    std::vector<uint8_t> b = std::move(pool_.back());
+    pool_.pop_back();
+    return b;
+  }
+  return {};
+}
+
+void Conn::Close() {
+  EventLoop* loop = loop_;
+  {
+    std::lock_guard<std::mutex> g(omu_);
+    if (closed_) return;
+  }
+  if (loop) loop->PostClose(shared_from_this());
+}
+
+int64_t Conn::deferred_us() const {
+  return defer_since_ ? NowUs() - defer_since_ : 0;
+}
+
+// ---------------------------------------------------------------------------
+// Server
+// ---------------------------------------------------------------------------
+
+Server::Server(const Options& opt, Callbacks cbs, Stats* stats)
+    : opt_(opt), cbs_(std::move(cbs)), stats_(stats) {
+  if (opt_.event_threads <= 0) {
+    const int hw = int(std::thread::hardware_concurrency());
+    opt_.event_threads = std::min(8, std::max(2, hw / 2));
+  }
+  if (opt_.max_conns <= 0) opt_.max_conns = 65536;
+}
+
+Server::~Server() { Stop(); }
+
+bool Server::Start(std::string* err) {
+  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) {
+    if (err) *err = "ptpu_net: socket() failed";
+    return false;
+  }
+  const int one = 1;
+  ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr =
+      htonl(opt_.loopback_only ? INADDR_LOOPBACK : INADDR_ANY);
+  addr.sin_port = htons(uint16_t(opt_.port));
+  if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(listen_fd_, opt_.listen_backlog) != 0) {
+    if (err)
+      *err = "ptpu_net: bind/listen on port " +
+             std::to_string(opt_.port) + " failed";
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+    return false;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
+  port_ = int(ntohs(addr.sin_port));
+
+  for (int i = 0; i < opt_.event_threads; ++i) {
+    auto loop = std::unique_ptr<EventLoop>(
+        new EventLoop(opt_, cbs_, stats_));
+    if (!loop->Init()) {
+      if (err) *err = "ptpu_net: epoll/eventfd setup failed";
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      loops_.clear();
+      return false;
+    }
+    loops_.push_back(std::move(loop));
+  }
+  for (auto& l : loops_) l->StartThread();
+  accept_thread_ = std::thread([this] { AcceptLoop(); });
+  return true;
+}
+
+void Server::AcceptLoop() {
+  for (;;) {
+    const int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) {
+      if (!stop_accept_.load() && AcceptErrnoIsTransient(errno)) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(5));
+        continue;
+      }
+      return;
+    }
+    if (stop_accept_.load()) {
+      ::close(fd);
+      return;
+    }
+    if (stats_->active_conns.load(std::memory_order_relaxed) >=
+        opt_.max_conns) {
+      // accept-time shedding: beyond the cap the kindest failure is
+      // an immediate close (clients see EOF before the nonce), not a
+      // half-served connection
+      stats_->conns_shed.Add(1);
+      ::close(fd);
+      continue;
+    }
+    if (!SetNonBlocking(fd)) {
+      ::close(fd);
+      continue;
+    }
+    stats_->conns_accepted.Add(1);
+    stats_->active_conns.fetch_add(1, std::memory_order_relaxed);
+    const int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    if (opt_.sockbuf_bytes > 0) {
+      ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &opt_.sockbuf_bytes,
+                   sizeof(opt_.sockbuf_bytes));
+      ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &opt_.sockbuf_bytes,
+                   sizeof(opt_.sockbuf_bytes));
+    }
+    auto conn = std::make_shared<Conn>();
+    conn->fd_ = fd;
+    conn->max_out_bytes_ = opt_.max_out_bytes;
+    conn->loop_ = loops_[next_loop_].get();
+    loops_[next_loop_]->PostAdopt(conn);
+    next_loop_ = (next_loop_ + 1) % loops_.size();
+  }
+}
+
+void Server::StopAccepting() {
+  if (stop_accept_.exchange(true)) return;
+  // shutdown() wakes the blocked accept() but keeps the fd alive;
+  // closing before the join would race the accept thread's read of
+  // listen_fd_ and invite fd-number reuse (TSan-caught in the old
+  // per-server loops)
+  if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+  if (accept_thread_.joinable()) accept_thread_.join();
+  if (listen_fd_ >= 0) {
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
+}
+
+void Server::Drain() {
+  if (drained_.exchange(true)) return;
+  for (auto& l : loops_) l->PostDrain();
+  for (auto& l : loops_) l->Join();
+  loops_.clear();
+}
+
+void Server::Stop() {
+  StopAccepting();
+  Drain();
+}
+
+}  // namespace net
+}  // namespace ptpu

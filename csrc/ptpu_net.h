@@ -1,0 +1,237 @@
+// ptpu_net — the shared event-driven network core under BOTH native
+// servers (csrc/ptpu_ps_server.cc data plane, csrc/ptpu_serving.cc
+// inference runtime). Reference counterpart: the brpc event-dispatcher
+// + Socket layer every distributed service in the upstream project
+// rides (PAPER.md §1 services rows) — rebuilt here as one epoll core
+// so C10K-scale connection counts stop costing one std::thread each.
+//
+// Shape:
+//   * 1 blocking acceptor thread + N event threads, each owning a
+//     private epoll set; accepted connections are assigned round-robin
+//     and then touched ONLY by their owner loop (no cross-thread
+//     socket reads, no per-connection locks on the read path).
+//   * Per-connection state machine speaking the existing u32-LE frame
+//     protocol (ptpu_wire.h) and the HMAC-SHA256 nonce handshake
+//     (ptpu_hmac.h): nonblocking partial reads accumulate into a
+//     per-conn buffer; complete frames dispatch to the server's
+//     frame handler; replies queue on the conn and flush with one
+//     writev per wakeup (several replies coalesce into one syscall).
+//   * Foreign-thread replies (the serving micro-batcher finishing a
+//     batch on an instance worker) enqueue under the conn's out-lock
+//     and wake the owner loop over an eventfd — workers never block
+//     on a slow client's socket.
+//   * Deadlines: a handshake that does not complete within
+//     handshake_timeout_us is cut (slow-loris shedding); idle
+//     connections close after idle_timeout_us (0 = never). A
+//     max-conns cap sheds at accept time. Stop() drains gracefully:
+//     stop accepting -> flush queued replies -> close.
+//
+// Threading contract (TSan-verified by csrc/ptpu_net_selftest.cc):
+// everything per-connection except {outq_, pool_, closed_,
+// flush_posted_} is owner-loop-only; those four are guarded by omu_.
+// The frame handler runs on the owner loop; Conn::SendPayload /
+// SendCopy / AcquireBuf / Close are safe from any thread.
+#ifndef PTPU_NET_H_
+#define PTPU_NET_H_
+
+#include <atomic>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "ptpu_stats.h"
+
+namespace ptpu {
+namespace net {
+
+// Net-core counters, embedded in each server's stats block and
+// rendered into its stats_json (twin names documented in
+// tools/ptpu_check.py PS_SERVER_C_ONLY).
+struct Stats {
+  Counter conns_accepted, conns_shed, handshake_fails,
+      handshake_timeouts, idle_closes, epoll_wakeups,
+      partial_write_flushes;
+  std::atomic<int64_t> active_conns{0};
+
+  void Reset() {
+    conns_accepted.Reset();
+    conns_shed.Reset();
+    handshake_fails.Reset();
+    handshake_timeouts.Reset();
+    idle_closes.Reset();
+    epoll_wakeups.Reset();
+    partial_write_flushes.Reset();
+    // active_conns is a live gauge, not a counter: reset must not
+    // forget currently-open connections
+  }
+};
+
+struct Options {
+  int port = 0;                 // 0 = pick a free one
+  bool loopback_only = true;
+  std::string authkey;
+  int event_threads = 0;        // <= 0: min(8, max(2, hw/2))
+  int64_t max_conns = 0;        // <= 0: 65536; above it, accept+close
+  int64_t handshake_timeout_us = 5 * 1000 * 1000;
+  int64_t idle_timeout_us = 0;  // 0 = never idle-close
+  int64_t defer_retry_us = 500; // kDefer re-dispatch cadence
+  int64_t drain_timeout_us = 5 * 1000 * 1000;
+  uint32_t max_frame = 1u << 30;
+  int listen_backlog = 512;
+  int sockbuf_bytes = 4 << 20;  // SO_SNDBUF/SO_RCVBUF (<=0: kernel)
+  // Per-connection cap on queued unsent reply bytes: a client that
+  // stops READING must not grow server memory without bound (the
+  // epoll-core replacement for the old SO_SNDTIMEO conn-break) —
+  // past the cap the connection is closed.
+  size_t max_out_bytes = 64u << 20;
+};
+
+// Apply the PTPU_NET_* env knobs on top of `base` (both servers call
+// this so one tuning story covers them): PTPU_NET_THREADS,
+// PTPU_NET_MAX_CONNS, PTPU_NET_HANDSHAKE_US, PTPU_NET_IDLE_US,
+// PTPU_NET_SOCKBUF, PTPU_NET_MAX_OUT (the per-connection queued-reply
+// byte cap that cuts slow readers). Unset/invalid vars keep the base
+// value.
+Options OptionsFromEnv(Options base);
+
+// Frame-handler verdict for one dispatched frame.
+enum class FrameResult {
+  kOk,     // frame consumed; keep parsing
+  kClose,  // close the connection (protocol violation / hangup)
+  kDefer,  // keep THIS frame unconsumed and re-dispatch it after
+           // defer_retry_us; reads from this conn pause meanwhile
+           // (bounded backpressure without blocking the event thread)
+};
+
+class EventLoop;
+class Server;
+
+class Conn : public std::enable_shared_from_this<Conn> {
+ public:
+  // Queue one frame for sending: buf = [4 reserved bytes][payload];
+  // the u32-LE length prefix is written here. Thread-safe. Returns
+  // false once the connection is closed (the buffer is dropped).
+  bool SendPayload(std::vector<uint8_t>&& buf);
+  // Convenience copy form for small frames (errors, acks, meta).
+  bool SendCopy(const uint8_t* payload, size_t n);
+  // Pooled reply buffer (size 0, capacity reused across frames on
+  // this conn — steady-state replies never reallocate). Thread-safe.
+  std::vector<uint8_t> AcquireBuf();
+  // Request an asynchronous close from any thread.
+  void Close();
+  // Microseconds the currently-dispatched frame has been deferred
+  // (0 on first dispatch) — handlers budget their kDefer retries
+  // against this. Owner-loop only (valid inside the frame handler).
+  int64_t deferred_us() const;
+
+  // Count of requests this connection has in flight OUTSIDE the net
+  // core (e.g. queued in the serving micro-batcher): while nonzero
+  // the idle timeout treats the conn as active even though no bytes
+  // are moving. Thread-safe; the server pairs +1 on handoff with -1
+  // when the reply (or its error) is queued.
+  void NotePending(int64_t delta) {
+    pending_work_.fetch_add(delta, std::memory_order_relaxed);
+  }
+
+  // Per-connection server state (owned by the server's callbacks:
+  // allocate in on_open, free in on_close).
+  void* user = nullptr;
+
+ private:
+  friend class EventLoop;
+  friend class Server;
+
+  struct OutBuf {
+    std::vector<uint8_t> b;
+    size_t off = 0;
+  };
+
+  // ---- owner-loop state (never touched by other threads) ----
+  int fd_ = -1;
+  EventLoop* loop_ = nullptr;
+  enum class St { kAwaitMac, kOpen, kClosed };
+  St state_ = St::kAwaitMac;
+  uint8_t nonce_[16] = {0};
+  std::vector<uint8_t> in_;
+  size_t in_head_ = 0, in_tail_ = 0;
+  bool want_write_ = false;     // EPOLLOUT armed
+  bool read_paused_ = false;    // EPOLLIN disarmed (kDefer)
+  int64_t handshake_deadline_ = 0;
+  int64_t idle_deadline_ = 0;   // 0 = none
+  int64_t defer_since_ = 0;     // 0 = not deferring
+  int64_t defer_retry_at_ = 0;
+  std::atomic<int64_t> pending_work_{0};  // see NotePending
+
+  // ---- shared state (guarded by omu_) ----
+  std::mutex omu_;
+  std::deque<OutBuf> outq_;
+  std::vector<std::vector<uint8_t>> pool_;
+  size_t out_bytes_ = 0;         // queued unsent bytes
+  size_t max_out_bytes_ = 0;     // set at accept from Options
+  bool closed_ = false;
+  bool flush_posted_ = false;
+};
+
+using ConnPtr = std::shared_ptr<Conn>;
+
+struct Callbacks {
+  // Handshake completed; runs on the owner loop. Optional.
+  std::function<void(const ConnPtr&)> on_open;
+  // Connection fully closed (fires exactly once); owner loop. Free
+  // conn->user here. Optional.
+  std::function<void(const ConnPtr&)> on_close;
+  // One complete frame (payload WITHOUT the 4-byte length prefix).
+  // Runs on the owner loop; must not block.
+  std::function<FrameResult(const ConnPtr&, const uint8_t*, uint32_t)>
+      on_frame;
+  // A frame length above max_frame arrived (the conn is closed right
+  // after) — servers count their proto_errors here. Optional.
+  std::function<void(const ConnPtr&)> on_oversize;
+};
+
+class Server {
+ public:
+  Server(const Options& opt, Callbacks cbs, Stats* stats);
+  ~Server();  // Stop()
+
+  // Bind + listen + start the acceptor and event threads. Returns
+  // false with *err set on failure (nothing keeps running).
+  bool Start(std::string* err);
+  int port() const { return port_; }
+
+  // Graceful stop, in two callable halves so servers can quiesce
+  // their own pipelines in between (serving: stop accepting, drain
+  // the micro-batcher so in-flight requests still answer, THEN flush
+  // + close): StopAccepting() wakes and joins the acceptor;
+  // Drain() flushes every conn's queued replies (bounded by
+  // drain_timeout_us), closes, and joins the event threads.
+  void StopAccepting();
+  void Drain();
+  void Stop();  // StopAccepting(); Drain();
+
+ private:
+  friend class EventLoop;
+
+  void AcceptLoop();
+
+  Options opt_;
+  Callbacks cbs_;
+  Stats* stats_;
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::atomic<bool> stop_accept_{false};
+  std::atomic<bool> drained_{false};
+  std::thread accept_thread_;
+  std::vector<std::unique_ptr<EventLoop>> loops_;
+  size_t next_loop_ = 0;
+};
+
+}  // namespace net
+}  // namespace ptpu
+
+#endif  // PTPU_NET_H_

@@ -1,0 +1,495 @@
+// Native unit tests for the shared epoll event core (ptpu_net.{h,cc})
+// — the cc_test analogue, same harness idiom as the other selftests
+// (plain asserts, exit 0 = pass; run by `make selftest` and both
+// sancheck legs; wrapped by tests/test_native_selftest.py).
+//
+// Covered: echo round trip over the HMAC handshake, partial frames at
+// EVERY byte split point, handshake reject + slow-loris handshake
+// timeout, idle-connection close, max-conns accept-time shedding,
+// 1k-connection churn with exact counters, foreign-thread replies
+// (the serving batcher pattern: handler parks the frame, a worker
+// thread answers through the eventfd wakeup), kDefer backpressure
+// re-dispatch, partial-write flushing of a multi-MB reply through a
+// tiny socket buffer, and graceful-drain ordering (queued reply is
+// flushed before the close).
+#include "ptpu_net.cc"
+
+// asserts ARE the test — never compile them out
+#undef NDEBUG
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+
+#include <cassert>
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+using ptpu::HmacSha256;
+using ptpu::PutU32;
+using ptpu::ReadExact;
+using ptpu::WriteExact;
+using ptpu::net::Callbacks;
+using ptpu::net::ConnPtr;
+using ptpu::net::FrameResult;
+using ptpu::net::Options;
+using ptpu::net::Server;
+using ptpu::net::Stats;
+
+namespace {
+
+// ------------------------------------------------------ client side
+
+int dial(int port) {
+  const int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  assert(fd >= 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(uint16_t(port));
+  assert(::connect(fd, reinterpret_cast<sockaddr *>(&addr),
+                   sizeof(addr)) == 0);
+  return fd;
+}
+
+bool client_handshake(int fd, const std::string &key) {
+  uint8_t nonce[16];
+  if (!ReadExact(fd, nonce, 16)) return false;
+  uint8_t mac[32];
+  HmacSha256(reinterpret_cast<const uint8_t *>(key.data()), key.size(),
+             nonce, 16, mac);
+  uint8_t frame[36];
+  PutU32(frame, 32);
+  std::memcpy(frame + 4, mac, 32);
+  if (!WriteExact(fd, frame, 36)) return false;
+  uint8_t ok = 0;
+  return ReadExact(fd, &ok, 1) && ok == 0x01;
+}
+
+void send_frame(int fd, const std::vector<uint8_t> &payload) {
+  uint8_t lenb[4];
+  PutU32(lenb, uint32_t(payload.size()));
+  assert(WriteExact(fd, lenb, 4));
+  assert(WriteExact(fd, payload.data(), payload.size()));
+}
+
+bool recv_frame(int fd, std::vector<uint8_t> *out) {
+  uint8_t lenb[4];
+  if (!ReadExact(fd, lenb, 4)) return false;
+  out->resize(ptpu::GetU32(lenb));
+  return out->empty() || ReadExact(fd, out->data(), out->size());
+}
+
+// ------------------------------------------------------ echo server
+
+struct EchoServer {
+  Stats stats;
+  std::unique_ptr<Server> srv;
+  // delayed-reply machinery (the serving-batcher pattern): frames
+  // whose first byte is 'D' park here and a worker thread answers
+  std::mutex dmu;
+  std::condition_variable dcv;
+  std::vector<std::pair<ConnPtr, std::vector<uint8_t>>> delayed;
+  bool dstop = false;
+  std::thread dworker;
+  // kDefer exercise: frames leading with 'R' defer until they have
+  // been deferred at least defer_min_us
+  int64_t defer_min_us = 0;
+  std::atomic<uint64_t> frames{0};
+
+  explicit EchoServer(Options opt) {
+    Callbacks cbs;
+    cbs.on_frame = [this](const ConnPtr &c, const uint8_t *p,
+                          uint32_t n) {
+      if (n > 0 && p[0] == 'R' && c->deferred_us() < defer_min_us)
+        return FrameResult::kDefer;
+      frames.fetch_add(1, std::memory_order_relaxed);
+      if (n > 0 && p[0] == 'D') {
+        std::lock_guard<std::mutex> g(dmu);
+        delayed.emplace_back(c, std::vector<uint8_t>(p, p + n));
+        dcv.notify_one();
+        return FrameResult::kOk;
+      }
+      if (n > 0 && p[0] == 'X') return FrameResult::kClose;
+      return c->SendCopy(p, n) ? FrameResult::kOk : FrameResult::kClose;
+    };
+    srv.reset(new Server(opt, std::move(cbs), &stats));
+    std::string err;
+    if (!srv->Start(&err)) {
+      std::fprintf(stderr, "start failed: %s\n", err.c_str());
+      assert(false);
+    }
+    dworker = std::thread([this] {
+      std::unique_lock<std::mutex> l(dmu);
+      for (;;) {
+        dcv.wait(l, [this] { return dstop || !delayed.empty(); });
+        if (delayed.empty() && dstop) return;
+        auto item = std::move(delayed.back());
+        delayed.pop_back();
+        l.unlock();
+        // foreign-thread reply: exercises the eventfd wakeup path
+        std::this_thread::sleep_for(std::chrono::milliseconds(20));
+        item.first->SendCopy(item.second.data(), item.second.size());
+        l.lock();
+      }
+    });
+  }
+
+  ~EchoServer() {
+    StopWorker();
+    srv.reset();
+  }
+
+  void StopWorker() {
+    {
+      std::lock_guard<std::mutex> g(dmu);
+      dstop = true;
+    }
+    dcv.notify_all();
+    if (dworker.joinable()) dworker.join();
+  }
+};
+
+Options base_opts(const char *key) {
+  Options o;
+  o.authkey = key;
+  o.event_threads = 2;
+  return o;
+}
+
+// ------------------------------------------------------------ tests
+
+void test_echo_round_trip_and_reject() {
+  EchoServer es(base_opts("net-key"));
+  const int port = es.srv->port();
+
+  {  // wrong key is rejected and counted
+    const int fd = dial(port);
+    assert(!client_handshake(fd, "wrong"));
+    ::close(fd);
+  }
+  const int fd = dial(port);
+  assert(client_handshake(fd, "net-key"));
+  std::vector<uint8_t> msg = {'h', 'e', 'l', 'l', 'o'};
+  send_frame(fd, msg);
+  std::vector<uint8_t> rep;
+  assert(recv_frame(fd, &rep));
+  assert(rep == msg);
+  // several pipelined frames come back in order (writev batching)
+  for (uint8_t i = 0; i < 10; ++i) send_frame(fd, {i, 'p'});
+  for (uint8_t i = 0; i < 10; ++i) {
+    assert(recv_frame(fd, &rep));
+    assert(rep.size() == 2 && rep[0] == i);
+  }
+  // zero-length frame echoes as zero-length
+  send_frame(fd, {});
+  assert(recv_frame(fd, &rep) && rep.empty());
+  ::close(fd);
+  assert(es.stats.handshake_fails.Get() == 1);
+  assert(es.stats.conns_accepted.Get() == 2);
+}
+
+void test_partial_frames_every_split() {
+  EchoServer es(base_opts("k"));
+  const int fd = dial(es.srv->port());
+  assert(client_handshake(fd, "k"));
+  // a 13-byte payload framed to 17 wire bytes, sent with a flush
+  // after EVERY byte — the state machine must reassemble regardless
+  // of where the kernel delivers the split
+  std::vector<uint8_t> payload;
+  for (int i = 0; i < 13; ++i) payload.push_back(uint8_t('a' + i));
+  std::vector<uint8_t> wire(4 + payload.size());
+  PutU32(wire.data(), uint32_t(payload.size()));
+  std::memcpy(wire.data() + 4, payload.data(), payload.size());
+  for (size_t cut = 0; cut < wire.size(); ++cut) {
+    for (size_t i = 0; i < wire.size(); ++i) {
+      assert(WriteExact(fd, wire.data() + i, 1));
+      if (i == cut)  // linger mid-frame to force a short read
+        std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+    std::vector<uint8_t> rep;
+    assert(recv_frame(fd, &rep));
+    assert(rep == payload);
+  }
+  // the MAC handshake itself is framed: replay it byte-by-byte too
+  const int fd2 = dial(es.srv->port());
+  uint8_t nonce[16];
+  assert(ReadExact(fd2, nonce, 16));
+  uint8_t mac[32];
+  HmacSha256(reinterpret_cast<const uint8_t *>("k"), 1, nonce, 16, mac);
+  uint8_t hs[36];
+  PutU32(hs, 32);
+  std::memcpy(hs + 4, mac, 32);
+  for (size_t i = 0; i < sizeof(hs); ++i) {
+    assert(WriteExact(fd2, hs + i, 1));
+    if (i % 7 == 0)
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+  uint8_t ok = 0;
+  assert(ReadExact(fd2, &ok, 1) && ok == 0x01);
+  ::close(fd);
+  ::close(fd2);
+}
+
+void test_handshake_timeout_slow_loris() {
+  Options o = base_opts("k");
+  o.handshake_timeout_us = 60 * 1000;  // 60ms
+  EchoServer es(o);
+  const int fd = dial(es.srv->port());
+  uint8_t nonce[16];
+  assert(ReadExact(fd, nonce, 16));
+  // ... and then send nothing: the server must cut us loose
+  uint8_t b;
+  const int64_t t0 = ptpu::NowUs();
+  const bool eof = ::read(fd, &b, 1) == 0;  // blocks until server closes
+  assert(eof);
+  const int64_t waited = ptpu::NowUs() - t0;
+  assert(waited < 5 * 1000 * 1000);  // not the 5s default — OUR deadline
+  ::close(fd);
+  assert(es.stats.handshake_timeouts.Get() == 1);
+  assert(es.stats.handshake_fails.Get() == 0);  // timeout, not reject
+}
+
+void test_idle_timeout() {
+  Options o = base_opts("k");
+  o.idle_timeout_us = 80 * 1000;  // 80ms
+  EchoServer es(o);
+  const int fd = dial(es.srv->port());
+  assert(client_handshake(fd, "k"));
+  send_frame(fd, {'a'});
+  std::vector<uint8_t> rep;
+  assert(recv_frame(fd, &rep));
+  uint8_t b;
+  assert(::read(fd, &b, 1) == 0);  // idle-closed
+  ::close(fd);
+  assert(es.stats.idle_closes.Get() == 1);
+}
+
+void test_max_conns_shed() {
+  Options o = base_opts("k");
+  o.max_conns = 3;
+  EchoServer es(o);
+  std::vector<int> kept;
+  int shed_seen = 0;
+  for (int i = 0; i < 6; ++i) {
+    const int fd = dial(es.srv->port());
+    // a kept conn sends its nonce; a shed conn sees immediate EOF
+    uint8_t nonce[16];
+    if (ReadExact(fd, nonce, 16)) {
+      uint8_t mac[32];
+      HmacSha256(reinterpret_cast<const uint8_t *>("k"), 1, nonce, 16,
+                 mac);
+      uint8_t hs[36];
+      PutU32(hs, 32);
+      std::memcpy(hs + 4, mac, 32);
+      assert(WriteExact(fd, hs, 36));
+      uint8_t ok;
+      assert(ReadExact(fd, &ok, 1) && ok == 0x01);
+      kept.push_back(fd);
+    } else {
+      ++shed_seen;
+      ::close(fd);
+    }
+  }
+  assert(kept.size() == 3 && shed_seen == 3);
+  assert(es.stats.conns_shed.Get() == 3);
+  assert(es.stats.conns_accepted.Get() == 3);
+  assert(es.stats.active_conns.load() == 3);
+  for (int fd : kept) ::close(fd);
+}
+
+void test_conn_churn_1k() {
+  EchoServer es(base_opts("churn"));
+  const int port = es.srv->port();
+  constexpr int kThreads = 4, kPer = 250;
+  std::vector<std::thread> ts;
+  std::atomic<int> ok_count{0};
+  for (int t = 0; t < kThreads; ++t)
+    ts.emplace_back([&, t] {
+      for (int i = 0; i < kPer; ++i) {
+        const int fd = dial(port);
+        assert(client_handshake(fd, "churn"));
+        std::vector<uint8_t> msg = {uint8_t(t), uint8_t(i), uint8_t(i >> 8)};
+        send_frame(fd, msg);
+        std::vector<uint8_t> rep;
+        assert(recv_frame(fd, &rep));
+        assert(rep == msg);
+        ::close(fd);
+        ok_count.fetch_add(1);
+      }
+    });
+  for (auto &th : ts) th.join();
+  assert(ok_count.load() == kThreads * kPer);
+  assert(es.stats.conns_accepted.Get() == kThreads * kPer);
+  assert(es.frames.load() == kThreads * kPer);
+  assert(es.stats.conns_shed.Get() == 0);
+  assert(es.stats.handshake_fails.Get() == 0);
+  // every churned conn eventually closes out of the gauge
+  const int64_t t0 = ptpu::NowUs();
+  while (es.stats.active_conns.load() != 0 &&
+         ptpu::NowUs() - t0 < 5 * 1000 * 1000)
+    std::this_thread::sleep_for(std::chrono::milliseconds(2));
+  assert(es.stats.active_conns.load() == 0);
+}
+
+void test_foreign_thread_reply_and_defer() {
+  Options o = base_opts("k");
+  EchoServer es(o);
+  es.defer_min_us = 5 * 1000;  // 'R' frames defer ~5ms before serving
+  const int fd = dial(es.srv->port());
+  assert(client_handshake(fd, "k"));
+  // delayed echo: the reply comes from the worker thread through the
+  // owner loop's eventfd wakeup
+  send_frame(fd, {'D', '1'});
+  std::vector<uint8_t> rep;
+  assert(recv_frame(fd, &rep));
+  assert((rep == std::vector<uint8_t>{'D', '1'}));
+  // deferred frame: first dispatch returns kDefer; the loop pauses
+  // reads, re-dispatches on the timer, and the frame QUEUED BEHIND it
+  // is answered after it (ordering preserved across the defer)
+  const int64_t t0 = ptpu::NowUs();
+  send_frame(fd, {'R', 'x'});
+  send_frame(fd, {'n', 'x', 't'});
+  assert(recv_frame(fd, &rep));
+  assert(rep.size() == 2 && rep[0] == 'R');
+  assert(ptpu::NowUs() - t0 >= 5 * 1000);  // honored the defer budget
+  assert(recv_frame(fd, &rep));
+  assert(rep.size() == 3 && rep[0] == 'n');
+  ::close(fd);
+}
+
+void test_partial_write_flush_big_reply() {
+  Options o = base_opts("k");
+  o.sockbuf_bytes = 32 << 10;  // tiny buffers force short writev()s
+  EchoServer es(o);
+  const int fd = dial(es.srv->port());
+  assert(client_handshake(fd, "k"));
+  std::vector<uint8_t> big(3 << 20);
+  for (size_t i = 0; i < big.size(); ++i) big[i] = uint8_t(i * 31 + 7);
+  send_frame(fd, big);
+  // read the echo back SLOWLY at first so the server's flush can
+  // never complete in one writev
+  std::this_thread::sleep_for(std::chrono::milliseconds(30));
+  std::vector<uint8_t> rep;
+  assert(recv_frame(fd, &rep));
+  assert(rep == big);
+  assert(es.stats.partial_write_flushes.Get() > 0);
+  ::close(fd);
+}
+
+void test_graceful_drain_flushes_in_flight() {
+  // serving-shaped shutdown: request parked with a worker, stop
+  // ordering is StopAccepting -> quiesce workers (reply queued) ->
+  // Drain. The client must still read its reply, then see EOF.
+  auto *es = new EchoServer(base_opts("k"));
+  const int port = es->srv->port();
+  const int fd = dial(port);
+  assert(client_handshake(fd, "k"));
+  send_frame(fd, {'D', 'q'});
+  // wait until the handler parked the request with the worker
+  {
+    std::unique_lock<std::mutex> l(es->dmu);
+    while (es->delayed.empty() &&
+           es->frames.load(std::memory_order_relaxed) == 0) {
+      l.unlock();
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+      l.lock();
+    }
+  }
+  es->srv->StopAccepting();
+  es->StopWorker();   // worker sends the queued reply before exiting
+  es->srv->Drain();   // flush that reply, then close
+  std::vector<uint8_t> rep;
+  assert(recv_frame(fd, &rep));  // in-flight request still answered
+  assert((rep == std::vector<uint8_t>{'D', 'q'}));
+  uint8_t b;
+  assert(::read(fd, &b, 1) == 0);  // ... and THEN the close
+  ::close(fd);
+  // accepting is over: new connects are refused or dropped
+  const int fd2 = ::socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(uint16_t(port));
+  if (::connect(fd2, reinterpret_cast<sockaddr *>(&addr),
+                sizeof(addr)) == 0) {
+    uint8_t nb[16];
+    assert(!ReadExact(fd2, nb, 16));  // no handshake from a dead server
+  }
+  ::close(fd2);
+  delete es;
+}
+
+void test_preauth_big_frame_rejected() {
+  // a pre-auth client claiming any non-32-byte handshake frame is cut
+  // IMMEDIATELY — before the core buffers a byte of it (a huge length
+  // claim must not become a pre-auth allocation)
+  EchoServer es(base_opts("k"));
+  const int fd = dial(es.srv->port());
+  uint8_t nonce[16];
+  assert(ReadExact(fd, nonce, 16));
+  uint8_t lenb[4];
+  PutU32(lenb, 64 << 20);  // "my MAC is 64MB"
+  const int64_t t0 = ptpu::NowUs();
+  assert(WriteExact(fd, lenb, 4));
+  uint8_t b;
+  assert(::read(fd, &b, 1) == 0);  // rejected on the LENGTH alone
+  assert(ptpu::NowUs() - t0 < 2 * 1000 * 1000);  // not via any timeout
+  ::close(fd);
+  assert(es.stats.handshake_fails.Get() == 1);
+  assert(es.stats.handshake_timeouts.Get() == 0);
+}
+
+void test_oversize_frame_closes() {
+  Options o = base_opts("k");
+  o.max_frame = 1 << 10;
+  std::atomic<int> oversize{0};
+  Stats stats;
+  Callbacks cbs;
+  cbs.on_frame = [](const ConnPtr &c, const uint8_t *p, uint32_t n) {
+    return c->SendCopy(p, n) ? FrameResult::kOk : FrameResult::kClose;
+  };
+  cbs.on_oversize = [&](const ConnPtr &) { oversize.fetch_add(1); };
+  Server srv(o, std::move(cbs), &stats);
+  std::string err;
+  assert(srv.Start(&err));
+  const int fd = dial(srv.port());
+  assert(client_handshake(fd, "k"));
+  uint8_t lenb[4];
+  PutU32(lenb, 1 << 20);  // claims a frame far over the cap
+  assert(WriteExact(fd, lenb, 4));
+  uint8_t b;
+  assert(::read(fd, &b, 1) == 0);  // server hangs up
+  ::close(fd);
+  assert(oversize.load() == 1);
+}
+
+}  // namespace
+
+// announce each test on stderr (unbuffered) BEFORE it runs — a hang
+// names its test instead of leaving a silent stuck binary
+#define RUN(t)                       \
+  do {                               \
+    std::fprintf(stderr, "  %s\n", #t); \
+    t();                             \
+  } while (0)
+
+int main() {
+  RUN(test_echo_round_trip_and_reject);
+  RUN(test_partial_frames_every_split);
+  RUN(test_handshake_timeout_slow_loris);
+  RUN(test_idle_timeout);
+  RUN(test_max_conns_shed);
+  RUN(test_conn_churn_1k);
+  RUN(test_foreign_thread_reply_and_defer);
+  RUN(test_partial_write_flush_big_reply);
+  RUN(test_graceful_drain_flushes_in_flight);
+  RUN(test_preauth_big_frame_rejected);
+  RUN(test_oversize_frame_closes);
+  std::printf("ptpu_net_selftest: all native net-core unit tests "
+              "passed\n");
+  return 0;
+}

@@ -2,6 +2,7 @@
 // the cc_test analogue (same harness idiom as ptpu_selftest.cc: plain
 // asserts, exit 0 = pass; wrapped by tests/test_native_selftest.py via
 // `make selftest`).
+#include "ptpu_net.cc"
 #include "ptpu_ps_server.cc"
 #include "ptpu_ps_table.cc"
 
@@ -15,6 +16,13 @@
 #include <random>
 #include <string>
 #include <thread>
+
+// the handshake/exact-IO helpers live in the shared headers now (the
+// server TU no longer re-exports them into its anonymous namespace)
+using ptpu::HmacSha256;
+using ptpu::ReadExact;
+using ptpu::Sha256;
+using ptpu::WriteExact;
 
 namespace {
 

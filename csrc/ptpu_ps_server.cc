@@ -3,11 +3,11 @@
 //
 // Reference counterpart: the brpc service loop of
 // distributed/service/brpc_ps_server.cc — request parsing, the table
-// gather/scatter, and the reply write all happen in C++ worker
-// threads; Python never touches a hot frame. The Python TableService
-// keeps the CONTROL plane (kv store, barriers, shuffle, heter calls)
-// on its multiprocessing.connection listener and advertises this
-// data-plane port for pull/push only.
+// gather/scatter, and the reply write all happen in C++ event threads;
+// Python never touches a hot frame. The Python TableService keeps the
+// CONTROL plane (kv store, barriers, shuffle, heter calls) on its
+// multiprocessing.connection listener and advertises this data-plane
+// port for pull/push only.
 //
 // Protocol (mirrors distributed/ps/wire.py fast frames):
 //   * connect: server sends a 16-byte random nonce; the client answers
@@ -19,19 +19,16 @@
 //     payload is exactly a wire.py fast frame: version byte, tag byte
 //     (0x50 PULL_REQ / 0x52 PUSH_REQ in; 0x51 PULL_REP / 0x53 OK /
 //     0x54 ERR out), fixed little-endian layout.
-//   * pull replies are gathered straight into the connection's reused
+//   * pull replies are gathered straight into a pooled per-connection
 //     reply buffer — zero per-frame allocation in steady state.
 //
-// Concurrency: one detached-joinable thread per accepted connection
-// (the brpc worker-pool analogue): a slow client stalls only its own
-// socket. Table access synchronizes inside ptpu_ps_table.cc (shared
-// lock pulls / exclusive pushes).
-
-#include <arpa/inet.h>
-#include <netinet/in.h>
-#include <netinet/tcp.h>
-#include <sys/socket.h>
-#include <unistd.h>
+// Concurrency: the shared epoll event core (csrc/ptpu_net.{h,cc}) —
+// 1 acceptor + N event threads; frame handlers run INLINE on the
+// event threads (a table gather is microseconds, never worth a hop).
+// Table access synchronizes inside ptpu_ps_table.cc (shared lock
+// pulls / exclusive pushes). The old thread-per-connection loop is
+// gone: thousands of idle or slow clients now cost file descriptors,
+// not threads (tools/ptpu_check.py's `net` checker keeps it that way).
 
 #include <algorithm>
 #include <atomic>
@@ -40,22 +37,15 @@
 #include <map>
 #include <memory>
 #include <mutex>
-#include <random>
 #include <string>
-#include <thread>
 #include <vector>
 
-#include "ptpu_hmac.h"
+#include "ptpu_net.h"
 #include "ptpu_ps_table.h"
 #include "ptpu_stats.h"
 #include "ptpu_wire.h"
 
 namespace {
-
-// SHA-256 + HMAC live in the shared csrc/ptpu_hmac.h (the serving
-// runtime's handshake uses the same MAC).
-using ptpu::HmacSha256;
-using ptpu::Sha256;
 
 // ---------------------------------------------------------------------------
 // Frame constants — keep in sync with distributed/ps/wire.py.
@@ -68,10 +58,6 @@ constexpr uint8_t kTagPushReq = 0x52;
 constexpr uint8_t kTagOk = 0x53;
 constexpr uint8_t kTagErr = 0x54;
 constexpr uint32_t kMaxFrame = 1u << 30;
-
-// exact socket I/O lives in the shared csrc/ptpu_wire.h
-using ptpu::ReadExact;
-using ptpu::WriteExact;
 
 // Wire-level counters for one exposed table (ptpu_stats.h relaxed
 // atomics; storage-level counters live inside the table itself).
@@ -92,13 +78,13 @@ struct TableWireStats {
 // Server-global wire counters + serve-latency histograms. Always-on:
 // a handful of relaxed fetch_adds and two NowUs reads per frame —
 // noise against the frame's own syscalls (bench-verified <3% on the
-// pipelined pull phase).
+// pipelined pull phase). Connection-lifecycle counters (accepts,
+// sheds, handshake outcomes, active gauge) live in the embedded
+// net-core stats block and render under the same "server" object.
 struct ServerStats {
   ptpu::Counter pull_ops, pull_rows, push_ops, push_rows, bytes_in,
-      bytes_out, err_frames, proto_errors, handshake_fails,
-      conns_accepted;
-  std::atomic<int64_t> conns_active{0};
-  ptpu::Histogram pull_us, push_us;  // frame-read -> reply-written
+      bytes_out, err_frames, proto_errors;
+  ptpu::Histogram pull_us, push_us;  // frame-read -> reply-queued
 
   void Reset() {
     pull_ops.Reset();
@@ -109,8 +95,6 @@ struct ServerStats {
     bytes_out.Reset();
     err_frames.Reset();
     proto_errors.Reset();
-    handshake_fails.Reset();
-    conns_accepted.Reset();
     pull_us.Reset();
     push_us.Reset();
   }
@@ -123,327 +107,224 @@ struct ShardEntry {
 };
 
 struct PsServer {
-  int listen_fd = -1;
-  int port = 0;
   std::string authkey;
-  std::atomic<bool> stop{false};
-  std::thread accept_thread;
-  std::mutex mu;  // guards tables + conn bookkeeping
+  int port = 0;
+  std::mutex mu;  // guards tables
   std::map<std::string, ShardEntry> tables;
   // per-table wire stats: pointers are handed to ShardEntry copies, so
   // entries are never erased (re-register reuses the slot)
   std::map<std::string, std::unique_ptr<TableWireStats>> table_stats;
   ServerStats stats;
-  std::vector<int> conn_fds;
-  std::vector<std::thread> conn_threads;
-  std::vector<std::thread::id> done_threads;  // finished, join pending
+  ptpu::net::Stats net;
+  std::unique_ptr<ptpu::net::Server> net_srv;
 
   ~PsServer() { Stop(); }
 
+  bool Start(int want_port, int loopback_only, std::string *err) {
+    ptpu::net::Options opt;
+    opt.port = want_port;
+    opt.loopback_only = loopback_only != 0;
+    opt.authkey = authkey;
+    opt.max_frame = kMaxFrame;
+    opt = ptpu::net::OptionsFromEnv(opt);
+    ptpu::net::Callbacks cbs;
+    cbs.on_frame = [this](const ptpu::net::ConnPtr &c,
+                          const uint8_t *p, uint32_t n) {
+      return OnFrame(c, p, n);
+    };
+    cbs.on_oversize = [this](const ptpu::net::ConnPtr &) {
+      stats.proto_errors.Add(1);
+    };
+    net_srv.reset(new ptpu::net::Server(opt, std::move(cbs), &net));
+    if (!net_srv->Start(err)) {
+      net_srv.reset();
+      return false;
+    }
+    port = net_srv->port();
+    return true;
+  }
+
   void Stop() {
-    if (stop.exchange(true)) return;
-    // shutdown() wakes the blocked accept() (EINVAL) but keeps the fd
-    // alive; closing or clearing listen_fd BEFORE the join would race
-    // the accept thread's concurrent read of it (TSan-caught in the
-    // serving twin of this loop) and invite fd-number reuse while
-    // accept() still holds the old value
-    if (listen_fd >= 0) ::shutdown(listen_fd, SHUT_RDWR);
-    {
-      std::lock_guard<std::mutex> g(mu);
-      for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
-    }
-    if (accept_thread.joinable()) accept_thread.join();
-    if (listen_fd >= 0) {
-      ::close(listen_fd);
-      listen_fd = -1;
-    }
-    std::vector<std::thread> ts;
-    {
-      std::lock_guard<std::mutex> g(mu);
-      ts.swap(conn_threads);
-    }
-    for (auto &t : ts)
-      if (t.joinable()) t.join();
-    {
-      std::lock_guard<std::mutex> g(mu);
-      for (int fd : conn_fds) ::close(fd);
-      conn_fds.clear();
-    }
+    if (!net_srv) return;
+    // graceful drain: stop accepting, flush queued replies, close
+    net_srv->Stop();
+    net_srv.reset();
   }
 
-  bool SendFrame(int fd, const uint8_t *payload, uint32_t n,
-                 std::vector<uint8_t> *buf) {
-    // one contiguous write: u32-LE length + payload (the payload is
-    // already in *buf with 4 bytes of headroom when buf != null)
-    if (buf) {
-      (*buf)[0] = uint8_t(n);
-      (*buf)[1] = uint8_t(n >> 8);
-      (*buf)[2] = uint8_t(n >> 16);
-      (*buf)[3] = uint8_t(n >> 24);
-      return WriteExact(fd, buf->data(), size_t(n) + 4);
-    }
-    uint8_t hdr[4] = {uint8_t(n), uint8_t(n >> 8), uint8_t(n >> 16),
-                      uint8_t(n >> 24)};
-    return WriteExact(fd, hdr, 4) && WriteExact(fd, payload, n);
-  }
-
-  bool SendErr(int fd, const std::string &msg) {
-    std::vector<uint8_t> f(4 + 2 + 4 + msg.size());
+  bool SendErr(const ptpu::net::ConnPtr &conn, const std::string &msg) {
+    std::vector<uint8_t> f = conn->AcquireBuf();
+    f.resize(4 + 2 + 4 + msg.size());
     f[4] = kWireVersion;
     f[5] = kTagErr;
-    const uint32_t n = uint32_t(msg.size());
-    f[6] = uint8_t(n);
-    f[7] = uint8_t(n >> 8);
-    f[8] = uint8_t(n >> 16);
-    f[9] = uint8_t(n >> 24);
+    ptpu::PutU32(f.data() + 6, uint32_t(msg.size()));
     std::memcpy(f.data() + 10, msg.data(), msg.size());
     stats.err_frames.Add(1);
     stats.bytes_out.Add(f.size());
-    return SendFrame(fd, nullptr, uint32_t(f.size() - 4), &f);
+    return conn->SendPayload(std::move(f));
   }
 
-
-  void Serve(int fd) {
-    std::vector<uint8_t> req;
-    std::vector<uint8_t> rep;  // reused: [4B length][frame payload]
-    std::vector<int64_t> local;
-    if (!ptpu::ServerHandshake(fd, authkey)) {
-      stats.handshake_fails.Add(1);
-      return;
-    }
-    // drop-the-connection protocol errors are counted before the
-    // return — the wire half of the Python plane's frame_errors
-    const auto proto_err = [this]() { stats.proto_errors.Add(1); };
-    for (;;) {
-      uint8_t lenb[4];
-      if (!ReadExact(fd, lenb, 4)) return;
-      const uint32_t n = uint32_t(lenb[0]) | uint32_t(lenb[1]) << 8 |
-                         uint32_t(lenb[2]) << 16 |
-                         uint32_t(lenb[3]) << 24;
-      if (n < 2 || n > kMaxFrame) return proto_err();
-      if (req.size() < n) req.resize(n);
-      if (!ReadExact(fd, req.data(), n)) return;
-      const int64_t t0 = ptpu::NowUs();
-      stats.bytes_in.Add(4 + uint64_t(n));
-      if (req[0] != kWireVersion) return proto_err();
-      const uint8_t tag = req[1];
-      if (tag != kTagPullReq && tag != kTagPushReq) return proto_err();
-      // [u8 tlen][table]
-      if (n < 3) return proto_err();
-      const uint8_t tlen = req[2];
-      size_t off = 3 + tlen;
-      if (n < off) return proto_err();
-      const std::string table(reinterpret_cast<char *>(req.data() + 3),
-                              tlen);
-      ShardEntry entry;
-      {
-        std::lock_guard<std::mutex> g(mu);
-        auto it = tables.find(table);
-        if (it == tables.end()) {
-          if (!SendErr(fd, "unknown table '" + table +
-                               "' on data plane"))
-            return;
-          continue;
-        }
-        entry = it->second;
-      }
-      entry.wire->bytes_in.Add(4 + uint64_t(n));
-      if (tag == kTagPullReq) {
-        // [u32 n][n x i64 ids]
-        if (n < off + 4) return proto_err();
-        uint32_t cnt;
-        std::memcpy(&cnt, req.data() + off, 4);
-        off += 4;
-        if (n != off + 8ull * cnt) return proto_err();
-        // bound the REPLY like the request: a small ids frame must not
-        // be able to demand a multi-GB gather allocation
-        if (10 + size_t(cnt) * size_t(ptpu_ps_table_dim(entry.table)) *
-                4 > kMaxFrame) {
-          if (!SendErr(fd, "pull reply would exceed frame limit"))
-            return;
-          continue;
-        }
-        // ids sit at 7+tlen into the frame — any alignment; every
-        // read goes through the unaligned-safe GetI64
-        const uint8_t *ids_b = req.data() + off;
-        const int64_t rows = ptpu_ps_table_rows(entry.table);
-        const int64_t dim = ptpu_ps_table_dim(entry.table);
-        const size_t row_b = size_t(dim) * 4;
-        const size_t body = size_t(cnt) * row_b;
-        // reply = length + header + gathered rows in the REUSED
-        // per-connection buffer, shipped with one write. (A
-        // row-pointer writev was tried first — 512 iovecs of 256B
-        // cost more in per-segment kernel overhead than the one
-        // 131KB gather memcpy saves.)
-        if (rep.size() < 14 + body) rep.resize(14 + body);
-        ptpu::PutU32(rep.data(), uint32_t(10 + body));
-        const uint32_t flen = uint32_t(10 + body);
-        rep[4] = kWireVersion;
-        rep[5] = kTagPullRep;
-        ptpu::PutU32(rep.data() + 6, cnt);
-        ptpu::PutU32(rep.data() + 10, uint32_t(dim));
-        const float *w = ptpu_ps_table_data(entry.table);
-        // gather straight into the reply as BYTES: the f32 rows start
-        // at +14, which is not 4-aligned, so a float* view would be UB
-        uint8_t *out = rep.data() + 14;
-        bool bad = false;
-        ptpu_ps_table_rdlock(entry.table);
-        for (uint32_t i = 0; i < cnt; ++i) {
-          const int64_t id = ptpu::GetI64(ids_b + 8 * i) - entry.lo;
-          if (id < 0 || id >= rows) {
-            bad = true;
-            break;
-          }
-          std::memcpy(out + size_t(i) * row_b, w + id * dim, row_b);
-        }
-        ptpu_ps_table_rdunlock(entry.table);
-        if (bad) {
-          if (!SendErr(fd, "pull id out of shard range")) return;
-          continue;
-        }
-        if (!WriteExact(fd, rep.data(), 4 + size_t(flen))) return;
-        ptpu_ps_table_note_pull(entry.table, int64_t(cnt));
-        stats.pull_ops.Add(1);
-        stats.pull_rows.Add(cnt);
-        stats.bytes_out.Add(4 + uint64_t(flen));
-        stats.pull_us.Observe(uint64_t(ptpu::NowUs() - t0));
-        entry.wire->pull_ops.Add(1);
-        entry.wire->pull_rows.Add(cnt);
-        entry.wire->bytes_out.Add(4 + uint64_t(flen));
-      } else {
-        // [u8 flags][u32 n][u32 dim][ids][grads]
-        if (n < off + 9) return proto_err();
-        const bool is_async = req[off] != 0;
-        (void)is_async;  // C applies inline — ack-after-apply is a
-                         // strictly stronger contract than coalesce
-        uint32_t cnt, d32;
-        std::memcpy(&cnt, req.data() + off + 1, 4);
-        std::memcpy(&d32, req.data() + off + 5, 4);
-        off += 9;
-        if (n != off + 8ull * cnt + 4ull * cnt * d32) return proto_err();
-        const int64_t dim = ptpu_ps_table_dim(entry.table);
-        const auto count_push = [&](uint32_t rows) {
-          stats.push_ops.Add(1);
-          stats.push_rows.Add(rows);
-          stats.bytes_out.Add(6);  // 4B length + OK frame
-          stats.push_us.Observe(uint64_t(ptpu::NowUs() - t0));
-          entry.wire->push_ops.Add(1);
-          entry.wire->push_rows.Add(rows);
-          entry.wire->bytes_out.Add(6);
-        };
-        if (cnt == 0) {  // empty push (dim underivable): trivially ok
-          if (rep.size() < 6) rep.resize(6);
-          rep[4] = kWireVersion;
-          rep[5] = kTagOk;
-          if (!SendFrame(fd, nullptr, 2, &rep)) return;
-          count_push(0);
-          continue;
-        }
-        if (int64_t(d32) != dim) {
-          // application error, not a protocol error: the frame parsed
-          // fine — answer like the Python plane instead of hanging up
-          if (!SendErr(fd, "push dim " + std::to_string(d32) +
-                               " != table dim " + std::to_string(dim)))
-            return;
-          continue;
-        }
-        // ids/grads sit at arbitrary offsets (table-name length shifts
-        // them): ids are read via the unaligned-safe GetI64; grads are
-        // handed to the table as a BYTE pointer — ptpu_ps_table_push
-        // reads each f32 with memcpy, so no aligned copy is needed
-        const uint8_t *ids_b = req.data() + off;
-        const uint8_t *grads_b = req.data() + off + 8ull * cnt;
-        if (local.size() < cnt) local.resize(cnt);
-        for (uint32_t i = 0; i < cnt; ++i)
-          local[i] = ptpu::GetI64(ids_b + 8 * i) - entry.lo;
-        if (ptpu_ps_table_push_raw(entry.table, local.data(), cnt,
-                                   grads_b) != 0) {
-          if (!SendErr(fd, ptpu_ps_last_error())) return;
-          continue;
-        }
-        if (rep.size() < 6) rep.resize(6);
-        rep[4] = kWireVersion;
-        rep[5] = kTagOk;
-        if (!SendFrame(fd, nullptr, 2, &rep)) return;
-        count_push(cnt);
-      }
-    }
-  }
-
-  // Join threads whose connections have closed — without this, a
-  // long-lived server under connection churn (one Channel per client
-  // phase) accumulates zombie std::threads until Stop().
-  void ReapFinished() {
-    std::vector<std::thread> reap;
+  // One complete framed request, dispatched inline on an event
+  // thread. kClose on protocol violations (the old loop hung up the
+  // same way); application errors answer ERR frames and keep going.
+  ptpu::net::FrameResult OnFrame(const ptpu::net::ConnPtr &conn,
+                                 const uint8_t *req, uint32_t n) {
+    using ptpu::net::FrameResult;
+    const auto proto_err = [this]() {
+      stats.proto_errors.Add(1);
+      return FrameResult::kClose;
+    };
+    if (n < 2) return proto_err();
+    const int64_t t0 = ptpu::NowUs();
+    stats.bytes_in.Add(4 + uint64_t(n));
+    if (req[0] != kWireVersion) return proto_err();
+    const uint8_t tag = req[1];
+    if (tag != kTagPullReq && tag != kTagPushReq) return proto_err();
+    // [u8 tlen][table]
+    if (n < 3) return proto_err();
+    const uint8_t tlen = req[2];
+    size_t off = 3 + tlen;
+    if (n < off) return proto_err();
+    const std::string table(reinterpret_cast<const char *>(req + 3),
+                            tlen);
+    ShardEntry entry;
     {
       std::lock_guard<std::mutex> g(mu);
-      if (done_threads.empty()) return;
-      for (auto it = conn_threads.begin(); it != conn_threads.end();) {
-        const auto tid = it->get_id();
-        if (std::find(done_threads.begin(), done_threads.end(), tid) !=
-            done_threads.end()) {
-          reap.push_back(std::move(*it));
-          it = conn_threads.erase(it);
-        } else {
-          ++it;
-        }
+      auto it = tables.find(table);
+      if (it == tables.end()) {
+        if (!SendErr(conn, "unknown table '" + table +
+                               "' on data plane"))
+          return FrameResult::kClose;
+        return FrameResult::kOk;
       }
-      done_threads.clear();
+      entry = it->second;
     }
-    for (auto &t : reap)
-      if (t.joinable()) t.join();
-  }
-
-  void AcceptLoop() {
-    for (;;) {
-      const int fd = ::accept(listen_fd, nullptr, nullptr);
-      if (fd < 0) {
-        // transient accept failures (peer RST, EINTR, momentary fd
-        // exhaustion) must not stop the server from accepting; only
-        // the Stop()-closed listener ends the loop
-        if (!stop.load() && ptpu::AcceptErrnoIsTransient(errno)) {
-          std::this_thread::sleep_for(std::chrono::milliseconds(5));
-          continue;
-        }
-        return;
+    entry.wire->bytes_in.Add(4 + uint64_t(n));
+    if (tag == kTagPullReq) {
+      // [u32 n][n x i64 ids]
+      if (n < off + 4) return proto_err();
+      uint32_t cnt;
+      std::memcpy(&cnt, req + off, 4);
+      off += 4;
+      if (n != off + 8ull * cnt) return proto_err();
+      // bound the REPLY like the request: a small ids frame must not
+      // be able to demand a multi-GB gather allocation
+      if (10 + size_t(cnt) * size_t(ptpu_ps_table_dim(entry.table)) *
+              4 > kMaxFrame) {
+        if (!SendErr(conn, "pull reply would exceed frame limit"))
+          return FrameResult::kClose;
+        return FrameResult::kOk;
       }
-      if (stop.load()) {
-        ::close(fd);
-        return;
+      // ids sit at 7+tlen into the frame — any alignment; every
+      // read goes through the unaligned-safe GetI64
+      const uint8_t *ids_b = req + off;
+      const int64_t rows = ptpu_ps_table_rows(entry.table);
+      const int64_t dim = ptpu_ps_table_dim(entry.table);
+      const size_t row_b = size_t(dim) * 4;
+      const size_t body = size_t(cnt) * row_b;
+      // reply = length + header + gathered rows in a POOLED
+      // per-connection buffer, queued for one writev flush. (A
+      // row-pointer writev was tried first — 512 iovecs of 256B cost
+      // more in per-segment kernel overhead than the one 131KB
+      // gather memcpy saves.)
+      std::vector<uint8_t> rep = conn->AcquireBuf();
+      rep.resize(14 + body);
+      ptpu::PutU32(rep.data(), uint32_t(10 + body));
+      const uint32_t flen = uint32_t(10 + body);
+      rep[4] = kWireVersion;
+      rep[5] = kTagPullRep;
+      ptpu::PutU32(rep.data() + 6, cnt);
+      ptpu::PutU32(rep.data() + 10, uint32_t(dim));
+      const float *w = ptpu_ps_table_data(entry.table);
+      // gather straight into the reply as BYTES: the f32 rows start
+      // at +14, which is not 4-aligned, so a float* view would be UB
+      uint8_t *out = rep.data() + 14;
+      bool bad = false;
+      ptpu_ps_table_rdlock(entry.table);
+      for (uint32_t i = 0; i < cnt; ++i) {
+        const int64_t id = ptpu::GetI64(ids_b + 8 * i) - entry.lo;
+        if (id < 0 || id >= rows) {
+          bad = true;
+          break;
+        }
+        std::memcpy(out + size_t(i) * row_b, w + id * dim, row_b);
       }
-      ReapFinished();
-      stats.conns_accepted.Add(1);
-      const int one = 1;
-      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      // deep pipelines keep several MB in flight per connection; a
-      // large send buffer keeps the reply writes from stalling
-      const int buf = 4 << 20;
-      ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
-      ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
-      std::lock_guard<std::mutex> g(mu);
-      conn_fds.push_back(fd);
-      conn_threads.emplace_back([this, fd]() {
-        // an escaping exception (e.g. bad_alloc on a hostile frame)
-        // would std::terminate the whole process — contain it to this
-        // connection, like the Python plane's drop-on-malformed
-        stats.conns_active.fetch_add(1, std::memory_order_relaxed);
-        try {
-          Serve(fd);
-        } catch (...) {
-        }
-        stats.conns_active.fetch_sub(1, std::memory_order_relaxed);
-        {
-          // prune BEFORE close: once closed, the OS may reuse the fd
-          // number and Stop() must not shutdown an unrelated socket
-          std::lock_guard<std::mutex> g2(mu);
-          conn_fds.erase(
-              std::remove(conn_fds.begin(), conn_fds.end(), fd),
-              conn_fds.end());
-          done_threads.push_back(std::this_thread::get_id());
-        }
-        ::close(fd);
-      });
+      ptpu_ps_table_rdunlock(entry.table);
+      if (bad) {
+        if (!SendErr(conn, "pull id out of shard range"))
+          return FrameResult::kClose;
+        return FrameResult::kOk;
+      }
+      if (!conn->SendPayload(std::move(rep))) return FrameResult::kClose;
+      ptpu_ps_table_note_pull(entry.table, int64_t(cnt));
+      stats.pull_ops.Add(1);
+      stats.pull_rows.Add(cnt);
+      stats.bytes_out.Add(4 + uint64_t(flen));
+      stats.pull_us.Observe(uint64_t(ptpu::NowUs() - t0));
+      entry.wire->pull_ops.Add(1);
+      entry.wire->pull_rows.Add(cnt);
+      entry.wire->bytes_out.Add(4 + uint64_t(flen));
+      return FrameResult::kOk;
     }
+    // [u8 flags][u32 n][u32 dim][ids][grads]
+    if (n < off + 9) return proto_err();
+    const bool is_async = req[off] != 0;
+    (void)is_async;  // C applies inline — ack-after-apply is a
+                     // strictly stronger contract than coalesce
+    uint32_t cnt, d32;
+    std::memcpy(&cnt, req + off + 1, 4);
+    std::memcpy(&d32, req + off + 5, 4);
+    off += 9;
+    if (n != off + 8ull * cnt + 4ull * cnt * d32) return proto_err();
+    const int64_t dim = ptpu_ps_table_dim(entry.table);
+    const auto count_push = [&](uint32_t rows) {
+      stats.push_ops.Add(1);
+      stats.push_rows.Add(rows);
+      stats.bytes_out.Add(6);  // 4B length + OK frame
+      stats.push_us.Observe(uint64_t(ptpu::NowUs() - t0));
+      entry.wire->push_ops.Add(1);
+      entry.wire->push_rows.Add(rows);
+      entry.wire->bytes_out.Add(6);
+    };
+    const auto send_ok = [&]() {
+      std::vector<uint8_t> rep = conn->AcquireBuf();
+      rep.resize(6);
+      rep[4] = kWireVersion;
+      rep[5] = kTagOk;
+      return conn->SendPayload(std::move(rep));
+    };
+    if (cnt == 0) {  // empty push (dim underivable): trivially ok
+      if (!send_ok()) return FrameResult::kClose;
+      count_push(0);
+      return FrameResult::kOk;
+    }
+    if (int64_t(d32) != dim) {
+      // application error, not a protocol error: the frame parsed
+      // fine — answer like the Python plane instead of hanging up
+      if (!SendErr(conn, "push dim " + std::to_string(d32) +
+                             " != table dim " + std::to_string(dim)))
+        return FrameResult::kClose;
+      return FrameResult::kOk;
+    }
+    // ids/grads sit at arbitrary offsets (table-name length shifts
+    // them): ids are read via the unaligned-safe GetI64; grads are
+    // handed to the table as a BYTE pointer — ptpu_ps_table_push_raw
+    // reads each f32 with memcpy, so no aligned copy is needed
+    const uint8_t *ids_b = req + off;
+    const uint8_t *grads_b = req + off + 8ull * cnt;
+    // event-thread scratch, reused across frames (was per-conn)
+    thread_local std::vector<int64_t> local;
+    if (local.size() < cnt) local.resize(cnt);
+    for (uint32_t i = 0; i < cnt; ++i)
+      local[i] = ptpu::GetI64(ids_b + 8 * i) - entry.lo;
+    if (ptpu_ps_table_push_raw(entry.table, local.data(), cnt,
+                               grads_b) != 0) {
+      if (!SendErr(conn, ptpu_ps_last_error()))
+        return FrameResult::kClose;
+      return FrameResult::kOk;
+    }
+    if (!send_ok()) return FrameResult::kClose;
+    count_push(cnt);
+    return FrameResult::kOk;
   }
 };
 
@@ -466,35 +347,12 @@ PTPU_PS_EXPORT void *ptpu_ps_server_start(int port, const char *authkey,
   auto *s = new PsServer();
   if (authkey && authkey_len > 0)
     s->authkey.assign(authkey, size_t(authkey_len));
-  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (s->listen_fd < 0) {
-    g_srv_error = "ptpu_ps_server_start: socket() failed";
+  std::string err;
+  if (!s->Start(port, loopback_only, &err)) {
+    g_srv_error = "ptpu_ps_server_start: " + err;
     delete s;
     return nullptr;
   }
-  const int one = 1;
-  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one,
-               sizeof(one));
-  sockaddr_in addr{};
-  addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr =
-      htonl(loopback_only ? INADDR_LOOPBACK : INADDR_ANY);
-  addr.sin_port = htons(uint16_t(port));
-  if (::bind(s->listen_fd, reinterpret_cast<sockaddr *>(&addr),
-             sizeof(addr)) != 0 ||
-      ::listen(s->listen_fd, 64) != 0) {
-    g_srv_error = "ptpu_ps_server_start: bind/listen on port " +
-                  std::to_string(port) + " failed";
-    ::close(s->listen_fd);
-    s->listen_fd = -1;
-    delete s;
-    return nullptr;
-  }
-  socklen_t alen = sizeof(addr);
-  ::getsockname(s->listen_fd, reinterpret_cast<sockaddr *>(&addr),
-                &alen);
-  s->port = int(ntohs(addr.sin_port));
-  s->accept_thread = std::thread([s]() { s->AcceptLoop(); });
   return s;
 }
 
@@ -522,31 +380,37 @@ PTPU_PS_EXPORT int ptpu_ps_server_register(void *h, const char *name,
   return 0;
 }
 
-// JSON snapshot: {"server":{global wire counters + pull_us/push_us
-// histograms}, "tables":{name:{"wire":{...},"table":{storage counters
-// from ptpu_ps_table_stats_json}}}}. Returned pointer is a
-// thread-local render buffer, valid until the calling thread's next
-// ptpu_ps_server_stats_json call.
+// JSON snapshot: {"server":{global wire counters + net-core conn
+// counters + pull_us/push_us histograms}, "tables":{name:{"wire":
+// {...},"table":{storage counters from ptpu_ps_table_stats_json}}}}.
+// Returned pointer is a thread-local render buffer, valid until the
+// calling thread's next ptpu_ps_server_stats_json call.
 PTPU_PS_EXPORT const char *ptpu_ps_server_stats_json(void *h) {
   thread_local std::string g_json;
   auto *s = static_cast<PsServer *>(h);
   if (!s) return "{}";
   std::string out = "{\"server\":{";
   const ServerStats &st = s->stats;
+  const ptpu::net::Stats &nt = s->net;
   const struct { const char *name; const ptpu::Counter *c; } cs[] = {
       {"pull_ops", &st.pull_ops},       {"pull_rows", &st.pull_rows},
       {"push_ops", &st.push_ops},       {"push_rows", &st.push_rows},
       {"bytes_in", &st.bytes_in},       {"bytes_out", &st.bytes_out},
       {"err_frames", &st.err_frames},   {"proto_errors", &st.proto_errors},
-      {"handshake_fails", &st.handshake_fails},
-      {"conns_accepted", &st.conns_accepted},
+      {"handshake_fails", &nt.handshake_fails},
+      {"conns_accepted", &nt.conns_accepted},
+      {"conns_shed", &nt.conns_shed},
+      {"handshake_timeouts", &nt.handshake_timeouts},
+      {"idle_closes", &nt.idle_closes},
+      {"epoll_wakeups", &nt.epoll_wakeups},
+      {"partial_write_flushes", &nt.partial_write_flushes},
   };
   for (const auto &kv : cs) {
     ptpu::AppendJsonU64(&out, kv.name, kv.c->Get());
     out += ',';
   }
   ptpu::AppendJsonU64(&out, "conns_active",
-                      uint64_t(st.conns_active.load(
+                      uint64_t(nt.active_conns.load(
                           std::memory_order_relaxed)));
   out += ',';
   ptpu::AppendJsonHist(&out, "pull_us", st.pull_us);
@@ -584,12 +448,14 @@ PTPU_PS_EXPORT const char *ptpu_ps_server_stats_json(void *h) {
   return g_json.c_str();
 }
 
-// Reset wire counters (global + per-table) AND the storage counters of
-// every registered table — one call zeroes the whole serving view.
+// Reset wire counters (global + net-core + per-table) AND the storage
+// counters of every registered table — one call zeroes the whole
+// serving view.
 PTPU_PS_EXPORT void ptpu_ps_server_stats_reset(void *h) {
   auto *s = static_cast<PsServer *>(h);
   if (!s) return;
   s->stats.Reset();
+  s->net.Reset();
   std::lock_guard<std::mutex> g(s->mu);
   for (auto &kv : s->tables) {
     kv.second.wire->Reset();

@@ -39,15 +39,18 @@
 //   req_id is caller-chosen; replies may interleave across a
 //   connection's in-flight requests (client pipelining).
 //
-// Build: linked with ptpu_predictor.cc into
+// Connection handling rides the shared epoll core
+// (csrc/ptpu_net.{h,cc}): INFER frames parse on the event threads and
+// enqueue into the micro-batcher; batch completions on the instance
+// workers queue replies on the connection and wake its owner event
+// loop over an eventfd — workers never block on a client socket. A
+// full request queue DEFERS the frame (reads from that connection
+// pause; the event loop re-dispatches on a timer) instead of sleeping
+// an event thread, bounding backpressure without blocking.
+//
+// Build: linked with ptpu_predictor.cc + ptpu_net.cc into
 // paddle_tpu/_native_predictor.so (csrc/Makefile); unit-tested by
 // csrc/ptpu_serving_selftest.cc.
-
-#include <arpa/inet.h>
-#include <netinet/in.h>
-#include <netinet/tcp.h>
-#include <sys/socket.h>
-#include <unistd.h>
 
 #include <algorithm>
 #include <atomic>
@@ -61,13 +64,12 @@
 #include <map>
 #include <memory>
 #include <mutex>
-#include <random>
 #include <string>
 #include <thread>
 #include <vector>
 
-#include "ptpu_hmac.h"
 #include "ptpu_inference_api.h"
+#include "ptpu_net.h"
 #include "ptpu_stats.h"
 #include "ptpu_sync.h"
 #include "ptpu_wire.h"
@@ -82,6 +84,10 @@ constexpr uint8_t kTagMetaReq = 0x63;
 constexpr uint8_t kTagMetaRep = 0x64;
 constexpr uint32_t kSvMaxFrame = 1u << 30;
 constexpr int kSvMaxNdim = 16;
+// backpressure budget: how long one INFER frame may sit deferred on a
+// full queue before it answers an error (matches the old 200 x 500us
+// blocking-retry budget)
+constexpr int64_t kSvDeferBudgetUs = 100 * 1000;
 
 // ONNX TensorProto dtype codes accepted on the wire
 enum { SV_F32 = 1, SV_I32 = 6, SV_I64 = 7 };
@@ -90,43 +96,8 @@ inline int sv_dtype_size(int dt) {
   return dt == SV_I64 ? 8 : dt == SV_I32 || dt == SV_F32 ? 4 : 0;
 }
 
-// exact I/O + frame codec live in the shared csrc/ptpu_wire.h
 using ptpu::GetU32;
 using ptpu::PutU32;
-using ptpu::ReadExact;
-using ptpu::WriteExact;
-
-/* One client connection. Replies are written by batcher instance
- * threads while the conn's reader thread parses the next request, so
- * writes serialize on wmu; `closed` keeps a late reply from writing
- * into a recycled fd. */
-struct SvConn {
-  int fd = -1;
-  std::mutex wmu;
-  bool closed = false;
-
-  bool Send(const std::vector<uint8_t>& frame) {
-    std::lock_guard<std::mutex> g(wmu);
-    if (closed) return false;
-    if (!WriteExact(fd, frame.data(), frame.size())) {
-      // SO_SNDTIMEO expired (client stopped reading) or hard error:
-      // break the connection so instance workers never stall on it
-      // again and the reader thread unblocks
-      closed = true;
-      ::shutdown(fd, SHUT_RDWR);
-      return false;
-    }
-    return true;
-  }
-
-  void Close() {
-    std::lock_guard<std::mutex> g(wmu);
-    if (!closed) {
-      closed = true;
-      ::shutdown(fd, SHUT_RDWR);
-    }
-  }
-};
 
 struct SvInput {
   int dtype = SV_F32;
@@ -138,17 +109,16 @@ struct SvRequest {
   uint64_t id = 0;
   int64_t rows = 0;
   std::vector<SvInput> inputs;
-  std::shared_ptr<SvConn> conn;
+  ptpu::net::ConnPtr conn;
   int64_t t_enq_us = 0;
 };
 
 // Always-on counters/histograms (csrc/ptpu_stats.h relaxed atomics).
+// Connection-lifecycle counters live in the embedded net-core stats.
 struct SvStats {
   ptpu::Counter requests, replies, req_errors, batches,
       batched_requests, batched_rows, bucket_miss, full_flushes,
-      deadline_flushes, bytes_in, bytes_out, err_frames, proto_errors,
-      handshake_fails, conns_accepted;
-  std::atomic<int64_t> conns_active{0};
+      deadline_flushes, bytes_in, bytes_out, err_frames, proto_errors;
   ptpu::Histogram queue_depth, batch_fill, e2e_us, run_us;
 
   void Reset() {
@@ -165,8 +135,6 @@ struct SvStats {
     bytes_out.Reset();
     err_frames.Reset();
     proto_errors.Reset();
-    handshake_fails.Reset();
-    conns_accepted.Reset();
     queue_depth.Reset();
     batch_fill.Reset();
     e2e_us.Reset();
@@ -182,7 +150,10 @@ struct SvStats {
  * requests only (no splitting), strictly FIFO, so de-muxed replies
  * preserve per-connection submission order. The runner is injected:
  * the server hands the stitched batch to a predictor instance; the
- * selftest injects a recording fake. */
+ * selftest injects a recording fake. stop() drains: workers keep
+ * flushing until the queue is empty (graceful-stop requests still
+ * answer), and only enqueues arriving after stop() see "server
+ * stopping". */
 class SvBatcher {
  public:
   using Runner = std::function<void(int instance,
@@ -227,8 +198,9 @@ class SvBatcher {
     return true;
   }
 
-  // stop workers; remaining queued requests are returned to the
-  // caller (the server errors them out before closing connections)
+  // stop workers AFTER they drain the queue; anything still queued
+  // when they exit (a wedged runner) is returned to the caller, which
+  // errors it out before closing connections
   std::deque<SvRequest> stop() {
     {
       std::lock_guard<std::mutex> l(mu_);
@@ -325,7 +297,6 @@ struct SvInstance {
 struct SvServer {
   std::string model_path;
   std::string authkey;
-  int listen_fd = -1;
   int port = 0;
   int64_t max_batch = 8;
   int64_t deadline_us = 2000;
@@ -339,13 +310,9 @@ struct SvServer {
   std::vector<std::unique_ptr<SvInstance>> insts;
   std::unique_ptr<SvBatcher> batcher;
   SvStats stats;
-
+  ptpu::net::Stats net;
+  std::unique_ptr<ptpu::net::Server> net_srv;
   std::atomic<bool> stop{false};
-  std::thread accept_thread;
-  std::mutex conn_mu;
-  std::vector<std::shared_ptr<SvConn>> conns;
-  std::vector<std::thread> conn_threads;
-  std::vector<std::thread::id> done_threads;
 
   ~SvServer() { Stop(); }
 
@@ -444,24 +411,33 @@ struct SvServer {
           RunBatch(instance, batch);
         }));
 
-    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
-    if (listen_fd < 0) throw std::runtime_error("socket() failed");
-    const int one = 1;
-    ::setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
-    sockaddr_in addr{};
-    addr.sin_family = AF_INET;
-    addr.sin_addr.s_addr =
-        htonl(loopback_only ? INADDR_LOOPBACK : INADDR_ANY);
-    addr.sin_port = htons(uint16_t(want_port));
-    if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr),
-               sizeof(addr)) != 0 ||
-        ::listen(listen_fd, 128) != 0)
-      throw std::runtime_error("bind/listen on port " +
-                               std::to_string(want_port) + " failed");
-    socklen_t alen = sizeof(addr);
-    ::getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
-    port = int(ntohs(addr.sin_port));
-    accept_thread = std::thread([this] { AcceptLoop(); });
+    ptpu::net::Options opt;
+    opt.port = want_port;
+    opt.loopback_only = loopback_only != 0;
+    opt.authkey = authkey;
+    opt.max_frame = kSvMaxFrame;
+    opt = ptpu::net::OptionsFromEnv(opt);
+    ptpu::net::Callbacks cbs;
+    cbs.on_frame = [this](const ptpu::net::ConnPtr& c,
+                          const uint8_t* p, uint32_t n) {
+      return OnFrame(c, p, n);
+    };
+    cbs.on_oversize = [this](const ptpu::net::ConnPtr&) {
+      stats.proto_errors.Add(1);
+    };
+    // conn->user stashes a parsed-but-unqueued SvRequest across defer
+    // retries (see OnFrame); free it if the conn dies mid-defer
+    cbs.on_close = [](const ptpu::net::ConnPtr& c) {
+      delete static_cast<SvRequest*>(c->user);
+      c->user = nullptr;
+    };
+    net_srv.reset(new ptpu::net::Server(opt, std::move(cbs), &net));
+    std::string nerr;
+    if (!net_srv->Start(&nerr)) {
+      net_srv.reset();
+      throw std::runtime_error(nerr);
+    }
+    port = net_srv->port();
   }
 
   bool ProbeBucket(int64_t b, std::string* perr) {
@@ -550,10 +526,10 @@ struct SvServer {
   }
 
   // ------------------------------------------------------ batch run
-  void SendErrFrame(const std::shared_ptr<SvConn>& conn, uint64_t id,
+  void SendErrFrame(const ptpu::net::ConnPtr& conn, uint64_t id,
                     const std::string& msg) {
-    std::vector<uint8_t> f(4 + 2 + 8 + 4 + msg.size());
-    PutU32(f.data(), uint32_t(f.size() - 4));
+    std::vector<uint8_t> f = conn->AcquireBuf();
+    f.resize(4 + 2 + 8 + 4 + msg.size());
     f[4] = kSvWireVersion;
     f[5] = kTagInferErr;
     std::memcpy(f.data() + 6, &id, 8);
@@ -562,7 +538,7 @@ struct SvServer {
     stats.err_frames.Add(1);
     stats.req_errors.Add(1);
     stats.bytes_out.Add(f.size());
-    conn->Send(f);
+    conn->SendPayload(std::move(f));
   }
 
   void RunBatch(int instance, std::vector<SvRequest>& batch) {
@@ -583,7 +559,10 @@ struct SvServer {
 
     char err[512] = {0};
     const auto fail_all = [&](const std::string& msg) {
-      for (auto& r : batch) SendErrFrame(r.conn, r.id, msg);
+      for (auto& r : batch) {
+        SendErrFrame(r.conn, r.id, msg);
+        r.conn->NotePending(-1);  // pairs the enqueue-time +1
+      }
     };
 
     for (size_t i = 0; i < sig.size(); ++i) {
@@ -655,8 +634,8 @@ struct SvServer {
       for (const auto& v : outs)
         fsz += 1 + v.dims.size() * 8 +
                size_t(r.rows) * size_t(v.row_elems) * 4;
-      std::vector<uint8_t> f(fsz);
-      PutU32(f.data(), uint32_t(fsz - 4));
+      std::vector<uint8_t> f = r.conn->AcquireBuf();
+      f.resize(fsz);
       f[4] = kSvWireVersion;
       f[5] = kTagInferRep;
       std::memcpy(f.data() + 6, &r.id, 8);
@@ -677,236 +656,178 @@ struct SvServer {
         off += nb;
       }
       row_off += r.rows;
-      if (r.conn->Send(f)) {
+      const size_t sent = f.size();
+      if (r.conn->SendPayload(std::move(f))) {
         stats.replies.Add(1);
-        stats.bytes_out.Add(f.size());
+        stats.bytes_out.Add(sent);
         stats.e2e_us.Observe(uint64_t(ptpu::NowUs() - r.t_enq_us));
       }
+      r.conn->NotePending(-1);  // pairs the enqueue-time +1
     }
   }
 
   // ------------------------------------------------------ wire loop
 
-  void Serve(const std::shared_ptr<SvConn>& conn) {
-    const int fd = conn->fd;
-    if (!ptpu::ServerHandshake(fd, authkey)) {
-      stats.handshake_fails.Add(1);
-      return;
-    }
-    std::vector<uint8_t> req;
-    const auto proto_err = [this] { stats.proto_errors.Add(1); };
-    for (;;) {
-      uint8_t lenb[4];
-      if (!ReadExact(fd, lenb, 4)) return;
-      const uint32_t n = GetU32(lenb);
-      if (n < 2 || n > kSvMaxFrame) return proto_err();
-      if (req.size() < n) req.resize(n);
-      if (!ReadExact(fd, req.data(), n)) return;
-      stats.bytes_in.Add(4 + uint64_t(n));
-      if (req[0] != kSvWireVersion) return proto_err();
-      const uint8_t tag = req[1];
-      if (tag == kTagMetaReq) {
-        std::vector<uint8_t> f(4 + 2 + 4 + meta_json.size());
-        PutU32(f.data(), uint32_t(f.size() - 4));
-        f[4] = kSvWireVersion;
-        f[5] = kTagMetaRep;
-        PutU32(f.data() + 6, uint32_t(meta_json.size()));
-        std::memcpy(f.data() + 10, meta_json.data(), meta_json.size());
-        stats.bytes_out.Add(f.size());
-        if (!conn->Send(f)) return;
-        continue;
-      }
-      if (tag != kTagInferReq) return proto_err();
-      // [u64 req_id][u16 n_inputs] per input:
-      // [u8 dtype][u8 ndim][ndim x i64][raw]
-      if (n < 2 + 8 + 2) return proto_err();
-      SvRequest r;
-      std::memcpy(&r.id, req.data() + 2, 8);
-      uint16_t nin;
-      std::memcpy(&nin, req.data() + 10, 2);
-      size_t off = 12;
-      std::string bad;
-      if (nin != sig.size())
-        bad = "expected " + std::to_string(sig.size()) +
-              " inputs, got " + std::to_string(nin);
-      r.inputs.resize(sig.size());
-      int64_t rows = -1;
-      for (size_t i = 0; bad.empty() && i < sig.size(); ++i) {
-        if (n < off + 2) return proto_err();
-        const int dt = req[off];
-        const int nd = req[off + 1];
-        off += 2;
-        if (nd < 1 || nd > kSvMaxNdim || n < off + size_t(nd) * 8)
-          return proto_err();
-        SvInput& in = r.inputs[i];
-        in.dtype = dt;
-        in.dims.resize(size_t(nd));
-        std::memcpy(in.dims.data(), req.data() + off, size_t(nd) * 8);
-        off += size_t(nd) * 8;
-        if (dt != sig[i].dtype) {
-          bad = "input '" + sig[i].name + "': dtype " +
-                std::to_string(dt) + " != model dtype " +
-                std::to_string(sig[i].dtype);
-          break;
-        }
-        if (size_t(nd) != sig[i].tail.size() + 1) {
-          bad = "input '" + sig[i].name + "': ndim " +
-                std::to_string(nd) + " != " +
-                std::to_string(sig[i].tail.size() + 1);
-          break;
-        }
-        for (size_t k = 0; k < sig[i].tail.size(); ++k)
-          if (in.dims[k + 1] != sig[i].tail[k]) {
-            bad = "input '" + sig[i].name +
-                  "': non-batch dims do not match the model";
-            break;
-          }
-        if (!bad.empty()) break;
-        if (in.dims[0] < 1) {
-          bad = "input '" + sig[i].name + "': batch dim must be >= 1";
-          break;
-        }
-        if (rows < 0) rows = in.dims[0];
-        else if (in.dims[0] != rows) {
-          bad = "inputs disagree on the batch dim";
-          break;
-        }
-        const size_t nb = size_t(in.dims[0]) *
-                          size_t(sig[i].row_elems) *
-                          size_t(sv_dtype_size(sig[i].dtype));
-        if (n < off + nb) return proto_err();
-        in.data.assign(req.data() + off, req.data() + off + nb);
-        off += nb;
-      }
-      stats.requests.Add(1);
-      if (!bad.empty()) {
-        SendErrFrame(conn, r.id, bad);
-        continue;
-      }
-      r.rows = rows;
-      r.conn = conn;
-      r.t_enq_us = ptpu::NowUs();
-      // backpressure: retry briefly before refusing — closed-loop
-      // clients outrunning the instances see latency, not errors.
-      // enqueue only moves the request on success, so r stays intact
-      // across failed attempts; id/conn are saved for the error path.
+  // One complete frame from the epoll core (event-thread context).
+  // INFER enqueues into the batcher; a full queue defers the frame
+  // (bounded by kSvDeferBudgetUs) instead of blocking the thread.
+  ptpu::net::FrameResult OnFrame(const ptpu::net::ConnPtr& conn,
+                                 const uint8_t* req, uint32_t n) {
+    using ptpu::net::FrameResult;
+    const bool retry = conn->deferred_us() > 0;
+    // defer retry fast path: the request was parsed (and its payload
+    // copied) on the FIRST attempt and stashed on the conn — retries
+    // only re-attempt the enqueue, they never re-parse a multi-MB
+    // frame on the event thread while the server is saturated
+    if (retry && conn->user) {
+      auto* stash = static_cast<SvRequest*>(conn->user);
       std::string why;
-      const uint64_t rid = r.id;
-      bool okq = false;
-      for (int attempt = 0; attempt < 200; ++attempt) {
-        okq = batcher->enqueue(std::move(r), &why);
-        if (okq || why != "request queue full") break;
-        std::this_thread::sleep_for(std::chrono::microseconds(500));
+      const uint64_t rid = stash->id;
+      if (batcher->enqueue(std::move(*stash), &why)) {
+        conn->NotePending(1);  // in the batcher: not idle (see
+                               // ptpu_net.h NotePending)
+        delete stash;
+        conn->user = nullptr;
+        return FrameResult::kOk;
       }
-      if (!okq) SendErrFrame(conn, rid, why);
+      if (why == "request queue full" &&
+          conn->deferred_us() < kSvDeferBudgetUs)
+        return FrameResult::kDefer;  // stash stays for the next try
+      delete stash;
+      conn->user = nullptr;
+      SendErrFrame(conn, rid, why);
+      return FrameResult::kOk;
     }
-  }
-
-  void ReapFinished() {
-    std::vector<std::thread> reap;
+    const auto proto_err = [this] {
+      stats.proto_errors.Add(1);
+      return FrameResult::kClose;
+    };
+    if (n < 2) return proto_err();
+    if (!retry) stats.bytes_in.Add(4 + uint64_t(n));
+    if (req[0] != kSvWireVersion) return proto_err();
+    const uint8_t tag = req[1];
+    if (tag == kTagMetaReq) {
+      std::vector<uint8_t> f = conn->AcquireBuf();
+      f.resize(4 + 2 + 4 + meta_json.size());
+      f[4] = kSvWireVersion;
+      f[5] = kTagMetaRep;
+      PutU32(f.data() + 6, uint32_t(meta_json.size()));
+      std::memcpy(f.data() + 10, meta_json.data(), meta_json.size());
+      stats.bytes_out.Add(f.size());
+      if (!conn->SendPayload(std::move(f))) return FrameResult::kClose;
+      return FrameResult::kOk;
+    }
+    if (tag != kTagInferReq) return proto_err();
+    // [u64 req_id][u16 n_inputs] per input:
+    // [u8 dtype][u8 ndim][ndim x i64][raw]
+    if (n < 2 + 8 + 2) return proto_err();
+    SvRequest r;
+    std::memcpy(&r.id, req + 2, 8);
+    uint16_t nin;
+    std::memcpy(&nin, req + 10, 2);
+    size_t off = 12;
+    std::string bad;
+    if (nin != sig.size())
+      bad = "expected " + std::to_string(sig.size()) +
+            " inputs, got " + std::to_string(nin);
+    r.inputs.resize(sig.size());
+    int64_t rows = -1;
+    for (size_t i = 0; bad.empty() && i < sig.size(); ++i) {
+      if (n < off + 2) return proto_err();
+      const int dt = req[off];
+      const int nd = req[off + 1];
+      off += 2;
+      if (nd < 1 || nd > kSvMaxNdim || n < off + size_t(nd) * 8)
+        return proto_err();
+      SvInput& in = r.inputs[i];
+      in.dtype = dt;
+      in.dims.resize(size_t(nd));
+      std::memcpy(in.dims.data(), req + off, size_t(nd) * 8);
+      off += size_t(nd) * 8;
+      if (dt != sig[i].dtype) {
+        bad = "input '" + sig[i].name + "': dtype " +
+              std::to_string(dt) + " != model dtype " +
+              std::to_string(sig[i].dtype);
+        break;
+      }
+      if (size_t(nd) != sig[i].tail.size() + 1) {
+        bad = "input '" + sig[i].name + "': ndim " +
+              std::to_string(nd) + " != " +
+              std::to_string(sig[i].tail.size() + 1);
+        break;
+      }
+      for (size_t k = 0; k < sig[i].tail.size(); ++k)
+        if (in.dims[k + 1] != sig[i].tail[k]) {
+          bad = "input '" + sig[i].name +
+                "': non-batch dims do not match the model";
+          break;
+        }
+      if (!bad.empty()) break;
+      if (in.dims[0] < 1) {
+        bad = "input '" + sig[i].name + "': batch dim must be >= 1";
+        break;
+      }
+      if (rows < 0) rows = in.dims[0];
+      else if (in.dims[0] != rows) {
+        bad = "inputs disagree on the batch dim";
+        break;
+      }
+      const size_t nb = size_t(in.dims[0]) *
+                        size_t(sig[i].row_elems) *
+                        size_t(sv_dtype_size(sig[i].dtype));
+      if (n < off + nb) return proto_err();
+      in.data.assign(req + off, req + off + nb);
+      off += nb;
+    }
+    if (!retry) stats.requests.Add(1);
+    if (!bad.empty()) {
+      SendErrFrame(conn, r.id, bad);
+      return FrameResult::kOk;
+    }
+    r.rows = rows;
+    r.conn = conn;
+    r.t_enq_us = ptpu::NowUs();
+    std::string why;
+    const uint64_t rid = r.id;
+    if (batcher->enqueue(std::move(r), &why)) {
+      conn->NotePending(1);  // in the batcher: not idle until replied
+      return FrameResult::kOk;
+    }
     {
-      std::lock_guard<std::mutex> g(conn_mu);
-      if (done_threads.empty()) return;
-      for (auto it = conn_threads.begin(); it != conn_threads.end();) {
-        if (std::find(done_threads.begin(), done_threads.end(),
-                      it->get_id()) != done_threads.end()) {
-          reap.push_back(std::move(*it));
-          it = conn_threads.erase(it);
-        } else {
-          ++it;
-        }
+      // enqueue moves the request only on success, so r is intact
+      if (why == "request queue full" &&
+          conn->deferred_us() < kSvDeferBudgetUs) {
+        // stash the parsed request; the event loop re-dispatches this
+        // frame and the retry fast path above re-attempts the enqueue
+        // (t_enq_us keeps the FIRST attempt's stamp, so e2e_us spans
+        // the whole deferred wait like the old blocking retries)
+        conn->user = new SvRequest(std::move(r));
+        return FrameResult::kDefer;
       }
-      done_threads.clear();
+      SendErrFrame(conn, rid, why);
     }
-    for (auto& t : reap)
-      if (t.joinable()) t.join();
-  }
-
-  void AcceptLoop() {
-    for (;;) {
-      const int fd = ::accept(listen_fd, nullptr, nullptr);
-      if (fd < 0) {
-        // a transient accept failure (peer RST, EINTR, momentary fd
-        // exhaustion) must not permanently stop the server from
-        // accepting; only the Stop()-closed listener ends the loop
-        if (!stop.load() && ptpu::AcceptErrnoIsTransient(errno)) {
-          std::this_thread::sleep_for(std::chrono::milliseconds(5));
-          continue;
-        }
-        return;
-      }
-      if (stop.load()) {
-        ::close(fd);
-        return;
-      }
-      ReapFinished();
-      stats.conns_accepted.Add(1);
-      const int one = 1;
-      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      const int buf = 4 << 20;
-      ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
-      ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
-      // bound reply writes: a client that stops READING replies would
-      // otherwise block an instance worker inside Send forever once
-      // its 4MB send buffer fills (and hang Stop with it)
-      struct timeval tv{10, 0};
-      ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
-      auto conn = std::make_shared<SvConn>();
-      conn->fd = fd;
-      std::lock_guard<std::mutex> g(conn_mu);
-      conns.push_back(conn);
-      conn_threads.emplace_back([this, conn] {
-        stats.conns_active.fetch_add(1, std::memory_order_relaxed);
-        try {
-          Serve(conn);
-        } catch (...) {
-        }
-        stats.conns_active.fetch_sub(1, std::memory_order_relaxed);
-        conn->Close();
-        {
-          std::lock_guard<std::mutex> g2(conn_mu);
-          conns.erase(std::remove(conns.begin(), conns.end(), conn),
-                      conns.end());
-          done_threads.push_back(std::this_thread::get_id());
-        }
-        ::close(conn->fd);
-      });
-    }
+    return FrameResult::kOk;
   }
 
   void Stop() {
     if (stop.exchange(true)) return;
-    // shutdown() wakes the blocked accept() (EINVAL) but keeps the fd
-    // alive; closing or clearing listen_fd BEFORE the join would race
-    // the accept thread's concurrent read of it (TSan-caught) and
-    // invite fd-number reuse while accept() still holds the old value
-    if (listen_fd >= 0) ::shutdown(listen_fd, SHUT_RDWR);
-    if (accept_thread.joinable()) accept_thread.join();
-    if (listen_fd >= 0) {
-      ::close(listen_fd);
-      listen_fd = -1;
-    }
-    // stop the batcher FIRST (in-flight batches reply over still-open
-    // conns, leftover queued requests get explicit errors) but keep
-    // the OBJECT alive until the conn reader threads are joined —
-    // they may still call enqueue(), which answers "server stopping"
-    // on a stopped batcher but would be UB on a destroyed one
+    // graceful drain: stop accepting -> let the batcher workers
+    // finish EVERYTHING queued (in-flight requests still answer over
+    // still-open conns) -> flush queued replies -> close. The batcher
+    // object stays alive until the event threads are joined — they
+    // may still call enqueue(), which answers "server stopping" on a
+    // stopped batcher but would be UB on a destroyed one.
+    if (net_srv) net_srv->StopAccepting();
     std::deque<SvRequest> leftover;
     if (batcher) leftover = batcher->stop();
-    for (auto& r : leftover)
+    for (auto& r : leftover) {
       SendErrFrame(r.conn, r.id, "server stopping");
-    {
-      std::lock_guard<std::mutex> g(conn_mu);
-      for (auto& c : conns) c->Close();
+      r.conn->NotePending(-1);  // pairs the enqueue-time +1
     }
-    std::vector<std::thread> ts;
-    {
-      std::lock_guard<std::mutex> g(conn_mu);
-      ts.swap(conn_threads);
-      done_threads.clear();
+    if (net_srv) {
+      net_srv->Drain();
+      net_srv.reset();
     }
-    for (auto& t : ts)
-      if (t.joinable()) t.join();
     batcher.reset();
   }
 
@@ -922,8 +843,13 @@ struct SvServer {
         {"req_errors", &stats.req_errors},
         {"err_frames", &stats.err_frames},
         {"proto_errors", &stats.proto_errors},
-        {"handshake_fails", &stats.handshake_fails},
-        {"conns_accepted", &stats.conns_accepted},
+        {"handshake_fails", &net.handshake_fails},
+        {"conns_accepted", &net.conns_accepted},
+        {"conns_shed", &net.conns_shed},
+        {"handshake_timeouts", &net.handshake_timeouts},
+        {"idle_closes", &net.idle_closes},
+        {"epoll_wakeups", &net.epoll_wakeups},
+        {"partial_write_flushes", &net.partial_write_flushes},
         {"bytes_in", &stats.bytes_in},
         {"bytes_out", &stats.bytes_out},
     };
@@ -933,7 +859,7 @@ struct SvServer {
     }
     ptpu::AppendJsonU64(
         &out, "conns_active",
-        uint64_t(stats.conns_active.load(std::memory_order_relaxed)));
+        uint64_t(net.active_conns.load(std::memory_order_relaxed)));
     out += "},\"batcher\":{";
     const struct {
       const char* name;
@@ -988,6 +914,7 @@ struct SvServer {
 
   void StatsReset() {
     stats.Reset();
+    net.Reset();
     dyn_fallback_base_.store(DynFallbackSum(),
                              std::memory_order_relaxed);
   }

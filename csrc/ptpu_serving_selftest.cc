@@ -14,13 +14,23 @@
 //
 // Build + run: make selftest (csrc/Makefile); wrapped by
 // tests/test_native_selftest.py.
+#include "ptpu_net.cc"
 #include "ptpu_predictor.cc"
 #include "ptpu_serving.cc"
 
 // asserts ARE the test — never compile them out
 #undef NDEBUG
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+
 #include <cassert>
 #include <cstdio>
+
+// exact-IO helpers live in the shared ptpu_wire.h (the serving TU no
+// longer re-exports them into its anonymous namespace)
+using ptpu::ReadExact;
+using ptpu::WriteExact;
 
 namespace {
 

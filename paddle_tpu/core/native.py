@@ -300,7 +300,8 @@ def aes_ctr_xcrypt(key: bytes, iv: bytes, data: bytes) -> bytes:
 
 _PS_SO = os.path.join(_PKG_DIR, "_native_ps.so")
 _PS_SRCS = [os.path.join(os.path.dirname(_PKG_DIR), "csrc", f)
-            for f in ("ptpu_ps_table.cc", "ptpu_ps_server.cc")]
+            for f in ("ptpu_ps_table.cc", "ptpu_ps_server.cc",
+                      "ptpu_net.cc")]
 _PS_LIB: Optional[ctypes.CDLL] = None
 _PS_TRIED = False
 _PS_LOCK = threading.Lock()

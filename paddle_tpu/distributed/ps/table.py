@@ -56,19 +56,44 @@ class _DataConn:
     `recv_bytes` returns a zero-copy view of a reused buffer, valid
     until the NEXT recv on this connection."""
 
+    # Bounded connect retry: during server start (port advertised but
+    # the listener not yet up) or drain (accept closed, RST/EOF before
+    # the nonce) a dial sees transient ECONNREFUSED/ECONNRESET — retry
+    # with backoff inside this budget instead of making every caller
+    # sleep-and-hope. A REJECTED handshake (wrong key) never retries.
+    CONNECT_RETRY_S = 5.0
+
     def __init__(self, host: str, port: int, authkey: bytes):
         import hmac
         import socket
         import struct
+        import time
         self._struct = struct
-        s = socket.create_connection((host, port), timeout=60)
-        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        # match the server's buffer: pipelined replies keep MBs in
-        # flight per connection
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4 << 20)
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4 << 20)
-        self._s = s
-        nonce = self._recv_exact(bytearray(16))
+        deadline = time.monotonic() + self.CONNECT_RETRY_S
+        delay = 0.02
+        while True:
+            s = None
+            try:
+                s = socket.create_connection((host, port), timeout=60)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # match the server's buffer: pipelined replies keep MBs
+                # in flight per connection
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4 << 20)
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4 << 20)
+                self._s = s
+                nonce = self._recv_exact(bytearray(16))
+                break
+            except (ConnectionError, BrokenPipeError, EOFError) as e:
+                if s is not None:
+                    s.close()
+                if time.monotonic() + delay > deadline:
+                    raise ConnectionError(
+                        f"PS data plane at {host}:{port} not reachable "
+                        f"within {self.CONNECT_RETRY_S:.0f}s "
+                        f"({type(e).__name__}: {e}) — server down or "
+                        f"still starting") from e
+                time.sleep(delay)
+                delay = min(delay * 2, 0.5)
         mac = hmac.new(authkey, bytes(nonce), "sha256").digest()
         s.sendall(struct.pack("<I", 32) + mac)
         ok = self._recv_exact(bytearray(1))

@@ -26,6 +26,7 @@ import json
 import os
 import socket
 import struct
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -147,15 +148,46 @@ def _read_exact(sock: socket.socket, n: int) -> bytes:
 
 
 class InferenceClient:
-    """Framed-wire client for the native serving runtime."""
+    """Framed-wire client for the native serving runtime.
+
+    Connecting retries transient ``ECONNREFUSED``/``ECONNRESET``/
+    EOF-before-nonce failures (server still starting, draining, or
+    shedding above its max-conns cap) with exponential backoff for up
+    to ``connect_retry_s`` seconds, then raises a clear
+    :class:`ServingError`. A REJECTED handshake (wrong authkey) is
+    never retried."""
 
     def __init__(self, port: int, authkey: bytes,
-                 host: str = "127.0.0.1", timeout_s: float = 60.0):
-        self._sock = socket.create_connection((host, port),
-                                              timeout=timeout_s)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                 host: str = "127.0.0.1", timeout_s: float = 60.0,
+                 connect_retry_s: float = 5.0):
+        deadline = time.monotonic() + connect_retry_s
+        delay = 0.02
+        while True:
+            sock = None
+            try:
+                sock = socket.create_connection((host, port),
+                                                timeout=timeout_s)
+                sock.setsockopt(socket.IPPROTO_TCP,
+                                socket.TCP_NODELAY, 1)
+                self._sock = sock
+                nonce = _read_exact(sock, 16)
+                break
+            # refused (starting), reset, or EOF-before-nonce (draining
+            # / shed) — all transient; rejection happens after this
+            # loop and is never retried
+            except (ConnectionError, BrokenPipeError) as e:
+                if sock is not None:
+                    sock.close()
+                if time.monotonic() + delay > deadline:
+                    raise ServingError(
+                        f"serving runtime at {host}:{port} not "
+                        f"reachable within {connect_retry_s:.0f}s "
+                        f"({type(e).__name__}: {e}) — server down, "
+                        f"still starting, or shedding connections"
+                    ) from e
+                time.sleep(delay)
+                delay = min(delay * 2, 0.5)
         self._next_id = 0
-        nonce = _read_exact(self._sock, 16)
         mac = _hmac.new(authkey, nonce, hashlib.sha256).digest()
         self._sock.sendall(_U32.pack(len(mac)) + mac)
         if _read_exact(self._sock, 1) != b"\x01":

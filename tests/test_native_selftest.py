@@ -43,9 +43,11 @@ SAN_BINARIES = {
     "asan,ubsan": ["ptpu_selftest.san-asan-ubsan",
                    "ptpu_ps_selftest.san-asan-ubsan",
                    "ptpu_serving_selftest.san-asan-ubsan",
+                   "ptpu_net_selftest.san-asan-ubsan",
                    "ptpu_predictor_demo.san-asan-ubsan"],
     "tsan": ["ptpu_selftest.san-tsan", "ptpu_ps_selftest.san-tsan",
              "ptpu_serving_selftest.san-tsan",
+             "ptpu_net_selftest.san-tsan",
              "ptpu_predictor_demo.san-tsan"],
 }
 

@@ -1,0 +1,70 @@
+"""net_bench `--out` persistence + exactness contract (ISSUE 7
+satellite; pattern of tests/test_ps_bench_persist.py).
+
+Runs `tools/net_bench.py` as a subprocess with a shrunken config
+(48 conns over 2 procs against the PS data plane; the serving leg is
+shrunk too but skips itself cleanly when the serving runtime is
+unavailable), asserts the persisted JSON schema, the conns-held gauge,
+and the zero-protocol-error / counters-exact row the C10K acceptance
+gates on.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "tools", "net_bench.py")
+
+
+@pytest.fixture(scope="module")
+def bench_out(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("netb") / "BENCH_NET.json")
+    env = dict(os.environ)
+    env.update({
+        "PTPU_NETBENCH_CONNS": "48", "PTPU_NETBENCH_PROCS": "2",
+        "PTPU_NETBENCH_OPS": "3", "PTPU_NETBENCH_BATCH": "4",
+        "PTPU_NETBENCH_DIM": "8", "PTPU_NETBENCH_SERVING_CONNS": "16",
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, BENCH, "--out", out], cwd=REPO,
+                       env=env, capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, \
+        f"rc={r.returncode}\nstdout:{r.stdout[-2000:]}\n" \
+        f"stderr:{r.stderr[-2000:]}"
+    with open(out) as f:
+        return json.load(f)
+
+
+class TestNetBenchPersist:
+    def test_schema(self, bench_out):
+        assert bench_out["bench"] == "net_bench"
+        for key in ("conns", "procs", "ops_per_conn", "batch", "dim"):
+            assert isinstance(bench_out[key], int)
+        rows = bench_out["measurements"]
+        assert rows, "no measurements persisted"
+        for row in rows:
+            assert {"metric", "value", "unit"} <= set(row)
+
+    def test_all_conns_held_concurrently(self, bench_out):
+        by = {r["metric"]: r for r in bench_out["measurements"]}
+        held = by["net_c10k_conns_held"]
+        assert held["value"] == held["target"] == 48
+
+    def test_counters_exact_and_zero_errors(self, bench_out):
+        by = {r["metric"]: r for r in bench_out["measurements"]}
+        row = by["net_c10k_counters_exact"]
+        assert row["value"] == 1, row
+        assert row["proto_errors"] == 0
+        assert row["handshake_fails"] == 0
+        assert row["client_ops"] == row["expected_ops"]
+
+    def test_throughput_positive(self, bench_out):
+        by = {r["metric"]: r for r in bench_out["measurements"]}
+        assert by["net_c10k_pull_ops_per_s"]["value"] > 0
+        assert by["net_c10k_pull_ops_per_s"]["client_errors"] == 0

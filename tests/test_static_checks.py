@@ -30,7 +30,8 @@ spec.loader.exec_module(ptpu_check)
 ABI_FILES = [
     "csrc/ptpu_runtime.cc", "csrc/ptpu_ps_table.cc",
     "csrc/ptpu_ps_server.cc", "csrc/ptpu_predictor.cc",
-    "csrc/ptpu_serving.cc", "csrc/ptpu_inference_api.h",
+    "csrc/ptpu_serving.cc", "csrc/ptpu_net.cc",
+    "csrc/ptpu_inference_api.h",
     "paddle_tpu/core/native.py", "goapi/predictor.go",
 ]
 WIRE_FILES = [
@@ -42,6 +43,10 @@ STATS_FILES = [
     "csrc/ptpu_ps_table.cc", "csrc/ptpu_ps_server.cc",
     "csrc/ptpu_stats.h", "paddle_tpu/distributed/ps/table.py",
     "paddle_tpu/profiler/stats.py",
+]
+NET_FILES = [
+    "csrc/ptpu_net.cc", "csrc/ptpu_net.h",
+    "csrc/ptpu_ps_server.cc", "csrc/ptpu_serving.cc",
 ]
 
 
@@ -79,7 +84,8 @@ class TestLiveTree:
                            capture_output=True, text=True, timeout=60)
         assert r.returncode == 0
         names = set(r.stdout.split())
-        assert names == {"abi", "wire", "stats", "locks", "nullcheck"}
+        assert names == {"abi", "wire", "stats", "locks", "net",
+                         "nullcheck"}
 
 
 class TestAbiChecker:
@@ -233,6 +239,50 @@ class TestLocksChecker:
         msgs = [f.message for f in _run(root, "locks")]
         assert any("pthread_mutex_lock" in m for m in msgs)
         assert any("__sync_fetch_and_add" in m for m in msgs)
+
+
+class TestNetChecker:
+    """The C10K regression gate: the epoll core's fd discipline and
+    the thread-per-connection ban in the two wire servers."""
+
+    def test_clean_fixture(self, tmp_path):
+        assert _run(_fixture(tmp_path, NET_FILES), "net") == []
+
+    def test_catches_blocking_fd_in_epoll(self, tmp_path):
+        """Dropping the nonblocking proof for a conn fd entering the
+        epoll set is the exact bug that stalls a whole event loop."""
+        root = _fixture(tmp_path, NET_FILES)
+        _mutate(root, "csrc/ptpu_net.cc",
+                "SetNonBlocking(c->fd_);", "/* nonblocking elided */")
+        msgs = [f.message for f in _run(root, "net")]
+        assert any("c->fd_" in m and "nonblocking" in m for m in msgs)
+
+    def test_catches_unhandled_epollerr(self, tmp_path):
+        root = _fixture(tmp_path, NET_FILES)
+        _mutate(root, "csrc/ptpu_net.cc",
+                "(EPOLLERR | EPOLLHUP)", "(EPOLLERR | EPOLLERR)")
+        msgs = [f.message for f in _run(root, "net")]
+        assert any("EPOLLHUP" in m for m in msgs)
+
+    def test_catches_accept_loop_reappearing(self, tmp_path):
+        """A server TU growing its own accept() call is the first step
+        back toward thread-per-connection — flagged immediately."""
+        root = _fixture(tmp_path, NET_FILES)
+        _mutate(root, "csrc/ptpu_ps_server.cc",
+                "bool Start(int want_port",
+                "int Rogue(int lfd) { return accept(lfd, 0, 0); }\n"
+                "  bool Start(int want_port")
+        msgs = [f.message for f in _run(root, "net")]
+        assert any("accept()" in m and "ptpu_net" in m for m in msgs)
+
+    def test_catches_conn_thread_bookkeeping(self, tmp_path):
+        root = _fixture(tmp_path, NET_FILES)
+        _mutate(root, "csrc/ptpu_serving.cc",
+                "std::unique_ptr<ptpu::net::Server> net_srv;",
+                "std::unique_ptr<ptpu::net::Server> net_srv;\n"
+                "  std::vector<std::thread> conn_threads;")
+        msgs = [f.message for f in _run(root, "net")]
+        assert any("thread-per-connection" in m for m in msgs)
 
 
 class TestNullcheckChecker:

@@ -1,0 +1,377 @@
+#!/usr/bin/env python
+"""C10K bench for the shared epoll network core (ISSUE 7 tentpole).
+
+Drives the REAL C PS data-plane server (csrc/ptpu_ps_server.cc over
+csrc/ptpu_net.cc) with thousands of CONCURRENT framed clients from an
+epoll-based multi-connection client (selectors.DefaultSelector — epoll
+on Linux), spread over NPROC client processes:
+
+  1. ramp    — every process connects + HMAC-handshakes its share of
+               connections (chunked so the listen backlog never
+               overflows); all processes barrier with every connection
+               OPEN, and the parent samples the server's live
+               conns_active gauge at the hold point;
+  2. ops     — every connection issues OPS_PER_CONN small framed pulls,
+               one in flight per connection, driven by the epoll
+               client loop; per-request latency is recorded;
+  3. drain   — connections close; the parent checks the server's
+               counters against the client-observed totals EXACTLY
+               (zero protocol errors, zero handshake failures).
+
+An optional serving leg repeats the hold + ops pattern against the
+inference runtime (csrc/ptpu_serving.cc) with a small MLP artifact —
+skipped when the serving runtime or jax is unavailable.
+
+The headline is connection SCALE and tail latency, not bandwidth
+(this box's loopback plateaus at ~2.6-2.9 GB/s; see ROADMAP): the
+acceptance gate is >= 5,000 concurrent framed clients served with
+zero protocol errors and counters exact.
+
+Config via env: PTPU_NETBENCH_{CONNS,PROCS,OPS,BATCH,DIM,SERVING_CONNS}
+Run: python tools/net_bench.py [--out BENCH_NET_r01.json]
+"""
+from __future__ import annotations
+
+import json
+import multiprocessing as mp
+import os
+import resource
+import selectors
+import socket
+import struct
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CONNS = int(os.environ.get("PTPU_NETBENCH_CONNS", 5120))
+PROCS = int(os.environ.get("PTPU_NETBENCH_PROCS", 8))
+OPS = int(os.environ.get("PTPU_NETBENCH_OPS", 5))       # per conn
+BATCH = int(os.environ.get("PTPU_NETBENCH_BATCH", 8))   # ids per pull
+DIM = int(os.environ.get("PTPU_NETBENCH_DIM", 16))
+SERVING_CONNS = int(os.environ.get("PTPU_NETBENCH_SERVING_CONNS", 1024))
+AUTHKEY = b"net-bench-key"
+
+_U32 = struct.Struct("<I")
+
+RESULTS: list = []
+
+
+def emit(row: dict):
+    RESULTS.append(row)
+    print(json.dumps(row), flush=True)
+
+
+def _raise_nofile(need: int):
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    want = min(hard, max(soft, need + 256))
+    if want > soft:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (want, hard))
+
+
+# ---------------------------------------------------------------------------
+# epoll client (one process's share of the connection herd)
+# ---------------------------------------------------------------------------
+
+class _Conn:
+    __slots__ = ("sock", "ops_left", "t_sent", "rx", "want",
+                 "latencies", "errors")
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.ops_left = OPS
+        self.t_sent = 0.0
+        self.rx = bytearray()
+        self.want = 4
+        self.latencies = []
+        self.errors = 0
+
+
+def _client_proc(pidx, my_conns, port, req_frame, rep_tag, rep_len,
+                 barrier, q):
+    """Connect `my_conns` conns, barrier at the hold point, then run
+    the request loop over one shared epoll selector. (`my_conns` is an
+    explicit arg: under the spawn start method children re-derive
+    module globals from env, so a parent-side override would be
+    lost.)"""
+    import hashlib
+    import hmac
+    _raise_nofile(my_conns)
+    conns = []
+    t_ramp0 = time.perf_counter()
+    for i in range(my_conns):
+        s = socket.create_connection(("127.0.0.1", port), timeout=60)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # blocking handshake during ramp (simple + it IS the slow-path
+        # the server must survive 5k times over)
+        nonce = b""
+        while len(nonce) < 16:
+            c = s.recv(16 - len(nonce))
+            if not c:
+                raise ConnectionError("EOF during bench handshake")
+            nonce += c
+        mac = hmac.new(AUTHKEY, nonce, hashlib.sha256).digest()
+        s.sendall(_U32.pack(32) + mac)
+        ok = s.recv(1)
+        if ok != b"\x01":
+            raise ConnectionError("bench handshake rejected")
+        conns.append(_Conn(s))
+        if i % 64 == 63:
+            time.sleep(0.001)  # keep the SYN burst under the backlog
+    t_ramp = time.perf_counter() - t_ramp0
+
+    barrier.wait(timeout=600)   # every process fully connected (hold)
+    barrier.wait(timeout=600)   # parent sampled conns_active
+
+    sel = selectors.DefaultSelector()
+    framed = _U32.pack(len(req_frame)) + req_frame
+    for c in conns:
+        c.sock.setblocking(False)
+        sel.register(c.sock, selectors.EVENT_READ, c)
+        c.t_sent = time.perf_counter()
+        c.sock.sendall(framed)  # first request (fits the send buffer)
+    pending = len(conns)
+    t_ops0 = time.perf_counter()
+    while pending > 0:
+        for key, _ in sel.select(timeout=30):
+            c = key.data
+            try:
+                chunk = c.sock.recv(65536)
+            except BlockingIOError:
+                continue
+            if not chunk:
+                c.errors += 1
+                sel.unregister(c.sock)
+                pending -= 1
+                continue
+            c.rx += chunk
+            # parse complete reply frames out of the stream
+            while True:
+                if len(c.rx) < 4:
+                    break
+                n = _U32.unpack_from(c.rx, 0)[0]
+                if len(c.rx) < 4 + n:
+                    break
+                frame = bytes(c.rx[4:4 + n])
+                del c.rx[:4 + n]
+                if (rep_len is not None and n != rep_len) or \
+                        len(frame) < 2 or frame[1] != rep_tag:
+                    c.errors += 1
+                c.latencies.append(time.perf_counter() - c.t_sent)
+                c.ops_left -= 1
+                if c.ops_left > 0:
+                    c.t_sent = time.perf_counter()
+                    c.sock.sendall(framed)
+                else:
+                    sel.unregister(c.sock)
+                    pending -= 1
+                    break
+    t_ops = time.perf_counter() - t_ops0
+    lats, errs = [], 0
+    for c in conns:
+        lats.extend(c.latencies)
+        errs += c.errors
+        c.sock.close()
+    sel.close()
+    q.put({"pidx": pidx, "conns": my_conns, "t_ramp": t_ramp,
+           "t_ops": t_ops, "latencies": lats, "errors": errs})
+
+
+# ---------------------------------------------------------------------------
+# PS leg
+# ---------------------------------------------------------------------------
+
+def run_ps_leg():
+    import numpy as np
+
+    from paddle_tpu.core import native
+    from paddle_tpu.distributed.ps import wire
+
+    if not native.ps_server_available():
+        emit({"metric": "net_c10k_conns_held", "value": 0,
+              "unit": "conns", "note": "native PS server unavailable"})
+        return
+
+    _raise_nofile(CONNS)
+    vocab = 4096
+    table = native.NativePsTable(vocab, DIM, "sgd", lr=1.0)
+    table.data[:] = np.random.RandomState(0).randn(
+        vocab, DIM).astype(np.float32)
+    srv = native.PsDataServer(0, AUTHKEY)
+    srv.register("t", table, lo=0)
+
+    ids = np.arange(BATCH, dtype=np.int64)
+    req = bytes(wire.build_pull_req("t", ids))
+    rep_len = 10 + BATCH * DIM * 4   # PULL_REP header + body
+
+    barrier = mp.Barrier(PROCS + 1)
+    q: "mp.Queue" = mp.Queue()
+    shares = [CONNS // PROCS + (1 if i < CONNS % PROCS else 0)
+              for i in range(PROCS)]
+    procs = [mp.Process(target=_client_proc,
+                        args=(i, shares[i], srv.port, req, 0x51,
+                              rep_len, barrier, q))
+             for i in range(PROCS)]
+    t0 = time.perf_counter()
+    for p in procs:
+        p.start()
+    barrier.wait(timeout=600)          # hold point: all conns open
+    held = srv.stats()["server"]["conns_active"]
+    t_all_connected = time.perf_counter() - t0
+    barrier.wait(timeout=600)          # release the op phase
+
+    res = [q.get(timeout=600) for _ in range(PROCS)]
+    for p in procs:
+        p.join(timeout=120)
+
+    lats = sorted(x for r in res for x in r["latencies"])
+    total_ops = len(lats)
+    errors = sum(r["errors"] for r in res)
+    wall = max(r["t_ops"] for r in res)
+    st = srv.stats()["server"]
+
+    def pct(p):
+        return round(lats[min(len(lats) - 1,
+                              int(p * len(lats)))] * 1e3, 3)
+
+    emit({"metric": "net_c10k_conns_held", "value": int(held),
+          "unit": "conns", "target": CONNS, "procs": PROCS,
+          "ramp_s": round(t_all_connected, 2),
+          "note": "live conns_active gauge with every client open"})
+    emit({"metric": "net_c10k_pull_ops_per_s",
+          "value": round(total_ops / wall, 1), "unit": "ops/s",
+          "conns": CONNS, "ops_per_conn": OPS, "batch": BATCH,
+          "dim": DIM, "p50_ms": pct(0.50), "p99_ms": pct(0.99),
+          "client_errors": errors})
+    emit({"metric": "net_c10k_counters_exact",
+          "value": int(errors == 0 and
+                       st["conns_accepted"] == CONNS and
+                       st["pull_ops"] == total_ops and
+                       total_ops == CONNS * OPS and
+                       st["proto_errors"] == 0 and
+                       st["handshake_fails"] == 0 and
+                       st["err_frames"] == 0),
+          "unit": "bool", "server_conns_accepted": st["conns_accepted"],
+          "server_pull_ops": st["pull_ops"],
+          "client_ops": total_ops, "expected_ops": CONNS * OPS,
+          "proto_errors": st["proto_errors"],
+          "handshake_fails": st["handshake_fails"],
+          "conns_shed": st["conns_shed"],
+          "epoll_wakeups": st["epoll_wakeups"],
+          "partial_write_flushes": st["partial_write_flushes"]})
+    srv.stop()
+    table.close()
+
+
+# ---------------------------------------------------------------------------
+# serving leg (INFER frames through the micro-batcher)
+# ---------------------------------------------------------------------------
+
+def run_serving_leg(tmpdir):
+    try:
+        import jax.numpy as jnp
+        import numpy as np
+
+        import paddle_tpu as pt
+        from paddle_tpu.core import native
+        from paddle_tpu.onnx.converter import trace_to_onnx
+        if not native.serving_available():
+            raise RuntimeError("serving unavailable")
+    except Exception as e:  # noqa: BLE001 — leg is optional
+        emit({"metric": "net_serving_conns_held", "value": 0,
+              "unit": "conns", "note": f"skipped: {e!r}"})
+        return
+
+    from paddle_tpu.inference import create_server
+
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(16, 32), pt.nn.ReLU(),
+                           pt.nn.Linear(32, 4))
+    net.eval()
+    path = os.path.join(tmpdir, "net_bench_mlp.onnx")
+    with open(path, "wb") as f:
+        f.write(trace_to_onnx(
+            lambda a: net(a),
+            (jnp.asarray(np.zeros((2, 16), np.float32)),)))
+
+    srv = create_server(path, authkey=AUTHKEY, max_batch=32,
+                        deadline_us=2000, instances=2)
+
+    # one-row INFER frame (id 7): [ver][tag][u64 id][u16 nin]
+    # [dtype][ndim][dims][f32 raw]
+    x = np.full((1, 16), 0.5, np.float32)
+    req = bytearray([1, 0x60])
+    req += struct.pack("<Q", 7) + struct.pack("<H", 1)
+    req += bytes([1, 2]) + struct.pack("<qq", 1, 16) + x.tobytes()
+
+    nconns, nprocs = SERVING_CONNS, max(2, PROCS // 2)
+    try:
+        barrier = mp.Barrier(nprocs + 1)
+        q: "mp.Queue" = mp.Queue()
+        shares = [nconns // nprocs + (1 if i < nconns % nprocs else 0)
+                  for i in range(nprocs)]
+        procs = [mp.Process(target=_client_proc,
+                            args=(i, shares[i], srv.port, bytes(req),
+                                  0x61, None, barrier, q))
+                 for i in range(nprocs)]
+        for p in procs:
+            p.start()
+        barrier.wait(timeout=600)
+        held = srv.stats()["server"]["conns_active"]
+        barrier.wait(timeout=600)
+        res = [q.get(timeout=600) for _ in range(nprocs)]
+        for p in procs:
+            p.join(timeout=120)
+        lats = sorted(x2 for r in res for x2 in r["latencies"])
+        errors = sum(r["errors"] for r in res)
+        wall = max(r["t_ops"] for r in res)
+        st = srv.stats()
+        emit({"metric": "net_serving_conns_held", "value": int(held),
+              "unit": "conns", "target": nconns})
+        emit({"metric": "net_serving_infer_ops_per_s",
+              "value": round(len(lats) / wall, 1), "unit": "ops/s",
+              "conns": nconns, "ops_per_conn": OPS,
+              "p50_ms": round(lats[len(lats) // 2] * 1e3, 3),
+              "p99_ms": round(lats[min(len(lats) - 1,
+                                       int(0.99 * len(lats)))] * 1e3,
+                              3),
+              "client_errors": errors,
+              "server_requests": st["server"]["requests"],
+              "server_replies": st["server"]["replies"],
+              "batches": st["batcher"]["batches"],
+              "counters_exact": int(
+                  errors == 0 and
+                  st["server"]["requests"] == nconns * OPS and
+                  st["server"]["replies"] == nconns * OPS and
+                  st["server"]["proto_errors"] == 0)})
+    finally:
+        srv.stop()
+
+
+def main():
+    out_path = None
+    if "--out" in sys.argv:
+        idx = sys.argv.index("--out")
+        if idx + 1 >= len(sys.argv):
+            sys.exit("usage: net_bench.py [--out RESULTS.json]")
+        out_path = sys.argv[idx + 1]
+
+    import tempfile
+    run_ps_leg()
+    with tempfile.TemporaryDirectory() as td:
+        run_serving_leg(td)
+
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump({"bench": "net_bench", "conns": CONNS,
+                       "procs": PROCS, "ops_per_conn": OPS,
+                       "batch": BATCH, "dim": DIM,
+                       "serving_conns": SERVING_CONNS,
+                       "measurements": RESULTS}, f, indent=1)
+        print(f"# persisted to {out_path}", flush=True)
+
+
+if __name__ == "__main__":
+    mp.set_start_method("spawn")
+    main()

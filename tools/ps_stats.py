@@ -69,11 +69,15 @@ def _rates(prev: dict, cur: dict, dt: float) -> str:
         return (cur.get("wire", {}).get(key, 0) -
                 prev.get("wire", {}).get(key, 0))
     mb = (d("bytes_in") + d("bytes_out")) / dt / 1e6
+    # live connection view from the epoll net core (C data plane)
+    conns = cur.get("wire", {}).get("conns_active", 0)
+    shed = cur.get("wire", {}).get("conns_shed", 0)
     return (f"pull {d('pull_ops') / dt:,.0f} ops/s "
             f"({d('pull_rows') / dt:,.0f} rows/s) | "
             f"push {d('push_ops') / dt:,.0f} ops/s "
             f"({d('push_rows') / dt:,.0f} rows/s) | "
-            f"{mb:,.1f} MB/s")
+            f"{mb:,.1f} MB/s | conns {conns}"
+            + (f" (shed {shed})" if shed else ""))
 
 
 def main(argv=None):

@@ -22,6 +22,11 @@ Go that the compiler cannot see across:
              sits in a re-check loop), no bare pthread_* / __sync_* /
              __atomic_* primitives (std:: only — TSan-visible and
              portable)
+  net        epoll-core discipline: every fd registered with epoll is
+             provably nonblocking, every epoll_wait loop handles
+             EPOLLERR/EPOLLHUP, and the two wire servers never regrow
+             a direct accept() loop or per-connection threads
+             (csrc/ptpu_net.cc is the one place that owns sockets)
   nullcheck  every extern-C ABI entry taking an opaque handle guards
              NULL before dereferencing (ctypes/cgo can always hand one
              back after a failed create or a teardown race)
@@ -156,9 +161,11 @@ def _lineno(src: str, pos: int) -> int:
 # csrc definition files per shared object — the unit the manifest keys on
 SO_SOURCES = {
     "_native.so": ["csrc/ptpu_runtime.cc"],
-    "_native_ps.so": ["csrc/ptpu_ps_table.cc", "csrc/ptpu_ps_server.cc"],
+    "_native_ps.so": ["csrc/ptpu_ps_table.cc", "csrc/ptpu_ps_server.cc",
+                      "csrc/ptpu_net.cc"],
     "_native_predictor.so": ["csrc/ptpu_predictor.cc",
-                             "csrc/ptpu_serving.cc"],
+                             "csrc/ptpu_serving.cc",
+                             "csrc/ptpu_net.cc"],
 }
 
 _EXPORT_RES = [
@@ -467,8 +474,13 @@ def py_stat_names(src: str) -> Set[str]:
 
 # C-only wire counters: the Python control-plane has no handshake (the
 # multiprocessing listener authenticates internally) and tracks
-# connection lifetime differently. Additions here must be justified.
-PS_SERVER_C_ONLY = {"handshake_fails", "conns_accepted", "conns_active"}
+# connection lifetime differently; the epoll net-core counters
+# (csrc/ptpu_net.h Stats) have no Python plane at all — the fallback
+# serve loop is thread-per-connection multiprocessing.connection.
+# Additions here must be justified.
+PS_SERVER_C_ONLY = {"handshake_fails", "conns_accepted", "conns_active",
+                    "conns_shed", "handshake_timeouts", "idle_closes",
+                    "epoll_wakeups", "partial_write_flushes"}
 
 
 def check_stats(root: str) -> List[Finding]:
@@ -624,6 +636,88 @@ def check_locks(root: str) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# checker: net
+# ---------------------------------------------------------------------------
+
+# The two wire servers ride the shared epoll core (csrc/ptpu_net.cc).
+# This checker keeps the C10K refactor from regressing: no direct
+# accept() loops or per-connection thread bookkeeping may reappear in
+# the server TUs, every fd an event loop registers must be provably
+# nonblocking, and every epoll_wait loop must handle EPOLLERR/EPOLLHUP
+# (an unhandled error event spins a level-triggered loop at 100% CPU).
+NET_SERVER_FILES = ["csrc/ptpu_ps_server.cc", "csrc/ptpu_serving.cc"]
+
+_EPOLL_ADD_RE = re.compile(
+    r"epoll_ctl\s*\([^,]+,\s*EPOLL_CTL_ADD\s*,\s*([A-Za-z_]\w*"
+    r"(?:(?:->|\.)\w+)*)")
+
+
+def check_net(root: str) -> List[Finding]:
+    f: List[Finding] = []
+    csrc = os.path.join(root, "csrc")
+    if not os.path.isdir(csrc):
+        f.append(Finding("net", "csrc", 0, "csrc directory missing"))
+        return f
+    for fname in sorted(os.listdir(csrc)):
+        if not (fname.endswith(".cc") or fname.endswith(".h")):
+            continue
+        rel = f"csrc/{fname}"
+        src = _read(root, rel)
+        if src is None:
+            continue
+        clean = strip_c_comments(src)
+        # 1) every fd entering an epoll set must be set nonblocking —
+        #    a blocking fd in a level-triggered loop stalls EVERY
+        #    connection that loop owns. Accepted proofs, per fd
+        #    expression: a SetNonBlocking(fd) call, or creation with
+        #    EFD_NONBLOCK / SOCK_NONBLOCK.
+        for m in _EPOLL_ADD_RE.finditer(clean):
+            fd = m.group(1)
+            fd_re = re.escape(fd)
+            proven = (
+                re.search(rf"SetNonBlocking\s*\(\s*{fd_re}\s*\)", clean)
+                or re.search(rf"{fd_re}\s*=[^;]*EFD_NONBLOCK", clean)
+                or re.search(rf"{fd_re}\s*=[^;]*SOCK_NONBLOCK", clean))
+            if not proven:
+                f.append(Finding(
+                    "net", rel, _lineno(clean, m.start()),
+                    f"fd '{fd}' is registered with EPOLL_CTL_ADD but "
+                    f"never provably set nonblocking (SetNonBlocking / "
+                    f"EFD_NONBLOCK / SOCK_NONBLOCK) — a blocking fd "
+                    f"stalls the whole event loop"))
+        # 2) every event loop must handle error/hangup events
+        if re.search(r"\bepoll_wait\s*\(", clean):
+            for flag in ("EPOLLERR", "EPOLLHUP"):
+                if not re.search(rf"\b{flag}\b", clean):
+                    f.append(Finding(
+                        "net", rel, 0,
+                        f"file calls epoll_wait but never handles "
+                        f"{flag} — an errored fd spins a "
+                        f"level-triggered loop forever"))
+    # 3) the servers must stay on the shared core: no direct accept()
+    #    and no per-connection thread bookkeeping (the r7-era
+    #    conn_threads pattern) may reappear
+    for rel in NET_SERVER_FILES:
+        src = _require(root, rel, "net", f)
+        if src is None:
+            continue
+        clean = strip_c_comments(src)
+        for m in re.finditer(r"\baccept\s*\(", clean):
+            f.append(Finding(
+                "net", rel, _lineno(clean, m.start()),
+                "direct accept() call — connection accept/dispatch "
+                "belongs to the shared epoll core (csrc/ptpu_net.cc); "
+                "register a frame handler instead"))
+        for m in re.finditer(r"\bconn_threads?\b", clean):
+            f.append(Finding(
+                "net", rel, _lineno(clean, m.start()),
+                "per-connection thread bookkeeping reappeared — the "
+                "thread-per-connection pattern is banned in the wire "
+                "servers (C10K: connections cost fds, not threads)"))
+    return f
+
+
+# ---------------------------------------------------------------------------
 # checker: nullcheck
 # ---------------------------------------------------------------------------
 
@@ -703,6 +797,7 @@ CHECKERS = {
     "wire": check_wire,
     "stats": check_stats,
     "locks": check_locks,
+    "net": check_net,
     "nullcheck": check_nullcheck,
 }
 
