@@ -88,6 +88,29 @@ int ptpu_predictor_output_ndim(PTPU_Predictor*, int i);
 const int64_t* ptpu_predictor_output_dims(PTPU_Predictor*, int i);
 const float* ptpu_predictor_output_data(PTPU_Predictor*, int i);
 
+/* ------------------------------------------------------------------ */
+/* KV-cached autoregressive decode (r9). A decode-step artifact
+ * (paddle_tpu.models.gpt.export_gpt_decode) follows the convention
+ *   inputs : [ids (B,1) int][pos (B) int] then per layer
+ *            [k_cache (B,P,H,D) f32][v_cache (B,P,H,D) f32]
+ *   outputs: [logits (B,...)] then per layer
+ *            [new_k (B,1,H,D)][new_v (B,1,H,D)].
+ * kv_plan validates it and allocates `sessions` per-session KV slots
+ * in ONE pre-planned cache block; decode_step batches one token step
+ * for up to B open sessions (append-position writes, no per-step
+ * allocation). Session slots: kv_open -> id (-1 when full; eviction
+ * policy belongs to the caller), kv_close frees + scrubs, kv_len is
+ * the appended position count. Thread contract matches run(). */
+int ptpu_predictor_kv_plan(PTPU_Predictor*, int sessions, char* err,
+                           int err_len);
+int ptpu_predictor_kv_sessions(PTPU_Predictor*);
+int ptpu_predictor_kv_open(PTPU_Predictor*);
+void ptpu_predictor_kv_close(PTPU_Predictor*, int sid);
+int64_t ptpu_predictor_kv_len(PTPU_Predictor*, int sid);
+int ptpu_predictor_decode_step(PTPU_Predictor*, const int64_t* sids,
+                               const int64_t* tokens, int n, char* err,
+                               int err_len);
+
 /* Serving stats since load (always-on): JSON {"runs","total_run_us",
  * "run_us":{count,sum,buckets[32] log2-us},"ops":{op:{calls,time_us,
  * bytes}}}. Pointer valid until the next stats_json call on this
@@ -124,6 +147,19 @@ void* ptpu_serving_start(const char* model_path, int port,
                          int max_batch, int64_t deadline_us,
                          int instances, int threads_per_instance,
                          int loopback_only, char* err, int err_len);
+
+/* Extended start (r9): decode_model_path (NULL/empty to disable) adds
+ * the KV-cached DECODE wire plane — sessions opened/stepped/closed
+ * over 0x65..0x69 frames, continuously batched through a dedicated
+ * micro-batcher at the decode artifact's baked batch size.
+ * kv_sessions <= 0 reads $PTPU_KV_SESSIONS (default 64). */
+void* ptpu_serving_start2(const char* model_path,
+                          const char* decode_model_path, int port,
+                          const char* authkey, int authkey_len,
+                          int max_batch, int64_t deadline_us,
+                          int instances, int threads_per_instance,
+                          int loopback_only, int kv_sessions, char* err,
+                          int err_len);
 int ptpu_serving_port(void*);
 
 /* Effective configuration as JSON (buckets built, instances, model

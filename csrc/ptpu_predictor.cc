@@ -25,6 +25,7 @@
 #include <cstring>
 #include <functional>
 #include <fstream>
+#include <limits>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -745,15 +746,71 @@ static inline void micro_kernel(const T* Ap, const T* Bp, T* C, int64_t ldc,
   }
 }
 
-#if defined(__AVX2__) && defined(__FMA__)
+#if defined(__x86_64__) || defined(__i386__)
+#define PTPU_X86 1
 #include <immintrin.h>
+#endif
+
+/* Runtime ISA dispatch (ISSUE r9 tentpole b). The shipped .so builds
+ * at the portable x86-64-v2 baseline, which used to mean NO vector
+ * micro-kernel at all unless the user rebuilt with -march=native. The
+ * vector kernels now compile unconditionally behind function-level
+ * `target` attributes (usable since GCC 4.9 without -mavx* on the
+ * command line) and ONE load-time cpuid probe picks the widest level
+ * the machine actually has: AVX-512F (one zmm per accumulator row),
+ * AVX2+FMA (the classic 12-ymm tile), or the portable scalar kernel.
+ * PTPU_ISA=generic|avx2|avx512 caps the level for parity testing —
+ * it can only lower, never enable what cpuid denies. */
+enum { ISA_GENERIC = 0, ISA_AVX2 = 1, ISA_AVX512 = 2 };
+
+static int isa_level() {
+#ifdef PTPU_X86
+  static const int lvl = [] {
+    const bool avx2 = __builtin_cpu_supports("avx2") &&
+                      __builtin_cpu_supports("fma");
+    const bool avx512 = avx2 && __builtin_cpu_supports("avx512f") &&
+                        __builtin_cpu_supports("avx512bw");
+    int got = avx512 ? ISA_AVX512 : avx2 ? ISA_AVX2 : ISA_GENERIC;
+    const char* e = std::getenv("PTPU_ISA");
+    if (e) {
+      if (!std::strcmp(e, "generic")) got = ISA_GENERIC;
+      else if (!std::strcmp(e, "avx2")) got = std::min(got, int(ISA_AVX2));
+    }
+    return got;
+  }();
+  return lvl;
+#else
+  return ISA_GENERIC;
+#endif
+}
+
+// AVX-512-VNNI int8 dot-product path (vpdpwssd over int16 pairs —
+// exact for int8 operands with int32 accumulation, same bound as
+// int8_depth_ok). PTPU_ISA / PTPU_ISA_VNNI=0 disable it for parity
+// runs; the int32 packed path remains the fallback everywhere.
+static bool isa_vnni() {
+#ifdef PTPU_X86
+  static const bool v = [] {
+    const char* e = std::getenv("PTPU_ISA_VNNI");
+    if (e && !std::strcmp(e, "0")) return false;
+    return isa_level() == ISA_AVX512 &&
+           bool(__builtin_cpu_supports("avx512vnni"));
+  }();
+  return v;
+#else
+  return false;
+#endif
+}
+
+#ifdef PTPU_X86
 /* Hand-vectorized full-tile fp32 micro-kernel: 6x16 = 12 ymm
  * accumulators + 2 B lanes + 1 broadcast — 15 of 16 registers, the
  * classic AVX2 register allocation. GCC only partially promotes the
  * generic template's accumulator array (measured ~5 GFLOP/s/core vs
- * ~50 here), so the hot full tiles get intrinsics; fringe tiles and
- * int32 stay on the generic kernel. */
-static inline void micro_tile_avx2(const float* Ap, const float* Bp,
+ * ~50 here), so the hot full tiles get intrinsics; fringe tiles stay
+ * on the generic kernel. */
+__attribute__((target("avx2,fma")))
+static void micro_tile_avx2(const float* Ap, const float* Bp,
                                    float* C, int64_t ldc, int64_t kc,
                                    bool first, bool last,
                                    const float* bias_n, const float* bias_m,
@@ -818,7 +875,8 @@ static inline void micro_tile_avx2(const float* Ap, const float* Bp,
 /* int32 sibling (the int8-executing artifacts): vpmulld + vpaddd, same
  * 6x16 register tiling. No bias/act epilogue — the integer paths are
  * never fusion targets (their dequant chains carry Casts). */
-static inline void micro_tile_avx2_i32(const int32_t* Ap, const int32_t* Bp,
+__attribute__((target("avx2")))
+static void micro_tile_avx2_i32(const int32_t* Ap, const int32_t* Bp,
                                        int32_t* C, int64_t ldc, int64_t kc,
                                        bool first) {
   __m256i acc[MR][2];
@@ -852,9 +910,80 @@ static inline void micro_tile_avx2_i32(const int32_t* Ap, const int32_t* Bp,
                         acc[r][1]);
   }
 }
-#endif  // __AVX2__ && __FMA__
 
-// full-tile dispatch: fp32/int32 go to the intrinsics kernels when built
+/* AVX-512 full tile: NR == 16 floats is exactly one zmm, so the 6x16
+ * tile is 6 zmm accumulators + 1 B lane + 1 broadcast — half the FMA
+ * issue count of the twin-ymm AVX2 form per k step on 512-bit FMA
+ * hardware. Same accumulation order, same epilogue semantics. */
+__attribute__((target("avx512f")))
+static void micro_tile_avx512(const float* Ap, const float* Bp, float* C,
+                              int64_t ldc, int64_t kc, bool first,
+                              bool last, const float* bias_n,
+                              const float* bias_m, int act) {
+  __m512 acc[MR];
+  if (first) {
+    for (int r = 0; r < MR; ++r) acc[r] = _mm512_setzero_ps();
+  } else {
+    for (int r = 0; r < MR; ++r) acc[r] = _mm512_loadu_ps(C + r * ldc);
+  }
+  for (int64_t k = 0; k < kc; ++k) {
+    const __m512 b = _mm512_loadu_ps(Bp + k * NR);
+    const float* a = Ap + k * MR;
+    for (int r = 0; r < MR; ++r)
+      acc[r] = _mm512_fmadd_ps(_mm512_set1_ps(a[r]), b, acc[r]);
+  }
+  if (last && (bias_n || bias_m || act != ACT_NONE)) {
+    if (act == ACT_NONE || act == ACT_RELU) {
+      const __m512 zero = _mm512_setzero_ps();
+      const __m512 bn = bias_n ? _mm512_loadu_ps(bias_n) : zero;
+      for (int r = 0; r < MR; ++r) {
+        const __m512 bm = bias_m ? _mm512_set1_ps(bias_m[r]) : zero;
+        __m512 v = _mm512_add_ps(_mm512_add_ps(acc[r], bn), bm);
+        if (act == ACT_RELU) v = _mm512_max_ps(v, zero);
+        _mm512_storeu_ps(C + r * ldc, v);
+      }
+    } else {  // transcendental epilogue: spill the tile, apply scalar
+      float tile[MR][NR];
+      for (int r = 0; r < MR; ++r) _mm512_storeu_ps(tile[r], acc[r]);
+      for (int r = 0; r < MR; ++r) {
+        const float bm = bias_m ? bias_m[r] : 0.f;
+        for (int c = 0; c < NR; ++c)
+          C[r * ldc + c] = act_apply(
+              tile[r][c] + bm + (bias_n ? bias_n[c] : 0.f), act);
+      }
+    }
+  } else {
+    for (int r = 0; r < MR; ++r) _mm512_storeu_ps(C + r * ldc, acc[r]);
+  }
+}
+
+__attribute__((target("avx512f")))
+static void micro_tile_avx512_i32(const int32_t* Ap, const int32_t* Bp,
+                                  int32_t* C, int64_t ldc, int64_t kc,
+                                  bool first) {
+  __m512i acc[MR];
+  if (first) {
+    for (int r = 0; r < MR; ++r) acc[r] = _mm512_setzero_si512();
+  } else {
+    for (int r = 0; r < MR; ++r)
+      acc[r] = _mm512_loadu_si512(
+          reinterpret_cast<const void*>(C + r * ldc));
+  }
+  for (int64_t k = 0; k < kc; ++k) {
+    const __m512i b = _mm512_loadu_si512(
+        reinterpret_cast<const void*>(Bp + k * NR));
+    const int32_t* a = Ap + k * MR;
+    for (int r = 0; r < MR; ++r)
+      acc[r] = _mm512_add_epi32(
+          acc[r], _mm512_mullo_epi32(_mm512_set1_epi32(a[r]), b));
+  }
+  for (int r = 0; r < MR; ++r)
+    _mm512_storeu_si512(reinterpret_cast<void*>(C + r * ldc), acc[r]);
+}
+#endif  // PTPU_X86
+
+// full-tile dispatch: fp32/int32 route to the widest intrinsics kernel
+// the load-time cpuid probe admitted; fringe tiles stay generic
 template <class T>
 static inline void micro_tile(const T* Ap, const T* Bp, T* C, int64_t ldc,
                               int64_t kc, int64_t mr, int64_t nr,
@@ -863,28 +992,46 @@ static inline void micro_tile(const T* Ap, const T* Bp, T* C, int64_t ldc,
   micro_kernel(Ap, Bp, C, ldc, kc, mr, nr, first, last, bias_n, bias_m,
                act);
 }
-#if defined(__AVX2__) && defined(__FMA__)
+#ifdef PTPU_X86
 static inline void micro_tile(const float* Ap, const float* Bp, float* C,
                               int64_t ldc, int64_t kc, int64_t mr,
                               int64_t nr, bool first, bool last,
                               const float* bias_n, const float* bias_m,
                               int act) {
-  if (mr == MR && nr == NR)
-    micro_tile_avx2(Ap, Bp, C, ldc, kc, first, last, bias_n, bias_m, act);
-  else
-    micro_kernel(Ap, Bp, C, ldc, kc, mr, nr, first, last, bias_n, bias_m,
-                 act);
+  if (mr == MR && nr == NR) {
+    const int lvl = isa_level();
+    if (lvl == ISA_AVX512) {
+      micro_tile_avx512(Ap, Bp, C, ldc, kc, first, last, bias_n, bias_m,
+                        act);
+      return;
+    }
+    if (lvl == ISA_AVX2) {
+      micro_tile_avx2(Ap, Bp, C, ldc, kc, first, last, bias_n, bias_m,
+                      act);
+      return;
+    }
+  }
+  micro_kernel(Ap, Bp, C, ldc, kc, mr, nr, first, last, bias_n, bias_m,
+               act);
 }
 static inline void micro_tile(const int32_t* Ap, const int32_t* Bp,
                               int32_t* C, int64_t ldc, int64_t kc,
                               int64_t mr, int64_t nr, bool first,
                               bool last, const int32_t* bias_n,
                               const int32_t* bias_m, int act) {
-  if (mr == MR && nr == NR && !bias_n && !bias_m && act == ACT_NONE)
-    micro_tile_avx2_i32(Ap, Bp, C, ldc, kc, first);
-  else
-    micro_kernel(Ap, Bp, C, ldc, kc, mr, nr, first, last, bias_n, bias_m,
-                 act);
+  if (mr == MR && nr == NR && !bias_n && !bias_m && act == ACT_NONE) {
+    const int lvl = isa_level();
+    if (lvl == ISA_AVX512) {
+      micro_tile_avx512_i32(Ap, Bp, C, ldc, kc, first);
+      return;
+    }
+    if (lvl == ISA_AVX2) {
+      micro_tile_avx2_i32(Ap, Bp, C, ldc, kc, first);
+      return;
+    }
+  }
+  micro_kernel(Ap, Bp, C, ldc, kc, mr, nr, first, last, bias_n, bias_m,
+               act);
 }
 #endif
 
@@ -1041,6 +1188,191 @@ static void gemm_bias_act(const SA* A, const SB* B, T* C, int64_t M,
                                    int64_t K) {
   gemm_bias_act<int32_t>(A, B, C, M, N, K, nullptr, nullptr, nullptr,
                          nullptr, ACT_NONE);
+}
+
+/* ------------------------------------------------------------------
+ * int8 VNNI path: int16 PAIR-packed panels + vpdpwssd macro-kernel.
+ *
+ * vpdpwssd multiplies 32 int16 lanes pairwise, sums each pair in
+ * int32 and accumulates — two k steps per instruction. Both operands
+ * are int8-range (the same int8_exact precondition as the int32
+ * path), so the int16 products are exact and the accumulation bound
+ * is unchanged (2 * 128^2 per pair, K/2 pairs == 128^2 * K). Panel
+ * layout interleaves k pairs: A [panel][k2][r][2], B [panel][k2][c][2]
+ * — one 64-byte B load covers all NR columns' pairs, and each A row's
+ * pair broadcasts as a single 32-bit element. Odd K pads the trailing
+ * half-pair with zeros (exact). Integer addition is associative, so
+ * this path is BITWISE-equal to the int32 kernel, only faster. */
+static inline int64_t kpairs(int64_t K) { return (K + 1) / 2; }
+static inline int64_t a_pack16_size(int64_t M, int64_t K) {
+  return ((M + MR - 1) / MR) * kpairs(K) * MR * 2;
+}
+static inline int64_t b_pack16_size(int64_t K, int64_t N) {
+  return ((N + NR - 1) / NR) * kpairs(K) * NR * 2;
+}
+
+static void pack_a16(const int64_t* A, int64_t M, int64_t K,
+                     int16_t* out) {
+  const int64_t K2 = kpairs(K);
+  const int64_t panels = (M + MR - 1) / MR;
+  const int64_t grain =
+      std::max<int64_t>(1, 65536 / std::max<int64_t>(K2 * MR, 1));
+  parallel_for(panels, grain, [&](int64_t p0, int64_t p1) {
+    for (int64_t p = p0; p < p1; ++p) {
+      int16_t* dst = out + p * K2 * MR * 2;
+      const int64_t mr = std::min(MR, M - p * MR);
+      for (int64_t r = 0; r < mr; ++r) {
+        const int64_t* src = A + (p * MR + r) * K;
+        for (int64_t k2 = 0; k2 < K2; ++k2) {
+          dst[(k2 * MR + r) * 2] = int16_t(src[2 * k2]);
+          dst[(k2 * MR + r) * 2 + 1] =
+              2 * k2 + 1 < K ? int16_t(src[2 * k2 + 1]) : int16_t(0);
+        }
+      }
+      for (int64_t r = mr; r < MR; ++r)
+        for (int64_t k2 = 0; k2 < K2; ++k2)
+          dst[(k2 * MR + r) * 2] = dst[(k2 * MR + r) * 2 + 1] = 0;
+    }
+  });
+}
+
+static void pack_b16(const int64_t* B, int64_t K, int64_t N,
+                     int16_t* out) {
+  const int64_t K2 = kpairs(K);
+  const int64_t panels = (N + NR - 1) / NR;
+  const int64_t grain =
+      std::max<int64_t>(1, 65536 / std::max<int64_t>(K2 * NR, 1));
+  parallel_for(panels, grain, [&](int64_t p0, int64_t p1) {
+    for (int64_t p = p0; p < p1; ++p) {
+      int16_t* dst = out + p * K2 * NR * 2;
+      const int64_t j0 = p * NR, w = std::min(NR, N - j0);
+      for (int64_t k2 = 0; k2 < K2; ++k2) {
+        const int64_t* r0 = B + (2 * k2) * N + j0;
+        const int64_t* r1 =
+            2 * k2 + 1 < K ? B + (2 * k2 + 1) * N + j0 : nullptr;
+        int16_t* d = dst + k2 * NR * 2;
+        for (int64_t c = 0; c < w; ++c) {
+          d[c * 2] = int16_t(r0[c]);
+          d[c * 2 + 1] = r1 ? int16_t(r1[c]) : int16_t(0);
+        }
+        for (int64_t c = w; c < NR; ++c) d[c * 2] = d[c * 2 + 1] = 0;
+      }
+    }
+  });
+}
+
+// portable pair kernel (fringe tiles + non-VNNI parity testing)
+static inline void micro_kernel_i16(const int16_t* Ap, const int16_t* Bp,
+                                    int32_t* C, int64_t ldc, int64_t k2c,
+                                    int64_t mr, int64_t nr, bool first) {
+  int32_t acc[MR][NR];
+  for (int r = 0; r < MR; ++r)
+    for (int c = 0; c < NR; ++c) acc[r][c] = 0;
+  if (!first)
+    for (int64_t r = 0; r < mr; ++r)
+      for (int64_t c = 0; c < nr; ++c) acc[r][c] = C[r * ldc + c];
+  for (int64_t k2 = 0; k2 < k2c; ++k2) {
+    const int16_t* a = Ap + k2 * MR * 2;
+    const int16_t* b = Bp + k2 * NR * 2;
+    for (int r = 0; r < MR; ++r) {
+      const int32_t a0 = a[r * 2], a1 = a[r * 2 + 1];
+      for (int c = 0; c < NR; ++c)
+        acc[r][c] += a0 * int32_t(b[c * 2]) + a1 * int32_t(b[c * 2 + 1]);
+    }
+  }
+  for (int64_t r = 0; r < mr; ++r)
+    for (int64_t c = 0; c < nr; ++c) C[r * ldc + c] = acc[r][c];
+}
+
+#ifdef PTPU_X86
+__attribute__((target("avx512f,avx512bw,avx512vnni")))
+static void micro_tile_vnni(const int16_t* Ap, const int16_t* Bp,
+                            int32_t* C, int64_t ldc, int64_t k2c,
+                            bool first) {
+  __m512i acc[MR];
+  if (first) {
+    for (int r = 0; r < MR; ++r) acc[r] = _mm512_setzero_si512();
+  } else {
+    for (int r = 0; r < MR; ++r)
+      acc[r] = _mm512_loadu_si512(
+          reinterpret_cast<const void*>(C + r * ldc));
+  }
+  for (int64_t k2 = 0; k2 < k2c; ++k2) {
+    const __m512i b = _mm512_loadu_si512(
+        reinterpret_cast<const void*>(Bp + k2 * NR * 2));
+    const int16_t* a = Ap + k2 * MR * 2;
+    for (int r = 0; r < MR; ++r) {
+      int32_t pair;  // (a[2k], a[2k+1]) as one 32-bit broadcast element
+      std::memcpy(&pair, a + r * 2, 4);
+      acc[r] = _mm512_dpwssd_epi32(acc[r], _mm512_set1_epi32(pair), b);
+    }
+  }
+  for (int r = 0; r < MR; ++r)
+    _mm512_storeu_si512(reinterpret_cast<void*>(C + r * ldc), acc[r]);
+}
+#endif
+
+static inline void micro_tile_i16(const int16_t* Ap, const int16_t* Bp,
+                                  int32_t* C, int64_t ldc, int64_t k2c,
+                                  int64_t mr, int64_t nr, bool first) {
+#ifdef PTPU_X86
+  if (mr == MR && nr == NR && isa_vnni()) {
+    micro_tile_vnni(Ap, Bp, C, ldc, k2c, first);
+    return;
+  }
+#endif
+  micro_kernel_i16(Ap, Bp, C, ldc, k2c, mr, nr, first);
+}
+
+/* Pair-panel macro-kernel: same (column-tile, row-block) task grid as
+ * gemm_compute. No KC blocking — the int8 artifacts' K (<= a few
+ * thousand) keeps a full B panel slice L2-resident, and the pair
+ * interleave already halves the k-loop trip count. */
+static void gemm_compute_i16(const int16_t* Apack, const int16_t* Bpack,
+                             int32_t* C, int64_t M, int64_t N,
+                             int64_t K) {
+  const int64_t K2 = kpairs(K);
+  const int64_t ntn = (N + NR - 1) / NR;
+  const int64_t mp = (M + MR - 1) / MR;
+  const int64_t want = int64_t(3) * num_threads();
+  int64_t nbm = std::max<int64_t>(
+      int64_t(1), std::min(mp, (want + ntn - 1) / ntn));
+  const int64_t per_blk = (mp + nbm - 1) / nbm;
+  nbm = (mp + per_blk - 1) / per_blk;
+  const int64_t grain = M * N * K < (int64_t(1) << 21) ? ntn * nbm : 1;
+  parallel_for(ntn * nbm, grain, [&](int64_t t0, int64_t t1) {
+    for (int64_t t = t0; t < t1; ++t) {
+      const int64_t np = t % ntn, mb = t / ntn;
+      const int64_t p_lo = mb * per_blk;
+      const int64_t p_hi = std::min(mp, p_lo + per_blk);
+      const int64_t j0 = np * NR, nr = std::min(NR, N - j0);
+      for (int64_t p = p_lo; p < p_hi; ++p) {
+        const int64_t m0 = p * MR, mr = std::min(MR, M - m0);
+        micro_tile_i16(Apack + p * K2 * MR * 2,
+                       Bpack + np * K2 * NR * 2, C + m0 * N + j0, N,
+                       K2, mr, nr, true);
+      }
+    }
+  });
+}
+
+/* int8-exact GEMM over the VNNI pair path: packs whichever operand has
+ * no pre-packed panel (B-side weights come pre-packed from
+ * prepack_weights when the load-time probe admitted VNNI). */
+static void gemm_i16(const int64_t* A, const int64_t* B, int32_t* C,
+                     int64_t M, int64_t N, int64_t K,
+                     const int16_t* Bpack_pre) {
+  auto& abuf = pack_scratch<int16_t>(0);
+  abuf.resize(size_t(a_pack16_size(M, K)));
+  pack_a16(A, M, K, abuf.data());
+  const int16_t* Bp = Bpack_pre;
+  if (!Bp) {
+    auto& bbuf = pack_scratch<int16_t>(1);
+    bbuf.resize(size_t(b_pack16_size(K, N)));
+    pack_b16(B, K, N, bbuf.data());
+    Bp = bbuf.data();
+  }
+  gemm_compute_i16(abuf.data(), Bp, C, M, N, K);
 }
 
 /* Implicit im2col: pack the conv patch matrix col[CK, P] for one
@@ -1333,6 +1665,7 @@ struct Predictor {
   struct PackedMat {
     std::vector<float> f;
     std::vector<int32_t> i;
+    std::vector<int16_t> i16;  // VNNI pair panels (isa_vnni() loads)
     bool int8_ok = false;
   };
   std::map<std::string, PackedMat> packed_w_;
@@ -1383,6 +1716,251 @@ struct Predictor {
   std::atomic<uint64_t> dyn_fallback_runs_{0};
   std::string stats_json_;
 
+  /* ---------------- KV-cached autoregressive decode ----------------
+   * A decode-step artifact (paddle_tpu.models.gpt.export_gpt_decode)
+   * follows a fixed input/output convention:
+   *   inputs : [ids (B,1) int] [pos (B) or (B,1) int]
+   *            then per layer l: [k_cache (B,P,H,D) f32]
+   *                              [v_cache (B,P,H,D) f32]
+   *   outputs: [logits (B, ...)] then per layer l:
+   *            [new_k (B,1,H,D)] [new_v (B,1,H,D)]
+   * kv_plan() validates the convention and allocates ONE zeroed cache
+   * block of `sessions` x layers x 2 x P*H*D floats plus per-input
+   * staging buffers — after that, a decode step performs ZERO
+   * allocation: stage row copies bound into env via Buf::bind, the
+   * planned-arena run, and append-position writes of each new k/v row
+   * into its session slot. Sessions are slots: open() hands out a free
+   * one (len 0), close() frees it; eviction policy lives in the
+   * serving layer. Thread-compatibility contract is run()'s: one
+   * thread at a time per predictor. */
+  struct KvSession {
+    bool open = false;
+    int64_t len = 0;
+  };
+  int kv_sessions_ = 0;
+  int64_t kv_batch_ = 0, kv_ctx_ = 0, kv_heads_ = 0, kv_hdim_ = 0;
+  int kv_layers_ = 0;
+  int kv_ids_dtype_ = DT_I32, kv_pos_dtype_ = DT_I32;
+  std::vector<int64_t> kv_pos_dims_;
+  std::vector<float> kv_block_;
+  std::vector<KvSession> kv_sess_;
+  std::vector<std::vector<float>> kv_stage_;   // one per cache input
+  std::vector<int64_t> kv_ids_stage_, kv_pos_stage_;
+  bool kv_out_checked_ = false;
+
+  int64_t kv_slot_elems() const { return kv_ctx_ * kv_heads_ * kv_hdim_; }
+  float* kv_slot(int sid, int layer, int which /*0=k,1=v*/) {
+    const int64_t per = kv_slot_elems();
+    return kv_block_.data() +
+           ((int64_t(sid) * kv_layers_ + layer) * 2 + which) * per;
+  }
+
+  void kv_plan(int sessions) {
+    if (sessions < 1) throw std::runtime_error("kv_plan: sessions < 1");
+    const int nin = int(g.input_names.size());
+    if (nin < 4 || (nin - 2) % 2)
+      throw std::runtime_error(
+          "kv_plan: not a decode artifact (want inputs "
+          "[ids][pos][k0][v0]...)");
+    kv_layers_ = (nin - 2) / 2;
+    const auto in_dims = [&](int i) -> const std::vector<int64_t>& {
+      auto it = g.input_dims.find(g.input_names[size_t(i)]);
+      if (it == g.input_dims.end())
+        throw std::runtime_error("kv_plan: input " + std::to_string(i) +
+                                 " has no dims");
+      return it->second;
+    };
+    const auto in_dtype = [&](int i) {
+      auto it = g.input_dtypes.find(g.input_names[size_t(i)]);
+      return it == g.input_dtypes.end() ? DT_F32 : it->second;
+    };
+    const auto& idd = in_dims(0);
+    if (idd.size() != 2 || idd[1] != 1 || idd[0] < 1)
+      throw std::runtime_error("kv_plan: ids input must be [B, 1]");
+    kv_batch_ = idd[0];
+    kv_ids_dtype_ = in_dtype(0);
+    if (kv_ids_dtype_ != DT_I32 && kv_ids_dtype_ != DT_I64)
+      throw std::runtime_error("kv_plan: ids input must be int32/int64");
+    const auto& pdd = in_dims(1);
+    if (!(pdd == std::vector<int64_t>{kv_batch_} ||
+          pdd == std::vector<int64_t>{kv_batch_, 1}))
+      throw std::runtime_error("kv_plan: pos input must be [B] or [B,1]");
+    kv_pos_dims_ = pdd;
+    kv_pos_dtype_ = in_dtype(1);
+    if (kv_pos_dtype_ != DT_I32 && kv_pos_dtype_ != DT_I64)
+      throw std::runtime_error("kv_plan: pos input must be int32/int64");
+    for (int l = 0; l < kv_layers_; ++l)
+      for (int w = 0; w < 2; ++w) {
+        const int i = 2 + 2 * l + w;
+        const auto& cd = in_dims(i);
+        if (cd.size() != 4 || cd[0] != kv_batch_)
+          throw std::runtime_error("kv_plan: cache input " +
+                                   std::to_string(i) +
+                                   " must be [B, P, H, D]");
+        if (l == 0 && w == 0) {
+          kv_ctx_ = cd[1];
+          kv_heads_ = cd[2];
+          kv_hdim_ = cd[3];
+          if (kv_ctx_ < 1 || kv_heads_ < 1 || kv_hdim_ < 1)
+            throw std::runtime_error("kv_plan: degenerate cache dims");
+        } else if (cd[1] != kv_ctx_ || cd[2] != kv_heads_ ||
+                   cd[3] != kv_hdim_) {
+          throw std::runtime_error(
+              "kv_plan: cache inputs disagree on [P, H, D]");
+        }
+        if (in_dtype(i) != DT_F32)
+          throw std::runtime_error("kv_plan: cache inputs must be f32");
+      }
+    if (int(g.output_names.size()) != 1 + 2 * kv_layers_)
+      throw std::runtime_error(
+          "kv_plan: decode artifact must have 1 + 2*layers outputs, got " +
+          std::to_string(g.output_names.size()));
+    kv_sessions_ = sessions;
+    kv_sess_.assign(size_t(sessions), KvSession{});
+    // the pre-planned cache block: zero-filled once; append-position
+    // writes only from here on (no per-step realloc)
+    kv_block_.assign(size_t(sessions) * size_t(kv_layers_) * 2 *
+                         size_t(kv_slot_elems()),
+                     0.f);
+    kv_stage_.assign(size_t(2 * kv_layers_),
+                     std::vector<float>(size_t(kv_batch_) *
+                                            size_t(kv_slot_elems()),
+                                        0.f));
+    kv_ids_stage_.assign(size_t(kv_batch_), 0);
+    kv_pos_stage_.assign(size_t(kv_batch_), 0);
+    kv_out_checked_ = false;
+  }
+
+  int kv_open() {
+    for (int s = 0; s < kv_sessions_; ++s)
+      if (!kv_sess_[size_t(s)].open) {
+        kv_sess_[size_t(s)].open = true;
+        kv_sess_[size_t(s)].len = 0;
+        return s;
+      }
+    return -1;
+  }
+
+  void kv_close(int sid) {
+    if (sid < 0 || sid >= kv_sessions_) return;
+    kv_sess_[size_t(sid)].open = false;
+    kv_sess_[size_t(sid)].len = 0;
+    // scrub the slot so a reused session never attends over a previous
+    // occupant's rows (they are masked, but stale NaN/Inf garbage must
+    // not exist to begin with)
+    for (int l = 0; l < kv_layers_; ++l)
+      for (int w = 0; w < 2; ++w)
+        std::memset(kv_slot(sid, l, w), 0,
+                    size_t(kv_slot_elems()) * sizeof(float));
+  }
+
+  /* One batched decode step over n <= B sessions. Row r binds session
+   * sids[r] feeding tokens[r]; rows n..B-1 are zero padding whose
+   * outputs are discarded. Appends each real row's new k/v into its
+   * slot and advances len; logits stay readable via the normal output
+   * accessors (row r of output 0). */
+  void decode_step(const int64_t* sids, const int64_t* tokens, int n) {
+    if (kv_sessions_ == 0)
+      throw std::runtime_error("decode_step: kv_plan() not called");
+    if (n < 1 || int64_t(n) > kv_batch_)
+      throw std::runtime_error("decode_step: n outside [1, B=" +
+                               std::to_string(kv_batch_) + "]");
+    for (int r = 0; r < n; ++r) {
+      const int64_t s = sids[r];
+      if (s < 0 || s >= kv_sessions_ || !kv_sess_[size_t(s)].open)
+        throw std::runtime_error("decode_step: session " +
+                                 std::to_string(s) + " is not open");
+      if (kv_sess_[size_t(s)].len >= kv_ctx_)
+        throw std::runtime_error("decode_step: session " +
+                                 std::to_string(s) +
+                                 " context is full (P=" +
+                                 std::to_string(kv_ctx_) + ")");
+      for (int r2 = 0; r2 < r; ++r2)
+        if (sids[r2] == s)
+          throw std::runtime_error(
+              "decode_step: duplicate session " + std::to_string(s) +
+              " in one batch (steps of one session are ordered)");
+    }
+    const int64_t per = kv_slot_elems();
+    const int64_t row_hd = kv_heads_ * kv_hdim_;
+    // stage: ids/pos plus each session's live cache rows (rows past a
+    // session's len are masked by the graph — stale stage contents are
+    // value-irrelevant and never NaN: slots zero on open)
+    for (int64_t r = 0; r < kv_batch_; ++r) {
+      kv_ids_stage_[size_t(r)] = r < n ? tokens[r] : 0;
+      kv_pos_stage_[size_t(r)] =
+          r < n ? kv_sess_[size_t(sids[r])].len : 0;
+    }
+    for (int l = 0; l < kv_layers_; ++l)
+      for (int w = 0; w < 2; ++w) {
+        float* stage = kv_stage_[size_t(2 * l + w)].data();
+        for (int64_t r = 0; r < kv_batch_; ++r) {
+          const int64_t len =
+              r < n ? kv_sess_[size_t(sids[r])].len : 0;
+          if (len > 0)
+            std::memcpy(stage + r * per, kv_slot(int(sids[r]), l, w),
+                        size_t(len * row_hd) * sizeof(float));
+          // contract: cache rows past a session's len read as ZERO
+          // (not whatever the previous batch staged there) — decode
+          // graphs mask them anyway, but the artifact convention must
+          // not depend on that
+          if (len < kv_ctx_)
+            std::memset(stage + r * per + len * row_hd, 0,
+                        size_t((kv_ctx_ - len) * row_hd) *
+                            sizeof(float));
+        }
+      }
+    // bind inputs (no copies: Buf::bind borrows the staging storage)
+    {
+      Tensor t;
+      t.dtype = kv_ids_dtype_;
+      t.dims = {kv_batch_, 1};
+      t.i.bind(kv_ids_stage_.data(), size_t(kv_batch_));
+      env[g.input_names[0]] = std::move(t);
+    }
+    {
+      Tensor t;
+      t.dtype = kv_pos_dtype_;
+      t.dims = kv_pos_dims_;
+      t.i.bind(kv_pos_stage_.data(), size_t(kv_batch_));
+      env[g.input_names[1]] = std::move(t);
+    }
+    for (int i = 2; i < int(g.input_names.size()); ++i) {
+      Tensor t;
+      t.dtype = DT_F32;
+      t.dims = {kv_batch_, kv_ctx_, kv_heads_, kv_hdim_};
+      t.f.bind(kv_stage_[size_t(i - 2)].data(),
+               size_t(kv_batch_ * per));
+      env[g.input_names[size_t(i)]] = std::move(t);
+    }
+    run();
+    if (!kv_out_checked_) {
+      for (int l = 0; l < kv_layers_; ++l)
+        for (int w = 0; w < 2; ++w) {
+          const Tensor& t = outputs[size_t(1 + 2 * l + w)];
+          const std::vector<int64_t> want = {kv_batch_, 1, kv_heads_,
+                                             kv_hdim_};
+          if (!t.is_float() || t.dims != want)
+            throw std::runtime_error(
+                "decode_step: output " + std::to_string(1 + 2 * l + w) +
+                " is not a [B,1,H,D] f32 cache append");
+        }
+      kv_out_checked_ = true;
+    }
+    // append-position writes into the pre-planned cache block
+    for (int l = 0; l < kv_layers_; ++l)
+      for (int w = 0; w < 2; ++w) {
+        const Tensor& t = outputs[size_t(1 + 2 * l + w)];
+        for (int r = 0; r < n; ++r) {
+          const int64_t len = kv_sess_[size_t(sids[r])].len;
+          std::memcpy(kv_slot(int(sids[r]), l, w) + len * row_hd,
+                      t.f.data() + int64_t(r) * row_hd,
+                      size_t(row_hd) * sizeof(float));
+        }
+      }
+    for (int r = 0; r < n; ++r) ++kv_sess_[size_t(sids[r])].len;
+  }
+
   /* Rebuild the node -> OpStat index after the load-time rewrites
    * settle the node list (fusion renames/removes nodes). std::map
    * nodes are pointer-stable, so the hot loop never rehashes. */
@@ -1413,6 +1991,10 @@ struct Predictor {
   static int64_t attr_i(const Node& n, const char* name, int64_t dflt) {
     auto it = n.attrs.find(name);
     return it == n.attrs.end() ? dflt : it->second.ival;
+  }
+  static float attr_f(const Node& n, const char* name, float dflt) {
+    auto it = n.attrs.find(name);
+    return it == n.attrs.end() ? dflt : it->second.fval;
   }
   static std::vector<int64_t> attr_ints(const Node& n, const char* name) {
     auto it = n.attrs.find(name);
@@ -1695,6 +2277,946 @@ struct Predictor {
     }
     g.nodes.swap(rebuilt);
     prune_dead_initializers();
+  }
+
+  /* ------------------------------------------------------------------
+   * Transformer fusion (ISSUE r9 tentpole a). The exporter lowers every
+   * attention head through a rigid ~20-node Transpose/Reshape/batched-
+   * MatMul/scale(/mask)/softmax/batched-MatMul block and every
+   * LayerNorm through a ~16-node Sub/Mul/ReduceSum/Sqrt/Pow chain —
+   * all memory-bound single-pass ops plus a full [q,k] score
+   * materialization per head. These two load-time passes recognize
+   * exactly those exported shapes (validated against dims recorded by
+   * a load-time dry run — no structural guessing) and collapse each
+   * into one fused op:
+   *
+   *   PtpuAttention  tiled flash-style kernel — online softmax, no
+   *                  [q,k] score tensor, row blocks threaded across
+   *                  (batch, head) on the WorkPool (the per-head tiny
+   *                  GEMMs used to run serially inside one batched
+   *                  MatMul dispatch).
+   *   PtpuLayerNorm  one pass per row: mean/var/normalize/affine.
+   *
+   * Both replicate the original float arithmetic closely enough for
+   * allclose parity against PTPU_PREDICTOR_OPT=0 (asserted by
+   * tests/test_attention_fusion.py); near-miss subgraphs (wrong axis,
+   * non-scalar scale, wrong Pow exponent...) fail the checks and stay
+   * unfused. */
+
+  /* One dry run with dummy zero inputs records every value's dims —
+   * the fusion matchers validate reshape/transpose dims against these
+   * instead of inferring shapes structurally. Returns false (no
+   * recording) for dynamic-shape artifacts, which then skip the
+   * transformer fusions the same way they skip the memory plan. */
+  bool dry_run_shapes(std::map<std::string, std::vector<int64_t>>* shp,
+                      std::map<std::string, int>* dty) {
+    if (g.nodes.empty()) return false;
+    for (const auto& name : g.input_names) {
+      auto it = g.input_dims.find(name);
+      if (it == g.input_dims.end()) return false;
+      for (auto d : it->second)
+        if (d <= 0) return false;
+    }
+    std::vector<std::string> dummies;
+    for (const auto& name : g.input_names) {
+      if (g.initializers.count(name)) continue;
+      Tensor t;
+      t.dims = g.input_dims[name];
+      auto dt = g.input_dtypes.find(name);
+      t.dtype = dt == g.input_dtypes.end() ? DT_F32 : dt->second;
+      if (t.dtype == DT_F64) t.dtype = DT_F32;
+      t.alloc();
+      env[name] = std::move(t);
+      dummies.push_back(name);
+    }
+    const auto scrub = [&] {
+      for (const auto& name : dummies) env.erase(name);
+      for (const auto& n : g.nodes)
+        for (const auto& o : n.outputs)
+          if (!g.initializers.count(o)) env.erase(o);
+    };
+    try {
+      for (const auto& n : g.nodes) {
+        run_node(n);
+        for (const auto& o : n.outputs) {
+          (*shp)[o] = env[o].dims;
+          (*dty)[o] = env[o].dtype;
+        }
+      }
+    } catch (const std::exception&) {
+      scrub();
+      return false;
+    }
+    scrub();
+    for (const auto& name : g.input_names) {
+      (*shp)[name] = g.input_dims[name];
+      auto it = g.input_dtypes.find(name);
+      const int dt = it == g.input_dtypes.end() ? DT_F32 : it->second;
+      (*dty)[name] = dt == DT_F64 ? DT_F32 : dt;
+    }
+    for (const auto& kv : g.initializers) {
+      (*shp)[kv.first] = kv.second.dims;
+      (*dty)[kv.first] = kv.second.dtype;
+    }
+    return true;
+  }
+
+  /* bf16 models export their compute-dtype casts as float32->float32
+   * Cast nodes (bf16 has no ONNX surface here) — full-tensor copy
+   * passes that do nothing. With dry-run dtypes in hand they are
+   * provably no-ops: alias them away like Identity. Only the
+   * float->float case is touched — integer-width casts carry dtype
+   * metadata the quant paths key on. */
+  void eliminate_noop_casts(const std::map<std::string, int>& dty) {
+    const std::set<std::string> outset(g.output_names.begin(),
+                                       g.output_names.end());
+    std::map<std::string, std::string> alias;
+    std::vector<Node> kept;
+    for (auto& n : g.nodes) {
+      for (auto& i : n.inputs) {
+        auto it = alias.find(i);
+        if (it != alias.end()) i = it->second;
+      }
+      bool drop = false;
+      if (n.op == "Cast" && n.inputs.size() == 1 &&
+          n.outputs.size() == 1 && !outset.count(n.outputs[0])) {
+        int64_t to = attr_i(n, "to", DT_F32);
+        if (to == DT_F64) to = DT_F32;
+        auto dt = dty.find(n.inputs[0]);
+        if (dt != dty.end() && to == DT_F32 && dt->second == DT_F32) {
+          alias[n.outputs[0]] = n.inputs[0];
+          drop = true;
+          ++fused_nodes_;
+        }
+      }
+      if (!drop) kept.push_back(std::move(n));
+    }
+    g.nodes.swap(kept);
+  }
+
+  // shared index for the two transformer matchers
+  struct FuseIdx {
+    std::map<std::string, size_t> producer;
+    std::map<std::string, std::vector<size_t>> uses;
+    std::set<std::string> outset;
+  };
+  FuseIdx build_fuse_idx() const {
+    FuseIdx ix;
+    ix.outset.insert(g.output_names.begin(), g.output_names.end());
+    for (size_t k = 0; k < g.nodes.size(); ++k) {
+      for (const auto& o : g.nodes[k].outputs) ix.producer[o] = k;
+      for (const auto& i : g.nodes[k].inputs) ix.uses[i].push_back(k);
+    }
+    return ix;
+  }
+
+  // shared rewrite applier for the pattern passes: drop dead nodes and
+  // splice each fused node in at its chain's last position
+  void apply_rewrite(const std::vector<char>& dead,
+                     std::map<size_t, Node>* placed) {
+    if (placed->empty()) return;
+    std::vector<Node> rebuilt;
+    rebuilt.reserve(g.nodes.size());
+    for (size_t k = 0; k < g.nodes.size(); ++k) {
+      auto it = placed->find(k);
+      if (it != placed->end()) rebuilt.push_back(std::move(it->second));
+      else if (!dead[k]) rebuilt.push_back(std::move(g.nodes[k]));
+    }
+    g.nodes.swap(rebuilt);
+    prune_dead_initializers();
+  }
+
+  // axes of a Reduce node (attr form or axes-input form)
+  std::vector<int64_t> reduce_axes(const Node& rn) const {
+    std::vector<int64_t> axes = attr_ints(rn, "axes");
+    if (axes.empty() && rn.inputs.size() > 1) {
+      const Tensor* t = const_initializer(rn.inputs[1]);
+      if (t) axes.assign(t->i.begin(), t->i.end());
+    }
+    return axes;
+  }
+  bool last_axis_reduce(const Node& rn,
+                        const std::vector<int64_t>& in_dims) const {
+    if (attr_i(rn, "keepdims", 1) != 0) return false;
+    auto axes = reduce_axes(rn);
+    if (axes.size() != 1) return false;
+    const int64_t ax =
+        axes[0] < 0 ? axes[0] + int64_t(in_dims.size()) : axes[0];
+    return ax == int64_t(in_dims.size()) - 1;
+  }
+
+  // float const broadcasting exactly per-last-dim (numel == D, last
+  // dim D, leading dims 1) — the LN gamma/beta shape after folding
+  bool lastdim_vec_const(const std::string& name, int64_t D) const {
+    const Tensor* t = const_initializer(name);
+    if (!t || !t->is_float() || t->numel() != D) return false;
+    if (t->dims.empty() || t->dims.back() != D) return false;
+    for (size_t k = 0; k + 1 < t->dims.size(); ++k)
+      if (t->dims[k] != 1) return false;
+    return true;
+  }
+
+  void fuse_attention(const std::map<std::string,
+                                     std::vector<int64_t>>& shp) {
+    FuseIdx ix = build_fuse_idx();
+    std::vector<char> dead(g.nodes.size(), 0);
+    std::map<size_t, Node> placed;
+    const size_t npos = size_t(-1);
+
+    const auto dims_of =
+        [&](const std::string& nm) -> const std::vector<int64_t>* {
+      auto it = shp.find(nm);
+      return it == shp.end() ? nullptr : &it->second;
+    };
+    const auto mid1 = [&](const std::string& nm) {
+      auto u = ix.uses.find(nm);
+      return !ix.outset.count(nm) && !g.initializers.count(nm) &&
+             u != ix.uses.end() && u->second.size() == 1;
+    };
+    const auto prod = [&](const std::string& nm) -> size_t {
+      auto it = ix.producer.find(nm);
+      if (it == ix.producer.end() || dead[it->second]) return npos;
+      return it->second;
+    };
+    const auto cons1 = [&](const std::string& nm) -> size_t {
+      if (!mid1(nm)) return npos;
+      const size_t j = ix.uses.find(nm)->second[0];
+      return dead[j] ? npos : j;
+    };
+    // walk UP through single-use Transposes; composed perm maps final
+    // axis j -> source axis perm[j]
+    const auto up_transposes = [&](std::string nm,
+                                   std::vector<int64_t>* perm_out,
+                                   std::string* src,
+                                   std::vector<size_t>* tchain) -> bool {
+      std::vector<int64_t> comb;
+      bool first = true;
+      for (;;) {
+        const size_t j = prod(nm);
+        if (j == npos || g.nodes[j].op != "Transpose") {
+          if (first) return false;
+          *perm_out = comb;
+          *src = nm;
+          return true;
+        }
+        const Node& t = g.nodes[j];
+        const auto* din = dims_of(t.inputs[0]);
+        if (!din) return false;
+        std::vector<int64_t> p = attr_ints(t, "perm");
+        if (p.empty())
+          for (size_t d2 = din->size(); d2-- > 0;)
+            p.push_back(int64_t(d2));
+        if (first) {
+          comb = p;
+          first = false;
+        } else {
+          for (auto& c : comb) {
+            if (c < 0 || size_t(c) >= p.size()) return false;
+            c = p[size_t(c)];
+          }
+        }
+        tchain->push_back(j);
+        nm = t.inputs[0];
+        // inner chain links must be single-use; the SOURCE may be
+        // shared (q/k/v slices feed nothing else, but stay safe)
+        const size_t jup = prod(nm);
+        if (jup != npos && g.nodes[jup].op == "Transpose" && !mid1(nm)) {
+          *perm_out = comb;
+          *src = nm;
+          return true;
+        }
+      }
+    };
+    // walk DOWN through single-consumer Transposes; composed perm maps
+    // final axis j -> source axis perm[j]
+    const auto down_transposes =
+        [&](std::string nm, std::vector<int64_t>* perm_out,
+            std::string* dst, std::vector<size_t>* tchain) -> bool {
+      std::vector<int64_t> comb;
+      bool first = true;
+      for (;;) {
+        const size_t j = cons1(nm);
+        if (j == npos || g.nodes[j].op != "Transpose" ||
+            g.nodes[j].inputs[0] != nm)
+          break;
+        const auto* din = dims_of(nm);
+        if (!din) return false;
+        std::vector<int64_t> p = attr_ints(g.nodes[j], "perm");
+        if (p.empty())
+          for (size_t d2 = din->size(); d2-- > 0;)
+            p.push_back(int64_t(d2));
+        if (first) {
+          comb = p;
+          first = false;
+        } else {
+          std::vector<int64_t> nc(comb.size());
+          for (size_t q2 = 0; q2 < p.size(); ++q2) {
+            if (p[q2] < 0 || size_t(p[q2]) >= comb.size()) return false;
+            nc[q2] = comb[size_t(p[q2])];
+          }
+          comb = nc;
+        }
+        tchain->push_back(j);
+        nm = g.nodes[j].outputs[0];
+      }
+      if (first) return false;
+      *perm_out = comb;
+      *dst = nm;
+      return true;
+    };
+    // Reshape([x0,x1,x2,x3] -> [x0*x1, x2, x3]) of an up-transpose
+    // chain with the wanted composed perm
+    const auto side = [&](const std::string& rname,
+                          const std::vector<int64_t>& want_perm,
+                          const std::vector<int64_t>& want_3d,
+                          std::string* src,
+                          std::vector<size_t>* side_chain) -> bool {
+      if (!mid1(rname)) return false;
+      const size_t rj = prod(rname);
+      if (rj == npos || g.nodes[rj].op != "Reshape") return false;
+      const auto* rd = dims_of(rname);
+      if (!rd || *rd != want_3d) return false;
+      const std::string tname = g.nodes[rj].inputs[0];
+      if (!mid1(tname)) return false;
+      std::vector<int64_t> perm;
+      std::vector<size_t> tchain;
+      std::string s;
+      if (!up_transposes(tname, &perm, &s, &tchain)) return false;
+      if (perm != want_perm) return false;
+      const auto* td = dims_of(tname);
+      if (!td || td->size() != 4) return false;
+      if ((*td)[0] * (*td)[1] != want_3d[0] || (*td)[2] != want_3d[1] ||
+          (*td)[3] != want_3d[2])
+        return false;
+      side_chain->push_back(rj);
+      side_chain->insert(side_chain->end(), tchain.begin(), tchain.end());
+      *src = s;
+      return true;
+    };
+
+    for (size_t idx = 0; idx < g.nodes.size(); ++idx) {
+      if (dead[idx]) continue;
+      const Node& dv = g.nodes[idx];
+      if (dv.op != "Div" || dv.inputs.size() != 2 ||
+          dv.outputs.size() != 1)
+        continue;
+      std::vector<size_t> chain;
+      // ---- softmax tail: Div(exp, Reshape(ReduceSum(exp, last)))
+      const std::string exp_name = dv.inputs[0];
+      const size_t eidx = prod(exp_name);
+      if (eidx == npos || g.nodes[eidx].op != "Exp") continue;
+      {
+        auto u = ix.uses.find(exp_name);
+        if (ix.outset.count(exp_name) || u == ix.uses.end() ||
+            u->second.size() != 2)
+          continue;
+      }
+      const auto* exp_dims = dims_of(exp_name);
+      if (!exp_dims || exp_dims->size() != 4) continue;
+      std::vector<int64_t> want_keep = *exp_dims;
+      want_keep.back() = 1;
+      const size_t sridx = prod(dv.inputs[1]);
+      if (sridx == npos || g.nodes[sridx].op != "Reshape" ||
+          !mid1(dv.inputs[1]))
+        continue;
+      {
+        const auto* srd = dims_of(dv.inputs[1]);
+        if (!srd || *srd != want_keep) continue;
+      }
+      const std::string rs_name = g.nodes[sridx].inputs[0];
+      const size_t rsidx = prod(rs_name);
+      if (rsidx == npos || g.nodes[rsidx].op != "ReduceSum" ||
+          !mid1(rs_name) || g.nodes[rsidx].inputs.empty() ||
+          g.nodes[rsidx].inputs[0] != exp_name ||
+          !last_axis_reduce(g.nodes[rsidx], *exp_dims))
+        continue;
+      // ---- Sub(scores, Reshape(Max(init, ReduceMax(scores, last))))
+      const size_t subidx = prod(g.nodes[eidx].inputs[0]);
+      if (subidx == npos || g.nodes[subidx].op != "Sub" ||
+          !mid1(g.nodes[eidx].inputs[0]))
+        continue;
+      const Node& sb = g.nodes[subidx];
+      const size_t mridx = prod(sb.inputs[1]);
+      if (mridx == npos || g.nodes[mridx].op != "Reshape" ||
+          !mid1(sb.inputs[1]))
+        continue;
+      {
+        const auto* mrd = dims_of(sb.inputs[1]);
+        if (!mrd || *mrd != want_keep) continue;
+      }
+      const std::string mx_name = g.nodes[mridx].inputs[0];
+      const size_t mxidx = prod(mx_name);
+      if (mxidx == npos || g.nodes[mxidx].op != "Max" ||
+          !mid1(mx_name) || g.nodes[mxidx].inputs.size() != 2)
+        continue;
+      float sm_init = 0.f;
+      std::string rm_name;
+      {
+        const Tensor* c0 = scalar_const(g.nodes[mxidx].inputs[0]);
+        const Tensor* c1 = scalar_const(g.nodes[mxidx].inputs[1]);
+        if (c0 && !c1) {
+          sm_init = c0->f[0];
+          rm_name = g.nodes[mxidx].inputs[1];
+        } else if (c1 && !c0) {
+          sm_init = c1->f[0];
+          rm_name = g.nodes[mxidx].inputs[0];
+        } else {
+          continue;
+        }
+      }
+      const size_t rmidx = prod(rm_name);
+      if (rmidx == npos || g.nodes[rmidx].op != "ReduceMax" ||
+          !mid1(rm_name))
+        continue;
+      const std::string scores = sb.inputs[0];
+      if (g.nodes[rmidx].inputs[0] != scores ||
+          !last_axis_reduce(g.nodes[rmidx], *exp_dims))
+        continue;
+      {
+        auto u = ix.uses.find(scores);
+        if (ix.outset.count(scores) || u == ix.uses.end() ||
+            u->second.size() != 2)
+          continue;
+      }
+      // ---- scores <- [Where(mask, ., neg)] <- Mul(scale) <- Reshape
+      //      <- MatMul(QR, KR)
+      std::string cur = scores, mask_name, neg_name;
+      {
+        const size_t whidx = prod(cur);
+        if (whidx != npos && g.nodes[whidx].op == "Where") {
+          const Node& wh = g.nodes[whidx];
+          if (wh.inputs.size() != 3) continue;
+          const Tensor* negc = const_initializer(wh.inputs[2]);
+          if (!negc || !negc->is_float()) continue;
+          mask_name = wh.inputs[0];
+          neg_name = wh.inputs[2];
+          cur = wh.inputs[1];
+          if (!mid1(cur)) continue;
+          chain.push_back(whidx);
+        }
+      }
+      const size_t mlidx = prod(cur);
+      if (mlidx == npos || g.nodes[mlidx].op != "Mul" ||
+          g.nodes[mlidx].inputs.size() != 2)
+        continue;
+      float scale = 1.f;
+      std::string mm_r;
+      {
+        const Node& ml = g.nodes[mlidx];
+        const Tensor* c0 = scalar_const(ml.inputs[0]);
+        const Tensor* c1 = scalar_const(ml.inputs[1]);
+        if (c1 && !c0) {
+          scale = c1->f[0];
+          mm_r = ml.inputs[0];
+        } else if (c0 && !c1) {
+          scale = c0->f[0];
+          mm_r = ml.inputs[1];
+        } else {
+          continue;
+        }
+      }
+      if (!mid1(mm_r)) continue;
+      const size_t rshidx = prod(mm_r);
+      if (rshidx == npos || g.nodes[rshidx].op != "Reshape") continue;
+      const std::string mm1_name = g.nodes[rshidx].inputs[0];
+      if (!mid1(mm1_name)) continue;
+      const size_t mm1idx = prod(mm1_name);
+      if (mm1idx == npos || g.nodes[mm1idx].op != "MatMul" ||
+          g.nodes[mm1idx].inputs.size() != 2)
+        continue;
+      const int64_t b = (*exp_dims)[0], hh = (*exp_dims)[1];
+      const int64_t sq = (*exp_dims)[2], sk = (*exp_dims)[3];
+      {
+        const auto* mmd = dims_of(mm1_name);
+        if (!mmd || mmd->size() != 3 || (*mmd)[0] != b * hh ||
+            (*mmd)[1] != sq || (*mmd)[2] != sk)
+          continue;
+      }
+      const auto* qr_dims = dims_of(g.nodes[mm1idx].inputs[0]);
+      if (!qr_dims || qr_dims->size() != 3) continue;
+      const int64_t dd = (*qr_dims)[2];
+      if (dd < 1 || dd > 1024) continue;
+      std::string q_src, k_src, v_src;
+      std::vector<size_t> qch, kch, vch;
+      if (!side(g.nodes[mm1idx].inputs[0], {0, 2, 1, 3},
+                {b * hh, sq, dd}, &q_src, &qch))
+        continue;
+      if (!side(g.nodes[mm1idx].inputs[1], {0, 2, 3, 1},
+                {b * hh, dd, sk}, &k_src, &kch))
+        continue;
+      const auto* qs_dims = dims_of(q_src);
+      const auto* ks_dims = dims_of(k_src);
+      if (!qs_dims || !ks_dims ||
+          *qs_dims != std::vector<int64_t>({b, sq, hh, dd}) ||
+          *ks_dims != std::vector<int64_t>({b, sk, hh, dd}))
+        continue;
+      // ---- down: Div -> Transpose(identity) -> Reshape [bh,q,k] ->
+      //      MatMul(probs, VR) -> Reshape [b,h,q,d] ->
+      //      Transpose{0,2,1,3} -> (optional) Reshape [b,q,h*d]
+      std::vector<int64_t> dperm;
+      std::string probs4;
+      std::vector<size_t> dchain;
+      if (!down_transposes(dv.outputs[0], &dperm, &probs4, &dchain))
+        continue;
+      {
+        bool ident = dperm.size() == 4;
+        for (size_t q2 = 0; ident && q2 < dperm.size(); ++q2)
+          if (dperm[q2] != int64_t(q2)) ident = false;
+        if (!ident) continue;
+      }
+      const size_t pridx = cons1(probs4);
+      if (pridx == npos || g.nodes[pridx].op != "Reshape") continue;
+      const std::string pr_name = g.nodes[pridx].outputs[0];
+      {
+        const auto* prd = dims_of(pr_name);
+        if (!prd || prd->size() != 3 || (*prd)[0] != b * hh ||
+            (*prd)[1] != sq || (*prd)[2] != sk)
+          continue;
+      }
+      const size_t mm2idx = cons1(pr_name);
+      if (mm2idx == npos || g.nodes[mm2idx].op != "MatMul" ||
+          g.nodes[mm2idx].inputs.size() != 2 ||
+          g.nodes[mm2idx].inputs[0] != pr_name)
+        continue;
+      if (!side(g.nodes[mm2idx].inputs[1], {0, 2, 1, 3},
+                {b * hh, sk, dd}, &v_src, &vch))
+        continue;
+      {
+        const auto* vsd = dims_of(v_src);
+        if (!vsd || *vsd != *ks_dims) continue;
+      }
+      const std::string mm2_name = g.nodes[mm2idx].outputs[0];
+      const size_t oridx = cons1(mm2_name);
+      if (oridx == npos || g.nodes[oridx].op != "Reshape") continue;
+      {
+        const auto* ord = dims_of(g.nodes[oridx].outputs[0]);
+        if (!ord || *ord != std::vector<int64_t>({b, hh, sq, dd}))
+          continue;
+      }
+      std::vector<int64_t> operm;
+      std::string out_name;
+      std::vector<size_t> ochain;
+      if (!down_transposes(g.nodes[oridx].outputs[0], &operm, &out_name,
+                           &ochain))
+        continue;
+      if (operm != std::vector<int64_t>({0, 2, 1, 3})) continue;
+      int64_t flat_out = 0;
+      std::vector<size_t> frchain;
+      {
+        const size_t fj = cons1(out_name);
+        if (fj != npos && g.nodes[fj].op == "Reshape") {
+          const auto* fd = dims_of(g.nodes[fj].outputs[0]);
+          if (fd && *fd == std::vector<int64_t>({b, sq, hh * dd})) {
+            frchain.push_back(fj);
+            out_name = g.nodes[fj].outputs[0];
+            flat_out = 1;
+          }
+        }
+      }
+      // mask/neg must be right-aligned-broadcastable to [b,h,q,k]
+      if (!mask_name.empty()) {
+        const auto bc_ok = [&](const std::vector<int64_t>* dm) {
+          if (!dm || dm->size() > 4 || dm->empty()) return false;
+          const int64_t want[4] = {b, hh, sq, sk};
+          const size_t off = 4 - dm->size();
+          for (size_t q2 = 0; q2 < dm->size(); ++q2)
+            if ((*dm)[q2] != 1 && (*dm)[q2] != want[q2 + off])
+              return false;
+          return true;
+        };
+        if (!bc_ok(dims_of(mask_name)) || !bc_ok(dims_of(neg_name)))
+          continue;
+      }
+      // ---- all checks passed: emit the fused node
+      chain.insert(chain.end(),
+                   {idx, eidx, sridx, rsidx, subidx, mridx, mxidx, rmidx,
+                    mlidx, rshidx, mm1idx, pridx, mm2idx, oridx});
+      for (auto& ch : {qch, kch, vch, dchain, ochain, frchain})
+        chain.insert(chain.end(), ch.begin(), ch.end());
+      Node f;
+      f.op = "PtpuAttention";
+      f.inputs = {q_src, k_src, v_src};
+      if (!mask_name.empty()) {
+        f.inputs.push_back(mask_name);
+        f.inputs.push_back(neg_name);
+      }
+      f.outputs = {out_name};
+      Attr asc;
+      asc.fval = scale;
+      f.attrs["ptpu_scale"] = asc;
+      Attr ain;
+      ain.fval = sm_init;
+      f.attrs["ptpu_sm_init"] = ain;
+      Attr afl;
+      afl.ival = flat_out;
+      f.attrs["ptpu_flat_out"] = afl;
+      size_t last = 0;
+      for (size_t j : chain) {
+        dead[j] = 1;
+        last = std::max(last, j);
+      }
+      fused_nodes_ += int(chain.size()) - 1;
+      placed[last] = std::move(f);
+    }
+
+    apply_rewrite(dead, &placed);
+  }
+
+  void fuse_layernorm(const std::map<std::string,
+                                     std::vector<int64_t>>& shp) {
+    FuseIdx ix = build_fuse_idx();
+    std::vector<char> dead(g.nodes.size(), 0);
+    std::map<size_t, Node> placed;
+    const size_t npos = size_t(-1);
+
+    const auto dims_of =
+        [&](const std::string& nm) -> const std::vector<int64_t>* {
+      auto it = shp.find(nm);
+      return it == shp.end() ? nullptr : &it->second;
+    };
+    const auto mid1 = [&](const std::string& nm) {
+      auto u = ix.uses.find(nm);
+      return !ix.outset.count(nm) && !g.initializers.count(nm) &&
+             u != ix.uses.end() && u->second.size() == 1;
+    };
+    const auto only_used_by = [&](const std::string& nm, size_t j) {
+      if (ix.outset.count(nm) || g.initializers.count(nm)) return false;
+      auto u = ix.uses.find(nm);
+      if (u == ix.uses.end()) return false;
+      for (size_t z : u->second)
+        if (z != j) return false;
+      return true;
+    };
+    const auto prod = [&](const std::string& nm) -> size_t {
+      auto it = ix.producer.find(nm);
+      if (it == ix.producer.end() || dead[it->second]) return npos;
+      return it->second;
+    };
+    const auto cons1 = [&](const std::string& nm) -> size_t {
+      if (!mid1(nm)) return npos;
+      const size_t j = ix.uses.find(nm)->second[0];
+      return dead[j] ? npos : j;
+    };
+    // mname = Div(Reshape(ReduceSum(x, last-axis, keepdims=0)), scalar
+    // const): the exported mean-over-last-dim. Fills x + the divisor.
+    const auto match_mean = [&](const std::string& mname, std::string* xn,
+                                float* divv,
+                                std::vector<size_t>* ch) -> bool {
+      if (!mid1(mname)) return false;
+      const size_t dj = prod(mname);
+      if (dj == npos || g.nodes[dj].op != "Div" ||
+          g.nodes[dj].inputs.size() != 2)
+        return false;
+      const Tensor* dc = scalar_const(g.nodes[dj].inputs[1]);
+      if (!dc) return false;
+      const std::string rn = g.nodes[dj].inputs[0];
+      if (!mid1(rn)) return false;
+      const size_t rj = prod(rn);
+      if (rj == npos || g.nodes[rj].op != "Reshape") return false;
+      const std::string sn = g.nodes[rj].inputs[0];
+      if (!mid1(sn)) return false;
+      const size_t sj = prod(sn);
+      if (sj == npos || g.nodes[sj].op != "ReduceSum" ||
+          g.nodes[sj].inputs.empty())
+        return false;
+      const std::string x = g.nodes[sj].inputs[0];
+      const auto* xd = dims_of(x);
+      if (!xd || xd->size() < 2) return false;
+      if (!last_axis_reduce(g.nodes[sj], *xd)) return false;
+      std::vector<int64_t> want = *xd;
+      want.back() = 1;
+      const auto* rrd = dims_of(rn);
+      if (!rrd || *rrd != want) return false;
+      *xn = x;
+      *divv = dc->f[0];
+      ch->push_back(dj);
+      ch->push_back(rj);
+      ch->push_back(sj);
+      return true;
+    };
+
+    for (size_t idx = 0; idx < g.nodes.size(); ++idx) {
+      if (dead[idx]) continue;
+      const Node& sq = g.nodes[idx];
+      if (sq.op != "Sqrt" || sq.outputs.size() != 1) continue;
+      std::vector<size_t> chain;
+      // ---- up: Sqrt(Add(var_guarded, eps))
+      if (!mid1(sq.inputs[0])) continue;
+      const size_t aidx = prod(sq.inputs[0]);
+      if (aidx == npos || g.nodes[aidx].op != "Add" ||
+          g.nodes[aidx].inputs.size() != 2)
+        continue;
+      float eps = 0.f;
+      std::string var_g;
+      {
+        const Tensor* c0 = scalar_const(g.nodes[aidx].inputs[0]);
+        const Tensor* c1 = scalar_const(g.nodes[aidx].inputs[1]);
+        if (c1 && !c0) {
+          eps = c1->f[0];
+          var_g = g.nodes[aidx].inputs[0];
+        } else if (c0 && !c1) {
+          eps = c0->f[0];
+          var_g = g.nodes[aidx].inputs[1];
+        } else {
+          continue;
+        }
+      }
+      if (!mid1(var_g)) continue;
+      // optional denominator guard: Where(all-true const, var, const)
+      std::string var_name = var_g;
+      {
+        const size_t wj = prod(var_g);
+        if (wj != npos && g.nodes[wj].op == "Where" &&
+            g.nodes[wj].inputs.size() == 3) {
+          const Tensor* cd = const_initializer(g.nodes[wj].inputs[0]);
+          const Tensor* alt = const_initializer(g.nodes[wj].inputs[2]);
+          if (!cd || !alt) continue;
+          bool all = true;
+          for (int64_t k = 0; all && k < cd->numel(); ++k)
+            if (cd->at(k) == 0) all = false;
+          if (!all) continue;  // guard can actually fire: keep unfused
+          var_name = g.nodes[wj].inputs[1];
+          if (!mid1(var_name)) continue;
+          chain.push_back(wj);
+        }
+      }
+      // var = Div(Reshape(ReduceSum(sqdiff, last)), const)
+      std::string sq_name;
+      float var_div = 1.f;
+      if (!match_mean(var_name, &sq_name, &var_div, &chain)) continue;
+      if (!mid1(sq_name)) continue;
+      const size_t mj = prod(sq_name);
+      if (mj == npos || g.nodes[mj].op != "Mul" ||
+          g.nodes[mj].inputs.size() != 2 ||
+          g.nodes[mj].inputs[0] != g.nodes[mj].inputs[1])
+        continue;
+      const std::string c2 = g.nodes[mj].inputs[0];
+      if (!only_used_by(c2, mj)) continue;
+      const size_t c2j = prod(c2);
+      if (c2j == npos || g.nodes[c2j].op != "Sub" ||
+          g.nodes[c2j].inputs.size() != 2)
+        continue;
+      std::string x = g.nodes[c2j].inputs[0];
+      std::string xB;
+      float mdivB = 1.f;
+      if (!match_mean(g.nodes[c2j].inputs[1], &xB, &mdivB, &chain))
+        continue;
+      if (xB != x) continue;
+      chain.push_back(mj);
+      chain.push_back(c2j);
+      // ---- down: Sqrt -> Pow(., -1) -> Mul(Sub(x, meanA), .)
+      const size_t pj = cons1(sq.outputs[0]);
+      if (pj == npos || g.nodes[pj].op != "Pow" ||
+          g.nodes[pj].inputs.size() != 2 ||
+          g.nodes[pj].inputs[0] != sq.outputs[0])
+        continue;
+      {
+        const Tensor* ec = scalar_const(g.nodes[pj].inputs[1]);
+        if (!ec || ec->f[0] != -1.0f) continue;
+      }
+      const std::string pw_name = g.nodes[pj].outputs[0];
+      const size_t m1j = cons1(pw_name);
+      if (m1j == npos || g.nodes[m1j].op != "Mul" ||
+          g.nodes[m1j].inputs.size() != 2)
+        continue;
+      const std::string c1 =
+          g.nodes[m1j].inputs[0] == pw_name ? g.nodes[m1j].inputs[1]
+                                            : g.nodes[m1j].inputs[0];
+      if (c1 == pw_name || !mid1(c1)) continue;
+      const size_t c1j = prod(c1);
+      if (c1j == npos || g.nodes[c1j].op != "Sub" ||
+          g.nodes[c1j].inputs.size() != 2 ||
+          g.nodes[c1j].inputs[0] != x)
+        continue;
+      std::string xA;
+      float mdivA = 1.f;
+      if (!match_mean(g.nodes[c1j].inputs[1], &xA, &mdivA, &chain))
+        continue;
+      if (xA != x) continue;
+      const auto* xd = dims_of(x);
+      if (!xd || xd->size() < 2) continue;
+      const int64_t D = xd->back();
+      // ---- optional affine tail: Mul(gamma) then Add(beta)
+      std::string out_name = g.nodes[m1j].outputs[0];
+      std::string gamma, beta;
+      {
+        const size_t gj = cons1(out_name);
+        if (gj != npos && g.nodes[gj].op == "Mul" &&
+            g.nodes[gj].inputs.size() == 2 &&
+            g.nodes[gj].outputs.size() == 1) {
+          const std::string other =
+              g.nodes[gj].inputs[0] == out_name ? g.nodes[gj].inputs[1]
+                                                : g.nodes[gj].inputs[0];
+          if (lastdim_vec_const(other, D)) {
+            gamma = other;
+            chain.push_back(gj);
+            out_name = g.nodes[gj].outputs[0];
+          }
+        }
+      }
+      if (!gamma.empty()) {
+        const size_t bj = cons1(out_name);
+        if (bj != npos && g.nodes[bj].op == "Add" &&
+            g.nodes[bj].inputs.size() == 2 &&
+            g.nodes[bj].outputs.size() == 1) {
+          const std::string other =
+              g.nodes[bj].inputs[0] == out_name ? g.nodes[bj].inputs[1]
+                                                : g.nodes[bj].inputs[0];
+          if (lastdim_vec_const(other, D)) {
+            beta = other;
+            chain.push_back(bj);
+            out_name = g.nodes[bj].outputs[0];
+          }
+        }
+      }
+      chain.insert(chain.end(), {idx, aidx, pj, m1j, c1j});
+      Node f;
+      f.op = "PtpuLayerNorm";
+      f.inputs = {x};
+      if (!gamma.empty()) f.inputs.push_back(gamma);
+      if (!beta.empty()) f.inputs.push_back(beta);
+      f.outputs = {out_name};
+      Attr ae;
+      ae.fval = eps;
+      f.attrs["ln_eps"] = ae;
+      Attr ama;
+      ama.fval = mdivA;
+      f.attrs["ln_mdiv"] = ama;
+      Attr amb;
+      amb.fval = mdivB;
+      f.attrs["ln_mdiv2"] = amb;
+      Attr av;
+      av.fval = var_div;
+      f.attrs["ln_vdiv"] = av;
+      Attr ag;
+      ag.ival = gamma.empty() ? 0 : 1;
+      f.attrs["ln_gamma"] = ag;
+      Attr ab;
+      ab.ival = beta.empty() ? 0 : 1;
+      f.attrs["ln_beta"] = ab;
+      size_t last = 0;
+      for (size_t j : chain) {
+        dead[j] = 1;
+        last = std::max(last, j);
+      }
+      fused_nodes_ += int(chain.size()) - 1;
+      placed[last] = std::move(f);
+    }
+
+    apply_rewrite(dead, &placed);
+  }
+
+  /* Tanh-approximate GELU: the exporter emits
+   *   Pow(x,3) -> Mul(c1) -> Add(x) -> Mul(c2) -> Tanh -> Add(c3) ->
+   *   Mul(c4) -> Mul(x)
+   * — eight full-tensor passes per FFN (one of them a serial pow and
+   * one a transcendental) for one elementwise function. The fused
+   * PtpuGelu replays the identical float ops in the identical order,
+   * so it is BITWISE equal to the chain, in one threaded pass. */
+  void fuse_gelu() {
+    FuseIdx ix = build_fuse_idx();
+    std::vector<char> dead(g.nodes.size(), 0);
+    std::map<size_t, Node> placed;
+    const size_t npos = size_t(-1);
+    const auto mid1 = [&](const std::string& nm) {
+      auto u = ix.uses.find(nm);
+      return !ix.outset.count(nm) && !g.initializers.count(nm) &&
+             u != ix.uses.end() && u->second.size() == 1;
+    };
+    const auto cons1 = [&](const std::string& nm) -> size_t {
+      if (!mid1(nm)) return npos;
+      const size_t j = ix.uses.find(nm)->second[0];
+      return dead[j] ? npos : j;
+    };
+    // j = single consumer of nm, must be `op` with nm + a scalar const
+    // (either order); returns the const value via *c
+    const auto scalar_step = [&](const std::string& nm, const char* op2,
+                                 float* c) -> size_t {
+      const size_t j = cons1(nm);
+      if (j == npos || g.nodes[j].op != op2 ||
+          g.nodes[j].inputs.size() != 2 || g.nodes[j].outputs.size() != 1)
+        return npos;
+      const std::string& other = g.nodes[j].inputs[0] == nm
+                                     ? g.nodes[j].inputs[1]
+                                     : g.nodes[j].inputs[0];
+      const Tensor* t = scalar_const(other);
+      if (!t || other == nm) return npos;
+      *c = t->f[0];
+      return j;
+    };
+    for (size_t idx = 0; idx < g.nodes.size(); ++idx) {
+      if (dead[idx]) continue;
+      const Node& pw = g.nodes[idx];
+      if (pw.op != "Pow" || pw.inputs.size() != 2 ||
+          pw.outputs.size() != 1)
+        continue;
+      const Tensor* e = scalar_const(pw.inputs[1]);
+      if (!e || e->f[0] != 3.0f) continue;
+      const std::string x = pw.inputs[0];
+      float c1, c2, c3, c4;
+      const size_t m1j = scalar_step(pw.outputs[0], "Mul", &c1);
+      if (m1j == npos) continue;
+      // Add(x, c1*x^3) — the non-chain operand must be x itself
+      const size_t a1j = cons1(g.nodes[m1j].outputs[0]);
+      if (a1j == npos || g.nodes[a1j].op != "Add" ||
+          g.nodes[a1j].inputs.size() != 2 ||
+          g.nodes[a1j].outputs.size() != 1)
+        continue;
+      {
+        const std::string& other =
+            g.nodes[a1j].inputs[0] == g.nodes[m1j].outputs[0]
+                ? g.nodes[a1j].inputs[1]
+                : g.nodes[a1j].inputs[0];
+        if (other != x) continue;
+      }
+      const size_t m2j = scalar_step(g.nodes[a1j].outputs[0], "Mul", &c2);
+      if (m2j == npos) continue;
+      const size_t tj = cons1(g.nodes[m2j].outputs[0]);
+      if (tj == npos || g.nodes[tj].op != "Tanh" ||
+          g.nodes[tj].outputs.size() != 1)
+        continue;
+      const size_t a2j = scalar_step(g.nodes[tj].outputs[0], "Add", &c3);
+      if (a2j == npos) continue;
+      const size_t m3j = scalar_step(g.nodes[a2j].outputs[0], "Mul", &c4);
+      if (m3j == npos) continue;
+      const size_t m4j = cons1(g.nodes[m3j].outputs[0]);
+      if (m4j == npos || g.nodes[m4j].op != "Mul" ||
+          g.nodes[m4j].inputs.size() != 2 ||
+          g.nodes[m4j].outputs.size() != 1)
+        continue;
+      {
+        const std::string& other =
+            g.nodes[m4j].inputs[0] == g.nodes[m3j].outputs[0]
+                ? g.nodes[m4j].inputs[1]
+                : g.nodes[m4j].inputs[0];
+        if (other != x) continue;
+      }
+      Node f;
+      f.op = "PtpuGelu";
+      f.inputs = {x};
+      f.outputs = {g.nodes[m4j].outputs[0]};
+      Attr a1a;
+      a1a.fval = c1;
+      f.attrs["gelu_c1"] = a1a;
+      Attr a2a;
+      a2a.fval = c2;
+      f.attrs["gelu_c2"] = a2a;
+      Attr a3a;
+      a3a.fval = c3;
+      f.attrs["gelu_c3"] = a3a;
+      Attr a4a;
+      a4a.fval = c4;
+      f.attrs["gelu_c4"] = a4a;
+      const size_t chain[] = {idx, m1j, a1j, m2j, tj, a2j, m3j, m4j};
+      size_t last = 0;
+      for (size_t j : chain) {
+        dead[j] = 1;
+        last = std::max(last, j);
+      }
+      fused_nodes_ += int(sizeof(chain) / sizeof(chain[0])) - 1;
+      placed[last] = std::move(f);
+    }
+    apply_rewrite(dead, &placed);
   }
 
   /* Load-time graph rewrite (reference: the conv_bn_fuse /
@@ -1986,8 +3508,16 @@ struct Predictor {
         } else {
           pm.int8_ok = int8_vals_ok(b.i.data(), b.i.size());
           if (pm.int8_ok) {
+            // int32 panels always (the batch-1 GEMV path reads them
+            // regardless of ISA); VNNI machines ADD the pair layout
+            // for the M > 1 vpdpwssd kernel — ~1.5x weight-pack bytes
+            // on exactly the machines with the most cache to spare
             pm.i.resize(size_t(b_pack_size(K, N)));
             pack_b<int64_t, int32_t>(b.i.data(), K, N, pm.i.data());
+            if (isa_vnni()) {
+              pm.i16.resize(size_t(b_pack16_size(K, N)));
+              pack_b16(b.i.data(), K, N, pm.i16.data());
+            }
           }
         }
         packed_w_[key] = std::move(pm);
@@ -2214,9 +3744,16 @@ void Predictor::run_node(const Node& n) {
       const bool bs = b.numel() == 1 && o.numel() != 1;
       const float *af = a.f.data(), *bf = b.f.data();
       float* of = o.f.data();
+      // transcendental fused activations (the GELU tanh) are
+      // compute-bound: thread them at the Exp/Erf grain, not the
+      // memory-bound elementwise grain (measured ~1.2 ms/pass on a
+      // 256k-element tanh at the coarse grain — 4 chunks on 24 cores)
+      const int64_t bin_grain =
+          (bact == ACT_SIGMOID || bact == ACT_TANH) ? (1 << 13)
+                                                    : (1 << 16);
       with_bin_op(code, [&](auto op) {
         with_act(bact, [&](auto act) {
-          parallel_for(o.numel(), 1 << 16, [&](int64_t lo, int64_t hi) {
+          parallel_for(o.numel(), bin_grain, [&](int64_t lo, int64_t hi) {
             if (as) {
               const float av = af[0];
               for (int64_t k = lo; k < hi; ++k)
@@ -2585,6 +4122,40 @@ void Predictor::run_node(const Node& n) {
       total += in(n, k).dims[size_t(axis)];
     o.dims[size_t(axis)] = total;
     o.alloc();
+    /* Same-dtype inputs (the KV-decode cache append, every exporter
+     * concat): each (outer, input) pair is ONE contiguous block of
+     * ax_t * inner elements — plain memcpys instead of the per-element
+     * rank-deep div/mod walk (measured ~0.5 ms per 16k-element cache
+     * concat on the old loop, the decode hot path's top cost). */
+    bool same_dt = true;
+    for (size_t t = 0; t < n.inputs.size(); ++t)
+      if (in(n, t).dtype != o.dtype ||
+          in(n, t).is_float() != o.is_float())
+        same_dt = false;
+    if (same_dt) {
+      int64_t outer = 1, inner = 1;
+      for (int64_t d = 0; d < axis; ++d) outer *= o.dims[size_t(d)];
+      for (size_t d = size_t(axis) + 1; d < o.dims.size(); ++d)
+        inner *= o.dims[d];
+      const int64_t esz = o.is_float() ? 4 : 8;
+      char* ob = o.is_float() ? reinterpret_cast<char*>(o.f.data())
+                              : reinterpret_cast<char*>(o.i.data());
+      int64_t off_ax = 0;
+      for (size_t t = 0; t < n.inputs.size(); ++t) {
+        const Tensor& a = in(n, t);
+        const int64_t ax = a.dims[size_t(axis)];
+        const char* ab = a.is_float()
+                             ? reinterpret_cast<const char*>(a.f.data())
+                             : reinterpret_cast<const char*>(a.i.data());
+        for (int64_t ou = 0; ou < outer; ++ou)
+          std::memcpy(ob + ((ou * total + off_ax) * inner) * esz,
+                      ab + (ou * ax * inner) * esz,
+                      size_t(ax * inner * esz));
+        off_ax += ax;
+      }
+      out(std::move(o));
+      return;
+    }
     auto ostr = strides_for(o.dims);
     int64_t offset = 0;
     for (size_t t = 0; t < n.inputs.size(); ++t) {
@@ -2801,10 +4372,19 @@ void Predictor::run_node(const Node& n) {
       // from the int64 storage into the panel buffers
       if (!batched_b) {
         std::vector<int32_t> acc(size_t(batch * m * nn));
-        gemm_bias_act<int32_t, int64_t, int64_t>(
-            a.i.data(), b.i.data(), acc.data(), batch * m, nn, k_d,
-            nullptr, pw && !pw->i.empty() ? pw->i.data() : nullptr,
-            nullptr, nullptr, ACT_NONE);
+        // VNNI dot-product path when the machine has it and the shape
+        // is past the GEMV special case; bitwise-equal (integer adds
+        // are associative) to the int32 packed kernel it replaces
+        if (isa_vnni() && batch * m > 1) {
+          gemm_i16(a.i.data(), b.i.data(), acc.data(), batch * m, nn,
+                   k_d,
+                   pw && !pw->i16.empty() ? pw->i16.data() : nullptr);
+        } else {
+          gemm_bias_act<int32_t, int64_t, int64_t>(
+              a.i.data(), b.i.data(), acc.data(), batch * m, nn, k_d,
+              nullptr, pw && !pw->i.empty() ? pw->i.data() : nullptr,
+              nullptr, nullptr, ACT_NONE);
+        }
         float* of = o.f.data();
         for (int64_t k = 0; k < batch * m * nn; ++k)
           of[k] = float(acc[size_t(k)]);
@@ -3282,6 +4862,214 @@ void Predictor::run_node(const Node& n) {
       }
     });
     out(std::move(o));
+  } else if (op == "PtpuAttention") {
+    /* Fused flash-style attention (load-time fuse_attention): q/k/v in
+     * the exporter's [batch, seq, heads, head_dim] layout, output in
+     * [b, q, h, d] (== the post-attention Transpose+Reshape memory
+     * layout, so the flat [b, q, h*d] form is the same bytes). Online
+     * softmax over k blocks — the [q, k] score matrix never
+     * materializes — with (batch, head, row-block) tasks spread over
+     * the WorkPool; the unfused path ran each head's GEMMs serially.
+     * Mask semantics replicate the Where node: masked positions take
+     * the `neg` operand's value BEFORE the row max, so fully-masked
+     * rows produce the same NaN the unfused softmax does. */
+    const Tensor &q = in(n, 0), &k = in(n, 1), &v = in(n, 2);
+    const bool has_mask = n.inputs.size() >= 5;
+    const Tensor* mk = has_mask ? &in(n, 3) : nullptr;
+    const Tensor* ng = has_mask ? &in(n, 4) : nullptr;
+    if (!q.is_float() || !k.is_float() || !v.is_float() ||
+        q.dims.size() != 4)
+      throw std::runtime_error("PtpuAttention: non-float or non-rank-4 "
+                               "operands at run time");
+    const float scale = attr_f(n, "ptpu_scale", 1.f);
+    const float sm_init = attr_f(n, "ptpu_sm_init",
+                                 -std::numeric_limits<float>::infinity());
+    const int64_t b = q.dims[0], sq = q.dims[1];
+    const int64_t h = q.dims[2], d = q.dims[3];
+    const int64_t sk = k.dims[1];
+    Tensor o;
+    o.dtype = DT_F32;
+    o.dims = attr_i(n, "ptpu_flat_out", 0)
+                 ? std::vector<int64_t>{b, sq, h * d}
+                 : std::vector<int64_t>{b, sq, h, d};
+    o.alloc();
+    // right-aligned broadcast strides over [b, h, q, k] for mask/neg
+    int64_t mst[4] = {0, 0, 0, 0}, nst[4] = {0, 0, 0, 0};
+    const auto bstr = [](const Tensor& t, int64_t st[4]) {
+      const size_t r = t.dims.size();
+      int64_t acc = 1;
+      for (size_t z = r; z-- > 0;) {
+        st[z + 4 - r] = t.dims[z] == 1 ? 0 : acc;
+        acc *= t.dims[z];
+      }
+    };
+    if (mk) bstr(*mk, mst);
+    if (ng) bstr(*ng, nst);
+    const float* qf = q.f.data();
+    const float* kf = k.f.data();
+    const float* vf = v.f.data();
+    float* of = o.f.data();
+    const float* ngf = ng ? ng->f.data() : nullptr;
+    const int64_t* mki = mk && !mk->is_float() ? mk->i.data() : nullptr;
+    const float* mkf = mk && mk->is_float() ? mk->f.data() : nullptr;
+    constexpr int64_t QB = 16, KB = 64;
+    const int64_t nqb = (sq + QB - 1) / QB;
+    // decode-sized blocks (q_len 1, tiny d) are microseconds of
+    // compute: run serially rather than paying a pool dispatch
+    const int64_t atn_grain =
+        b * h * sq * sk * d < (int64_t(1) << 18) ? b * h * nqb : 1;
+    parallel_for(b * h * nqb, atn_grain, [&](int64_t t0, int64_t t1) {
+      std::vector<float> acc(size_t(d), 0.f);
+      float s[KB];
+      for (int64_t t = t0; t < t1; ++t) {
+        const int64_t qb = t % nqb, bh = t / nqb;
+        const int64_t hh = bh % h, bb = bh / h;
+        const int64_t i1 = std::min(sq, (qb + 1) * QB);
+        for (int64_t i = qb * QB; i < i1; ++i) {
+          const float* qi = qf + ((bb * sq + i) * h + hh) * d;
+          float m = sm_init;
+          double l = 0.0;
+          for (int64_t z = 0; z < d; ++z) acc[size_t(z)] = 0.f;
+          for (int64_t j0 = 0; j0 < sk; j0 += KB) {
+            const int64_t jn = std::min(sk, j0 + KB) - j0;
+            for (int64_t jj = 0; jj < jn; ++jj) {
+              const float* kj = kf + ((bb * sk + j0 + jj) * h + hh) * d;
+              float dot = 0.f;
+              for (int64_t z = 0; z < d; ++z) dot += qi[z] * kj[z];
+              s[jj] = dot * scale;
+            }
+            if (mk) {
+              for (int64_t jj = 0; jj < jn; ++jj) {
+                const int64_t j = j0 + jj;
+                const int64_t mi =
+                    bb * mst[0] + hh * mst[1] + i * mst[2] + j * mst[3];
+                const bool keep =
+                    mki ? mki[mi] != 0 : mkf[mi] != 0.f;
+                if (!keep)
+                  s[jj] = ngf[bb * nst[0] + hh * nst[1] + i * nst[2] +
+                              j * nst[3]];
+              }
+            }
+            float bm = m;
+            for (int64_t jj = 0; jj < jn; ++jj)
+              bm = std::max(bm, s[jj]);
+            if (bm > m) {
+              const float r = float(std::exp(double(m) - double(bm)));
+              l *= double(r);
+              for (int64_t z = 0; z < d; ++z) acc[size_t(z)] *= r;
+              m = bm;
+            }
+            /* m still -inf => every score seen so far (this block
+             * included) is -inf. Against any later finite score these
+             * terms are exp(-inf - finite) == 0, so skipping them is
+             * exact; computing them here would be exp(-inf - -inf) ==
+             * NaN (a fully-masked k PREFIX spanning a whole block —
+             * the fresh-session decode shape). A row that stays -inf
+             * to the end keeps l == 0 and divides 0/0 below — the
+             * same NaN the unfused softmax yields for an all-masked
+             * row. */
+            if (std::isinf(m) && m < 0.f) continue;
+            for (int64_t jj = 0; jj < jn; ++jj) {
+              const float p =
+                  float(std::exp(double(s[jj]) - double(m)));
+              l += double(p);
+              const float* vj = vf + ((bb * sk + j0 + jj) * h + hh) * d;
+              for (int64_t z = 0; z < d; ++z)
+                acc[size_t(z)] += p * vj[z];
+            }
+          }
+          float* oi = of + ((bb * sq + i) * h + hh) * d;
+          const float lf = float(l);
+          for (int64_t z = 0; z < d; ++z)
+            oi[z] = acc[size_t(z)] / lf;
+        }
+      }
+    });
+    out(std::move(o));
+  } else if (op == "PtpuGelu") {
+    /* Fused tanh-GELU (load-time fuse_gelu): replays the exported
+     * chain's float ops in the same order — x*x*x (the Pow-3 special
+     * case), the same scalar mul/add sequence, double tanh — so the
+     * output is bitwise identical to the 8-pass chain. Threaded at
+     * the transcendental grain (tanh-bound). */
+    const Tensor& a = in(n, 0);
+    if (!a.is_float())
+      throw std::runtime_error("PtpuGelu: non-float input at run time");
+    const float c1 = attr_f(n, "gelu_c1", 0.f);
+    const float c2 = attr_f(n, "gelu_c2", 0.f);
+    const float c3 = attr_f(n, "gelu_c3", 0.f);
+    const float c4 = attr_f(n, "gelu_c4", 0.f);
+    Tensor o;
+    o.dims = a.dims;
+    o.dtype = DT_F32;
+    o.alloc();
+    const float* af = a.f.data();
+    float* of = o.f.data();
+    parallel_for(o.numel(), 1 << 13, [&](int64_t k0, int64_t k1) {
+      for (int64_t k = k0; k < k1; ++k) {
+        const float x = af[k];
+        const float inner = c2 * (x + c1 * (x * x * x));
+        const float t = float(std::tanh(double(inner)));
+        of[k] = x * (c4 * (c3 + t));
+      }
+    });
+    out(std::move(o));
+  } else if (op == "PtpuLayerNorm") {
+    /* Fused LayerNorm (load-time fuse_layernorm): the exported chain
+     * computes the mean TWICE (one for centering the variance, one for
+     * centering the output), a biased variance, a denominator guard
+     * (folded to always-true), sqrt, pow(.,-1) and the affine tail —
+     * ~16 memory-bound passes. One pass per row here, replaying the
+     * same float arithmetic (double-accumulated row sums like the
+     * ReduceSum fast path, float divides, pow(sqrt(var+eps), -1)). */
+    const Tensor& a = in(n, 0);
+    if (!a.is_float() || a.dims.size() < 2)
+      throw std::runtime_error("PtpuLayerNorm: non-float or sub-rank-2 "
+                               "input at run time");
+    const bool hg = attr_i(n, "ln_gamma", 0) != 0;
+    const bool hb = attr_i(n, "ln_beta", 0) != 0;
+    const Tensor* gt = hg ? &in(n, 1) : nullptr;
+    const Tensor* bt = hb ? &in(n, hg ? 2 : 1) : nullptr;
+    const float eps = attr_f(n, "ln_eps", 0.f);
+    const float mdivA = attr_f(n, "ln_mdiv", 1.f);
+    const float mdivB = attr_f(n, "ln_mdiv2", 1.f);
+    const float vdiv = attr_f(n, "ln_vdiv", 1.f);
+    const int64_t D = a.dims.back();
+    const int64_t rows = a.numel() / D;
+    Tensor o;
+    o.dims = a.dims;
+    o.dtype = DT_F32;
+    o.alloc();
+    const float* af = a.f.data();
+    float* of = o.f.data();
+    const float* gf = gt ? gt->f.data() : nullptr;
+    const float* bf = bt ? bt->f.data() : nullptr;
+    parallel_for(rows, std::max<int64_t>(1, 65536 / std::max<int64_t>(
+                                                      D, 1)),
+                 [&](int64_t r0, int64_t r1) {
+      for (int64_t row = r0; row < r1; ++row) {
+        const float* xr = af + row * D;
+        double sum = 0.0;
+        for (int64_t j = 0; j < D; ++j) sum += xr[j];
+        const float meanA = float(sum) / mdivA;
+        const float meanB = float(sum) / mdivB;
+        double s2 = 0.0;
+        for (int64_t j = 0; j < D; ++j) {
+          const float c = xr[j] - meanB;
+          s2 += double(c * c);
+        }
+        const float var = float(s2) / vdiv;
+        const float rstd = std::pow(std::sqrt(var + eps), -1.0f);
+        float* orow = of + row * D;
+        for (int64_t j = 0; j < D; ++j) {
+          float val = (xr[j] - meanA) * rstd;
+          if (gf) val *= gf[j];
+          if (bf) val += bf[j];
+          orow[j] = val;
+        }
+      }
+    });
+    out(std::move(o));
   } else {
     throw std::runtime_error("op '" + op + "' not supported by the native "
                              "predictor (re-export or extend "
@@ -3379,6 +5167,17 @@ static PTPU_Predictor* predictor_create_impl(const char* model_path,
     if (!opt || std::strcmp(opt, "0") != 0) {
       p->eliminate_identities();
       p->fuse_quant_ops();
+      // transformer fusions validate against dims recorded by one
+      // load-time dry run; dynamic-shape artifacts skip them exactly
+      // like they skip the memory plan
+      std::map<std::string, std::vector<int64_t>> shp;
+      std::map<std::string, int> dty;
+      if (p->dry_run_shapes(&shp, &dty)) {
+        p->eliminate_noop_casts(dty);
+        p->fuse_attention(shp);
+        p->fuse_layernorm(shp);
+      }
+      p->fuse_gelu();
       p->fuse_ops();
       p->prepack_weights();
       p->plan_memory();
@@ -3653,6 +5452,73 @@ void ptpu_predictor_set_profiler(ProfRecordFn record_fn,
                                  ProfEnabledFn enabled_fn) {
   g_prof_record.store(record_fn, std::memory_order_relaxed);
   g_prof_enabled.store(enabled_fn, std::memory_order_relaxed);
+}
+
+// ---- KV-cached decode (ISSUE r9 tentpole c) -------------------------
+/* Validate the decode-artifact convention and allocate the per-session
+ * KV arena (`sessions` slots). Returns 0 on success. Must be called
+ * before any other kv/decode entry. */
+__attribute__((visibility("default")))
+int ptpu_predictor_kv_plan(PTPU_Predictor* h, int sessions, char* err,
+                           int err_len) {
+  try {
+    if (!h) throw std::runtime_error("kv_plan: null predictor handle");
+    ((Predictor*)h)->kv_plan(sessions);
+    return 0;
+  } catch (const std::exception& e) {
+    fill_error(err, err_len, e.what());
+    return 1;
+  }
+}
+
+__attribute__((visibility("default")))
+int ptpu_predictor_kv_sessions(PTPU_Predictor* h) {
+  if (!h) return 0;
+  return ((Predictor*)h)->kv_sessions_;
+}
+
+// free slot id (len 0), or -1 when every slot is busy (the caller —
+// the serving layer — owns the eviction policy)
+__attribute__((visibility("default")))
+int ptpu_predictor_kv_open(PTPU_Predictor* h) {
+  if (!h) return -1;
+  return ((Predictor*)h)->kv_open();
+}
+
+__attribute__((visibility("default")))
+void ptpu_predictor_kv_close(PTPU_Predictor* h, int sid) {
+  if (!h) return;
+  ((Predictor*)h)->kv_close(sid);
+}
+
+// current appended length of a session (-1: bad/closed session)
+__attribute__((visibility("default")))
+int64_t ptpu_predictor_kv_len(PTPU_Predictor* h, int sid) {
+  auto* p = (Predictor*)h;
+  if (!p || sid < 0 || sid >= p->kv_sessions_ ||
+      !p->kv_sess_[size_t(sid)].open)
+    return -1;
+  return p->kv_sess_[size_t(sid)].len;
+}
+
+/* One batched decode step: row r feeds tokens[r] into open session
+ * sids[r] (n <= the artifact batch; a session may appear at most once
+ * per call). On success the per-row next-token logits are output 0 of
+ * the run (rows beyond n are padding) and each session's cache grew by
+ * one position. Same thread-compatibility contract as run(). */
+__attribute__((visibility("default")))
+int ptpu_predictor_decode_step(PTPU_Predictor* h, const int64_t* sids,
+                               const int64_t* tokens, int n, char* err,
+                               int err_len) {
+  try {
+    if (!h || !sids || !tokens)
+      throw std::runtime_error("decode_step: null handle or buffer");
+    ((Predictor*)h)->decode_step(sids, tokens, n);
+    return 0;
+  } catch (const std::exception& e) {
+    fill_error(err, err_len, e.what());
+    return 1;
+  }
 }
 
 // Output data as float32 (int outputs are converted in place once).

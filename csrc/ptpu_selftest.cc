@@ -280,6 +280,355 @@ void test_predictor_run_stats_accumulate() {
   assert(p.runs_ == 0 && p.op_stats_["Relu"].calls == 0);
 }
 
+// --------------------------------------------------------------- r9
+// graph-construction helpers for the transformer-fusion parity tests
+Tensor mk_f32(const std::vector<int64_t>& dims,
+              const std::vector<float>& vals) {
+  Tensor t;
+  t.dtype = DT_F32;
+  t.dims = dims;
+  t.f.assign(vals.begin(), vals.end());
+  return t;
+}
+Tensor mk_i64(const std::vector<int64_t>& dims,
+              const std::vector<int64_t>& vals) {
+  Tensor t;
+  t.dtype = DT_I64;
+  t.dims = dims;
+  t.i.assign(vals.begin(), vals.end());
+  return t;
+}
+Tensor mk_bool(const std::vector<int64_t>& dims,
+               const std::vector<int64_t>& vals) {
+  Tensor t;
+  t.dtype = DT_BOOL;
+  t.dims = dims;
+  t.i.assign(vals.begin(), vals.end());
+  return t;
+}
+void add_init(Predictor* p, const std::string& name, Tensor t) {
+  p->env[name] = t;
+  p->g.initializers[name] = std::move(t);
+}
+Node mk_node(const std::string& op, std::vector<std::string> ins,
+             std::vector<std::string> outs) {
+  Node n;
+  n.op = op;
+  n.inputs = std::move(ins);
+  n.outputs = std::move(outs);
+  return n;
+}
+void set_ints(Node* n, const char* name, std::vector<int64_t> v) {
+  Attr a;
+  a.ints = std::move(v);
+  n->attrs[name] = a;
+}
+void set_ival(Node* n, const char* name, int64_t v) {
+  Attr a;
+  a.ival = v;
+  n->attrs[name] = a;
+}
+
+/* Replicates the exporter's attention lowering byte for byte (the
+ * pattern fuse_attention matches): transposes + rank-3 reshapes +
+ * batched MatMuls + scalar scale (+ optional const mask Where) + the
+ * ReduceMax/Max/Sub/Exp/ReduceSum/Div softmax + output transpose +
+ * flatten. `sm_axis` parametrizes the softmax axis so a near-miss
+ * (axis != last) proves the matcher refuses to fuse it. */
+void build_attention_graph(Predictor* p, int64_t b, int64_t s, int64_t h,
+                           int64_t d, bool masked, int64_t sm_axis) {
+  Graph& g = p->g;
+  g.input_names = {"q", "k", "v"};
+  for (const auto& nm : g.input_names) {
+    g.input_dims[nm] = {b, s, h, d};
+    g.input_dtypes[nm] = DT_F32;
+  }
+  g.output_names = {"out"};
+  add_init(p, "sh_q3", mk_i64({3}, {b * h, s, d}));
+  add_init(p, "sh_k3", mk_i64({3}, {b * h, d, s}));
+  add_init(p, "sh_s4", mk_i64({4}, {b, h, s, s}));
+  add_init(p, "sh_keep", mk_i64({4}, {b, h, s, 1}));
+  add_init(p, "sh_p3", mk_i64({3}, {b * h, s, s}));
+  add_init(p, "sh_o4", mk_i64({4}, {b, h, s, d}));
+  add_init(p, "sh_flat", mk_i64({3}, {b, s, h * d}));
+  add_init(p, "scale", mk_f32({}, {0.37f}));
+  add_init(p, "ninf", mk_f32({}, {-std::numeric_limits<float>::infinity()}));
+  add_init(p, "axes_last", mk_i64({1}, {3}));
+  if (masked) {
+    // lower-triangular causal mask + a folded -inf else tensor, the
+    // shapes the exporter's folded Where carries
+    std::vector<int64_t> mv(size_t(s * s));
+    for (int64_t i = 0; i < s; ++i)
+      for (int64_t j = 0; j < s; ++j) mv[size_t(i * s + j)] = j <= i;
+    add_init(p, "maskc", mk_bool({1, 1, s, s}, mv));
+    add_init(p, "negc",
+             mk_f32({1, 1, 1, 1},
+                    {-std::numeric_limits<float>::infinity()}));
+  }
+  std::vector<Node> ns;
+  Node t1 = mk_node("Transpose", {"q"}, {"qt"});
+  set_ints(&t1, "perm", {0, 2, 1, 3});
+  ns.push_back(t1);
+  Node t2 = mk_node("Transpose", {"qt"}, {"qt2"});
+  set_ints(&t2, "perm", {0, 1, 2, 3});
+  ns.push_back(t2);
+  ns.push_back(mk_node("Reshape", {"qt2", "sh_q3"}, {"q3"}));
+  Node t3 = mk_node("Transpose", {"k"}, {"kt"});
+  set_ints(&t3, "perm", {0, 2, 1, 3});
+  ns.push_back(t3);
+  Node t4 = mk_node("Transpose", {"kt"}, {"kt2"});
+  set_ints(&t4, "perm", {0, 1, 3, 2});
+  ns.push_back(t4);
+  ns.push_back(mk_node("Reshape", {"kt2", "sh_k3"}, {"k3"}));
+  ns.push_back(mk_node("MatMul", {"q3", "k3"}, {"mm1"}));
+  ns.push_back(mk_node("Reshape", {"mm1", "sh_s4"}, {"s4"}));
+  ns.push_back(mk_node("Mul", {"s4", "scale"}, {"sc"}));
+  const char* scores = "sc";
+  if (masked) {
+    ns.push_back(mk_node("Where", {"maskc", "sc", "negc"}, {"scm"}));
+    scores = "scm";
+  }
+  Node rm = mk_node("ReduceMax", {scores}, {"rm"});
+  set_ints(&rm, "axes", {sm_axis});
+  set_ival(&rm, "keepdims", 0);
+  ns.push_back(rm);
+  ns.push_back(mk_node("Max", {"ninf", "rm"}, {"mx"}));
+  ns.push_back(mk_node("Reshape", {"mx", "sh_keep"}, {"mxr"}));
+  ns.push_back(mk_node("Sub", {scores, "mxr"}, {"sub"}));
+  ns.push_back(mk_node("Exp", {"sub"}, {"ex"}));
+  Node rs = mk_node("ReduceSum", {"ex", "axes_last"}, {"rs"});
+  set_ival(&rs, "keepdims", 0);
+  ns.push_back(rs);
+  ns.push_back(mk_node("Reshape", {"rs", "sh_keep"}, {"rsr"}));
+  ns.push_back(mk_node("Div", {"ex", "rsr"}, {"pr"}));
+  Node t5 = mk_node("Transpose", {"pr"}, {"prt"});
+  set_ints(&t5, "perm", {0, 1, 2, 3});
+  ns.push_back(t5);
+  ns.push_back(mk_node("Reshape", {"prt", "sh_p3"}, {"pr3"}));
+  Node t6 = mk_node("Transpose", {"v"}, {"vt"});
+  set_ints(&t6, "perm", {0, 2, 1, 3});
+  ns.push_back(t6);
+  Node t7 = mk_node("Transpose", {"vt"}, {"vt2"});
+  set_ints(&t7, "perm", {0, 1, 2, 3});
+  ns.push_back(t7);
+  ns.push_back(mk_node("Reshape", {"vt2", "sh_q3"}, {"v3"}));
+  ns.push_back(mk_node("MatMul", {"pr3", "v3"}, {"mm2"}));
+  ns.push_back(mk_node("Reshape", {"mm2", "sh_o4"}, {"o4"}));
+  Node t8 = mk_node("Transpose", {"o4"}, {"ot"});
+  set_ints(&t8, "perm", {0, 2, 1, 3});
+  ns.push_back(t8);
+  ns.push_back(mk_node("Reshape", {"ot", "sh_flat"}, {"out"}));
+  g.nodes = std::move(ns);
+}
+
+int count_op(const Predictor& p, const char* op) {
+  int c = 0;
+  for (const auto& n : p.g.nodes)
+    if (n.op == op) ++c;
+  return c;
+}
+
+void run_with_qkv(Predictor* p, const std::vector<float>& q,
+                  const std::vector<float>& k,
+                  const std::vector<float>& v,
+                  const std::vector<int64_t>& dims) {
+  Tensor tq = mk_f32(dims, q), tk = mk_f32(dims, k), tv = mk_f32(dims, v);
+  p->env["q"] = tq;
+  p->env["k"] = tk;
+  p->env["v"] = tv;
+  p->build_stats_index();
+  p->run();
+}
+
+void test_attention_fusion_parity() {
+  // odd seq, masked and unmasked, plus the near-miss axis control
+  for (int masked = 0; masked < 2; ++masked) {
+    const int64_t b = 2, s = 5, h = 2, d = 3;
+    std::mt19937 rng(7 + masked);
+    std::uniform_real_distribution<float> dist(-1.f, 1.f);
+    std::vector<float> q(size_t(b * s * h * d)), k(q.size()), v(q.size());
+    for (auto& x : q) x = dist(rng);
+    for (auto& x : k) x = dist(rng);
+    for (auto& x : v) x = dist(rng);
+
+    Predictor ref;
+    build_attention_graph(&ref, b, s, h, d, masked != 0, 3);
+    run_with_qkv(&ref, q, k, v, {b, s, h, d});
+
+    Predictor fp;
+    build_attention_graph(&fp, b, s, h, d, masked != 0, 3);
+    std::map<std::string, std::vector<int64_t>> shp;
+    std::map<std::string, int> dty;
+    assert(fp.dry_run_shapes(&shp, &dty));
+    fp.fuse_attention(shp);
+    assert(count_op(fp, "PtpuAttention") == 1);
+    assert(count_op(fp, "MatMul") == 0 && count_op(fp, "Exp") == 0);
+    run_with_qkv(&fp, q, k, v, {b, s, h, d});
+
+    assert(ref.outputs.size() == 1 && fp.outputs.size() == 1);
+    assert(ref.outputs[0].dims == fp.outputs[0].dims);
+    for (int64_t i = 0; i < ref.outputs[0].numel(); ++i) {
+      const float a = ref.outputs[0].f[size_t(i)];
+      const float bv = fp.outputs[0].f[size_t(i)];
+      assert(std::fabs(a - bv) <= 1e-5f * (1.f + std::fabs(a)));
+    }
+  }
+  // NEAR-MISS control: softmax over axis 2 (not last) must NOT fuse
+  {
+    Predictor nf;
+    build_attention_graph(&nf, 2, 4, 2, 3, false, 2);
+    std::map<std::string, std::vector<int64_t>> shp;
+    std::map<std::string, int> dty;
+    // the axis-2 ReduceMax makes Sub/Div shapes inconsistent with the
+    // keepdim reshape targets, so the dry run itself may throw OR the
+    // matcher must refuse — either way: no PtpuAttention node
+    if (nf.dry_run_shapes(&shp, &dty)) nf.fuse_attention(shp);
+    assert(count_op(nf, "PtpuAttention") == 0);
+  }
+}
+
+void test_layernorm_fusion_parity() {
+  const int64_t b = 2, s = 3, D = 4;
+  Predictor ref, fp;
+  for (Predictor* p : {&ref, &fp}) {
+    Graph& g = p->g;
+    g.input_names = {"x"};
+    g.input_dims["x"] = {b, s, D};
+    g.input_dtypes["x"] = DT_F32;
+    g.output_names = {"out"};
+    add_init(p, "axes", mk_i64({1}, {2}));
+    add_init(p, "sh_keep", mk_i64({3}, {b, s, 1}));
+    add_init(p, "Dc", mk_f32({}, {float(D)}));
+    add_init(p, "eps", mk_f32({}, {1e-5f}));
+    add_init(p, "negone", mk_f32({}, {-1.f}));
+    add_init(p, "gamma", mk_f32({1, 1, D}, {1.5f, 0.5f, -2.f, 1.f}));
+    add_init(p, "beta", mk_f32({1, 1, D}, {0.1f, -0.2f, 0.3f, 0.f}));
+    add_init(p, "condc", mk_bool({b, s, 1}, std::vector<int64_t>(
+                                                size_t(b * s), 1)));
+    add_init(p, "altc",
+             mk_f32({b, s, 1}, std::vector<float>(size_t(b * s),
+                                                  std::nanf(""))));
+    std::vector<Node> ns;
+    Node r1 = mk_node("ReduceSum", {"x", "axes"}, {"s1"});
+    set_ival(&r1, "keepdims", 0);
+    ns.push_back(r1);
+    ns.push_back(mk_node("Reshape", {"s1", "sh_keep"}, {"r1"}));
+    ns.push_back(mk_node("Div", {"r1", "Dc"}, {"meanA"}));
+    Node r2 = mk_node("ReduceSum", {"x", "axes"}, {"s2"});
+    set_ival(&r2, "keepdims", 0);
+    ns.push_back(r2);
+    ns.push_back(mk_node("Reshape", {"s2", "sh_keep"}, {"r2"}));
+    ns.push_back(mk_node("Div", {"r2", "Dc"}, {"meanB"}));
+    ns.push_back(mk_node("Sub", {"x", "meanB"}, {"c2"}));
+    ns.push_back(mk_node("Mul", {"c2", "c2"}, {"sq"}));
+    Node r3 = mk_node("ReduceSum", {"sq", "axes"}, {"s3"});
+    set_ival(&r3, "keepdims", 0);
+    ns.push_back(r3);
+    ns.push_back(mk_node("Reshape", {"s3", "sh_keep"}, {"r3"}));
+    ns.push_back(mk_node("Div", {"r3", "Dc"}, {"var"}));
+    ns.push_back(mk_node("Where", {"condc", "var", "altc"}, {"varg"}));
+    ns.push_back(mk_node("Add", {"varg", "eps"}, {"ve"}));
+    ns.push_back(mk_node("Sqrt", {"ve"}, {"sqv"}));
+    ns.push_back(mk_node("Pow", {"sqv", "negone"}, {"rstd"}));
+    ns.push_back(mk_node("Sub", {"x", "meanA"}, {"c1"}));
+    ns.push_back(mk_node("Mul", {"c1", "rstd"}, {"m1"}));
+    ns.push_back(mk_node("Mul", {"m1", "gamma"}, {"m2"}));
+    ns.push_back(mk_node("Add", {"m2", "beta"}, {"out"}));
+    g.nodes = std::move(ns);
+  }
+  std::mt19937 rng(11);
+  std::uniform_real_distribution<float> dist(-2.f, 2.f);
+  std::vector<float> x(size_t(b * s * D), 0.f);
+  for (auto& v2 : x) v2 = dist(rng);
+  const auto run_x = [&](Predictor* p) {
+    p->env["x"] = mk_f32({b, s, D}, x);
+    p->build_stats_index();
+    p->run();
+  };
+  run_x(&ref);
+  std::map<std::string, std::vector<int64_t>> shp;
+  std::map<std::string, int> dty;
+  assert(fp.dry_run_shapes(&shp, &dty));
+  fp.fuse_layernorm(shp);
+  assert(count_op(fp, "PtpuLayerNorm") == 1);
+  assert(count_op(fp, "Sqrt") == 0 && count_op(fp, "ReduceSum") == 0);
+  run_x(&fp);
+  for (int64_t i = 0; i < ref.outputs[0].numel(); ++i) {
+    const float a = ref.outputs[0].f[size_t(i)];
+    const float bv = fp.outputs[0].f[size_t(i)];
+    assert(std::fabs(a - bv) <= 1e-5f * (1.f + std::fabs(a)));
+  }
+}
+
+void test_gelu_fusion_bitwise() {
+  const int64_t n = 2 * 7;
+  Predictor ref, fp;
+  for (Predictor* p : {&ref, &fp}) {
+    Graph& g = p->g;
+    g.input_names = {"x"};
+    g.input_dims["x"] = {2, 7};
+    g.input_dtypes["x"] = DT_F32;
+    g.output_names = {"out"};
+    add_init(p, "three", mk_f32({}, {3.f}));
+    add_init(p, "c1", mk_f32({}, {0.044715f}));
+    add_init(p, "c2", mk_f32({}, {0.7978846f}));
+    add_init(p, "one", mk_f32({}, {1.f}));
+    add_init(p, "half", mk_f32({}, {0.5f}));
+    std::vector<Node> ns;
+    ns.push_back(mk_node("Pow", {"x", "three"}, {"p3"}));
+    ns.push_back(mk_node("Mul", {"c1", "p3"}, {"m1"}));
+    ns.push_back(mk_node("Add", {"x", "m1"}, {"a1"}));
+    ns.push_back(mk_node("Mul", {"c2", "a1"}, {"m2"}));
+    ns.push_back(mk_node("Tanh", {"m2"}, {"t"}));
+    ns.push_back(mk_node("Add", {"one", "t"}, {"a2"}));
+    ns.push_back(mk_node("Mul", {"half", "a2"}, {"m3"}));
+    ns.push_back(mk_node("Mul", {"x", "m3"}, {"out"}));
+    g.nodes = std::move(ns);
+  }
+  std::mt19937 rng(13);
+  std::uniform_real_distribution<float> dist(-3.f, 3.f);
+  std::vector<float> x(size_t(n), 0.f);
+  for (auto& v2 : x) v2 = dist(rng);
+  const auto run_x = [&](Predictor* p) {
+    p->env["x"] = mk_f32({2, 7}, x);
+    p->build_stats_index();
+    p->run();
+  };
+  run_x(&ref);
+  fp.fuse_gelu();
+  assert(count_op(fp, "PtpuGelu") == 1 && fp.g.nodes.size() == 1);
+  run_x(&fp);
+  for (int64_t i = 0; i < n; ++i)   // same float ops, same order
+    assert(ref.outputs[0].f[size_t(i)] == fp.outputs[0].f[size_t(i)]);
+}
+
+void test_gemm_i16_pair_path_exact() {
+  // the VNNI pair-packed path (vpdpwssd where cpuid allows, generic
+  // pair kernel otherwise) must match the scalar reference EXACTLY —
+  // integer adds are associative, so any reordering is still ==
+  std::mt19937 rng(17);
+  std::uniform_int_distribution<int> dist(-128, 127);
+  for (const auto& mnk : {std::array<int64_t, 3>{9, 13, 21},
+                          std::array<int64_t, 3>{16, 16, 32},
+                          std::array<int64_t, 3>{7, 33, 5}}) {
+    const int64_t M = mnk[0], N = mnk[1], K = mnk[2];
+    std::vector<int64_t> A(size_t(M * K)), B(size_t(K * N));
+    for (auto& v : A) v = dist(rng);
+    for (auto& v : B) v = dist(rng);
+    std::vector<int32_t> C(size_t(M * N), 0);
+    gemm_i16(A.data(), B.data(), C.data(), M, N, K, nullptr);
+    for (int64_t m = 0; m < M; ++m)
+      for (int64_t j = 0; j < N; ++j) {
+        int64_t acc = 0;
+        for (int64_t k = 0; k < K; ++k)
+          acc += A[size_t(m * K + k)] * B[size_t(k * N + j)];
+        assert(C[size_t(m * N + j)] == acc);
+      }
+  }
+  std::printf("  gemm_i16 exact (vnni=%d, isa=%d)\n", int(isa_vnni()),
+              isa_level());
+}
+
 }  // namespace
 
 int main() {
@@ -296,6 +645,10 @@ int main() {
   test_plan_arena_reuses_offsets();
   test_pack_b_im2col_matches_reference();
   test_predictor_run_stats_accumulate();
+  test_attention_fusion_parity();
+  test_layernorm_fusion_parity();
+  test_gelu_fusion_bitwise();
+  test_gemm_i16_pair_path_exact();
   std::printf("ptpu_selftest: all native unit tests passed\n");
   return 0;
 }

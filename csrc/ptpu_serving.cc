@@ -64,6 +64,7 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 #include <vector>
@@ -82,6 +83,24 @@ constexpr uint8_t kTagInferRep = 0x61;
 constexpr uint8_t kTagInferErr = 0x62;
 constexpr uint8_t kTagMetaReq = 0x63;
 constexpr uint8_t kTagMetaRep = 0x64;
+/* KV-cached decode ops (ISSUE r9): sessions are server-side KV slots
+ * in the decode predictor; a step feeds one token into one session
+ * and answers that session's next-token logits. Layouts (payload
+ * offsets, after the u32 frame length):
+ *   DECODE_OPEN  [ver][tag][u64 req_id]                      (10 B)
+ *   DECODE_SESS  [ver][tag][u64 req_id][u64 session]         (18 B)
+ *   DECODE_STEP  [ver][tag][u64 req_id][u64 session][i64 tok](26 B)
+ *   DECODE_REP   [ver][tag][u64 req_id][u64 session]
+ *                [u32 n_logits][f32 x n]
+ *   DECODE_CLOSE [ver][tag][u64 req_id][u64 session] -> SESS echo
+ * Errors ride the existing INFER_ERR frame. Python twin:
+ * inference/serving.py TAG_DECODE_* (tools/ptpu_check.py wire checker
+ * holds the two in lockstep). */
+constexpr uint8_t kTagDecodeOpen = 0x65;
+constexpr uint8_t kTagDecodeSess = 0x66;
+constexpr uint8_t kTagDecodeStep = 0x67;
+constexpr uint8_t kTagDecodeRep = 0x68;
+constexpr uint8_t kTagDecodeClose = 0x69;
 constexpr uint32_t kSvMaxFrame = 1u << 30;
 constexpr int kSvMaxNdim = 16;
 // backpressure budget: how long one INFER frame may sit deferred on a
@@ -111,6 +130,11 @@ struct SvRequest {
   std::vector<SvInput> inputs;
   ptpu::net::ConnPtr conn;
   int64_t t_enq_us = 0;
+  // decode steps ride the same batcher machinery as INFER requests
+  // (continuous batching of decode steps across sessions)
+  bool is_decode = false;
+  uint64_t session = 0;
+  int64_t token = 0;
 };
 
 // Always-on counters/histograms (csrc/ptpu_stats.h relaxed atomics).
@@ -294,6 +318,24 @@ struct SvInstance {
   }
 };
 
+// decode-plane counters (rendered under "decode" in stats_json; the
+// PS twin-registry checker only covers the PS renderers, so these are
+// C-only by construction)
+struct DecStats {
+  ptpu::Counter opens, closes, evictions, steps, replies, batches;
+  ptpu::Histogram run_us, batch_fill;
+  void Reset() {
+    opens.Reset();
+    closes.Reset();
+    evictions.Reset();
+    steps.Reset();
+    replies.Reset();
+    batches.Reset();
+    run_us.Reset();
+    batch_fill.Reset();
+  }
+};
+
 struct SvServer {
   std::string model_path;
   std::string authkey;
@@ -302,6 +344,41 @@ struct SvServer {
   int64_t deadline_us = 2000;
   int instances = 2;
   int threads_per_instance = 0;
+  // ---- KV-cached decode plane (optional second artifact) ----
+  std::string decode_model_path;
+  int kv_sessions = 0;             // 0 -> PTPU_KV_SESSIONS -> 64
+  PTPU_Predictor* dec_pred = nullptr;
+  void* dec_pool = nullptr;
+  int64_t dec_batch = 0;           // decode artifact's baked batch
+  int64_t dec_ctx = 0;             // cache positions per session
+  int64_t dec_logit_elems = 0;     // logits row width
+  std::unique_ptr<SvBatcher> dec_batcher;
+  DecStats dstats;
+  /* Wire-session registry, two locks with a fixed order kv_mu_ ->
+   * sess_mu_:
+   *   sess_mu_  the registry map only — always held briefly.
+   *   kv_mu_    every ptpu_predictor_kv_* / decode_step call (the
+   *             predictor is thread-compatible; open/close arrive on
+   *             event threads while steps run on the decode worker).
+   * The split keeps the event loops responsive: a closing INFER-only
+   * connection checks session ownership under sess_mu_ alone and
+   * never waits out a running decode batch; only decode-plane ops
+   * (open/close/step of sessions) serialize on kv_mu_. slot == -1 is
+   * an eviction tombstone: later steps on that session answer
+   * "evicted" instead of "unknown". */
+  struct WireSession {
+    int slot = -1;
+    uint64_t last_us = 0;
+    const void* owner = nullptr;   // opening conn (freed on conn close)
+  };
+  std::mutex kv_mu_;
+  std::mutex sess_mu_;
+  std::map<uint64_t, WireSession> sessions_;
+  uint64_t next_session_ = 1;
+  // the decode batcher keeps its own batcher-stats block so the INFER
+  // plane's exact counters (batches, batched_requests, queue_depth)
+  // stay decode-free
+  SvStats dec_bstats;
   std::vector<int64_t> ladder;
   std::vector<SvInputSig> sig;
   int n_outputs = 0;
@@ -403,6 +480,58 @@ struct SvServer {
 
     for (auto& inst : insts) inst->stage.resize(sig.size());
 
+    // ---- optional KV-decode plane: its own predictor (the KV arena
+    // lives inside it — sessions are bound to ONE predictor), its own
+    // worker sub-pool, and its own micro-batcher instance so decode
+    // steps from different sessions batch continuously without mixing
+    // into INFER flushes.
+    if (!decode_model_path.empty()) {
+      if (kv_sessions <= 0) {
+        const char* e = std::getenv("PTPU_KV_SESSIONS");
+        kv_sessions = e ? std::atoi(e) : 0;
+        if (kv_sessions <= 0) kv_sessions = 64;
+      }
+      dec_pred = ptpu_predictor_create_opts(decode_model_path.c_str(), 0,
+                                            0, err, sizeof(err));
+      if (!dec_pred)
+        throw std::runtime_error(std::string("decode model: ") + err);
+      dec_pool = ptpu_workpool_create(threads_per_instance);
+      ptpu_predictor_set_pool(dec_pred, dec_pool);
+      if (ptpu_predictor_kv_plan(dec_pred, kv_sessions, err,
+                                 sizeof(err)) != 0)
+        throw std::runtime_error(std::string("kv_plan: ") + err);
+      const int64_t* idd = ptpu_predictor_input_dims(dec_pred, 0);
+      const int64_t* cdd = ptpu_predictor_input_dims(dec_pred, 2);
+      if (!idd || !cdd)
+        throw std::runtime_error("decode model: missing input dims");
+      dec_batch = idd[0];
+      dec_ctx = cdd[1];
+      // probe one step now: a malformed decode artifact fails at
+      // start, not on the first live session; also learns the logits
+      // row width for DECODE_REP frames
+      {
+        const int sid = ptpu_predictor_kv_open(dec_pred);
+        if (sid < 0) throw std::runtime_error("kv probe: no slot");
+        const int64_t sids[1] = {sid}, toks[1] = {0};
+        if (ptpu_predictor_decode_step(dec_pred, sids, toks, 1, err,
+                                       sizeof(err)) != 0)
+          throw std::runtime_error(std::string("decode probe: ") + err);
+        const int nd = ptpu_predictor_output_ndim(dec_pred, 0);
+        const int64_t* od = ptpu_predictor_output_dims(dec_pred, 0);
+        if (nd < 1 || !od || od[0] != dec_batch)
+          throw std::runtime_error(
+              "decode probe: logits output lost the batch axis");
+        dec_logit_elems = 1;
+        for (int k = 1; k < nd; ++k) dec_logit_elems *= od[k];
+        ptpu_predictor_kv_close(dec_pred, sid);
+      }
+      dec_batcher.reset(new SvBatcher(
+          dec_batch, deadline_us, 1, &dec_bstats,
+          [this](int, std::vector<SvRequest>& batch) {
+            RunDecode(batch);
+          }));
+    }
+
     BuildMetaJson();
 
     batcher.reset(new SvBatcher(
@@ -426,10 +555,12 @@ struct SvServer {
       stats.proto_errors.Add(1);
     };
     // conn->user stashes a parsed-but-unqueued SvRequest across defer
-    // retries (see OnFrame); free it if the conn dies mid-defer
-    cbs.on_close = [](const ptpu::net::ConnPtr& c) {
+    // retries (see OnFrame); free it if the conn dies mid-defer. A
+    // closing conn also frees every decode session it opened.
+    cbs.on_close = [this](const ptpu::net::ConnPtr& c) {
       delete static_cast<SvRequest*>(c->user);
       c->user = nullptr;
+      DecodeConnClosed(c.get());
     };
     net_srv.reset(new ptpu::net::Server(opt, std::move(cbs), &net));
     std::string nerr;
@@ -521,7 +652,20 @@ struct SvServer {
       }
       out += "]}";
     }
-    out += "]}";
+    out += "]";
+    if (dec_pred) {
+      out += ",\"decode\":{";
+      ptpu::AppendJsonU64(&out, "batch", uint64_t(dec_batch));
+      out += ',';
+      ptpu::AppendJsonU64(&out, "context", uint64_t(dec_ctx));
+      out += ',';
+      ptpu::AppendJsonU64(&out, "kv_sessions", uint64_t(kv_sessions));
+      out += ',';
+      ptpu::AppendJsonU64(&out, "logit_elems",
+                          uint64_t(dec_logit_elems));
+      out += '}';
+    }
+    out += "}";
     meta_json = std::move(out);
   }
 
@@ -666,6 +810,219 @@ struct SvServer {
     }
   }
 
+  // ------------------------------------------------- decode plane
+  bool DecodeOpen(const ptpu::net::ConnPtr& conn, uint64_t* sess,
+                  std::string* why) {
+    std::lock_guard<std::mutex> kl(kv_mu_);
+    std::lock_guard<std::mutex> l(sess_mu_);
+    int slot = ptpu_predictor_kv_open(dec_pred);
+    if (slot < 0) {
+      // every KV slot busy: evict the least-recently-stepped live
+      // session (its later steps answer "evicted" off the tombstone)
+      uint64_t victim = 0, oldest = UINT64_MAX;
+      bool found = false;
+      for (const auto& kv : sessions_)
+        if (kv.second.slot >= 0 && kv.second.last_us < oldest) {
+          oldest = kv.second.last_us;
+          victim = kv.first;
+          found = true;
+        }
+      if (!found) {
+        *why = "no KV session slots";
+        return false;
+      }
+      ptpu_predictor_kv_close(dec_pred, sessions_[victim].slot);
+      sessions_[victim].slot = -1;
+      dstats.evictions.Add(1);
+      slot = ptpu_predictor_kv_open(dec_pred);
+      if (slot < 0) {
+        *why = "no KV session slots";
+        return false;
+      }
+    }
+    // bound tombstone growth: drop the oldest evicted entries once
+    // they outnumber the live slots 4:1
+    size_t tombs = 0;
+    for (const auto& kv : sessions_)
+      if (kv.second.slot < 0) ++tombs;
+    for (auto it = sessions_.begin();
+         tombs > size_t(4 * kv_sessions) && it != sessions_.end();) {
+      if (it->second.slot < 0) {
+        it = sessions_.erase(it);
+        --tombs;
+      } else {
+        ++it;
+      }
+    }
+    const uint64_t id = next_session_++;
+    WireSession ws;
+    ws.slot = slot;
+    ws.last_us = uint64_t(ptpu::NowUs());
+    ws.owner = conn.get();
+    sessions_[id] = ws;
+    dstats.opens.Add(1);
+    *sess = id;
+    return true;
+  }
+
+  bool DecodeClose(uint64_t sess, std::string* why) {
+    std::lock_guard<std::mutex> kl(kv_mu_);
+    std::lock_guard<std::mutex> l(sess_mu_);
+    auto it = sessions_.find(sess);
+    if (it == sessions_.end()) {
+      *why = "unknown decode session";
+      return false;
+    }
+    if (it->second.slot >= 0)
+      ptpu_predictor_kv_close(dec_pred, it->second.slot);
+    sessions_.erase(it);
+    dstats.closes.Add(1);
+    return true;
+  }
+
+  void DecodeConnClosed(const void* conn) {
+    if (!dec_pred) return;
+    {
+      // fast path for the common case — a closing connection that
+      // never opened a decode session must not wait out a running
+      // decode batch on kv_mu_ (that would stall its whole event loop)
+      std::lock_guard<std::mutex> l(sess_mu_);
+      bool owns = false;
+      for (const auto& kv : sessions_)
+        if (kv.second.owner == conn) {
+          owns = true;
+          break;
+        }
+      if (!owns) return;
+    }
+    std::lock_guard<std::mutex> kl(kv_mu_);
+    std::lock_guard<std::mutex> l(sess_mu_);
+    for (auto it = sessions_.begin(); it != sessions_.end();) {
+      if (it->second.owner == conn) {
+        if (it->second.slot >= 0)
+          ptpu_predictor_kv_close(dec_pred, it->second.slot);
+        it = sessions_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+
+  /* One decode flush. The FIFO may hold several steps of one session
+   * (a pipelining client); a session's steps are ordered, so the
+   * batch splits into FIFO-prefix sub-runs with unique sessions. */
+  void RunDecode(std::vector<SvRequest>& batch) {
+    size_t i = 0;
+    while (i < batch.size()) {
+      std::vector<SvRequest*> run;
+      std::set<uint64_t> seen;
+      size_t j = i;
+      for (; j < batch.size() && int64_t(run.size()) < dec_batch; ++j) {
+        if (seen.count(batch[j].session)) break;
+        seen.insert(batch[j].session);
+        run.push_back(&batch[j]);
+      }
+      DecodeStepRun(run);
+      i = j;
+    }
+  }
+
+  // reply with row `row` of the just-run decode outputs (kv_mu_ held:
+  // the next run overwrites the predictor's output block)
+  void DecodeReply(SvRequest* r, const float* lg, int64_t row) {
+    std::vector<uint8_t> f = r->conn->AcquireBuf();
+    f.resize(4 + 2 + 8 + 8 + 4 + size_t(dec_logit_elems) * 4);
+    f[4] = kSvWireVersion;
+    f[5] = kTagDecodeRep;
+    ptpu::PutU64(f.data() + 6, r->id);
+    ptpu::PutU64(f.data() + 14, r->session);
+    PutU32(f.data() + 22, uint32_t(dec_logit_elems));
+    std::memcpy(f.data() + 26, lg + row * dec_logit_elems,
+                size_t(dec_logit_elems) * 4);
+    const size_t sent = f.size();
+    if (r->conn->SendPayload(std::move(f))) {
+      dstats.replies.Add(1);
+      stats.bytes_out.Add(sent);
+      stats.e2e_us.Observe(uint64_t(ptpu::NowUs() - r->t_enq_us));
+    }
+    r->conn->NotePending(-1);
+  }
+
+  void DecodeStepRun(std::vector<SvRequest*>& run) {
+    char err[512] = {0};
+    std::vector<int64_t> sids, toks;
+    std::vector<SvRequest*> live;
+    std::lock_guard<std::mutex> kl(kv_mu_);
+    {
+      std::lock_guard<std::mutex> l(sess_mu_);
+      for (auto* r : run) {
+        auto it = sessions_.find(r->session);
+        if (it == sessions_.end() || it->second.slot < 0) {
+          SendErrFrame(r->conn, r->id,
+                       it == sessions_.end() ? "unknown decode session"
+                                             : "decode session evicted");
+          r->conn->NotePending(-1);
+          continue;
+        }
+        it->second.last_us = uint64_t(ptpu::NowUs());
+        sids.push_back(it->second.slot);
+        toks.push_back(r->token);
+        live.push_back(r);
+      }
+    }
+    if (live.empty()) return;
+    const int64_t t0 = ptpu::NowUs();
+    if (ptpu_predictor_decode_step(dec_pred, sids.data(), toks.data(),
+                                   int(live.size()), err,
+                                   sizeof(err)) != 0) {
+      /* One request's bad input (e.g. an out-of-vocab token failing
+       * the embedding Gather) must not error its co-batched
+       * neighbours: retry each row alone so only the offending
+       * session answers the error. Pays only on the error path. */
+      if (live.size() == 1) {
+        SendErrFrame(live[0]->conn, live[0]->id,
+                     std::string("decode_step: ") + err);
+        live[0]->conn->NotePending(-1);
+        return;
+      }
+      for (size_t r2 = 0; r2 < live.size(); ++r2) {
+        char rerr[512] = {0};
+        const int64_t sid1[1] = {sids[r2]}, tok1[1] = {toks[r2]};
+        if (ptpu_predictor_decode_step(dec_pred, sid1, tok1, 1, rerr,
+                                       sizeof(rerr)) != 0) {
+          SendErrFrame(live[r2]->conn, live[r2]->id,
+                       std::string("decode_step: ") + rerr);
+          live[r2]->conn->NotePending(-1);
+          continue;
+        }
+        dstats.batches.Add(1);
+        dstats.batch_fill.Observe(1);
+        const float* lg1 = ptpu_predictor_output_data(dec_pred, 0);
+        if (lg1) {
+          DecodeReply(live[r2], lg1, 0);
+        } else {
+          SendErrFrame(live[r2]->conn, live[r2]->id,
+                       "decode: no logits output");
+          live[r2]->conn->NotePending(-1);
+        }
+      }
+      return;
+    }
+    dstats.run_us.Observe(uint64_t(ptpu::NowUs() - t0));
+    dstats.batches.Add(1);
+    dstats.batch_fill.Observe(uint64_t(live.size()));
+    const float* lg = ptpu_predictor_output_data(dec_pred, 0);
+    if (!lg) {
+      for (auto* r : live) {
+        SendErrFrame(r->conn, r->id, "decode: no logits output");
+        r->conn->NotePending(-1);
+      }
+      return;
+    }
+    for (size_t r2 = 0; r2 < live.size(); ++r2)
+      DecodeReply(live[r2], lg, int64_t(r2));
+  }
+
   // ------------------------------------------------------ wire loop
 
   // One complete frame from the epoll core (event-thread context).
@@ -715,6 +1072,75 @@ struct SvServer {
       std::memcpy(f.data() + 10, meta_json.data(), meta_json.size());
       stats.bytes_out.Add(f.size());
       if (!conn->SendPayload(std::move(f))) return FrameResult::kClose;
+      return FrameResult::kOk;
+    }
+    if (tag == kTagDecodeOpen || tag == kTagDecodeStep ||
+        tag == kTagDecodeClose) {
+      if (n < 2 + 8) return proto_err();
+      const uint64_t rid = ptpu::GetU64(req + 2);
+      if (!dec_pred) {
+        SendErrFrame(conn, rid, "decode serving not configured (start "
+                                "the server with a decode_model)");
+        return FrameResult::kOk;
+      }
+      if (tag == kTagDecodeOpen) {
+        if (n != 2 + 8) return proto_err();
+        uint64_t sess = 0;
+        std::string why;
+        if (!DecodeOpen(conn, &sess, &why)) {
+          SendErrFrame(conn, rid, why);
+          return FrameResult::kOk;
+        }
+        std::vector<uint8_t> f = conn->AcquireBuf();
+        f.resize(4 + 2 + 8 + 8);
+        f[4] = kSvWireVersion;
+        f[5] = kTagDecodeSess;
+        ptpu::PutU64(f.data() + 6, rid);
+        ptpu::PutU64(f.data() + 14, sess);
+        stats.bytes_out.Add(f.size());
+        if (!conn->SendPayload(std::move(f)))
+          return FrameResult::kClose;
+        return FrameResult::kOk;
+      }
+      if (tag == kTagDecodeClose) {
+        if (n != 2 + 8 + 8) return proto_err();
+        const uint64_t sess = ptpu::GetU64(req + 10);
+        std::string why;
+        if (!DecodeClose(sess, &why)) {
+          SendErrFrame(conn, rid, why);
+          return FrameResult::kOk;
+        }
+        std::vector<uint8_t> f = conn->AcquireBuf();
+        f.resize(4 + 2 + 8 + 8);
+        f[4] = kSvWireVersion;
+        f[5] = kTagDecodeSess;
+        ptpu::PutU64(f.data() + 6, rid);
+        ptpu::PutU64(f.data() + 14, sess);
+        stats.bytes_out.Add(f.size());
+        if (!conn->SendPayload(std::move(f)))
+          return FrameResult::kClose;
+        return FrameResult::kOk;
+      }
+      // DECODE_STEP: [ver][tag][u64 req_id][u64 session][i64 token]
+      if (n != 2 + 8 + 8 + 8) return proto_err();
+      SvRequest r;
+      r.is_decode = true;
+      r.id = rid;
+      r.session = ptpu::GetU64(req + 10);
+      r.token = ptpu::GetI64(req + 18);
+      r.rows = 1;
+      r.conn = conn;
+      r.t_enq_us = ptpu::NowUs();
+      if (!retry) dstats.steps.Add(1);
+      std::string why;
+      if (dec_batcher->enqueue(std::move(r), &why)) {
+        conn->NotePending(1);  // pairs with the reply/error -1
+        return FrameResult::kOk;
+      }
+      if (why == "request queue full" &&
+          conn->deferred_us() < kSvDeferBudgetUs)
+        return FrameResult::kDefer;  // cheap 26-byte re-parse on retry
+      SendErrFrame(conn, rid, why);
       return FrameResult::kOk;
     }
     if (tag != kTagInferReq) return proto_err();
@@ -814,12 +1240,16 @@ struct SvServer {
     // graceful drain: stop accepting -> let the batcher workers
     // finish EVERYTHING queued (in-flight requests still answer over
     // still-open conns) -> flush queued replies -> close. The batcher
-    // object stays alive until the event threads are joined — they
+    // objects stay alive until the event threads are joined — they
     // may still call enqueue(), which answers "server stopping" on a
     // stopped batcher but would be UB on a destroyed one.
     if (net_srv) net_srv->StopAccepting();
     std::deque<SvRequest> leftover;
     if (batcher) leftover = batcher->stop();
+    if (dec_batcher) {
+      auto dec_left = dec_batcher->stop();
+      for (auto& r : dec_left) leftover.push_back(std::move(r));
+    }
     for (auto& r : leftover) {
       SendErrFrame(r.conn, r.id, "server stopping");
       r.conn->NotePending(-1);  // pairs the enqueue-time +1
@@ -829,6 +1259,15 @@ struct SvServer {
       net_srv.reset();
     }
     batcher.reset();
+    dec_batcher.reset();
+    if (dec_pred) {
+      ptpu_predictor_destroy(dec_pred);
+      dec_pred = nullptr;
+    }
+    if (dec_pool) {
+      ptpu_workpool_destroy(dec_pool);
+      dec_pool = nullptr;
+    }
   }
 
   // --------------------------------------------------------- stats
@@ -892,7 +1331,40 @@ struct SvServer {
     ptpu::AppendJsonHist(&out, "e2e_us", stats.e2e_us);
     out += ',';
     ptpu::AppendJsonHist(&out, "run_us", stats.run_us);
-    out += "}}";
+    out += "}";
+    if (dec_pred) {
+      out += ",\"decode\":{";
+      const struct {
+        const char* name;
+        const ptpu::Counter* c;
+      } ds[] = {
+          {"opens", &dstats.opens},
+          {"closes", &dstats.closes},
+          {"evictions", &dstats.evictions},
+          {"steps", &dstats.steps},
+          {"replies", &dstats.replies},
+          {"batches", &dstats.batches},
+      };
+      for (const auto& kv : ds) {
+        ptpu::AppendJsonU64(&out, kv.name, kv.c->Get());
+        out += ',';
+      }
+      uint64_t live = 0;
+      {
+        std::lock_guard<std::mutex> l(sess_mu_);
+        for (const auto& kv : sessions_)
+          if (kv.second.slot >= 0) ++live;
+      }
+      ptpu::AppendJsonU64(&out, "sessions_active", live);
+      out += ',';
+      ptpu::AppendJsonU64(&out, "kv_sessions", uint64_t(kv_sessions));
+      out += ',';
+      ptpu::AppendJsonHist(&out, "run_us", dstats.run_us);
+      out += ',';
+      ptpu::AppendJsonHist(&out, "batch_fill", dstats.batch_fill);
+      out += '}';
+    }
+    out += "}";
     return out;
   }
 
@@ -915,6 +1387,8 @@ struct SvServer {
   void StatsReset() {
     stats.Reset();
     net.Reset();
+    dstats.Reset();
+    dec_bstats.Reset();
     dyn_fallback_base_.store(DynFallbackSum(),
                              std::memory_order_relaxed);
   }
@@ -926,15 +1400,25 @@ thread_local std::string g_sv_json;
 
 extern "C" {
 
+/* Extended start (r9): `decode_model_path` (may be NULL/empty) adds
+ * the KV-cached DECODE plane — a decode-step artifact served through
+ * its own predictor + micro-batcher with `kv_sessions` per-session KV
+ * slots (<= 0: $PTPU_KV_SESSIONS, default 64). Everything else is
+ * ptpu_serving_start. */
 __attribute__((visibility("default")))
-void* ptpu_serving_start(const char* model_path, int port,
-                         const char* authkey, int authkey_len,
-                         int max_batch, int64_t deadline_us,
-                         int instances, int threads_per_instance,
-                         int loopback_only, char* err, int err_len) {
+void* ptpu_serving_start2(const char* model_path,
+                          const char* decode_model_path, int port,
+                          const char* authkey, int authkey_len,
+                          int max_batch, int64_t deadline_us,
+                          int instances, int threads_per_instance,
+                          int loopback_only, int kv_sessions, char* err,
+                          int err_len) {
   auto* s = new SvServer();
   try {
     s->model_path = model_path ? model_path : "";
+    s->decode_model_path =
+        decode_model_path ? decode_model_path : "";
+    s->kv_sessions = kv_sessions;
     s->authkey.assign(authkey ? authkey : "",
                       authkey_len > 0 ? size_t(authkey_len) : 0);
     s->max_batch = max_batch > 0 ? max_batch : 8;
@@ -949,6 +1433,18 @@ void* ptpu_serving_start(const char* model_path, int port,
     delete s;
     return nullptr;
   }
+}
+
+__attribute__((visibility("default")))
+void* ptpu_serving_start(const char* model_path, int port,
+                         const char* authkey, int authkey_len,
+                         int max_batch, int64_t deadline_us,
+                         int instances, int threads_per_instance,
+                         int loopback_only, char* err, int err_len) {
+  return ptpu_serving_start2(model_path, nullptr, port, authkey,
+                             authkey_len, max_batch, deadline_us,
+                             instances, threads_per_instance,
+                             loopback_only, 0, err, err_len);
 }
 
 // Handle-taking entries guard NULL (a failed start returns NULL; a

@@ -96,6 +96,71 @@ std::string onnx_node(const std::string& op,
   return n;
 }
 
+std::string onnx_tensor_i64(const std::string& name,
+                            const std::vector<int64_t>& dims,
+                            const std::vector<int64_t>& data) {
+  std::string t;
+  for (int64_t d : dims) put_u64f(&t, 1, uint64_t(d));
+  put_u64f(&t, 2, 7);  // data_type i64
+  put_lenf(&t, 8, name);
+  put_lenf(&t, 9,
+           std::string(reinterpret_cast<const char*>(data.data()),
+                       data.size() * 8));
+  return t;
+}
+
+// node with one integer attribute (Cast's `to`)
+std::string onnx_node_iattr(const std::string& op,
+                            const std::vector<std::string>& ins,
+                            const std::vector<std::string>& outs,
+                            const std::string& aname, int64_t aval) {
+  std::string n = onnx_node(op, ins, outs);
+  std::string a;
+  put_lenf(&a, 1, aname);
+  put_u64f(&a, 3, uint64_t(aval));
+  put_lenf(&n, 5, a);
+  return n;
+}
+
+/* Hand-rolled KV-decode artifact obeying the kv_plan convention
+ * (B=2 rows, P=4 cache positions, H=D=1, one layer, one logit):
+ *   inputs : ids [2,1] i64, pos [2] i64, k0/v0 [2,4,1,1] f32
+ *   outputs: y [2,1]   = sum(k0 cache) + token + 0*pos
+ *            nk [2,1,1,1] = token (appended as the new k row)
+ *            nv [2,1,1,1] = 2*token
+ * After t steps with tokens t_1..t_t the cache holds t_1..t_{t-1}, so
+ * step t's logit is EXACTLY the running token sum — de-mux and slot
+ * reuse are checkable to the last bit. */
+std::string build_decode_model() {
+  std::string g;
+  put_lenf(&g, 1, onnx_node_iattr("Cast", {"ids"}, {"idsf"}, "to", 1));
+  put_lenf(&g, 1, onnx_node("Reshape", {"idsf", "sh_nk"}, {"nk"}));
+  put_lenf(&g, 1, onnx_node("Mul", {"nk", "two"}, {"nv"}));
+  put_lenf(&g, 1, onnx_node("ReduceSum", {"k0", "axes"}, {"ksum"}));
+  put_lenf(&g, 1, onnx_node("Reshape", {"ksum", "sh_y"}, {"ksum2"}));
+  put_lenf(&g, 1, onnx_node_iattr("Cast", {"pos"}, {"posf"}, "to", 1));
+  put_lenf(&g, 1, onnx_node("Reshape", {"posf", "sh_y"}, {"posr"}));
+  put_lenf(&g, 1, onnx_node("Mul", {"posr", "zero"}, {"pos0"}));
+  put_lenf(&g, 1, onnx_node("Add", {"ksum2", "idsf"}, {"t1"}));
+  put_lenf(&g, 1, onnx_node("Add", {"t1", "pos0"}, {"y"}));
+  put_lenf(&g, 5, onnx_tensor_i64("sh_nk", {4}, {2, 1, 1, 1}));
+  put_lenf(&g, 5, onnx_tensor_i64("sh_y", {2}, {2, 1}));
+  put_lenf(&g, 5, onnx_tensor_i64("axes", {3}, {1, 2, 3}));
+  const float twov = 2.f, zerov = 0.f;
+  put_lenf(&g, 5, onnx_tensor_f32("two", {}, &twov, 1));
+  put_lenf(&g, 5, onnx_tensor_f32("zero", {}, &zerov, 1));
+  put_lenf(&g, 11, onnx_value_info("ids", 7, {2, 1}));
+  put_lenf(&g, 11, onnx_value_info("pos", 7, {2}));
+  put_lenf(&g, 11, onnx_value_info("k0", 1, {2, 4, 1, 1}));
+  put_lenf(&g, 11, onnx_value_info("v0", 1, {2, 4, 1, 1}));
+  put_lenf(&g, 12, onnx_value_info("y", 1, {2, 1}));
+  put_lenf(&g, 12, onnx_value_info("nk", 1, {2, 1, 1, 1}));
+  put_lenf(&g, 12, onnx_value_info("nv", 1, {2, 1, 1, 1}));
+  std::string m;
+  put_lenf(&m, 7, g);
+  return m;
+}
+
 /* y[B, N] = x[B, K] @ W[K, N]: batch-polymorphic (MatMul collapses
  * leading dims), so every bucket of the ladder plans cleanly. */
 std::string build_matmul_model(int64_t B, int64_t K, int64_t N,
@@ -580,6 +645,205 @@ void test_serving_pipelined_requests_batch() {
   ptpu_serving_stop(h);
 }
 
+// ------------------------------------------------- KV decode legs
+/* Direct-ABI decode: slot lifecycle, batched de-mux EXACTNESS (each
+ * row's logit is its own session's running token sum), slot reuse
+ * after close (scrubbed cache), duplicate-session rejection, and the
+ * context-full bound. */
+void test_decode_kv_abi() {
+  const std::string path =
+      write_model_file(build_decode_model(), "ptpu_sv_selftest_dec.onnx");
+  char err[512] = {0};
+  PTPU_Predictor* p =
+      ptpu_predictor_create(path.c_str(), err, sizeof(err));
+  assert(p && "decode model load failed");
+  // kv_plan rejects a non-decode artifact
+  {
+    std::vector<float> W;
+    const std::string mm = write_model_file(
+        build_matmul_model(4, 8, 4, &W), "ptpu_sv_selftest_notdec.onnx");
+    PTPU_Predictor* bad =
+        ptpu_predictor_create(mm.c_str(), err, sizeof(err));
+    assert(bad);
+    assert(ptpu_predictor_kv_plan(bad, 2, err, sizeof(err)) != 0);
+    ptpu_predictor_destroy(bad);
+  }
+  assert(ptpu_predictor_kv_plan(p, 2, err, sizeof(err)) == 0);
+  assert(ptpu_predictor_kv_sessions(p) == 2);
+  const int s0 = ptpu_predictor_kv_open(p);
+  const int s1 = ptpu_predictor_kv_open(p);
+  assert(s0 == 0 && s1 == 1);
+  assert(ptpu_predictor_kv_open(p) == -1);  // full
+  // batched steps: session 0 feeds 5,2,9 / session 1 feeds 7,1
+  const auto step2 = [&](int64_t t0, int64_t t1, float* y0, float* y1) {
+    const int64_t sids[2] = {s0, s1}, toks[2] = {t0, t1};
+    assert(ptpu_predictor_decode_step(p, sids, toks, 2, err,
+                                      sizeof(err)) == 0);
+    const float* y = ptpu_predictor_output_data(p, 0);
+    assert(y);
+    *y0 = y[0];
+    *y1 = y[1];
+  };
+  float y0, y1;
+  step2(5, 7, &y0, &y1);
+  assert(y0 == 5.f && y1 == 7.f);
+  step2(2, 1, &y0, &y1);
+  assert(y0 == 7.f && y1 == 8.f);   // 5+2 / 7+1 — de-mux exact
+  assert(ptpu_predictor_kv_len(p, s0) == 2);
+  // single-row (padded) step advances only its session
+  {
+    const int64_t sids[1] = {s0}, toks[1] = {9};
+    assert(ptpu_predictor_decode_step(p, sids, toks, 1, err,
+                                      sizeof(err)) == 0);
+    const float* y = ptpu_predictor_output_data(p, 0);
+    assert(y[0] == 5.f + 2.f + 9.f);
+    assert(ptpu_predictor_kv_len(p, s0) == 3 &&
+           ptpu_predictor_kv_len(p, s1) == 2);
+  }
+  // duplicate session in one batch is rejected
+  {
+    const int64_t sids[2] = {s1, s1}, toks[2] = {1, 2};
+    assert(ptpu_predictor_decode_step(p, sids, toks, 2, err,
+                                      sizeof(err)) != 0);
+  }
+  // context bound: P=4 — session 0 takes exactly one more step
+  {
+    const int64_t sids[1] = {s0};
+    int64_t tok[1] = {1};
+    assert(ptpu_predictor_decode_step(p, sids, tok, 1, err,
+                                      sizeof(err)) == 0);
+    assert(ptpu_predictor_kv_len(p, s0) == 4);
+    assert(ptpu_predictor_decode_step(p, sids, tok, 1, err,
+                                      sizeof(err)) != 0);
+    assert(std::string(err).find("context is full") != std::string::npos);
+  }
+  // close + reopen reuses the slot with a SCRUBBED cache
+  ptpu_predictor_kv_close(p, s0);
+  assert(ptpu_predictor_kv_len(p, s0) == -1);
+  const int s0b = ptpu_predictor_kv_open(p);
+  assert(s0b == s0 && ptpu_predictor_kv_len(p, s0b) == 0);
+  {
+    const int64_t sids[1] = {s0b}, toks[1] = {3};
+    assert(ptpu_predictor_decode_step(p, sids, toks, 1, err,
+                                      sizeof(err)) == 0);
+    assert(ptpu_predictor_output_data(p, 0)[0] == 3.f);  // no stale sum
+  }
+  ptpu_predictor_destroy(p);
+}
+
+/* Wire decode: OPEN/STEP/CLOSE frames over a live server, pipelined
+ * steps of two sessions batched and de-muxed by request id, LRU
+ * eviction at the kv_sessions bound, and counter exactness. */
+void test_serving_decode_wire() {
+  std::vector<float> W;
+  const std::string mm_path = write_model_file(
+      build_matmul_model(4, 16, 8, &W), "ptpu_sv_selftest_decmm.onnx");
+  const std::string dec_path =
+      write_model_file(build_decode_model(), "ptpu_sv_selftest_dec.onnx");
+  char err[512] = {0};
+  void* h = ptpu_serving_start2(mm_path.c_str(), dec_path.c_str(), 0,
+                                "dk", 2, 4, 3000, 1, 1, 1,
+                                /*kv_sessions=*/2, err, sizeof(err));
+  assert(h != nullptr && "serving start2 failed");
+  SvTestClient cli;
+  assert(cli.connect_to(ptpu_serving_port(h)));
+  assert(cli.handshake("dk"));
+
+  const auto open_sess = [&](uint64_t rid) {
+    std::vector<uint8_t> f{kSvWireVersion, kTagDecodeOpen}, rep;
+    f.resize(10);
+    ptpu::PutU64(f.data() + 2, rid);
+    assert(cli.send_frame(f) && cli.read_frame(&rep));
+    assert(rep[1] == kTagDecodeSess && ptpu::GetU64(rep.data() + 2) == rid);
+    return ptpu::GetU64(rep.data() + 10);
+  };
+  const auto send_step = [&](uint64_t rid, uint64_t sess, int64_t tok) {
+    std::vector<uint8_t> f{kSvWireVersion, kTagDecodeStep};
+    f.resize(26);
+    ptpu::PutU64(f.data() + 2, rid);
+    ptpu::PutU64(f.data() + 10, sess);
+    ptpu::PutI64(f.data() + 18, tok);
+    assert(cli.send_frame(f));
+  };
+  const uint64_t sa = open_sess(1), sb = open_sess(2);
+  assert(sa != sb);
+  // pipelined steps of BOTH sessions: server may batch them into one
+  // decode run; replies de-mux by request id with each session's own
+  // running sum
+  send_step(10, sa, 5);
+  send_step(11, sb, 7);
+  std::map<uint64_t, float> got;
+  for (int i = 0; i < 2; ++i) {
+    std::vector<uint8_t> rep;
+    assert(cli.read_frame(&rep));
+    assert(rep[1] == kTagDecodeRep);
+    const uint64_t rid = ptpu::GetU64(rep.data() + 2);
+    assert(ptpu::GetU32(rep.data() + 18) == 1);   // one logit
+    got[rid] = ptpu::GetF32(rep.data() + 22);
+  }
+  assert(got.at(10) == 5.f && got.at(11) == 7.f);
+  send_step(12, sa, 2);
+  send_step(13, sb, 1);
+  got.clear();
+  for (int i = 0; i < 2; ++i) {
+    std::vector<uint8_t> rep;
+    assert(cli.read_frame(&rep));
+    got[ptpu::GetU64(rep.data() + 2)] = ptpu::GetF32(rep.data() + 22);
+  }
+  assert(got.at(12) == 7.f && got.at(13) == 8.f);
+  // kv_sessions=2: a third open evicts the LRU (sa — stepped first in
+  // the last batch? both stepped; LRU by last_us: sa's step ran in the
+  // same batch — evict whichever, then its next step must error)
+  const uint64_t sc = open_sess(3);
+  assert(sc != sa && sc != sb);
+  int err_frames = 0;
+  send_step(20, sa, 1);
+  send_step(21, sb, 1);
+  for (int i = 0; i < 2; ++i) {
+    std::vector<uint8_t> rep;
+    assert(cli.read_frame(&rep));
+    if (rep[1] == kTagInferErr) ++err_frames;
+    else assert(rep[1] == kTagDecodeRep);
+  }
+  assert(err_frames == 1);   // exactly one of the two was evicted
+  // stats exactness: 6 steps in, 5 decode replies, 1 error; 3 opens,
+  // 1 eviction
+  const std::string js = ptpu_serving_stats_json(h);
+  assert(js.find("\"opens\":3") != std::string::npos);
+  assert(js.find("\"evictions\":1") != std::string::npos);
+  assert(js.find("\"steps\":6") != std::string::npos);
+  assert(js.find("\"replies\":5") != std::string::npos);
+  // close the fresh session: SESS echo, counter bumps
+  {
+    std::vector<uint8_t> f{kSvWireVersion, kTagDecodeClose}, rep;
+    f.resize(18);
+    ptpu::PutU64(f.data() + 2, 30);
+    ptpu::PutU64(f.data() + 10, sc);
+    assert(cli.send_frame(f) && cli.read_frame(&rep));
+    assert(rep[1] == kTagDecodeSess);
+  }
+  const std::string js2 = ptpu_serving_stats_json(h);
+  assert(js2.find("\"closes\":1") != std::string::npos);
+  // a server WITHOUT a decode plane answers INFER_ERR, not a close
+  cli.close();
+  ptpu_serving_stop(h);
+  void* h2 = ptpu_serving_start(mm_path.c_str(), 0, "dk", 2, 4, 3000, 1,
+                                1, 1, err, sizeof(err));
+  assert(h2);
+  SvTestClient c2;
+  assert(c2.connect_to(ptpu_serving_port(h2)));
+  assert(c2.handshake("dk"));
+  {
+    std::vector<uint8_t> f{kSvWireVersion, kTagDecodeOpen}, rep;
+    f.resize(10);
+    ptpu::PutU64(f.data() + 2, 1);
+    assert(c2.send_frame(f) && c2.read_frame(&rep));
+    assert(rep[1] == kTagInferErr);
+  }
+  c2.close();
+  ptpu_serving_stop(h2);
+}
+
 }  // namespace
 
 int main() {
@@ -591,6 +855,8 @@ int main() {
   test_two_instance_concurrent_scaling();
   test_serving_socket_round_trip();
   test_serving_pipelined_requests_batch();
+  test_decode_kv_abi();
+  test_serving_decode_wire();
   std::printf("ptpu_serving_selftest: all native serving unit tests "
               "passed\n");
   return 0;

@@ -285,6 +285,77 @@ func (p *Predictor) Output(i int) ([]float32, []int64) {
 	return out, dims
 }
 
+// KvPlan validates the decode-artifact convention ([ids][pos][k/v
+// caches...] in, [logits][new k/v...] out — see export_gpt_decode) and
+// allocates `sessions` per-session KV slots in one pre-planned cache
+// block. Must run before any other Kv/DecodeStep call.
+func (p *Predictor) KvPlan(sessions int) error {
+	if p.p == nil {
+		return errors.New("KvPlan: predictor is destroyed")
+	}
+	buf := make([]C.char, errLen)
+	rc := C.ptpu_predictor_kv_plan(p.p, C.int(sessions), &buf[0], errLen)
+	runtime.KeepAlive(p)
+	if rc != 0 {
+		return lastErr(buf)
+	}
+	return nil
+}
+
+// KvSessions reports the planned KV slot count (0 before KvPlan).
+func (p *Predictor) KvSessions() int {
+	n := int(C.ptpu_predictor_kv_sessions(p.p))
+	runtime.KeepAlive(p)
+	return n
+}
+
+// KvOpen claims a free KV session slot; -1 when every slot is busy
+// (eviction policy belongs to the caller).
+func (p *Predictor) KvOpen() int {
+	n := int(C.ptpu_predictor_kv_open(p.p))
+	runtime.KeepAlive(p)
+	return n
+}
+
+// KvClose frees a session slot and scrubs its cache rows.
+func (p *Predictor) KvClose(sid int) {
+	C.ptpu_predictor_kv_close(p.p, C.int(sid))
+	runtime.KeepAlive(p)
+}
+
+// KvLen is the appended position count of an open session (-1 for a
+// closed/invalid one).
+func (p *Predictor) KvLen(sid int) int64 {
+	n := int64(C.ptpu_predictor_kv_len(p.p, C.int(sid)))
+	runtime.KeepAlive(p)
+	return n
+}
+
+// DecodeStep feeds tokens[r] into open session sids[r] (one batched
+// step; a session may appear at most once per call). Next-token logits
+// are rows 0..len(sids)-1 of Output(0).
+func (p *Predictor) DecodeStep(sids, tokens []int64) error {
+	if p.p == nil {
+		return errors.New("DecodeStep: predictor is destroyed")
+	}
+	if len(sids) == 0 || len(sids) != len(tokens) {
+		return errors.New("DecodeStep: sids/tokens must be equal-length" +
+			" and non-empty")
+	}
+	buf := make([]C.char, errLen)
+	rc := C.ptpu_predictor_decode_step(p.p,
+		(*C.int64_t)(unsafe.Pointer(&sids[0])),
+		(*C.int64_t)(unsafe.Pointer(&tokens[0])), C.int(len(sids)),
+		&buf[0], errLen)
+	runtime.KeepAlive(p)
+	runtime.KeepAlive(sids)
+	runtime.KeepAlive(tokens)
+	if rc != 0 {
+		return lastErr(buf)
+	}
+	return nil
+}
+
 // StatsJSON returns the predictor's serving stats snapshot (always-on
 // per-op calls/time/bytes + per-run latency histogram) as the JSON
 // string ptpu_predictor_stats_json renders — unmarshal with

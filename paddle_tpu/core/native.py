@@ -642,6 +642,26 @@ def _predictor_lib() -> ctypes.CDLL:
         lib.ptpu_predictor_output_data.restype = c.POINTER(c.c_float)
         lib.ptpu_predictor_output_data.argtypes = [c.c_void_p, c.c_int]
         try:
+            # KV-cached decode ABI (r9) — absent from stale .so builds
+            lib.ptpu_predictor_kv_plan.argtypes = [
+                c.c_void_p, c.c_int, c.c_char_p, c.c_int]
+            lib.ptpu_predictor_kv_sessions.argtypes = [c.c_void_p]
+            lib.ptpu_predictor_kv_open.argtypes = [c.c_void_p]
+            lib.ptpu_predictor_kv_close.argtypes = [c.c_void_p, c.c_int]
+            lib.ptpu_predictor_kv_len.restype = c.c_int64
+            lib.ptpu_predictor_kv_len.argtypes = [c.c_void_p, c.c_int]
+            lib.ptpu_predictor_decode_step.argtypes = [
+                c.c_void_p, c.POINTER(c.c_int64), c.POINTER(c.c_int64),
+                c.c_int, c.c_char_p, c.c_int]
+            lib.ptpu_serving_start2.restype = c.c_void_p
+            lib.ptpu_serving_start2.argtypes = [
+                c.c_char_p, c.c_char_p, c.c_int, c.c_char_p, c.c_int,
+                c.c_int, c.c_int64, c.c_int, c.c_int, c.c_int, c.c_int,
+                c.c_char_p, c.c_int]
+            lib._ptpu_has_decode = True
+        except AttributeError:   # stale prebuilt .so: decode degrades
+            lib._ptpu_has_decode = False
+        try:
             lib.ptpu_predictor_stats_json.restype = c.c_char_p
             lib.ptpu_predictor_stats_json.argtypes = [c.c_void_p]
             lib.ptpu_predictor_stats_reset.argtypes = [c.c_void_p]
@@ -801,6 +821,54 @@ class NativePredictor:
         if self._lib._ptpu_has_pred_stats:
             self._lib.ptpu_predictor_stats_reset(self._handle())
 
+    # ---- KV-cached decode (r9) ----
+    def _need_decode(self):
+        if not getattr(self._lib, "_ptpu_has_decode", False):
+            raise RuntimeError(
+                "KV decode needs the r9 ABI (stale _native_predictor.so:"
+                " delete it and re-import)")
+
+    def kv_plan(self, sessions: int) -> None:
+        """Validate the decode-artifact convention and allocate the
+        per-session KV arena (see models.gpt.export_gpt_decode)."""
+        self._need_decode()
+        if self._lib.ptpu_predictor_kv_plan(self._handle(), sessions,
+                                            self._err, 512) != 0:
+            raise RuntimeError("kv_plan: " + self._err.value.decode())
+
+    def kv_open(self) -> int:
+        """Free session slot id, or -1 when every slot is busy."""
+        self._need_decode()
+        return int(self._lib.ptpu_predictor_kv_open(self._handle()))
+
+    def kv_close(self, sid: int) -> None:
+        self._need_decode()
+        self._lib.ptpu_predictor_kv_close(self._handle(), sid)
+
+    def kv_len(self, sid: int) -> int:
+        self._need_decode()
+        return int(self._lib.ptpu_predictor_kv_len(self._handle(), sid))
+
+    def decode_step(self, sids, tokens):
+        """One batched decode step: feed tokens[r] into open session
+        sids[r]; returns the per-row next-token logits (len(sids) rows
+        of output 0). Appends each row's k/v into its session cache."""
+        self._need_decode()
+        np = self._np
+        c = ctypes
+        sids = np.ascontiguousarray(sids, np.int64)
+        tokens = np.ascontiguousarray(tokens, np.int64)
+        if sids.size != tokens.size:
+            raise ValueError("decode_step: sids/tokens length mismatch")
+        rc = self._lib.ptpu_predictor_decode_step(
+            self._handle(), sids.ctypes.data_as(c.POINTER(c.c_int64)),
+            tokens.ctypes.data_as(c.POINTER(c.c_int64)), sids.size,
+            self._err, 512)
+        if rc != 0:
+            raise RuntimeError("decode_step: " +
+                               self._err.value.decode())
+        return self.output(0)[:sids.size]
+
     def output(self, i: int = 0):
         np = self._np
         nd = self._lib.ptpu_predictor_output_ndim(self._handle(), i)
@@ -876,7 +944,10 @@ ABI_SYMBOLS = {
         "ptpu_predictor_output_ndim", "ptpu_predictor_output_dims",
         "ptpu_predictor_output_data", "ptpu_predictor_stats_json",
         "ptpu_predictor_stats_reset", "ptpu_predictor_set_profiler",
-        "ptpu_serving_start", "ptpu_serving_port",
+        "ptpu_predictor_kv_plan", "ptpu_predictor_kv_sessions",
+        "ptpu_predictor_kv_open", "ptpu_predictor_kv_close",
+        "ptpu_predictor_kv_len", "ptpu_predictor_decode_step",
+        "ptpu_serving_start", "ptpu_serving_start2", "ptpu_serving_port",
         "ptpu_serving_config_json", "ptpu_serving_stats_json",
         "ptpu_serving_stats_reset", "ptpu_serving_stop",
     ),

@@ -37,6 +37,16 @@ TAG_INFER_REP = 0x61
 TAG_INFER_ERR = 0x62
 TAG_META_REQ = 0x63
 TAG_META_REP = 0x64
+# KV-cached decode ops (r9) — csrc/ptpu_serving.cc kTagDecode* twins.
+# Layouts (payload offsets): OPEN [ver][tag][u64 req_id]; SESS
+# [ver][tag][u64 req_id][u64 session]; STEP [ver][tag][u64 req_id]
+# [u64 session][i64 token]; REP [ver][tag][u64 req_id][u64 session]
+# [u32 n][f32 x n]; CLOSE mirrors SESS.
+TAG_DECODE_OPEN = 0x65
+TAG_DECODE_SESS = 0x66
+TAG_DECODE_STEP = 0x67
+TAG_DECODE_REP = 0x68
+TAG_DECODE_CLOSE = 0x69
 
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
@@ -64,7 +74,9 @@ class InferenceServer:
                  authkey: Optional[bytes] = None, max_batch: int = 8,
                  deadline_us: int = 2000, instances: int = 2,
                  threads_per_instance: int = 0,
-                 loopback_only: bool = True):
+                 loopback_only: bool = True,
+                 decode_model: Optional[str] = None,
+                 kv_sessions: int = 0):
         from ..core.native import _predictor_lib
         lib = _predictor_lib()
         if not getattr(lib, "_ptpu_has_serving", False):
@@ -74,10 +86,23 @@ class InferenceServer:
         self._lib = lib
         self.authkey = authkey if authkey is not None else os.urandom(16)
         err = ctypes.create_string_buffer(512)
-        self._h = lib.ptpu_serving_start(
-            model_path.encode(), port, self.authkey, len(self.authkey),
-            max_batch, deadline_us, instances, threads_per_instance,
-            1 if loopback_only else 0, err, 512)
+        if decode_model is not None or kv_sessions:
+            if not getattr(lib, "_ptpu_has_decode", False):
+                raise RuntimeError(
+                    "decode serving needs the r9 ABI (stale "
+                    "_native_predictor.so: delete it and re-import)")
+            self._h = lib.ptpu_serving_start2(
+                model_path.encode(),
+                decode_model.encode() if decode_model else None, port,
+                self.authkey, len(self.authkey), max_batch, deadline_us,
+                instances, threads_per_instance,
+                1 if loopback_only else 0, kv_sessions, err, 512)
+        else:
+            self._h = lib.ptpu_serving_start(
+                model_path.encode(), port, self.authkey,
+                len(self.authkey), max_batch, deadline_us, instances,
+                threads_per_instance, 1 if loopback_only else 0, err,
+                512)
         if not self._h:
             raise RuntimeError("ptpu_serving_start: " +
                                err.value.decode())
@@ -133,7 +158,10 @@ def create_server(model_path: str, **kwargs) -> InferenceServer:
     Keyword knobs: `port` (0 = pick free), `authkey` (bytes; random by
     default — read it back from `.authkey`), `max_batch`,
     `deadline_us`, `instances`, `threads_per_instance` (0 = split host
-    cores evenly), `loopback_only`."""
+    cores evenly), `loopback_only`, `decode_model` (path of a KV
+    decode-step artifact from models.gpt.export_gpt_decode — enables
+    the DECODE wire ops), `kv_sessions` (KV slots for decode; 0 =
+    $PTPU_KV_SESSIONS, default 64)."""
     return InferenceServer(model_path, **kwargs)
 
 
@@ -288,6 +316,93 @@ class InferenceClient:
             got_id, outs = self._decode_reply(self._read_frame())
             results[pending.pop(got_id)] = outs
             done += 1
+        if not return_exceptions:
+            for r in results:
+                if isinstance(r, ServingError):
+                    raise r
+        return results
+
+    # -------------------------------------------------------- decode
+    def _decode_reply_expect(self, want_tag: int, rid: int):
+        f = self._read_frame()
+        got = _U64.unpack_from(f, 2)[0]
+        if got != rid:
+            raise ConnectionError(
+                f"decode reply id {got} != request id {rid}")
+        if f[1] == TAG_INFER_ERR:
+            (mlen,) = _U32.unpack_from(f, 10)
+            raise ServingError(f[14:14 + mlen].decode())
+        if f[1] != want_tag:
+            raise ConnectionError(
+                f"unexpected decode reply tag {f[1]:#x}")
+        return f
+
+    def decode_open(self) -> int:
+        """Open a server-side KV decode session; returns its id.
+        Raises ServingError when the server has no decode plane or no
+        free slot (after LRU eviction failed)."""
+        rid = self._next_id
+        self._next_id += 1
+        self._send_frame(bytes([WIRE_VERSION, TAG_DECODE_OPEN]) +
+                         _U64.pack(rid))
+        f = self._decode_reply_expect(TAG_DECODE_SESS, rid)
+        return _U64.unpack_from(f, 10)[0]
+
+    def decode_close(self, session: int) -> None:
+        rid = self._next_id
+        self._next_id += 1
+        self._send_frame(bytes([WIRE_VERSION, TAG_DECODE_CLOSE]) +
+                         _U64.pack(rid) + _U64.pack(session))
+        self._decode_reply_expect(TAG_DECODE_SESS, rid)
+
+    @staticmethod
+    def _decode_step_payload(rid: int, session: int,
+                             token: int) -> bytes:
+        return (bytes([WIRE_VERSION, TAG_DECODE_STEP]) +
+                _U64.pack(rid) + _U64.pack(session) + _I64.pack(token))
+
+    @staticmethod
+    def _decode_rep_logits(f: bytes) -> np.ndarray:
+        (n,) = _U32.unpack_from(f, 18)
+        return np.frombuffer(f, np.float32, n, 22).copy()
+
+    def decode_step(self, session: int, token: int) -> np.ndarray:
+        """Feed one token into a session; returns the session's
+        next-token logits (float32 vector)."""
+        rid = self._next_id
+        self._next_id += 1
+        self._send_frame(self._decode_step_payload(rid, session, token))
+        f = self._decode_reply_expect(TAG_DECODE_REP, rid)
+        return self._decode_rep_logits(f)
+
+    def decode_step_many(self, pairs, return_exceptions: bool = False):
+        """Pipelined decode steps: ``pairs`` is a sequence of
+        ``(session, token)`` — all frames are written before replies
+        are drained, so steps of DIFFERENT sessions batch server-side
+        (one session's steps stay ordered). Returns per-pair logits in
+        input order; server-side errors surface like infer_many."""
+        results = [None] * len(pairs)
+        pending = {}
+        for i, (sess, tok) in enumerate(pairs):
+            rid = self._next_id
+            self._next_id += 1
+            pending[rid] = i
+            self._send_frame(self._decode_step_payload(rid, sess, tok))
+        while pending:
+            f = self._read_frame()
+            got = _U64.unpack_from(f, 2)[0]
+            if got not in pending:
+                raise ConnectionError(
+                    f"unexpected decode reply id {got}")
+            i = pending.pop(got)
+            if f[1] == TAG_INFER_ERR:
+                (mlen,) = _U32.unpack_from(f, 10)
+                results[i] = ServingError(f[14:14 + mlen].decode())
+            elif f[1] == TAG_DECODE_REP:
+                results[i] = self._decode_rep_logits(f)
+            else:
+                raise ConnectionError(
+                    f"unexpected decode reply tag {f[1]:#x}")
         if not return_exceptions:
             for r in results:
                 if isinstance(r, ServingError):

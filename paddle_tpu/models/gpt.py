@@ -1068,6 +1068,94 @@ def _build_offload_chunked_step(*, cfg, optimizer, outer, stacked,
     return step_fn, state0
 
 
+# --------------------------------------------------------------------------
+# KV-cached decode-step export (native serving DECODE workload)
+# --------------------------------------------------------------------------
+
+def make_gpt_decode_step(model: GPTForPretraining, context: int):
+    """Build the single-token decode-step function for the native
+    predictor's KV-cache convention (csrc/ptpu_predictor.cc kv_plan):
+
+      step(ids[B,1] i32, pos[B] i32, k0, v0, ..., k_{L-1}, v_{L-1})
+        -> (logits[B, V], nk0, nv0, ..., nk_{L-1}, nv_{L-1})
+
+    Cache operands are ``[B, context, heads, head_dim]`` float32 in the
+    exporter's [batch, seq, heads, head_dim] attention layout; each
+    ``nk``/``nv`` is the current token's ``[B, 1, heads, head_dim]``
+    projection, which the C runtime appends into the session's slot at
+    position ``pos``. Attention runs over ``concat(cache, current)``
+    with positions ``j < pos`` (cache) and the current token unmasked —
+    a fixed-shape graph, so it loads onto the planned zero-alloc arena
+    and the attention block fuses into PtpuAttention like the full-seq
+    export."""
+    cfg = model.config
+    if context < 1 or context + 1 > cfg.max_position_embeddings:
+        raise ValueError(
+            f"context {context} needs max_position_embeddings > context "
+            f"(got {cfg.max_position_embeddings})")
+
+    def block_step(blk, x, k_cache, v_cache, pos):
+        b = x.shape[0]
+        h, hd = blk.num_heads, blk.head_dim
+        res = x
+        qkv = blk.qkv(blk.ln1(x))
+        qkv = jnp.reshape(qkv, (b, 1, 3, h, hd))
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        kcat = jnp.concatenate([k_cache, k], axis=1)   # [b, P+1, h, hd]
+        vcat = jnp.concatenate([v_cache, v], axis=1)
+        P = k_cache.shape[1]
+        j = jnp.arange(P + 1, dtype=jnp.int32)
+        valid = (j[None, :] < pos[:, None]) | (j[None, :] == P)
+        attn = F.scaled_dot_product_attention(
+            q, kcat, vcat, attn_mask=valid[:, None, None, :],
+            training=False)
+        attn = jnp.reshape(attn, (b, 1, h * hd))
+        x = res + blk.out_proj(attn)
+        res = x
+        y = blk.fc2(F.gelu(blk.fc1(blk.ln2(x)), approximate=True))
+        return res + y, k, v
+
+    def step(ids, pos, *caches):
+        x = model.gpt.embeddings(ids, pos[:, None])
+        news = []
+        for li, blk in enumerate(model.gpt.layers):
+            x, nk, nv = block_step(blk, x, caches[2 * li],
+                                   caches[2 * li + 1], pos)
+            news.append(nk)
+            news.append(nv)
+        hidden = model.gpt.ln_f(x)
+        logits = model.logits(hidden)   # [B, 1, V]
+        return (logits[:, 0], *news)
+
+    return step
+
+
+def export_gpt_decode(model: GPTForPretraining, path: str, batch: int,
+                      context: int) -> str:
+    """Export the KV decode-step artifact for ``model`` at a fixed
+    decode ``batch`` and cache ``context`` (positions per session).
+    Returns the written path. Serve it with
+    ``inference.create_server(..., decode_model=path)`` or drive it
+    directly over ``ptpu_predictor_kv_plan``/``decode_step``."""
+    import numpy as onp
+    from ..onnx.converter import trace_to_onnx
+    cfg = model.config
+    step = make_gpt_decode_step(model, context)
+    h, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    args = [jnp.zeros((batch, 1), jnp.int32),
+            jnp.zeros((batch,), jnp.int32)]
+    for _ in range(cfg.num_layers):
+        args.append(jnp.zeros((batch, context, h, hd), jnp.float32))
+        args.append(jnp.zeros((batch, context, h, hd), jnp.float32))
+    data = trace_to_onnx(step, tuple(args))
+    if not path.endswith(".onnx"):
+        path = path + ".onnx"
+    with open(path, "wb") as f:
+        f.write(onp.frombuffer(data, dtype=onp.uint8).tobytes()
+                if not isinstance(data, bytes) else data)
+    return path
+
+
 def sync_params_to_model(model: GPTForPretraining, state):
     """Write (outer, stacked) back into the Layer tree (for save/eval)."""
     outer_p, stacked_p, _ = state

@@ -1,0 +1,246 @@
+"""Load-time transformer fusion parity (ISSUE r9 tentpole a).
+
+The native predictor recognizes the exporter's attention lowering
+(Transpose/Reshape/batched-MatMul/scale(/mask)/softmax/MatMul) and the
+LayerNorm and tanh-GELU chains, collapsing each into one fused op
+(PtpuAttention — a tiled flash-style kernel with online softmax and no
+[q,k] score materialization —, PtpuLayerNorm, PtpuGelu). These tests
+assert, across head counts / odd sequence lengths / masked and
+unmasked variants:
+
+  * allclose parity against the PTPU_PREDICTOR_OPT=0 unfused baseline;
+  * that fusion actually FIRED (the fused op shows up in the
+    predictor's per-op stats);
+  * that near-miss subgraphs (softmax over a non-last axis, non-scalar
+    scale) do NOT fuse and still compute correctly.
+
+The csrc twin (ptpu_selftest.cc test_attention_fusion_parity) covers
+the same contracts on hand-built graphs under ASan/UBSan/TSan.
+"""
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from paddle_tpu.core.native import NativePredictor, serving_available  # noqa: E402
+from paddle_tpu.nn import functional as F  # noqa: E402
+from paddle_tpu.onnx.converter import trace_to_onnx  # noqa: E402
+
+pytestmark = pytest.mark.skipif(
+    not serving_available(),
+    reason="native predictor .so unavailable")
+
+
+def _export(tmp_path, fn, args, name="m"):
+    path = os.path.join(str(tmp_path), name + ".onnx")
+    with open(path, "wb") as f:
+        f.write(trace_to_onnx(fn, args))
+    return path
+
+
+def _run(path, arrays, opt):
+    env_before = os.environ.get("PTPU_PREDICTOR_OPT")
+    try:
+        if opt:
+            os.environ.pop("PTPU_PREDICTOR_OPT", None)
+        else:
+            os.environ["PTPU_PREDICTOR_OPT"] = "0"
+        with NativePredictor(path) as p:
+            for i, a in enumerate(arrays):
+                p.set_input(p.input_name(i), a)
+            p.run()
+            out = p.output(0)
+            ops = set((p.stats() or {}).get("ops", {}))
+        return out, ops
+    finally:
+        if env_before is None:
+            os.environ.pop("PTPU_PREDICTOR_OPT", None)
+        else:
+            os.environ["PTPU_PREDICTOR_OPT"] = env_before
+
+
+def _parity(path, arrays, want_op, rtol=1e-5, atol=1e-6):
+    ref, ref_ops = _run(path, arrays, opt=False)
+    out, ops = _run(path, arrays, opt=True)
+    assert want_op not in ref_ops
+    assert want_op in ops, f"{want_op} did not fuse; ran {sorted(ops)}"
+    np.testing.assert_allclose(out, ref, rtol=rtol, atol=atol)
+    return ops
+
+
+# ---------------------------------------------------------------------------
+# attention
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("b,s,h,d", [(1, 7, 1, 4), (2, 33, 2, 8),
+                                     (2, 16, 3, 5)])
+@pytest.mark.parametrize("causal", [False, True])
+def test_attention_parity(tmp_path, b, s, h, d, causal):
+    """Head counts, odd sequence lengths, masked and unmasked — fused
+    output allclose vs the unfused baseline."""
+    rs = np.random.RandomState(0)
+    q = rs.randn(b, s, h, d).astype(np.float32)
+    k = rs.randn(b, s, h, d).astype(np.float32)
+    v = rs.randn(b, s, h, d).astype(np.float32)
+
+    def f(q, k, v):
+        return F.scaled_dot_product_attention(q, k, v, is_causal=causal,
+                                              training=False)
+
+    path = _export(tmp_path, f, tuple(jnp.asarray(x) for x in (q, k, v)))
+    _parity(path, [q, k, v], "PtpuAttention")
+
+
+def test_attention_long_masked_prefix(tmp_path):
+    """Regression: a fully-masked k PREFIX spanning a whole flash
+    block (the fresh-decode-session shape) must not NaN the online
+    softmax — masked blocks seen while the running max is -inf are
+    exp(-inf - finite) == 0 terms."""
+    b, s, h, d = 2, 70, 2, 4  # s > the kernel's KB=64 block
+    rs = np.random.RandomState(1)
+    q = rs.randn(b, s, h, d).astype(np.float32)
+    k = rs.randn(b, s, h, d).astype(np.float32)
+    v = rs.randn(b, s, h, d).astype(np.float32)
+    def f(q, k, v):
+        # every row attends only to the last 3 positions -> the first
+        # 64-key flash block is fully masked
+        keep = jnp.arange(s) >= s - 3
+        mask = keep[None, None, None, :]
+        return F.scaled_dot_product_attention(q, k, v, attn_mask=mask,
+                                              training=False)
+
+    path = _export(tmp_path, f, tuple(jnp.asarray(x) for x in (q, k, v)))
+    out, ops = _run(path, [q, k, v], opt=True)
+    assert "PtpuAttention" in ops
+    assert not np.isnan(out).any()
+    ref, _ = _run(path, [q, k, v], opt=False)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_attention_near_miss_softmax_axis_does_not_fuse(tmp_path):
+    """Negative control: the identical block with softmax over the
+    WRONG axis must stay unfused (and still compute correctly)."""
+    b, s, h, d = 2, 6, 2, 4
+    rs = np.random.RandomState(2)
+    q = rs.randn(b, s, h, d).astype(np.float32)
+    k = rs.randn(b, s, h, d).astype(np.float32)
+    v = rs.randn(b, s, h, d).astype(np.float32)
+
+    def f(q, k, v):
+        qt = jnp.swapaxes(q, 1, 2)
+        kt = jnp.swapaxes(k, 1, 2)
+        vt = jnp.swapaxes(v, 1, 2)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * (1.0 / 2.0)
+        probs = jax.nn.softmax(scores, axis=2)   # near-miss: not -1
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+        return jnp.swapaxes(out, 1, 2)
+
+    path = _export(tmp_path, f, tuple(jnp.asarray(x) for x in (q, k, v)))
+    ref, _ = _run(path, [q, k, v], opt=False)
+    out, ops = _run(path, [q, k, v], opt=True)
+    assert "PtpuAttention" not in ops
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_attention_near_miss_vector_scale_does_not_fuse(tmp_path):
+    """Negative control: a per-position (non-scalar) scale breaks the
+    pattern — no fuse, correct output."""
+    b, s, h, d = 1, 5, 2, 4
+    rs = np.random.RandomState(3)
+    q = rs.randn(b, s, h, d).astype(np.float32)
+    k = rs.randn(b, s, h, d).astype(np.float32)
+    v = rs.randn(b, s, h, d).astype(np.float32)
+    def f(q, k, v):
+        qt = jnp.swapaxes(q, 1, 2)
+        kt = jnp.swapaxes(k, 1, 2)
+        vt = jnp.swapaxes(v, 1, 2)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt)
+        vec = jnp.linspace(0.5, 1.5, s).astype(jnp.float32)
+        scores = scores * vec[None, None, None, :]
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+        return jnp.swapaxes(out, 1, 2)
+
+    path = _export(tmp_path, f, tuple(jnp.asarray(x) for x in (q, k, v)))
+    ref, _ = _run(path, [q, k, v], opt=False)
+    out, ops = _run(path, [q, k, v], opt=True)
+    assert "PtpuAttention" not in ops
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# layernorm / gelu
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("shape", [(2, 5, 16), (3, 7), (2, 3, 4, 9)])
+def test_layernorm_parity(tmp_path, shape):
+    from paddle_tpu.nn.layer_conv_norm import LayerNorm
+    import paddle_tpu as pt
+
+    pt.seed(0)
+    ln = LayerNorm(shape[-1])
+    ln.eval()
+    rs = np.random.RandomState(4)
+    x = rs.randn(*shape).astype(np.float32) * 3.0
+
+    path = _export(tmp_path, lambda a: ln(a), (jnp.asarray(x),))
+    _parity(path, [x], "PtpuLayerNorm", rtol=1e-4, atol=1e-5)
+
+
+def test_layernorm_wrong_axis_does_not_fuse(tmp_path):
+    """Negative control: normalizing over a non-last axis exports
+    non-last-axis reductions — no fuse, correct output."""
+    rs = np.random.RandomState(5)
+    x = rs.randn(2, 6, 4).astype(np.float32)
+
+    def f(a):
+        mean = jnp.mean(a, axis=1, keepdims=True)
+        var = jnp.mean((a - mean) ** 2, axis=1, keepdims=True)
+        return (a - mean) / jnp.sqrt(var + 1e-5)
+
+    path = _export(tmp_path, f, (jnp.asarray(x),))
+    ref, _ = _run(path, [x], opt=False)
+    out, ops = _run(path, [x], opt=True)
+    assert "PtpuLayerNorm" not in ops
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_gelu_parity(tmp_path):
+    """The fused tanh-GELU replays the chain's float ops in the same
+    order — bitwise identical under the portable (no-FMA) build the
+    gates run; a -march=native benchmarking build may contract
+    x + c1*x^3 into an fma inside the fused kernel, so the assertion
+    here allows a few ulp (the C selftest holds the bitwise line in
+    the portable build)."""
+    rs = np.random.RandomState(6)
+    x = rs.randn(4, 33).astype(np.float32) * 2.0
+
+    path = _export(tmp_path,
+                   lambda a: F.gelu(a, approximate=True),
+                   (jnp.asarray(x),))
+    ref, _ = _run(path, [x], opt=False)
+    out, ops = _run(path, [x], opt=True)
+    assert "PtpuGelu" in ops
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-7)
+
+
+def test_bert_tiny_end_to_end_parity(tmp_path):
+    """The real artifact: BERT-tiny fuses attention AND LayerNorm AND
+    GELU, and the optimized output stays allclose to the unfused
+    baseline."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import BertModel, bert_tiny
+    from paddle_tpu.static import InputSpec
+
+    pt.seed(0)
+    m = BertModel(bert_tiny())
+    m.eval()
+    path = pt.onnx.export(m, os.path.join(str(tmp_path), "bert"),
+                          input_spec=[InputSpec([2, 32], "int32")])
+    rs = np.random.RandomState(7)
+    ids = rs.randint(0, bert_tiny().vocab_size, (2, 32)).astype(np.int32)
+    ops = _parity(path, [ids], "PtpuAttention", rtol=2e-4, atol=2e-5)
+    assert "PtpuLayerNorm" in ops and "PtpuGelu" in ops

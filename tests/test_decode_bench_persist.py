@@ -1,0 +1,75 @@
+"""decode_bench `--out` persistence contract (ISSUE r9 satellite;
+pattern of tests/test_serving_bench_persist.py).
+
+Runs `tools/decode_bench.py` as a subprocess with a shrunken config
+(2 sessions, 6 tokens, context 16, decode batch 2), asserts the
+persisted JSON schema, the parity row, and the server-vs-client decode
+counter exactness. The >= 5x tokens/s acceptance is NOT asserted here —
+a 2-session smoke config cannot amortize the per-step wire round trip
+the way the committed BENCH_DECODE run does.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "tools", "decode_bench.py")
+
+
+@pytest.fixture(scope="module")
+def bench_out(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("decb") / "BENCH_DECODE.json")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, BENCH, "--out", out, "--sessions", "2",
+         "--tokens", "6", "--context", "16", "--batch", "2"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    # the smoke config may legitimately miss the 5x throughput gate
+    # (the script exits nonzero then) — parity/counters must still hold
+    with open(out) as f:
+        data = json.load(f)
+    data["_rc"] = r.returncode
+    data["_stderr"] = r.stderr[-2000:]
+    return data
+
+
+class TestDecodeBenchPersist:
+    def test_schema(self, bench_out):
+        assert bench_out["bench"] == "decode_bench"
+        cfg = bench_out["config"]
+        assert cfg == {"sessions": 2, "tokens": 6, "context": 16,
+                       "batch": 2}
+        rows = bench_out["measurements"]
+        metrics = {r["metric"] for r in rows}
+        assert {"recompute_tokens_per_s", "kv_decode_tokens_per_s",
+                "decode_counters_exact", "decode_parity",
+                "decode_kv_speedup_vs_recompute"} <= metrics
+
+    def test_counters_exact(self, bench_out):
+        by = {r["metric"]: r for r in bench_out["measurements"]}
+        row = by["decode_counters_exact"]
+        assert row["value"] is True, row
+        assert row["server"]["steps"] == row["client_steps"]
+        assert row["server"]["replies"] == row["client_steps"]
+        assert row["server"]["evictions"] == 0
+
+    def test_parity(self, bench_out):
+        by = {r["metric"]: r for r in bench_out["measurements"]}
+        assert by["decode_parity"]["value"] is True, \
+            bench_out["_stderr"]
+
+    def test_throughputs_positive_and_gate_row(self, bench_out):
+        by = {r["metric"]: r for r in bench_out["measurements"]}
+        assert by["recompute_tokens_per_s"]["value"] > 0
+        assert by["kv_decode_tokens_per_s"]["value"] > 0
+        gate = by["decode_kv_speedup_vs_recompute"]
+        assert gate["acceptance_gate"] == 5.0
+        assert isinstance(gate["within_gate"], bool)

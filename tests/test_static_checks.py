@@ -151,6 +151,34 @@ class TestWireChecker:
         msgs = [f.message for f in _run(root, "wire")]
         assert any("PULL_REP header" in m for m in msgs)
 
+    def test_catches_decode_tag_drift(self, tmp_path):
+        """r9 DECODE ops are covered: renumbering the Python step tag
+        without the C side must trip the parity map."""
+        root = _fixture(tmp_path, WIRE_FILES)
+        _mutate(root, "paddle_tpu/inference/serving.py",
+                "TAG_DECODE_STEP = 0x67", "TAG_DECODE_STEP = 0x77")
+        msgs = [f.message for f in _run(root, "wire")]
+        assert any("kTagDecodeStep" in m and "drift" in m for m in msgs)
+
+    def test_catches_decode_layout_drift(self, tmp_path):
+        """Moving the DECODE_REP logits count off payload offset 18
+        (C-side write at +22 in the length-prefixed buffer) must trip
+        the layout probe."""
+        root = _fixture(tmp_path, WIRE_FILES)
+        _mutate(root, "csrc/ptpu_serving.cc",
+                "PutU32(f.data() + 22, uint32_t(dec_logit_elems));",
+                "PutU32(f.data() + 20, uint32_t(dec_logit_elems));")
+        msgs = [f.message for f in _run(root, "wire")]
+        assert any("DECODE_REP n_logits" in m for m in msgs)
+
+    def test_catches_decode_step_size_drift(self, tmp_path):
+        root = _fixture(tmp_path, WIRE_FILES)
+        _mutate(root, "csrc/ptpu_serving.cc",
+                "if (n != 2 + 8 + 8 + 8) return proto_err();",
+                "if (n < 2 + 8 + 8) return proto_err();")
+        msgs = [f.message for f in _run(root, "wire")]
+        assert any("DECODE_STEP exact-size" in m for m in msgs)
+
 
 class TestStatsChecker:
     def test_clean_fixture(self, tmp_path):

@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""KV-cached autoregressive decode vs full-prefix recompute (ISSUE r9).
+
+The generation workload for the native serving stack: a GPT-tiny
+decode-step artifact (models.gpt.export_gpt_decode — per-layer KV cache
+inputs, one-token step) served through the C runtime's DECODE wire ops
+(csrc/ptpu_serving.cc 0x65..0x69) with per-session KV slots in the
+predictor (csrc/ptpu_predictor.cc kv_plan/decode_step) and continuous
+batching of steps from different sessions through the micro-batcher.
+
+Three legs:
+  recompute  greedy generation via the FULL-SEQUENCE artifact — every
+             token re-runs the whole fixed-shape [1, S] graph (what
+             this stack had to do before DECODE existed);
+  kv_serving greedy generation for N concurrent sessions over the wire,
+             steps pipelined so the decode batcher fills;
+  parity     one session's greedy token stream must be IDENTICAL
+             between the two paths, logits allclose, and the server's
+             decode counters must equal the client-observed counts
+             EXACTLY.
+
+Gate (acceptance): kv tokens/s >= 5x recompute tokens/s.
+
+Run: python tools/decode_bench.py [--out BENCH_DECODE_rNN.json]
+     [--sessions N] [--tokens T] [--context P] [--batch B]
+(CPU-only; forces jax to CPU; rebuilds nothing — uses the shipped .so,
+whose micro-kernels runtime-dispatch on cpuid.)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+RESULTS = []
+
+
+def emit(rec):
+    RESULTS.append(rec)
+    print(json.dumps(rec), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out")
+    ap.add_argument("--sessions", type=int, default=8)
+    ap.add_argument("--tokens", type=int, default=48)
+    ap.add_argument("--context", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import inference
+    from paddle_tpu.core.native import NativePredictor
+    from paddle_tpu.models.gpt import (GPTForPretraining,
+                                       export_gpt_decode, gpt_tiny)
+    from paddle_tpu.onnx.converter import trace_to_onnx
+
+    assert args.tokens <= args.context
+
+    pt.seed(0)
+    cfg = gpt_tiny(dtype=jnp.float32, dropout=0.0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+
+    with tempfile.TemporaryDirectory() as tmp:
+        dec_path = export_gpt_decode(model, os.path.join(tmp, "dec"),
+                                     batch=args.batch,
+                                     context=args.context)
+        S = args.tokens  # full-seq artifact sized to the generation
+        full_bytes = trace_to_onnx(lambda ids: model(ids),
+                                   (jnp.zeros((1, S), jnp.int32),))
+        full_path = os.path.join(tmp, "full.onnx")
+        with open(full_path, "wb") as f:
+            f.write(full_bytes)
+
+        prompt = 7  # fixed prompt token; everything after is greedy
+
+        # ---- leg 1: full-prefix recompute baseline -----------------
+        # step t: run the whole [1, S] graph over the prefix (padded),
+        # next token = argmax of the logits at position t
+        def recompute_generate(steps):
+            toks = np.zeros((1, S), np.int32)
+            toks[0, 0] = prompt
+            out = [prompt]
+            with NativePredictor(full_path) as p:
+                name = p.input_name(0)
+                p.set_input(name, toks)
+                p.run()  # warmup/load
+                t0 = time.perf_counter()
+                for t in range(steps - 1):
+                    p.set_input(name, toks)
+                    p.run()
+                    lg = p.output(0)[0, t]
+                    nxt = int(np.argmax(lg))
+                    out.append(nxt)
+                    toks[0, t + 1] = nxt
+                dt = time.perf_counter() - t0
+            return out, (steps - 1) / dt
+
+        rc_tokens, rc_tps = recompute_generate(args.tokens)
+        emit({"metric": "recompute_tokens_per_s",
+              "value": round(rc_tps, 1), "unit": "tokens/s",
+              "seq": S, "note": "full [1,S] graph re-run per token"})
+
+        # ---- leg 2: KV-cached decode through the serving wire ------
+        srv = inference.create_server(
+            full_path, max_batch=2, instances=1,
+            decode_model=dec_path, kv_sessions=args.sessions + 2)
+        cli = srv.client()
+        meta = srv.config()
+        assert meta["decode"]["batch"] == args.batch
+        sess = [cli.decode_open() for _ in range(args.sessions)]
+        cur = [prompt] * args.sessions
+        streams = [[prompt] for _ in range(args.sessions)]
+        t0 = time.perf_counter()
+        for _ in range(args.tokens - 1):
+            outs = cli.decode_step_many(
+                [(sess[i], cur[i]) for i in range(args.sessions)])
+            for i in range(args.sessions):
+                cur[i] = int(np.argmax(outs[i]))
+                streams[i].append(cur[i])
+        dt = time.perf_counter() - t0
+        kv_steps = args.sessions * (args.tokens - 1)
+        kv_tps = kv_steps / dt
+        st = srv.stats()["decode"]
+        emit({"metric": "kv_decode_tokens_per_s",
+              "value": round(kv_tps, 1), "unit": "tokens/s",
+              "sessions": args.sessions, "batch": args.batch,
+              "context": args.context,
+              "batches": st["batches"],
+              "mean_fill": round(kv_steps / max(st["batches"], 1), 2)})
+
+        # ---- counter exactness: server == client-observed ----------
+        counters_exact = (st["steps"] == kv_steps and
+                          st["replies"] == kv_steps and
+                          st["opens"] == args.sessions and
+                          st["evictions"] == 0)
+        emit({"metric": "decode_counters_exact",
+              "value": bool(counters_exact),
+              "server": {k: st[k] for k in
+                         ("steps", "replies", "opens", "evictions")},
+              "client_steps": kv_steps})
+
+        # ---- parity: teacher-forced logits match the full-seq graph
+        # at EVERY position (argmax streams on an UNTRAINED model are
+        # ulp-unstable across compute paths, so the check is on logits,
+        # not on greedy choices)
+        ps = cli.decode_open()
+        kv_logits = [np.asarray(cli.decode_step(ps, rc_tokens[t]))
+                     for t in range(args.tokens - 1)]
+        cli.decode_close(ps)
+        with NativePredictor(full_path) as p:
+            name = p.input_name(0)
+            toks = np.zeros((1, S), np.int32)
+            toks[0, :len(rc_tokens)] = rc_tokens
+            p.set_input(name, toks)
+            p.run()
+            full_logits = p.output(0)[0]
+        per_step_close = [bool(np.allclose(kv_logits[t], full_logits[t],
+                                           rtol=2e-3, atol=2e-4))
+                          for t in range(args.tokens - 1)]
+        logits_close = all(per_step_close)
+        emit({"metric": "decode_parity",
+              "value": bool(logits_close),
+              "teacher_forced_steps": args.tokens - 1,
+              "all_positions_allclose": logits_close})
+        del streams  # greedy streams only drive the throughput leg
+
+        for s in sess:
+            cli.decode_close(s)
+        cli.close()
+        srv.stop()
+
+        # ---- the gate ----------------------------------------------
+        ratio = kv_tps / rc_tps
+        emit({"metric": "decode_kv_speedup_vs_recompute",
+              "value": round(ratio, 2), "unit": "x",
+              "acceptance_gate": 5.0,
+              "within_gate": bool(ratio >= 5.0)})
+
+        ok = counters_exact and logits_close and ratio >= 5.0
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"bench": "decode_bench",
+                       "config": {"sessions": args.sessions,
+                                  "tokens": args.tokens,
+                                  "context": args.context,
+                                  "batch": args.batch},
+                       "measurements": RESULTS}, f, indent=1)
+        print(f"# persisted to {args.out}", flush=True)
+    if not ok:
+        sys.exit("decode_bench: acceptance gate FAILED")
+
+
+if __name__ == "__main__":
+    main()

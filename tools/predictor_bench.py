@@ -213,11 +213,24 @@ def bench_bert_tiny(tmp):
         fwd(params, xj).block_until_ready()
     dt_xla = (time.perf_counter() - t0) / 10
 
-    dt_nat, _, _ = time_native(path, ids, steps=5, warmup=1)
+    dt_nat, _, _ = time_native(path, ids, steps=10, warmup=2)
+    # FIRST-CLASS gated metric (ISSUE r9 satellite): r07 shipped
+    # BERT-tiny at 2.70x XLA because the attention/softmax/LayerNorm
+    # glue ran as ~40 unfused passes per layer. The r9 load-time
+    # fusions (PtpuAttention flash kernel, PtpuLayerNorm, PtpuGelu,
+    # no-op-Cast elimination) + runtime-dispatched AVX-512 micro-
+    # kernels brought it to ~1.0x on this machine. The gate holds the
+    # tentpole's acceptance line (<= 1.3x). If this trips, profile the
+    # Ptpu* transformer ops first (PTPU_PREDICTOR_PROFILE=1).
+    ratio = round(dt_nat / dt_xla, 2)
     emit({"metric": "bert_tiny_native_over_xla_ratio",
-          "value": round(dt_nat / dt_xla, 2), "unit": "x",
+          "value": ratio, "unit": "x",
           "native_ms": round(dt_nat * 1e3, 2),
-          "xla_ms": round(dt_xla * 1e3, 2)})
+          "xla_ms": round(dt_xla * 1e3, 2),
+          "regression_gate": 1.3,
+          "within_gate": bool(ratio <= 1.3),
+          "note": "r07 was 2.70x; closed by load-time attention/LN/"
+                  "GELU fusion + cpuid-dispatched AVX-512 kernels"})
 
 
 def main():

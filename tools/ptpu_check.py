@@ -332,7 +332,13 @@ PS_TAGS = {"kTagPullReq": "TAG_PULL_REQ", "kTagPullRep": "TAG_PULL_REP",
            "kTagErr": "TAG_ERR"}
 SV_TAGS = {"kTagInferReq": "TAG_INFER_REQ", "kTagInferRep": "TAG_INFER_REP",
            "kTagInferErr": "TAG_INFER_ERR", "kTagMetaReq": "TAG_META_REQ",
-           "kTagMetaRep": "TAG_META_REP"}
+           "kTagMetaRep": "TAG_META_REP",
+           # KV-decode ops (r9): sessions/steps over 0x65..0x69
+           "kTagDecodeOpen": "TAG_DECODE_OPEN",
+           "kTagDecodeSess": "TAG_DECODE_SESS",
+           "kTagDecodeStep": "TAG_DECODE_STEP",
+           "kTagDecodeRep": "TAG_DECODE_REP",
+           "kTagDecodeClose": "TAG_DECODE_CLOSE"}
 
 
 def _py_struct_size(src: str, var: str) -> Optional[int]:
@@ -444,6 +450,38 @@ def check_wire(root: str) -> List[Finding]:
                          pys):
             f.append(Finding("wire", pys_rel, 0,
                              "INFER reply count at payload offset 10 "
+                             "not found (layout probe)"))
+
+        # DECODE layout probes (r9). STEP payload is
+        # [ver][tag][u64 req_id][u64 session][i64 token] = 26 bytes —
+        # the C parser must pin exactly that; the REP payload carries
+        # [u32 n_logits] at offset 18 and the f32 body at 22, which the
+        # C writer addresses at +22/+26 in the length-prefixed reply
+        # buffer and the Python reader at 18/22 on the stripped payload.
+        if not re.search(r"n\s*!=\s*2\s*\+\s*8\s*\+\s*8\s*\+\s*8", clean):
+            f.append(Finding("wire", sv_rel, 0,
+                             "DECODE_STEP exact-size check (2 + 8 + 8 "
+                             "+ 8) not found (layout probe)"))
+        m = re.search(r"PutU32\(f\.data\(\)\s*\+\s*(\d+),\s*"
+                      r"uint32_t\(dec_logit_elems\)\)", clean)
+        if m is None:
+            f.append(Finding("wire", sv_rel, 0,
+                             "DECODE_REP n_logits write not found "
+                             "(layout probe)"))
+        elif int(m.group(1)) != 22:
+            f.append(Finding(
+                "wire", sv_rel, _lineno(clean, m.start()),
+                f"DECODE_REP n_logits lands at +{m.group(1)} in the C "
+                f"reply buffer; expected 4-byte length prefix + 18"))
+        if not re.search(r"unpack_from\(\s*f,\s*18\s*\)",
+                         pys.split("_decode_rep_logits", 1)[-1][:300]):
+            f.append(Finding("wire", pys_rel, 0,
+                             "DECODE_REP n_logits at payload offset 18 "
+                             "not found (layout probe)"))
+        if not re.search(r"np\.frombuffer\(\s*f,\s*np\.float32,\s*n,"
+                         r"\s*22\s*\)", pys):
+            f.append(Finding("wire", pys_rel, 0,
+                             "DECODE_REP f32 body at payload offset 22 "
                              "not found (layout probe)"))
     return f
 
