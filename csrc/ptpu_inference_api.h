@@ -160,7 +160,41 @@ void* ptpu_serving_start2(const char* model_path,
                           int instances, int threads_per_instance,
                           int loopback_only, int kv_sessions, char* err,
                           int err_len);
+
+/* Extended start (r10): http_port >= 0 adds the telemetry HTTP/1.1
+ * listener (GET /metrics Prometheus text, /healthz, /statsz stats
+ * JSON, /tracez sampled request spans; 0 picks a free port) served by
+ * the SAME epoll event threads — no extra threads. The PTPU_NET_HTTP
+ * env knob overrides either start form. */
+void* ptpu_serving_start3(const char* model_path,
+                          const char* decode_model_path, int port,
+                          const char* authkey, int authkey_len,
+                          int max_batch, int64_t deadline_us,
+                          int instances, int threads_per_instance,
+                          int loopback_only, int kv_sessions,
+                          int http_port, char* err, int err_len);
 int ptpu_serving_port(void*);
+
+/* Telemetry HTTP port, or -1 when disabled. */
+int ptpu_serving_http_port(void*);
+
+/* Two-phase shutdown, half one: stop accepting framed connections and
+ * flip GET /healthz to 503 "draining" while existing connections (and
+ * the HTTP listener) keep answering; ptpu_serving_stop completes the
+ * teardown. Idempotent. */
+void ptpu_serving_drain_begin(void*);
+
+/* Prometheus exposition text of the live stats snapshot (the GET
+ * /metrics bytes). Thread-local buffer, valid until the calling
+ * thread's next call. */
+const char* ptpu_serving_prom_text(void*);
+
+/* Request tracing (csrc/ptpu_trace.{h,cc}, process-global per .so):
+ * runtime override of the PTPU_TRACE_SAMPLE / PTPU_TRACE_SLOW_US
+ * knobs (negative keeps the current value), and the GET /tracez JSON
+ * for bindings without HTTP. */
+void ptpu_trace_set(int64_t sample, int64_t slow_us);
+const char* ptpu_trace_json(int64_t max_spans);
 
 /* Effective configuration as JSON (buckets built, instances, model
  * input signature). Pointer valid until the calling thread's next

@@ -9,6 +9,7 @@
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
 #include <sys/socket.h>
@@ -23,6 +24,7 @@
 #include <unordered_map>
 
 #include "ptpu_hmac.h"
+#include "ptpu_trace.h"
 #include "ptpu_wire.h"
 
 namespace ptpu {
@@ -47,6 +49,25 @@ bool SetNonBlocking(int fd) {
   return fl >= 0 && ::fcntl(fd, F_SETFL, fl | O_NONBLOCK) == 0;
 }
 
+// Process-wide monotonic connection id (the `conn` key of every trace
+// span — stable across both servers in one process image).
+std::atomic<uint64_t> g_conn_id{1};
+
+// HTTP request headers larger than this are a slow-loris/garbage cut.
+constexpr size_t kHttpMaxHeader = 16 << 10;
+
+const char* HttpStatusText(int status) {
+  switch (status) {
+    case 200: return "OK";
+    case 400: return "Bad Request";
+    case 404: return "Not Found";
+    case 405: return "Method Not Allowed";
+    case 431: return "Request Header Fields Too Large";
+    case 503: return "Service Unavailable";
+    default: return "Error";
+  }
+}
+
 int64_t EnvI64(const char* name, int64_t dflt) {
   const char* e = std::getenv(name);
   if (!e || !*e) return dflt;
@@ -56,6 +77,57 @@ int64_t EnvI64(const char* name, int64_t dflt) {
 }
 
 }  // namespace
+
+// `n` query parameter of a /tracez target, matched as a WHOLE key
+// (never a suffix of another parameter like "conn=").
+static int64_t TracezQueryN(const std::string& target, int64_t dflt) {
+  const size_t q = target.find('?');
+  if (q == std::string::npos) return dflt;
+  size_t p = q + 1;
+  while (p < target.size()) {
+    size_t amp = target.find('&', p);
+    if (amp == std::string::npos) amp = target.size();
+    if (amp > p + 2 && target[p] == 'n' && target[p + 1] == '=') {
+      const long long v =
+          std::strtoll(target.c_str() + p + 2, nullptr, 10);
+      return v > 0 ? int64_t(v) : dflt;
+    }
+    p = amp + 1;
+  }
+  return dflt;
+}
+
+HttpReply TelemetryHttp(const std::string& target,
+                        const std::function<std::string()>& stats_json,
+                        const std::string& prom_prefix, bool draining) {
+  const std::string path = target.substr(0, target.find('?'));
+  HttpReply rep;
+  if (path == "/healthz") {
+    rep.content_type = "application/json";
+    if (draining) {
+      rep.status = 503;
+      rep.body = "{\"status\":\"draining\"}\n";
+    } else {
+      rep.body = "{\"status\":\"ok\"}\n";
+    }
+  } else if (path == "/statsz") {
+    rep.content_type = "application/json";
+    rep.body = stats_json();
+    rep.body += '\n';
+  } else if (path == "/metrics") {
+    rep.content_type = "text/plain; version=0.0.4; charset=utf-8";
+    rep.body = trace::PromFromStatsJson(stats_json(), prom_prefix);
+  } else if (path == "/tracez") {
+    rep.content_type = "application/json";
+    rep.body = trace::Global().TracezJson(
+        size_t(TracezQueryN(target, 128)));
+    rep.body += '\n';
+  } else {
+    rep.status = 404;
+    rep.body = "not found\n";
+  }
+  return rep;
+}
 
 Options OptionsFromEnv(Options base) {
   base.event_threads =
@@ -68,6 +140,7 @@ Options OptionsFromEnv(Options base) {
       int(EnvI64("PTPU_NET_SOCKBUF", base.sockbuf_bytes));
   base.max_out_bytes =
       size_t(EnvI64("PTPU_NET_MAX_OUT", int64_t(base.max_out_bytes)));
+  base.http_port = int(EnvI64("PTPU_NET_HTTP", base.http_port));
   return base;
 }
 
@@ -206,18 +279,34 @@ class EventLoop {
     }
   }
 
+  // Idle budget for HTTP telemetry conns: the configured idle timeout
+  // when on, else the handshake timeout (an HTTP peer that dribbles a
+  // request for 5s is the same slow-loris the handshake deadline cuts).
+  int64_t HttpIdleUs() const {
+    return opt_.idle_timeout_us > 0 ? opt_.idle_timeout_us
+                                    : opt_.handshake_timeout_us;
+  }
+
   void Adopt(const ConnPtr& c) {
     c->loop_ = this;
-    c->state_ = Conn::St::kAwaitMac;
-    c->handshake_deadline_ = NowUs() + opt_.handshake_timeout_us;
-    ++awaiting_mac_;
     // the acceptor already set O_NONBLOCK; re-assert it here so EVERY
     // fd entering this epoll set is provably nonblocking (the `net`
     // checker in tools/ptpu_check.py keys on this call)
     SetNonBlocking(c->fd_);
-    // the nonce goes out through the normal (nonblocking) write path
-    std::random_device rd;
-    for (auto& b : c->nonce_) b = uint8_t(rd());
+    if (c->http_) {
+      // HTTP telemetry protocol: no nonce, no handshake — the conn
+      // opens immediately and requests parse in ParseHttp
+      c->state_ = Conn::St::kOpen;
+      ++http_conns_;
+      if (HttpIdleUs() > 0) c->idle_deadline_ = NowUs() + HttpIdleUs();
+    } else {
+      c->state_ = Conn::St::kAwaitMac;
+      c->handshake_deadline_ = NowUs() + opt_.handshake_timeout_us;
+      ++awaiting_mac_;
+      // the nonce goes out through the normal (nonblocking) write path
+      std::random_device rd;
+      for (auto& b : c->nonce_) b = uint8_t(rd());
+    }
     epoll_event ev{};
     ev.events = EPOLLIN;
     ev.data.ptr = c.get();
@@ -226,13 +315,15 @@ class EventLoop {
       return;
     }
     conns_.emplace(c->fd_, c);
-    {
-      std::lock_guard<std::mutex> g(c->omu_);
-      Conn::OutBuf ob;
-      ob.b.assign(c->nonce_, c->nonce_ + sizeof(c->nonce_));
-      c->outq_.push_back(std::move(ob));
+    if (!c->http_) {
+      {
+        std::lock_guard<std::mutex> g(c->omu_);
+        Conn::OutBuf ob;
+        ob.b.assign(c->nonce_, c->nonce_ + sizeof(c->nonce_));
+        c->outq_.push_back(std::move(ob));
+      }
+      FlushConn(c.get());
     }
-    FlushConn(c.get());
   }
 
   // ---------------------------------------------------------- reads
@@ -269,7 +360,13 @@ class EventLoop {
       }
       c->in_tail_ += size_t(r);
       budget -= r;
-      if (!ParseFrames(c)) return;  // closed (or paused) inside
+      // net.read span begin: first bytes of the pending request seen
+      if (c->frame_t0_ == 0) c->frame_t0_ = NowUs();
+      if (c->http_) {
+        if (!ParseHttp(c)) return;  // closed inside
+      } else {
+        if (!ParseFrames(c)) return;  // closed (or paused) inside
+      }
       if (c->read_paused_) return;
     }
     if (c->in_head_ == c->in_tail_) c->in_head_ = c->in_tail_ = 0;
@@ -314,6 +411,7 @@ class EventLoop {
           return false;
         }
         c->in_head_ += 4 + size_t(n);
+        c->frame_t0_ = c->in_tail_ > c->in_head_ ? NowUs() : 0;
         continue;
       }
       if (!DispatchFrame(c, payload, n)) return false;
@@ -377,6 +475,9 @@ class EventLoop {
     switch (r) {
       case FrameResult::kOk:
         c->in_head_ += 4 + size_t(n);
+        // next frame's read stamp: bytes already buffered mean it is
+        // "arriving now"; an empty buffer re-stamps on the next read
+        c->frame_t0_ = c->in_tail_ > c->in_head_ ? NowUs() : 0;
         if (c->defer_since_) {  // deferred frame finally consumed
           c->defer_since_ = 0;
           DropDeferred(c);
@@ -423,6 +524,128 @@ class EventLoop {
     ::epoll_ctl(ep_, EPOLL_CTL_MOD, c->fd_, &ev);
   }
 
+  // ----------------------------------------------------------- http
+
+  // Build + queue one HTTP/1.1 response. Returns false when the conn
+  // should close after the flush (draining_ marks every queued buffer
+  // for close already).
+  bool SendHttpResponse(Conn* c, int status,
+                        const std::string& content_type,
+                        const std::string& body, bool keep_alive) {
+    std::string head = "HTTP/1.1 " + std::to_string(status) + " " +
+                       HttpStatusText(status) + "\r\n";
+    head += "Content-Type: " + content_type + "\r\n";
+    head += "Content-Length: " + std::to_string(body.size()) + "\r\n";
+    head += keep_alive ? "Connection: keep-alive\r\n"
+                       : "Connection: close\r\n";
+    head += "\r\n";
+    std::vector<uint8_t> buf = c->AcquireBuf();
+    buf.clear();
+    buf.reserve(head.size() + body.size());
+    buf.insert(buf.end(), head.begin(), head.end());
+    buf.insert(buf.end(), body.begin(), body.end());
+    return c->SendRaw(std::move(buf)) && keep_alive;
+  }
+
+  // Dispatch every complete HTTP request in the buffer (GET-only
+  // telemetry: requests have no body). Returns false when the conn
+  // was closed.
+  bool ParseHttp(Conn* c) {
+    for (;;) {
+      const char* data =
+          reinterpret_cast<const char*>(c->in_.data() + c->in_head_);
+      const size_t avail = c->in_tail_ - c->in_head_;
+      if (avail == 0) break;
+      // find the header terminator
+      size_t hdr_end = 0;
+      for (size_t i = 0; i + 3 < avail; ++i) {
+        if (data[i] == '\r' && data[i + 1] == '\n' &&
+            data[i + 2] == '\r' && data[i + 3] == '\n') {
+          hdr_end = i + 4;
+          break;
+        }
+      }
+      if (hdr_end == 0) {
+        if (avail > kHttpMaxHeader) {
+          SendHttpResponse(c, 431, "text/plain; charset=utf-8",
+                           "header too large\n", false);
+          CloseAfterFlush(c);
+          return false;
+        }
+        break;  // need more bytes
+      }
+      const std::string req(data, hdr_end);
+      c->in_head_ += hdr_end;
+      c->frame_t0_ = c->in_tail_ > c->in_head_ ? NowUs() : 0;
+      if (HttpIdleUs() > 0) c->idle_deadline_ = NowUs() + HttpIdleUs();
+      // request line: METHOD SP target SP version
+      const size_t eol = req.find("\r\n");
+      const std::string line = req.substr(0, eol);
+      const size_t sp1 = line.find(' ');
+      const size_t sp2 =
+          sp1 == std::string::npos ? sp1 : line.find(' ', sp1 + 1);
+      if (sp1 == std::string::npos || sp2 == std::string::npos) {
+        SendHttpResponse(c, 400, "text/plain; charset=utf-8",
+                         "bad request\n", false);
+        CloseAfterFlush(c);
+        return false;
+      }
+      const std::string method = line.substr(0, sp1);
+      const std::string target = line.substr(sp1 + 1, sp2 - sp1 - 1);
+      // keep-alive: HTTP/1.1 default unless "Connection: close"
+      std::string low = req;
+      for (auto& ch : low)
+        ch = char(ch >= 'A' && ch <= 'Z' ? ch + 32 : ch);
+      const bool http10 = line.find("HTTP/1.0") != std::string::npos;
+      bool keep = !http10;
+      if (low.find("connection: close") != std::string::npos)
+        keep = false;
+      if (http10 && low.find("connection: keep-alive") !=
+                        std::string::npos)
+        keep = true;
+      stats_->http_reqs.Add(1);
+      bool alive;
+      if (method != "GET") {
+        alive = SendHttpResponse(c, 405, "text/plain; charset=utf-8",
+                                 "only GET is served here\n", keep);
+      } else {
+        HttpReply rep;
+        if (cbs_.on_http) {
+          try {
+            rep = cbs_.on_http(target);
+          } catch (...) {
+            rep.status = 500;
+            rep.content_type = "text/plain; charset=utf-8";
+            rep.body = "internal error\n";
+          }
+        } else {
+          rep.status = 404;
+          rep.body = "not found\n";
+        }
+        alive = SendHttpResponse(c, rep.status, rep.content_type,
+                                 rep.body, keep);
+      }
+      if (!alive) {
+        CloseAfterFlush(c);
+        return false;
+      }
+      if (c->state_ == Conn::St::kClosed) return false;
+    }
+    if (c->in_head_ == c->in_tail_) c->in_head_ = c->in_tail_ = 0;
+    return true;
+  }
+
+  // Close once the queued response bytes are flushed: stop reading
+  // and let the empty-outq flush path (or the deadline scan) finish
+  // it — mirrors "Connection: close" semantics without dropping the
+  // response that was just queued.
+  void CloseAfterFlush(Conn* c) {
+    if (c->state_ == Conn::St::kClosed) return;
+    c->http_close_ = true;
+    PauseReads(c);
+    FlushConn(c);
+  }
+
   // --------------------------------------------------------- writes
 
   void FlushConn(Conn* c) {
@@ -450,6 +673,10 @@ class EventLoop {
         const size_t rem = ob.b.size() - ob.off;
         if (left >= rem) {
           left -= rem;
+          if (ob.trace_id)  // net.flush span: queued -> last byte out
+            trace::Global().Record(ob.trace_id, trace::kFlush,
+                                   ob.t_queued, NowUs(), c->id_,
+                                   ob.trace_arg);
           if (c->pool_.size() < kPoolCap &&
               ob.b.capacity() <= kPoolMaxBufBytes) {
             ob.b.clear();
@@ -479,7 +706,12 @@ class EventLoop {
         c->want_write_ = false;
         ArmEpoll(c);
       }
-      if (draining_) CloseConn(c, CloseWhy::kDrain);
+      if (draining_) {
+        CloseConn(c, CloseWhy::kDrain);
+      } else if (c->http_close_) {
+        // "Connection: close": the response is fully on the wire
+        CloseConn(c, CloseWhy::kAuto);
+      }
     }
   }
 
@@ -490,7 +722,9 @@ class EventLoop {
   // is on — a steady-state open fleet pays nothing here.
   void CheckDeadlines() {
     if (conns_.empty()) return;
-    if (awaiting_mac_ == 0 && opt_.idle_timeout_us <= 0) return;
+    if (awaiting_mac_ == 0 && opt_.idle_timeout_us <= 0 &&
+        http_conns_ == 0)
+      return;
     const int64_t now = NowUs();
     if (now < next_scan_us_) return;
     next_scan_us_ = now + ScanPeriodUs();
@@ -514,7 +748,8 @@ class EventLoop {
           busy = !c->outq_.empty();
         }
         if (busy)
-          c->idle_deadline_ = now + opt_.idle_timeout_us;
+          c->idle_deadline_ =
+              now + (c->http_ ? HttpIdleUs() : opt_.idle_timeout_us);
         else
           expired.push_back(c);
       }
@@ -567,7 +802,7 @@ class EventLoop {
     int64_t next = INT64_MAX;
     for (Conn* c : deferred_)
       next = std::min(next, c->defer_retry_at_);
-    if (awaiting_mac_ > 0 ||
+    if (awaiting_mac_ > 0 || http_conns_ > 0 ||
         (opt_.idle_timeout_us > 0 && !conns_.empty()))
       next = std::min(next, next_scan_us_);
     if (next == INT64_MAX) return -1;
@@ -593,9 +828,13 @@ class EventLoop {
   }
 
   void FinishClose(Conn* c) {
-    const bool was_open = c->state_ == Conn::St::kOpen;
+    // on_open/on_close are the FRAMED protocol's lifecycle hooks; an
+    // HTTP telemetry conn owns no server-side state to free
+    const bool was_open = c->state_ == Conn::St::kOpen && !c->http_;
     if (c->state_ == Conn::St::kAwaitMac && awaiting_mac_ > 0)
       --awaiting_mac_;
+    if (c->http_ && c->state_ != Conn::St::kClosed && http_conns_ > 0)
+      --http_conns_;
     if (c->defer_since_) {
       c->defer_since_ = 0;
       DropDeferred(c);
@@ -609,7 +848,8 @@ class EventLoop {
     }
     ::epoll_ctl(ep_, EPOLL_CTL_DEL, c->fd_, nullptr);
     ::close(c->fd_);
-    stats_->active_conns.fetch_sub(1, std::memory_order_relaxed);
+    if (!c->http_)  // telemetry conns were never counted (AcceptOne)
+      stats_->active_conns.fetch_sub(1, std::memory_order_relaxed);
     ConnPtr self;
     auto it = conns_.find(c->fd_);
     if (it != conns_.end()) {
@@ -660,6 +900,7 @@ class EventLoop {
   std::vector<ConnPtr> local_flush_;
   std::vector<Conn*> deferred_;  // conns holding a kDefer'd frame
   int64_t awaiting_mac_ = 0;     // conns still mid-handshake
+  int64_t http_conns_ = 0;       // open HTTP telemetry conns
   bool draining_ = false;
   int64_t drain_deadline_ = 0;
   int64_t next_scan_us_ = 0;
@@ -669,9 +910,9 @@ class EventLoop {
 // Conn
 // ---------------------------------------------------------------------------
 
-bool Conn::SendPayload(std::vector<uint8_t>&& buf) {
-  if (buf.size() < 4) return false;
-  PutU32(buf.data(), uint32_t(buf.size() - 4));
+// Shared enqueue/backpressure/flush-post body of both send forms.
+bool Conn::EnqueueOut(std::vector<uint8_t>&& buf, uint64_t trace_id,
+                      uint64_t trace_arg) {
   EventLoop* loop = loop_;
   bool post_remote = false, post_local = false, kill = false;
   {
@@ -691,6 +932,11 @@ bool Conn::SendPayload(std::vector<uint8_t>&& buf) {
       out_bytes_ += buf.size();
       OutBuf ob;
       ob.b = std::move(buf);
+      if (trace_id) {
+        ob.trace_id = trace_id;
+        ob.trace_arg = trace_arg;
+        ob.t_queued = NowUs();
+      }
       outq_.push_back(std::move(ob));
       if (!flush_posted_) {
         flush_posted_ = true;
@@ -708,6 +954,19 @@ bool Conn::SendPayload(std::vector<uint8_t>&& buf) {
   if (post_local) loop->NoteLocalFlush(shared_from_this());
   if (post_remote) loop->PostFlush(shared_from_this());
   return true;
+}
+
+bool Conn::SendPayload(std::vector<uint8_t>&& buf, uint64_t trace_id,
+                       uint64_t trace_arg) {
+  if (buf.size() < 4) return false;
+  PutU32(buf.data(), uint32_t(buf.size() - 4));
+  return EnqueueOut(std::move(buf), trace_id, trace_arg);
+}
+
+bool Conn::SendRaw(std::vector<uint8_t>&& buf) {
+  // verbatim bytes (HTTP): same queue/flush path, no length prefix
+  if (buf.empty()) return false;
+  return EnqueueOut(std::move(buf), 0, 0);
 }
 
 bool Conn::SendCopy(const uint8_t* payload, size_t n) {
@@ -755,32 +1014,54 @@ Server::Server(const Options& opt, Callbacks cbs, Stats* stats)
 
 Server::~Server() { Stop(); }
 
-bool Server::Start(std::string* err) {
-  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (listen_fd_ < 0) {
+namespace {
+
+// Bind + listen one TCP socket; returns the fd (or -1 with *err set)
+// and the bound port via *out_port.
+int BindListen(int port, bool loopback, int backlog, int* out_port,
+               std::string* err) {
+  const int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) {
     if (err) *err = "ptpu_net: socket() failed";
-    return false;
+    return -1;
   }
   const int one = 1;
-  ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr =
-      htonl(opt_.loopback_only ? INADDR_LOOPBACK : INADDR_ANY);
-  addr.sin_port = htons(uint16_t(opt_.port));
-  if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
-             sizeof(addr)) != 0 ||
-      ::listen(listen_fd_, opt_.listen_backlog) != 0) {
+      htonl(loopback ? INADDR_LOOPBACK : INADDR_ANY);
+  addr.sin_port = htons(uint16_t(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+          0 ||
+      ::listen(fd, backlog) != 0) {
     if (err)
-      *err = "ptpu_net: bind/listen on port " +
-             std::to_string(opt_.port) + " failed";
-    ::close(listen_fd_);
-    listen_fd_ = -1;
-    return false;
+      *err = "ptpu_net: bind/listen on port " + std::to_string(port) +
+             " failed";
+    ::close(fd);
+    return -1;
   }
   socklen_t alen = sizeof(addr);
-  ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
-  port_ = int(ntohs(addr.sin_port));
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  if (out_port) *out_port = int(ntohs(addr.sin_port));
+  return fd;
+}
+
+}  // namespace
+
+bool Server::Start(std::string* err) {
+  listen_fd_ = BindListen(opt_.port, opt_.loopback_only,
+                          opt_.listen_backlog, &port_, err);
+  if (listen_fd_ < 0) return false;
+  if (opt_.http_port >= 0 && cbs_.on_http) {
+    http_fd_ = BindListen(opt_.http_port, opt_.loopback_only,
+                          opt_.listen_backlog, &http_port_, err);
+    if (http_fd_ < 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return false;
+    }
+  }
 
   for (int i = 0; i < opt_.event_threads; ++i) {
     auto loop = std::unique_ptr<EventLoop>(
@@ -789,6 +1070,10 @@ bool Server::Start(std::string* err) {
       if (err) *err = "ptpu_net: epoll/eventfd setup failed";
       ::close(listen_fd_);
       listen_fd_ = -1;
+      if (http_fd_ >= 0) {
+        ::close(http_fd_);
+        http_fd_ = -1;
+      }
       loops_.clear();
       return false;
     }
@@ -799,68 +1084,125 @@ bool Server::Start(std::string* err) {
   return true;
 }
 
-void Server::AcceptLoop() {
-  for (;;) {
-    const int fd = ::accept(listen_fd_, nullptr, nullptr);
-    if (fd < 0) {
-      if (!stop_accept_.load() && AcceptErrnoIsTransient(errno)) {
-        std::this_thread::sleep_for(std::chrono::milliseconds(5));
-        continue;
-      }
-      return;
+// Accept + configure one connection off `lfd`. Returns false when the
+// listener is dead (shutdown by Stop or a fatal errno).
+bool Server::AcceptOne(int lfd, bool http) {
+  const int fd = ::accept(lfd, nullptr, nullptr);
+  if (fd < 0) {
+    if (AcceptErrnoIsTransient(errno)) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(5));
+      return true;
     }
-    if (stop_accept_.load()) {
-      ::close(fd);
-      return;
-    }
-    if (stats_->active_conns.load(std::memory_order_relaxed) >=
-        opt_.max_conns) {
-      // accept-time shedding: beyond the cap the kindest failure is
-      // an immediate close (clients see EOF before the nonce), not a
-      // half-served connection
-      stats_->conns_shed.Add(1);
-      ::close(fd);
-      continue;
-    }
-    if (!SetNonBlocking(fd)) {
-      ::close(fd);
-      continue;
-    }
+    return false;
+  }
+  if ((http ? stop_http_ : stop_accept_).load()) {
+    ::close(fd);
+    return false;
+  }
+  if (!http && stats_->active_conns.load(std::memory_order_relaxed) >=
+                   opt_.max_conns) {
+    // accept-time shedding: beyond the cap the kindest failure is
+    // an immediate close (clients see EOF before the nonce), not a
+    // half-served connection. Telemetry (HTTP) conns are EXEMPT and
+    // uncounted: a saturated fleet is exactly when /healthz must
+    // still answer — they are loopback, header-deadline + idle
+    // bounded, and tracked by http_reqs instead.
+    stats_->conns_shed.Add(1);
+    ::close(fd);
+    return true;
+  }
+  if (!SetNonBlocking(fd)) {
+    ::close(fd);
+    return true;
+  }
+  if (!http) {
     stats_->conns_accepted.Add(1);
     stats_->active_conns.fetch_add(1, std::memory_order_relaxed);
-    const int one = 1;
-    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    if (opt_.sockbuf_bytes > 0) {
-      ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &opt_.sockbuf_bytes,
-                   sizeof(opt_.sockbuf_bytes));
-      ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &opt_.sockbuf_bytes,
-                   sizeof(opt_.sockbuf_bytes));
+  }
+  const int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  if (opt_.sockbuf_bytes > 0 && !http) {
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &opt_.sockbuf_bytes,
+                 sizeof(opt_.sockbuf_bytes));
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &opt_.sockbuf_bytes,
+                 sizeof(opt_.sockbuf_bytes));
+  }
+  auto conn = std::make_shared<Conn>();
+  conn->fd_ = fd;
+  conn->id_ = g_conn_id.fetch_add(1, std::memory_order_relaxed);
+  conn->http_ = http;
+  conn->max_out_bytes_ = opt_.max_out_bytes;
+  conn->loop_ = loops_[next_loop_].get();
+  loops_[next_loop_]->PostAdopt(conn);
+  next_loop_ = (next_loop_ + 1) % loops_.size();
+  return true;
+}
+
+// One acceptor thread for BOTH listeners (framed wire + telemetry
+// HTTP): poll() multiplexes them, so the second protocol costs no
+// extra thread. Exits when every live listener is stopped.
+void Server::AcceptLoop() {
+  bool main_alive = listen_fd_ >= 0;
+  bool http_alive = http_fd_ >= 0;
+  while (main_alive || http_alive) {
+    if (stop_accept_.load()) main_alive = false;
+    if (stop_http_.load()) http_alive = false;
+    pollfd pfds[2];
+    int n = 0, idx_main = -1, idx_http = -1;
+    if (main_alive) {
+      pfds[n] = pollfd{listen_fd_, POLLIN, 0};
+      idx_main = n++;
     }
-    auto conn = std::make_shared<Conn>();
-    conn->fd_ = fd;
-    conn->max_out_bytes_ = opt_.max_out_bytes;
-    conn->loop_ = loops_[next_loop_].get();
-    loops_[next_loop_]->PostAdopt(conn);
-    next_loop_ = (next_loop_ + 1) % loops_.size();
+    if (http_alive) {
+      pfds[n] = pollfd{http_fd_, POLLIN, 0};
+      idx_http = n++;
+    }
+    if (n == 0) break;
+    const int pr = ::poll(pfds, nfds_t(n), 200);
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    if (pr == 0) continue;
+    if (idx_main >= 0 && pfds[idx_main].revents != 0)
+      main_alive = AcceptOne(listen_fd_, /*http=*/false);
+    if (idx_http >= 0 && pfds[idx_http].revents != 0)
+      http_alive = AcceptOne(http_fd_, /*http=*/true);
   }
 }
 
 void Server::StopAccepting() {
   if (stop_accept_.exchange(true)) return;
-  // shutdown() wakes the blocked accept() but keeps the fd alive;
+  // shutdown() wakes the acceptor's poll() but keeps the fd alive;
   // closing before the join would race the accept thread's read of
   // listen_fd_ and invite fd-number reuse (TSan-caught in the old
   // per-server loops)
   if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+  if (http_fd_ < 0) {
+    // no telemetry listener: the acceptor has nothing left to serve
+    if (accept_thread_.joinable()) accept_thread_.join();
+    if (listen_fd_ >= 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+  }
+  // with HTTP enabled the acceptor keeps serving health probes until
+  // Drain() — a draining server must still answer GET /healthz
+}
+
+void Server::Drain() {
+  if (drained_.exchange(true)) return;
+  stop_http_.store(true);
+  if (http_fd_ >= 0) ::shutdown(http_fd_, SHUT_RDWR);
   if (accept_thread_.joinable()) accept_thread_.join();
   if (listen_fd_ >= 0) {
     ::close(listen_fd_);
     listen_fd_ = -1;
   }
-}
-
-void Server::Drain() {
-  if (drained_.exchange(true)) return;
+  if (http_fd_ >= 0) {
+    ::close(http_fd_);
+    http_fd_ = -1;
+  }
   for (auto& l : loops_) l->PostDrain();
   for (auto& l : loops_) l->Join();
   loops_.clear();

@@ -55,7 +55,7 @@ namespace net {
 struct Stats {
   Counter conns_accepted, conns_shed, handshake_fails,
       handshake_timeouts, idle_closes, epoll_wakeups,
-      partial_write_flushes;
+      partial_write_flushes, http_reqs;
   std::atomic<int64_t> active_conns{0};
 
   void Reset() {
@@ -66,6 +66,7 @@ struct Stats {
     idle_closes.Reset();
     epoll_wakeups.Reset();
     partial_write_flushes.Reset();
+    http_reqs.Reset();
     // active_conns is a live gauge, not a counter: reset must not
     // forget currently-open connections
   }
@@ -89,14 +90,21 @@ struct Options {
   // epoll-core replacement for the old SO_SNDTIMEO conn-break) —
   // past the cap the connection is closed.
   size_t max_out_bytes = 64u << 20;
+  // Second protocol: a minimal HTTP/1.1 GET responder (telemetry:
+  // /metrics, /healthz, /statsz, /tracez) served by the SAME event
+  // threads from a second listen socket (the acceptor thread polls
+  // both — no new threads). -1 disables; 0 picks a free port. The
+  // HTTP listener keeps accepting through StopAccepting() (health
+  // probes must reach a draining server) and closes at Drain().
+  int http_port = -1;
 };
 
 // Apply the PTPU_NET_* env knobs on top of `base` (both servers call
 // this so one tuning story covers them): PTPU_NET_THREADS,
 // PTPU_NET_MAX_CONNS, PTPU_NET_HANDSHAKE_US, PTPU_NET_IDLE_US,
 // PTPU_NET_SOCKBUF, PTPU_NET_MAX_OUT (the per-connection queued-reply
-// byte cap that cuts slow readers). Unset/invalid vars keep the base
-// value.
+// byte cap that cuts slow readers), PTPU_NET_HTTP (telemetry HTTP
+// port: -1 off, 0 free pick). Unset/invalid vars keep the base value.
 Options OptionsFromEnv(Options base);
 
 // Frame-handler verdict for one dispatched frame.
@@ -116,9 +124,16 @@ class Conn : public std::enable_shared_from_this<Conn> {
   // Queue one frame for sending: buf = [4 reserved bytes][payload];
   // the u32-LE length prefix is written here. Thread-safe. Returns
   // false once the connection is closed (the buffer is dropped).
-  bool SendPayload(std::vector<uint8_t>&& buf);
+  // `trace_id` nonzero records a net.flush span (queue time -> last
+  // byte written) with `trace_arg` into the shared ptpu_trace ring
+  // when the buffer fully drains.
+  bool SendPayload(std::vector<uint8_t>&& buf, uint64_t trace_id = 0,
+                   uint64_t trace_arg = 0);
   // Convenience copy form for small frames (errors, acks, meta).
   bool SendCopy(const uint8_t* payload, size_t n);
+  // Verbatim bytes, NO u32 length prefix (HTTP responses). Same
+  // queue/flush/backpressure path as SendPayload. Thread-safe.
+  bool SendRaw(std::vector<uint8_t>&& buf);
   // Pooled reply buffer (size 0, capacity reused across frames on
   // this conn — steady-state replies never reallocate). Thread-safe.
   std::vector<uint8_t> AcquireBuf();
@@ -128,6 +143,15 @@ class Conn : public std::enable_shared_from_this<Conn> {
   // (0 on first dispatch) — handlers budget their kDefer retries
   // against this. Owner-loop only (valid inside the frame handler).
   int64_t deferred_us() const;
+
+  // Stable per-connection id (monotonic across the process), stamped
+  // at accept — the `conn` field of every trace span. Thread-safe.
+  uint64_t id() const { return id_; }
+
+  // When the currently-dispatched frame's first bytes were read off
+  // the socket (steady-clock us) — the net.read span's begin stamp.
+  // Owner-loop only (valid inside the frame handler); 0 if unknown.
+  int64_t frame_recv_us() const { return frame_t0_; }
 
   // Count of requests this connection has in flight OUTSIDE the net
   // core (e.g. queued in the serving micro-batcher): while nonzero
@@ -146,10 +170,20 @@ class Conn : public std::enable_shared_from_this<Conn> {
   friend class EventLoop;
   friend class Server;
 
+  // shared enqueue/backpressure/flush-post body of SendPayload/SendRaw
+  bool EnqueueOut(std::vector<uint8_t>&& buf, uint64_t trace_id,
+                  uint64_t trace_arg);
+
   struct OutBuf {
     std::vector<uint8_t> b;
     size_t off = 0;
+    uint64_t trace_id = 0, trace_arg = 0;  // net.flush span (if traced)
+    int64_t t_queued = 0;
   };
+
+  // ---- accept-time constants (never change after adoption) ----
+  uint64_t id_ = 0;     // process-wide monotonic connection id
+  bool http_ = false;   // second protocol: HTTP/1.1 GET telemetry
 
   // ---- owner-loop state (never touched by other threads) ----
   int fd_ = -1;
@@ -159,8 +193,10 @@ class Conn : public std::enable_shared_from_this<Conn> {
   uint8_t nonce_[16] = {0};
   std::vector<uint8_t> in_;
   size_t in_head_ = 0, in_tail_ = 0;
+  int64_t frame_t0_ = 0;  // first bytes of the pending frame read at
   bool want_write_ = false;     // EPOLLOUT armed
   bool read_paused_ = false;    // EPOLLIN disarmed (kDefer)
+  bool http_close_ = false;     // close once the response flushes
   int64_t handshake_deadline_ = 0;
   int64_t idle_deadline_ = 0;   // 0 = none
   int64_t defer_since_ = 0;     // 0 = not deferring
@@ -179,6 +215,23 @@ class Conn : public std::enable_shared_from_this<Conn> {
 
 using ConnPtr = std::shared_ptr<Conn>;
 
+// One telemetry HTTP response (GET only; built inline on the event
+// thread, so handlers must not block).
+struct HttpReply {
+  int status = 200;
+  std::string content_type = "text/plain; charset=utf-8";
+  std::string body;
+};
+
+// The shared telemetry routes both servers mount on their second
+// (HTTP) listener: /healthz (503 {"status":"draining"} when
+// `draining`), /statsz (stats_json()), /metrics (the C Prometheus
+// renderer over the same snapshot, family prefix `prom_prefix`), and
+// /tracez?n=K (the shared ptpu_trace ring). Anything else is 404.
+HttpReply TelemetryHttp(const std::string& target,
+                        const std::function<std::string()>& stats_json,
+                        const std::string& prom_prefix, bool draining);
+
 struct Callbacks {
   // Handshake completed; runs on the owner loop. Optional.
   std::function<void(const ConnPtr&)> on_open;
@@ -192,6 +245,10 @@ struct Callbacks {
   // A frame length above max_frame arrived (the conn is closed right
   // after) — servers count their proto_errors here. Optional.
   std::function<void(const ConnPtr&)> on_oversize;
+  // One HTTP GET on the telemetry listener (path includes the query
+  // string). Runs on the owner loop; must not block. Required when
+  // Options::http_port >= 0.
+  std::function<HttpReply(const std::string& path)> on_http;
 };
 
 class Server {
@@ -203,13 +260,17 @@ class Server {
   // false with *err set on failure (nothing keeps running).
   bool Start(std::string* err);
   int port() const { return port_; }
+  // Telemetry HTTP port (-1 when disabled).
+  int http_port() const { return http_port_; }
 
   // Graceful stop, in two callable halves so servers can quiesce
   // their own pipelines in between (serving: stop accepting, drain
   // the micro-batcher so in-flight requests still answer, THEN flush
-  // + close): StopAccepting() wakes and joins the acceptor;
-  // Drain() flushes every conn's queued replies (bounded by
-  // drain_timeout_us), closes, and joins the event threads.
+  // + close): StopAccepting() stops the FRAMED listener (the HTTP
+  // telemetry listener keeps answering health probes during the
+  // quiesce window); Drain() closes both listeners, flushes every
+  // conn's queued replies (bounded by drain_timeout_us), closes, and
+  // joins the event threads.
   void StopAccepting();
   void Drain();
   void Stop();  // StopAccepting(); Drain();
@@ -218,13 +279,19 @@ class Server {
   friend class EventLoop;
 
   void AcceptLoop();
+  // Accept + configure one connection off `lfd`; returns false when
+  // the listener is dead (shutdown or fatal errno).
+  bool AcceptOne(int lfd, bool http);
 
   Options opt_;
   Callbacks cbs_;
   Stats* stats_;
   int listen_fd_ = -1;
+  int http_fd_ = -1;
   int port_ = 0;
+  int http_port_ = -1;
   std::atomic<bool> stop_accept_{false};
+  std::atomic<bool> stop_http_{false};
   std::atomic<bool> drained_{false};
   std::thread accept_thread_;
   std::vector<std::unique_ptr<EventLoop>> loops_;

@@ -13,6 +13,7 @@
 // tiny socket buffer, and graceful-drain ordering (queued reply is
 // flushed before the close).
 #include "ptpu_net.cc"
+#include "ptpu_trace.cc"
 
 // asserts ARE the test — never compile them out
 #undef NDEBUG

@@ -3,6 +3,7 @@
 // asserts, exit 0 = pass; wrapped by tests/test_native_selftest.py via
 // `make selftest`).
 #include "ptpu_net.cc"
+#include "ptpu_trace.cc"
 #include "ptpu_ps_server.cc"
 #include "ptpu_ps_table.cc"
 

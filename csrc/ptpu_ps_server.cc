@@ -32,6 +32,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cstdlib>
 #include <cstdint>
 #include <cstring>
 #include <map>
@@ -43,6 +44,7 @@
 #include "ptpu_net.h"
 #include "ptpu_ps_table.h"
 #include "ptpu_stats.h"
+#include "ptpu_trace.h"
 #include "ptpu_wire.h"
 
 namespace {
@@ -52,6 +54,10 @@ namespace {
 // ---------------------------------------------------------------------------
 
 constexpr uint8_t kWireVersion = 1;
+// Traced frames (ISSUE 10): [ver=2][tag][u64 trace id] then the v1
+// body; replies to a traced request echo the same extension. Old v1
+// clients are untouched. Python twin: wire.py WIRE_VERSION_TRACED.
+constexpr uint8_t kWireVersionTraced = 2;
 constexpr uint8_t kTagPullReq = 0x50;
 constexpr uint8_t kTagPullRep = 0x51;
 constexpr uint8_t kTagPushReq = 0x52;
@@ -120,12 +126,14 @@ struct PsServer {
 
   ~PsServer() { Stop(); }
 
-  bool Start(int want_port, int loopback_only, std::string *err) {
+  bool Start(int want_port, int loopback_only, int http_port,
+             std::string *err) {
     ptpu::net::Options opt;
     opt.port = want_port;
     opt.loopback_only = loopback_only != 0;
     opt.authkey = authkey;
     opt.max_frame = kMaxFrame;
+    opt.http_port = http_port;
     opt = ptpu::net::OptionsFromEnv(opt);
     ptpu::net::Callbacks cbs;
     cbs.on_frame = [this](const ptpu::net::ConnPtr &c,
@@ -135,6 +143,9 @@ struct PsServer {
     cbs.on_oversize = [this](const ptpu::net::ConnPtr &) {
       stats.proto_errors.Add(1);
     };
+    cbs.on_http = [this](const std::string &target) {
+      return HandleHttp(target);
+    };
     net_srv.reset(new ptpu::net::Server(opt, std::move(cbs), &net));
     if (!net_srv->Start(err)) {
       net_srv.reset();
@@ -143,6 +154,17 @@ struct PsServer {
     port = net_srv->port();
     return true;
   }
+
+  // Telemetry endpoints, served inline on the event threads from the
+  // second (HTTP) listener: the brpc /vars-/rpcz-style surface
+  // (shared routes — csrc/ptpu_net.cc TelemetryHttp).
+  ptpu::net::HttpReply HandleHttp(const std::string &target) {
+    return ptpu::net::TelemetryHttp(
+        target, [this] { return StatsJson(); }, "ptpu_ps",
+        /*draining=*/false);
+  }
+
+  std::string StatsJson();
 
   void Stop() {
     if (!net_srv) return;
@@ -166,6 +188,9 @@ struct PsServer {
   // One complete framed request, dispatched inline on an event
   // thread. kClose on protocol violations (the old loop hung up the
   // same way); application errors answer ERR frames and keep going.
+  // v2 frames carry [u64 trace id] between [ver][tag] and the v1
+  // body; REP/OK replies to a traced request echo it (ERR frames stay
+  // v1 — error paths are never latency-traced).
   ptpu::net::FrameResult OnFrame(const ptpu::net::ConnPtr &conn,
                                  const uint8_t *req, uint32_t n) {
     using ptpu::net::FrameResult;
@@ -176,16 +201,29 @@ struct PsServer {
     if (n < 2) return proto_err();
     const int64_t t0 = ptpu::NowUs();
     stats.bytes_in.Add(4 + uint64_t(n));
-    if (req[0] != kWireVersion) return proto_err();
+    uint64_t wire_tid = 0;
+    uint32_t ext = 0;
+    if (req[0] == kWireVersionTraced) {
+      if (n < 2 + ptpu::trace::kTraceExt) return proto_err();
+      wire_tid = ptpu::GetU64(req + 2);  // trace id at payload +2
+      ext = ptpu::trace::kTraceExt;
+    } else if (req[0] != kWireVersion) {
+      return proto_err();
+    }
     const uint8_t tag = req[1];
     if (tag != kTagPullReq && tag != kTagPushReq) return proto_err();
+    // sampling decision (one relaxed load when tracing is off); a
+    // client-sent trace id is always traced while tracing is on
+    const uint64_t tid = ptpu::trace::Global().BeginRequest(wire_tid);
+    const int64_t t_read =
+        conn->frame_recv_us() > 0 ? conn->frame_recv_us() : t0;
     // [u8 tlen][table]
-    if (n < 3) return proto_err();
-    const uint8_t tlen = req[2];
-    size_t off = 3 + tlen;
+    if (n < 3 + ext) return proto_err();
+    const uint8_t tlen = req[2 + ext];
+    size_t off = 3 + ext + tlen;
     if (n < off) return proto_err();
-    const std::string table(reinterpret_cast<const char *>(req + 3),
-                            tlen);
+    const std::string table(
+        reinterpret_cast<const char *>(req + 3 + ext), tlen);
     ShardEntry entry;
     {
       std::lock_guard<std::mutex> g(mu);
@@ -225,19 +263,22 @@ struct PsServer {
       // per-connection buffer, queued for one writev flush. (A
       // row-pointer writev was tried first — 512 iovecs of 256B cost
       // more in per-segment kernel overhead than the one 131KB
-      // gather memcpy saves.)
+      // gather memcpy saves.) A traced request's reply echoes the
+      // trace id: header grows by ho == kTraceExt bytes after the tag.
+      const size_t ho = wire_tid ? size_t(ptpu::trace::kTraceExt) : 0;
       std::vector<uint8_t> rep = conn->AcquireBuf();
-      rep.resize(14 + body);
-      ptpu::PutU32(rep.data(), uint32_t(10 + body));
-      const uint32_t flen = uint32_t(10 + body);
-      rep[4] = kWireVersion;
+      rep.resize(14 + ho + body);
+      ptpu::PutU32(rep.data(), uint32_t(10 + ho + body));
+      const uint32_t flen = uint32_t(10 + ho + body);
+      rep[4] = wire_tid ? kWireVersionTraced : kWireVersion;
       rep[5] = kTagPullRep;
-      ptpu::PutU32(rep.data() + 6, cnt);
-      ptpu::PutU32(rep.data() + 10, uint32_t(dim));
+      if (wire_tid) ptpu::PutU64(rep.data() + 6, wire_tid);
+      ptpu::PutU32(rep.data() + 6 + ho, cnt);
+      ptpu::PutU32(rep.data() + 10 + ho, uint32_t(dim));
       const float *w = ptpu_ps_table_data(entry.table);
       // gather straight into the reply as BYTES: the f32 rows start
-      // at +14, which is not 4-aligned, so a float* view would be UB
-      uint8_t *out = rep.data() + 14;
+      // at +14(+ho), which is not 4-aligned, so a float* view is UB
+      uint8_t *out = rep.data() + 14 + ho;
       bool bad = false;
       ptpu_ps_table_rdlock(entry.table);
       for (uint32_t i = 0; i < cnt; ++i) {
@@ -254,15 +295,29 @@ struct PsServer {
           return FrameResult::kClose;
         return FrameResult::kOk;
       }
-      if (!conn->SendPayload(std::move(rep))) return FrameResult::kClose;
+      if (!conn->SendPayload(std::move(rep), tid, cnt))
+        return FrameResult::kClose;
       ptpu_ps_table_note_pull(entry.table, int64_t(cnt));
       stats.pull_ops.Add(1);
       stats.pull_rows.Add(cnt);
       stats.bytes_out.Add(4 + uint64_t(flen));
-      stats.pull_us.Observe(uint64_t(ptpu::NowUs() - t0));
+      const int64_t t1 = ptpu::NowUs();
+      stats.pull_us.Observe(uint64_t(t1 - t0));
       entry.wire->pull_ops.Add(1);
       entry.wire->pull_rows.Add(cnt);
       entry.wire->bytes_out.Add(4 + uint64_t(flen));
+      if (tid) {  // lifecycle spans: frame read -> gather+reply queued
+        auto &tr = ptpu::trace::Global();
+        tr.Record(tid, ptpu::trace::kRead, t_read, t0, conn->id(), cnt);
+        tr.Record(tid, ptpu::trace::kPull, t0, t1, conn->id(), cnt);
+      }
+      if (ptpu::trace::Global().SlowEligible(t1 - t_read)) {
+        const ptpu::trace::SpanRec sp[2] = {
+            {ptpu::trace::kRead, t_read, t0},
+            {ptpu::trace::kPull, t0, t1}};
+        ptpu::trace::Global().RecordSlow(tid, conn->id(), cnt,
+                                         t1 - t_read, sp, 2);
+      }
       return FrameResult::kOk;
     }
     // [u8 flags][u32 n][u32 dim][ids][grads]
@@ -280,17 +335,33 @@ struct PsServer {
       stats.push_ops.Add(1);
       stats.push_rows.Add(rows);
       stats.bytes_out.Add(6);  // 4B length + OK frame
-      stats.push_us.Observe(uint64_t(ptpu::NowUs() - t0));
+      const int64_t t1 = ptpu::NowUs();
+      stats.push_us.Observe(uint64_t(t1 - t0));
       entry.wire->push_ops.Add(1);
       entry.wire->push_rows.Add(rows);
       entry.wire->bytes_out.Add(6);
+      if (tid) {
+        auto &tr = ptpu::trace::Global();
+        tr.Record(tid, ptpu::trace::kRead, t_read, t0, conn->id(),
+                  rows);
+        tr.Record(tid, ptpu::trace::kPush, t0, t1, conn->id(), rows);
+      }
+      if (ptpu::trace::Global().SlowEligible(t1 - t_read)) {
+        const ptpu::trace::SpanRec sp[2] = {
+            {ptpu::trace::kRead, t_read, t0},
+            {ptpu::trace::kPush, t0, t1}};
+        ptpu::trace::Global().RecordSlow(tid, conn->id(), rows,
+                                         t1 - t_read, sp, 2);
+      }
     };
     const auto send_ok = [&]() {
+      const size_t ho = wire_tid ? size_t(ptpu::trace::kTraceExt) : 0;
       std::vector<uint8_t> rep = conn->AcquireBuf();
-      rep.resize(6);
-      rep[4] = kWireVersion;
+      rep.resize(6 + ho);
+      rep[4] = wire_tid ? kWireVersionTraced : kWireVersion;
       rep[5] = kTagOk;
-      return conn->SendPayload(std::move(rep));
+      if (wire_tid) ptpu::PutU64(rep.data() + 6, wire_tid);
+      return conn->SendPayload(std::move(rep), tid, 0);
     };
     if (cnt == 0) {  // empty push (dim underivable): trivially ok
       if (!send_ok()) return FrameResult::kClose;
@@ -328,6 +399,66 @@ struct PsServer {
   }
 };
 
+std::string PsServer::StatsJson() {
+  std::string out = "{\"server\":{";
+  const ServerStats &st = stats;
+  const ptpu::net::Stats &nt = net;
+  const struct { const char *name; const ptpu::Counter *c; } cs[] = {
+      {"pull_ops", &st.pull_ops},       {"pull_rows", &st.pull_rows},
+      {"push_ops", &st.push_ops},       {"push_rows", &st.push_rows},
+      {"bytes_in", &st.bytes_in},       {"bytes_out", &st.bytes_out},
+      {"err_frames", &st.err_frames},   {"proto_errors", &st.proto_errors},
+      {"handshake_fails", &nt.handshake_fails},
+      {"conns_accepted", &nt.conns_accepted},
+      {"conns_shed", &nt.conns_shed},
+      {"handshake_timeouts", &nt.handshake_timeouts},
+      {"idle_closes", &nt.idle_closes},
+      {"epoll_wakeups", &nt.epoll_wakeups},
+      {"partial_write_flushes", &nt.partial_write_flushes},
+      {"http_reqs", &nt.http_reqs},
+  };
+  for (const auto &kv : cs) {
+    ptpu::AppendJsonU64(&out, kv.name, kv.c->Get());
+    out += ',';
+  }
+  ptpu::AppendJsonU64(&out, "conns_active",
+                      uint64_t(nt.active_conns.load(
+                          std::memory_order_relaxed)));
+  out += ',';
+  ptpu::AppendJsonHist(&out, "pull_us", st.pull_us);
+  out += ',';
+  ptpu::AppendJsonHist(&out, "push_us", st.push_us);
+  out += "},\"tables\":{";
+  {
+    std::lock_guard<std::mutex> g(mu);
+    bool first = true;
+    for (const auto &kv : tables) {
+      if (!first) out += ',';
+      first = false;
+      out += '"';
+      out += ptpu::JsonEscape(kv.first);
+      out += "\":{\"wire\":{";
+      const TableWireStats &w = *kv.second.wire;
+      const struct { const char *name; const ptpu::Counter *c; } ws[] = {
+          {"pull_ops", &w.pull_ops},   {"pull_rows", &w.pull_rows},
+          {"push_ops", &w.push_ops},   {"push_rows", &w.push_rows},
+          {"bytes_in", &w.bytes_in},   {"bytes_out", &w.bytes_out},
+      };
+      bool wfirst = true;
+      for (const auto &c : ws) {
+        if (!wfirst) out += ',';
+        wfirst = false;
+        ptpu::AppendJsonU64(&out, c.name, c.c->Get());
+      }
+      out += "},\"table\":";
+      out += ptpu_ps_table_stats_json(kv.second.table);
+      out += '}';
+    }
+  }
+  out += "}}";
+  return out;
+}
+
 thread_local std::string g_srv_error;
 
 }  // namespace
@@ -335,6 +466,12 @@ thread_local std::string g_srv_error;
 PTPU_PS_EXPORT const char *ptpu_ps_server_last_error(void) {
   return g_srv_error.c_str();
 }
+
+PTPU_PS_EXPORT void *ptpu_ps_server_start2(int port,
+                                           const char *authkey,
+                                           int authkey_len,
+                                           int loopback_only,
+                                           int http_port);
 
 // Start the data-plane server on `port` (0 picks a free one;
 // ptpu_ps_server_port reports it). `loopback_only` nonzero binds
@@ -344,11 +481,24 @@ PTPU_PS_EXPORT const char *ptpu_ps_server_last_error(void) {
 PTPU_PS_EXPORT void *ptpu_ps_server_start(int port, const char *authkey,
                                           int authkey_len,
                                           int loopback_only) {
+  return ptpu_ps_server_start2(port, authkey, authkey_len,
+                               loopback_only, -1);
+}
+
+// Extended start (ISSUE 10): http_port >= 0 adds the telemetry
+// HTTP/1.1 listener (0 picks a free port; ptpu_ps_server_http_port
+// reports it) served by the same epoll event threads. The
+// PTPU_NET_HTTP env knob overrides either form.
+PTPU_PS_EXPORT void *ptpu_ps_server_start2(int port,
+                                           const char *authkey,
+                                           int authkey_len,
+                                           int loopback_only,
+                                           int http_port) {
   auto *s = new PsServer();
   if (authkey && authkey_len > 0)
     s->authkey.assign(authkey, size_t(authkey_len));
   std::string err;
-  if (!s->Start(port, loopback_only, &err)) {
+  if (!s->Start(port, loopback_only, http_port, &err)) {
     g_srv_error = "ptpu_ps_server_start: " + err;
     delete s;
     return nullptr;
@@ -389,63 +539,28 @@ PTPU_PS_EXPORT const char *ptpu_ps_server_stats_json(void *h) {
   thread_local std::string g_json;
   auto *s = static_cast<PsServer *>(h);
   if (!s) return "{}";
-  std::string out = "{\"server\":{";
-  const ServerStats &st = s->stats;
-  const ptpu::net::Stats &nt = s->net;
-  const struct { const char *name; const ptpu::Counter *c; } cs[] = {
-      {"pull_ops", &st.pull_ops},       {"pull_rows", &st.pull_rows},
-      {"push_ops", &st.push_ops},       {"push_rows", &st.push_rows},
-      {"bytes_in", &st.bytes_in},       {"bytes_out", &st.bytes_out},
-      {"err_frames", &st.err_frames},   {"proto_errors", &st.proto_errors},
-      {"handshake_fails", &nt.handshake_fails},
-      {"conns_accepted", &nt.conns_accepted},
-      {"conns_shed", &nt.conns_shed},
-      {"handshake_timeouts", &nt.handshake_timeouts},
-      {"idle_closes", &nt.idle_closes},
-      {"epoll_wakeups", &nt.epoll_wakeups},
-      {"partial_write_flushes", &nt.partial_write_flushes},
-  };
-  for (const auto &kv : cs) {
-    ptpu::AppendJsonU64(&out, kv.name, kv.c->Get());
-    out += ',';
-  }
-  ptpu::AppendJsonU64(&out, "conns_active",
-                      uint64_t(nt.active_conns.load(
-                          std::memory_order_relaxed)));
-  out += ',';
-  ptpu::AppendJsonHist(&out, "pull_us", st.pull_us);
-  out += ',';
-  ptpu::AppendJsonHist(&out, "push_us", st.push_us);
-  out += "},\"tables\":{";
-  {
-    std::lock_guard<std::mutex> g(s->mu);
-    bool first = true;
-    for (const auto &kv : s->tables) {
-      if (!first) out += ',';
-      first = false;
-      out += '"';
-      out += ptpu::JsonEscape(kv.first);
-      out += "\":{\"wire\":{";
-      const TableWireStats &w = *kv.second.wire;
-      const struct { const char *name; const ptpu::Counter *c; } ws[] = {
-          {"pull_ops", &w.pull_ops},   {"pull_rows", &w.pull_rows},
-          {"push_ops", &w.push_ops},   {"push_rows", &w.push_rows},
-          {"bytes_in", &w.bytes_in},   {"bytes_out", &w.bytes_out},
-      };
-      bool wfirst = true;
-      for (const auto &c : ws) {
-        if (!wfirst) out += ',';
-        wfirst = false;
-        ptpu::AppendJsonU64(&out, c.name, c.c->Get());
-      }
-      out += "},\"table\":";
-      out += ptpu_ps_table_stats_json(kv.second.table);
-      out += '}';
-    }
-  }
-  out += "}}";
-  g_json.swap(out);
+  g_json = s->StatsJson();
   return g_json.c_str();
+}
+
+// Prometheus exposition text of the live stats snapshot — the same
+// bytes GET /metrics serves (and byte-identical to profiler/stats.py
+// prometheus_text over the stats_json snapshot). Thread-local buffer,
+// valid until this thread's next call.
+PTPU_PS_EXPORT const char *ptpu_ps_server_prom_text(void *h) {
+  thread_local std::string g_prom;
+  auto *s = static_cast<PsServer *>(h);
+  if (!s) return "";
+  g_prom = ptpu::trace::PromFromStatsJson(s->StatsJson(), "ptpu_ps");
+  return g_prom.c_str();
+}
+
+// Telemetry HTTP port (GET /metrics /healthz /statsz /tracez), or -1
+// when the endpoint is disabled.
+PTPU_PS_EXPORT int ptpu_ps_server_http_port(void *h) {
+  auto *s = static_cast<PsServer *>(h);
+  if (!s || !s->net_srv) return -1;
+  return s->net_srv->http_port();
 }
 
 // Reset wire counters (global + net-core + per-table) AND the storage

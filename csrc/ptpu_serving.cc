@@ -57,6 +57,7 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <cstdio>
 #include <cstring>
 #include <deque>
@@ -73,11 +74,17 @@
 #include "ptpu_net.h"
 #include "ptpu_stats.h"
 #include "ptpu_sync.h"
+#include "ptpu_trace.h"
 #include "ptpu_wire.h"
 
 namespace {
 
 constexpr uint8_t kSvWireVersion = 1;
+// Traced frames (ISSUE 10): [ver=2][tag][u64 trace id] then the v1
+// body; REP frames for a traced request echo the same extension (ERR
+// frames stay v1). Old v1 clients are untouched. Python twin:
+// inference/serving.py WIRE_VERSION_TRACED.
+constexpr uint8_t kSvWireVersionTraced = 2;
 constexpr uint8_t kTagInferReq = 0x60;
 constexpr uint8_t kTagInferRep = 0x61;
 constexpr uint8_t kTagInferErr = 0x62;
@@ -135,6 +142,11 @@ struct SvRequest {
   bool is_decode = false;
   uint64_t session = 0;
   int64_t token = 0;
+  // ---- request tracing (ptpu_trace) ----
+  uint64_t wire_tid = 0;   // client-sent trace id (echoed in replies)
+  uint64_t trace_id = 0;   // effective id (0 = spans not recorded)
+  int64_t t_read_us = 0;   // frame bytes first read off the socket
+  int64_t t_deq_us = 0;    // popped from the batcher queue
 };
 
 // Always-on counters/histograms (csrc/ptpu_stats.h relaxed atomics).
@@ -390,6 +402,12 @@ struct SvServer {
   ptpu::net::Stats net;
   std::unique_ptr<ptpu::net::Server> net_srv;
   std::atomic<bool> stop{false};
+  // two-phase shutdown: drain_begin() stops the framed listener and
+  // flips /healthz to 503 "draining" while in-flight + existing-conn
+  // requests still answer; Stop() completes the teardown
+  std::atomic<bool> draining{false};
+  int http_port_want = -1;       // start3 http_port (env can override)
+  std::atomic<uint64_t> batch_seq{0};  // trace arg of batch-side spans
 
   ~SvServer() { Stop(); }
 
@@ -545,6 +563,7 @@ struct SvServer {
     opt.loopback_only = loopback_only != 0;
     opt.authkey = authkey;
     opt.max_frame = kSvMaxFrame;
+    opt.http_port = http_port_want;
     opt = ptpu::net::OptionsFromEnv(opt);
     ptpu::net::Callbacks cbs;
     cbs.on_frame = [this](const ptpu::net::ConnPtr& c,
@@ -553,6 +572,9 @@ struct SvServer {
     };
     cbs.on_oversize = [this](const ptpu::net::ConnPtr&) {
       stats.proto_errors.Add(1);
+    };
+    cbs.on_http = [this](const std::string& target) {
+      return HandleHttp(target);
     };
     // conn->user stashes a parsed-but-unqueued SvRequest across defer
     // retries (see OnFrame); free it if the conn dies mid-defer. A
@@ -669,7 +691,40 @@ struct SvServer {
     meta_json = std::move(out);
   }
 
+  // ---------------------------------------------------- telemetry
+  // HTTP endpoints on the second listener (same event threads): the
+  // serving control plane's health/metrics/trace surface (shared
+  // routes — csrc/ptpu_net.cc TelemetryHttp).
+  ptpu::net::HttpReply HandleHttp(const std::string& target) {
+    return ptpu::net::TelemetryHttp(
+        target, [this] { return StatsJson(); }, "ptpu_serving",
+        draining.load(std::memory_order_relaxed) ||
+            stop.load(std::memory_order_relaxed));
+  }
+
+  // Stop the framed listener + flip /healthz to "draining" (the
+  // take-me-out-of-the-LB half of a zero-downtime restart): existing
+  // connections and everything queued still answer; Stop() finishes.
+  void DrainBegin() {
+    if (draining.exchange(true)) return;
+    if (net_srv) net_srv->StopAccepting();
+  }
+
   // ------------------------------------------------------ batch run
+  // Reply-frame header after the 4-byte length slot: [ver][tag], plus
+  // the echoed trace id for a traced (v2) request. Returns the offset
+  // where the v1 body begins.
+  static size_t RepHdr(std::vector<uint8_t>& f, uint8_t tag,
+                       uint64_t echo_tid) {
+    f[4] = echo_tid ? kSvWireVersionTraced : kSvWireVersion;
+    f[5] = tag;
+    if (echo_tid) {
+      ptpu::PutU64(f.data() + 6, echo_tid);
+      return 6 + ptpu::trace::kTraceExt;
+    }
+    return 6;
+  }
+
   void SendErrFrame(const ptpu::net::ConnPtr& conn, uint64_t id,
                     const std::string& msg) {
     std::vector<uint8_t> f = conn->AcquireBuf();
@@ -687,6 +742,11 @@ struct SvServer {
 
   void RunBatch(int instance, std::vector<SvRequest>& batch) {
     SvInstance& inst = *insts[size_t(instance)];
+    // trace stamps: queue wait ended here; batch id keys the shared
+    // batch-side spans of every co-batched request
+    const int64_t t_deq = ptpu::NowUs();
+    const uint64_t bid =
+        batch_seq.fetch_add(1, std::memory_order_relaxed) + 1;
     int64_t rows = 0;
     for (const auto& r : batch) rows += r.rows;
     // smallest bucket that fits; pad rows up to it (zero rows — their
@@ -747,7 +807,8 @@ struct SvServer {
     const int64_t t0 = ptpu::NowUs();
     if (ptpu_predictor_run(p, err, sizeof(err)) != 0)
       return fail_all(std::string("run: ") + err);
-    stats.run_us.Observe(uint64_t(ptpu::NowUs() - t0));
+    const int64_t t1 = ptpu::NowUs();
+    stats.run_us.Observe(uint64_t(t1 - t0));
 
     // de-mux row-wise, FIFO: request k gets rows [row_off, row_off +
     // rows_k) of every output
@@ -773,19 +834,19 @@ struct SvServer {
 
     int64_t row_off = 0;
     for (auto& r : batch) {
-      // frame: [len][ver][tag][id][u16 n_outputs] + outputs
-      size_t fsz = 4 + 2 + 8 + 2;
+      // frame: [len][ver][tag](+trace id echo)[id][u16 n_outputs]
+      // + outputs
+      size_t fsz = 4 + 2 + (r.wire_tid ? 8 : 0) + 8 + 2;
       for (const auto& v : outs)
         fsz += 1 + v.dims.size() * 8 +
                size_t(r.rows) * size_t(v.row_elems) * 4;
       std::vector<uint8_t> f = r.conn->AcquireBuf();
       f.resize(fsz);
-      f[4] = kSvWireVersion;
-      f[5] = kTagInferRep;
-      std::memcpy(f.data() + 6, &r.id, 8);
+      const size_t ho = RepHdr(f, kTagInferRep, r.wire_tid);
+      std::memcpy(f.data() + ho, &r.id, 8);
       const uint16_t no16 = uint16_t(n_outputs);
-      std::memcpy(f.data() + 14, &no16, 2);
-      size_t off = 16;
+      std::memcpy(f.data() + ho + 8, &no16, 2);
+      size_t off = ho + 10;
       for (const auto& v : outs) {
         f[off++] = uint8_t(v.dims.size());
         int64_t d0 = r.rows;
@@ -801,10 +862,34 @@ struct SvServer {
       }
       row_off += r.rows;
       const size_t sent = f.size();
-      if (r.conn->SendPayload(std::move(f))) {
+      if (r.conn->SendPayload(std::move(f), r.trace_id, r.id)) {
         stats.replies.Add(1);
         stats.bytes_out.Add(sent);
-        stats.e2e_us.Observe(uint64_t(ptpu::NowUs() - r.t_enq_us));
+        const int64_t t_rep = ptpu::NowUs();
+        stats.e2e_us.Observe(uint64_t(t_rep - r.t_enq_us));
+        if (r.trace_id) {
+          // the INFER lifecycle: read -> queue -> batch -> run (the
+          // net core adds net.flush when the reply hits the wire)
+          auto& tr = ptpu::trace::Global();
+          const uint64_t cid = r.conn->id();
+          tr.Record(r.trace_id, ptpu::trace::kRead, r.t_read_us,
+                    r.t_enq_us, cid, r.id);
+          tr.Record(r.trace_id, ptpu::trace::kQueue, r.t_enq_us, t_deq,
+                    cid, bid);
+          tr.Record(r.trace_id, ptpu::trace::kBatch, t_deq, t0, cid,
+                    bid);
+          tr.Record(r.trace_id, ptpu::trace::kRun, t0, t1, cid, bid);
+        }
+        if (ptpu::trace::Global().SlowEligible(t_rep - r.t_read_us)) {
+          const ptpu::trace::SpanRec sp[4] = {
+              {ptpu::trace::kRead, r.t_read_us, r.t_enq_us},
+              {ptpu::trace::kQueue, r.t_enq_us, t_deq},
+              {ptpu::trace::kBatch, t_deq, t0},
+              {ptpu::trace::kRun, t0, t1}};
+          ptpu::trace::Global().RecordSlow(r.trace_id, r.conn->id(),
+                                           r.id, t_rep - r.t_read_us,
+                                           sp, 4);
+        }
       }
       r.conn->NotePending(-1);  // pairs the enqueue-time +1
     }
@@ -912,6 +997,8 @@ struct SvServer {
    * (a pipelining client); a session's steps are ordered, so the
    * batch splits into FIFO-prefix sub-runs with unique sessions. */
   void RunDecode(std::vector<SvRequest>& batch) {
+    const int64_t t_deq = ptpu::NowUs();
+    for (auto& r : batch) r.t_deq_us = t_deq;
     size_t i = 0;
     while (i < batch.size()) {
       std::vector<SvRequest*> run;
@@ -928,22 +1015,48 @@ struct SvServer {
   }
 
   // reply with row `row` of the just-run decode outputs (kv_mu_ held:
-  // the next run overwrites the predictor's output block)
-  void DecodeReply(SvRequest* r, const float* lg, int64_t row) {
+  // the next run overwrites the predictor's output block). run0/run1
+  // bracket the ptpu_predictor_decode_step that produced the row (the
+  // per-step decode.step trace span, keyed by session).
+  void DecodeReply(SvRequest* r, const float* lg, int64_t row,
+                   int64_t run0, int64_t run1) {
     std::vector<uint8_t> f = r->conn->AcquireBuf();
-    f.resize(4 + 2 + 8 + 8 + 4 + size_t(dec_logit_elems) * 4);
-    f[4] = kSvWireVersion;
-    f[5] = kTagDecodeRep;
-    ptpu::PutU64(f.data() + 6, r->id);
-    ptpu::PutU64(f.data() + 14, r->session);
-    PutU32(f.data() + 22, uint32_t(dec_logit_elems));
-    std::memcpy(f.data() + 26, lg + row * dec_logit_elems,
+    f.resize(4 + 2 + (r->wire_tid ? 8 : 0) + 8 + 8 + 4 +
+             size_t(dec_logit_elems) * 4);
+    const size_t ho = RepHdr(f, kTagDecodeRep, r->wire_tid);
+    ptpu::PutU64(f.data() + ho, r->id);
+    ptpu::PutU64(f.data() + ho + 8, r->session);
+    PutU32(f.data() + ho + 16, uint32_t(dec_logit_elems));
+    std::memcpy(f.data() + ho + 20, lg + row * dec_logit_elems,
                 size_t(dec_logit_elems) * 4);
     const size_t sent = f.size();
-    if (r->conn->SendPayload(std::move(f))) {
+    if (r->conn->SendPayload(std::move(f), r->trace_id, r->session)) {
       dstats.replies.Add(1);
       stats.bytes_out.Add(sent);
-      stats.e2e_us.Observe(uint64_t(ptpu::NowUs() - r->t_enq_us));
+      const int64_t t_rep = ptpu::NowUs();
+      stats.e2e_us.Observe(uint64_t(t_rep - r->t_enq_us));
+      if (r->trace_id) {
+        auto& tr = ptpu::trace::Global();
+        const uint64_t cid = r->conn->id();
+        tr.Record(r->trace_id, ptpu::trace::kRead, r->t_read_us,
+                  r->t_enq_us, cid, r->id);
+        tr.Record(r->trace_id, ptpu::trace::kQueue, r->t_enq_us,
+                  r->t_deq_us, cid, r->session);
+        tr.Record(r->trace_id, ptpu::trace::kBatch, r->t_deq_us, run0,
+                  cid, r->session);
+        tr.Record(r->trace_id, ptpu::trace::kDecode, run0, run1, cid,
+                  r->session);
+      }
+      if (ptpu::trace::Global().SlowEligible(t_rep - r->t_read_us)) {
+        const ptpu::trace::SpanRec sp[4] = {
+            {ptpu::trace::kRead, r->t_read_us, r->t_enq_us},
+            {ptpu::trace::kQueue, r->t_enq_us, r->t_deq_us},
+            {ptpu::trace::kBatch, r->t_deq_us, run0},
+            {ptpu::trace::kDecode, run0, run1}};
+        ptpu::trace::Global().RecordSlow(r->trace_id, r->conn->id(),
+                                         r->id, t_rep - r->t_read_us,
+                                         sp, 4);
+      }
     }
     r->conn->NotePending(-1);
   }
@@ -988,6 +1101,7 @@ struct SvServer {
       for (size_t r2 = 0; r2 < live.size(); ++r2) {
         char rerr[512] = {0};
         const int64_t sid1[1] = {sids[r2]}, tok1[1] = {toks[r2]};
+        const int64_t rt0 = ptpu::NowUs();
         if (ptpu_predictor_decode_step(dec_pred, sid1, tok1, 1, rerr,
                                        sizeof(rerr)) != 0) {
           SendErrFrame(live[r2]->conn, live[r2]->id,
@@ -995,11 +1109,12 @@ struct SvServer {
           live[r2]->conn->NotePending(-1);
           continue;
         }
+        const int64_t rt1 = ptpu::NowUs();
         dstats.batches.Add(1);
         dstats.batch_fill.Observe(1);
         const float* lg1 = ptpu_predictor_output_data(dec_pred, 0);
         if (lg1) {
-          DecodeReply(live[r2], lg1, 0);
+          DecodeReply(live[r2], lg1, 0, rt0, rt1);
         } else {
           SendErrFrame(live[r2]->conn, live[r2]->id,
                        "decode: no logits output");
@@ -1008,7 +1123,8 @@ struct SvServer {
       }
       return;
     }
-    dstats.run_us.Observe(uint64_t(ptpu::NowUs() - t0));
+    const int64_t t1 = ptpu::NowUs();
+    dstats.run_us.Observe(uint64_t(t1 - t0));
     dstats.batches.Add(1);
     dstats.batch_fill.Observe(uint64_t(live.size()));
     const float* lg = ptpu_predictor_output_data(dec_pred, 0);
@@ -1020,7 +1136,7 @@ struct SvServer {
       return;
     }
     for (size_t r2 = 0; r2 < live.size(); ++r2)
-      DecodeReply(live[r2], lg, int64_t(r2));
+      DecodeReply(live[r2], lg, int64_t(r2), t0, t1);
   }
 
   // ------------------------------------------------------ wire loop
@@ -1061,30 +1177,43 @@ struct SvServer {
     };
     if (n < 2) return proto_err();
     if (!retry) stats.bytes_in.Add(4 + uint64_t(n));
-    if (req[0] != kSvWireVersion) return proto_err();
+    // v2 frames carry [u64 trace id] between [ver][tag] and the v1
+    // body; every body offset below shifts by ext
+    uint64_t wire_tid = 0;
+    uint32_t ext = 0;
+    if (req[0] == kSvWireVersionTraced) {
+      if (n < 2 + ptpu::trace::kTraceExt) return proto_err();
+      wire_tid = ptpu::GetU64(req + 2);  // trace id at payload +2
+      ext = ptpu::trace::kTraceExt;
+    } else if (req[0] != kSvWireVersion) {
+      return proto_err();
+    }
+    const int64_t t_read =
+        conn->frame_recv_us() > 0 ? conn->frame_recv_us()
+                                  : ptpu::NowUs();
     const uint8_t tag = req[1];
     if (tag == kTagMetaReq) {
       std::vector<uint8_t> f = conn->AcquireBuf();
-      f.resize(4 + 2 + 4 + meta_json.size());
-      f[4] = kSvWireVersion;
-      f[5] = kTagMetaRep;
-      PutU32(f.data() + 6, uint32_t(meta_json.size()));
-      std::memcpy(f.data() + 10, meta_json.data(), meta_json.size());
+      f.resize(4 + 2 + (wire_tid ? 8 : 0) + 4 + meta_json.size());
+      const size_t ho = RepHdr(f, kTagMetaRep, wire_tid);
+      PutU32(f.data() + ho, uint32_t(meta_json.size()));
+      std::memcpy(f.data() + ho + 4, meta_json.data(),
+                  meta_json.size());
       stats.bytes_out.Add(f.size());
       if (!conn->SendPayload(std::move(f))) return FrameResult::kClose;
       return FrameResult::kOk;
     }
     if (tag == kTagDecodeOpen || tag == kTagDecodeStep ||
         tag == kTagDecodeClose) {
-      if (n < 2 + 8) return proto_err();
-      const uint64_t rid = ptpu::GetU64(req + 2);
+      if (n < 2 + ext + 8) return proto_err();
+      const uint64_t rid = ptpu::GetU64(req + 2 + ext);
       if (!dec_pred) {
         SendErrFrame(conn, rid, "decode serving not configured (start "
                                 "the server with a decode_model)");
         return FrameResult::kOk;
       }
       if (tag == kTagDecodeOpen) {
-        if (n != 2 + 8) return proto_err();
+        if (n != 2 + ext + 8) return proto_err();
         uint64_t sess = 0;
         std::string why;
         if (!DecodeOpen(conn, &sess, &why)) {
@@ -1092,44 +1221,49 @@ struct SvServer {
           return FrameResult::kOk;
         }
         std::vector<uint8_t> f = conn->AcquireBuf();
-        f.resize(4 + 2 + 8 + 8);
-        f[4] = kSvWireVersion;
-        f[5] = kTagDecodeSess;
-        ptpu::PutU64(f.data() + 6, rid);
-        ptpu::PutU64(f.data() + 14, sess);
+        f.resize(4 + 2 + (wire_tid ? 8 : 0) + 8 + 8);
+        const size_t ho = RepHdr(f, kTagDecodeSess, wire_tid);
+        ptpu::PutU64(f.data() + ho, rid);
+        ptpu::PutU64(f.data() + ho + 8, sess);
         stats.bytes_out.Add(f.size());
         if (!conn->SendPayload(std::move(f)))
           return FrameResult::kClose;
         return FrameResult::kOk;
       }
       if (tag == kTagDecodeClose) {
-        if (n != 2 + 8 + 8) return proto_err();
-        const uint64_t sess = ptpu::GetU64(req + 10);
+        if (n != 2 + ext + 8 + 8) return proto_err();
+        const uint64_t sess = ptpu::GetU64(req + 10 + ext);
         std::string why;
         if (!DecodeClose(sess, &why)) {
           SendErrFrame(conn, rid, why);
           return FrameResult::kOk;
         }
         std::vector<uint8_t> f = conn->AcquireBuf();
-        f.resize(4 + 2 + 8 + 8);
-        f[4] = kSvWireVersion;
-        f[5] = kTagDecodeSess;
-        ptpu::PutU64(f.data() + 6, rid);
-        ptpu::PutU64(f.data() + 14, sess);
+        f.resize(4 + 2 + (wire_tid ? 8 : 0) + 8 + 8);
+        const size_t ho = RepHdr(f, kTagDecodeSess, wire_tid);
+        ptpu::PutU64(f.data() + ho, rid);
+        ptpu::PutU64(f.data() + ho + 8, sess);
         stats.bytes_out.Add(f.size());
         if (!conn->SendPayload(std::move(f)))
           return FrameResult::kClose;
         return FrameResult::kOk;
       }
       // DECODE_STEP: [ver][tag][u64 req_id][u64 session][i64 token]
-      if (n != 2 + 8 + 8 + 8) return proto_err();
+      if (n != 2 + ext + 8 + 8 + 8) return proto_err();
       SvRequest r;
       r.is_decode = true;
       r.id = rid;
-      r.session = ptpu::GetU64(req + 10);
-      r.token = ptpu::GetI64(req + 18);
+      r.session = ptpu::GetU64(req + 10 + ext);
+      r.token = ptpu::GetI64(req + 18 + ext);
       r.rows = 1;
       r.conn = conn;
+      r.wire_tid = wire_tid;
+      // a defer retry re-parses this 26/34-byte frame; only the FIRST
+      // attempt rolls the sampling dice (retries reuse the client id)
+      r.trace_id = retry && !wire_tid
+                       ? 0
+                       : ptpu::trace::Global().BeginRequest(wire_tid);
+      r.t_read_us = t_read;
       r.t_enq_us = ptpu::NowUs();
       if (!retry) dstats.steps.Add(1);
       std::string why;
@@ -1146,12 +1280,12 @@ struct SvServer {
     if (tag != kTagInferReq) return proto_err();
     // [u64 req_id][u16 n_inputs] per input:
     // [u8 dtype][u8 ndim][ndim x i64][raw]
-    if (n < 2 + 8 + 2) return proto_err();
+    if (n < 2 + ext + 8 + 2) return proto_err();
     SvRequest r;
-    std::memcpy(&r.id, req + 2, 8);
+    std::memcpy(&r.id, req + 2 + ext, 8);
     uint16_t nin;
-    std::memcpy(&nin, req + 10, 2);
-    size_t off = 12;
+    std::memcpy(&nin, req + 10 + ext, 2);
+    size_t off = 12 + ext;
     std::string bad;
     if (nin != sig.size())
       bad = "expected " + std::to_string(sig.size()) +
@@ -1212,6 +1346,9 @@ struct SvServer {
     }
     r.rows = rows;
     r.conn = conn;
+    r.wire_tid = wire_tid;
+    r.trace_id = ptpu::trace::Global().BeginRequest(wire_tid);
+    r.t_read_us = t_read;
     r.t_enq_us = ptpu::NowUs();
     std::string why;
     const uint64_t rid = r.id;
@@ -1289,6 +1426,7 @@ struct SvServer {
         {"idle_closes", &net.idle_closes},
         {"epoll_wakeups", &net.epoll_wakeups},
         {"partial_write_flushes", &net.partial_write_flushes},
+        {"http_reqs", &net.http_reqs},
         {"bytes_in", &stats.bytes_in},
         {"bytes_out", &stats.bytes_out},
     };
@@ -1400,6 +1538,41 @@ thread_local std::string g_sv_json;
 
 extern "C" {
 
+/* Extended start (ISSUE 10): http_port >= 0 adds the telemetry
+ * HTTP/1.1 listener (GET /metrics /healthz /statsz /tracez; 0 picks a
+ * free port — ptpu_serving_http_port reports it) on the same epoll
+ * event threads. Everything else is ptpu_serving_start2. */
+__attribute__((visibility("default")))
+void* ptpu_serving_start3(const char* model_path,
+                          const char* decode_model_path, int port,
+                          const char* authkey, int authkey_len,
+                          int max_batch, int64_t deadline_us,
+                          int instances, int threads_per_instance,
+                          int loopback_only, int kv_sessions,
+                          int http_port, char* err, int err_len) {
+  auto* s = new SvServer();
+  try {
+    s->model_path = model_path ? model_path : "";
+    s->decode_model_path =
+        decode_model_path ? decode_model_path : "";
+    s->kv_sessions = kv_sessions;
+    s->authkey.assign(authkey ? authkey : "",
+                      authkey_len > 0 ? size_t(authkey_len) : 0);
+    s->max_batch = max_batch > 0 ? max_batch : 8;
+    s->deadline_us = deadline_us > 0 ? deadline_us : 2000;
+    s->instances = instances > 0 ? instances : 2;
+    s->threads_per_instance = threads_per_instance;
+    s->http_port_want = http_port;
+    s->Start(port, loopback_only);
+    return s;
+  } catch (const std::exception& e) {
+    if (err && err_len > 0)
+      std::snprintf(err, size_t(err_len), "%s", e.what());
+    delete s;
+    return nullptr;
+  }
+}
+
 /* Extended start (r9): `decode_model_path` (may be NULL/empty) adds
  * the KV-cached DECODE plane — a decode-step artifact served through
  * its own predictor + micro-batcher with `kv_sessions` per-session KV
@@ -1413,26 +1586,11 @@ void* ptpu_serving_start2(const char* model_path,
                           int instances, int threads_per_instance,
                           int loopback_only, int kv_sessions, char* err,
                           int err_len) {
-  auto* s = new SvServer();
-  try {
-    s->model_path = model_path ? model_path : "";
-    s->decode_model_path =
-        decode_model_path ? decode_model_path : "";
-    s->kv_sessions = kv_sessions;
-    s->authkey.assign(authkey ? authkey : "",
-                      authkey_len > 0 ? size_t(authkey_len) : 0);
-    s->max_batch = max_batch > 0 ? max_batch : 8;
-    s->deadline_us = deadline_us > 0 ? deadline_us : 2000;
-    s->instances = instances > 0 ? instances : 2;
-    s->threads_per_instance = threads_per_instance;
-    s->Start(port, loopback_only);
-    return s;
-  } catch (const std::exception& e) {
-    if (err && err_len > 0)
-      std::snprintf(err, size_t(err_len), "%s", e.what());
-    delete s;
-    return nullptr;
-  }
+  return ptpu_serving_start3(model_path, decode_model_path, port,
+                             authkey, authkey_len, max_batch,
+                             deadline_us, instances,
+                             threads_per_instance, loopback_only,
+                             kv_sessions, -1, err, err_len);
 }
 
 __attribute__((visibility("default")))
@@ -1453,6 +1611,40 @@ __attribute__((visibility("default")))
 int ptpu_serving_port(void* h) {
   auto* s = static_cast<SvServer*>(h);
   return s ? s->port : -1;
+}
+
+// Telemetry HTTP port, or -1 when the endpoint is disabled.
+__attribute__((visibility("default")))
+int ptpu_serving_http_port(void* h) {
+  auto* s = static_cast<SvServer*>(h);
+  if (!s || !s->net_srv) return -1;
+  return s->net_srv->http_port();
+}
+
+/* Two-phase shutdown, half one: stop accepting framed connections
+ * and flip GET /healthz to 503 {"status":"draining"} while existing
+ * connections (and the HTTP listener) keep answering — take the node
+ * out of the load balancer, let in-flight work finish, THEN call
+ * ptpu_serving_stop. Idempotent. */
+__attribute__((visibility("default")))
+void ptpu_serving_drain_begin(void* h) {
+  auto* s = static_cast<SvServer*>(h);
+  if (!s) return;
+  s->DrainBegin();
+}
+
+// Prometheus exposition text of the live stats snapshot — the same
+// bytes GET /metrics serves (byte-identical to profiler/stats.py
+// prometheus_text over the stats_json snapshot). Thread-local buffer,
+// valid until this thread's next call.
+__attribute__((visibility("default")))
+const char* ptpu_serving_prom_text(void* h) {
+  auto* s = static_cast<SvServer*>(h);
+  if (!s) return "";
+  thread_local std::string g_prom;
+  g_prom = ptpu::trace::PromFromStatsJson(s->StatsJson(),
+                                          "ptpu_serving");
+  return g_prom.c_str();
 }
 
 __attribute__((visibility("default")))

@@ -15,6 +15,7 @@
 // Build + run: make selftest (csrc/Makefile); wrapped by
 // tests/test_native_selftest.py.
 #include "ptpu_net.cc"
+#include "ptpu_trace.cc"
 #include "ptpu_predictor.cc"
 #include "ptpu_serving.cc"
 

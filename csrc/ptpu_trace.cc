@@ -1,0 +1,548 @@
+// Implementation of the shared span recorder + the C Prometheus
+// renderer (see ptpu_trace.h). Compiled into BOTH shipping server .so
+// artifacts and single-TU-included by the selftests.
+#include "ptpu_trace.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <random>
+
+#include "ptpu_stats.h"
+
+namespace ptpu {
+namespace trace {
+
+// Twin map: paddle_tpu/profiler/timeline.py SPAN_KIND_NAMES (the
+// `trace` checker in tools/ptpu_check.py enforces the parity).
+const char* const kSpanKindNames[kKindCount] = {
+    "net.read",      // kRead
+    "batch.queue",   // kQueue
+    "batch.fill",    // kBatch
+    "predictor.run", // kRun
+    "net.flush",     // kFlush
+    "ps.pull",       // kPull
+    "ps.push",       // kPush
+    "decode.step",   // kDecode
+};
+
+namespace {
+
+int64_t EnvI64(const char* name, int64_t dflt) {
+  const char* e = std::getenv(name);
+  if (!e || !*e) return dflt;
+  char* end = nullptr;
+  const long long v = std::strtoll(e, &end, 10);
+  return (end && *end == '\0') ? int64_t(v) : dflt;
+}
+
+size_t RoundPow2(size_t v, size_t lo, size_t hi) {
+  size_t p = lo;
+  while (p < v && p < hi) p <<= 1;
+  return p;
+}
+
+}  // namespace
+
+Config ConfigFromEnv() {
+  Config c;
+  c.sample = EnvI64("PTPU_TRACE_SAMPLE", c.sample);
+  c.slow_us = EnvI64("PTPU_TRACE_SLOW_US", c.slow_us);
+  c.ring = size_t(EnvI64("PTPU_TRACE_RING", int64_t(c.ring)));
+  return c;
+}
+
+Recorder::Recorder(const Config& cfg)
+    : sample_(cfg.sample),
+      slow_us_(cfg.slow_us),
+      ring_(RoundPow2(cfg.ring, 64, 1u << 20)),
+      slow_(RoundPow2(cfg.slow_ring, 8, 1u << 12)) {
+  // seed the id mixer once (construction is cold; ids must differ
+  // across processes so merged traces never collide)
+  std::random_device rd;
+  seed_ = (uint64_t(rd()) << 32) | rd();
+}
+
+uint64_t Recorder::NewTraceId() {
+  // splitmix64 over a claimed counter: unique per recorder, cheap,
+  // and never 0 after the final fixup (0 means "untraced")
+  uint64_t z =
+      id_ctr_.fetch_add(1, std::memory_order_relaxed) + seed_ +
+      0x9e3779b97f4a7c15ull;
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+  z ^= z >> 31;
+  return z ? z : 1;
+}
+
+void Recorder::Record(uint64_t tid, uint8_t kind, int64_t t0_us,
+                      int64_t t1_us, uint64_t conn, uint64_t arg) {
+  if (!tid) return;
+  const uint64_t idx = head_.fetch_add(1, std::memory_order_relaxed);
+  Slot& s = ring_[idx & (ring_.size() - 1)];
+  /* Seqlock write bracket (Boehm, "Can seqlocks get along with
+   * programming language memory models"): the release FENCE keeps the
+   * odd marker visible before any field store (a release STORE alone
+   * orders only prior accesses — the relaxed field writes could hoist
+   * above it), and the final release store keeps every field before
+   * the even marker. Readers mirror with an acquire fence. */
+  s.seq.store(2 * idx + 1, std::memory_order_relaxed);
+  std::atomic_thread_fence(std::memory_order_release);
+  s.trace_id.store(tid, std::memory_order_relaxed);
+  s.kind.store(kind, std::memory_order_relaxed);
+  s.t0.store(t0_us, std::memory_order_relaxed);
+  s.t1.store(t1_us, std::memory_order_relaxed);
+  s.conn.store(conn, std::memory_order_relaxed);
+  s.arg.store(arg, std::memory_order_relaxed);
+  s.seq.store(2 * idx + 2, std::memory_order_release);
+}
+
+void Recorder::RecordSlow(uint64_t tid, uint64_t conn, uint64_t req,
+                          int64_t e2e_us, const SpanRec* spans, int n) {
+  const uint64_t idx =
+      slow_head_.fetch_add(1, std::memory_order_relaxed);
+  SlowSlot& s = slow_[idx & (slow_.size() - 1)];
+  s.seq.store(2 * idx + 1, std::memory_order_relaxed);
+  std::atomic_thread_fence(std::memory_order_release);
+  s.trace_id.store(tid, std::memory_order_relaxed);
+  s.conn.store(conn, std::memory_order_relaxed);
+  s.req.store(req, std::memory_order_relaxed);
+  s.e2e.store(e2e_us, std::memory_order_relaxed);
+  const int keep = n < kSlowSpans ? n : kSlowSpans;
+  s.n.store(keep, std::memory_order_relaxed);
+  for (int i = 0; i < keep; ++i) {
+    s.kind[i].store(spans[i].kind, std::memory_order_relaxed);
+    s.t0[i].store(spans[i].t0_us, std::memory_order_relaxed);
+    s.t1[i].store(spans[i].t1_us, std::memory_order_relaxed);
+  }
+  s.seq.store(2 * idx + 2, std::memory_order_release);
+}
+
+void Recorder::Set(int64_t sample, int64_t slow_us) {
+  if (sample >= 0)
+    sample_.store(sample, std::memory_order_relaxed);
+  if (slow_us >= 0)
+    slow_us_.store(slow_us, std::memory_order_relaxed);
+}
+
+void Recorder::Snapshot(std::vector<SpanView>* out,
+                        size_t max_n) const {
+  out->clear();
+  const uint64_t head = head_.load(std::memory_order_acquire);
+  const size_t n =
+      size_t(head < ring_.size() ? head : ring_.size());
+  const size_t want = max_n < n ? max_n : n;
+  out->reserve(want);
+  for (size_t i = 0; i < want; ++i) {
+    const uint64_t idx = head - 1 - i;
+    const Slot& s = ring_[idx & (ring_.size() - 1)];
+    if (s.seq.load(std::memory_order_acquire) != 2 * idx + 2)
+      continue;  // torn (being overwritten right now): skip
+    SpanView v;
+    v.trace_id = s.trace_id.load(std::memory_order_relaxed);
+    v.kind = s.kind.load(std::memory_order_relaxed);
+    v.t0_us = s.t0.load(std::memory_order_relaxed);
+    v.t1_us = s.t1.load(std::memory_order_relaxed);
+    v.conn = s.conn.load(std::memory_order_relaxed);
+    v.arg = s.arg.load(std::memory_order_relaxed);
+    // the acquire fence pins the field loads BEFORE the re-check (an
+    // acquire load alone would let them sink past it)
+    std::atomic_thread_fence(std::memory_order_acquire);
+    if (s.seq.load(std::memory_order_relaxed) != 2 * idx + 2)
+      continue;  // overwritten while copying
+    out->push_back(v);
+  }
+}
+
+void Recorder::SnapshotSlow(std::vector<SlowView>* out) const {
+  out->clear();
+  const uint64_t head = slow_head_.load(std::memory_order_acquire);
+  const size_t n =
+      size_t(head < slow_.size() ? head : slow_.size());
+  out->reserve(n);
+  for (size_t i = 0; i < n; ++i) {
+    const uint64_t idx = head - 1 - i;
+    const SlowSlot& s = slow_[idx & (slow_.size() - 1)];
+    if (s.seq.load(std::memory_order_acquire) != 2 * idx + 2)
+      continue;
+    SlowView v;
+    v.trace_id = s.trace_id.load(std::memory_order_relaxed);
+    v.conn = s.conn.load(std::memory_order_relaxed);
+    v.req = s.req.load(std::memory_order_relaxed);
+    v.e2e_us = s.e2e.load(std::memory_order_relaxed);
+    const int cnt = s.n.load(std::memory_order_relaxed);
+    for (int k = 0; k < cnt && k < kSlowSpans; ++k) {
+      SpanView sp;
+      sp.kind = s.kind[k].load(std::memory_order_relaxed);
+      sp.t0_us = s.t0[k].load(std::memory_order_relaxed);
+      sp.t1_us = s.t1[k].load(std::memory_order_relaxed);
+      v.spans.push_back(sp);
+    }
+    std::atomic_thread_fence(std::memory_order_acquire);
+    if (s.seq.load(std::memory_order_relaxed) != 2 * idx + 2)
+      continue;
+    out->push_back(std::move(v));
+  }
+}
+
+namespace {
+
+const char* KindName(uint8_t k) {
+  return k < kKindCount ? kSpanKindNames[k] : "unknown";
+}
+
+void AppendSpan(std::string* out, const SpanView& v, bool full) {
+  *out += "{\"kind\":\"";
+  *out += KindName(v.kind);
+  *out += "\",";
+  AppendJsonU64(out, "t0_us", uint64_t(v.t0_us));
+  *out += ',';
+  AppendJsonU64(out, "t1_us", uint64_t(v.t1_us));
+  if (full) {
+    *out += ',';
+    AppendJsonU64(out, "trace_id", v.trace_id);
+    *out += ',';
+    AppendJsonU64(out, "conn", v.conn);
+    *out += ',';
+    AppendJsonU64(out, "arg", v.arg);
+  }
+  *out += '}';
+}
+
+}  // namespace
+
+std::string Recorder::TracezJson(size_t max_n) const {
+  std::vector<SpanView> spans;
+  Snapshot(&spans, max_n);
+  std::vector<SlowView> slow;
+  SnapshotSlow(&slow);
+  std::string out = "{";
+  AppendJsonU64(&out, "sample", uint64_t(sample()));
+  out += ',';
+  AppendJsonU64(&out, "slow_us", uint64_t(slow_us()));
+  out += ',';
+  AppendJsonU64(&out, "ring", uint64_t(ring_.size()));
+  out += ',';
+  AppendJsonU64(&out, "recorded", recorded());
+  out += ",\"spans\":[";
+  for (size_t i = 0; i < spans.size(); ++i) {
+    if (i) out += ',';
+    AppendSpan(&out, spans[i], /*full=*/true);
+  }
+  out += "],\"slow\":[";
+  for (size_t i = 0; i < slow.size(); ++i) {
+    if (i) out += ',';
+    const SlowView& v = slow[i];
+    out += '{';
+    AppendJsonU64(&out, "trace_id", v.trace_id);
+    out += ',';
+    AppendJsonU64(&out, "conn", v.conn);
+    out += ',';
+    AppendJsonU64(&out, "req", v.req);
+    out += ',';
+    AppendJsonU64(&out, "e2e_us", uint64_t(v.e2e_us));
+    out += ",\"spans\":[";
+    for (size_t k = 0; k < v.spans.size(); ++k) {
+      if (k) out += ',';
+      AppendSpan(&out, v.spans[k], /*full=*/false);
+    }
+    out += "]}";
+  }
+  out += "]}";
+  return out;
+}
+
+Recorder& Global() {
+  static Recorder g(ConfigFromEnv());
+  return g;
+}
+
+// ---------------------------------------------------------------------------
+// Prometheus renderer — a restricted JSON reader over the stats
+// snapshots OUR renderers emit (objects, unsigned integers, arrays of
+// unsigned integers, escaped strings), walked exactly like
+// profiler/stats.py::prometheus_text so the two outputs are
+// byte-identical for the same snapshot.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct JNode {
+  enum Kind { kNum, kStr, kArr, kObj } kind = kNum;
+  uint64_t num = 0;
+  std::string str;
+  std::vector<uint64_t> arr;
+  std::vector<std::pair<std::string, JNode>> obj;  // insertion order
+};
+
+struct JParser {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  void Ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' ||
+                       *p == '\r'))
+      ++p;
+  }
+
+  bool Eat(char c) {
+    Ws();
+    if (p < end && *p == c) {
+      ++p;
+      return true;
+    }
+    ok = false;
+    return false;
+  }
+
+  std::string Str() {
+    std::string s;
+    if (!Eat('"')) return s;
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        ++p;
+        switch (*p) {
+          case 'n': s += '\n'; break;
+          case 't': s += '\t'; break;
+          case 'r': s += '\r'; break;
+          case '"': s += '"'; break;
+          case '\\': s += '\\'; break;
+          default: s += *p; break;  // \uXXXX never emitted for names
+        }
+        ++p;
+      } else {
+        s += *p++;
+      }
+    }
+    if (p < end) ++p;  // closing quote
+    else ok = false;
+    return s;
+  }
+
+  uint64_t Num() {
+    Ws();
+    uint64_t v = 0;
+    bool any = false;
+    while (p < end && *p >= '0' && *p <= '9') {
+      v = v * 10 + uint64_t(*p - '0');
+      ++p;
+      any = true;
+    }
+    if (!any) ok = false;
+    return v;
+  }
+
+  JNode Value(int depth) {
+    JNode n;
+    Ws();
+    if (!ok || depth > 16 || p >= end) {
+      ok = false;
+      return n;
+    }
+    if (*p == '{') {
+      ++p;
+      n.kind = JNode::kObj;
+      Ws();
+      if (p < end && *p == '}') {
+        ++p;
+        return n;
+      }
+      for (;;) {
+        std::string k = Str();
+        if (!Eat(':')) break;
+        n.obj.emplace_back(std::move(k), Value(depth + 1));
+        Ws();
+        if (p < end && *p == ',') {
+          ++p;
+          continue;
+        }
+        Eat('}');
+        break;
+      }
+      return n;
+    }
+    if (*p == '[') {
+      ++p;
+      n.kind = JNode::kArr;
+      Ws();
+      if (p < end && *p == ']') {
+        ++p;
+        return n;
+      }
+      for (;;) {
+        n.arr.push_back(Num());
+        Ws();
+        if (p < end && *p == ',') {
+          ++p;
+          continue;
+        }
+        Eat(']');
+        break;
+      }
+      return n;
+    }
+    if (*p == '"') {
+      n.kind = JNode::kStr;
+      n.str = Str();
+      return n;
+    }
+    n.kind = JNode::kNum;
+    n.num = Num();
+    return n;
+  }
+};
+
+bool IsHist(const JNode& n) {
+  if (n.kind != JNode::kObj) return false;
+  bool c = false, s = false, b = false;
+  for (const auto& kv : n.obj) {
+    if (kv.first == "count") c = true;
+    else if (kv.first == "sum") s = true;
+    else if (kv.first == "buckets") b = true;
+  }
+  return c && s && b;
+}
+
+const JNode* HistField(const JNode& n, const char* name) {
+  for (const auto& kv : n.obj)
+    if (kv.first == name) return &kv.second;
+  return nullptr;
+}
+
+std::string PromName(const std::string& prefix,
+                     const std::vector<std::string>& path,
+                     const std::string& leaf) {
+  // python twin: "_".join(non-empty parts), then sanitize
+  std::string name;
+  const auto add = [&name](const std::string& s) {
+    if (s.empty()) return;
+    if (!name.empty()) name += '_';
+    name += s;
+  };
+  add(prefix);
+  for (const auto& p : path) add(p);
+  add(leaf);
+  for (auto& ch : name)
+    if (!((ch >= 'a' && ch <= 'z') || (ch >= 'A' && ch <= 'Z') ||
+          (ch >= '0' && ch <= '9') || ch == '_'))
+      ch = '_';
+  return name;
+}
+
+struct PromWalk {
+  std::string out;
+  std::vector<std::string> seen_type;  // families with a TYPE line
+
+  bool Seen(const std::string& name) {
+    for (const auto& s : seen_type)
+      if (s == name) return true;
+    seen_type.push_back(name);
+    return false;
+  }
+
+  void Emit(const std::string& name, const JNode& v,
+            const std::string& labels) {
+    if (IsHist(v)) {
+      if (!Seen(name))
+        out += "# TYPE " + name + " histogram\n";
+      const JNode* buckets = HistField(v, "buckets");
+      const JNode* sum = HistField(v, "sum");
+      const JNode* count = HistField(v, "count");
+      uint64_t cum = 0;
+      const size_t nb = buckets->arr.size();
+      for (size_t b = 0; b < nb; ++b) {
+        cum += buckets->arr[b];
+        std::string le;
+        if (b == 0) {
+          le = "0";
+        } else if (b == nb - 1) {
+          le = "+Inf";
+        } else {
+          // log2 bucket b covers [2^(b-1), 2^b): upper edge 2^b - 1
+          le = std::to_string((uint64_t(1) << b) - 1);
+        }
+        out += name + "_bucket{" + labels +
+               (labels.empty() ? "" : ",") + "le=\"" + le + "\"} " +
+               std::to_string(cum) + "\n";
+      }
+      if (labels.empty()) {
+        out += name + "_sum " + std::to_string(sum->num) + "\n";
+        out += name + "_count " + std::to_string(count->num) + "\n";
+      } else {
+        out += name + "_sum{" + labels + "} " +
+               std::to_string(sum->num) + "\n";
+        out += name + "_count{" + labels + "} " +
+               std::to_string(count->num) + "\n";
+      }
+    } else {
+      if (!Seen(name))
+        out += "# TYPE " + name + " counter\n";
+      if (labels.empty())
+        out += name + " " + std::to_string(v.num) + "\n";
+      else
+        out += name + "{" + labels + "} " + std::to_string(v.num) +
+               "\n";
+    }
+  }
+
+  void Walk(const std::string& prefix, std::vector<std::string>& path,
+            const JNode& node, const std::string& labels) {
+    for (const auto& kv : node.obj) {
+      const std::string& k = kv.first;
+      const JNode& v = kv.second;
+      if (k == "tables" && v.kind == JNode::kObj && !IsHist(v)) {
+        for (const auto& tkv : v.obj) {
+          path.push_back("table");
+          std::string lbl = labels + (labels.empty() ? "" : ",") +
+                            "table=\"" + tkv.first + "\"";
+          Walk(prefix, path, tkv.second, lbl);
+          path.pop_back();
+        }
+      } else if (v.kind == JNode::kObj && !IsHist(v)) {
+        path.push_back(k);
+        Walk(prefix, path, v, labels);
+        path.pop_back();
+      } else if (v.kind == JNode::kNum || IsHist(v)) {
+        Emit(PromName(prefix, path, k), v, labels);
+      }
+      // strings / number arrays outside a histogram: not metrics
+    }
+  }
+};
+
+}  // namespace
+
+std::string PromFromStatsJson(const std::string& stats_json,
+                              const std::string& prefix) {
+  JParser jp{stats_json.data(),
+             stats_json.data() + stats_json.size()};
+  JNode root = jp.Value(0);
+  if (!jp.ok || root.kind != JNode::kObj)
+    return "# ptpu: stats snapshot did not parse\n";
+  PromWalk w;
+  std::vector<std::string> path;
+  w.Walk(prefix, path, root, "");
+  return w.out;
+}
+
+}  // namespace trace
+}  // namespace ptpu
+
+// Runtime tracing override, exported from every .so that links this
+// TU: sample < 0 / slow_us < 0 keep the current value. Tests and
+// operators flip sampling without a restart (the env knobs
+// PTPU_TRACE_SAMPLE / PTPU_TRACE_SLOW_US only apply at first touch).
+extern "C" __attribute__((visibility("default"))) void ptpu_trace_set(
+    int64_t sample, int64_t slow_us) {
+  ptpu::trace::Global().Set(sample, slow_us);
+}
+
+// Read-side twin for bindings without HTTP: the /tracez JSON.
+// Thread-local buffer, valid until the calling thread's next call.
+extern "C" __attribute__((visibility("default"))) const char*
+ptpu_trace_json(int64_t max_spans) {
+  thread_local std::string buf;
+  buf = ptpu::trace::Global().TracezJson(
+      max_spans > 0 ? size_t(max_spans) : 128);
+  return buf.c_str();
+}

@@ -1,0 +1,200 @@
+// ptpu_trace — lock-free sampled per-request span recorder shared by
+// BOTH native servers (csrc/ptpu_ps_server.cc, csrc/ptpu_serving.cc)
+// and the net core (csrc/ptpu_net.cc records the reply-flush span).
+// Reference counterpart: the profiler/timeline layer the upstream
+// stack pairs with its executor (platform/profiler RecordEvent ->
+// chrome trace) plus the /tracez-style request sampling every
+// production RPC layer grows (brpc rpcz).
+//
+// Shape:
+//   * A fixed-slot ring of COMPLETED span records. A writer claims a
+//     slot with one relaxed fetch_add and publishes begin/end
+//     microseconds, kind, conn id and a kind-specific arg (batch id,
+//     session id, request id) through relaxed atomics — zero
+//     allocation, zero locks, no syscalls on the hot path. Readers
+//     (GET /tracez) snapshot the ring and drop torn slots by sequence
+//     check; tracing is observability, not an audit log.
+//   * Sampling: PTPU_TRACE_SAMPLE = 0 disables everything (the
+//     zero-cost path: one relaxed load per request), 1 traces every
+//     request, N traces 1-in-N. A client-supplied trace id (the v2
+//     wire frames) is always traced while sampling is on — explicit
+//     opt-in wins over the sampling dice.
+//   * Slow-request ring: any request whose end-to-end latency crosses
+//     PTPU_TRACE_SLOW_US (0 = off) gets its FULL span breakdown
+//     captured into a small bounded ring, sampled or not — the "why
+//     was that one INFER slow" answer survives even at 1-in-N
+//     sampling.
+//
+// One Recorder instance per loaded .so (Global()); servers in the same
+// process but different shared objects each own their ring. The
+// runtime override ptpu_trace_set(sample, slow_us) and the JSON view
+// TracezJson() are exported through each server's ABI/HTTP endpoint.
+//
+// Span-kind names must stay identical to the Python timeline map
+// (paddle_tpu/profiler/timeline.py SPAN_KIND_NAMES) — the `trace`
+// checker in tools/ptpu_check.py holds the two in lockstep.
+#ifndef PTPU_TRACE_H_
+#define PTPU_TRACE_H_
+
+#include <atomic>
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace ptpu {
+namespace trace {
+
+// Lifecycle span kinds. Index == wire value in /tracez; names in
+// kSpanKindNames (ptpu_trace.cc) == timeline.py SPAN_KIND_NAMES.
+enum Kind : uint8_t {
+  kRead = 0,    // frame bytes first seen -> dispatched to the server
+  kQueue = 1,   // request enqueued -> popped by a batcher worker
+  kBatch = 2,   // batch popped -> inputs stitched, run ready
+  kRun = 3,     // predictor run (one batch)
+  kFlush = 4,   // reply queued on the conn -> last byte written
+  kPull = 5,    // PS pull handled (parse -> reply queued)
+  kPush = 6,    // PS push handled (parse -> ack queued)
+  kDecode = 7,  // decode step run (one continuous-batching sub-run)
+  kKindCount
+};
+
+extern const char* const kSpanKindNames[kKindCount];
+
+// The trace-id extension of v2 wire frames: [ver=2][tag][u64 trace id]
+// then the v1 body. Python twins: TRACE_EXT in inference/serving.py
+// and distributed/ps/wire.py (trace checker parity).
+constexpr uint32_t kTraceExt = 8;
+
+struct Config {
+  int64_t sample = 64;        // PTPU_TRACE_SAMPLE: 0 off, 1 all, N 1-in-N
+  int64_t slow_us = 100000;   // PTPU_TRACE_SLOW_US: 0 off
+  size_t ring = 4096;         // PTPU_TRACE_RING span slots (pow2-rounded)
+  size_t slow_ring = 64;      // slow-request slots (pow2-rounded)
+};
+
+Config ConfigFromEnv();
+
+// A completed span, as read back out of the ring.
+struct SpanView {
+  uint64_t trace_id = 0;
+  uint8_t kind = 0;
+  int64_t t0_us = 0, t1_us = 0;
+  uint64_t conn = 0;  // net-core connection id
+  uint64_t arg = 0;   // kind-specific: batch seq / session / req id
+};
+
+// Caller-side span scratch for RecordSlow (stack array, no alloc).
+struct SpanRec {
+  uint8_t kind = 0;
+  int64_t t0_us = 0, t1_us = 0;
+};
+
+struct SlowView {
+  uint64_t trace_id = 0, conn = 0, req = 0;
+  int64_t e2e_us = 0;
+  std::vector<SpanView> spans;
+};
+
+class Recorder {
+ public:
+  static constexpr int kSlowSpans = 8;
+
+  explicit Recorder(const Config& cfg);
+
+  // Sampling decision for one arriving request. Returns the effective
+  // trace id (client id, or a fresh one when the sampling dice hit),
+  // or 0 = not traced. With sample == 0 this is ONE relaxed load.
+  uint64_t BeginRequest(uint64_t client_tid) {
+    const int64_t s = sample_.load(std::memory_order_relaxed);
+    if (s <= 0) return 0;
+    if (client_tid) return client_tid;
+    if (s != 1 &&
+        sample_ctr_.fetch_add(1, std::memory_order_relaxed) %
+                uint64_t(s) !=
+            0)
+      return 0;
+    return NewTraceId();
+  }
+
+  // Record one completed span. tid == 0 is a no-op (untraced request).
+  void Record(uint64_t tid, uint8_t kind, int64_t t0_us, int64_t t1_us,
+              uint64_t conn, uint64_t arg);
+
+  bool SlowEligible(int64_t e2e_us) const {
+    const int64_t t = slow_us_.load(std::memory_order_relaxed);
+    return t > 0 && e2e_us >= t;
+  }
+
+  // Capture a slow request's full breakdown (first kSlowSpans spans).
+  void RecordSlow(uint64_t tid, uint64_t conn, uint64_t req,
+                  int64_t e2e_us, const SpanRec* spans, int n);
+
+  // Runtime override (ptpu_trace_set ABI): sample < 0 / slow_us < 0
+  // keep the current value.
+  void Set(int64_t sample, int64_t slow_us);
+
+  int64_t sample() const {
+    return sample_.load(std::memory_order_relaxed);
+  }
+  int64_t slow_us() const {
+    return slow_us_.load(std::memory_order_relaxed);
+  }
+  uint64_t recorded() const {
+    return head_.load(std::memory_order_relaxed);
+  }
+  size_t ring_size() const { return ring_.size(); }
+
+  // Newest-first snapshots; torn slots (mid-overwrite) are skipped.
+  void Snapshot(std::vector<SpanView>* out, size_t max_n) const;
+  void SnapshotSlow(std::vector<SlowView>* out) const;
+
+  // {"sample","slow_us","ring","recorded","spans":[...],"slow":[...]}
+  // — the GET /tracez body.
+  std::string TracezJson(size_t max_n) const;
+
+ private:
+  struct Slot {
+    std::atomic<uint64_t> seq{0};  // 2*idx+1 writing, 2*idx+2 done
+    std::atomic<uint64_t> trace_id{0}, conn{0}, arg{0};
+    std::atomic<int64_t> t0{0}, t1{0};
+    std::atomic<uint8_t> kind{0};
+  };
+  struct SlowSlot {
+    std::atomic<uint64_t> seq{0};
+    std::atomic<uint64_t> trace_id{0}, conn{0}, req{0};
+    std::atomic<int64_t> e2e{0};
+    std::atomic<int32_t> n{0};
+    std::atomic<uint8_t> kind[kSlowSpans] = {};
+    std::atomic<int64_t> t0[kSlowSpans] = {}, t1[kSlowSpans] = {};
+  };
+
+  uint64_t NewTraceId();
+
+  std::atomic<int64_t> sample_, slow_us_;
+  std::atomic<uint64_t> head_{0}, slow_head_{0};
+  std::atomic<uint64_t> sample_ctr_{0}, id_ctr_{0};
+  uint64_t seed_;
+  std::vector<Slot> ring_;       // size is a power of two
+  std::vector<SlowSlot> slow_;   // size is a power of two
+};
+
+// Process-global recorder for this shared object, lazily constructed
+// from the PTPU_TRACE_* env on first touch.
+Recorder& Global();
+
+// ---------------------------------------------------------------------------
+// Prometheus exposition renderer (GET /metrics). Walks a stats JSON
+// snapshot (the exact strings the servers' *_stats_json render) and
+// emits the same text profiler/stats.py::prometheus_text produces for
+// that snapshot — byte-for-byte (tested): nested keys join the metric
+// name with '_', a "tables" level becomes a table="<name>" label,
+// histograms render cumulative le-bucket _bucket/_sum/_count series,
+// each family gets exactly one "# TYPE" line.
+// ---------------------------------------------------------------------------
+std::string PromFromStatsJson(const std::string& stats_json,
+                              const std::string& prefix);
+
+}  // namespace trace
+}  // namespace ptpu
+
+#endif  // PTPU_TRACE_H_

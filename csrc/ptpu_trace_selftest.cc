@@ -1,0 +1,270 @@
+// Unit tests for the shared span recorder + Prometheus renderer
+// (single-TU include of ptpu_trace.cc — cc_test analogue, run by
+// `make selftest` and both sancheck legs; no Python, no sockets).
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "ptpu_trace.cc"
+
+using ptpu::trace::Config;
+using ptpu::trace::Recorder;
+using ptpu::trace::SpanRec;
+using ptpu::trace::SpanView;
+using ptpu::trace::SlowView;
+
+static int g_tests = 0;
+
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,     \
+                   #cond);                                             \
+      return 1;                                                        \
+    }                                                                  \
+  } while (0)
+
+#define TEST(name)                                                     \
+  std::fprintf(stderr, "trace_selftest: %s\n", name);                  \
+  ++g_tests;
+
+int main() {
+  {
+    TEST("ring wraparound exactness");
+    Config cfg;
+    cfg.sample = 1;
+    cfg.slow_us = 0;
+    cfg.ring = 64;
+    Recorder r(cfg);
+    // write 1000 spans with trace_id == i+1; the ring keeps exactly
+    // the newest 64, in order, with every field intact
+    for (uint64_t i = 0; i < 1000; ++i)
+      r.Record(i + 1, ptpu::trace::kRun, int64_t(10 * i),
+               int64_t(10 * i + 5), /*conn=*/7, /*arg=*/i);
+    CHECK(r.recorded() == 1000);
+    std::vector<SpanView> got;
+    r.Snapshot(&got, 1000);
+    CHECK(got.size() == 64);
+    for (size_t k = 0; k < got.size(); ++k) {
+      const uint64_t want = 1000 - k;  // newest first
+      CHECK(got[k].trace_id == want);
+      CHECK(got[k].kind == ptpu::trace::kRun);
+      CHECK(got[k].t0_us == int64_t(10 * (want - 1)));
+      CHECK(got[k].t1_us == int64_t(10 * (want - 1) + 5));
+      CHECK(got[k].conn == 7);
+      CHECK(got[k].arg == want - 1);
+    }
+    // max_n clamps
+    r.Snapshot(&got, 3);
+    CHECK(got.size() == 3 && got[0].trace_id == 1000);
+  }
+
+  {
+    TEST("sampled-off zero-cost path");
+    Config cfg;
+    cfg.sample = 0;
+    cfg.slow_us = 0;
+    Recorder r(cfg);
+    for (int i = 0; i < 10000; ++i) {
+      CHECK(r.BeginRequest(0) == 0);
+      // a client-sent trace id is ALSO off while the kill switch is
+      // set: PTPU_TRACE_SAMPLE=0 must mean zero recorder work
+      CHECK(r.BeginRequest(0xdeadbeefull) == 0);
+    }
+    r.Record(0, ptpu::trace::kRead, 1, 2, 3, 4);  // tid 0: no-op
+    CHECK(r.recorded() == 0);
+    CHECK(!r.SlowEligible(INT64_MAX / 2));
+    std::vector<SpanView> got;
+    r.Snapshot(&got, 16);
+    CHECK(got.empty());
+  }
+
+  {
+    TEST("sampling: 1-in-N dice + client ids always win");
+    Config cfg;
+    cfg.sample = 4;
+    Recorder r(cfg);
+    int hits = 0;
+    for (int i = 0; i < 400; ++i)
+      if (r.BeginRequest(0)) ++hits;
+    CHECK(hits == 100);  // deterministic counter dice, exactly 1-in-4
+    // a client id is returned verbatim, no dice roll
+    for (int i = 0; i < 10; ++i)
+      CHECK(r.BeginRequest(42) == 42);
+    // generated ids are unique and nonzero
+    std::set<uint64_t> ids;
+    Config all = cfg;
+    all.sample = 1;
+    Recorder r2(all);
+    for (int i = 0; i < 1000; ++i) {
+      const uint64_t id = r2.BeginRequest(0);
+      CHECK(id != 0);
+      ids.insert(id);
+    }
+    CHECK(ids.size() == 1000);
+  }
+
+  {
+    TEST("runtime Set() override");
+    Config cfg;
+    cfg.sample = 0;
+    Recorder r(cfg);
+    CHECK(r.BeginRequest(7) == 0);
+    r.Set(1, 250);
+    CHECK(r.sample() == 1 && r.slow_us() == 250);
+    CHECK(r.BeginRequest(7) == 7);
+    CHECK(r.SlowEligible(250) && !r.SlowEligible(249));
+    r.Set(-1, -1);  // negative keeps current
+    CHECK(r.sample() == 1 && r.slow_us() == 250);
+  }
+
+  {
+    TEST("slow ring: bounded capture with full breakdown");
+    Config cfg;
+    cfg.sample = 1;
+    cfg.slow_us = 100;
+    cfg.slow_ring = 8;
+    Recorder r(cfg);
+    for (int i = 0; i < 20; ++i) {
+      SpanRec sp[3] = {{ptpu::trace::kRead, 10 * i, 10 * i + 1},
+                       {ptpu::trace::kQueue, 10 * i + 1, 10 * i + 4},
+                       {ptpu::trace::kRun, 10 * i + 4, 10 * i + 9}};
+      r.RecordSlow(uint64_t(i + 1), /*conn=*/3, /*req=*/uint64_t(i),
+                   /*e2e=*/1000 + i, sp, 3);
+    }
+    std::vector<SlowView> got;
+    r.SnapshotSlow(&got);
+    CHECK(got.size() == 8);
+    for (size_t k = 0; k < got.size(); ++k) {
+      const uint64_t want = 20 - k;  // newest first
+      CHECK(got[k].trace_id == want);
+      CHECK(got[k].e2e_us == int64_t(1000 + want - 1));
+      CHECK(got[k].spans.size() == 3);
+      CHECK(got[k].spans[0].kind == ptpu::trace::kRead);
+      CHECK(got[k].spans[2].kind == ptpu::trace::kRun);
+      CHECK(got[k].spans[2].t1_us - got[k].spans[2].t0_us == 5);
+    }
+    // span count clamps at kSlowSpans
+    SpanRec many[12] = {};
+    for (int i = 0; i < 12; ++i)
+      many[i] = {ptpu::trace::kRead, i, i + 1};
+    r.RecordSlow(99, 0, 0, 500, many, 12);
+    r.SnapshotSlow(&got);
+    CHECK(got[0].trace_id == 99);
+    CHECK(int(got[0].spans.size()) == Recorder::kSlowSpans);
+  }
+
+  {
+    TEST("threaded recorder consistency (4 writers x 25k)");
+    Config cfg;
+    cfg.sample = 1;
+    cfg.ring = 1024;
+    Recorder r(cfg);
+    constexpr int kThreads = 4, kPer = 25000;
+    std::vector<std::thread> ts;
+    for (int t = 0; t < kThreads; ++t)
+      ts.emplace_back([&r, t] {
+        for (int i = 0; i < kPer; ++i)
+          r.Record(uint64_t(t) * kPer + i + 1,
+                   uint8_t(i % ptpu::trace::kKindCount), i, i + 1,
+                   uint64_t(t), uint64_t(i));
+      });
+    // concurrent readers must never see torn records
+    std::thread reader([&r] {
+      std::vector<SpanView> got;
+      for (int i = 0; i < 200; ++i) {
+        r.Snapshot(&got, 256);
+        for (const auto& v : got) {
+          assert(v.trace_id != 0);
+          assert(v.t1_us == v.t0_us + 1);
+          assert(v.kind < ptpu::trace::kKindCount);
+        }
+      }
+    });
+    for (auto& t : ts) t.join();
+    reader.join();
+    CHECK(r.recorded() == uint64_t(kThreads) * kPer);
+    std::vector<SpanView> got;
+    r.Snapshot(&got, 4096);
+    CHECK(got.size() == 1024);  // quiescent: nothing torn
+    for (const auto& v : got) CHECK(v.t1_us == v.t0_us + 1);
+  }
+
+  {
+    TEST("tracez JSON shape");
+    Config cfg;
+    cfg.sample = 2;
+    cfg.slow_us = 50;
+    cfg.ring = 64;
+    Recorder r(cfg);
+    r.Record(5, ptpu::trace::kPull, 100, 200, 9, 512);
+    SpanRec sp[1] = {{ptpu::trace::kPull, 100, 200}};
+    r.RecordSlow(5, 9, 512, 100, sp, 1);
+    const std::string j = r.TracezJson(16);
+    CHECK(j.find("\"sample\":2") != std::string::npos);
+    CHECK(j.find("\"slow_us\":50") != std::string::npos);
+    CHECK(j.find("\"ring\":64") != std::string::npos);
+    CHECK(j.find("\"recorded\":1") != std::string::npos);
+    CHECK(j.find("\"spans\":[{\"kind\":\"ps.pull\",\"t0_us\":100,"
+                 "\"t1_us\":200,\"trace_id\":5,\"conn\":9,\"arg\":512}"
+                 "]") != std::string::npos);
+    CHECK(j.find("\"slow\":[{\"trace_id\":5,\"conn\":9,\"req\":512,"
+                 "\"e2e_us\":100,\"spans\":[{\"kind\":\"ps.pull\","
+                 "\"t0_us\":100,\"t1_us\":200}]}]") !=
+          std::string::npos);
+  }
+
+  {
+    TEST("span-kind name table is dense and distinct");
+    std::set<std::string> names;
+    for (int k = 0; k < ptpu::trace::kKindCount; ++k) {
+      CHECK(ptpu::trace::kSpanKindNames[k] != nullptr);
+      CHECK(std::strlen(ptpu::trace::kSpanKindNames[k]) > 0);
+      names.insert(ptpu::trace::kSpanKindNames[k]);
+    }
+    CHECK(int(names.size()) == ptpu::trace::kKindCount);
+  }
+
+  {
+    TEST("Prometheus renderer: counters, labels, cumulative buckets");
+    // a miniature stats snapshot in exactly the renderers' grammar
+    const std::string snap =
+        "{\"server\":{\"pull_ops\":3,\"lat_us\":{\"count\":4,"
+        "\"sum\":30,\"buckets\":[1,2,0,1]}},"
+        "\"tables\":{\"emb\":{\"wire\":{\"rows\":7}},"
+        "\"w2\":{\"wire\":{\"rows\":9}}}}";
+    const std::string got =
+        ptpu::trace::PromFromStatsJson(snap, "ptpu_ps");
+    const std::string want =
+        "# TYPE ptpu_ps_server_pull_ops counter\n"
+        "ptpu_ps_server_pull_ops 3\n"
+        "# TYPE ptpu_ps_server_lat_us histogram\n"
+        "ptpu_ps_server_lat_us_bucket{le=\"0\"} 1\n"
+        "ptpu_ps_server_lat_us_bucket{le=\"1\"} 3\n"
+        "ptpu_ps_server_lat_us_bucket{le=\"3\"} 3\n"
+        "ptpu_ps_server_lat_us_bucket{le=\"+Inf\"} 4\n"
+        "ptpu_ps_server_lat_us_sum 30\n"
+        "ptpu_ps_server_lat_us_count 4\n"
+        "# TYPE ptpu_ps_table_wire_rows counter\n"
+        "ptpu_ps_table_wire_rows{table=\"emb\"} 7\n"
+        "ptpu_ps_table_wire_rows{table=\"w2\"} 9\n";
+    if (got != want) {
+      std::fprintf(stderr, "prom mismatch:\n--- got ---\n%s--- want "
+                           "---\n%s",
+                   got.c_str(), want.c_str());
+      return 1;
+    }
+    // malformed input never crashes
+    CHECK(ptpu::trace::PromFromStatsJson("{broken", "x").find(
+              "did not parse") != std::string::npos);
+    CHECK(ptpu::trace::PromFromStatsJson("", "x").find(
+              "did not parse") != std::string::npos);
+  }
+
+  std::fprintf(stderr, "ptpu_trace_selftest: %d tests OK\n", g_tests);
+  return 0;
+}

@@ -385,6 +385,21 @@ def _ps_load() -> Optional[ctypes.CDLL]:
             lib._ptpu_has_ps_server_stats = True
         except AttributeError:
             lib._ptpu_has_ps_server_stats = False
+        try:
+            # telemetry HTTP + request tracing ABI (r10)
+            lib.ptpu_ps_server_start2.restype = c.c_void_p
+            lib.ptpu_ps_server_start2.argtypes = [
+                c.c_int, c.c_char_p, c.c_int, c.c_int, c.c_int]
+            lib.ptpu_ps_server_http_port.restype = c.c_int
+            lib.ptpu_ps_server_http_port.argtypes = [c.c_void_p]
+            lib.ptpu_ps_server_prom_text.restype = c.c_char_p
+            lib.ptpu_ps_server_prom_text.argtypes = [c.c_void_p]
+            lib.ptpu_trace_set.argtypes = [c.c_int64, c.c_int64]
+            lib.ptpu_trace_json.restype = c.c_char_p
+            lib.ptpu_trace_json.argtypes = [c.c_int64]
+            lib._ptpu_has_ps_http = True
+        except AttributeError:   # stale prebuilt .so: telemetry off
+            lib._ptpu_has_ps_http = False
         _PS_LIB = lib
         return _PS_LIB
 
@@ -407,17 +422,41 @@ class PsDataServer:
     port over it."""
 
     def __init__(self, port: int, authkey: bytes,
-                 loopback_only: bool = True):
+                 loopback_only: bool = True,
+                 http_port: Optional[int] = None):
         l = _ps_load()
         if l is None or not l._ptpu_has_ps_server:
             raise RuntimeError("native PS data-plane server unavailable")
         self._l = l
         self._tables = {}   # name -> NativePsTable (keep shards alive)
-        self._h = l.ptpu_ps_server_start(port, authkey, len(authkey),
-                                         1 if loopback_only else 0)
+        has_http = getattr(l, "_ptpu_has_ps_http", False)
+        if http_port is not None and not has_http:
+            raise RuntimeError(
+                "telemetry HTTP needs the r10 PS ABI (stale "
+                "_native_ps.so: delete it and re-import)")
+        if has_http:
+            self._h = l.ptpu_ps_server_start2(
+                port, authkey, len(authkey), 1 if loopback_only else 0,
+                -1 if http_port is None else http_port)
+        else:
+            self._h = l.ptpu_ps_server_start(port, authkey,
+                                             len(authkey),
+                                             1 if loopback_only else 0)
         if not self._h:
             raise OSError(l.ptpu_ps_server_last_error().decode())
         self.port = int(l.ptpu_ps_server_port(self._h))
+        # telemetry HTTP port (-1 disabled); PTPU_NET_HTTP forces it
+        # on regardless of the http_port argument
+        self.http_port = (int(l.ptpu_ps_server_http_port(self._h))
+                          if has_http else -1)
+
+    def prom_text(self) -> Optional[str]:
+        """Prometheus exposition text (C-rendered; the GET /metrics
+        bytes). None when the .so predates the r10 ABI."""
+        if not getattr(self, "_h", None) or \
+                not getattr(self._l, "_ptpu_has_ps_http", False):
+            return None
+        return self._l.ptpu_ps_server_prom_text(self._h).decode()
 
     def register(self, name: str, table: NativePsTable, lo: int):
         """Expose `table` as `name`; the server maps global ids by
@@ -661,6 +700,24 @@ def _predictor_lib() -> ctypes.CDLL:
             lib._ptpu_has_decode = True
         except AttributeError:   # stale prebuilt .so: decode degrades
             lib._ptpu_has_decode = False
+        try:
+            # telemetry HTTP + two-phase drain + tracing ABI (r10)
+            lib.ptpu_serving_start3.restype = c.c_void_p
+            lib.ptpu_serving_start3.argtypes = [
+                c.c_char_p, c.c_char_p, c.c_int, c.c_char_p, c.c_int,
+                c.c_int, c.c_int64, c.c_int, c.c_int, c.c_int, c.c_int,
+                c.c_int, c.c_char_p, c.c_int]
+            lib.ptpu_serving_http_port.restype = c.c_int
+            lib.ptpu_serving_http_port.argtypes = [c.c_void_p]
+            lib.ptpu_serving_drain_begin.argtypes = [c.c_void_p]
+            lib.ptpu_serving_prom_text.restype = c.c_char_p
+            lib.ptpu_serving_prom_text.argtypes = [c.c_void_p]
+            lib.ptpu_trace_set.argtypes = [c.c_int64, c.c_int64]
+            lib.ptpu_trace_json.restype = c.c_char_p
+            lib.ptpu_trace_json.argtypes = [c.c_int64]
+            lib._ptpu_has_http = True
+        except AttributeError:   # stale prebuilt .so: telemetry off
+            lib._ptpu_has_http = False
         try:
             lib.ptpu_predictor_stats_json.restype = c.c_char_p
             lib.ptpu_predictor_stats_json.argtypes = [c.c_void_p]
@@ -924,9 +981,11 @@ ABI_SYMBOLS = {
         "ptpu_ps_table_stats_json", "ptpu_ps_table_stats_reset",
         "ptpu_ps_table_note_pull",
         "ptpu_ps_server_last_error", "ptpu_ps_server_start",
-        "ptpu_ps_server_port", "ptpu_ps_server_register",
+        "ptpu_ps_server_start2", "ptpu_ps_server_port",
+        "ptpu_ps_server_http_port", "ptpu_ps_server_register",
         "ptpu_ps_server_stop", "ptpu_ps_server_stats_json",
-        "ptpu_ps_server_stats_reset",
+        "ptpu_ps_server_stats_reset", "ptpu_ps_server_prom_text",
+        "ptpu_trace_set", "ptpu_trace_json",
     ),
     "_native_predictor.so": (
         "ptpu_predictor_create", "ptpu_predictor_create_opts",
@@ -947,8 +1006,11 @@ ABI_SYMBOLS = {
         "ptpu_predictor_kv_plan", "ptpu_predictor_kv_sessions",
         "ptpu_predictor_kv_open", "ptpu_predictor_kv_close",
         "ptpu_predictor_kv_len", "ptpu_predictor_decode_step",
-        "ptpu_serving_start", "ptpu_serving_start2", "ptpu_serving_port",
+        "ptpu_serving_start", "ptpu_serving_start2",
+        "ptpu_serving_start3", "ptpu_serving_port",
+        "ptpu_serving_http_port", "ptpu_serving_drain_begin",
         "ptpu_serving_config_json", "ptpu_serving_stats_json",
-        "ptpu_serving_stats_reset", "ptpu_serving_stop",
+        "ptpu_serving_stats_reset", "ptpu_serving_prom_text",
+        "ptpu_serving_stop", "ptpu_trace_set", "ptpu_trace_json",
     ),
 }

@@ -236,9 +236,19 @@ TAG_OK = 0x53
 TAG_ERR = 0x54
 _FAST_MIN, _FAST_MAX = TAG_PULL_REQ, TAG_ERR
 
+# Traced fast frames (ISSUE 10): version 2 inserts a client-generated
+# [u64-LE trace id] between [ver][tag] and the v1 body; the server
+# echoes it in PULL_REP/OK replies (ERR frames stay v1) and records
+# its lifecycle spans under that id (csrc/ptpu_trace.{h,cc}, exposed
+# over GET /tracez). Old v1 peers are untouched. C twin constants:
+# kWireVersionTraced / ptpu::trace::kTraceExt in ptpu_ps_server.cc.
+WIRE_VERSION_TRACED = 2
+TRACE_EXT = 8
+
 OK_FRAME = bytes([WIRE_VERSION, TAG_OK])
 
 _U32x2 = struct.Struct("<II")
+_U64 = struct.Struct("<Q")
 
 
 def fast_tag(data) -> int:
@@ -246,7 +256,7 @@ def fast_tag(data) -> int:
     frames. Raises the same version-mismatch error as `loads`."""
     if len(data) < 2:
         return -1
-    if data[0] != WIRE_VERSION:
+    if data[0] not in (WIRE_VERSION, WIRE_VERSION_TRACED):
         raise ValueError(
             f"PS wire: protocol version mismatch (got {data[0]}, "
             f"expected {WIRE_VERSION}) — all ranks must run the same "
@@ -255,25 +265,45 @@ def fast_tag(data) -> int:
     return tag if _FAST_MIN <= tag <= _FAST_MAX else -1
 
 
-def _table_header(tag: int, table: str) -> bytes:
+def trace_id_of(data) -> int:
+    """Trace id of a traced (v2) fast frame, 0 for v1 frames."""
+    if len(data) >= 2 + TRACE_EXT and data[0] == WIRE_VERSION_TRACED:
+        return _U64.unpack_from(data, 2)[0]
+    return 0
+
+
+def _trace_ext_of(data) -> int:
+    """Byte shift of every v1 body offset for this frame (0 or 8)."""
+    return TRACE_EXT if data[0] == WIRE_VERSION_TRACED else 0
+
+
+def _table_header(tag: int, table: str, trace_id: int = 0) -> bytes:
     tb = table.encode()
     if len(tb) > 255:
         raise ValueError("PS wire: table name too long for fast frame")
+    if trace_id:
+        return (bytes([WIRE_VERSION_TRACED, tag]) +
+                _U64.pack(trace_id) + bytes([len(tb)]) + tb)
     return bytes([WIRE_VERSION, tag, len(tb)]) + tb
 
 
-def build_pull_req(table: str, ids: np.ndarray) -> bytes:
+def build_pull_req(table: str, ids: np.ndarray,
+                   trace_id: int = 0) -> bytes:
+    """trace_id nonzero builds a traced (v2) frame: the server records
+    this request's lifecycle spans under that id and echoes it in the
+    reply (old servers reject v2 — only send when tracing is on)."""
     ids = np.ascontiguousarray(ids, np.dtype("<i8"))
-    return (_table_header(TAG_PULL_REQ, table) + _U32.pack(ids.size) +
-            ids.tobytes())
+    return (_table_header(TAG_PULL_REQ, table, trace_id) +
+            _U32.pack(ids.size) + ids.tobytes())
 
 
 def parse_pull_req(data):
     """-> (table, ids) — ids a zero-copy int64 view of `data`."""
     buf = memoryview(data)
-    tlen = buf[2]
-    off = 3 + tlen
-    table = bytes(buf[3:off]).decode()
+    ext = _trace_ext_of(buf)
+    tlen = buf[2 + ext]
+    off = 3 + ext + tlen
+    table = bytes(buf[3 + ext:off]).decode()
     (n,) = _U32.unpack_from(buf, off)
     off += 4
     if len(buf) != off + 8 * n:
@@ -296,24 +326,28 @@ def alloc_pull_rep(n: int, dim: int):
 
 
 def parse_pull_rep(data):
-    """-> (n, dim) float32 zero-copy view of the reply body."""
+    """-> (n, dim) float32 zero-copy view of the reply body. Traced
+    (v2) replies carry the echoed trace id — read it with
+    `trace_id_of`; the body sits TRACE_EXT bytes later."""
     buf = memoryview(data)
-    n, dim = _U32x2.unpack_from(buf, 2)
-    if len(buf) != _PULL_REP_HDR + 4 * n * dim:
+    ext = _trace_ext_of(buf)
+    n, dim = _U32x2.unpack_from(buf, 2 + ext)
+    if len(buf) != ext + _PULL_REP_HDR + 4 * n * dim:
         raise ValueError("PS wire: truncated pull reply")
     return np.frombuffer(buf, np.dtype("<f4"), count=n * dim,
-                         offset=_PULL_REP_HDR).reshape(n, dim)
+                         offset=ext + _PULL_REP_HDR).reshape(n, dim)
 
 
 def build_push_req(table: str, ids: np.ndarray, grads: np.ndarray,
-                   is_async: bool = False) -> bytearray:
+                   is_async: bool = False,
+                   trace_id: int = 0) -> bytearray:
     ids = np.ascontiguousarray(ids, np.dtype("<i8"))
     grads = np.ascontiguousarray(grads, np.dtype("<f4"))
     n = ids.size
     dim = grads.size // max(n, 1)
     if grads.size != n * dim:
         raise ValueError("PS wire: grads size not a multiple of ids")
-    hdr = (_table_header(TAG_PUSH_REQ, table) +
+    hdr = (_table_header(TAG_PUSH_REQ, table, trace_id) +
            bytes([1 if is_async else 0]) + _U32x2.pack(n, dim))
     frame = bytearray(len(hdr) + 8 * n + 4 * n * dim)
     frame[:len(hdr)] = hdr
@@ -325,9 +359,10 @@ def build_push_req(table: str, ids: np.ndarray, grads: np.ndarray,
 def parse_push_req(data):
     """-> (table, ids, grads, is_async) — ids/grads zero-copy views."""
     buf = memoryview(data)
-    tlen = buf[2]
-    off = 3 + tlen
-    table = bytes(buf[3:off]).decode()
+    ext = _trace_ext_of(buf)
+    tlen = buf[2 + ext]
+    off = 3 + ext + tlen
+    table = bytes(buf[3 + ext:off]).decode()
     is_async = bool(buf[off])
     n, dim = _U32x2.unpack_from(buf, off + 1)
     off += 1 + _U32x2.size

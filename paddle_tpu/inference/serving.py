@@ -48,9 +48,40 @@ TAG_DECODE_STEP = 0x67
 TAG_DECODE_REP = 0x68
 TAG_DECODE_CLOSE = 0x69
 
+# Traced frames (ISSUE 10): version 2 inserts a client-generated
+# [u64-LE trace id] between [ver][tag] and the v1 body; REP frames for
+# a traced request echo the same extension (ERR frames stay v1). The
+# server records the request's lifecycle spans (net.read ->
+# batch.queue -> batch.fill -> predictor.run -> net.flush) under that
+# id — GET /tracez returns them, and profiler.timeline.
+# merge_request_trace joins them with the client-side spans captured
+# by InferenceClient(trace=True). C twins: kSvWireVersionTraced /
+# ptpu::trace::kTraceExt in csrc/ptpu_serving.cc.
+WIRE_VERSION_TRACED = 2
+TRACE_EXT = 8
+
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
 _I64 = struct.Struct("<q")
+
+
+def _frame_trace_id(f) -> int:
+    """Echoed trace id of a reply frame (0 for v1 frames)."""
+    if len(f) >= 2 + TRACE_EXT and f[0] == WIRE_VERSION_TRACED:
+        return _U64.unpack_from(f, 2)[0]
+    return 0
+
+
+def _frame_base(f) -> int:
+    """Byte shift of every v1 body offset for this frame (0 or 8)."""
+    return TRACE_EXT if f[0] == WIRE_VERSION_TRACED else 0
+
+
+def _now_us() -> int:
+    """CLOCK_MONOTONIC microseconds — same clock domain as the C
+    server's steady_clock span stamps, so same-host client/server
+    spans merge with no skew correction."""
+    return time.monotonic_ns() // 1000
 
 # ONNX TensorProto codes on the wire
 _DT_F32, _DT_I32, _DT_I64 = 1, 6, 7
@@ -76,7 +107,8 @@ class InferenceServer:
                  threads_per_instance: int = 0,
                  loopback_only: bool = True,
                  decode_model: Optional[str] = None,
-                 kv_sessions: int = 0):
+                 kv_sessions: int = 0,
+                 http_port: Optional[int] = None):
         from ..core.native import _predictor_lib
         lib = _predictor_lib()
         if not getattr(lib, "_ptpu_has_serving", False):
@@ -86,7 +118,20 @@ class InferenceServer:
         self._lib = lib
         self.authkey = authkey if authkey is not None else os.urandom(16)
         err = ctypes.create_string_buffer(512)
-        if decode_model is not None or kv_sessions:
+        has_http = getattr(lib, "_ptpu_has_http", False)
+        if http_port is not None and not has_http:
+            raise RuntimeError(
+                "telemetry HTTP needs the r10 ABI (stale "
+                "_native_predictor.so: delete it and re-import)")
+        if has_http:
+            self._h = lib.ptpu_serving_start3(
+                model_path.encode(),
+                decode_model.encode() if decode_model else None, port,
+                self.authkey, len(self.authkey), max_batch, deadline_us,
+                instances, threads_per_instance,
+                1 if loopback_only else 0, kv_sessions,
+                -1 if http_port is None else http_port, err, 512)
+        elif decode_model is not None or kv_sessions:
             if not getattr(lib, "_ptpu_has_decode", False):
                 raise RuntimeError(
                     "decode serving needs the r9 ABI (stale "
@@ -107,6 +152,10 @@ class InferenceServer:
             raise RuntimeError("ptpu_serving_start: " +
                                err.value.decode())
         self.port = int(lib.ptpu_serving_port(self._h))
+        # telemetry HTTP port (-1 when disabled); PTPU_NET_HTTP can
+        # force it on even through the old start forms
+        self.http_port = (int(lib.ptpu_serving_http_port(self._h))
+                          if has_http else -1)
 
     def _handle(self):
         # a NULL handle would segfault inside the C runtime; fail here
@@ -130,9 +179,31 @@ class InferenceServer:
     def stats_reset(self) -> None:
         self._lib.ptpu_serving_stats_reset(self._handle())
 
-    def client(self, host: str = "127.0.0.1") -> "InferenceClient":
+    def prom_text(self) -> str:
+        """Prometheus exposition text of the live stats — the same
+        bytes ``GET /metrics`` serves (C-rendered; byte-identical to
+        ``profiler.stats.prometheus_text(self.stats(),
+        prefix="ptpu_serving")``)."""
+        if not getattr(self._lib, "_ptpu_has_http", False):
+            raise RuntimeError("prom_text needs the r10 ABI")
+        return self._lib.ptpu_serving_prom_text(
+            self._handle()).decode()
+
+    def drain_begin(self) -> None:
+        """Two-phase shutdown, half one: stop accepting framed
+        connections and flip ``GET /healthz`` to 503 "draining" while
+        existing connections (and the HTTP listener) keep answering —
+        take the node out of the load balancer, let in-flight work
+        finish, then call :meth:`stop`. Idempotent."""
+        if not getattr(self._lib, "_ptpu_has_http", False):
+            raise RuntimeError("drain_begin needs the r10 ABI")
+        self._lib.ptpu_serving_drain_begin(self._handle())
+
+    def client(self, host: str = "127.0.0.1",
+               trace: bool = False) -> "InferenceClient":
         self._handle()   # a stopped server has no port to dial
-        return InferenceClient(self.port, self.authkey, host=host)
+        return InferenceClient(self.port, self.authkey, host=host,
+                               trace=trace)
 
     def stop(self) -> None:
         if getattr(self, "_h", None):
@@ -187,7 +258,15 @@ class InferenceClient:
 
     def __init__(self, port: int, authkey: bytes,
                  host: str = "127.0.0.1", timeout_s: float = 60.0,
-                 connect_retry_s: float = 5.0):
+                 connect_retry_s: float = 5.0, trace: bool = False):
+        # trace=True sends v2 frames carrying a fresh 8-byte trace id
+        # per request, checks the server's echo, and records a
+        # client-side span per call into `trace_spans` — merge them
+        # with the server's GET /tracez via
+        # profiler.timeline.merge_request_trace. Only enable against
+        # r10+ servers: old servers close on v2 frames.
+        self.trace = trace
+        self.trace_spans: List[dict] = []
         deadline = time.monotonic() + connect_retry_s
         delay = 0.02
         while True:
@@ -237,11 +316,52 @@ class InferenceClient:
         (mlen,) = _U32.unpack_from(f, 2)
         return json.loads(f[6:6 + mlen].decode())
 
+    # ------------------------------------------------------- tracing
+    @staticmethod
+    def _new_trace_id() -> int:
+        """A fresh nonzero 8-byte trace id."""
+        tid = 0
+        while not tid:
+            tid = int.from_bytes(os.urandom(8), "little")
+        return tid
+
+    def _trace_begin(self):
+        """-> (trace_id, t0_us) — (0, 0) when tracing is off."""
+        if not self.trace:
+            return 0, 0
+        return self._new_trace_id(), _now_us()
+
+    # client-side span list cap: a long-lived traced client (soak
+    # test, always-on sidecar) must not grow memory without bound —
+    # the OLDEST half is dropped past this, mirroring the server
+    # ring's keep-the-newest semantics
+    TRACE_SPANS_MAX = 4096
+
+    def _trace_end(self, tid: int, t0_us: int, name: str,
+                   f: bytes) -> None:
+        """Record the client-side span and verify the server echo."""
+        if not tid:
+            return
+        got = _frame_trace_id(f)
+        # ERR replies are v1 by contract; REP frames must echo
+        if f[1] not in (TAG_INFER_ERR,) and got != tid:
+            raise ConnectionError(
+                f"trace id echo mismatch: sent {tid:#x}, got {got:#x}")
+        if len(self.trace_spans) >= self.TRACE_SPANS_MAX:
+            del self.trace_spans[:self.TRACE_SPANS_MAX // 2]
+        self.trace_spans.append({"trace_id": tid, "name": name,
+                                 "t0_us": t0_us, "t1_us": _now_us()})
+
     # --------------------------------------------------------- infer
     def _encode_request(self, req_id: int,
-                        arrays: Sequence[np.ndarray]) -> bytes:
-        parts = [bytes([WIRE_VERSION, TAG_INFER_REQ]),
-                 _U64.pack(req_id), struct.pack("<H", len(arrays))]
+                        arrays: Sequence[np.ndarray],
+                        trace_id: int = 0) -> bytes:
+        if trace_id:
+            parts = [bytes([WIRE_VERSION_TRACED, TAG_INFER_REQ]),
+                     _U64.pack(trace_id)]
+        else:
+            parts = [bytes([WIRE_VERSION, TAG_INFER_REQ])]
+        parts += [_U64.pack(req_id), struct.pack("<H", len(arrays))]
         for a in arrays:
             a = np.ascontiguousarray(a)
             dt = _NP_TO_DT.get(a.dtype.name)
@@ -256,15 +376,18 @@ class InferenceClient:
     def _decode_reply(f: bytes):
         """-> (req_id, outputs-list | ServingError). Server-side
         request errors come back as a VALUE so pipelined readers can
-        keep draining the stream in sync; plain infer() raises it."""
-        req_id = _U64.unpack_from(f, 2)[0]
+        keep draining the stream in sync; plain infer() raises it.
+        Traced (v2) replies shift every body offset by TRACE_EXT."""
+        base = _frame_base(f)
+        req_id = _U64.unpack_from(f, 2 + base)[0]
         if f[1] == TAG_INFER_ERR:
-            (mlen,) = _U32.unpack_from(f, 10)
-            return req_id, ServingError(f[14:14 + mlen].decode())
+            (mlen,) = _U32.unpack_from(f, 10 + base)
+            return req_id, ServingError(
+                f[14 + base:14 + base + mlen].decode())
         if f[1] != TAG_INFER_REP:
             raise ConnectionError(f"unexpected reply tag {f[1]:#x}")
-        (nout,) = struct.unpack_from("<H", f, 10)
-        off = 12
+        (nout,) = struct.unpack_from("<H", f, 10 + base)
+        off = 12 + base
         outs = []
         for _ in range(nout):
             nd = f[off]
@@ -283,11 +406,14 @@ class InferenceClient:
         ServingError on a server-side INFER_ERR."""
         rid = self._next_id
         self._next_id += 1
-        self._send_frame(self._encode_request(rid, arrays))
-        got_id, outs = self._decode_reply(self._read_frame())
+        tid, t0 = self._trace_begin()
+        self._send_frame(self._encode_request(rid, arrays, tid))
+        f = self._read_frame()
+        got_id, outs = self._decode_reply(f)
         if got_id != rid:
             raise ConnectionError(
                 f"reply id {got_id} != request id {rid}")
+        self._trace_end(tid, t0, "client.infer", f)
         if isinstance(outs, ServingError):
             raise outs
         return outs
@@ -309,12 +435,16 @@ class InferenceClient:
             while sent < len(requests) and len(pending) < depth:
                 rid = self._next_id
                 self._next_id += 1
-                pending[rid] = sent
+                tid, t0 = self._trace_begin()
+                pending[rid] = (sent, tid, t0)
                 self._send_frame(
-                    self._encode_request(rid, requests[sent]))
+                    self._encode_request(rid, requests[sent], tid))
                 sent += 1
-            got_id, outs = self._decode_reply(self._read_frame())
-            results[pending.pop(got_id)] = outs
+            f = self._read_frame()
+            got_id, outs = self._decode_reply(f)
+            idx, tid, t0 = pending.pop(got_id)
+            self._trace_end(tid, t0, "client.infer", f)
+            results[idx] = outs
             done += 1
         if not return_exceptions:
             for r in results:
@@ -325,13 +455,14 @@ class InferenceClient:
     # -------------------------------------------------------- decode
     def _decode_reply_expect(self, want_tag: int, rid: int):
         f = self._read_frame()
-        got = _U64.unpack_from(f, 2)[0]
+        base = _frame_base(f)
+        got = _U64.unpack_from(f, 2 + base)[0]
         if got != rid:
             raise ConnectionError(
                 f"decode reply id {got} != request id {rid}")
         if f[1] == TAG_INFER_ERR:
-            (mlen,) = _U32.unpack_from(f, 10)
-            raise ServingError(f[14:14 + mlen].decode())
+            (mlen,) = _U32.unpack_from(f, 10 + base)
+            raise ServingError(f[14 + base:14 + base + mlen].decode())
         if f[1] != want_tag:
             raise ConnectionError(
                 f"unexpected decode reply tag {f[1]:#x}")
@@ -346,7 +477,7 @@ class InferenceClient:
         self._send_frame(bytes([WIRE_VERSION, TAG_DECODE_OPEN]) +
                          _U64.pack(rid))
         f = self._decode_reply_expect(TAG_DECODE_SESS, rid)
-        return _U64.unpack_from(f, 10)[0]
+        return _U64.unpack_from(f, 10 + _frame_base(f))[0]
 
     def decode_close(self, session: int) -> None:
         rid = self._next_id
@@ -356,23 +487,31 @@ class InferenceClient:
         self._decode_reply_expect(TAG_DECODE_SESS, rid)
 
     @staticmethod
-    def _decode_step_payload(rid: int, session: int,
-                             token: int) -> bytes:
+    def _decode_step_payload(rid: int, session: int, token: int,
+                             trace_id: int = 0) -> bytes:
+        if trace_id:
+            return (bytes([WIRE_VERSION_TRACED, TAG_DECODE_STEP]) +
+                    _U64.pack(trace_id) + _U64.pack(rid) +
+                    _U64.pack(session) + _I64.pack(token))
         return (bytes([WIRE_VERSION, TAG_DECODE_STEP]) +
                 _U64.pack(rid) + _U64.pack(session) + _I64.pack(token))
 
     @staticmethod
     def _decode_rep_logits(f: bytes) -> np.ndarray:
-        (n,) = _U32.unpack_from(f, 18)
-        return np.frombuffer(f, np.float32, n, 22).copy()
+        base = _frame_base(f)
+        (n,) = _U32.unpack_from(f, 18 + base)
+        return np.frombuffer(f, np.float32, n, 22 + base).copy()
 
     def decode_step(self, session: int, token: int) -> np.ndarray:
         """Feed one token into a session; returns the session's
         next-token logits (float32 vector)."""
         rid = self._next_id
         self._next_id += 1
-        self._send_frame(self._decode_step_payload(rid, session, token))
+        tid, t0 = self._trace_begin()
+        self._send_frame(
+            self._decode_step_payload(rid, session, token, tid))
         f = self._decode_reply_expect(TAG_DECODE_REP, rid)
+        self._trace_end(tid, t0, "client.decode_step", f)
         return self._decode_rep_logits(f)
 
     def decode_step_many(self, pairs, return_exceptions: bool = False):
@@ -386,19 +525,24 @@ class InferenceClient:
         for i, (sess, tok) in enumerate(pairs):
             rid = self._next_id
             self._next_id += 1
-            pending[rid] = i
-            self._send_frame(self._decode_step_payload(rid, sess, tok))
+            tid, t0 = self._trace_begin()
+            pending[rid] = (i, tid, t0)
+            self._send_frame(
+                self._decode_step_payload(rid, sess, tok, tid))
         while pending:
             f = self._read_frame()
-            got = _U64.unpack_from(f, 2)[0]
+            got = _U64.unpack_from(f, 2 + _frame_base(f))[0]
             if got not in pending:
                 raise ConnectionError(
                     f"unexpected decode reply id {got}")
-            i = pending.pop(got)
+            i, tid, t0 = pending.pop(got)
+            base = _frame_base(f)
             if f[1] == TAG_INFER_ERR:
-                (mlen,) = _U32.unpack_from(f, 10)
-                results[i] = ServingError(f[14:14 + mlen].decode())
+                (mlen,) = _U32.unpack_from(f, 10 + base)
+                results[i] = ServingError(
+                    f[14 + base:14 + base + mlen].decode())
             elif f[1] == TAG_DECODE_REP:
+                self._trace_end(tid, t0, "client.decode_step", f)
                 results[i] = self._decode_rep_logits(f)
             else:
                 raise ConnectionError(

@@ -166,13 +166,23 @@ def _is_hist(v) -> bool:
     return isinstance(v, dict) and set(v) >= {"count", "sum", "buckets"}
 
 
-def _prom_emit(lines, name, v, labels: str):
+def _prom_emit(lines, name, v, labels: str, seen: set):
+    """One metric family sample set in proper exposition form:
+    histograms render CUMULATIVE ``le``-edged ``_bucket`` series plus
+    ``_sum``/``_count`` (so ``histogram_quantile`` works in Grafana),
+    and each family gets exactly ONE ``# TYPE`` line even when it
+    repeats under different label sets (per-table metrics)."""
     if _is_hist(v):
-        lines.append(f"# TYPE {name} histogram")
+        if name not in seen:
+            seen.add(name)
+            lines.append(f"# TYPE {name} histogram")
         cum = 0
+        nb = len(v["buckets"])
         for b, c in enumerate(v["buckets"]):
             cum += c
-            le = "0" if b == 0 else ("+Inf" if b == HIST_BUCKETS - 1
+            # log2 bucket b covers [2**(b-1), 2**b): upper edge is
+            # 2**b - 1; the overflow tail is +Inf
+            le = "0" if b == 0 else ("+Inf" if b == nb - 1
                                      else str(2 ** b - 1))
             sep = "," if labels else ""
             lines.append(f'{name}_bucket{{{labels}{sep}le="{le}"}} {cum}')
@@ -181,7 +191,9 @@ def _prom_emit(lines, name, v, labels: str):
         lines.append(f"{name}_count{{{labels}}} {v['count']}" if labels
                      else f"{name}_count {v['count']}")
     else:
-        lines.append(f"# TYPE {name} counter")
+        if name not in seen:
+            seen.add(name)
+            lines.append(f"# TYPE {name} counter")
         lines.append(f"{name}{{{labels}}} {v}" if labels
                      else f"{name} {v}")
 
@@ -191,13 +203,19 @@ def prometheus_text(snapshot: dict, prefix: str = "ptpu",
     """Render a (possibly nested) snapshot in Prometheus exposition
     format. Nested dict keys join the metric name with ``_``, except a
     ``"tables"`` level: its children become a ``table="<name>"`` label
-    (per-table stats stay one metric family)."""
+    (per-table stats stay one metric family).
+
+    The C twin (``csrc/ptpu_trace.cc PromFromStatsJson``, behind the
+    servers' ``GET /metrics``) walks the same snapshot the same way —
+    the two outputs are byte-identical for identical snapshots
+    (tested in tests/test_trace.py)."""
     base = ",".join(f'{k}="{v}"' for k, v in (labels or {}).items())
     lines: list = []
+    seen: set = set()
 
     def walk(path, node, lbl):
         for k, v in node.items():
-            if k == "tables" and isinstance(v, dict):
+            if k == "tables" and isinstance(v, dict) and not _is_hist(v):
                 for tname, tnode in v.items():
                     sep = "," if lbl else ""
                     walk(path + ["table"], tnode,
@@ -205,7 +223,8 @@ def prometheus_text(snapshot: dict, prefix: str = "ptpu",
             elif isinstance(v, dict) and not _is_hist(v):
                 walk(path + [k], v, lbl)
             elif isinstance(v, (int, float)) or _is_hist(v):
-                _prom_emit(lines, _prom_name(prefix, *path, k), v, lbl)
+                _prom_emit(lines, _prom_name(prefix, *path, k), v, lbl,
+                           seen)
             # strings/None (backend tags etc.) are not metrics: skipped
 
     walk([], snapshot, base)

@@ -13,6 +13,20 @@ import json
 import os
 from typing import Dict, List, Optional, Sequence
 
+# Server-side request-lifecycle span kinds (csrc/ptpu_trace.h Kind /
+# kSpanKindNames — tools/ptpu_check.py's `trace` checker holds the two
+# in lockstep). /tracez reports these names per span.
+SPAN_KIND_NAMES = {
+    0: "net.read",
+    1: "batch.queue",
+    2: "batch.fill",
+    3: "predictor.run",
+    4: "net.flush",
+    5: "ps.pull",
+    6: "ps.push",
+    7: "decode.step",
+}
+
 
 def _load(path: str) -> List[dict]:
     with open(path) as f:
@@ -56,6 +70,82 @@ def merge_timelines(paths: Sequence[str], out_path: str,
     out = {"traceEvents": merged, "displayTimeUnit": "ms"}
     with open(out_path, "w") as f:
         json.dump(out, f)
+    return out
+
+
+def _span_events(spans, pid: int, lane_of) -> List[dict]:
+    """Server /tracez span dicts -> chrome complete ('X') events."""
+    out = []
+    for sp in spans:
+        t0, t1 = sp.get("t0_us", 0), sp.get("t1_us", 0)
+        out.append({
+            "name": sp.get("kind", "span"),
+            "ph": "X", "pid": pid,
+            "tid": lane_of(sp.get("trace_id", 0)),
+            "ts": t0, "dur": max(t1 - t0, 0),
+            "args": {k: sp[k] for k in ("trace_id", "conn", "arg")
+                     if k in sp},
+        })
+    return out
+
+
+def merge_request_trace(client_spans: Sequence[dict],
+                        server_tracez,
+                        out_path: Optional[str] = None,
+                        trace_id: Optional[int] = None) -> dict:
+    """Merge CLIENT-side request spans with SERVER-side /tracez spans
+    into ONE chrome trace — a single slow request becomes visible
+    across the process boundary.
+
+    client_spans: the ``InferenceClient(trace=True).trace_spans`` list
+    (dicts with ``trace_id``/``name``/``t0_us``/``t1_us``).
+    server_tracez: a ``GET /tracez`` JSON dict (or just its ``spans``
+    list). Both sides stamp CLOCK_MONOTONIC microseconds (time.
+    monotonic_ns vs C++ steady_clock), so same-host spans align with
+    no skew correction; cross-host merges should align externally.
+
+    trace_id filters both sides to one request. Each trace id gets its
+    own thread lane; client events land in pid 0, server in pid 1.
+    Returns (and optionally writes) the chrome trace dict."""
+    if isinstance(server_tracez, dict):
+        server_spans = list(server_tracez.get("spans", []))
+        # slow-ring entries carry their breakdown inline: surface them
+        # in the same view (they have no per-span trace_id field)
+        for slow in server_tracez.get("slow", []):
+            for sp in slow.get("spans", []):
+                server_spans.append(dict(sp, trace_id=slow.get(
+                    "trace_id", 0), conn=slow.get("conn", 0)))
+    else:
+        server_spans = list(server_tracez)
+    if trace_id is not None:
+        client_spans = [s for s in client_spans
+                        if s.get("trace_id") == trace_id]
+        server_spans = [s for s in server_spans
+                        if s.get("trace_id") == trace_id]
+    lanes: Dict[int, int] = {}
+
+    def lane_of(tid: int) -> int:
+        return lanes.setdefault(tid, len(lanes))
+
+    merged: List[dict] = [
+        {"name": "process_name", "ph": "M", "pid": 0,
+         "args": {"name": "client"}},
+        {"name": "process_name", "ph": "M", "pid": 1,
+         "args": {"name": "server"}},
+    ]
+    for sp in client_spans:
+        t0, t1 = sp.get("t0_us", 0), sp.get("t1_us", 0)
+        merged.append({
+            "name": sp.get("name", "client.request"),
+            "ph": "X", "pid": 0, "tid": lane_of(sp.get("trace_id", 0)),
+            "ts": t0, "dur": max(t1 - t0, 0),
+            "args": {"trace_id": sp.get("trace_id", 0)},
+        })
+    merged.extend(_span_events(server_spans, pid=1, lane_of=lane_of))
+    out = {"traceEvents": merged, "displayTimeUnit": "ms"}
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(out, f)
     return out
 
 

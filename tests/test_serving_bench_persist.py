@@ -91,3 +91,50 @@ class TestServingBenchPersist:
         for st in phases.values():
             assert "server" in st and "batcher" in st
             assert st["batcher"]["batch_fill"]["count"] > 0
+
+
+class TestTraceAbPersist:
+    """`--trace` mode (ISSUE 10): the tracing-on/off overhead A/B
+    persists both planes' interleaved rounds and the exactness rows.
+    The 3% gate itself is a full-size committed-bench property
+    (BENCH_TRACE_r01.json), not assertable from a smoke config."""
+
+    @pytest.fixture(scope="class")
+    def trace_out(self, tmp_path_factory):
+        out = str(tmp_path_factory.mktemp("trb") / "BENCH_TRACE.json")
+        env = dict(os.environ)
+        env.update({
+            "PTPU_SRVBENCH_CLIENTS": "2", "PTPU_SRVBENCH_OPS": "20",
+            "PTPU_SRVBENCH_MAX_BATCH": "4",
+            "PTPU_SRVBENCH_SKIP_BUILD": "1",
+            "PTPU_TRBENCH_PULL_OPS": "200",
+            "PTPU_TRBENCH_ROUNDS": "1",
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH",
+                                                      ""),
+        })
+        env.pop("XLA_FLAGS", None)
+        r = subprocess.run([sys.executable, BENCH, "--trace", "--out",
+                            out], cwd=REPO, env=env,
+                           capture_output=True, text=True, timeout=600)
+        assert r.returncode == 0, \
+            f"rc={r.returncode}\nstdout:{r.stdout[-2000:]}\n" \
+            f"stderr:{r.stderr[-2000:]}"
+        with open(out) as f:
+            return json.load(f)
+
+    def test_schema_and_counters(self, trace_out):
+        assert trace_out["bench"] == "serving_bench --trace"
+        assert trace_out["trace_on_config"] == {"sample": 64,
+                                                "slow_us": 100000}
+        by = {r["metric"]: r for r in trace_out["measurements"]}
+        for leg in ("trace_ab_serving_batched",
+                    "trace_ab_ps_pipelined_pull"):
+            row = by[leg]
+            assert len(row["off"]) >= 1 and len(row["on"]) >= 1
+            assert all(v > 0 for v in row["off"] + row["on"])
+            assert isinstance(row["within_3pct"], bool)
+            assert row["acceptance_max_pct"] == 3.0
+        exact = by["trace_ab_counters_exact"]
+        assert exact["value"] == 1, exact
+        assert all(e["exact"] for e in exact["legs"])

@@ -31,7 +31,7 @@ ABI_FILES = [
     "csrc/ptpu_runtime.cc", "csrc/ptpu_ps_table.cc",
     "csrc/ptpu_ps_server.cc", "csrc/ptpu_predictor.cc",
     "csrc/ptpu_serving.cc", "csrc/ptpu_net.cc",
-    "csrc/ptpu_inference_api.h",
+    "csrc/ptpu_trace.cc", "csrc/ptpu_inference_api.h",
     "paddle_tpu/core/native.py", "goapi/predictor.go",
 ]
 WIRE_FILES = [
@@ -47,6 +47,13 @@ STATS_FILES = [
 NET_FILES = [
     "csrc/ptpu_net.cc", "csrc/ptpu_net.h",
     "csrc/ptpu_ps_server.cc", "csrc/ptpu_serving.cc",
+]
+TRACE_FILES = [
+    "csrc/ptpu_trace.h", "csrc/ptpu_trace.cc",
+    "csrc/ptpu_ps_server.cc", "csrc/ptpu_serving.cc",
+    "paddle_tpu/profiler/timeline.py",
+    "paddle_tpu/inference/serving.py",
+    "paddle_tpu/distributed/ps/wire.py",
 ]
 
 
@@ -85,7 +92,7 @@ class TestLiveTree:
         assert r.returncode == 0
         names = set(r.stdout.split())
         assert names == {"abi", "wire", "stats", "locks", "net",
-                         "nullcheck"}
+                         "nullcheck", "trace"}
 
 
 class TestAbiChecker:
@@ -146,8 +153,8 @@ class TestWireChecker:
         drift the tag check cannot see."""
         root = _fixture(tmp_path, WIRE_FILES)
         _mutate(root, "csrc/ptpu_ps_server.cc",
-                "PutU32(rep.data(), uint32_t(10 + body));",
-                "PutU32(rep.data(), uint32_t(8 + body));")
+                "PutU32(rep.data(), uint32_t(10 + ho + body));",
+                "PutU32(rep.data(), uint32_t(8 + ho + body));")
         msgs = [f.message for f in _run(root, "wire")]
         assert any("PULL_REP header" in m for m in msgs)
 
@@ -162,20 +169,20 @@ class TestWireChecker:
 
     def test_catches_decode_layout_drift(self, tmp_path):
         """Moving the DECODE_REP logits count off payload offset 18
-        (C-side write at +22 in the length-prefixed buffer) must trip
-        the layout probe."""
+        (C-side write at ho + 16 past the reply header) must trip the
+        layout probe."""
         root = _fixture(tmp_path, WIRE_FILES)
         _mutate(root, "csrc/ptpu_serving.cc",
-                "PutU32(f.data() + 22, uint32_t(dec_logit_elems));",
-                "PutU32(f.data() + 20, uint32_t(dec_logit_elems));")
+                "PutU32(f.data() + ho + 16, uint32_t(dec_logit_elems));",
+                "PutU32(f.data() + ho + 14, uint32_t(dec_logit_elems));")
         msgs = [f.message for f in _run(root, "wire")]
         assert any("DECODE_REP n_logits" in m for m in msgs)
 
     def test_catches_decode_step_size_drift(self, tmp_path):
         root = _fixture(tmp_path, WIRE_FILES)
         _mutate(root, "csrc/ptpu_serving.cc",
-                "if (n != 2 + 8 + 8 + 8) return proto_err();",
-                "if (n < 2 + 8 + 8) return proto_err();")
+                "if (n != 2 + ext + 8 + 8 + 8) return proto_err();",
+                "if (n < 2 + ext + 8 + 8) return proto_err();")
         msgs = [f.message for f in _run(root, "wire")]
         assert any("DECODE_STEP exact-size" in m for m in msgs)
 
@@ -341,6 +348,54 @@ class TestNullcheckChecker:
             "  return ptpu_ok_a(h);\n"
             "}\n")
         assert _run(root, "nullcheck") == []
+
+
+class TestTraceChecker:
+    """The r10 request-tracing seam: traced-frame version/offset parity
+    C <-> Python and the span-kind name map C <-> timeline.py."""
+
+    def test_clean_fixture(self, tmp_path):
+        assert _run(_fixture(tmp_path, TRACE_FILES), "trace") == []
+
+    def test_catches_span_kind_rename(self, tmp_path):
+        root = _fixture(tmp_path, TRACE_FILES)
+        _mutate(root, "paddle_tpu/profiler/timeline.py",
+                '3: "predictor.run"', '3: "predictor.exec"')
+        msgs = [f.message for f in _run(root, "trace")]
+        assert any("span kind 3" in m and "predictor.run" in m
+                   for m in msgs)
+
+    def test_catches_c_kind_table_rename(self, tmp_path):
+        root = _fixture(tmp_path, TRACE_FILES)
+        _mutate(root, "csrc/ptpu_trace.cc",
+                '"batch.queue",   // kQueue',
+                '"batcher.queue", // kQueue')
+        msgs = [f.message for f in _run(root, "trace")]
+        assert any("span kind 1" in m for m in msgs)
+
+    def test_catches_traced_version_drift(self, tmp_path):
+        root = _fixture(tmp_path, TRACE_FILES)
+        _mutate(root, "paddle_tpu/inference/serving.py",
+                "WIRE_VERSION_TRACED = 2", "WIRE_VERSION_TRACED = 3")
+        msgs = [f.message for f in _run(root, "trace")]
+        assert any("kSvWireVersionTraced" in m and "drift" in m
+                   for m in msgs)
+
+    def test_catches_trace_ext_drift(self, tmp_path):
+        root = _fixture(tmp_path, TRACE_FILES)
+        _mutate(root, "paddle_tpu/distributed/ps/wire.py",
+                "TRACE_EXT = 8", "TRACE_EXT = 16")
+        msgs = [f.message for f in _run(root, "trace")]
+        assert any("TRACE_EXT = 16" in m and "kTraceExt" in m
+                   for m in msgs)
+
+    def test_catches_trace_id_offset_drift(self, tmp_path):
+        root = _fixture(tmp_path, TRACE_FILES)
+        _mutate(root, "csrc/ptpu_ps_server.cc",
+                "wire_tid = ptpu::GetU64(req + 2);",
+                "wire_tid = ptpu::GetU64(req + 3);")
+        msgs = [f.message for f in _run(root, "trace")]
+        assert any("GetU64(req + 2)" in m for m in msgs)
 
 
 class TestFindingPlumbing:

@@ -1,27 +1,42 @@
 #!/usr/bin/env python
-"""Poll a LIVE PS node's observability snapshot (ISSUE 3 tentpole).
+"""Poll a LIVE node's observability snapshot (ISSUE 3 tentpole; grown
+into the shared stats CLI by ISSUE 10).
 
-Connects to the node's control plane (the multiprocessing.connection
-listener `distributed/ps/table.py` serves) and issues the `"stats"`
-op — the reference analogue of curling a brpc server's /vars page.
-Works against any running TableService: a training job, a
-`tools/ps_bench.py` server mid-run, or the shrunken test config.
+Three addressing modes:
+
+  (default)          a PS node's CONTROL plane (the multiprocessing.
+                     connection listener `distributed/ps/table.py`
+                     serves): the `"stats"` op — the reference
+                     analogue of curling a brpc server's /vars page.
+  --http HOST:PORT   the telemetry HTTP endpoint either C server
+                     (PS data plane or serving runtime) exposes on the
+                     epoll net core (ISSUE 10): GET /statsz (JSON) or
+                     GET /metrics (--prom, served byte-identical to
+                     the local renderer).
+  --serving HOST:PORT  alias of --http for a serving runtime — same
+                     fetch; the --watch delta line shows infer/decode
+                     ops/s instead of pull/push.
 
 Output modes:
   (default)      pretty JSON snapshot
   --prom         Prometheus exposition text (profiler/stats.py
-                 prometheus_text) — pipe to a file node_exporter-style
+                 prometheus_text; over --http the server's C-rendered
+                 /metrics bytes) — pipe to a file node_exporter-style
                  or serve it from a sidecar
-  --watch SEC    poll every SEC seconds; prints pull/push ops/s and
-                 MB/s deltas between polls plus the snapshot
-  --reset        zero the node's counters ("stats_reset" op) and exit
+  --watch SEC    poll every SEC seconds; prints ops/s and MB/s deltas
+                 between polls plus the snapshot (pull/push for a PS
+                 snapshot, infer/decode for a serving one — detected
+                 from the snapshot shape)
+  --reset        zero the node's counters ("stats_reset" op; control
+                 plane only) and exit
 
-Addressing mirrors the launcher env contract: the control port of rank
-R is MASTER_PORT + 200 + R and the connection authkey derives from
-MASTER_PORT (same derivation as the service itself).
+Addressing for the default mode mirrors the launcher env contract: the
+control port of rank R is MASTER_PORT + 200 + R and the connection
+authkey derives from MASTER_PORT (same derivation as the service).
 
 Run: python tools/ps_stats.py [--master-port 8476] [--rank 0]
-         [--host 127.0.0.1] [--prom | --watch 2 | --reset]
+         [--host 127.0.0.1] [--http H:P | --serving H:P]
+         [--prom | --watch 2 | --reset]
 """
 from __future__ import annotations
 
@@ -64,14 +79,64 @@ def fetch_stats(master_port: int, rank: int = 0,
         conn.close()
 
 
+def http_get(hostport: str, path: str, timeout_s: float = 10.0):
+    """GET one telemetry path off a C server's HTTP endpoint; returns
+    the body bytes. Raises RuntimeError on a non-200 status."""
+    from urllib.error import HTTPError
+    from urllib.request import urlopen
+
+    url = f"http://{hostport}{path}"
+    try:
+        with urlopen(url, timeout=timeout_s) as r:
+            return r.read()
+    except HTTPError as e:   # 503 draining etc: surface the status
+        raise RuntimeError(
+            f"GET {url} -> {e.code} {e.reason}") from e
+
+
+def fetch_http_stats(hostport: str, timeout_s: float = 10.0) -> dict:
+    """GET /statsz of a PS data-plane or serving telemetry endpoint."""
+    return json.loads(http_get(hostport, "/statsz", timeout_s))
+
+
+def _is_serving(snap: dict) -> bool:
+    """A serving runtime snapshot carries the batcher section; a PS
+    node's carries pull/push counters."""
+    return "batcher" in snap
+
+
 def _rates(prev: dict, cur: dict, dt: float) -> str:
+    """One ops/s + MB/s delta line between two polls; the counter set
+    is picked from the snapshot shape (PS vs serving)."""
+    if _is_serving(cur):
+        def d(key):
+            return (cur.get("server", {}).get(key, 0) -
+                    prev.get("server", {}).get(key, 0))
+
+        def dd(key):
+            return (cur.get("decode", {}).get(key, 0) -
+                    prev.get("decode", {}).get(key, 0))
+        mb = (d("bytes_in") + d("bytes_out")) / dt / 1e6
+        conns = cur.get("server", {}).get("conns_active", 0)
+        line = (f"infer {d('requests') / dt:,.0f} req/s "
+                f"({d('replies') / dt:,.0f} rep/s, "
+                f"{d('req_errors') / dt:,.0f} err/s)")
+        if "decode" in cur:
+            line += (f" | decode {dd('steps') / dt:,.0f} steps/s "
+                     f"({cur['decode'].get('sessions_active', 0)} "
+                     f"sessions)")
+        return line + f" | {mb:,.1f} MB/s | conns {conns}"
+    # PS planes: the control-plane snapshot nests wire counters under
+    # "wire"; the HTTP /statsz one keeps them under "server"
+    sec = "wire" if "wire" in cur else "server"
+
     def d(key):
-        return (cur.get("wire", {}).get(key, 0) -
-                prev.get("wire", {}).get(key, 0))
+        return (cur.get(sec, {}).get(key, 0) -
+                prev.get(sec, {}).get(key, 0))
     mb = (d("bytes_in") + d("bytes_out")) / dt / 1e6
     # live connection view from the epoll net core (C data plane)
-    conns = cur.get("wire", {}).get("conns_active", 0)
-    shed = cur.get("wire", {}).get("conns_shed", 0)
+    conns = cur.get(sec, {}).get("conns_active", 0)
+    shed = cur.get(sec, {}).get("conns_shed", 0)
     return (f"pull {d('pull_ops') / dt:,.0f} ops/s "
             f"({d('pull_rows') / dt:,.0f} rows/s) | "
             f"push {d('push_ops') / dt:,.0f} ops/s "
@@ -82,41 +147,63 @@ def _rates(prev: dict, cur: dict, dt: float) -> str:
 
 def main(argv=None):
     ap = argparse.ArgumentParser(
-        description="poll a live PS node's stats snapshot")
+        description="poll a live PS / serving node's stats snapshot")
     ap.add_argument("--master-port", type=int,
                     default=int(os.environ.get("MASTER_PORT", "8476")))
     ap.add_argument("--rank", type=int, default=0)
     ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--http", default=None, metavar="HOST:PORT",
+                    help="poll a C server's telemetry HTTP endpoint "
+                         "(GET /statsz, /metrics) instead of the "
+                         "control plane")
+    ap.add_argument("--serving", default=None, metavar="HOST:PORT",
+                    help="poll a serving runtime's telemetry endpoint "
+                         "(same as --http; --watch shows infer/decode "
+                         "deltas)")
     ap.add_argument("--prom", action="store_true",
                     help="Prometheus exposition format")
     ap.add_argument("--watch", type=float, default=None, metavar="SEC",
                     help="poll every SEC seconds with ops/s deltas")
     ap.add_argument("--reset", action="store_true",
-                    help="zero the node's counters and exit")
+                    help="zero the node's counters and exit "
+                         "(control-plane mode only)")
     a = ap.parse_args(argv)
+    endpoint = a.serving or a.http
 
     if a.reset:
+        if endpoint:
+            sys.exit("--reset needs the control plane (the HTTP "
+                     "endpoint is read-only)")
         fetch_stats(a.master_port, a.rank, a.host, op="stats_reset")
         print(f"rank {a.rank} stats reset")
         return
 
     from paddle_tpu.profiler.stats import prometheus_text
 
+    def fetch():
+        if endpoint:
+            return fetch_http_stats(endpoint)
+        return fetch_stats(a.master_port, a.rank, a.host)
+
     def render(snap):
         if a.prom:
+            if endpoint:
+                # the server's own C renderer — byte-identical to
+                # prometheus_text over /statsz, and one fetch fresher
+                return http_get(endpoint, "/metrics").decode()
             return prometheus_text(
                 snap, prefix="ptpu_ps",
                 labels={"rank": str(snap.get("rank", a.rank))})
         return json.dumps(snap, indent=1, sort_keys=True)
 
-    snap = fetch_stats(a.master_port, a.rank, a.host)
+    snap = fetch()
     last = time.time()
     print(render(snap), flush=True)
     if a.watch is None:
         return
     while True:
         time.sleep(a.watch)
-        nxt = fetch_stats(a.master_port, a.rank, a.host)
+        nxt = fetch()
         now = time.time()
         print(f"# {time.strftime('%H:%M:%S')} "
               f"{_rates(snap, nxt, max(1e-9, now - last))}",

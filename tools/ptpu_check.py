@@ -30,6 +30,12 @@ Go that the compiler cannot see across:
   nullcheck  every extern-C ABI entry taking an opaque handle guards
              NULL before dereferencing (ctypes/cgo can always hand one
              back after a failed create or a teardown race)
+  trace      request-tracing seam (ISSUE 10): the traced v2 frame
+             extension (version byte, 8-byte trace-id insert, read and
+             echo offsets) in csrc (ptpu_ps_server.cc, ptpu_serving.cc)
+             == the Python twins (wire.py, serving.py), and the C span
+             recorder's kind-name table (csrc/ptpu_trace.cc)  ==  the
+             timeline name map (profiler/timeline.py SPAN_KIND_NAMES)
 
 No clang, no compilation: regex/AST over the sources, so the suite runs
 in milliseconds and anywhere. Exit 0 == no findings. Each checker is
@@ -162,10 +168,10 @@ def _lineno(src: str, pos: int) -> int:
 SO_SOURCES = {
     "_native.so": ["csrc/ptpu_runtime.cc"],
     "_native_ps.so": ["csrc/ptpu_ps_table.cc", "csrc/ptpu_ps_server.cc",
-                      "csrc/ptpu_net.cc"],
+                      "csrc/ptpu_net.cc", "csrc/ptpu_trace.cc"],
     "_native_predictor.so": ["csrc/ptpu_predictor.cc",
                              "csrc/ptpu_serving.cc",
-                             "csrc/ptpu_net.cc"],
+                             "csrc/ptpu_net.cc", "csrc/ptpu_trace.cc"],
 }
 
 _EXPORT_RES = [
@@ -393,9 +399,10 @@ def check_wire(root: str) -> List[Finding]:
                     "kWireVersion", f)
 
         # layout probe: PULL_REP header is [ver][tag][u32 n][u32 dim] =
-        # 10 payload bytes. Python: _PULL_REP_HDR = 2 + Struct("<II");
-        # C: the reply writes its frame length as 10 + body and the
-        # gather body at rep.data() + 14 (4B length prefix + 10).
+        # 10 payload bytes (+`ho` == the 8-byte trace-id echo for v2
+        # frames). Python: _PULL_REP_HDR = 2 + Struct("<II"); C: the
+        # reply writes its frame length as 10 + ho + body and the
+        # gather body at rep.data() + 14 + ho (4B length prefix + 10).
         u32x2 = _py_struct_size(pyw, "_U32x2")
         if u32x2 is None:
             f.append(Finding("wire", pyw_rel, 0,
@@ -404,7 +411,7 @@ def check_wire(root: str) -> List[Finding]:
             py_hdr = 2 + u32x2
             clean = strip_c_comments(ps_c)
             m = re.search(r"PutU32\(rep\.data\(\),\s*uint32_t\((\d+)\s*\+"
-                          r"\s*body\)\)", clean)
+                          r"\s*ho\s*\+\s*body\)\)", clean)
             if not m:
                 f.append(Finding("wire", ps_rel, 0,
                                  "PULL_REP frame-length expression not "
@@ -414,11 +421,12 @@ def check_wire(root: str) -> List[Finding]:
                     "wire", ps_rel, _lineno(clean, m.start()),
                     f"PULL_REP header is {m.group(1)} bytes in C but "
                     f"_PULL_REP_HDR = {py_hdr} in wire.py"))
-            m = re.search(r"rep\.data\(\)\s*\+\s*(\d+);", clean)
+            m = re.search(r"rep\.data\(\)\s*\+\s*(\d+)\s*\+\s*ho;",
+                          clean)
             if m and int(m.group(1)) != py_hdr + 4:
                 f.append(Finding(
                     "wire", ps_rel, _lineno(clean, m.start()),
-                    f"PULL_REP body lands at +{m.group(1)} in the C "
+                    f"PULL_REP body lands at +{m.group(1)}+ho in the C "
                     f"reply buffer; expected 4-byte length prefix + "
                     f"{py_hdr}"))
             # PUSH_REQ fixed block after the table name:
@@ -438,51 +446,62 @@ def check_wire(root: str) -> List[Finding]:
         _tag_parity(sv_rel, c_consts, pys_rel, py_consts, SV_TAGS,
                     "kSvWireVersion", f)
 
-        # layout probe: INFER frames lead with [ver][tag][u64 req_id]
-        # [u16 count] = 12 payload bytes; the C parser enforces
-        # n >= 2 + 8 + 2 and Python unpacks the count at offset 10.
+        # layout probe: INFER frames lead with [ver][tag](+trace id)
+        # [u64 req_id][u16 count] — the C parser enforces
+        # n >= 2 + ext + 8 + 2 (ext == 0 for v1, 8 for traced v2) and
+        # Python unpacks the count at offset 10 + base.
         clean = strip_c_comments(sv_c)
-        if not re.search(r"n\s*<\s*2\s*\+\s*8\s*\+\s*2", clean):
+        if not re.search(r"n\s*<\s*2\s*\+\s*ext\s*\+\s*8\s*\+\s*2",
+                         clean):
             f.append(Finding("wire", sv_rel, 0,
-                             "INFER_REQ minimum-size check (2 + 8 + 2) "
-                             "not found (layout probe)"))
+                             "INFER_REQ minimum-size check (2 + ext + "
+                             "8 + 2) not found (layout probe)"))
         if not re.search(r'unpack_from\(\s*f,\s*10\s*\)|"<H",\s*f,\s*10',
                          pys):
             f.append(Finding("wire", pys_rel, 0,
                              "INFER reply count at payload offset 10 "
                              "not found (layout probe)"))
 
-        # DECODE layout probes (r9). STEP payload is
-        # [ver][tag][u64 req_id][u64 session][i64 token] = 26 bytes —
-        # the C parser must pin exactly that; the REP payload carries
-        # [u32 n_logits] at offset 18 and the f32 body at 22, which the
-        # C writer addresses at +22/+26 in the length-prefixed reply
-        # buffer and the Python reader at 18/22 on the stripped payload.
-        if not re.search(r"n\s*!=\s*2\s*\+\s*8\s*\+\s*8\s*\+\s*8", clean):
+        # DECODE layout probes (r9, traced offsets r10). STEP payload
+        # is [ver][tag](+trace id)[u64 req_id][u64 session][i64 token]
+        # = 26 + ext bytes — the C parser must pin exactly that. The
+        # REP payload carries [u32 n_logits] at offset 18 + base and
+        # the f32 body at 22 + base; the C writer addresses them at
+        # ho + 16 / ho + 20 in the length-prefixed reply buffer, where
+        # ho == RepHdr's return (6 untraced == 4B length + [ver][tag]).
+        if not re.search(r"n\s*!=\s*2\s*\+\s*ext\s*\+\s*8\s*\+\s*8"
+                         r"\s*\+\s*8", clean):
             f.append(Finding("wire", sv_rel, 0,
-                             "DECODE_STEP exact-size check (2 + 8 + 8 "
-                             "+ 8) not found (layout probe)"))
-        m = re.search(r"PutU32\(f\.data\(\)\s*\+\s*(\d+),\s*"
+                             "DECODE_STEP exact-size check (2 + ext + "
+                             "8 + 8 + 8) not found (layout probe)"))
+        m = re.search(r"PutU32\(f\.data\(\)\s*\+\s*ho\s*\+\s*(\d+),\s*"
                       r"uint32_t\(dec_logit_elems\)\)", clean)
         if m is None:
             f.append(Finding("wire", sv_rel, 0,
                              "DECODE_REP n_logits write not found "
                              "(layout probe)"))
-        elif int(m.group(1)) != 22:
+        elif int(m.group(1)) != 16:
             f.append(Finding(
                 "wire", sv_rel, _lineno(clean, m.start()),
-                f"DECODE_REP n_logits lands at +{m.group(1)} in the C "
-                f"reply buffer; expected 4-byte length prefix + 18"))
-        if not re.search(r"unpack_from\(\s*f,\s*18\s*\)",
-                         pys.split("_decode_rep_logits", 1)[-1][:300]):
+                f"DECODE_REP n_logits lands at ho+{m.group(1)} in the "
+                f"C reply buffer; expected ho + 16 (== payload 18 for "
+                f"v1 frames)"))
+        # the untraced reply header must stay [4B len][ver][tag] == 6
+        if not re.search(r"RepHdr\([^)]*\)\s*\{.*?return\s+6;\s*\}",
+                         clean, re.S):
+            f.append(Finding("wire", sv_rel, 0,
+                             "RepHdr untraced base (return 6) not "
+                             "found (layout probe)"))
+        if not re.search(r"unpack_from\(\s*f,\s*18\s*\+\s*base\s*\)",
+                         pys.split("_decode_rep_logits", 1)[-1][:400]):
             f.append(Finding("wire", pys_rel, 0,
-                             "DECODE_REP n_logits at payload offset 18 "
-                             "not found (layout probe)"))
+                             "DECODE_REP n_logits at payload offset "
+                             "18 + base not found (layout probe)"))
         if not re.search(r"np\.frombuffer\(\s*f,\s*np\.float32,\s*n,"
-                         r"\s*22\s*\)", pys):
+                         r"\s*22\s*\+\s*base\s*\)", pys):
             f.append(Finding("wire", pys_rel, 0,
-                             "DECODE_REP f32 body at payload offset 22 "
-                             "not found (layout probe)"))
+                             "DECODE_REP f32 body at payload offset "
+                             "22 + base not found (layout probe)"))
     return f
 
 
@@ -518,7 +537,8 @@ def py_stat_names(src: str) -> Set[str]:
 # Additions here must be justified.
 PS_SERVER_C_ONLY = {"handshake_fails", "conns_accepted", "conns_active",
                     "conns_shed", "handshake_timeouts", "idle_closes",
-                    "epoll_wakeups", "partial_write_flushes"}
+                    "epoll_wakeups", "partial_write_flushes",
+                    "http_reqs"}
 
 
 def check_stats(root: str) -> List[Finding]:
@@ -827,6 +847,167 @@ def check_nullcheck(root: str) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# checker: trace
+# ---------------------------------------------------------------------------
+
+# The request-tracing seam (ISSUE 10) spans four hand-maintained
+# contracts: the v2 traced-frame extension (version byte + 8-byte
+# trace-id insert) between each C server and its Python wire twin, the
+# trace-id read/echo offsets, and the span-kind name table the C
+# recorder emits vs the Python timeline map that renders it.
+
+# C version constant -> (python twin file, python constant)
+TRACE_VERSIONS = {
+    "csrc/ptpu_serving.cc": ("kSvWireVersionTraced",
+                             "paddle_tpu/inference/serving.py"),
+    "csrc/ptpu_ps_server.cc": ("kWireVersionTraced",
+                               "paddle_tpu/distributed/ps/wire.py"),
+}
+
+# files that must agree on the 8-byte trace-id extension width
+TRACE_EXT_PY = ["paddle_tpu/inference/serving.py",
+                "paddle_tpu/distributed/ps/wire.py"]
+
+
+def _py_dict_literal(src: str, name: str, rel: str, checker: str,
+                     findings: List[Finding]):
+    """Top-level `name = {literal dict}` via ast, or None."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        findings.append(Finding(checker, rel, e.lineno or 0,
+                                f"cannot parse: {e.msg}"))
+        return None
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == name:
+            try:
+                return ast.literal_eval(node.value)
+            except (ValueError, TypeError):
+                findings.append(Finding(checker, rel, node.lineno,
+                                        f"{name} is not a literal"))
+                return None
+    findings.append(Finding(checker, rel, 0, f"{name} not found"))
+    return None
+
+
+def check_trace(root: str) -> List[Finding]:
+    f: List[Finding] = []
+    hdr_rel, cc_rel = "csrc/ptpu_trace.h", "csrc/ptpu_trace.cc"
+    tl_rel = "paddle_tpu/profiler/timeline.py"
+    hdr = _require(root, hdr_rel, "trace", f)
+    cc = _require(root, cc_rel, "trace", f)
+    tl = _require(root, tl_rel, "trace", f)
+
+    # 1) span-kind names: the C table (index == wire value in /tracez)
+    #    must equal the Python timeline map rendering those spans
+    if cc is not None and tl is not None:
+        clean = strip_c_comments(cc, keep_strings=True)
+        m = re.search(r"kSpanKindNames\s*\[[^\]]*\]\s*=\s*\{(.*?)\};",
+                      clean, re.S)
+        py_map = _py_dict_literal(tl, "SPAN_KIND_NAMES", tl_rel,
+                                  "trace", f)
+        if m is None:
+            f.append(Finding("trace", cc_rel, 0,
+                             "kSpanKindNames table not found"))
+        elif py_map is not None:
+            c_names = re.findall(r'"([^"]*)"', m.group(1))
+            line = _lineno(clean, m.start())
+            if sorted(py_map) != list(range(len(c_names))):
+                f.append(Finding(
+                    "trace", tl_rel, 0,
+                    f"SPAN_KIND_NAMES keys {sorted(py_map)} are not "
+                    f"dense 0..{len(c_names) - 1} — kind values are "
+                    f"array indices in C"))
+            else:
+                for i, cn in enumerate(c_names):
+                    if py_map.get(i) != cn:
+                        f.append(Finding(
+                            "trace", cc_rel, line,
+                            f"span kind {i} is '{cn}' in C but "
+                            f"'{py_map.get(i)}' in timeline.py "
+                            f"SPAN_KIND_NAMES — /tracez names would "
+                            f"render wrong"))
+
+    # 2) trace-id extension width: C kTraceExt == every Python
+    #    TRACE_EXT (the v2 body shift)
+    c_ext = None
+    if hdr is not None:
+        m = re.search(r"kTraceExt\s*=\s*(\d+)", hdr)
+        if m is None:
+            f.append(Finding("trace", hdr_rel, 0,
+                             "kTraceExt not found"))
+        else:
+            c_ext = int(m.group(1))
+    for rel in TRACE_EXT_PY:
+        src = _require(root, rel, "trace", f)
+        if src is None:
+            continue
+        pyv = py_int_constants(src, rel, "trace", f).get("TRACE_EXT")
+        if pyv is None:
+            f.append(Finding("trace", rel, 0, "TRACE_EXT not found"))
+        elif c_ext is not None and pyv != c_ext:
+            f.append(Finding(
+                "trace", rel, 0,
+                f"TRACE_EXT = {pyv} but csrc/ptpu_trace.h kTraceExt = "
+                f"{c_ext} — traced-frame offsets drift"))
+
+    # 3) traced version bytes + trace-id offset probes per server
+    for c_rel, (c_name, py_rel) in sorted(TRACE_VERSIONS.items()):
+        c_src = _require(root, c_rel, "trace", f)
+        py_src = _require(root, py_rel, "trace", f)
+        if c_src is None or py_src is None:
+            continue
+        c_consts = c_u8_constants(c_src)
+        py_consts = py_int_constants(py_src, py_rel, "trace", f)
+        if c_name not in c_consts:
+            f.append(Finding("trace", c_rel, 0,
+                             f"{c_name} not found"))
+        elif "WIRE_VERSION_TRACED" not in py_consts:
+            f.append(Finding("trace", py_rel, 0,
+                             "WIRE_VERSION_TRACED not found"))
+        else:
+            cv, line = c_consts[c_name]
+            pv = py_consts["WIRE_VERSION_TRACED"]
+            if cv != pv:
+                f.append(Finding(
+                    "trace", c_rel, line,
+                    f"{c_name} = {cv} in C but WIRE_VERSION_TRACED = "
+                    f"{pv} in {py_rel} — traced-frame version drift"))
+        clean = strip_c_comments(c_src)
+        # the trace id sits at payload offset 2 ([ver][tag][u64 id])
+        if not re.search(r"GetU64\(req\s*\+\s*2\)", clean):
+            f.append(Finding(
+                "trace", c_rel, 0,
+                "traced-frame id read GetU64(req + 2) not found "
+                "(layout probe: [ver][tag][u64 trace id])"))
+        # replies echo it right after [4B len][ver][tag]
+        if not re.search(r"PutU64\(\w+\.data\(\)\s*\+\s*6,", clean):
+            f.append(Finding(
+                "trace", c_rel, 0,
+                "trace-id echo write at reply offset 6 not found "
+                "(layout probe: [len][ver][tag][u64 trace id])"))
+    # Python reads the id at the same payload offset 2
+    pys = _read(root, "paddle_tpu/inference/serving.py")
+    if pys is not None and \
+            not re.search(r"def _frame_trace_id[^#]*?unpack_from\(\s*f,"
+                          r"\s*2\s*\)", pys, re.S):
+        f.append(Finding("trace", "paddle_tpu/inference/serving.py", 0,
+                         "_frame_trace_id must read the id at payload "
+                         "offset 2 (layout probe)"))
+    pyw = _read(root, "paddle_tpu/distributed/ps/wire.py")
+    if pyw is not None and \
+            not re.search(r"def trace_id_of[^#]*?unpack_from\(\s*data,"
+                          r"\s*2\s*\)", pyw, re.S):
+        f.append(Finding("trace", "paddle_tpu/distributed/ps/wire.py",
+                         0,
+                         "trace_id_of must read the id at payload "
+                         "offset 2 (layout probe)"))
+    return f
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -837,6 +1018,7 @@ CHECKERS = {
     "locks": check_locks,
     "net": check_net,
     "nullcheck": check_nullcheck,
+    "trace": check_trace,
 }
 
 

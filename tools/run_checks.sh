@@ -3,10 +3,10 @@
 # against — reference: the upstream tools/ check scripts chained in CI).
 #
 #   build            the three shipping .so artifacts (-Werror on)
-#   sancheck         all three C selftests + the pure-C demo under
+#   sancheck         all five C selftests + the pure-C demo under
 #                    ASan+UBSan, fail-fast; TSan leg when libtsan exists
-#   ptpu_check       the 5 static checkers (ABI / wire / stats / locks /
-#                    nullcheck) — 0 findings required
+#   ptpu_check       the 7 static checkers (ABI / wire / stats / locks /
+#                    net / nullcheck / trace) — 0 findings required
 #   selftest         the plain (uninstrumented) native selftests
 #
 # Usage: tools/run_checks.sh [-j N]
@@ -42,7 +42,7 @@ else
   step "sancheck: TSan SKIPPED (no usable libtsan on this machine)"
 fi
 
-step "ptpu_check: static analysis (abi / wire / stats / locks / nullcheck)"
+step "ptpu_check: static analysis (abi / wire / stats / locks / net / nullcheck / trace)"
 python3 tools/ptpu_check.py
 
 step "native selftests (uninstrumented)"

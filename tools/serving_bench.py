@@ -143,6 +143,197 @@ def run_phase(model_path, clients, ops, max_batch, deadline_us):
     return total / wall, stats, config, total
 
 
+# ---------------------------------------------------------------------------
+# --trace: tracing-on/off overhead A/B (ISSUE 10 acceptance gate).
+#
+# Two hot paths, each run OFF/ON interleaved (2 rounds) in ONE session
+# so machine drift cancels: the serving concurrent-batched phase (the
+# r8 headline) and a single-process pipelined PS wire pull loop (the
+# bandwidth-bound plane). "On" is the DEFAULT sampling config
+# (PTPU_TRACE_SAMPLE=64, PTPU_TRACE_SLOW_US=100000) — what production
+# pays; acceptance: on within 3% of off, counters still exact.
+# ---------------------------------------------------------------------------
+
+PULL_OPS = int(os.environ.get("PTPU_TRBENCH_PULL_OPS", 8000))
+PULL_ROWS = int(os.environ.get("PTPU_TRBENCH_PULL_ROWS", 512))
+PULL_DEPTH = int(os.environ.get("PTPU_TRBENCH_PULL_DEPTH", 8))
+
+
+def _ps_pull_connect(port, authkey):
+    """Handshaken raw socket for the pull legs. ONE connection serves
+    every off/on leg: a fresh dial per leg lands on a different event
+    thread each time (round-robin loop assignment), and thread
+    placement moves single-conn throughput by >±10% on this box —
+    keeping the conn fixed makes the A/B genuinely paired."""
+    import hashlib
+    import hmac
+    import socket
+    import struct
+
+    s = socket.create_connection(("127.0.0.1", port), 10)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    nonce = _read_n(s, 16)
+    mac = hmac.new(authkey, nonce, hashlib.sha256).digest()
+    s.sendall(struct.pack("<I", len(mac)) + mac)
+    assert _read_n(s, 1) == b"\x01"
+    return s
+
+
+def _ps_pull_ops_per_s(s, ops, rows, depth):
+    """Pipelined fast-frame pulls over an open raw socket (the
+    ps_bench pipelined-pull shape, single process)."""
+    import struct
+
+    import numpy as np
+    from paddle_tpu.distributed.ps import wire
+
+    req = bytes(wire.build_pull_req("emb", np.arange(rows)))
+    frame = struct.pack("<I", len(req)) + req
+
+    def read_reply():
+        n = struct.unpack("<I", _read_n(s, 4))[0]
+        _read_n(s, n)
+
+    warm = min(64, ops // 4)
+    for _ in range(warm):
+        s.sendall(frame)
+        read_reply()
+    t0 = time.perf_counter()
+    sent = 0
+    while sent < depth and sent < ops:
+        s.sendall(frame)
+        sent += 1
+    done = 0
+    while done < ops:
+        read_reply()
+        done += 1
+        if sent < ops:
+            s.sendall(frame)
+            sent += 1
+    dt = time.perf_counter() - t0
+    return ops / dt
+
+
+def _read_n(sock, n):
+    buf = b""
+    while len(buf) < n:
+        c = sock.recv(n - len(buf))
+        if not c:
+            raise ConnectionError("peer closed")
+        buf += c
+    return buf
+
+
+def run_trace_ab(out_path):
+    import tempfile
+
+    from paddle_tpu.core import native as N
+
+    build_native()
+    sv_lib = N._predictor_lib()
+    ps_lib = N._ps_load()
+    configs = [("off", (0, 0)), ("on", (64, 100000))]
+    rounds = int(os.environ.get("PTPU_TRBENCH_ROUNDS", 4))
+    results = {"serving_batched": {"off": [], "on": []},
+               "ps_pipelined_pull": {"off": [], "on": []}}
+    exact = []
+
+    with tempfile.TemporaryDirectory() as tmp:
+        model = build_mlp_artifact(tmp)
+        # PS table served once; only the tracing knob flips per leg
+        srv_key = b"trace-bench-key"
+        ps_srv = N.PsDataServer(0, srv_key)
+        tbl = N.NativePsTable(max(PULL_ROWS * 4, 4096), 64,
+                              optimizer="sgd", lr=0.1)
+        ps_srv.register("emb", tbl, 0)
+        # each plane's off/on legs run back-to-back with nothing in
+        # between (an 8-process serving phase perturbs thread placement
+        # enough to swamp the signal if a pull leg follows it), and the
+        # pair ORDER ALTERNATES per round — session drift on this box
+        # is a slow ramp (±10% per leg), and fixed ordering aliases it
+        # straight into the A/B; alternation cancels the linear part
+        # the pull legs run FIRST: an 8-process serving phase perturbs
+        # scheduler state for long after it exits, and the single-conn
+        # pull loop is the most placement-sensitive measurement here.
+        # One unrecorded warm leg (cold caches bias whichever config
+        # runs first), then `rounds` recorded off/on pairs — all over
+        # the SAME connection (see _ps_pull_connect)
+        psock = _ps_pull_connect(ps_srv.port, srv_key)
+        ps_lib.ptpu_trace_set(0, 0)
+        _ps_pull_ops_per_s(psock, PULL_OPS, PULL_ROWS, PULL_DEPTH)
+        for rnd in range(rounds):
+            for name, (sample, slow) in (configs if rnd % 2 == 0
+                                         else configs[::-1]):
+                ps_lib.ptpu_trace_set(sample, slow)
+                pull = _ps_pull_ops_per_s(psock, PULL_OPS, PULL_ROWS,
+                                          PULL_DEPTH)
+                results["ps_pipelined_pull"][name].append(
+                    round(pull, 1))
+        psock.close()
+        ps_srv.stop()
+        for rnd in range(rounds):
+            for name, (sample, slow) in (configs if rnd % 2 == 0
+                                         else configs[::-1]):
+                sv_lib.ptpu_trace_set(sample, slow)
+                ops, stats, _, total = run_phase(
+                    model, clients=NCLIENTS, ops=OPS,
+                    max_batch=MAX_BATCH, deadline_us=DEADLINE_US)
+                results["serving_batched"][name].append(round(ops, 1))
+                sv = stats["server"]
+                exact.append({"leg": f"serving_{name}_r{rnd}",
+                              "expected": total,
+                              "requests": sv["requests"],
+                              "replies": sv["replies"],
+                              "exact": bool(
+                                  sv["requests"] == total and
+                                  sv["replies"] == total and
+                                  sv["req_errors"] == 0)})
+    sv_lib.ptpu_trace_set(64, 100000)
+    ps_lib.ptpu_trace_set(64, 100000)
+
+    rows = []
+    all_within = True
+    for leg, vals in results.items():
+        # the phases carry ~±6% per-run session noise on this box
+        # (documented across r8-r10 bench_guards), so the 3% gate
+        # compares MEANS over the alternating rounds — drift hits both
+        # configs equally; best-of is reported alongside
+        off = sum(vals["off"]) / len(vals["off"])
+        on = sum(vals["on"]) / len(vals["on"])
+        overhead = (off - on) / off * 100.0
+        within = overhead <= 3.0
+        all_within = all_within and within
+        row = {"metric": f"trace_ab_{leg}", "unit": "ops/s",
+               "off": vals["off"], "on": vals["on"],
+               "mean_off": round(off, 1), "mean_on": round(on, 1),
+               "best_off": max(vals["off"]),
+               "best_on": max(vals["on"]),
+               "overhead_pct": round(overhead, 2),
+               "acceptance_max_pct": 3.0,
+               "within_3pct": bool(within)}
+        rows.append(row)
+        emit(row)
+    emit({"metric": "trace_ab_counters_exact",
+          "value": int(all(e["exact"] for e in exact)), "unit": "bool",
+          "legs": exact})
+    emit({"metric": "trace_ab_within_3pct", "value": int(all_within),
+          "unit": "bool"})
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump({"bench": "serving_bench --trace",
+                       "clients": NCLIENTS, "ops": OPS,
+                       "max_batch": MAX_BATCH,
+                       "deadline_us": DEADLINE_US,
+                       "instances": INSTANCES,
+                       "pull": {"ops": PULL_OPS, "rows": PULL_ROWS,
+                                "depth": PULL_DEPTH},
+                       "trace_on_config": {"sample": 64,
+                                           "slow_us": 100000},
+                       "rounds": rounds,
+                       "measurements": RESULTS}, f, indent=1)
+        print(f"# persisted to {out_path}", flush=True)
+
+
 def main():
     import tempfile
 
@@ -152,6 +343,10 @@ def main():
         if idx + 1 >= len(sys.argv):
             sys.exit("usage: serving_bench.py [--out RESULTS.json]")
         out_path = sys.argv[idx + 1]
+
+    if "--trace" in sys.argv:
+        run_trace_ab(out_path)
+        return
 
     build_native()
     phases = {}
