@@ -1,0 +1,161 @@
+// Fuzz target: the net core's connection byte-stream state machine —
+// frame reassembly (u32-LE length prefix, oversize cut, the
+// exactly-32-byte pre-auth rule), the HMAC-SHA256 nonce handshake,
+// and post-auth frame dispatch — driven END TO END through a real
+// epoll server on loopback. This is the only harness that exercises
+// the real partial-read/partial-frame paths with attacker bytes.
+//
+// One exec == one TCP connection: read the nonce, then
+//   input[0] odd  -> answer the REAL HMAC first (covers the post-auth
+//                    parser with the remaining bytes),
+//   input[0] even -> raw pre-auth bytes (covers handshake rejection).
+// The remaining input streams in two writes (split point derived from
+// the input) to hit reassembly seams. SO_LINGER{1,0} closes with RST
+// so ephemeral ports never pile up in TIME_WAIT at fuzz rates. A
+// crash on an event thread takes the process down under ASan/UBSan
+// with the driver's crash-dump hook holding the input.
+//
+// Corpus: csrc/fuzz/corpus/frames. Build: `make fuzz`.
+#include "../ptpu_net.cc"
+#include "../ptpu_trace.cc"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr const char* kKey = "fuzzkey";
+
+ptpu::net::Stats* g_stats = nullptr;
+ptpu::net::Server* g_srv = nullptr;
+int g_port = 0;
+
+void InitOnce() {
+  if (g_srv) return;
+  g_stats = new ptpu::net::Stats();
+  ptpu::net::Options opt;
+  opt.authkey = kKey;
+  opt.event_threads = 2;
+  opt.handshake_timeout_us = 60ll * 1000 * 1000;  // fuzz decides pace
+  opt.max_frame = 1u << 20;
+  opt.http_port = 0;  // second protocol on the same loops
+  ptpu::net::Callbacks cbs;
+  cbs.on_frame = [](const ptpu::net::ConnPtr& c, const uint8_t* p,
+                    uint32_t n) {
+    // echo; 'X' closes; 'R' defers once (the kDefer retry path)
+    if (n > 0 && p[0] == 'X') return ptpu::net::FrameResult::kClose;
+    if (n > 0 && p[0] == 'R' && c->deferred_us() == 0)
+      return ptpu::net::FrameResult::kDefer;
+    return c->SendCopy(p, n) ? ptpu::net::FrameResult::kOk
+                             : ptpu::net::FrameResult::kClose;
+  };
+  cbs.on_http = [](const std::string& target) {
+    return ptpu::net::TelemetryHttp(
+        target, [] { return std::string("{\"server\":{\"x\":1}}"); },
+        "ptpu_fuzz", false);
+  };
+  g_srv = new ptpu::net::Server(opt, std::move(cbs), g_stats);
+  std::string err;
+  if (!g_srv->Start(&err)) {
+    std::fprintf(stderr, "fuzz_frames: start failed: %s\n",
+                 err.c_str());
+    std::abort();
+  }
+  g_port = g_srv->port();
+}
+
+int Dial(int port) {
+  for (int tries = 0; tries < 1000; ++tries) {
+    const int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    sockaddr_in a{};
+    a.sin_family = AF_INET;
+    a.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    a.sin_port = htons(uint16_t(port));
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&a), sizeof(a)) ==
+        0) {
+      const int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
+    }
+    ::close(fd);
+    // transient EADDRNOTAVAIL/ECONNREFUSED under churn: brief backoff
+    ::usleep(1000);
+  }
+  return -1;
+}
+
+bool ReadN(int fd, uint8_t* p, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    const ssize_t r = ::read(fd, p + got, n - got);
+    if (r <= 0) return false;
+    got += size_t(r);
+  }
+  return true;
+}
+
+void WriteAll(int fd, const uint8_t* p, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    const ssize_t w = ::write(fd, p + off, n - off);
+    if (w <= 0) return;  // peer (server) cut us: expected often
+    off += size_t(w);
+  }
+}
+
+void RstClose(int fd) {
+  linger lg{1, 0};
+  ::setsockopt(fd, SOL_SOCKET, SO_LINGER, &lg, sizeof(lg));
+  ::close(fd);
+}
+
+}  // namespace
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  if (size > (256u << 10)) return 0;
+  InitOnce();
+  const int fd = Dial(g_port);
+  if (fd < 0) return 0;
+  uint8_t nonce[16];
+  if (!ReadN(fd, nonce, sizeof(nonce))) {
+    RstClose(fd);
+    return 0;
+  }
+  const uint8_t* body = data;
+  size_t body_n = size;
+  if (size > 0 && (data[0] & 1)) {
+    // authenticate for real, then fuzz the POST-auth frame parser
+    uint8_t frame[4 + 32];
+    ptpu::PutU32(frame, 32);
+    ptpu::HmacSha256(reinterpret_cast<const uint8_t*>(kKey),
+                     std::strlen(kKey), nonce, sizeof(nonce),
+                     frame + 4);
+    WriteAll(fd, frame, sizeof(frame));
+    uint8_t ack = 0;
+    if (!ReadN(fd, &ack, 1) || ack != 0x01) {
+      RstClose(fd);
+      return 0;
+    }
+    ++body;
+    --body_n;
+  }
+  // stream in two chunks to land on reassembly seams
+  const size_t cut = body_n ? (body[0] * 131 % (body_n + 1)) : 0;
+  WriteAll(fd, body, cut);
+  WriteAll(fd, body + cut, body_n - cut);
+  // drain whatever the echo produced without blocking forever
+  timeval tv{0, 20000};
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  uint8_t sink[4096];
+  while (::read(fd, sink, sizeof(sink)) > 0) {
+  }
+  RstClose(fd);
+  return 0;
+}

@@ -1,0 +1,48 @@
+// Fuzz target: the HTTP telemetry request parser — header-end scan
+// (HttpHeaderEnd), request-line + keep-alive parsing
+// (ParseHttpRequestHead), the /tracez?n= whole-key query parser, and
+// the shared route dispatch (TelemetryHttp, which renders /statsz,
+// /metrics via the JSON walker, and /tracez). These are the bytes any
+// local process can throw at the telemetry port pre-auth.
+//
+// The buffered reassembly state machine AROUND these functions
+// (partial reads, 431 header cap, keep-alive loop) is split-point
+// driven by csrc/ptpu_net_selftest.cc and end-to-end by
+// csrc/fuzz/fuzz_frames.cc.
+//
+// Corpus: csrc/fuzz/corpus/http (every route incl. query forms, bad
+// request lines, 1.0/1.1 keep-alive shapes). Build: `make fuzz`.
+#include "../ptpu_net.cc"
+#include "../ptpu_trace.cc"
+
+#include <cstdint>
+#include <string>
+
+namespace {
+
+std::string FakeStatsJson() {
+  // the shape both servers emit: nested objects, counters, one hist
+  return "{\"server\":{\"pull_ops\":3,\"pull_us\":{\"count\":2,"
+         "\"sum\":10,\"buckets\":[1,1]}},\"tables\":{\"t\":{\"wire\":"
+         "{\"bytes_in\":7}}}}";
+}
+
+}  // namespace
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  if (size > (256u << 10)) return 0;
+  const char* p = reinterpret_cast<const char*>(data);
+  const size_t end = ptpu::net::HttpHeaderEnd(p, size);
+  const size_t head_len = end ? end : size;  // also parse partials
+  const ptpu::net::HttpReqHead head =
+      ptpu::net::ParseHttpRequestHead(p, head_len);
+  if (head.ok) {
+    // route dispatch exactly as both servers mount it (the target
+    // string is attacker-shaped: path + query, verbatim)
+    (void)ptpu::net::TelemetryHttp(head.target, FakeStatsJson,
+                                   "ptpu_fuzz", false);
+    (void)ptpu::net::TelemetryHttp(head.target, FakeStatsJson, "",
+                                   true);
+  }
+  return 0;
+}

@@ -1,0 +1,24 @@
+// Fuzz target: the restricted JSON walker behind GET /metrics —
+// ptpu::trace::PromFromStatsJson parses the stats_json snapshot (an
+// attacker cannot reach it with arbitrary bytes over the wire, but
+// the walker also renders /statsz-shaped JSON handed in by tools and
+// tests, and a memory-safety bug here is a memory-safety bug in every
+// telemetry scrape). Also walks TracezJson's own renderer once per
+// input via the query-parameter parser path in fuzz_http.cc — this
+// target is the pure parser.
+//
+// Corpus: csrc/fuzz/corpus/json (real stats_json snapshots from both
+// servers + histogram/edge shapes). Build: `make fuzz` (csrc/Makefile).
+#include "../ptpu_trace.cc"
+
+#include <cstdint>
+#include <string>
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  if (size > (1u << 20)) return 0;
+  const std::string snapshot(reinterpret_cast<const char*>(data), size);
+  // both family prefixes the servers use, plus an empty one
+  (void)ptpu::trace::PromFromStatsJson(snapshot, "ptpu_ps");
+  (void)ptpu::trace::PromFromStatsJson(snapshot, "");
+  return 0;
+}

@@ -1,0 +1,49 @@
+// Fuzz target: the ONNX artifact loader in csrc/ptpu_predictor.cc —
+// the protobuf wire Reader, parse_model / parse_tensor / parse_attr /
+// parse_value_info, and (for inputs that survive parsing) the FULL
+// predictor load pipeline: shape inference, load-time fusion passes,
+// the static memory planner's dry run. Artifacts come from disk and
+// are the deployment trust boundary (PAPER.md: a serving process
+// loads artifacts produced elsewhere).
+//
+// Two layers per input:
+//   1. parse_model on the raw bytes (cheap, throws on malformed);
+//   2. when layer 1 yields any node, the bytes are replayed through
+//      ptpu_predictor_create via memfd (/proc/self/fd) so the
+//      planner/fusion layers see them too.
+//
+// Corpus: csrc/fuzz/corpus/onnx (real selftest artifacts, an all-ops
+// graph, truncations). Build: `make fuzz` (csrc/Makefile).
+#include "../ptpu_predictor.cc"
+
+#include <sys/mman.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <string>
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  if (size > (1u << 20)) return 0;
+  const std::string bytes(reinterpret_cast<const char*>(data), size);
+  bool have_nodes = false;
+  try {
+    Graph g = parse_model(bytes);
+    have_nodes = !g.nodes.empty();
+  } catch (const std::exception&) {
+    // malformed-model rejection IS the contract
+  }
+  if (!have_nodes) return 0;
+  const int fd = ::memfd_create("fuzz_onnx", 0);
+  if (fd < 0) return 0;
+  if (::write(fd, bytes.data(), bytes.size()) ==
+      ssize_t(bytes.size())) {
+    char path[64];
+    std::snprintf(path, sizeof(path), "/proc/self/fd/%d", fd);
+    char err[256];
+    PTPU_Predictor* p = ptpu_predictor_create(path, err, sizeof(err));
+    if (p) ptpu_predictor_destroy(p);
+  }
+  ::close(fd);
+  return 0;
+}

@@ -1,0 +1,51 @@
+// Fuzz target: the PS data-plane frame parser — PsServer::OnFrame in
+// csrc/ptpu_ps_server.cc (v1 + traced-v2 PULL/PUSH layouts, table
+// lookup, id bounds, reply sizing) down through the table gather and
+// the coalescing push in csrc/ptpu_ps_table.cc. Frames are the bytes
+// any authenticated client sends; every offset in them is
+// attacker-controlled.
+//
+// Harness shape: the single-TU include idiom of
+// csrc/ptpu_ps_selftest.cc reaches the anonymous-namespace PsServer
+// directly; a Detached net::Conn (csrc/ptpu_net.h fuzz hook) stands
+// in for a live connection, so one exec == one frame dispatch with no
+// sockets in the loop. The input IS the frame payload (no u32 length
+// prefix — the net core validates that before handlers run).
+//
+// Corpus: csrc/fuzz/corpus/wire_ps. Build: `make fuzz`.
+#include "../ptpu_ps_table.cc"
+#include "../ptpu_ps_server.cc"
+#include "../ptpu_net.cc"
+#include "../ptpu_trace.cc"
+
+#include <cstdint>
+
+namespace {
+
+PsServer* g_srv = nullptr;
+void* g_tab = nullptr;
+void* g_tab2 = nullptr;
+
+void InitOnce() {
+  if (g_srv) return;
+  g_srv = new PsServer();
+  // two live shards: a plain SGD table at lo=0 and an adam table at a
+  // nonzero lo (global-id offset arithmetic is part of the parser's
+  // bounds story). Sizes stay tiny so pushes/pulls run in microseconds.
+  g_tab = ptpu_ps_table_create(64, 4, PTPU_PS_SGD, 0.1f, 0.9f, 0.999f,
+                               1e-8f);
+  g_tab2 = ptpu_ps_table_create(32, 3, PTPU_PS_ADAM, 0.1f, 0.9f,
+                                0.999f, 1e-8f);
+  ptpu_ps_server_register(g_srv, "t", g_tab, 0);
+  ptpu_ps_server_register(g_srv, "emb", g_tab2, 1000);
+}
+
+}  // namespace
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  if (size > (1u << 20)) return 0;
+  InitOnce();
+  auto conn = ptpu::net::Conn::Detached();
+  (void)g_srv->OnFrame(conn, data, uint32_t(size));
+  return 0;
+}

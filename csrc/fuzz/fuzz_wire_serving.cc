@@ -1,0 +1,132 @@
+// Fuzz target: the serving wire-frame parser — SvServer::OnFrame in
+// csrc/ptpu_serving.cc: v1 + traced-v2 INFER_REQ (per-input
+// dtype/ndim/dims/raw walk), META, and the DECODE 0x65..0x69 ops,
+// through the real micro-batcher, bucket-ladder predictor run,
+// row-wise de-mux, and the KV session registry. Everything after the
+// HMAC handshake is attacker-bytes; this is the full post-auth
+// surface of the inference server.
+//
+// Harness shape: a REAL server (ptpu_serving_start2 over a
+// hand-rolled matmul artifact + the selftest-convention decode
+// artifact) whose internal OnFrame is reachable because this TU
+// includes ptpu_serving.cc (the selftest idiom). Frames dispatch on a
+// Detached net::Conn; batcher workers run and answer on it
+// asynchronously — replies queue on the conn and die with it. The
+// listener sockets are started but never dialed.
+//
+// Corpus: csrc/fuzz/corpus/wire_serving. Build: `make fuzz`.
+#include "../ptpu_net.cc"
+#include "../ptpu_trace.cc"
+#include "../ptpu_predictor.cc"
+#include "../ptpu_serving.cc"
+#include "../ptpu_onnx_writer.h"
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+namespace {
+
+using ptpu::onnxw::onnx_node;
+using ptpu::onnxw::onnx_node_iattr;
+using ptpu::onnxw::onnx_tensor_f32;
+using ptpu::onnxw::onnx_tensor_i64;
+using ptpu::onnxw::onnx_value_info;
+using ptpu::onnxw::put_lenf;
+
+// y[B,2] = x[B,4] @ W[4,2] — batch-polymorphic, so the bucket ladder
+// plans every size; runs are a few microseconds.
+std::string build_matmul_model() {
+  const float w[8] = {0.5f, -1.f, 2.f, 0.25f, 1.f, 0.f, -2.f, 3.f};
+  std::string g;
+  put_lenf(&g, 1, onnx_node("MatMul", {"x", "w"}, {"y"}));
+  put_lenf(&g, 5, onnx_tensor_f32("w", {4, 2}, w, 8));
+  put_lenf(&g, 11, onnx_value_info("x", 1, {2, 4}));
+  put_lenf(&g, 12, onnx_value_info("y", 1, {2, 2}));
+  std::string m;
+  put_lenf(&m, 7, g);
+  return m;
+}
+
+// The serving selftest's decode-step artifact convention (B=2, P=4,
+// H=D=1): logit == running token sum.
+std::string build_decode_model() {
+  std::string g;
+  put_lenf(&g, 1, onnx_node_iattr("Cast", {"ids"}, {"idsf"}, "to", 1));
+  put_lenf(&g, 1, onnx_node("Reshape", {"idsf", "sh_nk"}, {"nk"}));
+  put_lenf(&g, 1, onnx_node("Mul", {"nk", "two"}, {"nv"}));
+  put_lenf(&g, 1, onnx_node("ReduceSum", {"k0", "axes"}, {"ksum"}));
+  put_lenf(&g, 1, onnx_node("Reshape", {"ksum", "sh_y"}, {"ksum2"}));
+  put_lenf(&g, 1, onnx_node_iattr("Cast", {"pos"}, {"posf"}, "to", 1));
+  put_lenf(&g, 1, onnx_node("Reshape", {"posf", "sh_y"}, {"posr"}));
+  put_lenf(&g, 1, onnx_node("Mul", {"posr", "zero"}, {"pos0"}));
+  put_lenf(&g, 1, onnx_node("Add", {"ksum2", "idsf"}, {"t1"}));
+  put_lenf(&g, 1, onnx_node("Add", {"t1", "pos0"}, {"y"}));
+  put_lenf(&g, 5, onnx_tensor_i64("sh_nk", {4}, {2, 1, 1, 1}));
+  put_lenf(&g, 5, onnx_tensor_i64("sh_y", {2}, {2, 1}));
+  put_lenf(&g, 5, onnx_tensor_i64("axes", {3}, {1, 2, 3}));
+  const float twov = 2.f, zerov = 0.f;
+  put_lenf(&g, 5, onnx_tensor_f32("two", {}, &twov, 1));
+  put_lenf(&g, 5, onnx_tensor_f32("zero", {}, &zerov, 1));
+  put_lenf(&g, 11, onnx_value_info("ids", 7, {2, 1}));
+  put_lenf(&g, 11, onnx_value_info("pos", 7, {2}));
+  put_lenf(&g, 11, onnx_value_info("k0", 1, {2, 4, 1, 1}));
+  put_lenf(&g, 11, onnx_value_info("v0", 1, {2, 4, 1, 1}));
+  put_lenf(&g, 12, onnx_value_info("y", 1, {2, 1}));
+  put_lenf(&g, 12, onnx_value_info("nk", 1, {2, 1, 1, 1}));
+  put_lenf(&g, 12, onnx_value_info("nv", 1, {2, 1, 1, 1}));
+  std::string m;
+  put_lenf(&m, 7, g);
+  return m;
+}
+
+std::string write_tmp(const std::string& bytes, const char* name) {
+  std::string path = std::string("/tmp/") + name;
+  std::FILE* f = std::fopen(path.c_str(), "wb");
+  if (!f) std::abort();
+  std::fwrite(bytes.data(), 1, bytes.size(), f);
+  std::fclose(f);
+  return path;
+}
+
+SvServer* g_srv = nullptr;
+
+void StopServer() {
+  if (g_srv) ptpu_serving_stop(g_srv);
+  g_srv = nullptr;
+}
+
+void InitOnce() {
+  if (g_srv) return;
+  const std::string mp =
+      write_tmp(build_matmul_model(), "ptpu_fuzz_serving.onnx");
+  const std::string dp =
+      write_tmp(build_decode_model(), "ptpu_fuzz_decode.onnx");
+  char err[512] = {0};
+  g_srv = static_cast<SvServer*>(ptpu_serving_start2(
+      mp.c_str(), dp.c_str(), /*port=*/0, "fz", 2, /*max_batch=*/4,
+      /*deadline_us=*/200, /*instances=*/1, /*threads=*/1,
+      /*loopback_only=*/1, /*kv_sessions=*/4, err, sizeof(err)));
+  if (!g_srv) {
+    std::fprintf(stderr, "fuzz_wire_serving: start failed: %s\n", err);
+    std::abort();
+  }
+  std::atexit(StopServer);  // teardown before LSan's end-of-run scan
+}
+
+}  // namespace
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  if (size > (1u << 20)) return 0;
+  InitOnce();
+  auto conn = ptpu::net::Conn::Detached();
+  (void)g_srv->OnFrame(conn, data, uint32_t(size));
+  // a kDefer stash is normally freed by the net core's on_close hook;
+  // a Detached conn has no loop, so mirror that hook here
+  delete static_cast<SvRequest*>(conn->user);
+  conn->user = nullptr;
+  g_srv->DecodeConnClosed(conn.get());
+  return 0;
+}

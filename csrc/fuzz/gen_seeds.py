@@ -1,0 +1,329 @@
+#!/usr/bin/env python3
+"""Structure-aware seed corpora for the csrc/fuzz harnesses (ISSUE 11).
+
+Regenerates csrc/fuzz/corpus/<target>/seed-*.bin from the SAME frame
+layouts the selftests hand-roll (wire.py / serving.py twins) plus a
+tiny ONNX/protobuf writer mirroring csrc/ptpu_onnx_writer.h. The
+corpus is CHECKED IN — this script exists so seeds can be rebuilt
+when a layout changes; crash regressions (crash-*.bin) are never
+regenerated, they are frozen findings.
+
+The all-ops ONNX seed derives the op list from ptpu_predictor.cc
+itself (the same extraction tools/ptpu_check.py's `fuzz` checker
+uses), so a newly parsed op automatically lands in the corpus on the
+next regen — and the checker fails until it does.
+
+Usage: python3 csrc/fuzz/gen_seeds.py   (idempotent, writes in place)
+"""
+import os
+import re
+import struct
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+CSRC = os.path.dirname(HERE)
+
+
+def w(target, name, data):
+    d = os.path.join(HERE, "corpus", target)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, name), "wb") as f:
+        f.write(data)
+
+
+# ---------------------------------------------------------------------------
+# tiny protobuf writer (twin of csrc/ptpu_onnx_writer.h)
+# ---------------------------------------------------------------------------
+
+def varint(v):
+    out = b""
+    while v >= 0x80:
+        out += bytes([v & 0x7F | 0x80])
+        v >>= 7
+    return out + bytes([v])
+
+
+def tag(field, wire):
+    return varint(field << 3 | wire)
+
+
+def u64f(field, v):
+    return tag(field, 0) + varint(v)
+
+
+def lenf(field, payload):
+    return tag(field, 2) + varint(len(payload)) + payload
+
+
+def onnx_tensor_f32(name, dims, vals):
+    t = b"".join(u64f(1, d) for d in dims) + u64f(2, 1)
+    t += lenf(8, name.encode())
+    t += lenf(9, struct.pack(f"<{len(vals)}f", *vals))
+    return t
+
+
+def onnx_tensor_i64(name, dims, vals):
+    t = b"".join(u64f(1, d) for d in dims) + u64f(2, 7)
+    t += lenf(8, name.encode())
+    t += lenf(9, struct.pack(f"<{len(vals)}q", *vals))
+    return t
+
+
+def onnx_value_info(name, elem, dims):
+    shape = b"".join(lenf(1, u64f(1, d)) for d in dims)
+    tt = u64f(1, elem) + lenf(2, shape)
+    return lenf(1, name.encode()) + lenf(2, lenf(1, tt))
+
+
+def onnx_node(op, ins, outs, iattr=None):
+    n = b"".join(lenf(1, i.encode()) for i in ins)
+    n += b"".join(lenf(2, o.encode()) for o in outs)
+    n += lenf(4, op.encode())
+    if iattr:
+        aname, aval = iattr
+        n += lenf(5, lenf(1, aname.encode()) + u64f(3, aval))
+    return n
+
+
+def onnx_model(graph_fields):
+    return lenf(7, b"".join(graph_fields))
+
+
+def matmul_model():
+    # twin of fuzz_wire_serving.cc build_matmul_model (y = x[B,4] @ w)
+    g = [
+        lenf(1, onnx_node("MatMul", ["x", "w"], ["y"])),
+        lenf(5, onnx_tensor_f32(
+            "w", [4, 2], [0.5, -1.0, 2.0, 0.25, 1.0, 0.0, -2.0, 3.0])),
+        lenf(11, onnx_value_info("x", 1, [2, 4])),
+        lenf(12, onnx_value_info("y", 1, [2, 2])),
+    ]
+    return onnx_model(g)
+
+
+def decode_model():
+    # twin of the serving-selftest decode artifact (B=2, P=4, H=D=1)
+    g = [
+        lenf(1, onnx_node("Cast", ["ids"], ["idsf"], ("to", 1))),
+        lenf(1, onnx_node("Reshape", ["idsf", "sh_nk"], ["nk"])),
+        lenf(1, onnx_node("Mul", ["nk", "two"], ["nv"])),
+        lenf(1, onnx_node("ReduceSum", ["k0", "axes"], ["ksum"])),
+        lenf(1, onnx_node("Reshape", ["ksum", "sh_y"], ["ksum2"])),
+        lenf(1, onnx_node("Cast", ["pos"], ["posf"], ("to", 1))),
+        lenf(1, onnx_node("Reshape", ["posf", "sh_y"], ["posr"])),
+        lenf(1, onnx_node("Mul", ["posr", "zero"], ["pos0"])),
+        lenf(1, onnx_node("Add", ["ksum2", "idsf"], ["t1"])),
+        lenf(1, onnx_node("Add", ["t1", "pos0"], ["y"])),
+        lenf(5, onnx_tensor_i64("sh_nk", [4], [2, 1, 1, 1])),
+        lenf(5, onnx_tensor_i64("sh_y", [2], [2, 1])),
+        lenf(5, onnx_tensor_i64("axes", [3], [1, 2, 3])),
+        lenf(5, onnx_tensor_f32("two", [], [2.0])),
+        lenf(5, onnx_tensor_f32("zero", [], [0.0])),
+        lenf(11, onnx_value_info("ids", 7, [2, 1])),
+        lenf(11, onnx_value_info("pos", 7, [2])),
+        lenf(11, onnx_value_info("k0", 1, [2, 4, 1, 1])),
+        lenf(11, onnx_value_info("v0", 1, [2, 4, 1, 1])),
+        lenf(12, onnx_value_info("y", 1, [2, 1])),
+        lenf(12, onnx_value_info("nk", 1, [2, 1, 1, 1])),
+        lenf(12, onnx_value_info("nv", 1, [2, 1, 1, 1])),
+    ]
+    return onnx_model(g)
+
+
+def predictor_ops():
+    """Every op name ptpu_predictor.cc dispatches on — the extraction
+    tools/ptpu_check.py's `fuzz` checker mirrors."""
+    src = open(os.path.join(CSRC, "ptpu_predictor.cc"),
+               encoding="utf-8").read()
+    ops = set(re.findall(r'\bop == "([A-Z][A-Za-z0-9]*)"', src))
+    ops |= set(re.findall(r'\.op == "([A-Z][A-Za-z0-9]*)"', src))
+    # bin_code / un_code map literals: {"Add", B_ADD} etc.
+    ops |= set(re.findall(r'\{"([A-Z][A-Za-z0-9]*)",\s*[BU]_[A-Z0-9_]+\}',
+                          src))
+    return sorted(ops)
+
+
+def all_ops_model():
+    """One (invalid but parseable) graph holding a node of EVERY op the
+    predictor knows: parser/validator coverage + the corpus bytes the
+    `fuzz` checker requires per op."""
+    g = []
+    for k, op in enumerate(predictor_ops()):
+        g.append(lenf(1, onnx_node(op, [f"i{k}", f"j{k}"], [f"o{k}"])))
+    g.append(lenf(5, onnx_tensor_f32("i0", [2], [1.0, 2.0])))
+    g.append(lenf(11, onnx_value_info("x", 1, [1, 2])))
+    g.append(lenf(12, onnx_value_info("o0", 1, [1, 2])))
+    return onnx_model(g)
+
+
+# ---------------------------------------------------------------------------
+# wire frames (payloads only — the u32 length prefix is the net
+# core's, handlers never see it)
+# ---------------------------------------------------------------------------
+
+def ps_pull(table=b"t", ids=(0, 1, 2, 63), ver=1, tid=None):
+    f = bytes([ver, 0x50])
+    if tid is not None:
+        f += struct.pack("<Q", tid)
+    f += bytes([len(table)]) + table
+    f += struct.pack("<I", len(ids)) + struct.pack(f"<{len(ids)}q", *ids)
+    return f
+
+
+def ps_push(table=b"t", ids=(1, 2, 1), dim=4, flags=0, ver=1, tid=None):
+    f = bytes([ver, 0x52])
+    if tid is not None:
+        f += struct.pack("<Q", tid)
+    f += bytes([len(table)]) + table
+    f += bytes([flags]) + struct.pack("<II", len(ids), dim)
+    f += struct.pack(f"<{len(ids)}q", *ids)
+    f += struct.pack(f"<{len(ids) * dim}f",
+                     *([0.25] * (len(ids) * dim)))
+    return f
+
+
+def sv_infer(rid=7, rows=1, ver=1, tid=None, dtype=1, tail=4):
+    f = bytes([ver, 0x60])
+    if tid is not None:
+        f += struct.pack("<Q", tid)
+    f += struct.pack("<QH", rid, 1)  # one input
+    f += bytes([dtype, 2]) + struct.pack("<qq", rows, tail)
+    f += struct.pack(f"<{rows * tail}f", *([1.5] * (rows * tail)))
+    return f
+
+
+def sv_plain(tag_byte, *fields, ver=1, tid=None):
+    f = bytes([ver, tag_byte])
+    if tid is not None:
+        f += struct.pack("<Q", tid)
+    for v in fields:
+        f += struct.pack("<Q", v)
+    return f
+
+
+def frame(payload):
+    return struct.pack("<I", len(payload)) + payload
+
+
+def main():
+    # ---- wire_ps ----
+    w("wire_ps", "seed-pull-v1.bin", ps_pull())
+    w("wire_ps", "seed-pull-v2-traced.bin", ps_pull(ver=2, tid=0xABCDEF))
+    w("wire_ps", "seed-pull-emb-offset.bin",
+      ps_pull(table=b"emb", ids=(1000, 1031)))
+    w("wire_ps", "seed-pull-unknown-table.bin", ps_pull(table=b"nope"))
+    w("wire_ps", "seed-pull-out-of-range.bin", ps_pull(ids=(64,)))
+    w("wire_ps", "seed-push-v1.bin", ps_push())
+    w("wire_ps", "seed-push-v2-traced.bin", ps_push(ver=2, tid=5))
+    w("wire_ps", "seed-push-async.bin", ps_push(flags=1))
+    w("wire_ps", "seed-push-empty.bin",
+      ps_push(ids=(), dim=0))
+    w("wire_ps", "seed-push-dim-mismatch.bin", ps_push(dim=3))
+    # reply-direction tags arriving as requests: parser must reject
+    w("wire_ps", "seed-tag-pull-rep.bin", bytes([1, 0x51]) + b"\0" * 8)
+    w("wire_ps", "seed-tag-ok.bin", bytes([1, 0x53]))
+    w("wire_ps", "seed-tag-err.bin",
+      bytes([1, 0x54]) + struct.pack("<I", 3) + b"boo")
+    w("wire_ps", "seed-truncated.bin", ps_pull()[:9])
+    w("wire_ps", "seed-bad-version.bin", bytes([9, 0x50]) + b"\x01t")
+
+    # ---- wire_serving ----
+    w("wire_serving", "seed-meta.bin", sv_plain(0x63))
+    w("wire_serving", "seed-meta-v2.bin", sv_plain(0x63, ver=2, tid=9))
+    w("wire_serving", "seed-infer-b1.bin", sv_infer())
+    w("wire_serving", "seed-infer-b2.bin", sv_infer(rows=2))
+    w("wire_serving", "seed-infer-v2-traced.bin",
+      sv_infer(ver=2, tid=0x1122334455667788))
+    w("wire_serving", "seed-infer-bad-dtype.bin", sv_infer(dtype=7))
+    w("wire_serving", "seed-infer-bad-tail.bin", sv_infer(tail=5))
+    w("wire_serving", "seed-infer-trunc.bin", sv_infer()[:14])
+    w("wire_serving", "seed-decode-open.bin", sv_plain(0x65, 11))
+    w("wire_serving", "seed-decode-open-v2.bin",
+      sv_plain(0x65, 12, ver=2, tid=3))
+    w("wire_serving", "seed-decode-step.bin", sv_plain(0x67, 13, 1, 5))
+    w("wire_serving", "seed-decode-step-v2.bin",
+      sv_plain(0x67, 14, 1, 6, ver=2, tid=4))
+    w("wire_serving", "seed-decode-close.bin", sv_plain(0x69, 15, 1))
+    w("wire_serving", "seed-decode-unknown-sess.bin",
+      sv_plain(0x67, 16, 999999, 0))
+    # reply-direction tags as requests: rejected
+    w("wire_serving", "seed-tag-infer-rep.bin", sv_plain(0x61, 1))
+    w("wire_serving", "seed-tag-infer-err.bin",
+      bytes([1, 0x62]) + struct.pack("<QI", 1, 2) + b"xx")
+    w("wire_serving", "seed-tag-meta-rep.bin",
+      bytes([1, 0x64]) + struct.pack("<I", 2) + b"{}")
+    w("wire_serving", "seed-tag-decode-sess.bin", sv_plain(0x66, 1, 2))
+    w("wire_serving", "seed-tag-decode-rep.bin",
+      bytes([1, 0x68]) + struct.pack("<QQI", 1, 2, 1) +
+      struct.pack("<f", 0.0))
+    w("wire_serving", "seed-bad-version.bin", bytes([7, 0x60]))
+
+    # ---- http ----
+    def req(line, hdrs=b"Host: x\r\n"):
+        return line + b"\r\n" + hdrs + b"\r\n"
+    w("http", "seed-healthz.bin", req(b"GET /healthz HTTP/1.1"))
+    w("http", "seed-statsz.bin", req(b"GET /statsz HTTP/1.1"))
+    w("http", "seed-metrics.bin", req(b"GET /metrics HTTP/1.1"))
+    w("http", "seed-tracez.bin", req(b"GET /tracez?n=5 HTTP/1.1"))
+    w("http", "seed-tracez-multi-key.bin",
+      req(b"GET /tracez?conn=1&n=2 HTTP/1.1"))
+    w("http", "seed-404.bin", req(b"GET /nope HTTP/1.1"))
+    w("http", "seed-post.bin", req(b"POST /healthz HTTP/1.1"))
+    w("http", "seed-http10-keepalive.bin",
+      req(b"GET /healthz HTTP/1.0",
+          b"Connection: keep-alive\r\n"))
+    w("http", "seed-connection-close.bin",
+      req(b"GET /statsz HTTP/1.1", b"Connection: close\r\n"))
+    w("http", "seed-bad-line.bin", req(b"GARBAGE"))
+    w("http", "seed-partial.bin", b"GET /heal")
+    w("http", "seed-empty-target.bin", req(b"GET  HTTP/1.1"))
+
+    # ---- onnx ----
+    w("onnx", "seed-matmul.bin", matmul_model())
+    w("onnx", "seed-decode.bin", decode_model())
+    w("onnx", "seed-all-ops.bin", all_ops_model())
+    w("onnx", "seed-trunc.bin", matmul_model()[:21])
+    w("onnx", "seed-empty-graph.bin", onnx_model([]))
+    w("onnx", "seed-not-proto.bin", b"\xff\xfe\x00garbage")
+
+    # ---- json (PromFromStatsJson walker) ----
+    w("json", "seed-serving-stats.bin", (
+        b'{"server":{"requests":5,"replies":5,"conns_active":1},'
+        b'"batcher":{"batches":2,"queue_depth":{"count":3,"sum":4,'
+        b'"buckets":[1,2]},"e2e_us":{"count":1,"sum":9,"buckets":[1]}},'
+        b'"decode":{"opens":1,"sessions_active":0}}'))
+    w("json", "seed-ps-stats.bin", (
+        b'{"server":{"pull_ops":7,"pull_us":{"count":2,"sum":10,'
+        b'"buckets":[1,1,0]}},"tables":{"emb":{"wire":{"bytes_in":3},'
+        b'"table":{"rows":64}}}}'))
+    w("json", "seed-escapes.bin",
+      b'{"a\\n\\t\\"b\\\\":1,"c":{"d\\r":2}}')
+    w("json", "seed-deep.bin",
+      b'{"a":' * 20 + b"1" + b"}" * 20)
+    w("json", "seed-arrays.bin", b'{"x":[1,2,3],"y":[],"z":[0]}')
+    w("json", "seed-bad.bin", b'{"a":,}')
+    w("json", "seed-empty.bin", b"")
+
+    # ---- frames (leading byte odd == authenticate first) ----
+    w("frames", "seed-auth-echo.bin", b"\x01" + frame(b"hello"))
+    w("frames", "seed-auth-pipelined.bin",
+      b"\x01" + frame(b"a") + frame(b"bb") + frame(b"ccc"))
+    w("frames", "seed-auth-defer.bin", b"\x01" + frame(b"Rdefer"))
+    w("frames", "seed-auth-close.bin", b"\x01" + frame(b"Xbye"))
+    w("frames", "seed-auth-empty-frame.bin", b"\x01" + frame(b""))
+    w("frames", "seed-auth-oversize.bin",
+      b"\x01" + struct.pack("<I", (1 << 20) + 1) + b"zz")
+    w("frames", "seed-preauth-badmac.bin",
+      b"\x00" + frame(b"\x00" * 32))
+    w("frames", "seed-preauth-wrong-len.bin",
+      b"\x00" + frame(b"\x00" * 31))
+    w("frames", "seed-preauth-huge-claim.bin",
+      b"\x00" + struct.pack("<I", 0x7FFFFFFF))
+    w("frames", "seed-preauth-partial.bin", b"\x00\x05\x00")
+
+    print("gen_seeds: corpora written under", os.path.join(HERE, "corpus"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,445 @@
+// ptpu_fuzz driver — the in-tree coverage-guided fuzzing engine under
+// every harness in csrc/fuzz/ (ISSUE 11).
+//
+// Why not libFuzzer: the baked toolchain is GCC-only (no clang, no
+// compiler-rt fuzzer archive), but GCC has shipped the SAME
+// instrumentation hook libFuzzer rides since GCC 6:
+// -fsanitize-coverage=trace-pc calls __sanitizer_cov_trace_pc() at
+// every edge. This TU supplies that callback (it is compiled WITHOUT
+// the coverage flag — instrumenting the engine itself recurses into
+// a stack overflow, measured) plus a minimal AFL-shaped mutation
+// loop over it. Harnesses keep the standard libFuzzer contract —
+//   extern "C" int LLVMFuzzerTestOneInput(const uint8_t*, size_t);
+//   extern "C" int LLVMFuzzerInitialize(int*, char***);  // optional
+// — so if a clang toolchain ever appears, the same harness sources
+// link against real libFuzzer unchanged.
+//
+// Modes (tools/run_checks.sh uses both):
+//   <target> DIR|FILE...              replay every input once (the CI
+//                                     corpus-regression leg; exit 0 ==
+//                                     every input survived)
+//   <target> -fuzz=SECS [-runs=N] DIR coverage-guided mutation loop
+//                                     seeded from DIR; -out=DIR writes
+//                                     inputs that reach new edges back
+//                                     to a corpus dir (default: none —
+//                                     CI smoke must not mutate the
+//                                     checked-in corpus)
+//   -max_len=N (default 1 MiB), -seed=N, -timeout=SECS (per-input
+//   alarm, default 20), -artifact=PREFIX (crash dump location,
+//   default ./crash-)
+//
+// Crash handling: the current input lives in a global; ASan's death
+// callback (and a SIGSEGV/SIGABRT/SIGALRM fallback) dumps it to
+// <artifact><len>-<hash> before the process dies, so every finding is
+// reproducible with `<target> <crash-file>`. Findings get MINIMIZED
+// by hand-replay and committed to csrc/fuzz/corpus/ as regressions.
+#include <dirent.h>
+#include <fcntl.h>
+#include <signal.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size);
+extern "C" int __attribute__((weak))
+LLVMFuzzerInitialize(int* argc, char*** argv);
+
+// ---------------------------------------------------------------------------
+// Coverage map (AFL-style edge hash over return addresses). The
+// callback must stay minimal and allocation-free: it runs at every
+// instrumented edge of the target TU.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr size_t kMapBits = 16;
+constexpr size_t kMapSize = 1u << kMapBits;
+uint8_t g_cov[kMapSize];
+size_t g_cov_count = 0;
+thread_local uintptr_t g_prev_pc = 0;
+
+}  // namespace
+
+extern "C" void __sanitizer_cov_trace_pc() {
+  const uintptr_t pc =
+      reinterpret_cast<uintptr_t>(__builtin_return_address(0));
+  const size_t idx = (pc ^ (g_prev_pc >> 1)) & (kMapSize - 1);
+  g_prev_pc = pc;
+  if (!g_cov[idx]) {
+    g_cov[idx] = 1;
+    ++g_cov_count;
+  }
+}
+
+// ASan runtime hook: called once when the process is about to die on
+// a sanitizer report. Weak so the uninstrumented build still links.
+extern "C" void __attribute__((weak))
+__sanitizer_set_death_callback(void (*cb)());
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Crash artifact dump (async-signal-safe: open/write only)
+// ---------------------------------------------------------------------------
+
+const uint8_t* g_cur_data = nullptr;
+size_t g_cur_size = 0;
+char g_artifact_prefix[512] = "./crash-";
+
+uint64_t Fnv1a(const uint8_t* d, size_t n) {
+  uint64_t h = 1469598103934665603ull;
+  for (size_t i = 0; i < n; ++i) h = (h ^ d[i]) * 1099511628211ull;
+  return h;
+}
+
+void DumpCurrentInput() {
+  if (!g_cur_data) return;
+  char path[640];
+  const uint64_t h = Fnv1a(g_cur_data, g_cur_size);
+  std::snprintf(path, sizeof(path), "%s%zu-%016llx", g_artifact_prefix,
+                g_cur_size, (unsigned long long)h);
+  const int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return;
+  ssize_t w = ::write(fd, g_cur_data, g_cur_size);
+  (void)w;
+  ::close(fd);
+  // stderr is fd 2; keep it async-signal-safe
+  const char* msg = "\nptpu_fuzz: crashing input written to ";
+  w = ::write(2, msg, std::strlen(msg));
+  w = ::write(2, path, std::strlen(path));
+  w = ::write(2, "\n", 1);
+  (void)w;
+}
+
+void CrashSignal(int sig) {
+  DumpCurrentInput();
+  ::signal(sig, SIG_DFL);
+  ::raise(sig);
+}
+
+// ---------------------------------------------------------------------------
+// Corpus
+// ---------------------------------------------------------------------------
+
+struct Input {
+  std::vector<uint8_t> bytes;
+  std::string path;  // empty for in-memory mutants
+};
+
+bool ReadFileBytes(const std::string& path, std::vector<uint8_t>* out) {
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  const long n = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  out->resize(n > 0 ? size_t(n) : 0);
+  const size_t got = n > 0 ? std::fread(out->data(), 1, size_t(n), f) : 0;
+  std::fclose(f);
+  out->resize(got);
+  return true;
+}
+
+void LoadCorpus(const std::string& arg, std::vector<Input>* corpus) {
+  struct stat st;
+  if (::stat(arg.c_str(), &st) != 0) {
+    std::fprintf(stderr, "ptpu_fuzz: cannot stat %s\n", arg.c_str());
+    std::exit(2);
+  }
+  if (S_ISDIR(st.st_mode)) {
+    DIR* d = ::opendir(arg.c_str());
+    if (!d) return;
+    std::vector<std::string> names;
+    while (dirent* e = ::readdir(d)) {
+      if (e->d_name[0] == '.') continue;
+      names.push_back(arg + "/" + e->d_name);
+    }
+    ::closedir(d);
+    std::sort(names.begin(), names.end());  // deterministic replay
+    for (const auto& p : names) {
+      Input in;
+      in.path = p;
+      if (ReadFileBytes(p, &in.bytes)) corpus->push_back(std::move(in));
+    }
+  } else {
+    Input in;
+    in.path = arg;
+    if (ReadFileBytes(arg, &in.bytes)) corpus->push_back(std::move(in));
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Mutator (AFL havoc subset; xorshift RNG for determinism under -seed)
+// ---------------------------------------------------------------------------
+
+uint64_t g_rng = 88172645463325252ull;
+
+uint64_t Rnd() {
+  g_rng ^= g_rng << 13;
+  g_rng ^= g_rng >> 7;
+  g_rng ^= g_rng << 17;
+  return g_rng;
+}
+
+size_t RndBelow(size_t n) { return n ? size_t(Rnd() % n) : 0; }
+
+const int64_t kInteresting[] = {0,    1,    -1,   16,   32,   64,
+                                100,  127,  -128, 255,  256,  512,
+                                1024, 4096, 65535, 65536, 1 << 20,
+                                -(1 << 20)};
+
+void Mutate(std::vector<uint8_t>* b, size_t max_len,
+            const std::vector<Input>& corpus) {
+  const int rounds = 1 + int(RndBelow(8));
+  for (int r = 0; r < rounds; ++r) {
+    if (b->empty()) {
+      b->push_back(uint8_t(Rnd()));
+      continue;
+    }
+    switch (RndBelow(10)) {
+      case 0:  // bit flip
+        (*b)[RndBelow(b->size())] ^= uint8_t(1u << RndBelow(8));
+        break;
+      case 1:  // random byte
+        (*b)[RndBelow(b->size())] = uint8_t(Rnd());
+        break;
+      case 2: {  // interesting value, random width/endian-free
+        const int64_t v =
+            kInteresting[RndBelow(sizeof(kInteresting) /
+                                  sizeof(kInteresting[0]))];
+        const size_t w = size_t(1) << RndBelow(4);  // 1/2/4/8
+        const size_t pos = RndBelow(b->size());
+        for (size_t i = 0; i < w && pos + i < b->size(); ++i)
+          (*b)[pos + i] = uint8_t(uint64_t(v) >> (8 * i));
+        break;
+      }
+      case 3: {  // delete a block
+        const size_t pos = RndBelow(b->size());
+        const size_t n = 1 + RndBelow(std::min<size_t>(
+                                 b->size() - pos, 1 + b->size() / 4));
+        b->erase(b->begin() + pos, b->begin() + pos + n);
+        break;
+      }
+      case 4: {  // duplicate / insert a block
+        if (b->size() >= max_len) break;
+        const size_t pos = RndBelow(b->size());
+        const size_t n = 1 + RndBelow(std::min<size_t>(
+                                 b->size() - pos,
+                                 std::min<size_t>(max_len - b->size(),
+                                                  256)));
+        std::vector<uint8_t> blk(b->begin() + pos,
+                                 b->begin() + pos + n);
+        b->insert(b->begin() + RndBelow(b->size()), blk.begin(),
+                  blk.end());
+        break;
+      }
+      case 5: {  // insert random bytes
+        if (b->size() >= max_len) break;
+        const size_t n = 1 + RndBelow(16);
+        std::vector<uint8_t> blk(n);
+        for (auto& c : blk) c = uint8_t(Rnd());
+        b->insert(b->begin() + RndBelow(b->size() + 1), blk.begin(),
+                  blk.end());
+        break;
+      }
+      case 6: {  // splice with another corpus input
+        if (corpus.empty()) break;
+        const auto& other = corpus[RndBelow(corpus.size())].bytes;
+        if (other.empty()) break;
+        const size_t cut_a = RndBelow(b->size());
+        const size_t cut_b = RndBelow(other.size());
+        b->resize(cut_a);
+        b->insert(b->end(), other.begin() + cut_b, other.end());
+        if (b->size() > max_len) b->resize(max_len);
+        break;
+      }
+      case 7: {  // overwrite with a chunk from another input
+        if (corpus.empty()) break;
+        const auto& other = corpus[RndBelow(corpus.size())].bytes;
+        if (other.empty()) break;
+        const size_t pos = RndBelow(b->size());
+        const size_t n =
+            std::min(b->size() - pos, 1 + RndBelow(other.size()));
+        const size_t src = RndBelow(other.size() - n + 1);
+        std::memcpy(b->data() + pos, other.data() + src, n);
+        break;
+      }
+      case 8: {  // arithmetic +-1..16 on a byte
+        uint8_t& c = (*b)[RndBelow(b->size())];
+        c = uint8_t(c + int(RndBelow(33)) - 16);
+        break;
+      }
+      default: {  // truncate
+        b->resize(1 + RndBelow(b->size()));
+        break;
+      }
+    }
+  }
+  if (b->size() > max_len) b->resize(max_len);
+}
+
+// ---------------------------------------------------------------------------
+// Execution
+// ---------------------------------------------------------------------------
+
+int64_t NowMs() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return int64_t(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
+
+unsigned g_timeout_s = 20;
+
+// Runs one input; returns true when it reached new coverage.
+bool RunOne(const uint8_t* data, size_t size) {
+  g_cur_data = data;
+  g_cur_size = size;
+  const size_t before = g_cov_count;
+  g_prev_pc = 0;
+  if (g_timeout_s) ::alarm(g_timeout_s);
+  LLVMFuzzerTestOneInput(data, size);
+  if (g_timeout_s) ::alarm(0);
+  g_cur_data = nullptr;
+  return g_cov_count > before;
+}
+
+void WriteCorpusFile(const std::string& dir,
+                     const std::vector<uint8_t>& b) {
+  char name[600];
+  std::snprintf(name, sizeof(name), "%s/auto-%016llx", dir.c_str(),
+                (unsigned long long)Fnv1a(b.data(), b.size()));
+  FILE* f = std::fopen(name, "wb");
+  if (!f) return;
+  std::fwrite(b.data(), 1, b.size(), f);
+  std::fclose(f);
+}
+
+}  // namespace
+
+// Sanitizer knobs, baked so every invocation (CI, sustained runs,
+// replay) behaves identically: huge hostile allocations must FAIL
+// (bad_alloc reaches the parser's error path) instead of aborting the
+// fuzzer, and leaks are findings.
+// default visibility: the whole tree builds -fvisibility=hidden, and a
+// hidden default-options hook is invisible to the sanitizer runtime
+// (observed: UBSan exiting without a stack or artifact dump)
+extern "C" __attribute__((visibility("default"))) const char*
+__asan_default_options() {
+  return "allocator_may_return_null=1:malloc_context_size=12:"
+         "detect_leaks=1:abort_on_error=1";
+}
+extern "C" __attribute__((visibility("default"))) const char*
+__ubsan_default_options() {
+  // abort (not _exit) so the SIGABRT hook dumps the crashing
+  // input even when the report comes from standalone UBSan
+  return "print_stacktrace=1:abort_on_error=1:halt_on_error=1";
+}
+
+int main(int argc, char** argv) {
+  int64_t fuzz_secs = 0, max_runs = 0;
+  size_t max_len = 1u << 20;
+  std::vector<std::string> corpus_args;
+  std::string out_dir;
+  uint64_t seed = 0;
+  for (int i = 1; i < argc; ++i) {
+    const std::string a = argv[i];
+    if (a.rfind("-fuzz=", 0) == 0) fuzz_secs = atoll(a.c_str() + 6);
+    else if (a.rfind("-runs=", 0) == 0) max_runs = atoll(a.c_str() + 6);
+    else if (a.rfind("-max_len=", 0) == 0) max_len = size_t(atoll(a.c_str() + 9));
+    else if (a.rfind("-seed=", 0) == 0) seed = uint64_t(atoll(a.c_str() + 6));
+    else if (a.rfind("-timeout=", 0) == 0) g_timeout_s = unsigned(atoi(a.c_str() + 9));
+    else if (a.rfind("-out=", 0) == 0) out_dir = a.substr(5);
+    else if (a.rfind("-artifact=", 0) == 0)
+      std::snprintf(g_artifact_prefix, sizeof(g_artifact_prefix), "%s",
+                    a.c_str() + 10);
+    else if (a == "-help" || a == "--help" || a[0] == '-') {
+      std::fprintf(stderr,
+                   "usage: %s [-fuzz=SECS] [-runs=N] [-max_len=N] "
+                   "[-seed=N] [-timeout=SECS] [-artifact=PREFIX] "
+                   "CORPUS_DIR|FILE...\n",
+                   argv[0]);
+      return a[0] == '-' && (a == "-help" || a == "--help") ? 0 : 2;
+    } else {
+      corpus_args.push_back(a);
+    }
+  }
+  if (seed) g_rng = seed * 0x9E3779B97F4A7C15ull + 1;
+
+  if (__sanitizer_set_death_callback)
+    __sanitizer_set_death_callback(DumpCurrentInput);
+  // SIGSEGV/SIGBUS stay with ASan (its report beats ours; the death
+  // callback above still dumps the input). Our handlers cover the
+  // paths ASan does not own: abort() from standalone UBSan, and the
+  // per-input alarm. PTPU_FUZZ_ALL_SIGNALS=1 restores the old
+  // behavior for uninstrumented builds.
+  if (std::getenv("PTPU_FUZZ_ALL_SIGNALS")) {
+    ::signal(SIGSEGV, CrashSignal);
+    ::signal(SIGBUS, CrashSignal);
+  }
+  ::signal(SIGABRT, CrashSignal);
+  ::signal(SIGALRM, CrashSignal);  // per-input timeout == finding
+
+  if (LLVMFuzzerInitialize) LLVMFuzzerInitialize(&argc, &argv);
+
+  std::vector<Input> corpus;
+  for (const auto& a : corpus_args) LoadCorpus(a, &corpus);
+  std::printf("ptpu_fuzz: %zu seed input(s), max_len %zu%s\n",
+              corpus.size(), max_len,
+              fuzz_secs || max_runs ? ", fuzzing" : ", replay only");
+
+  // ---- replay every seed (also primes the coverage map) ----
+  size_t replayed = 0;
+  for (const auto& in : corpus) {
+    RunOne(in.bytes.data(), in.bytes.size());
+    ++replayed;
+  }
+  std::printf("ptpu_fuzz: replayed %zu input(s), cov %zu edge(s)\n",
+              replayed, g_cov_count);
+  if (!fuzz_secs && !max_runs) {
+    std::printf("ptpu_fuzz: replay clean\n");
+    return 0;
+  }
+
+  // ---- mutation loop ----
+  const int64_t t_end = NowMs() + fuzz_secs * 1000;
+  int64_t runs = 0, last_report = NowMs(), last_runs = 0;
+  std::vector<uint8_t> buf;
+  while ((fuzz_secs == 0 || NowMs() < t_end) &&
+         (max_runs == 0 || runs < max_runs)) {
+    if (!corpus.empty() && RndBelow(256) != 0) {
+      buf = corpus[RndBelow(corpus.size())].bytes;
+    } else {
+      buf.assign(1 + RndBelow(64), 0);
+      for (auto& c : buf) c = uint8_t(Rnd());
+    }
+    Mutate(&buf, max_len, corpus);
+    const bool fresh = RunOne(buf.data(), buf.size());
+    ++runs;
+    if (fresh) {
+      Input in;
+      in.bytes = buf;
+      corpus.push_back(std::move(in));
+      if (!out_dir.empty()) WriteCorpusFile(out_dir, buf);
+    }
+    const int64_t now = NowMs();
+    if (now - last_report >= 5000) {
+      std::printf(
+          "#%lld cov: %zu corp: %zu exec/s: %lld\n",
+          (long long)runs, g_cov_count, corpus.size(),
+          (long long)((runs - last_runs) * 1000 / (now - last_report)));
+      std::fflush(stdout);
+      last_report = now;
+      last_runs = runs;
+    }
+  }
+  std::printf("ptpu_fuzz: done — %lld run(s), cov %zu, corpus %zu\n",
+              (long long)runs, g_cov_count, corpus.size());
+  return 0;
+}

@@ -1,0 +1,271 @@
+// ptpu_lockdep unit tests — the seeded-violation fixtures of the
+// ranked-mutex validator (csrc/ptpu_sync.h, ISSUE 11).
+//
+// Every violating scenario runs in a FORKED child (a lockdep report
+// abort()s, fail-fast like the sanitizers): the parent captures the
+// child's stderr through a pipe and asserts (a) the child died on
+// SIGABRT, (b) the report names the involved lock classes, and (c)
+// BOTH acquisition stacks were printed (two ">>> stack" blocks). The
+// clean scenarios run in-process and assert a zero violation count —
+// the same property tests/test_lockdep.py asserts over the live
+// selftest suite.
+//
+// Build: `make selftest` (always compiled with -DPTPU_LOCKDEP — this
+// binary IS the validator's fixture; the LOCKDEP knob only governs
+// the OTHER selftests). The shipping .so rules never see the macro:
+// tests/test_lockdep.py proves the pass-through by nm.
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cassert>
+#include <chrono>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "ptpu_sync.h"
+
+#ifndef PTPU_LOCKDEP
+#error "ptpu_lockdep_selftest must be built with -DPTPU_LOCKDEP"
+#endif
+
+namespace {
+
+// The fixture's own classes sit far above every production rank so a
+// test acquisition can never perturb the real table.
+PTPU_LOCK_CLASS(kClsA, "fixture.a", 200);
+PTPU_LOCK_CLASS(kClsB, "fixture.b", 210);
+PTPU_LOCK_CLASS(kClsEq1, "fixture.eq1", 220);
+PTPU_LOCK_CLASS(kClsEq2, "fixture.eq2", 230);
+PTPU_LOCK_CLASS(kClsBlocky, "fixture.blocky", 240,
+                ptpu::kLockAllowBlock);
+PTPU_LOCK_CLASS(kClsNoBlock, "fixture.noblock", 250);
+PTPU_LOCK_CLASS(kClsWaitee, "fixture.waitee", 260);
+PTPU_LOCK_CLASS(kClsShared, "fixture.shared", 270);
+// used ONLY by the rank-inversion fixture: they must carry no edges
+// from other tests (the graph is inherited across the test fork, and
+// a pre-existing opposite edge upgrades the report to a cycle)
+PTPU_LOCK_CLASS(kClsRankLo, "fixture.rank_lo", 300);
+PTPU_LOCK_CLASS(kClsRankHi, "fixture.rank_hi", 310);
+
+int g_tests = 0;
+
+void ok(const char* name) {
+  ++g_tests;
+  std::printf("  lockdep %-44s OK\n", name);
+}
+
+// Run `fn` in a forked child; return its stderr and assert it died on
+// SIGABRT. The child must not return from fn.
+std::string run_death_test(void (*fn)()) {
+  int fds[2];
+  assert(pipe(fds) == 0);
+  std::fflush(nullptr);
+  const pid_t pid = fork();
+  assert(pid >= 0);
+  if (pid == 0) {
+    ::unsetenv("PTPU_LOCKDEP_NOABORT");
+    ::dup2(fds[1], 2);
+    ::close(fds[0]);
+    ::close(fds[1]);
+    fn();
+    _exit(0);  // reached == violation NOT detected
+  }
+  ::close(fds[1]);
+  std::string out;
+  char buf[4096];
+  ssize_t r;
+  while ((r = ::read(fds[0], buf, sizeof(buf))) > 0)
+    out.append(buf, size_t(r));
+  ::close(fds[0]);
+  int st = 0;
+  assert(::waitpid(pid, &st, 0) == pid);
+  if (!(WIFSIGNALED(st) && WTERMSIG(st) == SIGABRT)) {
+    std::fprintf(stderr,
+                 "death test did NOT abort (status %d); stderr:\n%s\n",
+                 st, out.c_str());
+    assert(false);
+  }
+  return out;
+}
+
+size_t count_sub(const std::string& hay, const char* needle) {
+  size_t n = 0, pos = 0;
+  while ((pos = hay.find(needle, pos)) != std::string::npos) {
+    ++n;
+    pos += std::strlen(needle);
+  }
+  return n;
+}
+
+// ---------------------------------------------------------- fixtures
+
+// The seeded ABBA deadlock: thread 1 takes A then B (recording the
+// edge), thread 2 takes B then A. Sequenced by a join so it can never
+// actually deadlock — lockdep must report it DETERMINISTICALLY from
+// the order graph alone.
+void abba_child() {
+  ptpu::Mutex a(kClsA), b(kClsB);
+  std::thread t([&] {
+    ptpu::MutexLock ga(a);
+    ptpu::MutexLock gb(b);
+  });
+  t.join();
+  ptpu::MutexLock gb(b);
+  ptpu::MutexLock ga(a);  // B -> A closes the cycle: must abort
+}
+
+void rank_inversion_child() {
+  ptpu::Mutex hi(kClsRankHi), lo(kClsRankLo);  // ranks 310, 300
+  ptpu::MutexLock g1(hi);
+  ptpu::MutexLock g2(lo);  // descending rank, no prior edge: abort
+}
+
+void same_class_child() {
+  ptpu::Mutex m1(kClsEq1), m2(kClsEq1);
+  ptpu::MutexLock g1(m1);
+  ptpu::MutexLock g2(m2);  // same class twice: abort
+}
+
+void held_across_blocking_child() {
+  ptpu::Mutex held(kClsNoBlock), waitee(kClsWaitee);
+  ptpu::CondVar cv;
+  ptpu::MutexLock g(held);
+  ptpu::UniqueLock l(waitee);
+  ptpu::CvWaitForUs(cv, l, 1000);  // noblock class held: abort
+}
+
+void boundary_child() {
+  ptpu::Mutex m(kClsEq2);
+  ptpu::MutexLock g(m);
+  PTPU_LOCKDEP_ASSERT_NO_LOCKS("a lock-free boundary (fixture)");
+}
+
+// ------------------------------------------------------------- tests
+
+void test_clean_nesting_counts_zero() {
+  ptpu::Mutex a(kClsA), b(kClsB);
+  ptpu::SharedMutex sh(kClsShared);
+  for (int i = 0; i < 100; ++i) {
+    ptpu::MutexLock ga(a);
+    ptpu::MutexLock gb(b);
+    ptpu::SharedLock gs(sh);
+  }
+  {
+    ptpu::SharedUniqueLock gw(sh);
+  }
+  // concurrent shared holders across threads are clean
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 4; ++t)
+    ts.emplace_back([&] {
+      for (int i = 0; i < 100; ++i) {
+        ptpu::SharedLock gs(sh);
+      }
+    });
+  for (auto& t : ts) t.join();
+  assert(ptpu::lockdep::ViolationCount() == 0);
+  ok("clean nesting + shared locks: 0 reports");
+}
+
+void test_condvar_wait_clean() {
+  ptpu::Mutex m(kClsWaitee);
+  ptpu::CondVar cv;
+  bool flag = false;
+  std::thread t([&] {
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+    {
+      ptpu::MutexLock g(m);
+      flag = true;
+    }
+    cv.notify_one();
+  });
+  {
+    ptpu::UniqueLock l(m);
+    cv.wait(l, [&] { return flag; });
+    assert(flag);
+  }
+  t.join();
+  // holding an allow_block class across a wait is sanctioned
+  ptpu::Mutex blocky(kClsBlocky), w(kClsWaitee);
+  {
+    ptpu::MutexLock g(blocky);
+    ptpu::UniqueLock l(w);
+    ptpu::CvWaitForUs(cv, l, 1000);
+  }
+  // timed predicate wait: times out with the predicate false
+  {
+    ptpu::UniqueLock l(m);
+    flag = false;
+    assert(!ptpu::CvWaitForUs(cv, l, 2000, [&] { return flag; }));
+  }
+  assert(ptpu::lockdep::ViolationCount() == 0);
+  ok("condvar waits (pred, timed, allow_block): 0 reports");
+}
+
+void test_abba_detected_with_both_stacks() {
+  const std::string out = run_death_test(abba_child);
+  assert(out.find("lock-order cycle") != std::string::npos);
+  assert(out.find("\"fixture.a\"") != std::string::npos);
+  assert(out.find("\"fixture.b\"") != std::string::npos);
+  // both acquisition stacks printed (current + held), plus the
+  // first-recorded stacks of the opposite edge
+  assert(count_sub(out, ">>> stack") >= 2);
+  assert(out.find("of the current acquisition") != std::string::npos);
+  assert(out.find("of the held lock's acquisition") !=
+         std::string::npos);
+  ok("seeded ABBA cycle: deterministic abort, both stacks");
+}
+
+void test_rank_inversion_detected() {
+  const std::string out = run_death_test(rank_inversion_child);
+  assert(out.find("rank-order violation") != std::string::npos);
+  assert(out.find("\"fixture.rank_lo\"") != std::string::npos);
+  assert(out.find("\"fixture.rank_hi\"") != std::string::npos);
+  assert(count_sub(out, ">>> stack") >= 2);
+  ok("rank inversion: abort with both stacks");
+}
+
+void test_same_class_recursion_detected() {
+  const std::string out = run_death_test(same_class_child);
+  assert(out.find("same-class recursion") != std::string::npos);
+  assert(out.find("\"fixture.eq1\"") != std::string::npos);
+  assert(count_sub(out, ">>> stack") >= 2);
+  ok("same-class double acquire: abort");
+}
+
+void test_held_across_blocking_detected() {
+  const std::string out = run_death_test(held_across_blocking_child);
+  assert(out.find("held across a blocking wait") != std::string::npos);
+  assert(out.find("\"fixture.noblock\"") != std::string::npos);
+  assert(count_sub(out, ">>> stack") >= 2);
+  ok("held-across-blocking wait: abort");
+}
+
+void test_boundary_assert_detected() {
+  const std::string out = run_death_test(boundary_child);
+  assert(out.find("locks held entering") != std::string::npos);
+  assert(out.find("a lock-free boundary (fixture)") !=
+         std::string::npos);
+  ok("lock-free boundary assert: abort");
+}
+
+}  // namespace
+
+int main() {
+  std::printf("ptpu_lockdep_selftest (PTPU_LOCKDEP build)\n");
+  test_clean_nesting_counts_zero();
+  test_condvar_wait_clean();
+  test_abba_detected_with_both_stacks();
+  test_rank_inversion_detected();
+  test_same_class_recursion_detected();
+  test_held_across_blocking_detected();
+  test_boundary_assert_detected();
+  std::printf("all native lockdep unit tests passed (%d tests)\n",
+              g_tests);
+  return 0;
+}

@@ -97,6 +97,42 @@ static int64_t TracezQueryN(const std::string& target, int64_t dflt) {
   return dflt;
 }
 
+size_t HttpHeaderEnd(const char* data, size_t len) {
+  for (size_t i = 0; i + 3 < len; ++i) {
+    if (data[i] == '\r' && data[i + 1] == '\n' && data[i + 2] == '\r' &&
+        data[i + 3] == '\n')
+      return i + 4;
+  }
+  return 0;
+}
+
+HttpReqHead ParseHttpRequestHead(const char* data, size_t head_len) {
+  HttpReqHead out;
+  const std::string req(data, head_len);
+  // request line: METHOD SP target SP version
+  const size_t eol = req.find("\r\n");
+  const std::string line = req.substr(0, eol);
+  const size_t sp1 = line.find(' ');
+  const size_t sp2 =
+      sp1 == std::string::npos ? sp1 : line.find(' ', sp1 + 1);
+  if (sp1 == std::string::npos || sp2 == std::string::npos) return out;
+  out.ok = true;
+  out.method = line.substr(0, sp1);
+  out.target = line.substr(sp1 + 1, sp2 - sp1 - 1);
+  // keep-alive: HTTP/1.1 default unless "Connection: close"
+  std::string low = req;
+  for (auto& ch : low)
+    ch = char(ch >= 'A' && ch <= 'Z' ? ch + 32 : ch);
+  const bool http10 = line.find("HTTP/1.0") != std::string::npos;
+  out.keep_alive = !http10;
+  if (low.find("connection: close") != std::string::npos)
+    out.keep_alive = false;
+  if (http10 &&
+      low.find("connection: keep-alive") != std::string::npos)
+    out.keep_alive = true;
+  return out;
+}
+
 HttpReply TelemetryHttp(const std::string& target,
                         const std::function<std::string()>& stats_json,
                         const std::string& prom_prefix, bool draining) {
@@ -201,7 +237,7 @@ class EventLoop {
 
   void Post(Task t) {
     {
-      std::lock_guard<std::mutex> g(inbox_mu_);
+      MutexLock g(inbox_mu_);
       inbox_.push_back(std::move(t));
     }
     const uint64_t one = 1;
@@ -234,7 +270,7 @@ class EventLoop {
         (void)r;  // EAGAIN when nothing pending — fine
       }
       {
-        std::lock_guard<std::mutex> g(inbox_mu_);
+        MutexLock g(inbox_mu_);
         tasks.swap(inbox_);
       }
       for (auto& t : tasks) RunTask(t);
@@ -317,7 +353,7 @@ class EventLoop {
     conns_.emplace(c->fd_, c);
     if (!c->http_) {
       {
-        std::lock_guard<std::mutex> g(c->omu_);
+        MutexLock g(c->omu_);
         Conn::OutBuf ob;
         ob.b.assign(c->nonce_, c->nonce_ + sizeof(c->nonce_));
         c->outq_.push_back(std::move(ob));
@@ -424,7 +460,7 @@ class EventLoop {
       if (c->state_ != Conn::St::kClosed) {
         bool have;
         {
-          std::lock_guard<std::mutex> g(c->omu_);
+          MutexLock g(c->omu_);
           have = !c->outq_.empty();
         }
         if (have) FlushConn(c);
@@ -449,7 +485,7 @@ class EventLoop {
     if (opt_.idle_timeout_us > 0)
       c->idle_deadline_ = NowUs() + opt_.idle_timeout_us;
     {
-      std::lock_guard<std::mutex> g(c->omu_);
+      MutexLock g(c->omu_);
       Conn::OutBuf ob;
       ob.b.assign(1, uint8_t(0x01));  // handshake ack byte
       c->outq_.push_back(std::move(ob));
@@ -463,6 +499,10 @@ class EventLoop {
   // false when the conn was closed.
   bool DispatchFrame(Conn* c, const uint8_t* payload, uint32_t n) {
     FrameResult r;
+    // handler-boundary invariant: frame handlers run lock-free (they
+    // may take server-side locks and send replies; entering with a
+    // net-core lock held would invert the order)
+    PTPU_LOCKDEP_ASSERT_NO_LOCKS("the net frame handler");
     try {
       r = cbs_.on_frame(c->shared_from_this(), payload, n);
     } catch (...) {
@@ -556,15 +596,7 @@ class EventLoop {
           reinterpret_cast<const char*>(c->in_.data() + c->in_head_);
       const size_t avail = c->in_tail_ - c->in_head_;
       if (avail == 0) break;
-      // find the header terminator
-      size_t hdr_end = 0;
-      for (size_t i = 0; i + 3 < avail; ++i) {
-        if (data[i] == '\r' && data[i + 1] == '\n' &&
-            data[i + 2] == '\r' && data[i + 3] == '\n') {
-          hdr_end = i + 4;
-          break;
-        }
-      }
+      const size_t hdr_end = HttpHeaderEnd(data, avail);
       if (hdr_end == 0) {
         if (avail > kHttpMaxHeader) {
           SendHttpResponse(c, 431, "text/plain; charset=utf-8",
@@ -574,35 +606,19 @@ class EventLoop {
         }
         break;  // need more bytes
       }
-      const std::string req(data, hdr_end);
+      const HttpReqHead head = ParseHttpRequestHead(data, hdr_end);
       c->in_head_ += hdr_end;
       c->frame_t0_ = c->in_tail_ > c->in_head_ ? NowUs() : 0;
       if (HttpIdleUs() > 0) c->idle_deadline_ = NowUs() + HttpIdleUs();
-      // request line: METHOD SP target SP version
-      const size_t eol = req.find("\r\n");
-      const std::string line = req.substr(0, eol);
-      const size_t sp1 = line.find(' ');
-      const size_t sp2 =
-          sp1 == std::string::npos ? sp1 : line.find(' ', sp1 + 1);
-      if (sp1 == std::string::npos || sp2 == std::string::npos) {
+      if (!head.ok) {
         SendHttpResponse(c, 400, "text/plain; charset=utf-8",
                          "bad request\n", false);
         CloseAfterFlush(c);
         return false;
       }
-      const std::string method = line.substr(0, sp1);
-      const std::string target = line.substr(sp1 + 1, sp2 - sp1 - 1);
-      // keep-alive: HTTP/1.1 default unless "Connection: close"
-      std::string low = req;
-      for (auto& ch : low)
-        ch = char(ch >= 'A' && ch <= 'Z' ? ch + 32 : ch);
-      const bool http10 = line.find("HTTP/1.0") != std::string::npos;
-      bool keep = !http10;
-      if (low.find("connection: close") != std::string::npos)
-        keep = false;
-      if (http10 && low.find("connection: keep-alive") !=
-                        std::string::npos)
-        keep = true;
+      const std::string& method = head.method;
+      const std::string& target = head.target;
+      const bool keep = head.keep_alive;
       stats_->http_reqs.Add(1);
       bool alive;
       if (method != "GET") {
@@ -611,6 +627,7 @@ class EventLoop {
       } else {
         HttpReply rep;
         if (cbs_.on_http) {
+          PTPU_LOCKDEP_ASSERT_NO_LOCKS("the HTTP handler");
           try {
             rep = cbs_.on_http(target);
           } catch (...) {
@@ -649,7 +666,7 @@ class EventLoop {
   // --------------------------------------------------------- writes
 
   void FlushConn(Conn* c) {
-    std::unique_lock<std::mutex> g(c->omu_);
+    UniqueLock g(c->omu_);
     c->flush_posted_ = false;
     bool fatal = false;
     while (!c->outq_.empty()) {
@@ -744,7 +761,7 @@ class EventLoop {
         bool busy =
             c->pending_work_.load(std::memory_order_relaxed) > 0;
         if (!busy) {
-          std::lock_guard<std::mutex> g(c->omu_);
+          MutexLock g(c->omu_);
           busy = !c->outq_.empty();
         }
         if (busy)
@@ -841,7 +858,7 @@ class EventLoop {
     }
     c->state_ = Conn::St::kClosed;
     {
-      std::lock_guard<std::mutex> g(c->omu_);
+      MutexLock g(c->omu_);
       c->closed_ = true;
       c->outq_.clear();
       c->out_bytes_ = 0;
@@ -873,7 +890,7 @@ class EventLoop {
       Conn* c = kv.second.get();
       bool empty;
       {
-        std::lock_guard<std::mutex> g(c->omu_);
+        MutexLock g(c->omu_);
         empty = c->outq_.empty();
       }
       if (empty || now >= drain_deadline_) finish.push_back(c);
@@ -893,7 +910,7 @@ class EventLoop {
   Stats* stats_;
   int ep_ = -1, wake_fd_ = -1;
   std::thread th_;
-  std::mutex inbox_mu_;
+  Mutex inbox_mu_{kLockInbox};
   std::vector<Task> inbox_;
   std::unordered_map<int, ConnPtr> conns_;
   std::vector<ConnPtr> graveyard_;
@@ -916,7 +933,7 @@ bool Conn::EnqueueOut(std::vector<uint8_t>&& buf, uint64_t trace_id,
   EventLoop* loop = loop_;
   bool post_remote = false, post_local = false, kill = false;
   {
-    std::lock_guard<std::mutex> g(omu_);
+    MutexLock g(omu_);
     if (closed_) return false;
     if (max_out_bytes_ > 0 && out_bytes_ >= max_out_bytes_) {
       // peer stopped reading: cut the connection instead of buffering
@@ -938,7 +955,8 @@ bool Conn::EnqueueOut(std::vector<uint8_t>&& buf, uint64_t trace_id,
         ob.t_queued = NowUs();
       }
       outq_.push_back(std::move(ob));
-      if (!flush_posted_) {
+      // a Detached() conn has no loop: replies just queue
+      if (loop && !flush_posted_) {
         flush_posted_ = true;
         if (loop->IsOwnerThread())
           post_local = true;
@@ -948,7 +966,7 @@ bool Conn::EnqueueOut(std::vector<uint8_t>&& buf, uint64_t trace_id,
     }
   }
   if (kill) {
-    loop->PostClose(shared_from_this());
+    if (loop) loop->PostClose(shared_from_this());
     return false;
   }
   if (post_local) loop->NoteLocalFlush(shared_from_this());
@@ -977,7 +995,7 @@ bool Conn::SendCopy(const uint8_t* payload, size_t n) {
 }
 
 std::vector<uint8_t> Conn::AcquireBuf() {
-  std::lock_guard<std::mutex> g(omu_);
+  MutexLock g(omu_);
   if (!pool_.empty()) {
     std::vector<uint8_t> b = std::move(pool_.back());
     pool_.pop_back();
@@ -989,10 +1007,24 @@ std::vector<uint8_t> Conn::AcquireBuf() {
 void Conn::Close() {
   EventLoop* loop = loop_;
   {
-    std::lock_guard<std::mutex> g(omu_);
+    MutexLock g(omu_);
     if (closed_) return;
+    if (!loop) {  // detached (fuzz/test) conn: close inline
+      closed_ = true;
+      outq_.clear();
+      out_bytes_ = 0;
+      return;
+    }
   }
-  if (loop) loop->PostClose(shared_from_this());
+  loop->PostClose(shared_from_this());
+}
+
+ConnPtr Conn::Detached(size_t max_out_bytes) {
+  auto c = std::make_shared<Conn>();
+  c->id_ = g_conn_id.fetch_add(1, std::memory_order_relaxed);
+  c->state_ = St::kOpen;
+  c->max_out_bytes_ = max_out_bytes;
+  return c;
 }
 
 int64_t Conn::deferred_us() const {

@@ -39,15 +39,22 @@
 #include <deque>
 #include <functional>
 #include <memory>
-#include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
 
 #include "ptpu_stats.h"
+#include "ptpu_sync.h"
 
 namespace ptpu {
 namespace net {
+
+// Lock classes of the net core (rank table: README "Correctness
+// tooling"). Event loops take at most ONE of these at a time; the
+// conn out-lock is the LAST lock on any reply path (a batcher worker
+// may reach it holding serving-side locks, never the reverse).
+PTPU_LOCK_CLASS(kLockConnOut, "net.conn_out", 100);
+PTPU_LOCK_CLASS(kLockInbox, "net.inbox", 110);
 
 // Net-core counters, embedded in each server's stats block and
 // rendered into its stats_json (twin names documented in
@@ -166,6 +173,14 @@ class Conn : public std::enable_shared_from_this<Conn> {
   // allocate in on_open, free in on_close).
   void* user = nullptr;
 
+  // Fuzz/test hook: a connection owned by NO event loop (fd -1, state
+  // open). Send*/AcquireBuf queue replies without flushing, so a
+  // harness can pump frame payloads straight into a server's on_frame
+  // handler with zero sockets in the loop (csrc/fuzz/*). Queued
+  // replies die with the object; past max_out_bytes the conn closes
+  // like a live one.
+  static std::shared_ptr<Conn> Detached(size_t max_out_bytes = 64u << 20);
+
  private:
   friend class EventLoop;
   friend class Server;
@@ -204,7 +219,7 @@ class Conn : public std::enable_shared_from_this<Conn> {
   std::atomic<int64_t> pending_work_{0};  // see NotePending
 
   // ---- shared state (guarded by omu_) ----
-  std::mutex omu_;
+  Mutex omu_{kLockConnOut};
   std::deque<OutBuf> outq_;
   std::vector<std::vector<uint8_t>> pool_;
   size_t out_bytes_ = 0;         // queued unsent bytes
@@ -214,6 +229,26 @@ class Conn : public std::enable_shared_from_this<Conn> {
 };
 
 using ConnPtr = std::shared_ptr<Conn>;
+
+// ---- HTTP request-head parsing (pure functions, fuzzed directly by
+// csrc/fuzz/fuzz_http.cc; the buffered state machine around them is
+// split-point-tested in csrc/ptpu_net_selftest.cc) ----
+
+// Offset one past the CRLFCRLF header terminator within [data, len),
+// or 0 when the buffer does not yet hold a complete head.
+size_t HttpHeaderEnd(const char* data, size_t len);
+
+// One parsed HTTP/1.x request head (GET-only telemetry).
+struct HttpReqHead {
+  bool ok = false;          // request line had METHOD SP target SP ...
+  std::string method;
+  std::string target;       // path + query string, verbatim
+  bool keep_alive = true;   // 1.1 default; Connection header honored
+};
+
+// Parse the request line + keep-alive semantics of one complete head
+// (`head_len` as returned by HttpHeaderEnd).
+HttpReqHead ParseHttpRequestHead(const char* data, size_t head_len);
 
 // One telemetry HTTP response (GET only; built inline on the event
 // thread, so handlers must not block).

@@ -22,7 +22,6 @@
 #include <sys/socket.h>
 
 #include <cassert>
-#include <condition_variable>
 #include <cstdio>
 #include <cstring>
 #include <string>
@@ -86,13 +85,17 @@ bool recv_frame(int fd, std::vector<uint8_t> *out) {
 
 // ------------------------------------------------------ echo server
 
+// Test-fixture lock class: acquired FIRST on any path that later
+// takes net-core locks (rank table: README "Correctness tooling").
+PTPU_LOCK_CLASS(kLockTestFixture, "test.fixture", 2);
+
 struct EchoServer {
   Stats stats;
   std::unique_ptr<Server> srv;
   // delayed-reply machinery (the serving-batcher pattern): frames
   // whose first byte is 'D' park here and a worker thread answers
-  std::mutex dmu;
-  std::condition_variable dcv;
+  ptpu::Mutex dmu{kLockTestFixture};
+  ptpu::CondVar dcv;
   std::vector<std::pair<ConnPtr, std::vector<uint8_t>>> delayed;
   bool dstop = false;
   std::thread dworker;
@@ -109,7 +112,7 @@ struct EchoServer {
         return FrameResult::kDefer;
       frames.fetch_add(1, std::memory_order_relaxed);
       if (n > 0 && p[0] == 'D') {
-        std::lock_guard<std::mutex> g(dmu);
+        ptpu::MutexLock g(dmu);
         delayed.emplace_back(c, std::vector<uint8_t>(p, p + n));
         dcv.notify_one();
         return FrameResult::kOk;
@@ -124,7 +127,7 @@ struct EchoServer {
       assert(false);
     }
     dworker = std::thread([this] {
-      std::unique_lock<std::mutex> l(dmu);
+      ptpu::UniqueLock l(dmu);
       for (;;) {
         dcv.wait(l, [this] { return dstop || !delayed.empty(); });
         if (delayed.empty() && dstop) return;
@@ -146,7 +149,7 @@ struct EchoServer {
 
   void StopWorker() {
     {
-      std::lock_guard<std::mutex> g(dmu);
+      ptpu::MutexLock g(dmu);
       dstop = true;
     }
     dcv.notify_all();
@@ -392,7 +395,7 @@ void test_graceful_drain_flushes_in_flight() {
   send_frame(fd, {'D', 'q'});
   // wait until the handler parked the request with the worker
   {
-    std::unique_lock<std::mutex> l(es->dmu);
+    ptpu::UniqueLock l(es->dmu);
     while (es->delayed.empty() &&
            es->frames.load(std::memory_order_relaxed) == 0) {
       l.unlock();

@@ -1,0 +1,116 @@
+// Tiny hand-rolled ONNX/protobuf WRITER — test/fuzz infrastructure
+// only, never linked into a shipping .so. One copy shared by the
+// serving selftest (csrc/ptpu_serving_selftest.cc round-trip
+// artifacts) and the fuzz harnesses (csrc/fuzz/: structure-aware
+// seed artifacts for the ONNX-loader and serving-wire targets). The
+// field numbers mirror exactly the subset csrc/ptpu_predictor.cc's
+// parse_model consumes (ModelProto.graph = 7; GraphProto node = 1,
+// initializer = 5, input = 11, output = 12; NodeProto input = 1,
+// output = 2, op_type = 4, attribute = 5; TensorProto dims = 1,
+// data_type = 2, name = 8, raw_data = 9).
+#ifndef PTPU_ONNX_WRITER_H_
+#define PTPU_ONNX_WRITER_H_
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace ptpu {
+namespace onnxw {
+
+inline void put_varint(std::string* s, uint64_t v) {
+  while (v >= 0x80) {
+    s->push_back(char(v | 0x80));
+    v >>= 7;
+  }
+  s->push_back(char(v));
+}
+
+inline void put_tag(std::string* s, int field, int wire) {
+  put_varint(s, uint64_t(field) << 3 | unsigned(wire));
+}
+
+inline void put_u64f(std::string* s, int field, uint64_t v) {
+  put_tag(s, field, 0);
+  put_varint(s, v);
+}
+
+inline void put_lenf(std::string* s, int field,
+                     const std::string& payload) {
+  put_tag(s, field, 2);
+  put_varint(s, payload.size());
+  s->append(payload);
+}
+
+inline std::string onnx_tensor_f32(const std::string& name,
+                                   const std::vector<int64_t>& dims,
+                                   const float* data, size_t n) {
+  std::string t;
+  for (int64_t d : dims) put_u64f(&t, 1, uint64_t(d));
+  put_u64f(&t, 2, 1);  // data_type f32
+  put_lenf(&t, 8, name);
+  put_lenf(&t, 9,
+           std::string(reinterpret_cast<const char*>(data), n * 4));
+  return t;
+}
+
+inline std::string onnx_tensor_i64(const std::string& name,
+                                   const std::vector<int64_t>& dims,
+                                   const std::vector<int64_t>& data) {
+  std::string t;
+  for (int64_t d : dims) put_u64f(&t, 1, uint64_t(d));
+  put_u64f(&t, 2, 7);  // data_type i64
+  put_lenf(&t, 8, name);
+  put_lenf(&t, 9,
+           std::string(reinterpret_cast<const char*>(data.data()),
+                       data.size() * 8));
+  return t;
+}
+
+inline std::string onnx_value_info(const std::string& name, int elem,
+                                   const std::vector<int64_t>& dims) {
+  std::string shape;
+  for (int64_t d : dims) {
+    std::string dim;
+    put_u64f(&dim, 1, uint64_t(d));
+    put_lenf(&shape, 1, dim);
+  }
+  std::string tt;
+  put_u64f(&tt, 1, uint64_t(elem));
+  put_lenf(&tt, 2, shape);
+  std::string ty;
+  put_lenf(&ty, 1, tt);
+  std::string vi;
+  put_lenf(&vi, 1, name);
+  put_lenf(&vi, 2, ty);
+  return vi;
+}
+
+inline std::string onnx_node(const std::string& op,
+                             const std::vector<std::string>& ins,
+                             const std::vector<std::string>& outs) {
+  std::string n;
+  for (const auto& i : ins) put_lenf(&n, 1, i);
+  for (const auto& o : outs) put_lenf(&n, 2, o);
+  put_lenf(&n, 4, op);
+  return n;
+}
+
+// node with one integer attribute (Cast's `to`)
+inline std::string onnx_node_iattr(const std::string& op,
+                                   const std::vector<std::string>& ins,
+                                   const std::vector<std::string>& outs,
+                                   const std::string& aname,
+                                   int64_t aval) {
+  std::string n = onnx_node(op, ins, outs);
+  std::string a;
+  put_lenf(&a, 1, aname);
+  put_u64f(&a, 3, uint64_t(aval));
+  put_lenf(&n, 5, a);
+  return n;
+}
+
+}  // namespace onnxw
+}  // namespace ptpu
+
+#endif  // PTPU_ONNX_WRITER_H_

@@ -18,7 +18,6 @@
 #include <atomic>
 #include <chrono>
 #include <cmath>
-#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -28,7 +27,6 @@
 #include <limits>
 #include <map>
 #include <memory>
-#include <mutex>
 #include <set>
 #include <sstream>
 #include <stdexcept>
@@ -39,6 +37,7 @@
 
 #include "ptpu_arena.h"
 #include "ptpu_stats.h"
+#include "ptpu_sync.h"
 
 namespace {
 
@@ -85,7 +84,10 @@ struct Reader {
         cb(field, wire, Reader{nullptr, nullptr}, v);
       } else if (wire == 2) {
         uint64_t len = varint();
-        if (p + len > end) { ok = false; return; }
+        // compare against the REMAINING size: `p + len` overflows the
+        // pointer for a hostile 64-bit length (UB; fuzzing finding,
+        // ISSUE 11; repro: corpus/onnx/crash-varint-len-overflow.bin)
+        if (len > uint64_t(end - p)) { ok = false; return; }
         cb(field, wire, Reader{p, p + len}, 0);
         p += len;
       } else if (wire == 5) {
@@ -102,7 +104,11 @@ struct Reader {
       }
     }
   }
-  std::string str() const { return std::string((const char*)p, end - p); }
+  std::string str() const {
+    // wire-0 fields hand sub-readers a null range: an empty string,
+    // never std::string(nullptr, 0) (UB; fuzzing finding, ISSUE 11)
+    return p ? std::string((const char*)p, end - p) : std::string();
+  }
   std::vector<int64_t> packed_varints() const {
     Reader r{p, end};
     std::vector<int64_t> out;
@@ -203,15 +209,34 @@ struct Tensor {
   Buf<float> f;    // DT_F32 / DT_F64 (converted)
   Buf<int64_t> i;  // DT_I32 / DT_I64 / DT_BOOL / DT_U8
   int64_t numel() const {
-    int64_t n = 1;
-    for (auto d : dims) n *= d;
-    return n;
+    // hostile artifacts carry arbitrary dims: negative or
+    // product-overflowing shapes must surface as a load error, not
+    // signed-overflow UB (fuzzing finding, ISSUE 11; repro:
+    // csrc/fuzz/corpus/onnx/crash-numel-overflow.bin)
+    uint64_t n = 1;
+    for (auto d : dims) {
+      if (d < 0) throw std::runtime_error("tensor dim < 0");
+      if (d != 0 && n > uint64_t(INT64_MAX) / uint64_t(d))
+        throw std::runtime_error("tensor element count overflows");
+      n *= uint64_t(d);
+    }
+    return int64_t(n);
   }
   bool is_float() const { return dtype == DT_F32 || dtype == DT_F64; }
   double at(int64_t k) const { return is_float() ? f[k] : double(i[k]); }
   void alloc() {
     const size_t n = size_t(numel());
     const size_t bytes = n * (is_float() ? sizeof(float) : sizeof(int64_t));
+    /* Single-tensor sanity cap (fuzzing finding, ISSUE 11; repro:
+     * csrc/fuzz/corpus/onnx/crash-expand-petabytes.bin): a hostile
+     * graph can COMPUTE a petabyte output shape (broadcast/Expand) —
+     * the load-time dry run must fail with an error, not an OOM
+     * abort. 8 GiB is far above any real serving tensor and far
+     * below the allocator's hard limits. */
+    if (bytes > (size_t(1) << 33))
+      throw std::runtime_error(
+          "tensor allocation of " + std::to_string(bytes) +
+          " bytes exceeds the 8 GiB per-tensor sanity cap");
     if (g_alloc_hint && !g_alloc_hint->used && bytes <= g_alloc_hint->bytes) {
       g_alloc_hint->used = true;
       if (is_float()) f.bind(reinterpret_cast<float*>(g_alloc_hint->base), n);
@@ -261,9 +286,41 @@ Tensor parse_tensor(Reader r) {
     else if (field == 9) raw = sub.str();
   });
   int64_t n = t.numel();
+  /* Truncation guard (fuzzing finding, ISSUE 11; repro:
+   * corpus/onnx/crash-initializer-claims-tb.bin): the claimed element
+   * count must be backed by the raw payload BEFORE the buffer is
+   * sized — a 100-byte artifact must not be able to demand a
+   * terabyte-scale allocation (and a short raw block used to
+   * zero-fill weights SILENTLY, which is corruption, not tolerance).
+   * Raw-less initializers (legal: zero tensors) are capped at 16M
+   * elements — shape/constant tensors, not weights. */
+  {
+    const int64_t esz = t.dtype == DT_F64 || t.dtype == DT_I64 ? 8
+                        : t.dtype == DT_BOOL || t.dtype == DT_U8 ||
+                                t.dtype == DT_I8
+                            ? 1
+                            : 4;
+    if (raw.empty()) {
+      if (n > (int64_t(1) << 24))
+        throw std::runtime_error(
+            "initializer with no raw data claims " + std::to_string(n) +
+            " elements");
+    } else if (uint64_t(raw.size()) / uint64_t(esz) <
+               uint64_t(n)) {  // divide: n * esz could overflow
+      throw std::runtime_error(
+          "initializer raw data truncated: " + std::to_string(n) +
+          " elements claimed, " + std::to_string(raw.size()) +
+          " bytes present");
+    }
+  }
+  // n == 0 (a dim of 0): the destination buffer is empty and data()
+  // NULL — memcpy(NULL, ..., 0) is UB by declaration and aborts a
+  // fail-fast build (fuzzing finding, ISSUE 11; repro:
+  // corpus/onnx/crash-zero-elem-initializer.bin). Guard n, not size.
   if (t.dtype == DT_F32) {
     t.f.resize(size_t(n));
-    if (raw.size() >= size_t(n) * 4) memcpy(t.f.data(), raw.data(), n * 4);
+    if (n > 0 && raw.size() >= size_t(n) * 4)
+      memcpy(t.f.data(), raw.data(), n * 4);
   } else if (t.dtype == DT_F64) {
     // raw sits at an arbitrary protobuf offset: per-element memcpy
     // (one unaligned mov) instead of a cast-deref, which is UB
@@ -277,7 +334,8 @@ Tensor parse_tensor(Reader r) {
     t.dtype = DT_F32;
   } else if (t.dtype == DT_I64) {
     t.i.resize(size_t(n));
-    if (raw.size() >= size_t(n) * 8) memcpy(t.i.data(), raw.data(), n * 8);
+    if (n > 0 && raw.size() >= size_t(n) * 8)
+      memcpy(t.i.data(), raw.data(), n * 8);
   } else if (t.dtype == DT_I32) {
     t.i.resize(size_t(n));
     if (raw.size() >= size_t(n) * 4)
@@ -287,13 +345,21 @@ Tensor parse_tensor(Reader r) {
         t.i[size_t(k)] = iv;
       }
   } else if (t.dtype == DT_BOOL || t.dtype == DT_U8) {
+    // raw may legally be ABSENT (zero tensor): the byte loops must
+    // not read past an empty string like the word-size branches
+    // already don't (fuzzing finding, ISSUE 11; repro:
+    // corpus/onnx/crash-u8-no-raw.bin) — resize() zero-fills
     t.i.resize(size_t(n));
-    const uint8_t* d = (const uint8_t*)raw.data();
-    for (int64_t k = 0; k < n; ++k) t.i[size_t(k)] = d[k];
+    if (int64_t(raw.size()) >= n) {
+      const uint8_t* d = (const uint8_t*)raw.data();
+      for (int64_t k = 0; k < n; ++k) t.i[size_t(k)] = d[k];
+    }
   } else if (t.dtype == DT_I8) {
     t.i.resize(size_t(n));
-    const int8_t* d = (const int8_t*)raw.data();
-    for (int64_t k = 0; k < n; ++k) t.i[size_t(k)] = d[k];
+    if (int64_t(raw.size()) >= n) {
+      const int8_t* d = (const int8_t*)raw.data();
+      for (int64_t k = 0; k < n; ++k) t.i[size_t(k)] = d[k];
+    }
   } else {
     throw std::runtime_error("initializer dtype " +
                              std::to_string(t.dtype) + " unsupported");
@@ -305,7 +371,13 @@ Attr parse_attr(Reader r, std::string* name) {
   Attr a;
   r.fields([&](int field, int wire, Reader sub, uint64_t v) {
     if (field == 1) *name = sub.str();
-    else if (field == 2) memcpy(&a.fval, sub.p, 4);
+    else if (field == 2) {
+      // AttributeProto.f is wire type 5 (4 bytes); a hostile varint
+      // encoding of field 2 hands a null/short reader — reading 4
+      // bytes from it is the crash csrc/fuzz/corpus/onnx/
+      // crash-attr-f-as-varint.bin reproduces (fuzzing finding)
+      if (sub.end - sub.p >= 4) memcpy(&a.fval, sub.p, 4);
+    }
     else if (field == 3) a.ival = int64_t(v);
     else if (field == 4) a.sval = sub.str();
     else if (field == 5) a.t = parse_tensor(sub);
@@ -404,17 +476,28 @@ std::vector<int64_t> bcast_dims(const std::vector<int64_t>& a,
     int64_t db = k < rank - b.size() ? 1 : b[k - (rank - b.size())];
     if (da != db && da != 1 && db != 1)
       throw std::runtime_error("broadcast mismatch");
-    out[k] = std::max(da, db);
+    // numpy semantics, NOT max(): a ZERO dim against 1 broadcasts to
+    // ZERO — max() manufactured elements out of an empty operand and
+    // the kernels then read past its storage (fuzzing finding, ISSUE
+    // 11; repro: corpus/onnx/crash-reshape-marker-mismatch.bin)
+    out[k] = da == 1 ? db : da;
   }
   return out;
 }
 
 std::vector<int64_t> strides_for(const std::vector<int64_t>& dims) {
   std::vector<int64_t> s(dims.size());
-  int64_t acc = 1;
+  // unsigned accumulation: a ZERO-element shape (which passes every
+  // numel guard) can still carry huge sibling dims whose partial
+  // product overflows int64 — defined wrap instead of UB; strides of
+  // an empty tensor are never dereferenced (fuzzing finding, ISSUE
+  // 11; repro: csrc/fuzz/corpus/onnx/crash-strides-overflow.bin).
+  // Non-empty shapes are safe: every partial product divides numel,
+  // which the overflow-checked Tensor::numel() already bounds.
+  uint64_t acc = 1;
   for (int k = int(dims.size()) - 1; k >= 0; --k) {
-    s[size_t(k)] = acc;
-    acc *= dims[size_t(k)];
+    s[size_t(k)] = int64_t(acc);
+    acc *= uint64_t(dims[size_t(k)]);
   }
   return s;
 }
@@ -476,6 +559,13 @@ static int num_threads() {
  * thread_local g_active_pool, so two instances with disjoint sub-pools
  * execute truly in parallel instead of queueing on the global
  * dispatch mutex. */
+// WorkPool lock classes (rank table: README "Correctness tooling"):
+// the dispatch lock is DESIGNED to be held across the cv_done_ wait
+// (it serializes whole dispatches) -> kLockAllowBlock; the state lock
+// nests inside it and is the leaf of every execution path.
+PTPU_LOCK_CLASS(kLockWpDispatch, "wp.dispatch", 60, ptpu::kLockAllowBlock);
+PTPU_LOCK_CLASS(kLockWpState, "wp.state", 70);
+
 class WorkPool {
  public:
   explicit WorkPool(int n_workers) {
@@ -494,12 +584,12 @@ class WorkPool {
       fn(0, n);
       return;
     }
-    std::lock_guard<std::mutex> dispatch(dispatch_mu_);
+    ptpu::MutexLock dispatch(dispatch_mu_);
     const int64_t parts = int64_t(workers_.size() + 1) * 4;
     const int64_t chunk = std::max(grain, (n + parts - 1) / parts);
     const int64_t chunks = (n + chunk - 1) / chunk;
     {
-      std::lock_guard<std::mutex> l(mu_);
+      ptpu::MutexLock l(mu_);
       fn_ = &fn;
       n_ = n;
       chunk_ = chunk;
@@ -530,7 +620,7 @@ class WorkPool {
       // wait for the joined workers — fn_ must not dangle past this
       // frame
       in_worker_ = false;
-      std::unique_lock<std::mutex> l(mu_);
+      ptpu::UniqueLock l(mu_);
       cv_done_.wait(l, [&] {
         return active_ == 0 && next_.load(std::memory_order_relaxed) >= n_;
       });
@@ -538,7 +628,7 @@ class WorkPool {
       throw;
     }
     in_worker_ = false;
-    std::unique_lock<std::mutex> l(mu_);
+    ptpu::UniqueLock l(mu_);
     cv_done_.wait(l, [&] {
       return active_ == 0 && next_.load(std::memory_order_relaxed) >= n_;
     });
@@ -547,7 +637,7 @@ class WorkPool {
 
   ~WorkPool() {
     {
-      std::lock_guard<std::mutex> l(mu_);
+      ptpu::MutexLock l(mu_);
       stop_ = true;
     }
     cv_go_.notify_all();
@@ -571,7 +661,7 @@ class WorkPool {
       const std::function<void(int64_t, int64_t)>* fn;
       int64_t n, chunk;
       {
-        std::unique_lock<std::mutex> l(mu_);
+        ptpu::UniqueLock l(mu_);
         cv_go_.wait(l, [&] { return stop_ || epoch_ != seen; });
         if (stop_) return;
         seen = epoch_;
@@ -583,15 +673,15 @@ class WorkPool {
       }
       drain(*fn, n, chunk);
       {
-        std::lock_guard<std::mutex> l(mu_);
+        ptpu::MutexLock l(mu_);
         if (--active_ == 0) cv_done_.notify_one();
       }
     }
   }
 
   std::vector<std::thread> workers_;
-  std::mutex mu_, dispatch_mu_;
-  std::condition_variable cv_go_, cv_done_;
+  ptpu::Mutex mu_{kLockWpState}, dispatch_mu_{kLockWpDispatch};
+  ptpu::CondVar cv_go_, cv_done_;
   const std::function<void(int64_t, int64_t)>* fn_ = nullptr;
   int64_t n_ = 0, chunk_ = 1;
   std::atomic<int64_t> next_{0};
@@ -1043,6 +1133,24 @@ template <class T>
 static void gemm_compute(const T* Apack, const T* Bpack, T* C,
                          int64_t M, int64_t N, int64_t K,
                          const T* bias_n, const T* bias_m, int act) {
+  // degenerate extents (a hostile artifact can drive N or K to 0
+  // through a zero dim): the tile-count arithmetic below divides by
+  // the N tile count (fuzzing finding, ISSUE 11; repro:
+  // corpus/onnx/crash-gemm-zero-n.bin). M or N zero leaves an empty
+  // C; K zero is an EMPTY SUM — C still has M*N elements and the
+  // arena planner never zero-fills (every op fully writes its
+  // output), so the epilogue must run over acc == 0 or stale arena
+  // bytes leak into the output.
+  if (M <= 0 || N <= 0) return;
+  if (K <= 0) {
+    for (int64_t i = 0; i < M; ++i)
+      for (int64_t j = 0; j < N; ++j) {
+        const T v = (bias_m ? bias_m[i] : T(0)) +
+                    (bias_n ? bias_n[j] : T(0));
+        C[i * N + j] = act_apply(v, act);
+      }
+    return;
+  }
   const int64_t ntn = (N + NR - 1) / NR;
   const int64_t mp = (M + MR - 1) / MR;
   const int64_t want = int64_t(3) * num_threads();
@@ -1331,6 +1439,15 @@ static inline void micro_tile_i16(const int16_t* Ap, const int16_t* Bp,
 static void gemm_compute_i16(const int16_t* Apack, const int16_t* Bpack,
                              int32_t* C, int64_t M, int64_t N,
                              int64_t K) {
+  // same degenerate-extent guard as gemm_compute (fuzzing finding,
+  // ISSUE 11; repro: csrc/fuzz/corpus/onnx/crash-gemm-i16-zero-n.bin);
+  // zero K is an empty sum, and C must still be fully written (the
+  // arena is not zero-filled)
+  if (M <= 0 || N <= 0) return;
+  if (K <= 0) {
+    std::fill(C, C + M * N, int32_t(0));
+    return;
+  }
   const int64_t K2 = kpairs(K);
   const int64_t ntn = (N + NR - 1) / NR;
   const int64_t mp = (M + MR - 1) / MR;
@@ -1616,6 +1733,10 @@ static void bcast_walk(const std::vector<int64_t>& odims,
   const size_t r = odims.size();
   int64_t total = 1;
   for (auto d : odims) total *= d;
+  // empty output (a zero dim): nothing to walk — the odometer seed
+  // below takes % odims[d] and a zero dim divides by zero (fuzzing
+  // finding, ISSUE 11; repro: corpus/onnx/crash-bcast-zero-dim.bin)
+  if (total == 0) return;
   if (r == 0) {
     if (total) f(int64_t(0), int64_t(0), int64_t(0));
     return;
@@ -1981,11 +2102,35 @@ struct Predictor {
   }
 
   const Tensor& in(const Node& n, size_t k) {
+    // arity guard BEFORE the access: a hostile artifact can carry a
+    // node with fewer inputs than its op implies — n.inputs[k] would
+    // read past the vector (ASan-caught in the load-time dry run;
+    // fuzzing finding, ISSUE 11; repro:
+    // csrc/fuzz/corpus/onnx/crash-binary-op-missing-input.bin)
+    if (k >= n.inputs.size())
+      throw std::runtime_error("op " + n.op + " expects input #" +
+                               std::to_string(k) + " but the node has " +
+                               std::to_string(n.inputs.size()));
     auto it = env.find(n.inputs[k]);
     if (it == env.end())
       throw std::runtime_error("missing input tensor '" + n.inputs[k] +
                                "' for op " + n.op);
-    return it->second;
+    /* Dims-vs-storage invariant at the ONE consumption chokepoint
+     * (fuzzing finding, ISSUE 11; repro:
+     * csrc/fuzz/corpus/onnx/crash-reshape-marker-mismatch.bin): a
+     * hostile graph can launder a dims/storage mismatch through ops
+     * that carry storage while rewriting dims (Reshape's dynamic
+     * 0/-1 marker path) — every kernel indexes by dims, so a
+     * mismatched operand is an OOB read wherever it is consumed.
+     * Catch the whole class here instead of auditing every producer. */
+    const Tensor& t = it->second;
+    const size_t have = t.is_float() ? t.f.size() : t.i.size();
+    if (size_t(t.numel()) > have)
+      throw std::runtime_error(
+          "tensor '" + n.inputs[k] + "' claims " +
+          std::to_string(t.numel()) + " elements but holds " +
+          std::to_string(have) + " (dims/storage mismatch)");
+    return t;
   }
 
   static int64_t attr_i(const Node& n, const char* name, int64_t dflt) {
@@ -3688,7 +3833,16 @@ struct Predictor {
       auto it = env.find(name);
       if (it == env.end())
         throw std::runtime_error("output '" + name + "' never produced");
-      outputs.push_back(it->second);
+      // same dims-vs-storage invariant as in(): callers copy
+      // numel()-many elements out of this buffer
+      const Tensor& t = it->second;
+      const size_t have = t.is_float() ? t.f.size() : t.i.size();
+      if (size_t(t.numel()) > have)
+        throw std::runtime_error(
+            "output '" + name + "' claims " + std::to_string(t.numel()) +
+            " elements but holds " + std::to_string(have) +
+            " (dims/storage mismatch)");
+      outputs.push_back(t);
     }
   }
 };
@@ -3712,6 +3866,11 @@ bool contains(const char* const* arr, size_t n, const std::string& s) {
 
 void Predictor::run_node(const Node& n) {
   const std::string& op = n.op;
+  // same hostile-artifact guard as Predictor::in(): every op writes
+  // n.outputs[0] (multi-output ops index further and are checked at
+  // their sites)
+  if (n.outputs.empty())
+    throw std::runtime_error("op " + op + " has no outputs");
   auto out = [&](Tensor t) { env[n.outputs[0]] = std::move(t); };
 
   if (op == "Identity") {
@@ -3816,7 +3975,10 @@ void Predictor::run_node(const Node& n) {
       }
     }
     if (a.is_float() && b.is_float() && o.dtype == DT_F32 &&
-        code <= B_MIN && o.dims.size() >= 2) {
+        code <= B_MIN && o.dims.size() >= 2 && o.dims.back() > 0) {
+      // ^ dims.back() > 0: a zero last axis divides rows by zero
+      // below (fuzzing finding, ISSUE 11; repro:
+      // csrc/fuzz/corpus/onnx/crash-rowbcast-zero-axis.bin)
       /* last-axis vector broadcast: one operand is a [1,..,N] vector
        * against a full [..,N] tensor — the bias-add (+act) epilogue
        * shape of every un-fusable GEMM/dequant chain. One vector
@@ -4020,13 +4182,27 @@ void Predictor::run_node(const Node& n) {
     const Tensor& a = in(n, 0);
     const Tensor& shp = in(n, 1);
     std::vector<int64_t> want(shp.i.begin(), shp.i.end());
-    int64_t wn = 1;
+    // overflow-checked product (fuzzing finding, ISSUE 11; repro:
+    // csrc/fuzz/corpus/onnx/crash-reshape-overflow.bin); a CONCRETE
+    // shape that does not match the element count is an error, not a
+    // dims/storage-mismatched tensor for a later op to index with
+    uint64_t wn_u = 1;
     bool concrete = true;
     for (auto d : want) {
-      if (d <= 0) concrete = false;
-      wn *= d;
+      if (d <= 0) {
+        concrete = false;
+        continue;
+      }
+      if (wn_u > uint64_t(INT64_MAX) / uint64_t(d))
+        throw std::runtime_error("Reshape: target shape overflows");
+      wn_u *= uint64_t(d);
     }
-    if (concrete && wn == a.numel()) {
+    const int64_t wn = int64_t(wn_u);
+    if (concrete && wn != a.numel())
+      throw std::runtime_error(
+          "Reshape: target shape has " + std::to_string(wn) +
+          " elements, tensor has " + std::to_string(a.numel()));
+    if (concrete) {  // mismatches threw above; dynamic markers fall through
       // plain copy into the (possibly arena-bound) output — threaded
       // memcpy instead of a per-run owning deep copy
       Tensor o;
@@ -4055,12 +4231,29 @@ void Predictor::run_node(const Node& n) {
     if (perm.empty())  // ONNX default: reverse the axes
       for (size_t d = a.dims.size(); d-- > 0;)
         perm.push_back(int64_t(d));
+    // hostile perms: wrong length or out-of-range axes index past
+    // both dims vectors (fuzzing audit alongside the Reshape finding;
+    // repro: csrc/fuzz/corpus/onnx/crash-transpose-bad-perm.bin)
+    if (perm.size() != a.dims.size())
+      throw std::runtime_error("Transpose: perm length != rank");
+    for (auto p : perm)
+      if (p < 0 || p >= int64_t(a.dims.size()))
+        throw std::runtime_error("Transpose: perm axis out of range");
     Tensor o;
     o.dtype = a.dtype;
     o.dims.resize(a.dims.size());
     for (size_t k = 0; k < perm.size(); ++k)
       o.dims[k] = a.dims[size_t(perm[k])];
     o.alloc();
+    // empty output: done — the row-partition below iterates over the
+    // product of LEADING dims, which a hostile zero-element shape can
+    // still drive to 2^50+ empty iterations (load-time CPU DoS;
+    // fuzzing finding, ISSUE 11; repro:
+    // csrc/fuzz/corpus/onnx/crash-transpose-empty-spin.bin)
+    if (o.numel() == 0) {
+      out(std::move(o));
+      return;
+    }
     // odometer walk: src index updated incrementally per output
     // element (every attention matmul lowers through Transpose — the
     // old per-element div/mod chain dominated transformer serving);
@@ -4114,12 +4307,19 @@ void Predictor::run_node(const Node& n) {
     int64_t rank = int64_t(in(n, 0).dims.size());
     int64_t axis = attr_i(n, "axis", 0);
     if (axis < 0) axis += rank;
+    // hostile-artifact guards (fuzzing audit with the ArgMax axis
+    // finding, ISSUE 11): axis in range, every operand of equal rank
+    if (axis < 0 || axis >= rank)
+      throw std::runtime_error("Concat: axis out of range");
     Tensor o;
     o.dtype = in(n, 0).dtype;
     o.dims = in(n, 0).dims;
     int64_t total = 0;
-    for (size_t k = 0; k < n.inputs.size(); ++k)
+    for (size_t k = 0; k < n.inputs.size(); ++k) {
+      if (int64_t(in(n, k).dims.size()) != rank)
+        throw std::runtime_error("Concat: operand ranks differ");
       total += in(n, k).dims[size_t(axis)];
+    }
     o.dims[size_t(axis)] = total;
     o.alloc();
     /* Same-dtype inputs (the KV-decode cache append, every exporter
@@ -4258,6 +4458,8 @@ void Predictor::run_node(const Node& n) {
     const Tensor &a = in(n, 0), &idx = in(n, 1);
     int64_t axis = attr_i(n, "axis", 0);
     if (axis < 0) axis += int64_t(a.dims.size());
+    if (axis < 0 || axis >= int64_t(a.dims.size()))
+      throw std::runtime_error("Gather: axis out of range");
     Tensor o;
     o.dtype = a.dtype;
     for (int64_t d = 0; d < axis; ++d) o.dims.push_back(a.dims[size_t(d)]);
@@ -4305,6 +4507,11 @@ void Predictor::run_node(const Node& n) {
     const Tensor* fb = fused ? &in(n, 2) : nullptr;
     const int act = fused ? int(attr_i(n, "ptpu_act", ACT_NONE)) : ACT_NONE;
     const size_t ra = a.dims.size(), rb = b.dims.size();
+    // rank guard: a hostile artifact can feed MatMul a SCALAR operand
+    // — dims.back() on an empty vector is UB (fuzzing finding, ISSUE
+    // 11; repro: csrc/fuzz/corpus/onnx/crash-matmul-scalar.bin)
+    if (ra == 0 || rb == 0)
+      throw std::runtime_error("MatMul: operands must have rank >= 1");
     const bool batched_b = rb > 2;
     int64_t k_d = a.dims.back();
     int64_t m = ra >= 2 ? a.dims[ra - 2] : 1;
@@ -4328,8 +4535,21 @@ void Predictor::run_node(const Node& n) {
       o.dims.assign(a.dims.begin(), a.dims.end() - 1);
       o.dims.push_back(nn);
     } else {
+      // inner-dim agreement holds for rank-1/2 B too — without it the
+      // kernels index B past its storage (fuzzing finding, ISSUE 11;
+      // repro: csrc/fuzz/corpus/onnx/crash-matmul-inner-dim.bin)
+      if (b.dims[0] != k_d)
+        throw std::runtime_error("MatMul: inner dims differ");
       nn = rb == 2 ? b.dims[1] : 1;
-      batch = a.numel() / (k_d * m);
+      // the leading dims collapse into the GEMM's M — computed as a
+      // direct product, NOT numel()/(k_d*m): a zero k_d would zero
+      // the divisor and silently drop the batch, leaving o's elements
+      // unwritten (stale arena; code-review finding on the ISSUE 11
+      // zero-extent guards). In-order leading products are prefix
+      // products, which Tensor::numel() already bounds.
+      batch = 1;
+      if (ra >= 2)
+        for (size_t d = 0; d + 2 < ra; ++d) batch *= a.dims[d];
       o.dims.assign(a.dims.begin(), a.dims.end() - 1);
       if (rb == 2) o.dims.push_back(nn);
     }
@@ -4618,8 +4838,17 @@ void Predictor::run_node(const Node& n) {
       axes.assign(in(n, 1).i.begin(), in(n, 1).i.end());
     bool keep = attr_i(n, "keepdims", 1) != 0;
     std::vector<bool> red(a.dims.size(), axes.empty());
-    for (auto ax : axes)
-      red[size_t(ax < 0 ? ax + int64_t(a.dims.size()) : ax)] = true;
+    for (auto ax : axes) {
+      // axis bounds BEFORE the write: hostile axes scribble past the
+      // vector (fuzzing finding, ISSUE 11; repro:
+      // csrc/fuzz/corpus/onnx/crash-reduce-axis-oob.bin)
+      const int64_t ax2 = ax < 0 ? ax + int64_t(a.dims.size()) : ax;
+      if (ax2 < 0 || ax2 >= int64_t(a.dims.size()))
+        throw std::runtime_error("Reduce: axis " + std::to_string(ax) +
+                                 " out of range for rank " +
+                                 std::to_string(a.dims.size()));
+      red[size_t(ax2)] = true;
+    }
     Tensor o;
     o.dtype = a.dtype;
     for (size_t d = 0; d < a.dims.size(); ++d) {
@@ -4702,6 +4931,11 @@ void Predictor::run_node(const Node& n) {
     const Tensor& a = in(n, 0);
     int64_t axis = attr_i(n, "axis", 0);
     if (axis < 0) axis += int64_t(a.dims.size());
+    // hostile axis: out of range (or a scalar input) indexes past
+    // dims (fuzzing finding, ISSUE 11; repro:
+    // csrc/fuzz/corpus/onnx/crash-argmax-axis-oob.bin)
+    if (axis < 0 || axis >= int64_t(a.dims.size()))
+      throw std::runtime_error(op + ": axis out of range");
     bool keep = attr_i(n, "keepdims", 1) != 0;
     Tensor o;
     o.dtype = DT_I64;
@@ -4736,8 +4970,12 @@ void Predictor::run_node(const Node& n) {
     out(std::move(o));
   } else if (op == "CumSum") {
     const Tensor& a = in(n, 0);
+    if (in(n, 1).numel() < 1)
+      throw std::runtime_error("CumSum: missing axis input");
     int64_t axis = int64_t(in(n, 1).at(0));
     if (axis < 0) axis += int64_t(a.dims.size());
+    if (axis < 0 || axis >= int64_t(a.dims.size()))
+      throw std::runtime_error("CumSum: axis out of range");
     Tensor o = a;
     auto istr = strides_for(a.dims);
     int64_t ax_dim = a.dims[size_t(axis)];
@@ -4753,6 +4991,8 @@ void Predictor::run_node(const Node& n) {
     size_t rank = a.dims.size();
     Tensor o;
     o.dtype = a.dtype;
+    if (pads.i.size() < 2 * rank)
+      throw std::runtime_error("Pad: pads input needs 2*rank entries");
     for (size_t d = 0; d < rank; ++d)
       o.dims.push_back(a.dims[d] + pads.i[d] + pads.i[d + rank]);
     o.alloc();
@@ -4770,10 +5010,12 @@ void Predictor::run_node(const Node& n) {
     const Tensor& a = in(n, 0);
     int64_t axis = attr_i(n, "axis", -1);
     if (axis < 0) axis += int64_t(a.dims.size());
+    if (axis < 0 || axis >= int64_t(a.dims.size()))
+      throw std::runtime_error("Softmax: axis out of range");
     Tensor o = a;
     auto istr = strides_for(a.dims);
     int64_t ax_dim = a.dims[size_t(axis)];
-    int64_t outer = a.numel() / ax_dim;
+    int64_t outer = ax_dim > 0 ? a.numel() / ax_dim : 0;
     for (int64_t b = 0; b < outer; ++b) {
       // map outer index to base offset
       int64_t base = 0, rem = b;
@@ -5034,6 +5276,11 @@ void Predictor::run_node(const Node& n) {
     const float mdivA = attr_f(n, "ln_mdiv", 1.f);
     const float mdivB = attr_f(n, "ln_mdiv2", 1.f);
     const float vdiv = attr_f(n, "ln_vdiv", 1.f);
+    // same hostile-artifact rank/zero guards as MatMul: LayerNorm
+    // normally only appears via fusion, but the PARSER accepts it in
+    // an artifact directly
+    if (a.dims.empty() || a.dims.back() == 0)
+      throw std::runtime_error("LayerNorm: empty normalized axis");
     const int64_t D = a.dims.back();
     const int64_t rows = a.numel() / D;
     Tensor o;
@@ -5146,6 +5393,18 @@ static PTPU_Predictor* predictor_create_impl(const char* model_path,
     ss << f.rdbuf();
     std::unique_ptr<Predictor> p(new Predictor());
     p->g = parse_model(ss.str());
+    /* Structural validation before ANY pass touches the graph
+     * (fuzzing finding, ISSUE 11; repro:
+     * csrc/fuzz/corpus/onnx/crash-identity-no-operands.bin): every op
+     * in this dialect consumes at least one input and produces at
+     * least one output — the load-time rewrites (identity
+     * elimination, fusion matchers) index inputs[0]/outputs[0] on
+     * matched nodes, so a hostile arity is rejected here once
+     * instead of guarded at every matcher. */
+    for (const auto& vn : p->g.nodes)
+      if (vn.inputs.empty() || vn.outputs.empty())
+        throw std::runtime_error("node '" + vn.op +
+                                 "' has no inputs or no outputs");
     /* Bucket-ladder support (the serving micro-batcher): re-plan the
      * SAME artifact for a different leading (batch) dim — every
      * overridable graph input's axis 0 is rewritten before the

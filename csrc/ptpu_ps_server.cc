@@ -37,7 +37,6 @@
 #include <cstring>
 #include <map>
 #include <memory>
-#include <mutex>
 #include <string>
 #include <vector>
 
@@ -52,6 +51,11 @@ namespace {
 // ---------------------------------------------------------------------------
 // Frame constants — keep in sync with distributed/ps/wire.py.
 // ---------------------------------------------------------------------------
+
+// Registry lock of the data-plane server (rank table: README
+// "Correctness tooling"): nests OUTSIDE the per-table storage lock
+// (StatsJson renders table stats under it) and outside reply sends.
+PTPU_LOCK_CLASS(kLockPsRegistry, "ps.registry", 40);
 
 constexpr uint8_t kWireVersion = 1;
 // Traced frames (ISSUE 10): [ver=2][tag][u64 trace id] then the v1
@@ -115,7 +119,7 @@ struct ShardEntry {
 struct PsServer {
   std::string authkey;
   int port = 0;
-  std::mutex mu;  // guards tables
+  ptpu::Mutex mu{kLockPsRegistry};  // guards tables
   std::map<std::string, ShardEntry> tables;
   // per-table wire stats: pointers are handed to ShardEntry copies, so
   // entries are never erased (re-register reuses the slot)
@@ -226,7 +230,7 @@ struct PsServer {
         reinterpret_cast<const char *>(req + 3 + ext), tlen);
     ShardEntry entry;
     {
-      std::lock_guard<std::mutex> g(mu);
+      ptpu::MutexLock g(mu);
       auto it = tables.find(table);
       if (it == tables.end()) {
         if (!SendErr(conn, "unknown table '" + table +
@@ -282,7 +286,13 @@ struct PsServer {
       bool bad = false;
       ptpu_ps_table_rdlock(entry.table);
       for (uint32_t i = 0; i < cnt; ++i) {
-        const int64_t id = ptpu::GetI64(ids_b + 8 * i) - entry.lo;
+        // id arithmetic in uint64 space: a hostile id near INT64_MIN
+        // minus a shard offset must WRAP (defined) and fail the range
+        // check below — as signed math it is UB and aborts a
+        // fail-fast build on one frame (fuzzing finding, ISSUE 11;
+        // repro: corpus/wire_ps/crash-pull-id-underflow.bin)
+        const int64_t id = int64_t(
+            uint64_t(ptpu::GetI64(ids_b + 8 * i)) - uint64_t(entry.lo));
         if (id < 0 || id >= rows) {
           bad = true;
           break;
@@ -386,7 +396,10 @@ struct PsServer {
     thread_local std::vector<int64_t> local;
     if (local.size() < cnt) local.resize(cnt);
     for (uint32_t i = 0; i < cnt; ++i)
-      local[i] = ptpu::GetI64(ids_b + 8 * i) - entry.lo;
+      // unsigned wrap, not signed overflow — same hostile-id story as
+      // the pull path above (corpus/wire_ps/crash-push-id-underflow.bin)
+      local[i] = int64_t(uint64_t(ptpu::GetI64(ids_b + 8 * i)) -
+                         uint64_t(entry.lo));
     if (ptpu_ps_table_push_raw(entry.table, local.data(), cnt,
                                grads_b) != 0) {
       if (!SendErr(conn, ptpu_ps_last_error()))
@@ -430,7 +443,7 @@ std::string PsServer::StatsJson() {
   ptpu::AppendJsonHist(&out, "push_us", st.push_us);
   out += "},\"tables\":{";
   {
-    std::lock_guard<std::mutex> g(mu);
+    ptpu::MutexLock g(mu);
     bool first = true;
     for (const auto &kv : tables) {
       if (!first) out += ',';
@@ -523,7 +536,7 @@ PTPU_PS_EXPORT int ptpu_ps_server_register(void *h, const char *name,
     g_srv_error = "ptpu_ps_server_register: null handle or table";
     return -1;
   }
-  std::lock_guard<std::mutex> g(s->mu);
+  ptpu::MutexLock g(s->mu);
   auto &ws = s->table_stats[name];
   if (!ws) ws.reset(new TableWireStats());
   s->tables[name] = ShardEntry{table, lo, ws.get()};
@@ -571,7 +584,7 @@ PTPU_PS_EXPORT void ptpu_ps_server_stats_reset(void *h) {
   if (!s) return;
   s->stats.Reset();
   s->net.Reset();
-  std::lock_guard<std::mutex> g(s->mu);
+  ptpu::MutexLock g(s->mu);
   for (auto &kv : s->tables) {
     kv.second.wire->Reset();
     ptpu_ps_table_stats_reset(kv.second.table);

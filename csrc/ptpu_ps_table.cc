@@ -21,16 +21,21 @@
 #include <cmath>
 #include <cstdlib>
 #include <cstring>
-#include <mutex>
 #include <new>
-#include <shared_mutex>
 #include <string>
 #include <vector>
 
 #include "ptpu_arena.h"
 #include "ptpu_stats.h"
+#include "ptpu_sync.h"
 
 namespace {
+
+// Per-table storage lock (rank table: README "Correctness tooling"):
+// the LEAF of the PS plane — shared for pulls, exclusive for pushes,
+// held only around the row copy / optimizer update, never across a
+// send or another lock.
+PTPU_LOCK_CLASS(kLockPsTable, "ps.table", 50);
 
 thread_local std::string g_last_error;
 
@@ -53,7 +58,7 @@ struct PsTable {
   float *slot1 = nullptr;    // adam v                (rows * dim)
   int64_t *steps = nullptr;  // adam per-row step count (rows)
 
-  std::shared_mutex mu;
+  ptpu::SharedMutex mu{kLockPsTable};
 
   // storage-level counters (ptpu_stats.h): relaxed atomics, safe to
   // bump under either lock mode and to snapshot without any lock
@@ -265,7 +270,7 @@ PTPU_PS_EXPORT int ptpu_ps_table_pull(void *h, const int64_t *ids,
     return -1;
   }
   const int64_t dim = t->dim;
-  std::shared_lock<std::shared_mutex> lock(t->mu);
+  ptpu::SharedLock lock(t->mu);
   for (int64_t i = 0; i < n; ++i) {
     const int64_t id = ids[i];
     if (id < 0 || id >= t->rows) {
@@ -289,7 +294,7 @@ PTPU_PS_EXPORT int ptpu_ps_table_push_raw(void *h, const int64_t *ids,
     return -1;
   }
   if (n <= 0) return 0;
-  std::unique_lock<std::shared_mutex> lock(t->mu);
+  ptpu::SharedUniqueLock lock(t->mu);
   if (!coalesce(t, ids, n, static_cast<const unsigned char *>(grads)))
     return -1;
   apply_update(t);

@@ -19,14 +19,12 @@
 #include <algorithm>
 #include <atomic>
 #include <chrono>
-#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <map>
-#include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
@@ -60,6 +58,15 @@ PTPU_EXPORT const char *ptpu_last_error() { return g_last_error.c_str(); }
 // (csrc/ptpu_arena.h), the same machinery the native predictor's static
 // memory planner uses in offset space.
 // ---------------------------------------------------------------------------
+// Lock classes of the runtime .so (rank table: README "Correctness
+// tooling"): none of these ever nest with another — each is a leaf
+// guarding one structure, ranked distinctly so any future nesting has
+// a defined order.
+PTPU_LOCK_CLASS(kLockRtArena, "rt.arena", 80);
+PTPU_LOCK_CLASS(kLockRtQueue, "rt.queue", 82);
+PTPU_LOCK_CLASS(kLockRtProfiler, "rt.profiler", 84);
+PTPU_LOCK_CLASS(kLockRtStats, "rt.stats", 86);
+
 namespace {
 
 struct Chunk {
@@ -77,7 +84,7 @@ class BestFitArena {
   }
 
   void *Alloc(size_t n) {
-    std::lock_guard<std::mutex> g(mu_);
+    ptpu::MutexLock g(mu_);
     // zero-size requests round up to one alignment unit: n==0 would erase
     // a free block yet re-add the whole block at the same base, leaving
     // the address simultaneously free and allocated
@@ -97,7 +104,7 @@ class BestFitArena {
   }
 
   bool Free(void *p) {
-    std::lock_guard<std::mutex> g(mu_);
+    ptpu::MutexLock g(mu_);
     auto it = allocated_.find(p);
     if (it == allocated_.end()) return false;
     size_t n = it->second;
@@ -134,7 +141,7 @@ class BestFitArena {
     return true;
   }
 
-  std::mutex mu_;
+  ptpu::Mutex mu_{kLockRtArena};
   size_t chunk_size_, align_;
   size_t in_use_ = 0, peak_ = 0, reserved_ = 0;
   std::vector<Chunk> chunks_;
@@ -189,7 +196,7 @@ class BlockingQueue {
 
   // returns 0 ok, -1 closed, -2 timeout
   int Push(int64_t v, int timeout_ms) {
-    std::unique_lock<std::mutex> l(mu_);
+    ptpu::UniqueLock l(mu_);
     if (!WaitFor(l, timeout_ms, [&] { return closed_ || q_.size() < cap_; }))
       return -2;
     if (closed_) return -1;
@@ -199,7 +206,7 @@ class BlockingQueue {
   }
 
   int Pop(int64_t *out, int timeout_ms) {
-    std::unique_lock<std::mutex> l(mu_);
+    ptpu::UniqueLock l(mu_);
     if (!WaitFor(l, timeout_ms, [&] { return !q_.empty() || closed_; }))
       return -2;
     if (q_.empty()) return -1;  // closed and drained
@@ -210,19 +217,19 @@ class BlockingQueue {
   }
 
   void Close() {
-    std::lock_guard<std::mutex> g(mu_);
+    ptpu::MutexLock g(mu_);
     closed_ = true;
     cv_.notify_all();
   }
 
   size_t Size() {
-    std::lock_guard<std::mutex> g(mu_);
+    ptpu::MutexLock g(mu_);
     return q_.size();
   }
 
  private:
   template <class Pred>
-  bool WaitFor(std::unique_lock<std::mutex> &l, int timeout_ms, Pred pred) {
+  bool WaitFor(ptpu::UniqueLock &l, int timeout_ms, Pred pred) {
     if (timeout_ms < 0) {
       cv_.wait(l, pred);
       return true;
@@ -230,8 +237,8 @@ class BlockingQueue {
     return ptpu::CvWaitForUs(cv_, l, int64_t(timeout_ms) * 1000, pred);
   }
 
-  std::mutex mu_;
-  std::condition_variable cv_;
+  ptpu::Mutex mu_{kLockRtQueue};
+  ptpu::CondVar cv_;
   std::deque<int64_t> q_;
   size_t cap_;
   bool closed_ = false;
@@ -297,7 +304,7 @@ class Profiler {
     std::hash<std::thread::id> h;
     Event e{name, begin_us, end_us - begin_us,
             static_cast<uint64_t>(h(std::this_thread::get_id()) & 0xffff)};
-    std::lock_guard<std::mutex> g(mu_);
+    ptpu::MutexLock g(mu_);
     events_.push_back(std::move(e));
   }
 
@@ -325,7 +332,7 @@ class Profiler {
   }
 
   int Dump(const char *path) {
-    std::lock_guard<std::mutex> g(mu_);
+    ptpu::MutexLock g(mu_);
     FILE *f = std::fopen(path, "w");
     if (!f) {
       set_error(std::string("profiler: cannot open ") + path);
@@ -347,18 +354,18 @@ class Profiler {
   }
 
   void Clear() {
-    std::lock_guard<std::mutex> g(mu_);
+    ptpu::MutexLock g(mu_);
     events_.clear();
   }
 
   uint64_t Count() {
-    std::lock_guard<std::mutex> g(mu_);
+    ptpu::MutexLock g(mu_);
     return events_.size();
   }
 
  private:
   std::atomic<bool> enabled_{false};
-  std::mutex mu_;
+  ptpu::Mutex mu_{kLockRtProfiler};
   std::vector<Event> events_;
 };
 
@@ -387,21 +394,21 @@ PTPU_EXPORT uint64_t ptpu_profiler_count() { return Profiler::Get().Count(); }
 // Monitor — named int64 stats (platform/monitor.h STAT_ADD).
 // ---------------------------------------------------------------------------
 namespace {
-std::mutex g_stat_mu;
+ptpu::Mutex g_stat_mu{kLockRtStats};
 std::map<std::string, int64_t> g_stats;
 }  // namespace
 
 PTPU_EXPORT void ptpu_stat_add(const char *name, int64_t v) {
-  std::lock_guard<std::mutex> g(g_stat_mu);
+  ptpu::MutexLock g(g_stat_mu);
   g_stats[name] += v;
 }
 PTPU_EXPORT int64_t ptpu_stat_get(const char *name) {
-  std::lock_guard<std::mutex> g(g_stat_mu);
+  ptpu::MutexLock g(g_stat_mu);
   auto it = g_stats.find(name);
   return it == g_stats.end() ? 0 : it->second;
 }
 PTPU_EXPORT void ptpu_stat_reset(const char *name) {
-  std::lock_guard<std::mutex> g(g_stat_mu);
+  ptpu::MutexLock g(g_stat_mu);
   g_stats.erase(name);
 }
 

@@ -170,6 +170,19 @@ void test_gemm_bias_act_epilogue() {
     for (int64_t j = 0; j < N; ++j)
       assert(std::fabs(C[size_t(m * N + j)] -
                        (R[size_t(m * N + j)] + bm[size_t(m)])) <= 1e-5f);
+  // K == 0 is an EMPTY SUM: C must still be fully written (bias +
+  // act of 0), never left as stale memory — the arena planner skips
+  // zero-fill on the promise that every op writes its whole output
+  // (code-review finding on the ISSUE 11 zero-extent guards)
+  std::fill(C.begin(), C.end(), -123.f);
+  gemm_bias_act<float>(A.data(), B.data(), C.data(), M, N, 0, nullptr,
+                       nullptr, bias.data(), nullptr, ACT_RELU);
+  for (int64_t m = 0; m < M; ++m)
+    for (int64_t j = 0; j < N; ++j)
+      assert(C[size_t(m * N + j)] == std::max(0.f, bias[size_t(j)]));
+  std::vector<int32_t> Ci(size_t(M * N), -77);
+  gemm_compute_i16(nullptr, nullptr, Ci.data(), M, N, 0);
+  for (int32_t v : Ci) assert(v == 0);
 }
 
 /* WorkPool concurrency: two threads dispatching interleaved

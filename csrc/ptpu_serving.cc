@@ -55,7 +55,6 @@
 #include <algorithm>
 #include <atomic>
 #include <chrono>
-#include <condition_variable>
 #include <cstdint>
 #include <cstdlib>
 #include <cstdio>
@@ -64,7 +63,6 @@
 #include <functional>
 #include <map>
 #include <memory>
-#include <mutex>
 #include <set>
 #include <string>
 #include <thread>
@@ -78,6 +76,15 @@
 #include "ptpu_wire.h"
 
 namespace {
+
+// Lock classes of the serving runtime (rank table: README
+// "Correctness tooling"). kv is held across whole decode runs (the
+// predictor blocks on its WorkPool inside) -> kLockAllowBlock; the
+// registry lock nests inside it, and reply sends (net.conn_out, rank
+// 100) nest inside both.
+PTPU_LOCK_CLASS(kLockSvKv, "sv.kv", 10, ptpu::kLockAllowBlock);
+PTPU_LOCK_CLASS(kLockSvSess, "sv.sess", 20);
+PTPU_LOCK_CLASS(kLockSvBatcher, "sv.batcher", 30);
 
 constexpr uint8_t kSvWireVersion = 1;
 // Traced frames (ISSUE 10): [ver=2][tag][u64 trace id] then the v1
@@ -209,7 +216,7 @@ class SvBatcher {
   ~SvBatcher() { stop(); }
 
   bool enqueue(SvRequest&& r, std::string* why) {
-    std::unique_lock<std::mutex> l(mu_);
+    ptpu::UniqueLock l(mu_);
     if (stop_) {
       if (why) *why = "server stopping";
       return false;
@@ -239,26 +246,26 @@ class SvBatcher {
   // errors it out before closing connections
   std::deque<SvRequest> stop() {
     {
-      std::lock_guard<std::mutex> l(mu_);
+      ptpu::MutexLock l(mu_);
       stop_ = true;
     }
     cv_.notify_all();
     for (auto& t : workers_)
       if (t.joinable()) t.join();
     workers_.clear();
-    std::lock_guard<std::mutex> l(mu_);
+    ptpu::MutexLock l(mu_);
     rows_queued_ = 0;
     return std::move(q_);
   }
 
   int64_t queued_rows() const {
-    std::lock_guard<std::mutex> l(mu_);
+    ptpu::MutexLock l(mu_);
     return rows_queued_;
   }
 
  private:
   void worker(int instance) {
-    std::unique_lock<std::mutex> l(mu_);
+    ptpu::UniqueLock l(mu_);
     for (;;) {
       cv_.wait(l, [&] { return stop_ || !q_.empty(); });
       if (q_.empty()) {
@@ -295,6 +302,8 @@ class SvBatcher {
       stats_->batch_fill.Observe(uint64_t(rows));
       if (!q_.empty()) cv_.notify_one();  // more work for a sibling
       l.unlock();
+      // runners take predictor + net locks and must enter lock-free
+      PTPU_LOCKDEP_ASSERT_NO_LOCKS("the batcher runner");
       runner_(instance, batch);
       l.lock();
     }
@@ -303,8 +312,8 @@ class SvBatcher {
   const int64_t max_batch_, deadline_us_, max_queue_rows_;
   SvStats* stats_;
   Runner runner_;
-  mutable std::mutex mu_;
-  std::condition_variable cv_;
+  mutable ptpu::Mutex mu_{kLockSvBatcher};
+  ptpu::CondVar cv_;
   std::deque<SvRequest> q_;
   int64_t rows_queued_ = 0;
   bool stop_ = false;
@@ -383,8 +392,8 @@ struct SvServer {
     uint64_t last_us = 0;
     const void* owner = nullptr;   // opening conn (freed on conn close)
   };
-  std::mutex kv_mu_;
-  std::mutex sess_mu_;
+  ptpu::Mutex kv_mu_{kLockSvKv};
+  ptpu::Mutex sess_mu_{kLockSvSess};
   std::map<uint64_t, WireSession> sessions_;
   uint64_t next_session_ = 1;
   // the decode batcher keeps its own batcher-stats block so the INFER
@@ -898,8 +907,8 @@ struct SvServer {
   // ------------------------------------------------- decode plane
   bool DecodeOpen(const ptpu::net::ConnPtr& conn, uint64_t* sess,
                   std::string* why) {
-    std::lock_guard<std::mutex> kl(kv_mu_);
-    std::lock_guard<std::mutex> l(sess_mu_);
+    ptpu::MutexLock kl(kv_mu_);
+    ptpu::MutexLock l(sess_mu_);
     int slot = ptpu_predictor_kv_open(dec_pred);
     if (slot < 0) {
       // every KV slot busy: evict the least-recently-stepped live
@@ -951,8 +960,8 @@ struct SvServer {
   }
 
   bool DecodeClose(uint64_t sess, std::string* why) {
-    std::lock_guard<std::mutex> kl(kv_mu_);
-    std::lock_guard<std::mutex> l(sess_mu_);
+    ptpu::MutexLock kl(kv_mu_);
+    ptpu::MutexLock l(sess_mu_);
     auto it = sessions_.find(sess);
     if (it == sessions_.end()) {
       *why = "unknown decode session";
@@ -971,7 +980,7 @@ struct SvServer {
       // fast path for the common case — a closing connection that
       // never opened a decode session must not wait out a running
       // decode batch on kv_mu_ (that would stall its whole event loop)
-      std::lock_guard<std::mutex> l(sess_mu_);
+      ptpu::MutexLock l(sess_mu_);
       bool owns = false;
       for (const auto& kv : sessions_)
         if (kv.second.owner == conn) {
@@ -980,8 +989,8 @@ struct SvServer {
         }
       if (!owns) return;
     }
-    std::lock_guard<std::mutex> kl(kv_mu_);
-    std::lock_guard<std::mutex> l(sess_mu_);
+    ptpu::MutexLock kl(kv_mu_);
+    ptpu::MutexLock l(sess_mu_);
     for (auto it = sessions_.begin(); it != sessions_.end();) {
       if (it->second.owner == conn) {
         if (it->second.slot >= 0)
@@ -1065,9 +1074,9 @@ struct SvServer {
     char err[512] = {0};
     std::vector<int64_t> sids, toks;
     std::vector<SvRequest*> live;
-    std::lock_guard<std::mutex> kl(kv_mu_);
+    ptpu::MutexLock kl(kv_mu_);
     {
-      std::lock_guard<std::mutex> l(sess_mu_);
+      ptpu::MutexLock l(sess_mu_);
       for (auto* r : run) {
         auto it = sessions_.find(r->session);
         if (it == sessions_.end() || it->second.slot < 0) {
@@ -1489,7 +1498,7 @@ struct SvServer {
       }
       uint64_t live = 0;
       {
-        std::lock_guard<std::mutex> l(sess_mu_);
+        ptpu::MutexLock l(sess_mu_);
         for (const auto& kv : sessions_)
           if (kv.second.slot >= 0) ++live;
       }

@@ -18,6 +18,7 @@
 #include "ptpu_trace.cc"
 #include "ptpu_predictor.cc"
 #include "ptpu_serving.cc"
+#include "ptpu_onnx_writer.h"
 
 // asserts ARE the test — never compile them out
 #undef NDEBUG
@@ -35,93 +36,13 @@ using ptpu::WriteExact;
 
 namespace {
 
-// ------------------------------------------------- tiny onnx writer
-void put_varint(std::string* s, uint64_t v) {
-  while (v >= 0x80) {
-    s->push_back(char(v | 0x80));
-    v >>= 7;
-  }
-  s->push_back(char(v));
-}
-void put_tag(std::string* s, int field, int wire) {
-  put_varint(s, uint64_t(field) << 3 | unsigned(wire));
-}
-void put_u64f(std::string* s, int field, uint64_t v) {
-  put_tag(s, field, 0);
-  put_varint(s, v);
-}
-void put_lenf(std::string* s, int field, const std::string& payload) {
-  put_tag(s, field, 2);
-  put_varint(s, payload.size());
-  s->append(payload);
-}
-
-std::string onnx_tensor_f32(const std::string& name,
-                            const std::vector<int64_t>& dims,
-                            const float* data, size_t n) {
-  std::string t;
-  for (int64_t d : dims) put_u64f(&t, 1, uint64_t(d));
-  put_u64f(&t, 2, 1);  // data_type f32
-  put_lenf(&t, 8, name);
-  put_lenf(&t, 9,
-           std::string(reinterpret_cast<const char*>(data), n * 4));
-  return t;
-}
-
-std::string onnx_value_info(const std::string& name, int elem,
-                            const std::vector<int64_t>& dims) {
-  std::string shape;
-  for (int64_t d : dims) {
-    std::string dim;
-    put_u64f(&dim, 1, uint64_t(d));
-    put_lenf(&shape, 1, dim);
-  }
-  std::string tt;
-  put_u64f(&tt, 1, uint64_t(elem));
-  put_lenf(&tt, 2, shape);
-  std::string ty;
-  put_lenf(&ty, 1, tt);
-  std::string vi;
-  put_lenf(&vi, 1, name);
-  put_lenf(&vi, 2, ty);
-  return vi;
-}
-
-std::string onnx_node(const std::string& op,
-                      const std::vector<std::string>& ins,
-                      const std::vector<std::string>& outs) {
-  std::string n;
-  for (const auto& i : ins) put_lenf(&n, 1, i);
-  for (const auto& o : outs) put_lenf(&n, 2, o);
-  put_lenf(&n, 4, op);
-  return n;
-}
-
-std::string onnx_tensor_i64(const std::string& name,
-                            const std::vector<int64_t>& dims,
-                            const std::vector<int64_t>& data) {
-  std::string t;
-  for (int64_t d : dims) put_u64f(&t, 1, uint64_t(d));
-  put_u64f(&t, 2, 7);  // data_type i64
-  put_lenf(&t, 8, name);
-  put_lenf(&t, 9,
-           std::string(reinterpret_cast<const char*>(data.data()),
-                       data.size() * 8));
-  return t;
-}
-
-// node with one integer attribute (Cast's `to`)
-std::string onnx_node_iattr(const std::string& op,
-                            const std::vector<std::string>& ins,
-                            const std::vector<std::string>& outs,
-                            const std::string& aname, int64_t aval) {
-  std::string n = onnx_node(op, ins, outs);
-  std::string a;
-  put_lenf(&a, 1, aname);
-  put_u64f(&a, 3, uint64_t(aval));
-  put_lenf(&n, 5, a);
-  return n;
-}
+// tiny onnx writer: shared test/fuzz header (ptpu_onnx_writer.h)
+using ptpu::onnxw::onnx_node;
+using ptpu::onnxw::onnx_node_iattr;
+using ptpu::onnxw::onnx_tensor_f32;
+using ptpu::onnxw::onnx_tensor_i64;
+using ptpu::onnxw::onnx_value_info;
+using ptpu::onnxw::put_lenf;
 
 /* Hand-rolled KV-decode artifact obeying the kv_plan convention
  * (B=2 rows, P=4 cache positions, H=D=1, one layer, one logit):
@@ -246,18 +167,22 @@ SvRequest make_req(uint64_t id, int64_t rows) {
   return r;
 }
 
+// Test-fixture lock class (runner-side records): acquired with no
+// other lock held, before any reply path locks.
+PTPU_LOCK_CLASS(kLockTestFixture, "test.fixture", 2);
+
 void test_batcher_deadline_flush() {
   SvStats st;
-  std::mutex mu;
+  ptpu::Mutex mu{kLockTestFixture};
   std::vector<std::vector<uint64_t>> flushed;
   SvBatcher b(8, 30000 /*30ms*/, 1, &st,
               [&](int, std::vector<SvRequest>& batch) {
-                std::lock_guard<std::mutex> g(mu);
+                ptpu::MutexLock g(mu);
                 flushed.emplace_back();
                 for (auto& r : batch) flushed.back().push_back(r.id);
               });
   const auto flushed_n = [&] {
-    std::lock_guard<std::mutex> g(mu);
+    ptpu::MutexLock g(mu);
     return flushed.size();
   };
   const int64_t t0 = ptpu::NowUs();
@@ -279,13 +204,13 @@ void test_batcher_deadline_flush() {
 
 void test_batcher_full_flush_and_partial_final() {
   SvStats st;
-  std::mutex mu;
+  ptpu::Mutex mu{kLockTestFixture};
   std::vector<int64_t> batch_rows;
   SvBatcher b(4, 200000 /*200ms*/, 1, &st,
               [&](int, std::vector<SvRequest>& batch) {
                 int64_t rows = 0;
                 for (auto& r : batch) rows += r.rows;
-                std::lock_guard<std::mutex> g(mu);
+                ptpu::MutexLock g(mu);
                 batch_rows.push_back(rows);
               });
   std::string why;
@@ -296,7 +221,7 @@ void test_batcher_full_flush_and_partial_final() {
   // wait on the runner's own record (stats publish BEFORE the runner
   // runs — spinning on them would race the batch_rows writes)
   const auto rows_seen = [&] {
-    std::lock_guard<std::mutex> g(mu);
+    ptpu::MutexLock g(mu);
     int64_t n = 0;
     for (int64_t r2 : batch_rows) n += r2;
     return n;
@@ -307,7 +232,7 @@ void test_batcher_full_flush_and_partial_final() {
   assert(st.batched_rows.Get() == 6);
   assert(st.batches.Get() == 2);
   {
-    std::lock_guard<std::mutex> g(mu);
+    ptpu::MutexLock g(mu);
     // first flush fills the batch (4), the PARTIAL final batch (2)
     // rides the deadline
     assert((batch_rows == std::vector<int64_t>{4, 2}));
@@ -319,10 +244,10 @@ void test_batcher_full_flush_and_partial_final() {
 
 void test_batcher_fifo_order_and_stats_exact() {
   SvStats st;
-  std::mutex mu;
+  ptpu::Mutex mu{kLockTestFixture};
   std::vector<uint64_t> order;
   SvBatcher b(4, 5000, 1, &st, [&](int, std::vector<SvRequest>& batch) {
-    std::lock_guard<std::mutex> g(mu);
+    ptpu::MutexLock g(mu);
     for (auto& r : batch) order.push_back(r.id);
   });
   std::string why;
@@ -336,7 +261,7 @@ void test_batcher_fifo_order_and_stats_exact() {
     }
   }
   const auto order_n = [&] {
-    std::lock_guard<std::mutex> g(mu);
+    ptpu::MutexLock g(mu);
     return order.size();
   };
   const int64_t t0 = ptpu::NowUs();
@@ -344,7 +269,7 @@ void test_batcher_fifo_order_and_stats_exact() {
     std::this_thread::sleep_for(std::chrono::milliseconds(1));
   assert(st.batched_requests.Get() == N);   // exact, no loss, no dups
   assert(st.batched_rows.Get() == N);
-  std::lock_guard<std::mutex> g(mu);
+  ptpu::MutexLock g(mu);
   assert(order.size() == N);
   for (uint64_t i = 0; i < N; ++i) assert(order[i] == i);  // FIFO
 }

@@ -1,27 +1,64 @@
-// Condition-variable timed-wait helpers shared by the native TUs
-// (csrc/ptpu_serving.cc batcher, csrc/ptpu_runtime.cc blocking queue).
+// ptpu_sync — the ONE synchronization layer of the native runtime.
 //
-// Why this exists: libstdc++ (>= 9) lowers steady-clock
-// condition_variable::wait_for / wait_until to pthread_cond_clockwait,
-// which the libtsan shipped with gcc-10 does NOT intercept. An
-// unintercepted wait means TSan never sees the mutex being released
-// and reacquired inside the wait, its lockset goes inconsistent, and
-// it then reports phantom "double lock of a mutex" plus data races on
-// perfectly lock-protected state (reproduced in isolation on this
-// toolchain; both sides of the reported races hold the same mutex).
+// Every mutex / shared-mutex / condition-variable in csrc lives behind
+// the wrappers in this header (tools/ptpu_check.py's `sync` checker
+// bans the raw std:: primitives everywhere else). Two reasons:
 //
-// Under TSan we therefore wait on the SYSTEM clock, which lowers to
-// the intercepted pthread_cond_timedwait. A wall-clock jump during the
-// wait can lengthen/shorten the timeout — harmless for a sanitizer
-// run, and every call site re-checks its predicate/deadline in a loop
-// anyway (the lint in tools/ptpu_check.py enforces that). Production
-// builds keep the steady clock.
+//  1. ptpu_lockdep (ISSUE 11): a ranked-mutex validator in the spirit
+//     of the kernel's lockdep. Every lock belongs to a named
+//     LockClass with an explicit RANK (the position in the global
+//     acquisition order, low acquired first — table in README
+//     "Correctness tooling"). Debug builds (-DPTPU_LOCKDEP, default
+//     for selftests/sancheck/`make fuzz` off, see csrc/Makefile)
+//     check, on EVERY acquisition:
+//       * rank order: acquiring a lock whose rank is <= the highest
+//         held rank is an inversion (same class twice = recursion);
+//       * the acquisition-order graph: each held->new class pair is
+//         an edge; an edge that closes a cycle is an ABBA deadlock
+//         that merely hasn't fired yet. Both the current acquisition
+//         stack and the first-recorded stack of the conflicting edge
+//         are printed;
+//       * held-across-blocking: waiting on a condition variable while
+//         holding any OTHER lock whose class is not kLockAllowBlock
+//         (event-loop-side locks must never be held across a sleep).
+//     A violation prints both stacks and abort()s (fail-fast, like
+//     the sanitizers). Shipping builds compile the wrappers to
+//     zero-cost pass-throughs: Mutex IS std::mutex plus nothing
+//     (tests/test_lockdep.py asserts no lockdep symbol reaches a
+//     shipping .so).
+//
+//  2. TSan-safe timed waits. libstdc++ (>= 9) lowers steady-clock
+//     condition_variable::wait_for / wait_until to
+//     pthread_cond_clockwait, which the libtsan shipped with gcc-10
+//     does NOT intercept. An unintercepted wait means TSan never sees
+//     the mutex being released and reacquired inside the wait, its
+//     lockset goes inconsistent, and it then reports phantom "double
+//     lock of a mutex" plus data races on perfectly lock-protected
+//     state (reproduced in isolation on this toolchain). Under TSan
+//     we therefore wait on the SYSTEM clock, which lowers to the
+//     intercepted pthread_cond_timedwait. A wall-clock jump during
+//     the wait can lengthen/shorten the timeout — harmless for a
+//     sanitizer run, and every call site re-checks its
+//     predicate/deadline in a loop anyway (the `locks` lint in
+//     tools/ptpu_check.py enforces that). Production builds keep the
+//     steady clock.
 #ifndef PTPU_SYNC_H_
 #define PTPU_SYNC_H_
 
 #include <chrono>
 #include <condition_variable>
+#include <cstdint>
 #include <mutex>
+#include <shared_mutex>
+
+#if defined(PTPU_LOCKDEP)
+#include <execinfo.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#endif
 
 #if defined(__SANITIZE_THREAD__)
 #define PTPU_TSAN_BUILD 1
@@ -33,32 +70,459 @@
 
 namespace ptpu {
 
+// LockClass flags.
+enum : unsigned {
+  // This class is DESIGNED to be held across a blocking wait (e.g.
+  // WorkPool's dispatch mutex serializes whole dispatches, waiting
+  // out cv_done_ while held is the point; the serving kv mutex is
+  // held across whole decode runs). Everything else reports when held
+  // across a CondVar wait.
+  kLockAllowBlock = 1u,
+};
+
+#if defined(PTPU_LOCKDEP)
+
+namespace lockdep {
+
+constexpr int kMaxClasses = 64;
+constexpr int kMaxHeld = 16;    // deepest legal nesting per thread
+constexpr int kStackDepth = 24; // frames captured per acquisition
+
+struct ClassInfo {
+  const char* name;
+  int rank;
+  unsigned flags;
+};
+
+struct Stack {
+  void* pc[kStackDepth];
+  int n = 0;
+  void Capture() { n = ::backtrace(pc, kStackDepth); }
+};
+
+struct Edge {         // first-seen evidence for class pair from->to
+  bool present = false;
+  Stack from_stack;   // where `from` was acquired (the holder)
+  Stack to_stack;     // where `to` was then acquired
+};
+
+struct HeldLock {
+  int cls = -1;
+  const void* addr = nullptr;
+  bool shared = false;
+  Stack stack;        // where this lock was acquired
+};
+
+struct State {
+  std::mutex mu;  // raw on purpose: the validator must not validate
+                  // itself (this header is the one exempt file)
+  ClassInfo classes[kMaxClasses] = {};
+  std::atomic<int> n_classes{0};
+  uint64_t adj[kMaxClasses] = {};       // adjacency bitset, a->b
+  Edge* edges = nullptr;                // kMaxClasses * kMaxClasses
+  std::atomic<uint64_t> violations{0};  // for tests; reports abort()
+
+  State() { edges = new Edge[kMaxClasses * kMaxClasses]; }
+};
+
+inline State& state() {
+  static State s;
+  return s;
+}
+
+struct ThreadHeld {
+  HeldLock h[kMaxHeld];
+  int n = 0;
+};
+
+inline ThreadHeld& held() {
+  thread_local ThreadHeld t;
+  return t;
+}
+
+inline int RegisterClass(const char* name, int rank, unsigned flags) {
+  State& s = state();
+  const int id = s.n_classes.fetch_add(1, std::memory_order_relaxed);
+  if (id >= kMaxClasses) {
+    std::fprintf(stderr,
+                 "ptpu_lockdep: more than %d lock classes (registering "
+                 "\"%s\") — raise kMaxClasses\n",
+                 kMaxClasses, name);
+    std::abort();
+  }
+  s.classes[id] = ClassInfo{name, rank, flags};
+  return id;
+}
+
+inline void PrintStack(const char* label, const Stack& st) {
+  std::fprintf(stderr, ">>> stack %s:\n", label);
+  if (st.n > 0) ::backtrace_symbols_fd(st.pc, st.n, 2);
+  std::fflush(stderr);
+}
+
+// One report == one abort (fail-fast like -fno-sanitize-recover);
+// PTPU_LOCKDEP_NOABORT=1 downgrades to count-and-continue so a test
+// can observe several reports in one process if it ever needs to.
+inline void ReportEnd() {
+  state().violations.fetch_add(1, std::memory_order_relaxed);
+  const char* e = std::getenv("PTPU_LOCKDEP_NOABORT");
+  if (e && e[0] == '1') return;
+  std::abort();
+}
+
+// DFS over the class-order graph: true when `to` can already reach
+// `from` (so adding from->to would close a cycle). Caller holds
+// state().mu.
+inline bool Reaches(const State& s, int src, int dst) {
+  uint64_t visited = 0, frontier = 1ull << src;
+  while (frontier) {
+    if (frontier & (1ull << dst)) return true;
+    visited |= frontier;
+    uint64_t next = 0;
+    for (int i = 0; i < kMaxClasses; ++i)
+      if (frontier & (1ull << i)) next |= s.adj[i];
+    frontier = next & ~visited;
+  }
+  return false;
+}
+
+// The acquisition hook: validate `cls` against every held lock, then
+// push the held record. `addr` is the lock object (for release
+// matching and same-instance diagnostics).
+inline void OnAcquire(int cls, const void* addr, bool shared) {
+  State& s = state();
+  ThreadHeld& th = held();
+  Stack cur;
+  cur.Capture();
+  if (th.n >= kMaxHeld) {
+    std::fprintf(stderr,
+                 "ptpu_lockdep: more than %d locks held by one thread "
+                 "(acquiring \"%s\")\n",
+                 kMaxHeld, s.classes[cls].name);
+    PrintStack("of the over-deep acquisition", cur);
+    ReportEnd();
+    return;
+  }
+  const ClassInfo& ci = s.classes[cls];
+  for (int i = 0; i < th.n; ++i) {
+    const HeldLock& hl = th.h[i];
+    const ClassInfo& hc = s.classes[hl.cls];
+    if (hl.cls == cls) {
+      std::fprintf(
+          stderr,
+          "== ptpu_lockdep: same-class recursion ==\n"
+          "acquiring lock class \"%s\" (rank %d) while already "
+          "holding %s instance of \"%s\"\n",
+          ci.name, ci.rank, hl.addr == addr ? "THE SAME" : "another",
+          hc.name);
+      PrintStack("of the current acquisition", cur);
+      PrintStack("of the already-held acquisition", hl.stack);
+      ReportEnd();
+      continue;
+    }
+    // ---- acquisition-order graph: edge hl.cls -> cls ----
+    bool cycle = false, rank_bad = ci.rank <= hc.rank;
+    Edge evid;  // opposite-direction evidence for the report
+    {
+      std::lock_guard<std::mutex> g(s.mu);
+      if (Reaches(s, cls, hl.cls)) {
+        cycle = true;
+        evid = s.edges[cls * kMaxClasses + hl.cls];
+      }
+      Edge& e = s.edges[hl.cls * kMaxClasses + cls];
+      if (!e.present) {
+        e.present = true;
+        e.from_stack = hl.stack;
+        e.to_stack = cur;
+        s.adj[hl.cls] |= 1ull << cls;
+      }
+    }
+    if (cycle) {
+      std::fprintf(
+          stderr,
+          "== ptpu_lockdep: lock-order cycle (ABBA deadlock) ==\n"
+          "acquiring \"%s\" (rank %d) while holding \"%s\" (rank %d): "
+          "the opposite order \"%s\" -> ... -> \"%s\" was recorded "
+          "earlier\n",
+          ci.name, ci.rank, hc.name, hc.rank, ci.name, hc.name);
+      PrintStack("of the current acquisition", cur);
+      PrintStack("of the held lock's acquisition", hl.stack);
+      if (evid.present) {
+        PrintStack("of the earlier direct edge: holder", evid.from_stack);
+        PrintStack("of the earlier direct edge: acquirer", evid.to_stack);
+      }
+      ReportEnd();
+    } else if (rank_bad) {
+      std::fprintf(
+          stderr,
+          "== ptpu_lockdep: rank-order violation ==\n"
+          "acquiring \"%s\" (rank %d) while holding \"%s\" (rank %d) "
+          "— ranks must strictly increase along any nesting "
+          "(declare the intended order in the PTPU_LOCK_CLASS table)\n",
+          ci.name, ci.rank, hc.name, hc.rank);
+      PrintStack("of the current acquisition", cur);
+      PrintStack("of the held lock's acquisition", hl.stack);
+      ReportEnd();
+    }
+  }
+  HeldLock& rec = th.h[th.n++];
+  rec.cls = cls;
+  rec.addr = addr;
+  rec.shared = shared;
+  rec.stack = cur;
+}
+
+inline void OnRelease(int cls, const void* addr) {
+  ThreadHeld& th = held();
+  for (int i = th.n - 1; i >= 0; --i) {
+    if (th.h[i].addr == addr && th.h[i].cls == cls) {
+      for (int k = i; k + 1 < th.n; ++k) th.h[k] = th.h[k + 1];
+      --th.n;
+      return;
+    }
+  }
+  std::fprintf(stderr,
+               "ptpu_lockdep: releasing \"%s\" that this thread does "
+               "not hold\n",
+               state().classes[cls].name);
+  Stack cur;
+  cur.Capture();
+  PrintStack("of the bogus release", cur);
+  ReportEnd();
+}
+
+// A blocking wait is about to sleep with `self` released by the wait:
+// every OTHER held lock must be kLockAllowBlock.
+inline void OnBlockingWait(const void* self) {
+  State& s = state();
+  ThreadHeld& th = held();
+  for (int i = 0; i < th.n; ++i) {
+    const HeldLock& hl = th.h[i];
+    if (hl.addr == self) continue;
+    const ClassInfo& hc = s.classes[hl.cls];
+    if (hc.flags & kLockAllowBlock) continue;
+    Stack cur;
+    cur.Capture();
+    std::fprintf(
+        stderr,
+        "== ptpu_lockdep: lock held across a blocking wait ==\n"
+        "waiting on a condition variable while holding \"%s\" "
+        "(rank %d), a class not marked kLockAllowBlock — every "
+        "waiter on that lock now sleeps too\n",
+        hc.name, hc.rank);
+    PrintStack("of the blocking wait", cur);
+    PrintStack("of the held lock's acquisition", hl.stack);
+    ReportEnd();
+  }
+}
+
+// Handler-boundary invariant (used by the net core before dispatching
+// a frame handler, and by the batcher before invoking a runner): the
+// calling thread must hold NO lockdep-tracked lock at all.
+inline void AssertNoLocksHeld(const char* what) {
+  ThreadHeld& th = held();
+  if (th.n == 0) return;
+  Stack cur;
+  cur.Capture();
+  std::fprintf(stderr,
+               "== ptpu_lockdep: locks held entering %s ==\n"
+               "\"%s\" (and %d other(s)) held at a boundary that "
+               "requires none\n",
+               what, state().classes[th.h[0].cls].name, th.n - 1);
+  PrintStack("of the boundary", cur);
+  PrintStack("of the held lock's acquisition", th.h[0].stack);
+  ReportEnd();
+}
+
+inline uint64_t ViolationCount() {
+  return state().violations.load(std::memory_order_relaxed);
+}
+
+}  // namespace lockdep
+
+// A named, ranked lock class (one per LOGICAL lock, shared by all its
+// instances — e.g. every connection's out-lock is one class).
+class LockClass {
+ public:
+  LockClass(const char* name, int rank, unsigned flags = 0)
+      : id_(lockdep::RegisterClass(name, rank, flags)) {}
+  int id() const { return id_; }
+
+ private:
+  int id_;
+};
+
+#define PTPU_LOCKDEP_ASSERT_NO_LOCKS(what) \
+  ::ptpu::lockdep::AssertNoLocksHeld(what)
+
+#else  // !PTPU_LOCKDEP ------------------------------------------------
+
+// Shipping pass-through: a LockClass carries nothing and the wrappers
+// below compile to the bare std:: primitive.
+class LockClass {
+ public:
+  constexpr LockClass(const char*, int, unsigned = 0) {}
+};
+
+#define PTPU_LOCKDEP_ASSERT_NO_LOCKS(what) ((void)0)
+
+#endif  // PTPU_LOCKDEP
+
+// Declare a lock class: PTPU_LOCK_CLASS(kFooClass, "subsys.foo", 40)
+// (+ optional ::ptpu::kLockAllowBlock). The `sync` checker in
+// tools/ptpu_check.py requires every class declaration to carry a
+// numeric rank and every ptpu::Mutex/SharedMutex to name its class.
+#define PTPU_LOCK_CLASS(var, name, ...) \
+  inline ::ptpu::LockClass var { name, __VA_ARGS__ }
+
+// ---------------------------------------------------------------------------
+// Mutex / SharedMutex / CondVar wrappers
+// ---------------------------------------------------------------------------
+
+class Mutex {
+ public:
+#if defined(PTPU_LOCKDEP)
+  explicit Mutex(LockClass& c) : cls_(&c) {}
+  void lock() {
+    m_.lock();
+    lockdep::OnAcquire(cls_->id(), this, /*shared=*/false);
+  }
+  bool try_lock() {
+    if (!m_.try_lock()) return false;
+    lockdep::OnAcquire(cls_->id(), this, /*shared=*/false);
+    return true;
+  }
+  void unlock() {
+    lockdep::OnRelease(cls_->id(), this);
+    m_.unlock();
+  }
+#else
+  explicit Mutex(LockClass&) {}
+  void lock() { m_.lock(); }
+  bool try_lock() { return m_.try_lock(); }
+  void unlock() { m_.unlock(); }
+#endif
+  std::mutex& native() { return m_; }
+
+ private:
+  friend class CondVar;
+#if defined(PTPU_LOCKDEP)
+  LockClass* cls_;
+#endif
+  std::mutex m_;
+};
+
+class SharedMutex {
+ public:
+#if defined(PTPU_LOCKDEP)
+  explicit SharedMutex(LockClass& c) : cls_(&c) {}
+  void lock() {
+    m_.lock();
+    lockdep::OnAcquire(cls_->id(), this, /*shared=*/false);
+  }
+  void unlock() {
+    lockdep::OnRelease(cls_->id(), this);
+    m_.unlock();
+  }
+  void lock_shared() {
+    m_.lock_shared();
+    lockdep::OnAcquire(cls_->id(), this, /*shared=*/true);
+  }
+  void unlock_shared() {
+    lockdep::OnRelease(cls_->id(), this);
+    m_.unlock_shared();
+  }
+#else
+  explicit SharedMutex(LockClass&) {}
+  void lock() { m_.lock(); }
+  void unlock() { m_.unlock(); }
+  void lock_shared() { m_.lock_shared(); }
+  void unlock_shared() { m_.unlock_shared(); }
+#endif
+
+ private:
+#if defined(PTPU_LOCKDEP)
+  LockClass* cls_;
+#endif
+  std::shared_mutex m_;
+};
+
+using MutexLock = std::lock_guard<Mutex>;
+using UniqueLock = std::unique_lock<Mutex>;
+using SharedLock = std::shared_lock<SharedMutex>;
+using SharedUniqueLock = std::unique_lock<SharedMutex>;
+
+class CondVar {
+ public:
+  void notify_one() { cv_.notify_one(); }
+  void notify_all() { cv_.notify_all(); }
+
+  // Untimed wait WITH predicate (the only public untimed form: a
+  // predicate-free wait returns on spurious wakeups unchecked — the
+  // `locks` lint bans it outside this header).
+  template <class Pred>
+  void wait(UniqueLock& l, Pred pred) {
+    while (!pred()) WaitImpl(l, -1);
+  }
+
+ private:
+  // Timed wait without predicate: callers MUST loop on their own
+  // predicate/deadline around this (spurious wakeups). Accessed via
+  // ptpu::CvWaitForUs below.
+  void WaitImpl(UniqueLock& l, int64_t usec) {
+    Mutex* m = l.mutex();
+#if defined(PTPU_LOCKDEP)
+    lockdep::OnBlockingWait(m);
+    // the wait releases and reacquires m: mirror that in the held
+    // set so the reacquisition re-validates order against anything
+    // still held
+    lockdep::OnRelease(m->cls_->id(), m);
+#endif
+    {
+      std::unique_lock<std::mutex> il(m->native(), std::adopt_lock);
+      if (usec < 0) {
+        cv_.wait(il);
+      } else {
+#if defined(PTPU_TSAN_BUILD)
+        cv_.wait_until(il, std::chrono::system_clock::now() +
+                               std::chrono::microseconds(usec));
+#else
+        cv_.wait_for(il, std::chrono::microseconds(usec));
+#endif
+      }
+      il.release();
+    }
+#if defined(PTPU_LOCKDEP)
+    lockdep::OnAcquire(m->cls_->id(), m, /*shared=*/false);
+#endif
+  }
+
+  friend void CvWaitForUs(CondVar&, UniqueLock&, int64_t);
+  std::condition_variable cv_;
+};
+
 // Timed wait without predicate: the caller MUST loop on its own
 // predicate/deadline around this (condvar waits wake spuriously).
-inline void CvWaitForUs(std::condition_variable &cv,
-                        std::unique_lock<std::mutex> &l, int64_t usec) {
-#if defined(PTPU_TSAN_BUILD)
-  cv.wait_until(l, std::chrono::system_clock::now() +
-                       std::chrono::microseconds(usec));
-#else
-  cv.wait_for(l, std::chrono::microseconds(usec));
-#endif
+inline void CvWaitForUs(CondVar& cv, UniqueLock& l, int64_t usec) {
+  cv.WaitImpl(l, usec);
 }
 
 // Timed wait with predicate; returns the predicate's final value
 // (false == timed out with the predicate still unsatisfied).
 template <class Pred>
-inline bool CvWaitForUs(std::condition_variable &cv,
-                        std::unique_lock<std::mutex> &l, int64_t usec,
+inline bool CvWaitForUs(CondVar& cv, UniqueLock& l, int64_t usec,
                         Pred pred) {
-#if defined(PTPU_TSAN_BUILD)
-  return cv.wait_until(l,
-                       std::chrono::system_clock::now() +
-                           std::chrono::microseconds(usec),
-                       pred);
-#else
-  return cv.wait_for(l, std::chrono::microseconds(usec), pred);
-#endif
+  const auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::microseconds(usec);
+  while (!pred()) {
+    const auto now = std::chrono::steady_clock::now();
+    if (now >= deadline) return pred();
+    CvWaitForUs(cv, l,
+                std::chrono::duration_cast<std::chrono::microseconds>(
+                    deadline - now)
+                    .count());
+  }
+  return true;
 }
 
 }  // namespace ptpu

@@ -252,8 +252,17 @@ std::string Recorder::TracezJson(size_t max_n) const {
 }
 
 Recorder& Global() {
-  static Recorder g(ConfigFromEnv());
-  return g;
+  /* Immortal on purpose (fuzzing finding, ISSUE 11): a function-local
+   * static is destroyed by __run_exit_handlers, but server/batcher
+   * threads may still be RECORDING at process exit whenever the
+   * embedding process exits without ptpu_serving_stop /
+   * ptpu_ps_server_stop (abrupt exit is a legal shutdown path) —
+   * ASan-caught heap-use-after-free in Record() against the
+   * destructed ring. The standard logger/recorder fix: heap-allocate
+   * once and never destroy; still reachable through this pointer, so
+   * LSan stays quiet. */
+  static Recorder* g = new Recorder(ConfigFromEnv());
+  return *g;
 }
 
 // ---------------------------------------------------------------------------

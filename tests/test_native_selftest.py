@@ -45,11 +45,13 @@ SAN_BINARIES = {
                    "ptpu_serving_selftest.san-asan-ubsan",
                    "ptpu_net_selftest.san-asan-ubsan",
                    "ptpu_trace_selftest.san-asan-ubsan",
+                   "ptpu_lockdep_selftest.san-asan-ubsan",
                    "ptpu_predictor_demo.san-asan-ubsan"],
     "tsan": ["ptpu_selftest.san-tsan", "ptpu_ps_selftest.san-tsan",
              "ptpu_serving_selftest.san-tsan",
              "ptpu_net_selftest.san-tsan",
              "ptpu_trace_selftest.san-tsan",
+             "ptpu_lockdep_selftest.san-tsan",
              "ptpu_predictor_demo.san-tsan"],
 }
 
@@ -117,6 +119,7 @@ def test_native_selftest_passes():
     assert "all native ps-table unit tests passed" in r.stdout
     assert "all native serving unit tests passed" in r.stdout
     assert "ptpu_trace_selftest" in r.stdout
+    assert "all native lockdep unit tests passed" in r.stdout
 
 
 def test_sancheck_asan_ubsan_green():

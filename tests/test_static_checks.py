@@ -92,7 +92,7 @@ class TestLiveTree:
         assert r.returncode == 0
         names = set(r.stdout.split())
         assert names == {"abi", "wire", "stats", "locks", "net",
-                         "nullcheck", "trace"}
+                         "nullcheck", "trace", "sync", "fuzz"}
 
 
 class TestAbiChecker:
@@ -396,6 +396,137 @@ class TestTraceChecker:
                 "wire_tid = ptpu::GetU64(req + 3);")
         msgs = [f.message for f in _run(root, "trace")]
         assert any("GetU64(req + 2)" in m for m in msgs)
+
+
+class TestSyncChecker:
+    """ISSUE 11: raw mutex/condvar primitives banned outside
+    csrc/ptpu_sync.h; every lock class declared with a literal rank;
+    every wrapper construction names a declared class."""
+
+    def test_clean_on_live_csrc(self):
+        assert ptpu_check.check_sync(REPO) == []
+
+    def test_catches_raw_std_mutex(self, tmp_path):
+        root = tmp_path / "tree"
+        (root / "csrc").mkdir(parents=True)
+        (root / "csrc" / "bad_sync.cc").write_text(
+            "#include <mutex>\n"
+            "std::mutex g_mu;\n"
+            "void f() { std::lock_guard<std::mutex> g(g_mu); }\n")
+        msgs = [f.message for f in _run(root, "sync")]
+        assert any("raw std::mutex" in m and "ptpu_sync.h" in m
+                   for m in msgs)
+
+    def test_catches_raw_condition_variable(self, tmp_path):
+        root = tmp_path / "tree"
+        (root / "csrc").mkdir(parents=True)
+        (root / "csrc" / "bad_cv.cc").write_text(
+            "std::condition_variable cv;\n")
+        msgs = [f.message for f in _run(root, "sync")]
+        assert any("std::condition_variable" in m for m in msgs)
+
+    def test_catches_class_without_numeric_rank(self, tmp_path):
+        root = tmp_path / "tree"
+        (root / "csrc").mkdir(parents=True)
+        (root / "csrc" / "bad_rank.cc").write_text(
+            'PTPU_LOCK_CLASS(kBad, "x.bad", kSomeRank);\n')
+        msgs = [f.message for f in _run(root, "sync")]
+        assert any("without a literal numeric rank" in m for m in msgs)
+
+    def test_catches_wrapper_with_undeclared_class(self, tmp_path):
+        root = tmp_path / "tree"
+        (root / "csrc").mkdir(parents=True)
+        (root / "csrc" / "bad_ctor.cc").write_text(
+            "ptpu::Mutex mu{kNowhereClass};\n")
+        msgs = [f.message for f in _run(root, "sync")]
+        assert any("kNowhereClass" in m and "not a PTPU_LOCK_CLASS" in m
+                   for m in msgs)
+
+    def test_catches_one_class_two_ranks(self, tmp_path):
+        root = tmp_path / "tree"
+        (root / "csrc").mkdir(parents=True)
+        (root / "csrc" / "dup.cc").write_text(
+            'PTPU_LOCK_CLASS(kA, "x.dup", 10);\n'
+            'PTPU_LOCK_CLASS(kB, "x.dup", 20);\n')
+        msgs = [f.message for f in _run(root, "sync")]
+        assert any("one class, one rank" in m for m in msgs)
+
+    def test_clean_wrapper_usage_passes(self, tmp_path):
+        root = tmp_path / "tree"
+        (root / "csrc").mkdir(parents=True)
+        (root / "csrc" / "ok_sync.cc").write_text(
+            'PTPU_LOCK_CLASS(kGood, "x.good", 10);\n'
+            "ptpu::Mutex mu{kGood};\n"
+            "void f() { ptpu::MutexLock g(mu); }\n")
+        assert _run(root, "sync") == []
+
+
+FUZZ_FILES = [
+    "csrc/Makefile", "csrc/ptpu_ps_server.cc", "csrc/ptpu_serving.cc",
+    "csrc/ptpu_net.cc", "csrc/ptpu_predictor.cc", "csrc/ptpu_trace.cc",
+    "csrc/fuzz/fuzz_wire_ps.cc", "csrc/fuzz/fuzz_wire_serving.cc",
+    "csrc/fuzz/fuzz_http.cc", "csrc/fuzz/fuzz_onnx.cc",
+    "csrc/fuzz/fuzz_json.cc", "csrc/fuzz/fuzz_frames.cc",
+]
+
+
+def _fuzz_fixture(tmp_path):
+    root = _fixture(tmp_path, FUZZ_FILES)
+    shutil.copytree(os.path.join(REPO, "csrc", "fuzz", "corpus"),
+                    root / "csrc" / "fuzz" / "corpus")
+    return root
+
+
+class TestFuzzChecker:
+    """ISSUE 11: every wire tag / HTTP route / ONNX op parsed in C must
+    map to a fuzz target with a checked-in corpus entry."""
+
+    def test_clean_fixture(self, tmp_path):
+        assert _run(_fuzz_fixture(tmp_path), "fuzz") == []
+
+    def test_catches_new_wire_tag_without_seed(self, tmp_path):
+        root = _fuzz_fixture(tmp_path)
+        _mutate(root, "csrc/ptpu_serving.cc",
+                "constexpr uint8_t kTagDecodeClose = 0x69;",
+                "constexpr uint8_t kTagDecodeClose = 0x69;\n"
+                "constexpr uint8_t kTagDecodeFork = 0x6a;")
+        msgs = [f.message for f in _run(root, "fuzz")]
+        assert any("kTagDecodeFork" in m and "no corpus frame" in m
+                   for m in msgs)
+
+    def test_catches_new_http_route_without_seed(self, tmp_path):
+        root = _fuzz_fixture(tmp_path)
+        _mutate(root, "csrc/ptpu_net.cc",
+                'path == "/healthz"',
+                'path == "/varz" || path == "/healthz"')
+        msgs = [f.message for f in _run(root, "fuzz")]
+        assert any("/varz" in m and "corpus/http" in m for m in msgs)
+
+    def test_catches_new_onnx_op_without_seed(self, tmp_path):
+        root = _fuzz_fixture(tmp_path)
+        _mutate(root, "csrc/ptpu_predictor.cc",
+                '{"Add", B_ADD},', '{"Addz", B_ADD},')
+        msgs = [f.message for f in _run(root, "fuzz")]
+        assert any("'Addz'" in m and "corpus/onnx" in m for m in msgs)
+
+    def test_catches_missing_corpus_dir(self, tmp_path):
+        root = _fuzz_fixture(tmp_path)
+        shutil.rmtree(root / "csrc" / "fuzz" / "corpus" / "json")
+        msgs = [f.message for f in _run(root, "fuzz")]
+        assert any("no checked-in corpus for 'json'" in m for m in msgs)
+
+    def test_catches_missing_harness(self, tmp_path):
+        root = _fuzz_fixture(tmp_path)
+        os.remove(root / "csrc" / "fuzz" / "fuzz_http.cc")
+        msgs = [f.message for f in _run(root, "fuzz")]
+        assert any("fuzz harness for 'http' missing" in m for m in msgs)
+
+    def test_catches_target_dropped_from_makefile(self, tmp_path):
+        root = _fuzz_fixture(tmp_path)
+        _mutate(root, "csrc/Makefile", "fuzz_json", "fuzz_jsonx")
+        msgs = [f.message for f in _run(root, "fuzz")]
+        assert any("fuzz_json not listed in FUZZ_TARGETS" in m
+                   for m in msgs)
 
 
 class TestFindingPlumbing:

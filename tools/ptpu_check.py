@@ -30,6 +30,15 @@ Go that the compiler cannot see across:
   nullcheck  every extern-C ABI entry taking an opaque handle guards
              NULL before dereferencing (ctypes/cgo can always hand one
              back after a failed create or a teardown race)
+  sync       every mutex/shared-mutex/condvar in csrc lives behind
+             the ptpu_sync.h wrappers (ptpu::Mutex / SharedMutex /
+             CondVar) and every lock class is declared with a literal
+             rank — raw primitives are invisible to ptpu_lockdep
+             (ISSUE 11)
+  fuzz       every untrusted-byte surface parsed in C maps to a fuzz
+             harness + checked-in corpus entry: wire tags (PS +
+             serving planes), HTTP telemetry routes, ONNX node ops
+             (csrc/fuzz, ISSUE 11)
   trace      request-tracing seam (ISSUE 10): the traced v2 frame
              extension (version byte, 8-byte trace-id insert, read and
              echo offsets) in csrc (ptpu_ps_server.cc, ptpu_serving.cc)
@@ -608,7 +617,9 @@ def check_stats(root: str) -> List[Finding]:
 
 # ptpu_sync.h IS the sanctioned wrapper around the raw timed waits (it
 # exists to reroute them under TSan), so the wait rules skip it.
-LOCK_EXEMPT_FILES = {"ptpu_sync.h"}
+# ptpu_lockdep_selftest.cc: the seeded-violation fixture suite — its
+# deliberately predicate-free waits ARE the fixtures
+LOCK_EXEMPT_FILES = {"ptpu_sync.h", "ptpu_lockdep_selftest.cc"}
 
 
 def _top_level_arg_count(clean: str, open_paren: int) -> int:
@@ -1008,6 +1019,226 @@ def check_trace(root: str) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# checker: sync
+# ---------------------------------------------------------------------------
+
+# ISSUE 11: every mutex/condvar in csrc lives behind the ptpu_sync.h
+# wrappers (ptpu::Mutex / SharedMutex / CondVar) so ptpu_lockdep sees
+# every acquisition — a raw std:: primitive is invisible to the rank
+# checks and the acquisition-order graph. ptpu_sync.h itself is the
+# one exempt file (it IS the wrapper).
+SYNC_EXEMPT_FILES = {"ptpu_sync.h"}
+SYNC_BANNED = [
+    "std::mutex", "std::shared_mutex", "std::recursive_mutex",
+    "std::timed_mutex", "std::condition_variable", "pthread_mutex_t",
+    "pthread_cond_t",
+]
+
+_LOCK_CLASS_DECL = re.compile(
+    r"PTPU_LOCK_CLASS\s*\(\s*(\w+)\s*,\s*\"([^\"]*)\"\s*,([^)]*)\)")
+_LOCK_WRAPPER_CTOR = re.compile(
+    r"\b(?:ptpu::)?(Mutex|SharedMutex)\b\s+(\w+)\s*[({]\s*(\w+)")
+
+
+def _csrc_sources(root: str):
+    """Yield (rel, fname) for every .cc/.h under csrc/, one level of
+    subdirectories included (csrc/fuzz harnesses are in scope)."""
+    csrc = os.path.join(root, "csrc")
+    for dirpath, _dirs, files in os.walk(csrc):
+        for fname in sorted(files):
+            if not (fname.endswith(".cc") or fname.endswith(".h")):
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, fname), root)
+            yield rel.replace(os.sep, "/"), fname
+
+
+def check_sync(root: str) -> List[Finding]:
+    f: List[Finding] = []
+    if not os.path.isdir(os.path.join(root, "csrc")):
+        f.append(Finding("sync", "csrc", 0, "csrc directory missing"))
+        return f
+    classes: Dict[str, Tuple[str, int, int]] = {}  # var -> (name, rank, line)
+    names_seen: Dict[str, Tuple[str, str]] = {}    # class name -> (rank str, rel)
+    sources = []
+    for rel, fname in _csrc_sources(root):
+        src = _read(root, rel)
+        if src is None:
+            continue
+        clean = strip_c_comments(src)
+        # class declarations carry their name in a string literal:
+        # parse them from a strings-kept strip
+        decls = strip_c_comments(src, keep_strings=True)
+        sources.append((rel, fname, clean))
+        for m in _LOCK_CLASS_DECL.finditer(decls):
+            var, cname, tail = m.group(1), m.group(2), m.group(3)
+            ln = _lineno(clean, m.start())
+            rank_m = re.match(r"\s*(\d+)\s*(?:,|$)", tail)
+            if rank_m is None:
+                f.append(Finding(
+                    "sync", rel, ln,
+                    f"lock class {var} (\"{cname}\") declared without "
+                    f"a literal numeric rank — every class carries its "
+                    f"place in the global acquisition order (README "
+                    f"rank table)"))
+                continue
+            rank = rank_m.group(1)
+            prev = names_seen.get(cname)
+            if prev is not None and prev[0] != rank:
+                f.append(Finding(
+                    "sync", rel, ln,
+                    f"lock class \"{cname}\" declared with rank {rank} "
+                    f"here but rank {prev[0]} in {prev[1]} — one class, "
+                    f"one rank"))
+            names_seen[cname] = (rank, rel)
+            classes[var] = (cname, int(rank), ln)
+    for rel, fname, clean in sources:
+        if fname in SYNC_EXEMPT_FILES:
+            continue
+        for tok in SYNC_BANNED:
+            for m in re.finditer(re.escape(tok) + r"\b", clean):
+                f.append(Finding(
+                    "sync", rel, _lineno(clean, m.start()),
+                    f"raw {tok} outside csrc/ptpu_sync.h — use the "
+                    f"ptpu::Mutex/SharedMutex/CondVar wrappers so "
+                    f"ptpu_lockdep sees the acquisition"))
+        for m in _LOCK_WRAPPER_CTOR.finditer(clean):
+            kind, var, cls = m.group(1), m.group(2), m.group(3)
+            if cls in classes:
+                continue
+            f.append(Finding(
+                "sync", rel, _lineno(clean, m.start()),
+                f"ptpu::{kind} {var} constructed from '{cls}', which "
+                f"is not a PTPU_LOCK_CLASS declaration visible in "
+                f"csrc — every lock names a ranked class"))
+    return f
+
+
+# ---------------------------------------------------------------------------
+# checker: fuzz
+# ---------------------------------------------------------------------------
+
+# ISSUE 11: every untrusted-byte surface parsed in C maps to a fuzz
+# harness with a checked-in corpus: each wire tag a server TU declares
+# must appear as the tag byte of a corpus frame, each HTTP telemetry
+# route must appear in the http corpus, and each ONNX op the predictor
+# dispatches must appear (as op_type bytes) in the onnx corpus — so a
+# new tag/route/op CANNOT land without seed coverage (regen via
+# csrc/fuzz/gen_seeds.py).
+FUZZ_TARGET_SOURCES = {
+    "wire_ps": "csrc/ptpu_ps_server.cc",
+    "wire_serving": "csrc/ptpu_serving.cc",
+    "http": "csrc/ptpu_net.cc",
+    "onnx": "csrc/ptpu_predictor.cc",
+    "json": "csrc/ptpu_trace.cc",
+    "frames": "csrc/ptpu_net.cc",
+}
+
+
+def _onnx_ops_parsed(src: str) -> Set[str]:
+    """Op names csrc/ptpu_predictor.cc dispatches on (the extraction
+    csrc/fuzz/gen_seeds.py mirrors for the all-ops seed)."""
+    clean = strip_c_comments(src, keep_strings=True)
+    ops = set(re.findall(r'\bop == "([A-Z][A-Za-z0-9]*)"', clean))
+    ops |= set(re.findall(r'\.op == "([A-Z][A-Za-z0-9]*)"', clean))
+    ops |= set(re.findall(
+        r'\{"([A-Z][A-Za-z0-9]*)",\s*[BU]_[A-Z0-9_]+\}', clean))
+    return ops
+
+
+def _corpus_blobs(root: str, target: str) -> List[bytes]:
+    d = os.path.join(root, "csrc", "fuzz", "corpus", target)
+    blobs = []
+    if os.path.isdir(d):
+        for fname in sorted(os.listdir(d)):
+            p = os.path.join(d, fname)
+            if os.path.isfile(p):
+                with open(p, "rb") as fh:
+                    blobs.append(fh.read())
+    return blobs
+
+
+def check_fuzz(root: str) -> List[Finding]:
+    f: List[Finding] = []
+    fuzz_dir = os.path.join(root, "csrc", "fuzz")
+    if not os.path.isdir(fuzz_dir):
+        f.append(Finding("fuzz", "csrc/fuzz", 0,
+                         "csrc/fuzz directory missing"))
+        return f
+
+    # 1) each target has a harness, a Makefile build entry, and a
+    #    non-empty checked-in corpus
+    mk = _require(root, "csrc/Makefile", "fuzz", f) or ""
+    mk_targets = set(re.findall(r"\bfuzz_(\w+)\b",
+                                "".join(re.findall(
+                                    r"FUZZ_TARGETS\s*:=((?:[^\n]*\\\n)*[^\n]*)",
+                                    mk))))
+    for target in sorted(FUZZ_TARGET_SOURCES):
+        harness = f"csrc/fuzz/fuzz_{target}.cc"
+        if _read(root, harness) is None:
+            f.append(Finding("fuzz", harness, 0,
+                             f"fuzz harness for '{target}' missing"))
+        if target not in mk_targets:
+            f.append(Finding(
+                "fuzz", "csrc/Makefile", 0,
+                f"fuzz_{target} not listed in FUZZ_TARGETS — `make "
+                f"fuzz` would not build it"))
+        if not _corpus_blobs(root, target):
+            f.append(Finding(
+                "fuzz", f"csrc/fuzz/corpus/{target}", 0,
+                f"no checked-in corpus for '{target}' (run "
+                f"csrc/fuzz/gen_seeds.py)"))
+
+    # 2) every wire tag a server TU declares appears as the tag byte
+    #    of at least one corpus frame for its plane
+    for target, rel in (("wire_ps", "csrc/ptpu_ps_server.cc"),
+                        ("wire_serving", "csrc/ptpu_serving.cc")):
+        src = _require(root, rel, "fuzz", f)
+        if src is None:
+            continue
+        clean = strip_c_comments(src)
+        blobs = _corpus_blobs(root, target)
+        for m in re.finditer(
+                r"constexpr\s+uint8_t\s+(kTag\w+)\s*=\s*0x([0-9a-fA-F]+)\s*;",
+                clean):
+            name, val = m.group(1), int(m.group(2), 16)
+            covered = any(len(b) >= 2 and b[0] in (1, 2) and b[1] == val
+                          for b in blobs)
+            if not covered:
+                f.append(Finding(
+                    "fuzz", rel, _lineno(clean, m.start()),
+                    f"wire tag {name} (0x{val:02x}) has no corpus "
+                    f"frame in csrc/fuzz/corpus/{target} — add a seed "
+                    f"(gen_seeds.py) so the fuzzer starts from it"))
+
+    # 3) every HTTP telemetry route appears in the http corpus
+    net = _require(root, "csrc/ptpu_net.cc", "fuzz", f)
+    if net is not None:
+        clean = strip_c_comments(net, keep_strings=True)
+        routes = set(re.findall(r'path == "(/\w+)"', clean))
+        blobs = _corpus_blobs(root, "http")
+        for route in sorted(routes):
+            if not any(route.encode() in b for b in blobs):
+                f.append(Finding(
+                    "fuzz", "csrc/ptpu_net.cc", 0,
+                    f"HTTP route {route} has no request in "
+                    f"csrc/fuzz/corpus/http — add a seed "
+                    f"(gen_seeds.py)"))
+
+    # 4) every ONNX op the predictor parses appears in the onnx corpus
+    pred = _require(root, "csrc/ptpu_predictor.cc", "fuzz", f)
+    if pred is not None:
+        blobs = _corpus_blobs(root, "onnx")
+        for opname in sorted(_onnx_ops_parsed(pred)):
+            if not any(opname.encode() in b for b in blobs):
+                f.append(Finding(
+                    "fuzz", "csrc/ptpu_predictor.cc", 0,
+                    f"ONNX op '{opname}' is parsed but appears in no "
+                    f"csrc/fuzz/corpus/onnx seed — regen the all-ops "
+                    f"seed (gen_seeds.py)"))
+    return f
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -1019,6 +1250,8 @@ CHECKERS = {
     "net": check_net,
     "nullcheck": check_nullcheck,
     "trace": check_trace,
+    "sync": check_sync,
+    "fuzz": check_fuzz,
 }
 
 

@@ -3,11 +3,22 @@
 # against — reference: the upstream tools/ check scripts chained in CI).
 #
 #   build            the three shipping .so artifacts (-Werror on)
-#   sancheck         all five C selftests + the pure-C demo under
-#                    ASan+UBSan, fail-fast; TSan leg when libtsan exists
-#   ptpu_check       the 7 static checkers (ABI / wire / stats / locks /
-#                    net / nullcheck / trace) — 0 findings required
-#   selftest         the plain (uninstrumented) native selftests
+#   sancheck         all six C selftests + the pure-C demo under
+#                    ASan+UBSan, fail-fast; TSan leg when libtsan
+#                    exists — selftests run LOCKDEP-enabled (the
+#                    ranked-mutex validator, csrc/ptpu_sync.h) in
+#                    every leg
+#   ptpu_check       the 9 static checkers (ABI / wire / stats / locks
+#                    / net / nullcheck / trace / sync / fuzz) — 0
+#                    findings required
+#   selftest         the plain (lockdep-enabled, uninstrumented)
+#                    native selftests incl. the seeded ABBA fixture
+#   fuzz smoke       build every csrc/fuzz harness (ASan+UBSan +
+#                    trace-pc coverage), replay the checked-in corpus
+#                    (seeds + frozen crash regressions), then a
+#                    bounded coverage-guided run per target
+#                    (FUZZ_SMOKE_SECS, default 5s) — any finding
+#                    fails the gate
 #
 # Usage: tools/run_checks.sh [-j N]
 set -euo pipefail
@@ -45,7 +56,20 @@ fi
 step "ptpu_check: static analysis (abi / wire / stats / locks / net / nullcheck / trace)"
 python3 tools/ptpu_check.py
 
-step "native selftests (uninstrumented)"
+step "native selftests (uninstrumented, lockdep-enabled)"
 make -C csrc -j"$JOBS" selftest
+
+step "fuzz smoke: build harnesses (ASan+UBSan + coverage)"
+make -C csrc -j"$JOBS" fuzz
+
+FUZZ_SMOKE_SECS="${FUZZ_SMOKE_SECS:-5}"
+step "fuzz smoke: corpus replay + ${FUZZ_SMOKE_SECS}s run per target"
+for t in wire_ps wire_serving http onnx json frames; do
+  echo "-- fuzz_${t}: corpus replay"
+  (cd csrc/fuzz && "./fuzz_${t}.fuzz" "corpus/${t}")
+  echo "-- fuzz_${t}: ${FUZZ_SMOKE_SECS}s coverage-guided run"
+  (cd csrc/fuzz && "./fuzz_${t}.fuzz" "-fuzz=${FUZZ_SMOKE_SECS}" \
+      -seed=1 "-artifact=crash-${t}-" "corpus/${t}")
+done
 
 printf '\nrun_checks: ALL GREEN\n'
