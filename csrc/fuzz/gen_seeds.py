@@ -246,6 +246,30 @@ def main():
     w("wire_serving", "seed-decode-close.bin", sv_plain(0x69, 15, 1))
     w("wire_serving", "seed-decode-unknown-sess.bin",
       sv_plain(0x67, 16, 999999, 0))
+    # paged-engine ops (r12): OPEN2 prompt prefill + COW fork
+    def sv_open2(rid, toks, flags=0, ver=1, tid=None, trunc=None):
+        f = bytes([ver, 0x6a])
+        if tid is not None:
+            f += struct.pack("<Q", tid)
+        f += struct.pack("<QII", rid, len(toks), flags)
+        f += struct.pack(f"<{len(toks)}q", *toks)
+        return f if trunc is None else f[:trunc]
+    w("wire_serving", "seed-decode-open2.bin", sv_open2(21, (5, 6, 7)))
+    w("wire_serving", "seed-decode-open2-v2.bin",
+      sv_open2(22, (5, 6), ver=2, tid=8))
+    w("wire_serving", "seed-decode-open2-flags.bin",
+      sv_open2(23, (5,), flags=1))
+    w("wire_serving", "seed-decode-open2-trunc.bin",
+      sv_open2(24, (5, 6, 7, 8), trunc=22))
+    w("wire_serving", "seed-decode-open2-huge-n.bin",
+      bytes([1, 0x6a]) + struct.pack("<QII", 25, 0xFFFFFFFF, 0))
+    w("wire_serving", "seed-decode-fork.bin", sv_plain(0x6c, 26, 1))
+    w("wire_serving", "seed-decode-fork-v2.bin",
+      sv_plain(0x6c, 27, 999999, ver=2, tid=6))
+    # reply-direction tag as request: rejected
+    w("wire_serving", "seed-tag-decode-open-rep.bin",
+      bytes([1, 0x6b]) + struct.pack("<QQII", 1, 2, 0, 1) +
+      struct.pack("<f", 0.0))
     # reply-direction tags as requests: rejected
     w("wire_serving", "seed-tag-infer-rep.bin", sv_plain(0x61, 1))
     w("wire_serving", "seed-tag-infer-err.bin",

@@ -21,6 +21,7 @@ extern "C" {
 #endif
 
 typedef struct PTPU_Predictor PTPU_Predictor;
+typedef struct PTPU_KvPool PTPU_KvPool;
 
 /* Load a model file. Returns NULL on failure and writes a message into
  * err (truncated to err_len). */
@@ -110,6 +111,39 @@ int64_t ptpu_predictor_kv_len(PTPU_Predictor*, int sid);
 int ptpu_predictor_decode_step(PTPU_Predictor*, const int64_t* sids,
                                const int64_t* tokens, int n, char* err,
                                int err_len);
+
+/* ------------------------------------------------------------------ */
+/* Paged KV pool (r12). Instead of kv_plan's fixed per-session
+ * max-context slots, a shared pool of fixed-size page GROUPS
+ * (page_tokens positions x all layers x k+v) backs every session:
+ * RAM scales with tokens actually held, so thousands of short
+ * sessions fit where 64 fixed slots did. One pool is shared by every
+ * ladder-bucket predictor of a decode artifact (kv_attach validates
+ * the convention; the pool geometry is fixed by the first attach).
+ * After attach, ptpu_predictor_kv_open/close/len/sessions and
+ * decode_step delegate to the pool's session space. Arguments <= 0
+ * resolve from $PTPU_KV_POOL_TOKENS (0 = 64 x context at attach),
+ * $PTPU_KV_PAGE (16), $PTPU_KV_SESSIONS (4096); prefix_cache < 0
+ * reads $PTPU_KV_PREFIX (on). fork() clones a session sharing every
+ * group copy-on-write; adopt()/publish() drive the prefix/prompt
+ * cache (exact-match gated: hashes only index, token ids and parent
+ * links must agree). stats_json is valid until the next call. */
+PTPU_KvPool* ptpu_kvpool_create(int64_t pool_tokens, int page_tokens,
+                                int max_sessions, int prefix_cache,
+                                char* err, int err_len);
+void ptpu_kvpool_destroy(PTPU_KvPool*);
+int ptpu_predictor_kv_attach(PTPU_Predictor*, PTPU_KvPool*, char* err,
+                             int err_len);
+int ptpu_predictor_kv_direct(PTPU_Predictor*);
+int ptpu_kvpool_open(PTPU_KvPool*);
+int ptpu_kvpool_fork(PTPU_KvPool*, int sid);
+void ptpu_kvpool_close(PTPU_KvPool*, int sid);
+int64_t ptpu_kvpool_len(PTPU_KvPool*, int sid);
+int64_t ptpu_kvpool_adopt(PTPU_KvPool*, int sid, const int64_t* tokens,
+                          int64_t n);
+int ptpu_kvpool_publish(PTPU_KvPool*, int sid, const int64_t* tokens,
+                        int64_t n);
+const char* ptpu_kvpool_stats_json(PTPU_KvPool*);
 
 /* Serving stats since load (always-on): JSON {"runs","total_run_us",
  * "run_us":{count,sum,buckets[32] log2-us},"ops":{op:{calls,time_us,

@@ -33,6 +33,7 @@
 #include <string>
 #include <thread>
 #include <type_traits>
+#include <unordered_map>
 #include <vector>
 
 #include "ptpu_arena.h"
@@ -1772,6 +1773,479 @@ static void bcast_walk(const std::vector<int64_t>& odims,
   });
 }
 
+/* ------------------------------------------------------------------
+ * Paged KV pool (ISSUE 12 tentpole) — the generation-engine memory
+ * backend. The r9 decode engine allocated one fixed max-context slot
+ * per session (sessions x layers x 2 x P*H*D floats, zeroed at plan
+ * time), so RAM scaled with sessions x max-context no matter how many
+ * tokens a session actually held. This pool stores KV in fixed-size
+ * PAGE GROUPS of `page_tokens` positions spanning every layer and
+ * both k/v ([layer][k|v][token][H][D] within a group), handed out
+ * from one slab on demand: a session's block table maps logical page
+ * index -> group id, so RAM scales with tokens held and thousands of
+ * short sessions fit where 64 fixed slots did.
+ *
+ * On top of the pager:
+ *   - prefix/prompt caching: full PROMPT pages can be published into
+ *     a hash-indexed cache and adopted by later sessions with the
+ *     same prompt prefix (refcount++ — a system prompt shared by
+ *     thousands of sessions costs one copy). Adoption is EXACT, not
+ *     hash-trusting: the hash only indexes; a hit must match the
+ *     page's stored token ids AND its parent link ((gid, gen) of the
+ *     previous page group), so collisions can only miss, never serve
+ *     wrong KV.
+ *   - copy-on-write: fork() clones a session sharing every group
+ *     including the partial tail; the next append into a shared tail
+ *     group copies it first (cow_copies counter). Published groups
+ *     are always full pages and never written again, so they are
+ *     never COW'd.
+ *   - reclaim/backpressure: a freed group returns to the free list
+ *     when its refcount drops to zero; when the free list is empty,
+ *     allocation evicts the least-recently-used published group that
+ *     only the cache still references; if nothing is evictable the
+ *     caller sees "kv pool exhausted" (the serving layer answers a
+ *     soft per-row error — backpressure, not a crash).
+ *
+ * Pages are NOT zeroed on (re)allocation: a position is readable only
+ * after its append advanced the session length, and both read paths
+ * (the block-table-aware PtpuPagedAttention kernel and the gather
+ * fallback) touch positions < len only — the same every-byte-written
+ * invariant the planned arena relies on.
+ *
+ * Thread contract: registry ops (open/close/fork/adopt/publish/
+ * ensure_append/advance) serialize on mu_; reads during a predictor
+ * run (gather/row_ptr/the kernel's table view) are lock-free, so
+ * callers must not mutate a session concurrently with a decode step
+ * that touches it — the serving layer's sv.kv lock (rank 10, below
+ * kv.pool) already serializes the whole decode plane. */
+// rank 25: the serving layer acquires sv.kv (10) -> sv.sess (20)
+// before pool registry ops (open/close/adopt during eviction and
+// prefill bookkeeping), and pool ops never take batcher (30) or
+// WorkPool (60+) locks
+PTPU_LOCK_CLASS(kLockKvPool, "kv.pool", 25);
+
+class KvPool {
+ public:
+  KvPool(int64_t pool_tokens, int page_tokens, int max_sessions,
+         bool prefix_on)
+      : cfg_pool_tokens_(pool_tokens),
+        page_(page_tokens),
+        max_sessions_(max_sessions),
+        prefix_on_(prefix_on) {
+    if (page_ < 1) throw std::runtime_error("kvpool: page_tokens < 1");
+    if (max_sessions_ < 1)
+      throw std::runtime_error("kvpool: max_sessions < 1");
+  }
+
+  // geometry is fixed by the FIRST attached decode artifact; later
+  // attaches (other ladder buckets of the same artifact) must agree
+  void attach_geom(int64_t ctx, int64_t heads, int64_t hdim,
+                   int layers) {
+    ptpu::MutexLock l(mu_);
+    if (layers_ == 0) {
+      if (ctx < 1 || heads < 1 || hdim < 1 || layers < 1)
+        throw std::runtime_error("kvpool: degenerate geometry");
+      ctx_ = ctx;
+      heads_ = heads;
+      hdim_ = hdim;
+      layers_ = layers;
+      int64_t pt = cfg_pool_tokens_;
+      if (pt <= 0) pt = 64 * ctx_;  // the r9 default RAM envelope
+      npages_ = std::max<int64_t>(1, pt / page_);
+      group_elems_ = int64_t(layers_) * 2 * page_ * heads_ * hdim_;
+      if (group_elems_ > 0 &&
+          npages_ > int64_t((size_t(1) << 46) / size_t(group_elems_)))
+        throw std::runtime_error("kvpool: pool size overflows");
+      pool_.assign(size_t(npages_) * size_t(group_elems_), 0.f);
+      groups_.assign(size_t(npages_), Group{});
+      free_.clear();
+      for (int64_t gid = npages_; gid-- > 0;)
+        free_.push_back(int32_t(gid));
+      sess_.assign(size_t(max_sessions_), Sess{});
+    } else if (ctx != ctx_ || heads != heads_ || hdim != hdim_ ||
+               layers != layers_) {
+      throw std::runtime_error(
+          "kvpool: attached artifacts disagree on [P, H, D, layers]");
+    }
+  }
+
+  int64_t ctx() const { return ctx_; }
+  int64_t page_tokens() const { return page_; }
+  int max_sessions() const { return max_sessions_; }
+  int64_t max_groups() const { return (ctx_ + page_ - 1) / page_; }
+  int64_t group_elems() const { return group_elems_; }
+  const float* base() const { return pool_.data(); }
+
+  int open() {
+    ptpu::MutexLock l(mu_);
+    if (layers_ == 0) return -1;
+    for (int s = 0; s < int(sess_.size()); ++s)
+      if (!sess_[size_t(s)].open) {
+        sess_[size_t(s)].open = true;
+        sess_[size_t(s)].len = 0;
+        sess_[size_t(s)].table.clear();
+        ++opens_;
+        return s;
+      }
+    return -1;
+  }
+
+  /* Clone `src` into a fresh session sharing every group (refcount++)
+   * including the partial tail — beam search / parallel sampling from
+   * one prompt. The first append into the shared tail COWs it. */
+  int fork(int src) {
+    ptpu::MutexLock l(mu_);
+    // sess_ is sized by the first attach_geom: empty (and everything
+    // below out of bounds) until a predictor attaches
+    if (src < 0 || src >= int(sess_.size()) || !sess_[size_t(src)].open)
+      return -1;
+    for (int s = 0; s < int(sess_.size()); ++s)
+      if (!sess_[size_t(s)].open) {
+        sess_[size_t(s)].open = true;
+        sess_[size_t(s)].len = sess_[size_t(src)].len;
+        sess_[size_t(s)].table = sess_[size_t(src)].table;
+        for (int32_t gid : sess_[size_t(s)].table)
+          ++groups_[size_t(gid)].ref;
+        ++forks_;
+        return s;
+      }
+    return -1;
+  }
+
+  void close(int sid) {
+    ptpu::MutexLock l(mu_);
+    if (sid < 0 || sid >= int(sess_.size()) ||
+        !sess_[size_t(sid)].open)
+      return;
+    for (int32_t gid : sess_[size_t(sid)].table) unref(gid);
+    sess_[size_t(sid)].open = false;
+    sess_[size_t(sid)].len = 0;
+    sess_[size_t(sid)].table.clear();
+    ++closes_;
+  }
+
+  int64_t len(int sid) const {
+    ptpu::MutexLock l(mu_);
+    if (sid < 0 || sid >= int(sess_.size()) ||
+        !sess_[size_t(sid)].open)
+      return -1;
+    return sess_[size_t(sid)].len;
+  }
+
+  bool is_open(int sid) const {
+    ptpu::MutexLock l(mu_);
+    return sid >= 0 && sid < int(sess_.size()) &&
+           sess_[size_t(sid)].open;
+  }
+
+  /* Make position `len` writable for `sid`: allocate a fresh tail
+   * group at a page boundary, or COW a shared tail. Idempotent — a
+   * batch that failed part-way retries without double-allocating.
+   * Throws "kv pool exhausted" when no group can be found (counted). */
+  void ensure_append(int sid) {
+    ptpu::MutexLock l(mu_);
+    Sess& s = sess_at(sid);
+    if (s.len >= ctx_)
+      throw std::runtime_error("kvpool: session context is full");
+    const int64_t need = s.len / page_;
+    if (int64_t(s.table.size()) <= need) {
+      const int32_t gid = alloc_group();
+      s.table.push_back(gid);
+      return;
+    }
+    Group& tail = groups_[size_t(s.table[size_t(need)])];
+    if (tail.ref > 1) {
+      // shared partial tail (fork divergence): copy before writing
+      const int32_t ng = alloc_group();
+      std::memcpy(&pool_[size_t(ng) * size_t(group_elems_)],
+                  &pool_[size_t(s.table[size_t(need)]) *
+                         size_t(group_elems_)],
+                  size_t(group_elems_) * sizeof(float));
+      unref(s.table[size_t(need)]);
+      s.table[size_t(need)] = ng;
+      ++cow_copies_;
+    }
+  }
+
+  void advance(int sid) {
+    ptpu::MutexLock l(mu_);
+    Sess& s = sess_at(sid);
+    if (s.len >= int64_t(s.table.size()) * page_)
+      throw std::runtime_error("kvpool: advance past allocated pages");
+    ++s.len;
+  }
+
+  /* Write address of (sid, layer, k|v, pos) — pos must be covered by
+   * ensure_append. Lock-free by the thread contract above. */
+  float* row_ptr(int sid, int layer, int which, int64_t pos) {
+    const Sess& s = sess_[size_t(sid)];
+    const int32_t gid = s.table[size_t(pos / page_)];
+    return pool_.data() + size_t(gid) * size_t(group_elems_) +
+           size_t(((int64_t(layer) * 2 + which) * page_ + pos % page_) *
+                  heads_ * hdim_);
+  }
+
+  // gather a session's first `n` positions of (layer, which) into a
+  // contiguous [n, H, D] destination — the fallback read path for
+  // decode artifacts whose attention did not rewrite to the paged
+  // kernel (hand-rolled artifacts, PTPU_PREDICTOR_OPT=0 graphs)
+  void gather(int sid, int layer, int which, int64_t n, float* dst) {
+    const Sess& s = sess_[size_t(sid)];
+    const int64_t row = heads_ * hdim_;
+    for (int64_t p0 = 0; p0 < n; p0 += page_) {
+      const int64_t cnt = std::min(page_, n - p0);
+      const int32_t gid = s.table[size_t(p0 / page_)];
+      std::memcpy(
+          dst + p0 * row,
+          pool_.data() + size_t(gid) * size_t(group_elems_) +
+              size_t((int64_t(layer) * 2 + which) * page_ * row),
+          size_t(cnt * row) * sizeof(float));
+    }
+  }
+
+  // copy the session's block table into a caller-owned flat view for
+  // the paged attention kernel (called pre-run, under mu_)
+  int64_t view(int sid, int32_t* tab, int64_t cap) {
+    ptpu::MutexLock l(mu_);
+    const Sess& s = sess_at(sid);
+    const int64_t ng = int64_t(s.table.size());
+    if (ng > cap)
+      throw std::runtime_error("kvpool: view capacity too small");
+    if (ng > 0)
+      std::memcpy(tab, s.table.data(), size_t(ng) * sizeof(int32_t));
+    return s.len;
+  }
+
+  /* Prefix adoption: extend a page-aligned session with published
+   * groups matching `tokens` page by page. Caps at n-1 tokens — the
+   * final prompt token must be STEPPED so its logits exist. Returns
+   * tokens adopted this call. */
+  int64_t adopt(int sid, const int64_t* tokens, int64_t n) {
+    if (!prefix_on_) return 0;
+    ptpu::MutexLock l(mu_);
+    Sess& s = sess_at(sid);
+    int64_t adopted = 0;
+    if (s.len % page_ != 0) return 0;  // only page-aligned sessions
+    // rebuild the chain over the session's already-held prefix: the
+    // caller passes the WHOLE prompt every time, so hashes for pages
+    // [0, len/page) recompute from `tokens` directly
+    uint64_t h = kChainSeed;
+    for (int64_t k = 0; k < s.len / page_; ++k) {
+      if ((k + 1) * page_ > n) return 0;
+      h = page_hash(h, tokens + k * page_, page_);
+    }
+    for (int64_t k = s.len / page_; (k + 1) * page_ <= n - 1; ++k) {
+      h = page_hash(h, tokens + k * page_, page_);
+      auto it = prefix_.find(h);
+      if (it == prefix_.end()) break;
+      Group& g = groups_[size_t(it->second)];
+      // exact-match gate: page tokens AND parent linkage must agree
+      if (!g.published ||
+          !std::equal(g.toks.begin(), g.toks.end(), tokens + k * page_))
+        break;
+      if (k == 0) {
+        if (g.parent_gid != -1) break;
+      } else {
+        const int32_t prev = s.table[size_t(k - 1)];
+        if (g.parent_gid != prev ||
+            g.parent_gen != groups_[size_t(prev)].gen)
+          break;
+      }
+      ++g.ref;
+      g.lru = ++tick_;
+      s.table.push_back(it->second);
+      s.len += page_;
+      adopted += page_;
+      ++prefix_hits_;
+    }
+    prefix_hit_tokens_ += uint64_t(adopted);
+    return adopted;
+  }
+
+  /* Publish every full PROMPT page of `sid` (tokens [0, n)) into the
+   * prefix cache. Generated tokens are the caller's to exclude by
+   * passing only the prompt length. */
+  void publish(int sid, const int64_t* tokens, int64_t n) {
+    if (!prefix_on_) return;
+    ptpu::MutexLock l(mu_);
+    Sess& s = sess_at(sid);
+    uint64_t h = kChainSeed;
+    const int64_t pages = std::min(n / page_, s.len / page_);
+    for (int64_t k = 0; k < pages; ++k) {
+      h = page_hash(h, tokens + k * page_, page_);
+      const int32_t gid = s.table[size_t(k)];
+      Group& g = groups_[size_t(gid)];
+      if (g.published) continue;   // adopted or already shared
+      auto it = prefix_.find(h);
+      if (it != prefix_.end()) continue;  // another chain owns the slot
+      g.published = true;
+      g.hash = h;
+      g.toks.assign(tokens + k * page_, tokens + (k + 1) * page_);
+      if (k == 0) {
+        g.parent_gid = -1;
+        g.parent_gen = 0;
+      } else {
+        g.parent_gid = s.table[size_t(k - 1)];
+        g.parent_gen = groups_[size_t(g.parent_gid)].gen;
+      }
+      g.lru = ++tick_;
+      ++g.ref;  // the cache's own reference
+      prefix_[h] = gid;
+      ++published_;
+    }
+  }
+
+  std::string stats_json() {
+    ptpu::MutexLock l(mu_);
+    int64_t cached = 0, live_sess = 0;
+    for (const auto& g : groups_)
+      if (g.published && g.ref == 1) ++cached;
+    for (const auto& s : sess_)
+      if (s.open) ++live_sess;
+    std::string out = "{";
+    ptpu::AppendJsonU64(&out, "pages_total", uint64_t(npages_));
+    out += ",";
+    ptpu::AppendJsonU64(&out, "pages_in_use",
+                        uint64_t(npages_ - int64_t(free_.size())));
+    out += ",";
+    ptpu::AppendJsonU64(&out, "pages_cached", uint64_t(cached));
+    out += ",";
+    ptpu::AppendJsonU64(&out, "page_tokens", uint64_t(page_));
+    out += ",";
+    ptpu::AppendJsonU64(&out, "pool_tokens",
+                        uint64_t(npages_ * page_));
+    out += ",";
+    ptpu::AppendJsonU64(&out, "max_sessions", uint64_t(max_sessions_));
+    out += ",";
+    ptpu::AppendJsonU64(&out, "sessions_active", uint64_t(live_sess));
+    out += ",";
+    ptpu::AppendJsonU64(&out, "prefix_hits", prefix_hits_);
+    out += ",";
+    ptpu::AppendJsonU64(&out, "prefix_hit_tokens", prefix_hit_tokens_);
+    out += ",";
+    ptpu::AppendJsonU64(&out, "prefix_published", published_);
+    out += ",";
+    ptpu::AppendJsonU64(&out, "prefix_evictions", prefix_evictions_);
+    out += ",";
+    ptpu::AppendJsonU64(&out, "cow_copies", cow_copies_);
+    out += ",";
+    ptpu::AppendJsonU64(&out, "forks", forks_);
+    out += ",";
+    ptpu::AppendJsonU64(&out, "pool_exhausted", exhausted_);
+    out += "}";
+    return out;
+  }
+
+  // the C ABI hands out a pointer into this cached snapshot
+  std::string stats_json_;
+
+ private:
+  struct Group {
+    int32_t ref = 0;
+    uint64_t gen = 0;       // bumped per allocation: ABA guard for
+                            // parent links after reuse
+    bool published = false;
+    uint64_t hash = 0;
+    uint64_t lru = 0;
+    int32_t parent_gid = -1;
+    uint64_t parent_gen = 0;
+    std::vector<int64_t> toks;  // published pages keep their ids for
+                                // exact adoption matching
+  };
+  struct Sess {
+    bool open = false;
+    int64_t len = 0;
+    std::vector<int32_t> table;  // logical page index -> group id
+  };
+
+  static constexpr uint64_t kChainSeed = 0xcbf29ce484222325ull;
+  static uint64_t page_hash(uint64_t h, const int64_t* toks,
+                            int64_t n) {
+    for (int64_t i = 0; i < n; ++i) {
+      uint64_t v = uint64_t(toks[i]);
+      for (int b = 0; b < 8; ++b) {
+        h ^= (v >> (8 * b)) & 0xff;
+        h *= 0x100000001b3ull;
+      }
+    }
+    return h;
+  }
+
+  Sess& sess_at(int sid) {
+    if (sid < 0 || sid >= int(sess_.size()) || !sess_[size_t(sid)].open)
+      throw std::runtime_error("kvpool: session " +
+                               std::to_string(sid) + " is not open");
+    return sess_[size_t(sid)];
+  }
+
+  int32_t alloc_group() {
+    if (free_.empty()) evict_one_cached();
+    if (free_.empty()) {
+      ++exhausted_;
+      throw std::runtime_error(
+          "kv pool exhausted (pages_total=" + std::to_string(npages_) +
+          "; raise PTPU_KV_POOL_TOKENS or close sessions)");
+    }
+    const int32_t gid = free_.back();
+    free_.pop_back();
+    Group& g = groups_[size_t(gid)];
+    ++g.gen;
+    g.ref = 1;
+    g.published = false;
+    g.parent_gid = -1;
+    g.parent_gen = 0;
+    g.toks.clear();
+    return gid;
+  }
+
+  void unref(int32_t gid) {
+    Group& g = groups_[size_t(gid)];
+    if (--g.ref == 0) {
+      // published groups always hold the cache ref, so ref==0 means
+      // unpublished (or just unpublished by eviction)
+      free_.push_back(gid);
+    }
+  }
+
+  // LRU-evict one published group only the cache still references
+  void evict_one_cached() {
+    int32_t victim = -1;
+    uint64_t oldest = UINT64_MAX;
+    for (size_t gid = 0; gid < groups_.size(); ++gid) {
+      const Group& g = groups_[gid];
+      if (g.published && g.ref == 1 && g.lru < oldest) {
+        oldest = g.lru;
+        victim = int32_t(gid);
+      }
+    }
+    if (victim < 0) return;
+    Group& g = groups_[size_t(victim)];
+    prefix_.erase(g.hash);
+    g.published = false;
+    g.toks.clear();
+    ++prefix_evictions_;
+    unref(victim);
+  }
+
+  const int64_t cfg_pool_tokens_;
+  const int64_t page_;
+  const int max_sessions_;
+  const bool prefix_on_;
+  int64_t ctx_ = 0, heads_ = 0, hdim_ = 0;
+  int layers_ = 0;
+  int64_t npages_ = 0, group_elems_ = 0;
+  std::vector<float> pool_;
+  std::vector<Group> groups_;
+  std::vector<int32_t> free_;
+  std::vector<Sess> sess_;
+  std::unordered_map<uint64_t, int32_t> prefix_;
+  uint64_t tick_ = 0;
+  uint64_t opens_ = 0, closes_ = 0, forks_ = 0, cow_copies_ = 0;
+  uint64_t prefix_hits_ = 0, prefix_hit_tokens_ = 0, published_ = 0;
+  uint64_t prefix_evictions_ = 0, exhausted_ = 0;
+  mutable ptpu::Mutex mu_{kLockKvPool};
+};
+
 // ----------------------------------------------------------------- executor
 struct Predictor {
   Graph g;
@@ -1803,6 +2277,9 @@ struct Predictor {
   char* arena_base_ = nullptr;
   uint64_t arena_bytes_ = 0;
   bool planned_ = false;
+  // bucket-ladder batch override: export batch -> planned batch (0 =
+  // no override); the Reshape kernel repairs batch-baked targets
+  int64_t bo_from_ = 0, bo_to_ = 0;
   int fused_nodes_ = 0;
 
   /* Private execution context (nullptr = shared global pool). Owned
@@ -1869,6 +2346,186 @@ struct Predictor {
   std::vector<int64_t> kv_ids_stage_, kv_pos_stage_;
   bool kv_out_checked_ = false;
 
+  /* ---- paged decode mode (ISSUE 12) ----
+   * kv_attach() binds this predictor to a shared KvPool instead of
+   * the fixed per-session slab: sessions live in the pool (several
+   * ladder-bucket predictors of the same artifact share one pool and
+   * one session space). Two read paths:
+   *   direct  rewrite_paged_attention() replaced every
+   *           PtpuAttention(q, Concat(cache, new), ...) with a
+   *           PtpuPagedAttention that reads cache rows THROUGH the
+   *           block-table view — no gather copy, no concat copy, and
+   *           the dead cache inputs are never staged or bound;
+   *   gather  any artifact whose attention did not rewrite (hand-
+   *           rolled graphs, PTPU_PREDICTOR_OPT=0) stages pages into
+   *           the contiguous kv_stage_ buffers exactly like the
+   *           unpaged path — memory still scales with tokens held.
+   */
+  KvPool* kv_pool_ = nullptr;       // borrowed; owned by the C handle
+  bool kv_direct_ = false;
+  std::set<std::string> dead_inputs_;  // unconsumed after the rewrite
+  std::vector<int32_t> kv_view_tab_;   // [B x max_groups] block tables
+  std::vector<int64_t> kv_view_len_;   // per row; -1 = no live view
+  const float* kv_pool_base_ = nullptr;
+  int64_t kv_group_elems_ = 0, kv_page_tokens_ = 0, kv_max_groups_ = 0;
+
+  void kv_attach(KvPool* pool) {
+    if (kv_sessions_ > 0)
+      throw std::runtime_error(
+          "kv_attach: predictor already kv_plan()ed (fixed slots)");
+    if (kv_pool_)
+      throw std::runtime_error("kv_attach: pool already attached");
+    kv_validate();
+    pool->attach_geom(kv_ctx_, kv_heads_, kv_hdim_, kv_layers_);
+    kv_pool_ = pool;
+    const char* dz = std::getenv("PTPU_KV_DIRECT");
+    const bool want_direct = !(dz && std::strcmp(dz, "0") == 0);
+    if (want_direct && rewrite_paged_attention()) {
+      kv_direct_ = true;
+      compute_dead_inputs();
+      plan_memory();     // concat outputs left the lifetime walk
+      build_stats_index();
+    } else {
+      kv_stage_.assign(size_t(2 * kv_layers_),
+                       std::vector<float>(size_t(kv_batch_) *
+                                              size_t(kv_slot_elems()),
+                                          0.f));
+    }
+    kv_pool_base_ = pool->base();
+    kv_group_elems_ = pool->group_elems();
+    kv_page_tokens_ = pool->page_tokens();
+    kv_max_groups_ = pool->max_groups();
+    kv_view_tab_.assign(size_t(kv_batch_ * kv_max_groups_), 0);
+    kv_view_len_.assign(size_t(kv_batch_), -1);
+    kv_ids_stage_.assign(size_t(kv_batch_), 0);
+    kv_pos_stage_.assign(size_t(kv_batch_), 0);
+    kv_out_checked_ = false;
+  }
+
+  // inputs no surviving node consumes (the rewritten-away cache
+  // inputs): the planner and the planned-run input check skip them
+  void compute_dead_inputs() {
+    dead_inputs_.clear();
+    std::set<std::string> used(g.output_names.begin(),
+                               g.output_names.end());
+    for (const auto& n : g.nodes)
+      used.insert(n.inputs.begin(), n.inputs.end());
+    for (const auto& name : g.input_names)
+      if (!used.count(name)) dead_inputs_.insert(name);
+  }
+
+  void decode_step_paged(const int64_t* sids, const int64_t* tokens,
+                         int n) {
+    KvPool& pool = *kv_pool_;
+    if (n < 1 || int64_t(n) > kv_batch_)
+      throw std::runtime_error("decode_step: n outside [1, B=" +
+                               std::to_string(kv_batch_) + "]");
+    for (int r = 0; r < n; ++r) {
+      const int64_t s = sids[r];
+      if (s < 0 || s >= pool.max_sessions() || !pool.is_open(int(s)))
+        throw std::runtime_error("decode_step: session " +
+                                 std::to_string(s) + " is not open");
+      if (pool.len(int(s)) >= kv_ctx_)
+        throw std::runtime_error("decode_step: session " +
+                                 std::to_string(s) +
+                                 " context is full (P=" +
+                                 std::to_string(kv_ctx_) + ")");
+      for (int r2 = 0; r2 < r; ++r2)
+        if (sids[r2] == s)
+          throw std::runtime_error(
+              "decode_step: duplicate session " + std::to_string(s) +
+              " in one batch (steps of one session are ordered)");
+    }
+    /* Make every row's append position writable BEFORE any compute:
+     * allocation (and COW of shared tails) throws "kv pool exhausted"
+     * here, idempotently, so a partially-provisioned batch can retry
+     * row-by-row without double-allocating. */
+    for (int r = 0; r < n; ++r) pool.ensure_append(int(sids[r]));
+    const int64_t row_hd = kv_heads_ * kv_hdim_;
+    for (int64_t r = 0; r < kv_batch_; ++r) {
+      kv_ids_stage_[size_t(r)] = r < n ? tokens[r] : 0;
+      kv_pos_stage_[size_t(r)] =
+          r < n ? pool.len(int(sids[r])) : 0;
+    }
+    if (kv_direct_) {
+      for (int64_t r = 0; r < kv_batch_; ++r)
+        kv_view_len_[size_t(r)] =
+            r < n ? pool.view(int(sids[r]),
+                              &kv_view_tab_[size_t(r * kv_max_groups_)],
+                              kv_max_groups_)
+                  : 0;
+    } else {
+      const int64_t per = kv_slot_elems();
+      for (int l = 0; l < kv_layers_; ++l)
+        for (int w = 0; w < 2; ++w) {
+          float* stage = kv_stage_[size_t(2 * l + w)].data();
+          for (int64_t r = 0; r < kv_batch_; ++r) {
+            const int64_t len = r < n ? pool.len(int(sids[r])) : 0;
+            if (len > 0)
+              pool.gather(int(sids[r]), l, w, len, stage + r * per);
+            // same contract as the slab path: rows past len read ZERO
+            if (len < kv_ctx_)
+              std::memset(stage + r * per + len * row_hd, 0,
+                          size_t((kv_ctx_ - len) * row_hd) *
+                              sizeof(float));
+          }
+        }
+      for (int i = 2; i < int(g.input_names.size()); ++i) {
+        Tensor t;
+        t.dtype = DT_F32;
+        t.dims = {kv_batch_, kv_ctx_, kv_heads_, kv_hdim_};
+        t.f.bind(kv_stage_[size_t(i - 2)].data(),
+                 size_t(kv_batch_ * per));
+        env[g.input_names[size_t(i)]] = std::move(t);
+      }
+    }
+    {
+      Tensor t;
+      t.dtype = kv_ids_dtype_;
+      t.dims = {kv_batch_, 1};
+      t.i.bind(kv_ids_stage_.data(), size_t(kv_batch_));
+      env[g.input_names[0]] = std::move(t);
+    }
+    {
+      Tensor t;
+      t.dtype = kv_pos_dtype_;
+      t.dims = kv_pos_dims_;
+      t.i.bind(kv_pos_stage_.data(), size_t(kv_batch_));
+      env[g.input_names[1]] = std::move(t);
+    }
+    try {
+      run();
+    } catch (...) {
+      std::fill(kv_view_len_.begin(), kv_view_len_.end(), -1);
+      throw;
+    }
+    std::fill(kv_view_len_.begin(), kv_view_len_.end(), -1);
+    if (!kv_out_checked_) {
+      for (int l = 0; l < kv_layers_; ++l)
+        for (int w = 0; w < 2; ++w) {
+          const Tensor& t = outputs[size_t(1 + 2 * l + w)];
+          const std::vector<int64_t> want = {kv_batch_, 1, kv_heads_,
+                                             kv_hdim_};
+          if (!t.is_float() || t.dims != want)
+            throw std::runtime_error(
+                "decode_step: output " + std::to_string(1 + 2 * l + w) +
+                " is not a [B,1,H,D] f32 cache append");
+        }
+      kv_out_checked_ = true;
+    }
+    for (int l = 0; l < kv_layers_; ++l)
+      for (int w = 0; w < 2; ++w) {
+        const Tensor& t = outputs[size_t(1 + 2 * l + w)];
+        for (int r = 0; r < n; ++r) {
+          const int64_t len = pool.len(int(sids[r]));
+          std::memcpy(pool.row_ptr(int(sids[r]), l, w, len),
+                      t.f.data() + int64_t(r) * row_hd,
+                      size_t(row_hd) * sizeof(float));
+        }
+      }
+    for (int r = 0; r < n; ++r) pool.advance(int(sids[r]));
+  }
+
   int64_t kv_slot_elems() const { return kv_ctx_ * kv_heads_ * kv_hdim_; }
   float* kv_slot(int sid, int layer, int which /*0=k,1=v*/) {
     const int64_t per = kv_slot_elems();
@@ -1876,8 +2533,10 @@ struct Predictor {
            ((int64_t(sid) * kv_layers_ + layer) * 2 + which) * per;
   }
 
-  void kv_plan(int sessions) {
-    if (sessions < 1) throw std::runtime_error("kv_plan: sessions < 1");
+  // decode-artifact convention check shared by the fixed-slot plan
+  // (kv_plan) and the paged-pool attach (kv_attach): fills the kv_*
+  // geometry fields without allocating anything
+  void kv_validate() {
     const int nin = int(g.input_names.size());
     if (nin < 4 || (nin - 2) % 2)
       throw std::runtime_error(
@@ -1936,6 +2595,14 @@ struct Predictor {
       throw std::runtime_error(
           "kv_plan: decode artifact must have 1 + 2*layers outputs, got " +
           std::to_string(g.output_names.size()));
+  }
+
+  void kv_plan(int sessions) {
+    if (sessions < 1) throw std::runtime_error("kv_plan: sessions < 1");
+    if (kv_pool_)
+      throw std::runtime_error(
+          "kv_plan: predictor already attached to a paged pool");
+    kv_validate();
     kv_sessions_ = sessions;
     kv_sess_.assign(size_t(sessions), KvSession{});
     // the pre-planned cache block: zero-filled once; append-position
@@ -1981,8 +2648,10 @@ struct Predictor {
    * slot and advances len; logits stay readable via the normal output
    * accessors (row r of output 0). */
   void decode_step(const int64_t* sids, const int64_t* tokens, int n) {
+    if (kv_pool_) return decode_step_paged(sids, tokens, n);
     if (kv_sessions_ == 0)
-      throw std::runtime_error("decode_step: kv_plan() not called");
+      throw std::runtime_error(
+          "decode_step: kv_plan()/kv_attach() not called");
     if (n < 1 || int64_t(n) > kv_batch_)
       throw std::runtime_error("decode_step: n outside [1, B=" +
                                std::to_string(kv_batch_) + "]");
@@ -3007,6 +3676,93 @@ struct Predictor {
     apply_rewrite(dead, &placed);
   }
 
+  /* kv_attach-time rewrite for the paged direct read path: every
+   * layer's
+   *   PtpuAttention(q, Concat1(k_cache_in, new_k),
+   *                    Concat1(v_cache_in, new_v)[, mask, neg])
+   * where k_cache_in/v_cache_in are the layer's cache GRAPH INPUTS and
+   * new_k/new_v are its append GRAPH OUTPUTS (the decode convention
+   * kv_validate pinned), becomes
+   *   PtpuPagedAttention(q, new_k, new_v[, mask, neg])
+   * reading cache rows through the pool block table at run time. The
+   * two Concat nodes die and the cache inputs lose their last
+   * consumer — decode steps stop staging ANY cache bytes. All-or-
+   * nothing: applied only when every layer matches (a half-paged
+   * graph would read half its cache from unbound inputs). Returns
+   * whether the rewrite fired. */
+  bool rewrite_paged_attention() {
+    if (kv_layers_ < 1) return false;
+    FuseIdx ix = build_fuse_idx();
+    const auto concat1_of =
+        [&](const std::string& name) -> const Node* {
+      auto p = ix.producer.find(name);
+      if (p == ix.producer.end()) return nullptr;
+      const Node& c = g.nodes[p->second];
+      if (c.op != "Concat" || c.inputs.size() != 2 ||
+          attr_i(c, "axis", 0) != 1)
+        return nullptr;
+      auto u = ix.uses.find(name);
+      if (u == ix.uses.end() || u->second.size() != 1 ||
+          ix.outset.count(name))
+        return nullptr;
+      return &c;
+    };
+    std::vector<char> dead(g.nodes.size(), 0);
+    std::map<size_t, Node> placed;
+    std::set<int> matched;
+    for (size_t k = 0; k < g.nodes.size(); ++k) {
+      const Node& a = g.nodes[k];
+      if (a.op != "PtpuAttention" ||
+          (a.inputs.size() != 3 && a.inputs.size() != 5))
+        continue;
+      const Node* kc = concat1_of(a.inputs[1]);
+      const Node* vc = concat1_of(a.inputs[2]);
+      if (!kc || !vc || kc == vc) continue;
+      int layer = -1;
+      for (int l = 0; l < kv_layers_; ++l)
+        if (kc->inputs[0] == g.input_names[size_t(2 + 2 * l)] &&
+            kc->inputs[1] == g.output_names[size_t(1 + 2 * l)] &&
+            vc->inputs[0] == g.input_names[size_t(3 + 2 * l)] &&
+            vc->inputs[1] == g.output_names[size_t(2 + 2 * l)]) {
+          layer = l;
+          break;
+        }
+      if (layer < 0 || matched.count(layer)) continue;
+      // the cache inputs must have no OTHER consumer (they die here)
+      const auto sole_use = [&](const std::string& nm) {
+        auto u = ix.uses.find(nm);
+        return u != ix.uses.end() && u->second.size() == 1 &&
+               !ix.outset.count(nm);
+      };
+      if (!sole_use(kc->inputs[0]) || !sole_use(vc->inputs[0]))
+        continue;
+      Node f;
+      f.op = "PtpuPagedAttention";
+      f.inputs = {a.inputs[0], kc->inputs[1], vc->inputs[1]};
+      if (a.inputs.size() == 5) {
+        f.inputs.push_back(a.inputs[3]);
+        f.inputs.push_back(a.inputs[4]);
+      }
+      f.outputs = a.outputs;
+      f.attrs = a.attrs;
+      Attr al;
+      al.ival = layer;
+      f.attrs["ptpu_kv_layer"] = al;
+      Attr ask;
+      ask.ival = kv_ctx_ + 1;  // concat key space: P cache rows + 1 new
+      f.attrs["ptpu_sk"] = ask;
+      matched.insert(layer);
+      dead[ix.producer[a.inputs[1]]] = 1;
+      dead[ix.producer[a.inputs[2]]] = 1;
+      dead[k] = 1;
+      placed[k] = std::move(f);
+    }
+    if (int(matched.size()) != kv_layers_) return false;
+    fused_nodes_ += int(placed.size()) * 2;
+    apply_rewrite(dead, &placed);
+    return true;
+  }
+
   void fuse_layernorm(const std::map<std::string,
                                      std::vector<int64_t>>& shp) {
     FuseIdx ix = build_fuse_idx();
@@ -3691,10 +4447,14 @@ struct Predictor {
     }
     for (const auto& n : g.nodes)
       if (n.outputs.size() != 1) return;
-    // dummy zero inputs (initializer-shadowed inputs keep the default)
+    // dummy zero inputs (initializer-shadowed inputs keep the default;
+    // inputs with no surviving consumer — the paged rewrite's cache
+    // inputs — are never bound, so they cost neither plan-time
+    // allocation nor a run-time binding)
     std::vector<std::string> dummies;
     for (const auto& name : g.input_names) {
       if (g.initializers.count(name)) continue;
+      if (dead_inputs_.count(name)) continue;
       Tensor t;
       t.dims = g.input_dims[name];
       auto dt = g.input_dtypes.find(name);
@@ -3762,6 +4522,8 @@ struct Predictor {
 
   bool inputs_match_plan() const {
     for (const auto& name : g.input_names) {
+      if (dead_inputs_.count(name)) continue;  // rewritten-away: no
+                                               // node reads them
       auto it = env.find(name);
       auto want = g.input_dims.find(name);
       if (it == env.end() || want == g.input_dims.end()) return false;
@@ -3798,6 +4560,15 @@ struct Predictor {
         const Node& n = g.nodes[k];
         const int64_t t0 = ptpu::NowUs();
         run_node(n);
+        static const bool shp_dbg =
+            std::getenv("PTPU_TRACE_SHAPES") != nullptr;
+        if (shp_dbg && !n.outputs.empty() && env.count(n.outputs[0])) {
+          std::string d;
+          for (auto v : env[n.outputs[0]].dims)
+            d += std::to_string(v) + ",";
+          std::fprintf(stderr, "[shape] %s -> %s [%s]\n", n.op.c_str(),
+                       n.outputs[0].c_str(), d.c_str());
+        }
         const int64_t t1 = ptpu::NowUs();
         g_alloc_hint = nullptr;
         OpStat* s = node_stat_[k];
@@ -4197,7 +4968,31 @@ void Predictor::run_node(const Node& n) {
         throw std::runtime_error("Reshape: target shape overflows");
       wn_u *= uint64_t(d);
     }
-    const int64_t wn = int64_t(wn_u);
+    int64_t wn = int64_t(wn_u);
+    /* Batch repair under a bucket-ladder override (bo_from_ ->
+     * bo_to_): exporters bake the trace batch into shape constants,
+     * so a batch-carrying Reshape target arrives with the EXPORT
+     * batch folded into one of its dims ([B,1,heads,hd] head splits,
+     * [1,B*M,K] matmul flattenings). The element count disambiguates:
+     * repair only fires when the target is off by exactly the
+     * export/override ratio, and the dim to scale is the leftmost one
+     * EQUAL to the export batch (the exporter's layouts lead with it)
+     * falling back to the leftmost divisible one. A graph this cannot
+     * carry still throws below and the serving layer drops that
+     * bucket at probe time — never silent wrong shapes. */
+    if (concrete && wn != a.numel() && bo_from_ > 1 &&
+        bo_to_ != bo_from_ && wn % bo_from_ == 0 &&
+        wn / bo_from_ * bo_to_ == a.numel()) {
+      int pick = -1;
+      for (size_t z = 0; pick < 0 && z < want.size(); ++z)
+        if (want[z] == bo_from_) pick = int(z);
+      for (size_t z = 0; pick < 0 && z < want.size(); ++z)
+        if (want[z] > 0 && want[z] % bo_from_ == 0) pick = int(z);
+      if (pick >= 0) {
+        want[size_t(pick)] = want[size_t(pick)] / bo_from_ * bo_to_;
+        wn = wn / bo_from_ * bo_to_;
+      }
+    }
     if (concrete && wn != a.numel())
       throw std::runtime_error(
           "Reshape: target shape has " + std::to_string(wn) +
@@ -4377,6 +5172,27 @@ void Predictor::run_node(const Node& n) {
     const Tensor& a = in(n, 0);
     const Tensor& shp = in(n, 1);
     std::vector<int64_t> want(shp.i.begin(), shp.i.end());
+    /* Batch repair under a bucket-ladder override (see the Reshape
+     * twin): exporters also bake the trace batch into Expand targets
+     * (broadcast materializations like eps -> [B,1,1]). A target dim
+     * EQUAL to the export batch whose right-aligned source dim
+     * broadcasts (1 or absent) rewrites to the override batch —
+     * expanding less before a broadcasting consumer is semantically
+     * free, and strict-shape consumers fail the bucket probe rather
+     * than serve wrong shapes. */
+    if (bo_from_ > 1 && bo_to_ != bo_from_) {
+      // only the LEFTMOST qualifying dim is the batch — exporter
+      // broadcast targets lead with it, and a non-batch dim that
+      // coincides with the export batch (heads == batch) must stay
+      for (size_t z = 0; z < want.size(); ++z) {
+        if (want[z] != bo_from_) continue;
+        const size_t ra = a.dims.size();
+        const int64_t src =
+            z + ra >= want.size() ? a.dims[z + ra - want.size()] : 1;
+        if (src == 1 || src == bo_to_) want[z] = bo_to_;
+        break;
+      }
+    }
     Tensor o;
     o.dims = bcast_dims(a.dims, want);
     o.dtype = a.dtype;
@@ -4526,7 +5342,10 @@ void Predictor::run_node(const Node& n) {
       batch = 1;
       for (size_t d = 0; d + 2 < ra; ++d) {
         if (a.dims[d] != b.dims[d])
-          throw std::runtime_error("MatMul: batch dims differ");
+          throw std::runtime_error(
+              "MatMul: batch dims differ (" + n.inputs[0] + "," + n.inputs[1] + " " + std::to_string(a.dims[d]) +
+              " vs " + std::to_string(b.dims[d]) + " at axis " +
+              std::to_string(d) + ")");
         batch *= a.dims[d];
       }
       if (b.dims[rb - 2] != k_d)
@@ -5228,6 +6047,187 @@ void Predictor::run_node(const Node& n) {
       }
     });
     out(std::move(o));
+  } else if (op == "PtpuPagedAttention") {
+    /* Block-table-aware flash attention (kv_attach rewrite,
+     * rewrite_paged_attention): q and the freshly projected new_k /
+     * new_v arrive as inputs; CACHE rows are read straight through
+     * the attached KvPool's per-row block-table views — no gather
+     * staging, no concat copy. The key index space replicates the
+     * rewritten Concat layout exactly: key j < len(row) reads the
+     * pool page, j in [len, P) is the zero tail the slab path staged
+     * (dot == +/-0, then the mask applies — decode masks always drop
+     * these), and j >= P reads new_k row j-P. Bit-identical to
+     * PtpuAttention over the staged concat: same KB blocking, same
+     * mask/neg semantics, same online-softmax order; the only
+     * substitution is zero storage for [len, P), whose score the
+     * contiguous kernel also computed as a zero dot and whose value
+     * rows contributed exactly +0 to the accumulators (skipping the
+     * add is IEEE-identical). Without a live view (memory-plan dry
+     * run, or a hostile artifact naming this op directly) every row
+     * reads len 0 and the kernel touches only its declared inputs. */
+    const Tensor &q = in(n, 0), &nk = in(n, 1), &nv = in(n, 2);
+    const bool has_mask = n.inputs.size() >= 5;
+    const Tensor* mk = has_mask ? &in(n, 3) : nullptr;
+    const Tensor* ng = has_mask ? &in(n, 4) : nullptr;
+    if (!q.is_float() || !nk.is_float() || !nv.is_float() ||
+        q.dims.size() != 4)
+      throw std::runtime_error("PtpuPagedAttention: non-float or "
+                               "non-rank-4 operands at run time");
+    if (nk.dims != q.dims || nv.dims != q.dims)
+      throw std::runtime_error("PtpuPagedAttention: new k/v dims must "
+                               "equal q dims at run time");
+    const float scale = attr_f(n, "ptpu_scale", 1.f);
+    const float sm_init = attr_f(n, "ptpu_sm_init",
+                                 -std::numeric_limits<float>::infinity());
+    const int64_t b = q.dims[0], sq = q.dims[1];
+    const int64_t h = q.dims[2], d = q.dims[3];
+    const int64_t sk = attr_i(n, "ptpu_sk", 0);
+    const int64_t layer = attr_i(n, "ptpu_kv_layer", 0);
+    const int64_t P = sk - sq;
+    if (sq < 1 || P < 0)
+      throw std::runtime_error(
+          "PtpuPagedAttention: ptpu_sk must cover the query width");
+    /* A live view requires the geometry the pool allocated for —
+     * anything else (hostile attrs, artifact-declared op) degrades to
+     * len 0 so only declared inputs are ever dereferenced. */
+    const bool viewed = kv_pool_base_ && kv_max_groups_ > 0 &&
+                        int64_t(kv_view_len_.size()) >= b &&
+                        layer >= 0 && layer < kv_layers_ &&
+                        P == kv_ctx_ && h == kv_heads_ &&
+                        d == kv_hdim_;
+    Tensor o;
+    o.dtype = DT_F32;
+    o.dims = attr_i(n, "ptpu_flat_out", 0)
+                 ? std::vector<int64_t>{b, sq, h * d}
+                 : std::vector<int64_t>{b, sq, h, d};
+    o.alloc();
+    int64_t mst[4] = {0, 0, 0, 0}, nst[4] = {0, 0, 0, 0};
+    const auto bstr = [](const Tensor& t, int64_t st[4]) {
+      const size_t r = t.dims.size();
+      int64_t acc = 1;
+      for (size_t z = r; z-- > 0;) {
+        st[z + 4 - r] = t.dims[z] == 1 ? 0 : acc;
+        acc *= t.dims[z];
+      }
+    };
+    if (mk) bstr(*mk, mst);
+    if (ng) bstr(*ng, nst);
+    // the mask/neg index space is [b, h, q, sk]: any non-1 dim must
+    // match it or the strided reads walk out of the operand
+    if (mk) {
+      const auto bc_ok = [&](const Tensor& t) {
+        if (t.dims.empty() || t.dims.size() > 4) return false;
+        const int64_t want[4] = {b, h, sq, sk};
+        const size_t off = 4 - t.dims.size();
+        for (size_t z = 0; z < t.dims.size(); ++z)
+          if (t.dims[z] != 1 && t.dims[z] != want[z + off])
+            return false;
+        return true;
+      };
+      if (!bc_ok(*mk) || !bc_ok(*ng))
+        throw std::runtime_error(
+            "PtpuPagedAttention: mask/neg not broadcastable to "
+            "[b, h, q, ptpu_sk]");
+    }
+    const float* qf = q.f.data();
+    const float* nkf = nk.f.data();
+    const float* nvf = nv.f.data();
+    float* of = o.f.data();
+    const float* ngf = ng ? ng->f.data() : nullptr;
+    const int64_t* mki = mk && !mk->is_float() ? mk->i.data() : nullptr;
+    const float* mkf = mk && mk->is_float() ? mk->f.data() : nullptr;
+    const float* pb = kv_pool_base_;
+    const int64_t pgt = kv_page_tokens_;
+    const int64_t ge = kv_group_elems_;
+    const int64_t ktok0 = (layer * 2 + 0) * pgt;  // group-local token
+    const int64_t vtok0 = (layer * 2 + 1) * pgt;  // offsets of k and v
+    constexpr int64_t QB = 16, KB = 64;
+    const int64_t nqb = (sq + QB - 1) / QB;
+    const int64_t atn_grain =
+        b * h * sq * sk * d < (int64_t(1) << 18) ? b * h * nqb : 1;
+    parallel_for(b * h * nqb, atn_grain, [&](int64_t t0, int64_t t1) {
+      std::vector<float> acc(size_t(d), 0.f);
+      float s[KB];
+      for (int64_t t = t0; t < t1; ++t) {
+        const int64_t qb = t % nqb, bh = t / nqb;
+        const int64_t hh = bh % h, bb = bh / h;
+        const int64_t len =
+            viewed ? std::max<int64_t>(0, kv_view_len_[size_t(bb)]) : 0;
+        const int32_t* tab =
+            viewed ? &kv_view_tab_[size_t(bb * kv_max_groups_)]
+                   : nullptr;
+        const int64_t i1 = std::min(sq, (qb + 1) * QB);
+        for (int64_t i = qb * QB; i < i1; ++i) {
+          const float* qi = qf + ((bb * sq + i) * h + hh) * d;
+          float m = sm_init;
+          double l = 0.0;
+          for (int64_t z = 0; z < d; ++z) acc[size_t(z)] = 0.f;
+          for (int64_t j0 = 0; j0 < sk; j0 += KB) {
+            const int64_t jn = std::min(sk, j0 + KB) - j0;
+            for (int64_t jj = 0; jj < jn; ++jj) {
+              const int64_t j = j0 + jj;
+              const float* kj =
+                  j < len
+                      ? pb + size_t(tab[j / pgt]) * size_t(ge) +
+                            size_t(((ktok0 + j % pgt) * h + hh) * d)
+                  : j >= P
+                      ? nkf + ((bb * sq + (j - P)) * h + hh) * d
+                      : nullptr;
+              float dot = 0.f;
+              if (kj)
+                for (int64_t z = 0; z < d; ++z) dot += qi[z] * kj[z];
+              s[jj] = dot * scale;
+            }
+            if (mk) {
+              for (int64_t jj = 0; jj < jn; ++jj) {
+                const int64_t j = j0 + jj;
+                const int64_t mi =
+                    bb * mst[0] + hh * mst[1] + i * mst[2] + j * mst[3];
+                const bool keep =
+                    mki ? mki[mi] != 0 : mkf[mi] != 0.f;
+                if (!keep)
+                  s[jj] = ngf[bb * nst[0] + hh * nst[1] + i * nst[2] +
+                              j * nst[3]];
+              }
+            }
+            float bm = m;
+            for (int64_t jj = 0; jj < jn; ++jj)
+              bm = std::max(bm, s[jj]);
+            if (bm > m) {
+              const float r = float(std::exp(double(m) - double(bm)));
+              l *= double(r);
+              for (int64_t z = 0; z < d; ++z) acc[size_t(z)] *= r;
+              m = bm;
+            }
+            // see PtpuAttention: a still--inf running max means every
+            // score so far is -inf; skipping is exact, computing would
+            // NaN on exp(-inf - -inf) (the fresh-session shape)
+            if (std::isinf(m) && m < 0.f) continue;
+            for (int64_t jj = 0; jj < jn; ++jj) {
+              const int64_t j = j0 + jj;
+              const float p =
+                  float(std::exp(double(s[jj]) - double(m)));
+              l += double(p);
+              const float* vj =
+                  j < len
+                      ? pb + size_t(tab[j / pgt]) * size_t(ge) +
+                            size_t(((vtok0 + j % pgt) * h + hh) * d)
+                  : j >= P
+                      ? nvf + ((bb * sq + (j - P)) * h + hh) * d
+                      : nullptr;
+              if (vj)
+                for (int64_t z = 0; z < d; ++z)
+                  acc[size_t(z)] += p * vj[z];
+            }
+          }
+          float* oi = of + ((bb * sq + i) * h + hh) * d;
+          const float lf = float(l);
+          for (int64_t z = 0; z < d; ++z)
+            oi[z] = acc[size_t(z)] / lf;
+        }
+      }
+    });
+    out(std::move(o));
   } else if (op == "PtpuGelu") {
     /* Fused tanh-GELU (load-time fuse_gelu): replays the exported
      * chain's float ops in the same order — x*x*x (the Pow-3 special
@@ -5380,6 +6380,7 @@ static int set_input_int(void* h, const char* name, const T* data,
 extern "C" {
 
 typedef struct PTPU_Predictor PTPU_Predictor;
+typedef struct PTPU_KvPool PTPU_KvPool;
 
 static PTPU_Predictor* predictor_create_impl(const char* model_path,
                                              int64_t batch_override,
@@ -5411,13 +6412,49 @@ static PTPU_Predictor* predictor_create_impl(const char* model_path,
      * load-time dry run, so fusion, weight pre-packing and the arena
      * plan all settle at the override batch and batched runs stay on
      * the zero-alloc path. */
-    if (batch_override > 0)
+    if (batch_override > 0) {
+      int64_t orig_batch = 0;
       for (const auto& name : p->g.input_names) {
         if (p->g.initializers.count(name)) continue;  // default-valued
         auto it = p->g.input_dims.find(name);
-        if (it != p->g.input_dims.end() && !it->second.empty())
+        if (it != p->g.input_dims.end() && !it->second.empty()) {
+          if (orig_batch == 0) orig_batch = it->second[0];
           it->second[0] = batch_override;
+        }
       }
+      /* Exporters bake the trace batch into Reshape shape constants
+       * and Expand targets (jax resolves every -1 before lowering),
+       * which pinned each re-planned bucket to graphs with no
+       * batch-carrying reshapes. Record the export->override batch
+       * pair: the Reshape/Expand kernels repair a batch-baked target
+       * at run time (see the batch-repair notes in those branches),
+       * and the serving layer PROBES every bucket before trusting it,
+       * so a graph the repair cannot carry degrades to a dropped
+       * bucket — never to silent wrong shapes. */
+      if (orig_batch > 1 && batch_override != orig_batch) {
+        p->bo_from_ = orig_batch;
+        p->bo_to_ = batch_override;
+      }
+    }
+    if (std::getenv("PTPU_DUMP_GRAPH")) {
+      for (const auto& nd : p->g.nodes) {
+        std::fprintf(stderr, "[graph] %s(", nd.op.c_str());
+        for (const auto& i2 : nd.inputs) {
+          auto it2 = p->g.initializers.find(i2);
+          if (it2 != p->g.initializers.end() && !it2->second.is_float() &&
+              it2->second.i.size() <= 8) {
+            std::fprintf(stderr, "%s=[", i2.c_str());
+            for (auto v : it2->second.i)
+              std::fprintf(stderr, "%lld,", (long long)v);
+            std::fprintf(stderr, "] ");
+          } else {
+            std::fprintf(stderr, "%s ", i2.c_str());
+          }
+        }
+        std::fprintf(stderr, ") -> %s\n",
+                     nd.outputs.empty() ? "?" : nd.outputs[0].c_str());
+      }
+    }
     for (const auto& kv : p->g.initializers) p->env[kv.first] = kv.second;
     p->fold_constants();
     // PTPU_PREDICTOR_OPT=0 keeps the unoptimized graph — the parity
@@ -5733,31 +6770,174 @@ int ptpu_predictor_kv_plan(PTPU_Predictor* h, int sessions, char* err,
 __attribute__((visibility("default")))
 int ptpu_predictor_kv_sessions(PTPU_Predictor* h) {
   if (!h) return 0;
-  return ((Predictor*)h)->kv_sessions_;
+  auto* p = (Predictor*)h;
+  if (p->kv_pool_) return p->kv_pool_->max_sessions();
+  return p->kv_sessions_;
 }
 
 // free slot id (len 0), or -1 when every slot is busy (the caller —
-// the serving layer — owns the eviction policy)
+// the serving layer — owns the eviction policy). With a paged pool
+// attached this delegates to the shared pool's session space.
 __attribute__((visibility("default")))
 int ptpu_predictor_kv_open(PTPU_Predictor* h) {
   if (!h) return -1;
-  return ((Predictor*)h)->kv_open();
+  auto* p = (Predictor*)h;
+  if (p->kv_pool_) return p->kv_pool_->open();
+  return p->kv_open();
 }
 
 __attribute__((visibility("default")))
 void ptpu_predictor_kv_close(PTPU_Predictor* h, int sid) {
   if (!h) return;
-  ((Predictor*)h)->kv_close(sid);
+  auto* p = (Predictor*)h;
+  if (p->kv_pool_) return p->kv_pool_->close(sid);
+  p->kv_close(sid);
 }
 
 // current appended length of a session (-1: bad/closed session)
 __attribute__((visibility("default")))
 int64_t ptpu_predictor_kv_len(PTPU_Predictor* h, int sid) {
   auto* p = (Predictor*)h;
-  if (!p || sid < 0 || sid >= p->kv_sessions_ ||
+  if (!p) return -1;
+  if (p->kv_pool_) return p->kv_pool_->len(sid);
+  if (sid < 0 || sid >= p->kv_sessions_ ||
       !p->kv_sess_[size_t(sid)].open)
     return -1;
   return p->kv_sess_[size_t(sid)].len;
+}
+
+// ---- paged KV pool (ISSUE 12 tentpole) ------------------------------
+/* Create a shared paged KV pool. Arguments <= 0 resolve from the
+ * environment: pool_tokens ($PTPU_KV_POOL_TOKENS; 0 defers sizing to
+ * the first attach as 64 x context — the r9 fixed-slot RAM envelope),
+ * page_tokens ($PTPU_KV_PAGE, default 16), max_sessions
+ * ($PTPU_KV_SESSIONS, default 4096); prefix_cache < 0 reads
+ * $PTPU_KV_PREFIX (default on). Attach it to every ladder-bucket
+ * predictor of ONE decode artifact; sessions live in the pool. */
+__attribute__((visibility("default")))
+PTPU_KvPool* ptpu_kvpool_create(int64_t pool_tokens, int page_tokens,
+                                int max_sessions, int prefix_cache,
+                                char* err, int err_len) {
+  try {
+    const auto env_i64 = [](const char* name, int64_t dflt) {
+      const char* e = std::getenv(name);
+      if (!e) return dflt;
+      const int64_t v = std::atoll(e);
+      return v > 0 ? v : dflt;
+    };
+    if (pool_tokens <= 0)
+      pool_tokens = env_i64("PTPU_KV_POOL_TOKENS", 0);
+    if (page_tokens <= 0)
+      page_tokens = int(env_i64("PTPU_KV_PAGE", 16));
+    if (max_sessions <= 0)
+      max_sessions = int(env_i64("PTPU_KV_SESSIONS", 4096));
+    if (prefix_cache < 0) {
+      const char* e = std::getenv("PTPU_KV_PREFIX");
+      prefix_cache = e && std::strcmp(e, "0") == 0 ? 0 : 1;
+    }
+    auto* pool = new KvPool(pool_tokens, page_tokens, max_sessions,
+                            prefix_cache != 0);
+    return (PTPU_KvPool*)pool;
+  } catch (const std::exception& e) {
+    fill_error(err, err_len, e.what());
+    return nullptr;
+  }
+}
+
+__attribute__((visibility("default")))
+void ptpu_kvpool_destroy(PTPU_KvPool* h) {
+  if (!h) return;
+  delete (KvPool*)h;
+}
+
+/* Bind a decode-artifact predictor to the pool (validates the decode
+ * convention, fixes the pool geometry on first attach, and — unless
+ * PTPU_KV_DIRECT=0 — rewrites the attention graph onto the
+ * block-table read path). The pool must outlive the predictor. */
+__attribute__((visibility("default")))
+int ptpu_predictor_kv_attach(PTPU_Predictor* h, PTPU_KvPool* pool,
+                             char* err, int err_len) {
+  try {
+    if (!h || !pool)
+      throw std::runtime_error("kv_attach: null handle");
+    ((Predictor*)h)->kv_attach((KvPool*)pool);
+    return 0;
+  } catch (const std::exception& e) {
+    fill_error(err, err_len, e.what());
+    return 1;
+  }
+}
+
+// 1 when the attention graph rewrote onto the block-table read path
+// (gather fallback otherwise) — introspection for tests and stats
+__attribute__((visibility("default")))
+int ptpu_predictor_kv_direct(PTPU_Predictor* h) {
+  if (!h) return 0;
+  return ((Predictor*)h)->kv_direct_ ? 1 : 0;
+}
+
+__attribute__((visibility("default")))
+int ptpu_kvpool_open(PTPU_KvPool* h) {
+  if (!h) return -1;
+  return ((KvPool*)h)->open();
+}
+
+// clone src sharing every page group (copy-on-write on divergence);
+// -1 when src is closed or the session table is full
+__attribute__((visibility("default")))
+int ptpu_kvpool_fork(PTPU_KvPool* h, int sid) {
+  if (!h) return -1;
+  return ((KvPool*)h)->fork(sid);
+}
+
+__attribute__((visibility("default")))
+void ptpu_kvpool_close(PTPU_KvPool* h, int sid) {
+  if (!h) return;
+  ((KvPool*)h)->close(sid);
+}
+
+__attribute__((visibility("default")))
+int64_t ptpu_kvpool_len(PTPU_KvPool* h, int sid) {
+  if (!h) return -1;
+  return ((KvPool*)h)->len(sid);
+}
+
+/* Prefix-cache adoption for a freshly opened (or page-aligned)
+ * session: extend it with published page groups matching `tokens`,
+ * never past n-1 (the final prompt token must be stepped for its
+ * logits). Returns tokens adopted, 0 on any mismatch/miss. */
+__attribute__((visibility("default")))
+int64_t ptpu_kvpool_adopt(PTPU_KvPool* h, int sid,
+                          const int64_t* tokens, int64_t n) {
+  if (!h || !tokens || n < 1) return 0;
+  try {
+    return ((KvPool*)h)->adopt(sid, tokens, n);
+  } catch (const std::exception&) {
+    return 0;
+  }
+}
+
+// publish every full PROMPT page of `sid` into the prefix cache
+// (pass the prompt length as n so generated tokens stay private)
+__attribute__((visibility("default")))
+int ptpu_kvpool_publish(PTPU_KvPool* h, int sid,
+                        const int64_t* tokens, int64_t n) {
+  if (!h || !tokens || n < 1) return 1;
+  try {
+    ((KvPool*)h)->publish(sid, tokens, n);
+    return 0;
+  } catch (const std::exception&) {
+    return 1;
+  }
+}
+
+// pages_total/in_use/cached gauges + prefix/cow/exhaustion counters
+__attribute__((visibility("default")))
+const char* ptpu_kvpool_stats_json(PTPU_KvPool* h) {
+  if (!h) return "{}";
+  auto* p = (KvPool*)h;
+  p->stats_json_ = p->stats_json();
+  return p->stats_json_.c_str();
 }
 
 /* One batched decode step: row r feeds tokens[r] into open session

@@ -115,6 +115,23 @@ constexpr uint8_t kTagDecodeSess = 0x66;
 constexpr uint8_t kTagDecodeStep = 0x67;
 constexpr uint8_t kTagDecodeRep = 0x68;
 constexpr uint8_t kTagDecodeClose = 0x69;
+/* Paged-engine ops (ISSUE r12). OPEN2 opens a session WITH its prompt:
+ * the server adopts shared prefix pages from the prompt cache, then
+ * prefills the rest in bounded chunks interleaved with running decode
+ * steps through the same micro-batcher (a long prompt never stalls
+ * running sessions), answering once with the last prompt token's
+ * logits. FORK clones a live session copy-on-write (parallel sampling
+ * from one prefix) and echoes the NEW session id as DECODE_SESS.
+ *   DECODE_OPEN2    [ver][tag][u64 req_id][u32 n_tokens][u32 flags=0]
+ *                   [n_tokens x i64 tokens]       (18 + 8n B)
+ *   DECODE_OPEN_REP [ver][tag][u64 req_id][u64 session]
+ *                   [u32 adopted_tokens][u32 n_logits][n x f32]
+ *   DECODE_FORK     [ver][tag][u64 req_id][u64 session] -> SESS echo
+ * Tag bytes/layouts mirror inference/serving.py TAG_DECODE_*
+ * (tools/ptpu_check.py wire checker enforces both). */
+constexpr uint8_t kTagDecodeOpen2 = 0x6a;
+constexpr uint8_t kTagDecodeOpenRep = 0x6b;
+constexpr uint8_t kTagDecodeFork = 0x6c;
 constexpr uint32_t kSvMaxFrame = 1u << 30;
 constexpr int kSvMaxNdim = 16;
 // backpressure budget: how long one INFER frame may sit deferred on a
@@ -147,6 +164,10 @@ struct SvRequest {
   // decode steps ride the same batcher machinery as INFER requests
   // (continuous batching of decode steps across sessions)
   bool is_decode = false;
+  // server-internal prompt-prefill step (ISSUE r12 chunked prefill):
+  // no per-step reply; completion is tracked on the session's
+  // PrefillJob, which answers DECODE_OPEN_REP after the LAST token
+  bool is_prefill = false;
   uint64_t session = 0;
   int64_t token = 0;
   // ---- request tracing (ptpu_trace) ----
@@ -344,6 +365,11 @@ struct SvInstance {
 // C-only by construction)
 struct DecStats {
   ptpu::Counter opens, closes, evictions, steps, replies, batches;
+  // paged-engine counters (r12): OPEN2 prompts, prompt tokens
+  // prefilled by compute vs adopted from the prefix cache, forks,
+  // and steps answered "kv pool exhausted" (backpressure, retryable)
+  ptpu::Counter prefills, prefill_tokens, prefill_adopted, forks,
+      pool_exhausted, bucket_miss;
   ptpu::Histogram run_us, batch_fill;
   void Reset() {
     opens.Reset();
@@ -352,6 +378,12 @@ struct DecStats {
     steps.Reset();
     replies.Reset();
     batches.Reset();
+    prefills.Reset();
+    prefill_tokens.Reset();
+    prefill_adopted.Reset();
+    forks.Reset();
+    pool_exhausted.Reset();
+    bucket_miss.Reset();
     run_us.Reset();
     batch_fill.Reset();
   }
@@ -367,14 +399,48 @@ struct SvServer {
   int threads_per_instance = 0;
   // ---- KV-cached decode plane (optional second artifact) ----
   std::string decode_model_path;
-  int kv_sessions = 0;             // 0 -> PTPU_KV_SESSIONS -> 64
-  PTPU_Predictor* dec_pred = nullptr;
+  int kv_sessions = 0;             // max sessions; 0 -> env -> default
+  PTPU_Predictor* dec_pred = nullptr;   // largest surviving bucket
   void* dec_pool = nullptr;
   int64_t dec_batch = 0;           // decode artifact's baked batch
   int64_t dec_ctx = 0;             // cache positions per session
   int64_t dec_logit_elems = 0;     // logits row width
   std::unique_ptr<SvBatcher> dec_batcher;
   DecStats dstats;
+  /* Paged generation engine (ISSUE r12): a step-batch bucket ladder
+   * {1, 2, 4, ..., B} of decode predictors re-planned at load (like
+   * the INFER ladder, so partial fill stops padding to one baked
+   * batch), all attached to ONE shared KvPool — sessions live in the
+   * pool, RAM scales with tokens held, prompt prefixes are shared
+   * through the pool's prefix cache. PTPU_KV_PAGED=0 falls back to
+   * the r9 fixed-slot engine (kv_plan on the single max predictor). */
+  bool kv_paged = false;
+  PTPU_KvPool* kv_pool = nullptr;
+  std::map<int64_t, PTPU_Predictor*> dec_buckets;
+  std::vector<int64_t> dec_ladder;
+  int64_t prefill_chunk = 16;      // $PTPU_PREFILL_CHUNK, else page
+  /* One in-flight prompt prefill per OPEN2 (keyed by wire session,
+   * guarded by sess_mu_): `next` tokens admitted into the decode
+   * batcher so far (at most `prefill_chunk` beyond `done`), `done`
+   * tokens whose step completed. The final token's step answers
+   * DECODE_OPEN_REP with its logits and publishes the prompt's full
+   * pages into the pool's prefix cache. */
+  struct PrefillJob {
+    uint64_t sess = 0;
+    uint64_t rid = 0;
+    ptpu::net::ConnPtr conn;
+    uint64_t wire_tid = 0;
+    uint64_t trace_id = 0;
+    int64_t t_read_us = 0, t_enq_us = 0;
+    std::vector<int64_t> tokens;
+    int64_t next = 0;     // tokens admitted (adopted ones count)
+    int64_t done = 0;     // tokens stepped (adopted ones count)
+    int64_t adopted = 0;
+  };
+  std::map<uint64_t, std::unique_ptr<PrefillJob>> prefills_;
+  // jobs whose next chunk could not enqueue (batcher full): retried
+  // at the start of every decode flush
+  std::vector<uint64_t> prefill_resume_;
   /* Wire-session registry, two locks with a fixed order kv_mu_ ->
    * sess_mu_:
    *   sess_mu_  the registry map only — always held briefly.
@@ -513,10 +579,13 @@ struct SvServer {
     // steps from different sessions batch continuously without mixing
     // into INFER flushes.
     if (!decode_model_path.empty()) {
+      const char* pg = std::getenv("PTPU_KV_PAGED");
+      kv_paged = !(pg && std::strcmp(pg, "0") == 0);
+      const int kv_sessions_arg = kv_sessions;
       if (kv_sessions <= 0) {
         const char* e = std::getenv("PTPU_KV_SESSIONS");
         kv_sessions = e ? std::atoi(e) : 0;
-        if (kv_sessions <= 0) kv_sessions = 64;
+        if (kv_sessions <= 0) kv_sessions = kv_paged ? 4096 : 64;
       }
       dec_pred = ptpu_predictor_create_opts(decode_model_path.c_str(), 0,
                                             0, err, sizeof(err));
@@ -524,34 +593,101 @@ struct SvServer {
         throw std::runtime_error(std::string("decode model: ") + err);
       dec_pool = ptpu_workpool_create(threads_per_instance);
       ptpu_predictor_set_pool(dec_pred, dec_pool);
-      if (ptpu_predictor_kv_plan(dec_pred, kv_sessions, err,
-                                 sizeof(err)) != 0)
-        throw std::runtime_error(std::string("kv_plan: ") + err);
       const int64_t* idd = ptpu_predictor_input_dims(dec_pred, 0);
       const int64_t* cdd = ptpu_predictor_input_dims(dec_pred, 2);
       if (!idd || !cdd)
         throw std::runtime_error("decode model: missing input dims");
       dec_batch = idd[0];
       dec_ctx = cdd[1];
-      // probe one step now: a malformed decode artifact fails at
-      // start, not on the first live session; also learns the logits
-      // row width for DECODE_REP frames
-      {
-        const int sid = ptpu_predictor_kv_open(dec_pred);
-        if (sid < 0) throw std::runtime_error("kv probe: no slot");
-        const int64_t sids[1] = {sid}, toks[1] = {0};
-        if (ptpu_predictor_decode_step(dec_pred, sids, toks, 1, err,
-                                       sizeof(err)) != 0)
-          throw std::runtime_error(std::string("decode probe: ") + err);
-        const int nd = ptpu_predictor_output_ndim(dec_pred, 0);
-        const int64_t* od = ptpu_predictor_output_dims(dec_pred, 0);
-        if (nd < 1 || !od || od[0] != dec_batch)
-          throw std::runtime_error(
-              "decode probe: logits output lost the batch axis");
-        dec_logit_elems = 1;
-        for (int k = 1; k < nd; ++k) dec_logit_elems *= od[k];
-        ptpu_predictor_kv_close(dec_pred, sid);
+      if (kv_paged) {
+        /* Pool sizing: an explicit kv_sessions argument keeps the old
+         * capacity promise (N sessions x full context always fit);
+         * the default pool spends the r9 envelope (64 x context) on
+         * however many sessions actually fit their tokens in it. */
+        int64_t page = 16;
+        if (const char* e = std::getenv("PTPU_KV_PAGE"))
+          if (std::atoll(e) > 0) page = std::atoll(e);
+        int64_t pool_tokens = 0;
+        if (const char* e = std::getenv("PTPU_KV_POOL_TOKENS"))
+          pool_tokens = std::atoll(e);
+        if (pool_tokens <= 0)
+          pool_tokens = (kv_sessions_arg > 0 ? int64_t(kv_sessions_arg)
+                                             : 64) *
+                        ((dec_ctx + page - 1) / page) * page;
+        kv_pool = ptpu_kvpool_create(pool_tokens, int(page),
+                                     kv_sessions, -1, err, sizeof(err));
+        if (!kv_pool)
+          throw std::runtime_error(std::string("kvpool: ") + err);
+        if (ptpu_predictor_kv_attach(dec_pred, kv_pool, err,
+                                     sizeof(err)) != 0)
+          throw std::runtime_error(std::string("kv_attach: ") + err);
+        dec_buckets[dec_batch] = dec_pred;
+        // step-batch ladder below the baked batch, re-planned at load
+        for (int64_t b2 = 1; b2 < dec_batch; b2 *= 2) {
+          PTPU_Predictor* p2 = ptpu_predictor_create_opts(
+              decode_model_path.c_str(), b2, 0, err, sizeof(err));
+          if (!p2)
+            throw std::runtime_error(std::string("decode bucket ") +
+                                     std::to_string(b2) + ": " + err);
+          ptpu_predictor_set_pool(p2, dec_pool);
+          if (ptpu_predictor_kv_attach(p2, kv_pool, err,
+                                       sizeof(err)) != 0) {
+            ptpu_predictor_destroy(p2);
+            throw std::runtime_error(std::string("decode bucket ") +
+                                     std::to_string(b2) +
+                                     " kv_attach: " + err);
+          }
+          dec_buckets[b2] = p2;
+        }
+        prefill_chunk = 16;
+        {
+          const char* e = std::getenv("PTPU_KV_PAGE");
+          if (e && std::atoi(e) > 0) prefill_chunk = std::atoi(e);
+          if (const char* c = std::getenv("PTPU_PREFILL_CHUNK"))
+            if (std::atoi(c) > 0) prefill_chunk = std::atoi(c);
+        }
+      } else {
+        if (ptpu_predictor_kv_plan(dec_pred, kv_sessions, err,
+                                   sizeof(err)) != 0)
+          throw std::runtime_error(std::string("kv_plan: ") + err);
+        dec_buckets[dec_batch] = dec_pred;
       }
+      /* Probe every decode bucket with one step now: a malformed (or
+       * non-batch-polymorphic) artifact fails at start, not on the
+       * first live session; the max bucket also fixes the logits row
+       * width for DECODE_REP frames. Failed buckets < B are dropped;
+       * a failing max bucket fails start. */
+      for (auto it = dec_buckets.begin(); it != dec_buckets.end();) {
+        PTPU_Predictor* p2 = it->second;
+        const int sid = ptpu_predictor_kv_open(p2);
+        if (sid < 0) throw std::runtime_error("kv probe: no session");
+        const int64_t sids[1] = {sid}, toks[1] = {0};
+        std::string perr;
+        if (ptpu_predictor_decode_step(p2, sids, toks, 1, err,
+                                       sizeof(err)) != 0)
+          perr = err;
+        if (perr.empty()) {
+          const int nd = ptpu_predictor_output_ndim(p2, 0);
+          const int64_t* od = ptpu_predictor_output_dims(p2, 0);
+          if (nd < 1 || !od || od[0] != it->first) {
+            perr = "logits output lost the batch axis";
+          } else if (it->first == dec_batch) {
+            dec_logit_elems = 1;
+            for (int k = 1; k < nd; ++k) dec_logit_elems *= od[k];
+          }
+        }
+        ptpu_predictor_kv_close(p2, sid);
+        if (perr.empty()) {
+          ++it;
+        } else if (it->first == dec_batch) {
+          throw std::runtime_error("decode probe: " + perr);
+        } else {
+          ptpu_predictor_destroy(p2);
+          it = dec_buckets.erase(it);
+        }
+      }
+      for (const auto& kv2 : dec_buckets)
+        dec_ladder.push_back(kv2.first);
       dec_batcher.reset(new SvBatcher(
           dec_batch, deadline_us, 1, &dec_bstats,
           [this](int, std::vector<SvRequest>& batch) {
@@ -694,6 +830,24 @@ struct SvServer {
       out += ',';
       ptpu::AppendJsonU64(&out, "logit_elems",
                           uint64_t(dec_logit_elems));
+      out += ',';
+      ptpu::AppendJsonU64(&out, "paged", kv_paged ? 1 : 0);
+      out += ',';
+      ptpu::AppendJsonU64(&out, "direct",
+                          uint64_t(ptpu_predictor_kv_direct(dec_pred)));
+      out += ',';
+      ptpu::AppendJsonU64(&out, "prefill_chunk",
+                          uint64_t(prefill_chunk));
+      out += ",\"step_buckets\":[";
+      for (size_t k = 0; k < dec_ladder.size(); ++k) {
+        if (k) out += ',';
+        out += std::to_string(dec_ladder[k]);
+      }
+      out += "]";
+      if (kv_pool) {
+        out += ",\"pool\":";
+        out += ptpu_kvpool_stats_json(kv_pool);
+      }
       out += '}';
     }
     out += "}";
@@ -909,6 +1063,13 @@ struct SvServer {
                   std::string* why) {
     ptpu::MutexLock kl(kv_mu_);
     ptpu::MutexLock l(sess_mu_);
+    return OpenSlotLocked(conn, sess, why);
+  }
+
+  // kv_mu_ + sess_mu_ held; allocates a predictor/pool session with
+  // LRU eviction of the least-recently-stepped live wire session
+  bool OpenSlotLocked(const ptpu::net::ConnPtr& conn, uint64_t* sess,
+                      std::string* why) {
     int slot = ptpu_predictor_kv_open(dec_pred);
     if (slot < 0) {
       // every KV slot busy: evict the least-recently-stepped live
@@ -928,6 +1089,16 @@ struct SvServer {
       ptpu_predictor_kv_close(dec_pred, sessions_[victim].slot);
       sessions_[victim].slot = -1;
       dstats.evictions.Add(1);
+      // an evicted session may still be mid-prefill: its OPEN2 must
+      // answer NOW (queued prefill steps drop at the tombstone), or
+      // the client waits forever on a session that no longer exists
+      auto jit = prefills_.find(victim);
+      if (jit != prefills_.end()) {
+        SendErrFrame(jit->second->conn, jit->second->rid,
+                     "decode session evicted");
+        jit->second->conn->NotePending(-1);
+        prefills_.erase(jit);
+      }
       slot = ptpu_predictor_kv_open(dec_pred);
       if (slot < 0) {
         *why = "no KV session slots";
@@ -970,6 +1141,15 @@ struct SvServer {
     if (it->second.slot >= 0)
       ptpu_predictor_kv_close(dec_pred, it->second.slot);
     sessions_.erase(it);
+    // a prefilling session closed out from under its job (only
+    // reachable via a racing second connection guessing the id —
+    // clients learn the id from OPEN_REP): drop the job, balance the
+    // OPEN2 pending mark, leave the open frame unanswered
+    auto jit = prefills_.find(sess);
+    if (jit != prefills_.end()) {
+      jit->second->conn->NotePending(-1);
+      prefills_.erase(jit);
+    }
     dstats.closes.Add(1);
     return true;
   }
@@ -995,6 +1175,7 @@ struct SvServer {
       if (it->second.owner == conn) {
         if (it->second.slot >= 0)
           ptpu_predictor_kv_close(dec_pred, it->second.slot);
+        prefills_.erase(it->first);  // conn is gone: no reply owed
         it = sessions_.erase(it);
       } else {
         ++it;
@@ -1002,25 +1183,246 @@ struct SvServer {
     }
   }
 
+  /* ---- chunked prompt prefill (ISSUE r12) ----
+   * OPEN2 turns a prompt into server-internal decode steps admitted
+   * at most `prefill_chunk` at a time: the steps ride the SAME
+   * micro-batcher FIFO as everyone's decode steps, so a 1,000-token
+   * prompt interleaves with running sessions instead of stalling
+   * them. Shared prefix pages are adopted from the pool's prompt
+   * cache before any compute; the full prompt pages publish back into
+   * the cache when prefill completes. */
+  void DecodeOpen2(const ptpu::net::ConnPtr& conn, uint64_t rid,
+                   uint64_t wire_tid, std::vector<int64_t>&& toks) {
+    const int64_t ntok = int64_t(toks.size());
+    uint64_t sess = 0;
+    int64_t adopted = 0;
+    {
+      std::string why;
+      ptpu::MutexLock kl(kv_mu_);
+      ptpu::MutexLock l(sess_mu_);
+      if (!OpenSlotLocked(conn, &sess, &why)) {
+        SendErrFrame(conn, rid, why);
+        return;
+      }
+      if (kv_pool)
+        adopted = ptpu_kvpool_adopt(kv_pool, sessions_[sess].slot,
+                                    toks.data(), ntok);
+      auto* job = new PrefillJob;
+      job->sess = sess;
+      job->rid = rid;
+      job->conn = conn;
+      job->wire_tid = wire_tid;
+      job->tokens = std::move(toks);
+      job->next = adopted;
+      job->done = adopted;
+      job->adopted = adopted;
+      prefills_[sess].reset(job);
+      dstats.prefills.Add(1);
+      dstats.prefill_adopted.Add(uint64_t(adopted));
+      dstats.prefill_tokens.Add(uint64_t(ntok - adopted));
+    }
+    conn->NotePending(1);  // paired by OPEN_REP / the job's error
+    PrefillAdmit(sess);
+  }
+
+  bool DecodeFork(const ptpu::net::ConnPtr& conn, uint64_t src,
+                  uint64_t* nsess, std::string* why) {
+    ptpu::MutexLock kl(kv_mu_);
+    ptpu::MutexLock l(sess_mu_);
+    if (!kv_pool) {
+      *why = "fork needs the paged KV engine (PTPU_KV_PAGED)";
+      return false;
+    }
+    auto it = sessions_.find(src);
+    if (it == sessions_.end() || it->second.slot < 0) {
+      *why = it == sessions_.end() ? "unknown decode session"
+                                   : "decode session evicted";
+      return false;
+    }
+    if (prefills_.count(src)) {
+      *why = "session is still prefilling";
+      return false;
+    }
+    const int ns = ptpu_kvpool_fork(kv_pool, it->second.slot);
+    if (ns < 0) {
+      *why = "no KV session slots";
+      return false;
+    }
+    const uint64_t id = next_session_++;
+    WireSession ws;
+    ws.slot = ns;
+    ws.last_us = uint64_t(ptpu::NowUs());
+    ws.owner = conn.get();
+    sessions_[id] = ws;
+    dstats.forks.Add(1);
+    dstats.opens.Add(1);
+    *nsess = id;
+    return true;
+  }
+
+  // admit the next chunk of a job's prompt into the decode batcher;
+  // a full queue parks the job on prefill_resume_ for the next flush
+  void PrefillAdmit(uint64_t sess) {
+    ptpu::MutexLock l(sess_mu_);
+    auto it = prefills_.find(sess);
+    if (it == prefills_.end()) return;
+    PrefillJob* job = it->second.get();
+    const int64_t total = int64_t(job->tokens.size());
+    while (job->next < total && job->next - job->done < prefill_chunk) {
+      SvRequest r;
+      r.is_decode = true;
+      r.is_prefill = true;
+      r.id = job->rid;
+      r.session = sess;
+      r.token = job->tokens[size_t(job->next)];
+      r.rows = 1;
+      r.conn = job->conn;
+      r.wire_tid = 0;
+      r.trace_id = 0;
+      r.t_read_us = r.t_enq_us = ptpu::NowUs();
+      std::string why;
+      if (!dec_batcher->enqueue(std::move(r), &why)) {
+        prefill_resume_.push_back(sess);
+        return;
+      }
+      ++job->next;
+    }
+  }
+
+  void PrefillResume() {
+    std::vector<uint64_t> retry;
+    {
+      ptpu::MutexLock l(sess_mu_);
+      retry.swap(prefill_resume_);
+    }
+    for (uint64_t s : retry) PrefillAdmit(s);
+  }
+
+  // a prefill step errored (bad token, pool exhausted after retries):
+  // answer the OPEN2 with the error, drop the job and its session
+  // (kv_mu_ held — called from the decode runner)
+  void PrefillRowError(uint64_t sess, const std::string& why) {
+    ptpu::net::ConnPtr conn;
+    uint64_t rid = 0;
+    int slot = -1;
+    {
+      ptpu::MutexLock l(sess_mu_);
+      auto it = prefills_.find(sess);
+      if (it == prefills_.end()) return;
+      conn = it->second->conn;
+      rid = it->second->rid;
+      prefills_.erase(it);
+      auto sit = sessions_.find(sess);
+      if (sit != sessions_.end()) {
+        slot = sit->second.slot;
+        sessions_.erase(sit);
+      }
+    }
+    if (slot >= 0) ptpu_predictor_kv_close(dec_pred, slot);
+    SendErrFrame(conn, rid, "prefill: " + why);
+    conn->NotePending(-1);
+  }
+
+  // one prefill step finished (kv_mu_ held): bookkeep, and either
+  // answer OPEN_REP with the LAST prompt token's logits + publish the
+  // prompt pages, or admit the next chunk once this one drains
+  void PrefillRowDone(SvRequest* r, const float* lg, int64_t row) {
+    ptpu::net::ConnPtr conn;
+    uint64_t rid = 0, wire_tid = 0;
+    int64_t adopted = 0;
+    int slot = -1;
+    std::vector<int64_t> toks;
+    bool fin = false, admit = false;
+    {
+      ptpu::MutexLock l(sess_mu_);
+      auto it = prefills_.find(r->session);
+      if (it == prefills_.end()) return;
+      PrefillJob* job = it->second.get();
+      ++job->done;
+      if (job->done >= int64_t(job->tokens.size())) {
+        fin = true;
+        conn = job->conn;
+        rid = job->rid;
+        wire_tid = job->wire_tid;
+        adopted = job->adopted;
+        toks.swap(job->tokens);
+        auto sit = sessions_.find(r->session);
+        slot = sit == sessions_.end() ? -1 : sit->second.slot;
+        prefills_.erase(it);
+      } else if (job->next - job->done <= 0) {
+        admit = true;
+      }
+    }
+    if (!fin) {
+      if (admit) PrefillAdmit(r->session);
+      return;
+    }
+    if (kv_pool && slot >= 0)
+      ptpu_kvpool_publish(kv_pool, slot, toks.data(),
+                          int64_t(toks.size()));
+    std::vector<uint8_t> f = conn->AcquireBuf();
+    f.resize(4 + 2 + (wire_tid ? 8 : 0) + 8 + 8 + 4 + 4 +
+             size_t(dec_logit_elems) * 4);
+    const size_t ho = RepHdr(f, kTagDecodeOpenRep, wire_tid);
+    ptpu::PutU64(f.data() + ho, rid);
+    ptpu::PutU64(f.data() + ho + 8, r->session);
+    PutU32(f.data() + ho + 16, uint32_t(adopted));
+    PutU32(f.data() + ho + 20, uint32_t(dec_logit_elems));
+    std::memcpy(f.data() + ho + 24, lg + row * dec_logit_elems,
+                size_t(dec_logit_elems) * 4);
+    stats.bytes_out.Add(f.size());
+    conn->SendPayload(std::move(f));
+    conn->NotePending(-1);
+  }
+
   /* One decode flush. The FIFO may hold several steps of one session
-   * (a pipelining client); a session's steps are ordered, so the
-   * batch splits into FIFO-prefix sub-runs with unique sessions. */
+   * (a pipelining client, or a prompt-prefill chunk); a session's
+   * steps are ordered, so the batch splits into FIFO-prefix sub-runs
+   * with unique sessions. Stalled prefill admissions retry first —
+   * the batcher just drained, so there is room again. */
   void RunDecode(std::vector<SvRequest>& batch) {
+    PrefillResume();
     const int64_t t_deq = ptpu::NowUs();
     for (auto& r : batch) r.t_deq_us = t_deq;
-    size_t i = 0;
-    while (i < batch.size()) {
-      std::vector<SvRequest*> run;
-      std::set<uint64_t> seen;
-      size_t j = i;
-      for (; j < batch.size() && int64_t(run.size()) < dec_batch; ++j) {
-        if (seen.count(batch[j].session)) break;
-        seen.insert(batch[j].session);
-        run.push_back(&batch[j]);
+    /* Greedy order-preserving re-pack. The old FIFO-prefix split cut
+     * a sub-run at the FIRST repeated session, so a queue holding
+     * consecutive steps of few sessions (a prefill chunk, a client
+     * pipelining one session) degraded to 1-row runs. Instead, scan
+     * in FIFO order and place each step into the first sub-run AFTER
+     * the session's previous placement with room and no step of the
+     * same session — steps of one session stay ordered across runs,
+     * while different sessions' chunks interleave into full rows. */
+    std::vector<std::vector<SvRequest*>> runs;
+    std::vector<std::set<uint64_t>> seen;
+    std::map<uint64_t, size_t> next_run;
+    for (auto& r : batch) {
+      size_t k = 0;
+      auto it = next_run.find(r.session);
+      if (it != next_run.end()) k = it->second;
+      for (; k < runs.size(); ++k)
+        if (int64_t(runs[k].size()) < dec_batch &&
+            !seen[k].count(r.session))
+          break;
+      if (k == runs.size()) {
+        runs.emplace_back();
+        seen.emplace_back();
       }
-      DecodeStepRun(run);
-      i = j;
+      runs[k].push_back(&r);
+      seen[k].insert(r.session);
+      next_run[r.session] = k + 1;
     }
+    for (auto& run : runs) DecodeStepRun(run);
+  }
+
+  // smallest surviving step-batch bucket holding `rows` (the max
+  // bucket otherwise); counts a miss when padding was unavoidable
+  PTPU_Predictor* DecBucket(size_t rows) {
+    for (int64_t b : dec_ladder)
+      if (int64_t(rows) <= b) {
+        if (int64_t(rows) < b) dstats.bucket_miss.Add(1);
+        return dec_buckets[b];
+      }
+    return dec_pred;
   }
 
   // reply with row `row` of the just-run decode outputs (kv_mu_ held:
@@ -1070,6 +1472,17 @@ struct SvServer {
     r->conn->NotePending(-1);
   }
 
+  // route a failed/completed row to its owner: client steps answer
+  // frames directly, prefill steps update their job (kv_mu_ held)
+  void StepRowError(SvRequest* r, const std::string& why) {
+    if (r->is_prefill) {
+      PrefillRowError(r->session, why);
+      return;
+    }
+    SendErrFrame(r->conn, r->id, why);
+    r->conn->NotePending(-1);
+  }
+
   void DecodeStepRun(std::vector<SvRequest*>& run) {
     char err[512] = {0};
     std::vector<int64_t> sids, toks;
@@ -1080,6 +1493,7 @@ struct SvServer {
       for (auto* r : run) {
         auto it = sessions_.find(r->session);
         if (it == sessions_.end() || it->second.slot < 0) {
+          if (r->is_prefill) continue;  // job died with its session
           SendErrFrame(r->conn, r->id,
                        it == sessions_.end() ? "unknown decode session"
                                              : "decode session evicted");
@@ -1093,42 +1507,49 @@ struct SvServer {
       }
     }
     if (live.empty()) return;
+    // smallest ladder bucket holding the sub-run: partial fill stops
+    // padding to the baked batch (r9 served every step at B rows)
+    PTPU_Predictor* pred = DecBucket(live.size());
     const int64_t t0 = ptpu::NowUs();
-    if (ptpu_predictor_decode_step(dec_pred, sids.data(), toks.data(),
+    if (ptpu_predictor_decode_step(pred, sids.data(), toks.data(),
                                    int(live.size()), err,
                                    sizeof(err)) != 0) {
-      /* One request's bad input (e.g. an out-of-vocab token failing
-       * the embedding Gather) must not error its co-batched
-       * neighbours: retry each row alone so only the offending
+      /* One request's bad input (an out-of-vocab token failing the
+       * embedding Gather, or "kv pool exhausted" under page pressure)
+       * must not error its co-batched neighbours: retry each row
+       * alone — on the SMALLEST bucket — so only the offending
        * session answers the error. Pays only on the error path. */
       if (live.size() == 1) {
-        SendErrFrame(live[0]->conn, live[0]->id,
-                     std::string("decode_step: ") + err);
-        live[0]->conn->NotePending(-1);
+        const std::string why = std::string("decode_step: ") + err;
+        if (std::strstr(err, "kv pool exhausted"))
+          dstats.pool_exhausted.Add(1);
+        StepRowError(live[0], why);
         return;
       }
+      PTPU_Predictor* p1 = dec_buckets.begin()->second;
       for (size_t r2 = 0; r2 < live.size(); ++r2) {
         char rerr[512] = {0};
         const int64_t sid1[1] = {sids[r2]}, tok1[1] = {toks[r2]};
         const int64_t rt0 = ptpu::NowUs();
-        if (ptpu_predictor_decode_step(dec_pred, sid1, tok1, 1, rerr,
+        if (ptpu_predictor_decode_step(p1, sid1, tok1, 1, rerr,
                                        sizeof(rerr)) != 0) {
-          SendErrFrame(live[r2]->conn, live[r2]->id,
-                       std::string("decode_step: ") + rerr);
-          live[r2]->conn->NotePending(-1);
+          if (std::strstr(rerr, "kv pool exhausted"))
+            dstats.pool_exhausted.Add(1);
+          StepRowError(live[r2], std::string("decode_step: ") + rerr);
           continue;
         }
         const int64_t rt1 = ptpu::NowUs();
         dstats.batches.Add(1);
         dstats.batch_fill.Observe(1);
-        const float* lg1 = ptpu_predictor_output_data(dec_pred, 0);
-        if (lg1) {
-          DecodeReply(live[r2], lg1, 0, rt0, rt1);
-        } else {
-          SendErrFrame(live[r2]->conn, live[r2]->id,
-                       "decode: no logits output");
-          live[r2]->conn->NotePending(-1);
+        const float* lg1 = ptpu_predictor_output_data(p1, 0);
+        if (!lg1) {
+          StepRowError(live[r2], "decode: no logits output");
+          continue;
         }
+        if (live[r2]->is_prefill)
+          PrefillRowDone(live[r2], lg1, 0);
+        else
+          DecodeReply(live[r2], lg1, 0, rt0, rt1);
       }
       return;
     }
@@ -1136,16 +1557,17 @@ struct SvServer {
     dstats.run_us.Observe(uint64_t(t1 - t0));
     dstats.batches.Add(1);
     dstats.batch_fill.Observe(uint64_t(live.size()));
-    const float* lg = ptpu_predictor_output_data(dec_pred, 0);
+    const float* lg = ptpu_predictor_output_data(pred, 0);
     if (!lg) {
-      for (auto* r : live) {
-        SendErrFrame(r->conn, r->id, "decode: no logits output");
-        r->conn->NotePending(-1);
-      }
+      for (auto* r : live) StepRowError(r, "decode: no logits output");
       return;
     }
-    for (size_t r2 = 0; r2 < live.size(); ++r2)
-      DecodeReply(live[r2], lg, int64_t(r2), t0, t1);
+    for (size_t r2 = 0; r2 < live.size(); ++r2) {
+      if (live[r2]->is_prefill)
+        PrefillRowDone(live[r2], lg, int64_t(r2));
+      else
+        DecodeReply(live[r2], lg, int64_t(r2), t0, t1);
+    }
   }
 
   // ------------------------------------------------------ wire loop
@@ -1213,12 +1635,55 @@ struct SvServer {
       return FrameResult::kOk;
     }
     if (tag == kTagDecodeOpen || tag == kTagDecodeStep ||
-        tag == kTagDecodeClose) {
+        tag == kTagDecodeClose || tag == kTagDecodeOpen2 ||
+        tag == kTagDecodeFork) {
       if (n < 2 + ext + 8) return proto_err();
       const uint64_t rid = ptpu::GetU64(req + 2 + ext);
       if (!dec_pred) {
         SendErrFrame(conn, rid, "decode serving not configured (start "
                                 "the server with a decode_model)");
+        return FrameResult::kOk;
+      }
+      if (tag == kTagDecodeOpen2) {
+        // [u64 req_id][u32 n_tokens][u32 flags=0][n_tokens x i64]
+        if (n < 2 + ext + 8 + 4 + 4) return proto_err();
+        const uint32_t ntok = GetU32(req + 10 + ext);
+        const uint32_t flags = GetU32(req + 14 + ext);
+        if (uint64_t(n) != 2 + ext + 8 + 4 + 4 + 8ull * ntok)
+          return proto_err();
+        if (flags != 0) {
+          SendErrFrame(conn, rid, "unknown DECODE_OPEN2 flags");
+          return FrameResult::kOk;
+        }
+        if (ntok < 1 || int64_t(ntok) > dec_ctx) {
+          SendErrFrame(conn, rid,
+                       "prompt length outside [1, context=" +
+                           std::to_string(dec_ctx) + "]");
+          return FrameResult::kOk;
+        }
+        std::vector<int64_t> toks(ntok);
+        for (uint32_t k = 0; k < ntok; ++k)
+          toks[k] = ptpu::GetI64(req + 18 + ext + 8 * size_t(k));
+        DecodeOpen2(conn, rid, wire_tid, std::move(toks));
+        return FrameResult::kOk;
+      }
+      if (tag == kTagDecodeFork) {
+        if (n != 2 + ext + 8 + 8) return proto_err();
+        const uint64_t src = ptpu::GetU64(req + 10 + ext);
+        uint64_t nsess = 0;
+        std::string why;
+        if (!DecodeFork(conn, src, &nsess, &why)) {
+          SendErrFrame(conn, rid, why);
+          return FrameResult::kOk;
+        }
+        std::vector<uint8_t> f = conn->AcquireBuf();
+        f.resize(4 + 2 + (wire_tid ? 8 : 0) + 8 + 8);
+        const size_t ho = RepHdr(f, kTagDecodeSess, wire_tid);
+        ptpu::PutU64(f.data() + ho, rid);
+        ptpu::PutU64(f.data() + ho + 8, nsess);
+        stats.bytes_out.Add(f.size());
+        if (!conn->SendPayload(std::move(f)))
+          return FrameResult::kClose;
         return FrameResult::kOk;
       }
       if (tag == kTagDecodeOpen) {
@@ -1397,6 +1862,18 @@ struct SvServer {
       for (auto& r : dec_left) leftover.push_back(std::move(r));
     }
     for (auto& r : leftover) {
+      if (r.is_prefill) {
+        // the job answers its OPEN2 once, not per queued step
+        ptpu::MutexLock l(sess_mu_);
+        auto it = prefills_.find(r.session);
+        if (it != prefills_.end()) {
+          SendErrFrame(it->second->conn, it->second->rid,
+                       "server stopping");
+          it->second->conn->NotePending(-1);
+          prefills_.erase(it);
+        }
+        continue;
+      }
       SendErrFrame(r.conn, r.id, "server stopping");
       r.conn->NotePending(-1);  // pairs the enqueue-time +1
     }
@@ -1406,9 +1883,17 @@ struct SvServer {
     }
     batcher.reset();
     dec_batcher.reset();
+    for (auto& kv2 : dec_buckets)
+      if (kv2.second != dec_pred) ptpu_predictor_destroy(kv2.second);
+    dec_buckets.clear();
+    dec_ladder.clear();
     if (dec_pred) {
       ptpu_predictor_destroy(dec_pred);
       dec_pred = nullptr;
+    }
+    if (kv_pool) {
+      ptpu_kvpool_destroy(kv_pool);
+      kv_pool = nullptr;
     }
     if (dec_pool) {
       ptpu_workpool_destroy(dec_pool);
@@ -1491,6 +1976,12 @@ struct SvServer {
           {"steps", &dstats.steps},
           {"replies", &dstats.replies},
           {"batches", &dstats.batches},
+          {"prefills", &dstats.prefills},
+          {"prefill_tokens", &dstats.prefill_tokens},
+          {"prefill_adopted", &dstats.prefill_adopted},
+          {"forks", &dstats.forks},
+          {"pool_exhausted", &dstats.pool_exhausted},
+          {"bucket_miss", &dstats.bucket_miss},
       };
       for (const auto& kv : ds) {
         ptpu::AppendJsonU64(&out, kv.name, kv.c->Get());
@@ -1509,6 +2000,18 @@ struct SvServer {
       ptpu::AppendJsonHist(&out, "run_us", dstats.run_us);
       out += ',';
       ptpu::AppendJsonHist(&out, "batch_fill", dstats.batch_fill);
+      if (kv_pool) {
+        // pages_in_use/pages_total gauges + prefix_hits/cow_copies
+        // live in the pool's own snapshot (rendered in the predictor
+        // .so — one source of truth for the pager's counters).
+        // ptpu_kvpool_stats_json caches its snapshot in the pool
+        // handle ("valid until the next call"), and StatsJson runs
+        // concurrently on every telemetry event thread: serialize
+        // the call AND the copy-out under sess_mu_.
+        ptpu::MutexLock l(sess_mu_);
+        out += ",\"pool\":";
+        out += ptpu_kvpool_stats_json(kv_pool);
+      }
       out += '}';
     }
     out += "}";

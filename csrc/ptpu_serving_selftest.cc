@@ -770,6 +770,258 @@ void test_serving_decode_wire() {
   ptpu_serving_stop(h2);
 }
 
+// --------------------------------------------- paged KV legs (r12)
+/* Paged pool ABI: page-boundary growth, fork + COW divergence on a
+ * shared partial tail, EXACT prefix adoption with publish, pool
+ * exhaustion backpressure, reclaim on close, and LRU eviction of
+ * cached prefix groups under pressure — driven through the
+ * running-sum decode artifact (no attention to rewrite, so this also
+ * pins the gather fallback read path). */
+void test_kvpool_pager_abi() {
+  const std::string dec_path =
+      write_model_file(build_decode_model(), "ptpu_sv_selftest_dec.onnx");
+  char err[512] = {0};
+  // 4 groups of 2 tokens; P=4, so a full session holds 2 groups
+  PTPU_KvPool* pool = ptpu_kvpool_create(8, 2, 8, 1, err, sizeof(err));
+  assert(pool != nullptr);
+  // every session accessor must answer cleanly BEFORE the first
+  // attach sizes the session table (code-review finding: these read
+  // an empty vector out of bounds)
+  assert(ptpu_kvpool_open(pool) == -1);
+  assert(ptpu_kvpool_fork(pool, 0) == -1);
+  assert(ptpu_kvpool_len(pool, 0) == -1);
+  ptpu_kvpool_close(pool, 0);
+  {
+    const int64_t t0[2] = {1, 2};
+    assert(ptpu_kvpool_adopt(pool, 0, t0, 2) == 0);
+    assert(ptpu_kvpool_publish(pool, 0, t0, 2) != 0);
+  }
+  PTPU_Predictor* p =
+      ptpu_predictor_create(dec_path.c_str(), err, sizeof(err));
+  assert(p != nullptr);
+  assert(ptpu_predictor_kv_attach(p, pool, err, sizeof(err)) == 0);
+  assert(ptpu_predictor_kv_direct(p) == 0);  // gather path
+  // re-attach and fixed-slot kv_plan after attach are refused
+  assert(ptpu_predictor_kv_attach(p, pool, err, sizeof(err)) != 0);
+  assert(ptpu_predictor_kv_plan(p, 2, err, sizeof(err)) != 0);
+  const auto step1 = [&](int sid, int64_t tok) -> float {
+    const int64_t sids[1] = {sid}, toks[1] = {tok};
+    char serr[512] = {0};
+    const int rc =
+        ptpu_predictor_decode_step(p, sids, toks, 1, serr, sizeof(serr));
+    assert(rc == 0 && "paged decode step failed");
+    return ptpu_predictor_output_data(p, 0)[0];
+  };
+  const int a = ptpu_kvpool_open(pool);
+  assert(a >= 0 && ptpu_kvpool_len(pool, a) == 0);
+  // growth across the 2-token page boundary: running sums stay exact
+  assert(step1(a, 5) == 5.f);
+  assert(step1(a, 7) == 12.f);   // page 0 full
+  assert(step1(a, 11) == 23.f);  // crosses into page 1
+  assert(ptpu_kvpool_len(pool, a) == 3);
+  // fork shares both groups including the PARTIAL tail
+  const int b = ptpu_kvpool_fork(pool, a);
+  assert(b >= 0 && b != a && ptpu_kvpool_len(pool, b) == 3);
+  // divergence mid-prefix: the first append into the shared tail
+  // copies it; histories stay independent
+  assert(step1(a, 100) == 123.f);
+  assert(step1(b, 200) == 223.f);
+  {
+    const std::string js = ptpu_kvpool_stats_json(pool);
+    assert(js.find("\"cow_copies\":1") != std::string::npos);
+    assert(js.find("\"forks\":1") != std::string::npos);
+  }
+  // publish a's prompt pages; a fresh session adopts the full-page
+  // prefix (capped at n-1: the last token must be stepped) and its
+  // replayed suffix reproduces a's sums exactly
+  const int64_t prompt[4] = {5, 7, 11, 100};
+  assert(ptpu_kvpool_publish(pool, a, prompt, 4) == 0);
+  const int c = ptpu_kvpool_open(pool);
+  assert(ptpu_kvpool_adopt(pool, c, prompt, 4) == 2);
+  assert(ptpu_kvpool_len(pool, c) == 2);
+  assert(step1(c, 11) == 23.f);
+  assert(step1(c, 100) == 123.f);
+  // a diverged token prefix must NOT adopt (exact-match gate)
+  const int d = ptpu_kvpool_open(pool);
+  const int64_t bad[4] = {5, 8, 11, 100};
+  assert(ptpu_kvpool_adopt(pool, d, bad, 4) == 0);
+  // pool exhausted: every group is held (a:2, b's COW tail, c's own
+  // tail) — d's first append answers backpressure, not a crash
+  {
+    const int64_t sids[1] = {d}, toks[1] = {1};
+    assert(ptpu_predictor_decode_step(p, sids, toks, 1, err,
+                                      sizeof(err)) != 0);
+    assert(std::strstr(err, "kv pool exhausted") != nullptr);
+  }
+  // closing a session reclaims its unshared pages; d proceeds
+  ptpu_kvpool_close(pool, b);
+  assert(step1(d, 9) == 9.f);
+  ptpu_kvpool_close(pool, a);
+  ptpu_kvpool_close(pool, c);
+  ptpu_kvpool_close(pool, d);
+  {
+    const std::string js = ptpu_kvpool_stats_json(pool);
+    assert(js.find("\"sessions_active\":0") != std::string::npos);
+    assert(js.find("\"prefix_hits\":1") != std::string::npos);
+    assert(js.find("\"pool_exhausted\":1") != std::string::npos);
+    // the published pages survive their sessions (prompt cache)
+    assert(js.find("\"pages_cached\":2") != std::string::npos);
+  }
+  // allocation pressure evicts cached prefix groups LRU instead of
+  // failing: 4 one-step sessions need all 4 groups
+  int sess4[4];
+  for (int k = 0; k < 4; ++k) {
+    sess4[k] = ptpu_kvpool_open(pool);
+    assert(step1(sess4[k], int64_t(k) + 1) == float(k + 1));
+  }
+  {
+    const std::string js = ptpu_kvpool_stats_json(pool);
+    assert(js.find("\"prefix_evictions\":2") != std::string::npos);
+    assert(js.find("\"pages_cached\":0") != std::string::npos);
+  }
+  ptpu_predictor_destroy(p);
+  ptpu_kvpool_destroy(pool);
+  std::printf("  paged pool: boundary/COW/adopt/exhaust/evict   OK\n");
+}
+
+/* Paged decode over the wire: OPEN2 prompt prefill (cold + prefix
+ * hit), OPEN_REP layout, FORK + equal-step parity, prefill
+ * exhaustion answering the OPEN2 with a soft error, reclaim-on-close
+ * unblocking it, and LRU session eviction whose tombstone answers
+ * "evicted" AFTER its pages returned to the pool. */
+void test_serving_decode_paged_wire() {
+  setenv("PTPU_KV_PAGE", "2", 1);
+  setenv("PTPU_KV_POOL_TOKENS", "8", 1);  // 4 groups of 2 tokens
+  std::vector<float> W;
+  const std::string mm_path = write_model_file(
+      build_matmul_model(4, 16, 8, &W), "ptpu_sv_selftest_decmm.onnx");
+  const std::string dec_path =
+      write_model_file(build_decode_model(), "ptpu_sv_selftest_dec.onnx");
+  char err[512] = {0};
+  void* h = ptpu_serving_start2(mm_path.c_str(), dec_path.c_str(), 0,
+                                "dk", 2, 4, 3000, 1, 1, 1,
+                                /*kv_sessions=*/4, err, sizeof(err));
+  assert(h != nullptr && "paged serving start2 failed");
+  SvTestClient cli;
+  assert(cli.connect_to(ptpu_serving_port(h)));
+  assert(cli.handshake("dk"));
+  // OPEN2: [ver][0x6a][u64 rid][u32 n][u32 flags][n x i64]
+  const auto open2 = [&](uint64_t rid, std::vector<int64_t> toks,
+                         uint64_t* sess, uint32_t* adopted,
+                         float* logit, std::string* why) {
+    std::vector<uint8_t> f{kSvWireVersion, kTagDecodeOpen2}, rep;
+    f.resize(18 + 8 * toks.size());
+    ptpu::PutU64(f.data() + 2, rid);
+    ptpu::PutU32(f.data() + 10, uint32_t(toks.size()));
+    ptpu::PutU32(f.data() + 14, 0);
+    for (size_t k = 0; k < toks.size(); ++k)
+      ptpu::PutI64(f.data() + 18 + 8 * k, toks[k]);
+    assert(cli.send_frame(f) && cli.read_frame(&rep));
+    assert(ptpu::GetU64(rep.data() + 2) == rid);
+    if (rep[1] == kTagInferErr) {
+      const uint32_t ml = ptpu::GetU32(rep.data() + 10);
+      why->assign((const char*)rep.data() + 14, ml);
+      return false;
+    }
+    assert(rep[1] == kTagDecodeOpenRep);
+    *sess = ptpu::GetU64(rep.data() + 10);
+    *adopted = ptpu::GetU32(rep.data() + 18);
+    assert(ptpu::GetU32(rep.data() + 22) == 1);  // one logit
+    *logit = ptpu::GetF32(rep.data() + 26);
+    return true;
+  };
+  const auto step = [&](uint64_t rid, uint64_t sess, int64_t tok,
+                        float* logit, std::string* why) {
+    std::vector<uint8_t> f{kSvWireVersion, kTagDecodeStep}, rep;
+    f.resize(26);
+    ptpu::PutU64(f.data() + 2, rid);
+    ptpu::PutU64(f.data() + 10, sess);
+    ptpu::PutI64(f.data() + 18, tok);
+    assert(cli.send_frame(f) && cli.read_frame(&rep));
+    assert(ptpu::GetU64(rep.data() + 2) == rid);
+    if (rep[1] == kTagInferErr) {
+      const uint32_t ml = ptpu::GetU32(rep.data() + 10);
+      why->assign((const char*)rep.data() + 14, ml);
+      return false;
+    }
+    assert(rep[1] == kTagDecodeRep);
+    *logit = ptpu::GetF32(rep.data() + 22);
+    return true;
+  };
+  uint64_t s1 = 0, s2 = 0;
+  uint32_t ad = 0;
+  float lg = 0.f;
+  std::string why;
+  // cold prefill: logit == prompt running sum, nothing adopted
+  assert(open2(1, {1, 2, 3}, &s1, &ad, &lg, &why));
+  assert(ad == 0 && lg == 6.f);
+  // same prompt again: one full page adopted from the prefix cache,
+  // identical logits
+  assert(open2(2, {1, 2, 3}, &s2, &ad, &lg, &why));
+  assert(ad == 2 && lg == 6.f && s2 != s1);
+  // fork s1; the same token steps BOTH to the same sum (COW under a
+  // shared partial tail), then the pool is fully allocated
+  std::vector<uint8_t> f{kSvWireVersion, kTagDecodeFork}, rep;
+  f.resize(18);
+  ptpu::PutU64(f.data() + 2, 3);
+  ptpu::PutU64(f.data() + 10, s1);
+  assert(cli.send_frame(f) && cli.read_frame(&rep));
+  assert(rep[1] == kTagDecodeSess);
+  const uint64_t sf = ptpu::GetU64(rep.data() + 10);
+  assert(step(4, sf, 4, &lg, &why) && lg == 10.f);
+  assert(step(5, s1, 4, &lg, &why) && lg == 10.f);
+  assert(step(6, s2, 5, &lg, &why) && lg == 11.f);
+  // prefill under pool exhaustion: the OPEN2 answers a soft error
+  // (backpressure) and tears its session down
+  uint64_t s9 = 0;
+  assert(!open2(7, {9}, &s9, &ad, &lg, &why));
+  assert(why.find("kv pool exhausted") != std::string::npos);
+  // closing the fork reclaims its COW tail; the retry succeeds
+  {
+    std::vector<uint8_t> cf{kSvWireVersion, kTagDecodeClose}, crep;
+    cf.resize(18);
+    ptpu::PutU64(cf.data() + 2, 8);
+    ptpu::PutU64(cf.data() + 10, sf);
+    assert(cli.send_frame(cf) && cli.read_frame(&crep));
+    assert(crep[1] == kTagDecodeSess);
+  }
+  assert(open2(9, {9}, &s9, &ad, &lg, &why));
+  assert(ad == 0 && lg == 9.f);
+  // kv_sessions=4: two more opens evict the LRU (s1); its tombstone
+  // answers "evicted" — after its pages went back to the pool
+  // (pages_in_use drops to s2's two + s9's one)
+  const auto open_plain = [&](uint64_t rid) {
+    std::vector<uint8_t> of{kSvWireVersion, kTagDecodeOpen}, orep;
+    of.resize(10);
+    ptpu::PutU64(of.data() + 2, rid);
+    assert(cli.send_frame(of) && cli.read_frame(&orep));
+    assert(orep[1] == kTagDecodeSess);
+    return ptpu::GetU64(orep.data() + 10);
+  };
+  open_plain(10);
+  open_plain(11);
+  {
+    const std::string js = ptpu_serving_stats_json(h);
+    assert(js.find("\"evictions\":1") != std::string::npos);
+    assert(js.find("\"pages_in_use\":3") != std::string::npos);
+    assert(js.find("\"prefills\":4") != std::string::npos);
+    assert(js.find("\"forks\":1") != std::string::npos);
+    assert(js.find("\"pool_exhausted\":1") != std::string::npos);
+  }
+  assert(!step(12, s1, 1, &lg, &why));
+  assert(why.find("evicted") != std::string::npos);
+  // surviving sessions still serve exactly (s2 is at full context
+  // P=4 after its prompt + one step; s9 has room)
+  assert(step(13, s9, 1, &lg, &why) && lg == 10.f);
+  assert(!step(14, s2, 1, &lg, &why));
+  assert(why.find("context is full") != std::string::npos);
+  cli.close();
+  ptpu_serving_stop(h);
+  unsetenv("PTPU_KV_PAGE");
+  unsetenv("PTPU_KV_POOL_TOKENS");
+  std::printf("  paged wire: open2/prefix/fork/backpressure/evict OK\n");
+}
+
 }  // namespace
 
 int main() {
@@ -783,6 +1035,8 @@ int main() {
   test_serving_pipelined_requests_batch();
   test_decode_kv_abi();
   test_serving_decode_wire();
+  test_kvpool_pager_abi();
+  test_serving_decode_paged_wire();
   std::printf("ptpu_serving_selftest: all native serving unit tests "
               "passed\n");
   return 0;

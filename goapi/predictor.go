@@ -356,6 +356,136 @@ func (p *Predictor) DecodeStep(sids, tokens []int64) error {
 	return nil
 }
 
+// KvPool is a shared paged KV-cache pool (r12): fixed-size page
+// groups back every decode session through per-session block tables,
+// so RAM scales with tokens held instead of sessions x max-context.
+// Attach one pool to every ladder-bucket predictor of a decode
+// artifact; the pool must outlive them.
+type KvPool struct {
+	p *C.PTPU_KvPool
+}
+
+// NewKvPool creates a pool. Arguments <= 0 resolve from the
+// environment: poolTokens ($PTPU_KV_POOL_TOKENS; 0 defers sizing to
+// the first attach as 64 x context), pageTokens ($PTPU_KV_PAGE, 16),
+// maxSessions ($PTPU_KV_SESSIONS, 4096); prefixCache < 0 reads
+// $PTPU_KV_PREFIX (on).
+func NewKvPool(poolTokens int64, pageTokens, maxSessions,
+	prefixCache int) (*KvPool, error) {
+	buf := make([]C.char, errLen)
+	h := C.ptpu_kvpool_create(C.int64_t(poolTokens), C.int(pageTokens),
+		C.int(maxSessions), C.int(prefixCache), &buf[0], errLen)
+	if h == nil {
+		return nil, lastErr(buf)
+	}
+	return &KvPool{p: h}, nil
+}
+
+// Destroy frees the pool (only after every attached predictor died).
+func (k *KvPool) Destroy() {
+	if k.p != nil {
+		C.ptpu_kvpool_destroy(k.p)
+		k.p = nil
+	}
+}
+
+// KvAttach binds a decode-artifact predictor to the shared pool
+// (instead of KvPlan's fixed slots): sessions then live in the pool
+// and KvOpen/KvClose/KvLen/DecodeStep delegate to it. Unless
+// PTPU_KV_DIRECT=0, the attention graph rewrites onto the
+// block-table read path (KvDirect reports whether it fired).
+func (p *Predictor) KvAttach(pool *KvPool) error {
+	if p.p == nil {
+		return errors.New("KvAttach: predictor is destroyed")
+	}
+	if pool == nil || pool.p == nil {
+		return errors.New("KvAttach: pool is destroyed")
+	}
+	buf := make([]C.char, errLen)
+	rc := C.ptpu_predictor_kv_attach(p.p, pool.p, &buf[0], errLen)
+	runtime.KeepAlive(p)
+	runtime.KeepAlive(pool)
+	if rc != 0 {
+		return lastErr(buf)
+	}
+	return nil
+}
+
+// KvDirect reports whether the attention graph rewrote onto the paged
+// (block-table) read path at KvAttach time.
+func (p *Predictor) KvDirect() bool {
+	n := int(C.ptpu_predictor_kv_direct(p.p))
+	runtime.KeepAlive(p)
+	return n != 0
+}
+
+// Open claims a fresh session in the pool (-1 when the session table
+// is full).
+func (k *KvPool) Open() int {
+	n := int(C.ptpu_kvpool_open(k.p))
+	runtime.KeepAlive(k)
+	return n
+}
+
+// Fork clones a live session sharing every page group copy-on-write
+// (-1 when full or src is closed).
+func (k *KvPool) Fork(sid int) int {
+	n := int(C.ptpu_kvpool_fork(k.p, C.int(sid)))
+	runtime.KeepAlive(k)
+	return n
+}
+
+// CloseSession releases a session; its unshared pages return to the
+// pool.
+func (k *KvPool) CloseSession(sid int) {
+	C.ptpu_kvpool_close(k.p, C.int(sid))
+	runtime.KeepAlive(k)
+}
+
+// Len is the appended position count of an open session (-1
+// otherwise).
+func (k *KvPool) Len(sid int) int64 {
+	n := int64(C.ptpu_kvpool_len(k.p, C.int(sid)))
+	runtime.KeepAlive(k)
+	return n
+}
+
+// Adopt extends a page-aligned session with published prefix pages
+// matching tokens (never past len(tokens)-1 — the final token's
+// logits must come from a step). Returns tokens adopted.
+func (k *KvPool) Adopt(sid int, tokens []int64) int64 {
+	if len(tokens) == 0 {
+		return 0
+	}
+	n := int64(C.ptpu_kvpool_adopt(k.p, C.int(sid),
+		(*C.int64_t)(unsafe.Pointer(&tokens[0])),
+		C.int64_t(len(tokens))))
+	runtime.KeepAlive(k)
+	runtime.KeepAlive(tokens)
+	return n
+}
+
+// Publish registers every full prompt page of sid into the prefix
+// cache for later adoption (tokens is the prompt only).
+func (k *KvPool) Publish(sid int, tokens []int64) {
+	if len(tokens) == 0 {
+		return
+	}
+	C.ptpu_kvpool_publish(k.p, C.int(sid),
+		(*C.int64_t)(unsafe.Pointer(&tokens[0])),
+		C.int64_t(len(tokens)))
+	runtime.KeepAlive(k)
+	runtime.KeepAlive(tokens)
+}
+
+// StatsJSON returns the pool's gauge/counter snapshot
+// (pages_total/in_use/cached, prefix_hits, cow_copies, ...).
+func (k *KvPool) StatsJSON() string {
+	s := C.GoString(C.ptpu_kvpool_stats_json(k.p))
+	runtime.KeepAlive(k)
+	return s
+}
+
 // StatsJSON returns the predictor's serving stats snapshot (always-on
 // per-op calls/time/bytes + per-run latency histogram) as the JSON
 // string ptpu_predictor_stats_json renders — unmarshal with

@@ -701,6 +701,31 @@ def _predictor_lib() -> ctypes.CDLL:
         except AttributeError:   # stale prebuilt .so: decode degrades
             lib._ptpu_has_decode = False
         try:
+            # paged KV pool ABI (r12) — absent from stale .so builds
+            lib.ptpu_kvpool_create.restype = c.c_void_p
+            lib.ptpu_kvpool_create.argtypes = [
+                c.c_int64, c.c_int, c.c_int, c.c_int, c.c_char_p,
+                c.c_int]
+            lib.ptpu_kvpool_destroy.argtypes = [c.c_void_p]
+            lib.ptpu_predictor_kv_attach.argtypes = [
+                c.c_void_p, c.c_void_p, c.c_char_p, c.c_int]
+            lib.ptpu_predictor_kv_direct.argtypes = [c.c_void_p]
+            lib.ptpu_kvpool_open.argtypes = [c.c_void_p]
+            lib.ptpu_kvpool_fork.argtypes = [c.c_void_p, c.c_int]
+            lib.ptpu_kvpool_close.argtypes = [c.c_void_p, c.c_int]
+            lib.ptpu_kvpool_len.restype = c.c_int64
+            lib.ptpu_kvpool_len.argtypes = [c.c_void_p, c.c_int]
+            lib.ptpu_kvpool_adopt.restype = c.c_int64
+            lib.ptpu_kvpool_adopt.argtypes = [
+                c.c_void_p, c.c_int, c.POINTER(c.c_int64), c.c_int64]
+            lib.ptpu_kvpool_publish.argtypes = [
+                c.c_void_p, c.c_int, c.POINTER(c.c_int64), c.c_int64]
+            lib.ptpu_kvpool_stats_json.restype = c.c_char_p
+            lib.ptpu_kvpool_stats_json.argtypes = [c.c_void_p]
+            lib._ptpu_has_kvpool = True
+        except AttributeError:   # stale prebuilt .so: paging degrades
+            lib._ptpu_has_kvpool = False
+        try:
             # telemetry HTTP + two-phase drain + tracing ABI (r10)
             lib.ptpu_serving_start3.restype = c.c_void_p
             lib.ptpu_serving_start3.argtypes = [
@@ -926,6 +951,30 @@ class NativePredictor:
                                self._err.value.decode())
         return self.output(0)[:sids.size]
 
+    # ---- paged KV pool (r12) ----
+    def kv_attach(self, pool: "KvPool") -> None:
+        """Bind this decode-artifact predictor to a shared paged
+        :class:`KvPool` (instead of :meth:`kv_plan`'s fixed slots).
+        Sessions then live in the pool; kv_open/close/len and
+        decode_step delegate to it. Unless ``PTPU_KV_DIRECT=0``, the
+        attention graph rewrites onto the block-table read path
+        (``kv_direct()`` reports whether it fired)."""
+        self._need_decode()
+        if not getattr(self._lib, "_ptpu_has_kvpool", False):
+            raise RuntimeError(
+                "paged KV needs the r12 ABI (stale "
+                "_native_predictor.so: delete it and re-import)")
+        if self._lib.ptpu_predictor_kv_attach(self._handle(),
+                                              pool._handle(),
+                                              self._err, 512) != 0:
+            raise RuntimeError("kv_attach: " + self._err.value.decode())
+
+    def kv_direct(self) -> bool:
+        """True when the attention graph rewrote onto the paged
+        (block-table) read path at :meth:`kv_attach` time."""
+        self._need_decode()
+        return bool(self._lib.ptpu_predictor_kv_direct(self._handle()))
+
     def output(self, i: int = 0):
         np = self._np
         nd = self._lib.ptpu_predictor_output_ndim(self._handle(), i)
@@ -934,6 +983,103 @@ class NativePredictor:
         data = self._lib.ptpu_predictor_output_data(self._handle(), i)
         n = int(np.prod(shape)) if shape else 1
         return np.ctypeslib.as_array(data, shape=(n,)).reshape(shape).copy()
+
+
+class KvPool:
+    """Shared paged KV-cache pool for decode predictors (r12).
+
+    Fixed-size page groups (``page_tokens`` positions x all layers x
+    k+v) back every decode session, so RAM scales with tokens held
+    instead of sessions x max-context. Attach the pool to every
+    ladder-bucket predictor of ONE decode artifact via
+    :meth:`NativePredictor.kv_attach`; open/fork/close/len address the
+    pool's shared session space. ``adopt``/``publish`` drive the
+    prefix/prompt cache; ``stats()`` parses the C snapshot
+    (pages_total/in_use/cached gauges, prefix_hits, cow_copies, ...).
+
+    Arguments <= 0 resolve from ``$PTPU_KV_POOL_TOKENS`` (0 = 64 x
+    context at first attach), ``$PTPU_KV_PAGE`` (16) and
+    ``$PTPU_KV_SESSIONS`` (4096); ``prefix_cache=None`` reads
+    ``$PTPU_KV_PREFIX`` (on)."""
+
+    def __init__(self, pool_tokens: int = 0, page_tokens: int = 0,
+                 max_sessions: int = 0, prefix_cache=None):
+        lib = _predictor_lib()
+        if not getattr(lib, "_ptpu_has_kvpool", False):
+            raise RuntimeError(
+                "paged KV needs the r12 ABI (stale "
+                "_native_predictor.so: delete it and re-import)")
+        self._lib = lib
+        self._err = ctypes.create_string_buffer(512)
+        pc = -1 if prefix_cache is None else (1 if prefix_cache else 0)
+        self._h = lib.ptpu_kvpool_create(pool_tokens, page_tokens,
+                                         max_sessions, pc, self._err,
+                                         512)
+        if not self._h:
+            raise RuntimeError("kvpool_create: " +
+                               self._err.value.decode())
+
+    def _handle(self):
+        if not getattr(self, "_h", None):
+            raise RuntimeError("KvPool is closed")
+        return self._h
+
+    def open(self) -> int:
+        return int(self._lib.ptpu_kvpool_open(self._handle()))
+
+    def fork(self, sid: int) -> int:
+        """Clone ``sid`` sharing every page group copy-on-write;
+        returns the new session id (-1 when full/closed)."""
+        return int(self._lib.ptpu_kvpool_fork(self._handle(), sid))
+
+    def close_session(self, sid: int) -> None:
+        self._lib.ptpu_kvpool_close(self._handle(), sid)
+
+    def len(self, sid: int) -> int:
+        return int(self._lib.ptpu_kvpool_len(self._handle(), sid))
+
+    def adopt(self, sid: int, tokens) -> int:
+        """Adopt published prefix pages matching ``tokens`` into a
+        page-aligned session; returns tokens adopted (never the final
+        token — its logits must come from a step)."""
+        import numpy as np
+        c = ctypes
+        t = np.ascontiguousarray(tokens, np.int64)
+        return int(self._lib.ptpu_kvpool_adopt(
+            self._handle(), sid,
+            t.ctypes.data_as(c.POINTER(c.c_int64)), t.size))
+
+    def publish(self, sid: int, tokens) -> None:
+        """Publish the full prompt pages of ``sid`` (``tokens`` is the
+        prompt) into the prefix cache for later adoption."""
+        import numpy as np
+        c = ctypes
+        t = np.ascontiguousarray(tokens, np.int64)
+        self._lib.ptpu_kvpool_publish(
+            self._handle(), sid,
+            t.ctypes.data_as(c.POINTER(c.c_int64)), t.size)
+
+    def stats(self) -> dict:
+        import json
+        return json.loads(
+            self._lib.ptpu_kvpool_stats_json(self._handle()).decode())
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.ptpu_kvpool_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:   # interpreter teardown
+            pass
 
 
 def serving_available() -> bool:
@@ -1006,6 +1152,11 @@ ABI_SYMBOLS = {
         "ptpu_predictor_kv_plan", "ptpu_predictor_kv_sessions",
         "ptpu_predictor_kv_open", "ptpu_predictor_kv_close",
         "ptpu_predictor_kv_len", "ptpu_predictor_decode_step",
+        "ptpu_kvpool_create", "ptpu_kvpool_destroy",
+        "ptpu_predictor_kv_attach", "ptpu_predictor_kv_direct",
+        "ptpu_kvpool_open", "ptpu_kvpool_fork", "ptpu_kvpool_close",
+        "ptpu_kvpool_len", "ptpu_kvpool_adopt", "ptpu_kvpool_publish",
+        "ptpu_kvpool_stats_json",
         "ptpu_serving_start", "ptpu_serving_start2",
         "ptpu_serving_start3", "ptpu_serving_port",
         "ptpu_serving_http_port", "ptpu_serving_drain_begin",

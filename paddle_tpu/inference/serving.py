@@ -47,6 +47,18 @@ TAG_DECODE_SESS = 0x66
 TAG_DECODE_STEP = 0x67
 TAG_DECODE_REP = 0x68
 TAG_DECODE_CLOSE = 0x69
+# Paged-engine ops (r12) — csrc/ptpu_serving.cc kTagDecodeOpen2/
+# OpenRep/Fork twins. Layouts (payload offsets): OPEN2 [ver][tag]
+# [u64 req_id][u32 n_tokens @10][u32 flags=0 @14][n x i64 @18] — the
+# server adopts cached prefix pages, chunk-prefills the rest through
+# the decode batcher, and answers OPEN_REP [ver][tag][u64 req_id]
+# [u64 session][u32 adopted @18][u32 n_logits @22][f32 x n @26] with
+# the LAST prompt token's logits. FORK [ver][tag][u64 req_id]
+# [u64 session] clones a session copy-on-write -> SESS echo of the
+# NEW id. (+8 on every offset past [ver][tag] for traced v2 frames.)
+TAG_DECODE_OPEN2 = 0x6a
+TAG_DECODE_OPEN_REP = 0x6b
+TAG_DECODE_FORK = 0x6c
 
 # Traced frames (ISSUE 10): version 2 inserts a client-generated
 # [u64-LE trace id] between [ver][tag] and the v1 body; REP frames for
@@ -231,8 +243,20 @@ def create_server(model_path: str, **kwargs) -> InferenceServer:
     `deadline_us`, `instances`, `threads_per_instance` (0 = split host
     cores evenly), `loopback_only`, `decode_model` (path of a KV
     decode-step artifact from models.gpt.export_gpt_decode — enables
-    the DECODE wire ops), `kv_sessions` (KV slots for decode; 0 =
-    $PTPU_KV_SESSIONS, default 64)."""
+    the DECODE wire ops), `kv_sessions` (max concurrent decode
+    sessions; 0 = $PTPU_KV_SESSIONS, default 4096 paged / 64 legacy).
+
+    The decode plane defaults to the PAGED generation engine (r12):
+    sessions draw fixed-size pages from one shared pool (RAM scales
+    with tokens held, not sessions x max-context), prompts sent via
+    ``client.decode_open(prompt=...)`` are chunk-prefilled server-side
+    and served from the prefix cache, and steps batch onto a
+    {1,2,4,...,B} bucket ladder. Env knobs: ``PTPU_KV_PAGE``
+    (tokens/page, 16), ``PTPU_KV_POOL_TOKENS`` (pool size; default
+    64 x context, or kv_sessions x context when kv_sessions is
+    explicit), ``PTPU_KV_PREFIX`` (prefix cache on/off),
+    ``PTPU_PREFILL_CHUNK`` (tokens admitted per session per chunk),
+    ``PTPU_KV_PAGED=0`` (the r9 fixed-slot engine)."""
     return InferenceServer(model_path, **kwargs)
 
 
@@ -468,14 +492,120 @@ class InferenceClient:
                 f"unexpected decode reply tag {f[1]:#x}")
         return f
 
-    def decode_open(self) -> int:
-        """Open a server-side KV decode session; returns its id.
-        Raises ServingError when the server has no decode plane or no
-        free slot (after LRU eviction failed)."""
+    def decode_open(self, prompt: Optional[Sequence[int]] = None,
+                    timeout: Optional[float] = None):
+        """Open a server-side KV decode session.
+
+        Without ``prompt`` (the r9 form) returns the session id; the
+        caller feeds tokens one ``decode_step`` at a time. With
+        ``prompt`` (r12, DECODE_OPEN2) the SERVER prefills the whole
+        prompt — adopting shared prefix pages from the prompt cache,
+        then chunk-prefilling the rest interleaved with running decode
+        steps — and returns ``(session, logits, adopted)``: the last
+        prompt token's next-token logits plus how many leading tokens
+        were satisfied from the prefix cache. ``timeout`` temporarily
+        widens the socket timeout (long prompts queue behind live
+        decode traffic by design)."""
         rid = self._next_id
         self._next_id += 1
-        self._send_frame(bytes([WIRE_VERSION, TAG_DECODE_OPEN]) +
-                         _U64.pack(rid))
+        if prompt is None:
+            self._send_frame(bytes([WIRE_VERSION, TAG_DECODE_OPEN]) +
+                             _U64.pack(rid))
+            f = self._decode_reply_expect(TAG_DECODE_SESS, rid)
+            return _U64.unpack_from(f, 10 + _frame_base(f))[0]
+        toks = np.ascontiguousarray(prompt, np.int64)
+        if toks.ndim != 1 or toks.size < 1:
+            raise ValueError("decode_open: prompt must be a non-empty "
+                             "1-D token sequence")
+        payload = (bytes([WIRE_VERSION, TAG_DECODE_OPEN2]) +
+                   _U64.pack(rid) + _U32.pack(toks.size) +
+                   _U32.pack(0) + toks.tobytes())
+        old_to = self._sock.gettimeout()
+        if timeout is not None:
+            self._sock.settimeout(timeout)
+        try:
+            self._send_frame(payload)
+            f = self._decode_reply_expect(TAG_DECODE_OPEN_REP, rid)
+        finally:
+            if timeout is not None:
+                self._sock.settimeout(old_to)
+        base = _frame_base(f)
+        sess = _U64.unpack_from(f, 10 + base)[0]
+        (adopted,) = _U32.unpack_from(f, 18 + base)
+        (n,) = _U32.unpack_from(f, 22 + base)
+        logits = np.frombuffer(f, np.float32, n, 26 + base).copy()
+        return sess, logits, int(adopted)
+
+    def decode_open_many(self, prompts, timeout: Optional[float] = None,
+                         return_exceptions: bool = False):
+        """Pipelined ``decode_open(prompt=...)``: all OPEN2 frames are
+        written before replies are drained, so the server prefills the
+        prompts CONCURRENTLY (chunked through the decode batcher,
+        shared prefixes adopted from the prompt cache). Returns
+        ``[(session, logits, adopted), ...]`` in input order.
+        Server-side errors (session pressure, pool exhaustion) drain
+        like ``infer_many``: every in-flight reply is consumed before
+        the first error raises (the stream stays usable), or — with
+        ``return_exceptions`` — surfaces as a per-entry
+        :class:`ServingError`."""
+        pending = {}
+        old_to = self._sock.gettimeout()
+        if timeout is not None:
+            self._sock.settimeout(timeout)
+        try:
+            for i, prompt in enumerate(prompts):
+                toks = np.ascontiguousarray(prompt, np.int64)
+                if toks.ndim != 1 or toks.size < 1:
+                    raise ValueError("decode_open_many: each prompt "
+                                     "must be a non-empty 1-D "
+                                     "sequence")
+                rid = self._next_id
+                self._next_id += 1
+                pending[rid] = i
+                self._send_frame(
+                    bytes([WIRE_VERSION, TAG_DECODE_OPEN2]) +
+                    _U64.pack(rid) + _U32.pack(toks.size) +
+                    _U32.pack(0) + toks.tobytes())
+            results = [None] * len(pending)
+            while pending:
+                f = self._read_frame()
+                base = _frame_base(f)
+                got = _U64.unpack_from(f, 2 + base)[0]
+                if got not in pending:
+                    raise ConnectionError(
+                        f"unexpected open reply id {got}")
+                i = pending.pop(got)
+                if f[1] == TAG_INFER_ERR:
+                    (mlen,) = _U32.unpack_from(f, 10 + base)
+                    results[i] = ServingError(
+                        f[14 + base:14 + base + mlen].decode())
+                    continue
+                if f[1] != TAG_DECODE_OPEN_REP:
+                    raise ConnectionError(
+                        f"unexpected open reply tag {f[1]:#x}")
+                sess = _U64.unpack_from(f, 10 + base)[0]
+                (adopted,) = _U32.unpack_from(f, 18 + base)
+                (n,) = _U32.unpack_from(f, 22 + base)
+                logits = np.frombuffer(f, np.float32, n,
+                                       26 + base).copy()
+                results[i] = (sess, logits, int(adopted))
+            if not return_exceptions:
+                for r in results:
+                    if isinstance(r, ServingError):
+                        raise r
+            return results
+        finally:
+            if timeout is not None:
+                self._sock.settimeout(old_to)
+
+    def decode_fork(self, session: int) -> int:
+        """Clone a live session copy-on-write (shared KV pages until
+        divergence) — parallel sampling from one prefix. Returns the
+        NEW session id."""
+        rid = self._next_id
+        self._next_id += 1
+        self._send_frame(bytes([WIRE_VERSION, TAG_DECODE_FORK]) +
+                         _U64.pack(rid) + _U64.pack(session))
         f = self._decode_reply_expect(TAG_DECODE_SESS, rid)
         return _U64.unpack_from(f, 10 + _frame_base(f))[0]
 

@@ -53,8 +53,12 @@ class GPTConfig:
 
 
 def gpt_tiny(**kw) -> GPTConfig:
-    return GPTConfig(vocab_size=512, hidden_size=64, num_layers=4,
-                     num_heads=4, max_position_embeddings=128, **kw)
+    # preset values are DEFAULTS: callers may override any of them
+    # (e.g. max_position_embeddings for long-context decode exports)
+    d = dict(vocab_size=512, hidden_size=64, num_layers=4,
+             num_heads=4, max_position_embeddings=128)
+    d.update(kw)
+    return GPTConfig(**d)
 
 
 def gpt_345m(**kw) -> GPTConfig:

@@ -1,12 +1,15 @@
-"""decode_bench `--out` persistence contract (ISSUE r9 satellite;
-pattern of tests/test_serving_bench_persist.py).
+"""decode_bench `--out` persistence contract (ISSUE r9 satellite,
+schema extended for the r12 paged engine; pattern of
+tests/test_serving_bench_persist.py).
 
-Runs `tools/decode_bench.py` as a subprocess with a shrunken config
-(2 sessions, 6 tokens, context 16, decode batch 2), asserts the
-persisted JSON schema, the parity row, and the server-vs-client decode
-counter exactness. The >= 5x tokens/s acceptance is NOT asserted here —
-a 2-session smoke config cannot amortize the per-step wire round trip
-the way the committed BENCH_DECODE run does.
+Runs `tools/decode_bench.py --smoke` as a subprocess with a shrunken
+config (2 sessions, 6 tokens, context 32, decode batch 2, a 12-session
+ramp, a 4-open prefix A/B), asserts the persisted JSON schema, the
+parity rows — including the NEW exact paged-vs-fixed gate — the
+server-vs-client decode counter exactness, and the ramp/prefix
+measurement columns (sessions held, per-session KV bytes, peak RSS).
+Throughput gates are NOT asserted: a smoke config cannot amortize the
+per-step wire round trip the way the committed BENCH_DECODE run does.
 """
 import json
 import os
@@ -29,14 +32,16 @@ def bench_out(tmp_path_factory):
     })
     env.pop("XLA_FLAGS", None)
     r = subprocess.run(
-        [sys.executable, BENCH, "--out", out, "--sessions", "2",
-         "--tokens", "6", "--context", "16", "--batch", "2"],
+        [sys.executable, BENCH, "--out", out, "--smoke",
+         "--sessions", "2", "--tokens", "6", "--context", "32",
+         "--batch", "2", "--ramp-sessions", "12", "--ramp-context",
+         "64", "--ramp-batch", "4", "--ramp-rounds", "2",
+         "--ramp-fixed-sessions", "4", "--prefix-opens", "4",
+         "--prefix-prompt", "24"],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
-    # the smoke config may legitimately miss the 5x throughput gate
-    # (the script exits nonzero then) — parity/counters must still hold
+    assert r.returncode == 0, r.stderr[-2000:]
     with open(out) as f:
         data = json.load(f)
-    data["_rc"] = r.returncode
     data["_stderr"] = r.stderr[-2000:]
     return data
 
@@ -45,12 +50,15 @@ class TestDecodeBenchPersist:
     def test_schema(self, bench_out):
         assert bench_out["bench"] == "decode_bench"
         cfg = bench_out["config"]
-        assert cfg == {"sessions": 2, "tokens": 6, "context": 16,
-                       "batch": 2}
+        assert cfg["sessions"] == 2 and cfg["batch"] == 2
+        assert cfg["ramp_sessions"] == 12 and cfg["smoke"] is True
         rows = bench_out["measurements"]
         metrics = {r["metric"] for r in rows}
         assert {"recompute_tokens_per_s", "kv_decode_tokens_per_s",
                 "decode_counters_exact", "decode_parity",
+                "decode_parity_exact_paged_vs_fixed",
+                "ramp_fixed_engine", "ramp_paged_engine",
+                "ramp_paged_over_fixed_equal_ram", "prefix_cache_ab",
                 "decode_kv_speedup_vs_recompute"} <= metrics
 
     def test_counters_exact(self, bench_out):
@@ -61,10 +69,37 @@ class TestDecodeBenchPersist:
         assert row["server"]["replies"] == row["client_steps"]
         assert row["server"]["evictions"] == 0
 
-    def test_parity(self, bench_out):
+    def test_parity_rows(self, bench_out):
         by = {r["metric"]: r for r in bench_out["measurements"]}
         assert by["decode_parity"]["value"] is True, \
             bench_out["_stderr"]
+        assert by["decode_parity_exact_paged_vs_fixed"]["value"] \
+            is True, bench_out["_stderr"]
+
+    def test_ramp_memory_columns(self, bench_out):
+        by = {r["metric"]: r for r in bench_out["measurements"]}
+        paged = by["ramp_paged_engine"]
+        fixed = by["ramp_fixed_engine"]
+        # all sessions held concurrently, each costing a bounded
+        # number of KV bytes, inside the fixed engine's RAM budget
+        assert paged["sessions_held"] == 12
+        assert fixed["sessions_held"] == 4
+        assert 0 < paged["per_session_kv_bytes"] < \
+            fixed["per_session_kv_bytes"]
+        assert paged["kv_ram_mb"] <= paged["kv_ram_budget_mb"] * 1.01
+        assert paged["pool"]["pages_in_use"] > 0
+        assert paged["pool"]["prefix_hits"] > 0
+        gate = by["ramp_paged_over_fixed_equal_ram"]
+        assert gate["peak_rss_mb"] > 0
+        assert isinstance(gate["within_gate"], bool)
+
+    def test_prefix_ab_row(self, bench_out):
+        by = {r["metric"]: r for r in bench_out["measurements"]}
+        ab = by["prefix_cache_ab"]
+        # even at smoke scale the shared prompt must adopt pages and
+        # open faster than distinct prompts
+        assert ab["adopted_tokens_shared"] > 0
+        assert ab["shared_open_s"] < ab["distinct_open_s"]
 
     def test_throughputs_positive_and_gate_row(self, bench_out):
         by = {r["metric"]: r for r in bench_out["measurements"]}

@@ -291,3 +291,191 @@ class TestDynamicShapeFallback:
             assert p.dynamic_fallbacks == 1
             p.stats_reset()
             assert p.stats()["dynamic_shape_fallback"] == 0
+
+
+@pytest.fixture(scope="module")
+def decode_artifacts(built, tmp_path_factory):
+    """GPT-tiny decode artifact (batch 8, context 48) + its full-seq
+    twin — the ISSUE r12 paged-engine fixture set."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import (GPTForPretraining,
+                                       export_gpt_decode, gpt_tiny)
+
+    pt.seed(0)
+    cfg = gpt_tiny(dtype=jnp.float32, dropout=0.0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    d = tmp_path_factory.mktemp("dec")
+    dec = export_gpt_decode(model, str(d / "dec"), batch=8, context=48)
+    return dec, cfg
+
+
+class TestPagedDecode:
+    """ISSUE r12: paged-KV continuous-batching generation engine —
+    Python-chain twins of csrc/ptpu_serving_selftest.cc's paged legs
+    (the C side drives the hand-rolled running-sum artifact; here the
+    REAL GPT export exercises the PtpuPagedAttention direct path)."""
+
+    def test_paged_meta_ladder_and_exact_parity(self, decode_artifacts,
+                                                mlp_artifact):
+        """The decode plane defaults to the paged engine with a full
+        step-bucket ladder, the attention graph rewrites onto the
+        block-table read path, and served logits are EXACTLY the
+        unpaged (r9 kv_plan) engine's at the same step batch."""
+        from paddle_tpu import inference
+        from paddle_tpu.core.native import NativePredictor
+
+        dec, _ = decode_artifacts
+        srv = inference.create_server(mlp_artifact, max_batch=2,
+                                      instances=1, decode_model=dec)
+        try:
+            meta = srv.config()["decode"]
+            assert meta["paged"] == 1
+            assert meta["direct"] == 1
+            assert meta["step_buckets"] == [1, 2, 4, 8]
+            cli = srv.client()
+            toks = list(range(3, 23))
+            # single-session steps run on bucket 1: reference is the
+            # unpaged engine at batch_override=1
+            sess = cli.decode_open()
+            got = [np.asarray(cli.decode_step(sess, t)) for t in toks]
+            with NativePredictor(dec, batch_override=1) as ref:
+                ref.kv_plan(2)
+                rs = ref.kv_open()
+                want = [ref.decode_step([rs], [t]).copy()[0]
+                        for t in toks]
+            for g, w in zip(got, want):
+                assert np.array_equal(g, w)
+            cli.decode_close(sess)
+            cli.close()
+        finally:
+            srv.stop()
+
+    def test_open2_prefill_prefix_cache_and_fork(self, decode_artifacts,
+                                                 mlp_artifact):
+        """OPEN2 server-side prefill equals client-driven stepping;
+        a repeated prompt adopts full pages from the prefix cache and
+        measurably skips prefill compute; fork clones a session
+        copy-on-write."""
+        from paddle_tpu import inference
+
+        dec, _ = decode_artifacts
+        srv = inference.create_server(mlp_artifact, max_batch=2,
+                                      instances=1, decode_model=dec)
+        try:
+            cli = srv.client()
+            prompt = list(range(5, 41))   # 36 tokens = 2 full pages +
+            s1, lg1, ad1 = cli.decode_open(prompt=prompt)
+            assert ad1 == 0
+            # teacher-forced reference: old-style open + steps
+            s2 = cli.decode_open()
+            for t in prompt:
+                ref = cli.decode_step(s2, t)
+            assert np.array_equal(lg1, np.asarray(ref))
+            # warm open: two full 16-token pages adopted, same logits
+            s3, lg3, ad3 = cli.decode_open(prompt=prompt)
+            assert ad3 == 32
+            assert np.array_equal(lg3, lg1)
+            st = srv.stats()["decode"]
+            assert st["prefills"] == 2
+            assert st["prefill_adopted"] == 32
+            assert st["pool"]["prefix_hits"] == 2
+            assert st["pool"]["pages_in_use"] > 0
+            assert st["pool"]["pages_total"] >= st["pool"]["pages_in_use"]
+            # fork: same token steps to identical logits, then the
+            # histories diverge independently (COW)
+            f1 = cli.decode_fork(s1)
+            a = cli.decode_step(s1, 7)
+            b = cli.decode_step(f1, 7)
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+            a2 = cli.decode_step(s1, 9)
+            b2 = cli.decode_step(f1, 11)
+            assert not np.array_equal(np.asarray(a2), np.asarray(b2))
+            assert srv.stats()["decode"]["pool"]["cow_copies"] >= 1
+            for s in (s1, s2, s3, f1):
+                cli.decode_close(s)
+            cli.close()
+        finally:
+            srv.stop()
+
+    def test_pool_exhaustion_backpressure_and_eviction(
+            self, decode_artifacts, mlp_artifact):
+        """A full pool answers steps with a soft retryable error (the
+        session survives); closing another session reclaims pages and
+        unblocks it. Session eviction tombstones answer 'evicted'."""
+        from paddle_tpu import inference
+        from paddle_tpu.inference.serving import ServingError
+
+        dec, _ = decode_artifacts
+        os.environ["PTPU_KV_POOL_TOKENS"] = "64"   # 4 pages of 16
+        os.environ["PTPU_KV_SESSIONS"] = "3"
+        try:
+            srv = inference.create_server(mlp_artifact, max_batch=2,
+                                          instances=1, decode_model=dec)
+        finally:
+            del os.environ["PTPU_KV_POOL_TOKENS"]
+            del os.environ["PTPU_KV_SESSIONS"]
+        try:
+            cli = srv.client()
+            # two sessions fill all four pages (2 x 17 tokens)
+            sa = cli.decode_open()
+            sb = cli.decode_open()
+            for t in range(17):
+                cli.decode_step(sa, t)
+                cli.decode_step(sb, t)
+            # sa to a page boundary (len 32): its next step needs a
+            # 5th page the 4-page pool cannot provide
+            for t in range(15):
+                cli.decode_step(sa, t)
+            with pytest.raises(ServingError, match="kv pool exhausted"):
+                cli.decode_step(sa, 99)
+            assert srv.stats()["decode"]["pool_exhausted"] >= 1
+            # reclaim: closing sb frees its pages; sa proceeds
+            cli.decode_close(sb)
+            cli.decode_step(sa, 99)
+            # eviction at the session cap: sa is LRU after sc opens
+            sc = cli.decode_open()
+            sd = cli.decode_open()
+            se = cli.decode_open()   # 4th live -> evicts LRU (sa)
+            assert srv.stats()["decode"]["evictions"] == 1
+            with pytest.raises(ServingError, match="evicted"):
+                cli.decode_step(sa, 1)
+            # the evicted session's pages returned to the pool
+            cli.decode_step(sc, 1)
+            for s in (sc, sd, se):
+                cli.decode_close(s)
+            cli.close()
+        finally:
+            srv.stop()
+
+    def test_legacy_fixed_slot_engine_env_fallback(
+            self, decode_artifacts, mlp_artifact):
+        """PTPU_KV_PAGED=0 keeps the r9 fixed-slot engine: no pool in
+        the stats, single step bucket, old wire ops still exact."""
+        from paddle_tpu import inference
+        from paddle_tpu.inference.serving import ServingError
+
+        dec, _ = decode_artifacts
+        os.environ["PTPU_KV_PAGED"] = "0"
+        try:
+            srv = inference.create_server(mlp_artifact, max_batch=2,
+                                          instances=1, decode_model=dec,
+                                          kv_sessions=4)
+        finally:
+            del os.environ["PTPU_KV_PAGED"]
+        try:
+            meta = srv.config()["decode"]
+            assert meta["paged"] == 0
+            assert meta["step_buckets"] == [8]
+            cli = srv.client()
+            s = cli.decode_open()
+            lg = cli.decode_step(s, 5)
+            assert np.asarray(lg).size > 0
+            assert "pool" not in srv.stats()["decode"]
+            # the paged-only ops degrade with a clear error
+            with pytest.raises(ServingError, match="paged KV engine"):
+                cli.decode_fork(s)
+            cli.decode_close(s)
+            cli.close()
+        finally:
+            srv.stop()

@@ -489,9 +489,9 @@ class TestFuzzChecker:
         _mutate(root, "csrc/ptpu_serving.cc",
                 "constexpr uint8_t kTagDecodeClose = 0x69;",
                 "constexpr uint8_t kTagDecodeClose = 0x69;\n"
-                "constexpr uint8_t kTagDecodeFork = 0x6a;")
+                "constexpr uint8_t kTagDecodeSpec = 0x7e;")
         msgs = [f.message for f in _run(root, "fuzz")]
-        assert any("kTagDecodeFork" in m and "no corpus frame" in m
+        assert any("kTagDecodeSpec" in m and "no corpus frame" in m
                    for m in msgs)
 
     def test_catches_new_http_route_without_seed(self, tmp_path):

