@@ -1,8 +1,9 @@
 // Fuzz target: the serving wire-frame parser — SvServer::OnFrame in
 // csrc/ptpu_serving.cc: v1 + traced-v2 INFER_REQ (per-input
-// dtype/ndim/dims/raw walk), META, and the DECODE 0x65..0x69 ops,
-// through the real micro-batcher, bucket-ladder predictor run,
-// row-wise de-mux, and the KV session registry. Everything after the
+// dtype/ndim/dims/raw walk), META, and the DECODE 0x65..0x6f ops
+// (incl. the r13 speculative OPEN/STEP), through the real
+// micro-batcher, bucket-ladder predictor run, draft/verify spec
+// rounds, row-wise de-mux, and the KV session registry. Everything after the
 // HMAC handshake is attacker-bytes; this is the full post-auth
 // surface of the inference server.
 //
@@ -82,6 +83,44 @@ std::string build_decode_model() {
   return m;
 }
 
+// Width-2 sibling (the speculative VERIFY shape, kv_width == 2): per-
+// window running sums via a lower-triangular cumsum matmul — same
+// artifact the serving selftest's spec leg drives. Enabling the spec
+// planes puts the whole DECODE_SPEC round machinery (draft bursts,
+// width-2 verify, kv_trim rollback) behind the fuzzed parser.
+std::string build_decode_model_w2() {
+  std::string g;
+  put_lenf(&g, 1, onnx_node_iattr("Cast", {"ids"}, {"idsf"}, "to", 1));
+  put_lenf(&g, 1, onnx_node("Reshape", {"idsf", "sh_nk"}, {"nk"}));
+  put_lenf(&g, 1, onnx_node("Mul", {"nk", "two"}, {"nv"}));
+  put_lenf(&g, 1, onnx_node("MatMul", {"idsf", "tri"}, {"cum"}));
+  put_lenf(&g, 1, onnx_node("ReduceSum", {"k0", "axes"}, {"ksum"}));
+  put_lenf(&g, 1, onnx_node("Reshape", {"ksum", "sh_y"}, {"ksum2"}));
+  put_lenf(&g, 1, onnx_node_iattr("Cast", {"pos"}, {"posf"}, "to", 1));
+  put_lenf(&g, 1, onnx_node("Reshape", {"posf", "sh_y"}, {"posr"}));
+  put_lenf(&g, 1, onnx_node("Mul", {"posr", "zero"}, {"pos0"}));
+  put_lenf(&g, 1, onnx_node("Add", {"cum", "ksum2"}, {"t1"}));
+  put_lenf(&g, 1, onnx_node("Add", {"t1", "pos0"}, {"y"}));
+  put_lenf(&g, 5, onnx_tensor_i64("sh_nk", {4}, {2, 2, 1, 1}));
+  put_lenf(&g, 5, onnx_tensor_i64("sh_y", {2}, {2, 1}));
+  put_lenf(&g, 5, onnx_tensor_i64("axes", {3}, {1, 2, 3}));
+  const float triv[4] = {1.f, 1.f, 0.f, 1.f};
+  put_lenf(&g, 5, onnx_tensor_f32("tri", {2, 2}, triv, 4));
+  const float twov = 2.f, zerov = 0.f;
+  put_lenf(&g, 5, onnx_tensor_f32("two", {}, &twov, 1));
+  put_lenf(&g, 5, onnx_tensor_f32("zero", {}, &zerov, 1));
+  put_lenf(&g, 11, onnx_value_info("ids", 7, {2, 2}));
+  put_lenf(&g, 11, onnx_value_info("pos", 7, {2}));
+  put_lenf(&g, 11, onnx_value_info("k0", 1, {2, 4, 1, 1}));
+  put_lenf(&g, 11, onnx_value_info("v0", 1, {2, 4, 1, 1}));
+  put_lenf(&g, 12, onnx_value_info("y", 1, {2, 2}));
+  put_lenf(&g, 12, onnx_value_info("nk", 1, {2, 2, 1, 1}));
+  put_lenf(&g, 12, onnx_value_info("nv", 1, {2, 2, 1, 1}));
+  std::string m;
+  put_lenf(&m, 7, g);
+  return m;
+}
+
 std::string write_tmp(const std::string& bytes, const char* name) {
   std::string path = std::string("/tmp/") + name;
   std::FILE* f = std::fopen(path.c_str(), "wb");
@@ -104,11 +143,15 @@ void InitOnce() {
       write_tmp(build_matmul_model(), "ptpu_fuzz_serving.onnx");
   const std::string dp =
       write_tmp(build_decode_model(), "ptpu_fuzz_decode.onnx");
+  const std::string vp =
+      write_tmp(build_decode_model_w2(), "ptpu_fuzz_verify.onnx");
   char err[512] = {0};
-  g_srv = static_cast<SvServer*>(ptpu_serving_start2(
-      mp.c_str(), dp.c_str(), /*port=*/0, "fz", 2, /*max_batch=*/4,
+  g_srv = static_cast<SvServer*>(ptpu_serving_start4(
+      mp.c_str(), dp.c_str(), /*spec_draft=*/dp.c_str(),
+      /*spec_verify=*/vp.c_str(), /*port=*/0, "fz", 2, /*max_batch=*/4,
       /*deadline_us=*/200, /*instances=*/1, /*threads=*/1,
-      /*loopback_only=*/1, /*kv_sessions=*/4, err, sizeof(err)));
+      /*loopback_only=*/1, /*kv_sessions=*/4, /*http_port=*/-1, err,
+      sizeof(err)));
   if (!g_srv) {
     std::fprintf(stderr, "fuzz_wire_serving: start failed: %s\n", err);
     std::abort();

@@ -266,6 +266,34 @@ def main():
     w("wire_serving", "seed-decode-fork.bin", sv_plain(0x6c, 26, 1))
     w("wire_serving", "seed-decode-fork-v2.bin",
       sv_plain(0x6c, 27, 999999, ver=2, tid=6))
+    # speculative-decoding ops (r13): SPEC_OPEN carries
+    # [u32 n][u32 flags][u64 seed][n x i64]; SPEC_STEP is
+    # [u64 rid][u64 session]
+    def sv_spec_open(rid, toks, flags=0, seed=0, ver=1, tid=None,
+                     trunc=None):
+        f = bytes([ver, 0x6d])
+        if tid is not None:
+            f += struct.pack("<Q", tid)
+        f += struct.pack("<QIIQ", rid, len(toks), flags, seed)
+        f += struct.pack(f"<{len(toks)}q", *toks)
+        return f if trunc is None else f[:trunc]
+    w("wire_serving", "seed-spec-open.bin",
+      sv_spec_open(31, (5, 6, 7), seed=11))
+    w("wire_serving", "seed-spec-open-v2.bin",
+      sv_spec_open(32, (5, 6), flags=1, seed=12, ver=2, tid=9))
+    w("wire_serving", "seed-spec-open-trunc.bin",
+      sv_spec_open(33, (5, 6, 7, 8), trunc=30))
+    w("wire_serving", "seed-spec-open-huge-n.bin",
+      bytes([1, 0x6d]) + struct.pack("<QIIQ", 34, 0xFFFFFFFF, 0, 0))
+    w("wire_serving", "seed-spec-open-bad-flags.bin",
+      sv_spec_open(35, (5,), flags=0xFF))
+    w("wire_serving", "seed-spec-step.bin", sv_plain(0x6e, 36, 1))
+    w("wire_serving", "seed-spec-step-v2.bin",
+      sv_plain(0x6e, 37, 999999, ver=2, tid=10))
+    # reply-direction tag as request: rejected
+    w("wire_serving", "seed-tag-spec-rep.bin",
+      bytes([1, 0x6f]) + struct.pack("<QQII", 1, 2, 0, 1) +
+      struct.pack("<q", 0))
     # reply-direction tag as request: rejected
     w("wire_serving", "seed-tag-decode-open-rep.bin",
       bytes([1, 0x6b]) + struct.pack("<QQII", 1, 2, 0, 1) +

@@ -108,6 +108,18 @@ int ptpu_predictor_kv_sessions(PTPU_Predictor*);
 int ptpu_predictor_kv_open(PTPU_Predictor*);
 void ptpu_predictor_kv_close(PTPU_Predictor*, int sid);
 int64_t ptpu_predictor_kv_len(PTPU_Predictor*, int sid);
+/* Step width W baked into the artifact's ids input [B, W] (1 for the
+ * classic autoregressive step, k+1 for a speculative-verify export);
+ * 0 before kv_plan/kv_attach. decode_step then consumes W tokens per
+ * row (tokens[r*W .. r*W+W-1]) and appends W positions per session. */
+int ptpu_predictor_kv_width(PTPU_Predictor*);
+/* Truncate a session to new_len positions (speculative rollback).
+ * Paged sessions release page groups past the new tail — shared
+ * groups are unreferenced, never mutated, so published prefix pages
+ * and fork siblings keep their bytes; the next append COW-unshares
+ * the kept tail. No-op when new_len >= len. */
+int ptpu_predictor_kv_trim(PTPU_Predictor*, int sid, int64_t new_len,
+                           char* err, int err_len);
 int ptpu_predictor_decode_step(PTPU_Predictor*, const int64_t* sids,
                                const int64_t* tokens, int n, char* err,
                                int err_len);
@@ -143,6 +155,7 @@ int64_t ptpu_kvpool_adopt(PTPU_KvPool*, int sid, const int64_t* tokens,
                           int64_t n);
 int ptpu_kvpool_publish(PTPU_KvPool*, int sid, const int64_t* tokens,
                         int64_t n);
+int ptpu_kvpool_trim(PTPU_KvPool*, int sid, int64_t new_len);
 const char* ptpu_kvpool_stats_json(PTPU_KvPool*);
 
 /* Serving stats since load (always-on): JSON {"runs","total_run_us",

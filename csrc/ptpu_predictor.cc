@@ -1938,41 +1938,69 @@ class KvPool {
            sess_[size_t(sid)].open;
   }
 
-  /* Make position `len` writable for `sid`: allocate a fresh tail
-   * group at a page boundary, or COW a shared tail. Idempotent — a
-   * batch that failed part-way retries without double-allocating.
-   * Throws "kv pool exhausted" when no group can be found (counted). */
-  void ensure_append(int sid) {
+  /* Make positions `len .. len+count-1` writable for `sid`: allocate
+   * fresh tail groups at page boundaries, and COW the current tail if
+   * it is shared (fork divergence, or a trim back into an adopted
+   * prefix page — published pages are NEVER written in place).
+   * Idempotent — a batch that failed part-way retries without
+   * double-allocating. Throws "kv pool exhausted" when no group can
+   * be found (counted). */
+  void ensure_append(int sid, int64_t count = 1) {
     ptpu::MutexLock l(mu_);
     Sess& s = sess_at(sid);
-    if (s.len >= ctx_)
+    if (count < 1) return;
+    if (s.len + count > ctx_)
       throw std::runtime_error("kvpool: session context is full");
-    const int64_t need = s.len / page_;
-    if (int64_t(s.table.size()) <= need) {
-      const int32_t gid = alloc_group();
-      s.table.push_back(gid);
-      return;
+    // COW the partially-filled shared tail we are about to write into
+    const int64_t tail_pg = s.len / page_;
+    if (s.len % page_ != 0 && int64_t(s.table.size()) > tail_pg) {
+      Group& tail = groups_[size_t(s.table[size_t(tail_pg)])];
+      if (tail.ref > 1) {
+        const int32_t ng = alloc_group();
+        std::memcpy(&pool_[size_t(ng) * size_t(group_elems_)],
+                    &pool_[size_t(s.table[size_t(tail_pg)]) *
+                           size_t(group_elems_)],
+                    size_t(group_elems_) * sizeof(float));
+        unref(s.table[size_t(tail_pg)]);
+        s.table[size_t(tail_pg)] = ng;
+        ++cow_copies_;
+      }
     }
-    Group& tail = groups_[size_t(s.table[size_t(need)])];
-    if (tail.ref > 1) {
-      // shared partial tail (fork divergence): copy before writing
-      const int32_t ng = alloc_group();
-      std::memcpy(&pool_[size_t(ng) * size_t(group_elems_)],
-                  &pool_[size_t(s.table[size_t(need)]) *
-                         size_t(group_elems_)],
-                  size_t(group_elems_) * sizeof(float));
-      unref(s.table[size_t(need)]);
-      s.table[size_t(need)] = ng;
-      ++cow_copies_;
-    }
+    const int64_t last = (s.len + count - 1) / page_;
+    while (int64_t(s.table.size()) <= last)
+      s.table.push_back(alloc_group());
   }
 
-  void advance(int sid) {
+  void advance(int sid, int64_t count = 1) {
     ptpu::MutexLock l(mu_);
     Sess& s = sess_at(sid);
-    if (s.len >= int64_t(s.table.size()) * page_)
+    if (s.len + count > int64_t(s.table.size()) * page_)
       throw std::runtime_error("kvpool: advance past allocated pages");
-    ++s.len;
+    s.len += count;
+  }
+
+  /* Truncate `sid` to `new_len` positions — the speculative-decoding
+   * rollback: rejected draft tokens' KV rows become unreadable (every
+   * read path touches positions < len only) and whole page groups
+   * past the new tail are released (or merely unreferenced when
+   * shared — a published prefix page or a fork sibling keeps its
+   * copy; the r12 refcount machinery already handles both). The kept
+   * tail group is NOT copied here: the next append COWs it via
+   * ensure_append if it is still shared. No-op when new_len >= len. */
+  void trim(int sid, int64_t new_len) {
+    ptpu::MutexLock l(mu_);
+    Sess& s = sess_at(sid);
+    if (new_len < 0)
+      throw std::runtime_error("kvpool: trim to negative length");
+    if (new_len >= s.len) return;
+    const int64_t keep =
+        new_len == 0 ? 0 : (new_len - 1) / page_ + 1;
+    while (int64_t(s.table.size()) > keep) {
+      unref(s.table.back());
+      s.table.pop_back();
+    }
+    s.len = new_len;
+    ++trims_;
   }
 
   /* Write address of (sid, layer, k|v, pos) — pos must be covered by
@@ -2131,6 +2159,8 @@ class KvPool {
     out += ",";
     ptpu::AppendJsonU64(&out, "forks", forks_);
     out += ",";
+    ptpu::AppendJsonU64(&out, "trims", trims_);
+    out += ",";
     ptpu::AppendJsonU64(&out, "pool_exhausted", exhausted_);
     out += "}";
     return out;
@@ -2241,6 +2271,7 @@ class KvPool {
   std::unordered_map<uint64_t, int32_t> prefix_;
   uint64_t tick_ = 0;
   uint64_t opens_ = 0, closes_ = 0, forks_ = 0, cow_copies_ = 0;
+  uint64_t trims_ = 0;
   uint64_t prefix_hits_ = 0, prefix_hit_tokens_ = 0, published_ = 0;
   uint64_t prefix_evictions_ = 0, exhausted_ = 0;
   mutable ptpu::Mutex mu_{kLockKvPool};
@@ -2337,6 +2368,7 @@ struct Predictor {
   };
   int kv_sessions_ = 0;
   int64_t kv_batch_ = 0, kv_ctx_ = 0, kv_heads_ = 0, kv_hdim_ = 0;
+  int64_t kv_width_ = 1;   // positions fed per session per step
   int kv_layers_ = 0;
   int kv_ids_dtype_ = DT_I32, kv_pos_dtype_ = DT_I32;
   std::vector<int64_t> kv_pos_dims_;
@@ -2397,7 +2429,7 @@ struct Predictor {
     kv_max_groups_ = pool->max_groups();
     kv_view_tab_.assign(size_t(kv_batch_ * kv_max_groups_), 0);
     kv_view_len_.assign(size_t(kv_batch_), -1);
-    kv_ids_stage_.assign(size_t(kv_batch_), 0);
+    kv_ids_stage_.assign(size_t(kv_batch_ * kv_width_), 0);
     kv_pos_stage_.assign(size_t(kv_batch_), 0);
     kv_out_checked_ = false;
   }
@@ -2417,6 +2449,7 @@ struct Predictor {
   void decode_step_paged(const int64_t* sids, const int64_t* tokens,
                          int n) {
     KvPool& pool = *kv_pool_;
+    const int64_t W = kv_width_;
     if (n < 1 || int64_t(n) > kv_batch_)
       throw std::runtime_error("decode_step: n outside [1, B=" +
                                std::to_string(kv_batch_) + "]");
@@ -2425,7 +2458,7 @@ struct Predictor {
       if (s < 0 || s >= pool.max_sessions() || !pool.is_open(int(s)))
         throw std::runtime_error("decode_step: session " +
                                  std::to_string(s) + " is not open");
-      if (pool.len(int(s)) >= kv_ctx_)
+      if (pool.len(int(s)) + W > kv_ctx_)
         throw std::runtime_error("decode_step: session " +
                                  std::to_string(s) +
                                  " context is full (P=" +
@@ -2436,14 +2469,16 @@ struct Predictor {
               "decode_step: duplicate session " + std::to_string(s) +
               " in one batch (steps of one session are ordered)");
     }
-    /* Make every row's append position writable BEFORE any compute:
+    /* Make every row's append window writable BEFORE any compute:
      * allocation (and COW of shared tails) throws "kv pool exhausted"
      * here, idempotently, so a partially-provisioned batch can retry
      * row-by-row without double-allocating. */
-    for (int r = 0; r < n; ++r) pool.ensure_append(int(sids[r]));
+    for (int r = 0; r < n; ++r) pool.ensure_append(int(sids[r]), W);
     const int64_t row_hd = kv_heads_ * kv_hdim_;
     for (int64_t r = 0; r < kv_batch_; ++r) {
-      kv_ids_stage_[size_t(r)] = r < n ? tokens[r] : 0;
+      for (int64_t w = 0; w < W; ++w)
+        kv_ids_stage_[size_t(r * W + w)] =
+            r < n ? tokens[r * W + w] : 0;
       kv_pos_stage_[size_t(r)] =
           r < n ? pool.len(int(sids[r])) : 0;
     }
@@ -2482,8 +2517,8 @@ struct Predictor {
     {
       Tensor t;
       t.dtype = kv_ids_dtype_;
-      t.dims = {kv_batch_, 1};
-      t.i.bind(kv_ids_stage_.data(), size_t(kv_batch_));
+      t.dims = {kv_batch_, W};
+      t.i.bind(kv_ids_stage_.data(), size_t(kv_batch_ * W));
       env[g.input_names[0]] = std::move(t);
     }
     {
@@ -2504,12 +2539,12 @@ struct Predictor {
       for (int l = 0; l < kv_layers_; ++l)
         for (int w = 0; w < 2; ++w) {
           const Tensor& t = outputs[size_t(1 + 2 * l + w)];
-          const std::vector<int64_t> want = {kv_batch_, 1, kv_heads_,
+          const std::vector<int64_t> want = {kv_batch_, W, kv_heads_,
                                              kv_hdim_};
           if (!t.is_float() || t.dims != want)
             throw std::runtime_error(
                 "decode_step: output " + std::to_string(1 + 2 * l + w) +
-                " is not a [B,1,H,D] f32 cache append");
+                " is not a [B,W,H,D] f32 cache append");
         }
       kv_out_checked_ = true;
     }
@@ -2518,12 +2553,13 @@ struct Predictor {
         const Tensor& t = outputs[size_t(1 + 2 * l + w)];
         for (int r = 0; r < n; ++r) {
           const int64_t len = pool.len(int(sids[r]));
-          std::memcpy(pool.row_ptr(int(sids[r]), l, w, len),
-                      t.f.data() + int64_t(r) * row_hd,
-                      size_t(row_hd) * sizeof(float));
+          for (int64_t q = 0; q < W; ++q)
+            std::memcpy(pool.row_ptr(int(sids[r]), l, w, len + q),
+                        t.f.data() + (int64_t(r) * W + q) * row_hd,
+                        size_t(row_hd) * sizeof(float));
         }
       }
-    for (int r = 0; r < n; ++r) pool.advance(int(sids[r]));
+    for (int r = 0; r < n; ++r) pool.advance(int(sids[r]), W);
   }
 
   int64_t kv_slot_elems() const { return kv_ctx_ * kv_heads_ * kv_hdim_; }
@@ -2555,9 +2591,11 @@ struct Predictor {
       return it == g.input_dtypes.end() ? DT_F32 : it->second;
     };
     const auto& idd = in_dims(0);
-    if (idd.size() != 2 || idd[1] != 1 || idd[0] < 1)
-      throw std::runtime_error("kv_plan: ids input must be [B, 1]");
+    if (idd.size() != 2 || idd[1] < 1 || idd[0] < 1)
+      throw std::runtime_error("kv_plan: ids input must be [B, W>=1]");
     kv_batch_ = idd[0];
+    kv_width_ = idd[1];   // tokens fed per session per step (W > 1:
+                          // the speculative-verify artifact shape)
     kv_ids_dtype_ = in_dtype(0);
     if (kv_ids_dtype_ != DT_I32 && kv_ids_dtype_ != DT_I64)
       throw std::runtime_error("kv_plan: ids input must be int32/int64");
@@ -2614,9 +2652,28 @@ struct Predictor {
                      std::vector<float>(size_t(kv_batch_) *
                                             size_t(kv_slot_elems()),
                                         0.f));
-    kv_ids_stage_.assign(size_t(kv_batch_), 0);
+    kv_ids_stage_.assign(size_t(kv_batch_ * kv_width_), 0);
     kv_pos_stage_.assign(size_t(kv_batch_), 0);
     kv_out_checked_ = false;
+  }
+
+  /* Truncate a session to `new_len` — the speculative-decoding
+   * rollback shared by both engines. Paged mode releases/unrefs page
+   * groups in the pool; slab mode just moves the length fence (the
+   * staging path re-zeroes [len, ctx) on every step, so rolled-back
+   * rows are unreadable either way). */
+  void kv_trim(int sid, int64_t new_len) {
+    if (kv_pool_) return kv_pool_->trim(sid, new_len);
+    if (kv_sessions_ == 0)
+      throw std::runtime_error(
+          "kv_trim: kv_plan()/kv_attach() not called");
+    if (sid < 0 || sid >= kv_sessions_ || !kv_sess_[size_t(sid)].open)
+      throw std::runtime_error("kv_trim: session " +
+                               std::to_string(sid) + " is not open");
+    if (new_len < 0)
+      throw std::runtime_error("kv_trim: negative length");
+    if (new_len < kv_sess_[size_t(sid)].len)
+      kv_sess_[size_t(sid)].len = new_len;
   }
 
   int kv_open() {
@@ -2643,15 +2700,17 @@ struct Predictor {
   }
 
   /* One batched decode step over n <= B sessions. Row r binds session
-   * sids[r] feeding tokens[r]; rows n..B-1 are zero padding whose
-   * outputs are discarded. Appends each real row's new k/v into its
-   * slot and advances len; logits stay readable via the normal output
-   * accessors (row r of output 0). */
+   * sids[r] feeding tokens[r*W .. r*W+W-1] (W == the artifact's step
+   * width, 1 for the classic autoregressive step); rows n..B-1 are
+   * zero padding whose outputs are discarded. Appends each real row's
+   * new k/v into its slot and advances len by W; logits stay readable
+   * via the normal output accessors (row r of output 0). */
   void decode_step(const int64_t* sids, const int64_t* tokens, int n) {
     if (kv_pool_) return decode_step_paged(sids, tokens, n);
     if (kv_sessions_ == 0)
       throw std::runtime_error(
           "decode_step: kv_plan()/kv_attach() not called");
+    const int64_t W = kv_width_;
     if (n < 1 || int64_t(n) > kv_batch_)
       throw std::runtime_error("decode_step: n outside [1, B=" +
                                std::to_string(kv_batch_) + "]");
@@ -2660,7 +2719,7 @@ struct Predictor {
       if (s < 0 || s >= kv_sessions_ || !kv_sess_[size_t(s)].open)
         throw std::runtime_error("decode_step: session " +
                                  std::to_string(s) + " is not open");
-      if (kv_sess_[size_t(s)].len >= kv_ctx_)
+      if (kv_sess_[size_t(s)].len + W > kv_ctx_)
         throw std::runtime_error("decode_step: session " +
                                  std::to_string(s) +
                                  " context is full (P=" +
@@ -2677,7 +2736,9 @@ struct Predictor {
     // session's len are masked by the graph — stale stage contents are
     // value-irrelevant and never NaN: slots zero on open)
     for (int64_t r = 0; r < kv_batch_; ++r) {
-      kv_ids_stage_[size_t(r)] = r < n ? tokens[r] : 0;
+      for (int64_t w = 0; w < W; ++w)
+        kv_ids_stage_[size_t(r * W + w)] =
+            r < n ? tokens[r * W + w] : 0;
       kv_pos_stage_[size_t(r)] =
           r < n ? kv_sess_[size_t(sids[r])].len : 0;
     }
@@ -2704,8 +2765,8 @@ struct Predictor {
     {
       Tensor t;
       t.dtype = kv_ids_dtype_;
-      t.dims = {kv_batch_, 1};
-      t.i.bind(kv_ids_stage_.data(), size_t(kv_batch_));
+      t.dims = {kv_batch_, W};
+      t.i.bind(kv_ids_stage_.data(), size_t(kv_batch_ * W));
       env[g.input_names[0]] = std::move(t);
     }
     {
@@ -2728,12 +2789,12 @@ struct Predictor {
       for (int l = 0; l < kv_layers_; ++l)
         for (int w = 0; w < 2; ++w) {
           const Tensor& t = outputs[size_t(1 + 2 * l + w)];
-          const std::vector<int64_t> want = {kv_batch_, 1, kv_heads_,
+          const std::vector<int64_t> want = {kv_batch_, W, kv_heads_,
                                              kv_hdim_};
           if (!t.is_float() || t.dims != want)
             throw std::runtime_error(
                 "decode_step: output " + std::to_string(1 + 2 * l + w) +
-                " is not a [B,1,H,D] f32 cache append");
+                " is not a [B,W,H,D] f32 cache append");
         }
       kv_out_checked_ = true;
     }
@@ -2744,11 +2805,11 @@ struct Predictor {
         for (int r = 0; r < n; ++r) {
           const int64_t len = kv_sess_[size_t(sids[r])].len;
           std::memcpy(kv_slot(int(sids[r]), l, w) + len * row_hd,
-                      t.f.data() + int64_t(r) * row_hd,
-                      size_t(row_hd) * sizeof(float));
+                      t.f.data() + int64_t(r) * W * row_hd,
+                      size_t(W * row_hd) * sizeof(float));
         }
       }
-    for (int r = 0; r < n; ++r) ++kv_sess_[size_t(sids[r])].len;
+    for (int r = 0; r < n; ++r) kv_sess_[size_t(sids[r])].len += W;
   }
 
   /* Rebuild the node -> OpStat index after the load-time rewrites
@@ -3749,7 +3810,8 @@ struct Predictor {
       al.ival = layer;
       f.attrs["ptpu_kv_layer"] = al;
       Attr ask;
-      ask.ival = kv_ctx_ + 1;  // concat key space: P cache rows + 1 new
+      // concat key space: P cache rows + the W fed-window rows
+      ask.ival = kv_ctx_ + kv_width_;
       f.attrs["ptpu_sk"] = ask;
       matched.insert(layer);
       dead[ix.producer[a.inputs[1]]] = 1;
@@ -4973,19 +5035,22 @@ void Predictor::run_node(const Node& n) {
      * bo_to_): exporters bake the trace batch into shape constants,
      * so a batch-carrying Reshape target arrives with the EXPORT
      * batch folded into one of its dims ([B,1,heads,hd] head splits,
-     * [1,B*M,K] matmul flattenings). The element count disambiguates:
-     * repair only fires when the target is off by exactly the
-     * export/override ratio, and the dim to scale is the leftmost one
-     * EQUAL to the export batch (the exporter's layouts lead with it)
-     * falling back to the leftmost divisible one. A graph this cannot
-     * carry still throws below and the serving layer drops that
-     * bucket at probe time — never silent wrong shapes. */
+     * [B*heads,W,hd] attention flattenings, [1,B*M,K] matmul
+     * flattenings). The element count disambiguates: repair only
+     * fires when the target is off by exactly the export/override
+     * ratio, and the dim to scale is the LEFTMOST one divisible by
+     * the export batch — the exporter's layouts lead with the batch
+     * (possibly folded into a product like B*heads). Preferring a
+     * later dim merely EQUAL to the batch mis-repaired width-k decode
+     * artifacts whose window width numerically equals the batch
+     * ([B, W, 3, heads, hd] with W == B scaled W instead of B). A
+     * graph the rule cannot carry still throws below and the serving
+     * layer drops that bucket at probe time — never silent wrong
+     * shapes. */
     if (concrete && wn != a.numel() && bo_from_ > 1 &&
         bo_to_ != bo_from_ && wn % bo_from_ == 0 &&
         wn / bo_from_ * bo_to_ == a.numel()) {
       int pick = -1;
-      for (size_t z = 0; pick < 0 && z < want.size(); ++z)
-        if (want[z] == bo_from_) pick = int(z);
       for (size_t z = 0; pick < 0 && z < want.size(); ++z)
         if (want[z] > 0 && want[z] % bo_from_ == 0) pick = int(z);
       if (pick >= 0) {
@@ -6794,6 +6859,35 @@ void ptpu_predictor_kv_close(PTPU_Predictor* h, int sid) {
   p->kv_close(sid);
 }
 
+// positions fed per session per decode step (the artifact's baked
+// ids width W — 1 for the classic step, k+1 for a speculative-verify
+// artifact); 0 before kv_plan/kv_attach validated the convention
+__attribute__((visibility("default")))
+int ptpu_predictor_kv_width(PTPU_Predictor* h) {
+  if (!h) return 0;
+  auto* p = (Predictor*)h;
+  if (p->kv_sessions_ == 0 && !p->kv_pool_) return 0;
+  return int(p->kv_width_);
+}
+
+/* Truncate a session to `new_len` positions — speculative-decoding
+ * rollback. Paged sessions release page groups past the new tail (a
+ * shared group is unreferenced, never mutated: published prefix pages
+ * and fork siblings keep their bytes); the next append COW-unshares
+ * the kept tail if needed. No-op when new_len >= len. */
+__attribute__((visibility("default")))
+int ptpu_predictor_kv_trim(PTPU_Predictor* h, int sid, int64_t new_len,
+                           char* err, int err_len) {
+  try {
+    if (!h) throw std::runtime_error("kv_trim: null predictor handle");
+    ((Predictor*)h)->kv_trim(sid, new_len);
+    return 0;
+  } catch (const std::exception& e) {
+    fill_error(err, err_len, e.what());
+    return 1;
+  }
+}
+
 // current appended length of a session (-1: bad/closed session)
 __attribute__((visibility("default")))
 int64_t ptpu_predictor_kv_len(PTPU_Predictor* h, int sid) {
@@ -6900,6 +6994,19 @@ __attribute__((visibility("default")))
 int64_t ptpu_kvpool_len(PTPU_KvPool* h, int sid) {
   if (!h) return -1;
   return ((KvPool*)h)->len(sid);
+}
+
+// truncate a pool session to new_len (COW-safe rollback; see
+// ptpu_predictor_kv_trim). Returns 0, or 1 on a closed/bad session.
+__attribute__((visibility("default")))
+int ptpu_kvpool_trim(PTPU_KvPool* h, int sid, int64_t new_len) {
+  if (!h) return 1;
+  try {
+    ((KvPool*)h)->trim(sid, new_len);
+    return 0;
+  } catch (const std::exception&) {
+    return 1;
+  }
 }
 
 /* Prefix-cache adoption for a freshly opened (or page-aligned)

@@ -132,6 +132,33 @@ constexpr uint8_t kTagDecodeClose = 0x69;
 constexpr uint8_t kTagDecodeOpen2 = 0x6a;
 constexpr uint8_t kTagDecodeOpenRep = 0x6b;
 constexpr uint8_t kTagDecodeFork = 0x6c;
+/* Speculative-decoding ops (ISSUE 13). A spec session runs a DRAFT
+ * model alongside the target: each SPEC_STEP is one draft/verify
+ * round — the draft proposes k tokens (k sequential width-1 draft
+ * steps), the target scores all k plus the bonus position in ONE
+ * width-(k+1) pass through the spec_verify artifact, the standard
+ * exact acceptance rule (greedy: longest matching prefix; sampling:
+ * modified rejection against the draft distribution) commits m
+ * accepted tokens + 1 target-sourced token, and the rejected suffix
+ * rolls back by TRUNCATING the session's paged block table (kv_trim —
+ * COW pages are unreferenced, never mutated). Zero distribution
+ * drift by construction.
+ *   DECODE_SPEC_OPEN [ver][tag][u64 req_id][u32 n_tokens]
+ *                    [u32 flags][u64 seed][n x i64]  (26 + 8n B)
+ *                    flags bit0: 1 = sampling, 0 = greedy; seed
+ *                    drives the server-side sampler (splitmix64).
+ *   DECODE_SPEC_STEP [ver][tag][u64 req_id][u64 session]  (18 B)
+ *   DECODE_SPEC_REP  [ver][tag][u64 req_id][u64 session]
+ *                    [u32 accepted][u32 n_tokens][n x i64]
+ *                    open: accepted = prefix-cache adopted tokens and
+ *                    n = 1 (the first generated token); step:
+ *                    accepted = draft tokens accepted this round and
+ *                    n = accepted + 1 (clients see tokens-per-round).
+ * Errors ride INFER_ERR. Python twin: inference/serving.py
+ * TAG_DECODE_SPEC_* (the wire checker holds the two in lockstep). */
+constexpr uint8_t kTagDecodeSpecOpen = 0x6d;
+constexpr uint8_t kTagDecodeSpecStep = 0x6e;
+constexpr uint8_t kTagDecodeSpecRep = 0x6f;
 constexpr uint32_t kSvMaxFrame = 1u << 30;
 constexpr int kSvMaxNdim = 16;
 // backpressure budget: how long one INFER frame may sit deferred on a
@@ -168,6 +195,10 @@ struct SvRequest {
   // no per-step reply; completion is tracked on the session's
   // PrefillJob, which answers DECODE_OPEN_REP after the LAST token
   bool is_prefill = false;
+  // one speculative draft/verify round (ISSUE 13): the runner drives
+  // the whole round (draft burst + width-k verify + rollback) and
+  // answers DECODE_SPEC_REP itself
+  bool is_spec = false;
   uint64_t session = 0;
   int64_t token = 0;
   // ---- request tracing (ptpu_trace) ----
@@ -370,6 +401,12 @@ struct DecStats {
   // and steps answered "kv pool exhausted" (backpressure, retryable)
   ptpu::Counter prefills, prefill_tokens, prefill_adopted, forks,
       pool_exhausted, bucket_miss;
+  // speculative-decoding counters (ISSUE 13): rounds run, draft
+  // tokens proposed/accepted, tokens committed via spec (incl. the
+  // per-round bonus/correction token), width-1 draft steps executed,
+  // and rounds that fell back to a plain target step (context end)
+  ptpu::Counter spec_rounds, spec_proposed, spec_accepted,
+      spec_tokens, spec_draft_steps, spec_fallbacks;
   ptpu::Histogram run_us, batch_fill;
   void Reset() {
     opens.Reset();
@@ -384,10 +421,71 @@ struct DecStats {
     forks.Reset();
     pool_exhausted.Reset();
     bucket_miss.Reset();
+    spec_rounds.Reset();
+    spec_proposed.Reset();
+    spec_accepted.Reset();
+    spec_tokens.Reset();
+    spec_draft_steps.Reset();
+    spec_fallbacks.Reset();
     run_us.Reset();
     batch_fill.Reset();
   }
 };
+
+/* ---- speculative-decoding sampler (ISSUE 13) ----------------------
+ * The acceptance rule needs a deterministic, seedable RNG and exact
+ * softmax/argmax/CDF primitives in C. splitmix64 is the generator
+ * (one u64 of state per session, seeded from the wire); uniforms are
+ * the standard 53-bit mantissa draw. Softmax accumulates in double so
+ * the sampled distribution matches numpy's float64 softmax of the
+ * same float32 logits to ~1ulp. argmax ties break to the LOWEST
+ * index — np.argmax's rule, which the greedy parity gate relies on. */
+inline uint64_t spec_sm64(uint64_t* s) {
+  uint64_t z = (*s += 0x9e3779b97f4a7c15ull);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+  return z ^ (z >> 31);
+}
+
+inline double spec_u01(uint64_t* s) {
+  return double(spec_sm64(s) >> 11) * 0x1.0p-53;
+}
+
+inline int64_t spec_argmax(const float* lg, int64_t v) {
+  int64_t best = 0;
+  for (int64_t i = 1; i < v; ++i)
+    if (lg[i] > lg[best]) best = i;
+  return best;
+}
+
+inline void spec_softmax(const float* lg, int64_t v, float* p) {
+  float m = lg[0];
+  for (int64_t i = 1; i < v; ++i) m = std::max(m, lg[i]);
+  double sum = 0.0;
+  for (int64_t i = 0; i < v; ++i) {
+    const double e = std::exp(double(lg[i]) - double(m));
+    p[i] = float(e);
+    sum += e;
+  }
+  const float inv = float(1.0 / sum);
+  for (int64_t i = 0; i < v; ++i) p[i] *= inv;
+}
+
+// CDF-walk sample of a (sub-)normalized distribution; `norm` is the
+// distribution's own mass so a residual distribution samples exactly
+inline int64_t spec_sample(const float* p, int64_t v, double norm,
+                           double u) {
+  double acc = 0.0;
+  const double target = u * norm;
+  for (int64_t i = 0; i < v; ++i) {
+    acc += double(p[i]);
+    if (target < acc) return i;
+  }
+  // fp tail: return the last index with nonzero mass
+  for (int64_t i = v; i-- > 0;)
+    if (p[i] > 0.f) return i;
+  return v - 1;
+}
 
 struct SvServer {
   std::string model_path;
@@ -419,6 +517,42 @@ struct SvServer {
   std::map<int64_t, PTPU_Predictor*> dec_buckets;
   std::vector<int64_t> dec_ladder;
   int64_t prefill_chunk = 16;      // $PTPU_PREFILL_CHUNK, else page
+  /* ---- speculative decoding (ISSUE 13) ----
+   * Two more artifact planes beside the width-1 target ladder:
+   *   draft   a SMALL model's width-1 decode artifact with its OWN
+   *           KvPool (different geometry than the target) — proposes
+   *           k tokens per round via sequential draft steps, batched
+   *           across sessions by the shared decode flush;
+   *   verify  the TARGET model exported at width k+1
+   *           (models.gpt.export_gpt_decode(width=k+1)) attached to
+   *           the SAME pool as the target ladder, so verify passes
+   *           read/extend/roll back the very sessions the width-1
+   *           steps use.
+   * spec_k = verify width - 1, optionally capped by $PTPU_SPEC_K
+   * (padding tokens fill the unused verify columns; their rows are
+   * rolled back with the rejected suffix). */
+  std::string spec_draft_path, spec_verify_path;
+  PTPU_KvPool* draft_pool = nullptr;
+  std::map<int64_t, PTPU_Predictor*> draft_buckets, ver_buckets;
+  std::vector<int64_t> draft_ladder, ver_ladder;
+  int64_t draft_batch = 0, draft_ctx = 0, draft_logit_elems = 0;
+  int64_t ver_batch = 0, ver_width = 0;
+  int64_t spec_k = 0;              // 0 = spec disabled
+  /* Per-session speculative state, owned by the WireSession. The
+   * committed vector is the full token history (prompt + generated);
+   * its LAST entry is committed-but-unfed — the round invariant:
+   * target fed len == committed.size() - 1. draft_len tracks the
+   * draft session's fed positions (lags behind during chunked
+   * catch-up; runs 1 ahead of a trim after a fully-accepted round). */
+  struct SpecState {
+    bool sample = false;
+    uint64_t rng = 0;              // splitmix64 state (wire seed)
+    int draft_slot = -1;           // session in draft_pool
+    std::vector<int64_t> committed;
+    int64_t draft_len = 0;
+    int64_t prompt_len = 0;
+    bool draft_published = false;  // draft prompt pages in its cache
+  };
   /* One in-flight prompt prefill per OPEN2 (keyed by wire session,
    * guarded by sess_mu_): `next` tokens admitted into the decode
    * batcher so far (at most `prefill_chunk` beyond `done`), `done`
@@ -436,11 +570,16 @@ struct SvServer {
     int64_t next = 0;     // tokens admitted (adopted ones count)
     int64_t done = 0;     // tokens stepped (adopted ones count)
     int64_t adopted = 0;
+    // SPEC_OPEN prefill: completion picks the first token from the
+    // last prompt logits and answers DECODE_SPEC_REP instead
+    bool spec = false;
   };
   std::map<uint64_t, std::unique_ptr<PrefillJob>> prefills_;
   // jobs whose next chunk could not enqueue (batcher full): retried
   // at the start of every decode flush
   std::vector<uint64_t> prefill_resume_;
+  // spec rounds parked mid-catch-up by a full queue (same retry)
+  std::vector<SvRequest> spec_resume_;
   /* Wire-session registry, two locks with a fixed order kv_mu_ ->
    * sess_mu_:
    *   sess_mu_  the registry map only — always held briefly.
@@ -457,6 +596,7 @@ struct SvServer {
     int slot = -1;
     uint64_t last_us = 0;
     const void* owner = nullptr;   // opening conn (freed on conn close)
+    std::unique_ptr<SpecState> spec;  // speculative sessions only
   };
   ptpu::Mutex kv_mu_{kLockSvKv};
   ptpu::Mutex sess_mu_{kLockSvSess};
@@ -688,6 +828,204 @@ struct SvServer {
       }
       for (const auto& kv2 : dec_buckets)
         dec_ladder.push_back(kv2.first);
+
+      // ---- speculative decoding plane (ISSUE 13) ----
+      if (!spec_draft_path.empty() || !spec_verify_path.empty()) {
+        if (spec_draft_path.empty() || spec_verify_path.empty())
+          throw std::runtime_error(
+              "speculative decoding needs BOTH spec_draft_model and "
+              "spec_verify_model");
+        if (!kv_paged || !kv_pool)
+          throw std::runtime_error(
+              "speculative decoding needs the paged KV engine "
+              "(unset PTPU_KV_PAGED=0)");
+        if (ptpu_predictor_kv_width(dec_pred) != 1)
+          throw std::runtime_error(
+              "decode_model must be a width-1 step artifact");
+        // probe one decode bucket of either spec plane: open a
+        // session, feed `width` zero tokens, validate the logits
+        // batch axis, report the per-row logits element count
+        const auto probe_spec = [&](PTPU_Predictor* p2, int64_t rows,
+                                    int64_t width, int64_t* row_elems,
+                                    std::string* perr) {
+          const int sid = ptpu_predictor_kv_open(p2);
+          if (sid < 0) {
+            *perr = "no probe session";
+            return false;
+          }
+          std::vector<int64_t> sids(1, sid), toks(size_t(width), 0);
+          char perr2[512] = {0};
+          bool ok = ptpu_predictor_decode_step(p2, sids.data(),
+                                               toks.data(), 1, perr2,
+                                               sizeof(perr2)) == 0;
+          if (!ok) {
+            *perr = perr2;
+          } else {
+            const int nd = ptpu_predictor_output_ndim(p2, 0);
+            const int64_t* od = ptpu_predictor_output_dims(p2, 0);
+            if (nd < 1 || !od || od[0] != rows) {
+              *perr = "logits output lost the batch axis";
+              ok = false;
+            } else if (row_elems) {
+              *row_elems = 1;
+              for (int k = 1; k < nd; ++k) *row_elems *= od[k];
+            }
+          }
+          ptpu_predictor_kv_close(p2, sid);
+          return ok;
+        };
+
+        /* Verify plane: the TARGET model exported at width k+1,
+         * attached to the SAME pool as the width-1 ladder — a verify
+         * pass extends (and kv_trim rolls back) the very sessions the
+         * plain steps feed. Own step-batch ladder below its baked
+         * batch, batch-repaired exactly like the dec ladder. */
+        PTPU_Predictor* vp = ptpu_predictor_create_opts(
+            spec_verify_path.c_str(), 0, 0, err, sizeof(err));
+        if (!vp)
+          throw std::runtime_error(std::string("spec verify model: ") +
+                                   err);
+        ptpu_predictor_set_pool(vp, dec_pool);
+        if (ptpu_predictor_kv_attach(vp, kv_pool, err,
+                                     sizeof(err)) != 0) {
+          ptpu_predictor_destroy(vp);
+          throw std::runtime_error(
+              std::string("spec verify kv_attach: ") + err);
+        }
+        const int64_t* vdd = ptpu_predictor_input_dims(vp, 0);
+        ver_batch = vdd ? vdd[0] : 0;
+        ver_width = ptpu_predictor_kv_width(vp);
+        if (ver_width < 2) {
+          ptpu_predictor_destroy(vp);
+          throw std::runtime_error(
+              "spec_verify_model must be a width >= 2 artifact "
+              "(models.gpt.export_gpt_decode(width=k+1))");
+        }
+        ver_buckets[ver_batch] = vp;
+        for (int64_t b2 = 1; b2 < ver_batch; b2 *= 2) {
+          PTPU_Predictor* p2 = ptpu_predictor_create_opts(
+              spec_verify_path.c_str(), b2, 0, err, sizeof(err));
+          if (!p2)
+            throw std::runtime_error(std::string("verify bucket ") +
+                                     std::to_string(b2) + ": " + err);
+          ptpu_predictor_set_pool(p2, dec_pool);
+          if (ptpu_predictor_kv_attach(p2, kv_pool, err,
+                                       sizeof(err)) != 0) {
+            ptpu_predictor_destroy(p2);
+            throw std::runtime_error(std::string("verify bucket ") +
+                                     std::to_string(b2) +
+                                     " kv_attach: " + err);
+          }
+          ver_buckets[b2] = p2;
+        }
+        int64_t ver_row_elems = 0;
+        for (auto it = ver_buckets.begin(); it != ver_buckets.end();) {
+          std::string perr;
+          int64_t re = 0;
+          if (probe_spec(it->second, it->first, ver_width, &re,
+                         &perr)) {
+            if (it->first == ver_batch) ver_row_elems = re;
+            ++it;
+          } else if (it->first == ver_batch) {
+            throw std::runtime_error("verify probe: " + perr);
+          } else {
+            ptpu_predictor_destroy(it->second);
+            it = ver_buckets.erase(it);
+          }
+        }
+        if (ver_row_elems != ver_width * dec_logit_elems)
+          throw std::runtime_error(
+              "spec_verify_model logits are not [B, W, vocab] for the "
+              "decode_model's vocab");
+        for (const auto& kv2 : ver_buckets)
+          ver_ladder.push_back(kv2.first);
+
+        /* Draft plane: a small model's width-1 artifact with its OWN
+         * pool (different [P,H,D,layers] geometry than the target).
+         * The draft session mirrors the committed token history; its
+         * prefix cache makes repeated spec opens of a shared prompt
+         * cheap on the draft side too. */
+        PTPU_Predictor* dp = ptpu_predictor_create_opts(
+            spec_draft_path.c_str(), 0, 0, err, sizeof(err));
+        if (!dp)
+          throw std::runtime_error(std::string("spec draft model: ") +
+                                   err);
+        const int64_t* ddd = ptpu_predictor_input_dims(dp, 0);
+        const int64_t* dcd = ptpu_predictor_input_dims(dp, 2);
+        draft_batch = ddd ? ddd[0] : 0;
+        draft_ctx = dcd ? dcd[1] : 0;
+        int64_t dpage = 16;
+        if (const char* e = std::getenv("PTPU_KV_PAGE"))
+          if (std::atoll(e) > 0) dpage = std::atoll(e);
+        const int64_t dpool_tokens =
+            (kv_sessions_arg > 0 ? int64_t(kv_sessions_arg) : 64) *
+            ((draft_ctx + dpage - 1) / dpage) * dpage;
+        draft_pool = ptpu_kvpool_create(dpool_tokens, int(dpage),
+                                       kv_sessions, -1, err,
+                                       sizeof(err));
+        if (!draft_pool) {
+          ptpu_predictor_destroy(dp);
+          throw std::runtime_error(std::string("draft kvpool: ") + err);
+        }
+        ptpu_predictor_set_pool(dp, dec_pool);
+        if (ptpu_predictor_kv_attach(dp, draft_pool, err,
+                                     sizeof(err)) != 0) {
+          ptpu_predictor_destroy(dp);
+          throw std::runtime_error(
+              std::string("spec draft kv_attach: ") + err);
+        }
+        if (ptpu_predictor_kv_width(dp) != 1) {
+          ptpu_predictor_destroy(dp);
+          throw std::runtime_error(
+              "spec_draft_model must be a width-1 step artifact");
+        }
+        draft_buckets[draft_batch] = dp;
+        for (int64_t b2 = 1; b2 < draft_batch; b2 *= 2) {
+          PTPU_Predictor* p2 = ptpu_predictor_create_opts(
+              spec_draft_path.c_str(), b2, 0, err, sizeof(err));
+          if (!p2)
+            throw std::runtime_error(std::string("draft bucket ") +
+                                     std::to_string(b2) + ": " + err);
+          ptpu_predictor_set_pool(p2, dec_pool);
+          if (ptpu_predictor_kv_attach(p2, draft_pool, err,
+                                       sizeof(err)) != 0) {
+            ptpu_predictor_destroy(p2);
+            throw std::runtime_error(std::string("draft bucket ") +
+                                     std::to_string(b2) +
+                                     " kv_attach: " + err);
+          }
+          draft_buckets[b2] = p2;
+        }
+        for (auto it = draft_buckets.begin();
+             it != draft_buckets.end();) {
+          std::string perr;
+          int64_t re = 0;
+          if (probe_spec(it->second, it->first, 1, &re, &perr)) {
+            if (it->first == draft_batch) draft_logit_elems = re;
+            ++it;
+          } else if (it->first == draft_batch) {
+            throw std::runtime_error("draft probe: " + perr);
+          } else {
+            ptpu_predictor_destroy(it->second);
+            it = draft_buckets.erase(it);
+          }
+        }
+        if (draft_logit_elems != dec_logit_elems)
+          throw std::runtime_error(
+              "spec_draft_model vocab (" +
+              std::to_string(draft_logit_elems) +
+              ") != decode_model vocab (" +
+              std::to_string(dec_logit_elems) + ")");
+        for (const auto& kv2 : draft_buckets)
+          draft_ladder.push_back(kv2.first);
+
+        spec_k = ver_width - 1;
+        if (const char* e = std::getenv("PTPU_SPEC_K")) {
+          const int64_t v = std::atoll(e);
+          if (v > 0 && v < spec_k) spec_k = v;
+        }
+      }
+
       dec_batcher.reset(new SvBatcher(
           dec_batch, deadline_us, 1, &dec_bstats,
           [this](int, std::vector<SvRequest>& batch) {
@@ -844,6 +1182,26 @@ struct SvServer {
         out += std::to_string(dec_ladder[k]);
       }
       out += "]";
+      if (spec_k > 0) {
+        out += ",\"spec\":{";
+        ptpu::AppendJsonU64(&out, "k", uint64_t(spec_k));
+        out += ',';
+        ptpu::AppendJsonU64(&out, "verify_width", uint64_t(ver_width));
+        out += ',';
+        ptpu::AppendJsonU64(&out, "draft_context",
+                            uint64_t(draft_ctx));
+        out += ",\"verify_buckets\":[";
+        for (size_t k = 0; k < ver_ladder.size(); ++k) {
+          if (k) out += ',';
+          out += std::to_string(ver_ladder[k]);
+        }
+        out += "],\"draft_buckets\":[";
+        for (size_t k = 0; k < draft_ladder.size(); ++k) {
+          if (k) out += ',';
+          out += std::to_string(draft_ladder[k]);
+        }
+        out += "]}";
+      }
       if (kv_pool) {
         out += ",\"pool\":";
         out += ptpu_kvpool_stats_json(kv_pool);
@@ -1088,6 +1446,7 @@ struct SvServer {
       }
       ptpu_predictor_kv_close(dec_pred, sessions_[victim].slot);
       sessions_[victim].slot = -1;
+      CloseSpecLocked(sessions_[victim]);
       dstats.evictions.Add(1);
       // an evicted session may still be mid-prefill: its OPEN2 must
       // answer NOW (queued prefill steps drop at the tombstone), or
@@ -1124,7 +1483,7 @@ struct SvServer {
     ws.slot = slot;
     ws.last_us = uint64_t(ptpu::NowUs());
     ws.owner = conn.get();
-    sessions_[id] = ws;
+    sessions_[id] = std::move(ws);
     dstats.opens.Add(1);
     *sess = id;
     return true;
@@ -1140,6 +1499,7 @@ struct SvServer {
     }
     if (it->second.slot >= 0)
       ptpu_predictor_kv_close(dec_pred, it->second.slot);
+    CloseSpecLocked(it->second);
     sessions_.erase(it);
     // a prefilling session closed out from under its job (only
     // reachable via a racing second connection guessing the id —
@@ -1175,6 +1535,7 @@ struct SvServer {
       if (it->second.owner == conn) {
         if (it->second.slot >= 0)
           ptpu_predictor_kv_close(dec_pred, it->second.slot);
+        CloseSpecLocked(it->second);
         prefills_.erase(it->first);  // conn is gone: no reply owed
         it = sessions_.erase(it);
       } else {
@@ -1243,6 +1604,12 @@ struct SvServer {
       *why = "session is still prefilling";
       return false;
     }
+    if (it->second.spec) {
+      // a fork would need a draft twin + sampler-state clone; not a
+      // supported shape yet
+      *why = "cannot fork a speculative session";
+      return false;
+    }
     const int ns = ptpu_kvpool_fork(kv_pool, it->second.slot);
     if (ns < 0) {
       *why = "no KV session slots";
@@ -1253,11 +1620,107 @@ struct SvServer {
     ws.slot = ns;
     ws.last_us = uint64_t(ptpu::NowUs());
     ws.owner = conn.get();
-    sessions_[id] = ws;
+    sessions_[id] = std::move(ws);
     dstats.forks.Add(1);
     dstats.opens.Add(1);
     *nsess = id;
     return true;
+  }
+
+  // close a session's draft-side state (sess_mu_ held); safe when the
+  // session never was speculative
+  void CloseSpecLocked(WireSession& ws) {
+    if (ws.spec && ws.spec->draft_slot >= 0 && draft_pool)
+      ptpu_kvpool_close(draft_pool, ws.spec->draft_slot);
+    ws.spec.reset();
+  }
+
+  // pick the next committed token from target logits: argmax (greedy)
+  // or one softmax draw (sampling) — exactly the primitive a
+  // non-speculative sampler applies to the same logits
+  int64_t SpecPick(SpecState& st, const float* lg) {
+    if (!st.sample) return spec_argmax(lg, dec_logit_elems);
+    std::vector<float> p(static_cast<size_t>(dec_logit_elems));
+    spec_softmax(lg, dec_logit_elems, p.data());
+    return spec_sample(p.data(), dec_logit_elems, 1.0,
+                       spec_u01(&st.rng));
+  }
+
+  void SendSpecRep(const ptpu::net::ConnPtr& conn, uint64_t rid,
+                   uint64_t sess, uint64_t wire_tid, uint32_t accepted,
+                   const int64_t* toks, uint32_t n) {
+    std::vector<uint8_t> f = conn->AcquireBuf();
+    f.resize(4 + 2 + (wire_tid ? 8 : 0) + 8 + 8 + 4 + 4 +
+             8ull * n);
+    const size_t ho = RepHdr(f, kTagDecodeSpecRep, wire_tid);
+    ptpu::PutU64(f.data() + ho, rid);
+    ptpu::PutU64(f.data() + ho + 8, sess);
+    PutU32(f.data() + ho + 16, accepted);
+    PutU32(f.data() + ho + 20, n);
+    for (uint32_t k = 0; k < n; ++k)
+      ptpu::PutI64(f.data() + ho + 24 + 8 * size_t(k),
+                   toks[size_t(k)]);
+    stats.bytes_out.Add(f.size());
+    conn->SendPayload(std::move(f));
+  }
+
+  /* SPEC_OPEN: open a target session + its draft twin, adopt shared
+   * prefix pages in BOTH pools, then prefill the target prompt through
+   * the existing chunked machinery (job->spec routes completion to a
+   * SPEC_REP carrying the first generated token). The draft session is
+   * NOT prefilled here — rounds catch it up chunk-wise, so a long
+   * prompt never stalls running sessions on the draft plane either. */
+  void DecodeSpecOpen(const ptpu::net::ConnPtr& conn, uint64_t rid,
+                      uint64_t wire_tid, uint32_t flags, uint64_t seed,
+                      std::vector<int64_t>&& toks) {
+    const int64_t ntok = int64_t(toks.size());
+    uint64_t sess = 0;
+    {
+      std::string why;
+      ptpu::MutexLock kl(kv_mu_);
+      ptpu::MutexLock l(sess_mu_);
+      if (!OpenSlotLocked(conn, &sess, &why)) {
+        SendErrFrame(conn, rid, why);
+        return;
+      }
+      const int dslot = ptpu_kvpool_open(draft_pool);
+      if (dslot < 0) {
+        ptpu_predictor_kv_close(dec_pred, sessions_[sess].slot);
+        sessions_.erase(sess);
+        SendErrFrame(conn, rid, "no draft KV session slots");
+        return;
+      }
+      const int64_t adopted = ptpu_kvpool_adopt(
+          kv_pool, sessions_[sess].slot, toks.data(), ntok);
+      auto* st = new SpecState;
+      st->sample = (flags & 1u) != 0;
+      st->rng = seed ? seed : 0x9e3779b97f4a7c15ull;
+      st->draft_slot = dslot;
+      st->committed = toks;          // the prompt; the first generated
+                                     // token lands at prefill end
+      st->prompt_len = ntok;
+      st->draft_len = ntok <= draft_ctx
+                          ? ptpu_kvpool_adopt(draft_pool, dslot,
+                                              toks.data(), ntok)
+                          : 0;
+      sessions_[sess].spec.reset(st);
+      auto* job = new PrefillJob;
+      job->sess = sess;
+      job->rid = rid;
+      job->conn = conn;
+      job->wire_tid = wire_tid;
+      job->tokens = std::move(toks);
+      job->next = adopted;
+      job->done = adopted;
+      job->adopted = adopted;
+      job->spec = true;
+      prefills_[sess].reset(job);
+      dstats.prefills.Add(1);
+      dstats.prefill_adopted.Add(uint64_t(adopted));
+      dstats.prefill_tokens.Add(uint64_t(ntok - adopted));
+    }
+    conn->NotePending(1);  // paired by SPEC_REP / the job's error
+    PrefillAdmit(sess);
   }
 
   // admit the next chunk of a job's prompt into the decode batcher;
@@ -1315,6 +1778,7 @@ struct SvServer {
       auto sit = sessions_.find(sess);
       if (sit != sessions_.end()) {
         slot = sit->second.slot;
+        CloseSpecLocked(sit->second);
         sessions_.erase(sit);
       }
     }
@@ -1332,7 +1796,8 @@ struct SvServer {
     int64_t adopted = 0;
     int slot = -1;
     std::vector<int64_t> toks;
-    bool fin = false, admit = false;
+    bool fin = false, admit = false, spec = false;
+    int64_t first_tok = 0;
     {
       ptpu::MutexLock l(sess_mu_);
       auto it = prefills_.find(r->session);
@@ -1345,9 +1810,17 @@ struct SvServer {
         rid = job->rid;
         wire_tid = job->wire_tid;
         adopted = job->adopted;
+        spec = job->spec;
         toks.swap(job->tokens);
         auto sit = sessions_.find(r->session);
         slot = sit == sessions_.end() ? -1 : sit->second.slot;
+        if (spec && sit != sessions_.end() && sit->second.spec) {
+          // speculative open completes here: the first generated
+          // token comes from the last prompt token's target logits
+          SpecState& st = *sit->second.spec;
+          first_tok = SpecPick(st, lg + row * dec_logit_elems);
+          st.committed.push_back(first_tok);
+        }
         prefills_.erase(it);
       } else if (job->next - job->done <= 0) {
         admit = true;
@@ -1360,6 +1833,12 @@ struct SvServer {
     if (kv_pool && slot >= 0)
       ptpu_kvpool_publish(kv_pool, slot, toks.data(),
                           int64_t(toks.size()));
+    if (spec) {
+      SendSpecRep(conn, rid, r->session, wire_tid, uint32_t(adopted),
+                  &first_tok, 1);
+      conn->NotePending(-1);
+      return;
+    }
     std::vector<uint8_t> f = conn->AcquireBuf();
     f.resize(4 + 2 + (wire_tid ? 8 : 0) + 8 + 8 + 4 + 4 +
              size_t(dec_logit_elems) * 4);
@@ -1382,6 +1861,7 @@ struct SvServer {
    * the batcher just drained, so there is room again. */
   void RunDecode(std::vector<SvRequest>& batch) {
     PrefillResume();
+    if (spec_k > 0) SpecResume();
     const int64_t t_deq = ptpu::NowUs();
     for (auto& r : batch) r.t_deq_us = t_deq;
     /* Greedy order-preserving re-pack. The old FIFO-prefix split cut
@@ -1423,6 +1903,36 @@ struct SvServer {
         return dec_buckets[b];
       }
     return dec_pred;
+  }
+
+  // same selection over the spec planes' draft/verify ladders
+  PTPU_Predictor* LadderBucket(
+      const std::map<int64_t, PTPU_Predictor*>& buckets,
+      const std::vector<int64_t>& ladder, size_t rows) {
+    for (int64_t b : ladder)
+      if (int64_t(rows) <= b) {
+        if (int64_t(rows) < b) dstats.bucket_miss.Add(1);
+        return buckets.at(b);
+      }
+    return buckets.rbegin()->second;
+  }
+
+  // re-enqueue spec rounds parked mid-catch-up by a full queue (the
+  // batcher just drained, so there is room again)
+  void SpecResume() {
+    std::vector<SvRequest> retry;
+    {
+      ptpu::MutexLock l(sess_mu_);
+      retry.swap(spec_resume_);
+    }
+    for (auto& r : retry) {
+      std::string why;
+      if (!dec_batcher->enqueue(std::move(r), &why)) {
+        // enqueue moves only on success: r is intact — park again
+        ptpu::MutexLock l(sess_mu_);
+        spec_resume_.push_back(std::move(r));
+      }
+    }
   }
 
   // reply with row `row` of the just-run decode outputs (kv_mu_ held:
@@ -1484,9 +1994,8 @@ struct SvServer {
   }
 
   void DecodeStepRun(std::vector<SvRequest*>& run) {
-    char err[512] = {0};
     std::vector<int64_t> sids, toks;
-    std::vector<SvRequest*> live;
+    std::vector<SvRequest*> live, spec_rounds;
     ptpu::MutexLock kl(kv_mu_);
     {
       ptpu::MutexLock l(sess_mu_);
@@ -1500,13 +2009,47 @@ struct SvServer {
           r->conn->NotePending(-1);
           continue;
         }
+        // plane routing: a speculative session only accepts
+        // SPEC_STEP rounds (and its own server-internal prefill) —
+        // mixing plain steps in would desync the committed history
+        if (r->is_spec) {
+          if (!it->second.spec) {
+            SendErrFrame(r->conn, r->id,
+                         "not a speculative session (open it with "
+                         "DECODE_SPEC_OPEN)");
+            r->conn->NotePending(-1);
+            continue;
+          }
+          if (prefills_.count(r->session)) {
+            SendErrFrame(r->conn, r->id, "session is still prefilling");
+            r->conn->NotePending(-1);
+            continue;
+          }
+          it->second.last_us = uint64_t(ptpu::NowUs());
+          spec_rounds.push_back(r);
+          continue;
+        }
+        if (it->second.spec && !r->is_prefill) {
+          SendErrFrame(r->conn, r->id,
+                       "speculative session: use DECODE_SPEC_STEP");
+          r->conn->NotePending(-1);
+          continue;
+        }
         it->second.last_us = uint64_t(ptpu::NowUs());
         sids.push_back(it->second.slot);
         toks.push_back(r->token);
         live.push_back(r);
       }
     }
-    if (live.empty()) return;
+    if (!live.empty()) PlainStepRun(live, sids, toks);
+    if (!spec_rounds.empty()) RunSpecRounds(spec_rounds);
+  }
+
+  // the width-1 target run (plain steps + prefill chunks); kv_mu_ held
+  void PlainStepRun(std::vector<SvRequest*>& live,
+                    std::vector<int64_t>& sids,
+                    std::vector<int64_t>& toks) {
+    char err[512] = {0};
     // smallest ladder bucket holding the sub-run: partial fill stops
     // padding to the baked batch (r9 served every step at B rows)
     PTPU_Predictor* pred = DecBucket(live.size());
@@ -1567,6 +2110,379 @@ struct SvServer {
         PrefillRowDone(live[r2], lg, int64_t(r2));
       else
         DecodeReply(live[r2], lg, int64_t(r2), t0, t1);
+    }
+  }
+
+  /* ---- speculative rounds (ISSUE 13 tentpole; kv_mu_ held) ----
+   * One call drives a full draft/verify round for every row (the
+   * re-pack guarantees unique sessions per sub-run):
+   *   1. draft catch-up + burst: sequential width-1 draft steps,
+   *      BATCHED ACROSS SESSIONS per iteration through the draft
+   *      bucket ladder (row A's step j runs in the same draft batch
+   *      as row B's step j). A long catch-up (fresh open after a big
+   *      prompt) feeds at most prefill_chunk tokens, then re-enqueues
+   *      the round so other sessions' steps interleave.
+   *   2. verify: ONE width-(k+1) pass per round through the verify
+   *      ladder — scores all k proposals + the bonus position.
+   *   3. exact acceptance (greedy prefix match / modified rejection),
+   *      commit m + 1 tokens, kv_trim the rejected suffix off the
+   *      target (COW pages unref, never mutate) and sync the draft.
+   * Rows whose context cannot hold a full round fall back to a plain
+   * width-1 target step (accepted = 0) — spec degrades gracefully at
+   * the context fence instead of erroring. */
+  void RunSpecRounds(std::vector<SvRequest*>& rounds) {
+    struct Rctx {
+      SvRequest* r = nullptr;
+      SpecState* st = nullptr;
+      int tslot = -1;
+      int64_t k = 0;               // proposals this round
+      int64_t catchup = 0;         // committed tokens to feed first
+      std::vector<int64_t> feeds;  // draft feed list (grows w/ props)
+      int64_t fed = 0;
+      std::vector<int64_t> props;
+      std::vector<float> q;        // k x vocab draft probs (sampling)
+      bool fallback = false, park = false, dead = false;
+    };
+    const int64_t V = dec_logit_elems;
+    std::vector<Rctx> rc(rounds.size());
+    {
+      ptpu::MutexLock l(sess_mu_);
+      for (size_t i = 0; i < rounds.size(); ++i) {
+        Rctx& c = rc[i];
+        c.r = rounds[i];
+        auto it = sessions_.find(c.r->session);
+        if (it == sessions_.end() || it->second.slot < 0 ||
+            !it->second.spec) {
+          // validated at de-queue; re-check after regaining the locks
+          SendErrFrame(c.r->conn, c.r->id, "decode session lost");
+          c.r->conn->NotePending(-1);
+          c.dead = true;
+          continue;
+        }
+        c.st = it->second.spec.get();
+        c.tslot = it->second.slot;
+        const int64_t C0 = int64_t(c.st->committed.size());
+        const int64_t catchup = C0 - c.st->draft_len;
+        c.k = spec_k;
+        if (C0 - 1 + ver_width > dec_ctx ||
+            C0 - 1 + c.k > draft_ctx || catchup < 1) {
+          c.fallback = true;
+          continue;
+        }
+        if (catchup > prefill_chunk + 1) {
+          // chunked draft catch-up: feed one chunk, then re-enqueue
+          c.park = true;
+          c.feeds.assign(
+              c.st->committed.begin() + c.st->draft_len,
+              c.st->committed.begin() + c.st->draft_len +
+                  prefill_chunk);
+        } else {
+          c.feeds.assign(c.st->committed.begin() + c.st->draft_len,
+                         c.st->committed.end());
+        }
+        c.catchup = int64_t(c.feeds.size());
+      }
+    }
+
+    // reply an error + roll the draft back to the committed history
+    // (uncommitted proposals it fed become unreadable); target and
+    // committed are untouched, so the client may simply retry
+    const auto round_error = [&](Rctx& c, const std::string& why) {
+      const int64_t fence = int64_t(c.st->committed.size()) - 1;
+      if (c.st->draft_len > fence) {
+        ptpu_kvpool_trim(draft_pool, c.st->draft_slot, fence);
+        c.st->draft_len = fence;
+      }
+      if (why.find("kv pool exhausted") != std::string::npos)
+        dstats.pool_exhausted.Add(1);
+      SendErrFrame(c.r->conn, c.r->id, why);
+      c.r->conn->NotePending(-1);
+      c.dead = true;
+    };
+
+    // one draft proposal pick off a completed draft step's logits row
+    const auto draft_pick = [&](Rctx& c, const float* lg) {
+      int64_t d;
+      if (c.st->sample) {
+        if (c.q.empty()) c.q.resize(size_t(c.k) * size_t(V));
+        float* qrow = c.q.data() + int64_t(c.props.size()) * V;
+        spec_softmax(lg, V, qrow);
+        d = spec_sample(qrow, V, 1.0, spec_u01(&c.st->rng));
+      } else {
+        d = spec_argmax(lg, V);
+      }
+      c.props.push_back(d);
+      if (int64_t(c.props.size()) < c.k) c.feeds.push_back(d);
+    };
+
+    // draft-step completion: count the feed, publish the draft's
+    // prompt pages once the catch-up covers them (so later spec opens
+    // of a shared prompt adopt on the draft plane too), and pick a
+    // proposal when this feed is at/past the committed fence
+    const auto feed_done = [&](Rctx& c, const float* lg) {
+      const int64_t j = c.fed;
+      ++c.fed;
+      ++c.st->draft_len;
+      dstats.spec_draft_steps.Add(1);
+      if (!c.st->draft_published &&
+          c.st->draft_len >= c.st->prompt_len &&
+          c.st->prompt_len <= draft_ctx) {
+        ptpu_kvpool_publish(draft_pool, c.st->draft_slot,
+                            c.st->committed.data(), c.st->prompt_len);
+        c.st->draft_published = true;
+      }
+      if (!c.park && j >= c.catchup - 1 &&
+          int64_t(c.props.size()) < c.k)
+        draft_pick(c, lg);
+    };
+
+    // ---- 1. draft bursts: iteration j batches every round's j-th
+    // pending draft feed across sessions through the draft ladder
+    for (;;) {
+      std::vector<Rctx*> part;
+      for (auto& c : rc)
+        if (!c.dead && !c.fallback && c.fed < int64_t(c.feeds.size()))
+          part.push_back(&c);
+      if (part.empty()) break;
+      for (size_t off = 0; off < part.size();
+           off += size_t(draft_batch)) {
+        const size_t m =
+            std::min(part.size() - off, size_t(draft_batch));
+        std::vector<int64_t> dsids(m), dtoks(m);
+        for (size_t z = 0; z < m; ++z) {
+          dsids[z] = part[off + z]->st->draft_slot;
+          dtoks[z] = part[off + z]->feeds[size_t(part[off + z]->fed)];
+        }
+        char err[512] = {0};
+        PTPU_Predictor* dpred = LadderBucket(draft_buckets,
+                                             draft_ladder, m);
+        const bool ok =
+            ptpu_predictor_decode_step(dpred, dsids.data(),
+                                       dtoks.data(), int(m), err,
+                                       sizeof(err)) == 0;
+        const float* lg =
+            ok ? ptpu_predictor_output_data(dpred, 0) : nullptr;
+        for (size_t z = 0; z < m; ++z) {
+          Rctx& c = *part[off + z];
+          if (!ok || !lg) {
+            // retry alone so one bad row cannot poison neighbours
+            char rerr[512] = {0};
+            PTPU_Predictor* p1 = draft_buckets.begin()->second;
+            const int64_t s1[1] = {dsids[z]}, t1[1] = {dtoks[z]};
+            if (ptpu_predictor_decode_step(p1, s1, t1, 1, rerr,
+                                           sizeof(rerr)) != 0) {
+              round_error(c, std::string("spec draft: ") + rerr);
+              continue;
+            }
+            const float* lg1 = ptpu_predictor_output_data(p1, 0);
+            if (!lg1) {
+              round_error(c, "spec draft: no logits output");
+              continue;
+            }
+            feed_done(c, lg1);
+          } else {
+            feed_done(c, lg + int64_t(z) * V);
+          }
+        }
+      }
+    }
+
+    // ---- parked rounds re-enqueue (chunked catch-up continues on a
+    // later flush so other sessions interleave); a full queue parks
+    // them on spec_resume_ exactly like stalled prefill admissions
+    for (auto& c : rc) {
+      if (c.dead || !c.park) continue;
+      SvRequest nr = *c.r;
+      std::string why;
+      if (!dec_batcher->enqueue(std::move(nr), &why)) {
+        ptpu::MutexLock l(sess_mu_);
+        spec_resume_.push_back(*c.r);
+      }
+      c.dead = true;  // this visit is done; no reply yet
+    }
+
+    // ---- 2. fallback rows: a plain width-1 target step (context
+    // fence) — still answers SPEC_REP so the client sees one token
+    {
+      std::vector<Rctx*> part;
+      for (auto& c : rc)
+        if (!c.dead && c.fallback) part.push_back(&c);
+      for (size_t off = 0; off < part.size();
+           off += size_t(dec_batch)) {
+        const size_t m = std::min(part.size() - off, size_t(dec_batch));
+        std::vector<int64_t> fsids(m), ftoks(m);
+        for (size_t z = 0; z < m; ++z) {
+          fsids[z] = part[off + z]->tslot;
+          ftoks[z] = part[off + z]->st->committed.back();
+        }
+        char err[512] = {0};
+        PTPU_Predictor* pred = DecBucket(m);
+        const bool ok =
+            ptpu_predictor_decode_step(pred, fsids.data(),
+                                       ftoks.data(), int(m), err,
+                                       sizeof(err)) == 0;
+        const float* lg =
+            ok ? ptpu_predictor_output_data(pred, 0) : nullptr;
+        for (size_t z = 0; z < m; ++z) {
+          Rctx& c = *part[off + z];
+          const float* row = nullptr;
+          char rerr[512] = {0};
+          if (ok && lg) {
+            row = lg + int64_t(z) * V;
+          } else {
+            PTPU_Predictor* p1 = dec_buckets.begin()->second;
+            const int64_t s1[1] = {fsids[z]}, t1[1] = {ftoks[z]};
+            if (ptpu_predictor_decode_step(p1, s1, t1, 1, rerr,
+                                           sizeof(rerr)) != 0) {
+              round_error(c, std::string("spec step: ") + rerr);
+              continue;
+            }
+            row = ptpu_predictor_output_data(p1, 0);
+            if (!row) {
+              round_error(c, "spec step: no logits output");
+              continue;
+            }
+          }
+          const int64_t nt = SpecPick(*c.st, row);
+          c.st->committed.push_back(nt);
+          dstats.spec_rounds.Add(1);
+          dstats.spec_fallbacks.Add(1);
+          dstats.spec_tokens.Add(1);
+          SendSpecRep(c.r->conn, c.r->id, c.r->session, c.r->wire_tid,
+                      0, &nt, 1);
+          c.r->conn->NotePending(-1);
+          c.dead = true;
+        }
+      }
+    }
+
+    // ---- 3. verify + acceptance + rollback
+    std::vector<Rctx*> vpart;
+    for (auto& c : rc)
+      if (!c.dead) vpart.push_back(&c);
+    std::vector<float> pbuf(static_cast<size_t>(V));
+    std::vector<float> rbuf(static_cast<size_t>(V));
+    for (size_t off = 0; off < vpart.size();
+         off += size_t(ver_batch)) {
+      const size_t m = std::min(vpart.size() - off, size_t(ver_batch));
+      std::vector<int64_t> vsids(m), vtoks(m * size_t(ver_width), 0);
+      for (size_t z = 0; z < m; ++z) {
+        Rctx& c = *vpart[off + z];
+        vsids[z] = c.tslot;
+        int64_t* row = vtoks.data() + int64_t(z) * ver_width;
+        row[0] = c.st->committed.back();
+        for (size_t j = 0; j < c.props.size(); ++j)
+          row[1 + j] = c.props[j];
+      }
+      char err[512] = {0};
+      PTPU_Predictor* vpred = LadderBucket(ver_buckets, ver_ladder, m);
+      const int64_t t0 = ptpu::NowUs();
+      bool ok = ptpu_predictor_decode_step(vpred, vsids.data(),
+                                           vtoks.data(), int(m), err,
+                                           sizeof(err)) == 0;
+      const int64_t t1 = ptpu::NowUs();
+      if (ok) dstats.run_us.Observe(uint64_t(t1 - t0));
+      const float* lg =
+          ok ? ptpu_predictor_output_data(vpred, 0) : nullptr;
+      if (ok && !lg) ok = false;
+      for (size_t z = 0; z < m; ++z) {
+        Rctx& c = *vpart[off + z];
+        const float* lgv = nullptr;
+        char rerr[512] = {0};
+        PTPU_Predictor* p1 = ver_buckets.begin()->second;
+        if (ok) {
+          lgv = lg + int64_t(z) * ver_width * V;
+        } else {
+          const int64_t s1[1] = {vsids[z]};
+          if (ptpu_predictor_decode_step(
+                  p1, s1, vtoks.data() + int64_t(z) * ver_width, 1,
+                  rerr, sizeof(rerr)) != 0) {
+            round_error(c, std::string("spec verify: ") + rerr);
+            continue;
+          }
+          lgv = ptpu_predictor_output_data(p1, 0);
+          if (!lgv) {
+            round_error(c, "spec verify: no logits output");
+            continue;
+          }
+        }
+        // exact acceptance: greedy longest matching prefix, or
+        // modified rejection against the stored draft distribution
+        SpecState& st = *c.st;
+        const int64_t C0 = int64_t(st.committed.size());
+        int64_t acc = 0, next_tok = -1;
+        if (!st.sample) {
+          while (acc < c.k &&
+                 c.props[size_t(acc)] == spec_argmax(lgv + acc * V, V))
+            ++acc;
+          next_tok = spec_argmax(lgv + acc * V, V);
+        } else {
+          while (acc < c.k) {
+            spec_softmax(lgv + acc * V, V, pbuf.data());
+            const float* qrow = c.q.data() + acc * V;
+            const int64_t d = c.props[size_t(acc)];
+            const double u = spec_u01(&st.rng);
+            if (u * double(qrow[d]) < double(pbuf[size_t(d)])) {
+              ++acc;
+              continue;
+            }
+            // rejected: one draw from the residual max(0, p - q)
+            double norm = 0.0;
+            for (int64_t i = 0; i < V; ++i) {
+              const float ri =
+                  std::max(0.f, pbuf[size_t(i)] - qrow[i]);
+              rbuf[size_t(i)] = ri;
+              norm += double(ri);
+            }
+            next_tok =
+                norm > 0.0
+                    ? spec_sample(rbuf.data(), V, norm,
+                                  spec_u01(&st.rng))
+                    : spec_sample(pbuf.data(), V, 1.0,
+                                  spec_u01(&st.rng));
+            break;
+          }
+          if (next_tok < 0) {  // every proposal accepted: bonus draw
+            spec_softmax(lgv + c.k * V, V, pbuf.data());
+            next_tok = spec_sample(pbuf.data(), V, 1.0,
+                                   spec_u01(&st.rng));
+          }
+        }
+        for (int64_t j = 0; j < acc; ++j)
+          st.committed.push_back(c.props[size_t(j)]);
+        st.committed.push_back(next_tok);
+        // rollback: the verify appended ver_width positions; keep
+        // only the accepted prefix (+ the round-opening token)
+        ptpu_kvpool_trim(kv_pool, c.tslot, C0 + acc);
+        const int64_t fence = int64_t(st.committed.size()) - 1;
+        if (st.draft_len > fence) {
+          ptpu_kvpool_trim(draft_pool, st.draft_slot, fence);
+          st.draft_len = fence;
+        }
+        dstats.spec_rounds.Add(1);
+        dstats.spec_proposed.Add(uint64_t(c.k));
+        dstats.spec_accepted.Add(uint64_t(acc));
+        dstats.spec_tokens.Add(uint64_t(acc + 1));
+        std::vector<int64_t> out(size_t(acc + 1));
+        for (int64_t j = 0; j < acc; ++j)
+          out[size_t(j)] = c.props[size_t(j)];
+        out[size_t(acc)] = next_tok;
+        SendSpecRep(c.r->conn, c.r->id, c.r->session, c.r->wire_tid,
+                    uint32_t(acc), out.data(), uint32_t(out.size()));
+        if (c.r->trace_id) {
+          auto& tr = ptpu::trace::Global();
+          const uint64_t cid = c.r->conn->id();
+          tr.Record(c.r->trace_id, ptpu::trace::kRead, c.r->t_read_us,
+                    c.r->t_enq_us, cid, c.r->id);
+          tr.Record(c.r->trace_id, ptpu::trace::kQueue, c.r->t_enq_us,
+                    c.r->t_deq_us, cid, c.r->session);
+          tr.Record(c.r->trace_id, ptpu::trace::kBatch, c.r->t_deq_us,
+                    t0, cid, c.r->session);
+          tr.Record(c.r->trace_id, ptpu::trace::kDecode, t0, t1, cid,
+                    c.r->session);
+        }
+        c.r->conn->NotePending(-1);
+      }
     }
   }
 
@@ -1636,7 +2552,8 @@ struct SvServer {
     }
     if (tag == kTagDecodeOpen || tag == kTagDecodeStep ||
         tag == kTagDecodeClose || tag == kTagDecodeOpen2 ||
-        tag == kTagDecodeFork) {
+        tag == kTagDecodeFork || tag == kTagDecodeSpecOpen ||
+        tag == kTagDecodeSpecStep) {
       if (n < 2 + ext + 8) return proto_err();
       const uint64_t rid = ptpu::GetU64(req + 2 + ext);
       if (!dec_pred) {
@@ -1684,6 +2601,72 @@ struct SvServer {
         stats.bytes_out.Add(f.size());
         if (!conn->SendPayload(std::move(f)))
           return FrameResult::kClose;
+        return FrameResult::kOk;
+      }
+      if (tag == kTagDecodeSpecOpen) {
+        // [u64 req_id][u32 n_tokens][u32 flags][u64 seed][n x i64]
+        if (n < 2 + ext + 8 + 4 + 4 + 8) return proto_err();
+        const uint32_t ntok = GetU32(req + 10 + ext);
+        const uint32_t flags = GetU32(req + 14 + ext);
+        const uint64_t seed = ptpu::GetU64(req + 18 + ext);
+        if (uint64_t(n) != 2 + ext + 8 + 4 + 4 + 8 + 8ull * ntok)
+          return proto_err();
+        if (spec_k <= 0) {
+          SendErrFrame(conn, rid,
+                       "speculative decoding not configured (start "
+                       "the server with spec draft/verify models)");
+          return FrameResult::kOk;
+        }
+        if (flags & ~1u) {
+          SendErrFrame(conn, rid, "unknown DECODE_SPEC_OPEN flags");
+          return FrameResult::kOk;
+        }
+        if (ntok < 1 || int64_t(ntok) >= dec_ctx) {
+          SendErrFrame(conn, rid,
+                       "prompt length outside [1, context=" +
+                           std::to_string(dec_ctx) + ")");
+          return FrameResult::kOk;
+        }
+        std::vector<int64_t> toks(ntok);
+        for (uint32_t k = 0; k < ntok; ++k)
+          toks[k] = ptpu::GetI64(req + 26 + ext + 8 * size_t(k));
+        DecodeSpecOpen(conn, rid, wire_tid, flags, seed,
+                       std::move(toks));
+        return FrameResult::kOk;
+      }
+      if (tag == kTagDecodeSpecStep) {
+        if (n != 2 + ext + 8 + 8) return proto_err();
+        if (spec_k <= 0) {
+          SendErrFrame(conn, rid,
+                       "speculative decoding not configured (start "
+                       "the server with spec draft/verify models)");
+          return FrameResult::kOk;
+        }
+        SvRequest r;
+        r.is_decode = true;
+        r.is_spec = true;
+        r.id = rid;
+        r.session = ptpu::GetU64(req + 10 + ext);
+        r.rows = 1;
+        r.conn = conn;
+        r.wire_tid = wire_tid;
+        // a defer retry re-parses this 18/26-byte frame; only the
+        // FIRST attempt rolls the sampling dice
+        r.trace_id = retry && !wire_tid
+                         ? 0
+                         : ptpu::trace::Global().BeginRequest(wire_tid);
+        r.t_read_us = t_read;
+        r.t_enq_us = ptpu::NowUs();
+        if (!retry) dstats.steps.Add(1);
+        std::string why;
+        if (dec_batcher->enqueue(std::move(r), &why)) {
+          conn->NotePending(1);  // pairs with the SPEC_REP/error -1
+          return FrameResult::kOk;
+        }
+        if (why == "request queue full" &&
+            conn->deferred_us() < kSvDeferBudgetUs)
+          return FrameResult::kDefer;
+        SendErrFrame(conn, rid, why);
         return FrameResult::kOk;
       }
       if (tag == kTagDecodeOpen) {
@@ -1860,6 +2843,11 @@ struct SvServer {
     if (dec_batcher) {
       auto dec_left = dec_batcher->stop();
       for (auto& r : dec_left) leftover.push_back(std::move(r));
+      // spec rounds parked mid-catch-up by a full queue still owe a
+      // reply (their NotePending +1 is live)
+      ptpu::MutexLock l(sess_mu_);
+      for (auto& r : spec_resume_) leftover.push_back(std::move(r));
+      spec_resume_.clear();
     }
     for (auto& r : leftover) {
       if (r.is_prefill) {
@@ -1887,6 +2875,14 @@ struct SvServer {
       if (kv2.second != dec_pred) ptpu_predictor_destroy(kv2.second);
     dec_buckets.clear();
     dec_ladder.clear();
+    // spec planes: predictors before their pools (a pool must outlive
+    // every predictor attached to it)
+    for (auto& kv2 : ver_buckets) ptpu_predictor_destroy(kv2.second);
+    ver_buckets.clear();
+    ver_ladder.clear();
+    for (auto& kv2 : draft_buckets) ptpu_predictor_destroy(kv2.second);
+    draft_buckets.clear();
+    draft_ladder.clear();
     if (dec_pred) {
       ptpu_predictor_destroy(dec_pred);
       dec_pred = nullptr;
@@ -1894,6 +2890,10 @@ struct SvServer {
     if (kv_pool) {
       ptpu_kvpool_destroy(kv_pool);
       kv_pool = nullptr;
+    }
+    if (draft_pool) {
+      ptpu_kvpool_destroy(draft_pool);
+      draft_pool = nullptr;
     }
     if (dec_pool) {
       ptpu_workpool_destroy(dec_pool);
@@ -1982,6 +2982,12 @@ struct SvServer {
           {"forks", &dstats.forks},
           {"pool_exhausted", &dstats.pool_exhausted},
           {"bucket_miss", &dstats.bucket_miss},
+          {"spec_rounds", &dstats.spec_rounds},
+          {"spec_proposed", &dstats.spec_proposed},
+          {"spec_accepted", &dstats.spec_accepted},
+          {"spec_tokens", &dstats.spec_tokens},
+          {"spec_draft_steps", &dstats.spec_draft_steps},
+          {"spec_fallbacks", &dstats.spec_fallbacks},
       };
       for (const auto& kv : ds) {
         ptpu::AppendJsonU64(&out, kv.name, kv.c->Get());
@@ -1996,6 +3002,8 @@ struct SvServer {
       ptpu::AppendJsonU64(&out, "sessions_active", live);
       out += ',';
       ptpu::AppendJsonU64(&out, "kv_sessions", uint64_t(kv_sessions));
+      out += ',';
+      ptpu::AppendJsonU64(&out, "spec_k", uint64_t(spec_k));
       out += ',';
       ptpu::AppendJsonHist(&out, "run_us", dstats.run_us);
       out += ',';
@@ -2050,13 +3058,19 @@ thread_local std::string g_sv_json;
 
 extern "C" {
 
-/* Extended start (ISSUE 10): http_port >= 0 adds the telemetry
- * HTTP/1.1 listener (GET /metrics /healthz /statsz /tracez; 0 picks a
- * free port — ptpu_serving_http_port reports it) on the same epoll
- * event threads. Everything else is ptpu_serving_start2. */
+/* Extended start (ISSUE 13): speculative decoding. spec_draft_path is
+ * a SMALL model's width-1 decode artifact; spec_verify_path is the
+ * TARGET model exported at width k+1
+ * (models.gpt.export_gpt_decode(width=k+1)). Both NULL/empty disables
+ * speculation; passing only one fails. k derives from the verify
+ * artifact's width (capped by $PTPU_SPEC_K). Enables the
+ * DECODE_SPEC_OPEN/STEP wire ops (0x6d/0x6e -> 0x6f replies carrying
+ * per-round accept counts). Everything else is ptpu_serving_start3. */
 __attribute__((visibility("default")))
-void* ptpu_serving_start3(const char* model_path,
-                          const char* decode_model_path, int port,
+void* ptpu_serving_start4(const char* model_path,
+                          const char* decode_model_path,
+                          const char* spec_draft_path,
+                          const char* spec_verify_path, int port,
                           const char* authkey, int authkey_len,
                           int max_batch, int64_t deadline_us,
                           int instances, int threads_per_instance,
@@ -2067,6 +3081,8 @@ void* ptpu_serving_start3(const char* model_path,
     s->model_path = model_path ? model_path : "";
     s->decode_model_path =
         decode_model_path ? decode_model_path : "";
+    s->spec_draft_path = spec_draft_path ? spec_draft_path : "";
+    s->spec_verify_path = spec_verify_path ? spec_verify_path : "";
     s->kv_sessions = kv_sessions;
     s->authkey.assign(authkey ? authkey : "",
                       authkey_len > 0 ? size_t(authkey_len) : 0);
@@ -2083,6 +3099,25 @@ void* ptpu_serving_start3(const char* model_path,
     delete s;
     return nullptr;
   }
+}
+
+/* Extended start (ISSUE 10): http_port >= 0 adds the telemetry
+ * HTTP/1.1 listener (GET /metrics /healthz /statsz /tracez; 0 picks a
+ * free port — ptpu_serving_http_port reports it) on the same epoll
+ * event threads. Everything else is ptpu_serving_start2. */
+__attribute__((visibility("default")))
+void* ptpu_serving_start3(const char* model_path,
+                          const char* decode_model_path, int port,
+                          const char* authkey, int authkey_len,
+                          int max_batch, int64_t deadline_us,
+                          int instances, int threads_per_instance,
+                          int loopback_only, int kv_sessions,
+                          int http_port, char* err, int err_len) {
+  return ptpu_serving_start4(model_path, decode_model_path, nullptr,
+                             nullptr, port, authkey, authkey_len,
+                             max_batch, deadline_us, instances,
+                             threads_per_instance, loopback_only,
+                             kv_sessions, http_port, err, err_len);
 }
 
 /* Extended start (r9): `decode_model_path` (may be NULL/empty) adds

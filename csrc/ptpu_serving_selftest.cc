@@ -53,7 +53,7 @@ using ptpu::onnxw::put_lenf;
  * After t steps with tokens t_1..t_t the cache holds t_1..t_{t-1}, so
  * step t's logit is EXACTLY the running token sum — de-mux and slot
  * reuse are checkable to the last bit. */
-std::string build_decode_model() {
+std::string build_decode_model(int64_t P = 4) {
   std::string g;
   put_lenf(&g, 1, onnx_node_iattr("Cast", {"ids"}, {"idsf"}, "to", 1));
   put_lenf(&g, 1, onnx_node("Reshape", {"idsf", "sh_nk"}, {"nk"}));
@@ -73,11 +73,52 @@ std::string build_decode_model() {
   put_lenf(&g, 5, onnx_tensor_f32("zero", {}, &zerov, 1));
   put_lenf(&g, 11, onnx_value_info("ids", 7, {2, 1}));
   put_lenf(&g, 11, onnx_value_info("pos", 7, {2}));
-  put_lenf(&g, 11, onnx_value_info("k0", 1, {2, 4, 1, 1}));
-  put_lenf(&g, 11, onnx_value_info("v0", 1, {2, 4, 1, 1}));
+  put_lenf(&g, 11, onnx_value_info("k0", 1, {2, P, 1, 1}));
+  put_lenf(&g, 11, onnx_value_info("v0", 1, {2, P, 1, 1}));
   put_lenf(&g, 12, onnx_value_info("y", 1, {2, 1}));
   put_lenf(&g, 12, onnx_value_info("nk", 1, {2, 1, 1, 1}));
   put_lenf(&g, 12, onnx_value_info("nv", 1, {2, 1, 1, 1}));
+  std::string m;
+  put_lenf(&m, 7, g);
+  return m;
+}
+
+/* Width-2 sibling of build_decode_model — the hand-rolled
+ * speculative-VERIFY shape (kv_width == 2): ids [2,2], per-window
+ * running sums via a lower-triangular cumsum matmul, so row w's logit
+ * is EXACTLY cache_sum + ids[:, 0..w].sum (the same value the width-1
+ * model would produce stepped to that position):
+ *   y  [2,2]     = ReduceSum(k0) + cumsum(ids) + 0*pos
+ *   nk [2,2,1,1] = ids (appended window), nv = 2*ids */
+std::string build_decode_model_w2(int64_t P = 4) {
+  std::string g;
+  put_lenf(&g, 1, onnx_node_iattr("Cast", {"ids"}, {"idsf"}, "to", 1));
+  put_lenf(&g, 1, onnx_node("Reshape", {"idsf", "sh_nk"}, {"nk"}));
+  put_lenf(&g, 1, onnx_node("Mul", {"nk", "two"}, {"nv"}));
+  put_lenf(&g, 1, onnx_node("MatMul", {"idsf", "tri"}, {"cum"}));
+  put_lenf(&g, 1, onnx_node("ReduceSum", {"k0", "axes"}, {"ksum"}));
+  put_lenf(&g, 1, onnx_node("Reshape", {"ksum", "sh_y"}, {"ksum2"}));
+  put_lenf(&g, 1, onnx_node_iattr("Cast", {"pos"}, {"posf"}, "to", 1));
+  put_lenf(&g, 1, onnx_node("Reshape", {"posf", "sh_y"}, {"posr"}));
+  put_lenf(&g, 1, onnx_node("Mul", {"posr", "zero"}, {"pos0"}));
+  put_lenf(&g, 1, onnx_node("Add", {"cum", "ksum2"}, {"t1"}));
+  put_lenf(&g, 1, onnx_node("Add", {"t1", "pos0"}, {"y"}));
+  put_lenf(&g, 5, onnx_tensor_i64("sh_nk", {4}, {2, 2, 1, 1}));
+  put_lenf(&g, 5, onnx_tensor_i64("sh_y", {2}, {2, 1}));
+  put_lenf(&g, 5, onnx_tensor_i64("axes", {3}, {1, 2, 3}));
+  // column w of tri carries 1s for rows <= w: idsf @ tri == cumsum
+  const float triv[4] = {1.f, 1.f, 0.f, 1.f};
+  put_lenf(&g, 5, onnx_tensor_f32("tri", {2, 2}, triv, 4));
+  const float twov = 2.f, zerov = 0.f;
+  put_lenf(&g, 5, onnx_tensor_f32("two", {}, &twov, 1));
+  put_lenf(&g, 5, onnx_tensor_f32("zero", {}, &zerov, 1));
+  put_lenf(&g, 11, onnx_value_info("ids", 7, {2, 2}));
+  put_lenf(&g, 11, onnx_value_info("pos", 7, {2}));
+  put_lenf(&g, 11, onnx_value_info("k0", 1, {2, P, 1, 1}));
+  put_lenf(&g, 11, onnx_value_info("v0", 1, {2, P, 1, 1}));
+  put_lenf(&g, 12, onnx_value_info("y", 1, {2, 2}));
+  put_lenf(&g, 12, onnx_value_info("nk", 1, {2, 2, 1, 1}));
+  put_lenf(&g, 12, onnx_value_info("nv", 1, {2, 2, 1, 1}));
   std::string m;
   put_lenf(&m, 7, g);
   return m;
@@ -1022,6 +1063,345 @@ void test_serving_decode_paged_wire() {
   std::printf("  paged wire: open2/prefix/fork/backpressure/evict OK\n");
 }
 
+/* COW-fork rollback edges (ISSUE 13 satellite): kv_trim against the
+ * refcount machinery. Page size 2, running-sum decode artifact — the
+ * logit IS the history sum, so every rollback is checkable exactly.
+ *   (a) trim to a MID-PAGE boundary: the tail group survives, groups
+ *       past it free, and decoding continues from the shorter prefix;
+ *   (b) trim back ACROSS a shared prefix-cache page: the published
+ *       page is unreferenced, NEVER mutated — the next append COWs,
+ *       and a later adopter still reads the original bytes;
+ *   (c) trim to ZERO then continue: all groups free, the session
+ *       rebuilds from scratch. */
+void test_kvpool_trim_cow_edges() {
+  const std::string dec_path =
+      write_model_file(build_decode_model(), "ptpu_sv_selftest_dec.onnx");
+  char err[512] = {0};
+  PTPU_KvPool* pool = ptpu_kvpool_create(8, 2, 8, 1, err, sizeof(err));
+  assert(pool != nullptr);
+  PTPU_Predictor* p =
+      ptpu_predictor_create(dec_path.c_str(), err, sizeof(err));
+  assert(p != nullptr);
+  assert(ptpu_predictor_kv_attach(p, pool, err, sizeof(err)) == 0);
+  assert(ptpu_predictor_kv_width(p) == 1);
+  const auto step1 = [&](int sid, int64_t tok) -> float {
+    const int64_t sids[1] = {sid}, toks[1] = {tok};
+    char serr[512] = {0};
+    const int rc =
+        ptpu_predictor_decode_step(p, sids, toks, 1, serr, sizeof(serr));
+    assert(rc == 0 && "trim-edge decode step failed");
+    return ptpu_predictor_output_data(p, 0)[0];
+  };
+  const auto in_use = [&]() -> int64_t {
+    const std::string js = ptpu_kvpool_stats_json(pool);
+    const size_t at = js.find("\"pages_in_use\":");
+    assert(at != std::string::npos);
+    return std::atoll(js.c_str() + at + 15);
+  };
+
+  // (a) mid-page trim: 3 tokens = page 0 full + page 1 half
+  const int a = ptpu_kvpool_open(pool);
+  assert(step1(a, 5) == 5.f && step1(a, 7) == 12.f &&
+         step1(a, 11) == 23.f);
+  assert(in_use() == 2);
+  assert(ptpu_kvpool_trim(pool, a, 1) == 0);  // mid page 0
+  assert(ptpu_kvpool_len(pool, a) == 1 && in_use() == 1);
+  // rejected rows are unreadable: the sum restarts from {5}
+  assert(step1(a, 30) == 35.f);
+  assert(step1(a, 1) == 36.f);   // page 1 reallocates cleanly
+  // trim to the exact page boundary keeps the full page only
+  assert(ptpu_kvpool_trim(pool, a, 2) == 0);
+  assert(ptpu_kvpool_len(pool, a) == 2 && in_use() == 1);
+  // a no-op trim (new_len >= len) changes nothing
+  assert(ptpu_kvpool_trim(pool, a, 99) == 0);
+  assert(ptpu_kvpool_len(pool, a) == 2);
+
+  // (b) publish the 2-token page {5,30}, adopt it elsewhere, then
+  // trim the adopter back INTO the shared page and diverge: the
+  // shared bytes must survive via COW, never in-place mutation
+  const int64_t prompt[3] = {5, 30, 1};
+  assert(ptpu_kvpool_publish(pool, a, prompt, 3) == 0);
+  const int b = ptpu_kvpool_open(pool);
+  assert(ptpu_kvpool_adopt(pool, b, prompt, 3) == 2);
+  assert(step1(b, 1) == 36.f);       // replays a's history exactly
+  assert(ptpu_kvpool_trim(pool, b, 1) == 0);  // back INTO the page
+  assert(ptpu_kvpool_len(pool, b) == 1);
+  uint64_t cows0 = 0;
+  {
+    const std::string js = ptpu_kvpool_stats_json(pool);
+    const size_t at = js.find("\"cow_copies\":");
+    cows0 = uint64_t(std::atoll(js.c_str() + at + 13));
+  }
+  assert(step1(b, 100) == 105.f);    // {5, 100}: diverged mid-page
+  {
+    const std::string js = ptpu_kvpool_stats_json(pool);
+    const size_t at = js.find("\"cow_copies\":");
+    assert(uint64_t(std::atoll(js.c_str() + at + 13)) == cows0 + 1 &&
+           "divergence into a shared trimmed tail must COW");
+  }
+  // the published page is untouched: a third adopter still reads the
+  // ORIGINAL {5, 30} prefix
+  const int c = ptpu_kvpool_open(pool);
+  assert(ptpu_kvpool_adopt(pool, c, prompt, 3) == 2);
+  assert(step1(c, 1) == 36.f);
+
+  // (c) trim to zero, then continue decoding from scratch
+  assert(ptpu_kvpool_trim(pool, c, 0) == 0);
+  assert(ptpu_kvpool_len(pool, c) == 0);
+  assert(step1(c, 4) == 4.f && step1(c, 6) == 10.f);
+  // error paths: negative length, closed session
+  assert(ptpu_kvpool_trim(pool, c, -1) != 0);
+  ptpu_kvpool_close(pool, c);
+  assert(ptpu_kvpool_trim(pool, c, 0) != 0);
+  {
+    const std::string js = ptpu_kvpool_stats_json(pool);
+    assert(js.find("\"trims\":") != std::string::npos);
+  }
+  ptpu_kvpool_close(pool, a);
+  ptpu_kvpool_close(pool, b);
+  ptpu_predictor_destroy(p);
+  ptpu_kvpool_destroy(pool);
+  std::printf("  kv_trim: mid-page/shared-page-COW/zero edges    OK\n");
+}
+
+/* The modified-rejection acceptance rule must reproduce the TARGET
+ * distribution exactly regardless of the draft distribution — the
+ * mathematical core of "zero distribution drift". Known p/q vectors,
+ * 200k trials: empirical frequencies of (accept-d-else-residual-draw)
+ * match p within 4-sigma binomial bounds. Also pins argmax tie
+ * breaking (lowest index — np.argmax's rule) and the u64-seeded
+ * determinism of the splitmix64 stream. */
+void test_spec_sampler_exactness() {
+  const int64_t V = 4;
+  const float p[4] = {0.45f, 0.25f, 0.20f, 0.10f};  // target
+  const float q[4] = {0.10f, 0.40f, 0.10f, 0.40f};  // draft
+  uint64_t rng = 42;
+  int counts[4] = {0, 0, 0, 0};
+  const int N = 200000;
+  float rbuf[4];
+  for (int t = 0; t < N; ++t) {
+    // draft proposes d ~ q; accept with prob min(1, p/q); on reject
+    // draw from the normalized residual max(0, p - q)
+    const int64_t d = spec_sample(q, V, 1.0, spec_u01(&rng));
+    const double u = spec_u01(&rng);
+    int64_t out;
+    if (u * double(q[d]) < double(p[d])) {
+      out = d;
+    } else {
+      double norm = 0.0;
+      for (int64_t i = 0; i < V; ++i) {
+        rbuf[i] = std::max(0.f, p[i] - q[i]);
+        norm += double(rbuf[i]);
+      }
+      out = spec_sample(rbuf, V, norm, spec_u01(&rng));
+    }
+    ++counts[out];
+  }
+  for (int64_t i = 0; i < V; ++i) {
+    const double exp_n = double(N) * double(p[i]);
+    const double sd = std::sqrt(exp_n * (1.0 - double(p[i])));
+    const double dev = std::abs(double(counts[i]) - exp_n);
+    assert(dev < 4.0 * sd &&
+           "modified rejection drifted off the target distribution");
+  }
+  // argmax ties break LOW (np.argmax parity — the greedy gate)
+  const float tie[4] = {1.f, 3.f, 3.f, 0.f};
+  assert(spec_argmax(tie, 4) == 1);
+  // identical seeds give identical streams; different seeds diverge
+  uint64_t s1 = 7, s2 = 7, s3 = 8;
+  for (int t = 0; t < 16; ++t) {
+    const double a = spec_u01(&s1), b = spec_u01(&s2);
+    assert(a == b);
+    (void)b;
+  }
+  assert(spec_u01(&s1) != spec_u01(&s3));
+  // softmax of a known row: double-accumulated, sums to 1
+  const float lg[4] = {0.f, 1.f, 2.f, 3.f};
+  float sm[4];
+  spec_softmax(lg, 4, sm);
+  float sum = 0.f;
+  for (int i = 0; i < 4; ++i) sum += sm[i];
+  assert(std::abs(sum - 1.f) < 1e-5f && sm[3] > sm[2] && sm[2] > sm[1]);
+  std::printf("  spec sampler: modified-rejection == target dist  OK\n");
+}
+
+/* Speculative wire plane over hand-rolled artifacts (V=1 running-sum
+ * models for both target and draft): SPEC_OPEN prefill + first-token
+ * reply, SPEC_STEP rounds committing k+1 tokens with accept counts,
+ * kv_trim'd sessions continuing exactly, plain-step rejection on a
+ * spec session (and vice versa), fork rejection, session cleanup
+ * freeing BOTH pools, and the not-configured error on a spec-less
+ * server. */
+void test_serving_decode_spec_wire() {
+  setenv("PTPU_KV_PAGE", "2", 1);
+  std::vector<float> W;
+  const std::string mm_path = write_model_file(
+      build_matmul_model(4, 16, 8, &W), "ptpu_sv_selftest_decmm.onnx");
+  // P=16 keeps three spec rounds clear of both context fences (the
+  // P=4 artifact the other tests use would force fallbacks)
+  const std::string dec_path = write_model_file(
+      build_decode_model(16), "ptpu_sv_selftest_dec16.onnx");
+  const std::string ver_path = write_model_file(
+      build_decode_model_w2(16), "ptpu_sv_selftest_ver.onnx");
+  char err[512] = {0};
+  void* h = ptpu_serving_start4(
+      mm_path.c_str(), dec_path.c_str(), /*spec_draft=*/dec_path.c_str(),
+      /*spec_verify=*/ver_path.c_str(), 0, "dk", 2, 4, 3000, 1, 1, 1,
+      /*kv_sessions=*/4, /*http_port=*/-1, err, sizeof(err));
+  assert(h != nullptr && "spec serving start4 failed");
+  {
+    const std::string cfg = ptpu_serving_config_json(h);
+    assert(cfg.find("\"spec\":{\"k\":1") != std::string::npos);
+  }
+  SvTestClient cli;
+  assert(cli.connect_to(ptpu_serving_port(h)));
+  assert(cli.handshake("dk"));
+  // SPEC_OPEN: [ver][0x6d][u64 rid][u32 n][u32 flags][u64 seed][toks]
+  const auto spec_open = [&](uint64_t rid, std::vector<int64_t> toks,
+                             uint32_t flags, uint64_t* sess,
+                             uint32_t* adopted,
+                             std::vector<int64_t>* out,
+                             std::string* why) {
+    std::vector<uint8_t> f{kSvWireVersion, kTagDecodeSpecOpen}, rep;
+    f.resize(26 + 8 * toks.size());
+    ptpu::PutU64(f.data() + 2, rid);
+    ptpu::PutU32(f.data() + 10, uint32_t(toks.size()));
+    ptpu::PutU32(f.data() + 14, flags);
+    ptpu::PutU64(f.data() + 18, 99);
+    for (size_t k = 0; k < toks.size(); ++k)
+      ptpu::PutI64(f.data() + 26 + 8 * k, toks[k]);
+    assert(cli.send_frame(f) && cli.read_frame(&rep));
+    assert(ptpu::GetU64(rep.data() + 2) == rid);
+    if (rep[1] == kTagInferErr) {
+      const uint32_t ml = ptpu::GetU32(rep.data() + 10);
+      why->assign((const char*)rep.data() + 14, ml);
+      return false;
+    }
+    assert(rep[1] == kTagDecodeSpecRep);
+    *sess = ptpu::GetU64(rep.data() + 10);
+    *adopted = ptpu::GetU32(rep.data() + 18);
+    const uint32_t n = ptpu::GetU32(rep.data() + 22);
+    out->clear();
+    for (uint32_t k = 0; k < n; ++k)
+      out->push_back(ptpu::GetI64(rep.data() + 26 + 8 * size_t(k)));
+    return true;
+  };
+  const auto spec_step = [&](uint64_t rid, uint64_t sess,
+                             uint32_t* accepted,
+                             std::vector<int64_t>* out,
+                             std::string* why) {
+    std::vector<uint8_t> f{kSvWireVersion, kTagDecodeSpecStep}, rep;
+    f.resize(18);
+    ptpu::PutU64(f.data() + 2, rid);
+    ptpu::PutU64(f.data() + 10, sess);
+    assert(cli.send_frame(f) && cli.read_frame(&rep));
+    assert(ptpu::GetU64(rep.data() + 2) == rid);
+    if (rep[1] == kTagInferErr) {
+      const uint32_t ml = ptpu::GetU32(rep.data() + 10);
+      why->assign((const char*)rep.data() + 14, ml);
+      return false;
+    }
+    assert(rep[1] == kTagDecodeSpecRep);
+    *accepted = ptpu::GetU32(rep.data() + 18);
+    const uint32_t n = ptpu::GetU32(rep.data() + 22);
+    out->clear();
+    for (uint32_t k = 0; k < n; ++k)
+      out->push_back(ptpu::GetI64(rep.data() + 26 + 8 * size_t(k)));
+    return true;
+  };
+  uint64_t s1 = 0;
+  uint32_t ad = 0, acc = 0;
+  std::vector<int64_t> toks;
+  std::string why;
+  // V=1 vocab: every argmax is token 0, so k=1 rounds always accept
+  // the proposal and commit 2 tokens — the full machinery (draft
+  // burst, width-2 verify, rollback trims, counters) still runs
+  assert(spec_open(1, {3, 4}, 0, &s1, &ad, &toks, &why));
+  assert(toks.size() == 1 && toks[0] == 0);
+  for (int r = 0; r < 3; ++r) {
+    assert(spec_step(2 + uint64_t(r), s1, &acc, &toks, &why));
+    assert(acc == 1 && toks.size() == 2);
+    assert(toks[0] == 0 && toks[1] == 0);
+  }
+  // a plain DECODE_STEP on the spec session is refused (and the
+  // session stays usable)
+  {
+    std::vector<uint8_t> f{kSvWireVersion, kTagDecodeStep}, rep;
+    f.resize(26);
+    ptpu::PutU64(f.data() + 2, 10);
+    ptpu::PutU64(f.data() + 10, s1);
+    ptpu::PutI64(f.data() + 18, 1);
+    assert(cli.send_frame(f) && cli.read_frame(&rep));
+    assert(rep[1] == kTagInferErr);
+  }
+  // forking a spec session is refused
+  {
+    std::vector<uint8_t> f{kSvWireVersion, kTagDecodeFork}, rep;
+    f.resize(18);
+    ptpu::PutU64(f.data() + 2, 11);
+    ptpu::PutU64(f.data() + 10, s1);
+    assert(cli.send_frame(f) && cli.read_frame(&rep));
+    assert(rep[1] == kTagInferErr);
+  }
+  // SPEC_STEP on a PLAIN session is refused
+  {
+    std::vector<uint8_t> of{kSvWireVersion, kTagDecodeOpen}, orep;
+    of.resize(10);
+    ptpu::PutU64(of.data() + 2, 12);
+    assert(cli.send_frame(of) && cli.read_frame(&orep));
+    assert(orep[1] == kTagDecodeSess);
+    const uint64_t plain = ptpu::GetU64(orep.data() + 10);
+    uint32_t a2 = 0;
+    assert(!spec_step(13, plain, &a2, &toks, &why));
+    assert(why.find("not a speculative") != std::string::npos);
+  }
+  // counters: rounds ran, proposals == accepts (V=1), tokens flowed,
+  // and the verify trims rolled the padding back every round
+  {
+    const std::string js = ptpu_serving_stats_json(h);
+    assert(js.find("\"spec_rounds\":3") != std::string::npos);
+    assert(js.find("\"spec_proposed\":3") != std::string::npos);
+    assert(js.find("\"spec_accepted\":3") != std::string::npos);
+    assert(js.find("\"spec_tokens\":6") != std::string::npos);
+    assert(js.find("\"spec_fallbacks\":0") != std::string::npos);
+    assert(js.find("\"trims\":") != std::string::npos);
+  }
+  // closing the session frees BOTH pools' sessions
+  {
+    std::vector<uint8_t> cf{kSvWireVersion, kTagDecodeClose}, crep;
+    cf.resize(18);
+    ptpu::PutU64(cf.data() + 2, 14);
+    ptpu::PutU64(cf.data() + 10, s1);
+    assert(cli.send_frame(cf) && cli.read_frame(&crep));
+    assert(crep[1] == kTagDecodeSess);
+  }
+  cli.close();
+  ptpu_serving_stop(h);
+  // a spec-less server answers SPEC ops with "not configured"
+  void* h2 = ptpu_serving_start2(mm_path.c_str(), dec_path.c_str(), 0,
+                                 "dk", 2, 4, 3000, 1, 1, 1, 4, err,
+                                 sizeof(err));
+  assert(h2 != nullptr);
+  SvTestClient cli2;
+  assert(cli2.connect_to(ptpu_serving_port(h2)));
+  assert(cli2.handshake("dk"));
+  {
+    std::vector<uint8_t> f{kSvWireVersion, kTagDecodeSpecStep}, rep;
+    f.resize(18);
+    ptpu::PutU64(f.data() + 2, 1);
+    ptpu::PutU64(f.data() + 10, 7);
+    assert(cli2.send_frame(f) && cli2.read_frame(&rep));
+    assert(rep[1] == kTagInferErr);
+    const uint32_t ml = ptpu::GetU32(rep.data() + 10);
+    const std::string msg((const char*)rep.data() + 14, ml);
+    assert(msg.find("not configured") != std::string::npos);
+  }
+  cli2.close();
+  ptpu_serving_stop(h2);
+  unsetenv("PTPU_KV_PAGE");
+  std::printf("  spec wire: open/step/guards/counters/cleanup     OK\n");
+}
+
 }  // namespace
 
 int main() {
@@ -1037,6 +1417,9 @@ int main() {
   test_serving_decode_wire();
   test_kvpool_pager_abi();
   test_serving_decode_paged_wire();
+  test_kvpool_trim_cow_edges();
+  test_spec_sampler_exactness();
+  test_serving_decode_spec_wire();
   std::printf("ptpu_serving_selftest: all native serving unit tests "
               "passed\n");
   return 0;

@@ -331,16 +331,48 @@ func (p *Predictor) KvLen(sid int) int64 {
 	return n
 }
 
-// DecodeStep feeds tokens[r] into open session sids[r] (one batched
-// step; a session may appear at most once per call). Next-token logits
-// are rows 0..len(sids)-1 of Output(0).
+// KvWidth reports the artifact's baked step width W — tokens fed per
+// session per DecodeStep (1 for the classic autoregressive step, k+1
+// for a speculative-verify export). 0 before KvPlan/KvAttach.
+func (p *Predictor) KvWidth() int {
+	n := int(C.ptpu_predictor_kv_width(p.p))
+	runtime.KeepAlive(p)
+	return n
+}
+
+// KvTrim truncates a session to newLen positions — the speculative-
+// decoding rollback. Paged sessions release page groups past the new
+// tail copy-on-write-safely (shared groups are unreferenced, never
+// mutated). No-op when newLen >= the session length.
+func (p *Predictor) KvTrim(sid int, newLen int64) error {
+	if p.p == nil {
+		return errors.New("KvTrim: predictor is destroyed")
+	}
+	buf := make([]C.char, errLen)
+	rc := C.ptpu_predictor_kv_trim(p.p, C.int(sid), C.int64_t(newLen),
+		&buf[0], errLen)
+	runtime.KeepAlive(p)
+	if rc != 0 {
+		return lastErr(buf)
+	}
+	return nil
+}
+
+// DecodeStep feeds tokens[r*W .. r*W+W-1] into open session sids[r]
+// (one batched step at the artifact's KvWidth W; a session may appear
+// at most once per call). Next-token logits are rows
+// 0..len(sids)-1 of Output(0).
 func (p *Predictor) DecodeStep(sids, tokens []int64) error {
 	if p.p == nil {
 		return errors.New("DecodeStep: predictor is destroyed")
 	}
-	if len(sids) == 0 || len(sids) != len(tokens) {
-		return errors.New("DecodeStep: sids/tokens must be equal-length" +
-			" and non-empty")
+	w := p.KvWidth()
+	if w < 1 {
+		w = 1
+	}
+	if len(sids) == 0 || len(tokens) != len(sids)*w {
+		return errors.New("DecodeStep: need len(tokens) == " +
+			"len(sids) * KvWidth() and non-empty sids")
 	}
 	buf := make([]C.char, errLen)
 	rc := C.ptpu_predictor_decode_step(p.p,
@@ -476,6 +508,15 @@ func (k *KvPool) Publish(sid int, tokens []int64) {
 		C.int64_t(len(tokens)))
 	runtime.KeepAlive(k)
 	runtime.KeepAlive(tokens)
+}
+
+// Trim truncates a pool session to newLen positions (speculative
+// rollback — shared page groups are unreferenced, never mutated).
+// Returns false on a closed/bad session.
+func (k *KvPool) Trim(sid int, newLen int64) bool {
+	rc := int(C.ptpu_kvpool_trim(k.p, C.int(sid), C.int64_t(newLen)))
+	runtime.KeepAlive(k)
+	return rc == 0
 }
 
 // StatsJSON returns the pool's gauge/counter snapshot

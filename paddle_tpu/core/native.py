@@ -744,6 +744,23 @@ def _predictor_lib() -> ctypes.CDLL:
         except AttributeError:   # stale prebuilt .so: telemetry off
             lib._ptpu_has_http = False
         try:
+            # speculative decoding ABI (r13) — width-k verify steps,
+            # COW-safe session trims, draft/verify server start
+            lib.ptpu_predictor_kv_width.argtypes = [c.c_void_p]
+            lib.ptpu_predictor_kv_trim.argtypes = [
+                c.c_void_p, c.c_int, c.c_int64, c.c_char_p, c.c_int]
+            lib.ptpu_kvpool_trim.argtypes = [
+                c.c_void_p, c.c_int, c.c_int64]
+            lib.ptpu_serving_start4.restype = c.c_void_p
+            lib.ptpu_serving_start4.argtypes = [
+                c.c_char_p, c.c_char_p, c.c_char_p, c.c_char_p,
+                c.c_int, c.c_char_p, c.c_int, c.c_int, c.c_int64,
+                c.c_int, c.c_int, c.c_int, c.c_int, c.c_int,
+                c.c_char_p, c.c_int]
+            lib._ptpu_has_spec = True
+        except AttributeError:   # stale prebuilt .so: spec degrades
+            lib._ptpu_has_spec = False
+        try:
             lib.ptpu_predictor_stats_json.restype = c.c_char_p
             lib.ptpu_predictor_stats_json.argtypes = [c.c_void_p]
             lib.ptpu_predictor_stats_reset.argtypes = [c.c_void_p]
@@ -931,17 +948,46 @@ class NativePredictor:
         self._need_decode()
         return int(self._lib.ptpu_predictor_kv_len(self._handle(), sid))
 
+    def kv_width(self) -> int:
+        """Step width W baked into the artifact's ids input [B, W]: 1
+        for the classic autoregressive step, k+1 for a
+        speculative-verify export. 0 before kv_plan/kv_attach."""
+        if not getattr(self._lib, "_ptpu_has_spec", False):
+            return 1
+        return int(self._lib.ptpu_predictor_kv_width(self._handle()))
+
+    def kv_trim(self, sid: int, new_len: int) -> None:
+        """Truncate a session to ``new_len`` positions — the
+        speculative-decoding rollback. Paged sessions release page
+        groups past the new tail COW-safely (shared groups are
+        unreferenced, never mutated; published prefix pages and fork
+        siblings keep their bytes). No-op when new_len >= len."""
+        self._need_decode()
+        if not getattr(self._lib, "_ptpu_has_spec", False):
+            raise RuntimeError(
+                "kv_trim needs the r13 ABI (stale "
+                "_native_predictor.so: delete it and re-import)")
+        if self._lib.ptpu_predictor_kv_trim(self._handle(), sid,
+                                            new_len, self._err,
+                                            512) != 0:
+            raise RuntimeError("kv_trim: " + self._err.value.decode())
+
     def decode_step(self, sids, tokens):
-        """One batched decode step: feed tokens[r] into open session
-        sids[r]; returns the per-row next-token logits (len(sids) rows
-        of output 0). Appends each row's k/v into its session cache."""
+        """One batched decode step: feed tokens[r*W .. r*W+W-1] into
+        open session sids[r] (W == :meth:`kv_width`, 1 for classic
+        artifacts); returns the per-row next-token logits (len(sids)
+        rows of output 0). Appends each row's k/v into its session
+        cache and advances its length by W."""
         self._need_decode()
         np = self._np
         c = ctypes
         sids = np.ascontiguousarray(sids, np.int64)
         tokens = np.ascontiguousarray(tokens, np.int64)
-        if sids.size != tokens.size:
-            raise ValueError("decode_step: sids/tokens length mismatch")
+        w = max(1, self.kv_width())
+        if tokens.size != sids.size * w:
+            raise ValueError(
+                f"decode_step: need len(sids) * width ({sids.size} * "
+                f"{w}) tokens, got {tokens.size}")
         rc = self._lib.ptpu_predictor_decode_step(
             self._handle(), sids.ctypes.data_as(c.POINTER(c.c_int64)),
             tokens.ctypes.data_as(c.POINTER(c.c_int64)), sids.size,
@@ -1059,6 +1105,19 @@ class KvPool:
             self._handle(), sid,
             t.ctypes.data_as(c.POINTER(c.c_int64)), t.size)
 
+    def trim(self, sid: int, new_len: int) -> bool:
+        """Truncate a pool session to ``new_len`` positions
+        (speculative rollback: groups past the new tail are released
+        or merely unreferenced when shared — published prefix pages
+        and fork siblings are never mutated). False on a closed/bad
+        session."""
+        if not getattr(self._lib, "_ptpu_has_spec", False):
+            raise RuntimeError(
+                "trim needs the r13 ABI (stale _native_predictor.so: "
+                "delete it and re-import)")
+        return self._lib.ptpu_kvpool_trim(self._handle(), sid,
+                                          new_len) == 0
+
     def stats(self) -> dict:
         import json
         return json.loads(
@@ -1151,14 +1210,16 @@ ABI_SYMBOLS = {
         "ptpu_predictor_stats_reset", "ptpu_predictor_set_profiler",
         "ptpu_predictor_kv_plan", "ptpu_predictor_kv_sessions",
         "ptpu_predictor_kv_open", "ptpu_predictor_kv_close",
-        "ptpu_predictor_kv_len", "ptpu_predictor_decode_step",
+        "ptpu_predictor_kv_len", "ptpu_predictor_kv_width",
+        "ptpu_predictor_kv_trim", "ptpu_predictor_decode_step",
         "ptpu_kvpool_create", "ptpu_kvpool_destroy",
         "ptpu_predictor_kv_attach", "ptpu_predictor_kv_direct",
         "ptpu_kvpool_open", "ptpu_kvpool_fork", "ptpu_kvpool_close",
         "ptpu_kvpool_len", "ptpu_kvpool_adopt", "ptpu_kvpool_publish",
-        "ptpu_kvpool_stats_json",
+        "ptpu_kvpool_trim", "ptpu_kvpool_stats_json",
         "ptpu_serving_start", "ptpu_serving_start2",
-        "ptpu_serving_start3", "ptpu_serving_port",
+        "ptpu_serving_start3", "ptpu_serving_start4",
+        "ptpu_serving_port",
         "ptpu_serving_http_port", "ptpu_serving_drain_begin",
         "ptpu_serving_config_json", "ptpu_serving_stats_json",
         "ptpu_serving_stats_reset", "ptpu_serving_prom_text",

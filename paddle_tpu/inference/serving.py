@@ -59,6 +59,21 @@ TAG_DECODE_CLOSE = 0x69
 TAG_DECODE_OPEN2 = 0x6a
 TAG_DECODE_OPEN_REP = 0x6b
 TAG_DECODE_FORK = 0x6c
+# Speculative-decoding ops (r13) — csrc/ptpu_serving.cc
+# kTagDecodeSpec* twins. Layouts (payload offsets): SPEC_OPEN
+# [ver][tag][u64 req_id][u32 n_tokens @10][u32 flags @14, bit0 =
+# sampling][u64 seed @18][n x i64 @26] — the server opens a target
+# session AND its draft twin, prefills the prompt, and answers
+# SPEC_REP with the first generated token. SPEC_STEP [ver][tag]
+# [u64 req_id][u64 session] runs ONE draft/verify round. SPEC_REP
+# [ver][tag][u64 req_id][u64 session][u32 accepted @18][u32 n @22]
+# [n x i64 @26]: on open accepted = prefix-cache adopted tokens and
+# n = 1; on step accepted = draft tokens accepted this round and
+# n = accepted + 1 (the bonus/correction token is target-sourced).
+# (+8 on every offset past [ver][tag] for traced v2 frames.)
+TAG_DECODE_SPEC_OPEN = 0x6d
+TAG_DECODE_SPEC_STEP = 0x6e
+TAG_DECODE_SPEC_REP = 0x6f
 
 # Traced frames (ISSUE 10): version 2 inserts a client-generated
 # [u64-LE trace id] between [ver][tag] and the v1 body; REP frames for
@@ -120,7 +135,9 @@ class InferenceServer:
                  loopback_only: bool = True,
                  decode_model: Optional[str] = None,
                  kv_sessions: int = 0,
-                 http_port: Optional[int] = None):
+                 http_port: Optional[int] = None,
+                 spec_model: Optional[str] = None,
+                 spec_verify_model: Optional[str] = None):
         from ..core.native import _predictor_lib
         lib = _predictor_lib()
         if not getattr(lib, "_ptpu_has_serving", False):
@@ -131,11 +148,27 @@ class InferenceServer:
         self.authkey = authkey if authkey is not None else os.urandom(16)
         err = ctypes.create_string_buffer(512)
         has_http = getattr(lib, "_ptpu_has_http", False)
+        has_spec = getattr(lib, "_ptpu_has_spec", False)
         if http_port is not None and not has_http:
             raise RuntimeError(
                 "telemetry HTTP needs the r10 ABI (stale "
                 "_native_predictor.so: delete it and re-import)")
-        if has_http:
+        if (spec_model or spec_verify_model) and not has_spec:
+            raise RuntimeError(
+                "speculative decoding needs the r13 ABI (stale "
+                "_native_predictor.so: delete it and re-import)")
+        if spec_model or spec_verify_model:
+            self._h = lib.ptpu_serving_start4(
+                model_path.encode(),
+                decode_model.encode() if decode_model else None,
+                spec_model.encode() if spec_model else None,
+                spec_verify_model.encode() if spec_verify_model
+                else None, port, self.authkey, len(self.authkey),
+                max_batch, deadline_us, instances,
+                threads_per_instance, 1 if loopback_only else 0,
+                kv_sessions, -1 if http_port is None else http_port,
+                err, 512)
+        elif has_http:
             self._h = lib.ptpu_serving_start3(
                 model_path.encode(),
                 decode_model.encode() if decode_model else None, port,
@@ -245,6 +278,18 @@ def create_server(model_path: str, **kwargs) -> InferenceServer:
     decode-step artifact from models.gpt.export_gpt_decode — enables
     the DECODE wire ops), `kv_sessions` (max concurrent decode
     sessions; 0 = $PTPU_KV_SESSIONS, default 4096 paged / 64 legacy).
+
+    Speculative decoding (r13): pass ``spec_model`` (a SMALL draft
+    model's width-1 decode artifact) AND ``spec_verify_model`` (the
+    TARGET model exported at width k+1 via
+    ``models.gpt.export_gpt_decode(width=k+1)``) to enable the
+    DECODE_SPEC wire ops — the server proposes k tokens per round with
+    the draft, verifies all of them (+ the bonus position) in one
+    batched multi-position target pass, and rolls rejected tokens back
+    by truncating the session's paged block table. Greedy rounds
+    reproduce non-speculative greedy decoding exactly; sampling rounds
+    use the modified-rejection rule (distribution-exact). Knobs:
+    ``PTPU_SPEC_K`` caps k below the verify artifact's width - 1.
 
     The decode plane defaults to the PAGED generation engine (r12):
     sessions draw fixed-size pages from one shared pool (RAM scales
@@ -677,6 +722,114 @@ class InferenceClient:
             else:
                 raise ConnectionError(
                     f"unexpected decode reply tag {f[1]:#x}")
+        if not return_exceptions:
+            for r in results:
+                if isinstance(r, ServingError):
+                    raise r
+        return results
+
+    # -------------------------------------------- speculative decode
+    @staticmethod
+    def _spec_rep_parse(f: bytes):
+        """-> (session, accepted, tokens) of a DECODE_SPEC_REP."""
+        base = _frame_base(f)
+        sess = _U64.unpack_from(f, 10 + base)[0]
+        (accepted,) = _U32.unpack_from(f, 18 + base)
+        (n,) = _U32.unpack_from(f, 22 + base)
+        toks = [int(_I64.unpack_from(f, 26 + base + 8 * k)[0])
+                for k in range(n)]
+        return sess, int(accepted), toks
+
+    def spec_open(self, prompt: Sequence[int], seed: int = 0,
+                  sample: bool = False,
+                  timeout: Optional[float] = None):
+        """Open a SPECULATIVE decode session: the server prefills the
+        prompt into a target session AND a draft twin, then returns
+        ``(session, tokens, adopted)`` where ``tokens`` holds the
+        first generated token (greedy argmax, or one draw from the
+        target softmax when ``sample=True`` — ``seed`` makes the
+        server-side sampler deterministic). Generate with
+        :meth:`spec_step`; tokens arrive in bursts of ``accepted + 1``
+        per round with zero distribution drift vs plain decoding."""
+        toks = np.ascontiguousarray(prompt, np.int64)
+        if toks.ndim != 1 or toks.size < 1:
+            raise ValueError("spec_open: prompt must be a non-empty "
+                             "1-D token sequence")
+        rid = self._next_id
+        self._next_id += 1
+        payload = (bytes([WIRE_VERSION, TAG_DECODE_SPEC_OPEN]) +
+                   _U64.pack(rid) + _U32.pack(toks.size) +
+                   _U32.pack(1 if sample else 0) +
+                   _U64.pack(seed & (2 ** 64 - 1)) + toks.tobytes())
+        old_to = self._sock.gettimeout()
+        if timeout is not None:
+            self._sock.settimeout(timeout)
+        try:
+            self._send_frame(payload)
+            f = self._decode_reply_expect(TAG_DECODE_SPEC_REP, rid)
+        finally:
+            if timeout is not None:
+                self._sock.settimeout(old_to)
+        sess, adopted, tokens = self._spec_rep_parse(f)
+        return sess, tokens, adopted
+
+    def spec_step(self, session: int):
+        """One speculative round: the draft proposes k tokens, the
+        target verifies them in one pass. Returns ``(tokens,
+        accepted)`` — the 1..k+1 tokens committed this round and how
+        many came from the draft (the last token is always
+        target-sourced)."""
+        rid = self._next_id
+        self._next_id += 1
+        tid, t0 = self._trace_begin()
+        if tid:
+            payload = (bytes([WIRE_VERSION_TRACED,
+                              TAG_DECODE_SPEC_STEP]) +
+                       _U64.pack(tid) + _U64.pack(rid) +
+                       _U64.pack(session))
+        else:
+            payload = (bytes([WIRE_VERSION, TAG_DECODE_SPEC_STEP]) +
+                       _U64.pack(rid) + _U64.pack(session))
+        self._send_frame(payload)
+        f = self._decode_reply_expect(TAG_DECODE_SPEC_REP, rid)
+        self._trace_end(tid, t0, "client.spec_step", f)
+        _, accepted, tokens = self._spec_rep_parse(f)
+        return tokens, accepted
+
+    def spec_step_many(self, sessions,
+                       return_exceptions: bool = False):
+        """Pipelined speculative rounds across sessions: one
+        SPEC_STEP per session id, all frames written before replies
+        drain, so different sessions' draft bursts and verify passes
+        batch server-side. Returns ``[(tokens, accepted), ...]`` in
+        input order; server errors surface like infer_many."""
+        results = [None] * len(sessions)
+        pending = {}
+        for i, sess in enumerate(sessions):
+            rid = self._next_id
+            self._next_id += 1
+            pending[rid] = i
+            self._send_frame(bytes([WIRE_VERSION,
+                                    TAG_DECODE_SPEC_STEP]) +
+                             _U64.pack(rid) + _U64.pack(sess))
+        while pending:
+            f = self._read_frame()
+            base = _frame_base(f)
+            got = _U64.unpack_from(f, 2 + base)[0]
+            if got not in pending:
+                raise ConnectionError(
+                    f"unexpected spec reply id {got}")
+            i = pending.pop(got)
+            if f[1] == TAG_INFER_ERR:
+                (mlen,) = _U32.unpack_from(f, 10 + base)
+                results[i] = ServingError(
+                    f[14 + base:14 + base + mlen].decode())
+            elif f[1] == TAG_DECODE_SPEC_REP:
+                _, accepted, tokens = self._spec_rep_parse(f)
+                results[i] = (tokens, accepted)
+            else:
+                raise ConnectionError(
+                    f"unexpected spec reply tag {f[1]:#x}")
         if not return_exceptions:
             for r in results:
                 if isinstance(r, ServingError):

@@ -1076,51 +1076,69 @@ def _build_offload_chunked_step(*, cfg, optimizer, outer, stacked,
 # KV-cached decode-step export (native serving DECODE workload)
 # --------------------------------------------------------------------------
 
-def make_gpt_decode_step(model: GPTForPretraining, context: int):
-    """Build the single-token decode-step function for the native
-    predictor's KV-cache convention (csrc/ptpu_predictor.cc kv_plan):
+def make_gpt_decode_step(model: GPTForPretraining, context: int,
+                         width: int = 1):
+    """Build the decode-step function for the native predictor's
+    KV-cache convention (csrc/ptpu_predictor.cc kv_plan/kv_attach):
 
-      step(ids[B,1] i32, pos[B] i32, k0, v0, ..., k_{L-1}, v_{L-1})
-        -> (logits[B, V], nk0, nv0, ..., nk_{L-1}, nv_{L-1})
+      step(ids[B,W] i32, pos[B] i32, k0, v0, ..., k_{L-1}, v_{L-1})
+        -> (logits, nk0, nv0, ..., nk_{L-1}, nv_{L-1})
 
-    Cache operands are ``[B, context, heads, head_dim]`` float32 in the
-    exporter's [batch, seq, heads, head_dim] attention layout; each
-    ``nk``/``nv`` is the current token's ``[B, 1, heads, head_dim]``
-    projection, which the C runtime appends into the session's slot at
-    position ``pos``. Attention runs over ``concat(cache, current)``
-    with positions ``j < pos`` (cache) and the current token unmasked —
-    a fixed-shape graph, so it loads onto the planned zero-alloc arena
-    and the attention block fuses into PtpuAttention like the full-seq
-    export."""
+    ``W = width`` is the number of positions fed per session per step:
+    width 1 is the classic autoregressive step (logits ``[B, V]``, the
+    shape the r9 engine pinned); width k+1 is the speculative-decoding
+    VERIFY artifact — the target model scores a draft's k proposals
+    plus the bonus position in ONE pass (logits ``[B, W, V]``, one row
+    per fed position). Cache operands are ``[B, context, heads,
+    head_dim]`` float32; each ``nk``/``nv`` is the fed window's
+    ``[B, W, heads, head_dim]`` projection, which the C runtime
+    appends into the session at positions ``pos .. pos+W-1``.
+    Attention runs over ``concat(cache, window)``: cache positions
+    ``j < pos`` are live, the zero tail ``[pos, P)`` is masked, and
+    the window is causal (window key w' attends from window query
+    ``w >= w'``) — a fixed-shape graph, so it loads onto the planned
+    zero-alloc arena and the attention block fuses into PtpuAttention
+    (and onto the block-table PtpuPagedAttention under kv_attach)
+    exactly like the width-1 export."""
     cfg = model.config
-    if context < 1 or context + 1 > cfg.max_position_embeddings:
+    if width < 1:
+        raise ValueError(f"width must be >= 1 (got {width})")
+    if context < 1 or context + width > cfg.max_position_embeddings:
         raise ValueError(
-            f"context {context} needs max_position_embeddings > context "
+            f"context {context} + width {width} needs "
+            f"max_position_embeddings > context + width - 1 "
             f"(got {cfg.max_position_embeddings})")
+    W = width
 
     def block_step(blk, x, k_cache, v_cache, pos):
         b = x.shape[0]
         h, hd = blk.num_heads, blk.head_dim
         res = x
         qkv = blk.qkv(blk.ln1(x))
-        qkv = jnp.reshape(qkv, (b, 1, 3, h, hd))
+        qkv = jnp.reshape(qkv, (b, W, 3, h, hd))
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        kcat = jnp.concatenate([k_cache, k], axis=1)   # [b, P+1, h, hd]
+        kcat = jnp.concatenate([k_cache, k], axis=1)   # [b, P+W, h, hd]
         vcat = jnp.concatenate([v_cache, v], axis=1)
         P = k_cache.shape[1]
-        j = jnp.arange(P + 1, dtype=jnp.int32)
-        valid = (j[None, :] < pos[:, None]) | (j[None, :] == P)
+        j = jnp.arange(P + W, dtype=jnp.int32)
+        wq = jnp.arange(W, dtype=jnp.int32)
+        # [b, W, P+W]: cache keys below the session length, plus the
+        # causal lower triangle of the fed window itself
+        valid = (j[None, None, :] < pos[:, None, None]) | \
+            ((j[None, None, :] >= P) &
+             (j[None, None, :] - P <= wq[None, :, None]))
         attn = F.scaled_dot_product_attention(
-            q, kcat, vcat, attn_mask=valid[:, None, None, :],
+            q, kcat, vcat, attn_mask=valid[:, None, :, :],
             training=False)
-        attn = jnp.reshape(attn, (b, 1, h * hd))
+        attn = jnp.reshape(attn, (b, W, h * hd))
         x = res + blk.out_proj(attn)
         res = x
         y = blk.fc2(F.gelu(blk.fc1(blk.ln2(x)), approximate=True))
         return res + y, k, v
 
     def step(ids, pos, *caches):
-        x = model.gpt.embeddings(ids, pos[:, None])
+        wq = jnp.arange(W, dtype=jnp.int32)
+        x = model.gpt.embeddings(ids, pos[:, None] + wq[None, :])
         news = []
         for li, blk in enumerate(model.gpt.layers):
             x, nk, nv = block_step(blk, x, caches[2 * li],
@@ -1128,25 +1146,31 @@ def make_gpt_decode_step(model: GPTForPretraining, context: int):
             news.append(nk)
             news.append(nv)
         hidden = model.gpt.ln_f(x)
-        logits = model.logits(hidden)   # [B, 1, V]
-        return (logits[:, 0], *news)
+        logits = model.logits(hidden)   # [B, W, V]
+        if W == 1:
+            return (logits[:, 0], *news)
+        return (logits, *news)
 
     return step
 
 
 def export_gpt_decode(model: GPTForPretraining, path: str, batch: int,
-                      context: int) -> str:
+                      context: int, width: int = 1) -> str:
     """Export the KV decode-step artifact for ``model`` at a fixed
-    decode ``batch`` and cache ``context`` (positions per session).
-    Returns the written path. Serve it with
-    ``inference.create_server(..., decode_model=path)`` or drive it
-    directly over ``ptpu_predictor_kv_plan``/``decode_step``."""
+    decode ``batch``, cache ``context`` (positions per session) and
+    step ``width`` (positions fed per step — width 1 is the normal
+    autoregressive step; width k+1 is the speculative-decoding verify
+    artifact, see ``make_gpt_decode_step``). Returns the written
+    path. Serve it with ``inference.create_server(...,
+    decode_model=path)`` (width 1) or ``spec_verify_model=path``
+    (width k+1), or drive it directly over
+    ``ptpu_predictor_kv_plan``/``decode_step``."""
     import numpy as onp
     from ..onnx.converter import trace_to_onnx
     cfg = model.config
-    step = make_gpt_decode_step(model, context)
+    step = make_gpt_decode_step(model, context, width)
     h, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
-    args = [jnp.zeros((batch, 1), jnp.int32),
+    args = [jnp.zeros((batch, width), jnp.int32),
             jnp.zeros((batch,), jnp.int32)]
     for _ in range(cfg.num_layers):
         args.append(jnp.zeros((batch, context, h, hd), jnp.float32))

@@ -1,15 +1,19 @@
 """decode_bench `--out` persistence contract (ISSUE r9 satellite,
-schema extended for the r12 paged engine; pattern of
-tests/test_serving_bench_persist.py).
+schema extended for the r12 paged engine and the r13 speculative
+A/B leg; pattern of tests/test_serving_bench_persist.py).
 
 Runs `tools/decode_bench.py --smoke` as a subprocess with a shrunken
 config (2 sessions, 6 tokens, context 32, decode batch 2, a 12-session
-ramp, a 4-open prefix A/B), asserts the persisted JSON schema, the
-parity rows — including the NEW exact paged-vs-fixed gate — the
-server-vs-client decode counter exactness, and the ramp/prefix
-measurement columns (sessions held, per-session KV bytes, peak RSS).
-Throughput gates are NOT asserted: a smoke config cannot amortize the
-per-step wire round trip the way the committed BENCH_DECODE run does.
+ramp, a 4-open prefix A/B, a barely-trained spec leg), asserts the
+persisted JSON schema, the parity rows — the exact paged-vs-fixed gate
+AND the spec greedy byte-parity row — the server-vs-client decode
+counter exactness, the ramp/prefix measurement columns, and the
+speculative A/B columns (accept rate, tokens/round, per-round
+tokens/s, seeded-sampling determinism). Throughput/accept gates are
+NOT asserted: a smoke config neither amortizes the wire round trip nor
+trains the models into agreement the way the committed BENCH_DECODE
+run does — but the EXACTNESS rows (greedy parity, determinism) must
+hold at any scale.
 """
 import json
 import os
@@ -37,8 +41,10 @@ def bench_out(tmp_path_factory):
          "--batch", "2", "--ramp-sessions", "12", "--ramp-context",
          "64", "--ramp-batch", "4", "--ramp-rounds", "2",
          "--ramp-fixed-sessions", "4", "--prefix-opens", "4",
-         "--prefix-prompt", "24"],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+         "--prefix-prompt", "24", "--spec-k", "2", "--spec-tokens",
+         "12", "--spec-train-steps", "8", "--spec-rounds", "2",
+         "--spec-sample-opens", "8"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
     assert r.returncode == 0, r.stderr[-2000:]
     with open(out) as f:
         data = json.load(f)
@@ -59,7 +65,11 @@ class TestDecodeBenchPersist:
                 "decode_parity_exact_paged_vs_fixed",
                 "ramp_fixed_engine", "ramp_paged_engine",
                 "ramp_paged_over_fixed_equal_ram", "prefix_cache_ab",
-                "decode_kv_speedup_vs_recompute"} <= metrics
+                "decode_kv_speedup_vs_recompute",
+                "spec_greedy_parity", "spec_ab_tokens_per_s_1s",
+                "spec_ab_tokens_per_s_2s", "spec_accept_rate",
+                "spec_speedup_single_session",
+                "spec_sampling_distribution"} <= metrics
 
     def test_counters_exact(self, bench_out):
         by = {r["metric"]: r for r in bench_out["measurements"]}
@@ -108,3 +118,29 @@ class TestDecodeBenchPersist:
         gate = by["decode_kv_speedup_vs_recompute"]
         assert gate["acceptance_gate"] == 5.0
         assert isinstance(gate["within_gate"], bool)
+
+    def test_spec_rows(self, bench_out):
+        """r13 schema: greedy byte-parity holds even on barely-trained
+        models; the A/B rows carry per-round tokens/s for BOTH legs;
+        accept-rate and tokens/round columns reconcile; the seeded
+        sampler is deterministic."""
+        by = {r["metric"]: r for r in bench_out["measurements"]}
+        assert by["spec_greedy_parity"]["value"] is True, \
+            bench_out["_stderr"]
+        for nsess in (1, 2):
+            row = by[f"spec_ab_tokens_per_s_{nsess}s"]
+            assert row["spec_tokens_per_s"] > 0
+            assert row["nospec_tokens_per_s"] > 0
+            assert len(row["per_round_spec"]) == 2
+            assert len(row["per_round_nospec"]) == 2
+        acc = by["spec_accept_rate"]
+        assert acc["k"] == 2
+        assert 0.0 <= acc["value"] <= 1.0
+        assert 1.0 <= acc["tokens_per_round"] <= acc["k"] + 1
+        assert acc["acceptance_gate"] == 0.60
+        gate = by["spec_speedup_single_session"]
+        assert gate["acceptance_gate"] == 1.8
+        assert isinstance(gate["within_gate"], bool)
+        samp = by["spec_sampling_distribution"]
+        assert samp["deterministic"] is True
+        assert samp["value"] is True

@@ -82,17 +82,47 @@ def _san_flag_available(kind: str) -> bool:
             return False
 
 
+def _csrc_content_hash() -> str:
+    """sha256 over every csrc source/header + Makefile, concatenated
+    in LC_ALL=C sort order — the exact recipe the Makefile's sancheck
+    stamp uses."""
+    import hashlib
+    names = sorted(f for f in os.listdir(CSRC)
+                   if f.endswith((".cc", ".h", ".c")) or f == "Makefile")
+    h = hashlib.sha256()
+    for f in names:
+        with open(os.path.join(CSRC, f), "rb") as fh:
+            h.update(fh.read())
+    return h.hexdigest()
+
+
 def _san_binaries_warm(san: str) -> bool:
-    """True when every sanitized binary for this leg exists and is at
-    least as new as every csrc source/header — i.e. `make sancheck`
-    will only re-RUN, not re-compile."""
+    """True when every sanitized binary for this leg exists and was
+    built from EXACTLY the current sources — i.e. `make sancheck` will
+    only re-RUN, not re-compile.
+
+    Currency is judged by the CONTENT-hash stamp the Makefile's
+    sancheck target writes (.san-srchash-<leg>), not by mtimes: a
+    `git checkout`/branch switch rewrites identical bytes with fresh
+    mtimes, which used to mis-read a warm tree as cold and skip the
+    sanitizer legs (r11 note). Trees whose binaries predate the stamp
+    fall back to the old mtime comparison (conservative: may still
+    misfire cold, never misfires warm)."""
+    for b in SAN_BINARIES[san]:
+        if not os.path.exists(os.path.join(CSRC, b)):
+            return False
+    stamp = os.path.join(CSRC,
+                         ".san-srchash-" + san.replace(",", "-"))
+    if os.path.exists(stamp):
+        with open(stamp) as f:
+            return f.read().strip() == _csrc_content_hash()
+    # pre-stamp binaries (built by an older Makefile): mtime fallback
     src_mtime = max(
         os.path.getmtime(os.path.join(CSRC, f))
         for f in os.listdir(CSRC)
         if f.endswith((".cc", ".h", ".c")) or f == "Makefile")
     for b in SAN_BINARIES[san]:
-        p = os.path.join(CSRC, b)
-        if not os.path.exists(p) or os.path.getmtime(p) < src_mtime:
+        if os.path.getmtime(os.path.join(CSRC, b)) < src_mtime:
             return False
     return True
 
@@ -110,6 +140,39 @@ def _sancheck_leg(san: str, kinds: list):
     r = _make(["sancheck", f"SAN={san}"])
     assert r.returncode == 0, r.stdout + r.stderr
     assert f"sancheck[{san}]: selftests + demo clean" in r.stdout
+
+
+def test_warm_gate_survives_touched_sources(tmp_path, monkeypatch):
+    """The r11 misfire: `git checkout` rewrites identical source bytes
+    with fresh mtimes, and the old mtime-based warm gate then skipped
+    the sanitizer legs on a perfectly warm tree. The content-hash
+    stamp must keep such a tree warm — and must go cold the moment a
+    source actually changes."""
+    import sys
+    import time
+    fake = tmp_path / "csrc"
+    fake.mkdir()
+    (fake / "a.cc").write_text("int x;\n")
+    (fake / "util.h").write_text("#pragma once\n")
+    (fake / "Makefile").write_text("all:\n")
+    binname = "ptpu_selftest.san-asan-ubsan"
+    (fake / binname).write_text("fake binary")
+    mod = sys.modules[__name__]
+    monkeypatch.setattr(mod, "CSRC", str(fake))
+    monkeypatch.setitem(SAN_BINARIES, "asan,ubsan", [binname])
+    (fake / ".san-srchash-asan-ubsan").write_text(
+        _csrc_content_hash() + "\n")
+    # a checkout-style touch: same bytes, NEWER mtime than the binary
+    time.sleep(0.02)
+    (fake / "a.cc").write_text("int x;\n")
+    assert _san_binaries_warm("asan,ubsan"), \
+        "identical sources with fresh mtimes must stay warm"
+    # a real edit flips it cold
+    (fake / "a.cc").write_text("int y;\n")
+    assert not _san_binaries_warm("asan,ubsan")
+    # a leg with no stamp and stale binaries is cold (mtime fallback)
+    (fake / ".san-srchash-asan-ubsan").unlink()
+    assert not _san_binaries_warm("asan,ubsan")
 
 
 def test_native_selftest_passes():

@@ -448,6 +448,68 @@ class TestPagedDecode:
         finally:
             srv.stop()
 
+    def test_trim_rollback_edges(self, decode_artifacts):
+        """ISSUE 13 satellite (Python twin of the C selftest's
+        kv_trim legs, on the REAL GPT export + PtpuPagedAttention):
+        trim to a mid-page boundary, trim back across a SHARED
+        prefix-cache page (must COW on divergence, never mutate the
+        published page), and trim-to-zero then continue — logits after
+        every rollback are bit-identical to a fresh session fed the
+        surviving history."""
+        from paddle_tpu.core.native import KvPool, NativePredictor
+
+        dec, _ = decode_artifacts
+        pool = KvPool(pool_tokens=16 * 48, page_tokens=16,
+                      max_sessions=16)
+        p = NativePredictor(dec, batch_override=1)
+        p.kv_attach(pool)
+        assert p.kv_width() == 1
+
+        def feed(sid, toks):
+            out = None
+            for t in toks:
+                out = p.decode_step([sid], [t]).copy()
+            return out
+
+        hist = list(range(3, 23))          # 20 tokens: page + 4
+        a = pool.open()
+        feed(a, hist)
+        assert pool.len(a) == 20
+        # (a) mid-page trim: keep 10, re-decode the suffix — logits
+        # match a fresh session with the same 10-token prefix exactly
+        p.kv_trim(a, 10)
+        assert pool.len(a) == 10
+        got = feed(a, [40, 41])
+        b = pool.open()
+        want = feed(b, hist[:10] + [40, 41])
+        assert np.array_equal(got, want)
+        # (b) publish a 16-token page, adopt it, trim back INTO it,
+        # then diverge: COW must fire and the published page must
+        # still serve the ORIGINAL prefix to a later adopter
+        prompt = hist[:10] + [40, 41] + list(range(50, 55))  # 17 toks
+        feed(b, prompt[12:])               # b now holds the full prompt
+        pool.publish(b, prompt[:17])
+        cows0 = pool.stats()["cow_copies"]
+        c = pool.open()
+        assert pool.adopt(c, prompt) == 16
+        p.kv_trim(c, 8)                    # back inside the shared page
+        got = feed(c, prompt[8:10])        # diverging writes -> COW
+        assert pool.stats()["cow_copies"] == cows0 + 1
+        want = feed(pool.open(), prompt[:10])
+        assert np.array_equal(got, want)
+        d = pool.open()
+        assert pool.adopt(d, prompt) == 16  # original page intact
+        assert np.array_equal(feed(d, [prompt[16]]),
+                              feed(pool.open(), prompt[:17]))
+        # (c) trim to zero, then continue decoding from scratch
+        p.kv_trim(d, 0)
+        assert pool.len(d) == 0
+        assert np.array_equal(feed(d, hist[:3]),
+                              feed(pool.open(), hist[:3]))
+        assert pool.stats()["trims"] >= 3
+        p.close()
+        pool.close()
+
     def test_legacy_fixed_slot_engine_env_fallback(
             self, decode_artifacts, mlp_artifact):
         """PTPU_KV_PAGED=0 keeps the r9 fixed-slot engine: no pool in
@@ -479,3 +541,163 @@ class TestPagedDecode:
             cli.close()
         finally:
             srv.stop()
+
+
+@pytest.fixture(scope="module")
+def spec_artifacts(built, decode_artifacts, tmp_path_factory):
+    """Speculative-decoding artifact set (ISSUE 13): the target's
+    width-1 step (shared with decode_artifacts), the target exported
+    at width k+1 = 4 (the verify pass), and a SMALLER draft model's
+    width-1 step — all at context 48."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import (GPTForPretraining,
+                                       export_gpt_decode, gpt_tiny)
+
+    dec, cfg = decode_artifacts
+    pt.seed(0)
+    model = GPTForPretraining(cfg)   # pt.seed(0) replays the SAME
+    model.eval()                     # weights decode_artifacts traced
+    pt.seed(7)
+    dcfg = gpt_tiny(dtype=jnp.float32, dropout=0.0, hidden_size=32,
+                    num_layers=1, num_heads=2)
+    draft = GPTForPretraining(dcfg)
+    draft.eval()
+    d = tmp_path_factory.mktemp("spec")
+    ver = export_gpt_decode(model, str(d / "ver"), batch=4,
+                            context=48, width=4)
+    drf = export_gpt_decode(draft, str(d / "drf"), batch=8,
+                            context=48)
+    return dec, ver, drf
+
+
+class TestSpeculativeDecode:
+    """ISSUE 13 tentpole: draft/verify speculative decoding with COW
+    rollback — exact-parity and protocol-guard tests over the wire."""
+
+    def _server(self, mlp_artifact, dec, ver, drf, **kw):
+        from paddle_tpu import inference
+        return inference.create_server(mlp_artifact, max_batch=2,
+                                       instances=1, decode_model=dec,
+                                       spec_model=drf,
+                                       spec_verify_model=ver,
+                                       kv_sessions=16, **kw)
+
+    def test_greedy_parity_and_round_counters(self, spec_artifacts,
+                                              mlp_artifact):
+        """Speculatively generated greedy tokens are BYTE-IDENTICAL
+        to the non-speculative greedy sequence from the same prompt,
+        rounds commit accepted+1 tokens each, and the accept counters
+        reconcile exactly."""
+        dec, ver, drf = spec_artifacts
+        srv = self._server(mlp_artifact, dec, ver, drf)
+        try:
+            meta = srv.config()["decode"]["spec"]
+            assert meta["k"] == 3 and meta["verify_width"] == 4
+            assert meta["verify_buckets"] == [1, 2, 4]
+            cli = srv.client()
+            prompt = [7, 3, 11, 2]
+            N = 30
+            s0, lg, _ = cli.decode_open(prompt=prompt)
+            ref = [int(np.argmax(lg))]
+            while len(ref) < N:
+                ref.append(int(np.argmax(
+                    cli.decode_step(s0, ref[-1]))))
+            cli.decode_close(s0)
+            s1, toks, _ = cli.spec_open(prompt)
+            out = list(toks)
+            rounds = 0
+            accepted = 0
+            while len(out) < N:
+                t, a = cli.spec_step(s1)
+                assert len(t) == a + 1
+                out.extend(t)
+                accepted += a
+                rounds += 1
+            assert out[:N] == ref
+            st = srv.stats()["decode"]
+            assert st["spec_rounds"] == rounds
+            assert st["spec_accepted"] == accepted
+            assert st["spec_tokens"] == accepted + rounds
+            if st["spec_fallbacks"] == 0:
+                assert st["spec_proposed"] == 3 * rounds
+            assert st["spec_draft_steps"] >= rounds
+            # the pool rolled back rejected suffixes via trims
+            if accepted < 3 * rounds:
+                assert st["pool"]["trims"] >= 1
+            cli.decode_close(s1)
+            cli.close()
+        finally:
+            srv.stop()
+
+    def test_sampling_seeded_determinism(self, spec_artifacts,
+                                         mlp_artifact):
+        """The server-side modified-rejection sampler is a pure
+        function of (prompt, seed): identical seeds replay the exact
+        token stream, different seeds diverge."""
+        dec, ver, drf = spec_artifacts
+        srv = self._server(mlp_artifact, dec, ver, drf)
+        try:
+            cli = srv.client()
+
+            def gen(seed, n=16):
+                s, toks, _ = cli.spec_open([5, 9], seed=seed,
+                                           sample=True)
+                out = list(toks)
+                while len(out) < n:
+                    t, _ = cli.spec_step(s)
+                    out.extend(t)
+                cli.decode_close(s)
+                return out[:n]
+
+            a, b, c = gen(1234), gen(1234), gen(99)
+            assert a == b
+            assert a != c
+            cli.close()
+        finally:
+            srv.stop()
+
+    def test_protocol_guards(self, spec_artifacts, mlp_artifact):
+        """Plane separation: plain steps on a spec session (and spec
+        steps on a plain session) are refused; spec sessions cannot
+        fork; pipelined spec rounds across sessions interleave through
+        one flush."""
+        from paddle_tpu.inference.serving import ServingError
+
+        dec, ver, drf = spec_artifacts
+        srv = self._server(mlp_artifact, dec, ver, drf)
+        try:
+            cli = srv.client()
+            s1, t1, _ = cli.spec_open([3, 4])
+            with pytest.raises(ServingError,
+                               match="use DECODE_SPEC_STEP"):
+                cli.decode_step(s1, 1)
+            with pytest.raises(ServingError, match="fork"):
+                cli.decode_fork(s1)
+            plain = cli.decode_open()
+            with pytest.raises(ServingError,
+                               match="not a speculative session"):
+                cli.spec_step(plain)
+            # pipelined rounds across several spec sessions
+            ss = [cli.spec_open([3, 4 + i])[0] for i in range(3)]
+            outs = cli.spec_step_many([s1] + ss)
+            assert len(outs) == 4
+            for toks, acc in outs:
+                assert len(toks) == acc + 1
+            for s in [s1, plain] + ss:
+                cli.decode_close(s)
+            cli.close()
+        finally:
+            srv.stop()
+
+    def test_spec_requires_paged_engine(self, spec_artifacts,
+                                        mlp_artifact):
+        """The r9 fixed-slot engine cannot share sessions across the
+        verify/step predictors: starting a spec server under
+        PTPU_KV_PAGED=0 fails with a clear error."""
+        dec, ver, drf = spec_artifacts
+        os.environ["PTPU_KV_PAGED"] = "0"
+        try:
+            with pytest.raises(RuntimeError, match="paged"):
+                self._server(mlp_artifact, dec, ver, drf)
+        finally:
+            del os.environ["PTPU_KV_PAGED"]

@@ -186,6 +186,39 @@ class TestWireChecker:
         msgs = [f.message for f in _run(root, "wire")]
         assert any("DECODE_STEP exact-size" in m for m in msgs)
 
+    def test_catches_spec_tag_drift(self, tmp_path):
+        """r13 DECODE_SPEC ops are covered: renumbering the Python
+        step tag without the C side must trip the parity map."""
+        root = _fixture(tmp_path, WIRE_FILES)
+        _mutate(root, "paddle_tpu/inference/serving.py",
+                "TAG_DECODE_SPEC_STEP = 0x6e",
+                "TAG_DECODE_SPEC_STEP = 0x7e")
+        msgs = [f.message for f in _run(root, "wire")]
+        assert any("kTagDecodeSpecStep" in m and "drift" in m
+                   for m in msgs)
+
+    def test_catches_spec_open_size_drift(self, tmp_path):
+        """Loosening SPEC_OPEN's exact-size check (the u64 seed field
+        is easy to forget) must trip the layout probe."""
+        root = _fixture(tmp_path, WIRE_FILES)
+        _mutate(root, "csrc/ptpu_serving.cc",
+                "if (uint64_t(n) != 2 + ext + 8 + 4 + 4 + 8 + "
+                "8ull * ntok)",
+                "if (uint64_t(n) < 2 + ext + 8 + 4 + 4 + "
+                "8ull * ntok)")
+        msgs = [f.message for f in _run(root, "wire")]
+        assert any("DECODE_SPEC_OPEN exact-size" in m for m in msgs)
+
+    def test_catches_spec_rep_layout_drift(self, tmp_path):
+        """Moving SPEC_REP's accepted count off ho + 16 (payload 18)
+        would desync _spec_rep_parse — the offset probe must fire."""
+        root = _fixture(tmp_path, WIRE_FILES)
+        _mutate(root, "csrc/ptpu_serving.cc",
+                "PutU32(f.data() + ho + 16, accepted);",
+                "PutU32(f.data() + ho + 12, accepted);")
+        msgs = [f.message for f in _run(root, "wire")]
+        assert any("DECODE_SPEC_REP accepted" in m for m in msgs)
+
 
 class TestStatsChecker:
     def test_clean_fixture(self, tmp_path):
@@ -508,6 +541,19 @@ class TestFuzzChecker:
                 '{"Add", B_ADD},', '{"Addz", B_ADD},')
         msgs = [f.message for f in _run(root, "fuzz")]
         assert any("'Addz'" in m and "corpus/onnx" in m for m in msgs)
+
+    def test_catches_spec_seed_removal(self, tmp_path):
+        """The r13 DECODE_SPEC tags are live parser surface: dropping
+        their corpus seeds must fail the per-tag coverage walk."""
+        root = _fuzz_fixture(tmp_path)
+        corpus = root / "csrc" / "fuzz" / "corpus" / "wire_serving"
+        for f_ in corpus.glob("seed-spec-*"):
+            os.remove(f_)
+        msgs = [f.message for f in _run(root, "fuzz")]
+        assert any("kTagDecodeSpecOpen" in m and "no corpus frame" in m
+                   for m in msgs)
+        assert any("kTagDecodeSpecStep" in m and "no corpus frame" in m
+                   for m in msgs)
 
     def test_catches_missing_corpus_dir(self, tmp_path):
         root = _fuzz_fixture(tmp_path)

@@ -357,7 +357,12 @@ SV_TAGS = {"kTagInferReq": "TAG_INFER_REQ", "kTagInferRep": "TAG_INFER_REP",
            # paged-engine ops (r12): prompt prefill + COW fork
            "kTagDecodeOpen2": "TAG_DECODE_OPEN2",
            "kTagDecodeOpenRep": "TAG_DECODE_OPEN_REP",
-           "kTagDecodeFork": "TAG_DECODE_FORK"}
+           "kTagDecodeFork": "TAG_DECODE_FORK",
+           # speculative decoding (r13): draft/verify rounds over
+           # 0x6d..0x6f
+           "kTagDecodeSpecOpen": "TAG_DECODE_SPEC_OPEN",
+           "kTagDecodeSpecStep": "TAG_DECODE_SPEC_STEP",
+           "kTagDecodeSpecRep": "TAG_DECODE_SPEC_REP"}
 
 
 def _py_struct_size(src: str, var: str) -> Optional[int]:
@@ -568,6 +573,50 @@ def check_wire(root: str) -> List[Finding]:
                          r"\s*26\s*\+\s*base\s*\)", pys):
             f.append(Finding("wire", pys_rel, 0,
                              "DECODE_OPEN_REP f32 body at payload "
+                             "offset 26 + base not found (layout "
+                             "probe)"))
+
+        # Speculative-decoding layout probes (r13). SPEC_OPEN payload
+        # is [ver][tag](+tid)[u64 req_id][u32 n_tokens @10][u32 flags
+        # @14][u64 seed @18][n x i64 @26]: the C parser must pin the
+        # exact frame size and read tokens from 26 + ext. SPEC_REP
+        # carries [u32 accepted][u32 n_tokens][n x i64] at
+        # reply-buffer offsets ho+16 / ho+20 / ho+24 (payload
+        # 18/22/26 + base), which _spec_rep_parse unpacks at exactly
+        # those offsets.
+        if not re.search(r"2\s*\+\s*ext\s*\+\s*8\s*\+\s*4\s*\+\s*4"
+                         r"\s*\+\s*8\s*\+\s*8ull\s*\*\s*ntok", clean):
+            f.append(Finding("wire", sv_rel, 0,
+                             "DECODE_SPEC_OPEN exact-size check (2 + "
+                             "ext + 8 + 4 + 4 + 8 + 8*n_tokens) not "
+                             "found (layout probe)"))
+        if not re.search(r"GetI64\(req\s*\+\s*26\s*\+\s*ext", clean):
+            f.append(Finding("wire", sv_rel, 0,
+                             "DECODE_SPEC_OPEN token read at payload "
+                             "offset 26 + ext not found (layout "
+                             "probe)"))
+        if not re.search(r"PutU32\(f\.data\(\)\s*\+\s*ho\s*\+\s*16,"
+                         r"\s*accepted\)", clean):
+            f.append(Finding("wire", sv_rel, 0,
+                             "DECODE_SPEC_REP accepted-count write at "
+                             "ho + 16 not found (layout probe)"))
+        if not re.search(r"PutI64\(f\.data\(\)\s*\+\s*ho\s*\+\s*24"
+                         r"\s*\+\s*8\s*\*\s*size_t\(k\)", clean):
+            f.append(Finding("wire", sv_rel, 0,
+                             "DECODE_SPEC_REP token body at ho + 24 "
+                             "not found (layout probe)"))
+        spec_py = pys.split("def _spec_rep_parse", 1)[-1][:600]
+        if not re.search(r"_U32\.unpack_from\(f,\s*18\s*\+\s*base\)"
+                         r"[^#]*?_U32\.unpack_from\(f,\s*22\s*\+\s*"
+                         r"base\)", spec_py, re.S):
+            f.append(Finding("wire", pys_rel, 0,
+                             "DECODE_SPEC_REP accepted/n_tokens at "
+                             "payload offsets 18/22 + base not found "
+                             "(layout probe)"))
+        if not re.search(r"_I64\.unpack_from\(f,\s*26\s*\+\s*base"
+                         r"\s*\+\s*8\s*\*\s*k\)", spec_py):
+            f.append(Finding("wire", pys_rel, 0,
+                             "DECODE_SPEC_REP token body at payload "
                              "offset 26 + base not found (layout "
                              "probe)"))
     return f
