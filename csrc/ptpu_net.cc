@@ -24,6 +24,7 @@
 #include <unordered_map>
 
 #include "ptpu_hmac.h"
+#include "ptpu_schedck.h"
 #include "ptpu_trace.h"
 #include "ptpu_wire.h"
 
@@ -240,6 +241,8 @@ class EventLoop {
       MutexLock g(inbox_mu_);
       inbox_.push_back(std::move(t));
     }
+    // the r10 race window: task queued, eventfd not yet signalled
+    PTPU_SCHED_POINT();
     const uint64_t one = 1;
     // a full eventfd counter (never at 1-per-post rates) still wakes
     const ssize_t r = ::write(wake_fd_, &one, sizeof(one));
@@ -269,6 +272,9 @@ class EventLoop {
         const ssize_t r = ::read(wake_fd_, &v, sizeof(v));
         (void)r;  // EAGAIN when nothing pending — fine
       }
+      // between clear and swap: a racing Post here re-signals the
+      // (just cleared) eventfd, so the next epoll_wait still wakes
+      PTPU_SCHED_POINT();
       {
         MutexLock g(inbox_mu_);
         tasks.swap(inbox_);

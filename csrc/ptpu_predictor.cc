@@ -37,6 +37,7 @@
 #include <vector>
 
 #include "ptpu_arena.h"
+#include "ptpu_schedck.h"
 #include "ptpu_stats.h"
 #include "ptpu_sync.h"
 
@@ -1904,8 +1905,10 @@ class KvPool {
         sess_[size_t(s)].open = true;
         sess_[size_t(s)].len = sess_[size_t(src)].len;
         sess_[size_t(s)].table = sess_[size_t(src)].table;
-        for (int32_t gid : sess_[size_t(s)].table)
+        for (int32_t gid : sess_[size_t(s)].table) {
+          PTPU_SCHED_POINT();  // COW fork mid-refcount walk
           ++groups_[size_t(gid)].ref;
+        }
         ++forks_;
         return s;
       }
@@ -2230,6 +2233,7 @@ class KvPool {
 
   void unref(int32_t gid) {
     Group& g = groups_[size_t(gid)];
+    PTPU_SCHED_POINT();  // drop-vs-evict ordering on the group ref
     if (--g.ref == 0) {
       // published groups always hold the cache ref, so ref==0 means
       // unpublished (or just unpublished by eviction)

@@ -1,0 +1,746 @@
+// The ptpu_schedck engine — see ptpu_schedck.h for the model. One
+// global cooperative scheduler: engine state lives behind a RAW
+// std::mutex / std::condition_variable pair (the engine is exempt
+// from its own instrumentation, the same way lockdep's state().mu is
+// exempt from rank checking). Exactly one managed thread owns the
+// schedule at a time; every hook is
+//     take engine lock -> mutate model state -> pick next thread ->
+//     wait until elected -> release engine lock
+// so successive decisions are totally ordered through the engine
+// mutex and every explored interleaving is physically data-race free.
+#ifndef PTPU_SCHEDCK
+#error "ptpu_schedck.cc must be compiled with -DPTPU_SCHEDCK"
+#endif
+
+#include "ptpu_schedck.h"
+
+#include <unistd.h>
+
+#include <cinttypes>
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace ptpu {
+namespace schedck {
+namespace {
+
+// Hard per-schedule decision budget: exceeding it means a thread (or
+// a set of threads) is spinning without the scenario converging — a
+// modeled livelock, reported like a deadlock.
+constexpr uint64_t kStepLimit = 1u << 20;
+
+uint64_t Splitmix64(uint64_t& s) {
+  uint64_t z = (s += 0x9e3779b97f4a7c15ull);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+  return z ^ (z >> 31);
+}
+
+int64_t EnvI64(const char* name, int64_t dflt) {
+  const char* e = std::getenv(name);
+  if (!e || !*e) return dflt;
+  char* end = nullptr;
+  const long long v = std::strtoll(e, &end, 10);
+  return (end && *end == '\0') ? int64_t(v) : dflt;
+}
+
+struct Rec {
+  enum class St {
+    kRunnable,
+    kBlockedMutex,    // obj = mutex address (exclusive wait)
+    kBlockedShared,   // obj = shared-mutex address (reader wait)
+    kBlockedCv,       // obj = condvar address, untimed
+    kBlockedCvTimed,  // obj = condvar address, timed (stays enabled)
+    kBlockedJoin,     // join_target = tid
+    kBlockedPred,     // pred() re-evaluated at every decision
+    kFinished,
+  };
+  int tid = 0;
+  St st = St::kRunnable;
+  const void* obj = nullptr;
+  std::function<bool()> pred;
+  bool timed_out = false;   // out-param of a timed cv wait
+  int64_t prio = 0;         // pct only
+  const char* where = "spawn";
+  int join_target = -1;
+  std::function<void()> fn;
+  std::thread real;  // empty for thread 0 (the Explore caller)
+};
+
+struct MutexSt {
+  int owner = -1;  // exclusive holder tid, -1 = free
+  int shared = 0;  // reader count (SharedMutex only)
+};
+
+struct Engine {
+  std::mutex mu;
+  std::condition_variable cv;
+  bool active = false;
+  int running = -1;
+  std::vector<std::unique_ptr<Rec>> threads;
+  std::unordered_map<const void*, MutexSt> mutexes;
+
+  // per-Explore
+  const char* scenario = "";
+  Options opt;
+  uint64_t schedule_idx = 0;
+
+  // per-schedule
+  uint64_t step = 0;
+  std::vector<int> trace;  // chosen tid per decision
+
+  // dfs backtracking state: for every decision inside the branch
+  // horizon, the enabled-set index chosen this schedule and how many
+  // were enabled. `prefix` forces the replayed stem of the next
+  // schedule.
+  std::vector<int> dfs_prefix;
+  std::vector<int> dfs_chosen;
+  std::vector<int> dfs_width;
+
+  // pct per-schedule state
+  bool pct = false;
+  uint64_t rng = 0;
+  std::vector<uint64_t> change_steps;
+  int64_t pct_floor = 0;     // descending priorities handed out at
+                             // change points (always the new minimum)
+  uint64_t est_len = 64;     // running estimate of schedule length
+
+  // replay
+  bool replaying = false;
+  std::vector<int> replay_tids;
+};
+
+Engine& E() {
+  static Engine* e = new Engine();
+  return *e;
+}
+
+thread_local Rec* tl = nullptr;
+
+bool ManagedActive() { return tl != nullptr && E().active; }
+
+const char* StName(Rec::St s) {
+  switch (s) {
+    case Rec::St::kRunnable: return "runnable";
+    case Rec::St::kBlockedMutex: return "blocked-mutex";
+    case Rec::St::kBlockedShared: return "blocked-shared";
+    case Rec::St::kBlockedCv: return "blocked-cv";
+    case Rec::St::kBlockedCvTimed: return "blocked-cv-timed";
+    case Rec::St::kBlockedJoin: return "blocked-join";
+    case Rec::St::kBlockedPred: return "blocked-pred";
+    case Rec::St::kFinished: return "finished";
+  }
+  return "?";
+}
+
+std::string TracePath() {
+  Engine& e = E();
+  if (e.opt.trace_out && *e.opt.trace_out) return e.opt.trace_out;
+  const char* env = std::getenv("PTPU_SCHEDCK_TRACE_OUT");
+  if (env && *env) return env;
+  return std::string(e.scenario) + ".schedck-trace";
+}
+
+// Failure path: report + trace file + abort. Engine lock held by the
+// caller; never returns.
+[[noreturn]] void FailLocked(const char* what, const char* detail) {
+  Engine& e = E();
+  std::fprintf(stderr,
+               "\n== ptpu_schedck: %s ==\n"
+               "scenario %s  strategy %s  schedule %" PRIu64
+               "  step %" PRIu64 "\n",
+               what, e.scenario,
+               e.replaying ? "replay" : (e.pct ? "pct" : "dfs"),
+               e.schedule_idx, e.step);
+  if (detail && *detail) std::fprintf(stderr, "  %s\n", detail);
+  for (const auto& t : e.threads) {
+    std::fprintf(stderr, "  thread %d: %s%s at %s\n", t->tid,
+                 StName(t->st),
+                 t->tid == e.running ? " (running)" : "", t->where);
+  }
+  const std::string path = TracePath();
+  if (std::FILE* f = std::fopen(path.c_str(), "w")) {
+    std::fprintf(f, "ptpu_schedck-trace v1\n");
+    std::fprintf(f, "scenario %s\n", e.scenario);
+    std::fprintf(f, "strategy %s\n",
+                 e.replaying ? "replay" : (e.pct ? "pct" : "dfs"));
+    std::fprintf(f, "schedule %" PRIu64 "\n", e.schedule_idx);
+    std::fprintf(f, "decisions %zu\n", e.trace.size());
+    for (size_t i = 0; i < e.trace.size(); ++i)
+      std::fprintf(f, "%d%c", e.trace[i],
+                   (i + 1 == e.trace.size()) ? '\n' : ' ');
+    std::fflush(f);
+    std::fclose(f);
+    std::fprintf(stderr,
+                 "decision trace written to %s — replay with "
+                 "schedck::Replay(name, body, \"%s\")\n",
+                 path.c_str(), path.c_str());
+  } else {
+    std::fprintf(stderr, "(could not write trace to %s)\n",
+                 path.c_str());
+  }
+  std::fflush(stderr);
+  std::abort();
+}
+
+void WakeMutexWaiters(const void* m) {
+  for (auto& t : E().threads) {
+    if ((t->st == Rec::St::kBlockedMutex ||
+         t->st == Rec::St::kBlockedShared) &&
+        t->obj == m) {
+      t->st = Rec::St::kRunnable;  // re-checks availability on wake
+      t->obj = nullptr;
+    }
+  }
+}
+
+// The single scheduling decision. Engine lock held. The caller has
+// already set its own state (kRunnable for a pure yield, a blocked
+// state otherwise); afterwards `running` names the elected thread.
+void PickNextLocked() {
+  Engine& e = E();
+  // 1. re-evaluate modeled syscall predicates
+  for (auto& t : e.threads) {
+    if (t->st == Rec::St::kBlockedPred && t->pred && t->pred()) {
+      t->st = Rec::St::kRunnable;
+      t->pred = nullptr;
+    }
+  }
+  // 2. the enabled set, in tid order (determinism)
+  std::vector<Rec*> enabled;
+  bool unfinished = false;
+  for (auto& t : e.threads) {
+    if (t->st != Rec::St::kFinished) unfinished = true;
+    if (t->st == Rec::St::kRunnable ||
+        t->st == Rec::St::kBlockedCvTimed)
+      enabled.push_back(t.get());
+  }
+  if (enabled.empty()) {
+    if (!unfinished) {  // scenario fully drained (last thread exiting)
+      e.running = -1;
+      e.cv.notify_all();
+      return;
+    }
+    FailLocked("DEADLOCK (all threads blocked)", nullptr);
+  }
+  if (e.step >= kStepLimit)
+    FailLocked("LIVELOCK (per-schedule step budget exhausted)",
+               nullptr);
+  // 3. choose
+  size_t idx = 0;
+  const int n = int(enabled.size());
+  if (e.replaying) {
+    if (e.step < e.replay_tids.size()) {
+      const int want = e.replay_tids[e.step];
+      bool found = false;
+      for (size_t i = 0; i < enabled.size(); ++i) {
+        if (enabled[i]->tid == want) { idx = i; found = true; break; }
+      }
+      if (!found)
+        FailLocked("REPLAY DIVERGENCE",
+                   "recorded thread not enabled at this step — the "
+                   "scenario is nondeterministic or the trace is "
+                   "stale");
+    } else {
+      idx = size_t(e.step) % size_t(n);  // past the recorded failure
+    }
+  } else if (e.pct) {
+    // priority change point: demote the current top before electing
+    for (uint64_t cs : e.change_steps) {
+      if (cs == e.step) {
+        Rec* top = enabled[0];
+        for (Rec* r : enabled)
+          if (r->prio > top->prio) top = r;
+        top->prio = --e.pct_floor;
+        break;
+      }
+    }
+    for (size_t i = 1; i < enabled.size(); ++i)
+      if (enabled[i]->prio > enabled[idx]->prio) idx = i;
+  } else {  // dfs
+    const int horizon = e.opt.depth;
+    if (e.step < uint64_t(horizon)) {
+      if (e.step < e.dfs_prefix.size()) {
+        idx = size_t(e.dfs_prefix[e.step]);
+        if (idx >= size_t(n))
+          FailLocked("DFS DIVERGENCE",
+                     "prefix index exceeds the enabled set — the "
+                     "scenario is nondeterministic");
+      } else {
+        idx = 0;
+      }
+      e.dfs_chosen.push_back(int(idx));
+      e.dfs_width.push_back(n);
+    } else {
+      idx = size_t(e.step) % size_t(n);  // round-robin for progress
+    }
+  }
+  Rec* chosen = enabled[idx];
+  e.trace.push_back(chosen->tid);
+  ++e.step;
+  if (chosen->st == Rec::St::kBlockedCvTimed) {
+    // electing a timed cv waiter = its timeout fired
+    chosen->st = Rec::St::kRunnable;
+    chosen->obj = nullptr;
+    chosen->timed_out = true;
+  }
+  e.running = chosen->tid;
+  e.cv.notify_all();
+}
+
+void WaitElectedLocked(std::unique_lock<std::mutex>& lk) {
+  Engine& e = E();
+  while (e.running != tl->tid) e.cv.wait(lk);
+}
+
+// Pure yield decision: self stays runnable.
+void YieldLocked(std::unique_lock<std::mutex>& lk, const char* where) {
+  tl->where = where;
+  PickNextLocked();
+  WaitElectedLocked(lk);
+}
+
+// Block self with `st`/`obj`, hand the schedule over, return once
+// re-elected (state back to kRunnable by then).
+void BlockSelfLocked(std::unique_lock<std::mutex>& lk, Rec::St st,
+                     const void* obj, const char* where) {
+  tl->st = st;
+  tl->obj = obj;
+  tl->where = where;
+  PickNextLocked();
+  WaitElectedLocked(lk);
+}
+
+// Exclusive-acquire with the pre-acquire decision point. Engine lock
+// held around the whole thing.
+void AcquireMutexLocked(std::unique_lock<std::mutex>& lk,
+                        const void* m, const char* where) {
+  Engine& e = E();
+  YieldLocked(lk, where);
+  // re-look-up around every block: other threads insert into the map
+  // while we are parked, which may rehash and move the node
+  for (;;) {
+    MutexSt& s = e.mutexes[m];
+    if (s.owner == -1 && s.shared == 0) {
+      s.owner = tl->tid;
+      return;
+    }
+    BlockSelfLocked(lk, Rec::St::kBlockedMutex, m, where);
+  }
+}
+
+int64_t NewPctPrio() {
+  Engine& e = E();
+  // positive random priority, low byte = tid for total order
+  return int64_t((Splitmix64(e.rng) >> 2) & ~uint64_t(0xff)) |
+         int64_t(e.threads.size() & 0xff);
+}
+
+void BeginSchedule() {
+  Engine& e = E();
+  std::lock_guard<std::mutex> lk(e.mu);
+  e.threads.clear();
+  e.mutexes.clear();
+  e.trace.clear();
+  e.dfs_chosen.clear();
+  e.dfs_width.clear();
+  e.step = 0;
+  auto main_rec = std::make_unique<Rec>();
+  main_rec->tid = 0;
+  main_rec->where = "scenario-body";
+  tl = main_rec.get();
+  e.threads.push_back(std::move(main_rec));
+  if (e.pct) {
+    e.rng = (e.opt.seed ^ 0x243f6a8885a308d3ull) +
+            e.schedule_idx * 0x9e3779b97f4a7c15ull;
+    (void)Splitmix64(e.rng);
+    e.pct_floor = 0;
+    e.change_steps.clear();
+    for (int i = 0; i < e.opt.depth; ++i)
+      e.change_steps.push_back(1 + Splitmix64(e.rng) % e.est_len);
+    e.threads[0]->prio = NewPctPrio();
+  }
+  e.running = 0;
+  e.active = true;
+}
+
+// Returns true when another schedule should run.
+bool EndSchedule(Result* res) {
+  Engine& e = E();
+  std::unique_lock<std::mutex> lk(e.mu);
+  for (auto& t : e.threads) {
+    if (t->tid != 0 && t->st != Rec::St::kFinished)
+      FailLocked("SCENARIO PROTOCOL",
+                 "body returned while spawned threads are still "
+                 "live — join every schedck::Thread");
+  }
+  if (e.step > res->max_steps) res->max_steps = e.step;
+  if (e.step > e.est_len) e.est_len = e.step;
+  e.active = false;
+  tl = nullptr;
+  e.threads.clear();
+  e.mutexes.clear();
+  if (e.replaying) return false;
+  if (e.pct) return e.schedule_idx + 1 < e.opt.max_schedules;
+  // dfs backtrack: bump the deepest in-horizon decision that still
+  // has an unexplored sibling, truncate the prefix there.
+  for (int s = int(e.dfs_chosen.size()) - 1; s >= 0; --s) {
+    if (e.dfs_chosen[s] + 1 < e.dfs_width[s]) {
+      e.dfs_prefix.assign(e.dfs_chosen.begin(),
+                          e.dfs_chosen.begin() + s + 1);
+      e.dfs_prefix[s] += 1;
+      if (e.schedule_idx + 1 >= e.opt.max_schedules)
+        return false;  // budget cap: bounded space NOT exhausted
+      return true;
+    }
+  }
+  res->exhausted = true;
+  return false;
+}
+
+void ResolveOptions(Options* opt) {
+  if (opt->max_schedules == 0) {
+    opt->max_schedules =
+        uint64_t(EnvI64("PTPU_SCHEDCK_SCHEDULES", 1000));
+    if (opt->max_schedules == 0) opt->max_schedules = 1;
+  }
+  if (opt->depth == 0) {
+    opt->depth = int(EnvI64(
+        "PTPU_SCHEDCK_DEPTH",
+        opt->strategy == Options::Strategy::kDfs ? 6 : 3));
+  }
+  if (opt->seed == 0)
+    opt->seed = uint64_t(EnvI64("PTPU_SCHEDCK_SEED", 1));
+  const char* st = std::getenv("PTPU_SCHEDCK_STRATEGY");
+  if (st && *st) {
+    if (std::strcmp(st, "dfs") == 0)
+      opt->strategy = Options::Strategy::kDfs;
+    else if (std::strcmp(st, "pct") == 0)
+      opt->strategy = Options::Strategy::kPct;
+  }
+}
+
+Result RunExploration(const char* name,
+                      const std::function<void()>& body,
+                      const Options& opt) {
+  Engine& e = E();
+  {
+    std::lock_guard<std::mutex> lk(e.mu);
+    if (e.active) {
+      std::fprintf(stderr,
+                   "ptpu_schedck: nested Explore/Replay (scenario %s "
+                   "inside %s)\n", name, e.scenario);
+      std::abort();
+    }
+    e.scenario = name;
+    e.opt = opt;
+    e.pct = opt.strategy == Options::Strategy::kPct && !e.replaying;
+    e.schedule_idx = 0;
+    e.dfs_prefix.clear();
+    e.est_len = 64;
+  }
+  Result res;
+  for (;;) {
+    BeginSchedule();
+    body();
+    res.schedules = e.schedule_idx + 1;
+    const bool more = EndSchedule(&res);
+    if (!more) break;
+    ++e.schedule_idx;
+  }
+  return res;
+}
+
+}  // namespace
+
+Result Explore(const char* name, const std::function<void()>& body,
+               Options opt) {
+  ResolveOptions(&opt);
+  E().replaying = false;
+  E().replay_tids.clear();
+  return RunExploration(name, body, opt);
+}
+
+Result Replay(const char* name, const std::function<void()>& body,
+              const char* trace_file) {
+  Engine& e = E();
+  std::vector<int> tids;
+  std::FILE* f = std::fopen(trace_file, "r");
+  if (!f) {
+    std::fprintf(stderr, "ptpu_schedck: cannot open trace %s\n",
+                 trace_file);
+    std::abort();
+  }
+  char line[256];
+  bool header_ok = false;
+  long decisions = -1;
+  while (std::fgets(line, sizeof(line), f)) {
+    if (std::strncmp(line, "ptpu_schedck-trace", 18) == 0) {
+      header_ok = true;
+    } else if (std::sscanf(line, "decisions %ld", &decisions) == 1) {
+      int tid;
+      while (std::fscanf(f, "%d", &tid) == 1) tids.push_back(tid);
+      break;
+    }
+  }
+  std::fclose(f);
+  if (!header_ok || decisions < 0 ||
+      size_t(decisions) != tids.size()) {
+    std::fprintf(stderr,
+                 "ptpu_schedck: malformed trace %s (decisions %ld, "
+                 "parsed %zu)\n", trace_file, decisions, tids.size());
+    std::abort();
+  }
+  Options opt;
+  ResolveOptions(&opt);
+  opt.max_schedules = 1;
+  e.replaying = true;
+  e.replay_tids = std::move(tids);
+  Result res = RunExploration(name, body, opt);
+  e.replaying = false;
+  e.replay_tids.clear();
+  return res;
+}
+
+Thread::Thread(std::function<void()> fn) {
+  Engine& e = E();
+  std::unique_lock<std::mutex> lk(e.mu);
+  if (!ManagedActive()) {
+    std::fprintf(stderr,
+                 "ptpu_schedck: schedck::Thread spawned outside an "
+                 "active exploration\n");
+    std::abort();
+  }
+  auto rec = std::make_unique<Rec>();
+  Rec* rp = rec.get();
+  rp->tid = int(e.threads.size());
+  rp->fn = std::move(fn);
+  if (e.pct) rp->prio = NewPctPrio();
+  e.threads.push_back(std::move(rec));
+  impl_ = rp;
+  rp->real = std::thread([rp] {
+    Engine& eng = E();
+    std::unique_lock<std::mutex> l(eng.mu);
+    tl = rp;
+    WaitElectedLocked(l);
+    l.unlock();
+    rp->fn();
+    l.lock();
+    rp->st = Rec::St::kFinished;
+    rp->where = "exit";
+    for (auto& t : eng.threads) {
+      if (t->st == Rec::St::kBlockedJoin &&
+          t->join_target == rp->tid) {
+        t->st = Rec::St::kRunnable;
+        t->join_target = -1;
+      }
+    }
+    PickNextLocked();
+    tl = nullptr;
+  });
+  // spawn decision: run the child now, or keep going?
+  YieldLocked(lk, "spawn");
+}
+
+Thread& Thread::operator=(Thread&& o) noexcept {
+  if (this != &o) {
+    if (impl_) {
+      std::fprintf(stderr,
+                   "ptpu_schedck: assignment over a joinable "
+                   "schedck::Thread\n");
+      std::abort();
+    }
+    impl_ = o.impl_;
+    o.impl_ = nullptr;
+  }
+  return *this;
+}
+
+Thread::~Thread() {
+  if (impl_) {
+    std::fprintf(stderr,
+                 "ptpu_schedck: schedck::Thread destroyed without "
+                 "join()\n");
+    std::abort();
+  }
+}
+
+void Thread::join() {
+  Engine& e = E();
+  Rec* rp = static_cast<Rec*>(impl_);
+  if (!rp) return;
+  {
+    std::unique_lock<std::mutex> lk(e.mu);
+    while (rp->st != Rec::St::kFinished) {
+      tl->join_target = rp->tid;
+      BlockSelfLocked(lk, Rec::St::kBlockedJoin, nullptr, "join");
+    }
+  }
+  rp->real.join();  // model-finished => the OS thread is exiting
+  impl_ = nullptr;
+}
+
+void SchedPoint(const char* where) {
+  if (!ManagedActive()) return;
+  Engine& e = E();
+  std::unique_lock<std::mutex> lk(e.mu);
+  YieldLocked(lk, where);
+}
+
+void BlockUntil(const std::function<bool()>& pred, const char* what) {
+  if (!ManagedActive()) {
+    // unmanaged fall-back: the predicate must already hold (no
+    // scheduler exists to make progress for us)
+    if (!pred()) {
+      std::fprintf(stderr,
+                   "ptpu_schedck: BlockUntil(%s) outside an "
+                   "exploration with a false predicate\n", what);
+      std::abort();
+    }
+    return;
+  }
+  Engine& e = E();
+  std::unique_lock<std::mutex> lk(e.mu);
+  if (pred()) {
+    YieldLocked(lk, what);
+    return;
+  }
+  tl->pred = pred;
+  BlockSelfLocked(lk, Rec::St::kBlockedPred, nullptr, what);
+  tl->pred = nullptr;
+}
+
+bool Managed() { return ManagedActive(); }
+
+void FailAssert(const char* expr, const char* file, int line) {
+  Engine& e = E();
+  char buf[512];
+  std::snprintf(buf, sizeof(buf), "%s at %s:%d", expr, file, line);
+  if (ManagedActive()) {
+    std::unique_lock<std::mutex> lk(e.mu);
+    tl->where = "assert";
+    FailLocked("ASSERTION FAILED", buf);
+  }
+  std::fprintf(stderr, "ptpu_schedck: assertion failed: %s\n", buf);
+  std::fflush(stderr);
+  std::abort();
+}
+
+// --- ptpu_sync.h hooks --------------------------------------------
+
+bool OnMutexLock(void* m) {
+  if (!ManagedActive()) return false;
+  Engine& e = E();
+  std::unique_lock<std::mutex> lk(e.mu);
+  AcquireMutexLocked(lk, m, "mutex.lock");
+  return true;
+}
+
+bool OnMutexTryLock(void* m, bool* acquired) {
+  if (!ManagedActive()) return false;
+  Engine& e = E();
+  std::unique_lock<std::mutex> lk(e.mu);
+  YieldLocked(lk, "mutex.try_lock");
+  MutexSt& s = e.mutexes[m];
+  if (s.owner == -1 && s.shared == 0) {
+    s.owner = tl->tid;
+    *acquired = true;
+  } else {
+    *acquired = false;
+  }
+  return true;
+}
+
+bool OnMutexUnlock(void* m) {
+  if (!ManagedActive()) return false;
+  Engine& e = E();
+  std::unique_lock<std::mutex> lk(e.mu);
+  MutexSt& s = e.mutexes[m];
+  if (s.owner != tl->tid)
+    FailLocked("MUTEX PROTOCOL", "unlock by a non-owner");
+  s.owner = -1;
+  WakeMutexWaiters(m);
+  YieldLocked(lk, "mutex.unlock");  // post-release decision point
+  return true;
+}
+
+bool OnSharedLock(void* m) { return OnMutexLock(m); }
+
+bool OnSharedUnlock(void* m) { return OnMutexUnlock(m); }
+
+bool OnSharedLockShared(void* m) {
+  if (!ManagedActive()) return false;
+  Engine& e = E();
+  std::unique_lock<std::mutex> lk(e.mu);
+  YieldLocked(lk, "shared.lock_shared");
+  while (e.mutexes[m].owner != -1) {
+    BlockSelfLocked(lk, Rec::St::kBlockedShared, m,
+                    "shared.lock_shared");
+  }
+  e.mutexes[m].shared += 1;
+  return true;
+}
+
+bool OnSharedUnlockShared(void* m) {
+  if (!ManagedActive()) return false;
+  Engine& e = E();
+  std::unique_lock<std::mutex> lk(e.mu);
+  MutexSt& s = e.mutexes[m];
+  if (s.shared <= 0)
+    FailLocked("MUTEX PROTOCOL",
+               "unlock_shared without a shared hold");
+  s.shared -= 1;
+  WakeMutexWaiters(m);
+  YieldLocked(lk, "shared.unlock_shared");
+  return true;
+}
+
+bool OnCvWait(void* cvp, void* mp, int64_t usec) {
+  if (!ManagedActive()) return false;
+  Engine& e = E();
+  std::unique_lock<std::mutex> lk(e.mu);
+  MutexSt& s = e.mutexes[mp];
+  if (s.owner != tl->tid)
+    FailLocked("CV PROTOCOL", "wait without holding the mutex");
+  s.owner = -1;
+  WakeMutexWaiters(mp);
+  tl->timed_out = false;
+  BlockSelfLocked(lk,
+                  usec < 0 ? Rec::St::kBlockedCv
+                           : Rec::St::kBlockedCvTimed,
+                  cvp, usec < 0 ? "cv.wait" : "cv.wait_timed");
+  AcquireMutexLocked(lk, mp, "cv.reacquire");
+  return true;
+}
+
+bool OnCvNotify(void* cvp) {
+  if (!ManagedActive()) return false;
+  Engine& e = E();
+  std::unique_lock<std::mutex> lk(e.mu);
+  // Wake EVERY waiter, for notify_one too: a sound over-approximation
+  // (spurious wakeups are legal for std::condition_variable, and the
+  // wrappers only expose predicate waits). Lost wakeups still show:
+  // an untimed wait that nobody notifies never re-enters the enabled
+  // set, so the schedule that strands it deadlocks.
+  for (auto& t : e.threads) {
+    if ((t->st == Rec::St::kBlockedCv ||
+         t->st == Rec::St::kBlockedCvTimed) &&
+        t->obj == cvp) {
+      t->st = Rec::St::kRunnable;
+      t->obj = nullptr;
+    }
+  }
+  YieldLocked(lk, "cv.notify");
+  return true;
+}
+
+}  // namespace schedck
+}  // namespace ptpu

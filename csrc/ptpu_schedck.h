@@ -1,0 +1,173 @@
+// ptpu_schedck — deterministic concurrency model checker over the
+// ptpu_sync.h chokepoint (CHESS/PCT lineage: Musuvathi et al., OSDI'08
+// "Finding and Reproducing Heisenbugs in Concurrent Programs";
+// Burckhardt et al., ASPLOS'10 probabilistic concurrency testing).
+//
+// Build mode, NEVER shipped: compile test TUs with -DPTPU_SCHEDCK (the
+// Makefile's schedck targets force it, the shipping .so rules refuse
+// it exactly like SAN=/COV=). A cooperative scheduler owns every
+// schedck::Thread — exactly ONE is runnable at a time — and takes a
+// scheduling decision at every ptpu::Mutex / SharedMutex / CondVar
+// acquire / release / wait / notify plus every explicit
+// PTPU_SCHED_POINT() dropped at lock-free hot spots (trace seqlock
+// bracket, KvPool group refcounts, net eventfd inbox swap, batcher
+// wakeups). Two search strategies share the one engine:
+//
+//   dfs — bounded-depth exhaustive DFS: the first `depth` decisions
+//         enumerate every enabled thread (classic backtracking over a
+//         deterministic scenario); beyond the depth horizon the
+//         scheduler round-robins for forward progress. Exhausts the
+//         bounded space and reports it (Result.exhausted).
+//   pct — random-priority scheduling with `depth` priority-change
+//         points, seeded (splitmix64) per schedule index: high
+//         discovery probability on long scenarios where DFS cannot
+//         reach, still fully deterministic for a given seed.
+//
+// Every execution is a recorded decision trace (the chosen thread id
+// per decision). Any failure — SCHEDCK_ASSERT, deadlock (no enabled
+// thread while unfinished threads exist), step-budget livelock — is
+// reported with the full thread table and the trace is written to a
+// file from which Replay() reproduces the failure byte-for-byte on
+// the FIRST schedule. Lockdep composes: schedck binaries build with
+// -DPTPU_LOCKDEP too, so rank violations abort mid-schedule and the
+// schedule that provoked them is in the trace.
+//
+// Memory model caveat (same as CHESS): interleavings are explored at
+// scheduling-point granularity under sequential consistency. Torn
+// protocols (seqlock brackets, swap-then-clear windows) are visible
+// because the points bracket their critical field groups; compiler /
+// hardware reordering is the sanitizers' job, not this tool's.
+//
+// Scenario discipline (enforced by tools/ptpu_check.py `sched`):
+//  * scenario threads are ::ptpu::schedck::Thread, never std::thread;
+//  * shared scenario state is plain data + std::atomic — the engine
+//    serializes all managed threads through one internal mutex, so
+//    every interleaving the model explores is physically data-race
+//    free (TSan-clean by construction);
+//  * BlockUntil predicates only read scenario state; they run under
+//    the engine lock at every decision, so they must not touch any
+//    ptpu::Mutex or schedck API.
+#ifndef PTPU_SCHEDCK_H_
+#define PTPU_SCHEDCK_H_
+
+#if defined(PTPU_SCHEDCK)
+
+#include <cstdint>
+#include <functional>
+
+namespace ptpu {
+namespace schedck {
+
+struct Options {
+  enum class Strategy { kDfs, kPct };
+  Strategy strategy = Strategy::kDfs;
+  // 0 = take PTPU_SCHEDCK_SCHEDULES from the env (default 1000).
+  uint64_t max_schedules = 0;
+  // DFS: branching-decision horizon; PCT: number of priority-change
+  // points. 0 = PTPU_SCHEDCK_DEPTH from the env (default: dfs 6,
+  // pct 3).
+  int depth = 0;
+  // PCT base seed; 0 = PTPU_SCHEDCK_SEED from the env (default 1).
+  uint64_t seed = 0;
+  // Failure-trace destination; nullptr = PTPU_SCHEDCK_TRACE_OUT from
+  // the env, else "<scenario>.schedck-trace" in the cwd.
+  const char* trace_out = nullptr;
+};
+
+struct Result {
+  uint64_t schedules = 0;   // schedules actually executed
+  bool exhausted = false;   // dfs only: bounded space fully covered
+  uint64_t max_steps = 0;   // longest schedule seen (decision count)
+};
+
+// Run `body` under the scheduler, once per explored schedule, until
+// the strategy budget is spent (or, for dfs, the bounded space is
+// exhausted). `body` runs on the CALLING thread (which becomes
+// managed thread 0); it must spawn schedck::Thread workers and join
+// them all before returning. Any failure prints a report, writes the
+// decision trace and abort()s — exploration only returns on success.
+Result Explore(const char* name, const std::function<void()>& body,
+               Options opt = Options());
+
+// Re-execute `body` once, forcing every decision from `trace_file`
+// (written by a failing Explore). A recorded failure reproduces
+// deterministically on this first and only schedule.
+Result Replay(const char* name, const std::function<void()>& body,
+              const char* trace_file);
+
+// Cooperative test thread. Registration, start, finish and join are
+// all scheduling decisions; the underlying OS thread only runs while
+// the scheduler has elected it.
+class Thread {
+ public:
+  Thread() = default;
+  explicit Thread(std::function<void()> fn);
+  Thread(Thread&& o) noexcept : impl_(o.impl_) { o.impl_ = nullptr; }
+  Thread& operator=(Thread&& o) noexcept;
+  Thread(const Thread&) = delete;
+  Thread& operator=(const Thread&) = delete;
+  ~Thread();
+  bool joinable() const { return impl_ != nullptr; }
+  void join();
+
+ private:
+  void* impl_ = nullptr;  // engine-owned thread record
+};
+
+// Explicit yield: a pure scheduling decision with no state change —
+// this is what PTPU_SCHED_POINT() expands to. `where` tags the
+// decision in reports/traces.
+void SchedPoint(const char* where);
+
+// Model of a blocking syscall wait (epoll_wait on an eventfd, a
+// blocking accept): the thread leaves the enabled set until `pred()`
+// is true. Predicates are re-evaluated at every scheduling decision.
+// A thread blocked here while no other thread can make its predicate
+// true is a deadlock — exactly how a lost wakeup surfaces.
+void BlockUntil(const std::function<bool()>& pred, const char* what);
+
+// True while the calling thread is owned by an active exploration.
+bool Managed();
+
+[[noreturn]] void FailAssert(const char* expr, const char* file,
+                             int line);
+
+// --- ptpu_sync.h hook surface -------------------------------------
+// Each returns false when the calling thread is not managed (or no
+// exploration is active); the wrapper then falls through to the real
+// primitive, so schedck-built code still runs normally outside
+// Explore().
+bool OnMutexLock(void* m);
+bool OnMutexTryLock(void* m, bool* acquired);
+bool OnMutexUnlock(void* m);
+bool OnSharedLock(void* m);
+bool OnSharedUnlock(void* m);
+bool OnSharedLockShared(void* m);
+bool OnSharedUnlockShared(void* m);
+// usec < 0: untimed wait (re-enabled only by notify — a wait no one
+// will ever notify deadlocks, which is how lost wakeups are caught).
+// usec >= 0: timed wait (stays in the enabled set; electing it means
+// the timeout fired). Returns false when unmanaged.
+bool OnCvWait(void* cv, void* m, int64_t usec);
+bool OnCvNotify(void* cv);
+
+}  // namespace schedck
+}  // namespace ptpu
+
+#define PTPU_SCHEDCK_STR2_(x) #x
+#define PTPU_SCHEDCK_STR_(x) PTPU_SCHEDCK_STR2_(x)
+// A named interleaving point at a lock-free hot spot. No-op unless
+// the TU is built with -DPTPU_SCHEDCK (see the #else leg below).
+#define PTPU_SCHED_POINT() \
+  ::ptpu::schedck::SchedPoint(__FILE__ ":" PTPU_SCHEDCK_STR_(__LINE__))
+// Scenario invariant: failure reports + writes the decision trace +
+// aborts, so the schedule that broke it replays exactly.
+#define SCHEDCK_ASSERT(c) \
+  ((c) ? (void)0 : ::ptpu::schedck::FailAssert(#c, __FILE__, __LINE__))
+
+#else  // !PTPU_SCHEDCK — shipping / plain test builds: zero cost.
+
+#define PTPU_SCHED_POINT() ((void)0)
+
+#endif  // PTPU_SCHEDCK
+#endif  // PTPU_SCHEDCK_H_

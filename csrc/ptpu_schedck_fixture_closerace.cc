@@ -1,0 +1,223 @@
+// Seeded historical-bug fixture: the r9 listen-fd close-before-join
+// race.
+//
+// The original r9 server shutdown closed the listening fd BEFORE
+// stopping and joining the acceptor thread. An acceptor woken by a
+// late connection then called accept4() on a closed — and possibly
+// already reused — descriptor: EBADF on a good day, accepting on a
+// stranger's fd on a bad one. The fix (r9, kept ever since in
+// ptpu_net.cc Server::Stop) is stop-then-join-THEN-close. This
+// fixture reintroduces the buggy ordering as a model (BlockUntil =
+// epoll_wait on the listen fd; SCHEDCK_ASSERT(fd_open) = the
+// accept4() call) and asserts that ptpu_schedck
+//   1. rediscovers the use-after-close within a bounded schedule
+//      budget, under BOTH strategies (dfs exhaustively, pct
+//      probabilistically),
+//   2. replays it from the recorded decision trace on the FIRST
+//      schedule, with a byte-identical report, and
+//   3. passes the FIXED stop-join-close ordering exhaustively clean
+//      (the negative control — mirroring the lockdep fixture
+//      pattern).
+//
+// Built only by the schedck targets (-DPTPU_SCHEDCK -DPTPU_LOCKDEP);
+// runs in `make selftest`, both sancheck legs and the run_checks
+// schedck leg.
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "ptpu_schedck.h"
+#include "ptpu_sync.h"
+
+namespace sck = ptpu::schedck;
+
+namespace {
+
+constexpr uint64_t kBudget = 5000;  // discovery budget, both legs
+const char* kTracePath = "ptpu_schedck_fixture_closerace.trace";
+
+int g_tests = 0;
+
+void ok(const char* name) {
+  ++g_tests;
+  std::printf("ok %2d - %s\n", g_tests, name);
+  std::fflush(stdout);
+}
+
+void fail(const char* why, const std::string& detail) {
+  std::fprintf(stderr, "FAIL closerace fixture: %s\n%s\n", why,
+               detail.c_str());
+  std::exit(1);
+}
+
+// The acceptor/shutdown model. `close_before_join` selects the
+// seeded r9 buggy (true) or the FIXED (false) teardown ordering.
+void ServerRound(bool close_before_join) {
+  struct St {
+    std::atomic<bool> stop{false};
+    std::atomic<bool> fd_open{true};
+    std::atomic<int> pending{0};
+    int accepted = 0;
+  } st;
+  sck::Thread acceptor([&st] {
+    for (;;) {
+      // epoll_wait on the listen fd (a stop request also wakes it)
+      sck::BlockUntil(
+          [&st] {
+            return st.stop.load() || st.pending.load() > 0;
+          },
+          "epoll_wait(listen fd)");
+      if (st.pending.load() > 0) {
+        // accept4(listen_fd, ...): the fd must still be ours
+        SCHEDCK_ASSERT(st.fd_open.load());
+        st.pending.fetch_sub(1);
+        ++st.accepted;
+        PTPU_SCHED_POINT();  // hand the conn off, poll again
+        continue;
+      }
+      if (st.stop.load()) break;
+    }
+  });
+  sck::Thread client([&st] {
+    PTPU_SCHED_POINT();  // connect() lands at an arbitrary time
+    st.pending.fetch_add(1);
+  });
+  if (close_before_join) {
+    // r9 bug: close the listen fd while the acceptor still runs
+    st.fd_open.store(false);
+    PTPU_SCHED_POINT();  // a late connect wakes the acceptor here
+    st.stop.store(true);
+    acceptor.join();
+  } else {
+    // the r9 fix: stop, join, and only then close the fd
+    st.stop.store(true);
+    acceptor.join();
+    st.fd_open.store(false);
+  }
+  client.join();
+}
+
+void BuggyBody() { ServerRound(true); }
+void FixedBody() { ServerRound(false); }
+
+void ChildDiscoverDfs() {
+  sck::Options o;
+  o.strategy = sck::Options::Strategy::kDfs;
+  o.max_schedules = kBudget;
+  o.depth = 10;
+  o.trace_out = kTracePath;
+  sck::Explore("closerace_buggy", BuggyBody, o);
+}
+
+void ChildDiscoverPct() {
+  sck::Options o;
+  o.strategy = sck::Options::Strategy::kPct;
+  o.max_schedules = kBudget;
+  o.depth = 3;
+  o.seed = 1;
+  o.trace_out = kTracePath;
+  sck::Explore("closerace_buggy", BuggyBody, o);
+}
+
+void ChildReplay() {
+  sck::Replay("closerace_buggy", BuggyBody, kTracePath);
+}
+
+// Fork `fn`; expect SIGABRT; return the child's stderr.
+std::string RunDeathTest(void (*fn)()) {
+  int fds[2];
+  if (pipe(fds) != 0) fail("pipe failed", "");
+  const pid_t pid = fork();
+  if (pid < 0) fail("fork failed", "");
+  if (pid == 0) {
+    close(fds[0]);
+    dup2(fds[1], 2);
+    close(fds[1]);
+    fn();
+    _exit(0);  // no failure found == fixture bug not rediscovered
+  }
+  close(fds[1]);
+  std::string err;
+  char buf[4096];
+  ssize_t n;
+  while ((n = read(fds[0], buf, sizeof(buf))) > 0)
+    err.append(buf, size_t(n));
+  close(fds[0]);
+  int wst = 0;
+  waitpid(pid, &wst, 0);
+  if (!WIFSIGNALED(wst) || WTERMSIG(wst) != SIGABRT)
+    fail("expected SIGABRT (bug not rediscovered in budget)", err);
+  return err;
+}
+
+uint64_t ParseSchedule(const std::string& report) {
+  const size_t p = report.find("schedule ");
+  if (p == std::string::npos) fail("no schedule in report", report);
+  return std::strtoull(report.c_str() + p + 9, nullptr, 10);
+}
+
+void CheckDiscovery(void (*child)(), const char* what) {
+  std::remove(kTracePath);
+  const std::string rep = RunDeathTest(child);
+  if (rep.find("ASSERTION FAILED") == std::string::npos)
+    fail("expected an ASSERTION FAILED report", rep);
+  if (rep.find("fd_open") == std::string::npos)
+    fail("assertion is not the accept-after-close one", rep);
+  FILE* f = std::fopen(kTracePath, "r");
+  if (!f) fail("no decision trace written", rep);
+  std::fclose(f);
+  const uint64_t k = ParseSchedule(rep);
+  if (k >= kBudget) fail("discovery outside budget", rep);
+  std::printf("ok %2d - %s rediscovered the r9 close-before-join "
+              "race at schedule %llu (budget %llu)\n",
+              ++g_tests, what, (unsigned long long)k,
+              (unsigned long long)kBudget);
+  std::fflush(stdout);
+}
+
+}  // namespace
+
+int main() {
+  std::printf("ptpu_schedck_fixture_closerace: r9 listen-fd "
+              "close-before-join race\n");
+  CheckDiscovery(ChildDiscoverDfs, "dfs");
+  // replay the DFS-found trace: identical failure, first schedule, 3x
+  std::string prev;
+  for (int i = 0; i < 3; ++i) {
+    const std::string r = RunDeathTest(ChildReplay);
+    if (r.find("strategy replay  schedule 0") == std::string::npos)
+      fail("replay did not reproduce on the first schedule", r);
+    if (r.find("ASSERTION FAILED") == std::string::npos)
+      fail("replay reproduced a different failure", r);
+    if (i > 0 && r != prev)
+      fail("replay reports differ across runs", r);
+    prev = r;
+  }
+  ok("trace replays the identical assertion, 3x, on schedule 0");
+  CheckDiscovery(ChildDiscoverPct, "pct");
+  std::remove(kTracePath);
+  // negative control: the FIXED teardown is exhaustively clean
+  {
+    sck::Options o;
+    o.strategy = sck::Options::Strategy::kDfs;
+    o.max_schedules = 200000;
+    o.depth = 10;
+    const sck::Result r =
+        sck::Explore("closerace_fixed", FixedBody, o);
+    if (!r.exhausted)
+      fail("clean control did not exhaust the space", "");
+    std::printf("ok %2d - fixed stop-join-close teardown clean "
+                "(%llu schedules, exhaustive)\n",
+                ++g_tests, (unsigned long long)r.schedules);
+  }
+  std::remove("closerace_buggy.schedck-trace");  // replay re-records
+  std::printf("all closerace fixture checks passed (%d tests)\n",
+              g_tests);
+  return 0;
+}

@@ -70,6 +70,7 @@
 
 #include "ptpu_inference_api.h"
 #include "ptpu_net.h"
+#include "ptpu_schedck.h"
 #include "ptpu_stats.h"
 #include "ptpu_sync.h"
 #include "ptpu_trace.h"
@@ -289,6 +290,7 @@ class SvBatcher {
     rows_queued_ += r.rows;
     q_.push_back(std::move(r));
     stats_->queue_depth.Observe(uint64_t(q_.size()));
+    PTPU_SCHED_POINT();  // request queued, worker wakeup not yet sent
     cv_.notify_one();
     return true;
   }
@@ -301,6 +303,7 @@ class SvBatcher {
       ptpu::MutexLock l(mu_);
       stop_ = true;
     }
+    PTPU_SCHED_POINT();  // stop flagged, drain wakeup not yet sent
     cv_.notify_all();
     for (auto& t : workers_)
       if (t.joinable()) t.join();
@@ -352,7 +355,10 @@ class SvBatcher {
       stats_->batched_requests.Add(batch.size());
       stats_->batched_rows.Add(uint64_t(rows));
       stats_->batch_fill.Observe(uint64_t(rows));
-      if (!q_.empty()) cv_.notify_one();  // more work for a sibling
+      if (!q_.empty()) {
+        PTPU_SCHED_POINT();  // leftover work, sibling not yet woken
+        cv_.notify_one();
+      }
       l.unlock();
       // runners take predictor + net locks and must enter lock-free
       PTPU_LOCKDEP_ASSERT_NO_LOCKS("the batcher runner");

@@ -51,6 +51,15 @@
 #include <mutex>
 #include <shared_mutex>
 
+#if defined(PTPU_SCHEDCK)
+// Model-checker hooks (schedck test builds only — the shipping .so
+// rules refuse -DPTPU_SCHEDCK). Each On*() returns true when the
+// calling thread is owned by an active schedck exploration, in which
+// case the operation happened in the MODEL and the real primitive
+// must not be touched; unmanaged threads fall through unchanged.
+#include "ptpu_schedck.h"
+#endif
+
 #if defined(PTPU_LOCKDEP)
 #include <execinfo.h>
 
@@ -384,23 +393,55 @@ class Mutex {
 #if defined(PTPU_LOCKDEP)
   explicit Mutex(LockClass& c) : cls_(&c) {}
   void lock() {
+#if defined(PTPU_SCHEDCK)
+    if (schedck::OnMutexLock(this)) {
+      lockdep::OnAcquire(cls_->id(), this, /*shared=*/false);
+      return;
+    }
+#endif
     m_.lock();
     lockdep::OnAcquire(cls_->id(), this, /*shared=*/false);
   }
   bool try_lock() {
+#if defined(PTPU_SCHEDCK)
+    bool acq = false;
+    if (schedck::OnMutexTryLock(this, &acq)) {
+      if (acq) lockdep::OnAcquire(cls_->id(), this, /*shared=*/false);
+      return acq;
+    }
+#endif
     if (!m_.try_lock()) return false;
     lockdep::OnAcquire(cls_->id(), this, /*shared=*/false);
     return true;
   }
   void unlock() {
     lockdep::OnRelease(cls_->id(), this);
+#if defined(PTPU_SCHEDCK)
+    if (schedck::OnMutexUnlock(this)) return;
+#endif
     m_.unlock();
   }
 #else
   explicit Mutex(LockClass&) {}
-  void lock() { m_.lock(); }
-  bool try_lock() { return m_.try_lock(); }
-  void unlock() { m_.unlock(); }
+  void lock() {
+#if defined(PTPU_SCHEDCK)
+    if (schedck::OnMutexLock(this)) return;
+#endif
+    m_.lock();
+  }
+  bool try_lock() {
+#if defined(PTPU_SCHEDCK)
+    bool acq = false;
+    if (schedck::OnMutexTryLock(this, &acq)) return acq;
+#endif
+    return m_.try_lock();
+  }
+  void unlock() {
+#if defined(PTPU_SCHEDCK)
+    if (schedck::OnMutexUnlock(this)) return;
+#endif
+    m_.unlock();
+  }
 #endif
   std::mutex& native() { return m_; }
 
@@ -417,27 +458,65 @@ class SharedMutex {
 #if defined(PTPU_LOCKDEP)
   explicit SharedMutex(LockClass& c) : cls_(&c) {}
   void lock() {
+#if defined(PTPU_SCHEDCK)
+    if (schedck::OnSharedLock(this)) {
+      lockdep::OnAcquire(cls_->id(), this, /*shared=*/false);
+      return;
+    }
+#endif
     m_.lock();
     lockdep::OnAcquire(cls_->id(), this, /*shared=*/false);
   }
   void unlock() {
     lockdep::OnRelease(cls_->id(), this);
+#if defined(PTPU_SCHEDCK)
+    if (schedck::OnSharedUnlock(this)) return;
+#endif
     m_.unlock();
   }
   void lock_shared() {
+#if defined(PTPU_SCHEDCK)
+    if (schedck::OnSharedLockShared(this)) {
+      lockdep::OnAcquire(cls_->id(), this, /*shared=*/true);
+      return;
+    }
+#endif
     m_.lock_shared();
     lockdep::OnAcquire(cls_->id(), this, /*shared=*/true);
   }
   void unlock_shared() {
     lockdep::OnRelease(cls_->id(), this);
+#if defined(PTPU_SCHEDCK)
+    if (schedck::OnSharedUnlockShared(this)) return;
+#endif
     m_.unlock_shared();
   }
 #else
   explicit SharedMutex(LockClass&) {}
-  void lock() { m_.lock(); }
-  void unlock() { m_.unlock(); }
-  void lock_shared() { m_.lock_shared(); }
-  void unlock_shared() { m_.unlock_shared(); }
+  void lock() {
+#if defined(PTPU_SCHEDCK)
+    if (schedck::OnSharedLock(this)) return;
+#endif
+    m_.lock();
+  }
+  void unlock() {
+#if defined(PTPU_SCHEDCK)
+    if (schedck::OnSharedUnlock(this)) return;
+#endif
+    m_.unlock();
+  }
+  void lock_shared() {
+#if defined(PTPU_SCHEDCK)
+    if (schedck::OnSharedLockShared(this)) return;
+#endif
+    m_.lock_shared();
+  }
+  void unlock_shared() {
+#if defined(PTPU_SCHEDCK)
+    if (schedck::OnSharedUnlockShared(this)) return;
+#endif
+    m_.unlock_shared();
+  }
 #endif
 
  private:
@@ -454,8 +533,18 @@ using SharedUniqueLock = std::unique_lock<SharedMutex>;
 
 class CondVar {
  public:
-  void notify_one() { cv_.notify_one(); }
-  void notify_all() { cv_.notify_all(); }
+  void notify_one() {
+#if defined(PTPU_SCHEDCK)
+    if (schedck::OnCvNotify(this)) return;
+#endif
+    cv_.notify_one();
+  }
+  void notify_all() {
+#if defined(PTPU_SCHEDCK)
+    if (schedck::OnCvNotify(this)) return;
+#endif
+    cv_.notify_all();
+  }
 
   // Untimed wait WITH predicate (the only public untimed form: a
   // predicate-free wait returns on spurious wakeups unchecked — the
@@ -477,6 +566,18 @@ class CondVar {
     // set so the reacquisition re-validates order against anything
     // still held
     lockdep::OnRelease(m->cls_->id(), m);
+#endif
+#if defined(PTPU_SCHEDCK)
+    // Managed threads never touched the real m->m_ (Mutex::lock was
+    // modeled too), so the wait/release/reacquire cycle is pure model
+    // state. usec semantics: <0 untimed (re-enabled only by notify),
+    // >=0 timed (the scheduler may elect the timeout at any decision).
+    if (schedck::OnCvWait(this, m, usec)) {
+#if defined(PTPU_LOCKDEP)
+      lockdep::OnAcquire(m->cls_->id(), m, /*shared=*/false);
+#endif
+      return;
+    }
 #endif
     {
       std::unique_lock<std::mutex> il(m->native(), std::adopt_lock);

@@ -7,6 +7,7 @@
 #include <cstring>
 #include <random>
 
+#include "ptpu_schedck.h"
 #include "ptpu_stats.h"
 
 namespace ptpu {
@@ -87,10 +88,12 @@ void Recorder::Record(uint64_t tid, uint8_t kind, int64_t t0_us,
    * the even marker. Readers mirror with an acquire fence. */
   s.seq.store(2 * idx + 1, std::memory_order_relaxed);
   std::atomic_thread_fence(std::memory_order_release);
+  PTPU_SCHED_POINT();  // mid-bracket: fields half-written, seq odd
   s.trace_id.store(tid, std::memory_order_relaxed);
   s.kind.store(kind, std::memory_order_relaxed);
   s.t0.store(t0_us, std::memory_order_relaxed);
   s.t1.store(t1_us, std::memory_order_relaxed);
+  PTPU_SCHED_POINT();  // fields written, even marker not yet visible
   s.conn.store(conn, std::memory_order_relaxed);
   s.arg.store(arg, std::memory_order_relaxed);
   s.seq.store(2 * idx + 2, std::memory_order_release);
@@ -137,11 +140,13 @@ void Recorder::Snapshot(std::vector<SpanView>* out,
     const Slot& s = ring_[idx & (ring_.size() - 1)];
     if (s.seq.load(std::memory_order_acquire) != 2 * idx + 2)
       continue;  // torn (being overwritten right now): skip
+    PTPU_SCHED_POINT();  // a writer may reclaim the slot mid-copy
     SpanView v;
     v.trace_id = s.trace_id.load(std::memory_order_relaxed);
     v.kind = s.kind.load(std::memory_order_relaxed);
     v.t0_us = s.t0.load(std::memory_order_relaxed);
     v.t1_us = s.t1.load(std::memory_order_relaxed);
+    PTPU_SCHED_POINT();  // mid-copy: the re-check below must catch it
     v.conn = s.conn.load(std::memory_order_relaxed);
     v.arg = s.arg.load(std::memory_order_relaxed);
     // the acquire fence pins the field loads BEFORE the re-check (an

@@ -29,6 +29,8 @@ CSRC = os.path.join(REPO, "csrc")
 SELFTEST_BINARIES = [
     "ptpu_selftest", "ptpu_ps_selftest", "ptpu_serving_selftest",
     "ptpu_net_selftest", "ptpu_trace_selftest", "ptpu_lockdep_selftest",
+    "ptpu_schedck_selftest", "ptpu_schedck_fixture_lostwake",
+    "ptpu_schedck_fixture_closerace",
 ]
 SHIPPING_SOS = [
     "paddle_tpu/_native.so", "paddle_tpu/_native_predictor.so",

@@ -46,12 +46,18 @@ SAN_BINARIES = {
                    "ptpu_net_selftest.san-asan-ubsan",
                    "ptpu_trace_selftest.san-asan-ubsan",
                    "ptpu_lockdep_selftest.san-asan-ubsan",
+                   "ptpu_schedck_selftest.san-asan-ubsan",
+                   "ptpu_schedck_fixture_lostwake.san-asan-ubsan",
+                   "ptpu_schedck_fixture_closerace.san-asan-ubsan",
                    "ptpu_predictor_demo.san-asan-ubsan"],
     "tsan": ["ptpu_selftest.san-tsan", "ptpu_ps_selftest.san-tsan",
              "ptpu_serving_selftest.san-tsan",
              "ptpu_net_selftest.san-tsan",
              "ptpu_trace_selftest.san-tsan",
              "ptpu_lockdep_selftest.san-tsan",
+             "ptpu_schedck_selftest.san-tsan",
+             "ptpu_schedck_fixture_lostwake.san-tsan",
+             "ptpu_schedck_fixture_closerace.san-tsan",
              "ptpu_predictor_demo.san-tsan"],
 }
 
@@ -183,6 +189,9 @@ def test_native_selftest_passes():
     assert "all native serving unit tests passed" in r.stdout
     assert "ptpu_trace_selftest" in r.stdout
     assert "all native lockdep unit tests passed" in r.stdout
+    assert "all native schedck unit tests passed" in r.stdout
+    assert "all lostwake fixture checks passed" in r.stdout
+    assert "all closerace fixture checks passed" in r.stdout
 
 
 def test_sancheck_asan_ubsan_green():

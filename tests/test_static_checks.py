@@ -92,7 +92,8 @@ class TestLiveTree:
         assert r.returncode == 0
         names = set(r.stdout.split())
         assert names == {"abi", "wire", "stats", "locks", "net",
-                         "nullcheck", "trace", "sync", "fuzz"}
+                         "nullcheck", "trace", "sync", "fuzz",
+                         "sched"}
 
 
 class TestAbiChecker:
@@ -573,6 +574,86 @@ class TestFuzzChecker:
         msgs = [f.message for f in _run(root, "fuzz")]
         assert any("fuzz_json not listed in FUZZ_TARGETS" in m
                    for m in msgs)
+
+
+def _sched_tree(tmp_path):
+    """Minimal synthetic tree the sched checker accepts: one
+    production lock class, a selftest registry with one scenario, and
+    a manifest mapping the class to it."""
+    root = tmp_path / "tree"
+    (root / "csrc").mkdir(parents=True)
+    (root / "csrc" / "ptpu_prod.cc").write_text(
+        'PTPU_LOCK_CLASS(kA, "x.a", 10);\n'
+        "ptpu::Mutex mu{kA};\n")
+    (root / "csrc" / "ptpu_schedck_selftest.cc").write_text(
+        '#include "ptpu_schedck.h"\n'
+        "const Scenario suite[] = {\n"
+        '    {"x_scenario", nullptr, nullptr},\n'
+        "};\n")
+    (root / "csrc" / "ptpu_schedck_coverage.txt").write_text(
+        "x.a x_scenario\n")
+    return root
+
+
+class TestSchedChecker:
+    """ISSUE 15: every production lock class maps to a schedck
+    scenario in the coverage manifest, mapped scenarios exist in the
+    selftest registry, scenario TUs never spawn raw std::thread, and
+    PTPU_SCHED_POINT only appears with its self-gating header."""
+
+    def test_clean_on_live_tree(self):
+        assert ptpu_check.check_sched(REPO) == []
+
+    def test_clean_fixture(self, tmp_path):
+        assert _run(_sched_tree(tmp_path), "sched") == []
+
+    def test_catches_unmapped_lock_class(self, tmp_path):
+        root = _sched_tree(tmp_path)
+        _mutate(root, "csrc/ptpu_prod.cc",
+                'PTPU_LOCK_CLASS(kA, "x.a", 10);',
+                'PTPU_LOCK_CLASS(kA, "x.a", 10);\n'
+                'PTPU_LOCK_CLASS(kB, "x.unmapped", 20);')
+        msgs = [f.message for f in _run(root, "sched")]
+        assert any('"x.unmapped" has no row' in m for m in msgs)
+
+    def test_catches_scenario_missing_from_registry(self, tmp_path):
+        root = _sched_tree(tmp_path)
+        _mutate(root, "csrc/ptpu_schedck_coverage.txt",
+                "x.a x_scenario", "x.a gone_scenario")
+        msgs = [f.message for f in _run(root, "sched")]
+        assert any("'gone_scenario'" in m and "does not exist" in m
+                   for m in msgs)
+
+    def test_catches_stale_manifest_row(self, tmp_path):
+        root = _sched_tree(tmp_path)
+        _mutate(root, "csrc/ptpu_schedck_coverage.txt",
+                "x.a x_scenario",
+                "x.a x_scenario\nx.gone x_scenario")
+        msgs = [f.message for f in _run(root, "sched")]
+        assert any('"x.gone"' in m and "stale" in m for m in msgs)
+
+    def test_catches_raw_std_thread_in_scenario_tu(self, tmp_path):
+        root = _sched_tree(tmp_path)
+        _mutate(root, "csrc/ptpu_schedck_selftest.cc",
+                "const Scenario suite",
+                "std::thread t;\nconst Scenario suite")
+        msgs = [f.message for f in _run(root, "sched")]
+        assert any("raw std::thread" in m and "schedck::Thread" in m
+                   for m in msgs)
+
+    def test_catches_sched_point_without_header(self, tmp_path):
+        root = _sched_tree(tmp_path)
+        (root / "csrc" / "ptpu_extra.cc").write_text(
+            "void f() { PTPU_SCHED_POINT(); }\n")
+        msgs = [f.message for f in _run(root, "sched")]
+        assert any("without including" in m and "ptpu_schedck.h" in m
+                   for m in msgs)
+
+    def test_manifest_missing_is_a_finding(self, tmp_path):
+        root = _sched_tree(tmp_path)
+        os.remove(root / "csrc" / "ptpu_schedck_coverage.txt")
+        msgs = [f.message for f in _run(root, "sched")]
+        assert any("file missing" in m for m in msgs)
 
 
 class TestFindingPlumbing:

@@ -1,0 +1,218 @@
+#!/usr/bin/env python3
+"""covcheck — gcov line-coverage gate for the native runtime
+(`make -C csrc covcheck`; reference: the coverage thresholds upstream
+CI enforces per directory).
+
+The tree's correctness tooling (selftests, fuzz corpus, the schedck
+model checker) is only as good as the lines it actually executes, so
+coverage is a checked floor, not a dashboard: this script builds each
+measurement unit with COV=1 (--coverage -O0; .cov-suffixed binaries,
+never clobbering production artifacts), runs it, harvests
+`gcov -t --json-format`, merges the per-source-file line counts
+across units, and asserts the FLOORS table — the hot contract files
+(ptpu_wire.h and its users, ptpu_net.cc, ptpu_sync.h) must keep their
+measured line coverage. A new parser branch nobody tests drops the
+percentage and fails the gate.
+
+One unit is built and harvested AT A TIME: gcov names its .gcno/.gcda
+after the SOURCE file, so two binaries compiling the same TU clobber
+each other's counters if built side by side.
+
+The merged result is written to csrc/covcheck_report.json (the CI
+artifact tests/test_covcheck.py validates).
+
+Usage:
+  python3 tools/covcheck.py            # full gate (builds, runs, asserts)
+  python3 tools/covcheck.py --report-only   # re-assert an existing report
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CSRC = os.path.join(REPO, "csrc")
+REPORT = os.path.join(CSRC, "covcheck_report.json")
+
+# (make target, run argv relative to csrc) — each unit is one binary.
+# The serving selftest is deliberately absent: its two-instance
+# scaling assertion needs >1 core, and an abort() loses the .gcda
+# (gcov flushes at exit) — its wire paths are credited by the
+# wire_serving corpus replay instead.
+UNITS: List[Tuple[str, List[str]]] = [
+    ("ptpu_net_selftest.cov", ["./ptpu_net_selftest.cov"]),
+    ("ptpu_ps_selftest.cov", ["./ptpu_ps_selftest.cov"]),
+    ("ptpu_schedck_selftest.cov", ["./ptpu_schedck_selftest.cov"]),
+    ("fuzz/fuzz_wire_ps.cov.fuzz",
+     ["./fuzz/fuzz_wire_ps.cov.fuzz", "fuzz/corpus/wire_ps"]),
+    ("fuzz/fuzz_wire_serving.cov.fuzz",
+     ["./fuzz/fuzz_wire_serving.cov.fuzz", "fuzz/corpus/wire_serving"]),
+    ("fuzz/fuzz_frames.cov.fuzz",
+     ["./fuzz/fuzz_frames.cov.fuzz", "fuzz/corpus/frames"]),
+    ("fuzz/fuzz_http.cov.fuzz",
+     ["./fuzz/fuzz_http.cov.fuzz", "fuzz/corpus/http"]),
+]
+
+# Minimum line coverage (percent of executable lines executed) per
+# source file, merged across all units. Measured headroom is kept
+# above each floor so routine edits don't trip it, but a tested-never
+# subsystem landing in one of these files will.
+FLOORS: Dict[str, float] = {
+    "ptpu_wire.h": 90.0,      # measured 97.6 at introduction
+    "ptpu_net.cc": 72.0,      # measured 79.6
+    "ptpu_sync.h": 65.0,      # measured 73.4
+    "ptpu_ps_server.cc": 75.0,  # measured 87.4
+    "ptpu_serving.cc": 45.0,  # measured 52.0
+}
+
+
+def parse_gcov_json(text: str) -> Dict[str, Dict[int, int]]:
+    """Parse `gcov -t --json-format` output (one JSON document per
+    line, one per .gcda) into {source file: {line: count}}."""
+    out: Dict[str, Dict[int, int]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        doc = json.loads(line)
+        for f in doc.get("files", []):
+            name = os.path.basename(f.get("file", ""))
+            if not name:
+                continue
+            dst = out.setdefault(name, {})
+            for rec in f.get("lines", []):
+                ln = rec["line_number"]
+                dst[ln] = max(dst.get(ln, 0), rec.get("count", 0))
+    return out
+
+
+def merge_counts(into: Dict[str, Dict[int, int]],
+                 unit: Dict[str, Dict[int, int]]) -> None:
+    """Union of executable lines; a line is covered if ANY unit ran
+    it (max of counts)."""
+    for name, lines in unit.items():
+        dst = into.setdefault(name, {})
+        for ln, cnt in lines.items():
+            dst[ln] = max(dst.get(ln, 0), cnt)
+
+
+def coverage_pct(lines: Dict[int, int]) -> float:
+    if not lines:
+        return 0.0
+    hit = sum(1 for c in lines.values() if c > 0)
+    return 100.0 * hit / len(lines)
+
+
+def check_floors(merged: Dict[str, Dict[int, int]],
+                 floors: Dict[str, float]) -> List[str]:
+    """Return human-readable failures (empty == gate passes)."""
+    failures = []
+    for name, floor in sorted(floors.items()):
+        lines = merged.get(name)
+        if lines is None:
+            failures.append(
+                f"{name}: no coverage data harvested (floor "
+                f"{floor:.0f}%) — did its measurement unit run?")
+            continue
+        pct = coverage_pct(lines)
+        if pct < floor:
+            failures.append(
+                f"{name}: line coverage {pct:.1f}% is below the "
+                f"{floor:.0f}% floor")
+    return failures
+
+
+def build_report(merged: Dict[str, Dict[int, int]],
+                 floors: Dict[str, float]) -> dict:
+    files = {}
+    for name, lines in sorted(merged.items()):
+        hit = sum(1 for c in lines.values() if c > 0)
+        files[name] = {
+            "executable_lines": len(lines),
+            "executed_lines": hit,
+            "pct": round(coverage_pct(lines), 2),
+        }
+    failures = check_floors(merged, floors)
+    return {
+        "schema": "ptpu-covcheck-report v1",
+        "floors": floors,
+        "files": files,
+        "failures": failures,
+        "pass": not failures,
+    }
+
+
+def _clean_gcda() -> None:
+    # counters only — the .gcno notes files are compile-time artifacts
+    # that pair with the (possibly warm) .cov binaries; removing them
+    # without forcing a rebuild would leave gcov unable to attribute
+    # the next run's counters. `make -C csrc clean` removes both.
+    for pat in ("*.gcda", os.path.join("fuzz", "*.gcda")):
+        for p in glob.glob(os.path.join(CSRC, pat)):
+            os.remove(p)
+
+
+def run_units(jobs: int) -> Dict[str, Dict[int, int]]:
+    merged: Dict[str, Dict[int, int]] = {}
+    for target, argv in UNITS:
+        _clean_gcda()
+        subprocess.run(["make", "-C", CSRC, f"-j{jobs}", target,
+                        "COV=1"], check=True)
+        subprocess.run(argv, cwd=CSRC, check=True,
+                       stdout=subprocess.DEVNULL)
+        gcda = sorted(glob.glob(os.path.join(CSRC, "*.gcda")) +
+                      glob.glob(os.path.join(CSRC, "fuzz", "*.gcda")))
+        if not gcda:
+            raise RuntimeError(f"unit {target}: no .gcda produced")
+        r = subprocess.run(["gcov", "-t", "--json-format"] + gcda,
+                           cwd=CSRC, check=True, capture_output=True,
+                           text=True)
+        merge_counts(merged, parse_gcov_json(r.stdout))
+    _clean_gcda()
+    return merged
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("-j", "--jobs", type=int, default=2)
+    ap.add_argument("--report-only", action="store_true",
+                    help="re-assert the floors against an existing "
+                         "csrc/covcheck_report.json (no build/run)")
+    args = ap.parse_args(argv)
+
+    if args.report_only:
+        with open(REPORT) as f:
+            report = json.load(f)
+        failures = report.get("failures", ["report carries no "
+                                           "failures field"])
+    else:
+        merged = run_units(args.jobs)
+        report = build_report(merged, FLOORS)
+        with open(REPORT, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+        failures = report["failures"]
+
+    for name in sorted(FLOORS):
+        entry = report["files"].get(name)
+        shown = (f"{entry['pct']:5.1f}% "
+                 f"({entry['executed_lines']}/"
+                 f"{entry['executable_lines']} lines)"
+                 if entry else "no data")
+        print(f"covcheck: {name:<18} {shown}  floor "
+              f"{FLOORS[name]:.0f}%")
+    if failures:
+        for msg in failures:
+            print(f"covcheck: FAIL {msg}", file=sys.stderr)
+        return 1
+    print(f"covcheck: PASS — report at {os.path.relpath(REPORT, REPO)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

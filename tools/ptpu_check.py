@@ -39,6 +39,13 @@ Go that the compiler cannot see across:
              harness + checked-in corpus entry: wire tags (PS +
              serving planes), HTTP telemetry routes, ONNX node ops
              (csrc/fuzz, ISSUE 11)
+  sched      model-checker coverage (ISSUE 15): every production
+             PTPU_LOCK_CLASS name maps to a scenario in
+             csrc/ptpu_schedck_coverage.txt, every mapped scenario
+             exists in the selftest registry, scenario TUs never
+             spawn raw std::thread (invisible to the exploration),
+             and PTPU_SCHED_POINT only appears with its self-gating
+             header included
   trace      request-tracing seam (ISSUE 10): the traced v2 frame
              extension (version byte, 8-byte trace-id insert, read and
              echo offsets) in csrc (ptpu_ps_server.cc, ptpu_serving.cc)
@@ -727,7 +734,13 @@ def check_stats(root: str) -> List[Finding]:
 # exists to reroute them under TSan), so the wait rules skip it.
 # ptpu_lockdep_selftest.cc: the seeded-violation fixture suite — its
 # deliberately predicate-free waits ARE the fixtures
-LOCK_EXEMPT_FILES = {"ptpu_sync.h", "ptpu_lockdep_selftest.cc"}
+# ptpu_schedck.cc: the engine's scheduling gate (cv.wait under its own
+# raw mutex) re-checks `running == tid` in its wake loop; the selftest
+# deliberately exercises un-predicated timed waits to test the model's
+# timeout-as-wake semantics — both are schedck-internal, like the
+# seeded fixtures in ptpu_lockdep_selftest.cc.
+LOCK_EXEMPT_FILES = {"ptpu_sync.h", "ptpu_lockdep_selftest.cc",
+                     "ptpu_schedck.cc", "ptpu_schedck_selftest.cc"}
 
 
 def _top_level_arg_count(clean: str, open_paren: int) -> int:
@@ -1133,9 +1146,11 @@ def check_trace(root: str) -> List[Finding]:
 # ISSUE 11: every mutex/condvar in csrc lives behind the ptpu_sync.h
 # wrappers (ptpu::Mutex / SharedMutex / CondVar) so ptpu_lockdep sees
 # every acquisition — a raw std:: primitive is invisible to the rank
-# checks and the acquisition-order graph. ptpu_sync.h itself is the
-# one exempt file (it IS the wrapper).
-SYNC_EXEMPT_FILES = {"ptpu_sync.h"}
+# checks and the acquisition-order graph. Exempt: ptpu_sync.h (it IS
+# the wrapper) and ptpu_schedck.cc (the model-checker engine runs
+# BENEATH the wrappers — its one raw mutex/cv pair serializes the
+# managed threads and must not recurse into its own instrumentation).
+SYNC_EXEMPT_FILES = {"ptpu_sync.h", "ptpu_schedck.cc"}
 SYNC_BANNED = [
     "std::mutex", "std::shared_mutex", "std::recursive_mutex",
     "std::timed_mutex", "std::condition_variable", "pthread_mutex_t",
@@ -1347,6 +1362,118 @@ def check_fuzz(root: str) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# checker: sched
+# ---------------------------------------------------------------------------
+
+# ISSUE 15: the concurrency model checker (csrc/ptpu_schedck.h) only
+# proves what its scenarios model, so coverage is a checked contract:
+# every production PTPU_LOCK_CLASS name must map to at least one
+# scenario in the manifest (csrc/ptpu_schedck_coverage.txt), every
+# scenario the manifest names must exist in the selftest's registry,
+# scenario TUs must spawn threads through the scheduler's wrapper
+# (a raw std::thread is invisible to the exploration), and any TU
+# using PTPU_SCHED_POINT must include ptpu_schedck.h (whose no-op
+# fallback keeps production builds clean).
+
+SCHED_MANIFEST = "csrc/ptpu_schedck_coverage.txt"
+SCHED_SCENARIO_TU = "csrc/ptpu_schedck_selftest.cc"
+# TUs whose lock classes mirror production ones (or are test-only):
+# exempt from manifest coverage, subject to the std::thread ban
+_SCHED_TEST_TU = re.compile(
+    r"(?:_selftest\.cc|_fixture_\w+\.cc)$|^fuzz_")
+# the engine TU owns the real threads behind the model — exempt
+SCHED_ENGINE_FILES = {"ptpu_schedck.cc", "ptpu_schedck.h"}
+
+
+def check_sched(root: str) -> List[Finding]:
+    f: List[Finding] = []
+    manifest = _require(root, SCHED_MANIFEST, "sched", f)
+    selftest = _require(root, SCHED_SCENARIO_TU, "sched", f)
+    if manifest is None or selftest is None:
+        return f
+
+    # manifest rows: <lock-class-name> <scenario> [<scenario>...]
+    covered: Dict[str, List[str]] = {}
+    for i, raw in enumerate(manifest.splitlines(), 1):
+        line = raw.split("#", 1)[0].strip()
+        if not line:
+            continue
+        parts = line.split()
+        if len(parts) < 2:
+            f.append(Finding(
+                "sched", SCHED_MANIFEST, i,
+                f"manifest row '{line}' names no scenario — format is "
+                f"<lock-class-name> <scenario> [<scenario>...]"))
+            continue
+        covered[parts[0]] = parts[1:]
+
+    # scenario registry: the {"name", ...} rows of the selftest suite
+    registry = set(re.findall(
+        r'\{\s*"([a-z][a-z0-9_]*)"\s*,',
+        strip_c_comments(selftest, keep_strings=True)))
+    for cname, scenarios in sorted(covered.items()):
+        for sc in scenarios:
+            if sc not in registry:
+                f.append(Finding(
+                    "sched", SCHED_MANIFEST, 0,
+                    f"manifest maps \"{cname}\" to scenario '{sc}', "
+                    f"which does not exist in the "
+                    f"{SCHED_SCENARIO_TU} scenario registry"))
+
+    prod_classes: Set[str] = set()
+    for rel, fname in _csrc_sources(root):
+        src = _read(root, rel)
+        if src is None:
+            continue
+        clean = strip_c_comments(src)
+        decls = strip_c_comments(src, keep_strings=True)
+        test_tu = bool(_SCHED_TEST_TU.search(fname))
+
+        # 1) production lock classes need a scenario mapping
+        if not test_tu and fname not in SCHED_ENGINE_FILES:
+            for m in _LOCK_CLASS_DECL.finditer(decls):
+                cname = m.group(2)
+                prod_classes.add(cname)
+                if cname not in covered:
+                    f.append(Finding(
+                        "sched", rel, _lineno(clean, m.start()),
+                        f"lock class \"{cname}\" has no row in "
+                        f"{SCHED_MANIFEST} — model its protocol in a "
+                        f"schedck scenario (csrc/"
+                        f"ptpu_schedck_selftest.cc) and map it"))
+
+        # 2) scenario TUs spawn threads only through the scheduler
+        if (fname.startswith("ptpu_schedck_")
+                and fname not in SCHED_ENGINE_FILES):
+            for m in re.finditer(r"\bstd::thread\b", clean):
+                f.append(Finding(
+                    "sched", rel, _lineno(clean, m.start()),
+                    "raw std::thread in a schedck scenario TU — use "
+                    "ptpu::schedck::Thread so the exploration owns "
+                    "the thread"))
+
+        # 3) PTPU_SCHED_POINT only with the self-gating header
+        if fname != "ptpu_schedck.h":
+            uses = [m for m in re.finditer(r"\bPTPU_SCHED_POINT\b",
+                                           clean)]
+            if uses and '#include "ptpu_schedck.h"' not in decls:
+                f.append(Finding(
+                    "sched", rel, _lineno(clean, uses[0].start()),
+                    "PTPU_SCHED_POINT used without including "
+                    "ptpu_schedck.h — only its #ifdef PTPU_SCHEDCK "
+                    "wrapper makes the macro a production no-op"))
+
+    # stale manifest rows: class no longer declared in production
+    for cname in sorted(covered):
+        if cname not in prod_classes:
+            f.append(Finding(
+                "sched", SCHED_MANIFEST, 0,
+                f"manifest row \"{cname}\" matches no PTPU_LOCK_CLASS "
+                f"declared in production csrc — remove the stale row"))
+    return f
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -1360,6 +1487,7 @@ CHECKERS = {
     "trace": check_trace,
     "sync": check_sync,
     "fuzz": check_fuzz,
+    "sched": check_sched,
 }
 
 

@@ -8,11 +8,23 @@
 #                    exists — selftests run LOCKDEP-enabled (the
 #                    ranked-mutex validator, csrc/ptpu_sync.h) in
 #                    every leg
-#   ptpu_check       the 9 static checkers (ABI / wire / stats / locks
-#                    / net / nullcheck / trace / sync / fuzz) — 0
-#                    findings required
+#   ptpu_check       the 10 static checkers (ABI / wire / stats /
+#                    locks / net / nullcheck / trace / sync / fuzz /
+#                    sched) — 0 findings required
 #   selftest         the plain (lockdep-enabled, uninstrumented)
 #                    native selftests incl. the seeded ABBA fixture
+#   schedck          the concurrency model checker (csrc/ptpu_schedck)
+#                    deep sweep: every scenario DFS-exhausted on its
+#                    small config and PCT-swept SCHEDCK_SCHEDULES
+#                    times (default 10000) on its large one, then both
+#                    seeded historical-bug fixtures (r10 eventfd lost
+#                    wakeup, r9 close-before-join) rediscovered and
+#                    replayed deterministically
+#   covcheck         gcov line-coverage floors on the hot contract
+#                    files (ptpu_wire.h + users, ptpu_net.cc,
+#                    ptpu_sync.h), merged across the selftests and the
+#                    fuzz corpus replay; report artifact at
+#                    csrc/covcheck_report.json
 #   fuzz smoke       build every csrc/fuzz harness (ASan+UBSan +
 #                    trace-pc coverage), replay the checked-in corpus
 #                    (seeds + frozen crash regressions), then a
@@ -53,11 +65,18 @@ else
   step "sancheck: TSan SKIPPED (no usable libtsan on this machine)"
 fi
 
-step "ptpu_check: static analysis (abi / wire / stats / locks / net / nullcheck / trace)"
+step "ptpu_check: static analysis (10 checkers, 0 findings required)"
 python3 tools/ptpu_check.py
 
 step "native selftests (uninstrumented, lockdep-enabled)"
 make -C csrc -j"$JOBS" selftest
+
+SCHEDCK_SCHEDULES="${SCHEDCK_SCHEDULES:-10000}"
+step "schedck: model-checker sweep (${SCHEDCK_SCHEDULES} PCT schedules) + bug-fixture rediscovery"
+make -C csrc -j"$JOBS" schedck SCHEDCK_SCHEDULES="$SCHEDCK_SCHEDULES"
+
+step "covcheck: gcov line-coverage floors (selftests + fuzz corpus replay)"
+make -C csrc -j"$JOBS" covcheck
 
 step "fuzz smoke: build harnesses (ASan+UBSan + coverage)"
 make -C csrc -j"$JOBS" fuzz
