@@ -1,0 +1,65 @@
+// Fuzz target: the tuning-cache parser — ptpu::tune::ParseCacheBytes
+// in csrc/ptpu_tune.h (header + record array, ISSUE 16). The cache
+// file is UNTRUSTED DISK INPUT: any process that can write the cache
+// path (or a stale copy from another machine) feeds these bytes to
+// every predictor load, so the parser gets the same r11 treatment as
+// wire frames — bounds-checked, fuzzed, and every malformed shape
+// degrades to "adopt nothing, re-probe silently", never a crash.
+//
+// Harness shape: bytes in, ParseCacheBytes against both the matching
+// and a mismatching cpu signature (the first 8 input bytes double as
+// the expected signature so the fuzzer can reach kOk and kWrongCpu
+// with the same mutation budget). Well-formed inputs additionally
+// round-trip through SerializeCache and must re-parse identically —
+// canonicalization bugs surface as an abort here, not as a silently
+// rewritten cache in production. The Registry singleton's merge path
+// (validity re-check + first-insert-wins) runs on every parsed entry
+// set via a memory-only exercise of Insert/Lookup.
+//
+// Corpus: csrc/fuzz/corpus/tune (valid caches, truncations, huge
+// counts, wrong cpuid, overflowing offsets — csrc/fuzz/gen_seeds.py).
+// Build: `make fuzz`.
+#include "../ptpu_tune.cc"
+
+#include <cassert>
+#include <cstdint>
+#include <vector>
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  if (size > (1u << 20)) return 0;
+  namespace tn = ptpu::tune;
+  // derive the "expected" signature from the input so mutated headers
+  // can hit every ParseResult without knowing this machine's CpuSig
+  uint64_t sig = 0;
+  if (size >= tn::kTuneHeaderBytes) std::memcpy(&sig, data + 8, 8);
+  std::vector<std::pair<tn::TuneKey, tn::TuneConfig>> out, scratch;
+  const tn::ParseResult r = tn::ParseCacheBytes(data, size, sig, &out);
+  // flipped signature: same bytes must land in kWrongCpu, not adopt
+  (void)tn::ParseCacheBytes(data, size, sig ^ 0x517cc1b727220a95ull,
+                            &scratch);
+  if (r == tn::ParseResult::kOk) {
+    // canonical round trip: serialize the adopted entries and re-parse
+    std::vector<uint8_t> bytes;
+    tn::SerializeCache(out, sig, &bytes);
+    std::vector<std::pair<tn::TuneKey, tn::TuneConfig>> again;
+    const tn::ParseResult r2 =
+        tn::ParseCacheBytes(bytes.data(), bytes.size(), sig, &again);
+    assert(r2 == tn::ParseResult::kOk);
+    assert(again.size() == out.size());
+    for (size_t i = 0; i < out.size(); ++i) {
+      assert(again[i].first.m == out[i].first.m &&
+             again[i].first.n == out[i].first.n &&
+             again[i].first.k == out[i].first.k &&
+             again[i].first.dtype == out[i].first.dtype);
+      assert(again[i].second == out[i].second);
+    }
+    // registry merge path: every adopted entry must survive the
+    // Insert validity re-check and come back from Lookup
+    auto& reg = tn::Registry::Inst();
+    for (const auto& e : out) reg.Insert(e.first, e.second);
+    tn::TuneConfig got;
+    for (const auto& e : out) assert(reg.Lookup(e.first, &got));
+    reg.Clear();
+  }
+  return 0;
+}

@@ -205,6 +205,27 @@ def frame(payload):
     return struct.pack("<I", len(payload)) + payload
 
 
+# ---------------------------------------------------------------------------
+# tuning-cache files (twin of ptpu_tune.h: "PTUN" header + 44-byte
+# records; the fuzz_tune harness reads the expected cpu signature out
+# of bytes 8..15, so any well-formed file parses kOk regardless of
+# the generating machine)
+# ---------------------------------------------------------------------------
+
+TUNE_MAGIC = 0x4E555450  # "PTUN" little-endian
+
+
+def tune_rec(m=4, n=512, k=128, dtype=0, path=0, kc=320, mult=3, group=0):
+    return struct.pack("<qqqIiiii", m, n, k, dtype, path, kc, mult, group)
+
+
+def tune_cache(recs, magic=TUNE_MAGIC, version=1, sig=0x1122334455667788,
+               count=None):
+    body = b"".join(recs)
+    n = len(recs) if count is None else count
+    return struct.pack("<IIQI", magic, version, sig, n) + body
+
+
 def main():
     # ---- wire_ps ----
     w("wire_ps", "seed-pull-v1.bin", ps_pull())
@@ -372,6 +393,39 @@ def main():
     w("frames", "seed-preauth-huge-claim.bin",
       b"\x00" + struct.pack("<I", 0x7FFFFFFF))
     w("frames", "seed-preauth-partial.bin", b"\x00\x05\x00")
+
+    # ---- tune (persisted autotuning cache, ISSUE 16) ----
+    w("tune", "seed-valid.bin", tune_cache([
+        tune_rec(),                                   # f32 macro default
+        tune_rec(m=2, path=1, kc=160, mult=2),        # f32 row-GEMV alt
+        tune_rec(m=0, n=64, k=96, dtype=2, group=32),  # q4 pack group
+    ]))
+    w("tune", "seed-empty.bin", tune_cache([]))
+    w("tune", "seed-one-q4.bin",
+      tune_cache([tune_rec(m=1, n=4096, k=4096, dtype=1, group=128)]))
+    w("tune", "seed-trunc-header.bin", tune_cache([])[:11])
+    w("tune", "seed-trunc-record.bin",
+      tune_cache([tune_rec(), tune_rec(m=8)])[:-7])
+    w("tune", "seed-padded.bin", tune_cache([tune_rec()]) + b"\x00")
+    w("tune", "seed-huge-count.bin",
+      tune_cache([tune_rec()], count=0xFFFFFFFF))
+    w("tune", "seed-count-over-cap.bin",
+      tune_cache([tune_rec(m=i) for i in range(8)], count=4097))
+    w("tune", "seed-bad-magic.bin",
+      tune_cache([tune_rec()], magic=0x4E555451))
+    w("tune", "seed-bad-version.bin",
+      tune_cache([tune_rec()], version=9))
+    # alien signature: the harness still parses it with the embedded
+    # sig (kOk) AND a flipped sig (kWrongCpu) every exec
+    w("tune", "seed-alien-sig.bin",
+      tune_cache([tune_rec()], sig=0xDEADBEEFCAFEF00D))
+    # out-of-range fields: one bad record poisons the whole file
+    w("tune", "seed-bad-group.bin",
+      tune_cache([tune_rec(), tune_rec(dtype=2, group=99999)]))
+    w("tune", "seed-bad-path.bin", tune_cache([tune_rec(path=7)]))
+    w("tune", "seed-overflow-dims.bin",
+      tune_cache([tune_rec(m=1 << 50, n=-3)]))
+    w("tune", "seed-bad-dtype.bin", tune_cache([tune_rec(dtype=9)]))
 
     print("gen_seeds: corpora written under", os.path.join(HERE, "corpus"))
     return 0

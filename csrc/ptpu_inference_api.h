@@ -243,6 +243,21 @@ const char* ptpu_serving_prom_text(void*);
 void ptpu_trace_set(int64_t sample, int64_t slow_us);
 const char* ptpu_trace_json(int64_t max_spans);
 
+/* Persisted kernel autotuning (csrc/ptpu_tune.{h,cc}, process-global
+ * per .so; opt-in via PTPU_TUNE=1). Winners probed at load persist in
+ * a per-MACHINE cache file (PTPU_TUNE_CACHE, default
+ * ./.ptpu_tune.cache) keyed by a cpu signature; a corrupt or
+ * foreign-machine file silently re-probes, never errors.
+ * ptpu_tune_save/load return the entry count (-1 on I/O error);
+ * NULL/empty path means the default. stats_json returns a
+ * thread-local buffer valid until the calling thread's next call.
+ * ptpu_tune_clear drops the in-memory entries only (tests force a
+ * re-probe with it; the cache file is untouched). */
+const char* ptpu_tune_stats_json(void);
+int ptpu_tune_save(const char* path);
+int ptpu_tune_load(const char* path);
+void ptpu_tune_clear(void);
+
 /* Effective configuration as JSON (buckets built, instances, model
  * input signature). Pointer valid until the calling thread's next
  * config_json/stats_json call on any serving handle. */

@@ -40,6 +40,7 @@
 #include "ptpu_schedck.h"
 #include "ptpu_stats.h"
 #include "ptpu_sync.h"
+#include "ptpu_tune.h"
 
 namespace {
 
@@ -268,6 +269,14 @@ struct Node {
   std::string op;
   std::vector<std::string> inputs, outputs;
   std::map<std::string, Attr> attrs;
+  /* Per-node autotune memo (ptpu_tune.h): the resolved kernel config
+   * for the last-seen GEMM M (shapes are static per artifact, but the
+   * bucket ladder re-plans M per bucket). mutable: exec takes const
+   * Node&, and a predictor's run() is thread-compatible (one thread),
+   * so the memo needs no lock — the cross-instance source of truth is
+   * the locked tune::Registry. */
+  mutable int64_t tune_m = -1;
+  mutable int32_t tune_path = 0, tune_kc = 0, tune_mult = 0;
 };
 
 struct Graph {
@@ -1131,10 +1140,16 @@ static inline void micro_tile(const int32_t* Ap, const int32_t* Bp,
  * (column-tile, row-block) tasks sized to ~3 tasks per thread so the
  * WorkPool's chunked-range stealing load-balances ragged shapes (late
  * ResNet convs: P = 49 columns but 512 rows; early: the reverse). */
+/* kc_blk / task_mult <= 0 keep the compile-time defaults (KC, 3
+ * tasks per thread). Nonzero values come from the per-machine
+ * autotuner (ptpu_tune.h): both knobs only re-block the SAME
+ * k-ascending accumulation, so every config computes bitwise-equal
+ * fp32 results — a stale tuning cache can cost time, never bits. */
 template <class T>
 static void gemm_compute(const T* Apack, const T* Bpack, T* C,
                          int64_t M, int64_t N, int64_t K,
-                         const T* bias_n, const T* bias_m, int act) {
+                         const T* bias_n, const T* bias_m, int act,
+                         int64_t kc_blk = 0, int64_t task_mult = 0) {
   // degenerate extents (a hostile artifact can drive N or K to 0
   // through a zero dim): the tile-count arithmetic below divides by
   // the N tile count (fuzzing finding, ISSUE 11; repro:
@@ -1153,9 +1168,11 @@ static void gemm_compute(const T* Apack, const T* Bpack, T* C,
       }
     return;
   }
+  const int64_t kcb = kc_blk > 0 ? kc_blk : KC;
   const int64_t ntn = (N + NR - 1) / NR;
   const int64_t mp = (M + MR - 1) / MR;
-  const int64_t want = int64_t(3) * num_threads();
+  const int64_t want =
+      (task_mult > 0 ? task_mult : int64_t(3)) * num_threads();
   int64_t nbm = std::max<int64_t>(
       int64_t(1), std::min(mp, (want + ntn - 1) / ntn));
   const int64_t per_blk = (mp + nbm - 1) / nbm;
@@ -1169,8 +1186,8 @@ static void gemm_compute(const T* Apack, const T* Bpack, T* C,
       const int64_t p_lo = mb * per_blk;
       const int64_t p_hi = std::min(mp, p_lo + per_blk);
       const int64_t j0 = np * NR, nr = std::min(NR, N - j0);
-      for (int64_t k0 = 0; k0 < K; k0 += KC) {
-        const int64_t kc = std::min(KC, K - k0);
+      for (int64_t k0 = 0; k0 < K; k0 += kcb) {
+        const int64_t kc = std::min(kcb, K - k0);
         const bool first = k0 == 0, last = k0 + kc == K;
         for (int64_t p = p_lo; p < p_hi; ++p) {
           const int64_t m0 = p * MR, mr = std::min(MR, M - m0);
@@ -1259,13 +1276,31 @@ template <class T, class SA, class SB>
 static void gemm_bias_act(const SA* A, const SB* B, T* C, int64_t M,
                           int64_t N, int64_t K, const T* Apack_pre,
                           const T* Bpack_pre, const T* bias_n,
-                          const T* bias_m, int act) {
+                          const T* bias_m, int act,
+                          const ptpu::tune::TuneConfig* cfg = nullptr) {
   if (M == 1 && !Apack_pre) {  // batch-1 serving: direct GEMV
     const T bm0 = bias_m ? bias_m[0] : T(0);
     if (Bpack_pre)
       gemv_packed<T, SA>(A, Bpack_pre, C, N, K, bias_n, bm0, act);
     else
       gemv_raw<T, SA, SB>(A, B, C, N, K, bias_n, bm0, act);
+    return;
+  }
+  /* Autotuned alternate path (kPathAlt) for small-M over pre-packed
+   * weights: the MR=6 macro tile pads M=2..5 with zero rows — up to
+   * 3x wasted MACs on exactly the decode-ladder bucket shapes — so
+   * run each row as a packed GEMV instead. Per-row accumulation keeps
+   * the macro kernel's k-ascending order (zero PADDING rows never
+   * reach memory either way); only FMA contraction may differ between
+   * the intrinsics tile and the auto-vectorized GEMV loop, a sub-ulp-
+   * per-step effect the kernel parity selftest bounds. */
+  if (cfg != nullptr && cfg->path == ptpu::tune::kPathAlt &&
+      Bpack_pre != nullptr && Apack_pre == nullptr && K > 0 && N > 0) {
+    for (int64_t r = 0; r < M; ++r) {
+      const T bm0 = bias_m ? bias_m[r] : T(0);
+      gemv_packed<T, SA>(A + r * K, Bpack_pre, C + r * N, N, K, bias_n,
+                         bm0, act);
+    }
     return;
   }
   const T* Ap = Apack_pre;
@@ -1282,7 +1317,9 @@ static void gemm_bias_act(const SA* A, const SB* B, T* C, int64_t M,
     pack_b<SB, T>(B, K, N, buf.data());
     Bp = buf.data();
   }
-  gemm_compute(Ap, Bp, C, M, N, K, bias_n, bias_m, act);
+  gemm_compute(Ap, Bp, C, M, N, K, bias_n, bias_m, act,
+               cfg != nullptr ? cfg->kc : 0,
+               cfg != nullptr ? cfg->mult : 0);
 }
 
 // plain entry points (the selftest surface; the executor calls
@@ -1298,6 +1335,491 @@ static void gemm_bias_act(const SA* A, const SB* B, T* C, int64_t M,
                                    int64_t K) {
   gemm_bias_act<int32_t>(A, B, C, M, N, K, nullptr, nullptr, nullptr,
                          nullptr, ACT_NONE);
+}
+
+/* ------------------------------------------------------------------
+ * Weight-only int4 (ISSUE 16 tentpole a).
+ *
+ * Decode is GEMV-bound: every generated token streams the full weight
+ * set through the core, so weight BYTES are the roofline. Group-wise
+ * asymmetric 4-bit quantization cuts them 8x vs fp32: along each
+ * B column, K is split into groups of Q4G values sharing one fp32
+ * scale + zero-point (v ~ scale*q + zp, q in 0..15, zp = group min so
+ * an all-equal group takes scale 0 and reconstructs EXACTLY — zero
+ * columns and the NR-padding lanes stay bitwise 0.0f).
+ *
+ * Layout rides the existing per-machine prepack: the same NR=16
+ * column panels as pack_b, 16 nibbles per k row packed into 8 bytes
+ * (byte j = col j low nibble | col j+8 high nibble — one vpmovzxbd
+ * plus shift/mask decodes a full row on AVX2/AVX-512), scales and
+ * zero-points as [panel][group][NR] fp32 planes. Activations stay
+ * fp32; products dequant IN REGISTER, and the per-group algebra is
+ * factored as
+ *     acc[c] += scale[g][c] * sum_k(a[k]*q[k][c]) + zp[g][c] * sum_k(a[k])
+ * so the hot loop is pure fmadd on the quantized lanes. int4 is
+ * LOSSY: the path is opt-in (PTPU_INT4=1) and gated by a measured
+ * quality bound, not bitwise parity (tools/decode_bench.py --int4,
+ * README "Quantization & autotuning"). */
+
+constexpr int64_t Q4_DEFAULT_GROUP = 64;
+// below this weight size the pack/scale overhead outweighs the
+// bandwidth win (and tiny weights are never the decode bottleneck)
+constexpr int64_t Q4_MIN_ELEMS = 1024;
+
+// opt-in knob, read per predictor load (NOT once per process: tests
+// and the A/B benches load fp32 and int4 predictors side by side)
+static bool int4_enabled() {
+  const char* e = std::getenv("PTPU_INT4");
+  return e != nullptr && !std::strcmp(e, "1");
+}
+static int64_t int4_group_env() {
+  const char* e = std::getenv("PTPU_INT4_GROUP");
+  if (e == nullptr || e[0] == '\0') return 0;  // 0 = tune or default
+  const long v = std::atol(e);
+  return (v >= 1 && v <= 4096) ? int64_t(v) : 0;
+}
+
+static inline int64_t q4_groups(int64_t K, int64_t G) {
+  return G > 0 ? (K + G - 1) / G : 0;
+}
+static inline int64_t q4_data_size(int64_t K, int64_t N) {
+  return ((N + NR - 1) / NR) * K * (NR / 2);
+}
+static inline int64_t q4_scale_size(int64_t K, int64_t N, int64_t G) {
+  return ((N + NR - 1) / NR) * q4_groups(K, G) * NR;
+}
+
+/* Quantize row-major B[K,N] into nibble panels + scale/zp planes.
+ * Returns false (leaving outputs untouched) when B holds a non-finite
+ * value — min/max quantization would launder Inf/NaN into garbage, so
+ * such weights stay on the fp32 path. */
+static bool pack_b_q4(const float* B, int64_t K, int64_t N, int64_t G,
+                      uint8_t* q4, float* scale, float* zp) {
+  for (int64_t i = 0; i < K * N; ++i)
+    if (!std::isfinite(B[i])) return false;
+  const int64_t panels = (N + NR - 1) / NR;
+  const int64_t ng = q4_groups(K, G);
+  const int64_t grain =
+      std::max<int64_t>(1, 65536 / std::max<int64_t>(K * NR, 1));
+  parallel_for(panels, grain, [&](int64_t p0, int64_t p1) {
+    for (int64_t p = p0; p < p1; ++p) {
+      const int64_t j0 = p * NR, w = std::min(NR, N - j0);
+      uint8_t* dst = q4 + p * K * (NR / 2);
+      for (int64_t g = 0; g < ng; ++g) {
+        const int64_t k0 = g * G, k1 = std::min(K, k0 + G);
+        float* s = scale + (p * ng + g) * NR;
+        float* z = zp + (p * ng + g) * NR;
+        float inv[NR];
+        for (int64_t c = 0; c < NR; ++c) {
+          float mn = 0.f, mx = 0.f;
+          if (c < w && k1 > k0) {
+            mn = mx = B[k0 * N + j0 + c];
+            for (int64_t k = k0 + 1; k < k1; ++k) {
+              const float v = B[k * N + j0 + c];
+              mn = std::min(mn, v);
+              mx = std::max(mx, v);
+            }
+          }
+          const float sc = (mx - mn) / 15.0f;
+          s[c] = sc;
+          z[c] = mn;
+          inv[c] = sc > 0.f ? 1.0f / sc : 0.f;
+        }
+        for (int64_t k = k0; k < k1; ++k) {
+          uint8_t* row = dst + k * (NR / 2);
+          for (int64_t j = 0; j < NR / 2; ++j) {
+            uint32_t qlo = 0, qhi = 0;
+            if (j < w) {
+              const long q = std::lround(
+                  (B[k * N + j0 + j] - z[j]) * inv[j]);
+              qlo = uint32_t(q < 0 ? 0 : q > 15 ? 15 : q);
+            }
+            if (j + 8 < w) {
+              const long q = std::lround(
+                  (B[k * N + j0 + j + 8] - z[j + 8]) * inv[j + 8]);
+              qhi = uint32_t(q < 0 ? 0 : q > 15 ? 15 : q);
+            }
+            row[j] = uint8_t(qlo | (qhi << 4));
+          }
+        }
+      }
+    }
+  });
+  return true;
+}
+
+/* Dequantize rows [k0, k0+kc) of one nibble panel into pack_b float
+ * panel layout ([k][c], NR-wide) — the M > 1 int4 path feeds these
+ * KC-deep slices straight into the existing fp32 macro tile, so the
+ * compute kernel (and its epilogue semantics) is shared with fp32. */
+static void q4_dequant_rows_generic(const uint8_t* panel,
+                                    const float* scale, const float* zp,
+                                    int64_t K, int64_t G, int64_t ng,
+                                    int64_t k0, int64_t kc, float* out) {
+  for (int64_t k = k0; k < k0 + kc; ++k) {
+    const uint8_t* row = panel + k * (NR / 2);
+    const int64_t g = k / G;
+    const float* s = scale + g * NR;
+    const float* z = zp + g * NR;
+    float* d = out + (k - k0) * NR;
+    for (int64_t j = 0; j < NR / 2; ++j) {
+      const uint32_t b = row[j];
+      d[j] = s[j] * float(b & 0xF) + z[j];
+      d[j + 8] = s[j + 8] * float(b >> 4) + z[j + 8];
+    }
+  }
+  (void)K;
+  (void)ng;
+}
+
+#ifdef PTPU_X86
+__attribute__((target("avx2,fma")))
+static void q4_dequant_rows_avx2(const uint8_t* panel, const float* scale,
+                                 const float* zp, int64_t K, int64_t G,
+                                 int64_t ng, int64_t k0, int64_t kc,
+                                 float* out) {
+  const __m256i mask = _mm256_set1_epi32(0xF);
+  for (int64_t k = k0; k < k0 + kc; ++k) {
+    const uint8_t* row = panel + k * (NR / 2);
+    const int64_t g = k / G;
+    const float* s = scale + g * NR;
+    const float* z = zp + g * NR;
+    float* d = out + (k - k0) * NR;
+    const __m128i bytes =
+        _mm_loadl_epi64(reinterpret_cast<const __m128i*>(row));
+    const __m256i w = _mm256_cvtepu8_epi32(bytes);
+    const __m256 lo =
+        _mm256_cvtepi32_ps(_mm256_and_si256(w, mask));
+    const __m256 hi =
+        _mm256_cvtepi32_ps(_mm256_and_si256(_mm256_srli_epi32(w, 4), mask));
+    _mm256_storeu_ps(
+        d, _mm256_fmadd_ps(_mm256_loadu_ps(s), lo, _mm256_loadu_ps(z)));
+    _mm256_storeu_ps(d + 8,
+                     _mm256_fmadd_ps(_mm256_loadu_ps(s + 8), hi,
+                                     _mm256_loadu_ps(z + 8)));
+  }
+  (void)K;
+  (void)ng;
+}
+#endif  // PTPU_X86
+
+static inline void q4_dequant_rows(const uint8_t* panel, const float* scale,
+                                   const float* zp, int64_t K, int64_t G,
+                                   int64_t ng, int64_t k0, int64_t kc,
+                                   float* out) {
+#ifdef PTPU_X86
+  if (isa_level() >= ISA_AVX2) {
+    q4_dequant_rows_avx2(panel, scale, zp, K, G, ng, k0, kc, out);
+    return;
+  }
+#endif
+  q4_dequant_rows_generic(panel, scale, zp, K, G, ng, k0, kc, out);
+}
+
+/* int4 GEMV: the decode shape (M == 1). One pass over the nibble
+ * panels — 8 bytes per k row instead of 64 — with the per-group
+ * scale/zp algebra applied once per group. asum (the group-wise
+ * activation sums) depends only on A, so it is computed once and
+ * shared across every panel. */
+#ifdef PTPU_X86
+__attribute__((target("avx2,fma")))
+static void gemv_q4_panel_avx2(const float* A, const uint8_t* panel,
+                               const float* scale, const float* zp,
+                               const float* asum, int64_t K, int64_t G,
+                               int64_t ng, float* acc16) {
+  const __m256i mask = _mm256_set1_epi32(0xF);
+  __m256 acc0 = _mm256_setzero_ps(), acc1 = _mm256_setzero_ps();
+  for (int64_t g = 0; g < ng; ++g) {
+    const int64_t k0 = g * G, k1 = std::min(K, k0 + G);
+    __m256 q0 = _mm256_setzero_ps(), q1 = _mm256_setzero_ps();
+    for (int64_t k = k0; k < k1; ++k) {
+      const __m256 av = _mm256_broadcast_ss(A + k);
+      const __m128i bytes = _mm_loadl_epi64(
+          reinterpret_cast<const __m128i*>(panel + k * (NR / 2)));
+      const __m256i w = _mm256_cvtepu8_epi32(bytes);
+      const __m256 lo = _mm256_cvtepi32_ps(_mm256_and_si256(w, mask));
+      const __m256 hi = _mm256_cvtepi32_ps(
+          _mm256_and_si256(_mm256_srli_epi32(w, 4), mask));
+      q0 = _mm256_fmadd_ps(av, lo, q0);
+      q1 = _mm256_fmadd_ps(av, hi, q1);
+    }
+    const float* s = scale + g * NR;
+    const float* z = zp + g * NR;
+    const __m256 za = _mm256_broadcast_ss(asum + g);
+    acc0 = _mm256_fmadd_ps(_mm256_loadu_ps(s), q0, acc0);
+    acc0 = _mm256_fmadd_ps(_mm256_loadu_ps(z), za, acc0);
+    acc1 = _mm256_fmadd_ps(_mm256_loadu_ps(s + 8), q1, acc1);
+    acc1 = _mm256_fmadd_ps(_mm256_loadu_ps(z + 8), za, acc1);
+  }
+  _mm256_storeu_ps(acc16, acc0);
+  _mm256_storeu_ps(acc16 + 8, acc1);
+}
+
+__attribute__((target("avx512f")))
+static void gemv_q4_panel_avx512(const float* A, const uint8_t* panel,
+                                 const float* scale, const float* zp,
+                                 const float* asum, int64_t K, int64_t G,
+                                 int64_t ng, float* acc16) {
+  // one zmm covers the panel: bytes 0..7 duplicated into lanes 8..15,
+  // then a per-lane shift {0 x8, 4 x8} + mask isolates each nibble
+  const __m512i mask = _mm512_set1_epi32(0xF);
+  const __m512i shifts = _mm512_set_epi32(4, 4, 4, 4, 4, 4, 4, 4,
+                                          0, 0, 0, 0, 0, 0, 0, 0);
+  __m512 acc = _mm512_setzero_ps();
+  for (int64_t g = 0; g < ng; ++g) {
+    const int64_t k0 = g * G, k1 = std::min(K, k0 + G);
+    __m512 q = _mm512_setzero_ps();
+    for (int64_t k = k0; k < k1; ++k) {
+      __m128i b8 = _mm_loadl_epi64(
+          reinterpret_cast<const __m128i*>(panel + k * (NR / 2)));
+      b8 = _mm_unpacklo_epi64(b8, b8);
+      const __m512i w = _mm512_cvtepu8_epi32(b8);
+      const __m512 qf = _mm512_cvtepi32_ps(
+          _mm512_and_si512(_mm512_srlv_epi32(w, shifts), mask));
+      q = _mm512_fmadd_ps(_mm512_set1_ps(A[k]), qf, q);
+    }
+    acc = _mm512_fmadd_ps(_mm512_loadu_ps(scale + g * NR), q, acc);
+    acc = _mm512_fmadd_ps(_mm512_loadu_ps(zp + g * NR),
+                          _mm512_set1_ps(asum[g]), acc);
+  }
+  _mm512_storeu_ps(acc16, acc);
+}
+#endif  // PTPU_X86
+
+static void gemv_q4_panel_generic(const float* A, const uint8_t* panel,
+                                  const float* scale, const float* zp,
+                                  const float* asum, int64_t K, int64_t G,
+                                  int64_t ng, float* acc16) {
+  float acc[NR] = {};
+  for (int64_t g = 0; g < ng; ++g) {
+    const int64_t k0 = g * G, k1 = std::min(K, k0 + G);
+    float qacc[NR] = {};
+    for (int64_t k = k0; k < k1; ++k) {
+      const float av = A[k];
+      const uint8_t* row = panel + k * (NR / 2);
+      for (int64_t j = 0; j < NR / 2; ++j) {
+        const uint32_t b = row[j];
+        qacc[j] += av * float(b & 0xF);
+        qacc[j + 8] += av * float(b >> 4);
+      }
+    }
+    const float* s = scale + g * NR;
+    const float* z = zp + g * NR;
+    for (int64_t c = 0; c < NR; ++c)
+      acc[c] += s[c] * qacc[c] + z[c] * asum[g];
+  }
+  for (int64_t c = 0; c < NR; ++c) acc16[c] = acc[c];
+}
+
+static void gemv_q4(const float* A, const uint8_t* q4, const float* scale,
+                    const float* zp, float* C, int64_t N, int64_t K,
+                    int64_t G, const float* bias_n, float bm0, int act) {
+  const int64_t ntn = (N + NR - 1) / NR;
+  const int64_t ng = q4_groups(K, G);
+  // group-wise activation sums: A-only, shared by every panel
+  static thread_local std::vector<float> asum_buf;
+  asum_buf.assign(size_t(std::max<int64_t>(ng, 1)), 0.f);
+  float* asum = asum_buf.data();
+  for (int64_t g = 0; g < ng; ++g) {
+    const int64_t k0 = g * G, k1 = std::min(K, k0 + G);
+    float s = 0.f;
+    for (int64_t k = k0; k < k1; ++k) s += A[k];
+    asum[g] = s;
+  }
+  const int64_t grain = N * K < (int64_t(1) << 21) ? ntn : 1;
+  parallel_for(ntn, grain, [&](int64_t p0, int64_t p1) {
+    for (int64_t p = p0; p < p1; ++p) {
+      float acc16[NR];
+      const uint8_t* panel = q4 + p * K * (NR / 2);
+      const float* s = scale + p * ng * NR;
+      const float* z = zp + p * ng * NR;
+#ifdef PTPU_X86
+      const int lvl = isa_level();
+      if (lvl == ISA_AVX512)
+        gemv_q4_panel_avx512(A, panel, s, z, asum, K, G, ng, acc16);
+      else if (lvl == ISA_AVX2)
+        gemv_q4_panel_avx2(A, panel, s, z, asum, K, G, ng, acc16);
+      else
+#endif
+        gemv_q4_panel_generic(A, panel, s, z, asum, K, G, ng, acc16);
+      const int64_t j0 = p * NR, nr = std::min(NR, N - j0);
+      for (int64_t c = 0; c < nr; ++c) {
+        const float v = acc16[c] + bm0 + (bias_n ? bias_n[j0 + c] : 0.f);
+        C[j0 + c] = act_apply(v, act);
+      }
+    }
+  });
+}
+
+/* int4 GEMM, M > 1 (prefill / batched decode): same task grid as
+ * gemm_compute, but each (panel, k-slice) step first dequantizes the
+ * 8-byte rows into a thread-local float panel slice and then runs the
+ * existing fp32 micro tile — weight DRAM traffic stays 4-bit, the
+ * dequant target stays L1-resident. kPathAlt instead runs each row as
+ * an int4 GEMV (the small-M decode buckets where the MR=6 tile pads
+ * 3x). Zero-extent semantics match gemm_compute: K == 0 is an empty
+ * sum whose epilogue still fills C (r11 invariant). */
+static void gemm_q4(const float* A, const uint8_t* q4, const float* scale,
+                    const float* zp, float* C, int64_t M, int64_t N,
+                    int64_t K, int64_t G, const float* bias_n, int act,
+                    const ptpu::tune::TuneConfig* cfg) {
+  if (M <= 0 || N <= 0) return;
+  if (K <= 0) {
+    for (int64_t i = 0; i < M; ++i)
+      for (int64_t j = 0; j < N; ++j)
+        C[i * N + j] = act_apply(bias_n ? bias_n[j] : 0.f, act);
+    return;
+  }
+  if (M == 1 ||
+      (cfg != nullptr && cfg->path == ptpu::tune::kPathAlt)) {
+    for (int64_t r = 0; r < M; ++r)
+      gemv_q4(A + r * K, q4, scale, zp, C + r * N, N, K, G, bias_n, 0.f,
+              act);
+    return;
+  }
+  const int64_t kcb = cfg != nullptr && cfg->kc > 0 ? cfg->kc : KC;
+  const int64_t ng = q4_groups(K, G);
+  auto& abuf = pack_scratch<float>(0);
+  abuf.resize(size_t(a_pack_size(M, K)));
+  pack_a<float, float>(A, M, K, abuf.data());
+  const float* Apack = abuf.data();
+  const int64_t ntn = (N + NR - 1) / NR;
+  const int64_t mp = (M + MR - 1) / MR;
+  const int64_t want =
+      (cfg != nullptr && cfg->mult > 0 ? int64_t(cfg->mult) : int64_t(3)) *
+      num_threads();
+  int64_t nbm = std::max<int64_t>(
+      int64_t(1), std::min(mp, (want + ntn - 1) / ntn));
+  const int64_t per_blk = (mp + nbm - 1) / nbm;
+  nbm = (mp + per_blk - 1) / per_blk;
+  const int64_t grain = M * N * K < (int64_t(1) << 21) ? ntn * nbm : 1;
+  parallel_for(ntn * nbm, grain, [&](int64_t t0, int64_t t1) {
+    static thread_local std::vector<float> deq;
+    deq.resize(size_t(kcb * NR));
+    for (int64_t t = t0; t < t1; ++t) {
+      const int64_t np = t % ntn, mb = t / ntn;
+      const int64_t p_lo = mb * per_blk;
+      const int64_t p_hi = std::min(mp, p_lo + per_blk);
+      const int64_t j0 = np * NR, nr = std::min(NR, N - j0);
+      const uint8_t* panel = q4 + np * K * (NR / 2);
+      const float* s = scale + np * ng * NR;
+      const float* z = zp + np * ng * NR;
+      for (int64_t k0 = 0; k0 < K; k0 += kcb) {
+        const int64_t kc = std::min(kcb, K - k0);
+        const bool first = k0 == 0, last = k0 + kc == K;
+        q4_dequant_rows(panel, s, z, K, G, ng, k0, kc, deq.data());
+        for (int64_t p = p_lo; p < p_hi; ++p) {
+          const int64_t m0 = p * MR, mr = std::min(MR, M - m0);
+          micro_tile(Apack + p * K * MR + k0 * MR, deq.data(),
+                     C + m0 * N + j0, N, kc, mr, nr, first, last,
+                     bias_n ? bias_n + j0 : nullptr, nullptr, act);
+        }
+      }
+    }
+  });
+}
+
+/* Pick the int4 group size for a [K, N] weight: PTPU_INT4_GROUP wins,
+ * then a cached tuning-cache entry (key {0, N, K, q4pack}), then —
+ * with PTPU_TUNE=1 — a load-time probe that packs each candidate and
+ * times the decode GEMV over it (smaller groups cost scale-plane
+ * bytes, larger ones lose accuracy and L1 residency of the planes;
+ * which wins is a machine property). Without tuning: 64. */
+static int64_t q4_pick_group(const float* B, int64_t K, int64_t N) {
+  const int64_t genv = int4_group_env();
+  if (genv > 0) return genv;
+  namespace tn = ptpu::tune;
+  if (!tn::Registry::Enabled() || K <= 0 || N <= 0)
+    return Q4_DEFAULT_GROUP;
+  tn::TuneKey key;
+  key.m = 0;
+  key.n = N;
+  key.k = K;
+  key.dtype = tn::kDtQ4Pack;
+  tn::TuneConfig cfg;
+  if (tn::Registry::Inst().Lookup(key, &cfg) && cfg.group > 0)
+    return cfg.group;
+  static const int64_t cands[] = {32, 64, 128};
+  std::vector<float> a(size_t(K), 1.0f), c(size_t(N), 0.f);
+  std::vector<uint8_t> q4(size_t(q4_data_size(K, N)));
+  std::vector<float> qs, qz;
+  int64_t best_g = Q4_DEFAULT_GROUP;
+  uint64_t best_us = ~0ull;
+  const uint64_t probe0 = tn::NowUs();
+  for (const int64_t g : cands) {
+    qs.assign(size_t(q4_scale_size(K, N, g)), 0.f);
+    qz.assign(qs.size(), 0.f);
+    if (!pack_b_q4(B, K, N, g, q4.data(), qs.data(), qz.data()))
+      return Q4_DEFAULT_GROUP;  // non-finite: caller falls back to fp32
+    uint64_t best = ~0ull;
+    for (int rep = 0; rep < 3; ++rep) {
+      const uint64_t t0 = tn::NowUs();
+      gemv_q4(a.data(), q4.data(), qs.data(), qz.data(), c.data(), N, K,
+              g, nullptr, 0.f, ACT_NONE);
+      const uint64_t dt = tn::NowUs() - t0;
+      if (dt < best) best = dt;
+    }
+    if (best < best_us) {
+      best_us = best;
+      best_g = g;
+    }
+  }
+  cfg = tn::TuneConfig();
+  cfg.group = int32_t(best_g);
+  tn::Registry::Inst().Insert(key, cfg);
+  tn::Registry::Inst().NoteProbe(tn::NowUs() - probe0);
+  return best_g;
+}
+
+/* Time the kernel-config candidate grid ON THE REAL OPERANDS of a
+ * cache-missing GEMM shape and return the winner. Fires through the
+ * load-time dry run (plan_memory executes every node) and the serving
+ * ladder's start-up bucket probes — steady-state traffic only ever
+ * sees memo/cache hits. Every candidate computes the full, correct
+ * output (fp32 configs are bitwise-identical; the caller reruns the
+ * winner after Insert so the node's output always comes from the
+ * config that every later run will use). */
+template <class RunFn>
+static ptpu::tune::TuneConfig probe_gemm_cfg(int64_t M, const RunFn& run) {
+  namespace tn = ptpu::tune;
+  std::vector<tn::TuneConfig> cands;
+  cands.emplace_back();  // candidate 0: the compile-time defaults
+  static const int32_t kcs[] = {160, 320, 640};
+  const bool multi = num_threads() > 1;
+  for (const int32_t kc : kcs) {
+    for (const int32_t mult : {2, 3, 4}) {
+      if (!multi && mult != 3) continue;  // task grain is moot on 1 core
+      if (kc == KC && mult == 3) continue;  // == candidate 0
+      tn::TuneConfig c;
+      c.path = tn::kPathDefault;
+      c.kc = kc;
+      c.mult = multi ? mult : 0;
+      cands.push_back(c);
+    }
+  }
+  if (M <= 2 * MR) {  // per-row GEMV only plausibly wins at small M
+    tn::TuneConfig c;
+    c.path = tn::kPathAlt;
+    cands.push_back(c);
+  }
+  const uint64_t probe0 = tn::NowUs();
+  tn::TuneConfig best = cands[0];
+  uint64_t best_us = ~0ull;
+  for (const auto& c : cands) {
+    uint64_t us = ~0ull;
+    for (int rep = 0; rep < 2; ++rep) {
+      const uint64_t t0 = tn::NowUs();
+      run(&c);
+      const uint64_t dt = tn::NowUs() - t0;
+      if (dt < us) us = dt;
+    }
+    if (us < best_us) {
+      best_us = us;
+      best = c;
+    }
+  }
+  tn::Registry::Inst().NoteProbe(tn::NowUs() - probe0);
+  return best;
 }
 
 /* ------------------------------------------------------------------
@@ -2297,6 +2819,14 @@ struct Predictor {
     std::vector<int32_t> i;
     std::vector<int16_t> i16;  // VNNI pair panels (isa_vnni() loads)
     bool int8_ok = false;
+    /* Weight-only int4 (PTPU_INT4=1): nibble panels + per-group
+     * scale/zp planes, replacing the fp32 panels for eligible MatMul
+     * weights — q4 non-empty means pm.f was NOT packed (the panels
+     * are the only hot-loop read; the artifact's fp32 initializer
+     * stays in env for the scalar fallback paths). */
+    std::vector<uint8_t> q4;
+    std::vector<float> q4s, q4z;
+    int64_t q4_group = 0;
   };
   std::map<std::string, PackedMat> packed_w_;
 
@@ -4470,8 +5000,28 @@ struct Predictor {
         if (packed_w_.count(key)) continue;
         PackedMat pm;
         if (b.is_float()) {
-          pm.f.resize(size_t(b_pack_size(K, N)));
-          pack_b<float, float>(b.f.data(), K, N, pm.f.data());
+          // weight-only int4 (opt-in, PTPU_INT4=1): quantize eligible
+          // projection weights into nibble panels INSTEAD of fp32
+          // panels — 8x less weight traffic on the decode GEMV. Tiny
+          // or non-finite weights keep the exact fp32 panels.
+          bool q4_done = false;
+          if (int4_enabled() && K * N >= Q4_MIN_ELEMS) {
+            const int64_t G = q4_pick_group(b.f.data(), K, N);
+            PackedMat qm;
+            qm.q4.resize(size_t(q4_data_size(K, N)));
+            qm.q4s.assign(size_t(q4_scale_size(K, N, G)), 0.f);
+            qm.q4z.assign(qm.q4s.size(), 0.f);
+            if (pack_b_q4(b.f.data(), K, N, G, qm.q4.data(),
+                          qm.q4s.data(), qm.q4z.data())) {
+              qm.q4_group = G;
+              pm = std::move(qm);
+              q4_done = true;
+            }
+          }
+          if (!q4_done) {
+            pm.f.resize(size_t(b_pack_size(K, N)));
+            pack_b<float, float>(b.f.data(), K, N, pm.f.data());
+          }
         } else {
           pm.int8_ok = int8_vals_ok(b.i.data(), b.i.size());
           if (pm.int8_ok) {
@@ -5450,11 +6000,60 @@ void Predictor::run_node(const Node& n) {
       if (!batched_b) {
         // leading dims of A collapse into M: one packed macro-kernel
         // call over the whole batch, one shared (pre-packed) B panel
-        gemm_bias_act<float>(a.f.data(), b.f.data(), o.f.data(),
-                             batch * m, nn, k_d,
-                             nullptr, pw && !pw->f.empty() ? pw->f.data()
-                                                          : nullptr,
-                             bias_n, nullptr, act);
+        // (int4-packed when the load quantized this weight), config
+        // steered by the per-machine autotuner when PTPU_TUNE=1
+        const bool q4w = pw != nullptr && !pw->q4.empty();
+        const int64_t gm = batch * m;
+        namespace tn = ptpu::tune;
+        auto run_cfg = [&](const tn::TuneConfig* c) {
+          if (q4w)
+            gemm_q4(a.f.data(), pw->q4.data(), pw->q4s.data(),
+                    pw->q4z.data(), o.f.data(), gm, nn, k_d,
+                    pw->q4_group, bias_n, act, c);
+          else
+            gemm_bias_act<float>(a.f.data(), b.f.data(), o.f.data(), gm,
+                                 nn, k_d, nullptr,
+                                 pw && !pw->f.empty() ? pw->f.data()
+                                                      : nullptr,
+                                 bias_n, nullptr, act, c);
+        };
+        // autotune only steers shapes with blocking freedom: M > 1
+        // over a pre-packed weight (M == 1 is already the GEMV
+        // special case; unpacked B is a one-shot activation GEMM)
+        const bool tunable = tn::Registry::Enabled() && gm > 1 &&
+                             pw != nullptr && (q4w || !pw->f.empty()) &&
+                             k_d > 0 && nn > 0;
+        if (!tunable) {
+          run_cfg(nullptr);
+        } else if (n.tune_m == gm) {  // per-node memo: steady serving
+          tn::TuneConfig cfg;
+          cfg.path = n.tune_path;
+          cfg.kc = n.tune_kc;
+          cfg.mult = n.tune_mult;
+          run_cfg(&cfg);
+        } else {
+          tn::TuneKey key;
+          key.m = gm;
+          key.n = nn;
+          key.k = k_d;
+          key.dtype = q4w ? tn::kDtQ4 : tn::kDtF32;
+          tn::TuneConfig cfg;
+          if (!tn::Registry::Inst().Lookup(key, &cfg)) {
+            cfg = probe_gemm_cfg(gm, run_cfg);
+            tn::Registry::Inst().Insert(key, cfg);
+            // Insert may lose a first-wins race with another instance
+            // probing the same shape; adopt the canonical entry so
+            // the whole process agrees on one config
+            tn::Registry::Inst().Lookup(key, &cfg);
+            run_cfg(&cfg);  // output must come from the adopted config
+          } else {
+            run_cfg(&cfg);
+          }
+          n.tune_m = gm;
+          n.tune_path = cfg.path;
+          n.tune_kc = cfg.kc;
+          n.tune_mult = cfg.mult;
+        }
       } else {
         // batched (attention heads): the per-element GEMMs are tiny, so
         // parallelism comes from the BATCH axis — each worker packs and
@@ -6546,6 +7145,11 @@ static PTPU_Predictor* predictor_create_impl(const char* model_path,
       p->fuse_ops();
       p->prepack_weights();
       p->plan_memory();
+      // plan_memory's dry run executed every GEMM, so all autotune
+      // probes for this artifact's shapes have fired — persist any
+      // new winners now (no-op when the cache was already warm)
+      if (ptpu::tune::Registry::Enabled())
+        ptpu::tune::Registry::Inst().SaveIfDirty();
     }
     p->build_stats_index();
     if (threads > 0) {

@@ -53,6 +53,7 @@ PTPU_LOCK_CLASS(kClsKvPool, "kv.pool", 25);
 PTPU_LOCK_CLASS(kClsSvBatcher, "sv.batcher", 30);
 PTPU_LOCK_CLASS(kClsPsRegistry, "ps.registry", 40);
 PTPU_LOCK_CLASS(kClsPsTable, "ps.table", 50);
+PTPU_LOCK_CLASS(kClsTuneCache, "tune.cache", 55);
 PTPU_LOCK_CLASS(kClsWpDispatch, "wp.dispatch", 60, ptpu::kLockAllowBlock);
 PTPU_LOCK_CLASS(kClsWpState, "wp.state", 70);
 PTPU_LOCK_CLASS(kClsRtArena, "rt.arena", 80);
@@ -626,6 +627,77 @@ void RuntimeLocksScenario(int nworkers, int per_worker) {
   for (bool b : st.seen) SCHEDCK_ASSERT(b);
 }
 
+// --- tune.cache: probe-miss insert race vs lazy load vs save -------
+// Mirrors the ptpu_tune.h Registry (ISSUE 16): executors Lookup under
+// the registry mutex (lazily adopting the cache file on first touch),
+// time candidate configs OUTSIDE the lock on a miss, then Insert with
+// first-insert-wins; the load/ladder thread runs SaveIfDirty, which
+// snapshots entries under the lock and does the tmp+rename write
+// outside it. Invariants: the file is adopted exactly once; exactly
+// one config wins and never changes after any thread observed it (the
+// per-node memo depends on that immutability); every completed save
+// captured the full winner, never a torn half-entry; no thread holds
+// the registry mutex while probing or writing the file.
+void TuneRegistryScenario(int probers, int savers) {
+  struct St {
+    ptpu::Mutex mu{kClsTuneCache};
+    bool loaded = false;
+    int file_loads = 0;
+    int winner = 0;  // 0 == cache miss, else the winning config id
+    bool dirty = false;
+    int snap = -1;  // last config a completed save wrote to "disk"
+  } st;
+  std::vector<sck::Thread> ts;
+  for (int p = 1; p <= probers; ++p) {
+    ts.emplace_back([&st, p] {
+      int seen;
+      {
+        ptpu::MutexLock g(st.mu);
+        if (!st.loaded) {  // lazy one-shot load, Registry::load_locked
+          st.loaded = true;
+          ++st.file_loads;
+        }
+        seen = st.winner;
+      }
+      if (seen == 0) {
+        PTPU_LOCKDEP_ASSERT_NO_LOCKS("the tune probe");
+        PTPU_SCHED_POINT();  // candidate timing runs outside the lock
+        ptpu::MutexLock g(st.mu);
+        if (st.winner == 0) {  // first insert wins; losers adopt it
+          st.winner = p;
+          st.dirty = true;
+        }
+        seen = st.winner;
+      }
+      // the memoized config must be stable: a re-lookup agrees
+      ptpu::MutexLock g(st.mu);
+      SCHEDCK_ASSERT(seen != 0 && st.winner == seen);
+    });
+  }
+  std::vector<sck::Thread> sv;
+  for (int s = 0; s < savers; ++s) {
+    sv.emplace_back([&st] {
+      int snap;
+      {
+        ptpu::MutexLock g(st.mu);
+        if (!st.dirty) return;  // clean registry: no file write
+        snap = st.winner;
+        st.dirty = false;
+      }
+      PTPU_LOCKDEP_ASSERT_NO_LOCKS("the tune cache write");
+      PTPU_SCHED_POINT();  // tmp write + rename happen unlocked
+      SCHEDCK_ASSERT(snap != 0);  // dirty implies a complete entry
+      st.snap = snap;  // models the rename landing
+    });
+  }
+  for (auto& t : ts) t.join();
+  for (auto& t : sv) t.join();
+  SCHEDCK_ASSERT(st.loaded && st.file_loads == 1);
+  SCHEDCK_ASSERT(st.winner != 0);
+  // any save that reached disk holds the one immutable winner
+  if (st.snap != -1) SCHEDCK_ASSERT(st.snap == st.winner);
+}
+
 // --- the REAL trace seqlock (ptpu_trace.cc, compiled in) -----------
 // Production Record()/Snapshot() with their live PTPU_SCHED_POINT()s:
 // writers stamp every span field with one signature value; whatever
@@ -899,6 +971,8 @@ void RunScenarios() {
        [] { ConnOutScenario(2, 3); }},
       {"runtime_arena_queue", [] { RuntimeLocksScenario(1, 2); },
        [] { RuntimeLocksScenario(2, 2); }},
+      {"tune_probe_insert_save", [] { TuneRegistryScenario(2, 1); },
+       [] { TuneRegistryScenario(3, 2); }},
       {"trace_seqlock_real", [] { TraceSeqlockScenario(1, 2, 2); },
        [] { TraceSeqlockScenario(2, 3, 3); }},
   };

@@ -14,6 +14,9 @@
 #undef NDEBUG
 #include <cassert>
 #include <cstdio>
+#include <fstream>
+#include <iterator>
+#include <limits>
 #include <random>
 
 namespace {
@@ -642,6 +645,321 @@ void test_gemm_i16_pair_path_exact() {
               isa_level());
 }
 
+/* ------------------------------------------------------------------
+ * int4 weight-only path + persisted autotune (ISSUE 16)
+ * ------------------------------------------------------------------ */
+
+/* Decode the nibble panels back into a row-major [K,N] matrix — the
+ * exact values the q4 kernels are contracted to multiply by. Padding
+ * lanes (columns >= N inside the last panel) must reconstruct to
+ * exactly 0.0f so fringe columns never leak into real outputs. */
+std::vector<float> q4_unpack_ref(const std::vector<uint8_t>& q4,
+                                 const std::vector<float>& qs,
+                                 const std::vector<float>& qz, int64_t K,
+                                 int64_t N, int64_t G) {
+  const int64_t panels = (N + NR - 1) / NR, ng = q4_groups(K, G);
+  std::vector<float> W(size_t(K * N), 0.f);
+  for (int64_t p = 0; p < panels; ++p) {
+    const uint8_t* pan = q4.data() + p * K * (NR / 2);
+    const float* s = qs.data() + p * ng * NR;
+    const float* z = qz.data() + p * ng * NR;
+    for (int64_t k = 0; k < K; ++k) {
+      const int64_t g = k / G;
+      for (int64_t j = 0; j < NR; ++j) {
+        const uint8_t byte = pan[size_t(k * (NR / 2) + (j & 7))];
+        const int q = (j < 8) ? (byte & 0xF) : (byte >> 4);
+        const float v = s[g * NR + j] * float(q) + z[g * NR + j];
+        const int64_t col = p * NR + j;
+        if (col < N)
+          W[size_t(k * N + col)] = v;
+        else
+          assert(v == 0.f);
+      }
+    }
+  }
+  return W;
+}
+
+/* gemv_q4 / gemm_q4 against a double-precision reference over the
+ * DEQUANTIZED weights: the factored epilogue (s*sum(a*q) + z*sum(a))
+ * is algebraically identical, so only fp reassociation separates the
+ * two. Shapes cover K not a multiple of the group size, a fringe
+ * column panel, and K < G (single short group). */
+void test_q4_kernels_match_dequant_reference() {
+  std::mt19937 rng(29);
+  std::uniform_real_distribution<float> d(-1.f, 1.f);
+  const int64_t shapes[][3] = {  // {K, N, G}
+      {70, 16, 32}, {64, 21, 64}, {130, 33, 64}, {24, 16, 64}};
+  for (const auto& sh : shapes) {
+    const int64_t K = sh[0], N = sh[1], G = sh[2], M = 4;
+    std::vector<float> B(size_t(K * N)), A(size_t(M * K));
+    for (auto& v : B) v = d(rng);
+    for (auto& v : A) v = d(rng);
+    std::vector<uint8_t> q4(size_t(q4_data_size(K, N)));
+    std::vector<float> qs(size_t(q4_scale_size(K, N, G)), 0.f);
+    std::vector<float> qz(size_t(q4_scale_size(K, N, G)), 0.f);
+    assert(pack_b_q4(B.data(), K, N, G, q4.data(), qs.data(), qz.data()));
+    const std::vector<float> W = q4_unpack_ref(q4, qs, qz, K, N, G);
+    // quantization error bound: |W - B| <= scale/2 per element
+    for (int64_t k = 0; k < K; ++k)
+      for (int64_t j = 0; j < N; ++j) {
+        const int64_t p = j / NR, g = k / G, ng = q4_groups(K, G);
+        const float s = qs[size_t((p * ng + g) * NR + (j % NR))];
+        assert(std::fabs(W[size_t(k * N + j)] - B[size_t(k * N + j)]) <=
+               0.5f * s + 1e-6f);
+      }
+    std::vector<float> bias(size_t(N), 0.f);
+    for (auto& v : bias) v = d(rng);
+    std::vector<float> C(size_t(M * N), -99.f);
+    gemm_q4(A.data(), q4.data(), qs.data(), qz.data(), C.data(), M, N, K,
+            G, bias.data(), ACT_RELU, nullptr);
+    std::vector<float> C1(size_t(N), -99.f);
+    gemv_q4(A.data(), q4.data(), qs.data(), qz.data(), C1.data(), N, K, G,
+            bias.data(), 0.f, ACT_RELU);
+    for (int64_t m = 0; m < M; ++m)
+      for (int64_t j = 0; j < N; ++j) {
+        double acc = bias[size_t(j)];
+        for (int64_t k = 0; k < K; ++k)
+          acc += double(A[size_t(m * K + k)]) * double(W[size_t(k * N + j)]);
+        const float want = float(acc > 0 ? acc : 0);
+        assert(std::fabs(C[size_t(m * N + j)] - want) <= 1e-3f);
+        if (m == 0) assert(std::fabs(C1[size_t(j)] - want) <= 1e-3f);
+      }
+  }
+  std::printf("  q4 kernels vs dequant reference (isa=%d)\n", isa_level());
+}
+
+/* All-equal weight group: max == min gives scale 0 and the guard must
+ * reconstruct the constant exactly (q=0, zp carries the value). */
+void test_q4_all_equal_group_exact() {
+  const int64_t K = 96, N = 20, G = 32;
+  std::vector<float> B(size_t(K * N), 0.37f);
+  std::vector<uint8_t> q4(size_t(q4_data_size(K, N)));
+  std::vector<float> qs(size_t(q4_scale_size(K, N, G)), -1.f);
+  std::vector<float> qz(size_t(q4_scale_size(K, N, G)), -1.f);
+  assert(pack_b_q4(B.data(), K, N, G, q4.data(), qs.data(), qz.data()));
+  const std::vector<float> W = q4_unpack_ref(q4, qs, qz, K, N, G);
+  for (int64_t k = 0; k < K; ++k)
+    for (int64_t j = 0; j < N; ++j)
+      assert(W[size_t(k * N + j)] == 0.37f);  // EXACT, not approximate
+}
+
+/* Zero-extent q4 GEMM keeps the r11 empty-sum contract: K == 0 still
+ * writes bias+act over the whole output (the arena planner skips
+ * zero-fill on that promise); M == 0 / N == 0 are no-ops. Non-finite
+ * weights must refuse to quantize (fp32 fallback at the call site). */
+void test_q4_zero_extent_and_nonfinite() {
+  const int64_t M = 5, N = 18, G = 64;
+  std::vector<float> bias(size_t(N), 0.f);
+  for (int64_t j = 0; j < N; ++j) bias[size_t(j)] = float(j) - 7.f;
+  std::vector<float> C(size_t(M * N), -123.f);
+  gemm_q4(nullptr, nullptr, nullptr, nullptr, C.data(), M, N, 0, G,
+          bias.data(), ACT_RELU, nullptr);
+  for (int64_t m = 0; m < M; ++m)
+    for (int64_t j = 0; j < N; ++j)
+      assert(C[size_t(m * N + j)] == std::max(0.f, bias[size_t(j)]));
+  std::fill(C.begin(), C.end(), -123.f);
+  gemm_q4(nullptr, nullptr, nullptr, nullptr, C.data(), 0, N, 8, G,
+          nullptr, ACT_NONE, nullptr);
+  gemm_q4(nullptr, nullptr, nullptr, nullptr, C.data(), M, 0, 8, G,
+          nullptr, ACT_NONE, nullptr);
+  for (float v : C) assert(v == -123.f);  // zero-extent never writes
+  std::vector<float> B(size_t(16 * 16), 1.f);
+  B[37] = std::numeric_limits<float>::quiet_NaN();
+  std::vector<uint8_t> q4(size_t(q4_data_size(16, 16)));
+  std::vector<float> qs(size_t(q4_scale_size(16, 16, G)), 0.f);
+  std::vector<float> qz(size_t(q4_scale_size(16, 16, G)), 0.f);
+  assert(!pack_b_q4(B.data(), 16, 16, G, q4.data(), qs.data(), qz.data()));
+  B[37] = std::numeric_limits<float>::infinity();
+  assert(!pack_b_q4(B.data(), 16, 16, G, q4.data(), qs.data(), qz.data()));
+}
+
+/* Quantization is a pure function of (B, K, N, G): two packs of the
+ * same weights must be byte-identical — the artifact→load round trip
+ * may not drift between processes or runs. */
+void test_q4_pack_deterministic() {
+  std::mt19937 rng(31);
+  std::uniform_real_distribution<float> d(-2.f, 2.f);
+  const int64_t K = 100, N = 40, G = 32;
+  std::vector<float> B(size_t(K * N));
+  for (auto& v : B) v = d(rng);
+  std::vector<uint8_t> qa(size_t(q4_data_size(K, N)));
+  std::vector<uint8_t> qb(size_t(q4_data_size(K, N)), 0xEE);
+  std::vector<float> sa(size_t(q4_scale_size(K, N, G)), 0.f), sb = sa;
+  std::vector<float> za = sa, zb = sa;
+  assert(pack_b_q4(B.data(), K, N, G, qa.data(), sa.data(), za.data()));
+  assert(pack_b_q4(B.data(), K, N, G, qb.data(), sb.data(), zb.data()));
+  assert(qa == qb && sa == sb && za == zb);
+}
+
+/* Tune cache wire format: round trip, then every corruption class the
+ * fuzz target covers must come back kMalformed (whole-file distrust —
+ * a bad record rejects everything) and wrong machine kWrongCpu. */
+void test_tune_cache_parse() {
+  namespace tn = ptpu::tune;
+  std::vector<std::pair<tn::TuneKey, tn::TuneConfig>> in, out;
+  tn::TuneKey k1;
+  k1.m = 4;
+  k1.n = 512;
+  k1.k = 128;
+  k1.dtype = tn::kDtF32;
+  tn::TuneConfig c1;
+  c1.path = tn::kPathAlt;
+  c1.kc = 160;
+  c1.mult = 2;
+  tn::TuneKey k2;
+  k2.m = 0;
+  k2.n = 64;
+  k2.k = 96;
+  k2.dtype = tn::kDtQ4Pack;
+  tn::TuneConfig c2;
+  c2.group = 32;
+  in.push_back({k1, c1});
+  in.push_back({k2, c2});
+  const uint64_t sig = tn::CpuSig();
+  std::vector<uint8_t> bytes;
+  tn::SerializeCache(in, sig, &bytes);
+  assert(bytes.size() ==
+         tn::kTuneHeaderBytes + in.size() * tn::kTuneRecordBytes);
+  assert(tn::ParseCacheBytes(bytes.data(), bytes.size(), sig, &out) ==
+         tn::ParseResult::kOk);
+  assert(out.size() == 2 && out[0].first.n == 512 &&
+         out[0].second.path == tn::kPathAlt && out[1].second.group == 32);
+  // wrong machine: recognizable file, different cpu signature
+  assert(tn::ParseCacheBytes(bytes.data(), bytes.size(), sig ^ 0x5a5a,
+                             &out) == tn::ParseResult::kWrongCpu);
+  // truncated / padded: the size must match the header's count exactly
+  assert(tn::ParseCacheBytes(bytes.data(), bytes.size() - 1, sig, &out) ==
+         tn::ParseResult::kMalformed);
+  std::vector<uint8_t> padded = bytes;
+  padded.push_back(0);
+  assert(tn::ParseCacheBytes(padded.data(), padded.size(), sig, &out) ==
+         tn::ParseResult::kMalformed);
+  assert(tn::ParseCacheBytes(bytes.data(), 3, sig, &out) == tn::ParseResult::kMalformed);
+  assert(tn::ParseCacheBytes(bytes.data(), 0, sig, &out) == tn::ParseResult::kMalformed);
+  // bad magic / bad version
+  std::vector<uint8_t> bad = bytes;
+  bad[0] ^= 0xFF;
+  assert(tn::ParseCacheBytes(bad.data(), bad.size(), sig, &out) ==
+         tn::ParseResult::kMalformed);
+  bad = bytes;
+  bad[4] = 99;
+  assert(tn::ParseCacheBytes(bad.data(), bad.size(), sig, &out) ==
+         tn::ParseResult::kMalformed);
+  // huge count with a body that can't hold it
+  bad = bytes;
+  bad[16] = 0xFF;
+  bad[17] = 0xFF;
+  bad[18] = 0xFF;
+  bad[19] = 0x7F;
+  assert(tn::ParseCacheBytes(bad.data(), bad.size(), sig, &out) ==
+         tn::ParseResult::kMalformed);
+  // one out-of-range record poisons the whole file (group > 4096 at
+  // record 1: offset header + record + {24 dims, 4 dtype, 12 cfg})
+  bad = bytes;
+  bad[tn::kTuneHeaderBytes + tn::kTuneRecordBytes + 41] = 0xFF;
+  assert(tn::ParseCacheBytes(bad.data(), bad.size(), sig, &out) ==
+         tn::ParseResult::kMalformed);
+  // empty cache is valid
+  tn::SerializeCache({}, sig, &bytes);
+  assert(tn::ParseCacheBytes(bytes.data(), bytes.size(), sig, &out) ==
+             tn::ParseResult::kOk &&
+         out.empty());
+}
+
+/* Registry semantics: first-insert-wins, invalid configs rejected,
+ * save→clear→load round trip through a real file, corrupt file and
+ * missing file adopt nothing (silent re-probe contract). */
+void test_tune_registry_persist() {
+  namespace tn = ptpu::tune;
+  auto& R = tn::Registry::Inst();
+  R.Clear();
+  tn::TuneKey key;
+  key.m = 6;
+  key.n = 256;
+  key.k = 64;
+  key.dtype = tn::kDtF32;
+  tn::TuneConfig cfg;
+  cfg.kc = 640;
+  cfg.mult = 4;
+  R.Insert(key, cfg);
+  tn::TuneConfig later;
+  later.kc = 160;
+  R.Insert(key, later);  // loser: first probe result stays
+  tn::TuneConfig got;
+  assert(R.Lookup(key, &got) && got.kc == 640 && got.mult == 4);
+  tn::TuneKey bad_key = key;
+  bad_key.n = 999;
+  tn::TuneConfig bad_cfg;
+  bad_cfg.group = 99999;  // out of range: must be dropped
+  R.Insert(bad_key, bad_cfg);
+  assert(!R.Lookup(bad_key, &got));
+  const std::string path = "/tmp/ptpu_selftest_tune.cache";
+  assert(R.SaveIfDirty(path) == 1);
+  R.Clear();
+  assert(!R.Lookup(key, &got));
+  assert(R.LoadFile(path) == 1);
+  assert(R.Lookup(key, &got) && got.kc == 640);
+  // corrupt the file on disk: load adopts nothing, never crashes
+  {
+    std::ifstream f(path, std::ios::binary);
+    std::vector<char> buf((std::istreambuf_iterator<char>(f)),
+                          std::istreambuf_iterator<char>());
+    buf[8] ^= 0x1;  // cpu signature byte
+    std::ofstream o(path, std::ios::binary | std::ios::trunc);
+    o.write(buf.data(), std::streamsize(buf.size()));
+  }
+  R.Clear();
+  assert(R.LoadFile(path) == 0 && R.Entries() == 0);
+  ::unlink(path.c_str());
+  R.Clear();
+  assert(R.LoadFile(path) == 0);  // missing file: clean start
+  assert(!R.StatsJson().empty() && R.StatsJson()[0] == '{');
+  R.Clear();
+}
+
+/* Tune configs on the fp32 macro kernel: kc/mult re-block the same
+ * k-ascending accumulation, so outputs are bitwise-equal to the
+ * default; the kPathAlt row-GEMV keeps the order but may contract
+ * differently, so it gets a tolerance. probe_gemm_cfg must try every
+ * candidate and return a valid config. */
+void test_tune_cfg_paths_consistent() {
+  namespace tn = ptpu::tune;
+  std::mt19937 rng(37);
+  std::uniform_real_distribution<float> d(-1.f, 1.f);
+  const int64_t M = 4, N = 48, K = 700;  // K spans multiple kc blocks
+  std::vector<float> A(size_t(M * K)), B(size_t(K * N));
+  for (auto& v : A) v = d(rng);
+  for (auto& v : B) v = d(rng);
+  std::vector<float> Bp(size_t((N + NR - 1) / NR * K * NR));
+  pack_b<float>(B.data(), K, N, Bp.data());
+  std::vector<float> ref(size_t(M * N)), C(size_t(M * N));
+  gemm_bias_act<float>(A.data(), B.data(), ref.data(), M, N, K, nullptr,
+                       Bp.data(), nullptr, nullptr, ACT_NONE);
+  tn::TuneConfig kc_cfg;
+  kc_cfg.kc = 160;
+  kc_cfg.mult = 2;
+  gemm_bias_act<float>(A.data(), B.data(), C.data(), M, N, K, nullptr,
+                       Bp.data(), nullptr, nullptr, ACT_NONE, &kc_cfg);
+  for (size_t i = 0; i < C.size(); ++i) assert(C[i] == ref[i]);  // bitwise
+  tn::TuneConfig alt;
+  alt.path = tn::kPathAlt;
+  gemm_bias_act<float>(A.data(), B.data(), C.data(), M, N, K, nullptr,
+                       Bp.data(), nullptr, nullptr, ACT_NONE, &alt);
+  for (size_t i = 0; i < C.size(); ++i)
+    assert(std::fabs(C[i] - ref[i]) <= 1e-4f * float(K));
+  int runs = 0;
+  const auto cfg = probe_gemm_cfg(M, [&](const tn::TuneConfig* c) {
+    ++runs;
+    gemm_bias_act<float>(A.data(), B.data(), C.data(), M, N, K, nullptr,
+                         Bp.data(), nullptr, nullptr, ACT_NONE, c);
+  });
+  assert(tn::config_valid(tn::kDtF32, cfg));
+  assert(runs >= 2 * 2);  // >= (default + alt) x 2 reps even on 1 core
+  for (size_t i = 0; i < C.size(); ++i)
+    assert(std::fabs(C[i] - ref[i]) <= 1e-4f * float(K));
+}
+
 }  // namespace
 
 int main() {
@@ -662,6 +980,13 @@ int main() {
   test_layernorm_fusion_parity();
   test_gelu_fusion_bitwise();
   test_gemm_i16_pair_path_exact();
+  test_q4_kernels_match_dequant_reference();
+  test_q4_all_equal_group_exact();
+  test_q4_zero_extent_and_nonfinite();
+  test_q4_pack_deterministic();
+  test_tune_cache_parse();
+  test_tune_registry_persist();
+  test_tune_cfg_paths_consistent();
   std::printf("ptpu_selftest: all native unit tests passed\n");
   return 0;
 }

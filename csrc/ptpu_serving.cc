@@ -74,6 +74,7 @@
 #include "ptpu_stats.h"
 #include "ptpu_sync.h"
 #include "ptpu_trace.h"
+#include "ptpu_tune.h"
 #include "ptpu_wire.h"
 
 namespace {
@@ -716,6 +717,13 @@ struct SvServer {
     }
     ladder = ok_ladder;
     max_batch = ladder.back();
+
+    // the bucket probes above executed every (bucket, shape) GEMM, so
+    // the per-machine autotuner has probed every shape this ladder
+    // can serve — persist the winners once, at start-up (the second
+    // start of the same ladder then loads them and probes nothing)
+    if (ptpu::tune::Registry::Enabled())
+      ptpu::tune::Registry::Inst().SaveIfDirty();
 
     for (auto& inst : insts) inst->stage.resize(sig.size());
 
@@ -1389,8 +1397,14 @@ struct SvServer {
       }
       row_off += r.rows;
       const size_t sent = f.size();
+      // count BEFORE the send: SendPayload hands the frame to the
+      // event loop, so a client can read the reply and query stats
+      // in-process before this worker resumes — the counter must
+      // already cover every reply a client has seen. A dead-conn
+      // send failure overcounts by one, but that client observes
+      // nothing, so the exactness contract (stats selftests) holds.
+      stats.replies.Add(1);
       if (r.conn->SendPayload(std::move(f), r.trace_id, r.id)) {
-        stats.replies.Add(1);
         stats.bytes_out.Add(sent);
         const int64_t t_rep = ptpu::NowUs();
         stats.e2e_us.Observe(uint64_t(t_rep - r.t_enq_us));
@@ -1957,8 +1971,10 @@ struct SvServer {
     std::memcpy(f.data() + ho + 20, lg + row * dec_logit_elems,
                 size_t(dec_logit_elems) * 4);
     const size_t sent = f.size();
+    // pre-send bump, same observable-ordering contract as the infer
+    // reply path: a client holding the reply frame must see it counted
+    dstats.replies.Add(1);
     if (r->conn->SendPayload(std::move(f), r->trace_id, r->session)) {
-      dstats.replies.Add(1);
       stats.bytes_out.Add(sent);
       const int64_t t_rep = ptpu::NowUs();
       stats.e2e_us.Observe(uint64_t(t_rep - r->t_enq_us));

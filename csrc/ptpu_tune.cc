@@ -1,0 +1,49 @@
+// extern "C" ABI surface over the persisted kernel-autotuning
+// registry (ptpu_tune.h). Process-global per .so, same model as the
+// trace ring: the registry itself is header-only so the single-TU
+// selftests and fuzz harnesses share one definition with the
+// predictor; only these exports need a dedicated TU in the
+// _native_predictor.so link.
+#include "ptpu_tune.h"
+
+extern "C" {
+
+/* Autotuner counters as JSON: {"enabled","entries","hits","misses",
+ * "probes","probe_us","file_loads","file_entries","file_rejects",
+ * "wrong_cpu","saves","save_errors"}. Thread-local buffer, valid
+ * until the calling thread's next call. */
+__attribute__((visibility("default")))
+const char* ptpu_tune_stats_json(void) {
+  thread_local std::string buf;
+  buf = ptpu::tune::Registry::Inst().StatsJson();
+  return buf.c_str();
+}
+
+/* Persist the current winners to `path` (NULL/empty = the
+ * PTPU_TUNE_CACHE default). Returns entries written, -1 on I/O
+ * error. Forces a write even when nothing is dirty so bindings can
+ * snapshot. */
+__attribute__((visibility("default")))
+int ptpu_tune_save(const char* path) {
+  const std::string p = (path != nullptr && path[0] != '\0')
+                            ? std::string(path)
+                            : ptpu::tune::Registry::DefaultPath();
+  return ptpu::tune::Registry::Inst().SaveIfDirty(p);
+}
+
+/* Merge-load a tuning cache from `path` (NULL/empty = the default).
+ * Returns entries adopted; corrupt or wrong-machine files adopt 0
+ * and never error — the contract is silent re-probe. */
+__attribute__((visibility("default")))
+int ptpu_tune_load(const char* path) {
+  const std::string p =
+      (path != nullptr && path[0] != '\0') ? std::string(path) : std::string();
+  return ptpu::tune::Registry::Inst().LoadFile(p);
+}
+
+/* Drop every in-memory entry and counter (the cache FILE is left
+ * untouched). Tests use this to force re-probe in one process. */
+__attribute__((visibility("default")))
+void ptpu_tune_clear(void) { ptpu::tune::Registry::Inst().Clear(); }
+
+}  // extern "C"

@@ -1,0 +1,452 @@
+// Persisted per-machine kernel autotuning (ISSUE 16 tentpole b).
+//
+// The predictor's GEMM kernels carry compile-time defaults (KC depth,
+// tasks-per-thread, one execution path per shape class). On a cache
+// miss for a (M, N, K, dtype) shape the executor probes a small
+// candidate grid ON THE REAL PACKED OPERANDS — the load-time dry run
+// and the serving bucket ladder's start-up probe are the natural
+// hosts, so probing happens at load, never on steady-state traffic —
+// and records the winner here. Winners persist in a tuning-cache
+// file keyed by a cpu signature, so subsequent loads of any artifact
+// skip the probe entirely (the bench gates second-load probe cost
+// ~0).
+//
+// The cache file is UNTRUSTED DISK INPUT (same rule as wire frames
+// and artifacts, ISSUE 11): the parser is bounds-checked end to end,
+// fuzzed (csrc/fuzz/fuzz_tune.cc), and every malformed shape —
+// truncation, huge counts, overflowing sizes, alien magic — degrades
+// to "no entries adopted, re-probe silently". A wrong or stale cache
+// can only cost a probe, never correctness: configs steer kernel
+// blocking/path choice, and every candidate computes the same
+// k-ascending accumulation (fp32 results are identical across
+// configs; int4 path choice may differ in final-rounding order and
+// is covered by the int4 quality bound, README "Quantization &
+// autotuning").
+//
+// Everything is inline so the single-TU selftests and fuzz harnesses
+// (#include "ptpu_predictor.cc" style) see one definition; the
+// extern "C" ABI surface lives in ptpu_tune.cc.
+#ifndef PTPU_TUNE_H_
+#define PTPU_TUNE_H_
+
+#include <stdio.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "ptpu_sync.h"
+#include "ptpu_wire.h"
+
+namespace ptpu {
+namespace tune {
+
+// ---------------------------------------------------------------------------
+// keys + configs
+// ---------------------------------------------------------------------------
+
+// dtype discriminator of a tuning record. kDtQ4Pack records the
+// chosen int4 group size per weight shape (m is 0 there: packing is
+// shape-of-B only); the others key kernel configs per GEMM shape.
+enum : uint32_t { kDtF32 = 0, kDtQ4 = 1, kDtQ4Pack = 2, kDtMax = 2 };
+
+// exec-path discriminator. Meaning depends on dtype:
+//   f32  M>1 : 0 = packed macro-kernel, 1 = per-row GEMV over the
+//              pre-packed panels (exact-MAC path for the small decode
+//              buckets the MR=6 tile would pad 3x)
+//   q4   M=1 : 0 = dequant-in-register GEMV, 1 = dequant panel to L1
+//              scratch then fp32 GEMV
+//   q4   M>1 : 0 = dequant-to-scratch macro-kernel, 1 = per-row
+//              dequant-in-register GEMV
+enum : int32_t { kPathDefault = 0, kPathAlt = 1, kPathMax = 1 };
+
+struct TuneKey {
+  int64_t m = 0, n = 0, k = 0;
+  uint32_t dtype = kDtF32;
+  bool operator<(const TuneKey& o) const {
+    if (m != o.m) return m < o.m;
+    if (n != o.n) return n < o.n;
+    if (k != o.k) return k < o.k;
+    return dtype < o.dtype;
+  }
+};
+
+// 0 == "use the compile-time default" for every knob.
+struct TuneConfig {
+  int32_t path = 0;   // execution path (see above)
+  int32_t kc = 0;     // K blocking depth (gemm_compute KC)
+  int32_t mult = 0;   // tasks-per-thread multiplier (gemm_compute)
+  int32_t group = 0;  // int4 group size along K (kDtQ4Pack records)
+  bool operator==(const TuneConfig& o) const {
+    return path == o.path && kc == o.kc && mult == o.mult &&
+           group == o.group;
+  }
+};
+
+// validity bounds for UNTRUSTED records — anything outside is a
+// corrupt cache, not a probe result this code could have written
+inline bool config_valid(uint32_t dtype, const TuneConfig& c) {
+  if (dtype > kDtMax) return false;
+  if (c.path < 0 || c.path > kPathMax) return false;
+  if (c.kc < 0 || c.kc > (1 << 20)) return false;
+  if (c.mult < 0 || c.mult > 64) return false;
+  if (c.group < 0 || c.group > 4096) return false;
+  return true;
+}
+inline bool key_valid(const TuneKey& k) {
+  const int64_t lim = int64_t(1) << 40;
+  return k.m >= 0 && k.m < lim && k.n >= 0 && k.n < lim && k.k >= 0 &&
+         k.k < lim && k.dtype <= kDtMax;
+}
+
+// ---------------------------------------------------------------------------
+// cpu signature + clock
+// ---------------------------------------------------------------------------
+
+// Per-machine key: ISA feature bits + core count. A cache written on
+// an AVX-512 24-core box silently re-probes on an AVX2 1-core box —
+// wrong-machine winners are worse than defaults.
+inline uint64_t CpuSig() {
+  static const uint64_t sig = [] {
+    uint64_t s = 0x70747531ull;  // "ptu1" version salt
+#if defined(__x86_64__) || defined(__i386__)
+    if (__builtin_cpu_supports("avx2")) s |= 1u << 8;
+    if (__builtin_cpu_supports("fma")) s |= 1u << 9;
+    if (__builtin_cpu_supports("avx512f")) s |= 1u << 10;
+    if (__builtin_cpu_supports("avx512bw")) s |= 1u << 11;
+    if (__builtin_cpu_supports("avx512vnni")) s |= 1u << 12;
+#endif
+    const unsigned hc = std::thread::hardware_concurrency();
+    s |= uint64_t(hc & 0xffff) << 16;
+    // splitmix64 finalizer: spread the bits so the sig doubles as a
+    // sanity token against files of the right length but alien bytes
+    s += 0x9e3779b97f4a7c15ull;
+    s = (s ^ (s >> 30)) * 0xbf58476d1ce4e5b9ull;
+    s = (s ^ (s >> 27)) * 0x94d049bb133111ebull;
+    return s ^ (s >> 31);
+  }();
+  return sig;
+}
+
+inline uint64_t NowUs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return uint64_t(ts.tv_sec) * 1000000ull + uint64_t(ts.tv_nsec) / 1000;
+}
+
+// ---------------------------------------------------------------------------
+// cache file format "ptpu-tune-cache v1"
+// ---------------------------------------------------------------------------
+//
+//   [0]  u32  magic  "PTUN" (LE 0x4e555450)
+//   [4]  u32  version (1)
+//   [8]  u64  cpu_sig (CpuSig() of the writing machine)
+//   [16] u32  count  (<= kTuneMaxEntries)
+//   [20] count x 44-byte records:
+//        i64 m, i64 n, i64 k, u32 dtype,
+//        i32 path, i32 kc, i32 mult, i32 group
+//
+// The byte length must equal 20 + 44*count EXACTLY — no trailing
+// garbage, no short reads. All fields little-endian via the
+// unaligned-safe ptpu_wire.h codecs.
+
+constexpr uint32_t kTuneMagic = 0x4e555450u;  // "PTUN"
+constexpr uint32_t kTuneVersion = 1;
+constexpr uint32_t kTuneMaxEntries = 4096;
+constexpr size_t kTuneHeaderBytes = 20;
+constexpr size_t kTuneRecordBytes = 44;
+
+enum class ParseResult {
+  kOk = 0,        // well-formed, entries returned
+  kMalformed,     // corrupt bytes: adopt nothing, re-probe silently
+  kWrongCpu,      // well-formed but another machine's winners
+};
+
+/* Bounds-checked parser over UNTRUSTED bytes. Never throws, never
+ * reads past `size`, never adopts a record whose fields fall outside
+ * the ranges a probe can produce. Fuzz target: csrc/fuzz/fuzz_tune.cc
+ * (corpus csrc/fuzz/corpus/tune). */
+inline ParseResult ParseCacheBytes(
+    const uint8_t* data, size_t size, uint64_t expect_sig,
+    std::vector<std::pair<TuneKey, TuneConfig>>* out) {
+  out->clear();
+  if (data == nullptr || size < kTuneHeaderBytes)
+    return ParseResult::kMalformed;
+  if (GetU32(data) != kTuneMagic) return ParseResult::kMalformed;
+  if (GetU32(data + 4) != kTuneVersion) return ParseResult::kMalformed;
+  const uint64_t sig = GetU64(data + 8);
+  const uint32_t count = GetU32(data + 16);
+  if (count > kTuneMaxEntries) return ParseResult::kMalformed;
+  // exact-size check BEFORE any record read: count is attacker data,
+  // and kTuneRecordBytes * count cannot overflow (count <= 4096)
+  if (size != kTuneHeaderBytes + size_t(count) * kTuneRecordBytes)
+    return ParseResult::kMalformed;
+  std::vector<std::pair<TuneKey, TuneConfig>> parsed;
+  parsed.reserve(count);
+  for (uint32_t i = 0; i < count; ++i) {
+    const uint8_t* r = data + kTuneHeaderBytes + size_t(i) * kTuneRecordBytes;
+    TuneKey key;
+    key.m = GetI64(r);
+    key.n = GetI64(r + 8);
+    key.k = GetI64(r + 16);
+    key.dtype = GetU32(r + 24);
+    TuneConfig cfg;
+    cfg.path = int32_t(GetU32(r + 28));
+    cfg.kc = int32_t(GetU32(r + 32));
+    cfg.mult = int32_t(GetU32(r + 36));
+    cfg.group = int32_t(GetU32(r + 40));
+    if (!key_valid(key) || !config_valid(key.dtype, cfg))
+      return ParseResult::kMalformed;  // whole file distrusted
+    parsed.emplace_back(key, cfg);
+  }
+  if (sig != expect_sig) return ParseResult::kWrongCpu;
+  out->swap(parsed);
+  return ParseResult::kOk;
+}
+
+inline void SerializeCache(
+    const std::vector<std::pair<TuneKey, TuneConfig>>& entries,
+    uint64_t sig, std::vector<uint8_t>* out) {
+  const size_t n =
+      entries.size() > kTuneMaxEntries ? kTuneMaxEntries : entries.size();
+  out->assign(kTuneHeaderBytes + n * kTuneRecordBytes, 0);
+  uint8_t* p = out->data();
+  PutU32(p, kTuneMagic);
+  PutU32(p + 4, kTuneVersion);
+  PutU64(p + 8, sig);
+  PutU32(p + 16, uint32_t(n));
+  for (size_t i = 0; i < n; ++i) {
+    uint8_t* r = p + kTuneHeaderBytes + i * kTuneRecordBytes;
+    PutI64(r, entries[i].first.m);
+    PutI64(r + 8, entries[i].first.n);
+    PutI64(r + 16, entries[i].first.k);
+    PutU32(r + 24, entries[i].first.dtype);
+    PutU32(r + 28, uint32_t(entries[i].second.path));
+    PutU32(r + 32, uint32_t(entries[i].second.kc));
+    PutU32(r + 36, uint32_t(entries[i].second.mult));
+    PutU32(r + 40, uint32_t(entries[i].second.group));
+  }
+}
+
+// ---------------------------------------------------------------------------
+// process-global registry
+// ---------------------------------------------------------------------------
+
+// Rank 55: looked up (and inserted) while the serving decode plane
+// holds sv.kv (10) / sv.sess (20), and NEVER held across a kernel
+// run — probes release it, so it also never wraps wp.dispatch (60).
+PTPU_LOCK_CLASS(kLockTuneCache, "tune.cache", 55);
+
+struct TuneStats {
+  uint64_t hits = 0, misses = 0, probes = 0, probe_us = 0;
+  uint64_t file_loads = 0, file_entries = 0, file_rejects = 0;
+  uint64_t wrong_cpu = 0, saves = 0, save_errors = 0;
+};
+
+class Registry {
+ public:
+  // PTPU_TUNE=1 opts the process into probing + persistence. Cached
+  // once (the repo's PTPU_ISA idiom): flipping it requires a fresh
+  // process, which every test/bench that A/Bs it already uses.
+  static bool Enabled() {
+    static const bool on = [] {
+      const char* e = std::getenv("PTPU_TUNE");
+      return e != nullptr && std::strcmp(e, "1") == 0;
+    }();
+    return on;
+  }
+
+  static std::string DefaultPath() {
+    const char* e = std::getenv("PTPU_TUNE_CACHE");
+    if (e != nullptr && e[0] != '\0') return e;
+    return ".ptpu_tune.cache";
+  }
+
+  /* Cache lookup; loads the cache file lazily on the first call so
+   * "second load skips the probe" needs no explicit wiring in any
+   * binding. Returns true on hit. */
+  bool Lookup(const TuneKey& key, TuneConfig* cfg) {
+    ptpu::MutexLock g(mu_);
+    load_locked();
+    auto it = map_.find(key);
+    if (it == map_.end()) {
+      ++stats_.misses;
+      return false;
+    }
+    ++stats_.hits;
+    *cfg = it->second;
+    return true;
+  }
+
+  /* Record a probe winner (idempotent: first insert wins so every
+   * instance in a process agrees on one config per shape). */
+  void Insert(const TuneKey& key, const TuneConfig& cfg) {
+    if (!key_valid(key) || !config_valid(key.dtype, cfg)) return;
+    ptpu::MutexLock g(mu_);
+    load_locked();
+    if (map_.size() >= kTuneMaxEntries) return;
+    if (map_.emplace(key, cfg).second) dirty_ = true;
+  }
+
+  void NoteProbe(uint64_t us) {
+    ptpu::MutexLock g(mu_);
+    ++stats_.probes;
+    stats_.probe_us += us;
+  }
+
+  /* Persist the current entries when anything new was probed.
+   * Serialize under the lock, write + rename outside it (file I/O
+   * must not block lookups). Returns entries written, -1 on error,
+   * 0 when clean. */
+  int SaveIfDirty(const std::string& explicit_path = std::string()) {
+    std::vector<uint8_t> bytes;
+    std::string path = explicit_path;
+    {
+      ptpu::MutexLock g(mu_);
+      if (!dirty_ && explicit_path.empty()) return 0;
+      std::vector<std::pair<TuneKey, TuneConfig>> entries(map_.begin(),
+                                                          map_.end());
+      SerializeCache(entries, CpuSig(), &bytes);
+      if (path.empty()) path = path_locked();
+      dirty_ = false;
+    }
+    const std::string tmp = path + ".tmp." + std::to_string(::getpid());
+    FILE* f = std::fopen(tmp.c_str(), "wb");
+    bool ok = f != nullptr;
+    if (ok) {
+      ok = std::fwrite(bytes.data(), 1, bytes.size(), f) == bytes.size();
+      ok = (std::fclose(f) == 0) && ok;
+    }
+    if (ok) ok = ::rename(tmp.c_str(), path.c_str()) == 0;
+    if (!ok) ::unlink(tmp.c_str());
+    ptpu::MutexLock g(mu_);
+    if (ok) {
+      ++stats_.saves;
+      return int((bytes.size() - kTuneHeaderBytes) / kTuneRecordBytes);
+    }
+    ++stats_.save_errors;
+    dirty_ = true;  // retry on the next save point
+    return -1;
+  }
+
+  /* Merge-load a cache file (missing file is not an error — first
+   * run). Corrupt or wrong-machine files adopt nothing and only
+   * bump a counter: the contract is silent re-probe, never a crash
+   * and never a refusal to serve. Returns entries adopted. */
+  int LoadFile(const std::string& explicit_path = std::string()) {
+    ptpu::MutexLock g(mu_);
+    loaded_ = true;  // explicit load supersedes the lazy one
+    return load_path_locked(explicit_path.empty() ? path_locked()
+                                                  : explicit_path);
+  }
+
+  void Clear() {
+    ptpu::MutexLock g(mu_);
+    map_.clear();
+    stats_ = TuneStats();
+    dirty_ = false;
+    loaded_ = false;
+  }
+
+  size_t Entries() {
+    ptpu::MutexLock g(mu_);
+    return map_.size();
+  }
+
+  std::string StatsJson() {
+    ptpu::MutexLock g(mu_);
+    char buf[512];
+    std::snprintf(
+        buf, sizeof(buf),
+        "{\"enabled\":%d,\"entries\":%zu,\"hits\":%llu,"
+        "\"misses\":%llu,\"probes\":%llu,\"probe_us\":%llu,"
+        "\"file_loads\":%llu,\"file_entries\":%llu,"
+        "\"file_rejects\":%llu,\"wrong_cpu\":%llu,\"saves\":%llu,"
+        "\"save_errors\":%llu}",
+        Enabled() ? 1 : 0, map_.size(),
+        (unsigned long long)stats_.hits,
+        (unsigned long long)stats_.misses,
+        (unsigned long long)stats_.probes,
+        (unsigned long long)stats_.probe_us,
+        (unsigned long long)stats_.file_loads,
+        (unsigned long long)stats_.file_entries,
+        (unsigned long long)stats_.file_rejects,
+        (unsigned long long)stats_.wrong_cpu,
+        (unsigned long long)stats_.saves,
+        (unsigned long long)stats_.save_errors);
+    return buf;
+  }
+
+  static Registry& Inst() {
+    static Registry r;
+    return r;
+  }
+
+ private:
+  std::string path_locked() {
+    if (path_.empty()) path_ = DefaultPath();
+    return path_;
+  }
+
+  void load_locked() {
+    if (loaded_) return;
+    loaded_ = true;
+    load_path_locked(path_locked());
+  }
+
+  int load_path_locked(const std::string& path) {
+    ++stats_.file_loads;
+    FILE* f = std::fopen(path.c_str(), "rb");
+    if (f == nullptr) return 0;  // first run: nothing to adopt
+    std::vector<uint8_t> bytes;
+    uint8_t chunk[4096];
+    size_t got;
+    // hard read cap just past the largest legal file: a 10GB file at
+    // the cache path must not balloon this process
+    const size_t cap = kTuneHeaderBytes +
+                       size_t(kTuneMaxEntries) * kTuneRecordBytes + 1;
+    while ((got = std::fread(chunk, 1, sizeof(chunk), f)) > 0) {
+      bytes.insert(bytes.end(), chunk, chunk + got);
+      if (bytes.size() > cap) break;
+    }
+    std::fclose(f);
+    std::vector<std::pair<TuneKey, TuneConfig>> entries;
+    const ParseResult pr =
+        bytes.size() > cap
+            ? ParseResult::kMalformed
+            : ParseCacheBytes(bytes.data(), bytes.size(), CpuSig(),
+                              &entries);
+    if (pr == ParseResult::kMalformed) {
+      ++stats_.file_rejects;
+      return 0;
+    }
+    if (pr == ParseResult::kWrongCpu) {
+      ++stats_.wrong_cpu;
+      return 0;
+    }
+    int adopted = 0;
+    for (const auto& e : entries)
+      if (map_.size() < kTuneMaxEntries && map_.emplace(e).second)
+        ++adopted;
+    stats_.file_entries += uint64_t(adopted);
+    return adopted;
+  }
+
+  ptpu::Mutex mu_{kLockTuneCache};
+  std::map<TuneKey, TuneConfig> map_;
+  TuneStats stats_;
+  std::string path_;
+  bool dirty_ = false;
+  bool loaded_ = false;
+};
+
+}  // namespace tune
+}  // namespace ptpu
+
+#endif  // PTPU_TUNE_H_

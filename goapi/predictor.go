@@ -552,3 +552,34 @@ func SetProfiler(recordFn, enabledFn unsafe.Pointer) {
 	C.ptpu_predictor_set_profiler(
 		(*[0]byte)(recordFn), (*[0]byte)(enabledFn))
 }
+
+// TuneStatsJSON snapshots the persisted-autotuner counters (entries,
+// hits/misses, probes + probe_us, cache-file loads/rejects) as JSON.
+// Process-global; autotuning itself is opt-in via PTPU_TUNE=1.
+func TuneStatsJSON() string {
+	return C.GoString(C.ptpu_tune_stats_json())
+}
+
+// TuneSave persists the in-memory autotune winners to path (empty =
+// the PTPU_TUNE_CACHE default). Returns the entry count written, -1
+// on I/O error.
+func TuneSave(path string) int {
+	cs := C.CString(path)
+	defer C.free(unsafe.Pointer(cs))
+	return int(C.ptpu_tune_save(cs))
+}
+
+// TuneLoad merge-loads a tuning-cache file (empty path = default).
+// Returns entries adopted; a corrupt or foreign-machine file adopts 0
+// and never errors — the contract is silent re-probe.
+func TuneLoad(path string) int {
+	cs := C.CString(path)
+	defer C.free(unsafe.Pointer(cs))
+	return int(C.ptpu_tune_load(cs))
+}
+
+// TuneClear drops the in-memory autotune entries and counters (the
+// cache file is untouched).
+func TuneClear() {
+	C.ptpu_tune_clear()
+}

@@ -769,6 +769,18 @@ def _predictor_lib() -> ctypes.CDLL:
             lib._ptpu_has_pred_stats = True
         except AttributeError:   # stale prebuilt .so: stats degrade
             lib._ptpu_has_pred_stats = False
+        try:
+            # persisted kernel autotuning ABI (r15) — process-global
+            lib.ptpu_tune_stats_json.restype = c.c_char_p
+            lib.ptpu_tune_stats_json.argtypes = []
+            lib.ptpu_tune_save.restype = c.c_int
+            lib.ptpu_tune_save.argtypes = [c.c_char_p]
+            lib.ptpu_tune_load.restype = c.c_int
+            lib.ptpu_tune_load.argtypes = [c.c_char_p]
+            lib.ptpu_tune_clear.argtypes = []
+            lib._ptpu_has_tune = True
+        except AttributeError:   # stale prebuilt .so: autotune off
+            lib._ptpu_has_tune = False
         # Wire the host profiler (csrc/ptpu_runtime.cc, a separate .so)
         # into the predictor: per-op RecordEvent spans when profiling
         # is on, so serving runs land in the same chrome trace as
@@ -1152,6 +1164,53 @@ def serving_available() -> bool:
 
 
 # ---------------------------------------------------------------------------
+# Persisted kernel autotuning (csrc/ptpu_tune.{h,cc}, r15). Process-
+# global per .so and opt-in via PTPU_TUNE=1; these helpers only
+# snapshot/steer it from Python (benches and tests).
+# ---------------------------------------------------------------------------
+
+def tune_available() -> bool:
+    """True when _native_predictor.so carries the autotuning ABI."""
+    try:
+        return bool(getattr(_predictor_lib(), "_ptpu_has_tune", False))
+    except OSError:
+        return False
+
+
+def _tune_lib() -> ctypes.CDLL:
+    l = _predictor_lib()
+    if not getattr(l, "_ptpu_has_tune", False):
+        raise RuntimeError(
+            "autotuning needs the r15 ABI (stale _native_predictor.so:"
+            " delete it and re-import)")
+    return l
+
+
+def tune_stats() -> dict:
+    """Autotuner counters: entries, hits/misses, probes + probe_us,
+    cache-file loads/rejects/wrong-cpu, saves."""
+    import json
+    return json.loads(_tune_lib().ptpu_tune_stats_json().decode())
+
+
+def tune_save(path: str = "") -> int:
+    """Persist the in-memory winners (empty path = PTPU_TUNE_CACHE
+    default). Returns entries written, -1 on I/O error."""
+    return int(_tune_lib().ptpu_tune_save(path.encode()))
+
+
+def tune_load(path: str = "") -> int:
+    """Merge-load a tuning cache. Returns entries adopted; corrupt or
+    foreign-machine files adopt 0 (silent re-probe contract)."""
+    return int(_tune_lib().ptpu_tune_load(path.encode()))
+
+
+def tune_clear() -> None:
+    """Drop the in-memory entries/counters (cache file untouched)."""
+    _tune_lib().ptpu_tune_clear()
+
+
+# ---------------------------------------------------------------------------
 # C ABI manifest — every exported symbol this binding layer (or the
 # tests' hand-rolled ctypes) relies on, per shared object. The tier-1
 # ABI-drift test (tests/test_observability.py) dlopen-checks each list
@@ -1224,5 +1283,7 @@ ABI_SYMBOLS = {
         "ptpu_serving_config_json", "ptpu_serving_stats_json",
         "ptpu_serving_stats_reset", "ptpu_serving_prom_text",
         "ptpu_serving_stop", "ptpu_trace_set", "ptpu_trace_json",
+        "ptpu_tune_stats_json", "ptpu_tune_save", "ptpu_tune_load",
+        "ptpu_tune_clear",
     ),
 }

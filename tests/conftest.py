@@ -22,6 +22,21 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                            " --xla_force_host_platform_device_count=8")
 
+# Persistent XLA compilation cache (r16): tier-1 wall time on the
+# 1-core CI box is compile-dominated — a single jitted YOLO train
+# step costs ~60s of XLA compile, the suite recompiles the identical
+# jaxprs every run. Keyed by HLO + compile options + jax/XLA version,
+# so upgrades invalidate cleanly and a hit is bit-identical to a
+# fresh compile. Set via env (not jax.config) so the subprocess tests
+# (examples, launch, dist runners) inherit it too. Opt out with
+# PTPU_NO_XLA_CACHE=1, e.g. when measuring compile time itself.
+if not os.environ.get("PTPU_NO_XLA_CACHE"):
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "ptpu_xla"))
+    os.environ.setdefault(
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

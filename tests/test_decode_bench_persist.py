@@ -1,6 +1,7 @@
 """decode_bench `--out` persistence contract (ISSUE r9 satellite,
-schema extended for the r12 paged engine and the r13 speculative
-A/B leg; pattern of tests/test_serving_bench_persist.py).
+schema extended for the r12 paged engine, the r13 speculative A/B
+leg, and the r16 int4/autotune legs; pattern of
+tests/test_serving_bench_persist.py).
 
 Runs `tools/decode_bench.py --smoke` as a subprocess with a shrunken
 config (2 sessions, 6 tokens, context 32, decode batch 2, a 12-session
@@ -28,7 +29,9 @@ BENCH = os.path.join(REPO, "tools", "decode_bench.py")
 
 @pytest.fixture(scope="module")
 def bench_out(tmp_path_factory):
-    out = str(tmp_path_factory.mktemp("decb") / "BENCH_DECODE.json")
+    d = tmp_path_factory.mktemp("decb")
+    out = str(d / "BENCH_DECODE.json")
+    i4out = str(d / "BENCH_INT4.json")
     env = dict(os.environ)
     env.update({
         "JAX_PLATFORMS": "cpu",
@@ -43,12 +46,16 @@ def bench_out(tmp_path_factory):
          "--ramp-fixed-sessions", "4", "--prefix-opens", "4",
          "--prefix-prompt", "24", "--spec-k", "2", "--spec-tokens",
          "12", "--spec-train-steps", "8", "--spec-rounds", "2",
-         "--spec-sample-opens", "8"],
+         "--spec-sample-opens", "8", "--int4-tokens", "12",
+         "--int4-rounds", "2", "--tune-reps", "6",
+         "--int4-out", i4out],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
     assert r.returncode == 0, r.stderr[-2000:]
     with open(out) as f:
         data = json.load(f)
     data["_stderr"] = r.stderr[-2000:]
+    with open(i4out) as f:
+        data["_int4_out"] = json.load(f)
     return data
 
 
@@ -69,7 +76,10 @@ class TestDecodeBenchPersist:
                 "spec_greedy_parity", "spec_ab_tokens_per_s_1s",
                 "spec_ab_tokens_per_s_2s", "spec_accept_rate",
                 "spec_speedup_single_session",
-                "spec_sampling_distribution"} <= metrics
+                "spec_sampling_distribution",
+                "int4_quality_vs_fp32", "int4_ab_tokens_per_s_1s",
+                "int4_ab_tokens_per_s_2s", "autotune_gemm_win",
+                "tune_warm_cache_probe_cost"} <= metrics
 
     def test_counters_exact(self, bench_out):
         by = {r["metric"]: r for r in bench_out["measurements"]}
@@ -144,3 +154,51 @@ class TestDecodeBenchPersist:
         samp = by["spec_sampling_distribution"]
         assert samp["deterministic"] is True
         assert samp["value"] is True
+
+    def test_int4_rows(self, bench_out):
+        """r16 schema: int4 A/B rows carry both legs' per-round
+        tokens/s and the 1.5x acceptance gate; the quality row records
+        the measured bound (argmax agreement + relative logits delta)
+        that gates the full run.  The throughput gate itself is not
+        asserted at smoke scale."""
+        by = {r["metric"]: r for r in bench_out["measurements"]}
+        q = by["int4_quality_vs_fp32"]
+        assert q["teacher_forced_steps"] > 0
+        assert 0.0 <= q["argmax_agreement"] <= 1.0
+        assert q["max_logits_delta"] >= 0.0
+        assert q["agreement_gate"] == 0.95
+        assert q["rel_delta_gate"] == 0.10
+        for nsess in (1, 2):
+            row = by[f"int4_ab_tokens_per_s_{nsess}s"]
+            assert row["int4_tokens_per_s"] > 0
+            assert row["fp32_tokens_per_s"] > 0
+            assert len(row["per_round_int4"]) == 2
+            assert len(row["per_round_fp32"]) == 2
+        assert by["int4_ab_tokens_per_s_1s"]["acceptance_gate"] == 1.5
+
+    def test_tune_rows(self, bench_out):
+        """The warm-cache row is an EXACT contract — a warm tune cache
+        must skip every probe even at smoke scale — so its value IS
+        asserted.  The autotune win ratio only has to be recorded."""
+        by = {r["metric"]: r for r in bench_out["measurements"]}
+        win = by["autotune_gemm_win"]
+        assert win["base_ms"] > 0 and win["tuned_ms"] > 0
+        assert len(win["per_round_base_ms"]) == 2
+        assert len(win["per_round_tuned_ms"]) == 2
+        assert win["acceptance_gate"] == 1.10
+        warm = by["tune_warm_cache_probe_cost"]
+        assert warm["value"] is True, bench_out["_stderr"]
+        assert warm["cold_probes"] > 0
+        assert warm["warm_probes"] == 0
+        assert warm["warm_probe_us"] == 0
+        assert warm["warm_file_entries"] == warm["cold_probes"]
+
+    def test_int4_out_file(self, bench_out):
+        """--int4-out persists just the int4/autotune rows (the
+        BENCH_INT4_r01.json artifact) alongside the main --out file."""
+        i4 = bench_out["_int4_out"]
+        assert i4["bench"] == "int4_tune_bench"
+        metrics = {r["metric"] for r in i4["measurements"]}
+        assert {"int4_quality_vs_fp32", "int4_ab_tokens_per_s_1s",
+                "autotune_gemm_win",
+                "tune_warm_cache_probe_cost"} <= metrics

@@ -106,8 +106,11 @@ class TestHeterSplitTraining:
             with pytest.raises(RuntimeError, match="spoof"):
                 s1.heter_call(0, "boom")
         finally:
-            s0.finalize()
+            # protocol order: non-zero ranks announce their bye first —
+            # finalizing rank 0 first leaves it spinning the full
+            # shutdown timeout waiting for a bye that never comes
             s1.finalize()
+            s0.finalize()
 
     def test_wire_protocol_version_mismatch(self):
         """r6: every frame leads with a protocol version byte; a frame

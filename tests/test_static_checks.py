@@ -30,7 +30,7 @@ spec.loader.exec_module(ptpu_check)
 ABI_FILES = [
     "csrc/ptpu_runtime.cc", "csrc/ptpu_ps_table.cc",
     "csrc/ptpu_ps_server.cc", "csrc/ptpu_predictor.cc",
-    "csrc/ptpu_serving.cc", "csrc/ptpu_net.cc",
+    "csrc/ptpu_serving.cc", "csrc/ptpu_tune.cc", "csrc/ptpu_net.cc",
     "csrc/ptpu_trace.cc", "csrc/ptpu_inference_api.h",
     "paddle_tpu/core/native.py", "goapi/predictor.go",
 ]
@@ -128,6 +128,17 @@ class TestAbiChecker:
                 "C.ptpu_predictor_run(p.p", "C.ptpu_predictor_runx(p.p")
         msgs = [f.message for f in _run(root, "abi")]
         assert any("ptpu_predictor_runx" in m and "does not declare" in m
+                   for m in msgs)
+
+    def test_catches_tune_symbol_drift(self, tmp_path):
+        """The r16 ptpu_tune_* ABI rides the same three-way contract:
+        csrc export == ABI_SYMBOLS == public header == goapi."""
+        root = _fixture(tmp_path, ABI_FILES)
+        _mutate(root, "paddle_tpu/core/native.py",
+                '"ptpu_tune_save",', '"ptpu_tune_savx",')
+        msgs = [f.message for f in _run(root, "abi")]
+        assert any("ptpu_tune_save is exported" in m for m in msgs)
+        assert any("ptpu_tune_savx" in m and "no csrc TU" in m
                    for m in msgs)
 
 
@@ -485,6 +496,21 @@ class TestSyncChecker:
         msgs = [f.message for f in _run(root, "sync")]
         assert any("one class, one rank" in m for m in msgs)
 
+    def test_catches_tune_rank_drift(self, tmp_path):
+        """tune.cache is declared twice (production ptpu_tune.h + the
+        schedck mirror): editing one side's rank must flag the
+        one-class-one-rank contract."""
+        root = tmp_path / "tree"
+        (root / "csrc").mkdir(parents=True)
+        for rel in ("ptpu_tune.h", "ptpu_schedck_selftest.cc"):
+            shutil.copyfile(os.path.join(REPO, "csrc", rel),
+                            root / "csrc" / rel)
+        _mutate(root, "csrc/ptpu_tune.h",
+                '"tune.cache", 55', '"tune.cache", 56')
+        msgs = [f.message for f in _run(root, "sync")]
+        assert any('"tune.cache"' in m and "one class, one rank" in m
+                   for m in msgs)
+
     def test_clean_wrapper_usage_passes(self, tmp_path):
         root = tmp_path / "tree"
         (root / "csrc").mkdir(parents=True)
@@ -501,6 +527,8 @@ FUZZ_FILES = [
     "csrc/fuzz/fuzz_wire_ps.cc", "csrc/fuzz/fuzz_wire_serving.cc",
     "csrc/fuzz/fuzz_http.cc", "csrc/fuzz/fuzz_onnx.cc",
     "csrc/fuzz/fuzz_json.cc", "csrc/fuzz/fuzz_frames.cc",
+    "csrc/fuzz/fuzz_tune.cc", "csrc/ptpu_tune.h",
+    "csrc/fuzz/gen_seeds.py",
 ]
 
 
@@ -573,6 +601,30 @@ class TestFuzzChecker:
         _mutate(root, "csrc/Makefile", "fuzz_json", "fuzz_jsonx")
         msgs = [f.message for f in _run(root, "fuzz")]
         assert any("fuzz_json not listed in FUZZ_TARGETS" in m
+                   for m in msgs)
+
+    def test_catches_tune_magic_drift(self, tmp_path):
+        """gen_seeds.py's TUNE_MAGIC twin must track kTuneMagic in
+        ptpu_tune.h — otherwise regenerated seeds miss the parser."""
+        root = _fuzz_fixture(tmp_path)
+        _mutate(root, "csrc/fuzz/gen_seeds.py",
+                "TUNE_MAGIC = 0x4E555450", "TUNE_MAGIC = 0x4E555451")
+        msgs = [f.message for f in _run(root, "fuzz")]
+        assert any("TUNE_MAGIC does not match kTuneMagic" in m
+                   for m in msgs)
+
+    def test_catches_tune_valid_seed_removal(self, tmp_path):
+        """Dropping every well-formed tune cache seed must fail the
+        magic-coverage walk: the fuzzer would never start inside the
+        record parser."""
+        root = _fuzz_fixture(tmp_path)
+        corpus = root / "csrc" / "fuzz" / "corpus" / "tune"
+        magic = (0x4E555450).to_bytes(4, "little")
+        for f_ in corpus.iterdir():
+            if f_.read_bytes()[:4] == magic:
+                os.remove(f_)
+        msgs = [f.message for f in _run(root, "fuzz")]
+        assert any("PTUN magic" in m and "record parser" in m
                    for m in msgs)
 
 
@@ -648,6 +700,22 @@ class TestSchedChecker:
         msgs = [f.message for f in _run(root, "sched")]
         assert any("without including" in m and "ptpu_schedck.h" in m
                    for m in msgs)
+
+    def test_catches_tune_class_losing_its_row(self, tmp_path):
+        """Deleting the tune.cache manifest row must flag the live
+        ptpu_tune.h lock class as unmodeled (the no-silent-path rule
+        that forced the tune_probe_insert_save scenario to exist)."""
+        root = tmp_path / "tree"
+        (root / "csrc").mkdir(parents=True)
+        for rel in ("ptpu_tune.h", "ptpu_schedck_selftest.cc"):
+            shutil.copyfile(os.path.join(REPO, "csrc", rel),
+                            root / "csrc" / rel)
+        manifest = root / "csrc" / "ptpu_schedck_coverage.txt"
+        manifest.write_text("tune.cache tune_probe_insert_save\n")
+        assert _run(root, "sched") == []
+        manifest.write_text("# no rows\n")
+        msgs = [f.message for f in _run(root, "sched")]
+        assert any('"tune.cache" has no row' in m for m in msgs)
 
     def test_manifest_missing_is_a_finding(self, tmp_path):
         root = _sched_tree(tmp_path)

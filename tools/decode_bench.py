@@ -28,7 +28,18 @@ Legs:
               per-session-memory columns;
   prefix_ab   M server-side prefills of ONE shared prompt vs M
               distinct prompts: the shared-prompt wall time must be
-              measurably lower (prefix-cache hit).
+              measurably lower (prefix-cache hit);
+  int4        (ISSUE 16) fp32 vs PTPU_INT4=1 on one TRAINED decode
+              artifact, alternating rounds: batch-1 GEMV decode
+              tokens/s gated >= 1.5x with a measured QUALITY bound
+              (max logits-delta + argmax agreement) instead of
+              bitwise parity — int4 is lossy by design;
+  tune        (ISSUE 16) persisted autotuning A/B in ctypes-only
+              subprocesses (PTPU_TUNE latches per process): tuned
+              configs gated >= 1.10x static tiles on a skinny-M MLP,
+              and a warm tuning cache must make the second load's
+              probe count/cost exactly zero. --int4-out persists
+              these rows separately (BENCH_INT4_r01.json).
 
 Run: python tools/decode_bench.py [--out BENCH_DECODE_rNN.json] [...]
 (CPU-only; forces jax to CPU; uses the shipped .so.)
@@ -70,6 +81,58 @@ def peak_rss_mb():
                  1024.0, 1)
 
 
+# ctypes-only one-shot predictor timer for the autotune A/B legs:
+# PTPU_TUNE latches once per process, so every leg is its own
+# subprocess — and skipping the jax import keeps a leg at process
+# cost, not interpreter-warmup cost.
+_TUNE_RUNNER = '''\
+import ctypes, json, sys, time
+import numpy as np
+
+so, model, xpath, reps = sys.argv[1], sys.argv[2], sys.argv[3], \
+    int(sys.argv[4])
+lib = ctypes.CDLL(so)
+c = ctypes
+lib.ptpu_predictor_create.restype = c.c_void_p
+lib.ptpu_predictor_create.argtypes = [c.c_char_p, c.c_char_p, c.c_int]
+lib.ptpu_predictor_input_name.restype = c.c_char_p
+lib.ptpu_predictor_input_name.argtypes = [c.c_void_p, c.c_int]
+lib.ptpu_predictor_set_input.argtypes = [
+    c.c_void_p, c.c_char_p, c.POINTER(c.c_float),
+    c.POINTER(c.c_int64), c.c_int, c.c_char_p, c.c_int]
+lib.ptpu_predictor_run.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+lib.ptpu_predictor_destroy.argtypes = [c.c_void_p]
+lib.ptpu_tune_stats_json.restype = c.c_char_p
+
+err = ctypes.create_string_buffer(512)
+t0 = time.perf_counter()
+h = lib.ptpu_predictor_create(model.encode(), err, 512)
+create_s = time.perf_counter() - t0
+assert h, err.value.decode()
+x = np.load(xpath)
+dims = (c.c_int64 * x.ndim)(*x.shape)
+
+def once():
+    rc = lib.ptpu_predictor_set_input(
+        h, lib.ptpu_predictor_input_name(h, 0),
+        x.ctypes.data_as(c.POINTER(c.c_float)), dims, x.ndim, err, 512)
+    assert rc == 0, err.value.decode()
+    rc = lib.ptpu_predictor_run(h, err, 512)
+    assert rc == 0, err.value.decode()
+
+for _ in range(3):
+    once()
+t0 = time.perf_counter()
+for _ in range(reps):
+    once()
+run_ms = (time.perf_counter() - t0) / reps * 1e3
+stats = json.loads(lib.ptpu_tune_stats_json().decode())
+lib.ptpu_predictor_destroy(h)
+print(json.dumps({"create_s": round(create_s, 4),
+                  "run_ms_mean": round(run_ms, 4), "stats": stats}))
+'''
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out")
@@ -108,6 +171,19 @@ def main():
                     help="seeded sampling draws for the distribution "
                          "gate")
     ap.add_argument("--skip-spec", action="store_true")
+    # weight-only int4 + persisted-autotuning A/B legs (ISSUE 16)
+    ap.add_argument("--int4-tokens", type=int, default=64,
+                    help="greedy tokens per measured int4/fp32 leg")
+    ap.add_argument("--int4-rounds", type=int, default=4,
+                    help="alternating A/B rounds per leg pair (r10 "
+                         "noise methodology)")
+    ap.add_argument("--tune-reps", type=int, default=30,
+                    help="timed predictor runs inside each autotune "
+                         "subprocess leg")
+    ap.add_argument("--int4-out",
+                    help="persist the int4/autotune measurements to "
+                         "this JSON (e.g. BENCH_INT4_r01.json)")
+    ap.add_argument("--skip-int4", action="store_true")
     ap.add_argument("--smoke", action="store_true",
                     help="shrunken-config run: record everything, "
                          "never fail throughput gates (correctness "
@@ -470,16 +546,18 @@ def main():
         # 1-layer draft memorizes — because speculation pays off
         # exactly when draft and target agree; random weights would
         # bench the rejection path.
-        if not args.skip_spec:
+        # The spec AND int4 legs share one trained target: quality
+        # metrics (acceptance rate, logits agreement) are meaningless
+        # on random weights, whose near-flat logits make every argmax
+        # a coin flip.
+        tgt, loss_t = None, None
+        if not (args.skip_spec and args.skip_int4):
             import jax
             from paddle_tpu.nn.layer import (functional_call,
                                              load_state,
                                              trainable_state)
 
-            k = args.spec_k
-            sctx = 120
             V = cfg.vocab_size
-            N = min(args.spec_tokens, sctx - 8 - k - 2)
 
             def make_batch(rs, bsz, seq):
                 arr = np.empty((bsz, seq + 1), np.int64)
@@ -528,6 +606,11 @@ def main():
             tgt = GPTForPretraining(cfg_s)
             tgt.eval()
             loss_t = train(tgt, args.spec_train_steps, 1)
+
+        if not args.skip_spec:
+            k = args.spec_k
+            sctx = 120
+            N = min(args.spec_tokens, sctx - 8 - k - 2)
             pt.seed(202)
             dcfg = gpt_tiny(dtype=jnp.float32, dropout=0.0,
                             hidden_size=32, num_layers=1, num_heads=2)
@@ -703,6 +786,245 @@ def main():
             if not args.smoke:
                 ok = ok and spec_ratio_1s >= 1.8 and accept_rate >= 0.60
 
+        # ---- leg 6: weight-only int4 A/B + quality gate (ISSUE 16) -
+        # fp32 vs PTPU_INT4=1 on the SAME trained decode artifact,
+        # loaded side by side (the knob is read per load), alternating
+        # rounds. The headline gate is the batch-1 GEMV decode — the
+        # shape where 8x less weight traffic must buy >= 1.5x — and the
+        # first NON-BITWISE gate in this repo: int4 is lossy, so the
+        # bound is measured quality (max logits-delta + greedy argmax
+        # agreement on the trained model), not parity.
+        if not args.skip_int4:
+            import subprocess as sp
+
+            ictx = 120
+            itok = min(args.int4_tokens, ictx - 2)
+            iprompt = 7
+            # quality runs on the TRAINED gpt_tiny (peaked logits make
+            # argmax agreement meaningful); throughput runs on a
+            # SERVING-SCALE variant — gpt_tiny's ~0.9 MB of weights
+            # live entirely in cache, where the GEMV is never weight-
+            # bandwidth-bound and int4's 8x traffic cut can't show.
+            # h=256/v=2048 is ~15 MB fp32: past L2, the shape the
+            # claim is about. Training it would add nothing (wall
+            # time is weight-shape-bound, not value-bound).
+            idec1 = export_gpt_decode(tgt, os.path.join(tmp, "i4dec1"),
+                                      batch=1, context=ictx)
+            pt.seed(44)
+            cfg_big = gpt_tiny(dtype=jnp.float32, dropout=0.0,
+                               hidden_size=256, vocab_size=2048)
+            big = GPTForPretraining(cfg_big)
+            big.eval()
+            bdec1 = export_gpt_decode(big, os.path.join(tmp, "i4big1"),
+                                      batch=1, context=ictx)
+            bdecB = export_gpt_decode(big, os.path.join(tmp, "i4bigB"),
+                                      batch=args.batch, context=ictx)
+
+            def load_dec(path, slots, int4):
+                if int4:
+                    os.environ["PTPU_INT4"] = "1"
+                try:
+                    p = NativePredictor(path)
+                finally:
+                    os.environ.pop("PTPU_INT4", None)
+                p.kv_plan(slots)
+                return p
+
+            def gen_tps(p, nsess, steps):
+                ss = [p.kv_open() for _ in range(nsess)]
+                cur = [iprompt] * nsess
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    lg = p.decode_step(ss, cur)
+                    cur = [int(np.argmax(lg[i])) for i in range(nsess)]
+                dt = time.perf_counter() - t0
+                for s in ss:
+                    p.kv_close(s)
+                return nsess * steps / dt
+
+            q32 = load_dec(idec1, 1, False)
+            qq = load_dec(idec1, 1, True)
+            p32_1 = load_dec(bdec1, 1, False)
+            pq_1 = load_dec(bdec1, 1, True)
+            p32_B = load_dec(bdecB, args.batch, False)
+            pq_B = load_dec(bdecB, args.batch, True)
+
+            # quality: teacher-forced on the fp32 greedy stream
+            s32, sq = q32.kv_open(), qq.kv_open()
+            toks, agree, mld, lmax = [iprompt], 0, 0.0, 0.0
+            for _ in range(itok - 1):
+                l32 = q32.decode_step([s32], [toks[-1]])[0]
+                lq = qq.decode_step([sq], [toks[-1]])[0]
+                mld = max(mld, float(np.max(np.abs(lq - l32))))
+                lmax = max(lmax, float(np.max(np.abs(l32))))
+                agree += int(np.argmax(lq)) == int(np.argmax(l32))
+                toks.append(int(np.argmax(l32)))
+            q32.kv_close(s32)
+            qq.kv_close(sq)
+            q32.close()
+            qq.close()
+            agreement = agree / (itok - 1)
+            rel_delta = mld / max(lmax, 1e-12)
+            quality_ok = agreement >= 0.95 and rel_delta <= 0.10
+            emit({"metric": "int4_quality_vs_fp32",
+                  "argmax_agreement": round(agreement, 4),
+                  "max_logits_delta": round(mld, 5),
+                  "max_logits_delta_rel": round(rel_delta, 5),
+                  "teacher_forced_steps": itok - 1,
+                  "train_loss_target": round(loss_t, 4),
+                  "agreement_gate": 0.95, "rel_delta_gate": 0.10,
+                  "value": bool(quality_ok),
+                  "note": "smoke models are barely trained: the gate "
+                          "binds only on the full run"})
+
+            # throughput: alternating rounds, order flipped each round
+            iab = {"b1": {"fp32": [], "int4": []},
+                   "bN": {"fp32": [], "int4": []}}
+            sizes = (("b1", 1),) if args.batch == 1 else \
+                (("b1", 1), ("bN", args.batch))
+            for p in (p32_1, pq_1):
+                gen_tps(p, 1, 4)   # warm the lazy first-step paths
+            for rnd in range(args.int4_rounds):
+                legs = [("int4", pq_1, pq_B), ("fp32", p32_1, p32_B)]
+                if rnd % 2:
+                    legs.reverse()
+                for name, p1, pB in legs:
+                    iab["b1"][name].append(gen_tps(p1, 1, itok))
+                    if len(sizes) > 1:
+                        iab["bN"][name].append(
+                            gen_tps(pB, args.batch, itok))
+            for p in (p32_1, pq_1, p32_B, pq_B):
+                p.close()
+            i4_ratio = 0.0
+            for lbl, nsess in sizes:
+                qm = float(np.mean(iab[lbl]["int4"]))
+                fm = float(np.mean(iab[lbl]["fp32"]))
+                r = qm / fm
+                if lbl == "b1":
+                    i4_ratio = r
+                emit({"metric": f"int4_ab_tokens_per_s_{nsess}s",
+                      "sessions": nsess,
+                      "model": "gpt_tiny(h=256,v=2048) ~15MB fp32",
+                      "int4_tokens_per_s": round(qm, 1),
+                      "fp32_tokens_per_s": round(fm, 1),
+                      "value": round(r, 2), "unit": "x",
+                      "rounds": args.int4_rounds,
+                      "per_round_int4": [round(x, 1)
+                                         for x in iab[lbl]["int4"]],
+                      "per_round_fp32": [round(x, 1)
+                                         for x in iab[lbl]["fp32"]],
+                      **({"acceptance_gate": 1.5,
+                          "within_gate": bool(r >= 1.5)}
+                         if lbl == "b1" else {})})
+
+            # ---- leg 7: persisted autotuning A/B + warm-cache probe
+            # cost. PTPU_TUNE is latched once per process, so each leg
+            # is a ctypes-only subprocess (no jax import). The shape
+            # is chosen where the row-GEMV alt path wins STRUCTURALLY,
+            # not by measurement luck: M=2 pads the MR=6 register tile
+            # to 3x the useful FMAs, and 320x320 weights (1.6MB for 4
+            # layers) stay L2-resident so the GEMM is compute-bound —
+            # padding waste is the bill, not memory bandwidth. (A
+            # DRAM-bound shape hides the waste entirely: the 15MB
+            # int4-leg MLP measures ~1.0x here no matter the config.)
+            # Rounds alternate tuned/untuned processes; the warm-cache
+            # contract (second load probes NOTHING) is exact and gates
+            # even the smoke run.
+            pt.seed(33)
+            tnet = pt.nn.Sequential(
+                pt.nn.Linear(320, 320), pt.nn.ReLU(),
+                pt.nn.Linear(320, 320), pt.nn.ReLU(),
+                pt.nn.Linear(320, 320), pt.nn.ReLU(),
+                pt.nn.Linear(320, 320))
+            tnet.eval()
+            xt = np.random.RandomState(33).randn(2, 320).astype(
+                np.float32)
+            tmlp = os.path.join(tmp, "tune_mlp.onnx")
+            with open(tmlp, "wb") as f:
+                f.write(trace_to_onnx(lambda a: tnet(a),
+                                      (jnp.asarray(xt),)))
+            xt_path = os.path.join(tmp, "tune_x.npy")
+            np.save(xt_path, xt)
+            runner = os.path.join(tmp, "tune_runner.py")
+            with open(runner, "w") as f:
+                f.write(_TUNE_RUNNER)
+            so = os.path.join(REPO, "paddle_tpu",
+                              "_native_predictor.so")
+            cache = os.path.join(tmp, "tune.cache")
+
+            def tune_leg(tuned):
+                env = dict(os.environ)
+                env.pop("PTPU_TUNE", None)
+                if tuned:
+                    env.update({"PTPU_TUNE": "1",
+                                "PTPU_TUNE_CACHE": cache})
+                r = sp.run([sys.executable, runner, so, tmlp, xt_path,
+                            str(args.tune_reps)], env=env,
+                           capture_output=True, text=True, timeout=300)
+                assert r.returncode == 0, r.stderr[-2000:]
+                return json.loads(r.stdout.strip().splitlines()[-1])
+
+            cold = tune_leg(True)   # probes fire + cache persists
+            base_ms, tuned_ms = [], []
+            warm = None
+            for rnd in range(args.int4_rounds):
+                legs = [("tuned", True), ("base", False)]
+                if rnd % 2:
+                    legs.reverse()
+                for name, tn_on in legs:
+                    rec = tune_leg(tn_on)
+                    if name == "tuned":
+                        tuned_ms.append(rec["run_ms_mean"])
+                        warm = rec
+                    else:
+                        base_ms.append(rec["run_ms_mean"])
+            win = float(np.mean(base_ms)) / float(np.mean(tuned_ms))
+            emit({"metric": "autotune_gemm_win",
+                  "value": round(win, 3), "unit": "x",
+                  "shape": "MLP [2,320]x[320,320] x4 layers, "
+                           "L2-resident (skinny-M: the 6-row tile "
+                           "pads M=2 to 3x the useful FMAs)",
+                  "base_ms": round(float(np.mean(base_ms)), 3),
+                  "tuned_ms": round(float(np.mean(tuned_ms)), 3),
+                  "per_round_base_ms": [round(x, 3) for x in base_ms],
+                  "per_round_tuned_ms": [round(x, 3)
+                                         for x in tuned_ms],
+                  "acceptance_gate": 1.10,
+                  "within_gate": bool(win >= 1.10)})
+            warm_ok = (warm["stats"]["probes"] == 0 and
+                       warm["stats"]["probe_us"] == 0 and
+                       warm["stats"]["file_loads"] == 1 and
+                       cold["stats"]["probes"] > 0 and
+                       cold["stats"]["saves"] >= 1)
+            emit({"metric": "tune_warm_cache_probe_cost",
+                  "value": bool(warm_ok),
+                  "cold_probes": cold["stats"]["probes"],
+                  "cold_probe_us": cold["stats"]["probe_us"],
+                  "cold_create_s": cold["create_s"],
+                  "warm_probes": warm["stats"]["probes"],
+                  "warm_probe_us": warm["stats"]["probe_us"],
+                  "warm_create_s": warm["create_s"],
+                  "warm_file_entries": warm["stats"]["file_entries"],
+                  "note": "exact contract: a warm cache skips every "
+                          "probe, at any scale"})
+
+            ok = ok and warm_ok
+            if not args.smoke:
+                ok = ok and quality_ok and i4_ratio >= 1.5 and \
+                    win >= 1.10
+
+            if args.int4_out:
+                i4_metrics = [m for m in RESULTS
+                              if m["metric"].startswith(
+                                  ("int4_", "autotune_", "tune_"))]
+                with open(args.int4_out, "w") as f:
+                    json.dump({"bench": "int4_tune_bench",
+                               "config": vars(args),
+                               "measurements": i4_metrics}, f,
+                              indent=1)
+                print(f"# persisted int4 legs to {args.int4_out}",
+                      flush=True)
+
         # ---- r01 guard + gates -------------------------------------
         ratio = kv_tps / rc_tps
         emit({"metric": "decode_kv_speedup_vs_recompute",
@@ -733,6 +1055,8 @@ def main():
         if args.smoke:
             # correctness only: exactness/parity must hold at any size
             ok = counters_exact and logits_close and exact_all
+            if not args.skip_int4:
+                ok = ok and warm_ok
         else:
             ok = ok and counters_exact and logits_close and exact_all \
                 and ratio >= 5.0

@@ -186,7 +186,7 @@ SO_SOURCES = {
     "_native_ps.so": ["csrc/ptpu_ps_table.cc", "csrc/ptpu_ps_server.cc",
                       "csrc/ptpu_net.cc", "csrc/ptpu_trace.cc"],
     "_native_predictor.so": ["csrc/ptpu_predictor.cc",
-                             "csrc/ptpu_serving.cc",
+                             "csrc/ptpu_serving.cc", "csrc/ptpu_tune.cc",
                              "csrc/ptpu_net.cc", "csrc/ptpu_trace.cc"],
 }
 
@@ -1254,6 +1254,7 @@ FUZZ_TARGET_SOURCES = {
     "onnx": "csrc/ptpu_predictor.cc",
     "json": "csrc/ptpu_trace.cc",
     "frames": "csrc/ptpu_net.cc",
+    "tune": "csrc/ptpu_tune.h",
 }
 
 
@@ -1358,6 +1359,45 @@ def check_fuzz(root: str) -> List[Finding]:
                     f"ONNX op '{opname}' is parsed but appears in no "
                     f"csrc/fuzz/corpus/onnx seed — regen the all-ops "
                     f"seed (gen_seeds.py)"))
+
+    # 5) tuning cache (ISSUE 16): the corpus must seed BOTH sides of
+    #    the magic check (well-formed caches reach the record parser,
+    #    alien bytes reach the reject path), and gen_seeds.py's twin
+    #    magic constant must track the parser's
+    tune_rel = "csrc/ptpu_tune.h"
+    tune_hdr = _require(root, tune_rel, "fuzz", f)
+    if tune_hdr is not None:
+        clean = strip_c_comments(tune_hdr)
+        m = re.search(r"\bkTuneMagic\s*=\s*0x([0-9a-fA-F]+)", clean)
+        if m is None:
+            f.append(Finding(
+                "fuzz", tune_rel, 0,
+                "kTuneMagic literal not found — the fuzz checker keys "
+                "the tune corpus on it"))
+        else:
+            magic = int(m.group(1), 16)
+            magic_le = magic.to_bytes(4, "little")
+            blobs = _corpus_blobs(root, "tune")
+            if not any(b[:4] == magic_le for b in blobs):
+                f.append(Finding(
+                    "fuzz", "csrc/fuzz/corpus/tune", 0,
+                    "no tune corpus seed starts with the PTUN magic — "
+                    "the fuzzer never starts inside the record parser "
+                    "(regen via gen_seeds.py)"))
+            if not any(len(b) >= 4 and b[:4] != magic_le for b in blobs):
+                f.append(Finding(
+                    "fuzz", "csrc/fuzz/corpus/tune", 0,
+                    "no tune corpus seed with a non-PTUN magic — the "
+                    "alien-file reject path is unseeded (gen_seeds.py)"))
+            gen = _require(root, "csrc/fuzz/gen_seeds.py", "fuzz", f)
+            if gen is not None:
+                gm = re.search(r"\bTUNE_MAGIC\s*=\s*0x([0-9a-fA-F]+)", gen)
+                if gm is None or int(gm.group(1), 16) != magic:
+                    f.append(Finding(
+                        "fuzz", "csrc/fuzz/gen_seeds.py", 0,
+                        "TUNE_MAGIC does not match kTuneMagic in "
+                        "csrc/ptpu_tune.h — regenerated seeds would "
+                        "miss the parser"))
     return f
 
 
