@@ -19,6 +19,8 @@
 #include "../ptpu_trace.cc"
 
 #include <cstdint>
+#include <cstring>
+#include <vector>
 
 namespace {
 
@@ -45,7 +47,15 @@ void InitOnce() {
 extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
   if (size > (1u << 20)) return 0;
   InitOnce();
-  auto conn = ptpu::net::Conn::Detached();
-  (void)g_srv->OnFrame(conn, data, uint32_t(size));
+  // Replay at every misalignment 0..7 (ISSUE 17): handlers parse
+  // payloads in place in the reassembly buffer, where a frame lands
+  // at whatever offset the preceding stream left — the unaligned-safe
+  // codecs must hold (under ASan/UBSan) at every shift.
+  std::vector<uint8_t> shifted(size + 8);
+  for (size_t s = 0; s < 8; ++s) {
+    if (size) std::memcpy(shifted.data() + s, data, size);
+    auto conn = ptpu::net::Conn::Detached();
+    (void)g_srv->OnFrame(conn, shifted.data() + s, uint32_t(size));
+  }
   return 0;
 }

@@ -25,6 +25,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
+#include <cstring>
 #include <string>
 #include <vector>
 
@@ -164,12 +165,20 @@ void InitOnce() {
 extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
   if (size > (1u << 20)) return 0;
   InitOnce();
-  auto conn = ptpu::net::Conn::Detached();
-  (void)g_srv->OnFrame(conn, data, uint32_t(size));
-  // a kDefer stash is normally freed by the net core's on_close hook;
-  // a Detached conn has no loop, so mirror that hook here
-  delete static_cast<SvRequest*>(conn->user);
-  conn->user = nullptr;
-  g_srv->DecodeConnClosed(conn.get());
+  // Replay at every misalignment 0..7 (ISSUE 17): the parser reads
+  // payloads in place in the reassembly buffer, where a frame lands
+  // at whatever offset the preceding stream left — the unaligned-safe
+  // codecs must hold (under ASan/UBSan) at every shift.
+  std::vector<uint8_t> shifted(size + 8);
+  for (size_t s = 0; s < 8; ++s) {
+    if (size) std::memcpy(shifted.data() + s, data, size);
+    auto conn = ptpu::net::Conn::Detached();
+    (void)g_srv->OnFrame(conn, shifted.data() + s, uint32_t(size));
+    // a kDefer stash is normally freed by the net core's on_close
+    // hook; a Detached conn has no loop, so mirror that hook here
+    delete static_cast<SvRequest*>(conn->user);
+    conn->user = nullptr;
+    g_srv->DecodeConnClosed(conn.get());
+  }
   return 0;
 }

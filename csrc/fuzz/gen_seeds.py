@@ -247,6 +247,17 @@ def main():
       bytes([1, 0x54]) + struct.pack("<I", 3) + b"boo")
     w("wire_ps", "seed-truncated.bin", ps_pull()[:9])
     w("wire_ps", "seed-bad-version.bin", bytes([9, 0x50]) + b"\x01t")
+    # in-place parse misalignment sweep (ISSUE 17): the table-name
+    # length shifts every i64 id / f32 val offset, so names of length
+    # 1..8 land the PULL id block (payload offset 7 + len) at every
+    # misalignment 0..7 — the harness additionally replays each seed
+    # at buffer shifts 0..7, covering the full cross product
+    for k in range(1, 9):
+        name = b"t" + b"x" * (k - 1)
+        w("wire_ps", f"seed-pull-misalign-{k - 1}.bin",
+          ps_pull(table=name, ids=(0, 1)))
+        w("wire_ps", f"seed-push-misalign-{k - 1}.bin",
+          ps_push(table=name, ids=(1, 2), dim=4))
 
     # ---- wire_serving ----
     w("wire_serving", "seed-meta.bin", sv_plain(0x63))
@@ -330,6 +341,16 @@ def main():
       bytes([1, 0x68]) + struct.pack("<QQI", 1, 2, 1) +
       struct.pack("<f", 0.0))
     w("wire_serving", "seed-bad-version.bin", bytes([7, 0x60]))
+    # in-place ingestion seeds (ISSUE 17): the parser borrows views
+    # straight out of the reassembly buffer — multi-row payloads walk
+    # the borrowed region end to end, and the v1/v2 pair shifts every
+    # body offset by the 8-byte trace ext (the harness replays each
+    # at buffer shifts 0..7)
+    w("wire_serving", "seed-infer-b4.bin", sv_infer(rows=4))
+    w("wire_serving", "seed-infer-b4-v2.bin",
+      sv_infer(rows=4, ver=2, tid=0x77))
+    w("wire_serving", "seed-infer-short-payload.bin",
+      sv_infer(rows=2)[:-3])
 
     # ---- http ----
     def req(line, hdrs=b"Host: x\r\n"):
@@ -393,6 +414,15 @@ def main():
     w("frames", "seed-preauth-huge-claim.bin",
       b"\x00" + struct.pack("<I", 0x7FFFFFFF))
     w("frames", "seed-preauth-partial.bin", b"\x00\x05\x00")
+    # reassembly misalignment + split seeds (ISSUE 17): a k-byte pad
+    # frame ahead of the echo frame lands the second payload at every
+    # in-buffer misalignment 0..7; the harness's split point is
+    # derived from the first body byte (the pad frame's length low
+    # byte == k), so the two-write seam also sweeps across the length
+    # prefix and payload of the second frame as k varies
+    for k in range(8):
+        w("frames", f"seed-auth-misalign-{k}.bin",
+          b"\x01" + frame(b"p" * k) + frame(b"hello"))
 
     # ---- tune (persisted autotuning cache, ISSUE 16) ----
     w("tune", "seed-valid.bin", tune_cache([

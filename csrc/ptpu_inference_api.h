@@ -89,6 +89,39 @@ int ptpu_predictor_output_ndim(PTPU_Predictor*, int i);
 const int64_t* ptpu_predictor_output_dims(PTPU_Predictor*, int i);
 const float* ptpu_predictor_output_data(PTPU_Predictor*, int i);
 
+/* Zero-copy serving hooks (ISSUE 17). input_alloc resolves the named
+ * input at the given dims and returns its WRITABLE storage so callers
+ * gather wire rows straight into the batch tensor (one pass instead
+ * of stage-memcpy + set_input copy): f32 storage is float[numel],
+ * i32/i64 storage is the predictor's internal int64[numel] (i32
+ * callers widen as they write, matching set_input_i32). Storage is
+ * reused across calls; every element (pad rows too) must be written
+ * before run(). Returns NULL + err on bad name/dtype/dims. */
+void* ptpu_predictor_input_alloc(PTPU_Predictor*, const char* name,
+                                 int dtype, const int64_t* dims,
+                                 int ndim, char* err, int err_len);
+
+/* Detach the last run's outputs into a refcounted pin: the returned
+ * handle keeps every output's storage alive (integer outputs already
+ * converted to f32) until pin_release, independent of later runs on
+ * the predictor — reply frames point writev iovecs at pin_data and
+ * release when the net core reports the final byte flushed. NULL when
+ * the last run produced no outputs. detach follows run()'s thread
+ * contract; the pin accessors and pin_release are thread-safe. */
+void* ptpu_predictor_outputs_detach(PTPU_Predictor*);
+int ptpu_outputs_pin_count(void* pin);
+const float* ptpu_outputs_pin_data(void* pin, int i);
+int ptpu_outputs_pin_ndim(void* pin, int i);
+const int64_t* ptpu_outputs_pin_dims(void* pin, int i);
+void ptpu_outputs_pin_release(void* pin);
+
+/* workpool_create with NUMA placement (ISSUE 17c): worker threads are
+ * spawned while the creating thread is bound to `node`'s CPU set and
+ * inherit that mask. node < 0, a single-node box, or PTPU_TOPO=0
+ * degrade to plain ptpu_workpool_create behavior (no affinity
+ * syscalls at all). */
+void* ptpu_workpool_create_bound(int threads, int node);
+
 /* ------------------------------------------------------------------ */
 /* KV-cached autoregressive decode (r9). A decode-step artifact
  * (paddle_tpu.models.gpt.export_gpt_decode) follows the convention

@@ -378,18 +378,9 @@ class EventLoop {
     // its event thread — level-triggered epoll re-delivers the rest
     int64_t budget = 1 << 20;
     while (budget > 0) {
-      if (c->in_.size() - c->in_tail_ < kReadChunk) {
-        if (c->in_head_ > 0) {  // compact before growing
-          std::memmove(c->in_.data(), c->in_.data() + c->in_head_,
-                       c->in_tail_ - c->in_head_);
-          c->in_tail_ -= c->in_head_;
-          c->in_head_ = 0;
-        }
-        if (c->in_.size() - c->in_tail_ < kReadChunk)
-          c->in_.resize(c->in_tail_ + kReadChunk);
-      }
-      const ssize_t r = ::read(c->fd_, c->in_.data() + c->in_tail_,
-                               c->in_.size() - c->in_tail_);
+      c->ReserveIn(kReadChunk);
+      const ssize_t r = ::read(c->fd_, c->in_->data() + c->in_tail_,
+                               c->in_->size() - c->in_tail_);
       if (r == 0) {
         CloseConn(c, CloseWhy::kAuto);
         return;
@@ -411,7 +402,7 @@ class EventLoop {
       }
       if (c->read_paused_) return;
     }
-    if (c->in_head_ == c->in_tail_) c->in_head_ = c->in_tail_ = 0;
+    c->MaybeResetIn();
   }
 
   // Dispatch every complete frame in the buffer. Returns false when
@@ -420,7 +411,7 @@ class EventLoop {
     while (c->state_ != Conn::St::kClosed && !c->read_paused_) {
       const size_t avail = c->in_tail_ - c->in_head_;
       if (avail < 4) break;
-      const uint32_t n = GetU32(c->in_.data() + c->in_head_);
+      const uint32_t n = GetU32(c->in_->data() + c->in_head_);
       if (n > opt_.max_frame) {
         if (cbs_.on_oversize) cbs_.on_oversize(c->shared_from_this());
         CloseConn(c, CloseWhy::kAuto);
@@ -436,17 +427,10 @@ class EventLoop {
       }
       if (avail - 4 < n) {
         // make room for the whole frame so the next reads can land
-        if (c->in_.size() - c->in_head_ < size_t(n) + 4) {
-          std::memmove(c->in_.data(), c->in_.data() + c->in_head_,
-                       avail);
-          c->in_tail_ = avail;
-          c->in_head_ = 0;
-          if (c->in_.size() < size_t(n) + 4)
-            c->in_.resize(size_t(n) + 4);
-        }
+        c->ReserveIn(size_t(n) + 4 - avail);
         break;
       }
-      const uint8_t* payload = c->in_.data() + c->in_head_ + 4;
+      const uint8_t* payload = c->in_->data() + c->in_head_ + 4;
       if (c->state_ == Conn::St::kAwaitMac) {
         if (!CheckMac(c, payload, n)) {
           CloseConn(c, CloseWhy::kAuto);  // pre-open: handshake_fails
@@ -472,7 +456,7 @@ class EventLoop {
         if (have) FlushConn(c);
       }
     }
-    if (c->in_head_ == c->in_tail_) c->in_head_ = c->in_tail_ = 0;
+    c->MaybeResetIn();
     return true;
   }
 
@@ -599,7 +583,7 @@ class EventLoop {
   bool ParseHttp(Conn* c) {
     for (;;) {
       const char* data =
-          reinterpret_cast<const char*>(c->in_.data() + c->in_head_);
+          reinterpret_cast<const char*>(c->in_->data() + c->in_head_);
       const size_t avail = c->in_tail_ - c->in_head_;
       if (avail == 0) break;
       const size_t hdr_end = HttpHeaderEnd(data, avail);
@@ -654,7 +638,7 @@ class EventLoop {
       }
       if (c->state_ == Conn::St::kClosed) return false;
     }
-    if (c->in_head_ == c->in_tail_) c->in_head_ = c->in_tail_ = 0;
+    c->MaybeResetIn();
     return true;
   }
 
@@ -671,6 +655,31 @@ class EventLoop {
 
   // --------------------------------------------------------- writes
 
+  // Append the unflushed tail of `ob` (owned head bytes, then any
+  // scatter segments) to `iov`; returns the new count (<= kFlushIov).
+  static int GatherIov(const Conn::OutBuf& ob, iovec* iov, int cnt) {
+    size_t skip = ob.off;
+    if (skip < ob.b.size()) {
+      iov[cnt].iov_base = const_cast<uint8_t*>(ob.b.data()) + skip;
+      iov[cnt].iov_len = ob.b.size() - skip;
+      if (++cnt == kFlushIov) return cnt;
+      skip = 0;
+    } else {
+      skip -= ob.b.size();
+    }
+    for (const OutSeg& s : ob.segs) {
+      if (skip >= s.n) {
+        skip -= s.n;
+        continue;
+      }
+      iov[cnt].iov_base = const_cast<uint8_t*>(s.p) + skip;
+      iov[cnt].iov_len = s.n - skip;
+      skip = 0;
+      if (++cnt == kFlushIov) return cnt;
+    }
+    return cnt;
+  }
+
   void FlushConn(Conn* c) {
     UniqueLock g(c->omu_);
     c->flush_posted_ = false;
@@ -679,10 +688,8 @@ class EventLoop {
       iovec iov[kFlushIov];
       int cnt = 0;
       for (auto it = c->outq_.begin();
-           it != c->outq_.end() && cnt < kFlushIov; ++it, ++cnt) {
-        iov[cnt].iov_base = it->b.data() + it->off;
-        iov[cnt].iov_len = it->b.size() - it->off;
-      }
+           it != c->outq_.end() && cnt < kFlushIov; ++it)
+        cnt = GatherIov(*it, iov, cnt);
       const ssize_t w = ::writev(c->fd_, iov, cnt);
       if (w < 0) {
         if (errno == EINTR) continue;
@@ -693,13 +700,16 @@ class EventLoop {
       c->out_bytes_ -= std::min(left, c->out_bytes_);
       while (left > 0 && !c->outq_.empty()) {
         Conn::OutBuf& ob = c->outq_.front();
-        const size_t rem = ob.b.size() - ob.off;
+        const size_t rem = ob.total() - ob.off;
         if (left >= rem) {
           left -= rem;
           if (ob.trace_id)  // net.flush span: queued -> last byte out
             trace::Global().Record(ob.trace_id, trace::kFlush,
                                    ob.t_queued, NowUs(), c->id_,
                                    ob.trace_arg);
+          // ob.pin releases with the pop: the arena output block (or
+          // pinned reassembly buffer) behind the segments is reusable
+          // the instant its last byte is on the wire
           if (c->pool_.size() < kPoolCap &&
               ob.b.capacity() <= kPoolMaxBufBytes) {
             ob.b.clear();
@@ -796,9 +806,9 @@ class EventLoop {
       if (c->state_ != Conn::St::kOpen || !c->defer_since_) continue;
       const size_t avail = c->in_tail_ - c->in_head_;
       if (avail < 4) continue;  // defensive: defer always holds a frame
-      const uint32_t n = GetU32(c->in_.data() + c->in_head_);
+      const uint32_t n = GetU32(c->in_->data() + c->in_head_);
       c->read_paused_ = false;  // let DispatchFrame re-pause on kDefer
-      if (DispatchFrame(c, c->in_.data() + c->in_head_ + 4, n)) {
+      if (DispatchFrame(c, c->in_->data() + c->in_head_ + 4, n)) {
         if (!c->read_paused_ && c->state_ == Conn::St::kOpen) {
           ArmEpoll(c);
           ParseFrames(c);  // consume any frames queued behind it
@@ -933,8 +943,8 @@ class EventLoop {
 // Conn
 // ---------------------------------------------------------------------------
 
-// Shared enqueue/backpressure/flush-post body of both send forms.
-bool Conn::EnqueueOut(std::vector<uint8_t>&& buf, uint64_t trace_id,
+// Shared enqueue/backpressure/flush-post body of every send form.
+bool Conn::EnqueueOut(OutBuf&& ob, uint64_t trace_id,
                       uint64_t trace_arg) {
   EventLoop* loop = loop_;
   bool post_remote = false, post_local = false, kill = false;
@@ -946,15 +956,15 @@ bool Conn::EnqueueOut(std::vector<uint8_t>&& buf, uint64_t trace_id,
       // its replies without bound (old SO_SNDTIMEO semantics). The
       // check is >= BEFORE adding, so a single protocol-legal frame
       // of any size (up to max_frame) always queues — the cap bounds
-      // ACCUMULATION across frames, never one reply.
+      // ACCUMULATION across frames, never one reply. Dropping the
+      // queue also releases every scatter pin still waiting on this
+      // dead peer.
       closed_ = true;
       outq_.clear();
       out_bytes_ = 0;
       kill = true;
     } else {
-      out_bytes_ += buf.size();
-      OutBuf ob;
-      ob.b = std::move(buf);
+      out_bytes_ += ob.total();
       if (trace_id) {
         ob.trace_id = trace_id;
         ob.trace_arg = trace_arg;
@@ -984,13 +994,32 @@ bool Conn::SendPayload(std::vector<uint8_t>&& buf, uint64_t trace_id,
                        uint64_t trace_arg) {
   if (buf.size() < 4) return false;
   PutU32(buf.data(), uint32_t(buf.size() - 4));
-  return EnqueueOut(std::move(buf), trace_id, trace_arg);
+  OutBuf ob;
+  ob.b = std::move(buf);
+  return EnqueueOut(std::move(ob), trace_id, trace_arg);
+}
+
+bool Conn::SendScatter(std::vector<uint8_t>&& head,
+                       std::vector<OutSeg>&& segs,
+                       std::shared_ptr<void> pin, uint64_t trace_id,
+                       uint64_t trace_arg) {
+  if (head.size() < 4) return false;
+  OutBuf ob;
+  for (const OutSeg& s : segs) ob.seg_bytes += s.n;
+  PutU32(head.data(),
+         uint32_t(head.size() - 4 + ob.seg_bytes));
+  ob.b = std::move(head);
+  ob.segs = std::move(segs);
+  ob.pin = std::move(pin);
+  return EnqueueOut(std::move(ob), trace_id, trace_arg);
 }
 
 bool Conn::SendRaw(std::vector<uint8_t>&& buf) {
   // verbatim bytes (HTTP): same queue/flush path, no length prefix
   if (buf.empty()) return false;
-  return EnqueueOut(std::move(buf), 0, 0);
+  OutBuf ob;
+  ob.b = std::move(buf);
+  return EnqueueOut(std::move(ob), 0, 0);
 }
 
 bool Conn::SendCopy(const uint8_t* payload, size_t n) {
@@ -998,6 +1027,37 @@ bool Conn::SendCopy(const uint8_t* payload, size_t n) {
   buf.resize(4 + n);
   std::memcpy(buf.data() + 4, payload, n);
   return SendPayload(std::move(buf));
+}
+
+// Make room for at least `need` writable bytes at in_tail_. The
+// unpinned case compacts/grows in place exactly as before; while a
+// frame handler holds a PinInbuf reference (use_count > 1) the bytes
+// must NOT move, so a fresh buffer takes over and only the unparsed
+// tail is carried across — the pinned buffer stays alive, immutable,
+// until the last pin drops.
+void Conn::ReserveIn(size_t need) {
+  if (in_->size() - in_tail_ >= need) return;
+  const size_t live = in_tail_ - in_head_;
+  if (in_.use_count() > 1) {
+    auto fresh = std::make_shared<std::vector<uint8_t>>();
+    fresh->resize(std::max(live + need, size_t(kReadChunk)));
+    std::memcpy(fresh->data(), in_->data() + in_head_, live);
+    in_ = std::move(fresh);
+  } else {
+    if (in_head_ > 0)
+      std::memmove(in_->data(), in_->data() + in_head_, live);
+    if (in_->size() < live + need) in_->resize(live + need);
+  }
+  in_head_ = 0;
+  in_tail_ = live;
+}
+
+std::shared_ptr<const void> Conn::PinInbuf(const uint8_t* payload,
+                                           size_t n) {
+  if (in_tail_ == 0) return nullptr;  // Detached conn: nothing buffered
+  const uint8_t* base = in_->data();
+  if (payload < base || payload + n > base + in_tail_) return nullptr;
+  return std::shared_ptr<const void>(in_, in_->data());
 }
 
 std::vector<uint8_t> Conn::AcquireBuf() {

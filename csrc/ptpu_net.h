@@ -126,6 +126,14 @@ enum class FrameResult {
 class EventLoop;
 class Server;
 
+// One external segment of a scatter reply (SendScatter): `n` wire
+// bytes read straight from `p` — typically a predictor arena output
+// block — without ever being copied into a reply buffer.
+struct OutSeg {
+  const uint8_t* p = nullptr;
+  size_t n = 0;
+};
+
 class Conn : public std::enable_shared_from_this<Conn> {
  public:
   // Queue one frame for sending: buf = [4 reserved bytes][payload];
@@ -138,6 +146,18 @@ class Conn : public std::enable_shared_from_this<Conn> {
                    uint64_t trace_arg = 0);
   // Convenience copy form for small frames (errors, acks, meta).
   bool SendCopy(const uint8_t* payload, size_t n);
+  // Scatter send (zero-copy replies): the frame's wire bytes are
+  // head[4..] followed by every segment in order, written with the
+  // same coalescing writev as SendPayload — the segments are never
+  // copied. `head` = [4 reserved bytes][header fields]; the u32-LE
+  // length prefix (covering head payload + all segments) is written
+  // here. `pin` keeps the memory behind every segment alive until
+  // the net core has flushed the frame's last byte (or the conn
+  // dies: close/backpressure-kill drop the queue and release it).
+  // Thread-safe.
+  bool SendScatter(std::vector<uint8_t>&& head,
+                   std::vector<OutSeg>&& segs, std::shared_ptr<void> pin,
+                   uint64_t trace_id = 0, uint64_t trace_arg = 0);
   // Verbatim bytes, NO u32 length prefix (HTTP responses). Same
   // queue/flush/backpressure path as SendPayload. Thread-safe.
   bool SendRaw(std::vector<uint8_t>&& buf);
@@ -150,6 +170,18 @@ class Conn : public std::enable_shared_from_this<Conn> {
   // (0 on first dispatch) — handlers budget their kDefer retries
   // against this. Owner-loop only (valid inside the frame handler).
   int64_t deferred_us() const;
+
+  // Zero-copy ingestion: pin the reassembly buffer backing the
+  // currently-dispatched frame so `payload` stays valid after the
+  // handler returns (kDefer stashes, micro-batcher gathers straight
+  // from the wire bytes). While any pin is live the buffer is
+  // append-only — the event loop swaps in a fresh buffer instead of
+  // compacting/growing in place, so pinned pointers never move.
+  // Returns nullptr when `payload` does not live in this conn's
+  // buffer (a Detached fuzz conn pumping foreign memory): callers
+  // must copy then. Owner-loop only (valid inside the frame handler).
+  std::shared_ptr<const void> PinInbuf(const uint8_t* payload,
+                                       size_t n);
 
   // Stable per-connection id (monotonic across the process), stamped
   // at accept — the `conn` field of every trace span. Thread-safe.
@@ -185,16 +217,20 @@ class Conn : public std::enable_shared_from_this<Conn> {
   friend class EventLoop;
   friend class Server;
 
-  // shared enqueue/backpressure/flush-post body of SendPayload/SendRaw
-  bool EnqueueOut(std::vector<uint8_t>&& buf, uint64_t trace_id,
-                  uint64_t trace_arg);
-
   struct OutBuf {
-    std::vector<uint8_t> b;
-    size_t off = 0;
+    std::vector<uint8_t> b;       // owned head bytes (whole frame when
+                                  // segs is empty)
+    std::vector<OutSeg> segs;     // external scatter segments after b
+    size_t seg_bytes = 0;         // sum of segs[i].n
+    std::shared_ptr<void> pin;    // keeps segment memory alive
+    size_t off = 0;               // flushed offset into b ++ segs
     uint64_t trace_id = 0, trace_arg = 0;  // net.flush span (if traced)
     int64_t t_queued = 0;
+    size_t total() const { return b.size() + seg_bytes; }
   };
+
+  // shared enqueue/backpressure/flush-post body of all send forms
+  bool EnqueueOut(OutBuf&& ob, uint64_t trace_id, uint64_t trace_arg);
 
   // ---- accept-time constants (never change after adoption) ----
   uint64_t id_ = 0;     // process-wide monotonic connection id
@@ -206,8 +242,21 @@ class Conn : public std::enable_shared_from_this<Conn> {
   enum class St { kAwaitMac, kOpen, kClosed };
   St state_ = St::kAwaitMac;
   uint8_t nonce_[16] = {0};
-  std::vector<uint8_t> in_;
+  // Reassembly buffer, shared so PinInbuf can extend its lifetime
+  // past the frame handler's return. use_count() > 1 means pinned:
+  // ReserveIn/MaybeResetIn then swap in a fresh buffer rather than
+  // moving bytes (appends at in_tail_ never move existing data).
+  std::shared_ptr<std::vector<uint8_t>> in_ =
+      std::make_shared<std::vector<uint8_t>>();
   size_t in_head_ = 0, in_tail_ = 0;
+  // Ensure >= need writable bytes after in_tail_ (compact or grow;
+  // pin-aware). MaybeResetIn rewinds head/tail to 0 when the buffer
+  // is fully parsed AND unpinned. Owner-loop only.
+  void ReserveIn(size_t need);
+  void MaybeResetIn() {
+    if (in_head_ == in_tail_ && in_.use_count() == 1)
+      in_head_ = in_tail_ = 0;
+  }
   int64_t frame_t0_ = 0;  // first bytes of the pending frame read at
   bool want_write_ = false;     // EPOLLOUT armed
   bool read_paused_ = false;    // EPOLLIN disarmed (kDefer)

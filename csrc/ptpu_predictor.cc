@@ -40,6 +40,7 @@
 #include "ptpu_schedck.h"
 #include "ptpu_stats.h"
 #include "ptpu_sync.h"
+#include "ptpu_topo.h"
 #include "ptpu_tune.h"
 
 namespace {
@@ -6998,6 +6999,39 @@ void fill_error(char* err, int err_len, const std::string& msg) {
   }
 }
 
+/* ---- zero-copy reply pinning (ISSUE 17b) --------------------------
+ * run() deep-copies every output tensor out of the planned arena into
+ * owned heap storage (Buf copy semantics), so "pinning the run's
+ * output block" is a MOVE: ptpu_predictor_outputs_detach transfers
+ * the outputs vector into a refcounted holder, the serving layer
+ * points reply iovecs straight at ptpu_outputs_pin_data, and the
+ * holder returns to a small bounded free list when the net core
+ * reports the last reply byte flushed. The free-list lock is its own
+ * class: release runs on net event threads while the conn's output
+ * lock (net.conn_out, rank 100) is held, so pred.outpin ranks above
+ * it (105) and below net.inbox (110). */
+PTPU_LOCK_CLASS(kLockPredOutpin, "pred.outpin", 105);
+
+struct OutPin {
+  std::vector<Tensor> outs;
+};
+
+ptpu::Mutex g_outpin_mu{kLockPredOutpin};
+std::vector<std::unique_ptr<OutPin>> g_outpin_free;
+constexpr size_t kOutPinPoolCap = 16;
+
+OutPin* outpin_acquire() {
+  {
+    ptpu::MutexLock l(g_outpin_mu);
+    if (!g_outpin_free.empty()) {
+      OutPin* p = g_outpin_free.back().release();
+      g_outpin_free.pop_back();
+      return p;
+    }
+  }
+  return new OutPin();
+}
+
 }  // namespace
 
 // -------------------------------------------------------------------- C ABI
@@ -7687,6 +7721,133 @@ const float* ptpu_predictor_output_data(PTPU_Predictor* h, int i) {
     for (int64_t k = 0; k < t.numel(); ++k) t.f[size_t(k)] = float(t.i[k]);
   }
   return t.f.data();
+}
+
+/* ---- zero-copy serving hooks (ISSUE 17) ---------------------------
+ * input_alloc: resolve the named graph input at the given dims and
+ * hand back its WRITABLE storage — the serving gather writes wire
+ * rows straight into the batch tensor, collapsing the old
+ * stage-buffer memcpy + set_input copy into one pass. f32 returns
+ * float storage; i32/i64 return the predictor's internal int64
+ * storage (i32 callers widen as they gather, exactly the widening
+ * set_input_i32 performed on its copy). The tensor is reused across
+ * calls, so steady-state batches allocate nothing. The caller must
+ * fill every element (pad rows included) before run(). */
+__attribute__((visibility("default")))
+void* ptpu_predictor_input_alloc(PTPU_Predictor* h, const char* name,
+                                 int dtype, const int64_t* dims,
+                                 int ndim, char* err, int err_len) {
+  try {
+    if (!h || !name)
+      throw std::runtime_error("input_alloc: null handle or name");
+    if (dtype != DT_F32 && dtype != DT_I32 && dtype != DT_I64)
+      throw std::runtime_error("input_alloc: unsupported dtype " +
+                               std::to_string(dtype));
+    check_dims(dims, ndim);
+    auto* p = (Predictor*)h;
+    Tensor& t = p->env[name];
+    t.dtype = dtype;
+    t.dims.assign(dims, dims + ndim);
+    const size_t n = size_t(t.numel());
+    if (t.is_float()) {
+      t.i.resize(0);
+      t.f.resize(n);
+      return t.f.data();
+    }
+    t.f.resize(0);
+    t.i.resize(n);
+    return t.i.data();
+  } catch (const std::exception& e) {
+    fill_error(err, err_len, e.what());
+    return nullptr;
+  }
+}
+
+/* Detach the last run's outputs into a refcounted pin holder (see the
+ * OutPin notes above): after this call the predictor's own
+ * output_data/output_dims views are empty until the next run, and the
+ * returned handle keeps every output's storage alive until
+ * ptpu_outputs_pin_release — reply frames point writev iovecs at
+ * pin_data and release on flush completion. Returns NULL when the
+ * last run produced no outputs. Same thread-compatibility contract as
+ * run(); the pin accessors and release are thread-safe. */
+__attribute__((visibility("default")))
+void* ptpu_predictor_outputs_detach(PTPU_Predictor* h) {
+  auto* p = (Predictor*)h;
+  if (!p || p->outputs.empty()) return nullptr;
+  // int outputs convert once here (output_data's rule) so pin_data
+  // stays a const read from any thread
+  for (auto& t : p->outputs) {
+    if (!t.is_float() && t.f.size() != size_t(t.numel())) {
+      t.f.resize(size_t(t.numel()));
+      for (int64_t k = 0; k < t.numel(); ++k)
+        t.f[size_t(k)] = float(t.i[k]);
+    }
+  }
+  OutPin* pin = outpin_acquire();
+  pin->outs = std::move(p->outputs);
+  p->outputs.clear();
+  return pin;
+}
+
+__attribute__((visibility("default")))
+int ptpu_outputs_pin_count(void* pin) {
+  auto* p = (OutPin*)pin;
+  return p ? int(p->outs.size()) : 0;
+}
+
+// f32 view of pinned output i (ints were converted at detach)
+__attribute__((visibility("default")))
+const float* ptpu_outputs_pin_data(void* pin, int i) {
+  auto* p = (OutPin*)pin;
+  if (!p || i < 0 || size_t(i) >= p->outs.size()) return nullptr;
+  return p->outs[size_t(i)].f.data();
+}
+
+__attribute__((visibility("default")))
+int ptpu_outputs_pin_ndim(void* pin, int i) {
+  auto* p = (OutPin*)pin;
+  if (!p || i < 0 || size_t(i) >= p->outs.size()) return -1;
+  return int(p->outs[size_t(i)].dims.size());
+}
+
+__attribute__((visibility("default")))
+const int64_t* ptpu_outputs_pin_dims(void* pin, int i) {
+  auto* p = (OutPin*)pin;
+  if (!p || i < 0 || size_t(i) >= p->outs.size()) return nullptr;
+  return p->outs[size_t(i)].dims.data();
+}
+
+// Release a pin: tensor storage frees now; the holder itself recycles
+// through the bounded free list (pred.outpin). Safe on any thread —
+// the serving layer calls it from net event threads as the flush-
+// completion signal fires.
+__attribute__((visibility("default")))
+void ptpu_outputs_pin_release(void* pin) {
+  auto* p = (OutPin*)pin;
+  if (!p) return;
+  p->outs.clear();
+  {
+    ptpu::MutexLock l(g_outpin_mu);
+    if (g_outpin_free.size() < kOutPinPoolCap) {
+      g_outpin_free.emplace_back(p);
+      return;
+    }
+  }
+  delete p;  // pool full
+}
+
+/* Topology-aware pool creation (ISSUE 17c): bind the CREATING thread
+ * to `node`'s CPU set before spawning — worker threads inherit the
+ * creator's affinity mask — then restore it. node < 0, a single-node
+ * box, or PTPU_TOPO=0 degrade to plain creation with no affinity
+ * syscalls at all (the ptpu_topo.h probe gate). */
+__attribute__((visibility("default")))
+void* ptpu_workpool_create_bound(int threads, int node) {
+  ptpu::topo::BindCurrentThreadToNode(node);
+  WorkPool* p = new WorkPool(threads > 0 ? threads - 1 : 0);
+  ptpu::topo::UnbindCurrentThread();
+  return p;
 }
 
 }  // extern "C"

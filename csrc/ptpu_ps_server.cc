@@ -94,9 +94,14 @@ struct TableWireStats {
 struct ServerStats {
   ptpu::Counter pull_ops, pull_rows, push_ops, push_rows, bytes_in,
       bytes_out, err_frames, proto_errors;
+  // CPU microseconds the event threads burned inside OnFrame
+  // (ThreadCpuUs deltas, ISSUE 17): cpu_us / (pull_ops + push_ops)
+  // is the PS bench's cycles-per-request column.
+  ptpu::Counter cpu_us;
   ptpu::Histogram pull_us, push_us;  // frame-read -> reply-queued
 
   void Reset() {
+    cpu_us.Reset();
     pull_ops.Reset();
     pull_rows.Reset();
     push_ops.Reset();
@@ -198,6 +203,13 @@ struct PsServer {
   ptpu::net::FrameResult OnFrame(const ptpu::net::ConnPtr &conn,
                                  const uint8_t *req, uint32_t n) {
     using ptpu::net::FrameResult;
+    // scope-aggregate this frame's event-thread CPU into cpu_us
+    // (cycles-per-request telemetry, ISSUE 17)
+    struct CpuScope {
+      ptpu::Counter *c;
+      int64_t t0;
+      ~CpuScope() { c->Add(uint64_t(ptpu::ThreadCpuUs() - t0)); }
+    } cpu{&stats.cpu_us, ptpu::ThreadCpuUs()};
     const auto proto_err = [this]() {
       stats.proto_errors.Add(1);
       return FrameResult::kClose;
@@ -421,6 +433,7 @@ std::string PsServer::StatsJson() {
       {"push_ops", &st.push_ops},       {"push_rows", &st.push_rows},
       {"bytes_in", &st.bytes_in},       {"bytes_out", &st.bytes_out},
       {"err_frames", &st.err_frames},   {"proto_errors", &st.proto_errors},
+      {"cpu_us", &st.cpu_us},
       {"handshake_fails", &nt.handshake_fails},
       {"conns_accepted", &nt.conns_accepted},
       {"conns_shed", &nt.conns_shed},

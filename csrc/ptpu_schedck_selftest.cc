@@ -61,6 +61,7 @@ PTPU_LOCK_CLASS(kClsRtQueue, "rt.queue", 82);
 PTPU_LOCK_CLASS(kClsRtProfiler, "rt.profiler", 84);
 PTPU_LOCK_CLASS(kClsRtStats, "rt.stats", 86);
 PTPU_LOCK_CLASS(kClsNetConnOut, "net.conn_out", 100);
+PTPU_LOCK_CLASS(kClsPredOutpin, "pred.outpin", 105);
 PTPU_LOCK_CLASS(kClsNetInbox, "net.inbox", 110);
 // engine-unit-test-only class, above every production rank
 PTPU_LOCK_CLASS(kClsSckUnit, "schedck.unit", 230);
@@ -561,6 +562,81 @@ void ConnOutScenario(int senders, int msgs_each) {
   SCHEDCK_ASSERT(st.written + st.dropped == st.accepted);
 }
 
+// --- pred.outpin: output-pin recycle vs reply flush ----------------
+// Mirrors the predictor's detached-output holder pool (ISSUE 17b):
+// batch workers pop a holder from the bounded free list under
+// pred.outpin (or allocate fresh) and queue pinned replies on a conn;
+// the event loop pops replies under the conn's output lock and drops
+// the LAST reference there — so the release's free-list lock nests
+// inside net.conn_out (100 -> 105, ascending). Invariants: every
+// acquired holder is recycled or freed exactly once, none leak, and
+// the pool never exceeds its cap.
+void OutpinScenario(int workers, int per_worker) {
+  struct St {
+    ptpu::Mutex out{kClsNetConnOut};
+    ptpu::Mutex pin{kClsPredOutpin};
+    int cap = 1;  // bounded pool (kOutPinPoolCap)
+    int free_n = 0;
+    int live = 0, acquired = 0, recycled = 0, freed = 0;
+    std::deque<int> flushq;  // pinned replies queued on the conn
+  } st;
+  const auto release_one = [&st] {
+    // drop the last reference with net.conn_out held, exactly like
+    // FlushConn popping a scatter OutBuf
+    ptpu::MutexLock p(st.pin);
+    --st.live;
+    if (st.free_n < st.cap) {
+      ++st.free_n;
+      ++st.recycled;
+    } else {
+      ++st.freed;
+    }
+  };
+  std::vector<sck::Thread> ws;
+  for (int w = 0; w < workers; ++w) {
+    ws.emplace_back([&st, per_worker] {
+      for (int i = 0; i < per_worker; ++i) {
+        {
+          // outpin_acquire: pool pop, else fresh allocation
+          ptpu::MutexLock p(st.pin);
+          if (st.free_n > 0) --st.free_n;
+          ++st.acquired;
+          ++st.live;
+        }
+        PTPU_SCHED_POINT();  // batch ran, reply not yet queued
+        ptpu::MutexLock g(st.out);
+        st.flushq.push_back(i);
+      }
+    });
+  }
+  sck::Thread loop([&st, &release_one] {
+    for (int round = 0; round < 3; ++round) {
+      {
+        ptpu::MutexLock g(st.out);
+        while (!st.flushq.empty()) {
+          st.flushq.pop_front();
+          release_one();
+        }
+      }
+      PTPU_SCHED_POINT();  // between flush rounds
+    }
+  });
+  for (auto& t : ws) t.join();
+  loop.join();
+  {
+    // stragglers queued after the last flush release at conn close
+    // (FinishClose clears outq_ — same release path)
+    ptpu::MutexLock g(st.out);
+    while (!st.flushq.empty()) {
+      st.flushq.pop_front();
+      release_one();
+    }
+  }
+  SCHEDCK_ASSERT(st.live == 0);
+  SCHEDCK_ASSERT(st.recycled + st.freed == st.acquired);
+  SCHEDCK_ASSERT(st.free_n <= st.cap);
+}
+
 // --- rt.arena / rt.queue / rt.profiler / rt.stats ------------------
 // Mirrors the runtime: workers bump-allocate ids from the arena, push
 // completions, and tick profiler + stats — always in ascending rank
@@ -969,6 +1045,8 @@ void RunScenarios() {
        [] { NetInboxScenario(2, 2); }},
       {"net_connout_flush_vs_close", [] { ConnOutScenario(1, 2); },
        [] { ConnOutScenario(2, 3); }},
+      {"outpin_recycle_vs_flush", [] { OutpinScenario(2, 1); },
+       [] { OutpinScenario(2, 3); }},
       {"runtime_arena_queue", [] { RuntimeLocksScenario(1, 2); },
        [] { RuntimeLocksScenario(2, 2); }},
       {"tune_probe_insert_save", [] { TuneRegistryScenario(2, 1); },

@@ -73,6 +73,7 @@
 #include "ptpu_schedck.h"
 #include "ptpu_stats.h"
 #include "ptpu_sync.h"
+#include "ptpu_topo.h"
 #include "ptpu_trace.h"
 #include "ptpu_tune.h"
 #include "ptpu_wire.h"
@@ -182,12 +183,26 @@ struct SvInput {
   int dtype = SV_F32;
   std::vector<int64_t> dims;
   std::vector<uint8_t> data;
+  /* Zero-copy ingestion (ISSUE 17a): when the owning SvRequest pinned
+   * the conn's reassembly buffer, `ext` views the payload bytes in
+   * place and `data` stays empty; the batch gather reads straight
+   * from the wire bytes. Detached conns (fuzz harnesses pumping
+   * caller-owned memory) cannot be pinned — they fall back to the
+   * copying `data` path. */
+  const uint8_t* ext = nullptr;
+  size_t ext_n = 0;
+  const uint8_t* bytes() const { return ext ? ext : data.data(); }
+  size_t nbytes() const { return ext ? ext_n : data.size(); }
 };
 
 struct SvRequest {
   uint64_t id = 0;
   int64_t rows = 0;
   std::vector<SvInput> inputs;
+  // holds the conn's reassembly buffer alive while inputs[i].ext
+  // views point into it (released with the request, after the batch
+  // gather consumed the bytes)
+  std::shared_ptr<const void> pin;
   ptpu::net::ConnPtr conn;
   int64_t t_enq_us = 0;
   // decode steps ride the same batcher machinery as INFER requests
@@ -216,9 +231,16 @@ struct SvStats {
   ptpu::Counter requests, replies, req_errors, batches,
       batched_requests, batched_rows, bucket_miss, full_flushes,
       deadline_flushes, bytes_in, bytes_out, err_frames, proto_errors;
+  // CPU microseconds this plane burned handling requests (parse +
+  // batch gather + run bookkeeping + reply build; ThreadCpuUs deltas,
+  // ISSUE 17). cpu_us / requests is the benches' cycles-per-request
+  // column — the perf metric wall time cannot see on a
+  // loopback-bandwidth-capped box.
+  ptpu::Counter cpu_us;
   ptpu::Histogram queue_depth, batch_fill, e2e_us, run_us;
 
   void Reset() {
+    cpu_us.Reset();
     requests.Reset();
     replies.Reset();
     req_errors.Reset();
@@ -390,11 +412,41 @@ struct SvInputSig {
 struct SvInstance {
   void* pool = nullptr;                       // ptpu_workpool handle
   std::map<int64_t, PTPU_Predictor*> buckets;  // batch size -> handle
-  std::vector<std::vector<uint8_t>> stage;     // per-input batch bufs
+  // NUMA node this instance is placed on (-1: topology probe off —
+  // single-node box or PTPU_TOPO=0 — placement fully disabled)
+  int node = -1;
 
   ~SvInstance() {
     for (auto& kv : buckets) ptpu_predictor_destroy(kv.second);
     if (pool) ptpu_workpool_destroy(pool);
+  }
+};
+
+/* Scope-aggregates the calling thread's consumed CPU time into a
+ * plane's cpu_us counter (ISSUE 17 cycles-per-request telemetry).
+ * `c` may be retargeted before the scope closes — OnFrame starts on
+ * the INFER plane and switches to the decode plane once the tag is
+ * known. */
+struct SvCpuScope {
+  explicit SvCpuScope(ptpu::Counter* counter)
+      : c(counter), t0(ptpu::ThreadCpuUs()) {}
+  ~SvCpuScope() { c->Add(uint64_t(ptpu::ThreadCpuUs() - t0)); }
+  ptpu::Counter* c;
+  int64_t t0;
+};
+
+/* Refcounted reply pin (ISSUE 17b): holds the batch's detached
+ * predictor outputs (every reply's payload segments point straight
+ * into them) plus the small owned metadata chunks that interleave
+ * with payload segments when a model has >1 output. One pin is shared
+ * by every reply of a batch; the net core drops its reference when a
+ * conn flushes (or abandons) its frame's last byte, and the LAST
+ * release returns the output storage to the predictor's holder pool. */
+struct SvReplyPin {
+  void* opin = nullptr;                        // ptpu_outputs_pin_*
+  std::vector<std::vector<uint8_t>> meta;      // [ndim][dims] chunks
+  ~SvReplyPin() {
+    if (opin) ptpu_outputs_pin_release(opin);
   }
 };
 
@@ -414,8 +466,11 @@ struct DecStats {
   // and rounds that fell back to a plain target step (context end)
   ptpu::Counter spec_rounds, spec_proposed, spec_accepted,
       spec_tokens, spec_draft_steps, spec_fallbacks;
+  // decode-plane CPU microseconds (same contract as SvStats::cpu_us)
+  ptpu::Counter cpu_us;
   ptpu::Histogram run_us, batch_fill;
   void Reset() {
+    cpu_us.Reset();
     opens.Reset();
     closes.Reset();
     evictions.Reset();
@@ -653,16 +708,30 @@ struct SvServer {
 
     for (int i = 0; i < instances; ++i) {
       auto inst = std::unique_ptr<SvInstance>(new SvInstance());
-      inst->pool = ptpu_workpool_create(threads_per_instance);
+      /* Topology-aware placement (ISSUE 17c): round-robin instances
+       * over NUMA nodes. The creating thread binds to the node FIRST
+       * so the instance's worker threads inherit the mask AND the
+       * bucket predictors' planned arenas first-touch node-local
+       * pages; the batcher worker that runs this instance binds
+       * itself on its first batch. node == -1 (single-node box or
+       * PTPU_TOPO=0) makes every call below a no-op — byte-identical
+       * to the unplaced build. */
+      inst->node = ptpu::topo::NodeOfInstance(i);
+      ptpu::topo::BindCurrentThreadToNode(inst->node);
+      inst->pool = ptpu_workpool_create_bound(threads_per_instance,
+                                              inst->node);
       for (int64_t b : ladder) {
         PTPU_Predictor* p = ptpu_predictor_create_opts(
             model_path.c_str(), b, 0, err, sizeof(err));
-        if (!p)
+        if (!p) {
+          ptpu::topo::UnbindCurrentThread();
           throw std::runtime_error(std::string("bucket ") +
                                    std::to_string(b) + ": " + err);
+        }
         ptpu_predictor_set_pool(p, inst->pool);
         inst->buckets[b] = p;
       }
+      ptpu::topo::UnbindCurrentThread();
       insts.push_back(std::move(inst));
     }
 
@@ -724,8 +793,6 @@ struct SvServer {
     // start of the same ladder then loads them and probes nothing)
     if (ptpu::tune::Registry::Enabled())
       ptpu::tune::Registry::Inst().SaveIfDirty();
-
-    for (auto& inst : insts) inst->stage.resize(sig.size());
 
     // ---- optional KV-decode plane: its own predictor (the KV arena
     // lives inside it — sessions are bound to ONE predictor), its own
@@ -1277,6 +1344,16 @@ struct SvServer {
 
   void RunBatch(int instance, std::vector<SvRequest>& batch) {
     SvInstance& inst = *insts[size_t(instance)];
+    /* One-time worker placement: each batcher worker serves exactly
+     * one instance index, so the first batch pins the worker thread
+     * to the instance's node (no-op when the topology probe is off —
+     * inst.node == -1). */
+    static thread_local int bound_node = -2;
+    if (bound_node != inst.node) {
+      ptpu::topo::BindCurrentThreadToNode(inst.node);
+      bound_node = inst.node;
+    }
+    SvCpuScope cpu(&stats.cpu_us);
     // trace stamps: queue wait ended here; batch id keys the shared
     // batch-side spans of every co-batched request
     const int64_t t_deq = ptpu::NowUs();
@@ -1304,39 +1381,44 @@ struct SvServer {
       }
     };
 
+    /* Gather batch inputs STRAIGHT from the pinned wire buffers into
+     * the predictor's input storage (ISSUE 17a): input_alloc hands
+     * back the batch tensor's bytes, so one pass replaces the old
+     * wire->SvInput copy + SvInput->stage copy + stage->tensor copy.
+     * i32 wire payloads widen into the predictor's int64 storage as
+     * they land — exactly the widening set_input_i32 performed on its
+     * own copy. */
     for (size_t i = 0; i < sig.size(); ++i) {
-      const size_t esz = size_t(sv_dtype_size(sig[i].dtype));
-      const size_t row_b = size_t(sig[i].row_elems) * esz;
-      auto& buf = inst.stage[i];
-      const size_t need = size_t(bucket) * row_b;
-      if (buf.size() < need) buf.resize(need);
-      size_t off = 0;
-      for (const auto& r : batch) {
-        std::memcpy(buf.data() + off, r.inputs[i].data.data(),
-                    r.inputs[i].data.size());
-        off += r.inputs[i].data.size();
-      }
-      if (off < need) std::memset(buf.data() + off, 0, need - off);
       std::vector<int64_t> dims;
       dims.push_back(bucket);
       dims.insert(dims.end(), sig[i].tail.begin(), sig[i].tail.end());
-      int rc;
-      if (sig[i].dtype == SV_F32)
-        rc = ptpu_predictor_set_input(
-            p, sig[i].name.c_str(),
-            reinterpret_cast<const float*>(buf.data()), dims.data(),
-            int(dims.size()), err, sizeof(err));
-      else if (sig[i].dtype == SV_I32)
-        rc = ptpu_predictor_set_input_i32(
-            p, sig[i].name.c_str(),
-            reinterpret_cast<const int32_t*>(buf.data()), dims.data(),
-            int(dims.size()), err, sizeof(err));
-      else
-        rc = ptpu_predictor_set_input_i64(
-            p, sig[i].name.c_str(),
-            reinterpret_cast<const int64_t*>(buf.data()), dims.data(),
-            int(dims.size()), err, sizeof(err));
-      if (rc != 0) return fail_all(std::string("set_input: ") + err);
+      void* dst = ptpu_predictor_input_alloc(
+          p, sig[i].name.c_str(), sig[i].dtype, dims.data(),
+          int(dims.size()), err, sizeof(err));
+      if (!dst) return fail_all(std::string("input_alloc: ") + err);
+      const size_t total_el = size_t(bucket) * size_t(sig[i].row_elems);
+      if (sig[i].dtype == SV_I32) {
+        int64_t* d = static_cast<int64_t*>(dst);
+        size_t el = 0;
+        for (const auto& r : batch) {
+          const uint8_t* src = r.inputs[i].bytes();
+          const size_t ne = r.inputs[i].nbytes() / 4;
+          for (size_t k = 0; k < ne; ++k)
+            d[el++] = int64_t(int32_t(GetU32(src + 4 * k)));
+        }
+        for (; el < total_el; ++el) d[el] = 0;  // pad rows
+      } else {
+        uint8_t* d = static_cast<uint8_t*>(dst);
+        const size_t esz = size_t(sv_dtype_size(sig[i].dtype));
+        size_t off = 0;
+        for (const auto& r : batch) {
+          std::memcpy(d + off, r.inputs[i].bytes(),
+                      r.inputs[i].nbytes());
+          off += r.inputs[i].nbytes();
+        }
+        const size_t need = total_el * esz;
+        if (off < need) std::memset(d + off, 0, need - off);
+      }
     }
 
     const int64_t t0 = ptpu::NowUs();
@@ -1345,8 +1427,17 @@ struct SvServer {
     const int64_t t1 = ptpu::NowUs();
     stats.run_us.Observe(uint64_t(t1 - t0));
 
-    // de-mux row-wise, FIFO: request k gets rows [row_off, row_off +
-    // rows_k) of every output
+    /* De-mux row-wise, FIFO: request k gets rows [row_off, row_off +
+     * rows_k) of every output — but the rows are never copied into
+     * reply frames anymore (ISSUE 17b). The run's outputs detach into
+     * a refcounted pin shared by every reply of this batch; each
+     * reply is a scatter frame whose payload segments point straight
+     * into the pinned storage, released when the net core flushes (or
+     * abandons) the last byte. */
+    auto rp = std::make_shared<SvReplyPin>();
+    rp->opin = ptpu_predictor_outputs_detach(p);
+    if (!rp->opin || ptpu_outputs_pin_count(rp->opin) != n_outputs)
+      return fail_all("run lost its outputs");
     struct OutView {
       const float* data;
       std::vector<int64_t> dims;
@@ -1355,9 +1446,9 @@ struct SvServer {
     std::vector<OutView> outs;
     for (int o = 0; o < n_outputs; ++o) {
       OutView v;
-      const int nd = ptpu_predictor_output_ndim(p, o);
-      const int64_t* od = ptpu_predictor_output_dims(p, o);
-      v.data = ptpu_predictor_output_data(p, o);
+      const int nd = ptpu_outputs_pin_ndim(rp->opin, o);
+      const int64_t* od = ptpu_outputs_pin_dims(rp->opin, o);
+      v.data = ptpu_outputs_pin_data(rp->opin, o);
       if (nd < 1 || !od || !v.data || od[0] != bucket)
         return fail_all("output " + std::to_string(o) +
                         " lost the batch axis");
@@ -1369,34 +1460,48 @@ struct SvServer {
 
     int64_t row_off = 0;
     for (auto& r : batch) {
-      // frame: [len][ver][tag](+trace id echo)[id][u16 n_outputs]
-      // + outputs
-      size_t fsz = 4 + 2 + (r.wire_tid ? 8 : 0) + 8 + 2;
-      for (const auto& v : outs)
-        fsz += 1 + v.dims.size() * 8 +
-               size_t(r.rows) * size_t(v.row_elems) * 4;
-      std::vector<uint8_t> f = r.conn->AcquireBuf();
-      f.resize(fsz);
-      const size_t ho = RepHdr(f, kTagInferRep, r.wire_tid);
-      std::memcpy(f.data() + ho, &r.id, 8);
+      /* Scatter frame: the owned head carries [len][ver][tag](+trace
+       * id echo)[id][u16 n_outputs] plus output 0's [ndim][dims]
+       * metadata (contiguous with the header on the wire); output
+       * 0's raw rows are a pinned segment. Outputs past the first
+       * interleave [ndim][dims] metadata — small pin-owned chunks —
+       * with their pinned payload segments, preserving the exact v1
+       * byte layout. */
+      std::vector<uint8_t> head = r.conn->AcquireBuf();
+      head.resize(4 + 2 + (r.wire_tid ? 8 : 0) + 8 + 2 + 1 +
+                  outs[0].dims.size() * 8);
+      const size_t ho = RepHdr(head, kTagInferRep, r.wire_tid);
+      std::memcpy(head.data() + ho, &r.id, 8);
       const uint16_t no16 = uint16_t(n_outputs);
-      std::memcpy(f.data() + ho + 8, &no16, 2);
-      size_t off = ho + 10;
-      for (const auto& v : outs) {
-        f[off++] = uint8_t(v.dims.size());
-        int64_t d0 = r.rows;
-        std::memcpy(f.data() + off, &d0, 8);
-        off += 8;
-        for (size_t k = 1; k < v.dims.size(); ++k) {
-          std::memcpy(f.data() + off, &v.dims[k], 8);
-          off += 8;
+      std::memcpy(head.data() + ho + 8, &no16, 2);
+      size_t sent = head.size();
+      std::vector<ptpu::net::OutSeg> segs;
+      segs.reserve(size_t(n_outputs) * 2);
+      size_t moff = ho + 10;  // metadata cursor (head for output 0)
+      for (int o = 0; o < n_outputs; ++o) {
+        const OutView& v = outs[size_t(o)];
+        uint8_t* mb;
+        if (o == 0) {
+          mb = head.data() + moff;
+        } else {
+          rp->meta.emplace_back(1 + v.dims.size() * 8);
+          mb = rp->meta.back().data();
+          segs.push_back({mb, rp->meta.back().size()});
+          sent += rp->meta.back().size();
         }
+        mb[0] = uint8_t(v.dims.size());
+        const int64_t d0 = r.rows;
+        std::memcpy(mb + 1, &d0, 8);
+        for (size_t k = 1; k < v.dims.size(); ++k)
+          std::memcpy(mb + 1 + 8 * k, &v.dims[k], 8);
         const size_t nb = size_t(r.rows) * size_t(v.row_elems) * 4;
-        std::memcpy(f.data() + off, v.data + row_off * v.row_elems, nb);
-        off += nb;
+        segs.push_back(
+            {reinterpret_cast<const uint8_t*>(v.data +
+                                              row_off * v.row_elems),
+             nb});
+        sent += nb;
       }
       row_off += r.rows;
-      const size_t sent = f.size();
       // count BEFORE the send: SendPayload hands the frame to the
       // event loop, so a client can read the reply and query stats
       // in-process before this worker resumes — the counter must
@@ -1404,8 +1509,9 @@ struct SvServer {
       // send failure overcounts by one, but that client observes
       // nothing, so the exactness contract (stats selftests) holds.
       stats.replies.Add(1);
-      if (r.conn->SendPayload(std::move(f), r.trace_id, r.id)) {
-        stats.bytes_out.Add(sent);
+      stats.bytes_out.Add(sent);
+      if (r.conn->SendScatter(std::move(head), std::move(segs), rp,
+                              r.trace_id, r.id)) {
         const int64_t t_rep = ptpu::NowUs();
         stats.e2e_us.Observe(uint64_t(t_rep - r.t_enq_us));
         if (r.trace_id) {
@@ -1880,6 +1986,7 @@ struct SvServer {
    * with unique sessions. Stalled prefill admissions retry first —
    * the batcher just drained, so there is room again. */
   void RunDecode(std::vector<SvRequest>& batch) {
+    SvCpuScope cpu(&dstats.cpu_us);
     PrefillResume();
     if (spec_k > 0) SpecResume();
     const int64_t t_deq = ptpu::NowUs();
@@ -1955,27 +2062,33 @@ struct SvServer {
     }
   }
 
-  // reply with row `row` of the just-run decode outputs (kv_mu_ held:
-  // the next run overwrites the predictor's output block). run0/run1
-  // bracket the ptpu_predictor_decode_step that produced the row (the
-  // per-step decode.step trace span, keyed by session).
+  /* Reply with row `row` of the just-run decode outputs. The logits
+   * row rides as a pinned scatter segment pointing into the step's
+   * detached outputs (`rp` — shared by every reply of the sub-run);
+   * the owned head carries [len][ver][tag](+tid)[rid][sess]
+   * [u32 n_logits]. run0/run1 bracket the ptpu_predictor_decode_step
+   * that produced the row (the per-step decode.step trace span, keyed
+   * by session). */
   void DecodeReply(SvRequest* r, const float* lg, int64_t row,
-                   int64_t run0, int64_t run1) {
+                   int64_t run0, int64_t run1,
+                   const std::shared_ptr<SvReplyPin>& rp) {
     std::vector<uint8_t> f = r->conn->AcquireBuf();
-    f.resize(4 + 2 + (r->wire_tid ? 8 : 0) + 8 + 8 + 4 +
-             size_t(dec_logit_elems) * 4);
+    f.resize(4 + 2 + (r->wire_tid ? 8 : 0) + 8 + 8 + 4);
     const size_t ho = RepHdr(f, kTagDecodeRep, r->wire_tid);
     ptpu::PutU64(f.data() + ho, r->id);
     ptpu::PutU64(f.data() + ho + 8, r->session);
     PutU32(f.data() + ho + 16, uint32_t(dec_logit_elems));
-    std::memcpy(f.data() + ho + 20, lg + row * dec_logit_elems,
-                size_t(dec_logit_elems) * 4);
-    const size_t sent = f.size();
+    std::vector<ptpu::net::OutSeg> segs(1);
+    segs[0].p =
+        reinterpret_cast<const uint8_t*>(lg + row * dec_logit_elems);
+    segs[0].n = size_t(dec_logit_elems) * 4;
+    const size_t sent = f.size() + segs[0].n;
     // pre-send bump, same observable-ordering contract as the infer
     // reply path: a client holding the reply frame must see it counted
     dstats.replies.Add(1);
-    if (r->conn->SendPayload(std::move(f), r->trace_id, r->session)) {
-      stats.bytes_out.Add(sent);
+    stats.bytes_out.Add(sent);
+    if (r->conn->SendScatter(std::move(f), std::move(segs), rp,
+                             r->trace_id, r->session)) {
       const int64_t t_rep = ptpu::NowUs();
       stats.e2e_us.Observe(uint64_t(t_rep - r->t_enq_us));
       if (r->trace_id) {
@@ -2106,7 +2219,12 @@ struct SvServer {
         const int64_t rt1 = ptpu::NowUs();
         dstats.batches.Add(1);
         dstats.batch_fill.Observe(1);
-        const float* lg1 = ptpu_predictor_output_data(p1, 0);
+        // detach this step's outputs; the reply's logits segment pins
+        // them until the net core flushes (ISSUE 17b)
+        auto rp1 = std::make_shared<SvReplyPin>();
+        rp1->opin = ptpu_predictor_outputs_detach(p1);
+        const float* lg1 =
+            rp1->opin ? ptpu_outputs_pin_data(rp1->opin, 0) : nullptr;
         if (!lg1) {
           StepRowError(live[r2], "decode: no logits output");
           continue;
@@ -2114,7 +2232,7 @@ struct SvServer {
         if (live[r2]->is_prefill)
           PrefillRowDone(live[r2], lg1, 0);
         else
-          DecodeReply(live[r2], lg1, 0, rt0, rt1);
+          DecodeReply(live[r2], lg1, 0, rt0, rt1, rp1);
       }
       return;
     }
@@ -2122,7 +2240,16 @@ struct SvServer {
     dstats.run_us.Observe(uint64_t(t1 - t0));
     dstats.batches.Add(1);
     dstats.batch_fill.Observe(uint64_t(live.size()));
-    const float* lg = ptpu_predictor_output_data(pred, 0);
+    /* Detach the whole step's outputs once: every row's DECODE_REP
+     * shares ONE pin, each pointing its logits segment at its own row
+     * of the pinned block — no per-row copy, and a slow reader on one
+     * conn cannot stall the others (the pin outlives the slowest
+     * flush). Prefill rows read their logits transiently before this
+     * scope ends, which the local rp reference guarantees. */
+    auto rp = std::make_shared<SvReplyPin>();
+    rp->opin = ptpu_predictor_outputs_detach(pred);
+    const float* lg =
+        rp->opin ? ptpu_outputs_pin_data(rp->opin, 0) : nullptr;
     if (!lg) {
       for (auto* r : live) StepRowError(r, "decode: no logits output");
       return;
@@ -2131,7 +2258,7 @@ struct SvServer {
       if (live[r2]->is_prefill)
         PrefillRowDone(live[r2], lg, int64_t(r2));
       else
-        DecodeReply(live[r2], lg, int64_t(r2), t0, t1);
+        DecodeReply(live[r2], lg, int64_t(r2), t0, t1, rp);
     }
   }
 
@@ -2516,6 +2643,9 @@ struct SvServer {
   ptpu::net::FrameResult OnFrame(const ptpu::net::ConnPtr& conn,
                                  const uint8_t* req, uint32_t n) {
     using ptpu::net::FrameResult;
+    // event-thread CPU attributes to the INFER plane until the tag
+    // proves the frame is a decode op
+    SvCpuScope cpu(&stats.cpu_us);
     const bool retry = conn->deferred_us() > 0;
     // defer retry fast path: the request was parsed (and its payload
     // copied) on the FIRST attempt and stashed on the conn — retries
@@ -2576,6 +2706,7 @@ struct SvServer {
         tag == kTagDecodeClose || tag == kTagDecodeOpen2 ||
         tag == kTagDecodeFork || tag == kTagDecodeSpecOpen ||
         tag == kTagDecodeSpecStep) {
+      cpu.c = &dstats.cpu_us;  // decode-plane frame: re-attribute
       if (n < 2 + ext + 8) return proto_err();
       const uint64_t rid = ptpu::GetU64(req + 2 + ext);
       if (!dec_pred) {
@@ -2761,6 +2892,15 @@ struct SvServer {
     // [u8 dtype][u8 ndim][ndim x i64][raw]
     if (n < 2 + ext + 8 + 2) return proto_err();
     SvRequest r;
+    /* In-place ingestion (ISSUE 17a): pin the conn's reassembly
+     * buffer once for the whole request — every input payload below
+     * becomes a borrowed view into the wire bytes instead of a copy.
+     * The pin survives kDefer stashes (the event loop swaps in a
+     * fresh buffer rather than compacting a pinned one, so stashed
+     * views never move) and rides into the batcher, released with the
+     * request after the gather. nullptr = a Detached conn pumping
+     * caller-owned memory (fuzz harnesses): inputs copy as before. */
+    r.pin = conn->PinInbuf(req, n);
     std::memcpy(&r.id, req + 2 + ext, 8);
     uint16_t nin;
     std::memcpy(&nin, req + 10 + ext, 2);
@@ -2815,7 +2955,14 @@ struct SvServer {
                         size_t(sig[i].row_elems) *
                         size_t(sv_dtype_size(sig[i].dtype));
       if (n < off + nb) return proto_err();
-      in.data.assign(req + off, req + off + nb);
+      if (r.pin) {
+        in.ext = req + off;
+        in.ext_n = nb;
+      } else {
+        // unpinnable (Detached) conn: dynamic fallback to the
+        // copying path — the view would dangle past the handler
+        in.data.assign(req + off, req + off + nb);
+      }
       off += nb;
     }
     if (!retry) stats.requests.Add(1);
@@ -2945,6 +3092,7 @@ struct SvServer {
         {"http_reqs", &net.http_reqs},
         {"bytes_in", &stats.bytes_in},
         {"bytes_out", &stats.bytes_out},
+        {"cpu_us", &stats.cpu_us},
     };
     for (const auto& kv : cs) {
       ptpu::AppendJsonU64(&out, kv.name, kv.c->Get());
@@ -3010,6 +3158,7 @@ struct SvServer {
           {"spec_tokens", &dstats.spec_tokens},
           {"spec_draft_steps", &dstats.spec_draft_steps},
           {"spec_fallbacks", &dstats.spec_fallbacks},
+          {"cpu_us", &dstats.cpu_us},
       };
       for (const auto& kv : ds) {
         ptpu::AppendJsonU64(&out, kv.name, kv.c->Get());

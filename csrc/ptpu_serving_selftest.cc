@@ -431,9 +431,9 @@ struct SvTestClient {
     return ReadExact(fd, out->data(), out->size());
   }
 
-  // one f32 input, rows x K; returns the INFER_REP payload
-  bool infer(uint64_t id, const float* x, int64_t rows, int64_t K,
-             std::vector<uint8_t>* rep) {
+  // fire an INFER without waiting for the reply (pipelining / slow-
+  // reader tests pair this with a later read_frame)
+  bool send_infer(uint64_t id, const float* x, int64_t rows, int64_t K) {
     std::vector<uint8_t> f;
     f.push_back(kSvWireVersion);
     f.push_back(kTagInferReq);
@@ -448,7 +448,13 @@ struct SvTestClient {
     f.resize(doff + 16 + size_t(rows * K) * 4);
     std::memcpy(f.data() + doff, dims, 16);
     std::memcpy(f.data() + doff + 16, x, size_t(rows * K) * 4);
-    return send_frame(f) && read_frame(rep);
+    return send_frame(f);
+  }
+
+  // one f32 input, rows x K; returns the INFER_REP payload
+  bool infer(uint64_t id, const float* x, int64_t rows, int64_t K,
+             std::vector<uint8_t>* rep) {
+    return send_infer(id, x, rows, K) && read_frame(rep);
   }
 
   void close() {
@@ -1418,6 +1424,214 @@ void test_serving_decode_spec_wire() {
   std::printf("  spec wire: open/step/guards/counters/cleanup     OK\n");
 }
 
+/* Reply pinning, leg 1 (ISSUE 17): the INFER_REP payload segments
+ * point into the detached predictor output until the net core reports
+ * the last byte flushed. Stall that flush (32KB sockbufs, a ~1MB
+ * reply) while a second client keeps pushing batches through the same
+ * instance — if the pin released at batch end instead of flush end,
+ * the recycled output holder would be overwritten mid-send and the
+ * stalled reply would carry the wrong rows (and the sancheck build
+ * would see a heap-use-after-free). */
+void test_reply_pin_outlives_slow_reader() {
+  setenv("PTPU_NET_SOCKBUF", "32768", 1);
+  std::vector<float> W;
+  // 4-row reply = 256KB >> the ~64KB effective snd+rcv windows
+  const int64_t K = 16, N = 16384;
+  const std::string path = write_model_file(
+      build_matmul_model(4, K, N, &W), "ptpu_sv_selftest_pin.onnx");
+  char err[512] = {0};
+  void* h = ptpu_serving_start(path.c_str(), 0, "sv-test-key", 11,
+                               /*max_batch=*/4, /*deadline_us=*/500,
+                               /*instances=*/1,
+                               /*threads_per_instance=*/1,
+                               /*loopback=*/1, err, 512);
+  assert(h != nullptr && "serving start failed");
+  unsetenv("PTPU_NET_SOCKBUF");
+  const int port = ptpu_serving_port(h);
+
+  SvTestClient slow, fast;
+  assert(slow.connect_to(port) && slow.handshake("sv-test-key"));
+  assert(fast.connect_to(port) && fast.handshake("sv-test-key"));
+
+  std::mt19937 rng(21);
+  std::uniform_real_distribution<float> d(-1.f, 1.f);
+  std::vector<float> xs(4 * K);
+  for (auto& v : xs) v = d(rng);
+  // the slow reader fires a full batch and does NOT read: the batch
+  // runs, the scatter reply jams the tiny sockbufs, and most of the
+  // payload stays pinned in the predictor output
+  assert(slow.send_infer(1, xs.data(), 4, K));
+  std::this_thread::sleep_for(std::chrono::milliseconds(30));
+
+  // meanwhile other batches recycle output holders through the
+  // bounded pin pool on the same instance (1-row replies drain fast)
+  std::vector<float> xf(K);
+  for (int it = 0; it < 6; ++it) {
+    for (auto& v : xf) v = d(rng);
+    std::vector<uint8_t> frep;
+    assert(fast.infer(uint64_t(100 + it), xf.data(), 1, K, &frep));
+    assert(frep[1] == kTagInferRep);
+  }
+
+  // drain the stalled reply and check it row for row against the
+  // ORIGINAL inputs
+  std::vector<uint8_t> rep;
+  assert(slow.read_frame(&rep));
+  assert(rep[1] == kTagInferRep);
+  uint64_t rid;
+  std::memcpy(&rid, rep.data() + 2, 8);
+  assert(rid == 1);
+  int64_t odims[2];
+  std::memcpy(odims, rep.data() + 13, 16);
+  assert(odims[0] == 4 && odims[1] == N);
+  for (int64_t r = 0; r < 4; ++r)
+    for (int64_t j = 0; j < N; j += 997) {  // strided: keep it fast
+      float acc = 0.f;
+      for (int64_t k = 0; k < K; ++k)
+        acc += xs[size_t(r * K + k)] * W[size_t(k * N + j)];
+      const float got = ptpu::GetF32(rep.data() + 29 + 4 * (r * N + j));
+      assert(std::fabs(got - acc) <= 1e-4f * (1.f + std::fabs(acc)));
+    }
+
+  const std::string js = ptpu_serving_stats_json(h);
+  assert(js.find("\"requests\":7") != std::string::npos);
+  assert(js.find("\"replies\":7") != std::string::npos);
+  assert(js.find("\"dynamic_shape_fallback\":0") != std::string::npos);
+  slow.close();
+  fast.close();
+  ptpu_serving_stop(h);
+  std::printf("  reply pin outlives slow-reader flush              OK\n");
+}
+
+/* Reply pinning, leg 2 (ISSUE 17): kDefer with a pinned reassembly
+ * buffer. Flood one connection with far more single-row requests than
+ * the bounded batch queue holds (cap = max(64, 16*max_batch) rows) —
+ * overflow frames stash their parsed request, whose input views
+ * borrow the PINNED inbuf, and retry on the defer tick. Every reply
+ * must still de-mux exactly in order; a compacted or recycled inbuf
+ * would feed the batch gather garbage (ASan catches the read in the
+ * sancheck build, the value asserts catch it here). */
+void test_defer_retry_with_pinned_buffer() {
+  std::vector<float> W;
+  const int64_t K = 256, N = 256;
+  const std::string path = write_model_file(
+      build_matmul_model(1, K, N, &W), "ptpu_sv_selftest_defer.onnx");
+  char err[512] = {0};
+  void* h = ptpu_serving_start(path.c_str(), 0, "sv-test-key", 11,
+                               /*max_batch=*/1, /*deadline_us=*/200,
+                               /*instances=*/1,
+                               /*threads_per_instance=*/1,
+                               /*loopback=*/1, err, 512);
+  assert(h != nullptr && "serving start failed");
+  SvTestClient cli;
+  assert(cli.connect_to(ptpu_serving_port(h)));
+  assert(cli.handshake("sv-test-key"));
+
+  // 300 pipelined rows against a 64-row queue: the event thread
+  // parses far faster than one worker drains, so defers are certain
+  const int kReqs = 300;
+  std::mt19937 rng(5);
+  std::uniform_real_distribution<float> d(-1.f, 1.f);
+  std::vector<std::vector<float>> xs;
+  xs.resize(size_t(kReqs), std::vector<float>(size_t(K)));
+  for (auto& x : xs)
+    for (auto& v : x) v = d(rng);
+  for (int i = 0; i < kReqs; ++i)
+    assert(cli.send_infer(uint64_t(i), xs[size_t(i)].data(), 1, K));
+
+  // a deferred frame pauses reads on its conn until it lands, so
+  // replies keep FIFO order per connection
+  for (int i = 0; i < kReqs; ++i) {
+    std::vector<uint8_t> rep;
+    assert(cli.read_frame(&rep));
+    assert(rep[1] == kTagInferRep && "deferred request errored");
+    uint64_t rid;
+    std::memcpy(&rid, rep.data() + 2, 8);
+    assert(rid == uint64_t(i));
+    const int64_t j = i % N;  // one exact value per reply
+    float acc = 0.f;
+    for (int64_t k = 0; k < K; ++k)
+      acc += xs[size_t(i)][size_t(k)] * W[size_t(k * N + j)];
+    const float got = ptpu::GetF32(rep.data() + 29 + 4 * j);
+    assert(std::fabs(got - acc) <= 1e-4f * (1.f + std::fabs(acc)));
+  }
+
+  const std::string js = ptpu_serving_stats_json(h);
+  assert(js.find("\"requests\":300") != std::string::npos);
+  assert(js.find("\"replies\":300") != std::string::npos);
+  assert(js.find("\"req_errors\":0") != std::string::npos);
+  assert(js.find("\"dynamic_shape_fallback\":0") != std::string::npos);
+  cli.close();
+  ptpu_serving_stop(h);
+  std::printf("  kDefer retry with pinned reassembly buffer        OK\n");
+}
+
+/* Reply pinning, leg 3 (ISSUE 17): a connection dying with a pinned
+ * reply still queued. The net core drops the conn's out-queue on the
+ * event thread, releasing the predictor-output pin under net.conn_out
+ * (rank 100 -> pred.outpin 105, lockdep-checked in the sancheck
+ * build); the holder must return to the pool — no leak (LSan), no
+ * use-after-free — and the server keeps serving. */
+void test_conn_death_with_pinned_output() {
+  setenv("PTPU_NET_SOCKBUF", "32768", 1);
+  std::vector<float> W;
+  const int64_t K = 16, N = 16384;
+  const std::string path = write_model_file(
+      build_matmul_model(4, K, N, &W), "ptpu_sv_selftest_die.onnx");
+  char err[512] = {0};
+  void* h = ptpu_serving_start(path.c_str(), 0, "sv-test-key", 11,
+                               /*max_batch=*/4, /*deadline_us=*/500,
+                               /*instances=*/1,
+                               /*threads_per_instance=*/1,
+                               /*loopback=*/1, err, 512);
+  assert(h != nullptr && "serving start failed");
+  unsetenv("PTPU_NET_SOCKBUF");
+  const int port = ptpu_serving_port(h);
+
+  std::mt19937 rng(33);
+  std::uniform_real_distribution<float> d(-1.f, 1.f);
+  std::vector<float> xs(4 * K);
+  for (auto& v : xs) v = d(rng);
+  {
+    SvTestClient doomed;
+    assert(doomed.connect_to(port) && doomed.handshake("sv-test-key"));
+    assert(doomed.send_infer(7, xs.data(), 4, K));
+    // let the batch run and the 1MB reply jam the sockbufs ...
+    std::this_thread::sleep_for(std::chrono::milliseconds(30));
+    doomed.close();  // ... then die with the payload still pinned
+  }
+
+  // the server must shrug it off: a fresh client gets exact answers,
+  // and several rounds re-exercise the pool slot the dead conn's
+  // teardown released
+  SvTestClient ok;
+  assert(ok.connect_to(port) && ok.handshake("sv-test-key"));
+  for (int it = 0; it < 4; ++it) {
+    for (auto& v : xs) v = d(rng);
+    std::vector<uint8_t> rep;
+    assert(ok.infer(uint64_t(50 + it), xs.data(), 4, K, &rep));
+    assert(rep[1] == kTagInferRep);
+    int64_t odims[2];
+    std::memcpy(odims, rep.data() + 13, 16);
+    assert(odims[0] == 4 && odims[1] == N);
+    for (int64_t r = 0; r < 4; ++r)
+      for (int64_t j = 0; j < N; j += 4099) {
+        float acc = 0.f;
+        for (int64_t k = 0; k < K; ++k)
+          acc += xs[size_t(r * K + k)] * W[size_t(k * N + j)];
+        const float got =
+            ptpu::GetF32(rep.data() + 29 + 4 * (r * N + j));
+        assert(std::fabs(got - acc) <= 1e-4f * (1.f + std::fabs(acc)));
+      }
+  }
+  const std::string js = ptpu_serving_stats_json(h);
+  assert(js.find("\"requests\":5") != std::string::npos);
+  assert(js.find("\"dynamic_shape_fallback\":0") != std::string::npos);
+  ok.close();
+  ptpu_serving_stop(h);
+  std::printf("  conn death with pinned output releases cleanly    OK\n");
+}
+
 }  // namespace
 
 int main() {
@@ -1436,6 +1650,9 @@ int main() {
   test_kvpool_trim_cow_edges();
   test_spec_sampler_exactness();
   test_serving_decode_spec_wire();
+  test_reply_pin_outlives_slow_reader();
+  test_defer_retry_with_pinned_buffer();
+  test_conn_death_with_pinned_output();
   std::printf("ptpu_serving_selftest: all native serving unit tests "
               "passed\n");
   return 0;

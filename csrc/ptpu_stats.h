@@ -14,6 +14,8 @@
 #ifndef PTPU_STATS_H_
 #define PTPU_STATS_H_
 
+#include <time.h>
+
 #include <atomic>
 #include <chrono>
 #include <cstdint>
@@ -26,6 +28,18 @@ inline int64_t NowUs() {
   return std::chrono::duration_cast<std::chrono::microseconds>(
              std::chrono::steady_clock::now().time_since_epoch())
       .count();
+}
+
+/* Calling thread's consumed CPU time in microseconds
+ * (CLOCK_THREAD_CPUTIME_ID). Hot paths take deltas around a request's
+ * CPU-owning section and aggregate them into a plane's `cpu_us`
+ * counter, so /statsz and the benches report cycles-per-request
+ * directly — on a loopback-bandwidth-capped box, CPU/request is the
+ * perf metric wall time cannot see (ISSUE 17). */
+inline int64_t ThreadCpuUs() {
+  struct timespec ts;
+  if (clock_gettime(CLOCK_THREAD_CPUTIME_ID, &ts) != 0) return 0;
+  return int64_t(ts.tv_sec) * 1000000 + int64_t(ts.tv_nsec) / 1000;
 }
 
 struct Counter {

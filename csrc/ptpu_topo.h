@@ -1,0 +1,155 @@
+// CPU/NUMA topology probe + instance placement (ISSUE 17c).
+//
+// One shared sysfs probe, same shape as the r9 ISA dispatcher's
+// load-time cpuid probe (csrc/ptpu_predictor.cc isa_level): read the
+// machine once, cache the answer, gate every consumer on it. The
+// serving runtime uses it to pin each instance's batcher worker + the
+// instance's WorkPool threads to one NUMA node's CPU set, and to
+// first-touch the instance's bucket arenas from a thread already bound
+// there — batches then run against node-local pages instead of
+// bouncing cache lines across the interconnect.
+//
+// Probe-gated like every bucket-ladder repair: on a single-node or
+// single-CPU box `Enabled()` is false and NOTHING changes — no
+// affinity syscalls, no placement, byte-identical behavior to a build
+// without this header. `PTPU_TOPO=0` is the escape hatch that forces
+// the same degradation on multi-node boxes.
+//
+// Affinity goes through sched_setaffinity(2) on the calling thread
+// (tid 0), never pthread_setaffinity_np — the repo-wide raw-pthread
+// ban (tools/ptpu_check.py locks checker) applies here too.
+#ifndef PTPU_TOPO_H_
+#define PTPU_TOPO_H_
+
+#include <sched.h>
+#include <unistd.h>
+
+#include <cctype>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace ptpu {
+namespace topo {
+
+struct Topology {
+  // one entry per online NUMA node: the node's online CPU ids
+  std::vector<std::vector<int>> node_cpus;
+  int cpus = 1;  // total online CPUs across nodes
+  // true only when placement can matter: >1 node AND >1 CPU AND the
+  // PTPU_TOPO escape hatch is not pulled
+  bool enabled = false;
+};
+
+// "0-3,8,10-11" -> {0,1,2,3,8,10,11}; hostile/garbage input yields {}
+inline std::vector<int> ParseCpuList(const std::string& s) {
+  std::vector<int> out;
+  size_t i = 0;
+  while (i < s.size()) {
+    if (!std::isdigit(static_cast<unsigned char>(s[i]))) {
+      ++i;
+      continue;
+    }
+    char* end = nullptr;
+    long a = std::strtol(s.c_str() + i, &end, 10);
+    i = size_t(end - s.c_str());
+    long b = a;
+    if (i < s.size() && s[i] == '-') {
+      b = std::strtol(s.c_str() + i + 1, &end, 10);
+      i = size_t(end - s.c_str());
+    }
+    for (long c = a; c <= b && c - a < 4096; ++c)
+      if (c >= 0 && c < CPU_SETSIZE) out.push_back(int(c));
+  }
+  return out;
+}
+
+inline std::string ReadSmallFile(const char* path) {
+  std::FILE* f = std::fopen(path, "r");
+  if (!f) return "";
+  char buf[4096];
+  const size_t n = std::fread(buf, 1, sizeof(buf) - 1, f);
+  std::fclose(f);
+  buf[n] = '\0';
+  return std::string(buf);
+}
+
+inline Topology ProbeUncached() {
+  Topology t;
+  // per-node CPU lists from /sys/devices/system/node/node<N>/cpulist;
+  // a box without the node directory (or with one node) degrades to a
+  // single all-CPUs node
+  for (int n = 0; n < 64; ++n) {
+    char path[128];
+    std::snprintf(path, sizeof(path),
+                  "/sys/devices/system/node/node%d/cpulist", n);
+    const std::string s = ReadSmallFile(path);
+    if (s.empty()) break;
+    std::vector<int> cpus = ParseCpuList(s);
+    if (!cpus.empty()) t.node_cpus.push_back(std::move(cpus));
+  }
+  if (t.node_cpus.empty()) {
+    const std::string s =
+        ReadSmallFile("/sys/devices/system/cpu/online");
+    std::vector<int> cpus = ParseCpuList(s);
+    if (cpus.empty()) cpus.push_back(0);
+    t.node_cpus.push_back(std::move(cpus));
+  }
+  t.cpus = 0;
+  for (const auto& nc : t.node_cpus) t.cpus += int(nc.size());
+  if (t.cpus < 1) t.cpus = 1;
+  const char* e = std::getenv("PTPU_TOPO");
+  const bool off = e && std::strcmp(e, "0") == 0;
+  t.enabled = !off && t.node_cpus.size() > 1 && t.cpus > 1;
+  return t;
+}
+
+// the one probe (function-local static: thread-safe init, no TU)
+inline const Topology& Probe() {
+  static const Topology t = ProbeUncached();
+  return t;
+}
+
+inline bool Enabled() { return Probe().enabled; }
+
+// Pin the CALLING thread to `node`'s CPU set. No-op (and no syscall)
+// when the probe is off or the node index is out of range, so every
+// call site stays byte-identical on single-node boxes.
+inline void BindCurrentThreadToNode(int node) {
+  const Topology& t = Probe();
+  if (!t.enabled || node < 0 ||
+      size_t(node) >= t.node_cpus.size())
+    return;
+  cpu_set_t set;
+  CPU_ZERO(&set);
+  for (int c : t.node_cpus[size_t(node)]) CPU_SET(c, &set);
+  // pid 0 == calling thread; failure (cpuset-restricted container)
+  // leaves the default mask — placement is an optimization, never a
+  // correctness requirement
+  (void)sched_setaffinity(0, sizeof(set), &set);
+}
+
+// Drop any node binding: back to every online CPU.
+inline void UnbindCurrentThread() {
+  const Topology& t = Probe();
+  if (!t.enabled) return;
+  cpu_set_t set;
+  CPU_ZERO(&set);
+  for (const auto& nc : t.node_cpus)
+    for (int c : nc) CPU_SET(c, &set);
+  (void)sched_setaffinity(0, sizeof(set), &set);
+}
+
+// Round-robin instance -> node assignment.
+inline int NodeOfInstance(int instance) {
+  const Topology& t = Probe();
+  if (!t.enabled) return -1;
+  return instance % int(t.node_cpus.size());
+}
+
+}  // namespace topo
+}  // namespace ptpu
+
+#endif  // PTPU_TOPO_H_

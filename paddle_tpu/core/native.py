@@ -298,7 +298,11 @@ def aes_ctr_xcrypt(key: bytes, iv: bytes, data: bytes) -> bytes:
 # stays as the parity fallback when the .so is absent.
 # ---------------------------------------------------------------------------
 
-_PS_SO = os.path.join(_PKG_DIR, "_native_ps.so")
+# PTPU_PS_SO points a process at an alternate build — the benches'
+# interleaved old-vs-new A/B legs run each side in a subprocess with
+# this set (ISSUE 17 cycles-per-request methodology)
+_PS_SO = os.environ.get("PTPU_PS_SO",
+                        os.path.join(_PKG_DIR, "_native_ps.so"))
 _PS_SRCS = [os.path.join(os.path.dirname(_PKG_DIR), "csrc", f)
             for f in ("ptpu_ps_table.cc", "ptpu_ps_server.cc",
                       "ptpu_net.cc")]
@@ -608,7 +612,9 @@ class NativePsTable:
 # hand-rolled ctypes to exercise the raw ABI.
 # ---------------------------------------------------------------------------
 
-_PRED_SO = os.path.join(_PKG_DIR, "_native_predictor.so")
+# PTPU_PREDICTOR_SO: same A/B-leg override as PTPU_PS_SO above
+_PRED_SO = os.environ.get("PTPU_PREDICTOR_SO",
+                          os.path.join(_PKG_DIR, "_native_predictor.so"))
 _PRED_LIB: Optional[ctypes.CDLL] = None
 _PRED_LOCK = threading.Lock()
 
@@ -1265,7 +1271,12 @@ ABI_SYMBOLS = {
         "ptpu_predictor_set_input", "ptpu_predictor_set_input_i32",
         "ptpu_predictor_set_input_i64", "ptpu_predictor_run",
         "ptpu_predictor_output_ndim", "ptpu_predictor_output_dims",
-        "ptpu_predictor_output_data", "ptpu_predictor_stats_json",
+        "ptpu_predictor_output_data",
+        "ptpu_predictor_input_alloc", "ptpu_predictor_outputs_detach",
+        "ptpu_outputs_pin_count", "ptpu_outputs_pin_data",
+        "ptpu_outputs_pin_ndim", "ptpu_outputs_pin_dims",
+        "ptpu_outputs_pin_release", "ptpu_workpool_create_bound",
+        "ptpu_predictor_stats_json",
         "ptpu_predictor_stats_reset", "ptpu_predictor_set_profiler",
         "ptpu_predictor_kv_plan", "ptpu_predictor_kv_sessions",
         "ptpu_predictor_kv_open", "ptpu_predictor_kv_close",

@@ -364,6 +364,7 @@ class InferenceClient:
                 time.sleep(delay)
                 delay = min(delay * 2, 0.5)
         self._next_id = 0
+        self._rbuf = bytearray(4096)
         mac = _hmac.new(authkey, nonce, hashlib.sha256).digest()
         self._sock.sendall(_U32.pack(len(mac)) + mac)
         if _read_exact(self._sock, 1) != b"\x01":
@@ -376,6 +377,38 @@ class InferenceClient:
     def _read_frame(self) -> bytes:
         n = _U32.unpack(_read_exact(self._sock, 4))[0]
         return _read_exact(self._sock, n)
+
+    # One bounded receive buffer per connection (ISSUE 17): the
+    # pipelined *_many drains read every reply frame into this
+    # bytearray via recv_into and parse a borrowed memoryview — no
+    # fresh bytes object per frame. It grows to the largest frame
+    # seen, then shrinks back to _RBUF_CAP once an oversized frame
+    # has been consumed. Parsers copy what they keep (every returned
+    # array owns its storage), so the view dies when the next frame
+    # lands.
+    _RBUF_CAP = 1 << 20
+
+    def _read_frame_reused(self) -> memoryview:
+        n = _U32.unpack(_read_exact(self._sock, 4))[0]
+        buf = self._rbuf
+        want = max(n, self._RBUF_CAP)
+        if len(buf) < n or len(buf) > want:
+            try:
+                del buf[want:]          # shrink an oversized carryover
+                if len(buf) < n:
+                    buf.extend(bytes(n - len(buf)))
+            except BufferError:
+                # a caller kept the previous view alive — leave that
+                # buffer to it and start a fresh one
+                buf = self._rbuf = bytearray(n)
+        mv = memoryview(buf)
+        got = 0
+        while got < n:
+            r = self._sock.recv_into(mv[got:n])
+            if not r:
+                raise ConnectionError("serving connection closed")
+            got += r
+        return mv[:n]
 
     def meta(self) -> dict:
         self._send_frame(bytes([WIRE_VERSION, TAG_META_REQ]))
@@ -452,7 +485,7 @@ class InferenceClient:
         if f[1] == TAG_INFER_ERR:
             (mlen,) = _U32.unpack_from(f, 10 + base)
             return req_id, ServingError(
-                f[14 + base:14 + base + mlen].decode())
+                bytes(f[14 + base:14 + base + mlen]).decode())
         if f[1] != TAG_INFER_REP:
             raise ConnectionError(f"unexpected reply tag {f[1]:#x}")
         (nout,) = struct.unpack_from("<H", f, 10 + base)
@@ -509,7 +542,7 @@ class InferenceClient:
                 self._send_frame(
                     self._encode_request(rid, requests[sent], tid))
                 sent += 1
-            f = self._read_frame()
+            f = self._read_frame_reused()
             got_id, outs = self._decode_reply(f)
             idx, tid, t0 = pending.pop(got_id)
             self._trace_end(tid, t0, "client.infer", f)
@@ -705,7 +738,7 @@ class InferenceClient:
             self._send_frame(
                 self._decode_step_payload(rid, sess, tok, tid))
         while pending:
-            f = self._read_frame()
+            f = self._read_frame_reused()
             got = _U64.unpack_from(f, 2 + _frame_base(f))[0]
             if got not in pending:
                 raise ConnectionError(
@@ -715,7 +748,7 @@ class InferenceClient:
             if f[1] == TAG_INFER_ERR:
                 (mlen,) = _U32.unpack_from(f, 10 + base)
                 results[i] = ServingError(
-                    f[14 + base:14 + base + mlen].decode())
+                    bytes(f[14 + base:14 + base + mlen]).decode())
             elif f[1] == TAG_DECODE_REP:
                 self._trace_end(tid, t0, "client.decode_step", f)
                 results[i] = self._decode_rep_logits(f)

@@ -305,6 +305,204 @@ class TestDynamicShapeFallback:
 
 
 @pytest.fixture(scope="module")
+def wide_artifact(built, tmp_path_factory):
+    """32 -> 16384 linear: one 4-row reply is ~256KB, big enough to
+    jam the 32KB sockbufs the reply-pinning tests run under."""
+    import paddle_tpu as pt
+    from paddle_tpu.onnx.converter import trace_to_onnx
+
+    pt.seed(3)
+    net = pt.nn.Linear(32, 16384)
+    net.eval()
+    x = np.zeros((4, 32), np.float32)
+    path = str(tmp_path_factory.mktemp("svpin") / "wide.onnx")
+    with open(path, "wb") as f:
+        f.write(trace_to_onnx(lambda a: net(a), (jnp.asarray(x),)))
+    return path
+
+
+class TestReplyPinning:
+    """ISSUE 17 zero-copy replies over the full Python chain — twins
+    of the native pinning selftests. Replies ship pinned predictor
+    output segments (no staging copy), so the output holder must stay
+    alive until the net core flushes the last byte: a stalled reader,
+    a deferred request's pinned inbuf, and a connection dying with a
+    pinned reply queued must all keep exact parity."""
+
+    def test_slow_reader_reply_survives_pool_recycle(
+            self, wide_artifact, monkeypatch):
+        from paddle_tpu.core.native import NativePredictor
+        from paddle_tpu.inference import create_server
+
+        monkeypatch.setenv("PTPU_NET_SOCKBUF", "32768")
+        ref = NativePredictor(wide_artifact)
+        with create_server(wide_artifact, max_batch=4, deadline_us=500,
+                           instances=1) as srv:
+            slow = srv.client()
+            fast = srv.client()
+            rs = np.random.RandomState(7)
+            x = rs.randn(4, 32).astype(np.float32)
+            # fire the big request and do NOT read: the scatter reply
+            # jams the tiny sockbufs with its tail still pinned
+            slow._send_frame(slow._encode_request(1, [x]))
+            time.sleep(0.05)
+            # meanwhile other batches recycle output holders through
+            # the bounded pin pool on the same instance
+            for _ in range(6):
+                xf = rs.randn(1, 32).astype(np.float32)
+                out = fast.infer(xf)
+                ref.set_input(ref.input_name(0), xf)
+                ref.run()
+                np.testing.assert_allclose(out[0], ref.output(0),
+                                           rtol=1e-5, atol=1e-6)
+            # now drain the stalled reply: still the ORIGINAL rows
+            rid, outs = slow._decode_reply(slow._read_frame())
+            assert rid == 1
+            ref.set_input(ref.input_name(0), x)
+            ref.run()
+            np.testing.assert_allclose(outs[0], ref.output(0),
+                                       rtol=1e-5, atol=1e-6)
+            st = srv.stats()
+            assert st["server"]["replies"] == 7
+            assert st["batcher"]["dynamic_shape_fallback"] == 0
+            slow.close()
+            fast.close()
+        ref.close()
+
+    def test_defer_retry_keeps_order_and_parity(self, mlp_artifact):
+        from paddle_tpu.core.native import NativePredictor
+        from paddle_tpu.inference import create_server
+
+        ref = NativePredictor(mlp_artifact)
+        # max_batch=1 -> 64-row request queue; 300 pipelined rows
+        # overflow it, so overflow frames ride the kDefer retry path
+        # with their input views borrowing the PINNED inbuf
+        with create_server(mlp_artifact, max_batch=1, deadline_us=200,
+                           instances=1) as srv:
+            cli = srv.client()
+            rs = np.random.RandomState(11)
+            reqs = [[rs.randn(1, 32).astype(np.float32)]
+                    for _ in range(300)]
+            res = cli.infer_many(reqs, depth=300)
+            for req, out in zip(reqs, res):
+                ref.set_input(ref.input_name(0), req[0])
+                ref.run()
+                np.testing.assert_allclose(out[0], ref.output(0),
+                                           rtol=1e-5, atol=1e-6)
+            st = srv.stats()
+            assert st["server"]["requests"] == 300
+            assert st["server"]["replies"] == 300
+            assert st["server"]["req_errors"] == 0
+            cli.close()
+        ref.close()
+
+    def test_conn_death_with_pinned_reply(self, wide_artifact,
+                                          monkeypatch):
+        from paddle_tpu.core.native import NativePredictor
+        from paddle_tpu.inference import create_server
+
+        monkeypatch.setenv("PTPU_NET_SOCKBUF", "32768")
+        ref = NativePredictor(wide_artifact)
+        with create_server(wide_artifact, max_batch=4, deadline_us=500,
+                           instances=1) as srv:
+            rs = np.random.RandomState(13)
+            doomed = srv.client()
+            doomed._send_frame(
+                doomed._encode_request(7, [rs.randn(4, 32)
+                                           .astype(np.float32)]))
+            time.sleep(0.05)   # batch runs, reply jams the sockbufs
+            doomed.close()     # ... die with the payload still pinned
+            # the server shrugs it off: fresh client, exact answers,
+            # and more rounds re-exercise the released pool slot
+            ok = srv.client()
+            for _ in range(3):
+                x = rs.randn(4, 32).astype(np.float32)
+                out = ok.infer(x)
+                ref.set_input(ref.input_name(0), x)
+                ref.run()
+                np.testing.assert_allclose(out[0], ref.output(0),
+                                           rtol=1e-5, atol=1e-6)
+            st = srv.stats()
+            assert st["server"]["requests"] == 4
+            ok.close()
+        ref.close()
+
+
+_TOPO_SCRIPT = r"""
+import json
+import sys
+
+import numpy as np
+
+sys.path.insert(0, sys.argv[2])
+from paddle_tpu.inference.serving import create_server
+
+srv = create_server(sys.argv[1], max_batch=4, deadline_us=1500,
+                    instances=2)
+cli = srv.client()
+rs = np.random.RandomState(0)
+for rows in (1, 2, 3, 4, 1, 4):
+    cli.infer(rs.randn(rows, 32).astype(np.float32))
+st = srv.stats()
+sv, bt = st["server"], st["batcher"]
+print("TOPO " + json.dumps({
+    "requests": sv["requests"], "replies": sv["replies"],
+    "req_errors": sv["req_errors"],
+    "bytes_in": sv["bytes_in"], "bytes_out": sv["bytes_out"],
+    "batches": bt["batches"],
+    "batched_requests": bt["batched_requests"],
+    "bucket_miss": bt["bucket_miss"],
+    "dynamic_shape_fallback": bt["dynamic_shape_fallback"],
+    "batch_fill_sum": bt["batch_fill"]["sum"],
+    "batch_fill_count": bt["batch_fill"]["count"],
+}, sort_keys=True))
+cli.close()
+srv.stop()
+"""
+
+
+class TestTopologyPlacement:
+    """ISSUE 17c: topology-aware placement is an optimization with a
+    hard no-behavior-change contract — flipping PTPU_TOPO=0 vs the
+    default probe must leave every serving counter identical for an
+    identical request sequence (placement may move threads on a
+    multi-node box, the wire/batcher arithmetic may never change; on a
+    single-node box the probe degrades and both runs are the same code
+    path end to end). The probe caches per process, so each side runs
+    in a fresh subprocess."""
+
+    def _counters(self, model, topo_env):
+        import json
+        import sys as _sys
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH",
+                                                        "")
+        env.pop("PTPU_TOPO", None)
+        env.pop("XLA_FLAGS", None)
+        if topo_env is not None:
+            env["PTPU_TOPO"] = topo_env
+        r = subprocess.run([_sys.executable, "-c", _TOPO_SCRIPT,
+                            model, REPO], env=env, cwd=REPO,
+                           capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, \
+            f"stdout:{r.stdout[-2000:]}\nstderr:{r.stderr[-2000:]}"
+        line = [ln for ln in r.stdout.splitlines()
+                if ln.startswith("TOPO ")][-1]
+        return json.loads(line[len("TOPO "):])
+
+    def test_topo_off_vs_default_identical_counters(self,
+                                                    mlp_artifact):
+        default = self._counters(mlp_artifact, None)
+        forced_off = self._counters(mlp_artifact, "0")
+        assert default == forced_off, (default, forced_off)
+        assert default["requests"] == 6
+        assert default["replies"] == 6
+        assert default["bucket_miss"] == 1
+
+
+@pytest.fixture(scope="module")
 def decode_artifacts(built, tmp_path_factory):
     """GPT-tiny decode artifact (batch 8, context 48) + its full-seq
     twin — the ISSUE r12 paged-engine fixture set."""

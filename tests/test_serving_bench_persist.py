@@ -123,7 +123,7 @@ class TestTraceAbPersist:
         with open(out) as f:
             return json.load(f)
 
-    def test_schema_and_counters(self, trace_out):
+    def test_schema_and_counters_trace(self, trace_out):
         assert trace_out["bench"] == "serving_bench --trace"
         assert trace_out["trace_on_config"] == {"sample": 64,
                                                 "slow_us": 100000}
@@ -138,3 +138,79 @@ class TestTraceAbPersist:
         exact = by["trace_ab_counters_exact"]
         assert exact["value"] == 1, exact
         assert all(e["exact"] for e in exact["legs"])
+
+
+class TestCprAbPersist:
+    """`--cpr` mode (ISSUE 17): the cycles-per-request old-vs-new-.so
+    A/B persists interleaved legs with both CPU columns and the gate
+    rows. The smoke points BOTH sides at the suite's build (the env
+    override skips the git-worktree compile), so the 15% reduction
+    gate itself is a full-size committed-bench property
+    (BENCH_CPR_r01.json) — here we assert schema, counter exactness,
+    and that identical sides read as ~equal, not the gate."""
+
+    @pytest.fixture(scope="class")
+    def cpr_out(self, tmp_path_factory):
+        so = os.path.join(REPO, "paddle_tpu", "_native_predictor.so")
+        ps_so = os.path.join(REPO, "paddle_tpu", "_native_ps.so")
+        if not (os.path.exists(so) and os.path.exists(ps_so)):
+            pytest.skip("native .so pair not built")
+        out = str(tmp_path_factory.mktemp("cpr") / "BENCH_CPR.json")
+        env = dict(os.environ)
+        env.update({
+            "PTPU_SRVBENCH_CLIENTS": "2", "PTPU_SRVBENCH_OPS": "25",
+            "PTPU_SRVBENCH_MAX_BATCH": "4",
+            "PTPU_SRVBENCH_SKIP_BUILD": "1",
+            "PTPU_CPRBENCH_PLANES": "serving,ps",
+            "PTPU_CPRBENCH_ROUNDS": "1",
+            "PTPU_CPRBENCH_COLS": "4096",
+            "PTPU_TRBENCH_PULL_OPS": "300",
+            "PTPU_CPRBENCH_OLD_PRED_SO": so,
+            "PTPU_CPRBENCH_OLD_PS_SO": ps_so,
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH",
+                                                      ""),
+        })
+        env.pop("XLA_FLAGS", None)
+        r = subprocess.run([sys.executable, BENCH, "--cpr", "--out",
+                            out], cwd=REPO, env=env,
+                           capture_output=True, text=True, timeout=600)
+        assert r.returncode == 0, \
+            f"rc={r.returncode}\nstdout:{r.stdout[-2000:]}\n" \
+            f"stderr:{r.stderr[-2000:]}"
+        with open(out) as f:
+            return json.load(f)
+
+    def test_schema_and_counters_cpr(self, cpr_out):
+        assert cpr_out["bench"] == "serving_bench --cpr"
+        assert cpr_out["planes"] == ["serving", "ps"]
+        by = {r["metric"]: r for r in cpr_out["measurements"]}
+        for plane in ("serving", "ps"):
+            row = by[f"cpr_ab_{plane}"]
+            # both CPU columns on every leg: the version-independent
+            # host rusage measurement and the /statsz cpu_us counters
+            # (non-None here — both sides run the new .so)
+            for leg in row["old"] + row["new"]:
+                assert leg["host_cpu_us_per_req"] > 0
+                assert leg["sv_cpu_us_per_req"] > 0
+                assert leg["exact"] is True
+            assert row["old_ops_per_s"] > 0
+            assert row["new_ops_per_s"] > 0
+            assert isinstance(row["meets_gate"], bool)
+        assert by["cpr_ab_counters_exact"]["value"] == 1
+        # identical sides must read as ~equal CPU (the A/B is paired,
+        # not noise): |reduction| under 30% even on a loaded box
+        srv = by["cpr_ab_serving"]
+        assert abs(srv["cpu_reduction_pct"]) < 30.0, srv
+
+    def test_normal_phase_rows_carry_cpu_columns(self, bench_out):
+        """The plain bench's phase rows grew the cycles/request
+        columns (ISSUE 17): /statsz cpu_us per request and the host
+        rusage twin."""
+        by = {r["metric"]: r for r in bench_out["measurements"]}
+        for m in ("serve_seq_batch1_ops_per_s",
+                  "serve_concurrent_nobatch_ops_per_s",
+                  "serve_concurrent_batched_ops_per_s"):
+            row = by[m]
+            assert row["sv_cpu_us_per_req"] > 0, row
+            assert row["host_cpu_us_per_req"] > 0, row

@@ -231,6 +231,38 @@ class TestWireChecker:
         msgs = [f.message for f in _run(root, "wire")]
         assert any("DECODE_SPEC_REP accepted" in m for m in msgs)
 
+    def test_catches_scatter_rewrite(self, tmp_path):
+        """ISSUE 17: rewriting the INFER_REP send back to a copied
+        frame (dropping SendScatter) silently loses the zero-copy
+        reply path — the probe must fire."""
+        root = _fixture(tmp_path, WIRE_FILES)
+        _mutate(root, "csrc/ptpu_serving.cc",
+                "SendScatter(std::move(head)",
+                "SendPayload(std::move(head)")
+        msgs = [f.message for f in _run(root, "wire")]
+        assert any("scatter send" in m for m in msgs)
+
+    def test_catches_infer_rep_count_offset_drift(self, tmp_path):
+        """The scatter head owns the n_outputs field; moving it off
+        ho + 8 desyncs the Python client's unpack at payload 10."""
+        root = _fixture(tmp_path, WIRE_FILES)
+        _mutate(root, "csrc/ptpu_serving.cc",
+                "std::memcpy(head.data() + ho + 8, &no16, 2);",
+                "std::memcpy(head.data() + ho + 6, &no16, 2);")
+        msgs = [f.message for f in _run(root, "wire")]
+        assert any("INFER_REP n_outputs" in m for m in msgs)
+
+    def test_catches_unpinned_ingestion(self, tmp_path):
+        """Dropping the reassembly-buffer pin turns every borrowed
+        input view into a dangling pointer past the frame handler —
+        the in-place ingestion probe must fire."""
+        root = _fixture(tmp_path, WIRE_FILES)
+        _mutate(root, "csrc/ptpu_serving.cc",
+                "r.pin = conn->PinInbuf(req, n);",
+                "r.pin = nullptr;")
+        msgs = [f.message for f in _run(root, "wire")]
+        assert any("PinInbuf" in m for m in msgs)
+
 
 class TestStatsChecker:
     def test_clean_fixture(self, tmp_path):
@@ -363,6 +395,49 @@ class TestNetChecker:
                 "  std::vector<std::thread> conn_threads;")
         msgs = [f.message for f in _run(root, "net")]
         assert any("thread-per-connection" in m for m in msgs)
+
+    def test_catches_staging_assign_on_hot_path(self, tmp_path):
+        """ISSUE 17: a frame handler growing a whole-payload
+        range-copy out of the reassembly buffer reverts the zero-copy
+        ingestion path — flagged unless pin-guarded."""
+        root = _fixture(tmp_path, NET_FILES)
+        _mutate(root, "csrc/ptpu_ps_server.cc",
+                "std::memcpy(&cnt, req + off, 4);",
+                "stage.assign(req + off, req + off + body);\n"
+                "      std::memcpy(&cnt, req + off, 4);")
+        msgs = [f.message for f in _run(root, "net")]
+        assert any("whole-payload range-assign" in m for m in msgs)
+
+    def test_catches_staging_memcpy_on_hot_path(self, tmp_path):
+        """The memcpy shape of the same regression: sourcing req with
+        a runtime payload size (fixed-size header reads pass)."""
+        root = _fixture(tmp_path, NET_FILES)
+        _mutate(root, "csrc/ptpu_ps_server.cc",
+                "std::memcpy(&cnt, req + off, 4);",
+                "std::memcpy(stage, req + off, body);\n"
+                "      std::memcpy(&cnt, req + off, 4);")
+        msgs = [f.message for f in _run(root, "net")]
+        assert any("whole-payload memcpy" in m for m in msgs)
+
+    def test_catches_unguarded_fallback_copy(self, tmp_path):
+        """The serving INFER fallback assign is allowlisted ONLY by
+        the .pin guard just above it; renaming the guard away must
+        re-flag the copy (proves the allowlist is the guard, not the
+        file)."""
+        root = _fixture(tmp_path, NET_FILES)
+        _mutate(root, "csrc/ptpu_serving.cc",
+                "if (r.pin) {", "if (always_copy) {")
+        msgs = [f.message for f in _run(root, "net")]
+        assert any("whole-payload range-assign" in m for m in msgs)
+
+    def test_allows_pin_guarded_fallback_copy(self, tmp_path):
+        """The Detached-conn dynamic fallback IS a whole-payload
+        assign — pinned here as an anchor so a refactor that moves it
+        away from its guard fails loudly (clean == allowlist works)."""
+        root = _fixture(tmp_path, NET_FILES)
+        src = (root / "csrc" / "ptpu_serving.cc").read_text()
+        assert "in.data.assign(req + off, req + off + nb);" in src
+        assert _run(root, "net") == []
 
 
 class TestNullcheckChecker:

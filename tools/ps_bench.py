@@ -184,6 +184,16 @@ def main():
             sys.exit("usage: ps_bench.py [--out RESULTS.json]")
         out_path = sys.argv[idx + 1]
 
+    if "--cpr" in sys.argv:
+        # interleaved old-vs-new-.so A/B of the PS plane (ISSUE 17):
+        # the shared subprocess-leg harness lives in serving_bench;
+        # restrict it to the ps pull leg
+        os.environ["PTPU_CPRBENCH_PLANES"] = "ps"
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from tools import serving_bench
+        serving_bench.run_cpr_ab(out_path)
+        return
+
     world = 1 + NCLIENTS
     port = 29650
     q: "mp.Queue" = mp.Queue()
@@ -267,6 +277,17 @@ def main():
                     .get("push_coalesced_rows")),
           "server_async_merged_frames":
               wire.get("async_push_merged_frames", 0)})
+
+    # ISSUE 17 cycles-per-request column: event-thread CPU per wire op
+    # from the new cpu_us counter (None on a pre-r17 .so)
+    total_wire_ops = wire.get("pull_ops", 0) + wire.get("push_ops", 0)
+    cpu = wire.get("cpu_us")
+    emit({"metric": "ps_cpu_us_per_op",
+          "value": (None if cpu is None or not total_wire_ops
+                    else round(cpu / total_wire_ops, 2)),
+          "unit": "us/op", "pull_ops": wire.get("pull_ops"),
+          "push_ops": wire.get("push_ops"), "cpu_us": cpu,
+          "native_table": native_engaged})
 
     if out_path:
         with open(out_path, "w") as f:

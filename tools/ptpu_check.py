@@ -487,6 +487,37 @@ def check_wire(root: str) -> List[Finding]:
                              "INFER reply count at payload offset 10 "
                              "not found (layout probe)"))
 
+        # Zero-copy wire path probes (ISSUE 17). The INFER parser
+        # pins the conn's reassembly buffer and borrows views into
+        # it; the INFER_REP writer owns only the head — [4B len][ver]
+        # [tag](+tid)[u64 id][u16 n_outputs @ho+8] + output 0's
+        # metadata — and ships payload rows as SendScatter iovecs
+        # pointing into the pinned predictor outputs. A rewrite back
+        # to copied frames (or a moved count offset) drops a probe.
+        if not re.search(r"PinInbuf\(req,\s*n\)", clean):
+            f.append(Finding("wire", sv_rel, 0,
+                             "INFER parse does not pin the reassembly "
+                             "buffer (PinInbuf) — in-place ingestion "
+                             "probe"))
+        m = re.search(r"memcpy\(head\.data\(\)\s*\+\s*ho\s*\+\s*(\d+),"
+                      r"\s*&no16,\s*2\)", clean)
+        if m is None:
+            f.append(Finding("wire", sv_rel, 0,
+                             "INFER_REP n_outputs write into the "
+                             "scatter head not found (layout probe)"))
+        elif int(m.group(1)) != 8:
+            f.append(Finding(
+                "wire", sv_rel, _lineno(clean, m.start()),
+                f"INFER_REP n_outputs lands at head ho+{m.group(1)}; "
+                f"expected ho + 8 (== payload 10, where the Python "
+                f"client unpacks it)"))
+        if not re.search(r"SendScatter\(std::move\(head\)", clean):
+            f.append(Finding("wire", sv_rel, 0,
+                             "INFER_REP scatter send not found — "
+                             "replies must ship predictor output rows "
+                             "as pinned iovec segments (SendScatter), "
+                             "not copied frames (zero-copy probe)"))
+
         # DECODE layout probes (r9, traced offsets r10). STEP payload
         # is [ver][tag](+trace id)[u64 req_id][u64 session][i64 token]
         # = 26 + ext bytes — the C parser must pin exactly that. The
@@ -662,7 +693,11 @@ def py_stat_names(src: str) -> Set[str]:
 PS_SERVER_C_ONLY = {"handshake_fails", "conns_accepted", "conns_active",
                     "conns_shed", "handshake_timeouts", "idle_closes",
                     "epoll_wakeups", "partial_write_flushes",
-                    "http_reqs"}
+                    "http_reqs",
+                    # event-thread CPU time per plane (ISSUE 17): a
+                    # CLOCK_THREAD_CPUTIME_ID aggregate only the native
+                    # server can measure
+                    "cpu_us"}
 
 
 def check_stats(root: str) -> List[Finding]:
@@ -904,6 +939,36 @@ def check_net(root: str) -> List[Finding]:
                 "per-connection thread bookkeeping reappeared — the "
                 "thread-per-connection pattern is banned in the wire "
                 "servers (C10K: connections cost fds, not threads)"))
+    # 4) zero-copy hot path (ISSUE 17): frame handlers parse payloads
+    #    in place in the conn's reassembly buffer — a whole-payload
+    #    copy out of `req` into staging storage is banned. Two shapes
+    #    are caught: a range .assign(req ...) and a memcpy sourcing
+    #    req with a runtime payload-size identifier (fixed header
+    #    reads pass — their size is a literal or a bounded-ndim
+    #    expression). The ONE allowed staging copy is the dynamic
+    #    fallback for unpinnable (Detached) conns, proven by a
+    #    PinInbuf()/.pin guard in the immediately preceding context.
+    for rel in NET_SERVER_FILES:
+        src = _read(root, rel)
+        if src is None:
+            continue
+        clean = strip_c_comments(src)
+        hits = [(m.start(), "range-assign")
+                for m in re.finditer(r"\.assign\(\s*req\b", clean)]
+        hits += [(m.start(), "memcpy")
+                 for m in re.finditer(
+                     r"memcpy\([^;()]*,\s*req\s*\+[^;()]*,\s*"
+                     r"[A-Za-z_]\w*\s*\)", clean)]
+        for pos, kind in sorted(hits):
+            ctx = clean[max(0, pos - 600):pos]
+            if "PinInbuf" in ctx or re.search(r"\.pin\b", ctx):
+                continue  # dynamic fallback for unpinnable conns
+            f.append(Finding(
+                "net", rel, _lineno(clean, pos),
+                f"whole-payload {kind} from the reassembly buffer "
+                f"into staging on a frame-handler hot path — parse "
+                f"in place (PinInbuf + borrowed views); only the "
+                f"pin-guarded Detached-conn fallback may copy"))
     return f
 
 

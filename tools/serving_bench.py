@@ -87,7 +87,7 @@ def build_mlp_artifact(tmp):
     return path
 
 
-def _client(rank, port, authkey, ops, warm, barrier, q):
+def _client(rank, port, authkey, ops, warm, cols, barrier, q):
     """Closed-loop client process. Loads the serving client module
     STANDALONE (socket + numpy only) — no paddle_tpu/jax import."""
     import importlib.util
@@ -100,7 +100,7 @@ def _client(rank, port, authkey, ops, warm, barrier, q):
     spec.loader.exec_module(sv)
 
     cli = sv.InferenceClient(port, authkey)
-    x = np.random.RandomState(rank).randn(1, 512).astype(np.float32)
+    x = np.random.RandomState(rank).randn(1, cols).astype(np.float32)
     for _ in range(warm):
         cli.infer(x)
     barrier.wait(timeout=600)   # A: everyone warm; parent resets stats
@@ -114,7 +114,10 @@ def _client(rank, port, authkey, ops, warm, barrier, q):
     cli.close()
 
 
-def run_phase(model_path, clients, ops, max_batch, deadline_us):
+def run_phase(model_path, clients, ops, max_batch, deadline_us,
+              cols=512):
+    import resource
+
     from paddle_tpu.inference.serving import create_server
 
     srv = create_server(model_path, max_batch=max_batch,
@@ -123,16 +126,23 @@ def run_phase(model_path, clients, ops, max_batch, deadline_us):
     barrier = mp.Barrier(clients + 1)
     q: "mp.Queue" = mp.Queue()
     ps = [mp.Process(target=_client,
-                     args=(r, srv.port, srv.authkey, ops, WARM,
+                     args=(r, srv.port, srv.authkey, ops, WARM, cols,
                            barrier, q))
           for r in range(clients)]
     for p in ps:
         p.start()
     barrier.wait(timeout=600)   # A: clients warm
     srv.stats_reset()
+    # server CPU per request (ISSUE 17): the server's native threads
+    # live in THIS process, the clients in their own — a
+    # getrusage(SELF) delta over the measured window divided by the
+    # request count is server CPU/request on ANY .so build (the
+    # /statsz cpu_us counters only exist on the new one)
+    ru0 = resource.getrusage(resource.RUSAGE_SELF)
     barrier.wait(timeout=600)   # B: go
     res = [q.get(timeout=600) for _ in range(clients)]
     barrier.wait(timeout=600)   # C: counters final
+    ru1 = resource.getrusage(resource.RUSAGE_SELF)
     stats = srv.stats()
     config = srv.config()
     for p in ps:
@@ -140,7 +150,22 @@ def run_phase(model_path, clients, ops, max_batch, deadline_us):
     srv.stop()
     wall = max(r["dt"] for r in res)
     total = sum(r["ops"] for r in res)
-    return total / wall, stats, config, total
+    host_cpu_us = ((ru1.ru_utime - ru0.ru_utime) +
+                   (ru1.ru_stime - ru0.ru_stime)) * 1e6
+    return total / wall, stats, config, total, host_cpu_us / total
+
+
+def _cpu_cols(stats, total, host_cpu_per_req):
+    """The two cycles-per-request columns every phase row carries:
+    /statsz cpu_us (serving + decode planes; None on a pre-r17 .so)
+    and the host rusage measurement."""
+    sv = stats["server"]
+    cpu = sv.get("cpu_us")
+    if cpu is not None:
+        cpu += (stats.get("decode") or {}).get("cpu_us", 0)
+    return {"sv_cpu_us_per_req":
+                None if cpu is None else round(cpu / max(1, total), 2),
+            "host_cpu_us_per_req": round(host_cpu_per_req, 2)}
 
 
 # ---------------------------------------------------------------------------
@@ -275,7 +300,7 @@ def run_trace_ab(out_path):
             for name, (sample, slow) in (configs if rnd % 2 == 0
                                          else configs[::-1]):
                 sv_lib.ptpu_trace_set(sample, slow)
-                ops, stats, _, total = run_phase(
+                ops, stats, _, total, _ = run_phase(
                     model, clients=NCLIENTS, ops=OPS,
                     max_batch=MAX_BATCH, deadline_us=DEADLINE_US)
                 results["serving_batched"][name].append(round(ops, 1))
@@ -334,8 +359,312 @@ def run_trace_ab(out_path):
         print(f"# persisted to {out_path}", flush=True)
 
 
+# ---------------------------------------------------------------------------
+# --cpr: cycles-per-request old-vs-new-.so A/B (ISSUE 17 acceptance).
+#
+# The zero-copy tentpole rewrote the request lifecycle (in-place
+# ingestion + scatter replies), so the gated metric is SERVER CPU per
+# request at equal throughput, not throughput alone — a closed-loop
+# bench on a small box hides CPU savings behind client time. The r10
+# A/B methodology, applied to .so builds: the OLD side is built from
+# git HEAD in a temp worktree, each leg runs in a fresh SUBPROCESS
+# with PTPU_PREDICTOR_SO / PTPU_PS_SO pointing at its side (a loaded
+# CDLL can't be swapped in-process), and leg order alternates per
+# round so session drift cancels. Every leg reports two CPU columns:
+#
+#   host_cpu_us_per_req — getrusage(SELF) over the measured window
+#       (the server's native threads live in the leg process, the
+#       serving clients do not); comparable across .so versions —
+#       this is the column the 15% gate reads;
+#   sv_cpu_us_per_req   — the new /statsz cpu_us counters (None on
+#       the old .so; sanity column on the new).
+#
+# The serving artifact is wire-weighted (elementwise over wide f32
+# rows): a GEMM-heavy model buries the request lifecycle under matmul
+# time and cannot observe a wire-path change at all. PS and decode
+# legs ride along under the 10% throughput guards.
+# ---------------------------------------------------------------------------
+
+CPR_COLS = int(os.environ.get("PTPU_CPRBENCH_COLS", 16384))
+CPR_ROUNDS = int(os.environ.get("PTPU_CPRBENCH_ROUNDS", 3))
+CPR_DECODE_ROUNDS = int(os.environ.get("PTPU_CPRBENCH_DECODE_ROUNDS",
+                                       36))
+CPR_PLANES = [p for p in os.environ.get(
+    "PTPU_CPRBENCH_PLANES", "serving,ps,decode").split(",") if p]
+
+
+def build_wire_artifact(tmp):
+    """Elementwise y = x + 1 over (1, CPR_COLS) f32 rows: per-request
+    bytes dominate per-request FLOPs, so the request lifecycle IS the
+    measured work."""
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.onnx.converter import trace_to_onnx
+
+    x = np.zeros((1, CPR_COLS), np.float32)
+    path = os.path.join(tmp, "wire.onnx")
+    with open(path, "wb") as f:
+        f.write(trace_to_onnx(lambda a: a + 1.0, (jnp.asarray(x),)))
+    return path
+
+
+def build_decode_artifact(tmp):
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import (GPTForPretraining,
+                                       export_gpt_decode, gpt_tiny)
+
+    pt.seed(0)
+    cfg = gpt_tiny(dtype=jnp.float32, dropout=0.0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    return export_gpt_decode(model, os.path.join(tmp, "dec"),
+                             batch=8, context=48)
+
+
+def _ru_us():
+    import resource
+    r = resource.getrusage(resource.RUSAGE_SELF)
+    return (r.ru_utime + r.ru_stime) * 1e6
+
+
+def run_cpr_leg(plane):
+    """One measured leg in THIS process (the parent spawned us with
+    PTPU_PREDICTOR_SO / PTPU_PS_SO routing the native load). Prints a
+    single `CPRLEG {json}` line for the parent."""
+    if plane == "serving":
+        model = os.environ["PTPU_CPRLEG_MODEL"]
+        ops, stats, _, total, host_cpu = run_phase(
+            model, clients=NCLIENTS, ops=OPS, max_batch=MAX_BATCH,
+            deadline_us=DEADLINE_US, cols=CPR_COLS)
+        sv = stats["server"]
+        out = {"plane": "serving", "ops_per_s": round(ops, 1),
+               "exact": bool(sv["requests"] == total and
+                             sv["replies"] == total and
+                             sv["req_errors"] == 0),
+               **_cpu_cols(stats, total, host_cpu)}
+    elif plane == "ps":
+        from paddle_tpu.core import native as N
+        key = b"cpr-ps-key"
+        srv = N.PsDataServer(0, key)
+        tbl = N.NativePsTable(max(PULL_ROWS * 4, 4096), 64,
+                              optimizer="sgd", lr=0.1)
+        srv.register("emb", tbl, 0)
+        s = _ps_pull_connect(srv.port, key)
+        # unrecorded warm leg (cold caches bias whichever side is
+        # first), then one measured pull loop; the loop's own small
+        # internal warm-up is folded into the CPU denominator
+        _ps_pull_ops_per_s(s, max(200, PULL_OPS // 8), PULL_ROWS,
+                           PULL_DEPTH)
+        st0 = (srv.stats() or {}).get("server") or {}
+        c0 = _ru_us()
+        pull = _ps_pull_ops_per_s(s, PULL_OPS, PULL_ROWS, PULL_DEPTH)
+        done_ops = PULL_OPS + min(64, PULL_OPS // 4)
+        host = (_ru_us() - c0) / done_ops
+        st1 = (srv.stats() or {}).get("server") or {}
+        cpu = None
+        if "cpu_us" in st1:
+            cpu = round((st1["cpu_us"] - st0.get("cpu_us", 0)) /
+                        max(1, st1["pull_ops"] - st0.get("pull_ops",
+                                                         0)), 2)
+        out = {"plane": "ps", "ops_per_s": round(pull, 1),
+               "sv_cpu_us_per_req": cpu,
+               "host_cpu_us_per_req": round(host, 2),
+               "exact": bool(st1.get("proto_errors", 0) == 0 and
+                             st1.get("err_frames", 0) == 0)}
+        s.close()
+        srv.stop()
+    elif plane == "decode":
+        from paddle_tpu.inference.serving import create_server
+        model = os.environ["PTPU_CPRLEG_MODEL"]
+        dec = os.environ["PTPU_CPRLEG_DECODE"]
+        srv = create_server(model, max_batch=8,
+                            deadline_us=DEADLINE_US, instances=1,
+                            decode_model=dec)
+        cli = srv.client()
+        sessions = [cli.decode_open() for _ in range(8)]
+        tok = 3
+        for _ in range(4):  # warm: plans every step bucket
+            cli.decode_step_many([(sess, tok) for sess in sessions])
+            tok += 1
+        st0 = (srv.stats().get("decode") or {})
+        c0 = _ru_us()
+        t0 = time.perf_counter()
+        steps = 0
+        for _ in range(CPR_DECODE_ROUNDS):
+            cli.decode_step_many([(sess, tok) for sess in sessions])
+            tok += 1
+            steps += len(sessions)
+        dt = time.perf_counter() - t0
+        host = (_ru_us() - c0) / steps
+        st1 = (srv.stats().get("decode") or {})
+        cpu = None
+        if "cpu_us" in st1:
+            cpu = round((st1["cpu_us"] - st0.get("cpu_us", 0)) /
+                        max(1, steps), 2)
+        got = st1.get("steps", 0) - st0.get("steps", 0)
+        out = {"plane": "decode", "ops_per_s": round(steps / dt, 1),
+               "sv_cpu_us_per_req": cpu,
+               "host_cpu_us_per_req": round(host, 2),
+               "exact": bool(got == steps)}
+        for sess in sessions:
+            cli.decode_close(sess)
+        cli.close()
+        srv.stop()
+    else:
+        sys.exit(f"unknown cpr leg plane {plane!r}")
+    print("CPRLEG " + json.dumps(out), flush=True)
+
+
+def _cpr_spawn_leg(plane, so_pred, so_ps, extra_env):
+    import subprocess
+    env = dict(os.environ)
+    env.update({"PTPU_PREDICTOR_SO": so_pred, "PTPU_PS_SO": so_ps,
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO + os.pathsep +
+                              env.get("PYTHONPATH", "")})
+    env.update(extra_env)
+    r = subprocess.run([sys.executable, os.path.abspath(__file__),
+                        "--cpr-leg", plane], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=1800)
+    if r.returncode != 0:
+        sys.exit(f"cpr {plane} leg failed (so={so_pred}):\n"
+                 f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
+    for line in reversed(r.stdout.splitlines()):
+        if line.startswith("CPRLEG "):
+            return json.loads(line[len("CPRLEG "):])
+    sys.exit(f"cpr {plane} leg printed no CPRLEG row:\n"
+             f"{r.stdout[-2000:]}")
+
+
+def _build_old_tree(tmp):
+    """Build the pre-PR .so pair from git HEAD in a temp worktree."""
+    import subprocess
+    tree = os.path.join(tmp, "old_tree")
+    subprocess.run(["git", "worktree", "add", "--detach", tree,
+                    "HEAD"], cwd=REPO, check=True, capture_output=True)
+    try:
+        subprocess.run(["make", "all", "MARCH=-march=native"],
+                       cwd=os.path.join(tree, "csrc"), check=True,
+                       capture_output=True, timeout=1200)
+    except subprocess.CalledProcessError as e:
+        sys.exit(f"old-tree build failed:\n{e.stderr[-2000:]}")
+    return (os.path.join(tree, "paddle_tpu", "_native_predictor.so"),
+            os.path.join(tree, "paddle_tpu", "_native_ps.so"))
+
+
+def _cpr_cleanup_worktree(tmp):
+    import subprocess
+    tree = os.path.join(tmp, "old_tree")
+    if os.path.isdir(tree):
+        subprocess.run(["git", "worktree", "remove", "--force", tree],
+                       cwd=REPO, capture_output=True)
+
+
+def run_cpr_ab(out_path):
+    import tempfile
+
+    build_native()
+    new_pred = os.path.join(REPO, "paddle_tpu",
+                            "_native_predictor.so")
+    new_ps = os.path.join(REPO, "paddle_tpu", "_native_ps.so")
+    planes = CPR_PLANES
+    with tempfile.TemporaryDirectory() as tmp:
+        try:
+            # smoke tests point both sides at one build to skip the
+            # worktree compile; the real run builds HEAD
+            old_pred = os.environ.get("PTPU_CPRBENCH_OLD_PRED_SO")
+            old_ps = os.environ.get("PTPU_CPRBENCH_OLD_PS_SO",
+                                    new_ps)
+            if not old_pred:
+                old_pred, old_ps = _build_old_tree(tmp)
+            extra = {}
+            if "serving" in planes or "decode" in planes:
+                extra["PTPU_CPRLEG_MODEL"] = build_wire_artifact(tmp)
+            if "decode" in planes:
+                extra["PTPU_CPRLEG_DECODE"] = \
+                    build_decode_artifact(tmp)
+            sides = {"old": (old_pred, old_ps),
+                     "new": (new_pred, new_ps)}
+            res = {p: {"old": [], "new": []} for p in planes}
+            for rnd in range(CPR_ROUNDS):
+                order = (["old", "new"] if rnd % 2 == 0
+                         else ["new", "old"])
+                for plane in planes:
+                    for side in order:
+                        leg = _cpr_spawn_leg(plane, *sides[side],
+                                             extra)
+                        res[plane][side].append(leg)
+                        print(f"# r{rnd} {plane}/{side}: "
+                              f"{leg['ops_per_s']} ops/s, "
+                              f"{leg['host_cpu_us_per_req']} cpu us/"
+                              f"req", flush=True)
+        finally:
+            _cpr_cleanup_worktree(tmp)
+
+    def mean(vals):
+        return sum(vals) / len(vals)
+
+    all_exact = True
+    gates_ok = True
+    for plane in planes:
+        legs = res[plane]
+        all_exact = all_exact and all(
+            leg["exact"] for s in ("old", "new") for leg in legs[s])
+        old_cpu = mean([leg["host_cpu_us_per_req"]
+                        for leg in legs["old"]])
+        new_cpu = mean([leg["host_cpu_us_per_req"]
+                        for leg in legs["new"]])
+        old_ops = mean([leg["ops_per_s"] for leg in legs["old"]])
+        new_ops = mean([leg["ops_per_s"] for leg in legs["new"]])
+        reduction = (old_cpu - new_cpu) / old_cpu * 100.0
+        tp_ratio = new_ops / old_ops
+        if plane == "serving":
+            # the headline gate: >= 15% less CPU/request at equal
+            # (>= 90%) throughput
+            ok = reduction >= 15.0 and tp_ratio >= 0.90
+        else:
+            # guard planes: not slower than the 10% band
+            ok = tp_ratio >= 0.90
+        gates_ok = gates_ok and ok
+        emit({"metric": f"cpr_ab_{plane}", "unit": "us/req",
+              "old_host_cpu_us_per_req": round(old_cpu, 2),
+              "new_host_cpu_us_per_req": round(new_cpu, 2),
+              "new_sv_cpu_us_per_req":
+                  legs["new"][-1]["sv_cpu_us_per_req"],
+              "cpu_reduction_pct": round(reduction, 2),
+              "old_ops_per_s": round(old_ops, 1),
+              "new_ops_per_s": round(new_ops, 1),
+              "throughput_ratio": round(tp_ratio, 3),
+              "rounds": CPR_ROUNDS,
+              "old": legs["old"], "new": legs["new"],
+              "acceptance": ("cpu_reduction>=15% and tp>=0.9x"
+                             if plane == "serving" else "tp>=0.9x"),
+              "meets_gate": bool(ok)})
+    emit({"metric": "cpr_ab_counters_exact", "value": int(all_exact),
+          "unit": "bool"})
+    emit({"metric": "cpr_ab_gates", "value": int(gates_ok),
+          "unit": "bool"})
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump({"bench": "serving_bench --cpr",
+                       "clients": NCLIENTS, "ops": OPS,
+                       "max_batch": MAX_BATCH,
+                       "deadline_us": DEADLINE_US,
+                       "instances": INSTANCES, "cols": CPR_COLS,
+                       "rounds": CPR_ROUNDS, "planes": planes,
+                       "pull": {"ops": PULL_OPS, "rows": PULL_ROWS,
+                                "depth": PULL_DEPTH},
+                       "measurements": RESULTS}, f, indent=1)
+        print(f"# persisted to {out_path}", flush=True)
+
+
 def main():
     import tempfile
+
+    if "--cpr-leg" in sys.argv:
+        run_cpr_leg(sys.argv[sys.argv.index("--cpr-leg") + 1])
+        return
 
     out_path = None
     if "--out" in sys.argv:
@@ -343,6 +672,10 @@ def main():
         if idx + 1 >= len(sys.argv):
             sys.exit("usage: serving_bench.py [--out RESULTS.json]")
         out_path = sys.argv[idx + 1]
+
+    if "--cpr" in sys.argv:
+        run_cpr_ab(out_path)
+        return
 
     if "--trace" in sys.argv:
         run_trace_ab(out_path)
@@ -353,24 +686,26 @@ def main():
     with tempfile.TemporaryDirectory() as tmp:
         model = build_mlp_artifact(tmp)
 
-        seq_ops, seq_stats, _, seq_total = run_phase(
+        seq_ops, seq_stats, _, seq_total, seq_cpu = run_phase(
             model, clients=1, ops=OPS, max_batch=1,
             deadline_us=DEADLINE_US)
         phases["seq_batch1"] = seq_stats
         emit({"metric": "serve_seq_batch1_ops_per_s",
               "value": round(seq_ops, 1), "unit": "ops/s",
-              "clients": 1, "max_batch": 1, "ops": seq_total})
+              "clients": 1, "max_batch": 1, "ops": seq_total,
+              **_cpu_cols(seq_stats, seq_total, seq_cpu)})
 
-        nb_ops, nb_stats, _, nb_total = run_phase(
+        nb_ops, nb_stats, _, nb_total, nb_cpu = run_phase(
             model, clients=NCLIENTS, ops=OPS, max_batch=1,
             deadline_us=DEADLINE_US)
         phases["concurrent_nobatch"] = nb_stats
         emit({"metric": "serve_concurrent_nobatch_ops_per_s",
               "value": round(nb_ops, 1), "unit": "ops/s",
               "clients": NCLIENTS, "max_batch": 1,
-              "instances": INSTANCES, "ops": nb_total})
+              "instances": INSTANCES, "ops": nb_total,
+              **_cpu_cols(nb_stats, nb_total, nb_cpu)})
 
-        b_ops, b_stats, b_cfg, b_total = run_phase(
+        b_ops, b_stats, b_cfg, b_total, b_cpu = run_phase(
             model, clients=NCLIENTS, ops=OPS, max_batch=MAX_BATCH,
             deadline_us=DEADLINE_US)
         phases["concurrent_batched"] = b_stats
@@ -384,7 +719,8 @@ def main():
               "deadline_us": DEADLINE_US, "instances": INSTANCES,
               "buckets": b_cfg["buckets"], "ops": b_total,
               "mean_batch_fill": round(mean_fill, 2),
-              "mean_e2e_us": round(mean_e2e, 1)})
+              "mean_e2e_us": round(mean_e2e, 1),
+              **_cpu_cols(b_stats, b_total, b_cpu)})
 
         ratio = b_ops / seq_ops
         emit({"metric": "serve_batched_over_seq_ratio",
