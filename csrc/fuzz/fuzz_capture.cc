@@ -1,0 +1,52 @@
+// Fuzz target: the capture-file parser — ptpu::capture::
+// ParseCaptureBytes in csrc/ptpu_capture.h (header + record array,
+// the ptpu_drill harness). Capture files are UNTRUSTED DISK INPUT:
+// tools/drill_replay.py writes them, operators copy them between
+// machines, and anything on the capture path can feed stale or
+// corrupt bytes back into the replay pipeline — so the parser gets
+// the same treatment as the tune cache: bounds-checked, fuzzed, and
+// every malformed shape is a whole-file reject (kMalformed), never a
+// crash or a partial adopt.
+//
+// Harness shape: bytes in, ParseCaptureBytes. Well-formed inputs
+// additionally round-trip through SerializeCapture and must re-parse
+// identically (same count, same record fields, same payload bytes) —
+// canonicalization bugs abort here instead of silently rewriting a
+// drill capture. The Python twin of both directions lives in
+// tools/drill_replay.py; tools/ptpu_check.py pins the two layouts
+// together.
+//
+// Corpus: csrc/fuzz/corpus/capture (valid files, truncations, huge
+// counts, ver/tag-vs-payload mismatches — csrc/fuzz/gen_seeds.py).
+// Build: `make fuzz`.
+#include "../ptpu_capture.h"
+
+#include <cassert>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  if (size > (1u << 20)) return 0;
+  namespace cp = ptpu::capture;
+  std::vector<cp::CapRecord> out;
+  const cp::ParseResult r = cp::ParseCaptureBytes(data, size, &out);
+  if (r != cp::ParseResult::kOk) return 0;
+  // canonical round trip: serialize the parsed records and re-parse
+  std::vector<uint8_t> bytes;
+  cp::SerializeCapture(out, &bytes);
+  std::vector<cp::CapRecord> again;
+  const cp::ParseResult r2 =
+      cp::ParseCaptureBytes(bytes.data(), bytes.size(), &again);
+  assert(r2 == cp::ParseResult::kOk);
+  assert(again.size() == out.size());
+  for (size_t i = 0; i < out.size(); ++i) {
+    assert(again[i].ts_us == out[i].ts_us);
+    assert(again[i].conn == out[i].conn);
+    assert(again[i].frame_len == out[i].frame_len);
+    assert(again[i].ver == out[i].ver);
+    assert(again[i].tag == out[i].tag);
+    assert(again[i].payload == out[i].payload);
+  }
+  return 0;
+}

@@ -226,6 +226,32 @@ def tune_cache(recs, magic=TUNE_MAGIC, version=1, sig=0x1122334455667788,
     return struct.pack("<IIQI", magic, version, sig, n) + body
 
 
+# ---------------------------------------------------------------------------
+# capture files (twin of ptpu_capture.h: "PCAP" header + 28-byte
+# records + per-record payload; tools/drill_replay.py carries the
+# SAME constants and tools/ptpu_check.py pins them together)
+# ---------------------------------------------------------------------------
+
+CAPTURE_MAGIC = 0x50414350  # "PCAP" little-endian
+
+
+def capture_rec(ts=1000, conn=7, payload=b"\x01\x60" + b"\x00" * 10,
+                frame_len=None, ver=None, tag=None, reserved=0):
+    flen = len(payload) if frame_len is None else frame_len
+    v = (payload[0] if len(payload) >= 1 else 0) if ver is None else ver
+    t = (payload[1] if len(payload) >= 2 else 0) if tag is None else tag
+    return struct.pack("<qQIIBBH", ts, conn, flen, len(payload),
+                       v, t, reserved) + payload
+
+
+def capture_file(recs, magic=CAPTURE_MAGIC, version=1, count=None,
+                 body=None):
+    blob = b"".join(recs)
+    n = len(recs) if count is None else count
+    b = len(blob) if body is None else body
+    return struct.pack("<IIII", magic, version, n, b) + blob
+
+
 def main():
     # ---- wire_ps ----
     w("wire_ps", "seed-pull-v1.bin", ps_pull())
@@ -361,6 +387,7 @@ def main():
     w("http", "seed-tracez.bin", req(b"GET /tracez?n=5 HTTP/1.1"))
     w("http", "seed-tracez-multi-key.bin",
       req(b"GET /tracez?conn=1&n=2 HTTP/1.1"))
+    w("http", "seed-capturez.bin", req(b"GET /capturez?n=5 HTTP/1.1"))
     w("http", "seed-404.bin", req(b"GET /nope HTTP/1.1"))
     w("http", "seed-post.bin", req(b"POST /healthz HTTP/1.1"))
     w("http", "seed-http10-keepalive.bin",
@@ -456,6 +483,44 @@ def main():
     w("tune", "seed-overflow-dims.bin",
       tune_cache([tune_rec(m=1 << 50, n=-3)]))
     w("tune", "seed-bad-dtype.bin", tune_cache([tune_rec(dtype=9)]))
+
+    # ---- capture (ptpu_drill raw-frame capture files) ----
+    w("capture", "seed-valid.bin", capture_file([
+        capture_rec(),                                  # infer-ish
+        capture_rec(ts=2000, conn=8, payload=b"\x01\x63"),   # meta
+        capture_rec(ts=3000, conn=7, payload=b"\x02\x60" + b"\x11" * 16),
+    ]))
+    w("capture", "seed-empty.bin", capture_file([]))
+    w("capture", "seed-truncated-tail.bin",
+      capture_file([capture_rec(frame_len=512)]))      # cap < frame
+    w("capture", "seed-one-byte-payload.bin",
+      capture_file([capture_rec(payload=b"\x01")]))
+    w("capture", "seed-empty-payload.bin",
+      capture_file([capture_rec(payload=b"", frame_len=64)]))
+    w("capture", "seed-trunc-header.bin", capture_file([])[:11])
+    w("capture", "seed-trunc-record.bin",
+      capture_file([capture_rec(), capture_rec(conn=9)])[:-5])
+    w("capture", "seed-padded.bin",
+      capture_file([capture_rec()]) + b"\x00")
+    w("capture", "seed-huge-count.bin",
+      capture_file([capture_rec()], count=0xFFFFFFFF))
+    w("capture", "seed-count-over-cap.bin",
+      capture_file([capture_rec()], count=65537))
+    w("capture", "seed-body-lies.bin",
+      capture_file([capture_rec()], body=4))
+    w("capture", "seed-bad-magic.bin",
+      capture_file([capture_rec()], magic=0x50414351))
+    w("capture", "seed-bad-version.bin",
+      capture_file([capture_rec()], version=9))
+    # the mirrored ver/tag fields must MATCH payload[0]/payload[1]
+    w("capture", "seed-ver-mismatch.bin",
+      capture_file([capture_rec(ver=9)]))
+    w("capture", "seed-tag-mismatch.bin",
+      capture_file([capture_rec(tag=0x99)]))
+    w("capture", "seed-reserved-set.bin",
+      capture_file([capture_rec(reserved=1)]))
+    w("capture", "seed-cap-over-max.bin",
+      capture_file([capture_rec(payload=b"\x01\x60" + b"z" * 4095)]))
 
     print("gen_seeds: corpora written under", os.path.join(HERE, "corpus"))
     return 0

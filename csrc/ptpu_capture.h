@@ -1,0 +1,431 @@
+// ptpu_capture — sampled raw-frame capture rings + the persisted
+// capture file format (ISSUE 18 tentpole a). The production-drill
+// observability plane: a lock-free fixed-slot ring (the ptpu_trace
+// seqlock pattern) records inbound FRAMED-wire frames as they are
+// dispatched — timestamp, connection id, wire ver/tag bytes, full
+// frame length, and a bounded payload prefix — so a live server can
+// dump real traffic through GET /capturez (or ptpu_capture_save) for
+// tools/drill_replay.py to re-fire against another instance.
+//
+// Shape:
+//   * Sampling: PTPU_CAPTURE_SAMPLE = 0 (default) disables everything
+//     — the zero-cost path is ONE relaxed load per frame; 1 captures
+//     every frame, N captures 1-in-N. Runtime override via the
+//     ptpu_capture_set ABI (csrc/ptpu_net.cc exports it into BOTH
+//     shipping .so's).
+//   * Ring: PTPU_CAPTURE_RING slots (pow2-rounded) with a
+//     PTPU_CAPTURE_BYTES payload-prefix cap per slot. Writers publish
+//     through the Boehm seqlock bracket (odd seq while writing, even
+//     when done); readers drop torn slots — capture is observability,
+//     not an audit log.
+//   * File format: length-prefixed little-endian records through the
+//     bounds-checked ptpu_wire.h codecs, with the r16 tune-cache
+//     posture — UNTRUSTED DISK INPUT, exact-size-first validation,
+//     whole-file reject on any malformed record, fuzzed end to end
+//     (csrc/fuzz/fuzz_capture.cc). Capture files are per-machine
+//     diagnostics, safe to delete.
+//
+// Everything is inline so the single-TU selftests and fuzz harnesses
+// (#include "ptpu_net.cc" style) see one definition; the extern "C"
+// ABI surface lives in ptpu_net.cc. Layout constants are mirrored by
+// tools/drill_replay.py — the `wire` checker in tools/ptpu_check.py
+// holds the two in lockstep.
+#ifndef PTPU_CAPTURE_H_
+#define PTPU_CAPTURE_H_
+
+#include <stdio.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "ptpu_schedck.h"
+#include "ptpu_wire.h"
+
+namespace ptpu {
+namespace capture {
+
+// ---------------------------------------------------------------------------
+// capture file format "ptpu-capture v1"
+// ---------------------------------------------------------------------------
+//
+//   [0]  u32  magic  "PCAP" (LE 0x50414350)
+//   [4]  u32  version (1)
+//   [8]  u32  count  (<= kCaptureMaxRecords)
+//   [12] u32  body_bytes (byte length of everything after the header)
+//   [16] count variable-length records:
+//        [0]  i64 ts_us     (NowUs() steady clock of the capture)
+//        [8]  u64 conn      (net-core connection id)
+//        [16] u32 frame_len (full wire payload length)
+//        [20] u32 cap_len   (prefix bytes stored; <= frame_len and
+//                            <= kCaptureMaxRecPayload)
+//        [24] u8  ver, u8 tag, u16 reserved (0)
+//        [28] cap_len payload-prefix bytes
+//
+// The byte length must equal 16 + body_bytes EXACTLY, the record walk
+// must consume exactly body_bytes yielding exactly count records, and
+// ver/tag must equal the stored payload's first two bytes — any
+// violation rejects the WHOLE file (never-crash/full-reject, the r16
+// tune-cache rule). All fields little-endian via the unaligned-safe
+// ptpu_wire.h codecs. Python twin: tools/drill_replay.py
+// CAPTURE_MAGIC/CAPTURE_VERSION/CAPTURE_HEADER_BYTES/CAPTURE_REC_BYTES.
+
+constexpr uint32_t kCaptureMagic = 0x50414350u;  // "PCAP"
+constexpr uint32_t kCaptureVersion = 1;
+constexpr uint32_t kCaptureMaxRecords = 65536;
+constexpr size_t kCaptureHeaderBytes = 16;
+constexpr size_t kCaptureRecBytes = 28;  // fixed part, before payload
+constexpr size_t kCaptureMaxRecPayload = 4096;
+
+enum class ParseResult {
+  kOk = 0,     // well-formed, records returned
+  kMalformed,  // corrupt bytes: adopt nothing
+};
+
+// One captured frame, as read back out of the ring or a file.
+struct CapRecord {
+  int64_t ts_us = 0;
+  uint64_t conn = 0;
+  uint32_t frame_len = 0;
+  uint8_t ver = 0, tag = 0;
+  std::vector<uint8_t> payload;  // cap_len prefix bytes
+};
+
+/* Bounds-checked parser over UNTRUSTED bytes. Never throws, never
+ * reads past `size`, never adopts a file whose walk disagrees with
+ * its own header. Fuzz target: csrc/fuzz/fuzz_capture.cc (corpus
+ * csrc/fuzz/corpus/capture). */
+inline ParseResult ParseCaptureBytes(const uint8_t* data, size_t size,
+                                     std::vector<CapRecord>* out) {
+  // *out is written ONLY on kOk (one swap at the end): a reject can
+  // never leave a caller holding a half-adopted record list
+  if (data == nullptr || size < kCaptureHeaderBytes)
+    return ParseResult::kMalformed;
+  if (GetU32(data) != kCaptureMagic) return ParseResult::kMalformed;
+  if (GetU32(data + 4) != kCaptureVersion)
+    return ParseResult::kMalformed;
+  const uint32_t count = GetU32(data + 8);
+  const uint32_t body_bytes = GetU32(data + 12);
+  if (count > kCaptureMaxRecords) return ParseResult::kMalformed;
+  // exact-size check BEFORE any record read: count/body_bytes are
+  // attacker data, and the sum cannot overflow (both fit in u32)
+  if (size != kCaptureHeaderBytes + size_t(body_bytes))
+    return ParseResult::kMalformed;
+  const uint8_t* body = data + kCaptureHeaderBytes;
+  std::vector<CapRecord> parsed;
+  parsed.reserve(count);
+  size_t off = 0;
+  for (uint32_t i = 0; i < count; ++i) {
+    if (off + kCaptureRecBytes > size_t(body_bytes))
+      return ParseResult::kMalformed;
+    const uint8_t* r = body + off;
+    CapRecord rec;
+    rec.ts_us = GetI64(r);
+    rec.conn = GetU64(r + 8);
+    rec.frame_len = GetU32(r + 16);
+    const uint32_t cap_len = GetU32(r + 20);
+    rec.ver = r[24];
+    rec.tag = r[25];
+    if (GetU16(r + 26) != 0) return ParseResult::kMalformed;
+    if (cap_len > rec.frame_len || cap_len > kCaptureMaxRecPayload)
+      return ParseResult::kMalformed;
+    if (off + kCaptureRecBytes + size_t(cap_len) > size_t(body_bytes))
+      return ParseResult::kMalformed;
+    const uint8_t* pl = r + kCaptureRecBytes;
+    // ver/tag mirror the payload's leading bytes — a record whose
+    // header disagrees with its own stored bytes was not written by
+    // this code
+    if ((cap_len >= 1 && rec.ver != pl[0]) ||
+        (cap_len >= 2 && rec.tag != pl[1]) ||
+        (cap_len < 1 && rec.ver != 0) || (cap_len < 2 && rec.tag != 0))
+      return ParseResult::kMalformed;
+    rec.payload.assign(pl, pl + cap_len);
+    parsed.push_back(std::move(rec));
+    off += kCaptureRecBytes + size_t(cap_len);
+  }
+  // no trailing garbage: the walk must land exactly on body_bytes
+  if (off != size_t(body_bytes)) return ParseResult::kMalformed;
+  out->swap(parsed);
+  return ParseResult::kOk;
+}
+
+inline void SerializeCapture(const std::vector<CapRecord>& records,
+                             std::vector<uint8_t>* out) {
+  const size_t n = records.size() > kCaptureMaxRecords
+                       ? kCaptureMaxRecords
+                       : records.size();
+  size_t body = 0;
+  for (size_t i = 0; i < n; ++i) {
+    const size_t cap = records[i].payload.size() > kCaptureMaxRecPayload
+                           ? kCaptureMaxRecPayload
+                           : records[i].payload.size();
+    body += kCaptureRecBytes + cap;
+  }
+  out->assign(kCaptureHeaderBytes + body, 0);
+  uint8_t* p = out->data();
+  PutU32(p, kCaptureMagic);
+  PutU32(p + 4, kCaptureVersion);
+  PutU32(p + 8, uint32_t(n));
+  PutU32(p + 12, uint32_t(body));
+  size_t off = kCaptureHeaderBytes;
+  for (size_t i = 0; i < n; ++i) {
+    const CapRecord& rec = records[i];
+    const size_t cap = rec.payload.size() > kCaptureMaxRecPayload
+                           ? kCaptureMaxRecPayload
+                           : rec.payload.size();
+    uint8_t* r = p + off;
+    PutI64(r, rec.ts_us);
+    PutU64(r + 8, rec.conn);
+    PutU32(r + 16, rec.frame_len);
+    PutU32(r + 20, uint32_t(cap));
+    r[24] = cap >= 1 ? rec.payload[0] : 0;
+    r[25] = cap >= 2 ? rec.payload[1] : 0;
+    PutU16(r + 26, 0);
+    if (cap > 0) std::memcpy(r + kCaptureRecBytes, rec.payload.data(), cap);
+    off += kCaptureRecBytes + cap;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// knobs
+// ---------------------------------------------------------------------------
+
+struct Config {
+  int64_t sample = 0;  // PTPU_CAPTURE_SAMPLE: 0 off (default), 1 all
+  size_t ring = 1024;  // PTPU_CAPTURE_RING slots (pow2-rounded)
+  size_t bytes = 256;  // PTPU_CAPTURE_BYTES payload-prefix cap
+};
+
+inline int64_t CaptureEnvI64(const char* name, int64_t dflt) {
+  const char* e = std::getenv(name);
+  if (!e || !*e) return dflt;
+  char* end = nullptr;
+  const long long v = std::strtoll(e, &end, 10);
+  return (end && *end == '\0') ? int64_t(v) : dflt;
+}
+
+inline size_t CaptureRoundPow2(size_t v, size_t lo, size_t hi) {
+  size_t p = lo;
+  while (p < v && p < hi) p <<= 1;
+  return p;
+}
+
+inline Config ConfigFromEnv() {
+  Config cfg;
+  cfg.sample = CaptureEnvI64("PTPU_CAPTURE_SAMPLE", cfg.sample);
+  if (cfg.sample < 0) cfg.sample = 0;
+  const int64_t ring =
+      CaptureEnvI64("PTPU_CAPTURE_RING", int64_t(cfg.ring));
+  if (ring > 0) cfg.ring = size_t(ring);
+  const int64_t bytes =
+      CaptureEnvI64("PTPU_CAPTURE_BYTES", int64_t(cfg.bytes));
+  if (bytes > 0) cfg.bytes = size_t(bytes);
+  if (cfg.bytes < 16) cfg.bytes = 16;
+  if (cfg.bytes > kCaptureMaxRecPayload)
+    cfg.bytes = kCaptureMaxRecPayload;
+  return cfg;
+}
+
+// ---------------------------------------------------------------------------
+// the ring
+// ---------------------------------------------------------------------------
+
+class Ring {
+ public:
+  explicit Ring(const Config& cfg)
+      : sample_(cfg.sample),
+        cap_bytes_(CaptureRoundPow2(cfg.bytes, 16, kCaptureMaxRecPayload)),
+        ring_(CaptureRoundPow2(cfg.ring, 64, 1u << 20)),
+        arena_(ring_.size() * cap_bytes_) {}
+
+  // Sampling decision for one arriving frame. With sample == 0 this
+  // is ONE relaxed load — the ≤3% capture-off overhead gate rides on
+  // this path staying empty.
+  bool Sampled() {
+    const int64_t s = sample_.load(std::memory_order_relaxed);
+    if (s <= 0) return false;
+    if (s != 1 &&
+        ctr_.fetch_add(1, std::memory_order_relaxed) % uint64_t(s) != 0)
+      return false;
+    return true;
+  }
+
+  /* Record one dispatched frame. Seqlock writer (Boehm, "Can seqlocks
+   * get along with programming language memory models?" MSPC'12):
+   * odd seq marks the slot mid-write, the release fence orders the
+   * mark before every field store, and the final release store
+   * publishes. Field + payload stores are relaxed atomics so a racing
+   * reader's copies are not UB — torn values are discarded by the
+   * reader's seq re-check. */
+  void Record(int64_t ts_us, uint64_t conn, const uint8_t* payload,
+              uint32_t n) {
+    const uint64_t idx = head_.fetch_add(1, std::memory_order_relaxed);
+    const size_t slot_i = idx & (ring_.size() - 1);
+    Slot& s = ring_[slot_i];
+    s.seq.store(2 * idx + 1, std::memory_order_relaxed);
+    std::atomic_thread_fence(std::memory_order_release);
+    PTPU_SCHED_POINT();
+    s.ts_us.store(ts_us, std::memory_order_relaxed);
+    s.conn.store(conn, std::memory_order_relaxed);
+    s.frame_len.store(n, std::memory_order_relaxed);
+    const uint32_t cap =
+        n < uint32_t(cap_bytes_) ? n : uint32_t(cap_bytes_);
+    s.cap_len.store(cap, std::memory_order_relaxed);
+    s.ver.store(n >= 1 ? payload[0] : 0, std::memory_order_relaxed);
+    s.tag.store(n >= 2 ? payload[1] : 0, std::memory_order_relaxed);
+    std::atomic<uint8_t>* dst = arena_.data() + slot_i * cap_bytes_;
+    for (uint32_t i = 0; i < cap; ++i)
+      dst[i].store(payload[i], std::memory_order_relaxed);
+    PTPU_SCHED_POINT();
+    s.seq.store(2 * idx + 2, std::memory_order_release);
+  }
+
+  // Runtime override (ptpu_capture_set ABI): sample < 0 keeps the
+  // current value. Ring/bytes stay env-only — they size allocations.
+  void Set(int64_t sample) {
+    if (sample >= 0) sample_.store(sample, std::memory_order_relaxed);
+  }
+
+  int64_t sample() const {
+    return sample_.load(std::memory_order_relaxed);
+  }
+  uint64_t recorded() const {
+    return head_.load(std::memory_order_relaxed);
+  }
+  size_t ring_size() const { return ring_.size(); }
+  size_t cap_bytes() const { return cap_bytes_; }
+
+  // Newest-first snapshot; torn slots (mid-overwrite) are skipped.
+  void Snapshot(std::vector<CapRecord>* out, size_t max_n) const {
+    out->clear();
+    const uint64_t head = head_.load(std::memory_order_acquire);
+    uint64_t n = head < ring_.size() ? head : ring_.size();
+    if (n > max_n) n = max_n;
+    for (uint64_t i = 0; i < n; ++i) {
+      const uint64_t idx = head - 1 - i;
+      const size_t slot_i = idx & (ring_.size() - 1);
+      const Slot& s = ring_[slot_i];
+      if (s.seq.load(std::memory_order_acquire) != 2 * idx + 2)
+        continue;
+      PTPU_SCHED_POINT();
+      CapRecord rec;
+      rec.ts_us = s.ts_us.load(std::memory_order_relaxed);
+      rec.conn = s.conn.load(std::memory_order_relaxed);
+      rec.frame_len = s.frame_len.load(std::memory_order_relaxed);
+      uint32_t cap = s.cap_len.load(std::memory_order_relaxed);
+      if (cap > cap_bytes_) cap = uint32_t(cap_bytes_);  // torn: bound
+      rec.ver = s.ver.load(std::memory_order_relaxed);
+      rec.tag = s.tag.load(std::memory_order_relaxed);
+      rec.payload.resize(cap);
+      const std::atomic<uint8_t>* src =
+          arena_.data() + slot_i * cap_bytes_;
+      for (uint32_t k = 0; k < cap; ++k)
+        rec.payload[k] = src[k].load(std::memory_order_relaxed);
+      std::atomic_thread_fence(std::memory_order_acquire);
+      if (s.seq.load(std::memory_order_relaxed) != 2 * idx + 2)
+        continue;  // overwritten mid-copy: drop the torn record
+      out->push_back(std::move(rec));
+    }
+  }
+
+  // {"sample","ring","bytes","recorded","frames":[...]} — the GET
+  // /capturez body. Payload prefixes are lowercase hex.
+  std::string CapturezJson(size_t max_n) const {
+    std::vector<CapRecord> recs;
+    Snapshot(&recs, max_n);
+    std::string out = "{\"sample\":";
+    out += std::to_string(sample());
+    out += ",\"ring\":";
+    out += std::to_string(ring_.size());
+    out += ",\"bytes\":";
+    out += std::to_string(cap_bytes_);
+    out += ",\"recorded\":";
+    out += std::to_string(recorded());
+    out += ",\"frames\":[";
+    static const char* hex = "0123456789abcdef";
+    for (size_t i = 0; i < recs.size(); ++i) {
+      const CapRecord& r = recs[i];
+      if (i) out += ',';
+      out += "{\"ts_us\":";
+      out += std::to_string(r.ts_us);
+      out += ",\"conn\":";
+      out += std::to_string(r.conn);
+      out += ",\"len\":";
+      out += std::to_string(r.frame_len);
+      out += ",\"ver\":";
+      out += std::to_string(unsigned(r.ver));
+      out += ",\"tag\":";
+      out += std::to_string(unsigned(r.tag));
+      out += ",\"data\":\"";
+      for (uint8_t b : r.payload) {
+        out += hex[b >> 4];
+        out += hex[b & 0xf];
+      }
+      out += "\"}";
+    }
+    out += "]}";
+    return out;
+  }
+
+  /* Dump the ring (oldest-first, every readable slot) into a capture
+   * file via tmp + rename (the tune-cache save idiom — a concurrent
+   * reader never sees a torn file). Returns records written, -1 on
+   * I/O error. */
+  int SaveFile(const std::string& path) const {
+    std::vector<CapRecord> recs;
+    Snapshot(&recs, kCaptureMaxRecords);
+    // Snapshot is newest-first; a replay wants arrival order
+    std::vector<CapRecord> ordered(recs.rbegin(), recs.rend());
+    std::vector<uint8_t> bytes;
+    SerializeCapture(ordered, &bytes);
+    const std::string tmp = path + ".tmp." + std::to_string(::getpid());
+    FILE* f = std::fopen(tmp.c_str(), "wb");
+    bool ok = f != nullptr;
+    if (ok) {
+      ok = std::fwrite(bytes.data(), 1, bytes.size(), f) == bytes.size();
+      ok = (std::fclose(f) == 0) && ok;
+    }
+    if (ok) ok = ::rename(tmp.c_str(), path.c_str()) == 0;
+    if (!ok) {
+      ::unlink(tmp.c_str());
+      return -1;
+    }
+    return int(ordered.size());
+  }
+
+ private:
+  struct Slot {
+    std::atomic<uint64_t> seq{0};  // 2*idx+1 writing, 2*idx+2 done
+    std::atomic<int64_t> ts_us{0};
+    std::atomic<uint64_t> conn{0};
+    std::atomic<uint32_t> frame_len{0}, cap_len{0};
+    std::atomic<uint8_t> ver{0}, tag{0};
+  };
+
+  std::atomic<int64_t> sample_;
+  std::atomic<uint64_t> head_{0}, ctr_{0};
+  const size_t cap_bytes_;
+  std::vector<Slot> ring_;  // size is a power of two
+  // payload-prefix arena: slot i owns bytes [i*cap_bytes_, (i+1)*..);
+  // relaxed byte stores inside the seqlock bracket keep racing
+  // readers defined (torn copies are dropped by the seq re-check)
+  std::vector<std::atomic<uint8_t>> arena_;
+};
+
+// Process-global ring for this shared object, lazily constructed from
+// the PTPU_CAPTURE_* env on first touch. Heap-allocated and never
+// destroyed (immortal): event threads may record during static
+// destruction of the host, and LSan treats reachable globals as live.
+inline Ring& Global() {
+  static Ring* g = new Ring(ConfigFromEnv());
+  return *g;
+}
+
+}  // namespace capture
+}  // namespace ptpu
+
+#endif  // PTPU_CAPTURE_H_

@@ -1,0 +1,512 @@
+// Native unit tests for the production-drill harness (ptpu_capture.h
+// + the PTPU_CHAOS fault-injection sites in ptpu_net.cc) — the
+// cc_test analogue, same harness idiom as the other selftests (plain
+// asserts, exit 0 = pass; run by `make selftest` and both sancheck
+// legs; wrapped by tests/test_native_selftest.py).
+//
+// Covered: capture-file parser whole-file reject family + round trip,
+// capture ring wraparound EXACTNESS (newest-first snapshot of the
+// last ring_size frames, byte-for-byte), payload truncation at
+// cap_bytes, 1-in-N sampling dice, SaveFile -> ParseCaptureBytes
+// round trip, the GET /capturez route over a live echo server with
+// runtime Set() on/off, and every chaos kind: injected conn kills
+// mapping 1:1 to client-observed deaths, handshake drops counted as
+// handshake_fails, read/write delays staying lossless, and short
+// writes delivering intact replies through the partial-write path.
+#include "ptpu_net.cc"
+#include "ptpu_trace.cc"
+
+// asserts ARE the test — never compile them out
+#undef NDEBUG
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+using ptpu::HmacSha256;
+using ptpu::PutU32;
+using ptpu::ReadExact;
+using ptpu::WriteExact;
+using ptpu::net::Callbacks;
+using ptpu::net::ConnPtr;
+using ptpu::net::FrameResult;
+using ptpu::net::Options;
+using ptpu::net::Server;
+using ptpu::net::Stats;
+namespace cap = ptpu::capture;
+
+namespace {
+
+// ------------------------------------------------------ client side
+
+int dial(int port) {
+  const int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  assert(fd >= 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(uint16_t(port));
+  assert(::connect(fd, reinterpret_cast<sockaddr *>(&addr),
+                   sizeof(addr)) == 0);
+  return fd;
+}
+
+bool client_handshake(int fd, const std::string &key) {
+  uint8_t nonce[16];
+  if (!ReadExact(fd, nonce, 16)) return false;
+  uint8_t mac[32];
+  HmacSha256(reinterpret_cast<const uint8_t *>(key.data()), key.size(),
+             nonce, 16, mac);
+  uint8_t frame[36];
+  PutU32(frame, 32);
+  std::memcpy(frame + 4, mac, 32);
+  if (!WriteExact(fd, frame, 36)) return false;
+  uint8_t ok = 0;
+  return ReadExact(fd, &ok, 1) && ok == 0x01;
+}
+
+void send_frame(int fd, const std::vector<uint8_t> &payload) {
+  uint8_t lenb[4];
+  PutU32(lenb, uint32_t(payload.size()));
+  assert(WriteExact(fd, lenb, 4));
+  assert(WriteExact(fd, payload.data(), payload.size()));
+}
+
+bool recv_frame(int fd, std::vector<uint8_t> *out) {
+  uint8_t lenb[4];
+  if (!ReadExact(fd, lenb, 4)) return false;
+  out->resize(ptpu::GetU32(lenb));
+  return out->empty() || ReadExact(fd, out->data(), out->size());
+}
+
+std::string http_get(int port, const std::string &target) {
+  const int fd = dial(port);
+  const std::string req = "GET " + target +
+                          " HTTP/1.1\r\nHost: x\r\n"
+                          "Connection: close\r\n\r\n";
+  assert(WriteExact(fd, reinterpret_cast<const uint8_t *>(req.data()),
+                    req.size()));
+  std::string out;
+  char buf[4096];
+  for (;;) {
+    const ssize_t r = ::read(fd, buf, sizeof(buf));
+    if (r <= 0) break;
+    out.append(buf, size_t(r));
+  }
+  ::close(fd);
+  return out;
+}
+
+// ------------------------------------------------------ echo server
+
+struct EchoServer {
+  Stats stats;
+  std::unique_ptr<Server> srv;
+
+  explicit EchoServer(Options opt) {
+    Callbacks cbs;
+    cbs.on_frame = [](const ConnPtr &c, const uint8_t *p, uint32_t n) {
+      return c->SendCopy(p, n) ? FrameResult::kOk : FrameResult::kClose;
+    };
+    // the stock telemetry table — the same /capturez the production
+    // servers mount
+    cbs.on_http = [](const std::string &target) {
+      return ptpu::net::TelemetryHttp(
+          target, [] { return std::string("{}"); }, "ptpu_test",
+          false);
+    };
+    srv.reset(new Server(opt, std::move(cbs), &stats));
+    std::string err;
+    if (!srv->Start(&err)) {
+      std::fprintf(stderr, "start failed: %s\n", err.c_str());
+      assert(false);
+    }
+  }
+};
+
+Options base_opts(const char *key) {
+  Options o;
+  o.authkey = key;
+  o.event_threads = 1;  // one chaos dice: injection order deterministic
+  return o;
+}
+
+// ------------------------------------------- capture format helpers
+
+std::vector<uint8_t> mk_file(uint32_t magic, uint32_t version,
+                             uint32_t count,
+                             const std::vector<uint8_t> &body) {
+  std::vector<uint8_t> f(cap::kCaptureHeaderBytes + body.size());
+  PutU32(f.data(), magic);
+  PutU32(f.data() + 4, version);
+  PutU32(f.data() + 8, count);
+  PutU32(f.data() + 12, uint32_t(body.size()));
+  std::memcpy(f.data() + 16, body.data(), body.size());
+  return f;
+}
+
+std::vector<uint8_t> mk_rec(int64_t ts, uint64_t conn,
+                            uint32_t frame_len,
+                            const std::vector<uint8_t> &payload,
+                            int ver_override = -1,
+                            int tag_override = -1,
+                            uint16_t reserved = 0) {
+  std::vector<uint8_t> r(cap::kCaptureRecBytes + payload.size());
+  std::memcpy(r.data(), &ts, 8);
+  std::memcpy(r.data() + 8, &conn, 8);
+  PutU32(r.data() + 16, frame_len);
+  PutU32(r.data() + 20, uint32_t(payload.size()));
+  r[24] = ver_override >= 0 ? uint8_t(ver_override)
+                            : (payload.size() >= 1 ? payload[0] : 0);
+  r[25] = tag_override >= 0 ? uint8_t(tag_override)
+                            : (payload.size() >= 2 ? payload[1] : 0);
+  std::memcpy(r.data() + 26, &reserved, 2);
+  std::memcpy(r.data() + 28, payload.data(), payload.size());
+  return r;
+}
+
+std::vector<uint8_t> cat(const std::vector<std::vector<uint8_t>> &vs) {
+  std::vector<uint8_t> out;
+  for (const auto &v : vs) out.insert(out.end(), v.begin(), v.end());
+  return out;
+}
+
+// ------------------------------------------------------------ tests
+
+void test_capture_parse_reject_family() {
+  const std::vector<uint8_t> p1 = {0x01, 0x60, 'a', 'b'};
+  const std::vector<uint8_t> p2 = {0x01, 0x63};
+  auto good = mk_file(cap::kCaptureMagic, cap::kCaptureVersion, 2,
+                      cat({mk_rec(100, 7, 4, p1),
+                           mk_rec(200, 8, 9, p2)}));
+  std::vector<cap::CapRecord> out;
+  assert(cap::ParseCaptureBytes(good.data(), good.size(), &out) ==
+         cap::ParseResult::kOk);
+  assert(out.size() == 2);
+  assert(out[0].ts_us == 100 && out[0].conn == 7 &&
+         out[0].frame_len == 4 && out[0].ver == 1 &&
+         out[0].tag == 0x60 && out[0].payload == p1);
+  assert(out[1].frame_len == 9 && out[1].payload == p2);
+
+  // serialize twin reproduces the same bytes
+  std::vector<uint8_t> rt;
+  cap::SerializeCapture(out, &rt);
+  assert(rt == good);
+
+  // the whole-file reject family: every malformed shape returns
+  // kMalformed and leaves *out untouched
+  auto expect_reject = [](std::vector<uint8_t> f) {
+    std::vector<cap::CapRecord> scratch = {cap::CapRecord{}};
+    assert(cap::ParseCaptureBytes(f.data(), f.size(), &scratch) ==
+           cap::ParseResult::kMalformed);
+    assert(scratch.size() == 1);  // full reject never partially adopts
+  };
+  expect_reject({good.begin(), good.begin() + 11});  // short header
+  auto bad = good;
+  bad[0] ^= 1;
+  expect_reject(bad);  // magic
+  bad = good;
+  bad[4] = 9;
+  expect_reject(bad);  // version
+  bad = good;
+  PutU32(bad.data() + 8, cap::kCaptureMaxRecords + 1);
+  expect_reject(bad);  // count over cap
+  bad = good;
+  bad.push_back(0);
+  expect_reject(bad);  // size != header + body
+  bad = good;
+  bad.pop_back();
+  expect_reject(bad);  // truncated payload
+  bad = good;
+  PutU32(bad.data() + 16 + 20, 500);
+  expect_reject(bad);  // cap_len > frame_len
+  bad = good;
+  bad[16 + 26] = 1;
+  expect_reject(bad);  // reserved != 0
+  bad = good;
+  bad[16 + 24] = 9;
+  expect_reject(bad);  // ver field != payload[0]
+  bad = good;
+  bad[16 + 25] = 0x99;
+  expect_reject(bad);  // tag field != payload[1]
+  expect_reject(mk_file(cap::kCaptureMagic, cap::kCaptureVersion, 3,
+                        cat({mk_rec(1, 1, 4, p1)})));  // count lies
+  assert(cap::ParseCaptureBytes(nullptr, 0, &out) ==
+         cap::ParseResult::kMalformed);
+}
+
+void test_ring_wraparound_exact() {
+  cap::Config cfg;
+  cfg.sample = 1;
+  cfg.ring = 64;
+  cfg.bytes = 16;
+  cap::Ring ring(cfg);
+  assert(ring.ring_size() == 64 && ring.cap_bytes() == 16);
+  // 200 frames of 24 bytes each: every slot overwritten 3+ times,
+  // every stored payload truncated to cap_bytes
+  for (int i = 0; i < 200; ++i) {
+    uint8_t p[24];
+    for (int k = 0; k < 24; ++k) p[k] = uint8_t(i ^ (k * 7));
+    assert(ring.Sampled());
+    ring.Record(1000 + i, uint64_t(100 + i), p, sizeof(p));
+  }
+  assert(ring.recorded() == 200);
+  std::vector<cap::CapRecord> snap;
+  ring.Snapshot(&snap, 1000);
+  assert(snap.size() == 64);
+  // newest-first: snap[j] is frame 199 - j, byte-for-byte
+  for (size_t j = 0; j < snap.size(); ++j) {
+    const int i = 199 - int(j);
+    assert(snap[j].ts_us == 1000 + i);
+    assert(snap[j].conn == uint64_t(100 + i));
+    assert(snap[j].frame_len == 24);        // true wire length kept
+    assert(snap[j].payload.size() == 16);   // stored prefix truncated
+    assert(snap[j].ver == uint8_t(i ^ 0));
+    assert(snap[j].tag == uint8_t(i ^ 7));
+    for (int k = 0; k < 16; ++k)
+      assert(snap[j].payload[size_t(k)] == uint8_t(i ^ (k * 7)));
+  }
+  // bounded snapshot takes the newest max_n only
+  ring.Snapshot(&snap, 5);
+  assert(snap.size() == 5 && snap[0].ts_us == 1199);
+}
+
+void test_ring_sampling_and_set() {
+  cap::Config cfg;
+  cfg.sample = 5;
+  cfg.ring = 64;
+  cfg.bytes = 32;
+  cap::Ring ring(cfg);
+  int recorded = 0;
+  for (int i = 0; i < 100; ++i) {
+    if (ring.Sampled()) {
+      const uint8_t p[2] = {1, 2};
+      ring.Record(i, 1, p, 2);
+      ++recorded;
+    }
+  }
+  assert(recorded == 20);  // 1-in-5 dice, single thread: exact
+  assert(ring.recorded() == 20);
+  ring.Set(0);             // runtime off: the one-relaxed-load path
+  for (int i = 0; i < 100; ++i) assert(!ring.Sampled());
+  ring.Set(1);             // back on: every frame
+  assert(ring.Sampled());
+  ring.Set(-1);            // negative keeps current
+  assert(ring.sample() == 1);
+}
+
+void test_save_file_round_trip() {
+  cap::Config cfg;
+  cfg.sample = 1;
+  cfg.ring = 64;
+  cfg.bytes = 64;
+  cap::Ring ring(cfg);
+  for (int i = 0; i < 10; ++i) {
+    uint8_t p[6] = {uint8_t(1 + (i & 1)), uint8_t(0x60 + i), 'x', 'y',
+                    uint8_t(i), 0};
+    ring.Record(5000 + i, uint64_t(i), p, sizeof(p));
+  }
+  const char *path = "/tmp/ptpu_drill_selftest.cap";
+  assert(ring.SaveFile(path) == 10);
+  FILE *f = std::fopen(path, "rb");
+  assert(f);
+  std::vector<uint8_t> bytes(1 << 16);
+  bytes.resize(std::fread(bytes.data(), 1, bytes.size(), f));
+  std::fclose(f);
+  std::remove(path);
+  std::vector<cap::CapRecord> out;
+  assert(cap::ParseCaptureBytes(bytes.data(), bytes.size(), &out) ==
+         cap::ParseResult::kOk);
+  assert(out.size() == 10);
+  // files are oldest-first (replay order), unlike snapshots
+  for (int i = 0; i < 10; ++i) {
+    assert(out[size_t(i)].ts_us == 5000 + i);
+    assert(out[size_t(i)].tag == uint8_t(0x60 + i));
+    assert(out[size_t(i)].payload.size() == 6);
+  }
+}
+
+void test_capturez_route_and_runtime_set() {
+  cap::Ring &g = cap::Global();
+  g.Set(1);
+  Options opt = base_opts("drill-key");
+  opt.http_port = 0;
+  EchoServer es(opt);
+  const int fd = dial(es.srv->port());
+  assert(client_handshake(fd, "drill-key"));
+  const uint64_t before = g.recorded();
+  std::vector<uint8_t> rep;
+  for (uint8_t i = 0; i < 3; ++i) {
+    send_frame(fd, {0x01, uint8_t(0x60 + i), 'd', i});
+    assert(recv_frame(fd, &rep) && rep.size() == 4);
+  }
+  assert(g.recorded() == before + 3);
+
+  const std::string http = http_get(es.srv->http_port(),
+                                    "/capturez?n=2");
+  assert(http.find("HTTP/1.1 200") == 0);
+  assert(http.find("application/json") != std::string::npos);
+  assert(http.find("\"frames\":[") != std::string::npos);
+  // newest-first: frames[0] is the LAST echo frame, full hex payload
+  assert(http.find("\"data\":\"016264") != std::string::npos);
+  assert(http.find("\"tag\":98") != std::string::npos);  // 0x62
+  // n=2 honored: exactly two frame objects in the window
+  size_t n_frames = 0;
+  for (size_t at = 0; (at = http.find("\"ts_us\":", at)) !=
+                      std::string::npos;
+       ++at)
+    ++n_frames;
+  assert(n_frames == 2);
+
+  // runtime off: traffic flows, nothing new is recorded
+  g.Set(0);
+  const uint64_t frozen = g.recorded();
+  send_frame(fd, {0x01, 0x60, 'z'});
+  assert(recv_frame(fd, &rep) && rep.size() == 3);
+  assert(g.recorded() == frozen);
+  ::close(fd);
+}
+
+void test_chaos_kill_reconciles_exactly() {
+  Options opt = base_opts("kill-key");
+  opt.chaos.kill = true;
+  opt.chaos.rate = 3;
+  EchoServer es(opt);
+  // single event thread + kill-only chaos: the dice is consumed once
+  // per post-handshake frame, so deaths land deterministically and
+  // every injected kill maps 1:1 to a client-observed EOF
+  int client_deaths = 0;
+  int echoed = 0;
+  while (client_deaths < 3) {
+    const int fd = dial(es.srv->port());
+    assert(client_handshake(fd, "kill-key"));
+    std::vector<uint8_t> rep;
+    for (;;) {
+      send_frame(fd, {0x01, 0x60, 'k'});
+      if (!recv_frame(fd, &rep)) {
+        ++client_deaths;
+        break;
+      }
+      ++echoed;
+    }
+    ::close(fd);
+  }
+  assert(es.stats.chaos_conn_kills.Get() == 3);
+  assert(echoed == 4);  // dice hits on frames 1, 4, 7 — 2+2 echo between
+  assert(es.stats.handshake_fails.Get() == 0);
+}
+
+void test_chaos_hsdrop_counted_as_handshake_fail() {
+  Options opt = base_opts("hs-key");
+  opt.chaos.hsdrop = true;
+  opt.chaos.rate = 1;  // every valid MAC dropped
+  EchoServer es(opt);
+  for (int i = 0; i < 3; ++i) {
+    const int fd = dial(es.srv->port());
+    assert(!client_handshake(fd, "hs-key"));
+    ::close(fd);
+  }
+  assert(es.stats.chaos_handshake_drops.Get() == 3);
+  assert(es.stats.handshake_fails.Get() == 3);
+  // drills must not mask REAL auth failures: a wrong key is a
+  // handshake_fail but never a chaos drop
+  const int fd = dial(es.srv->port());
+  assert(!client_handshake(fd, "wrong"));
+  ::close(fd);
+  assert(es.stats.chaos_handshake_drops.Get() == 3);
+  assert(es.stats.handshake_fails.Get() == 4);
+}
+
+void test_chaos_delays_and_short_writes_lossless() {
+  Options opt = base_opts("slow-key");
+  opt.chaos.rdelay = true;
+  opt.chaos.wdelay = true;
+  opt.chaos.shortw = true;
+  opt.chaos.rate = 1;
+  opt.chaos.delay_us = 500;
+  EchoServer es(opt);
+  const int fd = dial(es.srv->port());
+  assert(client_handshake(fd, "slow-key"));
+  // a 100-byte echo through 1-byte chaos writes: the remainder rides
+  // the partial-write EPOLLOUT path and arrives INTACT — delay-style
+  // chaos loses nothing, it only stretches time
+  std::vector<uint8_t> big(100);
+  for (size_t i = 0; i < big.size(); ++i) big[i] = uint8_t(i * 3);
+  std::vector<uint8_t> rep;
+  for (int round = 0; round < 3; ++round) {
+    send_frame(fd, big);
+    assert(recv_frame(fd, &rep));
+    assert(rep == big);
+  }
+  ::close(fd);
+  assert(es.stats.chaos_read_delays.Get() > 0);
+  assert(es.stats.chaos_write_delays.Get() > 0);
+  assert(es.stats.chaos_short_writes.Get() >= 100);
+  assert(es.stats.partial_write_flushes.Get() > 0);
+}
+
+void test_chaos_env_parse() {
+  // OptionsFromEnv twin checks: kinds list + rate, unknown kinds and
+  // bad rates leave chaos OFF (fault injection must never turn on by
+  // accident)
+  auto parse = [](const char *v) {
+    ::setenv("PTPU_CHAOS", v, 1);
+    Options o = ptpu::net::OptionsFromEnv(Options());
+    ::unsetenv("PTPU_CHAOS");
+    return o.chaos;
+  };
+  auto c = parse("kill,rdelay:100");
+  assert(c.kill && c.rdelay && !c.wdelay && !c.shortw && !c.hsdrop);
+  assert(c.rate == 100 && c.enabled());
+  c = parse("all:7");
+  assert(c.kill && c.rdelay && c.wdelay && c.shortw && c.hsdrop &&
+         c.rate == 7);
+  assert(!parse("kill").enabled());        // no rate
+  assert(!parse("kill:0").enabled());      // zero rate
+  assert(!parse("kill:-5").enabled());     // negative rate
+  assert(!parse("kill:12x").enabled());    // trailing junk
+  assert(!parse("nuke:5").enabled());      // unknown kind
+  assert(!parse(":5").enabled());          // empty kinds
+  assert(!parse("").enabled());
+  ::setenv("PTPU_CHAOS_DELAY_US", "250", 1);
+  ::setenv("PTPU_CHAOS", "wdelay:9", 1);
+  Options o = ptpu::net::OptionsFromEnv(Options());
+  ::unsetenv("PTPU_CHAOS");
+  ::unsetenv("PTPU_CHAOS_DELAY_US");
+  assert(o.chaos.wdelay && o.chaos.delay_us == 250);
+}
+
+}  // namespace
+
+// announce each test on stderr (unbuffered) BEFORE it runs — a hang
+// names its test instead of leaving a silent stuck binary
+#define RUN(t)                       \
+  do {                               \
+    std::fprintf(stderr, "  %s\n", #t); \
+    t();                             \
+  } while (0)
+
+int main() {
+  // the global ring reads its env config at FIRST touch — pin it
+  // before any traffic so the /capturez test sees a known shape
+  ::setenv("PTPU_CAPTURE_RING", "64", 1);
+  ::setenv("PTPU_CAPTURE_BYTES", "64", 1);
+  RUN(test_capture_parse_reject_family);
+  RUN(test_ring_wraparound_exact);
+  RUN(test_ring_sampling_and_set);
+  RUN(test_save_file_round_trip);
+  RUN(test_capturez_route_and_runtime_set);
+  RUN(test_chaos_kill_reconciles_exactly);
+  RUN(test_chaos_hsdrop_counted_as_handshake_fail);
+  RUN(test_chaos_delays_and_short_writes_lossless);
+  RUN(test_chaos_env_parse);
+  std::printf("ptpu_drill_selftest: all native drill-harness unit "
+              "tests passed\n");
+  return 0;
+}

@@ -276,6 +276,20 @@ const char* ptpu_serving_prom_text(void*);
 void ptpu_trace_set(int64_t sample, int64_t slow_us);
 const char* ptpu_trace_json(int64_t max_spans);
 
+/* Raw-frame capture (csrc/ptpu_capture.h, process-global per .so;
+ * off by default — PTPU_CAPTURE_SAMPLE / PTPU_CAPTURE_RING /
+ * PTPU_CAPTURE_BYTES size it at first touch): runtime override of
+ * the sampling rate (0 off, 1 every frame, N 1-in-N; negative keeps
+ * the current value), the GET /capturez JSON for bindings without
+ * HTTP (thread-local buffer, valid until the calling thread's next
+ * call; max_n <= 0 means 64), and persistence of the ring as a
+ * capture file for tools/drill_replay.py (returns records written,
+ * -1 on error). Capture files are per-machine diagnostics, safe to
+ * delete. */
+void ptpu_capture_set(int64_t sample);
+const char* ptpu_capture_json(int64_t max_n);
+int ptpu_capture_save(const char* path);
+
 /* Persisted kernel autotuning (csrc/ptpu_tune.{h,cc}, process-global
  * per .so; opt-in via PTPU_TUNE=1). Winners probed at load persist in
  * a per-MACHINE cache file (PTPU_TUNE_CACHE, default

@@ -23,6 +23,7 @@
 #include <random>
 #include <unordered_map>
 
+#include "ptpu_capture.h"
 #include "ptpu_hmac.h"
 #include "ptpu_schedck.h"
 #include "ptpu_trace.h"
@@ -159,11 +160,61 @@ HttpReply TelemetryHttp(const std::string& target,
     rep.body = trace::Global().TracezJson(
         size_t(TracezQueryN(target, 128)));
     rep.body += '\n';
+  } else if (path == "/capturez") {
+    rep.content_type = "application/json";
+    rep.body = capture::Global().CapturezJson(
+        size_t(TracezQueryN(target, 64)));
+    rep.body += '\n';
   } else {
     rep.status = 404;
     rep.body = "not found\n";
   }
   return rep;
+}
+
+// PTPU_CHAOS="kinds:rate" — kinds is a comma list out of
+// {kill,rdelay,wdelay,shortw,hsdrop} (or "all"), rate N means 1-in-N
+// eligible events. Anything malformed (no colon, rate <= 0, zero
+// recognized kinds) leaves chaos OFF: fault injection must never turn
+// itself on by accident.
+static ChaosConfig ChaosFromEnv(ChaosConfig base) {
+  base.delay_us = EnvI64("PTPU_CHAOS_DELAY_US", base.delay_us);
+  if (base.delay_us < 0) base.delay_us = 0;
+  const char* e = std::getenv("PTPU_CHAOS");
+  if (!e || !*e) return base;
+  const std::string spec(e);
+  const size_t colon = spec.rfind(':');
+  if (colon == std::string::npos || colon + 1 >= spec.size())
+    return base;
+  char* end = nullptr;
+  const long long rate =
+      std::strtoll(spec.c_str() + colon + 1, &end, 10);
+  if (!end || *end != '\0' || rate <= 0) return base;
+  bool any = false;
+  size_t p = 0;
+  while (p < colon) {
+    size_t comma = spec.find(',', p);
+    if (comma == std::string::npos || comma > colon) comma = colon;
+    const std::string kind = spec.substr(p, comma - p);
+    if (kind == "all") {
+      base.kill = base.rdelay = base.wdelay = base.shortw =
+          base.hsdrop = true;
+      any = true;
+    } else if (kind == "kill") {
+      base.kill = any = true;
+    } else if (kind == "rdelay") {
+      base.rdelay = any = true;
+    } else if (kind == "wdelay") {
+      base.wdelay = any = true;
+    } else if (kind == "shortw") {
+      base.shortw = any = true;
+    } else if (kind == "hsdrop") {
+      base.hsdrop = any = true;
+    }
+    p = comma + 1;
+  }
+  if (any) base.rate = int64_t(rate);
+  return base;
 }
 
 Options OptionsFromEnv(Options base) {
@@ -178,6 +229,7 @@ Options OptionsFromEnv(Options base) {
   base.max_out_bytes =
       size_t(EnvI64("PTPU_NET_MAX_OUT", int64_t(base.max_out_bytes)));
   base.http_port = int(EnvI64("PTPU_NET_HTTP", base.http_port));
+  base.chaos = ChaosFromEnv(base.chaos);
   return base;
 }
 
@@ -321,6 +373,21 @@ class EventLoop {
     }
   }
 
+  // One shared chaos dice for all fault kinds on this loop: rate N
+  // injects on every Nth eligible event. Owner-thread only (every
+  // injection site runs on the loop), so a plain counter suffices —
+  // chaos off is a single bool test.
+  bool ChaosHit() {
+    return opt_.chaos.rate > 0 &&
+           (chaos_ctr_++ % uint64_t(opt_.chaos.rate)) == 0;
+  }
+
+  void ChaosSleep() {
+    if (opt_.chaos.delay_us > 0)
+      std::this_thread::sleep_for(
+          std::chrono::microseconds(opt_.chaos.delay_us));
+  }
+
   // Idle budget for HTTP telemetry conns: the configured idle timeout
   // when on, else the handshake timeout (an HTTP peer that dribbles a
   // request for 5s is the same slow-loris the handshake deadline cuts).
@@ -372,6 +439,12 @@ class EventLoop {
 
   void HandleReadable(Conn* c) {
     if (c->read_paused_) return;
+    if (opt_.chaos.rdelay && ChaosHit()) {
+      // drill: rx jitter — stall this wakeup before draining the
+      // socket; level-triggered epoll re-delivers whatever is left
+      stats_->chaos_read_delays.Add(1);
+      ChaosSleep();
+    }
     if (opt_.idle_timeout_us > 0)
       c->idle_deadline_ = NowUs() + opt_.idle_timeout_us;
     // fairness budget: one firehose connection must not monopolize
@@ -440,6 +513,23 @@ class EventLoop {
         c->frame_t0_ = c->in_tail_ > c->in_head_ ? NowUs() : 0;
         continue;
       }
+      if (opt_.chaos.kill && ChaosHit()) {
+        // drill: server "crash" — cut the conn just before dispatch.
+        // The frame is NOT captured and NOT dispatched, so replay
+        // counter-mix accounting stays consistent with what the
+        // server actually processed.
+        stats_->chaos_conn_kills.Add(1);
+        CloseConn(c, CloseWhy::kAuto);
+        return false;
+      }
+      {
+        // capture tap: record the frame exactly as it dispatches
+        // (after auth, after oversize/kill cuts). With sampling off
+        // this is one relaxed load.
+        capture::Ring& cap = capture::Global();
+        if (cap.Sampled())
+          cap.Record(NowUs(), c->id_, payload, n);
+      }
       if (!DispatchFrame(c, payload, n)) return false;
       // eager flush: a reply this frame generated goes on the wire
       // BEFORE the next queued frame is parsed, so a pipelined client
@@ -469,6 +559,13 @@ class EventLoop {
     uint8_t diff = 0;
     for (int i = 0; i < 32; ++i) diff |= uint8_t(mac[i] ^ want[i]);
     if (diff) return false;
+    if (opt_.chaos.hsdrop && ChaosHit()) {
+      // drill: auth flake — reject a VALID MAC; the caller closes the
+      // conn through the normal pre-open path (handshake_fails++), so
+      // clients see exactly what key skew during a deploy looks like
+      stats_->chaos_handshake_drops.Add(1);
+      return false;
+    }
     c->state_ = Conn::St::kOpen;
     c->handshake_deadline_ = 0;
     --awaiting_mac_;
@@ -681,6 +778,14 @@ class EventLoop {
   }
 
   void FlushConn(Conn* c) {
+    if (opt_.chaos.wdelay && ChaosHit()) {
+      // drill: tx congestion — stall BEFORE taking the out-lock so a
+      // batcher worker queueing replies never blocks on the injected
+      // sleep, only on the real lock hold below
+      stats_->chaos_write_delays.Add(1);
+      ChaosSleep();
+    }
+    const bool chaos_short = opt_.chaos.shortw && ChaosHit();
     UniqueLock g(c->omu_);
     c->flush_posted_ = false;
     bool fatal = false;
@@ -690,6 +795,13 @@ class EventLoop {
       for (auto it = c->outq_.begin();
            it != c->outq_.end() && cnt < kFlushIov; ++it)
         cnt = GatherIov(*it, iov, cnt);
+      if (chaos_short && cnt > 0) {
+        // drill: tiny socket buffer — write ONE byte this flush and
+        // bail, forcing the partial-write EPOLLOUT re-arm path. No
+        // bytes are lost: the rest stays queued and flushes later.
+        cnt = 1;
+        if (iov[0].iov_len > 1) iov[0].iov_len = 1;
+      }
       const ssize_t w = ::writev(c->fd_, iov, cnt);
       if (w < 0) {
         if (errno == EINTR) continue;
@@ -720,6 +832,10 @@ class EventLoop {
           ob.off += left;
           left = 0;
         }
+      }
+      if (chaos_short) {
+        stats_->chaos_short_writes.Add(1);
+        break;  // leave the remainder for the EPOLLOUT path
       }
     }
     const bool pending = !c->outq_.empty();
@@ -937,6 +1053,7 @@ class EventLoop {
   bool draining_ = false;
   int64_t drain_deadline_ = 0;
   int64_t next_scan_us_ = 0;
+  uint64_t chaos_ctr_ = 0;  // ChaosHit dice; owner-thread only
 };
 
 // ---------------------------------------------------------------------------
@@ -1313,3 +1430,36 @@ void Server::Stop() {
 
 }  // namespace net
 }  // namespace ptpu
+
+// ---------------------------------------------------------------------------
+// C ABI over the process-global capture ring (declared in
+// ptpu_inference_api.h; compiled into BOTH shipping .so's because
+// this TU links into each). Mirrors the ptpu_trace_set/json pair.
+// ---------------------------------------------------------------------------
+
+// Runtime sampling override: 0 off, 1 every frame, N 1-in-N;
+// negative keeps the current value. Ring/byte sizing stays env-only
+// (PTPU_CAPTURE_RING / PTPU_CAPTURE_BYTES — they size allocations).
+extern "C" __attribute__((visibility("default"))) void ptpu_capture_set(
+    int64_t sample) {
+  ptpu::capture::Global().Set(sample);
+}
+
+// JSON snapshot of the newest max_n captured frames (the /capturez
+// body; max_n <= 0 means 64). Returned pointer is valid until the
+// calling thread's next ptpu_capture_json call.
+extern "C" __attribute__((visibility("default"))) const char*
+ptpu_capture_json(int64_t max_n) {
+  thread_local std::string buf;
+  buf = ptpu::capture::Global().CapturezJson(
+      max_n > 0 ? size_t(max_n) : 64);
+  return buf.c_str();
+}
+
+// Persist the ring (oldest-first) as a capture file at `path` via
+// tmp + rename. Returns the number of records written, -1 on error.
+extern "C" __attribute__((visibility("default"))) int ptpu_capture_save(
+    const char* path) {
+  if (path == nullptr || path[0] == '\0') return -1;
+  return ptpu::capture::Global().SaveFile(path);
+}

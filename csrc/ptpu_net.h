@@ -63,6 +63,12 @@ struct Stats {
   Counter conns_accepted, conns_shed, handshake_fails,
       handshake_timeouts, idle_closes, epoll_wakeups,
       partial_write_flushes, http_reqs;
+  // Injected-fault counters (PTPU_CHAOS drills): every fault the net
+  // core injects is COUNTED here so a chaos soak can reconcile what
+  // the server says happened against what clients observed — exact
+  // equality is the pass condition, not "roughly the right number".
+  Counter chaos_conn_kills, chaos_read_delays, chaos_write_delays,
+      chaos_short_writes, chaos_handshake_drops;
   std::atomic<int64_t> active_conns{0};
 
   void Reset() {
@@ -74,8 +80,43 @@ struct Stats {
     epoll_wakeups.Reset();
     partial_write_flushes.Reset();
     http_reqs.Reset();
+    chaos_conn_kills.Reset();
+    chaos_read_delays.Reset();
+    chaos_write_delays.Reset();
+    chaos_short_writes.Reset();
+    chaos_handshake_drops.Reset();
     // active_conns is a live gauge, not a counter: reset must not
     // forget currently-open connections
+  }
+};
+
+// Env-gated fault injection (the chaos half of the ptpu_drill
+// harness): PTPU_CHAOS="kinds:rate" turns faults on for BOTH servers,
+// where kinds is a comma list of {kill,rdelay,wdelay,shortw,hsdrop}
+// (or "all") and rate N injects on 1-in-N eligible events. Unset (the
+// default) and malformed values leave every fault OFF — production
+// pays one branch per site. PTPU_CHAOS_DELAY_US sizes the rdelay /
+// wdelay stalls. Each injected fault increments its Stats counter,
+// and every kind maps onto a failure the core already survives:
+//   kill   — close an OPEN conn just before its next frame dispatch
+//            (peer sees EOF mid-pipeline, like a server crash)
+//   rdelay — stall before draining a readable socket (rx scheduling
+//            jitter / packet delay)
+//   wdelay — stall before a writev flush (tx congestion)
+//   shortw — cap one flush to a single byte, forcing the partial-
+//            write EPOLLOUT path (tiny socket buffers); lossless
+//   hsdrop — reject a VALID handshake MAC (flaky auth / mid-deploy
+//            key skew); client sees the normal handshake-fail close
+struct ChaosConfig {
+  bool kill = false;
+  bool rdelay = false;
+  bool wdelay = false;
+  bool shortw = false;
+  bool hsdrop = false;
+  int64_t rate = 0;          // 0 = off; N = 1-in-N eligible events
+  int64_t delay_us = 2000;   // rdelay/wdelay stall length
+  bool enabled() const {
+    return rate > 0 && (kill || rdelay || wdelay || shortw || hsdrop);
   }
 };
 
@@ -104,6 +145,10 @@ struct Options {
   // HTTP listener keeps accepting through StopAccepting() (health
   // probes must reach a draining server) and closes at Drain().
   int http_port = -1;
+  // Fault injection for production drills (see ChaosConfig above).
+  // Default-constructed = fully off; OptionsFromEnv fills it from
+  // PTPU_CHAOS / PTPU_CHAOS_DELAY_US.
+  ChaosConfig chaos;
 };
 
 // Apply the PTPU_NET_* env knobs on top of `base` (both servers call
@@ -111,7 +156,9 @@ struct Options {
 // PTPU_NET_MAX_CONNS, PTPU_NET_HANDSHAKE_US, PTPU_NET_IDLE_US,
 // PTPU_NET_SOCKBUF, PTPU_NET_MAX_OUT (the per-connection queued-reply
 // byte cap that cuts slow readers), PTPU_NET_HTTP (telemetry HTTP
-// port: -1 off, 0 free pick). Unset/invalid vars keep the base value.
+// port: -1 off, 0 free pick), and the chaos drill knobs PTPU_CHAOS
+// ("kinds:rate") + PTPU_CHAOS_DELAY_US. Unset/invalid vars keep the
+// base value.
 Options OptionsFromEnv(Options base);
 
 // Frame-handler verdict for one dispatched frame.
@@ -310,8 +357,9 @@ struct HttpReply {
 // The shared telemetry routes both servers mount on their second
 // (HTTP) listener: /healthz (503 {"status":"draining"} when
 // `draining`), /statsz (stats_json()), /metrics (the C Prometheus
-// renderer over the same snapshot, family prefix `prom_prefix`), and
-// /tracez?n=K (the shared ptpu_trace ring). Anything else is 404.
+// renderer over the same snapshot, family prefix `prom_prefix`),
+// /tracez?n=K (the shared ptpu_trace ring), and /capturez?n=K (the
+// shared ptpu_capture frame ring). Anything else is 404.
 HttpReply TelemetryHttp(const std::string& target,
                         const std::function<std::string()>& stats_json,
                         const std::string& prom_prefix, bool draining);

@@ -442,6 +442,11 @@ std::string PsServer::StatsJson() {
       {"epoll_wakeups", &nt.epoll_wakeups},
       {"partial_write_flushes", &nt.partial_write_flushes},
       {"http_reqs", &nt.http_reqs},
+      {"chaos_conn_kills", &nt.chaos_conn_kills},
+      {"chaos_read_delays", &nt.chaos_read_delays},
+      {"chaos_write_delays", &nt.chaos_write_delays},
+      {"chaos_short_writes", &nt.chaos_short_writes},
+      {"chaos_handshake_drops", &nt.chaos_handshake_drops},
   };
   for (const auto &kv : cs) {
     ptpu::AppendJsonU64(&out, kv.name, kv.c->Get());

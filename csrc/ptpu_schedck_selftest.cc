@@ -60,6 +60,7 @@ PTPU_LOCK_CLASS(kClsRtArena, "rt.arena", 80);
 PTPU_LOCK_CLASS(kClsRtQueue, "rt.queue", 82);
 PTPU_LOCK_CLASS(kClsRtProfiler, "rt.profiler", 84);
 PTPU_LOCK_CLASS(kClsRtStats, "rt.stats", 86);
+PTPU_LOCK_CLASS(kClsSvShadow, "sv.shadow", 15, ptpu::kLockAllowBlock);
 PTPU_LOCK_CLASS(kClsNetConnOut, "net.conn_out", 100);
 PTPU_LOCK_CLASS(kClsPredOutpin, "pred.outpin", 105);
 PTPU_LOCK_CLASS(kClsNetInbox, "net.inbox", 110);
@@ -774,6 +775,52 @@ void TuneRegistryScenario(int probers, int savers) {
   if (st.snap != -1) SCHEDCK_ASSERT(st.snap == st.winner);
 }
 
+// --- sv.shadow: sampled mirror runs vs concurrent batch workers ----
+// Mirrors the ptpu_serving.cc shadow plane (ISSUE 18): instance
+// workers finish a primary batch OUTSIDE any lock, roll the shared
+// atomic sampling dice, and 1-in-N of them take shadow_mu_ to re-run
+// the batch on the ONE shared shadow predictor (thread-compatible,
+// not thread-safe) and fold diff stats. Invariants: the shadow
+// predictor is never entered concurrently, the primary path never
+// runs under shadow_mu_, and the folded stats account for exactly
+// the sampled batches — none lost, none double-counted.
+void ShadowScenario(int workers, int batches_each) {
+  constexpr int kSample = 2;
+  struct St {
+    ptpu::Mutex mu{kClsSvShadow};
+    int ctr = 0;          // sampling dice; one model step == atomic
+    bool in_run = false;  // shadow predictor occupancy
+    int batches = 0;      // sstats.batches
+    uint64_t maxd = 0;    // sstats.max_abs_diff_e9 (CAS-max fold)
+  } st;
+  std::vector<sck::Thread> ws;
+  for (int w = 1; w <= workers; ++w) {
+    ws.emplace_back([&st, w, batches_each] {
+      for (int i = 0; i < batches_each; ++i) {
+        PTPU_LOCKDEP_ASSERT_NO_LOCKS("the primary batch run");
+        PTPU_SCHED_POINT();  // primary predict runs unlocked
+        if (st.ctr++ % kSample != 0) continue;
+        ptpu::MutexLock g(st.mu);
+        SCHEDCK_ASSERT(!st.in_run);  // single-occupancy predictor
+        st.in_run = true;
+        PTPU_SCHED_POINT();  // the shadow run, under the mutex
+        st.in_run = false;
+        ++st.batches;
+        const uint64_t d = uint64_t(w);  // this batch's |Δ|, 1e-9
+        if (d > st.maxd) st.maxd = d;
+      }
+    });
+  }
+  for (auto& t : ws) t.join();
+  const int total = workers * batches_each;
+  SCHEDCK_ASSERT(st.ctr == total);
+  // dice values 0..total-1 occur exactly once each, so the sampled
+  // count is interleaving-independent; WHICH worker drew each hit is
+  // not, so the diff fold is only bounded
+  SCHEDCK_ASSERT(st.batches == (total + kSample - 1) / kSample);
+  SCHEDCK_ASSERT(st.maxd >= 1 && st.maxd <= uint64_t(workers));
+}
+
 // --- the REAL trace seqlock (ptpu_trace.cc, compiled in) -----------
 // Production Record()/Snapshot() with their live PTPU_SCHED_POINT()s:
 // writers stamp every span field with one signature value; whatever
@@ -1051,6 +1098,8 @@ void RunScenarios() {
        [] { RuntimeLocksScenario(2, 2); }},
       {"tune_probe_insert_save", [] { TuneRegistryScenario(2, 1); },
        [] { TuneRegistryScenario(3, 2); }},
+      {"shadow_mirror_sample", [] { ShadowScenario(2, 2); },
+       [] { ShadowScenario(3, 3); }},
       {"trace_seqlock_real", [] { TraceSeqlockScenario(1, 2, 2); },
        [] { TraceSeqlockScenario(2, 3, 3); }},
   };

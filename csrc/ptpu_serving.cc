@@ -55,6 +55,7 @@
 #include <algorithm>
 #include <atomic>
 #include <chrono>
+#include <cmath>
 #include <cstdint>
 #include <cstdlib>
 #include <cstdio>
@@ -86,6 +87,11 @@ namespace {
 // registry lock nests inside it, and reply sends (net.conn_out, rank
 // 100) nest inside both.
 PTPU_LOCK_CLASS(kLockSvKv, "sv.kv", 10, ptpu::kLockAllowBlock);
+// shadow-mirror predictors are shared across instance workers and the
+// predictor is thread-compatible, not thread-safe: the shadow run
+// serializes on this lock (held across a blocking run, like sv.kv;
+// ranked under sv.sess so it can never invert with the registry)
+PTPU_LOCK_CLASS(kLockSvShadow, "sv.shadow", 15, ptpu::kLockAllowBlock);
 PTPU_LOCK_CLASS(kLockSvSess, "sv.sess", 20);
 PTPU_LOCK_CLASS(kLockSvBatcher, "sv.batcher", 30);
 
@@ -258,6 +264,31 @@ struct SvStats {
     batch_fill.Reset();
     e2e_us.Reset();
     run_us.Reset();
+  }
+};
+
+/* Shadow-mirror counters (production drills): sampled INFER batches
+ * re-run on a second loaded artifact (PTPU_SHADOW_MODEL) with output
+ * + latency diffing — the safety check a hot model swap rides.
+ * Everything here is u64 (diffs in 1e-9 units) so the `shadow` stats
+ * object renders through the /metrics Prometheus walker unchanged. */
+struct ShadowStats {
+  ptpu::Counter batches;             // mirrored batches run
+  ptpu::Counter requests;            // requests inside them
+  ptpu::Counter mismatched_batches;  // diff > tol or shape mismatch
+  ptpu::Counter run_errors;          // shadow alloc/run failures
+  ptpu::Counter primary_run_us;      // primary run_us, mirrored only
+  ptpu::Counter shadow_run_us;       // shadow run_us (latency diff)
+  std::atomic<uint64_t> max_abs_diff_e9{0};  // worst |Δ| seen, 1e-9
+
+  void Reset() {
+    batches.Reset();
+    requests.Reset();
+    mismatched_batches.Reset();
+    run_errors.Reset();
+    primary_run_us.Reset();
+    shadow_run_us.Reset();
+    max_abs_diff_e9.store(0, std::memory_order_relaxed);
   }
 };
 
@@ -673,6 +704,23 @@ struct SvServer {
   int n_outputs = 0;
   std::string meta_json;
 
+  /* ---- shadow traffic plane (production drills) ----
+   * PTPU_SHADOW_MODEL loads a SECOND artifact next to the primary
+   * ladder; 1-in-PTPU_SHADOW_SAMPLE INFER batches re-run on it after
+   * their primary replies are queued, and outputs/latency diff into
+   * sstats (surfaced as the `shadow` stats object + GET /shadowz).
+   * One bucket set shared by every instance worker, serialized on
+   * shadow_mu_ — mirroring is sampled diagnostics, not a second
+   * serving plane, so one run at a time is the point. */
+  std::string shadow_model_path;
+  int64_t shadow_sample = 1;       // PTPU_SHADOW_SAMPLE: 1-in-N batches
+  double shadow_tol = 1e-5;        // PTPU_SHADOW_TOL: max |Δ| allowed
+  void* shadow_pool = nullptr;
+  std::map<int64_t, PTPU_Predictor*> shadow_buckets;
+  std::atomic<uint64_t> shadow_ctr_{0};
+  ptpu::Mutex shadow_mu_{kLockSvShadow};
+  ShadowStats sstats;
+
   std::vector<std::unique_ptr<SvInstance>> insts;
   std::unique_ptr<SvBatcher> batcher;
   SvStats stats;
@@ -793,6 +841,38 @@ struct SvServer {
     // start of the same ladder then loads them and probes nothing)
     if (ptpu::tune::Registry::Enabled())
       ptpu::tune::Registry::Inst().SaveIfDirty();
+
+    /* ---- optional shadow plane (production drills): a second
+     * artifact built over the SAME surviving ladder, its own worker
+     * pool. The shadow model must be signature-compatible with the
+     * primary (same inputs/outputs) — a drill that cannot compare is
+     * a configuration error, so it fails start loudly. */
+    const char* sm = std::getenv("PTPU_SHADOW_MODEL");
+    if (sm && *sm) {
+      shadow_model_path = sm;
+      const char* se = std::getenv("PTPU_SHADOW_SAMPLE");
+      if (se && *se) shadow_sample = std::atoll(se);
+      if (shadow_sample < 1) shadow_sample = 1;
+      const char* te = std::getenv("PTPU_SHADOW_TOL");
+      if (te && *te) shadow_tol = std::atof(te);
+      if (!(shadow_tol >= 0)) shadow_tol = 1e-5;
+      shadow_pool = ptpu_workpool_create(threads_per_instance);
+      for (int64_t b : ladder) {
+        PTPU_Predictor* sp = ptpu_predictor_create_opts(
+            shadow_model_path.c_str(), b, 0, err, sizeof(err));
+        if (!sp)
+          throw std::runtime_error(std::string("shadow bucket ") +
+                                   std::to_string(b) + ": " + err);
+        ptpu_predictor_set_pool(sp, shadow_pool);
+        shadow_buckets[b] = sp;
+      }
+      PTPU_Predictor* s1 = shadow_buckets[ladder.front()];
+      if (ptpu_predictor_num_inputs(s1) != int(sig.size()) ||
+          ptpu_predictor_num_outputs(s1) != n_outputs)
+        throw std::runtime_error(
+            "shadow model input/output signature differs from the "
+            "primary — cannot mirror traffic onto it");
+    }
 
     // ---- optional KV-decode plane: its own predictor (the KV arena
     // lives inside it — sessions are bound to ONE predictor), its own
@@ -1298,6 +1378,16 @@ struct SvServer {
   // serving control plane's health/metrics/trace surface (shared
   // routes — csrc/ptpu_net.cc TelemetryHttp).
   ptpu::net::HttpReply HandleHttp(const std::string& target) {
+    // serving-only route: the shadow-diff snapshot (the shared
+    // TelemetryHttp table serves everything else, /capturez included)
+    const std::string path = target.substr(0, target.find('?'));
+    if (path == "/shadowz") {
+      ptpu::net::HttpReply rep;
+      rep.content_type = "application/json";
+      rep.body = ShadowJson();
+      rep.body += '\n';
+      return rep;
+    }
     return ptpu::net::TelemetryHttp(
         target, [this] { return StatsJson(); }, "ptpu_serving",
         draining.load(std::memory_order_relaxed) ||
@@ -1388,6 +1478,11 @@ struct SvServer {
      * i32 wire payloads widen into the predictor's int64 storage as
      * they land — exactly the widening set_input_i32 performed on its
      * own copy. */
+    // the shadow mirror (end of this function) re-reads the gathered
+    // batch straight out of the primary's input storage — valid until
+    // this worker's NEXT input_alloc on p, i.e. its next batch
+    std::vector<void*> in_ptrs;
+    in_ptrs.reserve(sig.size());
     for (size_t i = 0; i < sig.size(); ++i) {
       std::vector<int64_t> dims;
       dims.push_back(bucket);
@@ -1396,6 +1491,7 @@ struct SvServer {
           p, sig[i].name.c_str(), sig[i].dtype, dims.data(),
           int(dims.size()), err, sizeof(err));
       if (!dst) return fail_all(std::string("input_alloc: ") + err);
+      in_ptrs.push_back(dst);
       const size_t total_el = size_t(bucket) * size_t(sig[i].row_elems);
       if (sig[i].dtype == SV_I32) {
         int64_t* d = static_cast<int64_t*>(dst);
@@ -1539,6 +1635,92 @@ struct SvServer {
         }
       }
       r.conn->NotePending(-1);  // pairs the enqueue-time +1
+    }
+
+    /* ---- shadow mirror (production drills): re-run 1-in-N batches
+     * on the shadow artifact and diff outputs + latency. Runs AFTER
+     * every primary reply is queued — mirroring adds zero latency to
+     * the answers clients see; the primary outputs stay comparable
+     * through rp (the replies' pin), the inputs through in_ptrs. */
+    if (!shadow_buckets.empty() &&
+        shadow_ctr_.fetch_add(1, std::memory_order_relaxed) %
+                uint64_t(shadow_sample) ==
+            0) {
+      ptpu::MutexLock sl(shadow_mu_);
+      PTPU_Predictor* sp = shadow_buckets[bucket];
+      bool fed = true;
+      for (size_t i = 0; i < sig.size(); ++i) {
+        std::vector<int64_t> dims;
+        dims.push_back(bucket);
+        dims.insert(dims.end(), sig[i].tail.begin(),
+                    sig[i].tail.end());
+        void* sdst = ptpu_predictor_input_alloc(
+            sp, sig[i].name.c_str(), sig[i].dtype, dims.data(),
+            int(dims.size()), err, sizeof(err));
+        if (!sdst) {
+          sstats.run_errors.Add(1);
+          fed = false;
+          break;
+        }
+        // i32 wire inputs widened into int64 storage at gather; the
+        // primary's storage bytes ARE the batch, padding included
+        const size_t esz = sig[i].dtype == SV_I32
+                               ? 8
+                               : size_t(sv_dtype_size(sig[i].dtype));
+        std::memcpy(sdst, in_ptrs[i],
+                    size_t(bucket) * size_t(sig[i].row_elems) * esz);
+      }
+      if (fed) {
+        const int64_t s0 = ptpu::NowUs();
+        if (ptpu_predictor_run(sp, err, sizeof(err)) != 0) {
+          sstats.run_errors.Add(1);
+        } else {
+          const int64_t s1 = ptpu::NowUs();
+          sstats.batches.Add(1);
+          sstats.requests.Add(uint64_t(batch.size()));
+          sstats.primary_run_us.Add(uint64_t(t1 - t0));
+          sstats.shadow_run_us.Add(uint64_t(s1 - s0));
+          double maxd = 0;
+          bool shape_mismatch = false;
+          for (int o = 0; o < n_outputs; ++o) {
+            const OutView& v = outs[size_t(o)];
+            const int nd = ptpu_predictor_output_ndim(sp, o);
+            const int64_t* od = ptpu_predictor_output_dims(sp, o);
+            const float* sd = ptpu_predictor_output_data(sp, o);
+            if (nd != int(v.dims.size()) || !od || !sd) {
+              shape_mismatch = true;
+              continue;
+            }
+            bool dims_ok = true;
+            for (int k = 0; k < nd; ++k)
+              dims_ok = dims_ok && od[k] == v.dims[size_t(k)];
+            if (!dims_ok) {
+              shape_mismatch = true;
+              continue;
+            }
+            // real rows only — the padded bucket tail is computed
+            // garbage on BOTH models and must not pollute the diff
+            const size_t ne = size_t(rows) * size_t(v.row_elems);
+            for (size_t k = 0; k < ne; ++k) {
+              const double d =
+                  std::fabs(double(sd[k]) - double(v.data[k]));
+              if (d > maxd) maxd = d;
+            }
+          }
+          // worst |Δ| in 1e-9 units (u64 keeps /metrics walkable);
+          // CAS-max races only with other mirrored batches
+          const uint64_t nv =
+              uint64_t(std::min(maxd * 1e9, 1e18));
+          uint64_t cur =
+              sstats.max_abs_diff_e9.load(std::memory_order_relaxed);
+          while (nv > cur &&
+                 !sstats.max_abs_diff_e9.compare_exchange_weak(
+                     cur, nv, std::memory_order_relaxed)) {
+          }
+          if (shape_mismatch || maxd > shadow_tol)
+            sstats.mismatched_batches.Add(1);
+        }
+      }
     }
   }
 
@@ -3068,6 +3250,13 @@ struct SvServer {
       ptpu_workpool_destroy(dec_pool);
       dec_pool = nullptr;
     }
+    // shadow plane: predictors before their pool
+    for (auto& kv2 : shadow_buckets) ptpu_predictor_destroy(kv2.second);
+    shadow_buckets.clear();
+    if (shadow_pool) {
+      ptpu_workpool_destroy(shadow_pool);
+      shadow_pool = nullptr;
+    }
   }
 
   // --------------------------------------------------------- stats
@@ -3090,6 +3279,11 @@ struct SvServer {
         {"epoll_wakeups", &net.epoll_wakeups},
         {"partial_write_flushes", &net.partial_write_flushes},
         {"http_reqs", &net.http_reqs},
+        {"chaos_conn_kills", &net.chaos_conn_kills},
+        {"chaos_read_delays", &net.chaos_read_delays},
+        {"chaos_write_delays", &net.chaos_write_delays},
+        {"chaos_short_writes", &net.chaos_short_writes},
+        {"chaos_handshake_drops", &net.chaos_handshake_drops},
         {"bytes_in", &stats.bytes_in},
         {"bytes_out", &stats.bytes_out},
         {"cpu_us", &stats.cpu_us},
@@ -3193,7 +3387,43 @@ struct SvServer {
       }
       out += '}';
     }
+    out += ",\"shadow\":";
+    out += ShadowJson();
     out += "}";
+    return out;
+  }
+
+  // The `shadow` stats object / GET /shadowz body. u64-only (diffs
+  // and tolerance in 1e-9 units) so /metrics renders it as counters.
+  std::string ShadowJson() {
+    std::string out = "{";
+    ptpu::AppendJsonU64(&out, "enabled",
+                        shadow_buckets.empty() ? 0 : 1);
+    out += ',';
+    ptpu::AppendJsonU64(&out, "sample", uint64_t(shadow_sample));
+    out += ',';
+    ptpu::AppendJsonU64(&out, "tol_e9",
+                        uint64_t(std::min(shadow_tol * 1e9, 1e18)));
+    out += ',';
+    const struct {
+      const char* name;
+      const ptpu::Counter* c;
+    } ss[] = {
+        {"batches", &sstats.batches},
+        {"requests", &sstats.requests},
+        {"mismatched_batches", &sstats.mismatched_batches},
+        {"run_errors", &sstats.run_errors},
+        {"primary_run_us", &sstats.primary_run_us},
+        {"shadow_run_us", &sstats.shadow_run_us},
+    };
+    for (const auto& kv : ss) {
+      ptpu::AppendJsonU64(&out, kv.name, kv.c->Get());
+      out += ',';
+    }
+    ptpu::AppendJsonU64(
+        &out, "max_abs_diff_e9",
+        sstats.max_abs_diff_e9.load(std::memory_order_relaxed));
+    out += '}';
     return out;
   }
 
@@ -3218,6 +3448,7 @@ struct SvServer {
     net.Reset();
     dstats.Reset();
     dec_bstats.Reset();
+    sstats.Reset();
     dyn_fallback_base_.store(DynFallbackSum(),
                              std::memory_order_relaxed);
   }

@@ -583,3 +583,100 @@ func TuneLoad(path string) int {
 func TuneClear() {
 	C.ptpu_tune_clear()
 }
+
+// CaptureSet overrides the raw-frame capture sampling rate at runtime
+// (0 off, 1 every frame, N 1-in-N; negative keeps the current value).
+// Process-global; capture is off by default (PTPU_CAPTURE_SAMPLE=0).
+func CaptureSet(sample int64) {
+	C.ptpu_capture_set(C.int64_t(sample))
+}
+
+// CaptureJSON snapshots the newest maxN captured frames as JSON (the
+// GET /capturez body; maxN <= 0 means 64).
+func CaptureJSON(maxN int64) string {
+	return C.GoString(C.ptpu_capture_json(C.int64_t(maxN)))
+}
+
+// CaptureSave persists the capture ring (oldest-first) as a capture
+// file at path for tools/drill_replay.py. Returns records written,
+// -1 on error. Capture files are per-machine diagnostics.
+func CaptureSave(path string) int {
+	cs := C.CString(path)
+	defer C.free(unsafe.Pointer(cs))
+	return int(C.ptpu_capture_save(cs))
+}
+
+// InputAlloc resolves the named input at dims and returns its
+// WRITABLE storage (zero-copy serving hook): callers gather wire rows
+// straight into the batch tensor instead of staging + SetInput. dtype
+// uses the ONNX codes (1 = f32, 6 = i32, 7 = i64); f32 storage is
+// float32[numel], i32/i64 inputs share the predictor's internal
+// int64[numel] plane (i32 writers widen as they store). The storage
+// is reused across calls and EVERY element (pad rows included) must
+// be written before Run.
+func (p *Predictor) InputAlloc(name string, dtype int,
+	dims []int64) (unsafe.Pointer, error) {
+	cname := C.CString(name)
+	defer C.free(unsafe.Pointer(cname))
+	buf := make([]C.char, errLen)
+	dp, nd := dimsPtr(dims)
+	ptr := C.ptpu_predictor_input_alloc(p.p, cname, C.int(dtype),
+		dp, nd, &buf[0], errLen)
+	runtime.KeepAlive(p)
+	if ptr == nil {
+		return nil, lastErr(buf)
+	}
+	return ptr, nil
+}
+
+// OutputsPin keeps one run's detached outputs alive independent of
+// later runs on the predictor (the scatter-reply contract: reply
+// iovecs point at pinned storage until the last byte flushes).
+type OutputsPin struct {
+	pin unsafe.Pointer
+}
+
+// OutputsDetach moves the LAST run's outputs into a refcounted pin
+// (integer outputs already converted to f32). Returns nil when the
+// last run produced no outputs. Release the pin when done.
+func (p *Predictor) OutputsDetach() *OutputsPin {
+	pin := C.ptpu_predictor_outputs_detach(p.p)
+	runtime.KeepAlive(p)
+	if pin == nil {
+		return nil
+	}
+	return &OutputsPin{pin: pin}
+}
+
+// Count reports how many outputs the pin holds.
+func (o *OutputsPin) Count() int {
+	return int(C.ptpu_outputs_pin_count(o.pin))
+}
+
+// Output copies output i out of the pin (data, dims). The copies
+// stay valid after Release, unlike the C pointers.
+func (o *OutputsPin) Output(i int) ([]float32, []int64) {
+	nd := int(C.ptpu_outputs_pin_ndim(o.pin, C.int(i)))
+	cdims := C.ptpu_outputs_pin_dims(o.pin, C.int(i))
+	if nd < 0 || (nd > 0 && cdims == nil) {
+		return nil, nil
+	}
+	dims := make([]int64, nd)
+	n := int64(1)
+	cd := unsafe.Slice((*int64)(unsafe.Pointer(cdims)), nd)
+	for k := 0; k < nd; k++ {
+		dims[k] = cd[k]
+		n *= cd[k]
+	}
+	cdata := C.ptpu_outputs_pin_data(o.pin, C.int(i))
+	out := make([]float32, n)
+	copy(out, unsafe.Slice((*float32)(unsafe.Pointer(cdata)), n))
+	return out, dims
+}
+
+// Release drops this handle's reference; storage frees once the net
+// core (or any other holder) drops the rest.
+func (o *OutputsPin) Release() {
+	C.ptpu_outputs_pin_release(o.pin)
+	o.pin = nil
+}

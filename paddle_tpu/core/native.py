@@ -404,6 +404,16 @@ def _ps_load() -> Optional[ctypes.CDLL]:
             lib._ptpu_has_ps_http = True
         except AttributeError:   # stale prebuilt .so: telemetry off
             lib._ptpu_has_ps_http = False
+        try:
+            # raw-frame capture ring ABI (production drills)
+            lib.ptpu_capture_set.argtypes = [c.c_int64]
+            lib.ptpu_capture_json.restype = c.c_char_p
+            lib.ptpu_capture_json.argtypes = [c.c_int64]
+            lib.ptpu_capture_save.restype = c.c_int
+            lib.ptpu_capture_save.argtypes = [c.c_char_p]
+            lib._ptpu_has_capture = True
+        except AttributeError:   # stale prebuilt .so: capture off
+            lib._ptpu_has_capture = False
         _PS_LIB = lib
         return _PS_LIB
 
@@ -749,6 +759,16 @@ def _predictor_lib() -> ctypes.CDLL:
             lib._ptpu_has_http = True
         except AttributeError:   # stale prebuilt .so: telemetry off
             lib._ptpu_has_http = False
+        try:
+            # raw-frame capture ring ABI (production drills)
+            lib.ptpu_capture_set.argtypes = [c.c_int64]
+            lib.ptpu_capture_json.restype = c.c_char_p
+            lib.ptpu_capture_json.argtypes = [c.c_int64]
+            lib.ptpu_capture_save.restype = c.c_int
+            lib.ptpu_capture_save.argtypes = [c.c_char_p]
+            lib._ptpu_has_capture = True
+        except AttributeError:   # stale prebuilt .so: capture off
+            lib._ptpu_has_capture = False
         try:
             # speculative decoding ABI (r13) — width-k verify steps,
             # COW-safe session trims, draft/verify server start
@@ -1256,6 +1276,7 @@ ABI_SYMBOLS = {
         "ptpu_ps_server_stop", "ptpu_ps_server_stats_json",
         "ptpu_ps_server_stats_reset", "ptpu_ps_server_prom_text",
         "ptpu_trace_set", "ptpu_trace_json",
+        "ptpu_capture_set", "ptpu_capture_json", "ptpu_capture_save",
     ),
     "_native_predictor.so": (
         "ptpu_predictor_create", "ptpu_predictor_create_opts",
@@ -1294,6 +1315,7 @@ ABI_SYMBOLS = {
         "ptpu_serving_config_json", "ptpu_serving_stats_json",
         "ptpu_serving_stats_reset", "ptpu_serving_prom_text",
         "ptpu_serving_stop", "ptpu_trace_set", "ptpu_trace_json",
+        "ptpu_capture_set", "ptpu_capture_json", "ptpu_capture_save",
         "ptpu_tune_stats_json", "ptpu_tune_save", "ptpu_tune_load",
         "ptpu_tune_clear",
     ),

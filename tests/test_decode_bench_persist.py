@@ -80,6 +80,13 @@ class TestDecodeBenchPersist:
                 "int4_quality_vs_fp32", "int4_ab_tokens_per_s_1s",
                 "int4_ab_tokens_per_s_2s", "autotune_gemm_win",
                 "tune_warm_cache_probe_cost"} <= metrics
+        # host fingerprint (ISSUE 18): bench docs from different
+        # machines must be distinguishable
+        host = bench_out["host"]
+        assert host["nproc"] == (os.cpu_count() or 1)
+        assert isinstance(host["cpu_sig"], str) \
+            and len(host["cpu_sig"]) == 16
+        int(host["cpu_sig"], 16)
 
     def test_counters_exact(self, bench_out):
         by = {r["metric"]: r for r in bench_out["measurements"]}
@@ -202,3 +209,4 @@ class TestDecodeBenchPersist:
         assert {"int4_quality_vs_fp32", "int4_ab_tokens_per_s_1s",
                 "autotune_gemm_win",
                 "tune_warm_cache_probe_cost"} <= metrics
+        assert i4["host"]["nproc"] == (os.cpu_count() or 1)

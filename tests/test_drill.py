@@ -1,0 +1,331 @@
+"""Production drill harness (ISSUE 18): capture rings, traffic
+replay, shadow diffing, chaos reconciliation.
+
+Four planes, each tested at its contract boundary:
+
+  * capture FILE format — tools/drill_replay.py is the Python twin of
+    csrc/ptpu_capture.h (whole-file reject posture; parity pinned by
+    tools/ptpu_check.py, exercised here on real bytes);
+  * capture RING + /capturez — ring size/sample env is frozen at the
+    first native touch per process, so ring-shape tests run in a
+    SUBPROCESS with a pinned PTPU_CAPTURE_RING;
+  * capture -> replay round trip — drill_replay selfbench: live
+    traffic captured on server A replays against fresh server B with
+    the per-op counter mix reproduced within 5% (asserted inside
+    sweep(); the subprocess exit code is the assertion);
+  * shadow diffing + chaos — a deliberately perturbed shadow model
+    must be FLAGGED (mismatched_batches > 0) while the identical
+    model stays clean; the two-phase chaos selfsoak must end in
+    EXACT counter reconciliation with zero stuck sessions.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import drill_replay as dr  # noqa: E402
+
+DRILL = os.path.join(REPO, "tools", "drill_replay.py")
+
+
+def _sub_env(**extra):
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO + os.pathsep +
+                env.get("PYTHONPATH", "")})
+    env.pop("XLA_FLAGS", None)
+    for k in list(env):
+        if k.startswith("PTPU_CAPTURE") or k.startswith("PTPU_CHAOS") \
+                or k.startswith("PTPU_SHADOW"):
+            env.pop(k)
+    env.update(extra)
+    return env
+
+
+def _rec(ts=1000, conn=7, payload=b"\x01\x60" + b"\x00" * 10,
+         frame_len=None, ver=None, tag=None):
+    return {"ts_us": ts, "conn": conn, "payload": payload,
+            "frame_len": len(payload) if frame_len is None
+            else frame_len,
+            "ver": payload[0] if ver is None and payload else
+            (ver or 0),
+            "tag": payload[1] if tag is None and len(payload) > 1 else
+            (tag or 0)}
+
+
+class TestCaptureFileFormat:
+    """Python side of the ptpu-capture v1 twins (C side:
+    csrc/ptpu_drill_selftest.cc test_capture_parse_reject_family)."""
+
+    def test_round_trip(self, tmp_path):
+        recs = [_rec(ts=10 * i, conn=i % 3,
+                     payload=bytes([1, 0x60]) + bytes(range(i + 1)))
+                for i in range(5)]
+        blob = dr.serialize_capture(recs)
+        assert dr.parse_capture_bytes(blob) == recs
+        p = str(tmp_path / "x.cap")
+        dr.save_capture(p, recs)
+        assert dr.load_capture(p) == recs
+
+    def test_truncated_record_round_trips(self):
+        # cap_len < frame_len models a ring payload cap: the full
+        # original length survives the file format
+        r = _rec(payload=b"\x01\x60" + b"ab", frame_len=512)
+        blob = dr.serialize_capture([r])
+        out = dr.parse_capture_bytes(blob)
+        assert out[0]["frame_len"] == 512
+        assert out[0]["payload"] == b"\x01\x60ab"
+
+    @pytest.mark.parametrize("mutate", [
+        lambda b: b[:11],                       # short header
+        lambda b: b"XXXX" + b[4:],              # bad magic
+        lambda b: b[:4] + b"\x09\0\0\0" + b[8:],  # bad version
+        lambda b: b + b"\x00",                  # trailing byte
+        lambda b: b[:-1],                       # truncated body
+        lambda b: b[:8] + b"\xff\xff\xff\xff" + b[12:],  # huge count
+    ])
+    def test_whole_file_reject(self, mutate):
+        blob = dr.serialize_capture([_rec()])
+        with pytest.raises(dr.CaptureFormatError):
+            dr.parse_capture_bytes(mutate(blob))
+
+    def test_reserved_and_mirror_rejects(self):
+        import struct
+        recs = [_rec()]
+        blob = bytearray(dr.serialize_capture(recs))
+        # record fixed part starts at 16; reserved is its last u16
+        off = 16 + dr.CAPTURE_REC_BYTES - 2
+        blob[off:off + 2] = struct.pack("<H", 1)
+        with pytest.raises(dr.CaptureFormatError):
+            dr.parse_capture_bytes(bytes(blob))
+        blob = bytearray(dr.serialize_capture(recs))
+        blob[16 + 24] ^= 0xFF   # ver byte no longer mirrors payload[0]
+        with pytest.raises(dr.CaptureFormatError):
+            dr.parse_capture_bytes(bytes(blob))
+
+
+_CAPTUREZ_SCRIPT = r"""
+import json, os, socket, sys
+os.environ["PTPU_CAPTURE_SAMPLE"] = "1"
+os.environ["PTPU_CAPTURE_RING"] = "64"   # the Ring ctor's slot floor
+os.environ["PTPU_CAPTURE_BYTES"] = "64"
+sys.path.insert(0, os.path.join(%(repo)r, "tools"))
+import drill_replay as dr
+import tempfile
+from paddle_tpu.inference import create_server
+
+tmp = tempfile.mkdtemp(prefix="ptpu_capz_")
+model = dr._export_mlp(tmp)
+with create_server(model, max_batch=4, deadline_us=1500,
+                   instances=1, http_port=0) as srv:
+    sock = dr.dial_framed("127.0.0.1", srv.port, srv.authkey)
+    for k in range(100):
+        f = dr._infer_frame(k, 1)
+        sock.sendall(dr._U32.pack(len(f)) + f)
+        n = dr._U32.unpack(dr._read_exact(sock, 4))[0]
+        dr._read_exact(sock, n)
+    sock.close()
+    # raw GET: status line + content-type are part of the contract
+    with socket.create_connection(("127.0.0.1", srv.http_port),
+                                  timeout=10) as s:
+        s.sendall(b"GET /capturez?n=200 HTTP/1.1\r\nHost: x\r\n"
+                  b"Connection: close\r\n\r\n")
+        raw = b""
+        while True:
+            c = s.recv(65536)
+            if not c:
+                break
+            raw += c
+    head, _, body = raw.partition(b"\r\n\r\n")
+    doc = json.loads(body)
+print(json.dumps({
+    "status": head.split(b"\r\n", 1)[0].decode(),
+    "content_type": [h.split(b":", 1)[1].strip().decode()
+                     for h in head.split(b"\r\n")
+                     if h.lower().startswith(b"content-type")][0],
+    "capturez": doc}))
+"""
+
+
+class TestCapturezRing:
+    @pytest.fixture(scope="class")
+    def capz(self):
+        r = subprocess.run(
+            [sys.executable, "-c", _CAPTUREZ_SCRIPT % {"repo": REPO}],
+            cwd=REPO, env=_sub_env(), capture_output=True, text=True,
+            timeout=300)
+        assert r.returncode == 0, \
+            f"rc={r.returncode}\nstdout:{r.stdout[-2000:]}\n" \
+            f"stderr:{r.stderr[-2000:]}"
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    def test_http_conformance(self, capz):
+        assert capz["status"].startswith("HTTP/1.1 200")
+        assert capz["content_type"] == "application/json"
+        doc = capz["capturez"]
+        assert doc["sample"] == 1 and doc["ring"] == 64
+        assert doc["bytes"] == 64
+
+    def test_ring_wraparound_exact(self, capz):
+        """100 frames through a 64-slot ring: recorded counts ALL of
+        them, the window is exactly the newest 64, newest-first."""
+        doc = capz["capturez"]
+        assert doc["recorded"] == 100
+        frames = doc["frames"]
+        assert len(frames) == 64
+        ts = [f["ts_us"] for f in frames]
+        assert ts == sorted(ts, reverse=True)
+        # rid sits at payload offset 2; the 64-byte cap keeps it
+        rids = {int.from_bytes(bytes.fromhex(f["data"])[2:10],
+                               "little") for f in frames}
+        assert rids == set(range(36, 100))
+        for f in frames:
+            assert f["ver"] == 1 and f["tag"] == 0x60
+            assert len(f["data"]) == 2 * 64   # capped at ring bytes
+            assert f["len"] > 64              # original frame length
+
+
+class TestCaptureReplayRoundTrip:
+    @pytest.fixture(scope="class")
+    def bench_doc(self, tmp_path_factory):
+        out = str(tmp_path_factory.mktemp("drill") / "BENCH_DRILL.json")
+        r = subprocess.run(
+            [sys.executable, DRILL, "selfbench", "--out", out,
+             "--speeds", "1,2", "--ops", "36"],
+            cwd=REPO, env=_sub_env(), capture_output=True, text=True,
+            timeout=480)
+        assert r.returncode == 0, \
+            f"rc={r.returncode}\nstdout:{r.stdout[-2000:]}\n" \
+            f"stderr:{r.stderr[-2000:]}"
+        with open(out) as f:
+            return json.load(f)
+
+    def test_counter_mix_reproduced(self, bench_doc):
+        """sweep() asserts replies == sent, the server-side request
+        delta, and the 5% per-op mix — exit 0 IS the reconciliation;
+        here we assert the persisted evidence shape."""
+        assert bench_doc["bench"] == "ptpu_drill"
+        assert bench_doc["captured_frames"] > 0
+        assert bench_doc["capture_conns"] >= 2
+        assert bench_doc["mix_tol"] == 0.05
+        orig = bench_doc["orig_mix"]
+        assert sum(orig.values()) == bench_doc["captured_frames"]
+        rows = bench_doc["rows"]
+        assert [row["speed"] for row in rows] == [1.0, 2.0]
+        for row in rows:
+            assert row["replies"] == row["sent"] > 0
+            assert row["conn_errors"] == 0
+            assert row["p50_us"] > 0 and row["p99_us"] >= row["p50_us"]
+            ok, worst = dr.mix_matches(orig, row["mix"],
+                                       bench_doc["mix_tol"])
+            assert ok, (worst, orig, row["mix"])
+
+    def test_host_meta_and_knee(self, bench_doc):
+        host = bench_doc["host"]
+        assert host["nproc"] == (os.cpu_count() or 1)
+        int(host["cpu_sig"], 16)
+        assert bench_doc["knee_frac"] == 0.9
+        # knee may be any swept speed (or None if even 1x saturates a
+        # loaded box) — but the field must be present
+        assert "knee_speed" in bench_doc
+
+
+class TestShadowDiff:
+    @pytest.fixture(scope="class")
+    def models(self, tmp_path_factory):
+        import jax.numpy as jnp
+        import paddle_tpu as pt
+        from paddle_tpu.onnx.converter import trace_to_onnx
+
+        tmp = tmp_path_factory.mktemp("shadow")
+        paths = {}
+        for name, seed in (("a", 0), ("perturbed", 1)):
+            pt.seed(seed)
+            net = pt.nn.Sequential(pt.nn.Linear(32, 64), pt.nn.ReLU(),
+                                   pt.nn.Linear(64, 8))
+            net.eval()
+            x = np.zeros((4, 32), np.float32)
+            p = str(tmp / f"{name}.onnx")
+            with open(p, "wb") as f:
+                f.write(trace_to_onnx(lambda a: net(a),
+                                      (jnp.asarray(x),)))
+            paths[name] = p
+        return paths
+
+    def _serve_and_infer(self, model, shadow, n=12):
+        from paddle_tpu.inference import create_server
+        os.environ["PTPU_SHADOW_MODEL"] = shadow
+        os.environ["PTPU_SHADOW_SAMPLE"] = "1"
+        os.environ["PTPU_SHADOW_TOL"] = "1e-6"
+        try:
+            with create_server(model, max_batch=4, deadline_us=1500,
+                               instances=1, http_port=0) as srv:
+                cli = srv.client()
+                x = np.random.RandomState(0) \
+                    .randn(2, 32).astype(np.float32)
+                for _ in range(n):
+                    cli.infer(x)
+                cli.close()
+                stats = srv.stats()
+                body = dr.http_get("127.0.0.1", srv.http_port,
+                                   "/shadowz")
+                return stats, json.loads(body)
+        finally:
+            for k in ("PTPU_SHADOW_MODEL", "PTPU_SHADOW_SAMPLE",
+                      "PTPU_SHADOW_TOL"):
+                os.environ.pop(k, None)
+
+    def test_perturbed_model_flagged(self, models):
+        stats, shz = self._serve_and_infer(models["a"],
+                                           models["perturbed"])
+        sh = stats["shadow"]
+        assert sh["enabled"] == 1 and sh["sample"] == 1
+        assert sh["batches"] > 0 and sh["run_errors"] == 0
+        assert sh["mismatched_batches"] > 0, sh
+        assert sh["max_abs_diff_e9"] > 1000, sh   # >> 1e-6 in 1e-9 u
+        assert sh["primary_run_us"] > 0 and sh["shadow_run_us"] > 0
+        # /shadowz serves the same live object (the last batch's
+        # mirror may complete between the two snapshots, so >=)
+        assert shz["enabled"] == 1
+        assert shz["mismatched_batches"] >= sh["mismatched_batches"] > 0
+
+    def test_identical_model_clean(self, models):
+        stats, shz = self._serve_and_infer(models["a"], models["a"])
+        sh = stats["shadow"]
+        assert sh["batches"] > 0 and sh["requests"] > 0
+        assert sh["mismatched_batches"] == 0, sh
+        assert sh["run_errors"] == 0
+        assert shz["mismatched_batches"] == 0
+
+    def test_shadow_off_by_default(self, models):
+        from paddle_tpu.inference import create_server
+        assert "PTPU_SHADOW_MODEL" not in os.environ
+        with create_server(models["a"], max_batch=4,
+                           instances=1) as srv:
+            sh = srv.stats()["shadow"]
+        assert sh["enabled"] == 0 and sh["batches"] == 0
+
+
+class TestChaosReconcile:
+    def test_selfsoak_reconciles_exactly(self):
+        """Both chaos phases (lossless delays/short-writes, then lossy
+        kills/handshake drops) reconcile EXACTLY: server counters ==
+        client-observed events, zero stuck sessions, connections
+        drained — all asserted inside selfsoak; rc 0 is the proof."""
+        r = subprocess.run(
+            [sys.executable, DRILL, "selfsoak", "--secs", "4"],
+            cwd=REPO, env=_sub_env(), capture_output=True, text=True,
+            timeout=480)
+        assert r.returncode == 0, \
+            f"rc={r.returncode}\nstdout:{r.stdout[-2000:]}\n" \
+            f"stderr:{r.stderr[-2000:]}"
+        assert "soak[lossless]" in r.stdout
+        assert "soak[lossy]" in r.stdout
+        assert r.stdout.count("reconciled exactly") == 2
+        assert "selfsoak: OK" in r.stdout

@@ -19,6 +19,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "tools", "serving_bench.py")
 
 
+def assert_host_meta(doc):
+    """Every persisted bench doc carries the host fingerprint (ISSUE
+    18): numbers from different machines must be distinguishable when
+    BENCH_*.json files are compared across checkouts."""
+    host = doc["host"]
+    assert host["nproc"] == (os.cpu_count() or 1)
+    sig = host["cpu_sig"]
+    assert isinstance(sig, str) and len(sig) == 16
+    int(sig, 16)  # hex digest prefix
+
+
 @pytest.fixture(scope="module")
 def bench_out(tmp_path_factory):
     out = str(tmp_path_factory.mktemp("svb") / "BENCH_SERVE.json")
@@ -55,6 +66,7 @@ class TestServingBenchPersist:
         assert rows, "no measurements persisted"
         for row in rows:
             assert {"metric", "value", "unit"} <= set(row)
+        assert_host_meta(bench_out)
 
     def test_throughput_rows_present_and_positive(self, bench_out):
         by = {r["metric"]: r for r in bench_out["measurements"]}
@@ -138,6 +150,7 @@ class TestTraceAbPersist:
         exact = by["trace_ab_counters_exact"]
         assert exact["value"] == 1, exact
         assert all(e["exact"] for e in exact["legs"])
+        assert_host_meta(trace_out)
 
 
 class TestCprAbPersist:
@@ -202,6 +215,7 @@ class TestCprAbPersist:
         # not noise): |reduction| under 30% even on a loaded box
         srv = by["cpr_ab_serving"]
         assert abs(srv["cpu_reduction_pct"]) < 30.0, srv
+        assert_host_meta(cpr_out)
 
     def test_normal_phase_rows_carry_cpu_columns(self, bench_out):
         """The plain bench's phase rows grew the cycles/request

@@ -36,8 +36,10 @@ ABI_FILES = [
 ]
 WIRE_FILES = [
     "csrc/ptpu_ps_server.cc", "csrc/ptpu_serving.cc",
+    "csrc/ptpu_capture.h",
     "paddle_tpu/distributed/ps/wire.py",
     "paddle_tpu/inference/serving.py",
+    "tools/drill_replay.py",
 ]
 STATS_FILES = [
     "csrc/ptpu_ps_table.cc", "csrc/ptpu_ps_server.cc",
@@ -51,9 +53,11 @@ NET_FILES = [
 TRACE_FILES = [
     "csrc/ptpu_trace.h", "csrc/ptpu_trace.cc",
     "csrc/ptpu_ps_server.cc", "csrc/ptpu_serving.cc",
+    "csrc/ptpu_net.cc",
     "paddle_tpu/profiler/timeline.py",
     "paddle_tpu/inference/serving.py",
     "paddle_tpu/distributed/ps/wire.py",
+    "tools/drill_replay.py",
 ]
 
 
@@ -262,6 +266,28 @@ class TestWireChecker:
                 "r.pin = nullptr;")
         msgs = [f.message for f in _run(root, "wire")]
         assert any("PinInbuf" in m for m in msgs)
+
+    def test_catches_capture_magic_drift(self, tmp_path):
+        """Drill capture files are a two-sided wire (ISSUE 18): a
+        Python-side magic rewrite would reject every C-written
+        capture."""
+        root = _fixture(tmp_path, WIRE_FILES)
+        _mutate(root, "tools/drill_replay.py",
+                "CAPTURE_MAGIC = 0x50414350",
+                "CAPTURE_MAGIC = 0x50414351")
+        msgs = [f.message for f in _run(root, "wire")]
+        assert any("kCaptureMagic" in m and "CAPTURE_MAGIC" in m
+                   for m in msgs)
+
+    def test_catches_capture_record_layout_drift(self, tmp_path):
+        """Shrinking the Python record struct mis-frames every capture
+        payload — the calcsize probe must fire."""
+        root = _fixture(tmp_path, WIRE_FILES)
+        _mutate(root, "tools/drill_replay.py",
+                '_REC = struct.Struct("<qQIIBBH")',
+                '_REC = struct.Struct("<qQIIBB")')
+        msgs = [f.message for f in _run(root, "wire")]
+        assert any("_REC packs to 26 bytes" in m for m in msgs)
 
 
 class TestStatsChecker:
@@ -517,6 +543,30 @@ class TestTraceChecker:
         msgs = [f.message for f in _run(root, "trace")]
         assert any("GetU64(req + 2)" in m for m in msgs)
 
+    def test_catches_dropped_capturez_route(self, tmp_path):
+        """The drill route twins (ISSUE 18): renaming /capturez on the
+        serving side strands the drill_replay.py consumer."""
+        root = _fixture(tmp_path, TRACE_FILES)
+        _mutate(root, "csrc/ptpu_net.cc",
+                'path == "/capturez"', 'path == "/capturex"')
+        msgs = [f.message for f in _run(root, "trace")]
+        assert any("/capturez is not served" in m for m in msgs)
+
+    def test_catches_dropped_shadowz_route(self, tmp_path):
+        root = _fixture(tmp_path, TRACE_FILES)
+        _mutate(root, "csrc/ptpu_serving.cc",
+                'path == "/shadowz"', 'path == "/shadowx"')
+        msgs = [f.message for f in _run(root, "trace")]
+        assert any("/shadowz is not served" in m for m in msgs)
+
+    def test_catches_dropped_capturez_consumer(self, tmp_path):
+        root = _fixture(tmp_path, TRACE_FILES)
+        _mutate(root, "tools/drill_replay.py",
+                '"/capturez?n={n}"', '"/capturex?n={n}"')
+        msgs = [f.message for f in _run(root, "trace")]
+        assert any("no consumer for route /capturez" in m
+                   for m in msgs)
+
 
 class TestSyncChecker:
     """ISSUE 11: raw mutex/condvar primitives banned outside
@@ -603,6 +653,7 @@ FUZZ_FILES = [
     "csrc/fuzz/fuzz_http.cc", "csrc/fuzz/fuzz_onnx.cc",
     "csrc/fuzz/fuzz_json.cc", "csrc/fuzz/fuzz_frames.cc",
     "csrc/fuzz/fuzz_tune.cc", "csrc/ptpu_tune.h",
+    "csrc/fuzz/fuzz_capture.cc", "csrc/ptpu_capture.h",
     "csrc/fuzz/gen_seeds.py",
 ]
 
@@ -700,6 +751,32 @@ class TestFuzzChecker:
                 os.remove(f_)
         msgs = [f.message for f in _run(root, "fuzz")]
         assert any("PTUN magic" in m and "record parser" in m
+                   for m in msgs)
+
+    def test_catches_capture_magic_twin_drift(self, tmp_path):
+        """gen_seeds.py's CAPTURE_MAGIC twin must track kCaptureMagic
+        in ptpu_capture.h (ISSUE 18) — same contract as the tune
+        cache."""
+        root = _fuzz_fixture(tmp_path)
+        _mutate(root, "csrc/fuzz/gen_seeds.py",
+                "CAPTURE_MAGIC = 0x50414350",
+                "CAPTURE_MAGIC = 0x50414351")
+        msgs = [f.message for f in _run(root, "fuzz")]
+        assert any("CAPTURE_MAGIC does not match kCaptureMagic" in m
+                   for m in msgs)
+
+    def test_catches_capture_valid_seed_removal(self, tmp_path):
+        """Dropping every PCAP-magic capture seed must fail the
+        coverage walk — the fuzzer would never reach the record
+        parser."""
+        root = _fuzz_fixture(tmp_path)
+        corpus = root / "csrc" / "fuzz" / "corpus" / "capture"
+        magic = (0x50414350).to_bytes(4, "little")
+        for f_ in corpus.iterdir():
+            if f_.read_bytes()[:4] == magic:
+                os.remove(f_)
+        msgs = [f.message for f in _run(root, "fuzz")]
+        assert any("PCAP magic" in m and "record parser" in m
                    for m in msgs)
 
 

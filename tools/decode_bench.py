@@ -58,6 +58,9 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from drill_replay import host_meta  # noqa: E402  (one fingerprint impl)
 
 RESULTS = []
 
@@ -1019,6 +1022,7 @@ def main():
                                   ("int4_", "autotune_", "tune_"))]
                 with open(args.int4_out, "w") as f:
                     json.dump({"bench": "int4_tune_bench",
+                               "host": host_meta(),
                                "config": vars(args),
                                "measurements": i4_metrics}, f,
                               indent=1)
@@ -1064,6 +1068,7 @@ def main():
     if args.out:
         with open(args.out, "w") as f:
             json.dump({"bench": "decode_bench",
+                       "host": host_meta(),
                        "config": vars(args),
                        "measurements": RESULTS}, f, indent=1)
         print(f"# persisted to {args.out}", flush=True)

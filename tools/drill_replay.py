@@ -1,0 +1,1033 @@
+#!/usr/bin/env python
+"""Production drill harness: capture replay + chaos soak (ptpu_drill).
+
+The capture half lives in C (csrc/ptpu_capture.h): a sampled raw-frame
+ring taps every framed request the serving/PS net core dispatches and
+persists "ptpu-capture v1" files (ptpu_capture_save) or serves the
+newest window over GET /capturez. This tool is the OTHER half of the
+drill loop:
+
+  fetch     GET /capturez from a live server -> capture file;
+  replay    re-fire a capture file against a (fresh) server at
+            1x..Nx original speed, preserving per-connection frame
+            ordering and the recorded inter-arrival shape, and report
+            the throughput knee plus p50/p99 latency. The replayed
+            per-op mix (tag + row-bucket histogram) must match the
+            original capture within REPLAY_MIX_TOL (5%) and the
+            server's `requests` delta must equal frames sent EXACTLY;
+  soak      loop a capture against a PTPU_CHAOS server, reconciling
+            the server's injected-fault counters against what this
+            client OBSERVED — exact equality, not "roughly right";
+  selfbench end-to-end evidence run (exports an MLP artifact, captures
+            live traffic, replays the capture at a speed sweep; with
+            --ab-rounds, adds the interleaved drills-off vs
+            baseline-.so overhead A/B) -> BENCH_DRILL_rNN.json;
+  selfsoak  end-to-end chaos drill (lossless kinds then lossy kinds)
+            against self-hosted servers — the run_checks.sh
+            DRILL_SOAK_SECS leg.
+
+Wire-format constants below are byte-for-byte twins of
+csrc/ptpu_capture.h (tools/ptpu_check.py cross-checks them):
+header [u32 magic][u32 version][u32 count][u32 body_bytes], record
+[i64 ts_us][u64 conn][u32 frame_len][u32 cap_len][u8 ver][u8 tag]
+[u16 reserved=0] + cap_len payload bytes. Parsing REJECTS the whole
+file on any violation (never-crash / full-reject, the tune-cache
+posture).
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import hmac as _hmac
+import json
+import os
+import socket
+import struct
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# --- csrc/ptpu_capture.h twins (checked by tools/ptpu_check.py) ----
+CAPTURE_MAGIC = 0x50414350          # "PCAP" little-endian
+CAPTURE_VERSION = 1
+CAPTURE_HEADER_BYTES = 16
+CAPTURE_REC_BYTES = 28              # fixed part, payload follows
+CAPTURE_MAX_REC_PAYLOAD = 4096
+CAPTURE_MAX_RECORDS = 65536
+
+REPLAY_MIX_TOL = 0.05               # 5% per-op mix tolerance
+
+_U32 = struct.Struct("<I")
+_HDR = struct.Struct("<IIII")
+_REC = struct.Struct("<qQIIBBH")
+
+
+class CaptureFormatError(ValueError):
+    """Malformed capture file — the WHOLE file is rejected."""
+
+
+# ------------------------------------------------ capture file twin
+def parse_capture_bytes(data: bytes) -> list:
+    """bytes -> [{ts_us, conn, frame_len, ver, tag, payload}].
+
+    Mirrors capture::ParseCaptureBytes exactly: same checks, same
+    order, whole-file reject (raise) on the first violation."""
+    if len(data) < CAPTURE_HEADER_BYTES:
+        raise CaptureFormatError("short header")
+    magic, version, count, body = _HDR.unpack_from(data, 0)
+    if magic != CAPTURE_MAGIC:
+        raise CaptureFormatError(f"bad magic {magic:#x}")
+    if version != CAPTURE_VERSION:
+        raise CaptureFormatError(f"bad version {version}")
+    if count > CAPTURE_MAX_RECORDS:
+        raise CaptureFormatError(f"count {count} over cap")
+    if len(data) != CAPTURE_HEADER_BYTES + body:
+        raise CaptureFormatError(
+            f"size {len(data)} != header + body_bytes {body}")
+    out = []
+    off = CAPTURE_HEADER_BYTES
+    end = CAPTURE_HEADER_BYTES + body
+    for _ in range(count):
+        if off + CAPTURE_REC_BYTES > end:
+            raise CaptureFormatError("truncated record")
+        ts, conn, flen, clen, ver, tag, rsv = _REC.unpack_from(
+            data, off)
+        off += CAPTURE_REC_BYTES
+        if clen > flen or clen > CAPTURE_MAX_REC_PAYLOAD:
+            raise CaptureFormatError(f"bad cap_len {clen}")
+        if rsv != 0:
+            raise CaptureFormatError("reserved != 0")
+        if off + clen > end:
+            raise CaptureFormatError("truncated payload")
+        payload = data[off:off + clen]
+        off += clen
+        # ver/tag mirror payload[0]/payload[1] (0 when absent)
+        if ver != (payload[0] if clen >= 1 else 0):
+            raise CaptureFormatError("ver != payload[0]")
+        if tag != (payload[1] if clen >= 2 else 0):
+            raise CaptureFormatError("tag != payload[1]")
+        out.append({"ts_us": ts, "conn": conn, "frame_len": flen,
+                    "ver": ver, "tag": tag, "payload": payload})
+    if off != end:
+        raise CaptureFormatError("trailing bytes after records")
+    return out
+
+
+def serialize_capture(records) -> bytes:
+    """Records -> capture-file bytes (capture::SerializeCapture twin;
+    count and per-record payload are capped, never rejected)."""
+    records = records[:CAPTURE_MAX_RECORDS]
+    body = bytearray()
+    for r in records:
+        payload = bytes(r["payload"])[:CAPTURE_MAX_REC_PAYLOAD]
+        flen = max(int(r.get("frame_len", len(payload))),
+                   len(payload))
+        ver = payload[0] if len(payload) >= 1 else 0
+        tag = payload[1] if len(payload) >= 2 else 0
+        body += _REC.pack(int(r["ts_us"]), int(r["conn"]), flen,
+                          len(payload), ver, tag, 0)
+        body += payload
+    return _HDR.pack(CAPTURE_MAGIC, CAPTURE_VERSION, len(records),
+                     len(body)) + bytes(body)
+
+
+def load_capture(path: str) -> list:
+    with open(path, "rb") as f:
+        return parse_capture_bytes(f.read())
+
+
+def save_capture(path: str, records) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(serialize_capture(records))
+    os.replace(tmp, path)
+
+
+# ------------------------------------------------------ /capturez
+def http_get(host: str, port: int, path: str,
+             timeout: float = 10.0) -> bytes:
+    with socket.create_connection((host, port),
+                                  timeout=timeout) as s:
+        s.sendall(f"GET {path} HTTP/1.1\r\nHost: x\r\n"
+                  f"Connection: close\r\n\r\n".encode())
+        buf = bytearray()
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    head, _, body = bytes(buf).partition(b"\r\n\r\n")
+    if b" 200 " not in head.split(b"\r\n", 1)[0]:
+        raise RuntimeError(
+            "HTTP error: " + head.split(b"\r\n", 1)[0].decode())
+    return body
+
+
+def fetch_capturez(host: str, port: int, n: int = 64) -> list:
+    """GET /capturez?n=N -> records (oldest first, replay order).
+
+    The route reports newest-first; this flips it so the result slots
+    straight into replay()/save_capture()."""
+    body = json.loads(http_get(host, port, f"/capturez?n={n}"))
+    recs = []
+    for f in reversed(body.get("frames", [])):
+        recs.append({"ts_us": int(f["ts_us"]),
+                     "conn": int(f["conn"]),
+                     "frame_len": int(f["len"]),
+                     "ver": int(f["ver"]), "tag": int(f["tag"]),
+                     "payload": bytes.fromhex(f["data"])})
+    return recs
+
+
+def fetch_shadowz(host: str, port: int) -> dict:
+    """GET /shadowz -> the serving plane's shadow-diff stats object
+    (enabled/sample/mismatched_batches/...). Soak and drill reports
+    fold this in so a perturbed shadow model shows up next to the
+    chaos counters."""
+    return json.loads(http_get(host, port, "/shadowz"))
+
+
+# ------------------------------------------------------- op mixing
+WIRE_VERSION = 1
+WIRE_VERSION_TRACED = 2
+TRACE_EXT = 8
+TAG_INFER_REQ = 0x60
+
+_TAG_NAMES = {0x60: "infer", 0x63: "meta", 0x65: "decode_open",
+              0x66: "decode_sess", 0x67: "decode_step",
+              0x69: "decode_close", 0x6a: "decode_open2",
+              0x6c: "decode_fork", 0x6d: "spec_open",
+              0x6e: "spec_step"}
+
+
+def frame_op_key(payload: bytes) -> str:
+    """Per-op mix key of one request frame: tag name, plus the
+    leading-dim row bucket for INFER (the per-op counter the batcher
+    actually keys on)."""
+    if len(payload) < 2:
+        return "short"
+    tag = payload[1]
+    name = _TAG_NAMES.get(tag, f"tag_{tag:#x}")
+    if tag != TAG_INFER_REQ:
+        return name
+    base = TRACE_EXT if payload[0] == WIRE_VERSION_TRACED else 0
+    # [ver][tag](+tid)[u64 rid][u16 n_in][u8 dt][u8 ndim][i64 dims..]
+    off = 2 + base + 8 + 2 + 2
+    if len(payload) < off + 8:
+        return name
+    rows = struct.unpack_from("<q", payload, off)[0]
+    return f"{name}[r{rows}]"
+
+
+def op_mix(records) -> dict:
+    mix: dict = {}
+    for r in records:
+        k = frame_op_key(r["payload"])
+        mix[k] = mix.get(k, 0) + 1
+    return mix
+
+
+def mix_matches(orig: dict, got: dict,
+                tol: float = REPLAY_MIX_TOL) -> tuple:
+    """-> (ok, worst_delta). Compares per-op SHARES: every op's share
+    of total traffic must agree within `tol` (absolute share delta —
+    an op that is 40% of the capture must be 35-45% of the replay)."""
+    to = max(1, sum(orig.values()))
+    tg = max(1, sum(got.values()))
+    worst = 0.0
+    for k in set(orig) | set(got):
+        d = abs(orig.get(k, 0) / to - got.get(k, 0) / tg)
+        worst = max(worst, d)
+    return worst <= tol, worst
+
+
+# ---------------------------------------------------- wire client
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("connection closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def dial_framed(host: str, port: int, authkey: bytes,
+                timeout: float = 30.0) -> socket.socket:
+    """Dial + HMAC handshake. Raises ConnectionError on a dropped
+    handshake (the PTPU_CHAOS hsdrop signature: EOF before the 0x01
+    ack)."""
+    s = socket.create_connection((host, port), timeout=timeout)
+    try:
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        nonce = _read_exact(s, 16)
+        mac = _hmac.new(authkey, nonce, hashlib.sha256).digest()
+        s.sendall(_U32.pack(len(mac)) + mac)
+        if _read_exact(s, 1) != b"\x01":
+            raise ConnectionError("handshake rejected")
+    except BaseException:
+        s.close()
+        raise
+    return s
+
+
+def _frame_rid(payload: bytes):
+    """Request/reply id of a framed serving op (None if too short)."""
+    if len(payload) < 10:
+        return None
+    base = TRACE_EXT if payload[0] == WIRE_VERSION_TRACED else 0
+    if len(payload) < 10 + base:
+        return None
+    return struct.unpack_from("<Q", payload, 2 + base)[0]
+
+
+class _ConnReplay:
+    """Replays ONE captured connection's frames in capture order at
+    `speed` x the recorded inter-arrival shape, reading replies on a
+    side thread and matching them to sends by request id."""
+
+    def __init__(self, recs, host, port, authkey, speed, t_base_us,
+                 barrier):
+        self.recs = recs
+        self.host, self.port, self.authkey = host, port, authkey
+        self.speed = speed
+        self.t_base_us = t_base_us
+        self.barrier = barrier   # all conns handshake, THEN fire
+        self.sent = 0
+        self.skipped = 0         # truncated in capture: not replayable
+        self.replies = 0
+        self.errors = 0          # transport death (chaos kill etc.)
+        self.t_first = None      # first send (after the barrier)
+        self.t_last = None       # last reply
+        self.lat_us: list = []
+        self.sent_keys: list = []
+        self._send_ts: dict = {}
+        self._lock = threading.Lock()
+        self._done_sending = threading.Event()
+
+    def _reader(self, sock):
+        try:
+            while True:
+                n = _U32.unpack(_read_exact(sock, 4))[0]
+                f = _read_exact(sock, n)
+                now = time.monotonic_ns() // 1000
+                rid = _frame_rid(f)
+                with self._lock:
+                    self.replies += 1
+                    self.t_last = time.monotonic()
+                    t0 = self._send_ts.pop(rid, None)
+                if t0 is not None:
+                    self.lat_us.append(now - t0)
+                with self._lock:
+                    if (self._done_sending.is_set()
+                            and not self._send_ts):
+                        return
+        except (ConnectionError, OSError):
+            pass
+
+    def run(self):
+        sock = None
+        try:
+            sock = dial_framed(self.host, self.port, self.authkey)
+        except (ConnectionError, OSError):
+            self.errors += 1
+        finally:
+            # setup time (dial + handshake) must not skew the rate
+            # measurement: every conn reaches the barrier, then all
+            # schedules start together
+            try:
+                self.barrier.wait(timeout=60.0)
+            except threading.BrokenBarrierError:
+                pass
+        if sock is None:
+            return
+        rd = threading.Thread(target=self._reader, args=(sock,),
+                              daemon=True)
+        rd.start()
+        start = time.monotonic()
+        self.t_first = start
+        try:
+            for r in self.recs:
+                if len(r["payload"]) < r["frame_len"]:
+                    self.skipped += 1   # capture truncated this one
+                    continue
+                due = start + (r["ts_us"] - self.t_base_us) / (
+                    1e6 * self.speed)
+                delay = due - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                rid = _frame_rid(r["payload"])
+                with self._lock:
+                    self._send_ts[rid] = time.monotonic_ns() // 1000
+                sock.sendall(_U32.pack(len(r["payload"]))
+                             + r["payload"])
+                self.sent += 1
+                self.sent_keys.append(frame_op_key(r["payload"]))
+        except (ConnectionError, OSError):
+            self.errors += 1
+        finally:
+            self._done_sending.set()
+            rd.join(timeout=30.0)
+            sock.close()
+
+
+def replay(records, host: str, port: int, authkey: bytes,
+           speed: float = 1.0) -> dict:
+    """Re-fire a capture at `speed` x. Per-connection ordering and the
+    recorded inter-arrival spacing are preserved (each captured conn
+    gets its own fresh connection + thread). -> report dict."""
+    if not records:
+        return {"speed": speed, "sent": 0, "replies": 0,
+                "skipped_truncated": 0, "conn_errors": 0,
+                "wall_s": 0.0, "offered_rps": 0.0,
+                "achieved_rps": 0.0, "p50_us": 0, "p99_us": 0,
+                "mix": {}}
+    t_base = min(r["ts_us"] for r in records)
+    span_s = (max(r["ts_us"] for r in records) - t_base) / 1e6
+    by_conn: dict = {}
+    for r in records:
+        by_conn.setdefault(r["conn"], []).append(r)
+    barrier = threading.Barrier(len(by_conn))
+    workers = [_ConnReplay(rs, host, port, authkey, speed, t_base,
+                           barrier)
+               for rs in by_conn.values()]
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=w.run) for w in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # rate window: first post-barrier send -> last reply (dial and
+    # handshake excluded, so tiny captures don't read as unsustained)
+    firsts = [w.t_first for w in workers if w.t_first is not None]
+    lasts = [w.t_last for w in workers if w.t_last is not None]
+    if firsts and lasts:
+        wall = max(max(lasts) - min(firsts), 1e-9)
+    else:
+        wall = max(time.monotonic() - t0, 1e-9)
+    lats = sorted(sum((w.lat_us for w in workers), []))
+
+    def pct(p):
+        return int(lats[min(len(lats) - 1,
+                            int(p * len(lats)))]) if lats else 0
+
+    sent = sum(w.sent for w in workers)
+    mix: dict = {}
+    for w in workers:
+        for k in w.sent_keys:
+            mix[k] = mix.get(k, 0) + 1
+    ideal_s = span_s / speed if speed > 0 else 0.0
+    return {"speed": speed, "sent": sent,
+            "replies": sum(w.replies for w in workers),
+            "skipped_truncated": sum(w.skipped for w in workers),
+            "conn_errors": sum(w.errors for w in workers),
+            "wall_s": round(wall, 6),
+            "offered_rps": round(sent / ideal_s, 2)
+            if ideal_s > 0 else float(sent),
+            "achieved_rps": round(sent / wall, 2),
+            "p50_us": pct(0.50), "p99_us": pct(0.99), "mix": mix}
+
+
+KNEE_FRAC = 0.9      # knee = last speed sustaining 90% of offered
+
+
+def sweep(records, host, port, authkey, speeds,
+          stats_fn=None) -> dict:
+    """Replay at each speed (ascending); -> {"rows", "knee_speed"}.
+
+    `stats_fn() -> dict` (the serving /statsz "server" object) makes
+    every round also assert server requests delta == frames sent."""
+    rows = []
+    knee = None
+    orig_mix = op_mix(records)
+    for sp in speeds:
+        before = stats_fn() if stats_fn else None
+        row = replay(records, host, port, authkey, speed=sp)
+        if row["replies"] != row["sent"]:
+            raise AssertionError(
+                f"{sp}x: {row['sent']} sent but {row['replies']} "
+                f"replies (conn_errors={row['conn_errors']})")
+        if stats_fn:
+            after = stats_fn()
+            d = after["requests"] - before["requests"]
+            if d != row["sent"]:
+                raise AssertionError(
+                    f"{sp}x: server requests delta {d} != "
+                    f"frames sent {row['sent']}")
+        ok, worst = mix_matches(orig_mix, row["mix"])
+        row["mix_worst_delta"] = round(worst, 4)
+        if not ok:
+            raise AssertionError(
+                f"{sp}x: replayed op mix off by {worst:.1%} "
+                f"(> {REPLAY_MIX_TOL:.0%}): orig={orig_mix} "
+                f"got={row['mix']}")
+        sustained = (row["offered_rps"] <= 0
+                     or row["achieved_rps"]
+                     >= KNEE_FRAC * row["offered_rps"])
+        row["sustained"] = bool(sustained)
+        rows.append(row)
+        if sustained:
+            knee = sp
+    return {"rows": rows, "knee_speed": knee,
+            "orig_mix": orig_mix}
+
+
+# ------------------------------------------------------ chaos soak
+class SoakTally:
+    """Client-observed chaos events — the reconciliation ledger."""
+
+    def __init__(self):
+        self.sent = 0
+        self.replies = 0
+        self.conn_deaths = 0        # EOF/reset AFTER the 0x01 ack
+        self.handshake_drops = 0    # EOF DURING the handshake
+        self.conns_opened = 0
+
+    def as_dict(self):
+        return dict(self.__dict__)
+
+
+def chaos_soak(records, host, port, authkey, secs,
+               speed: float = 8.0) -> SoakTally:
+    """Loop the capture against a PTPU_CHAOS server for `secs`,
+    reconnecting through injected conn kills and handshake drops and
+    tallying every client-observed event for reconciliation."""
+    tally = SoakTally()
+    lock = threading.Lock()
+    deadline = time.monotonic() + secs
+    frames = [bytes(r["payload"]) for r in records
+              if len(r["payload"]) >= r["frame_len"]]
+    if not frames:
+        raise ValueError("no complete frames to soak with")
+
+    def worker(wid):
+        i = wid      # stagger start offsets across workers
+        while time.monotonic() < deadline:
+            try:
+                sock = dial_framed(host, port, authkey, timeout=30.0)
+            except (ConnectionError, OSError):
+                with lock:
+                    tally.handshake_drops += 1
+                continue
+            with lock:
+                tally.conns_opened += 1
+            pending = 0
+            try:
+                sock.settimeout(30.0)
+                while time.monotonic() < deadline:
+                    f = frames[i % len(frames)]
+                    i += 1
+                    sock.sendall(_U32.pack(len(f)) + f)
+                    with lock:
+                        tally.sent += 1
+                    pending += 1
+                    # shallow pipeline: drain once 4 deep so kills
+                    # strand only a handful of in-flight replies
+                    while pending >= 4:
+                        n = _U32.unpack(_read_exact(sock, 4))[0]
+                        _read_exact(sock, n)
+                        with lock:
+                            tally.replies += 1
+                        pending -= 1
+                    if speed > 0:
+                        time.sleep(0.001 / speed)
+                while pending > 0:      # clean drain at deadline
+                    n = _U32.unpack(_read_exact(sock, 4))[0]
+                    _read_exact(sock, n)
+                    with lock:
+                        tally.replies += 1
+                    pending -= 1
+                sock.close()
+                return
+            except (ConnectionError, OSError):
+                with lock:
+                    tally.conn_deaths += 1
+                sock.close()
+
+    ts = [threading.Thread(target=worker, args=(w,))
+          for w in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return tally
+
+
+def reconcile_lossless(tally: SoakTally, before: dict,
+                       after: dict) -> None:
+    """Delay-style chaos (rdelay/wdelay/shortw) loses NOTHING: every
+    counter must reconcile exactly, client against server."""
+    d = {k: after[k] - before[k] for k in after}
+    errs = []
+    if tally.sent != tally.replies:
+        errs.append(f"client sent {tally.sent} != "
+                    f"replies {tally.replies}")
+    if d["requests"] != tally.sent:
+        errs.append(f"server requests {d['requests']} != "
+                    f"client sent {tally.sent}")
+    if d["replies"] != tally.replies:
+        errs.append(f"server replies {d['replies']} != "
+                    f"client replies {tally.replies}")
+    if d["req_errors"] != 0:
+        errs.append(f"req_errors {d['req_errors']} != 0")
+    if tally.conn_deaths or tally.handshake_drops:
+        errs.append("lossless kinds killed connections: "
+                    f"{tally.as_dict()}")
+    injected = (d["chaos_read_delays"] + d["chaos_write_delays"]
+                + d["chaos_short_writes"])
+    if injected == 0:
+        errs.append("no faults injected — chaos not armed?")
+    if errs:
+        raise AssertionError("lossless reconcile: " + "; ".join(errs))
+
+
+def reconcile_lossy(tally: SoakTally, before: dict,
+                    after: dict) -> None:
+    """kill/hsdrop chaos: dropped replies are expected, but every
+    injected fault must map 1:1 to a client-observed event and the
+    dispatch ledger must balance (no stuck sessions)."""
+    d = {k: after[k] - before[k] for k in after}
+    errs = []
+    # every dispatched request was answered (even if the reply then
+    # died with its killed conn) — the zero-stuck-sessions proof
+    if d["requests"] != d["replies"] + d["req_errors"]:
+        errs.append(
+            f"requests {d['requests']} != replies {d['replies']} + "
+            f"req_errors {d['req_errors']} — stuck requests")
+    if d["chaos_conn_kills"] != tally.conn_deaths:
+        errs.append(f"server kills {d['chaos_conn_kills']} != "
+                    f"client conn deaths {tally.conn_deaths}")
+    if d["chaos_handshake_drops"] != tally.handshake_drops:
+        errs.append(
+            f"server hsdrops {d['chaos_handshake_drops']} != client "
+            f"handshake drops {tally.handshake_drops}")
+    if d["handshake_fails"] != d["chaos_handshake_drops"]:
+        errs.append(f"handshake_fails {d['handshake_fails']} != "
+                    f"chaos drops {d['chaos_handshake_drops']}")
+    if d["chaos_conn_kills"] + d["chaos_handshake_drops"] == 0:
+        errs.append("no faults injected — chaos not armed?")
+    if tally.replies > d["replies"]:
+        errs.append(f"client saw {tally.replies} replies but server "
+                    f"only counted {d['replies']}")
+    if errs:
+        raise AssertionError("lossy reconcile: " + "; ".join(errs))
+
+
+def wait_conns_drained(stats_fn, timeout: float = 30.0) -> None:
+    """Poll until the server's conns_active gauge returns to 0 —
+    zero stuck sessions, the soak's exit condition."""
+    deadline = time.monotonic() + timeout
+    while True:
+        n = stats_fn()["conns_active"]
+        if n == 0:
+            return
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                f"{n} connections still active after {timeout}s")
+        time.sleep(0.05)
+
+
+# ----------------------------------------------- self-hosted drills
+def host_meta() -> dict:
+    """Host fingerprint persisted into every drill/bench JSON (twin
+    of the serving_bench/decode_bench "host" row)."""
+    sig = hashlib.sha256()
+    try:
+        with open("/proc/cpuinfo", "rb") as f:
+            for ln in f:
+                if ln.startswith((b"model name", b"flags")):
+                    sig.update(ln)
+    except OSError:
+        sig.update(b"unknown")
+    return {"nproc": os.cpu_count() or 1,
+            "cpu_sig": sig.hexdigest()[:16]}
+
+
+def _export_mlp(tmpdir: str) -> str:
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.onnx.converter import trace_to_onnx
+
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(32, 64), pt.nn.ReLU(),
+                           pt.nn.Linear(64, 8))
+    net.eval()
+    x = np.zeros((4, 32), np.float32)
+    path = os.path.join(tmpdir, "mlp.onnx")
+    with open(path, "wb") as f:
+        f.write(trace_to_onnx(lambda a: net(a), (jnp.asarray(x),)))
+    return path
+
+
+def _infer_frame(rid: int, rows: int, cols: int = 32,
+                 seed: int = 0) -> bytes:
+    """A raw v1 INFER frame: one float32 [rows, cols] input."""
+    import numpy as np
+    x = np.random.RandomState(seed).randn(rows, cols) \
+        .astype(np.float32)
+    return (bytes([WIRE_VERSION, TAG_INFER_REQ])
+            + struct.pack("<QH", rid, 1)
+            + bytes([1, 2])                       # f32, ndim 2
+            + struct.pack("<qq", rows, cols) + x.tobytes())
+
+
+def _live_traffic(host, port, authkey, n_conns=4, ops=60):
+    """Original traffic for the capture phase: n_conns connections,
+    each a pipelined mixed-row INFER stream (rows 1/2/4 in a 3:2:1
+    mix — the per-op mix replay must reproduce)."""
+    row_plan = [1, 1, 1, 2, 2, 4]
+
+    def one(cid):
+        sock = dial_framed(host, port, authkey)
+        try:
+            pending = 0
+            for k in range(ops):
+                rows = row_plan[k % len(row_plan)]
+                f = _infer_frame(k, rows, seed=cid * 997 + k)
+                sock.sendall(_U32.pack(len(f)) + f)
+                pending += 1
+                if pending >= 4:
+                    n = _U32.unpack(_read_exact(sock, 4))[0]
+                    _read_exact(sock, n)
+                    pending -= 1
+                time.sleep(0.002)   # shaped inter-arrival to replay
+            while pending:
+                n = _U32.unpack(_read_exact(sock, 4))[0]
+                _read_exact(sock, n)
+                pending -= 1
+        finally:
+            sock.close()
+
+    ts = [threading.Thread(target=one, args=(c,))
+          for c in range(n_conns)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+
+def _capture_lib():
+    from paddle_tpu.core.native import _predictor_lib
+    lib = _predictor_lib()
+    if not getattr(lib, "_ptpu_has_capture", False):
+        raise RuntimeError("stale _native_predictor.so: no capture "
+                           "ABI — delete it and re-import")
+    return lib
+
+
+# ------------------------------------------ drills-off overhead A/B
+def ab_leg(ops: int):
+    """One measured leg in THIS process (the parent routed the native
+    load via PTPU_PREDICTOR_SO and stripped every drill knob, so
+    capture/chaos/shadow are fully OFF on both sides). Closed-loop
+    pipelined INFERs; prints one `DRILLEG {json}` line."""
+    import tempfile
+    import numpy as np
+    from paddle_tpu.inference import create_server
+
+    tmpdir = tempfile.mkdtemp(prefix="ptpu_drill_ab_")
+    model = _export_mlp(tmpdir)
+    x = np.random.RandomState(0).randn(4, 32).astype(np.float32)
+    with create_server(model, max_batch=4, deadline_us=1500,
+                       instances=2) as srv:
+        cli = srv.client()
+        cli.infer_many([[x]] * 64)          # warm: plans every bucket
+        st0 = srv.stats()["server"]
+        t0 = time.perf_counter()
+        cli.infer_many([[x]] * ops)
+        dt = time.perf_counter() - t0
+        st1 = srv.stats()["server"]
+        out = {"ops_per_s": round(ops / dt, 1),
+               "exact": bool(
+                   st1["requests"] - st0["requests"] == ops and
+                   st1["replies"] - st0["replies"] == ops and
+                   st1["req_errors"] == st0["req_errors"])}
+        cli.close()
+    print("DRILLEG " + json.dumps(out), flush=True)
+
+
+def _ab_spawn_leg(so_pred, ops):
+    import subprocess
+    env = dict(os.environ)
+    for k in list(env):
+        if k.startswith(("PTPU_CAPTURE_", "PTPU_SHADOW_")) or \
+                k in ("PTPU_CHAOS", "PTPU_CHAOS_DELAY_US",
+                      "PTPU_PREDICTOR_SO"):
+            env.pop(k)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO + os.pathsep +
+                              env.get("PYTHONPATH", "")})
+    if so_pred:
+        env["PTPU_PREDICTOR_SO"] = so_pred
+    r = subprocess.run([sys.executable, os.path.abspath(__file__),
+                        "ab-leg", "--ops", str(ops)], cwd=REPO,
+                       env=env, capture_output=True, text=True,
+                       timeout=900)
+    if r.returncode != 0:
+        raise RuntimeError(f"ab leg failed (so={so_pred}):\n"
+                           f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
+    for line in reversed(r.stdout.splitlines()):
+        if line.startswith("DRILLEG "):
+            return json.loads(line[len("DRILLEG "):])
+    raise RuntimeError("ab leg printed no DRILLEG row:\n"
+                       + r.stdout[-2000:])
+
+
+def _ab_build_baseline(ref: str):
+    """Build the baseline predictor .so (a tree WITHOUT the drill
+    code, e.g. the pre-drill commit) from a git ref in a detached
+    worktree. Returns (so_path, worktree_path)."""
+    import subprocess
+    import tempfile
+    tree = os.path.join(tempfile.mkdtemp(prefix="ptpu_drill_base_"),
+                        "tree")
+    subprocess.run(["git", "worktree", "add", "--detach", tree, ref],
+                   cwd=REPO, check=True, capture_output=True)
+    subprocess.run(["make", "-j4", "all"],
+                   cwd=os.path.join(tree, "csrc"), check=True,
+                   capture_output=True, timeout=1800)
+    return (os.path.join(tree, "paddle_tpu",
+                         "_native_predictor.so"), tree)
+
+
+def off_overhead_ab(rounds=10, ops=600, baseline_so=None,
+                    baseline_ref="HEAD"):
+    """Drills-compiled-in-but-OFF vs a baseline .so built without the
+    drill code (the r10 trace-bench methodology): leg order alternates
+    per round to cancel machine drift, medians summarize. Gate: the
+    off-mode server within 3% of the baseline's ops/s."""
+    import subprocess
+    tree = None
+    if baseline_so is None:
+        print(f"ab: building baseline .so from {baseline_ref} ...",
+              flush=True)
+        baseline_so, tree = _ab_build_baseline(baseline_ref)
+        base_id = baseline_ref
+    else:
+        base_id = baseline_so
+
+    def med(xs):
+        return sorted(xs)[len(xs) // 2]
+
+    try:
+        base, off = [], []
+        for rnd in range(rounds):
+            legs = [("base", baseline_so), ("off", None)]
+            if rnd % 2:
+                legs.reverse()
+            for name, so in legs:
+                row = _ab_spawn_leg(so, ops)
+                (base if name == "base" else off).append(row)
+                print(f"ab round {rnd} {name}: {row}", flush=True)
+        mb = med([r["ops_per_s"] for r in base])
+        mo = med([r["ops_per_s"] for r in off])
+        overhead = round((mb - mo) / mb * 100.0, 2)
+        return {"baseline": base_id, "rounds": rounds, "ops": ops,
+                "base": [r["ops_per_s"] for r in base],
+                "off": [r["ops_per_s"] for r in off],
+                "base_ops_per_s": mb, "off_ops_per_s": mo,
+                "overhead_pct": overhead,
+                "within_3pct": bool(overhead <= 3.0),
+                "acceptance_max_pct": 3.0,
+                "exact": bool(all(r["exact"] for r in base + off))}
+    finally:
+        if tree:
+            subprocess.run(["git", "worktree", "remove", "--force",
+                            tree], cwd=REPO, capture_output=True)
+
+
+def selfbench(out_path, speeds=(1, 2, 4, 8), n_conns=4, ops=60,
+              ab_rounds=0, ab_ops=600, ab_baseline_so=None,
+              ab_baseline_ref="HEAD"):
+    """End-to-end drill evidence: capture live traffic on server A,
+    replay the saved capture against fresh server B at a speed sweep.
+    Writes the knee + p50/p99 report to `out_path`."""
+    import tempfile
+    from paddle_tpu.inference import create_server
+
+    os.environ["PTPU_CAPTURE_SAMPLE"] = "1"
+    os.environ["PTPU_CAPTURE_BYTES"] = "4096"
+    os.environ["PTPU_CAPTURE_RING"] = "16384"
+    tmpdir = tempfile.mkdtemp(prefix="ptpu_drill_")
+    model = _export_mlp(tmpdir)
+    lib = _capture_lib()
+    cap_file = os.path.join(tmpdir, "drill.cap")
+
+    with create_server(model, max_batch=4, deadline_us=1500,
+                       instances=2) as srv:
+        _live_traffic("127.0.0.1", srv.port, srv.authkey,
+                      n_conns=n_conns, ops=ops)
+        n = lib.ptpu_capture_save(cap_file.encode())
+        if n <= 0:
+            raise RuntimeError(f"ptpu_capture_save -> {n}")
+    lib.ptpu_capture_set(0)     # replay servers must not re-capture
+
+    records = load_capture(cap_file)
+    print(f"captured {len(records)} frames "
+          f"({len({r['conn'] for r in records})} conns), "
+          f"mix={op_mix(records)}", flush=True)
+
+    with create_server(model, max_batch=4, deadline_us=1500,
+                       instances=2) as srv:
+        report = sweep(records, "127.0.0.1", srv.port, srv.authkey,
+                       list(speeds),
+                       stats_fn=lambda: srv.stats()["server"])
+    doc = {"bench": "ptpu_drill", "host": host_meta(),
+           "captured_frames": len(records),
+           "capture_conns": len({r["conn"] for r in records}),
+           "knee_frac": KNEE_FRAC,
+           "mix_tol": REPLAY_MIX_TOL, **report}
+    if ab_rounds:
+        doc["off_overhead_ab"] = off_overhead_ab(
+            rounds=ab_rounds, ops=ab_ops, baseline_so=ab_baseline_so,
+            baseline_ref=ab_baseline_ref)
+        print(f"off_overhead_ab: {doc['off_overhead_ab']}",
+              flush=True)
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"knee_speed={report['knee_speed']}x -> {out_path}",
+          flush=True)
+    return doc
+
+
+def selfsoak(secs: float):
+    """run_checks.sh DRILL_SOAK_SECS leg: two chaos phases (lossless,
+    then lossy) against self-hosted servers, each ending in EXACT
+    counter reconciliation and a drained-connections check."""
+    import tempfile
+    from paddle_tpu.inference import create_server
+
+    os.environ["PTPU_CAPTURE_SAMPLE"] = "1"
+    os.environ["PTPU_CAPTURE_BYTES"] = "4096"
+    tmpdir = tempfile.mkdtemp(prefix="ptpu_soak_")
+    model = _export_mlp(tmpdir)
+    lib = _capture_lib()
+
+    # seed capture: a short clean run so the soak has frames to loop
+    with create_server(model, max_batch=4, instances=2) as srv:
+        _live_traffic("127.0.0.1", srv.port, srv.authkey,
+                      n_conns=2, ops=20)
+        cap_file = os.path.join(tmpdir, "soak.cap")
+        if lib.ptpu_capture_save(cap_file.encode()) <= 0:
+            raise RuntimeError("capture_save failed")
+    lib.ptpu_capture_set(0)
+    records = load_capture(cap_file)
+    half = max(secs / 2.0, 1.0)
+
+    phases = [("lossless", "rdelay,wdelay,shortw:17",
+               reconcile_lossless),
+              ("lossy", "kill,hsdrop:53", reconcile_lossy)]
+    for name, chaos, check in phases:
+        os.environ["PTPU_CHAOS"] = chaos
+        os.environ["PTPU_CHAOS_DELAY_US"] = "500"
+        try:
+            with create_server(model, max_batch=4,
+                               instances=2) as srv:
+                stats = lambda: srv.stats()["server"]  # noqa: E731
+                before = stats()
+                tally = chaos_soak(records, "127.0.0.1", srv.port,
+                                   srv.authkey, half)
+                wait_conns_drained(stats)
+                check(tally, before, stats())
+                print(f"soak[{name}] chaos={chaos}: "
+                      f"{tally.as_dict()} reconciled exactly",
+                      flush=True)
+        finally:
+            os.environ.pop("PTPU_CHAOS", None)
+            os.environ.pop("PTPU_CHAOS_DELAY_US", None)
+    print("selfsoak: OK", flush=True)
+
+
+# ------------------------------------------------------------- CLI
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("fetch", help="GET /capturez -> capture file")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--n", type=int, default=64)
+    p.add_argument("--out", required=True)
+
+    p = sub.add_parser("replay", help="re-fire a capture file")
+    p.add_argument("--file", required=True)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--authkey-hex", required=True)
+    p.add_argument("--speeds", default="1,2,4,8")
+    p.add_argument("--out", default=None)
+
+    p = sub.add_parser("soak", help="chaos soak against a live "
+                                    "PTPU_CHAOS server")
+    p.add_argument("--file", required=True)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--authkey-hex", required=True)
+    p.add_argument("--secs", type=float, default=10.0)
+
+    p = sub.add_parser("selfbench",
+                       help="self-hosted capture->replay evidence")
+    p.add_argument("--out", default="BENCH_DRILL_r01.json")
+    p.add_argument("--speeds", default="1,2,4,8")
+    p.add_argument("--ops", type=int, default=60)
+    p.add_argument("--ab-rounds", type=int, default=0,
+                   help="interleaved drills-off overhead A/B rounds "
+                        "(0 = skip)")
+    p.add_argument("--ab-ops", type=int, default=600)
+    p.add_argument("--ab-baseline-so", default=None,
+                   help="baseline _native_predictor.so (default: "
+                        "build --ab-baseline-ref in a worktree)")
+    p.add_argument("--ab-baseline-ref", default="HEAD",
+                   help="git ref of the drill-free baseline tree")
+
+    p = sub.add_parser("ab-leg",
+                       help="(internal) one off-overhead A/B leg")
+    p.add_argument("--ops", type=int, default=600)
+
+    p = sub.add_parser("selfsoak",
+                       help="self-hosted two-phase chaos drill")
+    p.add_argument("--secs", type=float, default=10.0)
+
+    a = ap.parse_args(argv)
+    if a.cmd == "fetch":
+        recs = fetch_capturez(a.host, a.port, a.n)
+        save_capture(a.out, recs)
+        print(f"{len(recs)} frames -> {a.out}")
+    elif a.cmd == "replay":
+        recs = load_capture(a.file)
+        rep = sweep(recs, a.host, a.port,
+                    bytes.fromhex(a.authkey_hex),
+                    [float(s) for s in a.speeds.split(",")])
+        txt = json.dumps(rep, indent=1, sort_keys=True)
+        if a.out:
+            with open(a.out, "w") as f:
+                f.write(txt + "\n")
+        print(txt)
+    elif a.cmd == "soak":
+        recs = load_capture(a.file)
+        tally = chaos_soak(recs, a.host, a.port,
+                           bytes.fromhex(a.authkey_hex), a.secs)
+        print(json.dumps(tally.as_dict()))
+    elif a.cmd == "selfbench":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        selfbench(a.out,
+                  speeds=[float(s) for s in a.speeds.split(",")],
+                  ops=a.ops, ab_rounds=a.ab_rounds, ab_ops=a.ab_ops,
+                  ab_baseline_so=a.ab_baseline_so,
+                  ab_baseline_ref=a.ab_baseline_ref)
+    elif a.cmd == "ab-leg":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        ab_leg(a.ops)
+    elif a.cmd == "selfsoak":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        selfsoak(a.secs)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -657,6 +657,59 @@ def check_wire(root: str) -> List[Finding]:
                              "DECODE_SPEC_REP token body at payload "
                              "offset 26 + base not found (layout "
                              "probe)"))
+
+    # ---- capture-file format (ISSUE 18). Drill capture files are a
+    # two-sided wire: csrc/ptpu_capture.h writes+parses them in C,
+    # tools/drill_replay.py re-parses them for replay (and writes them
+    # back via `fetch`). The six layout constants must match, and the
+    # Python struct formats must pack to exactly the C byte counts —
+    # otherwise a capture taken on one side is rejected (or worse,
+    # mis-framed) by the other.
+    cap_rel, dr_rel = "csrc/ptpu_capture.h", "tools/drill_replay.py"
+    cap = _require(root, cap_rel, "wire", f)
+    dr = _require(root, dr_rel, "wire", f)
+    if cap is not None and dr is not None:
+        clean = strip_c_comments(cap)
+        dr_consts = py_int_constants(dr, dr_rel, "wire", f)
+        c_vals: Dict[str, int] = {}
+        for cn, pn in (("kCaptureMagic", "CAPTURE_MAGIC"),
+                       ("kCaptureVersion", "CAPTURE_VERSION"),
+                       ("kCaptureHeaderBytes", "CAPTURE_HEADER_BYTES"),
+                       ("kCaptureRecBytes", "CAPTURE_REC_BYTES"),
+                       ("kCaptureMaxRecPayload",
+                        "CAPTURE_MAX_REC_PAYLOAD"),
+                       ("kCaptureMaxRecords", "CAPTURE_MAX_RECORDS")):
+            m = re.search(rf"\b{cn}\s*=\s*(0x[0-9a-fA-F]+|\d+)", clean)
+            if m is None:
+                f.append(Finding("wire", cap_rel, 0,
+                                 f"{cn} not found (capture layout "
+                                 f"probe)"))
+                continue
+            c_vals[cn] = int(m.group(1), 0)
+            if pn not in dr_consts:
+                f.append(Finding("wire", dr_rel, 0,
+                                 f"{pn} not found (capture layout "
+                                 f"probe)"))
+            elif dr_consts[pn] != c_vals[cn]:
+                f.append(Finding(
+                    "wire", cap_rel, _lineno(clean, m.start()),
+                    f"{cn} = {c_vals[cn]} in C but {pn} = "
+                    f"{dr_consts[pn]} in drill_replay.py — capture "
+                    f"files written by one side would be rejected by "
+                    f"the other"))
+        for var, want_key in (("_HDR", "kCaptureHeaderBytes"),
+                              ("_REC", "kCaptureRecBytes")):
+            size = _py_struct_size(dr, var)
+            if size is None:
+                f.append(Finding("wire", dr_rel, 0,
+                                 f"{var} struct definition not found "
+                                 f"(capture layout probe)"))
+            elif want_key in c_vals and size != c_vals[want_key]:
+                f.append(Finding(
+                    "wire", dr_rel, 0,
+                    f"{var} packs to {size} bytes but {want_key} = "
+                    f"{c_vals[want_key]} in ptpu_capture.h — capture "
+                    f"record layout drift"))
     return f
 
 
@@ -697,7 +750,14 @@ PS_SERVER_C_ONLY = {"handshake_fails", "conns_accepted", "conns_active",
                     # event-thread CPU time per plane (ISSUE 17): a
                     # CLOCK_THREAD_CPUTIME_ID aggregate only the native
                     # server can measure
-                    "cpu_us"}
+                    "cpu_us",
+                    # injected-fault counters (PTPU_CHAOS drills):
+                    # fault injection lives in the epoll net core
+                    # only — the Python fallback loop has no chaos
+                    # mode to count
+                    "chaos_conn_kills", "chaos_read_delays",
+                    "chaos_write_delays", "chaos_short_writes",
+                    "chaos_handshake_drops"}
 
 
 def check_stats(root: str) -> List[Finding]:
@@ -1201,6 +1261,30 @@ def check_trace(root: str) -> List[Finding]:
                          0,
                          "trace_id_of must read the id at payload "
                          "offset 2 (layout probe)"))
+
+    # 4) drill telemetry route twins (ISSUE 18): each observability
+    #    route the drill harness depends on must be SERVED by its C
+    #    plane and CONSUMED by tools/drill_replay.py — a renamed or
+    #    dropped route on either side breaks capture fetch / shadow
+    #    reporting silently, so both halves are pinned here.
+    dr_rel = "tools/drill_replay.py"
+    dr = _require(root, dr_rel, "trace", f)
+    for route, c_rel in (("/capturez", "csrc/ptpu_net.cc"),
+                         ("/shadowz", "csrc/ptpu_serving.cc")):
+        c_src = _require(root, c_rel, "trace", f)
+        if c_src is not None and \
+                f'"{route}"' not in strip_c_comments(
+                    c_src, keep_strings=True):
+            f.append(Finding(
+                "trace", c_rel, 0,
+                f"route {route} is not served (no \"{route}\" "
+                f"literal) — the drill harness consumes it "
+                f"(tools/drill_replay.py)"))
+        if dr is not None and f'"{route}' not in dr:
+            f.append(Finding(
+                "trace", dr_rel, 0,
+                f"no consumer for route {route} — drill_replay.py "
+                f"must fetch it (route twin)"))
     return f
 
 
@@ -1320,6 +1404,7 @@ FUZZ_TARGET_SOURCES = {
     "json": "csrc/ptpu_trace.cc",
     "frames": "csrc/ptpu_net.cc",
     "tune": "csrc/ptpu_tune.h",
+    "capture": "csrc/ptpu_capture.h",
 }
 
 
@@ -1463,6 +1548,48 @@ def check_fuzz(root: str) -> List[Finding]:
                         "TUNE_MAGIC does not match kTuneMagic in "
                         "csrc/ptpu_tune.h — regenerated seeds would "
                         "miss the parser"))
+
+    # 6) capture files (ISSUE 18): same two-sided seeding contract as
+    #    the tune cache — the corpus must reach the record parser
+    #    (PCAP magic) AND the alien-bytes reject path, and the seed
+    #    generator's twin magic must track the header's
+    cap_rel = "csrc/ptpu_capture.h"
+    cap_hdr = _require(root, cap_rel, "fuzz", f)
+    if cap_hdr is not None:
+        clean = strip_c_comments(cap_hdr)
+        m = re.search(r"\bkCaptureMagic\s*=\s*0x([0-9a-fA-F]+)", clean)
+        if m is None:
+            f.append(Finding(
+                "fuzz", cap_rel, 0,
+                "kCaptureMagic literal not found — the fuzz checker "
+                "keys the capture corpus on it"))
+        else:
+            magic = int(m.group(1), 16)
+            magic_le = magic.to_bytes(4, "little")
+            blobs = _corpus_blobs(root, "capture")
+            if not any(b[:4] == magic_le for b in blobs):
+                f.append(Finding(
+                    "fuzz", "csrc/fuzz/corpus/capture", 0,
+                    "no capture corpus seed starts with the PCAP "
+                    "magic — the fuzzer never starts inside the "
+                    "record parser (regen via gen_seeds.py)"))
+            if not any(len(b) >= 4 and b[:4] != magic_le
+                       for b in blobs):
+                f.append(Finding(
+                    "fuzz", "csrc/fuzz/corpus/capture", 0,
+                    "no capture corpus seed with a non-PCAP magic — "
+                    "the alien-file reject path is unseeded "
+                    "(gen_seeds.py)"))
+            gen = _require(root, "csrc/fuzz/gen_seeds.py", "fuzz", f)
+            if gen is not None:
+                gm = re.search(r"\bCAPTURE_MAGIC\s*=\s*0x([0-9a-fA-F]+)",
+                               gen)
+                if gm is None or int(gm.group(1), 16) != magic:
+                    f.append(Finding(
+                        "fuzz", "csrc/fuzz/gen_seeds.py", 0,
+                        "CAPTURE_MAGIC does not match kCaptureMagic "
+                        "in csrc/ptpu_capture.h — regenerated seeds "
+                        "would miss the parser"))
     return f
 
 

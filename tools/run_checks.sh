@@ -3,7 +3,7 @@
 # against — reference: the upstream tools/ check scripts chained in CI).
 #
 #   build            the three shipping .so artifacts (-Werror on)
-#   sancheck         all six C selftests + the pure-C demo under
+#   sancheck         all seven C selftests + the pure-C demo under
 #                    ASan+UBSan, fail-fast; TSan leg when libtsan
 #                    exists — selftests run LOCKDEP-enabled (the
 #                    ranked-mutex validator, csrc/ptpu_sync.h) in
@@ -83,12 +83,24 @@ make -C csrc -j"$JOBS" fuzz
 
 FUZZ_SMOKE_SECS="${FUZZ_SMOKE_SECS:-5}"
 step "fuzz smoke: corpus replay + ${FUZZ_SMOKE_SECS}s run per target"
-for t in wire_ps wire_serving http onnx json frames tune; do
+for t in wire_ps wire_serving http onnx json frames tune capture; do
   echo "-- fuzz_${t}: corpus replay"
   (cd csrc/fuzz && "./fuzz_${t}.fuzz" "corpus/${t}")
   echo "-- fuzz_${t}: ${FUZZ_SMOKE_SECS}s coverage-guided run"
   (cd csrc/fuzz && "./fuzz_${t}.fuzz" "-fuzz=${FUZZ_SMOKE_SECS}" \
       -seed=1 "-artifact=crash-${t}-" "corpus/${t}")
 done
+
+# Opt-in chaos soak (production drills, ISSUE 18): DRILL_SOAK_SECS=N
+# runs the two-phase selfsoak — lossless chaos (read/write delays,
+# short writes), then lossy (conn kills, handshake drops) — each
+# ending in EXACT server==client counter reconciliation and a
+# drained-connections check. Off by default: it needs the Python
+# serving stack, not just the csrc toolchain.
+if [[ -n "${DRILL_SOAK_SECS:-}" ]]; then
+  step "drill soak: ${DRILL_SOAK_SECS}s two-phase chaos reconciliation"
+  JAX_PLATFORMS=cpu python3 tools/drill_replay.py selfsoak \
+      --secs "$DRILL_SOAK_SECS"
+fi
 
 printf '\nrun_checks: ALL GREEN\n'

@@ -36,6 +36,9 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from drill_replay import host_meta  # noqa: E402  (one fingerprint impl)
 
 NCLIENTS = int(os.environ.get("PTPU_SRVBENCH_CLIENTS", 8))
 OPS = int(os.environ.get("PTPU_SRVBENCH_OPS", 300))
@@ -346,6 +349,7 @@ def run_trace_ab(out_path):
     if out_path:
         with open(out_path, "w") as f:
             json.dump({"bench": "serving_bench --trace",
+                       "host": host_meta(),
                        "clients": NCLIENTS, "ops": OPS,
                        "max_batch": MAX_BATCH,
                        "deadline_us": DEADLINE_US,
@@ -648,6 +652,7 @@ def run_cpr_ab(out_path):
     if out_path:
         with open(out_path, "w") as f:
             json.dump({"bench": "serving_bench --cpr",
+                       "host": host_meta(),
                        "clients": NCLIENTS, "ops": OPS,
                        "max_batch": MAX_BATCH,
                        "deadline_us": DEADLINE_US,
@@ -754,6 +759,7 @@ def main():
     if out_path:
         with open(out_path, "w") as f:
             json.dump({"bench": "serving_bench", "clients": NCLIENTS,
+                       "host": host_meta(),
                        "ops": OPS, "max_batch": MAX_BATCH,
                        "deadline_us": DEADLINE_US,
                        "instances": INSTANCES,
