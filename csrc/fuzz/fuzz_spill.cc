@@ -1,0 +1,96 @@
+// Fuzz target: the KV spill-tier parsers — ptpu::spill's
+// ParseSpillHeader / ParseHibBytes / ParsePrefixBytes in
+// csrc/ptpu_spill.h (ISSUE 19). All three read UNTRUSTED DISK INPUT:
+// the spill-file header is re-read on every attach, hibernation
+// records round-trip through callers that may persist them, and the
+// prefix-persist file warms the adopt index across restarts — so the
+// parsers get the same r11 treatment as wire frames and the tune
+// cache: bounds-checked, fuzzed, whole-file reject on any malformed
+// byte, never a crash.
+//
+// Harness shape: the same bytes feed all three parsers (their magics
+// disambiguate). Well-formed inputs additionally round-trip through
+// the matching Serialize* and must re-parse identically —
+// canonicalization bugs abort here, not as a silently rewritten file
+// in production. The prefix parser needs a geometry to validate
+// against; it is derived from the input's own header words (capped),
+// so mutations can both match and mismatch the pinned geometry.
+//
+// Corpus: csrc/fuzz/corpus/spill (valid files of each flavour,
+// truncations, huge counts, bit flips, wrong versions —
+// csrc/fuzz/gen_seeds.py). Build: `make fuzz`.
+#include "../ptpu_spill.h"
+
+#include <cassert>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  if (size > (1u << 20)) return 0;
+  namespace sp = ptpu::spill;
+  // 1) spill-file header: parse + canonical round trip
+  {
+    sp::SpillGeom g;
+    if (sp::ParseSpillHeader(data, size, &g) == sp::ParseResult::kOk) {
+      assert(sp::GeomValid(g));
+      uint8_t buf[sp::kSpillHeaderBytes];
+      sp::SerializeSpillHeader(g, buf);
+      sp::SpillGeom again;
+      assert(sp::ParseSpillHeader(buf, sizeof(buf), &again) ==
+             sp::ParseResult::kOk);
+      assert(again.page == g.page && again.layers == g.layers &&
+             again.heads == g.heads && again.hdim == g.hdim &&
+             again.slot_bytes == g.slot_bytes);
+    }
+  }
+  // 2) hibernation record: parse + canonical round trip
+  {
+    sp::HibRecord rec;
+    if (sp::ParseHibBytes(data, size, &rec) == sp::ParseResult::kOk) {
+      std::vector<uint8_t> bytes;
+      sp::SerializeHib(rec, &bytes);
+      assert(bytes.size() == size);
+      sp::HibRecord again;
+      assert(sp::ParseHibBytes(bytes.data(), bytes.size(), &again) ==
+             sp::ParseResult::kOk);
+      assert(again.hib_id == rec.hib_id && again.len == rec.len &&
+             again.groups.size() == rec.groups.size());
+      for (size_t i = 0; i < rec.groups.size(); ++i) {
+        assert(again.groups[i].kind == rec.groups[i].kind &&
+               again.groups[i].a == rec.groups[i].a &&
+               again.groups[i].b == rec.groups[i].b);
+      }
+    }
+  }
+  // 3) prefix-persist file: the caller pins the pool geometry, so
+  // derive it from the input's own header words — valid seeds parse
+  // kOk against their embedded geometry while any mutation of those
+  // words exercises the geometry-mismatch rejects too. Caps keep a
+  // hostile header from allocating GeomElems-sized scratch.
+  if (size >= sp::kPrefixHeaderBytes) {
+    const auto clamp = [](uint32_t v, uint32_t cap) {
+      return (v >= 1 && v <= cap) ? v : (v % cap) + 1;
+    };
+    sp::SpillGeom g;
+    g.page = clamp(ptpu::GetU32(data + 8), 8);
+    g.layers = clamp(ptpu::GetU32(data + 12), 4);
+    g.heads = clamp(ptpu::GetU32(data + 16), 4);
+    g.hdim = clamp(ptpu::GetU32(data + 20), 8);
+    g.slot_bytes = uint64_t(g.layers) * 2 * g.page * g.heads * g.hdim *
+                   sizeof(float);
+    std::vector<sp::PrefixRec> recs;
+    if (sp::ParsePrefixBytes(data, size, g, &recs) ==
+        sp::ParseResult::kOk) {
+      std::vector<uint8_t> bytes;
+      sp::SerializePrefix(recs, g, &bytes);
+      assert(bytes.size() == size);
+      assert(std::memcmp(bytes.data(), data, size) == 0);
+      std::vector<sp::PrefixRec> again;
+      assert(sp::ParsePrefixBytes(bytes.data(), bytes.size(), g,
+                                  &again) == sp::ParseResult::kOk);
+      assert(again.size() == recs.size());
+    }
+  }
+  return 0;
+}

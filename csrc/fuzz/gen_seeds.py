@@ -252,6 +252,62 @@ def capture_file(recs, magic=CAPTURE_MAGIC, version=1, count=None,
     return struct.pack("<IIII", magic, version, n, b) + blob
 
 
+# ---------------------------------------------------------------------------
+# KV spill-tier files (twin of ptpu_spill.h: "PSPL" spill-file header,
+# "PHIB" hibernation records, "PPFX" prefix-persist files — the r19
+# tiering formats; tools/ptpu_check.py pins these magics to the C
+# constants and csrc/fuzz/fuzz_spill.cc fuzzes all three parsers)
+# ---------------------------------------------------------------------------
+
+SPILL_MAGIC = 0x4C505350   # "PSPL" little-endian
+HIB_MAGIC = 0x42494850     # "PHIB" little-endian
+PREFIX_MAGIC = 0x58465050  # "PPFX" little-endian
+
+
+def spill_header(page=2, layers=1, heads=2, hdim=4, slot_bytes=None,
+                 magic=SPILL_MAGIC, version=1):
+    sb = (layers * 2 * page * heads * hdim * 4 if slot_bytes is None
+          else slot_bytes)
+    return struct.pack("<IIIIIIQ", magic, version, page, layers, heads,
+                       hdim, sb) + b"\x00" * 4  # 8 spare bytes (32 total)
+
+
+def hib_group(kind=1, a=0, b=0):
+    return struct.pack("<IIqQ", kind, 0, a, b)
+
+
+def hib_rec(groups, hib_id=1, length=32, magic=HIB_MAGIC, version=1,
+            count=None, reserved=0):
+    n = len(groups) if count is None else count
+    return struct.pack("<IIQQII", magic, version, hib_id, length, n,
+                       reserved) + b"".join(groups)
+
+
+def fnv1a(data):
+    h = 0xCBF29CE484222325
+    for c in data:
+        h = ((h ^ c) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def prefix_rec(page, layers, heads, hdim, parent=0xFFFFFFFF, toks=None,
+               val=1.0, checksum=None, ntoks=None):
+    elems = layers * 2 * page * heads * hdim
+    t = list(range(1, page + 1)) if toks is None else toks
+    body = struct.pack("<II", parent, page if ntoks is None else ntoks)
+    body += struct.pack(f"<{page}q", *t)
+    body += struct.pack(f"<{elems}f", *([val] * elems))
+    ck = fnv1a(body) if checksum is None else checksum
+    return body + struct.pack("<Q", ck)
+
+
+def prefix_file(recs, page=2, layers=1, heads=2, hdim=4,
+                magic=PREFIX_MAGIC, version=1, count=None, reserved=0):
+    n = len(recs) if count is None else count
+    return struct.pack("<IIIIIIII", magic, version, page, layers, heads,
+                       hdim, n, reserved) + b"".join(recs)
+
+
 def main():
     # ---- wire_ps ----
     w("wire_ps", "seed-pull-v1.bin", ps_pull())
@@ -521,6 +577,63 @@ def main():
       capture_file([capture_rec(reserved=1)]))
     w("capture", "seed-cap-over-max.bin",
       capture_file([capture_rec(payload=b"\x01\x60" + b"z" * 4095)]))
+
+    # ---- spill (r19 KV tiering: spill header + hibernation records +
+    # prefix-persist files; one corpus for all three parsers — the
+    # magics disambiguate inside fuzz_spill.cc) ----
+    w("spill", "seed-spill-valid.bin", spill_header())
+    w("spill", "seed-spill-trunc.bin", spill_header()[:17])
+    w("spill", "seed-spill-bad-magic.bin",
+      spill_header(magic=0x4C505351))
+    w("spill", "seed-spill-bad-version.bin", spill_header(version=9))
+    w("spill", "seed-spill-geom-lies.bin",
+      spill_header(slot_bytes=12345))         # != layers*2*P*H*D*4
+    w("spill", "seed-spill-geom-zero.bin", spill_header(page=0))
+    w("spill", "seed-spill-geom-over-cap.bin",
+      spill_header(page=1 << 20, slot_bytes=1))
+    w("spill", "seed-hib-valid.bin", hib_rec([
+        hib_group(kind=1, a=0),               # spilled slot 0
+        hib_group(kind=0, a=3, b=7),          # shared gid 3 gen 7
+        hib_group(kind=1, a=2),
+    ]))
+    w("spill", "seed-hib-empty.bin", hib_rec([], length=0))
+    w("spill", "seed-hib-trunc-header.bin", hib_rec([])[:13])
+    w("spill", "seed-hib-trunc-record.bin",
+      hib_rec([hib_group(), hib_group(a=1)])[:-9])
+    w("spill", "seed-hib-padded.bin", hib_rec([hib_group()]) + b"\x00")
+    w("spill", "seed-hib-huge-count.bin",
+      hib_rec([hib_group()], count=0xFFFFFFFF))
+    w("spill", "seed-hib-count-over-cap.bin",
+      hib_rec([hib_group()], count=(1 << 20) + 1))
+    w("spill", "seed-hib-bad-magic.bin",
+      hib_rec([hib_group()], magic=0x42494851))
+    w("spill", "seed-hib-bad-version.bin",
+      hib_rec([hib_group()], version=9))
+    w("spill", "seed-hib-bad-kind.bin", hib_rec([hib_group(kind=2)]))
+    w("spill", "seed-hib-neg-slot.bin", hib_rec([hib_group(a=-1)]))
+    w("spill", "seed-hib-spilled-gen.bin",
+      hib_rec([hib_group(kind=1, a=0, b=5)]))  # kind 1 must carry b=0
+    w("spill", "seed-hib-reserved-set.bin",
+      hib_rec([hib_group()], reserved=1))
+    w("spill", "seed-prefix-valid.bin", prefix_file([
+        prefix_rec(2, 1, 2, 4),                        # root page
+        prefix_rec(2, 1, 2, 4, parent=0, toks=[9, 10], val=2.0),
+    ]))
+    w("spill", "seed-prefix-empty.bin", prefix_file([]))
+    w("spill", "seed-prefix-trunc.bin",
+      prefix_file([prefix_rec(2, 1, 2, 4)])[:-3])
+    w("spill", "seed-prefix-bad-magic.bin",
+      prefix_file([prefix_rec(2, 1, 2, 4)], magic=0x58465051))
+    w("spill", "seed-prefix-bad-version.bin",
+      prefix_file([prefix_rec(2, 1, 2, 4)], version=9))
+    w("spill", "seed-prefix-huge-count.bin",
+      prefix_file([prefix_rec(2, 1, 2, 4)], count=0xFFFFFFFF))
+    w("spill", "seed-prefix-forward-parent.bin",
+      prefix_file([prefix_rec(2, 1, 2, 4, parent=1)]))  # self/forward
+    w("spill", "seed-prefix-bit-flip.bin",
+      prefix_file([prefix_rec(2, 1, 2, 4, checksum=0xDEAD)]))
+    w("spill", "seed-prefix-ntoks-lies.bin",
+      prefix_file([prefix_rec(2, 1, 2, 4, ntoks=3)]))
 
     print("gen_seeds: corpora written under", os.path.join(HERE, "corpus"))
     return 0

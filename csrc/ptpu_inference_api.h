@@ -191,6 +191,33 @@ int ptpu_kvpool_publish(PTPU_KvPool*, int sid, const int64_t* tokens,
 int ptpu_kvpool_trim(PTPU_KvPool*, int sid, int64_t new_len);
 const char* ptpu_kvpool_stats_json(PTPU_KvPool*);
 
+/* KV tiering + session hibernation (r19). spill_attach binds an
+ * mmap'd disk tier of page-group slabs (max_bytes < 0 resolves from
+ * $PTPU_KV_SPILL_MAX_BYTES, default 1 GiB). hibernate serializes an
+ * idle session out of the pool — cold groups spill, shared groups
+ * stay with the record holding their ref, the session slot frees —
+ * via a two-call protocol: returns the record size; executes only
+ * when `cap` holds it. restore re-materializes (returns sid; -1 =
+ * session table full, retry after freeing; -2 + err = failure, with
+ * "kv pool exhausted" soft-retryable exactly like decode).
+ * hibernate_drop discards a record (hibernated session closed).
+ * prefix_save/prefix_load persist the content-addressed adopt index
+ * across restarts (load recomputes every chain hash from the token
+ * ids — a warmed cache can only miss, never serve wrong KV). */
+int ptpu_kvpool_spill_attach(PTPU_KvPool*, const char* path,
+                             int64_t max_bytes, char* err, int err_len);
+int64_t ptpu_kvpool_hibernate(PTPU_KvPool*, int sid, uint8_t* buf,
+                              int64_t cap, char* err, int err_len);
+int ptpu_kvpool_restore(PTPU_KvPool*, const uint8_t* data, int64_t size,
+                        char* err, int err_len);
+void ptpu_kvpool_hibernate_drop(PTPU_KvPool*, const uint8_t* data,
+                                int64_t size);
+int64_t ptpu_kvpool_hibernated(PTPU_KvPool*);
+int64_t ptpu_kvpool_prefix_save(PTPU_KvPool*, const char* path,
+                                char* err, int err_len);
+int64_t ptpu_kvpool_prefix_load(PTPU_KvPool*, const char* path,
+                                char* err, int err_len);
+
 /* Serving stats since load (always-on): JSON {"runs","total_run_us",
  * "run_us":{count,sum,buckets[32] log2-us},"ops":{op:{calls,time_us,
  * bytes}}}. Pointer valid until the next stats_json call on this

@@ -38,6 +38,7 @@
 
 #include "ptpu_arena.h"
 #include "ptpu_schedck.h"
+#include "ptpu_spill.h"
 #include "ptpu_stats.h"
 #include "ptpu_sync.h"
 #include "ptpu_topo.h"
@@ -2464,6 +2465,16 @@ class KvPool {
            sess_[size_t(sid)].open;
   }
 
+  // allocated page groups (may exceed ceil(len/page) transiently
+  // after a failed step) — sizes the hibernation record exactly
+  int64_t table_groups(int sid) const {
+    ptpu::MutexLock l(mu_);
+    if (sid < 0 || sid >= int(sess_.size()) ||
+        !sess_[size_t(sid)].open)
+      return -1;
+    return int64_t(sess_[size_t(sid)].table.size());
+  }
+
   /* Make positions `len .. len+count-1` writable for `sid`: allocate
    * fresh tail groups at page boundaries, and COW the current tail if
    * it is shared (fork divergence, or a trim back into an adopted
@@ -2649,6 +2660,358 @@ class KvPool {
     }
   }
 
+  // ---- KV tiering + session hibernation (r19) -----------------------
+
+  /* Attach the disk tier. Geometry must already be fixed (a decode
+   * artifact attached): the spill slot size IS the page-group slab
+   * size. max_bytes==0 means unbounded. */
+  void spill_attach(const std::string& path, uint64_t max_bytes) {
+    ptpu::MutexLock l(mu_);
+    if (layers_ == 0)
+      throw std::runtime_error(
+          "kvpool: spill_attach before a decode artifact fixed the "
+          "geometry");
+    std::string err;
+    if (!spill_.Attach(path, max_bytes, geom_locked(), &err))
+      throw std::runtime_error("kvpool: " + err);
+  }
+
+  bool spill_on() const { return spill_.attached(); }
+
+  /* Serialize `sid` out of the pool. Sole-owner groups (ref==1 —
+   * necessarily unpublished, since published pages always carry the
+   * cache's own ref) spill to disk slots and their pages free;
+   * shared groups (fork siblings, adopted prefix pages) stay
+   * resident with THIS session's ref transferred into the record.
+   * The session slot itself frees — hibernated sessions do not count
+   * against max_sessions, which is exactly how far more
+   * conversations than session slots stay open at bounded RSS.
+   * Throws the soft retryable "kv spill exhausted" error on the byte
+   * cap with every spill slot taken so far rolled back: the pool is
+   * untouched on failure. */
+  std::vector<uint8_t> hibernate(int sid, int64_t cap, int64_t* need) {
+    ptpu::MutexLock l(mu_);
+    Sess& s = sess_at(sid);
+    if (!spill_.attached())
+      throw std::runtime_error("kvpool: spill tier is not attached");
+    // size query and execute decide under ONE lock hold, so the
+    // caller's buffer can never be outgrown between the two
+    *need = int64_t(ptpu::spill::kHibHeaderBytes +
+                    s.table.size() * ptpu::spill::kHibRecordBytes);
+    if (cap < *need) return {};
+    ptpu::spill::HibRecord rec;
+    rec.hib_id = next_hib_id_;
+    rec.len = uint64_t(s.len);
+    rec.groups.resize(s.table.size());
+    // pass 1: classify + take spill slots — rollbackable, no pool
+    // mutation until every write landed
+    for (size_t k = 0; k < s.table.size(); ++k) {
+      const int32_t gid = s.table[k];
+      auto& hg = rec.groups[k];
+      if (groups_[size_t(gid)].ref == 1) {
+        const int64_t slot = spill_.Alloc();
+        if (slot < 0 ||
+            !spill_.Write(slot,
+                          &pool_[size_t(gid) * size_t(group_elems_)],
+                          size_t(group_elems_))) {
+          if (slot >= 0) spill_.Free(slot);
+          for (size_t j = 0; j < k; ++j)
+            if (rec.groups[j].kind == ptpu::spill::kHibKindSpilled)
+              spill_.Free(rec.groups[j].a);
+          ++spill_exhausted_;
+          throw std::runtime_error(
+              "kv spill exhausted (raise PTPU_KV_SPILL_MAX_BYTES or "
+              "close sessions)");
+        }
+        hg.kind = ptpu::spill::kHibKindSpilled;
+        hg.a = slot;
+        hg.b = 0;
+      } else {
+        hg.kind = ptpu::spill::kHibKindShared;
+        hg.a = gid;
+        hg.b = groups_[size_t(gid)].gen;
+      }
+    }
+    // pass 2: commit — spilled pages free, shared refs transfer into
+    // the record, the session slot opens up
+    PTPU_SCHED_POINT();  // hibernate-vs-evict ordering
+    for (size_t k = 0; k < s.table.size(); ++k)
+      if (rec.groups[k].kind == ptpu::spill::kHibKindSpilled)
+        unref(s.table[k]);
+    s.open = false;
+    s.len = 0;
+    s.table.clear();
+    ++next_hib_id_;
+    ++hibernates_;
+    std::vector<uint8_t> out;
+    ptpu::spill::SerializeHib(rec, &out);
+    hib_.emplace(rec.hib_id, std::move(rec));
+    return out;
+  }
+
+  /* Re-materialize a hibernated session. The bytes are a handle, not
+   * a capability: every field is cross-validated against the
+   * RAM-side registry entry, and any mismatch rejects WITHOUT
+   * touching the pool. Returns the new sid, or -1 when every session
+   * slot is taken (the open() contract — caller frees one and
+   * retries). Pool exhaustion mid-restore rolls back the freshly
+   * allocated pages, KEEPS the record + spill slots intact, and
+   * rethrows the soft "kv pool exhausted" error. */
+  int restore(const uint8_t* data, size_t size) {
+    ptpu::MutexLock l(mu_);
+    ptpu::spill::HibRecord rec;
+    if (ptpu::spill::ParseHibBytes(data, size, &rec) !=
+        ptpu::spill::ParseResult::kOk) {
+      ++hib_rejects_;
+      throw std::runtime_error("kvpool: hibernation record corrupt");
+    }
+    auto it = hib_.find(rec.hib_id);
+    bool match = it != hib_.end() && it->second.len == rec.len &&
+                 it->second.groups.size() == rec.groups.size();
+    for (size_t k = 0; match && k < rec.groups.size(); ++k)
+      match = it->second.groups[k].kind == rec.groups[k].kind &&
+              it->second.groups[k].a == rec.groups[k].a &&
+              it->second.groups[k].b == rec.groups[k].b;
+    if (!match) {
+      ++hib_rejects_;
+      throw std::runtime_error("kvpool: hibernation record corrupt");
+    }
+    int sid = -1;
+    for (int s2 = 0; s2 < int(sess_.size()); ++s2)
+      if (!sess_[size_t(s2)].open) {
+        sid = s2;
+        break;
+      }
+    if (sid < 0) return -1;
+    // pass 1: pages for the spilled groups (rollbackable)
+    std::vector<int32_t> table(rec.groups.size(), -1);
+    for (size_t k = 0; k < rec.groups.size(); ++k) {
+      const auto& hg = rec.groups[k];
+      if (hg.kind == ptpu::spill::kHibKindShared) {
+        // the record holds a ref, so the group cannot have been
+        // freed/reused — the gen must still match
+        if (hg.a >= int64_t(groups_.size()) ||
+            groups_[size_t(hg.a)].gen != hg.b) {
+          ++hib_rejects_;
+          throw std::runtime_error(
+              "kvpool: hibernation record corrupt");
+        }
+        table[k] = int32_t(hg.a);
+      } else {
+        try {
+          table[k] = alloc_group();
+        } catch (...) {
+          for (size_t j = 0; j < k; ++j)
+            if (rec.groups[j].kind == ptpu::spill::kHibKindSpilled &&
+                table[j] >= 0)
+              unref(table[j]);
+          throw;
+        }
+      }
+    }
+    // pass 2: payloads back from disk, then the slots free
+    for (size_t k = 0; k < rec.groups.size(); ++k)
+      if (rec.groups[k].kind == ptpu::spill::kHibKindSpilled &&
+          !spill_.Read(rec.groups[k].a,
+                       &pool_[size_t(table[k]) * size_t(group_elems_)],
+                       size_t(group_elems_))) {
+        for (size_t j = 0; j < rec.groups.size(); ++j)
+          if (rec.groups[j].kind == ptpu::spill::kHibKindSpilled &&
+              table[j] >= 0)
+            unref(table[j]);
+        ++hib_rejects_;
+        throw std::runtime_error("kvpool: hibernation record corrupt");
+      }
+    PTPU_SCHED_POINT();  // restore-vs-close ordering
+    for (size_t k = 0; k < rec.groups.size(); ++k)
+      if (rec.groups[k].kind == ptpu::spill::kHibKindSpilled)
+        spill_.Free(rec.groups[k].a);
+    Sess& s = sess_[size_t(sid)];
+    s.open = true;
+    s.len = int64_t(rec.len);
+    s.table.assign(table.begin(), table.end());
+    hib_.erase(it);
+    ++restores_;
+    return sid;
+  }
+
+  /* Discard a hibernation record without restoring — the hibernated
+   * session was closed. Spill slots free, shared refs drop. Invalid
+   * or unknown bytes are counted and ignored (close is never an
+   * error path). */
+  void hibernate_drop(const uint8_t* data, size_t size) {
+    ptpu::MutexLock l(mu_);
+    ptpu::spill::HibRecord rec;
+    if (ptpu::spill::ParseHibBytes(data, size, &rec) !=
+        ptpu::spill::ParseResult::kOk) {
+      ++hib_rejects_;
+      return;
+    }
+    auto it = hib_.find(rec.hib_id);
+    if (it == hib_.end()) {
+      ++hib_rejects_;
+      return;
+    }
+    // act on the REGISTRY copy, never the caller's bytes
+    for (const auto& hg : it->second.groups) {
+      if (hg.kind == ptpu::spill::kHibKindSpilled)
+        spill_.Free(hg.a);
+      else
+        unref(int32_t(hg.a));
+    }
+    hib_.erase(it);
+    ++hib_drops_;
+  }
+
+  int64_t hibernated() const {
+    ptpu::MutexLock l(mu_);
+    return int64_t(hib_.size());
+  }
+
+  /* Persist the content-addressed adopt index (parent-before-child,
+   * tmp+rename). Returns records written, -1 on I/O failure. */
+  int64_t prefix_save(const std::string& path) {
+    ptpu::MutexLock l(mu_);
+    if (layers_ == 0 || !prefix_on_) return 0;
+    const ptpu::spill::SpillGeom g = geom_locked();
+    if (!ptpu::spill::GeomValid(g)) return 0;
+    // roots first, then children whose parent is already emitted —
+    // the cache is a forest, so passes converge within chain depth
+    std::vector<int32_t> pending;
+    for (const auto& kv : prefix_) pending.push_back(kv.second);
+    std::vector<ptpu::spill::PrefixRec> recs;
+    std::unordered_map<int32_t, uint32_t> idx;
+    bool progress = true;
+    while (progress) {
+      progress = false;
+      std::vector<int32_t> next;
+      for (const int32_t gid : pending) {
+        const Group& gr = groups_[size_t(gid)];
+        uint32_t parent = ptpu::spill::kPrefixRootParent;
+        if (gr.parent_gid >= 0) {
+          auto pit = idx.find(gr.parent_gid);
+          // a child only persists under a LIVE emitted parent (gen
+          // match rules out ABA reuse of the parent's gid)
+          if (pit == idx.end() ||
+              groups_[size_t(gr.parent_gid)].gen != gr.parent_gen) {
+            next.push_back(gid);
+            continue;
+          }
+          parent = pit->second;
+        }
+        if (recs.size() >= ptpu::spill::kPrefixMaxRecords) continue;
+        ptpu::spill::PrefixRec r;
+        r.parent = parent;
+        r.toks = gr.toks;
+        r.vals.assign(
+            &pool_[size_t(gid) * size_t(group_elems_)],
+            &pool_[size_t(gid) * size_t(group_elems_)] + group_elems_);
+        idx.emplace(gid, uint32_t(recs.size()));
+        recs.push_back(std::move(r));
+        progress = true;
+      }
+      pending.swap(next);
+    }
+    std::vector<uint8_t> bytes;
+    ptpu::spill::SerializePrefix(recs, g, &bytes);
+    const std::string tmp =
+        path + ".tmp." + std::to_string(uint64_t(::getpid()));
+    std::FILE* f = std::fopen(tmp.c_str(), "wb");
+    if (f == nullptr) return -1;
+    const bool ok =
+        std::fwrite(bytes.data(), 1, bytes.size(), f) == bytes.size();
+    if (std::fclose(f) != 0 || !ok ||
+        std::rename(tmp.c_str(), path.c_str()) != 0) {
+      std::remove(tmp.c_str());
+      return -1;
+    }
+    prefix_saved_ += recs.size();
+    return int64_t(recs.size());
+  }
+
+  /* Warm the adopt index from a persisted file. A missing file is a
+   * cold start (0); ANY malformed byte rejects the whole file
+   * (counted). The chain hash is recomputed FROM THE TOKEN IDS —
+   * never read from disk — and parent linkage is rebuilt against the
+   * freshly allocated groups, so a warmed cache can only miss, never
+   * serve wrong KV. Loading stops silently at pool exhaustion: a
+   * partial warm cache is still just a cache. */
+  int64_t prefix_load(const std::string& path) {
+    ptpu::MutexLock l(mu_);
+    if (layers_ == 0 || !prefix_on_) return 0;
+    const ptpu::spill::SpillGeom g = geom_locked();
+    if (!ptpu::spill::GeomValid(g)) return 0;
+    std::FILE* f = std::fopen(path.c_str(), "rb");
+    if (f == nullptr) return 0;
+    // bounded read: cap + 1 sentinel so an oversized file fails the
+    // exact-size check instead of growing the buffer without limit
+    const uint64_t cap =
+        ptpu::spill::kPrefixHeaderBytes +
+        uint64_t(ptpu::spill::kPrefixMaxRecords) *
+            ptpu::spill::PrefixRecordBytes(g) +
+        1;
+    std::vector<uint8_t> bytes;
+    uint8_t buf[4096];
+    size_t got;
+    while ((got = std::fread(buf, 1, sizeof buf, f)) > 0) {
+      bytes.insert(bytes.end(), buf, buf + got);
+      if (uint64_t(bytes.size()) > cap) break;
+    }
+    std::fclose(f);
+    std::vector<ptpu::spill::PrefixRec> recs;
+    if (uint64_t(bytes.size()) > cap ||
+        ptpu::spill::ParsePrefixBytes(bytes.data(), bytes.size(), g,
+                                      &recs) !=
+            ptpu::spill::ParseResult::kOk) {
+      ++prefix_persist_rejects_;
+      return 0;
+    }
+    std::vector<int32_t> gid_of(recs.size(), -1);
+    std::vector<uint64_t> hash_of(recs.size(), 0);
+    int64_t loaded = 0;
+    for (size_t i = 0; i < recs.size(); ++i) {
+      const auto& r = recs[i];
+      int32_t parent_gid = -1;
+      uint64_t h = kChainSeed;
+      if (r.parent != ptpu::spill::kPrefixRootParent) {
+        parent_gid = gid_of[r.parent];
+        // parent skipped, or evicted again by alloc pressure during
+        // this very load -> the child cannot link, skip it
+        if (parent_gid < 0 ||
+            !groups_[size_t(parent_gid)].published ||
+            groups_[size_t(parent_gid)].hash != hash_of[r.parent])
+          continue;
+        h = hash_of[r.parent];
+      }
+      h = page_hash(h, r.toks.data(), page_);
+      if (prefix_.count(h)) continue;  // already warm
+      int32_t gid;
+      try {
+        gid = alloc_group();
+      } catch (...) {
+        break;  // pool full: stop, keep what warmed
+      }
+      std::memcpy(&pool_[size_t(gid) * size_t(group_elems_)],
+                  r.vals.data(),
+                  size_t(group_elems_) * sizeof(float));
+      Group& gr = groups_[size_t(gid)];
+      gr.published = true;
+      gr.hash = h;
+      gr.toks = r.toks;
+      gr.parent_gid = parent_gid;
+      gr.parent_gen =
+          parent_gid >= 0 ? groups_[size_t(parent_gid)].gen : 0;
+      gr.lru = ++tick_;
+      // gr.ref stays 1 from alloc_group — that IS the cache ref
+      prefix_[h] = gid;
+      gid_of[i] = gid;
+      hash_of[i] = h;
+      ++loaded;
+    }
+    prefix_loaded_ += uint64_t(loaded);
+    return loaded;
+  }
+
   std::string stats_json() {
     ptpu::MutexLock l(mu_);
     int64_t cached = 0, live_sess = 0;
@@ -2688,6 +3051,42 @@ class KvPool {
     ptpu::AppendJsonU64(&out, "trims", trims_);
     out += ",";
     ptpu::AppendJsonU64(&out, "pool_exhausted", exhausted_);
+    out += ",";
+    ptpu::AppendJsonU64(&out, "sessions_hibernated",
+                        uint64_t(hib_.size()));
+    out += ",";
+    ptpu::AppendJsonU64(&out, "hibernates", hibernates_);
+    out += ",";
+    ptpu::AppendJsonU64(&out, "restores", restores_);
+    out += ",";
+    ptpu::AppendJsonU64(&out, "hib_drops", hib_drops_);
+    out += ",";
+    ptpu::AppendJsonU64(&out, "hib_rejects", hib_rejects_);
+    out += ",";
+    ptpu::AppendJsonU64(&out, "spill_exhausted", spill_exhausted_);
+    const ptpu::spill::SpillFile::Stats sp = spill_.Snapshot();
+    out += ",";
+    ptpu::AppendJsonU64(&out, "spill_attached", sp.attached ? 1 : 0);
+    out += ",";
+    ptpu::AppendJsonU64(&out, "spill_slots_total", sp.slots_total);
+    out += ",";
+    ptpu::AppendJsonU64(&out, "spill_slots_in_use", sp.slots_in_use);
+    out += ",";
+    ptpu::AppendJsonU64(&out, "spill_bytes", sp.bytes_mapped);
+    out += ",";
+    ptpu::AppendJsonU64(&out, "spill_writes", sp.writes);
+    out += ",";
+    ptpu::AppendJsonU64(&out, "spill_reads", sp.reads);
+    out += ",";
+    ptpu::AppendJsonU64(&out, "spill_header_rejects",
+                        sp.header_rejects);
+    out += ",";
+    ptpu::AppendJsonU64(&out, "prefix_persist_saved", prefix_saved_);
+    out += ",";
+    ptpu::AppendJsonU64(&out, "prefix_persist_loaded", prefix_loaded_);
+    out += ",";
+    ptpu::AppendJsonU64(&out, "prefix_persist_rejects",
+                        prefix_persist_rejects_);
     out += "}";
     return out;
   }
@@ -2801,6 +3200,23 @@ class KvPool {
   uint64_t trims_ = 0;
   uint64_t prefix_hits_ = 0, prefix_hit_tokens_ = 0, published_ = 0;
   uint64_t prefix_evictions_ = 0, exhausted_ = 0;
+  // ---- KV tiering (r19) ----
+  ptpu::spill::SpillGeom geom_locked() const {
+    ptpu::spill::SpillGeom g;
+    g.page = uint32_t(page_);
+    g.layers = uint32_t(layers_);
+    g.heads = uint32_t(heads_);
+    g.hdim = uint32_t(hdim_);
+    g.slot_bytes = uint64_t(group_elems_) * sizeof(float);
+    return g;
+  }
+  ptpu::spill::SpillFile spill_;
+  std::unordered_map<uint64_t, ptpu::spill::HibRecord> hib_;
+  uint64_t next_hib_id_ = 1;
+  uint64_t hibernates_ = 0, restores_ = 0, hib_drops_ = 0;
+  uint64_t hib_rejects_ = 0, spill_exhausted_ = 0;
+  uint64_t prefix_saved_ = 0, prefix_loaded_ = 0;
+  uint64_t prefix_persist_rejects_ = 0;
   mutable ptpu::Mutex mu_{kLockKvPool};
 };
 
@@ -7687,6 +8103,122 @@ const char* ptpu_kvpool_stats_json(PTPU_KvPool* h) {
   auto* p = (KvPool*)h;
   p->stats_json_ = p->stats_json();
   return p->stats_json_.c_str();
+}
+
+// ---- KV tiering + session hibernation (ISSUE 19) --------------------
+/* Attach the mmap'd spill tier at `path` (created 0600 if missing; a
+ * malformed pre-existing file is rejected + counted, never scribbled
+ * over). Arguments <= 0 resolve from the environment: max_bytes
+ * ($PTPU_KV_SPILL_MAX_BYTES, default 1 GiB; 0 stays 0 = unbounded
+ * only when passed explicitly). Requires an attached decode artifact
+ * (the slot size is the page-group slab size). */
+__attribute__((visibility("default")))
+int ptpu_kvpool_spill_attach(PTPU_KvPool* h, const char* path,
+                             int64_t max_bytes, char* err,
+                             int err_len) {
+  try {
+    if (!h || !path || !*path)
+      throw std::runtime_error("spill_attach: null handle or path");
+    if (max_bytes < 0) {
+      const char* e = std::getenv("PTPU_KV_SPILL_MAX_BYTES");
+      max_bytes = e ? std::atoll(e) : 0;
+      if (max_bytes <= 0) max_bytes = int64_t(1) << 30;
+    }
+    ((KvPool*)h)->spill_attach(path, uint64_t(max_bytes));
+    return 0;
+  } catch (const std::exception& e) {
+    fill_error(err, err_len, e.what());
+    return 1;
+  }
+}
+
+/* Hibernate session `sid`: serialize it out of the pool (cold groups
+ * spill to disk, the session slot frees). Two-call protocol: returns
+ * the record size in bytes; the hibernation EXECUTES only when `cap`
+ * holds it (query with cap=0 first, then call again with a buffer).
+ * Returns -1 with `err` filled on failure — "kv spill exhausted" is
+ * the soft retryable case, mirroring "kv pool exhausted". */
+__attribute__((visibility("default")))
+int64_t ptpu_kvpool_hibernate(PTPU_KvPool* h, int sid, uint8_t* buf,
+                              int64_t cap, char* err, int err_len) {
+  try {
+    if (!h) throw std::runtime_error("hibernate: null handle");
+    auto* p = (KvPool*)h;
+    int64_t need = 0;
+    const std::vector<uint8_t> rec =
+        p->hibernate(sid, buf == nullptr ? -1 : cap, &need);
+    if (rec.empty()) return need;  // query mode / cap too small
+    std::memcpy(buf, rec.data(), rec.size());
+    return int64_t(rec.size());
+  } catch (const std::exception& e) {
+    fill_error(err, err_len, e.what());
+    return -1;
+  }
+}
+
+/* Restore a hibernated session from its record bytes. Returns the
+ * new sid, -1 when every session slot is taken (free one and retry —
+ * the open() contract, no error), or -2 with `err` filled ("kv pool
+ * exhausted" is the soft retryable case; "hibernation record
+ * corrupt" is terminal for these bytes). */
+__attribute__((visibility("default")))
+int ptpu_kvpool_restore(PTPU_KvPool* h, const uint8_t* data,
+                        int64_t size, char* err, int err_len) {
+  try {
+    if (!h || !data || size < 1)
+      throw std::runtime_error("restore: null handle or buffer");
+    return ((KvPool*)h)->restore(data, size_t(size));
+  } catch (const std::exception& e) {
+    fill_error(err, err_len, e.what());
+    return -2;
+  }
+}
+
+// discard a hibernation record without restoring (the hibernated
+// session was closed) — frees its spill slots and shared-group refs
+__attribute__((visibility("default")))
+void ptpu_kvpool_hibernate_drop(PTPU_KvPool* h, const uint8_t* data,
+                                int64_t size) {
+  if (!h || !data || size < 1) return;
+  ((KvPool*)h)->hibernate_drop(data, size_t(size));
+}
+
+// sessions currently hibernated (the RAM-side registry size)
+__attribute__((visibility("default")))
+int64_t ptpu_kvpool_hibernated(PTPU_KvPool* h) {
+  if (!h) return 0;
+  return ((KvPool*)h)->hibernated();
+}
+
+// persist the content-addressed adopt index (tmp+rename). Returns
+// records written, -1 on I/O failure.
+__attribute__((visibility("default")))
+int64_t ptpu_kvpool_prefix_save(PTPU_KvPool* h, const char* path,
+                                char* err, int err_len) {
+  try {
+    if (!h || !path || !*path)
+      throw std::runtime_error("prefix_save: null handle or path");
+    return ((KvPool*)h)->prefix_save(path);
+  } catch (const std::exception& e) {
+    fill_error(err, err_len, e.what());
+    return -1;
+  }
+}
+
+// warm the adopt index from a persisted file. Returns records
+// adopted (missing file -> 0; malformed file -> whole-file reject,
+// counted, 0).
+__attribute__((visibility("default")))
+int64_t ptpu_kvpool_prefix_load(PTPU_KvPool* h, const char* path,
+                                char* err, int err_len) {
+  try {
+    if (!h || !path || !*path)
+      throw std::runtime_error("prefix_load: null handle or path");
+    return ((KvPool*)h)->prefix_load(path);
+  } catch (const std::exception& e) {
+    fill_error(err, err_len, e.what());
+    return -1;
+  }
 }
 
 /* One batched decode step: row r feeds tokens[r] into open session

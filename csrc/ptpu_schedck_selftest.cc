@@ -50,6 +50,7 @@ namespace sck = ptpu::schedck;
 PTPU_LOCK_CLASS(kClsSvKv, "sv.kv", 10, ptpu::kLockAllowBlock);
 PTPU_LOCK_CLASS(kClsSvSess, "sv.sess", 20);
 PTPU_LOCK_CLASS(kClsKvPool, "kv.pool", 25);
+PTPU_LOCK_CLASS(kClsKvSpill, "kv.spill", 28);
 PTPU_LOCK_CLASS(kClsSvBatcher, "sv.batcher", 30);
 PTPU_LOCK_CLASS(kClsPsRegistry, "ps.registry", 40);
 PTPU_LOCK_CLASS(kClsPsTable, "ps.table", 50);
@@ -426,6 +427,192 @@ void SpecRollbackScenario(int rounds, int drafts) {
   ptpu::MutexLock gk(st.kv);
   ptpu::MutexLock gp(st.pool);
   SCHEDCK_ASSERT(st.pool_free + st.pages == kPool);
+}
+
+// --- kv.pool + kv.spill: hibernate/restore vs decode collection ----
+// Mirrors the KV tiering protocol (ISSUE 19): the hibernator moves an
+// idle session's pages into a spill slot (kv.pool → kv.spill, the
+// production nesting) and frees its pool slot; the decode collector
+// transparently restores hibernated sessions before a step — possibly
+// hibernating an LRU victim to make room — and PINS every collected
+// session so a restore-triggered eviction inside the same collection
+// pass can never take a sid already captured into the running batch.
+// The closer frees either tier. Invariants: a session is exactly one
+// of resident/hibernated/closed, a pinned session is never chosen as
+// a hibernation victim, a step only touches resident sessions, and
+// pool + spill slot accounting balances at teardown.
+void KvSpillScenario(int nsess, int steps) {
+  constexpr int kPoolSlots = 2;
+  struct Sess {
+    int state = 0;  // 0 = resident, 1 = hibernated, 2 = closed
+    bool pinned = false;
+    uint64_t lru = 0;
+  };
+  struct St {
+    ptpu::Mutex kv{kClsSvKv};
+    ptpu::Mutex sess{kClsSvSess};
+    ptpu::Mutex pool{kClsKvPool};
+    ptpu::Mutex spill{kClsKvSpill};
+    std::vector<Sess> s;
+    int pool_free = kPoolSlots;
+    int spill_free = 0;
+    uint64_t clock = 1;
+  } st;
+  st.s.resize(size_t(nsess));
+  // seed (lock-free on purpose: no thread exists yet, and every
+  // main-thread decision step eats into the DFS horizon): the spill
+  // file is sized to hold every session, and sessions beyond the
+  // pool start hibernated — the steady state the ramp leaves behind
+  st.spill_free = nsess;
+  for (int j = 0; j < nsess; ++j) {
+    if (st.pool_free > 0) {
+      --st.pool_free;
+    } else {
+      st.s[size_t(j)].state = 1;
+      --st.spill_free;
+    }
+  }
+  // pool-level hibernate: copy pages out into a spill slot, then free
+  // the pool slot. Caller holds sv.kv + sv.sess.
+  auto hibernate = [&st](int i) -> bool {
+    Sess& se = st.s[size_t(i)];
+    SCHEDCK_ASSERT(se.state == 0 && !se.pinned);
+    ptpu::MutexLock gp(st.pool);
+    {
+      ptpu::MutexLock gl(st.spill);
+      if (st.spill_free == 0) return false;  // "kv spill exhausted"
+      --st.spill_free;
+    }
+    PTPU_SCHED_POINT();  // page copy-out runs with kv.pool held
+    ++st.pool_free;
+    se.state = 1;
+    return true;
+  };
+  // LRU hibernation victim among resident, UNPINNED sessions — the
+  // pin is what keeps a mid-collection restore from yanking a sid the
+  // collector already captured.
+  auto pick_victim = [&st]() -> int {
+    int victim = -1;
+    uint64_t best = ~uint64_t(0);
+    for (size_t j = 0; j < st.s.size(); ++j) {
+      const Sess& se = st.s[j];
+      if (se.state == 0 && !se.pinned && se.lru < best) {
+        best = se.lru;
+        victim = int(j);
+      }
+    }
+    return victim;
+  };
+  // transparent restore: allocate a pool slot (hibernating an LRU
+  // victim if the pool is full), copy pages back, release the spill
+  // slot. Caller holds sv.kv + sv.sess. Failure is the soft
+  // "no KV session slots" error — the session stays whole.
+  auto restore = [&st, &hibernate, &pick_victim](int i) -> bool {
+    Sess& se = st.s[size_t(i)];
+    SCHEDCK_ASSERT(se.state == 1);
+    for (int attempt = 0; attempt < 2; ++attempt) {
+      {
+        ptpu::MutexLock gp(st.pool);
+        if (st.pool_free > 0) {
+          --st.pool_free;
+          PTPU_SCHED_POINT();  // page copy-in runs with kv.pool held
+          ptpu::MutexLock gl(st.spill);
+          ++st.spill_free;
+          se.state = 0;
+          return true;
+        }
+      }
+      const int victim = pick_victim();
+      if (victim < 0 || !hibernate(victim)) return false;
+    }
+    return false;
+  };
+  sck::Thread collector([&st, &restore, steps] {
+    for (int r = 0; r < steps; ++r) {
+      ptpu::MutexLock gk(st.kv);  // held across the whole run
+      std::vector<int> batch;
+      {
+        ptpu::MutexLock gs(st.sess);
+        for (size_t j = 0; j < st.s.size(); ++j) {
+          Sess& se = st.s[j];
+          if (se.state == 2) continue;
+          if (se.state == 1 && !restore(int(j))) continue;  // soft err
+          se.pinned = true;
+          se.lru = st.clock++;
+          batch.push_back(int(j));
+        }
+      }
+      PTPU_SCHED_POINT();  // the decode step, outside sv.sess
+      {
+        ptpu::MutexLock gs(st.sess);
+        for (int j : batch) {
+          // the pin held every batched session resident for the step
+          SCHEDCK_ASSERT(st.s[size_t(j)].state == 0);
+          st.s[size_t(j)].pinned = false;
+        }
+      }
+    }
+  });
+  // lifecycle: the idle-hibernation sweep, then session close — one
+  // thread (both take sv.kv first, exactly like production, so their
+  // mutual order is already serialized; folding them keeps the DFS
+  // horizon for the interleavings that CAN differ)
+  sck::Thread lifecycle([&st, &hibernate, &pick_victim, steps] {
+    for (int r = 0; r < steps; ++r) {
+      ptpu::MutexLock gk(st.kv);
+      ptpu::MutexLock gs(st.sess);
+      const int victim = pick_victim();
+      if (victim >= 0) hibernate(victim);
+    }
+    for (size_t j = 0; j < st.s.size(); ++j) {
+      ptpu::MutexLock gk(st.kv);
+      ptpu::MutexLock gs(st.sess);
+      Sess& se = st.s[j];
+      SCHEDCK_ASSERT(!se.pinned);  // closer holds sv.kv: no live run
+      if (se.state == 0) {
+        ptpu::MutexLock gp(st.pool);
+        ++st.pool_free;
+      } else if (se.state == 1) {
+        // DropHibLocked: release the spill slot, pool → spill nesting
+        ptpu::MutexLock gp(st.pool);
+        ptpu::MutexLock gl(st.spill);
+        ++st.spill_free;
+      }
+      se.state = 2;
+      PTPU_SCHED_POINT();
+    }
+  });
+  // StatsJson gauges: sessions_resident / sessions_hibernated are
+  // rendered under sv.sess alone — no sv.kv — so telemetry races the
+  // decode step itself (the collector holds sv.kv but NOT sv.sess
+  // across the step point). The slot accounting must balance at
+  // every such observation, and a pinned (mid-step) session must
+  // always read as resident.
+  sck::Thread gauges([&st, steps] {
+    const int nsess = int(st.s.size());
+    for (int r = 0; r < steps + 1; ++r) {
+      ptpu::MutexLock gs(st.sess);
+      int resident = 0, hibernated = 0;
+      for (const Sess& se : st.s) {
+        if (se.state == 0) ++resident;
+        if (se.state == 1) ++hibernated;
+        if (se.pinned) SCHEDCK_ASSERT(se.state == 0);
+      }
+      PTPU_SCHED_POINT();  // gauge read racing the step (hot spot)
+      ptpu::MutexLock gp(st.pool);
+      ptpu::MutexLock gl(st.spill);
+      SCHEDCK_ASSERT(resident == kPoolSlots - st.pool_free);
+      SCHEDCK_ASSERT(hibernated == nsess - st.spill_free);
+    }
+  });
+  collector.join();
+  lifecycle.join();
+  gauges.join();
+  ptpu::MutexLock gp(st.pool);
+  ptpu::MutexLock gl(st.spill);
+  for (const Sess& se : st.s) SCHEDCK_ASSERT(se.state == 2);
+  SCHEDCK_ASSERT(st.pool_free == kPoolSlots);
+  SCHEDCK_ASSERT(st.spill_free == int(st.s.size()));
 }
 
 // --- ps.registry / ps.table: shard pulls vs optimizer pushes -------
@@ -1086,6 +1273,8 @@ void RunScenarios() {
        [] { ServingCloseScenario(3, 3); }},
       {"spec_rollback_vs_evict", [] { SpecRollbackScenario(2, 3); },
        [] { SpecRollbackScenario(4, 3); }},
+      {"kv_hibernate_restore_vs_close", [] { KvSpillScenario(3, 1); },
+       [] { KvSpillScenario(4, 2); }},
       {"ps_pull_vs_push", [] { PsPullPushScenario(1, 2); },
        [] { PsPullPushScenario(2, 3); }},
       {"net_inbox_wake_drain", [] { NetInboxScenario(1, 2); },

@@ -497,9 +497,14 @@ struct DecStats {
   // and rounds that fell back to a plain target step (context end)
   ptpu::Counter spec_rounds, spec_proposed, spec_accepted,
       spec_tokens, spec_draft_steps, spec_fallbacks;
+  // KV tiering counters (ISSUE 19): sessions hibernated to the spill
+  // tier instead of tombstone-evicted, transparent restores on the
+  // next op, and steps answered "kv spill exhausted" (retryable, the
+  // spill-tier twin of pool_exhausted)
+  ptpu::Counter hibernates, restores, spill_exhausted;
   // decode-plane CPU microseconds (same contract as SvStats::cpu_us)
   ptpu::Counter cpu_us;
-  ptpu::Histogram run_us, batch_fill;
+  ptpu::Histogram run_us, batch_fill, restore_us;
   void Reset() {
     cpu_us.Reset();
     opens.Reset();
@@ -520,8 +525,12 @@ struct DecStats {
     spec_tokens.Reset();
     spec_draft_steps.Reset();
     spec_fallbacks.Reset();
+    hibernates.Reset();
+    restores.Reset();
+    spill_exhausted.Reset();
     run_us.Reset();
     batch_fill.Reset();
+    restore_us.Reset();
   }
 };
 
@@ -624,6 +633,15 @@ struct SvServer {
    * spec_k = verify width - 1, optionally capped by $PTPU_SPEC_K
    * (padding tokens fill the unused verify columns; their rows are
    * rolled back with the rejected suffix). */
+  /* ---- KV tiering + session hibernation (ISSUE 19) ----
+   * PTPU_KV_SPILL_PATH attaches an mmap'd spill file to the decode
+   * pool(s); with it set, the LRU victim in OpenSlotLocked hibernates
+   * (pool state serialized, pages spilled or kept by shared ref)
+   * instead of tombstone-evicting, and the next DECODE/SPEC op on a
+   * hibernated session restores it transparently. Default off. */
+  std::string sv_spill_path;       // empty = tiering off
+  int64_t sv_spill_max = -1;       // PTPU_KV_SPILL_MAX_BYTES (-1=env)
+  std::string prefix_persist_path; // PTPU_KV_PREFIX_PERSIST (empty=off)
   std::string spec_draft_path, spec_verify_path;
   PTPU_KvPool* draft_pool = nullptr;
   std::map<int64_t, PTPU_Predictor*> draft_buckets, ver_buckets;
@@ -684,12 +702,23 @@ struct SvServer {
    * never waits out a running decode batch; only decode-plane ops
    * (open/close/step of sessions) serialize on kv_mu_. slot == -1 is
    * an eviction tombstone: later steps on that session answer
-   * "evicted" instead of "unknown". */
+   * "evicted" instead of "unknown" — unless `hib` is non-empty, in
+   * which case the session is HIBERNATED (ISSUE 19): its pool state
+   * lives in the spill tier and the next step restores it
+   * transparently. */
   struct WireSession {
     int slot = -1;
     uint64_t last_us = 0;
     const void* owner = nullptr;   // opening conn (freed on conn close)
     std::unique_ptr<SpecState> spec;  // speculative sessions only
+    // hibernation records (ISSUE 19): opaque pool handles from
+    // ptpu_kvpool_hibernate, cross-validated by the pool on restore.
+    // hib covers the target session; hib_draft the spec draft twin.
+    std::vector<uint8_t> hib, hib_draft;
+    // set while this session's pool sid is collected into the decode
+    // run being assembled: a mid-run restore's make-room pass must
+    // not hibernate/evict it out from under the collected sid
+    bool pinned = false;
   };
   ptpu::Mutex kv_mu_{kLockSvKv};
   ptpu::Mutex sess_mu_{kLockSvSess};
@@ -922,6 +951,25 @@ struct SvServer {
         if (ptpu_predictor_kv_attach(dec_pred, kv_pool, err,
                                      sizeof(err)) != 0)
           throw std::runtime_error(std::string("kv_attach: ") + err);
+        /* ---- KV tiering (ISSUE 19): attach the spill tier and warm
+         * the prefix cache. Both default off. The kv_attach above
+         * fixed the pool geometry, which both file formats pin. */
+        if (sv_spill_path.empty())
+          if (const char* e = std::getenv("PTPU_KV_SPILL_PATH"))
+            sv_spill_path = e;
+        if (!sv_spill_path.empty() &&
+            ptpu_kvpool_spill_attach(kv_pool, sv_spill_path.c_str(),
+                                     sv_spill_max, err,
+                                     sizeof(err)) != 0)
+          throw std::runtime_error(std::string("kv spill: ") + err);
+        if (prefix_persist_path.empty())
+          if (const char* e = std::getenv("PTPU_KV_PREFIX_PERSIST"))
+            prefix_persist_path = e;
+        if (!prefix_persist_path.empty())
+          // best-effort warm: a malformed/missing file only counts a
+          // reject in the pool (the cache can miss, never lie)
+          ptpu_kvpool_prefix_load(kv_pool, prefix_persist_path.c_str(),
+                                  err, sizeof(err));
         dec_buckets[dec_batch] = dec_pred;
         // step-batch ladder below the baked batch, re-planned at load
         for (int64_t b2 = 1; b2 < dec_batch; b2 *= 2) {
@@ -1139,6 +1187,18 @@ struct SvServer {
           ptpu_predictor_destroy(dp);
           throw std::runtime_error(
               "spec_draft_model must be a width-1 step artifact");
+        }
+        // spill tier for the draft twin (ISSUE 19): spec sessions
+        // hibernate both planes, so the draft pool needs its own
+        // spill file (different geometry than the target's)
+        if (!sv_spill_path.empty() &&
+            ptpu_kvpool_spill_attach(draft_pool,
+                                     (sv_spill_path + ".draft").c_str(),
+                                     sv_spill_max, err,
+                                     sizeof(err)) != 0) {
+          ptpu_predictor_destroy(dp);
+          throw std::runtime_error(std::string("draft kv spill: ") +
+                                   err);
         }
         draft_buckets[draft_batch] = dp;
         for (int64_t b2 = 1; b2 < draft_batch; b2 *= 2) {
@@ -1732,40 +1792,123 @@ struct SvServer {
     return OpenSlotLocked(conn, sess, why);
   }
 
+  /* hibernate a live wire session into the spill tier (kv_mu_ +
+   * sess_mu_ held, ISSUE 19). On success the session's pool slot(s)
+   * are freed and ws.hib / ws.hib_draft hold the opaque pool records;
+   * SpecState (rng, committed history) stays resident — only pool
+   * state tiers out. Returns true iff the target pool slot was freed
+   * (in the pathological draft-rollback-failure case by dropping the
+   * session, counted as an eviction). */
+  bool HibernateLocked(uint64_t id, WireSession& ws) {
+    if (ws.slot < 0 || !kv_pool) return false;
+    if (prefills_.count(id)) return false;  // mid-prefill: slot is hot
+    char err[256] = {0};
+    const int64_t need = ptpu_kvpool_hibernate(kv_pool, ws.slot,
+                                               nullptr, 0, err,
+                                               sizeof(err));
+    if (need < 0) return false;
+    std::vector<uint8_t> rec(static_cast<size_t>(need));
+    const int64_t got = ptpu_kvpool_hibernate(
+        kv_pool, ws.slot, rec.data(), need, err, sizeof(err));
+    if (got < 0) {
+      if (std::strstr(err, "spill exhausted"))
+        dstats.spill_exhausted.Add(1);
+      return false;
+    }
+    rec.resize(size_t(got));
+    if (ws.spec && ws.spec->draft_slot >= 0 && draft_pool) {
+      // spec-twin linkage: the draft session hibernates alongside the
+      // target so a later restore resumes rounds mid-history
+      char derr[256] = {0};
+      const int64_t dneed =
+          ptpu_kvpool_hibernate(draft_pool, ws.spec->draft_slot,
+                                nullptr, 0, derr, sizeof(derr));
+      std::vector<uint8_t> drec;
+      int64_t dgot = -1;
+      if (dneed >= 0) {
+        drec.resize(size_t(dneed));
+        dgot = ptpu_kvpool_hibernate(draft_pool, ws.spec->draft_slot,
+                                     drec.data(), dneed, derr,
+                                     sizeof(derr));
+      }
+      if (dgot < 0) {
+        if (std::strstr(derr, "spill exhausted"))
+          dstats.spill_exhausted.Add(1);
+        // roll the target back to resident; if even that fails the
+        // session is unrecoverable — drop the record (tombstone)
+        const int back =
+            ptpu_kvpool_restore(kv_pool, rec.data(),
+                                int64_t(rec.size()), err, sizeof(err));
+        if (back >= 0) {
+          ws.slot = back;
+        } else {
+          ptpu_kvpool_hibernate_drop(kv_pool, rec.data(),
+                                     int64_t(rec.size()));
+          ws.slot = -1;
+          CloseSpecLocked(ws);
+          dstats.evictions.Add(1);
+          return true;  // the slot IS free, just not by hibernation
+        }
+        return false;
+      }
+      drec.resize(size_t(dgot));
+      ws.hib_draft = std::move(drec);
+      ws.spec->draft_slot = -1;
+    }
+    ws.hib = std::move(rec);
+    ws.slot = -1;
+    dstats.hibernates.Add(1);
+    return true;
+  }
+
+  // kv_mu_ + sess_mu_ held: make room for one more pool session by
+  // hibernating (spill tier attached) or tombstone-evicting the
+  // least-recently-stepped live wire session
+  bool EvictOneLocked(std::string* why) {
+    uint64_t victim = 0, oldest = UINT64_MAX;
+    bool found = false;
+    for (const auto& kv : sessions_)
+      if (kv.second.slot >= 0 && !kv.second.pinned &&
+          kv.second.last_us < oldest) {
+        oldest = kv.second.last_us;
+        victim = kv.first;
+        found = true;
+      }
+    if (!found) {
+      *why = "no KV session slots";
+      return false;
+    }
+    // tiering on: hibernate instead of evicting — the session
+    // survives with its pool state in the spill tier
+    if (!sv_spill_path.empty() &&
+        HibernateLocked(victim, sessions_[victim]))
+      return true;
+    ptpu_predictor_kv_close(dec_pred, sessions_[victim].slot);
+    sessions_[victim].slot = -1;
+    CloseSpecLocked(sessions_[victim]);
+    dstats.evictions.Add(1);
+    // an evicted session may still be mid-prefill: its OPEN2 must
+    // answer NOW (queued prefill steps drop at the tombstone), or
+    // the client waits forever on a session that no longer exists
+    auto jit = prefills_.find(victim);
+    if (jit != prefills_.end()) {
+      SendErrFrame(jit->second->conn, jit->second->rid,
+                   "decode session evicted");
+      jit->second->conn->NotePending(-1);
+      prefills_.erase(jit);
+    }
+    return true;
+  }
+
   // kv_mu_ + sess_mu_ held; allocates a predictor/pool session with
   // LRU eviction of the least-recently-stepped live wire session
   bool OpenSlotLocked(const ptpu::net::ConnPtr& conn, uint64_t* sess,
                       std::string* why) {
     int slot = ptpu_predictor_kv_open(dec_pred);
     if (slot < 0) {
-      // every KV slot busy: evict the least-recently-stepped live
-      // session (its later steps answer "evicted" off the tombstone)
-      uint64_t victim = 0, oldest = UINT64_MAX;
-      bool found = false;
-      for (const auto& kv : sessions_)
-        if (kv.second.slot >= 0 && kv.second.last_us < oldest) {
-          oldest = kv.second.last_us;
-          victim = kv.first;
-          found = true;
-        }
-      if (!found) {
-        *why = "no KV session slots";
-        return false;
-      }
-      ptpu_predictor_kv_close(dec_pred, sessions_[victim].slot);
-      sessions_[victim].slot = -1;
-      CloseSpecLocked(sessions_[victim]);
-      dstats.evictions.Add(1);
-      // an evicted session may still be mid-prefill: its OPEN2 must
-      // answer NOW (queued prefill steps drop at the tombstone), or
-      // the client waits forever on a session that no longer exists
-      auto jit = prefills_.find(victim);
-      if (jit != prefills_.end()) {
-        SendErrFrame(jit->second->conn, jit->second->rid,
-                     "decode session evicted");
-        jit->second->conn->NotePending(-1);
-        prefills_.erase(jit);
-      }
+      // every KV slot busy: hibernate or evict the
+      // least-recently-stepped live session
+      if (!EvictOneLocked(why)) return false;
       slot = ptpu_predictor_kv_open(dec_pred);
       if (slot < 0) {
         *why = "no KV session slots";
@@ -1773,13 +1916,15 @@ struct SvServer {
       }
     }
     // bound tombstone growth: drop the oldest evicted entries once
-    // they outnumber the live slots 4:1
+    // they outnumber the live slots 4:1. Hibernated sessions (slot
+    // -1 but a live spill record) are NOT tombstones — holding many
+    // of them at bounded RSS is the point of the tier.
     size_t tombs = 0;
     for (const auto& kv : sessions_)
-      if (kv.second.slot < 0) ++tombs;
+      if (kv.second.slot < 0 && kv.second.hib.empty()) ++tombs;
     for (auto it = sessions_.begin();
          tombs > size_t(4 * kv_sessions) && it != sessions_.end();) {
-      if (it->second.slot < 0) {
+      if (it->second.slot < 0 && it->second.hib.empty()) {
         it = sessions_.erase(it);
         --tombs;
       } else {
@@ -1797,6 +1942,95 @@ struct SvServer {
     return true;
   }
 
+  /* restore a hibernated wire session's pool state (kv_mu_ +
+   * sess_mu_ held, ISSUE 19). Soft failures ("kv pool exhausted",
+   * "kv spill exhausted", full tables) set *why and leave the session
+   * hibernated — the caller answers a retryable row error, exactly
+   * like pool_exhausted backpressure. */
+  bool RestoreLocked(WireSession& ws, std::string* why) {
+    const int64_t t0 = ptpu::NowUs();
+    char err[256] = {0};
+    int slot = ptpu_kvpool_restore(kv_pool, ws.hib.data(),
+                                   int64_t(ws.hib.size()), err,
+                                   sizeof(err));
+    if (slot == -1) {
+      // pool session table full: free one resident slot, retry once
+      std::string ewhy;
+      if (EvictOneLocked(&ewhy))
+        slot = ptpu_kvpool_restore(kv_pool, ws.hib.data(),
+                                   int64_t(ws.hib.size()), err,
+                                   sizeof(err));
+    }
+    if (slot < 0) {
+      if (std::strstr(err, "kv pool exhausted"))
+        dstats.pool_exhausted.Add(1);
+      *why = slot == -1 ? "no KV session slots"
+                        : std::string("restore: ") + err;
+      return false;
+    }
+    if (ws.spec && !ws.hib_draft.empty()) {
+      char derr[256] = {0};
+      const int ds = ptpu_kvpool_restore(
+          draft_pool, ws.hib_draft.data(),
+          int64_t(ws.hib_draft.size()), derr, sizeof(derr));
+      if (ds < 0) {
+        if (std::strstr(derr, "kv pool exhausted"))
+          dstats.pool_exhausted.Add(1);
+        // tier the freshly-restored target back out so the session
+        // stays whole; the step retries later
+        const int64_t need = ptpu_kvpool_hibernate(
+            kv_pool, slot, nullptr, 0, err, sizeof(err));
+        bool back = false;
+        if (need >= 0) {
+          std::vector<uint8_t> rec(static_cast<size_t>(need));
+          const int64_t got = ptpu_kvpool_hibernate(
+              kv_pool, slot, rec.data(), need, err, sizeof(err));
+          if (got >= 0) {
+            rec.resize(size_t(got));
+            ws.hib = std::move(rec);
+            back = true;
+          }
+        }
+        if (!back) {
+          // unrecoverable: drop both planes (tombstone)
+          ptpu_predictor_kv_close(dec_pred, slot);
+          ptpu_kvpool_hibernate_drop(draft_pool, ws.hib_draft.data(),
+                                     int64_t(ws.hib_draft.size()));
+          ws.hib.clear();
+          ws.hib_draft.clear();
+          CloseSpecLocked(ws);
+          dstats.evictions.Add(1);
+          *why = "decode session evicted";
+          return false;
+        }
+        *why = ds == -1 ? "no draft KV session slots"
+                        : std::string("restore: ") + derr;
+        return false;
+      }
+      ws.spec->draft_slot = ds;
+      ws.hib_draft.clear();
+    }
+    ws.hib.clear();
+    ws.slot = slot;
+    ws.last_us = uint64_t(ptpu::NowUs());
+    dstats.restores.Add(1);
+    dstats.restore_us.Observe(uint64_t(ptpu::NowUs() - t0));
+    return true;
+  }
+
+  // kv_mu_ + sess_mu_ held: release a departing session's spill-tier
+  // state (no-op for resident/tombstone sessions)
+  void DropHibLocked(WireSession& ws) {
+    if (!ws.hib.empty() && kv_pool)
+      ptpu_kvpool_hibernate_drop(kv_pool, ws.hib.data(),
+                                 int64_t(ws.hib.size()));
+    if (!ws.hib_draft.empty() && draft_pool)
+      ptpu_kvpool_hibernate_drop(draft_pool, ws.hib_draft.data(),
+                                 int64_t(ws.hib_draft.size()));
+    ws.hib.clear();
+    ws.hib_draft.clear();
+  }
+
   bool DecodeClose(uint64_t sess, std::string* why) {
     ptpu::MutexLock kl(kv_mu_);
     ptpu::MutexLock l(sess_mu_);
@@ -1808,6 +2042,7 @@ struct SvServer {
     if (it->second.slot >= 0)
       ptpu_predictor_kv_close(dec_pred, it->second.slot);
     CloseSpecLocked(it->second);
+    DropHibLocked(it->second);
     sessions_.erase(it);
     // a prefilling session closed out from under its job (only
     // reachable via a racing second connection guessing the id —
@@ -1844,6 +2079,7 @@ struct SvServer {
         if (it->second.slot >= 0)
           ptpu_predictor_kv_close(dec_pred, it->second.slot);
         CloseSpecLocked(it->second);
+        DropHibLocked(it->second);
         prefills_.erase(it->first);  // conn is gone: no reply owed
         it = sessions_.erase(it);
       } else {
@@ -1903,10 +2139,17 @@ struct SvServer {
       return false;
     }
     auto it = sessions_.find(src);
-    if (it == sessions_.end() || it->second.slot < 0) {
-      *why = it == sessions_.end() ? "unknown decode session"
-                                   : "decode session evicted";
+    if (it == sessions_.end()) {
+      *why = "unknown decode session";
       return false;
+    }
+    if (it->second.slot < 0) {
+      // hibernated source: restore first, then fork (ISSUE 19)
+      if (it->second.hib.empty()) {
+        *why = "decode session evicted";
+        return false;
+      }
+      if (!RestoreLocked(it->second, why)) return false;
     }
     if (prefills_.count(src)) {
       *why = "session is still prefilling";
@@ -2318,6 +2561,20 @@ struct SvServer {
       ptpu::MutexLock l(sess_mu_);
       for (auto* r : run) {
         auto it = sessions_.find(r->session);
+        if (it != sessions_.end() && it->second.slot < 0 &&
+            !it->second.hib.empty()) {
+          // hibernated session (ISSUE 19): restore transparently —
+          // the step below runs as if the session never left RAM.
+          // Soft failures answer a retryable error (pool/spill
+          // backpressure), same contract as pool_exhausted.
+          std::string why;
+          if (!RestoreLocked(it->second, &why)) {
+            if (r->is_prefill) continue;
+            SendErrFrame(r->conn, r->id, why);
+            r->conn->NotePending(-1);
+            continue;
+          }
+        }
         if (it == sessions_.end() || it->second.slot < 0) {
           if (r->is_prefill) continue;  // job died with its session
           SendErrFrame(r->conn, r->id,
@@ -2343,6 +2600,7 @@ struct SvServer {
             continue;
           }
           it->second.last_us = uint64_t(ptpu::NowUs());
+          it->second.pinned = true;
           spec_rounds.push_back(r);
           continue;
         }
@@ -2353,10 +2611,15 @@ struct SvServer {
           continue;
         }
         it->second.last_us = uint64_t(ptpu::NowUs());
+        it->second.pinned = true;
         sids.push_back(it->second.slot);
         toks.push_back(r->token);
         live.push_back(r);
       }
+      // collection done: no further restores can run before the step
+      // itself (kv_mu_ stays held), so the pins have done their job
+      for (auto* r : live) sessions_[r->session].pinned = false;
+      for (auto* r : spec_rounds) sessions_[r->session].pinned = false;
     }
     if (!live.empty()) PlainStepRun(live, sids, toks);
     if (!spec_rounds.empty()) RunSpecRounds(spec_rounds);
@@ -3222,6 +3485,14 @@ struct SvServer {
     }
     batcher.reset();
     dec_batcher.reset();
+    // prefix-cache persistence (ISSUE 19): snapshot the adopt index
+    // before the pool dies; the next start warms from it (load
+    // re-keys by token ids, so a stale file can only miss)
+    if (kv_pool && !prefix_persist_path.empty()) {
+      char perr[256] = {0};
+      ptpu_kvpool_prefix_save(kv_pool, prefix_persist_path.c_str(),
+                              perr, sizeof(perr));
+    }
     for (auto& kv2 : dec_buckets)
       if (kv2.second != dec_pred) ptpu_predictor_destroy(kv2.second);
     dec_buckets.clear();
@@ -3352,19 +3623,31 @@ struct SvServer {
           {"spec_tokens", &dstats.spec_tokens},
           {"spec_draft_steps", &dstats.spec_draft_steps},
           {"spec_fallbacks", &dstats.spec_fallbacks},
+          {"hibernates", &dstats.hibernates},
+          {"restores", &dstats.restores},
+          {"spill_exhausted", &dstats.spill_exhausted},
           {"cpu_us", &dstats.cpu_us},
       };
       for (const auto& kv : ds) {
         ptpu::AppendJsonU64(&out, kv.name, kv.c->Get());
         out += ',';
       }
-      uint64_t live = 0;
+      uint64_t live = 0, hibernated = 0;
       {
         ptpu::MutexLock l(sess_mu_);
-        for (const auto& kv : sessions_)
+        for (const auto& kv : sessions_) {
           if (kv.second.slot >= 0) ++live;
+          if (kv.second.slot < 0 && !kv.second.hib.empty())
+            ++hibernated;
+        }
       }
       ptpu::AppendJsonU64(&out, "sessions_active", live);
+      out += ',';
+      // ISSUE 19 gauges: sessions holding pool pages vs. sessions
+      // whose pool state lives in the spill tier (slot freed)
+      ptpu::AppendJsonU64(&out, "sessions_resident", live);
+      out += ',';
+      ptpu::AppendJsonU64(&out, "sessions_hibernated", hibernated);
       out += ',';
       ptpu::AppendJsonU64(&out, "kv_sessions", uint64_t(kv_sessions));
       out += ',';
@@ -3373,6 +3656,8 @@ struct SvServer {
       ptpu::AppendJsonHist(&out, "run_us", dstats.run_us);
       out += ',';
       ptpu::AppendJsonHist(&out, "batch_fill", dstats.batch_fill);
+      out += ',';
+      ptpu::AppendJsonHist(&out, "restore_us", dstats.restore_us);
       if (kv_pool) {
         // pages_in_use/pages_total gauges + prefix_hits/cow_copies
         // live in the pool's own snapshot (rendered in the predictor

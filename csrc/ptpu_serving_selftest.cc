@@ -947,6 +947,176 @@ void test_kvpool_pager_abi() {
   std::printf("  paged pool: boundary/COW/adopt/exhaust/evict   OK\n");
 }
 
+// ------------------------------------- KV tiering + hibernation (r19)
+/* Spill-tier ABI: hibernate an active session out of the pool (slot
+ * frees — the RSS-bounding mechanism), restore it and continue the
+ * running sums EXACTLY, reject a corrupted record whole, drop an
+ * unwanted record, answer spill exhaustion as a soft error, and
+ * persist the prefix-adopt index across pool instances (restart-warm
+ * adoption replays the same sums). The record is a handle, not a
+ * capability: every restore cross-validates against the pool's RAM
+ * registry. */
+void test_kvpool_spill_hibernate() {
+  const std::string dec_path =
+      write_model_file(build_decode_model(), "ptpu_sv_selftest_dec.onnx");
+  const char* spill_path = "/tmp/ptpu_sv_selftest_spill.bin";
+  const char* prefix_path = "/tmp/ptpu_sv_selftest_prefix.bin";
+  std::remove(spill_path);
+  std::remove(prefix_path);
+  char err[512] = {0};
+  PTPU_KvPool* pool = ptpu_kvpool_create(8, 2, 2, 1, err, sizeof(err));
+  assert(pool != nullptr);
+  PTPU_Predictor* p =
+      ptpu_predictor_create(dec_path.c_str(), err, sizeof(err));
+  assert(p != nullptr);
+  assert(ptpu_predictor_kv_attach(p, pool, err, sizeof(err)) == 0);
+  assert(ptpu_kvpool_spill_attach(pool, spill_path, 64 << 20, err,
+                                  sizeof(err)) == 0);
+  const auto step1 = [&](int sid, int64_t tok) -> float {
+    const int64_t sids[1] = {sid}, toks[1] = {tok};
+    char serr[512] = {0};
+    const int rc =
+        ptpu_predictor_decode_step(p, sids, toks, 1, serr, sizeof(serr));
+    assert(rc == 0 && "spill-leg decode step failed");
+    return ptpu_predictor_output_data(p, 0)[0];
+  };
+  const int a = ptpu_kvpool_open(pool);
+  assert(a >= 0);
+  assert(step1(a, 5) == 5.f);
+  assert(step1(a, 7) == 12.f);   // page 0 full
+  assert(step1(a, 11) == 23.f);  // partial tail in page 1
+  // two-call hibernate: size first, then execute — the session slot
+  // frees (max_sessions=2, so a second open+hibernate cycle proves
+  // the slot actually returned)
+  const int64_t need = ptpu_kvpool_hibernate(pool, a, nullptr, 0, err,
+                                             sizeof(err));
+  assert(need > 0);
+  std::vector<uint8_t> rec(static_cast<size_t>(need));
+  assert(ptpu_kvpool_hibernate(pool, a, rec.data(), need, err,
+                               sizeof(err)) == need);
+  assert(ptpu_kvpool_hibernated(pool) == 1);
+  assert(ptpu_kvpool_len(pool, a) == -1);  // slot is gone
+  // the freed slot is reusable while `a` sleeps on disk
+  const int b = ptpu_kvpool_open(pool);
+  const int c = ptpu_kvpool_open(pool);
+  assert(b >= 0 && c >= 0 && ptpu_kvpool_open(pool) == -1);
+  ptpu_kvpool_close(pool, c);
+  // a corrupted record is rejected WHOLE — and the hibernated session
+  // survives the attempt
+  {
+    std::vector<uint8_t> bad = rec;
+    bad[bad.size() / 2] ^= 0x40;
+    char rerr[512] = {0};
+    assert(ptpu_kvpool_restore(pool, bad.data(), int64_t(bad.size()),
+                               rerr, sizeof(rerr)) == -2);
+    assert(std::strstr(rerr, "corrupt") != nullptr);
+    assert(ptpu_kvpool_hibernated(pool) == 1);
+  }
+  // restore re-materializes the session: the running sum continues
+  // exactly where the hibernated history left it
+  const int a2 = ptpu_kvpool_restore(pool, rec.data(),
+                                     int64_t(rec.size()), err,
+                                     sizeof(err));
+  assert(a2 >= 0);
+  assert(ptpu_kvpool_hibernated(pool) == 0);
+  assert(ptpu_kvpool_len(pool, a2) == 3);
+  assert(step1(a2, 100) == 123.f);
+  // a replayed (already-restored) record must not restore twice
+  {
+    char rerr[512] = {0};
+    assert(ptpu_kvpool_restore(pool, rec.data(), int64_t(rec.size()),
+                               rerr, sizeof(rerr)) == -2);
+  }
+  // hibernate_drop releases a record without restoring it
+  {
+    const int64_t n2 = ptpu_kvpool_hibernate(pool, b, nullptr, 0, err,
+                                             sizeof(err));
+    assert(n2 > 0);
+    std::vector<uint8_t> rec2(static_cast<size_t>(n2));
+    assert(ptpu_kvpool_hibernate(pool, b, rec2.data(), n2, err,
+                                 sizeof(err)) == n2);
+    assert(ptpu_kvpool_hibernated(pool) == 1);
+    ptpu_kvpool_hibernate_drop(pool, rec2.data(), int64_t(rec2.size()));
+    assert(ptpu_kvpool_hibernated(pool) == 0);
+  }
+  {
+    const std::string js = ptpu_kvpool_stats_json(pool);
+    assert(js.find("\"hibernates\":2") != std::string::npos);
+    assert(js.find("\"restores\":1") != std::string::npos);
+    assert(js.find("\"hib_drops\":1") != std::string::npos);
+    assert(js.find("\"spill_attached\":1") != std::string::npos);
+  }
+  // restart-warm prefix cache: publish a2's prompt, persist the adopt
+  // index, then a FRESH pool (new process stand-in) loads it and
+  // adopts the full-page prefix exactly like the r12 in-RAM path
+  const int64_t prompt[4] = {5, 7, 11, 100};
+  assert(ptpu_kvpool_publish(pool, a2, prompt, 4) == 0);
+  assert(ptpu_kvpool_prefix_save(pool, prefix_path, err,
+                                 sizeof(err)) == 2);
+  ptpu_predictor_destroy(p);
+  ptpu_kvpool_destroy(pool);
+  PTPU_KvPool* pool2 = ptpu_kvpool_create(8, 2, 2, 1, err, sizeof(err));
+  assert(pool2 != nullptr);
+  PTPU_Predictor* p2 =
+      ptpu_predictor_create(dec_path.c_str(), err, sizeof(err));
+  assert(p2 != nullptr);
+  assert(ptpu_predictor_kv_attach(p2, pool2, err, sizeof(err)) == 0);
+  assert(ptpu_kvpool_prefix_load(pool2, prefix_path, err,
+                                 sizeof(err)) == 2);
+  const int w = ptpu_kvpool_open(pool2);
+  assert(ptpu_kvpool_adopt(pool2, w, prompt, 4) == 2);
+  {
+    const int64_t sids[1] = {w}, toks[1] = {11};
+    assert(ptpu_predictor_decode_step(p2, sids, toks, 1, err,
+                                      sizeof(err)) == 0);
+    assert(ptpu_predictor_output_data(p2, 0)[0] == 23.f);
+  }
+  // spill exhaustion is a SOFT error: a cap too small for one slot
+  // answers backpressure with the raise-the-knob message
+  {
+    const char* tiny_path = "/tmp/ptpu_sv_selftest_spill_tiny.bin";
+    std::remove(tiny_path);
+    assert(ptpu_kvpool_spill_attach(pool2, tiny_path, 4096, err,
+                                    sizeof(err)) == 0);
+    char herr[512] = {0};
+    const int64_t hn = ptpu_kvpool_hibernate(pool2, w, nullptr, 0,
+                                             herr, sizeof(herr));
+    assert(hn > 0);  // the size query never touches the spill tier
+    std::vector<uint8_t> hbuf(static_cast<size_t>(hn));
+    assert(ptpu_kvpool_hibernate(pool2, w, hbuf.data(), hn, herr,
+                                 sizeof(herr)) < 0);
+    assert(std::strstr(herr, "spill exhausted") != nullptr);
+    assert(ptpu_kvpool_len(pool2, w) == 3);  // session untouched
+    std::remove(tiny_path);
+  }
+  ptpu_predictor_destroy(p2);
+  ptpu_kvpool_destroy(pool2);
+  // the untrusted-byte parsers reject malformed input whole (the
+  // fuzz target drives these exhaustively; this pins the contract in
+  // the plain selftest too)
+  {
+    namespace sp = ptpu::spill;
+    sp::HibRecord hr;
+    hr.hib_id = 7;
+    hr.len = 3;
+    hr.groups.push_back(sp::HibGroup{sp::kHibKindSpilled, 0, 0});
+    std::vector<uint8_t> bytes;
+    sp::SerializeHib(hr, &bytes);
+    sp::HibRecord back;
+    assert(sp::ParseHibBytes(bytes.data(), bytes.size(), &back) ==
+           sp::ParseResult::kOk);
+    assert(sp::ParseHibBytes(bytes.data(), bytes.size() - 1, &back) ==
+           sp::ParseResult::kMalformed);  // truncated
+    std::vector<uint8_t> wrong = bytes;
+    wrong[0] ^= 0xff;  // magic
+    assert(sp::ParseHibBytes(wrong.data(), wrong.size(), &back) ==
+           sp::ParseResult::kMalformed);
+  }
+  std::remove(spill_path);
+  std::remove(prefix_path);
+  std::printf("  kv spill: hibernate/restore/drop/persist        OK\n");
+}
+
 /* Paged decode over the wire: OPEN2 prompt prefill (cold + prefix
  * hit), OPEN_REP layout, FORK + equal-step parity, prefill
  * exhaustion answering the OPEN2 with a soft error, reclaim-on-close
@@ -1646,6 +1816,7 @@ int main() {
   test_decode_kv_abi();
   test_serving_decode_wire();
   test_kvpool_pager_abi();
+  test_kvpool_spill_hibernate();
   test_serving_decode_paged_wire();
   test_kvpool_trim_cow_edges();
   test_spec_sampler_exactness();

@@ -1,0 +1,513 @@
+// KV-cache spill tier (ISSUE 19): the disk half of KV tiering +
+// session hibernation. Three byte formats live here, all following
+// the r11 untrusted-file posture established by ptpu_tune.h /
+// ptpu_capture.h — versioned magic + fixed-size header + fixed-size
+// records through the bounds-checked ptpu_wire.h codecs, an
+// exact-size check BEFORE any record read, and whole-file reject on
+// ANY malformed byte (csrc/fuzz/fuzz_spill.cc fuzzes every parser
+// below):
+//
+//   1. The SPILL FILE header ("PSPL"): an mmap'd slot store of
+//      fixed-size page-group slabs. KV page groups are contiguous
+//      [layer][k|v][token][H][D] float slabs — natural disk records —
+//      so a cold group spills as one slot write and restores as one
+//      slot read. Slot CONTENT is per-process scratch (the
+//      hibernation registry that gives slots meaning lives in KvPool
+//      RAM), so Attach always resets the file; the header exists so a
+//      foreign/corrupt file at the configured path is detected and
+//      counted instead of silently scribbled over.
+//
+//   2. HIBERNATION RECORDS ("PHIB"): a serialized idle session —
+//      length + per-group (kind, gid|slot, gen) rows. The bytes are a
+//      HANDLE, not a capability: KvPool::restore() cross-validates
+//      every field against its RAM-side registry entry and rejects on
+//      any mismatch, so malformed or replayed bytes can error but
+//      never corrupt the pool.
+//
+//   3. The PREFIX-PERSIST FILE ("PPFX"): the content-addressed adopt
+//      index serialized across restarts, parent-before-child. Safety
+//      matches the r12 in-RAM argument: the chain hash is recomputed
+//      from the PERSISTED TOKEN IDS on load (never read from the
+//      file), and adoption still exact-matches token ids + parent
+//      (gid,gen) linkage — a warmed cache can only miss, never serve
+//      wrong KV for a different token sequence. A corrupted payload
+//      is caught by the per-record checksum; the whole file rejects.
+//
+// Concurrency: SpillFile has its own ranked mutex (kv.spill, rank 28)
+// taken strictly UNDER kv.pool (25) — KvPool calls into the slot
+// store while holding its pool lock — and above nothing: SpillFile
+// never calls out. See the README lock-rank table.
+#ifndef PTPU_SPILL_H_
+#define PTPU_SPILL_H_
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "ptpu_sync.h"
+#include "ptpu_wire.h"
+
+namespace ptpu {
+namespace spill {
+
+// ---------------------------------------------------------------- formats
+// Spill-file header (one per file, in a 4096-byte reserved region so
+// slot offsets stay page-aligned for mmap):
+//   [u32 magic "PSPL"][u32 version][u32 page][u32 layers][u32 heads]
+//   [u32 hdim][u64 slot_bytes]
+constexpr uint32_t kSpillMagic = 0x4c505350u;  // "PSPL" little-endian
+constexpr uint32_t kSpillVersion = 1;
+constexpr size_t kSpillHeaderBytes = 32;  // 24 used + 8 spare (zero)
+constexpr size_t kSpillHeaderReserve = 4096;
+constexpr int64_t kSpillChunkSlots = 64;  // mmap growth granule
+
+// Hibernation record:
+//   [u32 magic "PHIB"][u32 version][u64 hib_id][u64 len]
+//   [u32 ngroups][u32 reserved=0]
+//   then ngroups x [u32 kind][u32 reserved=0][i64 a][u64 b]
+// kind 0 = shared (a=gid, b=gen: the record HOLDS a pool ref);
+// kind 1 = spilled (a=spill slot, b=0).
+constexpr uint32_t kHibMagic = 0x42494850u;  // "PHIB" little-endian
+constexpr uint32_t kHibVersion = 1;
+constexpr size_t kHibHeaderBytes = 32;
+constexpr size_t kHibRecordBytes = 24;
+constexpr uint32_t kHibMaxGroups = 1u << 20;
+constexpr uint64_t kHibMaxLen = 1ull << 40;
+constexpr uint32_t kHibKindShared = 0;
+constexpr uint32_t kHibKindSpilled = 1;
+
+// Prefix-persist file:
+//   [u32 magic "PPFX"][u32 version][u32 page][u32 layers][u32 heads]
+//   [u32 hdim][u32 count][u32 reserved=0]
+//   then count x [u32 parent_idx][u32 ntoks=page][page x i64 tokens]
+//                [group_elems x f32 payload][u64 fnv1a checksum]
+// parent_idx refers to an EARLIER record in the same file (or
+// kPrefixRootParent) — parent-before-child order is part of the
+// format, so a single forward pass rebuilds the chain.
+constexpr uint32_t kPrefixMagic = 0x58465050u;  // "PPFX" little-endian
+constexpr uint32_t kPrefixVersion = 1;
+constexpr size_t kPrefixHeaderBytes = 32;
+constexpr uint32_t kPrefixMaxRecords = 65536;
+constexpr uint32_t kPrefixRootParent = 0xffffffffu;
+
+// geometry caps: keep every derived size computable in uint64 with
+// headroom (max slot_bytes under these caps is ~2^55)
+constexpr uint32_t kMaxPage = 4096;
+constexpr uint32_t kMaxLayers = 1024;
+constexpr uint32_t kMaxHeads = 4096;
+constexpr uint32_t kMaxHdim = 65536;
+
+enum class ParseResult { kOk, kMalformed };
+
+struct SpillGeom {
+  uint32_t page = 0, layers = 0, heads = 0, hdim = 0;
+  uint64_t slot_bytes = 0;  // == layers * 2 * page * heads * hdim * 4
+};
+
+inline bool GeomValid(const SpillGeom& g) {
+  if (g.page < 1 || g.page > kMaxPage) return false;
+  if (g.layers < 1 || g.layers > kMaxLayers) return false;
+  if (g.heads < 1 || g.heads > kMaxHeads) return false;
+  if (g.hdim < 1 || g.hdim > kMaxHdim) return false;
+  const uint64_t want = uint64_t(g.layers) * 2 * g.page * g.heads *
+                        g.hdim * sizeof(float);
+  return g.slot_bytes == want;
+}
+
+inline uint64_t GeomElems(const SpillGeom& g) {
+  return uint64_t(g.layers) * 2 * g.page * g.heads * g.hdim;
+}
+
+inline void SerializeSpillHeader(const SpillGeom& g,
+                                 uint8_t out[kSpillHeaderBytes]) {
+  std::memset(out, 0, kSpillHeaderBytes);
+  PutU32(out + 0, kSpillMagic);
+  PutU32(out + 4, kSpillVersion);
+  PutU32(out + 8, g.page);
+  PutU32(out + 12, g.layers);
+  PutU32(out + 16, g.heads);
+  PutU32(out + 20, g.hdim);
+  PutU64(out + 24, g.slot_bytes);
+}
+
+inline ParseResult ParseSpillHeader(const uint8_t* data, size_t size,
+                                    SpillGeom* out) {
+  if (data == nullptr || size < kSpillHeaderBytes)
+    return ParseResult::kMalformed;
+  if (GetU32(data + 0) != kSpillMagic) return ParseResult::kMalformed;
+  if (GetU32(data + 4) != kSpillVersion) return ParseResult::kMalformed;
+  SpillGeom g;
+  g.page = GetU32(data + 8);
+  g.layers = GetU32(data + 12);
+  g.heads = GetU32(data + 16);
+  g.hdim = GetU32(data + 20);
+  g.slot_bytes = GetU64(data + 24);
+  if (!GeomValid(g)) return ParseResult::kMalformed;
+  *out = g;
+  return ParseResult::kOk;
+}
+
+// -------------------------------------------------------- hibernation
+struct HibGroup {
+  uint32_t kind = 0;
+  int64_t a = 0;   // kind 0: gid | kind 1: spill slot
+  uint64_t b = 0;  // kind 0: gen | kind 1: 0
+};
+
+struct HibRecord {
+  uint64_t hib_id = 0;
+  uint64_t len = 0;
+  std::vector<HibGroup> groups;
+};
+
+inline void SerializeHib(const HibRecord& r, std::vector<uint8_t>* out) {
+  out->assign(kHibHeaderBytes + r.groups.size() * kHibRecordBytes, 0);
+  uint8_t* p = out->data();
+  PutU32(p + 0, kHibMagic);
+  PutU32(p + 4, kHibVersion);
+  PutU64(p + 8, r.hib_id);
+  PutU64(p + 16, r.len);
+  PutU32(p + 24, uint32_t(r.groups.size()));
+  for (size_t i = 0; i < r.groups.size(); ++i) {
+    uint8_t* q = p + kHibHeaderBytes + i * kHibRecordBytes;
+    PutU32(q + 0, r.groups[i].kind);
+    PutI64(q + 8, r.groups[i].a);
+    PutU64(q + 16, r.groups[i].b);
+  }
+}
+
+inline ParseResult ParseHibBytes(const uint8_t* data, size_t size,
+                                 HibRecord* out) {
+  if (data == nullptr || size < kHibHeaderBytes)
+    return ParseResult::kMalformed;
+  if (GetU32(data + 0) != kHibMagic) return ParseResult::kMalformed;
+  if (GetU32(data + 4) != kHibVersion) return ParseResult::kMalformed;
+  const uint64_t len = GetU64(data + 16);
+  const uint32_t n = GetU32(data + 24);
+  if (len > kHibMaxLen || n > kHibMaxGroups)
+    return ParseResult::kMalformed;
+  if (GetU32(data + 28) != 0) return ParseResult::kMalformed;
+  // exact size BEFORE any record read (the r11 rule)
+  if (size != kHibHeaderBytes + size_t(n) * kHibRecordBytes)
+    return ParseResult::kMalformed;
+  HibRecord r;
+  r.hib_id = GetU64(data + 8);
+  r.len = len;
+  r.groups.resize(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    const uint8_t* q = data + kHibHeaderBytes + size_t(i) * kHibRecordBytes;
+    HibGroup& g = r.groups[i];
+    g.kind = GetU32(q + 0);
+    if (GetU32(q + 4) != 0) return ParseResult::kMalformed;
+    g.a = GetI64(q + 8);
+    g.b = GetU64(q + 16);
+    if (g.kind != kHibKindShared && g.kind != kHibKindSpilled)
+      return ParseResult::kMalformed;
+    if (g.a < 0) return ParseResult::kMalformed;
+    if (g.kind == kHibKindSpilled && g.b != 0)
+      return ParseResult::kMalformed;
+  }
+  out->groups.swap(r.groups);  // adopt only on full success
+  out->hib_id = r.hib_id;
+  out->len = r.len;
+  return ParseResult::kOk;
+}
+
+// ------------------------------------------------------ prefix persist
+struct PrefixRec {
+  uint32_t parent = kPrefixRootParent;  // index of an EARLIER record
+  std::vector<int64_t> toks;            // exactly `page` ids
+  std::vector<float> vals;              // exactly group_elems floats
+};
+
+inline uint64_t Fnv1a(const uint8_t* p, size_t n) {
+  uint64_t h = 0xcbf29ce484222325ull;
+  for (size_t i = 0; i < n; ++i) {
+    h ^= p[i];
+    h *= 0x100000001b3ull;
+  }
+  return h;
+}
+
+inline uint64_t PrefixRecordBytes(const SpillGeom& g) {
+  return 8 + uint64_t(g.page) * 8 + GeomElems(g) * 4 + 8;
+}
+
+inline void SerializePrefix(const std::vector<PrefixRec>& recs,
+                            const SpillGeom& g,
+                            std::vector<uint8_t>* out) {
+  const uint64_t rec_bytes = PrefixRecordBytes(g);
+  out->assign(kPrefixHeaderBytes + recs.size() * rec_bytes, 0);
+  uint8_t* p = out->data();
+  PutU32(p + 0, kPrefixMagic);
+  PutU32(p + 4, kPrefixVersion);
+  PutU32(p + 8, g.page);
+  PutU32(p + 12, g.layers);
+  PutU32(p + 16, g.heads);
+  PutU32(p + 20, g.hdim);
+  PutU32(p + 24, uint32_t(recs.size()));
+  for (size_t i = 0; i < recs.size(); ++i) {
+    uint8_t* q = p + kPrefixHeaderBytes + i * rec_bytes;
+    PutU32(q + 0, recs[i].parent);
+    PutU32(q + 4, g.page);
+    for (uint32_t t = 0; t < g.page; ++t)
+      PutI64(q + 8 + size_t(t) * 8, recs[i].toks[t]);
+    uint8_t* v = q + 8 + size_t(g.page) * 8;
+    for (uint64_t e = 0; e < GeomElems(g); ++e)
+      PutF32(v + e * 4, recs[i].vals[size_t(e)]);
+    PutU64(q + rec_bytes - 8, Fnv1a(q, size_t(rec_bytes) - 8));
+  }
+}
+
+inline ParseResult ParsePrefixBytes(const uint8_t* data, size_t size,
+                                    const SpillGeom& g,
+                                    std::vector<PrefixRec>* out) {
+  if (data == nullptr || size < kPrefixHeaderBytes || !GeomValid(g))
+    return ParseResult::kMalformed;
+  if (GetU32(data + 0) != kPrefixMagic) return ParseResult::kMalformed;
+  if (GetU32(data + 4) != kPrefixVersion) return ParseResult::kMalformed;
+  if (GetU32(data + 8) != g.page || GetU32(data + 12) != g.layers ||
+      GetU32(data + 16) != g.heads || GetU32(data + 20) != g.hdim)
+    return ParseResult::kMalformed;
+  const uint32_t count = GetU32(data + 24);
+  if (count > kPrefixMaxRecords) return ParseResult::kMalformed;
+  if (GetU32(data + 28) != 0) return ParseResult::kMalformed;
+  const uint64_t rec_bytes = PrefixRecordBytes(g);
+  // exact size BEFORE any record read (the r11 rule)
+  if (uint64_t(size) != kPrefixHeaderBytes + uint64_t(count) * rec_bytes)
+    return ParseResult::kMalformed;
+  std::vector<PrefixRec> recs(count);
+  for (uint32_t i = 0; i < count; ++i) {
+    const uint8_t* q = data + kPrefixHeaderBytes + size_t(i) * rec_bytes;
+    PrefixRec& r = recs[i];
+    r.parent = GetU32(q + 0);
+    if (r.parent != kPrefixRootParent && r.parent >= i)
+      return ParseResult::kMalformed;
+    if (GetU32(q + 4) != g.page) return ParseResult::kMalformed;
+    if (GetU64(q + rec_bytes - 8) != Fnv1a(q, size_t(rec_bytes) - 8))
+      return ParseResult::kMalformed;
+    r.toks.resize(g.page);
+    for (uint32_t t = 0; t < g.page; ++t)
+      r.toks[t] = GetI64(q + 8 + size_t(t) * 8);
+    const uint8_t* v = q + 8 + size_t(g.page) * 8;
+    r.vals.resize(size_t(GeomElems(g)));
+    for (uint64_t e = 0; e < GeomElems(g); ++e)
+      r.vals[size_t(e)] = GetF32(v + e * 4);
+  }
+  out->swap(recs);  // adopt only on full success
+  return ParseResult::kOk;
+}
+
+// --------------------------------------------------------- slot store
+// Rank 28: strictly under kv.pool (25) — KvPool spill/restore paths
+// call in while holding the pool lock — and above nothing (SpillFile
+// never calls out, so no lock ever nests inside kv.spill).
+PTPU_LOCK_CLASS(kLockKvSpill, "kv.spill", 28);
+
+class SpillFile {
+ public:
+  struct Stats {
+    bool attached = false;
+    uint64_t slots_total = 0, slots_in_use = 0, bytes_mapped = 0;
+    uint64_t writes = 0, reads = 0, header_rejects = 0, exhausted = 0;
+  };
+
+  SpillFile() = default;
+  SpillFile(const SpillFile&) = delete;
+  SpillFile& operator=(const SpillFile&) = delete;
+  ~SpillFile() { Detach(); }
+
+  // Open-or-create the slot store at `path`. A pre-existing file is
+  // ALWAYS reset (slot content is per-process scratch) but a
+  // malformed pre-existing header is counted first — detection over
+  // silent overwrite. max_bytes==0 means unbounded.
+  bool Attach(const std::string& path, uint64_t max_bytes,
+              const SpillGeom& geom, std::string* err) {
+    ptpu::MutexLock l(mu_);
+    if (fd_ >= 0) {
+      *err = "spill: already attached to " + path_;
+      return false;
+    }
+    if (!GeomValid(geom)) {
+      *err = "spill: invalid geometry";
+      return false;
+    }
+    const int fd = ::open(path.c_str(), O_RDWR | O_CREAT | O_CLOEXEC,
+                          0600);
+    if (fd < 0) {
+      *err = "spill: cannot open " + path;
+      return false;
+    }
+    uint8_t hdr[kSpillHeaderBytes];
+    const ssize_t got = ::pread(fd, hdr, sizeof hdr, 0);
+    if (got > 0) {
+      SpillGeom old;
+      if (ParseSpillHeader(hdr, size_t(got), &old) != ParseResult::kOk)
+        ++header_rejects_;
+    }
+    uint8_t fresh[kSpillHeaderBytes];
+    SerializeSpillHeader(geom, fresh);
+    if (::ftruncate(fd, off_t(kSpillHeaderReserve)) != 0 ||
+        ::pwrite(fd, fresh, sizeof fresh, 0) !=
+            ssize_t(sizeof fresh)) {
+      ::close(fd);
+      *err = "spill: cannot initialize " + path;
+      return false;
+    }
+    fd_ = fd;
+    path_ = path;
+    geom_ = geom;
+    max_bytes_ = max_bytes;
+    // chunk size rounded UP to a page multiple so every chunk's file
+    // offset stays mmap-alignable as the file grows
+    chunk_bytes_ = uint64_t(kSpillChunkSlots) * geom_.slot_bytes;
+    chunk_bytes_ = (chunk_bytes_ + kSpillHeaderReserve - 1) /
+                   kSpillHeaderReserve * kSpillHeaderReserve;
+    return true;
+  }
+
+  bool attached() const {
+    ptpu::MutexLock l(mu_);
+    return fd_ >= 0;
+  }
+
+  // -1 when the store is detached, the byte cap is reached, or the
+  // filesystem refuses growth — the caller surfaces all three as the
+  // soft retryable "kv spill exhausted" error.
+  int64_t Alloc() {
+    ptpu::MutexLock l(mu_);
+    if (fd_ < 0) return -1;
+    if (free_.empty()) {
+      const uint64_t grown =
+          kSpillHeaderReserve + (chunks_.size() + 1) * chunk_bytes_;
+      if (max_bytes_ > 0 && grown > max_bytes_) {
+        ++exhausted_;
+        return -1;
+      }
+      if (::ftruncate(fd_, off_t(grown)) != 0) {
+        ++exhausted_;
+        return -1;
+      }
+      void* m = ::mmap(nullptr, size_t(chunk_bytes_),
+                       PROT_READ | PROT_WRITE, MAP_SHARED, fd_,
+                       off_t(kSpillHeaderReserve +
+                             chunks_.size() * chunk_bytes_));
+      if (m == MAP_FAILED) {
+        ++exhausted_;
+        return -1;
+      }
+      const int64_t base = int64_t(chunks_.size()) * kSpillChunkSlots;
+      chunks_.push_back(static_cast<uint8_t*>(m));
+      for (int64_t s = base + kSpillChunkSlots; s-- > base;)
+        free_.push_back(s);
+    }
+    const int64_t slot = free_.back();
+    free_.pop_back();
+    return slot;
+  }
+
+  void Free(int64_t slot) {
+    ptpu::MutexLock l(mu_);
+    if (slot < 0 || slot >= int64_t(chunks_.size()) * kSpillChunkSlots)
+      return;
+    free_.push_back(slot);
+  }
+
+  bool Write(int64_t slot, const float* src, size_t n) {
+    ptpu::MutexLock l(mu_);
+    uint8_t* p = slot_ptr_locked(slot, n);
+    if (p == nullptr) return false;
+    std::memcpy(p, src, n * sizeof(float));
+    ++writes_;
+    drop_slot_pages_locked(slot);
+    return true;
+  }
+
+  bool Read(int64_t slot, float* dst, size_t n) {
+    ptpu::MutexLock l(mu_);
+    uint8_t* p = slot_ptr_locked(slot, n);
+    if (p == nullptr) return false;
+    std::memcpy(dst, p, n * sizeof(float));
+    ++reads_;
+    drop_slot_pages_locked(slot);
+    return true;
+  }
+
+  // munmap + close; the file itself is LEFT on disk (per-machine
+  // scratch, safe to delete any time — see MIGRATION.md)
+  void Detach() {
+    ptpu::MutexLock l(mu_);
+    for (uint8_t* m : chunks_) ::munmap(m, size_t(chunk_bytes_));
+    chunks_.clear();
+    free_.clear();
+    if (fd_ >= 0) ::close(fd_);
+    fd_ = -1;
+    path_.clear();
+  }
+
+  Stats Snapshot() const {
+    ptpu::MutexLock l(mu_);
+    Stats st;
+    st.attached = fd_ >= 0;
+    st.slots_total = chunks_.size() * uint64_t(kSpillChunkSlots);
+    st.slots_in_use = st.slots_total - free_.size();
+    st.bytes_mapped = chunks_.size() * chunk_bytes_;
+    st.writes = writes_;
+    st.reads = reads_;
+    st.header_rejects = header_rejects_;
+    st.exhausted = exhausted_;
+    return st;
+  }
+
+ private:
+  // Dirty MAP_SHARED pages count against this process's RSS until
+  // writeback, and the whole point of the spill tier is to BOUND
+  // resident memory — so after every slot copy the covering pages are
+  // dropped back to the page cache.  MADV_DONTNEED on a shared file
+  // mapping never loses data (the mapped pages ARE the page cache;
+  // a later access merely re-faults them in), and neighbouring slots
+  // sharing an edge page pay only that re-fault.  chunk_bytes_ is a
+  // page multiple, so the rounded-up end never leaves the mapping.
+  void drop_slot_pages_locked(int64_t slot) {
+    static const uintptr_t kPg = uintptr_t(::sysconf(_SC_PAGESIZE));
+    uint8_t* chunk = chunks_[size_t(slot / kSpillChunkSlots)];
+    const uint64_t off =
+        uint64_t(slot % kSpillChunkSlots) * geom_.slot_bytes;
+    const uintptr_t beg = (uintptr_t(chunk) + off) & ~(kPg - 1);
+    const uintptr_t end =
+        (uintptr_t(chunk) + off + geom_.slot_bytes + kPg - 1) &
+        ~(kPg - 1);
+    ::madvise(reinterpret_cast<void*>(beg), size_t(end - beg),
+              MADV_DONTNEED);
+  }
+
+  uint8_t* slot_ptr_locked(int64_t slot, size_t n) {
+    if (fd_ < 0 || slot < 0 ||
+        slot >= int64_t(chunks_.size()) * kSpillChunkSlots ||
+        n * sizeof(float) > geom_.slot_bytes)
+      return nullptr;
+    return chunks_[size_t(slot / kSpillChunkSlots)] +
+           uint64_t(slot % kSpillChunkSlots) * geom_.slot_bytes;
+  }
+
+  int fd_ = -1;
+  std::string path_;
+  SpillGeom geom_;
+  uint64_t max_bytes_ = 0;
+  uint64_t chunk_bytes_ = 0;
+  std::vector<uint8_t*> chunks_;
+  std::vector<int64_t> free_;
+  uint64_t writes_ = 0, reads_ = 0, header_rejects_ = 0, exhausted_ = 0;
+  mutable ptpu::Mutex mu_{kLockKvSpill};
+};
+
+}  // namespace spill
+}  // namespace ptpu
+
+#endif  // PTPU_SPILL_H_

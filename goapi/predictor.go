@@ -527,6 +527,114 @@ func (k *KvPool) StatsJSON() string {
 	return s
 }
 
+// SpillAttach attaches the mmap'd spill tier at path (r19).
+// maxBytes < 0 resolves $PTPU_KV_SPILL_MAX_BYTES (default 1 GiB);
+// 0 is unbounded. The file is per-machine scratch — safe to delete.
+func (k *KvPool) SpillAttach(path string, maxBytes int64) error {
+	cs := C.CString(path)
+	defer C.free(unsafe.Pointer(cs))
+	buf := make([]C.char, errLen)
+	rc := C.ptpu_kvpool_spill_attach(k.p, cs, C.int64_t(maxBytes),
+		&buf[0], errLen)
+	runtime.KeepAlive(k)
+	if rc != 0 {
+		return lastErr(buf)
+	}
+	return nil
+}
+
+// Hibernate serializes a session into the spill tier, freeing its
+// pool slot + sole-owner pages, and returns the opaque record the
+// pool cross-validates on Restore. The retryable "kv spill
+// exhausted" error leaves the session untouched.
+func (k *KvPool) Hibernate(sid int) ([]byte, error) {
+	buf := make([]C.char, errLen)
+	need := int64(C.ptpu_kvpool_hibernate(k.p, C.int(sid), nil, 0,
+		&buf[0], errLen))
+	if need < 0 {
+		runtime.KeepAlive(k)
+		return nil, lastErr(buf)
+	}
+	rec := make([]byte, need)
+	got := int64(C.ptpu_kvpool_hibernate(k.p, C.int(sid),
+		(*C.uint8_t)(unsafe.Pointer(&rec[0])), C.int64_t(need),
+		&buf[0], errLen))
+	runtime.KeepAlive(k)
+	if got < 0 {
+		return nil, lastErr(buf)
+	}
+	return rec[:got], nil
+}
+
+// Restore re-opens a hibernated session from its record; the
+// retryable "kv pool exhausted" error keeps the record valid.
+func (k *KvPool) Restore(rec []byte) (int, error) {
+	if len(rec) == 0 {
+		return -1, errors.New("Restore: empty record")
+	}
+	buf := make([]C.char, errLen)
+	sid := int(C.ptpu_kvpool_restore(k.p,
+		(*C.uint8_t)(unsafe.Pointer(&rec[0])), C.int64_t(len(rec)),
+		&buf[0], errLen))
+	runtime.KeepAlive(k)
+	runtime.KeepAlive(rec)
+	if sid == -1 {
+		return -1, errors.New("Restore: no session slots")
+	}
+	if sid < 0 {
+		return -1, lastErr(buf)
+	}
+	return sid, nil
+}
+
+// HibernateDrop releases a hibernated session's spill state without
+// restoring it (the CloseSession of the tiered world).
+func (k *KvPool) HibernateDrop(rec []byte) {
+	if len(rec) == 0 {
+		return
+	}
+	C.ptpu_kvpool_hibernate_drop(k.p,
+		(*C.uint8_t)(unsafe.Pointer(&rec[0])), C.int64_t(len(rec)))
+	runtime.KeepAlive(k)
+	runtime.KeepAlive(rec)
+}
+
+// Hibernated is the count of sessions parked in the spill tier.
+func (k *KvPool) Hibernated() int64 {
+	n := int64(C.ptpu_kvpool_hibernated(k.p))
+	runtime.KeepAlive(k)
+	return n
+}
+
+// PrefixSave persists the content-addressed prefix cache to path
+// (tmp+rename); returns records written.
+func (k *KvPool) PrefixSave(path string) (int64, error) {
+	cs := C.CString(path)
+	defer C.free(unsafe.Pointer(cs))
+	buf := make([]C.char, errLen)
+	n := int64(C.ptpu_kvpool_prefix_save(k.p, cs, &buf[0], errLen))
+	runtime.KeepAlive(k)
+	if n < 0 {
+		return 0, lastErr(buf)
+	}
+	return n, nil
+}
+
+// PrefixLoad warms the prefix cache from a PrefixSave file; returns
+// pages adopted. A missing/malformed/stale file loads 0 pages (the
+// cache can only miss, never serve wrong KV).
+func (k *KvPool) PrefixLoad(path string) (int64, error) {
+	cs := C.CString(path)
+	defer C.free(unsafe.Pointer(cs))
+	buf := make([]C.char, errLen)
+	n := int64(C.ptpu_kvpool_prefix_load(k.p, cs, &buf[0], errLen))
+	runtime.KeepAlive(k)
+	if n < 0 {
+		return 0, lastErr(buf)
+	}
+	return n, nil
+}
+
 // StatsJSON returns the predictor's serving stats snapshot (always-on
 // per-op calls/time/bytes + per-run latency histogram) as the JSON
 // string ptpu_predictor_stats_json renders — unmarshal with

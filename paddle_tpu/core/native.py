@@ -807,6 +807,32 @@ def _predictor_lib() -> ctypes.CDLL:
             lib._ptpu_has_tune = True
         except AttributeError:   # stale prebuilt .so: autotune off
             lib._ptpu_has_tune = False
+        try:
+            # KV tiering + session hibernation ABI (r19)
+            lib.ptpu_kvpool_spill_attach.restype = c.c_int
+            lib.ptpu_kvpool_spill_attach.argtypes = [
+                c.c_void_p, c.c_char_p, c.c_int64, c.c_char_p, c.c_int]
+            lib.ptpu_kvpool_hibernate.restype = c.c_int64
+            lib.ptpu_kvpool_hibernate.argtypes = [
+                c.c_void_p, c.c_int, c.POINTER(c.c_uint8), c.c_int64,
+                c.c_char_p, c.c_int]
+            lib.ptpu_kvpool_restore.restype = c.c_int
+            lib.ptpu_kvpool_restore.argtypes = [
+                c.c_void_p, c.POINTER(c.c_uint8), c.c_int64,
+                c.c_char_p, c.c_int]
+            lib.ptpu_kvpool_hibernate_drop.argtypes = [
+                c.c_void_p, c.POINTER(c.c_uint8), c.c_int64]
+            lib.ptpu_kvpool_hibernated.restype = c.c_int64
+            lib.ptpu_kvpool_hibernated.argtypes = [c.c_void_p]
+            lib.ptpu_kvpool_prefix_save.restype = c.c_int64
+            lib.ptpu_kvpool_prefix_save.argtypes = [
+                c.c_void_p, c.c_char_p, c.c_char_p, c.c_int]
+            lib.ptpu_kvpool_prefix_load.restype = c.c_int64
+            lib.ptpu_kvpool_prefix_load.argtypes = [
+                c.c_void_p, c.c_char_p, c.c_char_p, c.c_int]
+            lib._ptpu_has_spill = True
+        except AttributeError:   # stale prebuilt .so: tiering off
+            lib._ptpu_has_spill = False
         # Wire the host profiler (csrc/ptpu_runtime.cc, a separate .so)
         # into the predictor: per-op RecordEvent spans when profiling
         # is on, so serving runs land in the same chrome trace as
@@ -1161,6 +1187,99 @@ class KvPool:
         return json.loads(
             self._lib.ptpu_kvpool_stats_json(self._handle()).decode())
 
+    # ---- KV tiering + session hibernation (r19) ----
+    def _spill_abi(self):
+        if not getattr(self._lib, "_ptpu_has_spill", False):
+            raise RuntimeError(
+                "KV tiering needs the r19 ABI (stale "
+                "_native_predictor.so: delete it and re-import)")
+        return self._lib
+
+    def spill_attach(self, path: str, max_bytes: int = -1) -> None:
+        """Attach the mmap'd spill tier at ``path``. ``max_bytes`` < 0
+        resolves ``$PTPU_KV_SPILL_MAX_BYTES`` (default 1 GiB); 0 is
+        unbounded. The file is per-machine scratch — safe to delete
+        between runs."""
+        lib = self._spill_abi()
+        if lib.ptpu_kvpool_spill_attach(
+                self._handle(), path.encode(), max_bytes, self._err,
+                512) != 0:
+            raise RuntimeError("spill_attach: " +
+                               self._err.value.decode())
+
+    def hibernate(self, sid: int) -> bytes:
+        """Serialize session ``sid`` into the spill tier and free its
+        pool slot + sole-owner pages. Returns the opaque record —
+        a handle cross-validated by the pool on :meth:`restore`, not a
+        capability. Raises the retryable ``kv spill exhausted`` error
+        when the spill file is full (record untouched)."""
+        c = ctypes
+        lib = self._spill_abi()
+        need = lib.ptpu_kvpool_hibernate(
+            self._handle(), sid, None, 0, self._err, 512)
+        if need < 0:
+            raise RuntimeError("hibernate: " + self._err.value.decode())
+        buf = (c.c_uint8 * int(need))()
+        got = lib.ptpu_kvpool_hibernate(
+            self._handle(), sid, buf, need, self._err, 512)
+        if got < 0:
+            raise RuntimeError("hibernate: " + self._err.value.decode())
+        return bytes(buf[:int(got)])
+
+    def restore(self, record: bytes) -> int:
+        """Re-open a hibernated session from its record; returns the
+        new session id. Raises the retryable ``kv pool exhausted``
+        error under page pressure (record stays valid) and -1 becomes
+        a ``no session slots`` error."""
+        c = ctypes
+        lib = self._spill_abi()
+        buf = (c.c_uint8 * len(record)).from_buffer_copy(record)
+        sid = lib.ptpu_kvpool_restore(self._handle(), buf, len(record),
+                                      self._err, 512)
+        if sid == -1:
+            raise RuntimeError("restore: no session slots")
+        if sid < 0:
+            raise RuntimeError("restore: " + self._err.value.decode())
+        return int(sid)
+
+    def hibernate_drop(self, record: bytes) -> None:
+        """Release a hibernated session's spill state without
+        restoring it (the close() of the tiered world)."""
+        c = ctypes
+        lib = self._spill_abi()
+        buf = (c.c_uint8 * len(record)).from_buffer_copy(record)
+        lib.ptpu_kvpool_hibernate_drop(self._handle(), buf,
+                                       len(record))
+
+    def hibernated(self) -> int:
+        """Sessions currently parked in the spill tier."""
+        return int(self._spill_abi().ptpu_kvpool_hibernated(
+            self._handle()))
+
+    def prefix_save(self, path: str) -> int:
+        """Persist the content-addressed prefix cache to ``path``
+        (tmp+rename); returns records written."""
+        lib = self._spill_abi()
+        n = lib.ptpu_kvpool_prefix_save(self._handle(), path.encode(),
+                                        self._err, 512)
+        if n < 0:
+            raise RuntimeError("prefix_save: " +
+                               self._err.value.decode())
+        return int(n)
+
+    def prefix_load(self, path: str) -> int:
+        """Warm the prefix cache from a :meth:`prefix_save` file;
+        returns pages adopted into the cache. A missing/malformed/
+        stale file loads 0 pages (the cache can only miss, never
+        serve wrong KV)."""
+        lib = self._spill_abi()
+        n = lib.ptpu_kvpool_prefix_load(self._handle(), path.encode(),
+                                        self._err, 512)
+        if n < 0:
+            raise RuntimeError("prefix_load: " +
+                               self._err.value.decode())
+        return int(n)
+
     def close(self) -> None:
         if getattr(self, "_h", None):
             self._lib.ptpu_kvpool_destroy(self._h)
@@ -1308,6 +1427,10 @@ ABI_SYMBOLS = {
         "ptpu_kvpool_open", "ptpu_kvpool_fork", "ptpu_kvpool_close",
         "ptpu_kvpool_len", "ptpu_kvpool_adopt", "ptpu_kvpool_publish",
         "ptpu_kvpool_trim", "ptpu_kvpool_stats_json",
+        "ptpu_kvpool_spill_attach", "ptpu_kvpool_hibernate",
+        "ptpu_kvpool_restore", "ptpu_kvpool_hibernate_drop",
+        "ptpu_kvpool_hibernated", "ptpu_kvpool_prefix_save",
+        "ptpu_kvpool_prefix_load",
         "ptpu_serving_start", "ptpu_serving_start2",
         "ptpu_serving_start3", "ptpu_serving_start4",
         "ptpu_serving_port",

@@ -1,20 +1,23 @@
 """decode_bench `--out` persistence contract (ISSUE r9 satellite,
 schema extended for the r12 paged engine, the r13 speculative A/B
-leg, and the r16 int4/autotune legs; pattern of
-tests/test_serving_bench_persist.py).
+leg, the r16 int4/autotune legs, and the r19 KV-tiering legs; pattern
+of tests/test_serving_bench_persist.py).
 
 Runs `tools/decode_bench.py --smoke` as a subprocess with a shrunken
 config (2 sessions, 6 tokens, context 32, decode batch 2, a 12-session
-ramp, a 4-open prefix A/B, a barely-trained spec leg), asserts the
-persisted JSON schema, the parity rows — the exact paged-vs-fixed gate
-AND the spec greedy byte-parity row — the server-vs-client decode
-counter exactness, the ramp/prefix measurement columns, and the
-speculative A/B columns (accept rate, tokens/round, per-round
-tokens/s, seeded-sampling determinism). Throughput/accept gates are
-NOT asserted: a smoke config neither amortizes the wire round trip nor
-trains the models into agreement the way the committed BENCH_DECODE
-run does — but the EXACTNESS rows (greedy parity, determinism) must
-hold at any scale.
+ramp, a 4-open prefix A/B, a barely-trained spec leg, a 60-session
+hibernation park), asserts the persisted JSON schema, the parity rows
+— the exact paged-vs-fixed gate AND the spec greedy byte-parity row —
+the server-vs-client decode counter exactness, the ramp/prefix
+measurement columns, the speculative A/B columns (accept rate,
+tokens/round, per-round tokens/s, seeded-sampling determinism), and
+the r19 kvtier rows (gauge-exact session parking, spill-round-trip
+logits exactness, restart-warm prefix adoption). Throughput/accept
+gates are NOT asserted: a smoke config neither amortizes the wire
+round trip nor trains the models into agreement the way the committed
+BENCH_DECODE run does — but the EXACTNESS rows (greedy parity,
+determinism, hibernate round trips, pool gauges) must hold at any
+scale.
 """
 import json
 import os
@@ -32,6 +35,7 @@ def bench_out(tmp_path_factory):
     d = tmp_path_factory.mktemp("decb")
     out = str(d / "BENCH_DECODE.json")
     i4out = str(d / "BENCH_INT4.json")
+    ktout = str(d / "BENCH_KVTIER.json")
     env = dict(os.environ)
     env.update({
         "JAX_PLATFORMS": "cpu",
@@ -48,7 +52,9 @@ def bench_out(tmp_path_factory):
          "12", "--spec-train-steps", "8", "--spec-rounds", "2",
          "--spec-sample-opens", "8", "--int4-tokens", "12",
          "--int4-rounds", "2", "--tune-reps", "6",
-         "--int4-out", i4out],
+         "--int4-out", i4out, "--kvtier-sessions", "60",
+         "--kvtier-resume-samples", "16", "--kvtier-ab-tokens", "6",
+         "--kvtier-ab-rounds", "2", "--kvtier-out", ktout],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
     assert r.returncode == 0, r.stderr[-2000:]
     with open(out) as f:
@@ -56,6 +62,8 @@ def bench_out(tmp_path_factory):
     data["_stderr"] = r.stderr[-2000:]
     with open(i4out) as f:
         data["_int4_out"] = json.load(f)
+    with open(ktout) as f:
+        data["_kvtier_out"] = json.load(f)
     return data
 
 
@@ -199,6 +207,64 @@ class TestDecodeBenchPersist:
         assert warm["warm_probes"] == 0
         assert warm["warm_probe_us"] == 0
         assert warm["warm_file_entries"] == warm["cold_probes"]
+
+    def test_kvtier_rows(self, bench_out):
+        """r19 schema: the parking row's gauges must be EXACT at any
+        scale (the bounded-RSS claim is a gauge claim), the spill
+        round trip must be bit-identical, and the restart-warm first
+        open must adopt at least the pre-restart steady state.  The
+        RSS bound and the tier-OFF throughput guard are full-run
+        gates, only recorded here."""
+        by = {r["metric"]: r for r in bench_out["measurements"]}
+        park = by["kvtier_sessions_parked"]
+        assert park["value"] >= 60
+        assert park["gauges_exact"] is True, bench_out["_stderr"]
+        assert (park["sessions_resident"] +
+                park["sessions_hibernated"]) == park["value"]
+        assert park["sessions_hibernated"] > \
+            10 * park["sessions_resident"]
+        # the pool's page slab never grows with the population (its
+        # constant 64-page cost only UNDERCUTS the naive all-resident
+        # cost at scale, so the full run gates that ratio, not this
+        # smoke); the spill file is what carries the population
+        assert park["pool_pages_total"] == 64
+        assert park["naive_resident_mb"] > 0
+        assert park["spill_file_mb"] > 0
+        assert park["spill_slots_in_use"] == \
+            park["sessions_hibernated"]
+        lat = by["kvtier_resume_latency_us"]
+        assert lat["samples"] == 16
+        assert 0 < lat["p50_us"] <= lat["p99_us"] <= lat["max_us"]
+        assert by["kvtier_restore_logits_exact"]["value"] is True, \
+            bench_out["_stderr"]
+        warm = by["kvtier_prefix_restart_warm"]
+        assert warm["value"] is True, bench_out["_stderr"]
+        assert warm["adopted_cold_first_open"] == 0
+        assert warm["adopted_post_restart_first_open"] >= \
+            warm["adopted_pre_restart_warm"] > 0
+        assert warm["hit_rate_post_restart"] >= warm["hit_rate_pre"]
+        guard = by["kvtier_tier_off_guard"]
+        assert guard["tier_on_tokens_per_s"] > 0
+        assert guard["tier_off_tokens_per_s"] > 0
+        assert len(guard["per_round_on"]) == 2
+        assert len(guard["per_round_off"]) == 2
+        assert guard["hibernates_while_attached_idle"] == 0
+        assert guard["acceptance_gate"] == 0.90
+
+    def test_kvtier_out_file(self, bench_out):
+        """--kvtier-out persists just the kvtier rows (the
+        BENCH_KVTIER_r01.json artifact) alongside the main --out
+        file."""
+        kt = bench_out["_kvtier_out"]
+        assert kt["bench"] == "kvtier_bench"
+        metrics = {r["metric"] for r in kt["measurements"]}
+        assert {"kvtier_sessions_parked", "kvtier_resume_latency_us",
+                "kvtier_restore_logits_exact",
+                "kvtier_prefix_restart_warm",
+                "kvtier_tier_off_guard"} <= metrics
+        assert all(r["metric"].startswith("kvtier_")
+                   for r in kt["measurements"])
+        assert kt["host"]["nproc"] == (os.cpu_count() or 1)
 
     def test_int4_out_file(self, bench_out):
         """--int4-out persists just the int4/autotune rows (the

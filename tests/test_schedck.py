@@ -80,7 +80,7 @@ def test_fixture_rediscovery_is_deterministic(name):
 
 
 def test_selftest_scenarios_green():
-    """Engine unit tests + all thirteen production-protocol scenarios:
+    """Engine unit tests + all fourteen production-protocol scenarios:
     DFS-exhaustive small configs, PCT sweep large ones (budget via
     PTPU_SCHEDCK_SCHEDULES; the default 300 keeps tier-1 fast — the
     run_checks.sh leg sweeps 10000)."""
@@ -88,7 +88,7 @@ def test_selftest_scenarios_green():
     r = _run(path)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "all native schedck unit tests passed" in r.stdout
-    assert len(re.findall(r"\(exhaustive\)", r.stdout)) == 13, \
+    assert len(re.findall(r"\(exhaustive\)", r.stdout)) == 14, \
         "every scenario's small config must exhaust its DFS space"
 
 

@@ -910,3 +910,216 @@ class TestSpeculativeDecode:
                 self._server(mlp_artifact, dec, ver, drf)
         finally:
             del os.environ["PTPU_KV_PAGED"]
+
+
+class TestKvTiering:
+    """ISSUE 19 tentpole: KV-cache tiering + session hibernation —
+    spill idle sessions to the mmap'd disk tier, restore them
+    transparently on the next step, persist the prefix-adopt index
+    across restarts. Python-chain twins of csrc's
+    test_kvpool_spill_hibernate, on the REAL GPT export."""
+
+    def test_hibernate_restore_logits_exact(self, decode_artifacts,
+                                            tmp_path):
+        """Pool-level round trip: a hibernated-then-restored session
+        continues its history with logits BIT-IDENTICAL to an
+        uninterrupted twin; a corrupted record is rejected whole (the
+        sleeping session survives); drop releases without restore."""
+        from paddle_tpu.core.native import KvPool, NativePredictor
+
+        dec, _ = decode_artifacts
+        pool = KvPool(pool_tokens=16 * 48, page_tokens=16,
+                      max_sessions=8)
+        p = NativePredictor(dec, batch_override=1)
+        p.kv_attach(pool)
+        pool.spill_attach(str(tmp_path / "spill.bin"))
+
+        def feed(sid, toks):
+            out = None
+            for t in toks:
+                out = p.decode_step([sid], [t]).copy()
+            return out
+
+        hist = list(range(3, 23))          # 20 tokens: page + 4
+        a = pool.open()
+        feed(a, hist)
+        rec = pool.hibernate(a)
+        assert len(rec) > 0
+        assert pool.hibernated() == 1
+        assert pool.len(a) == -1           # the pool slot is gone
+        # a flipped byte rejects WHOLE — and the record stays usable
+        bad = bytearray(rec)
+        bad[len(bad) // 2] ^= 0x40
+        with pytest.raises(RuntimeError, match="corrupt"):
+            pool.restore(bytes(bad))
+        assert pool.hibernated() == 1
+        a2 = pool.restore(rec)
+        assert pool.hibernated() == 0
+        assert pool.len(a2) == 20
+        got = feed(a2, [40, 41])
+        want = feed(pool.open(), hist + [40, 41])
+        assert np.array_equal(got, want)
+        st = pool.stats()
+        assert st["hibernates"] == 1
+        assert st["restores"] == 1
+        assert st["spill_attached"] == 1
+        assert st["spill_writes"] >= 1 and st["spill_reads"] >= 1
+        # drop: the spill state releases without a restore
+        b = pool.open()
+        feed(b, [1, 2, 3])
+        rec2 = pool.hibernate(b)
+        assert pool.hibernated() == 1
+        pool.hibernate_drop(rec2)
+        assert pool.hibernated() == 0
+        assert pool.stats()["hib_drops"] == 1
+        p.close()
+        pool.close()
+
+    def test_server_hibernates_instead_of_evicting(
+            self, decode_artifacts, mlp_artifact, tmp_path):
+        """With PTPU_KV_SPILL_PATH set, session-table pressure
+        hibernates the LRU session instead of tombstone-evicting it,
+        and the next step on the sleeping session transparently
+        restores it — logits exactly as if it never left RAM."""
+        from paddle_tpu import inference
+        from paddle_tpu.core.native import NativePredictor
+
+        dec, _ = decode_artifacts
+        os.environ["PTPU_KV_SPILL_PATH"] = str(tmp_path / "sv.spill")
+        os.environ["PTPU_KV_SESSIONS"] = "3"
+        try:
+            srv = inference.create_server(mlp_artifact, max_batch=2,
+                                          instances=1, decode_model=dec)
+        finally:
+            del os.environ["PTPU_KV_SPILL_PATH"]
+            del os.environ["PTPU_KV_SESSIONS"]
+        try:
+            cli = srv.client()
+            toks = list(range(3, 9))
+            sa = cli.decode_open()
+            got = [np.asarray(cli.decode_step(sa, t)).copy()
+                   for t in toks[:5]]
+            # fill the 3-slot table: sa (the LRU) must hibernate, not
+            # tombstone
+            others = [cli.decode_open() for _ in range(3)]
+            st = srv.stats()["decode"]
+            assert st["hibernates"] >= 1
+            assert st["evictions"] == 0
+            assert st["sessions_hibernated"] >= 1
+            assert (st["sessions_resident"]
+                    + st["sessions_hibernated"]) == 4
+            # the hibernated session answers its next step as if it
+            # never left (transparent restore, not 'evicted')
+            got.append(np.asarray(cli.decode_step(sa, toks[5])).copy())
+            st = srv.stats()["decode"]
+            assert st["restores"] >= 1
+            assert st["restore_us"]["count"] >= 1
+            with NativePredictor(dec, batch_override=1) as ref:
+                ref.kv_plan(2)
+                rs = ref.kv_open()
+                want = [ref.decode_step([rs], [t]).copy()[0]
+                        for t in toks]
+            for g, w in zip(got, want):
+                assert np.array_equal(g, w)
+            for s in [sa] + others:
+                cli.decode_close(s)
+            cli.close()
+        finally:
+            srv.stop()
+
+    def test_spec_session_hibernate_restore_planes(
+            self, spec_artifacts, mlp_artifact, tmp_path):
+        """A speculative session hibernates BOTH planes (target +
+        draft twin) and restores them together: the greedy stream
+        across the sleep equals the non-speculative reference, and
+        the plane guards survive the round trip."""
+        from paddle_tpu import inference
+        from paddle_tpu.inference.serving import ServingError
+
+        dec, ver, drf = spec_artifacts
+        os.environ["PTPU_KV_SPILL_PATH"] = str(tmp_path / "spec.spill")
+        try:
+            srv = inference.create_server(mlp_artifact, max_batch=2,
+                                          instances=1, decode_model=dec,
+                                          spec_model=drf,
+                                          spec_verify_model=ver,
+                                          kv_sessions=2)
+        finally:
+            del os.environ["PTPU_KV_SPILL_PATH"]
+        try:
+            cli = srv.client()
+            prompt = [7, 3, 11, 2]
+            N = 12
+            s0, lg, _ = cli.decode_open(prompt=prompt)
+            ref = [int(np.argmax(lg))]
+            while len(ref) < N:
+                ref.append(int(np.argmax(cli.decode_step(s0, ref[-1]))))
+            cli.decode_close(s0)
+            s1, toks, _ = cli.spec_open(prompt)
+            out = list(toks)
+            t, _ = cli.spec_step(s1)
+            out.extend(t)
+            # churn the 2-slot table: the idle spec session sleeps
+            s2 = cli.decode_open()
+            s3 = cli.decode_open()
+            assert srv.stats()["decode"]["hibernates"] >= 1
+            # next round transparently restores target AND draft
+            while len(out) < N:
+                t, _ = cli.spec_step(s1)
+                out.extend(t)
+            assert out[:N] == ref
+            st = srv.stats()["decode"]
+            assert st["restores"] >= 1
+            assert st["evictions"] == 0
+            # spec linkage survived the sleep: plane guard intact
+            with pytest.raises(ServingError,
+                               match="use DECODE_SPEC_STEP"):
+                cli.decode_step(s1, 1)
+            for s in (s1, s2, s3):
+                cli.decode_close(s)
+            cli.close()
+        finally:
+            srv.stop()
+
+    def test_prefix_persist_restart_warm(self, decode_artifacts,
+                                         mlp_artifact, tmp_path):
+        """PTPU_KV_PREFIX_PERSIST survives a server restart: the
+        second server adopts the full prompt pages cold-start (hit
+        rate >= pre-restart) and serves byte-identical logits —
+        the warmed cache can only miss, never serve wrong KV."""
+        from paddle_tpu import inference
+
+        dec, _ = decode_artifacts
+        pp = str(tmp_path / "prefix.bin")
+        prompt = list(range(5, 41))        # 36 tokens = 2 full pages
+        os.environ["PTPU_KV_PREFIX_PERSIST"] = pp
+        try:
+            srv = inference.create_server(mlp_artifact, max_batch=2,
+                                          instances=1, decode_model=dec)
+            try:
+                cli = srv.client()
+                s1, lg1, ad1 = cli.decode_open(prompt=prompt)
+                assert ad1 == 0            # cold
+                lg1 = np.asarray(lg1).copy()
+                cli.decode_close(s1)
+                cli.close()
+            finally:
+                srv.stop()                 # persists the adopt index
+            assert os.path.exists(pp)
+            srv = inference.create_server(mlp_artifact, max_batch=2,
+                                          instances=1, decode_model=dec)
+            try:
+                assert (srv.stats()["decode"]["pool"]
+                        ["prefix_persist_loaded"]) >= 1
+                cli = srv.client()
+                s2, lg2, ad2 = cli.decode_open(prompt=prompt)
+                assert ad2 == 32           # restart-warm full-page hit
+                assert np.array_equal(np.asarray(lg2), lg1)
+                assert (srv.stats()["decode"]["pool"]
+                        ["prefix_hits"]) >= 1
+                cli.decode_close(s2)
+                cli.close()
+            finally:
+                srv.stop()
+        finally:
+            del os.environ["PTPU_KV_PREFIX_PERSIST"]

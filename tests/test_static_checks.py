@@ -45,6 +45,7 @@ STATS_FILES = [
     "csrc/ptpu_ps_table.cc", "csrc/ptpu_ps_server.cc",
     "csrc/ptpu_stats.h", "paddle_tpu/distributed/ps/table.py",
     "paddle_tpu/profiler/stats.py",
+    "csrc/ptpu_serving.cc", "tools/ps_stats.py",
 ]
 NET_FILES = [
     "csrc/ptpu_net.cc", "csrc/ptpu_net.h",
@@ -654,6 +655,7 @@ FUZZ_FILES = [
     "csrc/fuzz/fuzz_json.cc", "csrc/fuzz/fuzz_frames.cc",
     "csrc/fuzz/fuzz_tune.cc", "csrc/ptpu_tune.h",
     "csrc/fuzz/fuzz_capture.cc", "csrc/ptpu_capture.h",
+    "csrc/fuzz/fuzz_spill.cc", "csrc/ptpu_spill.h",
     "csrc/fuzz/gen_seeds.py",
 ]
 

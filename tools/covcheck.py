@@ -56,6 +56,11 @@ UNITS: List[Tuple[str, List[str]]] = [
      ["./fuzz/fuzz_frames.cov.fuzz", "fuzz/corpus/frames"]),
     ("fuzz/fuzz_http.cov.fuzz",
      ["./fuzz/fuzz_http.cov.fuzz", "fuzz/corpus/http"]),
+    # r19: the spill/hibernation/prefix-persist parsers route through
+    # the ptpu_wire.h codecs; without this replay their codec lines
+    # are instantiated (via ptpu_spill.h) but never credited.
+    ("fuzz/fuzz_spill.cov.fuzz",
+     ["./fuzz/fuzz_spill.cov.fuzz", "fuzz/corpus/spill"]),
 ]
 
 # Minimum line coverage (percent of executable lines executed) per

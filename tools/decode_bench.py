@@ -39,7 +39,20 @@ Legs:
               configs gated >= 1.10x static tiles on a skinny-M MLP,
               and a warm tuning cache must make the second load's
               probe count/cost exactly zero. --int4-out persists
-              these rows separately (BENCH_INT4_r01.json).
+              these rows separately (BENCH_INT4_r01.json);
+  kvtier      (ISSUE 19) KV tiering + session hibernation: (a) park
+              --kvtier-sessions (default 100,000) conversations
+              through the mmap'd spill tier while the pool's page
+              gauges stay pinned (bounded-RSS claim, gauge-verified);
+              (b) hibernate->restore logits EXACT vs an uninterrupted
+              twin; (c) timed pool restores, p50/p99 resume latency;
+              (d) restart-warm prefix cache — a fresh server's FIRST
+              open must adopt at least as many prompt tokens as the
+              old server's steady state; (e) tiering-OFF guard:
+              attaching the spill tier must cost < 10% on the
+              un-tiered decode path (interleaved rounds, r10 noise
+              methodology). --kvtier-out persists these rows
+              separately (BENCH_KVTIER_r01.json).
 
 Run: python tools/decode_bench.py [--out BENCH_DECODE_rNN.json] [...]
 (CPU-only; forces jax to CPU; uses the shipped .so.)
@@ -187,6 +200,23 @@ def main():
                     help="persist the int4/autotune measurements to "
                          "this JSON (e.g. BENCH_INT4_r01.json)")
     ap.add_argument("--skip-int4", action="store_true")
+    # KV tiering + session hibernation legs (ISSUE 19)
+    ap.add_argument("--kvtier-sessions", type=int, default=100_000,
+                    help="open conversations parked through the spill "
+                         "tier in the bounded-RSS leg (smoke clamps "
+                         "to 1,500)")
+    ap.add_argument("--kvtier-resume-samples", type=int, default=512,
+                    help="timed pool restores for the resume-latency "
+                         "p50/p99 leg")
+    ap.add_argument("--kvtier-ab-tokens", type=int, default=32,
+                    help="greedy tokens per tiering-ON/OFF guard leg")
+    ap.add_argument("--kvtier-ab-rounds", type=int, default=4,
+                    help="alternating tier-ON/OFF rounds (r10 noise "
+                         "methodology)")
+    ap.add_argument("--kvtier-out",
+                    help="persist the kvtier measurements to this "
+                         "JSON (e.g. BENCH_KVTIER_r01.json)")
+    ap.add_argument("--skip-kvtier", action="store_true")
     ap.add_argument("--smoke", action="store_true",
                     help="shrunken-config run: record everything, "
                          "never fail throughput gates (correctness "
@@ -1029,6 +1059,256 @@ def main():
                 print(f"# persisted int4 legs to {args.int4_out}",
                       flush=True)
 
+        # ---- leg 8: KV tiering + session hibernation (ISSUE 19) ----
+        kvtier_correct = True
+        if not args.skip_kvtier:
+            n_tier = args.kvtier_sessions
+            resume_n = args.kvtier_resume_samples
+            ab_rounds = args.kvtier_ab_rounds
+            ab_tokens = args.kvtier_ab_tokens
+            if args.smoke:
+                n_tier = min(n_tier, 1500)
+                resume_n = min(resume_n, 64)
+                ab_rounds, ab_tokens = (min(ab_rounds, 2),
+                                        min(ab_tokens, 12))
+
+            # (a) park n_tier conversations at bounded RSS: cycles of
+            # one batched decode step (every session holds REAL kv)
+            # then per-session hibernate.  page_tokens=2 keeps a
+            # 1-token session at ONE group, so the spill file — not
+            # the pool — carries the population; the pool never holds
+            # more than 64 groups / 2*batch sessions, and the gauges
+            # prove it.
+            page = 2
+            group_mb = page * kv_row_bytes / 1e6
+            pool = KvPool(pool_tokens=64 * page, page_tokens=page,
+                          max_sessions=2 * args.batch)
+            hp = NativePredictor(dec_path)
+            hp.kv_attach(pool)
+            pool.spill_attach(os.path.join(tmp, "kvtier_spill.bin"),
+                              max_bytes=0)   # unbounded: cap is n_tier
+            rng = np.random.RandomState(19)
+            b = args.batch
+            records = []
+            rss0 = rss_mb()
+            t0 = time.perf_counter()
+            while len(records) < n_tier - b:
+                sids = [pool.open() for _ in range(b)]
+                hp.decode_step(sids,
+                               rng.randint(0, cfg.vocab_size, size=b))
+                records.extend(pool.hibernate(s) for s in sids)
+            live = [pool.open() for _ in range(b)]
+            hp.decode_step(live,
+                           rng.randint(0, cfg.vocab_size, size=b))
+            t_park = time.perf_counter() - t0
+            st = pool.stats()
+            open_total = len(records) + b
+            rss1 = rss_mb()
+            naive_mb = open_total * group_mb  # all-resident, same geom
+            pool_mb = st["pages_total"] * page * kv_row_bytes / 1e6
+            gauges_exact = (
+                st["sessions_hibernated"] == len(records) and
+                st["sessions_active"] == b and
+                st["pages_total"] == 64 and
+                st["spill_slots_in_use"] == len(records) and
+                st["hibernates"] == len(records) and
+                st["spill_exhausted"] == 0)
+            rss_bounded = (rss1 - rss0) <= max(128.0, 0.25 * naive_mb)
+            emit({"metric": "kvtier_sessions_parked",
+                  "value": open_total,
+                  "sessions_resident": int(st["sessions_active"]),
+                  "sessions_hibernated":
+                      int(st["sessions_hibernated"]),
+                  "park_sessions_per_s": round(open_total / t_park, 1),
+                  "pool_pages_total": int(st["pages_total"]),
+                  "pool_ram_mb": round(pool_mb, 2),
+                  "naive_resident_mb": round(naive_mb, 1),
+                  "spill_file_mb": round(st["spill_bytes"] / 1e6, 1),
+                  "spill_slots_in_use": int(st["spill_slots_in_use"]),
+                  "rss_before_mb": rss0, "rss_after_mb": rss1,
+                  "rss_growth_mb": round(rss1 - rss0, 1),
+                  "gauges_exact": bool(gauges_exact),
+                  "rss_bounded": bool(rss_bounded),
+                  "note": "pool RAM is the ONLY kv residency (spill "
+                          "pages are madvise-dropped after every "
+                          "copy); naive_resident_mb is the same "
+                          "population held un-tiered",
+                  "within_gate": bool(gauges_exact and
+                                      (args.smoke or rss_bounded))})
+
+            # (c) resume latency: timed restores of parked sessions
+            lat_us = []
+            for _ in range(min(resume_n, len(records))):
+                rec = records.pop()
+                t0 = time.perf_counter()
+                sid = pool.restore(rec)
+                lat_us.append((time.perf_counter() - t0) * 1e6)
+                pool.close_session(sid)
+            p50 = float(np.percentile(lat_us, 50))
+            p99 = float(np.percentile(lat_us, 99))
+            emit({"metric": "kvtier_resume_latency_us",
+                  "value": round(p99, 1), "unit": "us (p99)",
+                  "p50_us": round(p50, 1), "p99_us": round(p99, 1),
+                  "max_us": round(max(lat_us), 1),
+                  "samples": len(lat_us),
+                  "acceptance_gate": 50_000,
+                  "within_gate": bool(p99 < 50_000)})
+            pool.close()
+            del hp
+
+            # (b) hibernate -> restore logits EXACT vs an
+            # uninterrupted twin session, normal page geometry
+            pool2 = KvPool(pool_tokens=args.batch * args.context,
+                           page_tokens=16, max_sessions=8)
+            ex = NativePredictor(dec_path, batch_override=1)
+            ex.kv_attach(pool2)
+            pool2.spill_attach(os.path.join(tmp, "kvtier_ex.bin"))
+            toks = rng.randint(0, cfg.vocab_size, size=24)
+            sa, sb = pool2.open(), pool2.open()
+            for t in toks[:20]:
+                ex.decode_step([sa], [int(t)])
+                ex.decode_step([sb], [int(t)])
+            sa = pool2.restore(pool2.hibernate(sa))
+            hib_exact = True
+            for t in toks[20:]:
+                la = ex.decode_step([sa], [int(t)]).copy()
+                lb = ex.decode_step([sb], [int(t)]).copy()
+                hib_exact = hib_exact and bool(np.array_equal(la, lb))
+            pool2.close()
+            del ex
+            emit({"metric": "kvtier_restore_logits_exact",
+                  "value": bool(hib_exact),
+                  "history_tokens": 20, "compared_steps": 4,
+                  "note": "bit-identical logits after a spill-file "
+                          "round trip"})
+
+            # (d) restart-warm prefix cache: hit rate of a FRESH
+            # server's first open vs the old server's steady state
+            persist = os.path.join(tmp, "kvtier_prefix.bin")
+            # >= one full 16-token page below the context ceiling, so
+            # warm opens have a group to adopt even at smoke scale
+            wprompt = list(range(21, 21 + min(36, args.context - 4)))
+
+            def tier_server(env, **kw):
+                for k, v in env.items():
+                    os.environ[k] = v
+                try:
+                    return inference.create_server(
+                        full_path, max_batch=2, instances=1,
+                        decode_model=dec_path, **kw)
+                finally:
+                    for k in env:
+                        del os.environ[k]
+
+            sv1 = tier_server({"PTPU_KV_PREFIX_PERSIST": persist},
+                              kv_sessions=16)
+            c1 = sv1.client()
+            t0 = time.perf_counter()
+            s0, _, ad_cold = c1.decode_open(prompt=wprompt,
+                                            timeout=120.0)
+            t_cold = time.perf_counter() - t0
+            s1, _, ad_pre = c1.decode_open(prompt=wprompt,
+                                           timeout=120.0)
+            for s in (s0, s1):
+                c1.decode_close(s)
+            c1.close()
+            sv1.stop()          # persists the prefix cache
+            sv2 = tier_server({"PTPU_KV_PREFIX_PERSIST": persist},
+                              kv_sessions=16)
+            c2 = sv2.client()
+            loaded = sv2.stats()["decode"]["pool"].get(
+                "prefix_persist_loaded", 0)
+            t0 = time.perf_counter()
+            s2, _, ad_post = c2.decode_open(prompt=wprompt,
+                                            timeout=120.0)
+            t_warm = time.perf_counter() - t0
+            c2.decode_close(s2)
+            c2.close()
+            sv2.stop()
+            prefix_warm_ok = (ad_cold == 0 and loaded >= 1 and
+                              ad_post >= ad_pre > 0)
+            emit({"metric": "kvtier_prefix_restart_warm",
+                  "value": bool(prefix_warm_ok),
+                  "prompt_tokens": len(wprompt),
+                  "adopted_cold_first_open": int(ad_cold),
+                  "adopted_pre_restart_warm": int(ad_pre),
+                  "adopted_post_restart_first_open": int(ad_post),
+                  "hit_rate_pre": round(ad_pre / len(wprompt), 3),
+                  "hit_rate_post_restart": round(
+                      ad_post / len(wprompt), 3),
+                  "prefix_persist_loaded_pages": int(loaded),
+                  "cold_open_s": round(t_cold, 4),
+                  "warm_open_s": round(t_warm, 4),
+                  "within_gate": bool(prefix_warm_ok)})
+
+            # (e) tiering-OFF guard: spill tier attached but idle must
+            # not tax the decode path (interleaved rounds)
+            def tier_ab_leg(env):
+                sv = tier_server(env,
+                                 kv_sessions=args.sessions + 2)
+                c = sv.client()
+                ss = [c.decode_open() for _ in range(args.sessions)]
+                cur = [7] * args.sessions  # the leg-2 prompt token
+                t0 = time.perf_counter()
+                for _ in range(ab_tokens - 1):
+                    outs = c.decode_step_many(
+                        [(ss[i], cur[i])
+                         for i in range(args.sessions)])
+                    for i in range(args.sessions):
+                        cur[i] = int(np.argmax(outs[i]))
+                dt = time.perf_counter() - t0
+                std = sv.stats()["decode"]
+                hib = std.get("hibernates", 0)
+                for s in ss:
+                    c.decode_close(s)
+                c.close()
+                sv.stop()
+                return args.sessions * (ab_tokens - 1) / dt, hib
+            on_env = {"PTPU_KV_SPILL_PATH":
+                      os.path.join(tmp, "kvtier_ab_spill.bin")}
+            on_tps, off_tps, idle_hib = [], [], 0
+            for r in range(ab_rounds):
+                order = [("on", on_env), ("off", {})]
+                if r % 2:
+                    order.reverse()
+                for label, e in order:
+                    tps, hib = tier_ab_leg(e)
+                    (on_tps if label == "on" else off_tps).append(tps)
+                    if label == "on":
+                        idle_hib += hib
+            tier_tax = (float(np.mean(on_tps)) /
+                        max(float(np.mean(off_tps)), 1e-9))
+            emit({"metric": "kvtier_tier_off_guard",
+                  "value": round(tier_tax, 3), "unit": "x",
+                  "tier_on_tokens_per_s":
+                      round(float(np.mean(on_tps)), 1),
+                  "tier_off_tokens_per_s":
+                      round(float(np.mean(off_tps)), 1),
+                  "per_round_on": [round(x, 1) for x in on_tps],
+                  "per_round_off": [round(x, 1) for x in off_tps],
+                  "hibernates_while_attached_idle": int(idle_hib),
+                  "rounds": ab_rounds,
+                  "acceptance_gate": 0.90,
+                  "within_gate": bool(tier_tax >= 0.90)})
+
+            kvtier_correct = (gauges_exact and hib_exact and
+                              prefix_warm_ok)
+            ok = ok and kvtier_correct
+            if not args.smoke:
+                ok = ok and rss_bounded and p99 < 50_000 and \
+                    tier_tax >= 0.90
+
+            if args.kvtier_out:
+                kt = [m for m in RESULTS
+                      if m["metric"].startswith("kvtier_")]
+                with open(args.kvtier_out, "w") as f:
+                    json.dump({"bench": "kvtier_bench",
+                               "host": host_meta(),
+                               "config": vars(args),
+                               "measurements": kt}, f, indent=1)
+                print(f"# persisted kvtier legs to {args.kvtier_out}",
+                      flush=True)
+
         # ---- r01 guard + gates -------------------------------------
         ratio = kv_tps / rc_tps
         emit({"metric": "decode_kv_speedup_vs_recompute",
@@ -1061,6 +1341,8 @@ def main():
             ok = counters_exact and logits_close and exact_all
             if not args.skip_int4:
                 ok = ok and warm_ok
+            if not args.skip_kvtier:
+                ok = ok and kvtier_correct
         else:
             ok = ok and counters_exact and logits_close and exact_all \
                 and ratio >= 5.0

@@ -123,8 +123,13 @@ def _rates(prev: dict, cur: dict, dt: float) -> str:
                 f"{d('req_errors') / dt:,.0f} err/s)")
         if "decode" in cur:
             line += (f" | decode {dd('steps') / dt:,.0f} steps/s "
-                     f"({cur['decode'].get('sessions_active', 0)} "
-                     f"sessions)")
+                     f"({cur['decode'].get('sessions_resident', 0)} "
+                     f"res/{cur['decode'].get('sessions_hibernated', 0)}"
+                     f" hib)")
+            # KV tiering (r19): restores/s only when the spill tier is
+            # live — a flat 0 column on untired deployments is noise
+            if cur["decode"].get("sessions_hibernated", 0) or dd("restores"):
+                line += f" | restore {dd('restores') / dt:,.0f}/s"
         return line + f" | {mb:,.1f} MB/s | conns {conns}"
     # PS planes: the control-plane snapshot nests wire counters under
     # "wire"; the HTTP /statsz one keeps them under "server"

@@ -818,6 +818,29 @@ def check_stats(root: str) -> List[Finding]:
                 f.append(Finding("stats", hdr_rel, 0,
                                  f"C histogram JSON lacks the '{key}' "
                                  f"field profiler/stats.py renders"))
+
+    # decode-view twin (ISSUE 19): every key tools/ps_stats.py reads
+    # out of the serving snapshot's "decode" object must actually be
+    # rendered by the C decode stats block in ptpu_serving.cc — a
+    # renamed counter would silently flatline the --watch columns
+    sv_rel = "csrc/ptpu_serving.cc"
+    pstool_rel = "tools/ps_stats.py"
+    sv = _require(root, sv_rel, "stats", f)
+    pstool = _require(root, pstool_rel, "stats", f)
+    if sv is not None and pstool is not None:
+        sv_names = set(c_json_names(sv))
+        reads: Dict[str, int] = {}
+        for m in re.finditer(
+                r'(?:\bdd\(|cur\[[\'"]decode[\'"]\]\.get\()'
+                r'[\'"](\w+)[\'"]', pstool):
+            reads.setdefault(m.group(1), _lineno(pstool, m.start()))
+        for name, line in sorted(reads.items()):
+            if name not in sv_names:
+                f.append(Finding(
+                    "stats", pstool_rel, line,
+                    f"ps_stats.py reads decode['{name}'] but "
+                    f"ptpu_serving.cc's decode renderer never emits "
+                    f"it — --watch column would flatline"))
     return f
 
 
@@ -1405,6 +1428,7 @@ FUZZ_TARGET_SOURCES = {
     "frames": "csrc/ptpu_net.cc",
     "tune": "csrc/ptpu_tune.h",
     "capture": "csrc/ptpu_capture.h",
+    "spill": "csrc/ptpu_spill.h",
 }
 
 
@@ -1590,6 +1614,51 @@ def check_fuzz(root: str) -> List[Finding]:
                         "CAPTURE_MAGIC does not match kCaptureMagic "
                         "in csrc/ptpu_capture.h — regenerated seeds "
                         "would miss the parser"))
+
+    # 7) KV spill tier (ISSUE 19): three formats share one corpus
+    #    (spill header / hibernation record / prefix-persist file).
+    #    Each magic needs the same two-sided seeding contract as the
+    #    tune cache, and gen_seeds.py's twins must track the header's.
+    spill_rel = "csrc/ptpu_spill.h"
+    spill_hdr = _require(root, spill_rel, "fuzz", f)
+    if spill_hdr is not None:
+        clean = strip_c_comments(spill_hdr)
+        gen = _require(root, "csrc/fuzz/gen_seeds.py", "fuzz", f)
+        for cn, pn, nick in (("kSpillMagic", "SPILL_MAGIC", "PSPL"),
+                             ("kHibMagic", "HIB_MAGIC", "PHIB"),
+                             ("kPrefixMagic", "PREFIX_MAGIC", "PPFX")):
+            m = re.search(r"\b%s\s*=\s*0x([0-9a-fA-F]+)" % cn, clean)
+            if m is None:
+                f.append(Finding(
+                    "fuzz", spill_rel, 0,
+                    f"{cn} literal not found — the fuzz checker keys "
+                    f"the spill corpus on it"))
+                continue
+            magic = int(m.group(1), 16)
+            magic_le = magic.to_bytes(4, "little")
+            blobs = _corpus_blobs(root, "spill")
+            if not any(b[:4] == magic_le for b in blobs):
+                f.append(Finding(
+                    "fuzz", "csrc/fuzz/corpus/spill", 0,
+                    f"no spill corpus seed starts with the {nick} "
+                    f"magic — the fuzzer never starts inside that "
+                    f"parser (regen via gen_seeds.py)"))
+            if not any(len(b) >= 4 and b[:4] != magic_le
+                       for b in blobs):
+                f.append(Finding(
+                    "fuzz", "csrc/fuzz/corpus/spill", 0,
+                    f"no spill corpus seed with a non-{nick} magic — "
+                    f"the alien-file reject path is unseeded "
+                    f"(gen_seeds.py)"))
+            if gen is not None:
+                gm = re.search(r"\b%s\s*=\s*0x([0-9a-fA-F]+)" % pn,
+                               gen)
+                if gm is None or int(gm.group(1), 16) != magic:
+                    f.append(Finding(
+                        "fuzz", "csrc/fuzz/gen_seeds.py", 0,
+                        f"{pn} does not match {cn} in "
+                        f"csrc/ptpu_spill.h — regenerated seeds "
+                        f"would miss the parser"))
     return f
 
 

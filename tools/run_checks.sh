@@ -83,7 +83,8 @@ make -C csrc -j"$JOBS" fuzz
 
 FUZZ_SMOKE_SECS="${FUZZ_SMOKE_SECS:-5}"
 step "fuzz smoke: corpus replay + ${FUZZ_SMOKE_SECS}s run per target"
-for t in wire_ps wire_serving http onnx json frames tune capture; do
+for t in wire_ps wire_serving http onnx json frames tune capture \
+         spill; do
   echo "-- fuzz_${t}: corpus replay"
   (cd csrc/fuzz && "./fuzz_${t}.fuzz" "corpus/${t}")
   echo "-- fuzz_${t}: ${FUZZ_SMOKE_SECS}s coverage-guided run"
