@@ -8,8 +8,10 @@
 // target is the pure parser.
 //
 // Corpus: csrc/fuzz/corpus/json (real stats_json snapshots from both
-// servers + histogram/edge shapes). Build: `make fuzz` (csrc/Makefile).
+// servers + histogram/edge shapes + invariant reports). Build:
+// `make fuzz` (csrc/Makefile).
 #include "../ptpu_trace.cc"
+#include "../ptpu_invar.cc"
 
 #include <cstdint>
 #include <string>
@@ -20,5 +22,13 @@ extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
   // both family prefixes the servers use, plus an empty one
   (void)ptpu::trace::PromFromStatsJson(snapshot, "ptpu_ps");
   (void)ptpu::trace::PromFromStatsJson(snapshot, "");
+  // the invariant engine walks the same restricted grammar twice over:
+  // once evaluating the fuzzed snapshot against the manifest, once
+  // re-parsing its OWN report (ViolationCount) — the report format is
+  // deliberately inside the rj:: grammar, so this closes the loop
+  (void)ptpu::invar::ViolationCount(
+      ptpu::invar::CheckJson(snapshot, "serving"));
+  (void)ptpu::invar::ViolationCount(
+      ptpu::invar::CheckJson(snapshot, ""));
   return 0;
 }

@@ -14,6 +14,7 @@
 //
 // Corpus: csrc/fuzz/corpus/wire_ps. Build: `make fuzz`.
 #include "../ptpu_ps_table.cc"
+#include "../ptpu_invar.cc"
 #include "../ptpu_ps_server.cc"
 #include "../ptpu_net.cc"
 #include "../ptpu_trace.cc"

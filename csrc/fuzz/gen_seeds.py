@@ -444,6 +444,7 @@ def main():
     w("http", "seed-tracez-multi-key.bin",
       req(b"GET /tracez?conn=1&n=2 HTTP/1.1"))
     w("http", "seed-capturez.bin", req(b"GET /capturez?n=5 HTTP/1.1"))
+    w("http", "seed-invarz.bin", req(b"GET /invarz HTTP/1.1"))
     w("http", "seed-404.bin", req(b"GET /nope HTTP/1.1"))
     w("http", "seed-post.bin", req(b"POST /healthz HTTP/1.1"))
     w("http", "seed-http10-keepalive.bin",
@@ -473,6 +474,19 @@ def main():
         b'{"server":{"pull_ops":7,"pull_us":{"count":2,"sum":10,'
         b'"buckets":[1,1,0]}},"tables":{"emb":{"wire":{"bytes_in":3},'
         b'"table":{"rows":64}}}}'))
+    # invariant reports (r20): the /invarz body shape — the walker must
+    # render the nested violations object, and fuzz_json additionally
+    # feeds every input through ptpu::invar::ViolationCount
+    w("json", "seed-invar-clean.bin", (
+        b'{"enabled":1,"plane":"serving","checked":9,"skipped":2,'
+        b'"violations":{}}'))
+    w("json", "seed-invar-violated.bin", (
+        b'{"enabled":1,"plane":"ps","checked":3,"skipped":8,'
+        b'"violations":{"req_balance":{"law":"server.requests == '
+        b'server.replies + server.req_errors","detail":"lhs=5 rhs=4"},'
+        b'"conn_balance":{"law":"x == y","detail":"lhs=1 rhs=0"}}}'))
+    w("json", "seed-invar-disabled.bin",
+      b'{"enabled":0,"plane":"serving","violations":{}}')
     w("json", "seed-escapes.bin",
       b'{"a\\n\\t\\"b\\\\":1,"c":{"d\\r":2}}')
     w("json", "seed-deep.bin",

@@ -1,0 +1,259 @@
+// ptpu_invar — runtime leg of the counter-conservation gate (ISSUE
+// 20; see ptpu_invar.h for the manifest grammar and the static leg).
+//
+// The engine is deliberately dumb: parse the manifest once, parse the
+// snapshot with the SAME restricted JSON walker /metrics uses
+// (ptpu_trace.h rj:: — fuzz_json.cc keeps it under coverage-guided
+// fuzzing), resolve dot paths, compare sums. No allocation tricks, no
+// caching of snapshots — this runs at quiesce points and in telemetry
+// scrapes, never on the request hot path.
+#include "ptpu_invar.h"
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "ptpu_stats.h"
+#include "ptpu_trace.h"
+
+namespace ptpu {
+namespace invar {
+
+namespace {
+
+using trace::rj::JNode;
+using trace::rj::JParser;
+
+struct Law {
+  std::string planes;             // "serving,ps" raw field
+  std::string name;
+  std::string lhs;
+  bool exact = true;              // == vs >=
+  std::vector<std::string> rhs;
+  std::string text;               // the declaration, for reports
+};
+
+struct ManifestRules {
+  std::vector<Law> laws;
+};
+
+std::vector<std::string> SplitWs(const std::string& line) {
+  std::vector<std::string> out;
+  size_t i = 0;
+  while (i < line.size()) {
+    while (i < line.size() && (line[i] == ' ' || line[i] == '\t')) ++i;
+    size_t j = i;
+    while (j < line.size() && line[j] != ' ' && line[j] != '\t') ++j;
+    if (j > i) out.push_back(line.substr(i, j - i));
+    i = j;
+  }
+  return out;
+}
+
+bool PlaneListed(const std::string& planes, const std::string& plane) {
+  size_t i = 0;
+  while (i < planes.size()) {
+    size_t j = planes.find(',', i);
+    if (j == std::string::npos) j = planes.size();
+    if (planes.compare(i, j - i, plane) == 0) return true;
+    i = j + 1;
+  }
+  return false;
+}
+
+// Parse only the `invar` lines — counter/gauge/pair declarations are
+// the static checker's food; the runtime needs just the laws.
+const ManifestRules& Rules() {
+  static const ManifestRules* rules = [] {
+    auto* r = new ManifestRules();
+    const char* m = Manifest();
+    const char* p = m;
+    while (*p) {
+      const char* e = std::strchr(p, '\n');
+      if (!e) e = p + std::strlen(p);
+      std::string line(p, size_t(e - p));
+      p = *e ? e + 1 : e;
+      const size_t h = line.find('#');
+      if (h != std::string::npos) line.resize(h);
+      std::vector<std::string> tok = SplitWs(line);
+      if (tok.size() < 6 || tok[0] != "invar") continue;
+      Law law;
+      law.planes = tok[1];
+      law.name = tok[2];
+      law.lhs = tok[3];
+      law.exact = tok[4] == "==";
+      law.text = law.lhs + " " + tok[4];
+      for (size_t i = 5; i < tok.size(); ++i) {
+        if (tok[i] == "+") continue;
+        law.rhs.push_back(tok[i]);
+        law.text += (law.rhs.size() == 1 ? " " : " + ") + tok[i];
+      }
+      r->laws.push_back(std::move(law));
+    }
+    return r;
+  }();
+  return *rules;
+}
+
+// Resolve a dot path to an unsigned value. Returns false when any
+// path step is missing or the leaf isn't a number.
+bool Resolve(const JNode& root, const std::string& path,
+             uint64_t* out) {
+  const JNode* n = &root;
+  size_t i = 0;
+  while (i <= path.size()) {
+    size_t j = path.find('.', i);
+    if (j == std::string::npos) j = path.size();
+    const std::string key = path.substr(i, j - i);
+    if (n->kind != JNode::kObj) return false;
+    const JNode* next = nullptr;
+    for (const auto& kv : n->obj)
+      if (kv.first == key) {
+        next = &kv.second;
+        break;
+      }
+    if (!next) return false;
+    n = next;
+    if (j == path.size()) break;
+    i = j + 1;
+  }
+  if (n->kind != JNode::kNum) return false;
+  *out = n->num;
+  return true;
+}
+
+bool Disabled() {
+  const char* v = std::getenv("PTPU_INVAR_OFF");
+  return v && v[0] && v[0] != '0';
+}
+
+std::string SniffPlane(const JNode& root) {
+  if (root.kind == JNode::kObj)
+    for (const auto& kv : root.obj)
+      if (kv.first == "batcher") return "serving";
+  return "ps";
+}
+
+// violations render as an OBJECT keyed by law name (not an array of
+// objects): the report stays inside the restricted JSON grammar the
+// rj:: walker reads, so the same fuzzed parser that consumes stats
+// snapshots consumes its own verdicts (and /metrics can render one).
+void AppendViolation(std::string* out, int* nviol,
+                     const std::string& name, const std::string& law,
+                     const std::string& detail) {
+  if ((*nviol)++) *out += ',';
+  *out += "\"" + JsonEscape(name) + "\":{\"law\":\"" +
+          JsonEscape(law) + "\",\"detail\":\"" + JsonEscape(detail) +
+          "\"}";
+}
+
+}  // namespace
+
+std::string CheckJson(const std::string& stats_json,
+                      const std::string& plane_in) {
+  if (Disabled())
+    return "{\"enabled\":0,\"plane\":\"" + JsonEscape(plane_in) +
+           "\",\"checked\":0,\"skipped\":0,\"violations\":{}}";
+  JParser jp{stats_json.data(), stats_json.data() + stats_json.size()};
+  const JNode root = jp.Value(0);
+  std::string plane = plane_in;
+  if (plane.empty() || plane == "auto")
+    plane = jp.ok ? SniffPlane(root) : "auto";
+  int checked = 0, skipped = 0, nviol = 0;
+  std::string viol;
+  if (!jp.ok || root.kind != JNode::kObj) {
+    AppendViolation(&viol, &nviol, "snapshot", "parse",
+                    "stats snapshot is not restricted JSON");
+  } else {
+    for (const Law& law : Rules().laws) {
+      if (!PlaneListed(law.planes, plane)) continue;
+      uint64_t lhs = 0;
+      if (!Resolve(root, law.lhs, &lhs)) {
+        // optional subsystem (e.g. no decode plan): law inactive
+        ++skipped;
+        continue;
+      }
+      uint64_t sum = 0;
+      std::string missing;
+      for (const std::string& term : law.rhs) {
+        uint64_t v = 0;
+        if (!Resolve(root, term, &v)) {
+          missing = term;
+          break;
+        }
+        sum += v;
+      }
+      ++checked;
+      if (!missing.empty()) {
+        AppendViolation(&viol, &nviol, law.name, law.text,
+                        "term " + missing + " missing from snapshot");
+        continue;
+      }
+      const bool holds = law.exact ? lhs == sum : lhs >= sum;
+      if (!holds) {
+        char buf[64];
+        std::snprintf(buf, sizeof(buf), "%llu %s %llu",
+                      (unsigned long long)lhs,
+                      law.exact ? "!=" : "<",
+                      (unsigned long long)sum);
+        AppendViolation(&viol, &nviol, law.name, law.text,
+                        law.lhs + " = " + buf + " = sum(rhs)");
+      }
+    }
+  }
+  std::string out = "{\"enabled\":1,\"plane\":\"" +
+                    JsonEscape(plane) + "\",";
+  AppendJsonU64(&out, "checked", uint64_t(checked));
+  out += ',';
+  AppendJsonU64(&out, "skipped", uint64_t(skipped));
+  out += ",\"violations\":{" + viol + "}}";
+  return out;
+}
+
+int ViolationCount(const std::string& report) {
+  JParser jp{report.data(), report.data() + report.size()};
+  const JNode root = jp.Value(0);
+  if (!jp.ok || root.kind != JNode::kObj) return -1;
+  for (const auto& kv : root.obj)
+    if (kv.first == "violations" && kv.second.kind == JNode::kObj)
+      return int(kv.second.obj.size());
+  return -1;
+}
+
+int GateQuiesced(const std::string& stats_json,
+                 const std::string& plane, const char* where) {
+  const std::string report = CheckJson(stats_json, plane);
+  const int n = ViolationCount(report);
+  if (n > 0) {
+    std::fprintf(stderr,
+                 "ptpu_invar[%s]: %d conservation-law violation(s) "
+                 "at quiesce (PTPU_INVAR_OFF=1 disables)\n%s\n",
+                 where, n, report.c_str());
+    // selftests/benches export PTPU_INVAR_FATAL=1 so EVERY Stop()
+    // they trigger is a hard teardown gate; production default is
+    // report-and-continue (a miscounted counter must not take down
+    // a serving process that just drained cleanly)
+    const char* f = std::getenv("PTPU_INVAR_FATAL");
+    if (f && f[0] && f[0] != '0') std::abort();
+  }
+  return n > 0 ? n : 0;
+}
+
+}  // namespace invar
+}  // namespace ptpu
+
+extern "C" __attribute__((visibility("default"))) const char*
+ptpu_invar_check_json(const char* stats_json, const char* plane) {
+  thread_local std::string g_invar_json;
+  g_invar_json = ptpu::invar::CheckJson(
+      stats_json ? stats_json : "", plane ? plane : "auto");
+  return g_invar_json.c_str();
+}
+
+extern "C" __attribute__((visibility("default"))) const char*
+ptpu_invar_manifest(void) {
+  return ptpu::invar::Manifest();
+}

@@ -1,0 +1,200 @@
+// ptpu_invar — declarative counter-conservation invariants over the
+// stats snapshots BOTH native servers export (ISSUE 20).
+//
+// Every subsystem's acceptance gate in this repo is some flavor of
+// "counters exact": serving_bench, decode_bench, ps_bench, the drill
+// chaos soak and a dozen C selftests each re-derived their own
+// reconciliation arithmetic by hand. ptpu_invar makes the counter
+// algebra itself a first-class, machine-checked artifact:
+//
+//   * ONE manifest (kInvarManifest below) declares the conservation
+//     laws and binds every participating counter to the C++ member
+//     expression that bumps it and the TU it lives in;
+//   * the `invar` checker in tools/ptpu_check.py enforces the
+//     manifest STATICALLY — every declared flow edge has a bump
+//     site, error paths bump their paired term, no bound counter is
+//     bumped at a site the manifest doesn't account for, and the
+//     Python twin string (profiler/stats.py INVAR_MANIFEST) stays
+//     token-identical;
+//   * this engine enforces it AT RUNTIME: CheckJson() evaluates the
+//     laws over a stats snapshot (the *_stats_json strings), parsed
+//     with the same restricted JSON walker /metrics uses
+//     (ptpu_trace.h rj:: — fuzzed by csrc/fuzz/fuzz_json.cc), wired
+//     into both servers' Stop(), the C selftest teardowns, the bench
+//     guards, GET /invarz, and drill_replay.py's chaos soak.
+//
+// Quiesce semantics: `==` laws only hold when no request is in
+// flight — a snapshot taken mid-request can legitimately see
+// `requests == replies + 1`. The gates therefore run at quiesce
+// points (Stop() after drain, selftest teardown, bench end, soak
+// drain); GET /invarz is served any time but is informational while
+// traffic flows. `>=` laws hold at any instant the snapshot is
+// internally consistent.
+//
+// Kill switch: PTPU_INVAR_OFF=1 turns every gate into a no-op (the
+// report says "enabled":false and carries zero violations) — the
+// escape hatch if a deployment hits a law the manifest got wrong.
+//
+// Manifest grammar (one declaration per line, '#' comments):
+//
+//   counter <planes> <path> <file> <expr>
+//       A monotonic counter: JSON leaf <path> (dot-joined) in the
+//       snapshot of each plane in <planes> (comma list of
+//       serving|ps), bumped ONLY inside <file> (comma list of
+//       repo-relative TUs) via `<expr>.Add(`.
+//   gauge <planes> <path> <file> <expr>
+//       A level, not a flow: computed or +/- adjusted; exempt from
+//       the bump-site rules, but <expr> must appear in <file> and
+//       <path> must be rendered there.
+//   invar <planes> <name> <path> ==|>= <path> [+ <path> ...]
+//       A conservation law over bound paths. Laws whose left-hand
+//       path is absent from a snapshot are skipped (optional
+//       subsystems: the decode block only exists with a decode
+//       plan); a law whose LHS resolves but an RHS term doesn't is a
+//       violation.
+//   pair <file> <exprA> <exprB>
+//       Per-function flow discipline: any function body in <file>
+//       bumping <exprA> must also touch <exprB> (the nullcheck-style
+//       path rule — catches an error path that bumps the success
+//       side without its paired term).
+//
+// The manifest string below and profiler/stats.py::INVAR_MANIFEST are
+// twins — token-identical, enforced by the `invar` checker — so the
+// Python evaluator needs neither codegen nor a csrc/ checkout.
+#ifndef PTPU_INVAR_H_
+#define PTPU_INVAR_H_
+
+#include <string>
+
+namespace ptpu {
+namespace invar {
+
+// The single source of truth for the counter algebra. Adding a
+// counter to a conservation law? Bind it here (and in the Python
+// twin), then `python3 tools/ptpu_check.py --check invar` tells you
+// every site the binding misses. See README "Correctness tooling v4".
+inline const char* Manifest() {
+  return R"INV(# ptpu_invar manifest — counter conservation laws (twin: profiler/stats.py)
+
+# ---- serving + PS shared net plane (csrc/ptpu_net.cc) ----
+counter serving,ps server.conns_accepted csrc/ptpu_net.cc stats_->conns_accepted
+counter serving,ps server.conns_closed csrc/ptpu_net.cc stats_->conns_closed
+counter serving,ps server.handshake_fails csrc/ptpu_net.cc stats_->handshake_fails
+counter serving,ps server.handshake_timeouts csrc/ptpu_net.cc stats_->handshake_timeouts
+gauge serving,ps server.conns_active csrc/ptpu_net.cc active_conns
+
+# every framed conn accepted is either still active or was closed —
+# exact because accept pairs accepted++ with active++ and FinishClose
+# pairs closed++ with active-- (telemetry HTTP conns are exempt and
+# uncounted on both sides)
+invar serving,ps conn_balance server.conns_accepted == server.conns_active + server.conns_closed
+# handshake failures/timeouts are close reasons of counted conns
+# (idle_closes is NOT listed: HTTP conns may idle-close uncounted)
+invar serving,ps close_reasons server.conns_closed >= server.handshake_fails + server.handshake_timeouts
+
+# ---- serving request plane (csrc/ptpu_serving.cc) ----
+counter serving server.requests csrc/ptpu_serving.cc stats.requests
+counter serving server.replies csrc/ptpu_serving.cc stats.replies
+counter serving server.req_errors csrc/ptpu_serving.cc stats.req_errors
+counter serving server.op_errors csrc/ptpu_serving.cc stats.op_errors
+counter serving server.err_frames csrc/ptpu_serving.cc stats.err_frames
+# the PS data plane reuses the err_frames name for its own ledger
+counter ps server.err_frames csrc/ptpu_ps_server.cc stats.err_frames
+
+# the zero-stuck-requests proof: every accepted INFER request is
+# answered exactly once — a reply or an error frame (replies are
+# counted at send-decision time, so a killed conn still balances;
+# decode/meta op errors land in op_errors, not here)
+invar serving req_balance server.requests == server.replies + server.req_errors
+# every ERR frame is attributed to exactly one plane: INFER
+# (req_errors) or decode/meta op (op_errors) — proto errors close
+# the conn without an ERR frame and count in neither
+invar serving err_split server.err_frames == server.req_errors + server.op_errors
+pair csrc/ptpu_serving.cc stats.req_errors stats.err_frames
+pair csrc/ptpu_serving.cc stats.op_errors stats.err_frames
+
+# ---- decode session ledger (csrc/ptpu_serving.cc, dstats) ----
+counter serving decode.opens csrc/ptpu_serving.cc dstats.opens
+counter serving decode.closes csrc/ptpu_serving.cc dstats.closes
+counter serving decode.evictions csrc/ptpu_serving.cc dstats.evictions
+counter serving decode.hibernates csrc/ptpu_serving.cc dstats.hibernates
+counter serving decode.restores csrc/ptpu_serving.cc dstats.restores
+counter serving decode.forks csrc/ptpu_serving.cc dstats.forks
+gauge serving decode.sessions_active csrc/ptpu_serving.cc sessions_active
+gauge serving decode.sessions_hibernated csrc/ptpu_serving.cc sessions_hibernated
+
+# every session ever opened is live, hibernated, or exited exactly
+# once as a close or an eviction (tombstones count at eviction time;
+# closing a tombstone later is NOT a second exit)
+invar serving session_balance decode.opens == decode.closes + decode.evictions + decode.sessions_active + decode.sessions_hibernated
+invar serving hibernate_flow decode.hibernates >= decode.restores
+# a fork IS an open (fork path bumps both)
+invar serving forks_are_opens decode.opens >= decode.forks
+pair csrc/ptpu_serving.cc dstats.forks dstats.opens
+
+# ---- KV pool page + hibernation ledgers (csrc/ptpu_predictor.cc) ----
+gauge serving decode.pool.pages_total csrc/ptpu_predictor.cc npages_
+gauge serving decode.pool.pages_in_use csrc/ptpu_predictor.cc npages_
+gauge serving decode.pool.pages_free csrc/ptpu_predictor.cc free_
+gauge serving decode.pool.pages_cached csrc/ptpu_predictor.cc pages_cached
+gauge serving decode.pool.sessions_hibernated csrc/ptpu_predictor.cc hib_
+counter serving decode.pool.hibernates csrc/ptpu_predictor.cc hibernates_
+counter serving decode.pool.restores csrc/ptpu_predictor.cc restores_
+counter serving decode.pool.hib_drops csrc/ptpu_predictor.cc hib_drops_
+gauge serving decode.pool.spill_slots_total csrc/ptpu_predictor.cc slots_total
+gauge serving decode.pool.spill_slots_in_use csrc/ptpu_predictor.cc slots_in_use
+
+# page conservation: the pool never leaks or invents a page —
+# rendered under one mu_ hold, so this is exact at ANY instant
+invar serving page_balance decode.pool.pages_total == decode.pool.pages_in_use + decode.pool.pages_free
+# cached (published, ref==1) pages are a subset of in-use pages
+invar serving cache_subset decode.pool.pages_in_use >= decode.pool.pages_cached
+# every hibernation record ever created was restored, dropped, or is
+# still resident in the registry — exact under mu_
+invar serving pool_hib_balance decode.pool.hibernates == decode.pool.restores + decode.pool.hib_drops + decode.pool.sessions_hibernated
+invar serving spill_slots decode.pool.spill_slots_total >= decode.pool.spill_slots_in_use
+)INV";
+}
+
+// Evaluate every law against `stats_json` (a *_stats_json snapshot).
+// `plane` is "serving", "ps", or "auto" (sniffed from the snapshot
+// shape: a batcher section means serving). Returns the report JSON —
+// deliberately inside the restricted rj:: grammar (no booleans, no
+// object arrays) so the same fuzzed walker consumes its own verdicts:
+//   {"enabled":1,"plane":"serving","checked":N,"skipped":N,
+//    "violations":{<law-name>:{"law":...,"detail":...},...}}
+// Unparseable snapshots report one "snapshot" violation. When
+// PTPU_INVAR_OFF=1 the report is {"enabled":0,...} with zero
+// violations — the kill switch for a mis-declared law.
+std::string CheckJson(const std::string& stats_json,
+                      const std::string& plane);
+
+// Number of violations inside a CheckJson() report (-1 when the
+// report itself doesn't parse). The selftest-teardown helper.
+int ViolationCount(const std::string& report);
+
+// Teardown gate: evaluate and, on any violation, print the report to
+// stderr and return the violation count (0 when clean or killed via
+// PTPU_INVAR_OFF=1). Both servers' Stop() call this, so every C
+// selftest teardown and bench shutdown inherits the gate; with
+// PTPU_INVAR_FATAL=1 (set by the selftests and bench guards) a
+// violation abort()s instead of merely reporting.
+int GateQuiesced(const std::string& stats_json,
+                 const std::string& plane, const char* where);
+
+}  // namespace invar
+}  // namespace ptpu
+
+extern "C" {
+/* Evaluate the conservation-law manifest over a stats snapshot.
+ * `plane` is "serving", "ps" or "auto"/NULL. Returns the report JSON
+ * (see ptpu::invar::CheckJson); pointer valid until the next call on
+ * this thread. */
+const char* ptpu_invar_check_json(const char* stats_json,
+                                  const char* plane);
+/* The manifest text itself (twin-checked against profiler/stats.py —
+ * lets tooling assert parity against a live .so, not a checkout). */
+const char* ptpu_invar_manifest(void);
+}
+
+#endif  // PTPU_INVAR_H_

@@ -997,8 +997,12 @@ class EventLoop {
     }
     ::epoll_ctl(ep_, EPOLL_CTL_DEL, c->fd_, nullptr);
     ::close(c->fd_);
-    if (!c->http_)  // telemetry conns were never counted (AcceptOne)
+    if (!c->http_) {  // telemetry conns were never counted (AcceptOne)
+      // conns_closed pairs this decrement: accepted == active + closed
+      // (the conn_balance law, csrc/ptpu_invar.h) holds at any quiesce
+      stats_->conns_closed.Add(1);
       stats_->active_conns.fetch_sub(1, std::memory_order_relaxed);
+    }
     ConnPtr self;
     auto it = conns_.find(c->fd_);
     if (it != conns_.end()) {

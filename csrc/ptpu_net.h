@@ -60,7 +60,12 @@ PTPU_LOCK_CLASS(kLockInbox, "net.inbox", 110);
 // rendered into its stats_json (twin names documented in
 // tools/ptpu_check.py PS_SERVER_C_ONLY).
 struct Stats {
-  Counter conns_accepted, conns_shed, handshake_fails,
+  // conns_closed counts every close of a COUNTED (framed, non-HTTP)
+  // conn, whatever the reason — the paired term that makes
+  //   conns_accepted == active_conns + conns_closed
+  // a conservation law (ptpu_invar manifest, csrc/ptpu_invar.h)
+  // instead of folklore.
+  Counter conns_accepted, conns_closed, conns_shed, handshake_fails,
       handshake_timeouts, idle_closes, epoll_wakeups,
       partial_write_flushes, http_reqs;
   // Injected-fault counters (PTPU_CHAOS drills): every fault the net
@@ -72,8 +77,22 @@ struct Stats {
   std::atomic<int64_t> active_conns{0};
 
   void Reset() {
-    conns_accepted.Reset();
+    // Invariant-preserving by construction (ISSUE 20): zeroing the
+    // flow counters while connections are open would leave
+    // conns_accepted (0) != active_conns (k) + conns_closed (0), and
+    // no multi-counter read is atomic against racing accept/close.
+    // Instead REBASE both sides of the conn_balance law by the same
+    // amount (closed-so-far): accepted - b == active + (closed - b)
+    // holds whenever accepted == active + closed did, for ANY racing
+    // interleaving. Post-reset semantics: conns_accepted counts
+    // still-open conns plus accepts since the reset.
+    const uint64_t closed_base = conns_closed.Get();
+    conns_accepted.Rebase(closed_base);
+    conns_closed.Rebase(closed_base);
     conns_shed.Reset();
+    // close-reason subsets may zero outright: every future reason
+    // bump pairs a conns_closed bump, so `closed >= reasons` keeps
+    // holding over the post-reset window
     handshake_fails.Reset();
     handshake_timeouts.Reset();
     idle_closes.Reset();

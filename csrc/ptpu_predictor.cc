@@ -3025,6 +3025,10 @@ class KvPool {
     ptpu::AppendJsonU64(&out, "pages_in_use",
                         uint64_t(npages_ - int64_t(free_.size())));
     out += ",";
+    // Emitted so page_balance (csrc/ptpu_invar.h) can check
+    // pages_total == pages_in_use + pages_free from the snapshot alone.
+    ptpu::AppendJsonU64(&out, "pages_free", uint64_t(free_.size()));
+    out += ",";
     ptpu::AppendJsonU64(&out, "pages_cached", uint64_t(cached));
     out += ",";
     ptpu::AppendJsonU64(&out, "page_tokens", uint64_t(page_));

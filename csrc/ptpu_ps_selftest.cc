@@ -4,6 +4,7 @@
 // `make selftest`).
 #include "ptpu_net.cc"
 #include "ptpu_trace.cc"
+#include "ptpu_invar.cc"
 #include "ptpu_ps_server.cc"
 #include "ptpu_ps_table.cc"
 
@@ -409,9 +410,71 @@ void test_server_pull_push_roundtrip() {
   ptpu_ps_table_destroy(t);
 }
 
+/* ISSUE 20: the conservation-law gate on the PS plane. A quiesced
+ * PS snapshot (including a failed handshake and a stats_reset racing
+ * an open conn) passes every manifest law; a doctored snapshot (a
+ * conn accepted but never closed — e.g. a lost FinishClose bump)
+ * trips conn_balance; plane sniffing resolves a batcher-less
+ * snapshot to "ps". */
+void test_invar_ps_gate() {
+  void *t = ptpu_ps_table_create(8, 2, PTPU_PS_SGD, 1.f, 0, 0, 0);
+  void *srv = ptpu_ps_server_start(0, "k3y", 3, /*loopback_only=*/1);
+  assert(srv && ptpu_ps_server_register(srv, "emb", t, 0) == 0);
+  const int port = ptpu_ps_server_port(srv);
+
+  const int fd = dial(port);
+  assert(client_handshake(fd, "k3y"));
+  // reset while this conn is open: the conn-ledger rebase must keep
+  // conn_balance exact (accepted rebases by the CLOSED base only)
+  ptpu_ps_server_stats_reset(srv);
+  std::vector<uint8_t> req = {1, 0x50, 3, 'e', 'm', 'b', 1, 0, 0, 0};
+  const int64_t gid = 3;
+  const auto *gb = reinterpret_cast<const uint8_t *>(&gid);
+  req.insert(req.end(), gb, gb + 8);
+  send_client_frame(fd, req);
+  assert(recv_client_frame(fd)[1] == 0x51);
+  ::close(fd);
+  const int fd2 = dial(port);
+  assert(!client_handshake(fd2, "wrong"));  // handshake_fails + close
+  ::close(fd2);
+
+  // quiesce: wait out the async close bookkeeping
+  std::string sj;
+  for (int spin = 0; spin < 400; ++spin) {
+    sj = ptpu_ps_server_stats_json(srv);
+    if (sj.find("\"conns_active\":0") != std::string::npos) break;
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  }
+  assert(ptpu::invar::GateQuiesced(sj, "ps", "selftest") == 0);
+
+  // plane sniffing over the C ABI: no batcher section -> "ps"
+  const std::string rep = ptpu_invar_check_json(sj.c_str(), nullptr);
+  assert(rep.find("\"plane\":\"ps\"") != std::string::npos);
+  assert(ptpu::invar::ViolationCount(rep) == 0);
+
+  // doctored snapshot: a conn accepted but never closed nor active
+  const size_t ap = sj.find("\"conns_accepted\":");
+  assert(ap != std::string::npos);
+  const uint64_t acc =
+      std::strtoull(sj.c_str() + ap + 17, nullptr, 10);
+  std::string bad = sj.substr(0, ap) + "\"conns_accepted\":" +
+                    std::to_string(acc + 1) +
+                    sj.substr(sj.find(',', ap));
+  const std::string vrep = ptpu::invar::CheckJson(bad, "ps");
+  assert(ptpu::invar::ViolationCount(vrep) == 1);
+  assert(vrep.find("\"conn_balance\"") != std::string::npos);
+
+  ptpu_ps_server_stop(srv);
+  ptpu_ps_table_destroy(t);
+  std::printf("ps invar gate: quiesce, reset, sniff, negative OK\n");
+}
+
 }  // namespace
 
 int main() {
+  // every ptpu_ps_server_stop below runs the conservation gate
+  // fatally (ptpu::invar::GateQuiesced abort()s under this env)
+  setenv("PTPU_INVAR_FATAL", "1", 1);
   test_pull_gathers_rows();
   test_pull_bounds_checked();
   test_push_sgd_coalesces_duplicates();
@@ -424,6 +487,7 @@ int main() {
   test_stats_hist_buckets();
   test_sha256_known_vector();
   test_server_pull_push_roundtrip();
+  test_invar_ps_gate();
   std::printf("all native ps-table unit tests passed\n");
   return 0;
 }

@@ -40,6 +40,7 @@
 #include <string>
 #include <vector>
 
+#include "ptpu_invar.h"
 #include "ptpu_net.h"
 #include "ptpu_ps_table.h"
 #include "ptpu_stats.h"
@@ -168,6 +169,16 @@ struct PsServer {
   // second (HTTP) listener: the brpc /vars-/rpcz-style surface
   // (shared routes — csrc/ptpu_net.cc TelemetryHttp).
   ptpu::net::HttpReply HandleHttp(const std::string &target) {
+    const std::string path = target.substr(0, target.find('?'));
+    if (path == "/invarz") {
+      // conservation-law report (ISSUE 20) — authoritative at
+      // quiesce, informational while pulls/pushes are in flight
+      ptpu::net::HttpReply rep;
+      rep.content_type = "application/json";
+      rep.body = ptpu::invar::CheckJson(StatsJson(), "ps");
+      rep.body += '\n';
+      return rep;
+    }
     return ptpu::net::TelemetryHttp(
         target, [this] { return StatsJson(); }, "ptpu_ps",
         /*draining=*/false);
@@ -180,6 +191,9 @@ struct PsServer {
     // graceful drain: stop accepting, flush queued replies, close
     net_srv->Stop();
     net_srv.reset();
+    // conservation-law gate (ISSUE 20): drained == quiescent — the
+    // point where every `==` law must hold exactly
+    ptpu::invar::GateQuiesced(StatsJson(), "ps", "ps.Stop");
   }
 
   bool SendErr(const ptpu::net::ConnPtr &conn, const std::string &msg) {
@@ -436,6 +450,7 @@ std::string PsServer::StatsJson() {
       {"cpu_us", &st.cpu_us},
       {"handshake_fails", &nt.handshake_fails},
       {"conns_accepted", &nt.conns_accepted},
+      {"conns_closed", &nt.conns_closed},
       {"conns_shed", &nt.conns_shed},
       {"handshake_timeouts", &nt.handshake_timeouts},
       {"idle_closes", &nt.idle_closes},

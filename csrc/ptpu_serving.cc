@@ -70,6 +70,7 @@
 #include <vector>
 
 #include "ptpu_inference_api.h"
+#include "ptpu_invar.h"
 #include "ptpu_net.h"
 #include "ptpu_schedck.h"
 #include "ptpu_stats.h"
@@ -234,7 +235,10 @@ struct SvRequest {
 // Always-on counters/histograms (csrc/ptpu_stats.h relaxed atomics).
 // Connection-lifecycle counters live in the embedded net-core stats.
 struct SvStats {
-  ptpu::Counter requests, replies, req_errors, batches,
+  // req_errors answers INFER requests (the req_balance law's error
+  // term); op_errors answers decode/meta ops; err_frames is the
+  // total — exactly their sum (err_split law, csrc/ptpu_invar.h)
+  ptpu::Counter requests, replies, req_errors, op_errors, batches,
       batched_requests, batched_rows, bucket_miss, full_flushes,
       deadline_flushes, bytes_in, bytes_out, err_frames, proto_errors;
   // CPU microseconds this plane burned handling requests (parse +
@@ -247,9 +251,23 @@ struct SvStats {
 
   void Reset() {
     cpu_us.Reset();
-    requests.Reset();
-    replies.Reset();
-    req_errors.Reset();
+    // Invariant-preserving reset (ISSUE 20): requests in flight at
+    // reset time have been counted but not yet answered, so zeroing
+    // would leave requests != replies + req_errors FOREVER after.
+    // Rebasing both sides of the req_balance law by the same amount
+    // (completed work so far) preserves it by construction — no
+    // multi-counter atomic snapshot needed, racing traffic cancels.
+    // Post-reset, `requests` reads as in-flight + accepted-since.
+    const uint64_t rep_base = replies.Get();
+    const uint64_t err_base = req_errors.Get();
+    const uint64_t op_base = op_errors.Get();
+    requests.Rebase(rep_base + err_base);
+    replies.Rebase(rep_base);
+    req_errors.Rebase(err_base);
+    // err_split law: err_frames == req_errors + op_errors — rebase
+    // the total by the sum of the bases taken from its terms
+    op_errors.Rebase(op_base);
+    err_frames.Rebase(err_base + op_base);
     batches.Reset();
     batched_requests.Reset();
     batched_rows.Reset();
@@ -258,7 +276,6 @@ struct SvStats {
     deadline_flushes.Reset();
     bytes_in.Reset();
     bytes_out.Reset();
-    err_frames.Reset();
     proto_errors.Reset();
     queue_depth.Reset();
     batch_fill.Reset();
@@ -507,9 +524,22 @@ struct DecStats {
   ptpu::Histogram run_us, batch_fill, restore_us;
   void Reset() {
     cpu_us.Reset();
-    opens.Reset();
-    closes.Reset();
-    evictions.Reset();
+    // Invariant-preserving reset (ISSUE 20), same construction as
+    // SvStats: rebase both sides of the session_balance law
+    //   opens == closes + evictions + live gauges
+    // by completed exits so far; live/hibernated sessions carry over
+    // into the post-reset ledger. hibernates/restores rebase by the
+    // same amount (restores so far) to keep hibernate_flow, and
+    // forks zeroes (every fork also bumps opens, so forks_are_opens
+    // survives any base).
+    const uint64_t close_base = closes.Get();
+    const uint64_t evict_base = evictions.Get();
+    opens.Rebase(close_base + evict_base);
+    closes.Rebase(close_base);
+    evictions.Rebase(evict_base);
+    const uint64_t restore_base = restores.Get();
+    hibernates.Rebase(restore_base);
+    restores.Rebase(restore_base);
     steps.Reset();
     replies.Reset();
     batches.Reset();
@@ -525,8 +555,6 @@ struct DecStats {
     spec_tokens.Reset();
     spec_draft_steps.Reset();
     spec_fallbacks.Reset();
-    hibernates.Reset();
-    restores.Reset();
     spill_exhausted.Reset();
     run_us.Reset();
     batch_fill.Reset();
@@ -1448,6 +1476,16 @@ struct SvServer {
       rep.body += '\n';
       return rep;
     }
+    if (path == "/invarz") {
+      // conservation-law report over a fresh snapshot (ISSUE 20).
+      // Served any time; `==` laws are authoritative only at quiesce
+      // (ptpu_invar.h) — mid-flight requests legitimately skew them.
+      ptpu::net::HttpReply rep;
+      rep.content_type = "application/json";
+      rep.body = ptpu::invar::CheckJson(StatsJson(), "serving");
+      rep.body += '\n';
+      return rep;
+    }
     return ptpu::net::TelemetryHttp(
         target, [this] { return StatsJson(); }, "ptpu_serving",
         draining.load(std::memory_order_relaxed) ||
@@ -1477,8 +1515,8 @@ struct SvServer {
     return 6;
   }
 
-  void SendErrFrame(const ptpu::net::ConnPtr& conn, uint64_t id,
-                    const std::string& msg) {
+  void SendErrFrameRaw(const ptpu::net::ConnPtr& conn, uint64_t id,
+                       const std::string& msg) {
     std::vector<uint8_t> f = conn->AcquireBuf();
     f.resize(4 + 2 + 8 + 4 + msg.size());
     f[4] = kSvWireVersion;
@@ -1486,10 +1524,27 @@ struct SvServer {
     std::memcpy(f.data() + 6, &id, 8);
     PutU32(f.data() + 14, uint32_t(msg.size()));
     std::memcpy(f.data() + 18, msg.data(), msg.size());
-    stats.err_frames.Add(1);
-    stats.req_errors.Add(1);
     stats.bytes_out.Add(f.size());
     conn->SendPayload(std::move(f));
+  }
+
+  // ERR frames answering INFER requests: the req_balance error term
+  // (see csrc/ptpu_invar.h — requests == replies + req_errors)
+  void SendErrFrame(const ptpu::net::ConnPtr& conn, uint64_t id,
+                    const std::string& msg) {
+    stats.err_frames.Add(1);
+    stats.req_errors.Add(1);
+    SendErrFrameRaw(conn, id, msg);
+  }
+
+  // ERR frames answering decode/meta ops (never counted in
+  // stats.requests): bump op_errors so req_balance stays exact and
+  // err_split (err_frames == req_errors + op_errors) stays total
+  void SendOpErrFrame(const ptpu::net::ConnPtr& conn, uint64_t id,
+                      const std::string& msg) {
+    stats.err_frames.Add(1);
+    stats.op_errors.Add(1);
+    SendErrFrameRaw(conn, id, msg);
   }
 
   void RunBatch(int instance, std::vector<SvRequest>& batch) {
@@ -1892,7 +1947,7 @@ struct SvServer {
     // the client waits forever on a session that no longer exists
     auto jit = prefills_.find(victim);
     if (jit != prefills_.end()) {
-      SendErrFrame(jit->second->conn, jit->second->rid,
+      SendOpErrFrame(jit->second->conn, jit->second->rid,
                    "decode session evicted");
       jit->second->conn->NotePending(-1);
       prefills_.erase(jit);
@@ -2042,6 +2097,11 @@ struct SvServer {
     if (it->second.slot >= 0)
       ptpu_predictor_kv_close(dec_pred, it->second.slot);
     CloseSpecLocked(it->second);
+    // tombstones (slot -1, no hibernation record) already exited the
+    // session_balance ledger as evictions: closing one later must
+    // not count a second exit
+    const bool counted_exit =
+        it->second.slot >= 0 || !it->second.hib.empty();
     DropHibLocked(it->second);
     sessions_.erase(it);
     // a prefilling session closed out from under its job (only
@@ -2053,7 +2113,7 @@ struct SvServer {
       jit->second->conn->NotePending(-1);
       prefills_.erase(jit);
     }
-    dstats.closes.Add(1);
+    if (counted_exit) dstats.closes.Add(1);
     return true;
   }
 
@@ -2076,6 +2136,12 @@ struct SvServer {
     ptpu::MutexLock l(sess_mu_);
     for (auto it = sessions_.begin(); it != sessions_.end();) {
       if (it->second.owner == conn) {
+        // a live or hibernated session dying with its conn IS a
+        // close — the session_balance ledger (csrc/ptpu_invar.h)
+        // counts every exit exactly once. Tombstones already exited
+        // as evictions and must not count twice.
+        if (it->second.slot >= 0 || !it->second.hib.empty())
+          dstats.closes.Add(1);
         if (it->second.slot >= 0)
           ptpu_predictor_kv_close(dec_pred, it->second.slot);
         CloseSpecLocked(it->second);
@@ -2106,7 +2172,7 @@ struct SvServer {
       ptpu::MutexLock kl(kv_mu_);
       ptpu::MutexLock l(sess_mu_);
       if (!OpenSlotLocked(conn, &sess, &why)) {
-        SendErrFrame(conn, rid, why);
+        SendOpErrFrame(conn, rid, why);
         return;
       }
       if (kv_pool)
@@ -2231,14 +2297,16 @@ struct SvServer {
       ptpu::MutexLock kl(kv_mu_);
       ptpu::MutexLock l(sess_mu_);
       if (!OpenSlotLocked(conn, &sess, &why)) {
-        SendErrFrame(conn, rid, why);
+        SendOpErrFrame(conn, rid, why);
         return;
       }
       const int dslot = ptpu_kvpool_open(draft_pool);
       if (dslot < 0) {
         ptpu_predictor_kv_close(dec_pred, sessions_[sess].slot);
         sessions_.erase(sess);
-        SendErrFrame(conn, rid, "no draft KV session slots");
+        // the open above already counted: this exit balances it
+        dstats.closes.Add(1);
+        SendOpErrFrame(conn, rid, "no draft KV session slots");
         return;
       }
       const int64_t adopted = ptpu_kvpool_adopt(
@@ -2330,11 +2398,14 @@ struct SvServer {
       if (sit != sessions_.end()) {
         slot = sit->second.slot;
         CloseSpecLocked(sit->second);
+        // a failed prefill exits its (live) session: balance opens
+        if (sit->second.slot >= 0 || !sit->second.hib.empty())
+          dstats.closes.Add(1);
         sessions_.erase(sit);
       }
     }
     if (slot >= 0) ptpu_predictor_kv_close(dec_pred, slot);
-    SendErrFrame(conn, rid, "prefill: " + why);
+    SendOpErrFrame(conn, rid, "prefill: " + why);
     conn->NotePending(-1);
   }
 
@@ -2549,7 +2620,7 @@ struct SvServer {
       PrefillRowError(r->session, why);
       return;
     }
-    SendErrFrame(r->conn, r->id, why);
+    SendOpErrFrame(r->conn, r->id, why);
     r->conn->NotePending(-1);
   }
 
@@ -2570,14 +2641,14 @@ struct SvServer {
           std::string why;
           if (!RestoreLocked(it->second, &why)) {
             if (r->is_prefill) continue;
-            SendErrFrame(r->conn, r->id, why);
+            SendOpErrFrame(r->conn, r->id, why);
             r->conn->NotePending(-1);
             continue;
           }
         }
         if (it == sessions_.end() || it->second.slot < 0) {
           if (r->is_prefill) continue;  // job died with its session
-          SendErrFrame(r->conn, r->id,
+          SendOpErrFrame(r->conn, r->id,
                        it == sessions_.end() ? "unknown decode session"
                                              : "decode session evicted");
           r->conn->NotePending(-1);
@@ -2588,14 +2659,14 @@ struct SvServer {
         // mixing plain steps in would desync the committed history
         if (r->is_spec) {
           if (!it->second.spec) {
-            SendErrFrame(r->conn, r->id,
+            SendOpErrFrame(r->conn, r->id,
                          "not a speculative session (open it with "
                          "DECODE_SPEC_OPEN)");
             r->conn->NotePending(-1);
             continue;
           }
           if (prefills_.count(r->session)) {
-            SendErrFrame(r->conn, r->id, "session is still prefilling");
+            SendOpErrFrame(r->conn, r->id, "session is still prefilling");
             r->conn->NotePending(-1);
             continue;
           }
@@ -2605,7 +2676,7 @@ struct SvServer {
           continue;
         }
         if (it->second.spec && !r->is_prefill) {
-          SendErrFrame(r->conn, r->id,
+          SendOpErrFrame(r->conn, r->id,
                        "speculative session: use DECODE_SPEC_STEP");
           r->conn->NotePending(-1);
           continue;
@@ -2748,7 +2819,7 @@ struct SvServer {
         if (it == sessions_.end() || it->second.slot < 0 ||
             !it->second.spec) {
           // validated at de-queue; re-check after regaining the locks
-          SendErrFrame(c.r->conn, c.r->id, "decode session lost");
+          SendOpErrFrame(c.r->conn, c.r->id, "decode session lost");
           c.r->conn->NotePending(-1);
           c.dead = true;
           continue;
@@ -2789,7 +2860,7 @@ struct SvServer {
       }
       if (why.find("kv pool exhausted") != std::string::npos)
         dstats.pool_exhausted.Add(1);
-      SendErrFrame(c.r->conn, c.r->id, why);
+      SendOpErrFrame(c.r->conn, c.r->id, why);
       c.r->conn->NotePending(-1);
       c.dead = true;
     };
@@ -3155,7 +3226,7 @@ struct SvServer {
       if (n < 2 + ext + 8) return proto_err();
       const uint64_t rid = ptpu::GetU64(req + 2 + ext);
       if (!dec_pred) {
-        SendErrFrame(conn, rid, "decode serving not configured (start "
+        SendOpErrFrame(conn, rid, "decode serving not configured (start "
                                 "the server with a decode_model)");
         return FrameResult::kOk;
       }
@@ -3167,11 +3238,11 @@ struct SvServer {
         if (uint64_t(n) != 2 + ext + 8 + 4 + 4 + 8ull * ntok)
           return proto_err();
         if (flags != 0) {
-          SendErrFrame(conn, rid, "unknown DECODE_OPEN2 flags");
+          SendOpErrFrame(conn, rid, "unknown DECODE_OPEN2 flags");
           return FrameResult::kOk;
         }
         if (ntok < 1 || int64_t(ntok) > dec_ctx) {
-          SendErrFrame(conn, rid,
+          SendOpErrFrame(conn, rid,
                        "prompt length outside [1, context=" +
                            std::to_string(dec_ctx) + "]");
           return FrameResult::kOk;
@@ -3188,7 +3259,7 @@ struct SvServer {
         uint64_t nsess = 0;
         std::string why;
         if (!DecodeFork(conn, src, &nsess, &why)) {
-          SendErrFrame(conn, rid, why);
+          SendOpErrFrame(conn, rid, why);
           return FrameResult::kOk;
         }
         std::vector<uint8_t> f = conn->AcquireBuf();
@@ -3210,17 +3281,17 @@ struct SvServer {
         if (uint64_t(n) != 2 + ext + 8 + 4 + 4 + 8 + 8ull * ntok)
           return proto_err();
         if (spec_k <= 0) {
-          SendErrFrame(conn, rid,
+          SendOpErrFrame(conn, rid,
                        "speculative decoding not configured (start "
                        "the server with spec draft/verify models)");
           return FrameResult::kOk;
         }
         if (flags & ~1u) {
-          SendErrFrame(conn, rid, "unknown DECODE_SPEC_OPEN flags");
+          SendOpErrFrame(conn, rid, "unknown DECODE_SPEC_OPEN flags");
           return FrameResult::kOk;
         }
         if (ntok < 1 || int64_t(ntok) >= dec_ctx) {
-          SendErrFrame(conn, rid,
+          SendOpErrFrame(conn, rid,
                        "prompt length outside [1, context=" +
                            std::to_string(dec_ctx) + ")");
           return FrameResult::kOk;
@@ -3235,7 +3306,7 @@ struct SvServer {
       if (tag == kTagDecodeSpecStep) {
         if (n != 2 + ext + 8 + 8) return proto_err();
         if (spec_k <= 0) {
-          SendErrFrame(conn, rid,
+          SendOpErrFrame(conn, rid,
                        "speculative decoding not configured (start "
                        "the server with spec draft/verify models)");
           return FrameResult::kOk;
@@ -3264,7 +3335,7 @@ struct SvServer {
         if (why == "request queue full" &&
             conn->deferred_us() < kSvDeferBudgetUs)
           return FrameResult::kDefer;
-        SendErrFrame(conn, rid, why);
+        SendOpErrFrame(conn, rid, why);
         return FrameResult::kOk;
       }
       if (tag == kTagDecodeOpen) {
@@ -3272,7 +3343,7 @@ struct SvServer {
         uint64_t sess = 0;
         std::string why;
         if (!DecodeOpen(conn, &sess, &why)) {
-          SendErrFrame(conn, rid, why);
+          SendOpErrFrame(conn, rid, why);
           return FrameResult::kOk;
         }
         std::vector<uint8_t> f = conn->AcquireBuf();
@@ -3290,7 +3361,7 @@ struct SvServer {
         const uint64_t sess = ptpu::GetU64(req + 10 + ext);
         std::string why;
         if (!DecodeClose(sess, &why)) {
-          SendErrFrame(conn, rid, why);
+          SendOpErrFrame(conn, rid, why);
           return FrameResult::kOk;
         }
         std::vector<uint8_t> f = conn->AcquireBuf();
@@ -3329,7 +3400,7 @@ struct SvServer {
       if (why == "request queue full" &&
           conn->deferred_us() < kSvDeferBudgetUs)
         return FrameResult::kDefer;  // cheap 26-byte re-parse on retry
-      SendErrFrame(conn, rid, why);
+      SendOpErrFrame(conn, rid, why);
       return FrameResult::kOk;
     }
     if (tag != kTagInferReq) return proto_err();
@@ -3469,20 +3540,32 @@ struct SvServer {
         ptpu::MutexLock l(sess_mu_);
         auto it = prefills_.find(r.session);
         if (it != prefills_.end()) {
-          SendErrFrame(it->second->conn, it->second->rid,
+          SendOpErrFrame(it->second->conn, it->second->rid,
                        "server stopping");
           it->second->conn->NotePending(-1);
           prefills_.erase(it);
         }
         continue;
       }
-      SendErrFrame(r.conn, r.id, "server stopping");
+      // leftover deque mixes INFER requests (counted in
+      // stats.requests) with decode steps/rounds (counted in
+      // dstats.steps): answer each on its own error ledger
+      if (r.is_decode)
+        SendOpErrFrame(r.conn, r.id, "server stopping");
+      else
+        SendErrFrame(r.conn, r.id, "server stopping");
       r.conn->NotePending(-1);  // pairs the enqueue-time +1
     }
     if (net_srv) {
       net_srv->Drain();
       net_srv.reset();
     }
+    // conservation-law gate (ISSUE 20): the server is quiescent here
+    // — drained, every queued request answered, sessions and pools
+    // still alive — exactly when the `==` laws must hold. Logs the
+    // report on violation (PTPU_INVAR_OFF=1 disables); selftests and
+    // benches assert the same report is clean via the ABI.
+    ptpu::invar::GateQuiesced(StatsJson(), "serving", "serving.Stop");
     batcher.reset();
     dec_batcher.reset();
     // prefix-cache persistence (ISSUE 19): snapshot the adopt index
@@ -3540,10 +3623,12 @@ struct SvServer {
         {"requests", &stats.requests},
         {"replies", &stats.replies},
         {"req_errors", &stats.req_errors},
+        {"op_errors", &stats.op_errors},
         {"err_frames", &stats.err_frames},
         {"proto_errors", &stats.proto_errors},
         {"handshake_fails", &net.handshake_fails},
         {"conns_accepted", &net.conns_accepted},
+        {"conns_closed", &net.conns_closed},
         {"conns_shed", &net.conns_shed},
         {"handshake_timeouts", &net.handshake_timeouts},
         {"idle_closes", &net.idle_closes},

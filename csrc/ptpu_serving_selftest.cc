@@ -17,6 +17,7 @@
 #include "ptpu_net.cc"
 #include "ptpu_trace.cc"
 #include "ptpu_predictor.cc"
+#include "ptpu_invar.cc"
 #include "ptpu_serving.cc"
 #include "ptpu_onnx_writer.h"
 
@@ -1802,9 +1803,102 @@ void test_conn_death_with_pinned_output() {
   std::printf("  conn death with pinned output releases cleanly    OK\n");
 }
 
+/* ISSUE 20: the counter-conservation runtime gate. (1) stats_reset
+ * racing live traffic preserves every law by construction
+ * (Counter::Rebase — no quiesce needed to reset); (2) a served
+ * workload's quiesced snapshot passes every manifest law via the C++
+ * gate, the C ABI, and plane sniffing; (3) a doctored snapshot (one
+ * lost reply bump) trips req_balance — the runtime half of the
+ * end-to-end negative whose static half lives in
+ * tests/test_static_checks.py; (4) PTPU_INVAR_OFF kills the gate. */
+void test_invar_conservation_gate() {
+  std::vector<float> W;
+  const int64_t K = 8, N = 4;
+  const std::string path = write_model_file(
+      build_matmul_model(2, K, N, &W), "ptpu_sv_selftest_invar.onnx");
+  char err[512] = {0};
+  void* h = ptpu_serving_start(path.c_str(), 0, "sv-test-key", 11,
+                               /*max_batch=*/2, /*deadline_us=*/200,
+                               /*instances=*/1,
+                               /*threads_per_instance=*/1,
+                               /*loopback=*/1, err, 512);
+  assert(h != nullptr && "serving start failed");
+  const int port = ptpu_serving_port(h);
+
+  // leg 1 — resets racing live traffic: whatever the interleaving,
+  // the rebase arithmetic must leave every law exact at quiesce
+  std::thread load([&] {
+    SvTestClient cli;
+    assert(cli.connect_to(port) && cli.handshake("sv-test-key"));
+    std::vector<float> x(2 * size_t(K), 0.25f);
+    for (int i = 0; i < 60; ++i) {
+      std::vector<uint8_t> rep;
+      assert(cli.infer(uint64_t(i), x.data(), 2, K, &rep));
+    }
+    cli.close();
+  });
+  for (int i = 0; i < 12; ++i) {
+    ptpu_serving_stats_reset(h);
+    std::this_thread::sleep_for(std::chrono::milliseconds(2));
+  }
+  load.join();
+
+  // repopulate after the last reset so the doctored-snapshot leg has
+  // a nonzero ledger to corrupt
+  {
+    SvTestClient cli;
+    assert(cli.connect_to(port) && cli.handshake("sv-test-key"));
+    std::vector<float> x(2 * size_t(K), 0.5f);
+    for (int i = 0; i < 5; ++i) {
+      std::vector<uint8_t> rep;
+      assert(cli.infer(uint64_t(100 + i), x.data(), 2, K, &rep));
+    }
+    cli.close();
+  }
+
+  // quiesce: wait out the async close bookkeeping
+  std::string js;
+  for (int i = 0; i < 400; ++i) {
+    js = ptpu_serving_stats_json(h);
+    if (js.find("\"conns_active\":0") != std::string::npos) break;
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  }
+  assert(ptpu::invar::GateQuiesced(js, "serving", "selftest") == 0);
+
+  // leg 2 — C ABI + plane sniffing (NULL plane resolves to serving)
+  const std::string rep = ptpu_invar_check_json(js.c_str(), nullptr);
+  assert(ptpu::invar::ViolationCount(rep) == 0);
+  assert(rep.find("\"plane\":\"serving\"") != std::string::npos);
+  assert(rep.find("\"enabled\":1") != std::string::npos);
+  const std::string manifest = ptpu_invar_manifest();
+  assert(manifest.find("conn_balance") != std::string::npos);
+
+  // leg 3 — lose one reply bump: req_balance must trip
+  const size_t rp = js.find("\"replies\":");
+  assert(rp != std::string::npos);
+  const std::string bad = js.substr(0, rp) + "\"replies\":0" +
+                          js.substr(js.find(',', rp));
+  const std::string vrep = ptpu::invar::CheckJson(bad, "serving");
+  assert(ptpu::invar::ViolationCount(vrep) == 1);
+  assert(vrep.find("\"req_balance\"") != std::string::npos);
+
+  // leg 4 — kill switch: same corruption, gate disabled and clean
+  setenv("PTPU_INVAR_OFF", "1", 1);
+  const std::string off = ptpu::invar::CheckJson(bad, "serving");
+  assert(off.find("\"enabled\":0") != std::string::npos);
+  assert(ptpu::invar::ViolationCount(off) == 0);
+  unsetenv("PTPU_INVAR_OFF");
+
+  ptpu_serving_stop(h);
+  std::printf("  invar gate: reset under load, ABI, negative, kill  OK\n");
+}
+
 }  // namespace
 
 int main() {
+  // every ptpu_serving_stop below runs the conservation gate fatally
+  // (ptpu::invar::GateQuiesced abort()s on violation under this env)
+  setenv("PTPU_INVAR_FATAL", "1", 1);
   test_wire_codec_round_trip();
   test_batcher_deadline_flush();
   test_batcher_full_flush_and_partial_final();
@@ -1824,6 +1918,7 @@ int main() {
   test_reply_pin_outlives_slow_reader();
   test_defer_retry_with_pinned_buffer();
   test_conn_death_with_pinned_output();
+  test_invar_conservation_gate();
   std::printf("ptpu_serving_selftest: all native serving unit tests "
               "passed\n");
   return 0;

@@ -48,6 +48,17 @@ struct Counter {
   void Add(uint64_t d) { v.fetch_add(d, std::memory_order_relaxed); }
   uint64_t Get() const { return v.load(std::memory_order_relaxed); }
   void Reset() { v.store(0, std::memory_order_relaxed); }
+  // Subtract a previously-read base without losing racing bumps —
+  // the invariant-preserving stats_reset primitive (ISSUE 20):
+  // zeroing a flow counter mid-flight breaks conservation laws
+  // (requests == replies + errors), but subtracting a base that
+  // itself satisfies the law preserves it by construction, racing
+  // traffic included (the skew cancels algebraically). Unsigned
+  // wraparound is the correct arithmetic here: base was read from
+  // this counter, so the running sum stays non-negative.
+  void Rebase(uint64_t base) {
+    v.fetch_sub(base, std::memory_order_relaxed);
+  }
 };
 
 // Log2 histogram: bucket 0 counts value 0, bucket b (1..kHistBuckets-2)

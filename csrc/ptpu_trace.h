@@ -39,6 +39,7 @@
 #include <atomic>
 #include <cstdint>
 #include <string>
+#include <utility>
 #include <vector>
 
 namespace ptpu {
@@ -193,6 +194,162 @@ Recorder& Global();
 // ---------------------------------------------------------------------------
 std::string PromFromStatsJson(const std::string& stats_json,
                               const std::string& prefix);
+
+// ---------------------------------------------------------------------------
+// Restricted JSON reader — the walker behind PromFromStatsJson, shared
+// with the ptpu_invar conservation-law engine (csrc/ptpu_invar.cc).
+// Parses exactly the grammar OUR renderers emit: objects, unsigned
+// integers, arrays of unsigned integers, escaped strings. Header-only
+// so every single-TU selftest and fuzz harness (csrc/fuzz/fuzz_json.cc
+// keeps this walker under coverage-guided fuzzing) compiles the same
+// code the shipping .so's run.
+// ---------------------------------------------------------------------------
+namespace rj {
+
+struct JNode {
+  enum Kind { kNum, kStr, kArr, kObj } kind = kNum;
+  uint64_t num = 0;
+  std::string str;
+  std::vector<uint64_t> arr;
+  std::vector<std::pair<std::string, JNode>> obj;  // insertion order
+};
+
+struct JParser {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  void Ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' ||
+                       *p == '\r'))
+      ++p;
+  }
+
+  bool Eat(char c) {
+    Ws();
+    if (p < end && *p == c) {
+      ++p;
+      return true;
+    }
+    ok = false;
+    return false;
+  }
+
+  std::string Str() {
+    std::string s;
+    if (!Eat('"')) return s;
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        ++p;
+        switch (*p) {
+          case 'n': s += '\n'; break;
+          case 't': s += '\t'; break;
+          case 'r': s += '\r'; break;
+          case '"': s += '"'; break;
+          case '\\': s += '\\'; break;
+          default: s += *p; break;  // \uXXXX never emitted for names
+        }
+        ++p;
+      } else {
+        s += *p++;
+      }
+    }
+    if (p < end) ++p;  // closing quote
+    else ok = false;
+    return s;
+  }
+
+  uint64_t Num() {
+    Ws();
+    uint64_t v = 0;
+    bool any = false;
+    while (p < end && *p >= '0' && *p <= '9') {
+      v = v * 10 + uint64_t(*p - '0');
+      ++p;
+      any = true;
+    }
+    if (!any) ok = false;
+    return v;
+  }
+
+  JNode Value(int depth) {
+    JNode n;
+    Ws();
+    if (!ok || depth > 16 || p >= end) {
+      ok = false;
+      return n;
+    }
+    if (*p == '{') {
+      ++p;
+      n.kind = JNode::kObj;
+      Ws();
+      if (p < end && *p == '}') {
+        ++p;
+        return n;
+      }
+      for (;;) {
+        std::string k = Str();
+        if (!Eat(':')) break;
+        n.obj.emplace_back(std::move(k), Value(depth + 1));
+        Ws();
+        if (p < end && *p == ',') {
+          ++p;
+          continue;
+        }
+        Eat('}');
+        break;
+      }
+      return n;
+    }
+    if (*p == '[') {
+      ++p;
+      n.kind = JNode::kArr;
+      Ws();
+      if (p < end && *p == ']') {
+        ++p;
+        return n;
+      }
+      for (;;) {
+        n.arr.push_back(Num());
+        Ws();
+        if (p < end && *p == ',') {
+          ++p;
+          continue;
+        }
+        Eat(']');
+        break;
+      }
+      return n;
+    }
+    if (*p == '"') {
+      n.kind = JNode::kStr;
+      n.str = Str();
+      return n;
+    }
+    n.kind = JNode::kNum;
+    n.num = Num();
+    return n;
+  }
+};
+
+inline bool IsHist(const JNode& n) {
+  if (n.kind != JNode::kObj) return false;
+  bool c = false, s = false, b = false;
+  for (const auto& kv : n.obj) {
+    if (kv.first == "count") c = true;
+    else if (kv.first == "sum") s = true;
+    else if (kv.first == "buckets") b = true;
+  }
+  return c && s && b;
+}
+
+inline const JNode* HistField(const JNode& n, const char* name) {
+  for (const auto& kv : n.obj)
+    if (kv.first == name) return &kv.second;
+  return nullptr;
+}
+
+}  // namespace rj
 
 }  // namespace trace
 }  // namespace ptpu

@@ -190,7 +190,13 @@ int main() {
     CHECK(r.recorded() == uint64_t(kThreads) * kPer);
     std::vector<SpanView> got;
     r.Snapshot(&got, 4096);
-    CHECK(got.size() == 1024);  // quiescent: nothing torn
+    // Quiescent: no slot is mid-write, but a writer that claimed
+    // index X and stalled past a later writer on the same slot
+    // (X + ring) leaves that slot's seq at the OLDER generation, and
+    // Snapshot rightly skips it — at most one slot per concurrent
+    // stale writer, so kThreads-1 worst case.
+    CHECK(got.size() >= 1024 - (kThreads - 1));
+    CHECK(got.size() <= 1024);
     for (const auto& v : got) CHECK(v.t1_us == v.t0_us + 1);
   }
 

@@ -414,6 +414,17 @@ def _ps_load() -> Optional[ctypes.CDLL]:
             lib._ptpu_has_capture = True
         except AttributeError:   # stale prebuilt .so: capture off
             lib._ptpu_has_capture = False
+        try:
+            # counter-conservation invariant gate (ISSUE 20): the C
+            # evaluator over the same manifest profiler/stats.py twins
+            lib.ptpu_invar_check_json.restype = c.c_char_p
+            lib.ptpu_invar_check_json.argtypes = [c.c_char_p,
+                                                  c.c_char_p]
+            lib.ptpu_invar_manifest.restype = c.c_char_p
+            lib.ptpu_invar_manifest.argtypes = []
+            lib._ptpu_has_invar = True
+        except AttributeError:   # stale prebuilt .so: gate off
+            lib._ptpu_has_invar = False
         _PS_LIB = lib
         return _PS_LIB
 
@@ -833,6 +844,17 @@ def _predictor_lib() -> ctypes.CDLL:
             lib._ptpu_has_spill = True
         except AttributeError:   # stale prebuilt .so: tiering off
             lib._ptpu_has_spill = False
+        try:
+            # counter-conservation invariant gate (ISSUE 20): the C
+            # evaluator over the same manifest profiler/stats.py twins
+            lib.ptpu_invar_check_json.restype = c.c_char_p
+            lib.ptpu_invar_check_json.argtypes = [c.c_char_p,
+                                                  c.c_char_p]
+            lib.ptpu_invar_manifest.restype = c.c_char_p
+            lib.ptpu_invar_manifest.argtypes = []
+            lib._ptpu_has_invar = True
+        except AttributeError:   # stale prebuilt .so: gate off
+            lib._ptpu_has_invar = False
         # Wire the host profiler (csrc/ptpu_runtime.cc, a separate .so)
         # into the predictor: per-op RecordEvent spans when profiling
         # is on, so serving runs land in the same chrome trace as
@@ -1396,6 +1418,7 @@ ABI_SYMBOLS = {
         "ptpu_ps_server_stats_reset", "ptpu_ps_server_prom_text",
         "ptpu_trace_set", "ptpu_trace_json",
         "ptpu_capture_set", "ptpu_capture_json", "ptpu_capture_save",
+        "ptpu_invar_check_json", "ptpu_invar_manifest",
     ),
     "_native_predictor.so": (
         "ptpu_predictor_create", "ptpu_predictor_create_opts",
@@ -1439,6 +1462,7 @@ ABI_SYMBOLS = {
         "ptpu_serving_stats_reset", "ptpu_serving_prom_text",
         "ptpu_serving_stop", "ptpu_trace_set", "ptpu_trace_json",
         "ptpu_capture_set", "ptpu_capture_json", "ptpu_capture_save",
+        "ptpu_invar_check_json", "ptpu_invar_manifest",
         "ptpu_tune_stats_json", "ptpu_tune_save", "ptpu_tune_load",
         "ptpu_tune_clear",
     ),

@@ -20,6 +20,7 @@ One small metrics core shared by every layer that reports:
 """
 from __future__ import annotations
 
+import os
 import threading
 from typing import Dict, Optional
 
@@ -229,3 +230,201 @@ def prometheus_text(snapshot: dict, prefix: str = "ptpu",
 
     walk([], snapshot, base)
     return "\n".join(lines) + "\n"
+
+
+# --------------------------------------------------------------------
+# Counter-conservation invariants (ISSUE 20) — the Python twin of the
+# native manifest in csrc/ptpu_invar.h. The two strings are
+# TOKEN-IDENTICAL (enforced by `python3 tools/ptpu_check.py --check
+# invar` and, against a live .so, by ptpu_invar_manifest()), so this
+# evaluator needs neither codegen nor a csrc/ checkout. Grammar and
+# quiesce semantics: see the header comment of csrc/ptpu_invar.h.
+
+INVAR_MANIFEST = """\
+# ptpu_invar manifest — counter conservation laws (twin: profiler/stats.py)
+
+# ---- serving + PS shared net plane (csrc/ptpu_net.cc) ----
+counter serving,ps server.conns_accepted csrc/ptpu_net.cc stats_->conns_accepted
+counter serving,ps server.conns_closed csrc/ptpu_net.cc stats_->conns_closed
+counter serving,ps server.handshake_fails csrc/ptpu_net.cc stats_->handshake_fails
+counter serving,ps server.handshake_timeouts csrc/ptpu_net.cc stats_->handshake_timeouts
+gauge serving,ps server.conns_active csrc/ptpu_net.cc active_conns
+
+# every framed conn accepted is either still active or was closed —
+# exact because accept pairs accepted++ with active++ and FinishClose
+# pairs closed++ with active-- (telemetry HTTP conns are exempt and
+# uncounted on both sides)
+invar serving,ps conn_balance server.conns_accepted == server.conns_active + server.conns_closed
+# handshake failures/timeouts are close reasons of counted conns
+# (idle_closes is NOT listed: HTTP conns may idle-close uncounted)
+invar serving,ps close_reasons server.conns_closed >= server.handshake_fails + server.handshake_timeouts
+
+# ---- serving request plane (csrc/ptpu_serving.cc) ----
+counter serving server.requests csrc/ptpu_serving.cc stats.requests
+counter serving server.replies csrc/ptpu_serving.cc stats.replies
+counter serving server.req_errors csrc/ptpu_serving.cc stats.req_errors
+counter serving server.op_errors csrc/ptpu_serving.cc stats.op_errors
+counter serving server.err_frames csrc/ptpu_serving.cc stats.err_frames
+# the PS data plane reuses the err_frames name for its own ledger
+counter ps server.err_frames csrc/ptpu_ps_server.cc stats.err_frames
+
+# the zero-stuck-requests proof: every accepted INFER request is
+# answered exactly once — a reply or an error frame (replies are
+# counted at send-decision time, so a killed conn still balances;
+# decode/meta op errors land in op_errors, not here)
+invar serving req_balance server.requests == server.replies + server.req_errors
+# every ERR frame is attributed to exactly one plane: INFER
+# (req_errors) or decode/meta op (op_errors) — proto errors close
+# the conn without an ERR frame and count in neither
+invar serving err_split server.err_frames == server.req_errors + server.op_errors
+pair csrc/ptpu_serving.cc stats.req_errors stats.err_frames
+pair csrc/ptpu_serving.cc stats.op_errors stats.err_frames
+
+# ---- decode session ledger (csrc/ptpu_serving.cc, dstats) ----
+counter serving decode.opens csrc/ptpu_serving.cc dstats.opens
+counter serving decode.closes csrc/ptpu_serving.cc dstats.closes
+counter serving decode.evictions csrc/ptpu_serving.cc dstats.evictions
+counter serving decode.hibernates csrc/ptpu_serving.cc dstats.hibernates
+counter serving decode.restores csrc/ptpu_serving.cc dstats.restores
+counter serving decode.forks csrc/ptpu_serving.cc dstats.forks
+gauge serving decode.sessions_active csrc/ptpu_serving.cc sessions_active
+gauge serving decode.sessions_hibernated csrc/ptpu_serving.cc sessions_hibernated
+
+# every session ever opened is live, hibernated, or exited exactly
+# once as a close or an eviction (tombstones count at eviction time;
+# closing a tombstone later is NOT a second exit)
+invar serving session_balance decode.opens == decode.closes + decode.evictions + decode.sessions_active + decode.sessions_hibernated
+invar serving hibernate_flow decode.hibernates >= decode.restores
+# a fork IS an open (fork path bumps both)
+invar serving forks_are_opens decode.opens >= decode.forks
+pair csrc/ptpu_serving.cc dstats.forks dstats.opens
+
+# ---- KV pool page + hibernation ledgers (csrc/ptpu_predictor.cc) ----
+gauge serving decode.pool.pages_total csrc/ptpu_predictor.cc npages_
+gauge serving decode.pool.pages_in_use csrc/ptpu_predictor.cc npages_
+gauge serving decode.pool.pages_free csrc/ptpu_predictor.cc free_
+gauge serving decode.pool.pages_cached csrc/ptpu_predictor.cc pages_cached
+gauge serving decode.pool.sessions_hibernated csrc/ptpu_predictor.cc hib_
+counter serving decode.pool.hibernates csrc/ptpu_predictor.cc hibernates_
+counter serving decode.pool.restores csrc/ptpu_predictor.cc restores_
+counter serving decode.pool.hib_drops csrc/ptpu_predictor.cc hib_drops_
+gauge serving decode.pool.spill_slots_total csrc/ptpu_predictor.cc slots_total
+gauge serving decode.pool.spill_slots_in_use csrc/ptpu_predictor.cc slots_in_use
+
+# page conservation: the pool never leaks or invents a page —
+# rendered under one mu_ hold, so this is exact at ANY instant
+invar serving page_balance decode.pool.pages_total == decode.pool.pages_in_use + decode.pool.pages_free
+# cached (published, ref==1) pages are a subset of in-use pages
+invar serving cache_subset decode.pool.pages_in_use >= decode.pool.pages_cached
+# every hibernation record ever created was restored, dropped, or is
+# still resident in the registry — exact under mu_
+invar serving pool_hib_balance decode.pool.hibernates == decode.pool.restores + decode.pool.hib_drops + decode.pool.sessions_hibernated
+invar serving spill_slots decode.pool.spill_slots_total >= decode.pool.spill_slots_in_use
+"""
+
+
+def _invar_laws():
+    """Parse the ``invar`` lines of INVAR_MANIFEST (counter/gauge/pair
+    declarations feed the static checker, not the evaluator)."""
+    laws = []
+    for line in INVAR_MANIFEST.splitlines():
+        line = line.split("#", 1)[0]
+        tok = line.split()
+        if len(tok) < 6 or tok[0] != "invar":
+            continue
+        rhs = [t for t in tok[5:] if t != "+"]
+        laws.append({
+            "planes": tok[1].split(","),
+            "name": tok[2],
+            "lhs": tok[3],
+            "exact": tok[4] == "==",
+            "rhs": rhs,
+            "text": f"{tok[3]} {tok[4]} " + " + ".join(rhs),
+        })
+    return laws
+
+
+def _invar_resolve(snapshot, path):
+    """Dot-path lookup; ``None`` when a step is missing or the leaf is
+    not an integer (histogram dicts, strings)."""
+    node = snapshot
+    for key in path.split("."):
+        if not isinstance(node, dict) or key not in node:
+            return None
+        node = node[key]
+    if isinstance(node, bool) or not isinstance(node, int):
+        return None
+    return node
+
+
+def invar_check(snapshot, plane: str = "auto") -> dict:
+    """Evaluate every conservation law against a stats snapshot dict.
+
+    Returns the same report shape as the native evaluator
+    (``ptpu_invar_check_json``): ``{"enabled": 0|1, "plane": ...,
+    "checked": N, "skipped": N, "violations": {name: {"law": ...,
+    "detail": ...}}}``. ``==`` laws are authoritative only at quiesce;
+    ``>=`` laws hold at any instant (csrc/ptpu_invar.h). The
+    ``PTPU_INVAR_OFF=1`` kill switch disables the gate here exactly as
+    it does natively."""
+    off = os.environ.get("PTPU_INVAR_OFF", "")
+    if off and off != "0":
+        return {"enabled": 0, "plane": plane, "checked": 0,
+                "skipped": 0, "violations": {}}
+    violations: dict = {}
+    checked = skipped = 0
+    if not isinstance(snapshot, dict):
+        violations["snapshot"] = {
+            "law": "parse",
+            "detail": "stats snapshot is not restricted JSON"}
+        plane = plane if plane not in ("", "auto") else "auto"
+    else:
+        if plane in ("", "auto"):
+            plane = "serving" if "batcher" in snapshot else "ps"
+        for law in _invar_laws():
+            if plane not in law["planes"]:
+                continue
+            lhs = _invar_resolve(snapshot, law["lhs"])
+            if lhs is None:
+                skipped += 1  # optional subsystem: law inactive
+                continue
+            checked += 1
+            total = 0
+            missing = None
+            for term in law["rhs"]:
+                v = _invar_resolve(snapshot, term)
+                if v is None:
+                    missing = term
+                    break
+                total += v
+            if missing is not None:
+                violations[law["name"]] = {
+                    "law": law["text"],
+                    "detail": f"term {missing} missing from snapshot"}
+                continue
+            holds = lhs == total if law["exact"] else lhs >= total
+            if not holds:
+                cmp = "!=" if law["exact"] else "<"
+                violations[law["name"]] = {
+                    "law": law["text"],
+                    "detail": (f"{law['lhs']} = {lhs} {cmp} {total}"
+                               " = sum(rhs)")}
+    return {"enabled": 1, "plane": plane, "checked": checked,
+            "skipped": skipped, "violations": violations}
+
+
+def invar_assert(snapshot, where: str = "", plane: str = "auto") -> dict:
+    """Gate form of :func:`invar_check` — the Python-twin analogue of
+    ``ptpu::invar::GateQuiesced``. Raises ``AssertionError`` naming
+    every violated law; returns the clean report otherwise. Benches
+    and the drill soak call this at their quiesce points instead of
+    re-deriving counter arithmetic by hand."""
+    report = invar_check(snapshot, plane)
+    if report["violations"]:
+        detail = "; ".join(
+            f"{name}: {v['detail']}"
+            for name, v in sorted(report["violations"].items()))
+        raise AssertionError(
+            f"ptpu_invar[{where or report['plane']}]: {detail} "
+            f"(PTPU_INVAR_OFF=1 disables)")
+    return report

@@ -18,6 +18,7 @@ What tier-1 proves here:
 Builds go through make (idempotent on a warm tree — `make selftest`
 already produced these binaries).
 """
+import importlib.util
 import os
 import re
 import subprocess
@@ -26,6 +27,56 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CSRC = os.path.join(REPO, "csrc")
+
+# The scenario/lock-class universe is DERIVED, never hand-bumped
+# (ISSUE 20 satellite): the expected scenario count comes from the
+# selftest's own registry, parsed with the sched checker's machinery
+# so this test and tools/ptpu_check.py can never disagree about what
+# exists.
+_spec = importlib.util.spec_from_file_location(
+    "_ptpu_check_for_schedck", os.path.join(REPO, "tools",
+                                            "ptpu_check.py"))
+ptpu_check = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(ptpu_check)
+
+
+def scenario_registry():
+    """The {"name", ...} rows of the selftest's scenario table — the
+    exact parse check_sched runs over the same TU."""
+    with open(os.path.join(REPO, ptpu_check.SCHED_SCENARIO_TU)) as fh:
+        src = fh.read()
+    return set(re.findall(
+        r'\{\s*"([a-z][a-z0-9_]*)"\s*,',
+        ptpu_check.strip_c_comments(src, keep_strings=True)))
+
+
+def coverage_rows():
+    """csrc/ptpu_schedck_coverage.txt as {lock class: [scenario...]}."""
+    rows = {}
+    with open(os.path.join(REPO, ptpu_check.SCHED_MANIFEST)) as fh:
+        for raw in fh:
+            line = raw.split("#", 1)[0].strip()
+            if line:
+                parts = line.split()
+                rows[parts[0]] = parts[1:]
+    return rows
+
+
+def production_lock_classes():
+    """Every PTPU_LOCK_CLASS declared in production csrc (the rank
+    table), via the checker's own source walk and declaration regex."""
+    classes = set()
+    for rel, fname in ptpu_check._csrc_sources(REPO):
+        if (ptpu_check._SCHED_TEST_TU.search(fname)
+                or fname in ptpu_check.SCHED_ENGINE_FILES):
+            continue
+        src = ptpu_check._read(REPO, rel)
+        if src is None:
+            continue
+        decls = ptpu_check.strip_c_comments(src, keep_strings=True)
+        for m in ptpu_check._LOCK_CLASS_DECL.finditer(decls):
+            classes.add(m.group(2))
+    return classes
 
 FIXTURES = {
     "lostwake": ("ptpu_schedck_fixture_lostwake",
@@ -80,16 +131,43 @@ def test_fixture_rediscovery_is_deterministic(name):
 
 
 def test_selftest_scenarios_green():
-    """Engine unit tests + all fourteen production-protocol scenarios:
-    DFS-exhaustive small configs, PCT sweep large ones (budget via
-    PTPU_SCHEDCK_SCHEDULES; the default 300 keeps tier-1 fast — the
-    run_checks.sh leg sweeps 10000)."""
+    """Engine unit tests + every registered production-protocol
+    scenario: DFS-exhaustive small configs, PCT sweep large ones
+    (budget via PTPU_SCHEDCK_SCHEDULES; the default 300 keeps tier-1
+    fast — the run_checks.sh leg sweeps 10000). The expected count is
+    DERIVED from the selftest's scenario registry — adding a scenario
+    must not require touching this test."""
+    registry = scenario_registry()
+    assert registry, "scenario registry parse came up empty"
     path = _built("ptpu_schedck_selftest")
     r = _run(path)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "all native schedck unit tests passed" in r.stdout
-    assert len(re.findall(r"\(exhaustive\)", r.stdout)) == 14, \
-        "every scenario's small config must exhaust its DFS space"
+    assert (len(re.findall(r"\(exhaustive\)", r.stdout))
+            == len(registry)), \
+        "every registered scenario's small config must exhaust its " \
+        "DFS space"
+
+
+def test_coverage_manifest_consistent_with_sources():
+    """The three derivation inputs agree with each other: every
+    coverage-manifest scenario exists in the registry, and the
+    manifest's lock-class rows are exactly the PTPU_LOCK_CLASS names
+    declared in production csrc (the rank table) — the same closure
+    check_sched enforces finding-by-finding, asserted here as set
+    algebra so a drift fails tier-1 even without the checker leg."""
+    registry = scenario_registry()
+    rows = coverage_rows()
+    classes = production_lock_classes()
+    mapped = set().union(*rows.values()) if rows else set()
+    assert mapped <= registry, \
+        f"coverage maps unknown scenarios: {sorted(mapped - registry)}"
+    assert classes == set(rows), \
+        f"rank table vs coverage rows drifted: " \
+        f"+{sorted(classes - set(rows))} -{sorted(set(rows) - classes)}"
+    # scenarios that model no lock class (pure-engine protocols) are
+    # fine; a manifest can never cover MORE scenarios than exist
+    assert len(rows) >= 1 and len(registry) >= len(mapped)
 
 
 def test_no_stray_trace_files_after_runs():

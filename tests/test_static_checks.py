@@ -31,7 +31,8 @@ ABI_FILES = [
     "csrc/ptpu_runtime.cc", "csrc/ptpu_ps_table.cc",
     "csrc/ptpu_ps_server.cc", "csrc/ptpu_predictor.cc",
     "csrc/ptpu_serving.cc", "csrc/ptpu_tune.cc", "csrc/ptpu_net.cc",
-    "csrc/ptpu_trace.cc", "csrc/ptpu_inference_api.h",
+    "csrc/ptpu_trace.cc", "csrc/ptpu_invar.cc",
+    "csrc/ptpu_inference_api.h",
     "paddle_tpu/core/native.py", "goapi/predictor.go",
 ]
 WIRE_FILES = [
@@ -98,7 +99,7 @@ class TestLiveTree:
         names = set(r.stdout.split())
         assert names == {"abi", "wire", "stats", "locks", "net",
                          "nullcheck", "trace", "sync", "fuzz",
-                         "sched"}
+                         "sched", "invar"}
 
 
 class TestAbiChecker:
@@ -876,6 +877,158 @@ class TestSchedChecker:
         os.remove(root / "csrc" / "ptpu_schedck_coverage.txt")
         msgs = [f.message for f in _run(root, "sched")]
         assert any("file missing" in m for m in msgs)
+
+
+_INVAR_MANIFEST = (
+    "counter serving server.requests csrc/ptpu_x.cc stats.requests\n"
+    "counter serving server.replies csrc/ptpu_x.cc stats.replies\n"
+    "counter serving server.req_errors csrc/ptpu_x.cc"
+    " stats.req_errors\n"
+    "counter serving server.err_frames csrc/ptpu_x.cc"
+    " stats.err_frames\n"
+    "invar serving req_balance server.requests == server.replies"
+    " + server.req_errors\n"
+    "pair csrc/ptpu_x.cc stats.req_errors stats.err_frames\n")
+
+_INVAR_TU = (
+    "void HandleOk() {\n"
+    "  stats.requests.Add(1);\n"
+    "  stats.replies.Add(1);\n"
+    "}\n"
+    "void HandleErr() {\n"
+    "  stats.requests.Add(1);\n"
+    "  stats.req_errors.Add(1);\n"
+    "  stats.err_frames.Add(1);\n"
+    "}\n"
+    "void HandleOpErr() {\n"
+    "  stats.err_frames.Add(1);\n"
+    "}\n"
+    "void Render(std::string& b) {\n"
+    '  AppendJsonU64(&b, "requests", stats.requests.Load());\n'
+    '  AppendJsonU64(&b, "replies", stats.replies.Load());\n'
+    '  AppendJsonU64(&b, "req_errors", stats.req_errors.Load());\n'
+    '  AppendJsonU64(&b, "err_frames", stats.err_frames.Load());\n'
+    "}\n")
+
+
+def _invar_tree(tmp_path):
+    """Minimal synthetic tree the invar checker accepts: one manifest
+    (req_balance law + the error-path pair), one production TU with
+    every bump site and a renderer, and a token-identical Python
+    twin."""
+    root = tmp_path / "tree"
+    (root / "csrc").mkdir(parents=True)
+    (root / "paddle_tpu" / "profiler").mkdir(parents=True)
+    (root / "csrc" / "ptpu_invar.h").write_text(
+        'const char* Manifest() { return R"INV(' + _INVAR_MANIFEST +
+        ')INV"; }\n')
+    (root / "csrc" / "ptpu_x.cc").write_text(_INVAR_TU)
+    (root / "paddle_tpu" / "profiler" / "stats.py").write_text(
+        "INVAR_MANIFEST = " + repr(_INVAR_MANIFEST) + "\n")
+    return root
+
+
+class TestInvarChecker:
+    """ISSUE 20: the conservation-law manifest's static flow rules —
+    each seeded violation is one real way a counter law rots."""
+
+    def test_clean_on_live_tree(self):
+        assert ptpu_check.check_invar(REPO) == []
+
+    def test_clean_fixture(self, tmp_path):
+        assert _run(_invar_tree(tmp_path), "invar") == []
+
+    def test_catches_deleted_bump_site(self, tmp_path):
+        """Rule A: deleting a counter's only bump site compiles fine
+        and the runtime law only trips once traffic hits the dead
+        path — the static leg must flag it immediately."""
+        root = _invar_tree(tmp_path)
+        _mutate(root, "csrc/ptpu_x.cc",
+                "  stats.replies.Add(1);\n", "")
+        msgs = [f.message for f in _run(root, "invar")]
+        assert any("server.replies" in m and "no bump site" in m
+                   and "req_balance" in m for m in msgs)
+
+    def test_catches_unpaired_error_path(self, tmp_path):
+        """Rule B: an error path bumping req_errors without its paired
+        total (err_frames) moves one side of a law; flagged at the
+        offending function, not the manifest."""
+        root = _invar_tree(tmp_path)
+        _mutate(root, "csrc/ptpu_x.cc",
+                "  stats.req_errors.Add(1);\n"
+                "  stats.err_frames.Add(1);\n",
+                "  stats.req_errors.Add(1);\n")
+        found = _run(root, "invar")
+        msgs = [f.message for f in found]
+        assert any("HandleErr()" in m and "stats.err_frames" in m
+                   for m in msgs)
+        assert any(f.path == "csrc/ptpu_x.cc" for f in found)
+
+    def test_catches_undeclared_bump_site(self, tmp_path):
+        """Rule C: a new TU bumping a bound counter changes the law's
+        meaning unless the manifest declares it."""
+        root = _invar_tree(tmp_path)
+        (root / "csrc" / "ptpu_y.cc").write_text(
+            "void Rogue() {\n"
+            "  stats.requests.Add(1);\n"
+            "}\n")
+        found = _run(root, "invar")
+        assert any(f.path == "csrc/ptpu_y.cc"
+                   and "does not declare" in f.message for f in found)
+
+    def test_catches_stale_manifest_name(self, tmp_path):
+        """Rule D: a renderer rename strands the bound path — the
+        runtime gate would skip or fail the law at every quiesce."""
+        root = _invar_tree(tmp_path)
+        _mutate(root, "csrc/ptpu_x.cc",
+                '"req_errors"', '"req_errorz"')
+        msgs = [f.message for f in _run(root, "invar")]
+        assert any("server.req_errors" in m
+                   and "no C snapshot renderer" in m for m in msgs)
+
+    def test_catches_python_twin_drift(self, tmp_path):
+        """Rule D: the two runtime gates must evaluate the same
+        algebra — a twin edit is flagged at the first differing
+        token."""
+        root = _invar_tree(tmp_path)
+        _mutate(root, "paddle_tpu/profiler/stats.py",
+                "req_balance", "req_balancx")
+        msgs = [f.message for f in _run(root, "invar")]
+        assert any("drifts from the C manifest" in m
+                   and "req_balancx" in m for m in msgs)
+
+    def test_deleted_bump_trips_both_legs(self, tmp_path):
+        """End-to-end negative: the SAME mutation — a deleted replies
+        bump — is caught statically (rule A above) AND by both runtime
+        evaluators once traffic runs: a snapshot accumulated without
+        that bump site violates req_balance at quiesce."""
+        root = _invar_tree(tmp_path)
+        _mutate(root, "csrc/ptpu_x.cc",
+                "  stats.replies.Add(1);\n", "")
+        assert any("no bump site" in f.message
+                   for f in _run(root, "invar"))
+        # what the mutated TU would accumulate after one HandleOk +
+        # one HandleErr: requests twice, replies never
+        snap = {"server": {"requests": 2, "replies": 0,
+                           "req_errors": 1, "op_errors": 0,
+                           "err_frames": 1, "conns_accepted": 0,
+                           "conns_closed": 0, "conns_active": 0},
+                "batcher": {}}
+        sys.path.insert(0, REPO)
+        from paddle_tpu.profiler.stats import invar_check
+        rep = invar_check(snap, "serving")
+        assert "req_balance" in rep["violations"]
+        import ctypes
+        import json
+        so = ctypes.CDLL(os.path.join(
+            REPO, "paddle_tpu", "_native_predictor.so"))
+        so.ptpu_invar_check_json.restype = ctypes.c_char_p
+        so.ptpu_invar_check_json.argtypes = [ctypes.c_char_p,
+                                             ctypes.c_char_p]
+        crep = json.loads(so.ptpu_invar_check_json(
+            json.dumps(snap).encode(), b"serving").decode())
+        assert "req_balance" in crep["violations"]
+        assert crep == rep  # twin evaluators agree on the verdict
 
 
 class TestFindingPlumbing:

@@ -68,6 +68,8 @@ import tempfile
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# bench server Stop() doubles as a hard conservation gate (ISSUE 20)
+os.environ.setdefault("PTPU_INVAR_FATAL", "1")
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -310,6 +312,11 @@ def main():
               "batches": st["batches"],
               "mean_fill": round(kv_steps / max(st["batches"], 1), 2)})
 
+        # session/page ledger balance (opens == closes + evictions +
+        # live, page conservation, ...) is the declarative invar
+        # gate's job; the bench keeps client-vs-server cross-checks
+        from paddle_tpu.profiler.stats import invar_assert
+        invar_assert(srv.stats(), "decode_bench_kv_leg")
         counters_exact = (st["steps"] == kv_steps and
                           st["replies"] == kv_steps and
                           st["opens"] == args.sessions and

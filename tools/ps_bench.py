@@ -47,6 +47,10 @@ DEPTH = int(os.environ.get("PTPU_PSBENCH_DEPTH", 6))
 # wider request merging than the library default: the bench hammers one
 # table, exactly the shape merging amortizes
 os.environ.setdefault("PTPU_PS_MERGE_ROWS", "8192")
+# the native PS server's Stop() runs the counter-conservation gate
+# (csrc/ptpu_invar.h); under the bench a violation is fatal, so every
+# worker teardown is itself a ledger check
+os.environ.setdefault("PTPU_INVAR_FATAL", "1")
 
 RESULTS: list = []
 

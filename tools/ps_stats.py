@@ -167,6 +167,12 @@ def main(argv=None):
                          "deltas)")
     ap.add_argument("--prom", action="store_true",
                     help="Prometheus exposition format")
+    ap.add_argument("--invar", action="store_true",
+                    help="conservation-law verdict instead of raw "
+                         "counters: GET /invarz over --http/--serving "
+                         "(the server's own C evaluator), or the "
+                         "profiler/stats.py twin over a control-plane "
+                         "snapshot; with --watch, polls")
     ap.add_argument("--watch", type=float, default=None, metavar="SEC",
                     help="poll every SEC seconds with ops/s deltas")
     ap.add_argument("--reset", action="store_true",
@@ -189,6 +195,33 @@ def main(argv=None):
         if endpoint:
             return fetch_http_stats(endpoint)
         return fetch_stats(a.master_port, a.rank, a.host)
+
+    if a.invar:
+        # one verdict per poll; `==` laws are authoritative only at
+        # quiesce (csrc/ptpu_invar.h), so a violation while traffic
+        # flows is informational — watch for one that PERSISTS
+        from paddle_tpu.profiler.stats import invar_check
+
+        def verdict():
+            if endpoint:
+                return json.loads(http_get(endpoint, "/invarz"))
+            snap = fetch()
+            if "server" not in snap and "wire" in snap:
+                # control-plane snapshots nest the C wire counters
+                # under "wire"; rehome them so law paths resolve
+                snap = dict(snap, server=snap["wire"])
+            return invar_check(snap)
+        while True:
+            rep = verdict()
+            tag = "OK" if not rep.get("violations") else "VIOLATED"
+            print(f"# {time.strftime('%H:%M:%S')} invar {tag} "
+                  f"(checked {rep.get('checked', 0)}, skipped "
+                  f"{rep.get('skipped', 0)})", flush=True)
+            print(json.dumps(rep, indent=1, sort_keys=True),
+                  flush=True)
+            if a.watch is None:
+                return
+            time.sleep(a.watch)
 
     def render(snap):
         if a.prom:

@@ -46,6 +46,15 @@ Go that the compiler cannot see across:
              spawn raw std::thread (invisible to the exploration),
              and PTPU_SCHED_POINT only appears with its self-gating
              header included
+  invar      counter-conservation manifest (ISSUE 20): every counter
+             csrc/ptpu_invar.h binds to a conservation law has a bump
+             site in its declared TU(s), `pair`ed error-path counters
+             move together per function body, no production TU bumps
+             a bound counter the manifest doesn't account for, law
+             terms resolve to bound paths whose leaves a C renderer
+             actually emits, and the Python twin manifest
+             (profiler/stats.py INVAR_MANIFEST) stays token-identical
+             with the C one — the static half of the ptpu_invar gate
   trace      request-tracing seam (ISSUE 10): the traced v2 frame
              extension (version byte, 8-byte trace-id insert, read and
              echo offsets) in csrc (ptpu_ps_server.cc, ptpu_serving.cc)
@@ -184,10 +193,12 @@ def _lineno(src: str, pos: int) -> int:
 SO_SOURCES = {
     "_native.so": ["csrc/ptpu_runtime.cc"],
     "_native_ps.so": ["csrc/ptpu_ps_table.cc", "csrc/ptpu_ps_server.cc",
-                      "csrc/ptpu_net.cc", "csrc/ptpu_trace.cc"],
+                      "csrc/ptpu_net.cc", "csrc/ptpu_trace.cc",
+                      "csrc/ptpu_invar.cc"],
     "_native_predictor.so": ["csrc/ptpu_predictor.cc",
                              "csrc/ptpu_serving.cc", "csrc/ptpu_tune.cc",
-                             "csrc/ptpu_net.cc", "csrc/ptpu_trace.cc"],
+                             "csrc/ptpu_net.cc", "csrc/ptpu_trace.cc",
+                             "csrc/ptpu_invar.cc"],
 }
 
 _EXPORT_RES = [
@@ -744,6 +755,7 @@ def py_stat_names(src: str) -> Set[str]:
 # serve loop is thread-per-connection multiprocessing.connection.
 # Additions here must be justified.
 PS_SERVER_C_ONLY = {"handshake_fails", "conns_accepted", "conns_active",
+                    "conns_closed",
                     "conns_shed", "handshake_timeouts", "idle_closes",
                     "epoll_wakeups", "partial_write_flushes",
                     "http_reqs",
@@ -1292,8 +1304,14 @@ def check_trace(root: str) -> List[Finding]:
     #    reporting silently, so both halves are pinned here.
     dr_rel = "tools/drill_replay.py"
     dr = _require(root, dr_rel, "trace", f)
+    consumer_checked: Set[str] = set()
     for route, c_rel in (("/capturez", "csrc/ptpu_net.cc"),
-                         ("/shadowz", "csrc/ptpu_serving.cc")):
+                         ("/shadowz", "csrc/ptpu_serving.cc"),
+                         # the conservation-law verdict route (ISSUE
+                         # 20): each plane serves it, the drill
+                         # harness polls it at soak quiesce
+                         ("/invarz", "csrc/ptpu_serving.cc"),
+                         ("/invarz", "csrc/ptpu_ps_server.cc")):
         c_src = _require(root, c_rel, "trace", f)
         if c_src is not None and \
                 f'"{route}"' not in strip_c_comments(
@@ -1303,11 +1321,13 @@ def check_trace(root: str) -> List[Finding]:
                 f"route {route} is not served (no \"{route}\" "
                 f"literal) — the drill harness consumes it "
                 f"(tools/drill_replay.py)"))
-        if dr is not None and f'"{route}' not in dr:
+        if dr is not None and route not in consumer_checked and \
+                f'"{route}' not in dr:
             f.append(Finding(
                 "trace", dr_rel, 0,
                 f"no consumer for route {route} — drill_replay.py "
                 f"must fetch it (route twin)"))
+        consumer_checked.add(route)
     return f
 
 
@@ -1775,6 +1795,323 @@ def check_sched(root: str) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# checker: invar
+# ---------------------------------------------------------------------------
+
+# ISSUE 20: the counter-conservation manifest (csrc/ptpu_invar.h)
+# declares the laws both runtime gates evaluate AND binds every
+# participating counter to the C++ member expression that bumps it and
+# the TU(s) allowed to bump it. The runtime gate can only prove laws
+# over whatever the counters actually accumulated — these rules prove
+# the FLOW side statically:
+#   A  every bound counter has at least one bump site in its declared
+#      TU(s) (a deleted bump site compiles fine and the runtime law
+#      only trips once traffic hits the dead path);
+#   B  `pair` rows: any function body bumping the first expression
+#      also touches the second (the nullcheck-style path rule — an
+#      error path that bumps one side of a law without its twin);
+#   C  no bound expression is bumped in a production TU outside the
+#      union of its declared files (a new bump site must be declared,
+#      or the law silently changes meaning);
+#   D  no stale names: law terms resolve to bound paths, bound leaves
+#      are actually rendered by some C snapshot renderer, gauge
+#      expressions still exist in their TU, and the Python twin
+#      manifest (profiler/stats.py INVAR_MANIFEST) is token-identical
+#      to the C one — the two evaluators must read the same algebra.
+
+INVAR_HEADER = "csrc/ptpu_invar.h"
+INVAR_PY_TWIN = "paddle_tpu/profiler/stats.py"
+
+# selftests, schedck fixtures and fuzz harnesses #include production
+# TUs and doctor snapshots, but never bump production counters
+# themselves — out of scope for the undeclared-bump scan
+_INVAR_TEST_TU = re.compile(
+    r"(?:_selftest\.cc|_fixture_\w+\.cc)$|^fuzz_|^gen_seeds")
+
+# accepted bump forms for a counter expression: ptpu::Counter's
+# .Add(n), and the raw-integer idioms the KV-pool ledger uses under
+# its own mutex (++x / x++ / x += n)
+def _invar_bump_re(expr: str) -> "re.Pattern[str]":
+    e = re.escape(expr)
+    return re.compile(
+        rf"(?:\+\+\s*{e}\b|\b{e}\s*\+\+|\b{e}\s*\+=|\b{e}\s*\.\s*Add\s*\()")
+
+
+def _invar_manifest_text(hdr: str, findings: List[Finding]) -> str:
+    m = re.search(r'R"INV\((.*?)\)INV"', hdr, re.S)
+    if m is None:
+        findings.append(Finding(
+            "invar", INVAR_HEADER, 0,
+            'manifest raw string R"INV(...)INV" not found'))
+        return ""
+    return m.group(1)
+
+
+def _invar_parse(text: str, findings: List[Finding]):
+    """Manifest rows -> (bindings, laws, pairs). Grammar errors become
+    findings (the manifest is itself a checked artifact)."""
+    bindings, laws, pairs = [], [], []
+    for i, raw in enumerate(text.splitlines(), 1):
+        line = raw.split("#", 1)[0].strip()
+        if not line:
+            continue
+        tok = line.split()
+        kind = tok[0]
+        if kind in ("counter", "gauge"):
+            if len(tok) != 5:
+                findings.append(Finding(
+                    "invar", INVAR_HEADER, i,
+                    f"malformed {kind} row '{line}' — format is "
+                    f"{kind} <planes> <path> <file,...> <expr>"))
+                continue
+            bindings.append({"kind": kind, "line": i,
+                             "planes": tok[1].split(","),
+                             "path": tok[2],
+                             "files": tok[3].split(","),
+                             "expr": tok[4]})
+        elif kind == "invar":
+            if len(tok) < 6 or tok[4] not in ("==", ">=") or \
+                    tok[6::2] != ["+"] * len(tok[6::2]):
+                findings.append(Finding(
+                    "invar", INVAR_HEADER, i,
+                    f"malformed invar row '{line}' — format is invar "
+                    f"<planes> <name> <path> ==|>= <path> [+ <path>...]"))
+                continue
+            laws.append({"line": i, "planes": tok[1].split(","),
+                         "name": tok[2], "lhs": tok[3], "op": tok[4],
+                         "rhs": tok[5::2]})
+        elif kind == "pair":
+            if len(tok) != 4:
+                findings.append(Finding(
+                    "invar", INVAR_HEADER, i,
+                    f"malformed pair row '{line}' — format is pair "
+                    f"<file> <exprA> <exprB>"))
+                continue
+            pairs.append({"line": i, "file": tok[1],
+                          "a": tok[2], "b": tok[3]})
+        else:
+            findings.append(Finding(
+                "invar", INVAR_HEADER, i,
+                f"unknown manifest keyword '{kind}'"))
+    return bindings, laws, pairs
+
+
+_INVAR_CTRL_KEYWORDS = {"if", "for", "while", "switch", "catch",
+                        "return", "sizeof", "alignof", "defined"}
+
+
+def _c_function_bodies(clean: str):
+    """Yield (name, body, line) for every plausible function
+    DEFINITION in comment-stripped C++ (any name, unlike
+    _c_functions' ptpu_* ABI filter). Bodies found inside other
+    bodies (local lambdas) are attributed to the enclosing match."""
+    for m in re.finditer(r"\b([A-Za-z_]\w*)\s*\(([^;{})]*)\)\s*"
+                         r"(?:const\s*|noexcept\s*|override\s*)*\{",
+                         clean):
+        name = m.group(1)
+        if name in _INVAR_CTRL_KEYWORDS:
+            continue
+        depth, i, n = 1, m.end(), len(clean)
+        while i < n and depth:
+            if clean[i] == "{":
+                depth += 1
+            elif clean[i] == "}":
+                depth -= 1
+            i += 1
+        yield name, clean[m.end():i], _lineno(clean, m.start())
+
+
+def check_invar(root: str) -> List[Finding]:
+    f: List[Finding] = []
+    hdr = _require(root, INVAR_HEADER, "invar", f)
+    if hdr is None:
+        return f
+    text = _invar_manifest_text(hdr, f)
+    bindings, laws, pairs = _invar_parse(text, f)
+
+    # production TU cache (comment-stripped, both with and without
+    # string literals) for the rules below
+    prod: Dict[str, str] = {}
+    prod_strs: Dict[str, str] = {}
+    for rel, fname in _csrc_sources(root):
+        if _INVAR_TEST_TU.search(fname) or fname == "ptpu_invar.h":
+            continue
+        src = _read(root, rel)
+        if src is None:
+            continue
+        prod[rel] = strip_c_comments(src)
+        prod_strs[rel] = strip_c_comments(src, keep_strings=True)
+
+    # ---- rule A: every counter binding has a bump site; gauges must
+    # at least still mention their expression (levels are computed or
+    # +/- adjusted, so no bump-form requirement)
+    for b in bindings:
+        rx = _invar_bump_re(b["expr"])
+        missing = [rel for rel in b["files"] if rel not in prod]
+        for rel in missing:
+            f.append(Finding(
+                "invar", INVAR_HEADER, b["line"],
+                f"binding for {b['path']} names {rel}, which is not a "
+                f"production csrc TU"))
+        have = [rel for rel in b["files"] if rel in prod]
+        if not have:
+            continue
+        if b["kind"] == "counter":
+            if not any(rx.search(prod[rel]) for rel in have):
+                using = "/".join(law["name"] for law in laws
+                                 if b["path"] in [law["lhs"]] +
+                                 law["rhs"]) or "declared"
+                f.append(Finding(
+                    "invar", INVAR_HEADER, b["line"],
+                    f"counter {b['path']} is bound to '{b['expr']}' in "
+                    f"{','.join(b['files'])} but no bump site "
+                    f"(.Add/++/+=) exists there — the {using} law "
+                    f"can no longer move"))
+        else:
+            if not any(b["expr"] in prod_strs[rel] for rel in have):
+                f.append(Finding(
+                    "invar", INVAR_HEADER, b["line"],
+                    f"gauge {b['path']} is bound to '{b['expr']}' in "
+                    f"{','.join(b['files'])} but the expression no "
+                    f"longer appears there — stale binding"))
+
+    # ---- rule B: pair discipline, per function body
+    for p in pairs:
+        src = prod.get(p["file"])
+        if src is None:
+            f.append(Finding(
+                "invar", INVAR_HEADER, p["line"],
+                f"pair row names {p['file']}, which is not a "
+                f"production csrc TU"))
+            continue
+        rx_a = _invar_bump_re(p["a"])
+        b_pat = re.compile(re.escape(p["b"]))
+        bumped_somewhere = False
+        for name, body, line in _c_function_bodies(src):
+            am = rx_a.search(body)
+            if not am:
+                continue
+            bumped_somewhere = True
+            if not b_pat.search(body):
+                f.append(Finding(
+                    "invar", p["file"],
+                    line + body[:am.start()].count("\n"),
+                    f"{name}() bumps {p['a']} without touching its "
+                    f"paired counter {p['b']} (pair rule, "
+                    f"{INVAR_HEADER}:{p['line']}) — an error path "
+                    f"moving one side of a conservation law"))
+        if not bumped_somewhere:
+            f.append(Finding(
+                "invar", INVAR_HEADER, p["line"],
+                f"pair row ({p['a']}, {p['b']}) matches no function "
+                f"in {p['file']} that bumps {p['a']} — stale pair"))
+
+    # ---- rule C: no undeclared bump site of a bound counter
+    # expression anywhere in production csrc (union of declared files
+    # across ALL bindings of that expression — e.g. stats.err_frames
+    # is legitimately bumped by both wire servers)
+    allowed: Dict[str, Set[str]] = {}
+    for b in bindings:
+        if b["kind"] == "counter":
+            allowed.setdefault(b["expr"], set()).update(b["files"])
+    for expr, files in sorted(allowed.items()):
+        rx = _invar_bump_re(expr)
+        for rel, clean in sorted(prod.items()):
+            if rel in files:
+                continue
+            m = rx.search(clean)
+            if m:
+                f.append(Finding(
+                    "invar", rel, _lineno(clean, m.start()),
+                    f"bump site for manifest-bound counter '{expr}' "
+                    f"in a TU the manifest does not declare "
+                    f"(declared: {','.join(sorted(files))}) — declare "
+                    f"it in {INVAR_HEADER} or the law silently "
+                    f"changes meaning"))
+
+    # ---- rule D: stale names
+    bound_paths: Dict[str, Set[str]] = {}
+    for b in bindings:
+        bound_paths.setdefault(b["path"], set()).update(b["planes"])
+    for law in laws:
+        for term in [law["lhs"]] + law["rhs"]:
+            planes = bound_paths.get(term)
+            if planes is None:
+                f.append(Finding(
+                    "invar", INVAR_HEADER, law["line"],
+                    f"law {law['name']} references {term}, which no "
+                    f"counter/gauge row binds"))
+            else:
+                for pl in law["planes"]:
+                    if pl not in planes:
+                        f.append(Finding(
+                            "invar", INVAR_HEADER, law["line"],
+                            f"law {law['name']} runs on plane '{pl}' "
+                            f"but {term} is only bound for "
+                            f"{','.join(sorted(planes))}"))
+    rendered: Set[str] = set()
+    for rel, clean in prod_strs.items():
+        if rel.endswith(".cc"):
+            rendered |= set(c_json_names(clean))
+    for b in bindings:
+        leaf = b["path"].rsplit(".", 1)[-1]
+        if leaf not in rendered:
+            f.append(Finding(
+                "invar", INVAR_HEADER, b["line"],
+                f"manifest binds {b['path']} but no C snapshot "
+                f"renderer emits '{leaf}' — stale manifest name (the "
+                f"runtime gate would skip or fail the law)"))
+
+    # the Python twin evaluates the SAME algebra without a csrc
+    # checkout: token-identical or the two gates diverge
+    py = _require(root, INVAR_PY_TWIN, "invar", f)
+    if py is not None and text:
+        twin = None
+        try:
+            tree = ast.parse(py)
+        except SyntaxError as e:
+            f.append(Finding("invar", INVAR_PY_TWIN, e.lineno or 0,
+                             f"cannot parse: {e.msg}"))
+            tree = None
+        if tree is not None:
+            for node in tree.body:
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name) and \
+                        node.targets[0].id == "INVAR_MANIFEST":
+                    try:
+                        twin = ast.literal_eval(node.value)
+                    except (ValueError, TypeError):
+                        f.append(Finding(
+                            "invar", INVAR_PY_TWIN, node.lineno,
+                            "INVAR_MANIFEST is not a literal string"))
+                    break
+            if twin is None:
+                f.append(Finding(
+                    "invar", INVAR_PY_TWIN, 0,
+                    "INVAR_MANIFEST twin string not found"))
+            elif not isinstance(twin, str):
+                f.append(Finding(
+                    "invar", INVAR_PY_TWIN, 0,
+                    "INVAR_MANIFEST twin is not a string"))
+            else:
+                ct, pt = text.split(), twin.split()
+                if ct != pt:
+                    idx = next((i for i, (a, bb) in
+                                enumerate(zip(ct, pt)) if a != bb),
+                               min(len(ct), len(pt)))
+                    ctok = ct[idx] if idx < len(ct) else "<end>"
+                    ptok = pt[idx] if idx < len(pt) else "<end>"
+                    f.append(Finding(
+                        "invar", INVAR_PY_TWIN, 0,
+                        f"INVAR_MANIFEST drifts from the C manifest "
+                        f"at token {idx}: C has '{ctok}', Python has "
+                        f"'{ptok}' — the two runtime gates would "
+                        f"evaluate different algebras"))
+    return f
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -1789,6 +2126,7 @@ CHECKERS = {
     "sync": check_sync,
     "fuzz": check_fuzz,
     "sched": check_sched,
+    "invar": check_invar,
 }
 
 

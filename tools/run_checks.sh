@@ -8,9 +8,13 @@
 #                    exists — selftests run LOCKDEP-enabled (the
 #                    ranked-mutex validator, csrc/ptpu_sync.h) in
 #                    every leg
-#   ptpu_check       the 10 static checkers (ABI / wire / stats /
+#   ptpu_check       the 11 static checkers (ABI / wire / stats /
 #                    locks / net / nullcheck / trace / sync / fuzz /
-#                    sched) — 0 findings required
+#                    sched / invar) — 0 findings required
+#   invar twin       the conservation-law manifest's Python twin
+#                    (profiler/stats.py) evaluated against both live
+#                    .so engines: byte-identical manifest, identical
+#                    reports on the same snapshot
 #   selftest         the plain (lockdep-enabled, uninstrumented)
 #                    native selftests incl. the seeded ABBA fixture
 #   schedck          the concurrency model checker (csrc/ptpu_schedck)
@@ -65,8 +69,30 @@ else
   step "sancheck: TSan SKIPPED (no usable libtsan on this machine)"
 fi
 
-step "ptpu_check: static analysis (10 checkers, 0 findings required)"
+step "ptpu_check: static analysis (11 checkers, 0 findings required)"
 python3 tools/ptpu_check.py
+
+step "invar twin: C engine vs profiler/stats.py manifest + report parity"
+python3 - <<'PY'
+import ctypes, json, os, sys
+sys.path.insert(0, os.getcwd())
+from paddle_tpu.profiler.stats import INVAR_MANIFEST, invar_check
+snap = json.dumps({
+    "server": {"conns_accepted": 3, "conns_closed": 3, "conns_active": 0,
+               "requests": 7, "replies": 6, "req_errors": 1,
+               "op_errors": 0, "err_frames": 1},
+    "batcher": {"batches": 2}})
+for lib in ("_native_predictor.so", "_native_ps.so"):
+    so = ctypes.CDLL(os.path.join("paddle_tpu", lib))
+    so.ptpu_invar_manifest.restype = ctypes.c_char_p
+    so.ptpu_invar_check_json.restype = ctypes.c_char_p
+    so.ptpu_invar_check_json.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    assert so.ptpu_invar_manifest().decode() == INVAR_MANIFEST, lib
+    crep = json.loads(so.ptpu_invar_check_json(snap.encode(),
+                                               b"serving").decode())
+    assert crep == invar_check(json.loads(snap), "serving"), (lib, crep)
+print("invar twin: manifest + report parity OK (both .so engines)")
+PY
 
 step "native selftests (uninstrumented, lockdep-enabled)"
 make -C csrc -j"$JOBS" selftest
@@ -95,9 +121,11 @@ done
 # Opt-in chaos soak (production drills, ISSUE 18): DRILL_SOAK_SECS=N
 # runs the two-phase selfsoak — lossless chaos (read/write delays,
 # short writes), then lossy (conn kills, handshake drops) — each
-# ending in EXACT server==client counter reconciliation and a
-# drained-connections check. Off by default: it needs the Python
-# serving stack, not just the csrc toolchain.
+# ending in a drained-connections check, the declarative ptpu_invar
+# conservation gate at quiesce (r20: PTPU_INVAR_FATAL=1 hard-gates
+# every server Stop(), and invar_assert replaces the hand-written
+# ledger arithmetic), and client-vs-server cross-checks. Off by
+# default: it needs the Python serving stack, not just csrc.
 if [[ -n "${DRILL_SOAK_SECS:-}" ]]; then
   step "drill soak: ${DRILL_SOAK_SECS}s two-phase chaos reconciliation"
   JAX_PLATFORMS=cpu python3 tools/drill_replay.py selfsoak \
